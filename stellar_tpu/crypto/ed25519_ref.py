@@ -1,0 +1,301 @@
+"""Pure-Python ed25519 reference implementation with libsodium-exact verify
+semantics.
+
+This is the consensus-critical oracle: the TPU batch verifier
+(``stellar_tpu.ops.verify``) must agree bit-for-bit with this module's
+accept/reject decisions, and this module mirrors libsodium's
+``crypto_sign_verify_detached`` (the reference's verify path behind
+``PubKeyUtils::verifySig``, reference ``src/crypto/SecretKey.cpp:435-468``):
+
+  * reject if S is non-canonical (S >= L)                 [sc25519_is_canonical]
+  * reject if R (sig[0:32]) encodes a small-order point   [ge25519_has_small_order]
+  * reject if A (pk) is non-canonical (y >= p)            [ge25519_is_canonical]
+  * reject if A encodes a small-order point
+  * reject if A fails point decompression
+  * compute h = SHA512(R || A || M) mod L
+  * accept iff encode(s*B - h*A) == R  (bytewise, cofactorless)
+
+The small-order check operates on raw encodings with the sign bit masked,
+exactly like libsodium's blocklist comparison, so non-canonical encodings of
+small-order points (y = p, y = p+1) are rejected too.
+
+Performance is irrelevant here — this is for tests, key generation, and the
+CPU fallback verifier. The hot path lives in ``stellar_tpu/ops``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = [
+    "P",
+    "L",
+    "D",
+    "verify",
+    "verify_detailed",
+    "sign",
+    "secret_to_public",
+    "scalarmult_base",
+    "point_decompress",
+    "point_compress",
+    "point_add",
+    "point_mul",
+    "IDENTITY",
+    "BASE",
+    "SMALL_ORDER_ENCODINGS",
+]
+
+# Field prime, group order, curve constant d = -121665/121666 mod p.
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+
+def _inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+# Points are extended homogeneous coordinates (X, Y, Z, T) with x = X/Z,
+# y = Y/Z, x*y = T/Z.
+IDENTITY = (0, 1, 1, 0)
+
+
+def point_add(p1, p2):
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2 = p2
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_double(p1):
+    # dedicated doubling (RFC 8032 / ref10 ge25519_p2_dbl semantics)
+    x1, y1, z1, _ = p1
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = (a + b) % P
+    e = (h - (x1 + y1) * (x1 + y1)) % P
+    g = (a - b) % P
+    f = (c + g) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_mul(s: int, p1):
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p1)
+        p1 = point_double(p1)
+        s >>= 1
+    return q
+
+
+def point_equal(p1, p2) -> bool:
+    x1, y1, z1, _ = p1
+    x2, y2, z2, _ = p2
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def point_compress(p1) -> bytes:
+    x1, y1, z1, _ = p1
+    zinv = _inv(z1)
+    x = x1 * zinv % P
+    y = y1 * zinv % P
+    return ((y | ((x & 1) << 255)).to_bytes(32, "little"))
+
+
+def _sqrt_ratio(u: int, v: int):
+    """Return (ok, x) with x = sqrt(u/v) using the ref10 candidate-root
+    method: x = u*v^3 * (u*v^7)^((p-5)/8), corrected by sqrt(-1)."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    x = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    vxx = x * x % P * v % P
+    if vxx == u % P:
+        return True, x
+    if vxx == (-u) % P:
+        return True, x * SQRT_M1 % P
+    return False, 0
+
+
+def point_decompress(s: bytes):
+    """Decompress a 32-byte encoding; returns extended point or None.
+
+    Mirrors libsodium ge25519_frombytes: the y coordinate is taken mod p
+    implicitly (non-canonical y still decompresses here — callers that need
+    libsodium verify semantics must apply the canonicity/small-order checks
+    separately, as verify() does)."""
+    if len(s) != 32:
+        raise ValueError("bad encoding length")
+    n = int.from_bytes(s, "little")
+    sign = (n >> 255) & 1
+    y = n & ((1 << 255) - 1)
+    y %= P
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    ok, x = _sqrt_ratio(u, v)
+    if not ok:
+        return None
+    if x == 0 and sign == 1:
+        return None  # "negative zero" rejected (ref10 frombytes)
+    if x & 1 != sign:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+# Base point: y = 4/5 mod p, x positive-even per RFC 8032 (x is "even"? sign
+# bit 0 encodes x with LSB 0 ... the standard base point has x with LSB 0).
+_by = 4 * _inv(5) % P
+_bp = point_decompress(_by.to_bytes(32, "little"))
+assert _bp is not None
+BASE = _bp
+
+
+def _small_order_encodings():
+    """All 32-byte encodings rejected by libsodium's ge25519_has_small_order:
+    canonical encodings of the 8 small-order points plus the non-canonical
+    aliases y=p, y=p+1 — compared with the sign bit masked off."""
+    # Find a point of order exactly 8: take L*P for random-ish points P.
+    y = 2
+    q8 = None
+    while q8 is None:
+        pt = point_decompress((y).to_bytes(32, "little"))
+        y += 1
+        if pt is None:
+            continue
+        cand = point_mul(L, pt)
+        if (not point_equal(cand, IDENTITY)
+                and not point_equal(point_double(cand), IDENTITY)
+                and not point_equal(point_double(point_double(cand)),
+                                    IDENTITY)):
+            q8 = cand
+    encs = set()
+    cur = IDENTITY
+    for _ in range(8):
+        enc = bytearray(point_compress(cur))
+        enc[31] &= 0x7F  # sign bit masked in the comparison
+        encs.add(bytes(enc))
+        cur = point_add(cur, q8)
+    # Non-canonical aliases of y=0 and y=1 (y = p, y = p + 1 fit in 255 bits).
+    encs.add(P.to_bytes(32, "little"))
+    encs.add((P + 1).to_bytes(32, "little"))
+    return frozenset(encs)
+
+
+SMALL_ORDER_ENCODINGS = _small_order_encodings()
+
+
+def has_small_order(s: bytes) -> bool:
+    masked = bytearray(s)
+    masked[31] &= 0x7F
+    return bytes(masked) in SMALL_ORDER_ENCODINGS
+
+
+def is_canonical_point(s: bytes) -> bool:
+    """libsodium ge25519_is_canonical: the 255-bit y must be < p."""
+    y = int.from_bytes(s, "little") & ((1 << 255) - 1)
+    return y < P
+
+
+def is_canonical_scalar(s: bytes) -> bool:
+    return int.from_bytes(s, "little") < L
+
+
+def sha512_mod_l(*chunks: bytes) -> int:
+    h = hashlib.sha512()
+    for c in chunks:
+        h.update(c)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def verify_detailed(pk: bytes, msg: bytes, sig: bytes) -> dict:
+    """Verify with per-check breakdown (for differential tests vs the TPU
+    path). Returns dict of named check booleans plus 'ok'."""
+    out = {
+        "s_canonical": False,
+        "r_not_small": False,
+        "a_canonical": False,
+        "a_not_small": False,
+        "a_decompressed": False,
+        "r_match": False,
+        "ok": False,
+    }
+    if len(pk) != 32 or len(sig) != 64:
+        return out
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    out["s_canonical"] = is_canonical_scalar(s_bytes)
+    out["r_not_small"] = not has_small_order(r_bytes)
+    out["a_canonical"] = is_canonical_point(pk)
+    out["a_not_small"] = not has_small_order(pk)
+    a = point_decompress(pk)
+    out["a_decompressed"] = a is not None
+    if a is None:
+        return out
+    s = int.from_bytes(s_bytes, "little")
+    h = sha512_mod_l(r_bytes, pk, msg)
+    # R' = s*B - h*A  (libsodium: double_scalarmult(h, -A, s))
+    neg_a = (P - a[0], a[1], a[2], (P - a[3]) % P)
+    rprime = point_add(point_mul(s % L, BASE), point_mul(h, neg_a))
+    out["r_match"] = point_compress(rprime) == r_bytes
+    out["ok"] = (out["s_canonical"] and out["r_not_small"]
+                 and out["a_canonical"] and out["a_not_small"]
+                 and out["a_decompressed"] and out["r_match"])
+    return out
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """libsodium-exact crypto_sign_verify_detached."""
+    if len(pk) != 32 or len(sig) != 64:
+        return False
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    if not is_canonical_scalar(s_bytes):
+        return False
+    if has_small_order(r_bytes) or has_small_order(pk):
+        return False
+    if not is_canonical_point(pk):
+        return False
+    a = point_decompress(pk)
+    if a is None:
+        return False
+    s = int.from_bytes(s_bytes, "little")
+    h = sha512_mod_l(r_bytes, pk, msg)
+    neg_a = (P - a[0], a[1], a[2], (P - a[3]) % P)
+    rprime = point_add(point_mul(s % L, BASE), point_mul(h, neg_a))
+    return point_compress(rprime) == r_bytes
+
+
+def _clamp(k: bytes) -> int:
+    a = bytearray(k)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+def secret_to_public(seed: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    return point_compress(point_mul(a, BASE))
+
+
+def scalarmult_base(s: int) -> bytes:
+    return point_compress(point_mul(s, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 ed25519 signing from a 32-byte seed."""
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    prefix = h[32:]
+    pk = point_compress(point_mul(a, BASE))
+    r = sha512_mod_l(prefix, msg)
+    r_enc = point_compress(point_mul(r, BASE))
+    k = sha512_mod_l(r_enc, pk, msg)
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")

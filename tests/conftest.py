@@ -1,0 +1,43 @@
+"""Test configuration: force CPU with an 8-device virtual mesh so multi-chip
+sharding (jax.sharding.Mesh + shard_map) is exercised without TPU hardware.
+
+The ambient environment pins JAX_PLATFORMS=axon (the real TPU tunnel) and a
+sitecustomize hook registers the axon PJRT plugin in every interpreter. JAX
+initializes registered plugins even when JAX_PLATFORMS=cpu, so if the TPU
+tunnel is unhealthy every first array creation hangs. Tests therefore both
+override JAX_PLATFORMS *and* deregister the axon backend factory before any
+backend is initialized. Only bench.py talks to the real chip.
+
+Must run before jax arrays are created anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _drop_axon_backend():
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+    except Exception:
+        return
+    try:
+        # The axon register hook hard-sets jax_platforms="axon,cpu" in the
+        # config (env var alone doesn't win); point it back at cpu.
+        jax.config.update("jax_platforms", "cpu")
+        with xb._backend_lock:
+            if xb._backends:
+                return  # backends already initialized; too late, leave it
+            for name in list(xb._backend_factories):
+                if name not in ("cpu", "interpreter"):
+                    del xb._backend_factories[name]
+    except Exception:
+        pass
+
+
+_drop_axon_backend()

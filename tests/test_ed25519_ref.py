@@ -1,0 +1,129 @@
+"""Reference ed25519 oracle tests: RFC 8032 vectors, differential vs OpenSSL
+(`cryptography`), and libsodium edge-case semantics (canonicality, small
+order). Mirrors the reference's crypto tests
+(src/crypto/test/CryptoTests.cpp sign/verify suites)."""
+
+import os
+
+import pytest
+
+from stellar_tpu.crypto import ed25519_ref as ref
+
+# RFC 8032 §7.1 test vectors (seed, pk, msg, sig).
+RFC8032 = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pk,msg,sig", RFC8032)
+def test_rfc8032_vectors(seed, pk, msg, sig):
+    seed, pk, msg, sig = (bytes.fromhex(seed), bytes.fromhex(pk),
+                          bytes.fromhex(msg), bytes.fromhex(sig))
+    assert ref.secret_to_public(seed) == pk
+    assert ref.sign(seed, msg) == sig
+    assert ref.verify(pk, msg, sig)
+
+
+def test_differential_vs_openssl():
+    """Our sign/verify must agree with OpenSSL on honest signatures."""
+    crypto = pytest.importorskip("cryptography.hazmat.primitives.asymmetric.ed25519")
+    for i in range(20):
+        seed = bytes([i]) * 31 + bytes([7])
+        sk = crypto.Ed25519PrivateKey.from_private_bytes(seed)
+        from cryptography.hazmat.primitives import serialization
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        assert ref.secret_to_public(seed) == pk
+        msg = os.urandom(i * 3)
+        sig = sk.sign(msg)
+        assert ref.sign(seed, msg) == sig
+        assert ref.verify(pk, msg, sig)
+
+
+def test_reject_bitflips():
+    seed = b"\x01" * 32
+    msg = b"stellar tpu"
+    pk = ref.secret_to_public(seed)
+    sig = ref.sign(seed, msg)
+    assert ref.verify(pk, msg, sig)
+    for pos in [0, 10, 31, 32, 40, 63]:
+        bad = bytearray(sig)
+        bad[pos] ^= 1
+        assert not ref.verify(pk, msg, bytes(bad))
+    assert not ref.verify(pk, msg + b"x", sig)
+    bad_pk = bytearray(pk)
+    bad_pk[3] ^= 1
+    assert not ref.verify(bytes(bad_pk), msg, sig)
+
+
+def test_noncanonical_s_rejected():
+    """libsodium rejects S >= L (malleability)."""
+    seed = b"\x02" * 32
+    msg = b"m"
+    pk = ref.secret_to_public(seed)
+    sig = ref.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    s_mall = s + ref.L
+    assert s_mall < 2**256
+    sig_mall = sig[:32] + s_mall.to_bytes(32, "little")
+    assert not ref.verify(pk, msg, sig_mall)
+
+
+def test_small_order_pk_and_r_rejected():
+    msg = b"m"
+    for enc in sorted(ref.SMALL_ORDER_ENCODINGS):
+        assert not ref.verify(enc, msg, b"\x01" * 32 + b"\x00" * 32)
+        # small-order R: rejected before any scalar math
+        pk = ref.secret_to_public(b"\x03" * 32)
+        assert not ref.verify(pk, msg, enc + b"\x00" * 32)
+        # sign-bit variant also rejected (blocklist masks bit 255)
+        flipped = bytearray(enc)
+        flipped[31] |= 0x80
+        assert not ref.verify(bytes(flipped), msg, b"\x01" * 32 + b"\x00" * 32)
+
+
+def test_noncanonical_pk_rejected():
+    """y >= p: e.g. y = p + 3 (if on curve) must be rejected even though it
+    decompresses mod p."""
+    for delta in range(2, 19):
+        enc = (ref.P + delta).to_bytes(32, "little")
+        if ref.point_decompress(enc) is not None:
+            assert not ref.is_canonical_point(enc)
+            assert not ref.verify(enc, b"m", b"\x01" * 32 + b"\x00" * 32)
+            break
+    else:
+        pytest.skip("no decompressible non-canonical y in range")
+
+
+def test_small_order_encodings_shape():
+    # 8 canonical small-order encodings (sign-masked) + 2 non-canonical
+    # aliases; some canonical ones coincide after masking, so >= 7.
+    assert len(ref.SMALL_ORDER_ENCODINGS) >= 7
+    assert ref.P.to_bytes(32, "little") in ref.SMALL_ORDER_ENCODINGS
+
+
+def test_scalar_edge_cases():
+    # s = 0 is canonical; s = L-1 canonical; s = L not.
+    assert ref.is_canonical_scalar(b"\x00" * 32)
+    assert ref.is_canonical_scalar((ref.L - 1).to_bytes(32, "little"))
+    assert not ref.is_canonical_scalar(ref.L.to_bytes(32, "little"))

@@ -1,0 +1,131 @@
+"""Differential tests of the JAX GF(2^255-19) limb arithmetic against Python
+big-int math, including adversarial boundary values."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from stellar_tpu.ops import field25519 as fe
+
+P = fe.P
+
+
+def rand_vals(n, rng):
+    vals = [
+        0, 1, 2, P - 1, P, P + 1, 2 * P - 1, 2**255 - 1, 2**256 - 1,
+        2**260 - 1, 19, 608, (1 << 255) - 19 - 1,
+    ]
+    while len(vals) < n:
+        vals.append(rng.getrandbits(260))
+    return vals[:n]
+
+
+def pack(vals):
+    """ints -> (20, N) int32 normalized limbs (values taken mod 2^260, limbs
+    < 2^13 — may represent non-canonical residues, as ops allow)."""
+    arr = np.zeros((fe.NLIMBS, len(vals)), dtype=np.int32)
+    for j, v in enumerate(vals):
+        v %= 1 << 260
+        for i in range(fe.NLIMBS):
+            arr[i, j] = (v >> (fe.BITS * i)) & fe.MASK
+    return jnp.asarray(arr)
+
+
+def test_roundtrip():
+    rng = random.Random(1)
+    vals = rand_vals(64, rng)
+    a = pack(vals)
+    back = fe.to_int(a)
+    for j, v in enumerate(vals):
+        assert back[j] == v % (1 << 260)
+
+
+@pytest.mark.parametrize("op,pyop", [
+    ("add", lambda x, y: (x + y) % P),
+    ("sub", lambda x, y: (x - y) % P),
+    ("mul", lambda x, y: (x * y) % P),
+])
+def test_binary_ops(op, pyop):
+    rng = random.Random(2)
+    xs = rand_vals(128, rng)
+    ys = list(reversed(rand_vals(128, rng)))
+    a, b = pack(xs), pack(ys)
+    f = jax.jit(getattr(fe, op))
+    got = fe.to_int(f(a, b))
+    got_norm = np.asarray(fe.canon(jnp.asarray(pack([int(g) for g in got]))))
+    got_ints = fe.to_int(got_norm)
+    for j, (x, y) in enumerate(zip(xs, ys)):
+        assert got_ints[j] == pyop(x, y), (op, j, x, y)
+        # also: raw result must be loose-bounded (no int32 overflow risk)
+    raw = np.asarray(f(a, b))
+    assert (raw >= 0).all() and (raw <= fe.LOOSE_MAX).all()
+
+
+def test_mul_no_overflow_worst_case():
+    """All-limbs-at-LOOSE_MAX through mul must not overflow int32 and must
+    produce loose output — validates the carry-bound analysis."""
+    worst = jnp.full((fe.NLIMBS, 4), fe.LOOSE_MAX, dtype=jnp.int32)
+    out = fe.mul(worst, worst)
+    v = fe.to_int(out)[0]
+    x = fe.to_int(worst)[0]
+    assert v % P == (x * x) % P
+    assert (np.asarray(out) >= 0).all()
+    assert (np.asarray(out) <= fe.LOOSE_MAX).all()
+
+
+def test_ops_closed_under_loose():
+    """Chained ops on worst-case loose inputs stay loose (int64 shadow check
+    that no int32 overflow can occur)."""
+    worst = jnp.full((fe.NLIMBS, 2), fe.LOOSE_MAX, dtype=jnp.int32)
+    w = int(fe.to_int(worst)[0])
+    x = worst
+    expect = w
+    for step, (op, pyop) in enumerate([
+            (lambda u: fe.add(u, worst), lambda e: e + w),
+            (lambda u: fe.sub(u, worst), lambda e: e - w),
+            (lambda u: fe.mul(u, worst), lambda e: e * w),
+            (lambda u: fe.sqr(u), lambda e: e * e),
+            (lambda u: fe.mul_small(u, 121666), lambda e: e * 121666),
+    ]):
+        x = op(x)
+        expect = pyop(expect) % P
+        raw = np.asarray(x)
+        assert (raw >= 0).all() and (raw <= fe.LOOSE_MAX).all(), step
+        got = fe.to_int(fe.canon(x))
+        assert int(got[0]) == expect, step
+
+
+def test_canon():
+    rng = random.Random(3)
+    vals = rand_vals(64, rng)
+    a = pack(vals)
+    c = np.asarray(jax.jit(fe.canon)(a))
+    ints = fe.to_int(c)
+    for j, v in enumerate(vals):
+        assert ints[j] == (v % (1 << 260)) % P
+
+
+def test_inv_and_pow22523():
+    rng = random.Random(4)
+    vals = [v for v in rand_vals(32, rng) if v % P != 0]
+    a = pack(vals)
+    got = fe.to_int(jax.jit(fe.inv)(a))
+    got = [int(g) % P for g in fe.to_int(fe.canon(pack([int(x) for x in got])))]
+    for j, v in enumerate(vals):
+        assert got[j] == pow(v % P, P - 2, P)
+    got2 = fe.to_int(fe.canon(jax.jit(fe.pow22523)(a)))
+    for j, v in enumerate(vals):
+        assert int(got2[j]) == pow(v % P, (P - 5) // 8, P)
+
+
+def test_eq_is_zero_select():
+    a = pack([5, P + 5, 0, P, 7])
+    b = pack([5, 5, 0, 0, 8])
+    assert list(np.asarray(fe.eq(a, b))) == [True, True, True, True, False]
+    assert list(np.asarray(fe.is_zero(pack([0, P, 1, 2 * P])))) == [
+        True, True, False, True]
+    sel = fe.select(jnp.array([True, False]), pack([1, 1]), pack([2, 2]))
+    assert list(fe.to_int(fe.canon(sel))) == [1, 2]
