@@ -1,0 +1,82 @@
+"""North-star benchmark: verify a 1,000-tx TxSet's worth of ed25519
+signatures (~2k sigs) end-to-end (host prep + TPU kernel + readback).
+
+Prints ONE JSON line:
+  {"metric": "txset_sigverify_p50_ms", "value": ..., "unit": "ms",
+   "vs_baseline": ...}
+
+vs_baseline = (single-core CPU verify time for the same batch) / (our
+p50) — i.e. speedup over the libsodium-class baseline (OpenSSL ed25519 via
+`cryptography`, same order of magnitude as libsodium's
+crypto_sign_verify_detached on one core; reference harness:
+SecretKey::benchmarkOpsPerSecond, src/crypto/SecretKey.cpp:193-233).
+"""
+
+import json
+import secrets
+import sys
+import time
+
+import numpy as np
+
+N_SIGS = 2048
+REPS = 20
+
+
+def gen_sigs(n):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    items = []
+    keys = [Ed25519PrivateKey.generate() for _ in range(64)]
+    pks = [k.public_key().public_bytes_raw() for k in keys]
+    for i in range(n):
+        k = i % len(keys)
+        msg = secrets.token_bytes(120)  # ~ tx hash + envelope-ish payload
+        items.append((pks[k], msg, keys[k].sign(msg)))
+    return items
+
+
+def cpu_baseline_ms(items):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey)
+    sub = items[:256]
+    loaded = [(Ed25519PublicKey.from_public_bytes(pk), m, s)
+              for pk, m, s in sub]
+    t0 = time.perf_counter()
+    for pk, m, s in loaded:
+        pk.verify(s, m)
+    dt = time.perf_counter() - t0
+    return dt * 1000.0 * (len(items) / len(sub))
+
+
+def main():
+    from stellar_tpu.crypto.batch_verifier import BatchVerifier
+
+    items = gen_sigs(N_SIGS)
+    v = BatchVerifier(bucket_sizes=(N_SIGS,))
+
+    # warmup / compile
+    for _ in range(2):
+        out = v.verify_batch(items)
+    assert out.all(), "benchmark signatures must verify"
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = v.verify_batch(items)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    assert out.all()
+    p50 = float(np.median(times))
+
+    base = cpu_baseline_ms(items)
+    print(json.dumps({
+        "metric": "txset_sigverify_p50_ms",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(base / p50, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Device-mesh helpers for the batch crypto backend.
+
+The reference scales signature verification per-core with a worker pool
+(`ApplicationImpl.cpp:171-178` worker threads); the TPU-native design
+instead shards the signature batch axis across a 1-D chip mesh via
+``shard_map`` — pure data parallelism over ICI, no collectives on the hot
+path. Multi-host pods extend the same mesh over DCN transparently through
+``jax.distributed`` (same code path; the mesh just gets bigger).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["batch_mesh", "device_count"]
+
+
+def device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def batch_mesh(n: Optional[int] = None, axis: str = "batch"):
+    """1-D mesh over the first ``n`` (default: all) local devices."""
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), (axis,))

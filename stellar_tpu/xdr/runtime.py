@@ -1,0 +1,396 @@
+"""Minimal XDR (RFC 4506) runtime: declarative types with pack/unpack.
+
+The reference compiles ``.x`` protocol files to C++ with xdrc
+(``src/Makefile.am:88-91``, xdrpp in ``lib/``); here the same wire format
+is expressed as composable Python type objects. Every type object
+implements ``pack(packer, value)`` and ``unpack(unpacker) -> value``;
+structs and unions are declared declaratively and round-trip to the exact
+big-endian 4-byte-aligned XDR encoding, so hashes of encoded structures
+(tx hashes, bucket hashes, ledger headers) are wire-compatible with the
+reference's.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "XdrError", "Packer", "Unpacker", "Uint32", "Int32", "Uint64", "Int64",
+    "Bool", "Opaque", "VarOpaque", "XdrString", "FixedArray", "VarArray",
+    "Option", "Enum", "Struct", "Union", "Void", "to_bytes", "from_bytes",
+]
+
+
+class XdrError(Exception):
+    pass
+
+
+class Packer:
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def pack_uint(self, v: int):
+        if not 0 <= v <= 0xFFFFFFFF:
+            raise XdrError(f"uint32 out of range: {v}")
+        self.buf += struct.pack(">I", v)
+
+    def pack_int(self, v: int):
+        if not -0x80000000 <= v <= 0x7FFFFFFF:
+            raise XdrError(f"int32 out of range: {v}")
+        self.buf += struct.pack(">i", v)
+
+    def pack_uhyper(self, v: int):
+        if not 0 <= v <= 0xFFFFFFFFFFFFFFFF:
+            raise XdrError(f"uint64 out of range: {v}")
+        self.buf += struct.pack(">Q", v)
+
+    def pack_hyper(self, v: int):
+        if not -0x8000000000000000 <= v <= 0x7FFFFFFFFFFFFFFF:
+            raise XdrError(f"int64 out of range: {v}")
+        self.buf += struct.pack(">q", v)
+
+    def pack_fopaque(self, n: int, v: bytes):
+        if len(v) != n:
+            raise XdrError(f"fixed opaque: want {n} bytes, got {len(v)}")
+        self.buf += v
+        if n % 4:
+            self.buf += b"\x00" * (4 - n % 4)
+
+    def pack_opaque(self, v: bytes, maxlen: int):
+        if len(v) > maxlen:
+            raise XdrError(f"opaque too long: {len(v)} > {maxlen}")
+        self.pack_uint(len(v))
+        self.pack_fopaque(len(v), v)
+
+    def bytes(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Unpacker:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise XdrError("unexpected end of XDR data")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def unpack_uint(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def unpack_int(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def unpack_uhyper(self) -> int:
+        return struct.unpack(">Q", self._take(8))[0]
+
+    def unpack_hyper(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def unpack_fopaque(self, n: int) -> bytes:
+        out = self._take(n)
+        if n % 4:
+            pad = self._take(4 - n % 4)
+            if pad != b"\x00" * len(pad):
+                raise XdrError("non-zero XDR padding")
+        return out
+
+    def unpack_opaque(self, maxlen: int) -> bytes:
+        n = self.unpack_uint()
+        if n > maxlen:
+            raise XdrError(f"opaque too long: {n} > {maxlen}")
+        return self.unpack_fopaque(n)
+
+    def done(self):
+        if self.pos != len(self.data):
+            raise XdrError(f"{len(self.data) - self.pos} trailing bytes")
+
+
+# ---------------- type objects ----------------
+
+class _Prim:
+    def __init__(self, packname, unpackname):
+        self._p, self._u = packname, unpackname
+
+    def pack(self, p: Packer, v):
+        getattr(p, self._p)(v)
+
+    def unpack(self, u: Unpacker):
+        return getattr(u, self._u)()
+
+
+Uint32 = _Prim("pack_uint", "unpack_uint")
+Int32 = _Prim("pack_int", "unpack_int")
+Uint64 = _Prim("pack_uhyper", "unpack_uhyper")
+Int64 = _Prim("pack_hyper", "unpack_hyper")
+
+
+class _Bool:
+    def pack(self, p, v):
+        p.pack_uint(1 if v else 0)
+
+    def unpack(self, u):
+        v = u.unpack_uint()
+        if v not in (0, 1):
+            raise XdrError(f"bad bool {v}")
+        return bool(v)
+
+
+Bool = _Bool()
+
+
+class _Void:
+    def pack(self, p, v):
+        if v is not None:
+            raise XdrError("void takes None")
+
+    def unpack(self, u):
+        return None
+
+
+Void = _Void()
+
+
+class Opaque:
+    def __init__(self, n: int):
+        self.n = n
+
+    def pack(self, p, v):
+        p.pack_fopaque(self.n, v)
+
+    def unpack(self, u):
+        return u.unpack_fopaque(self.n)
+
+
+class VarOpaque:
+    def __init__(self, maxlen: int = 0xFFFFFFFF):
+        self.maxlen = maxlen
+
+    def pack(self, p, v):
+        p.pack_opaque(v, self.maxlen)
+
+    def unpack(self, u):
+        return u.unpack_opaque(self.maxlen)
+
+
+class XdrString:
+    """XDR string<maxlen>; values are Python bytes (the reference treats
+    string32/string64 as raw bytes too)."""
+
+    def __init__(self, maxlen: int = 0xFFFFFFFF):
+        self.maxlen = maxlen
+
+    def pack(self, p, v):
+        if isinstance(v, str):
+            v = v.encode()
+        p.pack_opaque(v, self.maxlen)
+
+    def unpack(self, u):
+        return u.unpack_opaque(self.maxlen)
+
+
+class FixedArray:
+    def __init__(self, elem, n: int):
+        self.elem, self.n = elem, n
+
+    def pack(self, p, v):
+        if len(v) != self.n:
+            raise XdrError(f"fixed array: want {self.n}, got {len(v)}")
+        for e in v:
+            self.elem.pack(p, e)
+
+    def unpack(self, u):
+        return [self.elem.unpack(u) for _ in range(self.n)]
+
+
+class VarArray:
+    def __init__(self, elem, maxlen: int = 0xFFFFFFFF):
+        self.elem, self.maxlen = elem, maxlen
+
+    def pack(self, p, v):
+        if len(v) > self.maxlen:
+            raise XdrError(f"array too long: {len(v)} > {self.maxlen}")
+        p.pack_uint(len(v))
+        for e in v:
+            self.elem.pack(p, e)
+
+    def unpack(self, u):
+        n = u.unpack_uint()
+        if n > self.maxlen:
+            raise XdrError(f"array too long: {n} > {self.maxlen}")
+        return [self.elem.unpack(u) for _ in range(n)]
+
+
+class Option:
+    def __init__(self, elem):
+        self.elem = elem
+
+    def pack(self, p, v):
+        if v is None:
+            p.pack_uint(0)
+        else:
+            p.pack_uint(1)
+            self.elem.pack(p, v)
+
+    def unpack(self, u):
+        flag = u.unpack_uint()
+        if flag == 0:
+            return None
+        if flag != 1:
+            raise XdrError(f"bad optional flag {flag}")
+        return self.elem.unpack(u)
+
+
+class Enum:
+    """Named int-valued enum; packs as int32, rejects unknown values."""
+
+    def __init__(self, name: str, values: Dict[str, int]):
+        self.name = name
+        self.by_name = dict(values)
+        self.by_value = {v: k for k, v in values.items()}
+        for k, v in values.items():
+            setattr(self, k, v)
+
+    def pack(self, p, v):
+        if v not in self.by_value:
+            raise XdrError(f"bad {self.name} value {v}")
+        p.pack_int(v)
+
+    def unpack(self, u):
+        v = u.unpack_int()
+        if v not in self.by_value:
+            raise XdrError(f"bad {self.name} value {v}")
+        return v
+
+    def name_of(self, v) -> str:
+        return self.by_value.get(v, f"<{self.name}:{v}>")
+
+
+class _StructMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        fields = ns.get("FIELDS")
+        if fields:
+            cls._names = tuple(f[0] for f in fields)
+            cls._types = tuple(f[1] for f in fields)
+            cls.__slots__ = ()
+        return cls
+
+
+class Struct(metaclass=_StructMeta):
+    """Declarative XDR struct: subclass with FIELDS = [(name, type), ...].
+
+    Instances are plain attribute bags; equality/repr/pack/unpack derived.
+    """
+    FIELDS: List[Tuple[str, Any]] = []
+    _names: Tuple[str, ...] = ()
+    _types: Tuple[Any, ...] = ()
+
+    def __init__(self, **kw):
+        for n in self._names:
+            setattr(self, n, kw.pop(n, None))
+        if kw:
+            raise TypeError(f"unknown fields {sorted(kw)} for "
+                            f"{type(self).__name__}")
+
+    @classmethod
+    def pack(cls, p: Packer, v: "Struct"):
+        for n, t in zip(cls._names, cls._types):
+            try:
+                t.pack(p, getattr(v, n))
+            except XdrError:
+                raise
+            except Exception as e:
+                raise XdrError(
+                    f"{cls.__name__}.{n}: {e}") from e
+
+    @classmethod
+    def unpack(cls, u: Unpacker) -> "Struct":
+        out = cls.__new__(cls)
+        for n, t in zip(cls._names, cls._types):
+            setattr(out, n, t.unpack(u))
+        return out
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and all(getattr(self, n) == getattr(other, n)
+                        for n in self._names))
+
+    def __hash__(self):
+        return hash((type(self).__name__, to_bytes(type(self), self)))
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._names)
+        return f"{type(self).__name__}({inner})"
+
+
+class Union:
+    """XDR discriminated union. Values are (arm_value, payload) pairs
+    exposed as a small object with .arm and .value.
+
+    arms: dict mapping discriminant value -> payload type (Void for void
+    arms); default: payload type for unlisted discriminants (None = reject).
+    """
+
+    class Value:
+        __slots__ = ("arm", "value")
+
+        def __init__(self, arm, value=None):
+            self.arm = arm
+            self.value = value
+
+        def __eq__(self, other):
+            return (isinstance(other, Union.Value)
+                    and self.arm == other.arm and self.value == other.value)
+
+        def __hash__(self):
+            return hash((self.arm, repr(self.value)))
+
+        def __repr__(self):
+            return f"Union({self.arm}, {self.value!r})"
+
+    def __init__(self, name: str, disc, arms: Dict[Any, Any], default=None):
+        self.name = name
+        self.disc = disc
+        self.arms = arms
+        self.default = default
+
+    def make(self, arm, value=None) -> "Union.Value":
+        return Union.Value(arm, value)
+
+    def _armtype(self, arm):
+        t = self.arms.get(arm, self.default)
+        if t is None:
+            raise XdrError(f"{self.name}: bad union arm {arm}")
+        return t
+
+    def pack(self, p, v: "Union.Value"):
+        t = self._armtype(v.arm)
+        self.disc.pack(p, v.arm)
+        t.pack(p, v.value)
+
+    def unpack(self, u):
+        arm = self.disc.unpack(u)
+        t = self._armtype(arm)
+        return Union.Value(arm, t.unpack(u))
+
+
+def to_bytes(t, v) -> bytes:
+    p = Packer()
+    t.pack(p, v)
+    return p.bytes()
+
+
+def from_bytes(t, data: bytes):
+    u = Unpacker(data)
+    out = t.unpack(u)
+    u.done()
+    return out

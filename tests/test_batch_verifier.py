@@ -1,0 +1,155 @@
+"""Differential fuzz: BatchVerifier (TPU kernel + host checks) vs the
+libsodium-exact Python oracle, over valid, corrupted, and adversarial
+edge-case signatures."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import ed25519_ref as ref
+from stellar_tpu.crypto.batch_verifier import BatchVerifier
+
+
+def make_sig(msg=None):
+    seed = secrets.token_bytes(32)
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey)
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        pk = sk.public_key().public_bytes_raw()
+        msg = secrets.token_bytes(secrets.randbelow(200)) if msg is None else msg
+        return pk, msg, sk.sign(msg)
+    except Exception:
+        pk = ref.secret_to_public(seed)
+        msg = secrets.token_bytes(64) if msg is None else msg
+        return pk, msg, ref.sign(seed, msg)
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return BatchVerifier(bucket_sizes=(8, 32))
+
+
+def check_differential(verifier, items):
+    got = verifier.verify_batch(items)
+    want = np.array([ref.verify(pk, m, s) for pk, m, s in items])
+    assert (got == want).all(), (
+        [i for i in range(len(items)) if got[i] != want[i]])
+    return got
+
+
+def test_valid_sigs(verifier):
+    items = [make_sig() for _ in range(8)]
+    got = check_differential(verifier, items)
+    assert got.all()
+
+
+def test_corruptions(verifier):
+    pk, msg, sig = make_sig(b"hello stellar")
+    items = [(pk, msg, sig)]
+    # flip each region: R, s, pk, msg
+    s2 = bytearray(sig); s2[3] ^= 1
+    items.append((pk, msg, bytes(s2)))
+    s3 = bytearray(sig); s3[40] ^= 1
+    items.append((pk, msg, bytes(s3)))
+    p2 = bytearray(pk); p2[0] ^= 1
+    items.append((bytes(p2), msg, sig))
+    items.append((pk, msg + b"!", sig))
+    items.append((pk, b"", sig))
+    # wrong lengths
+    items.append((pk[:31], msg, sig))
+    items.append((pk, msg, sig[:63]))
+    got = check_differential(verifier, items)
+    assert list(got) == [True] + [False] * 7
+
+
+def test_noncanonical_s(verifier):
+    pk, msg, sig = make_sig(b"msg")
+    s_int = int.from_bytes(sig[32:], "little")
+    bad_s = (s_int + ref.L).to_bytes(32, "little")  # same value mod L, >= L
+    items = [(pk, msg, sig[:32] + bad_s)]
+    got = check_differential(verifier, items)
+    assert not got[0]
+
+
+def test_small_order_and_noncanonical_pk(verifier):
+    _, msg, sig = make_sig(b"m")
+    items = []
+    for enc in sorted(ref.SMALL_ORDER_ENCODINGS):
+        items.append((enc, msg, sig))               # small-order A
+        items.append((enc[:31] + bytes([enc[31] | 0x80]), msg, sig))
+        pk2, msg2, sig2 = make_sig(b"m")
+        items.append((pk2, msg2, enc + sig2[32:]))  # small-order R
+    # non-canonical A: y = p + 3 (valid x exists for y=3)
+    items.append(((ref.P + 3).to_bytes(32, "little"), msg, sig))
+    got = check_differential(verifier, items)
+    assert not got.any()
+
+
+def test_undecompressable_pk(verifier):
+    _, msg, sig = make_sig(b"m")
+    ys = []
+    y = 2
+    while len(ys) < 3:
+        if ref.point_decompress(int(y).to_bytes(32, "little")) is None:
+            ys.append(int(y).to_bytes(32, "little"))
+        y += 1
+    check_differential(verifier, [(yy, msg, sig) for yy in ys])
+
+
+def test_chunking_and_padding(verifier):
+    # 70 items with bucket sizes (8, 32): exercises pad + chunk paths
+    items = [make_sig() for _ in range(20)]
+    bad = []
+    for pk, msg, sig in items[:10]:
+        s2 = bytearray(sig); s2[10] ^= 0xFF
+        bad.append((pk, msg, bytes(s2)))
+    mixed = items + bad + items[:5] + bad[:5]
+    got = check_differential(verifier, mixed)
+    assert got[:20].all() and not got[20:30].any()
+
+
+def test_verify_sig_cache(verifier):
+    pk, msg, sig = make_sig(b"cached")
+    h0 = verifier.cache_stats.hits
+    assert verifier.verify_sig(pk, msg, sig)
+    assert verifier.verify_sig(pk, msg, sig)
+    assert verifier.cache_stats.hits == h0 + 1
+
+
+def test_sharded_mesh():
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(devs[:8]), ("batch",))
+    v = BatchVerifier(mesh=mesh, bucket_sizes=(16,))
+    items = [make_sig() for _ in range(10)]
+    s2 = bytearray(items[0][2]); s2[1] ^= 4
+    items.append((items[0][0], items[0][1], bytes(s2)))
+    got = v.verify_batch(items)
+    assert got[:10].all() and not got[10]
+
+
+def test_rfc8032_vectors(verifier):
+    # RFC 8032 §7.1 test vectors 1-3
+    vecs = [
+        ("d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+         "",
+         "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+         "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"),
+        ("3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+         "72",
+         "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+         "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"),
+        ("fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+         "af82",
+         "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+         "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"),
+    ]
+    items = [(bytes.fromhex(pk), bytes.fromhex(m), bytes.fromhex(sig))
+             for pk, m, sig in vecs]
+    got = check_differential(verifier, items)
+    assert got.all()
