@@ -20,22 +20,23 @@ exactly once; oversize batches are chunked. A 1-D ``jax.sharding.Mesh``
 shards the batch across chips with ``shard_map`` (no collectives — verify
 is data-parallel).
 
-A verify-result cache fronts the whole thing, like the reference's
-0xffff-entry ``RandomEvictionCache`` (``SecretKey.cpp:44-48,318-338``).
+The process-wide verify-result cache (the reference's 0xffff-entry
+``RandomEvictionCache``, ``SecretKey.cpp:44-48,318-338``) lives in
+``stellar_tpu.crypto.keys``; :meth:`BatchVerifier.install` wires this
+verifier in behind it.
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
-from collections import OrderedDict
 from typing import Optional, Sequence
 
 import numpy as np
 
 from stellar_tpu.crypto import ed25519_ref as ref
 
-__all__ = ["BatchVerifier", "VerifyCacheStats", "default_verifier"]
+__all__ = ["BatchVerifier", "default_verifier"]
 
 _L = ref.L
 _P = ref.P
@@ -80,14 +81,6 @@ def _digits16_msb(b_arr: np.ndarray) -> np.ndarray:
     return inter[:, ::-1].astype(np.int32)
 
 
-class VerifyCacheStats:
-    __slots__ = ("hits", "misses")
-
-    def __init__(self):
-        self.hits = 0
-        self.misses = 0
-
-
 class BatchVerifier:
     """Batched libsodium-exact ed25519 verifier with a jit bucket cache.
 
@@ -95,18 +88,12 @@ class BatchVerifier:
       mesh: optional 1-D ``jax.sharding.Mesh``; if given, buckets divisible
         by the mesh size run under shard_map across its devices.
       bucket_sizes: padded batch sizes, ascending; each compiles once.
-      cache_entries: verify-result cache capacity (reference: 0xffff).
     """
 
-    def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048),
-                 cache_entries: int = 0xFFFF):
+    def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
         self._mesh = mesh
         self._buckets = tuple(sorted(bucket_sizes))
         self._kernels = {}
-        self._cache: OrderedDict[bytes, bool] = OrderedDict()
-        self._cache_entries = cache_entries
-        self._cache_lock = threading.Lock()
-        self.cache_stats = VerifyCacheStats()
 
     # ---------------- device dispatch ----------------
 
@@ -185,22 +172,17 @@ class BatchVerifier:
         return ok & dev
 
     def verify_sig(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
-        """Single verify through the result cache (the reference's
-        verifySigCachedKey path, SecretKey.cpp:435-468)."""
-        key = hashlib.sha256(pk + sig + msg).digest()
-        with self._cache_lock:
-            hit = self._cache.get(key)
-            if hit is not None:
-                self._cache.move_to_end(key)
-                self.cache_stats.hits += 1
-                return hit
-        self.cache_stats.misses += 1
-        res = bool(self.verify_batch([(pk, msg, sig)])[0])
-        with self._cache_lock:
-            self._cache[key] = res
-            if len(self._cache) > self._cache_entries:
-                self._cache.popitem(last=False)
-        return res
+        """Single verify (uncached — the process-wide result cache lives
+        in ``stellar_tpu.crypto.keys.verify_sig``; wire this verifier in
+        behind it with :meth:`install`)."""
+        return bool(self.verify_batch([(pk, msg, sig)])[0])
+
+    def install(self) -> "BatchVerifier":
+        """Make this verifier the backend for ``keys.verify_sig`` so all
+        single-sig call sites hit the shared cache first, then the TPU."""
+        from stellar_tpu.crypto import keys
+        keys.set_verifier_backend(self.verify_sig)
+        return self
 
 
 # Padding rows: any syntactically valid inputs work (results are sliced

@@ -1,0 +1,207 @@
+"""SecretKey / PubKeyUtils: the framework's signing identity layer.
+
+Mirrors the reference's ``src/crypto/SecretKey.h`` surface: seed-based
+ed25519 keys, StrKey round-trips, deterministic test keys
+(``pseudoRandomForTesting``), and — the north-star boundary —
+``verify_sig`` with a 0xffff-entry random-eviction result cache in front
+of a *pluggable* verifier backend (``crypto/SecretKey.cpp:44-48,435-468``).
+
+Backends:
+  * the pure-Python libsodium-exact oracle (default; always available)
+  * the TPU ``BatchVerifier`` (``stellar_tpu.crypto.batch_verifier``) —
+    installed via ``set_verifier_backend`` for bulk paths; single-sig
+    calls still hit the cache first.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from stellar_tpu.crypto import ed25519_ref as _ref
+from stellar_tpu.crypto import strkey as _strkey
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.utils.cache import RandomEvictionCache
+
+__all__ = [
+    "SecretKey", "PublicKey", "verify_sig", "set_verifier_backend",
+    "get_verify_cache_stats", "flush_verify_cache",
+    "sign_ops_per_second", "verify_ops_per_second",
+]
+
+VERIFY_CACHE_SIZE = 0xFFFF
+
+_cache_lock = threading.Lock()
+_verify_cache: RandomEvictionCache = RandomEvictionCache(VERIFY_CACHE_SIZE)
+_backend: Optional[Callable[[bytes, bytes, bytes], bool]] = None
+
+
+class PublicKey:
+    """32-byte ed25519 public key with StrKey + XDR conveniences."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("public key must be 32 bytes")
+        self.raw = bytes(raw)
+
+    @classmethod
+    def from_strkey(cls, s: str) -> "PublicKey":
+        return cls(_strkey.decode_account(s))
+
+    def to_strkey(self) -> str:
+        return _strkey.encode_account(self.raw)
+
+    def to_xdr(self):
+        from stellar_tpu.xdr.types import account_id
+        return account_id(self.raw)
+
+    @classmethod
+    def from_xdr(cls, v) -> "PublicKey":
+        return cls(v.value)
+
+    def hint(self) -> bytes:
+        """Signature hint: last 4 bytes of the key (reference
+        ``SignatureUtils::getHint``)."""
+        return self.raw[-4:]
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and self.raw == other.raw
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def __repr__(self):
+        return f"PublicKey({self.to_strkey()})"
+
+
+class SecretKey:
+    """Seed-based ed25519 secret key (reference ``SecretKey.h:22``)."""
+
+    __slots__ = ("seed", "_pk")
+
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self.seed = bytes(seed)
+        self._pk: Optional[PublicKey] = None
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_strkey_seed(cls, s: str) -> "SecretKey":
+        return cls(_strkey.decode_seed(s))
+
+    @classmethod
+    def pseudo_random_for_testing(cls) -> "SecretKey":
+        """Non-CSPRNG key for tests (reference ``SecretKey.h:66-77``)."""
+        import random
+        return cls(bytes(random.getrandbits(8) for _ in range(32)))
+
+    @classmethod
+    def from_seed_str(cls, s: str) -> "SecretKey":
+        """Deterministic key from an arbitrary string: seed = SHA256(s)
+        (reference tests' getAccount pattern)."""
+        return cls(sha256(s.encode() if isinstance(s, str) else s))
+
+    def to_strkey_seed(self) -> str:
+        return _strkey.encode_seed(self.seed)
+
+    @property
+    def public_key(self) -> PublicKey:
+        if self._pk is None:
+            self._pk = PublicKey(_ref.secret_to_public(self.seed))
+        return self._pk
+
+    def get_public_key(self) -> PublicKey:
+        return self.public_key
+
+    def sign(self, msg: bytes) -> bytes:
+        return _ref.sign(self.seed, msg)
+
+    def sign_decorated(self, msg: bytes):
+        from stellar_tpu.xdr.tx import DecoratedSignature
+        return DecoratedSignature(hint=self.public_key.hint(),
+                                  signature=self.sign(msg))
+
+    def __eq__(self, other):
+        return isinstance(other, SecretKey) and self.seed == other.seed
+
+    def __hash__(self):
+        return hash(self.seed)
+
+    def __repr__(self):
+        return f"SecretKey({self.public_key.to_strkey()})"
+
+
+def set_verifier_backend(fn: Optional[Callable[[bytes, bytes, bytes], bool]]):
+    """Install a verify backend (pk, msg, sig) -> bool; None restores the
+    pure-Python oracle. The result cache stays in front either way."""
+    global _backend
+    _backend = fn
+
+
+def _cache_key(pk: bytes, msg: bytes, sig: bytes) -> bytes:
+    # Identity of the (key, sig, msg) triple. pk and sig are validated
+    # fixed-length (32/64) before this is called, so the concatenation
+    # has unambiguous field boundaries.
+    return sha256(pk + sig + msg)
+
+
+def verify_sig(pk, msg: bytes, sig: bytes) -> bool:
+    """The ``PubKeyUtils::verifySig`` equivalent — all single-signature
+    verification funnels through here."""
+    raw = pk.raw if isinstance(pk, PublicKey) else bytes(pk)
+    if len(sig) != 64 or len(raw) != 32:
+        return False
+    key = _cache_key(raw, msg, sig)
+    with _cache_lock:
+        got = _verify_cache.maybe_get(key)
+    if got is not None:
+        return got
+    fn = _backend or _ref.verify
+    ok = bool(fn(raw, msg, sig))
+    with _cache_lock:
+        _verify_cache.put(key, ok)
+    return ok
+
+
+def flush_verify_cache():
+    with _cache_lock:
+        _verify_cache.clear()
+        _verify_cache.hits = 0
+        _verify_cache.misses = 0
+
+
+def get_verify_cache_stats() -> dict:
+    with _cache_lock:
+        return {"hits": _verify_cache.hits, "misses": _verify_cache.misses,
+                "size": len(_verify_cache)}
+
+
+def sign_ops_per_second(iterations: int = 200) -> float:
+    """Reference ``SecretKey::benchmarkOpsPerSecond`` (sign half)."""
+    import time
+    sk = SecretKey.random()
+    msg = b"benchmark-payload" * 4
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        sk.sign(msg)
+    return iterations / (time.perf_counter() - t0)
+
+
+def verify_ops_per_second(iterations: int = 200) -> float:
+    import time
+    sk = SecretKey.random()
+    msg = b"benchmark-payload" * 4
+    sig = sk.sign(msg)
+    pk = sk.public_key
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        flush_verify_cache()
+        verify_sig(pk, msg, sig)
+    return iterations / (time.perf_counter() - t0)

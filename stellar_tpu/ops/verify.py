@@ -47,11 +47,6 @@ def verify_kernel(a_bytes, r_bytes, s_digits, h_digits):
     return ok & ed.compress_equals(rprime, r_bytes)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh_axis",))
-def _jit_kernel(a_bytes, r_bytes, s_digits, h_digits, mesh_axis=None):
-    return verify_kernel(a_bytes, r_bytes, s_digits, h_digits)
-
-
 def verify_kernel_sharded(mesh, axis_name="batch"):
     """Wrap the kernel in shard_map over a 1-D mesh: batch split across
     devices, no cross-device communication (each chip verifies its shard).

@@ -1,0 +1,53 @@
+"""Partitioned logging.
+
+The reference's spdlog setup has 13 compile-time partitions
+(``src/util/LogPartitions.def``) each independently leveled at runtime via
+the ``ll`` admin endpoint. Same model here on top of :mod:`logging`:
+``get_logger(partition)`` and ``set_log_level(partition_or_None, level)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+PARTITIONS = [
+    "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
+    "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
+]
+
+_configured = False
+
+
+def _configure():
+    global _configured
+    if _configured:
+        return
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s [%(name)s %(levelname)s] %(message)s"))
+    root = logging.getLogger("stellar_tpu")
+    root.addHandler(h)
+    root.setLevel(logging.WARNING)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(partition: str) -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"stellar_tpu.{partition}")
+
+
+def set_log_level(partition: Optional[str], level) -> None:
+    """``partition=None`` sets every partition (the ``ll`` endpoint
+    semantics)."""
+    _configure()
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    if partition is None:
+        logging.getLogger("stellar_tpu").setLevel(level)
+        for p in PARTITIONS:
+            logging.getLogger(f"stellar_tpu.{p}").setLevel(level)
+    else:
+        logging.getLogger(f"stellar_tpu.{partition}").setLevel(level)

@@ -276,12 +276,15 @@ class Enum:
 
 class _StructMeta(type):
     def __new__(mcls, name, bases, ns):
-        cls = super().__new__(mcls, name, bases, ns)
         fields = ns.get("FIELDS")
+        if fields:
+            # real __slots__: catches misspelled field assignments and
+            # drops per-instance dict overhead
+            ns.setdefault("__slots__", tuple(f[0] for f in fields))
+        cls = super().__new__(mcls, name, bases, ns)
         if fields:
             cls._names = tuple(f[0] for f in fields)
             cls._types = tuple(f[1] for f in fields)
-            cls.__slots__ = ()
         return cls
 
 
@@ -290,6 +293,7 @@ class Struct(metaclass=_StructMeta):
 
     Instances are plain attribute bags; equality/repr/pack/unpack derived.
     """
+    __slots__ = ()
     FIELDS: List[Tuple[str, Any]] = []
     _names: Tuple[str, ...] = ()
     _types: Tuple[Any, ...] = ()

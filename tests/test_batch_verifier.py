@@ -110,12 +110,19 @@ def test_chunking_and_padding(verifier):
     assert got[:20].all() and not got[20:30].any()
 
 
-def test_verify_sig_cache(verifier):
+def test_verify_sig_via_installed_backend(verifier):
+    from stellar_tpu.crypto import keys
     pk, msg, sig = make_sig(b"cached")
-    h0 = verifier.cache_stats.hits
-    assert verifier.verify_sig(pk, msg, sig)
-    assert verifier.verify_sig(pk, msg, sig)
-    assert verifier.cache_stats.hits == h0 + 1
+    keys.flush_verify_cache()
+    try:
+        verifier.install()
+        assert keys.verify_sig(pk, msg, sig)
+        before = keys.get_verify_cache_stats()
+        assert keys.verify_sig(pk, msg, sig)   # second hit: cached
+        after = keys.get_verify_cache_stats()
+        assert after["hits"] == before["hits"] + 1
+    finally:
+        keys.set_verifier_backend(None)
 
 
 def test_sharded_mesh():

@@ -1,0 +1,121 @@
+"""VirtualClock / VirtualTimer / Scheduler semantics
+(reference ``src/util/test/TimerTests.cpp`` + ``SchedulerTests.cpp``)."""
+
+from stellar_tpu.utils.scheduler import ActionType, Scheduler
+from stellar_tpu.utils.timer import (
+    REAL_TIME, VIRTUAL_TIME, VirtualClock, VirtualTimer)
+
+
+def test_virtual_time_starts_at_zero():
+    clock = VirtualClock(VIRTUAL_TIME)
+    assert clock.now() == 0.0
+
+
+def test_timer_fires_in_virtual_time():
+    clock = VirtualClock(VIRTUAL_TIME)
+    fired = []
+    t = VirtualTimer(clock)
+    t.expires_from_now(5.0)
+    t.async_wait(lambda: fired.append(clock.now()))
+    assert clock.crank(block=False) == 0   # not due yet
+    assert clock.crank(block=True) == 1    # jumps virtual time forward
+    assert fired == [5.0]
+
+
+def test_timer_ordering():
+    clock = VirtualClock(VIRTUAL_TIME)
+    order = []
+    for delay, name in [(3.0, "c"), (1.0, "a"), (2.0, "b")]:
+        t = VirtualTimer(clock)
+        t.expires_from_now(delay)
+        t.async_wait(lambda n=name: order.append(n))
+    while clock.crank(block=True):
+        pass
+    assert order == ["a", "b", "c"]
+
+
+def test_timer_cancel_invokes_cancel_handler():
+    clock = VirtualClock(VIRTUAL_TIME)
+    events = []
+    t = VirtualTimer(clock)
+    t.expires_from_now(1.0)
+    t.async_wait(lambda: events.append("fired"),
+                 on_cancel=lambda: events.append("cancelled"))
+    t.cancel()
+    while clock.crank(block=True):
+        pass
+    assert events == ["cancelled"]
+
+
+def test_post_action_runs_on_crank():
+    clock = VirtualClock(VIRTUAL_TIME)
+    out = []
+    clock.post_action(lambda: out.append(1))
+    clock.post_action(lambda: out.append(2))
+    assert clock.crank() == 2
+    assert out == [1, 2]
+
+
+def test_crank_until():
+    clock = VirtualClock(VIRTUAL_TIME)
+    state = {"n": 0}
+
+    def tick():
+        state["n"] += 1
+        if state["n"] < 5:
+            t = VirtualTimer(clock)
+            t.expires_from_now(1.0)
+            t.async_wait(tick)
+
+    tick()
+    assert clock.crank_until(lambda: state["n"] >= 5, timeout=100.0)
+    assert state["n"] == 5
+    assert clock.now() <= 10.0
+
+
+def test_crank_until_gives_up_when_idle():
+    clock = VirtualClock(VIRTUAL_TIME)
+    assert not clock.crank_until(lambda: False, timeout=10.0)
+
+
+def test_scheduler_fairness():
+    s = Scheduler()
+    order = []
+    for i in range(3):
+        s.enqueue("q1", lambda i=i: order.append(("q1", i)))
+    s.enqueue("q2", lambda: order.append(("q2", 0)))
+    s.run_some()
+    # q2 must be serviced before q1 drains completely
+    assert order.index(("q2", 0)) < 3
+
+
+def test_scheduler_sheds_stale_droppable():
+    clock = VirtualClock(VIRTUAL_TIME)
+    s = clock.scheduler
+    ran = []
+    clock.post_action(lambda: ran.append("d"), name="flood",
+                      action_type=ActionType.DROPPABLE)
+    # age the queue far past the latency window before cranking
+    clock.set_current_virtual_time(100.0)
+    clock.crank()
+    assert ran == []
+    assert s.actions_dropped == 1
+
+
+def test_real_time_clock_advances():
+    clock = VirtualClock(REAL_TIME)
+    t0 = clock.now()
+    clock.sleep_for(0.01)
+    assert clock.now() >= t0 + 0.009
+
+
+def test_cross_thread_post():
+    import threading
+    clock = VirtualClock(VIRTUAL_TIME)
+    out = []
+    th = threading.Thread(
+        target=lambda: clock.post_to_main(lambda: out.append(42)))
+    th.start()
+    th.join()
+    clock.crank()
+    assert out == [42]

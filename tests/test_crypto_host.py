@@ -1,0 +1,125 @@
+"""Host-side crypto layer: SHA/HMAC/HKDF, SipHash, StrKey, SecretKey,
+verify cache. Mirrors reference ``src/crypto/test/CryptoTests.cpp``."""
+
+import hashlib
+
+import pytest
+
+from stellar_tpu.crypto import shorthash, strkey
+from stellar_tpu.crypto.keys import (
+    PublicKey, SecretKey, flush_verify_cache, get_verify_cache_stats,
+    verify_sig)
+from stellar_tpu.crypto.sha import (
+    SHA256, hkdf_expand, hkdf_extract, hmac_sha256, hmac_sha256_verify,
+    sha256)
+from stellar_tpu.utils.cache import RandomEvictionCache
+
+
+def test_sha256_vector():
+    # FIPS 180-2 "abc" vector
+    assert sha256(b"abc").hex() == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+
+
+def test_sha256_incremental():
+    h = SHA256().add(b"a").add(b"b").add(b"c")
+    assert h.finish() == sha256(b"abc")
+    with pytest.raises(RuntimeError):
+        h.add(b"d")
+
+
+def test_hmac_roundtrip():
+    key = b"k" * 32
+    mac = hmac_sha256(key, b"hello")
+    assert hmac_sha256_verify(mac, key, b"hello")
+    assert not hmac_sha256_verify(mac, key, b"hellO")
+
+
+def test_hkdf_shapes():
+    prk = hkdf_extract(b"input key material")
+    okm = hkdf_expand(prk, b"info")
+    assert len(prk) == 32 and len(okm) == 32
+    assert okm != hkdf_expand(prk, b"other")
+
+
+def test_siphash_vector():
+    # SipHash-2-4 official test vector: key 00..0f, input 00..0e -> value
+    shorthash.seed(bytes(range(16)))
+    assert shorthash.compute_hash(bytes(range(15))) == 0xA129CA6149BE45E5
+    shorthash.seed(bytes(range(16)))
+    # empty input vector
+    assert shorthash.compute_hash(b"") == 0x726FDB47DD0E0E31
+
+
+def test_strkey_roundtrip():
+    raw = bytes(range(32))
+    s = strkey.encode_account(raw)
+    assert s[0] == "G"
+    assert strkey.decode_account(s) == raw
+    seed = strkey.encode_seed(raw)
+    assert seed[0] == "S"
+    assert strkey.decode_seed(seed) == raw
+
+
+def test_strkey_known_value():
+    # Public interop vector (SEP-23): all-zero key
+    assert strkey.encode_account(b"\x00" * 32) == (
+        "GAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAWHF")
+
+
+def test_strkey_rejects_corruption():
+    s = strkey.encode_account(bytes(range(32)))
+    bad = s[:-1] + ("A" if s[-1] != "A" else "B")
+    with pytest.raises(ValueError):
+        strkey.decode_account(bad)
+    with pytest.raises(ValueError):
+        strkey.decode_seed(s)  # wrong version byte
+
+
+def test_secret_key_sign_verify():
+    sk = SecretKey.from_seed_str("alice")
+    pk = sk.public_key
+    msg = b"the message"
+    sig = sk.sign(msg)
+    flush_verify_cache()
+    assert verify_sig(pk, msg, sig)
+    assert not verify_sig(pk, msg + b"!", sig)
+    # cache: repeating the same verify is a hit
+    before = get_verify_cache_stats()
+    assert verify_sig(pk, msg, sig)
+    after = get_verify_cache_stats()
+    assert after["hits"] == before["hits"] + 1
+
+
+def test_secret_key_strkey_roundtrip():
+    sk = SecretKey.from_seed_str("bob")
+    s = sk.to_strkey_seed()
+    assert SecretKey.from_strkey_seed(s) == sk
+    p = sk.public_key.to_strkey()
+    assert PublicKey.from_strkey(p) == sk.public_key
+
+
+def test_random_eviction_cache():
+    c = RandomEvictionCache(4)
+    for i in range(10):
+        c.put(i, i * 10)
+    assert len(c) == 4
+    # all resident entries readable; each get counts a hit
+    resident = [k for k in range(10) if c.exists(k, count_stats=False)]
+    assert len(resident) == 4
+    for k in resident:
+        assert c.get(k) == k * 10
+    assert c.hits == len(resident)
+    with pytest.raises(KeyError):
+        c.get(999)
+    assert c.misses >= 1
+
+
+def test_cache_key_is_domain_separated():
+    # pk+sig+msg concatenation hashed — equal concatenations with shifted
+    # boundaries must not collide because components are fixed-length.
+    sk = SecretKey.from_seed_str("carol")
+    sig = sk.sign(b"m1")
+    flush_verify_cache()
+    assert verify_sig(sk.public_key, b"m1", sig)
+    assert not verify_sig(sk.public_key, b"m2", sig)

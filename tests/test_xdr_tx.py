@@ -1,0 +1,338 @@
+"""XDR transaction/ledger/SCP round-trips + canonical-encoding checks.
+
+The critical property is wire compatibility: hashes of encoded structures
+(tx signature payloads, tx set hashes, header hashes) must match the
+canonical protocol encoding, since signatures and consensus depend on
+them (reference ``src/protocol-curr/xdr``).
+"""
+
+import pytest
+
+from stellar_tpu.crypto.keys import SecretKey
+from stellar_tpu.xdr import ledger as xl
+from stellar_tpu.xdr import results as xr
+from stellar_tpu.xdr import scp as xs
+from stellar_tpu.xdr import tx as xt
+from stellar_tpu.xdr import types as xty
+from stellar_tpu.xdr.runtime import XdrError, from_bytes, to_bytes
+
+
+def _payment_tx(src: SecretKey, dst: SecretKey, amount=100, seq=1,
+                fee=100):
+    op = xt.Operation(
+        sourceAccount=None,
+        body=xt.OperationBody.make(
+            xt.OperationType.PAYMENT,
+            xt.PaymentOp(destination=xt.muxed_account(dst.public_key.raw),
+                         asset=xty.NATIVE_ASSET, amount=amount)))
+    return xt.Transaction(
+        sourceAccount=xt.muxed_account(src.public_key.raw),
+        fee=fee, seqNum=seq,
+        cond=xt.Preconditions.make(xt.PreconditionType.PRECOND_NONE),
+        memo=xt.MEMO_NONE,
+        operations=[op],
+        ext=xt.Transaction._types[6].make(0))
+
+
+def test_transaction_roundtrip():
+    a, b = SecretKey.from_seed_str("a"), SecretKey.from_seed_str("b")
+    tx = _payment_tx(a, b)
+    raw = to_bytes(xt.Transaction, tx)
+    back = from_bytes(xt.Transaction, raw)
+    assert back == tx
+    assert to_bytes(xt.Transaction, back) == raw
+
+
+def test_envelope_roundtrip_and_hash_stability():
+    a, b = SecretKey.from_seed_str("a"), SecretKey.from_seed_str("b")
+    tx = _payment_tx(a, b)
+    net = b"\x07" * 32
+    payload = xt.transaction_sig_payload(net, tx)
+    sig = a.sign(payload)
+    env = xt.TransactionEnvelope.make(
+        xty.EnvelopeType.ENVELOPE_TYPE_TX,
+        xt.TransactionV1Envelope(
+            tx=tx, signatures=[xt.DecoratedSignature(
+                hint=a.public_key.hint(), signature=sig)]))
+    raw = to_bytes(xt.TransactionEnvelope, env)
+    back = from_bytes(xt.TransactionEnvelope, raw)
+    assert to_bytes(xt.TransactionEnvelope, back) == raw
+    # hash is deterministic
+    assert xt.transaction_hash(net, tx) == xt.transaction_hash(net, tx)
+
+
+def test_sig_payload_against_stellar_sdk_if_present():
+    """Differential check vs the public stellar_sdk package when
+    installed; skipped otherwise (zero-egress image may lack it)."""
+    sdk = pytest.importorskip("stellar_sdk")
+    kp = sdk.Keypair.random()
+    dst = sdk.Keypair.random()
+    net = "Test SDF Network ; September 2015"
+    acct = sdk.Account(kp.public_key, 41)
+    sdk_tx = (sdk.TransactionBuilder(
+        source_account=acct, network_passphrase=net, base_fee=100)
+        .append_payment_op(destination=dst.public_key, amount="10",
+                           asset=sdk.Asset.native())
+        .add_time_bounds(0, 0).build())
+    sdk_hash = sdk_tx.hash()
+
+    from stellar_tpu.crypto.sha import sha256
+    op = xt.Operation(
+        sourceAccount=None,
+        body=xt.OperationBody.make(
+            xt.OperationType.PAYMENT,
+            xt.PaymentOp(
+                destination=xt.muxed_account(
+                    sdk.strkey.StrKey.decode_ed25519_public_key(
+                        dst.public_key)),
+                asset=xty.NATIVE_ASSET, amount=100_000_000)))
+    tx = xt.Transaction(
+        sourceAccount=xt.muxed_account(
+            sdk.strkey.StrKey.decode_ed25519_public_key(kp.public_key)),
+        fee=100, seqNum=42,
+        cond=xt.Preconditions.make(
+            xt.PreconditionType.PRECOND_TIME,
+            xt.TimeBounds(minTime=0, maxTime=0)),
+        memo=xt.MEMO_NONE, operations=[op],
+        ext=xt.Transaction._types[6].make(0))
+    ours = xt.transaction_hash(sha256(net.encode()), tx)
+    assert ours == sdk_hash
+
+
+def test_all_operation_bodies_roundtrip():
+    a = SecretKey.from_seed_str("a").public_key
+    b = SecretKey.from_seed_str("b").public_key
+    acct = a.to_xdr()
+    mux = xt.muxed_account(b.raw)
+    usd = xty.asset_alphanum4(b"USD", b.to_xdr())
+    price = xty.Price(n=1, d=2)
+    bodies = {
+        xt.OperationType.CREATE_ACCOUNT: xt.CreateAccountOp(
+            destination=acct, startingBalance=10),
+        xt.OperationType.PAYMENT: xt.PaymentOp(
+            destination=mux, asset=xty.NATIVE_ASSET, amount=5),
+        xt.OperationType.PATH_PAYMENT_STRICT_RECEIVE:
+            xt.PathPaymentStrictReceiveOp(
+                sendAsset=xty.NATIVE_ASSET, sendMax=10, destination=mux,
+                destAsset=usd, destAmount=5, path=[usd]),
+        xt.OperationType.MANAGE_SELL_OFFER: xt.ManageSellOfferOp(
+            selling=xty.NATIVE_ASSET, buying=usd, amount=7, price=price,
+            offerID=0),
+        xt.OperationType.CREATE_PASSIVE_SELL_OFFER:
+            xt.CreatePassiveSellOfferOp(
+                selling=xty.NATIVE_ASSET, buying=usd, amount=7,
+                price=price),
+        xt.OperationType.SET_OPTIONS: xt.SetOptionsOp(
+            inflationDest=None, clearFlags=None, setFlags=1,
+            masterWeight=2, lowThreshold=1, medThreshold=2,
+            highThreshold=3, homeDomain=b"example.com",
+            signer=xty.Signer(
+                key=xty.SignerKey.make(
+                    xty.SignerKeyType.SIGNER_KEY_TYPE_ED25519, b.raw),
+                weight=1)),
+        xt.OperationType.CHANGE_TRUST: xt.ChangeTrustOp(
+            line=xt.ChangeTrustAsset.make(
+                xty.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                xty.AlphaNum4(assetCode=b"USD\x00", issuer=acct)),
+            limit=2**62),
+        xt.OperationType.ALLOW_TRUST: xt.AllowTrustOp(
+            trustor=acct,
+            asset=xty.AssetCode.make(
+                xty.AssetType.ASSET_TYPE_CREDIT_ALPHANUM4, b"USD\x00"),
+            authorize=1),
+        xt.OperationType.ACCOUNT_MERGE: mux,
+        xt.OperationType.INFLATION: None,
+        xt.OperationType.MANAGE_DATA: xt.ManageDataOp(
+            dataName=b"key", dataValue=b"value"),
+        xt.OperationType.BUMP_SEQUENCE: xt.BumpSequenceOp(bumpTo=99),
+        xt.OperationType.MANAGE_BUY_OFFER: xt.ManageBuyOfferOp(
+            selling=xty.NATIVE_ASSET, buying=usd, buyAmount=3,
+            price=price, offerID=4),
+        xt.OperationType.PATH_PAYMENT_STRICT_SEND:
+            xt.PathPaymentStrictSendOp(
+                sendAsset=xty.NATIVE_ASSET, sendAmount=10,
+                destination=mux, destAsset=usd, destMin=5, path=[]),
+        xt.OperationType.CREATE_CLAIMABLE_BALANCE:
+            xt.CreateClaimableBalanceOp(
+                asset=xty.NATIVE_ASSET, amount=1, claimants=[
+                    xty.Claimant.make(
+                        xty.ClaimantType.CLAIMANT_TYPE_V0,
+                        xty.ClaimantV0(
+                            destination=acct,
+                            predicate=xty.ClaimPredicate.make(
+                                xty.ClaimPredicateType
+                                .CLAIM_PREDICATE_UNCONDITIONAL)))]),
+        xt.OperationType.CLAIM_CLAIMABLE_BALANCE:
+            xt.ClaimClaimableBalanceOp(
+                balanceID=xty.ClaimableBalanceID.make(
+                    xty.ClaimableBalanceIDType
+                    .CLAIMABLE_BALANCE_ID_TYPE_V0, b"\x01" * 32)),
+        xt.OperationType.BEGIN_SPONSORING_FUTURE_RESERVES:
+            xt.BeginSponsoringFutureReservesOp(sponsoredID=acct),
+        xt.OperationType.END_SPONSORING_FUTURE_RESERVES: None,
+        xt.OperationType.REVOKE_SPONSORSHIP:
+            xt.RevokeSponsorshipOp.make(
+                xt.RevokeSponsorshipType.REVOKE_SPONSORSHIP_SIGNER,
+                xt.RevokeSponsorshipOpSigner(
+                    accountID=acct,
+                    signerKey=xty.SignerKey.make(
+                        xty.SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        b.raw))),
+        xt.OperationType.CLAWBACK: xt.ClawbackOp(
+            asset=usd, from_=mux, amount=1),
+        xt.OperationType.CLAWBACK_CLAIMABLE_BALANCE:
+            xt.ClawbackClaimableBalanceOp(
+                balanceID=xty.ClaimableBalanceID.make(
+                    xty.ClaimableBalanceIDType
+                    .CLAIMABLE_BALANCE_ID_TYPE_V0, b"\x02" * 32)),
+        xt.OperationType.SET_TRUST_LINE_FLAGS: xt.SetTrustLineFlagsOp(
+            trustor=acct, asset=usd, clearFlags=0, setFlags=1),
+        xt.OperationType.LIQUIDITY_POOL_DEPOSIT:
+            xt.LiquidityPoolDepositOp(
+                liquidityPoolID=b"\x03" * 32, maxAmountA=1, maxAmountB=2,
+                minPrice=price, maxPrice=price),
+        xt.OperationType.LIQUIDITY_POOL_WITHDRAW:
+            xt.LiquidityPoolWithdrawOp(
+                liquidityPoolID=b"\x03" * 32, amount=1, minAmountA=0,
+                minAmountB=0),
+    }
+    for op_type, body in bodies.items():
+        op = xt.Operation(sourceAccount=None,
+                          body=xt.OperationBody.make(op_type, body))
+        raw = to_bytes(xt.Operation, op)
+        back = from_bytes(xt.Operation, raw)
+        assert to_bytes(xt.Operation, back) == raw, op_type
+
+
+def test_soroban_ops_roundtrip():
+    from stellar_tpu.xdr import contract as xc
+    hf = xc.HostFunction.make(
+        xc.HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        xc.InvokeContractArgs(
+            contractAddress=xc.contract_address(b"\x09" * 32),
+            functionName=b"transfer",
+            args=[xc.scv_u32(1), xc.scv_symbol("x"),
+                  xc.scv_i128(-(2**100)),
+                  xc.scv_vec([xc.scv_bool(True), xc.scv_void()]),
+                  xc.scv_map([(xc.scv_symbol("k"), xc.scv_u64(9))])]))
+    op = xt.Operation(
+        sourceAccount=None,
+        body=xt.OperationBody.make(
+            xt.OperationType.INVOKE_HOST_FUNCTION,
+            xt.InvokeHostFunctionOp(hostFunction=hf, auth=[])))
+    raw = to_bytes(xt.Operation, op)
+    assert to_bytes(xt.Operation, from_bytes(xt.Operation, raw)) == raw
+
+
+def test_fee_bump_envelope():
+    a, b = SecretKey.from_seed_str("a"), SecretKey.from_seed_str("b")
+    tx = _payment_tx(a, b)
+    inner = xt.TransactionV1Envelope(tx=tx, signatures=[])
+    fb = xt.FeeBumpTransaction(
+        feeSource=xt.muxed_account(b.public_key.raw),
+        fee=400,
+        innerTx=xt._FeeBumpInner.make(
+            xty.EnvelopeType.ENVELOPE_TYPE_TX, inner),
+        ext=xt.FeeBumpTransaction._types[3].make(0))
+    net = b"\x07" * 32
+    h = xt.feebump_hash(net, fb)
+    assert len(h) == 32
+    assert h != xt.transaction_hash(net, tx)
+
+
+def test_transaction_result_roundtrip():
+    res = xr.tx_success([
+        xr.op_success(xt.OperationType.PAYMENT,
+                      xr.PaymentResult.make(0))])
+    raw = to_bytes(xr.TransactionResult, res)
+    back = from_bytes(xr.TransactionResult, raw)
+    assert to_bytes(xr.TransactionResult, back) == raw
+    failed = xr.tx_result(xr.TransactionResultCode.txBAD_SEQ,
+                          fee_charged=100)
+    raw2 = to_bytes(xr.TransactionResult, failed)
+    assert from_bytes(xr.TransactionResult, raw2).feeCharged == 100
+
+
+def test_ledger_header_roundtrip():
+    sv = xl.basic_stellar_value(b"\x01" * 32, 123)
+    h = xl.LedgerHeader(
+        ledgerVersion=23, previousLedgerHash=b"\x02" * 32, scpValue=sv,
+        txSetResultHash=b"\x03" * 32, bucketListHash=b"\x04" * 32,
+        ledgerSeq=7, totalCoins=10**18, feePool=55, inflationSeq=0,
+        idPool=9, baseFee=100, baseReserve=5000000, maxTxSetSize=1000,
+        skipList=[b"\x00" * 32] * 4,
+        ext=xl.LedgerHeader._types[14].make(0))
+    raw = to_bytes(xl.LedgerHeader, h)
+    assert to_bytes(xl.LedgerHeader, from_bytes(xl.LedgerHeader, raw)) \
+        == raw
+    assert len(xl.ledger_header_hash(h)) == 32
+
+
+def test_scp_envelope_roundtrip():
+    n = SecretKey.from_seed_str("node")
+    st = xs.SCPStatement(
+        nodeID=n.public_key.to_xdr(), slotIndex=5,
+        pledges=xs.SCPStatementPledges.make(
+            xs.SCPStatementType.SCP_ST_PREPARE,
+            xs.SCPStatementPrepare(
+                quorumSetHash=b"\x05" * 32,
+                ballot=xs.SCPBallot(counter=1, value=b"v"),
+                prepared=None, preparedPrime=None, nC=0, nH=0)))
+    env = xs.SCPEnvelope(statement=st, signature=b"\x00" * 64)
+    raw = to_bytes(xs.SCPEnvelope, env)
+    assert to_bytes(xs.SCPEnvelope, from_bytes(xs.SCPEnvelope, raw)) == raw
+
+
+def test_quorum_set_recursive():
+    ids = [SecretKey.from_seed_str(str(i)).public_key.to_xdr()
+           for i in range(4)]
+    inner = xs.SCPQuorumSet(threshold=2, validators=ids[2:], innerSets=[])
+    q = xs.SCPQuorumSet(threshold=2, validators=ids[:2],
+                        innerSets=[inner])
+    raw = to_bytes(xs.SCPQuorumSet, q)
+    back = from_bytes(xs.SCPQuorumSet, raw)
+    assert to_bytes(xs.SCPQuorumSet, back) == raw
+    assert len(xs.quorum_set_hash(q)) == 32
+
+
+def test_generalized_tx_set_roundtrip():
+    a, b = SecretKey.from_seed_str("a"), SecretKey.from_seed_str("b")
+    tx = _payment_tx(a, b)
+    env = xt.TransactionEnvelope.make(
+        xty.EnvelopeType.ENVELOPE_TYPE_TX,
+        xt.TransactionV1Envelope(tx=tx, signatures=[]))
+    comp = xl.TxSetComponent.make(
+        xl.TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
+        xl.TxSetComponentTxsMaybeDiscountedFee(baseFee=100, txs=[env]))
+    gset = xl.GeneralizedTransactionSet.make(
+        1, xl.TransactionSetV1(
+            previousLedgerHash=b"\x08" * 32,
+            phases=[xl.TransactionPhase.make(0, [comp]),
+                    xl.TransactionPhase.make(0, [])]))
+    raw = to_bytes(xl.GeneralizedTransactionSet, gset)
+    back = from_bytes(xl.GeneralizedTransactionSet, raw)
+    assert to_bytes(xl.GeneralizedTransactionSet, back) == raw
+    assert len(xl.generalized_tx_set_hash(gset)) == 32
+
+
+def test_ledger_entry_roundtrip():
+    a = SecretKey.from_seed_str("a").public_key
+    ae = xty.AccountEntry(
+        accountID=a.to_xdr(), balance=10**9, seqNum=1, numSubEntries=0,
+        inflationDest=None, flags=0, homeDomain=b"", thresholds=b"\x01"
+        + b"\x00" * 3, signers=[],
+        ext=xty._AccountEntryExt.make(0))
+    le = xty.LedgerEntry(
+        lastModifiedLedgerSeq=5,
+        data=xty.LedgerEntryData.make(xty.LedgerEntryType.ACCOUNT, ae),
+        ext=xty.LedgerEntry._types[2].make(0))
+    raw = to_bytes(xty.LedgerEntry, le)
+    assert to_bytes(xty.LedgerEntry, from_bytes(xty.LedgerEntry, raw)) \
+        == raw
+
+
+def test_xdr_rejects_trailing_bytes():
+    a = SecretKey.from_seed_str("a").public_key
+    raw = to_bytes(xty.PublicKey, a.to_xdr())
+    with pytest.raises(XdrError):
+        from_bytes(xty.PublicKey, raw + b"\x00\x00\x00\x00")
