@@ -1,0 +1,309 @@
+"""LedgerTxn semantics tests (modeled on the reference's
+``src/ledger/test/LedgerTxnTests.cpp``: commit/rollback nesting, erase,
+active-entry exclusivity, sealed-parent access, deltas/changes)."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import (
+    EntryHandle, InMemoryLedgerStore, LedgerTxn, LedgerTxnError,
+    LedgerTxnRoot, entry_to_key, key_bytes,
+)
+from stellar_tpu.xdr.ledger import LedgerEntryChangeType
+from stellar_tpu.xdr.types import (
+    AccountEntry, LedgerEntry, LedgerEntryType, account_id,
+)
+
+
+def make_account_entry(seed: int, balance: int = 1000) -> LedgerEntry:
+    from stellar_tpu.xdr.types import _AccountEntryExt
+    acc = AccountEntry(
+        accountID=account_id(bytes([seed]) * 32),
+        balance=balance,
+        seqNum=1,
+        numSubEntries=0,
+        inflationDest=None,
+        flags=0,
+        homeDomain=b"",
+        thresholds=b"\x01\x00\x00\x00",
+        signers=[],
+        ext=_AccountEntryExt.make(0),
+    )
+    le = LedgerEntry(
+        lastModifiedLedgerSeq=1,
+        data=LedgerEntry._types[1].make(LedgerEntryType.ACCOUNT, acc),
+        ext=LedgerEntry._types[2].make(0),
+    )
+    return le
+
+
+def test_create_commit_visible_at_root():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    kb = key_bytes(entry_to_key(e))
+    ltx = LedgerTxn(root)
+    h = ltx.create(e)
+    h.deactivate()
+    ltx.commit()
+    assert root.store.get(kb) == e
+
+
+def test_rollback_discards():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    kb = key_bytes(entry_to_key(e))
+    ltx = LedgerTxn(root)
+    ltx.create(e).deactivate()
+    ltx.rollback()
+    assert root.store.get(kb) is None
+
+
+def test_nested_commit_then_outer_rollback():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    kb = key_bytes(entry_to_key(e))
+    outer = LedgerTxn(root)
+    inner = LedgerTxn(outer)
+    inner.create(e).deactivate()
+    inner.commit()
+    assert outer.exists(entry_to_key(e))
+    outer.rollback()
+    assert root.store.get(kb) is None
+
+
+def test_nested_rollback_keeps_outer_state():
+    root = LedgerTxnRoot()
+    e1, e2 = make_account_entry(1), make_account_entry(2)
+    outer = LedgerTxn(root)
+    outer.create(e1).deactivate()
+    inner = LedgerTxn(outer)
+    inner.create(e2).deactivate()
+    inner.rollback()
+    assert outer.exists(entry_to_key(e1))
+    assert not outer.exists(entry_to_key(e2))
+    outer.commit()
+    assert root.store.get(key_bytes(entry_to_key(e1))) is not None
+
+
+def test_sealed_parent_access_raises():
+    root = LedgerTxnRoot()
+    outer = LedgerTxn(root)
+    inner = LedgerTxn(outer)
+    with pytest.raises(LedgerTxnError):
+        outer.create(make_account_entry(1))
+    with pytest.raises(LedgerTxnError):
+        LedgerTxn(outer)  # second child
+    inner.rollback()
+    outer.create(make_account_entry(1)).deactivate()
+    outer.commit()
+
+
+def test_active_entry_exclusivity():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    ltx = LedgerTxn(root)
+    h = ltx.create(e)
+    with pytest.raises(LedgerTxnError):
+        ltx.load(entry_to_key(e))
+    h.deactivate()
+    h2 = ltx.load(entry_to_key(e))
+    assert h2 is not None
+    h2.deactivate()
+    ltx.commit()
+
+
+def test_create_existing_raises():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    ltx = LedgerTxn(root)
+    ltx.create(e).deactivate()
+    with pytest.raises(LedgerTxnError):
+        ltx.create(make_account_entry(1, balance=5))
+    ltx.rollback()
+
+
+def test_erase_and_shadowing():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    k = entry_to_key(e)
+    seed = LedgerTxn(root)
+    seed.create(e).deactivate()
+    seed.commit()
+
+    ltx = LedgerTxn(root)
+    ltx.erase(k)
+    assert not ltx.exists(k)
+    inner = LedgerTxn(ltx)
+    assert not inner.exists(k)
+    with pytest.raises(LedgerTxnError):
+        inner.erase(k)  # already gone
+    inner.rollback()
+    ltx.commit()
+    assert root.store.get(key_bytes(k)) is None
+
+
+def test_mutation_through_handle_commits():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1, balance=100)
+    k = entry_to_key(e)
+    seed = LedgerTxn(root)
+    seed.create(e).deactivate()
+    seed.commit()
+
+    ltx = LedgerTxn(root)
+    h = ltx.load(k)
+    h.data.balance = 250
+    h.deactivate()
+    ltx.commit()
+    assert root.store.get(key_bytes(k)).data.value.balance == 250
+
+
+def test_mutation_rolled_back_does_not_leak():
+    """Child mutations must not alias parent state (copy-on-load)."""
+    root = LedgerTxnRoot()
+    e = make_account_entry(1, balance=100)
+    k = entry_to_key(e)
+    seed = LedgerTxn(root)
+    seed.create(e).deactivate()
+    seed.commit()
+
+    outer = LedgerTxn(root)
+    inner = LedgerTxn(outer)
+    h = inner.load(k)
+    h.data.balance = 999
+    h.deactivate()
+    inner.rollback()
+    got = outer.load(k)
+    assert got.data.balance == 100
+    got.deactivate()
+    outer.rollback()
+
+
+def test_load_without_record_not_in_delta():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    seed = LedgerTxn(root)
+    seed.create(e).deactivate()
+    seed.commit()
+
+    ltx = LedgerTxn(root)
+    snap = ltx.load_without_record(entry_to_key(e))
+    assert snap is not None
+    assert ltx.get_delta() == {}
+    ltx.rollback()
+
+
+def test_get_changes_meta_shapes():
+    root = LedgerTxnRoot()
+    e1 = make_account_entry(1, balance=100)
+    e2 = make_account_entry(2)
+    seed = LedgerTxn(root)
+    seed.create(e1).deactivate()
+    seed.create(e2).deactivate()
+    seed.commit()
+
+    ltx = LedgerTxn(root)
+    h = ltx.load(entry_to_key(e1))
+    h.data.balance = 150
+    h.deactivate()
+    ltx.erase(entry_to_key(e2))
+    ltx.create(make_account_entry(3)).deactivate()
+    changes = ltx.get_changes()
+    kinds = [c.arm for c in changes]
+    assert kinds.count(LedgerEntryChangeType.LEDGER_ENTRY_CREATED) == 1
+    assert kinds.count(LedgerEntryChangeType.LEDGER_ENTRY_REMOVED) == 1
+    assert kinds.count(LedgerEntryChangeType.LEDGER_ENTRY_STATE) == 1
+    assert kinds.count(LedgerEntryChangeType.LEDGER_ENTRY_UPDATED) == 1
+    ltx.rollback()
+
+
+def test_header_mutation_propagates():
+    root = LedgerTxnRoot()
+    ltx = LedgerTxn(root)
+    with ltx.load_header() as hh:
+        hh.header.feePool += 500
+        hh.header.idPool += 1
+    ltx.commit()
+    assert root.header().feePool == 500
+    assert root.header().idPool == 1
+
+
+def test_header_rollback_discards():
+    root = LedgerTxnRoot()
+    base_fee_pool = root.header().feePool
+    ltx = LedgerTxn(root)
+    with ltx.load_header() as hh:
+        hh.header.feePool += 500
+    ltx.rollback()
+    assert root.header().feePool == base_fee_pool
+
+
+def test_all_entries_of_type_shadowing():
+    root = LedgerTxnRoot()
+    seed = LedgerTxn(root)
+    for i in range(1, 4):
+        seed.create(make_account_entry(i)).deactivate()
+    seed.commit()
+
+    ltx = LedgerTxn(root)
+    ltx.erase(entry_to_key(make_account_entry(2)))
+    ltx.create(make_account_entry(9)).deactivate()
+    got = ltx.all_entries_of_type(LedgerEntryType.ACCOUNT)
+    seeds = sorted(e.data.value.accountID.value[0] for e in got)
+    assert seeds == [1, 3, 9]
+    ltx.rollback()
+
+
+def test_context_manager_rolls_back_on_exit():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1)
+    with LedgerTxn(root) as ltx:
+        ltx.create(e).deactivate()
+    assert root.store.get(key_bytes(entry_to_key(e))) is None
+
+
+def test_rollback_with_open_child_rolls_back_child():
+    root = LedgerTxnRoot()
+    outer = LedgerTxn(root)
+    inner = LedgerTxn(outer)
+    inner.create(make_account_entry(1)).deactivate()
+    outer.rollback()  # must cascade into inner
+    assert not inner._open
+    assert root.store.entries == {}
+
+
+def test_child_of_closed_txn_rejected():
+    root = LedgerTxnRoot()
+    ltx = LedgerTxn(root)
+    ltx.commit()
+    with pytest.raises(LedgerTxnError):
+        LedgerTxn(ltx)
+
+
+def test_erase_via_handle_checks_state():
+    root = LedgerTxnRoot()
+    outer = LedgerTxn(root)
+    h = outer.create(make_account_entry(1))
+    inner = LedgerTxn(outer)
+    with pytest.raises(LedgerTxnError):
+        h.erase()  # outer is sealed
+    inner.rollback()
+    h.erase()
+    with pytest.raises(LedgerTxnError):
+        h.erase()  # already deactivated
+    outer.rollback()
+
+
+def test_load_without_record_returns_copy():
+    root = LedgerTxnRoot()
+    e = make_account_entry(1, balance=100)
+    seed = LedgerTxn(root)
+    seed.create(e).deactivate()
+    seed.commit()
+    ltx = LedgerTxn(root)
+    snap = ltx.load_without_record(entry_to_key(e))
+    snap.data.value.balance = 0  # must not leak
+    assert ltx.get_delta() == {}
+    h = ltx.load(entry_to_key(e))
+    assert h.data.balance == 100
+    h.deactivate()
+    ltx.rollback()
