@@ -1,0 +1,70 @@
+"""Asset helpers: validity, issuer extraction, trustline keys
+(reference ``src/util/types.cpp`` ``isAssetValid``/``getIssuer`` and
+``src/transactions/TransactionUtils.cpp`` ``trustlineKey``).
+"""
+
+from __future__ import annotations
+
+from stellar_tpu.xdr.types import (
+    Asset, AssetType, LedgerEntryType, LedgerKey, LedgerKeyTrustLine,
+    TrustLineAsset,
+)
+
+__all__ = [
+    "is_asset_code_valid", "is_asset_valid", "get_issuer",
+    "asset_to_trustline_asset", "trustline_key", "is_native",
+]
+
+_ALNUM = set(b"abcdefghijklmnopqrstuvwxyz"
+             b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789")
+
+
+def _code_ok(code: bytes, min_len: int, max_len: int) -> bool:
+    """Zero-padded [a-zA-Z0-9]+ of length in [min_len, max_len]
+    (reference ``isStringValid``/``isAssetValid``)."""
+    n = len(code)
+    # find content length: chars up to first NUL; rest must be NUL
+    content = code.rstrip(b"\x00")
+    if not (min_len <= len(content) <= max_len):
+        return False
+    if any(c not in _ALNUM for c in content):
+        return False
+    return code[len(content):] == b"\x00" * (n - len(content))
+
+
+def is_asset_code_valid(asset) -> bool:
+    if asset.arm == AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+        return _code_ok(asset.value.assetCode, 1, 4)
+    if asset.arm == AssetType.ASSET_TYPE_CREDIT_ALPHANUM12:
+        return _code_ok(asset.value.assetCode, 5, 12)
+    return False
+
+
+def is_native(asset) -> bool:
+    return asset.arm == AssetType.ASSET_TYPE_NATIVE
+
+
+def is_asset_valid(asset, ledger_version: int) -> bool:
+    if asset.arm == AssetType.ASSET_TYPE_NATIVE:
+        return True
+    if asset.arm in (AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                     AssetType.ASSET_TYPE_CREDIT_ALPHANUM12):
+        return is_asset_code_valid(asset)
+    return False
+
+
+def get_issuer(asset):
+    return asset.value.issuer
+
+
+def asset_to_trustline_asset(asset):
+    return TrustLineAsset.make(asset.arm, asset.value)
+
+
+def trustline_key(account_id, asset) -> "LedgerKey.Value":
+    # Asset and TrustLineAsset share arm values for the asset kinds a
+    # trustline key can name, so re-tagging the same payload is exact.
+    return LedgerKey.make(
+        LedgerEntryType.TRUSTLINE,
+        LedgerKeyTrustLine(accountID=account_id,
+                           asset=asset_to_trustline_asset(asset)))

@@ -1,0 +1,29 @@
+"""Order-book conversion engine boundary (reference
+``src/transactions/OfferExchange.cpp``).
+
+``convert`` / ``convert_send`` are the hooks the path-payment frames call
+for each cross-asset hop. The full matching engine (offer crossing +
+liquidity-pool exchange, ``convertWithOffersAndPools``) lands with the
+offers milestone; until then the book is empty, so every conversion
+reports TOO_FEW_OFFERS — byte-identical behavior to an empty order book.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+__all__ = ["convert", "convert_send"]
+
+
+def convert(op, ltx, send_asset, recv_asset, max_recv: int
+            ) -> Tuple[bool, int, List, str]:
+    """Strict-receive hop: acquire ``max_recv`` of recv_asset for
+    send_asset. Returns (ok, amount_sent, claim_atoms, fail_name)."""
+    return False, 0, [], "TOO_FEW_OFFERS"
+
+
+def convert_send(op, ltx, send_asset, recv_asset, amount_send: int
+                 ) -> Tuple[bool, int, List, str]:
+    """Strict-send hop: spend ``amount_send`` of send_asset into
+    recv_asset. Returns (ok, amount_received, claim_atoms, fail_name)."""
+    return False, 0, [], "TOO_FEW_OFFERS"

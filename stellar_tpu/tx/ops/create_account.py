@@ -1,0 +1,69 @@
+"""CreateAccount (reference ``src/transactions/CreateAccountOpFrame.cpp``,
+protocol >= 14 path, non-sponsored)."""
+
+from __future__ import annotations
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_tpu.tx.account_utils import (
+    add_balance, get_available_balance, get_min_balance,
+    get_starting_sequence_number,
+)
+from stellar_tpu.tx.op_frame import OperationFrame, account_key, register_op
+from stellar_tpu.xdr.results import CreateAccountResultCode as Code
+from stellar_tpu.xdr.tx import OperationType
+from stellar_tpu.xdr.types import (
+    AccountEntry, LedgerEntry, LedgerEntryType, _AccountEntryExt,
+)
+
+
+def new_account_entry(account_id, balance: int, seq_num: int,
+                      last_modified: int = 0) -> LedgerEntry:
+    acc = AccountEntry(
+        accountID=account_id, balance=balance, seqNum=seq_num,
+        numSubEntries=0, inflationDest=None, flags=0, homeDomain=b"",
+        thresholds=b"\x01\x00\x00\x00", signers=[],
+        ext=_AccountEntryExt.make(0))
+    return LedgerEntry(
+        lastModifiedLedgerSeq=last_modified,
+        data=LedgerEntry._types[1].make(LedgerEntryType.ACCOUNT, acc),
+        ext=LedgerEntry._types[2].make(0))
+
+
+@register_op(OperationType.CREATE_ACCOUNT)
+class CreateAccountOpFrame(OperationFrame):
+
+    def do_check_valid(self, ledger_version: int):
+        if self.body.startingBalance < 0:
+            return False, self.make_result(Code.CREATE_ACCOUNT_MALFORMED)
+        if self.body.destination == self.source_account_id():
+            return False, self.make_result(Code.CREATE_ACCOUNT_MALFORMED)
+        return True, None
+
+    def do_apply(self, outer):
+        if outer.exists(account_key(self.body.destination)):
+            return False, self.make_result(Code.CREATE_ACCOUNT_ALREADY_EXIST)
+
+        with LedgerTxn(outer) as ltx:
+            header = ltx.header()
+            # the created account must itself meet the base reserve
+            if self.body.startingBalance < 2 * header.baseReserve:
+                return False, self.make_result(
+                    Code.CREATE_ACCOUNT_LOW_RESERVE)
+
+            src = ltx.load(account_key(self.source_account_id()))
+            if get_available_balance(header, src.entry) < \
+                    self.body.startingBalance:
+                src.deactivate()
+                return False, self.make_result(
+                    Code.CREATE_ACCOUNT_UNDERFUNDED)
+            ok = add_balance(header, src.entry, -self.body.startingBalance)
+            assert ok
+            src.deactivate()
+
+            entry = new_account_entry(
+                self.body.destination, self.body.startingBalance,
+                get_starting_sequence_number(header.ledgerSeq),
+                last_modified=header.ledgerSeq)
+            ltx.create(entry).deactivate()
+            ltx.commit()
+        return True, self.make_result(Code.CREATE_ACCOUNT_SUCCESS)

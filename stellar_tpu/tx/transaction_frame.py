@@ -1,0 +1,753 @@
+"""TransactionFrame: validation, fee/seq processing, and apply
+(reference ``src/transactions/TransactionFrame.cpp``).
+
+Lifecycle (current protocol >= 19):
+
+* ``check_valid`` — pre-consensus validation: structure, preconditions,
+  sequence number, signatures (low threshold + extra signers), balance
+  can cover the fee, then per-op ``do_check_valid`` + op signature
+  thresholds; used by the tx queue and txset validation.
+* ``process_fee_seq_num`` — ledger-close fee phase: charge
+  min(balance, fee) into the fee pool (no reserve check — reference
+  ``processFeeSeqNum``).
+* ``apply`` — re-validate under the apply snapshot, bump the sequence
+  number even when invalid (``processSeqNum``), settle signature
+  bookkeeping (one-time signer removal, BAD_AUTH_EXTRA), then apply each
+  operation in its own nested LedgerTxn, rolling everything back if any
+  op fails (``applyOperations``).
+
+Fee-bump envelopes are handled by :class:`FeeBumpTransactionFrame`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_tpu.tx.account_utils import (
+    INT64_MAX, account_ext_v2, get_available_balance,
+)
+from stellar_tpu.tx.op_frame import account_key, make_op_frame
+from stellar_tpu.tx.signature_checker import SignatureChecker
+from stellar_tpu.xdr.results import (
+    OperationResult, TransactionResult, TransactionResultCode as TxCode,
+    tx_result,
+)
+from stellar_tpu.xdr.tx import (
+    DecoratedSignature, FeeBumpTransaction, MAX_OPS_PER_TX,
+    Preconditions, PreconditionType, Transaction, TransactionEnvelope,
+    feebump_sig_payload, muxed_account, muxed_to_account_id,
+    transaction_sig_payload,
+)
+from stellar_tpu.xdr.types import (
+    EnvelopeType, Signer, SignerKey, SignerKeyType,
+)
+
+__all__ = [
+    "ValidationType", "MutableTxResult", "TransactionFrame",
+    "FeeBumpTransactionFrame", "make_transaction_frame",
+]
+
+
+class ValidationType:
+    INVALID = 0            # fast fail
+    UPDATE_SEQ_NUM = 1     # invalid, but seq num still consumed on apply
+    POST_AUTH = 2          # invalid after auth (fee was charged)
+    MAYBE_VALID = 3
+
+
+class MutableTxResult:
+    """Accumulates the result of one transaction (reference
+    ``MutableTransactionResult``)."""
+
+    def __init__(self, code: int = TxCode.txSUCCESS, fee_charged: int = 0):
+        self.code = code
+        self.fee_charged = fee_charged
+        self.op_results: List = []
+
+    def set_code(self, code: int):
+        self.code = code
+
+    def to_xdr(self) -> TransactionResult:
+        ops = self.op_results if self.code in (
+            TxCode.txSUCCESS, TxCode.txFAILED) else None
+        return tx_result(self.code, ops, self.fee_charged)
+
+    @property
+    def is_success(self) -> bool:
+        return self.code == TxCode.txSUCCESS
+
+
+class TxApplyMeta:
+    """Collects entry-change meta during apply (reference
+    ``TransactionMetaFrame``)."""
+
+    def __init__(self):
+        self.tx_changes_before: List = []
+        self.operations: List = []
+        self.tx_changes_after: List = []
+
+
+class TransactionFrame:
+    """A v0/v1 transaction envelope bound to a network id."""
+
+    def __init__(self, network_id: bytes, envelope):
+        self.network_id = network_id
+        self.envelope = envelope
+        etype = envelope.arm
+        if etype == EnvelopeType.ENVELOPE_TYPE_TX:
+            self.tx: Transaction = envelope.value.tx
+        elif etype == EnvelopeType.ENVELOPE_TYPE_TX_V0:
+            self.tx = _v0_to_v1(envelope.value.tx)
+        else:
+            raise ValueError("not a v0/v1 transaction envelope")
+        self.signatures: Sequence[DecoratedSignature] = \
+            envelope.value.signatures
+        self._hash: Optional[bytes] = None
+        self.op_frames = [make_op_frame(op, self, i)
+                          for i, op in enumerate(self.tx.operations)]
+
+    # ---------------- identity / accessors ----------------
+
+    def contents_hash(self) -> bytes:
+        """Tx id: SHA-256 of the signature payload (reference
+        ``getContentsHash``; v0 envelopes hash as their v1 form)."""
+        if self._hash is None:
+            self._hash = sha256(
+                transaction_sig_payload(self.network_id, self.tx))
+        return self._hash
+
+    def source_account_id(self):
+        return muxed_to_account_id(self.tx.sourceAccount)
+
+    @property
+    def seq_num(self) -> int:
+        return self.tx.seqNum
+
+    def num_operations(self) -> int:
+        return len(self.tx.operations)
+
+    def full_fee(self) -> int:
+        return self.tx.fee
+
+    def inclusion_fee(self) -> int:
+        if self.is_soroban():
+            return self.full_fee() - self.declared_soroban_resource_fee()
+        return self.full_fee()
+
+    def is_soroban(self) -> bool:
+        return self.tx.ext.arm == 1
+
+    def declared_soroban_resource_fee(self) -> int:
+        return self.tx.ext.value.resourceFee if self.is_soroban() else 0
+
+    def fee(self, header, base_fee: Optional[int], applying: bool) -> int:
+        """Effective fee under a discounted base fee (reference
+        ``TransactionFrame::getFee``)."""
+        if base_fee is None:
+            return self.full_fee()
+        adjusted = base_fee * max(1, self.num_operations())
+        resource = self.declared_soroban_resource_fee()
+        if applying:
+            return resource + min(self.inclusion_fee(), adjusted)
+        return resource + adjusted
+
+    # -- preconditions --
+
+    def time_bounds(self):
+        c = self.tx.cond
+        if c.arm == PreconditionType.PRECOND_TIME:
+            return c.value
+        if c.arm == PreconditionType.PRECOND_V2:
+            return c.value.timeBounds
+        return None
+
+    def ledger_bounds(self):
+        c = self.tx.cond
+        return c.value.ledgerBounds \
+            if c.arm == PreconditionType.PRECOND_V2 else None
+
+    def min_seq_num(self):
+        c = self.tx.cond
+        return c.value.minSeqNum \
+            if c.arm == PreconditionType.PRECOND_V2 else None
+
+    def min_seq_age(self) -> int:
+        c = self.tx.cond
+        return c.value.minSeqAge \
+            if c.arm == PreconditionType.PRECOND_V2 else 0
+
+    def min_seq_ledger_gap(self) -> int:
+        c = self.tx.cond
+        return c.value.minSeqLedgerGap \
+            if c.arm == PreconditionType.PRECOND_V2 else 0
+
+    def extra_signers(self) -> list:
+        c = self.tx.cond
+        return list(c.value.extraSigners) \
+            if c.arm == PreconditionType.PRECOND_V2 else []
+
+    # ---------------- signature plumbing ----------------
+
+    def make_signature_checker(self, ledger_version: int) -> SignatureChecker:
+        return SignatureChecker(ledger_version, self.contents_hash(),
+                                self.signatures)
+
+    def check_signature_for_account(self, checker: SignatureChecker, acc,
+                                    needed_weight: int) -> bool:
+        """Master key + account signers vs needed weight (reference
+        ``TransactionFrame::checkSignature``)."""
+        signers = []
+        if acc.thresholds[0]:
+            signers.append(Signer(
+                key=SignerKey.make(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                                   acc.accountID.value),
+                weight=acc.thresholds[0]))
+        signers.extend(acc.signers)
+        return checker.check_signature(signers, needed_weight)
+
+    def check_signature_no_account(self, checker: SignatureChecker,
+                                   account_id) -> bool:
+        """Missing op-source account: master key with weight 1, needed 0
+        (reference ``checkSignatureNoAccount``)."""
+        signers = [Signer(
+            key=SignerKey.make(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                               account_id.value),
+            weight=1)]
+        return checker.check_signature(signers, 0)
+
+    def check_extra_signers(self, checker: SignatureChecker) -> bool:
+        extra = self.extra_signers()
+        if not extra:
+            return True
+        signers = [Signer(key=k, weight=1) for k in extra]
+        return checker.check_signature(signers, len(signers))
+
+    # ---------------- validation ----------------
+
+    def is_too_early(self, header, lower_offset: int = 0) -> bool:
+        tb = self.time_bounds()
+        if tb and tb.minTime and \
+                tb.minTime > header.scpValue.closeTime + lower_offset:
+            return True
+        lb = self.ledger_bounds()
+        return bool(lb and lb.minLedger > header.ledgerSeq)
+
+    def is_too_late(self, header, upper_offset: int = 0) -> bool:
+        tb = self.time_bounds()
+        if tb and tb.maxTime and \
+                tb.maxTime < header.scpValue.closeTime + upper_offset:
+            return True
+        lb = self.ledger_bounds()
+        return bool(lb and lb.maxLedger != 0
+                    and lb.maxLedger <= header.ledgerSeq)
+
+    def is_bad_seq(self, header, current: int) -> bool:
+        if self.seq_num == (header.ledgerSeq << 32):
+            return True
+        msn = self.min_seq_num()
+        if msn is not None:
+            return current < msn or current >= self.seq_num
+        return current == INT64_MAX or current + 1 != self.seq_num
+
+    def is_too_early_for_account(self, header, acc, lower_offset: int) -> bool:
+        """minSeqAge / minSeqLedgerGap vs the account's seqTime/seqLedger
+        (reference ``isTooEarlyForAccount``)."""
+        v2 = account_ext_v2(acc)
+        v3 = v2.ext.value if (v2 is not None and v2.ext.arm == 3) else None
+        acc_seq_time = v3.seqTime if v3 else 0
+        min_seq_age = self.min_seq_age()
+        lower_close = header.scpValue.closeTime + lower_offset
+        if min_seq_age > lower_close or \
+                lower_close - min_seq_age < acc_seq_time:
+            return True
+        acc_seq_ledger = v3.seqLedger if v3 else 0
+        gap = self.min_seq_ledger_gap()
+        if gap > header.ledgerSeq or \
+                header.ledgerSeq - gap < acc_seq_ledger:
+            return True
+        return False
+
+    def _soroban_ops_consistent(self) -> bool:
+        """Soroban data ext <=> exactly one Soroban op (reference
+        ``validateSorobanOpsConsistency``)."""
+        from stellar_tpu.xdr.tx import OperationType
+        soroban_types = (OperationType.INVOKE_HOST_FUNCTION,
+                         OperationType.EXTEND_FOOTPRINT_TTL,
+                         OperationType.RESTORE_FOOTPRINT)
+        n_soroban = sum(1 for op in self.tx.operations
+                        if op.body.arm in soroban_types)
+        if self.is_soroban():
+            return n_soroban == 1 and self.num_operations() == 1
+        return n_soroban == 0
+
+    def _common_valid_pre_seq_num(self, ltx, result: MutableTxResult,
+                                  lower_offset: int, upper_offset: int,
+                                  charge_fee: bool = True) -> bool:
+        """Account-independent checks (reference
+        ``commonValidPreSeqNum``)."""
+        extra = self.extra_signers()
+        if extra:
+            if len(extra) == 2 and extra[0] == extra[1]:
+                result.set_code(TxCode.txMALFORMED)
+                return False
+            for s in extra:
+                if s.arm == \
+                        SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD \
+                        and len(s.value.payload) == 0:
+                    result.set_code(TxCode.txMALFORMED)
+                    return False
+        if self.num_operations() == 0:
+            result.set_code(TxCode.txMISSING_OPERATION)
+            return False
+        if self.num_operations() > MAX_OPS_PER_TX:
+            result.set_code(TxCode.txMALFORMED)
+            return False
+        if not self._soroban_ops_consistent():
+            result.set_code(TxCode.txMALFORMED)
+            return False
+        header = ltx.header()
+        if self.is_too_early(header, lower_offset):
+            result.set_code(TxCode.txTOO_EARLY)
+            return False
+        if self.is_too_late(header, upper_offset):
+            result.set_code(TxCode.txTOO_LATE)
+            return False
+        # fee-bumped inner txs (charge_fee False) may bid any fee >= 0;
+        # the outer envelope pays (reference gates this on chargeFee)
+        if charge_fee and self.full_fee() < self.fee(
+                header, header.baseFee, applying=False):
+            result.set_code(TxCode.txINSUFFICIENT_FEE)
+            return False
+        if not charge_fee and self.inclusion_fee() < 0:
+            result.set_code(TxCode.txINSUFFICIENT_FEE)
+            return False
+        if ltx.load_without_record(
+                account_key(self.source_account_id())) is None:
+            result.set_code(TxCode.txNO_ACCOUNT)
+            return False
+        return True
+
+    def common_valid(self, checker: SignatureChecker, ltx,
+                     current: int, applying: bool, charge_fee: bool,
+                     result: MutableTxResult, lower_offset: int = 0,
+                     upper_offset: int = 0) -> int:
+        """Returns a ValidationType (reference ``commonValid``)."""
+        if not self._common_valid_pre_seq_num(
+                ltx, result, lower_offset, upper_offset, charge_fee):
+            return ValidationType.INVALID
+
+        header = ltx.header()
+        src_entry = ltx.load_without_record(
+            account_key(self.source_account_id()))
+        acc = src_entry.data.value
+
+        if current == 0:
+            current = acc.seqNum
+        if self.is_bad_seq(header, current):
+            result.set_code(TxCode.txBAD_SEQ)
+            return ValidationType.INVALID
+
+        cv = ValidationType.UPDATE_SEQ_NUM
+
+        if self.is_too_early_for_account(header, acc, lower_offset):
+            result.set_code(TxCode.txBAD_MIN_SEQ_AGE_OR_GAP)
+            return cv
+        if not self.check_signature_for_account(
+                checker, acc, acc.thresholds[1]):
+            result.set_code(TxCode.txBAD_AUTH)
+            return cv
+        if not self.check_extra_signers(checker):
+            result.set_code(TxCode.txBAD_AUTH)
+            return cv
+
+        cv = ValidationType.POST_AUTH
+
+        # when applying, the fee was already taken in the fee phase
+        fee_to_pay = 0 if applying else self.full_fee()
+        if charge_fee and \
+                get_available_balance(header, src_entry) < fee_to_pay:
+            result.set_code(TxCode.txINSUFFICIENT_BALANCE)
+            return cv
+
+        return ValidationType.MAYBE_VALID
+
+    def check_valid(self, ltx, current: int = 0, lower_offset: int = 0,
+                    upper_offset: int = 0,
+                    charge_fee: bool = True) -> MutableTxResult:
+        """Full pre-consensus validation incl. per-op checks (reference
+        ``checkValidWithOptionallyChargedFee``)."""
+        result = MutableTxResult(
+            fee_charged=self.fee(ltx.header(), ltx.header().baseFee
+                                 if charge_fee else None, applying=False))
+        checker = self.make_signature_checker(ltx.header().ledgerVersion)
+        cv = self.common_valid(checker, ltx, current, applying=False,
+                               charge_fee=charge_fee, result=result,
+                               lower_offset=lower_offset,
+                               upper_offset=upper_offset)
+        if cv != ValidationType.MAYBE_VALID:
+            return result
+
+        ok_all = True
+        for op in self.op_frames:
+            ok, fail = op.check_valid(checker, ltx, for_apply=False)
+            self_res = fail if fail is not None else op.make_result(0)
+            result.op_results.append(self_res)
+            if not ok:
+                ok_all = False
+        if not ok_all:
+            result.set_code(TxCode.txFAILED)
+            return result
+        if not checker.check_all_signatures_used():
+            result.set_code(TxCode.txBAD_AUTH_EXTRA)
+            return result
+        result.set_code(TxCode.txSUCCESS)
+        return result
+
+    # ---------------- ledger-close processing ----------------
+
+    def process_fee_seq_num(self, ltx, base_fee: Optional[int]
+                            ) -> MutableTxResult:
+        """Fee phase: charge min(balance, fee) (reference
+        ``processFeeSeqNum``)."""
+        with LedgerTxn(ltx) as inner:
+            with inner.load_header() as hh:
+                header = hh.header
+                fee = self.fee(header, base_fee, applying=True)
+                result = MutableTxResult(fee_charged=fee)
+                src = inner.load(account_key(self.source_account_id()))
+                if src is None:
+                    raise RuntimeError("fee source account missing")
+                acc = src.data
+                if fee > 0:
+                    charged = min(acc.balance, fee)
+                    result.fee_charged = charged
+                    acc.balance -= charged
+                    header.feePool += charged
+                src.deactivate()
+            inner.commit()
+        return result
+
+    def process_seq_num(self, ltx):
+        """Consume the sequence number (reference ``processSeqNum``)."""
+        from stellar_tpu.tx.ops.misc import (
+            maybe_update_account_on_seq_update,
+        )
+        with ltx.load(account_key(self.source_account_id())) as src:
+            if src.data.seqNum > self.seq_num:
+                raise RuntimeError("unexpected sequence number")
+            src.data.seqNum = self.seq_num
+            maybe_update_account_on_seq_update(ltx.header(), src.data)
+
+    def remove_one_time_signers(self, ltx):
+        """Drop pre-auth-tx signers matching this tx from every source
+        account (reference ``removeOneTimeSignerFromAllSourceAccounts``)."""
+        # collect unique source account ids (tx + op sources)
+        seen = []
+        for aid in [self.source_account_id()] + \
+                [op.source_account_id() for op in self.op_frames]:
+            if aid not in seen:
+                seen.append(aid)
+        h = self.contents_hash()
+        for aid in seen:
+            handle = ltx.load(account_key(aid))
+            if handle is None:
+                continue
+            acc = handle.data
+            doomed = [i for i, s in enumerate(acc.signers)
+                      if s.key.arm ==
+                      SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
+                      and s.key.value == h]
+            for i in reversed(doomed):
+                _remove_signer_with_possible_sponsorship(ltx, acc, i)
+            handle.deactivate()
+
+    def process_signatures(self, cv: int, checker: SignatureChecker,
+                           ltx, result: MutableTxResult) -> bool:
+        """Post-validation signature settlement (reference
+        ``processSignatures``)."""
+        maybe_valid = cv == ValidationType.MAYBE_VALID
+        if not maybe_valid:
+            self.remove_one_time_signers(ltx)
+            return False
+
+        all_ops_valid = True
+        if result.code in (TxCode.txSUCCESS, TxCode.txFAILED):
+            with LedgerTxn(ltx) as scope:
+                for i, op in enumerate(self.op_frames):
+                    ok, fail = op.check_signature(
+                        checker, scope, for_apply=False)
+                    if not ok:
+                        result.op_results[i] = fail
+                        all_ops_valid = False
+                scope.rollback()
+
+        self.remove_one_time_signers(ltx)
+
+        if not all_ops_valid:
+            result.set_code(TxCode.txFAILED)
+            return False
+        if not checker.check_all_signatures_used():
+            result.set_code(TxCode.txBAD_AUTH_EXTRA)
+            return False
+        return maybe_valid
+
+    # ---------------- apply ----------------
+
+    def apply(self, ltx, meta: Optional[TxApplyMeta] = None,
+              charge_fee: bool = True) -> MutableTxResult:
+        """Apply under the close snapshot (reference
+        ``TransactionFrame::apply``). Returns the final result; state
+        effects are committed into ``ltx``."""
+        if meta is None:
+            meta = TxApplyMeta()
+        checker = self.make_signature_checker(ltx.header().ledgerVersion)
+        result = MutableTxResult(fee_charged=0)
+        # op results pre-seeded as successes so op signature failures can
+        # be recorded positionally
+        result.op_results = [op.make_result(0) for op in self.op_frames]
+
+        tx_level = LedgerTxn(ltx)
+        cv = self.common_valid(checker, tx_level, 0, applying=True,
+                               charge_fee=charge_fee, result=result)
+        if cv >= ValidationType.UPDATE_SEQ_NUM:
+            self.process_seq_num(tx_level)
+        sigs_valid = self.process_signatures(cv, checker, tx_level, result)
+        meta.tx_changes_before.extend(tx_level.get_changes())
+        tx_level.commit()
+
+        ok = sigs_valid and cv == ValidationType.MAYBE_VALID
+        if not ok:
+            if result.code == TxCode.txSUCCESS:
+                result.set_code(TxCode.txFAILED)
+            return result
+
+        return self._apply_operations(checker, ltx, meta, result)
+
+    def _apply_operations(self, checker, ltx, meta: TxApplyMeta,
+                          result: MutableTxResult) -> MutableTxResult:
+        """Per-op apply loop (reference ``applyOperations``)."""
+        success = True
+        op_metas = []
+        tx_txn = LedgerTxn(ltx)
+        try:
+            for i, op in enumerate(self.op_frames):
+                op_txn = LedgerTxn(tx_txn)
+                ok, op_res = op.apply(checker, op_txn)
+                result.op_results[i] = op_res
+                if not ok:
+                    success = False
+                if success:
+                    op_metas.append(op_txn.get_changes())
+                if ok:
+                    op_txn.commit()
+                else:
+                    op_txn.rollback()
+            if success:
+                tx_txn.commit()
+                meta.operations.extend(op_metas)
+                result.set_code(TxCode.txSUCCESS)
+            else:
+                tx_txn.rollback()
+                result.set_code(TxCode.txFAILED)
+        except Exception:
+            if tx_txn._open:
+                tx_txn.rollback()
+            result.set_code(TxCode.txINTERNAL_ERROR)
+            raise
+        return result
+
+
+def _remove_signer_with_possible_sponsorship(ltx, acc, idx: int):
+    """Remove acc.signers[idx] keeping sponsorship bookkeeping aligned:
+    the parallel signerSponsoringIDs entry goes too, and a sponsor's
+    numSponsoring / the account's numSponsored are decremented
+    (reference ``removeSignerWithPossibleSponsorship``,
+    ``src/transactions/SponsorshipUtils.cpp``)."""
+    v2 = account_ext_v2(acc)
+    sponsor_id = None
+    if v2 is not None and idx < len(v2.signerSponsoringIDs):
+        sponsor_id = v2.signerSponsoringIDs[idx]
+        del v2.signerSponsoringIDs[idx]
+    del acc.signers[idx]
+    if sponsor_id is not None:
+        v2.numSponsored -= 1
+        sp = ltx.load(account_key(sponsor_id))
+        if sp is not None:
+            sp_v2 = account_ext_v2(sp.data)
+            if sp_v2 is not None:
+                sp_v2.numSponsoring -= 1
+            sp.deactivate()
+    else:
+        acc.numSubEntries -= 1
+
+
+def _v0_to_v1(tx_v0) -> Transaction:
+    """Normalize a legacy TransactionV0 to the v1 shape it hashes as."""
+    cond = Preconditions.make(PreconditionType.PRECOND_NONE) \
+        if tx_v0.timeBounds is None else \
+        Preconditions.make(PreconditionType.PRECOND_TIME, tx_v0.timeBounds)
+    return Transaction(
+        sourceAccount=muxed_account(tx_v0.sourceAccountEd25519),
+        fee=tx_v0.fee, seqNum=tx_v0.seqNum, cond=cond, memo=tx_v0.memo,
+        operations=tx_v0.operations,
+        ext=Transaction._types[6].make(0))
+
+
+class FeeBumpTransactionFrame:
+    """Fee-bump envelope: outer fee account pays, inner tx applies
+    (reference ``FeeBumpTransactionFrame.cpp``)."""
+
+    def __init__(self, network_id: bytes, envelope):
+        if envelope.arm != EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+            raise ValueError("not a fee-bump envelope")
+        self.network_id = network_id
+        self.envelope = envelope
+        self.fee_bump: FeeBumpTransaction = envelope.value.tx
+        self.signatures = envelope.value.signatures
+        inner_env = TransactionEnvelope.make(
+            EnvelopeType.ENVELOPE_TYPE_TX, self.fee_bump.innerTx.value)
+        self.inner = TransactionFrame(network_id, inner_env)
+        self._hash: Optional[bytes] = None
+
+    def contents_hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = sha256(
+                feebump_sig_payload(self.network_id, self.fee_bump))
+        return self._hash
+
+    def fee_source_id(self):
+        return muxed_to_account_id(self.fee_bump.feeSource)
+
+    def source_account_id(self):
+        return self.inner.source_account_id()
+
+    @property
+    def seq_num(self) -> int:
+        return self.inner.seq_num
+
+    def num_operations(self) -> int:
+        return self.inner.num_operations()
+
+    def full_fee(self) -> int:
+        return self.fee_bump.fee
+
+    def inclusion_fee(self) -> int:
+        return self.full_fee() - self.inner.declared_soroban_resource_fee()
+
+    def is_soroban(self) -> bool:
+        return self.inner.is_soroban()
+
+    def fee(self, header, base_fee: Optional[int], applying: bool) -> int:
+        if base_fee is None:
+            return self.full_fee()
+        adjusted = base_fee * (self.num_operations() + 1)
+        resource = self.inner.declared_soroban_resource_fee()
+        if applying:
+            return resource + min(self.inclusion_fee(), adjusted)
+        return resource + adjusted
+
+    def check_valid(self, ltx, current: int = 0, lower_offset: int = 0,
+                    upper_offset: int = 0) -> MutableTxResult:
+        header = ltx.header()
+        result = MutableTxResult(
+            fee_charged=self.fee(header, header.baseFee, applying=False))
+        # outer: fee source exists, fee >= (ops+1)*baseFee and >= inner
+        # full fee, signatures at low threshold
+        if self.full_fee() < self.fee(header, header.baseFee,
+                                      applying=False):
+            result.set_code(TxCode.txINSUFFICIENT_FEE)
+            return result
+        # the outer fee-per-operation rate must beat the inner's:
+        # outerInclusion * innerOps >= innerInclusion * outerOps
+        # (reference FeeBumpTransactionFrame::commonValidPreSeqNum)
+        v1 = self.inclusion_fee() * self.inner.num_operations()
+        v2 = self.inner.inclusion_fee() * (self.inner.num_operations() + 1)
+        if v1 < v2:
+            result.set_code(TxCode.txINSUFFICIENT_FEE)
+            return result
+        fee_entry = ltx.load_without_record(
+            account_key(self.fee_source_id()))
+        if fee_entry is None:
+            result.set_code(TxCode.txNO_ACCOUNT)
+            return result
+        checker = SignatureChecker(header.ledgerVersion,
+                                   self.contents_hash(), self.signatures)
+        acc = fee_entry.data.value
+        if not TransactionFrame.check_signature_for_account(
+                self, checker, acc, acc.thresholds[1]):
+            result.set_code(TxCode.txBAD_AUTH)
+            return result
+        if not checker.check_all_signatures_used():
+            result.set_code(TxCode.txBAD_AUTH_EXTRA)
+            return result
+        if get_available_balance(header, fee_entry) < self.full_fee():
+            result.set_code(TxCode.txINSUFFICIENT_BALANCE)
+            return result
+        inner_res = self.inner.check_valid(
+            ltx, current, lower_offset, upper_offset, charge_fee=False)
+        if inner_res.is_success:
+            result.set_code(TxCode.txFEE_BUMP_INNER_SUCCESS)
+        else:
+            result.set_code(TxCode.txFEE_BUMP_INNER_FAILED)
+        result.inner_result = inner_res
+        return result
+
+    check_signature_for_account = TransactionFrame.check_signature_for_account
+
+    def process_fee_seq_num(self, ltx, base_fee: Optional[int]
+                            ) -> MutableTxResult:
+        with LedgerTxn(ltx) as inner:
+            with inner.load_header() as hh:
+                header = hh.header
+                fee = self.fee(header, base_fee, applying=True)
+                result = MutableTxResult(fee_charged=fee)
+                src = inner.load(account_key(self.fee_source_id()))
+                if src is None:
+                    raise RuntimeError("fee source account missing")
+                acc = src.data
+                if fee > 0:
+                    charged = min(acc.balance, fee)
+                    result.fee_charged = charged
+                    acc.balance -= charged
+                    header.feePool += charged
+                src.deactivate()
+            inner.commit()
+        return result
+
+    def apply(self, ltx, meta: Optional[TxApplyMeta] = None
+              ) -> MutableTxResult:
+        """Outer wraps the inner apply result (fee already charged in the
+        fee phase; inner applies with charge_fee=False)."""
+        inner_res = self.inner.apply(ltx, meta, charge_fee=False)
+        result = MutableTxResult(fee_charged=0)
+        result.set_code(TxCode.txFEE_BUMP_INNER_SUCCESS
+                        if inner_res.is_success
+                        else TxCode.txFEE_BUMP_INNER_FAILED)
+        result.inner_result = inner_res
+        return result
+
+    def to_result_xdr(self, result: MutableTxResult) -> TransactionResult:
+        from stellar_tpu.xdr.results import (
+            InnerTransactionResult, InnerTransactionResultPair,
+        )
+        inner = result.inner_result
+        inner_ops = inner.op_results if inner.code in (
+            TxCode.txSUCCESS, TxCode.txFAILED) else None
+        ir = InnerTransactionResult(
+            feeCharged=0,
+            result=InnerTransactionResult._types[1].make(
+                inner.code, inner_ops),
+            ext=InnerTransactionResult._types[2].make(0))
+        pair = InnerTransactionResultPair(
+            transactionHash=self.inner.contents_hash(), result=ir)
+        return tx_result(result.code, pair, result.fee_charged)
+
+
+def make_transaction_frame(network_id: bytes, envelope):
+    """Frame factory over any envelope arm (reference
+    ``TransactionFrameBase::makeTransactionFromWire``)."""
+    if envelope.arm == EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+        return FeeBumpTransactionFrame(network_id, envelope)
+    return TransactionFrame(network_id, envelope)

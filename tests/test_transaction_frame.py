@@ -1,0 +1,474 @@
+"""TransactionFrame + op tests (modeled on the reference's
+``transactions/test/TxEnvelopeTests.cpp`` / ``PaymentTests.cpp``
+semantics: validation codes, signature thresholds, fee/seq processing,
+apply atomicity)."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.transaction_frame import MutableTxResult, TxApplyMeta
+from stellar_tpu.tx.tx_test_utils import (
+    TEST_NETWORK_ID, create_account_op, make_tx, payment_op,
+    seed_root_with_accounts, keypair,
+)
+from stellar_tpu.xdr.results import (
+    CreateAccountResultCode, OperationResultCode, PaymentResultCode,
+    TransactionResultCode as TxCode,
+)
+from stellar_tpu.xdr.runtime import to_bytes
+from stellar_tpu.xdr.results import TransactionResult
+
+XLM = 10_000_000  # stroops
+
+
+@pytest.fixture
+def env():
+    a, b = keypair("alice"), keypair("bob")
+    root = seed_root_with_accounts([(a, 1000 * XLM), (b, 1000 * XLM)])
+    return root, a, b
+
+
+def seq(root, key):
+    e = root.store.get(
+        __import__("stellar_tpu.ledger.ledger_txn",
+                   fromlist=["key_bytes"]).key_bytes(
+            account_key(
+                __import__("stellar_tpu.xdr.types",
+                           fromlist=["account_id"]).account_id(
+                    key.public_key.raw))))
+    return e.data.value.seqNum
+
+
+def balance_of(root, key):
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.xdr.types import account_id
+    e = root.store.get(
+        key_bytes(account_key(account_id(key.public_key.raw))))
+    return None if e is None else e.data.value.balance
+
+
+def test_check_valid_success(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TxCode.txSUCCESS
+
+
+def test_bad_seq(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 7, ops=[payment_op(b, XLM)])
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txBAD_SEQ
+
+
+def test_no_account():
+    stranger, b = keypair("stranger"), keypair("bob")
+    root = seed_root_with_accounts([(b, 1000 * XLM)])
+    tx = make_tx(stranger, seq_num=1, ops=[payment_op(b, XLM)])
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txNO_ACCOUNT
+
+
+def test_insufficient_fee(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)], fee=99)
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txINSUFFICIENT_FEE
+
+
+def test_missing_operation(env):
+    root, a, _ = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[])
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txMISSING_OPERATION
+
+
+def test_bad_auth_wrong_signer(env):
+    root, a, b = env
+    mallory = keypair("mallory")
+    tx = make_tx(mallory, seq_num=(1 << 32) + 1,
+                 ops=[payment_op(b, XLM)])
+    # re-point source at alice but keep mallory's signature
+    tx.tx.sourceAccount = __import__(
+        "stellar_tpu.xdr.tx", fromlist=["muxed_account"]).muxed_account(
+        a.public_key.raw)
+    tx._hash = None
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txBAD_AUTH
+
+
+def test_bad_auth_extra_signature(env):
+    root, a, b = env
+    extra = keypair("extra")
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                 extra_signers=[extra])
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txBAD_AUTH_EXTRA
+
+
+def test_too_late(env):
+    root, a, b = env
+    from stellar_tpu.xdr.tx import (
+        Preconditions, PreconditionType, TimeBounds,
+    )
+    cond = Preconditions.make(PreconditionType.PRECOND_TIME,
+                              TimeBounds(minTime=0, maxTime=10))
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                 cond=cond)
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txTOO_LATE
+
+
+def test_fee_processing(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)])
+    before = balance_of(root, a)
+    with LedgerTxn(root) as ltx:
+        res = tx.process_fee_seq_num(ltx, base_fee=100)
+        ltx.commit()
+    assert res.fee_charged == 100
+    assert balance_of(root, a) == before - 100
+    assert root.header().feePool == 100
+
+
+def test_apply_payment_end_to_end(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, 5 * XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txSUCCESS
+    assert balance_of(root, a) == 1000 * XLM - 5 * XLM - 100
+    assert balance_of(root, b) == 1005 * XLM
+    assert seq(root, a) == (1 << 32) + 1
+    # result XDR round-trips
+    raw = to_bytes(TransactionResult, res.to_xdr())
+    assert len(raw) > 0
+
+
+def test_apply_underfunded_payment_fails_and_consumes_seq(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[payment_op(b, 10_000 * XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txFAILED
+    inner = res.op_results[0].value.value.arm
+    assert inner == PaymentResultCode.PAYMENT_UNDERFUNDED
+    # seq consumed even though ops failed
+    assert seq(root, a) == (1 << 32) + 1
+    # balances unchanged except the fee
+    assert balance_of(root, a) == 1000 * XLM - 100
+    assert balance_of(root, b) == 1000 * XLM
+
+
+def test_apply_multi_op_atomicity(env):
+    """Second op fails -> first op's effects must be rolled back."""
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[payment_op(b, 5 * XLM),
+                      payment_op(b, 10_000 * XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txFAILED
+    assert res.op_results[0].value.value.arm == \
+        PaymentResultCode.PAYMENT_SUCCESS
+    assert res.op_results[1].value.value.arm == \
+        PaymentResultCode.PAYMENT_UNDERFUNDED
+    assert balance_of(root, b) == 1000 * XLM
+
+
+def test_create_account(env):
+    root, a, _ = env
+    fresh = keypair("fresh")
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[create_account_op(fresh, 100 * XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txSUCCESS
+    assert balance_of(root, fresh) == 100 * XLM
+    # created at ledger 2 -> starting seq = 2 << 32
+    assert seq(root, fresh) == 2 << 32
+
+
+def test_create_account_already_exists(env):
+    root, a, b = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[create_account_op(b, 100 * XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txFAILED
+    assert res.op_results[0].value.value.arm == \
+        CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
+
+
+def test_create_account_low_reserve(env):
+    root, a, _ = env
+    fresh = keypair("fresh2")
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[create_account_op(fresh, 1)])  # below 2*baseReserve
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.op_results[0].value.value.arm == \
+        CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE
+
+
+def test_op_source_account(env):
+    """Op with explicit source != tx source needs that account's sig."""
+    root, a, b = env
+    # b is op source but only a signed -> opBAD_AUTH -> txFAILED
+    tx = make_tx(a, seq_num=(1 << 32) + 1,
+                 ops=[payment_op(a, XLM, source=b)])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TxCode.txFAILED
+    assert res.op_results[0].arm == OperationResultCode.opBAD_AUTH
+
+    # signed by both -> valid
+    tx2 = make_tx(a, seq_num=(1 << 32) + 1,
+                  ops=[payment_op(a, XLM, source=b)], extra_signers=[b])
+    with LedgerTxn(root) as ltx:
+        res2 = tx2.check_valid(ltx)
+    assert res2.code == TxCode.txSUCCESS
+
+
+def test_self_payment_instant_success(env):
+    root, a, _ = env
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(a, XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txSUCCESS
+    assert balance_of(root, a) == 1000 * XLM - 100
+
+
+def test_payment_no_destination(env):
+    root, a, _ = env
+    ghost = keypair("ghost")
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(ghost, XLM)])
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.op_results[0].value.value.arm == \
+        PaymentResultCode.PAYMENT_NO_DESTINATION
+
+
+def test_preauth_tx_signer(env):
+    """Pre-auth-tx signer authorizes without a signature and is removed
+    after apply (one-time signer semantics)."""
+    root, a, b = env
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.xdr.types import (
+        Signer, SignerKey, SignerKeyType, account_id,
+    )
+    # build the tx first (unsigned by a's key) to learn its hash
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)])
+    h = tx.contents_hash()
+    env_unsigned = __import__(
+        "stellar_tpu.xdr.tx", fromlist=["TransactionEnvelope"])
+    tx.signatures.clear()
+
+    # attach a pre-auth signer for this hash with weight >= med threshold
+    with LedgerTxn(root) as ltx:
+        with ltx.load(account_key(account_id(a.public_key.raw))) as hdl:
+            hdl.data.signers = [Signer(
+                key=SignerKey.make(
+                    SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX, h),
+                weight=255)]
+            hdl.data.numSubEntries += 1
+            # master weight 0 so only the preauth signer can authorize
+            hdl.data.thresholds = b"\x00\x00\x00\x00"
+        ltx.commit()
+
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+        assert res.code == TxCode.txSUCCESS
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txSUCCESS
+    # one-time signer consumed
+    from stellar_tpu.xdr.types import account_id as aid
+    e = root.store.get(key_bytes(account_key(aid(a.public_key.raw))))
+    assert e.data.value.signers == []
+
+
+def test_multisig_med_threshold(env):
+    """Payment needs MED threshold; master alone below MED fails."""
+    root, a, b = env
+    cosigner = keypair("cosigner")
+    from stellar_tpu.xdr.types import (
+        Signer, SignerKey, SignerKeyType, account_id,
+    )
+    with LedgerTxn(root) as ltx:
+        with ltx.load(account_key(account_id(a.public_key.raw))) as hdl:
+            # master weight 1; thresholds low=1 med=2 high=3
+            hdl.data.thresholds = b"\x01\x01\x02\x03"
+            hdl.data.signers = [Signer(
+                key=SignerKey.make(
+                    SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                    cosigner.public_key.raw),
+                weight=1)]
+            hdl.data.numSubEntries += 1
+        ltx.commit()
+
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TxCode.txFAILED  # low passes, op med fails
+    assert res.op_results[0].arm == OperationResultCode.opBAD_AUTH
+
+    tx2 = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                  extra_signers=[cosigner])
+    with LedgerTxn(root) as ltx:
+        res2 = tx2.check_valid(ltx)
+    assert res2.code == TxCode.txSUCCESS
+
+
+def make_feebump(fee_source, outer_fee, inner_frame):
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
+    from stellar_tpu.xdr.tx import (
+        FeeBumpTransaction, FeeBumpTransactionEnvelope, TransactionEnvelope,
+        TransactionV1Envelope, _FeeBumpInner, feebump_sig_payload,
+        muxed_account,
+    )
+    from stellar_tpu.xdr.types import EnvelopeType
+    fb = FeeBumpTransaction(
+        feeSource=muxed_account(fee_source.public_key.raw),
+        fee=outer_fee,
+        innerTx=_FeeBumpInner.make(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=inner_frame.tx,
+                                  signatures=inner_frame.signatures)),
+        ext=FeeBumpTransaction._types[3].make(0))
+    h = sha256(feebump_sig_payload(TEST_NETWORK_ID, fb))
+    env = TransactionEnvelope.make(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(tx=fb,
+                                   signatures=[fee_source.sign_decorated(h)]))
+    return FeeBumpTransactionFrame(TEST_NETWORK_ID, env)
+
+
+def test_feebump_inner_zero_fee_applies(env):
+    """Canonical fee bump: inner tx bids fee 0, outer pays everything."""
+    root, a, b = env
+    sponsor = keypair("sponsor")
+    from stellar_tpu.tx.tx_test_utils import seed_root_with_accounts
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM), (sponsor, 1000 * XLM)])
+    inner = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                    fee=0)
+    fb = make_feebump(sponsor, outer_fee=400, inner_frame=inner)
+    with LedgerTxn(root) as ltx:
+        res = fb.check_valid(ltx)
+        assert res.code == TxCode.txFEE_BUMP_INNER_SUCCESS
+        fb.process_fee_seq_num(ltx, base_fee=100)
+        res = fb.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txFEE_BUMP_INNER_SUCCESS
+    assert res.inner_result.code == TxCode.txSUCCESS
+    assert balance_of(root, sponsor) == 1000 * XLM - 200  # (1 op + 1) * 100
+    assert balance_of(root, a) == 1000 * XLM - XLM        # no fee charged
+    assert balance_of(root, b) == 1001 * XLM
+    # result XDR encodes
+    raw = to_bytes(TransactionResult, fb.to_result_xdr(res))
+    assert raw
+
+
+def test_feebump_rate_too_low_rejected(env):
+    """Outer rate must beat inner rate: fee 400 vs inner fee 300/1op."""
+    root, a, b = env
+    sponsor = keypair("sponsor")
+    from stellar_tpu.tx.tx_test_utils import seed_root_with_accounts
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM), (sponsor, 1000 * XLM)])
+    inner = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                    fee=300)
+    fb = make_feebump(sponsor, outer_fee=400, inner_frame=inner)
+    with LedgerTxn(root) as ltx:
+        assert fb.check_valid(ltx).code == TxCode.txINSUFFICIENT_FEE
+
+
+def test_manage_data_invalid_name(env):
+    root, a, _ = env
+    from stellar_tpu.xdr.tx import (
+        ManageDataOp, Operation, OperationBody, OperationType,
+    )
+    op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.MANAGE_DATA,
+        ManageDataOp(dataName=b"ab\x01", dataValue=b"v")))
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[op])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TxCode.txFAILED
+    from stellar_tpu.xdr.results import ManageDataResultCode
+    assert res.op_results[0].value.value.arm == \
+        ManageDataResultCode.MANAGE_DATA_INVALID_NAME
+
+
+def test_manage_data_create_update_delete(env):
+    root, a, _ = env
+    from stellar_tpu.xdr.tx import (
+        ManageDataOp, Operation, OperationBody, OperationType,
+    )
+
+    def md(name, value, seq):
+        op = Operation(sourceAccount=None, body=OperationBody.make(
+            OperationType.MANAGE_DATA,
+            ManageDataOp(dataName=name, dataValue=value)))
+        return make_tx(a, seq_num=seq, ops=[op])
+
+    base = 1 << 32
+    for i, (name, value) in enumerate(
+            [(b"k1", b"v1"), (b"k1", b"v2"), (b"k1", None)]):
+        tx = md(name, value, base + 1 + i)
+        with LedgerTxn(root) as ltx:
+            tx.process_fee_seq_num(ltx, base_fee=100)
+            res = tx.apply(ltx)
+            ltx.commit()
+        assert res.code == TxCode.txSUCCESS, (i, res.code)
+    # after create+update+delete the entry is gone and subentries back to 0
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.xdr.types import account_id
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    assert e.data.value.numSubEntries == 0
+
+
+def test_soroban_ext_with_classic_ops_malformed(env):
+    root, a, b = env
+    from stellar_tpu.xdr.tx import (
+        LedgerFootprint, SorobanResources, SorobanTransactionData,
+        Transaction,
+    )
+    tx = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)])
+    tx.tx.ext = Transaction._types[6].make(1, SorobanTransactionData(
+        ext=__import__("stellar_tpu.xdr.types",
+                       fromlist=["ExtensionPoint"]).ExtensionPoint.make(0),
+        resources=SorobanResources(
+            footprint=LedgerFootprint(readOnly=[], readWrite=[]),
+            instructions=0, readBytes=0, writeBytes=0),
+        resourceFee=0))
+    tx._hash = None
+    tx.signatures.clear()
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.tx import transaction_sig_payload
+    tx.signatures.append(a.sign_decorated(
+        sha256(transaction_sig_payload(TEST_NETWORK_ID, tx.tx))))
+    with LedgerTxn(root) as ltx:
+        assert tx.check_valid(ltx).code == TxCode.txMALFORMED
