@@ -31,6 +31,8 @@ __all__ = [
 ]
 
 VERIFY_CACHE_SIZE = 0xFFFF
+# below this, batch_verify_into_cache uses the host oracle directly
+MIN_DEVICE_BATCH = 32
 
 _cache_lock = threading.Lock()
 _verify_cache: RandomEvictionCache = RandomEvictionCache(VERIFY_CACHE_SIZE)
@@ -168,6 +170,46 @@ def verify_sig(pk, msg: bytes, sig: bytes) -> bool:
     with _cache_lock:
         _verify_cache.put(key, ok)
     return ok
+
+
+def batch_verify_into_cache(items) -> None:
+    """Verify (pk, msg, sig) triples in one device batch and seed the
+    result cache, so subsequent ``verify_sig`` calls for the same
+    triples are O(1) lookups. This is how bulk validation paths (txset
+    checkValid, SCP envelope floods, catchup replay) ride the TPU: they
+    prefetch, then the per-signer logic runs unchanged
+    (reference boundary: ``PubKeyUtils::verifySig`` cache,
+    ``SecretKey.cpp:318-338``)."""
+    # hash outside the lock; keep the key alongside the triple
+    keyed = [(_cache_key(pk, msg, sig), pk, msg, sig)
+             for pk, msg, sig in items
+             if len(pk) == 32 and len(sig) == 64]
+    with _cache_lock:
+        todo = [(k, pk, msg, sig) for k, pk, msg, sig in keyed
+                if _verify_cache.maybe_get(k) is None]
+    if not todo:
+        return
+    if len(todo) < MIN_DEVICE_BATCH:
+        # tiny batches aren't worth a device round trip; use exactly
+        # what verify_sig would (installed backend or host oracle) so
+        # both paths cache consistent answers
+        fn = _backend or _ref.verify
+        results = [fn(pk, msg, sig) for _, pk, msg, sig in todo]
+    elif _backend is not None:
+        if hasattr(_backend, "__self__") and \
+                hasattr(_backend.__self__, "verify_batch"):
+            results = _backend.__self__.verify_batch(
+                [(pk, msg, sig) for _, pk, msg, sig in todo])
+        else:
+            # custom scalar backend: stay consistent with verify_sig
+            results = [_backend(pk, msg, sig) for _, pk, msg, sig in todo]
+    else:
+        from stellar_tpu.crypto.batch_verifier import default_verifier
+        results = default_verifier().verify_batch(
+            [(pk, msg, sig) for _, pk, msg, sig in todo])
+    with _cache_lock:
+        for (k, _, _, _), ok in zip(todo, results):
+            _verify_cache.put(k, bool(ok))
 
 
 def flush_verify_cache():

@@ -1,0 +1,357 @@
+"""TxSetFrame: transaction-set construction, hashing, validation, and
+apply ordering (reference ``src/herder/TxSetFrame.cpp``).
+
+Pipeline (mirrors the reference's three-stage design):
+
+* ``make_tx_set_from_transactions`` — nominate-time construction: group
+  per source account in sequence order, surge-price down to the ledger's
+  operation capacity, compute the discounted base fee, emit the
+  GeneralizedTransactionSet XDR whose SHA-256 is the set's identity.
+* ``TxSetXDRFrame`` — wire form + hash, convertible to an
+  ``ApplicableTxSetFrame`` against the current ledger
+  (``prepareForApply``).
+* ``ApplicableTxSetFrame.check_valid`` — structural checks + per-tx
+  ``checkValid``; all ed25519 signatures in the set are first verified
+  in ONE TPU batch (``batch_verify_into_cache``), so the per-signer
+  logic afterwards only hits the verify cache. This is sig hot path #3
+  (``TxSetFrame.cpp:1633``) riding the device.
+* ``get_txs_in_apply_order`` — per-account batches shuffled by
+  hash XOR setHash (reference ``ApplyTxSorter`` /
+  ``sortedForApplySequential``) so apply order is unpredictable but
+  deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from stellar_tpu.crypto.keys import batch_verify_into_cache
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.xdr.ledger import (
+    GeneralizedTransactionSet, TransactionPhase, TransactionSetV1,
+    TxSetComponent, TxSetComponentType, TxSetComponentTxsMaybeDiscountedFee,
+    generalized_tx_set_hash,
+)
+from stellar_tpu.xdr.runtime import to_bytes
+from stellar_tpu.xdr.tx import TransactionEnvelope
+from stellar_tpu.xdr.types import SignerKeyType
+
+__all__ = [
+    "full_tx_hash", "fee_rate_less_than", "compute_per_op_fee",
+    "make_tx_set_from_transactions", "TxSetXDRFrame",
+    "ApplicableTxSetFrame", "prefetch_signature_batch",
+]
+
+
+def full_tx_hash(frame) -> bytes:
+    """Hash of the whole envelope incl. signatures (reference
+    ``getFullHash``) — distinct from the contents hash."""
+    return sha256(to_bytes(TransactionEnvelope, frame.envelope))
+
+
+def fee_rate_less_than(a, b) -> bool:
+    """a bids a strictly lower fee-per-op than b (reference
+    ``feeRate3WayCompare``: cross-multiplied, overflow-free)."""
+    return a.inclusion_fee() * b.num_operations() < \
+        b.inclusion_fee() * a.num_operations()
+
+
+def compute_per_op_fee(frame) -> int:
+    """Inclusion fee per operation, rounded down (current protocol;
+    reference ``computePerOpFee``)."""
+    return frame.inclusion_fee() // max(1, frame.num_operations())
+
+
+def _xored(h: bytes, set_hash: bytes) -> bytes:
+    return bytes(a ^ b for a, b in zip(h, set_hash))
+
+
+def _build_account_queues(frames) -> Dict[bytes, List]:
+    """Per-source-account tx lists in ascending seq order (reference
+    ``TxSetUtils::buildAccountTxQueues``)."""
+    queues: Dict[bytes, List] = {}
+    for f in frames:
+        aid = f.source_account_id().value
+        queues.setdefault(aid, []).append(f)
+    for q in queues.values():
+        q.sort(key=lambda f: f.seq_num)
+    return queues
+
+
+def make_tx_set_from_transactions(
+        frames: Sequence, lcl_header, lcl_hash: bytes
+) -> Tuple["ApplicableTxSetFrame", List]:
+    """Build a valid (surge-priced) tx set from candidate frames.
+
+    Returns (applicable_frame, excluded_frames). Capacity is
+    ``lcl_header.maxTxSetSize`` counted in operations (protocol >= 11
+    semantics). When candidates exceed capacity, lowest-fee-rate
+    accounts' tails are trimmed first and the set's discounted base fee
+    becomes the lowest included per-op fee (reference
+    ``makeTxSetFromTransactions`` + ``SurgePricingPriorityQueue``).
+    """
+    queues = _build_account_queues(frames)
+    # candidate "account chains": we take or trim whole tails so the
+    # per-account sequence stays gapless
+    included: List = []
+    excluded: List = []
+    capacity = lcl_header.maxTxSetSize
+
+    # greedy: repeatedly take the highest-fee-rate head among accounts
+    heads = [(q[0], aid) for aid, q in queues.items()]
+    total_ops = 0
+    surge = False
+    while heads:
+        # pick max fee rate head (ties by contents hash for determinism)
+        best_i = 0
+        for i in range(1, len(heads)):
+            a, b = heads[i][0], heads[best_i][0]
+            if fee_rate_less_than(b, a) or (
+                    not fee_rate_less_than(a, b)
+                    and a.contents_hash() < b.contents_hash()):
+                best_i = i
+        frame, aid = heads.pop(best_i)
+        q = queues[aid]
+        ops = max(1, frame.num_operations())
+        if total_ops + ops > capacity:
+            # trim this whole account tail (seq gap otherwise)
+            surge = True
+            excluded.extend(q)
+            queues[aid] = []
+            continue
+        total_ops += ops
+        included.append(frame)
+        q.pop(0)
+        if q:
+            heads.append((q[0], aid))
+
+    base_fee = lcl_header.baseFee
+    if surge and included:
+        base_fee = min(compute_per_op_fee(f) for f in included)
+
+    xdr_set = _to_generalized_xdr(included, lcl_hash, base_fee,
+                                  discounted=surge)
+    applicable = ApplicableTxSetFrame(
+        xdr_set, included, {id(f): base_fee if surge else None
+                            for f in included})
+    return applicable, excluded
+
+
+def _sorted_in_hash_order(frames) -> List:
+    # canonical wire order is by FULL envelope hash (reference
+    # ``TxSetUtils::sortTxsInHashOrder`` uses getFullHash)
+    return sorted(frames, key=full_tx_hash)
+
+
+def _to_generalized_xdr(frames, lcl_hash: bytes, base_fee: int,
+                        discounted: bool):
+    comp = TxSetComponent.make(
+        TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
+        TxSetComponentTxsMaybeDiscountedFee(
+            baseFee=base_fee if discounted else None,
+            txs=[f.envelope for f in _sorted_in_hash_order(frames)]))
+    phase = TransactionPhase.make(0, [comp] if frames else [])
+    return GeneralizedTransactionSet.make(
+        1, TransactionSetV1(previousLedgerHash=lcl_hash, phases=[phase]))
+
+
+class TxSetXDRFrame:
+    """Wire-form tx set: XDR + content hash; parse-on-demand
+    (reference ``TxSetXDRFrame``)."""
+
+    def __init__(self, xdr_set):
+        self.xdr = xdr_set
+        self.hash = generalized_tx_set_hash(xdr_set)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "TxSetXDRFrame":
+        from stellar_tpu.xdr.runtime import from_bytes
+        return cls(from_bytes(GeneralizedTransactionSet, raw))
+
+    def prepare_for_apply(self, network_id: bytes
+                          ) -> Optional["ApplicableTxSetFrame"]:
+        """Parse envelopes into frames (reference ``prepareForApply``);
+        None on malformed contents."""
+        from stellar_tpu.tx.transaction_frame import make_transaction_frame
+        try:
+            frames = []
+            discounts = {}
+            v1 = self.xdr.value
+            for phase in v1.phases:
+                if phase.arm != 0:
+                    return None  # parallel soroban phase: later milestone
+                for comp in phase.value:
+                    for env in comp.value.txs:
+                        f = make_transaction_frame(network_id, env)
+                        frames.append(f)
+                        discounts[id(f)] = comp.value.baseFee
+            return ApplicableTxSetFrame(self.xdr, frames, discounts,
+                                        precomputed_hash=self.hash)
+        except Exception:
+            return None
+
+
+class ApplicableTxSetFrame:
+    """A parsed tx set pinned to the ledger it applies to (reference
+    ``ApplicableTxSetFrame``)."""
+
+    def __init__(self, xdr_set, frames: Sequence, discounts: Dict,
+                 precomputed_hash: Optional[bytes] = None):
+        self.xdr = xdr_set
+        self.frames = list(frames)
+        self._discounts = discounts  # id(frame) -> Optional[baseFee]
+        self.hash = precomputed_hash if precomputed_hash is not None \
+            else generalized_tx_set_hash(xdr_set)
+
+    @property
+    def previous_ledger_hash(self) -> bytes:
+        return self.xdr.value.previousLedgerHash
+
+    def base_fee_for(self, frame) -> Optional[int]:
+        """The discounted base fee this tx applies under (None = bid)."""
+        return self._discounts.get(id(frame))
+
+    def size_op(self) -> int:
+        return sum(max(1, f.num_operations()) for f in self.frames)
+
+    def size_tx(self) -> int:
+        return len(self.frames)
+
+    # ---------------- validation ----------------
+
+    def check_valid(self, ltx, lcl_hash: bytes,
+                    lower_offset: int = 0, upper_offset: int = 0) -> bool:
+        """Full set validation against the current ledger (reference
+        ``ApplicableTxSetFrame::checkValid``)."""
+        if self.previous_ledger_hash != lcl_hash:
+            return False
+        header = ltx.header()
+        if self.size_op() > header.maxTxSetSize:
+            return False
+        # discounted base fee must not be below the protocol minimum
+        for phase in self.xdr.value.phases:
+            for comp in phase.value:
+                bf = comp.value.baseFee
+                if bf is not None and bf < header.baseFee:
+                    return False
+                # wire order must be canonical (hash-sorted) so the set
+                # hash is unique for its contents
+                hashes = [sha256(to_bytes(TransactionEnvelope, e))
+                          for e in comp.value.txs]
+                if hashes != sorted(hashes):
+                    return False
+        if not self._sequences_are_gapless(ltx):
+            return False
+        prefetch_signature_batch(ltx, self.frames)
+        from stellar_tpu.xdr.results import TransactionResultCode as TC
+        # per-account chains: each tx validates against its predecessor's
+        # seq num (reference ``TxSetUtils::getInvalidTxList``)
+        for q in _build_account_queues(self.frames).values():
+            current = 0
+            for f in q:
+                res = f.check_valid(ltx, current, lower_offset,
+                                    upper_offset)
+                if res.code not in (TC.txSUCCESS,
+                                    TC.txFEE_BUMP_INNER_SUCCESS):
+                    return False
+                current = f.seq_num
+        return True
+
+    def _sequences_are_gapless(self, ltx) -> bool:
+        for aid, q in _build_account_queues(self.frames).items():
+            from stellar_tpu.xdr.types import account_id
+            entry = ltx.load_without_record(account_key(account_id(aid)))
+            if entry is None:
+                return False
+            cur = entry.data.value.seqNum
+            for f in q:
+                if f.seq_num != cur + 1:
+                    return False
+                cur = f.seq_num
+        return True
+
+    # ---------------- apply order ----------------
+
+    def get_txs_in_apply_order(self) -> List:
+        """Reference ``sortedForApplySequential``: round-robin account
+        batches, each shuffled by full-hash XOR set-hash."""
+        queues = list(_build_account_queues(self.frames).values())
+        batches: List[List] = []
+        while queues:
+            batch = []
+            nxt = []
+            for q in queues:
+                batch.append(q.pop(0))
+                if q:
+                    nxt.append(q)
+            queues = nxt
+            batches.append(batch)
+        out: List = []
+        for batch in batches:
+            batch.sort(key=lambda f: _xored(full_tx_hash(f), self.hash))
+            out.extend(batch)
+        return out
+
+    def summary(self) -> str:
+        return (f"txset(txs={self.size_tx()}, ops={self.size_op()}, "
+                f"hash={self.hash.hex()[:8]})")
+
+
+def prefetch_signature_batch(ltx, frames) -> int:
+    """Collect every plausible (pubkey, payload, signature) triple in the
+    set and verify them in one device batch, seeding the verify cache.
+
+    Candidates per tx: master key + account signers of the tx source,
+    every op source, the fee source (fee bumps), and extraSigners —
+    filtered by the 4-byte hint before batching. Returns the number of
+    triples shipped to the device.
+    """
+    items = []
+    for f in frames:
+        inner_frames = [f]
+        if hasattr(f, "inner"):  # fee bump: outer + inner
+            for sig in f.signatures:
+                _collect_for_account(
+                    ltx, f.fee_source_id(), f.contents_hash(), sig, items)
+            inner_frames = [f.inner]
+        for tf in inner_frames:
+            h = tf.contents_hash()
+            account_ids = [tf.source_account_id()]
+            for op in tf.op_frames:
+                aid = op.source_account_id()
+                if aid not in account_ids:
+                    account_ids.append(aid)
+            for sig in tf.signatures:
+                for aid in account_ids:
+                    _collect_for_account(ltx, aid, h, sig, items)
+                for sk in tf.extra_signers():
+                    _collect_for_signer_key(sk, h, sig, items)
+    batch_verify_into_cache(items)
+    return len(items)
+
+
+def _collect_for_account(ltx, account_id_v, h: bytes, sig, items):
+    from stellar_tpu.tx.signature_utils import does_hint_match
+    entry = ltx.load_without_record(account_key(account_id_v))
+    if entry is None:
+        return
+    acc = entry.data.value
+    pk = acc.accountID.value
+    if does_hint_match(pk, sig.hint):
+        items.append((pk, h, sig.signature))
+    for s in acc.signers:
+        _collect_for_signer_key(s.key, h, sig, items)
+
+
+def _collect_for_signer_key(key, h: bytes, sig, items):
+    from stellar_tpu.tx.signature_utils import (
+        does_hint_match, signed_payload_hint,
+    )
+    if key.arm == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+        if does_hint_match(key.value, sig.hint):
+            items.append((key.value, h, sig.signature))
+    elif key.arm == SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD:
+        if sig.hint == signed_payload_hint(key.value):
+            items.append((key.value.ed25519, key.value.payload,
+                          sig.signature))

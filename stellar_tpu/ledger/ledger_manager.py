@@ -1,0 +1,208 @@
+"""LedgerManager: the ledger-close pipeline (reference
+``src/ledger/LedgerManagerImpl.cpp`` — ``closeLedger`` is the 7-step
+dance at ``:804-1122``).
+
+``close_ledger`` takes externalized close data (tx set + close time +
+upgrades), applies it to the last closed ledger, and advances the chain:
+
+1. sanity: seq is LCL+1, tx set binds to the LCL hash;
+2. header roll-forward (seq, scpValue, previousLedgerHash);
+3. fee + seq-num phase for every tx in apply order
+   (``processFeesSeqNums``);
+4. per-tx apply (``applyTransactions``) collecting results + meta;
+5. txSetResultHash = SHA-256 of the TransactionResultSet XDR;
+6. upgrades (protocol version / base fee / max set size / base reserve);
+7. state hash + skip list, commit, LCL advance.
+
+The state hash is computed by the pluggable ``state_hasher`` — a direct
+SHA-256 over the sorted committed store until the BucketList lands, then
+the 11-level bucket list hash (same header field either way).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.ledger.ledger_txn import (
+    LedgerTxn, LedgerTxnRoot, copy_header,
+)
+from stellar_tpu.xdr.ledger import (
+    LedgerHeader, LedgerUpgrade, LedgerUpgradeType, StellarValue,
+    basic_stellar_value, ledger_header_hash,
+)
+from stellar_tpu.xdr.results import (
+    TransactionResultPair, TransactionResultSet,
+)
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+
+__all__ = ["LedgerCloseData", "CloseLedgerResult", "LedgerManager",
+           "hash_store_state"]
+
+# reference BucketManager.h skip cadence
+SKIP_1, SKIP_2, SKIP_3, SKIP_4 = 50, 5000, 50000, 500000
+
+
+@dataclass
+class LedgerCloseData:
+    """What consensus externalizes for one slot (reference
+    ``LedgerCloseData``)."""
+    ledger_seq: int
+    tx_set: "ApplicableTxSetFrame"
+    close_time: int
+    upgrades: Sequence = ()
+
+
+@dataclass
+class CloseLedgerResult:
+    header: LedgerHeader
+    header_hash: bytes
+    tx_results: List = field(default_factory=list)
+    tx_metas: List = field(default_factory=list)
+    applied_count: int = 0
+    failed_count: int = 0
+
+
+def hash_store_state(store) -> bytes:
+    """Deterministic hash of the committed store: SHA-256 over sorted
+    (key, entry) pairs. Stand-in with the same determinism contract as
+    the bucket list hash (``bucket/readme.md:23-26``)."""
+    import hashlib
+    h = hashlib.sha256()
+    for kb in sorted(store.entries):
+        h.update(kb)
+        h.update(store.entries[kb])
+    return h.digest()
+
+
+class LedgerManager:
+    """Owns the LCL and the close pipeline for one node."""
+
+    def __init__(self, network_id: bytes,
+                 root: Optional[LedgerTxnRoot] = None,
+                 state_hasher: Optional[Callable] = None):
+        self.network_id = network_id
+        self.root = root if root is not None else LedgerTxnRoot()
+        self.state_hasher = state_hasher or hash_store_state
+        self._lcl_hash = ledger_header_hash(self.root.header())
+        self.close_meta_stream: List = []  # downstream consumers hook
+
+    # ---------------- LCL accessors ----------------
+
+    @property
+    def last_closed_header(self) -> LedgerHeader:
+        return self.root.header()
+
+    @property
+    def last_closed_hash(self) -> bytes:
+        return self._lcl_hash
+
+    @property
+    def ledger_seq(self) -> int:
+        return self.last_closed_header.ledgerSeq
+
+    # ---------------- the close pipeline ----------------
+
+    def close_ledger(self, lcd: LedgerCloseData) -> CloseLedgerResult:
+        lcl = self.last_closed_header
+        if lcd.ledger_seq != lcl.ledgerSeq + 1:
+            raise ValueError(
+                f"close out of order: got {lcd.ledger_seq}, "
+                f"LCL is {lcl.ledgerSeq}")
+        if lcd.tx_set.previous_ledger_hash != self._lcl_hash:
+            raise ValueError("tx set does not bind to LCL")
+
+        ltx = LedgerTxn(self.root)
+        with ltx.load_header() as hh:
+            header = hh.header
+            header.ledgerSeq = lcd.ledger_seq
+            header.previousLedgerHash = self._lcl_hash
+            header.scpValue = basic_stellar_value(
+                lcd.tx_set.hash, lcd.close_time,
+                upgrades=list(lcd.upgrades))
+
+        result = CloseLedgerResult(header=None, header_hash=b"")
+        apply_order = lcd.tx_set.get_txs_in_apply_order()
+
+        # fee phase first for ALL txs, then apply (reference
+        # processFeesSeqNums before applyTransactions)
+        fee_results = {}
+        for f in apply_order:
+            base_fee = lcd.tx_set.base_fee_for(f)
+            fee_results[id(f)] = f.process_fee_seq_num(ltx, base_fee)
+
+        result_pairs = []
+        for f in apply_order:
+            from stellar_tpu.tx.transaction_frame import TxApplyMeta
+            meta = TxApplyMeta()
+            res = f.apply(ltx, meta)
+            res.fee_charged = fee_results[id(f)].fee_charged
+            xdr_res = f.to_result_xdr(res) if hasattr(f, "to_result_xdr") \
+                else res.to_xdr()
+            result_pairs.append(TransactionResultPair(
+                transactionHash=f.contents_hash(), result=xdr_res))
+            result.tx_results.append(res)
+            result.tx_metas.append(meta)
+            if res.is_success or res.code == 1:  # txFEE_BUMP_INNER_SUCCESS
+                result.applied_count += 1
+            else:
+                result.failed_count += 1
+
+        rset = TransactionResultSet(results=result_pairs)
+        tx_set_result_hash = sha256(to_bytes(TransactionResultSet, rset))
+
+        with ltx.load_header() as hh:
+            hh.header.txSetResultHash = tx_set_result_hash
+
+        for raw in lcd.upgrades:
+            self._apply_upgrade(ltx, raw)
+
+        # stamp state hash + skip list on a post-commit header view
+        ltx.commit()
+        header = copy_header(self.root.header())
+        header.bucketListHash = self.state_hasher(self.root.store)
+        self._calculate_skip_values(header)
+        self.root.set_header(header)
+        self._lcl_hash = ledger_header_hash(header)
+
+        result.header = header
+        result.header_hash = self._lcl_hash
+        return result
+
+    # ---------------- upgrades ----------------
+
+    def _apply_upgrade(self, ltx, raw_upgrade):
+        """Apply one LedgerUpgrade (reference ``Upgrades::applyTo``)."""
+        up = raw_upgrade if not isinstance(raw_upgrade, (bytes, bytearray)) \
+            else from_bytes(LedgerUpgrade, bytes(raw_upgrade))
+        with ltx.load_header() as hh:
+            h = hh.header
+            t = up.arm
+            if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+                h.ledgerVersion = up.value
+            elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+                h.baseFee = up.value
+            elif t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+                h.maxTxSetSize = up.value
+            elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+                h.baseReserve = up.value
+            else:
+                raise NotImplementedError(
+                    f"upgrade type {t} not supported yet")
+
+    @staticmethod
+    def _calculate_skip_values(header: LedgerHeader):
+        """Reference ``BucketManager::calculateSkipValues``."""
+        if header.ledgerSeq % SKIP_1 != 0:
+            return
+        v = header.ledgerSeq - SKIP_1
+        if v > 0 and v % SKIP_2 == 0:
+            v = header.ledgerSeq - SKIP_2 - SKIP_1
+            if v > 0 and v % SKIP_3 == 0:
+                v = header.ledgerSeq - SKIP_3 - SKIP_2 - SKIP_1
+                if v > 0 and v % SKIP_4 == 0:
+                    header.skipList[3] = header.skipList[2]
+                header.skipList[2] = header.skipList[1]
+            header.skipList[1] = header.skipList[0]
+        header.skipList[0] = header.bucketListHash

@@ -571,6 +571,7 @@ def _remove_signer_with_possible_sponsorship(ltx, acc, idx: int):
         sponsor_id = v2.signerSponsoringIDs[idx]
         del v2.signerSponsoringIDs[idx]
     del acc.signers[idx]
+    acc.numSubEntries -= 1
     if sponsor_id is not None:
         v2.numSponsored -= 1
         sp = ltx.load(account_key(sponsor_id))
@@ -579,8 +580,6 @@ def _remove_signer_with_possible_sponsorship(ltx, acc, idx: int):
             if sp_v2 is not None:
                 sp_v2.numSponsoring -= 1
             sp.deactivate()
-    else:
-        acc.numSubEntries -= 1
 
 
 def _v0_to_v1(tx_v0) -> Transaction:
@@ -719,7 +718,27 @@ class FeeBumpTransactionFrame:
     def apply(self, ltx, meta: Optional[TxApplyMeta] = None
               ) -> MutableTxResult:
         """Outer wraps the inner apply result (fee already charged in the
-        fee phase; inner applies with charge_fee=False)."""
+        fee phase; inner applies with charge_fee=False). A one-time
+        pre-auth signer on the fee source is consumed first (reference
+        ``FeeBumpTransactionFrame::apply`` →
+        ``removeOneTimeSignerKeyFromFeeSource``)."""
+        if meta is None:
+            meta = TxApplyMeta()
+        fee_txn = LedgerTxn(ltx)
+        h = self.contents_hash()
+        handle = fee_txn.load(account_key(self.fee_source_id()))
+        if handle is not None:
+            acc = handle.data
+            doomed = [i for i, s in enumerate(acc.signers)
+                      if s.key.arm ==
+                      SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
+                      and s.key.value == h]
+            for i in reversed(doomed):
+                _remove_signer_with_possible_sponsorship(fee_txn, acc, i)
+            handle.deactivate()
+        meta.tx_changes_before.extend(fee_txn.get_changes())
+        fee_txn.commit()
+
         inner_res = self.inner.apply(ltx, meta, charge_fee=False)
         result = MutableTxResult(fee_charged=0)
         result.set_code(TxCode.txFEE_BUMP_INNER_SUCCESS

@@ -472,3 +472,42 @@ def test_soroban_ext_with_classic_ops_malformed(env):
         sha256(transaction_sig_payload(TEST_NETWORK_ID, tx.tx))))
     with LedgerTxn(root) as ltx:
         assert tx.check_valid(ltx).code == TxCode.txMALFORMED
+
+
+def test_feebump_preauth_fee_source_signer_consumed(env):
+    """A PRE_AUTH_TX signer on the fee source authorizing the outer
+    envelope is consumed at apply (reference
+    removeOneTimeSignerKeyFromFeeSource)."""
+    root, a, b = env
+    sponsor = keypair("sponsor2")
+    from stellar_tpu.tx.tx_test_utils import seed_root_with_accounts
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM), (sponsor, 1000 * XLM)])
+    inner = make_tx(a, seq_num=(1 << 32) + 1, ops=[payment_op(b, XLM)],
+                    fee=0)
+    fb = make_feebump(sponsor, outer_fee=400, inner_frame=inner)
+    h = fb.contents_hash()
+    fb.signatures.clear()  # authorize via pre-auth signer only
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.xdr.types import (
+        Signer, SignerKey, SignerKeyType, account_id,
+    )
+    with LedgerTxn(root) as ltx:
+        with ltx.load(account_key(
+                account_id(sponsor.public_key.raw))) as hdl:
+            hdl.data.signers = [Signer(
+                key=SignerKey.make(
+                    SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX, h),
+                weight=255)]
+            hdl.data.numSubEntries = 1
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        assert fb.check_valid(ltx).code == TxCode.txFEE_BUMP_INNER_SUCCESS
+        fb.process_fee_seq_num(ltx, base_fee=100)
+        res = fb.apply(ltx)
+        ltx.commit()
+    assert res.code == TxCode.txFEE_BUMP_INNER_SUCCESS
+    e = root.store.get(key_bytes(account_key(
+        account_id(sponsor.public_key.raw))))
+    assert e.data.value.signers == []
+    assert e.data.value.numSubEntries == 0
