@@ -46,8 +46,13 @@ __all__ = [
 
 def full_tx_hash(frame) -> bytes:
     """Hash of the whole envelope incl. signatures (reference
-    ``getFullHash``) — distinct from the contents hash."""
-    return sha256(to_bytes(TransactionEnvelope, frame.envelope))
+    ``getFullHash``) — distinct from the contents hash. Memoized on the
+    frame (hot: sorting, apply ordering, canonical-order checks)."""
+    h = getattr(frame, "_full_hash", None)
+    if h is None:
+        h = sha256(to_bytes(TransactionEnvelope, frame.envelope))
+        frame._full_hash = h
+    return h
 
 
 def fee_rate_less_than(a, b) -> bool:
@@ -126,15 +131,16 @@ def make_tx_set_from_transactions(
         if q:
             heads.append((q[0], aid))
 
+    # the component base fee is always present: header.baseFee when the
+    # ledger isn't congested, the lowest included per-op bid under surge
+    # pricing (reference ``computeLaneBaseFee``, TxSetFrame.cpp:610-631)
     base_fee = lcl_header.baseFee
     if surge and included:
         base_fee = min(compute_per_op_fee(f) for f in included)
 
-    xdr_set = _to_generalized_xdr(included, lcl_hash, base_fee,
-                                  discounted=surge)
+    xdr_set = _to_generalized_xdr(included, lcl_hash, base_fee)
     applicable = ApplicableTxSetFrame(
-        xdr_set, included, {id(f): base_fee if surge else None
-                            for f in included})
+        xdr_set, included, {id(f): base_fee for f in included})
     return applicable, excluded
 
 
@@ -144,12 +150,11 @@ def _sorted_in_hash_order(frames) -> List:
     return sorted(frames, key=full_tx_hash)
 
 
-def _to_generalized_xdr(frames, lcl_hash: bytes, base_fee: int,
-                        discounted: bool):
+def _to_generalized_xdr(frames, lcl_hash: bytes, base_fee: int):
     comp = TxSetComponent.make(
         TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
         TxSetComponentTxsMaybeDiscountedFee(
-            baseFee=base_fee if discounted else None,
+            baseFee=base_fee,
             txs=[f.envelope for f in _sorted_in_hash_order(frames)]))
     phase = TransactionPhase.make(0, [comp] if frames else [])
     return GeneralizedTransactionSet.make(
@@ -241,12 +246,19 @@ class ApplicableTxSetFrame:
                           for e in comp.value.txs]
                 if hashes != sorted(hashes):
                     return False
-        if not self._sequences_are_gapless(ltx):
-            return False
+        # every tx must bid at least the component's discounted rate
+        # (reference checkValid, TxSetFrame.cpp:1678-1686)
+        for f in self.frames:
+            bf = self.base_fee_for(f)
+            if bf is not None and \
+                    f.inclusion_fee() < bf * max(1, f.num_operations()):
+                return False
         prefetch_signature_batch(ltx, self.frames)
         from stellar_tpu.xdr.results import TransactionResultCode as TC
         # per-account chains: each tx validates against its predecessor's
-        # seq num (reference ``TxSetUtils::getInvalidTxList``)
+        # seq num (reference ``TxSetUtils::getInvalidTxList``); gaps
+        # allowed only where a minSeqNum precondition admits them —
+        # is_bad_seq decides, not a set-level rule
         for q in _build_account_queues(self.frames).values():
             current = 0
             for f in q:
@@ -256,19 +268,6 @@ class ApplicableTxSetFrame:
                                     TC.txFEE_BUMP_INNER_SUCCESS):
                     return False
                 current = f.seq_num
-        return True
-
-    def _sequences_are_gapless(self, ltx) -> bool:
-        for aid, q in _build_account_queues(self.frames).items():
-            from stellar_tpu.xdr.types import account_id
-            entry = ltx.load_without_record(account_key(account_id(aid)))
-            if entry is None:
-                return False
-            cur = entry.data.value.seqNum
-            for f in q:
-                if f.seq_num != cur + 1:
-                    return False
-                cur = f.seq_num
         return True
 
     # ---------------- apply order ----------------

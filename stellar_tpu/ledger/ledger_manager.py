@@ -156,7 +156,15 @@ class LedgerManager:
             hh.header.txSetResultHash = tx_set_result_hash
 
         for raw in lcd.upgrades:
-            self._apply_upgrade(ltx, raw)
+            # bad/unsupported upgrades are logged and skipped, never
+            # abort the close (reference LedgerManagerImpl.cpp:955-996)
+            try:
+                self._apply_upgrade(ltx, raw)
+            except Exception as e:
+                import logging
+                logging.getLogger("stellar_tpu.ledger").warning(
+                    "skipping malformed/unsupported upgrade at ledger "
+                    "%d: %s", lcd.ledger_seq, e)
 
         # stamp state hash + skip list on a post-commit header view
         ltx.commit()

@@ -1,0 +1,384 @@
+"""SCP kernel tests (modeled on the reference's
+``src/scp/test/SCPTests.cpp``: a TestSCPDriver drives the abstract
+kernel with crafted peer envelopes, no application or network)."""
+
+from typing import Dict, List
+
+import pytest
+
+from stellar_tpu.scp import SCP, EnvelopeState, SCPDriver, ValidationLevel
+from stellar_tpu.scp.ballot import (
+    PH_CONFIRM, PH_EXTERNALIZE, PH_PREPARE,
+)
+from stellar_tpu.scp.quorum import (
+    is_quorum, is_quorum_set_sane, is_quorum_slice, is_v_blocking,
+    make_node_id, node_key, normalize_qset,
+)
+from stellar_tpu.xdr.scp import (
+    SCPBallot, SCPEnvelope, SCPNomination, SCPQuorumSet, SCPStatement,
+    SCPStatementConfirm, SCPStatementExternalize, SCPStatementPledges,
+    SCPStatementPrepare, SCPStatementType, quorum_set_hash,
+)
+
+ST = SCPStatementType
+
+NODES = [bytes([i + 1]) * 32 for i in range(5)]
+V0, V1, V2, V3, V4 = NODES
+
+
+def qset5(threshold=4):
+    return SCPQuorumSet(threshold=threshold,
+                        validators=[make_node_id(n) for n in NODES],
+                        innerSets=[])
+
+
+# ---------------- quorum math ----------------
+
+
+def test_quorum_slice_flat():
+    q = qset5(3)
+    assert is_quorum_slice(q, {V0, V1, V2})
+    assert not is_quorum_slice(q, {V0, V1})
+
+
+def test_v_blocking_flat():
+    q = qset5(3)
+    # 5 nodes, threshold 3 -> any 3 nodes can be missing-blocked by 3
+    assert is_v_blocking(q, {V0, V1, V2})
+    assert not is_v_blocking(q, {V0, V1})
+    assert not is_v_blocking(SCPQuorumSet(
+        threshold=0, validators=[], innerSets=[]), {V0})
+
+
+def test_nested_qset():
+    inner = SCPQuorumSet(threshold=2,
+                         validators=[make_node_id(V2), make_node_id(V3),
+                                     make_node_id(V4)],
+                         innerSets=[])
+    q = SCPQuorumSet(threshold=2,
+                     validators=[make_node_id(V0), make_node_id(V1)],
+                     innerSets=[inner])
+    # slice: v0 + v1, or v0 + (2 of inner)
+    assert is_quorum_slice(q, {V0, V1})
+    assert is_quorum_slice(q, {V0, V2, V3})
+    assert not is_quorum_slice(q, {V0, V2})
+    # v-blocking: need 2 of the 3 top-level members
+    assert is_v_blocking(q, {V0, V1})
+    assert is_v_blocking(q, {V0, V3, V4})
+    assert not is_v_blocking(q, {V3})
+
+
+def test_qset_sanity():
+    assert is_quorum_set_sane(qset5(4))
+    assert not is_quorum_set_sane(qset5(0))
+    assert not is_quorum_set_sane(qset5(6))
+    dup = SCPQuorumSet(threshold=1,
+                       validators=[make_node_id(V0), make_node_id(V0)],
+                       innerSets=[])
+    assert not is_quorum_set_sane(dup)
+
+
+def test_is_quorum_transitive():
+    q = qset5(4)
+    sts = {n: "st" for n in NODES[:4]}
+    assert is_quorum(q, sts, lambda st: q, lambda st: True)
+    sts3 = {n: "st" for n in NODES[:3]}
+    assert not is_quorum(q, sts3, lambda st: q, lambda st: True)
+
+
+def test_normalize_excludes_self():
+    q = qset5(4)
+    n = normalize_qset(q, remove=V0)
+    from stellar_tpu.scp.quorum import for_all_nodes
+    assert V0 not in for_all_nodes(n)
+    assert n.threshold == 3
+
+
+# ---------------- test driver ----------------
+
+
+class TestDriver(SCPDriver):
+    __test__ = False
+
+    def __init__(self, priority_node=None):
+        self.qsets: Dict[bytes, SCPQuorumSet] = {}
+        self.emitted: List[SCPEnvelope] = []
+        self.externalized: Dict[int, bytes] = {}
+        self.timers: Dict[tuple, tuple] = {}
+        self.priority_node = priority_node
+
+    def register_qset(self, qset):
+        self.qsets[quorum_set_hash(qset)] = qset
+
+    def validate_value(self, slot_index, value, nomination):
+        return ValidationLevel.FULLY_VALIDATED
+
+    def combine_candidates(self, slot_index, candidates):
+        return b"+".join(sorted(candidates))
+
+    def sign_envelope(self, statement):
+        return SCPEnvelope(statement=statement, signature=b"sig")
+
+    def emit_envelope(self, envelope):
+        self.emitted.append(envelope)
+
+    def get_qset(self, qset_hash):
+        return self.qsets.get(qset_hash)
+
+    def setup_timer(self, slot_index, timer_id, timeout_ms, callback):
+        if callback is None:
+            self.timers.pop((slot_index, timer_id), None)
+        else:
+            self.timers[(slot_index, timer_id)] = (timeout_ms, callback)
+
+    def value_externalized(self, slot_index, value):
+        self.externalized[slot_index] = value
+
+    def compute_hash_node(self, slot_index, prev, is_priority, round_n,
+                          node_id):
+        if self.priority_node is not None:
+            return (1 if is_priority and
+                    node_key(node_id) == self.priority_node else 0)
+        return super().compute_hash_node(slot_index, prev, is_priority,
+                                         round_n, node_id)
+
+
+def make_scp(local=V0, threshold=4, priority_node=None):
+    driver = TestDriver(priority_node=priority_node)
+    q = qset5(threshold)
+    driver.register_qset(q)
+    scp = SCP(driver, local, True, q)
+    return scp, driver, q
+
+
+def env_of(node, slot, pledges_type, payload):
+    st = SCPStatement(
+        nodeID=make_node_id(node), slotIndex=slot,
+        pledges=SCPStatementPledges.make(pledges_type, payload))
+    return SCPEnvelope(statement=st, signature=b"sig")
+
+
+def prepare_env(node, qh, slot, ballot, prepared=None, prepared_prime=None,
+                nC=0, nH=0):
+    return env_of(node, slot, ST.SCP_ST_PREPARE, SCPStatementPrepare(
+        quorumSetHash=qh, ballot=ballot, prepared=prepared,
+        preparedPrime=prepared_prime, nC=nC, nH=nH))
+
+
+def confirm_env(node, qh, slot, ballot, nPrepared, nCommit, nH):
+    return env_of(node, slot, ST.SCP_ST_CONFIRM, SCPStatementConfirm(
+        ballot=ballot, nPrepared=nPrepared, nCommit=nCommit, nH=nH,
+        quorumSetHash=qh))
+
+
+def b(counter, value=b"x"):
+    return SCPBallot(counter=counter, value=value)
+
+
+# ---------------- ballot protocol round ----------------
+
+
+def test_ballot_protocol_full_round():
+    """v0 goes PREPARE -> CONFIRM -> EXTERNALIZE as peers progress
+    (the reference's core5 'ballot protocol' flow)."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    slot_i = 1
+
+    # start our own ballot
+    assert scp.get_slot(slot_i).bump_state(b"x".ljust(1, b"x"), True)
+    ballot = b(1)
+    bp = scp.get_slot(slot_i).ballot
+    assert bp.phase == PH_PREPARE
+    assert bp.current.counter == 1
+
+    # quorum votes prepare(b1) -> we accept prepared(b1)
+    for v in (V1, V2, V3):
+        scp.receive_envelope(prepare_env(v, qh, slot_i, ballot))
+    assert bp.prepared is not None and bp.prepared.counter == 1
+
+    # quorum accepts prepared(b1) -> confirm prepared -> h=c=b1
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, slot_i, ballot, prepared=b(1)))
+    assert bp.high is not None and bp.high.counter == 1
+    assert bp.commit is not None and bp.commit.counter == 1
+    assert bp.phase == PH_PREPARE
+
+    # quorum votes commit [1,1] (PREPARE with nC=nH=1) -> accept commit
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, slot_i, ballot, prepared=b(1), nC=1, nH=1))
+    assert bp.phase == PH_CONFIRM
+
+    # quorum accepts commit (CONFIRM) -> externalize
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            confirm_env(v, qh, slot_i, ballot, 1, 1, 1))
+    assert bp.phase == PH_EXTERNALIZE
+    assert driver.externalized[slot_i] == b"x"
+    assert scp.externalized_value(slot_i) == b"x"
+
+    # emitted envelopes end with an EXTERNALIZE statement
+    assert driver.emitted[-1].statement.pledges.arm == \
+        ST.SCP_ST_EXTERNALIZE
+
+
+def test_v_blocking_accept_shortcut():
+    """A v-blocking set that accepted prepared(b) lets us accept without
+    a voting quorum."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    # v-blocking here is 2 nodes (5 nodes, threshold 4)
+    for v in (V1, V2):
+        scp.receive_envelope(
+            prepare_env(v, qh, 1, b(1), prepared=b(1)))
+    bp = scp.get_slot(1).ballot
+    assert bp.prepared is not None and bp.prepared.counter == 1
+
+
+def test_stale_statement_rejected():
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    e = prepare_env(V1, qh, 1, b(2))
+    assert scp.receive_envelope(e) == EnvelopeState.VALID
+    # same statement again -> stale
+    assert scp.receive_envelope(
+        prepare_env(V1, qh, 1, b(2))) == EnvelopeState.INVALID
+    # lower ballot -> stale
+    assert scp.receive_envelope(
+        prepare_env(V1, qh, 1, b(1))) == EnvelopeState.INVALID
+
+
+def test_malformed_statement_rejected():
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    # b=0 from a peer is not sane
+    assert scp.receive_envelope(
+        prepare_env(V1, qh, 1, b(0))) == EnvelopeState.INVALID
+    # unknown qset hash -> invalid
+    assert scp.receive_envelope(
+        prepare_env(V1, b"\x99" * 32, 1, b(1))) == EnvelopeState.INVALID
+    # confirm with nH > ballot counter -> insane
+    assert scp.receive_envelope(
+        confirm_env(V1, qh, 1, b(2), 2, 3, 5)) == EnvelopeState.INVALID
+
+
+def test_timer_bump_on_v_blocking_ahead():
+    """Peers ahead on counters force our counter up (step 9)."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    bp = scp.get_slot(1).ballot
+    assert bp.current.counter == 1
+    # two nodes (v-blocking) at counter 3
+    scp.receive_envelope(prepare_env(V1, qh, 1, b(3)))
+    scp.receive_envelope(prepare_env(V2, qh, 1, b(3)))
+    assert bp.current.counter == 3
+
+
+# ---------------- nomination ----------------
+
+
+def test_nomination_to_ballot():
+    """Leader's nomination propagates: votes -> accepted -> candidate ->
+    ballot starts on the composite."""
+    scp, driver, q = make_scp(priority_node=V0)  # we are the leader
+    qh = quorum_set_hash(q)
+    slot_i = 1
+
+    assert scp.nominate(slot_i, b"val", b"prev")
+    nom = scp.get_slot(slot_i).nomination
+    assert b"val" in nom.votes
+    # everyone echoes the vote
+    def nom_env(node, votes, accepted=()):
+        return env_of(node, slot_i, ST.SCP_ST_NOMINATE, SCPNomination(
+            quorumSetHash=qh, votes=sorted(votes),
+            accepted=sorted(accepted)))
+
+    for v in (V1, V2, V3):
+        assert scp.receive_envelope(
+            nom_env(v, [b"val"])) == EnvelopeState.VALID
+    # quorum voted -> accepted locally
+    assert b"val" in nom.accepted
+    # everyone accepts -> candidate -> ballot protocol starts
+    for v in (V1, V2, V3):
+        assert scp.receive_envelope(
+            nom_env(v, [b"val"], [b"val"])) == EnvelopeState.VALID
+    assert b"val" in nom.candidates
+    bp = scp.get_slot(slot_i).ballot
+    assert bp.current is not None
+    assert bp.current.value == b"val"
+
+
+def test_nomination_follower_echoes_leader():
+    """Non-leader echoes values nominated by the round leader only."""
+    scp, driver, q = make_scp(priority_node=V1)  # v1 is leader
+    qh = quorum_set_hash(q)
+    assert not scp.nominate(1, b"mine", b"prev")  # not leader: no vote
+    nom = scp.get_slot(1).nomination
+    assert not nom.votes
+
+    def nom_env(node, votes):
+        return env_of(node, 1, ST.SCP_ST_NOMINATE, SCPNomination(
+            quorumSetHash=qh, votes=sorted(votes), accepted=[]))
+
+    # non-leader value is not echoed
+    scp.receive_envelope(nom_env(V2, [b"other"]))
+    assert not nom.votes
+    # leader value is echoed
+    scp.receive_envelope(nom_env(V1, [b"theirs"]))
+    assert b"theirs" in nom.votes
+
+
+# ---------------- multi-node convergence ----------------
+
+
+class Network:
+    """N in-process SCP nodes wired through emit_envelope (the
+    reference tests do this via Simulation; here: direct delivery)."""
+
+    def __init__(self, n=5, threshold=4):
+        self.nodes = {}
+        nodes = NODES[:n]
+        q = SCPQuorumSet(threshold=threshold,
+                         validators=[make_node_id(x) for x in nodes],
+                         innerSets=[])
+        self.queue = []
+        for nid in nodes:
+            drv = TestDriver(priority_node=V0)
+            drv.register_qset(q)
+            drv.emit_envelope = lambda env, _nid=nid: \
+                self.queue.append((_nid, env))
+            self.nodes[nid] = SCP(drv, nid, True, q)
+
+    def run(self, max_steps=1000):
+        steps = 0
+        while self.queue and steps < max_steps:
+            sender, env = self.queue.pop(0)
+            for nid, scp in self.nodes.items():
+                if nid != sender:
+                    scp.receive_envelope(env)
+            steps += 1
+        return steps
+
+
+def test_five_node_convergence():
+    net = Network()
+    for nid, scp in net.nodes.items():
+        scp.nominate(1, b"V", b"prev")
+    net.run()
+    values = {scp.externalized_value(1) for scp in net.nodes.values()}
+    assert values == {b"V"}
+
+
+def test_five_node_convergence_competing_values():
+    """Different initial proposals still converge to a single value."""
+    net = Network()
+    for i, (nid, scp) in enumerate(net.nodes.items()):
+        scp.nominate(1, b"val-%d" % i, b"prev")
+    net.run()
+    values = {scp.externalized_value(1) for scp in net.nodes.values()}
+    assert len(values) == 1 and None not in values
