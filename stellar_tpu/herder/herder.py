@@ -1,0 +1,438 @@
+"""Herder: the concrete SCP driver — slot = ledger sequence, value =
+``StellarValue{txSetHash, closeTime, upgrades}`` — plus the glue between
+the tx queue, tx sets, SCP, and the ledger close (reference
+``src/herder/HerderImpl.cpp`` / ``HerderSCPDriver.cpp``).
+
+Pipeline per ledger (reference call stack §3.3 of SURVEY.md):
+
+  trigger_next_ledger: queue -> makeTxSetFromTransactions -> nominate
+  recv_scp_envelope:   verify sig -> (txset known?) -> SCP
+  value_externalized:  StellarValue -> LedgerCloseData -> closeLedger
+                       -> queue shift/ban -> re-trigger after the
+                       remainder of EXP_LEDGER_TIMESPAN
+
+Envelope signatures are over (networkID ‖ ENVELOPE_TYPE_SCP ‖ statement)
+— sig hot path #2 (``HerderImpl.cpp:2413-2431``); bulk floods should go
+through ``prefetch_envelope_signatures`` to ride the TPU batch verifier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from stellar_tpu.crypto.keys import (
+    SecretKey, batch_verify_into_cache, verify_sig,
+)
+from stellar_tpu.herder.transaction_queue import AddResult, TransactionQueue
+from stellar_tpu.herder.tx_set import (
+    ApplicableTxSetFrame, TxSetXDRFrame, make_tx_set_from_transactions,
+)
+from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
+from stellar_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_tpu.scp import SCP, EnvelopeState, SCPDriver, ValidationLevel
+from stellar_tpu.scp.slot import BALLOT_PROTOCOL_TIMER, NOMINATION_TIMER
+from stellar_tpu.utils.timer import VirtualClock, VirtualTimer
+from stellar_tpu.xdr.ledger import StellarValue, basic_stellar_value
+from stellar_tpu.xdr.runtime import Packer, from_bytes, to_bytes
+from stellar_tpu.xdr.scp import SCPEnvelope, SCPQuorumSet, SCPStatement
+from stellar_tpu.xdr.types import EnvelopeType
+
+__all__ = ["Herder", "HERDER_STATE"]
+
+# reference src/herder/Herder.cpp:7-22
+EXP_LEDGER_TIMESPAN_SECONDS = 5
+MAX_SCP_TIMEOUT_SECONDS = 240
+CONSENSUS_STUCK_TIMEOUT_SECONDS = 35
+MAX_TIME_SLIP_SECONDS = 60
+LEDGER_VALIDITY_BRACKET = 100  # max slots ahead we accept
+SCP_EXTRA_LOOKBACK_LEDGERS = 3
+
+
+class HERDER_STATE:
+    BOOTING = 0
+    TRACKING = 1
+    OUT_OF_SYNC = 2
+
+
+def scp_envelope_sign_payload(network_id: bytes,
+                              statement: SCPStatement) -> bytes:
+    """(networkID ‖ ENVELOPE_TYPE_SCP ‖ statement) — what validators
+    sign (reference ``HerderImpl::signEnvelope``)."""
+    p = Packer()
+    p.pack_fopaque(32, network_id)
+    p.pack_int(EnvelopeType.ENVELOPE_TYPE_SCP)
+    SCPStatement.pack(p, statement)
+    return p.bytes()
+
+
+class _HerderSCPDriver(SCPDriver):
+    """SCP callbacks bound to a Herder (reference HerderSCPDriver)."""
+
+    def __init__(self, herder: "Herder"):
+        self.herder = herder
+
+    # -- values --
+
+    def validate_value(self, slot_index, value, nomination):
+        return self.herder._validate_value(slot_index, value, nomination)
+
+    def extract_valid_value(self, slot_index, value):
+        return None
+
+    def combine_candidates(self, slot_index, candidates):
+        return self.herder._combine_candidates(slot_index, candidates)
+
+    # -- plumbing --
+
+    def sign_envelope(self, statement):
+        sig = self.herder.secret_key.sign(
+            scp_envelope_sign_payload(self.herder.network_id, statement))
+        return SCPEnvelope(statement=statement, signature=sig)
+
+    def emit_envelope(self, envelope):
+        self.herder._emit_envelope(envelope)
+
+    def get_qset(self, qset_hash):
+        return self.herder.qsets.get(qset_hash)
+
+    def setup_timer(self, slot_index, timer_id, timeout_ms, callback):
+        self.herder._setup_timer(slot_index, timer_id, timeout_ms,
+                                 callback)
+
+    def compute_timeout(self, round_number):
+        secs = min(round_number, MAX_SCP_TIMEOUT_SECONDS)
+        return secs * 1000
+
+    # -- notifications --
+
+    def value_externalized(self, slot_index, value):
+        self.herder._value_externalized(slot_index, value)
+
+
+class Herder:
+    def __init__(self, secret_key: SecretKey, network_id: bytes,
+                 ledger_manager: LedgerManager, clock: VirtualClock,
+                 qset: SCPQuorumSet, is_validator: bool = True,
+                 target_close_seconds: int = EXP_LEDGER_TIMESPAN_SECONDS):
+        self.secret_key = secret_key
+        self.network_id = network_id
+        self.lm = ledger_manager
+        self.clock = clock
+        self.target_close_seconds = target_close_seconds
+        self.driver = _HerderSCPDriver(self)
+        self.scp = SCP(self.driver, secret_key.public_key.raw,
+                       is_validator, qset)
+        from stellar_tpu.xdr.scp import quorum_set_hash
+        self.qsets: Dict[bytes, SCPQuorumSet] = {
+            quorum_set_hash(qset): qset}
+        # txset hash -> ApplicableTxSetFrame (PendingEnvelopes role)
+        self.tx_sets: Dict[bytes, ApplicableTxSetFrame] = {}
+        # envelopes waiting for their txset: txset hash -> [envelope]
+        self.waiting_envelopes: Dict[bytes, List[SCPEnvelope]] = {}
+        self.tx_queue = TransactionQueue(
+            max_ops=2 * self.lm.last_closed_header.maxTxSetSize,
+            check_valid=self._check_tx_valid)
+        self.state = HERDER_STATE.BOOTING
+        self.tracking_slot = 0
+        self._timers: Dict[tuple, VirtualTimer] = {}
+        self._trigger_timer = VirtualTimer(clock)
+        self._trigger_armed_for = 0
+        self._last_trigger_at = 0.0
+        # network hooks (set by overlay / simulation): fan out to peers
+        self.broadcast_envelope: Callable = lambda env: None
+        self.broadcast_tx_set: Callable = lambda frame: None
+        self.broadcast_transaction: Callable = lambda frame: None
+        # herder-level observers
+        self.on_externalized: Optional[Callable] = None
+
+    # ---------------- qset/txset registry ----------------
+
+    def register_qset(self, qset: SCPQuorumSet):
+        from stellar_tpu.xdr.scp import quorum_set_hash
+        self.qsets[quorum_set_hash(qset)] = qset
+
+    def recv_tx_set(self, frame) -> bool:
+        """Register a tx set heard from the network; releases any SCP
+        envelopes waiting on it (reference
+        ``PendingEnvelopes::recvTxSet``)."""
+        if isinstance(frame, TxSetXDRFrame):
+            applicable = frame.prepare_for_apply(self.network_id)
+            if applicable is None:
+                return False
+        else:
+            applicable = frame
+        h = applicable.hash
+        if h in self.tx_sets:
+            return True
+        self.tx_sets[h] = applicable
+        # release held envelopes — but only those with no OTHER missing
+        # tx set (an envelope held under several hashes is fed exactly
+        # once, when its last dependency arrives)
+        for env in self.waiting_envelopes.pop(h, []):
+            if not self._missing_tx_sets(env.statement):
+                self._feed_scp(env)
+        return True
+
+    def get_tx_set(self, h: bytes) -> Optional[ApplicableTxSetFrame]:
+        return self.tx_sets.get(h)
+
+    # ---------------- transactions ----------------
+
+    def _check_tx_valid(self, frame, current_seq: int = 0):
+        with LedgerTxn(self.lm.root) as ltx:
+            res = frame.check_valid(
+                ltx, current_seq, 0, self.target_close_seconds)
+            ltx.rollback()
+        return res
+
+    def recv_transaction(self, frame, submitted_from_self=False
+                         ) -> AddResult:
+        """Reference ``HerderImpl::recvTransaction``: admit to the queue
+        and flood on success."""
+        res = self.tx_queue.try_add(frame)
+        if res.code == AddResult.ADD_STATUS_PENDING:
+            self.broadcast_transaction(frame)
+        return res
+
+    # ---------------- SCP envelopes ----------------
+
+    def verify_envelope(self, env: SCPEnvelope) -> bool:
+        """Sig hot path #2 (reference ``HerderImpl::verifyEnvelope``)."""
+        payload = scp_envelope_sign_payload(self.network_id,
+                                            env.statement)
+        return verify_sig(env.statement.nodeID.value, payload,
+                          env.signature)
+
+    def prefetch_envelope_signatures(self, envs: List[SCPEnvelope]):
+        """Batch-verify an envelope flood in one device round trip; the
+        per-envelope verify_envelope calls then hit the cache."""
+        batch_verify_into_cache([
+            (e.statement.nodeID.value,
+             scp_envelope_sign_payload(self.network_id, e.statement),
+             e.signature)
+            for e in envs])
+
+    def recv_scp_envelope(self, env: SCPEnvelope) -> int:
+        """Reference ``HerderImpl::recvSCPEnvelope``."""
+        if not self.verify_envelope(env):
+            return EnvelopeState.INVALID
+        slot = env.statement.slotIndex
+        low = max(1, self.lm.ledger_seq - SCP_EXTRA_LOOKBACK_LEDGERS)
+        if slot < low or \
+                slot > self.lm.ledger_seq + LEDGER_VALIDITY_BRACKET:
+            return EnvelopeState.INVALID
+        # hold envelopes whose tx sets we don't have yet
+        missing = self._missing_tx_sets(env.statement)
+        if missing:
+            for h in missing:
+                self.waiting_envelopes.setdefault(h, []).append(env)
+            return EnvelopeState.VALID
+        return self._feed_scp(env)
+
+    def _feed_scp(self, env: SCPEnvelope) -> int:
+        return self.scp.receive_envelope(env)
+
+    def _missing_tx_sets(self, st: SCPStatement) -> List[bytes]:
+        out = []
+        for v in self._statement_values(st):
+            sv = _parse_stellar_value(v)
+            if sv is not None and sv.txSetHash not in self.tx_sets \
+                    and sv.txSetHash not in out:
+                out.append(sv.txSetHash)
+        return out
+
+    @staticmethod
+    def _statement_values(st: SCPStatement) -> List[bytes]:
+        from stellar_tpu.xdr.scp import SCPStatementType as T
+        t = st.pledges.arm
+        p = st.pledges.value
+        if t == T.SCP_ST_NOMINATE:
+            return list(p.votes) + list(p.accepted)
+        if t == T.SCP_ST_PREPARE:
+            vals = [p.ballot.value]
+            if p.prepared is not None:
+                vals.append(p.prepared.value)
+            if p.preparedPrime is not None:
+                vals.append(p.preparedPrime.value)
+            return vals
+        if t == T.SCP_ST_CONFIRM:
+            return [p.ballot.value]
+        return [p.commit.value]
+
+    # ---------------- value validation / combination ----------------
+
+    def _validate_value(self, slot_index: int, value: bytes,
+                        nomination: bool) -> int:
+        sv = _parse_stellar_value(value)
+        if sv is None:
+            return ValidationLevel.INVALID
+        lcl = self.lm.last_closed_header
+        # close time advances strictly, and not absurdly into the future
+        if sv.closeTime <= lcl.scpValue.closeTime:
+            return ValidationLevel.INVALID
+        if nomination and sv.closeTime > \
+                self.clock.system_now() + MAX_TIME_SLIP_SECONDS:
+            return ValidationLevel.INVALID
+        if slot_index != lcl.ledgerSeq + 1:
+            # can't fully validate against a non-current ledger
+            return ValidationLevel.MAYBE_VALID
+        txset = self.tx_sets.get(sv.txSetHash)
+        if txset is None:
+            return ValidationLevel.MAYBE_VALID
+        with LedgerTxn(self.lm.root) as ltx:
+            ok = txset.check_valid(ltx, self.lm.last_closed_hash)
+            ltx.rollback()
+        return ValidationLevel.FULLY_VALIDATED if ok \
+            else ValidationLevel.INVALID
+
+    def _combine_candidates(self, slot_index: int,
+                            candidates) -> Optional[bytes]:
+        """Pick the best txset (most ops, xored-hash tiebreak), max
+        closeTime, merged upgrades (reference
+        ``HerderSCPDriver::combineCandidates``)."""
+        from stellar_tpu.crypto.sha import sha256
+        parsed = []
+        for v in sorted(candidates):
+            sv = _parse_stellar_value(v)
+            if sv is not None:
+                parsed.append(sv)
+        if not parsed:
+            return None
+        candidates_hash = sha256(b"".join(sorted(candidates)))
+        best = None
+        best_key = None
+        max_close = 0
+        upgrades: Dict[int, object] = {}
+        for sv in parsed:
+            max_close = max(max_close, sv.closeTime)
+            txset = self.tx_sets.get(sv.txSetHash)
+            ops = txset.size_op() if txset is not None else 0
+            xored = bytes(a ^ b for a, b in
+                          zip(sv.txSetHash, candidates_hash))
+            key = (ops, xored)
+            if best_key is None or key > best_key:
+                best_key = key
+                best = sv
+            for raw in sv.upgrades:
+                from stellar_tpu.xdr.ledger import LedgerUpgrade
+                try:
+                    up = from_bytes(LedgerUpgrade, bytes(raw))
+                except Exception:
+                    continue
+                cur = upgrades.get(up.arm)
+                if cur is None or up.value > cur.value:
+                    upgrades[up.arm] = up
+        from stellar_tpu.xdr.ledger import LedgerUpgrade
+        up_bytes = [to_bytes(LedgerUpgrade, upgrades[t])
+                    for t in sorted(upgrades)]
+        out = basic_stellar_value(best.txSetHash, max_close, up_bytes)
+        return to_bytes(StellarValue, out)
+
+    # ---------------- timers ----------------
+
+    def _setup_timer(self, slot_index, timer_id, timeout_ms, callback):
+        key = (slot_index, timer_id)
+        timer = self._timers.get(key)
+        if timer is None:
+            timer = self._timers[key] = VirtualTimer(self.clock)
+        timer.cancel()
+        if callback is not None and timeout_ms >= 0:
+            timer.expires_from_now(timeout_ms / 1000.0)
+            timer.async_wait(callback)
+
+    # ---------------- nomination trigger ----------------
+
+    def start(self):
+        """Begin participating: arm the first ledger trigger."""
+        self.state = HERDER_STATE.TRACKING
+        self.tracking_slot = self.lm.ledger_seq + 1
+        self._arm_trigger(0.0)
+
+    def _arm_trigger(self, delay: float):
+        seq = self.lm.ledger_seq + 1
+        self._trigger_armed_for = seq
+        self._trigger_timer.cancel()
+        self._trigger_timer.expires_from_now(max(0.0, delay))
+        self._trigger_timer.async_wait(
+            lambda: self.trigger_next_ledger(seq))
+
+    def trigger_next_ledger(self, ledger_seq_to_trigger: int):
+        """Reference ``HerderImpl::triggerNextLedger``: build + nominate
+        this node's proposal."""
+        if ledger_seq_to_trigger != self.lm.ledger_seq + 1:
+            return
+        self._last_trigger_at = self.clock.now()
+        lcl = self.lm.last_closed_header
+        frames = self.tx_queue.get_transactions()
+        txset, _ = make_tx_set_from_transactions(
+            frames, lcl, self.lm.last_closed_hash)
+        self.recv_tx_set(txset)
+        self.broadcast_tx_set(txset)
+        close_time = max(self.clock.system_now(),
+                         lcl.scpValue.closeTime + 1)
+        sv = basic_stellar_value(txset.hash, close_time)
+        prev = to_bytes(StellarValue, lcl.scpValue)
+        self.scp.nominate(ledger_seq_to_trigger,
+                          to_bytes(StellarValue, sv), prev)
+
+    # ---------------- externalize ----------------
+
+    def _emit_envelope(self, envelope: SCPEnvelope):
+        self.broadcast_envelope(envelope)
+
+    def _value_externalized(self, slot_index: int, value: bytes):
+        """Reference ``HerderImpl::valueExternalized`` →
+        ``LedgerManager::valueExternalized``."""
+        sv = _parse_stellar_value(value)
+        if sv is None:
+            raise RuntimeError("externalized unparsable value")
+        txset = self.tx_sets.get(sv.txSetHash)
+        if txset is None:
+            raise RuntimeError("externalized unknown tx set")
+        if slot_index != self.lm.ledger_seq + 1:
+            return  # stale/buffered: catchup handles this later
+        result = self.lm.close_ledger(LedgerCloseData(
+            ledger_seq=slot_index, tx_set=txset,
+            close_time=sv.closeTime, upgrades=list(sv.upgrades)))
+        self.state = HERDER_STATE.TRACKING
+        self.tracking_slot = slot_index + 1
+        # queue bookkeeping
+        self.tx_queue.remove_applied(txset.frames)
+        self.tx_queue.shift()
+        self.tx_queue.max_ops = 2 * self.lm.last_closed_header.maxTxSetSize
+        # GC old slots + their timers + txsets
+        keep_from = max(1, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS)
+        self.scp.purge_slots(keep_from)
+        for key in [k for k in self._timers if k[0] < keep_from]:
+            self._timers.pop(key).cancel()
+        self._gc_tx_sets()
+        if self.on_externalized is not None:
+            self.on_externalized(slot_index, result)
+        # pace the next ledger to the target cadence
+        elapsed = self.clock.now() - self._last_trigger_at
+        self._arm_trigger(max(0.0, self.target_close_seconds - elapsed))
+
+    def _gc_tx_sets(self):
+        """Keep only tx sets referenced by live slots' values."""
+        live: set = set()
+        for idx in self.scp.known_slots:
+            slot = self.scp.known_slots[idx]
+            for env in slot.get_current_state():
+                for v in self._statement_values(env.statement):
+                    sv = _parse_stellar_value(v)
+                    if sv is not None:
+                        live.add(sv.txSetHash)
+        self.tx_sets = {h: t for h, t in self.tx_sets.items()
+                        if h in live}
+        # waiting envelopes for closed slots will never be fed; drop them
+        self.waiting_envelopes = {
+            h: kept for h, envs in self.waiting_envelopes.items()
+            if (kept := [e for e in envs
+                         if e.statement.slotIndex > self.lm.ledger_seq])}
+
+
+def _parse_stellar_value(raw: bytes) -> Optional[StellarValue]:
+    try:
+        return from_bytes(StellarValue, bytes(raw))
+    except Exception:
+        return None

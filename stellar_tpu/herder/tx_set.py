@@ -235,14 +235,17 @@ class ApplicableTxSetFrame:
         if self.size_op() > header.maxTxSetSize:
             return False
         # discounted base fee must not be below the protocol minimum
+        by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames}
         for phase in self.xdr.value.phases:
             for comp in phase.value:
                 bf = comp.value.baseFee
                 if bf is not None and bf < header.baseFee:
                     return False
                 # wire order must be canonical (hash-sorted) so the set
-                # hash is unique for its contents
-                hashes = [sha256(to_bytes(TransactionEnvelope, e))
+                # hash is unique for its contents; envelopes are the
+                # frames' own objects, so reuse their memoized hashes
+                hashes = [by_env.get(id(e)) or
+                          sha256(to_bytes(TransactionEnvelope, e))
                           for e in comp.value.txs]
                 if hashes != sorted(hashes):
                     return False

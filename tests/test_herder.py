@@ -1,0 +1,222 @@
+"""Herder integration tests: N in-process validators on a shared
+VIRTUAL_TIME clock drive real consensus rounds that close real ledgers
+(the reference's ``herder/test/HerderTests.cpp`` via ``Simulation``)."""
+
+import pytest
+
+from stellar_tpu.herder.herder import HERDER_STATE, Herder
+from stellar_tpu.herder.transaction_queue import AddResult
+from stellar_tpu.ledger.ledger_manager import LedgerManager
+from stellar_tpu.scp.quorum import make_node_id
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+from stellar_tpu.xdr.scp import SCPQuorumSet
+
+XLM = 10_000_000
+NETWORK_ID = b"\x07" * 32
+
+
+class MiniNetwork:
+    """Validators wired directly through broadcast callbacks, messages
+    delivered via the shared clock's action queue (in-process loopback —
+    the Simulation harness shape)."""
+
+    def __init__(self, n_nodes=4, accounts=(), threshold=None):
+        self.clock = VirtualClock(VIRTUAL_TIME)
+        self.node_keys = [keypair(f"validator-{i}") for i in range(n_nodes)]
+        qset = SCPQuorumSet(
+            threshold=threshold if threshold is not None
+            else (n_nodes - (n_nodes - 1) // 3),
+            validators=[make_node_id(k.public_key.raw)
+                        for k in self.node_keys],
+            innerSets=[])
+        self.herders = []
+        for k in self.node_keys:
+            root = seed_root_with_accounts(list(accounts))
+            lm = LedgerManager(NETWORK_ID, root)
+            h = Herder(k, NETWORK_ID, lm, self.clock, qset)
+            self.herders.append(h)
+        for h in self.herders:
+            h.broadcast_envelope = self._make_bcast(h, "env")
+            h.broadcast_tx_set = self._make_bcast(h, "txset")
+            h.broadcast_transaction = self._make_bcast(h, "tx")
+
+    def _make_bcast(self, sender, kind):
+        def bcast(item):
+            for other in self.herders:
+                if other is sender:
+                    continue
+                if kind == "env":
+                    self.clock.post_to_main(
+                        lambda o=other, i=item: o.recv_scp_envelope(i))
+                elif kind == "txset":
+                    self.clock.post_to_main(
+                        lambda o=other, i=item: o.recv_tx_set(i))
+                else:
+                    self.clock.post_to_main(
+                        lambda o=other, i=item: o.recv_transaction(i))
+        return bcast
+
+    def start(self):
+        for h in self.herders:
+            h.start()
+
+    def crank_until_ledger(self, seq, timeout=120):
+        ok = self.clock.crank_until(
+            lambda: all(h.lm.ledger_seq >= seq for h in self.herders),
+            timeout)
+        return ok
+
+
+def test_four_node_consensus_closes_ledger():
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    net.start()
+    assert net.crank_until_ledger(3)
+    hashes = {h.lm.last_closed_hash for h in net.herders}
+    assert len(hashes) == 1  # all nodes agree bit-for-bit
+    assert all(h.state == HERDER_STATE.TRACKING for h in net.herders)
+
+
+def test_transaction_flows_through_consensus():
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    net.start()
+    tx = make_tx(a, (1 << 32) + 1, [payment_op(b, 5 * XLM)],
+                 network_id=NETWORK_ID)
+    res = net.herders[0].recv_transaction(tx)
+    assert res.code == AddResult.ADD_STATUS_PENDING
+
+    target = net.herders[0].lm.ledger_seq + 2
+    assert net.crank_until_ledger(target)
+    # payment applied identically everywhere
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.xdr.types import account_id
+    for h in net.herders:
+        e = h.lm.root.store.get(
+            key_bytes(account_key(account_id(b.public_key.raw))))
+        assert e.data.value.balance == 1005 * XLM
+    assert len({h.lm.last_closed_hash for h in net.herders}) == 1
+    # applied tx left every queue
+    for h in net.herders:
+        assert not h.tx_queue.get_transactions()
+
+
+def test_ledger_cadence_averages_target():
+    net = MiniNetwork(accounts=[])
+    net.start()
+    t0 = net.clock.now()
+    assert net.crank_until_ledger(6, timeout=300)
+    elapsed = net.clock.now() - t0
+    closes = net.herders[0].lm.ledger_seq - 2
+    # virtual time: cadence should be ~EXP_LEDGER_TIMESPAN (5s)
+    assert 1.0 <= elapsed / closes <= 20.0
+
+
+def test_duplicate_and_banned_tx_rejected():
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    net.start()
+    tx = make_tx(a, (1 << 32) + 1, [payment_op(b, XLM)],
+                 network_id=NETWORK_ID)
+    h0 = net.herders[0]
+    assert h0.recv_transaction(tx).code == AddResult.ADD_STATUS_PENDING
+    assert h0.recv_transaction(tx).code == AddResult.ADD_STATUS_DUPLICATE
+
+
+def test_invalid_envelope_signature_rejected():
+    net = MiniNetwork(accounts=[])
+    net.start()
+    h0, h1 = net.herders[0], net.herders[1]
+    # craft: h1 emits a valid envelope; corrupt the signature
+    captured = []
+    h1.broadcast_envelope = lambda env: captured.append(env)
+    net.clock.crank_until(lambda: captured, 30)
+    assert captured
+    env = captured[0]
+    good = h0.verify_envelope(env)
+    assert good
+    env.signature = bytes(64)
+    from stellar_tpu.scp import EnvelopeState
+    assert h0.recv_scp_envelope(env) == EnvelopeState.INVALID
+
+
+def test_envelope_held_until_txset_arrives():
+    """SCP envelopes naming an unknown txset wait in PendingEnvelopes."""
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    h0, h1 = net.herders[0], net.herders[1]
+    # suppress txset broadcast from h1; capture it
+    held_sets = []
+    h1.broadcast_tx_set = lambda ts: held_sets.append(ts)
+    envs = []
+    h1.broadcast_envelope = lambda env: envs.append(env)
+    h1.start()
+    net.clock.crank_until(lambda: envs and held_sets, 30)
+    assert envs and held_sets
+    # deliver envelope first: it must be held, not fed to SCP
+    e = envs[0]
+    h0.recv_scp_envelope(e)
+    assert h0.waiting_envelopes
+    # now the txset arrives: the envelope is released
+    h0.recv_tx_set(held_sets[0])
+    assert not h0.waiting_envelopes
+
+
+def test_sixteen_validator_storm():
+    """BASELINE config #4: 16 validators, 5 consensus rounds."""
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(n_nodes=16,
+                      accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    net.start()
+    assert net.crank_until_ledger(7, timeout=600)
+    assert len({h.lm.last_closed_hash for h in net.herders}) == 1
+
+
+def test_tx_queue_chain_extension():
+    """An account can queue several consecutive txs; they all make it
+    into one ledger."""
+    a, b = keypair("alice"), keypair("bob")
+    net = MiniNetwork(accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    net.start()
+    h0 = net.herders[0]
+    base = (1 << 32)
+    for i in range(3):
+        tx = make_tx(a, base + 1 + i, [payment_op(b, XLM)],
+                     network_id=NETWORK_ID)
+        res = h0.recv_transaction(tx)
+        assert res.code == AddResult.ADD_STATUS_PENDING, (i, res.code)
+    assert len(h0.tx_queue.get_transactions()) == 3
+    target = h0.lm.ledger_seq + 2
+    assert net.crank_until_ledger(target)
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.xdr.types import account_id
+    e = h0.lm.root.store.get(
+        key_bytes(account_key(account_id(b.public_key.raw))))
+    assert e.data.value.balance == 1003 * XLM
+
+
+def test_tx_queue_eviction_never_orphans_own_chain():
+    from stellar_tpu.herder.transaction_queue import TransactionQueue
+    from stellar_tpu.xdr.results import TransactionResultCode as TC
+
+    class FakeRes:
+        code = TC.txSUCCESS
+    a, b = keypair("alice"), keypair("bob")
+    q = TransactionQueue(max_ops=2, check_valid=lambda f, cur: FakeRes())
+    base = 1 << 32
+    t1 = make_tx(a, base + 1, [payment_op(b, XLM)], fee=100,
+                 network_id=NETWORK_ID)
+    t2 = make_tx(a, base + 2, [payment_op(b, XLM)], fee=100_000,
+                 network_id=NETWORK_ID)
+    t3 = make_tx(a, base + 3, [payment_op(b, XLM)], fee=100_000,
+                 network_id=NETWORK_ID)
+    assert q.try_add(t1).code == AddResult.ADD_STATUS_PENDING
+    assert q.try_add(t2).code == AddResult.ADD_STATUS_PENDING
+    # queue full (2 ops); t3 must NOT evict its own predecessors
+    assert q.try_add(t3).code == AddResult.ADD_STATUS_TRY_AGAIN_LATER
+    assert len(q.get_transactions()) == 2
