@@ -1,0 +1,153 @@
+"""The 11-level LiveBucketList: the hashed canonical ledger state
+(reference ``src/bucket/BucketListBase.h:445`` / ``.cpp``).
+
+Geometry: level i holds ~4^(i+1) ledgers of changes as two buckets,
+``curr`` and ``snap``; half-full currs snap and spill downward on the
+cadence ``levelShouldSpill(ledger, i) = ledger % levelHalf(i) == 0 or
+ledger % levelSize(i) == 0`` with ``levelSize(i) = 4^(i+1)``. The merge
+of a spilled snap into the next level's curr is *prepared* at spill time
+and only becomes visible (``commit``) at that level's next spill — the
+reference runs these merges on worker threads (FutureBucket,
+``bucket/FutureBucket.h:37-127``); here they're computed eagerly but
+held in ``next`` so the visible state sequence is identical.
+
+The list hash is SHA-256 over each level's SHA-256(curr.hash ‖
+snap.hash) (reference ``BucketListBase::getHash``), and chains into the
+ledger header, making every checkpoint verifiable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional
+
+from stellar_tpu.bucket.bucket import (
+    EMPTY, Bucket, fresh_bucket, merge_buckets,
+)
+from stellar_tpu.xdr.ledger import BucketEntryType
+
+__all__ = ["BucketLevel", "LiveBucketList", "NUM_LEVELS"]
+
+NUM_LEVELS = 11
+
+
+def level_size(level: int) -> int:
+    return 1 << (2 * (level + 1))
+
+
+def level_half(level: int) -> int:
+    return level_size(level) >> 1
+
+
+def round_down(v: int, m: int) -> int:
+    return v - (v % m)
+
+
+def level_should_spill(ledger: int, level: int) -> bool:
+    if level == NUM_LEVELS - 1:
+        return False  # the bottom level never spills
+    return (ledger == round_down(ledger, level_half(level)) or
+            ledger == round_down(ledger, level_size(level)))
+
+
+class BucketLevel:
+    __slots__ = ("level", "curr", "snap", "next")
+
+    def __init__(self, level: int):
+        self.level = level
+        self.curr: Bucket = EMPTY
+        self.snap: Bucket = EMPTY
+        self.next: Optional[Bucket] = None  # prepared (pending) merge
+
+    def hash(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(self.curr.hash)
+        h.update(self.snap.hash)
+        return h.digest()
+
+    def take_snap(self) -> Bucket:
+        """curr -> snap, fresh curr (reference ``BucketLevel::snap``)."""
+        self.snap = self.curr
+        self.curr = EMPTY
+        return self.snap
+
+    def commit(self):
+        """Make the prepared merge visible (reference
+        ``BucketLevel::commit`` resolving the FutureBucket)."""
+        if self.next is not None:
+            self.curr = self.next
+            self.next = None
+
+    def prepare(self, incoming_snap: Bucket, protocol_version: int,
+                keep_tombstones: bool):
+        """Start (here: compute) the merge of the level above's snap
+        into this level's curr; visible at the next commit."""
+        self.next = merge_buckets(self.curr, incoming_snap,
+                                  protocol_version,
+                                  keep_tombstones=keep_tombstones)
+
+
+class LiveBucketList:
+    def __init__(self):
+        self.levels: List[BucketLevel] = [BucketLevel(i)
+                                          for i in range(NUM_LEVELS)]
+
+    # ---------------- hashing ----------------
+
+    def hash(self) -> bytes:
+        h = hashlib.sha256()
+        for lev in self.levels:
+            h.update(lev.hash())
+        return h.digest()
+
+    # ---------------- the spill cascade ----------------
+
+    def add_batch(self, current_ledger: int, protocol_version: int,
+                  init_entries: Iterable, live_entries: Iterable,
+                  dead_keys: Iterable):
+        """Apply one ledger's changes (reference
+        ``BucketListBase::addBatch`` / ``addBatchInternal`` — shadows
+        omitted, removed since protocol 12)."""
+        assert current_ledger > 0
+        for i in range(NUM_LEVELS - 1, 0, -1):
+            if level_should_spill(current_ledger, i - 1):
+                spilled = self.levels[i - 1].take_snap()
+                self.levels[i].commit()
+                self.levels[i].prepare(
+                    spilled, protocol_version,
+                    keep_tombstones=(i < NUM_LEVELS - 1))
+        # level 0 accumulates each ledger's batch into curr immediately
+        # (reference: prepare(fresh) then commit in the same call)
+        self.levels[0].prepare(
+            fresh_bucket(protocol_version, init_entries, live_entries,
+                         dead_keys),
+            protocol_version, keep_tombstones=True)
+        self.levels[0].commit()
+
+    # ---------------- lookups (the BucketListDB role) ----------------
+
+    def get(self, kb: bytes):
+        """Newest-first point lookup across levels; returns the live
+        LedgerEntry or None (dead/absent) (reference
+        ``SearchableBucketListSnapshot::load``)."""
+        for lev in self.levels:
+            for bucket in (lev.curr, lev.snap):
+                e = bucket.get(kb)
+                if e is not None:
+                    if e.arm == BucketEntryType.DEADENTRY:
+                        return None
+                    return e.value
+        return None
+
+    def all_buckets(self) -> List[Bucket]:
+        out = []
+        for lev in self.levels:
+            out.append(lev.curr)
+            out.append(lev.snap)
+            if lev.next is not None:
+                out.append(lev.next)
+        return out
+
+    def total_entry_count(self) -> int:
+        return sum(len(b.entries) for lev in self.levels
+                   for b in (lev.curr, lev.snap))

@@ -81,10 +81,18 @@ class LedgerManager:
 
     def __init__(self, network_id: bytes,
                  root: Optional[LedgerTxnRoot] = None,
-                 state_hasher: Optional[Callable] = None):
+                 state_hasher: Optional[Callable] = None,
+                 bucket_list=None):
         self.network_id = network_id
         self.root = root if root is not None else LedgerTxnRoot()
         self.state_hasher = state_hasher or hash_store_state
+        # the bucket list is fed every close's entry delta and its
+        # 11-level hash becomes header.bucketListHash; pass
+        # bucket_list=False to fall back to a flat store hash
+        if bucket_list is None:
+            from stellar_tpu.bucket.bucket_list import LiveBucketList
+            bucket_list = LiveBucketList()
+        self.bucket_list = bucket_list or None
         self._lcl_hash = ledger_header_hash(self.root.header())
         self.close_meta_stream: List = []  # downstream consumers hook
 
@@ -166,10 +174,27 @@ class LedgerManager:
                     "skipping malformed/unsupported upgrade at ledger "
                     "%d: %s", lcd.ledger_seq, e)
 
-        # stamp state hash + skip list on a post-commit header view
+        # classify the close's entry delta and stamp lastModified —
+        # this is what the bucket list (and meta) see
+        init_entries, live_entries, dead_keys = [], [], []
+        for kb, (prev, cur) in ltx.get_delta().items():
+            if cur is not None:
+                cur.lastModifiedLedgerSeq = lcd.ledger_seq
+                (live_entries if prev is not None
+                 else init_entries).append(cur)
+            elif prev is not None:
+                from stellar_tpu.xdr.types import LedgerKey
+                dead_keys.append(from_bytes(LedgerKey, kb))
+
         ltx.commit()
         header = copy_header(self.root.header())
-        header.bucketListHash = self.state_hasher(self.root.store)
+        if self.bucket_list is not None:
+            self.bucket_list.add_batch(
+                lcd.ledger_seq, header.ledgerVersion,
+                init_entries, live_entries, dead_keys)
+            header.bucketListHash = self.bucket_list.hash()
+        else:
+            header.bucketListHash = self.state_hasher(self.root.store)
         self._calculate_skip_values(header)
         self.root.set_header(header)
         self._lcl_hash = ledger_header_hash(header)

@@ -40,6 +40,16 @@ def is_asset_code_valid(asset) -> bool:
     return False
 
 
+def is_raw_code_valid(arm: int, code: bytes) -> bool:
+    """Validity of bare AssetCode union contents (AllowTrustOp carries
+    the code bytes without an issuer)."""
+    if arm == AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+        return _code_ok(code, 1, 4)
+    if arm == AssetType.ASSET_TYPE_CREDIT_ALPHANUM12:
+        return _code_ok(code, 5, 12)
+    return False
+
+
 def is_native(asset) -> bool:
     return asset.arm == AssetType.ASSET_TYPE_NATIVE
 

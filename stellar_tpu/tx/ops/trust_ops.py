@@ -1,0 +1,282 @@
+"""ChangeTrust + AllowTrust + SetTrustLineFlags (reference
+``ChangeTrustOpFrame.cpp``, ``TrustFlagsOpFrameBase.cpp``,
+``AllowTrustOpFrame.cpp``, ``SetTrustLineFlagsOpFrame.cpp``).
+
+Liquidity-pool-share trustlines land with the pools milestone; the
+classic credit-asset paths here are complete. Offer removal on
+authorization revocation is wired through
+``stellar_tpu.tx.offer_exchange`` once the order book exists.
+"""
+
+from __future__ import annotations
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_tpu.tx.account_utils import (
+    add_num_entries, get_buying_liabilities,
+)
+from stellar_tpu.tx.asset_utils import (
+    get_issuer, is_asset_code_valid, is_asset_valid, is_native,
+    trustline_key,
+)
+from stellar_tpu.tx.op_frame import (
+    OperationFrame, ThresholdLevel, account_key, register_op,
+)
+from stellar_tpu.tx.ops.account_ops import (
+    is_auth_required, is_auth_revocable, is_clawback_enabled,
+)
+from stellar_tpu.xdr.results import (
+    AllowTrustResultCode, ChangeTrustResultCode,
+    SetTrustLineFlagsResultCode,
+)
+from stellar_tpu.xdr.tx import OperationType
+from stellar_tpu.xdr.types import (
+    AUTHORIZED_FLAG, AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG, AlphaNum4,
+    AlphaNum12, Asset, AssetType, LedgerEntry, LedgerEntryType,
+    MASK_TRUSTLINE_FLAGS_V17, TRUSTLINE_CLAWBACK_ENABLED_FLAG,
+    TrustLineEntry,
+)
+
+INT64_MAX = 0x7FFFFFFFFFFFFFFF
+TRUST_AUTH_FLAGS = (AUTHORIZED_FLAG |
+                    AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
+
+
+def _is_issuer(account_id_v, asset) -> bool:
+    return not is_native(asset) and get_issuer(asset) == account_id_v
+
+
+def new_trustline_entry(account_id_v, tl_asset, limit: int,
+                        flags: int, last_modified: int) -> LedgerEntry:
+    tl = TrustLineEntry(
+        accountID=account_id_v, asset=tl_asset, balance=0, limit=limit,
+        flags=flags, ext=TrustLineEntry._types[5].make(0))
+    return LedgerEntry(
+        lastModifiedLedgerSeq=last_modified,
+        data=LedgerEntry._types[1].make(LedgerEntryType.TRUSTLINE, tl),
+        ext=LedgerEntry._types[2].make(0))
+
+
+@register_op(OperationType.CHANGE_TRUST)
+class ChangeTrustOpFrame(OperationFrame):
+
+    def do_check_valid(self, ledger_version: int):
+        Code = ChangeTrustResultCode
+        line = self.body.line
+        if self.body.limit < 0:
+            return False, self.make_result(Code.CHANGE_TRUST_MALFORMED)
+        if line.arm == AssetType.ASSET_TYPE_POOL_SHARE:
+            return False, self.make_result(Code.CHANGE_TRUST_MALFORMED)
+        if line.arm == AssetType.ASSET_TYPE_NATIVE or \
+                not is_asset_valid(line, ledger_version):
+            return False, self.make_result(Code.CHANGE_TRUST_MALFORMED)
+        if _is_issuer(self.source_account_id(), line):
+            return False, self.make_result(Code.CHANGE_TRUST_MALFORMED)
+        return True, None
+
+    def do_apply(self, outer):
+        Code = ChangeTrustResultCode
+        line = self.body.line
+        limit = self.body.limit
+        src_id = self.source_account_id()
+        key = trustline_key(src_id, line)
+        with LedgerTxn(outer) as ltx:
+            header = ltx.header()
+            tl_handle = ltx.load(key)
+            if tl_handle is not None:
+                tl = tl_handle.data
+                min_limit = tl.balance + get_buying_liabilities(
+                    tl_handle.entry)
+                if limit < min_limit:
+                    tl_handle.deactivate()
+                    return False, self.make_result(
+                        Code.CHANGE_TRUST_INVALID_LIMIT)
+                if limit == 0:
+                    tl_handle.deactivate()
+                    ltx.erase(key)
+                    with ltx.load(account_key(src_id)) as src:
+                        add_num_entries(header, src.data, -1)
+                else:
+                    if not ltx.exists(account_key(get_issuer(line))):
+                        tl_handle.deactivate()
+                        return False, self.make_result(
+                            Code.CHANGE_TRUST_NO_ISSUER)
+                    tl.limit = limit
+                    tl_handle.deactivate()
+                ltx.commit()
+                return True, self.make_result(Code.CHANGE_TRUST_SUCCESS)
+
+            # new trustline
+            if limit == 0:
+                return False, self.make_result(
+                    Code.CHANGE_TRUST_INVALID_LIMIT)
+            issuer = ltx.load_without_record(
+                account_key(get_issuer(line)))
+            if issuer is None:
+                return False, self.make_result(
+                    Code.CHANGE_TRUST_NO_ISSUER)
+            flags = 0
+            if not is_auth_required(issuer.data.value):
+                flags |= AUTHORIZED_FLAG
+            if is_clawback_enabled(issuer.data.value):
+                flags |= TRUSTLINE_CLAWBACK_ENABLED_FLAG
+            with ltx.load(account_key(src_id)) as src:
+                if not add_num_entries(header, src.data, 1):
+                    ltx.rollback()
+                    return False, self.make_result(
+                        Code.CHANGE_TRUST_LOW_RESERVE)
+            from stellar_tpu.tx.asset_utils import asset_to_trustline_asset
+            ltx.create(new_trustline_entry(
+                src_id, asset_to_trustline_asset(line), limit, flags,
+                header.ledgerSeq)).deactivate()
+            ltx.commit()
+        return True, self.make_result(Code.CHANGE_TRUST_SUCCESS)
+
+
+class _TrustFlagsBase(OperationFrame):
+    """Shared auth-flag mutation (reference TrustFlagsOpFrameBase)."""
+
+    def threshold_level(self) -> int:
+        return ThresholdLevel.LOW
+
+    def trustor(self):
+        raise NotImplementedError
+
+    def op_asset(self):
+        raise NotImplementedError
+
+    def _expected_flags(self, cur_flags: int):
+        """(ok, new_flags, fail_result)."""
+        raise NotImplementedError
+
+    def _fail(self, code):
+        return False, self.make_result(code)
+
+    def do_apply(self, outer):
+        src_id = self.source_account_id()
+        with LedgerTxn(outer) as ltx:
+            src = ltx.load_without_record(account_key(src_id))
+            auth_revocable = is_auth_revocable(src.data.value)
+            key = trustline_key(self.trustor(), self.op_asset())
+            h = ltx.load(key)
+            if h is None:
+                return self._no_trustline()
+            tl = h.data
+            ok, new_flags, fail = self._expected_flags(tl.flags)
+            if not ok:
+                h.deactivate()
+                return False, fail
+            # revoking full authorization requires AUTH_REVOCABLE
+            losing_auth = (tl.flags & AUTHORIZED_FLAG) and \
+                not (new_flags & AUTHORIZED_FLAG)
+            losing_maintain = (tl.flags & TRUST_AUTH_FLAGS) and \
+                not (new_flags & TRUST_AUTH_FLAGS)
+            if (losing_auth or losing_maintain) and not auth_revocable:
+                h.deactivate()
+                return self._cant_revoke()
+            tl.flags = new_flags
+            h.deactivate()
+            # NOTE: full revocation should also pull the trustor's offers
+            # in this asset and redeem pool shares (reference
+            # removeOffers/removePoolShareTrustLines) — wired in with the
+            # order-book milestone.
+            ltx.commit()
+        return True, self._success()
+
+
+@register_op(OperationType.ALLOW_TRUST)
+class AllowTrustOpFrame(_TrustFlagsBase):
+
+    def trustor(self):
+        return self.body.trustor
+
+    def op_asset(self):
+        code = self.body.asset
+        issuer = self.source_account_id()
+        if code.arm == AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+            return Asset.make(code.arm,
+                              AlphaNum4(assetCode=code.value, issuer=issuer))
+        return Asset.make(code.arm,
+                          AlphaNum12(assetCode=code.value, issuer=issuer))
+
+    def do_check_valid(self, ledger_version: int):
+        from stellar_tpu.tx.asset_utils import is_raw_code_valid
+        Code = AllowTrustResultCode
+        if not is_raw_code_valid(self.body.asset.arm,
+                                 self.body.asset.value):
+            return False, self.make_result(Code.ALLOW_TRUST_MALFORMED)
+        if self.body.authorize & ~TRUST_AUTH_FLAGS:
+            return False, self.make_result(Code.ALLOW_TRUST_MALFORMED)
+        if self.body.trustor == self.source_account_id():
+            return False, self.make_result(
+                Code.ALLOW_TRUST_SELF_NOT_ALLOWED)
+        return True, None
+
+    def _expected_flags(self, cur_flags: int):
+        new = (cur_flags & ~TRUST_AUTH_FLAGS) | self.body.authorize
+        return True, new, None
+
+    def _no_trustline(self):
+        return self._fail(AllowTrustResultCode.ALLOW_TRUST_NO_TRUST_LINE)
+
+    def _cant_revoke(self):
+        return self._fail(AllowTrustResultCode.ALLOW_TRUST_CANT_REVOKE)
+
+    def _success(self):
+        return self.make_result(AllowTrustResultCode.ALLOW_TRUST_SUCCESS)
+
+
+@register_op(OperationType.SET_TRUST_LINE_FLAGS)
+class SetTrustLineFlagsOpFrame(_TrustFlagsBase):
+
+    def trustor(self):
+        return self.body.trustor
+
+    def op_asset(self):
+        return self.body.asset
+
+    def do_check_valid(self, ledger_version: int):
+        Code = SetTrustLineFlagsResultCode
+        b = self.body
+        if b.trustor == self.source_account_id():
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if is_native(b.asset) or \
+                not is_asset_valid(b.asset, ledger_version):
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if get_issuer(b.asset) != self.source_account_id():
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if b.setFlags & b.clearFlags:
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        if (b.setFlags | b.clearFlags) & ~MASK_TRUSTLINE_FLAGS_V17:
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        # clawback flag can only be cleared, never set, per trustline
+        if b.setFlags & TRUSTLINE_CLAWBACK_ENABLED_FLAG:
+            return False, self.make_result(
+                Code.SET_TRUST_LINE_FLAGS_MALFORMED)
+        return True, None
+
+    def _expected_flags(self, cur_flags: int):
+        new = (cur_flags & ~self.body.clearFlags) | self.body.setFlags
+        # AUTHORIZED and MAINTAIN_LIABILITIES are mutually exclusive
+        if (new & AUTHORIZED_FLAG) and \
+                (new & AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG):
+            return False, 0, self.make_result(
+                SetTrustLineFlagsResultCode
+                .SET_TRUST_LINE_FLAGS_INVALID_STATE)
+        return True, new, None
+
+    def _no_trustline(self):
+        return self._fail(
+            SetTrustLineFlagsResultCode.SET_TRUST_LINE_FLAGS_NO_TRUST_LINE)
+
+    def _cant_revoke(self):
+        return self._fail(
+            SetTrustLineFlagsResultCode.SET_TRUST_LINE_FLAGS_CANT_REVOKE)
+
+    def _success(self):
+        return self.make_result(
+            SetTrustLineFlagsResultCode.SET_TRUST_LINE_FLAGS_SUCCESS)

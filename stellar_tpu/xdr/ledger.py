@@ -358,3 +358,45 @@ LedgerCloseMeta = Union("LedgerCloseMeta", Int32, {
     0: LedgerCloseMetaV0,
     1: LedgerCloseMetaV1,
 })
+
+
+# ---------------- bucket entries (state store) ----------------
+
+BucketEntryType = Enum("BucketEntryType", {
+    "METAENTRY": -1,
+    "LIVEENTRY": 0,
+    "DEADENTRY": 1,
+    "INITENTRY": 2,
+})
+
+BucketListType = Enum("BucketListType", {
+    "LIVE": 0,
+    "HOT_ARCHIVE": 1,
+})
+
+
+class BucketMetadata(Struct):
+    FIELDS = [("ledgerVersion", Uint32),
+              ("ext", Union("BucketMetadata.ext", Int32, {
+                  0: Void, 1: BucketListType}))]
+
+
+BucketEntry = Union("BucketEntry", BucketEntryType, {
+    BucketEntryType.LIVEENTRY: LedgerEntry,
+    BucketEntryType.INITENTRY: LedgerEntry,
+    BucketEntryType.DEADENTRY: LedgerKey,
+    BucketEntryType.METAENTRY: BucketMetadata,
+})
+
+HotArchiveBucketEntryType = Enum("HotArchiveBucketEntryType", {
+    "HOT_ARCHIVE_METAENTRY": -1,
+    "HOT_ARCHIVE_ARCHIVED": 0,
+    "HOT_ARCHIVE_LIVE": 1,
+})
+
+HotArchiveBucketEntry = Union(
+    "HotArchiveBucketEntry", HotArchiveBucketEntryType, {
+        HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED: LedgerEntry,
+        HotArchiveBucketEntryType.HOT_ARCHIVE_LIVE: LedgerKey,
+        HotArchiveBucketEntryType.HOT_ARCHIVE_METAENTRY: BucketMetadata,
+    })

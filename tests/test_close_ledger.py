@@ -46,7 +46,7 @@ def test_close_one_payment():
     assert lm.ledger_seq == 3
     assert res.header.scpValue.closeTime == 2000
     assert res.header.previousLedgerHash != b"\x00" * 32
-    assert res.header.bucketListHash == hash_store_state(lm.root.store)
+    assert res.header.bucketListHash == lm.bucket_list.hash()
 
 
 def test_txset_validation_and_wire_roundtrip():

@@ -1,0 +1,318 @@
+"""SetOptions / ChangeTrust / AllowTrust / SetTrustLineFlags /
+AccountMerge + credit-asset payment tests (reference
+``transactions/test/{SetOptions,ChangeTrust,AllowTrust,Merge,Payment}
+Tests.cpp`` behaviors)."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.asset_utils import trustline_key
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import (
+    AccountMergeResultCode, AllowTrustResultCode, ChangeTrustResultCode,
+    PaymentResultCode, SetOptionsResultCode, TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    AllowTrustOp, ChangeTrustAsset, ChangeTrustOp, Operation,
+    OperationBody, OperationType, SetOptionsOp, SetTrustLineFlagsOp,
+    muxed_account,
+)
+from stellar_tpu.xdr.types import (
+    AUTH_REQUIRED_FLAG, AUTH_REVOCABLE_FLAG, AUTHORIZED_FLAG, AssetCode,
+    AssetType, Signer, SignerKey, SignerKeyType, account_id,
+    asset_alphanum4,
+)
+
+XLM = 10_000_000
+
+
+def op(body_type, body, source=None):
+    return Operation(
+        sourceAccount=muxed_account(source.public_key.raw)
+        if source else None,
+        body=OperationBody.make(body_type, body))
+
+
+def change_trust_op(asset, limit, source=None):
+    line = ChangeTrustAsset.make(asset.arm, asset.value)
+    return op(OperationType.CHANGE_TRUST,
+              ChangeTrustOp(line=line, limit=limit), source)
+
+
+def set_options_op(source=None, **kw):
+    fields = dict(inflationDest=None, clearFlags=None, setFlags=None,
+                  masterWeight=None, lowThreshold=None, medThreshold=None,
+                  highThreshold=None, homeDomain=None, signer=None)
+    fields.update(kw)
+    return op(OperationType.SET_OPTIONS, SetOptionsOp(**fields), source)
+
+
+@pytest.fixture
+def env():
+    a, b, issuer = keypair("alice"), keypair("bob"), keypair("issuer")
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM), (issuer, 1000 * XLM)])
+    return root, a, b, issuer
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner_code(res, i=0):
+    return res.op_results[i].value.value.arm
+
+
+def seq_for(root, key, off=1):
+    e = root.store.get(key_bytes(account_key(account_id(key.public_key.raw))))
+    return e.data.value.seqNum + off
+
+
+def test_set_options_thresholds_and_home_domain(env):
+    root, a, _, _ = env
+    tx = make_tx(a, seq_for(root, a), [set_options_op(
+        masterWeight=5, lowThreshold=1, medThreshold=2, highThreshold=3,
+        homeDomain=b"example.com")])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    acc = e.data.value
+    assert acc.thresholds == bytes([5, 1, 2, 3])
+    assert acc.homeDomain == b"example.com"
+
+
+def test_set_options_add_update_remove_signer(env):
+    root, a, _, _ = env
+    co = keypair("cosigner")
+    sk = SignerKey.make(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        co.public_key.raw)
+    # add
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [set_options_op(
+        signer=Signer(key=sk, weight=10))]))
+    assert res.code == TC.txSUCCESS
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    assert e.data.value.signers[0].weight == 10
+    assert e.data.value.numSubEntries == 1
+    # update
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [set_options_op(
+        signer=Signer(key=sk, weight=20))]))
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    assert e.data.value.signers[0].weight == 20
+    assert e.data.value.numSubEntries == 1
+    # remove
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [set_options_op(
+        signer=Signer(key=sk, weight=0))]))
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    assert e.data.value.signers == []
+    assert e.data.value.numSubEntries == 0
+
+
+def test_set_options_self_signer_rejected(env):
+    root, a, _, _ = env
+    sk = SignerKey.make(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        a.public_key.raw)
+    tx = make_tx(a, seq_for(root, a), [set_options_op(
+        signer=Signer(key=sk, weight=1))])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == SetOptionsResultCode.SET_OPTIONS_BAD_SIGNER
+
+
+def test_set_options_requires_high_threshold(env):
+    root, a, _, _ = env
+    # raise high threshold to 2 while master weight stays 1
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [set_options_op(highThreshold=2)]))
+    # now further threshold changes can't be authorized by master alone
+    tx = make_tx(a, seq_for(root, a), [set_options_op(highThreshold=1)])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TC.txFAILED
+    from stellar_tpu.xdr.results import OperationResultCode
+    assert res.op_results[0].arm == OperationResultCode.opBAD_AUTH
+    # but a payment (MED=1) still works
+    b = keypair("bob")
+    tx2 = make_tx(a, seq_for(root, a), [payment_op(b, XLM)])
+    with LedgerTxn(root) as ltx:
+        assert tx2.check_valid(ltx).code == TC.txSUCCESS
+
+
+def test_change_trust_and_credit_payment(env):
+    root, a, b, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    # alice and bob trust the issuer
+    for k in (a, b):
+        res = apply_tx(root, make_tx(
+            k, seq_for(root, k), [change_trust_op(usd, 1000 * XLM)]))
+        assert res.code == TC.txSUCCESS, inner_code(res)
+    # issuer mints to alice (pays from issuing account)
+    res = apply_tx(root, make_tx(
+        issuer, seq_for(root, issuer),
+        [payment_op(a, 100 * XLM, asset=usd)]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    # alice pays bob in USD
+    res = apply_tx(root, make_tx(
+        a, seq_for(root, a), [payment_op(b, 40 * XLM, asset=usd)]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    tl_b = root.store.get(key_bytes(trustline_key(
+        account_id(b.public_key.raw), usd)))
+    assert tl_b.data.value.balance == 40 * XLM
+    # bob sends back to the issuer: credits burn
+    res = apply_tx(root, make_tx(
+        b, seq_for(root, b), [payment_op(issuer, 10 * XLM, asset=usd)]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    tl_b = root.store.get(key_bytes(trustline_key(
+        account_id(b.public_key.raw), usd)))
+    assert tl_b.data.value.balance == 30 * XLM
+
+
+def test_payment_no_trust_and_line_full(env):
+    root, a, b, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    # a has no trustline: issuer -> a fails NO_TRUST
+    res = apply_tx(root, make_tx(
+        issuer, seq_for(root, issuer), [payment_op(a, XLM, asset=usd)]))
+    assert inner_code(res) == PaymentResultCode.PAYMENT_NO_TRUST
+    # a trusts with tiny limit; overflow -> LINE_FULL
+    apply_tx(root, make_tx(a, seq_for(root, a), [change_trust_op(usd, 5)]))
+    res = apply_tx(root, make_tx(
+        issuer, seq_for(root, issuer), [payment_op(a, 6, asset=usd)]))
+    assert inner_code(res) == PaymentResultCode.PAYMENT_LINE_FULL
+
+
+def test_change_trust_delete_and_invalid_limit(env):
+    root, a, _, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [change_trust_op(usd, 100)]))
+    # mint 50 to alice
+    apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                           [payment_op(a, 50, asset=usd)]))
+    # can't set limit below balance
+    res = apply_tx(root, make_tx(a, seq_for(root, a),
+                                 [change_trust_op(usd, 40)]))
+    assert inner_code(res) == \
+        ChangeTrustResultCode.CHANGE_TRUST_INVALID_LIMIT
+    # send back, then delete
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [payment_op(issuer, 50, asset=usd)]))
+    res = apply_tx(root, make_tx(a, seq_for(root, a),
+                                 [change_trust_op(usd, 0)]))
+    assert res.code == TC.txSUCCESS
+    assert root.store.get(key_bytes(trustline_key(
+        account_id(a.public_key.raw), usd))) is None
+    e = root.store.get(key_bytes(account_key(account_id(a.public_key.raw))))
+    assert e.data.value.numSubEntries == 0
+
+
+def test_auth_required_and_allow_trust(env):
+    root, a, _, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    # issuer requires + can revoke auth
+    apply_tx(root, make_tx(issuer, seq_for(root, issuer), [set_options_op(
+        setFlags=AUTH_REQUIRED_FLAG | AUTH_REVOCABLE_FLAG)]))
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [change_trust_op(usd, 1000)]))
+    # unauthorized: payment from issuer fails
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                 [payment_op(a, 10, asset=usd)]))
+    assert inner_code(res) == PaymentResultCode.PAYMENT_NOT_AUTHORIZED
+    # allow trust
+    code4 = AssetCode.make(AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                           b"USD\x00")
+    allow = op(OperationType.ALLOW_TRUST, AllowTrustOp(
+        trustor=account_id(a.public_key.raw), asset=code4,
+        authorize=AUTHORIZED_FLAG))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [allow]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                 [payment_op(a, 10, asset=usd)]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    # revoke: works because issuer is AUTH_REVOCABLE
+    revoke = op(OperationType.ALLOW_TRUST, AllowTrustOp(
+        trustor=account_id(a.public_key.raw), asset=code4, authorize=0))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [revoke]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+
+
+def test_allow_trust_cant_revoke_without_flag(env):
+    root, a, _, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [change_trust_op(usd, 1000)]))
+    code4 = AssetCode.make(AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                           b"USD\x00")
+    revoke = op(OperationType.ALLOW_TRUST, AllowTrustOp(
+        trustor=account_id(a.public_key.raw), asset=code4, authorize=0))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [revoke]))
+    assert inner_code(res) == AllowTrustResultCode.ALLOW_TRUST_CANT_REVOKE
+
+
+def test_set_trust_line_flags(env):
+    root, a, _, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    apply_tx(root, make_tx(issuer, seq_for(root, issuer), [set_options_op(
+        setFlags=AUTH_REQUIRED_FLAG | AUTH_REVOCABLE_FLAG)]))
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [change_trust_op(usd, 1000)]))
+    stf = op(OperationType.SET_TRUST_LINE_FLAGS, SetTrustLineFlagsOp(
+        trustor=account_id(a.public_key.raw), asset=usd,
+        clearFlags=0, setFlags=AUTHORIZED_FLAG))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [stf]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    tl = root.store.get(key_bytes(trustline_key(
+        account_id(a.public_key.raw), usd)))
+    assert tl.data.value.flags & AUTHORIZED_FLAG
+
+
+def test_account_merge(env):
+    root, a, b, _ = env
+    merge = op(OperationType.ACCOUNT_MERGE,
+               muxed_account(b.public_key.raw).value
+               if False else None)
+    # build merge op properly: body is a MuxedAccount
+    from stellar_tpu.xdr.tx import OperationBody
+    merge = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.ACCOUNT_MERGE, muxed_account(b.public_key.raw)))
+    balance_before = 1000 * XLM
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [merge]))
+    assert res.code == TC.txSUCCESS, inner_code(res)
+    # a is gone, b absorbed a's balance minus the fee
+    assert root.store.get(
+        key_bytes(account_key(account_id(a.public_key.raw)))) is None
+    e = root.store.get(key_bytes(account_key(account_id(b.public_key.raw))))
+    assert e.data.value.balance == 2000 * XLM - 100
+    # merge result carries the transferred balance
+    assert res.op_results[0].value.value.value == balance_before - 100
+
+
+def test_account_merge_with_subentries_fails(env):
+    root, a, b, issuer = env
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    apply_tx(root, make_tx(a, seq_for(root, a),
+                           [change_trust_op(usd, 1000)]))
+    merge = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.ACCOUNT_MERGE, muxed_account(b.public_key.raw)))
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [merge]))
+    assert inner_code(res) == \
+        AccountMergeResultCode.ACCOUNT_MERGE_HAS_SUB_ENTRIES
+
+
+def test_account_merge_to_self_malformed(env):
+    root, a, _, _ = env
+    merge = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.ACCOUNT_MERGE, muxed_account(a.public_key.raw)))
+    tx = make_tx(a, seq_for(root, a), [merge])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == \
+        AccountMergeResultCode.ACCOUNT_MERGE_MALFORMED
