@@ -50,6 +50,17 @@ def level_should_spill(ledger: int, level: int) -> bool:
             ledger == round_down(ledger, level_size(level)))
 
 
+def should_merge_with_empty_curr(ledger: int, level: int) -> bool:
+    """True when level's curr will itself be snapped before the merge
+    being prepared now commits (reference
+    ``shouldMergeWithEmptyCurr``)."""
+    if level == 0:
+        return False
+    merge_start = round_down(ledger, level_half(level - 1))
+    next_change = merge_start + level_half(level - 1)
+    return level_should_spill(next_change, level)
+
+
 class BucketLevel:
     __slots__ = ("level", "curr", "snap", "next")
 
@@ -79,11 +90,15 @@ class BucketLevel:
             self.next = None
 
     def prepare(self, incoming_snap: Bucket, protocol_version: int,
-                keep_tombstones: bool):
+                keep_tombstones: bool, merge_with_empty_curr: bool):
         """Start (here: compute) the merge of the level above's snap
-        into this level's curr; visible at the next commit."""
-        self.next = merge_buckets(self.curr, incoming_snap,
-                                  protocol_version,
+        into this level's curr; visible at the next commit. When this
+        level's own curr will be snapped away before that commit, merge
+        into an empty curr instead (reference
+        ``shouldMergeWithEmptyCurr`` — otherwise the same contents would
+        live at two levels)."""
+        base = EMPTY if merge_with_empty_curr else self.curr
+        self.next = merge_buckets(base, incoming_snap, protocol_version,
                                   keep_tombstones=keep_tombstones)
 
 
@@ -115,13 +130,16 @@ class LiveBucketList:
                 self.levels[i].commit()
                 self.levels[i].prepare(
                     spilled, protocol_version,
-                    keep_tombstones=(i < NUM_LEVELS - 1))
+                    keep_tombstones=(i < NUM_LEVELS - 1),
+                    merge_with_empty_curr=should_merge_with_empty_curr(
+                        current_ledger, i))
         # level 0 accumulates each ledger's batch into curr immediately
         # (reference: prepare(fresh) then commit in the same call)
         self.levels[0].prepare(
             fresh_bucket(protocol_version, init_entries, live_entries,
                          dead_keys),
-            protocol_version, keep_tombstones=True)
+            protocol_version, keep_tombstones=True,
+            merge_with_empty_curr=False)
         self.levels[0].commit()
 
     # ---------------- lookups (the BucketListDB role) ----------------

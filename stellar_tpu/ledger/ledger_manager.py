@@ -93,6 +93,17 @@ class LedgerManager:
             from stellar_tpu.bucket.bucket_list import LiveBucketList
             bucket_list = LiveBucketList()
         self.bucket_list = bucket_list or None
+        # a pre-seeded store becomes the genesis batch so the bucket
+        # list covers ALL state, not just post-construction deltas
+        if self.bucket_list is not None and self.root.store.entries and \
+                self.bucket_list.total_entry_count() == 0:
+            from stellar_tpu.xdr.runtime import from_bytes as _fb
+            from stellar_tpu.xdr.types import LedgerEntry as _LE
+            seeded = [_fb(_LE, raw)
+                      for raw in self.root.store.entries.values()]
+            hdr = self.root.header()
+            self.bucket_list.add_batch(
+                max(1, hdr.ledgerSeq), hdr.ledgerVersion, seeded, [], [])
         self._lcl_hash = ledger_header_hash(self.root.header())
         self.close_meta_stream: List = []  # downstream consumers hook
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from stellar_tpu.ledger.ledger_txn import LedgerTxn
 from stellar_tpu.tx.account_utils import (
-    add_num_entries, get_buying_liabilities,
+    INT64_MAX, add_num_entries, get_buying_liabilities,
 )
 from stellar_tpu.tx.asset_utils import (
     get_issuer, is_asset_code_valid, is_asset_valid, is_native,
@@ -36,7 +36,7 @@ from stellar_tpu.xdr.types import (
     TrustLineEntry,
 )
 
-INT64_MAX = 0x7FFFFFFFFFFFFFFF
+
 TRUST_AUTH_FLAGS = (AUTHORIZED_FLAG |
                     AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG)
 
@@ -151,11 +151,19 @@ class _TrustFlagsBase(OperationFrame):
     def _fail(self, code):
         return False, self.make_result(code)
 
+    def pre_trustline_revocation_check(self, auth_revocable: bool):
+        """Hook: failure result if revocation is invalid before even
+        loading the trustline (AllowTrust's authorize==0 rule)."""
+        return None
+
     def do_apply(self, outer):
         src_id = self.source_account_id()
         with LedgerTxn(outer) as ltx:
             src = ltx.load_without_record(account_key(src_id))
             auth_revocable = is_auth_revocable(src.data.value)
+            early_fail = self.pre_trustline_revocation_check(auth_revocable)
+            if early_fail is not None:
+                return False, early_fail
             key = trustline_key(self.trustor(), self.op_asset())
             h = ltx.load(key)
             if h is None:
@@ -210,6 +218,15 @@ class AllowTrustOpFrame(_TrustFlagsBase):
             return False, self.make_result(
                 Code.ALLOW_TRUST_SELF_NOT_ALLOWED)
         return True, None
+
+    def pre_trustline_revocation_check(self, auth_revocable: bool):
+        # reference AllowTrustOpFrame::isAuthRevocationValid: a full
+        # revocation from a non-revocable issuer fails before the
+        # trustline is consulted
+        if not auth_revocable and self.body.authorize == 0:
+            return self.make_result(
+                AllowTrustResultCode.ALLOW_TRUST_CANT_REVOKE)
+        return None
 
     def _expected_flags(self, cur_flags: int):
         new = (cur_flags & ~TRUST_AUTH_FLAGS) | self.body.authorize

@@ -1,0 +1,343 @@
+"""Offer / order-book tests (reference ``transactions/test/OfferTests.cpp``
+and ``ExchangeTests.cpp`` behaviors: exchange rounding, book crossing,
+partial fills, passive offers, path payments through the book)."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx import offer_exchange as ox
+from stellar_tpu.tx.asset_utils import trustline_key
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import (
+    ManageOfferEffect, ManageSellOfferResultCode, PaymentResultCode,
+    TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    ChangeTrustAsset, ChangeTrustOp, ManageBuyOfferOp, ManageSellOfferOp,
+    Operation, OperationBody, OperationType, PathPaymentStrictReceiveOp,
+    muxed_account,
+)
+from stellar_tpu.xdr.types import (
+    LedgerEntryType, NATIVE_ASSET, Price, account_id, asset_alphanum4,
+)
+
+XLM = 10_000_000
+
+
+def price(n, d):
+    return Price(n=n, d=d)
+
+
+def op(t, body, source=None):
+    return Operation(
+        sourceAccount=muxed_account(source.public_key.raw)
+        if source else None,
+        body=OperationBody.make(t, body))
+
+
+def sell_offer_op(selling, buying, amount, p, offer_id=0, source=None):
+    return op(OperationType.MANAGE_SELL_OFFER, ManageSellOfferOp(
+        selling=selling, buying=buying, amount=amount, price=p,
+        offerID=offer_id), source)
+
+
+def buy_offer_op(selling, buying, buy_amount, p, offer_id=0, source=None):
+    return op(OperationType.MANAGE_BUY_OFFER, ManageBuyOfferOp(
+        selling=selling, buying=buying, buyAmount=buy_amount, price=p,
+        offerID=offer_id), source)
+
+
+def change_trust(asset, limit=10**15):
+    return op(OperationType.CHANGE_TRUST, ChangeTrustOp(
+        line=ChangeTrustAsset.make(asset.arm, asset.value), limit=limit))
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner(res, i=0):
+    return res.op_results[i].value.value
+
+
+def seq_for(root, key):
+    e = root.store.get(key_bytes(account_key(
+        account_id(key.public_key.raw))))
+    return e.data.value.seqNum + 1
+
+
+@pytest.fixture
+def market():
+    issuer = keypair("issuer")
+    maker, taker = keypair("maker"), keypair("taker")
+    root = seed_root_with_accounts(
+        [(issuer, 10_000 * XLM), (maker, 10_000 * XLM),
+         (taker, 10_000 * XLM)])
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    for k in (maker, taker):
+        assert apply_tx(root, make_tx(
+            k, seq_for(root, k), [change_trust(usd)])).is_success
+    # issuer funds both with USD
+    assert apply_tx(root, make_tx(
+        issuer, seq_for(root, issuer),
+        [payment_op(maker, 1000 * XLM, asset=usd),
+         payment_op(taker, 1000 * XLM, asset=usd)])).is_success
+    return root, issuer, maker, taker, usd
+
+
+# ---------------- exchange math ----------------
+
+
+def test_exchange_v10_exact_small():
+    # price 3/2: taker wants 10 wheat; maker has plenty
+    wr, ss, stays = ox.exchange_v10(price(3, 2), 100, 10, 10**9, 10**9,
+                                    ox.ROUND_NORMAL)
+    assert stays
+    assert wr == 10 and ss == 15  # 10 * 3/2
+
+
+def test_exchange_rounding_favors_stayer():
+    # price 3/7 (wheat cheap); odd limits force rounding
+    wr, ss, stays = ox.exchange_v10(price(3, 7), 101, 100, 10**9, 10**9,
+                                    ox.ROUND_NORMAL)
+    # effective price paid must be >= price when wheat stays
+    if stays and wr:
+        assert ss * 7 >= wr * 3
+    # conservation bounds
+    assert 0 <= wr <= 100
+
+
+def test_adjust_offer_idempotent():
+    for n, d in ((3, 2), (2, 3), (7, 11), (1, 1)):
+        p = price(n, d)
+        a1 = ox.adjust_offer_amount(p, 1000, 1500)
+        a2 = ox.adjust_offer_amount(p, a1, 1500)
+        assert a1 == a2
+
+
+def test_offer_liabilities_shape():
+    selling, buying = ox.offer_liabilities(price(2, 1), 100)
+    assert selling == 100
+    assert buying == 200
+
+
+# ---------------- manage offer ----------------
+
+
+def test_create_offer_books_and_tracks_liabilities(market):
+    root, issuer, maker, taker, usd = market
+    # maker sells 100 XLM for USD at 2 USD/XLM
+    res = apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    assert res.is_success, inner(res).arm
+    succ = inner(res).value
+    assert succ.offer.arm == ManageOfferEffect.MANAGE_OFFER_CREATED
+    oid = succ.offer.value.offerID
+    assert oid == 1
+    # offer entry exists; subentry + liabilities tracked
+    acc = root.store.get(key_bytes(account_key(
+        account_id(maker.public_key.raw)))).data.value
+    assert acc.numSubEntries == 2  # trustline + offer
+    assert acc.ext.arm == 1
+    assert acc.ext.value.liabilities.selling == 100 * XLM
+    tl = root.store.get(key_bytes(trustline_key(
+        account_id(maker.public_key.raw), usd))).data.value
+    assert tl.ext.arm == 1
+    assert tl.ext.value.liabilities.buying == 200 * XLM
+
+
+def test_cross_exact_fill(market):
+    root, issuer, maker, taker, usd = market
+    # maker: sell 100 XLM @ 2 USD/XLM
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    maker_xlm_before = root.store.get(key_bytes(account_key(
+        account_id(maker.public_key.raw)))).data.value.balance
+    # taker: sell 200 USD @ 0.5 XLM/USD -> crosses fully
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        sell_offer_op(usd, NATIVE_ASSET, 200 * XLM, price(1, 2))]))
+    assert res.is_success, inner(res).arm
+    succ = inner(res).value
+    assert succ.offer.arm == ManageOfferEffect.MANAGE_OFFER_DELETED
+    assert len(succ.offersClaimed) == 1
+    atom = succ.offersClaimed[0].value
+    assert atom.amountSold == 100 * XLM       # maker sold XLM
+    assert atom.amountBought == 200 * XLM     # maker bought USD
+    # the book is empty now
+    with LedgerTxn(root) as ltx:
+        assert ox.load_best_offer(ltx, NATIVE_ASSET, usd) is None
+        ltx.rollback()
+    # balances moved
+    maker_acc = root.store.get(key_bytes(account_key(
+        account_id(maker.public_key.raw)))).data.value
+    assert maker_acc.balance == maker_xlm_before - 100 * XLM
+    assert maker_acc.ext.value.liabilities.selling == 0
+    taker_tl = root.store.get(key_bytes(trustline_key(
+        account_id(taker.public_key.raw), usd))).data.value
+    assert taker_tl.balance == 800 * XLM  # 1000 - 200 sold
+
+
+def test_partial_fill_keeps_remainder(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    # taker only buys 40 XLM worth (sells 80 USD)
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        sell_offer_op(usd, NATIVE_ASSET, 80 * XLM, price(1, 2))]))
+    assert res.is_success
+    succ = inner(res).value
+    assert succ.offer.arm == ManageOfferEffect.MANAGE_OFFER_DELETED
+    # maker's offer partially consumed: 60 XLM left
+    with LedgerTxn(root) as ltx:
+        o = ox.load_best_offer(ltx, NATIVE_ASSET, usd)
+        assert o is not None and o.amount == 60 * XLM
+        ltx.rollback()
+
+
+def test_no_cross_bad_price_books_both(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    # taker bids too low: wants 1 XLM per 1 USD (maker asks 2)
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        sell_offer_op(usd, NATIVE_ASSET, 50 * XLM, price(1, 1))]))
+    assert res.is_success
+    succ = inner(res).value
+    assert succ.offer.arm == ManageOfferEffect.MANAGE_OFFER_CREATED
+    assert succ.offersClaimed == []
+    with LedgerTxn(root) as ltx:
+        assert ox.load_best_offer(ltx, NATIVE_ASSET, usd) is not None
+        assert ox.load_best_offer(ltx, usd, NATIVE_ASSET) is not None
+        ltx.rollback()
+
+
+def test_buy_offer_equivalent(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    # taker buys 100 XLM paying USD at up to 2 USD/XLM
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        buy_offer_op(usd, NATIVE_ASSET, 100 * XLM, price(2, 1))]))
+    assert res.is_success, inner(res).arm
+    succ = inner(res).value
+    assert len(succ.offersClaimed) == 1
+    assert succ.offersClaimed[0].value.amountSold == 100 * XLM
+
+
+def test_update_and_delete_offer(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    # update amount
+    res = apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 50 * XLM, price(2, 1),
+                      offer_id=1)]))
+    assert res.is_success
+    assert inner(res).value.offer.arm == \
+        ManageOfferEffect.MANAGE_OFFER_UPDATED
+    with LedgerTxn(root) as ltx:
+        assert ox.load_best_offer(ltx, NATIVE_ASSET, usd).amount == 50 * XLM
+        ltx.rollback()
+    # delete
+    res = apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 0, price(2, 1), offer_id=1)]))
+    assert res.is_success
+    assert inner(res).value.offer.arm == \
+        ManageOfferEffect.MANAGE_OFFER_DELETED
+    acc = root.store.get(key_bytes(account_key(
+        account_id(maker.public_key.raw)))).data.value
+    assert acc.numSubEntries == 1  # just the trustline
+    assert (acc.ext.arm == 0 or
+            acc.ext.value.liabilities.selling == 0)
+
+
+def test_delete_missing_offer(market):
+    root, issuer, maker, taker, usd = market
+    res = apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 10 * XLM, price(2, 1),
+                      offer_id=99)]))
+    assert inner(res).arm == \
+        ManageSellOfferResultCode.MANAGE_SELL_OFFER_NOT_FOUND
+
+
+def test_cross_self_rejected(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    res = apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(usd, NATIVE_ASSET, 100 * XLM, price(1, 2))]))
+    assert inner(res).arm == \
+        ManageSellOfferResultCode.MANAGE_SELL_OFFER_CROSS_SELF
+
+
+def test_passive_offer_does_not_cross_equal_price(market):
+    root, issuer, maker, taker, usd = market
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(1, 1))]))
+    from stellar_tpu.xdr.tx import CreatePassiveSellOfferOp
+    passive = op(OperationType.CREATE_PASSIVE_SELL_OFFER,
+                 CreatePassiveSellOfferOp(
+                     selling=usd, buying=NATIVE_ASSET, amount=50 * XLM,
+                     price=price(1, 1)))
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [passive]))
+    assert res.is_success
+    succ = inner(res).value
+    assert succ.offersClaimed == []  # equal price not crossed
+    assert succ.offer.arm == ManageOfferEffect.MANAGE_OFFER_CREATED
+
+
+def test_path_payment_through_book(market):
+    root, issuer, maker, taker, usd = market
+    # maker sells XLM for USD: 100 XLM @ 2 USD each
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 100 * XLM, price(2, 1))]))
+    # taker pays bob 10 XLM, funding it with USD (strict receive)
+    bob = keypair("bob-recipient")
+    from stellar_tpu.tx.tx_test_utils import create_account_op
+    apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        create_account_op(bob, 100 * XLM)]))
+    pp = op(OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+            PathPaymentStrictReceiveOp(
+                sendAsset=usd, sendMax=30 * XLM,
+                destination=muxed_account(bob.public_key.raw),
+                destAsset=NATIVE_ASSET, destAmount=10 * XLM, path=[]))
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [pp]))
+    assert res.is_success, inner(res).arm
+    succ = inner(res).value
+    assert len(succ.offers) == 1
+    assert succ.offers[0].value.amountSold == 10 * XLM  # XLM from maker
+    assert succ.offers[0].value.amountBought == 20 * XLM  # USD paid
+    bob_acc = root.store.get(key_bytes(account_key(
+        account_id(bob.public_key.raw)))).data.value
+    assert bob_acc.balance == 110 * XLM
+
+
+def test_order_book_price_priority(market):
+    root, issuer, maker, taker, usd = market
+    # two makers at different prices
+    maker2 = keypair("maker2")
+    from stellar_tpu.tx.tx_test_utils import create_account_op
+    apply_tx(root, make_tx(issuer, seq_for(root, issuer), [
+        create_account_op(maker2, 1000 * XLM)]))
+    apply_tx(root, make_tx(maker2, seq_for(root, maker2),
+                           [change_trust(usd)]))
+    apply_tx(root, make_tx(maker, seq_for(root, maker), [
+        sell_offer_op(NATIVE_ASSET, usd, 50 * XLM, price(3, 1))]))
+    apply_tx(root, make_tx(maker2, seq_for(root, maker2), [
+        sell_offer_op(NATIVE_ASSET, usd, 50 * XLM, price(2, 1))]))
+    # taker hits the book: cheaper (maker2's price 2) must fill first
+    res = apply_tx(root, make_tx(taker, seq_for(root, taker), [
+        buy_offer_op(usd, NATIVE_ASSET, 50 * XLM, price(3, 1))]))
+    assert res.is_success, inner(res).arm
+    succ = inner(res).value
+    assert len(succ.offersClaimed) == 1
+    assert succ.offersClaimed[0].value.sellerID == \
+        account_id(maker2.public_key.raw)
+    assert succ.offersClaimed[0].value.amountBought == 100 * XLM
