@@ -1,0 +1,189 @@
+"""Claimable balance + clawback tests (reference
+``transactions/test/ClaimableBalanceTests.cpp`` /
+``ClawbackTests.cpp`` behaviors)."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.asset_utils import trustline_key
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.ops.claimable_balances import claimable_balance_key
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import (
+    ClaimClaimableBalanceResultCode as ClaimCode,
+    ClawbackResultCode, CreateClaimableBalanceResultCode as CBCode,
+    TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    ChangeTrustAsset, ChangeTrustOp, ClaimClaimableBalanceOp, ClawbackOp,
+    CreateClaimableBalanceOp, Operation, OperationBody, OperationType,
+    SetOptionsOp, muxed_account,
+)
+from stellar_tpu.xdr.types import (
+    AUTH_CLAWBACK_ENABLED_FLAG, AUTH_REVOCABLE_FLAG, Claimant, ClaimantV0,
+    ClaimPredicate, ClaimPredicateType, NATIVE_ASSET, account_id,
+    asset_alphanum4,
+)
+
+XLM = 10_000_000
+PT = ClaimPredicateType
+
+
+def op(t, body, source=None):
+    return Operation(
+        sourceAccount=muxed_account(source.public_key.raw)
+        if source else None,
+        body=OperationBody.make(t, body))
+
+
+def unconditional():
+    return ClaimPredicate.make(PT.CLAIM_PREDICATE_UNCONDITIONAL)
+
+
+def before_abs(t):
+    return ClaimPredicate.make(PT.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME, t)
+
+
+def claimant(key, predicate=None):
+    return Claimant.make(0, ClaimantV0(
+        destination=account_id(key.public_key.raw),
+        predicate=predicate if predicate is not None else unconditional()))
+
+
+def create_cb_op(asset, amount, claimants):
+    return op(OperationType.CREATE_CLAIMABLE_BALANCE,
+              CreateClaimableBalanceOp(asset=asset, amount=amount,
+                                       claimants=claimants))
+
+
+def claim_cb_op(balance_id):
+    return op(OperationType.CLAIM_CLAIMABLE_BALANCE,
+              ClaimClaimableBalanceOp(balanceID=balance_id))
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner(res, i=0):
+    return res.op_results[i].value.value
+
+
+def seq_for(root, key):
+    e = root.store.get(key_bytes(account_key(
+        account_id(key.public_key.raw))))
+    return e.data.value.seqNum + 1
+
+
+@pytest.fixture
+def env():
+    a, b = keypair("alice"), keypair("bob")
+    root = seed_root_with_accounts([(a, 1000 * XLM), (b, 1000 * XLM)])
+    return root, a, b
+
+
+def test_create_and_claim_native(env):
+    root, a, b = env
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        create_cb_op(NATIVE_ASSET, 50 * XLM, [claimant(b)])]))
+    assert res.is_success, inner(res).arm
+    balance_id = inner(res).value
+    # entry exists, sponsored by a
+    cb = root.store.get(key_bytes(claimable_balance_key(balance_id)))
+    assert cb is not None and cb.data.value.amount == 50 * XLM
+    acc_a = root.store.get(key_bytes(account_key(
+        account_id(a.public_key.raw)))).data.value
+    assert acc_a.ext.value.ext.value.numSponsoring == 1
+
+    res = apply_tx(root, make_tx(b, seq_for(root, b), [
+        claim_cb_op(balance_id)]))
+    assert res.is_success, inner(res).arm
+    assert root.store.get(key_bytes(
+        claimable_balance_key(balance_id))) is None
+    acc_b = root.store.get(key_bytes(account_key(
+        account_id(b.public_key.raw)))).data.value
+    assert acc_b.balance == 1050 * XLM - 100  # minus the claim fee
+    acc_a = root.store.get(key_bytes(account_key(
+        account_id(a.public_key.raw)))).data.value
+    assert acc_a.ext.value.ext.value.numSponsoring == 0
+
+
+def test_claim_wrong_claimant_or_expired(env):
+    root, a, b = env
+    mallory = keypair("mallory")
+    from stellar_tpu.tx.tx_test_utils import create_account_op
+    apply_tx(root, make_tx(a, seq_for(root, a), [
+        create_account_op(mallory, 100 * XLM)]))
+    # expires before close time 1001 (root seeded close_time=1000)
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        create_cb_op(NATIVE_ASSET, 10 * XLM,
+                     [claimant(b, before_abs(900))])]))
+    balance_id = inner(res).value
+    # wrong claimant
+    res = apply_tx(root, make_tx(mallory, seq_for(root, mallory), [
+        claim_cb_op(balance_id)]))
+    assert inner(res).arm == \
+        ClaimCode.CLAIM_CLAIMABLE_BALANCE_CANNOT_CLAIM
+    # right claimant but predicate (before t=900) no longer satisfiable
+    res = apply_tx(root, make_tx(b, seq_for(root, b), [
+        claim_cb_op(balance_id)]))
+    assert inner(res).arm == \
+        ClaimCode.CLAIM_CLAIMABLE_BALANCE_CANNOT_CLAIM
+
+
+def test_create_malformed(env):
+    root, a, b = env
+    # duplicate claimants
+    tx = make_tx(a, seq_for(root, a), [
+        create_cb_op(NATIVE_ASSET, XLM, [claimant(b), claimant(b)])])
+    with LedgerTxn(root) as ltx:
+        res = tx.check_valid(ltx)
+    assert inner(res).arm == CBCode.CREATE_CLAIMABLE_BALANCE_MALFORMED
+
+
+def test_clawback_flow(env):
+    root, a, b = env
+    issuer = keypair("cb-issuer")
+    from stellar_tpu.tx.tx_test_utils import create_account_op
+    apply_tx(root, make_tx(a, seq_for(root, a), [
+        create_account_op(issuer, 100 * XLM)]))
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    # issuer enables clawback (requires revocable)
+    so = op(OperationType.SET_OPTIONS, SetOptionsOp(
+        inflationDest=None, clearFlags=None,
+        setFlags=AUTH_CLAWBACK_ENABLED_FLAG | AUTH_REVOCABLE_FLAG,
+        masterWeight=None, lowThreshold=None, medThreshold=None,
+        highThreshold=None, homeDomain=None, signer=None))
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                  [so])).is_success
+    ct = op(OperationType.CHANGE_TRUST, ChangeTrustOp(
+        line=ChangeTrustAsset.make(usd.arm, usd.value), limit=10**15))
+    assert apply_tx(root, make_tx(b, seq_for(root, b), [ct])).is_success
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer), [
+        payment_op(b, 100 * XLM, asset=usd)])).is_success
+    # trustline carries the clawback flag
+    tl = root.store.get(key_bytes(trustline_key(
+        account_id(b.public_key.raw), usd))).data.value
+    from stellar_tpu.xdr.types import TRUSTLINE_CLAWBACK_ENABLED_FLAG
+    assert tl.flags & TRUSTLINE_CLAWBACK_ENABLED_FLAG
+    # issuer claws back 40
+    cb = op(OperationType.CLAWBACK, ClawbackOp(
+        asset=usd, from_=muxed_account(b.public_key.raw),
+        amount=40 * XLM))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [cb]))
+    assert res.is_success, inner(res).arm
+    tl = root.store.get(key_bytes(trustline_key(
+        account_id(b.public_key.raw), usd))).data.value
+    assert tl.balance == 60 * XLM
+    # clawing back more than held -> UNDERFUNDED
+    cb2 = op(OperationType.CLAWBACK, ClawbackOp(
+        asset=usd, from_=muxed_account(b.public_key.raw),
+        amount=100 * XLM))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [cb2]))
+    assert inner(res).arm == ClawbackResultCode.CLAWBACK_UNDERFUNDED
