@@ -1,0 +1,284 @@
+"""History archives: checkpoint publish + retrieval (reference
+``src/history/`` — ``HistoryManagerImpl``, ``CheckpointBuilder``,
+``HistoryArchive``, ``StateSnapshot``; file layout per
+``history/readme.md``).
+
+Every 64 ledgers a checkpoint is cut: gzipped XDR streams of ledger
+headers, tx sets, and tx results for the checkpoint range, the bucket
+files referenced by the current bucket list, and a JSON
+``HistoryArchiveState`` (HAS) manifest — enough for any node to rebuild
+state via catchup. Archive paths are layered by the checkpoint number's
+hex prefix exactly like the reference so real archive layouts round
+trip. The transport here is a local filesystem archive; command-template
+get/put subprocesses (curl/aws) layer on via the process manager.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import struct
+from typing import Dict, List, Optional
+
+from stellar_tpu.xdr.ledger import (
+    GeneralizedTransactionSet, LedgerHeaderHistoryEntry,
+    TransactionHistoryEntry, TransactionHistoryResultEntry, TransactionSet,
+)
+from stellar_tpu.xdr.results import TransactionResultSet
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+
+__all__ = [
+    "CHECKPOINT_FREQUENCY", "checkpoint_containing", "is_last_in_checkpoint",
+    "first_in_checkpoint", "FileArchive", "HistoryArchiveState",
+    "CheckpointBuilder", "HistoryManager",
+]
+
+CHECKPOINT_FREQUENCY = 64  # reference HistoryManager.h:52-58
+HAS_VERSION = 1
+
+
+def checkpoint_containing(ledger: int) -> int:
+    """Last ledger of the checkpoint containing ``ledger`` (reference
+    ``checkpointContainingLedger``). Checkpoints end at 63, 127, ..."""
+    return (ledger // CHECKPOINT_FREQUENCY) * CHECKPOINT_FREQUENCY + \
+        CHECKPOINT_FREQUENCY - 1
+
+
+def is_last_in_checkpoint(ledger: int) -> bool:
+    return ledger == checkpoint_containing(ledger)
+
+
+def first_in_checkpoint(checkpoint: int) -> int:
+    return max(1, checkpoint - CHECKPOINT_FREQUENCY + 1)
+
+
+def _layered_path(category: str, checkpoint: int, ext: str) -> str:
+    """category/ww/xx/yy/category-wwxxyyzz.ext (reference
+    ``HistoryArchiveState::remoteName`` layout)."""
+    hexseq = f"{checkpoint:08x}"
+    return (f"{category}/{hexseq[0:2]}/{hexseq[2:4]}/{hexseq[4:6]}/"
+            f"{category}-{hexseq}.{ext}")
+
+
+def _records(frames: List[bytes]) -> bytes:
+    return b"".join(struct.pack(">I", 0x80000000 | len(x)) + x
+                    for x in frames)
+
+
+def _unrecords(raw: bytes) -> List[bytes]:
+    out = []
+    pos = 0
+    while pos < len(raw):
+        (marked,) = struct.unpack_from(">I", raw, pos)
+        n = marked & 0x7FFFFFFF
+        pos += 4
+        out.append(raw[pos:pos + n])
+        pos += n
+    return out
+
+
+class FileArchive:
+    """Local-directory archive with get/put (the reference's archives
+    are get/put command templates; a directory IS the simplest one)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, rel: str, data: bytes):
+        path = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def get(self, rel: str) -> Optional[bytes]:
+        path = os.path.join(self.root, rel)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class HistoryArchiveState:
+    """The JSON "HAS" manifest (reference ``HistoryArchiveState``)."""
+
+    def __init__(self, current_ledger: int, network_passphrase: str,
+                 bucket_hashes: List[Dict[str, str]]):
+        self.version = HAS_VERSION
+        self.current_ledger = current_ledger
+        self.network_passphrase = network_passphrase
+        self.bucket_hashes = bucket_hashes  # [{"curr": hex, "snap": hex}]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "server": "stellar_tpu",
+            "currentLedger": self.current_ledger,
+            "networkPassphrase": self.network_passphrase,
+            "currentBuckets": self.bucket_hashes,
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "HistoryArchiveState":
+        d = json.loads(raw)
+        return cls(d["currentLedger"], d.get("networkPassphrase", ""),
+                   d["currentBuckets"])
+
+    def all_bucket_hashes(self) -> List[str]:
+        out = []
+        for lev in self.bucket_hashes:
+            out.append(lev["curr"])
+            out.append(lev["snap"])
+            if lev.get("next"):
+                out.append(lev["next"])
+        return out
+
+
+class CheckpointBuilder:
+    """Accumulates one checkpoint's ledgers (reference
+    ``CheckpointBuilder`` — the reference streams to .dirty files for
+    crash safety; we accumulate and write atomically at publish)."""
+
+    def __init__(self):
+        self.headers: List[LedgerHeaderHistoryEntry] = []
+        self.tx_sets: List[TransactionHistoryEntry] = []
+        self.results: List[TransactionHistoryResultEntry] = []
+
+    def append(self, header_entry, tx_entry, result_entry):
+        self.headers.append(header_entry)
+        self.tx_sets.append(tx_entry)
+        self.results.append(result_entry)
+
+    def clear(self):
+        self.headers.clear()
+        self.tx_sets.clear()
+        self.results.clear()
+
+
+class HistoryManager:
+    """Publish side (reference ``HistoryManagerImpl``): observe closes,
+    cut checkpoints, push to archives."""
+
+    def __init__(self, archives: List[FileArchive],
+                 network_passphrase: str = ""):
+        self.archives = archives
+        self.network_passphrase = network_passphrase
+        self.builder = CheckpointBuilder()
+        self.published_checkpoints: List[int] = []
+
+    # ---------------- per-close hook ----------------
+
+    def ledger_closed(self, close_result, tx_set, bucket_list=None):
+        """Record one closed ledger; publish when the checkpoint is
+        full. ``close_result`` is LedgerManager's CloseLedgerResult."""
+        header = close_result.header
+        hhe = LedgerHeaderHistoryEntry(
+            hash=close_result.header_hash, header=header,
+            ext=LedgerHeaderHistoryEntry._types[2].make(0))
+        the = TransactionHistoryEntry(
+            ledgerSeq=header.ledgerSeq,
+            txSet=TransactionSet(previousLedgerHash=header.previousLedgerHash,
+                                 txs=[]),
+            ext=TransactionHistoryEntry._types[2].make(1, tx_set.xdr))
+        rset = TransactionResultSet(results=[
+            _pair(f, r) for f, r in zip(
+                tx_set.get_txs_in_apply_order(), close_result.tx_results)])
+        tre = TransactionHistoryResultEntry(
+            ledgerSeq=header.ledgerSeq, txResultSet=rset,
+            ext=TransactionHistoryResultEntry._types[2].make(0))
+        self.builder.append(hhe, the, tre)
+        if is_last_in_checkpoint(header.ledgerSeq):
+            self.publish_checkpoint(header.ledgerSeq, bucket_list)
+
+    # ---------------- publish ----------------
+
+    def publish_checkpoint(self, checkpoint: int, bucket_list=None):
+        files = {
+            _layered_path("ledger", checkpoint, "xdr.gz"): gzip.compress(
+                _records([to_bytes(LedgerHeaderHistoryEntry, h)
+                          for h in self.builder.headers])),
+            _layered_path("transactions", checkpoint, "xdr.gz"):
+                gzip.compress(_records(
+                    [to_bytes(TransactionHistoryEntry, t)
+                     for t in self.builder.tx_sets])),
+            _layered_path("results", checkpoint, "xdr.gz"): gzip.compress(
+                _records([to_bytes(TransactionHistoryResultEntry, r)
+                          for r in self.builder.results])),
+        }
+        bucket_hashes = []
+        buckets = {}
+        if bucket_list is not None:
+            for lev in bucket_list.levels:
+                # "next" is the prepared-but-uncommitted merge — part of
+                # the state sequence, so the HAS must carry it (the
+                # reference stores the FutureBucket state the same way)
+                nxt = lev.next
+                bucket_hashes.append({
+                    "curr": lev.curr.hash.hex(),
+                    "snap": lev.snap.hash.hex(),
+                    "next": nxt.hash.hex() if nxt is not None else "",
+                })
+                for b in (lev.curr, lev.snap, nxt):
+                    if b is not None and not b.is_empty():
+                        buckets[b.hash.hex()] = b
+        has = HistoryArchiveState(checkpoint, self.network_passphrase,
+                                  bucket_hashes)
+        has_json = has.to_json().encode()
+        files[_layered_path("history", checkpoint, "json")] = has_json
+        for hexhash, bucket in buckets.items():
+            rel = (f"bucket/{hexhash[0:2]}/{hexhash[2:4]}/{hexhash[4:6]}/"
+                   f"bucket-{hexhash}.xdr.gz")
+            files[rel] = gzip.compress(bucket.serialize())
+        for archive in self.archives:
+            for rel, data in files.items():
+                archive.put(rel, data)
+            archive.put(".well-known/stellar-history.json", has_json)
+        self.published_checkpoints.append(checkpoint)
+        self.builder.clear()
+
+    # ---------------- retrieval (consumer side) ----------------
+
+    @staticmethod
+    def get_root_has(archive: FileArchive) -> Optional[HistoryArchiveState]:
+        raw = archive.get(".well-known/stellar-history.json")
+        return None if raw is None else \
+            HistoryArchiveState.from_json(raw.decode())
+
+    @staticmethod
+    def get_checkpoint(archive: FileArchive, checkpoint: int):
+        """(headers, tx_entries, result_entries) for one checkpoint, or
+        None if absent."""
+        def load(category, t):
+            raw = archive.get(_layered_path(category, checkpoint, "xdr.gz"))
+            if raw is None:
+                return None
+            return [from_bytes(t, x)
+                    for x in _unrecords(gzip.decompress(raw))]
+        headers = load("ledger", LedgerHeaderHistoryEntry)
+        txs = load("transactions", TransactionHistoryEntry)
+        results = load("results", TransactionHistoryResultEntry)
+        if headers is None:
+            return None
+        return headers, txs or [], results or []
+
+    @staticmethod
+    def get_bucket(archive: FileArchive, hexhash: str):
+        from stellar_tpu.bucket.bucket import Bucket
+        rel = (f"bucket/{hexhash[0:2]}/{hexhash[2:4]}/{hexhash[4:6]}/"
+               f"bucket-{hexhash}.xdr.gz")
+        raw = archive.get(rel)
+        if raw is None:
+            return None
+        b = Bucket.deserialize(gzip.decompress(raw))
+        if b.hash.hex() != hexhash:
+            raise ValueError("bucket hash mismatch (corrupt archive)")
+        return b
+
+
+def _pair(frame, result):
+    from stellar_tpu.xdr.results import TransactionResultPair
+    xdr = frame.to_result_xdr(result) if hasattr(frame, "to_result_xdr") \
+        else result.to_xdr()
+    return TransactionResultPair(transactionHash=frame.contents_hash(),
+                                 result=xdr)

@@ -1,0 +1,229 @@
+"""Work system + history publish + catchup tests (reference
+``work/test/WorkTests.cpp``, ``history/test/HistoryTests.cpp``,
+``catchup/test/CatchupWorkTests.cpp`` behaviors)."""
+
+import pytest
+
+from stellar_tpu.catchup.catchup import (
+    CatchupConfiguration, CatchupWork, LedgerApplyManager,
+    apply_buckets_catchup, replay_checkpoint, verify_ledger_chain,
+)
+from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+from stellar_tpu.history.history_manager import (
+    CHECKPOINT_FREQUENCY, FileArchive, HistoryManager,
+    checkpoint_containing, is_last_in_checkpoint,
+)
+from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
+from stellar_tpu.tx.tx_test_utils import (
+    TEST_NETWORK_ID, keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+from stellar_tpu.work.work import (
+    BatchWork, FunctionWork, State, WorkScheduler, WorkSequence,
+)
+
+XLM = 10_000_000
+
+
+# ---------------- work system ----------------
+
+
+def test_function_work_and_scheduler():
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    log = []
+    ws.schedule(FunctionWork("a", lambda: log.append("a")))
+    ws.schedule(FunctionWork("b", lambda: log.append("b")))
+    assert ws.run_until_done(10)
+    assert sorted(log) == ["a", "b"]
+
+
+def test_work_sequence_order_and_failure():
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    log = []
+    seq = WorkSequence("seq", max_retries=0)
+    seq.add_child(FunctionWork("one", lambda: log.append(1)))
+    seq.add_child(FunctionWork("two", lambda: log.append(2)))
+    seq.add_child(FunctionWork("fail", lambda: State.FAILURE))
+    seq.add_child(FunctionWork("never", lambda: log.append(3)))
+    ws.schedule(seq)
+    ws.run_until_done(10)
+    assert seq.state == State.FAILURE
+    assert log == [1, 2]  # strict order, stopped at the failure
+
+
+def test_work_retry_then_success():
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        return State.FAILURE if len(attempts) < 3 else State.SUCCESS
+    w = FunctionWork("flaky", flaky, max_retries=5)
+    ws.schedule(w)
+    # retries arm timers; crank time forward
+    clock.crank_until(lambda: w.is_done(), 300)
+    assert w.state == State.SUCCESS
+    assert len(attempts) == 3
+
+
+def test_batch_work_bounded_parallelism():
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    done = []
+
+    class Batch(BatchWork):
+        def __init__(self):
+            super().__init__("batch", max_parallel=3)
+            self.n = 0
+
+        def has_next(self):
+            return self.n < 10
+
+        def yield_more_work(self):
+            self.n += 1
+            i = self.n
+            return FunctionWork(f"item-{i}", lambda: done.append(i))
+
+    b = Batch()
+    ws.schedule(b)
+    ws.run_until_done(10)
+    assert b.state == State.SUCCESS
+    assert sorted(done) == list(range(1, 11))
+
+
+# ---------------- history + catchup ----------------
+
+
+def build_chain(n_ledgers, archive_dir, with_txs=True):
+    """Drive a LedgerManager + HistoryManager through n closes."""
+    a, b = keypair("alice"), keypair("bob")
+    root = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    archive = FileArchive(archive_dir)
+    hm = HistoryManager([archive], "test-net")
+    seq_counter = [1 << 32]
+    for i in range(n_ledgers):
+        frames = []
+        if with_txs and i % 3 == 0:
+            seq_counter[0] += 1
+            frames = [make_tx(a, seq_counter[0], [payment_op(b, XLM)])]
+        txset, _ = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash)
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset, 1000 + (i + 1) * 5))
+        assert res.failed_count == 0
+        hm.ledger_closed(res, txset, lm.bucket_list)
+    return lm, archive, hm
+
+
+def test_checkpoint_math():
+    assert checkpoint_containing(1) == 63
+    assert checkpoint_containing(63) == 63
+    assert checkpoint_containing(64) == 127
+    assert is_last_in_checkpoint(63)
+    assert not is_last_in_checkpoint(64)
+
+
+def test_publish_and_chain_verify(tmp_path):
+    lm, archive, hm = build_chain(61, str(tmp_path))  # closes 3..63
+    assert hm.published_checkpoints == [63]
+    has = HistoryManager.get_root_has(archive)
+    assert has is not None and has.current_ledger == 63
+    headers, txs, results = HistoryManager.get_checkpoint(archive, 63)
+    assert len(headers) == 61  # ledgers 3..63
+    assert verify_ledger_chain(headers)
+    # corrupt one header -> verification fails
+    headers[5].header.feePool += 1
+    assert not verify_ledger_chain(headers)
+
+
+def test_replay_catchup_matches_hashes(tmp_path):
+    lm, archive, hm = build_chain(61, str(tmp_path))
+    # fresh node from the same genesis replays to the checkpoint
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    applied = replay_checkpoint(lm2, archive, 63)
+    assert applied == 61
+    assert lm2.ledger_seq == 63
+    assert lm2.last_closed_hash == lm.last_closed_hash
+    assert lm2.root.store.entries == lm.root.store.entries
+
+
+def test_replay_detects_divergence(tmp_path):
+    lm, archive, hm = build_chain(61, str(tmp_path))
+    a, b = keypair("alice"), keypair("bob")
+    # different genesis -> replay must fail loudly, not silently fork
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 999)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    with pytest.raises(ValueError):
+        replay_checkpoint(lm2, archive, 63)
+
+
+def test_minimal_catchup_from_buckets(tmp_path):
+    lm, archive, hm = build_chain(61, str(tmp_path))
+    # brand-new empty node assumes the checkpoint state from buckets
+    lm2 = LedgerManager(TEST_NETWORK_ID)
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    work = CatchupWork(lm2, archive,
+                       CatchupConfiguration(63,
+                                            CatchupConfiguration.MINIMAL))
+    ws.schedule(work)
+    ws.run_until_done(60)
+    assert work.state == State.SUCCESS, work.state
+    assert lm2.ledger_seq == 63
+    assert lm2.last_closed_hash == lm.last_closed_hash
+    assert lm2.root.store.entries == lm.root.store.entries
+    assert lm2.bucket_list.hash() == lm.bucket_list.hash()
+    # the caught-up node keeps closing ledgers in lockstep with the old
+    txset, _ = make_tx_set_from_transactions(
+        [], lm.last_closed_header, lm.last_closed_hash)
+    r1 = lm.close_ledger(LedgerCloseData(64, txset, 99999))
+    txset2, _ = make_tx_set_from_transactions(
+        [], lm2.last_closed_header, lm2.last_closed_hash)
+    r2 = lm2.close_ledger(LedgerCloseData(64, txset2, 99999))
+    assert r1.header_hash == r2.header_hash
+
+
+def test_catchup_work_complete_mode(tmp_path):
+    lm, archive, hm = build_chain(125, str(tmp_path))  # closes 3..127
+    assert hm.published_checkpoints == [63, 127]
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    work = CatchupWork(lm2, archive, CatchupConfiguration(127))
+    ws.schedule(work)
+    ws.run_until_done(60)
+    assert work.state == State.SUCCESS
+    assert lm2.ledger_seq == 127
+    assert lm2.last_closed_hash == lm.last_closed_hash
+
+
+def test_ledger_apply_manager_buffers_and_drains():
+    a, b = keypair("alice"), keypair("bob")
+    root = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lam = LedgerApplyManager(lm)
+
+    def lcd_for(target_lm):
+        txset, _ = make_tx_set_from_transactions(
+            [], target_lm.last_closed_header, target_lm.last_closed_hash)
+        return LedgerCloseData(target_lm.ledger_seq + 1, txset,
+                               1000 + target_lm.ledger_seq * 5)
+
+    # apply 3 in order
+    for _ in range(3):
+        assert lam.process_ledger(lcd_for(lm)) == "applied"
+    assert lm.ledger_seq == 5
+    # a skipped ledger buffers; a second gap triggers catchup-needed
+    import copy
+    fake = LedgerCloseData(lm.ledger_seq + 2, lcd_for(lm).tx_set, 2000)
+    assert lam.process_ledger(fake) == "buffered"
+    fake2 = LedgerCloseData(lm.ledger_seq + 3, lcd_for(lm).tx_set, 2001)
+    assert lam.process_ledger(fake2) == "catchup-needed"
