@@ -1,0 +1,101 @@
+"""X25519 ECDH for overlay channel auth (reference
+``src/crypto/Curve25519.cpp`` wrapping libsodium crypto_scalarmult;
+RFC 7748 semantics re-implemented on the same GF(2^255-19) the ed25519
+oracle uses).
+
+Host-side and tiny: one scalar mult per peer handshake — nowhere near
+the batch-crypto hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+
+__all__ = ["scalarmult", "scalarmult_base", "random_secret",
+           "public_from_secret", "hkdf_extract", "hkdf_expand",
+           "hmac_sha256", "verify_hmac_sha256"]
+
+P = 2 ** 255 - 19
+A24 = 121665
+
+
+def _clamp(k: bytes) -> int:
+    n = bytearray(k)
+    n[0] &= 248
+    n[31] &= 127
+    n[31] |= 64
+    return int.from_bytes(bytes(n), "little")
+
+
+def scalarmult(secret: bytes, point: bytes) -> bytes:
+    """RFC 7748 Montgomery ladder."""
+    k = _clamp(secret)
+    u = int.from_bytes(point, "little") & ((1 << 255) - 1)
+    x1 = u % P
+    x2, z2 = 1, 0
+    x3, z3 = x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        kt = (k >> t) & 1
+        swap ^= kt
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % P
+        aa = a * a % P
+        b = (x2 - z2) % P
+        bb = b * b % P
+        e = (aa - bb) % P
+        c = (x3 + z3) % P
+        d = (x3 - z3) % P
+        da = d * a % P
+        cb = c * b % P
+        x3 = (da + cb) % P
+        x3 = x3 * x3 % P
+        z3 = (da - cb) % P
+        z3 = z3 * z3 % P
+        z3 = z3 * x1 % P
+        x2 = aa * bb % P
+        z2 = e * (aa + A24 * e) % P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, P - 2, P) % P
+    return out.to_bytes(32, "little")
+
+
+BASE_POINT = (9).to_bytes(32, "little")
+
+
+def scalarmult_base(secret: bytes) -> bytes:
+    return scalarmult(secret, BASE_POINT)
+
+
+def random_secret() -> bytes:
+    return os.urandom(32)
+
+
+def public_from_secret(secret: bytes) -> bytes:
+    return scalarmult_base(secret)
+
+
+def hkdf_extract(ikm: bytes, salt: bytes = b"") -> bytes:
+    """RFC 5869 extract (reference ``hkdfExtract``: zero salt)."""
+    return _hmac.new(salt if salt else b"\x00" * 32, ikm,
+                     hashlib.sha256).digest()
+
+
+def hkdf_expand(prk: bytes, info: bytes) -> bytes:
+    """Single-block expand (reference ``hkdfExpand``)."""
+    return _hmac.new(prk, info + b"\x01", hashlib.sha256).digest()
+
+
+def hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    return _hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def verify_hmac_sha256(key: bytes, msg: bytes, mac: bytes) -> bool:
+    return _hmac.compare_digest(hmac_sha256(key, msg), mac)

@@ -129,6 +129,11 @@ class Herder:
         self.tx_sets: Dict[bytes, ApplicableTxSetFrame] = {}
         # envelopes waiting for their txset: txset hash -> [envelope]
         self.waiting_envelopes: Dict[bytes, List[SCPEnvelope]] = {}
+        # envelopes waiting for an unknown quorum set
+        self.waiting_for_qset: Dict[bytes, List[SCPEnvelope]] = {}
+        # fetch hooks (wired by the overlay): ask peers for missing items
+        self.request_tx_set: Callable = lambda h: None
+        self.request_quorum_set: Callable = lambda h: None
         self.tx_queue = TransactionQueue(
             max_ops=2 * self.lm.last_closed_header.maxTxSetSize,
             check_valid=self._check_tx_valid)
@@ -149,7 +154,12 @@ class Herder:
 
     def register_qset(self, qset: SCPQuorumSet):
         from stellar_tpu.xdr.scp import quorum_set_hash
-        self.qsets[quorum_set_hash(qset)] = qset
+        h = quorum_set_hash(qset)
+        if h in self.qsets:
+            return
+        self.qsets[h] = qset
+        for env in self.waiting_for_qset.pop(h, []):
+            self.recv_scp_envelope(env)
 
     def recv_tx_set(self, frame) -> bool:
         """Register a tx set heard from the network; releases any SCP
@@ -221,13 +231,29 @@ class Herder:
         if slot < low or \
                 slot > self.lm.ledger_seq + LEDGER_VALIDITY_BRACKET:
             return EnvelopeState.INVALID
+        # hold envelopes pledging under a quorum set we don't know yet
+        # (reference PendingEnvelopes qset fetch)
+        qh = self._statement_qset_hash(env.statement)
+        if qh not in self.qsets:
+            self.waiting_for_qset.setdefault(qh, []).append(env)
+            self.request_quorum_set(qh)
+            return EnvelopeState.VALID
         # hold envelopes whose tx sets we don't have yet
         missing = self._missing_tx_sets(env.statement)
         if missing:
             for h in missing:
                 self.waiting_envelopes.setdefault(h, []).append(env)
+                self.request_tx_set(h)
             return EnvelopeState.VALID
         return self._feed_scp(env)
+
+    @staticmethod
+    def _statement_qset_hash(st: SCPStatement) -> bytes:
+        from stellar_tpu.xdr.scp import SCPStatementType as T
+        p = st.pledges.value
+        if st.pledges.arm == T.SCP_ST_EXTERNALIZE:
+            return p.commitQuorumSetHash
+        return p.quorumSetHash
 
     def _feed_scp(self, env: SCPEnvelope) -> int:
         return self.scp.receive_envelope(env)

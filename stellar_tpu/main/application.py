@@ -1,0 +1,121 @@
+"""Application: the hub owning every subsystem of one node (reference
+``src/main/Application.h:133`` / ``ApplicationImpl.cpp`` — here the
+single-threaded crank loop IS the architecture: all consensus work runs
+as clock actions, with the TPU batch-crypto service as the device-side
+coprocessor behind the verify cache)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from stellar_tpu.herder.herder import Herder
+from stellar_tpu.history.history_manager import FileArchive, HistoryManager
+from stellar_tpu.ledger.ledger_manager import LedgerManager
+from stellar_tpu.ledger.ledger_txn import LedgerTxnRoot
+from stellar_tpu.main.config import Config
+from stellar_tpu.overlay.overlay_manager import OverlayManager
+from stellar_tpu.overlay.peer import PeerAuth
+from stellar_tpu.utils.timer import REAL_TIME, VIRTUAL_TIME, VirtualClock
+from stellar_tpu.work.work import WorkScheduler
+
+__all__ = ["Application"]
+
+
+class Application:
+    def __init__(self, config: Config,
+                 clock: Optional[VirtualClock] = None,
+                 root: Optional[LedgerTxnRoot] = None):
+        if config.NODE_SEED is None:
+            from stellar_tpu.crypto.keys import SecretKey
+            config.NODE_SEED = SecretKey.random()
+        self.config = config
+        self.clock = clock if clock is not None else \
+            VirtualClock(REAL_TIME)
+        network_id = config.network_id()
+        self.lm = LedgerManager(network_id, root)
+        hdr = self.lm.last_closed_header
+        hdr.maxTxSetSize = config.MAX_TX_SET_SIZE
+        hdr.ledgerVersion = config.LEDGER_PROTOCOL_VERSION
+
+        qset = config.QUORUM_SET
+        if qset is None:
+            from stellar_tpu.scp.quorum import singleton_qset
+            qset = singleton_qset(config.NODE_SEED.public_key.raw)
+        self.herder = Herder(
+            config.NODE_SEED, network_id, self.lm, self.clock, qset,
+            is_validator=config.NODE_IS_VALIDATOR,
+            target_close_seconds=config.EXPECTED_LEDGER_CLOSE_TIME)
+        self.peer_auth = PeerAuth(config.NODE_SEED, network_id,
+                                  self.clock.system_now())
+        self.overlay = OverlayManager(self)
+        self.work_scheduler = WorkScheduler(self.clock)
+        self.history: Optional[HistoryManager] = None
+        if config.HISTORY_ARCHIVES:
+            self.history = HistoryManager(
+                [FileArchive(p) for p in config.HISTORY_ARCHIVES],
+                config.NETWORK_PASSPHRASE)
+        self.herder.on_externalized = self._on_externalized
+        self._started = False
+
+    # ---------------- lifecycle ----------------
+
+    @property
+    def node_id(self) -> bytes:
+        return self.config.NODE_SEED.public_key.raw
+
+    def start(self):
+        """Begin consensus participation (reference
+        ``ApplicationImpl::start``)."""
+        self._started = True
+        if not self.config.MANUAL_CLOSE:
+            self.herder.start()
+
+    def crank(self, block: bool = False) -> int:
+        return self.clock.crank(block)
+
+    # ---------------- hooks ----------------
+
+    def _on_externalized(self, slot_index: int, close_result):
+        if self.history is not None:
+            txset = None
+            sv = close_result.header.scpValue
+            txset = self.herder.tx_sets.get(sv.txSetHash)
+            if txset is not None:
+                self.history.ledger_closed(close_result, txset,
+                                           self.lm.bucket_list)
+        self.overlay.ledger_closed(slot_index)
+
+    # ---------------- operator surface ----------------
+
+    def info(self) -> dict:
+        """The HTTP 'info' payload (reference CommandHandler)."""
+        from stellar_tpu.herder.herder import HERDER_STATE
+        lcl = self.lm.last_closed_header
+        return {
+            "ledger": {
+                "num": lcl.ledgerSeq,
+                "hash": self.lm.last_closed_hash.hex(),
+                "closeTime": lcl.scpValue.closeTime,
+                "baseFee": lcl.baseFee,
+                "baseReserve": lcl.baseReserve,
+                "maxTxSetSize": lcl.maxTxSetSize,
+                "version": lcl.ledgerVersion,
+            },
+            "state": {HERDER_STATE.BOOTING: "booting",
+                      HERDER_STATE.TRACKING: "synced",
+                      HERDER_STATE.OUT_OF_SYNC: "out-of-sync"}[
+                self.herder.state],
+            "peers": {"authenticated_count":
+                      self.overlay.authenticated_count()},
+            "quorum": {"node": self.config.NODE_SEED.public_key
+                       .to_strkey()},
+            "protocol_version": lcl.ledgerVersion,
+        }
+
+    def manual_close(self) -> dict:
+        """Close one ledger on demand (reference ``manualclose``
+        command; standalone mode)."""
+        seq = self.lm.ledger_seq + 1
+        self.herder.trigger_next_ledger(seq)
+        # single-node qset externalizes immediately via self-messages
+        return {"ledger": self.lm.ledger_seq}

@@ -1,0 +1,95 @@
+"""Node configuration (reference ``src/main/Config.h`` — a plain struct
+of typed fields loaded from TOML with per-key validation; quorum-set DSL
+per ``Config.cpp:475-719``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from stellar_tpu.crypto.keys import SecretKey
+from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+from stellar_tpu.scp.quorum import make_node_id
+from stellar_tpu.xdr.scp import SCPQuorumSet
+
+__all__ = ["Config"]
+
+
+@dataclass
+class Config:
+    # identity / network
+    NODE_SEED: Optional[SecretKey] = None
+    NODE_IS_VALIDATOR: bool = True
+    NETWORK_PASSPHRASE: str = "Standalone stellar_tpu Network"
+    LEDGER_PROTOCOL_VERSION: int = CURRENT_LEDGER_PROTOCOL_VERSION
+
+    # consensus
+    QUORUM_SET: Optional[SCPQuorumSet] = None
+    EXPECTED_LEDGER_CLOSE_TIME: int = 5
+    MAX_TX_SET_SIZE: int = 100
+    RUN_STANDALONE: bool = False
+    MANUAL_CLOSE: bool = False
+
+    # overlay
+    PEER_PORT: int = 11625
+    TARGET_PEER_CONNECTIONS: int = 8
+    MAX_PEER_CONNECTIONS: int = 64
+    KNOWN_PEERS: List[str] = field(default_factory=list)
+
+    # history
+    HISTORY_ARCHIVES: List[str] = field(default_factory=list)
+
+    # ops / observability
+    LOG_LEVEL: str = "INFO"
+    INVARIANT_CHECKS: List[str] = field(default_factory=list)
+    HTTP_PORT: int = 11626
+
+    # test knobs (reference ARTIFICIALLY_* family)
+    ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
+
+    def network_id(self) -> bytes:
+        from stellar_tpu.crypto.sha import sha256
+        return sha256(self.NETWORK_PASSPHRASE.encode())
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        """Load from a TOML file (field names match the reference's
+        upper-snake keys)."""
+        import tomllib
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        cfg = cls()
+        simple = {
+            "NODE_IS_VALIDATOR", "NETWORK_PASSPHRASE", "PEER_PORT",
+            "TARGET_PEER_CONNECTIONS", "MAX_PEER_CONNECTIONS",
+            "KNOWN_PEERS", "HISTORY_ARCHIVES", "LOG_LEVEL", "HTTP_PORT",
+            "RUN_STANDALONE", "MANUAL_CLOSE", "MAX_TX_SET_SIZE",
+            "EXPECTED_LEDGER_CLOSE_TIME", "INVARIANT_CHECKS",
+        }
+        for key, value in raw.items():
+            if key == "NODE_SEED":
+                cfg.NODE_SEED = SecretKey.from_strkey_seed(value) \
+                    if value.startswith("S") else \
+                    SecretKey.from_seed_str(value)
+            elif key == "QUORUM_SET":
+                cfg.QUORUM_SET = _parse_quorum_set(value)
+            elif key in simple:
+                setattr(cfg, key, value)
+            # unknown keys rejected like the reference's strict parser
+            else:
+                raise ValueError(f"unknown config key {key}")
+        return cfg
+
+
+def _parse_quorum_set(d: Dict) -> SCPQuorumSet:
+    """{"THRESHOLD_PERCENT": 66, "VALIDATORS": [strkey...],
+    "INNER_SETS": [...]} -> SCPQuorumSet (reference quorum DSL)."""
+    from stellar_tpu.crypto import strkey
+    validators = [make_node_id(strkey.decode_account(v))
+                  for v in d.get("VALIDATORS", [])]
+    inner = [_parse_quorum_set(i) for i in d.get("INNER_SETS", [])]
+    size = len(validators) + len(inner)
+    pct = d.get("THRESHOLD_PERCENT", 67)
+    threshold = max(1, (size * pct + 99) // 100)
+    return SCPQuorumSet(threshold=threshold, validators=validators,
+                        innerSets=inner)

@@ -1,0 +1,192 @@
+"""OverlayManager: peer book, flooding, and the herder<->network glue
+(reference ``src/overlay/OverlayManagerImpl.cpp``, ``Floodgate.cpp``,
+``ItemFetcher``).
+
+The Floodgate deduplicates by message hash and fans out to every
+authenticated peer except those it already came from; records are swept
+as ledgers close. Tx-set / quorum-set fetches are anycast: ask one
+authenticated peer at a time (GET_TX_SET / GET_SCP_QUORUMSET), fall
+through on DONT_HAVE.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.herder.tx_set import TxSetXDRFrame
+from stellar_tpu.xdr.overlay import (
+    DontHave, MessageType, StellarMessage,
+)
+from stellar_tpu.xdr.runtime import to_bytes
+
+__all__ = ["Floodgate", "OverlayManager"]
+
+
+class Floodgate:
+    """Dedup + fanout (reference ``Floodgate.cpp:59-118``)."""
+
+    def __init__(self):
+        # msg hash -> set of peers it was seen from (ledger seq for GC)
+        self.records: Dict[bytes, tuple] = {}
+
+    def add_record(self, msg_hash: bytes, from_peer, ledger_seq: int
+                   ) -> bool:
+        """True if this is a NEW message (should be processed)."""
+        rec = self.records.get(msg_hash)
+        if rec is None:
+            self.records[msg_hash] = ({id(from_peer)} if from_peer
+                                      else set(), ledger_seq)
+            return True
+        rec[0].add(id(from_peer))
+        return False
+
+    def peers_to_skip(self, msg_hash: bytes) -> Set[int]:
+        rec = self.records.get(msg_hash)
+        return rec[0] if rec else set()
+
+    def clear_below(self, ledger_seq: int):
+        self.records = {h: r for h, r in self.records.items()
+                        if r[1] + 10 >= ledger_seq}
+
+
+class OverlayManager:
+    """One node's network face. ``app`` is the owning Application-like
+    container (herder, clock, peer_auth)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.peers: List = []  # authenticated peers
+        self.pending_peers: List = []
+        self.floodgate = Floodgate()
+        self._wire_herder()
+
+    # ---------------- herder wiring ----------------
+
+    def _wire_herder(self):
+        h = self.app.herder
+        h.broadcast_envelope = self.broadcast_scp_envelope
+        h.broadcast_tx_set = self.broadcast_tx_set
+        h.broadcast_transaction = self.broadcast_transaction
+        h.request_tx_set = self.fetch_tx_set
+        h.request_quorum_set = self.fetch_quorum_set
+
+    # ---------------- peer lifecycle ----------------
+
+    def add_pending(self, peer):
+        self.pending_peers.append(peer)
+
+    def peer_authenticated(self, peer):
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+        if peer not in self.peers:
+            self.peers.append(peer)
+
+    def peer_dropped(self, peer, reason: str):
+        if peer in self.peers:
+            self.peers.remove(peer)
+        if peer in self.pending_peers:
+            self.pending_peers.remove(peer)
+
+    def authenticated_count(self) -> int:
+        return len(self.peers)
+
+    # ---------------- broadcast (herder -> network) ----------------
+
+    def _flood(self, msg, from_peer=None):
+        raw_hash = sha256(to_bytes(StellarMessage, msg))
+        self.floodgate.add_record(raw_hash, from_peer,
+                                  self.app.herder.lm.ledger_seq)
+        skip = self.floodgate.peers_to_skip(raw_hash)
+        for p in list(self.peers):
+            if id(p) not in skip:
+                p.send(msg)
+
+    def broadcast_scp_envelope(self, envelope):
+        self._flood(StellarMessage.make(MessageType.SCP_MESSAGE, envelope))
+
+    def broadcast_tx_set(self, txset_frame):
+        self._flood(StellarMessage.make(MessageType.GENERALIZED_TX_SET,
+                                        txset_frame.xdr))
+
+    def broadcast_transaction(self, frame):
+        self._flood(StellarMessage.make(MessageType.TRANSACTION,
+                                        frame.envelope))
+
+    # ---------------- fetch (anycast) ----------------
+
+    def fetch_tx_set(self, tx_set_hash: bytes):
+        # ask every peer (the reference's ItemFetcher walks peers one at
+        # a time on DONT_HAVE; asking all is the degenerate-but-correct
+        # form at simulation scale)
+        for p in list(self.peers):
+            p.send(StellarMessage.make(MessageType.GET_TX_SET,
+                                       tx_set_hash))
+
+    def fetch_quorum_set(self, qset_hash: bytes):
+        for p in list(self.peers):
+            p.send(StellarMessage.make(MessageType.GET_SCP_QUORUMSET,
+                                       qset_hash))
+
+    # ---------------- inbound dispatch (peer -> node) ----------------
+
+    def recv_message(self, peer, msg):
+        t = msg.arm
+        herder = self.app.herder
+        if t == MessageType.TRANSACTION:
+            raw_hash = sha256(to_bytes(StellarMessage, msg))
+            if self.floodgate.add_record(raw_hash, peer,
+                                         herder.lm.ledger_seq):
+                from stellar_tpu.tx.transaction_frame import (
+                    make_transaction_frame,
+                )
+                try:
+                    frame = make_transaction_frame(herder.network_id,
+                                                   msg.value)
+                except Exception:
+                    return
+                from stellar_tpu.herder.transaction_queue import AddResult
+                res = herder.tx_queue.try_add(frame)
+                if res.code == AddResult.ADD_STATUS_PENDING:
+                    self._flood(msg, from_peer=peer)
+        elif t == MessageType.SCP_MESSAGE:
+            raw_hash = sha256(to_bytes(StellarMessage, msg))
+            if self.floodgate.add_record(raw_hash, peer,
+                                         herder.lm.ledger_seq):
+                from stellar_tpu.scp import EnvelopeState
+                if herder.recv_scp_envelope(msg.value) == \
+                        EnvelopeState.VALID:
+                    self._flood(msg, from_peer=peer)
+        elif t == MessageType.GENERALIZED_TX_SET:
+            herder.recv_tx_set(TxSetXDRFrame(msg.value))
+        elif t == MessageType.GET_TX_SET:
+            ts = herder.get_tx_set(msg.value)
+            if ts is not None:
+                peer.send(StellarMessage.make(
+                    MessageType.GENERALIZED_TX_SET, ts.xdr))
+            else:
+                peer.send(StellarMessage.make(
+                    MessageType.DONT_HAVE,
+                    DontHave(type=MessageType.GENERALIZED_TX_SET,
+                             reqHash=msg.value)))
+        elif t == MessageType.GET_SCP_QUORUMSET:
+            qs = herder.qsets.get(msg.value)
+            if qs is not None:
+                peer.send(StellarMessage.make(
+                    MessageType.SCP_QUORUMSET, qs))
+            else:
+                peer.send(StellarMessage.make(
+                    MessageType.DONT_HAVE,
+                    DontHave(type=MessageType.SCP_QUORUMSET,
+                             reqHash=msg.value)))
+        elif t == MessageType.SCP_QUORUMSET:
+            herder.register_qset(msg.value)
+        elif t == MessageType.GET_SCP_STATE:
+            for idx, slot in herder.scp.known_slots.items():
+                for env in slot.get_current_state():
+                    peer.send(StellarMessage.make(
+                        MessageType.SCP_MESSAGE, env))
+        # DONT_HAVE / PEERS / surveys: tracked by fetchers (round 2)
+
+    def ledger_closed(self, ledger_seq: int):
+        self.floodgate.clear_below(ledger_seq)

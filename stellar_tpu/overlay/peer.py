@@ -1,0 +1,302 @@
+"""Peer: one authenticated overlay connection (reference
+``src/overlay/Peer.cpp``, ``PeerAuth.cpp``, ``Hmac.h``,
+``FlowControl.h``).
+
+Channel security exactly as the reference: each node signs an ephemeral
+X25519 key with its ed25519 identity (AuthCert, bound to the network id
+and an expiration), HELLOs exchange certs+nonces, HKDF over the ECDH
+shared secret + nonces derives one HMAC-SHA256 key per direction, and
+every subsequent message is MAC'd over (sequence ‖ message) with a
+strictly-increasing sequence — replay- and tamper-proof per connection.
+
+Flow control is the reference's credit scheme: a peer may only send
+while it holds message credits; the receiver returns SEND_MORE(_EXTENDED)
+credits as it drains its queue.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from stellar_tpu.crypto import curve25519 as c25519
+from stellar_tpu.crypto.keys import SecretKey, verify_sig
+from stellar_tpu.xdr.overlay import (
+    Auth, AuthCert, AuthenticatedMessage, AuthenticatedMessageV0, ErrorMsg,
+    ErrorCode, Hello, MessageType, SendMoreExtended, StellarMessage,
+)
+from stellar_tpu.xdr.runtime import Packer, from_bytes, to_bytes
+from stellar_tpu.xdr.types import (
+    Curve25519Public, EnvelopeType, HmacSha256Mac,
+)
+
+__all__ = ["PeerAuth", "FlowControl", "Peer", "PEER_STATE"]
+
+AUTH_CERT_LIFETIME = 3600  # seconds (reference PeerAuth.cpp expiration)
+OVERLAY_VERSION = 38
+
+# reference FlowControl defaults
+PEER_FLOOD_READING_CAPACITY = 200
+FLOW_CONTROL_SEND_MORE_BATCH = 40
+
+
+class PeerAuth:
+    """Per-node auth material (reference ``PeerAuth``)."""
+
+    def __init__(self, node_key: SecretKey, network_id: bytes, now: int):
+        self.node_key = node_key
+        self.network_id = network_id
+        self.ecdh_secret = c25519.random_secret()
+        self.ecdh_public = c25519.public_from_secret(self.ecdh_secret)
+        self.cert = self._make_cert(now)
+
+    def _cert_payload(self, expiration: int, pubkey: bytes) -> bytes:
+        # (networkID | ENVELOPE_TYPE_AUTH | expiration | pubkey)
+        # (reference PeerAuth::getAuthCert)
+        p = Packer()
+        p.pack_fopaque(32, self.network_id)
+        p.pack_int(EnvelopeType.ENVELOPE_TYPE_AUTH)
+        p.pack_uhyper(expiration)
+        p.pack_fopaque(32, pubkey)
+        return p.bytes()
+
+    def _make_cert(self, now: int) -> AuthCert:
+        expiration = now + AUTH_CERT_LIFETIME
+        sig = self.node_key.sign(
+            self._cert_payload(expiration, self.ecdh_public))
+        return AuthCert(pubkey=Curve25519Public(key=self.ecdh_public),
+                        expiration=expiration, sig=sig)
+
+    def verify_remote_cert(self, cert: AuthCert, remote_node_id: bytes,
+                           now: int) -> bool:
+        if cert.expiration < now:
+            return False
+        payload = self._cert_payload(cert.expiration, cert.pubkey.key)
+        return verify_sig(remote_node_id, payload, cert.sig)
+
+    def shared_keys(self, remote_pub: bytes, local_nonce: bytes,
+                    remote_nonce: bytes, we_called: bool):
+        """(sending_key, receiving_key) via HKDF over ECDH + nonces
+        (reference ``PeerAuth::getSharedKey`` + per-direction expand)."""
+        shared = c25519.scalarmult(self.ecdh_secret, remote_pub)
+        # include both public keys sorted by role for symmetry
+        if we_called:
+            ikm = shared + self.ecdh_public + remote_pub
+        else:
+            ikm = shared + remote_pub + self.ecdh_public
+        prk = c25519.hkdf_extract(ikm)
+        if we_called:
+            send_info = b"S" + local_nonce + remote_nonce
+            recv_info = b"R" + remote_nonce + local_nonce
+        else:
+            send_info = b"R" + local_nonce + remote_nonce
+            recv_info = b"S" + remote_nonce + local_nonce
+        return (c25519.hkdf_expand(prk, send_info),
+                c25519.hkdf_expand(prk, recv_info))
+
+
+class FlowControl:
+    """Message-credit flow control (reference ``FlowControl.h:27-104``)."""
+
+    def __init__(self, capacity: int = PEER_FLOOD_READING_CAPACITY):
+        self.outbound_credits = 0       # what the remote granted us
+        self.to_grant = 0               # what we owe the remote
+        self.capacity = capacity
+
+    def can_send(self) -> bool:
+        return self.outbound_credits > 0
+
+    def note_sent(self):
+        self.outbound_credits -= 1
+
+    def note_received(self) -> Optional[int]:
+        """Returns a credit batch to grant when the threshold hits."""
+        self.to_grant += 1
+        if self.to_grant >= FLOW_CONTROL_SEND_MORE_BATCH:
+            grant, self.to_grant = self.to_grant, 0
+            return grant
+        return None
+
+    def receive_credits(self, n: int):
+        self.outbound_credits += n
+
+
+class PEER_STATE:
+    CONNECTING = 0
+    CONNECTED = 1       # transport up, HELLO not done
+    GOT_HELLO = 2
+    GOT_AUTH = 3        # fully authenticated
+    CLOSING = 4
+
+
+FLOOD_TYPES = (MessageType.TRANSACTION, MessageType.SCP_MESSAGE,
+               MessageType.FLOOD_ADVERT, MessageType.FLOOD_DEMAND)
+
+
+class Peer:
+    """Protocol state machine over an abstract transport; subclasses
+    provide ``send_bytes`` (Loopback or TCP)."""
+
+    def __init__(self, app, we_called: bool):
+        self.app = app  # duck-typed: .herder .clock .peer_auth .overlay
+        self.we_called = we_called
+        self.state = PEER_STATE.CONNECTED
+        self.remote_node_id: Optional[bytes] = None
+        self.remote_nonce: Optional[bytes] = None
+        self.local_nonce = c25519.random_secret()
+        self.send_key = self.recv_key = None
+        self.send_seq = 0
+        self.recv_seq = 0
+        self.flow = FlowControl()
+        self.on_drop: Optional[Callable] = None
+
+    # ---------------- transport hooks ----------------
+
+    def send_bytes(self, raw: bytes):
+        raise NotImplementedError
+
+    def receive_bytes(self, raw: bytes):
+        try:
+            am = from_bytes(AuthenticatedMessage, raw)
+        except Exception:
+            return self.drop("malformed frame")
+        self._recv_authenticated(am.value)
+
+    # ---------------- handshake ----------------
+
+    def start_handshake(self):
+        if self.we_called:
+            self._send_hello()
+
+    def _send_hello(self):
+        lcl = self.app.herder.lm.last_closed_header
+        hello = Hello(
+            ledgerVersion=lcl.ledgerVersion,
+            overlayVersion=OVERLAY_VERSION,
+            overlayMinVersion=OVERLAY_VERSION,
+            networkID=self.app.herder.network_id,
+            versionStr=b"stellar_tpu",
+            listeningPort=getattr(self.app, "port", 0),
+            peerID=self.app.herder.scp.local_node_xdr,
+            cert=self.app.peer_auth.cert,
+            nonce=self.local_nonce)
+        self._send_message(StellarMessage.make(MessageType.HELLO, hello))
+
+    def _send_auth(self):
+        self._send_message(StellarMessage.make(
+            MessageType.AUTH,
+            Auth(flags=200)))  # flow-control-in-bytes requested
+
+    # ---------------- MAC framing ----------------
+
+    def _send_message(self, msg):
+        mac = b"\x00" * 32
+        if self.send_key is not None and msg.arm != MessageType.HELLO:
+            p = Packer()
+            p.pack_uhyper(self.send_seq)
+            StellarMessage.pack(p, msg)
+            mac = c25519.hmac_sha256(self.send_key, p.bytes())
+        am = AuthenticatedMessage.make(0, AuthenticatedMessageV0(
+            sequence=self.send_seq, message=msg,
+            mac=HmacSha256Mac(mac=mac)))
+        if self.send_key is not None and msg.arm != MessageType.HELLO:
+            self.send_seq += 1
+        if msg.arm in FLOOD_TYPES and self.state == PEER_STATE.GOT_AUTH:
+            self.flow.note_sent()
+        self.send_bytes(to_bytes(AuthenticatedMessage, am))
+
+    def _recv_authenticated(self, am: AuthenticatedMessageV0):
+        msg = am.message
+        if msg.arm != MessageType.HELLO:
+            if self.recv_key is None:
+                return self.drop("message before handshake")
+            if am.sequence != self.recv_seq:
+                return self.drop("out-of-order sequence")
+            p = Packer()
+            p.pack_uhyper(am.sequence)
+            StellarMessage.pack(p, msg)
+            if not c25519.verify_hmac_sha256(self.recv_key, p.bytes(),
+                                             am.mac.mac):
+                return self.drop("bad MAC")
+            self.recv_seq += 1
+        self._recv_message(msg)
+
+    # ---------------- dispatch ----------------
+
+    def _recv_message(self, msg):
+        t = msg.arm
+        if t == MessageType.HELLO:
+            return self._recv_hello(msg.value)
+        if t == MessageType.AUTH:
+            return self._recv_auth()
+        if self.state != PEER_STATE.GOT_AUTH:
+            return self.drop("message before AUTH")
+        if t == MessageType.SEND_MORE:
+            self.flow.receive_credits(msg.value.numMessages)
+            return
+        if t == MessageType.SEND_MORE_EXTENDED:
+            self.flow.receive_credits(msg.value.numMessages)
+            return
+        if t in FLOOD_TYPES:
+            grant = self.flow.note_received()
+            if grant:
+                self._send_message(StellarMessage.make(
+                    MessageType.SEND_MORE_EXTENDED,
+                    SendMoreExtended(numMessages=grant,
+                                     numBytes=grant * 0x10000)))
+        self.app.overlay.recv_message(self, msg)
+
+    def _recv_hello(self, hello: Hello):
+        if self.state not in (PEER_STATE.CONNECTED,):
+            return self.drop("duplicate HELLO")
+        if hello.networkID != self.app.herder.network_id:
+            return self.drop("wrong network")
+        now = self.app.clock.system_now()
+        remote_id = hello.peerID.value
+        if remote_id == self.app.herder.scp.local_node_id:
+            return self.drop("connected to self")
+        if not self.app.peer_auth.verify_remote_cert(
+                hello.cert, remote_id, now):
+            self._send_message(StellarMessage.make(
+                MessageType.ERROR_MSG,
+                ErrorMsg(code=ErrorCode.ERR_AUTH, msg=b"bad cert")))
+            return self.drop("bad auth cert")
+        self.remote_node_id = remote_id
+        self.remote_nonce = hello.nonce
+        self.send_key, self.recv_key = self.app.peer_auth.shared_keys(
+            hello.cert.pubkey.key, self.local_nonce, hello.nonce,
+            self.we_called)
+        self.state = PEER_STATE.GOT_HELLO
+        if not self.we_called:
+            self._send_hello()
+        self._send_auth()
+
+    def _recv_auth(self):
+        if self.state != PEER_STATE.GOT_HELLO:
+            return self.drop("AUTH out of order")
+        self.state = PEER_STATE.GOT_AUTH
+        # initial flood credits for the remote
+        self._send_message(StellarMessage.make(
+            MessageType.SEND_MORE_EXTENDED,
+            SendMoreExtended(numMessages=PEER_FLOOD_READING_CAPACITY,
+                             numBytes=PEER_FLOOD_READING_CAPACITY
+                             * 0x10000)))
+        self.app.overlay.peer_authenticated(self)
+
+    # ---------------- outbound API ----------------
+
+    def send(self, msg):
+        """Queue-or-send respecting flow control for flood traffic."""
+        if self.state != PEER_STATE.GOT_AUTH:
+            return
+        if msg.arm in FLOOD_TYPES and not self.flow.can_send():
+            return  # dropped under backpressure (reference load shedding)
+        self._send_message(msg)
+
+    def is_authenticated(self) -> bool:
+        return self.state == PEER_STATE.GOT_AUTH
+
+    def drop(self, reason: str = ""):
+        self.state = PEER_STATE.CLOSING
+        if self.on_drop is not None:
+            self.on_drop(self, reason)
+        self.app.overlay.peer_dropped(self, reason)
