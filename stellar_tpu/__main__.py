@@ -1,0 +1,5 @@
+import sys
+
+from stellar_tpu.main.cli import main
+
+sys.exit(main())

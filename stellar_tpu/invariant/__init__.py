@@ -1,0 +1,19 @@
+"""Invariant subsystem. The active manager is process-global (one node
+per process in production; tests swap it per fixture)."""
+
+from typing import Optional
+
+from stellar_tpu.invariant.invariants import (  # noqa: F401
+    InvariantDoesNotHold, InvariantManager,
+)
+
+_active: Optional[InvariantManager] = None
+
+
+def set_active_manager(mgr: Optional[InvariantManager]):
+    global _active
+    _active = mgr
+
+
+def get_active_manager() -> Optional[InvariantManager]:
+    return _active

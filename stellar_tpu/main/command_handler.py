@@ -1,0 +1,165 @@
+"""HTTP admin API (reference ``src/main/CommandHandler.cpp:90-134``):
+info, metrics, peers, tx submit, manualclose, ll, scp/quorum
+introspection — served off the node's crank loop."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["CommandHandler"]
+
+
+class CommandHandler:
+    """Routes are handled on the HTTP thread but all node state access
+    is marshalled onto the main thread via post_to_main + an event —
+    the reference's single-writer discipline."""
+
+    def __init__(self, app, port: int = 0):
+        self.app = app
+        handler = self._make_handler()
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+
+    def _on_main(self, fn):
+        """Run fn on the cranking thread; block for the result."""
+        done = threading.Event()
+        box = {}
+
+        def run():
+            try:
+                box["out"] = fn()
+            except Exception as e:  # surfaced as a 500
+                box["err"] = str(e)
+            done.set()
+        self.app.clock.post_to_main(run, name="http-command")
+        if not done.wait(timeout=10.0):
+            raise TimeoutError("main thread did not respond")
+        if "err" in box:
+            raise RuntimeError(box["err"])
+        return box.get("out")
+
+    # ---------------- commands ----------------
+
+    def cmd_info(self, params):
+        return self._on_main(self.app.info)
+
+    def cmd_metrics(self, params):
+        from stellar_tpu.utils.metrics import registry
+        return self._on_main(registry.to_dict)
+
+    def cmd_peers(self, params):
+        def peers():
+            out = []
+            for p in self.app.overlay.peers:
+                out.append({
+                    "id": p.remote_node_id.hex()
+                    if p.remote_node_id else None,
+                    "authenticated": p.is_authenticated(),
+                })
+            return {"authenticated_peers": out}
+        return self._on_main(peers)
+
+    def cmd_tx(self, params):
+        blob = params.get("blob", [None])[0]
+        if blob is None:
+            return {"status": "ERROR", "detail": "missing blob param"}
+
+        def submit():
+            import base64
+            from stellar_tpu.tx.transaction_frame import (
+                make_transaction_frame,
+            )
+            from stellar_tpu.xdr.runtime import from_bytes
+            from stellar_tpu.xdr.tx import TransactionEnvelope
+            raw = base64.b64decode(blob)
+            env = from_bytes(TransactionEnvelope, raw)
+            frame = make_transaction_frame(self.app.herder.network_id, env)
+            res = self.app.herder.recv_transaction(frame)
+            from stellar_tpu.herder.transaction_queue import AddResult
+            names = {AddResult.ADD_STATUS_PENDING: "PENDING",
+                     AddResult.ADD_STATUS_DUPLICATE: "DUPLICATE",
+                     AddResult.ADD_STATUS_ERROR: "ERROR",
+                     AddResult.ADD_STATUS_TRY_AGAIN_LATER:
+                         "TRY_AGAIN_LATER",
+                     AddResult.ADD_STATUS_BANNED: "BANNED"}
+            out = {"status": names.get(res.code, "?")}
+            if res.tx_result is not None:
+                out["error_result_code"] = res.tx_result.code
+            return out
+        return self._on_main(submit)
+
+    def cmd_manualclose(self, params):
+        return self._on_main(self.app.manual_close)
+
+    def cmd_quorum(self, params):
+        def quorum():
+            from stellar_tpu.scp.quorum import for_all_nodes
+            q = self.app.herder.scp.local_qset
+            return {"threshold": q.threshold,
+                    "validators": [v.hex()[:16]
+                                   for v in for_all_nodes(q)]}
+        return self._on_main(quorum)
+
+    def cmd_scp(self, params):
+        def scp():
+            out = {}
+            for idx, slot in self.app.herder.scp.known_slots.items():
+                out[str(idx)] = {
+                    "phase": slot.ballot.phase,
+                    "nomination_round":
+                        slot.nomination.round_number,
+                    "statements": len(slot.statements_history),
+                }
+            return out
+        return self._on_main(scp)
+
+    def cmd_ll(self, params):
+        level = params.get("level", [None])[0]
+        partition = params.get("partition", ["root"])[0]
+        from stellar_tpu.utils.logging import set_log_level
+        if level:
+            set_log_level(None if partition == "root" else partition,
+                          level)
+        return {"partition": partition, "level": level or "unchanged"}
+
+    ROUTES = {
+        "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
+        "tx": cmd_tx, "manualclose": cmd_manualclose,
+        "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
+    }
+
+    def _make_handler(outer_self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                route = parsed.path.strip("/")
+                fn = CommandHandler.ROUTES.get(route)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "unknown command"}')
+                    return
+                try:
+                    out = fn(outer_self, parse_qs(parsed.query))
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+        return Handler

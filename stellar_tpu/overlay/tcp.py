@@ -1,0 +1,156 @@
+"""TCP peer transport (reference ``src/overlay/TCPPeer.cpp`` +
+``PeerDoor.cpp``): length-prefixed AuthenticatedMessage frames over
+non-blocking sockets, polled from the node's crank loop — the same
+single-threaded-I/O discipline as the reference's asio handlers.
+"""
+
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+import struct
+from typing import Dict, Optional
+
+from stellar_tpu.overlay.peer import Peer
+
+__all__ = ["TCPPeer", "PeerDoor", "TCPDriver"]
+
+MAX_MESSAGE_SIZE = 0x1000000  # 16 MiB frame cap (reference MAX_MESSAGE_SIZE)
+
+
+class TCPPeer(Peer):
+    def __init__(self, app, we_called: bool, sock: socket.socket):
+        super().__init__(app, we_called)
+        self.sock = sock
+        self.sock.setblocking(False)
+        self._rx = bytearray()
+        self._txq = bytearray()
+
+    def send_bytes(self, raw: bytes):
+        self._txq += struct.pack(">I", len(raw)) + raw
+        self._try_flush()
+
+    def _try_flush(self):
+        while self._txq:
+            try:
+                n = self.sock.send(self._txq)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return self.drop("socket write error")
+            if n <= 0:
+                return
+            del self._txq[:n]
+
+    def on_readable(self):
+        try:
+            chunk = self.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            return self.drop("socket read error")
+        if not chunk:
+            return self.drop("remote closed")
+        self._rx += chunk
+        while len(self._rx) >= 4:
+            (n,) = struct.unpack_from(">I", self._rx, 0)
+            if n > MAX_MESSAGE_SIZE:
+                return self.drop("oversized frame")
+            if len(self._rx) < 4 + n:
+                break
+            frame = bytes(self._rx[4:4 + n])
+            del self._rx[:4 + n]
+            self.receive_bytes(frame)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PeerDoor:
+    """Listening socket accepting inbound peers (reference
+    ``PeerDoor``)."""
+
+    def __init__(self, app, port: int):
+        self.app = app
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("127.0.0.1", port))
+        self.listener.listen(16)
+        self.listener.setblocking(False)
+        self.port = self.listener.getsockname()[1]
+
+    def try_accept(self) -> Optional[TCPPeer]:
+        try:
+            sock, _addr = self.listener.accept()
+        except (BlockingIOError, InterruptedError):
+            return None
+        peer = TCPPeer(self.app, we_called=False, sock=sock)
+        self.app.overlay.add_pending(peer)
+        return peer
+
+    def close(self):
+        self.listener.close()
+
+
+class TCPDriver:
+    """Polls sockets as a recurring clock action (the asio io_context
+    role). One per node process."""
+
+    def __init__(self, app, listen_port: int = 0):
+        self.app = app
+        self.door = PeerDoor(app, listen_port)
+        self.peers: list = []
+        self._pump_armed = False
+        self.arm()
+
+    def connect(self, host: str, port: int) -> TCPPeer:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.connect((host, port))
+        except BlockingIOError:
+            pass
+        peer = TCPPeer(self.app, we_called=True, sock=sock)
+        self.app.overlay.add_pending(peer)
+        self.peers.append(peer)
+        # handshake begins once the socket is writable; send eagerly
+        # (bytes queue until the connect completes)
+        peer.start_handshake()
+        return peer
+
+    def poll(self):
+        newp = self.door.try_accept()
+        if newp is not None:
+            self.peers.append(newp)
+        from stellar_tpu.overlay.peer import PEER_STATE
+        for p in list(self.peers):
+            if p.state == PEER_STATE.CLOSING:
+                p.close()
+                self.peers.remove(p)
+                continue
+            p.on_readable()
+            p._try_flush()
+
+    def arm(self):
+        """Keep polling scheduled off the clock (REAL_TIME cranks)."""
+        if self._pump_armed:
+            return
+        self._pump_armed = True
+        from stellar_tpu.utils.timer import VirtualTimer
+        timer = VirtualTimer(self.app.clock)
+
+        def tick():
+            self.poll()
+            timer.expires_from_now(0.005)
+            timer.async_wait(tick)
+        timer.expires_from_now(0.0)
+        timer.async_wait(tick)
+
+    def close(self):
+        self.door.close()
+        for p in self.peers:
+            p.close()

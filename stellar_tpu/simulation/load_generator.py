@@ -1,0 +1,106 @@
+"""Load generation + apply-load benchmarking (reference
+``src/simulation/LoadGenerator.h:30-49`` modes and ``ApplyLoad.h:14-55``
+— synthetic tx queues driven through the real close pipeline, measuring
+the ``ledger.ledger.close`` timer)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from stellar_tpu.crypto.keys import SecretKey
+from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
+from stellar_tpu.utils.metrics import registry
+
+__all__ = ["LoadGenerator", "apply_load"]
+
+XLM = 10_000_000
+
+
+class LoadGenerator:
+    """Paced synthetic traffic through a real herder (reference
+    ``LoadGenerator``: CREATE + PAY modes)."""
+
+    def __init__(self, app, n_accounts: int = 16):
+        self.app = app
+        self.accounts: List[SecretKey] = [
+            SecretKey.from_seed_str(f"loadgen-{i}")
+            for i in range(n_accounts)]
+        self.seqs = {}
+        self.submitted = 0
+
+    def account_keys(self):
+        return self.accounts
+
+    def generate_load(self, n_txs: int, source_balances_known=True):
+        """Submit n payment txs round-robin across accounts."""
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.tx.op_frame import account_key
+        from stellar_tpu.tx.tx_test_utils import make_tx, payment_op
+        from stellar_tpu.xdr.types import account_id
+        herder = self.app.herder
+        for i in range(n_txs):
+            src = self.accounts[i % len(self.accounts)]
+            dst = self.accounts[(i + 1) % len(self.accounts)]
+            raw = src.public_key.raw
+            if raw not in self.seqs:
+                e = herder.lm.root.store.get(
+                    key_bytes(account_key(account_id(raw))))
+                if e is None:
+                    continue
+                self.seqs[raw] = e.data.value.seqNum
+            self.seqs[raw] += 1
+            tx = make_tx(src, self.seqs[raw], [payment_op(dst, XLM)],
+                         network_id=herder.network_id)
+            herder.recv_transaction(tx)
+            self.submitted += 1
+
+
+def apply_load(n_ledgers: int = 10, txs_per_ledger: int = 100,
+               n_accounts: int = 64) -> dict:
+    """Standalone close-ledger benchmark (reference ``apply-load``):
+    build txsets from a synthetic queue and drive closeLedger, reporting
+    the close-timer distribution."""
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, make_tx, payment_op, seed_root_with_accounts,
+    )
+    keys = [SecretKey.from_seed_str(f"applyload-{i}")
+            for i in range(n_accounts)]
+    root = seed_root_with_accounts([(k, 10**13) for k in keys])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.maxTxSetSize = max(1000, txs_per_ledger * 2)
+    close_timer = registry.timer("ledger.ledger.close")
+    seqs = {k.public_key.raw: (1 << 32) for k in keys}
+    total_applied = 0
+    for ledger_i in range(n_ledgers):
+        frames = []
+        for t in range(txs_per_ledger):
+            src = keys[t % len(keys)]
+            dst = keys[(t + 1) % len(keys)]
+            seqs[src.public_key.raw] += 1
+            frames.append(make_tx(
+                src, seqs[src.public_key.raw], [payment_op(dst, XLM)]))
+        txset, excluded = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash)
+        with close_timer.time():
+            res = lm.close_ledger(LedgerCloseData(
+                lm.ledger_seq + 1, txset,
+                lm.last_closed_header.scpValue.closeTime + 5))
+        if res.failed_count:
+            raise RuntimeError(f"apply-load tx failures: "
+                               f"{res.failed_count}")
+        total_applied += res.applied_count
+    stats = close_timer.to_dict()
+    return {
+        "ledgers": n_ledgers,
+        "txs_per_ledger": txs_per_ledger,
+        "total_applied": total_applied,
+        "close_min_ms": stats["min_ms"],
+        "close_mean_ms": stats["mean_ms"],
+        "close_max_ms": stats["max_ms"],
+        "close_stddev_ms": stats["stddev_ms"],
+        "tx_apply_per_sec": round(
+            total_applied / (stats["mean_ms"] * n_ledgers / 1000.0), 1)
+        if stats["mean_ms"] else 0.0,
+    }

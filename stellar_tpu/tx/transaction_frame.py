@@ -541,6 +541,14 @@ class TransactionFrame:
                 if success:
                     op_metas.append(op_txn.get_changes())
                 if ok:
+                    # post-condition checks over the op's delta
+                    # (reference checkOnOperationApply via AppConnector)
+                    from stellar_tpu.invariant import get_active_manager
+                    mgr = get_active_manager()
+                    if mgr is not None:
+                        mgr.check_on_operation_apply(
+                            op, op_res, op_txn.get_delta(),
+                            op_txn.header())
                     op_txn.commit()
                 else:
                     op_txn.rollback()

@@ -1,0 +1,129 @@
+"""Metrics registry (the reference vendors libmedida: meters, counters,
+timers, histograms keyed by dotted names, exported via the HTTP
+``metrics`` endpoint — ``docs/metrics.md``)."""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List
+
+__all__ = ["Counter", "Meter", "Timer", "MetricsRegistry", "registry"]
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    def inc(self, n: int = 1):
+        self.count += n
+
+    def dec(self, n: int = 1):
+        self.count -= n
+
+    def to_dict(self):
+        return {"type": "counter", "count": self.count}
+
+
+class Meter:
+    """Event rate: count + 1-minute-window rate."""
+
+    def __init__(self):
+        self.count = 0
+        self._events: List[float] = []
+
+    def mark(self, n: int = 1):
+        self.count += n
+        now = time.monotonic()
+        self._events.append(now)
+        cutoff = now - 60.0
+        while self._events and self._events[0] < cutoff:
+            self._events.pop(0)
+
+    def one_minute_rate(self) -> float:
+        return len(self._events) / 60.0
+
+    def to_dict(self):
+        return {"type": "meter", "count": self.count,
+                "1m_rate": round(self.one_minute_rate(), 4)}
+
+
+class Timer:
+    """Duration stats: count/min/mean/max/stddev (ms)."""
+
+    def __init__(self):
+        self.count = 0
+        self._sum = 0.0
+        self._sum2 = 0.0
+        self.min_ms = math.inf
+        self.max_ms = 0.0
+
+    def update_ms(self, ms: float):
+        self.count += 1
+        self._sum += ms
+        self._sum2 += ms * ms
+        self.min_ms = min(self.min_ms, ms)
+        self.max_ms = max(self.max_ms, ms)
+
+    def time(self):
+        t0 = time.perf_counter()
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                timer.update_ms((time.perf_counter() - t0) * 1000.0)
+                return False
+        return _Ctx()
+
+    def mean_ms(self) -> float:
+        return self._sum / self.count if self.count else 0.0
+
+    def stddev_ms(self) -> float:
+        if self.count < 2:
+            return 0.0
+        m = self.mean_ms()
+        var = max(0.0, self._sum2 / self.count - m * m)
+        return math.sqrt(var)
+
+    def to_dict(self):
+        return {"type": "timer", "count": self.count,
+                "min_ms": 0.0 if math.isinf(self.min_ms) else
+                round(self.min_ms, 3),
+                "mean_ms": round(self.mean_ms(), 3),
+                "max_ms": round(self.max_ms, 3),
+                "stddev_ms": round(self.stddev_ms(), 3)}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls()
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def to_dict(self) -> dict:
+        return {name: m.to_dict()
+                for name, m in sorted(self._metrics.items())}
+
+    def clear(self):
+        self._metrics.clear()
+
+
+# process-wide registry (the reference's per-app medida registry; one
+# node per process in production)
+registry = MetricsRegistry()
