@@ -1,0 +1,244 @@
+// Native bucket-stream runtime: record-framed XDR stream hashing,
+// splitting, and sorted merging — the host-side hot loops behind the
+// bucket list state store (the reference implements these in C++ in
+// src/bucket/{BucketOutputIterator,BucketBase}.cpp; here they are the
+// native backend behind stellar_tpu/utils/native.py with a pure-Python
+// fallback, differential-tested against it).
+//
+// Build: g++ -O2 -shared -fPIC -o libbucketstream.so bucket_stream.cpp
+//
+// ABI: plain C functions over byte buffers (ctypes-friendly).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), self-contained.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Sha256 {
+    uint32_t h[8];
+    uint64_t len = 0;
+    uint8_t buf[64];
+    size_t buflen = 0;
+
+    Sha256() {
+        static const uint32_t init[8] = {
+            0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+            0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+        memcpy(h, init, sizeof(h));
+    }
+
+    static uint32_t rotr(uint32_t x, int n) {
+        return (x >> n) | (x << (32 - n));
+    }
+
+    void block(const uint8_t* p) {
+        static const uint32_t K[64] = {
+            0x428a2f98u,0x71374491u,0xb5c0fbcfu,0xe9b5dba5u,0x3956c25bu,
+            0x59f111f1u,0x923f82a4u,0xab1c5ed5u,0xd807aa98u,0x12835b01u,
+            0x243185beu,0x550c7dc3u,0x72be5d74u,0x80deb1feu,0x9bdc06a7u,
+            0xc19bf174u,0xe49b69c1u,0xefbe4786u,0x0fc19dc6u,0x240ca1ccu,
+            0x2de92c6fu,0x4a7484aau,0x5cb0a9dcu,0x76f988dau,0x983e5152u,
+            0xa831c66du,0xb00327c8u,0xbf597fc7u,0xc6e00bf3u,0xd5a79147u,
+            0x06ca6351u,0x14292967u,0x27b70a85u,0x2e1b2138u,0x4d2c6dfcu,
+            0x53380d13u,0x650a7354u,0x766a0abbu,0x81c2c92eu,0x92722c85u,
+            0xa2bfe8a1u,0xa81a664bu,0xc24b8b70u,0xc76c51a3u,0xd192e819u,
+            0xd6990624u,0xf40e3585u,0x106aa070u,0x19a4c116u,0x1e376c08u,
+            0x2748774cu,0x34b0bcb5u,0x391c0cb3u,0x4ed8aa4au,0x5b9cca4fu,
+            0x682e6ff3u,0x748f82eeu,0x78a5636fu,0x84c87814u,0x8cc70208u,
+            0x90befffau,0xa4506cebu,0xbef9a3f7u,0xc67178f2u};
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (uint32_t(p[4*i]) << 24) | (uint32_t(p[4*i+1]) << 16) |
+                   (uint32_t(p[4*i+2]) << 8) | uint32_t(p[4*i+3]);
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i-15],7) ^ rotr(w[i-15],18) ^ (w[i-15]>>3);
+            uint32_t s1 = rotr(w[i-2],17) ^ rotr(w[i-2],19) ^ (w[i-2]>>10);
+            w[i] = w[i-16] + s0 + w[i-7] + s1;
+        }
+        uint32_t a=h[0],b=h[1],c=h[2],d=h[3],e=h[4],f=h[5],g=h[6],hh=h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr(e,6) ^ rotr(e,11) ^ rotr(e,25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+            uint32_t S0 = rotr(a,2) ^ rotr(a,13) ^ rotr(a,22);
+            uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = S0 + mj;
+            hh=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+        }
+        h[0]+=a; h[1]+=b; h[2]+=c; h[3]+=d;
+        h[4]+=e; h[5]+=f; h[6]+=g; h[7]+=hh;
+    }
+
+    void update(const uint8_t* p, size_t n) {
+        len += n;
+        if (buflen) {
+            size_t take = 64 - buflen;
+            if (take > n) take = n;
+            memcpy(buf + buflen, p, take);
+            buflen += take;
+            p += take;
+            n -= take;
+            if (buflen == 64) { block(buf); buflen = 0; }
+        }
+        while (n >= 64) { block(p); p += 64; n -= 64; }
+        if (n) { memcpy(buf, p, n); buflen = n; }
+    }
+
+    void final(uint8_t out[32]) {
+        uint64_t bits = len * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t z = 0;
+        while (buflen != 56) update(&z, 1);
+        uint8_t lb[8];
+        for (int i = 0; i < 8; i++) lb[i] = uint8_t(bits >> (56 - 8*i));
+        update(lb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4*i]   = uint8_t(h[i] >> 24);
+            out[4*i+1] = uint8_t(h[i] >> 16);
+            out[4*i+2] = uint8_t(h[i] >> 8);
+            out[4*i+3] = uint8_t(h[i]);
+        }
+    }
+};
+
+inline void put_mark(std::vector<uint8_t>& out, uint32_t n) {
+    uint32_t m = 0x80000000u | n;
+    out.push_back(uint8_t(m >> 24));
+    out.push_back(uint8_t(m >> 16));
+    out.push_back(uint8_t(m >> 8));
+    out.push_back(uint8_t(m));
+}
+
+}  // namespace
+
+extern "C" {
+
+// SHA-256 of a raw buffer. out must hold 32 bytes.
+void bs_sha256(const uint8_t* data, uint64_t n, uint8_t* out) {
+    Sha256 s;
+    s.update(data, n);
+    s.final(out);
+}
+
+// Hash a record-framed stream built from `count` frames given as one
+// concatenated blob + per-frame lengths: the bucket content hash
+// (frame mark = 0x80000000 | len, big-endian, then the XDR body).
+void bs_hash_frames(const uint8_t* blob, const uint64_t* lens,
+                    uint64_t count, uint8_t* out) {
+    Sha256 s;
+    const uint8_t* p = blob;
+    for (uint64_t i = 0; i < count; i++) {
+        uint32_t n = uint32_t(lens[i]);
+        uint8_t mark[4] = {uint8_t(0x80u | (n >> 24)), uint8_t(n >> 16),
+                           uint8_t(n >> 8), uint8_t(n)};
+        s.update(mark, 4);
+        s.update(p, n);
+        p += n;
+    }
+    s.final(out);
+}
+
+// Serialize frames into one record-marked stream. Returns total bytes
+// written (caller sizes out as sum(lens) + 4*count).
+uint64_t bs_join_frames(const uint8_t* blob, const uint64_t* lens,
+                        uint64_t count, uint8_t* out) {
+    uint64_t w = 0;
+    const uint8_t* p = blob;
+    for (uint64_t i = 0; i < count; i++) {
+        uint32_t n = uint32_t(lens[i]);
+        out[w++] = uint8_t(0x80u | (n >> 24));
+        out[w++] = uint8_t(n >> 16);
+        out[w++] = uint8_t(n >> 8);
+        out[w++] = uint8_t(n);
+        memcpy(out + w, p, n);
+        w += n;
+        p += n;
+    }
+    return w;
+}
+
+// Count frames in a record-marked stream; returns count, or
+// (uint64_t)-1 on framing corruption.
+uint64_t bs_count_frames(const uint8_t* raw, uint64_t n) {
+    uint64_t pos = 0, count = 0;
+    while (pos < n) {
+        if (pos + 4 > n) return (uint64_t)-1;
+        uint32_t m = (uint32_t(raw[pos]) << 24) |
+                     (uint32_t(raw[pos+1]) << 16) |
+                     (uint32_t(raw[pos+2]) << 8) | uint32_t(raw[pos+3]);
+        uint32_t len = m & 0x7FFFFFFFu;
+        pos += 4;
+        if (pos + len > n) return (uint64_t)-1;
+        pos += len;
+        count++;
+    }
+    return count;
+}
+
+// Split a record-marked stream: writes each frame's (offset, length)
+// into offs/lens (caller sized via bs_count_frames). Returns count.
+uint64_t bs_split_frames(const uint8_t* raw, uint64_t n,
+                         uint64_t* offs, uint64_t* lens) {
+    uint64_t pos = 0, count = 0;
+    while (pos + 4 <= n) {
+        uint32_t m = (uint32_t(raw[pos]) << 24) |
+                     (uint32_t(raw[pos+1]) << 16) |
+                     (uint32_t(raw[pos+2]) << 8) | uint32_t(raw[pos+3]);
+        uint32_t len = m & 0x7FFFFFFFu;
+        pos += 4;
+        offs[count] = pos;
+        lens[count] = len;
+        pos += len;
+        count++;
+    }
+    return count;
+}
+
+// Two-way sorted merge of pre-keyed frame arrays (the bucket merge
+// inner loop). Inputs: for each side, a key blob + key lengths and a
+// frame blob + frame lengths (parallel arrays, already sorted by key
+// ascending, unique keys per side). Emits, per output slot, the source
+// side (0=old, 1=new, 2=equal-keys-pair) and the indices; the Python
+// layer applies the INIT/LIVE/DEAD fusion on the (tiny) equal-key set.
+// Returns the number of output slots. sides/idx_old/idx_new must hold
+// n_old + n_new entries.
+uint64_t bs_merge_plan(const uint8_t* keys_old, const uint64_t* klens_old,
+                       uint64_t n_old,
+                       const uint8_t* keys_new, const uint64_t* klens_new,
+                       uint64_t n_new,
+                       uint8_t* sides, uint64_t* idx_old,
+                       uint64_t* idx_new) {
+    std::vector<uint64_t> off_old(n_old + 1, 0), off_new(n_new + 1, 0);
+    for (uint64_t i = 0; i < n_old; i++)
+        off_old[i + 1] = off_old[i] + klens_old[i];
+    for (uint64_t i = 0; i < n_new; i++)
+        off_new[i + 1] = off_new[i] + klens_new[i];
+    uint64_t i = 0, j = 0, w = 0;
+    while (i < n_old && j < n_new) {
+        const uint8_t* a = keys_old + off_old[i];
+        const uint8_t* b = keys_new + off_new[j];
+        uint64_t la = klens_old[i], lb = klens_new[j];
+        uint64_t common = la < lb ? la : lb;
+        int c = memcmp(a, b, common);
+        if (c == 0) c = (la < lb) ? -1 : (la > lb ? 1 : 0);
+        if (c < 0) {
+            sides[w] = 0; idx_old[w] = i; idx_new[w] = 0; i++;
+        } else if (c > 0) {
+            sides[w] = 1; idx_old[w] = 0; idx_new[w] = j; j++;
+        } else {
+            sides[w] = 2; idx_old[w] = i; idx_new[w] = j; i++; j++;
+        }
+        w++;
+    }
+    while (i < n_old) { sides[w] = 0; idx_old[w] = i++; idx_new[w] = 0; w++; }
+    while (j < n_new) { sides[w] = 1; idx_old[w] = 0; idx_new[w] = j++; w++; }
+    return w;
+}
+
+}  // extern "C"

@@ -74,27 +74,21 @@ class Bucket:
             if not self.entries:
                 self._hash = b"\x00" * 32
             else:
-                h = hashlib.sha256()
-                for e in self.entries:
-                    h.update(_record_frame(to_bytes(BucketEntry, e)))
-                self._hash = h.digest()
+                from stellar_tpu.utils import native
+                self._hash = native.hash_frames(
+                    [to_bytes(BucketEntry, e) for e in self.entries])
         return self._hash
 
     def serialize(self) -> bytes:
-        return b"".join(_record_frame(to_bytes(BucketEntry, e))
-                        for e in self.entries)
+        from stellar_tpu.utils import native
+        return native.join_frames(
+            [to_bytes(BucketEntry, e) for e in self.entries])
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "Bucket":
-        entries = []
-        pos = 0
-        while pos < len(raw):
-            (marked,) = struct.unpack_from(">I", raw, pos)
-            n = marked & 0x7FFFFFFF
-            pos += 4
-            entries.append(from_bytes(BucketEntry, raw[pos:pos + n]))
-            pos += n
-        return cls(entries)
+        from stellar_tpu.utils import native
+        return cls([from_bytes(BucketEntry, f)
+                    for f in native.split_frames(raw)])
 
     # ---------------- lookups ----------------
 
@@ -174,9 +168,11 @@ def _merge_equal_keys(old, new):
 def merge_buckets(old: Bucket, new: Bucket, protocol_version: int,
                   keep_tombstones: bool = True) -> Bucket:
     """Two-way sorted merge, new over old (reference
-    ``BucketBase::merge``; shadows are gone in current protocol)."""
+    ``BucketBase::merge``; shadows are gone in current protocol). The
+    merge plan runs in the native runtime; only equal-key pairs (rare)
+    come back to Python for INIT/LIVE/DEAD fusion."""
+    from stellar_tpu.utils import native
     out = []
-    oi = ni = 0
     oe = [e for e in old.entries if e.arm != BET.METAENTRY]
     ne = [e for e in new.entries if e.arm != BET.METAENTRY]
 
@@ -185,27 +181,17 @@ def merge_buckets(old: Bucket, new: Bucket, protocol_version: int,
             return
         out.append(e)
 
-    while oi < len(oe) and ni < len(ne):
-        ok = _entry_sort_key(oe[oi])
-        nk = _entry_sort_key(ne[ni])
-        if ok < nk:
-            put(oe[oi])
-            oi += 1
-        elif nk < ok:
-            put(ne[ni])
-            ni += 1
+    plan = native.merge_plan([_entry_sort_key(e) for e in oe],
+                             [_entry_sort_key(e) for e in ne])
+    for side, i, j in plan:
+        if side == 0:
+            put(oe[i])
+        elif side == 1:
+            put(ne[j])
         else:
-            merged = _merge_equal_keys(oe[oi], ne[ni])
+            merged = _merge_equal_keys(oe[i], ne[j])
             if merged is not None:
                 put(merged)
-            oi += 1
-            ni += 1
-    while oi < len(oe):
-        put(oe[oi])
-        oi += 1
-    while ni < len(ne):
-        put(ne[ni])
-        ni += 1
 
     if not out:
         return EMPTY

@@ -55,6 +55,12 @@ class Application:
                 [FileArchive(p) for p in config.HISTORY_ARCHIVES],
                 config.NETWORK_PASSPHRASE)
         self.herder.on_externalized = self._on_externalized
+        if config.INVARIANT_CHECKS:
+            from stellar_tpu.invariant import (
+                InvariantManager, set_active_manager,
+            )
+            set_active_manager(
+                InvariantManager(config.INVARIANT_CHECKS))
         self._started = False
 
     # ---------------- lifecycle ----------------

@@ -44,15 +44,20 @@ class TCPPeer(Peer):
             del self._txq[:n]
 
     def on_readable(self):
-        try:
-            chunk = self.sock.recv(65536)
-        except (BlockingIOError, InterruptedError):
-            return
-        except OSError:
-            return self.drop("socket read error")
-        if not chunk:
-            return self.drop("remote closed")
-        self._rx += chunk
+        # drain the socket fully each poll tick (a single recv would cap
+        # throughput at 64 KiB per 5 ms)
+        while True:
+            try:
+                chunk = self.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                return self.drop("socket read error")
+            if not chunk:
+                return self.drop("remote closed")
+            self._rx += chunk
+            if len(chunk) < 65536:
+                break
         while len(self._rx) >= 4:
             (n,) = struct.unpack_from(">I", self._rx, 0)
             if n > MAX_MESSAGE_SIZE:
@@ -141,16 +146,22 @@ class TCPDriver:
             return
         self._pump_armed = True
         from stellar_tpu.utils.timer import VirtualTimer
-        timer = VirtualTimer(self.app.clock)
+        self._timer = VirtualTimer(self.app.clock)
 
         def tick():
+            if not self._pump_armed:
+                return
             self.poll()
-            timer.expires_from_now(0.005)
-            timer.async_wait(tick)
-        timer.expires_from_now(0.0)
-        timer.async_wait(tick)
+            self._timer.expires_from_now(0.005)
+            self._timer.async_wait(tick)
+        self._timer.expires_from_now(0.0)
+        self._timer.async_wait(tick)
 
     def close(self):
+        self._pump_armed = False
+        if hasattr(self, "_timer"):
+            self._timer.cancel()
         self.door.close()
         for p in self.peers:
             p.close()
+        self.peers.clear()
