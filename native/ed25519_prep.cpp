@@ -1,0 +1,277 @@
+// Native host-prep for the TPU batch ed25519 verifier.
+//
+// The device kernel (stellar_tpu/ops/verify.py) needs, per signature,
+// h = SHA-512(R || A || M) reduced mod the ed25519 group order L. Doing
+// this in a Python loop costs ~12 ms for a 2048-signature TxSet — more
+// than the TPU kernel itself — so the batch hash+reduce runs here as a
+// multithreaded C++ routine (analog of the host-side hashing the
+// reference does inside libsodium's crypto_sign_verify_detached behind
+// PubKeyUtils::verifySig, src/crypto/SecretKey.cpp:435-468).
+//
+// Self-contained: SHA-512 per FIPS 180-4 (constants generated from the
+// primes' cube/square roots), mod-L reduction via 32-bit Horner steps
+// with an approximate-quotient correction (see ed25519_mod_l below).
+//
+// Exposed C ABI (ctypes, see stellar_tpu/crypto/native_prep.py):
+//   ed25519_prep_batch(r, a, msgs, offs, lens, n, nthreads, h_out)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+static const uint64_t K512[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL, 0xe9b5dba58189dbbcULL,
+    0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL, 0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL,
+    0xd807aa98a3030242ULL, 0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL, 0xc19bf174cf692694ULL,
+    0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL, 0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL,
+    0x2de92c6f592b0275ULL, 0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL, 0xbf597fc7beef0ee4ULL,
+    0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL, 0x06ca6351e003826fULL, 0x142929670a0e6e70ULL,
+    0x27b70a8546d22ffcULL, 0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL, 0x92722c851482353bULL,
+    0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL, 0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL,
+    0xd192e819d6ef5218ULL, 0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL, 0x34b0bcb5e19b48a8ULL,
+    0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL, 0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL,
+    0x748f82ee5defb2fcULL, 0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL, 0xc67178f2e372532bULL,
+    0xca273eceea26619cULL, 0xd186b8c721c0c207ULL, 0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL,
+    0x06f067aa72176fbaULL, 0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL, 0x431d67c49c100d4cULL,
+    0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL, 0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL,
+};
+static const uint64_t H512[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+    0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL, 0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+inline uint64_t rotr(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+inline uint64_t be64(const uint8_t* p) {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+    return v;
+}
+
+void sha512_compress(uint64_t st[8], const uint8_t* block) {
+    uint64_t w[80];
+    for (int i = 0; i < 16; i++) w[i] = be64(block + 8 * i);
+    for (int i = 16; i < 80; i++) {
+        uint64_t s0 = rotr(w[i - 15], 1) ^ rotr(w[i - 15], 8) ^ (w[i - 15] >> 7);
+        uint64_t s1 = rotr(w[i - 2], 19) ^ rotr(w[i - 2], 61) ^ (w[i - 2] >> 6);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint64_t a = st[0], b = st[1], c = st[2], d = st[3];
+    uint64_t e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 80; i++) {
+        uint64_t S1 = rotr(e, 14) ^ rotr(e, 18) ^ rotr(e, 41);
+        uint64_t ch = (e & f) ^ (~e & g);
+        uint64_t t1 = h + S1 + ch + K512[i] + w[i];
+        uint64_t S0 = rotr(a, 28) ^ rotr(a, 34) ^ rotr(a, 39);
+        uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint64_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+// Streaming SHA-512 over (prefix64, message) without concatenating buffers.
+void sha512_two_part(const uint8_t pre[64], const uint8_t* msg, uint64_t mlen,
+                     uint8_t out[64]) {
+    uint64_t st[8];
+    memcpy(st, H512, sizeof st);
+    uint8_t block[128];
+    memcpy(block, pre, 64);
+    uint64_t total = 64 + mlen;
+    uint64_t fill = 64;  // bytes currently in block
+    uint64_t consumed = 0;
+    while (mlen - consumed >= 128 - fill) {
+        memcpy(block + fill, msg + consumed, 128 - fill);
+        consumed += 128 - fill;
+        fill = 0;
+        sha512_compress(st, block);
+    }
+    memcpy(block + fill, msg + consumed, mlen - consumed);
+    fill += mlen - consumed;
+    // padding: 0x80, zeros, 128-bit big-endian bit length
+    block[fill++] = 0x80;
+    if (fill > 112) {
+        memset(block + fill, 0, 128 - fill);
+        sha512_compress(st, block);
+        fill = 0;
+    }
+    memset(block + fill, 0, 128 - fill);
+    uint64_t bits = total * 8;
+    for (int i = 0; i < 8; i++) block[127 - i] = (uint8_t)(bits >> (8 * i));
+    sha512_compress(st, block);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++)
+            out[8 * i + j] = (uint8_t)(st[i] >> (56 - 8 * j));
+}
+
+// ---- reduction mod L = 2^252 + 27742317777372353535851937790883648493 ----
+
+static const uint64_t L_LIMBS[4] = {
+    0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL, 0x0000000000000000ULL,
+    0x1000000000000000ULL,
+};
+
+// r (4 limbs LE) := digest (64 bytes, little-endian integer) mod L.
+//
+// Horner over 32-bit chunks from the top: x = r*2^32 + chunk, then subtract
+// q*L with q = max(0, (x >> 252) - 1). Since L < 2^252 * (1 + 2^-127), this
+// q never overshoots (q <= x/L) and leaves x < 2^252 + 2^157 + L < 2^254,
+// so x always fits five 64-bit limbs; trailing conditional subtracts
+// produce the canonical representative.
+void ed25519_mod_l(const uint8_t digest[64], uint64_t r[4]) {
+    uint64_t x[5] = {0, 0, 0, 0, 0};
+    for (int ci = 15; ci >= 0; ci--) {
+        uint32_t chunk = (uint32_t)digest[4 * ci] |
+                         ((uint32_t)digest[4 * ci + 1] << 8) |
+                         ((uint32_t)digest[4 * ci + 2] << 16) |
+                         ((uint32_t)digest[4 * ci + 3] << 24);
+        // x = x << 32 | chunk   (x < 2^254 so shifted fits 5 limbs)
+        x[4] = (x[4] << 32) | (x[3] >> 32);
+        x[3] = (x[3] << 32) | (x[2] >> 32);
+        x[2] = (x[2] << 32) | (x[1] >> 32);
+        x[1] = (x[1] << 32) | (x[0] >> 32);
+        x[0] = (x[0] << 32) | chunk;
+        // q = (x >> 252) - 1, clamped at 0
+        uint64_t q = (x[4] << 4) | (x[3] >> 60);
+        if (q) q -= 1;
+        if (!q) continue;
+        // x -= q * L
+        unsigned __int128 borrow = 0;
+        unsigned __int128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            carry += (unsigned __int128)q * L_LIMBS[i];
+            uint64_t sub = (uint64_t)carry;
+            carry >>= 64;
+            unsigned __int128 d = (unsigned __int128)x[i] - sub - borrow;
+            x[i] = (uint64_t)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+        unsigned __int128 d = (unsigned __int128)x[4] - (uint64_t)carry - borrow;
+        x[4] = (uint64_t)d;
+    }
+    // now x < 2^254: at most 3 conditional subtracts of L
+    for (int iter = 0; iter < 4; iter++) {
+        // compare x >= L (x[4] must be 0 by now if below; fold it in anyway)
+        bool ge = x[4] != 0;
+        if (!ge) {
+            ge = true;
+            for (int i = 3; i >= 0; i--) {
+                if (x[i] != L_LIMBS[i]) { ge = x[i] > L_LIMBS[i]; break; }
+            }
+        }
+        if (!ge) break;
+        unsigned __int128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            unsigned __int128 d = (unsigned __int128)x[i] - L_LIMBS[i] - borrow;
+            x[i] = (uint64_t)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+        x[4] -= (uint64_t)borrow;
+    }
+    for (int i = 0; i < 4; i++) r[i] = x[i];
+}
+
+void prep_range(const uint8_t* r_bytes, const uint8_t* a_bytes,
+                const uint8_t* msgs, const uint64_t* offs,
+                const uint64_t* lens, uint64_t lo, uint64_t hi,
+                uint8_t* h_out) {
+    uint8_t pre[64];
+    uint8_t digest[64];
+    for (uint64_t i = lo; i < hi; i++) {
+        memcpy(pre, r_bytes + 32 * i, 32);
+        memcpy(pre + 32, a_bytes + 32 * i, 32);
+        sha512_two_part(pre, msgs + offs[i], lens[i], digest);
+        uint64_t r[4];
+        ed25519_mod_l(digest, r);
+        uint8_t* out = h_out + 32 * i;
+        for (int j = 0; j < 4; j++)
+            for (int k = 0; k < 8; k++)
+                out[8 * j + k] = (uint8_t)(r[j] >> (8 * k));
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// h_out[i] = SHA512(R_i || A_i || M_i) mod L, 32-byte little-endian.
+void ed25519_prep_batch(const uint8_t* r_bytes, const uint8_t* a_bytes,
+                        const uint8_t* msgs, const uint64_t* offs,
+                        const uint64_t* lens, uint64_t n, int nthreads,
+                        uint8_t* h_out) {
+    if (nthreads <= 1 || n < 64) {
+        prep_range(r_bytes, a_bytes, msgs, offs, lens, 0, n, h_out);
+        return;
+    }
+    int t = std::min<int>(nthreads, (int)((n + 63) / 64));
+    std::vector<std::thread> workers;
+    uint64_t per = (n + t - 1) / t;
+    for (int w = 0; w < t; w++) {
+        uint64_t lo = w * per, hi = std::min<uint64_t>(n, lo + per);
+        if (lo >= hi) break;
+        workers.emplace_back(prep_range, r_bytes, a_bytes, msgs, offs, lens,
+                             lo, hi, h_out);
+    }
+    for (auto& th : workers) th.join();
+}
+
+// Direct mod-L reduction (for differential tests): 64-byte LE in,
+// 32-byte LE canonical residue out.
+void ed25519_mod_l_raw(const uint8_t* digest, uint8_t* out) {
+    uint64_t r[4];
+    ed25519_mod_l(digest, r);
+    for (int j = 0; j < 4; j++)
+        for (int k = 0; k < 8; k++)
+            out[8 * j + k] = (uint8_t)(r[j] >> (8 * k));
+}
+
+// Plain batch SHA-512 (for tests): out[i] = SHA512(msgs[offs[i]..+lens[i]]).
+void sha512_batch(const uint8_t* msgs, const uint64_t* offs,
+                  const uint64_t* lens, uint64_t n, uint8_t* out) {
+    for (uint64_t i = 0; i < n; i++) {
+        uint64_t st[8];
+        // reuse two-part with an empty prefix is wrong (prefix is fixed
+        // 64 bytes) — hash directly.
+        (void)st;
+        // one-shot: pad into blocks
+        const uint8_t* m = msgs + offs[i];
+        uint64_t len = lens[i];
+        uint64_t stt[8];
+        memcpy(stt, H512, sizeof stt);
+        uint64_t consumed = 0;
+        while (len - consumed >= 128) {
+            sha512_compress(stt, m + consumed);
+            consumed += 128;
+        }
+        uint8_t block[128];
+        uint64_t fill = len - consumed;
+        memcpy(block, m + consumed, fill);
+        block[fill++] = 0x80;
+        if (fill > 112) {
+            memset(block + fill, 0, 128 - fill);
+            sha512_compress(stt, block);
+            fill = 0;
+        }
+        memset(block + fill, 0, 128 - fill);
+        uint64_t bits = len * 8;
+        for (int j = 0; j < 8; j++) block[127 - j] = (uint8_t)(bits >> (8 * j));
+        sha512_compress(stt, block);
+        uint8_t* o = out + 64 * i;
+        for (int a = 0; a < 8; a++)
+            for (int b = 0; b < 8; b++)
+                o[8 * a + b] = (uint8_t)(stt[a] >> (56 - 8 * b));
+    }
+}
+
+}  // extern "C"

@@ -64,6 +64,11 @@ def scalarmult(secret: bytes, point: bytes) -> bytes:
         x2, x3 = x3, x2
         z2, z3 = z3, z2
     out = x2 * pow(z2, P - 2, P) % P
+    # libsodium's crypto_scalarmult fails on small-order peer points
+    # (all-zero shared secret); without this a malicious peer could force
+    # session keys derived from public data alone.
+    if out == 0:
+        raise ValueError("small-order X25519 point: all-zero shared secret")
     return out.to_bytes(32, "little")
 
 

@@ -120,18 +120,21 @@ def neg(a):
 
 
 def mul(a, b):
-    """Schoolbook 20x20 -> 39-coefficient product, vectorized as 20 shifted
-    row-adds; inputs loose (limbs <= LOOSE_MAX)."""
+    """Schoolbook 20x20 -> 39-coefficient product, vectorized as 20
+    statically shifted row-adds; inputs loose (limbs <= LOOSE_MAX).
+
+    Every shift is a compile-time-constant ``jnp.pad`` so the whole product
+    is one XLA elementwise fusion (the round-1 ``dynamic_update_slice``
+    formulation lowered to ~20 unfused kernels per multiply, which made
+    this op launch-bound on TPU)."""
     batch = a.shape[1:]
-    nb = len(batch)
-    # rows[i] = a[i] * b, shifted up by i limbs into a 39-coeff accumulator.
-    acc = jnp.zeros((2 * NLIMBS - 1,) + batch, dtype=jnp.int32)
+    pad_rest = ((0, 0),) * len(batch)
+    # rows[i] = a[i] * b placed at limb offset i inside 39 coefficients.
+    acc = None
     for i in range(NLIMBS):
         row = a[i][None] * b  # (20, ...) — products <= LOOSE_MAX^2 ~ 1.04e8
-        acc = lax.dynamic_update_slice(
-            acc, lax.dynamic_slice(acc, (i,) + (0,) * nb,
-                                   (NLIMBS,) + batch) + row,
-            (i,) + (0,) * nb)
+        shifted = jnp.pad(row, ((i, NLIMBS - 1 - i),) + pad_rest)
+        acc = shifted if acc is None else acc + shifted
     # acc coefficients <= 20 * LOOSE_MAX^2 < 2^31.
     # Carry round over 39 coeffs; the top overflow becomes coeff 39.
     lo = acc & MASK
@@ -149,7 +152,30 @@ def mul(a, b):
 
 
 def sqr(a):
-    return mul(a, a)
+    """Dedicated squaring: the off-diagonal products a_i*a_j (i<j) appear
+    twice in the schoolbook sum, so compute them once against a pre-doubled
+    operand — ~210 limb products instead of 400. Same worst-case coefficient
+    bound as :func:`mul` (20 terms of <= LOOSE_MAX^2 each)."""
+    batch = a.shape[1:]
+    pad_rest = ((0, 0),) * len(batch)
+    a2 = a + a  # limbs <= 2*LOOSE_MAX; products vs a <= 2*LOOSE_MAX^2
+    acc = None
+    for i in range(NLIMBS):
+        # diagonal term a_i^2 at offset 2i, doubled cross terms a_i*a_j
+        # (j > i) at offsets i+j.
+        row = jnp.concatenate([a[i][None] * a[i][None], a[i][None] * a2[i + 1:]],
+                              axis=0)  # (20-i, ...)
+        shifted = jnp.pad(row, ((2 * i, NLIMBS - 1 - i),) + pad_rest)
+        acc = shifted if acc is None else acc + shifted
+    lo = acc & MASK
+    hi = acc >> BITS
+    shifted = jnp.concatenate(
+        [jnp.zeros((1,) + batch, jnp.int32), hi[:-1]], axis=0)
+    c40_low = lo + shifted
+    c39 = hi[-1:]
+    high = jnp.concatenate([c40_low[NLIMBS:], c39], axis=0)
+    low = c40_low[:NLIMBS] + FOLD * high
+    return _carry_step(_carry_step(low))
 
 
 def mul_small(a, k: int):

@@ -290,8 +290,13 @@ class NominationProtocol:
                     self.slot.driver.nominating_value(
                         self.slot.slot_index, nv)
 
+        # A round leader always nominates its own value, even when it has
+        # already echoed another leader's (reference
+        # NominationProtocol::nominate: leaders insert their value
+        # unconditionally; copying from other leaders is the non-leader
+        # path). Gating on empty votes starved the local value.
         if self.slot.local_node_id in self.round_leaders and \
-                not self.votes:
+                value not in self.votes:
             self.votes.add(value)
             updated = True
             self.slot.driver.nominating_value(self.slot.slot_index, value)

@@ -110,7 +110,12 @@ def get_max_amount_receive(header, le: LedgerEntry) -> int:
         return INT64_MAX - d.value.balance - get_buying_liabilities(le)
     if d.arm == LedgerEntryType.TRUSTLINE:
         tl = d.value
-        if not is_authorized(tl):
+        # Maintain-liabilities suffices (reference checkAuthorization,
+        # TransactionUtils.cpp, protocol >= 10): offers held by an account
+        # whose trustline was downgraded to AUTHORIZED_TO_MAINTAIN_
+        # LIABILITIES must still cross. Full-authorization checks are the
+        # op frames' job.
+        if not is_authorized_to_maintain_liabilities(tl):
             return 0
         return tl.limit - tl.balance - get_buying_liabilities(le)
     raise ValueError("unknown entry type for receive headroom")
@@ -139,7 +144,10 @@ def add_balance(header, le: LedgerEntry, delta: int) -> bool:
         tl = d.value
         if delta == 0:
             return True
-        if not is_authorized(tl):
+        # Same gating as get_max_amount_receive: maintain-liabilities
+        # authorization is enough to move balance during offer crossing;
+        # ops that require full authorization check it themselves.
+        if not is_authorized_to_maintain_liabilities(tl):
             return False
         new_balance = tl.balance + delta
         if not (0 <= new_balance <= tl.limit):

@@ -423,9 +423,18 @@ def convert_with_offers(ltx, sheep, max_sheep_send: int, wheat,
 # ---------------- path-payment hooks ----------------
 
 
-def convert(op, ltx, send_asset, recv_asset, max_recv: int
+# Sentinel fail name for the op-level opEXCEEDED_WORK_LIMIT result (the
+# reference fails the whole op with that top-level code when the
+# cumulative cross budget runs out, PathPaymentOpFrameBase::convert).
+EXCEEDED_WORK_LIMIT = "EXCEEDED_WORK_LIMIT"
+
+
+def convert(op, ltx, send_asset, recv_asset, max_recv: int,
+            max_offers: int = MAX_OFFERS_TO_CROSS
             ) -> Tuple[bool, int, List, str]:
     """Strict-receive hop: acquire exactly ``max_recv`` of recv_asset.
+    ``max_offers`` is the *remaining* cumulative cross budget for the
+    whole path (reference threads maxOffersToCross across hops).
     Returns (ok, amount_sent, claim_atoms, fail_name)."""
     src = op.source_account_id()
 
@@ -436,19 +445,21 @@ def convert(op, ltx, send_asset, recv_asset, max_recv: int
 
     outcome, sheep_sent, wheat_received, atoms = convert_with_offers(
         ltx, send_asset, INT64_MAX, recv_asset, max_recv,
-        ROUND_PP_STRICT_RECEIVE, offer_filter)
+        ROUND_PP_STRICT_RECEIVE, offer_filter, max_offers)
     if outcome == CROSS_STOPPED_SELF:
         return False, 0, [], "OFFER_CROSS_SELF"
     if outcome == CROSS_TOO_MANY:
-        return False, 0, [], "TOO_FEW_OFFERS"
+        return False, 0, [], EXCEEDED_WORK_LIMIT
     if outcome != CROSS_OK or wheat_received != max_recv:
         return False, 0, [], "TOO_FEW_OFFERS"
     return True, sheep_sent, atoms, ""
 
 
-def convert_send(op, ltx, send_asset, recv_asset, amount_send: int
+def convert_send(op, ltx, send_asset, recv_asset, amount_send: int,
+                 max_offers: int = MAX_OFFERS_TO_CROSS
                  ) -> Tuple[bool, int, List, str]:
     """Strict-send hop: spend exactly ``amount_send`` of send_asset.
+    ``max_offers`` as in :func:`convert`.
     Returns (ok, amount_received, claim_atoms, fail_name)."""
     src = op.source_account_id()
 
@@ -459,11 +470,11 @@ def convert_send(op, ltx, send_asset, recv_asset, amount_send: int
 
     outcome, sheep_sent, wheat_received, atoms = convert_with_offers(
         ltx, send_asset, amount_send, recv_asset, INT64_MAX,
-        ROUND_PP_STRICT_SEND, offer_filter)
+        ROUND_PP_STRICT_SEND, offer_filter, max_offers)
     if outcome == CROSS_STOPPED_SELF:
         return False, 0, [], "OFFER_CROSS_SELF"
     if outcome == CROSS_TOO_MANY:
-        return False, 0, [], "TOO_FEW_OFFERS"
+        return False, 0, [], EXCEEDED_WORK_LIMIT
     if outcome != CROSS_OK or sheep_sent != amount_send:
         return False, 0, [], "TOO_FEW_OFFERS"
     return True, wheat_received, atoms, ""

@@ -21,6 +21,7 @@ from stellar_tpu.tx.asset_utils import (
 )
 from stellar_tpu.tx.op_frame import OperationFrame, account_key, register_op
 from stellar_tpu.xdr.results import (
+    OperationResultCode,
     PathPaymentStrictReceiveResultCode, PathPaymentStrictSendResultCode,
     PathPaymentStrictReceiveResultSuccess, PathPaymentStrictSendResultSuccess,
     PaymentResultCode, SimplePaymentResult,
@@ -147,11 +148,17 @@ class PathPaymentStrictReceiveOpFrame(_PathPaymentBase):
             for send_asset in full_path:
                 if send_asset == recv_asset:
                     continue
-                from stellar_tpu.tx.offer_exchange import convert
-                ok, amount_send, trail, fail_name = convert(
-                    self, ltx, send_asset, recv_asset, max_amount_recv)
+                from stellar_tpu.tx import offer_exchange as ox
+                # cumulative cross budget across the whole path (reference
+                # maxOffersToCross -= offerTrail.size() per hop)
+                ok, amount_send, trail, fail_name = ox.convert(
+                    self, ltx, send_asset, recv_asset, max_amount_recv,
+                    ox.MAX_OFFERS_TO_CROSS - len(offers))
                 if not ok:
                     ltx.rollback()
+                    if fail_name == ox.EXCEEDED_WORK_LIMIT:
+                        return False, OperationFrame.make_top_result(
+                            OperationResultCode.opEXCEEDED_WORK_LIMIT)
                     return self.fail(fail_name)
                 max_amount_recv = amount_send
                 recv_asset = send_asset
@@ -207,11 +214,15 @@ class PathPaymentStrictSendOpFrame(_PathPaymentBase):
             for recv_asset in full_path:
                 if send_asset == recv_asset:
                     continue
-                from stellar_tpu.tx.offer_exchange import convert_send
-                ok, amount_recv, trail, fail_name = convert_send(
-                    self, ltx, send_asset, recv_asset, amount_send)
+                from stellar_tpu.tx import offer_exchange as ox
+                ok, amount_recv, trail, fail_name = ox.convert_send(
+                    self, ltx, send_asset, recv_asset, amount_send,
+                    ox.MAX_OFFERS_TO_CROSS - len(offers))
                 if not ok:
                     ltx.rollback()
+                    if fail_name == ox.EXCEEDED_WORK_LIMIT:
+                        return False, OperationFrame.make_top_result(
+                            OperationResultCode.opEXCEEDED_WORK_LIMIT)
                     return self.fail(fail_name)
                 amount_send = amount_recv
                 send_asset = recv_asset
