@@ -158,6 +158,12 @@ class _Base:
     def _get(self, kb: bytes) -> Optional[LedgerEntry]:
         raise NotImplementedError
 
+    def _get_internal(self, ik: bytes):
+        raise NotImplementedError
+
+    def _internal_keys(self) -> Iterable[bytes]:
+        raise NotImplementedError
+
     def _header(self) -> LedgerHeader:
         raise NotImplementedError
 
@@ -191,6 +197,12 @@ class LedgerTxn(_Base):
         self._child = None
         # kb -> LedgerEntry (current) | None (erased at this level)
         self._entries: Dict[bytes, Optional[LedgerEntry]] = {}
+        # internal (non-XDR) entries: tx-scoped sponsorship bookkeeping
+        # (reference InternalLedgerEntry SPONSORSHIP / SPONSORSHIP_COUNTER,
+        # src/ledger/InternalLedgerEntry.h). Values are immutable scalars
+        # (bytes / int) or None (erased); replace-on-write, same
+        # commit/rollback lifecycle as ``_entries``.
+        self._internal: Dict[bytes, object] = {}
         self._active: set = set()
         self._header_copy: Optional[LedgerHeader] = None
         self._open = True
@@ -284,6 +296,40 @@ class LedgerTxn(_Base):
         self._check_open()
         return [self._get(kb) for kb in self._all_keys_of_type(t)]
 
+    # ---------------- internal (non-XDR) entry API ----------------
+
+    def _get_internal(self, ik: bytes):
+        if ik in self._internal:
+            return self._internal[ik]
+        return self._parent._get_internal(ik)
+
+    def get_internal(self, ik: bytes):
+        """Current value of an internal entry (None if absent/erased)."""
+        self._check_open()
+        return self._get_internal(ik)
+
+    def set_internal(self, ik: bytes, value):
+        """Set (or erase with None) an internal entry at this level."""
+        self._check_open()
+        self._internal[ik] = value
+
+    def _internal_keys(self) -> Iterable[bytes]:
+        yield from self._internal
+        yield from self._parent._internal_keys()
+
+    def has_live_internal(self, prefix: bytes) -> bool:
+        """Any internal entry with this key prefix live in the current
+        view? (reference ``LedgerTxn::hasSponsorshipEntry``)."""
+        self._check_open()
+        seen = set()
+        for ik in self._internal_keys():
+            if ik in seen:
+                continue
+            seen.add(ik)
+            if ik.startswith(prefix) and self._get_internal(ik) is not None:
+                return True
+        return False
+
     # ---------------- header API ----------------
 
     def header(self) -> LedgerHeader:
@@ -304,7 +350,8 @@ class LedgerTxn(_Base):
         """Fold effects into parent and close (``LedgerTxn::commit``)."""
         self._check_open()
         self._active.clear()
-        self._parent._absorb(self._entries, self._header_copy)
+        self._parent._absorb(self._entries, self._header_copy,
+                             self._internal)
         self._parent._detach_child()
         self._open = False
 
@@ -317,14 +364,18 @@ class LedgerTxn(_Base):
             self._child.rollback()
         self._active.clear()
         self._entries.clear()
+        self._internal.clear()
         self._header_copy = None
         self._parent._detach_child()
         self._open = False
 
     def _absorb(self, entries: Dict[bytes, Optional[LedgerEntry]],
-                header: Optional[LedgerHeader]):
+                header: Optional[LedgerHeader],
+                internal: Optional[Dict[bytes, object]] = None):
         """Receive a committing child's effects."""
         self._entries.update(entries)
+        if internal:
+            self._internal.update(internal)
         if header is not None:
             self._header_copy = header
 
@@ -411,6 +462,12 @@ class LedgerTxnRoot(_Base):
     def _get(self, kb: bytes) -> Optional[LedgerEntry]:
         return self.store.get(kb)
 
+    def _get_internal(self, ik: bytes):
+        return None
+
+    def _internal_keys(self) -> Iterable[bytes]:
+        return ()
+
     def _header(self) -> LedgerHeader:
         return self._hdr
 
@@ -418,7 +475,16 @@ class LedgerTxnRoot(_Base):
         return self.store.keys_of_type(t)
 
     def _absorb(self, entries: Dict[bytes, Optional[LedgerEntry]],
-                header: Optional[LedgerHeader]):
+                header: Optional[LedgerHeader],
+                internal: Optional[Dict[bytes, object]] = None):
+        # Internal entries are tx-scoped: TransactionFrame fails any tx
+        # that leaves one live (txBAD_SPONSORSHIP), so only erasure
+        # markers may ever reach the root.
+        if internal:
+            for ik, v in internal.items():
+                if v is not None:
+                    raise LedgerTxnError(
+                        "internal entry leaked to committed state")
         for kb, e in entries.items():
             if e is None:
                 self.store.delete(kb)

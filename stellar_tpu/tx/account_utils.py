@@ -85,6 +85,10 @@ def is_authorized_to_maintain_liabilities(tl: TrustLineEntry) -> bool:
     from stellar_tpu.xdr.types import (
         AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG,
     )
+    # pool-share trustlines carry no auth flags and are always considered
+    # authorized (reference TransactionUtils.cpp:1027-1034)
+    if tl.asset.arm == AssetType.ASSET_TYPE_POOL_SHARE:
+        return True
     return bool(tl.flags & (AUTHORIZED_FLAG |
                             AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG))
 

@@ -13,7 +13,12 @@ from stellar_tpu.xdr.types import (
 __all__ = [
     "is_asset_code_valid", "is_asset_valid", "get_issuer",
     "asset_to_trustline_asset", "trustline_key", "is_native",
+    "asset_lt", "is_change_trust_asset_valid", "pool_id_from_params",
+    "change_trust_asset_to_trustline_asset", "pool_share_trustline_key",
+    "liquidity_pool_key", "LIQUIDITY_POOL_FEE_V18",
 ]
+
+LIQUIDITY_POOL_FEE_V18 = 30  # basis points (Stellar-ledger-entries.x)
 
 _ALNUM = set(b"abcdefghijklmnopqrstuvwxyz"
              b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789")
@@ -78,3 +83,58 @@ def trustline_key(account_id, asset) -> "LedgerKey.Value":
         LedgerEntryType.TRUSTLINE,
         LedgerKeyTrustLine(accountID=account_id,
                            asset=asset_to_trustline_asset(asset)))
+
+
+# ---------------- liquidity-pool assets ----------------
+
+def asset_lt(a, b) -> bool:
+    """Canonical asset ordering (reference xdrpp ``operator<`` on Asset).
+    Field-order comparison equals byte order of the XDR encoding for
+    assets: type discriminant, then code, then issuer key."""
+    from stellar_tpu.xdr.runtime import to_bytes
+    return to_bytes(Asset, a) < to_bytes(Asset, b)
+
+
+def is_change_trust_asset_valid(ct_asset, ledger_version: int) -> bool:
+    """ChangeTrustAsset validity incl. the pool-share arm (reference
+    ``isPoolShareAssetValid(ChangeTrustAsset)``, util/types.cpp:132):
+    both constituents valid, strictly ordered, canonical fee."""
+    if ct_asset.arm != AssetType.ASSET_TYPE_POOL_SHARE:
+        return is_asset_valid(ct_asset, ledger_version)
+    cp = ct_asset.value.value  # LiquidityPoolParameters -> constantProduct
+    return (is_asset_valid(cp.assetA, ledger_version) and
+            is_asset_valid(cp.assetB, ledger_version) and
+            asset_lt(cp.assetA, cp.assetB) and
+            cp.fee == LIQUIDITY_POOL_FEE_V18)
+
+
+def pool_id_from_params(params) -> bytes:
+    """PoolID = SHA-256 of the XDR LiquidityPoolParameters (reference
+    ``changeTrustAssetToTrustLineAsset`` → ``xdrSha256``)."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.runtime import to_bytes
+    from stellar_tpu.xdr.types import LiquidityPoolParameters
+    return sha256(to_bytes(LiquidityPoolParameters, params))
+
+
+def change_trust_asset_to_trustline_asset(ct_asset):
+    if ct_asset.arm == AssetType.ASSET_TYPE_POOL_SHARE:
+        return TrustLineAsset.make(AssetType.ASSET_TYPE_POOL_SHARE,
+                                   pool_id_from_params(ct_asset.value))
+    return TrustLineAsset.make(ct_asset.arm, ct_asset.value)
+
+
+def pool_share_trustline_key(account_id, pool_id: bytes):
+    return LedgerKey.make(
+        LedgerEntryType.TRUSTLINE,
+        LedgerKeyTrustLine(
+            accountID=account_id,
+            asset=TrustLineAsset.make(AssetType.ASSET_TYPE_POOL_SHARE,
+                                      pool_id)))
+
+
+def liquidity_pool_key(pool_id: bytes):
+    from stellar_tpu.xdr.types import LedgerKeyLiquidityPool
+    return LedgerKey.make(
+        LedgerEntryType.LIQUIDITY_POOL,
+        LedgerKeyLiquidityPool(liquidityPoolID=pool_id))

@@ -8,8 +8,11 @@ decide which side stays in the book, rounding that favors the staying
 side, 1% price-error bound for NORMAL rounding) — Python integers stand
 in for the uint128 arithmetic, bit-exact by construction.
 
-Liquidity-pool exchange (``convertWithOffersAndPools``' pool arm) lands
-with the pools milestone; the offer arm here is complete.
+``convert_with_offers_and_pools`` adds the liquidity-pool arm for path
+payments: the pool quote is computed first, the order book is crossed in
+a child transaction, and the book wins only when it gives a strictly
+better price (reference ``maybeConvertWithOffers`` /
+``shouldConvertWithOffers``).
 """
 
 from __future__ import annotations
@@ -22,7 +25,9 @@ from stellar_tpu.tx.account_utils import (
 )
 from stellar_tpu.tx.asset_utils import get_issuer, is_native, trustline_key
 from stellar_tpu.tx.op_frame import account_key
-from stellar_tpu.xdr.results import ClaimAtom, ClaimAtomType, ClaimOfferAtom
+from stellar_tpu.xdr.results import (
+    ClaimAtom, ClaimAtomType, ClaimLiquidityAtom, ClaimOfferAtom,
+)
 from stellar_tpu.xdr.types import (
     LedgerEntryType, LedgerKey, LedgerKeyOffer, Price,
 )
@@ -30,8 +35,10 @@ from stellar_tpu.xdr.types import (
 __all__ = [
     "ROUND_NORMAL", "ROUND_PP_STRICT_RECEIVE", "ROUND_PP_STRICT_SEND",
     "exchange_v10", "adjust_offer_amount", "offer_liabilities",
-    "convert", "convert_send", "convert_with_offers", "load_best_offer",
-    "release_offer_liabilities", "acquire_offer_liabilities", "offer_key",
+    "convert", "convert_send", "convert_with_offers",
+    "convert_with_offers_and_pools", "exchange_with_pool_amounts",
+    "load_best_offer", "release_offer_liabilities",
+    "acquire_offer_liabilities", "offer_key",
 ]
 
 ROUND_NORMAL = 0
@@ -375,10 +382,17 @@ def _cross_one(ltx, offer, max_wheat_receive: int, max_sheep_send: int,
 
 
 def _erase_offer(ltx, key, seller_id):
-    from stellar_tpu.tx.account_utils import add_num_entries
+    """Erase a fully-crossed offer, returning its reserve to the seller
+    or sponsor (reference ``OfferExchange.cpp`` crossOfferV10 →
+    ``removeEntryWithPossibleSponsorship``)."""
+    from stellar_tpu.tx.sponsorship import (
+        remove_entry_with_possible_sponsorship,
+    )
+    le = ltx.load_without_record(key)
     ltx.erase(key)
     with ltx.load(account_key(seller_id)) as h:
-        add_num_entries(ltx.header(), h.data, -1)
+        remove_entry_with_possible_sponsorship(ltx, ltx.header(), le,
+                                               h.entry)
 
 
 def convert_with_offers(ltx, sheep, max_sheep_send: int, wheat,
@@ -420,6 +434,162 @@ def convert_with_offers(ltx, sheep, max_sheep_send: int, wheat,
             return CROSS_OK, sheep_sent, wheat_received, atoms
 
 
+# ---------------- liquidity-pool arm ----------------
+
+
+LIQUIDITY_POOL_MAX_BPS = 10000
+
+
+def exchange_with_pool_amounts(reserves_to: int, max_send_to: int,
+                               reserves_from: int, max_receive_from: int,
+                               fee_bps: int, rounding: int):
+    """Constant-product quote (reference ``exchangeWithPool`` math arm,
+    OfferExchange.cpp:1243). Returns (ok, to_pool, from_pool) without
+    touching state."""
+    max_bps = LIQUIDITY_POOL_MAX_BPS
+    if not (0 <= fee_bps < max_bps):
+        raise ValueError("liquidity pool fee out of range")
+    if reserves_to <= 0 or reserves_from <= 0:
+        raise ValueError("non-positive reserve in exchange_with_pool")
+    if rounding == ROUND_PP_STRICT_SEND:
+        if max_receive_from != INT64_MAX:
+            raise ValueError("strict send with bounded receive")
+        if max_send_to > INT64_MAX - reserves_to:
+            return False, 0, 0
+        to_pool = max_send_to
+        num = (max_bps - fee_bps) * reserves_from * to_pool
+        den = max_bps * reserves_to + (max_bps - fee_bps) * to_pool
+        from_pool = num // den
+        if from_pool > INT64_MAX:
+            return False, 0, 0
+        if from_pool > reserves_from:
+            raise RuntimeError("received too much from pool")
+        return from_pool != 0, to_pool, from_pool
+    if rounding == ROUND_PP_STRICT_RECEIVE:
+        if max_send_to != INT64_MAX:
+            raise ValueError("strict receive with bounded send")
+        if max_receive_from >= reserves_from:
+            return False, 0, 0
+        from_pool = max_receive_from
+        num = max_bps * reserves_to * from_pool
+        den = (reserves_from - from_pool) * (max_bps - fee_bps)
+        to_pool = -((-num) // den)  # ceil
+        if to_pool > INT64_MAX - reserves_to:
+            return False, 0, 0
+        return True, to_pool, from_pool
+    raise ValueError("invalid rounding type for pool exchange")
+
+
+def _pool_id_for_pair(a, b) -> bytes:
+    from stellar_tpu.tx.asset_utils import (
+        LIQUIDITY_POOL_FEE_V18, asset_lt, pool_id_from_params,
+    )
+    from stellar_tpu.xdr.types import (
+        LiquidityPoolConstantProductParameters, LiquidityPoolParameters,
+        LiquidityPoolType,
+    )
+    lo, hi = (a, b) if asset_lt(a, b) else (b, a)
+    params = LiquidityPoolParameters.make(
+        LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        LiquidityPoolConstantProductParameters(
+            assetA=lo, assetB=hi, fee=LIQUIDITY_POOL_FEE_V18))
+    return pool_id_from_params(params)
+
+
+def _load_pool_cp(ltx, pool_id: bytes):
+    from stellar_tpu.tx.asset_utils import liquidity_pool_key
+    h = ltx.load(liquidity_pool_key(pool_id))
+    return h
+
+
+def _quote_pool_exchange(ltx, sheep, max_sheep_send, wheat,
+                         max_wheat_receive, rounding, max_offers):
+    """(pool_id, to_pool, from_pool) or None — a no-side-effect pool
+    quote (reference computes it in an always-rolled-back child)."""
+    if rounding == ROUND_NORMAL or max_offers == 0:
+        return None
+    pool_id = _pool_id_for_pair(sheep, wheat)
+    from stellar_tpu.tx.asset_utils import liquidity_pool_key
+    pe = ltx.load_without_record(liquidity_pool_key(pool_id))
+    if pe is None:
+        return None
+    cp = pe.data.value.body.value
+    if cp.reserveA <= 0 or cp.reserveB <= 0:
+        return None
+    from stellar_tpu.tx.asset_utils import LIQUIDITY_POOL_FEE_V18
+    from stellar_tpu.xdr.runtime import to_bytes as _tb
+    from stellar_tpu.xdr.types import Asset as _Asset
+    if _tb(_Asset, sheep) == _tb(_Asset, cp.params.assetA):
+        reserves_to, reserves_from = cp.reserveA, cp.reserveB
+    else:
+        reserves_to, reserves_from = cp.reserveB, cp.reserveA
+    ok, to_pool, from_pool = exchange_with_pool_amounts(
+        reserves_to, max_sheep_send, reserves_from, max_wheat_receive,
+        LIQUIDITY_POOL_FEE_V18, rounding)
+    if not ok:
+        return None
+    return pool_id, to_pool, from_pool
+
+
+def _apply_pool_exchange(ltx, sheep, pool_id: bytes, to_pool: int,
+                         from_pool: int):
+    """Move the quoted amounts into/out of the pool reserves."""
+    h = _load_pool_cp(ltx, pool_id)
+    if h is None:
+        raise RuntimeError("pool vanished between quote and apply")
+    with h:
+        cp = h.data.body.value
+        from stellar_tpu.xdr.runtime import to_bytes as _tb
+        from stellar_tpu.xdr.types import Asset as _Asset
+        if _tb(_Asset, sheep) == _tb(_Asset, cp.params.assetA):
+            cp.reserveA += to_pool
+            cp.reserveB -= from_pool
+        else:
+            cp.reserveB += to_pool
+            cp.reserveA -= from_pool
+        if cp.reserveA < 0 or cp.reserveB < 0:
+            raise RuntimeError("could not update reserves")
+
+
+def convert_with_offers_and_pools(ltx, sheep, max_sheep_send: int, wheat,
+                                  max_wheat_receive: int, rounding: int,
+                                  offer_filter: Callable,
+                                  max_offers: int = MAX_OFFERS_TO_CROSS):
+    """Cross against the better of the order book and the liquidity pool
+    (reference ``convertWithOffersAndPools``). Same return shape as
+    :func:`convert_with_offers`."""
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+
+    quote = _quote_pool_exchange(ltx, sheep, max_sheep_send, wheat,
+                                 max_wheat_receive, rounding, max_offers)
+
+    book_ltx = LedgerTxn(ltx)
+    outcome, sheep_sent, wheat_received, atoms = convert_with_offers(
+        book_ltx, sheep, max_sheep_send, wheat, max_wheat_receive,
+        rounding, offer_filter, max_offers)
+    use_book = True
+    if quote is not None:
+        _, to_pool, from_pool = quote
+        if outcome != CROSS_OK:
+            use_book = False
+        else:
+            # book wins only on a strictly better price
+            use_book = to_pool * wheat_received > from_pool * sheep_sent
+    if use_book:
+        book_ltx.commit()
+        return outcome, sheep_sent, wheat_received, atoms
+    book_ltx.rollback()
+
+    pool_id, to_pool, from_pool = quote
+    _apply_pool_exchange(ltx, sheep, pool_id, to_pool, from_pool)
+    atom = ClaimAtom.make(
+        ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL,
+        ClaimLiquidityAtom(liquidityPoolID=pool_id,
+                           assetSold=wheat, amountSold=from_pool,
+                           assetBought=sheep, amountBought=to_pool))
+    return CROSS_OK, to_pool, from_pool, [atom]
+
+
 # ---------------- path-payment hooks ----------------
 
 
@@ -443,9 +613,10 @@ def convert(op, ltx, send_asset, recv_asset, max_recv: int,
             return CROSS_STOPPED_SELF
         return None
 
-    outcome, sheep_sent, wheat_received, atoms = convert_with_offers(
-        ltx, send_asset, INT64_MAX, recv_asset, max_recv,
-        ROUND_PP_STRICT_RECEIVE, offer_filter, max_offers)
+    outcome, sheep_sent, wheat_received, atoms = \
+        convert_with_offers_and_pools(
+            ltx, send_asset, INT64_MAX, recv_asset, max_recv,
+            ROUND_PP_STRICT_RECEIVE, offer_filter, max_offers)
     if outcome == CROSS_STOPPED_SELF:
         return False, 0, [], "OFFER_CROSS_SELF"
     if outcome == CROSS_TOO_MANY:
@@ -468,9 +639,10 @@ def convert_send(op, ltx, send_asset, recv_asset, amount_send: int,
             return CROSS_STOPPED_SELF
         return None
 
-    outcome, sheep_sent, wheat_received, atoms = convert_with_offers(
-        ltx, send_asset, amount_send, recv_asset, INT64_MAX,
-        ROUND_PP_STRICT_SEND, offer_filter, max_offers)
+    outcome, sheep_sent, wheat_received, atoms = \
+        convert_with_offers_and_pools(
+            ltx, send_asset, amount_send, recv_asset, INT64_MAX,
+            ROUND_PP_STRICT_SEND, offer_filter, max_offers)
     if outcome == CROSS_STOPPED_SELF:
         return False, 0, [], "OFFER_CROSS_SELF"
     if outcome == CROSS_TOO_MANY:

@@ -98,6 +98,23 @@ class OperationFrame:
         """Top-level failure (opBAD_AUTH, opNO_ACCOUNT, ...)."""
         return OperationResult.make(code)
 
+    def sponsorship_failure(self, res: int,
+                            low_reserve_code: int) -> OperationResult:
+        """Map a failed SponsorshipResult to this op's failure result:
+        LOW_RESERVE carries the op's own inner code, the counter overflows
+        map to top-level op codes (the switch every reference op frame
+        repeats after ``createEntryWithPossibleSponsorship``)."""
+        from stellar_tpu.tx.sponsorship import SponsorshipResult
+        if res == SponsorshipResult.LOW_RESERVE:
+            return self.make_result(low_reserve_code)
+        if res == SponsorshipResult.TOO_MANY_SUBENTRIES:
+            return self.make_top_result(
+                OperationResultCode.opTOO_MANY_SUBENTRIES)
+        if res == SponsorshipResult.TOO_MANY_SPONSORING:
+            return self.make_top_result(
+                OperationResultCode.opTOO_MANY_SPONSORING)
+        raise ValueError(f"unexpected sponsorship result {res}")
+
     # ---------------- signature / validity ----------------
 
     def threshold_level(self) -> int:

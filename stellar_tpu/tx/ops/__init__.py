@@ -4,7 +4,9 @@ op registry used by ``make_op_frame``."""
 from stellar_tpu.tx.ops import account_ops  # noqa: F401
 from stellar_tpu.tx.ops import claimable_balances  # noqa: F401
 from stellar_tpu.tx.ops import create_account  # noqa: F401
+from stellar_tpu.tx.ops import liquidity_pool_ops  # noqa: F401
 from stellar_tpu.tx.ops import misc  # noqa: F401
 from stellar_tpu.tx.ops import offers  # noqa: F401
 from stellar_tpu.tx.ops import payment  # noqa: F401
+from stellar_tpu.tx.ops import sponsorship_ops  # noqa: F401
 from stellar_tpu.tx.ops import trust_ops  # noqa: F401

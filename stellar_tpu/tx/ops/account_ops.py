@@ -141,22 +141,28 @@ class SetOptionsOpFrame(OperationFrame):
                 th[3] = o.highThreshold & UINT8_MAX
             acc.thresholds = bytes(th)
             if o.signer is not None:
-                ok, fail = self._apply_signer(header, acc, o.signer)
+                ok, fail = self._apply_signer(ltx, header, src.entry,
+                                              o.signer)
                 if not ok:
                     return False, fail
         return True, self.make_result(Code.SET_OPTIONS_SUCCESS)
 
-    def _apply_signer(self, header, acc, signer):
-        """Add / update / delete (weight 0) a signer (reference
-        ``addOrChangeSigner`` / ``deleteSigner``)."""
-        from stellar_tpu.tx.account_utils import add_num_entries
+    def _apply_signer(self, ltx, header, acc_le, signer):
+        """Add / update / delete (weight 0) a signer with sponsorship
+        accounting (reference ``addOrChangeSigner`` / ``deleteSigner``,
+        SetOptionsOpFrame.cpp)."""
+        from stellar_tpu.tx.sponsorship import (
+            SponsorshipResult, create_signer_with_possible_sponsorship,
+            remove_signer_with_possible_sponsorship,
+        )
         Code = SetOptionsResultCode
+        acc = acc_le.data.value
         existing = [i for i, s in enumerate(acc.signers)
                     if s.key == signer.key]
         if signer.weight == 0:
             if existing:
-                del acc.signers[existing[0]]
-                add_num_entries(header, acc, -1)
+                remove_signer_with_possible_sponsorship(
+                    ltx, header, acc_le, existing[0])
             return True, None
         if existing:
             acc.signers[existing[0]].weight = signer.weight
@@ -164,13 +170,25 @@ class SetOptionsOpFrame(OperationFrame):
         if len(acc.signers) >= MAX_SIGNERS:
             return False, self.make_result(
                 Code.SET_OPTIONS_TOO_MANY_SIGNERS)
-        if not add_num_entries(header, acc, 1):
-            return False, self.make_result(Code.SET_OPTIONS_LOW_RESERVE)
-        acc.signers.append(signer)
-        # keep signers sorted by key encoding (reference keeps sorted)
+        # sorted insert keeps signers ordered by key encoding, with the
+        # parallel signerSponsoringIDs slot inserted at the same index
         from stellar_tpu.xdr.runtime import to_bytes
         from stellar_tpu.xdr.types import SignerKey
-        acc.signers.sort(key=lambda s: to_bytes(SignerKey, s.key))
+        kb = to_bytes(SignerKey, signer.key)
+        n = sum(1 for s in acc.signers
+                if to_bytes(SignerKey, s.key) < kb)
+        acc.signers.insert(n, signer)
+        v2 = account_ext_v2(acc)
+        if v2 is not None:
+            v2.signerSponsoringIDs.insert(n, None)
+        res = create_signer_with_possible_sponsorship(ltx, header,
+                                                      acc_le, n)
+        if res != SponsorshipResult.SUCCESS:
+            del acc.signers[n]
+            if v2 is not None:
+                del v2.signerSponsoringIDs[n]
+            return False, self.sponsorship_failure(
+                res, Code.SET_OPTIONS_LOW_RESERVE)
         return True, None
 
 
@@ -212,13 +230,29 @@ class MergeOpFrame(OperationFrame):
                 src_handle.deactivate()
                 return False, self.make_result(
                     Code.ACCOUNT_MERGE_SEQNUM_TOO_FAR)
+            # an account may not merge while it sponsors anything (active
+            # directive or recorded reserves); being sponsored is fine —
+            # signer and entry sponsorships release below (reference
+            # MergeOpFrame.cpp:226-256)
+            from stellar_tpu.tx.sponsorship import (
+                load_sponsorship_counter,
+                remove_entry_with_possible_sponsorship,
+                remove_signer_with_possible_sponsorship,
+            )
             v2 = account_ext_v2(acc)
-            if v2 is not None and \
-                    (v2.numSponsoring != 0 or v2.numSponsored != 0):
+            if load_sponsorship_counter(
+                    ltx, self.source_account_id()) is not None or \
+                    (v2 is not None and v2.numSponsoring != 0):
                 src_handle.deactivate()
                 return False, self.make_result(
                     Code.ACCOUNT_MERGE_IS_SPONSOR)
+            while acc.signers:
+                remove_signer_with_possible_sponsorship(
+                    ltx, header, src_handle.entry, len(acc.signers) - 1)
+            src_le = src_handle.entry
             src_handle.deactivate()
+            remove_entry_with_possible_sponsorship(ltx, header, src_le,
+                                                   src_le)
 
             with ltx.load(account_key(self.dest_id())) as dest:
                 if not add_balance(header, dest.entry, balance):

@@ -15,6 +15,10 @@ from stellar_tpu.tx.op_frame import (
     OperationFrame, ThresholdLevel, account_key, register_op,
 )
 from stellar_tpu.tx.ops.account_ops import is_clawback_enabled
+from stellar_tpu.tx.sponsorship import (
+    SponsorshipResult, create_entry_with_possible_sponsorship,
+    remove_entry_with_possible_sponsorship,
+)
 from stellar_tpu.xdr.results import (
     ClaimClaimableBalanceResultCode, ClawbackClaimableBalanceResultCode,
     ClawbackResultCode, CreateClaimableBalanceResultCode,
@@ -132,19 +136,43 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
         src_id = self.source_account_id()
         with LedgerTxn(outer) as ltx:
             header = ltx.header()
-            # reserve: claimants.size() * baseReserve carried by source
-            # as sponsor of the new entry (non-sponsored-by-others path)
+            balance_id = ClaimableBalanceID.make(
+                ClaimableBalanceIDType.CLAIMABLE_BALANCE_ID_TYPE_V0,
+                operation_balance_id(
+                    self.parent_tx.source_account_id(),
+                    self.parent_tx.seq_num, self.index))
+            from stellar_tpu.xdr.types import Claimant, ClaimantV0
+            claimants = [
+                Claimant.make(0, ClaimantV0(
+                    destination=c.value.destination,
+                    predicate=_to_absolute(c.value.predicate,
+                                           header.scpValue.closeTime)))
+                for c in b.claimants]
+            flags = 0
+            if not is_native(b.asset):
+                issuer = ltx.load_without_record(
+                    account_key(get_issuer(b.asset)))
+                if issuer is not None and \
+                        is_clawback_enabled(issuer.data.value):
+                    flags = CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG
+            cb_entry = ClaimableBalanceEntry(
+                balanceID=balance_id, claimants=claimants, asset=b.asset,
+                amount=b.amount, ext=_cb_ext(flags))
+            le = LedgerEntry(
+                lastModifiedLedgerSeq=header.ledgerSeq,
+                data=LedgerEntry._types[1].make(
+                    LedgerEntryType.CLAIMABLE_BALANCE, cb_entry),
+                ext=LedgerEntry._types[2].make(0))
+            # reserve: claimants.size() * baseReserve charged to the
+            # active sponsor, else self-sponsored by the source
+            # (claimable balances always record a sponsor)
             with ltx.load(account_key(src_id)) as src:
-                acc = src.data
-                from stellar_tpu.tx.account_utils import (
-                    account_ext_v2, get_min_balance,
-                )
-                needed = len(b.claimants) * header.baseReserve
-                if get_available_balance(header, src.entry) < 0 or \
-                        acc.balance < get_min_balance(header, acc) + needed:
-                    return False, self.make_result(
-                        CBCode.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
-                _bump_sponsoring(acc, len(b.claimants))
+                res = create_entry_with_possible_sponsorship(
+                    ltx, header, le, src.entry)
+            if res != SponsorshipResult.SUCCESS:
+                ltx.rollback()
+                return False, self.sponsorship_failure(
+                    res, CBCode.CREATE_CLAIMABLE_BALANCE_LOW_RESERVE)
 
             # move the amount out of the source
             if is_native(b.asset):
@@ -172,41 +200,7 @@ class CreateClaimableBalanceOpFrame(OperationFrame):
                         return False, self.make_result(
                             CBCode.CREATE_CLAIMABLE_BALANCE_UNDERFUNDED)
 
-            balance_id = ClaimableBalanceID.make(
-                ClaimableBalanceIDType.CLAIMABLE_BALANCE_ID_TYPE_V0,
-                operation_balance_id(
-                    self.parent_tx.source_account_id(),
-                    self.parent_tx.seq_num, self.index))
-            from stellar_tpu.xdr.types import Claimant, ClaimantV0
-            claimants = [
-                Claimant.make(0, ClaimantV0(
-                    destination=c.value.destination,
-                    predicate=_to_absolute(c.value.predicate,
-                                           header.scpValue.closeTime)))
-                for c in b.claimants]
-            flags = 0
-            if not is_native(b.asset):
-                issuer = ltx.load_without_record(
-                    account_key(get_issuer(b.asset)))
-                if issuer is not None and \
-                        is_clawback_enabled(issuer.data.value):
-                    flags = CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG
-            entry = ClaimableBalanceEntry(
-                balanceID=balance_id, claimants=claimants, asset=b.asset,
-                amount=b.amount,
-                ext=_cb_ext(flags))
-            # record the source as the entry's reserve sponsor so the
-            # claim/clawback path can release numSponsoring symmetrically
-            from stellar_tpu.xdr.ledger import LedgerEntryChangeType  # noqa
-            from stellar_tpu.xdr.types import LedgerEntryExtensionV1
-            ext = LedgerEntry._types[2].make(1, LedgerEntryExtensionV1(
-                sponsoringID=src_id,
-                ext=LedgerEntryExtensionV1._types[1].make(0)))
-            ltx.create(LedgerEntry(
-                lastModifiedLedgerSeq=header.ledgerSeq,
-                data=LedgerEntry._types[1].make(
-                    LedgerEntryType.CLAIMABLE_BALANCE, entry),
-                ext=ext)).deactivate()
+            ltx.create(le).deactivate()
             ltx.commit()
         return True, self.make_result(
             CBCode.CREATE_CLAIMABLE_BALANCE_SUCCESS, balance_id)
@@ -222,26 +216,6 @@ def _cb_ext(flags: int):
         ext=ClaimableBalanceEntryExtensionV1._types[0].make(0),
         flags=flags)
     return ClaimableBalanceEntry._types[4].make(1, v1)
-
-
-def _bump_sponsoring(acc, n: int):
-    """Track entry-reserve sponsorship on the creating account
-    (numSponsoring, reference createEntryWithPossibleSponsorship for
-    claimable balances)."""
-    from stellar_tpu.xdr.types import (
-        AccountEntryExtensionV1, AccountEntryExtensionV2, Liabilities,
-        _AEV1Ext, _AEV2Ext, _AccountEntryExt,
-    )
-    if acc.ext.arm == 0:
-        acc.ext = _AccountEntryExt.make(1, AccountEntryExtensionV1(
-            liabilities=Liabilities(buying=0, selling=0),
-            ext=_AEV1Ext.make(0)))
-    v1 = acc.ext.value
-    if v1.ext.arm == 0:
-        v1.ext = _AEV1Ext.make(2, AccountEntryExtensionV2(
-            numSponsored=0, numSponsoring=0, signerSponsoringIDs=[],
-            ext=_AEV2Ext.make(0)))
-    v1.ext.value.numSponsoring += n
 
 
 @register_op(OperationType.CLAIM_CLAIMABLE_BALANCE)
@@ -292,29 +266,13 @@ class ClaimClaimableBalanceOpFrame(OperationFrame):
                         ltx.rollback()
                         return False, self.make_result(
                             ClaimCode.CLAIM_CLAIMABLE_BALANCE_LINE_FULL)
-            _release_entry_sponsorship(ltx, entry)
+            with ltx.load(account_key(src_id)) as src:
+                remove_entry_with_possible_sponsorship(
+                    ltx, header, entry, src.entry)
             ltx.erase(key)
             ltx.commit()
         return True, self.make_result(
             ClaimCode.CLAIM_CLAIMABLE_BALANCE_SUCCESS)
-
-
-def _release_entry_sponsorship(ltx, entry):
-    """Release the creating sponsor's reserve (sponsoringID ext, or the
-    implicit creator for entries made here)."""
-    sponsor_id = None
-    if entry.ext.arm == 1 and entry.ext.value.sponsoringID is not None:
-        sponsor_id = entry.ext.value.sponsoringID
-    if sponsor_id is None:
-        return
-    h = ltx.load(account_key(sponsor_id))
-    if h is not None:
-        from stellar_tpu.tx.account_utils import account_ext_v2
-        v2 = account_ext_v2(h.data)
-        if v2 is not None:
-            v2.numSponsoring = max(
-                0, v2.numSponsoring - len(entry.data.value.claimants))
-        h.deactivate()
 
 
 @register_op(OperationType.CLAWBACK)
@@ -375,7 +333,9 @@ class ClawbackClaimableBalanceOpFrame(OperationFrame):
             if not (flags & CLAIMABLE_BALANCE_CLAWBACK_ENABLED_FLAG):
                 return False, self.make_result(
                     Code.CLAWBACK_CLAIMABLE_BALANCE_NOT_CLAWBACK_ENABLED)
-            _release_entry_sponsorship(ltx, entry)
+            with ltx.load(account_key(self.source_account_id())) as src:
+                remove_entry_with_possible_sponsorship(
+                    ltx, ltx.header(), entry, src.entry)
             ltx.erase(key)  # amount burned with the entry
             ltx.commit()
         return True, self.make_result(

@@ -1,15 +1,19 @@
 """CreateAccount (reference ``src/transactions/CreateAccountOpFrame.cpp``,
-protocol >= 14 path, non-sponsored)."""
+``doApplyFromV14`` path: reserve charged via possible sponsorship)."""
 
 from __future__ import annotations
 
 from stellar_tpu.ledger.ledger_txn import LedgerTxn
 from stellar_tpu.tx.account_utils import (
-    add_balance, get_available_balance, get_min_balance,
-    get_starting_sequence_number,
+    add_balance, get_available_balance, get_starting_sequence_number,
 )
 from stellar_tpu.tx.op_frame import OperationFrame, account_key, register_op
-from stellar_tpu.xdr.results import CreateAccountResultCode as Code
+from stellar_tpu.tx.sponsorship import (
+    SponsorshipResult, create_entry_with_possible_sponsorship,
+)
+from stellar_tpu.xdr.results import (
+    CreateAccountResultCode as Code, OperationResultCode,
+)
 from stellar_tpu.xdr.tx import OperationType
 from stellar_tpu.xdr.types import (
     AccountEntry, LedgerEntry, LedgerEntryType, _AccountEntryExt,
@@ -45,10 +49,21 @@ class CreateAccountOpFrame(OperationFrame):
 
         with LedgerTxn(outer) as ltx:
             header = ltx.header()
-            # the created account must itself meet the base reserve
-            if self.body.startingBalance < 2 * header.baseReserve:
+            entry = new_account_entry(
+                self.body.destination, self.body.startingBalance,
+                get_starting_sequence_number(header.ledgerSeq),
+                last_modified=header.ledgerSeq)
+            # Reserve for the new account: paid by the account's own
+            # starting balance, or by an active sponsor.
+            res = create_entry_with_possible_sponsorship(
+                ltx, header, entry, None)
+            if res == SponsorshipResult.LOW_RESERVE:
                 return False, self.make_result(
                     Code.CREATE_ACCOUNT_LOW_RESERVE)
+            if res == SponsorshipResult.TOO_MANY_SPONSORING:
+                return False, self.make_top_result(
+                    OperationResultCode.opTOO_MANY_SPONSORING)
+            assert res == SponsorshipResult.SUCCESS
 
             src = ltx.load(account_key(self.source_account_id()))
             if get_available_balance(header, src.entry) < \
@@ -60,10 +75,6 @@ class CreateAccountOpFrame(OperationFrame):
             assert ok
             src.deactivate()
 
-            entry = new_account_entry(
-                self.body.destination, self.body.startingBalance,
-                get_starting_sequence_number(header.ledgerSeq),
-                last_modified=header.ledgerSeq)
             ltx.create(entry).deactivate()
             ltx.commit()
         return True, self.make_result(Code.CREATE_ACCOUNT_SUCCESS)

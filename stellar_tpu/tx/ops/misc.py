@@ -4,7 +4,10 @@
 from __future__ import annotations
 
 from stellar_tpu.ledger.ledger_txn import LedgerTxn
-from stellar_tpu.tx.account_utils import add_num_entries
+from stellar_tpu.tx.sponsorship import (
+    SponsorshipResult, create_entry_with_possible_sponsorship,
+    remove_entry_with_possible_sponsorship,
+)
 from stellar_tpu.tx.op_frame import (
     OperationFrame, ThresholdLevel, account_key, register_op,
 )
@@ -49,28 +52,33 @@ class ManageDataOpFrame(OperationFrame):
                     existing.data.dataValue = self.body.dataValue
                     existing.deactivate()
                 else:
-                    with ltx.load(account_key(src_id)) as src:
-                        if not add_num_entries(header, src.data, 1):
-                            ltx.rollback()
-                            return False, self.make_result(
-                                Code.MANAGE_DATA_LOW_RESERVE)
                     de = DataEntry(
                         accountID=src_id, dataName=self.body.dataName,
                         dataValue=self.body.dataValue,
                         ext=DataEntry._types[3].make(0))
-                    ltx.create(LedgerEntry(
+                    le = LedgerEntry(
                         lastModifiedLedgerSeq=header.ledgerSeq,
                         data=LedgerEntry._types[1].make(
                             LedgerEntryType.DATA, de),
-                        ext=LedgerEntry._types[2].make(0))).deactivate()
+                        ext=LedgerEntry._types[2].make(0))
+                    with ltx.load(account_key(src_id)) as src:
+                        res = create_entry_with_possible_sponsorship(
+                            ltx, header, le, src.entry)
+                    if res != SponsorshipResult.SUCCESS:
+                        ltx.rollback()
+                        return False, self.sponsorship_failure(
+                            res, Code.MANAGE_DATA_LOW_RESERVE)
+                    ltx.create(le).deactivate()
             else:
-                if not ltx.exists(key):
+                le = ltx.load_without_record(key)
+                if le is None:
                     ltx.rollback()
                     return False, self.make_result(
                         Code.MANAGE_DATA_NAME_NOT_FOUND)
                 ltx.erase(key)
                 with ltx.load(account_key(src_id)) as src:
-                    add_num_entries(header, src.data, -1)
+                    remove_entry_with_possible_sponsorship(
+                        ltx, header, le, src.entry)
             ltx.commit()
         return True, self.make_result(Code.MANAGE_DATA_SUCCESS)
 

@@ -14,7 +14,11 @@ from __future__ import annotations
 
 from stellar_tpu.ledger.ledger_txn import LedgerTxn
 from stellar_tpu.tx import offer_exchange as ox
-from stellar_tpu.tx.account_utils import INT64_MAX, add_num_entries
+from stellar_tpu.tx.account_utils import INT64_MAX
+from stellar_tpu.tx.sponsorship import (
+    SponsorshipResult, create_entry_with_possible_sponsorship,
+    remove_entry_with_possible_sponsorship,
+)
 from stellar_tpu.tx.asset_utils import (
     get_issuer, is_asset_valid, is_native, trustline_key,
 )
@@ -141,6 +145,10 @@ class _ManageOfferBase(OperationFrame):
 
             creating = self.offer_id() == 0
             passive = False
+            # sponsorship extension carried from the modified offer, or
+            # established up front for a new one (reference apply start:
+            # "establishing the numSubEntries and sponsorship changes")
+            ext = None
             if not creating:
                 key = ox.offer_key(src, self.offer_id())
                 h = ltx.load(key)
@@ -149,18 +157,27 @@ class _ManageOfferBase(OperationFrame):
                     return self._fail("NOT_FOUND")
                 old = h.data
                 passive = bool(old.flags & PASSIVE_FLAG)
+                ext = h.entry.ext
                 h.deactivate()
                 with ltx.load(key) as h2:
                     ox.release_offer_liabilities(ltx, h2.data)
                 ltx.erase(key)
-                # numSubEntries retained: the slot carries over (or is
-                # released below on delete)
+                # numSubEntries/sponsorship retained: the slot carries
+                # over (or is released below on delete)
             else:
                 passive = self.passive_on_create()
+                template = new_offer_entry(src, 0, self.sheep(),
+                                           self.wheat(), 0, self.price(),
+                                           0, header.ledgerSeq)
                 with ltx.load(account_key(src)) as acc_h:
-                    if not add_num_entries(header, acc_h.data, 1):
-                        ltx.rollback()
-                        return self._fail("LOW_RESERVE")
+                    res = create_entry_with_possible_sponsorship(
+                        ltx, header, template, acc_h.entry)
+                if res != SponsorshipResult.SUCCESS:
+                    ltx.rollback()
+                    return False, self.sponsorship_failure(
+                        res, getattr(self.CODES,
+                                     self.PREFIX + "LOW_RESERVE"))
+                ext = template.ext
 
             atoms = []
             amount = 0
@@ -206,6 +223,8 @@ class _ManageOfferBase(OperationFrame):
                 le = new_offer_entry(src, new_id, self.sheep(),
                                      self.wheat(), amount, self.price(),
                                      flags, header.ledgerSeq)
+                if ext is not None:
+                    le.ext = ext
                 ltx.create(le).deactivate()
                 with ltx.load(ox.offer_key(src, new_id)) as h:
                     if not ox.acquire_offer_liabilities(ltx, h.data):
@@ -217,9 +236,14 @@ class _ManageOfferBase(OperationFrame):
                     success.offer = ManageOfferSuccessResult._types[1].make(
                         effect, _copy_offer(booked))
             else:
-                # nothing booked: release the subentry slot
+                # nothing booked: release the subentry slot + sponsorship
+                le = new_offer_entry(src, 0, self.sheep(), self.wheat(),
+                                     0, self.price(), 0, header.ledgerSeq)
+                if ext is not None:
+                    le.ext = ext
                 with ltx.load(account_key(src)) as acc_h:
-                    add_num_entries(header, acc_h.data, -1)
+                    remove_entry_with_possible_sponsorship(
+                        ltx, header, le, acc_h.entry)
                 success.offer = ManageOfferSuccessResult._types[1].make(
                     ManageOfferEffect.MANAGE_OFFER_DELETED)
             ltx.commit()

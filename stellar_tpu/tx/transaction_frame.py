@@ -30,6 +30,9 @@ from stellar_tpu.tx.account_utils import (
 )
 from stellar_tpu.tx.op_frame import account_key, make_op_frame
 from stellar_tpu.tx.signature_checker import SignatureChecker
+from stellar_tpu.tx.sponsorship import (
+    remove_signer_with_possible_sponsorship,
+)
 from stellar_tpu.xdr.results import (
     OperationResult, TransactionResult, TransactionResultCode as TxCode,
     tx_result,
@@ -460,7 +463,8 @@ class TransactionFrame:
                       SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
                       and s.key.value == h]
             for i in reversed(doomed):
-                _remove_signer_with_possible_sponsorship(ltx, acc, i)
+                remove_signer_with_possible_sponsorship(
+                    ltx, ltx.header(), handle.entry, i)
             handle.deactivate()
 
     def process_signatures(self, cv: int, checker: SignatureChecker,
@@ -552,42 +556,31 @@ class TransactionFrame:
                     op_txn.commit()
                 else:
                     op_txn.rollback()
+            # a Begin without its matching End leaves a live sponsorship
+            # directive: the whole tx fails (reference
+            # TransactionFrame.cpp:1693, txBAD_SPONSORSHIP)
+            bad_sponsorship = False
+            if success:
+                from stellar_tpu.tx.sponsorship import (
+                    has_sponsorship_entries,
+                )
+                if has_sponsorship_entries(tx_txn):
+                    success = False
+                    bad_sponsorship = True
             if success:
                 tx_txn.commit()
                 meta.operations.extend(op_metas)
                 result.set_code(TxCode.txSUCCESS)
             else:
                 tx_txn.rollback()
-                result.set_code(TxCode.txFAILED)
+                result.set_code(TxCode.txBAD_SPONSORSHIP
+                                if bad_sponsorship else TxCode.txFAILED)
         except Exception:
             if tx_txn._open:
                 tx_txn.rollback()
             result.set_code(TxCode.txINTERNAL_ERROR)
             raise
         return result
-
-
-def _remove_signer_with_possible_sponsorship(ltx, acc, idx: int):
-    """Remove acc.signers[idx] keeping sponsorship bookkeeping aligned:
-    the parallel signerSponsoringIDs entry goes too, and a sponsor's
-    numSponsoring / the account's numSponsored are decremented
-    (reference ``removeSignerWithPossibleSponsorship``,
-    ``src/transactions/SponsorshipUtils.cpp``)."""
-    v2 = account_ext_v2(acc)
-    sponsor_id = None
-    if v2 is not None and idx < len(v2.signerSponsoringIDs):
-        sponsor_id = v2.signerSponsoringIDs[idx]
-        del v2.signerSponsoringIDs[idx]
-    del acc.signers[idx]
-    acc.numSubEntries -= 1
-    if sponsor_id is not None:
-        v2.numSponsored -= 1
-        sp = ltx.load(account_key(sponsor_id))
-        if sp is not None:
-            sp_v2 = account_ext_v2(sp.data)
-            if sp_v2 is not None:
-                sp_v2.numSponsoring -= 1
-            sp.deactivate()
 
 
 def _v0_to_v1(tx_v0) -> Transaction:
@@ -742,7 +735,8 @@ class FeeBumpTransactionFrame:
                       SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX
                       and s.key.value == h]
             for i in reversed(doomed):
-                _remove_signer_with_possible_sponsorship(fee_txn, acc, i)
+                remove_signer_with_possible_sponsorship(
+                    fee_txn, fee_txn.header(), handle.entry, i)
             handle.deactivate()
         meta.tx_changes_before.extend(fee_txn.get_changes())
         fee_txn.commit()

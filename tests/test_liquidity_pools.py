@@ -1,0 +1,306 @@
+"""Liquidity-pool tests (reference
+``src/transactions/test/LiquidityPoolDepositTests.cpp``,
+``LiquidityPoolWithdrawTests.cpp``, ``LiquidityPoolTradeTests.cpp``,
+``ChangeTrustTests.cpp`` pool-share scenarios): pool-share trustlines,
+deposit/withdraw math, path-payment pool trading, and revocation
+redemption into claimable balances."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.asset_utils import (
+    change_trust_asset_to_trustline_asset, liquidity_pool_key,
+    pool_share_trustline_key, trustline_key,
+)
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import (
+    ChangeTrustResultCode as CT, ClaimAtomType,
+    LiquidityPoolDepositResultCode as DEP,
+    LiquidityPoolWithdrawResultCode as WD,
+    SetTrustLineFlagsResultCode, TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    ChangeTrustAsset, ChangeTrustOp, LiquidityPoolDepositOp,
+    LiquidityPoolWithdrawOp, Operation, OperationBody, OperationType,
+    PathPaymentStrictReceiveOp, PathPaymentStrictSendOp,
+    SetTrustLineFlagsOp, muxed_account,
+)
+from stellar_tpu.xdr.types import (
+    AUTHORIZED_FLAG, AssetType, LIQUIDITY_POOL_FEE_V18,
+    LiquidityPoolConstantProductParameters, LiquidityPoolParameters,
+    LiquidityPoolType, NATIVE_ASSET, Price, account_id, asset_alphanum4,
+)
+
+XLM = 10_000_000
+
+
+def op(body_type, body, source=None):
+    return Operation(
+        sourceAccount=muxed_account(source.public_key.raw)
+        if source else None,
+        body=OperationBody.make(body_type, body))
+
+
+def change_trust_op(line, limit, source=None):
+    return op(OperationType.CHANGE_TRUST,
+              ChangeTrustOp(line=line, limit=limit), source)
+
+
+def pool_params(asset_a, asset_b):
+    return LiquidityPoolParameters.make(
+        LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+        LiquidityPoolConstantProductParameters(
+            assetA=asset_a, assetB=asset_b, fee=LIQUIDITY_POOL_FEE_V18))
+
+
+def pool_share_line(asset_a, asset_b):
+    return ChangeTrustAsset.make(AssetType.ASSET_TYPE_POOL_SHARE,
+                                 pool_params(asset_a, asset_b))
+
+
+def deposit_op(pool_id, max_a, max_b, min_price=(1, 10_000_000),
+               max_price=(10_000_000, 1), source=None):
+    return op(OperationType.LIQUIDITY_POOL_DEPOSIT, LiquidityPoolDepositOp(
+        liquidityPoolID=pool_id, maxAmountA=max_a, maxAmountB=max_b,
+        minPrice=Price(n=min_price[0], d=min_price[1]),
+        maxPrice=Price(n=max_price[0], d=max_price[1])), source)
+
+
+def withdraw_op(pool_id, amount, min_a=0, min_b=0, source=None):
+    return op(OperationType.LIQUIDITY_POOL_WITHDRAW,
+              LiquidityPoolWithdrawOp(liquidityPoolID=pool_id,
+                                      amount=amount, minAmountA=min_a,
+                                      minAmountB=min_b), source)
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner_code(res, i=0):
+    return res.op_results[i].value.value.arm
+
+
+def get_account(root, kp):
+    e = root.store.get(key_bytes(account_key(
+        account_id(kp.public_key.raw))))
+    return None if e is None else e.data.value
+
+
+def seq_for(root, kp, off=1):
+    return get_account(root, kp).seqNum + off
+
+
+@pytest.fixture
+def env():
+    """XLM/USD pool: alice deposits, bob trades."""
+    a, b, issuer = keypair("lp-alice"), keypair("lp-bob"), keypair("lp-iss")
+    root = seed_root_with_accounts(
+        [(a, 100_000 * XLM), (b, 100_000 * XLM), (issuer, 100_000 * XLM)])
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    line = pool_share_line(NATIVE_ASSET, usd)
+    pool_id = change_trust_asset_to_trustline_asset(line).value
+    # alice: USD trustline + pool share trustline; fund USD
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(ChangeTrustAsset.make(usd.arm, usd.value),
+                        10_000_000 * XLM),
+    ])).code == TC.txSUCCESS
+    from stellar_tpu.xdr.tx import PaymentOp
+    pay = op(OperationType.PAYMENT, PaymentOp(
+        destination=muxed_account(a.public_key.raw), asset=usd,
+        amount=50_000 * XLM))
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                  [pay])).code == TC.txSUCCESS
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(line, 10_000_000 * XLM)]))
+    assert res.code == TC.txSUCCESS
+    return root, a, b, issuer, usd, line, pool_id
+
+
+def pool_entry(root, pool_id):
+    e = root.store.get(key_bytes(liquidity_pool_key(pool_id)))
+    return None if e is None else e.data.value.body.value
+
+
+def test_pool_share_trustline_creates_pool(env):
+    root, a, _, _, usd, line, pool_id = env
+    cp = pool_entry(root, pool_id)
+    assert cp is not None
+    assert cp.poolSharesTrustLineCount == 1
+    assert cp.totalPoolShares == 0
+    # underlying USD trustline got pinned
+    tle = root.store.get(key_bytes(trustline_key(
+        account_id(a.public_key.raw), usd)))
+    assert tle.data.value.ext.value.ext.value.liquidityPoolUseCount == 1
+    # account paid 2 base reserves for the pool share line
+    from stellar_tpu.tx.account_utils import account_ext_v2
+    acc = get_account(root, a)
+    assert acc.numSubEntries == 3  # USD line (1) + pool share line (2)
+
+
+def test_deposit_empty_and_proportional(env):
+    root, a, _, _, usd, line, pool_id = env
+    # seed 1000 XLM / 5000 USD  (price 0.2 XLM per USD)
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM)]))
+    assert res.code == TC.txSUCCESS
+    cp = pool_entry(root, pool_id)
+    assert cp.reserveA == 1000 * XLM
+    assert cp.reserveB == 5000 * XLM
+    import math
+    expected = math.isqrt(1000 * XLM * 5000 * XLM)
+    assert cp.totalPoolShares == expected
+    tl = root.store.get(key_bytes(pool_share_trustline_key(
+        account_id(a.public_key.raw), pool_id)))
+    assert tl.data.value.balance == expected
+
+    # proportional second deposit: maxA 100 XLM, maxB huge
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 100 * XLM, 50_000 * XLM)]))
+    assert res.code == TC.txSUCCESS
+    cp2 = pool_entry(root, pool_id)
+    assert cp2.reserveA == 1100 * XLM
+    # B grew proportionally (~10%)
+    assert abs(cp2.reserveB - 5500 * XLM) <= 10
+
+
+def test_deposit_bad_price_and_no_trust(env):
+    root, a, b, _, usd, line, pool_id = env
+    # price bounds exclude 1:5
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM,
+                   min_price=(1, 2), max_price=(2, 1))]))
+    assert inner_code(res) == DEP.LIQUIDITY_POOL_DEPOSIT_BAD_PRICE
+    # bob has no pool share trustline
+    res = apply_tx(root, make_tx(b, seq_for(root, b), [
+        deposit_op(pool_id, 10 * XLM, 10 * XLM)]))
+    assert inner_code(res) == DEP.LIQUIDITY_POOL_DEPOSIT_NO_TRUST
+
+
+def test_withdraw_pro_rata(env):
+    root, a, _, _, usd, line, pool_id = env
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM)])).code == TC.txSUCCESS
+    cp = pool_entry(root, pool_id)
+    shares = cp.totalPoolShares
+    # withdraw half
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        withdraw_op(pool_id, shares // 2)]))
+    assert res.code == TC.txSUCCESS
+    cp2 = pool_entry(root, pool_id)
+    assert abs(cp2.reserveA - 500 * XLM) <= 1
+    assert abs(cp2.reserveB - 2500 * XLM) <= 1
+    # under-minimum
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        withdraw_op(pool_id, 1000, min_a=10**18)]))
+    assert inner_code(res) == WD.LIQUIDITY_POOL_WITHDRAW_UNDER_MINIMUM
+
+
+def test_path_payment_trades_with_pool(env):
+    root, a, b, issuer, usd, line, pool_id = env
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM)])).code == TC.txSUCCESS
+    # bob strict-sends 10 XLM -> USD to himself (needs USD trustline)
+    assert apply_tx(root, make_tx(b, seq_for(root, b), [
+        change_trust_op(ChangeTrustAsset.make(usd.arm, usd.value),
+                        10_000_000 * XLM)])).code == TC.txSUCCESS
+    pps = op(OperationType.PATH_PAYMENT_STRICT_SEND, PathPaymentStrictSendOp(
+        sendAsset=NATIVE_ASSET, sendAmount=10 * XLM,
+        destination=muxed_account(b.public_key.raw),
+        destAsset=usd, destMin=1, path=[]))
+    res = apply_tx(root, make_tx(b, seq_for(root, b), [pps]))
+    assert res.code == TC.txSUCCESS
+    # success result carries a liquidity-pool claim atom
+    inner = res.op_results[0].value.value
+    success = inner.value
+    atoms = success.offers
+    assert len(atoms) == 1
+    assert atoms[0].arm == ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+    lp_atom = atoms[0].value
+    assert lp_atom.liquidityPoolID == pool_id
+    assert lp_atom.amountBought == 10 * XLM
+    # constant-product with 30bps fee: floor(9970*R_B*x/(10000*R_A+9970*x))
+    x = 10 * XLM
+    expect = (9970 * 5000 * XLM * x) // (10000 * 1000 * XLM + 9970 * x)
+    assert lp_atom.amountSold == expect
+    cp = pool_entry(root, pool_id)
+    assert cp.reserveA == 1010 * XLM
+    assert cp.reserveB == 5000 * XLM - expect
+    # bob received the USD
+    tle = root.store.get(key_bytes(trustline_key(
+        account_id(b.public_key.raw), usd)))
+    assert tle.data.value.balance == expect
+
+
+def test_cannot_delete_pinned_trustline(env):
+    root, a, _, issuer, usd, line, pool_id = env
+    # empty the USD balance back to the issuer so only the pool pin blocks
+    from stellar_tpu.xdr.tx import PaymentOp
+    pay = op(OperationType.PAYMENT, PaymentOp(
+        destination=muxed_account(issuer.public_key.raw), asset=usd,
+        amount=50_000 * XLM))
+    assert apply_tx(root, make_tx(a, seq_for(root, a),
+                                  [pay])).code == TC.txSUCCESS
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(ChangeTrustAsset.make(usd.arm, usd.value), 0)]))
+    assert inner_code(res) == CT.CHANGE_TRUST_CANNOT_DELETE
+
+
+def test_delete_pool_share_trustline_drops_pool(env):
+    root, a, _, _, usd, line, pool_id = env
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(line, 0)]))
+    assert res.code == TC.txSUCCESS
+    assert pool_entry(root, pool_id) is None
+    tle = root.store.get(key_bytes(trustline_key(
+        account_id(a.public_key.raw), usd)))
+    assert tle.data.value.ext.value.ext.value.liquidityPoolUseCount == 0
+    acc = get_account(root, a)
+    assert acc.numSubEntries == 1
+
+
+def test_revocation_redeems_pool_shares(env):
+    """Issuer revokes alice's USD auth: her pool-share trustline redeems
+    into claimable balances and the pool empties (reference
+    SetTrustLineFlagsTests revoke-with-pool scenarios)."""
+    root, a, _, issuer, usd, line, pool_id = env
+    # issuer must be auth-revocable
+    from stellar_tpu.xdr.tx import SetOptionsOp
+    from stellar_tpu.xdr.types import AUTH_REVOCABLE_FLAG
+    so = op(OperationType.SET_OPTIONS, SetOptionsOp(
+        inflationDest=None, clearFlags=None, setFlags=AUTH_REVOCABLE_FLAG,
+        masterWeight=None, lowThreshold=None, medThreshold=None,
+        highThreshold=None, homeDomain=None, signer=None))
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                  [so])).code == TC.txSUCCESS
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM)])).code == TC.txSUCCESS
+
+    stf = op(OperationType.SET_TRUST_LINE_FLAGS, SetTrustLineFlagsOp(
+        trustor=account_id(a.public_key.raw), asset=usd,
+        clearFlags=AUTHORIZED_FLAG, setFlags=0))
+    res = apply_tx(root, make_tx(issuer, seq_for(root, issuer), [stf]))
+    assert res.code == TC.txSUCCESS
+    # pool gone (alice held the only share trustline)
+    assert pool_entry(root, pool_id) is None
+    assert root.store.get(key_bytes(pool_share_trustline_key(
+        account_id(a.public_key.raw), pool_id))) is None
+    # claimable balances exist for both constituents
+    from stellar_tpu.xdr.types import LedgerEntryType
+    cbs = [e for kb, e in
+           ((kb, root.store.get(kb)) for kb in list(root.store.entries))
+           if e.data.arm == LedgerEntryType.CLAIMABLE_BALANCE]
+    assert len(cbs) == 2
+    amounts = sorted(cb.data.value.amount for cb in cbs)
+    assert amounts == [1000 * XLM, 5000 * XLM]
+    for cb in cbs:
+        claimants = cb.data.value.claimants
+        assert claimants[0].value.destination == \
+            account_id(a.public_key.raw)

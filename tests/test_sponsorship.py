@@ -1,0 +1,378 @@
+"""Sponsored-reserves tests (reference
+``src/transactions/test/SponsorshipTests.cpp``,
+``BeginSponsoringFutureReservesTests.cpp``,
+``EndSponsoringFutureReservesTests.cpp``, ``RevokeSponsorshipTests.cpp``
+scenarios): Begin/End bracketing, sponsored account/trustline/signer
+creation, revoke/transfer, and the txBAD_SPONSORSHIP tx-level guard."""
+
+import pytest
+
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.asset_utils import trustline_key
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.tx_test_utils import (
+    create_account_op, keypair, make_tx, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import (
+    AccountMergeResultCode, BeginSponsoringFutureReservesResultCode as BC,
+    EndSponsoringFutureReservesResultCode as EC, OperationResultCode,
+    RevokeSponsorshipResultCode as RC, TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    BeginSponsoringFutureReservesOp, ChangeTrustAsset, ChangeTrustOp,
+    Operation, OperationBody, OperationType, RevokeSponsorshipOp,
+    RevokeSponsorshipOpSigner, RevokeSponsorshipType, SetOptionsOp,
+    muxed_account,
+)
+from stellar_tpu.xdr.types import (
+    LedgerEntryType, LedgerKey, LedgerKeyTrustLine, Signer, SignerKey,
+    SignerKeyType, account_id, asset_alphanum4,
+)
+
+XLM = 10_000_000
+BASE_RESERVE = 100_000_000  # genesis header (ledger_txn._genesis_header)
+
+
+def op(body_type, body, source=None):
+    return Operation(
+        sourceAccount=muxed_account(source.public_key.raw)
+        if source else None,
+        body=OperationBody.make(body_type, body))
+
+
+def begin_op(sponsored, source=None):
+    return op(OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+              BeginSponsoringFutureReservesOp(
+                  sponsoredID=account_id(sponsored.public_key.raw)),
+              source)
+
+
+def end_op(source=None):
+    return op(OperationType.END_SPONSORING_FUTURE_RESERVES, None, source)
+
+
+def revoke_entry_op(ledger_key, source=None):
+    body = RevokeSponsorshipOp.make(
+        RevokeSponsorshipType.REVOKE_SPONSORSHIP_LEDGER_ENTRY, ledger_key)
+    return op(OperationType.REVOKE_SPONSORSHIP, body, source)
+
+
+def revoke_signer_op(target, signer_key, source=None):
+    body = RevokeSponsorshipOp.make(
+        RevokeSponsorshipType.REVOKE_SPONSORSHIP_SIGNER,
+        RevokeSponsorshipOpSigner(
+            accountID=account_id(target.public_key.raw),
+            signerKey=signer_key))
+    return op(OperationType.REVOKE_SPONSORSHIP, body, source)
+
+
+def change_trust_op(asset, limit, source=None):
+    line = ChangeTrustAsset.make(asset.arm, asset.value)
+    return op(OperationType.CHANGE_TRUST,
+              ChangeTrustOp(line=line, limit=limit), source)
+
+
+def set_options_signer_op(signer, source=None):
+    fields = dict(inflationDest=None, clearFlags=None, setFlags=None,
+                  masterWeight=None, lowThreshold=None, medThreshold=None,
+                  highThreshold=None, homeDomain=None, signer=signer)
+    return op(OperationType.SET_OPTIONS, SetOptionsOp(**fields), source)
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner_code(res, i=0):
+    return res.op_results[i].value.value.arm
+
+
+def get_account(root, kp):
+    e = root.store.get(key_bytes(account_key(
+        account_id(kp.public_key.raw))))
+    return None if e is None else e.data.value
+
+
+def get_account_entry(root, kp):
+    return root.store.get(key_bytes(account_key(
+        account_id(kp.public_key.raw))))
+
+
+def seq_for(root, kp, off=1):
+    return get_account(root, kp).seqNum + off
+
+
+def num_sponsoring(acc):
+    from stellar_tpu.tx.account_utils import account_ext_v2
+    v2 = account_ext_v2(acc)
+    return v2.numSponsoring if v2 else 0
+
+
+def num_sponsored(acc):
+    from stellar_tpu.tx.account_utils import account_ext_v2
+    v2 = account_ext_v2(acc)
+    return v2.numSponsored if v2 else 0
+
+
+@pytest.fixture
+def env():
+    a, b, issuer = keypair("sponsor"), keypair("sponsored"), keypair("iss")
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM + 40 * BASE_RESERVE),
+         (b, 1000 * XLM + 2 * BASE_RESERVE),
+         (issuer, 1000 * XLM + 2 * BASE_RESERVE)])
+    return root, a, b, issuer
+
+
+def test_sponsored_account_creation(env):
+    """A sponsors the creation of C with zero starting balance."""
+    root, a, b, _ = env
+    c = keypair("created")
+    tx = make_tx(a, seq_for(root, a), [
+        begin_op(c),
+        create_account_op(c, 0),
+        end_op(source=c),
+    ], extra_signers=[c])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    ce = get_account_entry(root, c)
+    assert ce.ext.arm == 1
+    assert ce.ext.value.sponsoringID == account_id(a.public_key.raw)
+    assert num_sponsored(ce.data.value) == 2
+    assert num_sponsoring(get_account(root, a)) == 2
+
+
+def test_begin_without_end_fails_tx(env):
+    root, a, _, _ = env
+    c = keypair("created2")
+    before = get_account(root, a).balance
+    tx = make_tx(a, seq_for(root, a), [
+        begin_op(c),
+        create_account_op(c, 0),
+    ])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txBAD_SPONSORSHIP
+    # the whole tx rolled back: no account created, fee still charged
+    assert get_account(root, c) is None
+    assert get_account(root, a).balance == before - 200
+
+
+def test_begin_self_malformed(env):
+    root, a, _, _ = env
+    tx = make_tx(a, seq_for(root, a), [begin_op(a), end_op()])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txFAILED
+    assert inner_code(res, 0) == \
+        BC.BEGIN_SPONSORING_FUTURE_RESERVES_MALFORMED
+
+
+def test_begin_already_sponsored_and_recursive(env):
+    root, a, b, issuer = env
+    # already sponsored: two begins for the same account
+    tx = make_tx(a, seq_for(root, a), [
+        begin_op(b), begin_op(b, source=issuer)], extra_signers=[issuer])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txFAILED  # second begin fails the tx outright
+    assert inner_code(res, 1) == \
+        BC.BEGIN_SPONSORING_FUTURE_RESERVES_ALREADY_SPONSORED
+
+    # recursive: b, while sponsored by a, begins sponsoring issuer
+    tx = make_tx(a, seq_for(root, a), [
+        begin_op(b),
+        begin_op(issuer, source=b),
+    ], extra_signers=[b])
+    res = apply_tx(root, tx)
+    assert inner_code(res, 1) == \
+        BC.BEGIN_SPONSORING_FUTURE_RESERVES_RECURSIVE
+
+
+def test_end_without_begin(env):
+    root, a, _, _ = env
+    tx = make_tx(a, seq_for(root, a), [end_op()])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == \
+        EC.END_SPONSORING_FUTURE_RESERVES_NOT_SPONSORED
+
+
+ASSET = None
+
+
+def _asset(issuer):
+    return asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+
+
+def test_sponsored_trustline_and_revoke(env):
+    root, a, b, issuer = env
+    asset = _asset(issuer)
+    # b opens a trustline under a's sponsorship
+    tx = make_tx(b, seq_for(root, b), [
+        begin_op(b, source=a),
+        change_trust_op(asset, 1000 * XLM),
+        end_op(),
+    ], extra_signers=[a])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    tlk = trustline_key(account_id(b.public_key.raw), asset)
+    tle = root.store.get(key_bytes(tlk))
+    assert tle.ext.arm == 1
+    assert tle.ext.value.sponsoringID == account_id(a.public_key.raw)
+    assert num_sponsoring(get_account(root, a)) == 1
+    assert num_sponsored(get_account(root, b)) == 1
+
+    # a (the sponsor) revokes: reserve reverts to b
+    tx = make_tx(a, seq_for(root, a), [revoke_entry_op(tlk)])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    assert inner_code(res) == RC.REVOKE_SPONSORSHIP_SUCCESS
+    tle = root.store.get(key_bytes(tlk))
+    assert tle.ext.value.sponsoringID is None
+    assert num_sponsoring(get_account(root, a)) == 0
+    assert num_sponsored(get_account(root, b)) == 0
+
+
+def test_revoke_not_sponsor(env):
+    root, a, b, issuer = env
+    asset = _asset(issuer)
+    tx = make_tx(b, seq_for(root, b), [change_trust_op(asset, 100 * XLM)])
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+    tlk = trustline_key(account_id(b.public_key.raw), asset)
+    # a never sponsored it and does not own it
+    tx = make_tx(a, seq_for(root, a), [revoke_entry_op(tlk)])
+    res = apply_tx(root, tx)
+    assert inner_code(res) == RC.REVOKE_SPONSORSHIP_NOT_SPONSOR
+
+
+def test_revoke_transfer_to_new_sponsor(env):
+    root, a, b, issuer = env
+    asset = _asset(issuer)
+    # a sponsors b's trustline
+    tx = make_tx(b, seq_for(root, b), [
+        begin_op(b, source=a), change_trust_op(asset, 100 * XLM), end_op(),
+    ], extra_signers=[a])
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+    tlk = trustline_key(account_id(b.public_key.raw), asset)
+    # a revokes while issuer sponsors a's future reserves: transfer
+    tx = make_tx(a, seq_for(root, a), [
+        begin_op(a, source=issuer),
+        revoke_entry_op(tlk),
+        end_op(),
+    ], extra_signers=[issuer])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    tle = root.store.get(key_bytes(tlk))
+    assert tle.ext.value.sponsoringID == account_id(issuer.public_key.raw)
+    assert num_sponsoring(get_account(root, a)) == 0
+    assert num_sponsoring(get_account(root, issuer)) == 1
+    assert num_sponsored(get_account(root, b)) == 1
+
+
+def test_sponsored_signer_and_revoke(env):
+    root, a, b, _ = env
+    co = keypair("cosigner-sp")
+    sk = SignerKey.make(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        co.public_key.raw)
+    tx = make_tx(b, seq_for(root, b), [
+        begin_op(b, source=a),
+        set_options_signer_op(Signer(key=sk, weight=1)),
+        end_op(),
+    ], extra_signers=[a])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    acc = get_account(root, b)
+    from stellar_tpu.tx.account_utils import account_ext_v2
+    v2 = account_ext_v2(acc)
+    assert v2.signerSponsoringIDs == [account_id(a.public_key.raw)]
+    assert num_sponsoring(get_account(root, a)) == 1
+
+    # sponsor revokes the signer sponsorship
+    tx = make_tx(a, seq_for(root, a), [revoke_signer_op(b, sk)])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    acc = get_account(root, b)
+    v2 = account_ext_v2(acc)
+    assert v2.signerSponsoringIDs == [None]
+    assert num_sponsoring(get_account(root, a)) == 0
+    assert len(acc.signers) == 1  # signer itself stays
+
+
+def test_merge_while_sponsoring_fails(env):
+    root, a, b, issuer = env
+    asset = _asset(issuer)
+    tx = make_tx(b, seq_for(root, b), [
+        begin_op(b, source=a), change_trust_op(asset, 100 * XLM), end_op(),
+    ], extra_signers=[a])
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+    # a sponsors the trustline → cannot merge away
+    from stellar_tpu.xdr.tx import OperationType as OT
+    merge = Operation(
+        sourceAccount=None,
+        body=OperationBody.make(
+            OT.ACCOUNT_MERGE, muxed_account(issuer.public_key.raw)))
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [merge]))
+    assert inner_code(res) == AccountMergeResultCode.ACCOUNT_MERGE_IS_SPONSOR
+
+
+def test_revoke_claimable_balance_only_transferable(env):
+    root, a, b, issuer = env
+    from stellar_tpu.tx.ops.claimable_balances import (
+        claimable_balance_key, operation_balance_id,
+    )
+    from stellar_tpu.xdr.tx import CreateClaimableBalanceOp
+    from stellar_tpu.xdr.types import (
+        ClaimPredicate, ClaimPredicateType, Claimant, ClaimantV0,
+        ClaimableBalanceID, ClaimableBalanceIDType, NATIVE_ASSET,
+    )
+    pred = ClaimPredicate.make(
+        ClaimPredicateType.CLAIM_PREDICATE_UNCONDITIONAL)
+    cb = CreateClaimableBalanceOp(
+        asset=NATIVE_ASSET, amount=5 * XLM,
+        claimants=[Claimant.make(0, ClaimantV0(
+            destination=account_id(b.public_key.raw), predicate=pred))])
+    seq = seq_for(root, a)
+    tx = make_tx(a, seq, [op(OperationType.CREATE_CLAIMABLE_BALANCE, cb)])
+    res = apply_tx(root, tx)
+    assert res.code == TC.txSUCCESS
+    bid = ClaimableBalanceID.make(
+        ClaimableBalanceIDType.CLAIMABLE_BALANCE_ID_TYPE_V0,
+        operation_balance_id(account_id(a.public_key.raw), seq, 0))
+    # creator self-sponsors the CB entry
+    assert num_sponsoring(get_account(root, a)) == 1
+    cbk = claimable_balance_key(bid)
+    # revoking with no active directive cannot drop the sponsorship
+    res = apply_tx(root, make_tx(a, seq_for(root, a),
+                                 [revoke_entry_op(cbk)]))
+    assert inner_code(res) == RC.REVOKE_SPONSORSHIP_ONLY_TRANSFERABLE
+
+
+def test_revoke_malformed_keys(env):
+    root, a, _, issuer = env
+    # native-asset trustline key is malformed
+    from stellar_tpu.xdr.types import (
+        AssetType, TrustLineAsset, LedgerKeyTrustLine,
+    )
+    lk = LedgerKey.make(
+        LedgerEntryType.TRUSTLINE,
+        LedgerKeyTrustLine(
+            accountID=account_id(a.public_key.raw),
+            asset=TrustLineAsset.make(AssetType.ASSET_TYPE_NATIVE)))
+    res = apply_tx(root, make_tx(a, seq_for(root, a),
+                                 [revoke_entry_op(lk)]))
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == RC.REVOKE_SPONSORSHIP_MALFORMED
+
+
+def test_sponsorship_survives_commit_guard():
+    """Internal sponsorship entries must never commit to the root."""
+    from stellar_tpu.ledger.ledger_txn import (
+        LedgerTxnError, LedgerTxnRoot,
+    )
+    root = LedgerTxnRoot()
+    ltx = LedgerTxn(root)
+    ltx.set_internal(b"S" + b"\x01" * 32, b"\x02" * 32)
+    with pytest.raises(LedgerTxnError):
+        ltx.commit()
