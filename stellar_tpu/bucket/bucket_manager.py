@@ -1,0 +1,119 @@
+"""BucketManager: content-addressed bucket files on disk (reference
+``src/bucket/BucketManager.h`` — adoption, retention/GC, and the
+bucket-dir layout ``bucket/bucket-<hex>.xdr``).
+
+Buckets are immutable and named by the SHA-256 of their contents, so
+persistence is idempotent: writing is adopt-if-absent via a tmp-file +
+atomic rename, restart just maps hashes back to files. The manifest of
+a whole LiveBucketList — per level ``curr``/``snap``/``next`` hashes —
+is what :class:`stellar_tpu.database.NodePersistence` stores in SQL; a
+restored list is bit-identical, including pending (``next``) merges, so
+the spill cadence continues exactly where it stopped.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+from stellar_tpu.bucket.bucket import EMPTY, Bucket
+from stellar_tpu.bucket.bucket_list import LiveBucketList, NUM_LEVELS
+
+__all__ = ["BucketManager"]
+
+
+class BucketManager:
+    def __init__(self, bucket_dir: Optional[str]):
+        """``bucket_dir=None`` keeps everything in memory (tests /
+        ephemeral nodes)."""
+        self.bucket_dir = bucket_dir
+        if bucket_dir is not None:
+            os.makedirs(bucket_dir, exist_ok=True)
+        self._cache: Dict[bytes, Bucket] = {}
+
+    # ---------------- adoption / retrieval ----------------
+
+    def _path_for(self, h: bytes) -> str:
+        return os.path.join(self.bucket_dir, f"bucket-{h.hex()}.xdr")
+
+    def adopt(self, bucket: Bucket) -> bytes:
+        """Ensure the bucket is durable; returns its hash (reference
+        ``adoptFileAsBucket``)."""
+        h = bucket.hash
+        if h in self._cache:
+            return h
+        self._cache[h] = bucket
+        if self.bucket_dir is not None and bucket is not EMPTY:
+            path = self._path_for(h)
+            if not os.path.exists(path):
+                fd, tmp = tempfile.mkstemp(dir=self.bucket_dir,
+                                           prefix=".tmp-bucket-")
+                try:
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(bucket.serialize())
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.rename(tmp, path)
+                except Exception:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    raise
+        return h
+
+    def load(self, h: bytes) -> Bucket:
+        if h == EMPTY.hash:
+            return EMPTY
+        b = self._cache.get(h)
+        if b is not None:
+            return b
+        if self.bucket_dir is None:
+            raise KeyError(f"unknown bucket {h.hex()}")
+        with open(self._path_for(h), "rb") as f:
+            b = Bucket.deserialize(f.read())
+        if b.hash != h:
+            raise IOError(f"bucket file {h.hex()} fails its hash check")
+        self._cache[h] = b
+        return b
+
+    # ---------------- whole-list persistence ----------------
+
+    def persist_bucket_list(self, bl: LiveBucketList) -> List[dict]:
+        """Write every referenced bucket to disk; return the level
+        manifest (curr/snap/next hashes, hex)."""
+        manifest = []
+        for lev in bl.levels:
+            entry = {"curr": self.adopt(lev.curr).hex(),
+                     "snap": self.adopt(lev.snap).hex()}
+            if lev.next is not None:
+                entry["next"] = self.adopt(lev.next).hex()
+            manifest.append(entry)
+        return manifest
+
+    def restore_bucket_list(self, manifest: List[dict]) -> LiveBucketList:
+        bl = LiveBucketList()
+        for i, entry in enumerate(manifest[:NUM_LEVELS]):
+            lev = bl.levels[i]
+            lev.curr = self.load(bytes.fromhex(entry["curr"]))
+            lev.snap = self.load(bytes.fromhex(entry["snap"]))
+            if "next" in entry:
+                lev.next = self.load(bytes.fromhex(entry["next"]))
+        return bl
+
+    # ---------------- GC ----------------
+
+    def forget_unreferenced(self, referenced: set):
+        """Drop cache entries and delete files not in ``referenced``
+        (reference ``forgetUnreferencedBuckets``)."""
+        referenced = set(referenced) | {EMPTY.hash}
+        for h in list(self._cache):
+            if h not in referenced:
+                del self._cache[h]
+        if self.bucket_dir is None:
+            return
+        for name in os.listdir(self.bucket_dir):
+            if not name.startswith("bucket-") or not name.endswith(".xdr"):
+                continue
+            h = bytes.fromhex(name[len("bucket-"):-len(".xdr")])
+            if h not in referenced:
+                os.unlink(os.path.join(self.bucket_dir, name))

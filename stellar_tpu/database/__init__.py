@@ -1,0 +1,3 @@
+from stellar_tpu.database.database import (  # noqa: F401
+    Database, NodePersistence, PersistentState,
+)

@@ -1,0 +1,237 @@
+"""Node-local persistence: SQLite store + crash-ordered ledger commit
+(reference ``src/database/Database.h`` + ``src/main/PersistentState.h``).
+
+The division of labor mirrors the reference post-BucketListDB
+(``src/bucket/readme.md:35-50``): SQL holds only small critical state —
+ledger headers, the PersistentState key/value rows (LCL pointer,
+bucket-list manifest, HAS, SCP data), tx/scp history — while live ledger
+entries live in content-addressed bucket files on disk (see
+``stellar_tpu.bucket.bucket_manager``).
+
+Crash ordering (reference ``LedgerManagerImpl.cpp:1026-1077``): bucket
+files are durably written *before* the single SQL transaction that
+flips the LCL pointer. A crash between the two leaves orphan bucket
+files (GC'd later) and a DB that still points at the previous LCL — the
+node restarts from a consistent earlier state, never a torn one.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from typing import List, Optional, Tuple
+
+__all__ = ["Database", "PersistentState", "NodePersistence"]
+
+SCHEMA_VERSION = 1
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS storestate (
+    statename TEXT PRIMARY KEY,
+    state     TEXT
+);
+CREATE TABLE IF NOT EXISTS ledgerheaders (
+    ledgerhash BLOB PRIMARY KEY,
+    prevhash   BLOB,
+    ledgerseq  INTEGER UNIQUE,
+    closetime  INTEGER,
+    data       BLOB
+);
+CREATE TABLE IF NOT EXISTS txhistory (
+    txid      BLOB,
+    ledgerseq INTEGER,
+    txindex   INTEGER,
+    txbody    BLOB,
+    txresult  BLOB,
+    PRIMARY KEY (ledgerseq, txindex)
+);
+CREATE TABLE IF NOT EXISTS scphistory (
+    nodeid    BLOB,
+    ledgerseq INTEGER,
+    envelope  BLOB
+);
+CREATE INDEX IF NOT EXISTS scphistorybyseq ON scphistory (ledgerseq);
+"""
+
+
+class Database:
+    """Thin sqlite3 wrapper (reference soci ``Database``). ``path`` may
+    be ``:memory:`` for tests."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self.conn = sqlite3.connect(path)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA synchronous=FULL")
+        self.initialize()
+
+    def initialize(self):
+        """Create/upgrade the schema (reference ``new-db`` /
+        ``upgrade-db``)."""
+        with self.conn:
+            self.conn.executescript(_SCHEMA)
+        ps = PersistentState(self)
+        if ps.get(PersistentState.DATABASE_SCHEMA) is None:
+            ps.set(PersistentState.DATABASE_SCHEMA, str(SCHEMA_VERSION))
+
+    def close(self):
+        self.conn.close()
+
+    # ---------------- ledger headers ----------------
+
+    def store_header(self, header_hash: bytes, prev_hash: bytes,
+                     seq: int, close_time: int, data: bytes,
+                     commit: bool = True):
+        sql = ("INSERT OR REPLACE INTO ledgerheaders "
+               "(ledgerhash, prevhash, ledgerseq, closetime, data) "
+               "VALUES (?, ?, ?, ?, ?)")
+        args = (header_hash, prev_hash, seq, close_time, data)
+        if commit:
+            with self.conn:
+                self.conn.execute(sql, args)
+        else:
+            self.conn.execute(sql, args)
+
+    def load_header_by_hash(self, header_hash: bytes) -> Optional[bytes]:
+        row = self.conn.execute(
+            "SELECT data FROM ledgerheaders WHERE ledgerhash = ?",
+            (header_hash,)).fetchone()
+        return row[0] if row else None
+
+    def load_header_by_seq(self, seq: int) -> Optional[bytes]:
+        row = self.conn.execute(
+            "SELECT data FROM ledgerheaders WHERE ledgerseq = ?",
+            (seq,)).fetchone()
+        return row[0] if row else None
+
+    def max_header_seq(self) -> Optional[int]:
+        row = self.conn.execute(
+            "SELECT MAX(ledgerseq) FROM ledgerheaders").fetchone()
+        return row[0]
+
+    # ---------------- tx history ----------------
+
+    def store_tx_history(self, seq: int,
+                         rows: List[Tuple[bytes, bytes, bytes]],
+                         commit: bool = True):
+        """rows: (txid, envelope_xdr, result_xdr) in apply order."""
+        sql = ("INSERT OR REPLACE INTO txhistory "
+               "(txid, ledgerseq, txindex, txbody, txresult) "
+               "VALUES (?, ?, ?, ?, ?)")
+        args = [(txid, seq, i, body, result)
+                for i, (txid, body, result) in enumerate(rows)]
+        if commit:
+            with self.conn:
+                self.conn.executemany(sql, args)
+        else:
+            self.conn.executemany(sql, args)
+
+    def load_tx_history(self, seq: int) -> List[Tuple[bytes, bytes, bytes]]:
+        return [(r[0], r[1], r[2]) for r in self.conn.execute(
+            "SELECT txid, txbody, txresult FROM txhistory "
+            "WHERE ledgerseq = ? ORDER BY txindex", (seq,))]
+
+    # ---------------- scp history ----------------
+
+    def store_scp_history(self, seq: int,
+                          envelopes: List[Tuple[bytes, bytes]],
+                          commit: bool = True):
+        sql = ("INSERT INTO scphistory (nodeid, ledgerseq, envelope) "
+               "VALUES (?, ?, ?)")
+        args = [(n, seq, e) for n, e in envelopes]
+        if commit:
+            with self.conn:
+                self.conn.executemany(sql, args)
+        else:
+            self.conn.executemany(sql, args)
+
+
+class PersistentState:
+    """Key/value critical state (reference ``PersistentState.h`` —
+    same row names where they exist there)."""
+
+    LAST_CLOSED_LEDGER = "lastclosedledger"     # header hash, hex
+    HISTORY_ARCHIVE_STATE = "historyarchivestate"
+    LAST_SCP_DATA = "lastscpdata"
+    DATABASE_SCHEMA = "databaseschema"
+    BUCKET_LIST_STATE = "bucketliststate"       # JSON level manifest
+    LEDGER_UPGRADES = "ledgerupgrades"
+
+    def __init__(self, db: Database):
+        self.db = db
+
+    def get(self, key: str) -> Optional[str]:
+        row = self.db.conn.execute(
+            "SELECT state FROM storestate WHERE statename = ?",
+            (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: str, value: str, commit: bool = True):
+        sql = ("INSERT OR REPLACE INTO storestate (statename, state) "
+               "VALUES (?, ?)")
+        if commit:
+            with self.db.conn:
+                self.db.conn.execute(sql, (key, value))
+        else:
+            self.db.conn.execute(sql, (key, value))
+
+
+class NodePersistence:
+    """The LedgerManager's durability hook: saves each close in crash
+    order and restores (header, bucket list, store) at startup."""
+
+    def __init__(self, db: Database, bucket_manager):
+        self.db = db
+        self.state = PersistentState(db)
+        self.buckets = bucket_manager
+
+    # ---------------- save (called at every close) ----------------
+
+    def save_ledger(self, header, header_hash: bytes, bucket_list,
+                    tx_rows: List[Tuple[bytes, bytes, bytes]],
+                    scp_rows: Optional[List[Tuple[bytes, bytes]]] = None):
+        """Persist one closed ledger. Step 1: bucket files on disk.
+        Step 2: one SQL transaction moving the LCL pointer."""
+        from stellar_tpu.xdr.ledger import LedgerHeader
+        from stellar_tpu.xdr.runtime import to_bytes
+        manifest = self.buckets.persist_bucket_list(bucket_list)
+        with self.db.conn:  # single transaction
+            self.db.store_header(
+                header_hash, header.previousLedgerHash, header.ledgerSeq,
+                header.scpValue.closeTime,
+                to_bytes(LedgerHeader, header), commit=False)
+            if tx_rows:
+                self.db.store_tx_history(header.ledgerSeq, tx_rows,
+                                         commit=False)
+            if scp_rows:
+                self.db.store_scp_history(header.ledgerSeq, scp_rows,
+                                          commit=False)
+            self.state.set(PersistentState.BUCKET_LIST_STATE,
+                           json.dumps(manifest), commit=False)
+            self.state.set(PersistentState.LAST_CLOSED_LEDGER,
+                           header_hash.hex(), commit=False)
+
+    # ---------------- restore (startup) ----------------
+
+    def load_last_ledger(self):
+        """(header, header_hash, bucket_list) from disk, or None on a
+        fresh database. Verifies the restored list hashes to the
+        header's bucketListHash."""
+        from stellar_tpu.xdr.ledger import LedgerHeader
+        from stellar_tpu.xdr.runtime import from_bytes
+        lcl_hex = self.state.get(PersistentState.LAST_CLOSED_LEDGER)
+        if lcl_hex is None:
+            return None
+        header_hash = bytes.fromhex(lcl_hex)
+        raw = self.db.load_header_by_hash(header_hash)
+        if raw is None:
+            raise RuntimeError("LCL pointer without header row")
+        header = from_bytes(LedgerHeader, raw)
+        manifest = json.loads(
+            self.state.get(PersistentState.BUCKET_LIST_STATE) or "[]")
+        bucket_list = self.buckets.restore_bucket_list(manifest)
+        if bucket_list.hash() != header.bucketListHash:
+            raise RuntimeError(
+                "restored bucket list does not match LCL header "
+                "(bucket dir corrupt?) — catch up from history instead")
+        return header, header_hash, bucket_list

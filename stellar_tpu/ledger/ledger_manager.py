@@ -82,10 +82,13 @@ class LedgerManager:
     def __init__(self, network_id: bytes,
                  root: Optional[LedgerTxnRoot] = None,
                  state_hasher: Optional[Callable] = None,
-                 bucket_list=None):
+                 bucket_list=None, persistence=None):
         self.network_id = network_id
         self.root = root if root is not None else LedgerTxnRoot()
         self.state_hasher = state_hasher or hash_store_state
+        # durability hook (stellar_tpu.database.NodePersistence): every
+        # close is saved in crash order; None = in-memory node
+        self.persistence = persistence
         # the bucket list is fed every close's entry delta and its
         # 11-level hash becomes header.bucketListHash; pass
         # bucket_list=False to fall back to a flat store hash
@@ -210,9 +213,58 @@ class LedgerManager:
         self.root.set_header(header)
         self._lcl_hash = ledger_header_hash(header)
 
+        if self.persistence is not None:
+            # crash-ordered durable commit: bucket files first, then one
+            # SQL transaction flipping the LCL pointer (reference
+            # LedgerManagerImpl.cpp:1026-1077)
+            from stellar_tpu.xdr.results import TransactionResult
+            from stellar_tpu.xdr.tx import TransactionEnvelope
+            tx_rows = [
+                (f.contents_hash(),
+                 to_bytes(TransactionEnvelope, f.envelope),
+                 to_bytes(TransactionResult, pair.result))
+                for f, pair in zip(apply_order, result_pairs)]
+            self.persistence.save_ledger(header, self._lcl_hash,
+                                         self.bucket_list, tx_rows)
+
         result.header = header
         result.header_hash = self._lcl_hash
         return result
+
+    # ---------------- restart ----------------
+
+    @classmethod
+    def from_persistence(cls, network_id: bytes, persistence
+                         ) -> Optional["LedgerManager"]:
+        """Resume from the durable LCL (reference
+        ``loadLastKnownLedger``): header + bucket list from disk, the
+        committed store rebuilt by replaying buckets oldest -> newest.
+        Returns None when the database is fresh."""
+        restored = persistence.load_last_ledger()
+        if restored is None:
+            return None
+        header, header_hash, bucket_list = restored
+        from stellar_tpu.ledger.ledger_txn import (
+            InMemoryLedgerStore, entry_to_key, key_bytes,
+        )
+        from stellar_tpu.xdr.ledger import BucketEntryType
+        from stellar_tpu.xdr.types import LedgerEntry, LedgerKey
+        store = InMemoryLedgerStore()
+        for lev in reversed(bucket_list.levels):  # oldest level first
+            for bucket in (lev.snap, lev.curr):   # snap older than curr
+                for be in bucket.entries:
+                    if be.arm == BucketEntryType.METAENTRY:
+                        continue
+                    if be.arm == BucketEntryType.DEADENTRY:
+                        store.delete(key_bytes(be.value))
+                    else:
+                        store.put(key_bytes(entry_to_key(be.value)),
+                                  be.value)
+        root = LedgerTxnRoot(store=store, header=header)
+        lm = cls(network_id, root, bucket_list=bucket_list,
+                 persistence=persistence)
+        lm._lcl_hash = header_hash
+        return lm
 
     # ---------------- upgrades ----------------
 

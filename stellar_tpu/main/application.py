@@ -32,10 +32,31 @@ class Application:
         self.clock = clock if clock is not None else \
             VirtualClock(REAL_TIME)
         network_id = config.network_id()
-        self.lm = LedgerManager(network_id, root)
-        hdr = self.lm.last_closed_header
-        hdr.maxTxSetSize = config.MAX_TX_SET_SIZE
-        hdr.ledgerVersion = config.LEDGER_PROTOCOL_VERSION
+        self.database = None
+        self.persistence = None
+        self.lm = None
+        if config.DATABASE:
+            import os
+            from stellar_tpu.bucket.bucket_manager import BucketManager
+            from stellar_tpu.database import Database, NodePersistence
+            self.database = Database(config.DATABASE)
+            bucket_dir = config.BUCKET_DIR_PATH
+            if bucket_dir is None and config.DATABASE != ":memory:":
+                bucket_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(config.DATABASE)),
+                    "buckets")
+            self.persistence = NodePersistence(self.database,
+                                               BucketManager(bucket_dir))
+            # resume from the durable LCL when one exists
+            self.lm = LedgerManager.from_persistence(network_id,
+                                                     self.persistence)
+        fresh = self.lm is None
+        if fresh:
+            self.lm = LedgerManager(network_id, root,
+                                    persistence=self.persistence)
+            hdr = self.lm.last_closed_header
+            hdr.maxTxSetSize = config.MAX_TX_SET_SIZE
+            hdr.ledgerVersion = config.LEDGER_PROTOCOL_VERSION
 
         qset = config.QUORUM_SET
         if qset is None:

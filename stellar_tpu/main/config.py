@@ -36,6 +36,11 @@ class Config:
     MAX_PEER_CONNECTIONS: int = 64
     KNOWN_PEERS: List[str] = field(default_factory=list)
 
+    # persistence (reference DATABASE / BUCKET_DIR_PATH): None keeps the
+    # node fully in-memory (tests); a path makes every close durable
+    DATABASE: Optional[str] = None
+    BUCKET_DIR_PATH: Optional[str] = None
+
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)
 
@@ -65,6 +70,7 @@ class Config:
             "KNOWN_PEERS", "HISTORY_ARCHIVES", "LOG_LEVEL", "HTTP_PORT",
             "RUN_STANDALONE", "MANUAL_CLOSE", "MAX_TX_SET_SIZE",
             "EXPECTED_LEDGER_CLOSE_TIME", "INVARIANT_CHECKS",
+            "DATABASE", "BUCKET_DIR_PATH",
         }
         for key, value in raw.items():
             if key == "NODE_SEED":
