@@ -1,0 +1,146 @@
+"""BucketListDB: live ledger state served from bucket files (reference
+``src/bucket/BucketSnapshotManager.h`` / ``SearchableBucketListSnapshot``
++ the ``LedgerTxnRoot`` BucketListDB backend, ``bucket/readme.md:35-50``).
+
+``BucketListStore`` plugs in behind the same store interface
+``LedgerTxnRoot`` already uses, so the rest of the framework is unaware
+whether state lives in a dict (tests) or in indexed files (real nodes):
+
+* reads: small overlay of not-yet-spilled writes, then newest-first
+  point lookups through per-bucket indexes (``bucket_index.DiskBucket``);
+* writes: accumulate in the overlay; at every ledger close the delta is
+  folded into the bucket list (``add_batch``) and ``rebase`` clears the
+  overlay — the bucket list is then the only copy of the state;
+* iteration (order book, invariants): an in-memory key-set per entry
+  type — keys only, never values — kept incrementally; the reference
+  keeps whole offers in memory for the same reason.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from stellar_tpu.bucket.bucket import EMPTY, Bucket
+from stellar_tpu.bucket.bucket_index import DiskBucket
+from stellar_tpu.xdr.ledger import BucketEntryType
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+from stellar_tpu.xdr.types import LedgerEntry
+
+__all__ = ["SearchableBucketListSnapshot", "BucketListStore"]
+
+BET = BucketEntryType
+
+
+class SearchableBucketListSnapshot:
+    """Newest-first point lookups over a bucket list whose buckets may
+    be disk-backed (reference ``SearchableBucketListSnapshot``)."""
+
+    def __init__(self, buckets: List):
+        self.buckets = buckets  # newest first; Bucket or DiskBucket
+
+    @classmethod
+    def from_bucket_list(cls, bucket_list, bucket_manager=None
+                         ) -> "SearchableBucketListSnapshot":
+        """Prefer file-backed access (index + seek) when the manager has
+        a bucket dir; fall back to the in-memory bucket."""
+        out = []
+        for lev in bucket_list.levels:
+            for b in (lev.curr, lev.snap):
+                if b.is_empty():
+                    continue
+                if bucket_manager is not None and \
+                        bucket_manager.bucket_dir is not None:
+                    bucket_manager.adopt(b)
+                    out.append(DiskBucket(bucket_manager._path_for(b.hash),
+                                          b.hash))
+                else:
+                    out.append(b)
+        return cls(out)
+
+    def load(self, kb: bytes):
+        """Live LedgerEntry or None (dead/absent)."""
+        for b in self.buckets:
+            e = b.get(kb)
+            if e is not None:
+                if e.arm == BET.DEADENTRY:
+                    return None
+                return e.value
+        return None
+
+    def iter_live_entries(self):
+        """(kb, LedgerEntry) for every live entry, newest version wins
+        (full scan; used for key-map builds and integrity checks)."""
+        from stellar_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+        from stellar_tpu.xdr.types import LedgerKey
+        seen: Set[bytes] = set()
+        for b in self.buckets:
+            it = b.iter_entries() if isinstance(b, DiskBucket) \
+                else iter(b.entries)
+            for e in it:
+                if e.arm == BET.METAENTRY:
+                    continue
+                if e.arm == BET.DEADENTRY:
+                    kb = to_bytes(LedgerKey, e.value)
+                    seen.add(kb)
+                    continue
+                kb = key_bytes(entry_to_key(e.value))
+                if kb in seen:
+                    continue
+                seen.add(kb)
+                yield kb, e.value
+
+
+class BucketListStore:
+    """LedgerTxnRoot store backed by the bucket list (the BucketListDB
+    role). Live entries are NOT held in RAM — point reads go through
+    bucket files; only the per-type key sets and the pre-close overlay
+    are resident."""
+
+    is_bucket_backed = True
+
+    def __init__(self, bucket_list, bucket_manager=None):
+        self.bucket_list = bucket_list
+        self.bucket_manager = bucket_manager
+        self._snapshot = SearchableBucketListSnapshot.from_bucket_list(
+            bucket_list, bucket_manager)
+        # kb -> encoded entry (written) | None (deleted) since last rebase
+        self.overlay: Dict[bytes, Optional[bytes]] = {}
+        # entry-type discriminant -> set of kb (keys only)
+        self._keys_by_type: Dict[int, Set[bytes]] = {}
+        for kb, _ in self._snapshot.iter_live_entries():
+            self._type_set(kb).add(kb)
+
+    @staticmethod
+    def _type_of(kb: bytes) -> int:
+        return int.from_bytes(kb[:4], "big")
+
+    def _type_set(self, kb: bytes) -> Set[bytes]:
+        return self._keys_by_type.setdefault(self._type_of(kb), set())
+
+    # ---------------- the store interface ----------------
+
+    def get(self, kb: bytes) -> Optional[LedgerEntry]:
+        if kb in self.overlay:
+            raw = self.overlay[kb]
+            return None if raw is None else from_bytes(LedgerEntry, raw)
+        return self._snapshot.load(kb)
+
+    def put(self, kb: bytes, entry: LedgerEntry):
+        self.overlay[kb] = to_bytes(LedgerEntry, entry)
+        self._type_set(kb).add(kb)
+
+    def delete(self, kb: bytes):
+        self.overlay[kb] = None
+        self._type_set(kb).discard(kb)
+
+    def keys_of_type(self, t) -> List[bytes]:
+        return list(self._keys_by_type.get(t, ()))
+
+    # ---------------- close integration ----------------
+
+    def rebase(self):
+        """Called after ``add_batch`` folded the overlay's changes into
+        the bucket list: refresh the snapshot, drop the overlay."""
+        self.overlay.clear()
+        self._snapshot = SearchableBucketListSnapshot.from_bucket_list(
+            self.bucket_list, self.bucket_manager)

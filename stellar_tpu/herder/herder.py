@@ -77,7 +77,7 @@ class _HerderSCPDriver(SCPDriver):
         return self.herder._validate_value(slot_index, value, nomination)
 
     def extract_valid_value(self, slot_index, value):
-        return None
+        return self.herder._extract_valid_value(slot_index, value)
 
     def combine_candidates(self, slot_index, candidates):
         return self.herder._combine_candidates(slot_index, candidates)
@@ -125,6 +125,8 @@ class Herder:
         from stellar_tpu.xdr.scp import quorum_set_hash
         self.qsets: Dict[bytes, SCPQuorumSet] = {
             quorum_set_hash(qset): qset}
+        from stellar_tpu.herder.upgrades import Upgrades
+        self.upgrades = Upgrades()
         # txset hash -> ApplicableTxSetFrame (PendingEnvelopes role)
         self.tx_sets: Dict[bytes, ApplicableTxSetFrame] = {}
         # envelopes waiting for their txset: txset hash -> [envelope]
@@ -299,6 +301,13 @@ class Herder:
         if nomination and sv.closeTime > \
                 self.clock.system_now() + MAX_TIME_SLIP_SECONDS:
             return ValidationLevel.INVALID
+        # every carried upgrade must be apply-valid (and, at nomination,
+        # exactly what this node scheduled) — reference
+        # validateUpgrades in HerderSCPDriver::validateValueHelper
+        for raw in sv.upgrades:
+            if not self.upgrades.is_valid(raw, lcl, nomination,
+                                          sv.closeTime):
+                return ValidationLevel.INVALID
         if slot_index != lcl.ledgerSeq + 1:
             # can't fully validate against a non-current ledger
             return ValidationLevel.MAYBE_VALID
@@ -310,6 +319,24 @@ class Herder:
             ltx.rollback()
         return ValidationLevel.FULLY_VALIDATED if ok \
             else ValidationLevel.INVALID
+
+    def _extract_valid_value(self, slot_index: int, value: bytes
+                             ) -> Optional[bytes]:
+        """Salvage a nominated value by stripping upgrades this node
+        won't vote for (reference
+        ``HerderSCPDriver::extractValidValue``)."""
+        sv = _parse_stellar_value(value)
+        if sv is None:
+            return None
+        lcl = self.lm.last_closed_header
+        if sv.closeTime <= lcl.scpValue.closeTime:
+            return None
+        kept = [u for u in sv.upgrades
+                if self.upgrades.is_valid(u, lcl, True, sv.closeTime)]
+        if len(kept) == len(sv.upgrades):
+            return value
+        return to_bytes(StellarValue, basic_stellar_value(
+            sv.txSetHash, sv.closeTime, upgrades=kept))
 
     def _combine_candidates(self, slot_index: int,
                             candidates) -> Optional[bytes]:
@@ -396,7 +423,9 @@ class Herder:
         self.broadcast_tx_set(txset)
         close_time = max(self.clock.system_now(),
                          lcl.scpValue.closeTime + 1)
-        sv = basic_stellar_value(txset.hash, close_time)
+        sv = basic_stellar_value(
+            txset.hash, close_time,
+            upgrades=self.upgrades.create_upgrades_for(lcl, close_time))
         prev = to_bytes(StellarValue, lcl.scpValue)
         self.scp.nominate(ledger_seq_to_trigger,
                           to_bytes(StellarValue, sv), prev)
@@ -420,6 +449,7 @@ class Herder:
         result = self.lm.close_ledger(LedgerCloseData(
             ledger_seq=slot_index, tx_set=txset,
             close_time=sv.closeTime, upgrades=list(sv.upgrades)))
+        self.upgrades.remove_upgrades_once_done(result.header)
         self.state = HERDER_STATE.TRACKING
         self.tracking_slot = slot_index + 1
         # queue bookkeeping

@@ -1,0 +1,178 @@
+"""Network upgrades (reference ``src/herder/Upgrades.h`` / ``.cpp``).
+
+A validator *schedules* upgrades (operator-set parameters + activation
+time); at nomination it attaches the scheduled upgrades to its proposed
+StellarValue, and every validator checks proposed upgrades twice:
+
+* apply-validity (``isValidForApply``) — would this upgrade be legal on
+  the current ledger at all (monotonic version, non-zero fee/reserve,
+  protocol-gated arms, masked flags);
+* nomination-validity (``isValidForNomination``) — does it exactly match
+  what this node is scheduled to vote for, and is it time.
+
+Ballot-phase validation uses only apply-validity, so a value carrying an
+upgrade the node didn't schedule can still externalize — upgrades are
+opt-in to *propose* but consensus to *apply*. Unknown/invalid upgrades
+are validate-rejected here so ledger close never has to throw.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from stellar_tpu.protocol import (
+    CURRENT_LEDGER_PROTOCOL_VERSION, SOROBAN_PROTOCOL_VERSION,
+)
+from stellar_tpu.xdr.ledger import LedgerUpgrade, LedgerUpgradeType
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+
+__all__ = ["UpgradeParameters", "Upgrades", "MASK_LEDGER_HEADER_FLAGS",
+           "UpgradeValidity"]
+
+MASK_LEDGER_HEADER_FLAGS = 0x7  # the three DISABLE_LIQUIDITY_POOL_* bits
+
+LUT = LedgerUpgradeType
+
+
+class UpgradeValidity:
+    VALID = 0
+    XDR_INVALID = 1
+    INVALID = 2
+
+
+@dataclass
+class UpgradeParameters:
+    """Operator-scheduled upgrade vote (reference
+    ``Upgrades::UpgradeParameters``)."""
+    upgrade_time: int = 0  # unix time the vote activates
+    protocol_version: Optional[int] = None
+    base_fee: Optional[int] = None
+    max_tx_set_size: Optional[int] = None
+    base_reserve: Optional[int] = None
+    flags: Optional[int] = None
+    max_soroban_tx_set_size: Optional[int] = None
+
+
+class Upgrades:
+    def __init__(self, params: Optional[UpgradeParameters] = None,
+                 max_protocol: int = CURRENT_LEDGER_PROTOCOL_VERSION):
+        self.params = params or UpgradeParameters()
+        self.max_protocol = max_protocol
+
+    # ---------------- validation ----------------
+
+    def is_valid_for_apply(self, raw: bytes, header) -> int:
+        """UpgradeValidity for one opaque upgrade against the current
+        header (reference ``Upgrades::isValidForApply``)."""
+        try:
+            up = from_bytes(LedgerUpgrade, bytes(raw))
+        except Exception:
+            return UpgradeValidity.XDR_INVALID
+        version = header.ledgerVersion
+        t = up.arm
+        if t == LUT.LEDGER_UPGRADE_VERSION:
+            ok = version < up.value <= self.max_protocol
+        elif t == LUT.LEDGER_UPGRADE_BASE_FEE:
+            ok = up.value != 0
+        elif t == LUT.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            ok = True
+        elif t == LUT.LEDGER_UPGRADE_BASE_RESERVE:
+            ok = up.value != 0
+        elif t == LUT.LEDGER_UPGRADE_FLAGS:
+            ok = version >= 18 and \
+                (up.value & ~MASK_LEDGER_HEADER_FLAGS) == 0
+        elif t == LUT.LEDGER_UPGRADE_CONFIG:
+            # needs a ConfigUpgradeSet published in contract data; until
+            # the Soroban config machinery lands, never valid
+            return UpgradeValidity.INVALID
+        elif t == LUT.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
+            ok = version >= SOROBAN_PROTOCOL_VERSION
+        else:
+            ok = False
+        return UpgradeValidity.VALID if ok else UpgradeValidity.INVALID
+
+    def _is_valid_for_nomination(self, up, close_time: int) -> bool:
+        if self.params.upgrade_time > close_time:
+            return False
+        p = self.params
+        t = up.arm
+        if t == LUT.LEDGER_UPGRADE_VERSION:
+            return p.protocol_version is not None and \
+                up.value == p.protocol_version
+        if t == LUT.LEDGER_UPGRADE_BASE_FEE:
+            return p.base_fee is not None and up.value == p.base_fee
+        if t == LUT.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            return p.max_tx_set_size is not None and \
+                up.value == p.max_tx_set_size
+        if t == LUT.LEDGER_UPGRADE_BASE_RESERVE:
+            return p.base_reserve is not None and \
+                up.value == p.base_reserve
+        if t == LUT.LEDGER_UPGRADE_FLAGS:
+            return p.flags is not None and up.value == p.flags
+        if t == LUT.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
+            return p.max_soroban_tx_set_size is not None and \
+                up.value == p.max_soroban_tx_set_size
+        return False
+
+    def is_valid(self, raw: bytes, header, nomination: bool,
+                 close_time: Optional[int] = None) -> bool:
+        if self.is_valid_for_apply(raw, header) != UpgradeValidity.VALID:
+            return False
+        if nomination:
+            up = from_bytes(LedgerUpgrade, bytes(raw))
+            return self._is_valid_for_nomination(
+                up, close_time if close_time is not None
+                else header.scpValue.closeTime)
+        return True
+
+    # ---------------- proposal ----------------
+
+    def create_upgrades_for(self, header, close_time: int) -> List[bytes]:
+        """The opaque upgrades this node votes for at nomination
+        (reference ``Upgrades::createUpgradesFor``)."""
+        if self.params.upgrade_time > close_time:
+            return []
+        p = self.params
+        out = []
+        if p.protocol_version is not None and \
+                header.ledgerVersion != p.protocol_version:
+            out.append(LedgerUpgrade.make(
+                LUT.LEDGER_UPGRADE_VERSION, p.protocol_version))
+        if p.base_fee is not None and header.baseFee != p.base_fee:
+            out.append(LedgerUpgrade.make(
+                LUT.LEDGER_UPGRADE_BASE_FEE, p.base_fee))
+        if p.max_tx_set_size is not None and \
+                header.maxTxSetSize != p.max_tx_set_size:
+            out.append(LedgerUpgrade.make(
+                LUT.LEDGER_UPGRADE_MAX_TX_SET_SIZE, p.max_tx_set_size))
+        if p.base_reserve is not None and \
+                header.baseReserve != p.base_reserve:
+            out.append(LedgerUpgrade.make(
+                LUT.LEDGER_UPGRADE_BASE_RESERVE, p.base_reserve))
+        if p.flags is not None:
+            cur = header.ext.value.flags if header.ext.arm == 1 else 0
+            if cur != p.flags:
+                out.append(LedgerUpgrade.make(
+                    LUT.LEDGER_UPGRADE_FLAGS, p.flags))
+        return [to_bytes(LedgerUpgrade, u) for u in out]
+
+    def remove_upgrades_once_done(self, header):
+        """Clear votes that took effect (reference
+        ``Upgrades::removeUpgrades`` after application)."""
+        p = self.params
+        if p.protocol_version is not None and \
+                header.ledgerVersion >= p.protocol_version:
+            p.protocol_version = None
+        if p.base_fee is not None and header.baseFee == p.base_fee:
+            p.base_fee = None
+        if p.max_tx_set_size is not None and \
+                header.maxTxSetSize == p.max_tx_set_size:
+            p.max_tx_set_size = None
+        if p.base_reserve is not None and \
+                header.baseReserve == p.base_reserve:
+            p.base_reserve = None
+        if p.flags is not None:
+            cur = header.ext.value.flags if header.ext.arm == 1 else 0
+            if cur == p.flags:
+                p.flags = None

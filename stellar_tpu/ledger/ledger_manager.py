@@ -98,7 +98,10 @@ class LedgerManager:
         self.bucket_list = bucket_list or None
         # a pre-seeded store becomes the genesis batch so the bucket
         # list covers ALL state, not just post-construction deltas
-        if self.bucket_list is not None and self.root.store.entries and \
+        # (bucket-backed stores ARE the list; nothing to seed)
+        if self.bucket_list is not None and \
+                not getattr(self.root.store, "is_bucket_backed", False) \
+                and self.root.store.entries and \
                 self.bucket_list.total_entry_count() == 0:
             from stellar_tpu.xdr.runtime import from_bytes as _fb
             from stellar_tpu.xdr.types import LedgerEntry as _LE
@@ -207,6 +210,10 @@ class LedgerManager:
                 lcd.ledger_seq, header.ledgerVersion,
                 init_entries, live_entries, dead_keys)
             header.bucketListHash = self.bucket_list.hash()
+            if hasattr(self.root.store, "rebase"):
+                # BucketListDB store: the delta now lives in the list;
+                # drop the overlay and refresh the read snapshot
+                self.root.store.rebase()
         else:
             header.bucketListHash = self.state_hasher(self.root.store)
         self._calculate_skip_values(header)
@@ -244,22 +251,10 @@ class LedgerManager:
         if restored is None:
             return None
         header, header_hash, bucket_list = restored
-        from stellar_tpu.ledger.ledger_txn import (
-            InMemoryLedgerStore, entry_to_key, key_bytes,
-        )
-        from stellar_tpu.xdr.ledger import BucketEntryType
-        from stellar_tpu.xdr.types import LedgerEntry, LedgerKey
-        store = InMemoryLedgerStore()
-        for lev in reversed(bucket_list.levels):  # oldest level first
-            for bucket in (lev.snap, lev.curr):   # snap older than curr
-                for be in bucket.entries:
-                    if be.arm == BucketEntryType.METAENTRY:
-                        continue
-                    if be.arm == BucketEntryType.DEADENTRY:
-                        store.delete(key_bytes(be.value))
-                    else:
-                        store.put(key_bytes(entry_to_key(be.value)),
-                                  be.value)
+        # live state is served straight from the (disk-backed) bucket
+        # list — the BucketListDB role; no dict of entries is built
+        from stellar_tpu.bucket.bucket_list_db import BucketListStore
+        store = BucketListStore(bucket_list, persistence.buckets)
         root = LedgerTxnRoot(store=store, header=header)
         lm = cls(network_id, root, bucket_list=bucket_list,
                  persistence=persistence)
@@ -283,7 +278,23 @@ class LedgerManager:
                 h.maxTxSetSize = up.value
             elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
                 h.baseReserve = up.value
+            elif t == LedgerUpgradeType.LEDGER_UPGRADE_FLAGS:
+                from stellar_tpu.herder.upgrades import (
+                    MASK_LEDGER_HEADER_FLAGS,
+                )
+                from stellar_tpu.xdr.ledger import LedgerHeaderExtensionV1
+                flags = up.value & MASK_LEDGER_HEADER_FLAGS
+                if h.ext.arm == 1:
+                    h.ext.value.flags = flags
+                else:
+                    h.ext = LedgerHeader._types[-1].make(
+                        1, LedgerHeaderExtensionV1(
+                            flags=flags,
+                            ext=LedgerHeaderExtensionV1._types[1].make(0)))
             else:
+                # CONFIG / MAX_SOROBAN_TX_SET_SIZE need the Soroban
+                # network-config store; validate-rejected at nomination,
+                # and tolerated (skipped) here so close never throws
                 raise NotImplementedError(
                     f"upgrade type {t} not supported yet")
 

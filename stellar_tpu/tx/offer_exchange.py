@@ -508,6 +508,13 @@ def _quote_pool_exchange(ltx, sheep, max_sheep_send, wheat,
     quote (reference computes it in an always-rolled-back child)."""
     if rounding == ROUND_NORMAL or max_offers == 0:
         return None
+    # a FLAGS upgrade can disable pool trading network-wide
+    hdr = ltx.header()
+    if hdr.ext.arm == 1:
+        from stellar_tpu.xdr.ledger import LedgerHeaderFlags
+        if hdr.ext.value.flags & \
+                LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG:
+            return None
     pool_id = _pool_id_for_pair(sheep, wheat)
     from stellar_tpu.tx.asset_utils import liquidity_pool_key
     pe = ltx.load_without_record(liquidity_pool_key(pool_id))

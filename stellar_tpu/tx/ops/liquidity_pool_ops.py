@@ -62,8 +62,24 @@ def _is_bad_price(amount_a, amount_b, min_price, max_price) -> bool:
     return False
 
 
+def header_flags(header) -> int:
+    return header.ext.value.flags if header.ext.arm == 1 else 0
+
+
 class _PoolOpBase(OperationFrame):
     """Shared loading for both pool ops."""
+
+    DISABLE_FLAG = 0
+
+    def apply(self, checker, ltx):
+        # a FLAGS upgrade can switch pool ops off network-wide
+        # (reference isPoolDepositDisabled / isPoolWithdrawalDisabled
+        # gating isOpSupported -> opNOT_SUPPORTED)
+        from stellar_tpu.xdr.results import OperationResultCode
+        if header_flags(ltx.header()) & self.DISABLE_FLAG:
+            return False, self.make_top_result(
+                OperationResultCode.opNOT_SUPPORTED)
+        return super().apply(checker, ltx)
 
     def _load_pool_context(self, ltx, pool_id: bytes, no_trust_result):
         """(fail_result | None, pool_tl_handle, pool_handle)."""
@@ -95,6 +111,9 @@ class _PoolOpBase(OperationFrame):
 @register_op(OperationType.LIQUIDITY_POOL_DEPOSIT)
 class LiquidityPoolDepositOpFrame(_PoolOpBase):
     """Reference ``LiquidityPoolDepositOpFrame.cpp``."""
+
+    from stellar_tpu.xdr.ledger import LedgerHeaderFlags as _LHF
+    DISABLE_FLAG = _LHF.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG
 
     def do_check_valid(self, ledger_version: int):
         b = self.body
@@ -221,6 +240,9 @@ class LiquidityPoolDepositOpFrame(_PoolOpBase):
 @register_op(OperationType.LIQUIDITY_POOL_WITHDRAW)
 class LiquidityPoolWithdrawOpFrame(_PoolOpBase):
     """Reference ``LiquidityPoolWithdrawOpFrame.cpp``."""
+
+    from stellar_tpu.xdr.ledger import LedgerHeaderFlags as _LHF
+    DISABLE_FLAG = _LHF.DISABLE_LIQUIDITY_POOL_WITHDRAWAL_FLAG
 
     def do_check_valid(self, ledger_version: int):
         b = self.body

@@ -1,0 +1,159 @@
+"""Network-upgrade tests (reference ``src/herder/test/UpgradesTests.cpp``
+scenarios): validity rules, nomination gating, consensus application of
+scheduled upgrades, FLAGS disabling pool operations."""
+
+import pytest
+
+from stellar_tpu.herder.upgrades import (
+    MASK_LEDGER_HEADER_FLAGS, UpgradeParameters, UpgradeValidity, Upgrades,
+)
+from stellar_tpu.ledger.ledger_manager import LedgerManager
+from stellar_tpu.simulation.simulation import Topologies
+from stellar_tpu.tx.tx_test_utils import keypair, seed_root_with_accounts
+from stellar_tpu.xdr.ledger import (
+    LedgerHeaderFlags, LedgerUpgrade, LedgerUpgradeType as LUT,
+)
+from stellar_tpu.xdr.runtime import to_bytes
+
+XLM = 10_000_000
+
+
+def up(t, v):
+    return to_bytes(LedgerUpgrade, LedgerUpgrade.make(t, v))
+
+
+@pytest.fixture
+def header():
+    from stellar_tpu.ledger.ledger_txn import _genesis_header
+    h = _genesis_header()
+    h.ledgerVersion = 22
+    return h
+
+
+def test_apply_validity_rules(header):
+    u = Upgrades(max_protocol=22)
+    V = UpgradeValidity.VALID
+    I = UpgradeValidity.INVALID
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_VERSION, 23),
+                                header) == I  # above max
+    header.ledgerVersion = 21
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_VERSION, 22),
+                                header) == V
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_VERSION, 21),
+                                header) == I  # not monotonic
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_BASE_FEE, 0),
+                                header) == I
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_BASE_FEE, 200),
+                                header) == V
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_BASE_RESERVE, 0),
+                                header) == I
+    assert u.is_valid_for_apply(
+        up(LUT.LEDGER_UPGRADE_FLAGS, MASK_LEDGER_HEADER_FLAGS),
+        header) == V
+    assert u.is_valid_for_apply(up(LUT.LEDGER_UPGRADE_FLAGS, 0xFF),
+                                header) == I  # unknown bits
+    assert u.is_valid_for_apply(b"\x00\x00\x00\x63", header) == \
+        UpgradeValidity.XDR_INVALID
+
+
+def test_nomination_gating(header):
+    u = Upgrades(UpgradeParameters(upgrade_time=0, base_fee=200))
+    raw = up(LUT.LEDGER_UPGRADE_BASE_FEE, 200)
+    assert u.is_valid(raw, header, nomination=True, close_time=100)
+    # different value than scheduled -> rejected at nomination,
+    # still fine for ballot/apply
+    other = up(LUT.LEDGER_UPGRADE_BASE_FEE, 300)
+    assert not u.is_valid(other, header, nomination=True, close_time=100)
+    assert u.is_valid(other, header, nomination=False)
+    # not yet time
+    late = Upgrades(UpgradeParameters(upgrade_time=10**9, base_fee=200))
+    assert not late.is_valid(raw, header, nomination=True, close_time=100)
+
+
+def test_create_and_clear_votes(header):
+    u = Upgrades(UpgradeParameters(
+        upgrade_time=0, base_fee=777,
+        flags=LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG))
+    ups = u.create_upgrades_for(header, close_time=50)
+    assert len(ups) == 2
+    header.baseFee = 777
+    from stellar_tpu.xdr.ledger import LedgerHeaderExtensionV1
+    from stellar_tpu.xdr.ledger import LedgerHeader
+    header.ext = LedgerHeader._types[-1].make(1, LedgerHeaderExtensionV1(
+        flags=LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG,
+        ext=LedgerHeaderExtensionV1._types[1].make(0)))
+    u.remove_upgrades_once_done(header)
+    assert u.create_upgrades_for(header, close_time=50) == []
+
+
+def test_upgrade_through_consensus():
+    """One validator schedules baseFee + FLAGS upgrades; the network
+    externalizes and applies them on every node."""
+    sim = Topologies.core4(accounts=[(keypair("up-rich"), 1000 * XLM)])
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    flags = LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG
+    for app in apps:  # all validators vote the same schedule
+        app.herder.upgrades.params = UpgradeParameters(
+            upgrade_time=0, base_fee=250, flags=flags)
+    target = apps[0].lm.ledger_seq + 3
+    assert sim.crank_until_ledger(target, timeout=300)
+    assert sim.in_consensus()
+    for app in apps:
+        h = app.lm.last_closed_header
+        assert h.baseFee == 250
+        assert h.ext.arm == 1 and h.ext.value.flags == flags
+        # votes cleared once applied
+        assert app.herder.upgrades.params.base_fee is None
+
+
+def test_flags_disable_pool_deposit(tmp_path):
+    """With DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG set, deposits fail with
+    opNOT_SUPPORTED."""
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_tpu.tx.tx_test_utils import make_tx
+    from stellar_tpu.xdr.ledger import (
+        LedgerHeader, LedgerHeaderExtensionV1,
+    )
+    from stellar_tpu.xdr.results import (
+        OperationResultCode, TransactionResultCode as TC,
+    )
+    from tests.test_liquidity_pools import (
+        change_trust_op, deposit_op, pool_share_line,
+    )
+    from stellar_tpu.tx.asset_utils import (
+        change_trust_asset_to_trustline_asset,
+    )
+    from stellar_tpu.xdr.types import NATIVE_ASSET, account_id, \
+        asset_alphanum4
+    a, issuer = keypair("fl-a"), keypair("fl-iss")
+    root = seed_root_with_accounts([(a, 100_000 * XLM),
+                                    (issuer, 100_000 * XLM)])
+    hdr = root.header()
+    hdr.ext = LedgerHeader._types[-1].make(1, LedgerHeaderExtensionV1(
+        flags=LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG,
+        ext=LedgerHeaderExtensionV1._types[1].make(0)))
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    line = pool_share_line(NATIVE_ASSET, usd)
+    pool_id = change_trust_asset_to_trustline_asset(line).value
+
+    def apply_tx(tx):
+        with LedgerTxn(root) as ltx:
+            tx.process_fee_seq_num(ltx, base_fee=100)
+            res = tx.apply(ltx)
+            ltx.commit()
+        return res
+
+    seq = (1 << 32) + 1
+    assert apply_tx(make_tx(a, seq, [
+        change_trust_op(
+            __import__("stellar_tpu.xdr.tx", fromlist=["ChangeTrustAsset"])
+            .ChangeTrustAsset.make(usd.arm, usd.value), 10**15),
+        change_trust_op(line, 10**15),
+    ])).code == TC.txSUCCESS
+    res = apply_tx(make_tx(a, seq + 1, [deposit_op(pool_id, XLM, XLM)]))
+    assert res.code == TC.txFAILED
+    assert res.op_results[0].arm == OperationResultCode.opNOT_SUPPORTED
