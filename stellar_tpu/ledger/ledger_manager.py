@@ -161,8 +161,8 @@ class LedgerManager:
         for f in apply_order:
             from stellar_tpu.tx.transaction_frame import TxApplyMeta
             meta = TxApplyMeta()
-            res = f.apply(ltx, meta)
-            res.fee_charged = fee_results[id(f)].fee_charged
+            res = f.apply(ltx, meta)  # fee_charged carried from fee phase
+            # (and already net of any Soroban refund)
             xdr_res = f.to_result_xdr(res) if hasattr(f, "to_result_xdr") \
                 else res.to_xdr()
             result_pairs.append(TransactionResultPair(

@@ -70,6 +70,13 @@ def entry_to_key(entry: LedgerEntry):
         body = LedgerKeyClaimableBalance(balanceID=v.balanceID)
     elif t == LedgerEntryType.LIQUIDITY_POOL:
         body = LedgerKeyLiquidityPool(liquidityPoolID=v.liquidityPoolID)
+    elif t == LedgerEntryType.CONTRACT_DATA:
+        from stellar_tpu.xdr.contract import LedgerKeyContractData
+        body = LedgerKeyContractData(contract=v.contract, key=v.key,
+                                     durability=v.durability)
+    elif t == LedgerEntryType.CONTRACT_CODE:
+        from stellar_tpu.xdr.contract import LedgerKeyContractCode
+        body = LedgerKeyContractCode(hash=v.hash)
     elif t == LedgerEntryType.TTL:
         body = LedgerKeyTtl(keyHash=v.keyHash)
     else:

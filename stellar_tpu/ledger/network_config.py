@@ -1,0 +1,96 @@
+"""Soroban network configuration (reference ``src/ledger/NetworkConfig.h``
+``InitialSorobanNetworkConfig`` values + the resource-fee formulas from
+``src/rust/src/lib.rs`` ``compute_transaction_resource_fee``).
+
+In the reference these live in CONFIG_SETTING ledger entries mutated by
+LEDGER_UPGRADE_CONFIG; here they are a plain object on the
+LedgerManager, upgradeable once the config-upgrade machinery lands —
+the *consumers* (fees, limits, TTLs) are what matter for parity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["SorobanNetworkConfig", "compute_resource_fee",
+           "compute_rent_fee"]
+
+DATA_SIZE_1KB_INCREMENT = 1024
+INSTRUCTIONS_INCREMENT = 10_000
+
+
+@dataclass
+class SorobanNetworkConfig:
+    """Initial settings (reference NetworkConfig.h:60-141)."""
+    # contract size / data limits
+    max_contract_size: int = 65_536
+    max_contract_data_key_size: int = 300
+    max_contract_data_entry_size: int = 65_536
+    # compute
+    tx_max_instructions: int = 2_500_000
+    ledger_max_instructions: int = 2_500_000
+    fee_rate_per_instructions_increment: int = 100
+    tx_memory_limit: int = 40 * 1024 * 1024
+    # ledger access
+    tx_max_read_ledger_entries: int = 3
+    tx_max_read_bytes: int = 3_200
+    tx_max_write_ledger_entries: int = 2
+    tx_max_write_bytes: int = 3_200
+    fee_read_ledger_entry: int = 5_000
+    fee_write_ledger_entry: int = 20_000
+    fee_read_1kb: int = 1_000
+    fee_write_1kb: int = 4_000
+    # historical + bandwidth
+    fee_historical_1kb: int = 100
+    tx_max_size_bytes: int = 10_000
+    fee_tx_size_1kb: int = 2_000
+    # events
+    tx_max_contract_events_size_bytes: int = 200
+    fee_contract_events_1kb: int = 200
+    # state archival
+    max_entry_ttl: int = 1_054_080
+    min_persistent_ttl: int = 4_096
+    min_temporary_ttl: int = 16
+    persistent_rent_rate_denominator: int = 252_480
+    temp_rent_rate_denominator: int = 2_524_800
+    # per-ledger caps
+    ledger_max_tx_count: int = 1
+
+
+def _kb_ceil_mul(fee_per_kb: int, size_bytes: int) -> int:
+    """ceil(size/1KB) * fee, computed as the reference's
+    ``compute_fee_per_increment`` (round up to the increment)."""
+    return -(-size_bytes * fee_per_kb // DATA_SIZE_1KB_INCREMENT)
+
+
+def compute_resource_fee(cfg: SorobanNetworkConfig, instructions: int,
+                         read_entries: int, write_entries: int,
+                         read_bytes: int, write_bytes: int,
+                         tx_size_bytes: int,
+                         events_size_bytes: int = 0) -> tuple:
+    """(non_refundable, refundable_events) fee split (reference
+    lib.rs:232-246 -> soroban host ``compute_transaction_resource_fee``:
+    compute + ledger access + historical + bandwidth are non-refundable;
+    events (and rent, computed separately) are refundable)."""
+    compute = -(-instructions * cfg.fee_rate_per_instructions_increment
+                // INSTRUCTIONS_INCREMENT)
+    ledger_access = (
+        (read_entries + write_entries) * cfg.fee_read_ledger_entry +
+        write_entries * cfg.fee_write_ledger_entry +
+        _kb_ceil_mul(cfg.fee_read_1kb, read_bytes) +
+        _kb_ceil_mul(cfg.fee_write_1kb, write_bytes))
+    historical = _kb_ceil_mul(cfg.fee_historical_1kb, tx_size_bytes)
+    bandwidth = _kb_ceil_mul(cfg.fee_tx_size_1kb, tx_size_bytes)
+    events = _kb_ceil_mul(cfg.fee_contract_events_1kb, events_size_bytes)
+    return compute + ledger_access + historical + bandwidth, events
+
+
+def compute_rent_fee(cfg: SorobanNetworkConfig, entry_size: int,
+                     ttl_extension: int, persistent: bool) -> int:
+    """Rent for extending one entry's lifetime (reference
+    ``compute_rent_fee``'s per-entry term: size * write_fee * extension /
+    rate_denominator)."""
+    denom = cfg.persistent_rent_rate_denominator if persistent \
+        else cfg.temp_rent_rate_denominator
+    wfee = _kb_ceil_mul(cfg.fee_write_1kb, entry_size)
+    return max(0, -(-wfee * ttl_extension // denom))

@@ -1,0 +1,3 @@
+from stellar_tpu.soroban.host import (  # noqa: F401
+    HostError, InvokeOutput, invoke_host_function,
+)

@@ -8,5 +8,6 @@ from stellar_tpu.tx.ops import liquidity_pool_ops  # noqa: F401
 from stellar_tpu.tx.ops import misc  # noqa: F401
 from stellar_tpu.tx.ops import offers  # noqa: F401
 from stellar_tpu.tx.ops import payment  # noqa: F401
+from stellar_tpu.tx.ops import soroban_ops  # noqa: F401
 from stellar_tpu.tx.ops import sponsorship_ops  # noqa: F401
 from stellar_tpu.tx.ops import trust_ops  # noqa: F401

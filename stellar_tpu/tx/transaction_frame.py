@@ -37,6 +37,7 @@ from stellar_tpu.xdr.results import (
     OperationResult, TransactionResult, TransactionResultCode as TxCode,
     tx_result,
 )
+from stellar_tpu.xdr.runtime import to_bytes
 from stellar_tpu.xdr.tx import (
     DecoratedSignature, FeeBumpTransaction, MAX_OPS_PER_TX,
     Preconditions, PreconditionType, Transaction, TransactionEnvelope,
@@ -138,6 +139,17 @@ class TransactionFrame:
         if self.is_soroban():
             return self.full_fee() - self.declared_soroban_resource_fee()
         return self.full_fee()
+
+    def size_bytes(self) -> int:
+        """Envelope wire size (feeds bandwidth/historical resource
+        fees)."""
+        return len(to_bytes(TransactionEnvelope, self.envelope))
+
+    def note_soroban_consumption(self, refundable_consumed: int, events):
+        """Called by the Soroban op frame after the host ran: how much
+        of the refundable fee (rent + events) was actually used."""
+        self._soroban_refundable_consumed = refundable_consumed
+        self._soroban_events = events
 
     def is_soroban(self) -> bool:
         return self.tx.ext.arm == 1
@@ -430,6 +442,7 @@ class TransactionFrame:
                     header.feePool += charged
                 src.deactivate()
             inner.commit()
+        self._fee_charged = result.fee_charged
         return result
 
     def process_seq_num(self, ltx):
@@ -507,7 +520,10 @@ class TransactionFrame:
         if meta is None:
             meta = TxApplyMeta()
         checker = self.make_signature_checker(ltx.header().ledgerVersion)
-        result = MutableTxResult(fee_charged=0)
+        # the fee phase (process_fee_seq_num) already ran; carry what it
+        # actually charged so refunds can be computed against it
+        result = MutableTxResult(
+            fee_charged=getattr(self, "_fee_charged", 0))
         # op results pre-seeded as successes so op signature failures can
         # be recorded positionally
         result.op_results = [op.make_result(0) for op in self.op_frames]
@@ -525,9 +541,52 @@ class TransactionFrame:
         if not ok:
             if result.code == TxCode.txSUCCESS:
                 result.set_code(TxCode.txFAILED)
+            self._process_soroban_refund(ltx, result)
             return result
 
-        return self._apply_operations(checker, ltx, meta, result)
+        result = self._apply_operations(checker, ltx, meta, result)
+        self._process_soroban_refund(ltx, result)
+        return result
+
+    def soroban_refund_amount(self, success: bool) -> int:
+        """Unused refundable resource fee: declared - non-refundable -
+        consumed(rent + events); consumption only counts on success."""
+        if not self.is_soroban():
+            return 0
+        from stellar_tpu.ledger.network_config import compute_resource_fee
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        cfg = default_soroban_config()
+        res = self.tx.ext.value.resources
+        fp = res.footprint
+        non_ref, _ = compute_resource_fee(
+            cfg, res.instructions, len(fp.readOnly), len(fp.readWrite),
+            res.readBytes, res.writeBytes, self.size_bytes())
+        consumed = getattr(self, "_soroban_refundable_consumed", 0) \
+            if success else 0
+        return max(0, self.declared_soroban_resource_fee() - non_ref -
+                   consumed)
+
+    def _process_soroban_refund(self, ltx, result: MutableTxResult,
+                                refund_to=None):
+        """Return the unused refundable portion of the resource fee to
+        the fee source (reference ``processRefund``)."""
+        refund = min(self.soroban_refund_amount(result.is_success),
+                     result.fee_charged)  # only what was charged
+        if refund <= 0:
+            return
+        with LedgerTxn(ltx) as scope:
+            src = scope.load(account_key(
+                refund_to if refund_to is not None
+                else self.source_account_id()))
+            if src is not None:
+                src.data.balance += refund
+                src.deactivate()
+                with scope.load_header() as hh:
+                    hh.header.feePool -= refund
+                result.fee_charged -= refund
+                scope.commit()
+            else:
+                scope.rollback()
 
     def _apply_operations(self, checker, ltx, meta: TxApplyMeta,
                           result: MutableTxResult) -> MutableTxResult:
@@ -714,6 +773,7 @@ class FeeBumpTransactionFrame:
                     header.feePool += charged
                 src.deactivate()
             inner.commit()
+        self._fee_charged = result.fee_charged
         return result
 
     def apply(self, ltx, meta: Optional[TxApplyMeta] = None
@@ -742,11 +802,28 @@ class FeeBumpTransactionFrame:
         fee_txn.commit()
 
         inner_res = self.inner.apply(ltx, meta, charge_fee=False)
-        result = MutableTxResult(fee_charged=0)
+        result = MutableTxResult(
+            fee_charged=getattr(self, "_fee_charged", 0))
         result.set_code(TxCode.txFEE_BUMP_INNER_SUCCESS
                         if inner_res.is_success
                         else TxCode.txFEE_BUMP_INNER_FAILED)
         result.inner_result = inner_res
+        # a Soroban inner tx refunds unused resource fee to the OUTER
+        # fee source, which paid it (reference FeeBump processRefund)
+        refund = min(self.inner.soroban_refund_amount(inner_res.is_success),
+                     result.fee_charged)
+        if refund > 0:
+            with LedgerTxn(ltx) as scope:
+                src = scope.load(account_key(self.fee_source_id()))
+                if src is not None:
+                    src.data.balance += refund
+                    src.deactivate()
+                    with scope.load_header() as hh:
+                        hh.header.feePool -= refund
+                    result.fee_charged -= refund
+                    scope.commit()
+                else:
+                    scope.rollback()
         return result
 
     def to_result_xdr(self, result: MutableTxResult) -> TransactionResult:

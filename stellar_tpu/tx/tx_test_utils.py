@@ -29,7 +29,8 @@ def keypair(name: str) -> SecretKey:
 def make_tx(source: SecretKey, seq_num: int, ops: Sequence[Operation],
             fee: Optional[int] = None, cond=None, memo=None,
             network_id: bytes = TEST_NETWORK_ID,
-            extra_signers: Sequence[SecretKey] = ()) -> TransactionFrame:
+            extra_signers: Sequence[SecretKey] = (),
+            soroban_data=None) -> TransactionFrame:
     """Build + sign a v1 envelope and wrap it in a frame."""
     tx = Transaction(
         sourceAccount=muxed_account(source.public_key.raw),
@@ -39,7 +40,8 @@ def make_tx(source: SecretKey, seq_num: int, ops: Sequence[Operation],
             PreconditionType.PRECOND_NONE),
         memo=memo if memo is not None else MEMO_NONE,
         operations=list(ops),
-        ext=Transaction._types[6].make(0))
+        ext=Transaction._types[6].make(0) if soroban_data is None
+        else Transaction._types[6].make(1, soroban_data))
     payload = transaction_sig_payload(network_id, tx)
     from stellar_tpu.crypto.sha import sha256
     h = sha256(payload)

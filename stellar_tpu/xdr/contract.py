@@ -416,6 +416,40 @@ class ContractCodeEntry(Struct):
 # preimages used for contract-id derivation and soroban auth signing
 
 
+class LedgerKeyContractData(Struct):
+    FIELDS = [("contract", SCAddress),
+              ("key", SCVal),
+              ("durability", ContractDataDurability)]
+
+
+class LedgerKeyContractCode(Struct):
+    FIELDS = [("hash", Hash)]
+
+
+# ---------------- contract events ----------------
+
+ContractEventType = Enum("ContractEventType", {
+    "SYSTEM": 0, "CONTRACT": 1, "DIAGNOSTIC": 2,
+})
+
+
+class ContractEventV0(Struct):
+    FIELDS = [("topics", VarArray(SCVal)), ("data", SCVal)]
+
+
+class ContractEvent(Struct):
+    FIELDS = [("ext", ExtensionPoint),
+              ("contractID", Option(Hash)),
+              ("type", ContractEventType),
+              ("body", Union("ContractEvent.body", Int32,
+                             {0: ContractEventV0}))]
+
+
+class InvokeHostFunctionSuccessPreImage(Struct):
+    FIELDS = [("returnValue", SCVal),
+              ("events", VarArray(ContractEvent))]
+
+
 class HashIDPreimageContractID(Struct):
     FIELDS = [("networkID", Hash),
               ("contractIDPreimage", ContractIDPreimage)]

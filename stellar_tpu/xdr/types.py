@@ -410,6 +410,37 @@ class LedgerEntryExtensionV1(Struct):
               ("ext", Union("LEEV1.ext", Int32, {0: Void}))]
 
 
+class _LazyArm:
+    """Defer an arm's payload type to break the types<->contract import
+    cycle (ContractDataEntry/ContractCodeEntry live in xdr.contract,
+    which imports this module)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._t = None
+
+    def _real(self):
+        if self._t is None:
+            self._t = self._loader()
+        return self._t
+
+    def pack(self, p, v):
+        self._real().pack(p, v)
+
+    def unpack(self, u):
+        return self._real().unpack(u)
+
+
+def _contract_data_entry():
+    from stellar_tpu.xdr.contract import ContractDataEntry
+    return ContractDataEntry
+
+
+def _contract_code_entry():
+    from stellar_tpu.xdr.contract import ContractCodeEntry
+    return ContractCodeEntry
+
+
 LedgerEntryData = Union("LedgerEntry.data", LedgerEntryType, {
     LedgerEntryType.ACCOUNT: AccountEntry,
     LedgerEntryType.TRUSTLINE: TrustLineEntry,
@@ -417,6 +448,8 @@ LedgerEntryData = Union("LedgerEntry.data", LedgerEntryType, {
     LedgerEntryType.DATA: DataEntry,
     LedgerEntryType.CLAIMABLE_BALANCE: ClaimableBalanceEntry,
     LedgerEntryType.LIQUIDITY_POOL: LiquidityPoolEntry,
+    LedgerEntryType.CONTRACT_DATA: _LazyArm(_contract_data_entry),
+    LedgerEntryType.CONTRACT_CODE: _LazyArm(_contract_code_entry),
     LedgerEntryType.TTL: TTLEntry,
 })
 
@@ -458,6 +491,16 @@ class LedgerKeyTtl(Struct):
     FIELDS = [("keyHash", Hash)]
 
 
+def _contract_data_key():
+    from stellar_tpu.xdr.contract import LedgerKeyContractData
+    return LedgerKeyContractData
+
+
+def _contract_code_key():
+    from stellar_tpu.xdr.contract import LedgerKeyContractCode
+    return LedgerKeyContractCode
+
+
 LedgerKey = Union("LedgerKey", LedgerEntryType, {
     LedgerEntryType.ACCOUNT: LedgerKeyAccount,
     LedgerEntryType.TRUSTLINE: LedgerKeyTrustLine,
@@ -465,6 +508,8 @@ LedgerKey = Union("LedgerKey", LedgerEntryType, {
     LedgerEntryType.DATA: LedgerKeyData,
     LedgerEntryType.CLAIMABLE_BALANCE: LedgerKeyClaimableBalance,
     LedgerEntryType.LIQUIDITY_POOL: LedgerKeyLiquidityPool,
+    LedgerEntryType.CONTRACT_DATA: _LazyArm(_contract_data_key),
+    LedgerEntryType.CONTRACT_CODE: _LazyArm(_contract_code_key),
     LedgerEntryType.TTL: LedgerKeyTtl,
 })
 

@@ -1,0 +1,43 @@
+"""End-to-end: a Soroban contract-upload transaction floods the
+4-validator network, reaches consensus, and the contract code + TTL
+entries exist identically on every node."""
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.ledger.ledger_txn import key_bytes
+from stellar_tpu.simulation.simulation import Topologies
+from stellar_tpu.soroban.host import contract_code_key, ttl_key_for
+from stellar_tpu.tx.tx_test_utils import keypair, make_tx
+
+from tests.test_soroban import COUNTER_CODE, soroban_data, soroban_op
+
+XLM = 10_000_000
+
+
+def test_soroban_upload_through_consensus():
+    from stellar_tpu.xdr.contract import HostFunction, HostFunctionType
+    a = keypair("sor-e2e")
+    sim = Topologies.core4(accounts=[(a, 100_000 * XLM)])
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    network_id = apps[0].config.network_id()
+    code_hash = sha256(COUNTER_CODE)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+        COUNTER_CODE)
+    sd = soroban_data(read_write=[contract_code_key(code_hash)])
+    tx = make_tx(a, (1 << 32) + 1, [soroban_op(fn)], fee=6_000_000,
+                 soroban_data=sd, network_id=network_id)
+    st = apps[0].herder.recv_transaction(tx)
+    assert st.code == 0
+    assert sim.crank_until_ledger(apps[0].lm.ledger_seq + 3, timeout=300)
+    assert sim.in_consensus()
+    ck = key_bytes(contract_code_key(code_hash))
+    tk = key_bytes(ttl_key_for(contract_code_key(code_hash)))
+    for app in apps:
+        code_entry = app.lm.root.store.get(ck)
+        assert code_entry is not None
+        assert code_entry.data.value.code == COUNTER_CODE
+        assert app.lm.root.store.get(tk) is not None

@@ -1,0 +1,372 @@
+"""Soroban slice tests (reference ``transactions/test/InvokeHost
+FunctionTests.cpp`` scenarios): upload -> create -> invoke through the
+real transaction pipeline, footprint enforcement, metering traps, auth
+entries with real ed25519 signatures, TTL extend/restore, and the
+refundable-fee refund."""
+
+import pytest
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.soroban.host import (
+    assemble_program, contract_code_key, contract_data_key,
+    derive_contract_id, ins, scaddress_account, scaddress_contract, sym,
+    ttl_key_for, u32,
+)
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+from stellar_tpu.tx.tx_test_utils import (
+    TEST_NETWORK_ID, keypair, make_tx, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.contract import (
+    ContractDataDurability, ContractIDPreimage, ContractIDPreimageFromAddress,
+    ContractIDPreimageType, CreateContractArgs, ContractExecutable,
+    ContractExecutableType, HostFunction, HostFunctionType,
+    InvokeContractArgs, SCVal, SCValType,
+)
+from stellar_tpu.xdr.results import (
+    InvokeHostFunctionResultCode as Inv, TransactionResultCode as TC,
+)
+from stellar_tpu.xdr.tx import (
+    InvokeHostFunctionOp, LedgerFootprint, Operation, OperationBody,
+    OperationType, SorobanResources, SorobanTransactionData,
+)
+from stellar_tpu.xdr.types import ExtensionPoint, account_id
+
+XLM = 10_000_000
+T = SCValType
+
+COUNTER_KEY = sym("count")
+
+# the counter contract: incr() bumps a persistent counter and returns it
+COUNTER_CODE = assemble_program({
+    "incr": [
+        ins("push", COUNTER_KEY), ins("has", sym("persistent")),
+        ins("jz", u32(3)),
+        ins("push", COUNTER_KEY), ins("get", sym("persistent")),
+        ins("jmp", u32(1)),
+        ins("push", u32(0)),
+        ins("push", u32(1)), ins("add"),
+        ins("dup"),
+        ins("push", COUNTER_KEY), ins("swap"),
+        ins("put", sym("persistent")),
+        ins("ret"),
+    ],
+    "auth_incr": [
+        ins("arg", u32(0)), ins("require_auth"),
+        ins("push", COUNTER_KEY), ins("has", sym("persistent")),
+        ins("jz", u32(3)),
+        ins("push", COUNTER_KEY), ins("get", sym("persistent")),
+        ins("jmp", u32(1)),
+        ins("push", u32(0)),
+        ins("push", u32(1)), ins("add"),
+        ins("push", COUNTER_KEY), ins("swap"),
+        ins("put", sym("persistent")),
+        ins("ret"),
+    ],
+    "boom": [ins("fail")],
+    "spin": [ins("jmp", SCVal.make(T.SCV_I32, -1))],
+})
+
+CODE_HASH = sha256(COUNTER_CODE)
+
+
+def soroban_op(host_fn, auth=()):
+    return Operation(
+        sourceAccount=None,
+        body=OperationBody.make(
+            OperationType.INVOKE_HOST_FUNCTION,
+            InvokeHostFunctionOp(hostFunction=host_fn, auth=list(auth))))
+
+
+def soroban_data(read_only=(), read_write=(), instructions=2_000_000,
+                 read_bytes=3_000, write_bytes=3_000,
+                 resource_fee=5_000_000):
+    return SorobanTransactionData(
+        ext=ExtensionPoint.make(0),
+        resources=SorobanResources(
+            footprint=LedgerFootprint(readOnly=list(read_only),
+                                      readWrite=list(read_write)),
+            instructions=instructions, readBytes=read_bytes,
+            writeBytes=write_bytes),
+        resourceFee=resource_fee)
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def inner_code(res, i=0):
+    return res.op_results[i].value.value.arm
+
+
+def seq_for(root, kp, off=1):
+    e = root.store.get(key_bytes(account_key(
+        account_id(kp.public_key.raw))))
+    return e.data.value.seqNum + off
+
+
+@pytest.fixture
+def env():
+    a = keypair("sor-a")
+    root = seed_root_with_accounts([(a, 100_000 * XLM)])
+    return root, a
+
+
+def upload_tx(root, a, code=COUNTER_CODE):
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM, code)
+    sd = soroban_data(read_write=[contract_code_key(sha256(code))])
+    return make_tx(a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+                   soroban_data=sd)
+
+
+def preimage_for(a, salt=b"\x01" * 32):
+    return ContractIDPreimage.make(
+        ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+        ContractIDPreimageFromAddress(
+            address=scaddress_account(account_id(a.public_key.raw)),
+            salt=salt))
+
+
+def create_tx(root, a):
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+        CreateContractArgs(
+            contractIDPreimage=preimage_for(a),
+            executable=ContractExecutable.make(
+                ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                CODE_HASH)))
+    contract_id = derive_contract_id(TEST_NETWORK_ID, preimage_for(a))
+    addr = scaddress_contract(contract_id)
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    sd = soroban_data(read_only=[contract_code_key(CODE_HASH)],
+                      read_write=[inst_key])
+    return make_tx(a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+                   soroban_data=sd), contract_id
+
+
+def invoke_tx(root, a, contract_id, fn_name, args=(), auth=(),
+              extra_rw=(), resource_fee=5_000_000):
+    addr = scaddress_contract(contract_id)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr,
+                           functionName=fn_name.encode(),
+                           args=list(args)))
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    counter_key = contract_data_key(addr, COUNTER_KEY,
+                                    ContractDataDurability.PERSISTENT)
+    sd = soroban_data(
+        read_only=[inst_key, contract_code_key(CODE_HASH)],
+        read_write=[counter_key] + list(extra_rw),
+        resource_fee=resource_fee)
+    return make_tx(a, seq_for(root, a), [soroban_op(fn, auth)],
+                   fee=resource_fee + 1000, soroban_data=sd)
+
+
+def counter_value(root, contract_id):
+    addr = scaddress_contract(contract_id)
+    ck = contract_data_key(addr, COUNTER_KEY,
+                           ContractDataDurability.PERSISTENT)
+    e = root.store.get(key_bytes(ck))
+    return None if e is None else e.data.value.val.value
+
+
+def test_upload_create_invoke(env):
+    root, a = env
+    assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+    # code entry + its TTL exist
+    ck = contract_code_key(CODE_HASH)
+    assert root.store.get(key_bytes(ck)) is not None
+    assert root.store.get(key_bytes(ttl_key_for(ck))) is not None
+
+    tx, contract_id = create_tx(root, a)
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+
+    res = apply_tx(root, invoke_tx(root, a, contract_id, "incr"))
+    assert res.code == TC.txSUCCESS
+    assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_SUCCESS
+    assert counter_value(root, contract_id) == 1
+    res = apply_tx(root, invoke_tx(root, a, contract_id, "incr"))
+    assert res.code == TC.txSUCCESS
+    assert counter_value(root, contract_id) == 2
+
+
+def test_trap_and_metering(env):
+    root, a = env
+    assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+    tx, contract_id = create_tx(root, a)
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+
+    res = apply_tx(root, invoke_tx(root, a, contract_id, "boom"))
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_TRAPPED
+    # infinite loop hits the instruction budget, not the wall clock
+    res = apply_tx(root, invoke_tx(root, a, contract_id, "spin"))
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == \
+        Inv.INVOKE_HOST_FUNCTION_RESOURCE_LIMIT_EXCEEDED
+    assert counter_value(root, contract_id) is None
+
+
+def test_footprint_enforced(env):
+    root, a = env
+    assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+    tx, contract_id = create_tx(root, a)
+    assert apply_tx(root, tx).code == TC.txSUCCESS
+    # drop the counter key from readWrite: the put must trap
+    addr = scaddress_contract(contract_id)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr,
+                           functionName=b"incr", args=[]))
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    sd = soroban_data(read_only=[inst_key,
+                                 contract_code_key(CODE_HASH)])
+    tx = make_tx(a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+                 soroban_data=sd)
+    res = apply_tx(root, tx)
+    assert res.code == TC.txFAILED
+    assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_TRAPPED
+
+
+def test_refund_of_unused_refundable_fee(env):
+    root, a = env
+    before = root.store.get(key_bytes(account_key(
+        account_id(a.public_key.raw)))).data.value.balance
+    res = apply_tx(root, upload_tx(root, a))
+    assert res.code == TC.txSUCCESS
+    after = root.store.get(key_bytes(account_key(
+        account_id(a.public_key.raw)))).data.value.balance
+    # charged = inclusion + non-refundable + consumed rent, far below
+    # the declared 5M resource fee; the rest came back
+    charged = before - after
+    assert charged == res.fee_charged
+    assert charged < 1_000_000
+    # fee pool balances exactly what was kept
+    assert root.header().feePool == charged
+
+
+def test_auth_entry_with_real_signature(env):
+    """auth_incr(require_auth(B)) invoked by A with B's signed auth
+    entry — the BASELINE #5 signature surface."""
+    from stellar_tpu.soroban.host import auth_payload_hash
+    from stellar_tpu.xdr.contract import (
+        SCNonceKey, SorobanAddressCredentials, SorobanAuthorizationEntry,
+        SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
+        SorobanAuthorizedInvocation, SorobanCredentials,
+        SorobanCredentialsType, SCMapEntry,
+    )
+    root, a = env
+    b = keypair("sor-b")
+    cfg = default_soroban_config()
+    old = (cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries)
+    cfg.tx_max_read_ledger_entries = 10
+    cfg.tx_max_write_ledger_entries = 8
+    try:
+        assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+        tx, contract_id = create_tx(root, a)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+
+        addr_b = scaddress_account(account_id(b.public_key.raw))
+        invocation = SorobanAuthorizedInvocation(
+            function=SorobanAuthorizedFunction.make(
+                SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                InvokeContractArgs(
+                    contractAddress=scaddress_contract(contract_id),
+                    functionName=b"auth_incr",
+                    args=[SCVal.make(T.SCV_ADDRESS, addr_b)])),
+            subInvocations=[])
+        nonce, expiry = 7, 10_000
+        payload = auth_payload_hash(TEST_NETWORK_ID, nonce, expiry,
+                                    invocation)
+        sig = b.sign(payload)
+        sig_val = SCVal.make(T.SCV_VEC, [SCVal.make(T.SCV_MAP, [
+            SCMapEntry(key=sym("public_key"),
+                       val=SCVal.make(T.SCV_BYTES, b.public_key.raw)),
+            SCMapEntry(key=sym("signature"),
+                       val=SCVal.make(T.SCV_BYTES, sig)),
+        ])])
+        auth = SorobanAuthorizationEntry(
+            credentials=SorobanCredentials.make(
+                SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+                SorobanAddressCredentials(
+                    address=addr_b, nonce=nonce,
+                    signatureExpirationLedger=expiry,
+                    signature=sig_val)),
+            rootInvocation=invocation)
+        nonce_key = contract_data_key(
+            addr_b,
+            SCVal.make(T.SCV_LEDGER_KEY_NONCE, SCNonceKey(nonce=nonce)),
+            ContractDataDurability.TEMPORARY)
+        tx = invoke_tx(root, a, contract_id, "auth_incr",
+                       args=[SCVal.make(T.SCV_ADDRESS, addr_b)],
+                       auth=[auth], extra_rw=[nonce_key])
+        res = apply_tx(root, tx)
+        assert res.code == TC.txSUCCESS
+        assert counter_value(root, contract_id) == 1
+        # nonce entry recorded -> replay rejected
+        assert root.store.get(key_bytes(nonce_key)) is not None
+        tx = invoke_tx(root, a, contract_id, "auth_incr",
+                       args=[SCVal.make(T.SCV_ADDRESS, addr_b)],
+                       auth=[auth], extra_rw=[nonce_key])
+        res = apply_tx(root, tx)
+        assert res.code == TC.txFAILED
+        assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_TRAPPED
+
+        # missing auth entirely: trap
+        tx = invoke_tx(root, a, contract_id, "auth_incr",
+                       args=[SCVal.make(T.SCV_ADDRESS, addr_b)])
+        res = apply_tx(root, tx)
+        assert res.code == TC.txFAILED
+    finally:
+        cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries = old
+
+
+def test_extend_and_restore_ttl(env):
+    from stellar_tpu.xdr.tx import ExtendFootprintTTLOp, RestoreFootprintOp
+    root, a = env
+    assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+    ck = contract_code_key(CODE_HASH)
+    ttl0 = root.store.get(key_bytes(ttl_key_for(ck))) \
+        .data.value.liveUntilLedgerSeq
+
+    ext_op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.EXTEND_FOOTPRINT_TTL,
+        ExtendFootprintTTLOp(ext=ExtensionPoint.make(0),
+                             extendTo=50_000)))
+    sd = soroban_data(read_only=[ck])
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [ext_op],
+                                 fee=6_000_000, soroban_data=sd))
+    assert res.code == TC.txSUCCESS
+    ttl1 = root.store.get(key_bytes(ttl_key_for(ck))) \
+        .data.value.liveUntilLedgerSeq
+    assert ttl1 > ttl0
+
+    # archive it artificially, then restore
+    e = root.store.get(key_bytes(ttl_key_for(ck)))
+    e.data.value.liveUntilLedgerSeq = 1
+    root.store.put(key_bytes(ttl_key_for(ck)), e)
+    res_op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.RESTORE_FOOTPRINT,
+        RestoreFootprintOp(ext=ExtensionPoint.make(0))))
+    sd = soroban_data(read_write=[ck])
+    res = apply_tx(root, make_tx(a, seq_for(root, a), [res_op],
+                                 fee=6_000_000, soroban_data=sd))
+    assert res.code == TC.txSUCCESS
+    cfg = default_soroban_config()
+    ttl2 = root.store.get(key_bytes(ttl_key_for(ck))) \
+        .data.value.liveUntilLedgerSeq
+    assert ttl2 >= cfg.min_persistent_ttl
