@@ -59,6 +59,15 @@ class OverlayManager:
         self.peers: List = []  # authenticated peers
         self.pending_peers: List = []
         self.floodgate = Floodgate()
+        from stellar_tpu.overlay.peer_manager import BanManager, PeerManager
+        from stellar_tpu.overlay.tx_adverts import (
+            TxAdverts, TxDemandsManager,
+        )
+        db = getattr(app, "database", None)
+        self.peer_manager = PeerManager(db)
+        self.ban_manager = BanManager(db)
+        self.tx_adverts = TxAdverts()
+        self.tx_demands = TxDemandsManager()
         self._wire_herder()
 
     # ---------------- herder wiring ----------------
@@ -87,9 +96,13 @@ class OverlayManager:
             self.peers.remove(peer)
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
+        self.tx_adverts.forget_peer(peer)
 
     def authenticated_count(self) -> int:
         return len(self.peers)
+
+    def _peers_by_id(self) -> Dict[int, object]:
+        return {id(p): p for p in self.peers}
 
     # ---------------- broadcast (herder -> network) ----------------
 
@@ -109,9 +122,16 @@ class OverlayManager:
         self._flood(StellarMessage.make(MessageType.GENERALIZED_TX_SET,
                                         txset_frame.xdr))
 
-    def broadcast_transaction(self, frame):
-        self._flood(StellarMessage.make(MessageType.TRANSACTION,
-                                        frame.envelope))
+    def broadcast_transaction(self, frame, from_peer=None):
+        """Pull-mode tx relay (reference TxAdverts): flood the HASH;
+        peers demand the body if they don't have it."""
+        tx_hash = frame.contents_hash()
+        skip = {id(from_peer)} if from_peer is not None else set()
+        for p in list(self.peers):
+            if id(p) in skip:
+                continue
+            self.tx_adverts.queue_advert(p, tx_hash)
+        self.tx_adverts.flush(self._peers_by_id())
 
     # ---------------- fetch (anycast) ----------------
 
@@ -145,10 +165,41 @@ class OverlayManager:
                                                    msg.value)
                 except Exception:
                     return
+                self.tx_demands.fulfilled(frame.contents_hash())
                 from stellar_tpu.herder.transaction_queue import AddResult
                 res = herder.tx_queue.try_add(frame)
                 if res.code == AddResult.ADD_STATUS_PENDING:
-                    self._flood(msg, from_peer=peer)
+                    # propagate by advert, not by pushing the body
+                    self.broadcast_transaction(frame, from_peer=peer)
+        elif t == MessageType.FLOOD_ADVERT:
+            hashes = list(msg.value.txHashes)
+            self.tx_adverts.note_incoming(peer, hashes)
+            demand = []
+            for h in hashes:
+                if h in herder.tx_queue.known_hashes or \
+                        herder.tx_queue.is_banned(h):
+                    continue
+                if self.tx_demands.start_demand(h, peer):
+                    demand.append(h)
+            if demand:
+                from stellar_tpu.xdr.overlay import FloodDemand
+                peer.send(StellarMessage.make(
+                    MessageType.FLOOD_DEMAND,
+                    FloodDemand(txHashes=demand)))
+        elif t == MessageType.FLOOD_DEMAND:
+            for h in msg.value.txHashes:
+                frame = herder.tx_queue.known_hashes.get(h)
+                if frame is not None:
+                    peer.send(StellarMessage.make(
+                        MessageType.TRANSACTION, frame.envelope))
+        elif t == MessageType.PEERS:
+            for addr in msg.value:
+                try:
+                    host = ".".join(str(b) for b in addr.ip.value) \
+                        if addr.ip.arm == 0 else addr.ip.value.hex()
+                    self.peer_manager.ensure_exists(host, addr.port)
+                except Exception:
+                    continue
         elif t == MessageType.SCP_MESSAGE:
             raw_hash = sha256(to_bytes(StellarMessage, msg))
             if self.floodgate.add_record(raw_hash, peer,
@@ -190,3 +241,16 @@ class OverlayManager:
 
     def ledger_closed(self, ledger_seq: int):
         self.floodgate.clear_below(ledger_seq)
+        peers = self._peers_by_id()
+        self.tx_adverts.flush(peers, force=True)
+        self.tx_demands.age_and_retry(self.tx_adverts, peers)
+
+    # ---------------- operator surface ----------------
+
+    def ban_peer(self, node_id: bytes):
+        """Ban + drop any live connection from that node (reference
+        CommandHandler 'ban' + BanManager)."""
+        self.ban_manager.ban(node_id)
+        for p in list(self.peers) + list(self.pending_peers):
+            if getattr(p, "remote_node_id", None) == node_id:
+                p.drop("banned")

@@ -37,6 +37,8 @@ OVERLAY_VERSION = 38
 # reference FlowControl defaults
 PEER_FLOOD_READING_CAPACITY = 200
 FLOW_CONTROL_SEND_MORE_BATCH = 40
+PEER_FLOOD_READING_CAPACITY_BYTES = 300_000
+FLOW_CONTROL_SEND_MORE_BATCH_BYTES = 100_000
 
 
 class PeerAuth:
@@ -95,29 +97,42 @@ class PeerAuth:
 
 
 class FlowControl:
-    """Message-credit flow control (reference ``FlowControl.h:27-104``)."""
+    """Message + byte credit flow control (reference
+    ``FlowControl.h:27-104``: SEND_MORE_EXTENDED carries both axes;
+    a flood message may only go out while the sender holds credits on
+    BOTH)."""
 
-    def __init__(self, capacity: int = PEER_FLOOD_READING_CAPACITY):
-        self.outbound_credits = 0       # what the remote granted us
-        self.to_grant = 0               # what we owe the remote
+    def __init__(self, capacity: int = PEER_FLOOD_READING_CAPACITY,
+                 capacity_bytes: int = PEER_FLOOD_READING_CAPACITY_BYTES):
+        self.outbound_credits = 0        # what the remote granted us
+        self.outbound_bytes = 0
+        self.to_grant = 0                # what we owe the remote
+        self.to_grant_bytes = 0
         self.capacity = capacity
+        self.capacity_bytes = capacity_bytes
 
-    def can_send(self) -> bool:
-        return self.outbound_credits > 0
+    def can_send(self, size: int) -> bool:
+        return self.outbound_credits > 0 and self.outbound_bytes >= size
 
-    def note_sent(self):
+    def note_sent(self, size: int):
         self.outbound_credits -= 1
+        self.outbound_bytes -= size
 
-    def note_received(self) -> Optional[int]:
-        """Returns a credit batch to grant when the threshold hits."""
+    def note_received(self, size: int) -> Optional[tuple]:
+        """(messages, bytes) batch to grant back once either threshold
+        hits (reference getFlowControlExtended batching)."""
         self.to_grant += 1
-        if self.to_grant >= FLOW_CONTROL_SEND_MORE_BATCH:
-            grant, self.to_grant = self.to_grant, 0
+        self.to_grant_bytes += size
+        if self.to_grant >= FLOW_CONTROL_SEND_MORE_BATCH or \
+                self.to_grant_bytes >= FLOW_CONTROL_SEND_MORE_BATCH_BYTES:
+            grant = (self.to_grant, self.to_grant_bytes)
+            self.to_grant = self.to_grant_bytes = 0
             return grant
         return None
 
-    def receive_credits(self, n: int):
+    def receive_credits(self, n: int, n_bytes: int):
         self.outbound_credits += n
+        self.outbound_bytes += n_bytes
 
 
 class PEER_STATE:
@@ -200,9 +215,10 @@ class Peer:
             mac=HmacSha256Mac(mac=mac)))
         if self.send_key is not None and msg.arm != MessageType.HELLO:
             self.send_seq += 1
+        raw = to_bytes(AuthenticatedMessage, am)
         if msg.arm in FLOOD_TYPES and self.state == PEER_STATE.GOT_AUTH:
-            self.flow.note_sent()
-        self.send_bytes(to_bytes(AuthenticatedMessage, am))
+            self.flow.note_sent(len(raw))
+        self.send_bytes(raw)
 
     def _recv_authenticated(self, am: AuthenticatedMessageV0):
         msg = am.message
@@ -231,18 +247,20 @@ class Peer:
         if self.state != PEER_STATE.GOT_AUTH:
             return self.drop("message before AUTH")
         if t == MessageType.SEND_MORE:
-            self.flow.receive_credits(msg.value.numMessages)
+            self.flow.receive_credits(msg.value.numMessages, 0x7FFFFFFF)
             return
         if t == MessageType.SEND_MORE_EXTENDED:
-            self.flow.receive_credits(msg.value.numMessages)
+            self.flow.receive_credits(msg.value.numMessages,
+                                      msg.value.numBytes)
             return
         if t in FLOOD_TYPES:
-            grant = self.flow.note_received()
+            grant = self.flow.note_received(
+                len(to_bytes(StellarMessage, msg)) + 44)  # + frame header
             if grant:
                 self._send_message(StellarMessage.make(
                     MessageType.SEND_MORE_EXTENDED,
-                    SendMoreExtended(numMessages=grant,
-                                     numBytes=grant * 0x10000)))
+                    SendMoreExtended(numMessages=grant[0],
+                                     numBytes=grant[1])))
         self.app.overlay.recv_message(self, msg)
 
     def _recv_hello(self, hello: Hello):
@@ -254,6 +272,12 @@ class Peer:
         remote_id = hello.peerID.value
         if remote_id == self.app.herder.scp.local_node_id:
             return self.drop("connected to self")
+        ban_mgr = getattr(self.app.overlay, "ban_manager", None)
+        if ban_mgr is not None and ban_mgr.is_banned(remote_id):
+            self._send_message(StellarMessage.make(
+                MessageType.ERROR_MSG,
+                ErrorMsg(code=ErrorCode.ERR_AUTH, msg=b"banned")))
+            return self.drop("banned peer")
         if not self.app.peer_auth.verify_remote_cert(
                 hello.cert, remote_id, now):
             self._send_message(StellarMessage.make(
@@ -277,9 +301,9 @@ class Peer:
         # initial flood credits for the remote
         self._send_message(StellarMessage.make(
             MessageType.SEND_MORE_EXTENDED,
-            SendMoreExtended(numMessages=PEER_FLOOD_READING_CAPACITY,
-                             numBytes=PEER_FLOOD_READING_CAPACITY
-                             * 0x10000)))
+            SendMoreExtended(
+                numMessages=PEER_FLOOD_READING_CAPACITY,
+                numBytes=PEER_FLOOD_READING_CAPACITY_BYTES)))
         self.app.overlay.peer_authenticated(self)
 
     # ---------------- outbound API ----------------
@@ -288,7 +312,8 @@ class Peer:
         """Queue-or-send respecting flow control for flood traffic."""
         if self.state != PEER_STATE.GOT_AUTH:
             return
-        if msg.arm in FLOOD_TYPES and not self.flow.can_send():
+        if msg.arm in FLOOD_TYPES and not self.flow.can_send(
+                len(to_bytes(StellarMessage, msg)) + 44):
             return  # dropped under backpressure (reference load shedding)
         self._send_message(msg)
 

@@ -1,0 +1,112 @@
+"""Pull-mode transaction flooding (reference ``src/overlay/TxAdverts.h``
+/ ``.cpp`` + ``TxDemandsManager.cpp``).
+
+Instead of pushing full transactions to every peer, a node floods
+FLOOD_ADVERT messages carrying tx *hashes*; peers that don't know a
+hash send FLOOD_DEMAND back to ONE advertiser at a time, which answers
+with the TRANSACTION message. This turns O(peers) tx bandwidth into
+O(peers) hash bandwidth + O(1) tx transfers, and is why byte-level flow
+control matters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from stellar_tpu.xdr.overlay import (
+    FloodAdvert, FloodDemand, MAX_TX_ADVERT_VECTOR, MessageType,
+    StellarMessage,
+)
+
+__all__ = ["TxAdverts", "TxDemandsManager"]
+
+ADVERT_FLUSH_SIZE = 50          # reference batches up to ~max/2
+DEMAND_RETRY_LEDGERS = 1        # re-demand from another peer next close
+MAX_RETAINED_ADVERTS = 10_000
+
+
+class TxAdverts:
+    """Per-peer outgoing advert queue + incoming advert memory
+    (reference ``TxAdverts``)."""
+
+    def __init__(self):
+        # id(peer) -> [hashes to advertise]
+        self.outgoing: Dict[int, List[bytes]] = {}
+        # id(peer) -> set of hashes that peer advertised to us
+        self.incoming: Dict[int, set] = {}
+
+    def queue_advert(self, peer, tx_hash: bytes):
+        self.outgoing.setdefault(id(peer), []).append(tx_hash)
+
+    def flush(self, peers_by_id: Dict[int, object],
+              force: bool = False):
+        """Send queued adverts; small queues flush immediately at sim
+        scale (the reference flushes on a timer or when half-full)."""
+        for pid, hashes in list(self.outgoing.items()):
+            if not hashes:
+                continue
+            if not force and len(hashes) < 1:
+                continue
+            peer = peers_by_id.get(pid)
+            if peer is None:
+                del self.outgoing[pid]
+                continue
+            batch, self.outgoing[pid] = \
+                hashes[:MAX_TX_ADVERT_VECTOR], hashes[MAX_TX_ADVERT_VECTOR:]
+            peer.send(StellarMessage.make(
+                MessageType.FLOOD_ADVERT, FloodAdvert(txHashes=batch)))
+
+    def note_incoming(self, peer, hashes: List[bytes]):
+        s = self.incoming.setdefault(id(peer), set())
+        s.update(hashes)
+        if len(s) > MAX_RETAINED_ADVERTS:
+            self.incoming[id(peer)] = set(list(s)[-MAX_RETAINED_ADVERTS:])
+
+    def advertisers_of(self, tx_hash: bytes) -> List[int]:
+        return [pid for pid, s in self.incoming.items() if tx_hash in s]
+
+    def forget_peer(self, peer):
+        self.outgoing.pop(id(peer), None)
+        self.incoming.pop(id(peer), None)
+
+
+class TxDemandsManager:
+    """Outstanding demands with rotation across advertisers (reference
+    ``TxDemandsManager``)."""
+
+    def __init__(self):
+        # tx hash -> (id(peer) demanded from, asked set, age)
+        self.pending: Dict[bytes, list] = {}
+
+    def start_demand(self, tx_hash: bytes, peer) -> bool:
+        """True if a demand should be sent to this peer now."""
+        rec = self.pending.get(tx_hash)
+        if rec is not None:
+            return False  # already demanded from someone
+        self.pending[tx_hash] = [id(peer), {id(peer)}, 0]
+        return True
+
+    def fulfilled(self, tx_hash: bytes):
+        self.pending.pop(tx_hash, None)
+
+    def age_and_retry(self, adverts: TxAdverts,
+                      peers_by_id: Dict[int, object]) -> int:
+        """Called at ledger close: rotate stuck demands to another
+        advertiser; returns number of retries sent."""
+        retries = 0
+        for h, rec in list(self.pending.items()):
+            rec[2] += 1
+            if rec[2] < DEMAND_RETRY_LEDGERS:
+                continue
+            candidates = [pid for pid in adverts.advertisers_of(h)
+                          if pid not in rec[1] and pid in peers_by_id]
+            if not candidates:
+                del self.pending[h]  # nobody left to ask
+                continue
+            pid = candidates[0]
+            rec[0], rec[2] = pid, 0
+            rec[1].add(pid)
+            peers_by_id[pid].send(StellarMessage.make(
+                MessageType.FLOOD_DEMAND, FloodDemand(txHashes=[h])))
+            retries += 1
+        return retries

@@ -1,0 +1,166 @@
+"""Overlay service tests (reference ``overlay/test/TxAdvertsTests``,
+``FlowControlTests``, ``PeerManagerTests``, ``BanManagerTests``
+behaviors): pull-mode tx relay with demand dedup + rotation, byte-credit
+backpressure, the peer address book, and bans."""
+
+import pytest
+
+from stellar_tpu.overlay.peer import (
+    FLOW_CONTROL_SEND_MORE_BATCH_BYTES, FlowControl,
+    PEER_FLOOD_READING_CAPACITY, PEER_FLOOD_READING_CAPACITY_BYTES,
+)
+from stellar_tpu.overlay.peer_manager import (
+    BanManager, PeerManager, PeerType,
+)
+from stellar_tpu.simulation.simulation import Simulation, Topologies
+from stellar_tpu.tx.tx_test_utils import keypair, make_tx, payment_op
+from stellar_tpu.xdr.overlay import MessageType
+
+XLM = 10_000_000
+
+
+def make_core(n, accounts=None):
+    sim = Topologies.core(n, accounts=accounts)
+    sim.start_all_nodes()
+    return sim
+
+
+def test_pull_mode_relay_uses_adverts_and_demands():
+    """The tx body travels once per hop via demand, not pushed to all."""
+    a, b = keypair("pm-a"), keypair("pm-b")
+    sim = make_core(4, accounts=[(a, 1000 * XLM), (b, 1000 * XLM)])
+    apps = list(sim.nodes.values())
+    sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    # count message types crossing each peer by wrapping send
+    counts = {MessageType.FLOOD_ADVERT: 0, MessageType.FLOOD_DEMAND: 0,
+              MessageType.TRANSACTION: 0}
+    for app in apps:
+        for p in app.overlay.peers:
+            orig = p.send
+
+            def counted(msg, _orig=orig):
+                if msg.arm in counts:
+                    counts[msg.arm] += 1
+                return _orig(msg)
+            p.send = counted
+    network_id = apps[0].config.network_id()
+    tx = make_tx(a, (1 << 32) + 1, [payment_op(b, 5 * XLM)],
+                 network_id=network_id)
+    apps[0].herder.recv_transaction(tx)
+    sim.crank_until(
+        lambda: all(tx.contents_hash() in x.herder.tx_queue.known_hashes
+                    for x in apps), 60)
+    for app in apps:
+        assert tx.contents_hash() in app.herder.tx_queue.known_hashes
+    assert counts[MessageType.FLOOD_ADVERT] >= 3
+    assert counts[MessageType.FLOOD_DEMAND] >= 3
+    # each node receives the body exactly once: 3 transfers for 4 nodes
+    assert counts[MessageType.TRANSACTION] == 3
+
+
+def test_demand_dedup_single_advertiser():
+    from stellar_tpu.overlay.tx_adverts import TxAdverts, TxDemandsManager
+    adverts = TxAdverts()
+    demands = TxDemandsManager()
+
+    class P:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, msg):
+            self.sent.append(msg)
+    p1, p2 = P(), P()
+    h = b"\x11" * 32
+    adverts.note_incoming(p1, [h])
+    adverts.note_incoming(p2, [h])
+    assert demands.start_demand(h, p1) is True
+    # second advertiser does NOT get a parallel demand
+    assert demands.start_demand(h, p2) is False
+    # unfulfilled after a ledger: rotates to the other advertiser
+    peers = {id(p1): p1, id(p2): p2}
+    assert demands.age_and_retry(adverts, peers) == 1
+    assert len(p2.sent) == 1 and \
+        p2.sent[0].arm == MessageType.FLOOD_DEMAND
+
+
+def test_flow_control_byte_credits():
+    fc = FlowControl()
+    fc.receive_credits(10, 1000)
+    assert fc.can_send(400)
+    fc.note_sent(400)
+    fc.note_sent(500)
+    assert fc.outbound_bytes == 100
+    assert not fc.can_send(200)  # byte credits exhausted first
+    assert fc.outbound_credits == 8
+    fc.receive_credits(0, 500)
+    assert fc.can_send(200)
+    # receiving side batches grants on the byte axis too
+    got = None
+    for _ in range(10):
+        got = fc.note_received(FLOW_CONTROL_SEND_MORE_BATCH_BYTES // 2)
+        if got:
+            break
+    assert got is not None and got[0] == 2
+
+
+def test_banned_peer_rejected_and_dropped():
+    sim = Topologies.core(3)
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 2 for x in apps),
+        30)
+    bad = apps[1]
+    # node 0 bans node 1: live connection drops immediately
+    apps[0].overlay.ban_peer(bad.node_id)
+    assert all(p.remote_node_id != bad.node_id
+               for p in apps[0].overlay.peers)
+    # reconnection attempts are refused at HELLO
+    from stellar_tpu.overlay.loopback import connect_loopback
+    connect_loopback(apps[0], bad)
+    sim.crank_all_nodes(20)
+    assert all(p.remote_node_id != bad.node_id
+               for p in apps[0].overlay.peers)
+    # unban heals
+    apps[0].overlay.ban_manager.unban(bad.node_id)
+    connect_loopback(apps[0], bad)
+    sim.crank_until(
+        lambda: any(p.remote_node_id == bad.node_id
+                    for p in apps[0].overlay.peers), 15)
+    assert any(p.remote_node_id == bad.node_id
+               for p in apps[0].overlay.peers)
+
+
+def test_peer_manager_backoff_and_random_source(tmp_path):
+    from stellar_tpu.database import Database
+    db = Database(str(tmp_path / "peers.db"))
+    pm = PeerManager(db)
+    pm.ensure_exists("10.0.0.1", 11625)
+    pm.ensure_exists("10.0.0.2", 11625, peer_type=PeerType.PREFERRED)
+    pm.on_connection_failure("10.0.0.1", 11625, now=100)
+    rec = pm.records["10.0.0.1:11625"]
+    assert rec.num_failures == 1 and rec.next_attempt > 100
+    # backed-off peer excluded until its window passes
+    got = pm.random_peers(5, now=100)
+    assert [r.key for r in got] == ["10.0.0.2:11625"]
+    got = pm.random_peers(5, now=10_000)
+    assert {r.key for r in got} == {"10.0.0.1:11625", "10.0.0.2:11625"}
+    assert got[0].peer_type == PeerType.PREFERRED  # preferred first
+    # persisted across restart
+    pm2 = PeerManager(Database(str(tmp_path / "peers.db")))
+    assert pm2.records["10.0.0.1:11625"].num_failures == 1
+
+
+def test_ban_manager_persists(tmp_path):
+    from stellar_tpu.database import Database
+    db = Database(str(tmp_path / "ban.db"))
+    bm = BanManager(db)
+    nid = b"\x42" * 32
+    bm.ban(nid)
+    assert bm.is_banned(nid)
+    bm2 = BanManager(Database(str(tmp_path / "ban.db")))
+    assert bm2.is_banned(nid)
+    bm2.unban(nid)
+    assert not bm2.is_banned(nid)
