@@ -119,6 +119,40 @@ def cmd_self_check(args) -> int:
     return 0
 
 
+def cmd_check_quorum_intersection(args) -> int:
+    """Offline safety analysis (reference ``check-quorum-intersection``,
+    ``CommandLine.cpp``): JSON file {node strkey: {"THRESHOLD": n,
+    "VALIDATORS": [strkey...], "INNER_SETS": [...]}} -> enjoys/split."""
+    from stellar_tpu.crypto import strkey
+    from stellar_tpu.herder.quorum_intersection import (
+        QuorumIntersectionChecker,
+    )
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    def parse_qset(d):
+        return SCPQuorumSet(
+            threshold=d["THRESHOLD"],
+            validators=[make_node_id(strkey.decode_account(v))
+                        for v in d.get("VALIDATORS", [])],
+            innerSets=[parse_qset(i) for i in d.get("INNER_SETS", [])])
+
+    with open(args.file) as f:
+        raw = json.load(f)
+    qmap = {strkey.decode_account(k): parse_qset(v)
+            for k, v in raw.items()}
+    qic = QuorumIntersectionChecker(qmap)
+    ok = qic.network_enjoys_quorum_intersection()
+    out = {"node_count": len(qmap),
+           "quorum_found": qic.quorum_found,
+           "enjoys_quorum_intersection": ok}
+    if not ok:
+        out["split"] = [[strkey.encode_account(n) for n in side]
+                        for side in qic.last_split]
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def cmd_apply_load(args) -> int:
     """Synthetic-queue close-ledger benchmark (reference ``apply-load``,
     ``CommandLine.cpp:1770-1860``)."""
@@ -145,6 +179,9 @@ def main(argv=None) -> int:
     sp.add_argument("--filetype", default="TransactionEnvelope")
     sp.set_defaults(fn=cmd_print_xdr)
     sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
+    sp = sub.add_parser("check-quorum-intersection")
+    sp.add_argument("file", help="JSON quorum map")
+    sp.set_defaults(fn=cmd_check_quorum_intersection)
     sp = sub.add_parser("apply-load")
     sp.add_argument("--ledgers", type=int, default=10)
     sp.add_argument("--txs", type=int, default=100)
