@@ -130,7 +130,9 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
     # replay buckets oldest -> newest into the committed store
     # (reference BucketApplicator order)
     lm.root.store.entries.clear()
+    from stellar_tpu.invariant import get_active_manager
     from stellar_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    mgr = get_active_manager()
     for lev in reversed(bl.levels):
         for bucket in (lev.snap, lev.curr):
             for e in bucket.entries:
@@ -143,6 +145,10 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
                 else:
                     lm.root.store.put(
                         key_bytes(entry_to_key(e.value)), e.value)
+            if mgr is not None and not bucket.is_empty():
+                # post-condition per applied bucket (reference
+                # checkOnBucketApply during ApplyBucketsWork)
+                mgr.check_on_bucket_apply(bucket, lm.root.store)
 
     lm.bucket_list = bl
     lm.root.set_header(target_header_entry.header)

@@ -1,0 +1,193 @@
+"""Tests for the four later invariants (reference
+``invariant/test/{LiabilitiesMatchOffers,OrderBookIsNotCrossed,
+ConstantProduct,BucketListIsConsistentWithDatabase}Tests.cpp``
+behaviors) plus full-suite runs over real op workloads."""
+
+import pytest
+
+from stellar_tpu.invariant import (
+    InvariantDoesNotHold, InvariantManager, set_active_manager,
+)
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.op_frame import account_key
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.results import TransactionResultCode as TC
+from stellar_tpu.xdr.types import account_id
+
+XLM = 10_000_000
+
+
+@pytest.fixture
+def full_invariants():
+    mgr = InvariantManager([".*"])
+    set_active_manager(mgr)
+    yield mgr
+    set_active_manager(None)
+
+
+def apply_tx(root, tx):
+    with LedgerTxn(root) as ltx:
+        tx.process_fee_seq_num(ltx, base_fee=100)
+        res = tx.apply(ltx)
+        ltx.commit()
+    return res
+
+
+def seq_for(root, kp, off=1):
+    e = root.store.get(key_bytes(account_key(
+        account_id(kp.public_key.raw))))
+    return e.data.value.seqNum + off
+
+
+def test_all_eight_invariants_registered(full_invariants):
+    names = {i.name for i in full_invariants.invariants}
+    assert names == {
+        "ConservationOfLumens", "LedgerEntryIsValid",
+        "AccountSubEntriesCountIsValid", "SponsorshipCountIsValid",
+        "LiabilitiesMatchOffers", "OrderBookIsNotCrossed",
+        "ConstantProductInvariant",
+        "BucketListIsConsistentWithDatabase"}
+
+
+def test_offer_workload_passes_all_invariants(full_invariants):
+    """Real offer crossings keep liabilities + order book consistent."""
+    from tests.test_liquidity_pools import op
+    from stellar_tpu.xdr.tx import (
+        ChangeTrustAsset, ChangeTrustOp, ManageSellOfferOp, OperationType,
+    )
+    from stellar_tpu.xdr.types import Price, asset_alphanum4
+    a, b, issuer = keypair("inv-a"), keypair("inv-b"), keypair("inv-i")
+    root = seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM), (issuer, 1000 * XLM)])
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    from stellar_tpu.xdr.tx import PaymentOp, muxed_account
+    ct = op(OperationType.CHANGE_TRUST, ChangeTrustOp(
+        line=ChangeTrustAsset.make(usd.arm, usd.value), limit=10**15))
+    assert apply_tx(root, make_tx(a, seq_for(root, a),
+                                  [ct])).code == TC.txSUCCESS
+    assert apply_tx(root, make_tx(b, seq_for(root, b),
+                                  [ct])).code == TC.txSUCCESS
+    pay = op(OperationType.PAYMENT, PaymentOp(
+        destination=muxed_account(b.public_key.raw), asset=usd,
+        amount=500 * XLM))
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                  [pay])).code == TC.txSUCCESS
+    from stellar_tpu.xdr.types import NATIVE_ASSET
+    sell = op(OperationType.MANAGE_SELL_OFFER, ManageSellOfferOp(
+        selling=NATIVE_ASSET, buying=usd, amount=100 * XLM,
+        price=Price(n=1, d=1), offerID=0))
+    assert apply_tx(root, make_tx(a, seq_for(root, a),
+                                  [sell])).code == TC.txSUCCESS
+    # b crosses it
+    buy = op(OperationType.MANAGE_SELL_OFFER, ManageSellOfferOp(
+        selling=usd, buying=NATIVE_ASSET, amount=50 * XLM,
+        price=Price(n=1, d=1), offerID=0))
+    assert apply_tx(root, make_tx(b, seq_for(root, b),
+                                  [buy])).code == TC.txSUCCESS
+
+
+def test_pool_workload_passes_constant_product(full_invariants):
+    from tests.test_liquidity_pools import (
+        change_trust_op, deposit_op, pool_share_line,
+    )
+    from stellar_tpu.tx.asset_utils import (
+        change_trust_asset_to_trustline_asset,
+    )
+    from stellar_tpu.xdr.tx import (
+        ChangeTrustAsset, PathPaymentStrictSendOp, OperationType,
+        muxed_account,
+    )
+    from stellar_tpu.xdr.types import NATIVE_ASSET, asset_alphanum4
+    from tests.test_liquidity_pools import op
+    a, issuer = keypair("cp-a"), keypair("cp-i")
+    root = seed_root_with_accounts([(a, 100_000 * XLM),
+                                    (issuer, 100_000 * XLM)])
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    line = pool_share_line(NATIVE_ASSET, usd)
+    pool_id = change_trust_asset_to_trustline_asset(line).value
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(ChangeTrustAsset.make(usd.arm, usd.value),
+                        10**15)])).code == TC.txSUCCESS
+    from stellar_tpu.xdr.tx import PaymentOp
+    pay = op(OperationType.PAYMENT, PaymentOp(
+        destination=muxed_account(a.public_key.raw), asset=usd,
+        amount=50_000 * XLM))
+    assert apply_tx(root, make_tx(issuer, seq_for(root, issuer),
+                                  [pay])).code == TC.txSUCCESS
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        change_trust_op(line, 10**15)])).code == TC.txSUCCESS
+    assert apply_tx(root, make_tx(a, seq_for(root, a), [
+        deposit_op(pool_id, 1000 * XLM, 5000 * XLM)])).code == TC.txSUCCESS
+    # trade against the pool — constant product must not decrease
+    pps = op(OperationType.PATH_PAYMENT_STRICT_SEND, PathPaymentStrictSendOp(
+        sendAsset=NATIVE_ASSET, sendAmount=10 * XLM,
+        destination=muxed_account(a.public_key.raw),
+        destAsset=usd, destMin=1, path=[]))
+    assert apply_tx(root, make_tx(a, seq_for(root, a),
+                                  [pps])).code == TC.txSUCCESS
+
+
+def test_constant_product_detects_violation(full_invariants):
+    """A hand-mutated pool delta that leaks reserves trips the
+    invariant."""
+    from stellar_tpu.invariant.invariants import ConstantProductInvariant
+    from stellar_tpu.xdr.types import (
+        LedgerEntry, LedgerEntryType, LiquidityPoolEntry,
+        LiquidityPoolConstantProductParameters, LiquidityPoolParameters,
+        LiquidityPoolType, NATIVE_ASSET, asset_alphanum4,
+    )
+    issuer = keypair("cpv-i")
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+
+    def pool_entry(ra, rb, shares):
+        body = LiquidityPoolEntry._types[1].make(
+            LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            __import__("stellar_tpu.xdr.types",
+                       fromlist=["LiquidityPoolEntryConstantProduct"])
+            .LiquidityPoolEntryConstantProduct(
+                params=LiquidityPoolConstantProductParameters(
+                    assetA=NATIVE_ASSET, assetB=usd, fee=30),
+                reserveA=ra, reserveB=rb, totalPoolShares=shares,
+                poolSharesTrustLineCount=1))
+        return LedgerEntry(
+            lastModifiedLedgerSeq=1,
+            data=LedgerEntry._types[1].make(
+                LedgerEntryType.LIQUIDITY_POOL,
+                LiquidityPoolEntry(liquidityPoolID=b"\x01" * 32,
+                                   body=body)),
+            ext=LedgerEntry._types[2].make(0))
+
+    inv = ConstantProductInvariant()
+    delta = {b"k": (pool_entry(1000, 1000, 50),
+                    pool_entry(900, 1100, 50))}  # 990000 < 1000000
+    assert inv.check_on_operation_apply(None, None, delta, None)
+    delta = {b"k": (pool_entry(1000, 1000, 50),
+                    pool_entry(990, 1012, 50))}  # 1001880 >= 1000000
+    assert inv.check_on_operation_apply(None, None, delta, None) is None
+
+
+def test_bucket_apply_consistency(tmp_path, full_invariants):
+    from stellar_tpu.bucket.bucket import fresh_bucket
+    from stellar_tpu.invariant.invariants import (
+        BucketListIsConsistentWithDatabase,
+    )
+    from stellar_tpu.ledger.ledger_txn import (
+        InMemoryLedgerStore, entry_to_key,
+    )
+    from stellar_tpu.tx.ops.create_account import new_account_entry
+    inv = BucketListIsConsistentWithDatabase()
+    e = new_account_entry(account_id(keypair("ba").public_key.raw),
+                          5 * XLM, 1)
+    bucket = fresh_bucket(22, [e], [], [])
+    store = InMemoryLedgerStore()
+    # missing entry -> violation
+    assert inv.check_on_bucket_apply(bucket, store)
+    store.put(key_bytes(entry_to_key(e)), e)
+    assert inv.check_on_bucket_apply(bucket, store) is None
+    # corrupted entry -> violation
+    e2 = new_account_entry(account_id(keypair("ba").public_key.raw),
+                           6 * XLM, 1)
+    store.put(key_bytes(entry_to_key(e2)), e2)
+    assert inv.check_on_bucket_apply(bucket, store)
