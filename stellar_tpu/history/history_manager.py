@@ -100,6 +100,67 @@ class FileArchive:
             return f.read()
 
 
+class CommandArchive:
+    """Archive reached through operator get/put command templates run
+    as subprocesses, with gzip transport encoding (reference
+    ``history/readme.md:5-29``: ``get="curl -sf {0} -o {1}"``,
+    ``put="aws s3 cp {1} {0}"``; {0}=remote name, {1}=local file).
+    Published files carry the reference's ``.gz`` suffix."""
+
+    def __init__(self, get_template: str = "",
+                 put_template: str = "",
+                 process_manager=None):
+        import tempfile
+        from stellar_tpu.process import ProcessManager
+        self.get_template = get_template
+        self.put_template = put_template
+        self.pm = process_manager or ProcessManager()
+        self.tmp = tempfile.mkdtemp(prefix="stpu-archive-")
+
+    def _local(self, rel: str) -> str:
+        path = os.path.join(self.tmp, rel.replace("/", "_")) + ".gz"
+        return path
+
+    def put(self, rel: str, data: bytes):
+        if not self.put_template:
+            raise IOError("archive has no put command (read-only)")
+        import gzip
+        local = self._local(rel)
+        with gzip.open(local, "wb") as f:
+            f.write(data)
+        cmd = self.put_template.replace("{0}", rel + ".gz") \
+                               .replace("{1}", local)
+        rc = self.pm.run_sync(cmd)
+        os.unlink(local)
+        if rc != 0:
+            raise IOError(f"archive put failed ({rc}): {cmd}")
+
+    def get(self, rel: str) -> Optional[bytes]:
+        if not self.get_template:
+            return None
+        import gzip
+        local = self._local(rel)
+        cmd = self.get_template.replace("{0}", rel + ".gz") \
+                               .replace("{1}", local)
+        rc = self.pm.run_sync(cmd)
+        if rc != 0 or not os.path.exists(local):
+            return None
+        try:
+            with gzip.open(local, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(local)
+
+
+def archive_from_config(spec) -> "FileArchive":
+    """Config HISTORY_ARCHIVES entry -> archive: a plain string is a
+    local directory; a dict {"get": ..., "put": ...} is a command
+    archive (reference [HISTORY.x] TOML tables)."""
+    if isinstance(spec, str):
+        return FileArchive(spec)
+    return CommandArchive(spec.get("get", ""), spec.get("put", ""))
+
+
 class HistoryArchiveState:
     """The JSON "HAS" manifest (reference ``HistoryArchiveState``)."""
 

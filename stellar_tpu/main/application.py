@@ -72,9 +72,14 @@ class Application:
         self.work_scheduler = WorkScheduler(self.clock)
         self.history: Optional[HistoryManager] = None
         if config.HISTORY_ARCHIVES:
+            from stellar_tpu.history.history_manager import (
+                archive_from_config,
+            )
             self.history = HistoryManager(
-                [FileArchive(p) for p in config.HISTORY_ARCHIVES],
+                [archive_from_config(p) for p in config.HISTORY_ARCHIVES],
                 config.NETWORK_PASSPHRASE)
+        from stellar_tpu.process import ProcessManager
+        self.process_manager = ProcessManager()
         self.herder.on_externalized = self._on_externalized
         if config.INVARIANT_CHECKS:
             from stellar_tpu.invariant import (
@@ -96,6 +101,23 @@ class Application:
         self._started = True
         if not self.config.MANUAL_CLOSE:
             self.herder.start()
+        if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0 and \
+                self.database is not None:
+            self._schedule_maintenance()
+
+    def _schedule_maintenance(self):
+        """Periodic history GC (reference Maintainer::scheduleMaintenance)."""
+        from stellar_tpu.utils.timer import VirtualTimer
+
+        def run():
+            from stellar_tpu.main.maintainer import Maintainer
+            Maintainer(self).perform_maintenance(
+                self.config.AUTOMATIC_MAINTENANCE_COUNT)
+            self._schedule_maintenance()
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(self.config.AUTOMATIC_MAINTENANCE_PERIOD)
+        t.async_wait(run, lambda: None)
+        self._maintenance_timer = t
 
     def crank(self, block: bool = False) -> int:
         return self.clock.crank(block)

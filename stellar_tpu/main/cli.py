@@ -44,8 +44,14 @@ def cmd_run(args) -> int:
     app = Application(cfg)
     tcp = TCPDriver(app, cfg.PEER_PORT)
     http = CommandHandler(app, cfg.HTTP_PORT)
+    query = None
+    if cfg.HTTP_QUERY_PORT:
+        from stellar_tpu.main.command_handler import QueryServer
+        query = QueryServer(app, cfg.HTTP_QUERY_PORT)
     print(f"stellar_tpu node up: peer port {tcp.door.port}, "
-          f"http port {http.port}", file=sys.stderr)
+          f"http port {http.port}"
+          + (f", query port {query.port}" if query else ""),
+          file=sys.stderr)
     for spec in cfg.KNOWN_PEERS:
         host, _, port = spec.partition(":")
         tcp.connect(host, int(port or 11625))
@@ -119,6 +125,126 @@ def cmd_self_check(args) -> int:
     return 0
 
 
+def cmd_new_db(args) -> int:
+    """(Re)initialize the node database (reference ``new-db``)."""
+    import os
+    cfg = _load_config(args)
+    if not cfg.DATABASE:
+        print("config has no DATABASE", file=sys.stderr)
+        return 1
+    for suffix in ("", "-wal", "-shm"):
+        path = cfg.DATABASE + suffix
+        if os.path.exists(path):
+            os.unlink(path)
+    from stellar_tpu.database import Database
+    Database(cfg.DATABASE).close()
+    print(json.dumps({"database": cfg.DATABASE, "status": "initialized"}))
+    return 0
+
+
+def cmd_dump_ledger(args) -> int:
+    """Dump committed ledger entries from a persisted node (reference
+    ``dump-ledger``)."""
+    from stellar_tpu.bucket.bucket_manager import BucketManager
+    from stellar_tpu.database import Database, NodePersistence
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    import os
+    cfg = _load_config(args)
+    if not cfg.DATABASE:
+        print("config has no DATABASE", file=sys.stderr)
+        return 1
+    bucket_dir = cfg.BUCKET_DIR_PATH or os.path.join(
+        os.path.dirname(os.path.abspath(cfg.DATABASE)), "buckets")
+    pers = NodePersistence(Database(cfg.DATABASE),
+                           BucketManager(bucket_dir))
+    lm = LedgerManager.from_persistence(b"\x00" * 32, pers)
+    if lm is None:
+        print("database has no last closed ledger", file=sys.stderr)
+        return 1
+    from stellar_tpu.xdr.runtime import to_bytes
+    from stellar_tpu.xdr.types import LedgerEntry, LedgerEntryType
+    limit = args.limit
+    count = 0
+    snapshot = lm.bucket_list
+    from stellar_tpu.bucket.bucket_list_db import (
+        SearchableBucketListSnapshot,
+    )
+    snap = SearchableBucketListSnapshot.from_bucket_list(snapshot)
+    for kb, entry in snap.iter_live_entries():
+        if count >= limit:
+            break
+        print(json.dumps({
+            "type": LedgerEntryType.name_of(entry.data.arm),
+            "key": kb.hex(),
+            "entry": to_bytes(LedgerEntry, entry).hex()}))
+        count += 1
+    print(json.dumps({"lcl": lm.ledger_seq, "dumped": count}),
+          file=sys.stderr)
+    return 0
+
+
+def cmd_sign_transaction(args) -> int:
+    """Add this node's signature to an envelope file (reference
+    ``sign-transaction``)."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+    from stellar_tpu.xdr.tx import (
+        TransactionEnvelope, transaction_sig_payload,
+    )
+    cfg = _load_config(args)
+    if cfg.NODE_SEED is None:
+        print("config has no NODE_SEED", file=sys.stderr)
+        return 1
+    with open(args.file, "rb") as f:
+        env = from_bytes(TransactionEnvelope, f.read())
+    network_id = cfg.network_id()
+    payload = transaction_sig_payload(network_id, env.value.tx)
+    env.value.signatures.append(
+        cfg.NODE_SEED.sign_decorated(sha256(payload)))
+    out = to_bytes(TransactionEnvelope, env)
+    sys.stdout.write(out.hex() + "\n")
+    return 0
+
+
+def cmd_verify_checkpoints(args) -> int:
+    """Walk an archive's header chain backwards from its HAS, verifying
+    every previousLedgerHash link (reference ``verify-checkpoints`` /
+    ``WriteVerifiedCheckpointHashesWork``)."""
+    from stellar_tpu.history.history_manager import (
+        FileArchive, HistoryManager, checkpoint_containing,
+    )
+    from stellar_tpu.xdr.ledger import ledger_header_hash
+    archive = FileArchive(args.archive)
+    has = HistoryManager.get_root_has(archive)
+    if has is None:
+        print("archive has no root HAS", file=sys.stderr)
+        return 1
+    verified = 0
+    expected_hash = None
+    cp = checkpoint_containing(has.current_ledger)
+    while cp >= 63:
+        chk = HistoryManager.get_checkpoint(archive, cp)
+        if chk is None:
+            break
+        headers = chk[0]
+        for he in reversed(headers):
+            got = ledger_header_hash(he.header)
+            if got != he.hash:
+                print(json.dumps({"error": "header hash mismatch",
+                                  "ledger": he.header.ledgerSeq}))
+                return 1
+            if expected_hash is not None and got != expected_hash:
+                print(json.dumps({"error": "chain broken",
+                                  "ledger": he.header.ledgerSeq}))
+                return 1
+            expected_hash = he.header.previousLedgerHash
+            verified += 1
+        cp -= 64
+    print(json.dumps({"verified_headers": verified,
+                      "tip": has.current_ledger}))
+    return 0
+
+
 def cmd_check_quorum_intersection(args) -> int:
     """Offline safety analysis (reference ``check-quorum-intersection``,
     ``CommandLine.cpp``): JSON file {node strkey: {"THRESHOLD": n,
@@ -179,6 +305,16 @@ def main(argv=None) -> int:
     sp.add_argument("--filetype", default="TransactionEnvelope")
     sp.set_defaults(fn=cmd_print_xdr)
     sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
+    sub.add_parser("new-db").set_defaults(fn=cmd_new_db)
+    sp = sub.add_parser("dump-ledger")
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.set_defaults(fn=cmd_dump_ledger)
+    sp = sub.add_parser("sign-transaction")
+    sp.add_argument("file", help="binary TransactionEnvelope XDR")
+    sp.set_defaults(fn=cmd_sign_transaction)
+    sp = sub.add_parser("verify-checkpoints")
+    sp.add_argument("archive", help="archive directory")
+    sp.set_defaults(fn=cmd_verify_checkpoints)
     sp = sub.add_parser("check-quorum-intersection")
     sp.add_argument("file", help="JSON quorum map")
     sp.set_defaults(fn=cmd_check_quorum_intersection)

@@ -18,8 +18,9 @@ class CommandHandler:
     is marshalled onto the main thread via post_to_main + an event —
     the reference's single-writer discipline."""
 
-    def __init__(self, app, port: int = 0):
+    def __init__(self, app, port: int = 0, routes=None):
         self.app = app
+        self.routes = dict(self.ROUTES if routes is None else routes)
         handler = self._make_handler()
         self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.server.server_address[1]
@@ -132,10 +133,105 @@ class CommandHandler:
                           level)
         return {"partition": partition, "level": level or "unchanged"}
 
+    def cmd_bans(self, params):
+        from stellar_tpu.crypto import strkey
+        return self._on_main(lambda: [
+            strkey.encode_account(n)
+            for n in self.app.overlay.ban_manager.banned_nodes()])
+
+    def cmd_ban(self, params):
+        from stellar_tpu.crypto import strkey
+        node = strkey.decode_account(params["node"][0])
+        self._on_main(lambda: self.app.overlay.ban_peer(node))
+        return {"banned": params["node"][0]}
+
+    def cmd_unban(self, params):
+        from stellar_tpu.crypto import strkey
+        node = strkey.decode_account(params["node"][0])
+        self._on_main(lambda: self.app.overlay.ban_manager.unban(node))
+        return {"unbanned": params["node"][0]}
+
+    def cmd_droppeer(self, params):
+        from stellar_tpu.crypto import strkey
+        node = strkey.decode_account(params["node"][0])
+
+        def drop():
+            for p in list(self.app.overlay.peers):
+                if p.remote_node_id == node:
+                    p.drop("dropped by operator")
+                    return True
+            return False
+        return {"dropped": self._on_main(drop)}
+
+    def cmd_upgrades(self, params):
+        """Schedule upgrade votes (reference 'upgrades?mode=set&...')."""
+        mode = params.get("mode", ["get"])[0]
+
+        def apply_():
+            up = self.app.herder.upgrades.params
+            if mode == "set":
+                from stellar_tpu.herder.upgrades import UpgradeParameters
+                up = UpgradeParameters(
+                    upgrade_time=int(params.get("upgradetime", ["0"])[0]))
+                for attr, key in (
+                        ("protocol_version", "protocolversion"),
+                        ("base_fee", "basefee"),
+                        ("max_tx_set_size", "maxtxsetsize"),
+                        ("base_reserve", "basereserve"),
+                        ("flags", "flags")):
+                    if key in params:
+                        setattr(up, attr, int(params[key][0]))
+                self.app.herder.upgrades.params = up
+            elif mode == "clear":
+                from stellar_tpu.herder.upgrades import UpgradeParameters
+                self.app.herder.upgrades.params = UpgradeParameters()
+                up = self.app.herder.upgrades.params
+            return {
+                "upgradetime": up.upgrade_time,
+                "protocolversion": up.protocol_version,
+                "basefee": up.base_fee,
+                "maxtxsetsize": up.max_tx_set_size,
+                "basereserve": up.base_reserve,
+                "flags": up.flags,
+            }
+        return self._on_main(apply_)
+
+    def cmd_maintenance(self, params):
+        count = int(params.get("count", ["50000"])[0])
+
+        def run():
+            from stellar_tpu.main.maintainer import Maintainer
+            return Maintainer(self.app).perform_maintenance(count)
+        return self._on_main(run)
+
+    def cmd_getledgerentryraw(self, params):
+        """The QueryServer route (reference ``QueryServer.h:21-29``):
+        hex-encoded LedgerKey XDR in, hex LedgerEntry XDR out."""
+        from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+        from stellar_tpu.xdr.types import LedgerEntry, LedgerKey
+        keys = params.get("key", [])
+
+        def run():
+            out = {"ledgerSeq": self.app.lm.ledger_seq, "entries": []}
+            for k in keys:
+                kb = bytes.fromhex(k)
+                from_bytes(LedgerKey, kb)  # validate
+                e = self.app.lm.root.store.get(kb)
+                out["entries"].append(
+                    {"key": k,
+                     "e": to_bytes(LedgerEntry, e).hex()
+                     if e is not None else None})
+            return out
+        return self._on_main(run)
+
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
+        "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,
+        "droppeer": cmd_droppeer, "upgrades": cmd_upgrades,
+        "maintenance": cmd_maintenance,
+        "getledgerentryraw": cmd_getledgerentryraw,
     }
 
     def _make_handler(outer_self):
@@ -146,7 +242,7 @@ class CommandHandler:
             def do_GET(self):
                 parsed = urlparse(self.path)
                 route = parsed.path.strip("/")
-                fn = CommandHandler.ROUTES.get(route)
+                fn = outer_self.routes.get(route)
                 if fn is None:
                     self.send_response(404)
                     self.end_headers()
@@ -163,3 +259,14 @@ class CommandHandler:
                 self.end_headers()
                 self.wfile.write(body)
         return Handler
+
+
+class QueryServer(CommandHandler):
+    """Separate read-only HTTP server answering ledger-entry queries
+    (reference ``src/main/QueryServer.h:21-29`` — its own port so heavy
+    query load can't crowd out operator commands)."""
+
+    def __init__(self, app, port: int = 0):
+        super().__init__(app, port, routes={
+            "getledgerentryraw": CommandHandler.cmd_getledgerentryraw,
+        })

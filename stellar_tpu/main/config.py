@@ -34,7 +34,13 @@ class Config:
     PEER_PORT: int = 11625
     TARGET_PEER_CONNECTIONS: int = 8
     MAX_PEER_CONNECTIONS: int = 64
+    MAX_PENDING_CONNECTIONS: int = 500
     KNOWN_PEERS: List[str] = field(default_factory=list)
+    PREFERRED_PEERS: List[str] = field(default_factory=list)
+    PEER_FLOOD_READING_CAPACITY: int = 200
+    PEER_FLOOD_READING_CAPACITY_BYTES: int = 300_000
+    FLOW_CONTROL_SEND_MORE_BATCH_SIZE: int = 40
+    FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES: int = 100_000
 
     # persistence (reference DATABASE / BUCKET_DIR_PATH): None keeps the
     # node fully in-memory (tests); a path makes every close durable
@@ -48,6 +54,11 @@ class Config:
     LOG_LEVEL: str = "INFO"
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HTTP_PORT: int = 11626
+    HTTP_QUERY_PORT: int = 0  # 0 disables the query server
+    AUTOMATIC_MAINTENANCE_PERIOD: int = 14400  # seconds; 0 disables
+    AUTOMATIC_MAINTENANCE_COUNT: int = 50_000
+    CATCHUP_COMPLETE: bool = False
+    CATCHUP_RECENT: int = 0
 
     # test knobs (reference ARTIFICIALLY_* family)
     ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
@@ -71,6 +82,14 @@ class Config:
             "RUN_STANDALONE", "MANUAL_CLOSE", "MAX_TX_SET_SIZE",
             "EXPECTED_LEDGER_CLOSE_TIME", "INVARIANT_CHECKS",
             "DATABASE", "BUCKET_DIR_PATH",
+            "MAX_PENDING_CONNECTIONS", "PREFERRED_PEERS",
+            "PEER_FLOOD_READING_CAPACITY",
+            "PEER_FLOOD_READING_CAPACITY_BYTES",
+            "FLOW_CONTROL_SEND_MORE_BATCH_SIZE",
+            "FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES",
+            "HTTP_QUERY_PORT", "AUTOMATIC_MAINTENANCE_PERIOD",
+            "AUTOMATIC_MAINTENANCE_COUNT", "CATCHUP_COMPLETE",
+            "CATCHUP_RECENT",
         }
         for key, value in raw.items():
             if key == "NODE_SEED":

@@ -1,0 +1,36 @@
+"""Maintainer: GC of historical rows the node no longer needs
+(reference ``src/main/Maintainer.cpp`` — deletes scphistory/txhistory
+below the publish cursor on a timer or via the 'maintenance' command)."""
+
+from __future__ import annotations
+
+__all__ = ["Maintainer"]
+
+
+class Maintainer:
+    def __init__(self, app):
+        self.app = app
+
+    def perform_maintenance(self, count: int) -> dict:
+        """Delete history rows older than LCL - count (bounded by what
+        has been published, when a history manager exists)."""
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return {"deleted": 0, "reason": "no database"}
+        keep_from = max(1, self.app.lm.ledger_seq - count)
+        history = getattr(self.app, "history", None)
+        if history is not None:
+            # never GC rows that still await publishing
+            from stellar_tpu.history.history_manager import (
+                checkpoint_containing,
+            )
+            keep_from = min(keep_from,
+                            checkpoint_containing(self.app.lm.ledger_seq))
+        deleted = 0
+        with db.conn:
+            for table in ("scphistory", "txhistory"):
+                cur = db.conn.execute(
+                    f"DELETE FROM {table} WHERE ledgerseq < ?",
+                    (keep_from,))
+                deleted += cur.rowcount
+        return {"deleted": deleted, "below": keep_from}

@@ -161,7 +161,12 @@ class Peer:
         self.send_key = self.recv_key = None
         self.send_seq = 0
         self.recv_seq = 0
-        self.flow = FlowControl()
+        cfg = getattr(app, "config", None)
+        self.flow = FlowControl(
+            getattr(cfg, "PEER_FLOOD_READING_CAPACITY",
+                    PEER_FLOOD_READING_CAPACITY),
+            getattr(cfg, "PEER_FLOOD_READING_CAPACITY_BYTES",
+                    PEER_FLOOD_READING_CAPACITY_BYTES))
         self.on_drop: Optional[Callable] = None
 
     # ---------------- transport hooks ----------------
@@ -302,8 +307,8 @@ class Peer:
         self._send_message(StellarMessage.make(
             MessageType.SEND_MORE_EXTENDED,
             SendMoreExtended(
-                numMessages=PEER_FLOOD_READING_CAPACITY,
-                numBytes=PEER_FLOOD_READING_CAPACITY_BYTES)))
+                numMessages=self.flow.capacity,
+                numBytes=self.flow.capacity_bytes)))
         self.app.overlay.peer_authenticated(self)
 
     # ---------------- outbound API ----------------

@@ -1,0 +1,3 @@
+from stellar_tpu.process.process_manager import (  # noqa: F401
+    ProcessManager,
+)
