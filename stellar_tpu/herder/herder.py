@@ -139,6 +139,13 @@ class Herder:
         self.tx_queue = TransactionQueue(
             max_ops=2 * self.lm.last_closed_header.maxTxSetSize,
             check_valid=self._check_tx_valid)
+        # Soroban txs queue separately with their own (tx-count) limits
+        # (reference SorobanTransactionQueue); pull-mode relay and set
+        # building see both through the facade methods below
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        self.soroban_tx_queue = TransactionQueue(
+            max_ops=2 * default_soroban_config().ledger_max_tx_count,
+            check_valid=self._check_tx_valid)
         self.state = HERDER_STATE.BOOTING
         self.tracking_slot = 0
         self._timers: Dict[tuple, VirtualTimer] = {}
@@ -201,10 +208,25 @@ class Herder:
                          ) -> AddResult:
         """Reference ``HerderImpl::recvTransaction``: admit to the queue
         and flood on success."""
-        res = self.tx_queue.try_add(frame)
+        res = self.queue_for(frame).try_add(frame)
         if res.code == AddResult.ADD_STATUS_PENDING:
             self.broadcast_transaction(frame)
         return res
+
+    def queue_for(self, frame) -> TransactionQueue:
+        return self.soroban_tx_queue if frame.is_soroban() \
+            else self.tx_queue
+
+    def get_pending_tx(self, tx_hash: bytes):
+        """Pull-mode demand lookup across both queues."""
+        return self.tx_queue.known_hashes.get(tx_hash) or \
+            self.soroban_tx_queue.known_hashes.get(tx_hash)
+
+    def is_tx_known_or_banned(self, tx_hash: bytes) -> bool:
+        return (tx_hash in self.tx_queue.known_hashes or
+                tx_hash in self.soroban_tx_queue.known_hashes or
+                self.tx_queue.is_banned(tx_hash) or
+                self.soroban_tx_queue.is_banned(tx_hash))
 
     # ---------------- SCP envelopes ----------------
 
@@ -416,7 +438,8 @@ class Herder:
             return
         self._last_trigger_at = self.clock.now()
         lcl = self.lm.last_closed_header
-        frames = self.tx_queue.get_transactions()
+        frames = self.tx_queue.get_transactions() + \
+            self.soroban_tx_queue.get_transactions()
         txset, _ = make_tx_set_from_transactions(
             frames, lcl, self.lm.last_closed_hash)
         self.recv_tx_set(txset)
@@ -456,6 +479,8 @@ class Herder:
         self.tx_queue.remove_applied(txset.frames)
         self.tx_queue.shift()
         self.tx_queue.max_ops = 2 * self.lm.last_closed_header.maxTxSetSize
+        self.soroban_tx_queue.remove_applied(txset.frames)
+        self.soroban_tx_queue.shift()
         # GC old slots + their timers + txsets
         keep_from = max(1, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS)
         self.scp.purge_slots(keep_from)

@@ -89,58 +89,53 @@ def make_tx_set_from_transactions(
 ) -> Tuple["ApplicableTxSetFrame", List]:
     """Build a valid (surge-priced) tx set from candidate frames.
 
-    Returns (applicable_frame, excluded_frames). Capacity is
-    ``lcl_header.maxTxSetSize`` counted in operations (protocol >= 11
-    semantics). When candidates exceed capacity, lowest-fee-rate
-    accounts' tails are trimmed first and the set's discounted base fee
-    becomes the lowest included per-op fee (reference
-    ``makeTxSetFromTransactions`` + ``SurgePricingPriorityQueue``).
+    Returns (applicable_frame, excluded_frames). Two phases (reference
+    generalized tx sets from protocol 20): the CLASSIC phase is limited
+    in operations by ``lcl_header.maxTxSetSize``; the SOROBAN phase in
+    transactions by the network config's per-ledger cap. Each phase
+    surge-prices independently: when it overflows, lowest-fee-rate
+    account tails are trimmed and that phase's discounted base fee
+    becomes the lowest included per-op bid (reference
+    ``makeTxSetFromTransactions`` + ``SurgePricingPriorityQueue`` +
+    ``computeLaneBaseFee``).
     """
-    queues = _build_account_queues(frames)
-    # candidate "account chains": we take or trim whole tails so the
-    # per-account sequence stays gapless
-    included: List = []
-    excluded: List = []
-    capacity = lcl_header.maxTxSetSize
+    from stellar_tpu.herder.surge_pricing import (
+        SurgePricingLaneConfig, SurgePricingPriorityQueue,
+    )
+    from stellar_tpu.protocol import SOROBAN_PROTOCOL_VERSION
 
-    # greedy: repeatedly take the highest-fee-rate head among accounts
-    heads = [(q[0], aid) for aid, q in queues.items()]
-    total_ops = 0
-    surge = False
-    while heads:
-        # pick max fee rate head (ties by contents hash for determinism)
-        best_i = 0
-        for i in range(1, len(heads)):
-            a, b = heads[i][0], heads[best_i][0]
-            if fee_rate_less_than(b, a) or (
-                    not fee_rate_less_than(a, b)
-                    and a.contents_hash() < b.contents_hash()):
-                best_i = i
-        frame, aid = heads.pop(best_i)
-        q = queues[aid]
-        ops = max(1, frame.num_operations())
-        if total_ops + ops > capacity:
-            # trim this whole account tail (seq gap otherwise)
-            surge = True
-            excluded.extend(q)
-            queues[aid] = []
-            continue
-        total_ops += ops
-        included.append(frame)
-        q.pop(0)
-        if q:
-            heads.append((q[0], aid))
+    classic = [f for f in frames if not f.is_soroban()]
+    soroban = [f for f in frames if f.is_soroban()]
 
-    # the component base fee is always present: header.baseFee when the
-    # ledger isn't congested, the lowest included per-op bid under surge
-    # pricing (reference ``computeLaneBaseFee``, TxSetFrame.cpp:610-631)
-    base_fee = lcl_header.baseFee
-    if surge and included:
-        base_fee = min(compute_per_op_fee(f) for f in included)
+    inc_c, exc_c, full_c = \
+        SurgePricingPriorityQueue.most_top_txs_within_limits(
+            classic, SurgePricingLaneConfig([lcl_header.maxTxSetSize]))
+    base_fee_c = SurgePricingPriorityQueue.lane_base_fee(
+        inc_c, lcl_header.baseFee, bool(full_c))
 
-    xdr_set = _to_generalized_xdr(included, lcl_hash, base_fee)
-    applicable = ApplicableTxSetFrame(
-        xdr_set, included, {id(f): base_fee for f in included})
+    soroban_phase = lcl_header.ledgerVersion >= SOROBAN_PROTOCOL_VERSION
+    inc_s: List = []
+    excluded = list(exc_c)
+    base_fee_s = lcl_header.baseFee
+    if soroban_phase:
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        cap = default_soroban_config().ledger_max_tx_count
+        inc_s, exc_s, full_s = \
+            SurgePricingPriorityQueue.most_top_txs_within_limits(
+                soroban, SurgePricingLaneConfig(
+                    [cap], resources_of=lambda f: 1))
+        base_fee_s = SurgePricingPriorityQueue.lane_base_fee(
+            inc_s, lcl_header.baseFee, bool(full_s))
+        excluded.extend(exc_s)
+    else:
+        excluded.extend(soroban)
+
+    xdr_set = _to_generalized_xdr(inc_c, base_fee_c, inc_s, base_fee_s,
+                                  lcl_hash, soroban_phase)
+    discounts = {id(f): base_fee_c for f in inc_c}
+    discounts.update({id(f): base_fee_s for f in inc_s})
+    applicable = ApplicableTxSetFrame(xdr_set, inc_c + inc_s, discounts,
+                                      soroban_frames=inc_s)
     return applicable, excluded
 
 
@@ -150,15 +145,24 @@ def _sorted_in_hash_order(frames) -> List:
     return sorted(frames, key=full_tx_hash)
 
 
-def _to_generalized_xdr(frames, lcl_hash: bytes, base_fee: int):
+def _phase_xdr(frames, base_fee: int):
     comp = TxSetComponent.make(
         TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
         TxSetComponentTxsMaybeDiscountedFee(
             baseFee=base_fee,
             txs=[f.envelope for f in _sorted_in_hash_order(frames)]))
-    phase = TransactionPhase.make(0, [comp] if frames else [])
+    return TransactionPhase.make(0, [comp] if frames else [])
+
+
+def _to_generalized_xdr(classic, base_fee_c: int, soroban, base_fee_s: int,
+                        lcl_hash: bytes, soroban_phase: bool):
+    """Phase 0 = classic, phase 1 = soroban (reference generalized tx
+    set layout from protocol 20; single phase before)."""
+    phases = [_phase_xdr(classic, base_fee_c)]
+    if soroban_phase:
+        phases.append(_phase_xdr(soroban, base_fee_s))
     return GeneralizedTransactionSet.make(
-        1, TransactionSetV1(previousLedgerHash=lcl_hash, phases=[phase]))
+        1, TransactionSetV1(previousLedgerHash=lcl_hash, phases=phases))
 
 
 class TxSetXDRFrame:
@@ -182,8 +186,9 @@ class TxSetXDRFrame:
         try:
             frames = []
             discounts = {}
+            soroban_frames = []
             v1 = self.xdr.value
-            for phase in v1.phases:
+            for phase_i, phase in enumerate(v1.phases):
                 if phase.arm != 0:
                     return None  # parallel soroban phase: later milestone
                 for comp in phase.value:
@@ -191,8 +196,11 @@ class TxSetXDRFrame:
                         f = make_transaction_frame(network_id, env)
                         frames.append(f)
                         discounts[id(f)] = comp.value.baseFee
+                        if phase_i == 1:
+                            soroban_frames.append(f)
             return ApplicableTxSetFrame(self.xdr, frames, discounts,
-                                        precomputed_hash=self.hash)
+                                        precomputed_hash=self.hash,
+                                        soroban_frames=soroban_frames)
         except Exception:
             return None
 
@@ -202,10 +210,12 @@ class ApplicableTxSetFrame:
     ``ApplicableTxSetFrame``)."""
 
     def __init__(self, xdr_set, frames: Sequence, discounts: Dict,
-                 precomputed_hash: Optional[bytes] = None):
+                 precomputed_hash: Optional[bytes] = None,
+                 soroban_frames: Sequence = ()):
         self.xdr = xdr_set
         self.frames = list(frames)
         self._discounts = discounts  # id(frame) -> Optional[baseFee]
+        self._soroban_ids = {id(f) for f in soroban_frames}
         self.hash = precomputed_hash if precomputed_hash is not None \
             else generalized_tx_set_hash(xdr_set)
 
@@ -218,10 +228,15 @@ class ApplicableTxSetFrame:
         return self._discounts.get(id(frame))
 
     def size_op(self) -> int:
-        return sum(max(1, f.num_operations()) for f in self.frames)
+        """Classic-phase operation count (the maxTxSetSize axis)."""
+        return sum(max(1, f.num_operations()) for f in self.frames
+                   if id(f) not in self._soroban_ids)
 
     def size_tx(self) -> int:
         return len(self.frames)
+
+    def soroban_tx_count(self) -> int:
+        return len(self._soroban_ids)
 
     # ---------------- validation ----------------
 
@@ -234,6 +249,14 @@ class ApplicableTxSetFrame:
         header = ltx.header()
         if self.size_op() > header.maxTxSetSize:
             return False
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        if self.soroban_tx_count() > \
+                default_soroban_config().ledger_max_tx_count:
+            return False
+        # soroban txs may only ride the soroban phase and vice versa
+        for f in self.frames:
+            if f.is_soroban() != (id(f) in self._soroban_ids):
+                return False
         # discounted base fee must not be below the protocol minimum
         by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames}
         for phase in self.xdr.value.phases:
@@ -276,9 +299,18 @@ class ApplicableTxSetFrame:
     # ---------------- apply order ----------------
 
     def get_txs_in_apply_order(self) -> List:
-        """Reference ``sortedForApplySequential``: round-robin account
-        batches, each shuffled by full-hash XOR set-hash."""
-        queues = list(_build_account_queues(self.frames).values())
+        """Reference ``sortedForApplySequential`` applied per phase:
+        classic applies first, then the soroban phase."""
+        classic = [f for f in self.frames
+                   if id(f) not in self._soroban_ids]
+        soroban = [f for f in self.frames if id(f) in self._soroban_ids]
+        return (self._phase_apply_order(classic) +
+                self._phase_apply_order(soroban))
+
+    def _phase_apply_order(self, frames) -> List:
+        """Round-robin account batches, each shuffled by full-hash XOR
+        set-hash."""
+        queues = list(_build_account_queues(frames).values())
         batches: List[List] = []
         while queues:
             batch = []

@@ -24,7 +24,8 @@ from stellar_tpu.ops import field25519 as fe
 from stellar_tpu.crypto import ed25519_ref as ref
 
 __all__ = [
-    "identity", "point_add", "point_double", "decompress", "compress_equals",
+    "identity", "point_add", "point_add_cached", "point_double",
+    "to_cached", "decompress", "compress_equals",
     "negate", "select_point", "table_select", "base_table", "D_LIMBS",
     "D2_LIMBS", "SQRTM1_LIMBS", "unpack255",
 ]
@@ -51,36 +52,55 @@ def negate(p):
     return (fe.neg(x), y, z, fe.neg(t))
 
 
-def point_add(p, q):
-    """Complete unified addition (RFC 8032 5.1.4); 8 field muls."""
+def _mul4(ls, rs):
+    """Four field multiplies fused into ONE stacked multiply over a
+    (20, 4, *batch) operand. The hot loop is bound by per-op overhead on
+    small (20, batch) tensors, not FLOPs — quartering the op count by
+    widening the batch axis is the single biggest lever on TPU."""
+    o = fe.mul(jnp.stack(ls, axis=1), jnp.stack(rs, axis=1))
+    return o[:, 0], o[:, 1], o[:, 2], o[:, 3]
+
+
+def to_cached(p):
+    """Extended point -> ref10 ``ge_cached`` form (Y+X, Y-X, Z, 2d*T):
+    the representation table entries are stored in, making every
+    window add exactly two fused multiplies."""
+    x, y, z, t = p
+    d2 = _const(D2_LIMBS, t.shape[1:])
+    return (fe.add(y, x), fe.sub(y, x), z, fe.mul(t, d2))
+
+
+def point_add_cached(p, q_cached):
+    """p (extended) + q (cached) — complete unified addition as two
+    fused 4-way multiplies (reference: libsodium ge25519_add)."""
     x1, y1, z1, t1 = p
-    x2, y2, z2, t2 = q
-    a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
-    b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
-    d2 = _const(D2_LIMBS, t1.shape[1:])
-    c = fe.mul(fe.mul(t1, t2), d2)
-    dd = fe.mul(z1, z2)
+    ypx2, ymx2, z2, t2d2 = q_cached
+    a, b, c, dd = _mul4((fe.sub(y1, x1), fe.add(y1, x1), t1, z1),
+                        (ymx2, ypx2, t2d2, z2))
     dd = fe.add(dd, dd)
     e = fe.sub(b, a)
     f = fe.sub(dd, c)
     g = fe.add(dd, c)
     h = fe.add(b, a)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+    return _mul4((e, g, f, e), (f, h, g, h))
+
+
+def point_add(p, q):
+    """Complete unified addition of two extended points."""
+    return point_add_cached(p, to_cached(q))
 
 
 def point_double(p):
-    """Dedicated doubling (4 sqr + 4 mul); valid for all points."""
+    """Dedicated doubling; one fused squaring + one fused multiply."""
     x1, y1, z1, _ = p
-    a = fe.sqr(x1)
-    b = fe.sqr(y1)
-    zz = fe.sqr(z1)
+    s = fe.sqr(jnp.stack([x1, y1, z1, fe.add(x1, y1)], axis=1))
+    a, b, zz, xysq = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
     c = fe.add(zz, zz)
     h = fe.add(a, b)
-    xy = fe.add(x1, y1)
-    e = fe.sub(h, fe.sqr(xy))
+    e = fe.sub(h, xysq)
     g = fe.sub(a, b)
     f = fe.add(c, g)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+    return _mul4((e, g, f, e), (f, h, g, h))
 
 
 def select_point(cond, p, q):
@@ -153,7 +173,7 @@ def compress_equals(p, r_bytes):
 
 
 def table_select(table, digit):
-    """table (16, 4, 20, batch), digit (batch,) int32 -> point tuple.
+    """table (16, 4, 20, batch), digit (batch,) int32 -> cached point.
 
     One-hot multiply-accumulate — branchless, constant-shape, VPU-friendly
     (a gather would lower to a serial dynamic-slice loop on TPU).
@@ -165,18 +185,18 @@ def table_select(table, digit):
 
 
 def _base_multiples() -> np.ndarray:
-    """Host-precomputed v*B for v in 0..15 as canonical affine-extended
-    limbs, shape (16, 4, 20) int32 (Z=1, T=x*y)."""
+    """Host-precomputed v*B for v in 0..15 in CACHED form (y+x, y-x, 1,
+    2d*x*y) canonical limbs, shape (16, 4, 20) int32."""
     out = np.zeros((16, 4, fe.NLIMBS), dtype=np.int32)
     for v in range(16):
         pt = ref.point_mul(v, ref.BASE)
         zinv = ref._inv(pt[2])
         x = pt[0] * zinv % ref.P
         y = pt[1] * zinv % ref.P
-        out[v, 0] = fe.from_int(x)
-        out[v, 1] = fe.from_int(y)
+        out[v, 0] = fe.from_int((y + x) % ref.P)
+        out[v, 1] = fe.from_int((y - x) % ref.P)
         out[v, 2] = fe.from_int(1)
-        out[v, 3] = fe.from_int(x * y % ref.P)
+        out[v, 3] = fe.from_int(2 * ref.D * x * y % ref.P)
     return out
 
 
@@ -184,17 +204,20 @@ _BASE_TABLE = _base_multiples()
 
 
 def base_table(batch_shape):
-    """(16, 4, 20, *batch) broadcast constant table of v*B."""
+    """(16, 4, 20, *batch) broadcast constant cached table of v*B."""
     t = jnp.asarray(_BASE_TABLE).reshape(
         (16, 4, fe.NLIMBS) + (1,) * len(batch_shape))
     return jnp.broadcast_to(t, (16, 4, fe.NLIMBS) + tuple(batch_shape))
 
 
 def build_point_table(p):
-    """Per-batch table v*p for v in 0..15 -> (16, 4, 20, batch)."""
-    entries = [identity(p[0].shape[1:]), p]
+    """Per-batch cached table v*p for v in 0..15 -> (16, 4, 20, batch)."""
+    cp = to_cached(p)
+    entries = [to_cached(identity(p[0].shape[1:])), cp]
+    plain = p
     for v in range(2, 16):
-        entries.append(point_add(entries[v - 1], p))
+        plain = point_add_cached(plain, cp)
+        entries.append(to_cached(plain))
     return jnp.stack([jnp.stack(e) for e in entries])
 
 
@@ -203,8 +226,8 @@ def double_scalarmult(s_digits, h_digits, a_neg):
 
     s_digits, h_digits: (64, batch) int32 radix-16 digits, most significant
     first. a_neg: extended point (the verifier passes -A). 252 shared
-    doublings + 128 table adds, all under one fori_loop — the hot loop of
-    the whole framework.
+    doublings + 128 cached-table adds, all under one fori_loop — the hot
+    loop of the whole framework.
     """
     batch = a_neg[0].shape[1:]
     tab_a = build_point_table(a_neg)
@@ -215,8 +238,8 @@ def double_scalarmult(s_digits, h_digits, a_neg):
             acc = point_double(acc)
         sd = lax.dynamic_index_in_dim(s_digits, j, 0, keepdims=False)
         hd = lax.dynamic_index_in_dim(h_digits, j, 0, keepdims=False)
-        acc = point_add(acc, table_select(tab_b, sd))
-        acc = point_add(acc, table_select(tab_a, hd))
+        acc = point_add_cached(acc, table_select(tab_b, sd))
+        acc = point_add_cached(acc, table_select(tab_a, hd))
         return acc
 
     return lax.fori_loop(0, 64, body, identity(batch))

@@ -167,7 +167,7 @@ class OverlayManager:
                     return
                 self.tx_demands.fulfilled(frame.contents_hash())
                 from stellar_tpu.herder.transaction_queue import AddResult
-                res = herder.tx_queue.try_add(frame)
+                res = herder.queue_for(frame).try_add(frame)
                 if res.code == AddResult.ADD_STATUS_PENDING:
                     # propagate by advert, not by pushing the body
                     self.broadcast_transaction(frame, from_peer=peer)
@@ -176,8 +176,7 @@ class OverlayManager:
             self.tx_adverts.note_incoming(peer, hashes)
             demand = []
             for h in hashes:
-                if h in herder.tx_queue.known_hashes or \
-                        herder.tx_queue.is_banned(h):
+                if herder.is_tx_known_or_banned(h):
                     continue
                 if self.tx_demands.start_demand(h, peer):
                     demand.append(h)
@@ -188,7 +187,7 @@ class OverlayManager:
                     FloodDemand(txHashes=demand)))
         elif t == MessageType.FLOOD_DEMAND:
             for h in msg.value.txHashes:
-                frame = herder.tx_queue.known_hashes.get(h)
+                frame = herder.get_pending_tx(h)
                 if frame is not None:
                     peer.send(StellarMessage.make(
                         MessageType.TRANSACTION, frame.envelope))
