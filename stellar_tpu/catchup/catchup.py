@@ -43,12 +43,20 @@ def verify_ledger_chain(headers) -> bool:
 
 
 class CatchupConfiguration:
+    """Reference ``CatchupConfiguration``: COMPLETE replays everything,
+    MINIMAL adopts the latest checkpoint's buckets, RECENT adopts
+    buckets at (target - count) and replays the last ``count`` ledgers
+    (``catchup <ledger>/<count>``)."""
+
     COMPLETE = "COMPLETE"
     MINIMAL = "MINIMAL"
+    RECENT = "RECENT"
 
-    def __init__(self, to_ledger: int, mode: str = COMPLETE):
+    def __init__(self, to_ledger: int, mode: str = COMPLETE,
+                 count: int = 0):
         self.to_ledger = to_ledger
         self.mode = mode
+        self.count = count
 
 
 def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
@@ -112,7 +120,10 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
     bl = LiveBucketList()
     for i, level in enumerate(has.bucket_hashes):
         for attr in ("curr", "snap", "next"):
-            hexhash = level.get(attr, "")
+            if attr == "next":
+                hexhash = HistoryArchiveState.next_output(level)
+            else:
+                hexhash = level.get(attr, "")
             if attr == "next" and not hexhash:
                 bl.levels[i].next = None
                 continue
@@ -197,18 +208,37 @@ class CatchupWork(WorkSequence):
         self.verified_headers = headers
         return State.SUCCESS
 
+    def _adopt_buckets_at(self, checkpoint: int,
+                          has: "HistoryArchiveState") -> bool:
+        cp_header = next(
+            (h for h in self.verified_headers
+             if h.header.ledgerSeq == checkpoint), None)
+        if cp_header is None:
+            return False
+        apply_buckets_catchup(self.lm, self.archive, has, cp_header)
+        return True
+
     def _apply(self):
         target = self._target()
         if self.config.mode == CatchupConfiguration.MINIMAL:
             # adopt the archive's checkpoint state wholesale
-            cp_header = next(
-                (h for h in self.verified_headers
-                 if h.header.ledgerSeq == self.has.current_ledger), None)
-            if cp_header is None:
+            if not self._adopt_buckets_at(self.has.current_ledger,
+                                          self.has):
                 return State.FAILURE
-            apply_buckets_catchup(self.lm, self.archive, self.has,
-                                  cp_header)
             return State.SUCCESS
+        if self.config.mode == CatchupConfiguration.RECENT:
+            # buckets to (target - count), then replay the recent window
+            # (reference CATCHUP_RECENT: verifiable recent history
+            # without full replay)
+            first_replayed = max(1, target - max(0, self.config.count))
+            # adopt at the checkpoint ENDING before the replay window so
+            # at least `count` ledgers are replayed
+            cp0 = checkpoint_containing(first_replayed) - \
+                CHECKPOINT_FREQUENCY
+            if cp0 >= 63 and cp0 > self.lm.ledger_seq:
+                has0 = HistoryManager.get_has(self.archive, cp0)
+                if has0 is None or not self._adopt_buckets_at(cp0, has0):
+                    return State.FAILURE
         cp = checkpoint_containing(self.lm.ledger_seq + 1)
         while self.lm.ledger_seq < target:
             replay_checkpoint(self.lm, self.archive, cp, up_to=target)

@@ -186,13 +186,25 @@ class HistoryArchiveState:
         return cls(d["currentLedger"], d.get("networkPassphrase", ""),
                    d["currentBuckets"])
 
+    @staticmethod
+    def next_output(lev: Dict) -> str:
+        """Hex hash of a level's pending merge, '' when none. Accepts
+        both the canonical FutureBucket object form
+        ({"state":0} / {"state":1,"output":hex}) and a legacy bare hex
+        string, so real stellar-core HAS files parse."""
+        nxt = lev.get("next", "")
+        if isinstance(nxt, dict):
+            return nxt.get("output", "") if nxt.get("state") else ""
+        return nxt
+
     def all_bucket_hashes(self) -> List[str]:
         out = []
         for lev in self.bucket_hashes:
             out.append(lev["curr"])
             out.append(lev["snap"])
-            if lev.get("next"):
-                out.append(lev["next"])
+            nxt = self.next_output(lev)
+            if nxt:
+                out.append(nxt)
         return out
 
 
@@ -275,10 +287,12 @@ class HistoryManager:
                 # the state sequence, so the HAS must carry it (the
                 # reference stores the FutureBucket state the same way)
                 nxt = lev.next
+                # FutureBucket JSON form, as real archives encode it
                 bucket_hashes.append({
                     "curr": lev.curr.hash.hex(),
                     "snap": lev.snap.hash.hex(),
-                    "next": nxt.hash.hex() if nxt is not None else "",
+                    "next": ({"state": 1, "output": nxt.hash.hex()}
+                             if nxt is not None else {"state": 0}),
                 })
                 for b in (lev.curr, lev.snap, nxt):
                     if b is not None and not b.is_empty():
@@ -303,6 +317,15 @@ class HistoryManager:
     @staticmethod
     def get_root_has(archive: FileArchive) -> Optional[HistoryArchiveState]:
         raw = archive.get(".well-known/stellar-history.json")
+        return None if raw is None else \
+            HistoryArchiveState.from_json(raw.decode())
+
+    @staticmethod
+    def get_has(archive: FileArchive, checkpoint: int
+                ) -> Optional[HistoryArchiveState]:
+        """The per-checkpoint HAS manifest (reference layered
+        ``history/xx/yy/zz/history-XXXXXXXX.json``)."""
+        raw = archive.get(_layered_path("history", checkpoint, "json"))
         return None if raw is None else \
             HistoryArchiveState.from_json(raw.decode())
 

@@ -79,10 +79,15 @@ def cmd_catchup(args) -> int:
     to_ledger, _, mode = args.spec.partition("/")
     app = Application(cfg, clock=VirtualClock(VIRTUAL_TIME))
     ws = WorkScheduler(app.clock)
-    conf = CatchupConfiguration(
-        int(to_ledger) if to_ledger != "current" else 0,
-        CatchupConfiguration.MINIMAL if mode == "minimal"
-        else CatchupConfiguration.COMPLETE)
+    target = int(to_ledger) if to_ledger != "current" else 0
+    if mode == "minimal":
+        conf = CatchupConfiguration(target, CatchupConfiguration.MINIMAL)
+    elif mode.isdigit():
+        # <ledger>/<count>: CATCHUP_RECENT — buckets + last N replayed
+        conf = CatchupConfiguration(target, CatchupConfiguration.RECENT,
+                                    count=int(mode))
+    else:
+        conf = CatchupConfiguration(target, CatchupConfiguration.COMPLETE)
     work = CatchupWork(app.lm, FileArchive(cfg.HISTORY_ARCHIVES[0]), conf)
     ws.schedule(work)
     ws.run_until_done(timeout=3600)
