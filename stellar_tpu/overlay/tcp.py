@@ -1,7 +1,11 @@
 """TCP peer transport (reference ``src/overlay/TCPPeer.cpp`` +
-``PeerDoor.cpp``): length-prefixed AuthenticatedMessage frames over
-non-blocking sockets, polled from the node's crank loop — the same
-single-threaded-I/O discipline as the reference's asio handlers.
+``PeerDoor.cpp`` + the OverlayManager connection maintainer):
+length-prefixed AuthenticatedMessage frames over non-blocking sockets,
+driven by OS readiness (``selectors``) from the node's crank loop — the
+same single-threaded-I/O discipline as the reference's asio handlers,
+without per-peer syscalls on idle ticks. Outbound connections come from
+the PeerManager address book and report successes/failures back into
+its backoff state.
 """
 
 from __future__ import annotations
@@ -20,12 +24,17 @@ MAX_MESSAGE_SIZE = 0x1000000  # 16 MiB frame cap (reference MAX_MESSAGE_SIZE)
 
 
 class TCPPeer(Peer):
-    def __init__(self, app, we_called: bool, sock: socket.socket):
+    def __init__(self, app, we_called: bool, sock: socket.socket,
+                 address=None):
         super().__init__(app, we_called)
         self.sock = sock
         self.sock.setblocking(False)
+        self.address = address  # (host, port) for outbound book-keeping
         self._rx = bytearray()
         self._txq = bytearray()
+
+    def wants_write(self) -> bool:
+        return bool(self._txq)
 
     def send_bytes(self, raw: bytes):
         self._txq += struct.pack(">I", len(raw)) + raw
@@ -101,44 +110,123 @@ class PeerDoor:
         self.listener.close()
 
 
+RECONNECT_PERIOD = 2.0  # seconds between connection-maintainer passes
+
+
 class TCPDriver:
-    """Polls sockets as a recurring clock action (the asio io_context
-    role). One per node process."""
+    """Readiness-driven socket pump (the asio io_context role): a
+    ``selectors`` registry watches the listener + every peer socket;
+    poll() touches only ready sockets. One per node process."""
 
     def __init__(self, app, listen_port: int = 0):
         self.app = app
         self.door = PeerDoor(app, listen_port)
         self.peers: list = []
+        self.sel = selectors.DefaultSelector()
+        self.sel.register(self.door.listener, selectors.EVENT_READ, None)
+        self._masks: Dict[socket.socket, int] = {}
         self._pump_armed = False
+        self._last_maintain = 0.0
         self.arm()
 
     def connect(self, host: str, port: int) -> TCPPeer:
+        self.app.overlay.peer_manager.ensure_exists(host, port)
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setblocking(False)
         try:
             sock.connect((host, port))
         except BlockingIOError:
             pass
-        peer = TCPPeer(self.app, we_called=True, sock=sock)
+        except OSError:
+            self.app.overlay.peer_manager.on_connection_failure(
+                host, port, self.app.clock.now())
+            raise
+        peer = TCPPeer(self.app, we_called=True, sock=sock,
+                       address=(host, port))
         self.app.overlay.add_pending(peer)
         self.peers.append(peer)
+        self._register(peer)
         # handshake begins once the socket is writable; send eagerly
         # (bytes queue until the connect completes)
         peer.start_handshake()
         return peer
 
+    # ---------------- selector bookkeeping ----------------
+
+    def _register(self, peer: TCPPeer):
+        mask = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if peer.wants_write() else 0)
+        self.sel.register(peer.sock, mask, peer)
+        self._masks[peer.sock] = mask
+
+    def _refresh_mask(self, peer: TCPPeer):
+        want = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if peer.wants_write() else 0)
+        if self._masks.get(peer.sock) != want:
+            try:
+                self.sel.modify(peer.sock, want, peer)
+                self._masks[peer.sock] = want
+            except KeyError:
+                pass
+
+    def _unregister(self, peer: TCPPeer):
+        try:
+            self.sel.unregister(peer.sock)
+        except (KeyError, ValueError):
+            pass
+        self._masks.pop(peer.sock, None)
+
+    # ---------------- the pump ----------------
+
     def poll(self):
-        newp = self.door.try_accept()
-        if newp is not None:
-            self.peers.append(newp)
         from stellar_tpu.overlay.peer import PEER_STATE
+        for key, events in self.sel.select(timeout=0):
+            peer = key.data
+            if peer is None:
+                newp = self.door.try_accept()
+                if newp is not None:
+                    self.peers.append(newp)
+                    self._register(newp)
+                continue
+            if events & selectors.EVENT_WRITE:
+                peer._try_flush()
+            if events & selectors.EVENT_READ:
+                peer.on_readable()
         for p in list(self.peers):
             if p.state == PEER_STATE.CLOSING:
+                self._unregister(p)
+                if p.we_called and p.address and not p.is_authenticated():
+                    self.app.overlay.peer_manager.on_connection_failure(
+                        *p.address, now=self.app.clock.now())
                 p.close()
                 self.peers.remove(p)
+            else:
+                self._refresh_mask(p)
+        self._maybe_maintain()
+
+    def _maybe_maintain(self):
+        """Connection maintainer (reference OverlayManager tick): top up
+        outbound connections from the address book, respecting
+        backoff."""
+        now = self.app.clock.now()
+        if now - self._last_maintain < RECONNECT_PERIOD:
+            return
+        self._last_maintain = now
+        ov = self.app.overlay
+        target = getattr(self.app.config, "TARGET_PEER_CONNECTIONS", 8) \
+            if getattr(self.app, "config", None) else 8
+        have = ov.authenticated_count() + len(ov.pending_peers)
+        if have >= target:
+            return
+        connected = {p.address for p in self.peers if p.address}
+        for rec in ov.peer_manager.random_peers(target - have, now=now):
+            addr = (rec.host, rec.port)
+            if addr in connected:
                 continue
-            p.on_readable()
-            p._try_flush()
+            try:
+                self.connect(rec.host, rec.port)
+            except OSError:
+                continue
 
     def arm(self):
         """Keep polling scheduled off the clock (REAL_TIME cranks)."""
@@ -161,7 +249,13 @@ class TCPDriver:
         self._pump_armed = False
         if hasattr(self, "_timer"):
             self._timer.cancel()
+        try:
+            self.sel.unregister(self.door.listener)
+        except (KeyError, ValueError):
+            pass
         self.door.close()
         for p in self.peers:
+            self._unregister(p)
             p.close()
         self.peers.clear()
+        self.sel.close()

@@ -247,3 +247,69 @@ def test_tcp_two_nodes_consensus():
             a.clock.post_to_main(lambda: None)
         for d in drivers:
             d.close()
+
+
+def test_tcp_reconnect_via_peer_book():
+    """A dropped TCP connection heals automatically: the connection
+    maintainer redials from the PeerManager address book (reference
+    OverlayManager tick + RandomPeerSource)."""
+    import threading
+    import time as _time
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.overlay.tcp import TCPDriver
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.utils.timer import REAL_TIME, VirtualClock
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    ka, kb = keypair("rc-a"), keypair("rc-b")
+    qset = SCPQuorumSet(
+        threshold=2,
+        validators=[make_node_id(ka.public_key.raw),
+                    make_node_id(kb.public_key.raw)],
+        innerSets=[])
+    apps, drivers = [], []
+    for k in (ka, kb):
+        cfg = Config()
+        cfg.NODE_SEED = k
+        cfg.QUORUM_SET = qset
+        cfg.TARGET_PEER_CONNECTIONS = 1
+        app = Application(cfg, clock=VirtualClock(REAL_TIME))
+        apps.append(app)
+        drivers.append(TCPDriver(app, listen_port=0))
+    drivers[0].connect("127.0.0.1", drivers[1].door.port)
+
+    stop = threading.Event()
+
+    def crank(app):
+        while not stop.is_set():
+            app.crank(block=True)
+    threads = [threading.Thread(target=crank, args=(a,), daemon=True)
+               for a in apps]
+    for t in threads:
+        t.start()
+    try:
+        def wait_connected(timeout=20):
+            deadline = _time.time() + timeout
+            while _time.time() < deadline:
+                if all(a.overlay.authenticated_count() == 1
+                       for a in apps):
+                    return True
+                _time.sleep(0.05)
+            return False
+        assert wait_connected()
+        # sever the link from node 0's side
+        done = threading.Event()
+
+        def sever():
+            for p in list(apps[0].overlay.peers):
+                p.drop("test sever")
+            done.set()
+        apps[0].clock.post_to_main(sever)
+        assert done.wait(5)
+        # ...the maintainer redials within a few RECONNECT_PERIODs
+        assert wait_connected(timeout=30)
+    finally:
+        stop.set()
+        for d in drivers:
+            d.close()
