@@ -132,6 +132,17 @@ class Application:
             if txset is not None:
                 self.history.ledger_closed(close_result, txset,
                                            self.lm.bucket_list)
+        if self.database is not None:
+            # HerderPersistence: the slot's SCP messages into scphistory
+            # (reference HerderPersistenceImpl::saveSCPHistory)
+            from stellar_tpu.xdr.runtime import to_bytes
+            from stellar_tpu.xdr.scp import SCPEnvelope
+            rows = [(env.statement.nodeID.value,
+                     to_bytes(SCPEnvelope, env))
+                    for env in self.herder.scp.get_current_state(
+                        slot_index)]
+            if rows:
+                self.database.store_scp_history(slot_index, rows)
         self.overlay.ledger_closed(slot_index)
 
     # ---------------- operator surface ----------------

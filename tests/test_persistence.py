@@ -168,3 +168,27 @@ def test_network_restart_rejoins_without_catchup(tmp_path):
     target = max(a.lm.ledger_seq for a in apps2) + 3
     assert sim2.crank_until_ledger(target, timeout=120)
     assert sim2.in_consensus()
+
+
+def test_scp_history_persisted(tmp_path):
+    """Externalized slots leave their SCP envelopes in scphistory
+    (reference HerderPersistence)."""
+    sim = _two_node_sim(tmp_path, restart=False)
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 1 for x in apps),
+        30)
+    assert sim.crank_until_ledger(4, timeout=120)
+    for a in apps:
+        rows = list(a.database.conn.execute(
+            "SELECT COUNT(*), MAX(ledgerseq) FROM scphistory"))
+        count, max_seq = rows[0]
+        assert count > 0 and max_seq >= 4
+        # envelopes decode
+        from stellar_tpu.xdr.runtime import from_bytes
+        from stellar_tpu.xdr.scp import SCPEnvelope
+        for (env,) in a.database.conn.execute(
+                "SELECT envelope FROM scphistory LIMIT 5"):
+            from_bytes(SCPEnvelope, env)
+        a.database.close()
