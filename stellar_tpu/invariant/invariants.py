@@ -205,15 +205,23 @@ class LiabilitiesMatchOffers(Invariant):
 
     @staticmethod
     def _offer_liab(entry):
+        from stellar_tpu.tx.asset_utils import get_issuer, is_native
         from stellar_tpu.tx.offer_exchange import offer_liabilities
         from stellar_tpu.xdr.runtime import to_bytes
         from stellar_tpu.xdr.types import Asset
         o = entry.data.value
         selling, buying = offer_liabilities(o.price, o.amount)
-        return {
-            (o.sellerID.value, to_bytes(Asset, o.selling)): (selling, 0),
-            (o.sellerID.value, to_bytes(Asset, o.buying)): (0, buying),
-        }
+        out = {}
+        # an issuer's offers in its own asset carry no tracked
+        # liabilities (no trustline exists; reference
+        # addSellingLiabilities/addBuyingLiabilities issuer arm)
+        for asset, pair in ((o.selling, (selling, 0)),
+                            (o.buying, (0, buying))):
+            if not is_native(asset) and \
+                    get_issuer(asset) == o.sellerID:
+                continue
+            out[(o.sellerID.value, to_bytes(Asset, asset))] = pair
+        return out
 
     def check_on_operation_apply(self, operation, result, delta, header):
         declared: Dict = {}

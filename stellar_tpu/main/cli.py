@@ -130,6 +130,15 @@ def cmd_self_check(args) -> int:
     return 0
 
 
+def cmd_fuzz(args) -> int:
+    """Deterministic fuzz campaign (reference ``fuzz`` CLI +
+    FuzzerImpl tx/overlay modes)."""
+    from stellar_tpu.main.fuzz import run_fuzz
+    out = run_fuzz(args.mode, args.iterations, args.seed)
+    print(json.dumps(out))
+    return 1 if out["crashes"] else 0
+
+
 def cmd_new_db(args) -> int:
     """(Re)initialize the node database (reference ``new-db``)."""
     import os
@@ -311,6 +320,11 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_print_xdr)
     sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
     sub.add_parser("new-db").set_defaults(fn=cmd_new_db)
+    sp = sub.add_parser("fuzz")
+    sp.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    sp.add_argument("--iterations", type=int, default=1000)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_fuzz)
     sp = sub.add_parser("dump-ledger")
     sp.add_argument("--limit", type=int, default=1000)
     sp.set_defaults(fn=cmd_dump_ledger)
