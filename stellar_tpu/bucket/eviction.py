@@ -1,0 +1,60 @@
+"""Eviction scan (reference ``BucketManager.h:299-308`` + the
+background eviction thread): every close scans a bounded window of
+Soroban state and evicts expired TEMPORARY entries — the entry and its
+TTL row become DEADENTRYs in that ledger's bucket batch. Persistent
+entries are never evicted here (they are archived, i.e. stay behind
+their expired TTL until restored).
+
+The scan cursor rotates through the key space so large states amortize
+across closes (the reference's incremental scan over bucket levels
+plays the same role)."""
+
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["EvictionScanner"]
+
+
+class EvictionScanner:
+    def __init__(self, max_entries_per_scan: int = 100):
+        self.max_entries = max_entries_per_scan
+        self._cursor: bytes = b""
+
+    def scan(self, ltx, ledger_seq: int) -> List:
+        """Erase expired TEMPORARY entries via ``ltx``; returns the
+        evicted LedgerKeys (already erased)."""
+        from stellar_tpu.soroban.host import ttl_key_for
+        from stellar_tpu.xdr.contract import ContractDataDurability
+        from stellar_tpu.xdr.runtime import from_bytes
+        from stellar_tpu.xdr.types import LedgerEntryType, LedgerKey
+
+        data_keys = sorted(ltx._all_keys_of_type(
+            LedgerEntryType.CONTRACT_DATA))
+        if not data_keys:
+            return []
+        # rotate: start after the cursor, wrap around
+        start = 0
+        for i, kb in enumerate(data_keys):
+            if kb > self._cursor:
+                start = i
+                break
+        window = (data_keys[start:] + data_keys[:start])[:self.max_entries]
+        evicted = []
+        for kb in window:
+            self._cursor = kb
+            data_key = from_bytes(LedgerKey, kb)
+            entry = ltx.load_without_record(data_key)
+            if entry is None or entry.data.value.durability != \
+                    ContractDataDurability.TEMPORARY:
+                continue
+            tk = ttl_key_for(data_key)
+            ttl_entry = ltx.load_without_record(tk)
+            if ttl_entry is not None and \
+                    ttl_entry.data.value.liveUntilLedgerSeq >= ledger_seq:
+                continue
+            ltx.erase(data_key)
+            if ttl_entry is not None:
+                ltx.erase(tk)
+            evicted.append(data_key)
+        return evicted

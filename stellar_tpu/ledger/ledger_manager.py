@@ -112,6 +112,8 @@ class LedgerManager:
                 max(1, hdr.ledgerSeq), hdr.ledgerVersion, seeded, [], [])
         self._lcl_hash = ledger_header_hash(self.root.header())
         self.close_meta_stream: List = []  # downstream consumers hook
+        from stellar_tpu.bucket.eviction import EvictionScanner
+        self.eviction_scanner = EvictionScanner()
 
     # ---------------- LCL accessors ----------------
 
@@ -190,6 +192,11 @@ class LedgerManager:
                 logging.getLogger("stellar_tpu.ledger").warning(
                     "skipping malformed/unsupported upgrade at ledger "
                     "%d: %s", lcd.ledger_seq, e)
+
+        # eviction scan: expired TEMPORARY Soroban entries leave the
+        # live state this close (reference startBackgroundEvictionScan,
+        # LedgerManagerImpl.cpp:1072-1077)
+        self.eviction_scanner.scan(ltx, lcd.ledger_seq)
 
         # classify the close's entry delta and stamp lastModified —
         # this is what the bucket list (and meta) see

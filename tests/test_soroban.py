@@ -370,3 +370,57 @@ def test_extend_and_restore_ttl(env):
     ttl2 = root.store.get(key_bytes(ttl_key_for(ck))) \
         .data.value.liveUntilLedgerSeq
     assert ttl2 >= cfg.min_persistent_ttl
+
+
+def test_eviction_scan_removes_expired_temporary(env):
+    """Expired TEMPORARY entries are evicted by the close-time scan;
+    PERSISTENT entries survive (archived, not evicted)."""
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.soroban.host import ttl_key_for
+    from stellar_tpu.xdr.contract import ContractDataEntry
+    from stellar_tpu.xdr.types import ExtensionPoint, LedgerEntry
+    from stellar_tpu.xdr.types import LedgerEntryType as LET
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    root, a = env
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    addr = scaddress_contract(b"\x77" * 32)
+
+    def put_entry(key_sym, durability, live_until):
+        cd = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=addr, key=sym(key_sym),
+            durability=durability, val=u32(1))
+        le = LedgerEntry(
+            lastModifiedLedgerSeq=1,
+            data=LedgerEntry._types[1].make(LET.CONTRACT_DATA, cd),
+            ext=LedgerEntry._types[2].make(0))
+        lk = contract_data_key(addr, sym(key_sym), durability)
+        from stellar_tpu.xdr.types import TTLEntry
+        tk = ttl_key_for(lk)
+        with LedgerTxn(lm.root) as ltx:
+            ltx.create(le).deactivate()
+            ltx.create(LedgerEntry(
+                lastModifiedLedgerSeq=1,
+                data=LedgerEntry._types[1].make(LET.TTL, TTLEntry(
+                    keyHash=tk.value.keyHash,
+                    liveUntilLedgerSeq=live_until)),
+                ext=LedgerEntry._types[2].make(0))).deactivate()
+            ltx.commit()
+        return lk, tk
+
+    temp_lk, temp_tk = put_entry("t", ContractDataDurability.TEMPORARY, 2)
+    pers_lk, pers_tk = put_entry("p", ContractDataDurability.PERSISTENT, 2)
+    live_lk, _ = put_entry("l", ContractDataDurability.TEMPORARY, 10**6)
+
+    txset, _ = make_tx_set_from_transactions(
+        [], lm.last_closed_header, lm.last_closed_hash)
+    lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lm.last_closed_header.scpValue.closeTime + 5))
+    store = lm.root.store
+    assert store.get(key_bytes(temp_lk)) is None      # evicted
+    assert store.get(key_bytes(temp_tk)) is None
+    assert store.get(key_bytes(pers_lk)) is not None  # archived only
+    assert store.get(key_bytes(live_lk)) is not None  # still live
