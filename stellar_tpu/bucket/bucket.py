@@ -58,7 +58,7 @@ class Bucket:
     """Immutable sorted bucket. Empty bucket hash is the zero hash
     (reference: an empty bucket has no file and hash 0)."""
 
-    __slots__ = ("entries", "_hash", "_index")
+    __slots__ = ("entries", "_hash", "_index", "_size")
 
     def __init__(self, entries: List):
         self.entries = entries
@@ -81,8 +81,20 @@ class Bucket:
 
     def serialize(self) -> bytes:
         from stellar_tpu.utils import native
-        return native.join_frames(
+        raw = native.join_frames(
             [to_bytes(BucketEntry, e) for e in self.entries])
+        self._size = len(raw)
+        return raw
+
+    @property
+    def size_bytes(self) -> int:
+        """Serialized size (cached; an immutable bucket never changes)."""
+        size = getattr(self, "_size", None)
+        if size is None:
+            size = sum(4 + len(to_bytes(BucketEntry, e))
+                       for e in self.entries)
+            self._size = size
+        return size
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "Bucket":

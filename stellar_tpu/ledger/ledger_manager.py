@@ -182,11 +182,19 @@ class LedgerManager:
         with ltx.load_header() as hh:
             hh.header.txSetResultHash = tx_set_result_hash
 
+        upgrade_metas = []
         for raw in lcd.upgrades:
             # bad/unsupported upgrades are logged and skipped, never
             # abort the close (reference LedgerManagerImpl.cpp:955-996)
             try:
-                self._apply_upgrade(ltx, raw)
+                up_ltx = LedgerTxn(ltx)
+                try:
+                    self._apply_upgrade(up_ltx, raw)
+                    upgrade_metas.append((raw, up_ltx.get_changes()))
+                    up_ltx.commit()
+                except Exception:
+                    up_ltx.rollback()
+                    raise
             except Exception as e:
                 import logging
                 logging.getLogger("stellar_tpu.ledger").warning(
@@ -196,7 +204,7 @@ class LedgerManager:
         # eviction scan: expired TEMPORARY Soroban entries leave the
         # live state this close (reference startBackgroundEvictionScan,
         # LedgerManagerImpl.cpp:1072-1077)
-        self.eviction_scanner.scan(ltx, lcd.ledger_seq)
+        evicted_keys = self.eviction_scanner.scan(ltx, lcd.ledger_seq)
 
         # classify the close's entry delta and stamp lastModified —
         # this is what the bucket list (and meta) see
@@ -243,7 +251,61 @@ class LedgerManager:
 
         result.header = header
         result.header_hash = self._lcl_hash
+
+        if self.close_meta_stream:
+            meta = self._build_close_meta(
+                lcd, header, result, result_pairs, apply_order,
+                fee_results, upgrade_metas, evicted_keys)
+            for consumer in self.close_meta_stream:
+                consumer(meta)
         return result
+
+    def _build_close_meta(self, lcd, header, result, result_pairs,
+                          apply_order, fee_results, upgrade_metas,
+                          evicted_keys):
+        """One LedgerCloseMeta (V1) for downstream consumers (reference
+        ``LedgerCloseMetaFrame`` + ``docs/integration.md:24-38``)."""
+        from stellar_tpu.xdr.ledger import (
+            LedgerCloseMeta, LedgerCloseMetaExt, LedgerCloseMetaV1,
+            LedgerHeaderHistoryEntry, LedgerUpgrade, OperationMeta,
+            TransactionMeta, TransactionMetaV3, TransactionResultMeta,
+            UpgradeEntryMeta,
+        )
+        from stellar_tpu.xdr.types import ExtensionPoint
+        tx_processing = []
+        for f, pair, res, meta in zip(
+                apply_order, result_pairs, result.tx_results,
+                result.tx_metas):
+            v3 = TransactionMetaV3(
+                ext=ExtensionPoint.make(0),
+                txChangesBefore=list(meta.tx_changes_before),
+                operations=[OperationMeta(changes=c)
+                            for c in meta.operations],
+                txChangesAfter=list(meta.tx_changes_after),
+                sorobanMeta=None)
+            fee_changes = getattr(fee_results[id(f)], "fee_changes", [])
+            tx_processing.append(TransactionResultMeta(
+                result=pair, feeProcessing=list(fee_changes),
+                txApplyProcessing=TransactionMeta.make(3, v3)))
+        ups = [UpgradeEntryMeta(
+            upgrade=raw if not isinstance(raw, (bytes, bytearray))
+            else from_bytes(LedgerUpgrade, bytes(raw)),
+            changes=changes) for raw, changes in upgrade_metas]
+        bl_size = sum(b.size_bytes for b in self.bucket_list.all_buckets()) \
+            if self.bucket_list is not None else 0
+        v1 = LedgerCloseMetaV1(
+            ext=LedgerCloseMetaExt.make(0),
+            ledgerHeader=LedgerHeaderHistoryEntry(
+                hash=self._lcl_hash, header=header,
+                ext=LedgerHeaderHistoryEntry._types[2].make(0)),
+            txSet=lcd.tx_set.xdr,
+            txProcessing=tx_processing,
+            upgradesProcessing=ups,
+            scpInfo=[],
+            totalByteSizeOfBucketList=bl_size,
+            evictedTemporaryLedgerKeys=list(evicted_keys),
+            evictedPersistentLedgerEntries=[])
+        return LedgerCloseMeta.make(1, v1)
 
     # ---------------- restart ----------------
 

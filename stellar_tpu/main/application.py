@@ -80,6 +80,9 @@ class Application:
                 config.NETWORK_PASSPHRASE)
         from stellar_tpu.process import ProcessManager
         self.process_manager = ProcessManager()
+        self._meta_stream_file = None
+        if config.METADATA_OUTPUT_STREAM:
+            self._open_meta_stream(config.METADATA_OUTPUT_STREAM)
         self.herder.on_externalized = self._on_externalized
         if config.INVARIANT_CHECKS:
             from stellar_tpu.invariant import (
@@ -88,6 +91,25 @@ class Application:
             set_active_manager(
                 InvariantManager(config.INVARIANT_CHECKS))
         self._started = False
+
+    def _open_meta_stream(self, spec: str):
+        """Stream framed LedgerCloseMeta XDR per close (reference
+        METADATA_OUTPUT_STREAM, docs/integration.md:24-38)."""
+        import os
+        import struct
+        if spec.startswith("fd:"):
+            self._meta_stream_file = os.fdopen(int(spec[3:]), "ab")
+        else:
+            self._meta_stream_file = open(spec, "ab")
+
+        def write_meta(meta):
+            from stellar_tpu.xdr.ledger import LedgerCloseMeta
+            from stellar_tpu.xdr.runtime import to_bytes
+            raw = to_bytes(LedgerCloseMeta, meta)
+            self._meta_stream_file.write(
+                struct.pack(">I", 0x80000000 | len(raw)) + raw)
+            self._meta_stream_file.flush()
+        self.lm.close_meta_stream.append(write_meta)
 
     # ---------------- lifecycle ----------------
 

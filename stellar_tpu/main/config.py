@@ -55,6 +55,9 @@ class Config:
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HTTP_PORT: int = 11626
     HTTP_QUERY_PORT: int = 0  # 0 disables the query server
+    # framed LedgerCloseMeta XDR per close (reference
+    # METADATA_OUTPUT_STREAM; "fd:N" or a file path)
+    METADATA_OUTPUT_STREAM: Optional[str] = None
     AUTOMATIC_MAINTENANCE_PERIOD: int = 14400  # seconds; 0 disables
     AUTOMATIC_MAINTENANCE_COUNT: int = 50_000
     CATCHUP_COMPLETE: bool = False
@@ -87,7 +90,8 @@ class Config:
             "PEER_FLOOD_READING_CAPACITY_BYTES",
             "FLOW_CONTROL_SEND_MORE_BATCH_SIZE",
             "FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES",
-            "HTTP_QUERY_PORT", "AUTOMATIC_MAINTENANCE_PERIOD",
+            "HTTP_QUERY_PORT", "METADATA_OUTPUT_STREAM",
+            "AUTOMATIC_MAINTENANCE_PERIOD",
             "AUTOMATIC_MAINTENANCE_COUNT", "CATCHUP_COMPLETE",
             "CATCHUP_RECENT",
         }

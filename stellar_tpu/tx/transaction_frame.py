@@ -441,6 +441,7 @@ class TransactionFrame:
                     acc.balance -= charged
                     header.feePool += charged
                 src.deactivate()
+            result.fee_changes = inner.get_changes()  # meta: feeProcessing
             inner.commit()
         self._fee_charged = result.fee_charged
         return result
@@ -772,6 +773,7 @@ class FeeBumpTransactionFrame:
                     acc.balance -= charged
                     header.feePool += charged
                 src.deactivate()
+            result.fee_changes = inner.get_changes()  # meta: feeProcessing
             inner.commit()
         self._fee_charged = result.fee_charged
         return result

@@ -1,0 +1,75 @@
+"""LedgerCloseMeta stream tests (reference LedgerCloseMetaFrame +
+METADATA_OUTPUT_STREAM, docs/integration.md): every close emits a
+decodable V1 meta carrying fee processing, per-op changes, upgrades,
+and eviction info."""
+
+import struct
+
+from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, payment_op, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.ledger import (
+    LedgerCloseMeta, LedgerUpgrade, LedgerUpgradeType,
+)
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+
+XLM = 10_000_000
+
+
+def test_close_meta_contents():
+    a, b = keypair("cm-a"), keypair("cm-b")
+    lm = LedgerManager(b"\x11" * 32, seed_root_with_accounts(
+        [(a, 1000 * XLM), (b, 1000 * XLM)]))
+    metas = []
+    lm.close_meta_stream.append(metas.append)
+    tx = make_tx(a, (1 << 32) + 1, [payment_op(b, 5 * XLM)],
+                 network_id=lm.network_id)
+    txset, _ = make_tx_set_from_transactions(
+        [tx], lm.last_closed_header, lm.last_closed_hash)
+    up = to_bytes(LedgerUpgrade, LedgerUpgrade.make(
+        LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 222))
+    lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lm.last_closed_header.scpValue.closeTime + 5, upgrades=[up]))
+    assert len(metas) == 1
+    meta = metas[0]
+    assert meta.arm == 1
+    v1 = meta.value
+    assert v1.ledgerHeader.header.ledgerSeq == lm.ledger_seq
+    assert v1.ledgerHeader.hash == lm.last_closed_hash
+    assert len(v1.txProcessing) == 1
+    trm = v1.txProcessing[0]
+    assert trm.result.transactionHash == tx.contents_hash()
+    assert trm.feeProcessing  # the fee debit shows up
+    assert len(trm.txApplyProcessing.value.operations) == 1
+    assert len(v1.upgradesProcessing) == 1
+    assert v1.upgradesProcessing[0].upgrade.value == 222
+    assert v1.totalByteSizeOfBucketList > 0
+    # round-trips on the wire
+    raw = to_bytes(LedgerCloseMeta, meta)
+    again = from_bytes(LedgerCloseMeta, raw)
+    assert to_bytes(LedgerCloseMeta, again) == raw
+
+
+def test_meta_stream_file(tmp_path):
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.utils.timer import REAL_TIME, VirtualClock
+    path = tmp_path / "meta.xdr"
+    cfg = Config()
+    cfg.NODE_SEED = keypair("cm-node")
+    cfg.MANUAL_CLOSE = True
+    cfg.METADATA_OUTPUT_STREAM = str(path)
+    app = Application(cfg, clock=VirtualClock(REAL_TIME))
+    txset, _ = make_tx_set_from_transactions(
+        [], app.lm.last_closed_header, app.lm.last_closed_hash)
+    app.lm.close_ledger(LedgerCloseData(
+        app.lm.ledger_seq + 1, txset,
+        app.lm.last_closed_header.scpValue.closeTime + 5))
+    raw = path.read_bytes()
+    (marker,) = struct.unpack_from(">I", raw, 0)
+    n = marker & 0x7FFFFFFF
+    meta = from_bytes(LedgerCloseMeta, raw[4:4 + n])
+    assert meta.value.ledgerHeader.header.ledgerSeq == app.lm.ledger_seq
