@@ -196,6 +196,24 @@ class CommandHandler:
             }
         return self._on_main(apply_)
 
+    def cmd_start_survey_collecting(self, params):
+        return self._on_main(
+            self.app.overlay.survey_manager.start_collecting)
+
+    def cmd_stop_survey_collecting(self, params):
+        return self._on_main(
+            self.app.overlay.survey_manager.stop_collecting)
+
+    def cmd_survey_topology_timesliced(self, params):
+        from stellar_tpu.crypto import strkey
+        node = strkey.decode_account(params["node"][0])
+        return self._on_main(
+            lambda: self.app.overlay.survey_manager.request_node(node))
+
+    def cmd_get_survey_result(self, params):
+        return self._on_main(
+            lambda: dict(self.app.overlay.survey_manager.results))
+
     def cmd_maintenance(self, params):
         count = int(params.get("count", ["50000"])[0])
 
@@ -232,6 +250,10 @@ class CommandHandler:
         "droppeer": cmd_droppeer, "upgrades": cmd_upgrades,
         "maintenance": cmd_maintenance,
         "getledgerentryraw": cmd_getledgerentryraw,
+        "startsurveycollecting": cmd_start_survey_collecting,
+        "stopsurveycollecting": cmd_stop_survey_collecting,
+        "surveytopologytimesliced": cmd_survey_topology_timesliced,
+        "getsurveyresult": cmd_get_survey_result,
     }
 
     def _make_handler(outer_self):

@@ -68,6 +68,8 @@ class OverlayManager:
         self.ban_manager = BanManager(db)
         self.tx_adverts = TxAdverts()
         self.tx_demands = TxDemandsManager()
+        from stellar_tpu.overlay.survey_manager import SurveyManager
+        self.survey_manager = SurveyManager(app)
         self._wire_herder()
 
     # ---------------- herder wiring ----------------
@@ -90,10 +92,14 @@ class OverlayManager:
             self.pending_peers.remove(peer)
         if peer not in self.peers:
             self.peers.append(peer)
+            if self.survey_manager.collecting_nonce is not None:
+                self.survey_manager.added_peers += 1
 
     def peer_dropped(self, peer, reason: str):
         if peer in self.peers:
             self.peers.remove(peer)
+            if self.survey_manager.collecting_nonce is not None:
+                self.survey_manager.dropped_peers += 1
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
         self.tx_adverts.forget_peer(peer)
@@ -236,13 +242,19 @@ class OverlayManager:
                 for env in slot.get_current_state():
                     peer.send(StellarMessage.make(
                         MessageType.SCP_MESSAGE, env))
-        # DONT_HAVE / PEERS / surveys: tracked by fetchers (round 2)
+        elif t in (MessageType.TIME_SLICED_SURVEY_START_COLLECTING,
+                   MessageType.TIME_SLICED_SURVEY_STOP_COLLECTING,
+                   MessageType.TIME_SLICED_SURVEY_REQUEST,
+                   MessageType.TIME_SLICED_SURVEY_RESPONSE):
+            if self.survey_manager.handle_message(msg, peer):
+                self._flood(msg, from_peer=peer)
 
     def ledger_closed(self, ledger_seq: int):
         self.floodgate.clear_below(ledger_seq)
         peers = self._peers_by_id()
         self.tx_adverts.flush(peers, force=True)
         self.tx_demands.age_and_retry(self.tx_adverts, peers)
+        self.survey_manager.ledger_closed()
 
     # ---------------- operator surface ----------------
 

@@ -175,6 +175,9 @@ class Peer:
         raise NotImplementedError
 
     def receive_bytes(self, raw: bytes):
+        sm = getattr(self.app.overlay, "survey_manager", None)
+        if sm is not None:
+            sm.note_traffic(self, read=len(raw))
         try:
             am = from_bytes(AuthenticatedMessage, raw)
         except Exception:
@@ -223,6 +226,9 @@ class Peer:
         raw = to_bytes(AuthenticatedMessage, am)
         if msg.arm in FLOOD_TYPES and self.state == PEER_STATE.GOT_AUTH:
             self.flow.note_sent(len(raw))
+        sm = getattr(self.app.overlay, "survey_manager", None)
+        if sm is not None:
+            sm.note_traffic(self, written=len(raw))
         self.send_bytes(raw)
 
     def _recv_authenticated(self, am: AuthenticatedMessageV0):

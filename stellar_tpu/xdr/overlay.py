@@ -9,7 +9,7 @@ from stellar_tpu.xdr.ledger import (
     GeneralizedTransactionSet, TransactionSet,
 )
 from stellar_tpu.xdr.runtime import (
-    Enum, FixedArray, Int32, Opaque, Struct, Uint32, Uint64, Union,
+    Bool, Enum, FixedArray, Int32, Opaque, Struct, Uint32, Uint64, Union,
     VarArray, VarOpaque, Void, XdrString,
 )
 from stellar_tpu.xdr.scp import SCPEnvelope, SCPQuorumSet
@@ -95,6 +95,111 @@ class FloodDemand(Struct):
     FIELDS = [("txHashes", TxDemandVector)]
 
 
+# ---------------- time-sliced surveys ----------------
+
+SurveyMessageCommandType = Enum("SurveyMessageCommandType", {
+    "SURVEY_TOPOLOGY": 0,
+    "TIME_SLICED_SURVEY_TOPOLOGY": 1,
+})
+
+EncryptedBody = VarOpaque(64000)
+
+
+class TimeSlicedSurveyStartCollectingMessage(Struct):
+    FIELDS = [("surveyorID", NodeID),
+              ("nonce", Uint32),
+              ("ledgerNum", Uint32)]
+
+
+class SignedTimeSlicedSurveyStartCollectingMessage(Struct):
+    FIELDS = [("signature", Signature),
+              ("startCollecting", TimeSlicedSurveyStartCollectingMessage)]
+
+
+class TimeSlicedSurveyStopCollectingMessage(Struct):
+    FIELDS = [("surveyorID", NodeID),
+              ("nonce", Uint32),
+              ("ledgerNum", Uint32)]
+
+
+class SignedTimeSlicedSurveyStopCollectingMessage(Struct):
+    FIELDS = [("signature", Signature),
+              ("stopCollecting", TimeSlicedSurveyStopCollectingMessage)]
+
+
+class SurveyRequestMessage(Struct):
+    FIELDS = [("surveyorPeerID", NodeID),
+              ("surveyedPeerID", NodeID),
+              ("ledgerNum", Uint32),
+              ("encryptionKey", Curve25519Public),
+              ("commandType", SurveyMessageCommandType)]
+
+
+class TimeSlicedSurveyRequestMessage(Struct):
+    FIELDS = [("request", SurveyRequestMessage),
+              ("nonce", Uint32),
+              ("inboundPeersIndex", Uint32),
+              ("outboundPeersIndex", Uint32)]
+
+
+class SignedTimeSlicedSurveyRequestMessage(Struct):
+    FIELDS = [("requestSignature", Signature),
+              ("request", TimeSlicedSurveyRequestMessage)]
+
+
+class SurveyResponseMessage(Struct):
+    FIELDS = [("surveyorPeerID", NodeID),
+              ("surveyedPeerID", NodeID),
+              ("ledgerNum", Uint32),
+              ("commandType", SurveyMessageCommandType),
+              ("encryptedBody", EncryptedBody)]
+
+
+class TimeSlicedSurveyResponseMessage(Struct):
+    FIELDS = [("response", SurveyResponseMessage),
+              ("nonce", Uint32)]
+
+
+class SignedTimeSlicedSurveyResponseMessage(Struct):
+    FIELDS = [("responseSignature", Signature),
+              ("response", TimeSlicedSurveyResponseMessage)]
+
+
+class TimeSlicedNodeData(Struct):
+    FIELDS = [("addedAuthenticatedPeers", Uint32),
+              ("droppedAuthenticatedPeers", Uint32),
+              ("totalInboundPeerCount", Uint32),
+              ("totalOutboundPeerCount", Uint32),
+              ("p75SCPFirstToSelfLatencyMs", Uint32),
+              ("p75SCPSelfToOtherLatencyMs", Uint32),
+              ("lostSyncCount", Uint32),
+              ("isValidator", Bool),
+              ("maxInboundPeerCount", Uint32),
+              ("maxOutboundPeerCount", Uint32)]
+
+
+class TimeSlicedPeerData(Struct):
+    FIELDS = [("peerId", NodeID),
+              ("messagesRead", Uint64),
+              ("messagesWritten", Uint64),
+              ("bytesRead", Uint64),
+              ("bytesWritten", Uint64)]
+
+
+TimeSlicedPeerDataList = VarArray(TimeSlicedPeerData, 25)
+
+
+class TopologyResponseBodyV2(Struct):
+    FIELDS = [("inboundPeers", TimeSlicedPeerDataList),
+              ("outboundPeers", TimeSlicedPeerDataList),
+              ("nodeData", TimeSlicedNodeData)]
+
+
+SurveyResponseBody = Union("SurveyResponseBody", Int32, {
+    2: TopologyResponseBodyV2,
+})
+
+
 MessageType = Enum("MessageType", {
     "ERROR_MSG": 0,
     "AUTH": 2,
@@ -122,6 +227,14 @@ MessageType = Enum("MessageType", {
 })
 
 StellarMessage = Union("StellarMessage", MessageType, {
+    MessageType.TIME_SLICED_SURVEY_START_COLLECTING:
+        SignedTimeSlicedSurveyStartCollectingMessage,
+    MessageType.TIME_SLICED_SURVEY_STOP_COLLECTING:
+        SignedTimeSlicedSurveyStopCollectingMessage,
+    MessageType.TIME_SLICED_SURVEY_REQUEST:
+        SignedTimeSlicedSurveyRequestMessage,
+    MessageType.TIME_SLICED_SURVEY_RESPONSE:
+        SignedTimeSlicedSurveyResponseMessage,
     MessageType.ERROR_MSG: ErrorMsg,
     MessageType.HELLO: Hello,
     MessageType.AUTH: Auth,

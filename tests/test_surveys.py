@@ -1,0 +1,86 @@
+"""Time-sliced survey tests (reference ``overlay/SurveyManager.h:20-38``
+behaviors): start/stop collecting floods, encrypted request/response
+through RELAYING nodes, per-peer traffic slices, sealed-box crypto."""
+
+from stellar_tpu.overlay.survey_manager import open_box, seal_box
+from stellar_tpu.simulation.simulation import Topologies
+from stellar_tpu.crypto import curve25519 as c25519
+
+
+def test_sealed_box_roundtrip_and_tamper():
+    secret = c25519.random_secret()
+    pub = c25519.public_from_secret(secret)
+    msg = b"topology" * 100
+    sealed = seal_box(pub, msg)
+    assert open_box(secret, sealed) == msg
+    bad = bytearray(sealed)
+    bad[40] ^= 1
+    assert open_box(secret, bytes(bad)) is None
+    assert open_box(c25519.random_secret(), sealed) is None
+
+
+def test_survey_flow_through_relay():
+    """Surveyor A surveys node C in a line topology A-B-C: the request
+    and the encrypted response both relay through B, which learns
+    nothing (can't decrypt)."""
+    from stellar_tpu.simulation.simulation import Simulation
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+    sim = Simulation()
+    keys = [SecretKey.from_seed_str(f"survey-{i}") for i in range(3)]
+    qset = SCPQuorumSet(
+        threshold=2,
+        validators=[make_node_id(k.public_key.raw) for k in keys],
+        innerSets=[])
+    for k in keys:
+        sim.add_node(k, qset)
+    ids = [k.public_key.raw for k in keys]
+    sim.add_connection(ids[0], ids[1])  # A - B
+    sim.add_connection(ids[1], ids[2])  # B - C
+    apps = [sim.nodes[i] for i in ids]
+    sim.crank_until(
+        lambda: apps[1].overlay.authenticated_count() == 2, 30)
+
+    a, b, c = apps
+    assert a.overlay.survey_manager.start_collecting()["nonce"] is not None
+    sim.crank_all_nodes(30)
+    # all three entered the collecting phase
+    assert b.overlay.survey_manager.collecting_nonce is not None
+    assert c.overlay.survey_manager.collecting_nonce is not None
+    # some traffic happens while collecting
+    sim.crank_all_nodes(30)
+    a.overlay.survey_manager.stop_collecting()
+    sim.crank_all_nodes(30)
+    assert b.overlay.survey_manager.collecting_nonce is None
+
+    a.overlay.survey_manager.request_node(ids[2])
+    sim.crank_until(
+        lambda: bool(a.overlay.survey_manager.results), 30)
+    results = a.overlay.survey_manager.results
+    key = ids[2].hex()
+    assert key in results
+    body = results[key]
+    # C has exactly one peer: B
+    assert body["node"]["totalInbound"] + body["node"]["totalOutbound"] == 1
+    peers = body["inboundPeers"] + body["outboundPeers"]
+    assert peers[0]["peer"] == ids[1].hex()
+    assert peers[0]["bytesRead"] > 0
+    # the relay B holds no survey results
+    assert b.overlay.survey_manager.results == {}
+
+
+def test_requests_throttled_per_ledger():
+    sim = Topologies.core(2, threshold=2)
+    apps = list(sim.nodes.values())
+    sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() == 1 for x in apps),
+        15)
+    sm = apps[0].overlay.survey_manager
+    sm.start_collecting()
+    sm.stop_collecting()
+    other = apps[1].node_id
+    oks = sum("requested" in sm.request_node(other) for _ in range(15))
+    assert oks == 10  # SURVEY_THROTTLE_PER_LEDGER
+    sm.ledger_closed()
+    assert "requested" in sm.request_node(other)
