@@ -116,6 +116,23 @@ def main():
     p50 = float(np.median(per_batch))
     p95 = float(np.percentile(per_batch, 95))
 
+    # trickle class: a single flooded tx signature through the installed
+    # verify path (cache miss -> device round trip; hit -> host dict)
+    v.install()
+    from stellar_tpu.crypto.keys import verify_sig
+    from stellar_tpu.crypto.keys import PublicKey
+    singles = gen_sigs(12)
+    miss_times, hit_times = [], []
+    for pk, m, s in singles:
+        t0 = time.perf_counter()
+        assert verify_sig(PublicKey(pk), m, s)
+        miss_times.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        assert verify_sig(PublicKey(pk), m, s)
+        hit_times.append((time.perf_counter() - t0) * 1000.0)
+    single_miss_p50 = float(np.median(miss_times))
+    single_hit_p50 = float(np.median(hit_times))
+
     base = cpu_baseline_ms(items)
     floor = dispatch_floor_ms()
     print(json.dumps({
@@ -129,6 +146,8 @@ def main():
         "host_prep_ms": round(host_prep_ms, 3),
         "cpu_baseline_ms": round(base, 3),
         "dispatch_floor_ms": round(floor, 3),
+        "single_sig_miss_p50_ms": round(single_miss_p50, 3),
+        "single_sig_hit_p50_ms": round(single_hit_p50, 4),
         "pipeline_depth": PIPELINE_DEPTH,
         "n_sigs": N_SIGS,
         "native_prep": native_prep.available(),
