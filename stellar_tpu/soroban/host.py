@@ -478,8 +478,7 @@ class _Interp:
     def _storage_op(self, op, a, stack):
         from stellar_tpu.ledger.ledger_txn import key_bytes
         if a and a[0].arm == T.SCV_SYMBOL and a[0].value == b"instance":
-            raise HostError(HostError.TRAPPED,
-                            "instance storage not supported yet")
+            return self._instance_storage_op(op, stack)
         dur = _DUR.get(a[0].value if a else b"persistent")
         if dur is None:
             raise HostError(HostError.TRAPPED, "bad durability")
@@ -516,6 +515,54 @@ class _Interp:
                 stack.append(SCVal.make(T.SCV_BOOL, e is not None))
             else:
                 host.storage.delete(kb)
+
+    def _instance_storage_op(self, op, stack):
+        """Instance storage: the SCMap inside the contract's instance
+        entry (reference host instance storage — shares the instance's
+        lifetime and footprint slot)."""
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        host = self.host
+        inst_lk = contract_data_key(
+            self.contract_addr,
+            SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        kb = key_bytes(inst_lk)
+        entry = host.storage.get(kb)
+        if entry is None:
+            raise HostError(HostError.TRAPPED, "missing instance entry")
+        inst = entry.data.value.val.value  # SCContractInstance
+        storage = list(inst.storage or ())
+        val = stack.pop() if op == b"put" else None
+        key = stack.pop()
+        key_b = to_bytes(SCVal, key)
+        idx = next((i for i, e in enumerate(storage)
+                    if to_bytes(SCVal, e.key) == key_b), None)
+        if op == b"get":
+            stack.append(storage[idx].val if idx is not None
+                         else SCVal.make(T.SCV_VOID))
+            return
+        if op == b"has":
+            stack.append(SCVal.make(T.SCV_BOOL, idx is not None))
+            return
+        if op == b"put":
+            if idx is not None:
+                storage[idx] = SCMapEntry(key=key, val=val)
+            else:
+                storage.append(SCMapEntry(key=key, val=val))
+                storage.sort(key=lambda e: to_bytes(SCVal, e.key))
+        else:  # del
+            if idx is None:
+                return
+            del storage[idx]
+        new_inst = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=self.contract_addr,
+            key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            durability=ContractDataDurability.PERSISTENT,
+            val=SCVal.make(T.SCV_CONTRACT_INSTANCE, SCContractInstance(
+                executable=inst.executable, storage=storage or None)))
+        host.storage.put(kb, _wrap_entry(
+            LedgerEntryType.CONTRACT_DATA, new_inst, host.ledger_seq),
+            None)
 
 
 # ---------------------------------------------------------------------------

@@ -424,3 +424,69 @@ def test_eviction_scan_removes_expired_temporary(env):
     assert store.get(key_bytes(temp_tk)) is None
     assert store.get(key_bytes(pers_lk)) is not None  # archived only
     assert store.get(key_bytes(live_lk)) is not None  # still live
+
+
+def test_instance_storage(env):
+    """Instance-durability storage lives inside the contract instance
+    entry and persists across invocations (requires the instance key in
+    readWrite)."""
+    from stellar_tpu.soroban.host import assemble_program, ins, sym, u32
+    root, a = env
+    code = assemble_program({
+        "set": [ins("push", sym("k")), ins("arg", u32(0)), ins("swap"),
+                ins("swap"),  # stack: [key, val]
+                ins("put", sym("instance")), ins("ret")],
+        "get": [ins("push", sym("k")), ins("get", sym("instance")),
+                ins("ret")],
+    })
+    code_hash = sha256(code)
+    assert apply_tx(root, upload_tx(root, a, code)).code == TC.txSUCCESS
+    from stellar_tpu.xdr.contract import (
+        ContractExecutable, ContractExecutableType, CreateContractArgs,
+    )
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+        CreateContractArgs(
+            contractIDPreimage=preimage_for(a, salt=b"\x09" * 32),
+            executable=ContractExecutable.make(
+                ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                code_hash)))
+    contract_id = derive_contract_id(
+        TEST_NETWORK_ID, preimage_for(a, salt=b"\x09" * 32))
+    addr = scaddress_contract(contract_id)
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    sd = soroban_data(read_only=[contract_code_key(code_hash)],
+                      read_write=[inst_key])
+    assert apply_tx(root, make_tx(
+        a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+        soroban_data=sd)).code == TC.txSUCCESS
+
+    def call(fn_name, args, rw_instance):
+        hf = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=fn_name, args=args))
+        ro = [contract_code_key(code_hash)]
+        rw = []
+        if rw_instance:
+            rw = [inst_key]
+        else:
+            ro = ro + [inst_key]
+        sd = soroban_data(read_only=ro, read_write=rw)
+        return apply_tx(root, make_tx(
+            a, seq_for(root, a), [soroban_op(hf)], fee=6_000_000,
+            soroban_data=sd))
+
+    res = call(b"set", [u32(41)], rw_instance=True)
+    assert res.code == TC.txSUCCESS
+    res = call(b"get", [], rw_instance=False)
+    assert res.code == TC.txSUCCESS
+    # value persisted inside the instance entry
+    e = root.store.get(key_bytes(inst_key))
+    storage = e.data.value.val.value.storage
+    assert storage and storage[0].val.value == 41
+    # writing without readWrite instance footprint traps
+    res = call(b"set", [u32(5)], rw_instance=False)
+    assert res.code == TC.txFAILED
