@@ -277,8 +277,17 @@ class _AuthContext:
                 self._verify_address_signature(ac, payload, verify_sig)
                 self._consume_nonce(ac, ledger_seq)
                 key = _address_bytes(ac.address)
-            fn = entry.rootInvocation.function
-            self.available.setdefault(key, []).append(fn)
+            # the whole invocation tree is authorized: flatten root +
+            # subInvocations (cross-contract calls consume sub-entries)
+            fns: list = []
+            self._flatten(entry.rootInvocation, fns)
+            self.available.setdefault(key, []).extend(fns)
+
+    @staticmethod
+    def _flatten(inv, out: list):
+        out.append(inv.function)
+        for sub in inv.subInvocations:
+            _AuthContext._flatten(sub, out)
 
     def _verify_address_signature(self, ac, payload: bytes, verify_sig):
         """Signature SCVal: vec of maps {public_key: bytes, signature:
@@ -370,11 +379,17 @@ def _truthy(v) -> bool:
     return True
 
 
+MAX_CALL_DEPTH = 10
+
+
 class _Interp:
-    def __init__(self, host: "_Host", contract_addr, program: Dict):
+    def __init__(self, host: "_Host", contract_addr, program: Dict,
+                 invocation=None, depth: int = 0):
         self.host = host
         self.contract_addr = contract_addr
         self.program = program  # fn name bytes -> list of instructions
+        self.invocation = invocation  # SorobanAuthorizedFunction
+        self.depth = depth
 
     def run(self, fn_name: bytes, args: List):
         body = self.program.get(fn_name)
@@ -425,7 +440,21 @@ class _Interp:
                 self._storage_op(op, a, stack)
             elif op == b"require_auth":
                 addr = stack.pop()
-                self.host.require_auth(addr)
+                self.host.require_auth(addr, self.invocation)
+            elif op == b"call":
+                # cross-contract call: ["call", n_args]; stack holds
+                # [addr, fn_symbol, arg1..argN]
+                n_args = a[0].value if a else 0
+                call_args = [stack.pop() for _ in range(n_args)][::-1]
+                fn_sym = stack.pop()
+                addr_val = stack.pop()
+                if addr_val.arm != T.SCV_ADDRESS or \
+                        fn_sym.arm != T.SCV_SYMBOL:
+                    raise HostError(HostError.TRAPPED,
+                                    "call needs (address, symbol)")
+                stack.append(self.host.call_contract(
+                    addr_val.value, fn_sym.value, call_args,
+                    self.depth + 1))
             elif op == b"event":
                 data = stack.pop()
                 topic = stack.pop()
@@ -592,14 +621,23 @@ class _Host:
         self.config = config
         self.ledger_seq = ledger_seq
         self.events: List = []
-        self.current_invocation = None  # SorobanAuthorizedFunction
 
-    def require_auth(self, addr):
+    def require_auth(self, addr, invocation):
         if addr.arm != T.SCV_ADDRESS:
             raise HostError(HostError.TRAPPED,
                             "require_auth on non-address")
-        self.auth.require(_address_bytes(addr.value),
-                          self.current_invocation)
+        self.auth.require(_address_bytes(addr.value), invocation)
+
+    def call_contract(self, addr, fn_name: bytes, args: List,
+                      depth: int):
+        """Cross-contract invocation sharing budget/storage/auth."""
+        if depth > MAX_CALL_DEPTH:
+            raise HostError(HostError.TRAPPED, "call depth exceeded")
+        from stellar_tpu.xdr.contract import InvokeContractArgs
+        return _run_contract(
+            self, InvokeContractArgs(contractAddress=addr,
+                                     functionName=fn_name,
+                                     args=list(args)), depth)
 
     def emit_event(self, contract_addr, topics, data):
         ev = ContractEvent(
@@ -724,7 +762,7 @@ def _create(host: "_Host", args, network_id: bytes):
     return SCVal.make(T.SCV_ADDRESS, addr)
 
 
-def _invoke(host: "_Host", args):
+def _run_contract(host: "_Host", args, depth: int = 0):
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.xdr.contract import (
         SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
@@ -745,8 +783,13 @@ def _invoke(host: "_Host", args):
     if code_entry is None:
         raise HostError(HostError.TRAPPED, "missing contract code")
     prog = _parse_program(code_entry.data.value.code)
-    host.current_invocation = SorobanAuthorizedFunction.make(
+    invocation = SorobanAuthorizedFunction.make(
         SorobanAuthorizedFunctionType
         .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN, args)
-    interp = _Interp(host, addr, prog)
+    interp = _Interp(host, addr, prog, invocation=invocation,
+                     depth=depth)
     return interp.run(args.functionName, list(args.args))
+
+
+def _invoke(host: "_Host", args):
+    return _run_contract(host, args, depth=0)

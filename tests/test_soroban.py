@@ -490,3 +490,83 @@ def test_instance_storage(env):
     # writing without readWrite instance footprint traps
     res = call(b"set", [u32(5)], rw_instance=False)
     assert res.code == TC.txFAILED
+
+
+def test_cross_contract_call(env):
+    """Contract A calls contract B ("call" op) with shared budget and
+    per-frame storage addressing."""
+    from stellar_tpu.soroban.host import assemble_program, ins, sym, u32
+    from stellar_tpu.xdr.contract import (
+        ContractExecutable, ContractExecutableType, CreateContractArgs,
+    )
+    root, a = env
+    # B: doubles its argument
+    code_b = assemble_program({
+        "dbl": [ins("arg", u32(0)), ins("arg", u32(0)), ins("add"),
+                ins("ret")],
+    })
+    hash_b = sha256(code_b)
+    contract_id_b = derive_contract_id(
+        TEST_NETWORK_ID, preimage_for(a, salt=b"\x0b" * 32))
+    addr_b = scaddress_contract(contract_id_b)
+    # A: calls B.dbl(21)
+    code_a = assemble_program({
+        "go": [ins("push", SCVal.make(T.SCV_ADDRESS, addr_b)),
+               ins("push", sym("dbl")),
+               ins("push", u32(21)),
+               ins("call", u32(1)),
+               ins("ret")],
+    })
+    hash_a = sha256(code_a)
+    contract_id_a = derive_contract_id(
+        TEST_NETWORK_ID, preimage_for(a, salt=b"\x0a" * 32))
+    addr_a = scaddress_contract(contract_id_a)
+
+    cfg = default_soroban_config()
+    old = (cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries)
+    cfg.tx_max_read_ledger_entries = 10
+    cfg.tx_max_write_ledger_entries = 8
+    try:
+        for code in (code_a, code_b):
+            assert apply_tx(root,
+                            upload_tx(root, a, code)).code == TC.txSUCCESS
+        for salt, chash in ((b"\x0a" * 32, hash_a), (b"\x0b" * 32, hash_b)):
+            fn = HostFunction.make(
+                HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+                CreateContractArgs(
+                    contractIDPreimage=preimage_for(a, salt=salt),
+                    executable=ContractExecutable.make(
+                        ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                        chash)))
+            cid = derive_contract_id(TEST_NETWORK_ID,
+                                     preimage_for(a, salt=salt))
+            inst = contract_data_key(
+                scaddress_contract(cid),
+                SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+                ContractDataDurability.PERSISTENT)
+            sd = soroban_data(read_only=[contract_code_key(chash)],
+                              read_write=[inst])
+            assert apply_tx(root, make_tx(
+                a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+                soroban_data=sd)).code == TC.txSUCCESS
+
+        hf = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr_a,
+                               functionName=b"go", args=[]))
+        inst_a = contract_data_key(
+            addr_a, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        inst_b = contract_data_key(
+            addr_b, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        sd = soroban_data(read_only=[
+            inst_a, inst_b, contract_code_key(hash_a),
+            contract_code_key(hash_b)])
+        res = apply_tx(root, make_tx(
+            a, seq_for(root, a), [soroban_op(hf)], fee=6_000_000,
+            soroban_data=sd))
+        assert res.code == TC.txSUCCESS
+        assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_SUCCESS
+    finally:
+        cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries = old
