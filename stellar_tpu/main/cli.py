@@ -294,10 +294,21 @@ def cmd_check_quorum_intersection(args) -> int:
 
 
 def cmd_apply_load(args) -> int:
-    """Synthetic-queue close-ledger benchmark (reference ``apply-load``,
-    ``CommandLine.cpp:1770-1860``)."""
-    from stellar_tpu.simulation.load_generator import apply_load
-    stats = apply_load(n_ledgers=args.ledgers, txs_per_ledger=args.txs)
+    """Benchmark scenarios (reference ``apply-load`` +
+    performance-eval methodology): close = synthetic-queue closeLedger
+    distribution; catchup = BASELINE #3 replay; scp-storm = BASELINE #4
+    16-validator consensus rounds."""
+    from stellar_tpu.simulation.load_generator import (
+        apply_load, catchup_replay_bench, scp_storm_bench,
+    )
+    if args.scenario == "catchup":
+        stats = catchup_replay_bench(n_ledgers=args.ledgers,
+                                     txs_per_ledger=args.txs)
+    elif args.scenario == "scp-storm":
+        stats = scp_storm_bench(n_validators=16, n_rounds=args.ledgers)
+    else:
+        stats = apply_load(n_ledgers=args.ledgers,
+                           txs_per_ledger=args.txs)
     print(json.dumps(stats))
     return 0
 
@@ -340,6 +351,8 @@ def main(argv=None) -> int:
     sp = sub.add_parser("apply-load")
     sp.add_argument("--ledgers", type=int, default=10)
     sp.add_argument("--txs", type=int, default=100)
+    sp.add_argument("--scenario", default="close",
+                    choices=["close", "catchup", "scp-storm"])
     sp.set_defaults(fn=cmd_apply_load)
     args = p.parse_args(argv)
     return args.fn(args)

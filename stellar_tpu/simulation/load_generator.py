@@ -104,3 +104,99 @@ def apply_load(n_ledgers: int = 10, txs_per_ledger: int = 100,
             total_applied / (stats["mean_ms"] * n_ledgers / 1000.0), 1)
         if stats["mean_ms"] else 0.0,
     }
+
+
+def catchup_replay_bench(n_ledgers: int = 256,
+                         txs_per_ledger: int = 20) -> dict:
+    """BASELINE config #3 shape: publish a chain, then time a fresh
+    node's COMPLETE replay (signature-bound without the batch
+    verifier)."""
+    import tempfile
+    import time as _time
+    from stellar_tpu.catchup.catchup import (
+        CatchupConfiguration, CatchupWork,
+    )
+    from stellar_tpu.history.history_manager import (
+        FileArchive, HistoryManager,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, make_tx, payment_op, seed_root_with_accounts,
+    )
+    from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+    from stellar_tpu.work.work import State, WorkScheduler
+
+    keys = [SecretKey.from_seed_str(f"cr-{i}") for i in range(8)]
+    root = seed_root_with_accounts([(k, 10**13) for k in keys])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.maxTxSetSize = max(1000, txs_per_ledger * 2)
+    tmp = tempfile.mkdtemp(prefix="stpu-catchup-bench-")
+    hm = HistoryManager([FileArchive(tmp)], "bench")
+    seqs = {k.public_key.raw: (1 << 32) for k in keys}
+    for i in range(n_ledgers):
+        frames = []
+        for t in range(txs_per_ledger):
+            src = keys[t % len(keys)]
+            seqs[src.public_key.raw] += 1
+            frames.append(make_tx(
+                src, seqs[src.public_key.raw],
+                [payment_op(keys[(t + 1) % len(keys)], XLM)]))
+        txset, _ = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash)
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5))
+        hm.ledger_closed(res, txset, lm.bucket_list)
+
+    root2 = seed_root_with_accounts([(k, 10**13) for k in keys])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    # genesis must match the published chain's bit-for-bit
+    lm2.last_closed_header.maxTxSetSize = \
+        max(1000, txs_per_ledger * 2)
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    target = hm.published_checkpoints[-1]
+    work = CatchupWork(lm2, FileArchive(tmp),
+                       CatchupConfiguration(target))
+    t0 = _time.perf_counter()
+    ws.schedule(work)
+    ws.run_until_done(timeout=3600)
+    dt = _time.perf_counter() - t0
+    assert work.state == State.SUCCESS
+    replayed = lm2.ledger_seq - 2
+    return {
+        "scenario": "catchup-replay",
+        "replayed_ledgers": replayed,
+        "txs_per_ledger": txs_per_ledger,
+        "wall_s": round(dt, 2),
+        "ledgers_per_sec": round(replayed / dt, 2),
+        "txs_per_sec": round(replayed * txs_per_ledger / dt, 1),
+    }
+
+
+def scp_storm_bench(n_validators: int = 16, n_rounds: int = 5) -> dict:
+    """BASELINE config #4 shape: N validators × M consensus rounds on
+    the loopback overlay; reports rounds/sec and envelope counts."""
+    import time as _time
+    from stellar_tpu.simulation.simulation import Topologies
+    sim = Topologies.core(n_validators)
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    ok = sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() >= n_validators - 1
+                    for a in apps), 60)
+    assert ok, "mesh never authenticated"
+    start_seq = apps[0].lm.ledger_seq
+    t0 = _time.perf_counter()
+    assert sim.crank_until_ledger(start_seq + n_rounds, timeout=600)
+    dt = _time.perf_counter() - t0
+    assert sim.in_consensus()
+    envelopes = sum(
+        len(slot.statements_history)
+        for a in apps for slot in a.herder.scp.known_slots.values())
+    return {
+        "scenario": "scp-storm",
+        "validators": n_validators,
+        "rounds": n_rounds,
+        "wall_s": round(dt, 2),
+        "rounds_per_sec": round(n_rounds / dt, 3),
+        "total_statements": envelopes,
+    }
