@@ -658,7 +658,8 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
                          read_only: set, read_write: set, auth_entries,
                          source_account, network_id: bytes,
                          ledger_seq: int, config,
-                         cpu_limit: Optional[int] = None) -> InvokeOutput:
+                         cpu_limit: Optional[int] = None,
+                         ledger_header=None) -> InvokeOutput:
     """Execute one HostFunction against declared state (the lib.rs
     boundary). ``footprint_entries``: kb -> (LedgerEntry|None,
     live_until|None) for every declared key that exists."""
@@ -673,6 +674,7 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
         auth = _AuthContext(auth_entries, source_account, network_id,
                             ledger_seq, storage, _verify_sig)
         host = _Host(storage, budget, auth, config, ledger_seq)
+        host.ledger_header = ledger_header  # classic reserve math (SAC)
         t = host_fn.arm
         if t == HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM:
             rv = _upload(host, host_fn.value, read_write)
@@ -740,12 +742,28 @@ def _create(host: "_Host", args, network_id: bytes):
     from stellar_tpu.ledger.ledger_txn import key_bytes
     contract_id = derive_contract_id(network_id, args.contractIDPreimage)
     addr = scaddress_contract(contract_id)
+    storage = None
     if args.executable.arm == \
             ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
+        if args.contractIDPreimage.arm == \
+                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET:
+            raise HostError(HostError.TRAPPED,
+                            "asset preimage needs the asset executable")
         code_kb = key_bytes(contract_code_key(args.executable.value))
         if host.storage.get(code_kb) is None:
             raise HostError(HostError.TRAPPED,
                             "executable code not uploaded")
+    else:
+        # Stellar Asset Contract: deployable only from an asset
+        # preimage; the wrapped asset rides in instance storage
+        if args.contractIDPreimage.arm != \
+                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET:
+            raise HostError(HostError.TRAPPED,
+                            "asset executable needs an asset preimage")
+        from stellar_tpu.soroban.asset_contract import (
+            asset_instance_storage,
+        )
+        storage = asset_instance_storage(args.contractIDPreimage.value)
     key = SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE)
     lk = contract_data_key(addr, key, ContractDataDurability.PERSISTENT)
     kb = key_bytes(lk)
@@ -755,7 +773,7 @@ def _create(host: "_Host", args, network_id: bytes):
         ext=ExtensionPoint.make(0), contract=addr, key=key,
         durability=ContractDataDurability.PERSISTENT,
         val=SCVal.make(T.SCV_CONTRACT_INSTANCE, SCContractInstance(
-            executable=args.executable, storage=None)))
+            executable=args.executable, storage=storage)))
     host.storage.put(kb, _wrap_entry(LedgerEntryType.CONTRACT_DATA,
                                      inst, host.ledger_seq),
                      host.ledger_seq + host.config.min_persistent_ttl - 1)
@@ -774,10 +792,17 @@ def _run_contract(host: "_Host", args, depth: int = 0):
     if inst_entry is None:
         raise HostError(HostError.TRAPPED, "contract does not exist")
     inst = inst_entry.data.value.val.value  # SCContractInstance
-    if inst.executable.arm != \
-            ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
-        raise HostError(HostError.TRAPPED,
-                        "asset contracts not supported yet")
+    if inst.executable.arm == \
+            ContractExecutableType.CONTRACT_EXECUTABLE_STELLAR_ASSET:
+        from stellar_tpu.soroban.asset_contract import asset_contract_call
+        from stellar_tpu.xdr.contract import (
+            SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
+        )
+        invocation = SorobanAuthorizedFunction.make(
+            SorobanAuthorizedFunctionType
+            .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN, args)
+        return asset_contract_call(host, addr, inst, args.functionName,
+                                   list(args.args), invocation)
     code_entry = host.storage.get(
         key_bytes(contract_code_key(inst.executable.value)))
     if code_entry is None:

@@ -146,7 +146,7 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                 self.body.hostFunction, footprint_entries, read_only,
                 read_write, self.body.auth, self.source_account_id(),
                 self.parent_tx.network_id, seq, cfg,
-                cpu_limit=res.instructions)
+                cpu_limit=res.instructions, ledger_header=header)
 
             if not out.success:
                 code = {

@@ -570,3 +570,168 @@ def test_cross_contract_call(env):
         assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_SUCCESS
     finally:
         cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries = old
+
+
+def test_stellar_asset_contract(env):
+    """Deploy the built-in SAC for a credit asset; mint with the
+    issuer's auth entry, transfer between classic accounts, balances
+    visible both classically and through the contract."""
+    from stellar_tpu.soroban.host import auth_payload_hash
+    from stellar_tpu.xdr.contract import (
+        ContractExecutable, ContractExecutableType, ContractIDPreimage,
+        ContractIDPreimageType, CreateContractArgs, Int128Parts,
+        SCMapEntry, SCNonceKey, SorobanAddressCredentials,
+        SorobanAuthorizationEntry, SorobanAuthorizedFunction,
+        SorobanAuthorizedFunctionType, SorobanAuthorizedInvocation,
+        SorobanCredentials, SorobanCredentialsType,
+    )
+    from stellar_tpu.xdr.types import NATIVE_ASSET, asset_alphanum4
+    from stellar_tpu.tx.asset_utils import trustline_key
+    from tests.test_liquidity_pools import change_trust_op, op as mk_op
+    from stellar_tpu.xdr.tx import ChangeTrustAsset, OperationType
+
+    root, a = env
+    issuer = keypair("sac-issuer")
+    holder = keypair("sac-holder")
+    from stellar_tpu.tx.tx_test_utils import seed_root_with_accounts
+    root = seed_root_with_accounts(
+        [(a, 100_000 * XLM), (issuer, 100_000 * XLM),
+         (holder, 100_000 * XLM)])
+    usd = asset_alphanum4(b"USD", account_id(issuer.public_key.raw))
+    # holder + a need USD trustlines
+    for kp in (a, holder):
+        res = apply_tx(root, make_tx(kp, seq_for(root, kp), [
+            change_trust_op(ChangeTrustAsset.make(usd.arm, usd.value),
+                            10**15)]))
+        assert res.code == TC.txSUCCESS
+
+    cfg = default_soroban_config()
+    old = (cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries)
+    cfg.tx_max_read_ledger_entries = 10
+    cfg.tx_max_write_ledger_entries = 8
+    try:
+        preimage = ContractIDPreimage.make(
+            ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET, usd)
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+            CreateContractArgs(
+                contractIDPreimage=preimage,
+                executable=ContractExecutable.make(
+                    ContractExecutableType
+                    .CONTRACT_EXECUTABLE_STELLAR_ASSET)))
+        contract_id = derive_contract_id(TEST_NETWORK_ID, preimage)
+        addr = scaddress_contract(contract_id)
+        inst_key = contract_data_key(
+            addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        sd = soroban_data(read_write=[inst_key])
+        assert apply_tx(root, make_tx(
+            a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
+            soroban_data=sd)).code == TC.txSUCCESS
+
+        def i128(v):
+            return SCVal.make(T.SCV_I128,
+                              Int128Parts(hi=0, lo=v))
+
+        def signed_auth(kp, invocation, nonce):
+            payload = auth_payload_hash(TEST_NETWORK_ID, nonce, 10_000,
+                                        invocation)
+            sig = kp.sign(payload)
+            sig_val = SCVal.make(T.SCV_VEC, [SCVal.make(T.SCV_MAP, [
+                SCMapEntry(key=sym("public_key"), val=SCVal.make(
+                    T.SCV_BYTES, kp.public_key.raw)),
+                SCMapEntry(key=sym("signature"),
+                           val=SCVal.make(T.SCV_BYTES, sig)),
+            ])])
+            return SorobanAuthorizationEntry(
+                credentials=SorobanCredentials.make(
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+                    SorobanAddressCredentials(
+                        address=scaddress_account(
+                            account_id(kp.public_key.raw)),
+                        nonce=nonce, signatureExpirationLedger=10_000,
+                        signature=sig_val)),
+                rootInvocation=invocation)
+
+        def nonce_key(kp, nonce):
+            return contract_data_key(
+                scaddress_account(account_id(kp.public_key.raw)),
+                SCVal.make(T.SCV_LEDGER_KEY_NONCE,
+                           SCNonceKey(nonce=nonce)),
+                ContractDataDurability.TEMPORARY)
+
+        # mint 500 USD to holder, authorized by the issuer
+        mint_args = [SCVal.make(T.SCV_ADDRESS, scaddress_account(
+            account_id(holder.public_key.raw))), i128(500 * XLM)]
+        invocation = SorobanAuthorizedInvocation(
+            function=SorobanAuthorizedFunction.make(
+                SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                InvokeContractArgs(contractAddress=addr,
+                                   functionName=b"mint",
+                                   args=mint_args)),
+            subInvocations=[])
+        hf = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"mint", args=mint_args))
+        hkb = trustline_key(account_id(holder.public_key.raw), usd)
+        sd = soroban_data(
+            read_only=[inst_key],
+            read_write=[hkb, nonce_key(issuer, 1)])
+        res = apply_tx(root, make_tx(
+            a, seq_for(root, a),
+            [soroban_op(hf, [signed_auth(issuer, invocation, 1)])],
+            fee=6_000_000, soroban_data=sd))
+        assert res.code == TC.txSUCCESS, res.op_results
+        tle = root.store.get(key_bytes(hkb))
+        assert tle.data.value.balance == 500 * XLM
+
+        # transfer 120 USD holder -> a, authorized by holder
+        xfer_args = [
+            SCVal.make(T.SCV_ADDRESS, scaddress_account(
+                account_id(holder.public_key.raw))),
+            SCVal.make(T.SCV_ADDRESS, scaddress_account(
+                account_id(a.public_key.raw))),
+            i128(120 * XLM)]
+        invocation = SorobanAuthorizedInvocation(
+            function=SorobanAuthorizedFunction.make(
+                SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                InvokeContractArgs(contractAddress=addr,
+                                   functionName=b"transfer",
+                                   args=xfer_args)),
+            subInvocations=[])
+        hf = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"transfer",
+                               args=xfer_args))
+        akb = trustline_key(account_id(a.public_key.raw), usd)
+        sd = soroban_data(
+            read_only=[inst_key],
+            read_write=[hkb, akb, nonce_key(holder, 2)])
+        res = apply_tx(root, make_tx(
+            a, seq_for(root, a),
+            [soroban_op(hf, [signed_auth(holder, invocation, 2)])],
+            fee=6_000_000, soroban_data=sd))
+        assert res.code == TC.txSUCCESS, res.op_results
+        assert root.store.get(key_bytes(hkb)).data.value.balance == \
+            380 * XLM
+        assert root.store.get(key_bytes(akb)).data.value.balance == \
+            120 * XLM
+
+        # balance() reads through the contract
+        hf = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(
+                contractAddress=addr, functionName=b"balance",
+                args=[SCVal.make(T.SCV_ADDRESS, scaddress_account(
+                    account_id(a.public_key.raw)))]))
+        sd = soroban_data(read_only=[inst_key, akb])
+        res = apply_tx(root, make_tx(
+            a, seq_for(root, a), [soroban_op(hf)], fee=6_000_000,
+            soroban_data=sd))
+        assert res.code == TC.txSUCCESS, res.op_results
+    finally:
+        cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries = old
