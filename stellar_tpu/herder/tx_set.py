@@ -187,10 +187,31 @@ class TxSetXDRFrame:
             frames = []
             discounts = {}
             soroban_frames = []
+            parallel_stages = None
             v1 = self.xdr.value
             for phase_i, phase in enumerate(v1.phases):
-                if phase.arm != 0:
-                    return None  # parallel soroban phase: later milestone
+                if phase.arm == 1:
+                    # parallel Soroban phase: sequential stages of
+                    # independent clusters (reference TxSetFrame.h:192-
+                    # 254); only valid as the soroban phase
+                    if phase_i != 1:
+                        return None
+                    comp = phase.value
+                    parallel_stages = []
+                    for stage in comp.executionStages:
+                        stage_frames = []
+                        for cluster in stage:
+                            cluster_frames = []
+                            for env in cluster:
+                                f = make_transaction_frame(network_id,
+                                                           env)
+                                frames.append(f)
+                                soroban_frames.append(f)
+                                discounts[id(f)] = comp.baseFee
+                                cluster_frames.append(f)
+                            stage_frames.append(cluster_frames)
+                        parallel_stages.append(stage_frames)
+                    continue
                 for comp in phase.value:
                     for env in comp.value.txs:
                         f = make_transaction_frame(network_id, env)
@@ -200,7 +221,8 @@ class TxSetXDRFrame:
                             soroban_frames.append(f)
             return ApplicableTxSetFrame(self.xdr, frames, discounts,
                                         precomputed_hash=self.hash,
-                                        soroban_frames=soroban_frames)
+                                        soroban_frames=soroban_frames,
+                                        parallel_stages=parallel_stages)
         except Exception:
             return None
 
@@ -211,11 +233,17 @@ class ApplicableTxSetFrame:
 
     def __init__(self, xdr_set, frames: Sequence, discounts: Dict,
                  precomputed_hash: Optional[bytes] = None,
-                 soroban_frames: Sequence = ()):
+                 soroban_frames: Sequence = (),
+                 parallel_stages=None):
         self.xdr = xdr_set
         self.frames = list(frames)
         self._discounts = discounts  # id(frame) -> Optional[baseFee]
         self._soroban_ids = {id(f) for f in soroban_frames}
+        # stages -> clusters -> frames when the soroban phase is the
+        # parallel representation (protocol 23+ sets); apply is still
+        # sequential in this snapshot (reference LedgerManagerImpl
+        # .cpp:1619-1689) but stage/cluster order is preserved
+        self.parallel_stages = parallel_stages
         self.hash = precomputed_hash if precomputed_hash is not None \
             else generalized_tx_set_hash(xdr_set)
 
@@ -260,6 +288,12 @@ class ApplicableTxSetFrame:
         # discounted base fee must not be below the protocol minimum
         by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames}
         for phase in self.xdr.value.phases:
+            if phase.arm == 1:
+                bf = phase.value.baseFee
+                if bf is not None and bf < header.baseFee:
+                    return False
+                # clusters are dependency chains, not hash-ordered
+                continue
             for comp in phase.value:
                 bf = comp.value.baseFee
                 if bf is not None and bf < header.baseFee:
@@ -300,12 +334,19 @@ class ApplicableTxSetFrame:
 
     def get_txs_in_apply_order(self) -> List:
         """Reference ``sortedForApplySequential`` applied per phase:
-        classic applies first, then the soroban phase."""
+        classic applies first, then the soroban phase. A parallel
+        soroban phase applies stage by stage, clusters in declared
+        order (each cluster is a dependency chain)."""
         classic = [f for f in self.frames
                    if id(f) not in self._soroban_ids]
+        out = self._phase_apply_order(classic)
+        if self.parallel_stages is not None:
+            for stage in self.parallel_stages:
+                for cluster in stage:
+                    out.extend(cluster)
+            return out
         soroban = [f for f in self.frames if id(f) in self._soroban_ids]
-        return (self._phase_apply_order(classic) +
-                self._phase_apply_order(soroban))
+        return out + self._phase_apply_order(soroban)
 
     def _phase_apply_order(self, frames) -> List:
         """Round-robin account batches, each shuffled by full-hash XOR

@@ -735,3 +735,47 @@ def test_stellar_asset_contract(env):
         assert res.code == TC.txSUCCESS, res.op_results
     finally:
         cfg.tx_max_read_ledger_entries, cfg.tx_max_write_ledger_entries = old
+
+
+def test_parallel_soroban_phase_applies(env):
+    """A generalized tx set whose soroban phase uses the PARALLEL
+    representation (stages of clusters) parses, validates, and applies
+    stage-by-stage (reference TxSetFrame.h:192-254; apply still
+    sequential in this snapshot)."""
+    from stellar_tpu.herder.tx_set import TxSetXDRFrame
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.xdr.ledger import (
+        GeneralizedTransactionSet, ParallelTxsComponent, TransactionPhase,
+        TransactionSetV1, TxSetComponent, TxSetComponentType,
+        TxSetComponentTxsMaybeDiscountedFee,
+    )
+    root, a = env
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    up_tx = upload_tx(root, a)
+    classic = TransactionPhase.make(0, [TxSetComponent.make(
+        TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
+        TxSetComponentTxsMaybeDiscountedFee(baseFee=None, txs=[]))])
+    parallel = TransactionPhase.make(1, ParallelTxsComponent(
+        baseFee=None, executionStages=[[[up_tx.envelope]]]))
+    gset = GeneralizedTransactionSet.make(1, TransactionSetV1(
+        previousLedgerHash=lm.last_closed_hash,
+        phases=[classic, parallel]))
+    frame = TxSetXDRFrame(gset)
+    applicable = frame.prepare_for_apply(TEST_NETWORK_ID)
+    assert applicable is not None
+    assert applicable.soroban_tx_count() == 1
+    assert applicable.parallel_stages is not None
+    order = applicable.get_txs_in_apply_order()
+    assert len(order) == 1
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    with LedgerTxn(lm.root) as ltx:
+        assert applicable.check_valid(ltx, lm.last_closed_hash)
+        ltx.rollback()
+    res = lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, applicable,
+        lm.last_closed_header.scpValue.closeTime + 5))
+    assert res.failed_count == 0
+    assert root.store.get(key_bytes(contract_code_key(CODE_HASH))) \
+        is not None
