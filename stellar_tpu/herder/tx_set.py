@@ -199,6 +199,11 @@ class TxSetXDRFrame:
                     comp = phase.value
                     parallel_stages = []
                     for stage in comp.executionStages:
+                        # structurally invalid: empty stages/clusters
+                        # (reference validateParallelComponent) — also
+                        # preserves hash-uniqueness of contents
+                        if not stage or any(not c for c in stage):
+                            return None
                         stage_frames = []
                         for cluster in stage:
                             cluster_frames = []
@@ -286,7 +291,9 @@ class ApplicableTxSetFrame:
             if f.is_soroban() != (id(f) in self._soroban_ids):
                 return False
         # discounted base fee must not be below the protocol minimum
-        by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames}
+        by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames
+                  if not (self.parallel_stages is not None and
+                          id(f) in self._soroban_ids)}
         for phase in self.xdr.value.phases:
             if phase.arm == 1:
                 bf = phase.value.baseFee
@@ -318,8 +325,23 @@ class ApplicableTxSetFrame:
         # per-account chains: each tx validates against its predecessor's
         # seq num (reference ``TxSetUtils::getInvalidTxList``); gaps
         # allowed only where a minSeqNum precondition admits them —
-        # is_bad_seq decides, not a set-level rule
-        for q in _build_account_queues(self.frames).values():
+        # is_bad_seq decides, not a set-level rule. The chain must be
+        # checked in APPLY order: sorted queues for sequential phases,
+        # declared cluster order for a parallel soroban phase (clusters
+        # are dependency chains — a descending-seq cluster must fail
+        # here, not at apply).
+        if self.parallel_stages is not None:
+            classic = [f for f in self.frames
+                       if id(f) not in self._soroban_ids]
+            queues = _build_account_queues(classic)
+            for stage in self.parallel_stages:
+                for cluster in stage:
+                    for f in cluster:
+                        queues.setdefault(
+                            f.source_account_id().value, []).append(f)
+        else:
+            queues = _build_account_queues(self.frames)
+        for q in queues.values():
             current = 0
             for f in q:
                 res = f.check_valid(ltx, current, lower_offset,

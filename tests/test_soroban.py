@@ -779,3 +779,64 @@ def test_parallel_soroban_phase_applies(env):
     assert res.failed_count == 0
     assert root.store.get(key_bytes(contract_code_key(CODE_HASH))) \
         is not None
+
+
+def test_parallel_phase_rejects_bad_structure_and_order(env):
+    """Empty stages/clusters are structurally invalid; a
+    descending-seq cluster fails checkValid (apply-order chain check)."""
+    from stellar_tpu.herder.tx_set import TxSetXDRFrame
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_tpu.xdr.ledger import (
+        GeneralizedTransactionSet, ParallelTxsComponent, TransactionPhase,
+        TransactionSetV1, TxSetComponent, TxSetComponentType,
+        TxSetComponentTxsMaybeDiscountedFee,
+    )
+    root, a = env
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    classic = TransactionPhase.make(0, [TxSetComponent.make(
+        TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
+        TxSetComponentTxsMaybeDiscountedFee(baseFee=None, txs=[]))])
+
+    def gset_with(stages):
+        return GeneralizedTransactionSet.make(1, TransactionSetV1(
+            previousLedgerHash=lm.last_closed_hash,
+            phases=[classic, TransactionPhase.make(
+                1, ParallelTxsComponent(baseFee=None,
+                                        executionStages=stages))]))
+
+    # empty stage / empty cluster: unparseable
+    assert TxSetXDRFrame(gset_with([[]])) \
+        .prepare_for_apply(TEST_NETWORK_ID) is None
+    assert TxSetXDRFrame(gset_with([[[]]])) \
+        .prepare_for_apply(TEST_NETWORK_ID) is None
+
+    # descending seq numbers inside one cluster: parses but checkValid
+    # rejects (apply-order chain)
+    cfg = default_soroban_config()
+    old_cap = cfg.ledger_max_tx_count
+    cfg.ledger_max_tx_count = 4
+    try:
+        tx1 = upload_tx(root, a)  # seq n+1
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            COUNTER_CODE)
+        sd = soroban_data(read_write=[contract_code_key(CODE_HASH)])
+        tx2 = make_tx(a, seq_for(root, a) + 1, [soroban_op(fn)],
+                      fee=6_000_001, soroban_data=sd)
+        applicable = TxSetXDRFrame(
+            gset_with([[[tx2.envelope, tx1.envelope]]])) \
+            .prepare_for_apply(TEST_NETWORK_ID)
+        assert applicable is not None
+        with LedgerTxn(lm.root) as ltx:
+            assert not applicable.check_valid(ltx, lm.last_closed_hash)
+            ltx.rollback()
+        # ascending order in the cluster is fine
+        applicable = TxSetXDRFrame(
+            gset_with([[[tx1.envelope, tx2.envelope]]])) \
+            .prepare_for_apply(TEST_NETWORK_ID)
+        with LedgerTxn(lm.root) as ltx:
+            assert applicable.check_valid(ltx, lm.last_closed_hash)
+            ltx.rollback()
+    finally:
+        cfg.ledger_max_tx_count = old_cap
