@@ -142,7 +142,12 @@ class Application:
         self._maintenance_timer = t
 
     def crank(self, block: bool = False) -> int:
-        return self.clock.crank(block)
+        n = self.clock.crank(block)
+        # reap finished archive subprocesses (reference: exit handlers
+        # posted back to the main thread)
+        if self.process_manager.running or self.process_manager.pending:
+            n += self.process_manager.poll()
+        return n
 
     # ---------------- hooks ----------------
 
@@ -188,10 +193,18 @@ class Application:
                       HERDER_STATE.OUT_OF_SYNC: "out-of-sync"}[
                 self.herder.state],
             "peers": {"authenticated_count":
-                      self.overlay.authenticated_count()},
+                      self.overlay.authenticated_count(),
+                      "pending_count": len(self.overlay.pending_peers)},
             "quorum": {"node": self.config.NODE_SEED.public_key
                        .to_strkey()},
+            "network": self.config.NETWORK_PASSPHRASE,
             "protocol_version": lcl.ledgerVersion,
+            "history": {
+                "published_checkpoints":
+                    list(self.history.published_checkpoints)
+                    if self.history else [],
+            },
+            "database": bool(self.database),
         }
 
     def manual_close(self) -> dict:

