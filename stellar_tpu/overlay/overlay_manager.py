@@ -94,6 +94,12 @@ class OverlayManager:
             self.peers.append(peer)
             if self.survey_manager.collecting_nonce is not None:
                 self.survey_manager.added_peers += 1
+            # pull the peer's SCP state for the current slot so a node
+            # joining mid-ledger catches up immediately (reference
+            # Peer::recvAuth -> sendGetScpState)
+            peer.send(StellarMessage.make(
+                MessageType.GET_SCP_STATE,
+                self.app.herder.lm.ledger_seq + 1))
 
     def peer_dropped(self, peer, reason: str):
         if peer in self.peers:
