@@ -242,10 +242,16 @@ def cmd_dump_ledger(args) -> int:
     from stellar_tpu.bucket.bucket_list_db import (
         SearchableBucketListSnapshot,
     )
+    predicate = None
+    if getattr(args, "filter", None):
+        from stellar_tpu.utils.xdrquery import compile_query
+        predicate = compile_query(args.filter)
     snap = SearchableBucketListSnapshot.from_bucket_list(snapshot)
     for kb, entry in snap.iter_live_entries():
         if count >= limit:
             break
+        if predicate is not None and not predicate(entry):
+            continue
         print(json.dumps({
             "type": LedgerEntryType.name_of(entry.data.arm),
             "key": kb.hex(),
@@ -397,6 +403,8 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_fuzz)
     sp = sub.add_parser("dump-ledger")
     sp.add_argument("--limit", type=int, default=1000)
+    sp.add_argument("--filter", help="xdrquery, e.g. "
+                    "\"type=='ACCOUNT' && data.balance > 1000000\"")
     sp.set_defaults(fn=cmd_dump_ledger)
     sp = sub.add_parser("sign-transaction")
     sp.add_argument("file", help="binary TransactionEnvelope XDR")

@@ -1,0 +1,31 @@
+"""xdrquery-lite tests (reference ``src/util/xdrquery`` role)."""
+
+from stellar_tpu.tx.ops.create_account import new_account_entry
+from stellar_tpu.utils.xdrquery import compile_query
+from stellar_tpu.xdr.types import account_id
+
+
+def acct(balance, raw=b"\x11" * 32):
+    return new_account_entry(account_id(raw), balance, 7)
+
+
+def test_type_and_balance_filters():
+    q = compile_query("type == 'ACCOUNT' && data.balance > 100")
+    assert q(acct(500))
+    assert not q(acct(50))
+    q = compile_query("type == 'TRUSTLINE'")
+    assert not q(acct(500))
+
+
+def test_field_paths_and_bytes():
+    q = compile_query("data.seqNum == 7")
+    assert q(acct(1))
+    q = compile_query("data.accountID == " + ("11" * 32))
+    assert q(acct(1))
+    assert not q(acct(1, raw=b"\x22" * 32))
+
+
+def test_bad_query_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        compile_query("not a query")
