@@ -205,6 +205,10 @@ class LedgerManager:
         # live state this close (reference startBackgroundEvictionScan,
         # LedgerManagerImpl.cpp:1072-1077)
         evicted_keys = self.eviction_scanner.scan(ltx, lcd.ledger_seq)
+        if evicted_keys:
+            from stellar_tpu.utils.metrics import registry
+            registry.counter("state.eviction.evicted").inc(
+                len(evicted_keys))
 
         # classify the close's entry delta and stamp lastModified —
         # this is what the bucket list (and meta) see

@@ -137,6 +137,8 @@ class OverlayManager:
     def broadcast_transaction(self, frame, from_peer=None):
         """Pull-mode tx relay (reference TxAdverts): flood the HASH;
         peers demand the body if they don't have it."""
+        from stellar_tpu.utils.metrics import registry
+        registry.meter("overlay.flood.advertised").mark()
         tx_hash = frame.contents_hash()
         skip = {id(from_peer)} if from_peer is not None else set()
         for p in list(self.peers):
@@ -198,6 +200,9 @@ class OverlayManager:
                     MessageType.FLOOD_DEMAND,
                     FloodDemand(txHashes=demand)))
         elif t == MessageType.FLOOD_DEMAND:
+            from stellar_tpu.utils.metrics import registry
+            registry.meter("overlay.flood.demanded").mark(
+                len(msg.value.txHashes))
             for h in msg.value.txHashes:
                 frame = herder.get_pending_tx(h)
                 if frame is not None:

@@ -142,6 +142,8 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                             return False, self.make_result(
                                 InvCode.INVOKE_HOST_FUNCTION_ENTRY_ARCHIVED)
 
+            from stellar_tpu.utils.metrics import registry
+            registry.meter("soroban.host.invoke").mark()
             out = invoke_host_function(
                 self.body.hostFunction, footprint_entries, read_only,
                 read_write, self.body.auth, self.source_account_id(),
