@@ -14,6 +14,13 @@ Must run before jax arrays are created anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# persistent XLA compilation cache: the verify-kernel compiles dominate
+# suite time; cache across runs (safe to delete any time)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..",
+                                   ".jax_cache"))
+os.environ.setdefault(
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -30,6 +37,14 @@ def _drop_axon_backend():
         # The axon register hook hard-sets jax_platforms="axon,cpu" in the
         # config (env var alone doesn't win); point it back at cpu.
         jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ["JAX_COMPILATION_CACHE_DIR"])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0)
+        except Exception:
+            pass
         with xb._backend_lock:
             if xb._backends:
                 return  # backends already initialized; too late, leave it
