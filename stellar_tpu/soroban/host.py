@@ -196,8 +196,16 @@ class _Storage:
         self.read_write = read_write
         self.budget = budget
         self.ledger_seq = ledger_seq
+        # declared-resource accounting charges each entry ONCE per axis
+        # (reference: footprint entries load once / write once at the
+        # end), however often the contract touches it
+        self._read_charged: set = set()
+        self._write_sizes: Dict[bytes, int] = {}
         self.read_bytes = 0
-        self.write_bytes = 0
+
+    @property
+    def write_bytes(self) -> int:
+        return sum(self._write_sizes.values())
 
     def _check_live(self, kb: bytes, slot):
         lu = slot[1]
@@ -213,7 +221,9 @@ class _Storage:
             return None
         self._check_live(kb, slot)
         size = len(to_bytes(LedgerEntry, slot[0]))
-        self.read_bytes += size
+        if kb not in self._read_charged:
+            self._read_charged.add(kb)
+            self.read_bytes += size
         self.budget.charge(CPU_PER_STORAGE_OP + CPU_PER_BYTE * size)
         return slot[0]
 
@@ -223,7 +233,7 @@ class _Storage:
             raise HostError(HostError.TRAPPED,
                             "write outside declared footprint")
         size = len(to_bytes(LedgerEntry, entry))
-        self.write_bytes += size
+        self._write_sizes[kb] = size  # final size counts, once per key
         self.budget.charge(CPU_PER_STORAGE_OP + CPU_PER_BYTE * size, size)
         slot = self.entries.setdefault(kb, [None, None, False])
         slot[0] = entry
