@@ -74,7 +74,48 @@ class TxFuzzer:
         )
         from stellar_tpu.xdr.types import Price
         r = self.rng
-        choice = r.randrange(7)
+        choice = r.randrange(9)
+        if choice == 7:
+            # sponsorship sandwich fragments (often invalid: missing
+            # Begin/End pairing exercises txBAD_SPONSORSHIP)
+            from stellar_tpu.xdr.tx import (
+                BeginSponsoringFutureReservesOp,
+            )
+            if r.random() < 0.5:
+                body = OperationBody.make(
+                    OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                    BeginSponsoringFutureReservesOp(
+                        sponsoredID=self._account()))
+            else:
+                body = OperationBody.make(
+                    OperationType.END_SPONSORING_FUTURE_RESERVES, None)
+            return Operation(sourceAccount=None, body=body)
+        if choice == 8:
+            from stellar_tpu.xdr.tx import (
+                LiquidityPoolDepositOp, LiquidityPoolWithdrawOp,
+            )
+            if r.random() < 0.5:
+                body = OperationBody.make(
+                    OperationType.LIQUIDITY_POOL_DEPOSIT,
+                    LiquidityPoolDepositOp(
+                        liquidityPoolID=bytes(
+                            r.randrange(256) for _ in range(32)),
+                        maxAmountA=self._amount(),
+                        maxAmountB=self._amount(),
+                        minPrice=Price(n=r.randrange(-2, 100),
+                                       d=r.randrange(-2, 100)),
+                        maxPrice=Price(n=r.randrange(-2, 100),
+                                       d=r.randrange(-2, 100))))
+            else:
+                body = OperationBody.make(
+                    OperationType.LIQUIDITY_POOL_WITHDRAW,
+                    LiquidityPoolWithdrawOp(
+                        liquidityPoolID=bytes(
+                            r.randrange(256) for _ in range(32)),
+                        amount=self._amount(),
+                        minAmountA=self._amount(),
+                        minAmountB=self._amount()))
+            return Operation(sourceAccount=None, body=body)
         if choice == 0:
             body = OperationBody.make(OperationType.PAYMENT, PaymentOp(
                 destination=self._muxed(), asset=self._asset(),

@@ -259,10 +259,10 @@ class _ManageOfferBase(OperationFrame):
         selling_liab, buying_liab = self._own_liabilities()
         if wheat_limit < buying_liab:
             f = self._fail("LINE_FULL")
-            return False, f[1], 0, 0, []
+            return False, f[1], None, 0, 0, []
         if sheep_limit < selling_liab:
             f = self._fail("UNDERFUNDED")
-            return False, f[1], 0, 0, []
+            return False, f[1], None, 0, 0, []
         max_sheep, max_wheat = self.apply_specific_limits(
             sheep_limit, 0, wheat_limit, 0)
         if max_wheat == 0:
