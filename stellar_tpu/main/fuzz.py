@@ -184,6 +184,23 @@ class TxFuzzer:
 
     # ---------------- the campaign ----------------
 
+    def _mutant_frame(self, source):
+        """Byte-level mutant of a valid signed envelope (the reference
+        fuzzer's raw-XDR mode): either unparsable (fine) or a parsed
+        frame with corrupted fields."""
+        from stellar_tpu.tx.tx_test_utils import payment_op
+        from stellar_tpu.tx.transaction_frame import make_transaction_frame
+        from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+        from stellar_tpu.xdr.tx import TransactionEnvelope
+        base = self._make_frame(
+            source, [payment_op(self.rng.choice(self.keys), XLM)])
+        raw = bytearray(to_bytes(TransactionEnvelope, base.envelope))
+        for _ in range(self.rng.randrange(1, 6)):
+            raw[self.rng.randrange(len(raw))] ^= \
+                1 << self.rng.randrange(8)
+        env = from_bytes(TransactionEnvelope, bytes(raw))  # may raise
+        return make_transaction_frame(self.lm.network_id, env)
+
     def step(self):
         from stellar_tpu.herder.tx_set import (
             make_tx_set_from_transactions,
@@ -191,16 +208,29 @@ class TxFuzzer:
         from stellar_tpu.invariant.invariants import InvariantDoesNotHold
         from stellar_tpu.ledger.ledger_manager import LedgerCloseData
         source = self.rng.choice(self.keys)
-        ops = [self._random_op()
-               for _ in range(self.rng.randrange(1, 4))]
         try:
-            frame = self._make_frame(source, ops)
+            if self.rng.random() < 0.2:
+                frame = self._mutant_frame(source)
+            else:
+                ops = [self._random_op()
+                       for _ in range(self.rng.randrange(1, 4))]
+                frame = self._make_frame(source, ops)
         except Exception:
             self.rejected += 1  # malformed beyond envelope construction
             return
         lcl = self.lm.last_closed_header
         txset, _ = make_tx_set_from_transactions(
             [frame], lcl, self.lm.last_closed_hash)
+        # the consensus trust boundary: only CERTIFIED sets reach
+        # close_ledger (validateValue -> checkValid); a set that fails
+        # validation is simply never externalized
+        from stellar_tpu.ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(self.lm.root) as scope:
+            set_ok = txset.check_valid(scope, self.lm.last_closed_hash)
+            scope.rollback()
+        if not set_ok:
+            self.rejected += 1
+            return
         try:
             res = self.lm.close_ledger(LedgerCloseData(
                 lcl.ledgerSeq + 1, txset,
