@@ -189,6 +189,62 @@ def cmd_self_check(args) -> int:
     return 0
 
 
+def cmd_sec_to_pub(args) -> int:
+    """Seed (stdin or --conf NODE_SEED) -> public strkey (reference
+    ``sec-to-pub``)."""
+    from stellar_tpu.crypto.keys import SecretKey
+    cfg = _load_config(args)
+    if cfg.NODE_SEED is not None:
+        sk = cfg.NODE_SEED
+    else:
+        seed = sys.stdin.readline().strip()
+        sk = SecretKey.from_strkey_seed(seed) if seed.startswith("S") \
+            else SecretKey.from_seed_str(seed)
+    print(sk.public_key.to_strkey())
+    return 0
+
+
+def cmd_convert_id(args) -> int:
+    """Translate an id between strkey and hex forms (reference
+    ``convert-id``)."""
+    from stellar_tpu.crypto import strkey
+    ident = args.id
+    out = {"input": ident}
+    if ident.startswith("G") and len(ident) == 56:
+        out["hex"] = strkey.decode_account(ident).hex()
+    else:
+        raw = bytes.fromhex(ident)
+        out["strkey"] = strkey.encode_account(raw)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_http_command(args) -> int:
+    """Send a command to a running node's admin port (reference
+    ``http-command``)."""
+    import urllib.request
+    cfg = _load_config(args)
+    url = f"http://127.0.0.1:{cfg.HTTP_PORT}/{args.command_line}"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        sys.stdout.write(r.read().decode() + "\n")
+    return 0
+
+
+def cmd_gen_fuzz(args) -> int:
+    """Write a seed corpus entry: one valid signed envelope as raw XDR
+    (reference ``gen-fuzz``)."""
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.tx.tx_test_utils import keypair, make_tx, payment_op
+    from stellar_tpu.xdr.runtime import to_bytes
+    from stellar_tpu.xdr.tx import TransactionEnvelope
+    a, b = keypair("fuzz-seed-a"), keypair("fuzz-seed-b")
+    frame = make_tx(a, (1 << 32) + 1, [payment_op(b, 10_000_000)])
+    with open(args.file, "wb") as f:
+        f.write(to_bytes(TransactionEnvelope, frame.envelope))
+    print(json.dumps({"written": args.file}))
+    return 0
+
+
 def cmd_fuzz(args) -> int:
     """Deterministic fuzz campaign (reference ``fuzz`` CLI +
     FuzzerImpl tx/overlay modes)."""
@@ -396,6 +452,16 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_print_xdr)
     sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
     sub.add_parser("new-db").set_defaults(fn=cmd_new_db)
+    sub.add_parser("sec-to-pub").set_defaults(fn=cmd_sec_to_pub)
+    sp = sub.add_parser("convert-id")
+    sp.add_argument("id")
+    sp.set_defaults(fn=cmd_convert_id)
+    sp = sub.add_parser("http-command")
+    sp.add_argument("command_line", help="e.g. 'info' or 'll?level=debug'")
+    sp.set_defaults(fn=cmd_http_command)
+    sp = sub.add_parser("gen-fuzz")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_gen_fuzz)
     sp = sub.add_parser("fuzz")
     sp.add_argument("--mode", choices=["tx", "overlay"], default="tx")
     sp.add_argument("--iterations", type=int, default=1000)
