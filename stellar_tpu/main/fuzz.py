@@ -171,7 +171,7 @@ class TxFuzzer:
                           for _ in range(self.rng.randrange(0, 3))]))
         return Operation(sourceAccount=None, body=body)
 
-    def _make_frame(self, source, ops):
+    def _make_frame(self, source, ops, soroban_data=None, fee=10_000):
         from stellar_tpu.tx.tx_test_utils import make_tx
         from stellar_tpu.ledger.ledger_txn import key_bytes
         from stellar_tpu.tx.op_frame import account_key
@@ -179,8 +179,51 @@ class TxFuzzer:
         e = self.lm.root.store.get(key_bytes(account_key(
             account_id(source.public_key.raw))))
         seq = e.data.value.seqNum + 1 if e is not None else 1
-        return make_tx(source, seq, ops, fee=10_000,
-                       network_id=self.lm.network_id)
+        return make_tx(source, seq, ops, fee=fee,
+                       network_id=self.lm.network_id,
+                       soroban_data=soroban_data)
+
+    def _soroban_frame(self, source):
+        """Random Soroban tx: uploads of valid/garbage code with
+        random-ish footprints and resource declarations."""
+        from stellar_tpu.crypto.sha import sha256
+        from stellar_tpu.soroban.host import (
+            assemble_program, contract_code_key, ins, sym, u32,
+        )
+        from stellar_tpu.xdr.contract import HostFunction, HostFunctionType
+        from stellar_tpu.xdr.tx import (
+            InvokeHostFunctionOp, LedgerFootprint, Operation,
+            OperationBody, OperationType, SorobanResources,
+            SorobanTransactionData,
+        )
+        from stellar_tpu.xdr.types import ExtensionPoint
+        r = self.rng
+        if r.random() < 0.5:
+            code = assemble_program({
+                f"f{r.randrange(4)}": [ins("push", u32(r.randrange(99))),
+                                       ins("ret")]})
+        else:
+            code = bytes(r.randrange(256)
+                         for _ in range(r.randrange(0, 200)))
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            code)
+        rw = [contract_code_key(sha256(code))]
+        if r.random() < 0.3:
+            rw = []  # missing footprint: must trap, not crash
+        sd = SorobanTransactionData(
+            ext=ExtensionPoint.make(0),
+            resources=SorobanResources(
+                footprint=LedgerFootprint(readOnly=[], readWrite=rw),
+                instructions=r.choice([0, 1000, 2_000_000]),
+                readBytes=r.choice([0, 3000]),
+                writeBytes=r.choice([0, 3000])),
+            resourceFee=r.choice([0, 1000, 5_000_000]))
+        op = Operation(sourceAccount=None, body=OperationBody.make(
+            OperationType.INVOKE_HOST_FUNCTION,
+            InvokeHostFunctionOp(hostFunction=fn, auth=[])))
+        return self._make_frame(source, [op], soroban_data=sd,
+                                fee=6_000_000)
 
     # ---------------- the campaign ----------------
 
@@ -209,8 +252,11 @@ class TxFuzzer:
         from stellar_tpu.ledger.ledger_manager import LedgerCloseData
         source = self.rng.choice(self.keys)
         try:
-            if self.rng.random() < 0.2:
+            roll = self.rng.random()
+            if roll < 0.2:
                 frame = self._mutant_frame(source)
+            elif roll < 0.35:
+                frame = self._soroban_frame(source)
             else:
                 ops = [self._random_op()
                        for _ in range(self.rng.randrange(1, 4))]
