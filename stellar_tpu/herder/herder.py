@@ -327,8 +327,9 @@ class Herder:
         # exactly what this node scheduled) — reference
         # validateUpgrades in HerderSCPDriver::validateValueHelper
         for raw in sv.upgrades:
-            if not self.upgrades.is_valid(raw, lcl, nomination,
-                                          sv.closeTime):
+            if not self.upgrades.is_valid(
+                    raw, lcl, nomination, sv.closeTime,
+                    state_getter=self.lm.root.store.get):
                 return ValidationLevel.INVALID
         if slot_index != lcl.ledgerSeq + 1:
             # can't fully validate against a non-current ledger
@@ -354,7 +355,9 @@ class Herder:
         if sv.closeTime <= lcl.scpValue.closeTime:
             return None
         kept = [u for u in sv.upgrades
-                if self.upgrades.is_valid(u, lcl, True, sv.closeTime)]
+                if self.upgrades.is_valid(
+                    u, lcl, True, sv.closeTime,
+                    state_getter=self.lm.root.store.get)]
         if len(kept) == len(sv.upgrades):
             return value
         return to_bytes(StellarValue, basic_stellar_value(

@@ -52,6 +52,44 @@ class UpgradeParameters:
     base_reserve: Optional[int] = None
     flags: Optional[int] = None
     max_soroban_tx_set_size: Optional[int] = None
+    config_upgrade_set_key: Optional[object] = None  # ConfigUpgradeSetKey
+
+
+def config_upgrade_entry_key(key) -> bytes:
+    """The contract-data location of a published ConfigUpgradeSet
+    (reference SettingsUpgradeUtils: a TEMPORARY entry under
+    key.contractID keyed by SCV_BYTES(contentHash))."""
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.host import (
+        contract_data_key, scaddress_contract, scbytes,
+    )
+    from stellar_tpu.xdr.contract import ContractDataDurability
+    return key_bytes(contract_data_key(
+        scaddress_contract(key.contractID), scbytes(key.contentHash),
+        ContractDataDurability.TEMPORARY))
+
+
+def load_config_upgrade_set(key, state_getter):
+    """Load + hash-verify + parse the published ConfigUpgradeSet, or
+    None (reference ``ConfigUpgradeSetFrame::makeFromKey``)."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.contract import ConfigUpgradeSet, SCValType
+    entry = state_getter(config_upgrade_entry_key(key))
+    if entry is None:
+        return None
+    val = entry.data.value.val
+    if val.arm != SCValType.SCV_BYTES:
+        return None
+    raw = val.value
+    if sha256(raw) != key.contentHash:
+        return None
+    try:
+        upgrade_set = from_bytes(ConfigUpgradeSet, raw)
+    except Exception:
+        return None
+    if not upgrade_set.updatedEntry:
+        return None
+    return upgrade_set
 
 
 class Upgrades:
@@ -62,9 +100,12 @@ class Upgrades:
 
     # ---------------- validation ----------------
 
-    def is_valid_for_apply(self, raw: bytes, header) -> int:
+    def is_valid_for_apply(self, raw: bytes, header,
+                           state_getter=None) -> int:
         """UpgradeValidity for one opaque upgrade against the current
-        header (reference ``Upgrades::isValidForApply``)."""
+        header (reference ``Upgrades::isValidForApply``).
+        ``state_getter(kb) -> LedgerEntry|None`` gives CONFIG upgrades
+        access to the published ConfigUpgradeSet entry."""
         try:
             up = from_bytes(LedgerUpgrade, bytes(raw))
         except Exception:
@@ -83,9 +124,10 @@ class Upgrades:
             ok = version >= 18 and \
                 (up.value & ~MASK_LEDGER_HEADER_FLAGS) == 0
         elif t == LUT.LEDGER_UPGRADE_CONFIG:
-            # needs a ConfigUpgradeSet published in contract data; until
-            # the Soroban config machinery lands, never valid
-            return UpgradeValidity.INVALID
+            if version < SOROBAN_PROTOCOL_VERSION or state_getter is None:
+                return UpgradeValidity.INVALID
+            ok = load_config_upgrade_set(up.value, state_getter) \
+                is not None
         elif t == LUT.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
             ok = version >= SOROBAN_PROTOCOL_VERSION
         else:
@@ -113,11 +155,18 @@ class Upgrades:
         if t == LUT.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
             return p.max_soroban_tx_set_size is not None and \
                 up.value == p.max_soroban_tx_set_size
+        if t == LUT.LEDGER_UPGRADE_CONFIG:
+            k = p.config_upgrade_set_key
+            return k is not None and \
+                up.value.contractID == k.contractID and \
+                up.value.contentHash == k.contentHash
         return False
 
     def is_valid(self, raw: bytes, header, nomination: bool,
-                 close_time: Optional[int] = None) -> bool:
-        if self.is_valid_for_apply(raw, header) != UpgradeValidity.VALID:
+                 close_time: Optional[int] = None,
+                 state_getter=None) -> bool:
+        if self.is_valid_for_apply(raw, header, state_getter) != \
+                UpgradeValidity.VALID:
             return False
         if nomination:
             up = from_bytes(LedgerUpgrade, bytes(raw))
@@ -155,6 +204,9 @@ class Upgrades:
             if cur != p.flags:
                 out.append(LedgerUpgrade.make(
                     LUT.LEDGER_UPGRADE_FLAGS, p.flags))
+        if p.config_upgrade_set_key is not None:
+            out.append(LedgerUpgrade.make(
+                LUT.LEDGER_UPGRADE_CONFIG, p.config_upgrade_set_key))
         return [to_bytes(LedgerUpgrade, u) for u in out]
 
     def remove_upgrades_once_done(self, header):

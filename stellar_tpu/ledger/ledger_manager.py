@@ -364,12 +364,50 @@ class LedgerManager:
                         1, LedgerHeaderExtensionV1(
                             flags=flags,
                             ext=LedgerHeaderExtensionV1._types[1].make(0)))
+            elif t == LedgerUpgradeType.LEDGER_UPGRADE_CONFIG:
+                self._apply_config_upgrade(ltx, up.value)
             else:
                 # CONFIG / MAX_SOROBAN_TX_SET_SIZE need the Soroban
                 # network-config store; validate-rejected at nomination,
                 # and tolerated (skipped) here so close never throws
                 raise NotImplementedError(
                     f"upgrade type {t} not supported yet")
+
+    def _apply_config_upgrade(self, ltx, key):
+        """LEDGER_UPGRADE_CONFIG: load the published ConfigUpgradeSet
+        and mutate the soroban network settings (reference
+        ``Upgrades::applyTo`` -> ConfigUpgradeSetFrame::applyTo)."""
+        from stellar_tpu.herder.upgrades import load_config_upgrade_set
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        from stellar_tpu.xdr.contract import ConfigSettingID as CSID
+
+        def getter(kb):
+            from stellar_tpu.xdr.types import LedgerKey
+            return ltx.load_without_record(from_bytes(LedgerKey, kb))
+        upgrade_set = load_config_upgrade_set(key, getter)
+        if upgrade_set is None:
+            raise ValueError("config upgrade set not published/invalid")
+        cfg = default_soroban_config()
+        for entry in upgrade_set.updatedEntry:
+            if entry.arm == CSID.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
+                v = entry.value
+                cfg.ledger_max_instructions = v.ledgerMaxInstructions
+                cfg.tx_max_instructions = v.txMaxInstructions
+                cfg.fee_rate_per_instructions_increment = \
+                    v.feeRatePerInstructionsIncrement
+                cfg.tx_memory_limit = v.txMemoryLimit
+            elif entry.arm == CSID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
+                cfg.ledger_max_tx_count = entry.value.ledgerMaxTxCount
+            elif entry.arm == CSID.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
+                v = entry.value
+                cfg.tx_max_size_bytes = v.txMaxSizeBytes
+                cfg.fee_tx_size_1kb = v.feeTxSize1KB
+            elif entry.arm == \
+                    CSID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES:
+                cfg.max_contract_size = entry.value
+            else:
+                raise ValueError(
+                    f"unsupported config setting arm {entry.arm}")
 
     @staticmethod
     def _calculate_skip_values(header: LedgerHeader):

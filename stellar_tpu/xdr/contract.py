@@ -460,3 +460,57 @@ class HashIDPreimageSorobanAuthorization(Struct):
               ("nonce", Int64),
               ("signatureExpirationLedger", Uint32),
               ("invocation", SorobanAuthorizedInvocation)]
+
+
+# ---------------- network config settings (upgradeable) ----------------
+
+ConfigSettingID = Enum("ConfigSettingID", {
+    "CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES": 0,
+    "CONFIG_SETTING_CONTRACT_COMPUTE_V0": 1,
+    "CONFIG_SETTING_CONTRACT_LEDGER_COST_V0": 2,
+    "CONFIG_SETTING_CONTRACT_HISTORICAL_DATA_V0": 3,
+    "CONFIG_SETTING_CONTRACT_EVENTS_V0": 4,
+    "CONFIG_SETTING_CONTRACT_BANDWIDTH_V0": 5,
+    "CONFIG_SETTING_CONTRACT_COST_PARAMS_CPU_INSTRUCTIONS": 6,
+    "CONFIG_SETTING_CONTRACT_COST_PARAMS_MEMORY_BYTES": 7,
+    "CONFIG_SETTING_CONTRACT_DATA_KEY_SIZE_BYTES": 8,
+    "CONFIG_SETTING_CONTRACT_DATA_ENTRY_SIZE_BYTES": 9,
+    "CONFIG_SETTING_STATE_ARCHIVAL": 10,
+    "CONFIG_SETTING_CONTRACT_EXECUTION_LANES": 11,
+    "CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW": 12,
+    "CONFIG_SETTING_EVICTION_ITERATOR": 13,
+})
+
+
+class ConfigSettingContractComputeV0(Struct):
+    FIELDS = [("ledgerMaxInstructions", Int64),
+              ("txMaxInstructions", Int64),
+              ("feeRatePerInstructionsIncrement", Int64),
+              ("txMemoryLimit", Uint32)]
+
+
+class ConfigSettingContractExecutionLanesV0(Struct):
+    FIELDS = [("ledgerMaxTxCount", Uint32)]
+
+
+class ConfigSettingContractBandwidthV0(Struct):
+    FIELDS = [("ledgerMaxTxsSizeBytes", Uint32),
+              ("txMaxSizeBytes", Uint32),
+              ("feeTxSize1KB", Int64)]
+
+
+# supported upgradeable arms (others reject at validation, reference
+# SettingsUpgradeUtils scope)
+ConfigSettingEntry = Union("ConfigSettingEntry", ConfigSettingID, {
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES: Uint32,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
+        ConfigSettingContractComputeV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
+        ConfigSettingContractBandwidthV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
+        ConfigSettingContractExecutionLanesV0,
+})
+
+
+class ConfigUpgradeSet(Struct):
+    FIELDS = [("updatedEntry", VarArray(ConfigSettingEntry))]

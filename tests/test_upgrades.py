@@ -157,3 +157,52 @@ def test_flags_disable_pool_deposit(tmp_path):
     res = apply_tx(make_tx(a, seq + 1, [deposit_op(pool_id, XLM, XLM)]))
     assert res.code == TC.txFAILED
     assert res.op_results[0].arm == OperationResultCode.opNOT_SUPPORTED
+
+
+def test_config_upgrade_through_consensus():
+    """A published ConfigUpgradeSet scheduled as LEDGER_UPGRADE_CONFIG
+    externalizes and mutates the soroban network settings network-wide
+    (reference SettingsUpgradeUtils + ConfigUpgradeSetFrame)."""
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_tpu.main.settings_upgrade import (
+        build_config_upgrade_publication,
+    )
+    from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+    from stellar_tpu.xdr.contract import (
+        ConfigSettingContractExecutionLanesV0, ConfigSettingEntry,
+        ConfigSettingID, ConfigUpgradeSet,
+    )
+    cfg = default_soroban_config()
+    old_cap = cfg.ledger_max_tx_count
+    try:
+        upgrade_set = ConfigUpgradeSet(updatedEntry=[
+            ConfigSettingEntry.make(
+                ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
+                ConfigSettingContractExecutionLanesV0(
+                    ledgerMaxTxCount=77))])
+        contract_id = b"\x42" * 32
+        sim = Topologies.core4(accounts=[(keypair("cu-rich"),
+                                          1000 * XLM)])
+        sim.start_all_nodes()
+        apps = list(sim.nodes.values())
+        assert sim.crank_until(
+            lambda: all(x.overlay.authenticated_count() >= 3
+                        for x in apps), 30)
+        # publish the set into every node's state (as a soroban tx
+        # would) and schedule the vote everywhere
+        entry, ttl, key = build_config_upgrade_publication(
+            contract_id, upgrade_set, apps[0].lm.ledger_seq,
+            live_until=10**6)
+        for app in apps:
+            with LedgerTxn(app.lm.root) as ltx:
+                ltx.create(entry).deactivate()
+                ltx.create(ttl).deactivate()
+                ltx.commit()
+            app.herder.upgrades.params = UpgradeParameters(
+                upgrade_time=0, config_upgrade_set_key=key)
+        target = apps[0].lm.ledger_seq + 3
+        assert sim.crank_until_ledger(target, timeout=300)
+        assert sim.in_consensus()
+        assert cfg.ledger_max_tx_count == 77
+    finally:
+        cfg.ledger_max_tx_count = old_cap
