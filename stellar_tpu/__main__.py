@@ -2,4 +2,8 @@ import sys
 
 from stellar_tpu.main.cli import main
 
-sys.exit(main())
+try:
+    sys.exit(main())
+except BrokenPipeError:
+    # downstream consumer (e.g. `| head`) closed the pipe mid-write
+    sys.exit(0)

@@ -23,7 +23,7 @@ from typing import List, Optional, Tuple
 
 __all__ = ["Database", "PersistentState", "NodePersistence"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS storestate (
@@ -53,26 +53,74 @@ CREATE TABLE IF NOT EXISTS scphistory (
 CREATE INDEX IF NOT EXISTS scphistorybyseq ON scphistory (ledgerseq);
 """
 
+_TXSETS_DDL = """
+CREATE TABLE IF NOT EXISTS txsets (
+    ledgerseq INTEGER PRIMARY KEY,
+    txset     BLOB
+);
+"""
+_SCHEMA += _TXSETS_DDL
+
+# schema version -> DDL bringing it to version+1 (reference
+# ``Database::applySchemaUpgrade``; run by the ``upgrade-db`` CLI)
+_MIGRATIONS = {
+    1: _TXSETS_DDL,
+}
+
 
 class Database:
     """Thin sqlite3 wrapper (reference soci ``Database``). ``path`` may
     be ``:memory:`` for tests."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", for_upgrade: bool = False):
         self.path = path
         self.conn = sqlite3.connect(path)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=FULL")
-        self.initialize()
+        if not for_upgrade:
+            self.initialize()
 
     def initialize(self):
-        """Create/upgrade the schema (reference ``new-db`` /
-        ``upgrade-db``)."""
-        with self.conn:
-            self.conn.executescript(_SCHEMA)
-        ps = PersistentState(self)
-        if ps.get(PersistentState.DATABASE_SCHEMA) is None:
-            ps.set(PersistentState.DATABASE_SCHEMA, str(SCHEMA_VERSION))
+        """Create the schema on a fresh database (reference ``new-db``).
+        An existing database at an older schema version is refused, like
+        the reference — run ``upgrade-db`` first."""
+        has_state = self.conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' "
+            "AND name='storestate'").fetchone() is not None
+        if not has_state:
+            with self.conn:
+                self.conn.executescript(_SCHEMA)
+            PersistentState(self).set(PersistentState.DATABASE_SCHEMA,
+                                      str(SCHEMA_VERSION))
+            return
+        current = self.schema_version()
+        if current < SCHEMA_VERSION:
+            raise RuntimeError(
+                f"database schema is version {current}, need "
+                f"{SCHEMA_VERSION}: run upgrade-db")
+        if current > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"database schema {current} is newer than this binary "
+                f"({SCHEMA_VERSION})")
+
+    def schema_version(self) -> int:
+        v = PersistentState(self).get(PersistentState.DATABASE_SCHEMA)
+        return int(v) if v is not None else 0
+
+    def upgrade_schema(self) -> List[int]:
+        """Apply pending migrations in order; returns the versions
+        stepped through (reference ``upgrade-db``)."""
+        applied = []
+        while (v := self.schema_version()) < SCHEMA_VERSION:
+            ddl = _MIGRATIONS.get(v)
+            if ddl is None:
+                raise RuntimeError(f"no migration from schema {v}")
+            with self.conn:
+                self.conn.executescript(ddl)
+            PersistentState(self).set(PersistentState.DATABASE_SCHEMA,
+                                      str(v + 1))
+            applied.append(v + 1)
+        return applied
 
     def close(self):
         self.conn.close()
@@ -130,6 +178,24 @@ class Database:
         return [(r[0], r[1], r[2]) for r in self.conn.execute(
             "SELECT txid, txbody, txresult FROM txhistory "
             "WHERE ledgerseq = ? ORDER BY txindex", (seq,))]
+
+    def store_txset(self, seq: int, txset_xdr: bytes,
+                    commit: bool = True):
+        """The applied GeneralizedTransactionSet per ledger — what the
+        ``publish`` CLI needs to rebuild checkpoint files after
+        downtime (reference keeps streamed .dirty checkpoint files)."""
+        sql = "INSERT OR REPLACE INTO txsets (ledgerseq, txset) VALUES (?, ?)"
+        if commit:
+            with self.conn:
+                self.conn.execute(sql, (seq, txset_xdr))
+        else:
+            self.conn.execute(sql, (seq, txset_xdr))
+
+    def load_txset(self, seq: int) -> Optional[bytes]:
+        row = self.conn.execute(
+            "SELECT txset FROM txsets WHERE ledgerseq = ?",
+            (seq,)).fetchone()
+        return row[0] if row else None
 
     # ---------------- scp history ----------------
 
@@ -189,7 +255,8 @@ class NodePersistence:
 
     def save_ledger(self, header, header_hash: bytes, bucket_list,
                     tx_rows: List[Tuple[bytes, bytes, bytes]],
-                    scp_rows: Optional[List[Tuple[bytes, bytes]]] = None):
+                    scp_rows: Optional[List[Tuple[bytes, bytes]]] = None,
+                    txset_xdr: Optional[bytes] = None):
         """Persist one closed ledger. Step 1: bucket files on disk.
         Step 2: one SQL transaction moving the LCL pointer."""
         from stellar_tpu.xdr.ledger import LedgerHeader
@@ -206,6 +273,9 @@ class NodePersistence:
             if scp_rows:
                 self.db.store_scp_history(header.ledgerSeq, scp_rows,
                                           commit=False)
+            if txset_xdr is not None:
+                self.db.store_txset(header.ledgerSeq, txset_xdr,
+                                    commit=False)
             self.state.set(PersistentState.BUCKET_LIST_STATE,
                            json.dumps(manifest), commit=False)
             self.state.set(PersistentState.LAST_CLOSED_LEDGER,

@@ -250,8 +250,11 @@ class LedgerManager:
                  to_bytes(TransactionEnvelope, f.envelope),
                  to_bytes(TransactionResult, pair.result))
                 for f, pair in zip(apply_order, result_pairs)]
-            self.persistence.save_ledger(header, self._lcl_hash,
-                                         self.bucket_list, tx_rows)
+            from stellar_tpu.xdr.ledger import GeneralizedTransactionSet
+            self.persistence.save_ledger(
+                header, self._lcl_hash, self.bucket_list, tx_rows,
+                txset_xdr=to_bytes(GeneralizedTransactionSet,
+                                   lcd.tx_set.xdr))
 
         result.header = header
         result.header_hash = self._lcl_hash

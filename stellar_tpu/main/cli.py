@@ -487,5 +487,7 @@ def main(argv=None) -> int:
     sp.add_argument("--scenario", default="close",
                     choices=["close", "catchup", "scp-storm"])
     sp.set_defaults(fn=cmd_apply_load)
+    from stellar_tpu.main.cli_offline import register as register_offline
+    register_offline(sub)
     args = p.parse_args(argv)
     return args.fn(args)
