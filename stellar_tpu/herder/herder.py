@@ -113,12 +113,16 @@ class Herder:
     def __init__(self, secret_key: SecretKey, network_id: bytes,
                  ledger_manager: LedgerManager, clock: VirtualClock,
                  qset: SCPQuorumSet, is_validator: bool = True,
-                 target_close_seconds: int = EXP_LEDGER_TIMESPAN_SECONDS):
+                 target_close_seconds: int = EXP_LEDGER_TIMESPAN_SECONDS,
+                 max_slots_to_remember: int = 12):
         self.secret_key = secret_key
         self.network_id = network_id
         self.lm = ledger_manager
         self.clock = clock
         self.target_close_seconds = target_close_seconds
+        # externalized-slot retention (reference MAX_SLOTS_TO_REMEMBER)
+        self.max_slots_to_remember = max(max_slots_to_remember,
+                                         SCP_EXTRA_LOOKBACK_LEDGERS)
         self.driver = _HerderSCPDriver(self)
         self.scp = SCP(self.driver, secret_key.public_key.raw,
                        is_validator, qset)
@@ -142,9 +146,14 @@ class Herder:
         # Soroban txs queue separately with their own (tx-count) limits
         # (reference SorobanTransactionQueue); pull-mode relay and set
         # building see both through the facade methods below
-        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        _scfg = getattr(ledger_manager, "soroban_config", None)
+        if _scfg is None:
+            from stellar_tpu.tx.ops.soroban_ops import (
+                default_soroban_config,
+            )
+            _scfg = default_soroban_config()
         self.soroban_tx_queue = TransactionQueue(
-            max_ops=2 * default_soroban_config().ledger_max_tx_count,
+            max_ops=2 * _scfg.ledger_max_tx_count,
             check_valid=self._check_tx_valid)
         self.state = HERDER_STATE.BOOTING
         self.tracking_slot = 0
@@ -444,14 +453,19 @@ class Herder:
         frames = self.tx_queue.get_transactions() + \
             self.soroban_tx_queue.get_transactions()
         txset, _ = make_tx_set_from_transactions(
-            frames, lcl, self.lm.last_closed_hash)
+            frames, lcl, self.lm.last_closed_hash,
+            soroban_config=getattr(self.lm, "soroban_config", None))
         self.recv_tx_set(txset)
         self.broadcast_tx_set(txset)
         close_time = max(self.clock.system_now(),
                          lcl.scpValue.closeTime + 1)
         sv = basic_stellar_value(
             txset.hash, close_time,
-            upgrades=self.upgrades.create_upgrades_for(lcl, close_time))
+            upgrades=self.upgrades.create_upgrades_for(
+                lcl, close_time,
+                soroban_config=getattr(self.lm, "soroban_config", None),
+                state_getter=self.lm.root.store.get
+                if hasattr(self.lm.root, "store") else None))
         prev = to_bytes(StellarValue, lcl.scpValue)
         self.scp.nominate(ledger_seq_to_trigger,
                           to_bytes(StellarValue, sv), prev)
@@ -475,7 +489,11 @@ class Herder:
         result = self.lm.close_ledger(LedgerCloseData(
             ledger_seq=slot_index, tx_set=txset,
             close_time=sv.closeTime, upgrades=list(sv.upgrades)))
-        self.upgrades.remove_upgrades_once_done(result.header)
+        self.upgrades.remove_upgrades_once_done(
+            result.header,
+            soroban_config=getattr(self.lm, "soroban_config", None),
+            state_getter=self.lm.root.store.get
+            if hasattr(self.lm.root, "store") else None)
         self.state = HERDER_STATE.TRACKING
         self.tracking_slot = slot_index + 1
         # queue bookkeeping
@@ -484,8 +502,12 @@ class Herder:
         self.tx_queue.max_ops = 2 * self.lm.last_closed_header.maxTxSetSize
         self.soroban_tx_queue.remove_applied(txset.frames)
         self.soroban_tx_queue.shift()
+        # config upgrades can change the per-ledger soroban cap mid-run
+        scfg = getattr(self.lm, "soroban_config", None)
+        if scfg is not None:
+            self.soroban_tx_queue.max_ops = 2 * scfg.ledger_max_tx_count
         # GC old slots + their timers + txsets
-        keep_from = max(1, slot_index - SCP_EXTRA_LOOKBACK_LEDGERS)
+        keep_from = max(1, slot_index - self.max_slots_to_remember)
         self.scp.purge_slots(keep_from)
         for key in [k for k in self._timers if k[0] < keep_from]:
             self._timers.pop(key).cancel()

@@ -85,7 +85,8 @@ def _build_account_queues(frames) -> Dict[bytes, List]:
 
 
 def make_tx_set_from_transactions(
-        frames: Sequence, lcl_header, lcl_hash: bytes
+        frames: Sequence, lcl_header, lcl_hash: bytes,
+        soroban_config=None,
 ) -> Tuple["ApplicableTxSetFrame", List]:
     """Build a valid (surge-priced) tx set from candidate frames.
 
@@ -118,8 +119,12 @@ def make_tx_set_from_transactions(
     excluded = list(exc_c)
     base_fee_s = lcl_header.baseFee
     if soroban_phase:
-        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
-        cap = default_soroban_config().ledger_max_tx_count
+        if soroban_config is None:
+            from stellar_tpu.tx.ops.soroban_ops import (
+                default_soroban_config,
+            )
+            soroban_config = default_soroban_config()
+        cap = soroban_config.ledger_max_tx_count
         inc_s, exc_s, full_s = \
             SurgePricingPriorityQueue.most_top_txs_within_limits(
                 soroban, SurgePricingLaneConfig(
@@ -282,9 +287,9 @@ class ApplicableTxSetFrame:
         header = ltx.header()
         if self.size_op() > header.maxTxSetSize:
             return False
-        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        from stellar_tpu.ledger.ledger_txn import soroban_config_of
         if self.soroban_tx_count() > \
-                default_soroban_config().ledger_max_tx_count:
+                soroban_config_of(ltx).ledger_max_tx_count:
             return False
         # soroban txs may only ride the soroban phase and vice versa
         for f in self.frames:

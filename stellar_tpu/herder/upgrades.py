@@ -177,7 +177,9 @@ class Upgrades:
 
     # ---------------- proposal ----------------
 
-    def create_upgrades_for(self, header, close_time: int) -> List[bytes]:
+    def create_upgrades_for(self, header, close_time: int,
+                            soroban_config=None,
+                            state_getter=None) -> List[bytes]:
         """The opaque upgrades this node votes for at nomination
         (reference ``Upgrades::createUpgradesFor``)."""
         if self.params.upgrade_time > close_time:
@@ -204,12 +206,50 @@ class Upgrades:
             if cur != p.flags:
                 out.append(LedgerUpgrade.make(
                     LUT.LEDGER_UPGRADE_FLAGS, p.flags))
-        if p.config_upgrade_set_key is not None:
+        if p.max_soroban_tx_set_size is not None and (
+                soroban_config is None or
+                soroban_config.ledger_max_tx_count !=
+                p.max_soroban_tx_set_size):
             out.append(LedgerUpgrade.make(
-                LUT.LEDGER_UPGRADE_CONFIG, p.config_upgrade_set_key))
+                LUT.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE,
+                p.max_soroban_tx_set_size))
+        if p.config_upgrade_set_key is not None:
+            # only nominate once the published ConfigUpgradeSet is
+            # actually loadable — a vote armed before the publication
+            # tx lands stays scheduled but silent (peers would reject
+            # a value carrying an unloadable set)
+            if state_getter is None or load_config_upgrade_set(
+                    p.config_upgrade_set_key, state_getter) is not None:
+                out.append(LedgerUpgrade.make(
+                    LUT.LEDGER_UPGRADE_CONFIG, p.config_upgrade_set_key))
         return [to_bytes(LedgerUpgrade, u) for u in out]
 
-    def remove_upgrades_once_done(self, header):
+    def _config_vote_done(self, soroban_config, state_getter) -> bool:
+        """True when the scheduled CONFIG vote can be retired: the
+        current network config already reflects the upgrade set (it
+        applied). An unloadable set does NOT retire the vote — the
+        publication may simply not have landed yet (create_upgrades_for
+        stays silent until it does)."""
+        import dataclasses
+        from stellar_tpu.ledger.network_config import (
+            apply_config_setting,
+        )
+        if state_getter is None or soroban_config is None:
+            return False
+        upgrade_set = load_config_upgrade_set(
+            self.params.config_upgrade_set_key, state_getter)
+        if upgrade_set is None:
+            return False
+        cfg = dataclasses.replace(soroban_config)
+        try:
+            for entry in upgrade_set.updatedEntry:
+                apply_config_setting(cfg, entry)
+        except ValueError:
+            return True  # can never apply: malformed for this node
+        return cfg == soroban_config
+
+    def remove_upgrades_once_done(self, header, soroban_config=None,
+                                  state_getter=None):
         """Clear votes that took effect (reference
         ``Upgrades::removeUpgrades`` after application)."""
         p = self.params
@@ -228,3 +268,11 @@ class Upgrades:
             cur = header.ext.value.flags if header.ext.arm == 1 else 0
             if cur == p.flags:
                 p.flags = None
+        if p.max_soroban_tx_set_size is not None and \
+                soroban_config is not None and \
+                soroban_config.ledger_max_tx_count == \
+                p.max_soroban_tx_set_size:
+            p.max_soroban_tx_set_size = None
+        if p.config_upgrade_set_key is not None and \
+                self._config_vote_done(soroban_config, state_getter):
+            p.config_upgrade_set_key = None

@@ -114,6 +114,22 @@ class LedgerManager:
         self.close_meta_stream: List = []  # downstream consumers hook
         from stellar_tpu.bucket.eviction import EvictionScanner
         self.eviction_scanner = EvictionScanner()
+        # Soroban network settings: the in-memory view of the
+        # CONFIG_SETTING ledger entries (restored from state, so
+        # upgraded values survive restart — reference
+        # LedgerManager::getSorobanNetworkConfig / updateNetworkConfig).
+        # A state with no stored settings uses the shared process-wide
+        # initial config (what a network looks like before its first
+        # config upgrade).
+        from stellar_tpu.ledger.network_config import load_network_config
+        self.soroban_config = load_network_config(self.root.store.get)
+        if self.soroban_config is None:
+            from stellar_tpu.tx.ops.soroban_ops import (
+                default_soroban_config,
+            )
+            self.soroban_config = default_soroban_config()
+        self.root.soroban_config = self.soroban_config
+        self._pending_soroban_config = None
 
     # ---------------- LCL accessors ----------------
 
@@ -192,8 +208,10 @@ class LedgerManager:
                     self._apply_upgrade(up_ltx, raw)
                     upgrade_metas.append((raw, up_ltx.get_changes()))
                     up_ltx.commit()
+                    self._promote_pending_soroban_config()
                 except Exception:
                     up_ltx.rollback()
+                    self._pending_soroban_config = None
                     raise
             except Exception as e:
                 import logging
@@ -369,20 +387,32 @@ class LedgerManager:
                             ext=LedgerHeaderExtensionV1._types[1].make(0)))
             elif t == LedgerUpgradeType.LEDGER_UPGRADE_CONFIG:
                 self._apply_config_upgrade(ltx, up.value)
+            elif t == LedgerUpgradeType.\
+                    LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
+                # reference: writes ledgerMaxTxCount into the
+                # CONFIG_SETTING_CONTRACT_EXECUTION_LANES entry
+                from stellar_tpu.xdr.contract import ConfigSettingID
+                import dataclasses
+                cfg = dataclasses.replace(self.soroban_config)
+                cfg.ledger_max_tx_count = up.value
+                self._write_config_settings(
+                    ltx, cfg,
+                    [ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES])
             else:
-                # CONFIG / MAX_SOROBAN_TX_SET_SIZE need the Soroban
-                # network-config store; validate-rejected at nomination,
-                # and tolerated (skipped) here so close never throws
+                # unknown arms are validate-rejected at nomination;
+                # raising here makes close skip (log) them defensively
                 raise NotImplementedError(
-                    f"upgrade type {t} not supported yet")
+                    f"upgrade type {t} not supported")
 
     def _apply_config_upgrade(self, ltx, key):
         """LEDGER_UPGRADE_CONFIG: load the published ConfigUpgradeSet
-        and mutate the soroban network settings (reference
-        ``Upgrades::applyTo`` -> ConfigUpgradeSetFrame::applyTo)."""
+        and write the updated CONFIG_SETTING ledger entries (reference
+        ``Upgrades::applyTo`` -> ConfigUpgradeSetFrame::applyTo). The
+        new settings live in ledger state, so they persist across
+        restart and replay deterministically."""
         from stellar_tpu.herder.upgrades import load_config_upgrade_set
-        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
-        from stellar_tpu.xdr.contract import ConfigSettingID as CSID
+        from stellar_tpu.ledger.network_config import apply_config_setting
+        import dataclasses
 
         def getter(kb):
             from stellar_tpu.xdr.types import LedgerKey
@@ -390,27 +420,38 @@ class LedgerManager:
         upgrade_set = load_config_upgrade_set(key, getter)
         if upgrade_set is None:
             raise ValueError("config upgrade set not published/invalid")
-        cfg = default_soroban_config()
+        cfg = dataclasses.replace(self.soroban_config)
         for entry in upgrade_set.updatedEntry:
-            if entry.arm == CSID.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
-                v = entry.value
-                cfg.ledger_max_instructions = v.ledgerMaxInstructions
-                cfg.tx_max_instructions = v.txMaxInstructions
-                cfg.fee_rate_per_instructions_increment = \
-                    v.feeRatePerInstructionsIncrement
-                cfg.tx_memory_limit = v.txMemoryLimit
-            elif entry.arm == CSID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
-                cfg.ledger_max_tx_count = entry.value.ledgerMaxTxCount
-            elif entry.arm == CSID.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
-                v = entry.value
-                cfg.tx_max_size_bytes = v.txMaxSizeBytes
-                cfg.fee_tx_size_1kb = v.feeTxSize1KB
-            elif entry.arm == \
-                    CSID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES:
-                cfg.max_contract_size = entry.value
+            apply_config_setting(cfg, entry)
+        self._write_config_settings(
+            ltx, cfg, [e.arm for e in upgrade_set.updatedEntry])
+
+    def _write_config_settings(self, ltx, cfg, setting_ids):
+        """Create/update the CONFIG_SETTING entries for ``setting_ids``
+        to match ``cfg``; the refreshed view is staged and promoted once
+        the upgrade's nested ltx commits."""
+        from stellar_tpu.ledger.network_config import (
+            config_setting_ledger_entry, config_setting_ledger_key,
+            setting_entry_from_config,
+        )
+        seq = ltx.header().ledgerSeq
+        for sid in dict.fromkeys(setting_ids):
+            se = setting_entry_from_config(cfg, sid)
+            handle = ltx.load(config_setting_ledger_key(sid))
+            if handle is not None:
+                handle.entry.data = config_setting_ledger_entry(
+                    se, seq).data
+                handle.deactivate()
             else:
-                raise ValueError(
-                    f"unsupported config setting arm {entry.arm}")
+                ltx.create(
+                    config_setting_ledger_entry(se, seq)).deactivate()
+        self._pending_soroban_config = cfg
+
+    def _promote_pending_soroban_config(self):
+        if self._pending_soroban_config is not None:
+            self.soroban_config = self._pending_soroban_config
+            self.root.soroban_config = self.soroban_config
+            self._pending_soroban_config = None
 
     @staticmethod
     def _calculate_skip_values(header: LedgerHeader):

@@ -77,6 +77,9 @@ def entry_to_key(entry: LedgerEntry):
     elif t == LedgerEntryType.CONTRACT_CODE:
         from stellar_tpu.xdr.contract import LedgerKeyContractCode
         body = LedgerKeyContractCode(hash=v.hash)
+    elif t == LedgerEntryType.CONFIG_SETTING:
+        from stellar_tpu.xdr.types import LedgerKeyConfigSetting
+        body = LedgerKeyConfigSetting(configSettingID=v.arm)
     elif t == LedgerEntryType.TTL:
         body = LedgerKeyTtl(keyHash=v.keyHash)
     else:
@@ -87,6 +90,26 @@ def entry_to_key(entry: LedgerEntry):
 def key_bytes(key) -> bytes:
     """Canonical identity of a LedgerKey: its XDR encoding."""
     return to_bytes(LedgerKey, key)
+
+
+def root_of(ltx):
+    """The LedgerTxnRoot at the bottom of a transaction chain — the
+    node-scoped anchor carrying e.g. the soroban network config."""
+    node = ltx
+    while isinstance(node, LedgerTxn):
+        node = node._parent
+    return node
+
+
+def soroban_config_of(ltx):
+    """The node's SorobanNetworkConfig via the root, or the process
+    defaults when the chain isn't anchored to a LedgerManager (unit
+    tests building bare roots)."""
+    cfg = getattr(root_of(ltx), "soroban_config", None)
+    if cfg is None:
+        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+        cfg = default_soroban_config()
+    return cfg
 
 
 def copy_entry(entry: LedgerEntry) -> LedgerEntry:

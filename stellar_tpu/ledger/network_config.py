@@ -2,18 +2,22 @@
 ``InitialSorobanNetworkConfig`` values + the resource-fee formulas from
 ``src/rust/src/lib.rs`` ``compute_transaction_resource_fee``).
 
-In the reference these live in CONFIG_SETTING ledger entries mutated by
-LEDGER_UPGRADE_CONFIG; here they are a plain object on the
-LedgerManager, upgradeable once the config-upgrade machinery lands —
-the *consumers* (fees, limits, TTLs) are what matter for parity.
-"""
+As in the reference, upgraded settings live in CONFIG_SETTING ledger
+entries (mutated by LEDGER_UPGRADE_CONFIG, persisted in the bucket
+list, restored on restart); ``SorobanNetworkConfig`` is the in-memory
+view the fee/limit consumers read (reference
+``LedgerManager::getSorobanNetworkConfig``). Settings without a stored
+entry take the initial values below."""
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
 __all__ = ["SorobanNetworkConfig", "compute_resource_fee",
-           "compute_rent_fee"]
+           "compute_rent_fee", "config_setting_ledger_key",
+           "load_network_config", "apply_config_setting",
+           "config_setting_ledger_entry", "setting_entry_from_config",
+           "UPGRADEABLE_SETTING_IDS"]
 
 DATA_SIZE_1KB_INCREMENT = 1024
 INSTRUCTIONS_INCREMENT = 10_000
@@ -42,6 +46,7 @@ class SorobanNetworkConfig:
     fee_write_1kb: int = 4_000
     # historical + bandwidth
     fee_historical_1kb: int = 100
+    ledger_max_txs_size_bytes: int = 100_000
     tx_max_size_bytes: int = 10_000
     fee_tx_size_1kb: int = 2_000
     # events
@@ -55,6 +60,113 @@ class SorobanNetworkConfig:
     temp_rent_rate_denominator: int = 2_524_800
     # per-ledger caps
     ledger_max_tx_count: int = 1
+
+
+# ---------------- CONFIG_SETTING ledger-entry binding ----------------
+# the upgradeable arms our ConfigSettingEntry union supports (reference
+# stores every arm; these are the ones SettingsUpgradeUtils upgrades)
+
+def _csid():
+    from stellar_tpu.xdr.contract import ConfigSettingID
+    return ConfigSettingID
+
+
+def UPGRADEABLE_SETTING_IDS():
+    c = _csid()
+    return (c.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES,
+            c.CONFIG_SETTING_CONTRACT_COMPUTE_V0,
+            c.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0,
+            c.CONFIG_SETTING_CONTRACT_EXECUTION_LANES)
+
+
+def config_setting_ledger_key(setting_id):
+    from stellar_tpu.xdr.types import (
+        LedgerEntryType, LedgerKey, LedgerKeyConfigSetting,
+    )
+    return LedgerKey.make(LedgerEntryType.CONFIG_SETTING,
+                          LedgerKeyConfigSetting(
+                              configSettingID=setting_id))
+
+
+def config_setting_ledger_entry(setting_entry, ledger_seq: int):
+    """Wrap a ConfigSettingEntry union value as a LedgerEntry."""
+    from stellar_tpu.xdr.types import LedgerEntry, LedgerEntryType
+    return LedgerEntry(
+        lastModifiedLedgerSeq=ledger_seq,
+        data=LedgerEntry._types[1].make(
+            LedgerEntryType.CONFIG_SETTING, setting_entry),
+        ext=LedgerEntry._types[2].make(0))
+
+
+def apply_config_setting(cfg: "SorobanNetworkConfig", entry) -> None:
+    """Mutate ``cfg`` from one ConfigSettingEntry (the shared setter
+    for restore-from-state and LEDGER_UPGRADE_CONFIG apply)."""
+    c = _csid()
+    if entry.arm == c.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
+        v = entry.value
+        cfg.ledger_max_instructions = v.ledgerMaxInstructions
+        cfg.tx_max_instructions = v.txMaxInstructions
+        cfg.fee_rate_per_instructions_increment = \
+            v.feeRatePerInstructionsIncrement
+        cfg.tx_memory_limit = v.txMemoryLimit
+    elif entry.arm == c.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
+        cfg.ledger_max_tx_count = entry.value.ledgerMaxTxCount
+    elif entry.arm == c.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
+        v = entry.value
+        cfg.ledger_max_txs_size_bytes = v.ledgerMaxTxsSizeBytes
+        cfg.tx_max_size_bytes = v.txMaxSizeBytes
+        cfg.fee_tx_size_1kb = v.feeTxSize1KB
+    elif entry.arm == c.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES:
+        cfg.max_contract_size = entry.value
+    else:
+        raise ValueError(f"unsupported config setting arm {entry.arm}")
+
+
+def setting_entry_from_config(cfg: "SorobanNetworkConfig", setting_id):
+    """The ConfigSettingEntry union value representing ``cfg``'s current
+    state of one setting (written back to the ledger at upgrade)."""
+    from stellar_tpu.xdr.contract import (
+        ConfigSettingContractBandwidthV0, ConfigSettingContractComputeV0,
+        ConfigSettingContractExecutionLanesV0, ConfigSettingEntry,
+    )
+    c = _csid()
+    if setting_id == c.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
+        val = ConfigSettingContractComputeV0(
+            ledgerMaxInstructions=cfg.ledger_max_instructions,
+            txMaxInstructions=cfg.tx_max_instructions,
+            feeRatePerInstructionsIncrement=(
+                cfg.fee_rate_per_instructions_increment),
+            txMemoryLimit=cfg.tx_memory_limit)
+    elif setting_id == c.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
+        val = ConfigSettingContractExecutionLanesV0(
+            ledgerMaxTxCount=cfg.ledger_max_tx_count)
+    elif setting_id == c.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
+        val = ConfigSettingContractBandwidthV0(
+            ledgerMaxTxsSizeBytes=cfg.ledger_max_txs_size_bytes,
+            txMaxSizeBytes=cfg.tx_max_size_bytes,
+            feeTxSize1KB=cfg.fee_tx_size_1kb)
+    elif setting_id == c.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES:
+        val = cfg.max_contract_size
+    else:
+        raise ValueError(f"unsupported config setting id {setting_id}")
+    return ConfigSettingEntry.make(setting_id, val)
+
+
+def load_network_config(getter):
+    """SorobanNetworkConfig from stored CONFIG_SETTING entries, or
+    None when the state holds none (a network that never applied a
+    config upgrade); ``getter(key_bytes) -> LedgerEntry|None``.
+    Settings without an entry keep the initial values (reference loads
+    all arms; a fresh network seeds them at the protocol-20 upgrade)."""
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    cfg = SorobanNetworkConfig()
+    found = False
+    for sid in UPGRADEABLE_SETTING_IDS():
+        entry = getter(key_bytes(config_setting_ledger_key(sid)))
+        if entry is not None:
+            apply_config_setting(cfg, entry.data.value)
+            found = True
+    return cfg if found else None
 
 
 def _kb_ceil_mul(fee_per_kb: int, size_bytes: int) -> int:

@@ -58,6 +58,8 @@ class Application:
             hdr.maxTxSetSize = config.MAX_TX_SET_SIZE
             hdr.ledgerVersion = config.LEDGER_PROTOCOL_VERSION
 
+        if config.QUORUM_SET is None and config.VALIDATORS:
+            config.resolve_quorum()
         qset = config.QUORUM_SET
         if qset is None:
             from stellar_tpu.scp.quorum import singleton_qset
@@ -65,7 +67,8 @@ class Application:
         self.herder = Herder(
             config.NODE_SEED, network_id, self.lm, self.clock, qset,
             is_validator=config.NODE_IS_VALIDATOR,
-            target_close_seconds=config.EXPECTED_LEDGER_CLOSE_TIME)
+            target_close_seconds=config.EXPECTED_LEDGER_CLOSE_TIME,
+            max_slots_to_remember=config.MAX_SLOTS_TO_REMEMBER)
         self.peer_auth = PeerAuth(config.NODE_SEED, network_id,
                                   self.clock.system_now())
         self.overlay = OverlayManager(self)

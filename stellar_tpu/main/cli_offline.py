@@ -67,6 +67,10 @@ def cmd_offline_info(args) -> int:
             "maxTxSetSize": h.maxTxSetSize,
             "bucketListHash": h.bucketListHash.hex(),
         }
+        import dataclasses
+        out["soroban_settings"] = dataclasses.asdict(lm.soroban_config)
+        if pers.state.get("forcescp") is not None:
+            out["forcescp"] = pers.state.get("forcescp") == "true"
     print(json.dumps(out, indent=2))
     return 0
 
@@ -259,10 +263,17 @@ def _complete_checkpoints_in_db(db, lcl: int):
     cp = 63
     while cp <= lcl:
         first = max(min_seq, first_in_checkpoint(cp))
-        row = db.conn.execute(
+        want = cp - first + 1
+        headers = db.conn.execute(
             "SELECT COUNT(*) FROM ledgerheaders WHERE ledgerseq "
-            "BETWEEN ? AND ?", (first, cp)).fetchone()
-        if first <= cp and row[0] == cp - first + 1:
+            "BETWEEN ? AND ?", (first, cp)).fetchone()[0]
+        # every ledger needs its stored txset too (pre-schema-2 or
+        # Maintainer-pruned rows can't rebuild a replayable archive —
+        # publishing headers without tx sets would poison catchup)
+        txsets = db.conn.execute(
+            "SELECT COUNT(*) FROM txsets WHERE ledgerseq "
+            "BETWEEN ? AND ?", (first, cp)).fetchone()[0]
+        if first <= cp and headers == want and txsets == want:
             out.append(cp)
         cp += 64
     return out

@@ -25,8 +25,18 @@ class Config:
 
     # consensus
     QUORUM_SET: Optional[SCPQuorumSet] = None
+    # declarative validator list + per-domain quality; when QUORUM_SET
+    # is absent the quorum is generated from these (reference
+    # ``[[VALIDATORS]]`` / ``[[HOME_DOMAINS]]``, Config.cpp:2425-2505)
+    VALIDATORS: List[Dict] = field(default_factory=list)
+    HOME_DOMAINS: List[Dict] = field(default_factory=list)
+    # how many node failures the quorum must tolerate; -1 = auto
+    # ((n-1)//3); 0 only with UNSAFE_QUORUM (reference FAILURE_SAFETY)
+    FAILURE_SAFETY: int = -1
+    UNSAFE_QUORUM: bool = False
     EXPECTED_LEDGER_CLOSE_TIME: int = 5
     MAX_TX_SET_SIZE: int = 100
+    MAX_SLOTS_TO_REMEMBER: int = 12
     RUN_STANDALONE: bool = False
     MANUAL_CLOSE: bool = False
 
@@ -93,7 +103,8 @@ class Config:
             "HTTP_QUERY_PORT", "METADATA_OUTPUT_STREAM",
             "AUTOMATIC_MAINTENANCE_PERIOD",
             "AUTOMATIC_MAINTENANCE_COUNT", "CATCHUP_COMPLETE",
-            "CATCHUP_RECENT",
+            "CATCHUP_RECENT", "FAILURE_SAFETY", "UNSAFE_QUORUM",
+            "MAX_SLOTS_TO_REMEMBER",
         }
         for key, value in raw.items():
             if key == "NODE_SEED":
@@ -102,12 +113,154 @@ class Config:
                     SecretKey.from_seed_str(value)
             elif key == "QUORUM_SET":
                 cfg.QUORUM_SET = _parse_quorum_set(value)
+            elif key in ("VALIDATORS", "HOME_DOMAINS"):
+                setattr(cfg, key, list(value))
             elif key in simple:
                 setattr(cfg, key, value)
             # unknown keys rejected like the reference's strict parser
             else:
                 raise ValueError(f"unknown config key {key}")
+        cfg.resolve_quorum()
         return cfg
+
+    # ---------------- quorum generation / validation ----------------
+
+    def resolve_quorum(self) -> None:
+        """Generate QUORUM_SET from VALIDATORS/HOME_DOMAINS when not
+        explicit, then sanity-check failure tolerance (reference
+        ``Config::generateQuorumSet`` + FAILURE_SAFETY validation)."""
+        if self.QUORUM_SET is None and self.VALIDATORS:
+            entries = parse_validators(self.VALIDATORS, self.HOME_DOMAINS)
+            self.QUORUM_SET = generate_quorum_set(entries)
+            for e in entries:
+                addr = e.get("ADDRESS")
+                if addr and addr not in self.KNOWN_PEERS:
+                    self.KNOWN_PEERS.append(addr)
+        if self.QUORUM_SET is not None:
+            self.validate_quorum(self.QUORUM_SET)
+
+    def validate_quorum(self, qset: SCPQuorumSet) -> None:
+        n = len(qset.validators) + len(qset.innerSets)
+        recommended = (n - 1) // 3
+        safety = self.FAILURE_SAFETY
+        if safety == -1:
+            safety = recommended
+        if safety == 0 and not self.UNSAFE_QUORUM and n > 1:
+            raise ValueError(
+                "FAILURE_SAFETY=0 requires UNSAFE_QUORUM=true")
+        tolerated = n - qset.threshold
+        if tolerated < safety and not self.UNSAFE_QUORUM and n > 1:
+            raise ValueError(
+                f"quorum threshold {qset.threshold}/{n} only tolerates "
+                f"{tolerated} failures < FAILURE_SAFETY {safety}; set "
+                "UNSAFE_QUORUM=true to override")
+
+
+QUALITY_LEVELS = {"LOW": 0, "MEDIUM": 1, "HIGH": 2, "CRITICAL": 3}
+
+
+def parse_validators(validators: List[Dict],
+                     home_domains: List[Dict]) -> List[Dict]:
+    """[[VALIDATORS]] + [[HOME_DOMAINS]] tables -> validated entries
+    (reference ``Config::parseValidators``): each entry needs NAME,
+    PUBLIC_KEY, HOME_DOMAIN, and a QUALITY either inline or via its
+    home domain."""
+    from stellar_tpu.crypto import strkey
+    domain_quality = {}
+    for d in home_domains:
+        if "HOME_DOMAIN" not in d or "QUALITY" not in d:
+            raise ValueError("HOME_DOMAINS entries need HOME_DOMAIN "
+                             "and QUALITY")
+        domain_quality[d["HOME_DOMAIN"]] = d["QUALITY"]
+    out = []
+    seen = set()
+    domain_seen_quality: Dict[str, str] = {}
+    for v in validators:
+        if "PUBLIC_KEY" not in v or "NAME" not in v or \
+                "HOME_DOMAIN" not in v:
+            raise ValueError(
+                "VALIDATORS entries need NAME, PUBLIC_KEY, HOME_DOMAIN")
+        q = v.get("QUALITY", domain_quality.get(v["HOME_DOMAIN"]))
+        if q not in QUALITY_LEVELS:
+            raise ValueError(
+                f"validator {v['NAME']}: unknown QUALITY {q!r}")
+        prev_q = domain_seen_quality.setdefault(v["HOME_DOMAIN"], q)
+        if prev_q != q:
+            raise ValueError(
+                f"validators of '{v['HOME_DOMAIN']}' must share one "
+                f"quality (saw {prev_q} and {q})")
+        if v["PUBLIC_KEY"] in seen:
+            raise ValueError(f"duplicate validator {v['NAME']}")
+        seen.add(v["PUBLIC_KEY"])
+        out.append({
+            "NAME": v["NAME"],
+            "KEY": make_node_id(strkey.decode_account(v["PUBLIC_KEY"])),
+            "HOME_DOMAIN": v["HOME_DOMAIN"],
+            "QUALITY": QUALITY_LEVELS[q],
+            "ADDRESS": v.get("ADDRESS"),
+        })
+    return out
+
+
+def _simple_majority(n: int) -> int:
+    return n // 2 + 1
+
+
+def _bft_threshold(n: int) -> int:
+    # tolerate f = (n-1)//3 failures: threshold = n - f
+    return n - (n - 1) // 3
+
+
+def _generate_quorum_set_helper(entries: List[Dict],
+                                cur_quality: int) -> SCPQuorumSet:
+    """One quality tier: an inner set per home domain (simple-majority
+    within the domain), plus one nested set for all lower tiers
+    (reference ``generateQuorumSetHelper``, Config.cpp:2425-2481)."""
+    i = 0
+    inner_sets = []
+    while i < len(entries) and entries[i]["QUALITY"] == cur_quality:
+        domain = entries[i]["HOME_DOMAIN"]
+        group = []
+        while i < len(entries) and \
+                entries[i]["HOME_DOMAIN"] == domain:
+            if entries[i]["QUALITY"] != cur_quality:
+                raise ValueError(
+                    f"validators of '{domain}' must share one quality")
+            group.append(entries[i]["KEY"])
+            i += 1
+        if len(group) < 3 and cur_quality >= QUALITY_LEVELS["HIGH"]:
+            raise ValueError(
+                f"HIGH/CRITICAL quality domain '{domain}' needs "
+                "redundancy of at least 3 validators")
+        inner_sets.append(SCPQuorumSet(
+            threshold=_simple_majority(len(group)),
+            validators=group, innerSets=[]))
+    rest = entries[i:]
+    if rest:
+        if rest[0]["QUALITY"] > cur_quality:
+            raise ValueError("validator qualities must be descending")
+        inner_sets.append(
+            _generate_quorum_set_helper(rest, rest[0]["QUALITY"]))
+    n = len(inner_sets)
+    threshold = n if cur_quality == QUALITY_LEVELS["CRITICAL"] \
+        else _bft_threshold(n)
+    return SCPQuorumSet(threshold=threshold, validators=[],
+                        innerSets=inner_sets)
+
+
+def generate_quorum_set(entries: List[Dict]) -> SCPQuorumSet:
+    """Automatic quorum from a validator list: sort by quality desc /
+    home domain asc, group into per-domain inner sets, nest lower
+    qualities (reference ``Config::generateQuorumSet``)."""
+    if not entries:
+        raise ValueError("no validators to build a quorum from")
+    todo = sorted(entries,
+                  key=lambda e: (-e["QUALITY"], e["HOME_DOMAIN"]))
+    qset = _generate_quorum_set_helper(todo, todo[0]["QUALITY"])
+    # a single top-level arm collapses to that arm (normalizeQSet)
+    while not qset.validators and len(qset.innerSets) == 1:
+        qset = qset.innerSets[0]
+    return qset
 
 
 def _parse_quorum_set(d: Dict) -> SCPQuorumSet:

@@ -28,7 +28,7 @@ class Maintainer:
                             checkpoint_containing(self.app.lm.ledger_seq))
         deleted = 0
         with db.conn:
-            for table in ("scphistory", "txhistory"):
+            for table in ("scphistory", "txhistory", "txsets"):
                 cur = db.conn.execute(
                     f"DELETE FROM {table} WHERE ledgerseq < ?",
                     (keep_from,))

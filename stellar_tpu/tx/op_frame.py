@@ -147,17 +147,24 @@ class OperationFrame:
                     for_apply: bool):
         """(ok, failure_result). Mirrors ``OperationFrame::checkValid``
         for protocol >= 19."""
-        if not for_apply:
-            ok, fail = self.check_signature(checker, ltx, for_apply)
-            if not ok:
-                return False, fail
-        else:
-            if ltx.load_without_record(
-                    account_key(self.source_account_id())) is None:
-                return False, self.make_top_result(
-                    OperationResultCode.opNO_ACCOUNT)
-        ledger_version = ltx.header().ledgerVersion
-        return self.do_check_valid(ledger_version)
+        # anchor for state-scoped lookups inside do_check_valid (e.g.
+        # the node's soroban network config); cleared on exit so queued
+        # frames don't pin dead LedgerTxn chains
+        self._active_ltx = ltx
+        try:
+            if not for_apply:
+                ok, fail = self.check_signature(checker, ltx, for_apply)
+                if not ok:
+                    return False, fail
+            else:
+                if ltx.load_without_record(
+                        account_key(self.source_account_id())) is None:
+                    return False, self.make_top_result(
+                        OperationResultCode.opNO_ACCOUNT)
+            ledger_version = ltx.header().ledgerVersion
+            return self.do_check_valid(ledger_version)
+        finally:
+            self._active_ltx = None
 
     def apply(self, checker: "SignatureChecker", ltx):
         """(ok, result). checkValid(forApply) then doApply
@@ -165,7 +172,11 @@ class OperationFrame:
         ok, fail = self.check_valid(checker, ltx, for_apply=True)
         if not ok:
             return False, fail
-        return self.do_apply(ltx)
+        self._active_ltx = ltx
+        try:
+            return self.do_apply(ltx)
+        finally:
+            self._active_ltx = None
 
     # ---------------- per-op hooks ----------------
 

@@ -88,6 +88,10 @@ class _SorobanBase(OperationFrame):
         return self.soroban_data().resources
 
     def config(self):
+        ltx = getattr(self, "_active_ltx", None)
+        if ltx is not None:
+            from stellar_tpu.ledger.ledger_txn import soroban_config_of
+            return soroban_config_of(ltx)
         return default_soroban_config()
 
 

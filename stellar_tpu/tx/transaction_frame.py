@@ -549,14 +549,17 @@ class TransactionFrame:
         self._process_soroban_refund(ltx, result)
         return result
 
-    def soroban_refund_amount(self, success: bool) -> int:
+    def soroban_refund_amount(self, success: bool, cfg=None) -> int:
         """Unused refundable resource fee: declared - non-refundable -
         consumed(rent + events); consumption only counts on success."""
         if not self.is_soroban():
             return 0
         from stellar_tpu.ledger.network_config import compute_resource_fee
-        from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
-        cfg = default_soroban_config()
+        if cfg is None:
+            from stellar_tpu.tx.ops.soroban_ops import (
+                default_soroban_config,
+            )
+            cfg = default_soroban_config()
         res = self.tx.ext.value.resources
         fp = res.footprint
         non_ref, _ = compute_resource_fee(
@@ -571,7 +574,9 @@ class TransactionFrame:
                                 refund_to=None):
         """Return the unused refundable portion of the resource fee to
         the fee source (reference ``processRefund``)."""
-        refund = min(self.soroban_refund_amount(result.is_success),
+        from stellar_tpu.ledger.ledger_txn import soroban_config_of
+        refund = min(self.soroban_refund_amount(result.is_success,
+                                                soroban_config_of(ltx)),
                      result.fee_charged)  # only what was charged
         if refund <= 0:
             return
@@ -812,8 +817,10 @@ class FeeBumpTransactionFrame:
         result.inner_result = inner_res
         # a Soroban inner tx refunds unused resource fee to the OUTER
         # fee source, which paid it (reference FeeBump processRefund)
-        refund = min(self.inner.soroban_refund_amount(inner_res.is_success),
-                     result.fee_charged)
+        from stellar_tpu.ledger.ledger_txn import soroban_config_of
+        refund = min(self.inner.soroban_refund_amount(
+            inner_res.is_success, soroban_config_of(ltx)),
+            result.fee_charged)
         if refund > 0:
             with LedgerTxn(ltx) as scope:
                 src = scope.load(account_key(self.fee_source_id()))

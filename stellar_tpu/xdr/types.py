@@ -441,6 +441,11 @@ def _contract_code_entry():
     return ContractCodeEntry
 
 
+def _config_setting_entry():
+    from stellar_tpu.xdr.contract import ConfigSettingEntry
+    return ConfigSettingEntry
+
+
 LedgerEntryData = Union("LedgerEntry.data", LedgerEntryType, {
     LedgerEntryType.ACCOUNT: AccountEntry,
     LedgerEntryType.TRUSTLINE: TrustLineEntry,
@@ -450,6 +455,7 @@ LedgerEntryData = Union("LedgerEntry.data", LedgerEntryType, {
     LedgerEntryType.LIQUIDITY_POOL: LiquidityPoolEntry,
     LedgerEntryType.CONTRACT_DATA: _LazyArm(_contract_data_entry),
     LedgerEntryType.CONTRACT_CODE: _LazyArm(_contract_code_entry),
+    LedgerEntryType.CONFIG_SETTING: _LazyArm(_config_setting_entry),
     LedgerEntryType.TTL: TTLEntry,
 })
 
@@ -491,6 +497,17 @@ class LedgerKeyTtl(Struct):
     FIELDS = [("keyHash", Hash)]
 
 
+def _config_setting_id():
+    from stellar_tpu.xdr.contract import ConfigSettingID
+    return ConfigSettingID
+
+
+class LedgerKeyConfigSetting(Struct):
+    # field type resolved lazily (ConfigSettingID lives in contract.py,
+    # which imports this module)
+    FIELDS = [("configSettingID", _LazyArm(_config_setting_id))]
+
+
 def _contract_data_key():
     from stellar_tpu.xdr.contract import LedgerKeyContractData
     return LedgerKeyContractData
@@ -510,6 +527,7 @@ LedgerKey = Union("LedgerKey", LedgerEntryType, {
     LedgerEntryType.LIQUIDITY_POOL: LedgerKeyLiquidityPool,
     LedgerEntryType.CONTRACT_DATA: _LazyArm(_contract_data_key),
     LedgerEntryType.CONTRACT_CODE: _LazyArm(_contract_code_key),
+    LedgerEntryType.CONFIG_SETTING: LedgerKeyConfigSetting,
     LedgerEntryType.TTL: LedgerKeyTtl,
 })
 

@@ -295,3 +295,106 @@ def test_get_settings_upgrade_txs(tmp_path, capsys):
     out = _out(capsys)
     assert out["settings_updated"] == 1
     assert base64.b64decode(out["config_upgrade_set_key"])
+
+
+def test_validator_dsl_quorum_generation(tmp_path):
+    """[[VALIDATORS]]/[[HOME_DOMAINS]] generate the quorum set
+    (reference Config::generateQuorumSet): per-domain inner sets at
+    simple majority, tiers nested, CRITICAL requires all."""
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.main.config import Config
+
+    def pk(name):
+        return SecretKey.from_seed_str(name).public_key.to_strkey()
+    conf = tmp_path / "v.cfg"
+    conf.write_text(f'''
+NETWORK_PASSPHRASE = "dsl net"
+UNSAFE_QUORUM = true
+
+[[HOME_DOMAINS]]
+HOME_DOMAIN = "alpha.example"
+QUALITY = "HIGH"
+
+[[HOME_DOMAINS]]
+HOME_DOMAIN = "beta.example"
+QUALITY = "MEDIUM"
+
+[[VALIDATORS]]
+NAME = "a1"
+HOME_DOMAIN = "alpha.example"
+PUBLIC_KEY = "{pk('dsl-a1')}"
+ADDRESS = "a1.example:11625"
+
+[[VALIDATORS]]
+NAME = "a2"
+HOME_DOMAIN = "alpha.example"
+PUBLIC_KEY = "{pk('dsl-a2')}"
+
+[[VALIDATORS]]
+NAME = "a3"
+HOME_DOMAIN = "alpha.example"
+PUBLIC_KEY = "{pk('dsl-a3')}"
+
+[[VALIDATORS]]
+NAME = "b1"
+HOME_DOMAIN = "beta.example"
+PUBLIC_KEY = "{pk('dsl-b1')}"
+''')
+    cfg = Config.from_toml(str(conf))
+    q = cfg.QUORUM_SET
+    assert q is not None
+    # top tier = HIGH: one inner set for alpha (majority 2 of 3) plus
+    # the nested MEDIUM tier
+    assert len(q.innerSets) == 2 and not q.validators
+    alpha = q.innerSets[0]
+    assert len(alpha.validators) == 3 and alpha.threshold == 2
+    # validator addresses feed KNOWN_PEERS
+    assert "a1.example:11625" in cfg.KNOWN_PEERS
+
+
+def test_validator_dsl_redundancy_and_quality_rules(tmp_path):
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.main.config import (
+        generate_quorum_set, parse_validators,
+    )
+    import pytest
+
+    def pk(name):
+        return SecretKey.from_seed_str(name).public_key.to_strkey()
+    # HIGH-quality domain with <3 validators rejected
+    entries = parse_validators(
+        [{"NAME": "x", "PUBLIC_KEY": pk("dsl-x"),
+          "HOME_DOMAIN": "solo.example", "QUALITY": "HIGH"}], [])
+    with pytest.raises(ValueError, match="redundancy"):
+        generate_quorum_set(entries)
+    # unknown quality rejected
+    with pytest.raises(ValueError, match="QUALITY"):
+        parse_validators(
+            [{"NAME": "x", "PUBLIC_KEY": pk("dsl-x"),
+              "HOME_DOMAIN": "d", "QUALITY": "BEST"}], [])
+
+
+def test_failure_safety_validation(tmp_path):
+    import pytest
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.main.config import Config
+
+    def pk(name):
+        return SecretKey.from_seed_str(name).public_key.to_strkey()
+    # 4 LOW validators in one domain -> majority 3/4 tolerates 1 = auto
+    base = "".join(f'''
+[[VALIDATORS]]
+NAME = "n{i}"
+HOME_DOMAIN = "d.example"
+PUBLIC_KEY = "{pk(f'fs-{i}')}"
+QUALITY = "LOW"
+''' for i in range(4))
+    ok = tmp_path / "ok.cfg"
+    ok.write_text('NETWORK_PASSPHRASE = "fs net"\n' + base)
+    assert Config.from_toml(str(ok)).QUORUM_SET.threshold == 3
+    # demanding more tolerated failures than the threshold allows fails
+    bad = tmp_path / "bad.cfg"
+    bad.write_text('NETWORK_PASSPHRASE = "fs net"\n'
+                   'FAILURE_SAFETY = 3\n' + base)
+    with pytest.raises(ValueError, match="FAILURE_SAFETY"):
+        Config.from_toml(str(bad))

@@ -161,48 +161,143 @@ def test_flags_disable_pool_deposit(tmp_path):
 
 def test_config_upgrade_through_consensus():
     """A published ConfigUpgradeSet scheduled as LEDGER_UPGRADE_CONFIG
-    externalizes and mutates the soroban network settings network-wide
-    (reference SettingsUpgradeUtils + ConfigUpgradeSetFrame)."""
-    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    externalizes, writes CONFIG_SETTING ledger entries on every node,
+    refreshes each node's network-config view, and retires the
+    scheduled vote (reference SettingsUpgradeUtils +
+    ConfigUpgradeSetFrame + Upgrades::removeUpgrades)."""
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+    from stellar_tpu.ledger.network_config import (
+        config_setting_ledger_key,
+    )
     from stellar_tpu.main.settings_upgrade import (
         build_config_upgrade_publication,
     )
-    from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
     from stellar_tpu.xdr.contract import (
         ConfigSettingContractExecutionLanesV0, ConfigSettingEntry,
         ConfigSettingID, ConfigUpgradeSet,
     )
-    cfg = default_soroban_config()
-    old_cap = cfg.ledger_max_tx_count
-    try:
-        upgrade_set = ConfigUpgradeSet(updatedEntry=[
-            ConfigSettingEntry.make(
-                ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
-                ConfigSettingContractExecutionLanesV0(
-                    ledgerMaxTxCount=77))])
-        contract_id = b"\x42" * 32
-        sim = Topologies.core4(accounts=[(keypair("cu-rich"),
-                                          1000 * XLM)])
-        sim.start_all_nodes()
-        apps = list(sim.nodes.values())
-        assert sim.crank_until(
-            lambda: all(x.overlay.authenticated_count() >= 3
-                        for x in apps), 30)
-        # publish the set into every node's state (as a soroban tx
-        # would) and schedule the vote everywhere
-        entry, ttl, key = build_config_upgrade_publication(
-            contract_id, upgrade_set, apps[0].lm.ledger_seq,
-            live_until=10**6)
-        for app in apps:
-            with LedgerTxn(app.lm.root) as ltx:
-                ltx.create(entry).deactivate()
-                ltx.create(ttl).deactivate()
-                ltx.commit()
-            app.herder.upgrades.params = UpgradeParameters(
-                upgrade_time=0, config_upgrade_set_key=key)
-        target = apps[0].lm.ledger_seq + 3
-        assert sim.crank_until_ledger(target, timeout=300)
-        assert sim.in_consensus()
-        assert cfg.ledger_max_tx_count == 77
-    finally:
-        cfg.ledger_max_tx_count = old_cap
+    upgrade_set = ConfigUpgradeSet(updatedEntry=[
+        ConfigSettingEntry.make(
+            ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
+            ConfigSettingContractExecutionLanesV0(
+                ledgerMaxTxCount=77))])
+    contract_id = b"\x42" * 32
+    sim = Topologies.core4(accounts=[(keypair("cu-rich"),
+                                      1000 * XLM)])
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3
+                    for x in apps), 30)
+    # publish the set into every node's state (as a soroban tx
+    # would) and schedule the vote everywhere
+    entry, ttl, key = build_config_upgrade_publication(
+        contract_id, upgrade_set, apps[0].lm.ledger_seq,
+        live_until=10**6)
+    for app in apps:
+        with LedgerTxn(app.lm.root) as ltx:
+            ltx.create(entry).deactivate()
+            ltx.create(ttl).deactivate()
+            ltx.commit()
+        app.herder.upgrades.params = UpgradeParameters(
+            upgrade_time=0, config_upgrade_set_key=key)
+    target = apps[0].lm.ledger_seq + 3
+    assert sim.crank_until_ledger(target, timeout=300)
+    assert sim.in_consensus()
+    lanes_kb = key_bytes(config_setting_ledger_key(
+        ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES))
+    for app in apps:
+        # per-node view refreshed...
+        assert app.lm.soroban_config.ledger_max_tx_count == 77
+        # ...backed by a CONFIG_SETTING entry in ledger state
+        stored = app.lm.root.store.get(lanes_kb)
+        assert stored is not None
+        assert stored.data.value.value.ledgerMaxTxCount == 77
+        # ...and the scheduled vote retired itself (it would otherwise
+        # be re-applied every ledger forever)
+        assert app.herder.upgrades.params.config_upgrade_set_key is None
+    # state hashes still agree after the upgrade entries landed
+    assert sim.in_consensus()
+
+
+def test_max_soroban_tx_set_size_upgrade_through_consensus():
+    """LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE externalizes, lands in the
+    EXECUTION_LANES CONFIG_SETTING entry, and retires its vote
+    (reference Upgrades::applyTo + removeUpgrades)."""
+    sim = Topologies.core4(accounts=[(keypair("ms-rich"), 1000 * XLM)])
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    for app in apps:
+        app.herder.upgrades.params = UpgradeParameters(
+            upgrade_time=0, max_soroban_tx_set_size=9)
+    target = apps[0].lm.ledger_seq + 3
+    assert sim.crank_until_ledger(target, timeout=300)
+    assert sim.in_consensus()
+    for app in apps:
+        assert app.lm.soroban_config.ledger_max_tx_count == 9
+        assert app.herder.upgrades.params.max_soroban_tx_set_size is None
+
+
+def test_config_upgrade_survives_restart(tmp_path):
+    """Upgraded network settings are CONFIG_SETTING ledger entries, so a
+    restarted node restores them from its buckets (reference stores
+    settings in ledger state for exactly this reason)."""
+    from stellar_tpu.bucket.bucket_manager import BucketManager
+    from stellar_tpu.database import Database, NodePersistence
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import LedgerCloseData
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_tpu.main.settings_upgrade import (
+        build_config_upgrade_publication,
+    )
+    from stellar_tpu.xdr.contract import (
+        ConfigSettingContractBandwidthV0, ConfigSettingEntry,
+        ConfigSettingID, ConfigUpgradeSet,
+    )
+    from stellar_tpu.xdr.ledger import ConfigUpgradeSetKey
+    upgrade_set = ConfigUpgradeSet(updatedEntry=[
+        ConfigSettingEntry.make(
+            ConfigSettingID.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0,
+            ConfigSettingContractBandwidthV0(
+                ledgerMaxTxsSizeBytes=250_000, txMaxSizeBytes=50_000,
+                feeTxSize1KB=3_000))])
+    net = b"\x21" * 32
+    a = keypair("cu-restart")
+    db = Database(str(tmp_path / "node.db"))
+    pers = NodePersistence(db, BucketManager(str(tmp_path / "buckets")))
+    root = seed_root_with_accounts([(a, 1000 * XLM)])
+    lm = LedgerManager(net, root, persistence=pers)
+    # publish the set, then externalize a close carrying the upgrade
+    entry, ttl, key = build_config_upgrade_publication(
+        b"\x42" * 32, upgrade_set, lm.ledger_seq, live_until=10**6)
+    with LedgerTxn(lm.root) as ltx:
+        ltx.create(entry).deactivate()
+        ltx.create(ttl).deactivate()
+        ltx.commit()
+    lcl = lm.last_closed_header
+    txset, _ = make_tx_set_from_transactions([], lcl, lm.last_closed_hash)
+    applicable = txset.prepare_for_apply() \
+        if hasattr(txset, "prepare_for_apply") else txset
+    lm.close_ledger(LedgerCloseData(
+        ledger_seq=lcl.ledgerSeq + 1, tx_set=applicable,
+        close_time=lcl.scpValue.closeTime + 5,
+        upgrades=[up(LUT.LEDGER_UPGRADE_CONFIG, key)]))
+    assert lm.soroban_config.tx_max_size_bytes == 50_000
+    assert lm.soroban_config.ledger_max_txs_size_bytes == 250_000
+    db.close()
+
+    # restart: the view is rebuilt from the persisted CONFIG_SETTING
+    # entries, not process defaults
+    db2 = Database(str(tmp_path / "node.db"))
+    pers2 = NodePersistence(db2, BucketManager(str(tmp_path / "buckets")))
+    lm2 = LedgerManager.from_persistence(net, pers2)
+    assert lm2 is not None
+    assert lm2.soroban_config.tx_max_size_bytes == 50_000
+    assert lm2.soroban_config.ledger_max_txs_size_bytes == 250_000
+    assert lm2.soroban_config.fee_tx_size_1kb == 3_000
+    # untouched settings keep their initial values
+    assert lm2.soroban_config.ledger_max_tx_count == \
+        lm.soroban_config.ledger_max_tx_count
