@@ -214,6 +214,29 @@ class CommandHandler:
         return self._on_main(
             lambda: dict(self.app.overlay.survey_manager.results))
 
+    def cmd_generate_load(self, params):
+        """Reference ``generateload`` admin route: mode=create|pay|
+        pretend|soroban_upload|soroban_invoke|mixed_classic_soroban,
+        txs=N (+ mode=soroban_invoke_setup to deploy the contract)."""
+        mode = params.get("mode", ["pay"])[0]
+        n = int(params.get("txs", ["10"])[0])
+
+        def run():
+            if getattr(self.app, "_load_generator", None) is None:
+                from stellar_tpu.simulation.load_generator import (
+                    LoadGenerator,
+                )
+                self.app._load_generator = LoadGenerator(self.app)
+            gen = self.app._load_generator
+            before = gen.submitted
+            if mode == "soroban_invoke_setup":
+                gen.setup_soroban()
+            else:
+                gen.generate_load(n, mode=mode)
+            return {"mode": mode, "submitted": gen.submitted - before,
+                    "total_submitted": gen.submitted}
+        return self._on_main(run)
+
     def cmd_maintenance(self, params):
         count = int(params.get("count", ["50000"])[0])
 
@@ -248,6 +271,7 @@ class CommandHandler:
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,
         "droppeer": cmd_droppeer, "upgrades": cmd_upgrades,
+        "generateload": cmd_generate_load,
         "maintenance": cmd_maintenance,
         "getledgerentryraw": cmd_getledgerentryraw,
         "startsurveycollecting": cmd_start_survey_collecting,

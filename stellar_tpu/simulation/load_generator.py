@@ -20,7 +20,11 @@ XLM = 10_000_000
 
 class LoadGenerator:
     """Paced synthetic traffic through a real herder (reference
-    ``LoadGenerator``: CREATE + PAY modes)."""
+    ``LoadGenerator.h:30-49`` modes: CREATE, PAY, PRETEND,
+    SOROBAN_UPLOAD, SOROBAN_INVOKE (+setup), MIXED_CLASSIC_SOROBAN)."""
+
+    MODES = ("pay", "create", "pretend", "soroban_upload",
+             "soroban_invoke", "mixed_classic_soroban")
 
     def __init__(self, app, n_accounts: int = 16):
         self.app = app
@@ -29,32 +33,250 @@ class LoadGenerator:
             for i in range(n_accounts)]
         self.seqs = {}
         self.submitted = 0
+        self.created = 0
+        # soroban_invoke state: one shared counter contract
+        self.contract_id: Optional[bytes] = None
 
     def account_keys(self):
         return self.accounts
 
-    def generate_load(self, n_txs: int, source_balances_known=True):
-        """Submit n payment txs round-robin across accounts."""
+    def _next_seq(self, src: SecretKey) -> Optional[int]:
         from stellar_tpu.ledger.ledger_txn import key_bytes
         from stellar_tpu.tx.op_frame import account_key
-        from stellar_tpu.tx.tx_test_utils import make_tx, payment_op
         from stellar_tpu.xdr.types import account_id
+        raw = src.public_key.raw
+        if raw not in self.seqs:
+            e = self.app.herder.lm.root.store.get(
+                key_bytes(account_key(account_id(raw))))
+            if e is None:
+                return None
+            self.seqs[raw] = e.data.value.seqNum
+        self.seqs[raw] += 1
+        return self.seqs[raw]
+
+    def _submit(self, tx) -> None:
+        self.app.herder.recv_transaction(tx)
+        self.submitted += 1
+
+    def generate_load(self, n_txs: int, mode: str = "pay"):
+        """Submit n txs of the given mode round-robin across accounts."""
+        if mode not in self.MODES:
+            raise ValueError(f"unknown load mode {mode!r}; "
+                             f"one of {self.MODES}")
+        if mode in ("soroban_invoke", "mixed_classic_soroban") and \
+                self.contract_id is None:
+            raise RuntimeError(
+                "run setup_soroban() (and crank it through a close) "
+                "before soroban_invoke load")
+        from stellar_tpu.tx.tx_test_utils import make_tx, payment_op
         herder = self.app.herder
         for i in range(n_txs):
             src = self.accounts[i % len(self.accounts)]
-            dst = self.accounts[(i + 1) % len(self.accounts)]
-            raw = src.public_key.raw
-            if raw not in self.seqs:
-                e = herder.lm.root.store.get(
-                    key_bytes(account_key(account_id(raw))))
-                if e is None:
-                    continue
-                self.seqs[raw] = e.data.value.seqNum
-            self.seqs[raw] += 1
-            tx = make_tx(src, self.seqs[raw], [payment_op(dst, XLM)],
-                         network_id=herder.network_id)
-            herder.recv_transaction(tx)
-            self.submitted += 1
+            seq = self._next_seq(src)
+            if seq is None:
+                continue
+            if mode == "pay" or (mode == "mixed_classic_soroban"
+                                 and i % 2 == 0):
+                dst = self.accounts[(i + 1) % len(self.accounts)]
+                tx = make_tx(src, seq, [payment_op(dst, XLM)],
+                             network_id=herder.network_id)
+            elif mode == "create":
+                from stellar_tpu.ledger.ledger_txn import key_bytes
+                from stellar_tpu.tx.op_frame import account_key
+                from stellar_tpu.tx.tx_test_utils import (
+                    create_account_op,
+                )
+                from stellar_tpu.xdr.types import account_id
+                # skip over accounts that already exist (repeat runs /
+                # restarted generators must still create fresh ones)
+                while True:
+                    new = SecretKey.from_seed_str(
+                        f"loadgen-created-{self.created}")
+                    self.created += 1
+                    if herder.lm.root.store.get(key_bytes(account_key(
+                            account_id(new.public_key.raw)))) is None:
+                        break
+                tx = make_tx(src, seq, [create_account_op(new, 50 * XLM)],
+                             network_id=herder.network_id)
+            elif mode == "pretend":
+                # realistic-looking no-op traffic (reference PRETEND:
+                # SetOptions that changes nothing observable)
+                from stellar_tpu.xdr.tx import (
+                    Operation, OperationBody, OperationType, SetOptionsOp,
+                )
+                op = Operation(
+                    sourceAccount=None,
+                    body=OperationBody.make(
+                        OperationType.SET_OPTIONS,
+                        SetOptionsOp(inflationDest=None, clearFlags=None,
+                                     setFlags=None, masterWeight=None,
+                                     lowThreshold=None, medThreshold=None,
+                                     highThreshold=None, homeDomain=None,
+                                     signer=None)))
+                tx = make_tx(src, seq, [op],
+                             network_id=herder.network_id)
+            elif mode == "soroban_upload":
+                tx = self._upload_tx(src, seq, unique=self.submitted)
+            else:  # soroban_invoke / mixed odd slots
+                tx = self._invoke_tx(src, seq)
+            self._submit(tx)
+
+    # ---------------- soroban builders ----------------
+
+    def _counter_code(self, unique: int = 0) -> bytes:
+        from stellar_tpu.soroban.host import (
+            assemble_program, ins, sym, u32,
+        )
+        return assemble_program({
+            "incr": [
+                ins("push", u32(unique)), ins("drop"),
+                ins("push", sym("count")), ins("has", sym("persistent")),
+                ins("jz", u32(3)),
+                ins("push", sym("count")), ins("get", sym("persistent")),
+                ins("jmp", u32(1)),
+                ins("push", u32(0)),
+                ins("push", u32(1)), ins("add"),
+                ins("dup"),
+                ins("push", sym("count")), ins("swap"),
+                ins("put", sym("persistent")),
+                ins("ret"),
+            ],
+        })
+
+    def _upload_tx(self, src, seq, unique: int = 0):
+        """SOROBAN_UPLOAD: each tx uploads a distinct contract body
+        (reference uploads randomized wasm)."""
+        from stellar_tpu.crypto.sha import sha256
+        from stellar_tpu.soroban.host import contract_code_key
+        from stellar_tpu.tx.tx_test_utils import make_tx
+        from stellar_tpu.xdr.contract import (
+            HostFunction, HostFunctionType,
+        )
+        code = self._counter_code(unique)
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            code)
+        sd = _soroban_data(
+            read_write=[contract_code_key(sha256(code))])
+        return make_tx(src, seq, [_soroban_op(fn)], fee=6_000_000,
+                       soroban_data=sd,
+                       network_id=self.app.herder.network_id)
+
+    def setup_soroban(self):
+        """SOROBAN_INVOKE_SETUP (reference mode): submit the upload +
+        create txs for the shared counter contract. Crank the network
+        through at least two closes afterwards, then invoke load can
+        run."""
+        from stellar_tpu.crypto.sha import sha256
+        from stellar_tpu.soroban.host import (
+            contract_code_key, contract_data_key, derive_contract_id,
+            scaddress_account, scaddress_contract,
+        )
+        from stellar_tpu.tx.tx_test_utils import make_tx
+        from stellar_tpu.xdr.contract import (
+            ContractDataDurability, ContractExecutable,
+            ContractExecutableType, ContractIDPreimage,
+            ContractIDPreimageFromAddress, ContractIDPreimageType,
+            CreateContractArgs, HostFunction, HostFunctionType, SCVal,
+            SCValType,
+        )
+        from stellar_tpu.xdr.types import account_id
+        owner = self.accounts[0]
+        code = self._counter_code()
+        code_hash = sha256(code)
+        seq = self._next_seq(owner)
+        if seq is None:
+            raise RuntimeError("loadgen account 0 does not exist yet")
+        up = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            code)
+        self._submit(make_tx(
+            owner, seq, [_soroban_op(up)], fee=6_000_000,
+            soroban_data=_soroban_data(
+                read_write=[contract_code_key(code_hash)]),
+            network_id=self.app.herder.network_id))
+        preimage = ContractIDPreimage.make(
+            ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+            ContractIDPreimageFromAddress(
+                address=scaddress_account(
+                    account_id(owner.public_key.raw)),
+                salt=b"\x5a" * 32))
+        create = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+            CreateContractArgs(
+                contractIDPreimage=preimage,
+                executable=ContractExecutable.make(
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                    code_hash)))
+        self.contract_id = derive_contract_id(
+            self.app.herder.network_id, preimage)
+        addr = scaddress_contract(self.contract_id)
+        inst_key = contract_data_key(
+            addr, SCVal.make(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        self._submit(make_tx(
+            owner, self._next_seq(owner), [_soroban_op(create)],
+            fee=6_000_000,
+            soroban_data=_soroban_data(
+                read_only=[contract_code_key(code_hash)],
+                read_write=[inst_key]),
+            network_id=self.app.herder.network_id))
+        self._code_hash = code_hash
+
+    def _invoke_tx(self, src, seq):
+        from stellar_tpu.soroban.host import (
+            contract_code_key, contract_data_key, scaddress_contract,
+            sym,
+        )
+        from stellar_tpu.tx.tx_test_utils import make_tx
+        from stellar_tpu.xdr.contract import (
+            ContractDataDurability, HostFunction, HostFunctionType,
+            InvokeContractArgs, SCVal, SCValType,
+        )
+        addr = scaddress_contract(self.contract_id)
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"incr", args=[]))
+        inst_key = contract_data_key(
+            addr, SCVal.make(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        counter_key = contract_data_key(
+            addr, sym("count"), ContractDataDurability.PERSISTENT)
+        sd = _soroban_data(
+            read_only=[inst_key, contract_code_key(self._code_hash)],
+            read_write=[counter_key])
+        return make_tx(src, seq, [_soroban_op(fn)], fee=6_000_000,
+                       soroban_data=sd,
+                       network_id=self.app.herder.network_id)
+
+
+def _soroban_op(host_fn, auth=()):
+    from stellar_tpu.xdr.tx import (
+        InvokeHostFunctionOp, Operation, OperationBody, OperationType,
+    )
+    return Operation(
+        sourceAccount=None,
+        body=OperationBody.make(
+            OperationType.INVOKE_HOST_FUNCTION,
+            InvokeHostFunctionOp(hostFunction=host_fn, auth=list(auth))))
+
+
+def _soroban_data(read_only=(), read_write=(), instructions=2_000_000,
+                  read_bytes=3_000, write_bytes=3_000,
+                  resource_fee=5_000_000):
+    from stellar_tpu.xdr.tx import (
+        LedgerFootprint, SorobanResources, SorobanTransactionData,
+    )
+    from stellar_tpu.xdr.types import ExtensionPoint
+    return SorobanTransactionData(
+        ext=ExtensionPoint.make(0),
+        resources=SorobanResources(
+            footprint=LedgerFootprint(readOnly=list(read_only),
+                                      readWrite=list(read_write)),
+            instructions=instructions, readBytes=read_bytes,
+            writeBytes=write_bytes),
+        resourceFee=resource_fee)
 
 
 def apply_load(n_ledgers: int = 10, txs_per_ledger: int = 100,

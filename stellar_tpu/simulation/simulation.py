@@ -22,13 +22,18 @@ __all__ = ["Simulation", "Topologies"]
 
 class Simulation:
     OVER_LOOPBACK = "loopback"
+    OVER_TCP = "tcp"
 
     def __init__(self, mode: str = OVER_LOOPBACK,
                  network_passphrase: str = "simulation network"):
         self.mode = mode
         self.network_passphrase = network_passphrase
+        # loopback: ONE shared virtual clock cranked in lockstep;
+        # tcp: per-node real-time clocks + real sockets on localhost
+        # (reference Simulation::OVER_TCP)
         self.clock = VirtualClock(VIRTUAL_TIME)
         self.nodes: Dict[bytes, Application] = {}
+        self.drivers: Dict[bytes, object] = {}
         self.pending_connections: List = []
 
     # ---------------- construction ----------------
@@ -46,27 +51,58 @@ class Simulation:
                 seed_root_with_accounts,
             )
             root = seed_root_with_accounts(list(accounts))
-        app = Application(cfg, clock=self.clock, root=root)
+        if self.mode == self.OVER_TCP:
+            from stellar_tpu.overlay.tcp import TCPDriver
+            from stellar_tpu.utils.timer import REAL_TIME
+            app = Application(cfg, clock=VirtualClock(REAL_TIME),
+                              root=root)
+            self.drivers[seed.public_key.raw] = TCPDriver(
+                app, listen_port=0)
+        else:
+            app = Application(cfg, clock=self.clock, root=root)
         self.nodes[seed.public_key.raw] = app
         return app
 
     def add_connection(self, node_a: bytes, node_b: bytes):
+        if self.mode == self.OVER_TCP:
+            return self.drivers[node_a].connect(
+                "127.0.0.1", self.drivers[node_b].door.port)
         return connect_loopback(self.nodes[node_a], self.nodes[node_b])
 
     def start_all_nodes(self):
         for app in self.nodes.values():
             app.start()
 
+    def close(self):
+        """Tear down TCP listeners/sockets (no-op for loopback)."""
+        for d in self.drivers.values():
+            d.close()
+
     # ---------------- cranking ----------------
 
     def crank_all_nodes(self, n: int = 1) -> int:
         progress = 0
+        if self.mode == self.OVER_TCP:
+            for _ in range(n):
+                for app in self.nodes.values():
+                    progress += app.crank(block=False)
+            return progress
         for _ in range(n):
             progress += self.clock.crank(block=True)
         return progress
 
     def crank_until(self, pred: Callable[[], bool],
                     timeout: float = 120.0) -> bool:
+        if self.mode == self.OVER_TCP:
+            import time as _time
+            deadline = _time.monotonic() + timeout
+            while _time.monotonic() < deadline:
+                if pred():
+                    return True
+                worked = self.crank_all_nodes()
+                if not worked:
+                    _time.sleep(0.005)
+            return pred()
         return self.clock.crank_until(pred, timeout)
 
     def crank_until_ledger(self, seq: int, timeout: float = 120.0) -> bool:
@@ -110,6 +146,77 @@ class Topologies:
     @staticmethod
     def core4(sim=None, accounts=None):
         return Topologies.core(4, sim, accounts)
+
+    @staticmethod
+    def pair(sim: Optional[Simulation] = None, accounts=None):
+        """Two mutually trusting validators (reference
+        ``Topologies::pair``)."""
+        return Topologies.core(2, sim, accounts, threshold=2)
+
+    @staticmethod
+    def branched_cycle(n: int, sim: Optional[Simulation] = None,
+                       accounts=None):
+        """Ring of n core validators, each with one leaf validator
+        hanging off it (reference ``Topologies::branchedcycle``): the
+        leaf trusts {self, core} (both required); the core nodes run
+        the cycle quorum. Exercises asymmetric trust + non-clique
+        connectivity."""
+        sim = Topologies.cycle(n, sim, accounts)
+        core_ids = list(sim.nodes)[-n:]  # the nodes cycle() just added
+        for i, core_id in enumerate(core_ids):
+            leaf = SecretKey.from_seed_str(f"sim-leaf-{i}")
+            qset = SCPQuorumSet(
+                threshold=2,
+                validators=[make_node_id(leaf.public_key.raw),
+                            make_node_id(core_id)],
+                innerSets=[])
+            sim.add_node(leaf, qset, accounts=accounts)
+            sim.add_connection(leaf.public_key.raw, core_id)
+        return sim
+
+    @staticmethod
+    def hierarchical_quorum(n_core: int = 4, n_branches: int = 2,
+                            branch_size: int = 3,
+                            sim: Optional[Simulation] = None,
+                            accounts=None):
+        """Tiered quorums (reference ``Topologies::hierarchicalQuorum``):
+        a BFT core clique, plus branches of validators whose quorum
+        requires BOTH a core majority and a branch majority."""
+        sim = sim if sim is not None else Simulation()
+        core_keys = [SecretKey.from_seed_str(f"sim-hq-core-{i}")
+                     for i in range(n_core)]
+        core_qset = SCPQuorumSet(
+            threshold=n_core - (n_core - 1) // 3,
+            validators=[make_node_id(k.public_key.raw)
+                        for k in core_keys],
+            innerSets=[])
+        for k in core_keys:
+            sim.add_node(k, core_qset, accounts=accounts)
+        core_ids = [k.public_key.raw for k in core_keys]
+        for i in range(n_core):
+            for j in range(i + 1, n_core):
+                sim.add_connection(core_ids[i], core_ids[j])
+        for b in range(n_branches):
+            branch_keys = [
+                SecretKey.from_seed_str(f"sim-hq-b{b}-{i}")
+                for i in range(branch_size)]
+            branch_set = SCPQuorumSet(
+                threshold=branch_size // 2 + 1,
+                validators=[make_node_id(k.public_key.raw)
+                            for k in branch_keys],
+                innerSets=[])
+            qset = SCPQuorumSet(threshold=2, validators=[],
+                                innerSets=[core_qset, branch_set])
+            for k in branch_keys:
+                sim.add_node(k, qset, accounts=accounts)
+            bids = [k.public_key.raw for k in branch_keys]
+            for i in range(branch_size):
+                for j in range(i + 1, branch_size):
+                    sim.add_connection(bids[i], bids[j])
+                # every branch node also talks to every core node
+                for cid in core_ids:
+                    sim.add_connection(bids[i], cid)
+        return sim
 
     @staticmethod
     def cycle(n: int, sim: Optional[Simulation] = None, accounts=None):
