@@ -124,6 +124,13 @@ class LiveBucketList:
         ``BucketListBase::addBatch`` / ``addBatchInternal`` — shadows
         omitted, removed since protocol 12)."""
         assert current_ledger > 0
+        from stellar_tpu.utils.tracing import zone
+        with zone("bucket.addBatch"):
+            self._add_batch_inner(current_ledger, protocol_version,
+                                  init_entries, live_entries, dead_keys)
+
+    def _add_batch_inner(self, current_ledger, protocol_version,
+                         init_entries, live_entries, dead_keys):
         for i in range(NUM_LEVELS - 1, 0, -1):
             if level_should_spill(current_ledger, i - 1):
                 spilled = self.levels[i - 1].take_snap()

@@ -171,11 +171,12 @@ class CatchupWork(WorkSequence):
     ``CatchupWork``): fetch HAS → verify chain → buckets or replay."""
 
     def __init__(self, lm: LedgerManager, archive: FileArchive,
-                 config: CatchupConfiguration):
+                 config: CatchupConfiguration, status_manager=None):
         super().__init__(f"catchup-{config.mode}-{config.to_ledger}")
         self.lm = lm
         self.archive = archive
         self.config = config
+        self.status_manager = status_manager
         self.has: Optional[HistoryArchiveState] = None
         self.verified_headers = []
         from stellar_tpu.work.work import FunctionWork
@@ -183,9 +184,33 @@ class CatchupWork(WorkSequence):
         self.add_child(FunctionWork("verify-chain", self._verify_chain))
         self.add_child(FunctionWork("apply", self._apply))
 
+    def _status(self, message: str) -> None:
+        """Operator status line (reference sets HISTORY_CATCHUP through
+        every CatchupWork phase)."""
+        if self.status_manager is not None:
+            from stellar_tpu.utils.status import StatusCategory
+            if message:
+                self.status_manager.set_status(
+                    StatusCategory.HISTORY_CATCHUP, message)
+            else:
+                self.status_manager.remove_status(
+                    StatusCategory.HISTORY_CATCHUP)
+
+    def on_success(self):
+        self._status("")
+        return super().on_success()
+
+    def on_failure_raise(self):
+        self._status(f"Catchup FAILED at ledger {self.lm.ledger_seq} "
+                     f"(mode {self.config.mode})")
+        return super().on_failure_raise()
+
     def _get_has(self):
+        self._status(f"Catching up: fetching archive state "
+                     f"(mode {self.config.mode})")
         self.has = HistoryManager.get_root_has(self.archive)
         if self.has is None:
+            self._status("Catchup failed: archive has no root HAS")
             return State.FAILURE
         return State.SUCCESS
 
@@ -241,6 +266,8 @@ class CatchupWork(WorkSequence):
                     return State.FAILURE
         cp = checkpoint_containing(self.lm.ledger_seq + 1)
         while self.lm.ledger_seq < target:
+            self._status(f"Catching up: applying checkpoint {cp} "
+                         f"({self.lm.ledger_seq}/{target})")
             replay_checkpoint(self.lm, self.archive, cp, up_to=target)
             cp += CHECKPOINT_FREQUENCY
         return State.SUCCESS

@@ -135,6 +135,11 @@ class BatchVerifier:
     # ---------------- public API ----------------
 
     def _prep(self, items: Sequence[tuple]):
+        from stellar_tpu.utils.tracing import zone
+        with zone("crypto.prep"):
+            return self._prep_inner(items)
+
+    def _prep_inner(self, items: Sequence[tuple]):
         n = len(items)
         ok = np.ones(n, dtype=bool)
         a = np.zeros((n, 32), dtype=np.uint8)

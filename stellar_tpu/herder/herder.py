@@ -257,6 +257,11 @@ class Herder:
 
     def recv_scp_envelope(self, env: SCPEnvelope) -> int:
         """Reference ``HerderImpl::recvSCPEnvelope``."""
+        from stellar_tpu.utils.tracing import zone
+        with zone("herder.recvSCPEnvelope"):
+            return self._recv_scp_envelope_inner(env)
+
+    def _recv_scp_envelope_inner(self, env: SCPEnvelope) -> int:
         if not self.verify_envelope(env):
             return EnvelopeState.INVALID
         slot = env.statement.slotIndex

@@ -148,6 +148,18 @@ class LedgerManager:
     # ---------------- the close pipeline ----------------
 
     def close_ledger(self, lcd: LedgerCloseData) -> CloseLedgerResult:
+        """One ledger close; traced + watchdogged like the reference
+        (Tracy zone + LogSlowExecution, LedgerManagerImpl.cpp:817)."""
+        from stellar_tpu.utils.tracing import (
+            LogSlowExecution, frame_mark, zone,
+        )
+        with zone("ledger.close"), \
+                LogSlowExecution("ledger-close", threshold_ms=2000.0):
+            result = self._close_ledger_inner(lcd)
+        frame_mark()
+        return result
+
+    def _close_ledger_inner(self, lcd: LedgerCloseData) -> CloseLedgerResult:
         lcl = self.last_closed_header
         if lcd.ledger_seq != lcl.ledgerSeq + 1:
             raise ValueError(

@@ -83,6 +83,8 @@ class Application:
                 config.NETWORK_PASSPHRASE)
         from stellar_tpu.process import ProcessManager
         self.process_manager = ProcessManager()
+        from stellar_tpu.utils.status import StatusManager
+        self.status_manager = StatusManager()
         self._meta_stream_file = None
         if config.METADATA_OUTPUT_STREAM:
             self._open_meta_stream(config.METADATA_OUTPUT_STREAM)
@@ -208,6 +210,9 @@ class Application:
                     if self.history else [],
             },
             "database": bool(self.database),
+            # per-category operator status lines (reference
+            # StatusManager, surfaced the same way in info)
+            "status": self.status_manager.status_lines(),
         }
 
     def manual_close(self) -> dict:

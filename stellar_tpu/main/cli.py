@@ -88,7 +88,8 @@ def cmd_catchup(args) -> int:
                                     count=int(mode))
     else:
         conf = CatchupConfiguration(target, CatchupConfiguration.COMPLETE)
-    work = CatchupWork(app.lm, FileArchive(cfg.HISTORY_ARCHIVES[0]), conf)
+    work = CatchupWork(app.lm, FileArchive(cfg.HISTORY_ARCHIVES[0]), conf,
+                       status_manager=app.status_manager)
     ws.schedule(work)
     ws.run_until_done(timeout=3600)
     print(json.dumps({"state": work.state,

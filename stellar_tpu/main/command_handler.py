@@ -229,11 +229,13 @@ class CommandHandler:
                 self.app._load_generator = LoadGenerator(self.app)
             gen = self.app._load_generator
             before = gen.submitted
+            before_rej = gen.rejected
             if mode == "soroban_invoke_setup":
                 gen.setup_soroban()
             else:
                 gen.generate_load(n, mode=mode)
             return {"mode": mode, "submitted": gen.submitted - before,
+                    "rejected": gen.rejected - before_rej,
                     "total_submitted": gen.submitted}
         return self._on_main(run)
 

@@ -33,6 +33,7 @@ class LoadGenerator:
             for i in range(n_accounts)]
         self.seqs = {}
         self.submitted = 0
+        self.rejected = 0
         self.created = 0
         # soroban_invoke state: one shared counter contract
         self.contract_id: Optional[bytes] = None
@@ -54,9 +55,19 @@ class LoadGenerator:
         self.seqs[raw] += 1
         return self.seqs[raw]
 
-    def _submit(self, tx) -> None:
-        self.app.herder.recv_transaction(tx)
-        self.submitted += 1
+    def _submit(self, tx, src: SecretKey) -> bool:
+        """Submit through the herder; on queue rejection, unwind the
+        cached seq so later txs from this account stay gap-free."""
+        from stellar_tpu.herder.transaction_queue import AddResult
+        res = self.app.herder.recv_transaction(tx)
+        accepted = res.code in (AddResult.ADD_STATUS_PENDING,
+                                AddResult.ADD_STATUS_DUPLICATE)
+        if accepted:
+            self.submitted += 1
+        else:
+            self.seqs[src.public_key.raw] -= 1
+            self.rejected += 1
+        return accepted
 
     def generate_load(self, n_txs: int, mode: str = "pay"):
         """Submit n txs of the given mode round-robin across accounts."""
@@ -68,7 +79,12 @@ class LoadGenerator:
             raise RuntimeError(
                 "run setup_soroban() (and crank it through a close) "
                 "before soroban_invoke load")
-        from stellar_tpu.tx.tx_test_utils import make_tx, payment_op
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.tx.op_frame import account_key
+        from stellar_tpu.tx.tx_test_utils import (
+            create_account_op, make_tx, payment_op,
+        )
+        from stellar_tpu.xdr.types import account_id
         herder = self.app.herder
         for i in range(n_txs):
             src = self.accounts[i % len(self.accounts)]
@@ -81,12 +97,6 @@ class LoadGenerator:
                 tx = make_tx(src, seq, [payment_op(dst, XLM)],
                              network_id=herder.network_id)
             elif mode == "create":
-                from stellar_tpu.ledger.ledger_txn import key_bytes
-                from stellar_tpu.tx.op_frame import account_key
-                from stellar_tpu.tx.tx_test_utils import (
-                    create_account_op,
-                )
-                from stellar_tpu.xdr.types import account_id
                 # skip over accounts that already exist (repeat runs /
                 # restarted generators must still create fresh ones)
                 while True:
@@ -119,7 +129,7 @@ class LoadGenerator:
                 tx = self._upload_tx(src, seq, unique=self.submitted)
             else:  # soroban_invoke / mixed odd slots
                 tx = self._invoke_tx(src, seq)
-            self._submit(tx)
+            self._submit(tx, src)
 
     # ---------------- soroban builders ----------------
 
@@ -194,7 +204,7 @@ class LoadGenerator:
             owner, seq, [_soroban_op(up)], fee=6_000_000,
             soroban_data=_soroban_data(
                 read_write=[contract_code_key(code_hash)]),
-            network_id=self.app.herder.network_id))
+            network_id=self.app.herder.network_id), owner)
         preimage = ContractIDPreimage.make(
             ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
             ContractIDPreimageFromAddress(
@@ -220,7 +230,7 @@ class LoadGenerator:
             soroban_data=_soroban_data(
                 read_only=[contract_code_key(code_hash)],
                 read_write=[inst_key]),
-            network_id=self.app.herder.network_id))
+            network_id=self.app.herder.network_id), owner)
         self._code_hash = code_hash
 
     def _invoke_tx(self, src, seq):

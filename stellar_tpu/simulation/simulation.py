@@ -183,19 +183,10 @@ class Topologies:
         a BFT core clique, plus branches of validators whose quorum
         requires BOTH a core majority and a branch majority."""
         sim = sim if sim is not None else Simulation()
-        core_keys = [SecretKey.from_seed_str(f"sim-hq-core-{i}")
-                     for i in range(n_core)]
-        core_qset = SCPQuorumSet(
-            threshold=n_core - (n_core - 1) // 3,
-            validators=[make_node_id(k.public_key.raw)
-                        for k in core_keys],
-            innerSets=[])
-        for k in core_keys:
-            sim.add_node(k, core_qset, accounts=accounts)
-        core_ids = [k.public_key.raw for k in core_keys]
-        for i in range(n_core):
-            for j in range(i + 1, n_core):
-                sim.add_connection(core_ids[i], core_ids[j])
+        # the BFT core clique is exactly Topologies.core
+        sim = Topologies.core(n_core, sim, accounts)
+        core_ids = list(sim.nodes)[-n_core:]
+        core_qset = sim.nodes[core_ids[0]].config.QUORUM_SET
         for b in range(n_branches):
             branch_keys = [
                 SecretKey.from_seed_str(f"sim-hq-b{b}-{i}")

@@ -1,0 +1,91 @@
+"""Lightweight tracing: nested zones + slow-execution watchdogs
+(reference: Tracy ``ZoneScoped`` annotations — 672 across ``src/`` —
+and ``util/LogSlowExecution.h`` wall-time watchdogs, e.g. the ledger
+close monitor at ``ledger/LedgerManagerImpl.cpp:817``).
+
+Zones are always-on but cheap: one ``perf_counter`` pair and a registry
+timer update per zone. A thread-local stack records nesting so a zone's
+metric name reflects its own cost (not children's) is NOT attempted —
+like Tracy, zone times are inclusive; the stack exists for the ``info``
+introspection of where time goes (``current_zones``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List
+
+from stellar_tpu.utils.metrics import registry
+
+__all__ = ["zone", "LogSlowExecution", "current_zones", "frame_mark"]
+
+_log = logging.getLogger("stellar_tpu.perf")
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    s = getattr(_tls, "zones", None)
+    if s is None:
+        s = _tls.zones = []
+    return s
+
+
+def current_zones() -> List[str]:
+    """The live zone stack of this thread (innermost last)."""
+    return list(_stack())
+
+
+class zone:
+    """``with zone("ledger.close"): ...`` — inclusive wall time into the
+    registry timer ``zone.<name>`` (the ZoneScoped analog)."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        _stack().append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt_ms = (time.perf_counter() - self._t0) * 1000.0
+        registry.timer(f"zone.{self.name}").update_ms(dt_ms)
+        s = _stack()
+        if s and s[-1] == self.name:
+            s.pop()
+        return False
+
+
+class LogSlowExecution:
+    """Warn when a scope overruns its budget (reference
+    ``LogSlowExecution``: construct at scope entry, log on exit if the
+    elapsed wall time exceeds the threshold)."""
+
+    __slots__ = ("name", "threshold_ms", "_t0")
+
+    def __init__(self, name: str, threshold_ms: float = 1000.0):
+        self.name = name
+        self.threshold_ms = threshold_ms
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt_ms = (time.perf_counter() - self._t0) * 1000.0
+        if dt_ms > self.threshold_ms:
+            registry.counter(f"slow.{self.name}").inc()
+            _log.warning("'%s' hung for %.0f ms (threshold %.0f ms)",
+                         self.name, dt_ms, self.threshold_ms)
+        return False
+
+
+def frame_mark() -> None:
+    """Per-ledger frame boundary (reference ``FrameMark`` at the end of
+    closeLedger, ``LedgerManagerImpl.cpp:1121``)."""
+    registry.meter("frame.ledger_close").mark()

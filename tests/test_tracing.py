@@ -1,0 +1,84 @@
+"""Tracing zones + slow-execution watchdogs (reference Tracy
+ZoneScoped + util/LogSlowExecution.h)."""
+
+import logging
+
+from stellar_tpu.utils.metrics import registry
+from stellar_tpu.utils.tracing import (
+    LogSlowExecution, current_zones, zone,
+)
+
+
+def test_zone_nesting_and_timing():
+    registry.clear()
+    with zone("outer"):
+        assert current_zones() == ["outer"]
+        with zone("inner"):
+            assert current_zones() == ["outer", "inner"]
+        assert current_zones() == ["outer"]
+    assert current_zones() == []
+    m = registry.to_dict()
+    assert m["zone.outer"]["count"] == 1
+    assert m["zone.inner"]["count"] == 1
+    # inclusive times: outer >= inner
+    assert m["zone.outer"]["max_ms"] >= m["zone.inner"]["max_ms"]
+
+
+def test_slow_execution_warns(caplog):
+    registry.clear()
+    with caplog.at_level(logging.WARNING, "stellar_tpu.perf"):
+        with LogSlowExecution("fast-scope", threshold_ms=10_000):
+            pass
+        assert not caplog.records
+        import time
+        with LogSlowExecution("slow-scope", threshold_ms=0.0001):
+            time.sleep(0.002)
+    assert any("slow-scope" in r.message for r in caplog.records)
+    assert registry.to_dict()["slow.slow-scope"]["count"] == 1
+
+
+def test_ledger_close_records_zones():
+    registry.clear()
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        keypair, seed_root_with_accounts,
+    )
+    a = keypair("tr-a")
+    root = seed_root_with_accounts([(a, 10**12)])
+    lm = LedgerManager(b"\x31" * 32, root)
+    lcl = lm.last_closed_header
+    txset, _ = make_tx_set_from_transactions([], lcl, lm.last_closed_hash)
+    ap = txset.prepare_for_apply() \
+        if hasattr(txset, "prepare_for_apply") else txset
+    lm.close_ledger(LedgerCloseData(
+        ledger_seq=lcl.ledgerSeq + 1, tx_set=ap,
+        close_time=lcl.scpValue.closeTime + 5))
+    m = registry.to_dict()
+    assert m["zone.ledger.close"]["count"] == 1
+    assert m["zone.bucket.addBatch"]["count"] >= 1
+    assert m["frame.ledger_close"]["count"] == 1
+
+
+def test_status_manager_lines_in_info():
+    from stellar_tpu.utils.status import StatusCategory, StatusManager
+    sm = StatusManager()
+    assert sm.status_lines() == []
+    sm.set_status(StatusCategory.HISTORY_CATCHUP, "Catching up: 5/63")
+    sm.set_status(StatusCategory.HISTORY_PUBLISH, "Publishing 63")
+    assert sm.status_lines() == ["Catching up: 5/63", "Publishing 63"]
+    sm.set_status(StatusCategory.HISTORY_CATCHUP, "Catching up: 60/63")
+    assert sm.get_status(StatusCategory.HISTORY_CATCHUP) == \
+        "Catching up: 60/63"
+    sm.remove_status(StatusCategory.HISTORY_CATCHUP)
+    assert sm.status_lines() == ["Publishing 63"]
+
+    # surfaced through Application.info
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.config import Config
+    app = Application(Config())
+    app.status_manager.set_status(StatusCategory.REQUIRES_UPGRADES,
+                                  "upgrade vote pending")
+    assert "upgrade vote pending" in app.info()["status"]
