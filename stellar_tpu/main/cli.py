@@ -421,13 +421,20 @@ def cmd_apply_load(args) -> int:
     distribution; catchup = BASELINE #3 replay; scp-storm = BASELINE #4
     16-validator consensus rounds."""
     from stellar_tpu.simulation.load_generator import (
-        apply_load, catchup_replay_bench, scp_storm_bench,
+        apply_load, catchup_replay_bench, multisig_apply_load,
+        scp_storm_bench, soroban_apply_load,
     )
     if args.scenario == "catchup":
         stats = catchup_replay_bench(n_ledgers=args.ledgers,
                                      txs_per_ledger=args.txs)
     elif args.scenario == "scp-storm":
         stats = scp_storm_bench(n_validators=16, n_rounds=args.ledgers)
+    elif args.scenario == "multisig":
+        stats = multisig_apply_load(n_ledgers=args.ledgers,
+                                    txs_per_ledger=args.txs)
+    elif args.scenario == "soroban":
+        stats = soroban_apply_load(n_ledgers=args.ledgers,
+                                   txs_per_ledger=args.txs)
     else:
         stats = apply_load(n_ledgers=args.ledgers,
                            txs_per_ledger=args.txs)
@@ -486,7 +493,8 @@ def main(argv=None) -> int:
     sp.add_argument("--ledgers", type=int, default=10)
     sp.add_argument("--txs", type=int, default=100)
     sp.add_argument("--scenario", default="close",
-                    choices=["close", "catchup", "scp-storm"])
+                    choices=["close", "catchup", "scp-storm",
+                             "multisig", "soroban"])
     sp.set_defaults(fn=cmd_apply_load)
     from stellar_tpu.main.cli_offline import register as register_offline
     register_offline(sub)

@@ -302,7 +302,10 @@ def apply_load(n_ledgers: int = 10, txs_per_ledger: int = 100,
     root = seed_root_with_accounts([(k, 10**13) for k in keys])
     lm = LedgerManager(TEST_NETWORK_ID, root)
     lm.last_closed_header.maxTxSetSize = max(1000, txs_per_ledger * 2)
-    close_timer = registry.timer("ledger.ledger.close")
+    from stellar_tpu.utils.metrics import Timer
+    # per-run timer: the process-wide registry timer accumulates
+    # across scenarios, which would contaminate repeat-run stats
+    close_timer = Timer()
     seqs = {k.public_key.raw: (1 << 32) for k in keys}
     total_applied = 0
     for ledger_i in range(n_ledgers):
@@ -334,6 +337,304 @@ def apply_load(n_ledgers: int = 10, txs_per_ledger: int = 100,
         "close_stddev_ms": stats["stddev_ms"],
         "tx_apply_per_sec": round(
             total_applied / (stats["mean_ms"] * n_ledgers / 1000.0), 1)
+        if stats["mean_ms"] else 0.0,
+    }
+
+
+def multisig_apply_load(n_ledgers: int = 5, txs_per_ledger: int = 1000,
+                        extra_signers: int = 1) -> dict:
+    """BASELINE config #2: 1,000-tx multi-signer payment sets — every tx
+    carries 1 + extra_signers ed25519 signatures, all checked at apply
+    (the ~2k-sig TxSet shape the north-star targets)."""
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, make_tx, payment_op, seed_root_with_accounts,
+    )
+    from stellar_tpu.xdr.types import (
+        Signer, SignerKey, SignerKeyType, account_id,
+    )
+    n_accounts = 64
+    keys = [SecretKey.from_seed_str(f"ms-{i}") for i in range(n_accounts)]
+    cosigners = [SecretKey.from_seed_str(f"ms-co-{i}-{j}")
+                 for i in range(n_accounts) for j in range(extra_signers)]
+    root = seed_root_with_accounts([(k, 10**13) for k in keys])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.maxTxSetSize = max(2000, txs_per_ledger * 2)
+    # register each account's cosigners (reference SetOptions signers)
+    with LedgerTxn(lm.root) as ltx:
+        for i, k in enumerate(keys):
+            h = ltx.load(account_key(account_id(k.public_key.raw)))
+            acct = h.entry.data.value
+            for j in range(extra_signers):
+                co = cosigners[i * extra_signers + j]
+                acct.signers.append(Signer(
+                    key=SignerKey.make(
+                        SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        co.public_key.raw),
+                    weight=1))
+            acct.numSubEntries += extra_signers
+            # require master + every cosigner (medium threshold =
+            # total weight), so each signature is consumed and verified
+            t = 1 + extra_signers
+            acct.thresholds = bytes([1, t, t, t])
+            h.deactivate()
+        ltx.commit()
+    from stellar_tpu.utils.metrics import Timer
+    # per-run timer: the process-wide registry timer accumulates
+    # across scenarios, which would contaminate repeat-run stats
+    close_timer = Timer()
+    seqs = {k.public_key.raw: (1 << 32) for k in keys}
+    total = 0
+    for _ in range(n_ledgers):
+        frames = []
+        for t in range(txs_per_ledger):
+            src = keys[t % n_accounts]
+            cos = [cosigners[(t % n_accounts) * extra_signers + j]
+                   for j in range(extra_signers)]
+            seqs[src.public_key.raw] += 1
+            frames.append(make_tx(
+                src, seqs[src.public_key.raw],
+                [payment_op(keys[(t + 1) % n_accounts], XLM)],
+                extra_signers=cos))
+        txset, _ = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash)
+        with close_timer.time():
+            res = lm.close_ledger(LedgerCloseData(
+                lm.ledger_seq + 1, txset,
+                lm.last_closed_header.scpValue.closeTime + 5))
+        if res.failed_count:
+            raise RuntimeError(f"multisig load failures: "
+                               f"{res.failed_count}")
+        total += res.applied_count
+    stats = close_timer.to_dict()
+    sigs_per_tx = 1 + extra_signers
+    return {
+        "scenario": "multisig",
+        "ledgers": n_ledgers,
+        "txs_per_ledger": txs_per_ledger,
+        "signatures_per_ledger": txs_per_ledger * sigs_per_tx,
+        "total_applied": total,
+        "close_mean_ms": stats["mean_ms"],
+        "close_max_ms": stats["max_ms"],
+        "sigs_per_sec": round(
+            total * sigs_per_tx / (stats["mean_ms"] * n_ledgers / 1000.0),
+            1) if stats["mean_ms"] else 0.0,
+    }
+
+
+def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
+                       ) -> dict:
+    """BASELINE config #5: Soroban InvokeHostFunction txs/ledger, each a
+    fee-bump outer envelope around an invoke with a signed ed25519 auth
+    entry — 3 signatures per tx (outer, inner, auth) through the verify
+    path, plus wasm execution and footprint/fee accounting."""
+    import dataclasses
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.host import (
+        assemble_program, auth_payload_hash, contract_code_key,
+        contract_data_key, derive_contract_id, ins, scaddress_account,
+        scaddress_contract, sym, u32,
+    )
+    from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, make_tx, seed_root_with_accounts,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, ContractExecutable,
+        ContractExecutableType, ContractIDPreimage,
+        ContractIDPreimageFromAddress, ContractIDPreimageType,
+        CreateContractArgs, HostFunction, HostFunctionType,
+        InvokeContractArgs, SCMapEntry, SCNonceKey, SCVal, SCValType,
+        SorobanAddressCredentials, SorobanAuthorizationEntry,
+        SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
+        SorobanAuthorizedInvocation, SorobanCredentials,
+        SorobanCredentialsType,
+    )
+    from stellar_tpu.xdr.tx import (
+        FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, TransactionV1Envelope, _FeeBumpInner,
+        feebump_sig_payload, muxed_account,
+    )
+    from stellar_tpu.xdr.types import EnvelopeType, account_id
+    T = SCValType
+    n_accounts = 50
+    srcs = [SecretKey.from_seed_str(f"sb-src-{i}")
+            for i in range(n_accounts)]
+    payers = [SecretKey.from_seed_str(f"sb-pay-{i}")
+              for i in range(n_accounts)]
+    signer = SecretKey.from_seed_str("sb-auth-signer")
+    root = seed_root_with_accounts(
+        [(k, 10**13) for k in srcs + payers + [signer]])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.maxTxSetSize = max(2000, txs_per_ledger * 2)
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    lm.last_closed_header.ledgerVersion = CURRENT_LEDGER_PROTOCOL_VERSION
+    # per-run raised caps, as a config upgrade would set them
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=max(1000, txs_per_ledger),
+        tx_max_read_ledger_entries=10, tx_max_write_ledger_entries=8)
+    lm.root.soroban_config = lm.soroban_config
+
+    code = assemble_program({
+        "auth_incr": [
+            ins("arg", u32(0)), ins("require_auth"),
+            ins("push", sym("count")), ins("has", sym("persistent")),
+            ins("jz", u32(3)),
+            ins("push", sym("count")), ins("get", sym("persistent")),
+            ins("jmp", u32(1)),
+            ins("push", u32(0)),
+            ins("push", u32(1)), ins("add"),
+            ins("push", sym("count")), ins("swap"),
+            ins("put", sym("persistent")),
+            ins("ret"),
+        ],
+    })
+    code_hash = sha256(code)
+    owner = srcs[0]
+    seqs = {k.public_key.raw: (1 << 32) for k in srcs + payers}
+
+    def _close(frames):
+        txset, excluded = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash,
+            soroban_config=lm.soroban_config)
+        if excluded:
+            raise RuntimeError(f"{len(excluded)} txs excluded from set")
+        return lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5))
+
+    # setup ledger: upload + create
+    seqs[owner.public_key.raw] += 1
+    up = make_tx(owner, seqs[owner.public_key.raw], [_soroban_op(
+        HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            code))], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_write=[contract_code_key(code_hash)]))
+    preimage = ContractIDPreimage.make(
+        ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+        ContractIDPreimageFromAddress(
+            address=scaddress_account(account_id(owner.public_key.raw)),
+            salt=b"\x66" * 32))
+    contract_id = derive_contract_id(TEST_NETWORK_ID, preimage)
+    addr = scaddress_contract(contract_id)
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    seqs[owner.public_key.raw] += 1
+    create = make_tx(owner, seqs[owner.public_key.raw], [_soroban_op(
+        HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+            CreateContractArgs(
+                contractIDPreimage=preimage,
+                executable=ContractExecutable.make(
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                    code_hash))))], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[contract_code_key(code_hash)],
+            read_write=[inst_key]))
+    res = _close([up])
+    res2 = _close([create])
+    if res.failed_count or res2.failed_count:
+        raise RuntimeError("soroban load setup failed")
+
+    addr_signer = scaddress_account(account_id(signer.public_key.raw))
+    counter_key = contract_data_key(addr, sym("count"),
+                                    ContractDataDurability.PERSISTENT)
+    from stellar_tpu.utils.metrics import Timer
+    # per-run timer: the process-wide registry timer accumulates
+    # across scenarios, which would contaminate repeat-run stats
+    close_timer = Timer()
+    total = 0
+    nonce = 0
+    for _ in range(n_ledgers):
+        frames = []
+        for t in range(txs_per_ledger):
+            src = srcs[t % n_accounts]
+            payer = payers[t % n_accounts]
+            nonce += 1
+            invocation = SorobanAuthorizedInvocation(
+                function=SorobanAuthorizedFunction.make(
+                    SorobanAuthorizedFunctionType
+                    .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                    InvokeContractArgs(
+                        contractAddress=addr, functionName=b"auth_incr",
+                        args=[SCVal.make(T.SCV_ADDRESS, addr_signer)])),
+                subInvocations=[])
+            expiry = lm.ledger_seq + 1000
+            payload = auth_payload_hash(TEST_NETWORK_ID, nonce, expiry,
+                                        invocation)
+            sig_val = SCVal.make(T.SCV_VEC, [SCVal.make(T.SCV_MAP, [
+                SCMapEntry(key=sym("public_key"),
+                           val=SCVal.make(T.SCV_BYTES,
+                                          signer.public_key.raw)),
+                SCMapEntry(key=sym("signature"),
+                           val=SCVal.make(T.SCV_BYTES,
+                                          signer.sign(payload))),
+            ])])
+            auth = SorobanAuthorizationEntry(
+                credentials=SorobanCredentials.make(
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+                    SorobanAddressCredentials(
+                        address=addr_signer, nonce=nonce,
+                        signatureExpirationLedger=expiry,
+                        signature=sig_val)),
+                rootInvocation=invocation)
+            nonce_key = contract_data_key(
+                addr_signer,
+                SCVal.make(T.SCV_LEDGER_KEY_NONCE,
+                           SCNonceKey(nonce=nonce)),
+                ContractDataDurability.TEMPORARY)
+            seqs[src.public_key.raw] += 1
+            inner = make_tx(
+                src, seqs[src.public_key.raw],
+                [_soroban_op(HostFunction.make(
+                    HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+                    InvokeContractArgs(
+                        contractAddress=addr, functionName=b"auth_incr",
+                        args=[SCVal.make(T.SCV_ADDRESS, addr_signer)])),
+                    [auth])],
+                fee=5_000_200,  # covers the declared resource fee
+                soroban_data=_soroban_data(
+                    read_only=[inst_key, contract_code_key(code_hash)],
+                    read_write=[counter_key, nonce_key]))
+            # fee-bump outer envelope signed by the payer
+            fb = FeeBumpTransaction(
+                feeSource=muxed_account(payer.public_key.raw),
+                fee=12_000_000,
+                innerTx=_FeeBumpInner.make(
+                    EnvelopeType.ENVELOPE_TYPE_TX,
+                    TransactionV1Envelope(
+                        tx=inner.tx, signatures=inner.signatures)),
+                ext=FeeBumpTransaction._types[3].make(0))
+            h = sha256(feebump_sig_payload(TEST_NETWORK_ID, fb))
+            env = TransactionEnvelope.make(
+                EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+                FeeBumpTransactionEnvelope(
+                    tx=fb, signatures=[payer.sign_decorated(h)]))
+            frames.append(FeeBumpTransactionFrame(TEST_NETWORK_ID, env))
+        with close_timer.time():
+            res = _close(frames)
+        if res.failed_count:
+            raise RuntimeError(
+                f"soroban load: {res.failed_count} txs failed")
+        total += res.applied_count
+    stats = close_timer.to_dict()
+    counter = lm.root.store.get(key_bytes(counter_key))
+    return {
+        "scenario": "soroban",
+        "ledgers": n_ledgers,
+        "txs_per_ledger": txs_per_ledger,
+        "signatures_per_ledger": txs_per_ledger * 3,
+        "total_applied": total,
+        "counter_value": counter.data.value.val.value
+        if counter is not None else None,
+        "close_mean_ms": stats["mean_ms"],
+        "close_max_ms": stats["max_ms"],
+        "txs_per_sec": round(
+            total / (stats["mean_ms"] * n_ledgers / 1000.0), 1)
         if stats["mean_ms"] else 0.0,
     }
 

@@ -148,3 +148,24 @@ def test_soroban_load_modes():
     entry = app.lm.root.store.get(key_bytes(counter_key))
     assert entry is not None
     assert entry.data.value.val.value >= 1
+
+
+def test_multisig_apply_load_scenario():
+    """BASELINE #2 shape: multi-signer payment sets where every tx
+    carries 2 consumed ed25519 signatures."""
+    from stellar_tpu.simulation.load_generator import multisig_apply_load
+    out = multisig_apply_load(n_ledgers=2, txs_per_ledger=20)
+    assert out["total_applied"] == 40
+    assert out["signatures_per_ledger"] == 40
+    assert out["sigs_per_sec"] > 0
+
+
+def test_soroban_apply_load_scenario():
+    """BASELINE #5 shape: fee-bump outer sig + inner sig + signed auth
+    entry per InvokeHostFunction tx, applied through real closes."""
+    from stellar_tpu.simulation.load_generator import soroban_apply_load
+    out = soroban_apply_load(n_ledgers=2, txs_per_ledger=10)
+    assert out["total_applied"] == 20
+    # every invoke really executed the contract
+    assert out["counter_value"] == 20
+    assert out["signatures_per_ledger"] == 30
