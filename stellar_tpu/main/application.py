@@ -131,6 +131,20 @@ class Application:
         if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0 and \
                 self.database is not None:
             self._schedule_maintenance()
+        self._schedule_overlay_tick()
+
+    def _schedule_overlay_tick(self):
+        """Recurring peer-liveness sweep (reference OverlayManager
+        tick timer)."""
+        from stellar_tpu.utils.timer import VirtualTimer
+
+        def run():
+            self.overlay.tick()
+            self._schedule_overlay_tick()
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(5)
+        t.async_wait(run, lambda: None)
+        self._overlay_tick_timer = t
 
     def _schedule_maintenance(self):
         """Periodic history GC (reference Maintainer::scheduleMaintenance)."""

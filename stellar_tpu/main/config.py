@@ -47,6 +47,10 @@ class Config:
     MAX_PENDING_CONNECTIONS: int = 500
     KNOWN_PEERS: List[str] = field(default_factory=list)
     PREFERRED_PEERS: List[str] = field(default_factory=list)
+    # liveness sweeps (reference PEER_TIMEOUT /
+    # PEER_AUTHENTICATION_TIMEOUT, seconds)
+    PEER_TIMEOUT: int = 30
+    PEER_AUTHENTICATION_TIMEOUT: int = 10
     PEER_FLOOD_READING_CAPACITY: int = 200
     PEER_FLOOD_READING_CAPACITY_BYTES: int = 300_000
     FLOW_CONTROL_SEND_MORE_BATCH_SIZE: int = 40
@@ -96,6 +100,7 @@ class Config:
             "EXPECTED_LEDGER_CLOSE_TIME", "INVARIANT_CHECKS",
             "DATABASE", "BUCKET_DIR_PATH",
             "MAX_PENDING_CONNECTIONS", "PREFERRED_PEERS",
+            "PEER_TIMEOUT", "PEER_AUTHENTICATION_TIMEOUT",
             "PEER_FLOOD_READING_CAPACITY",
             "PEER_FLOOD_READING_CAPACITY_BYTES",
             "FLOW_CONTROL_SEND_MORE_BATCH_SIZE",

@@ -70,7 +70,37 @@ class OverlayManager:
         self.tx_demands = TxDemandsManager()
         from stellar_tpu.overlay.survey_manager import SurveyManager
         self.survey_manager = SurveyManager(app)
+        cfg = getattr(app, "config", None)
+        # liveness budgets (reference Config PEER_TIMEOUT /
+        # PEER_AUTHENTICATION_TIMEOUT, enforced by the overlay tick)
+        self.peer_timeout = getattr(cfg, "PEER_TIMEOUT", 30)
+        self.peer_auth_timeout = getattr(
+            cfg, "PEER_AUTHENTICATION_TIMEOUT", 10)
         self._wire_herder()
+
+    def tick(self):
+        """Periodic liveness sweep (reference ``Peer``'s 5s recurrent
+        timer): drop pending peers that never authenticated within
+        PEER_AUTHENTICATION_TIMEOUT; ping authenticated peers (a
+        GET_SCP_QUORUMSET for a time-derived hash, answered DONT_HAVE —
+        reference ``pingPeer``) and drop those with neither reads nor
+        successful writes inside PEER_TIMEOUT."""
+        from stellar_tpu.crypto.sha import sha256
+        now = self.app.clock.now()
+        for p in list(self.pending_peers):
+            if now - p.created_at > self.peer_auth_timeout:
+                p.drop("authentication timeout")
+        for p in list(self.peers):
+            if now - p.last_read_time > self.peer_timeout and \
+                    now - getattr(p, "last_write_time", now) > \
+                    self.peer_timeout:
+                p.drop("idle timeout")
+                continue
+            # ping: refreshes the remote's read-liveness view of us and
+            # elicits a response that refreshes ours of it
+            ping_id = sha256(b"ping" + str(now).encode())
+            p.send(StellarMessage.make(
+                MessageType.GET_SCP_QUORUMSET, ping_id))
 
     # ---------------- herder wiring ----------------
 

@@ -168,6 +168,12 @@ class Peer:
             getattr(cfg, "PEER_FLOOD_READING_CAPACITY_BYTES",
                     PEER_FLOOD_READING_CAPACITY_BYTES))
         self.on_drop: Optional[Callable] = None
+        # liveness bookkeeping for the overlay tick's timeout sweep
+        # (reference Peer::mLastRead/mLastWrite / pending-peer age)
+        now = app.clock.now()
+        self.created_at = now
+        self.last_read_time = now
+        self.last_write_time = now
 
     # ---------------- transport hooks ----------------
 
@@ -175,6 +181,7 @@ class Peer:
         raise NotImplementedError
 
     def receive_bytes(self, raw: bytes):
+        self.last_read_time = self.app.clock.now()
         sm = getattr(self.app.overlay, "survey_manager", None)
         if sm is not None:
             sm.note_traffic(self, read=len(raw))
@@ -229,6 +236,7 @@ class Peer:
         sm = getattr(self.app.overlay, "survey_manager", None)
         if sm is not None:
             sm.note_traffic(self, written=len(raw))
+        self.last_write_time = self.app.clock.now()
         self.send_bytes(raw)
 
     def _recv_authenticated(self, am: AuthenticatedMessageV0):

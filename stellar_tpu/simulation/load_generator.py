@@ -177,61 +177,16 @@ class LoadGenerator:
         create txs for the shared counter contract. Crank the network
         through at least two closes afterwards, then invoke load can
         run."""
-        from stellar_tpu.crypto.sha import sha256
-        from stellar_tpu.soroban.host import (
-            contract_code_key, contract_data_key, derive_contract_id,
-            scaddress_account, scaddress_contract,
-        )
-        from stellar_tpu.tx.tx_test_utils import make_tx
-        from stellar_tpu.xdr.contract import (
-            ContractDataDurability, ContractExecutable,
-            ContractExecutableType, ContractIDPreimage,
-            ContractIDPreimageFromAddress, ContractIDPreimageType,
-            CreateContractArgs, HostFunction, HostFunctionType, SCVal,
-            SCValType,
-        )
-        from stellar_tpu.xdr.types import account_id
         owner = self.accounts[0]
-        code = self._counter_code()
-        code_hash = sha256(code)
         seq = self._next_seq(owner)
         if seq is None:
             raise RuntimeError("loadgen account 0 does not exist yet")
-        up = HostFunction.make(
-            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
-            code)
-        self._submit(make_tx(
-            owner, seq, [_soroban_op(up)], fee=6_000_000,
-            soroban_data=_soroban_data(
-                read_write=[contract_code_key(code_hash)]),
-            network_id=self.app.herder.network_id), owner)
-        preimage = ContractIDPreimage.make(
-            ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
-            ContractIDPreimageFromAddress(
-                address=scaddress_account(
-                    account_id(owner.public_key.raw)),
-                salt=b"\x5a" * 32))
-        create = HostFunction.make(
-            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
-            CreateContractArgs(
-                contractIDPreimage=preimage,
-                executable=ContractExecutable.make(
-                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
-                    code_hash)))
-        self.contract_id = derive_contract_id(
-            self.app.herder.network_id, preimage)
-        addr = scaddress_contract(self.contract_id)
-        inst_key = contract_data_key(
-            addr, SCVal.make(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
-            ContractDataDurability.PERSISTENT)
-        self._submit(make_tx(
-            owner, self._next_seq(owner), [_soroban_op(create)],
-            fee=6_000_000,
-            soroban_data=_soroban_data(
-                read_only=[contract_code_key(code_hash)],
-                read_write=[inst_key]),
-            network_id=self.app.herder.network_id), owner)
-        self._code_hash = code_hash
+        up, create, self.contract_id, self._code_hash, _ = \
+            _deploy_frames(owner, seq, self._next_seq(owner),
+                           self._counter_code(),
+                           self.app.herder.network_id, salt=b"\x5a" * 32)
+        self._submit(up, owner)
+        self._submit(create, owner)
 
     def _invoke_tx(self, src, seq):
         from stellar_tpu.soroban.host import (
@@ -259,6 +214,62 @@ class LoadGenerator:
         return make_tx(src, seq, [_soroban_op(fn)], fee=6_000_000,
                        soroban_data=sd,
                        network_id=self.app.herder.network_id)
+
+
+def _deploy_frames(owner, seq_upload: int, seq_create: int, code: bytes,
+                   network_id: bytes, salt: bytes):
+    """(upload_frame, create_frame, contract_id, code_hash, inst_key):
+    the contract-deployment pair shared by the paced LoadGenerator and
+    the apply-load soroban scenario."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.soroban.host import (
+        contract_code_key, contract_data_key, derive_contract_id,
+        scaddress_account, scaddress_contract,
+    )
+    from stellar_tpu.tx.tx_test_utils import make_tx
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, ContractExecutable,
+        ContractExecutableType, ContractIDPreimage,
+        ContractIDPreimageFromAddress, ContractIDPreimageType,
+        CreateContractArgs, HostFunction, HostFunctionType, SCVal,
+        SCValType,
+    )
+    from stellar_tpu.xdr.types import account_id
+    code_hash = sha256(code)
+    upload = make_tx(
+        owner, seq_upload,
+        [_soroban_op(HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            code))],
+        fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_write=[contract_code_key(code_hash)]),
+        network_id=network_id)
+    preimage = ContractIDPreimage.make(
+        ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+        ContractIDPreimageFromAddress(
+            address=scaddress_account(account_id(owner.public_key.raw)),
+            salt=salt))
+    contract_id = derive_contract_id(network_id, preimage)
+    addr = scaddress_contract(contract_id)
+    inst_key = contract_data_key(
+        addr, SCVal.make(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    create = make_tx(
+        owner, seq_create,
+        [_soroban_op(HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+            CreateContractArgs(
+                contractIDPreimage=preimage,
+                executable=ContractExecutable.make(
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                    code_hash))))],
+        fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[contract_code_key(code_hash)],
+            read_write=[inst_key]),
+        network_id=network_id)
+    return upload, create, contract_id, code_hash, inst_key
 
 
 def _soroban_op(host_fn, auth=()):
@@ -434,18 +445,15 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.soroban.host import (
         assemble_program, auth_payload_hash, contract_code_key,
-        contract_data_key, derive_contract_id, ins, scaddress_account,
-        scaddress_contract, sym, u32,
+        contract_data_key, ins, scaddress_account, scaddress_contract,
+        sym, u32,
     )
     from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
     from stellar_tpu.tx.tx_test_utils import (
         TEST_NETWORK_ID, make_tx, seed_root_with_accounts,
     )
     from stellar_tpu.xdr.contract import (
-        ContractDataDurability, ContractExecutable,
-        ContractExecutableType, ContractIDPreimage,
-        ContractIDPreimageFromAddress, ContractIDPreimageType,
-        CreateContractArgs, HostFunction, HostFunctionType,
+        ContractDataDurability, HostFunction, HostFunctionType,
         InvokeContractArgs, SCMapEntry, SCNonceKey, SCVal, SCValType,
         SorobanAddressCredentials, SorobanAuthorizationEntry,
         SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
@@ -495,46 +503,26 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
     owner = srcs[0]
     seqs = {k.public_key.raw: (1 << 32) for k in srcs + payers}
 
-    def _close(frames):
+    def _make_set(frames):
         txset, excluded = make_tx_set_from_transactions(
             frames, lm.last_closed_header, lm.last_closed_hash,
             soroban_config=lm.soroban_config)
         if excluded:
             raise RuntimeError(f"{len(excluded)} txs excluded from set")
+        return txset
+
+    def _close(frames):
         return lm.close_ledger(LedgerCloseData(
-            lm.ledger_seq + 1, txset,
+            lm.ledger_seq + 1, _make_set(frames),
             lm.last_closed_header.scpValue.closeTime + 5))
 
-    # setup ledger: upload + create
-    seqs[owner.public_key.raw] += 1
-    up = make_tx(owner, seqs[owner.public_key.raw], [_soroban_op(
-        HostFunction.make(
-            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
-            code))], fee=6_000_000,
-        soroban_data=_soroban_data(
-            read_write=[contract_code_key(code_hash)]))
-    preimage = ContractIDPreimage.make(
-        ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
-        ContractIDPreimageFromAddress(
-            address=scaddress_account(account_id(owner.public_key.raw)),
-            salt=b"\x66" * 32))
-    contract_id = derive_contract_id(TEST_NETWORK_ID, preimage)
+    # setup ledger: upload + create (shared deployment builder)
+    seqs[owner.public_key.raw] += 2
+    up, create, contract_id, code_hash, inst_key = _deploy_frames(
+        owner, seqs[owner.public_key.raw] - 1,
+        seqs[owner.public_key.raw], code, TEST_NETWORK_ID,
+        salt=b"\x66" * 32)
     addr = scaddress_contract(contract_id)
-    inst_key = contract_data_key(
-        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
-        ContractDataDurability.PERSISTENT)
-    seqs[owner.public_key.raw] += 1
-    create = make_tx(owner, seqs[owner.public_key.raw], [_soroban_op(
-        HostFunction.make(
-            HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
-            CreateContractArgs(
-                contractIDPreimage=preimage,
-                executable=ContractExecutable.make(
-                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
-                    code_hash))))], fee=6_000_000,
-        soroban_data=_soroban_data(
-            read_only=[contract_code_key(code_hash)],
-            read_write=[inst_key]))
     res = _close([up])
     res2 = _close([create])
     if res.failed_count or res2.failed_count:
@@ -615,8 +603,13 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
                 FeeBumpTransactionEnvelope(
                     tx=fb, signatures=[payer.sign_decorated(h)]))
             frames.append(FeeBumpTransactionFrame(TEST_NETWORK_ID, env))
+        # time ONLY closeLedger (set assembly outside), so close stats
+        # are comparable with the other apply-load scenarios
+        txset = _make_set(frames)
         with close_timer.time():
-            res = _close(frames)
+            res = lm.close_ledger(LedgerCloseData(
+                lm.ledger_seq + 1, txset,
+                lm.last_closed_header.scpValue.closeTime + 5))
         if res.failed_count:
             raise RuntimeError(
                 f"soroban load: {res.failed_count} txs failed")

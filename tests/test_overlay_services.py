@@ -164,3 +164,54 @@ def test_ban_manager_persists(tmp_path):
     assert bm2.is_banned(nid)
     bm2.unban(nid)
     assert not bm2.is_banned(nid)
+
+
+def test_peer_liveness_timeouts():
+    """The overlay tick drops never-authenticating pending peers after
+    PEER_AUTHENTICATION_TIMEOUT and idle authenticated peers after
+    PEER_TIMEOUT (reference OverlayManagerImpl::tick)."""
+    from stellar_tpu.simulation.simulation import Topologies
+    sim = Topologies.pair()
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() == 1 for a in apps),
+        30)
+    a = apps[0]
+
+    # a pending peer that never completes the handshake gets dropped
+    class _StuckPeer:
+        def __init__(self, clock):
+            self.created_at = clock.now()
+            self.last_read_time = self.created_at
+            self.last_write_time = self.created_at  # sends never succeed
+            self.dropped = None
+            self.remote_node_id = b"\xfe" * 32
+
+        def send(self, msg):  # broadcast sink: silent peer
+            pass
+
+        def is_authenticated(self):
+            return True
+
+        def drop(self, reason=""):
+            self.dropped = reason
+            a.overlay.peer_dropped(self, reason)
+    stuck = _StuckPeer(a.clock)
+    a.overlay.add_pending(stuck)
+    a.overlay.peer_auth_timeout = 0.5
+    assert sim.crank_until(lambda: stuck.dropped is not None, 30)
+    assert "authentication timeout" in stuck.dropped
+    assert stuck not in a.overlay.pending_peers
+
+    # an authenticated peer that goes silent gets idle-dropped; the
+    # active partner keeps flowing (SCP traffic at the 5s close cadence
+    # refreshes its last_read), so a timeout just above the cadence
+    # separates the two
+    real = a.overlay.peers[0]
+    idle = _StuckPeer(a.clock)
+    a.overlay.peers.append(idle)
+    a.overlay.peer_timeout = 12
+    assert sim.crank_until(lambda: idle.dropped is not None, 60)
+    assert "idle timeout" in idle.dropped
+    assert real in a.overlay.peers  # live peer untouched
