@@ -93,8 +93,43 @@ def dispatch_floor_ms():
     return float(np.median(times))
 
 
+def _probe_device(timeout_s: float = 180.0) -> bool:
+    """True when a trivial dispatch completes within the budget. The
+    TPU tunnel can wedge (observed: libtpu version-mismatch windows
+    where even x+1 blocks forever); failing loudly beats hanging the
+    benchmark harness."""
+    import threading
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            import jax
+            f = jax.jit(lambda x: x + 1)
+            np.asarray(f(np.zeros(2, np.int32)))
+        except Exception as e:  # fail fast with the real cause
+            err.append(e)
+        finally:
+            done.set()
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        return False
+    if err:
+        raise RuntimeError(f"device probe failed: {err[0]!r}")
+    return True
+
+
 def main():
     _enable_compilation_cache()
+    if not _probe_device():
+        print(json.dumps({
+            "metric": "txset_sigverify_p50_ms", "value": None,
+            "unit": "ms", "vs_baseline": None,
+            "error": "device unreachable: trivial dispatch did not "
+                     "complete within 180s (TPU tunnel down?)",
+        }))
+        return 3
     from stellar_tpu.crypto.batch_verifier import BatchVerifier
     from stellar_tpu.crypto import native_prep
 

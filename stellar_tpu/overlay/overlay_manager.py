@@ -91,9 +91,13 @@ class OverlayManager:
             if now - p.created_at > self.peer_auth_timeout:
                 p.drop("authentication timeout")
         for p in list(self.peers):
-            if now - p.last_read_time > self.peer_timeout and \
-                    now - getattr(p, "last_write_time", now) > \
-                    self.peer_timeout:
+            # pings below guarantee a live peer answers (DONT_HAVE)
+            # every tick, so read-silence across the whole timeout
+            # means ~timeout/5 unanswered pings: genuinely gone.
+            # (The reference conditions on write-idle too, but its
+            # writes are socket-flush timestamps; here queueing always
+            # succeeds, which would make the sweep unreachable.)
+            if now - p.last_read_time > self.peer_timeout:
                 p.drop("idle timeout")
                 continue
             # ping: refreshes the remote's read-liveness view of us and

@@ -511,10 +511,13 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
             raise RuntimeError(f"{len(excluded)} txs excluded from set")
         return txset
 
-    def _close(frames):
+    def _close_set(txset):
         return lm.close_ledger(LedgerCloseData(
-            lm.ledger_seq + 1, _make_set(frames),
+            lm.ledger_seq + 1, txset,
             lm.last_closed_header.scpValue.closeTime + 5))
+
+    def _close(frames):
+        return _close_set(_make_set(frames))
 
     # setup ledger: upload + create (shared deployment builder)
     seqs[owner.public_key.raw] += 2
@@ -607,9 +610,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
         # are comparable with the other apply-load scenarios
         txset = _make_set(frames)
         with close_timer.time():
-            res = lm.close_ledger(LedgerCloseData(
-                lm.ledger_seq + 1, txset,
-                lm.last_closed_header.scpValue.closeTime + 5))
+            res = _close_set(txset)
         if res.failed_count:
             raise RuntimeError(
                 f"soroban load: {res.failed_count} txs failed")
