@@ -424,6 +424,19 @@ def cmd_apply_load(args) -> int:
         apply_load, catchup_replay_bench, multisig_apply_load,
         scp_storm_bench, soroban_apply_load,
     )
+    mode = getattr(args, "verify", "auto")
+    if mode == "device":
+        # force every verification through the device batch verifier
+        # (BASELINE #3: catchup replay no longer sig-bound)
+        from stellar_tpu.crypto.batch_verifier import default_verifier
+        default_verifier().install()
+    elif mode == "host":
+        # force the host oracle even for large batches (the CPU
+        # baseline side of the A/B)
+        from stellar_tpu.crypto import ed25519_ref
+        from stellar_tpu.crypto.keys import set_verifier_backend
+        set_verifier_backend(ed25519_ref.verify)
+    # "auto" (default): host below MIN_DEVICE_BATCH, device above
     if args.scenario == "catchup":
         stats = catchup_replay_bench(n_ledgers=args.ledgers,
                                      txs_per_ledger=args.txs)
@@ -495,6 +508,11 @@ def main(argv=None) -> int:
     sp.add_argument("--scenario", default="close",
                     choices=["close", "catchup", "scp-storm",
                              "multisig", "soroban"])
+    sp.add_argument("--verify", default="auto",
+                    choices=["auto", "host", "device"],
+                    help="signature verification routing: auto = "
+                    "device for large batches only; host / device "
+                    "force one side of the A/B")
     sp.set_defaults(fn=cmd_apply_load)
     from stellar_tpu.main.cli_offline import register as register_offline
     register_offline(sub)

@@ -679,6 +679,11 @@ def catchup_replay_bench(n_ledgers: int = 256,
     # genesis must match the published chain's bit-for-bit
     lm2.last_closed_header.maxTxSetSize = \
         max(1000, txs_per_ledger * 2)
+    # the chain build above verified every signature through the
+    # process-wide result cache; flush it so the replay measures real
+    # verification work (the whole point of BASELINE #3)
+    from stellar_tpu.crypto.keys import flush_verify_cache
+    flush_verify_cache()
     ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
     target = hm.published_checkpoints[-1]
     work = CatchupWork(lm2, FileArchive(tmp),
