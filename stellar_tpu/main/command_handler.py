@@ -104,11 +104,17 @@ class CommandHandler:
 
     def cmd_quorum(self, params):
         def quorum():
+            from stellar_tpu.herder.quorum_tracker import QuorumTracker
             from stellar_tpu.scp.quorum import for_all_nodes
             q = self.app.herder.scp.local_qset
-            return {"threshold": q.threshold,
-                    "validators": [v.hex()[:16]
-                                   for v in for_all_nodes(q)]}
+            out = {"threshold": q.threshold,
+                   "validators": [v.hex()[:16]
+                                  for v in for_all_nodes(q)]}
+            # reference form: quorum?transitive=true
+            if params.get("transitive", ["false"])[0] == "true":
+                out["transitive"] = QuorumTracker(
+                    self.app.herder).analyze()
+            return out
         return self._on_main(quorum)
 
     def cmd_scp(self, params):

@@ -113,3 +113,67 @@ def test_checker_handles_sim_qsets():
     qs = qset(3, ids)
     qic = QuorumIntersectionChecker({n: qs for n in ids})
     assert qic.network_enjoys_quorum_intersection()
+
+
+def test_quorum_tracker_transitive_analysis():
+    """QuorumTracker expands the transitive quorum from SCP traffic and
+    reports intersection + critical nodes (reference QuorumTracker +
+    the 'quorum?transitive' endpoint analytics)."""
+    from stellar_tpu.herder.quorum_tracker import QuorumTracker
+    from stellar_tpu.simulation.simulation import Topologies
+    sim = Topologies.core4()
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() >= 3 for a in apps),
+        30)
+    target = apps[0].lm.ledger_seq + 2
+    assert sim.crank_until_ledger(target, timeout=300)
+    tr = QuorumTracker(apps[0].herder).analyze()
+    # all 4 validators share one qset -> closure is the full clique
+    assert tr["node_count"] == 4
+    assert tr["fully_known"] is True
+    assert tr["intersection"] is True
+    # threshold 3 of 4 tolerates any single failure: nobody critical
+    assert tr["critical_nodes"] == []
+
+
+def test_quorum_tracker_critical_node():
+    """A bridge node whose fickle reconfiguration would let the network
+    split is reported intersection-critical (reference
+    getIntersectionCriticalGroups semantics)."""
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.herder.quorum_tracker import QuorumTracker
+    from stellar_tpu.herder.quorum_intersection import (
+        QuorumIntersectionChecker,
+    )
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    def nid(name):
+        return SecretKey.from_seed_str(name).public_key.raw
+
+    def qs(threshold, *nodes):
+        return SCPQuorumSet(threshold=threshold,
+                            validators=[make_node_id(n) for n in nodes],
+                            innerSets=[])
+    a1, a2 = nid("qt-a1"), nid("qt-a2")
+    b1, b2 = nid("qt-b1"), nid("qt-b2")
+    h = nid("qt-h")
+    # {a1,a2} is a self-sufficient clique; the b side needs h, and h's
+    # own config anchors it to a1 — every b-quorum therefore overlaps
+    # the a-clique, so intersection holds
+    qmap = {
+        a1: qs(2, a1, a2),
+        a2: qs(2, a1, a2),
+        b1: qs(3, b1, b2, h),
+        b2: qs(3, b1, b2, h),
+        h: qs(2, h, a1),
+    }
+    assert QuorumIntersectionChecker(
+        qmap).network_enjoys_quorum_intersection()
+    # if h goes fickle, {b1,b2,h} becomes a quorum disjoint from
+    # {a1,a2}: h is intersection-critical
+    assert QuorumTracker._is_critical(qmap, {h})
+    # the a-clique members are not individually critical
+    assert not QuorumTracker._is_critical(qmap, {a1})
