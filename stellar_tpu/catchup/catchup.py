@@ -61,12 +61,15 @@ class CatchupConfiguration:
 
 def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
                       checkpoint: int,
-                      up_to: Optional[int] = None) -> int:
+                      up_to: Optional[int] = None,
+                      preloaded=None) -> int:
     """Replay one checkpoint's ledgers onto ``lm`` (reference
     ``ApplyCheckpointWork``). Returns how many ledgers were applied;
-    raises on any hash divergence."""
+    raises on any hash divergence. ``preloaded`` short-circuits the
+    download when a BatchDownloadWork already fetched the data."""
     from stellar_tpu.herder.tx_set import TxSetXDRFrame
-    data = HistoryManager.get_checkpoint(archive, checkpoint)
+    data = preloaded if preloaded is not None else \
+        HistoryManager.get_checkpoint(archive, checkpoint)
     if data is None:
         raise FileNotFoundError(f"checkpoint {checkpoint} not in archive")
     headers, tx_entries, _results = data
@@ -109,13 +112,16 @@ def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
 
 def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
                           has: HistoryArchiveState,
-                          target_header_entry) -> None:
+                          target_header_entry,
+                          preloaded_buckets=None) -> None:
     """MINIMAL catchup: install archived buckets as the full state
     (reference ``DownloadBucketsWork`` + ``ApplyBucketsWork`` +
-    ``AssumeStateWork``)."""
+    ``AssumeStateWork``). ``preloaded_buckets`` (hex hash -> Bucket)
+    short-circuits downloads a DownloadBucketsWork already did."""
     from stellar_tpu.bucket.bucket import EMPTY
     from stellar_tpu.bucket.bucket_list import LiveBucketList
     from stellar_tpu.xdr.ledger import BucketEntryType
+    preloaded_buckets = preloaded_buckets or {}
 
     bl = LiveBucketList()
     for i, level in enumerate(has.bucket_hashes):
@@ -130,7 +136,8 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
             if set(hexhash) == {"0"}:
                 bucket = EMPTY
             else:
-                bucket = HistoryManager.get_bucket(archive, hexhash)
+                bucket = preloaded_buckets.get(hexhash) or \
+                    HistoryManager.get_bucket(archive, hexhash)
                 if bucket is None:
                     raise FileNotFoundError(f"bucket {hexhash} missing")
             setattr(bl.levels[i], attr, bucket)
@@ -179,10 +186,14 @@ class CatchupWork(WorkSequence):
         self.status_manager = status_manager
         self.has: Optional[HistoryArchiveState] = None
         self.verified_headers = []
+        self._download = None  # BatchDownloadWork, created by _plan
+        from stellar_tpu.historywork import GetHistoryArchiveStateWork
         from stellar_tpu.work.work import FunctionWork
-        self.add_child(FunctionWork("get-has", self._get_has))
-        self.add_child(FunctionWork("verify-chain", self._verify_chain))
-        self.add_child(FunctionWork("apply", self._apply))
+        self._has_work = GetHistoryArchiveStateWork(archive)
+        self.add_child(self._has_work)
+        # _plan appends the download fan-out + verify + apply children
+        # once the HAS (and so the checkpoint range) is known
+        self.add_child(FunctionWork("plan", self._plan))
 
     def _status(self, message: str) -> None:
         """Operator status line (reference sets HISTORY_CATCHUP through
@@ -205,33 +216,44 @@ class CatchupWork(WorkSequence):
                      f"(mode {self.config.mode})")
         return super().on_failure_raise()
 
-    def _get_has(self):
-        self._status(f"Catching up: fetching archive state "
-                     f"(mode {self.config.mode})")
-        self.has = HistoryManager.get_root_has(self.archive)
-        if self.has is None:
-            self._status("Catchup failed: archive has no root HAS")
-            return State.FAILURE
+    def _plan(self):
+        """HAS is in; fan out the checkpoint downloads (retrying work
+        per file), then chain-verify and apply (reference CatchupWork
+        building its download/verify/apply sub-DAG after the HAS)."""
+        self._status(f"Catching up: planning (mode {self.config.mode})")
+        self.has = self._has_work.has
+        from stellar_tpu.historywork import (
+            BatchDownloadWork, VerifyLedgerChainWork,
+        )
+        from stellar_tpu.work.work import FunctionWork
+        cps = list(range(
+            checkpoint_containing(max(1, self.lm.ledger_seq)),
+            checkpoint_containing(self._target()) + 1,
+            CHECKPOINT_FREQUENCY))
+        self._download = BatchDownloadWork(self.archive, cps)
+        self.add_child(self._download)
+        self.add_child(VerifyLedgerChainWork(self._collect_headers))
+        if self.config.mode == CatchupConfiguration.MINIMAL:
+            from stellar_tpu.historywork import DownloadBucketsWork
+            self._bucket_download = DownloadBucketsWork(
+                self.archive, self.has.all_bucket_hashes())
+            self.add_child(self._bucket_download)
+        else:
+            self._bucket_download = None
+        self.add_child(FunctionWork("apply", self._apply))
         return State.SUCCESS
+
+    def _collect_headers(self):
+        headers = []
+        for cp in sorted(self._download.downloaded):
+            headers.extend(self._download.downloaded[cp][0])
+        self.verified_headers = headers
+        return headers
 
     def _target(self) -> int:
         if self.config.to_ledger > 0:
             return min(self.config.to_ledger, self.has.current_ledger)
         return self.has.current_ledger
-
-    def _verify_chain(self):
-        headers = []
-        cp = checkpoint_containing(max(1, self.lm.ledger_seq))
-        while cp <= checkpoint_containing(self._target()):
-            data = HistoryManager.get_checkpoint(self.archive, cp)
-            if data is None:
-                return State.FAILURE
-            headers.extend(data[0])
-            cp += CHECKPOINT_FREQUENCY
-        if not verify_ledger_chain(headers):
-            return State.FAILURE
-        self.verified_headers = headers
-        return State.SUCCESS
 
     def _adopt_buckets_at(self, checkpoint: int,
                           has: "HistoryArchiveState") -> bool:
@@ -240,7 +262,10 @@ class CatchupWork(WorkSequence):
              if h.header.ledgerSeq == checkpoint), None)
         if cp_header is None:
             return False
-        apply_buckets_catchup(self.lm, self.archive, has, cp_header)
+        preloaded = self._bucket_download.buckets \
+            if self._bucket_download is not None else None
+        apply_buckets_catchup(self.lm, self.archive, has, cp_header,
+                              preloaded_buckets=preloaded)
         return True
 
     def _apply(self):
@@ -268,7 +293,9 @@ class CatchupWork(WorkSequence):
         while self.lm.ledger_seq < target:
             self._status(f"Catching up: applying checkpoint {cp} "
                          f"({self.lm.ledger_seq}/{target})")
-            replay_checkpoint(self.lm, self.archive, cp, up_to=target)
+            replay_checkpoint(
+                self.lm, self.archive, cp, up_to=target,
+                preloaded=self._download.downloaded.get(cp))
             cp += CHECKPOINT_FREQUENCY
         return State.SUCCESS
 

@@ -102,33 +102,43 @@ class FileArchive:
 
 class CommandArchive:
     """Archive reached through operator get/put command templates run
-    as subprocesses, with gzip transport encoding (reference
-    ``history/readme.md:5-29``: ``get="curl -sf {0} -o {1}"``,
-    ``put="aws s3 cp {1} {0}"``; {0}=remote name, {1}=local file).
-    Published files carry the reference's ``.gz`` suffix."""
+    as subprocesses (reference ``history/readme.md:5-29``:
+    ``get="curl -sf {0} -o {1}"``, ``put="aws s3 cp {1} {0}"``;
+    {0}=remote name, {1}=local file). The transport moves files
+    VERBATIM — compression is already part of the archive format
+    (``.xdr.gz`` category files), so a command archive interoperates
+    byte-for-byte with a directory archive published by FileArchive,
+    exactly as the reference's get/put commands do."""
 
     def __init__(self, get_template: str = "",
                  put_template: str = "",
+                 mkdir_template: str = "",
                  process_manager=None):
         import tempfile
         from stellar_tpu.process import ProcessManager
         self.get_template = get_template
         self.put_template = put_template
+        self.mkdir_template = mkdir_template
         self.pm = process_manager or ProcessManager()
         self.tmp = tempfile.mkdtemp(prefix="stpu-archive-")
+        self._made_dirs = set()
 
     def _local(self, rel: str) -> str:
-        path = os.path.join(self.tmp, rel.replace("/", "_")) + ".gz"
-        return path
+        return os.path.join(self.tmp, rel.replace("/", "_"))
 
     def put(self, rel: str, data: bytes):
         if not self.put_template:
             raise IOError("archive has no put command (read-only)")
-        import gzip
+        # remote directory creation (reference mkdir template)
+        rdir = os.path.dirname(rel)
+        if self.mkdir_template and rdir and rdir not in self._made_dirs:
+            if self.pm.run_sync(
+                    self.mkdir_template.replace("{0}", rdir)) == 0:
+                self._made_dirs.add(rdir)  # only cache success
         local = self._local(rel)
-        with gzip.open(local, "wb") as f:
+        with open(local, "wb") as f:
             f.write(data)
-        cmd = self.put_template.replace("{0}", rel + ".gz") \
+        cmd = self.put_template.replace("{0}", rel) \
                                .replace("{1}", local)
         rc = self.pm.run_sync(cmd)
         os.unlink(local)
@@ -138,15 +148,14 @@ class CommandArchive:
     def get(self, rel: str) -> Optional[bytes]:
         if not self.get_template:
             return None
-        import gzip
         local = self._local(rel)
-        cmd = self.get_template.replace("{0}", rel + ".gz") \
+        cmd = self.get_template.replace("{0}", rel) \
                                .replace("{1}", local)
         rc = self.pm.run_sync(cmd)
         if rc != 0 or not os.path.exists(local):
             return None
         try:
-            with gzip.open(local, "rb") as f:
+            with open(local, "rb") as f:
                 return f.read()
         finally:
             os.unlink(local)
@@ -158,7 +167,8 @@ def archive_from_config(spec) -> "FileArchive":
     archive (reference [HISTORY.x] TOML tables)."""
     if isinstance(spec, str):
         return FileArchive(spec)
-    return CommandArchive(spec.get("get", ""), spec.get("put", ""))
+    return CommandArchive(spec.get("get", ""), spec.get("put", ""),
+                          spec.get("mkdir", ""))
 
 
 class HistoryArchiveState:

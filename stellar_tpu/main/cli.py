@@ -68,7 +68,7 @@ def cmd_catchup(args) -> int:
     from stellar_tpu.catchup.catchup import (
         CatchupConfiguration, CatchupWork,
     )
-    from stellar_tpu.history.history_manager import FileArchive
+    from stellar_tpu.history.history_manager import archive_from_config
     from stellar_tpu.main.application import Application
     from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
     from stellar_tpu.work.work import State, WorkScheduler
@@ -88,8 +88,9 @@ def cmd_catchup(args) -> int:
                                     count=int(mode))
     else:
         conf = CatchupConfiguration(target, CatchupConfiguration.COMPLETE)
-    work = CatchupWork(app.lm, FileArchive(cfg.HISTORY_ARCHIVES[0]), conf,
-                       status_manager=app.status_manager)
+    work = CatchupWork(app.lm,
+                       archive_from_config(cfg.HISTORY_ARCHIVES[0]),
+                       conf, status_manager=app.status_manager)
     ws.schedule(work)
     ws.run_until_done(timeout=3600)
     print(json.dumps({"state": work.state,

@@ -46,6 +46,7 @@ class BasicWork:
         self.state = State.PENDING
         self.retries = 0
         self._scheduler: Optional["WorkScheduler"] = None
+        self._parent_work: Optional["Work"] = None
         self._retry_timer: Optional[VirtualTimer] = None
 
     # -- subclass hooks --
@@ -114,9 +115,21 @@ class BasicWork:
             if self.state == State.RETRYING:
                 self.state = State.PENDING
                 self.on_reset()
-                if self._scheduler is not None:
-                    self._scheduler._pump()
+                self._wake_ancestors()
         self._retry_timer.async_wait(fire)
+
+    def _wake_ancestors(self):
+        """Un-park WAITING ancestors and pump the owning scheduler —
+        a nested work's timer must be able to resume the whole tree."""
+        node = self
+        root = self
+        while node is not None:
+            if node.state == State.WAITING:
+                node.state = State.PENDING
+            root = node
+            node = getattr(node, "_parent_work", None)
+        if root._scheduler is not None:
+            root._scheduler._pump()
 
     def wake(self):
         """External event: WAITING -> RUNNING-eligible."""
@@ -140,6 +153,7 @@ class Work(BasicWork):
 
     def add_child(self, child: BasicWork) -> BasicWork:
         self.children.append(child)
+        child._parent_work = self
         return child
 
     def any_child_failed(self) -> bool:
@@ -156,6 +170,15 @@ class Work(BasicWork):
                 c.crank(self._clock)
             if self.any_child_failed():
                 return State.FAILURE
+            still = [c for c in pending if not c.is_done()]
+            if still and all(
+                    c.state in (State.RETRYING, State.WAITING)
+                    for c in still):
+                # nothing runnable until a child's timer/event fires;
+                # park so the scheduler's action queue can drain and
+                # (virtual) time can advance to fire that timer — the
+                # child's wake propagates back up through _parent_work
+                return State.WAITING
             return State.RUNNING
         if self.any_child_failed():
             return State.FAILURE
@@ -186,6 +209,8 @@ class WorkSequence(Work):
             c.crank(self._clock)
             if c.state in (State.FAILURE, State.ABORTED):
                 return State.FAILURE
+            if c.state in (State.RETRYING, State.WAITING):
+                return State.WAITING  # parked until the child wakes
             return State.RUNNING
         return self.do_work()
 
@@ -220,7 +245,16 @@ class BatchWork(Work):
             c.crank(self._clock)
         if self.any_child_failed():
             return State.FAILURE
-        if in_flight or self.has_next():
+        still = [c for c in in_flight if not c.is_done()]
+        if still or self.has_next():
+            if still and all(
+                    c.state in (State.RETRYING, State.WAITING)
+                    for c in still):
+                # every in-flight child is parked on a timer/event —
+                # even with more items queued, the parallelism cap is
+                # full of parked children, so park too; the first
+                # retry wake resumes and refills the window
+                return State.WAITING
             return State.RUNNING
         return State.SUCCESS
 
@@ -247,6 +281,7 @@ class ConditionalWork(BasicWork):
         super().__init__(name, RETRY_NEVER)
         self.condition = condition
         self.inner = inner
+        inner._parent_work = self
         self._clock = None
 
     def crank(self, clock):

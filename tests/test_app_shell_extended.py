@@ -34,21 +34,30 @@ def test_process_manager_async_and_timeout(tmp_path):
     assert results and results[0] != 0
 
 
-def test_command_archive_roundtrip_gzip(tmp_path):
-    """cp-template archive: the reference's test setup shape."""
-    from stellar_tpu.history.history_manager import CommandArchive
+def test_command_archive_roundtrip(tmp_path):
+    """cp-template archive: verbatim transport + mkdir template for
+    nested remote paths, interoperable with a FileArchive-published
+    layout (the reference's get/put/mkdir command semantics)."""
+    from stellar_tpu.history.history_manager import (
+        CommandArchive, FileArchive,
+    )
     store = tmp_path / "remote"
     store.mkdir()
     arch = CommandArchive(
         get_template=f"cp {store}/{{0}} {{1}}",
-        put_template=f"cp {{1}} {store}/{{0}}")
+        put_template=f"cp {{1}} {store}/{{0}}",
+        mkdir_template=f"mkdir -p {store}/{{0}}")
     arch.put("history_00000001.json", b"x" * 10_000)
-    # stored gzipped under the remote name
-    files = list(store.iterdir())
-    assert files and files[0].name.endswith(".gz")
-    assert files[0].stat().st_size < 10_000
+    # stored VERBATIM under the remote name (compression is part of
+    # the archive format, not the transport)
+    assert (store / "history_00000001.json").read_bytes() == b"x" * 10_000
     assert arch.get("history_00000001.json") == b"x" * 10_000
     assert arch.get("missing.json") is None
+    # nested paths work through the mkdir template, and a FileArchive
+    # pointed at the same directory reads them byte-for-byte
+    arch.put("bucket/ab/cd/ef/bucket-abcdef.xdr.gz", b"\x1f\x8bdata")
+    assert FileArchive(str(store)).get(
+        "bucket/ab/cd/ef/bucket-abcdef.xdr.gz") == b"\x1f\x8bdata"
 
 
 def test_archive_from_config_dispatch(tmp_path):

@@ -255,3 +255,129 @@ def test_recent_catchup_buckets_then_replay(tmp_path):
     from stellar_tpu.xdr.ledger import ledger_header_hash
     assert ledger_header_hash(lm2.last_closed_header) == \
         lm2.last_closed_hash
+
+
+def test_catchup_retries_flaky_archive(tmp_path):
+    """Each download is its own retrying work (reference historywork
+    DAG): an archive whose reads fail transiently still catches up —
+    one file's retry, not a whole-catchup restart."""
+    from stellar_tpu.catchup.catchup import (
+        CatchupConfiguration, CatchupWork,
+    )
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+
+    class FlakyArchive:
+        """Every distinct path fails on its first read, succeeds on
+        retry."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.seen = set()
+            self.failures = 0
+
+        def get(self, rel):
+            if rel not in self.seen:
+                self.seen.add(rel)
+                self.failures += 1
+                return None
+            return self.inner.get(rel)
+
+    flaky = FlakyArchive(archive)
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    work = CatchupWork(lm2, flaky,
+                       CatchupConfiguration(63,
+                                            CatchupConfiguration.COMPLETE))
+    ws.schedule(work)
+    ws.run_until_done(600)
+    assert work.state == State.SUCCESS
+    assert lm2.ledger_seq == 63
+    # the flaky transport really did fail and really was retried
+    assert flaky.failures >= 2
+    # replay matches the publisher's chain at the target
+    hdr = next(h for h in work.verified_headers
+               if h.header.ledgerSeq == 63)
+    assert lm2.last_closed_hash == hdr.hash
+
+
+def test_minimal_catchup_uses_bucket_download_work(tmp_path):
+    """MINIMAL catchup routes bucket fetches through the
+    DownloadBucketsWork fan-out (hash-verified per file)."""
+    from stellar_tpu.catchup.catchup import (
+        CatchupConfiguration, CatchupWork,
+    )
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    work = CatchupWork(lm2, archive,
+                       CatchupConfiguration(0,
+                                            CatchupConfiguration.MINIMAL))
+    ws.schedule(work)
+    ws.run_until_done(600)
+    assert work.state == State.SUCCESS
+    assert work._bucket_download is not None
+    assert len(work._bucket_download.buckets) > 0
+    # adopted state = the archive's checkpoint (63), self-verifying
+    # against the target header's bucketListHash
+    assert lm2.ledger_seq == 63
+    assert lm2.bucket_list.hash() == \
+        lm2.last_closed_header.bucketListHash
+
+
+def test_batch_work_parks_when_window_full_of_retries():
+    """All in-flight children RETRYING with more items queued must park
+    (not livelock): the first retry wake refills the window."""
+    from stellar_tpu.work.work import BatchWork, FunctionWork
+
+    attempts = {}
+
+    class FailOnce(FunctionWork):
+        def __init__(self, i):
+            super().__init__(f"fo-{i}", lambda: self._go(i),
+                             max_retries=3)
+
+        @staticmethod
+        def _go(i):
+            attempts[i] = attempts.get(i, 0) + 1
+            return State.SUCCESS if attempts[i] > 1 else State.FAILURE
+
+    class Batch(BatchWork):
+        def __init__(self):
+            super().__init__("b", max_parallel=2)
+            self.n = 0
+
+        def has_next(self):
+            return self.n < 5
+
+        def yield_more_work(self):
+            self.n += 1
+            return FailOnce(self.n)
+
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    b = Batch()
+    ws.schedule(b)
+    assert ws.run_until_done(600)
+    assert b.state == State.SUCCESS
+    assert all(attempts[i] == 2 for i in range(1, 6))
+
+
+def test_catchup_to_target_at_or_below_lcl_is_noop(tmp_path):
+    """Catching up to a ledger the node already has succeeds without
+    applying anything (old inline behavior, kept by the DAG)."""
+    from stellar_tpu.catchup.catchup import (
+        CatchupConfiguration, CatchupWork,
+    )
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    work = CatchupWork(lm, archive,
+                       CatchupConfiguration(63,
+                                            CatchupConfiguration.COMPLETE))
+    before = lm.ledger_seq
+    ws.schedule(work)
+    assert ws.run_until_done(600)
+    assert work.state == State.SUCCESS
+    assert lm.ledger_seq == before
