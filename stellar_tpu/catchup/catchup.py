@@ -187,6 +187,8 @@ class CatchupWork(WorkSequence):
         self.has: Optional[HistoryArchiveState] = None
         self.verified_headers = []
         self._download = None  # BatchDownloadWork, created by _plan
+        self._bucket_download = None
+        self._cp0_has_work = None  # RECENT: HAS at the adoption point
         from stellar_tpu.historywork import GetHistoryArchiveStateWork
         from stellar_tpu.work.work import FunctionWork
         self._has_work = GetHistoryArchiveStateWork(archive)
@@ -194,6 +196,17 @@ class CatchupWork(WorkSequence):
         # _plan appends the download fan-out + verify + apply children
         # once the HAS (and so the checkpoint range) is known
         self.add_child(FunctionWork("plan", self._plan))
+        # a whole-catchup retry must re-plan from scratch, not stack a
+        # second planned child set next to the stale one
+        self._base_children = list(self.children)
+
+    def on_reset(self):
+        self.children = list(self._base_children)
+        self._download = None
+        self._bucket_download = None
+        self._cp0_has_work = None
+        self.verified_headers = []
+        super().on_reset()
 
     def _status(self, message: str) -> None:
         """Operator status line (reference sets HISTORY_CATCHUP through
@@ -238,9 +251,43 @@ class CatchupWork(WorkSequence):
             self._bucket_download = DownloadBucketsWork(
                 self.archive, self.has.all_bucket_hashes())
             self.add_child(self._bucket_download)
-        else:
-            self._bucket_download = None
+        elif self.config.mode == CatchupConfiguration.RECENT:
+            cp0 = self._recent_adoption_checkpoint()
+            if cp0 is not None:
+                from stellar_tpu.historywork import (
+                    GetHistoryArchiveStateWork,
+                )
+                self._cp0_has_work = GetHistoryArchiveStateWork(
+                    self.archive, cp0)
+                self.add_child(self._cp0_has_work)
+                # bucket list known only once that HAS is in: second
+                # planning step appends the bucket fan-out
+                self.add_child(FunctionWork("plan-recent-buckets",
+                                            self._plan_recent_buckets))
         self.add_child(FunctionWork("apply", self._apply))
+        return State.SUCCESS
+
+    def _recent_adoption_checkpoint(self) -> Optional[int]:
+        """RECENT: the checkpoint whose state gets adopted so at least
+        ``count`` ledgers are replayed after it; None = replay only."""
+        target = self._target()
+        first_replayed = max(1, target - max(0, self.config.count))
+        cp0 = checkpoint_containing(first_replayed) - \
+            CHECKPOINT_FREQUENCY
+        if cp0 >= 63 and cp0 > self.lm.ledger_seq:
+            return cp0
+        return None
+
+    def _plan_recent_buckets(self):
+        from stellar_tpu.historywork import DownloadBucketsWork
+        from stellar_tpu.work.work import FunctionWork  # noqa: F401
+        has0 = self._cp0_has_work.has
+        self._bucket_download = DownloadBucketsWork(
+            self.archive, has0.all_bucket_hashes())
+        # runs before 'apply' (inserted ahead of it in sequence order)
+        idx = len(self.children) - 1  # 'apply' is last
+        self.children.insert(idx, self._bucket_download)
+        self._bucket_download._parent_work = self
         return State.SUCCESS
 
     def _collect_headers(self):
@@ -276,26 +323,25 @@ class CatchupWork(WorkSequence):
                                           self.has):
                 return State.FAILURE
             return State.SUCCESS
-        if self.config.mode == CatchupConfiguration.RECENT:
-            # buckets to (target - count), then replay the recent window
+        if self.config.mode == CatchupConfiguration.RECENT and \
+                self._cp0_has_work is not None:
+            # buckets to (target - count) were fetched by the planned
+            # DownloadBucketsWork; adopt, then replay the recent window
             # (reference CATCHUP_RECENT: verifiable recent history
             # without full replay)
-            first_replayed = max(1, target - max(0, self.config.count))
-            # adopt at the checkpoint ENDING before the replay window so
-            # at least `count` ledgers are replayed
-            cp0 = checkpoint_containing(first_replayed) - \
-                CHECKPOINT_FREQUENCY
-            if cp0 >= 63 and cp0 > self.lm.ledger_seq:
-                has0 = HistoryManager.get_has(self.archive, cp0)
-                if has0 is None or not self._adopt_buckets_at(cp0, has0):
-                    return State.FAILURE
+            has0 = self._cp0_has_work.has
+            cp0 = self._cp0_has_work.checkpoint
+            if has0 is None or not self._adopt_buckets_at(cp0, has0):
+                return State.FAILURE
         cp = checkpoint_containing(self.lm.ledger_seq + 1)
         while self.lm.ledger_seq < target:
             self._status(f"Catching up: applying checkpoint {cp} "
                          f"({self.lm.ledger_seq}/{target})")
+            # pop: a long COMPLETE catchup must not hold every
+            # checkpoint's tx data in memory at once
             replay_checkpoint(
                 self.lm, self.archive, cp, up_to=target,
-                preloaded=self._download.downloaded.get(cp))
+                preloaded=self._download.downloaded.pop(cp, None))
             cp += CHECKPOINT_FREQUENCY
         return State.SUCCESS
 

@@ -533,6 +533,45 @@ def cmd_encode_asset(args) -> int:
     return 0
 
 
+def cmd_dump_xdr(args) -> int:
+    """Pretty-print a file of FRAMED XDR records (history category
+    files, bucket files, meta streams) — the streaming counterpart of
+    ``print-xdr`` (reference ``dump-xdr`` / dumpxdr.cpp). Gzip is
+    detected from the magic bytes."""
+    import gzip
+    from stellar_tpu.history.history_manager import _unrecords
+    from stellar_tpu.xdr.runtime import from_bytes
+    types = _stream_types()
+    t = types.get(args.filetype)
+    if t is None:
+        print(f"unknown type {args.filetype}; one of {sorted(types)}",
+              file=sys.stderr)
+        return 1
+    with open(args.file, "rb") as f:
+        raw = f.read()
+    if raw[:2] == b"\x1f\x8b":
+        raw = gzip.decompress(raw)
+    records = _unrecords(raw)[:args.limit]
+    for rec in records:
+        print(repr(from_bytes(t, rec)))
+    print(json.dumps({"records": len(records)}), file=sys.stderr)
+    return 0
+
+
+def _stream_types():
+    from stellar_tpu.xdr import ledger as xl, tx as xt
+    from stellar_tpu.xdr.types import LedgerEntry
+    return {
+        "LedgerHeaderHistoryEntry": xl.LedgerHeaderHistoryEntry,
+        "TransactionHistoryEntry": xl.TransactionHistoryEntry,
+        "TransactionHistoryResultEntry": xl.TransactionHistoryResultEntry,
+        "BucketEntry": xl.BucketEntry,
+        "LedgerCloseMeta": xl.LedgerCloseMeta,
+        "LedgerEntry": LedgerEntry,
+        "TransactionEnvelope": xt.TransactionEnvelope,
+    }
+
+
 def cmd_replay_debug_meta(args) -> int:
     """Verify a framed LedgerCloseMeta stream file: per-ledger decode,
     seq continuity, and header hash-chain (reference
@@ -658,6 +697,11 @@ def register(sub) -> None:
     sp.add_argument("--code", default="")
     sp.add_argument("--issuer", default="")
     sp.set_defaults(fn=cmd_encode_asset)
+    sp = sub.add_parser("dump-xdr")
+    sp.add_argument("file", help="framed XDR record stream (.xdr/.gz)")
+    sp.add_argument("--filetype", default="LedgerHeaderHistoryEntry")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_dump_xdr)
     sp = sub.add_parser("replay-debug-meta")
     sp.add_argument("file", help="framed LedgerCloseMeta stream file")
     sp.set_defaults(fn=cmd_replay_debug_meta)

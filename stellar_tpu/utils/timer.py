@@ -98,7 +98,10 @@ class VirtualClock:
                     self._virtual_now = (min(nxt, deadline)
                                          if nxt is not None else deadline)
                 else:
-                    _time.sleep(min(0.001, deadline - self.now()))
+                    # a slow crank can overrun the deadline between the
+                    # loop check and here; never sleep a negative span
+                    _time.sleep(max(0.0, min(0.001,
+                                             deadline - self.now())))
 
     # ---- event scheduling ----
 

@@ -132,9 +132,12 @@ class BasicWork:
             root._scheduler._pump()
 
     def wake(self):
-        """External event: WAITING -> RUNNING-eligible."""
+        """External event: WAITING -> RUNNING-eligible. Propagates up
+        so a nested parked tree (parents WAITING on this child)
+        resumes too."""
         if self.state == State.WAITING:
             self.state = State.PENDING
+            self._wake_ancestors()
 
     def abort(self):
         if not self.is_done():

@@ -398,3 +398,24 @@ QUALITY = "LOW"
                    'FAILURE_SAFETY = 3\n' + base)
     with pytest.raises(ValueError, match="FAILURE_SAFETY"):
         Config.from_toml(str(bad))
+
+
+def test_dump_xdr_stream(persisted_node, tmp_path, capsys):
+    """dump-xdr pretty-prints framed XDR record streams, gzip-aware
+    (reference dump-xdr)."""
+    conf, _, _ = persisted_node
+    # publish so a real gzipped history category file exists
+    assert cli_offline.cmd_publish(_args(conf)) == 0
+    capsys.readouterr()
+    import glob
+    files = glob.glob(str(tmp_path / "archive" / "ledger" / "**" /
+                          "ledger-*.xdr.gz"), recursive=True)
+    assert files
+    args = types.SimpleNamespace(
+        file=files[0], filetype="LedgerHeaderHistoryEntry", limit=3)
+    assert cli_offline.cmd_dump_xdr(args) == 0
+    out = capsys.readouterr().out
+    assert out.count("LedgerHeaderHistoryEntry(") == 3
+    # unknown type is a clean error
+    args = types.SimpleNamespace(file=files[0], filetype="Nope", limit=1)
+    assert cli_offline.cmd_dump_xdr(args) == 1

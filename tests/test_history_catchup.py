@@ -381,3 +381,39 @@ def test_catchup_to_target_at_or_below_lcl_is_noop(tmp_path):
     assert ws.run_until_done(600)
     assert work.state == State.SUCCESS
     assert lm.ledger_seq == before
+
+
+def test_catchup_replans_after_whole_retry(tmp_path):
+    """When the archive is dead long enough to exhaust per-file
+    retries, the whole CatchupWork retries — and re-plans from scratch
+    instead of stacking duplicate download/verify/apply children."""
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+
+    class DeadThenAlive:
+        def __init__(self, inner, dead_calls):
+            self.inner = inner
+            self.remaining = dead_calls
+
+        def get(self, rel):
+            if self.remaining > 0:
+                self.remaining -= 1
+                return None
+            return self.inner.get(rel)
+
+    # enough failures to exhaust one child's retries (RETRY_A_FEW=5)
+    flaky = DeadThenAlive(archive, dead_calls=7)
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    work = CatchupWork(lm2, flaky,
+                       CatchupConfiguration(63,
+                                            CatchupConfiguration.COMPLETE))
+    ws.schedule(work)
+    assert ws.run_until_done(3600)
+    assert work.state == State.SUCCESS
+    assert lm2.ledger_seq == 63
+    # re-planning replaced, not duplicated, the planned children
+    names = [c.name for c in work.children]
+    assert names.count("apply") == 1
+    assert sum(1 for n in names if n.startswith("batch-download")) == 1
