@@ -280,14 +280,11 @@ class CatchupWork(WorkSequence):
 
     def _plan_recent_buckets(self):
         from stellar_tpu.historywork import DownloadBucketsWork
-        from stellar_tpu.work.work import FunctionWork  # noqa: F401
         has0 = self._cp0_has_work.has
         self._bucket_download = DownloadBucketsWork(
             self.archive, has0.all_bucket_hashes())
         # runs before 'apply' (inserted ahead of it in sequence order)
-        idx = len(self.children) - 1  # 'apply' is last
-        self.children.insert(idx, self._bucket_download)
-        self._bucket_download._parent_work = self
+        self.insert_child(len(self.children) - 1, self._bucket_download)
         return State.SUCCESS
 
     def _collect_headers(self):

@@ -159,6 +159,11 @@ class Work(BasicWork):
         child._parent_work = self
         return child
 
+    def insert_child(self, index: int, child: BasicWork) -> BasicWork:
+        self.children.insert(index, child)
+        child._parent_work = self
+        return child
+
     def any_child_failed(self) -> bool:
         return any(c.state in (State.FAILURE, State.ABORTED)
                    for c in self.children)

@@ -160,3 +160,55 @@ def test_rfc8032_vectors(verifier):
              for pk, m, sig in vecs]
     got = check_differential(verifier, items)
     assert got.all()
+
+
+def test_adversarial_structured_fuzz(verifier):
+    """Seeded adversarial differential fuzz: device accept/reject must
+    match the libsodium-exact host oracle on structured edge inputs —
+    the consensus-safety requirement (SURVEY hard part #1)."""
+    import random
+    rng = random.Random(0x5EED)
+    items = []
+    L = ref.L
+    P = ref.P
+    for i in range(64):
+        pk, msg, sig = make_sig(msg=bytes([i]) * (1 + i % 40))
+        r, s = bytearray(sig[:32]), bytearray(sig[32:])
+        mode = i % 8
+        if mode == 0:
+            items.append((pk, msg, bytes(sig)))  # control: valid
+            continue
+        if mode == 1:
+            # s exactly L (first non-canonical scalar)
+            s = bytearray(L.to_bytes(32, "little"))
+        elif mode == 2:
+            # s = valid + L (same value mod L, non-canonical form)
+            v = int.from_bytes(bytes(s), "little") + L
+            if v < (1 << 256):
+                s = bytearray(v.to_bytes(32, "little"))
+        elif mode == 3:
+            # set the high bit of R's y (non-canonical-ish encodings)
+            r[31] |= 0x80
+        elif mode == 4:
+            # A with y >= p (non-canonical pubkey)
+            y = (P + rng.randrange(1, 19))
+            pk = bytearray(y.to_bytes(32, "little"))
+            pk[31] |= rng.choice([0, 0x80])
+            pk = bytes(pk)
+        elif mode == 5:
+            # random byte flip anywhere in (pk, r, s)
+            which = rng.randrange(3)
+            buf = [bytearray(pk), r, s][which]
+            buf[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            if which == 0:
+                pk = bytes(buf)
+        elif mode == 6:
+            # swap R and s halves (structurally plausible garbage)
+            r, s = s, r
+        else:
+            # message tampered after signing
+            msg = msg[:-1] + bytes([msg[-1] ^ 1])
+        items.append((bytes(pk), msg, bytes(r) + bytes(s)))
+    got = check_differential(verifier, items)
+    # sanity: the fuzz actually produced both outcomes
+    assert got.any() and not got.all()

@@ -390,18 +390,24 @@ def test_catchup_replans_after_whole_retry(tmp_path):
     lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
 
     class DeadThenAlive:
+        """Only the POST-PLAN downloads (category files) fail, so the
+        whole-catchup retry happens with planned children in place —
+        the exact scenario the re-plan fix covers."""
+
         def __init__(self, inner, dead_calls):
             self.inner = inner
             self.remaining = dead_calls
 
         def get(self, rel):
-            if self.remaining > 0:
+            if rel.startswith("ledger/") and self.remaining > 0:
                 self.remaining -= 1
                 return None
             return self.inner.get(rel)
 
-    # enough failures to exhaust one child's retries (RETRY_A_FEW=5)
-    flaky = DeadThenAlive(archive, dead_calls=7)
+    # exceed the nested retry capacity (6 attempts per download child
+    # x 6 attempts of the batch itself = 36) so the WHOLE CatchupWork
+    # retries with planned children in place
+    flaky = DeadThenAlive(archive, dead_calls=40)
     a, b = keypair("alice"), keypair("bob")
     root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
     lm2 = LedgerManager(TEST_NETWORK_ID, root2)
