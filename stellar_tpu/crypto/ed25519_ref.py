@@ -237,20 +237,46 @@ def verify_detailed(pk: bytes, msg: bytes, sig: bytes) -> dict:
     out["a_decompressed"] = a is not None
     if a is None:
         return out
-    s = int.from_bytes(s_bytes, "little")
-    h = sha512_mod_l(r_bytes, pk, msg)
-    # R' = s*B - h*A  (libsodium: double_scalarmult(h, -A, s))
-    neg_a = (P - a[0], a[1], a[2], (P - a[3]) % P)
-    rprime = point_add(point_mul(s % L, BASE), point_mul(h, neg_a))
-    out["r_match"] = point_compress(rprime) == r_bytes
+    out["r_match"] = _verify_equation_python(pk, msg, sig, a)
     out["ok"] = (out["s_canonical"] and out["r_not_small"]
                  and out["a_canonical"] and out["a_not_small"]
                  and out["a_decompressed"] and out["r_match"])
     return out
 
 
-def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
-    """libsodium-exact crypto_sign_verify_detached."""
+# Fast curve core: OpenSSL (the `cryptography` package) implements the
+# same ref10-derived cofactorless equation check as libsodium; behind
+# OUR policy gate (canonical s, small-order/canonical A and R — the
+# checks libsodium performs that OpenSSL does not) its accept/reject
+# matches the pure-Python oracle bit-for-bit. Differential + structured
+# adversarial tests (tests/test_ed25519_ref.py,
+# tests/test_batch_verifier.py) pin this equivalence; any load failure
+# falls back to the pure-Python equation, never to a different answer.
+try:
+    from cryptography.exceptions import InvalidSignature as _OsslBadSig
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _OsslSK, Ed25519PublicKey as _OsslPK,
+    )
+    _HAVE_OSSL = True
+except Exception:  # pragma: no cover - cryptography is baked in
+    _HAVE_OSSL = False
+
+
+def _verify_equation_python(pk: bytes, msg: bytes, sig: bytes,
+                            a) -> bool:
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    s = int.from_bytes(s_bytes, "little")
+    h = sha512_mod_l(r_bytes, pk, msg)
+    neg_a = (P - a[0], a[1], a[2], (P - a[3]) % P)
+    rprime = point_add(point_mul(s % L, BASE), point_mul(h, neg_a))
+    return point_compress(rprime) == r_bytes
+
+
+def _policy_gate(pk: bytes, sig: bytes) -> bool:
+    """The byte-level rejections libsodium performs that the bare
+    curve-equation check does not: lengths, canonical s, small-order
+    R/A, canonical A. The single source of truth for BOTH verify
+    paths — edit here or nowhere."""
     if len(pk) != 32 or len(sig) != 64:
         return False
     r_bytes, s_bytes = sig[:32], sig[32:]
@@ -258,16 +284,40 @@ def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
         return False
     if has_small_order(r_bytes) or has_small_order(pk):
         return False
-    if not is_canonical_point(pk):
+    return is_canonical_point(pk)
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """libsodium-exact ``crypto_sign_verify_detached``."""
+    if not _policy_gate(pk, sig):
+        return False
+    if _HAVE_OSSL:
+        try:
+            # OpenSSL's ref10 frombytes performs the same decompression
+            # rejection as point_decompress, so no eager decompress here
+            _OsslPK.from_public_bytes(pk).verify(sig, msg)
+            return True
+        except _OsslBadSig:
+            return False
+        except Exception:
+            # OpenSSL wouldn't load a key our policy accepted: fall
+            # back to the oracle equation rather than guess
+            pass
+    a = point_decompress(pk)
+    if a is None:
+        return False
+    return _verify_equation_python(pk, msg, sig, a)
+
+
+def verify_python(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """The pure-Python oracle path (policy + equation), independent of
+    OpenSSL — the differential-testing ground truth."""
+    if not _policy_gate(pk, sig):
         return False
     a = point_decompress(pk)
     if a is None:
         return False
-    s = int.from_bytes(s_bytes, "little")
-    h = sha512_mod_l(r_bytes, pk, msg)
-    neg_a = (P - a[0], a[1], a[2], (P - a[3]) % P)
-    rprime = point_add(point_mul(s % L, BASE), point_mul(h, neg_a))
-    return point_compress(rprime) == r_bytes
+    return _verify_equation_python(pk, msg, sig, a)
 
 
 def _clamp(k: bytes) -> int:
@@ -279,6 +329,13 @@ def _clamp(k: bytes) -> int:
 
 
 def secret_to_public(seed: bytes) -> bytes:
+    if len(seed) != 32:  # same contract on both paths
+        raise ValueError("ed25519 seed must be 32 bytes")
+    if _HAVE_OSSL:
+        from cryptography.hazmat.primitives import serialization
+        return _OsslSK.from_private_bytes(seed).public_key() \
+            .public_bytes(serialization.Encoding.Raw,
+                          serialization.PublicFormat.Raw)
     h = hashlib.sha512(seed).digest()
     a = _clamp(h[:32])
     return point_compress(point_mul(a, BASE))
@@ -289,7 +346,17 @@ def scalarmult_base(s: int) -> bytes:
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
-    """RFC 8032 ed25519 signing from a 32-byte seed."""
+    """RFC 8032 ed25519 signing from a 32-byte seed. Deterministic, so
+    the OpenSSL fast path produces byte-identical signatures to the
+    pure-Python construction (pinned by test_differential_vs_openssl)."""
+    if len(seed) != 32:  # same contract on both paths
+        raise ValueError("ed25519 seed must be 32 bytes")
+    if _HAVE_OSSL:
+        return _OsslSK.from_private_bytes(seed).sign(msg)
+    return sign_python(seed, msg)
+
+
+def sign_python(seed: bytes, msg: bytes) -> bytes:
     h = hashlib.sha512(seed).digest()
     a = _clamp(h[:32])
     prefix = h[32:]

@@ -45,19 +45,24 @@ def test_rfc8032_vectors(seed, pk, msg, sig):
 
 
 def test_differential_vs_openssl():
-    """Our sign/verify must agree with OpenSSL on honest signatures."""
+    """The PURE-PYTHON sign/verify must agree with OpenSSL on honest
+    signatures (ref.sign/verify may themselves delegate to OpenSSL, so
+    this must exercise the *_python paths to be a real differential)."""
     crypto = pytest.importorskip("cryptography.hazmat.primitives.asymmetric.ed25519")
+    import hashlib
     for i in range(20):
         seed = bytes([i]) * 31 + bytes([7])
         sk = crypto.Ed25519PrivateKey.from_private_bytes(seed)
         from cryptography.hazmat.primitives import serialization
         pk = sk.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw)
-        assert ref.secret_to_public(seed) == pk
+        # pure-Python public-key derivation
+        a = ref._clamp(hashlib.sha512(seed).digest()[:32])
+        assert ref.point_compress(ref.point_mul(a, ref.BASE)) == pk
         msg = os.urandom(i * 3)
         sig = sk.sign(msg)
-        assert ref.sign(seed, msg) == sig
-        assert ref.verify(pk, msg, sig)
+        assert ref.sign_python(seed, msg) == sig
+        assert ref.verify_python(pk, msg, sig)
 
 
 def test_reject_bitflips():
@@ -127,3 +132,68 @@ def test_scalar_edge_cases():
     assert ref.is_canonical_scalar(b"\x00" * 32)
     assert ref.is_canonical_scalar((ref.L - 1).to_bytes(32, "little"))
     assert not ref.is_canonical_scalar(ref.L.to_bytes(32, "little"))
+
+
+def test_fast_path_matches_python_oracle_adversarial():
+    """The OpenSSL-backed verify must agree with the pure-Python
+    oracle on every structured adversarial input — it is allowed to be
+    faster, never different (consensus safety)."""
+    import random
+    rng = random.Random(0xFA57)
+    L, P = ref.L, ref.P
+    cases = []
+    for i in range(120):
+        seed = bytes([i % 251 + 1]) * 32
+        msg = bytes([i]) * (1 + i % 37)
+        pk = ref.secret_to_public(seed)
+        sig = ref.sign(seed, msg)
+        r, s = bytearray(sig[:32]), bytearray(sig[32:])
+        mode = i % 10
+        if mode == 1:
+            s = bytearray(L.to_bytes(32, "little"))
+        elif mode == 2:
+            v = int.from_bytes(bytes(s), "little") + L
+            if v < (1 << 256):
+                s = bytearray(v.to_bytes(32, "little"))
+        elif mode == 3:
+            r[31] |= 0x80
+        elif mode == 4:
+            y = P + rng.randrange(1, 19)
+            pk = bytearray(y.to_bytes(32, "little"))
+            pk[31] |= rng.choice([0, 0x80])
+            pk = bytes(pk)
+        elif mode == 5:
+            which = rng.randrange(3)
+            buf = [bytearray(pk), r, s][which]
+            buf[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            if which == 0:
+                pk = bytes(buf)
+        elif mode == 6:
+            r, s = s, r
+        elif mode == 7:
+            msg = msg[:-1] + bytes([msg[-1] ^ 1])
+        elif mode == 8:
+            so = sorted(ref.SMALL_ORDER_ENCODINGS)
+            pk = so[rng.randrange(len(so))]
+        elif mode == 9:
+            so = sorted(ref.SMALL_ORDER_ENCODINGS)
+            r = bytearray(so[rng.randrange(len(so))])
+        cases.append((bytes(pk), msg, bytes(r) + bytes(s)))
+    accepts = 0
+    for pk, msg, sig in cases:
+        fast = ref.verify(pk, msg, sig)
+        slow = ref.verify_python(pk, msg, sig)
+        assert fast == slow, (pk.hex(), sig.hex())
+        accepts += fast
+    assert 0 < accepts < len(cases)  # both outcomes exercised
+
+
+def test_fast_sign_matches_python_sign():
+    for i in range(10):
+        seed = bytes([i + 1]) * 32
+        msg = bytes([i]) * i
+        assert ref.sign(seed, msg) == ref.sign_python(seed, msg)
+        assert ref.secret_to_public(seed) == \
+            ref.point_compress(ref.point_mul(
+                ref._clamp(__import__("hashlib").sha512(seed)
+                           .digest()[:32]), ref.BASE))
