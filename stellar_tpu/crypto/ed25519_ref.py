@@ -336,6 +336,11 @@ def secret_to_public(seed: bytes) -> bytes:
         return _OsslSK.from_private_bytes(seed).public_key() \
             .public_bytes(serialization.Encoding.Raw,
                           serialization.PublicFormat.Raw)
+    return secret_to_public_python(seed)
+
+
+def secret_to_public_python(seed: bytes) -> bytes:
+    """Pure-Python derivation (differential ground truth)."""
     h = hashlib.sha512(seed).digest()
     a = _clamp(h[:32])
     return point_compress(point_mul(a, BASE))

@@ -101,10 +101,32 @@ class OverlayManager:
                 p.drop("idle timeout")
                 continue
             # ping: refreshes the remote's read-liveness view of us and
-            # elicits a response that refreshes ours of it
-            ping_id = sha256(b"ping" + str(now).encode())
-            p.send(StellarMessage.make(
-                MessageType.GET_SCP_QUORUMSET, ping_id))
+            # elicits a response that refreshes ours of it; latency is
+            # measured from the matching DONT_HAVE (reference pingPeer
+            # + maybeProcessPingResponse)
+            sent_at = getattr(p, "_ping_sent_at", None)
+            # re-arm a swallowed ping after two ticks so latency
+            # sampling and the keepalive never freeze on one lost
+            # response
+            if sent_at is None or now - sent_at > 10:
+                ping_id = sha256(b"ping" + str(now).encode())
+                p._ping_id = ping_id
+                p._ping_sent_at = now
+                p.send(StellarMessage.make(
+                    MessageType.GET_SCP_QUORUMSET, ping_id))
+
+    def maybe_process_ping_response(self, peer, req_hash: bytes) -> bool:
+        """DONT_HAVE for our outstanding ping id: record latency
+        (reference ``Peer::maybeProcessPingResponse``)."""
+        if getattr(peer, "_ping_id", None) != req_hash:
+            return False
+        from stellar_tpu.utils.metrics import registry
+        dt_ms = (self.app.clock.now() - peer._ping_sent_at) * 1000.0
+        peer.last_ping_ms = dt_ms
+        peer._ping_id = None
+        peer._ping_sent_at = None
+        registry.timer("overlay.connection.latency").update_ms(dt_ms)
+        return True
 
     # ---------------- herder wiring ----------------
 
@@ -280,6 +302,8 @@ class OverlayManager:
                     MessageType.DONT_HAVE,
                     DontHave(type=MessageType.SCP_QUORUMSET,
                              reqHash=msg.value)))
+        elif t == MessageType.DONT_HAVE:
+            self.maybe_process_ping_response(peer, msg.value.reqHash)
         elif t == MessageType.SCP_QUORUMSET:
             herder.register_qset(msg.value)
         elif t == MessageType.GET_SCP_STATE:

@@ -215,3 +215,24 @@ def test_peer_liveness_timeouts():
     assert sim.crank_until(lambda: idle.dropped is not None, 60)
     assert "idle timeout" in idle.dropped
     assert real in a.overlay.peers  # live peer untouched
+
+
+def test_ping_latency_recorded():
+    """The liveness pings elicit DONT_HAVE responses and the measured
+    round-trip lands in the connection-latency metric (reference
+    pingPeer / maybeProcessPingResponse)."""
+    from stellar_tpu.simulation.simulation import Topologies
+    from stellar_tpu.utils.metrics import registry
+    registry.clear()
+    sim = Topologies.pair()
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() == 1 for a in apps),
+        30)
+    # crank past a few 5s ticks so pings flow both ways
+    assert sim.crank_until(
+        lambda: registry.to_dict().get(
+            "overlay.connection.latency", {}).get("count", 0) >= 2, 60)
+    peer = apps[0].overlay.peers[0]
+    assert getattr(peer, "last_ping_ms", None) is not None
