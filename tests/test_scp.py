@@ -382,3 +382,93 @@ def test_five_node_convergence_competing_values():
     net.run()
     values = {scp.externalized_value(1) for scp in net.nodes.values()}
     assert len(values) == 1 and None not in values
+
+
+# ---------------- ballot protocol: reference SCPTests scenarios ------
+
+
+def test_prepared_switches_to_higher_value():
+    """Peers prepare an incompatible higher ballot: prepared switches
+    to it and the old one is retained as preparedPrime (reference
+    'prepare B then A' switching cases)."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    bp = scp.get_slot(1).ballot
+    # quorum accepts prepared on our value first
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, 1, b(1, b"x"), prepared=b(1, b"x")))
+    assert bp.prepared is not None and bp.prepared.value == b"x"
+    # then a quorum accepts prepared on an incompatible HIGHER ballot
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, 1, b(2, b"z"), prepared=b(2, b"z")))
+    assert bp.prepared.counter == 2 and bp.prepared.value == b"z"
+    # the older incompatible prepared survives as p'
+    assert bp.prepared_prime is not None
+    assert bp.prepared_prime.value == b"x"
+    from stellar_tpu.scp.ballot import compare_ballots
+    assert compare_ballots(bp.prepared_prime, bp.prepared) < 0
+
+
+def test_timeout_bumps_ballot_counter():
+    """The armed ballot timer fires -> counter bumps (abandon ballot),
+    staying in PREPARE with a fresh round (reference timer bump)."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    bp = scp.get_slot(1).ballot
+    assert bp.current.counter == 1
+    # a quorum at counter 1 arms the ballot timer
+    for v in (V1, V2, V3):
+        scp.receive_envelope(prepare_env(v, qh, 1, b(1, b"x")))
+    timer = driver.timers.get((1, 1))  # (slot, TIMER_BALLOT)
+    assert timer is not None, list(driver.timers)
+    _, callback = timer
+    callback()
+    assert bp.current.counter == 2
+    # the bump re-emitted a PREPARE at the new counter
+    last = driver.emitted[-1].statement.pledges
+    assert last.arm == ST.SCP_ST_PREPARE
+    assert last.value.ballot.counter == 2
+
+
+def test_confirm_commit_range_externalizes_high():
+    """CONFIRM statements carrying a commit range externalize at the
+    committed value with the range's bounds honored."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, 1, b(1, b"x"), prepared=b(1, b"x")))
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            prepare_env(v, qh, 1, b(1, b"x"), prepared=b(1, b"x"),
+                        nC=1, nH=1))
+    # peers confirm commit over [1, 3]
+    for v in (V1, V2, V3):
+        scp.receive_envelope(
+            confirm_env(v, qh, 1, b(3, b"x"), 3, 1, 3))
+    bp = scp.get_slot(1).ballot
+    assert bp.phase == PH_EXTERNALIZE
+    assert driver.externalized[1] == b"x"
+    last = driver.emitted[-1].statement.pledges
+    assert last.arm == ST.SCP_ST_EXTERNALIZE
+    assert last.value.commit.counter >= 1
+    assert last.value.nH >= 1
+
+
+def test_higher_counter_statement_supersedes():
+    """A node's newer (higher-counter) statement replaces its older one
+    in the tally; replaying the older is ignored (reference
+    'statements only move forward')."""
+    scp, driver, q = make_scp()
+    qh = quorum_set_hash(q)
+    scp.get_slot(1).bump_state(b"x", True)
+    st_new = prepare_env(V1, qh, 1, b(5, b"x"))
+    st_old = prepare_env(V1, qh, 1, b(2, b"x"))
+    from stellar_tpu.scp import EnvelopeState
+    assert scp.receive_envelope(st_new) == EnvelopeState.VALID
+    assert scp.receive_envelope(st_old) == EnvelopeState.INVALID
