@@ -306,7 +306,47 @@ class Struct(metaclass=_StructMeta):
                             f"{type(self).__name__}")
 
     @classmethod
+    def _compile_codecs(cls):
+        """Generate straight-line pack/unpack for this struct (the
+        namedtuple trick): no per-field loop, zip, or getattr. Error
+        context is recovered by re-running the slow field loop on
+        failure, so messages stay field-precise."""
+        ns = {"_types": cls._types, "_cls": cls}
+        pack_body = "\n".join(
+            f"    _types[{i}].pack(p, v.{n})"
+            for i, n in enumerate(cls._names)) or "    pass"
+        unpack_body = "\n".join(
+            f"    out.{n} = _types[{i}].unpack(u)"
+            for i, n in enumerate(cls._names)) or "    pass"
+        src = (f"def _fast_pack(p, v):\n{pack_body}\n"
+               f"def _fast_unpack(u):\n"
+               f"    out = _cls.__new__(_cls)\n{unpack_body}\n"
+               f"    return out\n")
+        exec(src, ns)  # noqa: S102 - trusted, generated from FIELDS
+        # plain functions (not staticmethod wrappers): every lookup goes
+        # through cls.__dict__, bypassing the descriptor protocol
+        cls._fast_pack = ns["_fast_pack"]
+        cls._fast_unpack = ns["_fast_unpack"]
+
+    @classmethod
     def pack(cls, p: Packer, v: "Struct"):
+        fast = cls.__dict__.get("_fast_pack")
+        if fast is None:
+            cls._compile_codecs()
+            fast = cls.__dict__["_fast_pack"]
+        mark = len(p.buf)
+        try:
+            fast(p, v)
+        except XdrError:
+            raise
+        except Exception as e:
+            # rewind the partial fast attempt, re-run the field loop
+            # for a field-precise error, and keep the original chained
+            del p.buf[mark:]
+            cls._pack_slow(p, v, e)
+
+    @classmethod
+    def _pack_slow(cls, p: Packer, v: "Struct", cause: Exception):
         for n, t in zip(cls._names, cls._types):
             try:
                 t.pack(p, getattr(v, n))
@@ -315,13 +355,16 @@ class Struct(metaclass=_StructMeta):
             except Exception as e:
                 raise XdrError(
                     f"{cls.__name__}.{n}: {e}") from e
+        raise XdrError(f"{cls.__name__}: fast pack failed but the "
+                       "field loop succeeded (flaky field?)") from cause
 
     @classmethod
     def unpack(cls, u: Unpacker) -> "Struct":
-        out = cls.__new__(cls)
-        for n, t in zip(cls._names, cls._types):
-            setattr(out, n, t.unpack(u))
-        return out
+        fast = cls.__dict__.get("_fast_unpack")
+        if fast is None:
+            cls._compile_codecs()
+            fast = cls.__dict__["_fast_unpack"]
+        return fast(u)
 
     def __eq__(self, other):
         return (type(self) is type(other)

@@ -423,7 +423,8 @@ def test_timeout_bumps_ballot_counter():
     # a quorum at counter 1 arms the ballot timer
     for v in (V1, V2, V3):
         scp.receive_envelope(prepare_env(v, qh, 1, b(1, b"x")))
-    timer = driver.timers.get((1, 1))  # (slot, TIMER_BALLOT)
+    from stellar_tpu.scp.slot import BALLOT_PROTOCOL_TIMER
+    timer = driver.timers.get((1, BALLOT_PROTOCOL_TIMER))
     assert timer is not None, list(driver.timers)
     _, callback = timer
     callback()
@@ -456,8 +457,11 @@ def test_confirm_commit_range_externalizes_high():
     assert driver.externalized[1] == b"x"
     last = driver.emitted[-1].statement.pledges
     assert last.arm == ST.SCP_ST_EXTERNALIZE
-    assert last.value.commit.counter >= 1
-    assert last.value.nH >= 1
+    # exact bounds: commit starts at the accepted c=1; h follows the
+    # confirmed range top (3)
+    assert last.value.commit.counter == 1
+    assert last.value.commit.value == b"x"
+    assert last.value.nH == 3
 
 
 def test_higher_counter_statement_supersedes():
