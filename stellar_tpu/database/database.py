@@ -199,6 +199,11 @@ class Database:
 
     # ---------------- scp history ----------------
 
+    def load_scp_history(self, seq: int) -> List[bytes]:
+        return [r[0] for r in self.conn.execute(
+            "SELECT envelope FROM scphistory WHERE ledgerseq = ?",
+            (seq,))]
+
     def store_scp_history(self, seq: int,
                           envelopes: List[Tuple[bytes, bytes]],
                           commit: bool = True):

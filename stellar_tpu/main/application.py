@@ -89,6 +89,11 @@ class Application:
         if config.METADATA_OUTPUT_STREAM:
             self._open_meta_stream(config.METADATA_OUTPUT_STREAM)
         self.herder.on_externalized = self._on_externalized
+        if self.database is not None:
+            if not fresh:
+                self._restore_scp_state()
+            # upgrade votes restore even before the first close
+            self._restore_scheduled_upgrades()
         if config.INVARIANT_CHECKS:
             from stellar_tpu.invariant import (
                 InvariantManager, set_active_manager,
@@ -168,6 +173,50 @@ class Application:
             n += self.process_manager.poll()
         return n
 
+    def _restore_scp_state(self):
+        """Re-feed the LCL slot's persisted SCP messages (reference
+        ``Herder::restoreSCPState``): a restarted validator can prove
+        the last externalization to peers (GET_SCP_STATE)."""
+        from stellar_tpu.xdr.runtime import from_bytes
+        from stellar_tpu.xdr.scp import SCPEnvelope
+        for raw in self.database.load_scp_history(self.lm.ledger_seq):
+            try:
+                env = from_bytes(SCPEnvelope, raw)
+                # restore entry point: records state without re-running
+                # validation (the reference's setStateFromEnvelope —
+                # tx sets for closed slots are gone, so the normal
+                # receive path could not validate them)
+                self.herder.scp.set_state_from_envelope(
+                    env.statement.slotIndex, env)
+            except Exception:
+                continue  # stale/foreign rows never block startup
+
+    def _restore_scheduled_upgrades(self):
+        from stellar_tpu.database import PersistentState
+        raw_up = self.persistence.state.get(
+            PersistentState.LEDGER_UPGRADES)
+        if raw_up:
+            try:
+                self.herder.upgrades.params = _upgrade_params_from_json(
+                    raw_up)
+            except Exception:
+                pass
+        self._saved_upgrades = raw_up
+
+    def save_scheduled_upgrades(self):
+        """Persist the operator's scheduled upgrade votes (reference
+        stores Upgrades parameters in PersistentState), including
+        clears: remove_upgrades_once_done must not resurrect applied
+        votes on restart."""
+        if self.persistence is None:
+            return
+        from stellar_tpu.database import PersistentState
+        raw = _upgrade_params_to_json(self.herder.upgrades.params)
+        if raw != getattr(self, "_saved_upgrades", None):
+            self.persistence.state.set(
+                PersistentState.LEDGER_UPGRADES, raw)
+            self._saved_upgrades = raw
+
     # ---------------- hooks ----------------
 
     def _on_externalized(self, slot_index: int, close_result):
@@ -189,6 +238,9 @@ class Application:
                         slot_index)]
             if rows:
                 self.database.store_scp_history(slot_index, rows)
+            # applied upgrade votes were cleared by the herder; keep
+            # the persisted row in sync so restarts don't resurrect
+            self.save_scheduled_upgrades()
         self.overlay.ledger_closed(slot_index)
 
     # ---------------- operator surface ----------------
@@ -236,3 +288,44 @@ class Application:
         self.herder.trigger_next_ledger(seq)
         # single-node qset externalizes immediately via self-messages
         return {"ledger": self.lm.ledger_seq}
+
+
+def _upgrade_params_to_json(params) -> str:
+    import base64
+    import json as _json
+    from stellar_tpu.xdr.ledger import ConfigUpgradeSetKey
+    from stellar_tpu.xdr.runtime import to_bytes
+    d = {
+        "upgrade_time": params.upgrade_time,
+        "protocol_version": params.protocol_version,
+        "base_fee": params.base_fee,
+        "max_tx_set_size": params.max_tx_set_size,
+        "base_reserve": params.base_reserve,
+        "flags": params.flags,
+        "max_soroban_tx_set_size": params.max_soroban_tx_set_size,
+        "config_upgrade_set_key": base64.b64encode(to_bytes(
+            ConfigUpgradeSetKey, params.config_upgrade_set_key)).decode()
+        if params.config_upgrade_set_key is not None else None,
+    }
+    return _json.dumps(d)
+
+
+def _upgrade_params_from_json(raw: str):
+    import base64
+    import json as _json
+    from stellar_tpu.herder.upgrades import UpgradeParameters
+    from stellar_tpu.xdr.ledger import ConfigUpgradeSetKey
+    from stellar_tpu.xdr.runtime import from_bytes
+    d = _json.loads(raw)
+    key = d.get("config_upgrade_set_key")
+    return UpgradeParameters(
+        upgrade_time=d.get("upgrade_time", 0),
+        protocol_version=d.get("protocol_version"),
+        base_fee=d.get("base_fee"),
+        max_tx_set_size=d.get("max_tx_set_size"),
+        base_reserve=d.get("base_reserve"),
+        flags=d.get("flags"),
+        max_soroban_tx_set_size=d.get("max_soroban_tx_set_size"),
+        config_upgrade_set_key=from_bytes(
+            ConfigUpgradeSetKey, base64.b64decode(key))
+        if key else None)

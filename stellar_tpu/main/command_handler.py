@@ -188,10 +188,12 @@ class CommandHandler:
                     if key in params:
                         setattr(up, attr, int(params[key][0]))
                 self.app.herder.upgrades.params = up
+                self.app.save_scheduled_upgrades()
             elif mode == "clear":
                 from stellar_tpu.herder.upgrades import UpgradeParameters
                 self.app.herder.upgrades.params = UpgradeParameters()
                 up = self.app.herder.upgrades.params
+                self.app.save_scheduled_upgrades()
             return {
                 "upgradetime": up.upgrade_time,
                 "protocolversion": up.protocol_version,

@@ -192,3 +192,59 @@ def test_scp_history_persisted(tmp_path):
                 "SELECT envelope FROM scphistory LIMIT 5"):
             from_bytes(SCPEnvelope, env)
         a.database.close()
+
+
+def test_scheduled_upgrades_and_scp_state_survive_restart(tmp_path):
+    """Reference parity: scheduled upgrade votes live in
+    PersistentState and the LCL slot's SCP messages are re-fed at
+    startup (Herder::restoreSCPState)."""
+    from stellar_tpu.main.application import Application
+
+    def mkapp():
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = "restore net"
+        cfg.NODE_SEED = keypair("restore-node")
+        cfg.DATABASE = str(tmp_path / "node.db")
+        cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+        cfg.MANUAL_CLOSE = True
+        from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+        return Application(cfg, clock=VirtualClock(VIRTUAL_TIME))
+
+    app = mkapp()
+    app.start()
+    # close a couple of ledgers through consensus (singleton quorum)
+    for _ in range(2):
+        app.manual_close()
+        app.clock.crank_until(
+            lambda: not app.clock._scheduler.size(), 10)
+    lcl = app.lm.ledger_seq
+    assert lcl >= 3
+    # schedule an upgrade vote via the same path the admin route uses
+    from stellar_tpu.herder.upgrades import UpgradeParameters
+    app.herder.upgrades.params = UpgradeParameters(
+        upgrade_time=0, base_fee=777)
+    app.save_scheduled_upgrades()
+    app.database.close()
+
+    app2 = mkapp()
+    # upgrades restored
+    assert app2.herder.upgrades.params.base_fee == 777
+    # the LCL slot's SCP state restored: the slot knows its
+    # externalized value again
+    assert app2.lm.ledger_seq == lcl
+    assert app2.herder.scp.externalized_value(lcl) is not None
+
+    # the vote applies at the next close and its clearing persists:
+    # another restart must NOT resurrect the applied vote
+    app2.start()
+    app2.manual_close()
+    app2.clock.crank_until(
+        lambda: not app2.clock._scheduler.size(), 10)
+    assert app2.lm.last_closed_header.baseFee == 777
+    assert app2.herder.upgrades.params.base_fee is None
+    app2.database.close()
+
+    app3 = mkapp()
+    assert app3.lm.last_closed_header.baseFee == 777
+    assert app3.herder.upgrades.params.base_fee is None
+    app3.database.close()
