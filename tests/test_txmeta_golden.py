@@ -215,6 +215,73 @@ def scenario_liquidity_pool(version):
     return out
 
 
+
+
+def scenario_soroban_counter(version):
+    """Upload + create + invoke the counter contract; meta covers
+    contract code/data/TTL entry changes and the nonce consumption of
+    a signed auth entry."""
+    from stellar_tpu.simulation.load_generator import (
+        _deploy_frames, _soroban_data, _soroban_op,
+    )
+    from stellar_tpu.soroban.host import (
+        contract_code_key, contract_data_key, scaddress_contract, sym,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, HostFunction, HostFunctionType,
+        InvokeContractArgs, SCVal, SCValType,
+    )
+    a = keypair("gm-sor")
+    lm = _lm_with([(a, 100_000 * XLM)], version)
+    net = lm.network_id
+    import dataclasses
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=10)
+    lm.root.soroban_config = lm.soroban_config
+    up, create, contract_id, code_hash, inst_key = _deploy_frames(
+        a, (1 << 32) + 1, (1 << 32) + 2, _counter_code_for_golden(),
+        net, salt=b"\x31" * 32)
+    out = [_close_with(lm, [up]), _close_with(lm, [create])]
+    addr = scaddress_contract(contract_id)
+    counter_key = contract_data_key(addr, sym("count"),
+                                    ContractDataDurability.PERSISTENT)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr, functionName=b"incr",
+                           args=[]))
+    invoke = make_tx(
+        a, (1 << 32) + 3, [_soroban_op(fn)], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[inst_key, contract_code_key(code_hash)],
+            read_write=[counter_key]),
+        network_id=net)
+    out.append(_close_with(lm, [invoke]))
+    return out
+
+
+def _counter_code_for_golden():
+    from stellar_tpu.soroban.host import assemble_program, ins, sym, u32
+    return assemble_program({
+        "incr": [
+            ins("push", sym("count")), ins("has", sym("persistent")),
+            ins("jz", u32(3)),
+            ins("push", sym("count")), ins("get", sym("persistent")),
+            ins("jmp", u32(1)),
+            ins("push", u32(0)),
+            ins("push", u32(1)), ins("add"),
+            ins("dup"),
+            ins("push", sym("count")), ins("swap"),
+            ins("put", sym("persistent")),
+            ins("ret"),
+        ],
+    })
+
+
+# soroban is protocol >= 20 only
+SOROBAN_SCENARIOS = {
+    "soroban_counter": scenario_soroban_counter,
+}
+
 SCENARIOS = {
     "payments": scenario_payments,
     "account_lifecycle": scenario_account_lifecycle,
@@ -222,6 +289,25 @@ SCENARIOS = {
     "sponsorship": scenario_sponsorship,
     "liquidity_pool": scenario_liquidity_pool,
 }
+
+
+@pytest.mark.parametrize(
+    "version", [v for v in VERSIONS if v >= 20])
+@pytest.mark.parametrize("name", sorted(SOROBAN_SCENARIOS))
+def test_txmeta_soroban_matches_baseline(name, version):
+    results = SOROBAN_SCENARIOS[name](version)
+    assert all(r.failed_count == 0 for r in results), \
+        f"{name}@{version} had failing txs"
+    got = outcome_hash(results)
+    key = f"{name}@p{version}"
+    if RECORD:
+        _recorded[key] = got
+        return
+    baseline = _load_baseline()
+    assert key in baseline, \
+        f"no baseline for {key}; record with STELLAR_TPU_RECORD_TEST_TX_META=1"
+    assert got == baseline[key], \
+        f"tx meta drift in {key}: {got} != {baseline[key]}"
 
 
 @pytest.mark.parametrize("version", VERSIONS)
