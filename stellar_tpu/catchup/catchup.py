@@ -178,12 +178,16 @@ class CatchupWork(WorkSequence):
     ``CatchupWork``): fetch HAS → verify chain → buckets or replay."""
 
     def __init__(self, lm: LedgerManager, archive: FileArchive,
-                 config: CatchupConfiguration, status_manager=None):
+                 config: CatchupConfiguration, status_manager=None,
+                 trusted_hashes=None):
         super().__init__(f"catchup-{config.mode}-{config.to_ledger}")
         self.lm = lm
         self.archive = archive
         self.config = config
         self.status_manager = status_manager
+        # {checkpoint seq -> header hash} trust anchors (reference
+        # --trusted-checkpoint-hashes from verify-checkpoints output)
+        self.trusted_hashes = dict(trusted_hashes or {})
         self.has: Optional[HistoryArchiveState] = None
         self.verified_headers = []
         self._download = None  # BatchDownloadWork, created by _plan
@@ -225,8 +229,13 @@ class CatchupWork(WorkSequence):
         return super().on_success()
 
     def on_failure_raise(self):
-        self._status(f"Catchup FAILED at ledger {self.lm.ledger_seq} "
-                     f"(mode {self.config.mode})")
+        refused = getattr(self, "_refused", None)
+        if refused is not None:
+            self._status(f"Catchup REFUSED: {refused}")
+        else:
+            self._status(
+                f"Catchup FAILED at ledger {self.lm.ledger_seq} "
+                f"(mode {self.config.mode})")
         return super().on_failure_raise()
 
     def _plan(self):
@@ -246,6 +255,9 @@ class CatchupWork(WorkSequence):
         self._download = BatchDownloadWork(self.archive, cps)
         self.add_child(self._download)
         self.add_child(VerifyLedgerChainWork(self._collect_headers))
+        if self.trusted_hashes:
+            self.add_child(FunctionWork("check-trusted-hashes",
+                                        self._check_trusted))
         if self.config.mode == CatchupConfiguration.MINIMAL:
             from stellar_tpu.historywork import DownloadBucketsWork
             self._bucket_download = DownloadBucketsWork(
@@ -285,6 +297,42 @@ class CatchupWork(WorkSequence):
             self.archive, has0.all_bucket_hashes())
         # runs before 'apply' (inserted ahead of it in sequence order)
         self.insert_child(len(self.children) - 1, self._bucket_download)
+        return State.SUCCESS
+
+    def _refuse(self, reason: str):
+        """Terminal refusal: no whole-catchup retry can change a
+        trust-anchor verdict, and the refusal reason must survive the
+        generic failure status."""
+        self._refused = reason
+        self._status(f"Catchup REFUSED: {reason}")
+        self.max_retries = 0
+        return State.FAILURE
+
+    def _check_trusted(self):
+        """FAIL-CLOSED trust anchoring: the archive must cover the
+        newest pinned checkpoint at/below the target and match every
+        pinned hash in range — an archive that sidesteps the pins
+        (shorter chain, missing boundary headers) is refused, not
+        waved through (reference trusted-checkpoint verification)."""
+        target = self._target()
+        applicable = [s for s in self.trusted_hashes if s <= target]
+        if not applicable:
+            return self._refuse(
+                f"no pinned checkpoint at/below target {target} — "
+                "anchors do not cover this catchup")
+        by_seq = {he.header.ledgerSeq: he
+                  for he in self.verified_headers}
+        need = max(applicable)
+        if need not in by_seq:
+            return self._refuse(
+                f"archive does not contain pinned checkpoint {need}")
+        for seq in applicable:
+            he = by_seq.get(seq)
+            if he is None:
+                continue  # below the verified window; `need` anchors it
+            if he.hash.hex() != self.trusted_hashes[seq]:
+                return self._refuse(
+                    f"checkpoint {seq} does not match the trusted hash")
         return State.SUCCESS
 
     def _collect_headers(self):

@@ -88,9 +88,20 @@ def cmd_catchup(args) -> int:
                                     count=int(mode))
     else:
         conf = CatchupConfiguration(target, CatchupConfiguration.COMPLETE)
+    trusted = None
+    if getattr(args, "trusted_checkpoint_hashes", None):
+        with open(args.trusted_checkpoint_hashes) as f:
+            trusted = {int(seq): hexhash for seq, hexhash in json.load(f)}
+        if not trusted:
+            # anchoring was requested; an empty file must not silently
+            # disable it
+            print("trusted-checkpoint-hashes file holds no anchors",
+                  file=sys.stderr)
+            return 1
     work = CatchupWork(app.lm,
                        archive_from_config(cfg.HISTORY_ARCHIVES[0]),
-                       conf, status_manager=app.status_manager)
+                       conf, status_manager=app.status_manager,
+                       trusted_hashes=trusted)
     ws.schedule(work)
     ws.run_until_done(timeout=3600)
     print(json.dumps({"state": work.state,
@@ -345,10 +356,14 @@ def cmd_sign_transaction(args) -> int:
 
 def cmd_verify_checkpoints(args) -> int:
     """Walk an archive's header chain backwards from its HAS, verifying
-    every previousLedgerHash link (reference ``verify-checkpoints`` /
-    ``WriteVerifiedCheckpointHashesWork``)."""
+    every previousLedgerHash link; optionally write the verified
+    checkpoint hashes as a trust anchor file (reference
+    ``verify-checkpoints`` / ``WriteVerifiedCheckpointHashesWork``:
+    ``[[seq, hex], ...]`` newest first, consumed by
+    ``catchup --trusted-checkpoint-hashes``)."""
     from stellar_tpu.history.history_manager import (
         FileArchive, HistoryManager, checkpoint_containing,
+        is_last_in_checkpoint,
     )
     from stellar_tpu.xdr.ledger import ledger_header_hash
     archive = FileArchive(args.archive)
@@ -358,6 +373,7 @@ def cmd_verify_checkpoints(args) -> int:
         return 1
     verified = 0
     expected_hash = None
+    checkpoint_hashes = []  # [(seq, hex)], newest first
     cp = checkpoint_containing(has.current_ledger)
     while cp >= 63:
         chk = HistoryManager.get_checkpoint(archive, cp)
@@ -374,11 +390,27 @@ def cmd_verify_checkpoints(args) -> int:
                 print(json.dumps({"error": "chain broken",
                                   "ledger": he.header.ledgerSeq}))
                 return 1
+            if is_last_in_checkpoint(he.header.ledgerSeq):
+                checkpoint_hashes.append(
+                    [he.header.ledgerSeq, got.hex()])
             expected_hash = he.header.previousLedgerHash
             verified += 1
         cp -= 64
+    complete = cp < 63  # the walk reached the first checkpoint
+    if getattr(args, "output", None):
+        if not complete or not checkpoint_hashes:
+            # never write a partial anchor file: a gap would leave
+            # older history silently unguarded
+            print(json.dumps({
+                "error": "archive walk incomplete (missing checkpoint "
+                         f"{cp}); refusing to write partial anchors"}))
+            return 1
+        with open(args.output, "w") as f:
+            json.dump(checkpoint_hashes, f)
     print(json.dumps({"verified_headers": verified,
-                      "tip": has.current_ledger}))
+                      "tip": has.current_ledger,
+                      "complete": complete,
+                      "checkpoints": len(checkpoint_hashes)}))
     return 0
 
 
@@ -467,6 +499,10 @@ def main(argv=None) -> int:
     sub.add_parser("run").set_defaults(fn=cmd_run)
     sp = sub.add_parser("catchup")
     sp.add_argument("spec", help="<ledger>/<mode: complete|minimal>")
+    sp.add_argument("--trusted-checkpoint-hashes",
+                    dest="trusted_checkpoint_hashes",
+                    help="verify-checkpoints --output file: refuse "
+                    "archives whose checkpoints diverge from it")
     sp.set_defaults(fn=cmd_catchup)
     sp = sub.add_parser("print-xdr")
     sp.add_argument("file")
@@ -499,6 +535,8 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_sign_transaction)
     sp = sub.add_parser("verify-checkpoints")
     sp.add_argument("archive", help="archive directory")
+    sp.add_argument("--output", help="write verified [[seq, hash]] "
+                    "trust anchors (newest first)")
     sp.set_defaults(fn=cmd_verify_checkpoints)
     sp = sub.add_parser("check-quorum-intersection")
     sp.add_argument("file", help="JSON quorum map")

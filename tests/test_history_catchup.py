@@ -423,3 +423,59 @@ def test_catchup_replans_after_whole_retry(tmp_path):
     names = [c.name for c in work.children]
     assert names.count("apply") == 1
     assert sum(1 for n in names if n.startswith("batch-download")) == 1
+
+
+def test_trusted_checkpoint_hashes_anchor_catchup(tmp_path):
+    """verify-checkpoints --output trust anchors gate catchup: a
+    matching archive passes, a tampered anchor refuses (reference
+    WriteVerifiedCheckpointHashesWork + trusted catchup)."""
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+
+    def run(trusted):
+        a, b = keypair("alice"), keypair("bob")
+        root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+        lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+        ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+        work = CatchupWork(
+            lm2, archive,
+            CatchupConfiguration(63, CatchupConfiguration.COMPLETE),
+            trusted_hashes=trusted)
+        ws.schedule(work)
+        ws.run_until_done(600)
+        return work, lm2
+
+    # the real anchor
+    from stellar_tpu.history.history_manager import HistoryManager
+    headers, _, _ = HistoryManager.get_checkpoint(archive, 63)
+    anchor = next(h for h in headers if h.header.ledgerSeq == 63)
+    work, lm2 = run({63: anchor.hash.hex()})
+    assert work.state == State.SUCCESS and lm2.ledger_seq == 63
+
+    # a forged anchor refuses the archive outright
+    work, lm2 = run({63: "00" * 32})
+    assert work.state == State.FAILURE
+    assert lm2.ledger_seq < 63
+
+
+def test_trusted_anchors_fail_closed(tmp_path):
+    """An archive that sidesteps every pin (shorter chain / anchors
+    above its tip) is REFUSED, not waved through, and the refusal is
+    terminal (no whole-catchup retry)."""
+    lm, archive, hm = build_chain(70, str(tmp_path / "arch"))
+
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+    ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+    # pins exist only for checkpoint 127, which this archive (tip 63)
+    # cannot cover -> refuse
+    work = CatchupWork(
+        lm2, archive,
+        CatchupConfiguration(63, CatchupConfiguration.COMPLETE),
+        trusted_hashes={127: "11" * 32})
+    ws.schedule(work)
+    ws.run_until_done(600)
+    assert work.state == State.FAILURE
+    assert "anchors do not cover" in work._refused
+    # terminal: the refusal did not burn retry rounds
+    assert work.retries == 0
