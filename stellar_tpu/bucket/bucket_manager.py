@@ -100,6 +100,42 @@ class BucketManager:
                 lev.next = self.load(bytes.fromhex(entry["next"]))
         return bl
 
+    def persist_hot_archive(self, hl) -> List[dict]:
+        """Hot-archive list persistence (same content-addressed files;
+        buckets carry HotArchiveBucketEntry records)."""
+        manifest = []
+        for lev in hl.levels:
+            entry = {"curr": self.adopt(lev.curr).hex(),
+                     "snap": self.adopt(lev.snap).hex()}
+            if lev.next is not None:
+                entry["next"] = self.adopt(lev.next).hex()
+            manifest.append(entry)
+        return manifest
+
+    def restore_hot_archive(self, manifest: List[dict]):
+        from stellar_tpu.bucket.hot_archive import (
+            HotArchiveBucket, HotArchiveBucketList,
+        )
+
+        def load_hot(hexhash: str) -> HotArchiveBucket:
+            h = bytes.fromhex(hexhash)
+            if h == b"\x00" * 32:
+                return HotArchiveBucket([])
+            with open(self._path_for(h), "rb") as f:
+                b = HotArchiveBucket.deserialize(f.read())
+            if b.hash != h:
+                raise IOError(
+                    f"hot bucket {hexhash} fails its hash check")
+            return b
+        hl = HotArchiveBucketList()
+        for i, entry in enumerate(manifest[:NUM_LEVELS]):
+            lev = hl.levels[i]
+            lev.curr = load_hot(entry["curr"])
+            lev.snap = load_hot(entry["snap"])
+            if "next" in entry:
+                lev.next = load_hot(entry["next"])
+        return hl
+
     # ---------------- GC ----------------
 
     def forget_unreferenced(self, referenced: set):

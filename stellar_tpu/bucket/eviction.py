@@ -1,9 +1,11 @@
 """Eviction scan (reference ``BucketManager.h:299-308`` + the
 background eviction thread): every close scans a bounded window of
 Soroban state and evicts expired TEMPORARY entries — the entry and its
-TTL row become DEADENTRYs in that ledger's bucket batch. Persistent
-entries are never evicted here (they are archived, i.e. stay behind
-their expired TTL until restored).
+TTL row become DEADENTRYs in that ledger's bucket batch. From the
+state-archival protocol, expired PERSISTENT entries are evicted too,
+with their full entries handed back for the hot archive (reference
+HotArchiveBucket); below it they stay behind their expired TTL until
+restored.
 
 The scan cursor rotates through the key space so large states amortize
 across closes (the reference's incremental scan over bucket levels
@@ -11,7 +13,7 @@ plays the same role)."""
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Tuple
 
 __all__ = ["EvictionScanner"]
 
@@ -21,9 +23,12 @@ class EvictionScanner:
         self.max_entries = max_entries_per_scan
         self._cursor: bytes = b""
 
-    def scan(self, ltx, ledger_seq: int) -> List:
-        """Erase expired TEMPORARY entries via ``ltx``; returns the
-        evicted LedgerKeys (already erased)."""
+    def scan(self, ltx, ledger_seq: int,
+             archive_persistent: bool = False) -> Tuple[List, List]:
+        """Erase expired Soroban entries via ``ltx``. Returns
+        (evicted LedgerKeys, archived LedgerEntries) — the second list
+        holds full PERSISTENT entries bound for the hot archive and is
+        empty unless ``archive_persistent``."""
         from stellar_tpu.soroban.host import ttl_key_for
         from stellar_tpu.xdr.contract import ContractDataDurability
         from stellar_tpu.xdr.runtime import from_bytes
@@ -32,7 +37,7 @@ class EvictionScanner:
         data_keys = sorted(ltx._all_keys_of_type(
             LedgerEntryType.CONTRACT_DATA))
         if not data_keys:
-            return []
+            return [], []
         # rotate: start after the cursor, wrap around
         start = 0
         for i, kb in enumerate(data_keys):
@@ -41,20 +46,26 @@ class EvictionScanner:
                 break
         window = (data_keys[start:] + data_keys[:start])[:self.max_entries]
         evicted = []
+        archived = []
         for kb in window:
             self._cursor = kb
             data_key = from_bytes(LedgerKey, kb)
             entry = ltx.load_without_record(data_key)
-            if entry is None or entry.data.value.durability != \
-                    ContractDataDurability.TEMPORARY:
+            if entry is None:
+                continue
+            persistent = entry.data.value.durability != \
+                ContractDataDurability.TEMPORARY
+            if persistent and not archive_persistent:
                 continue
             tk = ttl_key_for(data_key)
             ttl_entry = ltx.load_without_record(tk)
             if ttl_entry is not None and \
                     ttl_entry.data.value.liveUntilLedgerSeq >= ledger_seq:
                 continue
+            if persistent:
+                archived.append(entry)
             ltx.erase(data_key)
             if ttl_entry is not None:
                 ltx.erase(tk)
             evicted.append(data_key)
-        return evicted
+        return evicted, archived

@@ -1,0 +1,210 @@
+"""Hot archive for evicted persistent Soroban state (reference
+``src/bucket/HotArchiveBucket*``: a second 11-level bucket list that
+receives ARCHIVED full entries when the eviction scan removes expired
+PERSISTENT contract data/code from the live state, and LIVE key markers
+when a RestoreFootprint brings an entry back).
+
+Activation is protocol-gated (STATE_ARCHIVAL_PROTOCOL_VERSION = 23 >
+CURRENT_LEDGER_PROTOCOL_VERSION): below it the live list keeps expired
+persistent entries and the hot archive stays empty — matching the
+reference's protocol sequencing (the class exists in the p22-era tree;
+persistent eviction begins with the state archival protocol). The
+archive persists with the node (content-addressed files + manifest).
+Turning the gate on for a REAL network additionally requires the
+archive hash in the ledger header and hot-archive reconstruction in
+catchup, exactly as the reference's protocol-23 upgrade does — until
+then the gate must stay above the network's protocol version.
+
+Merge semantics (reference ``HotArchiveBucket::mergeCasesWithEqualKeys``):
+newest wins per key; at the bottom level LIVE markers annihilate (a
+restored entry needs no tombstone below it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from stellar_tpu.bucket.bucket_list import (
+    NUM_LEVELS, level_should_spill, should_merge_with_empty_curr,
+)
+from stellar_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+from stellar_tpu.xdr.ledger import (
+    HotArchiveBucketEntry, HotArchiveBucketEntryType as HBET,
+)
+from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+from stellar_tpu.xdr.types import LedgerKey
+
+__all__ = ["HotArchiveBucket", "HotArchiveBucketList",
+           "STATE_ARCHIVAL_PROTOCOL_VERSION"]
+
+STATE_ARCHIVAL_PROTOCOL_VERSION = 23
+
+
+def _entry_key_bytes(e) -> bytes:
+    if e.arm == HBET.HOT_ARCHIVE_LIVE:
+        return to_bytes(LedgerKey, e.value)
+    return key_bytes(entry_to_key(e.value))
+
+
+class HotArchiveBucket:
+    """Immutable sorted hot-archive bucket; same content-addressed
+    framed-SHA256 identity scheme as the live buckets."""
+
+    __slots__ = ("entries", "_hash", "_index")
+
+    def __init__(self, entries: List):
+        self.entries = entries
+        self._hash: Optional[bytes] = None
+        self._index: Optional[Dict[bytes, object]] = None
+
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    @property
+    def hash(self) -> bytes:
+        if self._hash is None:
+            if not self.entries:
+                self._hash = b"\x00" * 32
+            else:
+                from stellar_tpu.utils import native
+                self._hash = native.hash_frames(
+                    [to_bytes(HotArchiveBucketEntry, e)
+                     for e in self.entries])
+        return self._hash
+
+    def serialize(self) -> bytes:
+        from stellar_tpu.utils import native
+        return native.join_frames(
+            [to_bytes(HotArchiveBucketEntry, e) for e in self.entries])
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "HotArchiveBucket":
+        from stellar_tpu.utils import native
+        return cls([from_bytes(HotArchiveBucketEntry, f)
+                    for f in native.split_frames(raw)])
+
+    @classmethod
+    def fresh(cls, archived: List, restored_keys: List
+              ) -> "HotArchiveBucket":
+        """One ledger's hot-archive delta: full ARCHIVED entries for
+        newly evicted state, LIVE markers for restored keys."""
+        ents = [HotArchiveBucketEntry.make(HBET.HOT_ARCHIVE_ARCHIVED, e)
+                for e in archived]
+        ents += [HotArchiveBucketEntry.make(HBET.HOT_ARCHIVE_LIVE, k)
+                 for k in restored_keys]
+        ents.sort(key=_entry_key_bytes)
+        return cls(ents)
+
+    def get(self, kb: bytes):
+        """The entry under ledger-key bytes ``kb`` or None."""
+        if self._index is None:
+            self._index = {_entry_key_bytes(e): e for e in self.entries}
+        return self._index.get(kb)
+
+
+def merge_hot_buckets(old: HotArchiveBucket, new: HotArchiveBucket,
+                      keep_live_markers: bool) -> HotArchiveBucket:
+    """Sorted-merge: per equal key the NEW entry wins outright
+    (archived-over-live, live-over-archived — last write is truth);
+    at the bottom level LIVE markers drop (nothing below to shadow)."""
+    out: List = []
+    i = j = 0
+    oe, ne = old.entries, new.entries
+
+    def put(e):
+        if e.arm == HBET.HOT_ARCHIVE_LIVE and not keep_live_markers:
+            return
+        out.append(e)
+    while i < len(oe) and j < len(ne):
+        ko, kn = _entry_key_bytes(oe[i]), _entry_key_bytes(ne[j])
+        if ko < kn:
+            put(oe[i])
+            i += 1
+        elif kn < ko:
+            put(ne[j])
+            j += 1
+        else:
+            put(ne[j])  # newest wins
+            i += 1
+            j += 1
+    while i < len(oe):
+        put(oe[i])
+        i += 1
+    while j < len(ne):
+        put(ne[j])
+        j += 1
+    return HotArchiveBucket(out)
+
+
+class _HotLevel:
+    def __init__(self, level: int):
+        self.level = level
+        self.curr = HotArchiveBucket([])
+        self.snap = HotArchiveBucket([])
+        self.next: Optional[HotArchiveBucket] = None
+
+    def hash(self) -> bytes:
+        from stellar_tpu.crypto.sha import sha256
+        return sha256(self.curr.hash + self.snap.hash)
+
+    def take_snap(self) -> HotArchiveBucket:
+        self.snap = self.curr
+        self.curr = HotArchiveBucket([])
+        return self.snap
+
+    def commit(self):
+        if self.next is not None:
+            self.curr = self.next
+            self.next = None
+
+    def prepare(self, incoming: HotArchiveBucket, keep_live: bool,
+                merge_with_empty_curr: bool):
+        base = HotArchiveBucket([]) if merge_with_empty_curr else self.curr
+        self.next = merge_hot_buckets(base, incoming, keep_live)
+
+
+class HotArchiveBucketList:
+    """Same 11-level spill cadence as the live list (reference shares
+    ``BucketListBase``), holding hot-archive buckets."""
+
+    def __init__(self):
+        self.levels = [_HotLevel(i) for i in range(NUM_LEVELS)]
+
+    def hash(self) -> bytes:
+        from stellar_tpu.crypto.sha import sha256
+        h = sha256(b"".join(lev.hash() for lev in self.levels))
+        return h
+
+    def add_batch(self, current_ledger: int, archived: List,
+                  restored_keys: List):
+        assert current_ledger > 0
+        for i in range(NUM_LEVELS - 1, 0, -1):
+            if level_should_spill(current_ledger, i - 1):
+                spilled = self.levels[i - 1].take_snap()
+                self.levels[i].commit()
+                self.levels[i].prepare(
+                    spilled,
+                    keep_live=(i < NUM_LEVELS - 1),
+                    merge_with_empty_curr=should_merge_with_empty_curr(
+                        current_ledger, i))
+        self.levels[0].prepare(
+            HotArchiveBucket.fresh(archived, restored_keys),
+            keep_live=True, merge_with_empty_curr=False)
+        self.levels[0].commit()
+
+    def get_archived(self, kb: bytes):
+        """Newest-first lookup: the ARCHIVED LedgerEntry for key bytes
+        ``kb``, or None when absent or restored (LIVE marker)."""
+        for lev in self.levels:
+            for bucket in (lev.curr, lev.snap):
+                e = bucket.get(kb)
+                if e is None:
+                    continue
+                if e.arm == HBET.HOT_ARCHIVE_ARCHIVED:
+                    return e.value
+                return None  # LIVE marker: restored since archival
+        return None
+
+    def total_entry_count(self) -> int:
+        return sum(len(lev.curr.entries) + len(lev.snap.entries)
+                   for lev in self.levels)

@@ -256,8 +256,11 @@ class CatchupWork(WorkSequence):
         self.add_child(self._download)
         self.add_child(VerifyLedgerChainWork(self._collect_headers))
         if self.trusted_hashes:
+            from stellar_tpu.work.work import RETRY_NEVER
+            # a trust verdict is deterministic: no per-child retries
             self.add_child(FunctionWork("check-trusted-hashes",
-                                        self._check_trusted))
+                                        self._check_trusted,
+                                        max_retries=RETRY_NEVER))
         if self.config.mode == CatchupConfiguration.MINIMAL:
             from stellar_tpu.historywork import DownloadBucketsWork
             self._bucket_download = DownloadBucketsWork(
@@ -320,9 +323,17 @@ class CatchupWork(WorkSequence):
             return self._refuse(
                 f"no pinned checkpoint at/below target {target} — "
                 "anchors do not cover this catchup")
+        need = max(applicable)
+        if target > max(self.trusted_hashes):
+            # everything past the newest pin would rest on the
+            # archive's say-so; anchored catchup must not outrun its
+            # anchors (the reference takes the target hash FROM the
+            # trusted file)
+            return self._refuse(
+                f"target {target} is beyond the newest pinned "
+                f"checkpoint {max(self.trusted_hashes)}")
         by_seq = {he.header.ledgerSeq: he
                   for he in self.verified_headers}
-        need = max(applicable)
         if need not in by_seq:
             return self._refuse(
                 f"archive does not contain pinned checkpoint {need}")

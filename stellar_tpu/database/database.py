@@ -258,15 +258,20 @@ class NodePersistence:
 
     # ---------------- save (called at every close) ----------------
 
+    HOT_ARCHIVE_STATE = "hotarchivestate"
+
     def save_ledger(self, header, header_hash: bytes, bucket_list,
                     tx_rows: List[Tuple[bytes, bytes, bytes]],
                     scp_rows: Optional[List[Tuple[bytes, bytes]]] = None,
-                    txset_xdr: Optional[bytes] = None):
+                    txset_xdr: Optional[bytes] = None,
+                    hot_archive=None):
         """Persist one closed ledger. Step 1: bucket files on disk.
         Step 2: one SQL transaction moving the LCL pointer."""
         from stellar_tpu.xdr.ledger import LedgerHeader
         from stellar_tpu.xdr.runtime import to_bytes
         manifest = self.buckets.persist_bucket_list(bucket_list)
+        hot_manifest = self.buckets.persist_hot_archive(hot_archive) \
+            if hot_archive is not None else None
         with self.db.conn:  # single transaction
             self.db.store_header(
                 header_hash, header.previousLedgerHash, header.ledgerSeq,
@@ -283,6 +288,9 @@ class NodePersistence:
                                     commit=False)
             self.state.set(PersistentState.BUCKET_LIST_STATE,
                            json.dumps(manifest), commit=False)
+            if hot_manifest is not None:
+                self.state.set(self.HOT_ARCHIVE_STATE,
+                               json.dumps(hot_manifest), commit=False)
             self.state.set(PersistentState.LAST_CLOSED_LEDGER,
                            header_hash.hex(), commit=False)
 
@@ -309,4 +317,7 @@ class NodePersistence:
             raise RuntimeError(
                 "restored bucket list does not match LCL header "
                 "(bucket dir corrupt?) — catch up from history instead")
-        return header, header_hash, bucket_list
+        hot_raw = self.state.get(NodePersistence.HOT_ARCHIVE_STATE)
+        hot_archive = self.buckets.restore_hot_archive(
+            json.loads(hot_raw)) if hot_raw else None
+        return header, header_hash, bucket_list, hot_archive

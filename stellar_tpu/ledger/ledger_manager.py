@@ -114,6 +114,12 @@ class LedgerManager:
         self.close_meta_stream: List = []  # downstream consumers hook
         from stellar_tpu.bucket.eviction import EvictionScanner
         self.eviction_scanner = EvictionScanner()
+        # hot archive for evicted PERSISTENT Soroban state (reference
+        # HotArchiveBucketList; receives entries from the
+        # state-archival protocol onward)
+        from stellar_tpu.bucket.hot_archive import HotArchiveBucketList
+        self.hot_archive = HotArchiveBucketList()
+        self.root.hot_archive = self.hot_archive
         # Soroban network settings: the in-memory view of the
         # CONFIG_SETTING ledger entries (restored from state, so
         # upgraded values survive restart — reference
@@ -233,8 +239,17 @@ class LedgerManager:
 
         # eviction scan: expired TEMPORARY Soroban entries leave the
         # live state this close (reference startBackgroundEvictionScan,
-        # LedgerManagerImpl.cpp:1072-1077)
-        evicted_keys = self.eviction_scanner.scan(ltx, lcd.ledger_seq)
+        # LedgerManagerImpl.cpp:1072-1077); from the state-archival
+        # protocol, expired PERSISTENT entries move to the hot archive
+        from stellar_tpu.bucket.hot_archive import (
+            STATE_ARCHIVAL_PROTOCOL_VERSION,
+        )
+        archive_persistent = (
+            self.hot_archive is not None and
+            ltx.header().ledgerVersion >=
+            STATE_ARCHIVAL_PROTOCOL_VERSION)
+        evicted_keys, archived_entries = self.eviction_scanner.scan(
+            ltx, lcd.ledger_seq, archive_persistent=archive_persistent)
         if evicted_keys:
             from stellar_tpu.utils.metrics import registry
             registry.counter("state.eviction.evicted").inc(
@@ -253,6 +268,26 @@ class LedgerManager:
                 dead_keys.append(from_bytes(LedgerKey, kb))
 
         ltx.commit()
+        if self.hot_archive is not None:
+            # restored keys = CONTRACT_DATA entries recreated this
+            # close whose key still sits ARCHIVED in the hot archive
+            # (RestoreFootprint brought them back); they get LIVE
+            # markers. Only contract data is ever archived, so other
+            # entry types skip the probe entirely.
+            from stellar_tpu.ledger.ledger_txn import (
+                entry_to_key, key_bytes,
+            )
+            from stellar_tpu.xdr.types import LedgerEntryType
+            restored = []
+            for e in init_entries:
+                if e.data.arm != LedgerEntryType.CONTRACT_DATA:
+                    continue
+                lk = entry_to_key(e)
+                if self.hot_archive.get_archived(
+                        key_bytes(lk)) is not None:
+                    restored.append(lk)
+            self.hot_archive.add_batch(
+                lcd.ledger_seq, archived_entries, restored)
         header = copy_header(self.root.header())
         if self.bucket_list is not None:
             self.bucket_list.add_batch(
@@ -284,7 +319,8 @@ class LedgerManager:
             self.persistence.save_ledger(
                 header, self._lcl_hash, self.bucket_list, tx_rows,
                 txset_xdr=to_bytes(GeneralizedTransactionSet,
-                                   lcd.tx_set.xdr))
+                                   lcd.tx_set.xdr),
+                hot_archive=self.hot_archive)
 
         result.header = header
         result.header_hash = self._lcl_hash
@@ -356,7 +392,7 @@ class LedgerManager:
         restored = persistence.load_last_ledger()
         if restored is None:
             return None
-        header, header_hash, bucket_list = restored
+        header, header_hash, bucket_list, hot_archive = restored
         # live state is served straight from the (disk-backed) bucket
         # list — the BucketListDB role; no dict of entries is built
         from stellar_tpu.bucket.bucket_list_db import BucketListStore
@@ -365,6 +401,9 @@ class LedgerManager:
         lm = cls(network_id, root, bucket_list=bucket_list,
                  persistence=persistence)
         lm._lcl_hash = header_hash
+        if hot_archive is not None:
+            lm.hot_archive = hot_archive
+            lm.root.hot_archive = hot_archive
         return lm
 
     # ---------------- upgrades ----------------

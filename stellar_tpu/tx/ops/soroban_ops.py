@@ -289,6 +289,28 @@ class RestoreFootprintOpFrame(_SorobanBase):
     """Reference ``RestoreFootprintOpFrame.cpp``: bring archived
     persistent readWrite entries back to the minimum lifetime."""
 
+    def _restore_from_hot_archive(self, ltx, lk):
+        """Recreate an evicted entry from the node's hot archive, or
+        None when it was never archived (or already restored). Gated on
+        the state-archival protocol like eviction itself."""
+        from stellar_tpu.bucket.hot_archive import (
+            STATE_ARCHIVAL_PROTOCOL_VERSION,
+        )
+        from stellar_tpu.ledger.ledger_txn import (
+            copy_entry, key_bytes, root_of,
+        )
+        if ltx.header().ledgerVersion < STATE_ARCHIVAL_PROTOCOL_VERSION:
+            return None
+        hot = getattr(root_of(ltx), "hot_archive", None)
+        if hot is None:
+            return None
+        archived = hot.get_archived(key_bytes(lk))
+        if archived is None:
+            return None
+        entry = copy_entry(archived)
+        ltx.create(entry).deactivate()
+        return entry
+
     def do_check_valid(self, ledger_version: int):
         fp = self.resources().footprint
         if fp.readOnly or not fp.readWrite:
@@ -313,9 +335,16 @@ class RestoreFootprintOpFrame(_SorobanBase):
         with LedgerTxn(outer) as ltx:
             for lk in self.resources().footprint.readWrite:
                 entry, live_until = _load_with_ttl(ltx, lk)
-                if entry is None or (live_until is not None and
-                                     live_until >= seq):
-                    continue  # absent or still live
+                if entry is None:
+                    # evicted to the hot archive? pull it back into
+                    # the live state (reference restores from
+                    # HotArchiveBucket after persistent eviction)
+                    entry = self._restore_from_hot_archive(ltx, lk)
+                    if entry is None:
+                        continue  # genuinely absent
+                    live_until = None
+                elif live_until is not None and live_until >= seq:
+                    continue  # still live
                 new_live = seq + cfg.min_persistent_ttl - 1
                 rent += compute_rent_fee(
                     cfg, len(to_bytes(LedgerEntry, entry)),

@@ -1,0 +1,228 @@
+"""Hot archive for evicted persistent Soroban state (reference
+``HotArchiveBucket`` / state-archival protocol): merge semantics, the
+eviction -> archive -> restore lifecycle at protocol >= 23, and the
+protocol gate below it."""
+
+import dataclasses
+
+import pytest
+
+from stellar_tpu.bucket.hot_archive import (
+    HotArchiveBucket, HotArchiveBucketList, merge_hot_buckets,
+    STATE_ARCHIVAL_PROTOCOL_VERSION,
+)
+from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.tx.tx_test_utils import (
+    keypair, make_tx, seed_root_with_accounts,
+)
+from stellar_tpu.xdr.ledger import HotArchiveBucketEntryType as HBET
+from stellar_tpu.xdr.runtime import to_bytes
+from stellar_tpu.xdr.types import LedgerKey
+
+XLM = 10_000_000
+
+
+def _account_entry(i, balance=1):
+    from stellar_tpu.tx.ops.create_account import new_account_entry
+    from stellar_tpu.xdr.types import account_id
+    k = keypair(f"hot-{i}")
+    return new_account_entry(account_id(k.public_key.raw), balance, 0)
+
+
+def _kb(entry):
+    from stellar_tpu.ledger.ledger_txn import entry_to_key
+    return key_bytes(entry_to_key(entry))
+
+
+def test_hot_bucket_merge_newest_wins_and_bottom_drops_live():
+    e1, e2 = _account_entry(1, 100), _account_entry(1, 999)
+    old = HotArchiveBucket.fresh([e1], [])
+    from stellar_tpu.ledger.ledger_txn import entry_to_key
+    new_live = HotArchiveBucket.fresh([], [entry_to_key(e2)])
+    merged = merge_hot_buckets(old, new_live, keep_live_markers=True)
+    assert len(merged.entries) == 1
+    assert merged.entries[0].arm == HBET.HOT_ARCHIVE_LIVE
+    # at the bottom, the LIVE marker annihilates
+    merged = merge_hot_buckets(old, new_live, keep_live_markers=False)
+    assert merged.entries == []
+    # archived-over-live: a re-archival shadows the marker
+    new_arch = HotArchiveBucket.fresh([e2], [])
+    merged = merge_hot_buckets(new_live, new_arch,
+                               keep_live_markers=True)
+    assert merged.entries[0].arm == HBET.HOT_ARCHIVE_ARCHIVED
+    assert merged.entries[0].value.data.value.balance == 999
+
+
+def test_hot_bucket_roundtrip_and_hash():
+    b = HotArchiveBucket.fresh([_account_entry(i) for i in range(4)], [])
+    again = HotArchiveBucket.deserialize(b.serialize())
+    assert again.hash == b.hash
+    assert HotArchiveBucket([]).hash == b"\x00" * 32
+
+
+def test_hot_list_lookup_and_spill_cadence():
+    hl = HotArchiveBucketList()
+    entries = [_account_entry(i) for i in range(12)]
+    for seq in range(1, 13):
+        hl.add_batch(seq, [entries[seq - 1]], [])
+    for e in entries:
+        got = hl.get_archived(_kb(e))
+        assert got is not None
+        assert to_bytes(
+            __import__("stellar_tpu.xdr.types",
+                       fromlist=["LedgerEntry"]).LedgerEntry, got) == \
+            to_bytes(
+            __import__("stellar_tpu.xdr.types",
+                       fromlist=["LedgerEntry"]).LedgerEntry, e)
+    # restore marker hides the archived entry
+    from stellar_tpu.ledger.ledger_txn import entry_to_key
+    hl.add_batch(13, [], [entry_to_key(entries[0])])
+    assert hl.get_archived(_kb(entries[0])) is None
+    assert hl.get_archived(_kb(entries[1])) is not None
+
+
+def _soroban_fixture(version):
+    """A ledger manager at ``version`` with a persistent contract-data
+    entry whose TTL has expired."""
+    from stellar_tpu.soroban.host import (
+        contract_data_key, scaddress_contract, sym, ttl_key_for,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, ContractDataEntry, SCVal, SCValType,
+    )
+    from stellar_tpu.xdr.types import (
+        ExtensionPoint, LedgerEntry, LedgerEntryType, TTLEntry,
+    )
+    a = keypair("hotlm-a")
+    root = seed_root_with_accounts([(a, 1000 * XLM)])
+    root.header().ledgerVersion = version
+    lm = LedgerManager(b"\x41" * 32, root)
+    addr = scaddress_contract(b"\x42" * 32)
+    cd = ContractDataEntry(
+        ext=ExtensionPoint.make(0), contract=addr,
+        key=SCVal.make(SCValType.SCV_SYMBOL, b"k"),
+        durability=ContractDataDurability.PERSISTENT,
+        val=SCVal.make(SCValType.SCV_U32, 7))
+    entry = LedgerEntry(
+        lastModifiedLedgerSeq=2,
+        data=LedgerEntry._types[1].make(
+            LedgerEntryType.CONTRACT_DATA, cd),
+        ext=LedgerEntry._types[2].make(0))
+    lk = contract_data_key(addr, SCVal.make(SCValType.SCV_SYMBOL, b"k"),
+                           ContractDataDurability.PERSISTENT)
+    ttl = LedgerEntry(
+        lastModifiedLedgerSeq=2,
+        data=LedgerEntry._types[1].make(
+            LedgerEntryType.TTL,
+            TTLEntry(keyHash=ttl_key_for(lk).value.keyHash,
+                     liveUntilLedgerSeq=2)),  # already expired
+        ext=LedgerEntry._types[2].make(0))
+    with LedgerTxn(lm.root) as ltx:
+        ltx.create(entry).deactivate()
+        ltx.create(ttl).deactivate()
+        ltx.commit()
+    return lm, a, lk
+
+
+def _close(lm, frames=()):
+    txset, _ = make_tx_set_from_transactions(
+        list(frames), lm.last_closed_header, lm.last_closed_hash)
+    return lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lm.last_closed_header.scpValue.closeTime + 5))
+
+
+def test_persistent_eviction_gated_below_archival_protocol():
+    lm, a, lk = _soroban_fixture(STATE_ARCHIVAL_PROTOCOL_VERSION - 1)
+    _close(lm)
+    # persistent entry stays in live state; nothing archived
+    assert lm.root.store.get(key_bytes(lk)) is not None
+    assert lm.hot_archive.total_entry_count() == 0
+
+
+def test_persistent_eviction_archives_and_restore_recovers():
+    from stellar_tpu.soroban.host import ttl_key_for
+    from stellar_tpu.tx.tx_test_utils import make_tx
+    lm, a, lk = _soroban_fixture(STATE_ARCHIVAL_PROTOCOL_VERSION)
+    _close(lm)
+    # evicted from live state, archived in full
+    assert lm.root.store.get(key_bytes(lk)) is None
+    assert lm.hot_archive.get_archived(key_bytes(lk)) is not None
+
+    # RestoreFootprint pulls it back from the hot archive
+    from stellar_tpu.simulation.load_generator import _soroban_data
+    from stellar_tpu.xdr.tx import (
+        Operation, OperationBody, OperationType, RestoreFootprintOp,
+    )
+    from stellar_tpu.xdr.types import ExtensionPoint
+    op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.RESTORE_FOOTPRINT,
+        RestoreFootprintOp(ext=ExtensionPoint.make(0))))
+    tx = make_tx(a, (1 << 32) + 1, [op], fee=6_000_000,
+                 soroban_data=_soroban_data(read_write=[lk]),
+                 network_id=lm.network_id)
+    res = _close(lm, [tx])
+    assert res.failed_count == 0, res.tx_results[0].code
+    restored = lm.root.store.get(key_bytes(lk))
+    assert restored is not None
+    assert restored.data.value.val.value == 7
+    # TTL recreated and live
+    ttl = lm.root.store.get(key_bytes(ttl_key_for(lk)))
+    assert ttl is not None
+    assert ttl.data.value.liveUntilLedgerSeq > lm.ledger_seq
+    # the archive now carries a LIVE marker: no double restore source
+    assert lm.hot_archive.get_archived(key_bytes(lk)) is None
+
+
+def test_hot_archive_survives_restart(tmp_path):
+    """The hot archive persists with the node: an entry evicted before
+    a restart is still restorable after it (prevents the restart-node
+    divergence the archive exists to avoid)."""
+    from stellar_tpu.bucket.bucket_manager import BucketManager
+    from stellar_tpu.database import Database, NodePersistence
+    lm, a, lk = _soroban_fixture(STATE_ARCHIVAL_PROTOCOL_VERSION)
+    db = Database(str(tmp_path / "node.db"))
+    pers = NodePersistence(db, BucketManager(str(tmp_path / "buckets")))
+    lm.persistence = pers
+    _close(lm)  # evicts + archives + persists
+    assert lm.hot_archive.get_archived(key_bytes(lk)) is not None
+    hot_hash = lm.hot_archive.hash()
+    db.close()
+
+    db2 = Database(str(tmp_path / "node.db"))
+    pers2 = NodePersistence(db2, BucketManager(str(tmp_path / "buckets")))
+    lm2 = LedgerManager.from_persistence(lm.network_id, pers2)
+    assert lm2 is not None
+    assert lm2.hot_archive.hash() == hot_hash
+    assert lm2.hot_archive.get_archived(key_bytes(lk)) is not None
+    db2.close()
+
+
+def test_restore_from_archive_gated_below_protocol():
+    """Below the archival protocol the restore op never consults the
+    hot archive (even a populated one)."""
+    lm, a, lk = _soroban_fixture(STATE_ARCHIVAL_PROTOCOL_VERSION - 1)
+    # plant an archived entry by hand
+    entry = lm.root.store.get(key_bytes(lk))
+    with LedgerTxn(lm.root) as ltx:
+        ltx.erase(__import__("stellar_tpu.xdr.runtime",
+                             fromlist=["from_bytes"]).from_bytes(
+            LedgerKey, key_bytes(lk)))
+        ltx.commit()
+    lm.hot_archive.add_batch(lm.ledger_seq, [entry], [])
+    from stellar_tpu.simulation.load_generator import _soroban_data
+    from stellar_tpu.xdr.tx import (
+        Operation, OperationBody, OperationType, RestoreFootprintOp,
+    )
+    from stellar_tpu.xdr.types import ExtensionPoint
+    op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.RESTORE_FOOTPRINT,
+        RestoreFootprintOp(ext=ExtensionPoint.make(0))))
+    tx = make_tx(a, (1 << 32) + 1, [op], fee=6_000_000,
+                 soroban_data=_soroban_data(read_write=[lk]),
+                 network_id=lm.network_id)
+    res = _close(lm, [tx])
+    assert res.failed_count == 0  # restore no-ops on absent entries
+    assert lm.root.store.get(key_bytes(lk)) is None  # NOT restored
