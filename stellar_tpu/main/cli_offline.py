@@ -132,6 +132,8 @@ def cmd_dump_archival_stats(args) -> int:
                 counts["ttl_live"] += 1
             else:
                 counts["ttl_expired"] += 1
+    counts["hot_archive_entries"] = lm.hot_archive.total_entry_count()
+    counts["hot_archive_hash"] = lm.hot_archive.hash().hex()
     print(json.dumps({"lcl": lcl, **counts}))
     return 0
 
