@@ -373,6 +373,11 @@ class CatchupWork(WorkSequence):
 
     def _apply(self):
         target = self._target()
+        if self.lm.ledger_seq >= target:
+            # the node advanced past the target while this work was in
+            # flight (buffered externalizes drained): adopting archive
+            # state now would REWIND the ledger — no-op instead
+            return State.SUCCESS
         if self.config.mode == CatchupConfiguration.MINIMAL:
             # adopt the archive's checkpoint state wholesale
             if not self._adopt_buckets_at(self.has.current_ledger,
@@ -405,24 +410,41 @@ class CatchupWork(WorkSequence):
 class LedgerApplyManager:
     """Buffers externalized-but-unappliable ledgers and decides
     sequential apply vs catchup (reference
-    ``LedgerApplyManagerImpl::processLedger``)."""
+    ``LedgerApplyManagerImpl::processLedger``). ``apply_fn`` is the
+    single close entry point — the herder passes its bookkeeping-
+    carrying apply so drains never bypass queue shifts / history
+    hooks; it defaults to a bare ``close_ledger`` for direct use."""
 
     TRIGGER_GAP = 2  # buffered ledgers beyond a gap before catching up
 
-    def __init__(self, lm: LedgerManager):
+    def __init__(self, lm: LedgerManager, apply_fn=None):
         self.lm = lm
+        self.apply_fn = apply_fn or lm.close_ledger
         self.buffered = {}  # seq -> LedgerCloseData
+
+    def _prune_stale(self):
+        for seq in [s for s in self.buffered
+                    if s <= self.lm.ledger_seq]:
+            del self.buffered[seq]
+
+    def drain(self) -> int:
+        """Apply contiguous buffered successors of the LCL; prunes
+        stale entries. Returns how many applied."""
+        self._prune_stale()
+        n = 0
+        while self.lm.ledger_seq + 1 in self.buffered:
+            self.apply_fn(self.buffered.pop(self.lm.ledger_seq + 1))
+            n += 1
+        return n
 
     def process_ledger(self, lcd: LedgerCloseData) -> str:
         """'applied' | 'buffered' | 'catchup-needed'."""
+        self._prune_stale()
         if lcd.ledger_seq <= self.lm.ledger_seq:
             return "applied"  # old news
         if lcd.ledger_seq == self.lm.ledger_seq + 1:
-            self.lm.close_ledger(lcd)
-            # drain any contiguous buffered successors
-            while self.lm.ledger_seq + 1 in self.buffered:
-                self.lm.close_ledger(
-                    self.buffered.pop(self.lm.ledger_seq + 1))
+            self.apply_fn(lcd)
+            self.drain()
             return "applied"
         self.buffered[lcd.ledger_seq] = lcd
         if len(self.buffered) >= self.TRIGGER_GAP:

@@ -157,6 +157,13 @@ class Herder:
             check_valid=self._check_tx_valid)
         self.state = HERDER_STATE.BOOTING
         self.tracking_slot = 0
+        # buffering + catchup arbitration for out-of-order externalizes
+        # (reference LedgerApplyManagerImpl::processLedger); applies go
+        # through _apply_externalized so drains carry full bookkeeping
+        from stellar_tpu.catchup.catchup import LedgerApplyManager
+        self.ledger_apply = LedgerApplyManager(
+            ledger_manager, apply_fn=self._apply_externalized)
+        self.on_catchup_needed = None  # app hook: start archive catchup
         self._timers: Dict[tuple, VirtualTimer] = {}
         self._trigger_timer = VirtualTimer(clock)
         self._trigger_armed_for = 0
@@ -482,18 +489,40 @@ class Herder:
 
     def _value_externalized(self, slot_index: int, value: bytes):
         """Reference ``HerderImpl::valueExternalized`` →
-        ``LedgerManager::valueExternalized``."""
+        ``LedgerManager::valueExternalized`` →
+        ``LedgerApplyManager::processLedger``: apply in sequence,
+        buffer ahead-of-LCL slots, signal catchup when the gap grows."""
         sv = _parse_stellar_value(value)
         if sv is None:
             raise RuntimeError("externalized unparsable value")
         txset = self.tx_sets.get(sv.txSetHash)
         if txset is None:
             raise RuntimeError("externalized unknown tx set")
-        if slot_index != self.lm.ledger_seq + 1:
-            return  # stale/buffered: catchup handles this later
-        result = self.lm.close_ledger(LedgerCloseData(
+        if slot_index <= self.lm.ledger_seq:
+            return  # stale: already applied
+        lcd = LedgerCloseData(
             ledger_seq=slot_index, tx_set=txset,
-            close_time=sv.closeTime, upgrades=list(sv.upgrades)))
+            close_time=sv.closeTime, upgrades=list(sv.upgrades))
+        outcome = self.ledger_apply.process_ledger(lcd)
+        if outcome == "applied":
+            return  # bookkeeping ran per applied close
+        # ahead of the LCL: buffered; once the gap passes the trigger
+        # depth, ask the application to catch up from archives
+        # (reference LM_CATCHING_UP_STATE)
+        self.state = HERDER_STATE.OUT_OF_SYNC
+        if outcome == "catchup-needed" and \
+                self.on_catchup_needed is not None:
+            self.on_catchup_needed(slot_index)
+
+    def drain_buffered(self):
+        """Apply any buffered contiguous successors of the LCL (called
+        after a catchup closes the gap)."""
+        self.ledger_apply.drain()
+
+    def _apply_externalized(self, lcd: LedgerCloseData):
+        slot_index = lcd.ledger_seq
+        txset = lcd.tx_set
+        result = self.lm.close_ledger(lcd)
         self.upgrades.remove_upgrades_once_done(
             result.header,
             soroban_config=getattr(self.lm, "soroban_config", None),

@@ -89,6 +89,8 @@ class Application:
         if config.METADATA_OUTPUT_STREAM:
             self._open_meta_stream(config.METADATA_OUTPUT_STREAM)
         self.herder.on_externalized = self._on_externalized
+        self.herder.on_catchup_needed = self._start_catchup
+        self._catchup_work = None
         if self.database is not None:
             if not fresh:
                 self._restore_scp_state()
@@ -216,6 +218,51 @@ class Application:
             self.persistence.state.set(
                 PersistentState.LEDGER_UPGRADES, raw)
             self._saved_upgrades = raw
+
+    def _start_catchup(self, target_seq: int):
+        """The node fell behind the network (reference
+        LM_CATCHING_UP_STATE): run a CatchupWork from the configured
+        archives, then drain the herder's buffered externalizes."""
+        if self._catchup_work is not None and \
+                not self._catchup_work.is_done():
+            return  # already catching up
+        # cooldown: a finished catchup that could not reach the
+        # buffered ledgers (archive's newest checkpoint too old) must
+        # not re-download the archive on every externalize — retry at
+        # roughly checkpoint-publish cadence
+        now = self.clock.now()
+        last = getattr(self, "_last_catchup_at", None)
+        if last is not None and now - last < 60:
+            return
+        self._last_catchup_at = now
+        if not self.config.HISTORY_ARCHIVES:
+            import logging
+            logging.getLogger("stellar_tpu.herder").warning(
+                "behind the network at slot %d but no HISTORY_ARCHIVES "
+                "configured; waiting for buffered ledgers", target_seq)
+            return
+        from stellar_tpu.catchup.catchup import (
+            CatchupConfiguration, CatchupWork,
+        )
+        from stellar_tpu.history.history_manager import (
+            archive_from_config,
+        )
+        from stellar_tpu.work.work import FunctionWork, WorkSequence
+        if self.config.CATCHUP_COMPLETE:
+            conf = CatchupConfiguration(0, CatchupConfiguration.COMPLETE)
+        elif self.config.CATCHUP_RECENT > 0:
+            conf = CatchupConfiguration(0, CatchupConfiguration.RECENT,
+                                        count=self.config.CATCHUP_RECENT)
+        else:
+            conf = CatchupConfiguration(0, CatchupConfiguration.MINIMAL)
+        self._catchup_work = CatchupWork(
+            self.lm, archive_from_config(self.config.HISTORY_ARCHIVES[0]),
+            conf, status_manager=self.status_manager)
+        seq = WorkSequence(f"catchup-and-resume-{target_seq}")
+        seq.add_child(self._catchup_work)
+        seq.add_child(FunctionWork("drain-buffered",
+                                   self.herder.drain_buffered))
+        self.work_scheduler.schedule(seq)
 
     # ---------------- hooks ----------------
 
