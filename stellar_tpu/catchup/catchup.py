@@ -360,6 +360,12 @@ class CatchupWork(WorkSequence):
 
     def _adopt_buckets_at(self, checkpoint: int,
                           has: "HistoryArchiveState") -> bool:
+        if self.lm.ledger_seq >= checkpoint:
+            # the node advanced past this adoption point while the
+            # work was in flight (buffered externalizes drained):
+            # adopting would rewind — skip, the replay loop (or the
+            # already-applied ledgers) covers the rest
+            return True
         cp_header = next(
             (h for h in self.verified_headers
              if h.header.ledgerSeq == checkpoint), None)

@@ -166,6 +166,8 @@ class Herder:
         self.on_catchup_needed = None  # app hook: start archive catchup
         self._timers: Dict[tuple, VirtualTimer] = {}
         self._trigger_timer = VirtualTimer(clock)
+        self._stuck_timer = VirtualTimer(clock)
+        self.request_scp_state = None  # overlay hook: pull peers' state
         self._trigger_armed_for = 0
         self._last_trigger_at = 0.0
         # network hooks (set by overlay / simulation): fan out to peers
@@ -446,6 +448,36 @@ class Herder:
         self.state = HERDER_STATE.TRACKING
         self.tracking_slot = self.lm.ledger_seq + 1
         self._arm_trigger(0.0)
+        self._arm_stuck_timer()
+
+    # ---------------- stuck detection / out-of-sync recovery --------
+
+    def _arm_stuck_timer(self):
+        """Reference ``Herder::CONSENSUS_STUCK_TIMEOUT_SECONDS``: no
+        externalize for 35s -> lost sync."""
+        self._stuck_timer.cancel()
+        self._stuck_timer.expires_from_now(
+            CONSENSUS_STUCK_TIMEOUT_SECONDS)
+        self._stuck_timer.async_wait(self._lost_sync)
+
+    def _lost_sync(self):
+        """Reference ``HerderImpl::lostSync`` + out-of-sync recovery:
+        flag the state and periodically pull peers' SCP state until an
+        externalize restores tracking."""
+        self.state = HERDER_STATE.OUT_OF_SYNC
+        from stellar_tpu.utils.metrics import registry
+        registry.counter("herder.lost-sync").inc()
+        self._out_of_sync_recovery()
+
+    def _out_of_sync_recovery(self):
+        if self.state != HERDER_STATE.OUT_OF_SYNC:
+            return
+        if self.request_scp_state is not None:
+            self.request_scp_state(self.lm.ledger_seq + 1)
+        # keep nudging at close cadence until tracking returns
+        self._stuck_timer.cancel()
+        self._stuck_timer.expires_from_now(self.target_close_seconds)
+        self._stuck_timer.async_wait(self._out_of_sync_recovery)
 
     def _arm_trigger(self, delay: float):
         seq = self.lm.ledger_seq + 1
@@ -530,6 +562,7 @@ class Herder:
             if hasattr(self.lm.root, "store") else None)
         self.state = HERDER_STATE.TRACKING
         self.tracking_slot = slot_index + 1
+        self._arm_stuck_timer()  # progress: reset the 35s watchdog
         # queue bookkeeping
         self.tx_queue.remove_applied(txset.frames)
         self.tx_queue.shift()

@@ -91,6 +91,7 @@ class Application:
         self.herder.on_externalized = self._on_externalized
         self.herder.on_catchup_needed = self._start_catchup
         self._catchup_work = None
+        self._last_catchup_at = None
         if self.database is not None:
             if not fresh:
                 self._restore_scp_state()
@@ -231,8 +232,8 @@ class Application:
         # not re-download the archive on every externalize — retry at
         # roughly checkpoint-publish cadence
         now = self.clock.now()
-        last = getattr(self, "_last_catchup_at", None)
-        if last is not None and now - last < 60:
+        if self._last_catchup_at is not None and \
+                now - self._last_catchup_at < 60:
             return
         self._last_catchup_at = now
         if not self.config.HISTORY_ARCHIVES:

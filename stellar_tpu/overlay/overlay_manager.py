@@ -137,6 +137,14 @@ class OverlayManager:
         h.broadcast_transaction = self.broadcast_transaction
         h.request_tx_set = self.fetch_tx_set
         h.request_quorum_set = self.fetch_quorum_set
+        h.request_scp_state = self.request_scp_state
+
+    def request_scp_state(self, from_slot: int):
+        """Out-of-sync recovery: ask every authenticated peer for its
+        SCP state from ``from_slot`` (reference sendGetScpState)."""
+        for p in list(self.peers):
+            p.send(StellarMessage.make(
+                MessageType.GET_SCP_STATE, from_slot))
 
     # ---------------- peer lifecycle ----------------
 

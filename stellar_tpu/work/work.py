@@ -327,7 +327,11 @@ class WorkScheduler:
 
         def step():
             self._scheduled = False
-            live = [w for w in self.works if not w.is_done()]
+            # prune finished works: a long-running app schedules many
+            # one-shot trees (catchup retries) and must not accumulate
+            # them (or their downloaded payloads) forever
+            self.works = [w for w in self.works if not w.is_done()]
+            live = list(self.works)
             for w in live:
                 w.crank(self.clock)
             # re-post only while something is actually runnable;

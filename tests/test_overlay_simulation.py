@@ -185,3 +185,37 @@ def test_node_heals_multi_ledger_gap_via_buffering():
         a.lm.last_closed_hash for a in others} or sim.crank_until(
         lambda: victim.lm.last_closed_hash ==
         others[0].lm.last_closed_hash, 60)
+
+
+def test_stuck_detection_and_out_of_sync_recovery():
+    """No externalize for the 35s stuck window flips the herder to
+    OUT_OF_SYNC and starts periodic SCP-state pulls; rejoining the
+    network restores TRACKING (reference lostSync + recovery)."""
+    from stellar_tpu.herder.herder import HERDER_STATE
+    from stellar_tpu.overlay.loopback import connect_loopback
+    from stellar_tpu.simulation.simulation import Topologies
+    sim = Topologies.core(4)
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() >= 3 for a in apps),
+        30)
+    assert sim.crank_until_ledger(apps[0].lm.ledger_seq + 1, 120)
+
+    victim = apps[3]
+    for p in list(victim.overlay.peers):
+        p.drop("test isolation")
+    others = apps[:3]
+    # network moves on; the victim externalizes nothing and trips the
+    # 35s watchdog
+    assert sim.crank_until(
+        lambda: victim.herder.state == HERDER_STATE.OUT_OF_SYNC, 120)
+    assert victim.lm.ledger_seq < others[0].lm.ledger_seq
+
+    # reconnect: the recovery pulls peers' SCP state; buffered
+    # externalizes drain and tracking resumes
+    connect_loopback(apps[0], victim)
+    target = others[0].lm.ledger_seq
+    assert sim.crank_until(
+        lambda: victim.lm.ledger_seq >= target and
+        victim.herder.state == HERDER_STATE.TRACKING, 180)
