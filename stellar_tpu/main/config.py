@@ -109,7 +109,8 @@ class Config:
             "AUTOMATIC_MAINTENANCE_PERIOD",
             "AUTOMATIC_MAINTENANCE_COUNT", "CATCHUP_COMPLETE",
             "CATCHUP_RECENT", "FAILURE_SAFETY", "UNSAFE_QUORUM",
-            "MAX_SLOTS_TO_REMEMBER",
+            "MAX_SLOTS_TO_REMEMBER", "LEDGER_PROTOCOL_VERSION",
+            "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
         }
         for key, value in raw.items():
             if key == "NODE_SEED":
@@ -149,8 +150,10 @@ class Config:
         recommended = (n - 1) // 3
         safety = self.FAILURE_SAFETY
         if safety == -1:
+            # auto: small quorums legitimately compute 0 (the
+            # reference only hard-errors on an EXPLICIT 0)
             safety = recommended
-        if safety == 0 and not self.UNSAFE_QUORUM and n > 1:
+        elif safety == 0 and not self.UNSAFE_QUORUM and n > 1:
             raise ValueError(
                 "FAILURE_SAFETY=0 requires UNSAFE_QUORUM=true")
         tolerated = n - qset.threshold
