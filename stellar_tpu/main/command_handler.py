@@ -247,6 +247,64 @@ class CommandHandler:
                     "total_submitted": gen.submitted}
         return self._on_main(run)
 
+    def cmd_clearmetrics(self, params):
+        """Reset the metrics registry (reference ``clearmetrics``)."""
+        from stellar_tpu.utils.metrics import registry
+
+        def run():
+            registry.clear()
+            return {"cleared": True}
+        return self._on_main(run)
+
+    def cmd_connect(self, params):
+        """Dial a peer (reference ``connect?peer=host&port=N``)."""
+        peer = params.get("peer", [None])[0]
+        if peer is None:
+            return {"status": "ERROR", "detail": "missing peer param"}
+        try:
+            port = int(params.get("port", ["11625"])[0])
+        except ValueError:
+            return {"status": "ERROR", "detail": "bad port param"}
+        driver = getattr(self.app, "tcp_driver", None)
+        if driver is None:
+            return {"status": "ERROR",
+                    "detail": "node has no TCP transport attached"}
+
+        def run():
+            driver.connect(peer, port)
+            return {"connecting": f"{peer}:{port}"}
+        return self._on_main(run)
+
+    def cmd_sorobaninfo(self, params):
+        """Current soroban network settings (reference
+        ``sorobaninfo``)."""
+        import dataclasses
+
+        def run():
+            return dataclasses.asdict(self.app.lm.soroban_config)
+        return self._on_main(run)
+
+    def cmd_dumpproposedsettings(self, params):
+        """The ConfigUpgradeSet this node's scheduled CONFIG vote
+        points at, decoded from ledger state (reference
+        ``dumpproposedsettings``)."""
+        def run():
+            from stellar_tpu.herder.upgrades import (
+                load_config_upgrade_set,
+            )
+            key = self.app.herder.upgrades.params.config_upgrade_set_key
+            if key is None:
+                return {"status": "no config upgrade scheduled"}
+            upgrade_set = load_config_upgrade_set(
+                key, self.app.lm.root.store.get)
+            if upgrade_set is None:
+                return {"status": "scheduled set not published/loadable",
+                        "contentHash": key.contentHash.hex()}
+            return {"contentHash": key.contentHash.hex(),
+                    "updatedEntries": [repr(e) for e in
+                                       upgrade_set.updatedEntry]}
+        return self._on_main(run)
+
     def cmd_maintenance(self, params):
         count = int(params.get("count", ["50000"])[0])
 
@@ -282,6 +340,9 @@ class CommandHandler:
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,
         "droppeer": cmd_droppeer, "upgrades": cmd_upgrades,
         "generateload": cmd_generate_load,
+        "clearmetrics": cmd_clearmetrics, "connect": cmd_connect,
+        "sorobaninfo": cmd_sorobaninfo,
+        "dumpproposedsettings": cmd_dumpproposedsettings,
         "maintenance": cmd_maintenance,
         "getledgerentryraw": cmd_getledgerentryraw,
         "startsurveycollecting": cmd_start_survey_collecting,

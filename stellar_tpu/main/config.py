@@ -150,12 +150,14 @@ class Config:
         recommended = (n - 1) // 3
         safety = self.FAILURE_SAFETY
         if safety == -1:
-            # auto: small quorums legitimately compute 0 (the
-            # reference only hard-errors on an EXPLICIT 0)
             safety = recommended
-        elif safety == 0 and not self.UNSAFE_QUORUM and n > 1:
+        # a quorum that tolerates zero failures (explicit OR computed
+        # for <4 members) demands the operator's explicit UNSAFE_QUORUM
+        # acknowledgement, as in the reference
+        if safety == 0 and not self.UNSAFE_QUORUM and n > 1:
             raise ValueError(
-                "FAILURE_SAFETY=0 requires UNSAFE_QUORUM=true")
+                "FAILURE_SAFETY=0 (no failure tolerance) requires "
+                "UNSAFE_QUORUM=true")
         tolerated = n - qset.threshold
         if tolerated < safety and not self.UNSAFE_QUORUM and n > 1:
             raise ValueError(
@@ -273,8 +275,17 @@ def generate_quorum_set(entries: List[Dict]) -> SCPQuorumSet:
 
 def _parse_quorum_set(d: Dict) -> SCPQuorumSet:
     """{"THRESHOLD_PERCENT": 66, "VALIDATORS": [strkey...],
-    "INNER_SETS": [...]} -> SCPQuorumSet (reference quorum DSL)."""
+    "INNER_SETS": [...]} -> SCPQuorumSet (reference quorum DSL).
+    Unknown keys are rejected — TOML places every key after a
+    [QUORUM_SET] header inside the table, so a stray key here usually
+    means a misplaced top-level setting."""
     from stellar_tpu.crypto import strkey
+    unknown = set(d) - {"THRESHOLD_PERCENT", "VALIDATORS", "INNER_SETS"}
+    if unknown:
+        raise ValueError(
+            f"unknown keys in QUORUM_SET: {sorted(unknown)} — "
+            "top-level settings must appear BEFORE the [QUORUM_SET] "
+            "table in TOML")
     validators = [make_node_id(strkey.decode_account(v))
                   for v in d.get("VALIDATORS", [])]
     inner = [_parse_quorum_set(i) for i in d.get("INNER_SETS", [])]

@@ -120,6 +120,7 @@ class TCPDriver:
 
     def __init__(self, app, listen_port: int = 0):
         self.app = app
+        app.tcp_driver = self  # the 'connect' admin route dials here
         self.door = PeerDoor(app, listen_port)
         self.peers: list = []
         self.sel = selectors.DefaultSelector()
