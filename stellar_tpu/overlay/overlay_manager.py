@@ -310,6 +310,20 @@ class OverlayManager:
                     MessageType.DONT_HAVE,
                     DontHave(type=MessageType.SCP_QUORUMSET,
                              reqHash=msg.value)))
+        elif t == MessageType.ERROR_MSG:
+            # the remote announced why it is dropping us (reference
+            # Peer::recvError): log it and close our side
+            import logging
+            logging.getLogger("stellar_tpu.overlay").info(
+                "peer %s sent error: %s",
+                (peer.remote_node_id or b"").hex()[:16],
+                bytes(msg.value.msg).decode("utf-8", "replace"))
+            from stellar_tpu.utils.metrics import registry
+            registry.counter("overlay.recv.error-msg").inc()
+            peer.remote_drop_reason = bytes(msg.value.msg)
+            # close silently (reference recvError): never echo an
+            # ERROR_MSG back at a peer that is already tearing down
+            peer.drop("remote error", announce=False)
         elif t == MessageType.DONT_HAVE:
             self.maybe_process_ping_response(peer, msg.value.reqHash)
         elif t == MessageType.SCP_QUORUMSET:

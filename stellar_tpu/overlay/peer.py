@@ -339,8 +339,23 @@ class Peer:
     def is_authenticated(self) -> bool:
         return self.state == PEER_STATE.GOT_AUTH
 
-    def drop(self, reason: str = ""):
+    def drop(self, reason: str = "", announce: bool = True):
+        if self.state == PEER_STATE.CLOSING:
+            return  # already dropping (avoid send->fail->drop loops)
+        was_auth = self.state == PEER_STATE.GOT_AUTH
+        # CLOSING FIRST: a failing farewell send must not re-enter
+        # drop (dead socket -> send error -> drop recursion)
         self.state = PEER_STATE.CLOSING
+        if was_auth and reason and announce:
+            # tell the remote WHY before closing (reference
+            # sendErrorAndDrop), best effort only
+            try:
+                self._send_message(StellarMessage.make(
+                    MessageType.ERROR_MSG,
+                    ErrorMsg(code=ErrorCode.ERR_MISC,
+                             msg=reason.encode()[:100])))
+            except Exception:
+                pass
         if self.on_drop is not None:
             self.on_drop(self, reason)
         self.app.overlay.peer_dropped(self, reason)

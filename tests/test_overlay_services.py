@@ -236,3 +236,22 @@ def test_ping_latency_recorded():
             "overlay.connection.latency", {}).get("count", 0) >= 2, 60)
     peer = apps[0].overlay.peers[0]
     assert getattr(peer, "last_ping_ms", None) is not None
+
+
+def test_drop_announces_reason_to_remote():
+    """Dropping an authenticated peer sends ERROR_MSG first (reference
+    sendErrorAndDrop), and the remote records the announced reason."""
+    from stellar_tpu.simulation.simulation import Topologies
+    sim = Topologies.pair()
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(a.overlay.authenticated_count() == 1 for a in apps),
+        30)
+    a_peer = apps[0].overlay.peers[0]   # A's view of B
+    b_peer = apps[1].overlay.peers[0]   # B's view of A
+    a_peer.drop("operator said so")
+    sim.crank_all_nodes(10)
+    assert getattr(b_peer, "remote_drop_reason", None) == \
+        b"operator said so"
+    assert b_peer not in apps[1].overlay.peers
