@@ -183,13 +183,15 @@ class OverlayManager:
     # ---------------- broadcast (herder -> network) ----------------
 
     def _flood(self, msg, from_peer=None):
-        raw_hash = sha256(to_bytes(StellarMessage, msg))
+        # serialize ONCE for hashing AND every peer's framing
+        msg_bytes = to_bytes(StellarMessage, msg)
+        raw_hash = sha256(msg_bytes)
         self.floodgate.add_record(raw_hash, from_peer,
                                   self.app.herder.lm.ledger_seq)
         skip = self.floodgate.peers_to_skip(raw_hash)
         for p in list(self.peers):
             if id(p) not in skip:
-                p.send(msg)
+                p.send(msg, msg_bytes)
 
     def broadcast_scp_envelope(self, envelope):
         self._flood(StellarMessage.make(MessageType.SCP_MESSAGE, envelope))

@@ -25,9 +25,7 @@ from stellar_tpu.xdr.overlay import (
     ErrorCode, Hello, MessageType, SendMoreExtended, StellarMessage,
 )
 from stellar_tpu.xdr.runtime import Packer, from_bytes, to_bytes
-from stellar_tpu.xdr.types import (
-    Curve25519Public, EnvelopeType, HmacSha256Mac,
-)
+from stellar_tpu.xdr.types import Curve25519Public, EnvelopeType
 
 __all__ = ["PeerAuth", "FlowControl", "Peer", "PEER_STATE"]
 
@@ -189,7 +187,7 @@ class Peer:
             am = from_bytes(AuthenticatedMessage, raw)
         except Exception:
             return self.drop("malformed frame")
-        self._recv_authenticated(am.value)
+        self._recv_authenticated(am.value, raw)
 
     # ---------------- handshake ----------------
 
@@ -218,19 +216,24 @@ class Peer:
 
     # ---------------- MAC framing ----------------
 
-    def _send_message(self, msg):
+    def _send_message(self, msg, msg_bytes: bytes = None):
+        """Frame + MAC + send. ``msg_bytes`` (the pre-packed
+        StellarMessage) lets broadcast fan-out serialize a message ONCE
+        for all peers; the wire layout is assembled by concatenation —
+        AuthenticatedMessage(v=0){sequence, message, mac} is exactly
+        uint32(0) || uhyper(seq) || message || mac(32), which the
+        framing test pins against the full XDR pack."""
+        if msg_bytes is None:
+            msg_bytes = to_bytes(StellarMessage, msg)
+        seq = self.send_seq
         mac = b"\x00" * 32
         if self.send_key is not None and msg.arm != MessageType.HELLO:
-            p = Packer()
-            p.pack_uhyper(self.send_seq)
-            StellarMessage.pack(p, msg)
-            mac = c25519.hmac_sha256(self.send_key, p.bytes())
-        am = AuthenticatedMessage.make(0, AuthenticatedMessageV0(
-            sequence=self.send_seq, message=msg,
-            mac=HmacSha256Mac(mac=mac)))
-        if self.send_key is not None and msg.arm != MessageType.HELLO:
+            mac = c25519.hmac_sha256(
+                self.send_key,
+                seq.to_bytes(8, "big") + msg_bytes)
             self.send_seq += 1
-        raw = to_bytes(AuthenticatedMessage, am)
+        raw = (b"\x00\x00\x00\x00" + seq.to_bytes(8, "big") +
+               msg_bytes + mac)
         if msg.arm in FLOOD_TYPES and self.state == PEER_STATE.GOT_AUTH:
             self.flow.note_sent(len(raw))
         sm = getattr(self.app.overlay, "survey_manager", None)
@@ -239,18 +242,20 @@ class Peer:
         self.last_write_time = self.app.clock.now()
         self.send_bytes(raw)
 
-    def _recv_authenticated(self, am: AuthenticatedMessageV0):
+    def _recv_authenticated(self, am: AuthenticatedMessageV0,
+                            raw: bytes):
         msg = am.message
         if msg.arm != MessageType.HELLO:
             if self.recv_key is None:
                 return self.drop("message before handshake")
             if am.sequence != self.recv_seq:
                 return self.drop("out-of-order sequence")
-            p = Packer()
-            p.pack_uhyper(am.sequence)
-            StellarMessage.pack(p, msg)
-            if not c25519.verify_hmac_sha256(self.recv_key, p.bytes(),
-                                             am.mac.mac):
+            # MAC input = uhyper(seq) || message — exactly the frame
+            # between the 4-byte union tag and the 32-byte trailing
+            # mac (from_bytes enforces canonical length), so no
+            # re-serialization is needed
+            if not c25519.verify_hmac_sha256(self.recv_key,
+                                             raw[4:-32], am.mac.mac):
                 return self.drop("bad MAC")
             self.recv_seq += 1
         self._recv_message(msg)
@@ -327,14 +332,17 @@ class Peer:
 
     # ---------------- outbound API ----------------
 
-    def send(self, msg):
-        """Queue-or-send respecting flow control for flood traffic."""
+    def send(self, msg, msg_bytes: bytes = None):
+        """Queue-or-send respecting flow control for flood traffic.
+        ``msg_bytes`` shares one serialization across broadcast."""
         if self.state != PEER_STATE.GOT_AUTH:
             return
+        if msg_bytes is None:
+            msg_bytes = to_bytes(StellarMessage, msg)
         if msg.arm in FLOOD_TYPES and not self.flow.can_send(
-                len(to_bytes(StellarMessage, msg)) + 44):
+                len(msg_bytes) + 44):
             return  # dropped under backpressure (reference load shedding)
-        self._send_message(msg)
+        self._send_message(msg, msg_bytes)
 
     def is_authenticated(self) -> bool:
         return self.state == PEER_STATE.GOT_AUTH

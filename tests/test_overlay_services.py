@@ -40,10 +40,10 @@ def test_pull_mode_relay_uses_adverts_and_demands():
         for p in app.overlay.peers:
             orig = p.send
 
-            def counted(msg, _orig=orig):
+            def counted(msg, msg_bytes=None, _orig=orig):
                 if msg.arm in counts:
                     counts[msg.arm] += 1
-                return _orig(msg)
+                return _orig(msg, msg_bytes)
             p.send = counted
     network_id = apps[0].config.network_id()
     tx = make_tx(a, (1 << 32) + 1, [payment_op(b, 5 * XLM)],
@@ -188,7 +188,7 @@ def test_peer_liveness_timeouts():
             self.dropped = None
             self.remote_node_id = b"\xfe" * 32
 
-        def send(self, msg):  # broadcast sink: silent peer
+        def send(self, msg, msg_bytes=None):  # broadcast sink
             pass
 
         def is_authenticated(self):
@@ -255,3 +255,23 @@ def test_drop_announces_reason_to_remote():
     assert getattr(b_peer, "remote_drop_reason", None) == \
         b"operator said so"
     assert b_peer not in apps[1].overlay.peers
+
+
+def test_hand_assembled_frame_matches_xdr_pack():
+    """The concatenation-framed AuthenticatedMessage must be byte-equal
+    to the full XDR pack (the fast path's correctness pin)."""
+    from stellar_tpu.xdr.overlay import (
+        AuthenticatedMessage, AuthenticatedMessageV0, HmacSha256Mac,
+        StellarMessage,
+    )
+    from stellar_tpu.xdr.runtime import to_bytes
+    msg = StellarMessage.make(MessageType.GET_SCP_STATE, 1234)
+    msg_bytes = to_bytes(StellarMessage, msg)
+    seq = 77
+    mac = bytes(range(32))
+    fast = (b"\x00\x00\x00\x00" + seq.to_bytes(8, "big") +
+            msg_bytes + mac)
+    slow = to_bytes(AuthenticatedMessage, AuthenticatedMessage.make(
+        0, AuthenticatedMessageV0(sequence=seq, message=msg,
+                                  mac=HmacSha256Mac(mac=mac))))
+    assert fast == slow
