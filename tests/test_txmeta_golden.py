@@ -62,15 +62,37 @@ def _close_with(lm, frames, close_time=1700000000):
         close_time=max(close_time, lcl.scpValue.closeTime + 5)))
 
 
+def _result_xdr_for_hash(tx_res) -> bytes:
+    """Deterministic TransactionResult bytes, including the fee-bump
+    shape (the inner tx hash is zeroed — frame context is gone here,
+    and determinism is all the golden needs)."""
+    from stellar_tpu.xdr.results import (
+        InnerTransactionResult, InnerTransactionResultPair,
+        TransactionResult,
+    )
+    from stellar_tpu.tx.transaction_frame import TxCode, tx_result
+    inner = getattr(tx_res, "inner_result", None)
+    if inner is None:
+        return to_bytes(TransactionResult, tx_res.to_xdr())
+    inner_ops = inner.op_results if inner.code in (
+        TxCode.txSUCCESS, TxCode.txFAILED) else None
+    ir = InnerTransactionResult(
+        feeCharged=0,
+        result=InnerTransactionResult._types[1].make(
+            inner.code, inner_ops),
+        ext=InnerTransactionResult._types[2].make(0))
+    pair = InnerTransactionResultPair(
+        transactionHash=b"\x00" * 32, result=ir)
+    return to_bytes(TransactionResult,
+                    tx_result(tx_res.code, pair, tx_res.fee_charged))
+
+
 def outcome_hash(close_results) -> str:
     """SHA-256 over every result + meta + header across the closes."""
     h = hashlib.sha256()
     for res in close_results:
         for tx_res in res.tx_results:
-            h.update(to_bytes(
-                __import__("stellar_tpu.xdr.results",
-                           fromlist=["TransactionResult"])
-                .TransactionResult, tx_res.to_xdr()))
+            h.update(_result_xdr_for_hash(tx_res))
         for meta in res.tx_metas:
             for change in meta.tx_changes_before:
                 h.update(to_bytes(LedgerEntryChange, change))
@@ -282,7 +304,80 @@ SOROBAN_SCENARIOS = {
     "soroban_counter": scenario_soroban_counter,
 }
 
+
+def scenario_claimable_and_feebump(version):
+    """Create + claim a claimable balance, then a fee-bump payment —
+    meta covers CB entries, sponsoring-id threading, and the fee-bump
+    outer/inner result shape."""
+    from tests.test_claimable_balances import (
+        claimant, create_cb_op, unconditional,
+    )
+    from tests.test_transaction_frame import make_feebump
+    from stellar_tpu.tx.ops.claimable_balances import (
+        claimable_balance_key,
+    )
+    from stellar_tpu.xdr.tx import (
+        ClaimClaimableBalanceOp, Operation, OperationBody, OperationType,
+    )
+    from stellar_tpu.xdr.types import NATIVE_ASSET
+    a, b = keypair("gm-cb-a"), keypair("gm-cb-b")
+    lm = _lm_with([(a, 1000 * XLM), (b, 1000 * XLM)], version)
+    net = lm.network_id
+    out = [_close_with(lm, [make_tx(
+        a, (1 << 32) + 1,
+        [create_cb_op(NATIVE_ASSET, 25 * XLM, [claimant(b)])],
+        network_id=net)])]
+    # deterministic balance id: find the created CB entry
+    from stellar_tpu.xdr.types import LedgerEntryType
+    cb_entry = next(
+        e for _, e in __import__(
+            "stellar_tpu.bucket.bucket_list_db",
+            fromlist=["SearchableBucketListSnapshot"])
+        .SearchableBucketListSnapshot.from_bucket_list(
+            lm.bucket_list).iter_live_entries()
+        if e.data.arm == LedgerEntryType.CLAIMABLE_BALANCE)
+    balance_id = cb_entry.data.value.balanceID
+    claim = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.CLAIM_CLAIMABLE_BALANCE,
+        ClaimClaimableBalanceOp(balanceID=balance_id)))
+    out.append(_close_with(lm, [make_tx(
+        b, (1 << 32) + 1, [claim], network_id=net)]))
+    # fee-bump payment: sponsor pays for a's zero-fee inner tx
+    inner = make_tx(a, (1 << 32) + 2, [payment_op(b, XLM)], fee=0,
+                    network_id=net)
+    import stellar_tpu.tx.tx_test_utils as ttu
+    fb = _feebump_for_net(b, 400, inner, net)
+    out.append(_close_with(lm, [fb]))
+    return out
+
+
+def _feebump_for_net(fee_source, outer_fee, inner_frame, network_id):
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
+    from stellar_tpu.xdr.tx import (
+        FeeBumpTransaction, FeeBumpTransactionEnvelope,
+        TransactionEnvelope, TransactionV1Envelope, _FeeBumpInner,
+        feebump_sig_payload, muxed_account,
+    )
+    from stellar_tpu.xdr.types import EnvelopeType
+    fb = FeeBumpTransaction(
+        feeSource=muxed_account(fee_source.public_key.raw),
+        fee=outer_fee,
+        innerTx=_FeeBumpInner.make(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=inner_frame.tx,
+                                  signatures=inner_frame.signatures)),
+        ext=FeeBumpTransaction._types[3].make(0))
+    h = sha256(feebump_sig_payload(network_id, fb))
+    env = TransactionEnvelope.make(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
+        FeeBumpTransactionEnvelope(
+            tx=fb, signatures=[fee_source.sign_decorated(h)]))
+    return FeeBumpTransactionFrame(network_id, env)
+
+
 SCENARIOS = {
+    "claimable_and_feebump": scenario_claimable_and_feebump,
     "payments": scenario_payments,
     "account_lifecycle": scenario_account_lifecycle,
     "trust_and_offers": scenario_trust_and_offers,
