@@ -60,6 +60,9 @@ class CloseLedgerResult:
     header_hash: bytes
     tx_results: List = field(default_factory=list)
     tx_metas: List = field(default_factory=list)
+    # canonical TransactionResultPair per applied tx (what history
+    # publishes and txSetResultHash commits to)
+    result_pairs: List = field(default_factory=list)
     applied_count: int = 0
     failed_count: int = 0
 
@@ -322,6 +325,7 @@ class LedgerManager:
                                    lcd.tx_set.xdr),
                 hot_archive=self.hot_archive)
 
+        result.result_pairs = result_pairs
         result.header = header
         result.header_hash = self._lcl_hash
 

@@ -182,9 +182,10 @@ class OverlayManager:
 
     # ---------------- broadcast (herder -> network) ----------------
 
-    def _flood(self, msg, from_peer=None):
+    def _flood(self, msg, from_peer=None, msg_bytes: bytes = None):
         # serialize ONCE for hashing AND every peer's framing
-        msg_bytes = to_bytes(StellarMessage, msg)
+        if msg_bytes is None:
+            msg_bytes = to_bytes(StellarMessage, msg)
         raw_hash = sha256(msg_bytes)
         self.floodgate.add_record(raw_hash, from_peer,
                                   self.app.herder.lm.ledger_seq)
@@ -230,11 +231,13 @@ class OverlayManager:
 
     # ---------------- inbound dispatch (peer -> node) ----------------
 
-    def recv_message(self, peer, msg):
+    def recv_message(self, peer, msg, msg_bytes: bytes = None):
+        if msg_bytes is None:
+            msg_bytes = to_bytes(StellarMessage, msg)
         t = msg.arm
         herder = self.app.herder
         if t == MessageType.TRANSACTION:
-            raw_hash = sha256(to_bytes(StellarMessage, msg))
+            raw_hash = sha256(msg_bytes)
             if self.floodgate.add_record(raw_hash, peer,
                                          herder.lm.ledger_seq):
                 from stellar_tpu.tx.transaction_frame import (
@@ -283,13 +286,14 @@ class OverlayManager:
                 except Exception:
                     continue
         elif t == MessageType.SCP_MESSAGE:
-            raw_hash = sha256(to_bytes(StellarMessage, msg))
+            raw_hash = sha256(msg_bytes)
             if self.floodgate.add_record(raw_hash, peer,
                                          herder.lm.ledger_seq):
                 from stellar_tpu.scp import EnvelopeState
                 if herder.recv_scp_envelope(msg.value) == \
                         EnvelopeState.VALID:
-                    self._flood(msg, from_peer=peer)
+                    self._flood(msg, from_peer=peer,
+                                msg_bytes=msg_bytes)
         elif t == MessageType.GENERALIZED_TX_SET:
             herder.recv_tx_set(TxSetXDRFrame(msg.value))
         elif t == MessageType.GET_TX_SET:

@@ -258,11 +258,13 @@ class Peer:
                                              raw[4:-32], am.mac.mac):
                 return self.drop("bad MAC")
             self.recv_seq += 1
-        self._recv_message(msg)
+        # msg bytes = frame minus 4B tag, 8B seq, 32B mac — shared
+        # downstream so flood hashing/re-broadcast never re-serializes
+        self._recv_message(msg, raw[12:-32])
 
     # ---------------- dispatch ----------------
 
-    def _recv_message(self, msg):
+    def _recv_message(self, msg, msg_bytes: bytes):
         t = msg.arm
         if t == MessageType.HELLO:
             return self._recv_hello(msg.value)
@@ -279,13 +281,13 @@ class Peer:
             return
         if t in FLOOD_TYPES:
             grant = self.flow.note_received(
-                len(to_bytes(StellarMessage, msg)) + 44)  # + frame header
+                len(msg_bytes) + 44)  # + frame header
             if grant:
                 self._send_message(StellarMessage.make(
                     MessageType.SEND_MORE_EXTENDED,
                     SendMoreExtended(numMessages=grant[0],
                                      numBytes=grant[1])))
-        self.app.overlay.recv_message(self, msg)
+        self.app.overlay.recv_message(self, msg, msg_bytes)
 
     def _recv_hello(self, hello: Hello):
         if self.state not in (PEER_STATE.CONNECTED,):

@@ -340,7 +340,8 @@ def test_multisig_med_threshold(env):
     assert res2.code == TxCode.txSUCCESS
 
 
-def make_feebump(fee_source, outer_fee, inner_frame):
+def make_feebump(fee_source, outer_fee, inner_frame,
+                 network_id=None):
     from stellar_tpu.crypto.sha import sha256
     from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
     from stellar_tpu.xdr.tx import (
@@ -349,6 +350,7 @@ def make_feebump(fee_source, outer_fee, inner_frame):
         muxed_account,
     )
     from stellar_tpu.xdr.types import EnvelopeType
+    network_id = TEST_NETWORK_ID if network_id is None else network_id
     fb = FeeBumpTransaction(
         feeSource=muxed_account(fee_source.public_key.raw),
         fee=outer_fee,
@@ -357,12 +359,12 @@ def make_feebump(fee_source, outer_fee, inner_frame):
             TransactionV1Envelope(tx=inner_frame.tx,
                                   signatures=inner_frame.signatures)),
         ext=FeeBumpTransaction._types[3].make(0))
-    h = sha256(feebump_sig_payload(TEST_NETWORK_ID, fb))
+    h = sha256(feebump_sig_payload(network_id, fb))
     env = TransactionEnvelope.make(
         EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
         FeeBumpTransactionEnvelope(tx=fb,
                                    signatures=[fee_source.sign_decorated(h)]))
-    return FeeBumpTransactionFrame(TEST_NETWORK_ID, env)
+    return FeeBumpTransactionFrame(network_id, env)
 
 
 def test_feebump_inner_zero_fee_applies(env):

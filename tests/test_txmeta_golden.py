@@ -62,37 +62,16 @@ def _close_with(lm, frames, close_time=1700000000):
         close_time=max(close_time, lcl.scpValue.closeTime + 5)))
 
 
-def _result_xdr_for_hash(tx_res) -> bytes:
-    """Deterministic TransactionResult bytes, including the fee-bump
-    shape (the inner tx hash is zeroed — frame context is gone here,
-    and determinism is all the golden needs)."""
-    from stellar_tpu.xdr.results import (
-        InnerTransactionResult, InnerTransactionResultPair,
-        TransactionResult,
-    )
-    from stellar_tpu.tx.transaction_frame import TxCode, tx_result
-    inner = getattr(tx_res, "inner_result", None)
-    if inner is None:
-        return to_bytes(TransactionResult, tx_res.to_xdr())
-    inner_ops = inner.op_results if inner.code in (
-        TxCode.txSUCCESS, TxCode.txFAILED) else None
-    ir = InnerTransactionResult(
-        feeCharged=0,
-        result=InnerTransactionResult._types[1].make(
-            inner.code, inner_ops),
-        ext=InnerTransactionResult._types[2].make(0))
-    pair = InnerTransactionResultPair(
-        transactionHash=b"\x00" * 32, result=ir)
-    return to_bytes(TransactionResult,
-                    tx_result(tx_res.code, pair, tx_res.fee_charged))
-
-
 def outcome_hash(close_results) -> str:
-    """SHA-256 over every result + meta + header across the closes."""
+    """SHA-256 over every result + meta + header across the closes.
+    Results hash as the CANONICAL TransactionResultPair bytes the
+    close computed (including fee-bump inner hashes) — exactly what
+    history publishes and txSetResultHash commits to."""
+    from stellar_tpu.xdr.results import TransactionResultPair
     h = hashlib.sha256()
     for res in close_results:
-        for tx_res in res.tx_results:
-            h.update(_result_xdr_for_hash(tx_res))
+        for pair in res.result_pairs:
+            h.update(to_bytes(TransactionResultPair, pair))
         for meta in res.tx_metas:
             for change in meta.tx_changes_before:
                 h.update(to_bytes(LedgerEntryChange, change))
@@ -309,13 +288,8 @@ def scenario_claimable_and_feebump(version):
     """Create + claim a claimable balance, then a fee-bump payment —
     meta covers CB entries, sponsoring-id threading, and the fee-bump
     outer/inner result shape."""
-    from tests.test_claimable_balances import (
-        claimant, create_cb_op, unconditional,
-    )
+    from tests.test_claimable_balances import claimant, create_cb_op
     from tests.test_transaction_frame import make_feebump
-    from stellar_tpu.tx.ops.claimable_balances import (
-        claimable_balance_key,
-    )
     from stellar_tpu.xdr.tx import (
         ClaimClaimableBalanceOp, Operation, OperationBody, OperationType,
     )
@@ -328,12 +302,12 @@ def scenario_claimable_and_feebump(version):
         [create_cb_op(NATIVE_ASSET, 25 * XLM, [claimant(b)])],
         network_id=net)])]
     # deterministic balance id: find the created CB entry
+    from stellar_tpu.bucket.bucket_list_db import (
+        SearchableBucketListSnapshot,
+    )
     from stellar_tpu.xdr.types import LedgerEntryType
     cb_entry = next(
-        e for _, e in __import__(
-            "stellar_tpu.bucket.bucket_list_db",
-            fromlist=["SearchableBucketListSnapshot"])
-        .SearchableBucketListSnapshot.from_bucket_list(
+        e for _, e in SearchableBucketListSnapshot.from_bucket_list(
             lm.bucket_list).iter_live_entries()
         if e.data.arm == LedgerEntryType.CLAIMABLE_BALANCE)
     balance_id = cb_entry.data.value.balanceID
@@ -345,35 +319,10 @@ def scenario_claimable_and_feebump(version):
     # fee-bump payment: sponsor pays for a's zero-fee inner tx
     inner = make_tx(a, (1 << 32) + 2, [payment_op(b, XLM)], fee=0,
                     network_id=net)
-    import stellar_tpu.tx.tx_test_utils as ttu
-    fb = _feebump_for_net(b, 400, inner, net)
+    fb = make_feebump(b, 400, inner, network_id=net)
     out.append(_close_with(lm, [fb]))
     return out
 
-
-def _feebump_for_net(fee_source, outer_fee, inner_frame, network_id):
-    from stellar_tpu.crypto.sha import sha256
-    from stellar_tpu.tx.transaction_frame import FeeBumpTransactionFrame
-    from stellar_tpu.xdr.tx import (
-        FeeBumpTransaction, FeeBumpTransactionEnvelope,
-        TransactionEnvelope, TransactionV1Envelope, _FeeBumpInner,
-        feebump_sig_payload, muxed_account,
-    )
-    from stellar_tpu.xdr.types import EnvelopeType
-    fb = FeeBumpTransaction(
-        feeSource=muxed_account(fee_source.public_key.raw),
-        fee=outer_fee,
-        innerTx=_FeeBumpInner.make(
-            EnvelopeType.ENVELOPE_TYPE_TX,
-            TransactionV1Envelope(tx=inner_frame.tx,
-                                  signatures=inner_frame.signatures)),
-        ext=FeeBumpTransaction._types[3].make(0))
-    h = sha256(feebump_sig_payload(network_id, fb))
-    env = TransactionEnvelope.make(
-        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP,
-        FeeBumpTransactionEnvelope(
-            tx=fb, signatures=[fee_source.sign_decorated(h)]))
-    return FeeBumpTransactionFrame(network_id, env)
 
 
 SCENARIOS = {
