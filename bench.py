@@ -128,6 +128,9 @@ def main():
             "unit": "ms", "vs_baseline": None,
             "error": "device unreachable: trivial dispatch did not "
                      "complete within 180s (TPU tunnel down?)",
+            "note": "not a kernel failure — even jit(x+1) never "
+                    "returned; the most recent on-device measurement "
+                    "is recorded in BENCH_r*.json",
         }))
         return 3
     from stellar_tpu.crypto.batch_verifier import BatchVerifier
