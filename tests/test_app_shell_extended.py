@@ -125,6 +125,20 @@ def test_query_server_and_admin_routes():
         assert app.herder.upgrades.params.base_fee == 321
         out = _http_get(admin.port, "upgrades?mode=clear")
         assert out["basefee"] is None
+        # sorobaninfo dumps the live network settings
+        out = _http_get(admin.port, "sorobaninfo")
+        assert out["ledger_max_tx_count"] >= 1
+        assert out["tx_max_instructions"] > 0
+        # dumpproposedsettings with nothing scheduled
+        out = _http_get(admin.port, "dumpproposedsettings")
+        assert out["status"] == "no config upgrade scheduled"
+        # clearmetrics resets the registry
+        assert _http_get(admin.port, "clearmetrics") == {"cleared": True}
+        # connect without a TCP transport is a clean structured error
+        out = _http_get(admin.port, "connect?peer=127.0.0.1&port=1")
+        assert out["status"] == "ERROR"
+        out = _http_get(admin.port, "connect?peer=h&port=abc")
+        assert out == {"status": "ERROR", "detail": "bad port param"}
     finally:
         stop.set()
         admin.stop()
