@@ -39,6 +39,19 @@ __all__ = ["HotArchiveBucket", "HotArchiveBucketList",
 
 STATE_ARCHIVAL_PROTOCOL_VERSION = 23
 
+# Hot-archive contents affect RestoreFootprint outcomes but are not yet
+# committed to the ledger header nor rebuilt by catchup — letting the
+# network reach this protocol would be consensus-divergent (a MINIMAL
+# catchup node gets an empty archive while replaying nodes have full
+# ones). Enforce the docstring's gate until header hash + catchup
+# reconstruction land; LEDGER_UPGRADE_VERSION past the current protocol
+# is independently rejected by Upgrades.max_protocol.
+from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION as _CUR
+assert STATE_ARCHIVAL_PROTOCOL_VERSION > _CUR, (
+    "state-archival gate must stay above the network protocol until "
+    "the hot-archive hash is in the ledger header and catchup rebuilds "
+    "the archive")
+
 
 def _entry_key_bytes(e) -> bytes:
     if e.arm == HBET.HOT_ARCHIVE_LIVE:

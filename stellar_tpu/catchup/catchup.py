@@ -312,36 +312,42 @@ class CatchupWork(WorkSequence):
         return State.FAILURE
 
     def _check_trusted(self):
-        """FAIL-CLOSED trust anchoring: the archive must cover the
-        newest pinned checkpoint at/below the target and match every
-        pinned hash in range — an archive that sidesteps the pins
-        (shorter chain, missing boundary headers) is refused, not
-        waved through (reference trusted-checkpoint verification)."""
+        """FAIL-CLOSED trust anchoring: the applied range must be
+        TOPPED by a pin. Header prev-hash links only constrain the
+        chain *below* a pinned header — nothing signs headers above the
+        newest applicable pin, so a target whose containing checkpoint
+        is unpinned would accept a forged-but-self-consistent suffix
+        on the archive's say-so (the reference takes the target hash
+        FROM the trusted file). ``_target()`` clamps unpinned targets
+        down to the newest pin at/below them; here the anchor header
+        must be present and match, and every pin inside the verified
+        window must match too."""
         target = self._target()
-        applicable = [s for s in self.trusted_hashes if s <= target]
-        if not applicable:
+        anchor = checkpoint_containing(target)
+        if anchor not in self.trusted_hashes:
+            applicable = [s for s in self.trusted_hashes if s <= target]
+            if not applicable:
+                return self._refuse(
+                    f"no pinned checkpoint at/below target {target} — "
+                    "anchors do not cover this catchup")
+            # defensive: _target() clamps to max(applicable), which is
+            # its own containing checkpoint only for boundary pins; a
+            # malformed (non-boundary) pin set must not fail open
             return self._refuse(
-                f"no pinned checkpoint at/below target {target} — "
-                "anchors do not cover this catchup")
-        need = max(applicable)
-        if target > max(self.trusted_hashes):
-            # everything past the newest pin would rest on the
-            # archive's say-so; anchored catchup must not outrun its
-            # anchors (the reference takes the target hash FROM the
-            # trusted file)
-            return self._refuse(
-                f"target {target} is beyond the newest pinned "
-                f"checkpoint {max(self.trusted_hashes)}")
+                f"checkpoint {anchor} containing target {target} has "
+                "no pinned hash — ledgers above the newest applicable "
+                "pin would be unanchored")
         by_seq = {he.header.ledgerSeq: he
                   for he in self.verified_headers}
-        if need not in by_seq:
+        if anchor not in by_seq:
             return self._refuse(
-                f"archive does not contain pinned checkpoint {need}")
-        for seq in applicable:
+                f"archive does not contain pinned checkpoint {anchor}")
+        for seq, want in self.trusted_hashes.items():
             he = by_seq.get(seq)
             if he is None:
-                continue  # below the verified window; `need` anchors it
-            if he.hash.hex() != self.trusted_hashes[seq]:
+                continue  # outside the verified window; `anchor` tops
+                # everything applied (prev-hash links reach down to it)
+            if he.hash.hex() != want:
                 return self._refuse(
                     f"checkpoint {seq} does not match the trusted hash")
         return State.SUCCESS
@@ -355,8 +361,21 @@ class CatchupWork(WorkSequence):
 
     def _target(self) -> int:
         if self.config.to_ledger > 0:
-            return min(self.config.to_ledger, self.has.current_ledger)
-        return self.has.current_ledger
+            target = min(self.config.to_ledger,
+                         self.has.current_ledger)
+        else:
+            target = self.has.current_ledger
+        if self.trusted_hashes and \
+                checkpoint_containing(target) not in self.trusted_hashes:
+            # Anchored catchup must not outrun its anchors: when the
+            # checkpoint containing the target is unpinned, clamp down
+            # to the newest pin at/below it so every applied ledger
+            # sits under a hash-checked header. (No pin at/below at
+            # all -> leave as-is; _check_trusted refuses.)
+            applicable = [s for s in self.trusted_hashes if s <= target]
+            if applicable:
+                target = max(applicable)
+        return target
 
     def _adopt_buckets_at(self, checkpoint: int,
                           has: "HistoryArchiveState") -> bool:

@@ -96,7 +96,14 @@ class Upgrades:
     def __init__(self, params: Optional[UpgradeParameters] = None,
                  max_protocol: int = CURRENT_LEDGER_PROTOCOL_VERSION):
         self.params = params or UpgradeParameters()
-        self.max_protocol = max_protocol
+        # the state-archival protocol is unreachable until the hot
+        # archive is header-committed and catchup-reconstructible
+        # (bucket/hot_archive.py gate) — clamp even explicit overrides
+        from stellar_tpu.bucket.hot_archive import (
+            STATE_ARCHIVAL_PROTOCOL_VERSION,
+        )
+        self.max_protocol = min(max_protocol,
+                                STATE_ARCHIVAL_PROTOCOL_VERSION - 1)
 
     # ---------------- validation ----------------
 

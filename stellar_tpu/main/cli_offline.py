@@ -219,6 +219,23 @@ def cmd_new_hist(args) -> int:
     if lm is None:
         # fresh genesis state (reference initializes archives pre-run)
         lm = LedgerManager(cfg.network_id())
+    else:
+        from stellar_tpu.history.history_manager import (
+            is_last_in_checkpoint,
+        )
+        if lm.ledger_seq > 1 and not is_last_in_checkpoint(lm.ledger_seq):
+            # a root HAS at a mid-checkpoint LCL poisons catchup: its
+            # current_ledger's header exists in no published checkpoint
+            # category file, so a default-target/MINIMAL catchup
+            # against the archive cannot adopt state there — and the
+            # bucket snapshot is only correct at THIS ledger, so it
+            # cannot be re-pointed at the last boundary either
+            print(
+                f"LCL {lm.ledger_seq} is mid-checkpoint; new-hist "
+                "needs a checkpoint-boundary LCL (run the node to the "
+                "next boundary, or init fresh archives pre-run)",
+                file=sys.stderr)
+            return 1
     out = []
     for spec in cfg.HISTORY_ARCHIVES:
         archive = archive_from_config(spec)

@@ -1,6 +1,14 @@
 """Maintainer: GC of historical rows the node no longer needs
 (reference ``src/main/Maintainer.cpp`` — deletes scphistory/txhistory
-below the publish cursor on a timer or via the 'maintenance' command)."""
+below the publish cursor on a timer or via the 'maintenance' command).
+
+The GC floor is the publish-queue minimum — the first ledger of the
+oldest checkpoint not yet present in every configured archive,
+including the in-progress checkpoint — NOT the checkpoint containing
+the LCL: after an archive outage longer than the maintenance window
+the unpublished checkpoints' rows must survive so ``publish`` can
+rebuild and drain them (reference bounds on
+``getMinLedgerQueuedToPublish``)."""
 
 from __future__ import annotations
 
@@ -10,22 +18,47 @@ __all__ = ["Maintainer"]
 class Maintainer:
     def __init__(self, app):
         self.app = app
+        # published checkpoints are append-only: remember the oldest
+        # candidate so the archive probe doesn't rescan from genesis
+        # every maintenance tick
+        self._probe_from = 63
+
+    def _publish_floor(self):
+        """First ledger of the oldest checkpoint still owed to some
+        configured archive (None = no publishing duties)."""
+        history = getattr(self.app, "history", None)
+        if history is None:
+            return None
+        archives = getattr(history, "archives", [])
+        if not archives:
+            return None
+        from stellar_tpu.history.history_manager import (
+            _layered_path, checkpoint_containing, first_in_checkpoint,
+        )
+        cur = checkpoint_containing(self.app.lm.ledger_seq)
+        cp = self._probe_from
+        while cp < cur:
+            if any(a.get(_layered_path("ledger", cp, "xdr.gz")) is None
+                   for a in archives):
+                break
+            cp += 64
+            self._probe_from = cp
+        # cp is the oldest unpublished checkpoint; `cur` itself is
+        # in-progress and always unpublished, so the floor never
+        # passes the current checkpoint's first ledger
+        return first_in_checkpoint(min(cp, cur))
 
     def perform_maintenance(self, count: int) -> dict:
-        """Delete history rows older than LCL - count (bounded by what
-        has been published, when a history manager exists)."""
+        """Delete history rows older than LCL - count (bounded below
+        the publish queue, when a history manager exists)."""
         db = getattr(self.app, "database", None)
         if db is None:
             return {"deleted": 0, "reason": "no database"}
         keep_from = max(1, self.app.lm.ledger_seq - count)
-        history = getattr(self.app, "history", None)
-        if history is not None:
+        floor = self._publish_floor()
+        if floor is not None:
             # never GC rows that still await publishing
-            from stellar_tpu.history.history_manager import (
-                checkpoint_containing,
-            )
-            keep_from = min(keep_from,
-                            checkpoint_containing(self.app.lm.ledger_seq))
+            keep_from = min(keep_from, floor)
         deleted = 0
         with db.conn:
             for table in ("scphistory", "txhistory", "txsets"):

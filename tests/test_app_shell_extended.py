@@ -167,6 +167,54 @@ def test_maintainer_gc(tmp_path):
     assert rows == [(99_999,)]
 
 
+def test_maintainer_gc_bounded_by_publish_queue(tmp_path):
+    """Archive outage: rows of complete-but-unpublished checkpoints
+    survive maintenance so ``publish`` can still rebuild them
+    (advisor r2 medium — bound on the publish-queue min, not LCL)."""
+    from stellar_tpu.database import Database
+    from stellar_tpu.history.history_manager import (
+        FileArchive, _layered_path,
+    )
+    from stellar_tpu.main.maintainer import Maintainer
+
+    class FakeApp:
+        pass
+    app = FakeApp()
+    app.database = Database(str(tmp_path / "m.db"))
+    archive = FileArchive(str(tmp_path / "arch"))
+
+    class History:
+        archives = [archive]
+    app.history = History()
+
+    class LM:
+        ledger_seq = 200  # current checkpoint = 255, in progress
+    app.lm = LM()
+    for seq in (10, 70, 130, 199):
+        app.database.store_scp_history(seq, [(b"n" * 32, b"e")])
+    # checkpoint 63 published; 127 and 191 owed to the archive
+    archive.put(_layered_path("ledger", 63, "xdr.gz"), b"x")
+
+    out = Maintainer(app).perform_maintenance(10)
+    # raw keep_from would be 190, but the publish floor is ledger 64
+    # (first of unpublished checkpoint 127)
+    assert out["below"] == 64
+    rows = sorted(r[0] for r in app.database.conn.execute(
+        "SELECT ledgerseq FROM scphistory"))
+    assert rows == [70, 130, 199]
+
+    # archive drains -> the floor advances past it
+    archive.put(_layered_path("ledger", 127, "xdr.gz"), b"x")
+    archive.put(_layered_path("ledger", 191, "xdr.gz"), b"x")
+    out = Maintainer(app).perform_maintenance(10)
+    # floor is now the in-progress checkpoint's first ledger (192),
+    # tighter than LCL - count (190) -> 190 wins
+    assert out["below"] == 190
+    rows = sorted(r[0] for r in app.database.conn.execute(
+        "SELECT ledgerseq FROM scphistory"))
+    assert rows == [199]
+
+
 def test_cli_new_db_and_sign_transaction(tmp_path):
     from stellar_tpu.main.cli import main
     conf = tmp_path / "node.toml"

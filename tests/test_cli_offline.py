@@ -113,8 +113,31 @@ def test_publish_queue_then_publish_then_catchup(persisted_node, tmp_path,
     # after publish: queue drained
     assert cli_offline.cmd_print_publish_queue(_args(conf)) == 0
     assert _out(capsys)["queue"] == []
-    # archive root HAS exists once new-hist runs (publish alone wrote
-    # category files; LCL 71 != 63 so no HAS)
+    # new-hist at the mid-checkpoint LCL (71) REFUSES: a root HAS
+    # there would target a header no published category file contains
+    # (advisor r2 low)
+    assert cli_offline.cmd_new_hist(_args(conf)) == 1
+    capsys.readouterr()
+
+    # drive the node to the checkpoint boundary 127, publish, retry
+    from stellar_tpu.bucket.bucket_manager import BucketManager
+    from stellar_tpu.database import NodePersistence
+    cfg0 = Config.from_toml(str(conf))
+    db2 = Database(cfg0.DATABASE)
+    pers2 = NodePersistence(
+        db2, BucketManager(str(conf.parent / "buckets")))
+    lm0 = LedgerManager.from_persistence(cfg0.network_id(), pers2)
+    while lm0.ledger_seq < 127:
+        lcl = lm0.last_closed_header
+        txset, _ = make_tx_set_from_transactions(
+            [], lcl, lm0.last_closed_hash)
+        lm0.close_ledger(LedgerCloseData(
+            ledger_seq=lcl.ledgerSeq + 1, tx_set=txset,
+            close_time=lcl.scpValue.closeTime + 5))
+    db2.close()
+    seq = 127
+    assert cli_offline.cmd_publish(_args(conf)) == 0
+    assert _out(capsys)["published_checkpoints"] == [127]
     assert cli_offline.cmd_new_hist(_args(conf)) == 0
     assert _out(capsys)["initialized"][0]["current_ledger"] == seq
     assert cli_offline.cmd_report_last_history_checkpoint(

@@ -457,6 +457,52 @@ def test_trusted_checkpoint_hashes_anchor_catchup(tmp_path):
     assert lm2.ledger_seq < 63
 
 
+def test_trusted_anchors_top_the_applied_range(tmp_path):
+    """A mid-checkpoint target between pins is anchored by the pin
+    ABOVE it (prev-hash links reach down from the pinned header), and
+    a target with no pin above is CLAMPED down to the newest pin —
+    never applied on the archive's say-so (advisor r2 high: anchoring
+    must not fail open for targets below the newest pin)."""
+    lm, archive, hm = build_chain(140, str(tmp_path / "arch"))
+    from stellar_tpu.history.history_manager import HistoryManager
+    pins = {}
+    for cp in (63, 127):
+        headers, _, _ = HistoryManager.get_checkpoint(archive, cp)
+        he = next(h for h in headers if h.header.ledgerSeq == cp)
+        pins[cp] = he.hash.hex()
+
+    def run(trusted, to_ledger):
+        a, b = keypair("alice"), keypair("bob")
+        root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+        lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+        ws = WorkScheduler(VirtualClock(VIRTUAL_TIME))
+        work = CatchupWork(
+            lm2, archive,
+            CatchupConfiguration(to_ledger,
+                                 CatchupConfiguration.COMPLETE),
+            trusted_hashes=trusted)
+        ws.schedule(work)
+        ws.run_until_done(600)
+        return work, lm2
+
+    # target 100 with pins {63,127}: anchored by 127 (the containing
+    # checkpoint), applied in full
+    work, lm2 = run(dict(pins), 100)
+    assert work.state == State.SUCCESS and lm2.ledger_seq == 100
+
+    # target 100 with only pin 63: ledgers 64..100 would rest on the
+    # archive alone -> clamp to 63, NOT applied unanchored
+    work, lm2 = run({63: pins[63]}, 100)
+    assert work.state == State.SUCCESS
+    assert lm2.ledger_seq == 63
+
+    # forged pin above the target refuses even though the pin below
+    # matches (every pin in the verified window must match)
+    work, lm2 = run({63: pins[63], 127: "00" * 32}, 100)
+    assert work.state == State.FAILURE
+    assert lm2.ledger_seq < 64
+
+
 def test_trusted_anchors_fail_closed(tmp_path):
     """An archive that sidesteps every pin (shorter chain / anchors
     above its tip) is REFUSED, not waved through, and the refusal is
