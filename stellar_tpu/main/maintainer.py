@@ -38,8 +38,14 @@ class Maintainer:
         cur = checkpoint_containing(self.app.lm.ledger_seq)
         cp = self._probe_from
         while cp < cur:
-            if any(a.get(_layered_path("ledger", cp, "xdr.gz")) is None
-                   for a in archives):
+            # a checkpoint counts as published only when EVERY category
+            # file landed in EVERY archive: publish writes them in
+            # order (ledger, transactions, results), so probing just
+            # the first would mark a crash-interrupted publish done and
+            # GC the rows needed to finish it
+            if any(a.get(_layered_path(cat, cp, "xdr.gz")) is None
+                   for a in archives
+                   for cat in ("ledger", "transactions", "results")):
                 break
             cp += 64
             self._probe_from = cp

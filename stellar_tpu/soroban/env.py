@@ -1,0 +1,596 @@
+"""Host environment for wasm contracts: the 64-bit tagged-``Val`` ABI
+and the host-function import table — the layer soroban-env-host puts
+between wasmi and the ledger (reference boundary:
+``src/rust/src/lib.rs:61-83`` links soroban-env-host, which defines the
+Val encoding and the env interface; the crate itself is external to the
+reference tree, so the import names here are this framework's own —
+the TAG layout and semantics mirror the published soroban-env-common
+value scheme so the conversion logic is protocol-shaped).
+
+A ``Val`` is a u64: low 8 bits tag, high 56 bits body. Small immediates
+(u32/i32, small u64/i64, short symbols, bool/void) travel inline;
+larger values live in a per-invocation object table addressed by
+handle. Handles never cross contract frames: cross-contract calls
+convert through SCVal at the boundary, so a callee cannot forge a
+caller's handles (same isolation the reference host enforces).
+
+Host imports use single-letter module names grouped by area (context
+"x", ledger "l", vec "v", map "m", buf "b", int "i", address "a",
+call "c", crypto "d") — the grouping soroban-env uses for its export
+names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from stellar_tpu.crypto.sha import sha256
+from stellar_tpu.soroban.wasm import Trap
+from stellar_tpu.xdr.contract import (
+    SCAddress, SCMapEntry, SCVal, SCValType,
+)
+from stellar_tpu.xdr.runtime import to_bytes
+
+__all__ = ["ValConverter", "make_imports", "EnvError",
+           "TAG_FALSE", "TAG_TRUE", "TAG_VOID", "TAG_U32", "TAG_I32",
+           "TAG_U64_SMALL", "TAG_I64_SMALL", "TAG_SYMBOL_SMALL",
+           "TAG_U64_OBJ", "TAG_I64_OBJ", "TAG_U128_OBJ", "TAG_I128_OBJ",
+           "TAG_BYTES_OBJ", "TAG_STRING_OBJ", "TAG_SYMBOL_OBJ",
+           "TAG_VEC_OBJ", "TAG_MAP_OBJ", "TAG_ADDRESS_OBJ",
+           "sym_to_small", "small_to_sym"]
+
+T = SCValType
+
+# Tag values mirror soroban-env-common's Tag enum
+TAG_FALSE = 0
+TAG_TRUE = 1
+TAG_VOID = 2
+TAG_ERROR = 3
+TAG_U32 = 4
+TAG_I32 = 5
+TAG_U64_SMALL = 6
+TAG_I64_SMALL = 7
+TAG_TIMEPOINT_SMALL = 8
+TAG_DURATION_SMALL = 9
+TAG_U128_SMALL = 10
+TAG_I128_SMALL = 11
+TAG_SYMBOL_SMALL = 14
+TAG_U64_OBJ = 64
+TAG_I64_OBJ = 65
+TAG_TIMEPOINT_OBJ = 66
+TAG_DURATION_OBJ = 67
+TAG_U128_OBJ = 68
+TAG_I128_OBJ = 69
+TAG_BYTES_OBJ = 72
+TAG_STRING_OBJ = 73
+TAG_SYMBOL_OBJ = 74
+TAG_VEC_OBJ = 75
+TAG_MAP_OBJ = 76
+TAG_ADDRESS_OBJ = 77
+
+_M56 = (1 << 56) - 1
+_M64 = (1 << 64) - 1
+_SMALL_MAX_U = _M56                      # unsigned small body range
+_SMALL_MIN_I = -(1 << 55)
+_SMALL_MAX_I = (1 << 55) - 1
+
+_SYM_CHARS = "_0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ" \
+    "abcdefghijklmnopqrstuvwxyz"
+_SYM_CODE = {c: i + 1 for i, c in enumerate(_SYM_CHARS)}
+_SYM_CHAR = {i + 1: c for i, c in enumerate(_SYM_CHARS)}
+
+
+class EnvError(Trap):
+    """Host-env failure surfaced to wasm as a trap."""
+
+
+def _tag(val: int) -> int:
+    return val & 0xFF
+
+
+def _body(val: int) -> int:
+    return (val >> 8) & _M56
+
+
+def _make(tag: int, body: int = 0) -> int:
+    return ((body & _M56) << 8) | tag
+
+
+def sym_to_small(s: bytes) -> int:
+    """Pack a <=9-char symbol into a SymbolSmall body (6 bits/char)."""
+    if len(s) > 9:
+        raise ValueError("symbol too long for small form")
+    body = 0
+    for ch in s.decode("ascii"):
+        code = _SYM_CODE.get(ch)
+        if code is None:
+            raise ValueError(f"bad symbol char {ch!r}")
+        body = (body << 6) | code
+    return _make(TAG_SYMBOL_SMALL, body)
+
+
+def small_to_sym(val: int) -> bytes:
+    body = _body(val)
+    chars = []
+    while body:
+        chars.append(_SYM_CHAR[body & 0x3F])
+        body >>= 6
+    return "".join(reversed(chars)).encode()
+
+
+class ValConverter:
+    """SCVal <-> Val conversion plus the per-invocation object table."""
+
+    def __init__(self, charge: Callable[[int, int], None]):
+        # charge(cpu, mem) — wired to the host budget
+        self.objs: List[Tuple[int, object]] = []  # (tag, payload)
+        self.charge = charge
+
+    # ---------------- object table ----------------
+
+    def new_obj(self, tag: int, payload) -> int:
+        self.charge(50, 16)
+        self.objs.append((tag, payload))
+        return _make(tag, len(self.objs) - 1)
+
+    def obj(self, val: int, want_tag: int):
+        tag = _tag(val)
+        if tag != want_tag:
+            raise EnvError(f"expected tag {want_tag}, got {tag}")
+        idx = _body(val)
+        if idx >= len(self.objs):
+            raise EnvError("bad object handle")
+        otag, payload = self.objs[idx]
+        if otag != want_tag:
+            raise EnvError("object tag mismatch")
+        return payload
+
+    # ---------------- SCVal -> Val ----------------
+
+    def from_scval(self, v: "SCVal.Value") -> int:
+        arm = v.arm
+        if arm == T.SCV_BOOL:
+            return _make(TAG_TRUE if v.value else TAG_FALSE)
+        if arm == T.SCV_VOID:
+            return _make(TAG_VOID)
+        if arm == T.SCV_U32:
+            return _make(TAG_U32, v.value & 0xFFFFFFFF)
+        if arm == T.SCV_I32:
+            return _make(TAG_I32, v.value & 0xFFFFFFFF)
+        if arm == T.SCV_U64:
+            if v.value <= _SMALL_MAX_U:
+                return _make(TAG_U64_SMALL, v.value)
+            return self.new_obj(TAG_U64_OBJ, v.value)
+        if arm == T.SCV_I64:
+            if _SMALL_MIN_I <= v.value <= _SMALL_MAX_I:
+                return _make(TAG_I64_SMALL, v.value)
+            return self.new_obj(TAG_I64_OBJ, v.value)
+        if arm == T.SCV_TIMEPOINT:
+            if v.value <= _SMALL_MAX_U:
+                return _make(TAG_TIMEPOINT_SMALL, v.value)
+            return self.new_obj(TAG_TIMEPOINT_OBJ, v.value)
+        if arm == T.SCV_DURATION:
+            if v.value <= _SMALL_MAX_U:
+                return _make(TAG_DURATION_SMALL, v.value)
+            return self.new_obj(TAG_DURATION_OBJ, v.value)
+        if arm == T.SCV_U128:
+            n = (v.value.hi << 64) | v.value.lo
+            if n <= _SMALL_MAX_U:
+                return _make(TAG_U128_SMALL, n)
+            return self.new_obj(TAG_U128_OBJ, n)
+        if arm == T.SCV_I128:
+            n = (v.value.hi << 64) | v.value.lo
+            if n >= 1 << 127:
+                n -= 1 << 128
+            if _SMALL_MIN_I <= n <= _SMALL_MAX_I:
+                return _make(TAG_I128_SMALL, n)
+            return self.new_obj(TAG_I128_OBJ, n)
+        if arm == T.SCV_SYMBOL:
+            if len(v.value) <= 9:
+                try:
+                    return sym_to_small(v.value)
+                except ValueError:
+                    pass
+            return self.new_obj(TAG_SYMBOL_OBJ, bytes(v.value))
+        if arm == T.SCV_BYTES:
+            return self.new_obj(TAG_BYTES_OBJ, bytes(v.value))
+        if arm == T.SCV_STRING:
+            return self.new_obj(TAG_STRING_OBJ, bytes(v.value))
+        if arm == T.SCV_VEC:
+            items = [self.from_scval(e) for e in (v.value or ())]
+            return self.new_obj(TAG_VEC_OBJ, items)
+        if arm == T.SCV_MAP:
+            pairs = [(self.from_scval(e.key), self.from_scval(e.val))
+                     for e in (v.value or ())]
+            return self.new_obj(TAG_MAP_OBJ, pairs)
+        if arm == T.SCV_ADDRESS:
+            return self.new_obj(TAG_ADDRESS_OBJ, v.value)
+        raise EnvError(f"SCVal arm {arm} has no Val form")
+
+    # ---------------- Val -> SCVal ----------------
+
+    def to_scval(self, val: int) -> "SCVal.Value":
+        val &= _M64
+        tag = _tag(val)
+        body = _body(val)
+        if tag == TAG_FALSE:
+            return SCVal.make(T.SCV_BOOL, False)
+        if tag == TAG_TRUE:
+            return SCVal.make(T.SCV_BOOL, True)
+        if tag == TAG_VOID:
+            return SCVal.make(T.SCV_VOID)
+        if tag == TAG_U32:
+            return SCVal.make(T.SCV_U32, body & 0xFFFFFFFF)
+        if tag == TAG_I32:
+            b = body & 0xFFFFFFFF
+            return SCVal.make(T.SCV_I32,
+                              b - (1 << 32) if b >> 31 else b)
+        if tag == TAG_U64_SMALL:
+            return SCVal.make(T.SCV_U64, body)
+        if tag == TAG_I64_SMALL:
+            return SCVal.make(
+                T.SCV_I64, body - (1 << 56) if body >> 55 else body)
+        if tag == TAG_TIMEPOINT_SMALL:
+            return SCVal.make(T.SCV_TIMEPOINT, body)
+        if tag == TAG_DURATION_SMALL:
+            return SCVal.make(T.SCV_DURATION, body)
+        if tag == TAG_U128_SMALL:
+            return self._u128(body)
+        if tag == TAG_I128_SMALL:
+            return self._i128(body - (1 << 56) if body >> 55 else body)
+        if tag == TAG_SYMBOL_SMALL:
+            return SCVal.make(T.SCV_SYMBOL, small_to_sym(val))
+        if tag == TAG_U64_OBJ:
+            return SCVal.make(T.SCV_U64, self.obj(val, tag))
+        if tag == TAG_I64_OBJ:
+            return SCVal.make(T.SCV_I64, self.obj(val, tag))
+        if tag == TAG_TIMEPOINT_OBJ:
+            return SCVal.make(T.SCV_TIMEPOINT, self.obj(val, tag))
+        if tag == TAG_DURATION_OBJ:
+            return SCVal.make(T.SCV_DURATION, self.obj(val, tag))
+        if tag == TAG_U128_OBJ:
+            return self._u128(self.obj(val, tag))
+        if tag == TAG_I128_OBJ:
+            return self._i128(self.obj(val, tag))
+        if tag == TAG_BYTES_OBJ:
+            return SCVal.make(T.SCV_BYTES, self.obj(val, tag))
+        if tag == TAG_STRING_OBJ:
+            return SCVal.make(T.SCV_STRING, self.obj(val, tag))
+        if tag == TAG_SYMBOL_OBJ:
+            return SCVal.make(T.SCV_SYMBOL, self.obj(val, tag))
+        if tag == TAG_VEC_OBJ:
+            return SCVal.make(T.SCV_VEC, [
+                self.to_scval(e) for e in self.obj(val, tag)])
+        if tag == TAG_MAP_OBJ:
+            return SCVal.make(T.SCV_MAP, [
+                SCMapEntry(key=self.to_scval(k), val=self.to_scval(w))
+                for k, w in self.obj(val, tag)])
+        if tag == TAG_ADDRESS_OBJ:
+            return SCVal.make(T.SCV_ADDRESS, self.obj(val, tag))
+        raise EnvError(f"bad Val tag {tag}")
+
+    @staticmethod
+    def _u128(n: int):
+        from stellar_tpu.xdr.contract import UInt128Parts
+        return SCVal.make(T.SCV_U128, UInt128Parts(
+            hi=(n >> 64) & _M64, lo=n & _M64))
+
+    @staticmethod
+    def _i128(n: int):
+        from stellar_tpu.xdr.contract import Int128Parts
+        u = n & ((1 << 128) - 1)
+        hi = (u >> 64) & _M64
+        if hi >= 1 << 63:
+            hi -= 1 << 64  # Int128Parts.hi is a signed int64
+        return SCVal.make(T.SCV_I128, Int128Parts(hi=hi, lo=u & _M64))
+
+
+# ---------------------------------------------------------------------------
+# Host-function imports
+# ---------------------------------------------------------------------------
+
+_DUR_BY_CODE = {0: "temporary", 1: "persistent", 2: "instance"}
+
+
+def make_imports(env) -> Dict[Tuple[str, str], Callable]:
+    """The import table for one contract frame. ``env`` is a
+    ``WasmContractEnv`` (defined in host.py) carrying the host, the
+    running contract's address, and the ValConverter."""
+    cv: ValConverter = env.cv
+
+    def _u32_arg(val: int, what: str) -> int:
+        if _tag(val) != TAG_U32:
+            raise EnvError(f"{what}: expected U32 val")
+        return _body(val) & 0xFFFFFFFF
+
+    # ---- context ----
+
+    def log(inst, val):
+        env.host.budget.charge(100, 0)
+        return _make(TAG_VOID)
+
+    def ledger_sequence(inst):
+        return _make(TAG_U32, env.host.ledger_seq)
+
+    def ledger_timestamp(inst):
+        ts = 0
+        hdr = getattr(env.host, "ledger_header", None)
+        if hdr is not None:
+            ts = hdr.scpValue.closeTime
+        return _make(TAG_U64_SMALL, ts) if ts <= _SMALL_MAX_U \
+            else cv.new_obj(TAG_U64_OBJ, ts)
+
+    def current_contract_address(inst):
+        return cv.new_obj(TAG_ADDRESS_OBJ, env.contract_addr)
+
+    def contract_event(inst, topics_val, data_val):
+        topics_sc = cv.to_scval(topics_val)
+        if topics_sc.arm != T.SCV_VEC:
+            raise EnvError("event topics must be a vec")
+        env.host.emit_event(env.contract_addr,
+                            list(topics_sc.value or ()),
+                            cv.to_scval(data_val))
+        return _make(TAG_VOID)
+
+    def fail(inst):
+        raise EnvError("contract called fail")
+
+    # ---- ledger ----
+
+    def _storage_args(k_val, t_val):
+        """(key_scval, durability|None, kb|None) — durability None
+        means instance storage; key is converted exactly once."""
+        from stellar_tpu.soroban.host import contract_data_key
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.xdr.contract import ContractDataDurability
+        code = _u32_arg(t_val, "storage type")
+        kind = _DUR_BY_CODE.get(code)
+        if kind is None:
+            raise EnvError("bad storage type")
+        key_sc = cv.to_scval(k_val)
+        if kind == "instance":
+            return key_sc, None, None
+        dur = ContractDataDurability.PERSISTENT \
+            if kind == "persistent" else ContractDataDurability.TEMPORARY
+        kb = key_bytes(contract_data_key(env.contract_addr, key_sc,
+                                         dur))
+        return key_sc, dur, kb
+
+    def put_contract_data(inst, k_val, v_val, t_val):
+        key_sc, dur, _kb = _storage_args(k_val, t_val)
+        if dur is None:
+            env.instance_put(key_sc, cv.to_scval(v_val))
+        else:
+            env.data_put(key_sc, cv.to_scval(v_val), dur)
+        return _make(TAG_VOID)
+
+    def get_contract_data(inst, k_val, t_val):
+        key_sc, dur, kb = _storage_args(k_val, t_val)
+        sc = env.instance_get(key_sc) if dur is None \
+            else env.data_get(kb)
+        if sc is None:
+            raise EnvError("missing contract data")
+        return cv.from_scval(sc)
+
+    def has_contract_data(inst, k_val, t_val):
+        key_sc, dur, kb = _storage_args(k_val, t_val)
+        sc = env.instance_get(key_sc) if dur is None \
+            else env.data_get(kb)
+        return _make(TAG_TRUE if sc is not None else TAG_FALSE)
+
+    def del_contract_data(inst, k_val, t_val):
+        key_sc, dur, kb = _storage_args(k_val, t_val)
+        if dur is None:
+            env.instance_del(key_sc)
+        else:
+            env.data_del(kb)
+        return _make(TAG_VOID)
+
+    # ---- vec ----
+    # Structural ops charge proportionally to the work they do (copy
+    # size, entries compared) — a flat per-call fee would let real CPU
+    # and memory run unbounded relative to the instruction budget
+    # (reference: soroban's per-cost-type calibrated charges).
+
+    def vec_new(inst):
+        return cv.new_obj(TAG_VEC_OBJ, [])
+
+    def vec_push_back(inst, vec_val, item):
+        items = list(cv.obj(vec_val, TAG_VEC_OBJ))
+        env.host.budget.charge(10 + len(items), 8 * (len(items) + 1))
+        items.append(item & _M64)
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    def vec_get(inst, vec_val, i_val):
+        items = cv.obj(vec_val, TAG_VEC_OBJ)
+        i = _u32_arg(i_val, "vec index")
+        if i >= len(items):
+            raise EnvError("vec index out of bounds")
+        return items[i]
+
+    def vec_len(inst, vec_val):
+        return _make(TAG_U32, len(cv.obj(vec_val, TAG_VEC_OBJ)))
+
+    # ---- map (entries kept sorted by canonical SCVal key bytes) ----
+
+    def _map_key_bytes(v: int) -> bytes:
+        kb = to_bytes(SCVal, cv.to_scval(v))
+        # the encode itself is the dominant cost of every compare
+        env.host.budget.charge(30 + 2 * len(kb), 0)
+        return kb
+
+    def map_new(inst):
+        return cv.new_obj(TAG_MAP_OBJ, [])
+
+    def map_put(inst, map_val, k, v):
+        pairs = list(cv.obj(map_val, TAG_MAP_OBJ))
+        env.host.budget.charge(10 + len(pairs), 16 * (len(pairs) + 1))
+        kb = _map_key_bytes(k)
+        for i, (pk, _pv) in enumerate(pairs):
+            if _map_key_bytes(pk) == kb:
+                pairs[i] = (k & _M64, v & _M64)
+                break
+        else:
+            pairs.append((k & _M64, v & _M64))
+            pairs.sort(key=lambda p: _map_key_bytes(p[0]))
+        return cv.new_obj(TAG_MAP_OBJ, pairs)
+
+    def map_get(inst, map_val, k):
+        kb = _map_key_bytes(k)
+        for pk, pv in cv.obj(map_val, TAG_MAP_OBJ):
+            if _map_key_bytes(pk) == kb:
+                return pv
+        raise EnvError("map key not found")
+
+    def map_has(inst, map_val, k):
+        kb = _map_key_bytes(k)
+        for pk, _pv in cv.obj(map_val, TAG_MAP_OBJ):
+            if _map_key_bytes(pk) == kb:
+                return _make(TAG_TRUE)
+        return _make(TAG_FALSE)
+
+    def map_len(inst, map_val):
+        return _make(TAG_U32, len(cv.obj(map_val, TAG_MAP_OBJ)))
+
+    # ---- bytes / string / symbol <-> linear memory ----
+
+    def bytes_new_from_linear_memory(inst, ptr_val, len_val):
+        ptr = _u32_arg(ptr_val, "ptr")
+        n = _u32_arg(len_val, "len")
+        env.host.budget.charge(50 + 2 * n, n)
+        return cv.new_obj(TAG_BYTES_OBJ, inst.mem_read(ptr, n))
+
+    def bytes_copy_to_linear_memory(inst, b_val, off_val, ptr_val,
+                                    len_val):
+        data = cv.obj(b_val, TAG_BYTES_OBJ)
+        off = _u32_arg(off_val, "offset")
+        ptr = _u32_arg(ptr_val, "ptr")
+        n = _u32_arg(len_val, "len")
+        if off + n > len(data):
+            raise EnvError("bytes copy out of range")
+        env.host.budget.charge(50 + 2 * n, 0)
+        inst.mem_write(ptr, data[off:off + n])
+        return _make(TAG_VOID)
+
+    def bytes_len(inst, b_val):
+        return _make(TAG_U32, len(cv.obj(b_val, TAG_BYTES_OBJ)))
+
+    def bytes_get(inst, b_val, i_val):
+        data = cv.obj(b_val, TAG_BYTES_OBJ)
+        i = _u32_arg(i_val, "index")
+        if i >= len(data):
+            raise EnvError("bytes index out of bounds")
+        return _make(TAG_U32, data[i])
+
+    def symbol_new_from_linear_memory(inst, ptr_val, len_val):
+        ptr = _u32_arg(ptr_val, "ptr")
+        n = _u32_arg(len_val, "len")
+        raw = inst.mem_read(ptr, n)
+        env.host.budget.charge(50 + 2 * n, n)
+        if n <= 9:
+            try:
+                return sym_to_small(raw)
+            except ValueError:
+                pass
+        return cv.new_obj(TAG_SYMBOL_OBJ, raw)
+
+    def string_new_from_linear_memory(inst, ptr_val, len_val):
+        ptr = _u32_arg(ptr_val, "ptr")
+        n = _u32_arg(len_val, "len")
+        env.host.budget.charge(50 + 2 * n, n)
+        return cv.new_obj(TAG_STRING_OBJ, inst.mem_read(ptr, n))
+
+    # ---- int object conversions (raw wasm i64 <-> Val) ----
+
+    def obj_from_u64(inst, raw):
+        raw &= _M64
+        if raw <= _SMALL_MAX_U:
+            return _make(TAG_U64_SMALL, raw)
+        return cv.new_obj(TAG_U64_OBJ, raw)
+
+    def obj_to_u64(inst, val):
+        sc = cv.to_scval(val)
+        if sc.arm != T.SCV_U64:
+            raise EnvError("not a u64")
+        return sc.value
+
+    def obj_from_i64(inst, raw):
+        raw &= _M64
+        signed = raw - (1 << 64) if raw >> 63 else raw
+        if _SMALL_MIN_I <= signed <= _SMALL_MAX_I:
+            return _make(TAG_I64_SMALL, signed)
+        return cv.new_obj(TAG_I64_OBJ, signed)
+
+    def obj_to_i64(inst, val):
+        sc = cv.to_scval(val)
+        if sc.arm != T.SCV_I64:
+            raise EnvError("not an i64")
+        return sc.value & _M64
+
+    # ---- address / auth ----
+
+    def require_auth(inst, addr_val):
+        addr = cv.obj(addr_val, TAG_ADDRESS_OBJ)
+        env.host.require_auth(
+            SCVal.make(T.SCV_ADDRESS, addr), env.invocation)
+        return _make(TAG_VOID)
+
+    # ---- cross-contract call ----
+
+    def call(inst, addr_val, fn_val, args_val):
+        addr_sc = cv.to_scval(addr_val)
+        fn_sc = cv.to_scval(fn_val)
+        args_sc = cv.to_scval(args_val)
+        if addr_sc.arm != T.SCV_ADDRESS or fn_sc.arm != T.SCV_SYMBOL \
+                or args_sc.arm != T.SCV_VEC:
+            raise EnvError("call needs (address, symbol, vec)")
+        rv = env.host.call_contract(addr_sc.value, fn_sc.value,
+                                    list(args_sc.value or ()),
+                                    env.depth + 1)
+        return cv.from_scval(rv)
+
+    # ---- crypto ----
+
+    def compute_sha256(inst, b_val):
+        data = cv.obj(b_val, TAG_BYTES_OBJ)
+        env.host.budget.charge(2000 + 30 * len(data), 32)
+        return cv.new_obj(TAG_BYTES_OBJ, sha256(data))
+
+    return {
+        ("x", "log"): log,
+        ("x", "ledger_sequence"): ledger_sequence,
+        ("x", "ledger_timestamp"): ledger_timestamp,
+        ("x", "current_contract_address"): current_contract_address,
+        ("x", "contract_event"): contract_event,
+        ("x", "fail"): fail,
+        ("l", "put_contract_data"): put_contract_data,
+        ("l", "get_contract_data"): get_contract_data,
+        ("l", "has_contract_data"): has_contract_data,
+        ("l", "del_contract_data"): del_contract_data,
+        ("v", "vec_new"): vec_new,
+        ("v", "vec_push_back"): vec_push_back,
+        ("v", "vec_get"): vec_get,
+        ("v", "vec_len"): vec_len,
+        ("m", "map_new"): map_new,
+        ("m", "map_put"): map_put,
+        ("m", "map_get"): map_get,
+        ("m", "map_has"): map_has,
+        ("m", "map_len"): map_len,
+        ("b", "bytes_new_from_linear_memory"):
+            bytes_new_from_linear_memory,
+        ("b", "bytes_copy_to_linear_memory"):
+            bytes_copy_to_linear_memory,
+        ("b", "bytes_len"): bytes_len,
+        ("b", "bytes_get"): bytes_get,
+        ("b", "symbol_new_from_linear_memory"):
+            symbol_new_from_linear_memory,
+        ("b", "string_new_from_linear_memory"):
+            string_new_from_linear_memory,
+        ("i", "obj_from_u64"): obj_from_u64,
+        ("i", "obj_to_u64"): obj_to_u64,
+        ("i", "obj_from_i64"): obj_from_i64,
+        ("i", "obj_to_i64"): obj_to_i64,
+        ("a", "require_auth"): require_auth,
+        ("c", "call"): call,
+        ("d", "compute_sha256"): compute_sha256,
+    }

@@ -1,0 +1,103 @@
+"""Canonical wasm example contracts, assembled in-process — the role
+the reference's checked-in soroban test fixtures play
+(``src/testdata/soroban/*.wasm``): real compiled modules for tests,
+golden tx-meta scenarios, and the load generator to exercise the wasm
+VM end-to-end (upload -> create -> invoke through the close pipeline).
+"""
+
+from __future__ import annotations
+
+from stellar_tpu.soroban.env import (
+    TAG_TRUE, TAG_U32, TAG_VOID, sym_to_small,
+)
+from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+
+__all__ = ["counter_wasm", "KEY_COUNT_VAL"]
+
+
+def _u32val(v: int) -> int:
+    return ((v & 0xFFFFFFFF) << 8) | TAG_U32
+
+
+KEY_COUNT_VAL = sym_to_small(b"count")
+_SYM_INCR = sym_to_small(b"incr")
+_T_PERSISTENT = _u32val(1)  # storage-type code: persistent
+
+
+def counter_wasm() -> bytes:
+    """The counter contract as a real wasm module.
+
+    Exports:
+      - ``incr()``       -> new count (U32 val): get/put persistent
+        storage + emits an ``incr`` event
+      - ``auth_incr(a)`` -> require_auth(a) then incr()
+      - ``sha8(x)``      -> first byte of sha256(le64(x)) (U32 val);
+        exercises linear memory + bytes objects + crypto
+      - ``boom()``       -> traps (unreachable)
+      - ``spin()``       -> infinite loop (budget-trap fodder)
+    """
+    b = ModuleBuilder()
+    has_fn = b.import_func("l", "has_contract_data", [I64, I64], [I64])
+    get_fn = b.import_func("l", "get_contract_data", [I64, I64], [I64])
+    put_fn = b.import_func("l", "put_contract_data",
+                           [I64, I64, I64], [I64])
+    event_fn = b.import_func("x", "contract_event", [I64, I64], [I64])
+    vec_new_fn = b.import_func("v", "vec_new", [], [I64])
+    vec_push_fn = b.import_func("v", "vec_push_back",
+                                [I64, I64], [I64])
+    auth_fn = b.import_func("a", "require_auth", [I64], [I64])
+    bytes_new_fn = b.import_func("b", "bytes_new_from_linear_memory",
+                                 [I64, I64], [I64])
+    bytes_get_fn = b.import_func("b", "bytes_get", [I64, I64], [I64])
+    sha_fn = b.import_func("d", "compute_sha256", [I64], [I64])
+
+    b.add_memory(1)
+
+    # incr() -> i64 val; local 0 holds the new counter val
+    c = Code()
+    c.i64_const(KEY_COUNT_VAL).i64_const(_T_PERSISTENT).call(has_fn)
+    c.i64_const(TAG_TRUE).i64_eq()
+    c.if_(I64)
+    c.i64_const(KEY_COUNT_VAL).i64_const(_T_PERSISTENT).call(get_fn)
+    c.else_()
+    c.i64_const(_u32val(0))
+    c.end()
+    # old val -> count -> count+1 -> new val
+    c.i64_const(8).i64_shr_u().i64_const(1).i64_add()
+    c.i64_const(8).i64_shl().i64_const(TAG_U32).i64_or()
+    c.local_set(0)
+    # put(key, new, persistent)
+    c.i64_const(KEY_COUNT_VAL).local_get(0)
+    c.i64_const(_T_PERSISTENT).call(put_fn).drop()
+    # contract_event([sym "incr"], new)
+    c.call(vec_new_fn).i64_const(_SYM_INCR).call(vec_push_fn)
+    c.local_get(0).call(event_fn).drop()
+    c.local_get(0).end()
+    incr_idx = b.add_func([], [I64], [I64], c, export="incr")
+
+    # auth_incr(addr) -> require_auth then incr
+    c = Code()
+    c.local_get(0).call(auth_fn).drop()
+    c.call(incr_idx).end()
+    b.add_func([I64], [I64], [], c, export="auth_incr")
+
+    # sha8(x): mem[0:8] = le64(x); sha256(bytes); first byte as U32 val
+    c = Code()
+    c.i32_const(0).local_get(0).i64_const(8).i64_shr_u().i64_store()
+    c.i64_const(_u32val(0)).i64_const(_u32val(8)).call(bytes_new_fn)
+    c.call(sha_fn)
+    c.i64_const(_u32val(0)).call(bytes_get_fn)
+    c.end()
+    b.add_func([I64], [I64], [], c, export="sha8")
+
+    # boom(): trap
+    b.add_func([], [I64], [], Code().unreachable().end(),
+               export="boom")
+
+    # spin(): infinite loop — must die by budget, not wall clock
+    c = Code()
+    c.loop(0x40).br(0).end()
+    c.i64_const(TAG_VOID).end()
+    b.add_func([], [I64], [], c, export="spin")
+
+    return b.build()

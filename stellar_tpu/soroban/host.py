@@ -4,17 +4,21 @@ same boundary the reference crosses into Rust (``src/rust/src/lib.rs``
 modified entries + events + consumption out; the C++ side at
 ``src/transactions/InvokeHostFunctionOpFrame.cpp:489`` only marshals).
 
-The VM here is a restricted interpreter rather than wasm: contract
-"code" is the XDR of an SCVal map {function symbol -> instruction
-vector}, each instruction an SCVal vec ``[op-symbol, args...]`` over a
-small stack machine (arithmetic, comparisons, relative jumps, contract
-data get/put/del, require_auth, events). Everything is metered against
-the same cpu/mem budget shape, storage is footprint-enforced, and auth
-entries verify real ed25519 signatures over the canonical
-HashIDPreimage — so fee, footprint, auth-signature, and TTL semantics
-exercise the full reference surface while the instruction set stays
-auditable. The boundary is wasm-shaped: swapping in a wasm interpreter
-changes only ``_execute``.
+Two execution engines sit behind the boundary:
+
+- **wasm** (the real thing): code beginning with ``\\0asm`` is a wasm
+  binary, validated at upload and executed by the metered wasm-MVP
+  interpreter in ``soroban/wasm.py`` through the tagged-Val host ABI
+  in ``soroban/env.py`` — the same wasmi-shaped stack the reference
+  links behind ``invoke_host_function``.
+- **legacy SCVal programs**: the XDR of an SCVal map {function symbol
+  -> instruction vector} over a small stack machine, kept for the
+  auditable golden scenarios that predate the wasm VM.
+
+Either way, everything is metered against the same cpu/mem budget
+shape, storage is footprint-enforced, and auth entries verify real
+ed25519 signatures over the canonical HashIDPreimage — fee, footprint,
+auth-signature, and TTL semantics exercise the full reference surface.
 """
 
 from __future__ import annotations
@@ -168,6 +172,9 @@ CPU_PER_INSTRUCTION = 500
 CPU_PER_STORAGE_OP = 2_000
 CPU_PER_BYTE = 2
 MEM_PER_STACK_SLOT = 64
+# one wasm instruction in budget cpu units (reference soroban cost
+# model's WasmInsnExec ~ 4 cpu instructions per wasm instruction)
+CPU_PER_WASM_INSN = 4
 
 
 class _Budget:
@@ -525,83 +532,39 @@ class _Interp:
         if op == b"put":
             val = stack.pop()
             key = stack.pop()
-            entry = ContractDataEntry(
-                ext=ExtensionPoint.make(0), contract=self.contract_addr,
-                key=key, durability=dur, val=val)
-            lk = contract_data_key(self.contract_addr, key, dur)
-            kb = key_bytes(lk)
-            is_new = host.storage.entries.get(kb, [None])[0] is None
-            live_until = None
-            if is_new:
-                cfg = host.config
-                ttl = cfg.min_persistent_ttl \
-                    if dur == ContractDataDurability.PERSISTENT \
-                    else cfg.min_temporary_ttl
-                live_until = host.ledger_seq + ttl - 1
-            host.storage.put(kb, _wrap_entry(
-                LedgerEntryType.CONTRACT_DATA, entry, host.ledger_seq),
-                live_until)
+            host.data_put(self.contract_addr, key, val, dur)
         else:
             key = stack.pop()
-            lk = contract_data_key(self.contract_addr, key, dur)
-            kb = key_bytes(lk)
+            kb = key_bytes(
+                contract_data_key(self.contract_addr, key, dur))
             if op == b"get":
-                e = host.storage.get(kb)
-                stack.append(e.data.value.val if e is not None
+                v = host.data_get(kb)
+                stack.append(v if v is not None
                              else SCVal.make(T.SCV_VOID))
             elif op == b"has":
-                e = host.storage.get(kb)
-                stack.append(SCVal.make(T.SCV_BOOL, e is not None))
+                stack.append(SCVal.make(T.SCV_BOOL,
+                                        host.data_get(kb) is not None))
             else:
-                host.storage.delete(kb)
+                host.data_del(kb)
 
     def _instance_storage_op(self, op, stack):
         """Instance storage: the SCMap inside the contract's instance
         entry (reference host instance storage — shares the instance's
         lifetime and footprint slot)."""
-        from stellar_tpu.ledger.ledger_txn import key_bytes
         host = self.host
-        inst_lk = contract_data_key(
-            self.contract_addr,
-            SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
-            ContractDataDurability.PERSISTENT)
-        kb = key_bytes(inst_lk)
-        entry = host.storage.get(kb)
-        if entry is None:
-            raise HostError(HostError.TRAPPED, "missing instance entry")
-        inst = entry.data.value.val.value  # SCContractInstance
-        storage = list(inst.storage or ())
         val = stack.pop() if op == b"put" else None
         key = stack.pop()
-        key_b = to_bytes(SCVal, key)
-        idx = next((i for i, e in enumerate(storage)
-                    if to_bytes(SCVal, e.key) == key_b), None)
         if op == b"get":
-            stack.append(storage[idx].val if idx is not None
-                         else SCVal.make(T.SCV_VOID))
-            return
-        if op == b"has":
-            stack.append(SCVal.make(T.SCV_BOOL, idx is not None))
-            return
-        if op == b"put":
-            if idx is not None:
-                storage[idx] = SCMapEntry(key=key, val=val)
-            else:
-                storage.append(SCMapEntry(key=key, val=val))
-                storage.sort(key=lambda e: to_bytes(SCVal, e.key))
-        else:  # del
-            if idx is None:
-                return
-            del storage[idx]
-        new_inst = ContractDataEntry(
-            ext=ExtensionPoint.make(0), contract=self.contract_addr,
-            key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
-            durability=ContractDataDurability.PERSISTENT,
-            val=SCVal.make(T.SCV_CONTRACT_INSTANCE, SCContractInstance(
-                executable=inst.executable, storage=storage or None)))
-        host.storage.put(kb, _wrap_entry(
-            LedgerEntryType.CONTRACT_DATA, new_inst, host.ledger_seq),
-            None)
+            v = host.instance_get(self.contract_addr, key)
+            stack.append(v if v is not None else SCVal.make(T.SCV_VOID))
+        elif op == b"has":
+            stack.append(SCVal.make(
+                T.SCV_BOOL,
+                host.instance_get(self.contract_addr, key) is not None))
+        elif op == b"put":
+            host.instance_put(self.contract_addr, key, val)
+        else:
+            host.instance_del(self.contract_addr, key)
 
 
 # ---------------------------------------------------------------------------
@@ -662,6 +625,82 @@ class _Host:
             raise HostError(HostError.BUDGET, "events size limit")
         self.budget.charge(CPU_PER_INSTRUCTION + CPU_PER_BYTE * size, size)
         self.events.append(ev)
+
+    # ---- contract-data storage (shared by both execution engines) ----
+
+    def data_put(self, contract_addr, key, val, dur):
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        entry = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=contract_addr,
+            key=key, durability=dur, val=val)
+        kb = key_bytes(contract_data_key(contract_addr, key, dur))
+        is_new = self.storage.entries.get(kb, [None])[0] is None
+        live_until = None
+        if is_new:
+            ttl = self.config.min_persistent_ttl \
+                if dur == ContractDataDurability.PERSISTENT \
+                else self.config.min_temporary_ttl
+            live_until = self.ledger_seq + ttl - 1
+        self.storage.put(kb, _wrap_entry(
+            LedgerEntryType.CONTRACT_DATA, entry, self.ledger_seq),
+            live_until)
+
+    def data_get(self, kb: bytes):
+        """Stored SCVal for a data key, or None."""
+        e = self.storage.get(kb)
+        return None if e is None else e.data.value.val
+
+    def data_del(self, kb: bytes):
+        self.storage.delete(kb)
+
+    def _instance_entry(self, contract_addr):
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        kb = key_bytes(contract_data_key(
+            contract_addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT))
+        entry = self.storage.get(kb)
+        if entry is None:
+            raise HostError(HostError.TRAPPED, "missing instance entry")
+        return kb, entry.data.value.val.value  # SCContractInstance
+
+    def instance_get(self, contract_addr, key):
+        _kb, inst = self._instance_entry(contract_addr)
+        key_b = to_bytes(SCVal, key)
+        for e in (inst.storage or ()):
+            if to_bytes(SCVal, e.key) == key_b:
+                return e.val
+        return None
+
+    def instance_put(self, contract_addr, key, val):
+        self._instance_update(contract_addr, key, val, delete=False)
+
+    def instance_del(self, contract_addr, key):
+        self._instance_update(contract_addr, key, None, delete=True)
+
+    def _instance_update(self, contract_addr, key, val, delete: bool):
+        kb, inst = self._instance_entry(contract_addr)
+        storage = list(inst.storage or ())
+        key_b = to_bytes(SCVal, key)
+        idx = next((i for i, e in enumerate(storage)
+                    if to_bytes(SCVal, e.key) == key_b), None)
+        if delete:
+            if idx is None:
+                return
+            del storage[idx]
+        elif idx is not None:
+            storage[idx] = SCMapEntry(key=key, val=val)
+        else:
+            storage.append(SCMapEntry(key=key, val=val))
+            storage.sort(key=lambda e: to_bytes(SCVal, e.key))
+        new_inst = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=contract_addr,
+            key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            durability=ContractDataDurability.PERSISTENT,
+            val=SCVal.make(T.SCV_CONTRACT_INSTANCE, SCContractInstance(
+                executable=inst.executable, storage=storage or None)))
+        self.storage.put(kb, _wrap_entry(
+            LedgerEntryType.CONTRACT_DATA, new_inst, self.ledger_seq),
+            None)
 
 
 def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
@@ -731,12 +770,117 @@ def _parse_program(code: bytes) -> Dict[bytes, List]:
     return prog
 
 
+_MODULE_CACHE: Dict[bytes, object] = {}
+_MODULE_CACHE_CAP = 128
+
+
+def _parsed_module(code: bytes):
+    """Validated WasmModule for ``code``, memoized by content hash
+    (the reference host caches parsed+validated wasmi modules per code
+    entry the same way)."""
+    from stellar_tpu.soroban.wasm import parse_module
+    h = sha256(code)
+    mod = _MODULE_CACHE.get(h)
+    if mod is None:
+        mod = parse_module(code)
+        if len(_MODULE_CACHE) >= _MODULE_CACHE_CAP:
+            _MODULE_CACHE.pop(next(iter(_MODULE_CACHE)))
+        _MODULE_CACHE[h] = mod
+    return mod
+
+
+class WasmContractEnv:
+    """Per-contract-frame bridge between the wasm host imports
+    (``soroban/env.py``) and the shared ``_Host`` services. A fresh
+    env (and so a fresh Val object table) is created per frame;
+    handles never leak across contract boundaries."""
+
+    def __init__(self, host: "_Host", contract_addr, invocation,
+                 depth: int):
+        from stellar_tpu.soroban.env import ValConverter
+        self.host = host
+        self.contract_addr = contract_addr
+        self.invocation = invocation
+        self.depth = depth
+        self.cv = ValConverter(host.budget.charge)
+
+    # storage bridges
+    def data_put(self, key_sc, val_sc, dur):
+        self.host.data_put(self.contract_addr, key_sc, val_sc, dur)
+
+    def data_get(self, kb):
+        return self.host.data_get(kb)
+
+    def data_del(self, kb):
+        self.host.data_del(kb)
+
+    def instance_get(self, key_sc):
+        return self.host.instance_get(self.contract_addr, key_sc)
+
+    def instance_put(self, key_sc, val_sc):
+        self.host.instance_put(self.contract_addr, key_sc, val_sc)
+
+    def instance_del(self, key_sc):
+        self.host.instance_del(self.contract_addr, key_sc)
+
+
+def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
+                       fn_name: bytes, args: List, invocation,
+                       depth: int):
+    """Execute one exported function of a wasm contract (the wasmi
+    dispatch inside the reference's soroban-env-host)."""
+    from stellar_tpu.soroban.env import make_imports
+    from stellar_tpu.soroban.wasm import Trap, WasmError, WasmInstance
+    try:
+        module = _parsed_module(code)
+    except WasmError as e:
+        raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
+    env = WasmContractEnv(host, contract_addr, invocation, depth)
+    budget = host.budget
+
+    def charge(n_insns: int):
+        budget.charge(n_insns * CPU_PER_WASM_INSN)
+
+    def mem_charge(n_bytes: int):
+        budget.charge(0, n_bytes)
+
+    try:
+        inst = WasmInstance(module, make_imports(env), charge,
+                            mem_charge)
+        try:
+            fn = fn_name.decode("utf-8")
+        except UnicodeDecodeError:
+            raise HostError(HostError.TRAPPED, "bad function name")
+        if not inst.exports_function(fn):
+            raise HostError(HostError.TRAPPED,
+                            f"no exported function {fn!r}")
+        vals = [env.cv.from_scval(a) for a in args]
+        rv = inst.invoke(fn, vals)
+        return env.cv.to_scval(rv) if rv is not None \
+            else SCVal.make(T.SCV_VOID)
+    except WasmError as e:
+        raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
+    except Trap as e:
+        raise HostError(HostError.TRAPPED, str(e))
+
+
 def _upload(host: "_Host", code: bytes, read_write: set):
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.xdr.contract import ContractCodeEntry
     if len(code) > host.config.max_contract_size:
         raise HostError(HostError.BUDGET, "contract too large")
-    _parse_program(code)  # must at least parse
+    if code[:4] == b"\x00asm":
+        # full decode + validation at upload, exactly like the
+        # reference host rejecting malformed modules before they can
+        # be created (charging by code size)
+        host.budget.charge(CPU_PER_BYTE * 40 * len(code), len(code))
+        from stellar_tpu.soroban.wasm import WasmError
+        try:
+            _parsed_module(code)
+        except WasmError as e:
+            raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
+    else:
+        _parse_program(code)  # legacy SCVal program must at least parse
     h = sha256(code)
     lk = contract_code_key(h)
     kb = key_bytes(lk)
@@ -817,10 +961,14 @@ def _run_contract(host: "_Host", args, depth: int = 0):
         key_bytes(contract_code_key(inst.executable.value)))
     if code_entry is None:
         raise HostError(HostError.TRAPPED, "missing contract code")
-    prog = _parse_program(code_entry.data.value.code)
+    code = code_entry.data.value.code
     invocation = SorobanAuthorizedFunction.make(
         SorobanAuthorizedFunctionType
         .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN, args)
+    if code[:4] == b"\x00asm":
+        return _run_wasm_contract(host, addr, code, args.functionName,
+                                  list(args.args), invocation, depth)
+    prog = _parse_program(code)
     interp = _Interp(host, addr, prog, invocation=invocation,
                      depth=depth)
     return interp.run(args.functionName, list(args.args))

@@ -192,8 +192,12 @@ def test_maintainer_gc_bounded_by_publish_queue(tmp_path):
     app.lm = LM()
     for seq in (10, 70, 130, 199):
         app.database.store_scp_history(seq, [(b"n" * 32, b"e")])
-    # checkpoint 63 published; 127 and 191 owed to the archive
-    archive.put(_layered_path("ledger", 63, "xdr.gz"), b"x")
+    # checkpoint 63 fully published; 127 and 191 owed to the archive.
+    # 127 has ONLY its ledger file (crash-interrupted publish): it must
+    # still count as unpublished
+    for cat in ("ledger", "transactions", "results"):
+        archive.put(_layered_path(cat, 63, "xdr.gz"), b"x")
+    archive.put(_layered_path("ledger", 127, "xdr.gz"), b"x")
 
     out = Maintainer(app).perform_maintenance(10)
     # raw keep_from would be 190, but the publish floor is ledger 64
@@ -204,8 +208,9 @@ def test_maintainer_gc_bounded_by_publish_queue(tmp_path):
     assert rows == [70, 130, 199]
 
     # archive drains -> the floor advances past it
-    archive.put(_layered_path("ledger", 127, "xdr.gz"), b"x")
-    archive.put(_layered_path("ledger", 191, "xdr.gz"), b"x")
+    for cp in (127, 191):
+        for cat in ("ledger", "transactions", "results"):
+            archive.put(_layered_path(cat, cp, "xdr.gz"), b"x")
     out = Maintainer(app).perform_maintenance(10)
     # floor is now the in-progress checkpoint's first ledger (192),
     # tighter than LCL - count (190) -> 190 wins

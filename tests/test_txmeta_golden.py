@@ -278,9 +278,53 @@ def _counter_code_for_golden():
     })
 
 
+def scenario_wasm_counter(version):
+    """Upload + create + invoke a GENUINELY COMPILED wasm module
+    through the close pipeline: pins the wasm VM's execution semantics
+    (decode, metering, Val ABI, storage writes, events) into tx meta."""
+    from stellar_tpu.simulation.load_generator import (
+        _deploy_frames, _soroban_data, _soroban_op,
+    )
+    from stellar_tpu.soroban.example_contracts import counter_wasm
+    from stellar_tpu.soroban.host import (
+        contract_code_key, contract_data_key, scaddress_contract, sym,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, HostFunction, HostFunctionType,
+        InvokeContractArgs,
+    )
+    a = keypair("gm-wasm")
+    lm = _lm_with([(a, 100_000 * XLM)], version)
+    net = lm.network_id
+    import dataclasses
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=10)
+    lm.root.soroban_config = lm.soroban_config
+    up, create, contract_id, code_hash, inst_key = _deploy_frames(
+        a, (1 << 32) + 1, (1 << 32) + 2, counter_wasm(),
+        net, salt=b"\x37" * 32)
+    out = [_close_with(lm, [up]), _close_with(lm, [create])]
+    addr = scaddress_contract(contract_id)
+    counter_key = contract_data_key(addr, sym("count"),
+                                    ContractDataDurability.PERSISTENT)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr, functionName=b"incr",
+                           args=[]))
+    invoke = make_tx(
+        a, (1 << 32) + 3, [_soroban_op(fn)], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[inst_key, contract_code_key(code_hash)],
+            read_write=[counter_key]),
+        network_id=net)
+    out.append(_close_with(lm, [invoke]))
+    return out
+
+
 # soroban is protocol >= 20 only
 SOROBAN_SCENARIOS = {
     "soroban_counter": scenario_soroban_counter,
+    "wasm_counter": scenario_wasm_counter,
 }
 
 
