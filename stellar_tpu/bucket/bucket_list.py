@@ -5,11 +5,16 @@ Geometry: level i holds ~4^(i+1) ledgers of changes as two buckets,
 ``curr`` and ``snap``; half-full currs snap and spill downward on the
 cadence ``levelShouldSpill(ledger, i) = ledger % levelHalf(i) == 0 or
 ledger % levelSize(i) == 0`` with ``levelSize(i) = 4^(i+1)``. The merge
-of a spilled snap into the next level's curr is *prepared* at spill time
-and only becomes visible (``commit``) at that level's next spill — the
-reference runs these merges on worker threads (FutureBucket,
-``bucket/FutureBucket.h:37-127``); here they're computed eagerly but
-held in ``next`` so the visible state sequence is identical.
+of a spilled snap into the next level's curr is *prepared* at spill
+time on the shared worker pool and only becomes visible (``commit``)
+at that level's next spill — the reference's FutureBucket semantics
+(``bucket/FutureBucket.h:37-127``): ``BucketLevel.next`` transparently
+resolves the pending merge the first time anything touches it (the
+next spill, persistence, the HAS), so a deep-level merge no longer
+stalls the close that spilled it. Merges are pure functions of
+immutable buckets, so backgrounding changes only WHEN the work runs,
+never the result; ``utils.workers.set_background(False)`` forces the
+old eager mode and tests pin result identity between the two.
 
 The list hash is SHA-256 over each level's SHA-256(curr.hash ‖
 snap.hash) (reference ``BucketListBase::getHash``), and chains into the
@@ -61,14 +66,74 @@ def should_merge_with_empty_curr(ledger: int, level: int) -> bool:
     return level_should_spill(next_change, level)
 
 
+class FutureBucket:
+    """Handle to an in-flight (or finished) merge (reference
+    ``FutureBucket``): resolves exactly once; the merge is a pure
+    function of two immutable buckets, so resolution order can never
+    change the result, only where the latency lands.
+
+    ``inputs`` carries (base, incoming, *params) for persistence: an
+    unresolved merge is saved as its inputs and RESTARTED at restore
+    (reference ``FutureBucket::makeLive`` from the HAS state=2 form) —
+    determinism makes the restarted output bit-identical, so a crash
+    mid-merge never blocks the close that persisted it."""
+
+    __slots__ = ("_bucket", "_future", "inputs")
+
+    def __init__(self, bucket: Optional[Bucket] = None, future=None,
+                 inputs: Optional[tuple] = None):
+        self._bucket = bucket
+        self._future = future
+        self.inputs = inputs
+
+    @classmethod
+    def start(cls, fn, inputs: Optional[tuple] = None) -> "FutureBucket":
+        from stellar_tpu.utils.workers import run_async
+        return cls(future=run_async(fn), inputs=inputs)
+
+    def resolve(self) -> Bucket:
+        if self._bucket is None:
+            self._bucket = self._future.result()
+            self._future = None
+        return self._bucket
+
+    @property
+    def done(self) -> bool:
+        return self._bucket is not None or self._future.done()
+
+
 class BucketLevel:
-    __slots__ = ("level", "curr", "snap", "next")
+    __slots__ = ("level", "curr", "snap", "_next")
 
     def __init__(self, level: int):
         self.level = level
         self.curr: Bucket = EMPTY
         self.snap: Bucket = EMPTY
-        self.next: Optional[Bucket] = None  # prepared (pending) merge
+        self._next = None  # FutureBucket | Bucket | None
+
+    @property
+    def next(self) -> Optional[Bucket]:
+        """The prepared merge output; touching it resolves a pending
+        background merge (blocking until it lands)."""
+        if isinstance(self._next, FutureBucket):
+            self._next = self._next.resolve()
+        return self._next
+
+    @next.setter
+    def next(self, bucket: Optional[Bucket]):
+        self._next = bucket
+
+    def merge_in_flight(self) -> bool:
+        """True while a prepared merge is still computing (metrics /
+        close-latency instrumentation)."""
+        return isinstance(self._next, FutureBucket) and \
+            not self._next.done
+
+    def pending_merge(self) -> Optional["FutureBucket"]:
+        """The unresolved FutureBucket, or None once resolved/absent
+        (persistence stores its inputs instead of blocking on it)."""
+        return self._next if isinstance(self._next, FutureBucket) \
+            else None
 
     def hash(self) -> bytes:
         h = hashlib.sha256()
@@ -85,21 +150,24 @@ class BucketLevel:
     def commit(self):
         """Make the prepared merge visible (reference
         ``BucketLevel::commit`` resolving the FutureBucket)."""
-        if self.next is not None:
-            self.curr = self.next
-            self.next = None
+        if self._next is not None:
+            self.curr = self.next  # resolves if still in flight
+            self._next = None
 
     def prepare(self, incoming_snap: Bucket, protocol_version: int,
                 keep_tombstones: bool, merge_with_empty_curr: bool):
-        """Start (here: compute) the merge of the level above's snap
-        into this level's curr; visible at the next commit. When this
+        """Start the merge of the level above's snap into this level's
+        curr on the worker pool; visible at the next commit. When this
         level's own curr will be snapped away before that commit, merge
         into an empty curr instead (reference
         ``shouldMergeWithEmptyCurr`` — otherwise the same contents would
         live at two levels)."""
         base = EMPTY if merge_with_empty_curr else self.curr
-        self.next = merge_buckets(base, incoming_snap, protocol_version,
-                                  keep_tombstones=keep_tombstones)
+        self._next = FutureBucket.start(
+            lambda: merge_buckets(base, incoming_snap, protocol_version,
+                                  keep_tombstones=keep_tombstones),
+            inputs=(base, incoming_snap, protocol_version,
+                    keep_tombstones))
 
 
 class LiveBucketList:
@@ -141,13 +209,15 @@ class LiveBucketList:
                     merge_with_empty_curr=should_merge_with_empty_curr(
                         current_ledger, i))
         # level 0 accumulates each ledger's batch into curr immediately
-        # (reference: prepare(fresh) then commit in the same call)
-        self.levels[0].prepare(
+        # (reference: prepare(fresh) then commit in the same call) —
+        # merged inline: the result is needed this very close, so a
+        # worker round-trip would only add latency
+        self.levels[0].curr = merge_buckets(
+            self.levels[0].curr,
             fresh_bucket(protocol_version, init_entries, live_entries,
                          dead_keys),
-            protocol_version, keep_tombstones=True,
-            merge_with_empty_curr=False)
-        self.levels[0].commit()
+            protocol_version, keep_tombstones=True)
+        self.levels[0]._next = None
 
     # ---------------- lookups (the BucketListDB role) ----------------
 

@@ -80,17 +80,30 @@ class BucketManager:
 
     def persist_bucket_list(self, bl: LiveBucketList) -> List[dict]:
         """Write every referenced bucket to disk; return the level
-        manifest (curr/snap/next hashes, hex)."""
+        manifest. A merge still in flight is saved as its INPUTS
+        (reference FutureBucket HAS state=2) rather than blocking the
+        close on its output: restore restarts the merge and determinism
+        makes the result bit-identical."""
         manifest = []
         for lev in bl.levels:
             entry = {"curr": self.adopt(lev.curr).hex(),
                      "snap": self.adopt(lev.snap).hex()}
-            if lev.next is not None:
+            fb = lev.pending_merge()
+            if fb is not None and not fb.done and fb.inputs is not None:
+                base, incoming, pv, keep = fb.inputs
+                entry["next_merge"] = {
+                    "base": self.adopt(base).hex(),
+                    "incoming": self.adopt(incoming).hex(),
+                    "protocol": pv, "keep_tombstones": keep,
+                }
+            elif lev.next is not None:  # resolved (or instantly done)
                 entry["next"] = self.adopt(lev.next).hex()
             manifest.append(entry)
         return manifest
 
     def restore_bucket_list(self, manifest: List[dict]) -> LiveBucketList:
+        from stellar_tpu.bucket.bucket import merge_buckets
+        from stellar_tpu.bucket.bucket_list import FutureBucket
         bl = LiveBucketList()
         for i, entry in enumerate(manifest[:NUM_LEVELS]):
             lev = bl.levels[i]
@@ -98,6 +111,15 @@ class BucketManager:
             lev.snap = self.load(bytes.fromhex(entry["snap"]))
             if "next" in entry:
                 lev.next = self.load(bytes.fromhex(entry["next"]))
+            elif "next_merge" in entry:
+                nm = entry["next_merge"]
+                base = self.load(bytes.fromhex(nm["base"]))
+                incoming = self.load(bytes.fromhex(nm["incoming"]))
+                pv, keep = nm["protocol"], nm["keep_tombstones"]
+                lev._next = FutureBucket.start(
+                    lambda b=base, s=incoming, p=pv, k=keep:
+                        merge_buckets(b, s, p, keep_tombstones=k),
+                    inputs=(base, incoming, pv, keep))
         return bl
 
     def persist_hot_archive(self, hl) -> List[dict]:
@@ -107,7 +129,15 @@ class BucketManager:
         for lev in hl.levels:
             entry = {"curr": self.adopt(lev.curr).hex(),
                      "snap": self.adopt(lev.snap).hex()}
-            if lev.next is not None:
+            fb = lev.pending_merge()
+            if fb is not None and not fb.done and fb.inputs is not None:
+                base, incoming, keep_live = fb.inputs
+                entry["next_merge"] = {
+                    "base": self.adopt(base).hex(),
+                    "incoming": self.adopt(incoming).hex(),
+                    "keep_live": keep_live,
+                }
+            elif lev.next is not None:
                 entry["next"] = self.adopt(lev.next).hex()
             manifest.append(entry)
         return manifest
@@ -134,6 +164,19 @@ class BucketManager:
             lev.snap = load_hot(entry["snap"])
             if "next" in entry:
                 lev.next = load_hot(entry["next"])
+            elif "next_merge" in entry:
+                from stellar_tpu.bucket.bucket_list import FutureBucket
+                from stellar_tpu.bucket.hot_archive import (
+                    merge_hot_buckets,
+                )
+                nm = entry["next_merge"]
+                base = load_hot(nm["base"])
+                incoming = load_hot(nm["incoming"])
+                keep = nm["keep_live"]
+                lev._next = FutureBucket.start(
+                    lambda b=base, s=incoming, k=keep:
+                        merge_hot_buckets(b, s, k),
+                    inputs=(base, incoming, keep))
         return hl
 
     # ---------------- GC ----------------

@@ -8,12 +8,19 @@ HotArchiveBucket); below it they stay behind their expired TTL until
 restored.
 
 The scan cursor rotates through the key space so large states amortize
-across closes (the reference's incremental scan over bucket levels
-plays the same role)."""
+across closes. The expensive part — enumerating every CONTRACT_DATA
+key in the committed state (O(state) over bucket indexes) — runs OFF
+the crank (reference ``startBackgroundEvictionScan``): after close N
+the sorted key list is computed on the worker pool from the immutable
+committed store, and at close N+1 the scan reconciles it with the
+ltx's own delta, yielding BIT-IDENTICAL results to a synchronous
+enumeration — backgrounding moves the work, never the outcome. The
+bounded window (TTL checks, erases) stays synchronous because it is
+consensus state mutation."""
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 __all__ = ["EvictionScanner"]
 
@@ -22,6 +29,55 @@ class EvictionScanner:
     def __init__(self, max_entries_per_scan: int = 100):
         self.max_entries = max_entries_per_scan
         self._cursor: bytes = b""
+        self._pending = None  # Future[List[bytes]] from prepare_async
+        self._pending_store = None  # identity guard
+
+    # ---------------- background enumeration ----------------
+
+    def prepare_async(self, store) -> None:
+        """Kick the CONTRACT_DATA key enumeration for the NEXT close on
+        the worker pool. ``store`` must be the committed root store —
+        immutable until that close's ltx commits, which happens after
+        the scan consumes this result."""
+        from stellar_tpu.utils.workers import run_async
+        from stellar_tpu.xdr.types import LedgerEntryType
+
+        def enumerate_keys():
+            return sorted(store.keys_of_type(
+                LedgerEntryType.CONTRACT_DATA))
+        self._pending = run_async(enumerate_keys)
+        self._pending_store = store
+
+    def _candidate_keys(self, ltx) -> List[bytes]:
+        """Sorted CONTRACT_DATA keys of the ltx's current state —
+        from the precomputed enumeration + the ltx delta when
+        available, else synchronously (first close, catchup)."""
+        from stellar_tpu.xdr.types import LedgerEntryType
+        root = ltx
+        while hasattr(root, "_parent"):
+            root = root._parent
+        if self._pending is not None and \
+                self._pending_store is getattr(root, "store", None):
+            base = self._pending.result()  # usually already done
+            self._pending = None
+            self._pending_store = None
+            keys = set(base)
+            t = LedgerEntryType.CONTRACT_DATA
+            type_be = int(t).to_bytes(4, "big")
+            for kb, (prev, cur) in ltx.get_delta().items():
+                if kb[:4] != type_be:
+                    continue
+                if cur is None:
+                    keys.discard(kb)
+                else:
+                    keys.add(kb)
+            return sorted(keys)
+        self._pending = None
+        self._pending_store = None
+        return sorted(ltx._all_keys_of_type(
+            LedgerEntryType.CONTRACT_DATA))
+
+    # ---------------- the (consensus) scan ----------------
 
     def scan(self, ltx, ledger_seq: int,
              archive_persistent: bool = False) -> Tuple[List, List]:
@@ -32,10 +88,9 @@ class EvictionScanner:
         from stellar_tpu.soroban.host import ttl_key_for
         from stellar_tpu.xdr.contract import ContractDataDurability
         from stellar_tpu.xdr.runtime import from_bytes
-        from stellar_tpu.xdr.types import LedgerEntryType, LedgerKey
+        from stellar_tpu.xdr.types import LedgerKey
 
-        data_keys = sorted(ltx._all_keys_of_type(
-            LedgerEntryType.CONTRACT_DATA))
+        data_keys = self._candidate_keys(ltx)
         if not data_keys:
             return [], []
         # rotate: start after the cursor, wrap around

@@ -154,7 +154,20 @@ class _HotLevel:
         self.level = level
         self.curr = HotArchiveBucket([])
         self.snap = HotArchiveBucket([])
-        self.next: Optional[HotArchiveBucket] = None
+        self._next = None  # FutureBucket | HotArchiveBucket | None
+
+    @property
+    def next(self) -> Optional[HotArchiveBucket]:
+        """Prepared merge output; resolves a pending background merge
+        (same FutureBucket semantics as the live list)."""
+        from stellar_tpu.bucket.bucket_list import FutureBucket
+        if isinstance(self._next, FutureBucket):
+            self._next = self._next.resolve()
+        return self._next
+
+    @next.setter
+    def next(self, bucket: Optional[HotArchiveBucket]):
+        self._next = bucket
 
     def hash(self) -> bytes:
         from stellar_tpu.crypto.sha import sha256
@@ -166,14 +179,27 @@ class _HotLevel:
         return self.snap
 
     def commit(self):
-        if self.next is not None:
-            self.curr = self.next
-            self.next = None
+        if self._next is not None:
+            self.curr = self.next  # resolves if still in flight
+            self._next = None
+
+    def merge_in_flight(self) -> bool:
+        from stellar_tpu.bucket.bucket_list import FutureBucket
+        return isinstance(self._next, FutureBucket) and \
+            not self._next.done
+
+    def pending_merge(self):
+        from stellar_tpu.bucket.bucket_list import FutureBucket
+        return self._next if isinstance(self._next, FutureBucket) \
+            else None
 
     def prepare(self, incoming: HotArchiveBucket, keep_live: bool,
                 merge_with_empty_curr: bool):
+        from stellar_tpu.bucket.bucket_list import FutureBucket
         base = HotArchiveBucket([]) if merge_with_empty_curr else self.curr
-        self.next = merge_hot_buckets(base, incoming, keep_live)
+        self._next = FutureBucket.start(
+            lambda: merge_hot_buckets(base, incoming, keep_live),
+            inputs=(base, incoming, keep_live))
 
 
 class HotArchiveBucketList:
@@ -200,10 +226,12 @@ class HotArchiveBucketList:
                     keep_live=(i < NUM_LEVELS - 1),
                     merge_with_empty_curr=should_merge_with_empty_curr(
                         current_ledger, i))
-        self.levels[0].prepare(
+        # level 0 is needed this close: merge inline, no worker hop
+        self.levels[0].curr = merge_hot_buckets(
+            self.levels[0].curr,
             HotArchiveBucket.fresh(archived, restored_keys),
-            keep_live=True, merge_with_empty_curr=False)
-        self.levels[0].commit()
+            keep_live_markers=True)
+        self.levels[0]._next = None
 
     def get_archived(self, kb: bytes):
         """Newest-first lookup: the ARCHIVED LedgerEntry for key bytes

@@ -303,6 +303,9 @@ class LedgerManager:
                 self.root.store.rebase()
         else:
             header.bucketListHash = self.state_hasher(self.root.store)
+        # kick next close's eviction enumeration off-crank against the
+        # now-committed state (reference startBackgroundEvictionScan)
+        self.eviction_scanner.prepare_async(self.root.store)
         self._calculate_skip_values(header)
         self.root.set_header(header)
         self._lcl_hash = ledger_header_hash(header)

@@ -113,7 +113,12 @@ def small_to_sym(val: int) -> bytes:
     body = _body(val)
     chars = []
     while body:
-        chars.append(_SYM_CHAR[body & 0x3F])
+        ch = _SYM_CHAR.get(body & 0x3F)
+        if ch is None:
+            # a forged Val with an embedded zero 6-bit group must trap
+            # the contract, not raise through the host
+            raise EnvError("malformed SymbolSmall encoding")
+        chars.append(ch)
         body >>= 6
     return "".join(reversed(chars)).encode()
 

@@ -862,6 +862,15 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
         raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
     except Trap as e:
         raise HostError(HostError.TRAPPED, str(e))
+    except HostError:
+        raise
+    except Exception as e:
+        # defense in depth: the VM's inputs are attacker-shaped; any
+        # unexpected failure must trap THIS transaction, never escape
+        # and abort the ledger close (the reference host catches Rust
+        # panics at the FFI boundary the same way)
+        raise HostError(HostError.TRAPPED,
+                        f"host internal error: {type(e).__name__}: {e}")
 
 
 def _upload(host: "_Host", code: bytes, read_write: set):

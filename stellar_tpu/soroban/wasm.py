@@ -402,8 +402,6 @@ def _rem_s(a: int, b: int) -> int:
     return -r if a < 0 else r
 
 
-_INT_OPS = set(range(0x45, 0x5B)) | set(range(0x67, 0x8B)) | \
-    {0xA7, 0xAC, 0xAD}
 # pure numeric ops: how many operands each pops (all push exactly 1)
 _NUMERIC_POPS = {}
 for _op in range(0x46, 0x50):
@@ -529,6 +527,13 @@ def _decode_body(m: WasmModule, ftype: FuncType, body: bytes) -> _Func:
             if frame.kind == "func":
                 if not r.eof():
                     raise WasmError("trailing bytes after function end")
+                # a br to the function frame is a return: jump past the
+                # last op so the run loop exits and yields the results
+                for ppc, slot in frame.patches:
+                    if slot is None:
+                        ops[ppc][1][0] = pc + 1
+                    else:
+                        ops[ppc][1][slot][0] = pc + 1
                 break
             end_pc = pc
             target = frame.pc + 1 if frame.kind == 0x03 else end_pc + 1

@@ -140,6 +140,43 @@ def test_sharded_mesh():
     assert got[:10].all() and not got[10]
 
 
+def test_default_verifier_auto_shards():
+    """default_verifier() spans every local device with no config
+    (VERDICT r2 #2): the sharded and unsharded kernels agree and each
+    device holds batch/n_devices rows."""
+    import jax
+    import stellar_tpu.crypto.batch_verifier as bv
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    with bv._default_lock:
+        old = bv._default
+        bv._default = None
+    try:
+        v = bv.default_verifier()
+        assert v._mesh is not None and v._mesh.size == len(devs)
+        items = [make_sig() for _ in range(20)]
+        bad = bytearray(items[3][2])
+        bad[0] ^= 1
+        items[3] = (items[3][0], items[3][1], bytes(bad))
+        got = v.verify_batch(items)
+        want = BatchVerifier().verify_batch(items)  # unsharded oracle
+        assert (got == want).all() and not got[3]
+        # the dispatched batch really is split 8 ways on device
+        n = v._buckets[0]
+        aa = np.repeat(bv._PAD_A, n, 0)
+        rr = np.repeat(bv._PAD_R, n, 0)
+        ss = np.repeat(bv._PAD_S, n, 0)
+        hh = np.repeat(bv._PAD_H, n, 0)
+        out = v._kernel_for(n)(aa, rr, ss, hh)
+        shards = out.addressable_shards
+        assert len(shards) == len(devs)
+        assert all(s.data.shape[0] == n // len(devs) for s in shards)
+    finally:
+        with bv._default_lock:
+            bv._default = old
+
+
 def test_rfc8032_vectors(verifier):
     # RFC 8032 §7.1 test vectors 1-3
     vecs = [
