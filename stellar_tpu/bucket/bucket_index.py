@@ -147,6 +147,30 @@ class DiskBucket:
                     return e
         return None
 
+    def get_batch(self, kbs) -> dict:
+        """{kb -> BucketEntry} for every hit among ``kbs``: ONE file
+        open, candidate records read in offset order (reference bulk
+        prefetch amortizing per-lookup seeks,
+        ``LedgerTxn.h:815`` prefetch + ``LedgerTxnRoot``'s bulk
+        loaders)."""
+        wanted = []  # (offset, length, kb)
+        for kb in kbs:
+            for off, length in self.index.candidates(kb):
+                wanted.append((off, length, kb))
+        if not wanted:
+            return {}
+        wanted.sort()
+        out = {}
+        with open(self.path, "rb") as f:
+            for off, length, kb in wanted:
+                if kb in out:
+                    continue
+                f.seek(off + 4)
+                e = from_bytes(BucketEntry, f.read(length))
+                if _entry_key_bytes(e) == kb:
+                    out[kb] = e
+        return out
+
     def iter_entries(self):
         """Stream-decode every entry (for scans/rebuilds)."""
         with open(self.path, "rb") as f:

@@ -18,6 +18,7 @@ whether state lives in a dict (tests) or in indexed files (real nodes):
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional, Set
 
 from stellar_tpu.bucket.bucket import EMPTY, Bucket
@@ -67,6 +68,32 @@ class SearchableBucketListSnapshot:
                 return e.value
         return None
 
+    def load_batch(self, kbs) -> dict:
+        """{kb -> live LedgerEntry | None} for every requested key in
+        ONE newest-first sweep: per disk bucket a single file open
+        serves all outstanding keys in offset order (the bulk-prefetch
+        path; reference ``LedgerManagerImpl.cpp:929-933``
+        prefetchTxSourceIds -> LedgerTxnRoot prefetch)."""
+        remaining = set(kbs)
+        out = {}
+        for b in self.buckets:
+            if not remaining:
+                break
+            if isinstance(b, DiskBucket):
+                hits = b.get_batch(remaining)
+            else:
+                hits = {}
+                for kb in remaining:
+                    e = b.get(kb)
+                    if e is not None:
+                        hits[kb] = e
+            for kb, e in hits.items():
+                out[kb] = None if e.arm == BET.DEADENTRY else e.value
+            remaining -= hits.keys()
+        for kb in remaining:
+            out[kb] = None
+        return out
+
     def iter_live_entries(self):
         """(kb, LedgerEntry) for every live entry, newest version wins
         (full scan; used for key-map builds and integrity checks)."""
@@ -90,11 +117,14 @@ class SearchableBucketListSnapshot:
                 yield kb, e.value
 
 
+_PREFETCH_CACHE_CAP = 100_000
+
+
 class BucketListStore:
     """LedgerTxnRoot store backed by the bucket list (the BucketListDB
     role). Live entries are NOT held in RAM — point reads go through
-    bucket files; only the per-type key sets and the pre-close overlay
-    are resident."""
+    bucket files; only the per-type key sets, the pre-close overlay,
+    and a bounded prefetch cache are resident."""
 
     is_bucket_backed = True
 
@@ -105,6 +135,9 @@ class BucketListStore:
             bucket_list, bucket_manager)
         # kb -> encoded entry (written) | None (deleted) since last rebase
         self.overlay: Dict[bytes, Optional[bytes]] = {}
+        # prefetched snapshot reads (kb -> LedgerEntry | None); valid
+        # until the next rebase, bounded by _PREFETCH_CACHE_CAP
+        self._read_cache: Dict[bytes, Optional[LedgerEntry]] = {}
         # entry-type discriminant -> set of kb (keys only)
         self._keys_by_type: Dict[int, Set[bytes]] = {}
         for kb, _ in self._snapshot.iter_live_entries():
@@ -123,7 +156,29 @@ class BucketListStore:
         if kb in self.overlay:
             raw = self.overlay[kb]
             return None if raw is None else from_bytes(LedgerEntry, raw)
+        if kb in self._read_cache:
+            return self._read_cache[kb]
         return self._snapshot.load(kb)
+
+    def prefetch(self, kbs) -> int:
+        """Warm the read cache with one batched newest-first sweep over
+        the bucket files (reference prefetch,
+        ``LedgerManagerImpl.cpp:929-933`` + ``LedgerTxn.h:815``).
+        Returns how many keys were newly fetched."""
+        todo = [kb for kb in set(kbs)
+                if kb not in self.overlay and kb not in self._read_cache]
+        if not todo:
+            return 0
+        # keep the bound without dumping warm entries: evict only as
+        # many (oldest-inserted) entries as the new batch needs, and
+        # never admit a single batch larger than the cap itself
+        todo = todo[:_PREFETCH_CACHE_CAP]
+        overflow = len(self._read_cache) + len(todo) - _PREFETCH_CACHE_CAP
+        if overflow > 0:
+            for kb in list(itertools.islice(self._read_cache, overflow)):
+                del self._read_cache[kb]
+        self._read_cache.update(self._snapshot.load_batch(todo))
+        return len(todo)
 
     def put(self, kb: bytes, entry: LedgerEntry):
         self.overlay[kb] = to_bytes(LedgerEntry, entry)
@@ -142,5 +197,6 @@ class BucketListStore:
         """Called after ``add_batch`` folded the overlay's changes into
         the bucket list: refresh the snapshot, drop the overlay."""
         self.overlay.clear()
+        self._read_cache.clear()
         self._snapshot = SearchableBucketListSnapshot.from_bucket_list(
             self.bucket_list, self.bucket_manager)

@@ -86,7 +86,7 @@ def _build_account_queues(frames) -> Dict[bytes, List]:
 
 def make_tx_set_from_transactions(
         frames: Sequence, lcl_header, lcl_hash: bytes,
-        soroban_config=None,
+        soroban_config=None, parallel_soroban: Optional[bool] = None,
 ) -> Tuple["ApplicableTxSetFrame", List]:
     """Build a valid (surge-priced) tx set from candidate frames.
 
@@ -99,6 +99,12 @@ def make_tx_set_from_transactions(
     becomes the lowest included per-op bid (reference
     ``makeTxSetFromTransactions`` + ``SurgePricingPriorityQueue`` +
     ``computeLaneBaseFee``).
+
+    ``parallel_soroban`` (default: ledgerVersion >= 23) emits the
+    soroban phase in the PARALLEL representation: footprint-disjoint
+    conflict clusters packed into sequential stages (reference
+    ``TxSetFrame.cpp:677-903`` building stages/clusters) — the
+    TPU-side batch hook: clusters of one stage are data-parallel.
     """
     from stellar_tpu.herder.surge_pricing import (
         SurgePricingLaneConfig, SurgePricingPriorityQueue,
@@ -135,13 +141,112 @@ def make_tx_set_from_transactions(
     else:
         excluded.extend(soroban)
 
+    if parallel_soroban is None:
+        from stellar_tpu.protocol import (
+            PARALLEL_SOROBAN_PHASE_PROTOCOL_VERSION,
+        )
+        parallel_soroban = soroban_phase and \
+            lcl_header.ledgerVersion >= \
+            PARALLEL_SOROBAN_PHASE_PROTOCOL_VERSION
+    stages = None
+    if parallel_soroban and soroban_phase:
+        stages = _build_parallel_stages(inc_s, soroban_config)
     xdr_set = _to_generalized_xdr(inc_c, base_fee_c, inc_s, base_fee_s,
-                                  lcl_hash, soroban_phase)
+                                  lcl_hash, soroban_phase,
+                                  parallel_stages=stages)
     discounts = {id(f): base_fee_c for f in inc_c}
     discounts.update({id(f): base_fee_s for f in inc_s})
     applicable = ApplicableTxSetFrame(xdr_set, inc_c + inc_s, discounts,
-                                      soroban_frames=inc_s)
+                                      soroban_frames=inc_s,
+                                      parallel_stages=stages)
     return applicable, excluded
+
+
+def _parallel_footprint(f) -> Tuple[set, set]:
+    """(written_kbs, touched_kbs) for conflict analysis. The source and
+    fee-source account keys count as writes: two txs from one account
+    mutate its sequence number, so they must serialize in one cluster
+    (the reference's per-account soroban queue limit makes this rare,
+    but a built set must stay correct without it)."""
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.xdr.types import LedgerKey
+    inner = getattr(f, "inner", f)
+    fp = inner.tx.ext.value.resources.footprint
+    rw = {to_bytes(LedgerKey, k) for k in fp.readWrite}
+    ro = {to_bytes(LedgerKey, k) for k in fp.readOnly}
+    rw.add(key_bytes(account_key(f.source_account_id())))
+    if hasattr(f, "fee_source_id"):
+        rw.add(key_bytes(account_key(f.fee_source_id())))
+    return rw, rw | ro
+
+
+def _cluster_order(members: List) -> List:
+    """Deterministic in-cluster order: cross-account positions follow
+    full-hash order, but each account's own txs fill its positions in
+    ascending sequence order — a cluster is a dependency chain, and a
+    same-account pair hash-ordered backwards would fail bad-seq at
+    validation (code-review r3 finding)."""
+    hashed = _sorted_in_hash_order(members)
+    by_acct: Dict[bytes, List] = {}
+    for f in hashed:
+        by_acct.setdefault(f.source_account_id().value, []).append(f)
+    for q in by_acct.values():
+        q.sort(key=lambda f: f.seq_num)
+    taken: Dict[bytes, int] = {}
+    out = []
+    for f in hashed:
+        acct = f.source_account_id().value
+        i = taken.get(acct, 0)
+        taken[acct] = i + 1
+        out.append(by_acct[acct][i])
+    return out
+
+
+def _build_parallel_stages(frames: Sequence, config) -> List[List[List]]:
+    """Partition soroban frames into conflict clusters (union-find over
+    footprint overlap: a WRITE by one tx against any touch by another
+    conflicts) and pack clusters into stages bounded by the network's
+    dependent-cluster cap. Deterministic: cluster members and clusters
+    order by full tx hash (reference ``TxSetFrame.cpp:677-903``)."""
+    if not frames:
+        return []
+    n = len(frames)
+    parent = list(range(n))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    def union(i: int, j: int):
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            parent[rj] = ri
+
+    fps = [_parallel_footprint(f) for f in frames]
+    touchers: Dict[bytes, List[int]] = {}
+    writers: Dict[bytes, List[int]] = {}
+    for i, (rw, touched) in enumerate(fps):
+        for kb in touched:
+            touchers.setdefault(kb, []).append(i)
+        for kb in rw:
+            writers.setdefault(kb, []).append(i)
+    for kb, ws in writers.items():
+        anchor = ws[0]
+        for i in touchers.get(kb, ()):
+            union(anchor, i)
+
+    by_root: Dict[int, List] = {}
+    for i in range(n):
+        by_root.setdefault(find(i), []).append(frames[i])
+    clusters = [_cluster_order(members) for members in by_root.values()]
+    clusters.sort(key=lambda cl: full_tx_hash(cl[0]))
+    max_clusters = max(1, getattr(config,
+                                  "ledger_max_dependent_tx_clusters", 8))
+    return [clusters[i:i + max_clusters]
+            for i in range(0, len(clusters), max_clusters)]
 
 
 def _sorted_in_hash_order(frames) -> List:
@@ -160,12 +265,23 @@ def _phase_xdr(frames, base_fee: int):
 
 
 def _to_generalized_xdr(classic, base_fee_c: int, soroban, base_fee_s: int,
-                        lcl_hash: bytes, soroban_phase: bool):
+                        lcl_hash: bytes, soroban_phase: bool,
+                        parallel_stages=None):
     """Phase 0 = classic, phase 1 = soroban (reference generalized tx
-    set layout from protocol 20; single phase before)."""
+    set layout from protocol 20; single phase before). With
+    ``parallel_stages`` the soroban phase is the parallel
+    representation (stages of independent clusters)."""
     phases = [_phase_xdr(classic, base_fee_c)]
     if soroban_phase:
-        phases.append(_phase_xdr(soroban, base_fee_s))
+        if parallel_stages is not None:
+            from stellar_tpu.xdr.ledger import ParallelTxsComponent
+            phases.append(TransactionPhase.make(1, ParallelTxsComponent(
+                baseFee=base_fee_s,
+                executionStages=[
+                    [[f.envelope for f in cluster] for cluster in stage]
+                    for stage in parallel_stages])))
+        else:
+            phases.append(_phase_xdr(soroban, base_fee_s))
     return GeneralizedTransactionSet.make(
         1, TransactionSetV1(previousLedgerHash=lcl_hash, phases=phases))
 
@@ -294,6 +410,23 @@ class ApplicableTxSetFrame:
         # soroban txs may only ride the soroban phase and vice versa
         for f in self.frames:
             if f.is_soroban() != (id(f) in self._soroban_ids):
+                return False
+        if self.parallel_stages is not None:
+            # the parallel representation is a protocol-23 construct:
+            # accepting it earlier would diverge from the network
+            # (reference gates on PARALLEL_SOROBAN_PHASE_PROTOCOL_
+            # VERSION), and each stage is bounded by the dependent-
+            # cluster cap
+            from stellar_tpu.protocol import (
+                PARALLEL_SOROBAN_PHASE_PROTOCOL_VERSION,
+            )
+            if header.ledgerVersion < \
+                    PARALLEL_SOROBAN_PHASE_PROTOCOL_VERSION:
+                return False
+            max_clusters = soroban_config_of(
+                ltx).ledger_max_dependent_tx_clusters
+            if any(len(stage) > max_clusters
+                   for stage in self.parallel_stages):
                 return False
         # discounted base fee must not be below the protocol minimum
         by_env = {id(f.envelope): full_tx_hash(f) for f in self.frames

@@ -79,6 +79,39 @@ def hash_store_state(store) -> bytes:
     return h.digest()
 
 
+def prefetch_apply_keys(store, frames) -> int:
+    """Collect every ledger key a tx set's fee+apply phases will read —
+    tx/fee/op source accounts, soroban footprint entries and their TTL
+    rows — and warm the store's prefetch cache with one batched sweep.
+    No-op on stores without a prefetch path (dict-backed tests).
+    Returns the number of keys handed to the store."""
+    prefetch = getattr(store, "prefetch", None)
+    if prefetch is None or not frames:
+        return 0
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.host import ttl_key_for
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.xdr.types import LedgerKey
+    kbs = set()
+    for f in frames:
+        kbs.add(key_bytes(account_key(f.source_account_id())))
+        if hasattr(f, "fee_source_id"):
+            kbs.add(key_bytes(account_key(f.fee_source_id())))
+        inner = getattr(f, "inner", f)
+        for op in inner.tx.operations:
+            if op.sourceAccount is not None:
+                from stellar_tpu.xdr.tx import muxed_to_account_id
+                kbs.add(key_bytes(account_key(
+                    muxed_to_account_id(op.sourceAccount))))
+        if f.is_soroban():
+            fp = inner.tx.ext.value.resources.footprint
+            for lk in list(fp.readOnly) + list(fp.readWrite):
+                kbs.add(to_bytes(LedgerKey, lk))
+                kbs.add(key_bytes(ttl_key_for(lk)))
+    prefetch(kbs)
+    return len(kbs)
+
+
 class LedgerManager:
     """Owns the LCL and the close pipeline for one node."""
 
@@ -188,6 +221,14 @@ class LedgerManager:
 
         result = CloseLedgerResult(header=None, header_hash=b"")
         apply_order = lcd.tx_set.get_txs_in_apply_order()
+
+        # bulk prefetch: one batched newest-first bucket sweep for every
+        # entry this set will touch — source accounts + soroban
+        # footprints (+TTLs) — so fee/apply point reads hit a warm cache
+        # instead of per-key file seeks (reference prefetchTxSourceIds,
+        # LedgerManagerImpl.cpp:929-933, + prefetch through the parent,
+        # LedgerTxn.h:815)
+        prefetch_apply_keys(self.root.store, apply_order)
 
         # fee phase first for ALL txs, then apply (reference
         # processFeesSeqNums before applyTransactions)

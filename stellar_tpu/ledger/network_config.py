@@ -60,6 +60,9 @@ class SorobanNetworkConfig:
     temp_rent_rate_denominator: int = 2_524_800
     # per-ledger caps
     ledger_max_tx_count: int = 1
+    # parallel soroban phase (protocol 23+): max independent clusters
+    # per execution stage (reference ledgerMaxDependentTxClusters)
+    ledger_max_dependent_tx_clusters: int = 8
 
 
 # ---------------- CONFIG_SETTING ledger-entry binding ----------------

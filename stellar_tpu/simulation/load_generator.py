@@ -680,6 +680,10 @@ def catchup_replay_bench(n_ledgers: int = 256,
     from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
     from stellar_tpu.work.work import State, WorkScheduler
 
+    if n_ledgers < 63:
+        raise ValueError(
+            "catchup scenario needs >= 63 ledgers (at least one "
+            "published checkpoint to replay)")
     keys = [SecretKey.from_seed_str(f"cr-{i}") for i in range(8)]
     root = seed_root_with_accounts([(k, 10**13) for k in keys])
     lm = LedgerManager(TEST_NETWORK_ID, root)

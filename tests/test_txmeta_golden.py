@@ -321,11 +321,79 @@ def scenario_wasm_counter(version):
     return out
 
 
+def scenario_parallel_soroban(version):
+    """Two independent + one conflicting invoke built as a PARALLEL
+    soroban phase (stages/clusters from footprints): pins the
+    construction, wire form, and stage/cluster apply order."""
+    from stellar_tpu.simulation.load_generator import (
+        _deploy_frames, _soroban_data, _soroban_op,
+    )
+    from stellar_tpu.soroban.host import (
+        contract_code_key, contract_data_key, scaddress_contract, sym,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, HostFunction, HostFunctionType,
+        InvokeContractArgs, SCVal, SCValType,
+    )
+    a, b, c = (keypair("gm-par-a"), keypair("gm-par-b"),
+               keypair("gm-par-c"))
+    lm = _lm_with([(a, 100_000 * XLM), (b, 100_000 * XLM),
+                   (c, 100_000 * XLM)], version)
+    net = lm.network_id
+    import dataclasses
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=10)
+    lm.root.soroban_config = lm.soroban_config
+    code = _counter_code_for_golden()
+    up, create1, cid1, code_hash, inst1 = _deploy_frames(
+        a, (1 << 32) + 1, (1 << 32) + 2, code, net, salt=b"\x41" * 32)
+    _, create2, cid2, _, inst2 = _deploy_frames(
+        a, (1 << 32) + 1, (1 << 32) + 3, code, net, salt=b"\x42" * 32)
+    out = [_close_with(lm, [up]), _close_with(lm, [create1]),
+           _close_with(lm, [create2])]
+
+    def incr(kp, seq, cid, inst_key):
+        addr = scaddress_contract(cid)
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"incr", args=[]))
+        counter_key = contract_data_key(
+            addr, sym("count"),
+            ContractDataDurability.PERSISTENT)
+        return make_tx(
+            kp, seq, [_soroban_op(fn)], fee=6_000_000,
+            soroban_data=_soroban_data(
+                read_only=[inst_key, contract_code_key(code_hash)],
+                read_write=[counter_key]),
+            network_id=net)
+
+    frames = [incr(a, (1 << 32) + 4, cid1, inst1),
+              incr(b, (1 << 32) + 1, cid2, inst2),
+              incr(c, (1 << 32) + 1, cid1, inst1)]
+    lcl = lm.last_closed_header
+    txset, exc = make_tx_set_from_transactions(
+        frames, lcl, lm.last_closed_hash,
+        soroban_config=lm.soroban_config, parallel_soroban=True)
+    assert not exc and txset.parallel_stages is not None
+    out.append(lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lcl.scpValue.closeTime + 5)))
+    return out
+
+
 # soroban is protocol >= 20 only
 SOROBAN_SCENARIOS = {
     "soroban_counter": scenario_soroban_counter,
     "wasm_counter": scenario_wasm_counter,
 }
+
+# the parallel soroban representation is a protocol-23 construct: its
+# golden runs only at the version where validators would accept it
+PARALLEL_SCENARIOS = {
+    "parallel_soroban": scenario_parallel_soroban,
+}
+PARALLEL_VERSIONS = [23]
 
 
 def scenario_claimable_and_feebump(version):
@@ -384,6 +452,24 @@ SCENARIOS = {
 @pytest.mark.parametrize("name", sorted(SOROBAN_SCENARIOS))
 def test_txmeta_soroban_matches_baseline(name, version):
     results = SOROBAN_SCENARIOS[name](version)
+    assert all(r.failed_count == 0 for r in results), \
+        f"{name}@{version} had failing txs"
+    got = outcome_hash(results)
+    key = f"{name}@p{version}"
+    if RECORD:
+        _recorded[key] = got
+        return
+    baseline = _load_baseline()
+    assert key in baseline, \
+        f"no baseline for {key}; record with STELLAR_TPU_RECORD_TEST_TX_META=1"
+    assert got == baseline[key], \
+        f"tx meta drift in {key}: {got} != {baseline[key]}"
+
+
+@pytest.mark.parametrize("version", PARALLEL_VERSIONS)
+@pytest.mark.parametrize("name", sorted(PARALLEL_SCENARIOS))
+def test_txmeta_parallel_matches_baseline(name, version):
+    results = PARALLEL_SCENARIOS[name](version)
     assert all(r.failed_count == 0 for r in results), \
         f"{name}@{version} had failing txs"
     got = outcome_hash(results)
