@@ -5,21 +5,29 @@ Prints ONE JSON line, e.g.:
   {"metric": "txset_sigverify_p50_ms", "value": ..., "unit": "ms",
    "vs_baseline": ..., ...extra diagnostic fields...}
 
-Headline value = p50 per-batch latency in pipelined steady state (depth-8
-pipeline of independent 2048-sig batches: host prep of batch k+1 overlaps
-device execution of batch k, exactly how the herder drains its verify
-queue under load). The blocking single-shot p50 is reported alongside as
-``blocking_p50_ms``; on this harness it is dominated by a fixed ~65 ms
-per-dispatch round-trip through the TPU tunnel relay (measured and
-reported as ``dispatch_floor_ms``) that is absent on locally attached
-TPU hardware and that equally penalizes a single `x+1` kernel.
+Headline ``value`` = BLOCKING single-shot p50 — the BASELINE.md metric
+as written ("<2 ms p50 to verify a 1,000-tx TxSet" is a latency target;
+VERDICT r2 weak #2 requires the scored number to be the unflattering
+definition). Reported alongside:
+
+- ``pipelined_p50_ms``: depth-8 steady state (host prep of batch k+1
+  overlapping device execution of batch k — the herder's queue-drain
+  shape); the throughput story for catchup.
+- ``dispatch_floor_ms``: the MEASURED fixed cost of any dispatch on
+  this harness (median of x+1 on 4 ints), and
+  ``blocking_minus_floor_ms`` — what the kernel itself costs once the
+  harness round-trip is subtracted.
+- ``trickle_p50_ms``: single-sig misses under concurrent load through
+  the TrickleBatcher micro-batch window (SURVEY §7 trickle class),
+  vs ``single_sig_miss_p50_ms`` — the solo-dispatch cost it amortizes.
 
 vs_baseline = (single-core CPU time to verify the same 2048 signatures
 sequentially with OpenSSL ed25519 — same order as libsodium's
 crypto_sign_verify_detached; reference harness:
 SecretKey::benchmarkOpsPerSecond, src/crypto/SecretKey.cpp:193-233)
-divided by the headline per-batch time. Both sides are steady-state
-throughput measures over identical work.
+divided by the headline blocking p50. The verifier is built exactly as
+production builds it (default mesh over all local devices — multi-chip
+hosts shard automatically).
 """
 
 import json
@@ -133,11 +141,16 @@ def main():
                     "is recorded in BENCH_r*.json",
         }))
         return 3
-    from stellar_tpu.crypto.batch_verifier import BatchVerifier
+    from stellar_tpu.crypto.batch_verifier import (
+        BatchVerifier, _auto_mesh,
+    )
     from stellar_tpu.crypto import native_prep
 
     items = gen_sigs(N_SIGS)
-    v = BatchVerifier(bucket_sizes=(N_SIGS,))
+    # production wiring: mesh over every local device (N_SIGS=2048 is
+    # divisible by any power-of-two chip count)
+    mesh = _auto_mesh()
+    v = BatchVerifier(mesh=mesh, bucket_sizes=(N_SIGS,))
 
     # warmup / compile
     for _ in range(2):
@@ -188,26 +201,62 @@ def main():
     single_miss_p50 = float(np.median(miss_times))
     single_hit_p50 = float(np.median(hit_times))
 
+    # trickle under mixed load: 8 threads of lone verifies share
+    # micro-batch dispatches instead of each paying the solo cost
+    trickle_p50, trickle_dispatches = trickle_bench(v)
+
     base = cpu_baseline_ms(items)
     floor = dispatch_floor_ms()
     print(json.dumps({
         "metric": "txset_sigverify_p50_ms",
-        "value": round(p50, 3),
+        "value": round(blocking_p50, 3),
         "unit": "ms",
-        "vs_baseline": round(base / p50, 2),
-        "p95_ms": round(p95, 3),
+        "vs_baseline": round(base / blocking_p50, 2),
         "blocking_p50_ms": round(blocking_p50, 3),
         "blocking_p95_ms": round(blocking_p95, 3),
+        "blocking_minus_floor_ms": round(blocking_p50 - floor, 3),
+        "pipelined_p50_ms": round(p50, 3),
+        "pipelined_p95_ms": round(p95, 3),
+        "vs_baseline_pipelined": round(base / p50, 2),
         "host_prep_ms": round(host_prep_ms, 3),
         "cpu_baseline_ms": round(base, 3),
         "dispatch_floor_ms": round(floor, 3),
         "single_sig_miss_p50_ms": round(single_miss_p50, 3),
         "single_sig_hit_p50_ms": round(single_hit_p50, 4),
+        "trickle_p50_ms": round(trickle_p50, 3),
+        "trickle_dispatches": trickle_dispatches,
         "pipeline_depth": PIPELINE_DEPTH,
         "n_sigs": N_SIGS,
+        "n_devices": 1 if mesh is None else mesh.size,
         "native_prep": native_prep.available(),
     }))
     return 0
+
+
+def trickle_bench(v, n_threads=8, per_thread=16):
+    """p50 per-verify latency of concurrent lone verifies through the
+    micro-batch window, plus how many device dispatches they shared."""
+    import threading
+    from stellar_tpu.crypto.batch_verifier import TrickleBatcher
+    batcher = TrickleBatcher(v, window_ms=1.0, max_batch=128)
+    work = [gen_sigs(per_thread) for _ in range(n_threads)]
+    times = []
+    lock = threading.Lock()
+
+    def run(sigs):
+        for pk, m, s in sigs:
+            t0 = time.perf_counter()
+            ok = batcher.verify_sig(pk, m, s)
+            dt = (time.perf_counter() - t0) * 1000.0
+            assert ok
+            with lock:
+                times.append(dt)
+    threads = [threading.Thread(target=run, args=(w,)) for w in work]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return float(np.median(times)), batcher.dispatches
 
 
 if __name__ == "__main__":

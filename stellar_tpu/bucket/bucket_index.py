@@ -31,6 +31,14 @@ BET = BucketEntryType
 
 _BLOOM_K = 4
 
+# files below the cutoff skip index+seek and serve from memory; the
+# index of larger files persists as a sidecar so restarts don't rescan
+# (reference BUCKETLIST_DB_INDEX_CUTOFF / BUCKETLIST_DB_PERSIST_INDEX;
+# set by Application from Config)
+INDEX_CUTOFF_BYTES = 20 * 1024 * 1024
+PERSIST_INDEX = True
+_INDEX_SIDECAR_VERSION = 1
+
 
 def _iter_frames(raw: bytes):
     """Yield (offset, length, body) for each RFC 5531 record frame."""
@@ -116,26 +124,69 @@ class BucketIndex:
 
 class DiskBucket:
     """A bucket served from its file through a BucketIndex: only the
-    records a lookup touches are ever read or decoded."""
+    records a lookup touches are ever read or decoded. Files below
+    ``INDEX_CUTOFF_BYTES`` are materialized in memory instead (small
+    buckets: the decode is cheaper than per-lookup seeks), and large
+    files persist their index as a ``.idx.npz`` sidecar."""
 
-    __slots__ = ("path", "hash", "_index")
+    __slots__ = ("path", "hash", "_index", "_mem")
 
     def __init__(self, path: str, bucket_hash: bytes,
                  index: Optional[BucketIndex] = None):
         self.path = path
         self.hash = bucket_hash
         self._index = index
+        self._mem = None  # in-memory Bucket for below-cutoff files
+
+    def _memory_bucket(self):
+        if self._mem is None:
+            from stellar_tpu.bucket.bucket import Bucket
+            with open(self.path, "rb") as f:
+                self._mem = Bucket.deserialize(f.read())
+        return self._mem
+
+    def _below_cutoff(self) -> bool:
+        import os
+        try:
+            return os.path.getsize(self.path) < INDEX_CUTOFF_BYTES and \
+                INDEX_CUTOFF_BYTES > 0
+        except OSError:
+            return False
 
     @property
     def index(self) -> BucketIndex:
         if self._index is None:
+            sidecar = self.path + ".idx.npz"
+            import os
+            if PERSIST_INDEX and os.path.exists(sidecar):
+                try:
+                    with np.load(sidecar) as d:
+                        if int(d["version"]) == _INDEX_SIDECAR_VERSION:
+                            self._index = BucketIndex.__new__(BucketIndex)
+                            BucketIndex.__init__(
+                                self._index, d["hashes"], d["offsets"],
+                                d["lengths"])
+                            return self._index
+                except Exception:
+                    pass  # corrupt sidecar: rebuild below
             with open(self.path, "rb") as f:
                 self._index = BucketIndex.build(f.read())
+            if PERSIST_INDEX:
+                try:
+                    np.savez(sidecar,
+                             version=_INDEX_SIDECAR_VERSION,
+                             hashes=self._index.hashes,
+                             offsets=self._index.offsets,
+                             lengths=self._index.lengths)
+                except Exception:
+                    pass  # best effort; the index itself is in memory
         return self._index
 
     def get(self, kb: bytes):
         """BucketEntry for a ledger-key encoding, or None — same
         contract as in-memory ``Bucket.get``."""
+        if self._mem is not None or self._below_cutoff():
+            return self._memory_bucket().get(kb)
         cands = self.index.candidates(kb)
         if not cands:
             return None
@@ -153,6 +204,14 @@ class DiskBucket:
         prefetch amortizing per-lookup seeks,
         ``LedgerTxn.h:815`` prefetch + ``LedgerTxnRoot``'s bulk
         loaders)."""
+        if self._mem is not None or self._below_cutoff():
+            b = self._memory_bucket()
+            out = {}
+            for kb in kbs:
+                e = b.get(kb)
+                if e is not None:
+                    out[kb] = e
+            return out
         wanted = []  # (offset, length, kb)
         for kb in kbs:
             for off, length in self.index.candidates(kb):

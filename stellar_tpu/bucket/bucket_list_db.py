@@ -118,6 +118,16 @@ class SearchableBucketListSnapshot:
 
 
 _PREFETCH_CACHE_CAP = 100_000
+_PREFETCH_BATCH_MAX = 100_000
+
+
+def set_prefetch_limits(entry_cache_size: int,
+                        prefetch_batch_size: int) -> None:
+    """Tune the prefetch cache (reference ENTRY_CACHE_SIZE /
+    PREFETCH_BATCH_SIZE; called by Application from Config)."""
+    global _PREFETCH_CACHE_CAP, _PREFETCH_BATCH_MAX
+    _PREFETCH_CACHE_CAP = max(1, entry_cache_size)
+    _PREFETCH_BATCH_MAX = max(1, prefetch_batch_size)
 
 
 class BucketListStore:
@@ -171,8 +181,8 @@ class BucketListStore:
             return 0
         # keep the bound without dumping warm entries: evict only as
         # many (oldest-inserted) entries as the new batch needs, and
-        # never admit a single batch larger than the cap itself
-        todo = todo[:_PREFETCH_CACHE_CAP]
+        # never admit a single batch larger than the caps
+        todo = todo[:min(_PREFETCH_CACHE_CAP, _PREFETCH_BATCH_MAX)]
         overflow = len(self._read_cache) + len(todo) - _PREFETCH_CACHE_CAP
         if overflow > 0:
             for kb in list(itertools.islice(self._read_cache, overflow)):

@@ -22,6 +22,11 @@ from stellar_tpu.bucket.bucket_list import LiveBucketList, NUM_LEVELS
 
 __all__ = ["BucketManager"]
 
+# durability / GC knobs (reference DISABLE_XDR_FSYNC /
+# DISABLE_BUCKET_GC; set by Application from Config)
+XDR_FSYNC = True
+BUCKET_GC = True
+
 
 class BucketManager:
     def __init__(self, bucket_dir: Optional[str]):
@@ -53,7 +58,8 @@ class BucketManager:
                     with os.fdopen(fd, "wb") as f:
                         f.write(bucket.serialize())
                         f.flush()
-                        os.fsync(f.fileno())
+                        if XDR_FSYNC:
+                            os.fsync(f.fileno())
                     os.rename(tmp, path)
                 except Exception:
                     if os.path.exists(tmp):
@@ -184,6 +190,8 @@ class BucketManager:
     def forget_unreferenced(self, referenced: set):
         """Drop cache entries and delete files not in ``referenced``
         (reference ``forgetUnreferencedBuckets``)."""
+        if not BUCKET_GC:
+            return  # reference DISABLE_BUCKET_GC: keep everything
         referenced = set(referenced) | {EMPTY.hash}
         for h in list(self._cache):
             if h not in referenced:

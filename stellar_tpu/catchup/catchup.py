@@ -29,6 +29,13 @@ from stellar_tpu.ledger.ledger_manager import LedgerCloseData, LedgerManager
 from stellar_tpu.work.work import State, Work, WorkSequence
 from stellar_tpu.xdr.ledger import ledger_header_hash
 
+# test knobs set by the Application from Config:
+# ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING (ms per bucket) and
+# CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING (resolve pending bucket
+# merges after every replayed ledger, reference Config.h)
+BUCKET_APPLY_DELAY_MS = 0
+WAIT_MERGES_ON_APPLY = False
+
 __all__ = ["verify_ledger_chain", "CatchupConfiguration", "CatchupWork",
            "replay_checkpoint", "apply_buckets_catchup", "LedgerApplyManager"]
 
@@ -106,6 +113,12 @@ def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
             raise ValueError(
                 f"replay diverged at ledger {seq}: "
                 f"{res.header_hash.hex()[:16]} != {hhe.hash.hex()[:16]}")
+        if WAIT_MERGES_ON_APPLY and lm.bucket_list is not None:
+            # resolve every pending merge before the next replayed
+            # ledger (reference CATCHUP_WAIT_MERGES_TX_APPLY — keeps
+            # replay memory flat at the cost of pipelining)
+            for lev in lm.bucket_list.levels:
+                _ = lev.next
         applied += 1
     return applied
 
@@ -124,7 +137,13 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
     preloaded_buckets = preloaded_buckets or {}
 
     bl = LiveBucketList()
+    if BUCKET_APPLY_DELAY_MS:
+        import time as _time
     for i, level in enumerate(has.bucket_hashes):
+        if BUCKET_APPLY_DELAY_MS:
+            # injected per-level apply latency (reference
+            # ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING)
+            _time.sleep(BUCKET_APPLY_DELAY_MS / 1000.0)
         for attr in ("curr", "snap", "next"):
             if attr == "next":
                 hexhash = HistoryArchiveState.next_output(level)

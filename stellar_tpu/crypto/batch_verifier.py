@@ -202,12 +202,80 @@ class BatchVerifier:
         behind it with :meth:`install`)."""
         return bool(self.verify_batch([(pk, msg, sig)])[0])
 
-    def install(self) -> "BatchVerifier":
+    def install(self, trickle_window_ms: Optional[float] = None
+                ) -> "BatchVerifier":
         """Make this verifier the backend for ``keys.verify_sig`` so all
-        single-sig call sites hit the shared cache first, then the TPU."""
+        single-sig call sites hit the shared cache first, then the TPU.
+
+        ``trickle_window_ms`` wires a :class:`TrickleBatcher` in front:
+        worth it when verify callers are CONCURRENT (overlay auth,
+        threaded replay); in a purely single-threaded crank it only
+        adds the window to each miss, so it stays opt-in."""
         from stellar_tpu.crypto import keys
-        keys.set_verifier_backend(self.verify_sig)
+        if trickle_window_ms is not None:
+            batcher = TrickleBatcher(self, window_ms=trickle_window_ms)
+            keys.set_verifier_backend(batcher.verify_sig)
+        else:
+            keys.set_verifier_backend(self.verify_sig)
         return self
+
+
+class TrickleBatcher:
+    """Micro-batch window for single-signature verify misses — the
+    "trickle queue class" of SURVEY §7: bulk paths batch explicitly,
+    but lone verifies (overlay auth handshakes, single SCP envelopes)
+    would each pay a full solo device dispatch. Concurrent arrivals
+    collect for up to ``window_ms`` (or ``max_batch``) and ride ONE
+    dispatch; the synchronous bool API is preserved by parking callers
+    on futures. The first caller of a window is the leader: it waits
+    the window out, dispatches everything queued, and resolves every
+    future; followers just block on theirs."""
+
+    def __init__(self, verifier: BatchVerifier, window_ms: float = 1.0,
+                 max_batch: int = 64):
+        self._verifier = verifier
+        self._window = window_ms / 1000.0
+        self._max = max_batch
+        self._cv = threading.Condition()
+        self._pending: list = []  # ((pk, msg, sig), Future)
+        self._leader_active = False
+        self.dispatches = 0  # instrumentation (bench / tests)
+
+    def verify_sig(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        from concurrent.futures import Future
+        import time
+        fut: Future = Future()
+        with self._cv:
+            self._pending.append(((pk, msg, sig), fut))
+            if self._leader_active:
+                if len(self._pending) >= self._max:
+                    self._cv.notify_all()  # wake the leader early
+                lead = False
+            else:
+                self._leader_active = True
+                lead = True
+        if lead:
+            deadline = time.perf_counter() + self._window
+            with self._cv:
+                while len(self._pending) < self._max:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
+                batch = self._pending
+                self._pending = []
+                self._leader_active = False
+            self.dispatches += 1
+            try:
+                results = self._verifier.verify_batch(
+                    [item for item, _f in batch])
+            except BaseException as e:
+                for _item, f in batch:
+                    f.set_exception(e)
+                raise
+            for (_item, f), ok in zip(batch, results):
+                f.set_result(bool(ok))
+        return fut.result()
 
 
 # Padding rows: any syntactically valid inputs work (results are sliced

@@ -109,17 +109,39 @@ class _HerderSCPDriver(SCPDriver):
         self.herder._value_externalized(slot_index, value)
 
 
+def _excluded_op_types(names) -> frozenset:
+    """OperationType values for configured names (reference
+    EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE); unknown names are
+    a config error."""
+    if not names:
+        return frozenset()
+    from stellar_tpu.xdr.tx import OperationType
+    out = set()
+    for name in names:
+        t = getattr(OperationType, name, None)
+        if t is None:
+            raise ValueError(f"unknown operation type {name!r} in "
+                             "EXCLUDE_TRANSACTIONS_CONTAINING_"
+                             "OPERATION_TYPE")
+        out.add(t)
+    return frozenset(out)
+
+
 class Herder:
     def __init__(self, secret_key: SecretKey, network_id: bytes,
                  ledger_manager: LedgerManager, clock: VirtualClock,
                  qset: SCPQuorumSet, is_validator: bool = True,
                  target_close_seconds: int = EXP_LEDGER_TIMESPAN_SECONDS,
-                 max_slots_to_remember: int = 12):
+                 max_slots_to_remember: int = 12,
+                 node_config=None):
         self.secret_key = secret_key
         self.network_id = network_id
         self.lm = ledger_manager
         self.clock = clock
         self.target_close_seconds = target_close_seconds
+        # operational knobs (reference Config.h); node_config is the
+        # main Config when running inside an Application
+        self.node_config = node_config
         # externalized-slot retention (reference MAX_SLOTS_TO_REMEMBER)
         self.max_slots_to_remember = max(max_slots_to_remember,
                                          SCP_EXTRA_LOOKBACK_LEDGERS)
@@ -140,9 +162,22 @@ class Herder:
         # fetch hooks (wired by the overlay): ask peers for missing items
         self.request_tx_set: Callable = lambda h: None
         self.request_quorum_set: Callable = lambda h: None
+        # queue capacities scale the ledger limits by the configured
+        # multipliers (reference TRANSACTION_QUEUE_SIZE_MULTIPLIER /
+        # SOROBAN_TRANSACTION_QUEUE_SIZE_MULTIPLIER); excluded op types
+        # and the ban depth ride the same Config
+        _mult = getattr(node_config,
+                        "TRANSACTION_QUEUE_SIZE_MULTIPLIER", 2)
+        _smult = getattr(node_config,
+                         "SOROBAN_TRANSACTION_QUEUE_SIZE_MULTIPLIER", 2)
+        _ban = getattr(node_config, "TRANSACTION_QUEUE_BAN_LEDGERS", 10)
+        _excluded = _excluded_op_types(getattr(
+            node_config,
+            "EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE", ()))
         self.tx_queue = TransactionQueue(
-            max_ops=2 * self.lm.last_closed_header.maxTxSetSize,
-            check_valid=self._check_tx_valid)
+            max_ops=_mult * self.lm.last_closed_header.maxTxSetSize,
+            check_valid=self._check_tx_valid, ban_ledgers=_ban,
+            excluded_op_types=_excluded)
         # Soroban txs queue separately with their own (tx-count) limits
         # (reference SorobanTransactionQueue); pull-mode relay and set
         # building see both through the facade methods below
@@ -153,8 +188,9 @@ class Herder:
             )
             _scfg = default_soroban_config()
         self.soroban_tx_queue = TransactionQueue(
-            max_ops=2 * _scfg.ledger_max_tx_count,
-            check_valid=self._check_tx_valid)
+            max_ops=_smult * _scfg.ledger_max_tx_count,
+            check_valid=self._check_tx_valid, ban_ledgers=_ban,
+            excluded_op_types=_excluded)
         self.state = HERDER_STATE.BOOTING
         self.tracking_slot = 0
         # buffering + catchup arbitration for out-of-order externalizes
@@ -566,13 +602,20 @@ class Herder:
         # queue bookkeeping
         self.tx_queue.remove_applied(txset.frames)
         self.tx_queue.shift()
-        self.tx_queue.max_ops = 2 * self.lm.last_closed_header.maxTxSetSize
+        # ledger limits can change via upgrades mid-run; re-derive the
+        # queue caps with the CONFIGURED multipliers
+        _mult = getattr(self.node_config,
+                        "TRANSACTION_QUEUE_SIZE_MULTIPLIER", 2)
+        _smult = getattr(self.node_config,
+                         "SOROBAN_TRANSACTION_QUEUE_SIZE_MULTIPLIER", 2)
+        self.tx_queue.max_ops = \
+            _mult * self.lm.last_closed_header.maxTxSetSize
         self.soroban_tx_queue.remove_applied(txset.frames)
         self.soroban_tx_queue.shift()
-        # config upgrades can change the per-ledger soroban cap mid-run
         scfg = getattr(self.lm, "soroban_config", None)
         if scfg is not None:
-            self.soroban_tx_queue.max_ops = 2 * scfg.ledger_max_tx_count
+            self.soroban_tx_queue.max_ops = \
+                _smult * scfg.ledger_max_tx_count
         # GC old slots + their timers + txsets
         keep_from = max(1, slot_index - self.max_slots_to_remember)
         self.scp.purge_slots(keep_from)

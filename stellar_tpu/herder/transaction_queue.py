@@ -38,13 +38,17 @@ class TransactionQueue:
     def __init__(self, max_ops: int,
                  check_valid: Callable,
                  pending_depth: int = PENDING_DEPTH,
-                 ban_ledgers: int = BAN_LEDGERS):
+                 ban_ledgers: int = BAN_LEDGERS,
+                 excluded_op_types=frozenset()):
         self.max_ops = max_ops
         # (frame, current_seq) -> MutableTxResult; current_seq 0 means
         # "use the account's ledger seq"
         self.check_valid = check_valid
         self.pending_depth = pending_depth
         self.ban_ledgers = ban_ledgers
+        # OperationType values refused at admission (reference
+        # EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE)
+        self.excluded_op_types = frozenset(excluded_op_types)
         # account raw key -> list of frames in seq order (+ age)
         self.accounts: Dict[bytes, List] = {}
         self.ages: Dict[bytes, int] = {}
@@ -72,6 +76,11 @@ class TransactionQueue:
             return AddResult(AddResult.ADD_STATUS_BANNED)
         if h in self.known_hashes:
             return AddResult(AddResult.ADD_STATUS_DUPLICATE)
+        if self.excluded_op_types:
+            inner = getattr(frame, "inner", frame)
+            if any(op.body.arm in self.excluded_op_types
+                   for op in inner.tx.operations):
+                return AddResult(AddResult.ADD_STATUS_FILTERED)
 
         acc = frame.source_account_id().value
         chain = self.accounts.get(acc, [])

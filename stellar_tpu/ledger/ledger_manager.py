@@ -202,6 +202,12 @@ class LedgerManager:
         return result
 
     def _close_ledger_inner(self, lcd: LedgerCloseData) -> CloseLedgerResult:
+        delay_ms = getattr(self, "close_delay_ms", 0)
+        if delay_ms:
+            # injected close latency (reference
+            # ARTIFICIALLY_DELAY_LEDGER_CLOSE_FOR_TESTING)
+            import time as _time
+            _time.sleep(delay_ms / 1000.0)
         lcl = self.last_closed_header
         if lcd.ledger_seq != lcl.ledgerSeq + 1:
             raise ValueError(

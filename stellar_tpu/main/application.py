@@ -32,6 +32,7 @@ class Application:
         self.clock = clock if clock is not None else \
             VirtualClock(REAL_TIME)
         network_id = config.network_id()
+        self._apply_global_config(config)
         self.database = None
         self.persistence = None
         self.lm = None
@@ -68,7 +69,9 @@ class Application:
             config.NODE_SEED, network_id, self.lm, self.clock, qset,
             is_validator=config.NODE_IS_VALIDATOR,
             target_close_seconds=config.EXPECTED_LEDGER_CLOSE_TIME,
-            max_slots_to_remember=config.MAX_SLOTS_TO_REMEMBER)
+            max_slots_to_remember=config.MAX_SLOTS_TO_REMEMBER,
+            node_config=config)
+        self._stage_testing_upgrades(config, fresh)
         self.peer_auth = PeerAuth(config.NODE_SEED, network_id,
                                   self.clock.system_now())
         self.overlay = OverlayManager(self)
@@ -82,7 +85,35 @@ class Application:
                 [archive_from_config(p) for p in config.HISTORY_ARCHIVES],
                 config.NETWORK_PASSPHRASE)
         from stellar_tpu.process import ProcessManager
-        self.process_manager = ProcessManager()
+        self.process_manager = ProcessManager(
+            max_concurrent=config.MAX_CONCURRENT_SUBPROCESSES)
+        # ledger-side test/tuning knobs
+        if config.TESTING_EVICTION_SCAN_SIZE > 0:
+            self.lm.eviction_scanner.max_entries = \
+                config.TESTING_EVICTION_SCAN_SIZE
+        if config.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME > 0:
+            import dataclasses as _dc
+            self.lm.soroban_config = _dc.replace(
+                self.lm.soroban_config,
+                min_persistent_ttl=(
+                    config.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME))
+            self.lm.root.soroban_config = self.lm.soroban_config
+        self.lm.close_delay_ms = \
+            config.ARTIFICIALLY_DELAY_LEDGER_CLOSE_FOR_TESTING
+        # process-wide knobs: push only non-default values (see
+        # _apply_global_config's rationale)
+        _d = Config()
+        if config.OUTBOUND_TX_QUEUE_BYTE_LIMIT != \
+                _d.OUTBOUND_TX_QUEUE_BYTE_LIMIT:
+            from stellar_tpu.overlay.tx_adverts import TxAdverts
+            TxAdverts.queue_byte_limit = \
+                config.OUTBOUND_TX_QUEUE_BYTE_LIMIT
+        from stellar_tpu.catchup import catchup as catchup_mod
+        if config.ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING:
+            catchup_mod.BUCKET_APPLY_DELAY_MS = \
+                config.ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING
+        if config.CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING:
+            catchup_mod.WAIT_MERGES_ON_APPLY = True
         from stellar_tpu.utils.status import StatusManager
         self.status_manager = StatusManager()
         self._meta_stream_file = None
@@ -104,6 +135,134 @@ class Application:
             set_active_manager(
                 InvariantManager(config.INVARIANT_CHECKS))
         self._started = False
+
+    def _apply_global_config(self, config: Config):
+        """Push Config knobs into the process-wide services they tune
+        (reference ApplicationImpl reading Config at construction).
+
+        Only knobs that DIFFER from their defaults are pushed: these
+        services are process-wide, and multi-node-in-one-process
+        simulations must not have a later default-config node silently
+        reset a tuned one. (Two nodes tuning the same global knob
+        differently still last-writes — matching the reference, where
+        one process is one node.)"""
+        import dataclasses as _dc
+        defaults = Config.__new__(Config)
+        for f in _dc.fields(Config):
+            if f.default is not _dc.MISSING:
+                setattr(defaults, f.name, f.default)
+            elif f.default_factory is not _dc.MISSING:
+                setattr(defaults, f.name, f.default_factory())
+
+        def changed(name: str) -> bool:
+            return getattr(config, name) != getattr(defaults, name)
+
+        from stellar_tpu.utils import workers
+        if config.WORKER_THREADS > 0:
+            import concurrent.futures
+            with workers._lock:
+                if workers._pool is None:
+                    workers._pool = \
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=config.WORKER_THREADS,
+                            thread_name_prefix="bg-work")
+        if changed("BACKGROUND_BUCKET_MERGES") or \
+                changed("ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING"):
+            workers.set_background(
+                config.BACKGROUND_BUCKET_MERGES and
+                not config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
+        # logging sinks (reference LOG_FILE_PATH / LOG_COLOR)
+        if config.LOG_FILE_PATH:
+            import logging
+            import os
+            root_logger = logging.getLogger("stellar_tpu")
+            want = os.path.abspath(config.LOG_FILE_PATH)
+            if not any(isinstance(h, logging.FileHandler) and
+                       getattr(h, "baseFilename", None) == want
+                       for h in root_logger.handlers):
+                handler = logging.FileHandler(config.LOG_FILE_PATH)
+                handler.setFormatter(logging.Formatter(
+                    "%(asctime)s %(name)s %(levelname)s %(message)s"))
+                root_logger.addHandler(handler)
+        if config.LOG_COLOR:
+            from stellar_tpu.utils.logging import set_log_color
+            set_log_color(True)
+        # soroban host diagnostics (reference
+        # ENABLE_SOROBAN_DIAGNOSTIC_EVENTS)
+        if changed("ENABLE_SOROBAN_DIAGNOSTIC_EVENTS"):
+            from stellar_tpu.soroban import host as soroban_host
+            soroban_host.DIAGNOSTIC_EVENTS_ENABLED = \
+                config.ENABLE_SOROBAN_DIAGNOSTIC_EVENTS
+        # internal tx errors: trap-and-fail (default) vs halt for
+        # debugging (reference HALT_ON_INTERNAL_TRANSACTION_ERROR)
+        from stellar_tpu.tx import transaction_frame as txf
+        if changed("HALT_ON_INTERNAL_TRANSACTION_ERROR"):
+            txf.HALT_ON_INTERNAL_ERROR = \
+                config.HALT_ON_INTERNAL_TRANSACTION_ERROR
+        # weighted per-op apply sleep (reference OP_APPLY_SLEEP_TIME_*)
+        if config.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING:
+            if len(config.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING) != \
+                    len(config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING):
+                raise ValueError(
+                    "OP_APPLY_SLEEP duration/weight lengths differ")
+            txf.OP_APPLY_SLEEP = (
+                list(config.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING),
+                list(config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING))
+        # bucket-file durability / GC / index knobs
+        from stellar_tpu.bucket import bucket_index as bi_mod
+        from stellar_tpu.bucket import bucket_manager as bm_mod
+        if changed("DISABLE_XDR_FSYNC"):
+            bm_mod.XDR_FSYNC = not config.DISABLE_XDR_FSYNC
+        if changed("DISABLE_BUCKET_GC"):
+            bm_mod.BUCKET_GC = not config.DISABLE_BUCKET_GC
+        if changed("BUCKETLIST_DB_INDEX_CUTOFF"):
+            bi_mod.INDEX_CUTOFF_BYTES = config.BUCKETLIST_DB_INDEX_CUTOFF
+        if changed("BUCKETLIST_DB_PERSIST_INDEX"):
+            bi_mod.PERSIST_INDEX = config.BUCKETLIST_DB_PERSIST_INDEX
+        if changed("ENTRY_CACHE_SIZE") or changed("PREFETCH_BATCH_SIZE"):
+            from stellar_tpu.bucket import bucket_list_db as bldb
+            bldb.set_prefetch_limits(config.ENTRY_CACHE_SIZE,
+                                     config.PREFETCH_BATCH_SIZE)
+
+    def _stage_testing_upgrades(self, config: Config,
+                                fresh: bool = True):
+        """TESTING_UPGRADE_* fields stage upgrade votes at startup for
+        standalone test networks (reference Config.h TESTING_UPGRADE
+        family + USE_CONFIG_FOR_GENESIS)."""
+        p = self.herder.upgrades.params
+        staged = False
+        if config.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION > 0:
+            p.protocol_version = \
+                config.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION
+            staged = True
+        if config.TESTING_UPGRADE_DESIRED_FEE > 0:
+            p.base_fee = config.TESTING_UPGRADE_DESIRED_FEE
+            staged = True
+        if config.TESTING_UPGRADE_MAX_TX_SET_SIZE > 0:
+            p.max_tx_set_size = config.TESTING_UPGRADE_MAX_TX_SET_SIZE
+            staged = True
+        if config.TESTING_UPGRADE_RESERVE > 0:
+            p.base_reserve = config.TESTING_UPGRADE_RESERVE
+            staged = True
+        if staged:
+            p.upgrade_time = 0  # vote immediately
+        if config.USE_CONFIG_FOR_GENESIS and fresh and staged:
+            # standalone genesis adopts the staged values directly;
+            # the LCL hash must be recomputed or ledger 2's
+            # previousLedgerHash would commit to the pre-mutation
+            # header and chain verification would fail
+            hdr = self.lm.last_closed_header
+            if config.TESTING_UPGRADE_DESIRED_FEE > 0:
+                hdr.baseFee = config.TESTING_UPGRADE_DESIRED_FEE
+            if config.TESTING_UPGRADE_MAX_TX_SET_SIZE > 0:
+                hdr.maxTxSetSize = config.TESTING_UPGRADE_MAX_TX_SET_SIZE
+            if config.TESTING_UPGRADE_RESERVE > 0:
+                hdr.baseReserve = config.TESTING_UPGRADE_RESERVE
+            if config.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION > 0:
+                hdr.ledgerVersion = \
+                    config.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION
+            from stellar_tpu.xdr.ledger import ledger_header_hash
+            self.lm._lcl_hash = ledger_header_hash(hdr)
 
     def _open_meta_stream(self, spec: str):
         """Stream framed LedgerCloseMeta XDR per close (reference
@@ -140,6 +299,75 @@ class Application:
                 self.database is not None:
             self._schedule_maintenance()
         self._schedule_overlay_tick()
+        self._schedule_advert_flush()
+        if self.config.AUTOMATIC_SELF_CHECK_PERIOD > 0:
+            self._schedule_self_check()
+        # self-issued admin commands (reference COMMANDS)
+        for cmd in self.config.COMMANDS:
+            self._run_self_command(cmd)
+
+    def _run_self_command(self, cmd: str):
+        """Dispatch one admin route as if it arrived over HTTP
+        (reference Config COMMANDS executed at startup) — same
+        dispatch shape as the HTTP handler: route(handler, params)
+        with parse_qs list-valued params."""
+        from urllib.parse import parse_qs, urlsplit
+        handler = getattr(self, "command_handler", None)
+        if handler is None:
+            raise ValueError(
+                "COMMANDS configured but no command handler is "
+                "attached; start the node through `run` (or attach "
+                "app.command_handler) before Application.start()")
+        parts = urlsplit("/" + cmd.lstrip("/"))
+        name = parts.path.lstrip("/")
+        route = handler.routes.get(name)
+        if route is None:
+            raise ValueError(f"unknown COMMANDS entry {cmd!r}")
+        route(handler, parse_qs(parts.query))
+
+    def _schedule_advert_flush(self):
+        """Recurring tx-advert flush + pre-verified tx admission
+        (reference FLOOD_ADVERT_PERIOD_MS timer)."""
+        period = self.overlay.advert_period_s
+        if period <= 0:
+            return
+        from stellar_tpu.utils.timer import VirtualTimer
+
+        def run():
+            self.overlay.flush_adverts_tick()
+            self._schedule_advert_flush()
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(period)
+        t.async_wait(run, lambda: None)
+        self._advert_flush_timer = t
+
+    def _schedule_self_check(self):
+        """Periodic integrity self-check (reference
+        AUTOMATIC_SELF_CHECK_PERIOD + ApplicationUtils selfCheck):
+        bucket-list hash must match the LCL header's commitment."""
+        from stellar_tpu.utils.timer import VirtualTimer
+
+        def run():
+            self.self_check()
+            self._schedule_self_check()
+        t = VirtualTimer(self.clock)
+        t.expires_from_now(self.config.AUTOMATIC_SELF_CHECK_PERIOD)
+        t.async_wait(run, lambda: None)
+        self._self_check_timer = t
+
+    def self_check(self) -> bool:
+        """Bucket-list integrity vs the header commitment."""
+        import logging
+        lm = self.lm
+        if lm.bucket_list is None:
+            return True
+        ok = lm.bucket_list.hash() == \
+            lm.last_closed_header.bucketListHash
+        if not ok:
+            logging.getLogger("stellar_tpu.main").error(
+                "SELF-CHECK FAILED: bucket list hash does not match "
+                "the LCL header")
+        return ok
 
     def _schedule_overlay_tick(self):
         """Recurring peer-liveness sweep (reference OverlayManager
@@ -169,6 +397,13 @@ class Application:
         self._maintenance_timer = t
 
     def crank(self, block: bool = False) -> int:
+        if self.config.ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING > 0:
+            # injected main-thread contention (reference
+            # ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING, microseconds)
+            import time as _time
+            _time.sleep(
+                self.config.ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING
+                / 1_000_000.0)
         n = self.clock.crank(block)
         # reap finished archive subprocesses (reference: exit handlers
         # posted back to the main thread)
@@ -224,6 +459,8 @@ class Application:
         """The node fell behind the network (reference
         LM_CATCHING_UP_STATE): run a CatchupWork from the configured
         archives, then drain the herder's buffered externalizes."""
+        if not self.config.MODE_DOES_CATCHUP:
+            return  # reference MODE_DOES_CATCHUP=false: observe only
         if self._catchup_work is not None and \
                 not self._catchup_work.is_done():
             return  # already catching up
@@ -315,7 +552,8 @@ class Application:
                       self.overlay.authenticated_count(),
                       "pending_count": len(self.overlay.pending_peers)},
             "quorum": {"node": self.config.NODE_SEED.public_key
-                       .to_strkey()},
+                       .to_strkey(),
+                       "home_domain": self.config.NODE_HOME_DOMAIN},
             "network": self.config.NETWORK_PASSPHRASE,
             "protocol_version": lcl.ledgerVersion,
             "history": {

@@ -42,19 +42,24 @@ def cmd_run(args) -> int:
     from stellar_tpu.overlay.tcp import TCPDriver
     cfg = _load_config(args)
     app = Application(cfg)
-    tcp = TCPDriver(app, cfg.PEER_PORT)
+    tcp = None
+    if cfg.MODE_AUTO_STARTS_OVERLAY:
+        tcp = TCPDriver(app, cfg.PEER_PORT)
     http = CommandHandler(app, cfg.HTTP_PORT)
+    app.command_handler = http
     query = None
     if cfg.HTTP_QUERY_PORT:
         from stellar_tpu.main.command_handler import QueryServer
         query = QueryServer(app, cfg.HTTP_QUERY_PORT)
-    print(f"stellar_tpu node up: peer port {tcp.door.port}, "
-          f"http port {http.port}"
+    print("stellar_tpu node up: "
+          + (f"peer port {tcp.door.port}, " if tcp else "no overlay, ")
+          + f"http port {http.port}"
           + (f", query port {query.port}" if query else ""),
           file=sys.stderr)
-    for spec in cfg.KNOWN_PEERS:
-        host, _, port = spec.partition(":")
-        tcp.connect(host, int(port or 11625))
+    if tcp is not None:
+        for spec in cfg.KNOWN_PEERS:
+            host, _, port = spec.partition(":")
+            tcp.connect(host, int(port or 11625))
     app.start()
     try:
         while True:

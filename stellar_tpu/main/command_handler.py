@@ -22,7 +22,17 @@ class CommandHandler:
         self.app = app
         self.routes = dict(self.ROUTES if routes is None else routes)
         handler = self._make_handler()
-        self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        cfg = getattr(app, "config", None)
+        # loopback unless the operator opted into a public admin port
+        # (reference PUBLIC_HTTP_PORT); backlog per HTTP_MAX_CLIENT
+        host = "0.0.0.0" if getattr(cfg, "PUBLIC_HTTP_PORT", False) \
+            else "127.0.0.1"
+        backlog = getattr(cfg, "HTTP_MAX_CLIENT", 128)
+
+        class _Server(ThreadingHTTPServer):
+            # per-instance backlog, not a process-global class mutation
+            request_queue_size = backlog
+        self.server = _Server((host, port), handler)
         self.port = self.server.server_address[1]
         self.thread = threading.Thread(target=self.server.serve_forever,
                                        daemon=True)

@@ -40,45 +40,134 @@ class Config:
     RUN_STANDALONE: bool = False
     MANUAL_CLOSE: bool = False
 
+    # herder / transaction queues (reference Config.h queue knobs)
+    TRANSACTION_QUEUE_SIZE_MULTIPLIER: int = 4
+    SOROBAN_TRANSACTION_QUEUE_SIZE_MULTIPLIER: int = 2
+    TRANSACTION_QUEUE_BAN_LEDGERS: int = 10
+    # ops of DEX-crossing txs admitted per set; None = no dedicated cap
+    MAX_DEX_TX_OPERATIONS_IN_TX_SET: Optional[int] = None
+    # OperationType names rejected at queue admission (reference
+    # EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE)
+    EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE: List[str] = \
+        field(default_factory=list)
+    # flood pacing (reference FLOOD_* family, herder/overlay broadcast)
+    FLOOD_OP_RATE_PER_LEDGER: float = 1.0
+    FLOOD_TX_PERIOD_MS: int = 200
+    FLOOD_SOROBAN_RATE_PER_LEDGER: float = 1.0
+    FLOOD_SOROBAN_TX_PERIOD_MS: int = 200
+    FLOOD_ADVERT_PERIOD_MS: int = 100
+    FLOOD_DEMAND_PERIOD_MS: int = 200
+    FLOOD_DEMAND_BACKOFF_DELAY_MS: int = 500
+
     # overlay
     PEER_PORT: int = 11625
     TARGET_PEER_CONNECTIONS: int = 8
     MAX_PEER_CONNECTIONS: int = 64
     MAX_PENDING_CONNECTIONS: int = 500
+    MAX_INBOUND_PENDING_CONNECTIONS: int = 0   # 0 = derive from above
+    MAX_OUTBOUND_PENDING_CONNECTIONS: int = 0  # 0 = derive from above
     KNOWN_PEERS: List[str] = field(default_factory=list)
     PREFERRED_PEERS: List[str] = field(default_factory=list)
+    # strkeys whose connections count as preferred regardless of address
+    PREFERRED_PEER_KEYS: List[str] = field(default_factory=list)
+    PREFERRED_PEERS_ONLY: bool = False
     # liveness sweeps (reference PEER_TIMEOUT /
-    # PEER_AUTHENTICATION_TIMEOUT, seconds)
+    # PEER_AUTHENTICATION_TIMEOUT / PEER_STRAGGLER_TIMEOUT, seconds)
     PEER_TIMEOUT: int = 30
     PEER_AUTHENTICATION_TIMEOUT: int = 10
+    PEER_STRAGGLER_TIMEOUT: int = 120
+    PEER_READING_CAPACITY: int = 200
     PEER_FLOOD_READING_CAPACITY: int = 200
     PEER_FLOOD_READING_CAPACITY_BYTES: int = 300_000
     FLOW_CONTROL_SEND_MORE_BATCH_SIZE: int = 40
     FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES: int = 100_000
+    # socket write batching (reference MAX_BATCH_WRITE_*)
+    MAX_BATCH_WRITE_COUNT: int = 1024
+    MAX_BATCH_WRITE_BYTES: int = 1024 * 1024
+    OUTBOUND_TX_QUEUE_BYTE_LIMIT: int = 1024 * 1024 * 3
+    # strkeys allowed to run time-sliced surveys against this node
+    SURVEYOR_KEYS: List[str] = field(default_factory=list)
+    # handshake version window (reference OVERLAY_PROTOCOL_VERSION /
+    # OVERLAY_PROTOCOL_MIN_VERSION)
+    OVERLAY_PROTOCOL_VERSION: int = 38
+    OVERLAY_PROTOCOL_MIN_VERSION: int = 35
+    # off-crank signature pre-verification of received tx floods
+    BACKGROUND_OVERLAY_PROCESSING: bool = True
+    ALLOW_LOCALHOST_FOR_TESTING: bool = False
 
     # persistence (reference DATABASE / BUCKET_DIR_PATH): None keeps the
     # node fully in-memory (tests); a path makes every close durable
     DATABASE: Optional[str] = None
     BUCKET_DIR_PATH: Optional[str] = None
+    DISABLE_XDR_FSYNC: bool = False
+    DISABLE_BUCKET_GC: bool = False
+    # buckets below the cutoff are served from memory, not index+seek
+    BUCKETLIST_DB_INDEX_CUTOFF: int = 20 * 1024 * 1024
+    BUCKETLIST_DB_PERSIST_INDEX: bool = True
+    # LedgerTxnRoot prefetch cache entries + per-sweep batch bound
+    ENTRY_CACHE_SIZE: int = 100_000
+    PREFETCH_BATCH_SIZE: int = 1_000
+
+    # background work (reference WORKER_THREADS; 0 = auto)
+    WORKER_THREADS: int = 0
+    BACKGROUND_BUCKET_MERGES: bool = True
+    MAX_CONCURRENT_SUBPROCESSES: int = 16
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)
 
     # ops / observability
     LOG_LEVEL: str = "INFO"
+    LOG_FILE_PATH: Optional[str] = None
+    LOG_COLOR: bool = False
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HTTP_PORT: int = 11626
     HTTP_QUERY_PORT: int = 0  # 0 disables the query server
+    HTTP_MAX_CLIENT: int = 128
+    # bind the admin port on all interfaces instead of loopback
+    PUBLIC_HTTP_PORT: bool = False
+    # admin commands self-issued once the app is set up (reference
+    # COMMANDS, e.g. ["ll?level=debug"])
+    COMMANDS: List[str] = field(default_factory=list)
+    NODE_HOME_DOMAIN: str = ""
     # framed LedgerCloseMeta XDR per close (reference
     # METADATA_OUTPUT_STREAM; "fd:N" or a file path)
     METADATA_OUTPUT_STREAM: Optional[str] = None
+    ENABLE_SOROBAN_DIAGNOSTIC_EVENTS: bool = False
     AUTOMATIC_MAINTENANCE_PERIOD: int = 14400  # seconds; 0 disables
     AUTOMATIC_MAINTENANCE_COUNT: int = 50_000
+    AUTOMATIC_SELF_CHECK_PERIOD: int = 0  # seconds; 0 disables
     CATCHUP_COMPLETE: bool = False
     CATCHUP_RECENT: int = 0
+    HALT_ON_INTERNAL_TRANSACTION_ERROR: bool = False
+    MODE_DOES_CATCHUP: bool = True
+    MODE_AUTO_STARTS_OVERLAY: bool = True
 
-    # test knobs (reference ARTIFICIALLY_* family)
+    # genesis / upgrade staging for standalone test networks (reference
+    # TESTING_UPGRADE_* + USE_CONFIG_FOR_GENESIS)
+    USE_CONFIG_FOR_GENESIS: bool = False
+    TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION: int = 0  # 0 = unset
+    TESTING_UPGRADE_DESIRED_FEE: int = 0
+    TESTING_UPGRADE_MAX_TX_SET_SIZE: int = 0
+    TESTING_UPGRADE_RESERVE: int = 0
+
+    # test knobs (reference ARTIFICIALLY_* family) — each consumed by
+    # the subsystem it stresses; see docs/stellar_tpu_example.cfg
     ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING: bool = False
+    ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING: bool = False
+    ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING: int = 0  # microseconds
+    ARTIFICIALLY_DELAY_LEDGER_CLOSE_FOR_TESTING: int = 0  # milliseconds
+    ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING: int = 0  # ms
+    ARTIFICIALLY_SET_SURVEY_PHASE_DURATION_FOR_TESTING: int = 0  # s
+    ARTIFICIALLY_SKIP_CONNECTION_ADJUSTMENT_FOR_TESTING: bool = False
+    # weighted per-op apply sleep: durations (microseconds) + weights
+    OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    TESTING_EVICTION_SCAN_SIZE: int = 0  # 0 = scanner default
+    TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME: int = 0  # 0 = protocol
+    CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING: bool = False
 
     def network_id(self) -> bytes:
         from stellar_tpu.crypto.sha import sha256
@@ -92,25 +181,12 @@ class Config:
         with open(path, "rb") as f:
             raw = tomllib.load(f)
         cfg = cls()
-        simple = {
-            "NODE_IS_VALIDATOR", "NETWORK_PASSPHRASE", "PEER_PORT",
-            "TARGET_PEER_CONNECTIONS", "MAX_PEER_CONNECTIONS",
-            "KNOWN_PEERS", "HISTORY_ARCHIVES", "LOG_LEVEL", "HTTP_PORT",
-            "RUN_STANDALONE", "MANUAL_CLOSE", "MAX_TX_SET_SIZE",
-            "EXPECTED_LEDGER_CLOSE_TIME", "INVARIANT_CHECKS",
-            "DATABASE", "BUCKET_DIR_PATH",
-            "MAX_PENDING_CONNECTIONS", "PREFERRED_PEERS",
-            "PEER_TIMEOUT", "PEER_AUTHENTICATION_TIMEOUT",
-            "PEER_FLOOD_READING_CAPACITY",
-            "PEER_FLOOD_READING_CAPACITY_BYTES",
-            "FLOW_CONTROL_SEND_MORE_BATCH_SIZE",
-            "FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES",
-            "HTTP_QUERY_PORT", "METADATA_OUTPUT_STREAM",
-            "AUTOMATIC_MAINTENANCE_PERIOD",
-            "AUTOMATIC_MAINTENANCE_COUNT", "CATCHUP_COMPLETE",
-            "CATCHUP_RECENT", "FAILURE_SAFETY", "UNSAFE_QUORUM",
-            "MAX_SLOTS_TO_REMEMBER", "LEDGER_PROTOCOL_VERSION",
-            "ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING",
+        # every dataclass field is loadable by its own name; the
+        # special-cased keys below need parsing/validation beyond a
+        # plain assignment
+        import dataclasses as _dc
+        simple = {f.name for f in _dc.fields(cls)} - {
+            "NODE_SEED", "QUORUM_SET", "VALIDATORS", "HOME_DOMAINS",
         }
         for key, value in raw.items():
             if key == "NODE_SEED":

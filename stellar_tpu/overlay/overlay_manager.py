@@ -72,10 +72,31 @@ class OverlayManager:
         self.survey_manager = SurveyManager(app)
         cfg = getattr(app, "config", None)
         # liveness budgets (reference Config PEER_TIMEOUT /
-        # PEER_AUTHENTICATION_TIMEOUT, enforced by the overlay tick)
+        # PEER_AUTHENTICATION_TIMEOUT / PEER_STRAGGLER_TIMEOUT,
+        # enforced by the overlay tick)
         self.peer_timeout = getattr(cfg, "PEER_TIMEOUT", 30)
         self.peer_auth_timeout = getattr(
             cfg, "PEER_AUTHENTICATION_TIMEOUT", 10)
+        self.peer_straggler_timeout = getattr(
+            cfg, "PEER_STRAGGLER_TIMEOUT", 120)
+        # flood pacing (reference FLOOD_ADVERT_PERIOD_MS /
+        # FLOOD_DEMAND_PERIOD_MS / FLOOD_DEMAND_BACKOFF_DELAY_MS):
+        # adverts batch until the flush timer or a half-full queue;
+        # demand retries back off before asking another peer
+        self.advert_period_s = getattr(
+            cfg, "FLOOD_ADVERT_PERIOD_MS", 100) / 1000.0
+        self.demand_period_s = getattr(
+            cfg, "FLOOD_DEMAND_PERIOD_MS", 200) / 1000.0
+        self.demand_backoff_s = getattr(
+            cfg, "FLOOD_DEMAND_BACKOFF_DELAY_MS", 500) / 1000.0
+        # off-crank signature pre-verification of received tx floods
+        # (reference BACKGROUND_OVERLAY_PROCESSING)
+        self.background_processing = getattr(
+            cfg, "BACKGROUND_OVERLAY_PROCESSING", True)
+        self.tx_demands.backoff_s = self.demand_backoff_s
+        # (future, frame, peer) awaiting background sig pre-verification
+        self._preverify: List = []
+        self._preverify_hashes: Set[bytes] = set()
         self._wire_herder()
 
     def tick(self):
@@ -99,6 +120,13 @@ class OverlayManager:
             # succeeds, which would make the sweep unreachable.)
             if now - p.last_read_time > self.peer_timeout:
                 p.drop("idle timeout")
+                continue
+            # straggler: writes queue but never drain (reference
+            # PEER_STRAGGLER_TIMEOUT — a reader that stopped reading)
+            stalled = getattr(p, "write_stalled_for", None)
+            if stalled is not None and \
+                    stalled(now) > self.peer_straggler_timeout:
+                p.drop("straggling (write queue never drains)")
                 continue
             # ping: refreshes the remote's read-liveness view of us and
             # elicits a response that refreshes ours of it; latency is
@@ -156,6 +184,18 @@ class OverlayManager:
             self.pending_peers.remove(peer)
         if peer not in self.peers:
             self.peers.append(peer)
+            # node-key preference (reference PREFERRED_PEER_KEYS):
+            # a peer whose identity key is preferred gets its address
+            # pinned as PREFERRED whatever IP it dialed in from
+            cfg = getattr(self.app, "config", None)
+            keys = getattr(cfg, "PREFERRED_PEER_KEYS", None)
+            if keys and getattr(peer, "remote_node_id", None) and \
+                    getattr(peer, "address", None):
+                from stellar_tpu.crypto import strkey
+                if strkey.encode_account(peer.remote_node_id) in keys:
+                    from stellar_tpu.overlay.peer_manager import PeerType
+                    rec = self.peer_manager.ensure_exists(*peer.address)
+                    rec.peer_type = PeerType.PREFERRED
             if self.survey_manager.collecting_nonce is not None:
                 self.survey_manager.added_peers += 1
             # pull the peer's SCP state for the current slot so a node
@@ -203,16 +243,53 @@ class OverlayManager:
 
     def broadcast_transaction(self, frame, from_peer=None):
         """Pull-mode tx relay (reference TxAdverts): flood the HASH;
-        peers demand the body if they don't have it."""
+        peers demand the body if they don't have it. Adverts batch up
+        to the flush timer (FLOOD_ADVERT_PERIOD_MS) unless a queue is
+        already half-full (reference flushAdvertTimer)."""
+        from stellar_tpu.overlay.tx_adverts import ADVERT_FLUSH_SIZE
         from stellar_tpu.utils.metrics import registry
         registry.meter("overlay.flood.advertised").mark()
         tx_hash = frame.contents_hash()
         skip = {id(from_peer)} if from_peer is not None else set()
+        full = False
         for p in list(self.peers):
             if id(p) in skip:
                 continue
-            self.tx_adverts.queue_advert(p, tx_hash)
-        self.tx_adverts.flush(self._peers_by_id())
+            q = self.tx_adverts.queue_advert(p, tx_hash)
+            if q >= ADVERT_FLUSH_SIZE:
+                full = True
+        if full or self.advert_period_s <= 0:
+            self.tx_adverts.flush(self._peers_by_id())
+
+    def flush_adverts_tick(self):
+        """Recurring advert flush (reference FLOOD_ADVERT_PERIOD_MS
+        timer; scheduled by the Application)."""
+        self._drain_preverified(block=False)
+        self.tx_adverts.flush(self._peers_by_id(), force=True)
+
+    def _admit_transaction(self, frame, peer):
+        from stellar_tpu.herder.transaction_queue import AddResult
+        res = self.app.herder.queue_for(frame).try_add(frame)
+        if res.code == AddResult.ADD_STATUS_PENDING:
+            # propagate by advert, not by pushing the body
+            self.broadcast_transaction(frame, from_peer=peer)
+
+    def _drain_preverified(self, block: bool):
+        """Admit txs whose background signature pre-verification
+        finished; at ledger close ``block`` waits the stragglers out so
+        close boundaries stay deterministic."""
+        rest = []
+        for fut, frame, peer in self._preverify:
+            if block or fut.done():
+                try:
+                    fut.result()
+                except Exception:
+                    pass  # admission re-verifies through the cache
+                self._preverify_hashes.discard(frame.contents_hash())
+                self._admit_transaction(frame, peer)
+            else:
+                rest.append((fut, frame, peer))
+        self._preverify = rest
 
     # ---------------- fetch (anycast) ----------------
 
@@ -249,19 +326,34 @@ class OverlayManager:
                 except Exception:
                     return
                 self.tx_demands.fulfilled(frame.contents_hash())
-                from stellar_tpu.herder.transaction_queue import AddResult
-                res = herder.queue_for(frame).try_add(frame)
-                if res.code == AddResult.ADD_STATUS_PENDING:
-                    # propagate by advert, not by pushing the body
-                    self.broadcast_transaction(frame, from_peer=peer)
+                if self.background_processing:
+                    # pre-verify master-key signatures on the worker
+                    # pool; admission happens once the verdicts are in
+                    # the cache (reference Peer.cpp:963-969 off-main
+                    # sig verification)
+                    items = _master_sig_items(frame)
+                    if items:
+                        from stellar_tpu.crypto.keys import (
+                            batch_verify_into_cache,
+                        )
+                        from stellar_tpu.utils.workers import run_async
+                        self._preverify.append(
+                            (run_async(batch_verify_into_cache, items),
+                             frame, peer))
+                        self._preverify_hashes.add(
+                            frame.contents_hash())
+                        return
+                self._admit_transaction(frame, peer)
         elif t == MessageType.FLOOD_ADVERT:
             hashes = list(msg.value.txHashes)
             self.tx_adverts.note_incoming(peer, hashes)
             demand = []
             for h in hashes:
-                if herder.is_tx_known_or_banned(h):
-                    continue
-                if self.tx_demands.start_demand(h, peer):
+                if h in self._preverify_hashes or \
+                        herder.is_tx_known_or_banned(h):
+                    continue  # body already held / pending admission
+                if self.tx_demands.start_demand(
+                        h, peer, now=self.app.clock.now()):
                     demand.append(h)
             if demand:
                 from stellar_tpu.xdr.overlay import FloodDemand
@@ -278,10 +370,18 @@ class OverlayManager:
                     peer.send(StellarMessage.make(
                         MessageType.TRANSACTION, frame.envelope))
         elif t == MessageType.PEERS:
+            allow_local = getattr(getattr(self.app, "config", None),
+                                  "ALLOW_LOCALHOST_FOR_TESTING", True)
             for addr in msg.value:
                 try:
-                    host = ".".join(str(b) for b in addr.ip.value) \
-                        if addr.ip.arm == 0 else addr.ip.value.hex()
+                    import ipaddress
+                    ip = ipaddress.ip_address(bytes(addr.ip.value))
+                    host = str(ip)
+                    # gossiped loopback addresses are poison on a real
+                    # network (reference ALLOW_LOCALHOST_FOR_TESTING);
+                    # operator-configured peers are exempt
+                    if not allow_local and ip.is_loopback:
+                        continue
                     self.peer_manager.ensure_exists(host, addr.port)
                 except Exception:
                     continue
@@ -347,10 +447,12 @@ class OverlayManager:
                 self._flood(msg, from_peer=peer)
 
     def ledger_closed(self, ledger_seq: int):
+        self._drain_preverified(block=True)
         self.floodgate.clear_below(ledger_seq)
         peers = self._peers_by_id()
         self.tx_adverts.flush(peers, force=True)
-        self.tx_demands.age_and_retry(self.tx_adverts, peers)
+        self.tx_demands.age_and_retry(self.tx_adverts, peers,
+                                      now=self.app.clock.now())
         self.survey_manager.ledger_closed()
 
     # ---------------- operator surface ----------------
@@ -362,3 +464,28 @@ class OverlayManager:
         for p in list(self.peers) + list(self.pending_peers):
             if getattr(p, "remote_node_id", None) == node_id:
                 p.drop("banned")
+
+
+def _master_sig_items(frame) -> List[tuple]:
+    """(pk, payload_hash, sig) triples for the envelope signatures that
+    hint-match the source (and fee-source) master keys — the cheap,
+    ltx-free subset worth pre-verifying off-crank; other signers verify
+    through the cache at admission as usual."""
+    items = []
+    try:
+        h = frame.contents_hash()
+
+        def add(pk_raw: bytes, sigs):
+            for ds in sigs or ():
+                if bytes(ds.hint) == pk_raw[-4:]:
+                    items.append((pk_raw, h, bytes(ds.signature)))
+        add(frame.source_account_id().value,
+            frame.envelope.value.signatures)
+        if hasattr(frame, "fee_source_id"):
+            inner = frame.inner
+            if hasattr(inner, "envelope"):
+                add(inner.source_account_id().value,
+                    inner.envelope.value.signatures)
+    except Exception:
+        return []
+    return items

@@ -197,10 +197,14 @@ class Peer:
 
     def _send_hello(self):
         lcl = self.app.herder.lm.last_closed_header
+        cfg = getattr(self.app, "config", None)
         hello = Hello(
             ledgerVersion=lcl.ledgerVersion,
-            overlayVersion=OVERLAY_VERSION,
-            overlayMinVersion=OVERLAY_VERSION,
+            overlayVersion=getattr(cfg, "OVERLAY_PROTOCOL_VERSION",
+                                   OVERLAY_VERSION),
+            overlayMinVersion=getattr(cfg,
+                                      "OVERLAY_PROTOCOL_MIN_VERSION",
+                                      OVERLAY_VERSION),
             networkID=self.app.herder.network_id,
             versionStr=b"stellar_tpu",
             listeningPort=getattr(self.app, "port", 0),
@@ -294,6 +298,16 @@ class Peer:
             return self.drop("duplicate HELLO")
         if hello.networkID != self.app.herder.network_id:
             return self.drop("wrong network")
+        cfg = getattr(self.app, "config", None)
+        our_min = getattr(cfg, "OVERLAY_PROTOCOL_MIN_VERSION",
+                          OVERLAY_VERSION)
+        our_ver = getattr(cfg, "OVERLAY_PROTOCOL_VERSION",
+                          OVERLAY_VERSION)
+        # overlay version handshake (reference Peer::recvHello: the
+        # ranges must overlap)
+        if hello.overlayVersion < our_min or \
+                hello.overlayMinVersion > our_ver:
+            return self.drop("incompatible overlay protocol version")
         now = self.app.clock.system_now()
         remote_id = hello.peerID.value
         if remote_id == self.app.herder.scp.local_node_id:

@@ -215,6 +215,13 @@ class SurveyManager:
 
     def ledger_closed(self):
         self._requests_this_ledger = 0
+        # expire a collecting phase that overran its duration
+        deadline = getattr(self, "_collecting_deadline", None)
+        if deadline is not None and self.collecting_nonce is not None \
+                and self.app.clock.now() > deadline:
+            self.collecting_nonce = None
+            self.collecting_surveyor = None
+            self._collecting_deadline = None
 
     # ---------------- message handling (both roles) ----------------
 
@@ -238,12 +245,25 @@ class SurveyManager:
             return self._handle_response(msg.value)
         return False
 
+    def _surveyor_allowed(self, surveyor_raw: bytes) -> bool:
+        """SURVEYOR_KEYS allowlist (reference Config.h): empty list =
+        anyone may survey (test networks); otherwise only the listed
+        strkeys."""
+        cfg = getattr(self.app, "config", None)
+        allowed = getattr(cfg, "SURVEYOR_KEYS", None)
+        if not allowed:
+            return True
+        from stellar_tpu.crypto import strkey
+        return strkey.encode_account(surveyor_raw) in allowed
+
     def _handle_start(self, signed) -> bool:
         msg = signed.startCollecting
         if not self._verify(msg.surveyorID, _signed_payload(
                 self.app.herder.network_id,
                 TimeSlicedSurveyStartCollectingMessage, msg),
                 signed.signature):
+            return False
+        if not self._surveyor_allowed(msg.surveyorID.value):
             return False
         if self.collecting_nonce is not None and \
                 self.collecting_surveyor != msg.surveyorID.value:
@@ -253,6 +273,12 @@ class SurveyManager:
         self.traffic = {}
         self.added_peers = 0
         self.dropped_peers = 0
+        # phase auto-expiry (reference survey phase duration, overridable
+        # via ARTIFICIALLY_SET_SURVEY_PHASE_DURATION_FOR_TESTING)
+        dur = getattr(getattr(self.app, "config", None),
+                      "ARTIFICIALLY_SET_SURVEY_PHASE_DURATION_FOR_TESTING",
+                      0) or 3600
+        self._collecting_deadline = self.app.clock.now() + dur
         return True
 
     def _handle_stop(self, signed) -> bool:

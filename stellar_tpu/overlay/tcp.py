@@ -32,16 +32,30 @@ class TCPPeer(Peer):
         self.address = address  # (host, port) for outbound book-keeping
         self._rx = bytearray()
         self._txq = bytearray()
+        cfg = getattr(app, "config", None)
+        # per-flush write budget (reference MAX_BATCH_WRITE_COUNT /
+        # MAX_BATCH_WRITE_BYTES: cap one peer's drain so a fat queue
+        # can't starve the poll loop)
+        self._batch_bytes = getattr(cfg, "MAX_BATCH_WRITE_BYTES",
+                                    1024 * 1024)
+        self._batch_count = getattr(cfg, "MAX_BATCH_WRITE_COUNT", 1024)
+        # when the queue first became non-empty (straggler detection)
+        self._write_stalled_since = None
 
     def wants_write(self) -> bool:
         return bool(self._txq)
 
     def send_bytes(self, raw: bytes):
+        if not self._txq:
+            self._write_stalled_since = self.app.clock.now()
         self._txq += struct.pack(">I", len(raw)) + raw
         self._try_flush()
 
     def _try_flush(self):
-        while self._txq:
+        sent_bytes = 0
+        sent_chunks = 0
+        while self._txq and sent_bytes < self._batch_bytes and \
+                sent_chunks < self._batch_count:
             try:
                 n = self.sock.send(self._txq)
             except (BlockingIOError, InterruptedError):
@@ -51,6 +65,21 @@ class TCPPeer(Peer):
             if n <= 0:
                 return
             del self._txq[:n]
+            sent_bytes += n
+            sent_chunks += 1
+        if sent_bytes > 0:
+            # progress resets the straggler clock: a busy-but-draining
+            # queue is healthy; only a reader that stopped ACCEPTING
+            # bytes is a straggler
+            self._write_stalled_since = None if not self._txq \
+                else self.app.clock.now()
+
+    def write_stalled_for(self, now: float) -> float:
+        """Seconds the send queue has failed to drain (reference
+        PEER_STRAGGLER_TIMEOUT enforcement)."""
+        if self._write_stalled_since is None or not self._txq:
+            return 0.0
+        return now - self._write_stalled_since
 
     def on_readable(self):
         # drain the socket fully each poll tick (a single recv would cap
@@ -67,7 +96,17 @@ class TCPPeer(Peer):
             self._rx += chunk
             if len(chunk) < 65536:
                 break
-        while len(self._rx) >= 4:
+        self._process_rx()
+
+    def _process_rx(self):
+        """Decode buffered frames, bounded per call (reference
+        PEER_READING_CAPACITY: one peer can't monopolize a crank tick).
+        Leftover complete frames drain on the NEXT poll tick — the
+        driver re-calls this for every peer with buffered bytes, so a
+        quiet socket can't strand them."""
+        budget = getattr(getattr(self.app, "config", None),
+                         "PEER_READING_CAPACITY", 200)
+        while len(self._rx) >= 4 and budget > 0:
             (n,) = struct.unpack_from(">I", self._rx, 0)
             if n > MAX_MESSAGE_SIZE:
                 return self.drop("oversized frame")
@@ -75,7 +114,14 @@ class TCPPeer(Peer):
                 break
             frame = bytes(self._rx[4:4 + n])
             del self._rx[:4 + n]
+            budget -= 1
             self.receive_bytes(frame)
+
+    def has_buffered_frames(self) -> bool:
+        if len(self._rx) < 4:
+            return False
+        (n,) = struct.unpack_from(">I", self._rx, 0)
+        return len(self._rx) >= 4 + n
 
     def close(self):
         try:
@@ -101,6 +147,17 @@ class PeerDoor:
         try:
             sock, _addr = self.listener.accept()
         except (BlockingIOError, InterruptedError):
+            return None
+        # inbound pending cap (reference MAX_INBOUND_PENDING_
+        # CONNECTIONS; 0 derives from the shared pool)
+        cfg = getattr(self.app, "config", None)
+        max_in = getattr(cfg, "MAX_INBOUND_PENDING_CONNECTIONS", 0) or \
+            max(1, getattr(cfg, "MAX_PENDING_CONNECTIONS", 500) // 2)
+        in_pending = sum(
+            1 for p in self.app.overlay.pending_peers
+            if not getattr(p, "we_called", True))
+        if in_pending >= max_in:
+            sock.close()
             return None
         peer = TCPPeer(self.app, we_called=False, sock=sock)
         self.app.overlay.add_pending(peer)
@@ -202,6 +259,10 @@ class TCPDriver:
                 p.close()
                 self.peers.remove(p)
             else:
+                # drain frames left over a previous tick's read budget
+                # (the socket may never become readable again)
+                if p.has_buffered_frames():
+                    p._process_rx()
                 self._refresh_mask(p)
         self._maybe_maintain()
 
@@ -214,13 +275,32 @@ class TCPDriver:
             return
         self._last_maintain = now
         ov = self.app.overlay
-        target = getattr(self.app.config, "TARGET_PEER_CONNECTIONS", 8) \
-            if getattr(self.app, "config", None) else 8
+        cfg = getattr(self.app, "config", None)
+        if getattr(cfg,
+                   "ARTIFICIALLY_SKIP_CONNECTION_ADJUSTMENT_FOR_TESTING",
+                   False):
+            return  # tests pin topology by hand
+        target = getattr(cfg, "TARGET_PEER_CONNECTIONS", 8) \
+            if cfg is not None else 8
+        # cap in-flight outbound dials (reference
+        # MAX_OUTBOUND_PENDING_CONNECTIONS; 0 derives from the shared
+        # MAX_PENDING_CONNECTIONS pool)
+        max_out_pending = getattr(
+            cfg, "MAX_OUTBOUND_PENDING_CONNECTIONS", 0) or \
+            max(1, getattr(cfg, "MAX_PENDING_CONNECTIONS", 500) // 2)
+        out_pending = sum(1 for p in ov.pending_peers
+                          if getattr(p, "we_called", False))
+        if out_pending >= max_out_pending:
+            return
         have = ov.authenticated_count() + len(ov.pending_peers)
         if have >= target:
             return
         connected = {p.address for p in self.peers if p.address}
+        preferred_only = getattr(cfg, "PREFERRED_PEERS_ONLY", False)
+        from stellar_tpu.overlay.peer_manager import PeerType
         for rec in ov.peer_manager.random_peers(target - have, now=now):
+            if preferred_only and rec.peer_type != PeerType.PREFERRED:
+                continue  # reference PREFERRED_PEERS_ONLY
             addr = (rec.host, rec.port)
             if addr in connected:
                 continue

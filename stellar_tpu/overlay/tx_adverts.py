@@ -35,8 +35,22 @@ class TxAdverts:
         # id(peer) -> set of hashes that peer advertised to us
         self.incoming: Dict[int, set] = {}
 
-    def queue_advert(self, peer, tx_hash: bytes):
-        self.outgoing.setdefault(id(peer), []).append(tx_hash)
+    # per-peer outgoing queue byte cap (reference
+    # OUTBOUND_TX_QUEUE_BYTE_LIMIT; 32 bytes per queued hash); set by
+    # the Application from Config
+    queue_byte_limit = 1024 * 1024 * 3
+
+    def queue_advert(self, peer, tx_hash: bytes) -> int:
+        """Queue one advert; returns that peer's queue depth so the
+        caller can force a flush on a half-full queue. Overflowing
+        queues shed their OLDEST adverts (stale hashes are the least
+        likely to still be demandable)."""
+        q = self.outgoing.setdefault(id(peer), [])
+        q.append(tx_hash)
+        max_len = max(1, self.queue_byte_limit // 32)
+        if len(q) > max_len:
+            del q[:len(q) - max_len]
+        return len(q)
 
     def flush(self, peers_by_id: Dict[int, object],
               force: bool = False):
@@ -74,23 +88,28 @@ class TxDemandsManager:
     """Outstanding demands with rotation across advertisers (reference
     ``TxDemandsManager``)."""
 
-    def __init__(self):
-        # tx hash -> (id(peer) demanded from, asked set, age)
+    def __init__(self, backoff_s: float = 0.0):
+        # tx hash -> [id(peer) demanded from, asked set, age, started]
         self.pending: Dict[bytes, list] = {}
+        # minimum seconds before re-demanding from another peer
+        # (reference FLOOD_DEMAND_BACKOFF_DELAY_MS)
+        self.backoff_s = backoff_s
 
-    def start_demand(self, tx_hash: bytes, peer) -> bool:
+    def start_demand(self, tx_hash: bytes, peer,
+                     now: float = 0.0) -> bool:
         """True if a demand should be sent to this peer now."""
         rec = self.pending.get(tx_hash)
         if rec is not None:
             return False  # already demanded from someone
-        self.pending[tx_hash] = [id(peer), {id(peer)}, 0]
+        self.pending[tx_hash] = [id(peer), {id(peer)}, 0, now]
         return True
 
     def fulfilled(self, tx_hash: bytes):
         self.pending.pop(tx_hash, None)
 
     def age_and_retry(self, adverts: TxAdverts,
-                      peers_by_id: Dict[int, object]) -> int:
+                      peers_by_id: Dict[int, object],
+                      now: float = 0.0) -> int:
         """Called at ledger close: rotate stuck demands to another
         advertiser; returns number of retries sent."""
         retries = 0
@@ -98,13 +117,16 @@ class TxDemandsManager:
             rec[2] += 1
             if rec[2] < DEMAND_RETRY_LEDGERS:
                 continue
+            if self.backoff_s and now and \
+                    now - rec[3] < self.backoff_s:
+                continue  # too soon to pester another advertiser
             candidates = [pid for pid in adverts.advertisers_of(h)
                           if pid not in rec[1] and pid in peers_by_id]
             if not candidates:
                 del self.pending[h]  # nobody left to ask
                 continue
             pid = candidates[0]
-            rec[0], rec[2] = pid, 0
+            rec[0], rec[2], rec[3] = pid, 0, now
             rec[1].add(pid)
             peers_by_id[pid].send(StellarMessage.make(
                 MessageType.FLOOD_DEMAND, FloodDemand(txHashes=[h])))

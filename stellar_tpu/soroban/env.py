@@ -312,6 +312,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def log(inst, val):
         env.host.budget.charge(100, 0)
+        from stellar_tpu.soroban import host as host_mod
+        if host_mod.DIAGNOSTIC_EVENTS_ENABLED:
+            env.host.diagnostics.append(cv.to_scval(val))
         return _make(TAG_VOID)
 
     def ledger_sequence(inst):

@@ -176,6 +176,10 @@ MEM_PER_STACK_SLOT = 64
 # model's WasmInsnExec ~ 4 cpu instructions per wasm instruction)
 CPU_PER_WASM_INSN = 4
 
+# record contract log/diagnostic calls into InvokeOutput.diagnostics
+# (reference ENABLE_SOROBAN_DIAGNOSTIC_EVENTS; set by Application)
+DIAGNOSTIC_EVENTS_ENABLED = False
+
 
 class _Budget:
     def __init__(self, cpu_limit: int, mem_limit: int):
@@ -578,6 +582,9 @@ class InvokeOutput:
     # kb -> (LedgerEntry|None, live_until|None) for dirtied slots
     modified: Dict[bytes, Tuple] = field(default_factory=dict)
     events: List = field(default_factory=list)
+    # contract log/debug output (SCVals), populated only when
+    # DIAGNOSTIC_EVENTS_ENABLED (never consensus-visible)
+    diagnostics: List = field(default_factory=list)
     cpu_insns: int = 0
     mem_bytes: int = 0
     read_bytes: int = 0
@@ -594,6 +601,7 @@ class _Host:
         self.config = config
         self.ledger_seq = ledger_seq
         self.events: List = []
+        self.diagnostics: List = []
 
     def require_auth(self, addr, invocation):
         if addr.arm != T.SCV_ADDRESS:
@@ -737,6 +745,7 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
         out.success = True
         out.return_value = rv
         out.events = host.events
+        out.diagnostics = host.diagnostics
     except HostError as e:
         out.error = e.kind
     out.cpu_insns = budget.cpu

@@ -53,6 +53,30 @@ __all__ = [
     "FeeBumpTransactionFrame", "make_transaction_frame",
 ]
 
+# escalate internal apply errors instead of failing the tx (reference
+# HALT_ON_INTERNAL_TRANSACTION_ERROR; set by Application from Config)
+HALT_ON_INTERNAL_ERROR = False
+
+# ([durations_us], [weights]) weighted per-op apply sleep, or None
+# (reference OP_APPLY_SLEEP_TIME_{DURATION,WEIGHT}_FOR_TESTING). The
+# pick rotates deterministically so stressed runs stay reproducible.
+OP_APPLY_SLEEP = None
+_OP_SLEEP_TICK = [0]
+
+
+def _op_apply_sleep():
+    import time as _time
+    durs, weights = OP_APPLY_SLEEP
+    total = sum(weights)
+    tick = _OP_SLEEP_TICK[0] % total
+    _OP_SLEEP_TICK[0] += 1
+    for d, w in zip(durs, weights):
+        if tick < w:
+            if d > 0:
+                _time.sleep(d / 1_000_000.0)
+            return
+        tick -= w
+
 
 class ValidationType:
     INVALID = 0            # fast fail
@@ -602,6 +626,8 @@ class TransactionFrame:
         tx_txn = LedgerTxn(ltx)
         try:
             for i, op in enumerate(self.op_frames):
+                if OP_APPLY_SLEEP is not None:
+                    _op_apply_sleep()
                 op_txn = LedgerTxn(tx_txn)
                 ok, op_res = op.apply(checker, op_txn)
                 result.op_results[i] = op_res
@@ -640,11 +666,24 @@ class TransactionFrame:
                 tx_txn.rollback()
                 result.set_code(TxCode.txBAD_SPONSORSHIP
                                 if bad_sponsorship else TxCode.txFAILED)
-        except Exception:
+        except Exception as e:
             if tx_txn._open:
                 tx_txn.rollback()
+            from stellar_tpu.invariant.invariants import (
+                InvariantDoesNotHold,
+            )
+            if isinstance(e, InvariantDoesNotHold):
+                raise  # node-integrity failure: always fatal
             result.set_code(TxCode.txINTERNAL_ERROR)
-            raise
+            # reference default: the tx fails with txINTERNAL_ERROR and
+            # the node keeps closing; HALT_ON_INTERNAL_TRANSACTION_
+            # ERROR escalates for debugging (Config.h)
+            if HALT_ON_INTERNAL_ERROR:
+                raise
+            import logging
+            logging.getLogger("stellar_tpu.tx").exception(
+                "internal error applying tx %s",
+                self.contents_hash().hex())
         return result
 
 

@@ -34,6 +34,29 @@ def _configure():
     _configured = True
 
 
+_COLORS = {"DEBUG": "\x1b[36m", "INFO": "\x1b[32m",
+           "WARNING": "\x1b[33m", "ERROR": "\x1b[31m",
+           "CRITICAL": "\x1b[35m"}
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        out = super().format(record)
+        c = _COLORS.get(record.levelname)
+        return f"{c}{out}\x1b[0m" if c else out
+
+
+def set_log_color(enabled: bool) -> None:
+    """Colorized console output (reference LOG_COLOR)."""
+    _configure()
+    fmt = "%(asctime)s [%(name)s %(levelname)s] %(message)s"
+    for h in logging.getLogger("stellar_tpu").handlers:
+        if isinstance(h, logging.StreamHandler) and \
+                not isinstance(h, logging.FileHandler):
+            h.setFormatter(_ColorFormatter(fmt) if enabled
+                           else logging.Formatter(fmt))
+
+
 def get_logger(partition: str) -> logging.Logger:
     _configure()
     return logging.getLogger(f"stellar_tpu.{partition}")

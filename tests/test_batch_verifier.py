@@ -249,3 +249,48 @@ def test_adversarial_structured_fuzz(verifier):
     got = check_differential(verifier, items)
     # sanity: the fuzz actually produced both outcomes
     assert got.any() and not got.all()
+
+
+def test_trickle_batcher_amortizes_dispatches():
+    """Concurrent single-sig verifies collect into shared dispatches
+    (SURVEY §7 trickle class): far fewer device calls than verifies,
+    with per-call results (incl. rejections) intact."""
+    import threading as th
+
+    from stellar_tpu.crypto.batch_verifier import TrickleBatcher
+
+    v = BatchVerifier(bucket_sizes=(128,))
+    batcher = TrickleBatcher(v, window_ms=20.0, max_batch=128)
+    good = [make_sig() for _ in range(24)]
+    bad = []
+    for pk, msg, sig in (make_sig() for _ in range(8)):
+        s2 = bytearray(sig)
+        s2[2] ^= 1
+        bad.append((pk, msg, bytes(s2)))
+    results = {}
+
+    def worker(i, item, want):
+        results[i] = (batcher.verify_sig(*item), want)
+
+    threads = [th.Thread(target=worker, args=(i, item, True))
+               for i, item in enumerate(good)]
+    threads += [th.Thread(target=worker, args=(100 + i, item, False))
+                for i, item in enumerate(bad)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(got == want for got, want in results.values())
+    assert len(results) == 32
+    # the whole storm rode a handful of dispatches, not 32
+    assert batcher.dispatches <= 4, batcher.dispatches
+
+
+def test_trickle_batcher_solo_caller_still_correct():
+    from stellar_tpu.crypto.batch_verifier import TrickleBatcher
+    v = BatchVerifier(bucket_sizes=(128,))
+    batcher = TrickleBatcher(v, window_ms=0.5)
+    pk, msg, sig = make_sig()
+    assert batcher.verify_sig(pk, msg, sig)
+    assert not batcher.verify_sig(pk, msg, b"\x00" * 64)
+    assert batcher.dispatches == 2

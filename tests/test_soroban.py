@@ -752,6 +752,8 @@ def test_parallel_soroban_phase_applies(env):
         TxSetComponentTxsMaybeDiscountedFee,
     )
     root, a = env
+    # the parallel representation is valid from protocol 23
+    root.header().ledgerVersion = 23
     lm = LedgerManager(TEST_NETWORK_ID, root)
     up_tx = upload_tx(root, a)
     classic = TransactionPhase.make(0, [TxSetComponent.make(
@@ -793,6 +795,7 @@ def test_parallel_phase_rejects_bad_structure_and_order(env):
         TxSetComponentTxsMaybeDiscountedFee,
     )
     root, a = env
+    root.header().ledgerVersion = 23  # parallel rep needs protocol 23
     lm = LedgerManager(TEST_NETWORK_ID, root)
     classic = TransactionPhase.make(0, [TxSetComponent.make(
         TxSetComponentType.TXSET_COMP_TXS_MAYBE_DISCOUNTED_FEE,
