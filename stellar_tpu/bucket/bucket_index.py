@@ -129,7 +129,7 @@ class DiskBucket:
     buckets: the decode is cheaper than per-lookup seeks), and large
     files persist their index as a ``.idx.npz`` sidecar."""
 
-    __slots__ = ("path", "hash", "_index", "_mem")
+    __slots__ = ("path", "hash", "_index", "_mem", "_small")
 
     def __init__(self, path: str, bucket_hash: bytes,
                  index: Optional[BucketIndex] = None):
@@ -137,6 +137,7 @@ class DiskBucket:
         self.hash = bucket_hash
         self._index = index
         self._mem = None  # in-memory Bucket for below-cutoff files
+        self._small = None  # cached cutoff decision (file is immutable)
 
     def _memory_bucket(self):
         if self._mem is None:
@@ -146,12 +147,15 @@ class DiskBucket:
         return self._mem
 
     def _below_cutoff(self) -> bool:
-        import os
-        try:
-            return os.path.getsize(self.path) < INDEX_CUTOFF_BYTES and \
-                INDEX_CUTOFF_BYTES > 0
-        except OSError:
-            return False
+        # content-addressed files never change: stat exactly once
+        if self._small is None:
+            import os
+            try:
+                self._small = INDEX_CUTOFF_BYTES > 0 and \
+                    os.path.getsize(self.path) < INDEX_CUTOFF_BYTES
+            except OSError:
+                self._small = False
+        return self._small
 
     @property
     def index(self) -> BucketIndex:

@@ -534,7 +534,10 @@ class Herder:
             self.soroban_tx_queue.get_transactions()
         txset, _ = make_tx_set_from_transactions(
             frames, lcl, self.lm.last_closed_hash,
-            soroban_config=getattr(self.lm, "soroban_config", None))
+            soroban_config=getattr(self.lm, "soroban_config", None),
+            max_dex_ops=getattr(self.node_config,
+                                "MAX_DEX_TX_OPERATIONS_IN_TX_SET",
+                                None))
         self.recv_tx_set(txset)
         self.broadcast_tx_set(txset)
         close_time = max(self.clock.system_now(),

@@ -84,9 +84,30 @@ def _build_account_queues(frames) -> Dict[bytes, List]:
     return queues
 
 
+DEX_OP_TYPES = None  # lazily-built frozenset of OperationType values
+
+
+def _is_dex_tx(frame) -> bool:
+    """True when any op trades against the order book (reference
+    ``TxSetUtils::hasDexOperations``)."""
+    global DEX_OP_TYPES
+    if DEX_OP_TYPES is None:
+        from stellar_tpu.xdr.tx import OperationType as OT
+        DEX_OP_TYPES = frozenset({
+            OT.MANAGE_SELL_OFFER, OT.MANAGE_BUY_OFFER,
+            OT.CREATE_PASSIVE_SELL_OFFER,
+            OT.PATH_PAYMENT_STRICT_RECEIVE,
+            OT.PATH_PAYMENT_STRICT_SEND,
+        })
+    inner = getattr(frame, "inner", frame)
+    return any(op.body.arm in DEX_OP_TYPES
+               for op in inner.tx.operations)
+
+
 def make_tx_set_from_transactions(
         frames: Sequence, lcl_header, lcl_hash: bytes,
         soroban_config=None, parallel_soroban: Optional[bool] = None,
+        max_dex_ops: Optional[int] = None,
 ) -> Tuple["ApplicableTxSetFrame", List]:
     """Build a valid (surge-priced) tx set from candidate frames.
 
@@ -114,9 +135,19 @@ def make_tx_set_from_transactions(
     classic = [f for f in frames if not f.is_soroban()]
     soroban = [f for f in frames if f.is_soroban()]
 
+    if max_dex_ops is not None:
+        # DEX lane (reference MAX_DEX_TX_OPERATIONS_IN_TX_SET): order-
+        # book-touching txs additionally cap at lane 1. (The wire form
+        # stays single-component: the cap is enforced at construction;
+        # per-lane discounted components are not emitted.)
+        lane_cfg = SurgePricingLaneConfig(
+            [lcl_header.maxTxSetSize, max_dex_ops],
+            lane_of=lambda f: 1 if _is_dex_tx(f) else 0)
+    else:
+        lane_cfg = SurgePricingLaneConfig([lcl_header.maxTxSetSize])
     inc_c, exc_c, full_c = \
         SurgePricingPriorityQueue.most_top_txs_within_limits(
-            classic, SurgePricingLaneConfig([lcl_header.maxTxSetSize]))
+            classic, lane_cfg)
     base_fee_c = SurgePricingPriorityQueue.lane_base_fee(
         inc_c, lcl_header.baseFee, bool(full_c))
 

@@ -146,13 +146,7 @@ class Application:
         reset a tuned one. (Two nodes tuning the same global knob
         differently still last-writes — matching the reference, where
         one process is one node.)"""
-        import dataclasses as _dc
-        defaults = Config.__new__(Config)
-        for f in _dc.fields(Config):
-            if f.default is not _dc.MISSING:
-                setattr(defaults, f.name, f.default)
-            elif f.default_factory is not _dc.MISSING:
-                setattr(defaults, f.name, f.default_factory())
+        defaults = Config()
 
         def changed(name: str) -> bool:
             return getattr(config, name) != getattr(defaults, name)

@@ -526,7 +526,8 @@ def main(argv=None) -> int:
     sp.add_argument("file")
     sp.set_defaults(fn=cmd_gen_fuzz)
     sp = sub.add_parser("fuzz")
-    sp.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    sp.add_argument("--mode", choices=["tx", "overlay", "wasm"],
+                    default="tx")
     sp.add_argument("--iterations", type=int, default=1000)
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_fuzz)

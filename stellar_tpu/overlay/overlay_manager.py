@@ -89,11 +89,27 @@ class OverlayManager:
             cfg, "FLOOD_DEMAND_PERIOD_MS", 200) / 1000.0
         self.demand_backoff_s = getattr(
             cfg, "FLOOD_DEMAND_BACKOFF_DELAY_MS", 500) / 1000.0
+        # per-peer advert rate limits (reference FLOOD_OP_RATE_PER_
+        # LEDGER / FLOOD_TX_PERIOD_MS + soroban twins): each rate
+        # window releases rate x ledger-limit x window/close_time
+        # ops (classic) / txs (soroban) per peer; leftovers stay
+        # queued. 0-or-negative rate disables the cap.
+        self.flood_op_rate = getattr(cfg, "FLOOD_OP_RATE_PER_LEDGER",
+                                     1.0)
+        self.flood_tx_period_s = getattr(
+            cfg, "FLOOD_TX_PERIOD_MS", 200) / 1000.0
+        self.flood_soroban_rate = getattr(
+            cfg, "FLOOD_SOROBAN_RATE_PER_LEDGER", 1.0)
+        self.flood_soroban_period_s = getattr(
+            cfg, "FLOOD_SOROBAN_TX_PERIOD_MS", 200) / 1000.0
+        self._last_classic_release = 0.0
+        self._last_soroban_release = 0.0
         # off-crank signature pre-verification of received tx floods
         # (reference BACKGROUND_OVERLAY_PROCESSING)
         self.background_processing = getattr(
             cfg, "BACKGROUND_OVERLAY_PROCESSING", True)
         self.tx_demands.backoff_s = self.demand_backoff_s
+        self.tx_demands.retry_period_s = self.demand_period_s
         # (future, frame, peer) awaiting background sig pre-verification
         self._preverify: List = []
         self._preverify_hashes: Set[bytes] = set()
@@ -263,9 +279,51 @@ class OverlayManager:
 
     def flush_adverts_tick(self):
         """Recurring advert flush (reference FLOOD_ADVERT_PERIOD_MS
-        timer; scheduled by the Application)."""
+        timer; scheduled by the Application), rate-limited per peer by
+        the FLOOD_*_RATE/PERIOD knobs."""
         self._drain_preverified(block=False)
-        self.tx_adverts.flush(self._peers_by_id(), force=True)
+        self.tx_adverts.flush(self._peers_by_id(), force=True,
+                              quotas=self._advert_quotas(),
+                              lane_of=self._advert_lane)
+
+    def _advert_lane(self, tx_hash: bytes) -> str:
+        h = self.app.herder
+        if tx_hash in h.soroban_tx_queue.known_hashes:
+            return "soroban"
+        return "classic"
+
+    def _advert_quotas(self):
+        """Per-peer {lane: quota} released this tick, or None (no rate
+        caps). A window that elapsed releases one window's worth."""
+        if self.flood_op_rate <= 0 and self.flood_soroban_rate <= 0:
+            return None
+        now = self.app.clock.now()
+        cfg = getattr(self.app, "config", None)
+        close_s = max(1, getattr(cfg, "EXPECTED_LEDGER_CLOSE_TIME", 5))
+        quotas = {"classic": 0, "soroban": 0}
+        if self.flood_op_rate > 0:
+            if now - self._last_classic_release >= \
+                    self.flood_tx_period_s:
+                self._last_classic_release = now
+                per_ledger = self.flood_op_rate * \
+                    self.app.herder.lm.last_closed_header.maxTxSetSize
+                quotas["classic"] = max(1, int(
+                    per_ledger * self.flood_tx_period_s / close_s))
+        else:
+            quotas["classic"] = 1 << 30
+        if self.flood_soroban_rate > 0:
+            if now - self._last_soroban_release >= \
+                    self.flood_soroban_period_s:
+                self._last_soroban_release = now
+                scfg = getattr(self.app.herder.lm, "soroban_config",
+                               None)
+                cap = getattr(scfg, "ledger_max_tx_count", 100) or 100
+                quotas["soroban"] = max(1, int(
+                    self.flood_soroban_rate * cap *
+                    self.flood_soroban_period_s / close_s))
+        else:
+            quotas["soroban"] = 1 << 30
+        return quotas
 
     def _admit_transaction(self, frame, peer):
         from stellar_tpu.herder.transaction_queue import AddResult
@@ -470,22 +528,26 @@ def _master_sig_items(frame) -> List[tuple]:
     """(pk, payload_hash, sig) triples for the envelope signatures that
     hint-match the source (and fee-source) master keys — the cheap,
     ltx-free subset worth pre-verifying off-crank; other signers verify
-    through the cache at admission as usual."""
+    through the cache at admission as usual. Fee bumps pair the OUTER
+    signatures with the fee source over the outer payload hash and the
+    INNER signatures with the inner source over the inner hash —
+    anything else would warm cache keys admission never queries."""
     items = []
     try:
-        h = frame.contents_hash()
-
-        def add(pk_raw: bytes, sigs):
+        def add(pk_raw: bytes, h: bytes, sigs):
             for ds in sigs or ():
                 if bytes(ds.hint) == pk_raw[-4:]:
                     items.append((pk_raw, h, bytes(ds.signature)))
-        add(frame.source_account_id().value,
-            frame.envelope.value.signatures)
         if hasattr(frame, "fee_source_id"):
+            add(frame.fee_source_id().value, frame.contents_hash(),
+                frame.envelope.value.signatures)
             inner = frame.inner
-            if hasattr(inner, "envelope"):
-                add(inner.source_account_id().value,
-                    inner.envelope.value.signatures)
+            add(inner.source_account_id().value,
+                inner.contents_hash(),
+                inner.envelope.value.signatures)
+        else:
+            add(frame.source_account_id().value, frame.contents_hash(),
+                frame.envelope.value.signatures)
     except Exception:
         return []
     return items

@@ -301,6 +301,11 @@ class SurveyManager:
                 TimeSlicedSurveyRequestMessage, ts),
                 signed.requestSignature):
             return False
+        # the allowlist must gate the DATA-disclosing path, not just
+        # startCollecting — a direct request from an unlisted surveyor
+        # gets no topology (code-review r3 finding)
+        if not self._surveyor_allowed(req.surveyorPeerID.value):
+            return False
         if req.surveyedPeerID.value != \
                 self.app.herder.scp.local_node_id:
             return True  # not for us: keep relaying

@@ -59,11 +59,11 @@ class TCPPeer(Peer):
             try:
                 n = self.sock.send(self._txq)
             except (BlockingIOError, InterruptedError):
-                return
+                break  # fall through: partial progress still resets
             except OSError:
                 return self.drop("socket write error")
             if n <= 0:
-                return
+                break
             del self._txq[:n]
             sent_bytes += n
             sent_chunks += 1

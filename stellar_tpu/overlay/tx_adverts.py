@@ -53,9 +53,13 @@ class TxAdverts:
         return len(q)
 
     def flush(self, peers_by_id: Dict[int, object],
-              force: bool = False):
+              force: bool = False, quotas=None, lane_of=None):
         """Send queued adverts; small queues flush immediately at sim
-        scale (the reference flushes on a timer or when half-full)."""
+        scale (the reference flushes on a timer or when half-full).
+
+        ``quotas`` ({lane: count} per peer, with ``lane_of(hash)``)
+        rate-limits how many adverts leave per call (reference
+        FLOOD_*_RATE/PERIOD pacing); hashes over quota stay queued."""
         for pid, hashes in list(self.outgoing.items()):
             if not hashes:
                 continue
@@ -65,10 +69,26 @@ class TxAdverts:
             if peer is None:
                 del self.outgoing[pid]
                 continue
-            batch, self.outgoing[pid] = \
-                hashes[:MAX_TX_ADVERT_VECTOR], hashes[MAX_TX_ADVERT_VECTOR:]
-            peer.send(StellarMessage.make(
-                MessageType.FLOOD_ADVERT, FloodAdvert(txHashes=batch)))
+            if quotas is not None and lane_of is not None:
+                budget = dict(quotas)
+                batch, rest = [], []
+                for h in hashes:
+                    lane = lane_of(h)
+                    if len(batch) < MAX_TX_ADVERT_VECTOR and \
+                            budget.get(lane, 0) > 0:
+                        budget[lane] -= 1
+                        batch.append(h)
+                    else:
+                        rest.append(h)
+                self.outgoing[pid] = rest
+            else:
+                batch, self.outgoing[pid] = \
+                    hashes[:MAX_TX_ADVERT_VECTOR], \
+                    hashes[MAX_TX_ADVERT_VECTOR:]
+            if batch:
+                peer.send(StellarMessage.make(
+                    MessageType.FLOOD_ADVERT,
+                    FloodAdvert(txHashes=batch)))
 
     def note_incoming(self, peer, hashes: List[bytes]):
         s = self.incoming.setdefault(id(peer), set())
@@ -88,12 +108,15 @@ class TxDemandsManager:
     """Outstanding demands with rotation across advertisers (reference
     ``TxDemandsManager``)."""
 
-    def __init__(self, backoff_s: float = 0.0):
+    def __init__(self, backoff_s: float = 0.0,
+                 retry_period_s: float = 0.0):
         # tx hash -> [id(peer) demanded from, asked set, age, started]
         self.pending: Dict[bytes, list] = {}
         # minimum seconds before re-demanding from another peer
-        # (reference FLOOD_DEMAND_BACKOFF_DELAY_MS)
+        # (reference FLOOD_DEMAND_BACKOFF_DELAY_MS) and the base
+        # re-demand cadence (reference FLOOD_DEMAND_PERIOD_MS)
         self.backoff_s = backoff_s
+        self.retry_period_s = retry_period_s
 
     def start_demand(self, tx_hash: bytes, peer,
                      now: float = 0.0) -> bool:
@@ -117,8 +140,8 @@ class TxDemandsManager:
             rec[2] += 1
             if rec[2] < DEMAND_RETRY_LEDGERS:
                 continue
-            if self.backoff_s and now and \
-                    now - rec[3] < self.backoff_s:
+            wait = max(self.backoff_s, self.retry_period_s)
+            if wait and now and now - rec[3] < wait:
                 continue  # too soon to pester another advertiser
             candidates = [pid for pid in adverts.advertisers_of(h)
                           if pid not in rec[1] and pid in peers_by_id]

@@ -178,3 +178,67 @@ def test_eviction_and_ttl_knobs_consumed():
         TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME=99)
     assert app.lm.eviction_scanner.max_entries == 17
     assert app.lm.soroban_config.min_persistent_ttl == 99
+
+
+def test_max_dex_ops_lane_caps_order_book_txs():
+    """MAX_DEX_TX_OPERATIONS_IN_TX_SET: order-book txs ride a capped
+    lane; payments are unaffected (reference DEX lane)."""
+    from tests.test_offers import sell_offer_op
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.tx.tx_test_utils import seed_root_with_accounts
+    from stellar_tpu.xdr.types import (
+        NATIVE_ASSET, Price, account_id, asset_alphanum4,
+    )
+    kps = [keypair(f"dex-{i}") for i in range(4)]
+    root = seed_root_with_accounts([(k, 1000 * XLM) for k in kps])
+    usd = asset_alphanum4(b"USD",
+                          account_id(kps[0].public_key.raw))
+    frames = [
+        make_tx(kps[0], (1 << 32) + 1,
+                [sell_offer_op(NATIVE_ASSET, usd, XLM, Price(n=1, d=1))],
+                fee=500),
+        make_tx(kps[1], (1 << 32) + 1,
+                [sell_offer_op(NATIVE_ASSET, usd, XLM, Price(n=1, d=1))],
+                fee=400),
+        make_tx(kps[2], (1 << 32) + 1, [payment_op(kps[3], XLM)],
+                fee=100),
+    ]
+    txset, excluded = make_tx_set_from_transactions(
+        frames, root.header(), b"\x00" * 32, max_dex_ops=1)
+    # the lower-fee DEX tx overflowed its lane; the payment rode free
+    assert len(excluded) == 1
+    assert excluded[0] is frames[1]
+    assert len(txset.frames) == 2
+
+
+def test_flood_rate_quota_paces_adverts():
+    """FLOOD_OP_RATE_PER_LEDGER + FLOOD_TX_PERIOD_MS budget how many
+    adverts leave per tick; the rest stay queued for later windows."""
+    app, cfg, a, root = _app(FLOOD_OP_RATE_PER_LEDGER=0.1,
+                             FLOOD_TX_PERIOD_MS=100,
+                             MAX_TX_SET_SIZE=100)
+    ov = app.overlay
+
+    class P:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, msg):
+            self.sent.append(msg)
+    p = P()
+    ov.peers.append(p)
+    for i in range(50):
+        ov.tx_adverts.queue_advert(p, bytes([i]) * 32)
+    app.clock.sleep_until(app.clock.now() + 1.0) \
+        if hasattr(app.clock, "sleep_until") else None
+    # force the release window open
+    ov._last_classic_release = -10.0
+    ov.flush_adverts_tick()
+    sent_hashes = sum(len(m.value.txHashes) for m in p.sent)
+    # quota = 0.1 * 100 ops/ledger * 0.1s / 5s close = max(1, 0.2) = 1
+    assert sent_hashes == 1
+    assert len(ov.tx_adverts.outgoing[id(p)]) == 49
+    # at ledger close everything drains (force path, no quotas)
+    ov.ledger_closed(2)
+    sent_hashes = sum(len(m.value.txHashes) for m in p.sent)
+    assert sent_hashes == 50

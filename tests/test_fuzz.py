@@ -17,3 +17,9 @@ def test_tx_fuzz_smoke():
 def test_overlay_fuzz_smoke():
     out = OverlayFuzzer(seed=99).run(120)
     assert out["crashes"] == [], out["crashes"]
+
+
+def test_wasm_fuzz_smoke():
+    from stellar_tpu.main.fuzz import run_fuzz
+    out = run_fuzz("wasm", 300, seed=7)
+    assert out["crashes"] == [], out["crashes"]
