@@ -1,0 +1,646 @@
+// Native wasm execution engine: the hot interpreter loop behind
+// stellar_tpu/soroban/wasm.py. The PYTHON side keeps decode +
+// validation (consensus-critical, byte-level); this executes the
+// already-flattened op list with bit-identical semantics — same traps,
+// same wrapping, same instruction accounting — at native speed
+// (reference: soroban-env-host runs wasmi, a Rust interpreter; this
+// plays that role for the TPU framework's C++ runtime layer).
+//
+// Build: g++ -O2 -shared -fPIC -o libwasmexec.so wasm_exec.cpp
+//
+// Contract with the bridge (stellar_tpu/soroban/native_wasm.py):
+// - ops/imm arrays are the EXACT flattened form _decode_body produces
+//   (opcode + up to 3 immediates; br_table arms live in a pool).
+// - instruction budget is counted in 64-op ticks exactly like the
+//   Python engine's charge loop, so budget exhaustion fires at the
+//   same op in both engines (consensus: consumed cpu is meta-visible).
+// - host imports bounce through a callback; the bridge refreshes the
+//   remaining budget after every host call (host fns charge cpu too).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t ST_OK = 0;        // ran to completion
+constexpr int32_t ST_TRAP = 1;      // wasm trap (code in trap_code)
+constexpr int32_t ST_BUDGET = 2;    // instruction budget exhausted
+constexpr int32_t ST_HOST = 3;      // host callback signalled failure
+
+constexpr int32_t TRAP_UNREACHABLE = 1;
+constexpr int32_t TRAP_OOB = 2;
+constexpr int32_t TRAP_DIV_ZERO = 3;
+constexpr int32_t TRAP_OVERFLOW = 4;
+constexpr int32_t TRAP_STACK = 5;
+constexpr int32_t TRAP_UNINIT_ELEM = 6;
+constexpr int32_t TRAP_TYPE = 7;
+constexpr int32_t TRAP_SEGMENT = 8;
+
+constexpr int32_t MAX_FRAMES = 256;
+constexpr int64_t PAGE = 65536;
+
+struct FuncDesc {
+    int64_t ops_off;   // into ops/imm arrays
+    int64_t n_ops;
+    int32_t n_locals;  // includes params
+    int32_t n_params;
+    int32_t n_results; // 0 or 1
+    int32_t type_id;
+    int32_t result_is32;  // declared result type is i32
+    int32_t _pad;
+};
+
+struct ProgramDesc {
+    const int32_t* ops;
+    const int64_t* imm_a;
+    const int64_t* imm_b;
+    const int64_t* imm_c;
+    const int64_t* br_pool;      // triples: target, arity, land
+    const FuncDesc* funcs;       // defined functions
+    int32_t n_funcs;
+    const int32_t* import_nparams;
+    const int32_t* import_nresults;
+    const int32_t* import_result32;
+    int32_t n_imports;
+    const int64_t* globals_init;
+    int32_t n_globals;
+    const int32_t* table;        // func idx or -1
+    int32_t table_len;
+    const uint8_t* data_blob;    // concatenated data segments
+    const int64_t* data_offs;    // per segment: mem offset
+    const int64_t* data_lens;
+    int32_t n_data;
+    int32_t mem_min_pages;
+    int32_t mem_max_pages;       // -1 = engine cap
+    int32_t start_func;          // unified index space; -1 = none
+    const int32_t* func_type_ids;  // type id per unified func index
+};
+
+typedef int32_t (*host_fn_cb)(void* ctx, int32_t import_idx,
+                              const int64_t* args, int32_t nargs,
+                              int64_t* result,
+                              int64_t* ticks_left,
+                              int64_t charged_so_far,
+                              uint8_t* mem, int64_t mem_len);
+typedef int32_t (*mem_grow_cb)(void* ctx, int64_t bytes);
+
+struct RunResult {
+    int32_t status;
+    int32_t trap_code;
+    int64_t value;
+    int32_t has_value;
+    int64_t executed;            // total wasm ops executed
+    int64_t charged;             // ops charged (incl. a failing chunk)
+};
+
+struct Engine {
+    const ProgramDesc* p;
+    host_fn_cb host_cb;
+    mem_grow_cb mem_cb;
+    void* ctx;
+    std::vector<uint8_t> memory;
+    std::vector<int64_t> globals;
+    std::vector<int32_t> table;
+    int32_t depth = 0;
+    // budget accounting in 64-op ticks, mirroring the Python engine:
+    // `tick` counts ops since the last charge; at 64 the tick is
+    // charged wholesale. ticks_left is the remaining op allowance
+    // (already divided by the per-insn cpu cost by the bridge).
+    int64_t ticks_left;
+    int64_t executed = 0;
+    int64_t charged = 0;   // mirror of the Python charge stream: a
+                           // failing chunk is still recorded so the
+                           // bridge's final budget.charge raises at
+                           // the identical point
+    int32_t status = ST_OK;
+    int32_t trap_code = 0;
+
+    bool charge(int64_t n) {
+        ticks_left -= n;
+        charged += n;
+        if (ticks_left < 0) { status = ST_BUDGET; return false; }
+        return true;
+    }
+};
+
+inline uint64_t rotl64(uint64_t v, unsigned k) {
+    return k ? (v << k) | (v >> (64 - k)) : v;
+}
+inline uint64_t rotr64(uint64_t v, unsigned k) {
+    return k ? (v >> k) | (v << (64 - k)) : v;
+}
+inline uint32_t rotl32(uint32_t v, unsigned k) {
+    return k ? (v << k) | (v >> (32 - k)) : v;
+}
+inline uint32_t rotr32(uint32_t v, unsigned k) {
+    return k ? (v >> k) | (v << (32 - k)) : v;
+}
+inline int64_t clz64(uint64_t v) { return v ? __builtin_clzll(v) : 64; }
+inline int64_t ctz64(uint64_t v) { return v ? __builtin_ctzll(v) : 64; }
+inline int64_t clz32(uint32_t v) { return v ? __builtin_clz(v) : 32; }
+inline int64_t ctz32(uint32_t v) { return v ? __builtin_ctz(v) : 32; }
+
+// returns has_value in *out_has; result value in *out_val
+static bool call_function(Engine& e, int32_t func_idx,
+                          const int64_t* args, int32_t nargs,
+                          int64_t* out_val, int32_t* out_has);
+
+static bool run_func(Engine& e, const FuncDesc& f, const int64_t* args,
+                     int32_t nargs, int64_t* out_val, int32_t* out_has) {
+    const ProgramDesc& p = *e.p;
+    std::vector<int64_t> locals(f.n_locals, 0);
+    for (int32_t i = 0; i < nargs && i < f.n_locals; i++)
+        locals[i] = args[i];
+    std::vector<int64_t> stack;
+    stack.reserve(64);
+    const int32_t* ops = p.ops + f.ops_off;
+    const int64_t* ia = p.imm_a + f.ops_off;
+    const int64_t* ib = p.imm_b + f.ops_off;
+    const int64_t* ic = p.imm_c + f.ops_off;
+    int64_t pc = 0;
+    const int64_t n_ops = f.n_ops;
+    int64_t tick = 0;
+
+#define TRAP(code) do { e.status = ST_TRAP; e.trap_code = (code); \
+                        e.executed += tick; return false; } while (0)
+#define SYNC_BUDGET() do { e.executed += tick; \
+        if (!e.charge(tick)) return false; tick = 0; } while (0)
+
+    while (pc < n_ops) {
+        const int32_t op = ops[pc];
+        const int64_t immA = ia[pc], immB = ib[pc], immC = ic[pc];
+        pc++;
+        tick++;
+        if (tick >= 64) { SYNC_BUDGET(); }
+        switch (op) {
+        case 0x41: case 0x42:                 // const
+            stack.push_back(immA); break;
+        case 0x20:                            // local.get
+            stack.push_back(locals[immA]); break;
+        case 0x21:                            // local.set
+            locals[immA] = stack.back(); stack.pop_back(); break;
+        case 0x22:                            // local.tee
+            locals[immA] = stack.back(); break;
+        case 0x0B: case 0x01: case 0x02: case 0x03:
+            break;                            // end/nop/block/loop
+        case 0x04:                            // if (immA = false target)
+            { int64_t c = stack.back(); stack.pop_back();
+              if (!(uint32_t)c) pc = immA; }
+            break;
+        case 0x05:                            // else: skip arm
+            pc = immA; break;
+        case 0x0C: {                          // br: target/arity/land
+            const int64_t target = immA, arity = immB, land = immC;
+            if (arity) {
+                if ((int64_t)stack.size() != land) {
+                    // keep top `arity`, truncate to land
+                    std::memmove(stack.data() + (land - arity),
+                                 stack.data() + (stack.size() - arity),
+                                 sizeof(int64_t) * arity);
+                    stack.resize(land);
+                }
+            } else if ((int64_t)stack.size() > land) {
+                stack.resize(land);
+            }
+            pc = target;
+            break;
+        }
+        case 0x0D: {                          // br_if
+            int64_t c = stack.back(); stack.pop_back();
+            if ((uint32_t)c) {
+                const int64_t target = immA, arity = immB, land = immC;
+                if (arity) {
+                    if ((int64_t)stack.size() != land) {
+                        std::memmove(stack.data() + (land - arity),
+                                     stack.data() +
+                                         (stack.size() - arity),
+                                     sizeof(int64_t) * arity);
+                        stack.resize(land);
+                    }
+                } else if ((int64_t)stack.size() > land) {
+                    stack.resize(land);
+                }
+                pc = target;
+            }
+            break;
+        }
+        case 0x0E: {                          // br_table: pool off/count
+            uint32_t i = (uint32_t)stack.back(); stack.pop_back();
+            const int64_t off = immA, count = immB;
+            const int64_t slot = (i < count - 1) ? i : count - 1;
+            const int64_t* tr = p.br_pool + 3 * (off + slot);
+            const int64_t target = tr[0], arity = tr[1], land = tr[2];
+            if (arity) {
+                if ((int64_t)stack.size() != land) {
+                    std::memmove(stack.data() + (land - arity),
+                                 stack.data() + (stack.size() - arity),
+                                 sizeof(int64_t) * arity);
+                    stack.resize(land);
+                }
+            } else if ((int64_t)stack.size() > land) {
+                stack.resize(land);
+            }
+            pc = target;
+            break;
+        }
+        case 0x0F:                            // return (immA = arity)
+            e.executed += tick;
+            if (!e.charge(tick)) return false;
+            if (immA) { *out_val = stack.back(); *out_has = 1; }
+            else { *out_has = 0; }
+            return true;
+        case 0x10: {                          // call (immA = func idx)
+            SYNC_BUDGET();
+            const int32_t fi = (int32_t)immA;
+            int32_t np, nr, r32;
+            if (fi < p.n_imports) {
+                np = p.import_nparams[fi];
+                nr = p.import_nresults[fi];
+                r32 = p.import_result32[fi];
+            } else {
+                const FuncDesc& g = p.funcs[fi - p.n_imports];
+                np = g.n_params; nr = g.n_results; r32 = g.result_is32;
+            }
+            int64_t val = 0; int32_t has = 0;
+            const int64_t* a =
+                np ? stack.data() + (stack.size() - np) : nullptr;
+            if (!call_function(e, fi, a, np, &val, &has)) return false;
+            stack.resize(stack.size() - np);
+            if (nr) {
+                int64_t v = has ? val : 0;
+                // mask to the DECLARED result type, like the Python
+                // engine's call-site masking (height-only validation
+                // can't guarantee the value's width)
+                stack.push_back(
+                    r32 ? (int64_t)(uint64_t)(uint32_t)v : v);
+            }
+            break;
+        }
+        case 0x11: {                          // call_indirect (immA=type)
+            SYNC_BUDGET();
+            uint32_t ti = (uint32_t)stack.back(); stack.pop_back();
+            if (ti >= (uint32_t)e.table.size() || e.table[ti] < 0)
+                TRAP(TRAP_UNINIT_ELEM);
+            const int32_t fi = e.table[ti];
+            if (p.func_type_ids[fi] != (int32_t)immA)
+                TRAP(TRAP_TYPE);
+            int32_t np, nr, r32;
+            if (fi < p.n_imports) {
+                np = p.import_nparams[fi];
+                nr = p.import_nresults[fi];
+                r32 = p.import_result32[fi];
+            } else {
+                const FuncDesc& g = p.funcs[fi - p.n_imports];
+                np = g.n_params; nr = g.n_results; r32 = g.result_is32;
+            }
+            int64_t val = 0; int32_t has = 0;
+            const int64_t* a =
+                np ? stack.data() + (stack.size() - np) : nullptr;
+            if (!call_function(e, fi, a, np, &val, &has)) return false;
+            stack.resize(stack.size() - np);
+            if (nr) {
+                int64_t v = has ? val : 0;
+                stack.push_back(
+                    r32 ? (int64_t)(uint64_t)(uint32_t)v : v);
+            }
+            break;
+        }
+        case 0x1A: stack.pop_back(); break;   // drop
+        case 0x1B: {                          // select
+            int64_t c = stack.back(); stack.pop_back();
+            int64_t b = stack.back(); stack.pop_back();
+            int64_t a = stack.back(); stack.pop_back();
+            stack.push_back(((uint32_t)c) ? a : b);
+            break;
+        }
+        case 0x23: stack.push_back(e.globals[immA]); break;
+        case 0x24:
+            e.globals[immA] = stack.back(); stack.pop_back(); break;
+        // ---- loads (immA = offset) ----
+        case 0x28: case 0x29: case 0x2C: case 0x2D: case 0x2E:
+        case 0x2F: case 0x30: case 0x31: case 0x32: case 0x33:
+        case 0x34: case 0x35: {
+            uint64_t addr =
+                (uint64_t)(uint32_t)stack.back() + (uint64_t)immA;
+            stack.pop_back();
+            int sz; bool sign; bool is64;
+            switch (op) {
+            case 0x28: sz = 4; sign = false; is64 = false; break;
+            case 0x29: sz = 8; sign = false; is64 = true; break;
+            case 0x2C: sz = 1; sign = true;  is64 = false; break;
+            case 0x2D: sz = 1; sign = false; is64 = false; break;
+            case 0x2E: sz = 2; sign = true;  is64 = false; break;
+            case 0x2F: sz = 2; sign = false; is64 = false; break;
+            case 0x30: sz = 1; sign = true;  is64 = true; break;
+            case 0x31: sz = 1; sign = false; is64 = true; break;
+            case 0x32: sz = 2; sign = true;  is64 = true; break;
+            case 0x33: sz = 2; sign = false; is64 = true; break;
+            case 0x34: sz = 4; sign = true;  is64 = true; break;
+            default:   sz = 4; sign = false; is64 = true; break;
+            }
+            if (addr + sz > e.memory.size()) TRAP(TRAP_OOB);
+            uint64_t v = 0;
+            std::memcpy(&v, e.memory.data() + addr, sz);  // little-endian host
+            if (sign) {
+                const int shift = 64 - 8 * sz;
+                int64_t sv = (int64_t)(v << shift) >> shift;
+                v = is64 ? (uint64_t)sv : (uint64_t)(uint32_t)sv;
+            }
+            stack.push_back((int64_t)v);
+            break;
+        }
+        // ---- stores ----
+        case 0x36: case 0x37: case 0x3A: case 0x3B: case 0x3C:
+        case 0x3D: case 0x3E: {
+            uint64_t val = (uint64_t)stack.back(); stack.pop_back();
+            uint64_t addr =
+                (uint64_t)(uint32_t)stack.back() + (uint64_t)immA;
+            stack.pop_back();
+            int sz;
+            switch (op) {
+            case 0x36: sz = 4; break; case 0x37: sz = 8; break;
+            case 0x3A: sz = 1; break; case 0x3B: sz = 2; break;
+            case 0x3C: sz = 1; break; case 0x3D: sz = 2; break;
+            default:   sz = 4; break;
+            }
+            if (addr + sz > e.memory.size()) TRAP(TRAP_OOB);
+            std::memcpy(e.memory.data() + addr, &val, sz);
+            break;
+        }
+        case 0x3F:                            // memory.size
+            stack.push_back((int64_t)(e.memory.size() / PAGE)); break;
+        case 0x40: {                          // memory.grow
+            // flush unconditionally, mirroring the Python engine's
+            // charge(tick) before _grow (refused grows included)
+            SYNC_BUDGET();
+            uint32_t delta = (uint32_t)stack.back(); stack.pop_back();
+            int64_t cur = (int64_t)(e.memory.size() / PAGE);
+            int64_t limit =
+                p.mem_max_pages >= 0 ? p.mem_max_pages : 1024;
+            if (limit > 1024) limit = 1024;
+            if (cur + (int64_t)delta > limit) {
+                stack.push_back(0xFFFFFFFFLL);
+            } else {
+                if (delta && e.mem_cb) {
+                    if (e.mem_cb(e.ctx, (int64_t)delta * PAGE)) {
+                        e.status = ST_HOST; return false;
+                    }
+                }
+                e.memory.resize(e.memory.size() + delta * PAGE, 0);
+                stack.push_back(cur);
+            }
+            break;
+        }
+        case 0x00: TRAP(TRAP_UNREACHABLE);
+        // ---- i32 compare ----
+        case 0x45: { uint32_t a = (uint32_t)stack.back();
+            stack.back() = (a == 0); break; }
+        case 0x46: case 0x47: case 0x48: case 0x49: case 0x4A:
+        case 0x4B: case 0x4C: case 0x4D: case 0x4E: case 0x4F: {
+            uint32_t b = (uint32_t)stack.back(); stack.pop_back();
+            uint32_t a = (uint32_t)stack.back();
+            int32_t sa = (int32_t)a, sb = (int32_t)b;
+            bool r;
+            switch (op) {
+            case 0x46: r = a == b; break; case 0x47: r = a != b; break;
+            case 0x48: r = sa < sb; break; case 0x49: r = a < b; break;
+            case 0x4A: r = sa > sb; break; case 0x4B: r = a > b; break;
+            case 0x4C: r = sa <= sb; break; case 0x4D: r = a <= b; break;
+            case 0x4E: r = sa >= sb; break; default: r = a >= b; break;
+            }
+            stack.back() = r ? 1 : 0;
+            break;
+        }
+        case 0x50: { uint64_t a = (uint64_t)stack.back();
+            stack.back() = (a == 0); break; }
+        case 0x51: case 0x52: case 0x53: case 0x54: case 0x55:
+        case 0x56: case 0x57: case 0x58: case 0x59: case 0x5A: {
+            uint64_t b = (uint64_t)stack.back(); stack.pop_back();
+            uint64_t a = (uint64_t)stack.back();
+            int64_t sa = (int64_t)a, sb = (int64_t)b;
+            bool r;
+            switch (op) {
+            case 0x51: r = a == b; break; case 0x52: r = a != b; break;
+            case 0x53: r = sa < sb; break; case 0x54: r = a < b; break;
+            case 0x55: r = sa > sb; break; case 0x56: r = a > b; break;
+            case 0x57: r = sa <= sb; break; case 0x58: r = a <= b; break;
+            case 0x59: r = sa >= sb; break; default: r = a >= b; break;
+            }
+            stack.back() = r ? 1 : 0;
+            break;
+        }
+        // ---- i32 arith ----
+        case 0x67: stack.back() =
+            clz32((uint32_t)stack.back()); break;
+        case 0x68: stack.back() =
+            ctz32((uint32_t)stack.back()); break;
+        case 0x69: stack.back() =
+            __builtin_popcount((uint32_t)stack.back()); break;
+        case 0x6A: case 0x6B: case 0x6C: case 0x6D: case 0x6E:
+        case 0x6F: case 0x70: case 0x71: case 0x72: case 0x73:
+        case 0x74: case 0x75: case 0x76: case 0x77: case 0x78: {
+            uint32_t b = (uint32_t)stack.back(); stack.pop_back();
+            uint32_t a = (uint32_t)stack.back();
+            uint32_t r = 0;
+            switch (op) {
+            case 0x6A: r = a + b; break;
+            case 0x6B: r = a - b; break;
+            case 0x6C: r = a * b; break;
+            case 0x6D: {
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                int32_t sa = (int32_t)a, sb = (int32_t)b;
+                if (sa == INT32_MIN && sb == -1) TRAP(TRAP_OVERFLOW);
+                r = (uint32_t)(sa / sb); break;
+            }
+            case 0x6E:
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                r = a / b; break;
+            case 0x6F: {
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                int32_t sa = (int32_t)a, sb = (int32_t)b;
+                r = (sa == INT32_MIN && sb == -1)
+                    ? 0 : (uint32_t)(sa % sb);
+                break;
+            }
+            case 0x70:
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                r = a % b; break;
+            case 0x71: r = a & b; break;
+            case 0x72: r = a | b; break;
+            case 0x73: r = a ^ b; break;
+            case 0x74: r = a << (b & 31); break;
+            case 0x75: r = (uint32_t)((int32_t)a >> (b & 31)); break;
+            case 0x76: r = a >> (b & 31); break;
+            case 0x77: r = rotl32(a, b & 31); break;
+            default:   r = rotr32(a, b & 31); break;
+            }
+            stack.back() = (int64_t)(uint64_t)r;
+            break;
+        }
+        // ---- i64 arith ----
+        case 0x79: stack.back() =
+            clz64((uint64_t)stack.back()); break;
+        case 0x7A: stack.back() =
+            ctz64((uint64_t)stack.back()); break;
+        case 0x7B: stack.back() =
+            __builtin_popcountll((uint64_t)stack.back()); break;
+        case 0x7C: case 0x7D: case 0x7E: case 0x7F: case 0x80:
+        case 0x81: case 0x82: case 0x83: case 0x84: case 0x85:
+        case 0x86: case 0x87: case 0x88: case 0x89: case 0x8A: {
+            uint64_t b = (uint64_t)stack.back(); stack.pop_back();
+            uint64_t a = (uint64_t)stack.back();
+            uint64_t r = 0;
+            switch (op) {
+            case 0x7C: r = a + b; break;
+            case 0x7D: r = a - b; break;
+            case 0x7E: r = a * b; break;
+            case 0x7F: {
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                int64_t sa = (int64_t)a, sb = (int64_t)b;
+                if (sa == INT64_MIN && sb == -1) TRAP(TRAP_OVERFLOW);
+                r = (uint64_t)(sa / sb); break;
+            }
+            case 0x80:
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                r = a / b; break;
+            case 0x81: {
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                int64_t sa = (int64_t)a, sb = (int64_t)b;
+                r = (sa == INT64_MIN && sb == -1)
+                    ? 0 : (uint64_t)(sa % sb);
+                break;
+            }
+            case 0x82:
+                if (b == 0) TRAP(TRAP_DIV_ZERO);
+                r = a % b; break;
+            case 0x83: r = a & b; break;
+            case 0x84: r = a | b; break;
+            case 0x85: r = a ^ b; break;
+            case 0x86: r = a << (b & 63); break;
+            case 0x87: r = (uint64_t)((int64_t)a >> (b & 63)); break;
+            case 0x88: r = a >> (b & 63); break;
+            case 0x89: r = rotl64(a, b & 63); break;
+            default:   r = rotr64(a, b & 63); break;
+            }
+            stack.back() = (int64_t)r;
+            break;
+        }
+        // ---- conversions ----
+        case 0xA7: stack.back() =
+            (int64_t)(uint64_t)(uint32_t)stack.back(); break;
+        case 0xAC: stack.back() =
+            (int64_t)(uint64_t)(int64_t)(int32_t)(uint32_t)stack.back();
+            break;
+        case 0xAD: stack.back() =
+            (int64_t)(uint64_t)(uint32_t)stack.back(); break;
+        case 0xC0: stack.back() = (int64_t)(uint64_t)(uint32_t)
+            (int32_t)(int8_t)(uint8_t)stack.back(); break;
+        case 0xC1: stack.back() = (int64_t)(uint64_t)(uint32_t)
+            (int32_t)(int16_t)(uint16_t)stack.back(); break;
+        case 0xC2: stack.back() =
+            (int64_t)(int8_t)(uint8_t)stack.back(); break;
+        case 0xC3: stack.back() =
+            (int64_t)(int16_t)(uint16_t)stack.back(); break;
+        case 0xC4: stack.back() =
+            (int64_t)(int32_t)(uint32_t)stack.back(); break;
+        default:
+            TRAP(TRAP_TYPE);
+        }
+    }
+    e.executed += tick;
+    if (!e.charge(tick)) return false;
+    if (f.n_results) {
+        if (stack.empty()) TRAP(TRAP_STACK);
+        *out_val = stack.back(); *out_has = 1;
+    } else {
+        *out_has = 0;
+    }
+    return true;
+#undef TRAP
+#undef SYNC_BUDGET
+}
+
+static bool call_function(Engine& e, int32_t func_idx,
+                          const int64_t* args, int32_t nargs,
+                          int64_t* out_val, int32_t* out_has) {
+    const ProgramDesc& p = *e.p;
+    if (func_idx < p.n_imports) {
+        // HOST_CALL_COST and the host fn's own charges go through the
+        // REAL budget on the bridge side; it hands back the refreshed
+        // remaining tick allowance
+        int64_t result = 0;
+        // the bridge recomputes the remaining allowance from the REAL
+        // budget MINUS our not-yet-settled op charges, so host-fn
+        // charges and wasm ticks share one exhaustion point
+        int32_t rc = e.host_cb(e.ctx, func_idx, args, nargs, &result,
+                               &e.ticks_left, e.charged,
+                               e.memory.data(),
+                               (int64_t)e.memory.size());
+        if (rc != 0) { e.status = ST_HOST; return false; }
+        *out_val = result;
+        *out_has = p.import_nresults[func_idx] ? 1 : 0;
+        return true;
+    }
+    if (e.depth >= MAX_FRAMES) {
+        e.status = ST_TRAP; e.trap_code = TRAP_STACK; return false;
+    }
+    e.depth++;
+    bool ok = run_func(e, p.funcs[func_idx - p.n_imports], args, nargs,
+                       out_val, out_has);
+    e.depth--;
+    return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t wasm_run(const ProgramDesc* prog, int32_t func_idx,
+                 const int64_t* args, int32_t nargs,
+                 host_fn_cb host_cb, mem_grow_cb mem_cb, void* ctx,
+                 int64_t ticks_budget, RunResult* out) {
+    Engine e;
+    e.p = prog;
+    e.host_cb = host_cb;
+    e.mem_cb = mem_cb;
+    e.ctx = ctx;
+    e.ticks_left = ticks_budget;
+    e.memory.assign((size_t)prog->mem_min_pages * PAGE, 0);
+    if (!e.memory.empty() && mem_cb) {
+        if (mem_cb(ctx, (int64_t)e.memory.size())) {
+            out->status = ST_HOST; out->executed = 0; return ST_HOST;
+        }
+    }
+    e.globals.assign(prog->globals_init,
+                     prog->globals_init + prog->n_globals);
+    e.table.assign(prog->table, prog->table + prog->table_len);
+    // data segments
+    const uint8_t* blob = prog->data_blob;
+    for (int32_t i = 0; i < prog->n_data; i++) {
+        const int64_t off = prog->data_offs[i];
+        const int64_t len = prog->data_lens[i];
+        if (off < 0 || (uint64_t)(off + len) > e.memory.size()) {
+            out->status = ST_TRAP; out->trap_code = TRAP_SEGMENT;
+            out->executed = 0;
+            return ST_TRAP;
+        }
+        std::memcpy(e.memory.data() + off, blob, len);
+        blob += len;
+    }
+    int64_t val = 0; int32_t has = 0;
+    bool ok = true;
+    if (prog->start_func >= 0)
+        ok = call_function(e, prog->start_func, nullptr, 0, &val, &has);
+    if (ok)
+        ok = call_function(e, func_idx, args, nargs, &val, &has);
+    out->status = ok ? ST_OK : e.status;
+    out->trap_code = e.trap_code;
+    out->value = val;
+    out->has_value = has;
+    out->executed = e.executed;
+    out->charged = e.charged;
+    return out->status;
+}
+
+}  // extern "C"

@@ -180,6 +180,11 @@ CPU_PER_WASM_INSN = 4
 # (reference ENABLE_SOROBAN_DIAGNOSTIC_EVENTS; set by Application)
 DIAGNOSTIC_EVENTS_ENABLED = False
 
+# execute wasm through the native C++ engine when its build is
+# available (identical semantics + charge stream; differential tests
+# pin it) — False forces the pure-Python engine
+USE_NATIVE_WASM = True
+
 
 class _Budget:
     def __init__(self, cpu_limit: int, mem_limit: int):
@@ -854,16 +859,24 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
         budget.charge(0, n_bytes)
 
     try:
-        inst = WasmInstance(module, make_imports(env), charge,
-                            mem_charge)
         try:
             fn = fn_name.decode("utf-8")
         except UnicodeDecodeError:
             raise HostError(HostError.TRAPPED, "bad function name")
+        vals = [env.cv.from_scval(a) for a in args]
+        if USE_NATIVE_WASM:
+            from stellar_tpu.soroban import native_wasm
+            if native_wasm.available():
+                rv = native_wasm.run_export(
+                    module, make_imports(env), budget,
+                    CPU_PER_WASM_INSN, fn, vals)
+                return env.cv.to_scval(rv) if rv is not None \
+                    else SCVal.make(T.SCV_VOID)
+        inst = WasmInstance(module, make_imports(env), charge,
+                            mem_charge)
         if not inst.exports_function(fn):
             raise HostError(HostError.TRAPPED,
                             f"no exported function {fn!r}")
-        vals = [env.cv.from_scval(a) for a in args]
         rv = inst.invoke(fn, vals)
         return env.cv.to_scval(rv) if rv is not None \
             else SCVal.make(T.SCV_VOID)

@@ -1,0 +1,385 @@
+"""ctypes bridge to the native wasm execution engine
+(``native/wasm_exec.cpp``) — the C++ runtime component playing wasmi's
+role behind ``invoke_host_function``. The Python side keeps decode +
+validation (``soroban/wasm.py``); this hands the flattened op lists to
+the native interpreter, with host imports bouncing back through a
+callback and ALL budget charges flowing through the real soroban
+budget. Both engines share one charge-stream contract (64-op ticks,
+flush before calls/grows, HOST_CALL_COST on crossings), so consumed
+cpu and budget-exhaustion points are bit-identical — a node may run
+either engine without consensus divergence (differential tests pin
+this).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+from stellar_tpu.soroban.wasm import (
+    HOST_CALL_COST, MAX_PAGES, Trap, WasmModule,
+)
+
+__all__ = ["available", "run_export"]
+
+_HERE = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_HERE, "native", "wasm_exec.cpp")
+_LIB = os.path.join(_HERE, "build", "libwasmexec.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+ST_OK, ST_TRAP, ST_BUDGET, ST_HOST = 0, 1, 2, 3
+
+_TRAP_MESSAGES = {
+    1: "unreachable executed",
+    2: "memory access out of bounds",
+    3: "integer divide by zero",
+    4: "integer overflow",
+    5: "call stack exhausted",
+    6: "uninitialized table element",
+    7: "indirect call type mismatch",
+    8: "data segment out of bounds",
+}
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+_HOST_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32, _i64p,
+    ctypes.c_int32, _i64p, _i64p, ctypes.c_int64, _u8p,
+    ctypes.c_int64)
+_MEM_CB = ctypes.CFUNCTYPE(ctypes.c_int32,
+                           ctypes.c_void_p, ctypes.c_int64)
+
+
+class _FuncDesc(ctypes.Structure):
+    _fields_ = [("ops_off", ctypes.c_int64),
+                ("n_ops", ctypes.c_int64),
+                ("n_locals", ctypes.c_int32),
+                ("n_params", ctypes.c_int32),
+                ("n_results", ctypes.c_int32),
+                ("type_id", ctypes.c_int32),
+                ("result_is32", ctypes.c_int32),
+                ("_pad", ctypes.c_int32)]
+
+
+class _ProgramDesc(ctypes.Structure):
+    _fields_ = [("ops", _i32p), ("imm_a", _i64p), ("imm_b", _i64p),
+                ("imm_c", _i64p), ("br_pool", _i64p),
+                ("funcs", ctypes.POINTER(_FuncDesc)),
+                ("n_funcs", ctypes.c_int32),
+                ("import_nparams", _i32p),
+                ("import_nresults", _i32p),
+                ("import_result32", _i32p),
+                ("n_imports", ctypes.c_int32),
+                ("globals_init", _i64p),
+                ("n_globals", ctypes.c_int32),
+                ("table", _i32p), ("table_len", ctypes.c_int32),
+                ("data_blob", _u8p), ("data_offs", _i64p),
+                ("data_lens", _i64p), ("n_data", ctypes.c_int32),
+                ("mem_min_pages", ctypes.c_int32),
+                ("mem_max_pages", ctypes.c_int32),
+                ("start_func", ctypes.c_int32),
+                ("func_type_ids", _i32p)]
+
+
+class _RunResult(ctypes.Structure):
+    _fields_ = [("status", ctypes.c_int32),
+                ("trap_code", ctypes.c_int32),
+                ("value", ctypes.c_int64),
+                ("has_value", ctypes.c_int32),
+                ("executed", ctypes.c_int64),
+                ("charged", ctypes.c_int64)]
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB) or \
+                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+                # atomic: concurrent processes must never dlopen a
+                # half-written library (the consensus path runs here)
+                tmp = _LIB + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _LIB)
+            lib = ctypes.CDLL(_LIB)
+            lib.wasm_run.argtypes = [
+                ctypes.POINTER(_ProgramDesc), ctypes.c_int32, _i64p,
+                ctypes.c_int32, _HOST_CB, _MEM_CB, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.POINTER(_RunResult)]
+            lib.wasm_run.restype = ctypes.c_int32
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# flattening: WasmModule -> ProgramDesc (cached on the module)
+# ---------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _s64(v: int) -> int:
+    v &= _M64
+    return v - (1 << 64) if v >> 63 else v
+
+
+def _compile(module: WasmModule):
+    """Flatten the decoded module into the arrays the native engine
+    consumes; kept alive as a tuple on the module."""
+    cached = getattr(module, "_native_prog", None)
+    if cached is not None:
+        return cached
+    # canonical type ids by STRUCTURE (call_indirect compares types
+    # structurally, like the Python engine)
+    type_ids: Dict[Tuple, int] = {}
+
+    def tid(ft) -> int:
+        key = (ft.params, ft.results)
+        return type_ids.setdefault(key, len(type_ids))
+
+    ops_l, ia_l, ib_l, ic_l = [], [], [], []
+    pool = []
+    funcs = (_FuncDesc * max(1, len(module.funcs)))()
+    for i, f in enumerate(module.funcs):
+        off = len(ops_l)
+        for op, imm in f.ops:
+            a = b = c = 0
+            if op in (0x0C, 0x0D):
+                a, b, c = imm
+            elif op == 0x0E:
+                a = len(pool)
+                b = len(imm)
+                pool.extend(imm)
+            elif op == 0x11:
+                a = tid(module.types[imm])
+            elif isinstance(imm, int):
+                a = _s64(imm)
+            ops_l.append(op)
+            ia_l.append(a)
+            ib_l.append(b)
+            ic_l.append(c)
+        from stellar_tpu.soroban.wasm import I32 as _I32
+        funcs[i] = _FuncDesc(
+            ops_off=off, n_ops=len(f.ops),
+            n_locals=len(f.locals), n_params=len(f.type.params),
+            n_results=len(f.type.results), type_id=tid(f.type),
+            result_is32=1 if (f.type.results and
+                              f.type.results[0] == _I32) else 0)
+
+    n_ops = max(1, len(ops_l))
+    ops = (ctypes.c_int32 * n_ops)(*ops_l)
+    ia = (ctypes.c_int64 * n_ops)(*ia_l)
+    ib = (ctypes.c_int64 * n_ops)(*ib_l)
+    ic = (ctypes.c_int64 * n_ops)(*ic_l)
+    pool_arr = (ctypes.c_int64 * max(1, len(pool) * 3))(
+        *[x for tr in pool for x in tr])
+
+    from stellar_tpu.soroban.wasm import I32
+    n_imp = max(1, len(module.imports))
+    imp_np = (ctypes.c_int32 * n_imp)(
+        *[len(t.params) for _m, _n, t in module.imports] or [0])
+    imp_nr = (ctypes.c_int32 * n_imp)(
+        *[len(t.results) for _m, _n, t in module.imports] or [0])
+    imp_r32 = (ctypes.c_int32 * n_imp)(
+        *[1 if (t.results and t.results[0] == I32) else 0
+          for _m, _n, t in module.imports] or [0])
+
+    n_glob = max(1, len(module.globals))
+    globs = (ctypes.c_int64 * n_glob)(
+        *[_s64(g[2]) for g in module.globals] or [0])
+
+    table_init = [-1] * module.table_min
+    for offt, idxs in module.elements:
+        if offt < 0 or offt + len(idxs) > len(table_init):
+            # the Python engine traps at instantiation; clamping here
+            # would diverge (code-review r3 finding)
+            raise Trap("element segment out of bounds")
+        for j, fi in enumerate(idxs):
+            table_init[offt + j] = fi
+    table = (ctypes.c_int32 * max(1, len(table_init)))(
+        *table_init or [0])
+
+    blob = b"".join(d for _o, d in module.data)
+    blob_arr = (ctypes.c_uint8 * max(1, len(blob)))(*blob or [0])
+    n_data = max(1, len(module.data))
+    doffs = (ctypes.c_int64 * n_data)(
+        *[o for o, _d in module.data] or [0])
+    dlens = (ctypes.c_int64 * n_data)(
+        *[len(d) for _o, d in module.data] or [0])
+
+    n_all = len(module.imports) + len(module.funcs)
+    ftids = (ctypes.c_int32 * max(1, n_all))(
+        *([tid(module.func_type(i)) for i in range(n_all)] or [0]))
+
+    desc = _ProgramDesc(
+        ops=ops, imm_a=ia, imm_b=ib, imm_c=ic, br_pool=pool_arr,
+        funcs=funcs, n_funcs=len(module.funcs),
+        import_nparams=imp_np, import_nresults=imp_nr,
+        import_result32=imp_r32,
+        n_imports=len(module.imports),
+        globals_init=globs, n_globals=len(module.globals),
+        table=table, table_len=len(table_init),
+        data_blob=blob_arr, data_offs=doffs, data_lens=dlens,
+        n_data=len(module.data),
+        mem_min_pages=module.mem_min,
+        mem_max_pages=(module.mem_max if module.mem_max is not None
+                       else -1),
+        start_func=(module.start if module.start is not None else -1),
+        func_type_ids=ftids)
+    # keep every array alive with the desc
+    prog = (desc, ops, ia, ib, ic, pool_arr, funcs, imp_np, imp_nr,
+            imp_r32, globs, table, blob_arr, doffs, dlens, ftids)
+    module._native_prog = prog
+    return prog
+
+
+class _MemShim:
+    """WasmInstance-compatible memory facade over the C++ engine's
+    linear memory, valid for the duration of one host callback."""
+
+    __slots__ = ("ptr", "size")
+
+    def __init__(self):
+        self.ptr = None
+        self.size = 0
+
+    def _base(self) -> Optional[int]:
+        return ctypes.cast(self.ptr, ctypes.c_void_p).value \
+            if self.ptr else None
+
+    def mem_read(self, ptr: int, n: int) -> bytes:
+        if ptr < 0 or n < 0 or ptr + n > self.size:
+            raise Trap("memory access out of bounds")
+        if n == 0:
+            return b""  # zero-length reads succeed even with no memory
+        base = self._base()
+        if base is None:
+            raise Trap("memory access out of bounds")
+        return ctypes.string_at(base + ptr, n)
+
+    def mem_write(self, ptr: int, data: bytes):
+        if ptr < 0 or ptr + len(data) > self.size:
+            raise Trap("memory access out of bounds")
+        if not data:
+            return
+        base = self._base()
+        if base is None:
+            raise Trap("memory access out of bounds")
+        ctypes.memmove(base + ptr, data, len(data))
+
+
+def run_export(module: WasmModule, imports: Dict, budget,
+               cpu_per_insn: int, fn_name: str, args) -> Optional[int]:
+    """Execute ``fn_name(args)`` natively. Charges ride the REAL
+    ``budget``; raises Trap (or re-raises whatever a host import
+    raised) exactly like the Python engine."""
+    lib = _load()
+    assert lib is not None
+    exp = module.exports.get(fn_name)
+    if exp is None or exp[0] != "func":
+        raise Trap(f"no exported function {fn_name!r}")
+    ft = module.func_type(exp[1])
+    if len(args) != len(ft.params):
+        raise Trap(f"{fn_name!r} expects {len(ft.params)} args")
+    prog = _compile(module)
+    desc = prog[0]
+
+    host_fns = []
+    for mod, name, _t in module.imports:
+        fn = imports.get((mod, name))
+        if fn is None:
+            from stellar_tpu.soroban.wasm import WasmError
+            raise WasmError(f"unresolved import {mod}.{name}")
+        host_fns.append(fn)
+
+    shim = _MemShim()
+    exc_box = []
+
+    settled = [0]  # engine op-ticks already charged to the real budget
+
+    def remaining_ticks() -> int:
+        room = budget.cpu_limit - budget.cpu
+        return max(0, room // cpu_per_insn)
+
+    def settle(charged_so_far: int):
+        """Charge the engine's op ticks into the REAL budget before any
+        host-side charge decision, so host-fn charges and wasm ticks
+        share ONE exhaustion point, exactly like the Python engine
+        (which charges every tick chunk straight into the budget). By
+        construction the engine only runs ticks it was granted, so a
+        settle inside the grant never raises; the FINAL settle of a
+        budget-trapped run carries the failing chunk and raises at the
+        same point the Python engine's chunk charge does."""
+        delta = charged_so_far - settled[0]
+        if delta:
+            settled[0] = charged_so_far
+            budget.charge(delta * cpu_per_insn)
+
+    def host_cb(_ctx, import_idx, args_p, nargs, result_p,
+                ticks_left_p, charged_so_far, mem_p, mem_len):
+        try:
+            settle(charged_so_far)
+            budget.charge(HOST_CALL_COST * cpu_per_insn)
+            shim.ptr = mem_p
+            shim.size = mem_len
+            call_args = [args_p[i] & _M64 for i in range(nargs)]
+            rv = host_fns[import_idx](shim, *call_args)
+            result_p[0] = _s64(rv if rv is not None else 0)
+            ticks_left_p[0] = remaining_ticks()
+            return 0
+        except BaseException as e:
+            exc_box.append(e)
+            return 1
+
+    def mem_cb(_ctx, n_bytes):
+        try:
+            budget.charge(0, n_bytes)
+            return 0
+        except BaseException as e:
+            exc_box.append(e)
+            return 1
+
+    out = _RunResult()
+    rc = lib.wasm_run(
+        ctypes.byref(desc), exp[1],
+        (ctypes.c_int64 * max(1, len(args)))(
+            *[_s64(a & _M64) for a in args] or [0]),
+        len(args), _HOST_CB(host_cb), _MEM_CB(mem_cb), None,
+        remaining_ticks(), ctypes.byref(out))
+
+    # settle the remaining wasm-op charges; a budget-trapped run's
+    # failing chunk raises here, mirroring the Python engine's chunk
+    # charge exactly
+    settle(out.charged)
+    if rc == ST_OK:
+        return (out.value & _M64) if out.has_value else None
+    if rc == ST_HOST:
+        raise exc_box[0] if exc_box else Trap("host call failed")
+    if rc == ST_BUDGET:
+        # charged included the failing chunk: budget.charge above must
+        # have raised; reaching here means accounting drifted
+        raise AssertionError("native budget accounting out of sync")
+    raise Trap(_TRAP_MESSAGES.get(out.trap_code,
+                                  f"trap {out.trap_code}"))

@@ -837,6 +837,11 @@ class WasmInstance:
                 charge(tick)
                 return stack.pop() if imm else None
             elif op == 0x10:                  # call
+                # flush before the crossing so the budget is current
+                # when the callee (or a host fn) charges — keeps the
+                # charge stream identical to the native engine's
+                charge(tick)
+                tick = 0
                 ft = m.func_type(imm)
                 n = len(ft.params)
                 if n:
@@ -849,6 +854,8 @@ class WasmInstance:
                     stack.append((rv if rv is not None else 0) &
                                  (_M32 if ft.results[0] == I32 else _M64))
             elif op == 0x11:                  # call_indirect
+                charge(tick)
+                tick = 0
                 ti = stack.pop() & _M32
                 if ti >= len(self.table) or self.table[ti] is None:
                     raise Trap("uninitialized table element")
@@ -898,6 +905,8 @@ class WasmInstance:
             elif op == 0x3F:                  # memory.size
                 stack.append(len(self.memory) // PAGE_SIZE)
             elif op == 0x40:                  # memory.grow
+                charge(tick)
+                tick = 0
                 stack.append(self._grow(stack.pop() & _M32))
             elif op == 0x00:                  # unreachable
                 raise Trap("unreachable executed")

@@ -1,0 +1,315 @@
+"""Native wasm engine differential tests: the C++ interpreter
+(``native/wasm_exec.cpp``) must match the Python engine bit-for-bit —
+values, traps, consumed budget, and exhaustion points — because
+consumed cpu is meta-visible (consensus) and a node may run either
+engine."""
+
+import pytest
+
+from stellar_tpu.soroban import native_wasm
+from stellar_tpu.soroban.example_contracts import counter_wasm
+from stellar_tpu.soroban.wasm import (
+    Trap, WasmInstance, parse_module,
+)
+from stellar_tpu.soroban.wasm_builder import Code, I32, I64, ModuleBuilder
+
+pytestmark = pytest.mark.skipif(not native_wasm.available(),
+                                reason="native build unavailable")
+
+
+class Budget:
+    def __init__(self, cpu_limit=10**9):
+        self.cpu_limit = cpu_limit
+        self.mem_limit = 10**9
+        self.cpu = 0
+        self.mem = 0
+
+    def charge(self, cpu, mem=0):
+        self.cpu += cpu
+        self.mem += mem
+        if self.cpu > self.cpu_limit or self.mem > self.mem_limit:
+            raise Trap("budget exceeded")
+
+
+CPU = 4
+
+
+def both(module, fn, args, imports=None, cpu_limit=10**9):
+    """(native_outcome, python_outcome): each is
+    ('value'|'trap'|'budget', payload, consumed_cpu)."""
+    imports = imports or {}
+
+    def run_native():
+        bud = Budget(cpu_limit)
+        try:
+            v = native_wasm.run_export(module, imports, bud, CPU, fn,
+                                       list(args))
+            return ("value", v, bud.cpu)
+        except Trap as e:
+            kind = "budget" if "budget" in str(e) else "trap"
+            return (kind, str(e), bud.cpu)
+
+    def run_python():
+        bud = Budget(cpu_limit)
+
+        def charge(n):
+            bud.charge(n * CPU)
+
+        def mem_charge(n):
+            bud.charge(0, n)
+        try:
+            inst = WasmInstance(module, imports, charge, mem_charge)
+            # mirror the host-call cost accounting of the native path
+            v = inst.invoke(fn, list(args))
+            return ("value", v, bud.cpu)
+        except Trap as e:
+            kind = "budget" if "budget" in str(e) else "trap"
+            return (kind, str(e), bud.cpu)
+    return run_native(), run_python()
+
+
+def assert_same(module, fn, args, imports=None, cpu_limit=10**9):
+    n, p = both(module, fn, args, imports, cpu_limit)
+    assert n[0] == p[0], (fn, args, n, p)
+    if n[0] == "value":
+        assert n[1] == p[1], (fn, args, n, p)
+    assert n[2] == p[2], f"consumed cpu diverged for {fn}{args}: " \
+        f"native {n[2]} != python {p[2]}"
+
+
+def _module():
+    b = ModuleBuilder()
+    b.add_memory(1, 2)
+    b.add_func([I64, I64], [I64],
+               [], Code().local_get(0).local_get(1).i64_add(),
+               export="add")
+    c = Code()
+    c.block(0x40).loop(0x40)
+    c.local_get(2).local_get(0).i64_ge_u().br_if(1)
+    c.local_get(2).i64_const(1).i64_add().local_tee(2)
+    c.local_get(1).i64_add().local_set(1)
+    c.br(0).end().end()
+    c.local_get(1)
+    b.add_func([I64], [I64], [I64, I64], c, export="sum")
+    # memory round-trip + signed byte load
+    c = Code().i32_const(64).local_get(0).i64_store() \
+        .i32_const(64).i64_load8_u()
+    b.add_func([I64], [I64], [], c, export="lowbyte")
+    # division / overflow traps
+    c = Code().local_get(0).local_get(1).i64_div_s()
+    b.add_func([I64, I64], [I64], [], c, export="divs")
+    # br_table
+    c = Code().block(0x40).block(0x40).block(0x40)
+    c.local_get(0).i32_wrap_i64().br_table([0, 1], 2)
+    c.end().i64_const(100).return_()
+    c.end().i64_const(200).return_()
+    c.end().i64_const(300)
+    b.add_func([I64], [I64], [], c, export="table")
+    # call_indirect dispatch incl. type mismatch
+    f1 = b.add_func([], [I64], [], Code().i64_const(11))
+    f2 = b.add_func([], [I64], [], Code().i64_const(22))
+    f3 = b.add_func([I64], [I64], [], Code().local_get(0))
+    b.add_table(3).add_elem(0, [f1, f2, f3])
+    ti = b.type_idx([], [I64])
+    c = Code().local_get(0).i32_wrap_i64().call_indirect(ti)
+    b.add_func([I64], [I64], [], c, export="dispatch")
+    # globals + start + grow + rotations + sign extension
+    g = b.add_global(I64, True, 5)
+    sf = b.add_func([], [], [], Code().global_get(g).i64_const(2)
+                    .i64_mul().global_set(g))
+    b.set_start(sf)
+    b.add_func([], [I64], [], Code().global_get(g), export="gread")
+    c = Code().i32_const(1).memory_grow().drop() \
+        .i32_const(9).memory_grow().i64_extend_i32_u()
+    b.add_func([], [I64], [], c, export="grow")
+    c = Code().local_get(0).i64_const(7).i64_rotl() \
+        .i64_extend8_s()
+    b.add_func([I64], [I64], [], c, export="rot8")
+    b.add_data(100, b"\x99\x88\x77")
+    c = Code().i32_const(101).i64_load8_u()
+    b.add_func([], [I64], [], c, export="data1")
+    return parse_module(b.build())
+
+
+CASES = [
+    ("add", [5, 7]), ("add", [(1 << 64) - 1, 2]),
+    ("sum", [0]), ("sum", [1]), ("sum", [1000]), ("sum", [63]),
+    ("sum", [64]), ("sum", [65]),
+    ("lowbyte", [0xdeadbeef]), ("lowbyte", [0x80]),
+    ("divs", [-7 & ((1 << 64) - 1), 2]), ("divs", [7, 0]),
+    ("divs", [1 << 63, (1 << 64) - 1]),  # INT64_MIN / -1 overflow
+    ("table", [0]), ("table", [1]), ("table", [2]), ("table", [99]),
+    ("dispatch", [0]), ("dispatch", [1]),
+    ("dispatch", [2]),  # type mismatch trap
+    ("dispatch", [9]),  # uninitialized/oob element trap
+    ("gread", []), ("grow", []), ("rot8", [3]),
+    ("rot8", [(1 << 57)]), ("data1", []),
+]
+
+
+@pytest.mark.parametrize("fn,args", CASES)
+def test_differential(fn, args):
+    assert_same(_module(), fn, args)
+
+
+def test_budget_exhaustion_point_identical():
+    m = _module()
+    # find a limit that exhausts mid-sum, then assert both engines
+    # consume the same cpu and both report budget
+    for limit in (256, 1024, 4096, 10_000):
+        n, p = both(m, "sum", [100_000], cpu_limit=limit)
+        assert n[0] == p[0] == "budget", (limit, n, p)
+        assert n[2] == p[2], (limit, n, p)
+
+
+def test_host_imports_and_exceptions_propagate():
+    b = ModuleBuilder()
+    h = b.import_func("t", "echo", [I64], [I64])
+    hb = b.import_func("t", "boom", [], [I64])
+    c = Code().local_get(0).call(h).i64_const(1).i64_add()
+    b.add_func([I64], [I64], [], c, export="via_host")
+    c = Code().call(hb)
+    b.add_func([], [I64], [], c, export="via_boom")
+    m = parse_module(b.build())
+
+    class Custom(Exception):
+        pass
+
+    def echo(inst, v):
+        return v * 2
+
+    def boom(inst):
+        raise Custom("kapow")
+    imports = {("t", "echo"): echo, ("t", "boom"): boom}
+    assert_same(m, "via_host", [21], imports)
+    bud = Budget()
+    with pytest.raises(Custom):
+        native_wasm.run_export(m, imports, bud, CPU, "via_boom", [])
+
+
+def test_host_memory_shim_read_write():
+    b = ModuleBuilder()
+    b.add_memory(1)
+    h = b.import_func("t", "mangle", [I64], [I64])
+    # store arg at 16, let the host read+overwrite it, load it back
+    c = Code().i32_const(16).local_get(0).i64_store() \
+        .i64_const(0).call(h).drop().i32_const(16).i64_load()
+    b.add_func([I64], [I64], [], c, export="f")
+    m = parse_module(b.build())
+
+    def mangle(inst, _v):
+        data = inst.mem_read(16, 8)
+        flipped = bytes(b ^ 0xFF for b in data)
+        inst.mem_write(16, flipped)
+        return 0
+    imports = {("t", "mangle"): mangle}
+    assert_same(m, "f", [0x1122334455667788], imports)
+    n, _p = both(m, "f", [0], imports)
+    assert n[1] == 0xFFFFFFFFFFFFFFFF
+
+
+def test_counter_contract_differential_via_host():
+    """The real counter contract through the REAL host boundary with
+    the native engine ON vs OFF: identical results, storage, and
+    consumed cpu (consensus parity e2e)."""
+    import test_soroban as ts
+    import test_wasm as tw
+    from stellar_tpu.soroban import host as host_mod
+    from stellar_tpu.tx.tx_test_utils import (
+        keypair, seed_root_with_accounts,
+    )
+    XLM = 10_000_000
+
+    def run(native):
+        old = host_mod.USE_NATIVE_WASM
+        host_mod.USE_NATIVE_WASM = native
+        try:
+            a = keypair("sor-a")
+            root = seed_root_with_accounts([(a, 100_000 * XLM)])
+            cid = tw._wasm_contract(root, a)
+            res = tw._wasm_invoke(root, a, cid, "incr")
+            res2 = tw._wasm_invoke(root, a, cid, "incr")
+            from stellar_tpu.ledger.ledger_txn import key_bytes
+            from stellar_tpu.soroban.host import (
+                contract_data_key, scaddress_contract, sym,
+            )
+            from stellar_tpu.xdr.contract import ContractDataDurability
+            ck = contract_data_key(
+                scaddress_contract(cid), sym("count"),
+                ContractDataDurability.PERSISTENT)
+            counter = root.store.get(key_bytes(ck)).data.value.val.value
+            return (res.code, res2.code, res.fee_charged,
+                    res2.fee_charged, counter)
+        finally:
+            host_mod.USE_NATIVE_WASM = old
+
+    assert run(True) == run(False)
+
+
+def test_budget_exhaustion_with_host_calls_identical():
+    """Exhaustion points must coincide even when host-fn charges
+    interleave with wasm ticks (code-review r3: the refresh must not
+    re-grant unsettled op charges)."""
+    b = ModuleBuilder()
+    h = b.import_func("t", "tax", [], [I64])
+    # loop: burn ~40 ops then a host call, repeat
+    c = Code()
+    c.block(0x40).loop(0x40)
+    c.local_get(1).i64_const(1).i64_add().local_set(1)
+    for _ in range(12):
+        c.local_get(1).i64_const(3).i64_mul().local_set(1)
+    c.call(h).drop()
+    c.local_get(0).i64_const(1).i64_sub().local_tee(0)
+    c.i64_const(0).i64_ne().br_if(0)
+    c.end().end().local_get(1)
+    b.add_func([I64], [I64], [I64], c, export="churn")
+    m = parse_module(b.build())
+
+    def tax(inst):
+        return 7
+    imports = {("t", "tax"): tax}
+    for limit in (500, 2000, 5000, 20_000, 100_000):
+        n, p = both(m, "churn", [200], imports, cpu_limit=limit)
+        assert n[0] == p[0], (limit, n, p)
+        assert n[2] == p[2], \
+            f"cpu diverged at limit {limit}: {n} vs {p}"
+
+
+def test_i32_result_import_masked_identically():
+    """An import declared with an i32 result gets its value masked at
+    the call site in BOTH engines (code-review r3 finding)."""
+    b = ModuleBuilder()
+    h = b.import_func("t", "wide", [], [I32])
+    c = Code().call(h).i64_extend_i32_u()
+    b.add_func([], [I64], [], c, export="f")
+    m = parse_module(b.build())
+
+    def wide(inst):
+        return 0xAABBCCDD11223344  # 64-bit value through an i32 slot
+    assert_same(m, "f", [], {("t", "wide"): wide})
+    n, _ = both(m, "f", [], {("t", "wide"): wide})
+    assert n[1] == 0x11223344
+
+
+def test_element_segment_overflow_traps_both():
+    b = ModuleBuilder()
+    f1 = b.add_func([], [I64], [], Code().i64_const(1), export="f")
+    b.add_table(1).add_elem(0, [f1, f1, f1])  # overflows the table
+    m = parse_module(b.build())
+    n, p = both(m, "f", [])
+    assert n[0] == p[0] == "trap", (n, p)
+
+
+def test_zero_length_mem_access_without_memory():
+    """mem_read(0,0) through a host fn succeeds in both engines even
+    when the module declares no linear memory."""
+    b = ModuleBuilder()
+    h = b.import_func("t", "peek", [], [I64])
+    c = Code().call(h)
+    b.add_func([], [I64], [], c, export="f")
+    m = parse_module(b.build())
+
+    def peek(inst):
+        assert inst.mem_read(0, 0) == b""
+        return 42
+    assert_same(m, "f", [], {("t", "peek"): peek})
