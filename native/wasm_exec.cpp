@@ -36,6 +36,7 @@ constexpr int32_t TRAP_STACK = 5;
 constexpr int32_t TRAP_UNINIT_ELEM = 6;
 constexpr int32_t TRAP_TYPE = 7;
 constexpr int32_t TRAP_SEGMENT = 8;
+constexpr int32_t TRAP_NO_EXPORT = 9;
 
 constexpr int32_t MAX_FRAMES = 256;
 constexpr int64_t PAGE = 65536;
@@ -606,12 +607,10 @@ int32_t wasm_run(const ProgramDesc* prog, int32_t func_idx,
     e.mem_cb = mem_cb;
     e.ctx = ctx;
     e.ticks_left = ticks_budget;
+    // initial linear memory is charged by the BRIDGE before this call
+    // (instantiation-order parity with the Python engine); mem_cb here
+    // covers only memory.grow
     e.memory.assign((size_t)prog->mem_min_pages * PAGE, 0);
-    if (!e.memory.empty() && mem_cb) {
-        if (mem_cb(ctx, (int64_t)e.memory.size())) {
-            out->status = ST_HOST; out->executed = 0; return ST_HOST;
-        }
-    }
     e.globals.assign(prog->globals_init,
                      prog->globals_init + prog->n_globals);
     e.table.assign(prog->table, prog->table + prog->table_len);
@@ -632,6 +631,14 @@ int32_t wasm_run(const ProgramDesc* prog, int32_t func_idx,
     bool ok = true;
     if (prog->start_func >= 0)
         ok = call_function(e, prog->start_func, nullptr, 0, &val, &has);
+    if (ok && func_idx < 0) {
+        // instantiation completed but the requested export does not
+        // exist (or its signature mismatched): trap AFTER start, the
+        // Python engine's ordering (WasmInstance.__init__ then invoke)
+        e.status = ST_TRAP;
+        e.trap_code = TRAP_NO_EXPORT;
+        ok = false;
+    }
     if (ok)
         ok = call_function(e, func_idx, args, nargs, &val, &has);
     out->status = ok ? ST_OK : e.status;

@@ -485,7 +485,8 @@ def cmd_apply_load(args) -> int:
                                     txs_per_ledger=args.txs)
     elif args.scenario == "soroban":
         stats = soroban_apply_load(n_ledgers=args.ledgers,
-                                   txs_per_ledger=args.txs)
+                                   txs_per_ledger=args.txs,
+                                   use_wasm=args.wasm)
     else:
         stats = apply_load(n_ledgers=args.ledgers,
                            txs_per_ledger=args.txs)
@@ -553,6 +554,9 @@ def main(argv=None) -> int:
     sp.add_argument("--scenario", default="close",
                     choices=["close", "catchup", "scp-storm",
                              "multisig", "soroban"])
+    sp.add_argument("--wasm", action="store_true",
+                    help="soroban scenario runs a compiled wasm "
+                         "contract (native engine when built)")
     sp.add_argument("--verify", default="auto",
                     choices=["auto", "host", "device"],
                     help="signature verification routing: auto = "

@@ -462,12 +462,14 @@ def multisig_apply_load(n_ledgers: int = 5, txs_per_ledger: int = 1000,
     }
 
 
-def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
-                       ) -> dict:
+def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
+                       use_wasm: bool = False) -> dict:
     """BASELINE config #5: Soroban InvokeHostFunction txs/ledger, each a
     fee-bump outer envelope around an invoke with a signed ed25519 auth
     entry — 3 signatures per tx (outer, inner, auth) through the verify
-    path, plus wasm execution and footprint/fee accounting."""
+    path, plus contract execution and footprint/fee accounting.
+    ``use_wasm`` runs a genuinely compiled wasm counter (native C++
+    engine when built) instead of the legacy SCVal program."""
     import dataclasses
     from stellar_tpu.crypto.sha import sha256
     from stellar_tpu.ledger.ledger_txn import key_bytes
@@ -513,20 +515,24 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
         tx_max_read_ledger_entries=10, tx_max_write_ledger_entries=8)
     lm.root.soroban_config = lm.soroban_config
 
-    code = assemble_program({
-        "auth_incr": [
-            ins("arg", u32(0)), ins("require_auth"),
-            ins("push", sym("count")), ins("has", sym("persistent")),
-            ins("jz", u32(3)),
-            ins("push", sym("count")), ins("get", sym("persistent")),
-            ins("jmp", u32(1)),
-            ins("push", u32(0)),
-            ins("push", u32(1)), ins("add"),
-            ins("push", sym("count")), ins("swap"),
-            ins("put", sym("persistent")),
-            ins("ret"),
-        ],
-    })
+    if use_wasm:
+        from stellar_tpu.soroban.example_contracts import counter_wasm
+        code = counter_wasm()  # auth_incr(addr): same ABI as below
+    else:
+        code = assemble_program({
+            "auth_incr": [
+                ins("arg", u32(0)), ins("require_auth"),
+                ins("push", sym("count")), ins("has", sym("persistent")),
+                ins("jz", u32(3)),
+                ins("push", sym("count")), ins("get", sym("persistent")),
+                ins("jmp", u32(1)),
+                ins("push", u32(0)),
+                ins("push", u32(1)), ins("add"),
+                ins("push", sym("count")), ins("swap"),
+                ins("put", sym("persistent")),
+                ins("ret"),
+            ],
+        })
     code_hash = sha256(code)
     owner = srcs[0]
     seqs = {k.public_key.raw: (1 << 32) for k in srcs + payers}
@@ -645,8 +651,16 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500
         total += res.applied_count
     stats = close_timer.to_dict()
     counter = lm.root.store.get(key_bytes(counter_key))
+    if use_wasm:
+        from stellar_tpu.soroban import host as _host_mod
+        from stellar_tpu.soroban import native_wasm as _nw
+        engine = "wasm-native" if (_host_mod.USE_NATIVE_WASM and
+                                   _nw.available()) else "wasm-python"
+    else:
+        engine = "scval"
     return {
         "scenario": "soroban",
+        "engine": engine,
         "ledgers": n_ledgers,
         "txs_per_ledger": txs_per_ledger,
         "signatures_per_ledger": txs_per_ledger * 3,

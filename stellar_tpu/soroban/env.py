@@ -394,6 +394,43 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             env.data_del(kb)
         return _make(TAG_VOID)
 
+    def extend_contract_data_ttl(inst, k_val, t_val, thresh_val,
+                                 ext_val):
+        key_sc, dur, kb = _storage_args(k_val, t_val)
+        if dur is None:
+            raise EnvError("use the instance TTL host fn for "
+                           "instance storage")
+        env.host.extend_ttl(kb, _u32_arg(thresh_val, "threshold"),
+                            _u32_arg(ext_val, "extend_to"))
+        return _make(TAG_VOID)
+
+    def extend_instance_and_code_ttl(inst, thresh_val, ext_val):
+        """Extend the current contract's instance entry AND its code
+        entry (reference extend_current_contract_instance_and_code_ttl)."""
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.soroban.host import (
+            contract_code_key, contract_data_key,
+        )
+        from stellar_tpu.xdr.contract import (
+            ContractDataDurability, ContractExecutableType,
+        )
+        thresh = _u32_arg(thresh_val, "threshold")
+        ext = _u32_arg(ext_val, "extend_to")
+        inst_kb = key_bytes(contract_data_key(
+            env.contract_addr,
+            SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT))
+        env.host.extend_ttl(inst_kb, thresh, ext)
+        slot = env.host.storage.entries.get(inst_kb)
+        if slot is not None and slot[0] is not None:
+            instance = slot[0].data.value.val.value
+            if instance.executable.arm == \
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
+                code_kb = key_bytes(contract_code_key(
+                    instance.executable.value))
+                env.host.extend_ttl(code_kb, thresh, ext)
+        return _make(TAG_VOID)
+
     # ---- vec ----
     # Structural ops charge proportionally to the work they do (copy
     # size, entries compared) — a flat per-call fee would let real CPU
@@ -575,6 +612,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         ("l", "get_contract_data"): get_contract_data,
         ("l", "has_contract_data"): has_contract_data,
         ("l", "del_contract_data"): del_contract_data,
+        ("l", "extend_contract_data_ttl"): extend_contract_data_ttl,
+        ("l", "extend_instance_and_code_ttl"):
+            extend_instance_and_code_ttl,
         ("v", "vec_new"): vec_new,
         ("v", "vec_push_back"): vec_push_back,
         ("v", "vec_get"): vec_get,

@@ -12,7 +12,7 @@ from stellar_tpu.soroban.env import (
 )
 from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
 
-__all__ = ["counter_wasm", "KEY_COUNT_VAL"]
+__all__ = ["counter_wasm", "ttl_wasm", "KEY_COUNT_VAL"]
 
 
 def _u32val(v: int) -> int:
@@ -100,4 +100,35 @@ def counter_wasm() -> bytes:
     c.i64_const(TAG_VOID).end()
     b.add_func([], [I64], [], c, export="spin")
 
+    return b.build()
+
+
+def ttl_wasm() -> bytes:
+    """TTL-exercising contract: ``setup()`` writes a persistent entry;
+    ``bump(threshold, extend_to)`` extends that entry's TTL from inside
+    the contract; ``bump_self(threshold, extend_to)`` extends the
+    instance + code TTLs (reference extend_contract_data_ttl /
+    extend_current_contract_instance_and_code_ttl host fns)."""
+    b = ModuleBuilder()
+    put_fn = b.import_func("l", "put_contract_data",
+                           [I64, I64, I64], [I64])
+    ext_fn = b.import_func("l", "extend_contract_data_ttl",
+                           [I64, I64, I64, I64], [I64])
+    self_fn = b.import_func("l", "extend_instance_and_code_ttl",
+                            [I64, I64], [I64])
+    key = KEY_COUNT_VAL  # rides the standard harness footprint
+
+    c = Code()
+    c.i64_const(key).i64_const(_u32val(1)).i64_const(_T_PERSISTENT)
+    c.call(put_fn).end()
+    b.add_func([], [I64], [], c, export="setup")
+
+    c = Code()
+    c.i64_const(key).i64_const(_T_PERSISTENT)
+    c.local_get(0).local_get(1).call(ext_fn).end()
+    b.add_func([I64, I64], [I64], [], c, export="bump")
+
+    c = Code()
+    c.local_get(0).local_get(1).call(self_fn).end()
+    b.add_func([I64, I64], [I64], [], c, export="bump_self")
     return b.build()

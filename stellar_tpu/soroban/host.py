@@ -218,6 +218,10 @@ class _Storage:
         self._read_charged: set = set()
         self._write_sizes: Dict[bytes, int] = {}
         self.read_bytes = 0
+        # kb -> new live_until from in-contract TTL extensions
+        # (separate from dirty slots: a TTL-only bump must not rewrite
+        # the data entry, mirroring ExtendFootprintTTLOp semantics)
+        self.ttl_extensions: Dict[bytes, int] = {}
 
     @property
     def write_bytes(self) -> int:
@@ -586,6 +590,8 @@ class InvokeOutput:
     return_value: Optional[object] = None
     # kb -> (LedgerEntry|None, live_until|None) for dirtied slots
     modified: Dict[bytes, Tuple] = field(default_factory=dict)
+    # kb -> new live_until for TTL-only extensions (entry untouched)
+    ttl_extensions: Dict[bytes, int] = field(default_factory=dict)
     events: List = field(default_factory=list)
     # contract log/debug output (SCVals), populated only when
     # DIAGNOSTIC_EVENTS_ENABLED (never consensus-visible)
@@ -690,6 +696,35 @@ class _Host:
     def instance_del(self, contract_addr, key):
         self._instance_update(contract_addr, key, None, delete=True)
 
+    def extend_ttl(self, kb: bytes, threshold: int, extend_to: int):
+        """In-contract TTL extension (reference host
+        ``extend_contract_data_ttl``): when the entry's remaining
+        lifetime sits below ``threshold`` ledgers, raise live_until to
+        now + extend_to (capped by max_entry_ttl). Declared-footprint
+        keys only; read-only keys allowed (like ExtendFootprintTTLOp)."""
+        st = self.storage
+        if kb not in st.read_only and kb not in st.read_write:
+            raise HostError(HostError.TRAPPED,
+                            "TTL extension outside declared footprint")
+        if threshold > extend_to:
+            raise HostError(HostError.TRAPPED,
+                            "TTL threshold above extend_to")
+        if extend_to > self.config.max_entry_ttl - 1:
+            raise HostError(HostError.TRAPPED, "extend_to above max TTL")
+        slot = st.entries.get(kb)
+        if slot is None or slot[0] is None:
+            raise HostError(HostError.TRAPPED,
+                            "missing entry for TTL extension")
+        st._check_live(kb, slot)
+        self.budget.charge(CPU_PER_STORAGE_OP)
+        cur_live = st.ttl_extensions.get(kb, slot[1])
+        if cur_live is None:
+            return  # entry carries no TTL (nothing to extend)
+        if cur_live - self.ledger_seq < threshold:
+            new_live = self.ledger_seq + extend_to
+            if new_live > cur_live:
+                st.ttl_extensions[kb] = new_live
+
     def _instance_update(self, contract_addr, key, val, delete: bool):
         kb, inst = self._instance_entry(contract_addr)
         storage = list(inst.storage or ())
@@ -758,9 +793,15 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
     out.read_bytes = storage.read_bytes
     out.write_bytes = storage.write_bytes
     if out.success:
-        out.modified = {kb: (slot[0], slot[1])
+        out.modified = {kb: (slot[0],
+                             max(slot[1] or 0,
+                                 storage.ttl_extensions.get(kb, 0))
+                             or None)
                         for kb, slot in storage.entries.items()
                         if slot[2]}
+        out.ttl_extensions = {
+            kb: lu for kb, lu in storage.ttl_extensions.items()
+            if kb not in out.modified}
     return out
 
 

@@ -297,14 +297,24 @@ def run_export(module: WasmModule, imports: Dict, budget,
     raised) exactly like the Python engine."""
     lib = _load()
     assert lib is not None
-    exp = module.exports.get(fn_name)
-    if exp is None or exp[0] != "func":
-        raise Trap(f"no exported function {fn_name!r}")
-    ft = module.func_type(exp[1])
-    if len(args) != len(ft.params):
-        raise Trap(f"{fn_name!r} expects {len(ft.params)} args")
-    prog = _compile(module)
+    # instantiation-order parity with the Python engine: initial
+    # memory is charged FIRST (WasmInstance.__init__), then element
+    # segments validate, then start runs, and only then do export /
+    # arity checks trap — so budget-vs-trap classification matches
+    if module.mem_min:
+        budget.charge(0, module.mem_min * 65536)
+    prog = _compile(module)  # raises the element-segment Trap
     desc = prog[0]
+    func_idx = -1
+    export_error = f"no exported function {fn_name!r}"
+    exp = module.exports.get(fn_name)
+    if exp is not None and exp[0] == "func":
+        ft = module.func_type(exp[1])
+        if len(args) != len(ft.params):
+            export_error = f"{fn_name!r} expects {len(ft.params)} args"
+            args = []
+        else:
+            func_idx = exp[1]
 
     host_fns = []
     for mod, name, _t in module.imports:
@@ -363,7 +373,7 @@ def run_export(module: WasmModule, imports: Dict, budget,
 
     out = _RunResult()
     rc = lib.wasm_run(
-        ctypes.byref(desc), exp[1],
+        ctypes.byref(desc), func_idx,
         (ctypes.c_int64 * max(1, len(args)))(
             *[_s64(a & _M64) for a in args] or [0]),
         len(args), _HOST_CB(host_cb), _MEM_CB(mem_cb), None,
@@ -381,5 +391,7 @@ def run_export(module: WasmModule, imports: Dict, budget,
         # charged included the failing chunk: budget.charge above must
         # have raised; reaching here means accounting drifted
         raise AssertionError("native budget accounting out of sync")
+    if out.trap_code == 9:  # missing export / arity, post-start
+        raise Trap(export_error)
     raise Trap(_TRAP_MESSAGES.get(out.trap_code,
                                   f"trap {out.trap_code}"))

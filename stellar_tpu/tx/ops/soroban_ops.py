@@ -63,6 +63,27 @@ def _load_with_ttl(ltx, lk):
     return entry, None
 
 
+def _extend_entry_ttl(cfg, ltx, lk, entry, old_live, live_until: int,
+                      seq: int, always_write: bool = False) -> int:
+    """Write the TTL row for an entry whose live_until rose and return
+    the rent fee for the extension (shared by written-entry and
+    TTL-only host-extension paths — one formula, one durability test).
+    ``always_write`` keeps the written-entry path's behavior of
+    refreshing the TTL row even without an extension."""
+    extension = live_until - (old_live if old_live else seq - 1)
+    fee = 0
+    if extension > 0:
+        from stellar_tpu.xdr.contract import ContractDataDurability
+        persistent = not (
+            lk.arm == LedgerEntryType.CONTRACT_DATA and
+            lk.value.durability == ContractDataDurability.TEMPORARY)
+        fee = compute_rent_fee(cfg, len(to_bytes(LedgerEntry, entry)),
+                               extension, persistent)
+    if extension > 0 or always_write:
+        _write_ttl(ltx, lk, live_until, seq)
+    return fee
+
+
 def _write_ttl(ltx, lk, live_until: int, ledger_seq: int):
     tk = ttl_key_for(lk)
     h = ltx.load(tk)
@@ -189,23 +210,22 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                 else:
                     ltx.create(entry).deactivate()
                 if live_until is not None:
-                    _, old_live = None, None
                     prev = footprint_entries.get(kb)
-                    old_live = prev[1] if prev else None
-                    extension = live_until - (old_live if old_live
-                                              else seq - 1)
-                    if extension > 0:
-                        from stellar_tpu.xdr.contract import (
-                            ContractDataDurability,
-                        )
-                        persistent = not (
-                            lk.arm == LedgerEntryType.CONTRACT_DATA and
-                            lk.value.durability ==
-                            ContractDataDurability.TEMPORARY)
-                        rent_fee += compute_rent_fee(
-                            cfg, len(to_bytes(LedgerEntry, entry)),
-                            extension, persistent)
-                    _write_ttl(ltx, lk, live_until, seq)
+                    rent_fee += _extend_entry_ttl(
+                        cfg, ltx, lk, entry,
+                        prev[1] if prev else None, live_until, seq,
+                        always_write=True)
+
+            # TTL-only extensions from inside the contract (reference
+            # extend_contract_data_ttl host fn): rent + TTL row, the
+            # data entry itself untouched
+            for kb, live_until in out.ttl_extensions.items():
+                lk = from_bytes(LedgerKey, kb)
+                prev = footprint_entries.get(kb)
+                if prev is None or prev[0] is None:
+                    continue
+                rent_fee += _extend_entry_ttl(
+                    cfg, ltx, lk, prev[0], prev[1], live_until, seq)
 
             events_size = sum(len(to_bytes(
                 __import__("stellar_tpu.xdr.contract",

@@ -313,3 +313,34 @@ def test_zero_length_mem_access_without_memory():
         assert inst.mem_read(0, 0) == b""
         return 42
     assert_same(m, "f", [], {("t", "peek"): peek})
+
+
+def test_missing_export_classification_matches_python():
+    """Instantiation (memory charge + start) precedes the export check
+    in BOTH engines, so budget-vs-trap classification agrees even for
+    invokes of nonexistent functions (code-review r3 finding)."""
+    b = ModuleBuilder()
+    b.add_memory(2)  # initial memory: a real mem charge
+    g = b.add_global(I64, True, 1)
+    # start fn burns ops so a tight cpu budget can exhaust pre-export
+    c = Code()
+    c.block(0x40).loop(0x40)
+    c.global_get(g).i64_const(1).i64_add().global_set(g)
+    c.global_get(g).i64_const(5000).i64_lt_u().br_if(0)
+    c.end().end()
+    sf = b.add_func([], [], [], c)
+    b.set_start(sf)
+    b.add_func([], [I64], [], Code().global_get(g), export="real")
+    m = parse_module(b.build())
+    # generous budget: both engines report the missing-export trap
+    n, p = both(m, "nope", [])
+    assert n[0] == p[0] == "trap", (n, p)
+    assert n[2] == p[2]
+    # tight budget: BOTH classify as budget (start exhausts first)
+    n, p = both(m, "nope", [], cpu_limit=2000)
+    assert n[0] == p[0] == "budget", (n, p)
+    assert n[2] == p[2]
+    # arity mismatch likewise traps after instantiation in both
+    n, p = both(m, "real", [1, 2, 3])
+    assert n[0] == p[0] == "trap", (n, p)
+    assert n[2] == p[2]

@@ -455,3 +455,66 @@ def test_wasm_rejects_malformed_upload(env):
     res = apply_tx(root, upload_tx(root, a, code=bad))
     assert res.code == TC.txFAILED
     assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_TRAPPED
+
+
+def test_wasm_in_contract_ttl_extension(env):
+    """A contract extends its own entry's TTL (and the instance+code
+    TTLs) from inside wasm; the ledger TTL rows rise without the data
+    entries being rewritten."""
+    import test_soroban
+    from stellar_tpu.soroban.example_contracts import ttl_wasm
+
+    root, a = env
+    code = ttl_wasm()
+    code_hash = sha256(code)
+    old_code, old_hash = test_soroban.COUNTER_CODE, test_soroban.CODE_HASH
+    test_soroban.COUNTER_CODE = code
+    test_soroban.CODE_HASH = code_hash
+    try:
+        assert apply_tx(root, upload_tx(root, a, code=code)).code == \
+            TC.txSUCCESS
+        tx, contract_id = create_tx(root, a)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+        addr = scaddress_contract(contract_id)
+        dk = contract_data_key(addr, sym("count"),
+                               ContractDataDurability.PERSISTENT)
+
+        res = apply_tx(root, invoke_tx(root, a, contract_id, "setup"))
+        assert res.code == TC.txSUCCESS
+
+        def live_until(lk):
+            e = root.store.get(key_bytes(ttl_key_for(lk)))
+            return e.data.value.liveUntilLedgerSeq
+
+        before = live_until(dk)
+        entry_before = root.store.get(key_bytes(dk))
+        # bump: remaining TTL is below a huge threshold -> extend
+        res = apply_tx(root, invoke_tx(
+            root, a, contract_id, "bump",
+            args=[SCVal.make(T.SCV_U32, 1_000_000),
+                  SCVal.make(T.SCV_U32, 1_000_000)]))
+        assert res.code == TC.txSUCCESS, res.code
+        after = live_until(dk)
+        assert after > before
+        # the data entry itself was NOT rewritten
+        entry_after = root.store.get(key_bytes(dk))
+        assert entry_after.lastModifiedLedgerSeq == \
+            entry_before.lastModifiedLedgerSeq
+
+        # instance + code TTLs through bump_self
+        from stellar_tpu.xdr.contract import SCValType as _T2
+        ik = contract_data_key(
+            addr, SCVal.make(_T2.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        ck = contract_code_key(code_hash)
+        inst_before, code_before = live_until(ik), live_until(ck)
+        res = apply_tx(root, invoke_tx(
+            root, a, contract_id, "bump_self",
+            args=[SCVal.make(T.SCV_U32, 1_000_000),
+                  SCVal.make(T.SCV_U32, 1_000_000)]))
+        assert res.code == TC.txSUCCESS
+        assert live_until(ik) > inst_before
+        assert live_until(ck) > code_before
+    finally:
+        test_soroban.COUNTER_CODE = old_code
+        test_soroban.CODE_HASH = old_hash
