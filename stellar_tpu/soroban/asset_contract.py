@@ -128,7 +128,7 @@ def _addr_raw(addr_val):
 
 
 def asset_contract_call(host, contract_addr, inst, fn_name: bytes,
-                        args, invocation):
+                        args, invocation, depth: int = 0):
     """Dispatch one SAC function (reference SAC entry points)."""
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.soroban.host import (
@@ -191,7 +191,7 @@ def asset_contract_call(host, contract_addr, inst, fn_name: bytes,
         amount = _from_i128(args[2])
         if amount < 0:
             raise HostError(HostError.TRAPPED, "negative amount")
-        host.auth.require(_address_bytes(frm), invocation)
+        host.auth.require(_address_bytes(frm), invocation, depth)
         holder_add(frm, -amount)
         holder_add(to, amount)
         host.emit_event(contract_addr,
@@ -209,7 +209,7 @@ def asset_contract_call(host, contract_addr, inst, fn_name: bytes,
         from stellar_tpu.xdr.types import account_id
         host.auth.require(
             _address_bytes(scaddress_account(account_id(issuer))),
-            invocation)
+            invocation, depth)
         holder_add(to, amount)
         host.emit_event(contract_addr, [sym("mint")], _i128(amount))
         return SCVal.make(T.SCV_VOID)

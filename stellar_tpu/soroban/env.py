@@ -577,7 +577,8 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     def require_auth(inst, addr_val):
         addr = cv.obj(addr_val, TAG_ADDRESS_OBJ)
         env.host.require_auth(
-            SCVal.make(T.SCV_ADDRESS, addr), env.invocation)
+            SCVal.make(T.SCV_ADDRESS, addr), env.invocation,
+            env.depth)
         return _make(TAG_VOID)
 
     # ---- cross-contract call ----

@@ -12,7 +12,8 @@ from stellar_tpu.soroban.env import (
 )
 from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
 
-__all__ = ["counter_wasm", "ttl_wasm", "KEY_COUNT_VAL"]
+__all__ = ["counter_wasm", "ttl_wasm", "custom_account_wasm",
+           "KEY_COUNT_VAL"]
 
 
 def _u32val(v: int) -> int:
@@ -131,4 +132,18 @@ def ttl_wasm() -> bytes:
     c = Code()
     c.local_get(0).local_get(1).call(self_fn).end()
     b.add_func([I64, I64], [I64], [], c, export="bump_self")
+    return b.build()
+
+
+def custom_account_wasm() -> bytes:
+    """Minimal CUSTOM ACCOUNT (reference account abstraction): the
+    host dispatches ``__check_auth(signature_payload, signatures)``
+    for contract-address credentials; this one approves when the
+    signature Val equals the symbol ``letmein``."""
+    b = ModuleBuilder()
+    c = Code()
+    c.local_get(1).i64_const(sym_to_small(b"letmein")).i64_eq()
+    c.if_(0x40).else_().unreachable().end()
+    c.i64_const(TAG_VOID).end()
+    b.add_func([I64, I64], [I64], [], c, export="__check_auth")
     return b.build()

@@ -283,16 +283,31 @@ def _address_bytes(addr) -> bytes:
 class _AuthContext:
     """Verified-but-unconsumed authorizations (reference host's
     require_auth against SorobanAuthorizationEntry trees; one level —
-    no sub-invocations until cross-contract calls land)."""
+    no sub-invocations until cross-contract calls land).
+
+    CONTRACT-address credentials are CUSTOM ACCOUNTS (reference
+    account abstraction): their signatures are not checked here but by
+    dispatching ``__check_auth(payload, signatures)`` on the contract
+    itself, deferred to the first matching ``require`` (the host must
+    exist to run contract code). A rejecting or trapping __check_auth
+    fails authorization; reentrant dispatch is refused."""
 
     def __init__(self, auth_entries, source_account, network_id: bytes,
                  ledger_seq: int, storage: _Storage, verify_sig):
+        # addr bytes -> [(fn, check_cell|None)]; a check cell is one
+        # auth ENTRY's deferred __check_auth state, shared by every fn
+        # the entry's invocation tree authorizes and dispatched only
+        # when one of THOSE fns is actually required (unused entries
+        # stay unchecked, like the reference)
         self.available: Dict[bytes, list] = {}
         self.source_addr = _address_bytes(
             scaddress_account(source_account))
         self.storage = storage
+        self.host = None  # back-ref set by invoke_host_function
+        self._checking_addr: Optional[bytes] = None
         for entry in auth_entries:
             cred = entry.credentials
+            cell = None
             if cred.arm == \
                     SorobanCredentialsType.SOROBAN_CREDENTIALS_SOURCE_ACCOUNT:
                 key = self.source_addr
@@ -304,14 +319,21 @@ class _AuthContext:
                 payload = auth_payload_hash(
                     network_id, ac.nonce, ac.signatureExpirationLedger,
                     entry.rootInvocation)
-                self._verify_address_signature(ac, payload, verify_sig)
-                self._consume_nonce(ac, ledger_seq)
                 key = _address_bytes(ac.address)
+                if ac.address.arm == \
+                        SCAddressType.SC_ADDRESS_TYPE_CONTRACT:
+                    cell = {"ac": ac, "payload": payload,
+                            "verified": False}
+                else:
+                    self._verify_address_signature(ac, payload,
+                                                   verify_sig)
+                self._consume_nonce(ac, ledger_seq)
             # the whole invocation tree is authorized: flatten root +
             # subInvocations (cross-contract calls consume sub-entries)
             fns: list = []
             self._flatten(entry.rootInvocation, fns)
-            self.available.setdefault(key, []).extend(fns)
+            self.available.setdefault(key, []).extend(
+                (fn, cell) for fn in fns)
 
     @staticmethod
     def _flatten(inv, out: list):
@@ -369,16 +391,59 @@ class _AuthContext:
             LedgerEntryType.CONTRACT_DATA, entry, ledger_seq),
             ac.signatureExpirationLedger)
 
-    def require(self, addr_bytes: bytes, invoked_fn):
+    def require(self, addr_bytes: bytes, invoked_fn, depth: int = 0):
         """Consume one matching authorization or trap (reference
-        require_auth semantics)."""
+        require_auth semantics); a custom-account entry runs ITS
+        __check_auth (once) before its first fn is consumed."""
         from stellar_tpu.xdr.contract import SorobanAuthorizedFunction
+        if self._checking_addr == addr_bytes:
+            # require_auth for the account whose __check_auth is
+            # currently running: refuse reentry (reference rule)
+            raise HostError(HostError.AUTH,
+                            "reentrant require_auth in __check_auth")
         want = to_bytes(SorobanAuthorizedFunction, invoked_fn)
-        for i, fn in enumerate(self.available.get(addr_bytes, [])):
+        entries = self.available.get(addr_bytes, [])
+        for i, (fn, cell) in enumerate(entries):
             if to_bytes(SorobanAuthorizedFunction, fn) == want:
-                self.available[addr_bytes].pop(i)
+                if cell is not None and not cell["verified"]:
+                    self._run_check_auth(addr_bytes, cell, depth)
+                    cell["verified"] = True
+                # the list was not mutated by the dispatch: reentrant
+                # requires for this address are refused above, and
+                # other addresses touch their own lists only — but
+                # re-locate defensively rather than pop a stale index
+                try:
+                    entries.remove((fn, cell))
+                except ValueError:
+                    raise HostError(HostError.AUTH,
+                                    "authorization consumed reentrantly")
                 return
         raise HostError(HostError.AUTH, "missing authorization")
+
+    def _run_check_auth(self, addr_bytes: bytes, cell, depth: int):
+        if self.host is None:
+            raise HostError(HostError.AUTH,
+                            "custom account auth unavailable")
+        if self._checking_addr is not None:
+            raise HostError(HostError.AUTH, "reentrant __check_auth")
+        self._checking_addr = addr_bytes
+        try:
+            ac = cell["ac"]
+            try:
+                # depth continues the CURRENT chain: __check_auth does
+                # not reset the shared call-depth budget
+                self.host.call_contract(
+                    ac.address, b"__check_auth",
+                    [scbytes(cell["payload"]), ac.signature],
+                    depth + 1)
+            except HostError as e:
+                if e.kind == HostError.BUDGET:
+                    raise
+                raise HostError(
+                    HostError.AUTH,
+                    f"__check_auth rejected authorization: {e}")
+        finally:
+            self._checking_addr = None
 
 
 def _wrap_entry(t, body, ledger_seq: int) -> LedgerEntry:
@@ -470,7 +535,8 @@ class _Interp:
                 self._storage_op(op, a, stack)
             elif op == b"require_auth":
                 addr = stack.pop()
-                self.host.require_auth(addr, self.invocation)
+                self.host.require_auth(addr, self.invocation,
+                                       self.depth)
             elif op == b"call":
                 # cross-contract call: ["call", n_args]; stack holds
                 # [addr, fn_symbol, arg1..argN]
@@ -614,11 +680,12 @@ class _Host:
         self.events: List = []
         self.diagnostics: List = []
 
-    def require_auth(self, addr, invocation):
+    def require_auth(self, addr, invocation, depth: int = 0):
         if addr.arm != T.SCV_ADDRESS:
             raise HostError(HostError.TRAPPED,
                             "require_auth on non-address")
-        self.auth.require(_address_bytes(addr.value), invocation)
+        self.auth.require(_address_bytes(addr.value), invocation,
+                          depth)
 
     def call_contract(self, addr, fn_name: bytes, args: List,
                       depth: int):
@@ -771,6 +838,7 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
         auth = _AuthContext(auth_entries, source_account, network_id,
                             ledger_seq, storage, _verify_sig)
         host = _Host(storage, budget, auth, config, ledger_seq)
+        auth.host = host  # custom-account __check_auth dispatch
         host.ledger_header = ledger_header  # classic reserve math (SAC)
         t = host_fn.arm
         if t == HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM:
@@ -1028,7 +1096,8 @@ def _run_contract(host: "_Host", args, depth: int = 0):
             SorobanAuthorizedFunctionType
             .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN, args)
         return asset_contract_call(host, addr, inst, args.functionName,
-                                   list(args.args), invocation)
+                                   list(args.args), invocation,
+                                   depth=depth)
     code_entry = host.storage.get(
         key_bytes(contract_code_key(inst.executable.value)))
     if code_entry is None:

@@ -133,20 +133,22 @@ def preimage_for(a, salt=b"\x01" * 32):
             salt=salt))
 
 
-def create_tx(root, a):
+def create_tx(root, a, code_hash=None, salt=b"\x01" * 32):
+    code_hash = CODE_HASH if code_hash is None else code_hash
+    pre = preimage_for(a, salt=salt)
     fn = HostFunction.make(
         HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
         CreateContractArgs(
-            contractIDPreimage=preimage_for(a),
+            contractIDPreimage=pre,
             executable=ContractExecutable.make(
                 ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
-                CODE_HASH)))
-    contract_id = derive_contract_id(TEST_NETWORK_ID, preimage_for(a))
+                code_hash)))
+    contract_id = derive_contract_id(TEST_NETWORK_ID, pre)
     addr = scaddress_contract(contract_id)
     inst_key = contract_data_key(
         addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
         ContractDataDurability.PERSISTENT)
-    sd = soroban_data(read_only=[contract_code_key(CODE_HASH)],
+    sd = soroban_data(read_only=[contract_code_key(code_hash)],
                       read_write=[inst_key])
     return make_tx(a, seq_for(root, a), [soroban_op(fn)], fee=6_000_000,
                    soroban_data=sd), contract_id
@@ -843,3 +845,155 @@ def test_parallel_phase_rejects_bad_structure_and_order(env):
             ltx.rollback()
     finally:
         cfg.ledger_max_tx_count = old_cap
+
+
+def test_custom_account_check_auth(env):
+    """CONTRACT-address credentials dispatch __check_auth on the
+    custom-account contract (reference account abstraction): the right
+    'signature' Val authorizes, the wrong one fails the tx."""
+    import dataclasses
+
+    from stellar_tpu.soroban.example_contracts import custom_account_wasm
+    from stellar_tpu.soroban.host import auth_payload_hash
+    from stellar_tpu.xdr.contract import (
+        ContractExecutable, ContractExecutableType, CreateContractArgs,
+        SCNonceKey, SorobanAddressCredentials, SorobanAuthorizationEntry,
+        SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
+        SorobanAuthorizedInvocation, SorobanCredentials,
+        SorobanCredentialsType,
+    )
+    root, a = env
+    root.soroban_config = dataclasses.replace(
+        default_soroban_config(), tx_max_read_ledger_entries=10,
+        tx_max_write_ledger_entries=8)
+    try:
+        # counter contract (harness code) + the custom account (wasm)
+        assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+        tx, contract_id = create_tx(root, a)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+
+        acct_code = custom_account_wasm()
+        acct_hash = sha256(acct_code)
+        assert apply_tx(root, upload_tx(root, a, code=acct_code)
+                        ).code == TC.txSUCCESS
+        tx, acct_id = create_tx(root, a, code_hash=acct_hash,
+                                salt=b"\x55" * 32)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+        acct_addr = scaddress_contract(acct_id)
+        acct_inst = contract_data_key(
+            acct_addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+
+        def invoke_with_password(password: str, nonce: int):
+            invocation = SorobanAuthorizedInvocation(
+                function=SorobanAuthorizedFunction.make(
+                    SorobanAuthorizedFunctionType
+                    .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                    InvokeContractArgs(
+                        contractAddress=scaddress_contract(contract_id),
+                        functionName=b"auth_incr",
+                        args=[SCVal.make(T.SCV_ADDRESS, acct_addr)])),
+                subInvocations=[])
+            auth = SorobanAuthorizationEntry(
+                credentials=SorobanCredentials.make(
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+                    SorobanAddressCredentials(
+                        address=acct_addr, nonce=nonce,
+                        signatureExpirationLedger=10_000,
+                        signature=sym(password))),
+                rootInvocation=invocation)
+            nonce_key = contract_data_key(
+                acct_addr,
+                SCVal.make(T.SCV_LEDGER_KEY_NONCE,
+                           SCNonceKey(nonce=nonce)),
+                ContractDataDurability.TEMPORARY)
+            tx = invoke_tx(
+                root, a, contract_id, "auth_incr",
+                args=[SCVal.make(T.SCV_ADDRESS, acct_addr)],
+                auth=[auth],
+                extra_rw=[nonce_key, acct_inst,
+                          contract_code_key(acct_hash)])
+            return apply_tx(root, tx)
+
+        res = invoke_with_password("letmein", nonce=1)
+        assert res.code == TC.txSUCCESS, inner_code(res)
+        assert counter_value(root, contract_id) == 1
+
+        res = invoke_with_password("wrong", nonce=2)
+        assert res.code == TC.txFAILED
+        assert inner_code(res) == Inv.INVOKE_HOST_FUNCTION_TRAPPED
+        assert counter_value(root, contract_id) == 1
+    finally:
+        root.soroban_config = None
+
+
+def test_custom_account_unused_bad_entry_is_not_checked(env):
+    """An auth entry whose fns are never required stays unchecked —
+    only the MATCHED entry's __check_auth runs (code-review r3)."""
+    import dataclasses
+
+    from stellar_tpu.soroban.example_contracts import custom_account_wasm
+    from stellar_tpu.xdr.contract import (
+        SCNonceKey, SorobanAddressCredentials, SorobanAuthorizationEntry,
+        SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
+        SorobanAuthorizedInvocation, SorobanCredentials,
+        SorobanCredentialsType,
+    )
+    root, a = env
+    root.soroban_config = dataclasses.replace(
+        default_soroban_config(), tx_max_read_ledger_entries=10,
+        tx_max_write_ledger_entries=8)
+    try:
+        assert apply_tx(root, upload_tx(root, a)).code == TC.txSUCCESS
+        tx, contract_id = create_tx(root, a)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+        acct_code = custom_account_wasm()
+        acct_hash = sha256(acct_code)
+        assert apply_tx(root, upload_tx(root, a, code=acct_code)
+                        ).code == TC.txSUCCESS
+        tx, acct_id = create_tx(root, a, code_hash=acct_hash,
+                                salt=b"\x56" * 32)
+        assert apply_tx(root, tx).code == TC.txSUCCESS
+        acct_addr = scaddress_contract(acct_id)
+        acct_inst = contract_data_key(
+            acct_addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+
+        def entry(password, nonce, fn_name):
+            invocation = SorobanAuthorizedInvocation(
+                function=SorobanAuthorizedFunction.make(
+                    SorobanAuthorizedFunctionType
+                    .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                    InvokeContractArgs(
+                        contractAddress=scaddress_contract(contract_id),
+                        functionName=fn_name,
+                        args=[SCVal.make(T.SCV_ADDRESS, acct_addr)])),
+                subInvocations=[])
+            return SorobanAuthorizationEntry(
+                credentials=SorobanCredentials.make(
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+                    SorobanAddressCredentials(
+                        address=acct_addr, nonce=nonce,
+                        signatureExpirationLedger=10_000,
+                        signature=sym(password))),
+                rootInvocation=invocation)
+
+        # good entry authorizes auth_incr; bad entry targets a fn the
+        # contract never requires — it must NOT be dispatched
+        good = entry("letmein", 1, b"auth_incr")
+        bad = entry("wrong", 2, b"never_required")
+        nonce_keys = [contract_data_key(
+            acct_addr,
+            SCVal.make(T.SCV_LEDGER_KEY_NONCE, SCNonceKey(nonce=n)),
+            ContractDataDurability.TEMPORARY) for n in (1, 2)]
+        tx = invoke_tx(
+            root, a, contract_id, "auth_incr",
+            args=[SCVal.make(T.SCV_ADDRESS, acct_addr)],
+            auth=[good, bad],
+            extra_rw=nonce_keys + [acct_inst,
+                                   contract_code_key(acct_hash)])
+        res = apply_tx(root, tx)
+        assert res.code == TC.txSUCCESS, inner_code(res)
+        assert counter_value(root, contract_id) == 1
+    finally:
+        root.soroban_config = None

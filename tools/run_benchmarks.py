@@ -53,6 +53,10 @@ def run_all(quick: bool) -> dict:
     print("[5/5] soroban...", file=sys.stderr)
     out["soroban"] = soroban_apply_load(n_ledgers=n(3),
                                         txs_per_ledger=n(500))
+    print("[5b] soroban (compiled wasm, native engine)...",
+          file=sys.stderr)
+    out["soroban_wasm"] = soroban_apply_load(
+        n_ledgers=n(3), txs_per_ledger=n(500), use_wasm=True)
     return out
 
 
@@ -79,6 +83,10 @@ def render_table(results: dict) -> str:
         ("soroban (#5)",
          f"{b['close_mean_ms']} ms mean close, {b['txs_per_sec']} tx/s"
          f" ({b['signatures_per_ledger']} sigs/ledger)"),
+        ("soroban #5, compiled wasm",
+         f"{results['soroban_wasm']['close_mean_ms']} ms mean close, "
+         f"{results['soroban_wasm']['txs_per_sec']} tx/s "
+         f"({results['soroban_wasm']['engine']})"),
     ]
     lines = [BEGIN, "",
              f"Generated {date.today()} on {platform.machine()} "
