@@ -372,6 +372,23 @@ def run_fuzz(mode: str, iterations: int, seed: int) -> dict:
     return out
 
 
+class _FuzzBudget:
+    """Budget-shaped object for engine-differential fuzzing."""
+
+    def __init__(self, cpu_limit: int):
+        self.cpu_limit = cpu_limit
+        self.mem_limit = 1 << 40
+        self.cpu = 0
+        self.mem = 0
+
+    def charge(self, cpu, mem=0):
+        from stellar_tpu.soroban.wasm import Trap
+        self.cpu += cpu
+        self.mem += mem
+        if self.cpu > self.cpu_limit or self.mem > self.mem_limit:
+            raise Trap("fuzz budget exceeded")
+
+
 class WasmFuzzer:
     """Wasm VM fuzz (the ``invoke_host_function`` attack surface): the
     decoder must raise ONLY WasmError on arbitrary bytes, and
@@ -423,32 +440,67 @@ class WasmFuzzer:
                 f"(input sha {__import__('hashlib').sha256(raw).hexdigest()[:16]})")
             return
         # validated: every export must run to a value/Trap under a
-        # hard budget, with host imports that return random Vals
-        spent = [0]
+        # hard budget, with host imports that return seeded Vals; when
+        # the native engine is built, BOTH engines run the same case
+        # and must agree on outcome class, value, and consumed budget
+        # (differential fuzzing of the consensus-parity contract)
+        from stellar_tpu.soroban import native_wasm
+        native_ok = native_wasm.available()
+        exports = [(name, idx)
+                   for name, (kind, idx) in module.exports.items()
+                   if kind == "func"][:4]
+        cases = []
+        for name, idx in exports:
+            ft = module.func_type(idx)
+            cases.append((name,
+                          [r.randrange(1 << 64) for _ in ft.params],
+                          r.randrange(64, 60_000)))
 
-        def charge(n):
-            spent[0] += n
-            if spent[0] > 200_000:
-                raise Trap("fuzz budget")
+        def run_python(name, args, limit, host_seed):
+            hr = random.Random(host_seed)
+            bud = _FuzzBudget(limit)
 
-        def host_fn(inst, *args):
-            return r.randrange(1 << 64)
-        imports = {(m, n): host_fn for m, n, _t in module.imports}
+            def host_fn(inst, *a):
+                return hr.randrange(1 << 64)
+            imports = {(m, n): host_fn
+                       for m, n, _t in module.imports}
+            try:
+                inst = WasmInstance(
+                    module, imports,
+                    lambda n: bud.charge(n * 4),
+                    mem_charge=lambda n: bud.charge(0, n))
+                v = inst.invoke(name, list(args))
+                return ("value", v, bud.cpu)
+            except Trap as e:
+                kind = "budget" if "budget" in str(e) else "trap"
+                return (kind, None, bud.cpu)
+
+        def run_native(name, args, limit, host_seed):
+            hr = random.Random(host_seed)
+            bud = _FuzzBudget(limit)
+
+            def host_fn(inst, *a):
+                return hr.randrange(1 << 64)
+            imports = {(m, n): host_fn
+                       for m, n, _t in module.imports}
+            try:
+                v = native_wasm.run_export(module, imports, bud, 4,
+                                           name, list(args))
+                return ("value", v, bud.cpu)
+            except Trap as e:
+                kind = "budget" if "budget" in str(e) else "trap"
+                return (kind, None, bud.cpu)
+
         try:
-            inst = WasmInstance(module, imports, charge,
-                                mem_charge=lambda n: None)
-            for name, (kind, idx) in list(module.exports.items())[:4]:
-                if kind != "func":
-                    continue
-                ft = module.func_type(idx)
-                args = [r.randrange(1 << 64) for _ in ft.params]
-                spent[0] = 0
-                try:
-                    inst.invoke(name, args)
-                except Trap:
-                    pass
-        except Trap:
-            pass
+            for name, args, limit in cases:
+                seed = r.randrange(1 << 30)
+                p = run_python(name, args, limit * 4, seed)
+                if native_ok:
+                    n = run_native(name, args, limit * 4, seed)
+                    if p[0] != n[0] or p[1] != n[1] or p[2] != n[2]:
+                        self.crashes.append(
+                            f"engine divergence on {name}{args}: "
+                            f"python {p} vs native {n}")
         except Exception as e:
             self.crashes.append(f"exec {type(e).__name__}: {e}")
 

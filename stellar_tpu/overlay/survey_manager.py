@@ -9,12 +9,27 @@ encrypted to the surveyor's ephemeral curve25519 key so relaying peers
 learn nothing. Responses flood back and the surveyor accumulates them
 in ``results``.
 
-Encryption: an ECIES-style sealed box over this framework's curve25519
-(HKDF keystream + HMAC tag). Structurally equivalent to the reference's
-``crypto_box_seal``; not byte-compatible with libsodium's
-xsalsa20-poly1305 (no xsalsa20 primitive here) — the surveyor and
-surveyed ends are both this implementation, which is the deployment
-unit of a survey.
+Encryption — EXPLICIT COMPATIBILITY DECISION (r3): the encrypted
+response body uses an ECIES-style sealed box over this framework's
+curve25519 (HKDF-SHA256 keystream + HMAC-SHA256 tag) rather than
+libsodium's ``crypto_box_seal`` (X25519 + XSalsa20-Poly1305). This is
+a deliberate wire-format fork of ONE field, scoped and safe because:
+
+1. the surveyor and the surveyed node are the only parties that ever
+   read the field — relay nodes treat it as opaque bytes, so mixed
+   fleets still relay each other's surveys correctly;
+2. a survey is operator tooling run against one's own fleet (the
+   surveyor key allowlist gates it), so both endpoints are the same
+   implementation in every supported deployment;
+3. the survey *protocol* — message flow, signatures over the
+   HashIDPreimage payloads, nonce/phase state machine, XDR shapes —
+   IS wire-compatible; only the sealed-box cipher differs;
+4. security properties match (ephemeral ECDH, authenticated
+   encryption, relaying peers learn nothing).
+
+If cross-implementation surveys are ever required, the seam is
+``seal_box``/``open_box`` below: swap in an XSalsa20-Poly1305
+implementation and the rest of the module is unchanged.
 """
 
 from __future__ import annotations

@@ -602,6 +602,29 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         env.host.budget.charge(2000 + 30 * len(data), 32)
         return cv.new_obj(TAG_BYTES_OBJ, sha256(data))
 
+    # ---- prng (deterministic per-frame stream; reference "p") ----
+
+    def _frame_prng():
+        if env.prng is None:
+            env.prng = env.host.fork_prng()
+        return env.prng
+
+    def prng_u64_in_inclusive_range(inst, lo_raw, hi_raw):
+        env.host.budget.charge(100, 0)
+        return _frame_prng().u64_in_range(lo_raw & _M64,
+                                          hi_raw & _M64) & _M64
+
+    def prng_bytes_new(inst, len_val):
+        n = _u32_arg(len_val, "prng length")
+        env.host.budget.charge(100 + 2 * n, n)
+        return cv.new_obj(TAG_BYTES_OBJ, _frame_prng().take(n))
+
+    def prng_reseed(inst, b_val):
+        data = cv.obj(b_val, TAG_BYTES_OBJ)
+        env.host.budget.charge(100 + len(data), 0)
+        _frame_prng().reseed(data)
+        return _make(TAG_VOID)
+
     return {
         ("x", "log"): log,
         ("x", "ledger_sequence"): ledger_sequence,
@@ -642,4 +665,8 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         ("a", "require_auth"): require_auth,
         ("c", "call"): call,
         ("d", "compute_sha256"): compute_sha256,
+        ("p", "prng_u64_in_inclusive_range"):
+            prng_u64_in_inclusive_range,
+        ("p", "prng_bytes_new"): prng_bytes_new,
+        ("p", "prng_reseed"): prng_reseed,
     }

@@ -669,9 +669,57 @@ class InvokeOutput:
     error: Optional[str] = None  # HostError kind
 
 
+class _Prng:
+    """Deterministic host PRNG (reference soroban ``prng`` module):
+    counter-mode SHA-256 over a per-invocation seed. Every node
+    derives the identical stream, so contract randomness is
+    consensus-safe; each contract frame forks its own stream
+    (reference: per-frame PRNGs forked from the base)."""
+
+    __slots__ = ("_seed", "_counter", "_buf")
+
+    def __init__(self, seed: bytes):
+        self._seed = seed
+        self._counter = 0
+        self._buf = b""
+
+    def take(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            self._buf += sha256(
+                self._seed + self._counter.to_bytes(8, "little"))
+            self._counter += 1
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def u64(self) -> int:
+        return int.from_bytes(self.take(8), "little")
+
+    def u64_in_range(self, lo: int, hi: int) -> int:
+        if lo > hi:
+            raise HostError(HostError.TRAPPED, "empty prng range")
+        span = hi - lo + 1
+        if span == 1 << 64:
+            return self.u64()
+        # rejection sampling: deterministic AND unbiased
+        limit = ((1 << 64) // span) * span
+        while True:
+            v = self.u64()
+            if v < limit:
+                return lo + (v % span)
+
+    def fork(self, salt: bytes) -> "_Prng":
+        return _Prng(sha256(self._seed + salt))
+
+    def reseed(self, seed: bytes):
+        self._seed = sha256(seed)
+        self._counter = 0
+        self._buf = b""
+
+
 class _Host:
     def __init__(self, storage: _Storage, budget: _Budget, auth,
-                 config, ledger_seq: int):
+                 config, ledger_seq: int,
+                 prng_seed: Optional[bytes] = None):
         self.storage = storage
         self.budget = budget
         self.auth = auth
@@ -679,6 +727,15 @@ class _Host:
         self.ledger_seq = ledger_seq
         self.events: List = []
         self.diagnostics: List = []
+        self.base_prng = _Prng(prng_seed if prng_seed is not None
+                               else b"\x00" * 32)
+        self._prng_forks = 0
+
+    def fork_prng(self) -> _Prng:
+        """A fresh per-frame PRNG stream (deterministic fork order)."""
+        self._prng_forks += 1
+        return self.base_prng.fork(
+            self._prng_forks.to_bytes(8, "little"))
 
     def require_auth(self, addr, invocation, depth: int = 0):
         if addr.arm != T.SCV_ADDRESS:
@@ -823,7 +880,8 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
                          source_account, network_id: bytes,
                          ledger_seq: int, config,
                          cpu_limit: Optional[int] = None,
-                         ledger_header=None) -> InvokeOutput:
+                         ledger_header=None,
+                         tx_hash: Optional[bytes] = None) -> InvokeOutput:
     """Execute one HostFunction against declared state (the lib.rs
     boundary). ``footprint_entries``: kb -> (LedgerEntry|None,
     live_until|None) for every declared key that exists."""
@@ -837,7 +895,17 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
     try:
         auth = _AuthContext(auth_entries, source_account, network_id,
                             ledger_seq, storage, _verify_sig)
-        host = _Host(storage, budget, auth, config, ledger_seq)
+        # PRNG seed: every node derives the same stream for this
+        # invocation (reference: per-tx sub-seed) — the TX HASH makes
+        # it unique per transaction, so a copycat invocation in the
+        # same ledger cannot predict another tx's stream
+        from stellar_tpu.xdr.contract import HostFunction as _HF
+        prng_seed = sha256(network_id +
+                           ledger_seq.to_bytes(8, "little") +
+                           (tx_hash if tx_hash is not None
+                            else to_bytes(_HF, host_fn)))
+        host = _Host(storage, budget, auth, config, ledger_seq,
+                     prng_seed=prng_seed)
         auth.host = host  # custom-account __check_auth dispatch
         host.ledger_header = ledger_header  # classic reserve math (SAC)
         t = host_fn.arm
@@ -926,6 +994,7 @@ class WasmContractEnv:
         self.invocation = invocation
         self.depth = depth
         self.cv = ValConverter(host.budget.charge)
+        self.prng = None  # per-frame stream, forked on first use
 
     # storage bridges
     def data_put(self, key_sc, val_sc, dur):

@@ -518,3 +518,48 @@ def test_wasm_in_contract_ttl_extension(env):
     finally:
         test_soroban.COUNTER_CODE = old_code
         test_soroban.CODE_HASH = old_hash
+
+
+def test_prng_host_module_deterministic(env):
+    """The "p" host module yields a consensus-safe stream: identical
+    across repeat applies of the same invocation on fresh states, in
+    range, and reseed-able."""
+    import test_soroban
+    from stellar_tpu.soroban.wasm_builder import (
+        Code as _Code, I64 as _I64, ModuleBuilder as _MB,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        keypair as _kp, seed_root_with_accounts as _seed,
+    )
+
+    b = _MB()
+    rng_fn = b.import_func("p", "prng_u64_in_inclusive_range",
+                           [_I64, _I64], [_I64])
+    # roll() -> U64 val of a d100 roll
+    c = _Code()
+    c.i64_const(1).i64_const(100).call(rng_fn)
+    c.i64_const(8).i64_shl().i64_const(6).i64_or()  # U64Small val
+    c.end()
+    b.add_func([], [_I64], [], c, export="roll")
+    code = b.build()
+    code_hash = sha256(code)
+
+    def run_once():
+        a = _kp("sor-a")
+        root = _seed([(a, 100_000 * 10_000_000)])
+        old = (test_soroban.COUNTER_CODE, test_soroban.CODE_HASH)
+        test_soroban.COUNTER_CODE = code
+        test_soroban.CODE_HASH = code_hash
+        try:
+            assert apply_tx(root, upload_tx(root, a, code=code)
+                            ).code == TC.txSUCCESS
+            tx, cid = create_tx(root, a)
+            assert apply_tx(root, tx).code == TC.txSUCCESS
+            res = apply_tx(root, invoke_tx(root, a, cid, "roll"))
+            assert res.code == TC.txSUCCESS, inner_code(res)
+            return res.op_results[0].value.value.value
+        finally:
+            test_soroban.COUNTER_CODE, test_soroban.CODE_HASH = old
+
+    h1, h2 = run_once(), run_once()
+    assert h1 == h2, "prng must be deterministic across nodes"
