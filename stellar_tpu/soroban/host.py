@@ -1041,22 +1041,33 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
             fn = fn_name.decode("utf-8")
         except UnicodeDecodeError:
             raise HostError(HostError.TRAPPED, "bad function name")
-        vals = [env.cv.from_scval(a) for a in args]
+        from stellar_tpu.soroban.legacy_abi import (
+            from_rawval, is_legacy_module, make_legacy_imports, to_rawval,
+        )
+        if is_legacy_module(module):
+            # pre-1.0 fixture dialect: 4-bit-tag RawVals + the tiny
+            # early import surface; same engines, different codec
+            imports = make_legacy_imports(env)
+            vals = [to_rawval(a) for a in args]
+            decode = from_rawval
+        else:
+            imports = make_imports(env)
+            vals = [env.cv.from_scval(a) for a in args]
+            decode = env.cv.to_scval
         if USE_NATIVE_WASM:
             from stellar_tpu.soroban import native_wasm
             if native_wasm.available():
                 rv = native_wasm.run_export(
-                    module, make_imports(env), budget,
-                    CPU_PER_WASM_INSN, fn, vals)
-                return env.cv.to_scval(rv) if rv is not None \
+                    module, imports, budget, CPU_PER_WASM_INSN, fn,
+                    vals)
+                return decode(rv) if rv is not None \
                     else SCVal.make(T.SCV_VOID)
-        inst = WasmInstance(module, make_imports(env), charge,
-                            mem_charge)
+        inst = WasmInstance(module, imports, charge, mem_charge)
         if not inst.exports_function(fn):
             raise HostError(HostError.TRAPPED,
                             f"no exported function {fn!r}")
         rv = inst.invoke(fn, vals)
-        return env.cv.to_scval(rv) if rv is not None \
+        return decode(rv) if rv is not None \
             else SCVal.make(T.SCV_VOID)
     except WasmError as e:
         raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")

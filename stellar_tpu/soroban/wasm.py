@@ -161,6 +161,23 @@ class WasmModule:
         self.elements: List[Tuple[int, List[int]]] = []  # (offset, idxs)
         self.data: List[Tuple[int, bytes]] = []
         self.start: Optional[int] = None
+        # custom sections by name (first occurrence wins); the soroban
+        # "contractenvmetav0" section carries the env interface version
+        # the contract was compiled against
+        self.customs: Dict[str, bytes] = {}
+
+    @property
+    def env_meta_version(self) -> Optional[int]:
+        """Interface version from the contractenvmetav0 custom section
+        (SCEnvMetaEntry: u32 kind 0 + u64 version), or None if absent.
+        Modern SDK builds encode ``protocol << 32 | prerelease``; the
+        reference's testdata fixtures carry small pre-1.0 versions."""
+        body = self.customs.get("contractenvmetav0")
+        if body is None or len(body) < 12:
+            return None
+        if int.from_bytes(body[:4], "big") != 0:
+            return None
+        return int.from_bytes(body[4:12], "big")
 
     def func_type(self, func_idx: int) -> FuncType:
         """Type of function ``func_idx`` in the unified index space
@@ -192,7 +209,13 @@ def parse_module(code: bytes) -> WasmModule:
             last_id = sec_id
         sr = _Reader(payload)
         if sec_id == 0:
-            continue  # custom section: skipped
+            # custom section: retain (env/spec metadata), never validate
+            try:
+                cname = sr.bytes(sr.u32()).decode("utf-8")
+            except Exception:
+                continue
+            m.customs.setdefault(cname, payload[sr.i:])
+            continue
         elif sec_id == 1:
             _parse_types(sr, m)
         elif sec_id == 2:

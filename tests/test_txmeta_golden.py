@@ -382,10 +382,89 @@ def scenario_parallel_soroban(version):
     return out
 
 
+def scenario_reference_fixtures(version):
+    """Upload + create + invoke the reference's OWN compiled wasm
+    fixtures (``src/testdata/example_add_i32.wasm`` and
+    ``example_contract_data.wasm``) through the close pipeline — the
+    binaries were produced by the real soroban SDK toolchain, so this
+    pins the legacy-ABI linking (4-bit-tag RawVals, short import
+    names) against artifacts this repo did not generate."""
+    from pathlib import Path as _P
+    from stellar_tpu.simulation.load_generator import (
+        _deploy_frames, _soroban_data, _soroban_op,
+    )
+    from stellar_tpu.soroban.host import (
+        contract_code_key, contract_data_key, scaddress_contract, sym,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, HostFunction, HostFunctionType,
+        InvokeContractArgs, SCVal, SCValType,
+    )
+    fixtures = _P("/root/reference/src/testdata")
+    if not fixtures.exists():
+        pytest.skip("reference testdata not present")
+    add_code = (fixtures / "example_add_i32.wasm").read_bytes()
+    data_code = (fixtures / "example_contract_data.wasm").read_bytes()
+    a = keypair("gm-ref-fix")
+    lm = _lm_with([(a, 100_000 * XLM)], version)
+    net = lm.network_id
+    import dataclasses
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=10)
+    lm.root.soroban_config = lm.soroban_config
+    up1, create1, cid1, hash1, inst1 = _deploy_frames(
+        a, (1 << 32) + 1, (1 << 32) + 2, add_code, net,
+        salt=b"\x51" * 32)
+    up2, create2, cid2, hash2, inst2 = _deploy_frames(
+        a, (1 << 32) + 3, (1 << 32) + 4, data_code, net,
+        salt=b"\x52" * 32)
+    out = [_close_with(lm, [up1]), _close_with(lm, [create1]),
+           _close_with(lm, [up2]), _close_with(lm, [create2])]
+    addr1 = scaddress_contract(cid1)
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr1, functionName=b"add",
+                           args=[SCVal.make(SCValType.SCV_I32, 20),
+                                 SCVal.make(SCValType.SCV_I32, 22)]))
+    invoke_add = make_tx(
+        a, (1 << 32) + 5, [_soroban_op(fn)], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[inst1, contract_code_key(hash1)]),
+        network_id=net)
+    addr2 = scaddress_contract(cid2)
+    data_key = contract_data_key(addr2, sym("COUNTER"),
+                                 ContractDataDurability.PERSISTENT)
+    fn_put = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr2, functionName=b"put",
+                           args=[sym("COUNTER"), sym("VALUE")]))
+    invoke_put = make_tx(
+        a, (1 << 32) + 6, [_soroban_op(fn_put)], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[inst2, contract_code_key(hash2)],
+            read_write=[data_key]),
+        network_id=net)
+    fn_del = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr2, functionName=b"del",
+                           args=[sym("COUNTER")]))
+    invoke_del = make_tx(
+        a, (1 << 32) + 7, [_soroban_op(fn_del)], fee=6_000_000,
+        soroban_data=_soroban_data(
+            read_only=[inst2, contract_code_key(hash2)],
+            read_write=[data_key]),
+        network_id=net)
+    out.append(_close_with(lm, [invoke_add]))
+    out.append(_close_with(lm, [invoke_put]))
+    out.append(_close_with(lm, [invoke_del]))
+    return out
+
+
 # soroban is protocol >= 20 only
 SOROBAN_SCENARIOS = {
     "soroban_counter": scenario_soroban_counter,
     "wasm_counter": scenario_wasm_counter,
+    "reference_fixtures": scenario_reference_fixtures,
 }
 
 # the parallel soroban representation is a protocol-23 construct: its

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Timestamped TPU device-availability probe (VERDICT r3 next-round #1).
+
+Appends one JSON line per invocation to DEVICE_PROBES.jsonl at the repo
+root so that dead tunnel windows are provable.  Runs the probe in a
+subprocess with a hard timeout because a down axon tunnel makes
+``jax.devices()`` hang forever rather than raise.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "DEVICE_PROBES.jsonl")
+
+PROBE_SRC = r"""
+import json, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+devs = jax.devices()
+x = jnp.ones((8, 8))
+y = jax.jit(lambda a: a + 1)(x)
+y.block_until_ready()
+print(json.dumps({
+    "platform": devs[0].platform,
+    "n_devices": len(devs),
+    "device": str(devs[0]),
+    "probe_s": round(time.time() - t0, 3),
+}))
+"""
+
+
+def probe(timeout_s: int = 90) -> dict:
+    rec = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat()}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if out.returncode == 0:
+            try:
+                last = out.stdout.strip().splitlines()[-1]
+                rec.update(json.loads(last))
+                rec["alive"] = True
+            except (IndexError, ValueError):
+                # rc=0 but no parseable JSON line: still record the
+                # window rather than losing the evidence
+                rec["alive"] = False
+                rec["rc"] = "bad-output"
+                rec["stdout_tail"] = out.stdout[-300:]
+        else:
+            rec["alive"] = False
+            rec["rc"] = out.returncode
+            rec["stderr_tail"] = out.stderr[-500:]
+    except subprocess.TimeoutExpired:
+        rec["alive"] = False
+        rec["rc"] = "timeout"
+        rec["timeout_s"] = timeout_s
+    return rec
+
+
+if __name__ == "__main__":
+    rec = probe(int(sys.argv[1]) if len(sys.argv) > 1 else 90)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    sys.exit(0 if rec["alive"] else 3)
