@@ -54,6 +54,8 @@ TAG_TIMEPOINT_SMALL = 8
 TAG_DURATION_SMALL = 9
 TAG_U128_SMALL = 10
 TAG_I128_SMALL = 11
+TAG_U256_SMALL = 12
+TAG_I256_SMALL = 13
 TAG_SYMBOL_SMALL = 14
 TAG_U64_OBJ = 64
 TAG_I64_OBJ = 65
@@ -61,6 +63,8 @@ TAG_TIMEPOINT_OBJ = 66
 TAG_DURATION_OBJ = 67
 TAG_U128_OBJ = 68
 TAG_I128_OBJ = 69
+TAG_U256_OBJ = 70
+TAG_I256_OBJ = 71
 TAG_BYTES_OBJ = 72
 TAG_STRING_OBJ = 73
 TAG_SYMBOL_OBJ = 74
@@ -82,6 +86,16 @@ _SYM_CHAR = {i + 1: c for i, c in enumerate(_SYM_CHARS)}
 
 class EnvError(Trap):
     """Host-env failure surfaced to wasm as a trap."""
+
+
+class ContractError(EnvError):
+    """fail_with_error trap carrying the contract's Error val so
+    try_call can hand the CALLEE'S error back to the caller (the
+    reference returns the failing frame's error value)."""
+
+    def __init__(self, msg: str, error_sc):
+        super().__init__(msg)
+        self.error_sc = error_sc  # SCVal of arm SCV_ERROR
 
 
 def _tag(val: int) -> int:
@@ -190,6 +204,29 @@ class ValConverter:
             if _SMALL_MIN_I <= n <= _SMALL_MAX_I:
                 return _make(TAG_I128_SMALL, n)
             return self.new_obj(TAG_I128_OBJ, n)
+        if arm == T.SCV_U256:
+            p = v.value
+            n = ((p.hi_hi << 192) | (p.hi_lo << 128) |
+                 (p.lo_hi << 64) | p.lo_lo)
+            if n <= _SMALL_MAX_U:
+                return _make(TAG_U256_SMALL, n)
+            return self.new_obj(TAG_U256_OBJ, n)
+        if arm == T.SCV_I256:
+            p = v.value
+            n = ((p.hi_hi << 192) | (p.hi_lo << 128) |
+                 (p.lo_hi << 64) | p.lo_lo)
+            # hi_hi is signed in Int256Parts; normalize to signed 256
+            if p.hi_hi < 0:
+                n = ((p.hi_hi & _M64) << 192 | (p.hi_lo << 128) |
+                     (p.lo_hi << 64) | p.lo_lo) - (1 << 256)
+            if _SMALL_MIN_I <= n <= _SMALL_MAX_I:
+                return _make(TAG_I256_SMALL, n)
+            return self.new_obj(TAG_I256_OBJ, n)
+        if arm == T.SCV_ERROR:
+            err = v.value
+            return _make(TAG_ERROR,
+                         ((int(err.arm) & 0xFFFFFF) << 32) |
+                         (int(err.value) & 0xFFFFFFFF))
         if arm == T.SCV_SYMBOL:
             if len(v.value) <= 9:
                 try:
@@ -243,6 +280,12 @@ class ValConverter:
             return self._u128(body)
         if tag == TAG_I128_SMALL:
             return self._i128(body - (1 << 56) if body >> 55 else body)
+        if tag == TAG_U256_SMALL:
+            return self._u256(body)
+        if tag == TAG_I256_SMALL:
+            return self._i256(body - (1 << 56) if body >> 55 else body)
+        if tag == TAG_ERROR:
+            return self._error(body)
         if tag == TAG_SYMBOL_SMALL:
             return SCVal.make(T.SCV_SYMBOL, small_to_sym(val))
         if tag == TAG_U64_OBJ:
@@ -257,6 +300,10 @@ class ValConverter:
             return self._u128(self.obj(val, tag))
         if tag == TAG_I128_OBJ:
             return self._i128(self.obj(val, tag))
+        if tag == TAG_U256_OBJ:
+            return self._u256(self.obj(val, tag))
+        if tag == TAG_I256_OBJ:
+            return self._i256(self.obj(val, tag))
         if tag == TAG_BYTES_OBJ:
             return SCVal.make(T.SCV_BYTES, self.obj(val, tag))
         if tag == TAG_STRING_OBJ:
@@ -288,6 +335,38 @@ class ValConverter:
         if hi >= 1 << 63:
             hi -= 1 << 64  # Int128Parts.hi is a signed int64
         return SCVal.make(T.SCV_I128, Int128Parts(hi=hi, lo=u & _M64))
+
+    @staticmethod
+    def _u256(n: int):
+        from stellar_tpu.xdr.contract import UInt256Parts
+        return SCVal.make(T.SCV_U256, UInt256Parts(
+            hi_hi=(n >> 192) & _M64, hi_lo=(n >> 128) & _M64,
+            lo_hi=(n >> 64) & _M64, lo_lo=n & _M64))
+
+    @staticmethod
+    def _i256(n: int):
+        from stellar_tpu.xdr.contract import Int256Parts
+        u = n & ((1 << 256) - 1)
+        hi_hi = (u >> 192) & _M64
+        if hi_hi >= 1 << 63:
+            hi_hi -= 1 << 64  # Int256Parts.hi_hi is a signed int64
+        return SCVal.make(T.SCV_I256, Int256Parts(
+            hi_hi=hi_hi, hi_lo=(u >> 128) & _M64,
+            lo_hi=(u >> 64) & _M64, lo_lo=u & _M64))
+
+    @staticmethod
+    def _error(body: int):
+        from stellar_tpu.xdr.contract import (
+            SCError, SCErrorCode, SCErrorType,
+        )
+        etype = (body >> 32) & 0xFFFFFF
+        code = body & 0xFFFFFFFF
+        if etype not in SCErrorType.by_value:
+            raise EnvError(f"bad error type {etype}")
+        if etype != SCErrorType.SCE_CONTRACT and \
+                code not in SCErrorCode.by_value:
+            raise EnvError(f"bad error code {code}")
+        return SCVal.make(T.SCV_ERROR, SCError.make(etype, code))
 
 
 # ---------------------------------------------------------------------------
@@ -468,16 +547,23 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return cv.new_obj(TAG_MAP_OBJ, [])
 
     def map_put(inst, map_val, k, v):
+        # the pair list is kept sorted in the deep Val order (the SAME
+        # total order obj_cmp exposes, so map_key_by_pos /
+        # vec_binary_search over map_keys stay mutually consistent);
+        # bisect to the slot in O(log n) compares
         pairs = list(cv.obj(map_val, TAG_MAP_OBJ))
         env.host.budget.charge(10 + len(pairs), 16 * (len(pairs) + 1))
-        kb = _map_key_bytes(k)
-        for i, (pk, _pv) in enumerate(pairs):
-            if _map_key_bytes(pk) == kb:
-                pairs[i] = (k & _M64, v & _M64)
-                break
+        lo, hi = 0, len(pairs)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if _cmp_vals(pairs[mid][0], k) < 0:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(pairs) and _cmp_vals(pairs[lo][0], k) == 0:
+            pairs[lo] = (k & _M64, v & _M64)
         else:
-            pairs.append((k & _M64, v & _M64))
-            pairs.sort(key=lambda p: _map_key_bytes(p[0]))
+            pairs.insert(lo, (k & _M64, v & _M64))
         return cv.new_obj(TAG_MAP_OBJ, pairs)
 
     def map_get(inst, map_val, k):
@@ -625,7 +711,1337 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         _frame_prng().reseed(data)
         return _make(TAG_VOID)
 
-    return {
+    def prng_vec_shuffle(inst, vec_val):
+        items = list(cv.obj(vec_val, TAG_VEC_OBJ))
+        env.host.budget.charge(100 + 10 * len(items),
+                               8 * (len(items) + 1))
+        prng = _frame_prng()
+        # Fisher-Yates with the deterministic frame stream
+        for i in range(len(items) - 1, 0, -1):
+            j = prng.u64_in_range(0, i)
+            items[i], items[j] = items[j], items[i]
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    # =====================================================================
+    # modern-env surface (the genuine soroban interface; every handler
+    # below also registers under its single-char export name)
+    # =====================================================================
+
+    charge = env.host.budget.charge
+
+    def _bytes_of(val):
+        return cv.obj(val, TAG_BYTES_OBJ)
+
+    def _sym_bytes(val) -> bytes:
+        if _tag(val) == TAG_SYMBOL_SMALL:
+            return small_to_sym(val)
+        return cv.obj(val, TAG_SYMBOL_OBJ)
+
+    def _str_bytes(val) -> bytes:
+        return cv.obj(val, TAG_STRING_OBJ)
+
+    def _raw64(v: int) -> int:
+        return v & _M64
+
+    # ---- deep total order (obj_cmp and the vec search family) ----
+
+    def _cmp_sc(a, b) -> int:
+        charge(50, 0)
+        if a.arm != b.arm:
+            return -1 if a.arm < b.arm else 1
+        arm = a.arm
+        if arm in (T.SCV_BOOL, T.SCV_U32, T.SCV_I32, T.SCV_U64,
+                   T.SCV_I64, T.SCV_TIMEPOINT, T.SCV_DURATION):
+            return (a.value > b.value) - (a.value < b.value)
+        if arm in (T.SCV_U128, T.SCV_I128):
+            av = (a.value.hi << 64) | a.value.lo
+            bv = (b.value.hi << 64) | b.value.lo
+            return (av > bv) - (av < bv)
+        if arm in (T.SCV_U256, T.SCV_I256):
+            def n256(p):
+                hh = p.hi_hi & _M64
+                return (hh << 192) | (p.hi_lo << 128) | \
+                    (p.lo_hi << 64) | p.lo_lo
+            av, bv = n256(a.value), n256(b.value)
+            if arm == T.SCV_I256:  # order negatives below positives
+                if (a.value.hi_hi < 0) != (b.value.hi_hi < 0):
+                    return -1 if a.value.hi_hi < 0 else 1
+            return (av > bv) - (av < bv)
+        if arm in (T.SCV_BYTES, T.SCV_STRING, T.SCV_SYMBOL):
+            av, bv = bytes(a.value), bytes(b.value)
+            charge(len(av) + len(bv), 0)
+            return (av > bv) - (av < bv)
+        if arm == T.SCV_VEC:
+            ai, bi = list(a.value or ()), list(b.value or ())
+            for x, y in zip(ai, bi):
+                r = _cmp_sc(x, y)
+                if r:
+                    return r
+            return (len(ai) > len(bi)) - (len(ai) < len(bi))
+        if arm == T.SCV_MAP:
+            ai, bi = list(a.value or ()), list(b.value or ())
+            for x, y in zip(ai, bi):
+                r = _cmp_sc(x.key, y.key)
+                if r:
+                    return r
+                r = _cmp_sc(x.val, y.val)
+                if r:
+                    return r
+            return (len(ai) > len(bi)) - (len(ai) < len(bi))
+        # fall back to canonical XDR bytes for structured leaves
+        ab_, bb_ = to_bytes(SCVal, a), to_bytes(SCVal, b)
+        charge(len(ab_) + len(bb_), 0)
+        return (ab_ > bb_) - (ab_ < bb_)
+
+    def _cmp_vals(a_val: int, b_val: int) -> int:
+        return _cmp_sc(cv.to_scval(a_val), cv.to_scval(b_val))
+
+    # ---- context ----
+
+    def obj_cmp(inst, a_val, b_val):
+        return _raw64(_cmp_vals(a_val, b_val))
+
+    def log_from_linear_memory(inst, msg_pos, msg_len, vals_pos,
+                               vals_len):
+        mp = _u32_arg(msg_pos, "msg pos")
+        ml = _u32_arg(msg_len, "msg len")
+        vp = _u32_arg(vals_pos, "vals pos")
+        vl = _u32_arg(vals_len, "vals len")
+        charge(100 + 2 * ml + 10 * vl, 0)
+        from stellar_tpu.soroban import host as host_mod
+        if host_mod.DIAGNOSTIC_EVENTS_ENABLED:
+            msg = inst.mem_read(mp, ml)
+            vals = [cv.to_scval(int.from_bytes(
+                inst.mem_read(vp + 8 * i, 8), "little"))
+                for i in range(vl)]
+            env.host.diagnostics.append(SCVal.make(T.SCV_VEC, [
+                SCVal.make(T.SCV_STRING, msg)] + vals))
+        return _make(TAG_VOID)
+
+    def get_ledger_version(inst):
+        hdr = getattr(env.host, "ledger_header", None)
+        return _make(TAG_U32,
+                     hdr.ledgerVersion if hdr is not None else 0)
+
+    def fail_with_error(inst, err_val):
+        if _tag(err_val) != TAG_ERROR:
+            raise EnvError("fail_with_error needs an Error val")
+        sc = cv.to_scval(err_val)
+        raise ContractError(
+            f"contract failure: error type {sc.value.arm} "
+            f"code {sc.value.value}", sc)
+
+    def get_ledger_network_id(inst):
+        charge(100, 32)
+        return cv.new_obj(TAG_BYTES_OBJ, env.host.network_id)
+
+    def get_max_live_until_ledger(inst):
+        return _make(TAG_U32, env.host.ledger_seq +
+                     env.host.config.max_entry_ttl - 1)
+
+    # ---- int: 128/256-bit objects + arithmetic ----
+
+    def obj_from_u128_pieces(inst, hi, lo):
+        n = (_raw64(hi) << 64) | _raw64(lo)
+        if n <= _SMALL_MAX_U:
+            return _make(TAG_U128_SMALL, n)
+        return cv.new_obj(TAG_U128_OBJ, n)
+
+    def _u128_of(val) -> int:
+        tag = _tag(val)
+        if tag == TAG_U128_SMALL:
+            return _body(val)
+        return cv.obj(val, TAG_U128_OBJ)
+
+    def obj_to_u128_lo64(inst, val):
+        return _u128_of(val) & _M64
+
+    def obj_to_u128_hi64(inst, val):
+        return (_u128_of(val) >> 64) & _M64
+
+    def obj_from_i128_pieces(inst, hi, lo):
+        hi_s = _raw64(hi)
+        if hi_s >> 63:
+            hi_s -= 1 << 64
+        n = (hi_s << 64) | _raw64(lo)
+        if _SMALL_MIN_I <= n <= _SMALL_MAX_I:
+            return _make(TAG_I128_SMALL, n)
+        return cv.new_obj(TAG_I128_OBJ, n)
+
+    def _i128_of(val) -> int:
+        tag = _tag(val)
+        if tag == TAG_I128_SMALL:
+            b = _body(val)
+            return b - (1 << 56) if b >> 55 else b
+        return cv.obj(val, TAG_I128_OBJ)
+
+    def obj_to_i128_lo64(inst, val):
+        return _i128_of(val) & _M64
+
+    def obj_to_i128_hi64(inst, val):
+        return (_i128_of(val) >> 64) & _M64
+
+    _U256_MAX = (1 << 256) - 1
+    _I256_MIN = -(1 << 255)
+    _I256_MAX = (1 << 255) - 1
+
+    def _mk_u256(n: int):
+        if n <= _SMALL_MAX_U:
+            return _make(TAG_U256_SMALL, n)
+        return cv.new_obj(TAG_U256_OBJ, n)
+
+    def _mk_i256(n: int):
+        if _SMALL_MIN_I <= n <= _SMALL_MAX_I:
+            return _make(TAG_I256_SMALL, n)
+        return cv.new_obj(TAG_I256_OBJ, n)
+
+    def _u256_of(val) -> int:
+        tag = _tag(val)
+        if tag == TAG_U256_SMALL:
+            return _body(val)
+        return cv.obj(val, TAG_U256_OBJ)
+
+    def _i256_of(val) -> int:
+        tag = _tag(val)
+        if tag == TAG_I256_SMALL:
+            b = _body(val)
+            return b - (1 << 56) if b >> 55 else b
+        return cv.obj(val, TAG_I256_OBJ)
+
+    def obj_from_u256_pieces(inst, hi_hi, hi_lo, lo_hi, lo_lo):
+        n = ((_raw64(hi_hi) << 192) | (_raw64(hi_lo) << 128) |
+             (_raw64(lo_hi) << 64) | _raw64(lo_lo))
+        return _mk_u256(n)
+
+    def obj_to_u256_hi_hi(inst, val):
+        return (_u256_of(val) >> 192) & _M64
+
+    def obj_to_u256_hi_lo(inst, val):
+        return (_u256_of(val) >> 128) & _M64
+
+    def obj_to_u256_lo_hi(inst, val):
+        return (_u256_of(val) >> 64) & _M64
+
+    def obj_to_u256_lo_lo(inst, val):
+        return _u256_of(val) & _M64
+
+    def obj_from_i256_pieces(inst, hi_hi, hi_lo, lo_hi, lo_lo):
+        hh = _raw64(hi_hi)
+        if hh >> 63:
+            hh -= 1 << 64
+        n = ((hh << 192) | (_raw64(hi_lo) << 128) |
+             (_raw64(lo_hi) << 64) | _raw64(lo_lo))
+        return _mk_i256(n)
+
+    def obj_to_i256_hi_hi(inst, val):
+        return (_i256_of(val) >> 192) & _M64
+
+    def obj_to_i256_hi_lo(inst, val):
+        return (_i256_of(val) >> 128) & _M64
+
+    def obj_to_i256_lo_hi(inst, val):
+        return (_i256_of(val) >> 64) & _M64
+
+    def obj_to_i256_lo_lo(inst, val):
+        return _i256_of(val) & _M64
+
+    def u256_val_from_be_bytes(inst, b_val):
+        data = _bytes_of(b_val)
+        if len(data) != 32:
+            raise EnvError("u256 bytes must be exactly 32")
+        charge(100, 32)
+        return _mk_u256(int.from_bytes(data, "big"))
+
+    def u256_val_to_be_bytes(inst, val):
+        charge(100, 32)
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          _u256_of(val).to_bytes(32, "big"))
+
+    def i256_val_from_be_bytes(inst, b_val):
+        data = _bytes_of(b_val)
+        if len(data) != 32:
+            raise EnvError("i256 bytes must be exactly 32")
+        charge(100, 32)
+        n = int.from_bytes(data, "big")
+        if n > _I256_MAX:
+            n -= 1 << 256
+        return _mk_i256(n)
+
+    def i256_val_to_be_bytes(inst, val):
+        charge(100, 32)
+        return cv.new_obj(
+            TAG_BYTES_OBJ,
+            (_i256_of(val) & _U256_MAX).to_bytes(32, "big"))
+
+    def _u256_binop(op):
+        def fn(inst, a_val, b_val):
+            charge(200, 0)
+            a, b = _u256_of(a_val), _u256_of(b_val)
+            r = op(a, b)
+            if r is None or not (0 <= r <= _U256_MAX):
+                raise EnvError("u256 arithmetic out of range")
+            return _mk_u256(r)
+        return fn
+
+    def _i256_binop(op):
+        def fn(inst, a_val, b_val):
+            charge(200, 0)
+            a, b = _i256_of(a_val), _i256_of(b_val)
+            r = op(a, b)
+            if r is None or not (_I256_MIN <= r <= _I256_MAX):
+                raise EnvError("i256 arithmetic out of range")
+            return _mk_i256(r)
+        return fn
+
+    def _div(a, b):
+        if b == 0:
+            return None
+        q = abs(a) // abs(b)
+        return q if (a >= 0) == (b >= 0) else -q  # truncating
+
+    def _rem_euclid(a, b):
+        if b == 0:
+            return None
+        return a % abs(b)  # Python % with positive modulus is Euclidean
+
+    def _pow_checked(a, b, limit):
+        # bases 0/±1 succeed at ANY u32 exponent; for |a| >= 2 an
+        # exponent above 256 always overflows 256 bits
+        if a == 0:
+            return 1 if b == 0 else 0
+        if a == 1:
+            return 1
+        if a == -1:
+            return 1 if b % 2 == 0 else -1
+        if b > 256:
+            return None
+        r = 1
+        for _ in range(b):
+            r *= a
+            if abs(r) > limit:
+                return None
+        return r
+
+    u256_add = _u256_binop(lambda a, b: a + b)
+    u256_sub = _u256_binop(lambda a, b: a - b)
+    u256_mul = _u256_binop(lambda a, b: a * b)
+    u256_div = _u256_binop(_div)
+    u256_rem_euclid = _u256_binop(_rem_euclid)
+    i256_add = _i256_binop(lambda a, b: a + b)
+    i256_sub = _i256_binop(lambda a, b: a - b)
+    i256_mul = _i256_binop(lambda a, b: a * b)
+    i256_div = _i256_binop(_div)
+    i256_rem_euclid = _i256_binop(_rem_euclid)
+
+    def u256_pow(inst, a_val, p_val):
+        charge(500, 0)
+        p = _u32_arg(p_val, "pow exponent")
+        r = _pow_checked(_u256_of(a_val), p, _U256_MAX)
+        if r is None or r > _U256_MAX:
+            raise EnvError("u256 pow out of range")
+        return _mk_u256(r)
+
+    def i256_pow(inst, a_val, p_val):
+        charge(500, 0)
+        p = _u32_arg(p_val, "pow exponent")
+        r = _pow_checked(_i256_of(a_val), p, 1 << 256)
+        if r is None or not (_I256_MIN <= r <= _I256_MAX):
+            raise EnvError("i256 pow out of range")
+        return _mk_i256(r)
+
+    def u256_shl(inst, a_val, s_val):
+        charge(200, 0)
+        s = _u32_arg(s_val, "shift")
+        if s >= 256:
+            raise EnvError("u256 shift out of range")
+        r = _u256_of(a_val) << s
+        if r > _U256_MAX:
+            raise EnvError("u256 shl overflow")
+        return _mk_u256(r)
+
+    def u256_shr(inst, a_val, s_val):
+        charge(200, 0)
+        s = _u32_arg(s_val, "shift")
+        if s >= 256:
+            raise EnvError("u256 shift out of range")
+        return _mk_u256(_u256_of(a_val) >> s)
+
+    def i256_shl(inst, a_val, s_val):
+        charge(200, 0)
+        s = _u32_arg(s_val, "shift")
+        if s >= 256:
+            raise EnvError("i256 shift out of range")
+        r = _i256_of(a_val) << s
+        if not (_I256_MIN <= r <= _I256_MAX):
+            raise EnvError("i256 shl overflow")
+        return _mk_i256(r)
+
+    def i256_shr(inst, a_val, s_val):
+        charge(200, 0)
+        s = _u32_arg(s_val, "shift")
+        if s >= 256:
+            raise EnvError("i256 shift out of range")
+        return _mk_i256(_i256_of(a_val) >> s)  # arithmetic shift
+
+    def timepoint_obj_from_u64(inst, raw):
+        raw = _raw64(raw)
+        if raw <= _SMALL_MAX_U:
+            return _make(TAG_TIMEPOINT_SMALL, raw)
+        return cv.new_obj(TAG_TIMEPOINT_OBJ, raw)
+
+    def timepoint_obj_to_u64(inst, val):
+        if _tag(val) == TAG_TIMEPOINT_SMALL:
+            return _body(val)
+        return cv.obj(val, TAG_TIMEPOINT_OBJ)
+
+    def duration_obj_from_u64(inst, raw):
+        raw = _raw64(raw)
+        if raw <= _SMALL_MAX_U:
+            return _make(TAG_DURATION_SMALL, raw)
+        return cv.new_obj(TAG_DURATION_OBJ, raw)
+
+    def duration_obj_to_u64(inst, val):
+        if _tag(val) == TAG_DURATION_SMALL:
+            return _body(val)
+        return cv.obj(val, TAG_DURATION_OBJ)
+
+    # ---- vec (remaining surface) ----
+
+    def _vec_of(val):
+        return cv.obj(val, TAG_VEC_OBJ)
+
+    def _vec_index(items, i_val, what="vec index", allow_end=False):
+        i = _u32_arg(i_val, what)
+        limit = len(items) + (1 if allow_end else 0)
+        if i >= limit:
+            raise EnvError(f"{what} out of bounds")
+        return i
+
+    def vec_put(inst, vec_val, i_val, x):
+        items = list(_vec_of(vec_val))
+        i = _vec_index(items, i_val)
+        charge(10 + len(items), 8 * len(items))
+        items[i] = x & _M64
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    def vec_del(inst, vec_val, i_val):
+        items = list(_vec_of(vec_val))
+        i = _vec_index(items, i_val)
+        charge(10 + len(items), 8 * len(items))
+        del items[i]
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    def vec_push_front(inst, vec_val, x):
+        items = list(_vec_of(vec_val))
+        charge(10 + len(items), 8 * (len(items) + 1))
+        return cv.new_obj(TAG_VEC_OBJ, [x & _M64] + items)
+
+    def vec_pop_front(inst, vec_val):
+        items = list(_vec_of(vec_val))
+        if not items:
+            raise EnvError("pop from empty vec")
+        charge(10 + len(items), 8 * len(items))
+        return cv.new_obj(TAG_VEC_OBJ, items[1:])
+
+    def vec_pop_back(inst, vec_val):
+        items = list(_vec_of(vec_val))
+        if not items:
+            raise EnvError("pop from empty vec")
+        charge(10 + len(items), 8 * len(items))
+        return cv.new_obj(TAG_VEC_OBJ, items[:-1])
+
+    def vec_front(inst, vec_val):
+        items = _vec_of(vec_val)
+        if not items:
+            raise EnvError("front of empty vec")
+        return items[0]
+
+    def vec_back(inst, vec_val):
+        items = _vec_of(vec_val)
+        if not items:
+            raise EnvError("back of empty vec")
+        return items[-1]
+
+    def vec_insert(inst, vec_val, i_val, x):
+        items = list(_vec_of(vec_val))
+        i = _vec_index(items, i_val, allow_end=True)
+        charge(10 + len(items), 8 * (len(items) + 1))
+        items.insert(i, x & _M64)
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    def vec_append(inst, v1_val, v2_val):
+        a, b = list(_vec_of(v1_val)), list(_vec_of(v2_val))
+        charge(10 + len(a) + len(b), 8 * (len(a) + len(b)))
+        return cv.new_obj(TAG_VEC_OBJ, a + b)
+
+    def vec_slice(inst, vec_val, start_val, end_val):
+        items = _vec_of(vec_val)
+        start = _u32_arg(start_val, "slice start")
+        end = _u32_arg(end_val, "slice end")
+        if start > end or end > len(items):
+            raise EnvError("vec slice out of range")
+        charge(10 + (end - start), 8 * (end - start))
+        return cv.new_obj(TAG_VEC_OBJ, list(items[start:end]))
+
+    def vec_first_index_of(inst, vec_val, x):
+        for i, item in enumerate(_vec_of(vec_val)):
+            if _cmp_vals(item, x) == 0:
+                return _make(TAG_U32, i)
+        return _make(TAG_VOID)
+
+    def vec_last_index_of(inst, vec_val, x):
+        items = _vec_of(vec_val)
+        for i in range(len(items) - 1, -1, -1):
+            if _cmp_vals(items[i], x) == 0:
+                return _make(TAG_U32, i)
+        return _make(TAG_VOID)
+
+    def vec_binary_search(inst, vec_val, x):
+        """u64 result: (1<<32)|index when found, else the insertion
+        point in the low 32 bits (the soroban result convention)."""
+        items = _vec_of(vec_val)
+        lo, hi = 0, len(items)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            r = _cmp_vals(items[mid], x)
+            if r == 0:
+                return (1 << 32) | mid
+            if r < 0:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def vec_new_from_linear_memory(inst, vals_pos, len_val):
+        vp = _u32_arg(vals_pos, "vals pos")
+        n = _u32_arg(len_val, "len")
+        charge(50 + 10 * n, 8 * n)
+        items = [int.from_bytes(inst.mem_read(vp + 8 * i, 8),
+                                "little") for i in range(n)]
+        return cv.new_obj(TAG_VEC_OBJ, items)
+
+    def vec_unpack_to_linear_memory(inst, vec_val, vals_pos, len_val):
+        items = _vec_of(vec_val)
+        vp = _u32_arg(vals_pos, "vals pos")
+        n = _u32_arg(len_val, "len")
+        if n != len(items):
+            raise EnvError("vec unpack length mismatch")
+        charge(50 + 10 * n, 0)
+        for i, item in enumerate(items):
+            inst.mem_write(vp + 8 * i, (item & _M64).to_bytes(
+                8, "little"))
+        return _make(TAG_VOID)
+
+    # ---- map (remaining surface) ----
+
+    def _map_of(val):
+        return cv.obj(val, TAG_MAP_OBJ)
+
+    def map_del(inst, map_val, k):
+        pairs = list(_map_of(map_val))
+        kb = _map_key_bytes(k)
+        for i, (pk, _pv) in enumerate(pairs):
+            if _map_key_bytes(pk) == kb:
+                charge(10 + len(pairs), 16 * len(pairs))
+                del pairs[i]
+                return cv.new_obj(TAG_MAP_OBJ, pairs)
+        raise EnvError("map key not found")
+
+    def map_key_by_pos(inst, map_val, i_val):
+        pairs = _map_of(map_val)
+        i = _u32_arg(i_val, "map pos")
+        if i >= len(pairs):
+            raise EnvError("map pos out of bounds")
+        return pairs[i][0]
+
+    def map_val_by_pos(inst, map_val, i_val):
+        pairs = _map_of(map_val)
+        i = _u32_arg(i_val, "map pos")
+        if i >= len(pairs):
+            raise EnvError("map pos out of bounds")
+        return pairs[i][1]
+
+    def map_keys(inst, map_val):
+        pairs = _map_of(map_val)
+        charge(10 + len(pairs), 8 * len(pairs))
+        return cv.new_obj(TAG_VEC_OBJ, [pk for pk, _ in pairs])
+
+    def map_values(inst, map_val):
+        pairs = _map_of(map_val)
+        charge(10 + len(pairs), 8 * len(pairs))
+        return cv.new_obj(TAG_VEC_OBJ, [pv for _, pv in pairs])
+
+    def _key_slices(inst, keys_pos: int, n: int):
+        """n (ptr,len) u32-pairs at keys_pos -> symbol byte strings
+        (the SDK's struct-field-name slices)."""
+        out = []
+        for i in range(n):
+            pair = inst.mem_read(keys_pos + 8 * i, 8)
+            ptr = int.from_bytes(pair[:4], "little")
+            ln = int.from_bytes(pair[4:], "little")
+            charge(20 + ln, ln)
+            out.append(inst.mem_read(ptr, ln))
+        return out
+
+    def _sym_val(raw: bytes) -> int:
+        if len(raw) <= 9:
+            try:
+                return sym_to_small(raw)
+            except ValueError:
+                pass
+        return cv.new_obj(TAG_SYMBOL_OBJ, raw)
+
+    def map_new_from_linear_memory(inst, keys_pos, vals_pos, len_val):
+        kp = _u32_arg(keys_pos, "keys pos")
+        vp = _u32_arg(vals_pos, "vals pos")
+        n = _u32_arg(len_val, "len")
+        charge(50 + 20 * n, 16 * n)
+        import functools
+        keys = [_sym_val(raw) for raw in _key_slices(inst, kp, n)]
+        vals = [int.from_bytes(inst.mem_read(vp + 8 * i, 8), "little")
+                for i in range(n)]
+        pairs = sorted(zip(keys, vals), key=functools.cmp_to_key(
+            lambda a, b: _cmp_vals(a[0], b[0])))
+        for i in range(1, len(pairs)):
+            if _map_key_bytes(pairs[i - 1][0]) == \
+                    _map_key_bytes(pairs[i][0]):
+                raise EnvError("duplicate map key")
+        return cv.new_obj(TAG_MAP_OBJ, [list(p) for p in pairs])
+
+    def map_unpack_to_linear_memory(inst, map_val, keys_pos, vals_pos,
+                                    len_val):
+        pairs = _map_of(map_val)
+        kp = _u32_arg(keys_pos, "keys pos")
+        vp = _u32_arg(vals_pos, "vals pos")
+        n = _u32_arg(len_val, "len")
+        if n != len(pairs):
+            raise EnvError("map unpack length mismatch")
+        charge(50 + 20 * n, 0)
+        want = _key_slices(inst, kp, n)
+        for i, (pk, pv) in enumerate(pairs):
+            if _sym_bytes(pk) != want[i]:
+                raise EnvError("map unpack key mismatch")
+            inst.mem_write(vp + 8 * i,
+                           (pv & _M64).to_bytes(8, "little"))
+        return _make(TAG_VOID)
+
+    # ---- buf: serialize + string/symbol + full bytes surface ----
+
+    def serialize_to_bytes(inst, val):
+        data = to_bytes(SCVal, cv.to_scval(val))
+        charge(100 + 5 * len(data), len(data))
+        return cv.new_obj(TAG_BYTES_OBJ, data)
+
+    def deserialize_from_bytes(inst, b_val):
+        from stellar_tpu.xdr.runtime import from_bytes as _fb
+        data = _bytes_of(b_val)
+        charge(100 + 5 * len(data), len(data))
+        try:
+            sc = _fb(SCVal, bytes(data))
+        except Exception:
+            raise EnvError("unparsable SCVal bytes")
+        return cv.from_scval(sc)
+
+    def string_copy_to_linear_memory(inst, s_val, s_pos, lm_pos,
+                                     len_val):
+        data = _str_bytes(s_val)
+        sp = _u32_arg(s_pos, "string pos")
+        lp = _u32_arg(lm_pos, "lm pos")
+        n = _u32_arg(len_val, "len")
+        if sp + n > len(data):
+            raise EnvError("string copy out of range")
+        charge(50 + 2 * n, 0)
+        inst.mem_write(lp, data[sp:sp + n])
+        return _make(TAG_VOID)
+
+    def symbol_copy_to_linear_memory(inst, s_val, s_pos, lm_pos,
+                                     len_val):
+        data = _sym_bytes(s_val)
+        sp = _u32_arg(s_pos, "symbol pos")
+        lp = _u32_arg(lm_pos, "lm pos")
+        n = _u32_arg(len_val, "len")
+        if sp + n > len(data):
+            raise EnvError("symbol copy out of range")
+        charge(50 + 2 * n, 0)
+        inst.mem_write(lp, data[sp:sp + n])
+        return _make(TAG_VOID)
+
+    def string_len(inst, s_val):
+        return _make(TAG_U32, len(_str_bytes(s_val)))
+
+    def symbol_len(inst, s_val):
+        return _make(TAG_U32, len(_sym_bytes(s_val)))
+
+    def bytes_copy_from_linear_memory(inst, b_val, b_pos, lm_pos,
+                                      len_val):
+        data = _bytes_of(b_val)
+        bp = _u32_arg(b_pos, "bytes pos")
+        lp = _u32_arg(lm_pos, "lm pos")
+        n = _u32_arg(len_val, "len")
+        if bp > len(data):
+            raise EnvError("bytes pos out of range")
+        charge(50 + 2 * n, n)
+        chunk = inst.mem_read(lp, n)
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          bytes(data[:bp]) + chunk +
+                          bytes(data[bp + n:]))
+
+    def bytes_new(inst):
+        return cv.new_obj(TAG_BYTES_OBJ, b"")
+
+    def bytes_put(inst, b_val, i_val, u_val):
+        data = bytearray(_bytes_of(b_val))
+        i = _u32_arg(i_val, "bytes index")
+        u = _u32_arg(u_val, "byte value")
+        if i >= len(data) or u > 255:
+            raise EnvError("bytes put out of range")
+        charge(10 + len(data), len(data))
+        data[i] = u
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data))
+
+    def bytes_del(inst, b_val, i_val):
+        data = bytearray(_bytes_of(b_val))
+        i = _u32_arg(i_val, "bytes index")
+        if i >= len(data):
+            raise EnvError("bytes del out of range")
+        charge(10 + len(data), len(data))
+        del data[i]
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data))
+
+    def bytes_push(inst, b_val, u_val):
+        data = _bytes_of(b_val)
+        u = _u32_arg(u_val, "byte value")
+        if u > 255:
+            raise EnvError("byte value out of range")
+        charge(10 + len(data), len(data) + 1)
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data) + bytes([u]))
+
+    def bytes_pop(inst, b_val):
+        data = _bytes_of(b_val)
+        if not data:
+            raise EnvError("pop from empty bytes")
+        charge(10 + len(data), len(data))
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data[:-1]))
+
+    def bytes_front(inst, b_val):
+        data = _bytes_of(b_val)
+        if not data:
+            raise EnvError("front of empty bytes")
+        return _make(TAG_U32, data[0])
+
+    def bytes_back(inst, b_val):
+        data = _bytes_of(b_val)
+        if not data:
+            raise EnvError("back of empty bytes")
+        return _make(TAG_U32, data[-1])
+
+    def bytes_insert(inst, b_val, i_val, u_val):
+        data = bytearray(_bytes_of(b_val))
+        i = _u32_arg(i_val, "bytes index")
+        u = _u32_arg(u_val, "byte value")
+        if i > len(data) or u > 255:
+            raise EnvError("bytes insert out of range")
+        charge(10 + len(data), len(data) + 1)
+        data.insert(i, u)
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data))
+
+    def bytes_append(inst, b1_val, b2_val):
+        a, b = _bytes_of(b1_val), _bytes_of(b2_val)
+        charge(10 + len(a) + len(b), len(a) + len(b))
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(a) + bytes(b))
+
+    def bytes_slice(inst, b_val, start_val, end_val):
+        data = _bytes_of(b_val)
+        start = _u32_arg(start_val, "slice start")
+        end = _u32_arg(end_val, "slice end")
+        if start > end or end > len(data):
+            raise EnvError("bytes slice out of range")
+        charge(10 + (end - start), end - start)
+        return cv.new_obj(TAG_BYTES_OBJ, bytes(data[start:end]))
+
+    def symbol_index_in_linear_memory(inst, sym_val, slices_pos,
+                                      len_val):
+        target = _sym_bytes(sym_val)
+        sp = _u32_arg(slices_pos, "slices pos")
+        n = _u32_arg(len_val, "len")
+        for i, raw in enumerate(_key_slices(inst, sp, n)):
+            if raw == target:
+                return _make(TAG_U32, i)
+        raise EnvError("symbol not found in linear memory slices")
+
+    # ---- crypto ----
+
+    def verify_sig_ed25519(inst, pk_val, payload_val, sig_val):
+        pk = _bytes_of(pk_val)
+        payload = _bytes_of(payload_val)
+        sig = _bytes_of(sig_val)
+        if len(pk) != 32 or len(sig) != 64:
+            raise EnvError("bad ed25519 key/signature length")
+        charge(400_000 + 30 * len(payload), 0)
+        from stellar_tpu.crypto.keys import PublicKey, verify_sig
+        if not verify_sig(PublicKey(bytes(pk)), bytes(payload),
+                          bytes(sig)):
+            raise EnvError("ed25519 signature verification failed")
+        return _make(TAG_VOID)
+
+    def compute_hash_keccak256(inst, b_val):
+        data = _bytes_of(b_val)
+        charge(3000 + 40 * len(data), 32)
+        from stellar_tpu.crypto.keccak import keccak256
+        return cv.new_obj(TAG_BYTES_OBJ, keccak256(bytes(data)))
+
+    def recover_key_ecdsa_secp256k1(inst, digest_val, sig_val,
+                                    rid_val):
+        digest = _bytes_of(digest_val)
+        sig = _bytes_of(sig_val)
+        rid = _u32_arg(rid_val, "recovery id")
+        charge(2_000_000, 65)
+        from stellar_tpu.crypto.secp256 import (
+            EcdsaError, recover_secp256k1,
+        )
+        try:
+            pk = recover_secp256k1(bytes(digest), bytes(sig), rid)
+        except EcdsaError as e:
+            raise EnvError(f"secp256k1 recover: {e}")
+        return cv.new_obj(TAG_BYTES_OBJ, pk)
+
+    def verify_sig_ecdsa_secp256r1(inst, pk_val, digest_val, sig_val):
+        pk = _bytes_of(pk_val)
+        digest = _bytes_of(digest_val)
+        sig = _bytes_of(sig_val)
+        charge(2_000_000, 0)
+        from stellar_tpu.crypto.secp256 import (
+            SECP256R1, EcdsaError, verify_ecdsa,
+        )
+        try:
+            ok = verify_ecdsa(SECP256R1, bytes(pk), bytes(digest),
+                              bytes(sig))
+        except EcdsaError as e:
+            raise EnvError(f"secp256r1 verify: {e}")
+        if not ok:
+            raise EnvError("secp256r1 signature verification failed")
+        return _make(TAG_VOID)
+
+    # ---- ledger (create/upload/id-derivation surface) ----
+
+    def _addr_of(val):
+        return cv.obj(val, TAG_ADDRESS_OBJ)
+
+    def _from_address_preimage(deployer, salt: bytes):
+        from stellar_tpu.xdr.contract import (
+            ContractIDPreimage, ContractIDPreimageFromAddress,
+            ContractIDPreimageType,
+        )
+        return ContractIDPreimage.make(
+            ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+            ContractIDPreimageFromAddress(address=deployer,
+                                          salt=salt))
+
+    def create_contract(inst, deployer_val, wasm_hash_val, salt_val):
+        from stellar_tpu.soroban.host import _address_bytes, _create
+        from stellar_tpu.xdr.contract import (
+            ContractExecutable, ContractExecutableType,
+            CreateContractArgs, SorobanAuthorizedFunction,
+            SorobanAuthorizedFunctionType,
+        )
+        deployer = _addr_of(deployer_val)
+        wasm_hash = bytes(_bytes_of(wasm_hash_val))
+        salt = bytes(_bytes_of(salt_val))
+        if len(wasm_hash) != 32 or len(salt) != 32:
+            raise EnvError("wasm hash and salt must be 32 bytes")
+        cc = CreateContractArgs(
+            contractIDPreimage=_from_address_preimage(deployer, salt),
+            executable=ContractExecutable.make(
+                ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                wasm_hash))
+        # a deployer other than the running contract must authorize
+        if _address_bytes(deployer) != \
+                _address_bytes(env.contract_addr):
+            inv = SorobanAuthorizedFunction.make(
+                SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CREATE_CONTRACT_HOST_FN,
+                cc)
+            env.host.require_auth(
+                SCVal.make(T.SCV_ADDRESS, deployer), inv, env.depth)
+        rv = _create(env.host, cc, env.host.network_id)
+        return cv.from_scval(rv)
+
+    def create_asset_contract(inst, asset_val):
+        from stellar_tpu.soroban.host import _create
+        from stellar_tpu.xdr.contract import (
+            ContractExecutable, ContractExecutableType,
+            ContractIDPreimage, ContractIDPreimageType,
+            CreateContractArgs,
+        )
+        from stellar_tpu.xdr.runtime import from_bytes as _fb
+        from stellar_tpu.xdr.types import Asset
+        try:
+            asset = _fb(Asset, bytes(_bytes_of(asset_val)))
+        except Exception:
+            raise EnvError("unparsable Asset XDR")
+        cc = CreateContractArgs(
+            contractIDPreimage=ContractIDPreimage.make(
+                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET,
+                asset),
+            executable=ContractExecutable.make(
+                ContractExecutableType.CONTRACT_EXECUTABLE_STELLAR_ASSET))
+        rv = _create(env.host, cc, env.host.network_id)
+        return cv.from_scval(rv)
+
+    def get_contract_id(inst, deployer_val, salt_val):
+        from stellar_tpu.soroban.host import derive_contract_id
+        from stellar_tpu.xdr.contract import contract_address
+        deployer = _addr_of(deployer_val)
+        salt = bytes(_bytes_of(salt_val))
+        if len(salt) != 32:
+            raise EnvError("salt must be 32 bytes")
+        charge(500, 32)
+        cid = derive_contract_id(
+            env.host.network_id,
+            _from_address_preimage(deployer, salt))
+        return cv.new_obj(TAG_ADDRESS_OBJ, contract_address(cid))
+
+    def get_asset_contract_id(inst, asset_val):
+        from stellar_tpu.soroban.host import derive_contract_id
+        from stellar_tpu.xdr.contract import (
+            ContractIDPreimage, ContractIDPreimageType,
+            contract_address,
+        )
+        from stellar_tpu.xdr.runtime import from_bytes as _fb
+        from stellar_tpu.xdr.types import Asset
+        try:
+            asset = _fb(Asset, bytes(_bytes_of(asset_val)))
+        except Exception:
+            raise EnvError("unparsable Asset XDR")
+        charge(500, 32)
+        cid = derive_contract_id(
+            env.host.network_id,
+            ContractIDPreimage.make(
+                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET,
+                asset))
+        return cv.new_obj(TAG_ADDRESS_OBJ, contract_address(cid))
+
+    def upload_wasm(inst, b_val):
+        from stellar_tpu.soroban.host import _upload
+        rv = _upload(env.host, bytes(_bytes_of(b_val)),
+                     env.host.storage.read_write)
+        return cv.from_scval(rv)
+
+    def update_current_contract_wasm(inst, hash_val):
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.soroban.host import (
+            _wrap_entry, contract_code_key, contract_data_key,
+        )
+        from stellar_tpu.xdr.contract import (
+            ContractDataDurability, ContractDataEntry,
+            ContractExecutable, ContractExecutableType,
+            SCContractInstance,
+        )
+        from stellar_tpu.xdr.types import (
+            ExtensionPoint, LedgerEntryType,
+        )
+        new_hash = bytes(_bytes_of(hash_val))
+        if len(new_hash) != 32:
+            raise EnvError("wasm hash must be 32 bytes")
+        if env.host.storage.get(
+                key_bytes(contract_code_key(new_hash))) is None:
+            raise EnvError("new wasm not uploaded")
+        key = SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE)
+        lk = contract_data_key(env.contract_addr, key,
+                               ContractDataDurability.PERSISTENT)
+        kb = key_bytes(lk)
+        entry = env.host.storage.get(kb)
+        if entry is None:
+            raise EnvError("missing instance entry")
+        inst_v = entry.data.value.val.value
+        new_inst = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=env.contract_addr,
+            key=key, durability=ContractDataDurability.PERSISTENT,
+            val=SCVal.make(T.SCV_CONTRACT_INSTANCE, SCContractInstance(
+                executable=ContractExecutable.make(
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                    new_hash),
+                storage=inst_v.storage)))
+        env.host.storage.put(kb, _wrap_entry(
+            LedgerEntryType.CONTRACT_DATA, new_inst,
+            env.host.ledger_seq), None)
+        return _make(TAG_VOID)
+
+    def extend_contract_instance_and_code_ttl(inst, addr_val,
+                                              thresh_val, ext_val):
+        """Like extend_current_contract_instance_and_code_ttl but for
+        an arbitrary contract address."""
+        from stellar_tpu.ledger.ledger_txn import key_bytes
+        from stellar_tpu.soroban.host import (
+            contract_code_key, contract_data_key,
+        )
+        from stellar_tpu.xdr.contract import (
+            ContractDataDurability, ContractExecutableType,
+        )
+        target = _addr_of(addr_val)
+        thresh = _u32_arg(thresh_val, "threshold")
+        ext = _u32_arg(ext_val, "extend_to")
+        inst_kb = key_bytes(contract_data_key(
+            target, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT))
+        env.host.extend_ttl(inst_kb, thresh, ext)
+        slot = env.host.storage.entries.get(inst_kb)
+        if slot is not None and slot[0] is not None:
+            instance = slot[0].data.value.val.value
+            if instance.executable.arm == \
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
+                env.host.extend_ttl(
+                    key_bytes(contract_code_key(
+                        instance.executable.value)), thresh, ext)
+        return _make(TAG_VOID)
+
+    # ---- call: try_call with frame rollback ----
+
+    def try_call(inst, addr_val, fn_val, args_val):
+        from stellar_tpu.soroban.host import HostError
+        from stellar_tpu.xdr.contract import SCError, SCErrorCode
+        addr_sc = cv.to_scval(addr_val)
+        fn_sc = cv.to_scval(fn_val)
+        args_sc = cv.to_scval(args_val)
+        if addr_sc.arm != T.SCV_ADDRESS or fn_sc.arm != T.SCV_SYMBOL \
+                or args_sc.arm != T.SCV_VEC:
+            raise EnvError("try_call needs (address, symbol, vec)")
+        snap = env.host.snapshot()
+        try:
+            rv = env.host.call_contract(addr_sc.value, fn_sc.value,
+                                        list(args_sc.value or ()),
+                                        env.depth + 1)
+        except HostError as e:
+            if e.kind == HostError.BUDGET:
+                raise  # metering exhaustion is never catchable
+            env.host.restore(snap)
+            if e.error_sc is not None:
+                # hand the CALLEE'S fail_with_error val to the caller
+                return cv.from_scval(e.error_sc)
+            from stellar_tpu.xdr.contract import SCErrorType
+            return cv.from_scval(SCVal.make(T.SCV_ERROR, SCError.make(
+                SCErrorType.SCE_CONTEXT,
+                SCErrorCode.SCEC_INVALID_ACTION)))
+        return cv.from_scval(rv)
+
+    # ---- address ----
+
+    def require_auth_for_args(inst, addr_val, args_val):
+        from stellar_tpu.xdr.contract import (
+            InvokeContractArgs, SorobanAuthorizedFunction,
+            SorobanAuthorizedFunctionType,
+        )
+        addr = _addr_of(addr_val)
+        args_sc = cv.to_scval(args_val)
+        if args_sc.arm != T.SCV_VEC:
+            raise EnvError("require_auth_for_args needs a vec")
+        if env.invocation is None or env.invocation.arm != \
+                SorobanAuthorizedFunctionType \
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN:
+            raise EnvError("no contract invocation context")
+        cur = env.invocation.value
+        inv = SorobanAuthorizedFunction.make(
+            SorobanAuthorizedFunctionType
+            .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+            InvokeContractArgs(contractAddress=cur.contractAddress,
+                               functionName=cur.functionName,
+                               args=list(args_sc.value or ())))
+        env.host.require_auth(SCVal.make(T.SCV_ADDRESS, addr), inv,
+                              env.depth)
+        return _make(TAG_VOID)
+
+    def strkey_to_address(inst, key_val):
+        from stellar_tpu.crypto import strkey as sk
+        from stellar_tpu.xdr.contract import (
+            SCAddressType, contract_address,
+        )
+        from stellar_tpu.xdr.types import account_id
+        tag = _tag(key_val)
+        if tag == TAG_BYTES_OBJ:
+            raw = bytes(_bytes_of(key_val))
+        elif tag == TAG_STRING_OBJ:
+            raw = bytes(_str_bytes(key_val))
+        else:
+            raise EnvError("strkey must be bytes or string")
+        charge(200, 0)
+        try:
+            s = raw.decode("ascii")
+        except UnicodeDecodeError:
+            raise EnvError("strkey must be ascii")
+        try:
+            if s.startswith("G"):
+                addr = SCAddress.make(
+                    SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                    account_id(sk.decode_account(s)))
+            elif s.startswith("C"):
+                addr = contract_address(sk.decode_contract(s))
+            else:
+                raise EnvError("unsupported strkey kind")
+        except sk.StrKeyError as e:
+            raise EnvError(f"bad strkey: {e}")
+        return cv.new_obj(TAG_ADDRESS_OBJ, addr)
+
+    def address_to_strkey(inst, addr_val):
+        from stellar_tpu.crypto import strkey as sk
+        from stellar_tpu.xdr.contract import SCAddressType
+        from stellar_tpu.xdr.types import account_ed25519
+        addr = _addr_of(addr_val)
+        charge(200, 64)
+        if addr.arm == SCAddressType.SC_ADDRESS_TYPE_ACCOUNT:
+            s = sk.encode_account(account_ed25519(addr.value))
+        else:
+            s = sk.encode_contract(addr.value)
+        return cv.new_obj(TAG_STRING_OBJ, s.encode("ascii"))
+
+    def authorize_as_curr_contract(inst, auth_vec_val):
+        """Register sub-invocation authorizations by the RUNNING
+        contract (reference authorize_as_curr_contract). Entry shape
+        accepted here: vec [address, fn-symbol, args-vec] per entry —
+        the flattened invocation list (the reference takes the
+        recursive InvokerContractAuthEntry tree; this registry keys
+        on the same (contract, fn, args) identity require_auth
+        matches on)."""
+        from stellar_tpu.soroban.host import _address_bytes
+        from stellar_tpu.xdr.contract import (
+            InvokeContractArgs, SorobanAuthorizedFunction,
+            SorobanAuthorizedFunctionType,
+        )
+        entries_sc = cv.to_scval(auth_vec_val)
+        if entries_sc.arm != T.SCV_VEC:
+            raise EnvError("authorize_as_curr_contract needs a vec")
+        my_ab = _address_bytes(env.contract_addr)
+        for entry in (entries_sc.value or ()):
+            if entry.arm != T.SCV_VEC or len(entry.value or ()) != 3:
+                raise EnvError("auth entry must be "
+                               "[address, symbol, args]")
+            addr_sc, fn_sc, args_sc = entry.value
+            if addr_sc.arm != T.SCV_ADDRESS or \
+                    fn_sc.arm != T.SCV_SYMBOL or \
+                    args_sc.arm != T.SCV_VEC:
+                raise EnvError("auth entry must be "
+                               "[address, symbol, args]")
+            inv = SorobanAuthorizedFunction.make(
+                SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                InvokeContractArgs(contractAddress=addr_sc.value,
+                                   functionName=fn_sc.value,
+                                   args=list(args_sc.value or ())))
+            charge(100, 64)
+            env.host.contract_auths.setdefault(my_ab, []).append(
+                (len(env.host.frame_addrs),
+                 to_bytes(SorobanAuthorizedFunction, inv)))
+        return _make(TAG_VOID)
+
+    # ---- test ----
+
+    def dummy0(inst):
+        return _make(TAG_VOID)
+
+    def protocol_gated_dummy(inst):
+        from stellar_tpu.protocol import (
+            CURRENT_LEDGER_PROTOCOL_VERSION,
+        )
+        hdr = getattr(env.host, "ledger_header", None)
+        version = hdr.ledgerVersion if hdr is not None \
+            else CURRENT_LEDGER_PROTOCOL_VERSION
+        if version < CURRENT_LEDGER_PROTOCOL_VERSION:
+            raise EnvError("protocol_gated_dummy not yet enabled")
+        return _make(TAG_VOID)
+
+    # =====================================================================
+    # the import table: every canonical handler registers under BOTH
+    # its (module, single-char export) name — what genuine SDK
+    # contracts import (see env_interface.py) — and (module, long
+    # name); the historical aliases this repo's earlier builder
+    # contracts imported stay bound to the same closures.
+    # =====================================================================
+
+    canonical = {
+        # context "x"
+        "log_from_linear_memory": ("x", log_from_linear_memory),
+        "obj_cmp": ("x", obj_cmp),
+        "contract_event": ("x", contract_event),
+        "get_ledger_version": ("x", get_ledger_version),
+        "get_ledger_sequence": ("x", ledger_sequence),
+        "get_ledger_timestamp": ("x", ledger_timestamp),
+        "fail_with_error": ("x", fail_with_error),
+        "get_ledger_network_id": ("x", get_ledger_network_id),
+        "get_current_contract_address":
+            ("x", current_contract_address),
+        "get_max_live_until_ledger": ("x", get_max_live_until_ledger),
+        # int "i"
+        "obj_from_u64": ("i", obj_from_u64),
+        "obj_to_u64": ("i", obj_to_u64),
+        "obj_from_i64": ("i", obj_from_i64),
+        "obj_to_i64": ("i", obj_to_i64),
+        "obj_from_u128_pieces": ("i", obj_from_u128_pieces),
+        "obj_to_u128_lo64": ("i", obj_to_u128_lo64),
+        "obj_to_u128_hi64": ("i", obj_to_u128_hi64),
+        "obj_from_i128_pieces": ("i", obj_from_i128_pieces),
+        "obj_to_i128_lo64": ("i", obj_to_i128_lo64),
+        "obj_to_i128_hi64": ("i", obj_to_i128_hi64),
+        "obj_from_u256_pieces": ("i", obj_from_u256_pieces),
+        "u256_val_from_be_bytes": ("i", u256_val_from_be_bytes),
+        "u256_val_to_be_bytes": ("i", u256_val_to_be_bytes),
+        "obj_to_u256_hi_hi": ("i", obj_to_u256_hi_hi),
+        "obj_to_u256_hi_lo": ("i", obj_to_u256_hi_lo),
+        "obj_to_u256_lo_hi": ("i", obj_to_u256_lo_hi),
+        "obj_to_u256_lo_lo": ("i", obj_to_u256_lo_lo),
+        "obj_from_i256_pieces": ("i", obj_from_i256_pieces),
+        "i256_val_from_be_bytes": ("i", i256_val_from_be_bytes),
+        "i256_val_to_be_bytes": ("i", i256_val_to_be_bytes),
+        "obj_to_i256_hi_hi": ("i", obj_to_i256_hi_hi),
+        "obj_to_i256_hi_lo": ("i", obj_to_i256_hi_lo),
+        "obj_to_i256_lo_hi": ("i", obj_to_i256_lo_hi),
+        "obj_to_i256_lo_lo": ("i", obj_to_i256_lo_lo),
+        "u256_add": ("i", u256_add),
+        "u256_sub": ("i", u256_sub),
+        "u256_mul": ("i", u256_mul),
+        "u256_div": ("i", u256_div),
+        "u256_rem_euclid": ("i", u256_rem_euclid),
+        "u256_pow": ("i", u256_pow),
+        "u256_shl": ("i", u256_shl),
+        "u256_shr": ("i", u256_shr),
+        "i256_add": ("i", i256_add),
+        "i256_sub": ("i", i256_sub),
+        "i256_mul": ("i", i256_mul),
+        "i256_div": ("i", i256_div),
+        "i256_rem_euclid": ("i", i256_rem_euclid),
+        "i256_pow": ("i", i256_pow),
+        "i256_shl": ("i", i256_shl),
+        "i256_shr": ("i", i256_shr),
+        "timepoint_obj_from_u64": ("i", timepoint_obj_from_u64),
+        "timepoint_obj_to_u64": ("i", timepoint_obj_to_u64),
+        "duration_obj_from_u64": ("i", duration_obj_from_u64),
+        "duration_obj_to_u64": ("i", duration_obj_to_u64),
+        # map "m"
+        "map_new": ("m", map_new),
+        "map_put": ("m", map_put),
+        "map_get": ("m", map_get),
+        "map_del": ("m", map_del),
+        "map_len": ("m", map_len),
+        "map_has": ("m", map_has),
+        "map_key_by_pos": ("m", map_key_by_pos),
+        "map_val_by_pos": ("m", map_val_by_pos),
+        "map_keys": ("m", map_keys),
+        "map_values": ("m", map_values),
+        "map_new_from_linear_memory":
+            ("m", map_new_from_linear_memory),
+        "map_unpack_to_linear_memory":
+            ("m", map_unpack_to_linear_memory),
+        # vec "v"
+        "vec_new": ("v", vec_new),
+        "vec_put": ("v", vec_put),
+        "vec_get": ("v", vec_get),
+        "vec_del": ("v", vec_del),
+        "vec_len": ("v", vec_len),
+        "vec_push_front": ("v", vec_push_front),
+        "vec_pop_front": ("v", vec_pop_front),
+        "vec_push_back": ("v", vec_push_back),
+        "vec_pop_back": ("v", vec_pop_back),
+        "vec_front": ("v", vec_front),
+        "vec_back": ("v", vec_back),
+        "vec_insert": ("v", vec_insert),
+        "vec_append": ("v", vec_append),
+        "vec_slice": ("v", vec_slice),
+        "vec_first_index_of": ("v", vec_first_index_of),
+        "vec_last_index_of": ("v", vec_last_index_of),
+        "vec_binary_search": ("v", vec_binary_search),
+        "vec_new_from_linear_memory":
+            ("v", vec_new_from_linear_memory),
+        "vec_unpack_to_linear_memory":
+            ("v", vec_unpack_to_linear_memory),
+        # ledger "l"
+        "put_contract_data": ("l", put_contract_data),
+        "has_contract_data": ("l", has_contract_data),
+        "get_contract_data": ("l", get_contract_data),
+        "del_contract_data": ("l", del_contract_data),
+        "extend_contract_data_ttl": ("l", extend_contract_data_ttl),
+        "extend_current_contract_instance_and_code_ttl":
+            ("l", extend_instance_and_code_ttl),
+        "extend_contract_instance_and_code_ttl":
+            ("l", extend_contract_instance_and_code_ttl),
+        "create_contract": ("l", create_contract),
+        "create_asset_contract": ("l", create_asset_contract),
+        "get_asset_contract_id": ("l", get_asset_contract_id),
+        "upload_wasm": ("l", upload_wasm),
+        "update_current_contract_wasm":
+            ("l", update_current_contract_wasm),
+        "get_contract_id": ("l", get_contract_id),
+        # call "d"
+        "call": ("d", call),
+        "try_call": ("d", try_call),
+        # buf "b"
+        "serialize_to_bytes": ("b", serialize_to_bytes),
+        "deserialize_from_bytes": ("b", deserialize_from_bytes),
+        "string_copy_to_linear_memory":
+            ("b", string_copy_to_linear_memory),
+        "symbol_copy_to_linear_memory":
+            ("b", symbol_copy_to_linear_memory),
+        "string_new_from_linear_memory":
+            ("b", string_new_from_linear_memory),
+        "symbol_new_from_linear_memory":
+            ("b", symbol_new_from_linear_memory),
+        "string_len": ("b", string_len),
+        "symbol_len": ("b", symbol_len),
+        "bytes_copy_to_linear_memory":
+            ("b", bytes_copy_to_linear_memory),
+        "bytes_copy_from_linear_memory":
+            ("b", bytes_copy_from_linear_memory),
+        "bytes_new_from_linear_memory":
+            ("b", bytes_new_from_linear_memory),
+        "bytes_new": ("b", bytes_new),
+        "bytes_put": ("b", bytes_put),
+        "bytes_get": ("b", bytes_get),
+        "bytes_del": ("b", bytes_del),
+        "bytes_len": ("b", bytes_len),
+        "bytes_push": ("b", bytes_push),
+        "bytes_pop": ("b", bytes_pop),
+        "bytes_front": ("b", bytes_front),
+        "bytes_back": ("b", bytes_back),
+        "bytes_insert": ("b", bytes_insert),
+        "bytes_append": ("b", bytes_append),
+        "bytes_slice": ("b", bytes_slice),
+        "symbol_index_in_linear_memory":
+            ("b", symbol_index_in_linear_memory),
+        # crypto "c"
+        "compute_hash_sha256": ("c", compute_sha256),
+        "verify_sig_ed25519": ("c", verify_sig_ed25519),
+        "compute_hash_keccak256": ("c", compute_hash_keccak256),
+        "recover_key_ecdsa_secp256k1":
+            ("c", recover_key_ecdsa_secp256k1),
+        "verify_sig_ecdsa_secp256r1":
+            ("c", verify_sig_ecdsa_secp256r1),
+        # address "a"
+        "require_auth_for_args": ("a", require_auth_for_args),
+        "require_auth": ("a", require_auth),
+        "strkey_to_address": ("a", strkey_to_address),
+        "address_to_strkey": ("a", address_to_strkey),
+        "authorize_as_curr_contract":
+            ("a", authorize_as_curr_contract),
+        # test "t"
+        "dummy0": ("t", dummy0),
+        "protocol_gated_dummy": ("t", protocol_gated_dummy),
+        # prng "p"
+        "prng_reseed": ("p", prng_reseed),
+        "prng_bytes_new": ("p", prng_bytes_new),
+        "prng_u64_in_inclusive_range":
+            ("p", prng_u64_in_inclusive_range),
+        "prng_vec_shuffle": ("p", prng_vec_shuffle),
+    }
+
+    from stellar_tpu.soroban.env_interface import long_to_short
+    table: Dict[Tuple[str, str], Callable] = {}
+    shorts = long_to_short()
+    for long_name, (mod, fn) in canonical.items():
+        table[(mod, long_name)] = fn
+        smod, schar = shorts[long_name]
+        assert smod == mod, f"module mismatch for {long_name}"
+        table[(mod, schar)] = fn
+
+    # historical aliases (this repo's earlier internal dialect, kept
+    # for wasm_builder contracts already pinned in goldens/fixtures)
+    table.update({
         ("x", "log"): log,
         ("x", "ledger_sequence"): ledger_sequence,
         ("x", "ledger_timestamp"): ledger_timestamp,
@@ -669,4 +2085,5 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             prng_u64_in_inclusive_range,
         ("p", "prng_bytes_new"): prng_bytes_new,
         ("p", "prng_reseed"): prng_reseed,
-    }
+    })
+    return table

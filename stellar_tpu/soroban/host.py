@@ -56,9 +56,13 @@ class HostError(Exception):
     ARCHIVED = "archived"
     AUTH = "auth"
 
-    def __init__(self, kind: str, msg: str):
+    def __init__(self, kind: str, msg: str, error_sc=None):
         super().__init__(msg)
         self.kind = kind
+        # SCVal (arm SCV_ERROR) when the failing frame raised a
+        # specific contract error (fail_with_error); try_call returns
+        # it to the caller
+        self.error_sc = error_sc
 
 
 # ---------------------------------------------------------------------------
@@ -719,17 +723,62 @@ class _Prng:
 class _Host:
     def __init__(self, storage: _Storage, budget: _Budget, auth,
                  config, ledger_seq: int,
-                 prng_seed: Optional[bytes] = None):
+                 prng_seed: Optional[bytes] = None,
+                 network_id: bytes = b"\x00" * 32):
         self.storage = storage
         self.budget = budget
         self.auth = auth
         self.config = config
         self.ledger_seq = ledger_seq
+        self.network_id = network_id
         self.events: List = []
         self.diagnostics: List = []
         self.base_prng = _Prng(prng_seed if prng_seed is not None
                                else b"\x00" * 32)
         self._prng_forks = 0
+        # active contract frames (SCAddress bytes, bottom -> top):
+        # drives the direct-contract-invoker implicit authorization
+        self.frame_addrs: List[bytes] = []
+        # authorize_as_curr_contract registrations, scoped to the
+        # granting frame: authorizer addr bytes ->
+        # [(granting frame depth, SorobanAuthorizedFunction bytes)];
+        # pruned when the granting frame exits (reference: these
+        # entries live only for the current invocation)
+        self.contract_auths: Dict[bytes, List[Tuple[int, bytes]]] = {}
+
+    def snapshot(self):
+        """Frame snapshot for try_call rollback: storage slots +
+        accounting, events, and auth consumption state. The budget is
+        deliberately NOT captured — metering consumed by a failed
+        callee stays consumed (reference try_call semantics)."""
+        st = self.storage
+        return (
+            {kb: list(slot) for kb, slot in st.entries.items()},
+            dict(st.ttl_extensions),
+            len(self.events), len(self.diagnostics),
+            # deep-copy the per-entry __check_auth cells: a rolled-back
+            # frame must not leave cell["verified"]=True behind while
+            # the storage effects that verification depended on are
+            # undone
+            {k: [(fn, dict(c) if c is not None else None)
+                 for fn, c in v]
+             for k, v in self.auth.available.items()}
+            if self.auth is not None else None,
+            {k: list(v) for k, v in self.contract_auths.items()},
+            set(st._read_charged), dict(st._write_sizes),
+            st.read_bytes,
+        )
+
+    def restore(self, snap):
+        st = self.storage
+        (st.entries, st.ttl_extensions, n_ev, n_diag, avail,
+         cauths, st._read_charged, st._write_sizes,
+         st.read_bytes) = snap
+        del self.events[n_ev:]
+        del self.diagnostics[n_diag:]
+        if avail is not None:
+            self.auth.available = avail
+        self.contract_auths = cauths
 
     def fork_prng(self) -> _Prng:
         """A fresh per-frame PRNG stream (deterministic fork order)."""
@@ -741,8 +790,35 @@ class _Host:
         if addr.arm != T.SCV_ADDRESS:
             raise HostError(HostError.TRAPPED,
                             "require_auth on non-address")
-        self.auth.require(_address_bytes(addr.value), invocation,
-                          depth)
+        ab = _address_bytes(addr.value)
+        # the DIRECT caller contract is implicitly authorized for the
+        # frame it invoked (reference contract-invoker rule); deeper
+        # sub-invocations need authorize_as_curr_contract entries
+        if len(self.frame_addrs) >= 2 and ab == self.frame_addrs[-2]:
+            return
+        regs = self.contract_auths.get(ab)
+        if regs and invocation is not None:
+            from stellar_tpu.xdr.contract import (
+                SorobanAuthorizedFunction,
+            )
+            want = to_bytes(SorobanAuthorizedFunction, invocation)
+            for i, (_d, fb) in enumerate(regs):
+                if fb == want:
+                    regs.pop(i)
+                    return
+        self.auth.require(ab, invocation, depth)
+
+    def prune_contract_auths(self):
+        """Drop authorize_as_curr_contract grants whose granting frame
+        has exited (called on every frame pop)."""
+        live = len(self.frame_addrs)
+        for ab in list(self.contract_auths):
+            kept = [(d, fb) for d, fb in self.contract_auths[ab]
+                    if d <= live]
+            if kept:
+                self.contract_auths[ab] = kept
+            else:
+                del self.contract_auths[ab]
 
     def call_contract(self, addr, fn_name: bytes, args: List,
                       depth: int):
@@ -905,7 +981,7 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
                            (tx_hash if tx_hash is not None
                             else to_bytes(_HF, host_fn)))
         host = _Host(storage, budget, auth, config, ledger_seq,
-                     prng_seed=prng_seed)
+                     prng_seed=prng_seed, network_id=network_id)
         auth.host = host  # custom-account __check_auth dispatch
         host.ledger_header = ledger_header  # classic reserve math (SAC)
         t = host_fn.arm
@@ -1072,7 +1148,8 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
     except WasmError as e:
         raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
     except Trap as e:
-        raise HostError(HostError.TRAPPED, str(e))
+        raise HostError(HostError.TRAPPED, str(e),
+                        error_sc=getattr(e, "error_sc", None))
     except HostError:
         raise
     except Exception as e:
@@ -1155,6 +1232,15 @@ def _create(host: "_Host", args, network_id: bytes):
 
 
 def _run_contract(host: "_Host", args, depth: int = 0):
+    host.frame_addrs.append(_address_bytes(args.contractAddress))
+    try:
+        return _run_contract_inner(host, args, depth)
+    finally:
+        host.frame_addrs.pop()
+        host.prune_contract_auths()
+
+
+def _run_contract_inner(host: "_Host", args, depth: int = 0):
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.xdr.contract import (
         SorobanAuthorizedFunction, SorobanAuthorizedFunctionType,
