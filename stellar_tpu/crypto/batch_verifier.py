@@ -290,6 +290,46 @@ _PAD_H = np.zeros((1, 32), dtype=np.uint8)
 _default: Optional[BatchVerifier] = None
 _default_lock = threading.Lock()
 
+_device_state: Optional[str] = None  # None=unprobed, else platform|"dead"
+_device_probe_lock = threading.Lock()
+
+
+def device_available(timeout_s: float = 30.0) -> bool:
+    """True when a REAL accelerator is reachable. Probed once per
+    process in a watchdogged thread: with the axon tunnel down,
+    ``jax.devices()`` hangs forever rather than raising, and a node
+    must fall back to the host oracle instead of hanging the close
+    path (failure detection, not configuration). jax-CPU reports
+    False: batching bignum kernels through XLA-on-CPU is strictly
+    slower than the host oracle, so auto mode only engages the device
+    path on tpu-class hardware."""
+    global _device_state
+    with _device_probe_lock:
+        if _device_state is None:
+            box = {}
+
+            def probe():
+                try:
+                    import jax
+                    box["platform"] = jax.devices()[0].platform
+                except Exception as e:  # no backend at all
+                    box["error"] = str(e)
+
+            t = threading.Thread(target=probe, daemon=True,
+                                 name="device-probe")
+            t.start()
+            t.join(timeout_s)
+            if "platform" in box:
+                _device_state = box["platform"]
+            else:
+                _device_state = "dead"
+                import logging
+                logging.getLogger("stellar_tpu.crypto").warning(
+                    "device probe failed (%s) — signature "
+                    "verification falls back to the host oracle",
+                    box.get("error", f"hung > {timeout_s}s"))
+        return _device_state not in ("dead", "cpu")
+
 
 def _auto_mesh():
     """1-D mesh over every local device, or None when single-device.

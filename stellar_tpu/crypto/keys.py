@@ -26,6 +26,7 @@ from stellar_tpu.utils.cache import RandomEvictionCache
 
 __all__ = [
     "SecretKey", "PublicKey", "verify_sig", "set_verifier_backend",
+    "get_verifier_backend_name",
     "get_verify_cache_stats", "flush_verify_cache",
     "sign_ops_per_second", "verify_ops_per_second",
 ]
@@ -147,6 +148,30 @@ def set_verifier_backend(fn: Optional[Callable[[bytes, bytes, bytes], bool]]):
     _backend = fn
 
 
+def get_verifier_backend_name() -> str:
+    """Which backend serves verification right now — recorded into
+    every published benchmark row so numbers are attributable."""
+    if _backend is None:
+        from stellar_tpu.crypto import batch_verifier
+        state = batch_verifier._device_state  # no probe side effect
+        if state in ("dead", "cpu"):
+            return f"host-oracle(auto; device={state})"
+        return f"auto(host<{MIN_DEVICE_BATCH},device-batch>=" \
+            f"{MIN_DEVICE_BATCH},device={state or 'unprobed'})"
+    self_obj = getattr(_backend, "__self__", None)
+    if self_obj is not None:
+        name = type(self_obj).__name__
+        if name == "TrickleBatcher":
+            return "device-batch+trickle"
+        if hasattr(self_obj, "verify_batch"):
+            return "device-batch"
+        return name
+    mod = getattr(_backend, "__module__", "")
+    if "ed25519_ref" in mod:
+        return "host-oracle"
+    return getattr(_backend, "__qualname__", "custom")
+
+
 def _cache_key(pk: bytes, msg: bytes, sig: bytes) -> bytes:
     # Identity of the (key, sig, msg) triple. pk and sig are validated
     # fixed-length (32/64) before this is called, so the concatenation
@@ -204,9 +229,15 @@ def batch_verify_into_cache(items) -> None:
             # custom scalar backend: stay consistent with verify_sig
             results = [_backend(pk, msg, sig) for _, pk, msg, sig in todo]
     else:
-        from stellar_tpu.crypto.batch_verifier import default_verifier
-        results = default_verifier().verify_batch(
-            [(pk, msg, sig) for _, pk, msg, sig in todo])
+        from stellar_tpu.crypto import batch_verifier
+        if batch_verifier.device_available():
+            results = batch_verifier.default_verifier().verify_batch(
+                [(pk, msg, sig) for _, pk, msg, sig in todo])
+        else:
+            # no accelerator (cpu-only jax, or a dead tunnel): the
+            # host oracle beats XLA-on-CPU for bignum verify
+            results = [_ref.verify(pk, msg, sig)
+                       for _, pk, msg, sig in todo]
     with _cache_lock:
         for (k, _, _, _), ok in zip(todo, results):
             _verify_cache.put(k, bool(ok))

@@ -490,6 +490,11 @@ class ApplicableTxSetFrame:
                     f.inclusion_fee() < bf * max(1, f.num_operations()):
                 return False
         prefetch_signature_batch(ltx, self.frames)
+        # close_ledger skips its own seeding pass for this set — the
+        # triples are already cached (herder-path closes would
+        # otherwise re-collect every account and re-hash every triple
+        # just to find full cache hits)
+        self.sig_cache_seeded = True
         from stellar_tpu.xdr.results import TransactionResultCode as TC
         # per-account chains: each tx validates against its predecessor's
         # seq num (reference ``TxSetUtils::getInvalidTxList``); gaps
@@ -574,12 +579,25 @@ def prefetch_signature_batch(ltx, frames) -> int:
     triples shipped to the device.
     """
     items = []
+    # one account load per DISTINCT account for the whole set — the
+    # collection must stay O(accounts) loads, not O(sigs x accounts)
+    # (each load copies the entry)
+    acct_cache: dict = {}
+
+    def acct_for(account_id_v):
+        k = account_id_v.value  # ed25519 bytes identify the account
+        if k not in acct_cache:
+            entry = ltx.load_without_record(account_key(account_id_v))
+            acct_cache[k] = None if entry is None else entry.data.value
+        return acct_cache[k]
+
     for f in frames:
         inner_frames = [f]
         if hasattr(f, "inner"):  # fee bump: outer + inner
             for sig in f.signatures:
                 _collect_for_account(
-                    ltx, f.fee_source_id(), f.contents_hash(), sig, items)
+                    acct_for(f.fee_source_id()), f.contents_hash(),
+                    sig, items)
             inner_frames = [f.inner]
         for tf in inner_frames:
             h = tf.contents_hash()
@@ -588,21 +606,20 @@ def prefetch_signature_batch(ltx, frames) -> int:
                 aid = op.source_account_id()
                 if aid not in account_ids:
                     account_ids.append(aid)
+            accts = [acct_for(aid) for aid in account_ids]
             for sig in tf.signatures:
-                for aid in account_ids:
-                    _collect_for_account(ltx, aid, h, sig, items)
+                for acc in accts:
+                    _collect_for_account(acc, h, sig, items)
                 for sk in tf.extra_signers():
                     _collect_for_signer_key(sk, h, sig, items)
     batch_verify_into_cache(items)
     return len(items)
 
 
-def _collect_for_account(ltx, account_id_v, h: bytes, sig, items):
+def _collect_for_account(acc, h: bytes, sig, items):
     from stellar_tpu.tx.signature_utils import does_hint_match
-    entry = ltx.load_without_record(account_key(account_id_v))
-    if entry is None:
+    if acc is None:
         return
-    acc = entry.data.value
     pk = acc.accountID.value
     if does_hint_match(pk, sig.hint):
         items.append((pk, h, sig.signature))

@@ -236,6 +236,24 @@ class LedgerManager:
         # LedgerTxn.h:815)
         prefetch_apply_keys(self.root.store, apply_order)
 
+        # seed the signature-verify cache with ONE device batch before
+        # any per-signature check runs in the fee/apply phases —
+        # checkValid's seeding doesn't reach closes driven directly
+        # (apply-load, catchup replay), and apply must never pay
+        # sequential host verifies (reference processSignatures via
+        # the SignatureChecker, TransactionFrame.cpp:1092; SIG HOT
+        # PATH). Only when an accelerator is live: on the host-oracle
+        # fallback the batch is the same sequential work plus
+        # collection overhead, so apply verifies lazily instead.
+        from stellar_tpu.crypto import batch_verifier, keys
+        if not getattr(lcd.tx_set, "sig_cache_seeded", False) and \
+                (keys._backend is not None or
+                 batch_verifier.device_available()):
+            from stellar_tpu.herder.tx_set import (
+                prefetch_signature_batch,
+            )
+            prefetch_signature_batch(ltx, apply_order)
+
         # fee phase first for ALL txs, then apply (reference
         # processFeesSeqNums before applyTransactions)
         fee_results = {}

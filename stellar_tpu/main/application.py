@@ -165,6 +165,18 @@ class Application:
             workers.set_background(
                 config.BACKGROUND_BUCKET_MERGES and
                 not config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
+        # worker pool active => verify callers are concurrent (overlay
+        # pre-verify, threaded replay): put the device batch verifier
+        # behind a trickle window by default (VERDICT r3 #3 — a policy,
+        # not just a class). Never clobbers an explicitly-installed
+        # backend, installs once per process.
+        if config.WORKER_THREADS > 0 and config.DEVICE_BATCH_VERIFY:
+            from stellar_tpu.crypto import batch_verifier, keys
+            if keys._backend is None and \
+                    batch_verifier.device_available():
+                window = config.TRICKLE_VERIFY_WINDOW_MS
+                batch_verifier.default_verifier().install(
+                    trickle_window_ms=window if window > 0 else None)
         # logging sinks (reference LOG_FILE_PATH / LOG_COLOR)
         if config.LOG_FILE_PATH:
             import logging

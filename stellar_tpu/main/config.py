@@ -112,6 +112,12 @@ class Config:
     WORKER_THREADS: int = 0
     BACKGROUND_BUCKET_MERGES: bool = True
     MAX_CONCURRENT_SUBPROCESSES: int = 16
+    # signature verification: when worker threads are active (verify
+    # callers are concurrent), install the device batch verifier with
+    # a trickle micro-batch window in front so lone verify misses ride
+    # shared dispatches instead of solo round trips
+    DEVICE_BATCH_VERIFY: bool = True
+    TRICKLE_VERIFY_WINDOW_MS: float = 1.0  # 0 = no window
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

@@ -110,6 +110,54 @@ def _make(tag: int, body: int = 0) -> int:
     return ((body & _M56) << 8) | tag
 
 
+def cmp_scval(a, b) -> int:
+    """Deep total order over SCVals — the order obj_cmp exposes, map
+    entries sort by, and from_scval validates on map ingestion (the
+    genuine host rejects out-of-order maps at conversion)."""
+    if a.arm != b.arm:
+        return -1 if a.arm < b.arm else 1
+    arm = a.arm
+    if arm in (T.SCV_BOOL, T.SCV_U32, T.SCV_I32, T.SCV_U64,
+               T.SCV_I64, T.SCV_TIMEPOINT, T.SCV_DURATION):
+        return (a.value > b.value) - (a.value < b.value)
+    if arm in (T.SCV_U128, T.SCV_I128):
+        av = (a.value.hi << 64) | a.value.lo
+        bv = (b.value.hi << 64) | b.value.lo
+        return (av > bv) - (av < bv)
+    if arm in (T.SCV_U256, T.SCV_I256):
+        def n256(p):
+            hh = p.hi_hi & _M64
+            return (hh << 192) | (p.hi_lo << 128) | \
+                (p.lo_hi << 64) | p.lo_lo
+        if arm == T.SCV_I256 and \
+                (a.value.hi_hi < 0) != (b.value.hi_hi < 0):
+            return -1 if a.value.hi_hi < 0 else 1
+        av, bv = n256(a.value), n256(b.value)
+        return (av > bv) - (av < bv)
+    if arm in (T.SCV_BYTES, T.SCV_STRING, T.SCV_SYMBOL):
+        av, bv = bytes(a.value), bytes(b.value)
+        return (av > bv) - (av < bv)
+    if arm == T.SCV_VEC:
+        ai, bi = list(a.value or ()), list(b.value or ())
+        for x, y in zip(ai, bi):
+            r = cmp_scval(x, y)
+            if r:
+                return r
+        return (len(ai) > len(bi)) - (len(ai) < len(bi))
+    if arm == T.SCV_MAP:
+        ai, bi = list(a.value or ()), list(b.value or ())
+        for x, y in zip(ai, bi):
+            r = cmp_scval(x.key, y.key)
+            if r:
+                return r
+            r = cmp_scval(x.val, y.val)
+            if r:
+                return r
+        return (len(ai) > len(bi)) - (len(ai) < len(bi))
+    ab_, bb_ = to_bytes(SCVal, a), to_bytes(SCVal, b)
+    return (ab_ > bb_) - (ab_ < bb_)
+
+
 def sym_to_small(s: bytes) -> int:
     """Pack a <=9-char symbol into a SymbolSmall body (6 bits/char)."""
     if len(s) > 9:
@@ -242,8 +290,17 @@ class ValConverter:
             items = [self.from_scval(e) for e in (v.value or ())]
             return self.new_obj(TAG_VEC_OBJ, items)
         if arm == T.SCV_MAP:
+            # the host invariant every map op relies on (bisect in
+            # map_put, positional unpack) is sorted-unique keys; maps
+            # arriving from XDR/args are validated here exactly like
+            # the genuine host, which rejects out-of-order maps at
+            # conversion
+            entries = list(v.value or ())
+            for i in range(1, len(entries)):
+                if cmp_scval(entries[i - 1].key, entries[i].key) >= 0:
+                    raise EnvError("map keys not sorted-unique")
             pairs = [(self.from_scval(e.key), self.from_scval(e.val))
-                     for e in (v.value or ())]
+                     for e in entries]
             return self.new_obj(TAG_MAP_OBJ, pairs)
         if arm == T.SCV_ADDRESS:
             return self.new_obj(TAG_ADDRESS_OBJ, v.value)
@@ -747,51 +804,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def _cmp_sc(a, b) -> int:
         charge(50, 0)
-        if a.arm != b.arm:
-            return -1 if a.arm < b.arm else 1
-        arm = a.arm
-        if arm in (T.SCV_BOOL, T.SCV_U32, T.SCV_I32, T.SCV_U64,
-                   T.SCV_I64, T.SCV_TIMEPOINT, T.SCV_DURATION):
-            return (a.value > b.value) - (a.value < b.value)
-        if arm in (T.SCV_U128, T.SCV_I128):
-            av = (a.value.hi << 64) | a.value.lo
-            bv = (b.value.hi << 64) | b.value.lo
-            return (av > bv) - (av < bv)
-        if arm in (T.SCV_U256, T.SCV_I256):
-            def n256(p):
-                hh = p.hi_hi & _M64
-                return (hh << 192) | (p.hi_lo << 128) | \
-                    (p.lo_hi << 64) | p.lo_lo
-            av, bv = n256(a.value), n256(b.value)
-            if arm == T.SCV_I256:  # order negatives below positives
-                if (a.value.hi_hi < 0) != (b.value.hi_hi < 0):
-                    return -1 if a.value.hi_hi < 0 else 1
-            return (av > bv) - (av < bv)
-        if arm in (T.SCV_BYTES, T.SCV_STRING, T.SCV_SYMBOL):
-            av, bv = bytes(a.value), bytes(b.value)
-            charge(len(av) + len(bv), 0)
-            return (av > bv) - (av < bv)
-        if arm == T.SCV_VEC:
-            ai, bi = list(a.value or ()), list(b.value or ())
-            for x, y in zip(ai, bi):
-                r = _cmp_sc(x, y)
-                if r:
-                    return r
-            return (len(ai) > len(bi)) - (len(ai) < len(bi))
-        if arm == T.SCV_MAP:
-            ai, bi = list(a.value or ()), list(b.value or ())
-            for x, y in zip(ai, bi):
-                r = _cmp_sc(x.key, y.key)
-                if r:
-                    return r
-                r = _cmp_sc(x.val, y.val)
-                if r:
-                    return r
-            return (len(ai) > len(bi)) - (len(ai) < len(bi))
-        # fall back to canonical XDR bytes for structured leaves
-        ab_, bb_ = to_bytes(SCVal, a), to_bytes(SCVal, b)
-        charge(len(ab_) + len(bb_), 0)
-        return (ab_ > bb_) - (ab_ < bb_)
+        return cmp_scval(a, b)
 
     def _cmp_vals(a_val: int, b_val: int) -> int:
         return _cmp_sc(cv.to_scval(a_val), cv.to_scval(b_val))
@@ -824,9 +837,18 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
                      hdr.ledgerVersion if hdr is not None else 0)
 
     def fail_with_error(inst, err_val):
+        from stellar_tpu.xdr.contract import (
+            SCError, SCErrorCode, SCErrorType,
+        )
         if _tag(err_val) != TAG_ERROR:
             raise EnvError("fail_with_error needs an Error val")
         sc = cv.to_scval(err_val)
+        if sc.value.arm != SCErrorType.SCE_CONTRACT:
+            # only contract-typed errors may be raised by contracts;
+            # anything else is replaced (reference host behavior)
+            sc = SCVal.make(T.SCV_ERROR, SCError.make(
+                SCErrorType.SCE_CONTEXT,
+                SCErrorCode.SCEC_UNEXPECTED_TYPE))
         raise ContractError(
             f"contract failure: error type {sc.value.arm} "
             f"code {sc.value.value}", sc)
@@ -1054,10 +1076,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("u256 shift out of range")
-        r = _u256_of(a_val) << s
-        if r > _U256_MAX:
-            raise EnvError("u256 shl overflow")
-        return _mk_u256(r)
+        # checked_shl semantics: only the shift amount can error;
+        # bits shifted past 256 are discarded
+        return _mk_u256((_u256_of(a_val) << s) & _U256_MAX)
 
     def u256_shr(inst, a_val, s_val):
         charge(200, 0)
@@ -1071,9 +1092,10 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("i256 shift out of range")
-        r = _i256_of(a_val) << s
-        if not (_I256_MIN <= r <= _I256_MAX):
-            raise EnvError("i256 shl overflow")
+        # checked_shl: wrap into the signed 256-bit range, bits drop
+        r = (_i256_of(a_val) << s) & _U256_MAX
+        if r > _I256_MAX:
+            r -= 1 << 256
         return _mk_i256(r)
 
     def i256_shr(inst, a_val, s_val):

@@ -29,11 +29,19 @@ BEGIN = "<!-- BENCH_TABLE_BEGIN (tools/run_benchmarks.py) -->"
 END = "<!-- BENCH_TABLE_END -->"
 
 
-def run_all(quick: bool) -> dict:
+def run_all(quick: bool, verify: str = "auto") -> dict:
+    from stellar_tpu.crypto.keys import get_verifier_backend_name
     from stellar_tpu.simulation.load_generator import (
         apply_load, catchup_replay_bench, multisig_apply_load,
         scp_storm_bench, soroban_apply_load,
     )
+    if verify == "device":
+        from stellar_tpu.crypto.batch_verifier import default_verifier
+        default_verifier().install()
+    elif verify == "host":
+        from stellar_tpu.crypto import ed25519_ref
+        from stellar_tpu.crypto.keys import set_verifier_backend
+        set_verifier_backend(ed25519_ref.verify)
     scale = 0.3 if quick else 1.0
 
     def n(x):
@@ -57,6 +65,11 @@ def run_all(quick: bool) -> dict:
           file=sys.stderr)
     out["soroban_wasm"] = soroban_apply_load(
         n_ledgers=n(3), txs_per_ledger=n(500), use_wasm=True)
+    # every row names the verify backend that produced it — numbers
+    # must be attributable to a verification path (VERDICT r3 #3)
+    backend = get_verifier_backend_name()
+    for row in out.values():
+        row["verify_backend"] = backend
     return out
 
 
@@ -103,8 +116,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="~30%% scale for smoke runs")
+    ap.add_argument("--verify", choices=("auto", "device", "host"),
+                    default="auto",
+                    help="verification backend for every scenario")
     args = ap.parse_args()
-    results = run_all(args.quick)
+    results = run_all(args.quick, verify=args.verify)
     (REPO / "docs" / "benchmarks.json").write_text(
         json.dumps(results, indent=1, sort_keys=True) + "\n")
     md_path = REPO / "docs" / "benchmarks.md"
