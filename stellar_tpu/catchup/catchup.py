@@ -103,7 +103,10 @@ def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
         from stellar_tpu.herder.tx_set import prefetch_signature_batch
         from stellar_tpu.ledger.ledger_txn import LedgerTxn
         with LedgerTxn(lm.root) as ltx:
-            prefetch_signature_batch(ltx, applicable.frames)
+            # stash the triples so close_ledger re-seeds from them
+            # instead of re-collecting the whole set
+            applicable.sig_triples = prefetch_signature_batch(
+                ltx, applicable.frames)
             ltx.rollback()
         res = lm.close_ledger(LedgerCloseData(
             ledger_seq=seq, tx_set=applicable,

@@ -489,12 +489,11 @@ class ApplicableTxSetFrame:
             if bf is not None and \
                     f.inclusion_fee() < bf * max(1, f.num_operations()):
                 return False
-        prefetch_signature_batch(ltx, self.frames)
-        # close_ledger skips its own seeding pass for this set — the
-        # triples are already cached (herder-path closes would
-        # otherwise re-collect every account and re-hash every triple
-        # just to find full cache hits)
-        self.sig_cache_seeded = True
+        # keep the collected triples on the set: close_ledger re-seeds
+        # from THEM (one cheap batch call that re-verifies anything the
+        # bounded cache evicted since validation) instead of re-walking
+        # frames and re-loading accounts
+        self.sig_triples = prefetch_signature_batch(ltx, self.frames)
         from stellar_tpu.xdr.results import TransactionResultCode as TC
         # per-account chains: each tx validates against its predecessor's
         # seq num (reference ``TxSetUtils::getInvalidTxList``); gaps
@@ -569,14 +568,14 @@ class ApplicableTxSetFrame:
                 f"hash={self.hash.hex()[:8]})")
 
 
-def prefetch_signature_batch(ltx, frames) -> int:
+def prefetch_signature_batch(ltx, frames) -> list:
     """Collect every plausible (pubkey, payload, signature) triple in the
     set and verify them in one device batch, seeding the verify cache.
 
     Candidates per tx: master key + account signers of the tx source,
     every op source, the fee source (fee bumps), and extraSigners —
-    filtered by the 4-byte hint before batching. Returns the number of
-    triples shipped to the device.
+    filtered by the 4-byte hint before batching. Returns the collected
+    triples so callers can re-seed later without re-collecting.
     """
     items = []
     # one account load per DISTINCT account for the whole set — the
@@ -613,7 +612,7 @@ def prefetch_signature_batch(ltx, frames) -> int:
                 for sk in tf.extra_signers():
                     _collect_for_signer_key(sk, h, sig, items)
     batch_verify_into_cache(items)
-    return len(items)
+    return items
 
 
 def _collect_for_account(acc, h: bytes, sig, items):

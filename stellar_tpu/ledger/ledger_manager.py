@@ -246,13 +246,22 @@ class LedgerManager:
         # fallback the batch is the same sequential work plus
         # collection overhead, so apply verifies lazily instead.
         from stellar_tpu.crypto import batch_verifier, keys
-        if not getattr(lcd.tx_set, "sig_cache_seeded", False) and \
-                (keys._backend is not None or
-                 batch_verifier.device_available()):
-            from stellar_tpu.herder.tx_set import (
-                prefetch_signature_batch,
-            )
-            prefetch_signature_batch(ltx, apply_order)
+        if keys._backend is not None or \
+                batch_verifier.device_available():
+            triples = getattr(lcd.tx_set, "sig_triples", None)
+            if triples is not None:
+                # checkValid collected these already: one cheap batch
+                # call re-verifies only what the bounded cache evicted
+                from stellar_tpu.crypto.keys import (
+                    batch_verify_into_cache,
+                )
+                batch_verify_into_cache(triples)
+            else:
+                from stellar_tpu.herder.tx_set import (
+                    prefetch_signature_batch,
+                )
+                lcd.tx_set.sig_triples = \
+                    prefetch_signature_batch(ltx, apply_order)
 
         # fee phase first for ALL txs, then apply (reference
         # processFeesSeqNums before applyTransactions)

@@ -380,6 +380,116 @@ def _spill_boundary_stats(per_close_ms) -> dict:
     return out
 
 
+def soroban_compute_load(n_ledgers: int = 3, txs_per_ledger: int = 100,
+                         use_wasm: bool = False,
+                         n_iter: int = 600) -> dict:
+    """Compute-bound soroban row: each invoke runs an ``n_iter``-step
+    accumulation loop with NO host calls inside — the workload where
+    engine per-instruction cost dominates (the counter scenario is
+    host-call-bound, where both engines converge on shared host work).
+    Equivalent semantics in both engines: the wasm ``sum`` contract
+    (raw i64 loop) vs an SCVal-program loop."""
+    from stellar_tpu.soroban.host import (
+        contract_code_key, scaddress_contract, u32,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, make_tx, seed_root_with_accounts,
+    )
+    from stellar_tpu.xdr.contract import (
+        HostFunction, HostFunctionType, InvokeContractArgs,
+    )
+    import dataclasses
+    n_accounts = 50
+    srcs = [SecretKey.from_seed_str(f"sc-src-{i}")
+            for i in range(n_accounts)]
+    root = seed_root_with_accounts([(k, 10**13) for k in srcs])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.maxTxSetSize = max(2000, txs_per_ledger * 2)
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    lm.last_closed_header.ledgerVersion = CURRENT_LEDGER_PROTOCOL_VERSION
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config,
+        ledger_max_tx_count=max(1000, txs_per_ledger),
+        ledger_max_instructions=10**10, tx_max_instructions=10**7)
+    lm.root.soroban_config = lm.soroban_config
+
+    if use_wasm:
+        from stellar_tpu.soroban.example_contracts import sum_wasm
+        code = sum_wasm()
+    else:
+        from stellar_tpu.soroban.example_contracts import (
+            sum_scval_program,
+        )
+        code = sum_scval_program()
+    owner = srcs[0]
+    seqs = {k.public_key.raw: (1 << 32) for k in srcs}
+    seqs[owner.public_key.raw] += 2
+    up, create, contract_id, code_hash, inst_key = _deploy_frames(
+        owner, seqs[owner.public_key.raw] - 1,
+        seqs[owner.public_key.raw], code, TEST_NETWORK_ID,
+        salt=b"\x67" * 32)
+    addr = scaddress_contract(contract_id)
+    for setup in ([up], [create]):
+        txset, _ = make_tx_set_from_transactions(
+            setup, lm.last_closed_header, lm.last_closed_hash,
+            soroban_config=lm.soroban_config)
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5))
+        if res.failed_count:
+            raise RuntimeError("compute load setup failed")
+
+    from stellar_tpu.utils.metrics import Timer
+    close_timer = Timer()
+    total = 0
+    for _ in range(n_ledgers):
+        frames = []
+        for t in range(txs_per_ledger):
+            src = srcs[t % n_accounts]
+            seqs[src.public_key.raw] += 1
+            frames.append(make_tx(
+                src, seqs[src.public_key.raw],
+                [_soroban_op(HostFunction.make(
+                    HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+                    InvokeContractArgs(
+                        contractAddress=addr, functionName=b"sum",
+                        args=[u32(n_iter)])))],
+                fee=8_000_000,
+                soroban_data=_soroban_data(
+                    read_only=[inst_key, contract_code_key(code_hash)],
+                    instructions=8_000_000)))
+        txset, excluded = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash,
+            soroban_config=lm.soroban_config)
+        if excluded:
+            raise RuntimeError(f"{len(excluded)} compute txs excluded")
+        with close_timer.time():
+            res = lm.close_ledger(LedgerCloseData(
+                lm.ledger_seq + 1, txset,
+                lm.last_closed_header.scpValue.closeTime + 5))
+        if res.failed_count:
+            raise RuntimeError(
+                f"compute load failures: {res.failed_count}")
+        total += res.applied_count
+    stats = close_timer.to_dict()
+    from stellar_tpu.soroban import native_wasm
+    engine = ("wasm-native" if use_wasm and native_wasm.available()
+              else "wasm-py" if use_wasm else "scval")
+    return {
+        "scenario": "soroban_compute",
+        "engine": engine,
+        "ledgers": n_ledgers,
+        "txs_per_ledger": txs_per_ledger,
+        "loop_iterations": n_iter,
+        "total_applied": total,
+        "close_mean_ms": stats["mean_ms"],
+        "close_max_ms": stats["max_ms"],
+        "txs_per_sec": round(
+            total / (stats["mean_ms"] * n_ledgers / 1000.0), 1)
+        if stats["mean_ms"] else 0.0,
+    }
+
+
 def multisig_apply_load(n_ledgers: int = 5, txs_per_ledger: int = 1000,
                         extra_signers: int = 1) -> dict:
     """BASELINE config #2: 1,000-tx multi-signer payment sets — every tx
@@ -519,6 +629,9 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
         from stellar_tpu.soroban.example_contracts import counter_wasm
         code = counter_wasm()  # auth_incr(addr): same ABI as below
     else:
+        # same semantic workload as the wasm counter (auth + has/get/
+        # put + an ``incr`` event with the new count) so the two
+        # benchmark rows compare engines, not contracts
         code = assemble_program({
             "auth_incr": [
                 ins("arg", u32(0)), ins("require_auth"),
@@ -528,8 +641,12 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                 ins("jmp", u32(1)),
                 ins("push", u32(0)),
                 ins("push", u32(1)), ins("add"),
+                ins("dup"),
                 ins("push", sym("count")), ins("swap"),
                 ins("put", sym("persistent")),
+                ins("dup"),
+                ins("push", sym("incr")), ins("swap"),
+                ins("event"),
                 ins("ret"),
             ],
         })

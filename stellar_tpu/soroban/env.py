@@ -84,6 +84,23 @@ _SYM_CODE = {c: i + 1 for i, c in enumerate(_SYM_CHARS)}
 _SYM_CHAR = {i + 1: c for i, c in enumerate(_SYM_CHARS)}
 
 
+_SHORTS_CACHE = None
+
+
+def _SHORTS():
+    """Memoized long->(module, export-char) registry map; rebuilt
+    per-frame it costs more than every handler the frame calls."""
+    global _SHORTS_CACHE
+    if _SHORTS_CACHE is None:
+        from stellar_tpu.soroban.env_interface import long_to_short
+        _SHORTS_CACHE = long_to_short()
+        # registry sanity: module chars agree with the handler table
+        from stellar_tpu.soroban.env_interface import MODULES
+        for name, (mod, _c) in _SHORTS_CACHE.items():
+            assert mod in MODULES
+    return _SHORTS_CACHE
+
+
 class EnvError(Trap):
     """Host-env failure surfaced to wasm as a trap."""
 
@@ -110,10 +127,15 @@ def _make(tag: int, body: int = 0) -> int:
     return ((body & _M56) << 8) | tag
 
 
-def cmp_scval(a, b) -> int:
+def cmp_scval(a, b, charge=None) -> int:
     """Deep total order over SCVals — the order obj_cmp exposes, map
     entries sort by, and from_scval validates on map ingestion (the
-    genuine host rejects out-of-order maps at conversion)."""
+    genuine host rejects out-of-order maps at conversion).
+    ``charge(cpu, mem)`` meters size-proportional comparison work so
+    the instruction budget bounds real CPU (a flat per-call fee would
+    let large-object compares run unmetered)."""
+    if charge is not None:
+        charge(50, 0)
     if a.arm != b.arm:
         return -1 if a.arm < b.arm else 1
     arm = a.arm
@@ -136,25 +158,29 @@ def cmp_scval(a, b) -> int:
         return (av > bv) - (av < bv)
     if arm in (T.SCV_BYTES, T.SCV_STRING, T.SCV_SYMBOL):
         av, bv = bytes(a.value), bytes(b.value)
+        if charge is not None:
+            charge(len(av) + len(bv), 0)
         return (av > bv) - (av < bv)
     if arm == T.SCV_VEC:
         ai, bi = list(a.value or ()), list(b.value or ())
         for x, y in zip(ai, bi):
-            r = cmp_scval(x, y)
+            r = cmp_scval(x, y, charge)
             if r:
                 return r
         return (len(ai) > len(bi)) - (len(ai) < len(bi))
     if arm == T.SCV_MAP:
         ai, bi = list(a.value or ()), list(b.value or ())
         for x, y in zip(ai, bi):
-            r = cmp_scval(x.key, y.key)
+            r = cmp_scval(x.key, y.key, charge)
             if r:
                 return r
-            r = cmp_scval(x.val, y.val)
+            r = cmp_scval(x.val, y.val, charge)
             if r:
                 return r
         return (len(ai) > len(bi)) - (len(ai) < len(bi))
     ab_, bb_ = to_bytes(SCVal, a), to_bytes(SCVal, b)
+    if charge is not None:
+        charge(len(ab_) + len(bb_), 0)
     return (ab_ > bb_) - (ab_ < bb_)
 
 
@@ -297,7 +323,8 @@ class ValConverter:
             # conversion
             entries = list(v.value or ())
             for i in range(1, len(entries)):
-                if cmp_scval(entries[i - 1].key, entries[i].key) >= 0:
+                if cmp_scval(entries[i - 1].key, entries[i].key,
+                             self.charge) >= 0:
                     raise EnvError("map keys not sorted-unique")
             pairs = [(self.from_scval(e.key), self.from_scval(e.val))
                      for e in entries]
@@ -784,7 +811,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     # below also registers under its single-char export name)
     # =====================================================================
 
-    charge = env.host.budget.charge
+    # identity-stable across env.reset() (frame pooling): forwards to
+    # the CURRENT frame's budget
+    charge = env.charge
 
     def _bytes_of(val):
         return cv.obj(val, TAG_BYTES_OBJ)
@@ -803,8 +832,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     # ---- deep total order (obj_cmp and the vec search family) ----
 
     def _cmp_sc(a, b) -> int:
-        charge(50, 0)
-        return cmp_scval(a, b)
+        return cmp_scval(a, b, charge)
 
     def _cmp_vals(a_val: int, b_val: int) -> int:
         return _cmp_sc(cv.to_scval(a_val), cv.to_scval(b_val))
@@ -2052,14 +2080,11 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         "prng_vec_shuffle": ("p", prng_vec_shuffle),
     }
 
-    from stellar_tpu.soroban.env_interface import long_to_short
     table: Dict[Tuple[str, str], Callable] = {}
-    shorts = long_to_short()
+    shorts = _SHORTS()
     for long_name, (mod, fn) in canonical.items():
         table[(mod, long_name)] = fn
-        smod, schar = shorts[long_name]
-        assert smod == mod, f"module mismatch for {long_name}"
-        table[(mod, schar)] = fn
+        table[(mod, shorts[long_name][1])] = fn
 
     # historical aliases (this repo's earlier internal dialect, kept
     # for wasm_builder contracts already pinned in goldens/fixtures)

@@ -104,6 +104,59 @@ def counter_wasm() -> bytes:
     return b.build()
 
 
+def sum_wasm() -> bytes:
+    """Compute-bound contract: ``sum(n)`` iterates ``n`` times
+    accumulating ``1 + 2 + ... + n`` in raw i64 arithmetic and returns
+    it as a U32 val. No host calls inside the loop — this is the
+    shape where a native engine's per-instruction cost dominates (the
+    benchmark counterpart of the host-call-bound counter).
+    ``sum_scval_program()`` is its semantic twin for the interpreter."""
+    b = ModuleBuilder()
+    b.add_memory(1)
+    c = Code()
+    # local0 = arg (U32Val n), local1 = i (raw), local2 = acc (raw)
+    c.local_get(0).i64_const(8).i64_shr_u().local_set(1)
+    c.block(0x40)
+    c.local_get(1).i64_eqz().br_if(0)
+    c.loop(0x40)
+    c.local_get(2).local_get(1).i64_add().local_set(2)
+    c.local_get(1).i64_const(1).i64_sub().local_tee(1)
+    c.i64_const(0).i64_ne().br_if(0)
+    c.end()
+    c.end()
+    # U32 val: (acc << 8) | 4 — same return arm as the scval twin
+    c.local_get(2).i64_const(8).i64_shl().i64_const(4).i64_or()
+    c.end()
+    b.add_func([I64], [I64], [I64, I64], c, export="sum")
+    return b.build()
+
+
+def sum_scval_program() -> bytes:
+    """The SCVal-interpreter twin of :func:`sum_wasm`: ``sum(n)``
+    returns ``1 + 2 + ... + n`` as a U32. Loop invariant on the stack
+    is ``[acc, i]`` with ``i`` counting down; 9 interpreted
+    instructions per iteration."""
+    from stellar_tpu.soroban.host import assemble_program, ins, sym, u32
+    from stellar_tpu.xdr.contract import SCVal, SCValType
+    return assemble_program({
+        "sum": [
+            ins("push", u32(0)),                     # 0: [acc]
+            ins("arg", u32(0)),                      # 1: [acc, i=n]
+            ins("dup"),                              # 2: loop top
+            ins("jz", u32(7)),                       # 3: i==0 -> 11
+            ins("swap"),                             # 4: [i, acc]
+            ins("over"),                             # 5: [i, acc, i]
+            ins("add"),                              # 6: [i, acc+i]
+            ins("swap"),                             # 7: [acc', i]
+            ins("push", u32(1)),                     # 8
+            ins("sub"),                              # 9: [acc', i-1]
+            ins("jmp", SCVal.make(SCValType.SCV_I32, -9)),  # 10 -> 2
+            ins("drop"),                             # 11: [acc']
+            ins("ret"),                              # 12
+        ],
+    })
+
+
 def ttl_wasm() -> bytes:
     """TTL-exercising contract: ``setup()`` writes a persistent entry;
     ``bump(threshold, extend_to)`` extends that entry's TTL from inside

@@ -521,6 +521,9 @@ class _Interp:
                 stack.pop()
             elif op == b"swap":
                 stack[-1], stack[-2] = stack[-2], stack[-1]
+            elif op == b"over":
+                # copy the second item to the top: [a, b] -> [a, b, a]
+                stack.append(stack[-2])
             elif op in (b"add", b"sub", b"mul", b"div", b"mod"):
                 rhs, lhs = stack.pop(), stack.pop()
                 stack.append(self._arith(op, lhs, rhs))
@@ -1058,9 +1061,15 @@ def _parsed_module(code: bytes):
 
 class WasmContractEnv:
     """Per-contract-frame bridge between the wasm host imports
-    (``soroban/env.py``) and the shared ``_Host`` services. A fresh
-    env (and so a fresh Val object table) is created per frame;
-    handles never leak across contract boundaries."""
+    (``soroban/env.py``) and the shared ``_Host`` services. Envs (and
+    their import tables, ~140 closures) are POOLED per thread and
+    reset per frame — the Val object table is cleared on acquire, so
+    handles still never leak across contract boundaries.
+
+    Everything the import-table closures capture must stay
+    identity-stable across a reset: the env itself, its ValConverter,
+    and the ``charge`` indirection below (the budget it forwards to is
+    re-pointed on acquire)."""
 
     def __init__(self, host: "_Host", contract_addr, invocation,
                  depth: int):
@@ -1069,8 +1078,22 @@ class WasmContractEnv:
         self.contract_addr = contract_addr
         self.invocation = invocation
         self.depth = depth
-        self.cv = ValConverter(host.budget.charge)
+        self.cv = ValConverter(self.charge)
         self.prng = None  # per-frame stream, forked on first use
+
+    def charge(self, cpu: int, mem: int = 0):
+        # stable bound method: closures capture THIS, the budget
+        # behind it follows the host of the current frame
+        self.host.budget.charge(cpu, mem)
+
+    def reset(self, host: "_Host", contract_addr, invocation,
+              depth: int):
+        self.host = host
+        self.contract_addr = contract_addr
+        self.invocation = invocation
+        self.depth = depth
+        self.cv.objs.clear()
+        self.prng = None
 
     # storage bridges
     def data_put(self, key_sc, val_sc, dur):
@@ -1092,6 +1115,40 @@ class WasmContractEnv:
         self.host.instance_del(self.contract_addr, key_sc)
 
 
+import threading as _threading
+
+_env_pool = _threading.local()
+
+
+def _acquire_wasm_env(host: "_Host", contract_addr, invocation,
+                      depth: int):
+    """(env, modern import table) from the per-thread pool — building
+    the table is ~100us of closure construction, pure overhead when
+    paid per frame. Nested frames pop deeper entries; release returns
+    them."""
+    free = getattr(_env_pool, "free", None)
+    if free is None:
+        free = _env_pool.free = []
+    if free:
+        env, imports = free.pop()
+        env.reset(host, contract_addr, invocation, depth)
+        return env, imports
+    from stellar_tpu.soroban.env import make_imports
+    env = WasmContractEnv(host, contract_addr, invocation, depth)
+    return env, make_imports(env)
+
+
+def _release_wasm_env(env, imports):
+    # drop every reference to the finished frame — a pooled idle env
+    # must not pin the invoke's host, auth tree, or PRNG state alive
+    env.cv.objs.clear()
+    env.host = None
+    env.contract_addr = None
+    env.invocation = None
+    env.prng = None
+    _env_pool.free.append((env, imports))
+
+
 def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
                        fn_name: bytes, args: List, invocation,
                        depth: int):
@@ -1103,7 +1160,6 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
         module = _parsed_module(code)
     except WasmError as e:
         raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
-    env = WasmContractEnv(host, contract_addr, invocation, depth)
     budget = host.budget
 
     def charge(n_insns: int):
@@ -1112,6 +1168,7 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
     def mem_charge(n_bytes: int):
         budget.charge(0, n_bytes)
 
+    pooled = None
     try:
         try:
             fn = fn_name.decode("utf-8")
@@ -1123,11 +1180,15 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
         if is_legacy_module(module):
             # pre-1.0 fixture dialect: 4-bit-tag RawVals + the tiny
             # early import surface; same engines, different codec
+            env = WasmContractEnv(host, contract_addr, invocation,
+                                  depth)
             imports = make_legacy_imports(env)
             vals = [to_rawval(a) for a in args]
             decode = from_rawval
         else:
-            imports = make_imports(env)
+            env, imports = _acquire_wasm_env(host, contract_addr,
+                                             invocation, depth)
+            pooled = (env, imports)
             vals = [env.cv.from_scval(a) for a in args]
             decode = env.cv.to_scval
         if USE_NATIVE_WASM:
@@ -1159,6 +1220,9 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
         # panics at the FFI boundary the same way)
         raise HostError(HostError.TRAPPED,
                         f"host internal error: {type(e).__name__}: {e}")
+    finally:
+        if pooled is not None:
+            _release_wasm_env(*pooled)
 
 
 def _upload(host: "_Host", code: bytes, read_write: set):

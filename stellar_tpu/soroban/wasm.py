@@ -165,19 +165,24 @@ class WasmModule:
         # "contractenvmetav0" section carries the env interface version
         # the contract was compiled against
         self.customs: Dict[str, bytes] = {}
+        self._env_meta: Tuple = ()  # lazily-computed cache
 
     @property
     def env_meta_version(self) -> Optional[int]:
         """Interface version from the contractenvmetav0 custom section
         (SCEnvMetaEntry: u32 kind 0 + u64 version), or None if absent.
         Modern SDK builds encode ``protocol << 32 | prerelease``; the
-        reference's testdata fixtures carry small pre-1.0 versions."""
+        reference's testdata fixtures carry small pre-1.0 versions.
+        Cached — the dialect check runs on every invoke."""
+        if self._env_meta:
+            return self._env_meta[0]
         body = self.customs.get("contractenvmetav0")
-        if body is None or len(body) < 12:
-            return None
-        if int.from_bytes(body[:4], "big") != 0:
-            return None
-        return int.from_bytes(body[4:12], "big")
+        version = None
+        if body is not None and len(body) >= 12 and \
+                int.from_bytes(body[:4], "big") == 0:
+            version = int.from_bytes(body[4:12], "big")
+        self._env_meta = (version,)
+        return version
 
     def func_type(self, func_idx: int) -> FuncType:
         """Type of function ``func_idx`` in the unified index space

@@ -133,6 +133,7 @@ class TransactionFrame:
         self.signatures: Sequence[DecoratedSignature] = \
             envelope.value.signatures
         self._hash: Optional[bytes] = None
+        self._size: Optional[int] = None
         self.op_frames = [make_op_frame(op, self, i)
                           for i, op in enumerate(self.tx.operations)]
 
@@ -166,8 +167,12 @@ class TransactionFrame:
 
     def size_bytes(self) -> int:
         """Envelope wire size (feeds bandwidth/historical resource
-        fees)."""
-        return len(to_bytes(TransactionEnvelope, self.envelope))
+        fees). Memoized: the envelope is immutable and fee/surge
+        paths ask several times per close."""
+        if self._size is None:
+            self._size = len(to_bytes(TransactionEnvelope,
+                                      self.envelope))
+        return self._size
 
     def note_soroban_consumption(self, refundable_consumed: int, events):
         """Called by the Soroban op frame after the host ran: how much

@@ -1,0 +1,115 @@
+"""Scenario-level perf budgets + engine-comparison guards (VERDICT r3
+#5). Budgets are deliberately loose enough for noisy CI machines —
+they catch order-of-magnitude regressions (an accidentally quadratic
+close, a de-cached parse), not single-digit drift; the ratio guard
+pins the STRUCTURAL property that the native wasm engine beats the
+SCVal interpreter on compute-bound contracts."""
+
+import pytest
+
+from stellar_tpu.soroban import native_wasm
+
+
+def test_sum_contract_correct_both_engines():
+    """sum(100) == 5050 through the full invoke path, both engines."""
+    from stellar_tpu.soroban import host as host_mod
+    from stellar_tpu.simulation.load_generator import (
+        soroban_compute_load,
+    )
+    # the loadgen asserts zero failures internally; run each engine
+    r1 = soroban_compute_load(n_ledgers=1, txs_per_ledger=5,
+                              n_iter=100)
+    assert r1["total_applied"] == 5
+    r2 = soroban_compute_load(n_ledgers=1, txs_per_ledger=5,
+                              use_wasm=True, n_iter=100)
+    assert r2["total_applied"] == 5
+
+
+def test_sum_return_value():
+    """BOTH engines return the exact accumulation — the compute rows
+    compare engines, not contracts, and this enforces it."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.example_contracts import (
+        sum_scval_program, sum_wasm,
+    )
+    from stellar_tpu.soroban.host import (
+        _wrap_entry, contract_code_key, contract_data_key,
+        invoke_host_function, make_instance_val,
+    )
+    from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+    from stellar_tpu.tx.tx_test_utils import TEST_NETWORK_ID, keypair
+    from stellar_tpu.xdr.contract import (
+        ContractCodeEntry, ContractDataDurability, ContractDataEntry,
+        HostFunction, HostFunctionType, InvokeContractArgs, SCVal,
+        SCValType, contract_address,
+    )
+    from stellar_tpu.xdr.types import (
+        ExtensionPoint, LedgerEntryType, account_id,
+    )
+    T = SCValType
+    kp = keypair("sum-check")
+    for code in (sum_wasm(), sum_scval_program()):
+        code_hash = sha256(code)
+        addr = contract_address(b"\x33" * 32)
+        inst_key = contract_data_key(
+            addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            ContractDataDurability.PERSISTENT)
+        inst_entry = ContractDataEntry(
+            ext=ExtensionPoint.make(0), contract=addr,
+            key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            durability=ContractDataDurability.PERSISTENT,
+            val=make_instance_val(code_hash))
+        code_entry = ContractCodeEntry(
+            ext=ContractCodeEntry._types[0].make(0), hash=code_hash,
+            code=code)
+        fp = {
+            key_bytes(inst_key): (_wrap_entry(
+                LedgerEntryType.CONTRACT_DATA, inst_entry, 1), None),
+            key_bytes(contract_code_key(code_hash)): (_wrap_entry(
+                LedgerEntryType.CONTRACT_CODE, code_entry, 1), None),
+        }
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"sum",
+                               args=[SCVal.make(T.SCV_U32, 100)]))
+        out = invoke_host_function(
+            fn, fp, set(fp), set(), [], account_id(kp.public_key.raw),
+            TEST_NETWORK_ID, 10, default_soroban_config())
+        assert out.success, out.error
+        assert out.return_value.arm == T.SCV_U32
+        assert out.return_value.value == 5050
+
+
+def test_compute_bound_native_beats_scval():
+    """Structural guard: on a host-call-free loop the native wasm
+    engine must beat the SCVal interpreter by a wide margin (the
+    per-instruction advantage the engine exists for). Skipped when
+    only the Python wasm engine is available."""
+    if not native_wasm.available():
+        pytest.skip("native engine not built")
+    from stellar_tpu.simulation.load_generator import (
+        soroban_compute_load,
+    )
+    scval = soroban_compute_load(n_ledgers=2, txs_per_ledger=40,
+                                 n_iter=600)
+    wasm = soroban_compute_load(n_ledgers=2, txs_per_ledger=40,
+                                use_wasm=True, n_iter=600)
+    assert wasm["engine"] == "wasm-native"
+    # 4x+ in practice; 1.5x floor keeps the guard noise-proof
+    assert wasm["txs_per_sec"] > 1.5 * scval["txs_per_sec"], (
+        wasm["txs_per_sec"], scval["txs_per_sec"])
+
+
+def test_soroban_close_latency_budget():
+    """500-tx soroban ledgers must close well inside the 5s cadence —
+    order-of-magnitude guard at 3s mean on CI-class hosts (measured
+    ~1.05s; the on-device target is <500ms with the verify batch on
+    the TPU)."""
+    from stellar_tpu.simulation.load_generator import (
+        soroban_apply_load,
+    )
+    r = soroban_apply_load(n_ledgers=2, txs_per_ledger=500,
+                           use_wasm=True)
+    assert r["close_mean_ms"] <= 3000.0, r["close_mean_ms"]

@@ -65,6 +65,15 @@ def run_all(quick: bool, verify: str = "auto") -> dict:
           file=sys.stderr)
     out["soroban_wasm"] = soroban_apply_load(
         n_ledgers=n(3), txs_per_ledger=n(500), use_wasm=True)
+    print("[5c] soroban compute-bound (both engines)...",
+          file=sys.stderr)
+    from stellar_tpu.simulation.load_generator import (
+        soroban_compute_load,
+    )
+    out["soroban_compute_scval"] = soroban_compute_load(
+        n_ledgers=n(3), txs_per_ledger=n(100))
+    out["soroban_compute_wasm"] = soroban_compute_load(
+        n_ledgers=n(3), txs_per_ledger=n(100), use_wasm=True)
     # every row names the verify backend that produced it — numbers
     # must be attributable to a verification path (VERDICT r3 #3)
     backend = get_verifier_backend_name()
@@ -100,6 +109,12 @@ def render_table(results: dict) -> str:
          f"{results['soroban_wasm']['close_mean_ms']} ms mean close, "
          f"{results['soroban_wasm']['txs_per_sec']} tx/s "
          f"({results['soroban_wasm']['engine']})"),
+        ("soroban compute-bound",
+         f"{results['soroban_compute_wasm']['txs_per_sec']} tx/s "
+         f"wasm-native vs "
+         f"{results['soroban_compute_scval']['txs_per_sec']} tx/s "
+         f"scval ({results['soroban_compute_wasm']['loop_iterations']}"
+         "-iteration loop)"),
     ]
     lines = [BEGIN, "",
              f"Generated {date.today()} on {platform.machine()} "
