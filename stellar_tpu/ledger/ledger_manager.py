@@ -260,8 +260,10 @@ class LedgerManager:
                 from stellar_tpu.herder.tx_set import (
                     prefetch_signature_batch,
                 )
-                lcd.tx_set.sig_triples = \
-                    prefetch_signature_batch(ltx, apply_order)
+                prefetch_signature_batch(ltx, apply_order)
+            # the herder remembers closed/losing sets for several
+            # slots — don't pin megabytes of consumed triples there
+            lcd.tx_set.sig_triples = None
 
         # fee phase first for ALL txs, then apply (reference
         # processFeesSeqNums before applyTransactions)

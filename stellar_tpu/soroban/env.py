@@ -94,10 +94,6 @@ def _SHORTS():
     if _SHORTS_CACHE is None:
         from stellar_tpu.soroban.env_interface import long_to_short
         _SHORTS_CACHE = long_to_short()
-        # registry sanity: module chars agree with the handler table
-        from stellar_tpu.soroban.env_interface import MODULES
-        for name, (mod, _c) in _SHORTS_CACHE.items():
-            assert mod in MODULES
     return _SHORTS_CACHE
 
 
@@ -2083,8 +2079,13 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     table: Dict[Tuple[str, str], Callable] = {}
     shorts = _SHORTS()
     for long_name, (mod, fn) in canonical.items():
+        smod, schar = shorts[long_name]
+        # a handler filed under a different module than the registry
+        # would otherwise register its short name under the wrong key
+        # and fail only at contract link time
+        assert smod == mod, f"module mismatch for {long_name}"
         table[(mod, long_name)] = fn
-        table[(mod, shorts[long_name][1])] = fn
+        table[(mod, schar)] = fn
 
     # historical aliases (this repo's earlier internal dialect, kept
     # for wasm_builder contracts already pinned in goldens/fixtures)

@@ -11,8 +11,9 @@ from stellar_tpu.soroban import native_wasm
 
 
 def test_sum_contract_correct_both_engines():
-    """sum(100) == 5050 through the full invoke path, both engines."""
-    from stellar_tpu.soroban import host as host_mod
+    """Both engines run the compute workload through the full close
+    pipeline with zero failures (the exact 5050 return value is
+    asserted by test_sum_return_value via direct invoke)."""
     from stellar_tpu.simulation.load_generator import (
         soroban_compute_load,
     )
