@@ -261,8 +261,10 @@ class LedgerManager:
                     prefetch_signature_batch,
                 )
                 prefetch_signature_batch(ltx, apply_order)
-            # the herder remembers closed/losing sets for several
-            # slots — don't pin megabytes of consumed triples there
+        # the herder remembers closed/losing sets for several slots —
+        # don't pin megabytes of consumed triples there (checkValid
+        # stores them unconditionally, so clear unconditionally too)
+        if getattr(lcd.tx_set, "sig_triples", None) is not None:
             lcd.tx_set.sig_triples = None
 
         # fee phase first for ALL txs, then apply (reference

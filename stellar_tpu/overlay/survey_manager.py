@@ -9,27 +9,13 @@ encrypted to the surveyor's ephemeral curve25519 key so relaying peers
 learn nothing. Responses flood back and the surveyor accumulates them
 in ``results``.
 
-Encryption — EXPLICIT COMPATIBILITY DECISION (r3): the encrypted
-response body uses an ECIES-style sealed box over this framework's
-curve25519 (HKDF-SHA256 keystream + HMAC-SHA256 tag) rather than
-libsodium's ``crypto_box_seal`` (X25519 + XSalsa20-Poly1305). This is
-a deliberate wire-format fork of ONE field, scoped and safe because:
-
-1. the surveyor and the surveyed node are the only parties that ever
-   read the field — relay nodes treat it as opaque bytes, so mixed
-   fleets still relay each other's surveys correctly;
-2. a survey is operator tooling run against one's own fleet (the
-   surveyor key allowlist gates it), so both endpoints are the same
-   implementation in every supported deployment;
-3. the survey *protocol* — message flow, signatures over the
-   HashIDPreimage payloads, nonce/phase state machine, XDR shapes —
-   IS wire-compatible; only the sealed-box cipher differs;
-4. security properties match (ephemeral ECDH, authenticated
-   encryption, relaying peers learn nothing).
-
-If cross-implementation surveys are ever required, the seam is
-``seal_box``/``open_box`` below: swap in an XSalsa20-Poly1305
-implementation and the rest of the module is unchanged.
+Encryption (r4, resolving the r3 wire-format fork): the encrypted
+response body now uses the genuine libsodium ``crypto_box_seal``
+construction — X25519 + HSalsa20 key derivation + XSalsa20-Poly1305
+secretbox with the BLAKE2b-192(eph_pub || recipient_pub) nonce
+(``crypto/nacl_box.py``) — byte-compatible with the reference's
+``curve25519Decrypt`` path (``src/crypto/Curve25519.cpp``), so mixed
+fleets can survey across implementations.
 """
 
 from __future__ import annotations
@@ -62,45 +48,22 @@ SURVEY_THROTTLE_PER_LEDGER = 10  # reference request rate cap
 # Sealed boxes
 # ---------------------------------------------------------------------------
 
-def _keystream(key: bytes, n: int) -> bytes:
-    out = b""
-    counter = 0
-    while len(out) < n:
-        out += c25519.hmac_sha256(key, b"ks" + counter.to_bytes(4, "big"))
-        counter += 1
-    return out[:n]
-
-
 def seal_box(recipient_pub: bytes, plaintext: bytes) -> bytes:
-    """Anonymous sealed box: eph_pub || ciphertext || tag."""
-    eph_secret = c25519.random_secret()
-    eph_pub = c25519.public_from_secret(eph_secret)
-    shared = c25519.scalarmult(eph_secret, recipient_pub)
-    prk = c25519.hkdf_extract(shared + eph_pub + recipient_pub)
-    enc_key = c25519.hkdf_expand(prk, b"survey-enc")
-    mac_key = c25519.hkdf_expand(prk, b"survey-mac")
-    ct = bytes(a ^ b for a, b in
-               zip(plaintext, _keystream(enc_key, len(plaintext))))
-    tag = c25519.hmac_sha256(mac_key, ct)
-    return eph_pub + ct + tag
+    """libsodium ``crypto_box_seal``: eph_pub || XSalsa20-Poly1305
+    box keyed by HSalsa20(X25519(eph, recipient)) with the
+    BLAKE2b-192(eph_pub || recipient_pub) nonce."""
+    from stellar_tpu.crypto.nacl_box import seal
+    return seal(plaintext, recipient_pub)
 
 
 def open_box(recipient_secret: bytes, sealed: bytes) -> Optional[bytes]:
-    if len(sealed) < 64:
-        return None
-    eph_pub, ct, tag = sealed[:32], sealed[32:-32], sealed[-32:]
+    from stellar_tpu.crypto.nacl_box import seal_open
     recipient_pub = c25519.public_from_secret(recipient_secret)
     try:
-        shared = c25519.scalarmult(recipient_secret, eph_pub)
+        return seal_open(sealed, recipient_secret, recipient_pub)
     except Exception:
+        # bad point / short box / bad tag — all just "not for us"
         return None
-    prk = c25519.hkdf_extract(shared + eph_pub + recipient_pub)
-    mac_key = c25519.hkdf_expand(prk, b"survey-mac")
-    if not c25519.verify_hmac_sha256(mac_key, ct, tag):
-        return None
-    enc_key = c25519.hkdf_expand(prk, b"survey-enc")
-    return bytes(a ^ b for a, b in
-                 zip(ct, _keystream(enc_key, len(ct))))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,176 @@
+"""libsodium sealed-box construction (VERDICT r3 #7 — the survey
+cipher now IS crypto_box_seal). No libsodium/PyNaCl ships in this
+image, so the primitives are pinned by independent means:
+
+- Salsa20 rounds: differential against OpenSSL's scrypt
+  (``hashlib.scrypt``'s BlockMix runs Salsa20/8 over the same core —
+  a hand-built scrypt using OUR core must reproduce OpenSSL's output).
+- Poly1305: the RFC 8439 §2.5.2 vector.
+- quarterround: the Salsa20 spec examples.
+- X25519: differential against the ``cryptography`` package.
+- secretbox/seal: roundtrips, tamper detection, wire layout.
+"""
+
+import hashlib
+import struct
+
+import pytest
+
+from stellar_tpu.crypto import curve25519 as c25519
+from stellar_tpu.crypto.nacl_box import (
+    BoxError, _quarterround, box_beforenm, hsalsa20, poly1305,
+    salsa20_core, seal, seal_open, secretbox, secretbox_open,
+    xsalsa20_xor,
+)
+
+
+def test_quarterround_spec_examples():
+    # Salsa20 spec section 3 examples
+    assert _quarterround(0, 0, 0, 0) == (0, 0, 0, 0)
+    assert _quarterround(1, 0, 0, 0) == \
+        (0x08008145, 0x00000080, 0x00010200, 0x20500000)
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex("85d6be7857556d337f4452fe42d506a8"
+                        "0103808afb0db2fd4abff6af4149f51b")
+    msg = b"Cryptographic Forum Research Group"
+    assert poly1305(msg, key).hex() == \
+        "a8061dc1305136c6c22b8baf0c0127a9"
+
+
+# ---------------------------------------------------------------------------
+# Salsa20 core vs OpenSSL scrypt
+# ---------------------------------------------------------------------------
+
+def _blockmix(B, r):
+    X = B[-1]
+    out = []
+    for i in range(2 * r):
+        X = salsa20_core(bytes(a ^ b for a, b in zip(X, B[i])),
+                         rounds=8)
+        out.append(X)
+    return [out[i * 2] for i in range(r)] + \
+        [out[i * 2 + 1] for i in range(r)]
+
+
+def _romix(B, N, r):
+    X = list(B)
+    V = []
+    for _ in range(N):
+        V.append(list(X))
+        X = _blockmix(X, r)
+    for _ in range(N):
+        j = struct.unpack("<I", X[2 * r - 1][:4])[0] % N
+        X = _blockmix([bytes(a ^ b for a, b in zip(X[k], V[j][k]))
+                       for k in range(2 * r)], r)
+    return X
+
+
+def _scrypt_with_our_core(password, salt, n, r, p, dklen):
+    B = hashlib.pbkdf2_hmac("sha256", password, salt, 1, p * 128 * r)
+    out = b""
+    for i in range(p):
+        blk = B[i * 128 * r:(i + 1) * 128 * r]
+        chunks = [blk[j * 64:(j + 1) * 64] for j in range(2 * r)]
+        out += b"".join(_romix(chunks, n, r))
+    return hashlib.pbkdf2_hmac("sha256", password, out, 1, dklen)
+
+
+@pytest.mark.parametrize("pw,salt,n,r,p", [
+    (b"pw", b"salt", 4, 2, 2),
+    (b"another password", b"NaCl-box-test", 8, 1, 1),
+])
+def test_salsa_core_differential_vs_openssl_scrypt(pw, salt, n, r, p):
+    assert _scrypt_with_our_core(pw, salt, n, r, p, 32) == \
+        hashlib.scrypt(pw, salt=salt, n=n, r=r, p=p, dklen=32)
+
+
+# ---------------------------------------------------------------------------
+# X25519 differential + box construction
+# ---------------------------------------------------------------------------
+
+def test_x25519_differential():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+    for _ in range(3):
+        a = X25519PrivateKey.generate()
+        b = X25519PrivateKey.generate()
+        a_raw = a.private_bytes(serialization.Encoding.Raw,
+                                serialization.PrivateFormat.Raw,
+                                serialization.NoEncryption())
+        b_pub = b.public_key().public_bytes(
+            serialization.Encoding.Raw,
+            serialization.PublicFormat.Raw)
+        assert c25519.scalarmult(a_raw, b_pub) == \
+            a.exchange(b.public_key())
+
+
+def test_hsalsa20_properties():
+    # deterministic, key-sensitive, input-sensitive
+    k, n16 = b"\x01" * 32, b"\x02" * 16
+    out = hsalsa20(k, n16)
+    assert len(out) == 32
+    assert out == hsalsa20(k, n16)
+    assert out != hsalsa20(b"\x03" * 32, n16)
+    assert out != hsalsa20(k, b"\x04" * 16)
+
+
+def test_xsalsa20_stream_xor_involution():
+    key, nonce = b"\x05" * 32, b"\x06" * 24
+    msg = bytes(range(200))
+    ct = xsalsa20_xor(msg, nonce, key)
+    assert ct != msg
+    assert xsalsa20_xor(ct, nonce, key) == msg
+
+
+def test_secretbox_roundtrip_and_tamper():
+    key, nonce = b"\x07" * 32, b"\x08" * 24
+    msg = b"the quick brown fox" * 7
+    boxed = secretbox(msg, nonce, key)
+    assert len(boxed) == 16 + len(msg)
+    assert secretbox_open(boxed, nonce, key) == msg
+    bad = bytearray(boxed)
+    bad[20] ^= 1
+    with pytest.raises(BoxError):
+        secretbox_open(bytes(bad), nonce, key)
+    with pytest.raises(BoxError):
+        secretbox_open(boxed, b"\x09" * 24, key)
+
+
+def test_box_beforenm_is_symmetric():
+    ask = c25519.random_secret()
+    bsk = c25519.random_secret()
+    apk = c25519.public_from_secret(ask)
+    bpk = c25519.public_from_secret(bsk)
+    assert box_beforenm(bpk, ask) == box_beforenm(apk, bsk)
+
+
+def test_seal_roundtrip_layout_and_reject():
+    rsk = c25519.random_secret()
+    rpk = c25519.public_from_secret(rsk)
+    msg = b"survey response body bytes"
+    boxed = seal(msg, rpk)
+    # crypto_box_seal layout: 32-byte eph pk + 16-byte tag + ct
+    assert len(boxed) == 48 + len(msg)
+    assert seal_open(boxed, rsk, rpk) == msg
+    # every seal uses a fresh ephemeral key
+    assert seal(msg, rpk) != boxed
+    other_sk = c25519.random_secret()
+    with pytest.raises(BoxError):
+        seal_open(boxed, other_sk,
+                  c25519.public_from_secret(other_sk))
+    with pytest.raises(BoxError):
+        seal_open(boxed[:40], rsk, rpk)
+
+
+def test_survey_manager_uses_sealed_boxes():
+    from stellar_tpu.overlay.survey_manager import open_box, seal_box
+    rsk = c25519.random_secret()
+    rpk = c25519.public_from_secret(rsk)
+    sealed = seal_box(rpk, b"topology payload")
+    assert open_box(rsk, sealed) == b"topology payload"
+    assert open_box(c25519.random_secret(), sealed) is None
+    assert open_box(rsk, sealed[:30]) is None
