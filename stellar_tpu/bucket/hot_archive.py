@@ -4,16 +4,17 @@ receives ARCHIVED full entries when the eviction scan removes expired
 PERSISTENT contract data/code from the live state, and LIVE key markers
 when a RestoreFootprint brings an entry back).
 
-Activation is protocol-gated (STATE_ARCHIVAL_PROTOCOL_VERSION = 23 >
-CURRENT_LEDGER_PROTOCOL_VERSION): below it the live list keeps expired
-persistent entries and the hot archive stays empty — matching the
-reference's protocol sequencing (the class exists in the p22-era tree;
-persistent eviction begins with the state archival protocol). The
-archive persists with the node (content-addressed files + manifest).
-Turning the gate on for a REAL network additionally requires the
-archive hash in the ledger header and hot-archive reconstruction in
-catchup, exactly as the reference's protocol-23 upgrade does — until
-then the gate must stay above the network's protocol version.
+Active from STATE_ARCHIVAL_PROTOCOL_VERSION (= 23, the protocol where
+persistent eviction begins — reference
+``FIRST_PROTOCOL_SUPPORTING_PERSISTENT_EVICTION``). From that version
+the archive is CONSENSUS STATE: its hash folds into the header's
+bucketListHash (``LedgerManager``; the reference snapshot leaves this
+as a TODO in ``BucketManager::snapshotLedger`` — committing it is
+required for restores to be consensus-safe, so this framework does),
+its buckets publish through the HistoryArchiveState
+("hotArchiveBuckets" levels), and MINIMAL catchup reconstructs it
+before verifying the combined hash. Below p23 the live list keeps
+expired persistent entries and the archive stays empty.
 
 Merge semantics (reference ``HotArchiveBucket::mergeCasesWithEqualKeys``):
 newest wins per key; at the bottom level LIVE markers annihilate (a
@@ -39,18 +40,14 @@ __all__ = ["HotArchiveBucket", "HotArchiveBucketList",
 
 STATE_ARCHIVAL_PROTOCOL_VERSION = 23
 
-# Hot-archive contents affect RestoreFootprint outcomes but are not yet
-# committed to the ledger header nor rebuilt by catchup — letting the
-# network reach this protocol would be consensus-divergent (a MINIMAL
-# catchup node gets an empty archive while replaying nodes have full
-# ones). Enforce the docstring's gate until header hash + catchup
-# reconstruction land; LEDGER_UPGRADE_VERSION past the current protocol
-# is independently rejected by Upgrades.max_protocol.
-from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION as _CUR
-assert STATE_ARCHIVAL_PROTOCOL_VERSION > _CUR, (
-    "state-archival gate must stay above the network protocol until "
-    "the hot-archive hash is in the ledger header and catchup rebuilds "
-    "the archive")
+
+def combined_bucket_list_hash(live_hash: bytes,
+                              hot_archive_hash: bytes) -> bytes:
+    """The p23+ header commitment: the header's bucketListHash covers
+    BOTH lists, so a MINIMAL-catchup node proves its reconstructed
+    archive against consensus before trusting RestoreFootprint reads."""
+    from stellar_tpu.crypto.sha import sha256
+    return sha256(live_hash + hot_archive_hash)
 
 
 def _entry_key_bytes(e) -> bytes:
@@ -213,6 +210,10 @@ class HotArchiveBucketList:
         from stellar_tpu.crypto.sha import sha256
         h = sha256(b"".join(lev.hash() for lev in self.levels))
         return h
+
+    def is_empty(self) -> bool:
+        return all(lev.curr.is_empty() and lev.snap.is_empty() and
+                   lev.next is None for lev in self.levels)
 
     def add_batch(self, current_ledger: int, archived: List,
                   restored_keys: List):

@@ -164,7 +164,44 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
                     raise FileNotFoundError(f"bucket {hexhash} missing")
             setattr(bl.levels[i], attr, bucket)
 
-    if bl.hash() != target_header_entry.header.bucketListHash:
+    # state-archival protocol: reconstruct the hot archive from the
+    # HAS and verify the COMBINED commitment the header carries
+    from stellar_tpu.bucket.hot_archive import (
+        STATE_ARCHIVAL_PROTOCOL_VERSION, HotArchiveBucket,
+        HotArchiveBucketList, combined_bucket_list_hash,
+    )
+    hot = HotArchiveBucketList()
+    if len(has.hot_archive_hashes) > len(hot.levels):
+        raise ValueError("malformed HAS: too many hot-archive levels")
+    for i, level in enumerate(has.hot_archive_hashes):
+        for attr in ("curr", "snap", "next"):
+            if attr == "next":
+                hexhash = HistoryArchiveState.next_output(level)
+                if not hexhash:
+                    hot.levels[i].next = None
+                    continue
+            else:
+                hexhash = level.get(attr, "")
+            if not hexhash or set(hexhash) == {"0"}:
+                bucket = HotArchiveBucket([])
+            else:
+                bucket = preloaded_buckets.get("hot:" + hexhash) or \
+                    HistoryManager.get_hot_bucket(archive, hexhash)
+                if bucket is None:
+                    raise FileNotFoundError(
+                        f"hot bucket {hexhash} missing")
+            if attr == "next":
+                hot.levels[i].next = bucket
+            else:
+                setattr(hot.levels[i], attr, bucket)
+
+    hdr = target_header_entry.header
+    if hdr.ledgerVersion >= STATE_ARCHIVAL_PROTOCOL_VERSION:
+        want = combined_bucket_list_hash(bl.hash(), hot.hash())
+        if want != hdr.bucketListHash:
+            raise ValueError("assembled live+hot bucket lists do not "
+                             "match the header commitment")
+    elif bl.hash() != hdr.bucketListHash:
         raise ValueError("assembled bucket list does not match header")
 
     # replay buckets oldest -> newest into the committed store
@@ -191,6 +228,8 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
                 mgr.check_on_bucket_apply(bucket, lm.root.store)
 
     lm.bucket_list = bl
+    lm.hot_archive = hot
+    lm.root.hot_archive = hot
     lm.root.set_header(target_header_entry.header)
     lm._lcl_hash = target_header_entry.hash
 
@@ -286,7 +325,8 @@ class CatchupWork(WorkSequence):
         if self.config.mode == CatchupConfiguration.MINIMAL:
             from stellar_tpu.historywork import DownloadBucketsWork
             self._bucket_download = DownloadBucketsWork(
-                self.archive, self.has.all_bucket_hashes())
+                self.archive, self.has.all_bucket_hashes() +
+                self.has.all_hot_bucket_hashes())
             self.add_child(self._bucket_download)
         elif self.config.mode == CatchupConfiguration.RECENT:
             cp0 = self._recent_adoption_checkpoint()

@@ -22,9 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac as _hmac
-import os
 import struct
-from typing import Tuple
 
 from stellar_tpu.crypto import curve25519 as c25519
 

@@ -313,11 +313,29 @@ class NodePersistence:
         manifest = json.loads(
             self.state.get(PersistentState.BUCKET_LIST_STATE) or "[]")
         bucket_list = self.buckets.restore_bucket_list(manifest)
-        if bucket_list.hash() != header.bucketListHash:
+        hot_raw = self.state.get(NodePersistence.HOT_ARCHIVE_STATE)
+        try:
+            hot_archive = self.buckets.restore_hot_archive(
+                json.loads(hot_raw)) if hot_raw else None
+        except (OSError, ValueError) as e:
+            raise RuntimeError(
+                "restored hot archive is unreadable "
+                f"({e}) — catch up from history instead")
+        from stellar_tpu.bucket.hot_archive import (
+            STATE_ARCHIVAL_PROTOCOL_VERSION, combined_bucket_list_hash,
+        )
+        want = bucket_list.hash()
+        if header.ledgerVersion >= STATE_ARCHIVAL_PROTOCOL_VERSION:
+            # p23+ headers commit to live+hot (empty archive hashes as
+            # a fresh list)
+            from stellar_tpu.bucket.hot_archive import (
+                HotArchiveBucketList,
+            )
+            hot_hash = (hot_archive.hash() if hot_archive is not None
+                        else HotArchiveBucketList().hash())
+            want = combined_bucket_list_hash(want, hot_hash)
+        if want != header.bucketListHash:
             raise RuntimeError(
                 "restored bucket list does not match LCL header "
                 "(bucket dir corrupt?) — catch up from history instead")
-        hot_raw = self.state.get(NodePersistence.HOT_ARCHIVE_STATE)
-        hot_archive = self.buckets.restore_hot_archive(
-            json.loads(hot_raw)) if hot_raw else None
         return header, header_hash, bucket_list, hot_archive

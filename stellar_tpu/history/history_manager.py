@@ -175,26 +175,34 @@ class HistoryArchiveState:
     """The JSON "HAS" manifest (reference ``HistoryArchiveState``)."""
 
     def __init__(self, current_ledger: int, network_passphrase: str,
-                 bucket_hashes: List[Dict[str, str]]):
+                 bucket_hashes: List[Dict[str, str]],
+                 hot_archive_hashes: Optional[List[Dict]] = None):
         self.version = HAS_VERSION
         self.current_ledger = current_ledger
         self.network_passphrase = network_passphrase
         self.bucket_hashes = bucket_hashes  # [{"curr": hex, "snap": hex}]
+        # state-archival (p23+) hot-archive levels, same level shape;
+        # absent/empty for pre-archival checkpoints and older HAS files
+        self.hot_archive_hashes = hot_archive_hashes or []
 
     def to_json(self) -> str:
-        return json.dumps({
+        doc = {
             "version": self.version,
             "server": "stellar_tpu",
             "currentLedger": self.current_ledger,
             "networkPassphrase": self.network_passphrase,
             "currentBuckets": self.bucket_hashes,
-        }, indent=2)
+        }
+        if self.hot_archive_hashes:
+            doc["hotArchiveBuckets"] = self.hot_archive_hashes
+        return json.dumps(doc, indent=2)
 
     @classmethod
     def from_json(cls, raw: str) -> "HistoryArchiveState":
         d = json.loads(raw)
         return cls(d["currentLedger"], d.get("networkPassphrase", ""),
-                   d["currentBuckets"])
+                   d["currentBuckets"],
+                   d.get("hotArchiveBuckets"))
 
     @staticmethod
     def next_output(lev: Dict) -> str:
@@ -215,6 +223,18 @@ class HistoryArchiveState:
             nxt = self.next_output(lev)
             if nxt:
                 out.append(nxt)
+        return out
+
+    def all_hot_bucket_hashes(self) -> List[str]:
+        """Hot-archive bucket ids, "hot:"-prefixed so the download
+        stage fetches them with the hot framing and catchup finds
+        them under distinct preload keys."""
+        out = []
+        for lev in self.hot_archive_hashes:
+            for h in (lev.get("curr", ""), lev.get("snap", ""),
+                      self.next_output(lev)):
+                if h:
+                    out.append("hot:" + h)
         return out
 
 
@@ -252,7 +272,8 @@ class HistoryManager:
 
     # ---------------- per-close hook ----------------
 
-    def ledger_closed(self, close_result, tx_set, bucket_list=None):
+    def ledger_closed(self, close_result, tx_set, bucket_list=None,
+                      hot_archive=None):
         """Record one closed ledger; publish when the checkpoint is
         full. ``close_result`` is LedgerManager's CloseLedgerResult."""
         header = close_result.header
@@ -272,11 +293,13 @@ class HistoryManager:
             ext=TransactionHistoryResultEntry._types[2].make(0))
         self.builder.append(hhe, the, tre)
         if is_last_in_checkpoint(header.ledgerSeq):
-            self.publish_checkpoint(header.ledgerSeq, bucket_list)
+            self.publish_checkpoint(header.ledgerSeq, bucket_list,
+                                    hot_archive=hot_archive)
 
     # ---------------- publish ----------------
 
-    def publish_checkpoint(self, checkpoint: int, bucket_list=None):
+    def publish_checkpoint(self, checkpoint: int, bucket_list=None,
+                           hot_archive=None):
         files = {
             _layered_path("ledger", checkpoint, "xdr.gz"): gzip.compress(
                 _records([to_bytes(LedgerHeaderHistoryEntry, h)
@@ -307,8 +330,22 @@ class HistoryManager:
                 for b in (lev.curr, lev.snap, nxt):
                     if b is not None and not b.is_empty():
                         buckets[b.hash.hex()] = b
+        hot_hashes = []
+        if hot_archive is not None and not hot_archive.is_empty():
+            for lev in hot_archive.levels:
+                nxt = lev.next
+                hot_hashes.append({
+                    "curr": lev.curr.hash.hex(),
+                    "snap": lev.snap.hash.hex(),
+                    "next": ({"state": 1, "output": nxt.hash.hex()}
+                             if nxt is not None else {"state": 0}),
+                })
+                for b in (lev.curr, lev.snap, nxt):
+                    if b is not None and not b.is_empty():
+                        buckets[b.hash.hex()] = b
         has = HistoryArchiveState(checkpoint, self.network_passphrase,
-                                  bucket_hashes)
+                                  bucket_hashes,
+                                  hot_archive_hashes=hot_hashes)
         has_json = has.to_json().encode()
         files[_layered_path("history", checkpoint, "json")] = has_json
         for hexhash, bucket in buckets.items():
@@ -357,17 +394,29 @@ class HistoryManager:
         return headers, txs or [], results or []
 
     @staticmethod
-    def get_bucket(archive: FileArchive, hexhash: str):
-        from stellar_tpu.bucket.bucket import Bucket
+    def get_bucket(archive: FileArchive, hexhash: str, cls=None):
+        """Content-addressed bucket download + hash verification.
+        ``cls`` selects the entry framing (live ``Bucket`` by default,
+        ``HotArchiveBucket`` for hot-archive files)."""
+        if cls is None:
+            from stellar_tpu.bucket.bucket import Bucket
+            cls = Bucket
         rel = (f"bucket/{hexhash[0:2]}/{hexhash[2:4]}/{hexhash[4:6]}/"
                f"bucket-{hexhash}.xdr.gz")
         raw = archive.get(rel)
         if raw is None:
             return None
-        b = Bucket.deserialize(gzip.decompress(raw))
+        b = cls.deserialize(gzip.decompress(raw))
         if b.hash.hex() != hexhash:
-            raise ValueError("bucket hash mismatch (corrupt archive)")
+            raise ValueError(
+                f"{cls.__name__} hash mismatch (corrupt archive)")
         return b
+
+    @staticmethod
+    def get_hot_bucket(archive: FileArchive, hexhash: str):
+        from stellar_tpu.bucket.hot_archive import HotArchiveBucket
+        return HistoryManager.get_bucket(archive, hexhash,
+                                         cls=HotArchiveBucket)
 
 
 def _pair(frame, result):

@@ -104,7 +104,12 @@ class DownloadVerifyBucketWork(BasicWork):
 
     def on_run(self) -> str:
         try:
-            bucket = HistoryManager.get_bucket(self.archive, self.hexhash)
+            if self.hexhash.startswith("hot:"):
+                bucket = HistoryManager.get_hot_bucket(
+                    self.archive, self.hexhash[4:])
+            else:
+                bucket = HistoryManager.get_bucket(self.archive,
+                                                   self.hexhash)
         except ValueError:
             return State.FAILURE  # hash mismatch: corrupt download
         if bucket is None:
@@ -119,7 +124,8 @@ class DownloadBucketsWork(BatchWork):
 
     def __init__(self, archive, hexhashes: List[str],
                  max_parallel: int = 8):
-        uniq = sorted({h for h in hexhashes if set(h) != {"0"}})
+        uniq = sorted({h for h in hexhashes
+                       if set(h.split(":")[-1]) != {"0"}})
         super().__init__(f"download-buckets-{len(uniq)}", max_parallel)
         self.archive = archive
         self._todo = uniq

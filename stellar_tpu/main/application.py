@@ -517,7 +517,9 @@ class Application:
             txset = self.herder.tx_sets.get(sv.txSetHash)
             if txset is not None:
                 self.history.ledger_closed(close_result, txset,
-                                           self.lm.bucket_list)
+                                           self.lm.bucket_list,
+                                           hot_archive=self.lm
+                                           .hot_archive)
         if self.database is not None:
             # HerderPersistence: the slot's SCP messages into scphistory
             # (reference HerderPersistenceImpl::saveSCPHistory)

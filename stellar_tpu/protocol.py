@@ -7,7 +7,7 @@ behavior switches on these constants the way the reference's
 :data:`MIN_SUPPORTED_PROTOCOL_VERSION` are not replayable here.
 """
 
-CURRENT_LEDGER_PROTOCOL_VERSION = 22
+CURRENT_LEDGER_PROTOCOL_VERSION = 23
 SOROBAN_PROTOCOL_VERSION = 20
 PARALLEL_SOROBAN_PHASE_PROTOCOL_VERSION = 23
 

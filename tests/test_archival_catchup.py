@@ -1,0 +1,206 @@
+"""Protocol-23 state archival end-to-end (VERDICT r3 #6): upgrade to
+the state-archival protocol, evict expired PERSISTENT entries into the
+hot archive, restore one, publish through a checkpoint — then a
+MINIMAL-catchup node (buckets + hot archive from the HAS) and a
+replaying node must agree with the original on store, hot archive,
+and header hashes, INCLUDING a restore-after-eviction performed after
+catchup on all three."""
+
+import pytest
+
+from stellar_tpu.bucket.hot_archive import (
+    STATE_ARCHIVAL_PROTOCOL_VERSION, combined_bucket_list_hash,
+)
+from stellar_tpu.catchup.catchup import (
+    CatchupConfiguration, CatchupWork, replay_checkpoint,
+)
+from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+from stellar_tpu.history.history_manager import (
+    FileArchive, HistoryManager,
+)
+from stellar_tpu.ledger.ledger_manager import (
+    LedgerCloseData, LedgerManager,
+)
+from stellar_tpu.ledger.ledger_txn import LedgerTxn, key_bytes
+from stellar_tpu.soroban.host import (
+    contract_data_key, scaddress_contract, ttl_key_for,
+)
+from stellar_tpu.tx.tx_test_utils import (
+    TEST_NETWORK_ID, keypair, make_tx, seed_root_with_accounts,
+)
+from stellar_tpu.utils.timer import VIRTUAL_TIME, VirtualClock
+from stellar_tpu.work.work import State, WorkScheduler
+from stellar_tpu.xdr.contract import (
+    ContractDataDurability, ContractDataEntry, SCVal, SCValType,
+)
+from stellar_tpu.xdr.ledger import LedgerUpgrade, LedgerUpgradeType
+from stellar_tpu.xdr.runtime import to_bytes
+from stellar_tpu.xdr.types import (
+    ExtensionPoint, LedgerEntry, LedgerEntryType, TTLEntry,
+)
+
+XLM = 10_000_000
+T = SCValType
+
+
+def _persistent_entry(tag: bytes, expired_at: int):
+    """(LedgerEntry, its LedgerKey, TTL LedgerEntry) for one
+    persistent contract-data entry expiring at ``expired_at``."""
+    addr = scaddress_contract(tag * 32)
+    cd = ContractDataEntry(
+        ext=ExtensionPoint.make(0), contract=addr,
+        key=SCVal.make(T.SCV_SYMBOL, b"k"),
+        durability=ContractDataDurability.PERSISTENT,
+        val=SCVal.make(T.SCV_U32, tag[0]))
+    entry = LedgerEntry(
+        lastModifiedLedgerSeq=2,
+        data=LedgerEntry._types[1].make(
+            LedgerEntryType.CONTRACT_DATA, cd),
+        ext=LedgerEntry._types[2].make(0))
+    lk = contract_data_key(addr, SCVal.make(T.SCV_SYMBOL, b"k"),
+                           ContractDataDurability.PERSISTENT)
+    ttl = LedgerEntry(
+        lastModifiedLedgerSeq=2,
+        data=LedgerEntry._types[1].make(
+            LedgerEntryType.TTL,
+            TTLEntry(keyHash=ttl_key_for(lk).value.keyHash,
+                     liveUntilLedgerSeq=expired_at)),
+        ext=LedgerEntry._types[2].make(0))
+    return entry, lk, ttl
+
+
+def _fresh_node():
+    """A node from the DETERMINISTIC shared genesis: two funded
+    accounts + two persistent entries whose TTLs are already expired.
+    Every node in the test seeds identically, so replay from genesis
+    and bucket-adoption both converge on the same state."""
+    a, b = keypair("arch-a"), keypair("arch-b")
+    root = seed_root_with_accounts([(a, 10**13), (b, 10**13)])
+    root.header().ledgerVersion = STATE_ARCHIVAL_PROTOCOL_VERSION - 1
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    entries = {}
+    with LedgerTxn(lm.root) as ltx:
+        for tag in (b"\x51", b"\x52"):
+            entry, lk, ttl = _persistent_entry(tag, expired_at=2)
+            ltx.create(entry).deactivate()
+            ltx.create(ttl).deactivate()
+            entries[tag] = lk
+        ltx.commit()
+    return lm, a, entries
+
+
+def _close(lm, frames=(), upgrades=()):
+    txset, excluded = make_tx_set_from_transactions(
+        list(frames), lm.last_closed_header, lm.last_closed_hash)
+    assert not excluded
+    res = lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lm.last_closed_header.scpValue.closeTime + 5,
+        upgrades=list(upgrades)))
+    assert res.failed_count == 0, [r.code for r in res.tx_results]
+    return res
+
+
+def _restore_tx(lm, kp, lk, seq):
+    from stellar_tpu.simulation.load_generator import _soroban_data
+    from stellar_tpu.xdr.tx import (
+        Operation, OperationBody, OperationType, RestoreFootprintOp,
+    )
+    op = Operation(sourceAccount=None, body=OperationBody.make(
+        OperationType.RESTORE_FOOTPRINT,
+        RestoreFootprintOp(ext=ExtensionPoint.make(0))))
+    return make_tx(kp, seq, [op], fee=6_000_000,
+                   soroban_data=_soroban_data(read_write=[lk]),
+                   network_id=lm.network_id)
+
+
+@pytest.fixture
+def chain(tmp_path):
+    # build with an explicit loop keeping the txset for history
+    lm, a, entries = _fresh_node()
+    archive = FileArchive(str(tmp_path))
+    hm = HistoryManager([archive], "test-net")
+    up = LedgerUpgrade.make(LedgerUpgradeType.LEDGER_UPGRADE_VERSION,
+                            STATE_ARCHIVAL_PROTOCOL_VERSION)
+    seq = (1 << 32)
+    while lm.ledger_seq < 63:
+        frames, upgrades = [], []
+        if lm.ledger_seq == 2:
+            upgrades = [to_bytes(LedgerUpgrade, up)]
+        elif lm.ledger_seq == 4:
+            seq += 1
+            frames = [_restore_tx(lm, a, entries[b"\x51"], seq)]
+        txset, excluded = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash)
+        assert not excluded
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5,
+            upgrades=upgrades))
+        assert res.failed_count == 0, [r.code for r in res.tx_results]
+        hm.ledger_closed(res, txset, lm.bucket_list,
+                         hot_archive=lm.hot_archive)
+    return lm, a, entries, archive, hm
+
+
+def test_archival_chain_state(chain):
+    lm, a, entries, archive, hm = chain
+    assert lm.last_closed_header.ledgerVersion == \
+        STATE_ARCHIVAL_PROTOCOL_VERSION
+    # entry 0x52 evicted and still archived; 0x51 restored to live
+    assert lm.root.store.get(key_bytes(entries[b"\x52"])) is None
+    assert lm.hot_archive.get_archived(
+        key_bytes(entries[b"\x52"])) is not None
+    assert lm.root.store.get(key_bytes(entries[b"\x51"])) is not None
+    assert lm.hot_archive.get_archived(
+        key_bytes(entries[b"\x51"])) is None
+    assert not lm.hot_archive.is_empty()
+    # the header commits to live+hot
+    assert lm.last_closed_header.bucketListHash == \
+        combined_bucket_list_hash(lm.bucket_list.hash(),
+                                  lm.hot_archive.hash())
+    # the HAS carries hot-archive levels
+    has = HistoryManager.get_root_has(archive)
+    assert has.hot_archive_hashes
+
+
+def test_minimal_catchup_reconstructs_hot_archive(chain):
+    lm, a, entries, archive, hm = chain
+    lm2 = LedgerManager(TEST_NETWORK_ID)
+    clock = VirtualClock(VIRTUAL_TIME)
+    ws = WorkScheduler(clock)
+    work = CatchupWork(lm2, archive, CatchupConfiguration(
+        63, CatchupConfiguration.MINIMAL))
+    ws.schedule(work)
+    ws.run_until_done(60)
+    assert work.state == State.SUCCESS, work.state
+    assert lm2.last_closed_hash == lm.last_closed_hash
+    assert lm2.hot_archive is not None
+    assert lm2.hot_archive.hash() == lm.hot_archive.hash()
+    assert lm2.hot_archive.get_archived(
+        key_bytes(entries[b"\x52"])) is not None
+    assert lm2.root.store.entries == lm.root.store.entries
+    # restore-after-eviction agrees across the original and the
+    # MINIMAL-catchup node: same restore tx, same resulting header
+    seq2 = (1 << 32) + 2
+    r1 = _close(lm, [_restore_tx(lm, a, entries[b"\x52"], seq2)])
+    r2 = _close(lm2, [_restore_tx(lm2, a, entries[b"\x52"], seq2)])
+    assert r1.header_hash == r2.header_hash
+    assert lm.root.store.get(key_bytes(entries[b"\x52"])) is not None
+    assert lm2.root.store.get(key_bytes(entries[b"\x52"])) is not None
+
+
+def test_replay_catchup_rebuilds_hot_archive(chain):
+    lm, a, entries, archive, hm = chain
+    # a replaying node starts from the SAME deterministic genesis
+    lm3, _a3, entries3 = _fresh_node()
+    applied = replay_checkpoint(lm3, archive, 63)
+    assert applied == 61
+    assert lm3.last_closed_hash == lm.last_closed_hash
+    assert lm3.hot_archive.hash() == lm.hot_archive.hash()
+    assert lm3.root.store.entries == lm.root.store.entries
+    # and the replayed node restores identically too
+    seq2 = (1 << 32) + 2
+    r1 = _close(lm, [_restore_tx(lm, a, entries[b"\x52"], seq2)])
+    r3 = _close(lm3, [_restore_tx(lm3, a, entries3[b"\x52"], seq2)])
+    assert r1.header_hash == r3.header_hash
