@@ -35,9 +35,15 @@ __all__ = ["BucketLevel", "LiveBucketList", "NUM_LEVELS"]
 
 NUM_LEVELS = 11
 
+# test knob (reference ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING,
+# pushed from Config): halves every level's size so spills reach deep
+# levels within a short test chain
+REDUCE_MERGE_COUNTS = False
+
 
 def level_size(level: int) -> int:
-    return 1 << (2 * (level + 1))
+    shift = 1 if REDUCE_MERGE_COUNTS else 0
+    return max(2, 1 << (2 * (level + 1) - shift))
 
 
 def level_half(level: int) -> int:

@@ -26,8 +26,19 @@ __all__ = ["EvictionScanner"]
 
 
 class EvictionScanner:
-    def __init__(self, max_entries_per_scan: int = 100):
+    def __init__(self, max_entries_per_scan: int = 100,
+                 max_archive_entries: int = 0,
+                 start_level: int = 0):
         self.max_entries = max_entries_per_scan
+        # cap on PERSISTENT entries archived per close; 0 = unlimited
+        # (reference TESTING_MAX_ENTRIES_TO_ARCHIVE under
+        # OVERRIDE_EVICTION_PARAMS_FOR_TESTING)
+        self.max_archive_entries = max_archive_entries
+        # reference TESTING_STARTING_EVICTION_SCAN_LEVEL: the scan
+        # begins at bucket level N, i.e. entries too recently modified
+        # to have spilled that deep are not yet scan candidates.
+        # 0 = scan everything (this implementation's flat default)
+        self.start_level = start_level
         self._cursor: bytes = b""
         self._pending = None  # Future[List[bytes]] from prepare_async
         self._pending_store = None  # identity guard
@@ -102,23 +113,40 @@ class EvictionScanner:
         window = (data_keys[start:] + data_keys[:start])[:self.max_entries]
         evicted = []
         archived = []
+        min_age = 0
+        if self.start_level > 0:
+            from stellar_tpu.bucket.bucket_list import level_half
+            min_age = level_half(self.start_level - 1)
         for kb in window:
-            self._cursor = kb
             data_key = from_bytes(LedgerKey, kb)
             entry = ltx.load_without_record(data_key)
             if entry is None:
+                self._cursor = kb
+                continue
+            if min_age and \
+                    ledger_seq - entry.lastModifiedLedgerSeq < min_age:
+                # not old enough to have spilled to the starting level
+                self._cursor = kb
                 continue
             persistent = entry.data.value.durability != \
                 ContractDataDurability.TEMPORARY
             if persistent and not archive_persistent:
+                self._cursor = kb
                 continue
             tk = ttl_key_for(data_key)
             ttl_entry = ltx.load_without_record(tk)
             if ttl_entry is not None and \
                     ttl_entry.data.value.liveUntilLedgerSeq >= ledger_seq:
+                self._cursor = kb
                 continue
             if persistent:
+                if self.max_archive_entries and \
+                        len(archived) >= self.max_archive_entries:
+                    # archive cap reached: stop BEFORE advancing the
+                    # cursor so the capped entry leads the next scan
+                    break
                 archived.append(entry)
+            self._cursor = kb
             ltx.erase(data_key)
             if ttl_entry is not None:
                 ltx.erase(tk)

@@ -34,10 +34,27 @@ from stellar_tpu.xdr.ledger import ledger_header_hash
 # CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING (resolve pending bucket
 # merges after every replayed ledger, reference Config.h)
 BUCKET_APPLY_DELAY_MS = 0
+# trust archived results during replay and skip signature verification
+# (reference CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING; pushed from
+# Config) — the chain is still hash-verified end to end
+SKIP_KNOWN_RESULTS = False
 WAIT_MERGES_ON_APPLY = False
 
 __all__ = ["verify_ledger_chain", "CatchupConfiguration", "CatchupWork",
            "replay_checkpoint", "apply_buckets_catchup", "LedgerApplyManager"]
+
+
+def _successful_tx_hashes(results_by_seq, seq) -> set:
+    """Tx hashes the archived result entry for ``seq`` recorded as
+    successful (txSUCCESS / txFEE_BUMP_INNER_SUCCESS)."""
+    entry = results_by_seq.get(seq)
+    if entry is None:
+        return set()
+    ok = set()
+    for pair in entry.txResultSet.results:
+        if pair.result.result.arm in (0, 1):
+            ok.add(pair.transactionHash)
+    return ok
 
 
 def verify_ledger_chain(headers) -> bool:
@@ -79,8 +96,9 @@ def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
         HistoryManager.get_checkpoint(archive, checkpoint)
     if data is None:
         raise FileNotFoundError(f"checkpoint {checkpoint} not in archive")
-    headers, tx_entries, _results = data
+    headers, tx_entries, results_entries = data
     tx_by_seq = {t.ledgerSeq: t for t in tx_entries}
+    results_by_seq = {r.ledgerSeq: r for r in (results_entries or ())}
     applied = 0
     for hhe in headers:
         seq = hhe.header.ledgerSeq
@@ -99,14 +117,37 @@ def replay_checkpoint(lm: LedgerManager, archive: FileArchive,
         if applicable is None or \
                 applicable.hash != hhe.header.scpValue.txSetHash:
             raise ValueError(f"tx set mismatch at ledger {seq}")
-        # batch-verify the whole set's signatures in one device trip
+        # batch-verify the whole set's signatures in one device trip;
+        # with SKIP_KNOWN_RESULTS the hash-verified chain's recorded
+        # outcomes are trusted and the triples seed as valid unverified
         from stellar_tpu.herder.tx_set import prefetch_signature_batch
         from stellar_tpu.ledger.ledger_txn import LedgerTxn
         with LedgerTxn(lm.root) as ltx:
-            # stash the triples so close_ledger re-seeds from them
-            # instead of re-collecting the whole set
-            applicable.sig_triples = prefetch_signature_batch(
-                ltx, applicable.frames)
+            if SKIP_KNOWN_RESULTS:
+                # trust recorded outcomes — but ONLY for txs the
+                # archive recorded as SUCCESSFUL: a recorded failure
+                # may be a bad signature, and assuming it valid would
+                # flip the outcome and diverge the replay
+                from stellar_tpu.crypto.keys import (
+                    seed_cache_assume_valid,
+                )
+                from stellar_tpu.herder.tx_set import (
+                    collect_signature_triples,
+                )
+                ok_hashes = _successful_tx_hashes(results_by_seq, seq)
+                trusted = [f for f in applicable.frames
+                           if f.contents_hash() in ok_hashes]
+                rest = [f for f in applicable.frames
+                        if f.contents_hash() not in ok_hashes]
+                items = collect_signature_triples(ltx, trusted)
+                seed_cache_assume_valid(items)
+                applicable.sig_triples = items + \
+                    prefetch_signature_batch(ltx, rest)
+            else:
+                # stash the triples so close_ledger re-seeds from them
+                # instead of re-collecting the whole set
+                applicable.sig_triples = prefetch_signature_batch(
+                    ltx, applicable.frames)
             ltx.rollback()
         res = lm.close_ledger(LedgerCloseData(
             ledger_seq=seq, tx_set=applicable,

@@ -243,6 +243,20 @@ def batch_verify_into_cache(items) -> None:
             _verify_cache.put(k, bool(ok))
 
 
+def seed_cache_assume_valid(items) -> int:
+    """Mark (pk, msg, sig) triples VALID in the cache without
+    verifying. ONLY for replaying history whose results are already
+    trusted (reference CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING) — the
+    outcome of every signature in an archived, hash-verified ledger is
+    fixed by its recorded results."""
+    keyed = [_cache_key(pk, msg, sig) for pk, msg, sig in items
+             if len(pk) == 32 and len(sig) == 64]
+    with _cache_lock:
+        for k in keyed:
+            _verify_cache.put(k, True)
+    return len(keyed)
+
+
 def flush_verify_cache():
     with _cache_lock:
         _verify_cache.clear()

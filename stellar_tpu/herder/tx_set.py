@@ -166,8 +166,11 @@ def make_tx_set_from_transactions(
             SurgePricingPriorityQueue.most_top_txs_within_limits(
                 soroban, SurgePricingLaneConfig(
                     [cap], resources_of=lambda f: 1))
+        inc_s, over_cap = _enforce_soroban_ledger_caps(
+            inc_s, soroban_config)
+        exc_s = list(exc_s) + over_cap
         base_fee_s = SurgePricingPriorityQueue.lane_base_fee(
-            inc_s, lcl_header.baseFee, bool(full_s))
+            inc_s, lcl_header.baseFee, bool(full_s) or bool(over_cap))
         excluded.extend(exc_s)
     else:
         excluded.extend(soroban)
@@ -435,6 +438,14 @@ class ApplicableTxSetFrame:
         if self.size_op() > header.maxTxSetSize:
             return False
         from stellar_tpu.ledger.ledger_txn import soroban_config_of
+        # per-ledger soroban aggregate access caps bind RECEIVED sets
+        # too — a peer-built set over the caps must not validate
+        soroban_frames = [f for f in self.frames
+                          if id(f) in self._soroban_ids]
+        kept, over = _enforce_soroban_ledger_caps(
+            soroban_frames, soroban_config_of(ltx))
+        if over:
+            return False
         if self.soroban_tx_count() > \
                 soroban_config_of(ltx).ledger_max_tx_count:
             return False
@@ -568,14 +579,50 @@ class ApplicableTxSetFrame:
                 f"hash={self.hash.hex()[:8]})")
 
 
+def _enforce_soroban_ledger_caps(frames, cfg):
+    """Greedy per-LEDGER aggregate access caps over the soroban phase
+    (reference ledgerMaxRead*/ledgerMaxWrite* set-building limits):
+    walk the already-priority-ordered selection and drop anything that
+    would push a declared aggregate over its cap."""
+    caps = (cfg.ledger_max_read_ledger_entries,
+            cfg.ledger_max_read_bytes,
+            cfg.ledger_max_write_ledger_entries,
+            cfg.ledger_max_write_bytes)
+    used = [0, 0, 0, 0]
+    kept, dropped = [], []
+    for f in frames:
+        res = (f.inner if hasattr(f, "inner") else f) \
+            .tx.ext.value.resources
+        decl = (len(res.footprint.readOnly) +
+                len(res.footprint.readWrite),
+                res.readBytes,
+                len(res.footprint.readWrite),
+                res.writeBytes)
+        if all(u + d <= c for u, d, c in zip(used, decl, caps)):
+            for i, d in enumerate(decl):
+                used[i] += d
+            kept.append(f)
+        else:
+            dropped.append(f)
+    return kept, dropped
+
+
 def prefetch_signature_batch(ltx, frames) -> list:
     """Collect every plausible (pubkey, payload, signature) triple in the
     set and verify them in one device batch, seeding the verify cache.
+    Returns the collected triples so callers can re-seed later without
+    re-collecting."""
+    items = collect_signature_triples(ltx, frames)
+    batch_verify_into_cache(items)
+    return items
+
+
+def collect_signature_triples(ltx, frames) -> list:
+    """Every plausible (pubkey, payload, signature) triple in the set.
 
     Candidates per tx: master key + account signers of the tx source,
     every op source, the fee source (fee bumps), and extraSigners —
-    filtered by the 4-byte hint before batching. Returns the collected
-    triples so callers can re-seed later without re-collecting.
+    filtered by the 4-byte hint.
     """
     items = []
     # one account load per DISTINCT account for the whole set — the
@@ -611,7 +658,6 @@ def prefetch_signature_batch(ltx, frames) -> list:
                     _collect_for_account(acc, h, sig, items)
                 for sk in tf.extra_signers():
                     _collect_for_signer_key(sk, h, sig, items)
-    batch_verify_into_cache(items)
     return items
 
 

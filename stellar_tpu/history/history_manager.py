@@ -264,11 +264,21 @@ class HistoryManager:
     cut checkpoints, push to archives."""
 
     def __init__(self, archives: List[FileArchive],
-                 network_passphrase: str = ""):
+                 network_passphrase: str = "",
+                 store_headers: bool = True, store_misc: bool = True,
+                 publish_delay_s: int = 0):
         self.archives = archives
         self.network_passphrase = network_passphrase
         self.builder = CheckpointBuilder()
         self.published_checkpoints: List[int] = []
+        # reference MODE_STORES_HISTORY_LEDGERHEADERS / _MISC: what a
+        # checkpoint records (captive nodes can skip tx sets/results)
+        self.store_headers = store_headers
+        self.store_misc = store_misc
+        # reference PUBLISH_TO_ARCHIVE_DELAY: seconds between cutting
+        # a checkpoint and uploading it
+        self.publish_delay_s = publish_delay_s
+        self._deferred: List = []  # (due_monotonic, files, has_json)
 
     # ---------------- per-close hook ----------------
 
@@ -277,6 +287,8 @@ class HistoryManager:
         """Record one closed ledger; publish when the checkpoint is
         full. ``close_result`` is LedgerManager's CloseLedgerResult."""
         header = close_result.header
+        if not self.store_headers:
+            return  # header-less node: nothing publishable accrues
         hhe = LedgerHeaderHistoryEntry(
             hash=close_result.header_hash, header=header,
             ext=LedgerHeaderHistoryEntry._types[2].make(0))
@@ -291,6 +303,19 @@ class HistoryManager:
         tre = TransactionHistoryResultEntry(
             ledgerSeq=header.ledgerSeq, txResultSet=rset,
             ext=TransactionHistoryResultEntry._types[2].make(0))
+        if not self.store_misc:
+            # headers only: empty tx/result records keep checkpoint
+            # shape without the misc payload
+            the = TransactionHistoryEntry(
+                ledgerSeq=header.ledgerSeq,
+                txSet=TransactionSet(
+                    previousLedgerHash=header.previousLedgerHash,
+                    txs=[]),
+                ext=TransactionHistoryEntry._types[2].make(0))
+            tre = TransactionHistoryResultEntry(
+                ledgerSeq=header.ledgerSeq,
+                txResultSet=TransactionResultSet(results=[]),
+                ext=TransactionHistoryResultEntry._types[2].make(0))
         self.builder.append(hhe, the, tre)
         if is_last_in_checkpoint(header.ledgerSeq):
             self.publish_checkpoint(header.ledgerSeq, bucket_list,
@@ -352,12 +377,33 @@ class HistoryManager:
             rel = (f"bucket/{hexhash[0:2]}/{hexhash[2:4]}/{hexhash[4:6]}/"
                    f"bucket-{hexhash}.xdr.gz")
             files[rel] = gzip.compress(bucket.serialize())
+        if self.publish_delay_s > 0:
+            import time as _time
+            self._deferred.append(
+                (_time.monotonic() + self.publish_delay_s, files,
+                 has_json, checkpoint))
+        else:
+            self._upload(files, has_json, checkpoint)
+        self.builder.clear()
+
+    def _upload(self, files, has_json, checkpoint):
         for archive in self.archives:
             for rel, data in files.items():
                 archive.put(rel, data)
             archive.put(".well-known/stellar-history.json", has_json)
         self.published_checkpoints.append(checkpoint)
-        self.builder.clear()
+
+    def poll_deferred_publishes(self):
+        """Upload any checkpoint whose PUBLISH_TO_ARCHIVE_DELAY has
+        elapsed (called from the externalize hook)."""
+        if not self._deferred:
+            return
+        import time as _time
+        now = _time.monotonic()
+        ready = [d for d in self._deferred if d[0] <= now]
+        self._deferred = [d for d in self._deferred if d[0] > now]
+        for _due, files, has_json, checkpoint in ready:
+            self._upload(files, has_json, checkpoint)
 
     # ---------------- retrieval (consumer side) ----------------
 

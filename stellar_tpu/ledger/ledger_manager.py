@@ -43,6 +43,13 @@ __all__ = ["LedgerCloseData", "CloseLedgerResult", "LedgerManager",
 # reference BucketManager.h skip cadence
 SKIP_1, SKIP_2, SKIP_3, SKIP_4 = 50, 5000, 50000, 500000
 
+# close-meta emission shape (reference EMIT_LEDGER_CLOSE_META_EXT_V1 /
+# EMIT_SOROBAN_TRANSACTION_META_EXT_V1; pushed from Config): V1 exts
+# add the soroban fee-write rate / per-tx fee breakdown for meta
+# consumers
+EMIT_LEDGER_CLOSE_META_EXT_V1 = False
+EMIT_SOROBAN_TX_META_EXT_V1 = False
+
 
 @dataclass
 class LedgerCloseData:
@@ -439,18 +446,38 @@ class LedgerManager:
             TransactionMeta, TransactionMetaV3, TransactionResultMeta,
             UpgradeEntryMeta,
         )
+        from stellar_tpu.xdr.ledger import (
+            SorobanTransactionMeta, SorobanTransactionMetaExt,
+            SorobanTransactionMetaExtV1,
+        )
         from stellar_tpu.xdr.types import ExtensionPoint
         tx_processing = []
         for f, pair, res, meta in zip(
                 apply_order, result_pairs, result.tx_results,
                 result.tx_metas):
+            soroban_meta = None
+            info = getattr(f, "_soroban_meta_info", None)
+            if info is not None:
+                rv, events, non_ref, refundable, rent = info
+                if EMIT_SOROBAN_TX_META_EXT_V1:
+                    sext = SorobanTransactionMetaExt.make(
+                        1, SorobanTransactionMetaExtV1(
+                            ext=ExtensionPoint.make(0),
+                            totalNonRefundableResourceFeeCharged=non_ref,
+                            totalRefundableResourceFeeCharged=refundable,
+                            rentFeeCharged=rent))
+                else:
+                    sext = SorobanTransactionMetaExt.make(0)
+                soroban_meta = SorobanTransactionMeta(
+                    ext=sext, events=list(events), returnValue=rv,
+                    diagnosticEvents=[])
             v3 = TransactionMetaV3(
                 ext=ExtensionPoint.make(0),
                 txChangesBefore=list(meta.tx_changes_before),
                 operations=[OperationMeta(changes=c)
                             for c in meta.operations],
                 txChangesAfter=list(meta.tx_changes_after),
-                sorobanMeta=None)
+                sorobanMeta=soroban_meta)
             fee_changes = getattr(fee_results[id(f)], "fee_changes", [])
             tx_processing.append(TransactionResultMeta(
                 result=pair, feeProcessing=list(fee_changes),
@@ -461,8 +488,15 @@ class LedgerManager:
             changes=changes) for raw, changes in upgrade_metas]
         bl_size = sum(b.size_bytes for b in self.bucket_list.all_buckets()) \
             if self.bucket_list is not None else 0
+        if EMIT_LEDGER_CLOSE_META_EXT_V1:
+            from stellar_tpu.xdr.ledger import LedgerCloseMetaExtV1
+            meta_ext = LedgerCloseMetaExt.make(1, LedgerCloseMetaExtV1(
+                ext=ExtensionPoint.make(0),
+                sorobanFeeWrite1KB=self.soroban_config.fee_write_1kb))
+        else:
+            meta_ext = LedgerCloseMetaExt.make(0)
         v1 = LedgerCloseMetaV1(
-            ext=LedgerCloseMetaExt.make(0),
+            ext=meta_ext,
             ledgerHeader=LedgerHeaderHistoryEntry(
                 hash=self._lcl_hash, header=header,
                 ext=LedgerHeaderHistoryEntry._types[2].make(0)),

@@ -40,6 +40,13 @@ class SorobanNetworkConfig:
     tx_max_read_bytes: int = 3_200
     tx_max_write_ledger_entries: int = 2
     tx_max_write_bytes: int = 3_200
+    # per-LEDGER aggregate access caps enforced at tx-set building
+    # (reference ledgerMaxRead*/ledgerMaxWrite*); generous defaults so
+    # only explicit tuning (apply-load overrides, upgrades) bites
+    ledger_max_read_ledger_entries: int = 100_000
+    ledger_max_read_bytes: int = 100 * 1024 * 1024
+    ledger_max_write_ledger_entries: int = 50_000
+    ledger_max_write_bytes: int = 50 * 1024 * 1024
     fee_read_ledger_entry: int = 5_000
     fee_write_ledger_entry: int = 20_000
     fee_read_1kb: int = 1_000

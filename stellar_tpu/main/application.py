@@ -51,10 +51,20 @@ class Application:
             # resume from the durable LCL when one exists
             self.lm = LedgerManager.from_persistence(network_id,
                                                      self.persistence)
+        if self.persistence is not None and \
+                config.MODE_USES_IN_MEMORY_LEDGER:
+            # reference MODE_USES_IN_MEMORY_LEDGER: the DB stays for
+            # misc storage but closes are not made durable
+            self.persistence = None
+            self.lm = None
         fresh = self.lm is None
         if fresh:
-            self.lm = LedgerManager(network_id, root,
-                                    persistence=self.persistence)
+            self.lm = LedgerManager(
+                network_id, root, persistence=self.persistence,
+                # reference MODE_ENABLES_BUCKETLIST: off = flat state
+                # hash, no bucket list maintenance
+                bucket_list=(None if config.MODE_ENABLES_BUCKETLIST
+                             else False))
             hdr = self.lm.last_closed_header
             hdr.maxTxSetSize = config.MAX_TX_SET_SIZE
             hdr.ledgerVersion = config.LEDGER_PROTOCOL_VERSION
@@ -83,7 +93,24 @@ class Application:
             )
             self.history = HistoryManager(
                 [archive_from_config(p) for p in config.HISTORY_ARCHIVES],
-                config.NETWORK_PASSPHRASE)
+                config.NETWORK_PASSPHRASE,
+                store_headers=config.MODE_STORES_HISTORY_LEDGERHEADERS,
+                store_misc=config.MODE_STORES_HISTORY_MISC,
+                publish_delay_s=config.PUBLISH_TO_ARCHIVE_DELAY)
+        # debug close-meta retention (reference METADATA_DEBUG_LEDGERS)
+        self.debug_meta = None
+        if config.METADATA_DEBUG_LEDGERS > 0:
+            import collections
+            self.debug_meta = collections.deque(
+                maxlen=config.METADATA_DEBUG_LEDGERS)
+            self.lm.close_meta_stream.append(self.debug_meta.append)
+        # node-id strkey -> display name (reference VALIDATOR_NAMES,
+        # merged with names declared on VALIDATORS entries)
+        self.validator_names = dict(config.VALIDATOR_NAMES)
+        for v in config.VALIDATORS:
+            if v.get("PUBLIC_KEY") and v.get("NAME"):
+                self.validator_names.setdefault(v["PUBLIC_KEY"],
+                                                v["NAME"])
         from stellar_tpu.process import ProcessManager
         self.process_manager = ProcessManager(
             max_concurrent=config.MAX_CONCURRENT_SUBPROCESSES)
@@ -91,6 +118,15 @@ class Application:
         if config.TESTING_EVICTION_SCAN_SIZE > 0:
             self.lm.eviction_scanner.max_entries = \
                 config.TESTING_EVICTION_SCAN_SIZE
+        if config.OVERRIDE_EVICTION_PARAMS_FOR_TESTING:
+            if not (0 <= config.TESTING_STARTING_EVICTION_SCAN_LEVEL
+                    <= 10):
+                raise ValueError(
+                    "TESTING_STARTING_EVICTION_SCAN_LEVEL out of range")
+            self.lm.eviction_scanner.max_archive_entries = \
+                config.TESTING_MAX_ENTRIES_TO_ARCHIVE
+            self.lm.eviction_scanner.start_level = \
+                config.TESTING_STARTING_EVICTION_SCAN_LEVEL
         if config.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME > 0:
             import dataclasses as _dc
             self.lm.soroban_config = _dc.replace(
@@ -229,6 +265,29 @@ class Application:
             from stellar_tpu.bucket import bucket_list_db as bldb
             bldb.set_prefetch_limits(config.ENTRY_CACHE_SIZE,
                                      config.PREFETCH_BATCH_SIZE)
+        if changed("HISTOGRAM_WINDOW_SIZE"):
+            from stellar_tpu.utils import metrics as metrics_mod
+            metrics_mod.WINDOW_SECONDS = \
+                float(config.HISTOGRAM_WINDOW_SIZE)
+        if changed("ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING"):
+            from stellar_tpu.bucket import bucket_list as bl_mod
+            bl_mod.REDUCE_MERGE_COUNTS = \
+                config.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING
+        if changed("BEST_OFFER_DEBUGGING_ENABLED"):
+            from stellar_tpu.tx import offer_exchange as oe_mod
+            oe_mod.BEST_OFFER_DEBUGGING = \
+                config.BEST_OFFER_DEBUGGING_ENABLED
+        if changed("CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING"):
+            from stellar_tpu.catchup import catchup as catchup_mod
+            catchup_mod.SKIP_KNOWN_RESULTS = \
+                config.CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING
+        if changed("EMIT_LEDGER_CLOSE_META_EXT_V1") or \
+                changed("EMIT_SOROBAN_TRANSACTION_META_EXT_V1"):
+            from stellar_tpu.ledger import ledger_manager as lm_mod
+            lm_mod.EMIT_LEDGER_CLOSE_META_EXT_V1 = \
+                config.EMIT_LEDGER_CLOSE_META_EXT_V1
+            lm_mod.EMIT_SOROBAN_TX_META_EXT_V1 = \
+                config.EMIT_SOROBAN_TRANSACTION_META_EXT_V1
 
     def _stage_testing_upgrades(self, config: Config,
                                 fresh: bool = True):
@@ -299,7 +358,9 @@ class Application:
         """Begin consensus participation (reference
         ``ApplicationImpl::start``)."""
         self._started = True
-        if not self.config.MANUAL_CLOSE:
+        if not self.config.MANUAL_CLOSE or self.config.FORCE_SCP:
+            # FORCE_SCP starts consensus from the LCL immediately even
+            # in manual-close setups (reference FORCE_SCP)
             self.herder.start()
         if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0 and \
                 self.database is not None:
@@ -520,6 +581,15 @@ class Application:
                                            self.lm.bucket_list,
                                            hot_archive=self.lm
                                            .hot_archive)
+            self.history.poll_deferred_publishes()
+        if self.config.REPORT_METRICS:
+            import logging
+            from stellar_tpu.utils.metrics import registry
+            log = logging.getLogger("stellar_tpu.metrics")
+            snapshot = registry.to_dict()
+            for name in self.config.REPORT_METRICS:
+                if name in snapshot:
+                    log.info("metric %s: %s", name, snapshot[name])
         if self.database is not None:
             # HerderPersistence: the slot's SCP messages into scphistory
             # (reference HerderPersistenceImpl::saveSCPHistory)
@@ -564,6 +634,8 @@ class Application:
                        "home_domain": self.config.NODE_HOME_DOMAIN},
             "network": self.config.NETWORK_PASSPHRASE,
             "protocol_version": lcl.ledgerVersion,
+            "version": self.config.VERSION_STR or "stellar_tpu",
+            "validator_names": self.validator_names,
             "history": {
                 "published_checkpoints":
                     list(self.history.published_checkpoints)

@@ -462,6 +462,48 @@ def cmd_apply_load(args) -> int:
         apply_load, catchup_replay_bench, multisig_apply_load,
         scp_storm_bench, soroban_apply_load,
     )
+    if getattr(args, "conf", None):
+        # APPLY_LOAD_* overrides (reference apply-load reading Config):
+        # retune the process-wide soroban limits the scenarios build on
+        import dataclasses
+        from stellar_tpu.tx.ops import soroban_ops
+        cfg = _load_config(args)
+        overrides = {}
+        for cfg_name, field_name in (
+                ("APPLY_LOAD_TX_MAX_INSTRUCTIONS",
+                 "tx_max_instructions"),
+                ("APPLY_LOAD_LEDGER_MAX_INSTRUCTIONS",
+                 "ledger_max_instructions"),
+                ("APPLY_LOAD_TX_MAX_READ_LEDGER_ENTRIES",
+                 "tx_max_read_ledger_entries"),
+                ("APPLY_LOAD_TX_MAX_WRITE_LEDGER_ENTRIES",
+                 "tx_max_write_ledger_entries"),
+                ("APPLY_LOAD_TX_MAX_READ_BYTES", "tx_max_read_bytes"),
+                ("APPLY_LOAD_TX_MAX_WRITE_BYTES",
+                 "tx_max_write_bytes"),
+                ("APPLY_LOAD_MAX_TX_COUNT", "ledger_max_tx_count"),
+                ("APPLY_LOAD_MAX_TX_SIZE_BYTES", "tx_max_size_bytes"),
+                ("APPLY_LOAD_MAX_LEDGER_TX_SIZE_BYTES",
+                 "ledger_max_txs_size_bytes"),
+                ("APPLY_LOAD_MAX_CONTRACT_EVENT_SIZE_BYTES",
+                 "tx_max_contract_events_size_bytes"),
+                ("APPLY_LOAD_LEDGER_MAX_READ_LEDGER_ENTRIES",
+                 "ledger_max_read_ledger_entries"),
+                ("APPLY_LOAD_LEDGER_MAX_READ_BYTES",
+                 "ledger_max_read_bytes"),
+                ("APPLY_LOAD_LEDGER_MAX_WRITE_LEDGER_ENTRIES",
+                 "ledger_max_write_ledger_entries"),
+                ("APPLY_LOAD_LEDGER_MAX_WRITE_BYTES",
+                 "ledger_max_write_bytes"),
+                ("APPLY_LOAD_DATA_ENTRY_SIZE_FOR_TESTING",
+                 "max_contract_data_entry_size")):
+            v = getattr(cfg, cfg_name, 0)
+            if v:
+                overrides[field_name] = v
+        if overrides:
+            base = soroban_ops.default_soroban_config()
+            soroban_ops._DEFAULT_CONFIG = dataclasses.replace(
+                base, **overrides)
     mode = getattr(args, "verify", "auto")
     if mode == "device":
         # force every verification through the device batch verifier

@@ -106,6 +106,22 @@ class CommandHandler:
             out = {"status": names.get(res.code, "?")}
             if res.tx_result is not None:
                 out["error_result_code"] = res.tx_result.code
+                if self.app.config \
+                        .ENABLE_DIAGNOSTICS_FOR_TX_SUBMISSION:
+                    # full result XDR for failed submissions
+                    # (reference ENABLE_DIAGNOSTICS_FOR_TX_SUBMISSION)
+                    from stellar_tpu.xdr.runtime import to_bytes as _tb
+                    xdr_res = res.tx_result.to_xdr() \
+                        if hasattr(res.tx_result, "to_xdr") \
+                        else res.tx_result
+                    try:
+                        from stellar_tpu.xdr.results import (
+                            TransactionResult,
+                        )
+                        out["diagnostic_result_xdr"] = base64.b64encode(
+                            _tb(TransactionResult, xdr_res)).decode()
+                    except Exception:
+                        pass
             return out
         return self._on_main(submit)
 
@@ -329,9 +345,27 @@ class CommandHandler:
         from stellar_tpu.xdr.runtime import from_bytes, to_bytes
         from stellar_tpu.xdr.types import LedgerEntry, LedgerKey
         keys = params.get("key", [])
+        want_seq = params.get("ledgerSeq", [None])[0]
 
         def run():
-            out = {"ledgerSeq": self.app.lm.ledger_seq, "entries": []}
+            cur = self.app.lm.ledger_seq
+            out = {"ledgerSeq": cur, "entries": []}
+            if want_seq is not None:
+                # reference QUERY_SNAPSHOT_LEDGERS: queries may only
+                # address the retained snapshot window; this node
+                # serves ONE snapshot (the LCL), so anything but the
+                # current ledger is answered with the window error or
+                # explicitly flagged as served-at-current
+                window = self.app.config.QUERY_SNAPSHOT_LEDGERS
+                seq = int(want_seq)
+                if not (cur - window <= seq <= cur):
+                    return {"error": "ledgerSeq outside the "
+                            f"{window}-ledger snapshot window"}
+                out["requestedLedgerSeq"] = seq
+                if seq != cur:
+                    out["note"] = ("historical snapshots are not "
+                                   "retained; entries are served at "
+                                   "the current ledger")
             for k in keys:
                 kb = bytes.fromhex(k)
                 from_bytes(LedgerKey, kb)  # validate

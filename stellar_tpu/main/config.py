@@ -50,6 +50,12 @@ class Config:
     # EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE)
     EXCLUDE_TRANSACTIONS_CONTAINING_OPERATION_TYPE: List[str] = \
         field(default_factory=list)
+    # arbitrage-flood damping (reference FLOOD_ARB_TX_*): per ledger,
+    # the first BASE_ALLOWANCE DEX/path-payment txs per source flood
+    # normally; beyond it each additional one floods with probability
+    # DAMPING_FACTOR^(n - allowance)
+    FLOOD_ARB_TX_BASE_ALLOWANCE: int = 5
+    FLOOD_ARB_TX_DAMPING_FACTOR: float = 0.8
     # flood pacing (reference FLOOD_* family, herder/overlay broadcast)
     FLOOD_OP_RATE_PER_LEDGER: float = 1.0
     FLOOD_TX_PERIOD_MS: int = 200
@@ -104,6 +110,12 @@ class Config:
     # buckets below the cutoff are served from memory, not index+seek
     BUCKETLIST_DB_INDEX_CUTOFF: int = 20 * 1024 * 1024
     BUCKETLIST_DB_PERSIST_INDEX: bool = True
+    # reference BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT tunes its
+    # RANGE-index page granularity; this implementation indexes every
+    # bucket file with a per-entry individual index (strictly finer
+    # than any page size), so the knob is accepted for config
+    # compatibility and has no effect by design
+    BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT: int = 14
     # LedgerTxnRoot prefetch cache entries + per-sweep batch bound
     ENTRY_CACHE_SIZE: int = 100_000
     PREFETCH_BATCH_SIZE: int = 1_000
@@ -121,8 +133,48 @@ class Config:
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)
+    # seconds to wait after a checkpoint boundary before publishing
+    # (reference PUBLISH_TO_ARCHIVE_DELAY)
+    PUBLISH_TO_ARCHIVE_DELAY: int = 0
+
+    # node modes (reference MODE_* family: run-mode capability flags
+    # derived from the command in the reference; explicit here)
+    MODE_ENABLES_BUCKETLIST: bool = True
+    MODE_USES_IN_MEMORY_LEDGER: bool = False
+    MODE_STORES_HISTORY_LEDGERHEADERS: bool = True
+    MODE_STORES_HISTORY_MISC: bool = True
+    # start SCP from the LCL immediately instead of waiting to hear
+    # from the network (reference FORCE_SCP)
+    FORCE_SCP: bool = False
 
     # ops / observability
+    # metric names logged after every externalized ledger (reference
+    # REPORT_METRICS)
+    REPORT_METRICS: List[str] = field(default_factory=list)
+    # sliding-window length (seconds) for timer percentiles
+    # (reference HISTOGRAM_WINDOW_SIZE)
+    HISTOGRAM_WINDOW_SIZE: int = 300
+    # node-id strkey -> human name for quorum/log output (reference
+    # VALIDATOR_NAMES; merged with names from VALIDATORS entries)
+    VALIDATOR_NAMES: Dict[str, str] = field(default_factory=dict)
+    # version-string override for /info and `version` (reference
+    # VERSION_STR; empty = built-in)
+    VERSION_STR: str = ""
+    # tx-submission responses carry soroban diagnostic events for
+    # failed txs (reference ENABLE_DIAGNOSTICS_FOR_TX_SUBMISSION)
+    ENABLE_DIAGNOSTICS_FOR_TX_SUBMISSION: bool = False
+    # keep debug LedgerCloseMeta for the last N ledgers in memory for
+    # the dump-debug-meta admin surface (reference METADATA_DEBUG_LEDGERS)
+    METADATA_DEBUG_LEDGERS: int = 0
+    # emission shape flags (reference EMIT_*_EXT_V1)
+    EMIT_LEDGER_CLOSE_META_EXT_V1: bool = False
+    EMIT_SOROBAN_TRANSACTION_META_EXT_V1: bool = False
+    # query server: how many recent ledger snapshots stay addressable
+    # (reference QUERY_SNAPSHOT_LEDGERS)
+    QUERY_SNAPSHOT_LEDGERS: int = 4
+    # cross-check every best-offer lookup against a full scan
+    # (reference BEST_OFFER_DEBUGGING_ENABLED; expensive, tests only)
+    BEST_OFFER_DEBUGGING_ENABLED: bool = False
     LOG_LEVEL: str = "INFO"
     LOG_FILE_PATH: Optional[str] = None
     LOG_COLOR: bool = False
@@ -173,6 +225,67 @@ class Config:
         field(default_factory=list)
     TESTING_EVICTION_SCAN_SIZE: int = 0  # 0 = scanner default
     TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME: int = 0  # 0 = protocol
+    # eviction-scan shaping (reference OVERRIDE_EVICTION_PARAMS_FOR_
+    # TESTING + TESTING_STARTING_EVICTION_SCAN_LEVEL +
+    # TESTING_MAX_ENTRIES_TO_ARCHIVE): the override flag arms the two
+    # values; scan starts at the given bucket level and archives at
+    # most N persistent entries per close
+    OVERRIDE_EVICTION_PARAMS_FOR_TESTING: bool = False
+    TESTING_STARTING_EVICTION_SCAN_LEVEL: int = 6
+    TESTING_MAX_ENTRIES_TO_ARCHIVE: int = 100
+    # halve every level's spill cadence so merges hit deep levels in
+    # few ledgers (reference ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_
+    # TESTING)
+    ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING: bool = False
+    # replay trusts archived results and skips per-signature
+    # verification for ledgers whose results are already known
+    # (reference CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING)
+    CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING: bool = False
+
+    # synthetic-load shaping (reference LOADGEN_* family): value lists
+    # with matching weight lists; the load generator samples them
+    # deterministically per tx
+    LOADGEN_OP_COUNT_FOR_TESTING: List[int] = field(default_factory=list)
+    LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_TX_SIZE_BYTES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_TX_SIZE_BYTES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_INSTRUCTIONS_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_INSTRUCTIONS_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_IO_KILOBYTES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_IO_KILOBYTES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_NUM_DATA_ENTRIES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_NUM_DATA_ENTRIES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_WASM_BYTES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    LOADGEN_WASM_BYTES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+
+    # apply-load soroban-limit overrides (reference APPLY_LOAD_*):
+    # 0 = keep the scenario default
+    APPLY_LOAD_TX_MAX_INSTRUCTIONS: int = 0
+    APPLY_LOAD_LEDGER_MAX_INSTRUCTIONS: int = 0
+    APPLY_LOAD_TX_MAX_READ_LEDGER_ENTRIES: int = 0
+    APPLY_LOAD_LEDGER_MAX_READ_LEDGER_ENTRIES: int = 0
+    APPLY_LOAD_TX_MAX_WRITE_LEDGER_ENTRIES: int = 0
+    APPLY_LOAD_LEDGER_MAX_WRITE_LEDGER_ENTRIES: int = 0
+    APPLY_LOAD_TX_MAX_READ_BYTES: int = 0
+    APPLY_LOAD_LEDGER_MAX_READ_BYTES: int = 0
+    APPLY_LOAD_TX_MAX_WRITE_BYTES: int = 0
+    APPLY_LOAD_LEDGER_MAX_WRITE_BYTES: int = 0
+    APPLY_LOAD_MAX_TX_COUNT: int = 0
+    APPLY_LOAD_MAX_TX_SIZE_BYTES: int = 0
+    APPLY_LOAD_MAX_LEDGER_TX_SIZE_BYTES: int = 0
+    APPLY_LOAD_MAX_CONTRACT_EVENT_SIZE_BYTES: int = 0
+    APPLY_LOAD_DATA_ENTRY_SIZE_FOR_TESTING: int = 0
     CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING: bool = False
 
     def network_id(self) -> bytes:

@@ -264,6 +264,9 @@ class OverlayManager:
         already half-full (reference flushAdvertTimer)."""
         from stellar_tpu.overlay.tx_adverts import ADVERT_FLUSH_SIZE
         from stellar_tpu.utils.metrics import registry
+        if not self._arb_flood_admit(frame):
+            registry.meter("overlay.flood.arb-damped").mark()
+            return
         registry.meter("overlay.flood.advertised").mark()
         tx_hash = frame.contents_hash()
         skip = {id(from_peer)} if from_peer is not None else set()
@@ -276,6 +279,41 @@ class OverlayManager:
                 full = True
         if full or self.advert_period_s <= 0:
             self.tx_adverts.flush(self._peers_by_id())
+
+    def _arb_flood_admit(self, frame) -> bool:
+        """Arbitrage-flood damping (reference FLOOD_ARB_TX_BASE_
+        ALLOWANCE / FLOOD_ARB_TX_DAMPING_FACTOR): per source and
+        ledger, the first ``allowance`` DEX-crossing txs (path
+        payments / offers) flood normally; each one beyond floods with
+        probability damping^(n - allowance), decided deterministically
+        from the tx hash so every node damps the same txs."""
+        cfg = self.app.config
+        allowance = getattr(cfg, "FLOOD_ARB_TX_BASE_ALLOWANCE", 0)
+        if allowance <= 0:
+            return True
+        from stellar_tpu.xdr.tx import OperationType as OT
+        dex_ops = (OT.PATH_PAYMENT_STRICT_RECEIVE,
+                   OT.PATH_PAYMENT_STRICT_SEND, OT.MANAGE_SELL_OFFER,
+                   OT.MANAGE_BUY_OFFER,
+                   OT.CREATE_PASSIVE_SELL_OFFER)
+        inner = getattr(frame, "inner", frame)
+        if not any(op.body.arm in dex_ops
+                   for op in inner.tx.operations):
+            return True
+        src = inner.source_account_id().value
+        counts = getattr(self, "_arb_counts", None)
+        if counts is None:
+            counts = self._arb_counts = {}
+        n = counts.get(src, 0)
+        counts[src] = n + 1
+        if n < allowance:
+            return True
+        damping = getattr(cfg, "FLOOD_ARB_TX_DAMPING_FACTOR", 1.0)
+        p = damping ** (n + 1 - allowance)
+        # deterministic coin: the tx hash's first 8 bytes as a
+        # fraction of 2^64
+        h = int.from_bytes(frame.contents_hash()[:8], "big")
+        return (h / (1 << 64)) < p
 
     def flush_adverts_tick(self):
         """Recurring advert flush (reference FLOOD_ADVERT_PERIOD_MS
@@ -505,6 +543,8 @@ class OverlayManager:
                 self._flood(msg, from_peer=peer)
 
     def ledger_closed(self, ledger_seq: int):
+        # arb damping counts are per-ledger
+        self._arb_counts = {}
         self._drain_preverified(block=True)
         self.floodgate.clear_below(ledger_seq)
         peers = self._peers_by_id()

@@ -41,6 +41,33 @@ class LoadGenerator:
     def account_keys(self):
         return self.accounts
 
+    def _cfg_sample(self, base: str, default: int) -> int:
+        """Weighted sample from the LOADGEN_{base}_FOR_TESTING value /
+        _DISTRIBUTION_FOR_TESTING weight lists (reference LOADGEN_*
+        shaping family). Deterministic: the nth submitted tx picks by
+        cumulative weight, so load shapes reproduce run to run."""
+        cfg = getattr(self.app, "config", None)
+        values = getattr(cfg, f"LOADGEN_{base}_FOR_TESTING", None) \
+            if cfg is not None else None
+        if not values:
+            return default
+        weights = getattr(
+            cfg, f"LOADGEN_{base}_DISTRIBUTION_FOR_TESTING", None) or \
+            [1] * len(values)
+        if len(weights) != len(values):
+            raise ValueError(f"LOADGEN_{base} value/weight "
+                             "lengths differ")
+        total = sum(weights)
+        if total <= 0:
+            raise ValueError(f"LOADGEN_{base} weights sum to zero")
+        pick = (self.submitted * 2654435761) % total  # Knuth hash
+        acc = 0
+        for v, w in zip(values, weights):
+            acc += w
+            if pick < acc:
+                return v
+        return values[-1]
+
     def _next_seq(self, src: SecretKey) -> Optional[int]:
         from stellar_tpu.ledger.ledger_txn import key_bytes
         from stellar_tpu.tx.op_frame import account_key
@@ -93,8 +120,11 @@ class LoadGenerator:
                 continue
             if mode == "pay" or (mode == "mixed_classic_soroban"
                                  and i % 2 == 0):
+                # LOADGEN_OP_COUNT shaping: n payments per tx
+                n_ops = max(1, self._cfg_sample("OP_COUNT", 1))
                 dst = self.accounts[(i + 1) % len(self.accounts)]
-                tx = make_tx(src, seq, [payment_op(dst, XLM)],
+                tx = make_tx(src, seq,
+                             [payment_op(dst, XLM)] * n_ops,
                              network_id=herder.network_id)
             elif mode == "create":
                 # skip over accounts that already exist (repeat runs /
@@ -123,7 +153,16 @@ class LoadGenerator:
                                      lowThreshold=None, medThreshold=None,
                                      highThreshold=None, homeDomain=None,
                                      signer=None)))
-                tx = make_tx(src, seq, [op],
+                # LOADGEN_OP_COUNT / TX_SIZE_BYTES shaping: op count,
+                # plus a text memo padding toward the size target
+                n_ops = max(1, self._cfg_sample("OP_COUNT", 1))
+                memo = None
+                pad = self._cfg_sample("TX_SIZE_BYTES", 0)
+                if pad:
+                    from stellar_tpu.xdr.tx import Memo, MemoType
+                    memo = Memo.make(MemoType.MEMO_TEXT,
+                                     b"x" * min(28, pad))
+                tx = make_tx(src, seq, [op] * n_ops, memo=memo,
                              network_id=herder.network_id)
             elif mode == "soroban_upload":
                 tx = self._upload_tx(src, seq, unique=self.submitted)
@@ -133,11 +172,25 @@ class LoadGenerator:
 
     # ---------------- soroban builders ----------------
 
-    def _counter_code(self, unique: int = 0) -> bytes:
+    def _counter_code(self, unique: int = 0, pad_to: int = 0) -> bytes:
+        """``pad_to`` pads the body toward the LOADGEN_WASM_BYTES
+        target with an unexecuted function holding a bytes blob."""
         from stellar_tpu.soroban.host import (
-            assemble_program, ins, sym, u32,
+            assemble_program, ins, scbytes, sym, u32,
         )
-        return assemble_program({
+        if pad_to:
+            base = len(self._counter_code(unique))
+            if pad_to > base + 64:
+                return assemble_program({
+                    "zpad": [ins("push",
+                                 scbytes(b"\x00" * (pad_to - base - 64)))],
+                    **self._counter_program(unique),
+                })
+        return assemble_program(self._counter_program(unique))
+
+    def _counter_program(self, unique: int = 0) -> dict:
+        from stellar_tpu.soroban.host import ins, sym, u32
+        return {
             "incr": [
                 ins("push", u32(unique)), ins("drop"),
                 ins("push", sym("count")), ins("has", sym("persistent")),
@@ -151,7 +204,7 @@ class LoadGenerator:
                 ins("put", sym("persistent")),
                 ins("ret"),
             ],
-        })
+        }
 
     def _upload_tx(self, src, seq, unique: int = 0):
         """SOROBAN_UPLOAD: each tx uploads a distinct contract body
@@ -162,7 +215,8 @@ class LoadGenerator:
         from stellar_tpu.xdr.contract import (
             HostFunction, HostFunctionType,
         )
-        code = self._counter_code(unique)
+        code = self._counter_code(
+            unique, pad_to=self._cfg_sample("WASM_BYTES", 0))
         fn = HostFunction.make(
             HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
             code)
@@ -208,9 +262,21 @@ class LoadGenerator:
             ContractDataDurability.PERSISTENT)
         counter_key = contract_data_key(
             addr, sym("count"), ContractDataDurability.PERSISTENT)
+        # LOADGEN shaping: declared instructions / io bytes / extra
+        # data-entry footprint per the configured distributions
+        insns = self._cfg_sample("INSTRUCTIONS", 2_000_000)
+        io_kb = self._cfg_sample("IO_KILOBYTES", 3)
+        extra_rw = [
+            contract_data_key(addr, sym(f"pad{j}"),
+                              ContractDataDurability.PERSISTENT)
+            for j in range(max(
+                0, self._cfg_sample("NUM_DATA_ENTRIES", 1) - 1))]
         sd = _soroban_data(
             read_only=[inst_key, contract_code_key(self._code_hash)],
-            read_write=[counter_key])
+            read_write=[counter_key] + extra_rw,
+            instructions=insns,
+            read_bytes=max(1, io_kb) * 1024,
+            write_bytes=max(1, io_kb) * 1024)
         return make_tx(src, seq, [_soroban_op(fn)], fee=6_000_000,
                        soroban_data=sd,
                        network_id=self.app.herder.network_id)

@@ -249,6 +249,12 @@ def offer_key(seller_id, offer_id: int):
                                          offerID=offer_id))
 
 
+# cross-check every best-offer selection against an independent
+# re-scan (reference BEST_OFFER_DEBUGGING_ENABLED, pushed from Config;
+# expensive — test runs only)
+BEST_OFFER_DEBUGGING = False
+
+
 def load_best_offer(ltx, selling, buying, skip_ids=()):
     """Best (lowest price, oldest id) live offer selling ``selling`` for
     ``buying`` (the order-book index role of ``getBestOffer``)."""
@@ -264,6 +270,17 @@ def load_best_offer(ltx, selling, buying, skip_ids=()):
                 (o.price.n * best.price.d, o.offerID) < \
                 (best.price.n * o.price.d, best.offerID):
             best = o
+    if BEST_OFFER_DEBUGGING and best is not None:
+        # no surviving candidate may beat the selection (guards the
+        # comparison logic and iteration-order independence)
+        for le in ltx.all_entries_of_type(LedgerEntryType.OFFER):
+            o = le.data.value
+            if o.selling != selling or o.buying != buying or \
+                    o.offerID in skip_ids:
+                continue
+            assert (best.price.n * o.price.d, best.offerID) <= \
+                (o.price.n * best.price.d, o.offerID), \
+                "best-offer selection beaten by a surviving candidate"
     return best
 
 

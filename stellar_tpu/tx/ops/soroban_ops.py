@@ -244,6 +244,10 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                     InvCode.INVOKE_HOST_FUNCTION_INSUFFICIENT_REFUNDABLE_FEE)
             self.parent_tx.note_soroban_consumption(refundable_consumed,
                                                     out.events)
+            # retained for the close meta's sorobanMeta block
+            self.parent_tx._soroban_meta_info = (
+                out.return_value, out.events, non_ref,
+                refundable_consumed, rent_fee)
 
             preimage = InvokeHostFunctionSuccessPreImage(
                 returnValue=out.return_value, events=out.events)

@@ -25,8 +25,15 @@ class Counter:
         return {"type": "counter", "count": self.count}
 
 
+# sliding-window length for meters/rates (reference
+# HISTOGRAM_WINDOW_SIZE; pushed from Config by the Application —
+# default matches the Config default so changed()-gated pushes stay
+# consistent)
+WINDOW_SECONDS = 300.0
+
+
 class Meter:
-    """Event rate: count + 1-minute-window rate."""
+    """Event rate: count + sliding-window rate."""
 
     def __init__(self):
         self.count = 0
@@ -36,12 +43,12 @@ class Meter:
         self.count += n
         now = time.monotonic()
         self._events.append(now)
-        cutoff = now - 60.0
+        cutoff = now - WINDOW_SECONDS
         while self._events and self._events[0] < cutoff:
             self._events.pop(0)
 
     def one_minute_rate(self) -> float:
-        return len(self._events) / 60.0
+        return len(self._events) / WINDOW_SECONDS
 
     def to_dict(self):
         return {"type": "meter", "count": self.count,

@@ -31,9 +31,14 @@ def _app(tmp_path=None, **overrides):
 def teardown_function(_fn):
     # knob hygiene: module-level flags back to defaults
     from stellar_tpu.bucket import bucket_index as bi
+    from stellar_tpu.bucket import bucket_list as bl
     from stellar_tpu.bucket import bucket_manager as bm
+    from stellar_tpu.catchup import catchup as cu
+    from stellar_tpu.ledger import ledger_manager as lmm
     from stellar_tpu.soroban import host as sh
+    from stellar_tpu.tx import offer_exchange as oe
     from stellar_tpu.tx import transaction_frame as txf
+    from stellar_tpu.utils import metrics as mt
     from stellar_tpu.utils import workers
     workers.set_background(True)
     txf.HALT_ON_INTERNAL_ERROR = False
@@ -43,6 +48,12 @@ def teardown_function(_fn):
     bm.BUCKET_GC = True
     bi.INDEX_CUTOFF_BYTES = 20 * 1024 * 1024
     bi.PERSIST_INDEX = True
+    bl.REDUCE_MERGE_COUNTS = False
+    oe.BEST_OFFER_DEBUGGING = False
+    cu.SKIP_KNOWN_RESULTS = False
+    mt.WINDOW_SECONDS = 300.0
+    lmm.EMIT_LEDGER_CLOSE_META_EXT_V1 = False
+    lmm.EMIT_SOROBAN_TX_META_EXT_V1 = False
 
 
 def test_example_config_loads_every_field(tmp_path):
@@ -242,3 +253,186 @@ def test_flood_rate_quota_paces_adverts():
     ov.ledger_closed(2)
     sent_hashes = sum(len(m.value.txHashes) for m in p.sent)
     assert sent_hashes == 50
+
+
+# ---------------------------------------------------------------------------
+# r4 config tail (VERDICT r3 #8)
+# ---------------------------------------------------------------------------
+
+def test_mode_knobs_consumed():
+    app, cfg, a, root = _app(MODE_ENABLES_BUCKETLIST=False)
+    assert app.lm.bucket_list is None
+    app2, *_ = _app(MODE_ENABLES_BUCKETLIST=True)
+    assert app2.lm.bucket_list is not None
+
+
+def test_report_metrics_and_window_knobs():
+    from stellar_tpu.utils import metrics as mt
+    app, cfg, a, root = _app(HISTOGRAM_WINDOW_SIZE=120,
+                             REPORT_METRICS=["herder.lost-sync"])
+    assert mt.WINDOW_SECONDS == 120.0
+
+
+def test_emit_meta_ext_v1_knobs():
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import LedgerCloseData
+    app, cfg, a, root = _app(EMIT_LEDGER_CLOSE_META_EXT_V1=True)
+    metas = []
+    app.lm.close_meta_stream.append(metas.append)
+    txset, _ = make_tx_set_from_transactions(
+        [], app.lm.last_closed_header, app.lm.last_closed_hash)
+    app.lm.close_ledger(LedgerCloseData(
+        app.lm.ledger_seq + 1, txset, 99999))
+    assert metas and metas[0].value.ext.arm == 1
+    assert metas[0].value.ext.value.sorobanFeeWrite1KB == \
+        app.lm.soroban_config.fee_write_1kb
+
+
+def test_reduce_merge_counts_knob_halves_level_sizes():
+    from stellar_tpu.bucket import bucket_list as bl
+    base = bl.level_size(2)
+    _app(ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING=True)
+    assert bl.level_size(2) == base // 2
+
+
+def test_eviction_archive_cap_knob():
+    app, cfg, a, root = _app(
+        OVERRIDE_EVICTION_PARAMS_FOR_TESTING=True,
+        TESTING_MAX_ENTRIES_TO_ARCHIVE=7)
+    assert app.lm.eviction_scanner.max_archive_entries == 7
+    with pytest.raises(ValueError):
+        _app(OVERRIDE_EVICTION_PARAMS_FOR_TESTING=True,
+             TESTING_STARTING_EVICTION_SCAN_LEVEL=99)
+
+
+def test_catchup_skip_known_results_knob():
+    from stellar_tpu.catchup import catchup as cu
+    _app(CATCHUP_SKIP_KNOWN_RESULTS_FOR_TESTING=True)
+    assert cu.SKIP_KNOWN_RESULTS is True
+
+
+def test_validator_names_and_version_in_info():
+    app, cfg, a, root = _app(
+        VERSION_STR="tpu-test-build",
+        VALIDATOR_NAMES={"GABC": "alpha"})
+    info = app.info()
+    assert info["version"] == "tpu-test-build"
+    assert info["validator_names"]["GABC"] == "alpha"
+
+
+def test_metadata_debug_ledgers_retention():
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import LedgerCloseData
+    app, cfg, a, root = _app(METADATA_DEBUG_LEDGERS=2)
+    for _ in range(4):
+        txset, _ = make_tx_set_from_transactions(
+            [], app.lm.last_closed_header, app.lm.last_closed_hash)
+        app.lm.close_ledger(LedgerCloseData(
+            app.lm.ledger_seq + 1, txset,
+            app.lm.last_closed_header.scpValue.closeTime + 5))
+    assert len(app.debug_meta) == 2  # only the last N retained
+
+
+def test_arb_flood_damping():
+    """Beyond the allowance, DEX txs from one source are damped
+    deterministically; plain payments never are."""
+    from stellar_tpu.tx.tx_test_utils import TEST_NETWORK_ID
+    from stellar_tpu.xdr.tx import (
+        ManageSellOfferOp, Operation, OperationBody, OperationType,
+        Price,
+    )
+    from stellar_tpu.xdr.types import NATIVE_ASSET, account_id
+    app, cfg, a, root = _app(FLOOD_ARB_TX_BASE_ALLOWANCE=2,
+                             FLOOD_ARB_TX_DAMPING_FACTOR=0.0)
+    ov = app.overlay
+    alt = __import__("stellar_tpu.tx.tx_test_utils",
+                     fromlist=["keypair"]).keypair("arb-asset")
+    from stellar_tpu.xdr.types import asset_alphanum4
+    asset = asset_alphanum4(b"ARB\x00",
+                            account_id(alt.public_key.raw))
+    admitted = []
+    for i in range(5):
+        op = Operation(sourceAccount=None, body=OperationBody.make(
+            OperationType.MANAGE_SELL_OFFER,
+            ManageSellOfferOp(selling=NATIVE_ASSET, buying=asset,
+                              amount=1000, price=Price(n=1, d=1),
+                              offerID=0)))
+        tx = make_tx(a, (1 << 32) + 1 + i, [op],
+                     network_id=TEST_NETWORK_ID)
+        admitted.append(ov._arb_flood_admit(tx))
+    # allowance=2, damping=0 -> exactly the first two admitted
+    assert admitted == [True, True, False, False, False]
+    # non-DEX traffic is never damped
+    pay = make_tx(a, (1 << 32) + 9, [payment_op(a, XLM)],
+                  network_id=TEST_NETWORK_ID)
+    assert ov._arb_flood_admit(pay)
+    # counts reset at ledger close
+    ov.ledger_closed(app.lm.ledger_seq)
+    assert ov._arb_flood_admit(
+        make_tx(a, (1 << 32) + 10, [op], network_id=TEST_NETWORK_ID))
+
+
+def test_loadgen_shaping_knobs():
+    from stellar_tpu.simulation.load_generator import LoadGenerator
+    app, cfg, a, root = _app(
+        LOADGEN_OP_COUNT_FOR_TESTING=[3],
+        LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING=[1])
+    gen = LoadGenerator(app)
+    assert gen._cfg_sample("OP_COUNT", 1) == 3
+    # weighted: with one weight at zero the other value always wins
+    cfg.LOADGEN_OP_COUNT_FOR_TESTING = [2, 9]
+    cfg.LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING = [0, 5]
+    assert all(gen._cfg_sample("OP_COUNT", 1) == 9 for _ in range(3))
+    cfg.LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING = [1]
+    with pytest.raises(ValueError):
+        gen._cfg_sample("OP_COUNT", 1)
+
+
+def test_soroban_ledger_caps_enforced_at_set_building():
+    """The new ledger-aggregate access caps drop over-cap soroban txs
+    at set building (reference ledgerMaxRead*/Write* limits)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from stellar_tpu.herder.tx_set import _enforce_soroban_ledger_caps
+    from stellar_tpu.ledger.network_config import SorobanNetworkConfig
+    from stellar_tpu.simulation.load_generator import _soroban_data
+    from stellar_tpu.soroban.host import contract_code_key
+    from stellar_tpu.tx.tx_test_utils import TEST_NETWORK_ID
+    from stellar_tpu.xdr.contract import HostFunction, HostFunctionType
+    from test_soroban import soroban_op
+    frames = []
+    for i in range(4):
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+            b"\x00asm" + bytes([i]))
+        sd = _soroban_data(
+            read_write=[contract_code_key(bytes([i]) * 32)],
+            read_bytes=1000, write_bytes=1000)
+        frames.append(make_tx(a_kp := keypair(f"cap-{i}"),
+                              (1 << 32) + 1, [soroban_op(fn)],
+                              fee=6_000_000, soroban_data=sd,
+                              network_id=TEST_NETWORK_ID))
+    cfg = dataclasses.replace(SorobanNetworkConfig(),
+                              ledger_max_read_bytes=2500)
+    kept, dropped = _enforce_soroban_ledger_caps(frames, cfg)
+    assert len(kept) == 2 and len(dropped) == 2
+
+
+def test_deep_spill_boundary_under_pessimized_merges():
+    """VERDICT r3 #8: cross a deep spill boundary under load with
+    pessimized (inline) merges + reduced merge counts and guard the
+    worst spill close against the p50 (the background-merge worst
+    case must stay bounded)."""
+    from stellar_tpu.bucket import bucket_list as bl
+    from stellar_tpu.simulation.load_generator import apply_load
+    from stellar_tpu.utils import workers
+    try:
+        bl.REDUCE_MERGE_COUNTS = True   # deep levels within 70 closes
+        workers.set_background(False)   # pessimized: merge inline
+        out = apply_load(n_ledgers=70, txs_per_ledger=10)
+        # level-3 spill boundary (size 64 at reduced counts) crossed
+        assert out["ledgers"] == 70
+        assert out["deep_spill_over_p50"] <= 25.0, out
+    finally:
+        bl.REDUCE_MERGE_COUNTS = False
+        workers.set_background(True)
