@@ -204,6 +204,9 @@ class Herder:
         self._trigger_timer = VirtualTimer(clock)
         self._stuck_timer = VirtualTimer(clock)
         self.request_scp_state = None  # overlay hook: pull peers' state
+        # overlay hook: settle off-crank preverification before a
+        # proposal is built (deterministic resolve point)
+        self.before_nomination: Optional[Callable] = None
         self._trigger_armed_for = 0
         self._last_trigger_at = 0.0
         # network hooks (set by overlay / simulation): fan out to peers
@@ -528,6 +531,12 @@ class Herder:
         this node's proposal."""
         if ledger_seq_to_trigger != self.lm.ledger_seq + 1:
             return
+        # deterministic resolve point: any off-crank pre-verified tx
+        # floods must land in the queues BEFORE the proposal is built
+        # (virtual-clock cranks would otherwise race real worker
+        # threads — the single-writer-crank determinism rule)
+        if self.before_nomination is not None:
+            self.before_nomination()
         self._last_trigger_at = self.clock.now()
         lcl = self.lm.last_closed_header
         frames = self.tx_queue.get_transactions() + \

@@ -440,11 +440,11 @@ class ApplicableTxSetFrame:
         from stellar_tpu.ledger.ledger_txn import soroban_config_of
         # per-ledger soroban aggregate access caps bind RECEIVED sets
         # too — a peer-built set over the caps must not validate
-        soroban_frames = [f for f in self.frames
-                          if id(f) in self._soroban_ids]
-        kept, over = _enforce_soroban_ledger_caps(
-            soroban_frames, soroban_config_of(ltx))
-        if over:
+        # (order-independent sum check; the builder uses the greedy
+        # priority walk)
+        if _soroban_ledger_caps_exceeded(
+                [f for f in self.frames if id(f) in self._soroban_ids],
+                soroban_config_of(ltx)):
             return False
         if self.soroban_tx_count() > \
                 soroban_config_of(ltx).ledger_max_tx_count:
@@ -579,6 +579,28 @@ class ApplicableTxSetFrame:
                 f"hash={self.hash.hex()[:8]})")
 
 
+def _declared_access(f):
+    res = (f.inner if hasattr(f, "inner") else f) \
+        .tx.ext.value.resources
+    return (len(res.footprint.readOnly) + len(res.footprint.readWrite),
+            res.readBytes,
+            len(res.footprint.readWrite),
+            res.writeBytes)
+
+
+def _soroban_ledger_caps_exceeded(frames, cfg) -> bool:
+    """Do the set's declared aggregates exceed the per-ledger caps?"""
+    caps = (cfg.ledger_max_read_ledger_entries,
+            cfg.ledger_max_read_bytes,
+            cfg.ledger_max_write_ledger_entries,
+            cfg.ledger_max_write_bytes)
+    totals = [0, 0, 0, 0]
+    for f in frames:
+        for i, d in enumerate(_declared_access(f)):
+            totals[i] += d
+    return any(t > c for t, c in zip(totals, caps))
+
+
 def _enforce_soroban_ledger_caps(frames, cfg):
     """Greedy per-LEDGER aggregate access caps over the soroban phase
     (reference ledgerMaxRead*/ledgerMaxWrite* set-building limits):
@@ -591,13 +613,7 @@ def _enforce_soroban_ledger_caps(frames, cfg):
     used = [0, 0, 0, 0]
     kept, dropped = [], []
     for f in frames:
-        res = (f.inner if hasattr(f, "inner") else f) \
-            .tx.ext.value.resources
-        decl = (len(res.footprint.readOnly) +
-                len(res.footprint.readWrite),
-                res.readBytes,
-                len(res.footprint.readWrite),
-                res.writeBytes)
+        decl = _declared_access(f)
         if all(u + d <= c for u, d, c in zip(used, decl, caps)):
             for i, d in enumerate(decl):
                 used[i] += d

@@ -266,7 +266,7 @@ class HistoryManager:
     def __init__(self, archives: List[FileArchive],
                  network_passphrase: str = "",
                  store_headers: bool = True, store_misc: bool = True,
-                 publish_delay_s: int = 0):
+                 publish_delay_s: int = 0, clock=None):
         self.archives = archives
         self.network_passphrase = network_passphrase
         self.builder = CheckpointBuilder()
@@ -276,9 +276,17 @@ class HistoryManager:
         self.store_headers = store_headers
         self.store_misc = store_misc
         # reference PUBLISH_TO_ARCHIVE_DELAY: seconds between cutting
-        # a checkpoint and uploading it
+        # a checkpoint and uploading it; the delay follows the APP
+        # clock (virtual in simulations) when one is provided
         self.publish_delay_s = publish_delay_s
-        self._deferred: List = []  # (due_monotonic, files, has_json)
+        self._clock = clock
+        self._deferred: List = []  # (due, files, has_json, checkpoint)
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock.now()
+        import time as _time
+        return _time.monotonic()
 
     # ---------------- per-close hook ----------------
 
@@ -292,18 +300,22 @@ class HistoryManager:
         hhe = LedgerHeaderHistoryEntry(
             hash=close_result.header_hash, header=header,
             ext=LedgerHeaderHistoryEntry._types[2].make(0))
-        the = TransactionHistoryEntry(
-            ledgerSeq=header.ledgerSeq,
-            txSet=TransactionSet(previousLedgerHash=header.previousLedgerHash,
-                                 txs=[]),
-            ext=TransactionHistoryEntry._types[2].make(1, tx_set.xdr))
-        rset = TransactionResultSet(results=[
-            _pair(f, r) for f, r in zip(
-                tx_set.get_txs_in_apply_order(), close_result.tx_results)])
-        tre = TransactionHistoryResultEntry(
-            ledgerSeq=header.ledgerSeq, txResultSet=rset,
-            ext=TransactionHistoryResultEntry._types[2].make(0))
-        if not self.store_misc:
+        if self.store_misc:
+            the = TransactionHistoryEntry(
+                ledgerSeq=header.ledgerSeq,
+                txSet=TransactionSet(
+                    previousLedgerHash=header.previousLedgerHash,
+                    txs=[]),
+                ext=TransactionHistoryEntry._types[2].make(
+                    1, tx_set.xdr))
+            rset = TransactionResultSet(results=[
+                _pair(f, r) for f, r in zip(
+                    tx_set.get_txs_in_apply_order(),
+                    close_result.tx_results)])
+            tre = TransactionHistoryResultEntry(
+                ledgerSeq=header.ledgerSeq, txResultSet=rset,
+                ext=TransactionHistoryResultEntry._types[2].make(0))
+        else:
             # headers only: empty tx/result records keep checkpoint
             # shape without the misc payload
             the = TransactionHistoryEntry(
@@ -378,9 +390,8 @@ class HistoryManager:
                    f"bucket-{hexhash}.xdr.gz")
             files[rel] = gzip.compress(bucket.serialize())
         if self.publish_delay_s > 0:
-            import time as _time
             self._deferred.append(
-                (_time.monotonic() + self.publish_delay_s, files,
+                (self._now() + self.publish_delay_s, files,
                  has_json, checkpoint))
         else:
             self._upload(files, has_json, checkpoint)
@@ -398,11 +409,17 @@ class HistoryManager:
         elapsed (called from the externalize hook)."""
         if not self._deferred:
             return
-        import time as _time
-        now = _time.monotonic()
+        now = self._now()
         ready = [d for d in self._deferred if d[0] <= now]
         self._deferred = [d for d in self._deferred if d[0] > now]
         for _due, files, has_json, checkpoint in ready:
+            self._upload(files, has_json, checkpoint)
+
+    def flush_deferred_publishes(self):
+        """Upload everything still deferred regardless of due time —
+        a stopping node must not lose cut checkpoints."""
+        deferred, self._deferred = self._deferred, []
+        for _due, files, has_json, checkpoint in deferred:
             self._upload(files, has_json, checkpoint)
 
     # ---------------- retrieval (consumer side) ----------------

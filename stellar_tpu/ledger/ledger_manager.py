@@ -456,7 +456,10 @@ class LedgerManager:
                 apply_order, result_pairs, result.tx_results,
                 result.tx_metas):
             soroban_meta = None
-            info = getattr(f, "_soroban_meta_info", None)
+            # the invoke op records on the frame it applied under —
+            # the INNER frame for fee bumps
+            info = getattr(getattr(f, "inner", f),
+                           "_soroban_meta_info", None)
             if info is not None:
                 rv, events, non_ref, refundable, rent = info
                 if EMIT_SOROBAN_TX_META_EXT_V1:

@@ -113,12 +113,14 @@ def soroban_config_of(ltx):
 
 
 def copy_entry(entry: LedgerEntry) -> LedgerEntry:
-    """Deep copy via the wire format — exact by construction."""
-    return from_bytes(LedgerEntry, to_bytes(LedgerEntry, entry))
+    """Deep copy via the compiled per-type copy plan (immutable leaves
+    share identity; containers re-materialize) — every ltx load pays
+    this, so it must not run the wire-format roundtrip."""
+    return LedgerEntry.copy(entry)
 
 
 def copy_header(header: LedgerHeader) -> LedgerHeader:
-    return from_bytes(LedgerHeader, to_bytes(LedgerHeader, header))
+    return LedgerHeader.copy(header)
 
 
 class EntryHandle:

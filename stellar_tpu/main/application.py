@@ -96,7 +96,8 @@ class Application:
                 config.NETWORK_PASSPHRASE,
                 store_headers=config.MODE_STORES_HISTORY_LEDGERHEADERS,
                 store_misc=config.MODE_STORES_HISTORY_MISC,
-                publish_delay_s=config.PUBLISH_TO_ARCHIVE_DELAY)
+                publish_delay_s=config.PUBLISH_TO_ARCHIVE_DELAY,
+                clock=self.clock)
         # debug close-meta retention (reference METADATA_DEBUG_LEDGERS)
         self.debug_meta = None
         if config.METADATA_DEBUG_LEDGERS > 0:

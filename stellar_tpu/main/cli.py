@@ -65,6 +65,10 @@ def cmd_run(args) -> int:
         while True:
             app.crank(block=True)
     except KeyboardInterrupt:
+        if app.history is not None:
+            # a stopping node must not lose cut-but-deferred
+            # checkpoints (PUBLISH_TO_ARCHIVE_DELAY)
+            app.history.flush_deferred_publishes()
         return 0
 
 

@@ -182,6 +182,8 @@ class OverlayManager:
         h.request_tx_set = self.fetch_tx_set
         h.request_quorum_set = self.fetch_quorum_set
         h.request_scp_state = self.request_scp_state
+        h.before_nomination = \
+            lambda: self._drain_preverified(block=True)
 
     def request_scp_state(self, from_slot: int):
         """Out-of-sync recovery: ask every authenticated peer for its

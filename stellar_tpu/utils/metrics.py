@@ -33,7 +33,9 @@ WINDOW_SECONDS = 300.0
 
 
 class Meter:
-    """Event rate: count + sliding-window rate."""
+    """Event rate: count + sliding-window rate (window length from
+    HISTOGRAM_WINDOW_SIZE; the exported JSON names the window so
+    consumers never misread the rate's denominator)."""
 
     def __init__(self):
         self.count = 0
@@ -47,12 +49,17 @@ class Meter:
         while self._events and self._events[0] < cutoff:
             self._events.pop(0)
 
-    def one_minute_rate(self) -> float:
+    def windowed_rate(self) -> float:
         return len(self._events) / WINDOW_SECONDS
+
+    # historical name, kept for callers that predate the configurable
+    # window
+    one_minute_rate = windowed_rate
 
     def to_dict(self):
         return {"type": "meter", "count": self.count,
-                "1m_rate": round(self.one_minute_rate(), 4)}
+                "window_s": WINDOW_SECONDS,
+                "rate": round(self.windowed_rate(), 4)}
 
 
 class Timer:

@@ -185,6 +185,9 @@ class _SCValLazy:
     def unpack(self, u):
         return self._real().unpack(u)
 
+    def copy(self, v):
+        return self._real().copy(v)
+
 
 SCVal = _SCValLazy()
 
@@ -339,6 +342,9 @@ class _AuthorizedInvocationLazy:
 
     def unpack(self, u):
         return self._real().unpack(u)
+
+    def copy(self, v):
+        return self._real().copy(v)
 
 
 class SorobanAuthorizedInvocation(Struct):

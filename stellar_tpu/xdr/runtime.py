@@ -117,6 +117,8 @@ class Unpacker:
 # ---------------- type objects ----------------
 
 class _Prim:
+    IMMUTABLE = True  # values are ints: copy by identity
+
     def __init__(self, packname, unpackname):
         self._p, self._u = packname, unpackname
 
@@ -126,6 +128,10 @@ class _Prim:
     def unpack(self, u: Unpacker):
         return getattr(u, self._u)()
 
+    @staticmethod
+    def copy(v):
+        return v
+
 
 Uint32 = _Prim("pack_uint", "unpack_uint")
 Int32 = _Prim("pack_int", "unpack_int")
@@ -134,6 +140,12 @@ Int64 = _Prim("pack_hyper", "unpack_hyper")
 
 
 class _Bool:
+    IMMUTABLE = True
+
+    @staticmethod
+    def copy(v):
+        return v
+
     def pack(self, p, v):
         p.pack_uint(1 if v else 0)
 
@@ -148,6 +160,12 @@ Bool = _Bool()
 
 
 class _Void:
+    IMMUTABLE = True
+
+    @staticmethod
+    def copy(v):
+        return v
+
     def pack(self, p, v):
         if v is not None:
             raise XdrError("void takes None")
@@ -160,6 +178,12 @@ Void = _Void()
 
 
 class Opaque:
+    IMMUTABLE = True  # values are bytes
+
+    @staticmethod
+    def copy(v):
+        return v
+
     def __init__(self, n: int):
         self.n = n
 
@@ -171,6 +195,12 @@ class Opaque:
 
 
 class VarOpaque:
+    IMMUTABLE = True
+
+    @staticmethod
+    def copy(v):
+        return v
+
     def __init__(self, maxlen: int = 0xFFFFFFFF):
         self.maxlen = maxlen
 
@@ -184,6 +214,12 @@ class VarOpaque:
 class XdrString:
     """XDR string<maxlen>; values are Python bytes (the reference treats
     string32/string64 as raw bytes too)."""
+
+    IMMUTABLE = True
+
+    @staticmethod
+    def copy(v):
+        return v
 
     def __init__(self, maxlen: int = 0xFFFFFFFF):
         self.maxlen = maxlen
@@ -200,6 +236,12 @@ class XdrString:
 class FixedArray:
     def __init__(self, elem, n: int):
         self.elem, self.n = elem, n
+        self._elem_immutable = getattr(elem, "IMMUTABLE", False)
+
+    def copy(self, v):
+        if self._elem_immutable:
+            return list(v)
+        return [self.elem.copy(e) for e in v]
 
     def pack(self, p, v):
         if len(v) != self.n:
@@ -214,6 +256,12 @@ class FixedArray:
 class VarArray:
     def __init__(self, elem, maxlen: int = 0xFFFFFFFF):
         self.elem, self.maxlen = elem, maxlen
+        self._elem_immutable = getattr(elem, "IMMUTABLE", False)
+
+    def copy(self, v):
+        if self._elem_immutable:
+            return list(v)
+        return [self.elem.copy(e) for e in v]
 
     def pack(self, p, v):
         if len(v) > self.maxlen:
@@ -232,6 +280,12 @@ class VarArray:
 class Option:
     def __init__(self, elem):
         self.elem = elem
+        self._elem_immutable = getattr(elem, "IMMUTABLE", False)
+
+    def copy(self, v):
+        if v is None or self._elem_immutable:
+            return v
+        return self.elem.copy(v)
 
     def pack(self, p, v):
         if v is None:
@@ -258,6 +312,12 @@ class Enum:
         self.by_value = {v: k for k, v in values.items()}
         for k, v in values.items():
             setattr(self, k, v)
+
+    IMMUTABLE = True  # values are plain ints
+
+    @staticmethod
+    def copy(v):
+        return v
 
     def pack(self, p, v):
         if v not in self.by_value:
@@ -318,15 +378,24 @@ class Struct(metaclass=_StructMeta):
         unpack_body = "\n".join(
             f"    out.{n} = _types[{i}].unpack(u)"
             for i, n in enumerate(cls._names)) or "    pass"
+        copy_body = "\n".join(
+            (f"    out.{n} = v.{n}"
+             if getattr(cls._types[i], "IMMUTABLE", False)
+             else f"    out.{n} = _types[{i}].copy(v.{n})")
+            for i, n in enumerate(cls._names)) or "    pass"
         src = (f"def _fast_pack(p, v):\n{pack_body}\n"
                f"def _fast_unpack(u):\n"
                f"    out = _cls.__new__(_cls)\n{unpack_body}\n"
+               f"    return out\n"
+               f"def _fast_copy(v):\n"
+               f"    out = _cls.__new__(_cls)\n{copy_body}\n"
                f"    return out\n")
         exec(src, ns)  # noqa: S102 - trusted, generated from FIELDS
         # plain functions (not staticmethod wrappers): every lookup goes
         # through cls.__dict__, bypassing the descriptor protocol
         cls._fast_pack = ns["_fast_pack"]
         cls._fast_unpack = ns["_fast_unpack"]
+        cls._fast_copy = ns["_fast_copy"]
 
     @classmethod
     def pack(cls, p: Packer, v: "Struct"):
@@ -365,6 +434,16 @@ class Struct(metaclass=_StructMeta):
             cls._compile_codecs()
             fast = cls.__dict__["_fast_unpack"]
         return fast(u)
+
+    @classmethod
+    def copy(cls, v: "Struct") -> "Struct":
+        """Deep copy without the wire roundtrip: compiled straight-line
+        field copies, identity for immutable leaves."""
+        fast = cls.__dict__.get("_fast_copy")
+        if fast is None:
+            cls._compile_codecs()
+            fast = cls.__dict__["_fast_copy"]
+        return fast(v)
 
     def __eq__(self, other):
         return (type(self) is type(other)
@@ -428,6 +507,12 @@ class Union:
         arm = self.disc.unpack(u)
         t = self._armtype(arm)
         return Union.Value(arm, t.unpack(u))
+
+    def copy(self, v: "Union.Value") -> "Union.Value":
+        t = self._armtype(v.arm)
+        if getattr(t, "IMMUTABLE", False):
+            return Union.Value(v.arm, v.value)
+        return Union.Value(v.arm, t.copy(v.value))
 
 
 def to_bytes(t, v) -> bytes:

@@ -83,6 +83,9 @@ class _QuorumSetLazy:
     def unpack(self, u):
         return SCPQuorumSet.unpack(u)
 
+    def copy(self, v):
+        return SCPQuorumSet.copy(v)
+
 
 class SCPQuorumSet(Struct):
     FIELDS = [("threshold", Uint32),

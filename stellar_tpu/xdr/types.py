@@ -328,6 +328,9 @@ class _ClaimPredicate:
     def unpack(self, u):
         return self._real().unpack(u)
 
+    def copy(self, v):
+        return self._real().copy(v)
+
 
 ClaimPredicate = _ClaimPredicate()
 
@@ -429,6 +432,9 @@ class _LazyArm:
 
     def unpack(self, u):
         return self._real().unpack(u)
+
+    def copy(self, v):
+        return self._real().copy(v)
 
 
 def _contract_data_entry():
