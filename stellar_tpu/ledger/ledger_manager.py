@@ -435,6 +435,31 @@ class LedgerManager:
                 consumer(meta)
         return result
 
+    @staticmethod
+    def _wrap_diagnostics(diags, in_success: bool = True):
+        """Host log/diagnostic SCVals -> DiagnosticEvent records (the
+        reference wraps logs as DIAGNOSTIC-type events under a "log"
+        topic; populated only when diagnostics are enabled, never
+        consensus-visible). ``in_success=False`` marks diagnostics
+        from a failed invocation — the main debugging case."""
+        from stellar_tpu.xdr.contract import (
+            ContractEvent, ContractEventType, ContractEventV0, SCVal,
+            SCValType,
+        )
+        from stellar_tpu.xdr.ledger import DiagnosticEvent
+        from stellar_tpu.xdr.types import ExtensionPoint
+        out = []
+        for d in diags or ():
+            ev = ContractEvent(
+                ext=ExtensionPoint.make(0), contractID=None,
+                type=ContractEventType.DIAGNOSTIC,
+                body=ContractEvent._types[3].make(0, ContractEventV0(
+                    topics=[SCVal.make(SCValType.SCV_SYMBOL, b"log")],
+                    data=d)))
+            out.append(DiagnosticEvent(
+                inSuccessfulContractCall=in_success, event=ev))
+        return out
+
     def _build_close_meta(self, lcd, header, result, result_pairs,
                           apply_order, fee_results, upgrade_metas,
                           evicted_keys):
@@ -461,7 +486,8 @@ class LedgerManager:
             info = getattr(getattr(f, "inner", f),
                            "_soroban_meta_info", None)
             if info is not None:
-                rv, events, non_ref, refundable, rent = info
+                (ok, rv, events, non_ref, refundable, rent,
+                 diags) = info
                 if EMIT_SOROBAN_TX_META_EXT_V1:
                     sext = SorobanTransactionMetaExt.make(
                         1, SorobanTransactionMetaExtV1(
@@ -471,9 +497,15 @@ class LedgerManager:
                             rentFeeCharged=rent))
                 else:
                     sext = SorobanTransactionMetaExt.make(0)
+                from stellar_tpu.xdr.contract import (
+                    SCVal as _SCVal, SCValType as _SCVT,
+                )
                 soroban_meta = SorobanTransactionMeta(
-                    ext=sext, events=list(events), returnValue=rv,
-                    diagnosticEvents=[])
+                    ext=sext, events=list(events),
+                    returnValue=(rv if rv is not None
+                                 else _SCVal.make(_SCVT.SCV_VOID)),
+                    diagnosticEvents=self._wrap_diagnostics(
+                        diags, in_success=ok))
             v3 = TransactionMetaV3(
                 ext=ExtensionPoint.make(0),
                 txChangesBefore=list(meta.tx_changes_before),

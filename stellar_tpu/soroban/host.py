@@ -971,6 +971,7 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
     storage = _Storage(footprint_entries, read_only, read_write, budget,
                        ledger_seq)
     out = InvokeOutput(success=False)
+    host = None
     try:
         auth = _AuthContext(auth_entries, source_account, network_id,
                             ledger_seq, storage, _verify_sig)
@@ -1003,6 +1004,10 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
         out.diagnostics = host.diagnostics
     except HostError as e:
         out.error = e.kind
+        # diagnostics accumulated up to the failure still surface —
+        # debugging trapping contracts is their main use
+        if host is not None:
+            out.diagnostics = host.diagnostics
     out.cpu_insns = budget.cpu
     out.mem_bytes = budget.mem
     out.read_bytes = storage.read_bytes

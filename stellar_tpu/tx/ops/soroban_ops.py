@@ -176,6 +176,11 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                 cpu_limit=res.instructions, ledger_header=header)
 
             if not out.success:
+                # failed invokes emit no contract events but their
+                # diagnostics still surface in meta (the debugging
+                # case diagnostics exist for)
+                self.parent_tx._soroban_meta_info = (
+                    False, None, [], 0, 0, 0, out.diagnostics)
                 code = {
                     HostError.BUDGET:
                         InvCode.INVOKE_HOST_FUNCTION_RESOURCE_LIMIT_EXCEEDED,
@@ -246,8 +251,8 @@ class InvokeHostFunctionOpFrame(_SorobanBase):
                                                     out.events)
             # retained for the close meta's sorobanMeta block
             self.parent_tx._soroban_meta_info = (
-                out.return_value, out.events, non_ref,
-                refundable_consumed, rent_fee)
+                True, out.return_value, out.events, non_ref,
+                refundable_consumed, rent_fee, out.diagnostics)
 
             preimage = InvokeHostFunctionSuccessPreImage(
                 returnValue=out.return_value, events=out.events)

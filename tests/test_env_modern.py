@@ -767,3 +767,161 @@ def test_short_name_contract_runs(native):
         assert total == big + 37
     finally:
         host_mod.USE_NATIVE_WASM = old
+
+
+def test_diagnostics_flow_into_soroban_meta():
+    """With diagnostics enabled, in-contract logs surface as
+    DiagnosticEvent records in the close meta's sorobanMeta (never
+    consensus-visible — meta only)."""
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.soroban import host as host_mod
+    from stellar_tpu.soroban.host import contract_code_key
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, keypair, make_tx, seed_root_with_accounts,
+    )
+    from stellar_tpu.simulation.load_generator import (
+        _deploy_frames, _soroban_data, _soroban_op,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractEventType, HostFunction, HostFunctionType,
+        InvokeContractArgs,
+    )
+
+    # contract that logs "hi" from linear memory, by short name
+    b = ModuleBuilder()
+    mod, char = _short("log_from_linear_memory")
+    log_fn = b.import_func(mod, char, [I64, I64, I64, I64], [I64])
+    b.add_memory(1, export="memory")
+    b.add_data(0, b"hi")
+    c = Code()
+    c.i64_const(_u32v(0)).i64_const(_u32v(2))
+    c.i64_const(_u32v(0)).i64_const(_u32v(0)).call(log_fn)
+    b.add_func([], [I64], [], c, export="say")
+    code = b.build()
+
+    a = keypair("diag-meta")
+    root = seed_root_with_accounts([(a, 10**12)])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    lm.last_closed_header.ledgerVersion = \
+        CURRENT_LEDGER_PROTOCOL_VERSION
+    import dataclasses
+    lm.soroban_config = dataclasses.replace(
+        lm.soroban_config, ledger_max_tx_count=10)
+    lm.root.soroban_config = lm.soroban_config
+    metas = []
+    lm.close_meta_stream.append(metas.append)
+    seq = (lm.ledger_seq - 1) << 32
+    up, create, cid, code_hash, inst_key = _deploy_frames(
+        a, seq + 1, seq + 2, code, TEST_NETWORK_ID, salt=b"\x61" * 32)
+
+    def close(frames):
+        txset, exc = make_tx_set_from_transactions(
+            frames, lm.last_closed_header, lm.last_closed_hash,
+            soroban_config=lm.soroban_config)
+        assert not exc
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5))
+        assert res.failed_count == 0, [r.code for r in res.tx_results]
+
+    close([up])
+    close([create])
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=contract_address(cid),
+                           functionName=b"say", args=[]))
+    invoke = make_tx(a, seq + 3, [_soroban_op(fn)], fee=6_000_000,
+                     soroban_data=_soroban_data(
+                         read_only=[inst_key,
+                                    contract_code_key(code_hash)]),
+                     network_id=TEST_NETWORK_ID)
+    old = host_mod.DIAGNOSTIC_EVENTS_ENABLED
+    host_mod.DIAGNOSTIC_EVENTS_ENABLED = True
+    try:
+        close([invoke])
+    finally:
+        host_mod.DIAGNOSTIC_EVENTS_ENABLED = old
+    sm = metas[-1].value.txProcessing[0].txApplyProcessing.value \
+        .sorobanMeta
+    assert sm is not None
+    assert sm.diagnosticEvents, "log did not surface as a diagnostic"
+    ev = sm.diagnosticEvents[0].event
+    assert ev.type == ContractEventType.DIAGNOSTIC
+
+
+def test_failed_invoke_surfaces_diagnostics():
+    """Diagnostics logged before a trap still reach sorobanMeta,
+    flagged inSuccessfulContractCall=False — the debugging case the
+    reference emits them for."""
+    from stellar_tpu.soroban import host as host_mod
+    from stellar_tpu.soroban.host import (
+        _wrap_entry, contract_code_key, contract_data_key,
+        invoke_host_function, make_instance_val,
+    )
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+    from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+    from stellar_tpu.tx.tx_test_utils import TEST_NETWORK_ID, keypair
+    from stellar_tpu.xdr.contract import (
+        ContractCodeEntry, ContractDataDurability, ContractDataEntry,
+        HostFunction, HostFunctionType, InvokeContractArgs,
+    )
+    from stellar_tpu.xdr.types import (
+        ExtensionPoint, LedgerEntryType, account_id,
+    )
+    b = ModuleBuilder()
+    mod, char = _short("log_from_linear_memory")
+    log_fn = b.import_func(mod, char, [I64, I64, I64, I64], [I64])
+    b.add_memory(1, export="memory")
+    b.add_data(0, b"boom")
+    c = Code()
+    c.i64_const(_u32v(0)).i64_const(_u32v(4))
+    c.i64_const(_u32v(0)).i64_const(_u32v(0)).call(log_fn).drop()
+    c.unreachable()
+    b.add_func([], [I64], [], c, export="fail")
+    code = b.build()
+    code_hash = sha256(code)
+    from stellar_tpu.xdr.contract import contract_address
+    addr = contract_address(b"\x44" * 32)
+    inst_entry = ContractDataEntry(
+        ext=ExtensionPoint.make(0), contract=addr,
+        key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        durability=ContractDataDurability.PERSISTENT,
+        val=make_instance_val(code_hash))
+    code_entry = ContractCodeEntry(
+        ext=ContractCodeEntry._types[0].make(0), hash=code_hash,
+        code=code)
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    fp = {
+        key_bytes(inst_key): (_wrap_entry(
+            LedgerEntryType.CONTRACT_DATA, inst_entry, 1), None),
+        key_bytes(contract_code_key(code_hash)): (_wrap_entry(
+            LedgerEntryType.CONTRACT_CODE, code_entry, 1), None),
+    }
+    fn = HostFunction.make(
+        HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+        InvokeContractArgs(contractAddress=addr, functionName=b"fail",
+                           args=[]))
+    old = host_mod.DIAGNOSTIC_EVENTS_ENABLED
+    host_mod.DIAGNOSTIC_EVENTS_ENABLED = True
+    try:
+        out = invoke_host_function(
+            fn, fp, set(fp), set(), [],
+            account_id(keypair("fd").public_key.raw),
+            TEST_NETWORK_ID, 10, default_soroban_config())
+    finally:
+        host_mod.DIAGNOSTIC_EVENTS_ENABLED = old
+    assert not out.success
+    assert out.diagnostics, "pre-trap log lost"
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    evs = LedgerManager._wrap_diagnostics(out.diagnostics,
+                                          in_success=False)
+    assert evs and evs[0].inSuccessfulContractCall is False

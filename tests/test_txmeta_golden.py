@@ -467,10 +467,38 @@ SOROBAN_SCENARIOS = {
     "reference_fixtures": scenario_reference_fixtures,
 }
 
-# the parallel soroban representation is a protocol-23 construct: its
-# golden runs only at the version where validators would accept it
+def scenario_state_archival(version):
+    """Protocol-23 state archival through the close pipeline: an
+    expired persistent entry is evicted into the hot archive (the
+    header commits to live+hot) and a RestoreFootprint pulls it back —
+    pins eviction meta, the combined commitment, and restore
+    semantics."""
+    import sys
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_archival_catchup import (
+        _persistent_entry, _restore_tx,
+    )
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    a = keypair("gm-archival")
+    lm = _lm_with([(a, 100_000 * XLM)], version)
+    with LedgerTxn(lm.root) as ltx:
+        entry, lk, ttl = _persistent_entry(b"\x71", expired_at=2)
+        ltx.create(entry).deactivate()
+        ltx.create(ttl).deactivate()
+        ltx.commit()
+    out = [_close_with(lm, [])]  # eviction close: entry -> archive
+    assert lm.hot_archive.get_archived(key_bytes(lk)) is not None
+    restore = _restore_tx(lm, a, lk, (1 << 32) + 1)
+    out.append(_close_with(lm, [restore]))
+    return out
+
+
+# the parallel soroban representation and state archival are
+# protocol-23 constructs: their goldens run only at the version where
+# validators would accept them
 PARALLEL_SCENARIOS = {
     "parallel_soroban": scenario_parallel_soroban,
+    "state_archival": scenario_state_archival,
 }
 PARALLEL_VERSIONS = [23]
 
