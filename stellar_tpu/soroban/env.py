@@ -1551,6 +1551,159 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             raise EnvError(f"secp256k1 recover: {e}")
         return cv.new_obj(TAG_BYTES_OBJ, pk)
 
+    # ---- BLS12-381 (protocol 22, CAP-59) ----
+
+    def _bls():
+        from stellar_tpu.crypto import bls12_381 as B
+        return B
+
+    def _g1_arg(val, check_subgroup=True):
+        B = _bls()
+        try:
+            return B.g1_decode(bytes(_bytes_of(val)),
+                               subgroup_check=check_subgroup)
+        except B.BlsError as e:
+            raise EnvError(f"bls12-381 g1: {e}")
+
+    def _g2_arg(val, check_subgroup=True):
+        B = _bls()
+        try:
+            return B.g2_decode(bytes(_bytes_of(val)),
+                               subgroup_check=check_subgroup)
+        except B.BlsError as e:
+            raise EnvError(f"bls12-381 g2: {e}")
+
+    def _fr_arg(val) -> int:
+        return _u256_of(val) % _bls().R
+
+    def bls12_381_check_g1_is_in_subgroup(inst, p_val):
+        charge(500_000, 0)
+        B = _bls()
+        pt = _g1_arg(p_val, check_subgroup=False)
+        try:
+            B.g1_check(pt)
+            return _make(TAG_TRUE)
+        except B.BlsError:
+            return _make(TAG_FALSE)
+
+    def bls12_381_g1_add(inst, a_val, b_val):
+        # add validates on-curve only (CAP-59: no subgroup check here)
+        charge(20_000, 96)
+        B = _bls()
+        return cv.new_obj(TAG_BYTES_OBJ, B.g1_encode(B.g1_add(
+            _g1_arg(a_val, check_subgroup=False),
+            _g1_arg(b_val, check_subgroup=False))))
+
+    def bls12_381_g1_mul(inst, p_val, k_val):
+        charge(1_500_000, 96)
+        B = _bls()
+        return cv.new_obj(TAG_BYTES_OBJ, B.g1_encode(
+            B.g1_mul(_fr_arg(k_val), _g1_arg(p_val))))
+
+    def bls12_381_g1_msm(inst, points_val, scalars_val):
+        B = _bls()
+        pts = [_g1_arg(v) for v in _vec_of(points_val)]
+        ks = [_fr_arg(v) for v in _vec_of(scalars_val)]
+        if len(pts) != len(ks):
+            raise EnvError("bls12-381 msm length mismatch")
+        charge(1_500_000 * max(1, len(pts)), 96)
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          B.g1_encode(B.g1_msm(list(zip(ks, pts)))))
+
+    def bls12_381_map_fp_to_g1(inst, fp_val):
+        raise EnvError(
+            "bls12_381_map_fp_to_g1 not implemented in this build "
+            "(RFC 9380 SSWU isogeny constants unavailable)")
+
+    def bls12_381_hash_to_g1(inst, msg_val, dst_val):
+        raise EnvError(
+            "bls12_381_hash_to_g1 not implemented in this build "
+            "(RFC 9380 SSWU isogeny constants unavailable)")
+
+    def bls12_381_check_g2_is_in_subgroup(inst, p_val):
+        charge(1_000_000, 0)
+        B = _bls()
+        pt = _g2_arg(p_val, check_subgroup=False)
+        try:
+            B.g2_check(pt)
+            return _make(TAG_TRUE)
+        except B.BlsError:
+            return _make(TAG_FALSE)
+
+    def bls12_381_g2_add(inst, a_val, b_val):
+        charge(40_000, 192)
+        B = _bls()
+        return cv.new_obj(TAG_BYTES_OBJ, B.g2_encode(B.g2_add(
+            _g2_arg(a_val, check_subgroup=False),
+            _g2_arg(b_val, check_subgroup=False))))
+
+    def bls12_381_g2_mul(inst, p_val, k_val):
+        charge(3_000_000, 192)
+        B = _bls()
+        return cv.new_obj(TAG_BYTES_OBJ, B.g2_encode(
+            B.g2_mul(_fr_arg(k_val), _g2_arg(p_val))))
+
+    def bls12_381_g2_msm(inst, points_val, scalars_val):
+        B = _bls()
+        pts = [_g2_arg(v) for v in _vec_of(points_val)]
+        ks = [_fr_arg(v) for v in _vec_of(scalars_val)]
+        if len(pts) != len(ks):
+            raise EnvError("bls12-381 msm length mismatch")
+        charge(3_000_000 * max(1, len(pts)), 192)
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          B.g2_encode(B.g2_msm(list(zip(ks, pts)))))
+
+    def bls12_381_map_fp2_to_g2(inst, fp2_val):
+        raise EnvError(
+            "bls12_381_map_fp2_to_g2 not implemented in this build "
+            "(RFC 9380 SSWU isogeny constants unavailable)")
+
+    def bls12_381_hash_to_g2(inst, msg_val, dst_val):
+        raise EnvError(
+            "bls12_381_hash_to_g2 not implemented in this build "
+            "(RFC 9380 SSWU isogeny constants unavailable)")
+
+    def bls12_381_multi_pairing_check(inst, vp1_val, vp2_val):
+        B = _bls()
+        ps = [_g1_arg(v) for v in _vec_of(vp1_val)]
+        qs = [_g2_arg(v) for v in _vec_of(vp2_val)]
+        if len(ps) != len(qs) or not ps:
+            raise EnvError("bls12-381 pairing vector mismatch")
+        charge(10_000_000 * len(ps), 0)
+        ok = B.pairing_check(list(zip(ps, qs)))
+        return _make(TAG_TRUE if ok else TAG_FALSE)
+
+    def _fr_result(n: int):
+        return _mk_u256(n % _bls().R)
+
+    def bls12_381_fr_add(inst, a_val, b_val):
+        charge(5_000, 0)
+        return _fr_result(_bls().fr_add(_fr_arg(a_val), _fr_arg(b_val)))
+
+    def bls12_381_fr_sub(inst, a_val, b_val):
+        charge(5_000, 0)
+        return _fr_result(_bls().fr_sub(_fr_arg(a_val), _fr_arg(b_val)))
+
+    def bls12_381_fr_mul(inst, a_val, b_val):
+        charge(5_000, 0)
+        return _fr_result(_bls().fr_mul(_fr_arg(a_val), _fr_arg(b_val)))
+
+    def bls12_381_fr_pow(inst, a_val, e_val):
+        charge(50_000, 0)
+        # the exponent is a tagged U64Val, not a raw wasm u64
+        e_sc = cv.to_scval(e_val)
+        if e_sc.arm != T.SCV_U64:
+            raise EnvError("fr_pow exponent must be a u64")
+        return _fr_result(_bls().fr_pow(_fr_arg(a_val), e_sc.value))
+
+    def bls12_381_fr_inv(inst, a_val):
+        charge(50_000, 0)
+        B = _bls()
+        try:
+            return _fr_result(B.fr_inv(_fr_arg(a_val)))
+        except B.BlsError as e:
+            raise EnvError(f"bls12-381 fr: {e}")
+
     def verify_sig_ecdsa_secp256r1(inst, pk_val, digest_val, sig_val):
         pk = _bytes_of(pk_val)
         digest = _bytes_of(digest_val)
@@ -2058,6 +2211,27 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             ("c", recover_key_ecdsa_secp256k1),
         "verify_sig_ecdsa_secp256r1":
             ("c", verify_sig_ecdsa_secp256r1),
+        "bls12_381_check_g1_is_in_subgroup":
+            ("c", bls12_381_check_g1_is_in_subgroup),
+        "bls12_381_g1_add": ("c", bls12_381_g1_add),
+        "bls12_381_g1_mul": ("c", bls12_381_g1_mul),
+        "bls12_381_g1_msm": ("c", bls12_381_g1_msm),
+        "bls12_381_map_fp_to_g1": ("c", bls12_381_map_fp_to_g1),
+        "bls12_381_hash_to_g1": ("c", bls12_381_hash_to_g1),
+        "bls12_381_check_g2_is_in_subgroup":
+            ("c", bls12_381_check_g2_is_in_subgroup),
+        "bls12_381_g2_add": ("c", bls12_381_g2_add),
+        "bls12_381_g2_mul": ("c", bls12_381_g2_mul),
+        "bls12_381_g2_msm": ("c", bls12_381_g2_msm),
+        "bls12_381_map_fp2_to_g2": ("c", bls12_381_map_fp2_to_g2),
+        "bls12_381_hash_to_g2": ("c", bls12_381_hash_to_g2),
+        "bls12_381_multi_pairing_check":
+            ("c", bls12_381_multi_pairing_check),
+        "bls12_381_fr_add": ("c", bls12_381_fr_add),
+        "bls12_381_fr_sub": ("c", bls12_381_fr_sub),
+        "bls12_381_fr_mul": ("c", bls12_381_fr_mul),
+        "bls12_381_fr_pow": ("c", bls12_381_fr_pow),
+        "bls12_381_fr_inv": ("c", bls12_381_fr_inv),
         # address "a"
         "require_auth_for_args": ("a", require_auth_for_args),
         "require_auth": ("a", require_auth),

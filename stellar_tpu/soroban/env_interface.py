@@ -186,6 +186,25 @@ MODULES: Dict[str, Tuple[str, List[str]]] = {
         "compute_hash_keccak256",
         "recover_key_ecdsa_secp256k1",
         "verify_sig_ecdsa_secp256r1",
+        # protocol 22 (CAP-59) BLS12-381 family
+        "bls12_381_check_g1_is_in_subgroup",
+        "bls12_381_g1_add",
+        "bls12_381_g1_mul",
+        "bls12_381_g1_msm",
+        "bls12_381_map_fp_to_g1",
+        "bls12_381_hash_to_g1",
+        "bls12_381_check_g2_is_in_subgroup",
+        "bls12_381_g2_add",
+        "bls12_381_g2_mul",
+        "bls12_381_g2_msm",
+        "bls12_381_map_fp2_to_g2",
+        "bls12_381_hash_to_g2",
+        "bls12_381_multi_pairing_check",
+        "bls12_381_fr_add",
+        "bls12_381_fr_sub",
+        "bls12_381_fr_mul",
+        "bls12_381_fr_pow",
+        "bls12_381_fr_inv",
     ]),
     "a": ("address", [
         "require_auth_for_args",

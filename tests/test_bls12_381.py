@@ -1,0 +1,201 @@
+"""BLS12-381 (protocol-22 CAP-59 host functions). No BLS library
+ships in this image, so the pairing is pinned by algebraic properties
+— group laws, order-r annihilation, and BILINEARITY (the property the
+multi-pairing host check exists to provide) — plus the published
+generator coordinates and encoding roundtrips."""
+
+import pytest
+
+from stellar_tpu.crypto.bls12_381 import (
+    BlsError, G1_GEN, G2_GEN, P, R, fr_add, fr_inv, fr_mul, fr_pow,
+    fr_sub, g1_add, g1_check, g1_decode, g1_encode, g1_msm, g1_mul,
+    g2_add, g2_check, g2_decode, g2_encode, g2_msm, g2_mul,
+    pairing_check,
+)
+from stellar_tpu.soroban.env import EnvError, TAG_BYTES_OBJ
+
+
+def test_generators_valid():
+    g1_check(G1_GEN)
+    g2_check(G2_GEN)
+    # published coordinates: first bytes of the standard generator
+    assert g1_encode(G1_GEN)[:2] == b"\x17\xf1"
+
+
+def test_g1_group_laws():
+    a, b = 97531, 13579
+    assert g1_add(g1_mul(a, G1_GEN), g1_mul(b, G1_GEN)) == \
+        g1_mul(a + b, G1_GEN)
+    # commutativity + identity + inverse
+    pa, pb = g1_mul(a, G1_GEN), g1_mul(b, G1_GEN)
+    assert g1_add(pa, pb) == g1_add(pb, pa)
+    assert g1_add(pa, None) == pa
+    assert g1_add(pa, g1_mul(R - a, G1_GEN)) is None
+    assert g1_mul(R, G1_GEN) is None
+
+
+def test_g2_group_laws():
+    a, b = 86420, 24680
+    assert g2_add(g2_mul(a, G2_GEN), g2_mul(b, G2_GEN)) == \
+        g2_mul(a + b, G2_GEN)
+    assert g2_mul(R, G2_GEN) is None
+
+
+def test_msm_matches_sum():
+    pairs = [(3, g1_mul(5, G1_GEN)), (7, g1_mul(11, G1_GEN)),
+             (2, G1_GEN)]
+    assert g1_msm(pairs) == g1_mul(3 * 5 + 7 * 11 + 2, G1_GEN)
+    pairs2 = [(3, g2_mul(5, G2_GEN)), (4, G2_GEN)]
+    assert g2_msm(pairs2) == g2_mul(19, G2_GEN)
+
+
+def test_pairing_bilinearity():
+    """e(aP, bQ) * e(-abP, Q) == 1 — the defining property."""
+    for a, b in ((2, 3), (1234567, 7654321)):
+        assert pairing_check([
+            (g1_mul(a, G1_GEN), g2_mul(b, G2_GEN)),
+            (g1_mul(R - (a * b) % R, G1_GEN), G2_GEN)])
+        # and the swapped form e(aP,bQ) == e(bP,aQ)
+        assert pairing_check([
+            (g1_mul(a, G1_GEN), g2_mul(b, G2_GEN)),
+            (g1_mul(R - b, G1_GEN), g2_mul(a, G2_GEN))])
+
+
+def test_pairing_rejects_wrong_relation():
+    a, b = 11, 13
+    assert not pairing_check([
+        (g1_mul(a, G1_GEN), g2_mul(b, G2_GEN)),
+        (g1_mul(R - (a * b + 1), G1_GEN), G2_GEN)])
+
+
+def test_bls_signature_shape():
+    """The scheme CAP-59 exists for: sk*H = signature verifies as
+    e(sig, G2) == e(H, pk) with pk = sk*G2 (message hashed to G1 —
+    here a fixed point stands in for hash_to_g1)."""
+    sk = 0x1F2E3D4C5B6A79
+    h = g1_mul(424242, G1_GEN)      # "hashed" message point
+    pk = g2_mul(sk, G2_GEN)
+    sig = g1_mul(sk, h)
+    assert pairing_check([(sig, G2_GEN),
+                          (g1_mul(R - 1, h), pk)])
+    # forged signature fails
+    assert not pairing_check([(g1_add(sig, G1_GEN), G2_GEN),
+                              (g1_mul(R - 1, h), pk)])
+
+
+def test_encoding_roundtrip_and_rejects():
+    pt = g1_mul(31337, G1_GEN)
+    raw = g1_encode(pt)
+    assert len(raw) == 96
+    assert g1_decode(raw) == pt
+    assert g1_decode(g1_encode(None)) is None
+    q = g2_mul(31337, G2_GEN)
+    raw2 = g2_encode(q)
+    assert len(raw2) == 192
+    assert g2_decode(raw2) == q
+    with pytest.raises(BlsError):
+        g1_decode(b"\x00" * 95)
+    # off-curve point rejected
+    bad = bytearray(raw)
+    bad[-1] ^= 1
+    with pytest.raises(BlsError):
+        g1_decode(bytes(bad))
+    # non-subgroup on-curve point rejected when checked: the curve has
+    # cofactor > 1, so tripling... construct by cofactor trick is
+    # expensive; instead verify the infinity flag handling
+    inf = bytearray(96)
+    inf[0] = 0x40
+    assert g1_decode(bytes(inf)) is None
+    inf[5] = 1
+    with pytest.raises(BlsError):
+        g1_decode(bytes(inf))
+
+
+def test_fr_field_ops():
+    a, b = 0xDEADBEEF, 0xFEEDFACE
+    assert fr_add(a, b) == (a + b) % R
+    assert fr_sub(a, b) == (a - b) % R
+    assert fr_mul(a, b) == a * b % R
+    assert fr_mul(a, fr_inv(a)) == 1
+    assert fr_pow(a, 3) == pow(a, 3, R)
+    with pytest.raises(BlsError):
+        fr_inv(0)
+
+
+# ---------------------------------------------------------------------------
+# through the host import table
+# ---------------------------------------------------------------------------
+
+def test_host_fns_end_to_end():
+    import sys
+    sys.path.insert(0, "tests")
+    from test_env_modern import _u32v, hostenv, table_fn  # noqa: F401
+    from stellar_tpu.soroban.env import (
+        TAG_TRUE, TAG_VEC_OBJ, make_imports,
+    )
+    from stellar_tpu.soroban.host import (
+        WasmContractEnv, _Budget, _Host, _Storage,
+    )
+    from stellar_tpu.xdr.contract import contract_address
+
+    class _Cfg:
+        max_entry_ttl = 1_054_080
+        min_persistent_ttl = 4_096
+        min_temporary_ttl = 16
+        max_contract_size = 65_536
+        tx_max_contract_events_size_bytes = 8_192
+
+    budget = _Budget(10**9, 10**9)
+    storage = _Storage({}, set(), set(), budget, ledger_seq=1)
+    host = _Host(storage, budget, None, _Cfg(), 1)
+    env = WasmContractEnv(host, contract_address(b"\x01" * 32), None, 0)
+    t = make_imports(env)
+    inst = None
+    cv = env.cv
+
+    def b_obj(raw):
+        return cv.new_obj(TAG_BYTES_OBJ, raw)
+
+    sk, hpt = 777, g1_mul(5, G1_GEN)
+    pk_raw = g2_encode(g2_mul(sk, G2_GEN))
+    sig_raw = g1_encode(g1_mul(sk, hpt))
+    neg_h = g1_encode(g1_mul(R - 1, hpt))
+    vp1 = cv.new_obj(TAG_VEC_OBJ, [b_obj(sig_raw), b_obj(neg_h)])
+    vp2 = cv.new_obj(TAG_VEC_OBJ, [b_obj(g2_encode(G2_GEN)),
+                                   b_obj(pk_raw)])
+    ok = table_fn(t, "bls12_381_multi_pairing_check")(inst, vp1, vp2)
+    assert ok & 0xFF == TAG_TRUE
+
+    # g1_add through the table
+    s = table_fn(t, "bls12_381_g1_add")(
+        inst, b_obj(g1_encode(G1_GEN)), b_obj(g1_encode(G1_GEN)))
+    assert bytes(cv.obj(s, TAG_BYTES_OBJ)) == g1_encode(
+        g1_mul(2, G1_GEN))
+
+    # fr arithmetic on U256 vals
+    a_val = table_fn(t, "obj_from_u256_pieces")(inst, 0, 0, 0, 9)
+    b_val = table_fn(t, "obj_from_u256_pieces")(inst, 0, 0, 0, 4)
+    r = table_fn(t, "bls12_381_fr_sub")(inst, a_val, b_val)
+    assert table_fn(t, "obj_to_u256_lo_lo")(inst, r) == 5
+
+    # hash-to-curve stubs trap with an explicit message
+    with pytest.raises(EnvError, match="not implemented"):
+        table_fn(t, "bls12_381_hash_to_g1")(inst, b_obj(b"m"),
+                                            b_obj(b"dst"))
+
+
+def test_non_subgroup_point_rejected():
+    """The cofactor point with x=4 is on-curve but outside the r-order
+    subgroup — checks must reject it (a reduced-scalar bug once made
+    this test vacuous)."""
+    x = 4
+    rhs = (x ** 3 + 4) % P
+    y = pow(rhs, (P + 1) // 4, P)
+    assert y * y % P == rhs
+    with pytest.raises(BlsError, match="subgroup"):
+        g1_check((x, y))
+    raw = x.to_bytes(48, "big") + y.to_bytes(48, "big")
+    with pytest.raises(BlsError, match="subgroup"):
+        g1_decode(raw)
+    # without the subgroup check the point is accepted (add-only path)
+    assert g1_decode(raw, subgroup_check=False) == (x, y)
