@@ -393,6 +393,29 @@ static bool run_func(Engine& e, const FuncDesc& f, const int64_t* args,
             }
             break;
         }
+        case 0xFC: {                          // memory.copy / fill
+            uint32_t n = (uint32_t)stack.back(); stack.pop_back();
+            uint64_t sv = (uint64_t)stack.back(); stack.pop_back();
+            uint32_t d = (uint32_t)stack.back(); stack.pop_back();
+            if (immA == 10) {
+                uint32_t s = (uint32_t)sv;
+                if ((uint64_t)d + n > e.memory.size() ||
+                    (uint64_t)s + n > e.memory.size())
+                    TRAP(TRAP_OOB);
+                std::memmove(e.memory.data() + d,
+                             e.memory.data() + s, n);
+            } else {
+                if ((uint64_t)d + n > e.memory.size())
+                    TRAP(TRAP_OOB);
+                std::memset(e.memory.data() + d,
+                            (int)(sv & 0xFF), n);
+            }
+            // bytes moved are metered work (same n/8 surcharge as the
+            // Python engine — the differential contract)
+            tick += (int64_t)(n >> 3);
+            if (tick >= 64) { SYNC_BUDGET(); }
+            break;
+        }
         case 0x00: TRAP(TRAP_UNREACHABLE);
         // ---- i32 compare ----
         case 0x45: { uint32_t a = (uint32_t)stack.back();

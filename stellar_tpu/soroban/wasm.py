@@ -705,6 +705,20 @@ def _decode_body(m: WasmModule, ftype: FuncType, body: bytes) -> _Func:
             pop(_NUMERIC_POPS[op])
             push(1)
             ops.append([op, None])
+        elif op == 0xFC:
+            # bulk-memory prefix (LLVM emits memory.copy/fill for
+            # memcpy/memset by default; soroban's wasmi enables them)
+            sub = r.u32()
+            if sub == 10:  # memory.copy: dst, src memory indices
+                if r.byte() != 0 or r.byte() != 0:
+                    raise WasmError("memory.copy: bad memory index")
+            elif sub == 11:  # memory.fill: memory index
+                if r.byte() != 0:
+                    raise WasmError("memory.fill: bad memory index")
+            else:
+                raise WasmError(f"unsupported 0xFC subop {sub}")
+            pop(3)
+            ops.append([op, sub])
         else:
             raise WasmError(f"unsupported opcode 0x{op:02x}")
 
@@ -938,6 +952,26 @@ class WasmInstance:
                 stack.append(self._grow(stack.pop() & _M32))
             elif op == 0x00:                  # unreachable
                 raise Trap("unreachable executed")
+            elif op == 0xFC:                  # memory.copy / fill
+                n = stack.pop() & _M32
+                s_or_v = stack.pop()
+                d = stack.pop() & _M32
+                mem = self.memory
+                if imm == 10:
+                    s = s_or_v & _M32
+                    if d + n > len(mem) or s + n > len(mem):
+                        raise Trap("memory access out of bounds")
+                    mem[d:d + n] = mem[s:s + n]
+                else:
+                    if d + n > len(mem):
+                        raise Trap("memory access out of bounds")
+                    mem[d:d + n] = bytes([s_or_v & 0xFF]) * n
+                # bytes moved are metered work (same n//8 surcharge as
+                # the native engine — the differential contract)
+                tick += n >> 3
+                if tick >= 64:
+                    charge(tick)
+                    tick = 0
             else:
                 stack.append(_numeric(op, stack))
         charge(tick)

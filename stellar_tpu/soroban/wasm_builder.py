@@ -184,6 +184,14 @@ class Code:
     def memory_grow(self):
         return self.raw(0x40, 0x00)
 
+    def memory_copy(self):
+        """Bulk memory: [dst, src, n] -> [] (0xFC 10)."""
+        return self.raw(0xFC, 0x0A, 0x00, 0x00)
+
+    def memory_fill(self):
+        """Bulk memory: [dst, val, n] -> [] (0xFC 11)."""
+        return self.raw(0xFC, 0x0B, 0x00)
+
     # consts
     def i32_const(self, v: int):
         self.b.append(0x41)

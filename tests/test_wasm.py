@@ -563,3 +563,89 @@ def test_prng_host_module_deterministic(env):
 
     h1, h2 = run_once(), run_once()
     assert h1 == h2, "prng must be deterministic across nodes"
+
+
+def test_bulk_memory_copy_fill_both_engines():
+    """memory.copy / memory.fill (0xFC prefix — LLVM emits them for
+    memcpy/memset): identical results, traps, and CONSUMED BUDGET on
+    both engines, including the bytes-moved surcharge."""
+    from stellar_tpu.soroban import native_wasm
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+
+    b = ModuleBuilder()
+    b.add_memory(1, export="memory")
+    b.add_data(0, b"hello world!")
+    c = Code()
+    c.i32_const(100).i32_const(0).i32_const(12).memory_copy()
+    c.i32_const(100).i64_load()
+    b.add_func([], [I64], [], c, export="copy_test")
+    c2 = Code()
+    c2.i32_const(200).i32_const(0x41).i32_const(1024).memory_fill()
+    c2.i32_const(200).i64_load()
+    b.add_func([], [I64], [], c2, export="fill_test")
+    c3 = Code()  # copy past the end of memory must trap
+    c3.i32_const(65530).i32_const(0).i32_const(100).memory_copy()
+    c3.i64_const(0)
+    b.add_func([], [I64], [], c3, export="oob_test")
+    code = b.build()
+    m = parse_module(code)
+
+    class _B:
+        def __init__(self):
+            self.cpu = 0
+            self.cpu_limit = 10 ** 9
+            self.mem_limit = 10 ** 9
+            self.mem = 0
+
+        def charge(self, c, mm=0):
+            self.cpu += c
+            self.mem += mm
+
+    def run_py(fn):
+        bud = _B()
+        inst = WasmInstance(m, {}, lambda n: bud.charge(n * 4),
+                            lambda n: None)
+        return inst.invoke(fn, []), bud.cpu
+
+    def run_native(fn):
+        bud = _B()
+        rv = native_wasm.run_export(m, {}, bud, 4, fn, [])
+        return rv, bud.cpu
+
+    M64 = (1 << 64) - 1
+    for fn in ("copy_test", "fill_test"):
+        pv, pc = run_py(fn)
+        if native_wasm.available():
+            nv, nc = run_native(fn)
+            assert (pv & M64) == (nv & M64)
+            assert pc == nc, (fn, pc, nc)  # surcharge parity
+    assert run_py("copy_test")[0] == int.from_bytes(b"hello wo",
+                                                    "little")
+    assert run_py("fill_test")[0] == 0x4141414141414141
+    with pytest.raises(Trap, match="out of bounds"):
+        run_py("oob_test")
+    if native_wasm.available():
+        with pytest.raises(Trap, match="out of bounds"):
+            run_native("oob_test")
+
+
+def test_bulk_memory_rejects_bad_encodings():
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+    # nonzero memory index byte
+    b = ModuleBuilder()
+    b.add_memory(1)
+    c = Code()
+    c.i32_const(0).i32_const(0).i32_const(0).raw(0xFC, 0x0A, 0x01, 0x00)
+    c.i64_const(0)
+    b.add_func([], [I64], [], c, export="f")
+    with pytest.raises(WasmError):
+        parse_module(b.build())
+    # unknown 0xFC subop
+    b2 = ModuleBuilder()
+    b2.add_memory(1)
+    c2 = Code()
+    c2.i32_const(0).i32_const(0).i32_const(0).raw(0xFC, 0x08)
+    c2.i64_const(0)
+    b2.add_func([], [I64], [], c2, export="f")
+    with pytest.raises(WasmError):
+        parse_module(b2.build())
