@@ -504,12 +504,20 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     # ---- ledger ----
 
+    # hot-path bindings hoisted out of the per-call handlers (a
+    # function-level import costs ~1-2us and storage ops run several
+    # times per invoke)
+    from stellar_tpu.ledger.ledger_txn import key_bytes as _key_bytes
+    from stellar_tpu.soroban.host import (
+        contract_data_key as _contract_data_key,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability as _Durability,
+    )
+
     def _storage_args(k_val, t_val):
         """(key_scval, durability|None, kb|None) — durability None
         means instance storage; key is converted exactly once."""
-        from stellar_tpu.soroban.host import contract_data_key
-        from stellar_tpu.ledger.ledger_txn import key_bytes
-        from stellar_tpu.xdr.contract import ContractDataDurability
         code = _u32_arg(t_val, "storage type")
         kind = _DUR_BY_CODE.get(code)
         if kind is None:
@@ -517,10 +525,10 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         key_sc = cv.to_scval(k_val)
         if kind == "instance":
             return key_sc, None, None
-        dur = ContractDataDurability.PERSISTENT \
-            if kind == "persistent" else ContractDataDurability.TEMPORARY
-        kb = key_bytes(contract_data_key(env.contract_addr, key_sc,
-                                         dur))
+        dur = _Durability.PERSISTENT if kind == "persistent" \
+            else _Durability.TEMPORARY
+        kb = _key_bytes(_contract_data_key(env.contract_addr, key_sc,
+                                           dur))
         return key_sc, dur, kb
 
     def put_contract_data(inst, k_val, v_val, t_val):

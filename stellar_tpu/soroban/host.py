@@ -1030,7 +1030,18 @@ def _verify_sig(pk, payload, sig) -> bool:
     return verify_sig(pk, payload, sig)
 
 
+from stellar_tpu.utils.cache import RandomEvictionCache as _REC
+
+_PROGRAM_CACHE: "_REC" = _REC(128)
+
+
 def _parse_program(code: bytes) -> Dict[bytes, List]:
+    """Decoded SCVal program for ``code``, memoized by content hash —
+    the interpreter-side analogue of the parsed-wasm module cache."""
+    h = sha256(code)
+    cached = _PROGRAM_CACHE.maybe_get(h)
+    if cached is not None:
+        return cached
     try:
         val = from_bytes(SCVal, code)
     except Exception:
@@ -1042,11 +1053,11 @@ def _parse_program(code: bytes) -> Dict[bytes, List]:
         if e.key.arm != T.SCV_SYMBOL or e.val.arm != T.SCV_VEC:
             raise HostError(HostError.TRAPPED, "bad function entry")
         prog[e.key.value] = list(e.val.value or ())
+    _PROGRAM_CACHE.put(h, prog)
     return prog
 
 
-_MODULE_CACHE: Dict[bytes, object] = {}
-_MODULE_CACHE_CAP = 128
+_MODULE_CACHE: "_REC" = _REC(128)
 
 
 def _parsed_module(code: bytes):
@@ -1055,12 +1066,10 @@ def _parsed_module(code: bytes):
     entry the same way)."""
     from stellar_tpu.soroban.wasm import parse_module
     h = sha256(code)
-    mod = _MODULE_CACHE.get(h)
+    mod = _MODULE_CACHE.maybe_get(h)
     if mod is None:
         mod = parse_module(code)
-        if len(_MODULE_CACHE) >= _MODULE_CACHE_CAP:
-            _MODULE_CACHE.pop(next(iter(_MODULE_CACHE)))
-        _MODULE_CACHE[h] = mod
+        _MODULE_CACHE.put(h, mod)
     return mod
 
 
