@@ -36,6 +36,7 @@ from stellar_tpu.xdr.results import (
     TransactionResultPair, TransactionResultSet,
 )
 from stellar_tpu.xdr.runtime import from_bytes, to_bytes
+from stellar_tpu.xdr.types import LedgerEntry as LedgerEntry_t
 
 __all__ = ["LedgerCloseData", "CloseLedgerResult", "LedgerManager",
            "hash_store_state"]
@@ -155,6 +156,13 @@ class LedgerManager:
                 max(1, hdr.ledgerSeq), hdr.ledgerVersion, seeded, [], [])
         self._lcl_hash = ledger_header_hash(self.root.header())
         self.close_meta_stream: List = []  # downstream consumers hook
+        # reverse-delta ring for point-in-time reads (reference
+        # QUERY_SNAPSHOT_LEDGERS: the query server answers at recent
+        # snapshots): when window > 0, each close records
+        # (seq, {kb: previous raw entry bytes | None}) so a reader
+        # can walk state back up to `window` ledgers
+        self.snapshot_window = 0
+        self._reverse_deltas: List[Tuple[int, Dict]] = []
         from stellar_tpu.bucket.eviction import EvictionScanner
         self.eviction_scanner = EvictionScanner()
         # hot archive for evicted PERSISTENT Soroban state (reference
@@ -345,8 +353,15 @@ class LedgerManager:
 
         # classify the close's entry delta and stamp lastModified —
         # this is what the bucket list (and meta) see
+        delta = ltx.get_delta()
+        if self.snapshot_window > 0:
+            rev = {kb: (None if prev is None
+                        else to_bytes(LedgerEntry_t, prev))
+                   for kb, (prev, _cur) in delta.items()}
+            self._reverse_deltas.append((lcd.ledger_seq, rev))
+            del self._reverse_deltas[:-self.snapshot_window]
         init_entries, live_entries, dead_keys = [], [], []
-        for kb, (prev, cur) in ltx.get_delta().items():
+        for kb, (prev, cur) in delta.items():
             if cur is not None:
                 cur.lastModifiedLedgerSeq = lcd.ledger_seq
                 (live_entries if prev is not None
@@ -434,6 +449,37 @@ class LedgerManager:
             for consumer in self.close_meta_stream:
                 consumer(meta)
         return result
+
+    def check_snapshot_seq(self, seq: int):
+        """Validate that point-in-time reads at ``seq`` are servable:
+        inside the configured window AND actually covered by recorded
+        reverse deltas (a freshly started ring covers fewer ledgers
+        than the window until it fills)."""
+        cur = self.ledger_seq
+        if not (cur - self.snapshot_window <= seq <= cur):
+            raise ValueError(
+                f"ledger {seq} outside the {self.snapshot_window}-"
+                "ledger snapshot window")
+        if seq < cur and (not self._reverse_deltas or
+                          self._reverse_deltas[0][0] > seq + 1):
+            raise ValueError(
+                f"snapshot ring does not yet cover ledger {seq}")
+
+    def entry_at(self, kb: bytes, seq: int) -> Optional[bytes]:
+        """Raw LedgerEntry bytes for key ``kb`` as of ledger ``seq``
+        (point-in-time read within the snapshot window): start from
+        the live value and walk the reverse deltas of every close
+        NEWER than ``seq``, newest first — the last reversal applied
+        is the oldest applicable one, i.e. the value as of ``seq``."""
+        self.check_snapshot_seq(seq)
+        e = self.root.store.get(kb)
+        val = None if e is None else to_bytes(LedgerEntry_t, e)
+        for dseq, rev in reversed(self._reverse_deltas):
+            if dseq <= seq:
+                break
+            if kb in rev:
+                val = rev[kb]
+        return val
 
     @staticmethod
     def _wrap_diagnostics(diags, in_success: bool = True):

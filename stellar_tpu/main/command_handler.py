@@ -348,32 +348,36 @@ class CommandHandler:
         want_seq = params.get("ledgerSeq", [None])[0]
 
         def run():
-            cur = self.app.lm.ledger_seq
+            lm = self.app.lm
+            cur = lm.ledger_seq
             out = {"ledgerSeq": cur, "entries": []}
+            at_seq = None
             if want_seq is not None:
-                # reference QUERY_SNAPSHOT_LEDGERS: queries may only
-                # address the retained snapshot window; this node
-                # serves ONE snapshot (the LCL), so anything but the
-                # current ledger is answered with the window error or
-                # explicitly flagged as served-at-current
-                window = self.app.config.QUERY_SNAPSHOT_LEDGERS
+                # reference QUERY_SNAPSHOT_LEDGERS: point-in-time
+                # reads within the retained reverse-delta window
                 seq = int(want_seq)
-                if not (cur - window <= seq <= cur):
-                    return {"error": "ledgerSeq outside the "
-                            f"{window}-ledger snapshot window"}
+                try:
+                    lm.check_snapshot_seq(seq)
+                except ValueError as e:
+                    return {"error": str(e)}
                 out["requestedLedgerSeq"] = seq
                 if seq != cur:
-                    out["note"] = ("historical snapshots are not "
-                                   "retained; entries are served at "
-                                   "the current ledger")
+                    at_seq = seq
+                    out["ledgerSeq"] = seq
             for k in keys:
                 kb = bytes.fromhex(k)
                 from_bytes(LedgerKey, kb)  # validate
-                e = self.app.lm.root.store.get(kb)
-                out["entries"].append(
-                    {"key": k,
-                     "e": to_bytes(LedgerEntry, e).hex()
-                     if e is not None else None})
+                if at_seq is not None:
+                    raw = lm.entry_at(kb, at_seq)
+                    out["entries"].append(
+                        {"key": k,
+                         "e": raw.hex() if raw is not None else None})
+                else:
+                    e = lm.root.store.get(kb)
+                    out["entries"].append(
+                        {"key": k,
+                         "e": to_bytes(LedgerEntry, e).hex()
+                         if e is not None else None})
             return out
         return self._on_main(run)
 

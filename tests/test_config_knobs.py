@@ -436,3 +436,67 @@ def test_deep_spill_boundary_under_pessimized_merges():
     finally:
         bl.REDUCE_MERGE_COUNTS = False
         workers.set_background(True)
+
+
+def test_query_snapshot_ledgers_point_in_time_reads():
+    """QUERY_SNAPSHOT_LEDGERS retains reverse deltas: the query
+    surface answers entry reads AS OF a recent ledger."""
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import LedgerCloseData
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.tx.op_frame import account_key
+    from stellar_tpu.xdr.runtime import from_bytes
+    from stellar_tpu.xdr.types import LedgerEntry, account_id
+    app, cfg, a, root = _app(HTTP_QUERY_PORT=1,
+                             QUERY_SNAPSHOT_LEDGERS=3)
+    lm = app.lm
+    assert lm.snapshot_window == 3
+    kb = key_bytes(account_key(account_id(a.public_key.raw)))
+    balances = {}
+    seq = (lm.ledger_seq - 1) << 32
+    for i in range(4):
+        tx = make_tx(a, seq + 1 + i, [payment_op(a, XLM)],
+                     network_id=cfg.network_id())
+        txset, exc = make_tx_set_from_transactions(
+            [tx], lm.last_closed_header, lm.last_closed_hash)
+        assert not exc
+        res = lm.close_ledger(LedgerCloseData(
+            lm.ledger_seq + 1, txset,
+            lm.last_closed_header.scpValue.closeTime + 5))
+        assert res.failed_count == 0
+        balances[lm.ledger_seq] = from_bytes(
+            LedgerEntry, lm.entry_at(kb, lm.ledger_seq)) \
+            .data.value.balance
+    cur = lm.ledger_seq
+    # each retained ledger reproduces ITS balance (fees differ by close)
+    for s in range(cur - 3, cur + 1):
+        got = from_bytes(LedgerEntry,
+                         lm.entry_at(kb, s)).data.value.balance
+        if s in balances:
+            assert got == balances[s], s
+    # distinct balances across the window (fees were charged each close)
+    vals = [from_bytes(LedgerEntry, lm.entry_at(kb, s))
+            .data.value.balance for s in range(cur - 3, cur + 1)]
+    assert len(set(vals)) == len(vals)
+    with pytest.raises(ValueError):
+        lm.entry_at(kb, cur - 4)  # outside the window
+
+
+def test_snapshot_ring_coverage_guard():
+    """Inside the nominal window but before the ring has filled, reads
+    must error rather than silently serve newer state."""
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import LedgerCloseData
+    app, cfg, a, root = _app(QUERY_SNAPSHOT_LEDGERS=4)
+    lm = app.lm
+    start = lm.ledger_seq
+    txset, _ = make_tx_set_from_transactions(
+        [], lm.last_closed_header, lm.last_closed_hash)
+    lm.close_ledger(LedgerCloseData(
+        lm.ledger_seq + 1, txset,
+        lm.last_closed_header.scpValue.closeTime + 5))
+    # one close recorded: cur and cur-1 servable, older in-window not
+    lm.check_snapshot_seq(lm.ledger_seq)
+    lm.check_snapshot_seq(start)
+    with pytest.raises(ValueError, match="does not yet cover"):
+        lm.check_snapshot_seq(start - 1)
