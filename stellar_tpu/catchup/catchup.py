@@ -273,6 +273,10 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
     lm.root.hot_archive = hot
     lm.root.set_header(target_header_entry.header)
     lm._lcl_hash = target_header_entry.hash
+    # the LCL jumped out-of-band: the snapshot ring's reverse deltas
+    # describe the OLD chain and must not serve point-in-time reads
+    # labelled with the new one
+    lm._reverse_deltas.clear()
 
 
 class CatchupWork(WorkSequence):

@@ -138,10 +138,11 @@ class Application:
         self.lm.close_delay_ms = \
             config.ARTIFICIALLY_DELAY_LEDGER_CLOSE_FOR_TESTING
         # reverse-delta snapshot retention powers point-in-time reads
-        # on BOTH the query server and the admin getledgerentryraw
-        # route (reference QUERY_SNAPSHOT_LEDGERS); cost is bounded by
-        # window x per-close delta size
-        if config.QUERY_SNAPSHOT_LEDGERS > 0:
+        # on the query server and the admin getledgerentryraw route
+        # (reference QUERY_SNAPSHOT_LEDGERS); only paid when some HTTP
+        # surface can actually serve the reads
+        if config.QUERY_SNAPSHOT_LEDGERS > 0 and \
+                (config.HTTP_PORT or config.HTTP_QUERY_PORT):
             self.lm.snapshot_window = config.QUERY_SNAPSHOT_LEDGERS
         # process-wide knobs: push only non-default values (see
         # _apply_global_config's rationale)

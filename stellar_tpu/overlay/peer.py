@@ -29,6 +29,12 @@ from stellar_tpu.xdr.types import Curve25519Public, EnvelopeType
 
 __all__ = ["PeerAuth", "FlowControl", "Peer", "PEER_STATE"]
 
+from stellar_tpu.utils.cache import RandomEvictionCache
+
+# inner-message-bytes -> parsed StellarMessage (private copies both
+# ways); shared process-wide because messages are content-addressed
+_MSG_PARSE_CACHE: RandomEvictionCache = RandomEvictionCache(512)
+
 AUTH_CERT_LIFETIME = 3600  # seconds (reference PeerAuth.cpp expiration)
 OVERLAY_VERSION = 38
 
@@ -183,11 +189,31 @@ class Peer:
         sm = getattr(self.app.overlay, "survey_manager", None)
         if sm is not None:
             sm.note_traffic(self, read=len(raw))
+        # fan-out parse cache: the INNER StellarMessage bytes of a
+        # flooded frame are identical across peers (only the per-peer
+        # sequence + mac differ), and the same tx/envelope arrives
+        # from several peers before the floodgate dedups — parse each
+        # unique message once and hand out compiled deep copies
+        # (cheaper than re-parsing; copies keep nodes memory-isolated)
+        inner = raw[12:-32]
+        cached = _MSG_PARSE_CACHE.maybe_get(inner) \
+            if len(raw) >= 44 and raw[:4] == b"\x00\x00\x00\x00" \
+            else None
+        if cached is not None:
+            from stellar_tpu.xdr.types import HmacSha256Mac
+            am_v = AuthenticatedMessageV0(
+                sequence=int.from_bytes(raw[4:12], "big"),
+                message=StellarMessage.copy(cached),
+                mac=HmacSha256Mac(mac=raw[-32:]))
+            return self._recv_authenticated(am_v, raw)
         try:
             am = from_bytes(AuthenticatedMessage, raw)
         except Exception:
             return self.drop("malformed frame")
-        self._recv_authenticated(am.value, raw)
+        # insertion happens in _recv_authenticated AFTER the MAC
+        # verifies — unauthenticated senders must not populate (or
+        # evict from) a process-wide cache
+        self._recv_authenticated(am.value, raw, cache_inner=inner)
 
     # ---------------- handshake ----------------
 
@@ -247,7 +273,7 @@ class Peer:
         self.send_bytes(raw)
 
     def _recv_authenticated(self, am: AuthenticatedMessageV0,
-                            raw: bytes):
+                            raw: bytes, cache_inner: bytes = None):
         msg = am.message
         if msg.arm != MessageType.HELLO:
             if self.recv_key is None:
@@ -262,6 +288,14 @@ class Peer:
                                              raw[4:-32], am.mac.mac):
                 return self.drop("bad MAC")
             self.recv_seq += 1
+            if cache_inner is not None and msg.arm in FLOOD_TYPES \
+                    and len(cache_inner) <= 65536:
+                # cache a PRIVATE copy, only for MAC-verified flood
+                # types (the only ones that repeat across peers) and
+                # bounded in size — the live object handed onward may
+                # be mutated and must never poison the cache
+                _MSG_PARSE_CACHE.put(cache_inner,
+                                     StellarMessage.copy(msg))
         # msg bytes = frame minus 4B tag, 8B seq, 32B mac — shared
         # downstream so flood hashing/re-broadcast never re-serializes
         self._recv_message(msg, raw[12:-32])

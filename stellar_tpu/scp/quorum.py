@@ -44,7 +44,10 @@ def is_quorum_slice(qset: SCPQuorumSet, nodes: Set[bytes]) -> bool:
     ``isQuorumSliceInternal``)."""
     left = qset.threshold
     for v in qset.validators:
-        if node_key(v) in nodes:
+        # validators are NodeID union values: .value IS the raw key
+        # (node_key()'s passthrough branch never applies here, and
+        # this loop dominates quorum math in consensus storms)
+        if v.value in nodes:
             left -= 1
             if left <= 0:
                 return True
