@@ -464,7 +464,7 @@ def cmd_apply_load(args) -> int:
     16-validator consensus rounds."""
     from stellar_tpu.simulation.load_generator import (
         apply_load, catchup_replay_bench, multisig_apply_load,
-        scp_storm_bench, soroban_apply_load,
+        scp_storm_bench, soroban_apply_load, soroban_compute_load,
     )
     if getattr(args, "conf", None):
         # APPLY_LOAD_* overrides (reference apply-load reading Config):
@@ -533,6 +533,10 @@ def cmd_apply_load(args) -> int:
         stats = soroban_apply_load(n_ledgers=args.ledgers,
                                    txs_per_ledger=args.txs,
                                    use_wasm=args.wasm)
+    elif args.scenario == "compute":
+        stats = soroban_compute_load(n_ledgers=args.ledgers,
+                                     txs_per_ledger=args.txs,
+                                     use_wasm=args.wasm)
     else:
         stats = apply_load(n_ledgers=args.ledgers,
                            txs_per_ledger=args.txs)
@@ -599,10 +603,10 @@ def main(argv=None) -> int:
     sp.add_argument("--txs", type=int, default=100)
     sp.add_argument("--scenario", default="close",
                     choices=["close", "catchup", "scp-storm",
-                             "multisig", "soroban"])
+                             "multisig", "soroban", "compute"])
     sp.add_argument("--wasm", action="store_true",
-                    help="soroban scenario runs a compiled wasm "
-                         "contract (native engine when built)")
+                    help="soroban/compute scenarios run a compiled "
+                         "wasm contract (native engine when built)")
     sp.add_argument("--verify", default="auto",
                     choices=["auto", "host", "device"],
                     help="signature verification routing: auto = "
