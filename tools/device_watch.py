@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Background device-window watcher (VERDICT r3 next-round #1).
+
+The axon TPU tunnel flaps: round 4 saw it come alive for ~2 minutes
+(long enough for one bench run) and die again.  This daemon loops a
+timestamped probe (``tools/device_probe.py``) and, whenever the device
+answers, immediately:
+
+1. runs ``bench.py`` and appends the JSON line (timestamped) to
+   ``docs/bench_runs/``, and
+2. captures a ``jax.profiler`` trace of the verify kernel into
+   ``docs/profiles/`` (perfetto .json.gz only, committed so outages
+   cannot erase the evidence).
+
+Run it under tmux for the whole round:  python tools/device_watch.py
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNS = os.path.join(REPO, "docs", "bench_runs")
+PROFILES = os.path.join(REPO, "docs", "profiles")
+PROBE = os.path.join(REPO, "tools", "device_probe.py")
+
+PROBE_PERIOD_DEAD_S = 120      # how often to re-probe while dead
+PROBE_PERIOD_ALIVE_S = 900     # back off after a successful capture
+BENCH_TIMEOUT_S = 560
+TRACE_TIMEOUT_S = 420
+
+TRACE_SRC = r"""
+import glob, json, os, shutil, sys
+import jax
+repo = sys.argv[1]
+out_dir = sys.argv[2]
+tmp = os.path.join(out_dir, "_tb")
+sys.path.insert(0, repo)
+from bench import gen_sigs  # exact benchmark workload (64 keys, 120B msgs)
+from stellar_tpu.crypto.batch_verifier import default_verifier
+items = gen_sigs(2048)
+v = default_verifier()
+assert v.verify_batch(items).all()  # warm/compile outside trace
+with jax.profiler.trace(tmp):
+    for _ in range(3):
+        v.verify_batch(items)
+# keep only the perfetto trace (small, committable)
+kept = []
+for f in glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"), recursive=True):
+    dst = os.path.join(out_dir, os.path.basename(f))
+    shutil.copy(f, dst)
+    kept.append(dst)
+shutil.rmtree(tmp, ignore_errors=True)
+print(json.dumps({"kept": kept}))
+sys.exit(0 if kept else 4)  # no trace file exported == failure
+"""
+
+
+def now():
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def stamp():
+    return now().strftime("%Y%m%dT%H%M%SZ")
+
+
+def log(msg):
+    print(f"[{now().isoformat()}] {msg}", flush=True)
+
+
+def run_probe():
+    try:
+        out = subprocess.run([sys.executable, PROBE, "60"],
+                             capture_output=True, text=True, timeout=120)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def capture_window():
+    """Device is up: grab a bench run and a profiler trace."""
+    os.makedirs(RUNS, exist_ok=True)
+    os.makedirs(PROFILES, exist_ok=True)
+    ts = stamp()
+    ok = False
+    try:
+        out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                             capture_output=True, text=True,
+                             timeout=BENCH_TIMEOUT_S, cwd=REPO)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        try:
+            rec = json.loads(line) if out.returncode == 0 else None
+        except ValueError:
+            rec = None
+        if rec is not None:
+            rec["recorded_at"] = now().isoformat()
+            path = os.path.join(RUNS, f"bench_{ts}.json")
+            with open(path, "w") as f:
+                json.dump(rec, f)
+            log(f"bench captured -> {path}: p50={rec.get('value')}ms "
+                f"vs_baseline={rec.get('vs_baseline')}")
+            ok = True
+        else:
+            log(f"bench failed rc={out.returncode}: "
+                f"stdout_tail={line[-200:]} stderr={out.stderr[-300:]}")
+    except subprocess.TimeoutExpired:
+        log("bench timed out (window closed mid-run?)")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", TRACE_SRC, REPO,
+             os.path.join(PROFILES, f"r4_{ts}")],
+            capture_output=True, text=True, timeout=TRACE_TIMEOUT_S, cwd=REPO,
+            env={**os.environ, "JAX_TRACEBACK_FILTERING": "off"})
+        if out.returncode == 0:
+            log(f"profiler trace captured: {out.stdout.strip()[-200:]}")
+            ok = True
+        else:
+            log(f"trace failed rc={out.returncode}: {out.stderr[-300:]}")
+    except subprocess.TimeoutExpired:
+        log("trace timed out")
+    return ok
+
+
+def main():
+    log("device watcher started")
+    while True:
+        alive = run_probe()
+        if alive:
+            log("device ALIVE - capturing window")
+            ok = capture_window()
+            time.sleep(PROBE_PERIOD_ALIVE_S if ok else PROBE_PERIOD_DEAD_S)
+        else:
+            time.sleep(PROBE_PERIOD_DEAD_S)
+
+
+if __name__ == "__main__":
+    main()
