@@ -292,9 +292,35 @@ _default_lock = threading.Lock()
 
 _device_state: Optional[str] = None  # None=unprobed, else platform|"dead"
 _device_probe_lock = threading.Lock()
+_probe_thread: Optional[threading.Thread] = None
+_probe_box: dict = {}
 
 
-def device_available(timeout_s: float = 30.0) -> bool:
+def start_device_probe() -> None:
+    """Fire the device probe WITHOUT waiting for it (idempotent).
+    Called from LedgerManager/Application construction so the jax
+    import + ``jax.devices()`` cost (seconds, or a hang on a dead
+    tunnel) is paid during startup, never inside the first ledger
+    close (the reference initializes its crypto stack at app start,
+    not in ``closeLedger``)."""
+    global _probe_thread
+    with _device_probe_lock:
+        if _probe_thread is None and _device_state is None:
+
+            def probe():
+                try:
+                    import jax
+                    _probe_box["platform"] = jax.devices()[0].platform
+                except Exception as e:  # no backend at all
+                    _probe_box["error"] = str(e)
+
+            _probe_thread = threading.Thread(target=probe, daemon=True,
+                                             name="device-probe")
+            _probe_thread.start()
+
+
+def device_available(timeout_s: float = 30.0,
+                     block: bool = True) -> bool:
     """True when a REAL accelerator is reachable. Probed once per
     process in a watchdogged thread: with the axon tunnel down,
     ``jax.devices()`` hangs forever rather than raising, and a node
@@ -302,32 +328,44 @@ def device_available(timeout_s: float = 30.0) -> bool:
     path (failure detection, not configuration). jax-CPU reports
     False: batching bignum kernels through XLA-on-CPU is strictly
     slower than the host oracle, so auto mode only engages the device
-    path on tpu-class hardware."""
+    path on tpu-class hardware.
+
+    ``block=False`` never waits: a still-pending probe answers False
+    for now WITHOUT caching a verdict, so latency-critical callers
+    (the close path) fall back to the host oracle this round and pick
+    up the device once the probe resolves."""
     global _device_state
+    start_device_probe()
+    if _device_state is None:
+        # join OUTSIDE the lock: a blocking waiter must never make a
+        # concurrent block=False caller (the close path) wait on the
+        # lock for up to timeout_s
+        t = _probe_thread
+        if block:
+            t.join(timeout_s)
+        elif t.is_alive():
+            return False  # pending — ask again later, don't cache
     with _device_probe_lock:
         if _device_state is None:
-            box = {}
-
-            def probe():
-                try:
-                    import jax
-                    box["platform"] = jax.devices()[0].platform
-                except Exception as e:  # no backend at all
-                    box["error"] = str(e)
-
-            t = threading.Thread(target=probe, daemon=True,
-                                 name="device-probe")
-            t.start()
-            t.join(timeout_s)
-            if "platform" in box:
-                _device_state = box["platform"]
+            t = _probe_thread
+            if t.is_alive():
+                if not block:
+                    return False  # pending — ask again later
+                _device_state = "dead"
+                import logging
+                logging.getLogger("stellar_tpu.crypto").warning(
+                    "device probe hung > %ss — signature "
+                    "verification falls back to the host oracle",
+                    timeout_s)
+            elif "platform" in _probe_box:
+                _device_state = _probe_box["platform"]
             else:
                 _device_state = "dead"
                 import logging
                 logging.getLogger("stellar_tpu.crypto").warning(
                     "device probe failed (%s) — signature "
                     "verification falls back to the host oracle",
-                    box.get("error", f"hung > {timeout_s}s"))
+                    _probe_box.get("error", "no backend"))
         return _device_state not in ("dead", "cpu")
 
 

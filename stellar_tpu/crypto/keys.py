@@ -230,7 +230,7 @@ def batch_verify_into_cache(items) -> None:
             results = [_backend(pk, msg, sig) for _, pk, msg, sig in todo]
     else:
         from stellar_tpu.crypto import batch_verifier
-        if batch_verifier.device_available():
+        if batch_verifier.device_available(block=False):
             results = batch_verifier.default_verifier().verify_batch(
                 [(pk, msg, sig) for _, pk, msg, sig in todo])
         else:

@@ -50,7 +50,9 @@ def full_tx_hash(frame) -> bytes:
     frame (hot: sorting, apply ordering, canonical-order checks)."""
     h = getattr(frame, "_full_hash", None)
     if h is None:
-        h = sha256(to_bytes(TransactionEnvelope, frame.envelope))
+        eb = getattr(frame, "envelope_bytes", None)
+        h = sha256(eb() if eb is not None
+                   else to_bytes(TransactionEnvelope, frame.envelope))
         frame._full_hash = h
     return h
 

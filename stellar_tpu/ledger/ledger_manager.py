@@ -130,6 +130,11 @@ class LedgerManager:
         self.network_id = network_id
         self.root = root if root is not None else LedgerTxnRoot()
         self.state_hasher = state_hasher or hash_store_state
+        # warm the accelerator probe off the close path: the first
+        # close must never pay the jax-import/device-discovery cost
+        # (reference: crypto stack is initialized at app start)
+        from stellar_tpu.crypto.batch_verifier import start_device_probe
+        start_device_probe()
         # durability hook (stellar_tpu.database.NodePersistence): every
         # close is saved in crash order; None = in-memory node
         self.persistence = persistence
@@ -262,7 +267,7 @@ class LedgerManager:
         # collection overhead, so apply verifies lazily instead.
         from stellar_tpu.crypto import batch_verifier, keys
         if keys._backend is not None or \
-                batch_verifier.device_available():
+                batch_verifier.device_available(block=False):
             triples = getattr(lcd.tx_set, "sig_triples", None)
             if triples is not None:
                 # checkValid collected these already: one cheap batch

@@ -37,16 +37,18 @@ from stellar_tpu.xdr.results import (
     OperationResult, TransactionResult, TransactionResultCode as TxCode,
     tx_result,
 )
-from stellar_tpu.xdr.runtime import to_bytes
+from stellar_tpu.xdr.runtime import Packer, to_bytes
 from stellar_tpu.xdr.tx import (
     DecoratedSignature, FeeBumpTransaction, MAX_OPS_PER_TX,
     Preconditions, PreconditionType, Transaction, TransactionEnvelope,
-    feebump_sig_payload, muxed_account, muxed_to_account_id,
-    transaction_sig_payload,
+    TransactionV1Envelope, muxed_account, muxed_to_account_id,
 )
 from stellar_tpu.xdr.types import (
     EnvelopeType, Signer, SignerKey, SignerKeyType,
 )
+
+# the signatures VarArray type shared by every envelope form
+_SIGS_T = dict(TransactionV1Envelope.FIELDS)["signatures"]
 
 __all__ = [
     "ValidationType", "MutableTxResult", "TransactionFrame",
@@ -134,17 +136,58 @@ class TransactionFrame:
             envelope.value.signatures
         self._hash: Optional[bytes] = None
         self._size: Optional[int] = None
+        self._body_bytes: Optional[bytes] = None
+        self._env_bytes: Optional[bytes] = None
         self.op_frames = [make_op_frame(op, self, i)
                           for i, op in enumerate(self.tx.operations)]
 
     # ---------------- identity / accessors ----------------
 
+    def invalidate_identity_caches(self) -> None:
+        """Drop every serialization-derived memo. MUST be called after
+        mutating ``self.tx`` / signatures (test-only idiom): resetting
+        ``_hash`` alone would rehash a stale memoized body."""
+        self._hash = None
+        self._size = None
+        self._body_bytes = None
+        self._env_bytes = None
+        if hasattr(self, "_full_hash"):
+            del self._full_hash
+
+    def tx_body_bytes(self) -> bytes:
+        """Memoized XDR of the (v1-form) transaction body. The sig
+        payload and the v1 envelope encoding both embed exactly these
+        bytes (RFC 4506 struct layout: TransactionSignaturePayload =
+        networkId ++ envType ++ body; TransactionEnvelope(TX) =
+        envType ++ body ++ signatures), so everything identity-shaped
+        on this frame derives from one serialization."""
+        if self._body_bytes is None:
+            self._body_bytes = to_bytes(Transaction, self.tx)
+        return self._body_bytes
+
+    def envelope_bytes(self) -> bytes:
+        """Memoized XDR of the full envelope (wire form, incl. sigs)."""
+        if self._env_bytes is None:
+            if self.envelope.arm == EnvelopeType.ENVELOPE_TYPE_TX:
+                p = Packer()
+                EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX)
+                p.buf += self.tx_body_bytes()
+                _SIGS_T.pack(p, self.envelope.value.signatures)
+                self._env_bytes = p.bytes()
+            else:  # v0 wire form differs from the v1 body
+                self._env_bytes = to_bytes(TransactionEnvelope,
+                                           self.envelope)
+        return self._env_bytes
+
     def contents_hash(self) -> bytes:
         """Tx id: SHA-256 of the signature payload (reference
         ``getContentsHash``; v0 envelopes hash as their v1 form)."""
         if self._hash is None:
-            self._hash = sha256(
-                transaction_sig_payload(self.network_id, self.tx))
+            p = Packer()
+            p.pack_fopaque(32, self.network_id)
+            EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX)
+            p.buf += self.tx_body_bytes()
+            self._hash = sha256(p.bytes())
         return self._hash
 
     def source_account_id(self):
@@ -170,8 +213,7 @@ class TransactionFrame:
         fees). Memoized: the envelope is immutable and fee/surge
         paths ask several times per close."""
         if self._size is None:
-            self._size = len(to_bytes(TransactionEnvelope,
-                                      self.envelope))
+            self._size = len(self.envelope_bytes())
         return self._size
 
     def note_soroban_consumption(self, refundable_consumed: int, events):
@@ -719,11 +761,42 @@ class FeeBumpTransactionFrame:
             EnvelopeType.ENVELOPE_TYPE_TX, self.fee_bump.innerTx.value)
         self.inner = TransactionFrame(network_id, inner_env)
         self._hash: Optional[bytes] = None
+        self._body_bytes: Optional[bytes] = None
+        self._env_bytes: Optional[bytes] = None
+
+    def invalidate_identity_caches(self) -> None:
+        """See ``TransactionFrame.invalidate_identity_caches``."""
+        self._hash = None
+        self._body_bytes = None
+        self._env_bytes = None
+        if hasattr(self, "_full_hash"):
+            del self._full_hash
+        self.inner.invalidate_identity_caches()
+
+    def tx_body_bytes(self) -> bytes:
+        """Memoized XDR of the FeeBumpTransaction body (see
+        ``TransactionFrame.tx_body_bytes`` for the layout argument)."""
+        if self._body_bytes is None:
+            self._body_bytes = to_bytes(FeeBumpTransaction,
+                                        self.fee_bump)
+        return self._body_bytes
+
+    def envelope_bytes(self) -> bytes:
+        if self._env_bytes is None:
+            p = Packer()
+            EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP)
+            p.buf += self.tx_body_bytes()
+            _SIGS_T.pack(p, self.envelope.value.signatures)
+            self._env_bytes = p.bytes()
+        return self._env_bytes
 
     def contents_hash(self) -> bytes:
         if self._hash is None:
-            self._hash = sha256(
-                feebump_sig_payload(self.network_id, self.fee_bump))
+            p = Packer()
+            p.pack_fopaque(32, self.network_id)
+            EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP)
+            p.buf += self.tx_body_bytes()
+            self._hash = sha256(p.bytes())
         return self._hash
 
     def fee_source_id(self):

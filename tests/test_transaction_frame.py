@@ -94,7 +94,7 @@ def test_bad_auth_wrong_signer(env):
     tx.tx.sourceAccount = __import__(
         "stellar_tpu.xdr.tx", fromlist=["muxed_account"]).muxed_account(
         a.public_key.raw)
-    tx._hash = None
+    tx.invalidate_identity_caches()
     with LedgerTxn(root) as ltx:
         assert tx.check_valid(ltx).code == TxCode.txBAD_AUTH
 
@@ -466,7 +466,7 @@ def test_soroban_ext_with_classic_ops_malformed(env):
             footprint=LedgerFootprint(readOnly=[], readWrite=[]),
             instructions=0, readBytes=0, writeBytes=0),
         resourceFee=0))
-    tx._hash = None
+    tx.invalidate_identity_caches()
     tx.signatures.clear()
     from stellar_tpu.crypto.sha import sha256
     from stellar_tpu.xdr.tx import transaction_sig_payload
@@ -513,3 +513,64 @@ def test_feebump_preauth_fee_source_signer_consumed(env):
         account_id(sponsor.public_key.raw))))
     assert e.data.value.signers == []
     assert e.data.value.numSubEntries == 0
+
+
+# ---------------------------------------------------------------------------
+# Envelope-byte fast paths (frame-level XDR reuse)
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_bytes_fast_path_matches_generic_v1():
+    """envelope_bytes()/contents_hash() are built by concatenating the
+    memoized tx-body encoding (RFC 4506 layout reuse) — they must be
+    byte-identical to a from-scratch generic serialization."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.tx import (
+        TransactionEnvelope, transaction_sig_payload,
+    )
+    a, b = keypair("fastA"), keypair("fastB")
+    f = make_tx(a, seq_num=5, ops=[payment_op(b, 7)],
+                extra_signers=[b])
+    assert f.envelope_bytes() == to_bytes(TransactionEnvelope, f.envelope)
+    assert f.contents_hash() == sha256(
+        transaction_sig_payload(TEST_NETWORK_ID, f.tx))
+    assert f.size_bytes() == len(to_bytes(TransactionEnvelope, f.envelope))
+
+
+def test_envelope_bytes_fast_path_matches_generic_feebump():
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.xdr.tx import TransactionEnvelope, feebump_sig_payload
+    a, b, payer = keypair("fbA"), keypair("fbB"), keypair("fbP")
+    inner = make_tx(a, seq_num=9, ops=[payment_op(b, 3)], fee=0)
+    fb = make_feebump(payer, outer_fee=400, inner_frame=inner)
+    assert fb.envelope_bytes() == to_bytes(TransactionEnvelope, fb.envelope)
+    assert fb.contents_hash() == sha256(
+        feebump_sig_payload(TEST_NETWORK_ID, fb.fee_bump))
+
+
+def test_envelope_bytes_v0_falls_back_to_generic():
+    """v0 envelopes keep the generic wire encoding (their wire form is
+    NOT the v1 body) while hashing as their v1 form."""
+    from stellar_tpu.crypto.sha import sha256
+    from stellar_tpu.tx.transaction_frame import TransactionFrame
+    from stellar_tpu.xdr.tx import (
+        TransactionEnvelope, TransactionV0, TransactionV0Envelope,
+        transaction_sig_payload,
+    )
+    from stellar_tpu.xdr.types import EnvelopeType
+    a, b = keypair("v0A"), keypair("v0B")
+    v1 = make_tx(a, seq_num=3, ops=[payment_op(b, 2)], fee=100)
+    tx0 = TransactionV0(
+        sourceAccountEd25519=a.public_key.raw,
+        fee=v1.tx.fee, seqNum=v1.tx.seqNum, timeBounds=None,
+        memo=v1.tx.memo, operations=list(v1.tx.operations),
+        ext=TransactionV0._types[6].make(0))
+    env0 = TransactionEnvelope.make(
+        EnvelopeType.ENVELOPE_TYPE_TX_V0,
+        TransactionV0Envelope(tx=tx0, signatures=list(v1.signatures)))
+    f0 = TransactionFrame(TEST_NETWORK_ID, env0)
+    assert f0.envelope_bytes() == to_bytes(TransactionEnvelope, env0)
+    # hashes as the v1 form: same contents hash as the equivalent v1 tx
+    assert f0.contents_hash() == sha256(
+        transaction_sig_payload(TEST_NETWORK_ID, f0.tx))
+    assert f0.size_bytes() == len(to_bytes(TransactionEnvelope, env0))

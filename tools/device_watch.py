@@ -79,34 +79,48 @@ def run_probe():
         return False
 
 
+def capture_json(cmd, prefix, ts, describe):
+    """Run cmd, parse its last stdout line as JSON, stamp + save it
+    under docs/bench_runs/. Returns True on a saved record."""
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=BENCH_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"{prefix} timed out (window closed mid-run?)")
+        return False
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    try:
+        rec = json.loads(line) if out.returncode == 0 else None
+    except ValueError:
+        rec = None
+    if rec is None:
+        log(f"{prefix} failed rc={out.returncode}: "
+            f"stdout_tail={line[-200:]} stderr={out.stderr[-300:]}")
+        return False
+    rec["recorded_at"] = now().isoformat()
+    path = os.path.join(RUNS, f"{prefix}_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    log(f"{prefix} captured -> {path}: {describe(rec)}")
+    return True
+
+
 def capture_window():
-    """Device is up: grab a bench run and a profiler trace."""
+    """Device is up: grab a bench run, an in-apply multisig run, and a
+    profiler trace."""
     os.makedirs(RUNS, exist_ok=True)
     os.makedirs(PROFILES, exist_ok=True)
     ts = stamp()
-    ok = False
-    try:
-        out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                             capture_output=True, text=True,
-                             timeout=BENCH_TIMEOUT_S, cwd=REPO)
-        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-        try:
-            rec = json.loads(line) if out.returncode == 0 else None
-        except ValueError:
-            rec = None
-        if rec is not None:
-            rec["recorded_at"] = now().isoformat()
-            path = os.path.join(RUNS, f"bench_{ts}.json")
-            with open(path, "w") as f:
-                json.dump(rec, f)
-            log(f"bench captured -> {path}: p50={rec.get('value')}ms "
-                f"vs_baseline={rec.get('vs_baseline')}")
-            ok = True
-        else:
-            log(f"bench failed rc={out.returncode}: "
-                f"stdout_tail={line[-200:]} stderr={out.stderr[-300:]}")
-    except subprocess.TimeoutExpired:
-        log("bench timed out (window closed mid-run?)")
+    ok = capture_json(
+        [sys.executable, os.path.join(REPO, "bench.py")], "bench", ts,
+        lambda r: f"p50={r.get('value')}ms "
+                  f"vs_baseline={r.get('vs_baseline')}")
+    ok = capture_json(
+        [sys.executable,
+         os.path.join(REPO, "tools", "ondevice_multisig.py"), "3"],
+        "multisig_device", ts,
+        lambda r: f"close_mean={r.get('close_mean_ms')}ms "
+                  f"backend={r.get('verify_backend')}") or ok
     try:
         out = subprocess.run(
             [sys.executable, "-c", TRACE_SRC, REPO,

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""On-device multisig apply-load capture (VERDICT r3 #3).
+
+Installs the device BatchVerifier as the process verify backend, runs
+the multisig apply-load scenario (1,000 txs x 2 sigs per ledger), and
+prints one JSON line.  Run by tools/device_watch.py during live TPU
+windows so ``docs/benchmarks.json``'s host-oracle multisig row gains a
+device-backend counterpart: close_mean should collapse from the
+sequential-verify cost (~660 ms) toward one batch dispatch.
+"""
+import json
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    n_ledgers = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    from stellar_tpu.crypto.batch_verifier import default_verifier
+    from stellar_tpu.crypto.keys import get_verifier_backend_name
+    from stellar_tpu.simulation.load_generator import multisig_apply_load
+    default_verifier().install()
+    rec = multisig_apply_load(n_ledgers=n_ledgers, txs_per_ledger=1000)
+    rec["verify_backend"] = get_verifier_backend_name()
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
