@@ -88,8 +88,16 @@ def entry_to_key(entry: LedgerEntry):
 
 
 def key_bytes(key) -> bytes:
-    """Canonical identity of a LedgerKey: its XDR encoding."""
-    return to_bytes(LedgerKey, key)
+    """Canonical identity of a LedgerKey: its XDR encoding. Memoized
+    on the key object (keys are build-then-use; mutating one after
+    the first serialization would already corrupt any map keyed by
+    it, so the memo introduces no new hazard)."""
+    try:
+        return key._xdr_cache
+    except AttributeError:
+        kb = to_bytes(LedgerKey, key)
+        key._xdr_cache = kb
+        return kb
 
 
 def root_of(ltx):

@@ -221,6 +221,10 @@ class _Storage:
         # end), however often the contract touches it
         self._read_charged: set = set()
         self._write_sizes: Dict[bytes, int] = {}
+        # kb -> serialized LedgerEntry size; entries only change via
+        # put() (which recomputes), so repeated gets reuse the size
+        # instead of re-serializing the whole entry each access
+        self._entry_sizes: Dict[bytes, int] = {}
         self.read_bytes = 0
         # kb -> new live_until from in-contract TTL extensions
         # (separate from dirty slots: a TTL-only bump must not rewrite
@@ -244,7 +248,10 @@ class _Storage:
         if slot is None or slot[0] is None:
             return None
         self._check_live(kb, slot)
-        size = len(to_bytes(LedgerEntry, slot[0]))
+        size = self._entry_sizes.get(kb)
+        if size is None:
+            size = len(to_bytes(LedgerEntry, slot[0]))
+            self._entry_sizes[kb] = size
         if kb not in self._read_charged:
             self._read_charged.add(kb)
             self.read_bytes += size
@@ -258,6 +265,7 @@ class _Storage:
                             "write outside declared footprint")
         size = len(to_bytes(LedgerEntry, entry))
         self._write_sizes[kb] = size  # final size counts, once per key
+        self._entry_sizes[kb] = size
         self.budget.charge(CPU_PER_STORAGE_OP + CPU_PER_BYTE * size, size)
         slot = self.entries.setdefault(kb, [None, None, False])
         slot[0] = entry
@@ -274,6 +282,7 @@ class _Storage:
         slot = self.entries.setdefault(kb, [None, None, False])
         slot[0] = None
         slot[2] = True
+        self._entry_sizes.pop(kb, None)
 
 
 # ---------------------------------------------------------------------------
@@ -735,6 +744,7 @@ class _Host:
         self.ledger_seq = ledger_seq
         self.network_id = network_id
         self.events: List = []
+        self._events_size = 0  # running serialized size (limit check)
         self.diagnostics: List = []
         self.base_prng = _Prng(prng_seed if prng_seed is not None
                                else b"\x00" * 32)
@@ -769,14 +779,14 @@ class _Host:
             if self.auth is not None else None,
             {k: list(v) for k, v in self.contract_auths.items()},
             set(st._read_charged), dict(st._write_sizes),
-            st.read_bytes,
+            st.read_bytes, self._events_size, dict(st._entry_sizes),
         )
 
     def restore(self, snap):
         st = self.storage
         (st.entries, st.ttl_extensions, n_ev, n_diag, avail,
          cauths, st._read_charged, st._write_sizes,
-         st.read_bytes) = snap
+         st.read_bytes, self._events_size, st._entry_sizes) = snap
         del self.events[n_ev:]
         del self.diagnostics[n_diag:]
         if avail is not None:
@@ -842,10 +852,13 @@ class _Host:
             body=ContractEvent._types[3].make(
                 0, ContractEventV0(topics=topics, data=data)))
         size = len(to_bytes(ContractEvent, ev))
-        if sum(len(to_bytes(ContractEvent, e)) for e in self.events) + \
-                size > self.config.tx_max_contract_events_size_bytes:
+        # running total, NOT a re-serialization of every prior event
+        # (that would be quadratic in the event count)
+        if self._events_size + size > \
+                self.config.tx_max_contract_events_size_bytes:
             raise HostError(HostError.BUDGET, "events size limit")
         self.budget.charge(CPU_PER_INSTRUCTION + CPU_PER_BYTE * size, size)
+        self._events_size += size
         self.events.append(ev)
 
     # ---- contract-data storage (shared by both execution engines) ----
@@ -1210,7 +1223,7 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
             if native_wasm.available():
                 rv = native_wasm.run_export(
                     module, imports, budget, CPU_PER_WASM_INSN, fn,
-                    vals)
+                    vals, cache_imports=pooled is not None)
                 return decode(rv) if rv is not None \
                     else SCVal.make(T.SCV_VOID)
         inst = WasmInstance(module, imports, charge, mem_charge)

@@ -290,11 +290,103 @@ class _MemShim:
         ctypes.memmove(base + ptr, data, len(data))
 
 
+class _RunCtx:
+    """Per-invocation state behind the PERSISTENT ctypes callbacks.
+    Creating a CFUNCTYPE wrapper costs more than a typical 3-op
+    contract's whole execution; instead one pair of callbacks per
+    thread closes over a swappable context (stacked for reentrant
+    ``call`` dispatch)."""
+
+    __slots__ = ("host_fns", "budget", "cpu_per_insn", "shim",
+                 "exc_box", "settled")
+
+    def __init__(self, host_fns, budget, cpu_per_insn):
+        self.host_fns = host_fns
+        self.budget = budget
+        self.cpu_per_insn = cpu_per_insn
+        self.shim = _MemShim()
+        self.exc_box = []
+        self.settled = 0  # op-ticks already charged to the real budget
+
+    def remaining_ticks(self) -> int:
+        room = self.budget.cpu_limit - self.budget.cpu
+        return max(0, room // self.cpu_per_insn)
+
+    def settle(self, charged_so_far: int, extra_cpu: int = 0):
+        """Charge the engine's op ticks into the REAL budget before any
+        host-side charge decision, so host-fn charges and wasm ticks
+        share ONE exhaustion point, exactly like the Python engine
+        (which charges every tick chunk straight into the budget). By
+        construction the engine only runs ticks it was granted, so a
+        settle inside the grant never raises; the FINAL settle of a
+        budget-trapped run carries the failing chunk and raises at the
+        same point the Python engine's chunk charge does."""
+        delta = charged_so_far - self.settled
+        if delta:
+            self.settled = charged_so_far
+            self.budget.charge(delta * self.cpu_per_insn)
+        if extra_cpu:
+            # separate charge call: the budget value observable at an
+            # exhaustion trap must match the Python engine's, which
+            # charges tick chunks and the crossing cost independently
+            self.budget.charge(extra_cpu)
+
+
+_tls = threading.local()
+
+
+def _thread_cbs():
+    """(ctx_stack, host_cb, mem_cb) — one persistent callback pair per
+    thread; ``ctx_stack[-1]`` is the active invocation's context."""
+    cbs = getattr(_tls, "cbs", None)
+    if cbs is None:
+        stack = []
+
+        def host_cb(_c, import_idx, args_p, nargs, result_p,
+                    ticks_left_p, charged_so_far, mem_p, mem_len):
+            ctx = stack[-1]
+            try:
+                # one combined charge: settled ticks + crossing cost
+                ctx.settle(charged_so_far,
+                           HOST_CALL_COST * ctx.cpu_per_insn)
+                shim = ctx.shim
+                shim.ptr = mem_p
+                shim.size = mem_len
+                call_args = [args_p[i] & _M64 for i in range(nargs)]
+                rv = ctx.host_fns[import_idx](shim, *call_args)
+                result_p[0] = _s64(rv if rv is not None else 0)
+                ticks_left_p[0] = ctx.remaining_ticks()
+                return 0
+            except BaseException as e:
+                ctx.exc_box.append(e)
+                return 1
+
+        def mem_cb(_c, n_bytes):
+            ctx = stack[-1]
+            try:
+                ctx.budget.charge(0, n_bytes)
+                return 0
+            except BaseException as e:
+                ctx.exc_box.append(e)
+                return 1
+
+        cbs = (stack, _HOST_CB(host_cb), _MEM_CB(mem_cb))
+        _tls.cbs = cbs
+    return cbs
+
+
 def run_export(module: WasmModule, imports: Dict, budget,
-               cpu_per_insn: int, fn_name: str, args) -> Optional[int]:
+               cpu_per_insn: int, fn_name: str, args,
+               cache_imports: bool = False) -> Optional[int]:
     """Execute ``fn_name(args)`` natively. Charges ride the REAL
     ``budget``; raises Trap (or re-raises whatever a host import
-    raised) exactly like the Python engine."""
+    raised) exactly like the Python engine.
+
+    ``cache_imports=True`` memoizes the resolved import list on the
+    module keyed by the imports dict's identity — pass it ONLY for
+    pooled, process-lifetime import tables (the modern host env pool):
+    caching an ad-hoc dict would pin its closed-over host graph alive
+    on the globally cached module."""
     lib = _load()
     assert lib is not None
     # instantiation-order parity with the Python engine: initial
@@ -316,73 +408,43 @@ def run_export(module: WasmModule, imports: Dict, budget,
         else:
             func_idx = exp[1]
 
-    host_fns = []
-    for mod, name, _t in module.imports:
-        fn = imports.get((mod, name))
-        if fn is None:
-            from stellar_tpu.soroban.wasm import WasmError
-            raise WasmError(f"unresolved import {mod}.{name}")
-        host_fns.append(fn)
+    # resolve the import table once per (module, imports-dict) pair —
+    # the per-thread env pool reuses its imports dict, so steady-state
+    # invokes skip the per-import lookups entirely
+    cache = getattr(module, "_host_fns_cache", None)
+    if cache is not None and cache[0] is imports:
+        host_fns = cache[1]
+    else:
+        host_fns = []
+        for mod, name, _t in module.imports:
+            fn = imports.get((mod, name))
+            if fn is None:
+                from stellar_tpu.soroban.wasm import WasmError
+                raise WasmError(f"unresolved import {mod}.{name}")
+            host_fns.append(fn)
+        if cache_imports:
+            module._host_fns_cache = (imports, host_fns)
 
-    shim = _MemShim()
-    exc_box = []
-
-    settled = [0]  # engine op-ticks already charged to the real budget
-
-    def remaining_ticks() -> int:
-        room = budget.cpu_limit - budget.cpu
-        return max(0, room // cpu_per_insn)
-
-    def settle(charged_so_far: int):
-        """Charge the engine's op ticks into the REAL budget before any
-        host-side charge decision, so host-fn charges and wasm ticks
-        share ONE exhaustion point, exactly like the Python engine
-        (which charges every tick chunk straight into the budget). By
-        construction the engine only runs ticks it was granted, so a
-        settle inside the grant never raises; the FINAL settle of a
-        budget-trapped run carries the failing chunk and raises at the
-        same point the Python engine's chunk charge does."""
-        delta = charged_so_far - settled[0]
-        if delta:
-            settled[0] = charged_so_far
-            budget.charge(delta * cpu_per_insn)
-
-    def host_cb(_ctx, import_idx, args_p, nargs, result_p,
-                ticks_left_p, charged_so_far, mem_p, mem_len):
-        try:
-            settle(charged_so_far)
-            budget.charge(HOST_CALL_COST * cpu_per_insn)
-            shim.ptr = mem_p
-            shim.size = mem_len
-            call_args = [args_p[i] & _M64 for i in range(nargs)]
-            rv = host_fns[import_idx](shim, *call_args)
-            result_p[0] = _s64(rv if rv is not None else 0)
-            ticks_left_p[0] = remaining_ticks()
-            return 0
-        except BaseException as e:
-            exc_box.append(e)
-            return 1
-
-    def mem_cb(_ctx, n_bytes):
-        try:
-            budget.charge(0, n_bytes)
-            return 0
-        except BaseException as e:
-            exc_box.append(e)
-            return 1
+    stack, hcb, mcb = _thread_cbs()
+    ctx = _RunCtx(host_fns, budget, cpu_per_insn)
+    exc_box = ctx.exc_box
 
     out = _RunResult()
-    rc = lib.wasm_run(
-        ctypes.byref(desc), func_idx,
-        (ctypes.c_int64 * max(1, len(args)))(
-            *[_s64(a & _M64) for a in args] or [0]),
-        len(args), _HOST_CB(host_cb), _MEM_CB(mem_cb), None,
-        remaining_ticks(), ctypes.byref(out))
+    stack.append(ctx)
+    try:
+        rc = lib.wasm_run(
+            ctypes.byref(desc), func_idx,
+            (ctypes.c_int64 * max(1, len(args)))(
+                *[_s64(a & _M64) for a in args] or [0]),
+            len(args), hcb, mcb, None,
+            ctx.remaining_ticks(), ctypes.byref(out))
+    finally:
+        stack.pop()
 
     # settle the remaining wasm-op charges; a budget-trapped run's
     # failing chunk raises here, mirroring the Python engine's chunk
     # charge exactly
-    settle(out.charged)
+    ctx.settle(out.charged)
     if rc == ST_OK:
         return (out.value & _M64) if out.has_value else None
     if rc == ST_HOST:

@@ -180,13 +180,20 @@ class _SCValLazy:
         return self._real().make(arm, value)
 
     def pack(self, p, v):
-        self._real().pack(p, v)
+        u = self._real()
+        # collapse the indirection for every later call
+        self.pack = u.pack
+        u.pack(p, v)
 
-    def unpack(self, u):
-        return self._real().unpack(u)
+    def unpack(self, u_):
+        u = self._real()
+        self.unpack = u.unpack
+        return u.unpack(u_)
 
     def copy(self, v):
-        return self._real().copy(v)
+        u = self._real()
+        self.copy = u.copy
+        return u.copy(v)
 
 
 SCVal = _SCValLazy()

@@ -399,13 +399,12 @@ class Struct(metaclass=_StructMeta):
 
     @classmethod
     def pack(cls, p: Packer, v: "Struct"):
-        fast = cls.__dict__.get("_fast_pack")
+        fast = cls.__dict__.get("_tree_pack_fn")
         if fast is None:
-            cls._compile_codecs()
-            fast = cls.__dict__["_fast_pack"]
+            fast = tree_packer(cls)
         mark = len(p.buf)
         try:
-            fast(p, v)
+            fast(p.buf, v)
         except XdrError:
             raise
         except Exception as e:
@@ -467,7 +466,10 @@ class Union:
     """
 
     class Value:
-        __slots__ = ("arm", "value")
+        # _xdr_cache: memoized encoding slot for identity-hot values
+        # (LedgerKey bytes — see ledger_txn.key_bytes). Never written
+        # by the runtime itself.
+        __slots__ = ("arm", "value", "_xdr_cache")
 
         def __init__(self, arm, value=None):
             self.arm = arm
@@ -488,6 +490,7 @@ class Union:
         self.disc = disc
         self.arms = arms
         self.default = default
+        self._tree_fn = None
 
     def make(self, arm, value=None) -> "Union.Value":
         return Union.Value(arm, value)
@@ -498,10 +501,26 @@ class Union:
             raise XdrError(f"{self.name}: bad union arm {arm}")
         return t
 
-    def pack(self, p, v: "Union.Value"):
+    def _pack_generic(self, p, v: "Union.Value"):
         t = self._armtype(v.arm)
         self.disc.pack(p, v.arm)
         t.pack(p, v.value)
+
+    def pack(self, p, v: "Union.Value"):
+        fn = self._tree_fn
+        if fn is None:
+            fn = self._tree_fn = tree_packer(self)
+        mark = len(p.buf)
+        try:
+            fn(p.buf, v)
+        except XdrError:
+            raise
+        except Exception:
+            # rewind, then generic for an arm-precise error
+            del p.buf[mark:]
+            self._pack_generic(p, v)
+            raise XdrError(f"{self.name}: tree pack failed but "
+                           "generic pack succeeded")
 
     def unpack(self, u):
         arm = self.disc.unpack(u)
@@ -515,10 +534,288 @@ class Union:
         return Union.Value(v.arm, t.copy(v.value))
 
 
+# ---------------------------------------------------------------------------
+# Inline tree-pack compiler
+# ---------------------------------------------------------------------------
+# The generic pack path costs ~6 Python calls per leaf (classmethod ->
+# compiled field line -> type.pack -> Packer method -> struct.pack).
+# Serialization IS the apply loop's hot path (tx hashing, entry sizes,
+# meta, bucket hashing — reference xdrpp is compiled C++), so each type
+# gets ONE generated function appending straight to a bytearray:
+# primitives become prebound struct.Struct packs, enums become
+# value->bytes dict lookups, arrays/options inline their element
+# handling, and composite children are direct function calls. Rarely-
+# taken error paths (bad enum value, wrong opaque length raising
+# through struct.error/KeyError) fall back to the generic packer for
+# field-precise XdrErrors — same discipline as Struct._pack_slow.
+
+_SU32 = struct.Struct(">I").pack
+_SI32 = struct.Struct(">i").pack
+_SU64 = struct.Struct(">Q").pack
+_SI64 = struct.Struct(">q").pack
+_ZERO4 = b"\x00\x00\x00\x00"
+_ONE4 = b"\x00\x00\x00\x01"
+_PADS = {1: b"\x00\x00\x00", 2: b"\x00\x00", 3: b"\x00"}
+
+# RLock: compiling a composite recursively compiles its children
+_tree_lock = __import__("threading").RLock()
+_tree_registry: Dict[int, Any] = {}
+_tree_keepalive: List[Any] = []  # pin type objects so ids stay unique
+
+
+def _resolve_lazy(t):
+    real = getattr(t, "_real", None)
+    return real() if callable(real) else t
+
+
+def _void_tree(buf, v):
+    if v is not None:
+        raise XdrError("void takes None")
+
+
+def _emit_node(t, expr, lines, ns, ctr, indent):
+    """Append source lines that pack ``expr`` (a Python expression)
+    into the local bytearray ``buf``."""
+    pre = "    " * indent
+    t = _resolve_lazy(t)
+    if t is Uint32:
+        lines.append(f"{pre}buf += _SU32({expr})")
+        return
+    if t is Int32:
+        lines.append(f"{pre}buf += _SI32({expr})")
+        return
+    if t is Uint64:
+        lines.append(f"{pre}buf += _SU64({expr})")
+        return
+    if t is Int64:
+        lines.append(f"{pre}buf += _SI64({expr})")
+        return
+    if isinstance(t, _Bool):
+        lines.append(f"{pre}buf += _ONE4 if {expr} else _ZERO4")
+        return
+    if isinstance(t, _Void):
+        k = next(ctr)
+        lines.append(f"{pre}if {expr} is not None:")
+        lines.append(f"{pre}    raise XdrError('void takes None')")
+        return
+    if isinstance(t, Opaque):
+        k = next(ctr)
+        n = t.n
+        lines.append(f"{pre}v{k} = {expr}")
+        lines.append(f"{pre}if len(v{k}) != {n}:")
+        lines.append(f"{pre}    raise XdrError("
+                     f"'fixed opaque: want {n} bytes')")
+        lines.append(f"{pre}buf += v{k}")
+        if n % 4:
+            lines.append(f"{pre}buf += {_PADS[n % 4]!r}")
+        return
+    if isinstance(t, (VarOpaque, XdrString)):
+        k = next(ctr)
+        lines.append(f"{pre}v{k} = {expr}")
+        if isinstance(t, XdrString):
+            lines.append(f"{pre}if type(v{k}) is str:")
+            lines.append(f"{pre}    v{k} = v{k}.encode()")
+        lines.append(f"{pre}n{k} = len(v{k})")
+        lines.append(f"{pre}if n{k} > {t.maxlen}:")
+        lines.append(f"{pre}    raise XdrError('opaque too long: ' +"
+                     f" str(n{k}) + ' > {t.maxlen}')")
+        lines.append(f"{pre}buf += _SU32(n{k})")
+        lines.append(f"{pre}buf += v{k}")
+        lines.append(f"{pre}if n{k} & 3:")
+        lines.append(f"{pre}    buf += _PADS[n{k} & 3]")
+        return
+    if isinstance(t, Enum):
+        k = next(ctr)
+        ns[f"_e{k}"] = {v: _SI32(v) for v in t.by_value}
+        lines.append(f"{pre}buf += _e{k}[{expr}]")  # KeyError->fallback
+        return
+    if isinstance(t, FixedArray):
+        k = next(ctr)
+        lines.append(f"{pre}a{k} = {expr}")
+        lines.append(f"{pre}if len(a{k}) != {t.n}:")
+        lines.append(f"{pre}    raise XdrError('fixed array: want "
+                     f"{t.n}, got ' + str(len(a{k})))")
+        lines.append(f"{pre}for e{k} in a{k}:")
+        _emit_node(t.elem, f"e{k}", lines, ns, ctr, indent + 1)
+        return
+    if isinstance(t, VarArray):
+        k = next(ctr)
+        lines.append(f"{pre}a{k} = {expr}")
+        lines.append(f"{pre}if len(a{k}) > {t.maxlen}:")
+        lines.append(f"{pre}    raise XdrError('array too long: ' + "
+                     f"str(len(a{k})) + ' > {t.maxlen}')")
+        lines.append(f"{pre}buf += _SU32(len(a{k}))")
+        lines.append(f"{pre}for e{k} in a{k}:")
+        _emit_node(t.elem, f"e{k}", lines, ns, ctr, indent + 1)
+        return
+    if isinstance(t, Option):
+        k = next(ctr)
+        lines.append(f"{pre}v{k} = {expr}")
+        lines.append(f"{pre}if v{k} is None:")
+        lines.append(f"{pre}    buf += _ZERO4")
+        lines.append(f"{pre}else:")
+        lines.append(f"{pre}    buf += _ONE4")
+        _emit_node(t.elem, f"v{k}", lines, ns, ctr, indent + 1)
+        return
+    if (isinstance(t, type) and issubclass(t, Struct)) or \
+            isinstance(t, Union):
+        k = next(ctr)
+        ns[f"_f{k}"] = tree_packer(t)
+        lines.append(f"{pre}_f{k}(buf, {expr})")
+        return
+    # unknown custom type: generic pack onto the shared buffer
+    k = next(ctr)
+    ns[f"_t{k}"] = t
+    ns["_Packer"] = Packer
+    lines.append(f"{pre}p{k} = _Packer()")
+    lines.append(f"{pre}p{k}.buf = buf")
+    lines.append(f"{pre}_t{k}.pack(p{k}, {expr})")
+
+
+def _compile_tree(t):
+    """Build the tree-pack function for one composite type."""
+    import itertools
+    ctr = itertools.count()
+    ns = {"_SU32": _SU32, "_SI32": _SI32, "_SU64": _SU64,
+          "_SI64": _SI64, "_ZERO4": _ZERO4, "_ONE4": _ONE4,
+          "_PADS": _PADS, "XdrError": XdrError}
+    lines: List[str] = []
+    if isinstance(t, type) and issubclass(t, Struct):
+        for n, ft in zip(t._names, t._types):
+            _emit_node(ft, f"v.{n}", lines, ns, ctr, 1)
+        body = "\n".join(lines) or "    pass"
+        src = f"def _tp(buf, v):\n{body}\n"
+        exec(src, ns)  # noqa: S102 - generated from declarative FIELDS
+        return ns["_tp"]
+    if isinstance(t, Union):
+        arms = {}
+        for arm, at in t.arms.items():
+            at = _resolve_lazy(at)
+            arms[arm] = _void_tree if isinstance(at, _Void) \
+                else tree_packer(at)
+        default = None
+        if t.default is not None:
+            dt = _resolve_lazy(t.default)
+            default = _void_tree if isinstance(dt, _Void) \
+                else tree_packer(dt)
+        ns["_arms_get"] = arms.get
+        ns["_dflt"] = default
+        ns["_name"] = t.name
+        disc = _resolve_lazy(t.disc)
+        if isinstance(disc, Enum):
+            ns["_ed"] = {v: _SI32(v) for v in disc.by_value}
+            disc_line = "    buf += _ed[arm]"
+        elif disc is Int32:
+            disc_line = "    buf += _SI32(arm)"
+        elif disc is Uint32:
+            disc_line = "    buf += _SU32(arm)"
+        else:  # exotic discriminant: generic path handles it
+            ns["_disc"] = disc
+            ns["_Packer"] = Packer
+            disc_line = ("    p0 = _Packer()\n    p0.buf = buf\n"
+                         "    _disc.pack(p0, arm)")
+        src = (
+            "def _tp(buf, v):\n"
+            "    arm = v.arm\n"
+            "    f = _arms_get(arm, _dflt)\n"
+            "    if f is None:\n"
+            "        raise XdrError('%s: bad union arm %r'"
+            " % (_name, arm))\n"
+            f"{disc_line}\n"
+            "    f(buf, v.value)\n")
+        exec(src, ns)  # noqa: S102
+        return ns["_tp"]
+    # non-composite root (primitive/array/option): wrap a single node
+    lines = []
+    _emit_node(t, "v", lines, ns, ctr, 1)
+    src = "def _tp(buf, v):\n" + "\n".join(lines) + "\n"
+    exec(src, ns)  # noqa: S102
+    return ns["_tp"]
+
+
+def tree_packer(t):
+    """Memoized tree-pack function for ``t`` (cycle-safe: a forwarder
+    is registered before compilation, so recursive types like SCVal
+    close their cycle through one extra indirection)."""
+    # fast path: previously-seen object (original OR resolved id)
+    fn = _tree_registry.get(id(t))
+    if fn is not None:
+        return fn
+    orig = t
+    t = _resolve_lazy(t)
+    if isinstance(t, type) and issubclass(t, Struct):
+        fn = t.__dict__.get("_tree_pack_fn")
+        if fn is not None:
+            if orig is not t:
+                _tree_registry[id(orig)] = fn
+                _tree_keepalive.append(orig)
+            return fn
+    else:
+        fn = _tree_registry.get(id(t))
+        if fn is not None:
+            if orig is not t:
+                _tree_registry[id(orig)] = fn
+                _tree_keepalive.append(orig)
+            return fn
+    with _tree_lock:
+        # re-check under the lock
+        if isinstance(t, type) and issubclass(t, Struct):
+            fn = t.__dict__.get("_tree_pack_fn")
+        else:
+            fn = _tree_registry.get(id(t))
+        if fn is not None:
+            return fn
+        cell = [None]
+
+        def forward(buf, v, _cell=cell):
+            fn = _cell[0]
+            if fn is None:
+                # a concurrent thread sees the forwarder mid-compile:
+                # wait for the compiling thread to release the lock
+                with _tree_lock:
+                    fn = _cell[0]
+                if fn is None:
+                    raise XdrError("tree pack compilation failed")
+            fn(buf, v)
+
+        # the forwarder lives ONLY in the registry (the class attr is
+        # published after compilation finishes): compile-time recursion
+        # closes cycles through it, while concurrent Struct.pack
+        # callers miss the class attr, land here, and block on the
+        # lock instead of calling through an un-filled cell
+        _tree_registry[id(t)] = forward
+        _tree_keepalive.append(t)
+        try:
+            real = _compile_tree(t)
+        except BaseException:
+            del _tree_registry[id(t)]
+            raise
+        cell[0] = real
+        if isinstance(t, type) and issubclass(t, Struct):
+            t._tree_pack_fn = real
+        _tree_registry[id(t)] = real
+        if orig is not t:
+            _tree_registry[id(orig)] = real
+            _tree_keepalive.append(orig)
+        return real
+
+
 def to_bytes(t, v) -> bytes:
-    p = Packer()
-    t.pack(p, v)
-    return p.bytes()
+    tp = tree_packer(t)
+    buf = bytearray()
+    try:
+        tp(buf, v)
+    except XdrError:
+        raise
+    except Exception as e:
+        # rare/exceptional encodings (bad enum value, wrong types):
+        # re-run the generic packer for a field-precise XdrError
+        p = Packer()
+        t.pack(p, v)
+        raise XdrError(
+            f"tree pack failed but generic pack succeeded: {e!r}"
+        ) from e
+    return bytes(buf)
 
 
 def from_bytes(t, data: bytes):

@@ -425,6 +425,11 @@ class _LazyArm:
     def _real(self):
         if self._t is None:
             self._t = self._loader()
+            # collapse the indirection: instance attributes shadow the
+            # class methods, so later calls skip this wrapper entirely
+            self.pack = self._t.pack
+            self.unpack = self._t.unpack
+            self.copy = self._t.copy
         return self._t
 
     def pack(self, p, v):
