@@ -1,0 +1,160 @@
+// CPython extension trampoline around the native wasm engine.
+//
+// The ctypes CFUNCTYPE path costs ~10-20us per host-call crossing
+// (thunk entry, per-argument ctypes object construction); a 3-op
+// soroban contract makes ~7 host calls, so the crossings dominated
+// its execution. This module drives the SAME engine (wasm_exec.cpp,
+// included as one translation unit — semantics are compiled in, not
+// duplicated) but dispatches host imports through the CPython C API:
+// one vectorcall into a Python dispatcher with plain int arguments.
+//
+// Contract with stellar_tpu/soroban/native_wasm.py:
+//   run(prog_addr, func_idx, args_seq, ticks_budget,
+//       host_dispatch, mem_dispatch, out_addr) -> None
+// - prog_addr / out_addr are ctypes.addressof() of the SAME
+//   ProgramDesc / RunResult structures the ctypes path uses.
+// - host_dispatch(import_idx, args_tuple, charged, mem_addr, mem_len)
+//   returns (result_u64, ticks_left) on success or None after
+//   recording the real exception on the Python side (the engine then
+//   reports ST_HOST and the bridge re-raises the recorded exception —
+//   identical control flow to the CFUNCTYPE path's exc_box).
+// - mem_dispatch(n_bytes) returns anything on success, None on a
+//   recorded failure.
+// - RunResult is ALWAYS filled before returning, including when a
+//   Python exception is propagating, so the bridge can settle the
+//   charged ticks exactly like the ctypes path does.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "wasm_exec.cpp"
+
+namespace {
+
+struct ExtCtx {
+    PyObject* host_dispatch;
+    PyObject* mem_dispatch;
+};
+
+int32_t ext_host_cb(void* vctx, int32_t import_idx,
+                    const int64_t* args, int32_t nargs,
+                    int64_t* result, int64_t* ticks_left,
+                    int64_t charged_so_far,
+                    uint8_t* mem, int64_t mem_len) {
+    ExtCtx* ctx = static_cast<ExtCtx*>(vctx);
+    // ext_run released the GIL around wasm_run (parity with the
+    // ctypes path, which releases it during native execution)
+    PyGILState_STATE gil = PyGILState_Ensure();
+    int32_t rc = 1;
+    PyObject* r = NULL;
+    PyObject* tup = PyTuple_New(nargs);
+    if (!tup)
+        goto done;
+    for (int32_t i = 0; i < nargs; i++) {
+        PyObject* o = PyLong_FromUnsignedLongLong(
+            (unsigned long long)(uint64_t)args[i]);
+        if (!o) {
+            Py_DECREF(tup);
+            tup = NULL;
+            goto done;
+        }
+        PyTuple_SET_ITEM(tup, i, o);
+    }
+    r = PyObject_CallFunction(
+        ctx->host_dispatch, "iNLKL", (int)import_idx, tup,
+        (long long)charged_so_far,
+        (unsigned long long)(uintptr_t)mem, (long long)mem_len);
+    tup = NULL;  // "N" stole the reference
+    if (!r)
+        goto done;
+    if (r == Py_None)  // dispatcher recorded the exception itself
+        goto done;
+    if (!PyTuple_Check(r) || PyTuple_GET_SIZE(r) != 2) {
+        PyErr_SetString(PyExc_TypeError,
+                        "host dispatcher must return (result, ticks)");
+        goto done;
+    }
+    {
+        uint64_t rv =
+            PyLong_AsUnsignedLongLongMask(PyTuple_GET_ITEM(r, 0));
+        long long ticks = PyLong_AsLongLong(PyTuple_GET_ITEM(r, 1));
+        if (PyErr_Occurred())
+            goto done;
+        *result = (int64_t)rv;
+        *ticks_left = (int64_t)ticks;
+        rc = 0;
+    }
+done:
+    Py_XDECREF(r);
+    PyGILState_Release(gil);
+    return rc;
+}
+
+int32_t ext_mem_cb(void* vctx, int64_t n_bytes) {
+    ExtCtx* ctx = static_cast<ExtCtx*>(vctx);
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* r = PyObject_CallFunction(ctx->mem_dispatch, "L",
+                                        (long long)n_bytes);
+    int32_t rc = (!r || r == Py_None) ? 1 : 0;
+    Py_XDECREF(r);
+    PyGILState_Release(gil);
+    return rc;
+}
+
+PyObject* ext_run(PyObject*, PyObject* pyargs) {
+    unsigned long long prog_addr, out_addr;
+    int func_idx;
+    PyObject* arglist;
+    long long ticks;
+    PyObject* hd;
+    PyObject* md;
+    if (!PyArg_ParseTuple(pyargs, "KiOLOOK", &prog_addr, &func_idx,
+                          &arglist, &ticks, &hd, &md, &out_addr))
+        return NULL;
+    Py_ssize_t n = PySequence_Size(arglist);
+    if (n < 0)
+        return NULL;
+    std::vector<int64_t> a((size_t)(n > 0 ? n : 1), 0);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* it = PySequence_GetItem(arglist, i);
+        if (!it)
+            return NULL;
+        a[(size_t)i] = (int64_t)PyLong_AsUnsignedLongLongMask(it);
+        Py_DECREF(it);
+        if (PyErr_Occurred())
+            return NULL;
+    }
+    ExtCtx ctx{hd, md};
+    RunResult* out = (RunResult*)(uintptr_t)out_addr;
+    // run without the GIL (parity with ctypes, which releases it for
+    // native calls); the callbacks re-acquire it per crossing
+    Py_BEGIN_ALLOW_THREADS
+    wasm_run((const ProgramDesc*)(uintptr_t)prog_addr, func_idx,
+             a.data(), (int32_t)n, ext_host_cb, ext_mem_cb, &ctx,
+             ticks, out);
+    Py_END_ALLOW_THREADS
+    // ST_HOST with a live Python exception: propagate it (the bridge
+    // reads *out first, settles, then re-raises its recorded one)
+    if (PyErr_Occurred())
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"run", ext_run, METH_VARARGS,
+     "run(prog_addr, func_idx, args, ticks, host_dispatch, "
+     "mem_dispatch, out_addr)"},
+    {NULL, NULL, 0, NULL},
+};
+
+PyModuleDef moddef = {
+    PyModuleDef_HEAD_INIT, "wasm_ext",
+    "CPython trampoline for the native wasm engine", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_wasm_ext(void) {
+    return PyModule_Create(&moddef);
+}

@@ -29,10 +29,40 @@ _HERE = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_HERE, "native", "wasm_exec.cpp")
 _LIB = os.path.join(_HERE, "build", "libwasmexec.so")
+_EXT_SRC = os.path.join(_HERE, "native", "wasm_ext.cpp")
+
+
+def _ext_lib_path() -> str:
+    # ABI-tagged: the extension links against a specific CPython's
+    # internals (unlike libwasmexec.so, which is Python-free), so a
+    # stale .so from another interpreter version must never be loaded
+    import sys
+    return os.path.join(_HERE, "build",
+                        f"wasm_ext.{sys.implementation.cache_tag}.so")
+
 
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_ext = None
+_ext_tried = False
+
+
+def _build_lib(srcs, out_path: str, extra_flags=(), timeout: int = 180):
+    """Compile-if-stale with an atomic publish: concurrent processes
+    must never dlopen a half-written library (the consensus path runs
+    through these)."""
+    src_mtime = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(out_path) and \
+            os.path.getmtime(out_path) >= src_mtime:
+        return
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    tmp = out_path + f".tmp.{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", *extra_flags,
+         "-o", tmp, srcs[0]],
+        check=True, capture_output=True, timeout=timeout)
+    os.replace(tmp, out_path)
 
 ST_OK, ST_TRAP, ST_BUDGET, ST_HOST = 0, 1, 2, 3
 
@@ -106,17 +136,7 @@ def _load():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB) or \
-                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-                os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-                # atomic: concurrent processes must never dlopen a
-                # half-written library (the consensus path runs here)
-                tmp = _LIB + f".tmp.{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC",
-                     "-o", tmp, _SRC],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _LIB)
+            _build_lib([_SRC], _LIB, timeout=120)
             lib = ctypes.CDLL(_LIB)
             lib.wasm_run.argtypes = [
                 ctypes.POINTER(_ProgramDesc), ctypes.c_int32, _i64p,
@@ -127,6 +147,35 @@ def _load():
         except Exception:
             _lib = None
         return _lib
+
+
+def _load_ext():
+    """The CPython-extension trampoline (native/wasm_ext.cpp): same
+    engine, ~5x cheaper host-call crossings than CFUNCTYPE. Falls back
+    to the ctypes path when the toolchain can't build extensions."""
+    global _ext, _ext_tried
+    if _ext_tried:
+        return _ext
+    with _lock:
+        if _ext_tried:
+            return _ext
+        _ext_tried = True
+        try:
+            import importlib.util
+            import sysconfig
+            lib_path = _ext_lib_path()
+            inc = sysconfig.get_paths()["include"]
+            _build_lib([_EXT_SRC, _SRC], lib_path,
+                       extra_flags=[f"-I{inc}",
+                                    f"-I{os.path.dirname(_SRC)}"])
+            spec = importlib.util.spec_from_file_location(
+                "wasm_ext", lib_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _ext = mod
+        except Exception:
+            _ext = None
+        return _ext
 
 
 def available() -> bool:
@@ -266,8 +315,10 @@ class _MemShim:
         self.size = 0
 
     def _base(self) -> Optional[int]:
-        return ctypes.cast(self.ptr, ctypes.c_void_p).value \
-            if self.ptr else None
+        p = self.ptr
+        if isinstance(p, int):  # extension path passes a raw address
+            return p or None
+        return ctypes.cast(p, ctypes.c_void_p).value if p else None
 
     def mem_read(self, ptr: int, n: int) -> bytes:
         if ptr < 0 or n < 0 or ptr + n > self.size:
@@ -335,12 +386,21 @@ class _RunCtx:
 _tls = threading.local()
 
 
+def _thread_stack():
+    """Per-thread context stack shared by BOTH dispatch paths; kept
+    separate so the extension path never pays CFUNCTYPE construction."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
 def _thread_cbs():
     """(ctx_stack, host_cb, mem_cb) — one persistent callback pair per
     thread; ``ctx_stack[-1]`` is the active invocation's context."""
     cbs = getattr(_tls, "cbs", None)
     if cbs is None:
-        stack = []
+        stack = _thread_stack()
 
         def host_cb(_c, import_idx, args_p, nargs, result_p,
                     ticks_left_p, charged_so_far, mem_p, mem_len):
@@ -373,6 +433,44 @@ def _thread_cbs():
         cbs = (stack, _HOST_CB(host_cb), _MEM_CB(mem_cb))
         _tls.cbs = cbs
     return cbs
+
+
+def _thread_dispatchers():
+    """(ctx_stack, host_dispatch, mem_dispatch) for the extension
+    trampoline — shares the ctx stack with the ctypes path. The
+    dispatchers record exceptions in the active context and return
+    None, mirroring the CFUNCTYPE path's exc_box control flow."""
+    d = getattr(_tls, "disp", None)
+    if d is None:
+        stack = _thread_stack()
+
+        def host_dispatch(import_idx, args_tup, charged,
+                          mem_addr, mem_len):
+            ctx = stack[-1]
+            try:
+                ctx.settle(charged, HOST_CALL_COST * ctx.cpu_per_insn)
+                shim = ctx.shim
+                shim.ptr = mem_addr
+                shim.size = mem_len
+                rv = ctx.host_fns[import_idx](shim, *args_tup)
+                return ((rv if rv is not None else 0) & _M64,
+                        ctx.remaining_ticks())
+            except BaseException as e:
+                ctx.exc_box.append(e)
+                return None
+
+        def mem_dispatch(n_bytes):
+            ctx = stack[-1]
+            try:
+                ctx.budget.charge(0, n_bytes)
+                return True
+            except BaseException as e:
+                ctx.exc_box.append(e)
+                return None
+
+        d = (stack, host_dispatch, mem_dispatch)
+        _tls.disp = d
+    return d
 
 
 def run_export(module: WasmModule, imports: Dict, budget,
@@ -425,21 +523,42 @@ def run_export(module: WasmModule, imports: Dict, budget,
         if cache_imports:
             module._host_fns_cache = (imports, host_fns)
 
-    stack, hcb, mcb = _thread_cbs()
     ctx = _RunCtx(host_fns, budget, cpu_per_insn)
     exc_box = ctx.exc_box
-
     out = _RunResult()
-    stack.append(ctx)
-    try:
-        rc = lib.wasm_run(
-            ctypes.byref(desc), func_idx,
-            (ctypes.c_int64 * max(1, len(args)))(
-                *[_s64(a & _M64) for a in args] or [0]),
-            len(args), hcb, mcb, None,
-            ctx.remaining_ticks(), ctypes.byref(out))
-    finally:
-        stack.pop()
+    ext = _load_ext()
+    if ext is not None:
+        stack, hd, md = _thread_dispatchers()
+        stack.append(ctx)
+        try:
+            try:
+                ext.run(ctypes.addressof(desc), func_idx,
+                        [a & _M64 for a in args],
+                        ctx.remaining_ticks(), hd, md,
+                        ctypes.addressof(out))
+            except BaseException as e:
+                # trampoline-internal failure: out is filled — settle
+                # like the normal path, then surface the recorded
+                # host exception if one exists
+                ctx.settle(out.charged)
+                if exc_box:
+                    raise exc_box[0] from None
+                raise e
+        finally:
+            stack.pop()
+        rc = out.status
+    else:
+        stack, hcb, mcb = _thread_cbs()
+        stack.append(ctx)
+        try:
+            rc = lib.wasm_run(
+                ctypes.byref(desc), func_idx,
+                (ctypes.c_int64 * max(1, len(args)))(
+                    *[_s64(a & _M64) for a in args] or [0]),
+                len(args), hcb, mcb, None,
+                ctx.remaining_ticks(), ctypes.byref(out))
+        finally:
+            stack.pop()
 
     # settle the remaining wasm-op charges; a budget-trapped run's
     # failing chunk raises here, mirroring the Python engine's chunk
