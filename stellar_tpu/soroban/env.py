@@ -193,7 +193,17 @@ def sym_to_small(s: bytes) -> int:
     return _make(TAG_SYMBOL_SMALL, body)
 
 
+from stellar_tpu.utils.cache import RandomEvictionCache
+
+_SYM_DECODE_CACHE: RandomEvictionCache = RandomEvictionCache(16384)
+
+
 def small_to_sym(val: int) -> bytes:
+    # memoized: small symbols are frame-independent (the value IS the
+    # encoding) and repeat heavily (storage keys, function names)
+    cached = _SYM_DECODE_CACHE.maybe_get(val)
+    if cached is not None:
+        return cached
     body = _body(val)
     chars = []
     while body:
@@ -204,7 +214,9 @@ def small_to_sym(val: int) -> bytes:
             raise EnvError("malformed SymbolSmall encoding")
         chars.append(ch)
         body >>= 6
-    return "".join(reversed(chars)).encode()
+    out = "".join(reversed(chars)).encode()
+    _SYM_DECODE_CACHE.put(val, out)
+    return out
 
 
 class ValConverter:
@@ -454,6 +466,9 @@ class ValConverter:
 # ---------------------------------------------------------------------------
 
 _DUR_BY_CODE = {0: "temporary", 1: "persistent", 2: "instance"}
+# (contract id, small key val, storage code) -> (SCVal, dur, kb);
+# see _storage_args for the safety argument
+_STORAGE_ARGS_CACHE: RandomEvictionCache = RandomEvictionCache(8192)
 
 
 def make_imports(env) -> Dict[Tuple[str, str], Callable]:
@@ -517,11 +532,27 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def _storage_args(k_val, t_val):
         """(key_scval, durability|None, kb|None) — durability None
-        means instance storage; key is converted exactly once."""
+        means instance storage; key is converted exactly once.
+
+        Small-tag keys (tag < 64: the value IS the encoding, no
+        object-table indirection) are memoized per contract id:
+        storage keys like a counter's symbol repeat every tx, and for
+        small tags the conversion path is charge-free, so a cache hit
+        is metering-identical to a rebuild. The cached SCVal/LedgerKey
+        are shared — storage treats keys as immutable."""
         code = _u32_arg(t_val, "storage type")
         kind = _DUR_BY_CODE.get(code)
         if kind is None:
             raise EnvError("bad storage type")
+        cacheable = (_tag(k_val) < 64 and kind != "instance" and
+                     isinstance(env.contract_addr.value, bytes))
+        if cacheable:
+            ckey = (env.contract_addr.value, k_val, code)
+            hit = _STORAGE_ARGS_CACHE.maybe_get(ckey)
+            if hit is not None:
+                return hit
+        # single derivation path — first call and cache hit MUST stay
+        # behavior-identical (metering parity)
         key_sc = cv.to_scval(k_val)
         if kind == "instance":
             return key_sc, None, None
@@ -529,7 +560,10 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             else _Durability.TEMPORARY
         kb = _key_bytes(_contract_data_key(env.contract_addr, key_sc,
                                            dur))
-        return key_sc, dur, kb
+        out = (key_sc, dur, kb)
+        if cacheable:
+            _STORAGE_ARGS_CACHE.put(ckey, out)
+        return out
 
     def put_contract_data(inst, k_val, v_val, t_val):
         key_sc, dur, _kb = _storage_args(k_val, t_val)

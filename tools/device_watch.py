@@ -140,12 +140,17 @@ def capture_window():
 def main():
     log("device watcher started")
     while True:
-        alive = run_probe()
-        if alive:
-            log("device ALIVE - capturing window")
-            ok = capture_window()
-            time.sleep(PROBE_PERIOD_ALIVE_S if ok else PROBE_PERIOD_DEAD_S)
-        else:
+        try:
+            alive = run_probe()
+            if alive:
+                log("device ALIVE - capturing window")
+                ok = capture_window()
+                time.sleep(PROBE_PERIOD_ALIVE_S if ok
+                           else PROBE_PERIOD_DEAD_S)
+            else:
+                time.sleep(PROBE_PERIOD_DEAD_S)
+        except Exception as e:  # never die silently mid-round
+            log(f"watcher iteration failed: {e!r}")
             time.sleep(PROBE_PERIOD_DEAD_S)
 
 
