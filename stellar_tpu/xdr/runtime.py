@@ -428,6 +428,22 @@ class Struct(metaclass=_StructMeta):
 
     @classmethod
     def unpack(cls, u: Unpacker) -> "Struct":
+        fast = cls.__dict__.get("_tree_unpack_fn")
+        if fast is None:
+            fast = tree_unpacker(cls)
+        try:
+            v, pos = fast(u.data, u.pos)
+        except XdrError:
+            raise
+        except Exception:
+            # canonical error (e.g. 'unexpected end of XDR data') via
+            # the generic field loop from the same offset
+            return cls._unpack_generic(u)
+        u.pos = pos
+        return v
+
+    @classmethod
+    def _unpack_generic(cls, u: Unpacker) -> "Struct":
         fast = cls.__dict__.get("_fast_unpack")
         if fast is None:
             cls._compile_codecs()
@@ -491,6 +507,7 @@ class Union:
         self.arms = arms
         self.default = default
         self._tree_fn = None
+        self._tree_unpack_fn = None
 
     def make(self, arm, value=None) -> "Union.Value":
         return Union.Value(arm, value)
@@ -523,6 +540,19 @@ class Union:
                            "generic pack succeeded")
 
     def unpack(self, u):
+        fn = self._tree_unpack_fn
+        if fn is None:
+            fn = self._tree_unpack_fn = tree_unpacker(self)
+        try:
+            v, pos = fn(u.data, u.pos)
+        except XdrError:
+            raise
+        except Exception:
+            return self._unpack_generic(u)
+        u.pos = pos
+        return v
+
+    def _unpack_generic(self, u):
         arm = self.disc.unpack(u)
         t = self._armtype(arm)
         return Union.Value(arm, t.unpack(u))
@@ -733,71 +763,67 @@ def _compile_tree(t):
     return ns["_tp"]
 
 
-def tree_packer(t):
-    """Memoized tree-pack function for ``t`` (cycle-safe: a forwarder
-    is registered before compilation, so recursive types like SCVal
-    close their cycle through one extra indirection)."""
-    # fast path: previously-seen object (original OR resolved id)
-    fn = _tree_registry.get(id(t))
+def _memoized_tree_fn(t, attr, registry, compiler, fail_msg):
+    """Shared memoization scaffold for the tree pack/unpack compilers.
+
+    Cycle-safe and concurrency-safe: a forwarder is registered in the
+    ``registry`` BEFORE compilation (compile-time recursion closes
+    cycles through it), while the Struct class attribute ``attr`` is
+    published only once the real function exists, so a concurrent
+    Struct.pack/unpack that misses the attr lands on the forwarder and
+    blocks on the lock instead of calling through an un-filled cell."""
+    fn = registry.get(id(t))
     if fn is not None:
         return fn
     orig = t
     t = _resolve_lazy(t)
-    if isinstance(t, type) and issubclass(t, Struct):
-        fn = t.__dict__.get("_tree_pack_fn")
-        if fn is not None:
-            if orig is not t:
-                _tree_registry[id(orig)] = fn
-                _tree_keepalive.append(orig)
-            return fn
-    else:
-        fn = _tree_registry.get(id(t))
-        if fn is not None:
-            if orig is not t:
-                _tree_registry[id(orig)] = fn
-                _tree_keepalive.append(orig)
-            return fn
+    is_struct = isinstance(t, type) and issubclass(t, Struct)
+    fn = t.__dict__.get(attr) if is_struct else registry.get(id(t))
+    if fn is not None:
+        if orig is not t:
+            registry[id(orig)] = fn
+            _tree_keepalive.append(orig)
+        return fn
     with _tree_lock:
         # re-check under the lock
-        if isinstance(t, type) and issubclass(t, Struct):
-            fn = t.__dict__.get("_tree_pack_fn")
-        else:
-            fn = _tree_registry.get(id(t))
+        fn = t.__dict__.get(attr) if is_struct else registry.get(id(t))
         if fn is not None:
             return fn
         cell = [None]
 
-        def forward(buf, v, _cell=cell):
-            fn = _cell[0]
-            if fn is None:
+        def forward(*args, _cell=cell):
+            f = _cell[0]
+            if f is None:
                 # a concurrent thread sees the forwarder mid-compile:
                 # wait for the compiling thread to release the lock
                 with _tree_lock:
-                    fn = _cell[0]
-                if fn is None:
-                    raise XdrError("tree pack compilation failed")
-            fn(buf, v)
+                    f = _cell[0]
+                if f is None:
+                    raise XdrError(fail_msg)
+            return f(*args)
 
-        # the forwarder lives ONLY in the registry (the class attr is
-        # published after compilation finishes): compile-time recursion
-        # closes cycles through it, while concurrent Struct.pack
-        # callers miss the class attr, land here, and block on the
-        # lock instead of calling through an un-filled cell
-        _tree_registry[id(t)] = forward
+        registry[id(t)] = forward
         _tree_keepalive.append(t)
         try:
-            real = _compile_tree(t)
+            real = compiler(t)
         except BaseException:
-            del _tree_registry[id(t)]
+            del registry[id(t)]
             raise
         cell[0] = real
-        if isinstance(t, type) and issubclass(t, Struct):
-            t._tree_pack_fn = real
-        _tree_registry[id(t)] = real
+        if is_struct:
+            setattr(t, attr, real)
+        registry[id(t)] = real
         if orig is not t:
-            _tree_registry[id(orig)] = real
+            registry[id(orig)] = real
             _tree_keepalive.append(orig)
         return real
+
+
+def tree_packer(t):
+    """Memoized tree-pack function for ``t``."""
+    return _memoized_tree_fn(t, "_tree_pack_fn", _tree_registry,
+                             _compile_tree,
+                             "tree pack compilation failed")
 
 
 def to_bytes(t, v) -> bytes:
@@ -818,8 +844,232 @@ def to_bytes(t, v) -> bytes:
     return bytes(buf)
 
 
-def from_bytes(t, data: bytes):
+# ---------------------------------------------------------------------------
+# Inline tree-unpack compiler (mirror of the tree packer)
+# ---------------------------------------------------------------------------
+# Generated per-type functions take (data, pos) and return (value,
+# pos'), with primitives inlined as prebound struct.Struct.unpack_from
+# calls, explicit bounds/padding checks matching the generic
+# Unpacker's, and struct instances built field-by-field via __new__.
+# Rare failures (short buffer raising struct.error, bad enum) fall
+# back to the generic unpacker from the SAME offset for the canonical
+# field-precise XdrError.
+
+_UU32 = struct.Struct(">I").unpack_from
+_UI32 = struct.Struct(">i").unpack_from
+_UU64 = struct.Struct(">Q").unpack_from
+_UI64 = struct.Struct(">q").unpack_from
+
+_untree_registry: Dict[int, Any] = {}
+
+
+def _emit_unode(t, lines, ns, ctr, indent, dest):
+    """Append source lines that read ``dest`` from data/pos."""
+    pre = "    " * indent
+    t = _resolve_lazy(t)
+    if t is Uint32:
+        lines.append(f"{pre}{dest} = _UU32(data, pos)[0]; pos += 4")
+        return
+    if t is Int32:
+        lines.append(f"{pre}{dest} = _UI32(data, pos)[0]; pos += 4")
+        return
+    if t is Uint64:
+        lines.append(f"{pre}{dest} = _UU64(data, pos)[0]; pos += 8")
+        return
+    if t is Int64:
+        lines.append(f"{pre}{dest} = _UI64(data, pos)[0]; pos += 8")
+        return
+    if isinstance(t, _Bool):
+        k = next(ctr)
+        lines.append(f"{pre}b{k} = _UU32(data, pos)[0]; pos += 4")
+        lines.append(f"{pre}if b{k} > 1:")
+        lines.append(f"{pre}    raise XdrError('bad bool ' + str(b{k}))")
+        lines.append(f"{pre}{dest} = b{k} == 1")
+        return
+    if isinstance(t, _Void):
+        lines.append(f"{pre}{dest} = None")
+        return
+    if isinstance(t, Opaque):
+        n = t.n
+        total = n + (4 - n % 4 if n % 4 else 0)
+        lines.append(f"{pre}if pos + {total} > len(data):")
+        lines.append(f"{pre}    raise XdrError("
+                     "'unexpected end of XDR data')")
+        lines.append(f"{pre}{dest} = data[pos:pos + {n}]")
+        if n % 4:
+            pad_lit = repr(b"\x00" * (4 - n % 4))
+            lines.append(f"{pre}if data[pos + {n}:pos + {total}] != "
+                         f"{pad_lit}:")
+            lines.append(f"{pre}    raise XdrError("
+                         "'non-zero XDR padding')")
+        lines.append(f"{pre}pos += {total}")
+        return
+    if isinstance(t, (VarOpaque, XdrString)):
+        k = next(ctr)
+        lines.append(f"{pre}n{k} = _UU32(data, pos)[0]; pos += 4")
+        lines.append(f"{pre}if n{k} > {t.maxlen}:")
+        lines.append(f"{pre}    raise XdrError('opaque too long: ' +"
+                     f" str(n{k}) + ' > {t.maxlen}')")
+        lines.append(f"{pre}e{k} = pos + n{k} + (-n{k} & 3)")
+        lines.append(f"{pre}if e{k} > len(data):")
+        lines.append(f"{pre}    raise XdrError("
+                     "'unexpected end of XDR data')")
+        lines.append(f"{pre}{dest} = data[pos:pos + n{k}]")
+        lines.append(f"{pre}if n{k} & 3 and "
+                     f"data[pos + n{k}:e{k}].strip(b'\\x00'):")
+        lines.append(f"{pre}    raise XdrError("
+                     "'non-zero XDR padding')")
+        lines.append(f"{pre}pos = e{k}")
+        return
+    if isinstance(t, Enum):
+        k = next(ctr)
+        ns[f"_es{k}"] = frozenset(t.by_value)
+        lines.append(f"{pre}{dest} = _UI32(data, pos)[0]; pos += 4")
+        lines.append(f"{pre}if {dest} not in _es{k}:")
+        lines.append(f"{pre}    raise XdrError('bad {t.name} value '"
+                     f" + str({dest}))")
+        return
+    if isinstance(t, FixedArray):
+        k = next(ctr)
+        lines.append(f"{pre}{dest} = []")
+        lines.append(f"{pre}for _i{k} in range({t.n}):")
+        _emit_unode(t.elem, lines, ns, ctr, indent + 1, f"x{k}")
+        lines.append(f"{pre}    {dest}.append(x{k})")
+        return
+    if isinstance(t, VarArray):
+        k = next(ctr)
+        lines.append(f"{pre}n{k} = _UU32(data, pos)[0]; pos += 4")
+        lines.append(f"{pre}if n{k} > {t.maxlen}:")
+        lines.append(f"{pre}    raise XdrError('array too long: ' +"
+                     f" str(n{k}) + ' > {t.maxlen}')")
+        lines.append(f"{pre}{dest} = []")
+        lines.append(f"{pre}for _i{k} in range(n{k}):")
+        _emit_unode(t.elem, lines, ns, ctr, indent + 1, f"x{k}")
+        lines.append(f"{pre}    {dest}.append(x{k})")
+        return
+    if isinstance(t, Option):
+        k = next(ctr)
+        lines.append(f"{pre}f{k} = _UU32(data, pos)[0]; pos += 4")
+        lines.append(f"{pre}if f{k} == 0:")
+        lines.append(f"{pre}    {dest} = None")
+        lines.append(f"{pre}elif f{k} == 1:")
+        _emit_unode(t.elem, lines, ns, ctr, indent + 1, dest)
+        lines.append(f"{pre}else:")
+        lines.append(f"{pre}    raise XdrError('bad optional flag '"
+                     f" + str(f{k}))")
+        return
+    if (isinstance(t, type) and issubclass(t, Struct)) or \
+            isinstance(t, Union):
+        k = next(ctr)
+        ns[f"_g{k}"] = tree_unpacker(t)
+        lines.append(f"{pre}{dest}, pos = _g{k}(data, pos)")
+        return
+    # unknown custom type: generic unpack resumed at this offset
+    k = next(ctr)
+    ns[f"_t{k}"] = t
+    ns["_Unpacker"] = Unpacker
+    lines.append(f"{pre}u{k} = _Unpacker(data)")
+    lines.append(f"{pre}u{k}.pos = pos")
+    lines.append(f"{pre}{dest} = _t{k}.unpack(u{k})")
+    lines.append(f"{pre}pos = u{k}.pos")
+
+
+def _compile_untree(t):
+    import itertools
+    ctr = itertools.count()
+    ns = {"_UU32": _UU32, "_UI32": _UI32, "_UU64": _UU64,
+          "_UI64": _UI64, "XdrError": XdrError}
+    lines: List[str] = []
+    if isinstance(t, type) and issubclass(t, Struct):
+        ns["_cls"] = t
+        for n, ft in zip(t._names, t._types):
+            _emit_unode(ft, lines, ns, ctr, 1, f"_fv_{n}")
+        body = "\n".join(lines) or "    pass"
+        assigns = "\n".join(f"    out.{n} = _fv_{n}"
+                            for n in t._names) or "    pass"
+        src = (f"def _tu(data, pos):\n{body}\n"
+               f"    out = _cls.__new__(_cls)\n{assigns}\n"
+               "    return out, pos\n")
+        exec(src, ns)  # noqa: S102 - generated from declarative FIELDS
+        return ns["_tu"]
+    if isinstance(t, Union):
+        arms = {}
+        for arm, at in t.arms.items():
+            arms[arm] = tree_unpacker(_resolve_lazy(at))
+        default = None
+        if t.default is not None:
+            default = tree_unpacker(_resolve_lazy(t.default))
+        ns["_arms_get"] = arms.get
+        ns["_dflt"] = default
+        ns["_name"] = t.name
+        ns["_UV"] = Union.Value
+        disc = _resolve_lazy(t.disc)
+        if isinstance(disc, Enum):
+            ns["_es"] = frozenset(disc.by_value)
+            # canonical message parity: the generic path raises with
+            # the ENUM's name (Enum.unpack), not the union's
+            ns["_ename"] = disc.name
+            disc_src = (
+                "    arm = _UI32(data, pos)[0]; pos += 4\n"
+                "    if arm not in _es:\n"
+                "        raise XdrError('bad %s value %s'"
+                " % (_ename, arm))\n")
+        elif disc is Int32:
+            disc_src = "    arm = _UI32(data, pos)[0]; pos += 4\n"
+        elif disc is Uint32:
+            disc_src = "    arm = _UU32(data, pos)[0]; pos += 4\n"
+        else:
+            ns["_disc"] = disc
+            ns["_Unpacker"] = Unpacker
+            disc_src = ("    u0 = _Unpacker(data)\n    u0.pos = pos\n"
+                        "    arm = _disc.unpack(u0)\n    pos = u0.pos\n")
+        src = (
+            "def _tu(data, pos):\n"
+            f"{disc_src}"
+            "    f = _arms_get(arm, _dflt)\n"
+            "    if f is None:\n"
+            "        raise XdrError('%s: bad union arm %r'"
+            " % (_name, arm))\n"
+            "    v, pos = f(data, pos)\n"
+            "    return _UV(arm, v), pos\n")
+        exec(src, ns)  # noqa: S102
+        return ns["_tu"]
+    # non-composite root
+    lines = []
+    _emit_unode(t, lines, ns, ctr, 1, "v")
+    src = ("def _tu(data, pos):\n" + "\n".join(lines) +
+           "\n    return v, pos\n")
+    exec(src, ns)  # noqa: S102
+    return ns["_tu"]
+
+
+def tree_unpacker(t):
+    """Memoized tree-unpack function for ``t``."""
+    return _memoized_tree_fn(t, "_tree_unpack_fn", _untree_registry,
+                             _compile_untree,
+                             "tree unpack compilation failed")
+
+
+def _from_bytes_generic(t, data: bytes):
     u = Unpacker(data)
     out = t.unpack(u)
     u.done()
     return out
+
+
+def from_bytes(t, data: bytes):
+    fn = tree_unpacker(t)
+    try:
+        v, pos = fn(data, 0)
+    except XdrError:
+        raise
+    except Exception as e:
+        # short buffer (struct.error) etc: canonical error via the
+        # generic path, which re-reads from the start
+        out = _from_bytes_generic(t, data)
+        raise XdrError(
+            f"tree unpack failed but generic unpack succeeded: {e!r}"
+        ) from e
+    if pos != len(data):
+        raise XdrError(f"{len(data) - pos} trailing bytes")
+    return v
