@@ -77,6 +77,9 @@ class SCPEnvelope(Struct):
 class _QuorumSetLazy:
     """Recursive innerSets."""
 
+    def _real(self):
+        return SCPQuorumSet
+
     def pack(self, p, v):
         SCPQuorumSet.pack(p, v)
 
