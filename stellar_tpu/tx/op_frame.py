@@ -39,7 +39,25 @@ class ThresholdLevel:
     HIGH = THRESHOLD_HIGH
 
 
+from stellar_tpu.utils.cache import RandomEvictionCache
+
+_ACCOUNT_KEY_CACHE: RandomEvictionCache = RandomEvictionCache(65536)
+
+
 def account_key(account_id) -> "LedgerKey.Value":
+    """Memoized by the 32-byte account id: the apply loop resolves the
+    same hot accounts' keys thousands of times per close, and the
+    LedgerKey (+ its cached encoding, see ledger_txn.key_bytes) is
+    immutable once built. Random eviction (not FIFO) so a churning
+    account stream cannot deterministically flush the hot set."""
+    aid = account_id.value
+    if type(aid) is bytes:
+        k = _ACCOUNT_KEY_CACHE.maybe_get(aid)
+        if k is None:
+            k = LedgerKey.make(LedgerEntryType.ACCOUNT,
+                               LedgerKeyAccount(accountID=account_id))
+            _ACCOUNT_KEY_CACHE.put(aid, k)
+        return k
     return LedgerKey.make(LedgerEntryType.ACCOUNT,
                           LedgerKeyAccount(accountID=account_id))
 
