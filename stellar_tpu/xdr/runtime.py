@@ -378,24 +378,16 @@ class Struct(metaclass=_StructMeta):
         unpack_body = "\n".join(
             f"    out.{n} = _types[{i}].unpack(u)"
             for i, n in enumerate(cls._names)) or "    pass"
-        copy_body = "\n".join(
-            (f"    out.{n} = v.{n}"
-             if getattr(cls._types[i], "IMMUTABLE", False)
-             else f"    out.{n} = _types[{i}].copy(v.{n})")
-            for i, n in enumerate(cls._names)) or "    pass"
+        # (copy is served exclusively by the compiled tree copier)
         src = (f"def _fast_pack(p, v):\n{pack_body}\n"
                f"def _fast_unpack(u):\n"
                f"    out = _cls.__new__(_cls)\n{unpack_body}\n"
-               f"    return out\n"
-               f"def _fast_copy(v):\n"
-               f"    out = _cls.__new__(_cls)\n{copy_body}\n"
                f"    return out\n")
         exec(src, ns)  # noqa: S102 - trusted, generated from FIELDS
         # plain functions (not staticmethod wrappers): every lookup goes
         # through cls.__dict__, bypassing the descriptor protocol
         cls._fast_pack = ns["_fast_pack"]
         cls._fast_unpack = ns["_fast_unpack"]
-        cls._fast_copy = ns["_fast_copy"]
 
     @classmethod
     def pack(cls, p: Packer, v: "Struct"):
@@ -453,11 +445,11 @@ class Struct(metaclass=_StructMeta):
     @classmethod
     def copy(cls, v: "Struct") -> "Struct":
         """Deep copy without the wire roundtrip: compiled straight-line
-        field copies, identity for immutable leaves."""
-        fast = cls.__dict__.get("_fast_copy")
+        field copies with inlined arrays/options/unions, identity for
+        immutable leaves."""
+        fast = cls.__dict__.get("_tree_copy_fn")
         if fast is None:
-            cls._compile_codecs()
-            fast = cls.__dict__["_fast_copy"]
+            fast = tree_copier(cls)
         return fast(v)
 
     def __eq__(self, other):
@@ -508,6 +500,7 @@ class Union:
         self.default = default
         self._tree_fn = None
         self._tree_unpack_fn = None
+        self._tree_copy_fn = None
 
     def make(self, arm, value=None) -> "Union.Value":
         return Union.Value(arm, value)
@@ -558,6 +551,12 @@ class Union:
         return Union.Value(arm, t.unpack(u))
 
     def copy(self, v: "Union.Value") -> "Union.Value":
+        fn = self._tree_copy_fn
+        if fn is None:
+            fn = self._tree_copy_fn = tree_copier(self)
+        return fn(v)
+
+    def _copy_generic(self, v: "Union.Value") -> "Union.Value":
         t = self._armtype(v.arm)
         if getattr(t, "IMMUTABLE", False):
             return Union.Value(v.arm, v.value)
@@ -761,6 +760,100 @@ def _compile_tree(t):
     src = "def _tp(buf, v):\n" + "\n".join(lines) + "\n"
     exec(src, ns)  # noqa: S102
     return ns["_tp"]
+
+
+# ---------------------------------------------------------------------------
+# Inline tree-copy compiler (completes the codec triad)
+# ---------------------------------------------------------------------------
+# LedgerTxn load/commit semantics deep-copy entries constantly; the
+# generic path pays a method dispatch per composite node. Generated
+# copiers inline IMMUTABLE leaves as identity, arrays as list() or
+# comprehensions, options as conditional expressions, and unions as
+# arm->function dict dispatch.
+
+_untree_copy_registry: Dict[int, Any] = {}
+
+
+def _is_immutable(t) -> bool:
+    return bool(getattr(t, "IMMUTABLE", False))
+
+
+def _copy_expr(t, expr, ns, ctr):
+    """An EXPRESSION producing a deep copy of ``expr``."""
+    t = _resolve_lazy(t)
+    if _is_immutable(t) or isinstance(t, _Void):
+        return expr
+    if isinstance(t, Option):
+        if _is_immutable(_resolve_lazy(t.elem)):
+            return expr
+        tmp = f"_o{next(ctr)}"
+        sub = _copy_expr(t.elem, tmp, ns, ctr)
+        return f"(None if ({tmp} := {expr}) is None else {sub})"
+    if isinstance(t, (FixedArray, VarArray)):
+        if _is_immutable(_resolve_lazy(t.elem)):
+            return f"list({expr})"
+        tmp = f"_e{next(ctr)}"
+        sub = _copy_expr(t.elem, tmp, ns, ctr)
+        return f"[{sub} for {tmp} in {expr}]"
+    if (isinstance(t, type) and issubclass(t, Struct)) or \
+            isinstance(t, Union):
+        k = next(ctr)
+        ns[f"_c{k}"] = tree_copier(t)
+        return f"_c{k}({expr})"
+    k = next(ctr)  # unknown custom type: its own generic copy
+    ns[f"_t{k}"] = t
+    return f"_t{k}.copy({expr})"
+
+
+_MISSING_ARM = object()
+
+
+def _compile_copytree(t):
+    import itertools
+    ctr = itertools.count()
+    ns = {}
+    if isinstance(t, type) and issubclass(t, Struct):
+        ns["_cls"] = t
+        lines = [f"    out.{n} = "
+                 f"{_copy_expr(ft, f'v.{n}', ns, ctr)}"
+                 for n, ft in zip(t._names, t._types)]
+        src = ("def _tc(v):\n    out = _cls.__new__(_cls)\n" +
+               "\n".join(lines) + "\n    return out\n")
+        exec(src, ns)  # noqa: S102 - generated from declarative FIELDS
+        return ns["_tc"]
+    if isinstance(t, Union):
+        arms = {}
+        for arm, at in t.arms.items():
+            at = _resolve_lazy(at)
+            arms[arm] = None if (_is_immutable(at) or
+                                 isinstance(at, _Void)) \
+                else tree_copier(at)
+        ns["_arms_get"] = arms.get
+        ns["_MISSING"] = _MISSING_ARM
+        ns["_gen"] = t._copy_generic
+        ns["_UV"] = Union.Value
+        src = (
+            "def _tc(v):\n"
+            "    arm = v.arm\n"
+            "    f = _arms_get(arm, _MISSING)\n"
+            "    if f is None:\n"
+            "        return _UV(arm, v.value)\n"
+            "    if f is _MISSING:\n"
+            "        return _gen(v)\n"  # default arm / invalid
+            "    return _UV(arm, f(v.value))\n")
+        exec(src, ns)  # noqa: S102
+        return ns["_tc"]
+    expr = _copy_expr(t, "v", ns, ctr)
+    src = f"def _tc(v):\n    return {expr}\n"
+    exec(src, ns)  # noqa: S102
+    return ns["_tc"]
+
+
+def tree_copier(t):
+    """Memoized tree-copy function for ``t``."""
+    return _memoized_tree_fn(t, "_tree_copy_fn", _untree_copy_registry,
+                             _compile_copytree,
+                             "tree copy compilation failed")
 
 
 def _memoized_tree_fn(t, attr, registry, compiler, fail_msg):

@@ -166,6 +166,34 @@ def _collect_types():
     return out
 
 
+def _scramble(v, rng):
+    """Mutate every mutable node of a decoded value in place."""
+    if isinstance(v, Struct):
+        for n in v._names:
+            cur = getattr(v, n)
+            if isinstance(cur, int):
+                setattr(v, n, (cur + 1) & 0x7F)
+            elif isinstance(cur, bytes):
+                setattr(v, n, bytes(len(cur)))
+            else:
+                _scramble(cur, rng)
+    elif isinstance(v, Union.Value):
+        if isinstance(v.value, int):
+            v.value = (v.value + 1) & 0x7F
+        elif isinstance(v.value, bytes):
+            v.value = bytes(len(v.value))
+        else:
+            _scramble(v.value, rng)
+    elif isinstance(v, list):
+        for i, e in enumerate(v):
+            if isinstance(e, int):
+                v[i] = (e + 1) & 0x7F
+            elif isinstance(e, bytes):
+                v[i] = bytes(len(e))
+            else:
+                _scramble(e, rng)
+
+
 TYPES = _collect_types()
 
 
@@ -197,3 +225,11 @@ def test_tree_codec_matches_generic(name, t):
         u.done()
         assert to_bytes(rt, v3) == tree, \
             f"{name}: generic unpack diverged"
+        # the compiled tree copier must produce an encoding-identical
+        # DEEP copy: mutating every mutable node of the copy must not
+        # change the original's encoding
+        cp = rt.copy(v)
+        assert to_bytes(rt, cp) == tree, f"{name}: tree copy diverged"
+        _scramble(cp, rng)
+        assert to_bytes(rt, v) == tree, \
+            f"{name}: copy aliases the original"
