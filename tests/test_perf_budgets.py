@@ -105,12 +105,20 @@ def test_compute_bound_native_beats_scval():
 
 def test_soroban_close_latency_budget():
     """500-tx soroban ledgers must close well inside the 5s cadence —
-    order-of-magnitude guard at 3s mean on CI-class hosts (measured
-    ~1.05s; the on-device target is <500ms with the verify batch on
-    the TPU)."""
+    guard at 1.5s mean on CI-class hosts (measured ~0.55s after the
+    r4 codec/bridge work; ~3x headroom absorbs shared-host noise; the
+    on-device target is <500ms with the verify batch on the TPU)."""
     from stellar_tpu.simulation.load_generator import (
         soroban_apply_load,
     )
     r = soroban_apply_load(n_ledgers=2, txs_per_ledger=500,
                            use_wasm=True)
-    assert r["close_mean_ms"] <= 3000.0, r["close_mean_ms"]
+    assert r["close_mean_ms"] <= 1500.0, r["close_mean_ms"]
+
+
+def test_classic_close_latency_budget():
+    """100-tx classic ledgers: measured ~22ms mean after the r4
+    codec work; 8x headroom for CI-class hosts."""
+    from stellar_tpu.simulation.load_generator import apply_load
+    r = apply_load(n_ledgers=5, txs_per_ledger=100)
+    assert r["close_mean_ms"] <= 180.0, r["close_mean_ms"]
