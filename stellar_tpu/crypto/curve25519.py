@@ -29,8 +29,36 @@ def _clamp(k: bytes) -> int:
     return int.from_bytes(bytes(n), "little")
 
 
+try:  # OpenSSL X25519 (identical RFC 7748 clamping/semantics; the
+    # pure-Python ladder below stays as the differential oracle —
+    # test_crypto_host pins agreement incl. the small-order rejection)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey as _OsslX25519Priv,
+        X25519PublicKey as _OsslX25519Pub,
+    )
+except ImportError:  # pragma: no cover
+    _OsslX25519Priv = None
+
+
 def scalarmult(secret: bytes, point: bytes) -> bytes:
-    """RFC 7748 Montgomery ladder."""
+    """X25519(secret, point) with libsodium's small-order rejection."""
+    if len(secret) != 32 or len(point) != 32:
+        raise ValueError("X25519 takes 32-byte scalar and point")
+    if _OsslX25519Priv is not None:
+        sk = _OsslX25519Priv.from_private_bytes(secret)
+        pk = _OsslX25519Pub.from_public_bytes(point)
+        try:
+            return sk.exchange(pk)
+        except ValueError as e:
+            # OpenSSL rejects all-zero shared secrets like libsodium
+            raise ValueError(
+                "small-order X25519 point: all-zero shared secret"
+            ) from e
+    return _scalarmult_ladder(secret, point)
+
+
+def _scalarmult_ladder(secret: bytes, point: bytes) -> bytes:
+    """RFC 7748 Montgomery ladder (pure-Python oracle)."""
     k = _clamp(secret)
     u = int.from_bytes(point, "little") & ((1 << 255) - 1)
     x1 = u % P
@@ -89,17 +117,18 @@ def public_from_secret(secret: bytes) -> bytes:
 
 def hkdf_extract(ikm: bytes, salt: bytes = b"") -> bytes:
     """RFC 5869 extract (reference ``hkdfExtract``: zero salt)."""
-    return _hmac.new(salt if salt else b"\x00" * 32, ikm,
-                     hashlib.sha256).digest()
+    return _hmac.digest(salt if salt else b"\x00" * 32, ikm, "sha256")
 
 
 def hkdf_expand(prk: bytes, info: bytes) -> bytes:
     """Single-block expand (reference ``hkdfExpand``)."""
-    return _hmac.new(prk, info + b"\x01", hashlib.sha256).digest()
+    return _hmac.digest(prk, info + b"\x01", "sha256")
 
 
-def hmac_sha256(key: bytes, msg: bytes) -> bytes:
-    return _hmac.new(key, msg, hashlib.sha256).digest()
+# one shared implementation (crypto/sha.py) — it MACs every overlay
+# message twice (send + receive verify), so it rides hmac.digest()'s
+# one-shot C fast path there
+from stellar_tpu.crypto.sha import hmac_sha256  # noqa: E402,F401
 
 
 def verify_hmac_sha256(key: bytes, msg: bytes, mac: bytes) -> bool:

@@ -53,7 +53,9 @@ class SHA256:
 
 
 def hmac_sha256(key: bytes, data: bytes) -> bytes:
-    return _hmac.new(key, data, hashlib.sha256).digest()
+    # hmac.digest() rides CPython's one-shot C fast path (no HMAC
+    # object construction) — overlay channels MAC every message twice
+    return _hmac.digest(key, data, "sha256")
 
 
 def hmac_sha256_verify(mac: bytes, key: bytes, data: bytes) -> bool:

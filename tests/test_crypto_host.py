@@ -123,3 +123,38 @@ def test_cache_key_is_domain_separated():
     flush_verify_cache()
     assert verify_sig(sk.public_key, b"m1", sig)
     assert not verify_sig(sk.public_key, b"m2", sig)
+
+
+def test_x25519_openssl_matches_ladder():
+    """The OpenSSL X25519 fast path must agree with the pure-Python
+    RFC 7748 ladder (the differential oracle), including libsodium's
+    small-order all-zero-shared-secret rejection."""
+    import random
+    from stellar_tpu.crypto import curve25519 as c
+    rng = random.Random(0x25519)
+    for i in range(40):
+        s = rng.randbytes(32)
+        p = c.scalarmult_base(rng.randbytes(32))
+        assert c.scalarmult(s, p) == c._scalarmult_ladder(s, p), i
+    # the full input space peers can send: arbitrary 32-byte points
+    # (non-canonical u >= p, bit 255 set, off-curve/twist) — both
+    # paths must agree on result-or-rejection
+    for i in range(60):
+        s = rng.randbytes(32)
+        p = rng.randbytes(32)
+        try:
+            got = c.scalarmult(s, p)
+        except ValueError:
+            got = ValueError
+        try:
+            want = c._scalarmult_ladder(s, p)
+        except ValueError:
+            want = ValueError
+        assert got == want, (i, p.hex())
+    s = rng.randbytes(32)
+    assert c.scalarmult_base(s) == c._scalarmult_ladder(s, c.BASE_POINT)
+    import pytest
+    for bad in (bytes(32), (1).to_bytes(32, "little")):
+        for fn in (c.scalarmult, c._scalarmult_ladder):
+            with pytest.raises(ValueError):
+                fn(s, bad)
