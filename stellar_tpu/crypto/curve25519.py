@@ -9,7 +9,6 @@ the batch-crypto hot path.
 
 from __future__ import annotations
 
-import hashlib
 import hmac as _hmac
 import os
 
@@ -115,14 +114,12 @@ def public_from_secret(secret: bytes) -> bytes:
     return scalarmult_base(secret)
 
 
-def hkdf_extract(ikm: bytes, salt: bytes = b"") -> bytes:
-    """RFC 5869 extract (reference ``hkdfExtract``: zero salt)."""
-    return _hmac.digest(salt if salt else b"\x00" * 32, ikm, "sha256")
+# single KDF implementation lives in crypto/sha.py
 
 
-def hkdf_expand(prk: bytes, info: bytes) -> bytes:
-    """Single-block expand (reference ``hkdfExpand``)."""
-    return _hmac.digest(prk, info + b"\x01", "sha256")
+from stellar_tpu.crypto.sha import (  # noqa: E402,F401
+    hkdf_expand, hkdf_extract,
+)
 
 
 # one shared implementation (crypto/sha.py) — it MACs every overlay
