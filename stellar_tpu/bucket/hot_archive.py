@@ -50,6 +50,19 @@ def combined_bucket_list_hash(live_hash: bytes,
     return sha256(live_hash + hot_archive_hash)
 
 
+def header_bucket_list_hash(live_hash: bytes, hot_archive,
+                            ledger_version: int) -> bytes:
+    """What a header at ``ledger_version`` commits to, given the live
+    list hash and the node's hot archive (None = empty archive): the
+    ONE implementation of the protocol-gated combine used by close,
+    self-check, restore, and catchup alike."""
+    if ledger_version < STATE_ARCHIVAL_PROTOCOL_VERSION:
+        return live_hash
+    hot_hash = (hot_archive.hash() if hot_archive is not None
+                else HotArchiveBucketList().hash())
+    return combined_bucket_list_hash(live_hash, hot_hash)
+
+
 def _entry_key_bytes(e) -> bytes:
     if e.arm == HBET.HOT_ARCHIVE_LIVE:
         return to_bytes(LedgerKey, e.value)

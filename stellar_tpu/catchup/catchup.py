@@ -237,13 +237,11 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
                 setattr(hot.levels[i], attr, bucket)
 
     hdr = target_header_entry.header
-    if hdr.ledgerVersion >= STATE_ARCHIVAL_PROTOCOL_VERSION:
-        want = combined_bucket_list_hash(bl.hash(), hot.hash())
-        if want != hdr.bucketListHash:
-            raise ValueError("assembled live+hot bucket lists do not "
-                             "match the header commitment")
-    elif bl.hash() != hdr.bucketListHash:
-        raise ValueError("assembled bucket list does not match header")
+    from stellar_tpu.bucket.hot_archive import header_bucket_list_hash
+    if header_bucket_list_hash(bl.hash(), hot,
+                               hdr.ledgerVersion) != hdr.bucketListHash:
+        raise ValueError("assembled bucket list(s) do not match the "
+                         "header commitment")
 
     # replay buckets oldest -> newest into the committed store
     # (reference BucketApplicator order)

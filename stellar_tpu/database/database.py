@@ -74,7 +74,12 @@ class Database:
 
     def __init__(self, path: str = ":memory:", for_upgrade: bool = False):
         self.path = path
-        self.conn = sqlite3.connect(path)
+        # check_same_thread=False: construction-time writes happen on
+        # the constructing thread; all steady-state access is funneled
+        # through the single crank thread (admin routes via _on_main),
+        # so the single-writer discipline holds without sqlite's
+        # same-thread guard (reference: SOCI sessions cross threads)
+        self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=FULL")
         if not for_upgrade:
@@ -246,6 +251,16 @@ class PersistentState:
         else:
             self.db.conn.execute(sql, (key, value))
 
+    def list_cursors(self) -> dict:
+        """Registered downstream cursors (reference ExternalQueue):
+        id -> acknowledged ledger. The ONE owner of the 'cursor.'
+        namespace — setcursor/getcursor/dropcursor and the maintenance
+        GC floor all go through here."""
+        rows = self.db.conn.execute(
+            "SELECT statename, state FROM storestate "
+            "WHERE statename LIKE 'cursor.%'").fetchall()
+        return {name[len("cursor."):]: int(v) for name, v in rows}
+
 
 class NodePersistence:
     """The LedgerManager's durability hook: saves each close in crash
@@ -322,18 +337,12 @@ class NodePersistence:
                 "restored hot archive is unreadable "
                 f"({e}) — catch up from history instead")
         from stellar_tpu.bucket.hot_archive import (
-            STATE_ARCHIVAL_PROTOCOL_VERSION, combined_bucket_list_hash,
+            header_bucket_list_hash,
         )
-        want = bucket_list.hash()
-        if header.ledgerVersion >= STATE_ARCHIVAL_PROTOCOL_VERSION:
-            # p23+ headers commit to live+hot (empty archive hashes as
-            # a fresh list)
-            from stellar_tpu.bucket.hot_archive import (
-                HotArchiveBucketList,
-            )
-            hot_hash = (hot_archive.hash() if hot_archive is not None
-                        else HotArchiveBucketList().hash())
-            want = combined_bucket_list_hash(want, hot_hash)
+        # p23+ headers commit to live+hot (empty archive hashes as a
+        # fresh list); one shared protocol-gated combine
+        want = header_bucket_list_hash(bucket_list.hash(), hot_archive,
+                                       header.ledgerVersion)
         if want != header.bucketListHash:
             raise RuntimeError(
                 "restored bucket list does not match LCL header "

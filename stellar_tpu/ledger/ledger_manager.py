@@ -408,16 +408,17 @@ class LedgerManager:
                 self.root.store.rebase()
         else:
             header.bucketListHash = self.state_hasher(self.root.store)
-        if header.ledgerVersion >= STATE_ARCHIVAL_PROTOCOL_VERSION \
-                and self.hot_archive is not None:
-            # from the state-archival protocol the header commits to
-            # BOTH lists (the hot archive decides RestoreFootprint
-            # outcomes, so it must be consensus-proven)
+        # from the state-archival protocol the header commits to BOTH
+        # lists (the hot archive decides RestoreFootprint outcomes, so
+        # it must be consensus-proven); one shared implementation of
+        # the protocol-gated combine
+        if self.hot_archive is not None:
             from stellar_tpu.bucket.hot_archive import (
-                combined_bucket_list_hash,
+                header_bucket_list_hash,
             )
-            header.bucketListHash = combined_bucket_list_hash(
-                header.bucketListHash, self.hot_archive.hash())
+            header.bucketListHash = header_bucket_list_hash(
+                header.bucketListHash, self.hot_archive,
+                header.ledgerVersion)
         # kick next close's eviction enumeration off-crank against the
         # now-committed state (reference startBackgroundEvictionScan)
         self.eviction_scanner.prepare_async(self.root.store)

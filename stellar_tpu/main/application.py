@@ -431,13 +431,21 @@ class Application:
         self._self_check_timer = t
 
     def self_check(self) -> bool:
-        """Bucket-list integrity vs the header commitment."""
+        """Bucket-list integrity vs the header commitment (from the
+        state-archival protocol the header commits to the COMBINED
+        live+hot hash — recompute exactly what closeLedger wrote)."""
         import logging
         lm = self.lm
         if lm.bucket_list is None:
             return True
-        ok = lm.bucket_list.hash() == \
-            lm.last_closed_header.bucketListHash
+        from stellar_tpu.bucket.hot_archive import (
+            header_bucket_list_hash,
+        )
+        header = lm.last_closed_header
+        want = header_bucket_list_hash(lm.bucket_list.hash(),
+                                       lm.hot_archive,
+                                       header.ledgerVersion)
+        ok = want == header.bucketListHash
         if not ok:
             logging.getLogger("stellar_tpu.main").error(
                 "SELF-CHECK FAILED: bucket list hash does not match "

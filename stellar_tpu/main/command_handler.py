@@ -339,6 +339,100 @@ class CommandHandler:
             return Maintainer(self.app).perform_maintenance(count)
         return self._on_main(run)
 
+    # ---- downstream-consumer cursors (reference ExternalQueue:
+    # setcursor/dropcursor hold history GC back for external readers)
+
+    def _cursor_state(self):
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return None
+        from stellar_tpu.database.database import PersistentState
+        return PersistentState(db)
+
+    def cmd_setcursor(self, params):
+        if "id" not in params or "cursor" not in params:
+            return {"status": "ERROR",
+                    "detail": "need id and cursor params"}
+        cid = params["id"][0]
+        if not cid.isalnum() or len(cid) > 32:
+            return {"status": "ERROR",
+                    "detail": "cursor id must be alphanumeric, <=32"}
+        try:
+            cursor = int(params["cursor"][0])
+        except ValueError:
+            return {"status": "ERROR", "detail": "bad cursor"}
+        if cursor <= 0:
+            return {"status": "ERROR", "detail": "cursor must be > 0"}
+
+        def run():
+            ps = self._cursor_state()
+            if ps is None:
+                return {"status": "ERROR", "detail": "no database"}
+            ps.set(f"cursor.{cid}", str(cursor))
+            return {"cursor": cid, "value": cursor}
+        return self._on_main(run)
+
+    def cmd_getcursor(self, params):
+        def run():
+            ps = self._cursor_state()
+            if ps is None:
+                return {"status": "ERROR", "detail": "no database"}
+            want = params.get("id", [None])[0]
+            out = ps.list_cursors()
+            if want is not None:
+                out = {want: out[want]} if want in out else {}
+            return {"cursors": out}
+        return self._on_main(run)
+
+    def cmd_dropcursor(self, params):
+        if "id" not in params:
+            return {"status": "ERROR", "detail": "need id param"}
+        cid = params["id"][0]
+        if not cid.isalnum() or len(cid) > 32:
+            # same validation as setcursor: a typo'd id must surface
+            # as an error, not as "cursor already gone"
+            return {"status": "ERROR",
+                    "detail": "cursor id must be alphanumeric, <=32"}
+
+        def run():
+            ps = self._cursor_state()
+            if ps is None:
+                return {"status": "ERROR", "detail": "no database"}
+            with ps.db.conn:
+                cur = ps.db.conn.execute(
+                    "DELETE FROM storestate WHERE statename = ?",
+                    (f"cursor.{cid}",))
+            return {"dropped": cid, "existed": cur.rowcount > 0}
+        return self._on_main(run)
+
+    def cmd_self_check(self, params):
+        """Online self-check (reference ``self-check``): the bucket
+        lists' hashes vs the LCL header commitment."""
+        def run():
+            ok = self.app.self_check()
+            return {"status": "OK" if ok else "FAILED"}
+        return self._on_main(run)
+
+    def cmd_logrotate(self, params):
+        """Reopen file log sinks (reference ``logrotate``)."""
+        import logging
+
+        def run():
+            rotated = 0
+            logger = logging.getLogger("stellar_tpu")
+            for h in logger.handlers:
+                if isinstance(h, logging.FileHandler):
+                    h.acquire()
+                    try:
+                        h.close()
+                        # next emit reopens the (possibly moved) path
+                        h.stream = None
+                    finally:
+                        h.release()
+                    rotated += 1
+            return {"rotated": rotated}
+        return self._on_main(run)
+
     def cmd_getledgerentryraw(self, params):
         """The QueryServer route (reference ``QueryServer.h:21-29``):
         hex-encoded LedgerKey XDR in, hex LedgerEntry XDR out."""
@@ -397,6 +491,9 @@ class CommandHandler:
         "stopsurveycollecting": cmd_stop_survey_collecting,
         "surveytopologytimesliced": cmd_survey_topology_timesliced,
         "getsurveyresult": cmd_get_survey_result,
+        "setcursor": cmd_setcursor, "getcursor": cmd_getcursor,
+        "dropcursor": cmd_dropcursor, "self-check": cmd_self_check,
+        "logrotate": cmd_logrotate,
     }
 
     def _make_handler(outer_self):

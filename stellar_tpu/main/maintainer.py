@@ -54,6 +54,13 @@ class Maintainer:
         # passes the current checkpoint's first ledger
         return first_in_checkpoint(min(cp, cur))
 
+    @staticmethod
+    def _min_cursor(db):
+        """Lowest registered downstream cursor, or None."""
+        from stellar_tpu.database.database import PersistentState
+        cursors = PersistentState(db).list_cursors()
+        return min(cursors.values()) if cursors else None
+
     def perform_maintenance(self, count: int) -> dict:
         """Delete history rows older than LCL - count (bounded below
         the publish queue, when a history manager exists)."""
@@ -65,6 +72,11 @@ class Maintainer:
         if floor is not None:
             # never GC rows that still await publishing
             keep_from = min(keep_from, floor)
+        cursor_floor = self._min_cursor(db)
+        if cursor_floor is not None:
+            # nor rows a registered downstream consumer (setcursor,
+            # reference ExternalQueue) has not acknowledged yet
+            keep_from = min(keep_from, cursor_floor)
         deleted = 0
         with db.conn:
             for table in ("scphistory", "txhistory", "txsets"):

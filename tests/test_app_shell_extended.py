@@ -292,3 +292,124 @@ class _EmptySet:
 
     def get_txs_in_apply_order(self):
         return []
+
+
+def test_cursor_routes_and_gc_floor(tmp_path):
+    """setcursor/getcursor/dropcursor (reference ExternalQueue): a
+    registered downstream cursor holds history GC back until
+    dropped; bad ids/cursors are refused."""
+    import threading
+
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.command_handler import CommandHandler
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.main.maintainer import Maintainer
+    from stellar_tpu.tx.tx_test_utils import keypair
+    from stellar_tpu.utils.timer import REAL_TIME, VirtualClock
+
+    cfg = Config()
+    cfg.NODE_SEED = keypair("cursor-node")
+    cfg.DATABASE = str(tmp_path / "node.db")
+    app = Application(cfg, clock=VirtualClock(REAL_TIME))
+    admin = CommandHandler(app, 0)
+    stop = threading.Event()
+
+    def crank():
+        while not stop.is_set():
+            app.crank(block=True)
+    t = threading.Thread(target=crank, daemon=True)
+    t.start()
+    try:
+        with app.database.conn:
+            for seq in range(1, 40):
+                app.database.conn.execute(
+                    "INSERT INTO scphistory "
+                    "(nodeid, ledgerseq, envelope) VALUES (?, ?, ?)",
+                    ("n", seq, b""))
+        app.lm.last_closed_header.ledgerSeq = 39
+
+        out = _http_get(admin.port, "setcursor?id=FEED1&cursor=20")
+        assert out == {"cursor": "FEED1", "value": 20}
+        assert _http_get(admin.port, "getcursor")["cursors"] == \
+            {"FEED1": 20}
+        assert _http_get(admin.port,
+                         "setcursor?id=x%2F..&cursor=5")["status"] \
+            == "ERROR"
+        assert _http_get(admin.port,
+                         "setcursor?id=A&cursor=0")["status"] == "ERROR"
+
+        # count=0 would GC everything below 39; the cursor floor
+        # holds rows >= 20
+        r = Maintainer(app).perform_maintenance(count=0)
+        assert r["below"] == 20
+        left = app.database.conn.execute(
+            "SELECT MIN(ledgerseq) FROM scphistory").fetchone()[0]
+        assert left == 20
+
+        out = _http_get(admin.port, "dropcursor?id=FEED1")
+        assert out["dropped"] == "FEED1" and out["existed"]
+        assert _http_get(admin.port, "getcursor")["cursors"] == {}
+        r = Maintainer(app).perform_maintenance(count=0)
+        assert r["below"] == 39
+    finally:
+        stop.set()
+        app.clock.post_to_main(lambda: None)
+        admin.stop()
+
+
+def test_self_check_and_logrotate_routes(tmp_path):
+    import logging
+    import threading
+
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.command_handler import CommandHandler
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.tx.tx_test_utils import keypair
+    from stellar_tpu.utils.timer import REAL_TIME, VirtualClock
+
+    cfg = Config()
+    cfg.NODE_SEED = keypair("selfcheck-node")
+    log_path = tmp_path / "node.log"
+    cfg.LOG_FILE_PATH = str(log_path)
+    app = Application(cfg, clock=VirtualClock(REAL_TIME))
+    app.start()
+    admin = CommandHandler(app, 0)
+    stop = threading.Event()
+
+    def crank():
+        while not stop.is_set():
+            app.crank(block=True)
+    t = threading.Thread(target=crank, daemon=True)
+    t.start()
+    try:
+        # genesis header carries a zero bucket hash; self-check is
+        # meaningful after the first real close
+        import time as _time
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            if _http_get(admin.port, "info")["ledger"]["num"] >= 2:
+                break
+            _time.sleep(0.2)
+        assert _http_get(admin.port, "self-check")["status"] == "OK"
+        logger = logging.getLogger("stellar_tpu")
+        logger.warning("before rotate")
+        rotated_path = tmp_path / "node.log.1"
+        log_path.rename(rotated_path)
+        out = _http_get(admin.port, "logrotate")
+        assert out["rotated"] >= 1
+        logger.warning("after rotate")
+        for h in logger.handlers:
+            h.flush()
+        assert log_path.exists()  # reopened at the configured path
+        assert "after rotate" in log_path.read_text()
+        assert "after rotate" not in rotated_path.read_text()
+    finally:
+        stop.set()
+        app.clock.post_to_main(lambda: None)
+        admin.stop()
+        # detach the file handler so later tests don't write here
+        logger = logging.getLogger("stellar_tpu")
+        for h in list(logger.handlers):
+            if isinstance(h, logging.FileHandler):
+                h.close()
+                logger.removeHandler(h)
