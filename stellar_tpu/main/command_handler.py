@@ -13,6 +13,21 @@ from urllib.parse import parse_qs, urlparse
 __all__ = ["CommandHandler"]
 
 
+def _submit_status(res) -> dict:
+    """Uniform tx-submission status JSON (tx + testtx routes):
+    AddResult code by NAME, plus the inner result code on rejection."""
+    from stellar_tpu.herder.transaction_queue import AddResult
+    names = {AddResult.ADD_STATUS_PENDING: "PENDING",
+             AddResult.ADD_STATUS_DUPLICATE: "DUPLICATE",
+             AddResult.ADD_STATUS_ERROR: "ERROR",
+             AddResult.ADD_STATUS_TRY_AGAIN_LATER: "TRY_AGAIN_LATER",
+             AddResult.ADD_STATUS_BANNED: "BANNED"}
+    out = {"status": names.get(res.code, "?")}
+    if res.tx_result is not None:
+        out["error_result_code"] = res.tx_result.code
+    return out
+
+
 class CommandHandler:
     """Routes are handled on the HTTP thread but all node state access
     is marshalled onto the main thread via post_to_main + an event —
@@ -96,16 +111,8 @@ class CommandHandler:
             env = from_bytes(TransactionEnvelope, raw)
             frame = make_transaction_frame(self.app.herder.network_id, env)
             res = self.app.herder.recv_transaction(frame)
-            from stellar_tpu.herder.transaction_queue import AddResult
-            names = {AddResult.ADD_STATUS_PENDING: "PENDING",
-                     AddResult.ADD_STATUS_DUPLICATE: "DUPLICATE",
-                     AddResult.ADD_STATUS_ERROR: "ERROR",
-                     AddResult.ADD_STATUS_TRY_AGAIN_LATER:
-                         "TRY_AGAIN_LATER",
-                     AddResult.ADD_STATUS_BANNED: "BANNED"}
-            out = {"status": names.get(res.code, "?")}
+            out = _submit_status(res)
             if res.tx_result is not None:
-                out["error_result_code"] = res.tx_result.code
                 if self.app.config \
                         .ENABLE_DIAGNOSTICS_FOR_TX_SUBMISSION:
                     # full result XDR for failed submissions
@@ -405,6 +412,67 @@ class CommandHandler:
             return {"dropped": cid, "existed": cur.rowcount > 0}
         return self._on_main(run)
 
+    def cmd_testacc(self, params):
+        """Reference ``testacc?name=bob`` (BUILD_TESTS route): balance
+        and seqnum of the deterministic test account for ``name``."""
+        name = params.get("name", [None])[0]
+        if name is None:
+            return {"status": "error",
+                    "detail": "try something like: testacc?name=bob"}
+
+        def run():
+            from stellar_tpu.crypto.keys import SecretKey
+            from stellar_tpu.ledger.ledger_txn import key_bytes
+            from stellar_tpu.tx.op_frame import account_key
+            from stellar_tpu.xdr.types import account_id
+            key = SecretKey.from_seed_str(name)
+            e = self.app.lm.root.store.get(key_bytes(
+                account_key(account_id(key.public_key.raw))))
+            if e is None:
+                return {"status": "error",
+                        "detail": f"no account for {name!r}"}
+            ae = e.data.value
+            return {"name": name, "id": key.public_key.to_strkey(),
+                    "balance": ae.balance, "seqnum": ae.seqNum}
+        return self._on_main(run)
+
+    def cmd_testtx(self, params):
+        """Reference ``testtx?from=root&to=bob&amount=N[&create=true]``:
+        build, sign, and submit a payment (or create-account) between
+        deterministic test accounts."""
+        missing = [k for k in ("from", "to", "amount")
+                   if k not in params]
+        if missing:
+            return {"status": "error",
+                    "detail": f"missing params: {missing}"}
+        try:
+            amount = int(params["amount"][0])
+        except ValueError:
+            return {"status": "error", "detail": "bad amount param"}
+
+        def run():
+            from stellar_tpu.crypto.keys import SecretKey
+            from stellar_tpu.ledger.ledger_txn import key_bytes
+            from stellar_tpu.tx.op_frame import account_key
+            from stellar_tpu.tx.tx_test_utils import (
+                create_account_op, make_tx, payment_op,
+            )
+            from stellar_tpu.xdr.types import account_id
+            src = SecretKey.from_seed_str(params["from"][0])
+            dst = SecretKey.from_seed_str(params["to"][0])
+            create = params.get("create", ["false"])[0] == "true"
+            e = self.app.lm.root.store.get(key_bytes(
+                account_key(account_id(src.public_key.raw))))
+            if e is None:
+                return {"status": "error", "detail": "no from account"}
+            op = create_account_op(dst, amount) if create \
+                else payment_op(dst, amount)
+            tx = make_tx(src, e.data.value.seqNum + 1, [op],
+                         network_id=self.app.config.network_id())
+            res = self.app.herder.recv_transaction(tx)
+            return _submit_status(res)
+        return self._on_main(run)
+
     def cmd_self_check(self, params):
         """Online self-check (reference ``self-check``): the bucket
         lists' hashes vs the LCL header commitment."""
@@ -494,6 +562,7 @@ class CommandHandler:
         "setcursor": cmd_setcursor, "getcursor": cmd_getcursor,
         "dropcursor": cmd_dropcursor, "self-check": cmd_self_check,
         "logrotate": cmd_logrotate,
+        "testacc": cmd_testacc, "testtx": cmd_testtx,
     }
 
     def _make_handler(outer_self):

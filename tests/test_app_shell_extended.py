@@ -413,3 +413,67 @@ def test_self_check_and_logrotate_routes(tmp_path):
             if isinstance(h, logging.FileHandler):
                 h.close()
                 logger.removeHandler(h)
+
+
+def test_testacc_and_testtx_routes():
+    """Reference BUILD_TESTS routes testacc/testtx: inspect a test
+    account and submit a payment between deterministic test keys."""
+    import threading
+    import time as _time
+
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.command_handler import CommandHandler
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.tx.tx_test_utils import (
+        keypair, seed_root_with_accounts,
+    )
+    from stellar_tpu.utils.timer import REAL_TIME, VirtualClock
+
+    XLM = 10_000_000
+    alice, bob = keypair("alice"), keypair("bob")
+    cfg = Config()
+    cfg.NODE_SEED = keypair("testtx-node")
+    app = Application(cfg, clock=VirtualClock(REAL_TIME),
+                      root=seed_root_with_accounts(
+                          [(alice, 1000 * XLM), (bob, 1000 * XLM)]))
+    app.start()
+    admin = CommandHandler(app, 0)
+    stop = threading.Event()
+
+    def crank():
+        while not stop.is_set():
+            app.crank(block=True)
+    threading.Thread(target=crank, daemon=True).start()
+    try:
+        acc = _http_get(admin.port, "testacc?name=alice")
+        assert acc["balance"] == 1000 * XLM and acc["id"].startswith("G")
+        assert _http_get(admin.port, "testacc?name=nobody")["status"] \
+            == "error"
+        out = _http_get(admin.port, "testtx?from=alice&to=bob&amount=7")
+        assert out == {"status": "PENDING"}
+        assert _http_get(admin.port,
+                         "testtx?from=alice&to=bob&amount=xyz")["status"] \
+            == "error"
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            bal = _http_get(admin.port, "testacc?name=bob")["balance"]
+            if bal == 1000 * XLM + 7:
+                break
+            _time.sleep(0.2)
+        assert bal == 1000 * XLM + 7
+        # create a brand-new account via create=true
+        out = _http_get(
+            admin.port,
+            f"testtx?from=alice&to=fresh1&amount={100 * XLM}&create=true")
+        assert out == {"status": "PENDING"}
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            acc = _http_get(admin.port, "testacc?name=fresh1")
+            if acc.get("balance") == 100 * XLM:
+                break
+            _time.sleep(0.2)
+        assert acc["balance"] == 100 * XLM
+    finally:
+        stop.set()
+        app.clock.post_to_main(lambda: None)
+        admin.stop()
