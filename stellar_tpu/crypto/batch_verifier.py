@@ -142,19 +142,29 @@ class BatchVerifier:
     def _prep_inner(self, items: Sequence[tuple]):
         n = len(items)
         ok = np.ones(n, dtype=bool)
-        a = np.zeros((n, 32), dtype=np.uint8)
-        r = np.zeros((n, 32), dtype=np.uint8)
-        s = np.zeros((n, 32), dtype=np.uint8)
+        # one frombuffer over joined bytes instead of three numpy row
+        # writes per item — the per-item version was the single
+        # biggest host-prep cost at 2k-signature batches
         msgs = []
+        pk_parts = []
+        sig_parts = []
+        z32, z64 = bytes(32), bytes(64)
         for i, (pk, msg, sig) in enumerate(items):
             if len(pk) != 32 or len(sig) != 64:
                 ok[i] = False
+                pk_parts.append(z32)
+                sig_parts.append(z64)
                 msgs.append(b"")
-                continue
-            a[i] = np.frombuffer(pk, dtype=np.uint8)
-            r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-            s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-            msgs.append(msg)
+            else:
+                pk_parts.append(pk)
+                sig_parts.append(sig)
+                msgs.append(msg)
+        a = np.frombuffer(b"".join(pk_parts),
+                          dtype=np.uint8).reshape(n, 32)
+        sig_mat = np.frombuffer(b"".join(sig_parts),
+                                dtype=np.uint8).reshape(n, 64)
+        r = np.ascontiguousarray(sig_mat[:, :32])
+        s = np.ascontiguousarray(sig_mat[:, 32:])
         # h = SHA512(R||A||M) mod L — native multithreaded C++
         h = native_prep.prep_batch(r, a, msgs)
         # host policy checks (libsodium order: s canonical, small-order R/A,
