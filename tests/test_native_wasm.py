@@ -344,3 +344,62 @@ def test_missing_export_classification_matches_python():
     n, p = both(m, "real", [1, 2, 3])
     assert n[0] == p[0] == "trap", (n, p)
     assert n[2] == p[2]
+
+
+def test_extension_releases_gil_during_native_run():
+    """The CPython-extension path must release the GIL around
+    wasm_run (parity with ctypes): a ticker thread keeps making
+    progress while a pure-wasm loop spins natively."""
+    import threading
+    import time
+
+    from stellar_tpu.soroban import native_wasm, wasm
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+    if native_wasm._load_ext() is None:
+        import pytest
+        pytest.skip("extension unavailable")
+    b = ModuleBuilder()
+    c = Code()
+    c.raw(0x42, 0x00, 0x21, 0x01)          # local1 = 0
+    c.block()
+    c.loop()
+    c.raw(0x20, 0x01, 0x42, 0x01, 0x7C, 0x21, 0x01)  # local1 += 1
+    c.raw(0x20, 0x01, 0x42, 0xC0, 0x84, 0x3D, 0x52)  # != 1_000_000
+    c.raw(0x0D, 0x00)                       # br_if loop
+    c.end()
+    c.end()
+    c.raw(0x20, 0x01)
+    c.end()
+    b.add_func([I64], [I64], [I64], c, export="spin")
+    module = wasm.parse_module(b.build())
+
+    class Budget:
+        cpu = 0
+        mem = 0
+        cpu_limit = 10 ** 14
+        mem_limit = 10 ** 14
+
+        def charge(self, c_, m=0):
+            self.cpu += c_
+            self.mem += m
+
+    ticks = []
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            ticks.append(time.perf_counter())
+            time.sleep(0.001)
+
+    th = threading.Thread(target=ticker)
+    th.start()
+    t0 = time.perf_counter()
+    rv = native_wasm.run_export(module, {}, Budget(), 1, "spin", [0])
+    dt = time.perf_counter() - t0
+    stop.set()
+    th.join()
+    assert rv == 1_000_000
+    in_window = sum(1 for t in ticks if t0 <= t <= t0 + dt)
+    # with the GIL held for the whole run the ticker would get ~0
+    # iterations; released, it ticks every ~1ms
+    assert in_window >= 3, (in_window, dt)

@@ -122,3 +122,14 @@ def test_classic_close_latency_budget():
     from stellar_tpu.simulation.load_generator import apply_load
     r = apply_load(n_ledgers=5, txs_per_ledger=100)
     assert r["close_mean_ms"] <= 180.0, r["close_mean_ms"]
+
+
+def test_catchup_replay_budget():
+    """125-ledger replay: measured ~0.7s after the r4 codec work;
+    ~7x headroom for CI-class hosts."""
+    from stellar_tpu.simulation.load_generator import (
+        catchup_replay_bench,
+    )
+    r = catchup_replay_bench(n_ledgers=125, txs_per_ledger=10)
+    assert r["replayed_ledgers"] >= 100
+    assert r["wall_s"] <= 5.0, r
