@@ -163,7 +163,13 @@ def cmd_self_check(args) -> int:
             out["state"] = "no last closed ledger"
         else:
             # phase 1: bucket list hash chains into the LCL header
-            ok_hash = lm.bucket_list.hash() == \
+            # (p23+: the header commits to live+hot combined)
+            from stellar_tpu.bucket.hot_archive import (
+                header_bucket_list_hash,
+            )
+            ok_hash = header_bucket_list_hash(
+                lm.bucket_list.hash(), lm.hot_archive,
+                lm.last_closed_header.ledgerVersion) == \
                 lm.last_closed_header.bucketListHash
             # phase 2: every bucket file re-hashes to its name
             ok_files = True

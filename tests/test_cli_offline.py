@@ -442,3 +442,45 @@ def test_dump_xdr_stream(persisted_node, tmp_path, capsys):
     # unknown type is a clean error
     args = types.SimpleNamespace(file=files[0], filetype="Nope", limit=1)
     assert cli_offline.cmd_dump_xdr(args) == 1
+
+
+def test_cli_self_check_on_persisted_p23_node(tmp_path, capsys):
+    """Full CLI self-check against a persisted node that closed
+    ledgers at p23: phase 1 must validate the COMBINED live+hot
+    header commitment (the naive live-only comparison regressed here
+    once), with all phases OK."""
+    import json
+
+    from stellar_tpu.bucket.bucket_manager import BucketManager
+    from stellar_tpu.database import Database, NodePersistence
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    from stellar_tpu.main.cli import main as cli_main
+    from stellar_tpu.tx.tx_test_utils import (
+        keypair, seed_root_with_accounts,
+    )
+    from tests.test_persistence import XLM, _close_n
+
+    a = keypair("sc-cli")
+    db_path = tmp_path / "node.db"
+    db = Database(str(db_path))
+    pers = NodePersistence(db, BucketManager(str(tmp_path / "buckets")))
+    root = seed_root_with_accounts([(a, 1000 * XLM)])
+    from stellar_tpu.ledger.ledger_txn import LedgerTxn
+    with LedgerTxn(root) as ltx:
+        with ltx.load_header() as hh:
+            hh.header.ledgerVersion = 23  # the p23 combined commitment
+        ltx.commit()
+    lm = LedgerManager(b"\x07" * 32, root, persistence=pers)
+    assert lm.last_closed_header.ledgerVersion >= 23
+    _close_n(lm, 5)
+    db.close()
+
+    cfg = tmp_path / "node.cfg"
+    cfg.write_text(f'DATABASE = "{db_path}"\n'
+                   'NETWORK_PASSPHRASE = "test"\n')
+    rc = cli_main(["--conf", str(cfg), "self-check"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, out
+    st = out["state"]
+    assert st["bucket_list_hash_ok"] is True, st
+    assert st["bucket_files_ok"] is True and st["store_scan_ok"] is True
