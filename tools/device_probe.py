@@ -32,13 +32,44 @@ print(json.dumps({
 """
 
 
+def _run_group(cmd, timeout_s):
+    """subprocess.run-alike that kills the WHOLE process group on
+    timeout: a half-alive tunnel leaves jax grandchildren holding the
+    inherited pipes, and a plain child kill then blocks communicate()
+    forever (observed: a probe stuck 44 minutes past its timeout)."""
+    import os
+    import signal
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    try:
+        stdout, stderr = p.communicate(timeout=timeout_s)
+        return p.returncode, stdout, stderr
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            p.communicate(timeout=10)
+        except Exception:
+            pass
+        raise
+
+
 def probe(timeout_s: int = 90) -> dict:
     rec = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat()}
+
+    class _Out:
+        pass
+
     try:
-        out = subprocess.run(
-            [sys.executable, "-u", "-c", PROBE_SRC],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
+        rc, so, se = _run_group(
+            [sys.executable, "-u", "-c", PROBE_SRC], timeout_s)
+        out = _Out()
+        out.returncode = rc
+        out.stdout = so
+        out.stderr = se
         if out.returncode == 0:
             try:
                 last = out.stdout.strip().splitlines()[-1]

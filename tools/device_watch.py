@@ -70,11 +70,33 @@ def log(msg):
     print(f"[{now().isoformat()}] {msg}", flush=True)
 
 
+def _run_group(cmd, timeout_s, env=None):
+    """Run with process-group kill on timeout: jax grandchildren of a
+    half-alive tunnel hold the inherited pipes and block communicate()
+    after a plain child kill (observed 44-minute stall)."""
+    import signal
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True, cwd=REPO, env=env)
+    try:
+        stdout, stderr = p.communicate(timeout=timeout_s)
+        return p.returncode, stdout, stderr
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            p.communicate(timeout=10)
+        except Exception:
+            pass
+        raise
+
+
 def run_probe():
     try:
-        out = subprocess.run([sys.executable, PROBE, "60"],
-                             capture_output=True, text=True, timeout=120)
-        return out.returncode == 0
+        rc, _o, _e = _run_group([sys.executable, PROBE, "60"], 150)
+        return rc == 0
     except subprocess.TimeoutExpired:
         return False
 
@@ -83,19 +105,18 @@ def capture_json(cmd, prefix, ts, describe):
     """Run cmd, parse its last stdout line as JSON, stamp + save it
     under docs/bench_runs/. Returns True on a saved record."""
     try:
-        out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=BENCH_TIMEOUT_S, cwd=REPO)
+        rc, so, se = _run_group(cmd, BENCH_TIMEOUT_S)
     except subprocess.TimeoutExpired:
         log(f"{prefix} timed out (window closed mid-run?)")
         return False
-    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    line = so.strip().splitlines()[-1] if so.strip() else ""
     try:
-        rec = json.loads(line) if out.returncode == 0 else None
+        rec = json.loads(line) if rc == 0 else None
     except ValueError:
         rec = None
     if rec is None:
-        log(f"{prefix} failed rc={out.returncode}: "
-            f"stdout_tail={line[-200:]} stderr={out.stderr[-300:]}")
+        log(f"{prefix} failed rc={rc}: "
+            f"stdout_tail={line[-200:]} stderr={se[-300:]}")
         return False
     rec["recorded_at"] = now().isoformat()
     path = os.path.join(RUNS, f"{prefix}_{ts}.json")
@@ -122,16 +143,15 @@ def capture_window():
         lambda r: f"close_mean={r.get('close_mean_ms')}ms "
                   f"backend={r.get('verify_backend')}") or ok
     try:
-        out = subprocess.run(
+        rc, so, se = _run_group(
             [sys.executable, "-c", TRACE_SRC, REPO,
-             os.path.join(PROFILES, f"r4_{ts}")],
-            capture_output=True, text=True, timeout=TRACE_TIMEOUT_S, cwd=REPO,
+             os.path.join(PROFILES, f"r4_{ts}")], TRACE_TIMEOUT_S,
             env={**os.environ, "JAX_TRACEBACK_FILTERING": "off"})
-        if out.returncode == 0:
-            log(f"profiler trace captured: {out.stdout.strip()[-200:]}")
+        if rc == 0:
+            log(f"profiler trace captured: {so.strip()[-200:]}")
             ok = True
         else:
-            log(f"trace failed rc={out.returncode}: {out.stderr[-300:]}")
+            log(f"trace failed rc={rc}: {se[-300:]}")
     except subprocess.TimeoutExpired:
         log("trace timed out")
     return ok
