@@ -1,0 +1,140 @@
+// Threaded batch ed25519 verification over OpenSSL's EVP interface
+// (the host-side fallback when no accelerator is reachable; analog of
+// the reference spreading verify across libsodium calls, but batched
+// and threaded — the node's apply path hands over whole signature
+// batches, reference SIG HOT PATHs, SecretKey.cpp:435-468).
+//
+// Accept semantics are pinned to the per-call host oracle by the
+// differential test (tests/test_batch_verifier.py): the system
+// libcrypto's EVP_DigestVerify runs the same ref10-derived
+// cofactorless equation, and the libsodium policy gate (canonical s,
+// small-order/canonical A and R) stays in Python
+// (crypto/ed25519_ref._policy_gate) exactly as for the per-call path.
+// (The `cryptography` wheel may embed its OWN OpenSSL build, so the
+// equivalence is test-pinned, not structural.) No OpenSSL headers in
+// this image, so the needed prototypes are declared by hand and
+// resolved with dlsym.
+//
+// Build: g++ -O2 -shared -fPIC -o libed25519verify.so \
+//            ed25519_batch_verify.cpp -ldl
+//
+// ABI:
+//   int ed25519_verify_batch(const uint8_t* pks,      // 32*n
+//                            const uint8_t* sigs,     // 64*n
+//                            const uint8_t* msgs,     // concatenated
+//                            const uint64_t* offs,
+//                            const uint64_t* lens,
+//                            uint64_t n, int nthreads,
+//                            uint8_t* out)            // n booleans
+//   returns 0 on success, nonzero when libcrypto could not be loaded.
+
+#include <cstdint>
+#include <cstring>
+#include <dlfcn.h>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// minimal hand-declared OpenSSL 3 surface
+typedef void EVP_PKEY;
+typedef void EVP_MD_CTX;
+constexpr int EVP_PKEY_ED25519 = 1087;  // NID_ED25519, ABI-stable
+
+typedef EVP_PKEY* (*fn_new_raw_pub)(int, void*, const unsigned char*,
+                                    size_t);
+typedef void (*fn_pkey_free)(EVP_PKEY*);
+typedef EVP_MD_CTX* (*fn_ctx_new)(void);
+typedef void (*fn_ctx_free)(EVP_MD_CTX*);
+typedef int (*fn_verify_init)(EVP_MD_CTX*, void**, const void*, void*,
+                              EVP_PKEY*);
+typedef int (*fn_verify)(EVP_MD_CTX*, const unsigned char*, size_t,
+                         const unsigned char*, size_t);
+
+struct Ossl {
+    fn_new_raw_pub new_raw_pub = nullptr;
+    fn_pkey_free pkey_free = nullptr;
+    fn_ctx_new ctx_new = nullptr;
+    fn_ctx_free ctx_free = nullptr;
+    fn_verify_init verify_init = nullptr;
+    fn_verify verify = nullptr;
+    bool ok = false;
+};
+
+const Ossl& ossl() {
+    static Ossl o = [] {
+        Ossl s;
+        void* h = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+        if (!h)
+            h = dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+        if (!h)
+            return s;
+        s.new_raw_pub = (fn_new_raw_pub)dlsym(
+            h, "EVP_PKEY_new_raw_public_key");
+        s.pkey_free = (fn_pkey_free)dlsym(h, "EVP_PKEY_free");
+        s.ctx_new = (fn_ctx_new)dlsym(h, "EVP_MD_CTX_new");
+        s.ctx_free = (fn_ctx_free)dlsym(h, "EVP_MD_CTX_free");
+        s.verify_init = (fn_verify_init)dlsym(h, "EVP_DigestVerifyInit");
+        s.verify = (fn_verify)dlsym(h, "EVP_DigestVerify");
+        s.ok = s.new_raw_pub && s.pkey_free && s.ctx_new && s.ctx_free &&
+               s.verify_init && s.verify;
+        return s;
+    }();
+    return o;
+}
+
+void verify_range(const uint8_t* pks, const uint8_t* sigs,
+                  const uint8_t* msgs, const uint64_t* offs,
+                  const uint64_t* lens, uint64_t lo, uint64_t hi,
+                  uint8_t* out) {
+    const Ossl& o = ossl();
+    for (uint64_t i = lo; i < hi; i++) {
+        out[i] = 0;
+        EVP_PKEY* pk = o.new_raw_pub(EVP_PKEY_ED25519, nullptr,
+                                     pks + 32 * i, 32);
+        if (!pk)
+            continue;
+        EVP_MD_CTX* ctx = o.ctx_new();
+        if (ctx) {
+            if (o.verify_init(ctx, nullptr, nullptr, nullptr, pk) == 1 &&
+                o.verify(ctx, sigs + 64 * i, 64, msgs + offs[i],
+                         (size_t)lens[i]) == 1)
+                out[i] = 1;
+            o.ctx_free(ctx);
+        }
+        o.pkey_free(pk);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int ed25519_verify_available(void) { return ossl().ok ? 1 : 0; }
+
+int ed25519_verify_batch(const uint8_t* pks, const uint8_t* sigs,
+                         const uint8_t* msgs, const uint64_t* offs,
+                         const uint64_t* lens, uint64_t n,
+                         int nthreads, uint8_t* out) {
+    if (!ossl().ok)
+        return 1;
+    if (nthreads <= 1 || n < 32) {
+        verify_range(pks, sigs, msgs, offs, lens, 0, n, out);
+        return 0;
+    }
+    int t = std::min<int>(nthreads, (int)((n + 31) / 32));
+    std::vector<std::thread> workers;
+    uint64_t per = (n + t - 1) / t;
+    for (int w = 0; w < t; w++) {
+        uint64_t lo = w * per, hi = std::min<uint64_t>(n, lo + per);
+        if (lo >= hi)
+            break;
+        workers.emplace_back(verify_range, pks, sigs, msgs, offs, lens,
+                             lo, hi, out);
+    }
+    for (auto& th : workers)
+        th.join();
+    return 0;
+}
+
+}  // extern "C"

@@ -197,6 +197,30 @@ def verify_sig(pk, msg: bytes, sig: bytes) -> bool:
     return ok
 
 
+def _host_oracle_batch(todo) -> list:
+    """Host verification of (key, pk, msg, sig) tuples: libsodium's
+    policy gate in Python (the single source of truth,
+    ed25519_ref._policy_gate), curve equations through the threaded
+    native libcrypto batch when it built, else the per-call oracle."""
+    from stellar_tpu.crypto import native_verify
+    if not native_verify.available():
+        return [_ref.verify(pk, msg, sig) for _, pk, msg, sig in todo]
+    gate = [_ref._policy_gate(pk, sig) for _, pk, msg, sig in todo]
+    # compact to gate-passing rows (a flood of malformed sigs must not
+    # pay full curve verifications for discarded results), then
+    # scatter the equation results back
+    idx = [i for i, g in enumerate(gate) if g]
+    if not idx:
+        return [False] * len(todo)
+    eq = native_verify.verify_eq_batch(
+        [todo[i][1] for i in idx], [todo[i][2] for i in idx],
+        [todo[i][3] for i in idx])
+    out = [False] * len(todo)
+    for i, e in zip(idx, eq):
+        out[i] = bool(e)
+    return out
+
+
 def batch_verify_into_cache(items) -> None:
     """Verify (pk, msg, sig) triples in one device batch and seed the
     result cache, so subsequent ``verify_sig`` calls for the same
@@ -235,9 +259,11 @@ def batch_verify_into_cache(items) -> None:
                 [(pk, msg, sig) for _, pk, msg, sig in todo])
         else:
             # no accelerator (cpu-only jax, or a dead tunnel): the
-            # host oracle beats XLA-on-CPU for bignum verify
-            results = [_ref.verify(pk, msg, sig)
-                       for _, pk, msg, sig in todo]
+            # host oracle beats XLA-on-CPU for bignum verify; the
+            # threaded native batch (same libcrypto, same EVP call,
+            # policy gate in Python as always) spreads the equation
+            # checks across cores where the host has them
+            results = _host_oracle_batch(todo)
     with _cache_lock:
         for (k, _, _, _), ok in zip(todo, results):
             _verify_cache.put(k, bool(ok))

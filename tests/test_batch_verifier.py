@@ -294,3 +294,42 @@ def test_trickle_batcher_solo_caller_still_correct():
     assert batcher.verify_sig(pk, msg, sig)
     assert not batcher.verify_sig(pk, msg, b"\x00" * 64)
     assert batcher.dispatches == 2
+
+
+def test_host_oracle_batch_matches_per_call_oracle():
+    """The threaded native libcrypto batch (policy gate in Python +
+    EVP equation in C++) must agree item-for-item with the per-call
+    host oracle across valid, tampered, malformed, and adversarial
+    (small-order / non-canonical) inputs."""
+    from stellar_tpu.crypto import ed25519_ref as ref
+    from stellar_tpu.crypto import native_verify
+    from stellar_tpu.crypto.keys import SecretKey, _host_oracle_batch
+    if not native_verify.available():
+        import pytest
+        pytest.skip("native verifier not built")
+    items = []
+    for i in range(64):
+        sk = SecretKey.from_seed_str(f"hob-{i}")
+        msg = bytes([i]) * (1 + i % 50)
+        sig = sk.sign(msg)
+        pk = sk.public_key.raw
+        if i % 5 == 1:   # tampered sig
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        elif i % 5 == 2:  # tampered msg
+            msg = msg + b"!"
+        elif i % 5 == 3:  # malformed lengths
+            pk = pk[:16]
+        elif i % 5 == 4:  # non-canonical s (s + L)
+            s_int = int.from_bytes(sig[32:], "little") + ref.L
+            sig = sig[:32] + s_int.to_bytes(32, "little")
+        items.append((b"k%d" % i, pk, msg, sig))
+    # small-order A and R encodings
+    small = sorted(ref._small_order_encodings())[0]
+    sk = SecretKey.from_seed_str("hob-small")
+    m = b"m"
+    items.append((b"kA", small, m, sk.sign(m)))
+    items.append((b"kR", sk.public_key.raw, m, small + sk.sign(m)[32:]))
+    got = _host_oracle_batch(items)
+    want = [ref.verify(pk, msg, sig) for _, pk, msg, sig in items]
+    assert got == want
+    assert any(want) and not all(want)  # both classes exercised
