@@ -373,6 +373,17 @@ class Herder:
 
     # ---------------- value validation / combination ----------------
 
+    def _closetime_drift(self) -> int:
+        """Configured MAXIMUM_LEDGER_CLOSETIME_DRIFT, or the
+        reference's derivation: min((slots+2) * close cadence, 90s)
+        (Config.cpp:196-204)."""
+        cfg = getattr(self.node_config,
+                      "MAXIMUM_LEDGER_CLOSETIME_DRIFT", 0)
+        if cfg > 0:
+            return cfg
+        return min((self.max_slots_to_remember + 2) *
+                   self.target_close_seconds, 90)
+
     def _validate_value(self, slot_index: int, value: bytes,
                         nomination: bool) -> int:
         sv = _parse_stellar_value(value)
@@ -382,9 +393,16 @@ class Herder:
         # close time advances strictly, and not absurdly into the future
         if sv.closeTime <= lcl.scpValue.closeTime:
             return ValidationLevel.INVALID
-        if nomination and sv.closeTime > \
-                self.clock.system_now() + MAX_TIME_SLIP_SECONDS:
-            return ValidationLevel.INVALID
+        if nomination:
+            now = self.clock.system_now()
+            if sv.closeTime > now + MAX_TIME_SLIP_SECONDS:
+                return ValidationLevel.INVALID
+            # and not absurdly in the past either (reference
+            # MAXIMUM_LEDGER_CLOSETIME_DRIFT, HerderImpl.cpp:656-658;
+            # 0 derives the reference's MAX_SLOTS_TO_REMEMBER bound)
+            drift = self._closetime_drift()
+            if now >= drift and sv.closeTime < now - drift:
+                return ValidationLevel.INVALID
         # every carried upgrade must be apply-valid (and, at nomination,
         # exactly what this node scheduled) — reference
         # validateUpgrades in HerderSCPDriver::validateValueHelper

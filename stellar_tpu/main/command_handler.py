@@ -595,9 +595,21 @@ class CommandHandler:
 class QueryServer(CommandHandler):
     """Separate read-only HTTP server answering ledger-entry queries
     (reference ``src/main/QueryServer.h:21-29`` — its own port so heavy
-    query load can't crowd out operator commands)."""
+    query load can't crowd out operator commands). Concurrency is
+    bounded by ``QUERY_THREAD_POOL_SIZE`` (reference requires it > 0
+    with a query port, ``ApplicationImpl.cpp:713-716``)."""
 
     def __init__(self, app, port: int = 0):
+        pool = getattr(getattr(app, "config", None),
+                       "QUERY_THREAD_POOL_SIZE", 4)
+        if pool <= 0:
+            raise ValueError(
+                "HTTP_QUERY_PORT requires QUERY_THREAD_POOL_SIZE > 0")
+        self._query_slots = threading.BoundedSemaphore(pool)
         super().__init__(app, port, routes={
-            "getledgerentryraw": CommandHandler.cmd_getledgerentryraw,
+            "getledgerentryraw": QueryServer._gated_getledgerentryraw,
         })
+
+    def _gated_getledgerentryraw(self, params):
+        with self._query_slots:
+            return CommandHandler.cmd_getledgerentryraw(self, params)

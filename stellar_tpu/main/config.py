@@ -37,6 +37,10 @@ class Config:
     EXPECTED_LEDGER_CLOSE_TIME: int = 5
     MAX_TX_SET_SIZE: int = 100
     MAX_SLOTS_TO_REMEMBER: int = 12
+    # 0 = derive min((MAX_SLOTS_TO_REMEMBER+2)*5s, 90s) like the
+    # reference (Config.cpp:196-204); bounds nominated close times
+    # against the local clock in BOTH directions
+    MAXIMUM_LEDGER_CLOSETIME_DRIFT: int = 0
     RUN_STANDALONE: bool = False
     MANUAL_CLOSE: bool = False
 
@@ -70,6 +74,9 @@ class Config:
     TARGET_PEER_CONNECTIONS: int = 8
     MAX_PEER_CONNECTIONS: int = 64
     MAX_PENDING_CONNECTIONS: int = 500
+    # -1 = derive TARGET_PEER_CONNECTIONS * 8 (reference default):
+    # cap on AUTHENTICATED inbound peers beyond the outbound target
+    MAX_ADDITIONAL_PEER_CONNECTIONS: int = -1
     MAX_INBOUND_PENDING_CONNECTIONS: int = 0   # 0 = derive from above
     MAX_OUTBOUND_PENDING_CONNECTIONS: int = 0  # 0 = derive from above
     KNOWN_PEERS: List[str] = field(default_factory=list)
@@ -181,6 +188,10 @@ class Config:
     INVARIANT_CHECKS: List[str] = field(default_factory=list)
     HTTP_PORT: int = 11626
     HTTP_QUERY_PORT: int = 0  # 0 disables the query server
+    # query-server concurrency bound (reference requires > 0 with a
+    # query port, ApplicationImpl.cpp:713-716); the listen backlog
+    # stays HTTP_MAX_CLIENT
+    QUERY_THREAD_POOL_SIZE: int = 4
     HTTP_MAX_CLIENT: int = 128
     # bind the admin port on all interfaces instead of loopback
     PUBLIC_HTTP_PORT: bool = False

@@ -201,6 +201,22 @@ class OverlayManager:
         if peer in self.pending_peers:
             self.pending_peers.remove(peer)
         if peer not in self.peers:
+            # the authenticated-inbound cap must hold at the
+            # pending->authenticated transition (a burst can pass the
+            # accept-time check together, reference
+            # OverlayManagerImpl.cpp:318): reject over-cap inbound here
+            if not getattr(peer, "we_called", True):
+                cfg = getattr(self.app, "config", None)
+                max_add = getattr(cfg,
+                                  "MAX_ADDITIONAL_PEER_CONNECTIONS", -1)
+                if max_add < 0:
+                    max_add = getattr(cfg, "TARGET_PEER_CONNECTIONS",
+                                      8) * 8
+                in_auth = sum(1 for p in self.peers
+                              if not getattr(p, "we_called", True))
+                if in_auth >= max_add:
+                    peer.drop("too many inbound peers")
+                    return
             self.peers.append(peer)
             # node-key preference (reference PREFERRED_PEER_KEYS):
             # a peer whose identity key is preferred gets its address

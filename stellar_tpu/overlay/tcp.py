@@ -159,6 +159,17 @@ class PeerDoor:
         if in_pending >= max_in:
             sock.close()
             return None
+        # authenticated-inbound cap: TARGET outbound + this many more
+        # (reference MAX_ADDITIONAL_PEER_CONNECTIONS; -1 derives 8x
+        # the outbound target, OverlayManagerImpl.cpp:318)
+        max_add = getattr(cfg, "MAX_ADDITIONAL_PEER_CONNECTIONS", -1)
+        if max_add < 0:
+            max_add = getattr(cfg, "TARGET_PEER_CONNECTIONS", 8) * 8
+        in_auth = sum(1 for p in self.app.overlay.peers
+                      if not getattr(p, "we_called", True))
+        if in_auth >= max_add:
+            sock.close()
+            return None
         peer = TCPPeer(self.app, we_called=False, sock=sock)
         self.app.overlay.add_pending(peer)
         return peer

@@ -500,3 +500,109 @@ def test_snapshot_ring_coverage_guard():
     lm.check_snapshot_seq(start)
     with pytest.raises(ValueError, match="does not yet cover"):
         lm.check_snapshot_seq(start - 1)
+
+
+def test_max_closetime_drift_bounds_nomination(tmp_path):
+    """MAXIMUM_LEDGER_CLOSETIME_DRIFT (0 = the reference derivation):
+    nominated values with close times absurdly in the PAST are
+    invalid, symmetric with the existing future bound."""
+    from stellar_tpu.herder.herder import Herder
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.scp.driver import ValidationLevel
+    from stellar_tpu.tx.tx_test_utils import keypair
+    from stellar_tpu.utils.timer import VirtualClock
+    from stellar_tpu.xdr.ledger import basic_stellar_value
+    from stellar_tpu.xdr.runtime import to_bytes
+    from stellar_tpu.xdr.ledger import StellarValue
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    k = keypair("drift-node")
+    qset = SCPQuorumSet(threshold=1,
+                        validators=[make_node_id(k.public_key.raw)],
+                        innerSets=[])
+    clock = VirtualClock()
+    cfg = Config()
+    cfg.MAXIMUM_LEDGER_CLOSETIME_DRIFT = 70
+    lm = LedgerManager(b"\x07" * 32)
+    h = Herder(k, b"\x07" * 32, lm, clock, qset, node_config=cfg)
+    assert h._closetime_drift() == 70
+    lcl_ct = lm.last_closed_header.scpValue.closeTime
+
+    def level(ct):
+        sv = basic_stellar_value(b"\x00" * 32, ct)
+        return h._validate_value(lm.ledger_seq + 1,
+                                 to_bytes(StellarValue, sv), True)
+
+    now = clock.system_now()
+    assert level(now) != ValidationLevel.INVALID
+    assert level(now - 71) == ValidationLevel.INVALID  # too old
+    assert level(now + 61) == ValidationLevel.INVALID  # too far ahead
+    # derivation path: slots+2 ledgers of cadence, capped at 90
+    cfg.MAXIMUM_LEDGER_CLOSETIME_DRIFT = 0
+    assert h._closetime_drift() == min((h.max_slots_to_remember + 2)
+                                       * h.target_close_seconds, 90)
+
+
+def test_query_thread_pool_size_required():
+    import pytest
+
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.command_handler import QueryServer
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.tx.tx_test_utils import keypair
+
+    cfg = Config()
+    cfg.NODE_SEED = keypair("qp-node")
+    cfg.QUERY_THREAD_POOL_SIZE = 0
+    app = Application(cfg)
+    with pytest.raises(ValueError):
+        QueryServer(app, 0)
+    cfg.QUERY_THREAD_POOL_SIZE = 2
+    q = QueryServer(app, 0)
+    q.stop()
+
+
+def test_inbound_auth_cap_enforced_at_promotion():
+    """MAX_ADDITIONAL_PEER_CONNECTIONS holds at the pending->
+    authenticated transition, not just at accept time (a burst can
+    pass accept together)."""
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.tx.tx_test_utils import keypair
+
+    cfg = Config()
+    cfg.NODE_SEED = keypair("cap-node")
+    cfg.MAX_ADDITIONAL_PEER_CONNECTIONS = 2
+    app = Application(cfg)
+
+    class _FakePeer:
+        def __init__(self, inbound):
+            self.we_called = not inbound
+            self.dropped = None
+            self.remote_node_id = None
+            self.address = None
+
+        def drop(self, reason):
+            self.dropped = reason
+
+        def is_authenticated(self):
+            return True
+
+        def send(self, msg):
+            pass
+
+    inbound = [_FakePeer(True) for _ in range(4)]
+    for p in inbound:
+        app.overlay.add_pending(p)
+    for p in inbound:
+        app.overlay.peer_authenticated(p)
+    kept = [p for p in inbound if p in app.overlay.peers]
+    dropped = [p for p in inbound if p.dropped]
+    assert len(kept) == 2 and len(dropped) == 2
+    # outbound peers are never capped by this knob
+    out = _FakePeer(False)
+    app.overlay.add_pending(out)
+    app.overlay.peer_authenticated(out)
+    assert out in app.overlay.peers
