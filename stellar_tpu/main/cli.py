@@ -536,9 +536,11 @@ def cmd_apply_load(args) -> int:
         stats = multisig_apply_load(n_ledgers=args.ledgers,
                                     txs_per_ledger=args.txs)
     elif args.scenario == "soroban":
-        stats = soroban_apply_load(n_ledgers=args.ledgers,
-                                   txs_per_ledger=args.txs,
-                                   use_wasm=args.wasm)
+        stats = soroban_apply_load(
+            n_ledgers=args.ledgers, txs_per_ledger=args.txs,
+            use_wasm=args.wasm,
+            config=_load_config(args) if getattr(args, "conf", None)
+            else None)
     elif args.scenario == "compute":
         stats = soroban_compute_load(n_ledgers=args.ledgers,
                                      txs_per_ledger=args.txs,

@@ -256,6 +256,17 @@ class Config:
     # synthetic-load shaping (reference LOADGEN_* family): value lists
     # with matching weight lists; the load generator samples them
     # deterministically per tx
+    # apply-load soroban footprint shaping (reference APPLY_LOAD_*
+    # family): extra read-only / read-write data entries per tx,
+    # weighted value lists like the LOADGEN_* distributions
+    APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    APPLY_LOAD_NUM_RO_ENTRIES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    APPLY_LOAD_NUM_RW_ENTRIES_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    APPLY_LOAD_NUM_RW_ENTRIES_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
     LOADGEN_OP_COUNT_FOR_TESTING: List[int] = field(default_factory=list)
     LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
         field(default_factory=list)

@@ -18,6 +18,33 @@ __all__ = ["LoadGenerator", "apply_load"]
 XLM = 10_000_000
 
 
+def weighted_cfg_sample(cfg, prefix: str, default: int,
+                        ordinal: int) -> int:
+    """Weighted sample from {prefix}_FOR_TESTING values with
+    {prefix}_DISTRIBUTION_FOR_TESTING weights (reference LOADGEN_* /
+    APPLY_LOAD_* shaping families). Deterministic in ``ordinal`` so
+    shapes reproduce run to run."""
+    values = getattr(cfg, f"{prefix}_FOR_TESTING", None) \
+        if cfg is not None else None
+    if not values:
+        return default
+    weights = getattr(
+        cfg, f"{prefix}_DISTRIBUTION_FOR_TESTING", None) or \
+        [1] * len(values)
+    if len(weights) != len(values):
+        raise ValueError(f"{prefix} value/weight lengths differ")
+    total = sum(weights)
+    if total <= 0:
+        raise ValueError(f"{prefix} weights sum to zero")
+    pick = (ordinal * 2654435761) % total  # Knuth hash
+    acc = 0
+    for v, w in zip(values, weights):
+        acc += w
+        if pick < acc:
+            return v
+    return values[-1]
+
+
 class LoadGenerator:
     """Paced synthetic traffic through a real herder (reference
     ``LoadGenerator.h:30-49`` modes: CREATE, PAY, PRETEND,
@@ -46,27 +73,9 @@ class LoadGenerator:
         _DISTRIBUTION_FOR_TESTING weight lists (reference LOADGEN_*
         shaping family). Deterministic: the nth submitted tx picks by
         cumulative weight, so load shapes reproduce run to run."""
-        cfg = getattr(self.app, "config", None)
-        values = getattr(cfg, f"LOADGEN_{base}_FOR_TESTING", None) \
-            if cfg is not None else None
-        if not values:
-            return default
-        weights = getattr(
-            cfg, f"LOADGEN_{base}_DISTRIBUTION_FOR_TESTING", None) or \
-            [1] * len(values)
-        if len(weights) != len(values):
-            raise ValueError(f"LOADGEN_{base} value/weight "
-                             "lengths differ")
-        total = sum(weights)
-        if total <= 0:
-            raise ValueError(f"LOADGEN_{base} weights sum to zero")
-        pick = (self.submitted * 2654435761) % total  # Knuth hash
-        acc = 0
-        for v, w in zip(values, weights):
-            acc += w
-            if pick < acc:
-                return v
-        return values[-1]
+        return weighted_cfg_sample(getattr(self.app, "config", None),
+                                   f"LOADGEN_{base}", default,
+                                   self.submitted)
 
     def _next_seq(self, src: SecretKey) -> Optional[int]:
         from stellar_tpu.ledger.ledger_txn import key_bytes
@@ -639,13 +648,15 @@ def multisig_apply_load(n_ledgers: int = 5, txs_per_ledger: int = 1000,
 
 
 def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
-                       use_wasm: bool = False) -> dict:
+                       use_wasm: bool = False, config=None) -> dict:
     """BASELINE config #5: Soroban InvokeHostFunction txs/ledger, each a
     fee-bump outer envelope around an invoke with a signed ed25519 auth
     entry — 3 signatures per tx (outer, inner, auth) through the verify
     path, plus contract execution and footprint/fee accounting.
     ``use_wasm`` runs a genuinely compiled wasm counter (native C++
-    engine when built) instead of the legacy SCVal program."""
+    engine when built) instead of the legacy SCVal program. ``config``
+    shapes per-tx footprints via the APPLY_LOAD_NUM_RO/RW_ENTRIES
+    value/weight lists (reference APPLY_LOAD_* family)."""
     import dataclasses
     from stellar_tpu.crypto.sha import sha256
     from stellar_tpu.ledger.ledger_txn import key_bytes
@@ -763,6 +774,22 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
             src = srcs[t % n_accounts]
             payer = payers[t % n_accounts]
             nonce += 1
+            # APPLY_LOAD_NUM_RO/RW_ENTRIES shaping: pad the declared
+            # footprint with extra data keys (reference APPLY_LOAD_*
+            # family — io-stress knobs for this very harness)
+            n_ro = weighted_cfg_sample(config,
+                                       "APPLY_LOAD_NUM_RO_ENTRIES",
+                                       0, nonce)
+            n_rw = weighted_cfg_sample(config,
+                                       "APPLY_LOAD_NUM_RW_ENTRIES",
+                                       0, nonce)
+            extra_ro = [contract_data_key(
+                addr, sym(f"ro{j}"), ContractDataDurability.TEMPORARY)
+                for j in range(n_ro)]
+            extra_rw = [contract_data_key(
+                addr, sym(f"rw{nonce}x{j}"),
+                ContractDataDurability.TEMPORARY)
+                for j in range(n_rw)]
             invocation = SorobanAuthorizedInvocation(
                 function=SorobanAuthorizedFunction.make(
                     SorobanAuthorizedFunctionType
@@ -806,8 +833,9 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                     [auth])],
                 fee=5_000_200,  # covers the declared resource fee
                 soroban_data=_soroban_data(
-                    read_only=[inst_key, contract_code_key(code_hash)],
-                    read_write=[counter_key, nonce_key]))
+                    read_only=[inst_key, contract_code_key(code_hash)]
+                    + extra_ro, read_write=[counter_key, nonce_key]
+                    + extra_rw))
             # fee-bump outer envelope signed by the payer
             fb = FeeBumpTransaction(
                 feeSource=muxed_account(payer.public_key.raw),

@@ -606,3 +606,31 @@ def test_inbound_auth_cap_enforced_at_promotion():
     app.overlay.add_pending(out)
     app.overlay.peer_authenticated(out)
     assert out in app.overlay.peers
+
+
+def test_apply_load_footprint_shaping_consumed():
+    """APPLY_LOAD_NUM_RO/RW_ENTRIES(+DISTRIBUTION) shape the soroban
+    apply-load scenario's declared footprints per tx."""
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.load_generator import soroban_apply_load
+
+    cfg = Config()
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING = [0, 3]
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_DISTRIBUTION_FOR_TESTING = [1, 1]
+    cfg.APPLY_LOAD_NUM_RW_ENTRIES_FOR_TESTING = [2]
+    r = soroban_apply_load(n_ledgers=1, txs_per_ledger=20,
+                           use_wasm=False, config=cfg)
+    assert r["total_applied"] == 20  # shaped footprints still apply
+
+
+def test_apply_load_shaping_rejects_bad_weights():
+    import pytest
+
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.load_generator import weighted_cfg_sample
+
+    cfg = Config()
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING = [1, 2]
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_DISTRIBUTION_FOR_TESTING = [1]
+    with pytest.raises(ValueError):
+        weighted_cfg_sample(cfg, "APPLY_LOAD_NUM_RO_ENTRIES", 0, 0)

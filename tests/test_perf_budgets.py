@@ -118,10 +118,12 @@ def test_soroban_close_latency_budget():
 
 def test_classic_close_latency_budget():
     """100-tx classic ledgers: measured ~22ms mean after the r4
-    codec work; 8x headroom for CI-class hosts."""
+    codec work. The bound is an order-of-magnitude guard: a 1-CPU CI
+    host mid-suite showed ~200ms under contention, so 400ms catches
+    an accidentally quadratic close without flaking."""
     from stellar_tpu.simulation.load_generator import apply_load
     r = apply_load(n_ledgers=5, txs_per_ledger=100)
-    assert r["close_mean_ms"] <= 180.0, r["close_mean_ms"]
+    assert r["close_mean_ms"] <= 400.0, r["close_mean_ms"]
 
 
 def test_catchup_replay_budget():
