@@ -472,12 +472,12 @@ def cmd_apply_load(args) -> int:
         apply_load, catchup_replay_bench, multisig_apply_load,
         scp_storm_bench, soroban_apply_load, soroban_compute_load,
     )
-    if getattr(args, "conf", None):
+    cfg = _load_config(args) if getattr(args, "conf", None) else None
+    if cfg is not None:
         # APPLY_LOAD_* overrides (reference apply-load reading Config):
         # retune the process-wide soroban limits the scenarios build on
         import dataclasses
         from stellar_tpu.tx.ops import soroban_ops
-        cfg = _load_config(args)
         overrides = {}
         for cfg_name, field_name in (
                 ("APPLY_LOAD_TX_MAX_INSTRUCTIONS",
@@ -538,9 +538,7 @@ def cmd_apply_load(args) -> int:
     elif args.scenario == "soroban":
         stats = soroban_apply_load(
             n_ledgers=args.ledgers, txs_per_ledger=args.txs,
-            use_wasm=args.wasm,
-            config=_load_config(args) if getattr(args, "conf", None)
-            else None)
+            use_wasm=args.wasm, config=cfg)
     elif args.scenario == "compute":
         stats = soroban_compute_load(n_ledgers=args.ledgers,
                                      txs_per_ledger=args.txs,

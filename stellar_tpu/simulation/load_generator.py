@@ -36,7 +36,10 @@ def weighted_cfg_sample(cfg, prefix: str, default: int,
     total = sum(weights)
     if total <= 0:
         raise ValueError(f"{prefix} weights sum to zero")
-    pick = (ordinal * 2654435761) % total  # Knuth hash
+    import zlib
+    # Knuth hash, salted per family so e.g. the RO and RW draws of the
+    # same tx decorrelate (and no mod-parity artifact for small totals)
+    pick = ((ordinal * 2654435761) ^ zlib.crc32(prefix.encode())) % total
     acc = 0
     for v, w in zip(values, weights):
         acc += w
@@ -696,10 +699,22 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
     lm.last_closed_header.maxTxSetSize = max(2000, txs_per_ledger * 2)
     from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
     lm.last_closed_header.ledgerVersion = CURRENT_LEDGER_PROTOCOL_VERSION
-    # per-run raised caps, as a config upgrade would set them
+    # per-run raised caps, as a config upgrade would set them; the
+    # entry limits grow to cover the APPLY_LOAD footprint shaping and
+    # never shrink below what the process defaults (possibly already
+    # patched by the CLI's APPLY_LOAD_TX_MAX_* overrides) allow
+    max_ro_shape = max([0] + list(getattr(
+        config, "APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING", []) or []))
+    max_rw_shape = max([0] + list(getattr(
+        config, "APPLY_LOAD_NUM_RW_ENTRIES_FOR_TESTING", []) or []))
     lm.soroban_config = dataclasses.replace(
         lm.soroban_config, ledger_max_tx_count=max(1000, txs_per_ledger),
-        tx_max_read_ledger_entries=10, tx_max_write_ledger_entries=8)
+        tx_max_read_ledger_entries=max(
+            lm.soroban_config.tx_max_read_ledger_entries,
+            10 + max_ro_shape + max_rw_shape),
+        tx_max_write_ledger_entries=max(
+            lm.soroban_config.tx_max_write_ledger_entries,
+            8 + max_rw_shape))
     lm.root.soroban_config = lm.soroban_config
 
     if use_wasm:
@@ -768,6 +783,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
     close_timer = Timer()
     total = 0
     nonce = 0
+    shaped_entries = 0
     for _ in range(n_ledgers):
         frames = []
         for t in range(txs_per_ledger):
@@ -790,6 +806,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                 addr, sym(f"rw{nonce}x{j}"),
                 ContractDataDurability.TEMPORARY)
                 for j in range(n_rw)]
+            shaped_entries += n_ro + n_rw
             invocation = SorobanAuthorizedInvocation(
                 function=SorobanAuthorizedFunction.make(
                     SorobanAuthorizedFunctionType
@@ -871,6 +888,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
         engine = "scval"
     return {
         "scenario": "soroban",
+        "shaped_footprint_entries": shaped_entries,
         "engine": engine,
         "ledgers": n_ledgers,
         "txs_per_ledger": txs_per_ledger,

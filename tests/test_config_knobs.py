@@ -621,6 +621,18 @@ def test_apply_load_footprint_shaping_consumed():
     r = soroban_apply_load(n_ledgers=1, txs_per_ledger=20,
                            use_wasm=False, config=cfg)
     assert r["total_applied"] == 20  # shaped footprints still apply
+    # the shaping is OBSERVED: every tx adds 2 RW, plus ~half add 3 RO
+    assert r["shaped_footprint_entries"] >= 20 * 2, r
+    assert r["shaped_footprint_entries"] > 20 * 2  # some RO sampled
+    plain = soroban_apply_load(n_ledgers=1, txs_per_ledger=5,
+                               use_wasm=False)
+    assert plain["shaped_footprint_entries"] == 0
+    # large shapes must not trip the footprint caps (they grow to fit)
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING = [12]
+    cfg.APPLY_LOAD_NUM_RO_ENTRIES_DISTRIBUTION_FOR_TESTING = [1]
+    r = soroban_apply_load(n_ledgers=1, txs_per_ledger=5,
+                           use_wasm=False, config=cfg)
+    assert r["total_applied"] == 5
 
 
 def test_apply_load_shaping_rejects_bad_weights():
