@@ -73,6 +73,32 @@ class _HerderSCPDriver(SCPDriver):
 
     # -- values --
 
+    def get_node_weight(self, node_id, qset, is_local: bool) -> int:
+        """Application-specific nomination weights from protocol 22
+        (reference ``HerderSCPDriver::getNodeWeight``,
+        HerderSCPDriver.cpp:1287-1352): a validator's weight is its
+        quality level's weight divided by its home-domain size; falls
+        back to the qset-structural weight below p22, under
+        FORCE_OLD_STYLE_LEADER_ELECTION, with a manual QUORUM_SET, or
+        for nodes outside the declared validator list."""
+        h = self.herder
+        cfg = h.node_config
+        if cfg is None or \
+                getattr(cfg, "FORCE_OLD_STYLE_LEADER_ELECTION", False) \
+                or h.lm.last_closed_header.ledgerVersion < 22:
+            return super().get_node_weight(node_id, qset, is_local)
+        vwc = cfg.validator_weight_config() \
+            if hasattr(cfg, "validator_weight_config") else None
+        if vwc is None:
+            return super().get_node_weight(node_id, qset, is_local)
+        from stellar_tpu.scp.quorum import node_key
+        entry = vwc["entries"].get(node_key(node_id))
+        if entry is None:
+            return super().get_node_weight(node_id, qset, is_local)
+        domain, quality = entry
+        return vwc["quality_weights"][quality] // \
+            vwc["domain_sizes"][domain]
+
     def validate_value(self, slot_index, value, nomination):
         return self.herder._validate_value(slot_index, value, nomination)
 

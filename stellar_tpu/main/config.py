@@ -41,6 +41,9 @@ class Config:
     # reference (Config.cpp:196-204); bounds nominated close times
     # against the local clock in BOTH directions
     MAXIMUM_LEDGER_CLOSETIME_DRIFT: int = 0
+    # disable application-specific (quality-weighted) nomination
+    # leader election even where protocol >= 22 supports it
+    FORCE_OLD_STYLE_LEADER_ELECTION: bool = False
     RUN_STANDALONE: bool = False
     MANUAL_CLOSE: bool = False
 
@@ -359,8 +362,25 @@ class Config:
                 addr = e.get("ADDRESS")
                 if addr and addr not in self.KNOWN_PEERS:
                     self.KNOWN_PEERS.append(addr)
+            # p22 nomination weights exist ONLY when the quorum came
+            # from the declarative form (reference: a manual
+            # QUORUM_SET never populates VALIDATOR_WEIGHT_CONFIG), and
+            # a validator-less node doesn't need them; deriving HERE
+            # makes malformed tables fail at startup, not mid-round
+            object.__setattr__(
+                self, "_vwc_cache",
+                derive_validator_weights(entries)
+                if self.NODE_IS_VALIDATOR else None)
         if self.QUORUM_SET is not None:
             self.validate_quorum(self.QUORUM_SET)
+
+    def validator_weight_config(self) -> Optional[Dict]:
+        """Application-specific nomination weights derived during
+        resolve_quorum, or None when the quorum was configured
+        manually / the node is not a validator (the reference's
+        VALIDATOR_WEIGHT_CONFIG is only populated from the declarative
+        validator form)."""
+        return getattr(self, "_vwc_cache", None)
 
     def validate_quorum(self, qset: SCPQuorumSet) -> None:
         n = len(qset.validators) + len(qset.innerSets)
@@ -384,6 +404,48 @@ class Config:
 
 
 QUALITY_LEVELS = {"LOW": 0, "MEDIUM": 1, "HIGH": 2, "CRITICAL": 3}
+
+
+def derive_validator_weights(entries: List[Dict]) -> Optional[Dict]:
+    """Application-specific nomination weights from the declarative
+    validator list (reference ``ValidatorWeightConfig`` +
+    ``Config::setValidatorWeightConfig``, Config.cpp:2545-2584):
+
+    - the highest present quality level weighs UINT64_MAX,
+    - each level below weighs the level above divided by
+      ((orgs at the level above + 1) * 10),
+    - LOW always weighs 0,
+    - a node's weight is its quality's weight divided by its home
+      domain's validator count.
+
+    Returns {"entries": node_key -> (domain, quality),
+             "domain_sizes": domain -> count,
+             "quality_weights": quality -> weight} or None when no
+    validators are configured."""
+    if not entries:
+        return None
+    from stellar_tpu.scp.quorum import node_key
+    U64 = 0xFFFFFFFFFFFFFFFF
+    by_key = {}
+    domain_sizes: Dict[str, int] = {}
+    domains_by_quality: Dict[int, set] = {}
+    lo, hi = min(QUALITY_LEVELS.values()), max(QUALITY_LEVELS.values())
+    lowest, highest = hi, lo
+    for e in entries:
+        by_key[node_key(e["KEY"])] = (e["HOME_DOMAIN"], e["QUALITY"])
+        domain_sizes[e["HOME_DOMAIN"]] = \
+            domain_sizes.get(e["HOME_DOMAIN"], 0) + 1
+        domains_by_quality.setdefault(e["QUALITY"], set()).add(
+            e["HOME_DOMAIN"])
+        lowest = min(lowest, e["QUALITY"])
+        highest = max(highest, e["QUALITY"])
+    weights = {highest: U64}
+    for q in range(highest - 1, lowest - 1, -1):
+        higher_orgs = len(domains_by_quality.get(q + 1, ())) + 1
+        weights[q] = weights[q + 1] // (higher_orgs * 10)
+    weights[QUALITY_LEVELS["LOW"]] = 0
+    return {"entries": by_key, "domain_sizes": domain_sizes,
+            "quality_weights": weights}
 
 
 def parse_validators(validators: List[Dict],

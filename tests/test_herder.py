@@ -220,3 +220,108 @@ def test_tx_queue_eviction_never_orphans_own_chain():
     # queue full (2 ops); t3 must NOT evict its own predecessors
     assert q.try_add(t3).code == AddResult.ADD_STATUS_TRY_AGAIN_LATER
     assert len(q.get_transactions()) == 2
+
+
+# ---------------------------------------------------------------------------
+# application-specific nomination weights (p22 leader election)
+# ---------------------------------------------------------------------------
+
+
+def _vwc_fixture():
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.tx.tx_test_utils import keypair
+
+    ks = {name: keypair(f"vwc-{name}")
+          for name in ("h1", "h2", "h3", "m1", "m2", "l1")}
+    cfg = Config()
+    cfg.NODE_SEED = ks["h1"]
+    cfg.HOME_DOMAINS = [
+        {"HOME_DOMAIN": "orgA", "QUALITY": "HIGH"},
+        {"HOME_DOMAIN": "orgC", "QUALITY": "MEDIUM"},
+        {"HOME_DOMAIN": "orgD", "QUALITY": "LOW"},
+    ]
+    cfg.VALIDATORS = [  # HIGH domains need >= 3 validators
+        {"NAME": "h1", "PUBLIC_KEY": ks["h1"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgA"},
+        {"NAME": "h2", "PUBLIC_KEY": ks["h2"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgA"},
+        {"NAME": "h3", "PUBLIC_KEY": ks["h3"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgA"},
+        {"NAME": "m1", "PUBLIC_KEY": ks["m1"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgC"},
+        {"NAME": "m2", "PUBLIC_KEY": ks["m2"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgC"},
+        {"NAME": "l1", "PUBLIC_KEY": ks["l1"].public_key.to_strkey(),
+         "HOME_DOMAIN": "orgD"},
+    ]
+    return cfg, ks
+
+
+def test_validator_weight_derivation():
+    """Reference Config.cpp:2545-2584: highest quality = U64_MAX; each
+    level below = above / ((orgs above + 1) * 10); LOW = 0; node
+    weight = quality weight / home-domain size."""
+    from stellar_tpu.main.config import QUALITY_LEVELS
+
+    cfg, _ = _vwc_fixture()
+    cfg.UNSAFE_QUORUM = True
+    cfg.resolve_quorum()  # weights derive at startup, with validation
+    vwc = cfg.validator_weight_config()
+    U = 0xFFFFFFFFFFFFFFFF
+    w = vwc["quality_weights"]
+    assert w[QUALITY_LEVELS["HIGH"]] == U
+    # one HIGH org (+1 virtual) * 10 divides the level below
+    assert w[QUALITY_LEVELS["MEDIUM"]] == U // 20
+    assert w[QUALITY_LEVELS["LOW"]] == 0
+    assert vwc["domain_sizes"] == {"orgA": 3, "orgC": 2, "orgD": 1}
+    # a MANUAL quorum set never gets application-specific weights
+    cfg2, _ = _vwc_fixture()
+    cfg2.UNSAFE_QUORUM = True
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+    cfg2.QUORUM_SET = SCPQuorumSet(
+        threshold=1,
+        validators=[make_node_id(cfg2.NODE_SEED.public_key.raw)],
+        innerSets=[])
+    cfg2.resolve_quorum()
+    assert cfg2.validator_weight_config() is None
+
+
+def test_driver_node_weight_uses_quality_config():
+    from stellar_tpu.herder.herder import Herder
+    from stellar_tpu.ledger.ledger_manager import LedgerManager
+    from stellar_tpu.main.config import QUALITY_LEVELS
+    from stellar_tpu.scp.quorum import make_node_id
+    from stellar_tpu.utils.timer import VirtualClock
+
+    cfg, ks = _vwc_fixture()
+    cfg.UNSAFE_QUORUM = True  # the tiny fixture quorum tolerates 0
+    cfg.resolve_quorum()
+    lm = LedgerManager(b"\x07" * 32)
+    lm.last_closed_header.ledgerVersion = 23
+    h = Herder(ks["h1"], b"\x07" * 32, lm, VirtualClock(),
+               cfg.QUORUM_SET, node_config=cfg)
+    U = 0xFFFFFFFFFFFFFFFF
+    qset = cfg.QUORUM_SET
+
+    def w(name):
+        return h.driver.get_node_weight(
+            make_node_id(ks[name].public_key.raw), qset, False)
+    assert w("h1") == U // 3      # HIGH, orgA has 3 validators
+    assert w("h3") == U // 3
+    assert w("m1") == (U // 20) // 2
+    assert w("l1") == 0
+    # out-of-list nodes fall back to the structural weight
+    from stellar_tpu.tx.tx_test_utils import keypair
+    stranger = make_node_id(keypair("vwc-x").public_key.raw)
+    import stellar_tpu.scp.driver as drv
+    assert h.driver.get_node_weight(stranger, qset, False) == \
+        drv.SCPDriver.get_node_weight(h.driver, stranger, qset, False)
+    # FORCE_OLD_STYLE and pre-p22 both fall back for listed nodes
+    cfg.FORCE_OLD_STYLE_LEADER_ELECTION = True
+    assert w("h3") == drv.SCPDriver.get_node_weight(
+        h.driver, make_node_id(ks["h3"].public_key.raw), qset, False)
+    cfg.FORCE_OLD_STYLE_LEADER_ELECTION = False
+    lm.last_closed_header.ledgerVersion = 21
+    assert w("h3") == drv.SCPDriver.get_node_weight(
+        h.driver, make_node_id(ks["h3"].public_key.raw), qset, False)
