@@ -270,6 +270,10 @@ class Config:
         field(default_factory=list)
     APPLY_LOAD_NUM_RW_ENTRIES_DISTRIBUTION_FOR_TESTING: List[int] = \
         field(default_factory=list)
+    APPLY_LOAD_EVENT_COUNT_FOR_TESTING: List[int] = \
+        field(default_factory=list)
+    APPLY_LOAD_EVENT_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
+        field(default_factory=list)
     LOADGEN_OP_COUNT_FOR_TESTING: List[int] = field(default_factory=list)
     LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
         field(default_factory=list)

@@ -707,6 +707,8 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
         config, "APPLY_LOAD_NUM_RO_ENTRIES_FOR_TESTING", []) or []))
     max_rw_shape = max([0] + list(getattr(
         config, "APPLY_LOAD_NUM_RW_ENTRIES_FOR_TESTING", []) or []))
+    max_ev_shape = max([0] + list(getattr(
+        config, "APPLY_LOAD_EVENT_COUNT_FOR_TESTING", []) or []))
     lm.soroban_config = dataclasses.replace(
         lm.soroban_config, ledger_max_tx_count=max(1000, txs_per_ledger),
         tx_max_read_ledger_entries=max(
@@ -714,34 +716,62 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
             10 + max_ro_shape + max_rw_shape),
         tx_max_write_ledger_entries=max(
             lm.soroban_config.tx_max_write_ledger_entries,
-            8 + max_rw_shape))
+            8 + max_rw_shape),
+        tx_max_contract_events_size_bytes=max(
+            lm.soroban_config.tx_max_contract_events_size_bytes,
+            (max_ev_shape + 2) * 128),
+        tx_max_instructions=max(
+            lm.soroban_config.tx_max_instructions,
+            2_000_000 + 8_000 * max_ev_shape))
     lm.root.soroban_config = lm.soroban_config
 
     if use_wasm:
         from stellar_tpu.soroban.example_contracts import counter_wasm
-        code = counter_wasm()  # auth_incr(addr): same ABI as below
+        # the burst export is only compiled in when shaping asks for
+        # it: the unshaped contract stays byte-identical (golden metas
+        # pin its code hash)
+        code = counter_wasm(with_burst=max_ev_shape > 0)
     else:
         # same semantic workload as the wasm counter (auth + has/get/
         # put + an ``incr`` event with the new count) so the two
         # benchmark rows compare engines, not contracts
-        code = assemble_program({
-            "auth_incr": [
-                ins("arg", u32(0)), ins("require_auth"),
-                ins("push", sym("count")), ins("has", sym("persistent")),
-                ins("jz", u32(3)),
-                ins("push", sym("count")), ins("get", sym("persistent")),
-                ins("jmp", u32(1)),
-                ins("push", u32(0)),
-                ins("push", u32(1)), ins("add"),
+        _incr_body = [
+            ins("arg", u32(0)), ins("require_auth"),
+            ins("push", sym("count")), ins("has", sym("persistent")),
+            ins("jz", u32(3)),
+            ins("push", sym("count")), ins("get", sym("persistent")),
+            ins("jmp", u32(1)),
+            ins("push", u32(0)),
+            ins("push", u32(1)), ins("add"),
+            ins("dup"),
+            ins("push", sym("count")), ins("swap"),
+            ins("put", sym("persistent")),
+            ins("dup"),
+            ins("push", sym("incr")), ins("swap"),
+            ins("event"),
+        ]
+        fns = {"auth_incr": _incr_body + [ins("ret")]}
+        if max_ev_shape > 0:
+            # auth_incr + k extra events (APPLY_LOAD_EVENT_COUNT
+            # shaping): loop on arg 1 emitting ("burst", k) events.
+            # Only added when shaping is configured, so the UNSHAPED
+            # benchmark contract stays byte-identical (golden metas
+            # pin its code hash)
+            fns["auth_incr_burst"] = _incr_body + [
+                ins("arg", u32(1)),                  # [nv, k]
+                ins("dup"),                          # loop top
+                ins("jz", u32(7)),                   # k==0 -> drop
                 ins("dup"),
-                ins("push", sym("count")), ins("swap"),
-                ins("put", sym("persistent")),
-                ins("dup"),
-                ins("push", sym("incr")), ins("swap"),
-                ins("event"),
+                ins("push", sym("burst")),
+                ins("swap"),
+                ins("event"),                        # [nv, k]
+                ins("push", u32(1)),
+                ins("sub"),                          # [nv, k-1]
+                ins("jmp", SCVal.make(T.SCV_I32, -9)),
+                ins("drop"),
                 ins("ret"),
-            ],
-        })
+            ]
+        code = assemble_program(fns)
     code_hash = sha256(code)
     owner = srcs[0]
     seqs = {k.public_key.raw: (1 << 32) for k in srcs + payers}
@@ -784,6 +814,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
     total = 0
     nonce = 0
     shaped_entries = 0
+    shaped_events = 0
     for _ in range(n_ledgers):
         frames = []
         for t in range(txs_per_ledger):
@@ -807,13 +838,30 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                 ContractDataDurability.TEMPORARY)
                 for j in range(n_rw)]
             shaped_entries += n_ro + n_rw
+            # APPLY_LOAD_EVENT_COUNT shaping: k extra events per tx
+            # via the burst variant (auth payload covers fn + args)
+            n_ev = weighted_cfg_sample(config, "APPLY_LOAD_EVENT_COUNT",
+                                       0, nonce)
+            if n_ev > 0:
+                fn_name = b"auth_incr_burst"
+                fn_args = [SCVal.make(T.SCV_ADDRESS, addr_signer),
+                           u32(n_ev)]
+                shaped_events += n_ev
+                # the scval interpreter charges ~5k budget cpu per
+                # burst iteration; declare instructions to match so
+                # the knob behaves identically on both engines
+                extra_insns = 8_000 * n_ev
+            else:
+                fn_name = b"auth_incr"
+                fn_args = [SCVal.make(T.SCV_ADDRESS, addr_signer)]
+                extra_insns = 0
             invocation = SorobanAuthorizedInvocation(
                 function=SorobanAuthorizedFunction.make(
                     SorobanAuthorizedFunctionType
                     .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
                     InvokeContractArgs(
-                        contractAddress=addr, functionName=b"auth_incr",
-                        args=[SCVal.make(T.SCV_ADDRESS, addr_signer)])),
+                        contractAddress=addr, functionName=fn_name,
+                        args=fn_args)),
                 subInvocations=[])
             expiry = lm.ledger_seq + 1000
             payload = auth_payload_hash(TEST_NETWORK_ID, nonce, expiry,
@@ -845,14 +893,15 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                 [_soroban_op(HostFunction.make(
                     HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
                     InvokeContractArgs(
-                        contractAddress=addr, functionName=b"auth_incr",
-                        args=[SCVal.make(T.SCV_ADDRESS, addr_signer)])),
+                        contractAddress=addr, functionName=fn_name,
+                        args=fn_args)),
                     [auth])],
                 fee=5_000_200,  # covers the declared resource fee
                 soroban_data=_soroban_data(
                     read_only=[inst_key, contract_code_key(code_hash)]
                     + extra_ro, read_write=[counter_key, nonce_key]
-                    + extra_rw))
+                    + extra_rw,
+                    instructions=2_000_000 + extra_insns))
             # fee-bump outer envelope signed by the payer
             fb = FeeBumpTransaction(
                 feeSource=muxed_account(payer.public_key.raw),
@@ -889,6 +938,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
     return {
         "scenario": "soroban",
         "shaped_footprint_entries": shaped_entries,
+        "shaped_extra_events": shaped_events,
         "engine": engine,
         "ledgers": n_ledgers,
         "txs_per_ledger": txs_per_ledger,

@@ -25,7 +25,7 @@ _SYM_INCR = sym_to_small(b"incr")
 _T_PERSISTENT = _u32val(1)  # storage-type code: persistent
 
 
-def counter_wasm() -> bytes:
+def counter_wasm(with_burst: bool = False) -> bytes:
     """The counter contract as a real wasm module.
 
     Exports:
@@ -100,6 +100,34 @@ def counter_wasm() -> bytes:
     c.loop(0x40).br(0).end()
     c.i64_const(TAG_VOID).end()
     b.add_func([], [I64], [], c, export="spin")
+
+    if with_burst:
+        # auth_incr_burst(addr, k) -> auth_incr + k extra ("burst",
+        # countdown) events (the wasm twin of the scval variant;
+        # APPLY_LOAD_EVENT_COUNT shaping). Appended AFTER the default
+        # exports so the with_burst=False bytes — whose code hash the
+        # golden metas pin — are untouched. local2 = remaining count,
+        # local3 = incr result
+        c = Code()
+        c.local_get(0).call(auth_fn).drop()
+        c.call(incr_idx).local_set(3)
+        c.local_get(1).i64_const(8).i64_shr_u().local_set(2)  # raw k
+        c.block(0x40)
+        c.local_get(2).i64_eqz().br_if(0)
+        c.loop(0x40)
+        c.call(vec_new_fn).i64_const(sym_to_small(b"burst"))
+        c.call(vec_push_fn)
+        # data = current countdown as a U32 val (the scval twin)
+        c.local_get(2).i64_const(8).i64_shl()
+        c.i64_const(TAG_U32).i64_or()
+        c.call(event_fn).drop()
+        c.local_get(2).i64_const(1).i64_sub().local_tee(2)
+        c.i64_const(0).i64_ne().br_if(0)
+        c.end()
+        c.end()
+        c.local_get(3).end()
+        b.add_func([I64, I64], [I64], [I64, I64], c,
+                   export="auth_incr_burst")
 
     return b.build()
 

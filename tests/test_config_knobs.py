@@ -646,3 +646,34 @@ def test_apply_load_shaping_rejects_bad_weights():
     cfg.APPLY_LOAD_NUM_RO_ENTRIES_DISTRIBUTION_FOR_TESTING = [1]
     with pytest.raises(ValueError):
         weighted_cfg_sample(cfg, "APPLY_LOAD_NUM_RO_ENTRIES", 0, 0)
+
+
+def test_apply_load_event_count_shaping_both_engines():
+    """APPLY_LOAD_EVENT_COUNT(+DISTRIBUTION): per-tx extra events via
+    the burst contract variant, identical on both engines."""
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.load_generator import soroban_apply_load
+
+    cfg = Config()
+    cfg.APPLY_LOAD_EVENT_COUNT_FOR_TESTING = [3]
+    for use_wasm in (False, True):
+        r = soroban_apply_load(n_ledgers=1, txs_per_ledger=10,
+                               use_wasm=use_wasm, config=cfg)
+        assert r["total_applied"] == 10, (use_wasm, r)
+        assert r["shaped_extra_events"] == 30, (use_wasm, r)
+        assert r["counter_value"] == 10  # the counter still advanced
+
+
+def test_apply_load_large_event_shape_identical_on_both_engines():
+    """A large event shape must not diverge between engines (the scval
+    interpreter's per-iteration budget cost is declared for)."""
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.load_generator import soroban_apply_load
+
+    cfg = Config()
+    cfg.APPLY_LOAD_EVENT_COUNT_FOR_TESTING = [500]
+    for use_wasm in (False, True):
+        r = soroban_apply_load(n_ledgers=1, txs_per_ledger=3,
+                               use_wasm=use_wasm, config=cfg)
+        assert r["total_applied"] == 3, (use_wasm, r)
+        assert r["shaped_extra_events"] == 1500
