@@ -274,6 +274,18 @@ class Config:
         field(default_factory=list)
     APPLY_LOAD_EVENT_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
         field(default_factory=list)
+    # synthetic bucket-list prefill before apply-load scenarios
+    # (reference APPLY_LOAD_BL_* family, ApplyLoad.cpp:316-355): write
+    # a batch of contract-data+TTL entries every WRITE_FREQUENCY of
+    # SIMULATED_LEDGERS addBatch calls, with the final
+    # LAST_BATCH_LEDGERS each writing LAST_BATCH_SIZE entries so the
+    # top levels are populated too. 0 simulated ledgers = off (the
+    # reference defaults engage only for its bucket-list scenario).
+    APPLY_LOAD_BL_SIMULATED_LEDGERS: int = 0
+    APPLY_LOAD_BL_WRITE_FREQUENCY: int = 1000
+    APPLY_LOAD_BL_BATCH_SIZE: int = 1000
+    APPLY_LOAD_BL_LAST_BATCH_LEDGERS: int = 300
+    APPLY_LOAD_BL_LAST_BATCH_SIZE: int = 100
     LOADGEN_OP_COUNT_FOR_TESTING: List[int] = field(default_factory=list)
     LOADGEN_OP_COUNT_DISTRIBUTION_FOR_TESTING: List[int] = \
         field(default_factory=list)

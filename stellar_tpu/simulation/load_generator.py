@@ -650,6 +650,67 @@ def multisig_apply_load(n_ledgers: int = 5, txs_per_ledger: int = 1000,
     }
 
 
+def _prefill_bucket_list(lm, config) -> int:
+    """Synthetic bucket-list prefill (reference APPLY_LOAD_BL_*,
+    ApplyLoad.cpp:316-355): every WRITE_FREQUENCYth of
+    SIMULATED_LEDGERS addBatch calls writes BATCH_SIZE contract-data +
+    TTL entry pairs (LAST_BATCH_SIZE for each of the final
+    LAST_BATCH_LEDGERS), building a deep, realistically-leveled list
+    before the benchmark. Returns the number of entries written."""
+    sim = getattr(config, "APPLY_LOAD_BL_SIMULATED_LEDGERS", 0) \
+        if config is not None else 0
+    if not sim or lm.bucket_list is None:
+        return 0
+    from stellar_tpu.ledger.ledger_txn import entry_to_key, key_bytes
+    from stellar_tpu.soroban.host import (
+        _wrap_entry, scaddress_contract, ttl_key_for,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, ContractDataEntry, SCVal, SCValType,
+    )
+    from stellar_tpu.xdr.types import (
+        ExtensionPoint, LedgerEntryType, TTLEntry,
+    )
+    freq = max(1, config.APPLY_LOAD_BL_WRITE_FREQUENCY)
+    batch = config.APPLY_LOAD_BL_BATCH_SIZE
+    last_n = config.APPLY_LOAD_BL_LAST_BATCH_LEDGERS
+    last_sz = config.APPLY_LOAD_BL_LAST_BATCH_SIZE
+    addr = scaddress_contract(b"\x42" * 32)
+    T = SCValType
+    seq = lm.ledger_seq
+    version = lm.last_closed_header.ledgerVersion
+    current_key = 0
+    for i in range(sim):
+        seq += 1
+        init = []
+        is_last = i >= sim - last_n
+        if i % freq == 0 or is_last:
+            for _ in range(last_sz if is_last else batch):
+                key_sc = SCVal.make(T.SCV_U64, current_key)
+                current_key += 1
+                de = ContractDataEntry(
+                    ext=ExtensionPoint.make(0), contract=addr,
+                    key=key_sc,
+                    durability=ContractDataDurability.PERSISTENT,
+                    val=SCVal.make(T.SCV_U64, 0))
+                le = _wrap_entry(LedgerEntryType.CONTRACT_DATA, de, seq)
+                ttl = _wrap_entry(
+                    LedgerEntryType.TTL,
+                    TTLEntry(keyHash=ttl_key_for(
+                        entry_to_key(le)).value.keyHash,
+                             liveUntilLedgerSeq=1_000_000_000), seq)
+                init.append(le)
+                init.append(ttl)
+                # live state and the bucket list must agree: the next
+                # close's header commits a bucketListHash that point
+                # reads (and a bucket-restored node) must match
+                lm.root.store.put(key_bytes(entry_to_key(le)), le)
+                lm.root.store.put(key_bytes(entry_to_key(ttl)), ttl)
+        lm.bucket_list.add_batch(seq, version, init, [], [])
+    lm.last_closed_header.ledgerSeq = seq
+    return current_key
+
+
 def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
                        use_wasm: bool = False, config=None) -> dict:
     """BASELINE config #5: Soroban InvokeHostFunction txs/ledger, each a
@@ -724,6 +785,7 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
             lm.soroban_config.tx_max_instructions,
             2_000_000 + 8_000 * max_ev_shape))
     lm.root.soroban_config = lm.soroban_config
+    prefilled = _prefill_bucket_list(lm, config)
 
     if use_wasm:
         from stellar_tpu.soroban.example_contracts import counter_wasm
@@ -939,6 +1001,11 @@ def soroban_apply_load(n_ledgers: int = 3, txs_per_ledger: int = 500,
         "scenario": "soroban",
         "shaped_footprint_entries": shaped_entries,
         "shaped_extra_events": shaped_events,
+        "bl_prefilled_entries": prefilled,
+        "bl_deep_levels": sum(
+            1 for lev in lm.bucket_list.levels
+            if not (lev.curr.is_empty() and lev.snap.is_empty()))
+        if lm.bucket_list is not None else 0,
         "engine": engine,
         "ledgers": n_ledgers,
         "txs_per_ledger": txs_per_ledger,

@@ -677,3 +677,34 @@ def test_apply_load_large_event_shape_identical_on_both_engines():
                                use_wasm=use_wasm, config=cfg)
         assert r["total_applied"] == 3, (use_wasm, r)
         assert r["shaped_extra_events"] == 1500
+
+
+def test_apply_load_bl_prefill_builds_deep_list():
+    """APPLY_LOAD_BL_* family: synthetic entries prefill the bucket
+    list (reaching beyond level 0) before the scenario closes, and the
+    workload still applies on top."""
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.load_generator import soroban_apply_load
+
+    cfg = Config()
+    cfg.APPLY_LOAD_BL_SIMULATED_LEDGERS = 40
+    cfg.APPLY_LOAD_BL_WRITE_FREQUENCY = 4
+    cfg.APPLY_LOAD_BL_BATCH_SIZE = 5
+    cfg.APPLY_LOAD_BL_LAST_BATCH_LEDGERS = 6
+    cfg.APPLY_LOAD_BL_LAST_BATCH_SIZE = 2
+    r = soroban_apply_load(n_ledgers=1, txs_per_ledger=5,
+                           use_wasm=False, config=cfg)
+    assert r["total_applied"] == 5
+    # 40 ledgers / freq 4 => 10 write ledgers, minus the overlap with
+    # the last 6 (those write 2 each): ceil-count the exact total
+    writes = sum(
+        (cfg.APPLY_LOAD_BL_LAST_BATCH_SIZE
+         if i >= 40 - cfg.APPLY_LOAD_BL_LAST_BATCH_LEDGERS
+         else cfg.APPLY_LOAD_BL_BATCH_SIZE)
+        for i in range(40)
+        if i % 4 == 0 or i >= 40 - cfg.APPLY_LOAD_BL_LAST_BATCH_LEDGERS)
+    assert r["bl_prefilled_entries"] == writes
+    assert r["bl_deep_levels"] >= 2  # entries actually spilled down
+    plain = soroban_apply_load(n_ledgers=1, txs_per_ledger=3,
+                               use_wasm=False)
+    assert plain["bl_prefilled_entries"] == 0
