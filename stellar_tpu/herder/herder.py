@@ -185,6 +185,11 @@ class Herder:
         self.waiting_envelopes: Dict[bytes, List[SCPEnvelope]] = {}
         # envelopes waiting for an unknown quorum set
         self.waiting_for_qset: Dict[bytes, List[SCPEnvelope]] = {}
+        # background quorum-intersection analysis state (reference
+        # mLastQuorumMapIntersectionState)
+        self._qic_last_hash: bytes = b""
+        self._qic_inflight = None
+        self.latest_quorum_intersection: Optional[dict] = None
         # fetch hooks (wired by the overlay): ask peers for missing items
         self.request_tx_set: Callable = lambda h: None
         self.request_quorum_set: Callable = lambda h: None
@@ -678,11 +683,70 @@ class Herder:
         for key in [k for k in self._timers if k[0] < keep_from]:
             self._timers.pop(key).cancel()
         self._gc_tx_sets()
+        self._maybe_reanalyze_quorum_map()
         if self.on_externalized is not None:
             self.on_externalized(slot_index, result)
         # pace the next ledger to the target cadence
         elapsed = self.clock.now() - self._last_trigger_at
         self._arm_trigger(max(0.0, self.target_close_seconds - elapsed))
+
+    def _maybe_reanalyze_quorum_map(self):
+        """Reference ``checkAndMaybeReanalyzeQuorumMap``
+        (HerderImpl.cpp:1852-1905): when QUORUM_INTERSECTION_CHECKER
+        is on and the tracked quorum map changed since the last
+        analysis, re-run the bounded intersection check off-crank and
+        remember the result (``latest_quorum_intersection``; a
+        detected split is logged as an error)."""
+        if self.node_config is None or not getattr(
+                self.node_config, "QUORUM_INTERSECTION_CHECKER", False):
+            return
+        from stellar_tpu.utils import workers
+        if not workers.background_enabled():
+            # the bounded search can still cost millions of sat calls;
+            # the reference only ever runs it off-thread, so inline
+            # (deterministic/pessimized) modes skip it rather than
+            # stall externalize
+            return
+        from stellar_tpu.crypto.sha import sha256
+        from stellar_tpu.herder.quorum_tracker import QuorumTracker
+        from stellar_tpu.xdr.scp import quorum_set_hash
+        # SNAPSHOT on the crank thread: the worker must never touch
+        # live herder state (workers contract: pure fn over immutable
+        # inputs); the hash covers the node->qset ASSIGNMENT, not just
+        # the learned-qset set (reference hashes the tracked map)
+        qmap = QuorumTracker(self).node_qset_map()
+        qmap_hash = sha256(b"".join(
+            n + (quorum_set_hash(q) if q is not None else b"\x00" * 32)
+            for n, q in sorted(qmap.items())))
+        if qmap_hash == self._qic_last_hash or \
+                self._qic_inflight is not None:
+            return
+        self._qic_last_hash = qmap_hash
+
+        def run():
+            return QuorumTracker(self).analyze(qmap=qmap)
+
+        fut = workers.run_async(run)
+        self._qic_inflight = fut
+
+        def done(f):
+            self._qic_inflight = None
+            try:
+                out = f.result()
+            except Exception as e:
+                import logging
+                logging.getLogger("stellar_tpu.herder").warning(
+                    "quorum intersection analysis failed: %s", e)
+                # retry on the next externalize
+                self._qic_last_hash = b""
+                return
+            self.latest_quorum_intersection = out
+            if out.get("intersection") is False:
+                import logging
+                logging.getLogger("stellar_tpu.herder").error(
+                    "POSSIBLE QUORUM SPLIT detected: %s",
+                    out.get("split"))
+        fut.add_done_callback(done)
 
     def _gc_tx_sets(self):
         """Keep only tx sets referenced by live slots' values."""

@@ -86,14 +86,17 @@ class QuorumTracker:
                         frontier.append(dep)
         return out
 
-    def analyze(self) -> dict:
+    def analyze(self, qmap=None) -> dict:
         """The ``quorum`` endpoint's transitive section (reference
         ``HerderImpl::getJsonTransitiveQuorumInfo``). Node ids use the
         same 16-hex-char short form as the endpoint's validator list.
         ``intersection`` is None when the closure is incomplete, too
         large, or the bounded search ran out of budget; ``split`` gives
-        a counterexample when intersection is False."""
-        qmap = self.node_qset_map()
+        a counterexample when intersection is False. Pass a
+        pre-snapshotted ``qmap`` to analyze off the main thread (the
+        live herder state must only be read on the crank)."""
+        if qmap is None:
+            qmap = self.node_qset_map()
         unknown = [n for n, q in qmap.items() if q is None]
         out = {
             "node_count": len(qmap),

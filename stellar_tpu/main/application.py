@@ -647,7 +647,9 @@ class Application:
                       "pending_count": len(self.overlay.pending_peers)},
             "quorum": {"node": self.config.NODE_SEED.public_key
                        .to_strkey(),
-                       "home_domain": self.config.NODE_HOME_DOMAIN},
+                       "home_domain": self.config.NODE_HOME_DOMAIN,
+                       "intersection":
+                           self.herder.latest_quorum_intersection},
             "network": self.config.NETWORK_PASSPHRASE,
             "protocol_version": lcl.ledgerVersion,
             "version": self.config.VERSION_STR or "stellar_tpu",

@@ -44,6 +44,10 @@ class Config:
     # disable application-specific (quality-weighted) nomination
     # leader election even where protocol >= 22 supports it
     FORCE_OLD_STYLE_LEADER_ELECTION: bool = False
+    # re-run the bounded quorum-intersection analysis off-crank when
+    # the tracked quorum map changes (reference
+    # checkAndMaybeReanalyzeQuorumMap); result lands in info()
+    QUORUM_INTERSECTION_CHECKER: bool = True
     RUN_STANDALONE: bool = False
     MANUAL_CLOSE: bool = False
 

@@ -325,3 +325,40 @@ def test_driver_node_weight_uses_quality_config():
     lm.last_closed_header.ledgerVersion = 21
     assert w("h3") == drv.SCPDriver.get_node_weight(
         h.driver, make_node_id(ks["h3"].public_key.raw), qset, False)
+
+
+def test_background_quorum_intersection_recheck():
+    """QUORUM_INTERSECTION_CHECKER: externalizing with a changed
+    quorum map re-runs the bounded analysis (off-crank pure compute,
+    inline in deterministic mode) and records the result; the flag
+    off means no analysis."""
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.simulation.simulation import Topologies
+    from stellar_tpu.tx.tx_test_utils import keypair
+
+    funded = [(keypair("qic-a"), 10_000 * 10_000_000)]
+    sim = Topologies.core4(accounts=funded)
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    assert sim.crank_until_ledger(apps[0].lm.ledger_seq + 2, 120)
+    out = apps[0].herder.latest_quorum_intersection
+    assert out is not None and out.get("intersection") is True, out
+
+    # flag OFF: a second network externalizes without ever analyzing
+    sim2 = Topologies.core4(accounts=[(keypair("qic-b"),
+                                       10_000 * 10_000_000)])
+    for app in sim2.nodes.values():
+        app.config.QUORUM_INTERSECTION_CHECKER = False
+    sim2.start_all_nodes()
+    apps2 = list(sim2.nodes.values())
+    assert sim2.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3
+                    for x in apps2), 30)
+    assert sim2.crank_until_ledger(apps2[0].lm.ledger_seq + 2, 120)
+    h2 = apps2[0].herder
+    assert h2.latest_quorum_intersection is None
+    assert h2._qic_last_hash == b""
+    assert Config().QUORUM_INTERSECTION_CHECKER is True  # default on
