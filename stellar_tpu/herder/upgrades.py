@@ -96,14 +96,11 @@ class Upgrades:
     def __init__(self, params: Optional[UpgradeParameters] = None,
                  max_protocol: int = CURRENT_LEDGER_PROTOCOL_VERSION):
         self.params = params or UpgradeParameters()
-        # the state-archival protocol is unreachable until the hot
-        # archive is header-committed and catchup-reconstructible
-        # (bucket/hot_archive.py gate) — clamp even explicit overrides
-        from stellar_tpu.bucket.hot_archive import (
-            STATE_ARCHIVAL_PROTOCOL_VERSION,
-        )
-        self.max_protocol = min(max_protocol,
-                                STATE_ARCHIVAL_PROTOCOL_VERSION - 1)
+        # upgrades may carry any version up to what this build speaks;
+        # the state-archival protocol became reachable once the hot
+        # archive was header-committed and catchup-reconstructible
+        # (p23 commitment + MINIMAL/replay reconstruction, r4)
+        self.max_protocol = max_protocol
 
     # ---------------- validation ----------------
 

@@ -301,3 +301,37 @@ def test_config_upgrade_survives_restart(tmp_path):
     # untouched settings keep their initial values
     assert lm2.soroban_config.ledger_max_tx_count == \
         lm.soroban_config.ledger_max_tx_count
+
+
+def test_protocol_version_upgrade_through_consensus():
+    """All validators vote LEDGER_UPGRADE_VERSION p22 -> p23 through
+    real consensus: every node adopts v23 and the headers (now
+    carrying the combined live+hot bucket commitment) stay identical
+    across the network."""
+    from stellar_tpu.bucket.hot_archive import (
+        STATE_ARCHIVAL_PROTOCOL_VERSION, header_bucket_list_hash,
+    )
+    sim = Topologies.core4(accounts=[(keypair("pv-rich"), 1000 * XLM)])
+    for app in sim.nodes.values():
+        app.lm.last_closed_header.ledgerVersion = \
+            STATE_ARCHIVAL_PROTOCOL_VERSION - 1
+    sim.start_all_nodes()
+    apps = list(sim.nodes.values())
+    assert sim.crank_until(
+        lambda: all(x.overlay.authenticated_count() >= 3 for x in apps),
+        30)
+    for app in apps:
+        app.herder.upgrades.params = UpgradeParameters(
+            upgrade_time=0,
+            protocol_version=STATE_ARCHIVAL_PROTOCOL_VERSION)
+    target = apps[0].lm.ledger_seq + 3
+    assert sim.crank_until_ledger(target, timeout=300)
+    assert sim.in_consensus()
+    for app in apps:
+        h = app.lm.last_closed_header
+        assert h.ledgerVersion == STATE_ARCHIVAL_PROTOCOL_VERSION
+        # the post-upgrade header commits to the COMBINED hash
+        assert h.bucketListHash == header_bucket_list_hash(
+            app.lm.bucket_list.hash(), app.lm.hot_archive,
+            h.ledgerVersion)
+        assert app.herder.upgrades.params.protocol_version is None
