@@ -42,6 +42,12 @@ class Simulation:
                  accounts=None, config: Optional[Config] = None
                  ) -> Application:
         cfg = config if config is not None else Config()
+        if config is None:
+            # reference test harness parity (test.cpp:321): in-process
+            # simulation nodes skip the background quorum-intersection
+            # recheck unless a test opts in — 16-validator storms would
+            # otherwise spend their wall time in bounded sat searches
+            cfg.QUORUM_INTERSECTION_CHECKER = False
         cfg.NODE_SEED = seed
         cfg.QUORUM_SET = qset
         cfg.NETWORK_PASSPHRASE = self.network_passphrase

@@ -338,6 +338,8 @@ def test_background_quorum_intersection_recheck():
 
     funded = [(keypair("qic-a"), 10_000 * 10_000_000)]
     sim = Topologies.core4(accounts=funded)
+    for app in sim.nodes.values():  # sim nodes default the flag OFF
+        app.config.QUORUM_INTERSECTION_CHECKER = True
     sim.start_all_nodes()
     apps = list(sim.nodes.values())
     assert sim.crank_until(
