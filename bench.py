@@ -14,9 +14,14 @@ definition). Reported alongside:
   overlapping device execution of batch k — the herder's queue-drain
   shape); the throughput story for catchup.
 - ``dispatch_floor_ms``: the MEASURED fixed cost of any dispatch on
-  this harness (median of x+1 on 4 ints), and
-  ``blocking_minus_floor_ms`` — what the kernel itself costs once the
-  harness round-trip is subtracted.
+  this harness (median of x+1 on 4 ints);
+  ``dispatch_floor_sized_ms``: same, but shipping the verify kernel's
+  exact 4x(2048,32) uint8 payload through an identity jit — the
+  defensible floor. ``blocking_minus_floor_ms`` subtracts the SIZED
+  floor (VERDICT r4 #1b).
+- ``coalesced_p50_ms``: per-logical-batch cost when 8 batches fuse
+  into ONE 16384-sig dispatch (one tunnel round-trip amortized 8x) —
+  the catchup/storm throughput shape (VERDICT r4 #2).
 - ``trickle_p50_ms``: single-sig misses under concurrent load through
   the TrickleBatcher micro-batch window (SURVEY §7 trickle class),
   vs ``single_sig_miss_p50_ms`` — the solo-dispatch cost it amortizes.
@@ -101,6 +106,30 @@ def dispatch_floor_ms():
     return float(np.median(times))
 
 
+def dispatch_floor_sized_ms(n=N_SIGS):
+    """SIZE-MATCHED dispatch floor (VERDICT r4 #1b): ship the verify
+    kernel's exact input payload — 4x(n,32) uint8 — through an identity
+    jit returning an (n,)-shaped result, so ``blocking - floor`` is a
+    defensible kernel-time estimate for THIS transfer size, not a 4-int
+    proxy."""
+    import jax
+    import jax.numpy as jnp
+
+    def ident(a, r, s, h):
+        return (a[:, 0] ^ r[:, 0] ^ s[:, 0] ^ h[:, 0]).astype(jnp.uint8)
+
+    f = jax.jit(ident)
+    args = [np.random.randint(0, 256, (n, 32), dtype=np.uint8)
+            for _ in range(4)]
+    np.asarray(f(*args))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times))
+
+
 def _probe_device(timeout_s: float = 180.0) -> bool:
     """True when a trivial dispatch completes within the budget. The
     TPU tunnel can wedge (observed: libtpu version-mismatch windows
@@ -128,6 +157,28 @@ def _probe_device(timeout_s: float = 180.0) -> bool:
     return True
 
 
+def _last_ondevice_record():
+    """Most recent self-recorded on-device bench (device_watch capture),
+    embedded verbatim in the rc=3 output so the driver artifact always
+    carries the round's best real number (VERDICT r4 #8)."""
+    import glob
+    docs = os.path.join(os.path.dirname(os.path.abspath(__file__)), "docs")
+    best, best_ts = None, ""
+    for path in (glob.glob(os.path.join(docs, "bench_runs", "bench_*.json"))
+                 + glob.glob(os.path.join(docs, "bench_r*_ondevice.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (ValueError, OSError):
+            continue
+        ts = rec.get("recorded_at", "")
+        if rec.get("value") is not None and ts > best_ts:
+            best, best_ts = rec, ts
+    if best is not None:
+        best["stale"] = True
+    return best
+
+
 def main():
     _enable_compilation_cache()
     if not _probe_device():
@@ -137,8 +188,9 @@ def main():
             "error": "device unreachable: trivial dispatch did not "
                      "complete within 180s (TPU tunnel down?)",
             "note": "not a kernel failure — even jit(x+1) never "
-                    "returned; the most recent on-device measurement "
-                    "is recorded in BENCH_r*.json",
+                    "returned; last_ondevice is the most recent "
+                    "self-recorded on-device run, verbatim",
+            "last_ondevice": _last_ondevice_record(),
         }))
         return 3
     from stellar_tpu.crypto.batch_verifier import (
@@ -172,69 +224,110 @@ def main():
     blocking_p50 = float(np.median(blocking))
     blocking_p95 = float(np.percentile(blocking, 95))
 
-    # pipelined steady state: depth-K in-flight batches, repeated
-    per_batch = []
-    for _ in range(PIPELINE_ROUNDS):
-        t0 = time.perf_counter()
-        resolvers = [v.submit(items) for _ in range(PIPELINE_DEPTH)]
-        outs = [r() for r in resolvers]
-        dt = (time.perf_counter() - t0) * 1000.0
-        per_batch.append(dt / PIPELINE_DEPTH)
-        assert all(o.all() for o in outs)
-    p50 = float(np.median(per_batch))
-    p95 = float(np.percentile(per_batch, 95))
-
-    # trickle class: a single flooded tx signature through the installed
-    # verify path (cache miss -> device round trip; hit -> host dict)
-    v.install()
-    from stellar_tpu.crypto.keys import verify_sig
-    from stellar_tpu.crypto.keys import PublicKey
-    singles = gen_sigs(12)
-    miss_times, hit_times = [], []
-    for pk, m, s in singles:
-        t0 = time.perf_counter()
-        assert verify_sig(PublicKey(pk), m, s)
-        miss_times.append((time.perf_counter() - t0) * 1000.0)
-        t0 = time.perf_counter()
-        assert verify_sig(PublicKey(pk), m, s)
-        hit_times.append((time.perf_counter() - t0) * 1000.0)
-    single_miss_p50 = float(np.median(miss_times))
-    single_hit_p50 = float(np.median(hit_times))
-
-    # trickle under mixed load: 8 threads of lone verifies share
-    # micro-batch dispatches instead of each paying the solo cost
-    trickle_p50, trickle_dispatches = trickle_bench(v)
-
+    # Headline + floors + baseline FIRST (all cheap): a tunnel death in
+    # a later optional phase must not erase the core measurement — the
+    # round-4 live window lasted ~3 minutes total.
     base = cpu_baseline_ms(items)
     floor = dispatch_floor_ms()
-    print(json.dumps({
+    floor_sized = dispatch_floor_sized_ms()
+    rec = {
         "metric": "txset_sigverify_p50_ms",
         "value": round(blocking_p50, 3),
         "unit": "ms",
         "vs_baseline": round(base / blocking_p50, 2),
         "blocking_p50_ms": round(blocking_p50, 3),
         "blocking_p95_ms": round(blocking_p95, 3),
-        "blocking_minus_floor_ms": round(blocking_p50 - floor, 3),
-        "pipelined_p50_ms": round(p50, 3),
-        "pipelined_p95_ms": round(p95, 3),
-        "vs_baseline_pipelined": round(base / p50, 2),
+        "blocking_minus_floor_ms": round(blocking_p50 - floor_sized, 3),
         "host_prep_ms": round(host_prep_ms, 3),
         "cpu_baseline_ms": round(base, 3),
         "dispatch_floor_ms": round(floor, 3),
+        "dispatch_floor_sized_ms": round(floor_sized, 3),
         # diagnostics, NOT the scored number: what the kernel delivers
-        # once the harness round-trip (the tunnel's dispatch floor) is
-        # excluded — the colocated-deployment projection
+        # once the harness round-trip (the SIZE-MATCHED dispatch floor)
+        # is excluded — the colocated-deployment projection
         "vs_baseline_ex_floor": round(
-            base / max(1e-6, blocking_p50 - floor), 2),
-        "single_sig_miss_p50_ms": round(single_miss_p50, 3),
-        "single_sig_hit_p50_ms": round(single_hit_p50, 4),
-        "trickle_p50_ms": round(trickle_p50, 3),
-        "trickle_dispatches": trickle_dispatches,
+            base / max(1e-6, blocking_p50 - floor_sized), 2),
         "pipeline_depth": PIPELINE_DEPTH,
         "n_sigs": N_SIGS,
         "n_devices": 1 if mesh is None else mesh.size,
         "native_prep": native_prep.available(),
-    }))
+    }
+
+    def optional(name, fn):
+        try:
+            rec.update(fn())
+        except Exception as e:
+            rec.setdefault("aborted_phases", []).append(
+                {"phase": name, "error": repr(e)[:200]})
+
+    def phase_pipelined():
+        per_batch = []
+        for _ in range(PIPELINE_ROUNDS):
+            t0 = time.perf_counter()
+            resolvers = [v.submit(items) for _ in range(PIPELINE_DEPTH)]
+            outs = [r() for r in resolvers]
+            dt = (time.perf_counter() - t0) * 1000.0
+            per_batch.append(dt / PIPELINE_DEPTH)
+            assert all(o.all() for o in outs)
+        p50 = float(np.median(per_batch))
+        return {"pipelined_p50_ms": round(p50, 3),
+                "pipelined_p95_ms": round(
+                    float(np.percentile(per_batch, 95)), 3),
+                "vs_baseline_pipelined": round(base / p50, 2)}
+
+    def phase_coalesced():
+        # VERDICT r4 #2: if the tunnel serializes round-trips, depth-K
+        # queuing amortizes nothing — so fuse K logical batches into ONE
+        # dispatch of K*N sigs and pay the round-trip once.  This is the
+        # catchup/storm throughput shape (verify_batches).
+        v_coal = BatchVerifier(
+            mesh=mesh, bucket_sizes=(N_SIGS, PIPELINE_DEPTH * N_SIGS))
+        big = items * PIPELINE_DEPTH
+        out = v_coal.verify_batch(big)   # warm/compile the big bucket
+        assert out.all()
+        coal = []
+        for _ in range(PIPELINE_ROUNDS):
+            t0 = time.perf_counter()
+            out = v_coal.verify_batch(big)
+            dt = (time.perf_counter() - t0) * 1000.0
+            coal.append(dt / PIPELINE_DEPTH)
+        assert out.all()
+        coal_p50 = float(np.median(coal))
+        return {"coalesced_p50_ms": round(coal_p50, 3),
+                "vs_baseline_coalesced": round(base / coal_p50, 2)}
+
+    def phase_singles():
+        # trickle class: a single flooded tx signature through the
+        # installed verify path (miss -> device round trip; hit -> dict)
+        v.install()
+        from stellar_tpu.crypto.keys import verify_sig
+        from stellar_tpu.crypto.keys import PublicKey
+        singles = gen_sigs(12)
+        miss_times, hit_times = [], []
+        for pk, m, s in singles:
+            t0 = time.perf_counter()
+            assert verify_sig(PublicKey(pk), m, s)
+            miss_times.append((time.perf_counter() - t0) * 1000.0)
+            t0 = time.perf_counter()
+            assert verify_sig(PublicKey(pk), m, s)
+            hit_times.append((time.perf_counter() - t0) * 1000.0)
+        return {"single_sig_miss_p50_ms": round(
+                    float(np.median(miss_times)), 3),
+                "single_sig_hit_p50_ms": round(
+                    float(np.median(hit_times)), 4)}
+
+    def phase_trickle():
+        # 8 threads of lone verifies share micro-batch dispatches
+        # instead of each paying the solo cost
+        trickle_p50, trickle_dispatches = trickle_bench(v)
+        return {"trickle_p50_ms": round(trickle_p50, 3),
+                "trickle_dispatches": trickle_dispatches}
+
+    optional("coalesced", phase_coalesced)   # most valuable first
+    optional("pipelined", phase_pipelined)
+    optional("singles", phase_singles)
+    optional("trickle", phase_trickle)
+    print(json.dumps(rec))
     return 0
 
 

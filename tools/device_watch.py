@@ -28,7 +28,7 @@ PROBE = os.path.join(REPO, "tools", "device_probe.py")
 
 PROBE_PERIOD_DEAD_S = 120      # how often to re-probe while dead
 PROBE_PERIOD_ALIVE_S = 900     # back off after a successful capture
-BENCH_TIMEOUT_S = 560
+BENCH_TIMEOUT_S = 720   # bench now also compiles a 16384-sig bucket
 TRACE_TIMEOUT_S = 420
 
 TRACE_SRC = r"""
@@ -145,16 +145,40 @@ def capture_window():
     try:
         rc, so, se = _run_group(
             [sys.executable, "-c", TRACE_SRC, REPO,
-             os.path.join(PROFILES, f"r4_{ts}")], TRACE_TIMEOUT_S,
+             os.path.join(PROFILES, f"r5_{ts}")], TRACE_TIMEOUT_S,
             env={**os.environ, "JAX_TRACEBACK_FILTERING": "off"})
         if rc == 0:
             log(f"profiler trace captured: {so.strip()[-200:]}")
             ok = True
+            _analyze_trace(so, ts)
         else:
             log(f"trace failed rc={rc}: {se[-300:]}")
     except subprocess.TimeoutExpired:
         log("trace timed out")
     return ok
+
+
+def _analyze_trace(trace_stdout, ts):
+    """Run trace_kernel_time.py on the just-captured trace so the
+    device-side kernel number (VERDICT r4 #1c) lands in bench_runs even
+    if the window closes before anyone can look at the trace."""
+    try:
+        kept = json.loads(trace_stdout.strip().splitlines()[-1])["kept"]
+    except (ValueError, KeyError, IndexError):
+        return
+    for path in kept:
+        try:
+            rc, so, se = _run_group(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "trace_kernel_time.py"),
+                 path, "3"], 120)
+            if rc == 0 and so.strip():
+                out = os.path.join(RUNS, f"kernel_time_{ts}.json")
+                with open(out, "w") as f:
+                    f.write(so.strip().splitlines()[-1] + "\n")
+                log(f"kernel-time analysis -> {out}")
+        except subprocess.TimeoutExpired:
+            log("trace analysis timed out")
 
 
 def main():
