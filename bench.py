@@ -252,6 +252,11 @@ def main():
         "n_devices": 1 if mesh is None else mesh.size,
         "native_prep": native_prep.available(),
     }
+    # Emit the core record NOW: the tunnel's observed failure mode is a
+    # HANG (not an exception), so a wedge inside an optional phase would
+    # otherwise erase the headline. Consumers read the LAST stdout line,
+    # so the enriched record below supersedes this one when we get there.
+    print(json.dumps(rec), flush=True)
 
     def optional(name, fn):
         try:

@@ -403,5 +403,12 @@ def default_verifier() -> BatchVerifier:
     global _default
     with _default_lock:
         if _default is None:
-            _default = BatchVerifier(mesh=_auto_mesh())
+            # the large buckets exist for COALESCED dispatches (catchup
+            # replay fusing a whole checkpoint's signatures into one
+            # round trip — the tunnel pays ~70ms per dispatch, so
+            # chunking a 16k batch into 8x2048 would cost 8 round trips
+            # for 8x less kernel work); small batches bucket as before
+            _default = BatchVerifier(
+                mesh=_auto_mesh(),
+                bucket_sizes=(128, 512, 2048, 4096, 8192, 16384))
         return _default

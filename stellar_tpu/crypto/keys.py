@@ -148,6 +148,19 @@ def set_verifier_backend(fn: Optional[Callable[[bytes, bytes, bytes], bool]]):
     _backend = fn
 
 
+def accelerated_verify_available() -> bool:
+    """True when bulk verification is worth collecting for: an explicit
+    backend is installed, or the device probe says an accelerator is
+    live. The shared gate for every prefetch-then-apply path (ledger
+    close seeding, catchup checkpoint prefetch) — on the host-oracle
+    fallback a prefetch is the same sequential work plus collection
+    overhead, so those paths verify lazily instead."""
+    if _backend is not None:
+        return True
+    from stellar_tpu.crypto import batch_verifier
+    return batch_verifier.device_available(block=False)
+
+
 def get_verifier_backend_name() -> str:
     """Which backend serves verification right now — recorded into
     every published benchmark row so numbers are attributable."""

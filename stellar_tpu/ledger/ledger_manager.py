@@ -265,9 +265,8 @@ class LedgerManager:
         # PATH). Only when an accelerator is live: on the host-oracle
         # fallback the batch is the same sequential work plus
         # collection overhead, so apply verifies lazily instead.
-        from stellar_tpu.crypto import batch_verifier, keys
-        if keys._backend is not None or \
-                batch_verifier.device_available(block=False):
+        from stellar_tpu.crypto import keys
+        if keys.accelerated_verify_available():
             triples = getattr(lcd.tx_set, "sig_triples", None)
             if triples is not None:
                 # checkValid collected these already: one cheap batch

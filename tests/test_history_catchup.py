@@ -525,3 +525,71 @@ def test_trusted_anchors_fail_closed(tmp_path):
     assert "anchors do not cover" in work._refused
     # terminal: the refusal did not burn retry rounds
     assert work.retries == 0
+
+
+def test_replay_coalesces_signature_prefetch(tmp_path, monkeypatch):
+    """With an accelerator backend installed, replay_checkpoint verifies
+    the whole checkpoint's signatures up front in coalesced
+    batch_verify_into_cache calls (one tunnel round trip per 16k sigs)
+    instead of one dispatch per ledger (VERDICT r4 #2)."""
+    lm, archive, hm = build_chain(61, str(tmp_path))
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+
+    from stellar_tpu.crypto import keys
+    from stellar_tpu.catchup import catchup as catchup_mod
+    calls = []
+    real = keys.batch_verify_into_cache
+
+    def recording(items):
+        calls.append(len(list(items)))
+        return real(items)
+
+    monkeypatch.setattr(keys, "batch_verify_into_cache", recording)
+    # a scalar host backend is enough to arm the device-present gate
+    keys.set_verifier_backend(
+        lambda pk, m, s: keys._ref.verify(pk, m, s))
+    try:
+        applied = replay_checkpoint(lm2, archive, 63)
+    finally:
+        keys.set_verifier_backend(None)
+    assert applied == 61
+    assert lm2.last_closed_hash == lm.last_closed_hash
+    # build_chain signs one tx every 3rd ledger: ~21 single-sig sets.
+    # The pre-pass must deliver them all in its FIRST (coalesced) call;
+    # later per-ledger re-seeds then find the cache warm.
+    assert calls, "prefetch never ran"
+    n_txs = sum(1 for i in range(61) if i % 3 == 0)
+    assert calls[0] >= n_txs
+    assert calls[0] == max(calls)
+
+
+def test_replay_skip_known_results_with_prefetch(tmp_path, monkeypatch):
+    """SKIP_KNOWN_RESULTS + accelerator: the pre-pass must only verify
+    NON-trusted frames (recorded successes seed assume-valid), reusing
+    its trusted/rest split in the loop, and replay still converges."""
+    lm, archive, hm = build_chain(61, str(tmp_path))
+    a, b = keypair("alice"), keypair("bob")
+    root2 = seed_root_with_accounts([(a, 10**14), (b, 10**14)])
+    lm2 = LedgerManager(TEST_NETWORK_ID, root2)
+
+    from stellar_tpu.crypto import keys
+    from stellar_tpu.catchup import catchup as catchup_mod
+    verified = []
+
+    def counting_backend(pk, m, s):
+        verified.append((pk, m, s))
+        return keys._ref.verify(pk, m, s)
+
+    monkeypatch.setattr(catchup_mod, "SKIP_KNOWN_RESULTS", True)
+    keys.set_verifier_backend(counting_backend)
+    try:
+        applied = replay_checkpoint(lm2, archive, 63)
+    finally:
+        keys.set_verifier_backend(None)
+    assert applied == 61
+    assert lm2.last_closed_hash == lm.last_closed_hash
+    # every replayed tx succeeded when recorded, so ALL its triples are
+    # trusted: nothing should have needed an actual verification
+    assert not verified

@@ -166,17 +166,19 @@ def _analyze_trace(trace_stdout, ts):
         kept = json.loads(trace_stdout.strip().splitlines()[-1])["kept"]
     except (ValueError, KeyError, IndexError):
         return
-    for path in kept:
+    for i, path in enumerate(kept):
         try:
             rc, so, se = _run_group(
                 [sys.executable,
                  os.path.join(REPO, "tools", "trace_kernel_time.py"),
                  path, "3"], 120)
             if rc == 0 and so.strip():
-                out = os.path.join(RUNS, f"kernel_time_{ts}.json")
+                out = os.path.join(RUNS, f"kernel_time_{ts}_{i}.json")
                 with open(out, "w") as f:
                     f.write(so.strip().splitlines()[-1] + "\n")
                 log(f"kernel-time analysis -> {out}")
+            else:
+                log(f"trace analysis failed rc={rc}: {se[-200:]}")
         except subprocess.TimeoutExpired:
             log("trace analysis timed out")
 

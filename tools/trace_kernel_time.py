@@ -41,8 +41,8 @@ def analyze(path, n_iters):
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as f:
         trace = json.load(f)
-    events = trace.get("traceEvents", trace if isinstance(trace, list)
-                       else [])
+    events = trace if isinstance(trace, list) else \
+        trace.get("traceEvents", [])
     pid_names = {}
     for ev in events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
