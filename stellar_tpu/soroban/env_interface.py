@@ -34,7 +34,19 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 __all__ = ["EXPORT_CHARS", "MODULES", "export_name", "short_to_long",
-           "long_to_short"]
+           "long_to_short", "evidence_tier", "describe_binding",
+           "FIXTURE_VERIFIED"]
+
+# (module char, long name) orderings pinned by offline artifacts — the
+# reference's own SDK-compiled fixtures import these with known
+# semantics (see legacy_abi.py and tests/test_reference_fixtures.py).
+# Everything else in MODULES is tier "derived".
+FIXTURE_VERIFIED = frozenset([
+    ("l", "put_contract_data"),
+    ("l", "has_contract_data"),
+    ("l", "get_contract_data"),
+    ("l", "del_contract_data"),
+])
 
 EXPORT_CHARS = ("_0123456789abcdefghijklmnopqrstuvwxyz"
                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
@@ -251,3 +263,35 @@ def long_to_short() -> Dict[str, Tuple[str, str]]:
         for i, fn in enumerate(fns):
             out[fn] = (mod_char, export_name(i))
     return out
+
+
+def evidence_tier(mod_char: str, long_name: str) -> str:
+    """'fixture-verified' when an offline artifact pins this ordering,
+    else 'derived' (see module docstring for what each tier means)."""
+    return "fixture-verified" \
+        if (mod_char, long_name) in FIXTURE_VERIFIED else "derived"
+
+
+def describe_binding(mod_char: str, export_char: str) -> str:
+    """Human context for a link error on (module char, export name):
+    which long name the registry derivation chose, at which index, and
+    under which evidence tier — so a mis-derived ordering reads as
+    exactly that, not as a mystery arity bug."""
+    entry = MODULES.get(mod_char)
+    if entry is None:
+        return ""
+    mod_name, fns = entry
+    long = short_to_long().get((mod_char, export_char))
+    if long is None:
+        if len(export_char) > 2 or export_char in fns:
+            # the readable long-name dialect (wasm_builder contracts /
+            # historical aliases) — not a registry-derived binding
+            return f" (module {mod_name!r}: long-name alias import, " \
+                   f"not registry-derived)"
+        return f" (module {mod_name!r}: no registry entry for export " \
+               f"{export_char!r})"
+    idx = fns.index(long)
+    return (f" (registry: module {mod_name!r} index {idx} -> "
+            f"{long!r}, evidence tier: "
+            f"{evidence_tier(mod_char, long)} — if the tier is "
+            f"'derived', suspect the ordering in env_interface.MODULES)")

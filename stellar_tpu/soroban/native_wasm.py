@@ -514,11 +514,14 @@ def run_export(module: WasmModule, imports: Dict, budget,
         host_fns = cache[1]
     else:
         host_fns = []
-        for mod, name, _t in module.imports:
+        from stellar_tpu.soroban.wasm import (
+            WasmError, check_import_binding,
+        )
+        for mod, name, t in module.imports:
             fn = imports.get((mod, name))
             if fn is None:
-                from stellar_tpu.soroban.wasm import WasmError
                 raise WasmError(f"unresolved import {mod}.{name}")
+            check_import_binding(mod, name, t, fn)
             host_fns.append(fn)
         if cache_imports:
             module._host_fns_cache = (imports, host_fns)
