@@ -477,6 +477,17 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     running contract's address, and the ValConverter."""
     cv: ValConverter = env.cv
 
+    def _frame_version() -> int:
+        """THE protocol version this frame runs under — shared by
+        get_ledger_version, the era gates, and the link-time check, so
+        a contract can never observe one version and be served
+        another's function set. Headerless hosts (unit tests, direct
+        simulation) run as the current protocol."""
+        from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+        hdr = getattr(env.host, "ledger_header", None)
+        return hdr.ledgerVersion if hdr is not None \
+            else CURRENT_LEDGER_PROTOCOL_VERSION
+
     def _u32_arg(val: int, what: str) -> int:
         if _tag(val) != TAG_U32:
             raise EnvError(f"{what}: expected U32 val")
@@ -898,9 +909,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _make(TAG_VOID)
 
     def get_ledger_version(inst):
-        hdr = getattr(env.host, "ledger_header", None)
-        return _make(TAG_U32,
-                     hdr.ledgerVersion if hdr is not None else 0)
+        return _make(TAG_U32, _frame_version())
 
     def fail_with_error(inst, err_val):
         from stellar_tpu.xdr.contract import (
@@ -2079,14 +2088,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _make(TAG_VOID)
 
     def protocol_gated_dummy(inst):
-        from stellar_tpu.protocol import (
-            CURRENT_LEDGER_PROTOCOL_VERSION,
-        )
-        hdr = getattr(env.host, "ledger_header", None)
-        version = hdr.ledgerVersion if hdr is not None \
-            else CURRENT_LEDGER_PROTOCOL_VERSION
-        if version < CURRENT_LEDGER_PROTOCOL_VERSION:
-            raise EnvError("protocol_gated_dummy not yet enabled")
+        # era availability comes from the central MIN_PROTOCOL gate
         return _make(TAG_VOID)
 
     # =====================================================================
@@ -2292,6 +2294,31 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         "prng_vec_shuffle": ("p", prng_vec_shuffle),
     }
 
+    # protocol-era gating (reference pins one soroban-env-host crate
+    # per protocol, src/rust/Cargo.toml:51-80, so a p21-era replay
+    # cannot see p22 functions). Two layers, because the import table
+    # is pooled across frames and the frame's protocol can differ per
+    # tx: (1) LINK time — check_import_binding reads __min_protocol__ /
+    # __frame_version__ and refuses the import like the reference's
+    # per-era host would (import-but-never-call still fails); (2) CALL
+    # time — defense in depth for direct handler invocation.
+    from stellar_tpu.soroban.env_interface import MIN_PROTOCOL
+    from stellar_tpu.soroban.wasm import handler_arity as _harity
+
+    def _version_gated(long_name, min_proto, fn):
+        def gated(inst, *args):
+            version = _frame_version()
+            if version < min_proto:
+                raise EnvError(
+                    f"{long_name} requires protocol {min_proto}; "
+                    f"ledger is protocol {version}")
+            return fn(inst, *args)
+        gated.__env_arity__ = _harity(fn)  # keep link-check visibility
+        gated.__min_protocol__ = min_proto
+        gated.__frame_version__ = _frame_version
+        gated.__name__ = f"{long_name}_p{min_proto}_gate"
+        return gated
+
     table: Dict[Tuple[str, str], Callable] = {}
     shorts = _SHORTS()
     for long_name, (mod, fn) in canonical.items():
@@ -2300,6 +2327,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         # would otherwise register its short name under the wrong key
         # and fail only at contract link time
         assert smod == mod, f"module mismatch for {long_name}"
+        min_proto = MIN_PROTOCOL.get(long_name)
+        if min_proto is not None:
+            fn = _version_gated(long_name, min_proto, fn)
         table[(mod, long_name)] = fn
         table[(mod, schar)] = fn
 

@@ -35,7 +35,46 @@ from typing import Dict, List, Tuple
 
 __all__ = ["EXPORT_CHARS", "MODULES", "export_name", "short_to_long",
            "long_to_short", "evidence_tier", "describe_binding",
-           "FIXTURE_VERIFIED"]
+           "FIXTURE_VERIFIED", "MIN_PROTOCOL", "SOROBAN_LAUNCH_PROTOCOL"]
+
+# Minimum ledger protocol at which each host function exists, mirroring
+# the reference's one-host-crate-per-protocol-era scheme
+# (src/rust/Cargo.toml:51-80: p21/p22 hosts are pinned so historical
+# replay is bit-exact). Functions absent here exist from the soroban
+# launch protocol (20). CAP-51 (secp256r1) shipped in protocol 21;
+# CAP-59 (BLS12-381 family) in protocol 22.
+SOROBAN_LAUNCH_PROTOCOL = 20
+
+
+def _current_protocol() -> int:
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    return CURRENT_LEDGER_PROTOCOL_VERSION
+
+
+MIN_PROTOCOL: Dict[str, int] = {
+    # the reference's vnext-gated test hook: enabled only at the
+    # current protocol (tracks the version constant, not an era)
+    "protocol_gated_dummy": _current_protocol(),
+    "verify_sig_ecdsa_secp256r1": 21,
+    "bls12_381_check_g1_is_in_subgroup": 22,
+    "bls12_381_g1_add": 22,
+    "bls12_381_g1_mul": 22,
+    "bls12_381_g1_msm": 22,
+    "bls12_381_map_fp_to_g1": 22,
+    "bls12_381_hash_to_g1": 22,
+    "bls12_381_check_g2_is_in_subgroup": 22,
+    "bls12_381_g2_add": 22,
+    "bls12_381_g2_mul": 22,
+    "bls12_381_g2_msm": 22,
+    "bls12_381_map_fp2_to_g2": 22,
+    "bls12_381_hash_to_g2": 22,
+    "bls12_381_multi_pairing_check": 22,
+    "bls12_381_fr_add": 22,
+    "bls12_381_fr_sub": 22,
+    "bls12_381_fr_mul": 22,
+    "bls12_381_fr_pow": 22,
+    "bls12_381_fr_inv": 22,
+}
 
 # (module char, long name) orderings pinned by offline artifacts — the
 # reference's own SDK-compiled fixtures import these with known

@@ -511,9 +511,17 @@ def run_export(module: WasmModule, imports: Dict, budget,
     # invokes skip the per-import lookups entirely
     cache = getattr(module, "_host_fns_cache", None)
     if cache is not None and cache[0] is imports:
-        host_fns = cache[1]
+        host_fns, gated = cache[1], cache[2]
+        if gated:
+            # the cached resolution skipped the full link checks, but
+            # the frame's PROTOCOL can differ per invoke (pooled
+            # imports serve many txs) — era refusal must re-run
+            from stellar_tpu.soroban.wasm import check_import_era
+            for mod, name, fn in gated:
+                check_import_era(mod, name, fn)
     else:
         host_fns = []
+        gated = []
         from stellar_tpu.soroban.wasm import (
             WasmError, check_import_binding,
         )
@@ -523,8 +531,10 @@ def run_export(module: WasmModule, imports: Dict, budget,
                 raise WasmError(f"unresolved import {mod}.{name}")
             check_import_binding(mod, name, t, fn)
             host_fns.append(fn)
+            if getattr(fn, "__min_protocol__", None) is not None:
+                gated.append((mod, name, fn))
         if cache_imports:
-            module._host_fns_cache = (imports, host_fns)
+            module._host_fns_cache = (imports, host_fns, gated)
 
     ctx = _RunCtx(host_fns, budget, cpu_per_insn)
     exc_box = ctx.exc_box

@@ -142,11 +142,32 @@ def handler_arity(fn):
     """Positional arity of a host-import handler, excluding the leading
     instance arg; None when not introspectable (builtin) or variadic.
     The single source of truth for both the link-time check below and
-    the generated evidence-tier audit table (tools/gen_env_tiers.py)."""
+    the generated evidence-tier audit table (tools/gen_env_tiers.py).
+    Wrappers that hide their wrapped function's signature (e.g. the
+    protocol-version gates in env.py) declare it via ``__env_arity__``."""
+    declared = getattr(fn, "__env_arity__", None)
+    if declared is not None:
+        return declared
     code = getattr(fn, "__code__", None)
     if code is None or (code.co_flags & _CO_VARARGS):
         return None
     return code.co_argcount - 1
+
+
+def check_import_era(mod: str, name: str, fn) -> None:
+    """Protocol-era link refusal: a handler carrying ``__min_protocol__``
+    (the env's version gates) must be UNRESOLVABLE below its era, not
+    merely trap when called — the reference pins one host crate per
+    protocol, so a p21-era frame importing a p22 function fails at
+    instantiation even if the function is never executed."""
+    min_proto = getattr(fn, "__min_protocol__", None)
+    if min_proto is None:
+        return
+    version = fn.__frame_version__()
+    if version < min_proto:
+        raise WasmError(
+            f"unresolved import {mod!r}.{name!r}: requires protocol "
+            f"{min_proto}, frame runs protocol {version}")
 
 
 def check_import_binding(mod: str, name: str, ftype: FuncType, fn) -> None:
@@ -159,6 +180,7 @@ def check_import_binding(mod: str, name: str, ftype: FuncType, fn) -> None:
     and misbehave at run time. (Reference links the real
     ``soroban-env-host`` crates, src/rust/src/lib.rs:61-83, where the
     linker does this job.)"""
+    check_import_era(mod, name, fn)
     have = handler_arity(fn)
     if have is None:  # non-introspectable or variadic wrapper
         return

@@ -555,7 +555,9 @@ def test_context_getters(hostenv):
     assert _body(mx) == 100 + _Cfg.max_entry_ttl - 1
     seq = table_fn(t, "get_ledger_sequence")(inst)
     assert _body(seq) == 100
-    assert _body(table_fn(t, "get_ledger_version")(inst)) == 0
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    assert _body(table_fn(t, "get_ledger_version")(inst)) == \
+        CURRENT_LEDGER_PROTOCOL_VERSION
     assert _tag(table_fn(t, "dummy0")(inst)) == TAG_VOID
 
 
@@ -1058,3 +1060,108 @@ def test_env_tiers_doc_in_sync(tmp_path):
     assert committed == regenerated, (
         "docs/env_interface_tiers.md is stale — run "
         "tools/gen_env_tiers.py and commit the result")
+
+
+# ---------------------------------------------------------------------------
+# protocol-era availability (VERDICT r4 #6)
+# ---------------------------------------------------------------------------
+
+class _Hdr:
+    def __init__(self, v):
+        self.ledgerVersion = v
+
+
+@pytest.mark.parametrize("fn_name,min_proto", [
+    ("verify_sig_ecdsa_secp256r1", 21),
+    ("bls12_381_g1_add", 22),
+    ("bls12_381_fr_add", 22),
+])
+def test_env_fn_availability_tracks_protocol(hostenv, fn_name, min_proto):
+    """Invoking at pre-era protocol traps era-gated; at its era the
+    call proceeds past the gate (failing, if at all, on argument
+    validation — proving the handler ran)."""
+    env, table, inst = hostenv
+    fn = table_fn(table, fn_name)
+    env.host.ledger_header = _Hdr(min_proto - 1)
+    with pytest.raises(EnvError, match="requires protocol"):
+        fn(inst, *([0] * fn.__env_arity__))
+    env.host.ledger_header = _Hdr(min_proto)
+    try:
+        fn(inst, *([0] * fn.__env_arity__))
+    except EnvError as e:
+        assert "requires protocol" not in str(e)
+
+
+def test_era_gate_preserves_link_arity(hostenv):
+    """The version-gate wrapper must stay visible to the link-time
+    arity check (it wraps with *args)."""
+    from stellar_tpu.soroban.wasm import handler_arity
+    env, table, _inst = hostenv
+    assert handler_arity(table_fn(table, "bls12_381_g1_add")) == 2
+    assert handler_arity(
+        table_fn(table, "verify_sig_ecdsa_secp256r1")) == 3
+
+
+def test_replay_era_correct_availability(hostenv):
+    """A p21-era ledger replayed through today's env must NOT see p22
+    functions, and a p22-era ledger must: the same env object serves
+    both eras correctly when the frame's header changes (pooled-env
+    shape)."""
+    env, table, inst = hostenv
+    g1_add = table_fn(table, "bls12_381_g1_add")
+    env.host.ledger_header = _Hdr(21)
+    with pytest.raises(EnvError, match="requires protocol 22"):
+        g1_add(inst, 0, 0)
+    env.host.ledger_header = _Hdr(22)
+    try:
+        g1_add(inst, 0, 0)
+    except EnvError as e:  # bad args are fine; era refusal is not
+        assert "requires protocol" not in str(e)
+
+
+def _import_only_bls_contract():
+    """Imports bls12_381_g1_add but NEVER calls it: under a p21-era
+    frame this must fail at LINK (the reference's p21 host crate has no
+    such import), not merely trap if called."""
+    from stellar_tpu.soroban.wasm_builder import Code, I64, ModuleBuilder
+    b = ModuleBuilder()
+    mod, char = _short("bls12_381_g1_add")
+    b.import_func(mod, char, [I64, I64], [I64])
+    c = Code()
+    c.i64_const(7)
+    b.add_func([], [I64], [], c, export="seven")
+    b.add_memory(1, export="memory")
+    return b.build()
+
+
+def test_era_refusal_at_link_python_engine(hostenv):
+    from stellar_tpu.soroban.wasm import (
+        WasmError, WasmInstance, parse_module,
+    )
+    env, table, _inst = hostenv
+    module = parse_module(_import_only_bls_contract())
+    env.host.ledger_header = _Hdr(21)
+    with pytest.raises(WasmError, match="requires protocol 22"):
+        WasmInstance(module, table, charge=lambda n: None)
+    env.host.ledger_header = _Hdr(22)
+    inst2 = WasmInstance(module, table, charge=lambda n: None)
+    assert inst2.invoke("seven", []) == 7
+
+
+def test_era_refusal_at_link_native_engine_cached(hostenv):
+    """The native engine's cached import resolution must still refuse
+    era-gated imports when the SAME pooled imports dict serves a frame
+    of an earlier protocol."""
+    from stellar_tpu.soroban import native_wasm
+    from stellar_tpu.soroban.host import _Budget
+    from stellar_tpu.soroban.wasm import WasmError, parse_module
+    env, table, _inst = hostenv
+    module = parse_module(_import_only_bls_contract())
+    budget = _Budget(500_000_000, 400 * 1024 * 1024)
+    env.host.ledger_header = _Hdr(22)
+    assert native_wasm.run_export(module, table, budget, 4, "seven", [],
+                                  cache_imports=True) == 7
+    env.host.ledger_header = _Hdr(21)  # same cached imports, older era
+    with pytest.raises(WasmError, match="requires protocol 22"):
+        native_wasm.run_export(module, table, budget, 4, "seven", [],
+                               cache_imports=True)
