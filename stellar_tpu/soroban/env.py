@@ -346,6 +346,24 @@ class ValConverter:
     def to_scval(self, val: int) -> "SCVal.Value":
         val &= _M64
         tag = _tag(val)
+        if tag < 64 and tag != TAG_ERROR:
+            # small tags ARE their value (no object table, no charges):
+            # the conversion is pure, so memoize it process-wide. The
+            # same counter values, symbols, and u32 codes recur every
+            # invoke, and the SCVal churn was the single biggest
+            # wasm-engine-only cost at scenario level. SCVals are
+            # treated as immutable throughout (storage shares them the
+            # same way, see _storage_args).
+            hit = _SMALL_SCVAL_CACHE.maybe_get(val)
+            if hit is not None:
+                return hit
+            sc = self._to_scval_uncached(val)
+            _SMALL_SCVAL_CACHE.put(val, sc)
+            return sc
+        return self._to_scval_uncached(val)
+
+    def _to_scval_uncached(self, val: int) -> "SCVal.Value":
+        tag = _tag(val)
         body = _body(val)
         if tag == TAG_FALSE:
             return SCVal.make(T.SCV_BOOL, False)
@@ -464,6 +482,9 @@ class ValConverter:
 # ---------------------------------------------------------------------------
 # Host-function imports
 # ---------------------------------------------------------------------------
+
+# small-tag Val -> SCVal memo (pure, chargeless conversions only)
+_SMALL_SCVAL_CACHE: "RandomEvictionCache" = RandomEvictionCache(4096)
 
 _DUR_BY_CODE = {0: "temporary", 1: "persistent", 2: "instance"}
 # (contract id, small key val, storage code) -> (SCVal, dur, kb);

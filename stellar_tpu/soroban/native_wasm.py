@@ -301,6 +301,9 @@ def _compile(module: WasmModule):
     prog = (desc, ops, ia, ib, ic, pool_arr, funcs, imp_np, imp_nr,
             imp_r32, globs, table, blob_arr, doffs, dlens, ftids)
     module._native_prog = prog
+    # the descriptor address is stable for the prog's lifetime; caching
+    # it saves a ctypes.addressof per invoke on the hot path
+    module._native_desc_addr = ctypes.addressof(desc)
     return prog
 
 
@@ -383,6 +386,8 @@ class _RunCtx:
             self.budget.charge(extra_cpu)
 
 
+_HOST_CALL_CPU = HOST_CALL_COST  # local alias for the dispatch hot path
+
 _tls = threading.local()
 
 
@@ -448,13 +453,34 @@ def _thread_dispatchers():
                           mem_addr, mem_len):
             ctx = stack[-1]
             try:
-                ctx.settle(charged, HOST_CALL_COST * ctx.cpu_per_insn)
+                # settle + crossing charge, inlined: this runs for
+                # EVERY host call of every contract — the two charge
+                # calls below are the metering contract (tick chunk and
+                # crossing cost charged separately, matching the Python
+                # engine's observable exhaustion points)
+                budget = ctx.budget
+                cpi = ctx.cpu_per_insn
+                delta = charged - ctx.settled
+                ctx.settled = charged
+                ticks_cpu = delta * cpi
+                cross_cpu = _HOST_CALL_CPU * cpi
+                new_cpu = budget.cpu + ticks_cpu + cross_cpu
+                if new_cpu <= budget.cpu_limit:
+                    budget.cpu = new_cpu  # fast path: no exhaustion
+                else:
+                    # slow path keeps the Python engine's exact two
+                    # observable exhaustion points (tick chunk, then
+                    # crossing cost)
+                    if ticks_cpu:
+                        budget.charge(ticks_cpu)
+                    budget.charge(cross_cpu)
                 shim = ctx.shim
                 shim.ptr = mem_addr
                 shim.size = mem_len
                 rv = ctx.host_fns[import_idx](shim, *args_tup)
+                room = budget.cpu_limit - budget.cpu
                 return ((rv if rv is not None else 0) & _M64,
-                        ctx.remaining_ticks())
+                        room // cpi if room > 0 else 0)
             except BaseException as e:
                 ctx.exc_box.append(e)
                 return None
@@ -536,56 +562,78 @@ def run_export(module: WasmModule, imports: Dict, budget,
         if cache_imports:
             module._host_fns_cache = (imports, host_fns, gated)
 
-    ctx = _RunCtx(host_fns, budget, cpu_per_insn)
+    # reuse one ctx + result struct per thread depth-slot: allocation
+    # (a _MemShim, an exc list, a ctypes struct) costs as much as a
+    # small contract's whole host work. Reentrant ``call`` frames get
+    # fresh objects (pool is per-depth via the stack length).
+    pool = getattr(_tls, "ctx_pool", None)
+    if pool is None:
+        pool = _tls.ctx_pool = []
+    depth = len(_thread_stack())
+    while len(pool) <= depth:
+        r = _RunResult()
+        pool.append((_RunCtx([], None, 1), r, ctypes.addressof(r)))
+    ctx, out, out_addr = pool[depth]
+    ctx.host_fns = host_fns
+    ctx.budget = budget
+    ctx.cpu_per_insn = cpu_per_insn
+    ctx.settled = 0
+    out.charged = 0
     exc_box = ctx.exc_box
-    out = _RunResult()
-    ext = _load_ext()
-    if ext is not None:
-        stack, hd, md = _thread_dispatchers()
-        stack.append(ctx)
-        try:
+    try:
+        if (ext := _load_ext()) is not None:
+            stack, hd, md = _thread_dispatchers()
+            stack.append(ctx)
             try:
-                ext.run(ctypes.addressof(desc), func_idx,
-                        [a & _M64 for a in args],
-                        ctx.remaining_ticks(), hd, md,
-                        ctypes.addressof(out))
-            except BaseException as e:
-                # trampoline-internal failure: out is filled — settle
-                # like the normal path, then surface the recorded
-                # host exception if one exists
-                ctx.settle(out.charged)
-                if exc_box:
-                    raise exc_box[0] from None
-                raise e
-        finally:
-            stack.pop()
-        rc = out.status
-    else:
-        stack, hcb, mcb = _thread_cbs()
-        stack.append(ctx)
-        try:
-            rc = lib.wasm_run(
-                ctypes.byref(desc), func_idx,
-                (ctypes.c_int64 * max(1, len(args)))(
-                    *[_s64(a & _M64) for a in args] or [0]),
-                len(args), hcb, mcb, None,
-                ctx.remaining_ticks(), ctypes.byref(out))
-        finally:
-            stack.pop()
+                try:
+                    ext.run(module._native_desc_addr, func_idx,
+                            [a & _M64 for a in args],
+                            ctx.remaining_ticks(), hd, md, out_addr)
+                except BaseException as e:
+                    # trampoline-internal failure: out is filled —
+                    # settle like the normal path, then surface the
+                    # recorded host exception if one exists
+                    ctx.settle(out.charged)
+                    if exc_box:
+                        raise exc_box[0] from None
+                    raise e
+            finally:
+                stack.pop()
+            rc = out.status
+        else:
+            stack, hcb, mcb = _thread_cbs()
+            stack.append(ctx)
+            try:
+                rc = lib.wasm_run(
+                    ctypes.byref(desc), func_idx,
+                    (ctypes.c_int64 * max(1, len(args)))(
+                        *[_s64(a & _M64) for a in args] or [0]),
+                    len(args), hcb, mcb, None,
+                    ctx.remaining_ticks(), ctypes.byref(out))
+            finally:
+                stack.pop()
 
-    # settle the remaining wasm-op charges; a budget-trapped run's
-    # failing chunk raises here, mirroring the Python engine's chunk
-    # charge exactly
-    ctx.settle(out.charged)
-    if rc == ST_OK:
-        return (out.value & _M64) if out.has_value else None
-    if rc == ST_HOST:
-        raise exc_box[0] if exc_box else Trap("host call failed")
-    if rc == ST_BUDGET:
-        # charged included the failing chunk: budget.charge above must
-        # have raised; reaching here means accounting drifted
-        raise AssertionError("native budget accounting out of sync")
-    if out.trap_code == 9:  # missing export / arity, post-start
-        raise Trap(export_error)
-    raise Trap(_TRAP_MESSAGES.get(out.trap_code,
-                                  f"trap {out.trap_code}"))
+        # settle the remaining wasm-op charges; a budget-trapped run's
+        # failing chunk raises here, mirroring the Python engine's
+        # chunk charge exactly
+        ctx.settle(out.charged)
+        if rc == ST_OK:
+            return (out.value & _M64) if out.has_value else None
+        if rc == ST_HOST:
+            raise exc_box[0] if exc_box else Trap("host call failed")
+        if rc == ST_BUDGET:
+            # charged included the failing chunk: budget.charge above
+            # must have raised; reaching here means accounting drifted
+            raise AssertionError("native budget accounting out of sync")
+        if out.trap_code == 9:  # missing export / arity, post-start
+            raise Trap(export_error)
+        raise Trap(_TRAP_MESSAGES.get(out.trap_code,
+                                      f"trap {out.trap_code}"))
+    finally:
+        # drop run references NOW, not at the next same-depth invoke: a
+        # pooled ctx holding the last run's exception (whose traceback
+        # pins the whole host/storage graph), budget, and import table
+        # would otherwise retain them for the thread's lifetime
+        ctx.host_fns = ()
+        ctx.budget = None
+        exc_box.clear()

@@ -103,27 +103,133 @@ def test_compute_bound_native_beats_scval():
         wasm["txs_per_sec"], scval["txs_per_sec"])
 
 
+def test_wasm_engine_invoke_overhead_bounded():
+    """Host-call-bound near-parity guard, at the INVOKE level where it
+    is measurable: on the counter workload (has/get/put/event — the
+    500-tx scenario's per-tx body) the native engine's per-invoke cost
+    must stay within 2x of the scval interpreter's. Measured 1.3x at
+    r5 (~52 vs ~40 us); a bridge regression (per-crossing cost
+    creeping back in) blows the bound, while the scenario-level
+    comparison lives in benchmarks.json via run_benchmarks.py's
+    interleaved A/B, where shared-host noise (~2x between runs,
+    time-correlated) would make any scenario assertion flake."""
+    if not native_wasm.available():
+        pytest.skip("native engine not built")
+    import time
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.env import make_imports
+    from stellar_tpu.soroban.example_contracts import counter_wasm
+    from stellar_tpu.soroban.host import (
+        WasmContractEnv, _Budget, _Host, _Interp, _Storage,
+        _parse_program, _parsed_module, assemble_program,
+        contract_data_key, ins, sym, u32,
+    )
+    from stellar_tpu.xdr.contract import (
+        ContractDataDurability, SCVal, SCValType, contract_address,
+    )
+
+    class _Cfg:
+        max_entry_ttl = 1_054_080
+        min_persistent_ttl = 4_096
+        min_temporary_ttl = 16
+        max_contract_size = 65_536
+        tx_max_contract_events_size_bytes = 1 << 40
+
+    addr = contract_address(b"\xAA" * 32)
+    kb = key_bytes(contract_data_key(
+        addr, SCVal.make(SCValType.SCV_SYMBOL, b"count"),
+        ContractDataDurability.PERSISTENT))
+
+    def mk_host():
+        budget = _Budget(500_000_000_000, 1 << 45)
+        storage = _Storage({}, set(), {kb}, budget, ledger_seq=100)
+        host = _Host(storage, budget, None, _Cfg(), 100,
+                     network_id=b"\x00" * 32)
+        host.frame_addrs.append(b"f0")
+        return host, budget
+
+    def best_us(run, host, n=800, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                run()
+                host.events.clear()
+                host._events_size = 0
+            best = min(best, (time.perf_counter() - t0) / n * 1e6)
+        return best
+
+    module = _parsed_module(counter_wasm())
+    h1, b1 = mk_host()
+    env = WasmContractEnv(h1, addr, None, 0)
+    imports = make_imports(env)
+    native_wasm.run_export(module, imports, b1, 4, "incr", [],
+                           cache_imports=True)
+    native_us = best_us(
+        lambda: native_wasm.run_export(module, imports, b1, 4,
+                                       "incr", [], cache_imports=True),
+        h1)
+
+    body = [
+        ins("push", sym("count")), ins("has", sym("persistent")),
+        ins("jz", u32(3)),
+        ins("push", sym("count")), ins("get", sym("persistent")),
+        ins("jmp", u32(1)),
+        ins("push", u32(0)),
+        ins("push", u32(1)), ins("add"),
+        ins("dup"),
+        ins("push", sym("count")), ins("swap"),
+        ins("put", sym("persistent")),
+        ins("dup"),
+        ins("push", sym("incr")), ins("swap"),
+        ins("event"),
+    ]
+    prog = _parse_program(assemble_program({"incr": body + [ins("ret")]}))
+    h2, _b2 = mk_host()
+    _Interp(h2, addr, prog, invocation=None, depth=0).run(b"incr", [])
+    scval_us = best_us(
+        lambda: _Interp(h2, addr, prog, invocation=None,
+                        depth=0).run(b"incr", []), h2)
+    assert native_us <= 2.0 * scval_us, (native_us, scval_us)
+
+
+def _best_under(run, bound_ms, attempts=3, backoff_s=3.0):
+    """Best-of-N with early exit and a backoff sleep between attempts:
+    shared-host contention is time-correlated, so back-to-back retries
+    alone re-measure the same noisy neighbor — spacing the retries is
+    what makes a tight bound non-flaky."""
+    import time
+    best = float("inf")
+    for i in range(attempts):
+        best = min(best, run()["close_mean_ms"])
+        if best <= bound_ms:
+            return best
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    return best
+
+
 def test_soroban_close_latency_budget():
-    """500-tx soroban ledgers must close well inside the 5s cadence —
-    guard at 1.5s mean on CI-class hosts (measured ~0.55s after the
-    r4 codec/bridge work; ~3x headroom absorbs shared-host noise; the
-    on-device target is <500ms with the verify batch on the TPU)."""
+    """500-tx soroban ledgers must close well inside the 5s cadence.
+    VERDICT r4 #5: budgets must BIND — measured 420-560ms mean on this
+    class of host (r5), so 800ms catches a 2x regression instead of
+    waving it through."""
     from stellar_tpu.simulation.load_generator import (
         soroban_apply_load,
     )
-    r = soroban_apply_load(n_ledgers=2, txs_per_ledger=500,
-                           use_wasm=True)
-    assert r["close_mean_ms"] <= 1500.0, r["close_mean_ms"]
+    best = _best_under(
+        lambda: soroban_apply_load(n_ledgers=2, txs_per_ledger=500,
+                                   use_wasm=True), 800.0)
+    assert best <= 800.0, best
 
 
 def test_classic_close_latency_budget():
-    """100-tx classic ledgers: measured ~22ms mean after the r4
-    codec work. The bound is an order-of-magnitude guard: a 1-CPU CI
-    host mid-suite showed ~200ms under contention, so 400ms catches
-    an accidentally quadratic close without flaking."""
+    """100-tx classic ledgers: measured 18-38ms mean (r5). 120ms
+    catches a 2x regression from the measured state (VERDICT r4 #5)."""
     from stellar_tpu.simulation.load_generator import apply_load
-    r = apply_load(n_ledgers=5, txs_per_ledger=100)
-    assert r["close_mean_ms"] <= 400.0, r["close_mean_ms"]
+    best = _best_under(
+        lambda: apply_load(n_ledgers=5, txs_per_ledger=100), 120.0)
+    assert best <= 120.0, best
 
 
 def test_catchup_replay_budget():
