@@ -1682,15 +1682,34 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return cv.new_obj(TAG_BYTES_OBJ,
                           B.g1_encode(B.g1_msm(list(zip(ks, pts)))))
 
+    def _h2c():
+        from stellar_tpu.crypto import h2c
+        return h2c
+
     def bls12_381_map_fp_to_g1(inst, fp_val):
-        raise EnvError(
-            "bls12_381_map_fp_to_g1 not implemented in this build "
-            "(RFC 9380 SSWU isogeny constants unavailable)")
+        # RFC 9380 map_to_curve: SSWU + 11-isogeny, NO cofactor
+        # clearing (reference host WBMap semantics — the result is
+        # on-curve but generally outside the r-subgroup); constants
+        # derived and verified by tools/derive_h2c.py (reproduces the
+        # RFC's own curve parameters and Z = 11)
+        charge(1_500_000, 96)
+        raw = bytes(_bytes_of(fp_val))
+        if len(raw) != 48:
+            raise EnvError("fp encoding must be 48 bytes")
+        u = int.from_bytes(raw, "big")
+        if u >= _bls().P:
+            raise EnvError("fp value out of range")
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          _bls().g1_encode(_h2c().map_fp_to_g1(u)))
 
     def bls12_381_hash_to_g1(inst, msg_val, dst_val):
-        raise EnvError(
-            "bls12_381_hash_to_g1 not implemented in this build "
-            "(RFC 9380 SSWU isogeny constants unavailable)")
+        charge(3_000_000, 96)
+        msg = bytes(_bytes_of(msg_val))
+        dst = bytes(_bytes_of(dst_val))
+        if not dst or len(dst) > 255:
+            raise EnvError("dst must be 1..255 bytes")
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          _bls().g1_encode(_h2c().hash_to_g1(msg, dst)))
 
     def bls12_381_check_g2_is_in_subgroup(inst, p_val):
         charge(1_000_000, 0)
@@ -1726,14 +1745,26 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
                           B.g2_encode(B.g2_msm(list(zip(ks, pts)))))
 
     def bls12_381_map_fp2_to_g2(inst, fp2_val):
-        raise EnvError(
-            "bls12_381_map_fp2_to_g2 not implemented in this build "
-            "(RFC 9380 SSWU isogeny constants unavailable)")
+        # same wire convention as the g2 point codec: c1 || c0
+        charge(3_000_000, 192)
+        raw = bytes(_bytes_of(fp2_val))
+        if len(raw) != 96:
+            raise EnvError("fp2 encoding must be 96 bytes")
+        c1 = int.from_bytes(raw[:48], "big")
+        c0 = int.from_bytes(raw[48:], "big")
+        if c0 >= _bls().P or c1 >= _bls().P:
+            raise EnvError("fp2 value out of range")
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          _bls().g2_encode(_h2c().map_fp2_to_g2((c0, c1))))
 
     def bls12_381_hash_to_g2(inst, msg_val, dst_val):
-        raise EnvError(
-            "bls12_381_hash_to_g2 not implemented in this build "
-            "(RFC 9380 SSWU isogeny constants unavailable)")
+        charge(6_000_000, 192)
+        msg = bytes(_bytes_of(msg_val))
+        dst = bytes(_bytes_of(dst_val))
+        if not dst or len(dst) > 255:
+            raise EnvError("dst must be 1..255 bytes")
+        return cv.new_obj(TAG_BYTES_OBJ,
+                          _bls().g2_encode(_h2c().hash_to_g2(msg, dst)))
 
     def bls12_381_multi_pairing_check(inst, vp1_val, vp2_val):
         B = _bls()

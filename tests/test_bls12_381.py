@@ -178,10 +178,19 @@ def test_host_fns_end_to_end():
     r = table_fn(t, "bls12_381_fr_sub")(inst, a_val, b_val)
     assert table_fn(t, "obj_to_u256_lo_lo")(inst, r) == 5
 
-    # hash-to-curve stubs trap with an explicit message
-    with pytest.raises(EnvError, match="not implemented"):
+    # hash_to_g1 through the table: deterministic valid subgroup point
+    h1 = table_fn(t, "bls12_381_hash_to_g1")(inst, b_obj(b"m"),
+                                             b_obj(b"dst"))
+    raw1 = bytes(cv.obj(h1, TAG_BYTES_OBJ))
+    assert len(raw1) == 96
+    g1_check(g1_decode(raw1))  # on-curve AND r-subgroup
+    h1b = table_fn(t, "bls12_381_hash_to_g1")(inst, b_obj(b"m"),
+                                              b_obj(b"dst"))
+    assert bytes(cv.obj(h1b, TAG_BYTES_OBJ)) == raw1
+    # empty DST is rejected (RFC 9380 requires a nonempty tag)
+    with pytest.raises(EnvError, match="dst"):
         table_fn(t, "bls12_381_hash_to_g1")(inst, b_obj(b"m"),
-                                            b_obj(b"dst"))
+                                            b_obj(b""))
 
 
 def test_non_subgroup_point_rejected():

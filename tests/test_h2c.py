@@ -1,0 +1,155 @@
+"""BLS12-381 hash-to-curve (RFC 9380 SSWU + derived isogeny).
+
+The constants are derived offline by tools/derive_h2c.py; the
+derivation independently reproduced the RFC's published curve
+parameters (G1 A' = 0x144698a3..., Z = 11; G2 B' = 1012(1+i),
+Z = -(2+i)), and these tests pin the runtime properties that make the
+construction a correct hash-to-curve: on-curve + r-subgroup outputs,
+determinism, message/DST separation, uniform-ish spread, and the
+exceptional SSWU inputs.
+"""
+
+import pytest
+
+from stellar_tpu.crypto import h2c
+from stellar_tpu.crypto._h2c_constants import G1, G2, H_EFF_G1
+from stellar_tpu.crypto.bls12_381 import P, R, g1_check, g2_check
+
+DST1 = b"STELLAR_TPU-V01-CS01-with-BLS12381G1_XMD:SHA-256_SSWU_RO_"
+DST2 = b"STELLAR_TPU-V01-CS01-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+
+def test_expand_message_xmd_shape():
+    out = h2c.expand_message_xmd(b"abc", b"dst", 128)
+    assert len(out) == 128
+    # deterministic, message- and dst-separated, length-separated
+    assert out == h2c.expand_message_xmd(b"abc", b"dst", 128)
+    assert out != h2c.expand_message_xmd(b"abd", b"dst", 128)
+    assert out != h2c.expand_message_xmd(b"abc", b"dst2", 128)
+    assert out[:64] != h2c.expand_message_xmd(b"abc", b"dst", 64)
+
+
+def test_hash_to_field_in_range():
+    for u in h2c.hash_to_field_fp(b"msg", DST1, 2):
+        assert 0 <= u < P
+    for (c0, c1) in h2c.hash_to_field_fp2(b"msg", DST2, 2):
+        assert 0 <= c0 < P and 0 <= c1 < P
+
+
+def test_hash_to_g1_subgroup_and_determinism():
+    p1 = h2c.hash_to_g1(b"sample message", DST1)
+    g1_check(p1)  # raises unless on-curve AND in the r-subgroup
+    assert p1 == h2c.hash_to_g1(b"sample message", DST1)
+    assert p1 != h2c.hash_to_g1(b"sample messagf", DST1)
+    assert p1 != h2c.hash_to_g1(b"sample message", DST1 + b"x")
+
+
+def test_hash_to_g2_subgroup_and_determinism():
+    q = h2c.hash_to_g2(b"sample message", DST2)
+    g2_check(q)
+    assert q == h2c.hash_to_g2(b"sample message", DST2)
+    assert q != h2c.hash_to_g2(b"other", DST2)
+
+
+def test_map_fp_variants_on_curve_not_cleared():
+    """map_fp(2)_to_g1(2) is RFC map_to_curve: on-curve output WITHOUT
+    cofactor clearing (reference WBMap semantics) — generally outside
+    the r-subgroup, and that is contract-visible behavior."""
+    from stellar_tpu.crypto.bls12_381 import BlsError
+    for u in (0, 1, 5, P - 1, 0xDEADBEEF):
+        g1_check(h2c.map_fp_to_g1(u), subgroup=False)
+    for u in ((0, 0), (1, 0), (0, 1), (P - 1, P - 1)):
+        g2_check(h2c.map_fp2_to_g2(u), subgroup=False)
+    # u=5's uncleared point is NOT in the subgroup (verified by the
+    # review cross-check); clearing here would silently diverge from
+    # the reference host
+    with pytest.raises(BlsError, match="subgroup"):
+        g1_check(h2c.map_fp_to_g1(5))
+
+
+def test_sswu_exceptional_input():
+    """u with Z^2 u^4 + Z u^2 == 0 (u = 0) takes the exceptional
+    branch and still produces a valid point."""
+    x, y = h2c._sswu(h2c._FpExt, G1["A2"], G1["B2"], G1["Z"], 0)
+    lhs = y * y % P
+    rhs = (x * x * x + G1["A2"] * x + G1["B2"]) % P
+    assert lhs == rhs
+
+
+def test_outputs_spread():
+    """64 distinct messages -> 64 distinct points (a constant or
+    near-constant map would collide immediately)."""
+    seen = {h2c.hash_to_g1(bytes([i]) * 8, DST1) for i in range(64)}
+    assert len(seen) == 64
+
+
+def test_derived_constants_sanity():
+    """The committed constants keep the properties the derivation
+    verified: SSWU-able curve (A'B' != 0), RFC Z values, and the
+    isogeny degree."""
+    assert G1["A2"] % P != 0 and G1["B2"] % P != 0
+    assert G1["Z"] == 11          # matches RFC 9380 G1 suite
+    assert G1["ell"] == 11
+    assert G2["Z"] == ((-2) % P, (-1) % P)  # -(2+i), RFC G2 suite
+    assert G2["ell"] == 3
+    assert G2["B2"] == (1012, 1012)         # 1012(1+i), RFC value
+    assert H_EFF_G1 == 1 + 0xD201000000010000  # 1 - z
+
+
+def test_rfc_g1_isogenous_curve_reproduced():
+    """The derivation's E' equals the RFC 9380 11-isogenous curve for
+    G1 (A' is the RFC's published constant) — strong evidence the whole
+    construction matches the standard, since E' was computed from
+    Velu's formulas, not copied."""
+    assert G1["A2"] == int(
+        "144698a3b8e9433d693a02c96d4982b0ea985383ee66a8d8e8981aef"
+        "d881ac98936f8da0e0f97f5cf428082d584c1d", 16)
+
+
+# ---------------------------------------------------------------------------
+# pinned outputs, QUUX test suites (G1 cross-checked byte-exact against
+# the RFC 9380 vectors by an external review pass; G2 pinned after the
+# Aut(E) post-composition + RFC h_eff fix from the same cross-check)
+# ---------------------------------------------------------------------------
+
+QG1 = b"QUUX-V01-CS02-with-BLS12381G1_XMD:SHA-256_SSWU_RO_"
+QG2 = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+
+def test_hash_to_g1_pinned_vectors():
+    p = h2c.hash_to_g1(b"", QG1)
+    assert p[0] == int(
+        "052926add2207b76ca4fa57a8734416c8dc95e24501772c81427870"
+        "0eed6d1e4e8cf62d9c09db0fac349612b759e79a1", 16)
+    # y pinned too: a sgn0/post_y_mul regression would negate y while
+    # passing every structural test (review cross-check: y matches RFC)
+    assert p[1] == int(
+        "08ba738453bfed09cb546dbb0783dbb3a5f1f566ed67bb6be0e8c67"
+        "e2e81a4cc68ee29813bb7994998f3eae0c9c6a265", 16)
+    p = h2c.hash_to_g1(b"abc", QG1)
+    assert p[0] == int(
+        "03567bc5ef9c690c2ab2ecdf6a96ef1c139cc0b2f284dca0a9a7943"
+        "388a49a3aee664ba5379a7655d3c68900be2f6903", 16)
+    assert p[1] == int(
+        "0b9c15f3fe6e5cf4211f346271d7b01c8f3b28be689c8429c85b67a"
+        "f215533311f0b8dfaaa154fa6b88176c229f2885d", 16)
+
+
+def test_hash_to_g2_pinned_vectors():
+    q = h2c.hash_to_g2(b"", QG2)
+    assert q[0] == (int(
+        "0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266"
+        "f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a", 16), int(
+        "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab37"
+        "9ba13dff5bf5dd71b72418717047f5b0f37da03d", 16))
+    assert q[1] == (int(
+        "0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d1106"
+        "2ee75ec076daf2d4bc358c4b190c0c98064fdd92", 16), int(
+        "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc"
+        "7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6", 16))
+    q = h2c.hash_to_g2(b"abc", QG2)
+    assert q[0] == (int(
+        "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245"
+        "129dbec7780ccc7954725f4168aff2787776e6", 16), int(
+        "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b"
+        "41dfe4ca3a230ed250fbe3a2acf73a41177fd8", 16))

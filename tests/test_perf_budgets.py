@@ -163,12 +163,19 @@ def test_wasm_engine_invoke_overhead_bounded():
     h1, b1 = mk_host()
     env = WasmContractEnv(h1, addr, None, 0)
     imports = make_imports(env)
-    native_wasm.run_export(module, imports, b1, 4, "incr", [],
-                           cache_imports=True)
-    native_us = best_us(
-        lambda: native_wasm.run_export(module, imports, b1, 4,
-                                       "incr", [], cache_imports=True),
-        h1)
+    try:
+        native_wasm.run_export(module, imports, b1, 4, "incr", [],
+                               cache_imports=True)
+        native_us = best_us(
+            lambda: native_wasm.run_export(
+                module, imports, b1, 4, "incr", [],
+                cache_imports=True),
+            h1)
+    finally:
+        # the module is process-cached by content hash: leaving this
+        # test's imports dict cached on it would pin the test host
+        # graph for the rest of the pytest process
+        module._host_fns_cache = None
 
     body = [
         ins("push", sym("count")), ins("has", sym("persistent")),

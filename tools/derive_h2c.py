@@ -1,0 +1,854 @@
+#!/usr/bin/env python
+"""Derive SSWU isogeny constants for BLS12-381 hash-to-curve
+(VERDICT r4 #7) and write ``stellar_tpu/crypto/_h2c_constants.py``.
+
+The RFC 9380 suites map into an isogenous curve E' (SSWU needs
+A·B != 0; BLS12-381 has A = 0) and then apply a fixed ell-isogeny
+E' -> E. The RFC's coefficient tables are not available offline, so
+this tool RE-DERIVES a valid construction from first principles:
+
+1. find the rational order-ell subgroup of E (ell = 11 for G1, 3 for
+   G2 — both exist: 11 | #E(Fp), 3 | #E2(Fp2), verified here);
+2. Velu's formulas give the quotient curve E' = E/<Q> and the
+   normalized isogeny phi: E -> E' as explicit rational maps;
+3. the iso_map we need is the DUAL phi_hat: E' -> E. Its x-map
+   X_hat satisfies X_hat(X_phi(x)) = x_[ell](x) (multiplication-by-ell
+   on E, via division polynomials) — LINEAR in X_hat's coefficients,
+   so a nullspace solve over the field recovers it exactly;
+4. the y-map of a degree-ell map with phi_hat* omega = ell*omega' is
+   y * X_hat'(x) / ell; verified on random points (lands on E);
+5. Z for SSWU is chosen by the RFC's own find_z_sswu criteria.
+
+Everything emitted is VERIFIED in-process: kernel order, quotient
+curve non-degeneracy (A'B' != 0), forward map lands on E', dual map
+lands on E, dual∘forward == [ell], Z criteria, cofactor clearing
+lands in the r-subgroup. What CANNOT be verified offline is that
+these constants equal RFC 9380's published tables bit-for-bit (the
+RFC fixed one specific isogenous model; ours is the Velu-normalized
+quotient by the rational kernel). The construction is cryptographically
+equivalent: deterministic, uniform, constant interface. See
+docs/parity.md for the compatibility note.
+
+Reference scope: the p22 host's bls12_381_hash_to_g1/g2 exports
+(/root/reference/src/rust/Cargo.toml:51-80, CAP-59).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+Z_BLS = -0xD201000000010000
+
+
+# ---------------------------------------------------------------------------
+# fields
+# ---------------------------------------------------------------------------
+
+class Fp:
+    name = "fp"
+
+    @staticmethod
+    def zero():
+        return 0
+
+    @staticmethod
+    def one():
+        return 1
+
+    @staticmethod
+    def from_int(n):
+        return n % P
+
+    @staticmethod
+    def add(a, b):
+        return (a + b) % P
+
+    @staticmethod
+    def sub(a, b):
+        return (a - b) % P
+
+    @staticmethod
+    def neg(a):
+        return (-a) % P
+
+    @staticmethod
+    def mul(a, b):
+        return (a * b) % P
+
+    @staticmethod
+    def inv(a):
+        return pow(a, P - 2, P)
+
+    @staticmethod
+    def is_zero(a):
+        return a % P == 0
+
+    @staticmethod
+    def eq(a, b):
+        return (a - b) % P == 0
+
+    @staticmethod
+    def is_square(a):
+        return a % P == 0 or pow(a, (P - 1) // 2, P) == 1
+
+    @staticmethod
+    def sqrt(a):
+        a %= P
+        s = pow(a, (P + 1) // 4, P)  # P % 4 == 3
+        return s if s * s % P == a else None
+
+
+class Fp2:
+    """Fp[i]/(i^2+1); elements are (a0, a1) = a0 + a1*i."""
+    name = "fp2"
+
+    @staticmethod
+    def zero():
+        return (0, 0)
+
+    @staticmethod
+    def one():
+        return (1, 0)
+
+    @staticmethod
+    def from_int(n):
+        return (n % P, 0)
+
+    @staticmethod
+    def add(a, b):
+        return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+    @staticmethod
+    def sub(a, b):
+        return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+    @staticmethod
+    def neg(a):
+        return ((-a[0]) % P, (-a[1]) % P)
+
+    @staticmethod
+    def mul(a, b):
+        return ((a[0] * b[0] - a[1] * b[1]) % P,
+                (a[0] * b[1] + a[1] * b[0]) % P)
+
+    @staticmethod
+    def inv(a):
+        n = pow((a[0] * a[0] + a[1] * a[1]) % P, P - 2, P)
+        return (a[0] * n % P, (-a[1]) * n % P)
+
+    @staticmethod
+    def is_zero(a):
+        return a[0] % P == 0 and a[1] % P == 0
+
+    @staticmethod
+    def eq(a, b):
+        return (a[0] - b[0]) % P == 0 and (a[1] - b[1]) % P == 0
+
+    @staticmethod
+    def is_square(a):
+        if Fp2.is_zero(a):
+            return True
+        # a square iff a^((p^2-1)/2) == 1; use norm: a^((p^2-1)/2) =
+        # norm(a)^((p-1)/2)
+        n = (a[0] * a[0] + a[1] * a[1]) % P
+        return pow(n, (P - 1) // 2, P) == 1
+
+    @staticmethod
+    def sqrt(a):
+        a0, a1 = a[0] % P, a[1] % P
+        if a1 == 0:
+            s = Fp.sqrt(a0)
+            if s is not None:
+                return (s, 0)
+            s = Fp.sqrt((-a0) % P)
+            if s is not None:
+                return (0, s)
+            return None
+        n = (a0 * a0 + a1 * a1) % P
+        s = Fp.sqrt(n)
+        if s is None:
+            return None
+        inv2 = (P + 1) // 2
+        for sg in (s, (-s) % P):
+            half = (a0 + sg) * inv2 % P
+            x0 = Fp.sqrt(half)
+            if x0 is None or x0 == 0:
+                continue
+            x1 = a1 * pow(2 * x0 % P, P - 2, P) % P
+            cand = (x0, x1)
+            if Fp2.eq(Fp2.mul(cand, cand), (a0, a1)):
+                return cand
+        return None
+
+
+# ---------------------------------------------------------------------------
+# polynomials (coeff lists, low -> high) over a field F
+# ---------------------------------------------------------------------------
+
+def ptrim(F, p):
+    while p and F.is_zero(p[-1]):
+        p.pop()
+    return p
+
+
+def padd(F, a, b):
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else F.zero()
+        y = b[i] if i < len(b) else F.zero()
+        out.append(F.add(x, y))
+    return ptrim(F, out)
+
+
+def psub(F, a, b):
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else F.zero()
+        y = b[i] if i < len(b) else F.zero()
+        out.append(F.sub(x, y))
+    return ptrim(F, out)
+
+
+def pmul(F, a, b):
+    if not a or not b:
+        return []
+    out = [F.zero()] * (len(a) + len(b) - 1)
+    for i, x in enumerate(a):
+        if F.is_zero(x):
+            continue
+        for j, y in enumerate(b):
+            out[i + j] = F.add(out[i + j], F.mul(x, y))
+    return ptrim(F, out)
+
+
+def pscale(F, a, k):
+    return ptrim(F, [F.mul(c, k) for c in a])
+
+
+def peval(F, a, x):
+    acc = F.zero()
+    for c in reversed(a):
+        acc = F.add(F.mul(acc, x), c)
+    return acc
+
+
+def pderiv(F, a):
+    return ptrim(F, [F.mul(c, F.from_int(i))
+                     for i, c in enumerate(a)][1:])
+
+
+def pdiv_exact(F, a, b):
+    """a / b for polynomials with zero remainder (asserted)."""
+    a = list(a)
+    out = [F.zero()] * (len(a) - len(b) + 1)
+    binv = F.inv(b[-1])
+    for i in range(len(out) - 1, -1, -1):
+        c = F.mul(a[i + len(b) - 1], binv)
+        out[i] = c
+        if not F.is_zero(c):
+            for j, bc in enumerate(b):
+                a[i + j] = F.sub(a[i + j], F.mul(c, bc))
+    assert all(F.is_zero(x) for x in a[:len(b) - 1]), \
+        "inexact polynomial division"
+    return ptrim(F, out)
+
+
+# ---------------------------------------------------------------------------
+# curve helpers (affine, None = infinity) over field F
+# ---------------------------------------------------------------------------
+
+def pt_add(F, A, p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if F.eq(x1, x2):
+        if F.eq(y1, F.neg(y2)):
+            return None
+        num = F.add(F.mul(F.from_int(3), F.mul(x1, x1)), A)
+        den = F.mul(F.from_int(2), y1)
+    else:
+        num = F.sub(y2, y1)
+        den = F.sub(x2, x1)
+    lam = F.mul(num, F.inv(den))
+    x3 = F.sub(F.sub(F.mul(lam, lam), x1), x2)
+    y3 = F.sub(F.mul(lam, F.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def pt_mul(F, A, k, pt):
+    out = None
+    acc = pt
+    while k:
+        if k & 1:
+            out = pt_add(F, A, out, acc)
+        acc = pt_add(F, A, acc, acc)
+        k >>= 1
+    return out
+
+
+def on_curve(F, A, B, pt):
+    if pt is None:
+        return True
+    x, y = pt
+    lhs = F.mul(y, y)
+    rhs = F.add(F.add(F.mul(F.mul(x, x), x), F.mul(A, x)), B)
+    return F.eq(lhs, rhs)
+
+
+def find_point(F, A, B, start=1):
+    n = start
+    while True:
+        x = F.from_int(n)
+        rhs = F.add(F.add(F.mul(F.mul(x, x), x), F.mul(A, x)), B)
+        y = F.sqrt(rhs)
+        if y is not None:
+            return (x, y)
+        n += 1
+
+
+def find_point_fp2(A, B, start=1):
+    """Deterministic Fp2 point search over x = c0 + c1*i."""
+    F = Fp2
+    n = start
+    while True:
+        for c1 in range(0, n + 1):
+            x = (n % P, c1 % P)
+            rhs = F.add(F.add(F.mul(F.mul(x, x), x), F.mul(A, x)), B)
+            y = F.sqrt(rhs)
+            if y is not None:
+                return (x, y)
+        n += 1
+
+
+# ---------------------------------------------------------------------------
+# division polynomials with implicit y: value = poly * y^k, k in {0,1}
+# ---------------------------------------------------------------------------
+
+def dp_mul(F, f, a, b):
+    pa, ka = a
+    pb, kb = b
+    k = ka + kb
+    out = pmul(F, pa, pb)
+    if k >= 2:
+        out = pmul(F, out, f)  # y^2 -> f
+        k -= 2
+    return (out, k)
+
+
+def dp_sub(F, a, b):
+    assert a[1] == b[1], "mixed y-parity subtraction"
+    return (psub(F, a[0], b[0]), a[1])
+
+
+def division_polys(F, A, B, upto):
+    """psi_0..psi_upto for y^2 = x^3 + Ax + B, as (poly, y_parity)."""
+    f = [B, A, F.zero(), F.one()]
+    two_inv_y = None  # division by 2y handled via parity bookkeeping
+    psi = {
+        0: ([], 0),
+        1: ([F.one()], 0),
+        2: ([F.from_int(2)], 1),  # 2y
+        3: (ptrim(F, [
+            F.neg(F.mul(A, A)),
+            F.mul(F.from_int(12), B),
+            F.mul(F.from_int(6), A),
+            F.zero(),
+            F.from_int(3)]), 0),
+    }
+    # psi_4 = 4y (x^6 + 5A x^4 + 20B x^3 - 5A^2 x^2 - 4AB x - 8B^2 - A^3)
+    A2 = F.mul(A, A)
+    psi[4] = (pscale(F, ptrim(F, [
+        F.sub(F.neg(F.mul(F.from_int(8), F.mul(B, B))),
+              F.mul(A, A2)),
+        F.neg(F.mul(F.from_int(4), F.mul(A, B))),
+        F.neg(F.mul(F.from_int(5), A2)),
+        F.mul(F.from_int(20), B),
+        F.mul(F.from_int(5), A),
+        F.zero(),
+        F.one()]), F.from_int(4)), 1)
+    inv2 = F.inv(F.from_int(2))
+    for n in range(5, upto + 1):
+        if n % 2 == 1:
+            m = (n - 1) // 2
+            t1 = dp_mul(F, f, psi[m + 2],
+                        dp_mul(F, f, psi[m],
+                               dp_mul(F, f, psi[m], psi[m])))
+            t2 = dp_mul(F, f, psi[m - 1],
+                        dp_mul(F, f, psi[m + 1],
+                               dp_mul(F, f, psi[m + 1], psi[m + 1])))
+            psi[n] = dp_sub(F, t1, t2)
+        else:
+            m = n // 2
+            t1 = dp_mul(F, f, psi[m + 2],
+                        dp_mul(F, f, psi[m - 1], psi[m - 1]))
+            t2 = dp_mul(F, f, psi[m - 2],
+                        dp_mul(F, f, psi[m + 1], psi[m + 1]))
+            inner = dp_sub(F, t1, t2)
+            poly, k = dp_mul(F, f, psi[m], inner)
+            # divide by 2y: (p*y)/(2y) = p/2 with parity 0;
+            # (p)/(2y) = p*y/(2f) with parity 1 (f must divide exactly)
+            if k == 1:
+                psi[n] = (pscale(F, poly, inv2), 0)
+            else:
+                psi[n] = (pscale(F, pdiv_exact(F, poly, f), inv2), 1)
+    return psi
+
+
+def mul_by_ell_xmap(F, A, B, ell):
+    """x-map of [ell] as (num, den): x - psi_{l-1} psi_{l+1} / psi_l^2."""
+    assert ell % 2 == 1
+    psi = division_polys(F, A, B, ell + 1)
+    f = [B, A, F.zero(), F.one()]
+    num_lm1_lp1 = dp_mul(F, f, psi[ell - 1], psi[ell + 1])
+    assert num_lm1_lp1[1] == 0, "even*even parity must cancel"
+    den = dp_mul(F, f, psi[ell], psi[ell])
+    assert den[1] == 0
+    # x*den - num
+    num = psub(F, pmul(F, [F.zero(), F.one()], den[0]), num_lm1_lp1[0])
+    return num, den[0]
+
+
+# ---------------------------------------------------------------------------
+# Velu: quotient curve + forward x-map
+# ---------------------------------------------------------------------------
+
+def velu(F, A, B, kernel_xy2, ell):
+    """E/<kernel> for odd prime ell: returns (A2, B2, N, D) with the
+    normalized forward x-map N/D (deg ell / ell-1). ``kernel_xy2`` is
+    [(x_T, y_T^2)] for one representative of each +-pair — Velu's
+    formulas never need y itself, so a Galois-stable kernel whose
+    points live over a quadratic extension (y_T outside F) works the
+    same as a rational one."""
+    v = F.zero()
+    w = F.zero()
+    terms = []
+    for (xT, yT2) in kernel_xy2:
+        gx = F.add(F.mul(F.from_int(3), F.mul(xT, xT)), A)
+        uT = F.mul(F.from_int(4), yT2)
+        vT = F.mul(F.from_int(2), gx)
+        v = F.add(v, vT)
+        w = F.add(w, F.add(uT, F.mul(xT, vT)))
+        terms.append((xT, vT, uT))
+    A2 = F.sub(A, F.mul(F.from_int(5), v))
+    B2 = F.sub(B, F.mul(F.from_int(7), w))
+    # X(x) = x + sum vT/(x-xT) + uT/(x-xT)^2 over common denominator
+    # D(x) = prod (x-xT)^2
+    D = [F.one()]
+    for (xT, _v, _u) in terms:
+        lin = [F.neg(xT), F.one()]
+        D = pmul(F, pmul(F, lin, lin), D)
+    N = pmul(F, [F.zero(), F.one()], D)
+    for i, (xT, vT, uT) in enumerate(terms):
+        rest = [F.one()]
+        for j, (xT2, _v2, _u2) in enumerate(terms):
+            if j == i:
+                continue
+            lin = [F.neg(xT2), F.one()]
+            rest = pmul(F, pmul(F, lin, lin), rest)
+        lin_i = [F.neg(xT), F.one()]
+        N = padd(F, N, pmul(F, padd(F, pmul(F, [vT], lin_i), [uT]),
+                            rest))
+    return A2, B2, N, D
+
+
+# ---------------------------------------------------------------------------
+# linear algebra over F
+# ---------------------------------------------------------------------------
+
+def nullspace_1(F, rows, ncols):
+    """One nullspace vector of the given row system (asserts rank
+    == ncols-1 so the solution is unique up to scale)."""
+    m = [list(r) for r in rows]
+    piv_cols = []
+    r = 0
+    for c in range(ncols):
+        piv = None
+        for i in range(r, len(m)):
+            if not F.is_zero(m[i][c]):
+                piv = i
+                break
+        if piv is None:
+            continue
+        m[r], m[piv] = m[piv], m[r]
+        inv = F.inv(m[r][c])
+        m[r] = [F.mul(x, inv) for x in m[r]]
+        for i in range(len(m)):
+            if i != r and not F.is_zero(m[i][c]):
+                k = m[i][c]
+                m[i] = [F.sub(x, F.mul(k, y))
+                        for x, y in zip(m[i], m[r])]
+        piv_cols.append(c)
+        r += 1
+    free = [c for c in range(ncols) if c not in piv_cols]
+    assert len(free) == 1, f"nullspace dimension {len(free)} != 1"
+    fc = free[0]
+    sol = [F.zero()] * ncols
+    sol[fc] = F.one()
+    for row_i, pc in enumerate(piv_cols):
+        sol[pc] = F.neg(m[row_i][fc])
+    return sol
+
+
+# ---------------------------------------------------------------------------
+# dual isogeny via X_hat(X_phi(x)) = x_[ell](x)
+# ---------------------------------------------------------------------------
+
+def solve_dual(F, ell, N, D, mul_num, mul_den, samples):
+    """Coefficients (Nhat deg<=ell, Dhat deg<=ell-1) of the dual's
+    x-map, from linear equations at sample x values."""
+    ncols = (ell + 1) + ell
+    rows = []
+    for xv in samples:
+        d = peval(F, D, xv)
+        md = peval(F, mul_den, xv)
+        if F.is_zero(d) or F.is_zero(md):
+            continue
+        a = F.mul(peval(F, N, xv), F.inv(d))        # X_phi(x)
+        b = F.mul(peval(F, mul_num, xv), F.inv(md))  # x_[ell](x)
+        row = []
+        acc = F.one()
+        for _ in range(ell + 1):   # Nhat coeffs
+            row.append(acc)
+            acc = F.mul(acc, a)
+        acc = F.one()
+        for _ in range(ell):       # -b * Dhat coeffs
+            row.append(F.neg(F.mul(b, acc)))
+            acc = F.mul(acc, a)
+        rows.append(row)
+    sol = nullspace_1(F, rows, ncols)
+    return sol[:ell + 1], sol[ell + 1:]
+
+
+# ---------------------------------------------------------------------------
+# SSWU Z per RFC find_z_sswu
+# ---------------------------------------------------------------------------
+
+def cubic_has_root(F, c0, c1, c2):
+    """Does x^3 + c2 x^2 + c1 x + c0 have a root in F? Tested via
+    gcd(x^|F| - x, cubic) != 1, computing x^|F| by square-and-multiply
+    modulo the cubic (|F| = p or p^2)."""
+    mod = [c0, c1, c2, F.one()]
+
+    def pmod(a):
+        a = list(a)
+        while len(a) > 3:
+            lead = a.pop()
+            if F.is_zero(lead):
+                continue
+            d = len(a) - 3
+            for j in range(3):
+                a[d + j] = F.sub(a[d + j], F.mul(lead, mod[j]))
+        return ptrim(F, a)
+
+    q = P if F is Fp else P * P
+    acc = [F.zero(), F.one()]  # x
+    out = [F.one()]
+    e = q
+    while e:
+        if e & 1:
+            out = pmod(pmul(F, out, acc))
+        acc = pmod(pmul(F, acc, acc))
+        e >>= 1
+    # gcd(x^q - x, cubic): a root exists iff x^q == x has a common
+    # factor with the cubic
+    diff = psub(F, out, [F.zero(), F.one()])
+    a, b = mod, diff
+    while b:
+        # a mod b
+        a = list(a)
+        binv = F.inv(b[-1])
+        while len(a) >= len(b):
+            lead = F.mul(a[-1], binv)
+            d = len(a) - len(b)
+            for j in range(len(b)):
+                a[d + j] = F.sub(a[d + j], F.mul(lead, b[j]))
+            a.pop()
+            a = ptrim(F, a)
+            if not a:
+                break
+        a, b = b, a
+    return len(a) > 1  # non-constant gcd => root in F
+
+
+def find_z(F, A2, B2, fp2=False):
+    """RFC 9380 F.1 find_z_sswu criteria, all four: Z non-square,
+    Z != -1, g(x) - Z irreducible (cubic: no F-root), g(B/(Z*A))
+    square."""
+    def g(x):
+        return F.add(F.add(F.mul(F.mul(x, x), x), F.mul(A2, x)), B2)
+
+    def ok(Z):
+        if F.is_zero(Z) or F.is_square(Z):
+            return False
+        if F.eq(Z, F.neg(F.one())):
+            return False
+        den = F.mul(Z, A2)
+        if F.is_zero(den):
+            return False
+        if not F.is_square(g(F.mul(B2, F.inv(den)))):
+            return False
+        if cubic_has_root(F, F.sub(B2, Z), A2, F.zero()):
+            return False  # g(x) - Z reducible
+        return True
+
+    if not fp2:
+        n = 1
+        while True:
+            for s in (F.from_int(n), F.neg(F.from_int(n))):
+                if ok(s):
+                    return s
+            n += 1
+    # Fp2: enumerate small c0 + c1*i by max-norm, signs together
+    n = 1
+    while True:
+        for c0 in range(0, n + 1):
+            for cand in (((-c0) % P, (-n) % P), (c0 % P, n % P),
+                         ((-n) % P, (-c0) % P), (n % P, c0 % P)):
+                if ok(cand):
+                    return cand
+        n += 1
+
+
+# ---------------------------------------------------------------------------
+# main derivation per group
+# ---------------------------------------------------------------------------
+
+def derive(F, A, B, ell, n_order=None, fp2=False, kernel_x=None):
+    """Derive the SSWU curve + dual isogeny for one group.
+
+    Kernel selection: either from a rational order-ell point (found
+    via the group order ``n_order``) or from an explicit Galois-stable
+    kernel x-coordinate ``kernel_x`` (a root of the ell-division
+    polynomial in F whose points' y lives over a quadratic extension
+    — the G2 case, where E2(Fp2) has no 3-torsion)."""
+    def fx(x):
+        return F.add(F.add(F.mul(F.mul(x, x), x), F.mul(A, x)), B)
+
+    if kernel_x is not None:
+        assert ell == 3, "explicit-kernel path implemented for ell=3"
+        # verify psi_3(kernel_x) == 0: 3x^4 + 6Ax^2 + 12Bx - A^2
+        x = kernel_x
+        psi3 = F.add(F.add(F.add(
+            F.mul(F.from_int(3), F.mul(F.mul(x, x), F.mul(x, x))),
+            F.mul(F.from_int(6), F.mul(A, F.mul(x, x)))),
+            F.mul(F.from_int(12), F.mul(B, x))),
+            F.neg(F.mul(A, A)))
+        assert F.is_zero(psi3), "kernel_x is not a 3-torsion abscissa"
+        kernel = [(x, fx(x))]
+    else:
+        assert n_order is not None and n_order % ell == 0
+        # rational order-ell point (11^2 || n1, so cast down to exact
+        # order ell; ANY rational order-ell kernel yields a valid
+        # SSWU-able quotient — uniqueness only mattered for matching
+        # the RFC's specific model, unverifiable offline anyway)
+        cof = n_order // ell
+        while cof % ell == 0:
+            cof //= ell
+        start = 1
+        while True:
+            base = find_point_fp2(A, B, start) if fp2 else \
+                find_point(F, A, B, start)
+            Q = pt_mul(F, A, cof, base)
+            while Q is not None and pt_mul(F, A, ell, Q) is not None:
+                Q = pt_mul(F, A, ell, Q)
+            if Q is not None:
+                break
+            start += 1
+        assert pt_mul(F, A, ell, Q) is None, "kernel point order wrong"
+        kernel = []
+        acc = Q
+        for _ in range((ell - 1) // 2):
+            kernel.append((acc[0], F.mul(acc[1], acc[1])))
+            acc = pt_add(F, A, acc, Q)
+    A2, B2, N, D = velu(F, A, B, kernel, ell)
+    assert not F.is_zero(A2) and not F.is_zero(B2), \
+        "quotient curve degenerate for SSWU"
+    # verify forward map: random E point -> E'
+    for s in (5, 23, 101):
+        pt = find_point_fp2(A, B, s) if fp2 else find_point(F, A, B, s)
+        xv, yv = pt
+        dv = peval(F, D, xv)
+        if F.is_zero(dv):
+            continue
+        X = F.mul(peval(F, N, xv), F.inv(dv))
+        # y' = y * X'(x) (normalized Velu)
+        Np, Dp = pderiv(F, N), pderiv(F, D)
+        dXn = psub(F, pmul(F, Np, D), pmul(F, N, Dp))
+        Xp = F.mul(peval(F, dXn, xv), F.inv(F.mul(dv, dv)))
+        Y = F.mul(yv, Xp)
+        assert on_curve(F, A2, B2, (X, Y)), "forward Velu map broken"
+    # dual x-map
+    mul_num, mul_den = mul_by_ell_xmap(F, A, B, ell)
+    if fp2:
+        samples = [(n % P, (3 * n + 1) % P)
+                   for n in range(2, 2 + 3 * (2 * ell + 4))]
+    else:
+        samples = [F.from_int(n) for n in range(2, 2 + 3 * (2 * ell + 4))]
+    Nhat, Dhat = solve_dual(F, ell, N, D, mul_num, mul_den, samples)
+    # verify dual: E' -> E, with y-map y * Xhat'(x) / ell
+    Nhp, Dhp = pderiv(F, Nhat), pderiv(F, Dhat)
+    dXn = psub(F, pmul(F, Nhp, Dhat), pmul(F, Nhat, Dhp))
+    ell_inv = F.inv(F.from_int(ell))
+    checked = 0
+    s = 3
+    while checked < 5:
+        pt = find_point_fp2(A2, B2, s) if fp2 else \
+            find_point(F, A2, B2, s)
+        s = (pt[0][0] if fp2 else pt[0]) + 1
+        xv, yv = pt
+        dv = peval(F, Dhat, xv)
+        if F.is_zero(dv):
+            continue
+        X = F.mul(peval(F, Nhat, xv), F.inv(dv))
+        Xp = F.mul(peval(F, dXn, xv), F.inv(F.mul(dv, dv)))
+        Y = F.mul(yv, F.mul(Xp, ell_inv))
+        assert on_curve(F, A, B, (X, Y)), "dual isogeny map broken"
+        checked += 1
+    # verify composition on x: Xhat(Xphi(x)) == x_[ell](x)
+    for x in (7, 19):
+        xv = F.from_int(x)
+        a = F.mul(peval(F, N, xv), F.inv(peval(F, D, xv)))
+        lhs = F.mul(peval(F, Nhat, a), F.inv(peval(F, Dhat, a)))
+        rhs = F.mul(peval(F, mul_num, xv),
+                    F.inv(peval(F, mul_den, xv)))
+        assert F.eq(lhs, rhs), "dual∘forward != [ell]"
+    Z = find_z(F, A2, B2, fp2=fp2)
+    return {"A2": A2, "B2": B2, "Z": Z, "ell": ell,
+            "iso_num": Nhat, "iso_den": Dhat}
+
+
+def f2_pow(a, e):
+    out = Fp2.one()
+    b = a
+    while e:
+        if e & 1:
+            out = Fp2.mul(out, b)
+        b = Fp2.mul(b, b)
+        e >>= 1
+    return out
+
+
+def f2_cuberoot(c):
+    """Cube root in Fp2 (v3(p^2-1) == 2): x = c^(3^-1 mod m) times a
+    3-Sylow correction, brute-forced over the order-9 subgroup."""
+    m = (P * P - 1) // 9
+    assert m % 3 != 0
+    e = pow(3, -1, m)
+    base = f2_pow(c, e)
+    # 3-Sylow generator
+    syl = [Fp2.one()]
+    n = 2
+    while len(syl) < 9:
+        g = f2_pow((n % P, (n * 7 + 1) % P), m)
+        elems = [Fp2.one()]
+        acc = g
+        while not Fp2.eq(acc, Fp2.one()):
+            elems.append(acc)
+            acc = Fp2.mul(acc, g)
+        if len(elems) > len(syl):
+            syl = elems
+        n += 1
+    for s in syl:
+        x = Fp2.mul(base, s)
+        if Fp2.eq(Fp2.mul(Fp2.mul(x, x), x), c):
+            return x
+    return None
+
+
+def main():
+    t = Z_BLS + 1
+    n1 = P + 1 - t
+    assert n1 % R == 0 and n1 % 11 == 0
+    print("deriving G1 (11-isogeny)...", file=sys.stderr)
+    g1 = derive(Fp, 0, 4, 11, n_order=n1)
+
+    # G2 twist order: test candidates against a real point
+    t2 = t * t - 2 * P
+    f2 = (4 * P * P - t2 * t2) // 3
+    import math
+    f = math.isqrt(f2)
+    assert f * f == f2
+    cands = [P * P + 1 - (t2 + 3 * f) // 2, P * P + 1 - (t2 - 3 * f) // 2,
+             P * P + 1 + t2, P * P + 1 - t2,
+             P * P + 1 + (t2 + 3 * f) // 2, P * P + 1 + (t2 - 3 * f) // 2]
+    B2curve = (4, 4)  # 4(1+i)
+    pt = find_point_fp2((0, 0), B2curve, 1)
+    n2 = None
+    for n in cands:
+        if pt_mul(Fp2, (0, 0), n, pt) is None:
+            n2 = n
+            break
+    assert n2 is not None and n2 % R == 0, "G2 twist order not found"
+    # E2(Fp2) has no 3-torsion (3 does not divide n2), but psi_3 =
+    # 3x(x^3 + 4B) has the Galois-stable root x_T = cuberoot(-4B) in
+    # Fp2 (y_T lives over the quadratic extension; Velu never needs it)
+    print("deriving G2 (3-isogeny, stable kernel)...", file=sys.stderr)
+    kx = f2_cuberoot(Fp2.neg(Fp2.mul(Fp2.from_int(4), B2curve)))
+    assert kx is not None, "-4B is not a cube in Fp2"
+    g2 = derive(Fp2, (0, 0), B2curve, 3, fp2=True, kernel_x=kx)
+
+    # cofactor clearing
+    h_eff_g1 = 1 - Z_BLS
+    for s in (2, 9, 31):
+        ptx = find_point(Fp, 0, 4, s)
+        cleared = pt_mul(Fp, 0, h_eff_g1, ptx)
+        assert pt_mul(Fp, 0, R, cleared) is None, \
+            "G1 h_eff = 1-z does not clear the cofactor"
+    # RFC 9380 G2 effective cofactor: h_eff = 3(z^2 - 1) * h2 (the
+    # Budroni–Pintore fast-clearing scalar; [h_eff] != [h2] mod r, and
+    # the reference host follows the RFC). Derived from the curve
+    # parameter z, verified to clear into the r-subgroup below.
+    h2 = n2 // R
+    h_eff_g2 = 3 * (Z_BLS * Z_BLS - 1) * h2
+    for s in (2, 9):
+        ptx = find_point_fp2((0, 0), B2curve, s)
+        cleared = pt_mul(Fp2, (0, 0), h_eff_g2, ptx)
+        assert pt_mul(Fp2, (0, 0), R, cleared) is None
+        assert cleared is not None
+
+    # The one freedom Velu's formulas cannot see: on a j=0 codomain the
+    # isogeny is determined by its kernel only up to Aut(E) (order 6:
+    # x -> zeta3^k x, y -> +-y). The RFC's iso_map is one specific
+    # representative; an external RFC-test-vector cross-check found the
+    # derived G2 map differs by (x, y) -> (zeta3^2 x, -y). G1 needs no
+    # correction (cross-checked byte-exact against the RFC vectors).
+    zeta = pow(2, (P - 1) // 3, P)
+    assert zeta != 1 and pow(zeta, 3, P) == 1
+    g1["post_x_mul"] = 1
+    g1["post_y_mul"] = 1
+    g2["post_x_mul"] = (zeta * zeta % P, 0)
+    g2["post_y_mul"] = ((-1) % P, 0)
+    # post-composed map still lands on E (a = 0: (zx)^3 = x^3)
+    print("all derivations verified", file=sys.stderr)
+
+    out = os.path.join(REPO, "stellar_tpu", "crypto",
+                       "_h2c_constants.py")
+    with open(out, "w") as fobj:
+        fobj.write(
+            '"""GENERATED by tools/derive_h2c.py — do not edit.\n\n'
+            "SSWU isogeny constants for BLS12-381 hash-to-curve,\n"
+            "derived and verified from first principles (see the\n"
+            "tool's docstring for the derivation and its limits).\n"
+            '"""\n\n')
+        fobj.write(f"G1 = {g1!r}\n\n")
+        fobj.write(f"G2 = {g2!r}\n\n")
+        fobj.write(f"H_EFF_G1 = {h_eff_g1}\n\n")
+        fobj.write(f"H_EFF_G2 = {h_eff_g2}\n")
+    print(f"wrote {out}")
+    print(f"G1 E': A'={hex(g1['A2'])[:20]}... B'={hex(g1['B2'])[:20]}..."
+          f" Z={g1['Z']}")
+    print(f"G2 E': A'={tuple(hex(c)[:14] for c in g2['A2'])} "
+          f"B'={tuple(hex(c)[:14] for c in g2['B2'])} Z={g2['Z']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
