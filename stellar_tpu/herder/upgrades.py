@@ -89,6 +89,17 @@ def load_config_upgrade_set(key, state_getter):
         return None
     if not upgrade_set.updatedEntry:
         return None
+    # the bucket-list size window and eviction iterator are
+    # core-maintained state that merely LIVES in CONFIG_SETTING
+    # entries — an upgrade must never overwrite them (reference
+    # SorobanNetworkConfig::isNonUpgradeableConfigSettingEntry,
+    # src/ledger/NetworkConfig.cpp:1067-1082)
+    from stellar_tpu.ledger.network_config import (
+        NON_UPGRADEABLE_SETTING_IDS,
+    )
+    banned = NON_UPGRADEABLE_SETTING_IDS()
+    if any(e.arm in banned for e in upgrade_set.updatedEntry):
+        return None
     return upgrade_set
 
 

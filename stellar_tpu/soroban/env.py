@@ -194,6 +194,7 @@ def sym_to_small(s: bytes) -> int:
 
 
 from stellar_tpu.utils.cache import RandomEvictionCache
+from stellar_tpu.soroban.cost_model import CostType as _COST
 
 _SYM_DECODE_CACHE: RandomEvictionCache = RandomEvictionCache(16384)
 
@@ -839,7 +840,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def compute_sha256(inst, b_val):
         data = cv.obj(b_val, TAG_BYTES_OBJ)
-        env.host.budget.charge(2000 + 30 * len(data), 32)
+        env.charge_type(_COST.ComputeSha256Hash, len(data))
         return cv.new_obj(TAG_BYTES_OBJ, sha256(data))
 
     # ---- prng (deterministic per-frame stream; reference "p") ----
@@ -884,6 +885,11 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
     # identity-stable across env.reset() (frame pooling): forwards to
     # the CURRENT frame's budget
     charge = env.charge
+    # metered cost-model charge: ContractCostType + the calibrated
+    # (const, linear) tables (soroban/cost_model.py; reference
+    # NetworkConfig.cpp initial params, upgradable consensus state)
+    charge_ct = env.charge_type
+    CT = _COST
 
     def _bytes_of(val):
         return cv.obj(val, TAG_BYTES_OBJ)
@@ -1091,9 +1097,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             TAG_BYTES_OBJ,
             (_i256_of(val) & _U256_MAX).to_bytes(32, "big"))
 
-    def _u256_binop(op):
+    def _u256_binop(op, ct=None):
         def fn(inst, a_val, b_val):
-            charge(200, 0)
+            charge_ct(CT.Int256AddSub if ct is None else ct)
             a, b = _u256_of(a_val), _u256_of(b_val)
             r = op(a, b)
             if r is None or not (0 <= r <= _U256_MAX):
@@ -1101,9 +1107,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             return _mk_u256(r)
         return fn
 
-    def _i256_binop(op):
+    def _i256_binop(op, ct=None):
         def fn(inst, a_val, b_val):
-            charge(200, 0)
+            charge_ct(CT.Int256AddSub if ct is None else ct)
             a, b = _i256_of(a_val), _i256_of(b_val)
             r = op(a, b)
             if r is None or not (_I256_MIN <= r <= _I256_MAX):
@@ -1142,17 +1148,17 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     u256_add = _u256_binop(lambda a, b: a + b)
     u256_sub = _u256_binop(lambda a, b: a - b)
-    u256_mul = _u256_binop(lambda a, b: a * b)
-    u256_div = _u256_binop(_div)
-    u256_rem_euclid = _u256_binop(_rem_euclid)
+    u256_mul = _u256_binop(lambda a, b: a * b, _COST.Int256Mul)
+    u256_div = _u256_binop(_div, _COST.Int256Div)
+    u256_rem_euclid = _u256_binop(_rem_euclid, _COST.Int256Div)
     i256_add = _i256_binop(lambda a, b: a + b)
     i256_sub = _i256_binop(lambda a, b: a - b)
-    i256_mul = _i256_binop(lambda a, b: a * b)
-    i256_div = _i256_binop(_div)
-    i256_rem_euclid = _i256_binop(_rem_euclid)
+    i256_mul = _i256_binop(lambda a, b: a * b, _COST.Int256Mul)
+    i256_div = _i256_binop(_div, _COST.Int256Div)
+    i256_rem_euclid = _i256_binop(_rem_euclid, _COST.Int256Div)
 
     def u256_pow(inst, a_val, p_val):
-        charge(500, 0)
+        charge_ct(CT.Int256Pow)
         p = _u32_arg(p_val, "pow exponent")
         r = _pow_checked(_u256_of(a_val), p, _U256_MAX)
         if r is None or r > _U256_MAX:
@@ -1160,7 +1166,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _mk_u256(r)
 
     def i256_pow(inst, a_val, p_val):
-        charge(500, 0)
+        charge_ct(CT.Int256Pow)
         p = _u32_arg(p_val, "pow exponent")
         r = _pow_checked(_i256_of(a_val), p, 1 << 256)
         if r is None or not (_I256_MIN <= r <= _I256_MAX):
@@ -1168,7 +1174,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _mk_i256(r)
 
     def u256_shl(inst, a_val, s_val):
-        charge(200, 0)
+        charge_ct(CT.Int256Shift)
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("u256 shift out of range")
@@ -1177,14 +1183,14 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _mk_u256((_u256_of(a_val) << s) & _U256_MAX)
 
     def u256_shr(inst, a_val, s_val):
-        charge(200, 0)
+        charge_ct(CT.Int256Shift)
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("u256 shift out of range")
         return _mk_u256(_u256_of(a_val) >> s)
 
     def i256_shl(inst, a_val, s_val):
-        charge(200, 0)
+        charge_ct(CT.Int256Shift)
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("i256 shift out of range")
@@ -1195,7 +1201,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _mk_i256(r)
 
     def i256_shr(inst, a_val, s_val):
-        charge(200, 0)
+        charge_ct(CT.Int256Shift)
         s = _u32_arg(s_val, "shift")
         if s >= 256:
             raise EnvError("i256 shift out of range")
@@ -1447,13 +1453,13 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def serialize_to_bytes(inst, val):
         data = to_bytes(SCVal, cv.to_scval(val))
-        charge(100 + 5 * len(data), len(data))
+        charge_ct(CT.ValSer, len(data))
         return cv.new_obj(TAG_BYTES_OBJ, data)
 
     def deserialize_from_bytes(inst, b_val):
         from stellar_tpu.xdr.runtime import from_bytes as _fb
         data = _bytes_of(b_val)
-        charge(100 + 5 * len(data), len(data))
+        charge_ct(CT.ValDeser, len(data))
         try:
             sc = _fb(SCVal, bytes(data))
         except Exception:
@@ -1595,7 +1601,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         sig = _bytes_of(sig_val)
         if len(pk) != 32 or len(sig) != 64:
             raise EnvError("bad ed25519 key/signature length")
-        charge(400_000 + 30 * len(payload), 0)
+        charge_ct(CT.VerifyEd25519Sig, len(payload))
         from stellar_tpu.crypto.keys import PublicKey, verify_sig
         if not verify_sig(PublicKey(bytes(pk)), bytes(payload),
                           bytes(sig)):
@@ -1604,7 +1610,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def compute_hash_keccak256(inst, b_val):
         data = _bytes_of(b_val)
-        charge(3000 + 40 * len(data), 32)
+        charge_ct(CT.ComputeKeccak256Hash, len(data))
         from stellar_tpu.crypto.keccak import keccak256
         return cv.new_obj(TAG_BYTES_OBJ, keccak256(bytes(data)))
 
@@ -1613,7 +1619,8 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         digest = _bytes_of(digest_val)
         sig = _bytes_of(sig_val)
         rid = _u32_arg(rid_val, "recovery id")
-        charge(2_000_000, 65)
+        charge_ct(CT.DecodeEcdsaCurve256Sig)
+        charge_ct(CT.RecoverEcdsaSecp256k1Key)
         from stellar_tpu.crypto.secp256 import (
             EcdsaError, recover_secp256k1,
         )
@@ -1649,7 +1656,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _u256_of(val) % _bls().R
 
     def bls12_381_check_g1_is_in_subgroup(inst, p_val):
-        charge(500_000, 0)
+        charge_ct(CT.Bls12381DecodeFp, iterations=2)
+        charge_ct(CT.Bls12381G1CheckPointOnCurve)
+        charge_ct(CT.Bls12381G1CheckPointInSubgroup)
         B = _bls()
         pt = _g1_arg(p_val, check_subgroup=False)
         try:
@@ -1660,14 +1669,16 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
 
     def bls12_381_g1_add(inst, a_val, b_val):
         # add validates on-curve only (CAP-59: no subgroup check here)
-        charge(20_000, 96)
+        charge_ct(CT.Bls12381G1Add)
+        charge_ct(CT.Bls12381EncodeFp, iterations=2)
         B = _bls()
         return cv.new_obj(TAG_BYTES_OBJ, B.g1_encode(B.g1_add(
             _g1_arg(a_val, check_subgroup=False),
             _g1_arg(b_val, check_subgroup=False))))
 
     def bls12_381_g1_mul(inst, p_val, k_val):
-        charge(1_500_000, 96)
+        charge_ct(CT.Bls12381G1Mul)
+        charge_ct(CT.Bls12381EncodeFp, iterations=2)
         B = _bls()
         return cv.new_obj(TAG_BYTES_OBJ, B.g1_encode(
             B.g1_mul(_fr_arg(k_val), _g1_arg(p_val))))
@@ -1678,7 +1689,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         ks = [_fr_arg(v) for v in _vec_of(scalars_val)]
         if len(pts) != len(ks):
             raise EnvError("bls12-381 msm length mismatch")
-        charge(1_500_000 * max(1, len(pts)), 96)
+        charge_ct(CT.Bls12381G1Msm, len(pts))
         return cv.new_obj(TAG_BYTES_OBJ,
                           B.g1_encode(B.g1_msm(list(zip(ks, pts)))))
 
@@ -1692,7 +1703,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         # on-curve but generally outside the r-subgroup); constants
         # derived and verified by tools/derive_h2c.py (reproduces the
         # RFC's own curve parameters and Z = 11)
-        charge(1_500_000, 96)
+        charge_ct(CT.Bls12381MapFpToG1)
         raw = bytes(_bytes_of(fp_val))
         if len(raw) != 48:
             raise EnvError("fp encoding must be 48 bytes")
@@ -1703,8 +1714,8 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
                           _bls().g1_encode(_h2c().map_fp_to_g1(u)))
 
     def bls12_381_hash_to_g1(inst, msg_val, dst_val):
-        charge(3_000_000, 96)
         msg = bytes(_bytes_of(msg_val))
+        charge_ct(CT.Bls12381HashToG1, len(msg))
         dst = bytes(_bytes_of(dst_val))
         if not dst or len(dst) > 255:
             raise EnvError("dst must be 1..255 bytes")
@@ -1712,7 +1723,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
                           _bls().g1_encode(_h2c().hash_to_g1(msg, dst)))
 
     def bls12_381_check_g2_is_in_subgroup(inst, p_val):
-        charge(1_000_000, 0)
+        charge_ct(CT.Bls12381DecodeFp, iterations=4)
+        charge_ct(CT.Bls12381G2CheckPointOnCurve)
+        charge_ct(CT.Bls12381G2CheckPointInSubgroup)
         B = _bls()
         pt = _g2_arg(p_val, check_subgroup=False)
         try:
@@ -1722,14 +1735,16 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
             return _make(TAG_FALSE)
 
     def bls12_381_g2_add(inst, a_val, b_val):
-        charge(40_000, 192)
+        charge_ct(CT.Bls12381G2Add)
+        charge_ct(CT.Bls12381EncodeFp, iterations=4)
         B = _bls()
         return cv.new_obj(TAG_BYTES_OBJ, B.g2_encode(B.g2_add(
             _g2_arg(a_val, check_subgroup=False),
             _g2_arg(b_val, check_subgroup=False))))
 
     def bls12_381_g2_mul(inst, p_val, k_val):
-        charge(3_000_000, 192)
+        charge_ct(CT.Bls12381G2Mul)
+        charge_ct(CT.Bls12381EncodeFp, iterations=4)
         B = _bls()
         return cv.new_obj(TAG_BYTES_OBJ, B.g2_encode(
             B.g2_mul(_fr_arg(k_val), _g2_arg(p_val))))
@@ -1740,13 +1755,13 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         ks = [_fr_arg(v) for v in _vec_of(scalars_val)]
         if len(pts) != len(ks):
             raise EnvError("bls12-381 msm length mismatch")
-        charge(3_000_000 * max(1, len(pts)), 192)
+        charge_ct(CT.Bls12381G2Msm, len(pts))
         return cv.new_obj(TAG_BYTES_OBJ,
                           B.g2_encode(B.g2_msm(list(zip(ks, pts)))))
 
     def bls12_381_map_fp2_to_g2(inst, fp2_val):
         # same wire convention as the g2 point codec: c1 || c0
-        charge(3_000_000, 192)
+        charge_ct(CT.Bls12381MapFp2ToG2)
         raw = bytes(_bytes_of(fp2_val))
         if len(raw) != 96:
             raise EnvError("fp2 encoding must be 96 bytes")
@@ -1758,8 +1773,8 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
                           _bls().g2_encode(_h2c().map_fp2_to_g2((c0, c1))))
 
     def bls12_381_hash_to_g2(inst, msg_val, dst_val):
-        charge(6_000_000, 192)
         msg = bytes(_bytes_of(msg_val))
+        charge_ct(CT.Bls12381HashToG2, len(msg))
         dst = bytes(_bytes_of(dst_val))
         if not dst or len(dst) > 255:
             raise EnvError("dst must be 1..255 bytes")
@@ -1772,7 +1787,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         qs = [_g2_arg(v) for v in _vec_of(vp2_val)]
         if len(ps) != len(qs) or not ps:
             raise EnvError("bls12-381 pairing vector mismatch")
-        charge(10_000_000 * len(ps), 0)
+        charge_ct(CT.Bls12381Pairing, len(ps))
         ok = B.pairing_check(list(zip(ps, qs)))
         return _make(TAG_TRUE if ok else TAG_FALSE)
 
@@ -1780,19 +1795,19 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _mk_u256(n % _bls().R)
 
     def bls12_381_fr_add(inst, a_val, b_val):
-        charge(5_000, 0)
+        charge_ct(CT.Bls12381FrAddSub)
         return _fr_result(_bls().fr_add(_fr_arg(a_val), _fr_arg(b_val)))
 
     def bls12_381_fr_sub(inst, a_val, b_val):
-        charge(5_000, 0)
+        charge_ct(CT.Bls12381FrAddSub)
         return _fr_result(_bls().fr_sub(_fr_arg(a_val), _fr_arg(b_val)))
 
     def bls12_381_fr_mul(inst, a_val, b_val):
-        charge(5_000, 0)
+        charge_ct(CT.Bls12381FrMul)
         return _fr_result(_bls().fr_mul(_fr_arg(a_val), _fr_arg(b_val)))
 
     def bls12_381_fr_pow(inst, a_val, e_val):
-        charge(50_000, 0)
+        charge_ct(CT.Bls12381FrPow, 64)  # input: exponent bit-width
         # the exponent is a tagged U64Val, not a raw wasm u64
         e_sc = cv.to_scval(e_val)
         if e_sc.arm != T.SCV_U64:
@@ -1800,7 +1815,7 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         return _fr_result(_bls().fr_pow(_fr_arg(a_val), e_sc.value))
 
     def bls12_381_fr_inv(inst, a_val):
-        charge(50_000, 0)
+        charge_ct(CT.Bls12381FrInv)
         B = _bls()
         try:
             return _fr_result(B.fr_inv(_fr_arg(a_val)))
@@ -1811,7 +1826,9 @@ def make_imports(env) -> Dict[Tuple[str, str], Callable]:
         pk = _bytes_of(pk_val)
         digest = _bytes_of(digest_val)
         sig = _bytes_of(sig_val)
-        charge(2_000_000, 0)
+        charge_ct(CT.Sec1DecodePointUncompressed)
+        charge_ct(CT.DecodeEcdsaCurve256Sig)
+        charge_ct(CT.VerifyEcdsaSecp256r1Sig)
         from stellar_tpu.crypto.secp256 import (
             SECP256R1, EcdsaError, verify_ecdsa,
         )

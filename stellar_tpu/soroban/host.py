@@ -190,18 +190,59 @@ DIAGNOSTIC_EVENTS_ENABLED = False
 USE_NATIVE_WASM = True
 
 
+from stellar_tpu.soroban import cost_model as _cm
+
+_DEFAULT_COST_PARAMS = None
+
+
+def _default_cost_params():
+    """Current-protocol initial tables, computed once per process (the
+    fallback when a budget is built without explicit params)."""
+    global _DEFAULT_COST_PARAMS
+    if _DEFAULT_COST_PARAMS is None:
+        from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+        _DEFAULT_COST_PARAMS = (
+            _cm.initial_cost_params(CURRENT_LEDGER_PROTOCOL_VERSION,
+                                    "cpu"),
+            _cm.initial_cost_params(CURRENT_LEDGER_PROTOCOL_VERSION,
+                                    "mem"))
+    return _DEFAULT_COST_PARAMS
+
+
 class _Budget:
-    def __init__(self, cpu_limit: int, mem_limit: int):
+    def __init__(self, cpu_limit: int, mem_limit: int,
+                 cpu_params=None, mem_params=None):
         self.cpu_limit = cpu_limit
         self.mem_limit = mem_limit
         self.cpu = 0
         self.mem = 0
+        # calibrated metered cost vectors [(const, linear)] indexed by
+        # ContractCostType (soroban/cost_model.py); None = the
+        # reference's initial tables for the current protocol
+        self.cpu_params = cpu_params
+        self.mem_params = mem_params
 
     def charge(self, cpu: int, mem: int = 0):
         self.cpu += cpu
         self.mem += mem
         if self.cpu > self.cpu_limit or self.mem > self.mem_limit:
             raise HostError(HostError.BUDGET, "budget exceeded")
+
+    def charge_type(self, type_idx: int, input_size: int = 0,
+                    iterations: int = 1):
+        """Charge by ContractCostType through the calibrated linear
+        model (reference: Budget::charge with a CostType — both the
+        cpu-instructions and memory-bytes dimensions at once). Runs on
+        the metered hot path: no per-call imports (_cm is bound at
+        module load)."""
+        if self.cpu_params is None:
+            self.cpu_params, self.mem_params = _default_cost_params()
+        cpu = _cm.eval_cost(self.cpu_params, type_idx, input_size)
+        mem = _cm.eval_cost(self.mem_params, type_idx, input_size)
+        if iterations != 1:
+            cpu *= iterations
+            mem *= iterations
+        self.charge(cpu, mem)
 
 
 class _Storage:
@@ -978,9 +1019,17 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
     boundary). ``footprint_entries``: kb -> (LedgerEntry|None,
     live_until|None) for every declared key that exists."""
     from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.ledger.network_config import effective_cost_params
+    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
+    proto = ledger_header.ledgerVersion if ledger_header is not None \
+        else CURRENT_LEDGER_PROTOCOL_VERSION
     budget = _Budget(cpu_limit if cpu_limit is not None
                      else config.tx_max_instructions,
-                     config.tx_memory_limit)
+                     config.tx_memory_limit,
+                     cpu_params=effective_cost_params(config, proto,
+                                                      "cpu"),
+                     mem_params=effective_cost_params(config, proto,
+                                                      "mem"))
     storage = _Storage(footprint_entries, read_only, read_write, budget,
                        ledger_seq)
     out = InvokeOutput(success=False)
@@ -1112,6 +1161,12 @@ class WasmContractEnv:
         # stable bound method: closures capture THIS, the budget
         # behind it follows the host of the current frame
         self.host.budget.charge(cpu, mem)
+
+    def charge_type(self, type_idx: int, input_size: int = 0,
+                    iterations: int = 1):
+        # metered cost-model charge (ContractCostType + calibrated
+        # params) — same identity-stability contract as ``charge``
+        self.host.budget.charge_type(type_idx, input_size, iterations)
 
     def reset(self, host: "_Host", contract_addr, invocation,
               depth: int):

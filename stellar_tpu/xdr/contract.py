@@ -502,6 +502,66 @@ class ConfigSettingContractComputeV0(Struct):
               ("txMemoryLimit", Uint32)]
 
 
+class ConfigSettingContractLedgerCostV0(Struct):
+    # field order mirrors the reference XDR (cross-checked against the
+    # committed soroban-settings/pubnet_phase*.json serialization)
+    FIELDS = [("ledgerMaxReadLedgerEntries", Uint32),
+              ("ledgerMaxReadBytes", Uint32),
+              ("ledgerMaxWriteLedgerEntries", Uint32),
+              ("ledgerMaxWriteBytes", Uint32),
+              ("txMaxReadLedgerEntries", Uint32),
+              ("txMaxReadBytes", Uint32),
+              ("txMaxWriteLedgerEntries", Uint32),
+              ("txMaxWriteBytes", Uint32),
+              ("feeReadLedgerEntry", Int64),
+              ("feeWriteLedgerEntry", Int64),
+              ("feeRead1KB", Int64),
+              ("bucketListTargetSizeBytes", Int64),
+              ("writeFee1KBBucketListLow", Int64),
+              ("writeFee1KBBucketListHigh", Int64),
+              ("bucketListWriteFeeGrowthFactor", Uint32)]
+
+
+class ConfigSettingContractHistoricalDataV0(Struct):
+    FIELDS = [("feeHistorical1KB", Int64)]
+
+
+class ConfigSettingContractEventsV0(Struct):
+    FIELDS = [("txMaxContractEventsSizeBytes", Uint32),
+              ("feeContractEvents1KB", Int64)]
+
+
+class StateArchivalSettings(Struct):
+    FIELDS = [("maxEntryTTL", Uint32),
+              ("minTemporaryTTL", Uint32),
+              ("minPersistentTTL", Uint32),
+              ("persistentRentRateDenominator", Int64),
+              ("tempRentRateDenominator", Int64),
+              ("maxEntriesToArchive", Uint32),
+              ("bucketListSizeWindowSampleSize", Uint32),
+              ("bucketListWindowSamplePeriod", Uint32),
+              ("evictionScanSize", Uint32),
+              ("startingEvictionScanLevel", Uint32)]
+
+
+class EvictionIterator(Struct):
+    FIELDS = [("bucketListLevel", Uint32),
+              ("isCurrBucket", Bool),
+              ("bucketFileOffset", Uint64)]
+
+
+class ContractCostParamEntry(Struct):
+    """One (const_term, linear_term) pricing row of the metered cost
+    model (reference ContractCostParamEntry; linear term in 1/128
+    units — see soroban/cost_model.py)."""
+    FIELDS = [("ext", ExtensionPoint),
+              ("constTerm", Int64),
+              ("linearTerm", Int64)]
+
+
+ContractCostParams = VarArray(ContractCostParamEntry, maxlen=1024)
+
+
 class ConfigSettingContractExecutionLanesV0(Struct):
     FIELDS = [("ledgerMaxTxCount", Uint32)]
 
@@ -512,16 +572,30 @@ class ConfigSettingContractBandwidthV0(Struct):
               ("feeTxSize1KB", Int64)]
 
 
-# supported upgradeable arms (others reject at validation, reference
-# SettingsUpgradeUtils scope)
 ConfigSettingEntry = Union("ConfigSettingEntry", ConfigSettingID, {
     ConfigSettingID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES: Uint32,
     ConfigSettingID.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
         ConfigSettingContractComputeV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_LEDGER_COST_V0:
+        ConfigSettingContractLedgerCostV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_HISTORICAL_DATA_V0:
+        ConfigSettingContractHistoricalDataV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_EVENTS_V0:
+        ConfigSettingContractEventsV0,
     ConfigSettingID.CONFIG_SETTING_CONTRACT_BANDWIDTH_V0:
         ConfigSettingContractBandwidthV0,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_COST_PARAMS_CPU_INSTRUCTIONS:
+        ContractCostParams,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_COST_PARAMS_MEMORY_BYTES:
+        ContractCostParams,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_DATA_KEY_SIZE_BYTES: Uint32,
+    ConfigSettingID.CONFIG_SETTING_CONTRACT_DATA_ENTRY_SIZE_BYTES: Uint32,
+    ConfigSettingID.CONFIG_SETTING_STATE_ARCHIVAL: StateArchivalSettings,
     ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
         ConfigSettingContractExecutionLanesV0,
+    ConfigSettingID.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW:
+        VarArray(Uint64),
+    ConfigSettingID.CONFIG_SETTING_EVICTION_ITERATOR: EvictionIterator,
 })
 
 
