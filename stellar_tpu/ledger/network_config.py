@@ -17,7 +17,8 @@ __all__ = ["SorobanNetworkConfig", "compute_resource_fee",
            "compute_rent_fee", "config_setting_ledger_key",
            "load_network_config", "apply_config_setting",
            "config_setting_ledger_entry", "setting_entry_from_config",
-           "UPGRADEABLE_SETTING_IDS"]
+           "UPGRADEABLE_SETTING_IDS", "ALL_SETTING_IDS",
+           "NON_UPGRADEABLE_SETTING_IDS"]
 
 DATA_SIZE_1KB_INCREMENT = 1024
 INSTRUCTIONS_INCREMENT = 10_000
@@ -116,7 +117,9 @@ def _csid():
     return ConfigSettingID
 
 
-def UPGRADEABLE_SETTING_IDS():
+def ALL_SETTING_IDS():
+    """Every CONFIG_SETTING arm this node stores/loads — including the
+    two core-owned ones an operator upgrade may NOT touch."""
     c = _csid()
     return (c.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES,
             c.CONFIG_SETTING_CONTRACT_COMPUTE_V0,
@@ -132,6 +135,12 @@ def UPGRADEABLE_SETTING_IDS():
             c.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
             c.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW,
             c.CONFIG_SETTING_EVICTION_ITERATOR)
+
+
+def UPGRADEABLE_SETTING_IDS():
+    """The arms a LEDGER_UPGRADE_CONFIG may legitimately change."""
+    banned = NON_UPGRADEABLE_SETTING_IDS()
+    return tuple(sid for sid in ALL_SETTING_IDS() if sid not in banned)
 
 
 def NON_UPGRADEABLE_SETTING_IDS():
@@ -468,7 +477,7 @@ def load_network_config(getter):
     from stellar_tpu.ledger.ledger_txn import key_bytes
     cfg = SorobanNetworkConfig()
     found = False
-    for sid in UPGRADEABLE_SETTING_IDS():
+    for sid in ALL_SETTING_IDS():
         entry = getter(key_bytes(config_setting_ledger_key(sid)))
         if entry is not None:
             apply_config_setting(cfg, entry.data.value)

@@ -190,6 +190,7 @@ DIAGNOSTIC_EVENTS_ENABLED = False
 USE_NATIVE_WASM = True
 
 
+from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
 from stellar_tpu.soroban import cost_model as _cm
 
 _DEFAULT_COST_PARAMS = None
@@ -200,7 +201,6 @@ def _default_cost_params():
     fallback when a budget is built without explicit params)."""
     global _DEFAULT_COST_PARAMS
     if _DEFAULT_COST_PARAMS is None:
-        from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
         _DEFAULT_COST_PARAMS = (
             _cm.initial_cost_params(CURRENT_LEDGER_PROTOCOL_VERSION,
                                     "cpu"),
@@ -227,6 +227,15 @@ class _Budget:
         self.mem += mem
         if self.cpu > self.cpu_limit or self.mem > self.mem_limit:
             raise HostError(HostError.BUDGET, "budget exceeded")
+
+    def wasm_insn_cost(self) -> int:
+        """Per-wasm-instruction cpu price from the active cost table
+        (WasmInsnExec const term) — upgradable consensus state, so the
+        engines must read it here, never a compile-time constant."""
+        if self.cpu_params is None:
+            self.cpu_params, self.mem_params = _default_cost_params()
+        return self.cpu_params[0][0] if self.cpu_params else \
+            CPU_PER_WASM_INSN
 
     def charge_type(self, type_idx: int, input_size: int = 0,
                     iterations: int = 1):
@@ -1020,7 +1029,6 @@ def invoke_host_function(host_fn, footprint_entries: Dict[bytes, Tuple],
     live_until|None) for every declared key that exists."""
     from stellar_tpu.ledger.ledger_txn import key_bytes
     from stellar_tpu.ledger.network_config import effective_cost_params
-    from stellar_tpu.protocol import CURRENT_LEDGER_PROTOCOL_VERSION
     proto = ledger_header.ledgerVersion if ledger_header is not None \
         else CURRENT_LEDGER_PROTOCOL_VERSION
     budget = _Budget(cpu_limit if cpu_limit is not None
@@ -1126,13 +1134,82 @@ def _parsed_module(code: bytes):
     """Validated WasmModule for ``code``, memoized by content hash
     (the reference host caches parsed+validated wasmi modules per code
     entry the same way)."""
+    return _parsed_module_tracked(code)[0]
+
+
+def _parsed_module_tracked(code: bytes):
+    """(module, cache_hit) — the hit flag drives instantiation
+    metering (parse costs are charged only on first touch)."""
     from stellar_tpu.soroban.wasm import parse_module
     h = sha256(code)
     mod = _MODULE_CACHE.maybe_get(h)
-    if mod is None:
-        mod = parse_module(code)
-        _MODULE_CACHE.put(h, mod)
-    return mod
+    if mod is not None:
+        return mod, True
+    mod = parse_module(code)
+    _MODULE_CACHE.put(h, mod)
+    return mod, False
+
+
+def _module_section_counts(module):
+    """Per-section sizes in the order of the ParseWasm*/InstantiateWasm*
+    cost types (instructions, functions, globals, table entries, types,
+    data segments, elem segments, imports, exports, data bytes)."""
+    cached = getattr(module, "_section_counts", None)
+    if cached is None:
+        cached = module._section_counts = (
+            sum(len(f.ops) for f in module.funcs),
+            len(module.funcs),
+            len(module.globals),
+            module.table_min,
+            len(module.types),
+            len(module.data),
+            len(module.elements),
+            len(module.imports),
+            len(module.exports),
+            sum(len(d) for _off, d in module.data),
+        )
+    return cached
+
+
+_PARSE_COST_TYPES = (
+    _cm.CostType.ParseWasmInstructions, _cm.CostType.ParseWasmFunctions,
+    _cm.CostType.ParseWasmGlobals, _cm.CostType.ParseWasmTableEntries,
+    _cm.CostType.ParseWasmTypes, _cm.CostType.ParseWasmDataSegments,
+    _cm.CostType.ParseWasmElemSegments, _cm.CostType.ParseWasmImports,
+    _cm.CostType.ParseWasmExports,
+    _cm.CostType.ParseWasmDataSegmentBytes,
+)
+_INSTANTIATE_COST_TYPES = (
+    _cm.CostType.InstantiateWasmInstructions,
+    _cm.CostType.InstantiateWasmFunctions,
+    _cm.CostType.InstantiateWasmGlobals,
+    _cm.CostType.InstantiateWasmTableEntries,
+    _cm.CostType.InstantiateWasmTypes,
+    _cm.CostType.InstantiateWasmDataSegments,
+    _cm.CostType.InstantiateWasmElemSegments,
+    _cm.CostType.InstantiateWasmImports,
+    _cm.CostType.InstantiateWasmExports,
+    _cm.CostType.InstantiateWasmDataSegmentBytes,
+)
+
+
+def _charge_vm_instantiation(budget, module, code_len: int,
+                             protocol: int) -> None:
+    """Era-correct VM setup metering: p20 charges VmInstantiation over
+    the code length; p21+ splits it — ParseWasm* plus InstantiateWasm*
+    by section, EVERY invocation (reference updateCpuCostParamsEntryForV21
+    rationale, NetworkConfig.cpp:355+; the p21/p22 host re-parses per
+    invocation). Deliberately independent of the process-local module
+    cache: metering is consensus, and a cache-dependent charge would
+    differ between a warm node and a freshly restarted one."""
+    if protocol < 21:
+        budget.charge_type(_cm.CostType.VmInstantiation, code_len)
+        return
+    counts = _module_section_counts(module)
+    for ct, n in zip(_PARSE_COST_TYPES, counts):
+        budget.charge_type(ct, n)
+    for ct, n in zip(_INSTANTIATE_COST_TYPES, counts):
+        budget.charge_type(ct, n)
 
 
 class WasmContractEnv:
@@ -1243,9 +1320,17 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
     except WasmError as e:
         raise HostError(HostError.TRAPPED, f"invalid wasm: {e}")
     budget = host.budget
+    hdr = getattr(host, "ledger_header", None)
+    proto = hdr.ledgerVersion if hdr is not None else \
+        CURRENT_LEDGER_PROTOCOL_VERSION
+    _charge_vm_instantiation(budget, module, len(code), proto)
+
+    # per-instruction tick price comes from the UPGRADABLE cost table
+    # (WasmInsnExec const term), not the compile-time default
+    cpu_per_insn = budget.wasm_insn_cost()
 
     def charge(n_insns: int):
-        budget.charge(n_insns * CPU_PER_WASM_INSN)
+        budget.charge(n_insns * cpu_per_insn)
 
     def mem_charge(n_bytes: int):
         budget.charge(0, n_bytes)
@@ -1277,7 +1362,7 @@ def _run_wasm_contract(host: "_Host", contract_addr, code: bytes,
             from stellar_tpu.soroban import native_wasm
             if native_wasm.available():
                 rv = native_wasm.run_export(
-                    module, imports, budget, CPU_PER_WASM_INSN, fn,
+                    module, imports, budget, cpu_per_insn, fn,
                     vals, cache_imports=pooled is not None)
                 return decode(rv) if rv is not None \
                     else SCVal.make(T.SCV_VOID)

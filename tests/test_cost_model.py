@@ -114,8 +114,8 @@ def test_full_settings_serialize_roundtrip():
     re-applies to an equal config (the write-at-upgrade path)."""
     import dataclasses
     from stellar_tpu.ledger.network_config import (
-        SorobanNetworkConfig, UPGRADEABLE_SETTING_IDS,
-        apply_config_setting, setting_entry_from_config,
+        ALL_SETTING_IDS, SorobanNetworkConfig, apply_config_setting,
+        setting_entry_from_config,
     )
     cfg = SorobanNetworkConfig()
     cfg.cpu_cost_params = initial_cost_params(22, "cpu")
@@ -123,7 +123,7 @@ def test_full_settings_serialize_roundtrip():
     cfg.bucket_list_size_window = (100, 200, 300)
     cfg.eviction_iterator = (3, False, 777)
     cfg2 = SorobanNetworkConfig()
-    for sid in UPGRADEABLE_SETTING_IDS():
+    for sid in ALL_SETTING_IDS():
         apply_config_setting(cfg2, setting_entry_from_config(cfg, sid))
     # fee_write_1kb is DERIVED from the curve + size window whenever
     # either applies; bring the source config to the same derived state
@@ -274,14 +274,6 @@ def test_non_upgradeable_arms_rejected():
     key = ConfigUpgradeSetKey(contractID=b"\x42" * 32,
                               contentHash=sha256(raw))
 
-    class _FakeVal:
-        arm = 13  # SCV_BYTES
-        value = raw
-
-    class _FakeData:
-        class value:
-            val = None
-
     # minimal fake ledger entry carrying the published bytes
     from stellar_tpu.xdr.contract import SCVal, SCValType
     entry = type("E", (), {})()
@@ -289,3 +281,52 @@ def test_non_upgradeable_arms_rejected():
     entry.data.value = type("V", (), {})()
     entry.data.value.val = SCVal.make(SCValType.SCV_BYTES, raw)
     assert load_config_upgrade_set(key, lambda k: entry) is None
+
+
+def test_vm_instantiation_metering_era_split():
+    """p20 charges VmInstantiation over code length; p21+ charges
+    ParseWasm* by section on first touch and InstantiateWasm* every
+    invocation (reference NetworkConfig.cpp v21 cost split)."""
+    from stellar_tpu.soroban.cost_model import eval_cost
+    from stellar_tpu.soroban.example_contracts import counter_wasm
+    from stellar_tpu.soroban.host import (
+        _Budget, _charge_vm_instantiation, _module_section_counts,
+        _parsed_module,
+    )
+    code = counter_wasm()
+    module = _parsed_module(code)
+    counts = _module_section_counts(module)
+    assert counts[1] > 0  # functions present
+
+    def fresh(proto):
+        return _Budget(10**10, 10**10,
+                       cpu_params=initial_cost_params(proto, "cpu"),
+                       mem_params=initial_cost_params(proto, "mem"))
+
+    b = fresh(20)
+    _charge_vm_instantiation(b, module, len(code), 20)
+    assert b.cpu == eval_cost(initial_cost_params(20, "cpu"),
+                              CostType.VmInstantiation, len(code))
+
+    # p21+: Parse* + Instantiate* every invocation, deterministically —
+    # metering must NOT depend on the process-local module cache (two
+    # nodes with different cache contents must charge identically)
+    b = fresh(21)
+    _charge_vm_instantiation(b, module, len(code), 21)
+    params21 = initial_cost_params(21, "cpu")
+    from stellar_tpu.soroban.host import (
+        _INSTANTIATE_COST_TYPES, _PARSE_COST_TYPES,
+    )
+    want = sum(eval_cost(params21, ct, n)
+               for ct, n in zip(_PARSE_COST_TYPES, counts))
+    want += sum(eval_cost(params21, ct, n)
+                for ct, n in zip(_INSTANTIATE_COST_TYPES, counts))
+    assert b.cpu == want and want > 0
+
+
+def test_wasm_insn_cost_matches_table():
+    """The engines' per-instruction constant must equal the calibrated
+    WasmInsnExec const term — one source of truth for tick pricing."""
+    from stellar_tpu.soroban.host import CPU_PER_WASM_INSN
+    assert initial_cost_params(20, "cpu")[CostType.WasmInsnExec] == \
+        (CPU_PER_WASM_INSN, 0)
