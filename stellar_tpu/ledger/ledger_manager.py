@@ -631,6 +631,7 @@ class LedgerManager:
             h = hh.header
             t = up.arm
             if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+                prev_version = h.ledgerVersion
                 h.ledgerVersion = up.value
             elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
                 h.baseFee = up.value
@@ -669,6 +670,67 @@ class LedgerManager:
                 # raising here makes close skip (log) them defensively
                 raise NotImplementedError(
                     f"upgrade type {t} not supported")
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            # outside the header context: entry writes re-read it
+            self._create_era_config_entries(ltx, prev_version, up.value)
+
+    def _create_era_config_entries(self, ltx, prev: int, new: int):
+        """Protocol-era crossings materialize soroban consensus state
+        (reference Upgrades::applyVersionUpgrade ->
+        createLedgerEntriesForV20 / createCostTypesForV21 / V22,
+        src/ledger/NetworkConfig.cpp:1085+): crossing into p20 creates
+        EVERY CONFIG_SETTING entry with the initial tables; later eras
+        extend the cost vectors in place, preserving any values an
+        operator upgrade already tuned."""
+        if prev >= new or new < 20:
+            return
+        import dataclasses
+        from stellar_tpu.ledger.network_config import (
+            ALL_SETTING_IDS, refresh_write_fee,
+        )
+        from stellar_tpu.soroban.cost_model import (
+            initial_cost_params, upgrade_cost_params,
+        )
+        from stellar_tpu.xdr.contract import ConfigSettingID as _CS
+        cfg = dataclasses.replace(self.soroban_config)
+        if prev < 20:
+            cfg.cpu_cost_params = initial_cost_params(20, "cpu")
+            cfg.mem_cost_params = initial_cost_params(20, "mem")
+            # the size window seeds with sample-size copies of the
+            # CURRENT bucket list size (reference
+            # createLedgerEntriesForV20), so the derived write fee
+            # starts from the real state size, not an empty window
+            bl_size = self._bucket_list_total_size()
+            cfg.bucket_list_size_window = \
+                (bl_size,) * cfg.bucket_list_size_window_sample_size
+            refresh_write_fee(cfg)
+            self._write_config_settings(ltx, cfg,
+                                        list(ALL_SETTING_IDS()))
+        if prev < 22 and new >= 21:  # some era in (21, 22) is crossed
+            cfg.cpu_cost_params = upgrade_cost_params(
+                cfg.cpu_cost_params
+                or initial_cost_params(max(prev, 20), "cpu"),
+                max(prev, 20), new, "cpu")
+            cfg.mem_cost_params = upgrade_cost_params(
+                cfg.mem_cost_params
+                or initial_cost_params(max(prev, 20), "mem"),
+                max(prev, 20), new, "mem")
+            self._write_config_settings(ltx, cfg, [
+                _CS.CONFIG_SETTING_CONTRACT_COST_PARAMS_CPU_INSTRUCTIONS,
+                _CS.CONFIG_SETTING_CONTRACT_COST_PARAMS_MEMORY_BYTES,
+            ])
+
+    def _bucket_list_total_size(self) -> int:
+        """Serialized byte size of the live bucket list (the quantity
+        the reference's size window samples); 0 without a bucket list."""
+        if self.bucket_list is None:
+            return 0
+        total = 0
+        for lev in self.bucket_list.levels:
+            for b in (lev.curr, lev.snap):
+                if b is not None and not b.is_empty():
+                    total += len(b.serialize())
+        return total
 
     def _apply_config_upgrade(self, ltx, key):
         """LEDGER_UPGRADE_CONFIG: load the published ConfigUpgradeSet

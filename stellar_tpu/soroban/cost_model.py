@@ -27,8 +27,8 @@ from __future__ import annotations
 from typing import List, Tuple
 
 __all__ = ["COST_TYPES", "cost_type_index", "initial_cost_params",
-           "eval_cost", "CostType", "COST_LINEAR_SCALE_BITS",
-           "n_cost_types_for_protocol"]
+           "upgrade_cost_params", "eval_cost", "CostType",
+           "COST_LINEAR_SCALE_BITS", "n_cost_types_for_protocol"]
 
 COST_LINEAR_SCALE_BITS = 7  # linear_term is in 1/128 units
 
@@ -253,24 +253,43 @@ _MEM_V22 = {
 }
 
 
+def _apply_era_overlay(params, era: int, dimension: str):
+    """Extend to the era's vector length and overlay its new/retuned
+    entries (shared by initial tables and era-crossing upgrades)."""
+    overlay = {21: (_CPU_V21, _MEM_V21), 22: (_CPU_V22, _MEM_V22)}[era]
+    table = overlay[0] if dimension == "cpu" else overlay[1]
+    length = {21: 45, 22: 70}[era]
+    if len(params) < length:
+        params.extend([(0, 0)] * (length - len(params)))
+    for name, cl in table.items():
+        params[_INDEX[name]] = cl
+    return params
+
+
 def initial_cost_params(protocol: int, dimension: str
                         ) -> List[Tuple[int, int]]:
     """The reference's initial (const, linear) vector for a protocol
     era — what the upgrade path installs into the CONFIG_SETTING
     entries when crossing into soroban/p21/p22."""
-    base = _CPU_V20 if dimension == "cpu" else _MEM_V20
-    overlay21 = _CPU_V21 if dimension == "cpu" else _MEM_V21
-    overlay22 = _CPU_V22 if dimension == "cpu" else _MEM_V22
-    params = list(base)
-    if protocol >= 21:
-        params.extend([(0, 0)] * (45 - len(params)))
-        for name, cl in overlay21.items():
-            params[_INDEX[name]] = cl
-    if protocol >= 22:
-        params.extend([(0, 0)] * (70 - len(params)))
-        for name, cl in overlay22.items():
-            params[_INDEX[name]] = cl
+    params = list(_CPU_V20 if dimension == "cpu" else _MEM_V20)
+    for era in (21, 22):
+        if protocol >= era:
+            _apply_era_overlay(params, era, dimension)
     return params
+
+
+def upgrade_cost_params(params, from_protocol: int, to_protocol: int,
+                        dimension: str):
+    """Carry an existing cost vector across a protocol-era crossing the
+    way the reference's updateCpuCostParamsEntryForV21/V22 do: extend
+    and overlay only the eras BETWEEN from and to (keyed on the actual
+    previous protocol, never inferred from vector length) — values an
+    operator upgrade already tuned within earlier eras are preserved."""
+    out = list(params)
+    for era in (21, 22):
+        if from_protocol < era <= to_protocol:
+            _apply_era_overlay(out, era, dimension)
+    return out
 
 
 def eval_cost(params: List[Tuple[int, int]], type_idx: int,

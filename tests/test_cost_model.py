@@ -330,3 +330,71 @@ def test_wasm_insn_cost_matches_table():
     from stellar_tpu.soroban.host import CPU_PER_WASM_INSN
     assert initial_cost_params(20, "cpu")[CostType.WasmInsnExec] == \
         (CPU_PER_WASM_INSN, 0)
+
+
+def test_protocol_upgrade_creates_era_config_entries(tmp_path):
+    """Crossing into p20 creates ALL CONFIG_SETTING entries (initial
+    tables); later eras extend the cost vectors IN PLACE, preserving
+    operator-tuned values (reference createLedgerEntriesForV20 +
+    createCostTypesForV21/V22)."""
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.ledger.network_config import (
+        ALL_SETTING_IDS, config_setting_ledger_key,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, keypair, seed_root_with_accounts,
+    )
+    from stellar_tpu.xdr.contract import ConfigSettingID as CS
+    from stellar_tpu.xdr.ledger import (
+        LedgerUpgrade, LedgerUpgradeType as LUT,
+    )
+    from stellar_tpu.xdr.runtime import to_bytes as _tb
+
+    def up(t, v):
+        return _tb(LedgerUpgrade, LedgerUpgrade.make(t, v))
+
+    a = keypair("era-upg")
+    root = seed_root_with_accounts([(a, 10**13)])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.ledgerVersion = 19  # pre-soroban network
+
+    def close_with(upgrades):
+        lcl = lm.last_closed_header
+        txset, _ = make_tx_set_from_transactions(
+            [], lcl, lm.last_closed_hash)
+        lm.close_ledger(LedgerCloseData(
+            ledger_seq=lcl.ledgerSeq + 1, tx_set=txset,
+            close_time=lcl.scpValue.closeTime + 5, upgrades=upgrades))
+
+    close_with([up(LUT.LEDGER_UPGRADE_VERSION, 20)])
+    # every arm materialized
+    for sid in ALL_SETTING_IDS():
+        assert lm.root.store.get(key_bytes(
+            config_setting_ledger_key(sid))) is not None, sid
+    cpu_kb = key_bytes(config_setting_ledger_key(
+        CS.CONFIG_SETTING_CONTRACT_COST_PARAMS_CPU_INSTRUCTIONS))
+    assert len(lm.root.store.get(cpu_kb).data.value.value) == 23
+
+    # operator tunes one p20 entry, then the network crosses to p22:
+    # the tuned value must survive the era extension
+    import dataclasses
+    cfg = dataclasses.replace(lm.soroban_config)
+    params = list(cfg.cpu_cost_params or
+                  initial_cost_params(20, "cpu"))
+    params[CostType.ComputeSha256Hash] = (3636, 7013)  # pubnet value
+    cfg.cpu_cost_params = params
+    lm.soroban_config = cfg
+    lm.root.soroban_config = cfg
+    close_with([up(LUT.LEDGER_UPGRADE_VERSION, 22)])
+    stored = lm.root.store.get(cpu_kb).data.value.value
+    assert len(stored) == 70
+    assert (stored[CostType.ComputeSha256Hash].constTerm,
+            stored[CostType.ComputeSha256Hash].linearTerm) == (3636, 7013)
+    assert (stored[CostType.Bls12381FrInv].constTerm,
+            stored[CostType.Bls12381FrInv].linearTerm) == (35421, 0)
+    assert lm.soroban_config.cpu_cost_params[CostType.Bls12381Pairing] \
+        == (10558948, 632860943)
