@@ -217,7 +217,13 @@ class LedgerManager:
         )
         with zone("ledger.close"), \
                 LogSlowExecution("ledger-close", threshold_ms=2000.0):
-            result = self._close_ledger_inner(lcd)
+            try:
+                result = self._close_ledger_inner(lcd)
+            except BaseException:
+                # a staged-but-uncommitted config view (size-window
+                # sample, upgrade) must not leak into the next close
+                self._pending_soroban_config = None
+                raise
         frame_mark()
         return result
 
@@ -337,6 +343,8 @@ class LedgerManager:
                     "skipping malformed/unsupported upgrade at ledger "
                     "%d: %s", lcd.ledger_seq, e)
 
+        self._maybe_sample_bucket_list_size(ltx, lcd.ledger_seq)
+
         # eviction scan: expired TEMPORARY Soroban entries leave the
         # live state this close (reference startBackgroundEvictionScan,
         # LedgerManagerImpl.cpp:1072-1077); from the state-archival
@@ -375,6 +383,9 @@ class LedgerManager:
                 dead_keys.append(from_bytes(LedgerKey, kb))
 
         ltx.commit()
+        # a size-window sample staged on the main apply ltx becomes the
+        # node's view only once that ltx durably committed
+        self._promote_pending_soroban_config()
         if self.hot_archive is not None:
             # restored keys = CONTRACT_DATA entries recreated this
             # close whose key still sits ARCHIVED in the hot archive
@@ -720,6 +731,33 @@ class LedgerManager:
                 _CS.CONFIG_SETTING_CONTRACT_COST_PARAMS_MEMORY_BYTES,
             ])
 
+    def _maybe_sample_bucket_list_size(self, ltx, seq: int) -> None:
+        """Every ``bucket_list_window_sample_period`` ledgers at p20+,
+        push the current bucket-list size into the sliding window
+        CONFIG_SETTING entry and re-derive the write fee (reference
+        maybeSnapshotBucketListSize / updateBucketListSizeWindow). Part
+        of this ledger's delta, so every node and every replay computes
+        the identical entry (a node without a bucket list samples 0 —
+        the entry must exist either way)."""
+        if ltx.header().ledgerVersion < 20:
+            return
+        base = self._pending_soroban_config or self.soroban_config
+        period = base.bucket_list_window_sample_period
+        if period <= 0 or seq % period != 0:
+            return
+        from stellar_tpu.ledger.network_config import refresh_write_fee
+        from stellar_tpu.xdr.contract import ConfigSettingID as _CS
+        import dataclasses
+        cfg = dataclasses.replace(base)
+        window = list(cfg.bucket_list_size_window)
+        window.append(self._bucket_list_total_size())
+        n = cfg.bucket_list_size_window_sample_size
+        cfg.bucket_list_size_window = tuple(window[-n:]) if n > 0 \
+            else ()
+        refresh_write_fee(cfg)
+        self._write_config_settings(ltx, cfg, [
+            _CS.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW])
+
     def _bucket_list_total_size(self) -> int:
         """Serialized byte size of the live bucket list (the quantity
         the reference's size window samples); 0 without a bucket list."""
@@ -729,7 +767,7 @@ class LedgerManager:
         for lev in self.bucket_list.levels:
             for b in (lev.curr, lev.snap):
                 if b is not None and not b.is_empty():
-                    total += len(b.serialize())
+                    total += b.size_bytes
         return total
 
     def _apply_config_upgrade(self, ltx, key):
@@ -751,8 +789,21 @@ class LedgerManager:
         cfg = dataclasses.replace(self.soroban_config)
         for entry in upgrade_set.updatedEntry:
             apply_config_setting(cfg, entry)
-        self._write_config_settings(
-            ltx, cfg, [e.arm for e in upgrade_set.updatedEntry])
+        arms = [e.arm for e in upgrade_set.updatedEntry]
+        # a STATE_ARCHIVAL upgrade that shrinks the sample size resizes
+        # the window entry ON THE UPGRADE LEDGER (reference
+        # maybeUpdateBucketListWindowSize), not at the next sample
+        n = cfg.bucket_list_size_window_sample_size
+        if len(cfg.bucket_list_size_window) > n:
+            from stellar_tpu.ledger.network_config import (
+                refresh_write_fee,
+            )
+            from stellar_tpu.xdr.contract import ConfigSettingID as _CS
+            cfg.bucket_list_size_window = \
+                tuple(cfg.bucket_list_size_window[-n:]) if n > 0 else ()
+            refresh_write_fee(cfg)
+            arms.append(_CS.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW)
+        self._write_config_settings(ltx, cfg, arms)
 
     def _write_config_settings(self, ltx, cfg, setting_ids):
         """Create/update the CONFIG_SETTING entries for ``setting_ids``

@@ -398,3 +398,57 @@ def test_protocol_upgrade_creates_era_config_entries(tmp_path):
             stored[CostType.Bls12381FrInv].linearTerm) == (35421, 0)
     assert lm.soroban_config.cpu_cost_params[CostType.Bls12381Pairing] \
         == (10558948, 632860943)
+
+
+def test_bucket_list_size_window_sampling(tmp_path):
+    """Every sample-period ledgers at p20+, the close pushes the
+    current bucket-list size into the sliding-window CONFIG_SETTING
+    entry and re-derives the write fee (reference
+    maybeSnapshotBucketListSize)."""
+    import dataclasses
+    from stellar_tpu.herder.tx_set import make_tx_set_from_transactions
+    from stellar_tpu.ledger.ledger_manager import (
+        LedgerCloseData, LedgerManager,
+    )
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.ledger.network_config import (
+        config_setting_ledger_key,
+    )
+    from stellar_tpu.tx.tx_test_utils import (
+        TEST_NETWORK_ID, keypair, seed_root_with_accounts,
+    )
+    from stellar_tpu.xdr.contract import ConfigSettingID as CS
+    a = keypair("win-sample")
+    root = seed_root_with_accounts([(a, 10**13)])
+    lm = LedgerManager(TEST_NETWORK_ID, root)
+    lm.last_closed_header.ledgerVersion = 22  # p20+ network
+    cfg = dataclasses.replace(lm.soroban_config)
+    cfg.bucket_list_window_sample_period = 4
+    cfg.bucket_list_size_window_sample_size = 3
+    lm.soroban_config = cfg
+    lm.root.soroban_config = cfg
+    win_kb = key_bytes(config_setting_ledger_key(
+        CS.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW))
+    start = lm.ledger_seq
+    for i in range(9):
+        lcl = lm.last_closed_header
+        txset, _ = make_tx_set_from_transactions(
+            [], lcl, lm.last_closed_hash)
+        lm.close_ledger(LedgerCloseData(
+            ledger_seq=lcl.ledgerSeq + 1, tx_set=txset,
+            close_time=lcl.scpValue.closeTime + 5))
+    stored = lm.root.store.get(win_kb)
+    assert stored is not None
+    window = list(stored.data.value.value)
+    # at least two samples landed over 9 closes at period 4, bounded
+    # by the sample size
+    assert 1 <= len(window) <= 3
+    assert all(s > 0 for s in window)  # real serialized sizes
+    assert tuple(window) == lm.soroban_config.bucket_list_size_window
+    # the write fee was re-derived from the sampled average
+    from stellar_tpu.ledger.network_config import (
+        average_bucket_list_size, compute_write_fee_1kb,
+    )
+    assert lm.soroban_config.fee_write_1kb == compute_write_fee_1kb(
+        lm.soroban_config,
+        average_bucket_list_size(lm.soroban_config))
