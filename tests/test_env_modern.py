@@ -1165,3 +1165,74 @@ def test_era_refusal_at_link_native_engine_cached(hostenv):
     with pytest.raises(WasmError, match="requires protocol 22"):
         native_wasm.run_export(module, table, budget, 4, "seven", [],
                                cache_imports=True)
+
+
+def test_era_availability_through_invoke_host_function():
+    """Full invoke_host_function pipeline: a contract importing a BLS
+    p22 function instantiates and runs under a p22 ledger header but
+    FAILS (trapped, never silently succeeds) under a p21 header — the
+    era decides a transaction's outcome end to end."""
+    from stellar_tpu.ledger.ledger_txn import key_bytes
+    from stellar_tpu.soroban.host import (
+        _wrap_entry, contract_code_key, contract_data_key,
+        invoke_host_function, make_instance_val,
+    )
+    from stellar_tpu.tx.ops.soroban_ops import default_soroban_config
+    from stellar_tpu.tx.tx_test_utils import TEST_NETWORK_ID, keypair
+    from stellar_tpu.xdr.contract import (
+        ContractCodeEntry, ContractDataDurability, ContractDataEntry,
+        HostFunction, HostFunctionType, InvokeContractArgs,
+    )
+    from stellar_tpu.xdr.types import (
+        ExtensionPoint, LedgerEntryType, account_id,
+    )
+
+    class _Hdr21:
+        ledgerVersion = 21
+
+        class scpValue:
+            closeTime = 1000
+
+    class _Hdr22(_Hdr21):
+        ledgerVersion = 22
+
+    code = _import_only_bls_contract()
+    code_hash = sha256(code)
+    addr = contract_address(b"\x2F" * 32)
+    inst_key = contract_data_key(
+        addr, SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+    inst_entry = ContractDataEntry(
+        ext=ExtensionPoint.make(0), contract=addr,
+        key=SCVal.make(T.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        durability=ContractDataDurability.PERSISTENT,
+        val=make_instance_val(code_hash))
+    code_entry = ContractCodeEntry(
+        ext=ContractCodeEntry._types[0].make(0), hash=code_hash,
+        code=code)
+
+    def run(header):
+        fp = {
+            key_bytes(inst_key): (_wrap_entry(
+                LedgerEntryType.CONTRACT_DATA, inst_entry, 1), None),
+            key_bytes(contract_code_key(code_hash)): (_wrap_entry(
+                LedgerEntryType.CONTRACT_CODE, code_entry, 1), None),
+        }
+        kp = keypair("era-e2e")
+        fn = HostFunction.make(
+            HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+            InvokeContractArgs(contractAddress=addr,
+                               functionName=b"seven", args=[]))
+        return invoke_host_function(
+            fn, fp, set(fp), set(), [], account_id(kp.public_key.raw),
+            TEST_NETWORK_ID, 10, default_soroban_config(),
+            ledger_header=header)
+
+    out22 = run(_Hdr22)
+    # the raw wasm i64 7 decodes through the Val ABI (tag bits), so
+    # only success/era-refusal is asserted — the era decides the
+    # transaction outcome, not the payload shape
+    assert out22.success, out22.error
+    out21 = run(_Hdr21)
+    assert not out21.success  # era refusal classifies as a trap
+    assert out21.error == "trapped"
