@@ -42,6 +42,33 @@ class EvictionScanner:
         self._cursor: bytes = b""
         self._pending = None  # Future[List[bytes]] from prepare_async
         self._pending_store = None  # identity guard
+        self._last_candidates = 0  # size of the latest enumeration
+
+    # ---------------- consensus iterator persistence ----------------
+    # this implementation scans one flat sorted enumeration, so the
+    # persisted EVICTION_ITERATOR shape is (level 0, curr, offset) with
+    # ``offset`` = the cursor's rank within it — deterministic for
+    # every node and every replay of the same state (reference
+    # persists its bucket-file scan position the same way so restarts
+    # resume instead of rescanning from the top). ``scan`` records it
+    # as ``last_iterator_state`` from the enumeration it already holds;
+    # no second O(state) pass happens on the close path.
+
+    last_iterator_state: tuple = (0, True, 0)
+
+    def seed_from_iterator(self, store, offset: int) -> None:
+        """Resume the scan at a persisted iterator offset (restart
+        path): the cursor becomes the offset-th key of the current
+        enumeration — the same quantization the reference accepts when
+        buckets shifted under a stored file offset."""
+        from stellar_tpu.xdr.types import LedgerEntryType
+        keys = sorted(store.keys_of_type(LedgerEntryType.CONTRACT_DATA))
+        if not keys or offset <= 0:
+            self._cursor = b""
+            self.last_iterator_state = (0, True, 0)
+        else:
+            self._cursor = keys[min(offset, len(keys)) - 1]
+            self.last_iterator_state = (0, True, min(offset, len(keys)))
 
     # ---------------- background enumeration ----------------
 
@@ -102,7 +129,14 @@ class EvictionScanner:
         from stellar_tpu.xdr.types import LedgerKey
 
         data_keys = self._candidate_keys(ltx)
+        self._last_candidates = len(data_keys)
         if not data_keys:
+            # empty enumeration: the persisted iterator resets to 0, so
+            # the in-memory cursor must reset WITH it or a restarted
+            # node (seeded to b"") and this one would later rotate
+            # their scan windows from different start points
+            self._cursor = b""
+            self.last_iterator_state = (0, True, 0)
             return [], []
         # rotate: start after the cursor, wrap around
         start = 0
@@ -151,4 +185,16 @@ class EvictionScanner:
             if ttl_entry is not None:
                 ltx.erase(tk)
             evicted.append(data_key)
+        # iterator offset over the POST-eviction enumeration, derived
+        # from the list already in hand (sorted; removals keep order)
+        import bisect
+        from stellar_tpu.ledger.ledger_txn import key_bytes as _kb
+        gone = {_kb(k) for k in evicted}
+        post = [k for k in data_keys if k not in gone]
+        if not post:
+            self._cursor = b""
+            self.last_iterator_state = (0, True, 0)
+        else:
+            self.last_iterator_state = (
+                0, True, bisect.bisect_right(post, self._cursor))
         return evicted, archived

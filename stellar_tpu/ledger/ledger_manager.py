@@ -192,6 +192,12 @@ class LedgerManager:
             self.soroban_config = default_soroban_config()
         self.root.soroban_config = self.soroban_config
         self._pending_soroban_config = None
+        # resume the eviction scan at the persisted iterator position
+        # (reference: the EvictionIterator CONFIG_SETTING entry exists
+        # so a restart continues where the last close stopped);
+        # seed_from_iterator maps offset<=0 / empty sets to a reset
+        self.eviction_scanner.seed_from_iterator(
+            self.root.store, self.soroban_config.eviction_iterator[2])
 
     # ---------------- LCL accessors ----------------
 
@@ -362,6 +368,20 @@ class LedgerManager:
             from stellar_tpu.utils.metrics import registry
             registry.counter("state.eviction.evicted").inc(
                 len(evicted_keys))
+        # from the state-archival protocol, the scan position is
+        # consensus state: persist it so every node (and a restarted
+        # one) resumes from the same point instead of rescanning from
+        # the top (reference EvictionIterator in CONFIG_SETTING)
+        if archive_persistent and \
+                self.eviction_scanner._last_candidates > 0:
+            import dataclasses
+            from stellar_tpu.xdr.contract import ConfigSettingID as _CS
+            it = self.eviction_scanner.last_iterator_state
+            base = self._pending_soroban_config or self.soroban_config
+            if it != base.eviction_iterator:
+                cfg = dataclasses.replace(base, eviction_iterator=it)
+                self._write_config_settings(ltx, cfg, [
+                    _CS.CONFIG_SETTING_EVICTION_ITERATOR])
 
         # classify the close's entry delta and stamp lastModified —
         # this is what the bucket list (and meta) see
