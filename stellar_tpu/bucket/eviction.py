@@ -59,11 +59,17 @@ class EvictionScanner:
     def seed_from_iterator(self, store, offset: int) -> None:
         """Resume the scan at a persisted iterator offset (restart
         path): the cursor becomes the offset-th key of the current
-        enumeration — the same quantization the reference accepts when
-        buckets shifted under a stored file offset."""
+        enumeration — the same quantization ``scan`` itself persists,
+        so a restarted node and a continuously-running one hold the
+        IDENTICAL cursor. Offset 0 resets without paying the O(state)
+        enumeration (every fresh node passes through here)."""
+        if offset <= 0:
+            self._cursor = b""
+            self.last_iterator_state = (0, True, 0)
+            return
         from stellar_tpu.xdr.types import LedgerEntryType
         keys = sorted(store.keys_of_type(LedgerEntryType.CONTRACT_DATA))
-        if not keys or offset <= 0:
+        if not keys:
             self._cursor = b""
             self.last_iterator_state = (0, True, 0)
         else:
@@ -195,6 +201,12 @@ class EvictionScanner:
             self._cursor = b""
             self.last_iterator_state = (0, True, 0)
         else:
-            self.last_iterator_state = (
-                0, True, bisect.bisect_right(post, self._cursor))
+            off = bisect.bisect_right(post, self._cursor)
+            # snap the cursor to the persisted quantization: the raw
+            # cursor may be a key this scan just ERASED, and a restarted
+            # node seeded from the offset would otherwise hold a
+            # slightly earlier cursor and scan a different window when
+            # new keys land between the two
+            self._cursor = post[off - 1] if off > 0 else b""
+            self.last_iterator_state = (0, True, off)
         return evicted, archived

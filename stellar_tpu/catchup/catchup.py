@@ -378,21 +378,7 @@ def apply_buckets_catchup(lm: LedgerManager, archive: FileArchive,
     # entries — the node's in-memory view (and the eviction scan
     # position) must come from THEM, not from process defaults
     # (reference AssumeStateWork -> updateNetworkConfig)
-    from stellar_tpu.ledger.network_config import load_network_config
-    cfg = load_network_config(lm.root.store.get)
-    if cfg is None:
-        # a snapshot with NO stored settings means the network never
-        # upgraded its config: reset to defaults exactly like a fresh
-        # LedgerManager over the same state would, or this node would
-        # keep the PRE-catchup chain's values and diverge from peers
-        from stellar_tpu.tx.ops.soroban_ops import (
-            default_soroban_config,
-        )
-        cfg = default_soroban_config()
-    lm.soroban_config = cfg
-    lm.root.soroban_config = cfg
-    lm.eviction_scanner.seed_from_iterator(
-        lm.root.store, cfg.eviction_iterator[2])
+    lm._reload_network_config()
 
 
 class CatchupWork(WorkSequence):

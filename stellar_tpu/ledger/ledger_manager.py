@@ -183,21 +183,26 @@ class LedgerManager:
         # A state with no stored settings uses the shared process-wide
         # initial config (what a network looks like before its first
         # config upgrade).
+        self._pending_soroban_config = None
+        self._reload_network_config()
+
+    def _reload_network_config(self) -> None:
+        """(Re)build the in-memory network-config view from the stored
+        CONFIG_SETTING entries — falling back to process defaults when
+        the state holds none — and resume the eviction scan at the
+        persisted iterator. Shared by construction, restart, and
+        bucket-apply catchup so all three paths behave identically."""
         from stellar_tpu.ledger.network_config import load_network_config
-        self.soroban_config = load_network_config(self.root.store.get)
-        if self.soroban_config is None:
+        cfg = load_network_config(self.root.store.get)
+        if cfg is None:
             from stellar_tpu.tx.ops.soroban_ops import (
                 default_soroban_config,
             )
-            self.soroban_config = default_soroban_config()
-        self.root.soroban_config = self.soroban_config
-        self._pending_soroban_config = None
-        # resume the eviction scan at the persisted iterator position
-        # (reference: the EvictionIterator CONFIG_SETTING entry exists
-        # so a restart continues where the last close stopped);
-        # seed_from_iterator maps offset<=0 / empty sets to a reset
+            cfg = default_soroban_config()
+        self.soroban_config = cfg
+        self.root.soroban_config = cfg
         self.eviction_scanner.seed_from_iterator(
-            self.root.store, self.soroban_config.eviction_iterator[2])
+            self.root.store, cfg.eviction_iterator[2])
 
     # ---------------- LCL accessors ----------------
 
@@ -368,12 +373,12 @@ class LedgerManager:
             from stellar_tpu.utils.metrics import registry
             registry.counter("state.eviction.evicted").inc(
                 len(evicted_keys))
-        # from the state-archival protocol, the scan position is
-        # consensus state: persist it so every node (and a restarted
-        # one) resumes from the same point instead of rescanning from
-        # the top (reference EvictionIterator in CONFIG_SETTING)
-        if archive_persistent and \
-                self.eviction_scanner._last_candidates > 0:
+        # from the soroban protocol, the scan position is consensus
+        # state: persist it whenever it CHANGED (advance or reset) so
+        # every node — including a restarted one seeded from the entry
+        # — resumes from the same point. The reference persists its
+        # EvictionIterator from protocol 20, not just the archival era.
+        if ltx.header().ledgerVersion >= 20:
             import dataclasses
             from stellar_tpu.xdr.contract import ConfigSettingID as _CS
             it = self.eviction_scanner.last_iterator_state

@@ -651,11 +651,20 @@ def cmd_get_settings_upgrade_txs(args) -> int:
     from stellar_tpu.xdr.types import LedgerEntry
     with open(args.file, "rb") as f:
         raw = f.read()
-    try:
-        upgrade_set = from_bytes(ConfigUpgradeSet, raw)
-    except Exception:
-        upgrade_set = from_bytes(ConfigUpgradeSet,
-                                 base64.b64decode(raw))
+    if raw.lstrip().startswith(b"{"):
+        # the reference's JSON settings-upgrade format (the committed
+        # soroban-settings/pubnet_phase*.json files work verbatim)
+        from stellar_tpu.ledger.network_config import (
+            load_settings_upgrade_json,
+        )
+        upgrade_set = ConfigUpgradeSet(
+            updatedEntry=load_settings_upgrade_json(raw.decode()))
+    else:
+        try:
+            upgrade_set = from_bytes(ConfigUpgradeSet, raw)
+        except Exception:
+            upgrade_set = from_bytes(ConfigUpgradeSet,
+                                     base64.b64decode(raw))
     contract_id = bytes.fromhex(args.contract_id) if args.contract_id \
         else b"\x01" * 32
     entry, ttl, key = build_config_upgrade_publication(

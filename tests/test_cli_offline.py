@@ -320,6 +320,23 @@ def test_get_settings_upgrade_txs(tmp_path, capsys):
     assert base64.b64decode(out["config_upgrade_set_key"])
 
 
+def test_get_settings_upgrade_txs_reference_json(capsys):
+    """The reference's own committed settings-upgrade JSON files work
+    verbatim (reference get-settings-upgrade-txs consumes this
+    format)."""
+    import base64
+    import os
+    path = "/root/reference/soroban-settings/pubnet_phase1.json"
+    if not os.path.exists(path):
+        pytest.skip("reference settings files not present")
+    args = types.SimpleNamespace(file=path, contract_id="",
+                                 ledger_seq=100)
+    assert cli_offline.cmd_get_settings_upgrade_txs(args) == 0
+    out = _out(capsys)
+    assert out["settings_updated"] == 12
+    assert base64.b64decode(out["config_upgrade_set_key"])
+
+
 def test_validator_dsl_quorum_generation(tmp_path):
     """[[VALIDATORS]]/[[HOME_DOMAINS]] generate the quorum set
     (reference Config::generateQuorumSet): per-domain inner sets at
