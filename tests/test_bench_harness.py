@@ -1,0 +1,38 @@
+"""Bench harness contracts the driver relies on (no device needed)."""
+
+import json
+import sys
+
+
+sys.path.insert(0, "/root/repo")
+
+
+def test_last_ondevice_record_picks_newest(tmp_path, monkeypatch):
+    """The rc=3 output embeds the NEWEST self-recorded on-device run,
+    stale-flagged, scanning both docs/bench_runs/ and the round-level
+    docs/bench_r*_ondevice.json captures (VERDICT r4 #8)."""
+    import bench
+    docs = tmp_path / "docs"
+    runs = docs / "bench_runs"
+    runs.mkdir(parents=True)
+    (docs / "bench_r04_ondevice.json").write_text(json.dumps(
+        {"value": 81.1, "recorded_at": "2026-07-31T03:48:08Z"}))
+    (runs / "bench_20260731T120000Z.json").write_text(json.dumps(
+        {"value": 42.0, "recorded_at": "2026-07-31T12:00:00+00:00"}))
+    (runs / "bench_garbage.json").write_text("{not json")
+    (runs / "bench_null.json").write_text(json.dumps(
+        {"value": None, "recorded_at": "2026-07-31T23:59:59+00:00"}))
+    monkeypatch.setattr(bench.os.path, "abspath",
+                        lambda p: str(tmp_path / "bench.py"))
+    rec = bench._last_ondevice_record()
+    assert rec is not None
+    assert rec["value"] == 42.0      # newest NON-NULL record wins
+    assert rec["stale"] is True
+
+
+def test_real_repo_last_ondevice_exists():
+    """The committed r4 on-device capture is reachable, so BENCH_r05
+    can never be number-free even if the tunnel stays dead."""
+    import bench
+    rec = bench._last_ondevice_record()
+    assert rec is not None and rec["value"] is not None
