@@ -95,18 +95,28 @@ def test_pubnet_settings_files_roundtrip_and_apply():
     from stellar_tpu.xdr.runtime import from_bytes, to_bytes
     total = 0
     cfg = SorobanNetworkConfig()
-    for n in (1, 2, 3, 4, 5):
-        for e in load_settings_upgrade_json(_phase(n)):
+    files = [_phase(n) for n in (1, 2, 3, 4, 5)]
+    for name in ("testnet_settings_enable_upgrades",
+                 "testnet_settings_upgrade"):
+        path = os.path.join(REF_SETTINGS, f"{name}.json")
+        files.append(open(path).read())
+    for raw in files:
+        for e in load_settings_upgrade_json(raw):
             wire = to_bytes(ConfigSettingEntry, e)
             back = from_bytes(ConfigSettingEntry, wire)
             assert to_bytes(ConfigSettingEntry, back) == wire
             apply_config_setting(cfg, back)
             total += 1
-    assert total == 21
-    # phase1's calibrated pubnet values landed
-    assert cfg.cpu_cost_params[CostType.ComputeSha256Hash] == (3636, 7013)
-    assert len(cfg.cpu_cost_params) == 23
-    assert cfg.max_entry_ttl == 3_110_400  # phase1 state_archival
+    assert total == 34  # every committed reference settings file
+    # the last-applied (testnet, newest-era) vector spans all 70 types
+    assert len(cfg.cpu_cost_params) == 70
+    # phase1 alone lands the calibrated pubnet p20 values
+    cfg1 = SorobanNetworkConfig()
+    for e in load_settings_upgrade_json(files[0]):
+        apply_config_setting(cfg1, e)
+    assert cfg1.cpu_cost_params[CostType.ComputeSha256Hash] == (3636, 7013)
+    assert len(cfg1.cpu_cost_params) == 23
+    assert cfg1.max_entry_ttl == 3_110_400  # phase1 state_archival
 
 
 def test_full_settings_serialize_roundtrip():
