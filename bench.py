@@ -64,32 +64,68 @@ PIPELINE_DEPTH = 8
 PIPELINE_ROUNDS = 5
 
 
+def _have_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
 def gen_sigs(n):
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey)
     items = []
-    keys = [Ed25519PrivateKey.generate() for _ in range(64)]
-    pks = [k.public_key().public_bytes_raw() for k in keys]
-    for i in range(n):
-        k = i % len(keys)
-        msg = secrets.token_bytes(120)  # ~ tx hash + envelope-ish payload
-        items.append((pks[k], msg, keys[k].sign(msg)))
-    return items
+    if _have_cryptography():
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey)
+        keys = [Ed25519PrivateKey.generate() for _ in range(64)]
+        pks = [k.public_key().public_bytes_raw() for k in keys]
+        for i in range(n):
+            k = i % len(keys)
+            msg = secrets.token_bytes(120)  # ~ tx hash + envelope payload
+            items.append((pks[k], msg, keys[k].sign(msg)))
+        return items
+    # cryptography absent in this container: the pure-Python reference
+    # signs ~25ms/sig — fine for correctness, not for generating 2k sigs.
+    # Sign a small pool and tile it; verification cost is per-row
+    # identical regardless of repeats.
+    from stellar_tpu.crypto import ed25519_ref as ref
+    pool = []
+    for i in range(32):
+        seed = secrets.token_bytes(32)
+        pk = ref.secret_to_public(seed)
+        msg = secrets.token_bytes(120)
+        pool.append((pk, msg, ref.sign(seed, msg)))
+    return [pool[i % len(pool)] for i in range(n)]
 
 
 def cpu_baseline_ms(items):
-    """Single-core sequential verify of the full batch (median of 3)."""
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PublicKey)
-    loaded = [(Ed25519PublicKey.from_public_bytes(pk), m, s)
-              for pk, m, s in items]
+    """Single-core sequential verify of the full batch (median of 3).
+    With OpenSSL (the `cryptography` package) absent, falls back to the
+    pure-Python oracle on a 64-row sample scaled up — flagged in the
+    record as `cpu_baseline_method`, NOT comparable to libsodium."""
+    if _have_cryptography():
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey)
+        loaded = [(Ed25519PublicKey.from_public_bytes(pk), m, s)
+                  for pk, m, s in items]
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for pk, m, s in loaded:
+                pk.verify(s, m)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.median(times))
+    from stellar_tpu.crypto import ed25519_ref as ref
+    sample = items[:64]
+    for pk, m, s in sample[:2]:
+        ref.verify_python(pk, m, s)  # warm any lazy tables
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        for pk, m, s in loaded:
-            pk.verify(s, m)
+        for pk, m, s in sample:
+            ref.verify_python(pk, m, s)
         times.append((time.perf_counter() - t0) * 1000.0)
-    return float(np.median(times))
+    return float(np.median(times)) * (len(items) / len(sample))
 
 
 def dispatch_floor_ms():
@@ -130,18 +166,24 @@ def dispatch_floor_sized_ms(n=N_SIGS):
     return float(np.median(times))
 
 
-def _probe_device(timeout_s: float = 180.0) -> bool:
-    """True when a trivial dispatch completes within the budget. The
-    TPU tunnel can wedge (observed: libtpu version-mismatch windows
-    where even x+1 blocks forever); failing loudly beats hanging the
-    benchmark harness."""
+def _probe_device(timeout_s: float = 180.0):
+    """(ok, reason). ok only when a trivial dispatch completes within the
+    budget on a REAL accelerator. Two observed failure modes, handled
+    separately: the TPU tunnel can wedge (libtpu version-mismatch windows
+    where even x+1 blocks forever — hence the watchdog), and the axon
+    PJRT plugin can fail to REGISTER, leaving jax silently on its CPU
+    backend — 'benchmarking' XLA-on-CPU bignum kernels would produce
+    numbers comparable to nothing, so that reports unavailable too
+    (same policy as batch_verifier.device_available)."""
     import threading
     done = threading.Event()
     err = []
+    plat = []
 
     def probe():
         try:
             import jax
+            plat.append(jax.devices()[0].platform)
             f = jax.jit(lambda x: x + 1)
             np.asarray(f(np.zeros(2, np.int32)))
         except Exception as e:  # fail fast with the real cause
@@ -151,10 +193,45 @@ def _probe_device(timeout_s: float = 180.0) -> bool:
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     if not done.wait(timeout_s):
-        return False
+        return False, ("device unreachable: trivial dispatch did not "
+                       f"complete within {timeout_s:.0f}s (TPU tunnel "
+                       "down?)")
     if err:
         raise RuntimeError(f"device probe failed: {err[0]!r}")
-    return True
+    if plat and plat[0] == "cpu":
+        return False, ("no accelerator: jax fell back to the CPU backend "
+                       "(axon plugin not registered?) — XLA-on-CPU "
+                       "numbers are not the target metric")
+    return True, plat[0] if plat else "unknown"
+
+
+def _static_kernel_cost(timeout_s: float = 300.0):
+    """Hardware-independent kernel-cost record (tools/kernel_cost.py):
+    traced multiply-op counts and MAC volume per stage, plus the select
+    MAC volume per verify. Runs in a SUBPROCESS pinned to jax-CPU so a
+    dead TPU tunnel can't hang it — this is the number that keeps the
+    perf trajectory non-empty when the device is unreachable."""
+    import subprocess
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "kernel_cost.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, tool, "--json"], env=env,
+            capture_output=True, text=True, timeout=timeout_s)
+        line = out.stdout.strip().splitlines()[-1]
+        rec = json.loads(line)
+    except Exception as e:
+        return {"error": f"kernel cost tool failed: {e!r}"[:200]}
+    return {
+        "select_macs_per_verify": rec.get("select_macs_per_verify"),
+        "table_entries": rec.get("table_entries"),
+        "dsm_static_mul_ops": rec.get("dsm_static_mul_ops"),
+        "dsm_weighted_mul_elems": rec.get("dsm_weighted_mul_elems"),
+        "kernel_static_mul_ops": rec.get(
+            "stages", {}).get("kernel_total", {}).get("static_mul_ops"),
+        "batch": rec.get("batch"),
+    }
 
 
 def _last_ondevice_record():
@@ -181,16 +258,19 @@ def _last_ondevice_record():
 
 def main():
     _enable_compilation_cache()
-    if not _probe_device():
+    dev_ok, dev_reason = _probe_device()
+    if not dev_ok:
         print(json.dumps({
             "metric": "txset_sigverify_p50_ms", "value": None,
             "unit": "ms", "vs_baseline": None,
-            "error": "device unreachable: trivial dispatch did not "
-                     "complete within 180s (TPU tunnel down?)",
+            "error": dev_reason,
             "note": "not a kernel failure — even jit(x+1) never "
                     "returned; last_ondevice is the most recent "
-                    "self-recorded on-device run, verbatim",
+                    "self-recorded on-device run, verbatim; kernel_cost "
+                    "is the STATIC (traced-jaxpr) cost of the current "
+                    "kernel — the hardware-independent perf trajectory",
             "last_ondevice": _last_ondevice_record(),
+            "kernel_cost": _static_kernel_cost(),
         }))
         return 3
     from stellar_tpu.crypto.batch_verifier import (
@@ -230,23 +310,34 @@ def main():
     base = cpu_baseline_ms(items)
     floor = dispatch_floor_ms()
     floor_sized = dispatch_floor_sized_ms()
+    # The vs_baseline* ratios are defined against OpenSSL (libsodium-class
+    # CPU verify). The pure-Python oracle is ~3 orders of magnitude slower,
+    # so ratios computed from it would be fiction — report them null and
+    # let cpu_baseline_method flag why.
+    base_is_openssl = _have_cryptography()
+
+    def _ratio(num, den):
+        return round(num / den, 2) if base_is_openssl else None
+
     rec = {
         "metric": "txset_sigverify_p50_ms",
         "value": round(blocking_p50, 3),
         "unit": "ms",
-        "vs_baseline": round(base / blocking_p50, 2),
+        "vs_baseline": _ratio(base, blocking_p50),
         "blocking_p50_ms": round(blocking_p50, 3),
         "blocking_p95_ms": round(blocking_p95, 3),
         "blocking_minus_floor_ms": round(blocking_p50 - floor_sized, 3),
         "host_prep_ms": round(host_prep_ms, 3),
         "cpu_baseline_ms": round(base, 3),
+        "cpu_baseline_method": ("openssl" if _have_cryptography()
+                                else "python_oracle_sampled_64"),
         "dispatch_floor_ms": round(floor, 3),
         "dispatch_floor_sized_ms": round(floor_sized, 3),
         # diagnostics, NOT the scored number: what the kernel delivers
         # once the harness round-trip (the SIZE-MATCHED dispatch floor)
         # is excluded — the colocated-deployment projection
-        "vs_baseline_ex_floor": round(
-            base / max(1e-6, blocking_p50 - floor_sized), 2),
+        "vs_baseline_ex_floor": _ratio(
+            base, max(1e-6, blocking_p50 - floor_sized)),
         "pipeline_depth": PIPELINE_DEPTH,
         "n_sigs": N_SIGS,
         "n_devices": 1 if mesh is None else mesh.size,
@@ -278,7 +369,7 @@ def main():
         return {"pipelined_p50_ms": round(p50, 3),
                 "pipelined_p95_ms": round(
                     float(np.percentile(per_batch, 95)), 3),
-                "vs_baseline_pipelined": round(base / p50, 2)}
+                "vs_baseline_pipelined": _ratio(base, p50)}
 
     def phase_coalesced():
         # VERDICT r4 #2: if the tunnel serializes round-trips, depth-K
@@ -299,7 +390,7 @@ def main():
         assert out.all()
         coal_p50 = float(np.median(coal))
         return {"coalesced_p50_ms": round(coal_p50, 3),
-                "vs_baseline_coalesced": round(base / coal_p50, 2)}
+                "vs_baseline_coalesced": _ratio(base, coal_p50)}
 
     def phase_singles():
         # trickle class: a single flooded tx signature through the
@@ -332,6 +423,9 @@ def main():
     optional("pipelined", phase_pipelined)
     optional("singles", phase_singles)
     optional("trickle", phase_trickle)
+    # hardware-independent, so it must never delay the on-device record
+    # above — the live window can be minutes long (round 4: ~3 min total)
+    optional("kernel_cost", lambda: {"kernel_cost": _static_kernel_cost()})
     print(json.dumps(rec))
     return 0
 

@@ -341,7 +341,12 @@ class Config:
     def from_toml(cls, path: str) -> "Config":
         """Load from a TOML file (field names match the reference's
         upper-snake keys)."""
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:
+            # Python < 3.11 (the container ships 3.10 and cannot install
+            # tomli); the compat parser covers the full config grammar
+            from stellar_tpu.utils import toml_compat as tomllib
         with open(path, "rb") as f:
             raw = tomllib.load(f)
         cfg = cls()

@@ -30,6 +30,14 @@ __all__ = [
     "D2_LIMBS", "SQRTM1_LIMBS", "unpack255",
 ]
 
+# Window-method shape constants (read by tools/kernel_cost.py).
+# Signed radix-16 (digits in [-8, 8)): each window select contracts over
+# the 8 cached multiples 1..8 of the base; sign is a cheap cached-form
+# negate and digit 0 a limb-0 identity fixup — HALF the one-hot MAC
+# volume of the unsigned 16-entry scheme (see docs/kernel_design.md).
+WINDOWS = 64       # radix-16 digits per 256-bit scalar
+TABLE_ENTRIES = 8  # one-hot contraction entries per window select
+
 # Curve constants as canonical limb vectors (host numpy, broadcast at trace).
 D_LIMBS = fe.from_int(ref.D)
 D2_LIMBS = fe.from_int(2 * ref.D % ref.P)
@@ -52,13 +60,23 @@ def negate(p):
     return (fe.neg(x), y, z, fe.neg(t))
 
 
-def _mul4(ls, rs):
-    """Four field multiplies fused into ONE stacked multiply over a
-    (20, 4, *batch) operand. The hot loop is bound by per-op overhead on
-    small (20, batch) tensors, not FLOPs — quartering the op count by
+def _mulstack(ls, rs):
+    """N field multiplies fused into ONE stacked multiply over a
+    (20, N, *batch) operand. The hot loop is bound by per-op overhead on
+    small (20, batch) tensors, not FLOPs — dividing the op count by
     widening the batch axis is the single biggest lever on TPU."""
     o = fe.mul(jnp.stack(ls, axis=1), jnp.stack(rs, axis=1))
-    return o[:, 0], o[:, 1], o[:, 2], o[:, 3]
+    return tuple(o[:, i] for i in range(len(ls)))
+
+
+def _stack_points(ps):
+    """Points (tuples of (20, *batch) coords) -> one point whose batch is
+    (len(ps), *batch): same-shaped group ops fuse into one call."""
+    return tuple(jnp.stack(cs, axis=1) for cs in zip(*ps))
+
+
+def _unstack_points(p, n):
+    return [tuple(c[:, i] for c in p) for i in range(n)]
 
 
 def to_cached(p):
@@ -70,19 +88,32 @@ def to_cached(p):
     return (fe.add(y, x), fe.sub(y, x), z, fe.mul(t, d2))
 
 
-def point_add_cached(p, q_cached):
+def point_add_cached(p, q_cached, need_t=True, z2_is_one=False):
     """p (extended) + q (cached) — complete unified addition as two
-    fused 4-way multiplies (reference: libsodium ge25519_add)."""
-    x1, y1, z1, t1 = p
+    fused stacked multiplies (reference: libsodium ge25519_add).
+
+    ``need_t=False`` returns a projective (X, Y, Z) triple, dropping the
+    E*H lane of the output multiply — valid whenever the result only
+    feeds doublings or encode (both ignore T).  ``z2_is_one`` drops the
+    Z1*Z2 lane of the input multiply when q's Z is exactly 1 (the
+    precomputed base table is stored affine)."""
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     ypx2, ymx2, z2, t2d2 = q_cached
-    a, b, c, dd = _mul4((fe.sub(y1, x1), fe.add(y1, x1), t1, z1),
-                        (ymx2, ypx2, t2d2, z2))
+    if z2_is_one:
+        a, b, c = _mulstack((fe.sub(y1, x1), fe.add(y1, x1), t1),
+                            (ymx2, ypx2, t2d2))
+        dd = z1
+    else:
+        a, b, c, dd = _mulstack((fe.sub(y1, x1), fe.add(y1, x1), t1, z1),
+                                (ymx2, ypx2, t2d2, z2))
     dd = fe.add(dd, dd)
     e = fe.sub(b, a)
     f = fe.sub(dd, c)
     g = fe.add(dd, c)
     h = fe.add(b, a)
-    return _mul4((e, g, f, e), (f, h, g, h))
+    if need_t:
+        return _mulstack((e, g, f, e), (f, h, g, h))
+    return _mulstack((e, g, f), (f, h, g))
 
 
 def point_add(p, q):
@@ -90,9 +121,14 @@ def point_add(p, q):
     return point_add_cached(p, to_cached(q))
 
 
-def point_double(p):
-    """Dedicated doubling; one fused squaring + one fused multiply."""
-    x1, y1, z1, _ = p
+def point_double(p, need_t=True):
+    """Dedicated doubling; one fused squaring + one fused multiply.
+
+    Accepts an extended (X, Y, Z, T) or projective (X, Y, Z) point — T is
+    never read.  ``need_t=False`` drops the E*H output lane and returns a
+    projective triple: in a doubling chain only the LAST double before a
+    cached add needs T, so chained doubles run 3-wide, not 4-wide."""
+    x1, y1, z1 = p[0], p[1], p[2]
     s = fe.sqr(jnp.stack([x1, y1, z1, fe.add(x1, y1)], axis=1))
     a, b, zz, xysq = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
     c = fe.add(zz, zz)
@@ -100,7 +136,9 @@ def point_double(p):
     e = fe.sub(h, xysq)
     g = fe.sub(a, b)
     f = fe.add(c, g)
-    return _mul4((e, g, f, e), (f, h, g, h))
+    if need_t:
+        return _mulstack((e, g, f, e), (f, h, g, h))
+    return _mulstack((e, g, f), (f, h, g))
 
 
 def select_point(cond, p, q):
@@ -163,8 +201,10 @@ def compress_equals(p, r_bytes):
     The encoding of p is always canonical, and ``unpack255`` yields the
     exact digits of r's 255-bit integer, so canonical-limb equality plus
     sign-bit equality is exactly libsodium's bytewise crypto_verify_32.
+    Accepts an extended (X, Y, Z, T) or projective (X, Y, Z) point — the
+    double-scalarmult loop returns projective, T never being read here.
     """
-    x, y, z, _ = p
+    x, y, z = p[0], p[1], p[2]
     zinv = fe.inv(z)
     xa = fe.canon(fe.mul(x, zinv))
     ya = fe.canon(fe.mul(y, zinv))
@@ -173,30 +213,50 @@ def compress_equals(p, r_bytes):
 
 
 def table_select(table, digit):
-    """table (16, 4, 20, batch), digit (batch,) int32 -> cached point.
+    """table (8, 4, 20, *batch) cached multiples 1*P..8*P; digit (*batch,)
+    int32 SIGNED window digit in [-8, 8) -> cached point |digit|*P
+    conditionally negated.
 
-    One-hot multiply-accumulate — branchless, constant-shape, VPU-friendly
-    (a gather would lower to a serial dynamic-slice loop on TPU).
+    One-hot multiply-accumulate over the 8 positive multiples — branchless,
+    constant-shape, VPU-friendly (a gather would lower to a serial
+    dynamic-slice loop on TPU) — at HALF the MAC volume of the unsigned
+    16-entry contraction. Digit 0 matches no entry and leaves zeros; the
+    cached identity (1, 1, 1, 0) is patched in with three limb-0 adds.
+    Negative digits cost one cached-form negate: swap Y+X <-> Y-X and
+    negate 2dT (Z unchanged) — adds and selects, no extra multiplies.
+
+    Batch-polymorphic: *batch may itself be stacked, e.g. (2, n) when the
+    B- and A-table selects of the verify loop fuse into one contraction.
     """
-    onehot = (jnp.arange(16, dtype=jnp.int32)[:, None]
-              == digit[None, :]).astype(jnp.int32)
-    sel = (table * onehot[:, None, None, :]).sum(axis=0)
-    return (sel[0], sel[1], sel[2], sel[3])
+    nb = digit.ndim
+    mag = jnp.abs(digit)
+    idx = jnp.arange(1, 9, dtype=jnp.int32).reshape((8,) + (1,) * nb)
+    onehot = (idx == mag[None]).astype(jnp.int32)
+    sel = (table * onehot[:, None, None]).sum(axis=0)  # (4, 20, *batch)
+    ypx, ymx, z, t2d = sel[0], sel[1], sel[2], sel[3]
+    is0 = (digit == 0).astype(jnp.int32)
+    ypx = ypx.at[0].add(is0)
+    ymx = ymx.at[0].add(is0)
+    z = z.at[0].add(is0)
+    neg = digit < 0
+    return (fe.select(neg, ymx, ypx), fe.select(neg, ypx, ymx), z,
+            fe.select(neg, fe.neg(t2d), t2d))
 
 
 def _base_multiples() -> np.ndarray:
-    """Host-precomputed v*B for v in 0..15 in CACHED form (y+x, y-x, 1,
-    2d*x*y) canonical limbs, shape (16, 4, 20) int32."""
-    out = np.zeros((16, 4, fe.NLIMBS), dtype=np.int32)
-    for v in range(16):
+    """Host-precomputed v*B for v in 1..8 in CACHED form (y+x, y-x, 1,
+    2d*x*y) canonical limbs, shape (8, 4, 20) int32. Z is exactly 1, so
+    base-table adds may use the ``z2_is_one`` fast path."""
+    out = np.zeros((TABLE_ENTRIES, 4, fe.NLIMBS), dtype=np.int32)
+    for v in range(1, TABLE_ENTRIES + 1):
         pt = ref.point_mul(v, ref.BASE)
         zinv = ref._inv(pt[2])
         x = pt[0] * zinv % ref.P
         y = pt[1] * zinv % ref.P
-        out[v, 0] = fe.from_int((y + x) % ref.P)
-        out[v, 1] = fe.from_int((y - x) % ref.P)
-        out[v, 2] = fe.from_int(1)
-        out[v, 3] = fe.from_int(2 * ref.D * x * y % ref.P)
+        out[v - 1, 0] = fe.from_int((y + x) % ref.P)
+        out[v - 1, 1] = fe.from_int((y - x) % ref.P)
+        out[v - 1, 2] = fe.from_int(1)
+        out[v - 1, 3] = fe.from_int(2 * ref.D * x * y % ref.P)
     return out
 
 
@@ -204,42 +264,74 @@ _BASE_TABLE = _base_multiples()
 
 
 def base_table(batch_shape):
-    """(16, 4, 20, *batch) broadcast constant cached table of v*B."""
+    """(8, 4, 20, *batch) broadcast constant cached table of v*B, v=1..8."""
     t = jnp.asarray(_BASE_TABLE).reshape(
-        (16, 4, fe.NLIMBS) + (1,) * len(batch_shape))
-    return jnp.broadcast_to(t, (16, 4, fe.NLIMBS) + tuple(batch_shape))
+        (TABLE_ENTRIES, 4, fe.NLIMBS) + (1,) * len(batch_shape))
+    return jnp.broadcast_to(
+        t, (TABLE_ENTRIES, 4, fe.NLIMBS) + tuple(batch_shape))
 
 
 def build_point_table(p):
-    """Per-batch cached table v*p for v in 0..15 -> (16, 4, 20, batch)."""
-    cp = to_cached(p)
-    entries = [to_cached(identity(p[0].shape[1:])), cp]
-    plain = p
-    for v in range(2, 16):
-        plain = point_add_cached(plain, cp)
-        entries.append(to_cached(plain))
-    return jnp.stack([jnp.stack(e) for e in entries])
+    """Per-batch cached table v*p for v in 1..8 -> (8, 4, 20, *batch).
+
+    Seven group ops instead of the old fourteen sequential adds, scheduled
+    so same-shaped ops fuse (ref10 ge25519_scalarmult's precompute DAG):
+
+        2 = dbl(1); 4 = dbl(2); {3, 5} = {2, 4} + 1 (one stacked add);
+        {6, 8} = dbl({3, 4}) (one stacked double); 7 = 6 + 1
+
+    — five fused kernel calls, dependency depth 5 instead of 14, and one
+    stacked ``to_cached`` over all 8 entries instead of 8 separate ones.
+    """
+    c1 = to_cached(p)
+    p2 = point_double(p)
+    p4 = point_double(p2)
+    p3, p5 = _unstack_points(
+        point_add_cached(_stack_points([p2, p4]), _stack_points([c1, c1])),
+        2)
+    p6, p8 = _unstack_points(point_double(_stack_points([p3, p4])), 2)
+    p7 = point_add_cached(p6, c1)
+    cached = to_cached(_stack_points([p, p2, p3, p4, p5, p6, p7, p8]))
+    # (4, 20, 8, *batch) -> (8, 4, 20, *batch)
+    return jnp.moveaxis(jnp.stack(cached), 2, 0)
 
 
 def double_scalarmult(s_digits, h_digits, a_neg):
-    """R' = s*B + h*a_neg via Strauss-Shamir with 4-bit windows.
+    """R' = s*B + h*a_neg via Strauss-Shamir with SIGNED 4-bit windows.
 
-    s_digits, h_digits: (64, batch) int32 radix-16 digits, most significant
-    first. a_neg: extended point (the verifier passes -A). 252 shared
-    doublings + 128 cached-table adds, all under one fori_loop — the hot
-    loop of the whole framework.
+    s_digits, h_digits: (64, batch) int32 signed radix-16 digits in
+    [-8, 8), most significant first (see
+    :func:`stellar_tpu.ops.verify.signed_digits16_dev`; the top digit may
+    reach 8 for scalars < 2^255, and scalars >= 9 * 2^252 — always
+    rejected by the host canonical-s gate — overflow the top window and
+    yield a well-defined garbage result). a_neg: extended point (the
+    verifier passes -A). Returns a PROJECTIVE (X, Y, Z) triple — T is
+    dropped lane-by-lane throughout the loop because nothing downstream
+    (doublings, encode) reads it.
+
+    252 shared doublings + 128 cached adds under one fori_loop — the hot
+    loop of the whole framework. Per iteration: three 3-wide doubles, one
+    4-wide double, ONE fused 8-entry one-hot contraction selecting both
+    the B- and A-table windows (the pair rides a stacked batch axis), a
+    z2=1 base add, and a full cached add. Static cost accounting lives in
+    tools/kernel_cost.py; the MAC ledger in docs/kernel_design.md.
     """
     batch = a_neg[0].shape[1:]
     tab_a = build_point_table(a_neg)
     tab_b = base_table(batch)
+    tab = jnp.stack([tab_b, tab_a], axis=3)  # (8, 4, 20, 2, *batch)
 
     def body(j, acc):
-        for _ in range(4):
-            acc = point_double(acc)
+        acc = point_double(acc, need_t=False)
+        acc = point_double(acc, need_t=False)
+        acc = point_double(acc, need_t=False)
+        acc = point_double(acc)  # the adds below read T
         sd = lax.dynamic_index_in_dim(s_digits, j, 0, keepdims=False)
         hd = lax.dynamic_index_in_dim(h_digits, j, 0, keepdims=False)
-        acc = point_add_cached(acc, table_select(tab_b, sd))
-        acc = point_add_cached(acc, table_select(tab_a, hd))
-        return acc
+        sel = table_select(tab, jnp.stack([sd, hd]))
+        bsel = tuple(c[:, 0] for c in sel)
+        asel = tuple(c[:, 1] for c in sel)
+        acc = point_add_cached(acc, bsel, z2_is_one=True)
+        return point_add_cached(acc, asel, need_t=False)
 
-    return lax.fori_loop(0, 64, body, identity(batch))
+    return lax.fori_loop(0, 64, body, identity(batch)[:3])

@@ -23,21 +23,64 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from stellar_tpu.ops import edwards as ed
 
-__all__ = ["verify_kernel", "verify_kernel_sharded", "digits16_dev"]
+__all__ = ["verify_kernel", "verify_kernel_sharded", "signed_digits16_dev"]
 
 
-def digits16_dev(b):
-    """(batch, 32) uint8 little-endian scalars -> (64, batch) int32 radix-16
-    digits, most significant first. Runs on device so the host ships raw
-    32-byte scalars (4x less relay/PCIe traffic than int32 digit arrays)."""
+def signed_digits16_dev(b):
+    """(batch, 32) uint8 little-endian scalars -> (64, batch) int32 SIGNED
+    radix-16 digits, most significant first: the ref10 signed-window
+    recode (libsodium ge25519_scalarmult's slide), vectorized. Runs on
+    device so the host ships raw 32-byte scalars (4x less relay/PCIe
+    traffic than int32 digit arrays).
+
+    Digits d_i satisfy sum(d_i * 16^i) == s exactly for EVERY 256-bit s,
+    with d_i in [-8, 8) for i < 63; the top digit absorbs the final carry
+    unsigned, so it stays in [0, 2] for canonical scalars (s < L < 2^253)
+    and in [0, 8] for any s < 2^255 — within the 8-entry table range of
+    :func:`stellar_tpu.ops.edwards.table_select`. (Scalars >= 9 * 2^252
+    overflow the top window; the host canonical-s gate rejects them before
+    the verdict, see double_scalarmult's contract.)
+
+    The nibble carry chain (c_{i+1} = 1 iff e_i + c_i >= 8) is a classic
+    generate/propagate recurrence — generate at e_i >= 8, propagate at
+    e_i == 7 — computed in log2(64) = 6 parallel steps with
+    ``lax.associative_scan`` instead of a 64-long sequential chain.
+    """
     x = b.astype(jnp.int32)
     lo = x & 15
     hi = x >> 4
-    inter = jnp.stack([lo, hi], axis=2).reshape(b.shape[0], 64)
-    return inter[:, ::-1].T
+    # (64, batch) unsigned nibbles, LEAST significant first
+    e = jnp.stack([lo, hi], axis=2).reshape(b.shape[0], 64).T
+    gen = e >= 8
+    prop = e == 7
+
+    def comb(lo_pair, hi_pair):
+        g1, p1 = lo_pair
+        g2, p2 = hi_pair
+        return g2 | (p2 & g1), p2 & p1
+
+    g_pre, _ = lax.associative_scan(comb, (gen, prop), axis=0)
+    carry_out = g_pre.astype(jnp.int32)                # c_{i+1}, i = 0..63
+    carry_in = jnp.concatenate(                        # c_i
+        [jnp.zeros_like(carry_out[:1]), carry_out[:-1]], axis=0)
+    # d_i = e_i + c_i - 16*c_{i+1}, except the top digit keeps its carry
+    # (unsigned residue) so the recode reconstructs every 256-bit value.
+    not_top = (jnp.arange(64, dtype=jnp.int32) < 63).astype(jnp.int32)
+    d = e + carry_in - 16 * carry_out * not_top[:, None]
+    return d[::-1]
+
+
+def dsm_stage(s_bytes, h_bytes, a_neg):
+    """Signed-window recode + double-scalarmult: the traceable 'dsm' stage
+    of the kernel (tools/kernel_cost.py accounts cost per stage; the
+    limb layout, window scheme, and MAC ledger live in
+    docs/kernel_design.md)."""
+    return ed.double_scalarmult(
+        signed_digits16_dev(s_bytes), signed_digits16_dev(h_bytes), a_neg)
 
 
 def verify_kernel(a_bytes, r_bytes, s_bytes, h_bytes):
@@ -51,11 +94,14 @@ def verify_kernel(a_bytes, r_bytes, s_bytes, h_bytes):
 
     Returns:
       (batch,) bool — True where decompression succeeded and
-      encode(s*B + h*(-A)) == R bytewise.
+      encode(s*B + h*(-A)) == R bytewise. The scalar mult runs signed
+      radix-16 windows (8-entry tables + conditional negate): exact for
+      every s < 2^255, and the composed verifier decision stays
+      bit-identical to libsodium because s >= L never reaches a verdict
+      (host canonical-s gate).
     """
     ok, a = ed.decompress(a_bytes)
-    rprime = ed.double_scalarmult(
-        digits16_dev(s_bytes), digits16_dev(h_bytes), ed.negate(a))
+    rprime = dsm_stage(s_bytes, h_bytes, ed.negate(a))
     return ok & ed.compress_equals(rprime, r_bytes)
 
 

@@ -1,0 +1,59 @@
+"""Force jax onto its CPU backend and deregister the axon TPU plugin.
+
+The ambient environment pins ``JAX_PLATFORMS=axon`` (the real TPU via a
+tunnel) and a sitecustomize hook registers the axon PJRT plugin in EVERY
+interpreter. JAX initializes registered plugins even when
+``JAX_PLATFORMS=cpu``, so with the tunnel unhealthy the first array
+creation hangs forever. Any CPU-side consumer (the test suite, jaxpr
+tracing in ``tools/kernel_cost.py``) must therefore both override the
+platform config *and* deregister the axon backend factory BEFORE any
+backend is initialized.
+
+This is the single shared copy of that hang-prevention dance — it pokes
+jax private API (``_backend_factories``/``_backend_lock``), so keeping
+one implementation is what stops the copies from drifting. Only
+``bench.py`` talks to the real chip.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu"]
+
+
+def force_cpu(compilation_cache_dir: str | None = None) -> None:
+    """Pin jax to CPU and drop non-CPU backend factories. Idempotent;
+    a no-op (beyond the config update) once backends are initialized —
+    by then it is too late to deregister anything safely.
+
+    ``compilation_cache_dir``: optionally also point jax's persistent
+    compilation cache there (the verify-kernel compiles dominate suite
+    time).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+    except Exception:
+        return
+    try:
+        # The axon register hook hard-sets jax_platforms="axon,cpu" in
+        # the config (env var alone doesn't win); point it back at cpu.
+        jax.config.update("jax_platforms", "cpu")
+        if compilation_cache_dir:
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir", compilation_cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 2.0)
+            except Exception:
+                pass
+        with xb._backend_lock:
+            if xb._backends:
+                return  # backends already initialized; too late, leave it
+            for name in list(xb._backend_factories):
+                if name not in ("cpu", "interpreter"):
+                    del xb._backend_factories[name]
+    except Exception:
+        pass

@@ -12,6 +12,7 @@ Must run before jax arrays are created anywhere.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 # persistent XLA compilation cache: the verify-kernel compiles dominate
@@ -27,32 +28,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 
-def _drop_axon_backend():
-    try:
-        import jax
-        import jax._src.xla_bridge as xb
-    except Exception:
-        return
-    try:
-        # The axon register hook hard-sets jax_platforms="axon,cpu" in the
-        # config (env var alone doesn't win); point it back at cpu.
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.environ["JAX_COMPILATION_CACHE_DIR"])
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 2.0)
-        except Exception:
-            pass
-        with xb._backend_lock:
-            if xb._backends:
-                return  # backends already initialized; too late, leave it
-            for name in list(xb._backend_factories):
-                if name not in ("cpu", "interpreter"):
-                    del xb._backend_factories[name]
-    except Exception:
-        pass
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from stellar_tpu.utils.cpu_backend import force_cpu  # noqa: E402
+
+force_cpu(compilation_cache_dir=os.environ["JAX_COMPILATION_CACHE_DIR"])
 
 
-_drop_axon_backend()
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight differential sweeps excluded from the tier-1 "
+        "gate (run explicitly: pytest -m slow)")

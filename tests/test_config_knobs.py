@@ -109,15 +109,21 @@ def test_op_apply_sleep_knob_slows_apply_not_results():
         from stellar_tpu.tx.tx_test_utils import (
             seed_root_with_accounts as seed,
         )
-        root2 = seed([(a, 1000 * XLM), (b, 1000 * XLM)])
         tx = make_tx(a, (1 << 32) + 1,
                      [payment_op(b, XLM)] * 5)
-        t0 = time.perf_counter()
-        with LedgerTxn(root2) as ltx:
-            tx.process_fee_seq_num(ltx, base_fee=100)
-            res = tx.apply(ltx)
-            ltx.commit()
-        dt = time.perf_counter() - t0
+        # best-of-3: a single scheduler hiccup on a shared host can cost
+        # more than the 20ms injected sleep this test measures (observed:
+        # a 48ms "fast" run during the PR 1 tier-1 triage), and the sleep
+        # knob itself is deterministic, so min() is the honest statistic
+        dt = float("inf")
+        for _ in range(3):
+            root_i = seed([(a, 1000 * XLM), (b, 1000 * XLM)])
+            t0 = time.perf_counter()
+            with LedgerTxn(root_i) as ltx:
+                tx.process_fee_seq_num(ltx, base_fee=100)
+                res = tx.apply(ltx)
+                ltx.commit()
+            dt = min(dt, time.perf_counter() - t0)
         return res.code, dt
 
     code_fast, dt_fast = run()

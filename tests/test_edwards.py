@@ -44,8 +44,8 @@ def to_device(pts):
 
 
 def to_affine_ints(p):
-    """Device point tuple -> list of (x, y) Python ints."""
-    x, y, z, _ = (np.asarray(fe.canon(c)) for c in p)
+    """Device point tuple (extended or projective) -> (x, y) ints."""
+    x, y, z = (np.asarray(fe.canon(c)) for c in p[:3])
     xs, ys, zs = fe.to_int(x), fe.to_int(y), fe.to_int(z)
     out = []
     for i in range(xs.shape[0]):
@@ -134,9 +134,25 @@ def test_decompress_noncanonical_y_wraps_mod_p():
     assert to_affine_ints(pt)[0] == ref_affine(y3)
 
 
-def digits16(x, n=64):
-    """msb-first radix-16 digits of a 256-bit int."""
-    return [(x >> (4 * (n - 1 - i))) & 0xF for i in range(n)]
+def signed_digits16(x, n=64):
+    """msb-first SIGNED radix-16 digits (host reference of the ref10
+    recode: digits in [-8, 8), top digit unsigned residue)."""
+    digs = []
+    for i in range(n):
+        d = x & 15
+        x >>= 4
+        if d >= 8 and i < n - 1:
+            d -= 16
+            x += 1
+        digs.append(d)
+    assert x == 0, "scalar wider than n windows"
+    return digs[::-1]
+
+
+def scalars_to_signed_digits(vals):
+    """List of ints -> (64, batch) signed-digit device array."""
+    return jnp.asarray(np.array([signed_digits16(v) for v in vals]).T,
+                       dtype=jnp.int32)
 
 
 def test_double_scalarmult_matches_ref():
@@ -144,16 +160,70 @@ def test_double_scalarmult_matches_ref():
     pts = random_ref_points(n)
     ss = [secrets.randbelow(ref.L) for _ in range(n)]
     hs = [secrets.randbelow(ref.L) for _ in range(n)]
-    s_d = jnp.asarray(np.array([digits16(s) for s in ss]).T, dtype=jnp.int32)
-    h_d = jnp.asarray(np.array([digits16(h) for h in hs]).T, dtype=jnp.int32)
     a_neg = ed.negate(to_device(pts))
-    got = to_affine_ints(ed.double_scalarmult(s_d, h_d, a_neg))
+    got = to_affine_ints(ed.double_scalarmult(
+        scalars_to_signed_digits(ss), scalars_to_signed_digits(hs), a_neg))
     want = []
     for s, h, p in zip(ss, hs, pts):
         neg = (ref.P - p[0], p[1], p[2], (ref.P - p[3]) % ref.P)
         want.append(ref_affine(ref.point_add(ref.point_mul(s, ref.BASE),
                                              ref.point_mul(h, neg))))
     assert got == want
+
+
+def test_double_scalarmult_boundary_scalars():
+    """Window-scheme edge scalars: 0 (all-identity selects), 1, 8 and -8
+    digit boundaries (0x88... patterns), L-1, 2^252, and the largest
+    top-window residues a canonical scalar can produce."""
+    cases = [0, 1, 8, 0x88, ref.L - 1, 2**252, 2**252 - 1,
+             int("8" * 63, 16), int("7" * 63, 16), 2**252 + 7]
+    n = len(cases)
+    pts = random_ref_points(n)
+    a_neg = ed.negate(to_device(pts))
+    d = scalars_to_signed_digits(cases)
+    got = to_affine_ints(ed.double_scalarmult(
+        d, d[:, ::-1], a_neg))
+    want = []
+    for s, h, p in zip(cases, reversed(cases), pts):
+        neg = (ref.P - p[0], p[1], p[2], (ref.P - p[3]) % ref.P)
+        want.append(ref_affine(ref.point_add(ref.point_mul(s, ref.BASE),
+                                             ref.point_mul(h, neg))))
+    assert got == want
+
+
+def test_table_select_signed_digits():
+    """table_select returns d*P in cached form for every d in [-8, 8]
+    (+8 included: the unsigned top digit reaches it for s < 2^255),
+    including the identity fixup at d == 0."""
+    base = random_ref_points(1)[0]
+    dev = to_device([base] * 17)
+    tab = ed.build_point_table(dev)
+    digits = jnp.asarray(np.arange(-8, 9, dtype=np.int32))
+    ypx, ymx, z, t2d = ed.table_select(tab, digits)
+    # reconstruct extended coords from the cached form: x = (ypx-ymx)/2 ...
+    ident = ed.identity((17,))
+    got = to_affine_ints(ed.point_add_cached(ident, (ypx, ymx, z, t2d)))
+    want = []
+    for d in range(-8, 9):
+        q = ref.point_mul(abs(d), base)
+        if d < 0:
+            q = (ref.P - q[0], q[1], q[2], (ref.P - q[3]) % ref.P)
+        want.append(ref_affine(q))
+    assert got == want
+
+
+def test_build_point_table_entries():
+    """The fused 7-op table build yields exactly v*P for v = 1..8."""
+    pts = random_ref_points(3)
+    dev = to_device(pts)
+    tab = np.asarray(ed.build_point_table(dev))
+    assert tab.shape == (8, 4, fe.NLIMBS, 3)
+    for v in range(1, 9):
+        ypx, ymx, z, t2d = (jnp.asarray(tab[v - 1, i]) for i in range(4))
+        got = to_affine_ints(ed.point_add_cached(
+            ed.identity((3,)), (ypx, ymx, z, t2d)))
+        want = [ref_affine(ref.point_mul(v, p)) for p in pts]
+        assert got == want, v
 
 
 def test_compress_equals():

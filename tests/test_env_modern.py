@@ -480,6 +480,10 @@ def test_verify_sig_ed25519_in_contract(hostenv):
 
 def test_secp256k1_recover_and_p256_verify(hostenv):
     env, t, inst = hostenv
+    pytest.importorskip(
+        "cryptography",
+        reason="differential oracle needs the cryptography package "
+               "(absent in this container; nothing may be installed)")
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (

@@ -78,13 +78,15 @@ def test_mod_l_edge_digests():
         assert native_mod(val) == val % L, hex(val)
 
 
-def test_digits16_dev_matches_host():
+def test_signed_digits16_dev_matches_host():
     import jax
-    from stellar_tpu.ops.verify import digits16_dev
+    from stellar_tpu.ops.verify import signed_digits16_dev
     rng = np.random.RandomState(5)
     b = rng.randint(0, 256, (16, 32)).astype(np.uint8)
-    got = np.asarray(jax.jit(digits16_dev)(b))
+    got = np.asarray(jax.jit(signed_digits16_dev)(b))
     for i in range(16):
         val = int.from_bytes(b[i].tobytes(), "little")
-        digs = [(val >> (4 * k)) & 15 for k in range(64)][::-1]
-        np.testing.assert_array_equal(got[:, i], digs)
+        acc = 0
+        for d in got[:, i]:
+            acc = acc * 16 + int(d)
+        assert acc == val

@@ -1,0 +1,164 @@
+"""Kernel-vs-oracle differential for the signed-window verify kernel
+(PR 1 acceptance): the composed device+host decision must be bit-identical
+to the libsodium-exact ``ed25519_ref`` oracle over random and structured
+edge vectors, at EVERY bucket size (each padded bucket jit-compiles its own
+kernel), including the padding lanes themselves.
+
+The 10k-vector sweep is ``-m slow`` (excluded from tier-1; run it when
+touching anything under stellar_tpu/ops/)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import ed25519_ref as ref
+from stellar_tpu.crypto.batch_verifier import BatchVerifier
+
+RNG = np.random.default_rng(0x51D3)
+
+
+def _keypair():
+    seed = secrets.token_bytes(32)
+    return seed, ref.secret_to_public(seed)
+
+
+def make_valid(n, msglen=lambda i: 1 + i % 64):
+    items = []
+    for i in range(n):
+        seed, pk = _keypair()
+        msg = secrets.token_bytes(msglen(i))
+        items.append((pk, msg, ref.sign(seed, msg)))
+    return items
+
+
+def edge_corpus():
+    """Structured adversarial vectors: small-order A/R, non-canonical
+    encodings, undecompressable keys, non-canonical s, bad lengths, zero
+    rows (the padding-lane pattern), RFC 8032 controls."""
+    seed, pk = _keypair()
+    msg = b"edge corpus"
+    sig = ref.sign(seed, msg)
+    r, s = sig[:32], sig[32:]
+    items = [(pk, msg, sig)]  # control
+    # small-order A and R, canonical + sign-flipped encodings
+    for enc in sorted(ref.SMALL_ORDER_ENCODINGS):
+        items.append((enc, msg, sig))
+        items.append((enc[:31] + bytes([enc[31] | 0x80]), msg, sig))
+        items.append((pk, msg, enc + s))
+    # non-canonical A (y = p + 3 has a valid x), non-canonical y for R
+    items.append(((ref.P + 3).to_bytes(32, "little"), msg, sig))
+    items.append((pk, msg, (ref.P + 3).to_bytes(32, "little") + s))
+    # undecompressable A (first three y with no sqrt)
+    y, found = 2, 0
+    while found < 3:
+        enc = int(y).to_bytes(32, "little")
+        if ref.point_decompress(enc) is None:
+            items.append((enc, msg, sig))
+            found += 1
+        y += 1
+    # negative zero A
+    nz = bytearray(int(1).to_bytes(32, "little"))
+    nz[31] |= 0x80
+    items.append((bytes(nz), msg, sig))
+    # non-canonical s: L, s + L, max; s = 0; top-window overflow scalars
+    s_int = int.from_bytes(s, "little")
+    for bad in (ref.L, s_int + ref.L, 2**256 - 1, 0, 9 * 2**252,
+                15 * 2**252 + s_int % 2**252):
+        items.append((pk, msg, r + int(bad % 2**256).to_bytes(32, "little")))
+    # bad lengths
+    items.append((pk[:31], msg, sig))
+    items.append((pk + b"\x00", msg, sig))
+    items.append((pk, msg, sig[:63]))
+    items.append((pk, msg, sig + b"\x00"))
+    items.append((b"", msg, sig))
+    items.append((pk, msg, b""))
+    # all-zero rows: exactly what padding lanes would look like if they
+    # leaked — must come back False like the oracle says
+    items.append((bytes(32), msg, bytes(64)))
+    items.append((bytes(32), b"", bytes(64)))
+    # tampered message / R / s single-bit flips
+    items.append((pk, msg + b"x", sig))
+    flip = bytearray(sig)
+    flip[5] ^= 0x40
+    items.append((pk, msg, bytes(flip)))
+    flip2 = bytearray(sig)
+    flip2[40] ^= 1
+    items.append((pk, msg, bytes(flip2)))
+    return items
+
+
+def check(verifier, items):
+    got = verifier.verify_batch(items)
+    want = np.array([ref.verify(pk, m, sg) for pk, m, sg in items])
+    mism = [i for i in range(len(items)) if got[i] != want[i]]
+    assert not mism, mism
+    return got
+
+
+@pytest.mark.parametrize("bucket", [4, 16])
+def test_differential_every_bucket_size(bucket):
+    """Each bucket size compiles its own kernel instance: run the edge
+    corpus + fresh valid signatures through each, with batch sizes chosen
+    to force padding (n % bucket != 0) and chunking (n > bucket)."""
+    v = BatchVerifier(bucket_sizes=(bucket,))
+    items = edge_corpus() + make_valid(5)
+    # non-multiple size: the final chunk is padded; > bucket: chunks loop
+    assert len(items) % bucket != 0 and len(items) > bucket
+    got = check(v, items)
+    assert got[0] and got[-5:].all()  # controls verify
+    assert not got[1]                 # small-order rejected
+
+
+def test_padding_lanes_do_not_leak():
+    """A solo item in a 16-wide bucket shares the kernel with 15 padding
+    rows; its decision must equal the unpadded one and the padding must
+    never surface."""
+    v = BatchVerifier(bucket_sizes=(16,))
+    items = make_valid(1)
+    bad = (items[0][0], items[0][1] + b"!", items[0][2])
+    assert list(v.verify_batch(items)) == [True]
+    assert list(v.verify_batch([bad])) == [False]
+    out = v.verify_batch(items + [bad] + items)
+    assert list(out) == [True, False, True]
+
+
+def test_mixed_buckets_agree():
+    """The same workload through different bucket configurations yields
+    identical decisions (bucketing is an execution detail, not policy)."""
+    items = edge_corpus()[:20] + make_valid(5)
+    a = BatchVerifier(bucket_sizes=(4,)).verify_batch(items)
+    b = BatchVerifier(bucket_sizes=(16,)).verify_batch(items)
+    assert (a == b).all()
+
+
+@pytest.mark.slow
+def test_differential_10k_random_vectors():
+    """ISSUE 1 acceptance: >= 10k random vectors, bit-identical decisions.
+    Random valid signatures with random single-byte corruptions applied to
+    a third of them, chunked through a 2048-bucket verifier."""
+    n = 10_240
+    keys = [_keypair() for _ in range(32)]
+    items = []
+    for i in range(n):
+        seed, pk = keys[i % len(keys)]
+        msg = RNG.bytes(1 + (i % 96))
+        sig = ref.sign(seed, msg)
+        if i % 3 == 0:
+            which = int(RNG.integers(0, 3))
+            if which == 0:
+                b = bytearray(pk)
+            elif which == 1:
+                b = bytearray(sig)
+            else:
+                b = bytearray(msg)
+            if len(b):
+                b[int(RNG.integers(0, len(b)))] ^= 1 << int(
+                    RNG.integers(0, 8))
+            pk, sig, msg = ((bytes(b), sig, msg) if which == 0 else
+                            (pk, bytes(b), msg) if which == 1 else
+                            (pk, sig, bytes(b)))
+        items.append((pk, msg, sig))
+    v = BatchVerifier(bucket_sizes=(2048,))
+    got = check(v, items)
+    assert got.any() and not got.all()

@@ -1,0 +1,195 @@
+"""Static cost accounting for the TPU verify kernel, from traced jaxprs.
+
+The TPU tunnel is frequently unreachable (0/332 live probes in round 5), so
+kernel optimizations need a hardware-independent scoreboard. This tool traces
+the jitted verify kernel's three stages —
+
+  * ``decompress``       — ``ops.edwards.decompress`` (A frombytes),
+  * ``dsm``              — scalar recode + table build + the Strauss-Shamir
+                           double-scalarmult loop (the hot loop), and
+  * ``compress_compare`` — ``ops.edwards.compress_equals`` (one field inverse
+                           + canonical compare)
+
+— and counts multiply work two ways from the jaxpr:
+
+  * **static**   — multiply *ops* (HLO ``mul``/``dot_general`` equations) with
+    every ``scan``/``while`` body counted ONCE: the size of the compiled
+    program, the cost model for a launch-overhead-bound kernel (the repo's
+    measured regime on small batches — see ``ops.edwards._mulstack``'s
+    note).
+  * **weighted** — the same traversal with ``scan`` bodies multiplied by their
+    static trip counts: total multiply ops *executed* per kernel call.  The
+    element variant (``*_elems``) additionally weights each op by its output
+    element count, i.e. scalar multiply (MAC) volume per call.
+
+``select_macs_per_verify`` is the analytic one-hot-contraction volume of the
+window selects (2 tables x 64 windows x entries x 4 coords x 20 limbs): the
+quantity the signed-window rework (PR 1) halves.
+
+Run as a script for one JSON line (used by ``bench.py`` when the device is
+dead, and by ``tests/test_kernel_cost.py`` as a regression gate):
+
+    python tools/kernel_cost.py            # pretty
+    python tools/kernel_cost.py --json     # one JSON line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BATCH_DEFAULT = 128
+
+
+def force_cpu():
+    """Pin jax to CPU and deregister the axon TPU plugin (the shared
+    dance in stellar_tpu.utils.cpu_backend): tracing needs a backend for
+    constants, and with the tunnel down any axon array creation hangs
+    forever. Must run before jax initializes a backend."""
+    from stellar_tpu.utils.cpu_backend import force_cpu as _force_cpu
+    _force_cpu()
+
+
+# Multiply-like primitives. ``mul`` is elementwise; ``dot_general`` (none in
+# the current kernel, but counted defensively) weights by contraction size.
+_MUL_PRIMS = ("mul", "dot_general")
+
+
+def _out_elems(eqn) -> int:
+    import numpy as np
+    n = 0
+    for v in eqn.outvars:
+        aval = v.aval
+        n += int(np.prod(aval.shape)) if aval.shape else 1
+    if eqn.primitive.name == "dot_general":
+        dims = eqn.params["dimension_numbers"][0][0]
+        lhs = eqn.invars[0].aval.shape
+        for d in dims:
+            n *= int(lhs[d])
+    return n
+
+
+def _sub_jaxprs(eqn):
+    """Yield (sub_jaxpr, trip_count) pairs for an equation's nested bodies.
+    trip_count is None when unknown (while bodies, cond branches)."""
+    import jax.core as core
+    name = eqn.primitive.name
+    if name == "scan":
+        yield eqn.params["jaxpr"], int(eqn.params["length"])
+        return
+    if name == "while":
+        yield eqn.params["cond_jaxpr"], None
+        yield eqn.params["body_jaxpr"], None
+        return
+    if name == "cond":
+        for br in eqn.params["branches"]:
+            yield br, None
+        return
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if isinstance(v, core.ClosedJaxpr):
+                yield v, 1
+            elif isinstance(v, core.Jaxpr):
+                yield v, 1
+
+
+def count_jaxpr(jaxpr) -> dict:
+    """Count multiply ops/elements in a (Closed)Jaxpr.
+
+    Returns dict with ``static_mul_ops``/``static_mul_elems`` (loop bodies
+    once) and ``weighted_mul_ops``/``weighted_mul_elems`` (scan bodies times
+    their trip counts; unknown-trip bodies count once and set
+    ``has_unbounded_loop``).
+    """
+    import jax.core as core
+    if isinstance(jaxpr, core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    out = {"static_mul_ops": 0, "static_mul_elems": 0,
+           "weighted_mul_ops": 0, "weighted_mul_elems": 0,
+           "has_unbounded_loop": False}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _MUL_PRIMS:
+            elems = _out_elems(eqn)
+            out["static_mul_ops"] += 1
+            out["static_mul_elems"] += elems
+            out["weighted_mul_ops"] += 1
+            out["weighted_mul_elems"] += elems
+        for sub, trips in _sub_jaxprs(eqn):
+            c = count_jaxpr(sub)
+            out["static_mul_ops"] += c["static_mul_ops"]
+            out["static_mul_elems"] += c["static_mul_elems"]
+            w = 1 if trips is None else trips
+            out["weighted_mul_ops"] += w * c["weighted_mul_ops"]
+            out["weighted_mul_elems"] += w * c["weighted_mul_elems"]
+            out["has_unbounded_loop"] |= (
+                trips is None or c["has_unbounded_loop"])
+    return out
+
+
+def _abstract_inputs(batch: int):
+    import jax
+    import numpy as np
+    bytes32 = jax.ShapeDtypeStruct((batch, 32), np.uint8)
+    from stellar_tpu.ops import field25519 as fe
+    limb = jax.ShapeDtypeStruct((fe.NLIMBS, batch), np.int32)
+    return bytes32, (limb, limb, limb, limb)
+
+
+def trace_stages(batch: int = BATCH_DEFAULT) -> dict:
+    """Trace each verify-kernel stage and the whole kernel; return
+    per-stage counts plus analytic select-MAC volume."""
+    import jax
+    from stellar_tpu.ops import edwards as ed
+    from stellar_tpu.ops import verify as vk
+
+    bytes32, point = _abstract_inputs(batch)
+
+    def dsm(s_bytes, h_bytes, x, y, z, t):
+        return vk.dsm_stage(s_bytes, h_bytes, (x, y, z, t))
+
+    stages = {
+        "decompress": jax.make_jaxpr(ed.decompress)(bytes32),
+        "dsm": jax.make_jaxpr(dsm)(bytes32, bytes32, *point),
+        "compress_compare": jax.make_jaxpr(
+            lambda x, y, z, t, r: ed.compress_equals((x, y, z, t), r))(
+                *point, bytes32),
+        "kernel_total": jax.make_jaxpr(vk.verify_kernel)(
+            bytes32, bytes32, bytes32, bytes32),
+    }
+    out = {"batch": batch, "stages": {}}
+    for name, jx in stages.items():
+        out["stages"][name] = count_jaxpr(jx)
+    entries = ed.TABLE_ENTRIES
+    out["table_entries"] = entries
+    out["windows"] = ed.WINDOWS
+    # 2 tables (B and A) selected per window, 4 cached coords, 20 limbs.
+    out["select_macs_per_verify"] = 2 * ed.WINDOWS * entries * 4 * 20
+    for k in ("static_mul_ops", "weighted_mul_ops",
+              "static_mul_elems", "weighted_mul_elems"):
+        out["dsm_" + k] = out["stages"]["dsm"][k]
+    return out
+
+
+def main(argv):
+    as_json = "--json" in argv
+    batch = BATCH_DEFAULT
+    for a in argv:
+        if a.startswith("--batch="):
+            batch = int(a.split("=", 1)[1])
+    force_cpu()
+    rec = trace_stages(batch)
+    if as_json:
+        print(json.dumps(rec))
+    else:
+        print(json.dumps(rec, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

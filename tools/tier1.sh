@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 verification gate — the EXACT command from ROADMAP.md, so the
+# builder and the reviewer run the identical check. Prints DOTS_PASSED=<n>
+# (count of passing-test dots in the pytest progress lines) and exits with
+# pytest's status.
+#
+# Usage: bash tools/tier1.sh    (from the repo root or anywhere)
+
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 2
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc
