@@ -33,19 +33,36 @@ The process-wide verify-result cache (the reference's 0xffff-entry
 ``RandomEvictionCache``, ``SecretKey.cpp:44-48,318-338``) lives in
 ``stellar_tpu.crypto.keys``; :meth:`BatchVerifier.install` wires this
 verifier in behind it.
+
+Fault tolerance (``docs/robustness.md``): the tunnel's observed failure
+mode is a HANG, not an exception — a mid-flight death would park
+``resolve`` in ``np.asarray`` forever. Every device interaction is
+therefore (a) deadline-guarded (``VERIFY_DEVICE_DEADLINE_MS``), (b)
+accounted to a process-wide circuit breaker, and (c) backed by host
+re-verification of the affected chunk through the same oracle stack
+(`ed25519_ref`/`native_verify`) — degraded mode changes latency, never
+decisions. The breaker also paces ``device_available`` re-probes so a
+recovered tunnel is picked up (half-open) instead of being ignored for
+the life of the process.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from stellar_tpu.crypto import ed25519_ref as ref
 from stellar_tpu.crypto import native_prep
+from stellar_tpu.utils import faults, resilience
+from stellar_tpu.utils.metrics import registry
 
-__all__ = ["BatchVerifier", "default_verifier"]
+__all__ = ["BatchVerifier", "default_verifier", "device_available",
+           "dispatch_health", "configure_dispatch"]
 
 _L = ref.L
 _P = ref.P
@@ -56,6 +73,130 @@ _SMALL_ORDER = np.stack([np.frombuffer(e, dtype=np.uint8)
 
 _L_BYTES = np.frombuffer(_L.to_bytes(32, "little"), dtype=np.uint8)
 _P_BYTES = np.frombuffer(_P.to_bytes(32, "little"), dtype=np.uint8)
+
+
+# ---------------- dispatch resilience policy ----------------
+# Env defaults let tools/bench set these without a Config; a node pushes
+# its Config knobs through configure_dispatch() at setup.
+
+DEADLINE_MS = float(os.environ.get("VERIFY_DEVICE_DEADLINE_MS", "8000"))
+DISPATCH_RETRIES = int(os.environ.get("VERIFY_DISPATCH_RETRIES", "1"))
+
+_log = logging.getLogger("stellar_tpu.crypto")
+
+
+def _on_breaker_transition(old: str, new: str) -> None:
+    registry.counter("crypto.verify.breaker.transitions").inc()
+    registry.gauge("crypto.verify.breaker.state").set(new)
+    _log.warning("verify-device breaker %s -> %s", old, new)
+
+
+_breaker = resilience.CircuitBreaker(
+    name="verify-device",
+    failure_threshold=int(os.environ.get(
+        "VERIFY_BREAKER_FAILURE_THRESHOLD", "3")),
+    backoff_min_s=float(os.environ.get(
+        "VERIFY_BREAKER_BACKOFF_MIN_S", "1")),
+    backoff_max_s=float(os.environ.get(
+        "VERIFY_BREAKER_BACKOFF_MAX_S", "120")),
+    on_transition=_on_breaker_transition)
+
+
+def configure_dispatch(deadline_ms: Optional[float] = None,
+                       dispatch_retries: Optional[int] = None,
+                       failure_threshold: Optional[int] = None,
+                       backoff_min_s: Optional[float] = None,
+                       backoff_max_s: Optional[float] = None) -> None:
+    """Push dispatch-resilience knobs (Config / tests); None keeps the
+    current value. ``deadline_ms <= 0`` disables the resolve watchdog."""
+    global DEADLINE_MS, DISPATCH_RETRIES
+    if deadline_ms is not None:
+        DEADLINE_MS = float(deadline_ms)
+    if dispatch_retries is not None:
+        DISPATCH_RETRIES = max(0, int(dispatch_retries))
+    _breaker.configure(failure_threshold=failure_threshold,
+                       backoff_min_s=backoff_min_s,
+                       backoff_max_s=backoff_max_s)
+
+
+def served_counts() -> dict:
+    """Process-wide items-served tally by backend — the attribution
+    bench.py records so a silent fallback can never be reported as a
+    device number."""
+    return {
+        "device": registry.meter("crypto.verify.serve.device").count,
+        "host_fallback": registry.meter(
+            "crypto.verify.serve.host_fallback").count,
+    }
+
+
+def dispatch_health() -> dict:
+    """Degradation observability (info endpoint / `dispatch` admin
+    route): breaker state, backend attribution, fallback/retry/deadline
+    counters, active knobs."""
+    return {
+        "device_state": _device_state or "unprobed",
+        "breaker": _breaker.snapshot(),
+        "deadline_ms": DEADLINE_MS,
+        "dispatch_retries": DISPATCH_RETRIES,
+        "served": served_counts(),
+        "fallback_chunks": registry.meter(
+            "crypto.verify.dispatch.fallback").count,
+        "deadline_misses": registry.counter(
+            "crypto.verify.dispatch.deadline_miss").count,
+        "retries": registry.counter("crypto.verify.dispatch.retry").count,
+        "short_circuits": registry.counter(
+            "crypto.verify.dispatch.short_circuit").count,
+    }
+
+
+def _note_device_failure(stage: str, exc: BaseException) -> None:
+    """One failing device interaction: breaker accounting + metrics.
+    The caller re-verifies the affected chunk on the host."""
+    registry.meter("crypto.verify.dispatch.fallback").mark()
+    _breaker.record_failure()
+    _log.warning(
+        "device %s failed (%s: %s) — affected chunk re-verified on the "
+        "host oracle", stage, type(exc).__name__, exc)
+
+
+def _resolve_budget_s() -> Optional[float]:
+    """Watchdog budget for one device-array fetch, or None (unguarded).
+    Guarded whenever a real accelerator answered the probe (hangs are
+    its observed failure mode) or a chaos fault is armed; UNGUARDED on
+    jax-CPU/unprobed processes — XLA-on-CPU test executions are slow
+    but cannot tunnel-hang, and a false deadline trip there would
+    silently reroute differential tests to the host oracle."""
+    if DEADLINE_MS <= 0:
+        return None
+    if faults.is_active(faults.RESOLVE) or faults.is_active(faults.DISPATCH):
+        return DEADLINE_MS / 1000.0
+    if _device_state in (None, "cpu"):
+        return None
+    return DEADLINE_MS / 1000.0
+
+
+def _fetch(dev) -> np.ndarray:
+    """The blocking half of a dispatch (runs under the watchdog)."""
+    faults.inject(faults.RESOLVE)
+    return np.asarray(dev)
+
+
+def _host_verify_items(items: Sequence[tuple]) -> np.ndarray:
+    """Bit-identical host re-verification of (pk, msg, sig) triples —
+    the failover path. Libsodium's policy gate stays the single source
+    of truth (``ed25519_ref._policy_gate``); curve equations ride the
+    threaded native batch when it built, else the pure oracle."""
+    from stellar_tpu.crypto import keys
+    out = np.zeros(len(items), dtype=bool)
+    good = [i for i, (pk, _m, sg) in enumerate(items)
+            if len(pk) == 32 and len(sg) == 64]
+    if good:
+        res = keys._host_oracle_batch(
+            [(None,) + tuple(items[i]) for i in good])
+        for i, okv in zip(good, res):
+            out[i] = bool(okv)
+    return out
 
 
 def _lt_le_bytes(vals: np.ndarray, bound: np.ndarray) -> np.ndarray:
@@ -92,6 +233,19 @@ class BatchVerifier:
         self._mesh = mesh
         self._buckets = tuple(sorted(bucket_sizes))
         self._kernels = {}
+        # per-instance backend attribution (items served), mirrored into
+        # the process-wide meters: bench and the chaos tests read these
+        self._stats_lock = threading.Lock()
+        self.served = {"device": 0, "host-fallback": 0}
+        self.deadline_misses = 0
+        self.retries = 0
+
+    def _mark_served(self, kind: str, n: int) -> None:
+        with self._stats_lock:
+            self.served[kind] += n
+        registry.meter("crypto.verify.serve." +
+                       ("device" if kind == "device" else
+                        "host_fallback")).mark(n)
 
     # ---------------- device dispatch ----------------
 
@@ -114,7 +268,11 @@ class BatchVerifier:
     def _dispatch_device(self, a: np.ndarray, r: np.ndarray, s: np.ndarray,
                          h: np.ndarray):
         """Dispatch padded/chunked batches to the jitted kernel without
-        blocking; returns a list of (slice, device_array)."""
+        blocking; returns a list of (slice, chunk_len, device_array).
+        A chunk whose dispatch raises (or that the open breaker refuses)
+        carries ``None`` and is re-verified on the host at resolve time;
+        transient dispatch exceptions get ``DISPATCH_RETRIES`` fresh
+        attempts first."""
         n = a.shape[0]
         top = self._buckets[-1]
         pending = []
@@ -128,7 +286,27 @@ class BatchVerifier:
             rr = np.concatenate([r[sl], np.repeat(_PAD_R, pad, 0)])
             ss = np.concatenate([s[sl], np.repeat(_PAD_S, pad, 0)])
             hh = np.concatenate([h[sl], np.repeat(_PAD_H, pad, 0)])
-            pending.append((sl, chunk, self._kernel_for(b)(aa, rr, ss, hh)))
+            dev = None
+            if _breaker.allow():
+                attempts = 1 + DISPATCH_RETRIES
+                for attempt in range(attempts):
+                    try:
+                        faults.inject(faults.DISPATCH)
+                        dev = self._kernel_for(b)(aa, rr, ss, hh)
+                        break
+                    except Exception as e:
+                        dev = None
+                        if attempt + 1 < attempts:
+                            registry.counter(
+                                "crypto.verify.dispatch.retry").inc()
+                            with self._stats_lock:
+                                self.retries += 1
+                        else:
+                            _note_device_failure("dispatch", e)
+            else:
+                registry.counter(
+                    "crypto.verify.dispatch.short_circuit").inc()
+            pending.append((sl, chunk, dev))
             start += chunk
         return pending
 
@@ -192,11 +370,46 @@ class BatchVerifier:
         if not ok.any():
             return lambda: ok
         pending = self._dispatch_device(a, r, s, h)
+        items = list(items)  # pinned for possible host re-verification
 
         def resolve() -> np.ndarray:
             out = np.zeros(n, dtype=bool)
             for sl, chunk, dev in pending:
-                out[sl] = np.asarray(dev)[:chunk]
+                got = None
+                if dev is not None:
+                    # an OPEN breaker short-circuits remaining chunks so
+                    # one outage costs threshold x deadline, not chunks
+                    # x deadline; state (not allow()) is checked because
+                    # a half-open chunk already holds its grant from
+                    # dispatch time and must be fetched, not refused
+                    if _breaker.state != resilience.OPEN:
+                        try:
+                            got = resilience.call_with_deadline(
+                                lambda d=dev: _fetch(d),
+                                _resolve_budget_s(),
+                                name="verify-resolve")
+                        except resilience.DeadlineExceeded as e:
+                            registry.counter(
+                                "crypto.verify.dispatch.deadline_miss"
+                            ).inc()
+                            with self._stats_lock:
+                                self.deadline_misses += 1
+                            _note_device_failure("resolve-deadline", e)
+                        except Exception as e:
+                            _note_device_failure("resolve", e)
+                    else:
+                        registry.counter(
+                            "crypto.verify.dispatch.short_circuit").inc()
+                if got is not None:
+                    out[sl] = np.asarray(got)[:chunk]
+                    _breaker.record_success()
+                    self._mark_served("device", chunk)
+                else:
+                    # failover: bit-identical host re-verification of
+                    # the affected chunk (latency changes, decisions
+                    # never do)
+                    out[sl] = _host_verify_items(items[sl])
+                    self._mark_served("host-fallback", chunk)
             return ok & out
 
         return resolve
@@ -307,8 +520,73 @@ _default_lock = threading.Lock()
 
 _device_state: Optional[str] = None  # None=unprobed, else platform|"dead"
 _device_probe_lock = threading.Lock()
-_probe_thread: Optional[threading.Thread] = None
-_probe_box: dict = {}
+# current probe attempt: {"thread", "box", "started", "accounted"}.
+# Unlike the pre-breaker design this is RE-ARMABLE: a "dead" verdict is
+# re-probed when the breaker's backoff window expires, so a recovered
+# tunnel is picked up instead of being ignored for the process lifetime.
+_probe: Optional[dict] = None
+
+
+def _launch_probe_locked() -> dict:
+    """Spawn a fresh probe attempt (call with _device_probe_lock held).
+    A probe on a wedged tunnel hangs; its daemon thread is abandoned
+    when accounted — backoff growth bounds the leak to one thread per
+    half-open window."""
+    global _probe
+
+    box: dict = {}
+
+    def probe():
+        try:
+            faults.inject(faults.PROBE)
+            import jax
+            platform = jax.devices()[0].platform
+            if platform != "cpu":
+                # jax.devices() answers from the in-process cache once
+                # the backend has initialized, so on an accelerator only
+                # a REAL tiny dispatch proves the tunnel: a vacuous
+                # success here would re-close a dispatch-opened breaker
+                # (and reset its backoff) while the device is still
+                # dead. On a dead tunnel this hangs — exactly what the
+                # caller's watchdog + breaker accounting expect.
+                np.asarray(jax.jit(lambda x: x + 1)(
+                    np.zeros(2, np.int32)))
+            box["platform"] = platform
+        except Exception as e:  # no backend at all
+            box["error"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True, name="device-probe")
+    _probe = {"thread": t, "box": box, "started": time.monotonic(),
+              "accounted": False}
+    t.start()
+    return _probe
+
+
+def _account_probe_locked(cur: dict, hung: bool, timeout_s: float) -> None:
+    """Turn a finished/overdue probe attempt into device state + breaker
+    accounting (call with _device_probe_lock held; idempotent)."""
+    global _device_state
+    if cur["accounted"]:
+        return
+    cur["accounted"] = True
+    box = cur["box"]
+    if hung:
+        _device_state = "dead"
+        _breaker.record_failure()
+        _log.warning(
+            "device probe hung > %ss — signature verification falls "
+            "back to the host oracle (breaker: %s)",
+            timeout_s, _breaker.state)
+    elif "platform" in box:
+        _device_state = box["platform"]
+        _breaker.record_success()
+    else:
+        _device_state = "dead"
+        _breaker.record_failure()
+        _log.warning(
+            "device probe failed (%s) — signature verification falls "
+            "back to the host oracle (breaker: %s)",
+            box.get("error", "no backend"), _breaker.state)
 
 
 def start_device_probe() -> None:
@@ -318,70 +596,77 @@ def start_device_probe() -> None:
     tunnel) is paid during startup, never inside the first ledger
     close (the reference initializes its crypto stack at app start,
     not in ``closeLedger``)."""
-    global _probe_thread
     with _device_probe_lock:
-        if _probe_thread is None and _device_state is None:
-
-            def probe():
-                try:
-                    import jax
-                    _probe_box["platform"] = jax.devices()[0].platform
-                except Exception as e:  # no backend at all
-                    _probe_box["error"] = str(e)
-
-            _probe_thread = threading.Thread(target=probe, daemon=True,
-                                             name="device-probe")
-            _probe_thread.start()
+        if _probe is None and _device_state is None:
+            _launch_probe_locked()
 
 
 def device_available(timeout_s: float = 30.0,
                      block: bool = True) -> bool:
-    """True when a REAL accelerator is reachable. Probed once per
-    process in a watchdogged thread: with the axon tunnel down,
-    ``jax.devices()`` hangs forever rather than raising, and a node
-    must fall back to the host oracle instead of hanging the close
-    path (failure detection, not configuration). jax-CPU reports
-    False: batching bignum kernels through XLA-on-CPU is strictly
-    slower than the host oracle, so auto mode only engages the device
-    path on tpu-class hardware.
+    """True when a REAL accelerator is reachable AND the dispatch
+    breaker is closed. Probes run in watchdogged threads: with the axon
+    tunnel down, ``jax.devices()`` hangs forever rather than raising,
+    and a node must fall back to the host oracle instead of hanging the
+    close path (failure detection, not configuration). jax-CPU reports
+    False permanently: batching bignum kernels through XLA-on-CPU is
+    strictly slower than the host oracle, so auto mode only engages the
+    device path on tpu-class hardware — that is configuration, and is
+    never re-probed.
+
+    A "dead" verdict, by contrast, is a FAILURE and heals: the circuit
+    breaker re-probes (half-open) once its exponential-backoff window
+    expires, so a tunnel that comes back is picked up without hammering
+    one that stays down.
 
     ``block=False`` never waits: a still-pending probe answers False
     for now WITHOUT caching a verdict, so latency-critical callers
     (the close path) fall back to the host oracle this round and pick
-    up the device once the probe resolves."""
-    global _device_state
+    up the device once the probe resolves. A pending probe older than
+    ``timeout_s`` is accounted hung even for non-blocking callers, so
+    breaker-paced recovery works on a node that only ever asks
+    non-blockingly."""
     start_device_probe()
-    if _device_state is None:
+    with _device_probe_lock:
+        cur = _probe
+        if cur is None or cur["accounted"]:
+            if _device_state == "cpu":
+                return False  # configuration, not a fault
+            if _device_state not in (None, "dead") and \
+                    _breaker.state == resilience.CLOSED:
+                return True
+            # dead (or breaker tripped by dispatch failures): re-probe
+            # only when the backoff window has expired
+            if _breaker.allow():
+                cur = _launch_probe_locked()
+            else:
+                return False
+    t = cur["thread"]
+    if block:
         # join OUTSIDE the lock: a blocking waiter must never make a
         # concurrent block=False caller (the close path) wait on the
         # lock for up to timeout_s
-        t = _probe_thread
-        if block:
-            t.join(timeout_s)
-        elif t.is_alive():
-            return False  # pending — ask again later, don't cache
+        t.join(timeout_s)
     with _device_probe_lock:
-        if _device_state is None:
-            t = _probe_thread
-            if t.is_alive():
-                if not block:
-                    return False  # pending — ask again later
-                _device_state = "dead"
-                import logging
-                logging.getLogger("stellar_tpu.crypto").warning(
-                    "device probe hung > %ss — signature "
-                    "verification falls back to the host oracle",
-                    timeout_s)
-            elif "platform" in _probe_box:
-                _device_state = _probe_box["platform"]
+        if not cur["accounted"]:
+            if not t.is_alive():
+                _account_probe_locked(cur, hung=False, timeout_s=timeout_s)
+            elif block or \
+                    time.monotonic() - cur["started"] > timeout_s:
+                _account_probe_locked(cur, hung=True, timeout_s=timeout_s)
             else:
-                _device_state = "dead"
-                import logging
-                logging.getLogger("stellar_tpu.crypto").warning(
-                    "device probe failed (%s) — signature "
-                    "verification falls back to the host oracle",
-                    _probe_box.get("error", "no backend"))
-        return _device_state not in ("dead", "cpu")
+                return False  # pending — ask again later, don't cache
+        return _device_state not in (None, "dead", "cpu") and \
+            _breaker.state == resilience.CLOSED
+
+
+def _reset_dispatch_state_for_testing() -> None:
+    """Fresh probe/breaker state (chaos tests): equivalent to process
+    start for the dispatch layer. Cumulative metrics are untouched."""
+    global _device_state, _probe
+    with _device_probe_lock:
+        _device_state = None
+        _probe = None
+    _breaker.record_success()  # closed, zero failures, backoff reset
 
 
 def _auto_mesh():

@@ -83,6 +83,18 @@ class CommandHandler:
         from stellar_tpu.utils.metrics import registry
         return self._on_main(registry.to_dict)
 
+    def cmd_dispatch(self, params):
+        """Verify-dispatch resilience surface: breaker state, backend
+        attribution, fallback/deadline/retry counters, active knobs
+        (docs/robustness.md). Served directly — the dispatch layer's
+        state is lock-protected module data, not node state, and must
+        stay readable even when the main thread is wedged (that is the
+        failure this subsystem exists to detect)."""
+        from stellar_tpu.crypto import batch_verifier, keys
+        health = batch_verifier.dispatch_health()
+        health["backend"] = keys.get_verifier_backend_name()
+        return health
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -545,6 +557,7 @@ class CommandHandler:
 
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
+        "dispatch": cmd_dispatch,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

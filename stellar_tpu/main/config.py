@@ -144,6 +144,21 @@ class Config:
     # shared dispatches instead of solo round trips
     DEVICE_BATCH_VERIFY: bool = True
     TRICKLE_VERIFY_WINDOW_MS: float = 1.0  # 0 = no window
+    # dispatch resilience (docs/robustness.md): watchdog budget for one
+    # device-array fetch — the tunnel's failure mode is a hang, and a
+    # node must fall back to the host oracle instead of hanging ledger
+    # close; <= 0 disables the watchdog (never the fallback)
+    VERIFY_DEVICE_DEADLINE_MS: int = 8000
+    # consecutive device failures before the circuit breaker opens and
+    # dispatch short-circuits straight to the host oracle
+    VERIFY_BREAKER_FAILURE_THRESHOLD: int = 3
+    # half-open re-probe backoff bounds (exponential + jitter between
+    # them): how fast a recovered tunnel is picked up vs how hard a
+    # dead one is hammered
+    VERIFY_BREAKER_BACKOFF_MIN_S: float = 1.0
+    VERIFY_BREAKER_BACKOFF_MAX_S: float = 120.0
+    # fresh dispatch attempts after a transient kernel-call exception
+    VERIFY_DISPATCH_RETRIES: int = 1
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

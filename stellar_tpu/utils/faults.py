@@ -1,0 +1,148 @@
+"""Fault-injection harness for chaos testing the dispatch layer.
+
+Production code plants named injection points (``faults.inject("...")``)
+at the spots whose real-world failure modes we must survive — the
+device probe, the kernel dispatch, the device-array resolve. With no
+fault armed the call is a dict lookup on an empty dict; with one armed
+it misbehaves in a controlled, configurable way so
+``tests/test_chaos_dispatch.py`` can drive the breaker/deadline/
+failover machinery on CPU, no broken tunnel required.
+
+Faults are armed programmatically (:func:`set_fault`) or via the
+``STELLAR_TPU_FAULTS`` environment variable, e.g.::
+
+    STELLAR_TPU_FAULTS="device.resolve=hang:2;device.probe=raise"
+
+Modes (``mode[:arg]``):
+
+* ``raise[:msg]``   — raise :class:`FaultInjected` on every call;
+* ``hang[:secs]``   — sleep ``secs`` (default 30) per call: the
+  dead-tunnel shape, where calls block instead of raising;
+* ``flake[:k]``     — raise on every k-th call (default 2): an
+  intermittently healthy link;
+* ``failn[:n]``     — raise on the first ``n`` calls (default 1), then
+  behave: a link that recovers (breaker re-close path).
+
+Injection points currently planted:
+
+* ``device.probe``    — inside the backend probe thread
+  (``batch_verifier.start_device_probe``);
+* ``device.dispatch`` — immediately before the jitted kernel call;
+* ``device.resolve``  — inside the (deadline-guarded) device-array
+  fetch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["FaultInjected", "inject", "is_active", "set_fault", "clear",
+           "counters", "load_spec"]
+
+PROBE = "device.probe"
+DISPATCH = "device.dispatch"
+RESOLVE = "device.resolve"
+
+_MODES = ("raise", "hang", "flake", "failn")
+
+_lock = threading.Lock()
+_active: Dict[str, "_Fault"] = {}
+
+
+class FaultInjected(RuntimeError):
+    """The exception raised by armed ``raise``/``flake``/``failn``
+    faults — deliberately NOT a subclass of anything the dispatch layer
+    special-cases, so injected faults exercise the generic handlers."""
+
+
+class _Fault:
+    def __init__(self, point: str, mode: str, arg: Optional[float]):
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r} "
+                             f"(one of {_MODES})")
+        self.point = point
+        self.mode = mode
+        self.arg = arg
+        self.calls = 0   # times the injection point was reached
+        self.fired = 0   # times it actually misbehaved
+
+    def trip(self) -> None:
+        with _lock:
+            self.calls += 1
+            n = self.calls
+        if self.mode == "raise":
+            fire = True
+        elif self.mode == "hang":
+            fire = True
+        elif self.mode == "flake":
+            fire = n % int(self.arg if self.arg else 2) == 0
+        else:  # failn
+            fire = n <= int(self.arg if self.arg is not None else 1)
+        if not fire:
+            return
+        with _lock:
+            self.fired += 1
+        if self.mode == "hang":
+            time.sleep(float(self.arg) if self.arg is not None else 30.0)
+            return
+        raise FaultInjected(f"injected fault at {self.point} "
+                            f"({self.mode}, call #{n})")
+
+
+def inject(point: str) -> None:
+    """Trip the fault armed at ``point``; no-op when nothing is armed.
+    This is the call production code plants at an injection site."""
+    if not _active:  # fast path: chaos off
+        return
+    f = _active.get(point)
+    if f is not None:
+        f.trip()
+
+
+def is_active(point: str) -> bool:
+    return point in _active
+
+
+def set_fault(point: str, mode: str, arg: Optional[float] = None) -> None:
+    """Arm ``point`` with ``mode`` (see module docstring)."""
+    f = _Fault(point, mode, arg)
+    with _lock:
+        _active[point] = f
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when ``point`` is None."""
+    with _lock:
+        if point is None:
+            _active.clear()
+        else:
+            _active.pop(point, None)
+
+
+def counters() -> Dict[str, dict]:
+    """Per-point {calls, fired} — how often each armed site was reached
+    and how often it actually misbehaved (chaos-test assertions)."""
+    with _lock:
+        return {p: {"mode": f.mode, "calls": f.calls, "fired": f.fired}
+                for p, f in _active.items()}
+
+
+def load_spec(spec: str) -> None:
+    """Parse a ``point=mode[:arg][;point=mode[:arg]...]`` spec string
+    (the ``STELLAR_TPU_FAULTS`` grammar) and arm each entry."""
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, rhs = part.partition("=")
+        mode, _, arg = rhs.partition(":")
+        set_fault(point.strip(), mode.strip(),
+                  float(arg) if arg else None)
+
+
+_env_spec = os.environ.get("STELLAR_TPU_FAULTS", "")
+if _env_spec:
+    load_spec(_env_spec)

@@ -8,7 +8,8 @@ import math
 import time
 from typing import Dict, List
 
-__all__ = ["Counter", "Meter", "Timer", "MetricsRegistry", "registry"]
+__all__ = ["Counter", "Meter", "Timer", "Gauge", "MetricsRegistry",
+           "registry"]
 
 
 class Counter:
@@ -111,6 +112,22 @@ class Timer:
                 "stddev_ms": round(self.stddev_ms(), 3)}
 
 
+class Gauge:
+    """Last-written value (numeric or label, e.g. a breaker state) —
+    the degradation-visibility primitive: unlike a counter it answers
+    "what is it NOW", which is what the info endpoint needs for
+    breaker state / deadline knobs."""
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, value):
+        self.value = value
+
+    def to_dict(self):
+        return {"type": "gauge", "value": self.value}
+
+
 class MetricsRegistry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
@@ -129,6 +146,9 @@ class MetricsRegistry:
 
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
 
     def to_dict(self) -> dict:
         return {name: m.to_dict()

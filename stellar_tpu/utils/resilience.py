@@ -1,0 +1,259 @@
+"""Generic resilience primitives: circuit breaker + deadlines.
+
+The verify boundary must stay as dependable as the reference's
+``PubKeyUtils::verifySig`` even when the accelerator tunnel dies
+mid-flight — a node that hangs in ledger close is worse than a slow
+node. These are the domain-free building blocks; the verify-specific
+policy (what counts as a failure, what the fallback is) lives in
+:mod:`stellar_tpu.crypto.batch_verifier`.
+
+* :class:`CircuitBreaker` — closed → open on a consecutive-failure
+  threshold → half-open re-probe after an exponential backoff window
+  (with jitter so a fleet of nodes doesn't re-probe in lockstep).
+* :class:`Deadline` / :func:`call_with_deadline` — watchdogged
+  execution budgets for calls whose observed failure mode is a HANG,
+  not an exception (``jax.devices()`` / device-array fetches through a
+  dead tunnel block forever).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = [
+    "CLOSED", "OPEN", "HALF_OPEN",
+    "CircuitBreaker", "Deadline", "DeadlineExceeded",
+    "call_with_deadline",
+]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class DeadlineExceeded(Exception):
+    """A guarded call did not finish within its budget."""
+
+
+class Deadline:
+    """A monotonic time budget threaded through a multi-step operation
+    so each step races against what is LEFT, not a fresh allowance."""
+
+    def __init__(self, budget_s: float, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self.budget_s = float(budget_s)
+
+    @classmethod
+    def from_ms(cls, budget_ms: float, clock=time.monotonic) -> "Deadline":
+        return cls(budget_ms / 1000.0, clock=clock)
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def remaining(self) -> float:
+        return self.budget_s - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{what}: {self.budget_s:.3f}s budget exhausted")
+
+
+def call_with_deadline(fn: Callable, budget_s: Optional[float],
+                       name: str = "guarded-call"):
+    """Run ``fn()`` on a watchdog thread; raise :class:`DeadlineExceeded`
+    if it doesn't finish within ``budget_s`` (None = no guard, direct
+    call). Python cannot kill the worker: on timeout it is ABANDONED as
+    a daemon thread parked on whatever hung — callers must treat the
+    underlying resource as suspect afterwards (that is the circuit
+    breaker's job). An exception from ``fn`` is re-raised verbatim."""
+    if budget_s is None:
+        return fn()
+    if budget_s <= 0:
+        raise DeadlineExceeded(f"{name}: no budget left")
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # re-raised on the caller's thread
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name=name)
+    t.start()
+    if not done.wait(budget_s):
+        raise DeadlineExceeded(
+            f"{name} exceeded {budget_s:.3f}s budget")
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
+
+
+class CircuitBreaker:
+    """Thread-safe consecutive-failure circuit breaker.
+
+    States: ``closed`` (healthy — every call allowed; failures counted),
+    ``open`` (tripped — calls refused until the backoff window expires),
+    ``half-open`` (window expired — ONE probe call allowed; its outcome
+    decides: success re-closes, failure re-opens with doubled backoff).
+
+    A half-open probe grant expires after the current backoff interval,
+    so a probe that itself hangs and never reports can't wedge the
+    breaker half-open forever.
+
+    ``on_transition(old, new)`` fires OUTSIDE the internal lock (it may
+    log or update metrics; it must not need the breaker's lock-step
+    consistency).
+    """
+
+    def __init__(self, name: str = "breaker", failure_threshold: int = 3,
+                 backoff_min_s: float = 1.0, backoff_max_s: float = 120.0,
+                 backoff_factor: float = 2.0, jitter_frac: float = 0.1,
+                 clock=time.monotonic, rng=random.random,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self.name = name
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._rng = rng
+        self._on_transition = on_transition
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_total = 0
+        self._open_until = 0.0
+        self._grant_expires = 0.0
+        self.configure(failure_threshold=failure_threshold,
+                       backoff_min_s=backoff_min_s,
+                       backoff_max_s=backoff_max_s,
+                       backoff_factor=backoff_factor,
+                       jitter_frac=jitter_frac)
+
+    def configure(self, failure_threshold: Optional[int] = None,
+                  backoff_min_s: Optional[float] = None,
+                  backoff_max_s: Optional[float] = None,
+                  backoff_factor: Optional[float] = None,
+                  jitter_frac: Optional[float] = None) -> None:
+        """Update policy knobs in place (config push); None keeps the
+        current value. Does not change the current state."""
+        with self._lock:
+            if failure_threshold is not None:
+                self._threshold = max(1, int(failure_threshold))
+            if backoff_min_s is not None:
+                self._backoff_min = max(0.001, float(backoff_min_s))
+            if backoff_max_s is not None:
+                self._backoff_max = float(backoff_max_s)
+            if backoff_factor is not None:
+                self._factor = max(1.0, float(backoff_factor))
+            if jitter_frac is not None:
+                self._jitter = max(0.0, float(jitter_frac))
+            self._backoff_max = max(self._backoff_max, self._backoff_min)
+            cur = getattr(self, "_backoff_cur", None)
+            self._backoff_cur = self._backoff_min if cur is None else \
+                min(max(cur, self._backoff_min), self._backoff_max)
+
+    # ---------------- state machine ----------------
+
+    def _transition_locked(self, new: str) -> Optional[tuple]:
+        old = self._state
+        if old == new:
+            return None
+        self._state = new
+        if new == OPEN:
+            self._opened_total += 1
+        return (old, new)
+
+    def _fire(self, change: Optional[tuple]) -> None:
+        if change is not None and self._on_transition is not None:
+            try:
+                self._on_transition(*change)
+            except Exception:
+                pass  # observability must never break the guarded path
+
+    def allow(self) -> bool:
+        """May a call proceed right now? In ``open``, flips to
+        ``half-open`` once the backoff window has expired and grants
+        exactly one probe per grant window."""
+        change = None
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = self._clock()
+            if self._state == OPEN:
+                if now < self._open_until:
+                    return False
+                change = self._transition_locked(HALF_OPEN)
+                self._grant_expires = now + self._backoff_cur
+                ok = True
+            else:  # HALF_OPEN: one outstanding probe per grant window
+                ok = now >= self._grant_expires
+                if ok:
+                    self._grant_expires = now + self._backoff_cur
+        self._fire(change)
+        return ok
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._backoff_cur = self._backoff_min
+            change = self._transition_locked(CLOSED)
+        self._fire(change)
+
+    def record_failure(self) -> None:
+        change = None
+        with self._lock:
+            self._failures += 1
+            now = self._clock()
+            if self._state == CLOSED:
+                if self._failures >= self._threshold:
+                    change = self._transition_locked(OPEN)
+                    self._arm_locked(now)
+            elif self._state == HALF_OPEN:
+                # the probe failed: back off harder
+                self._backoff_cur = min(self._backoff_cur * self._factor,
+                                        self._backoff_max)
+                change = self._transition_locked(OPEN)
+                self._arm_locked(now)
+            # already OPEN: a straggler failure report; don't extend
+        self._fire(change)
+
+    def _arm_locked(self, now: float) -> None:
+        jittered = self._backoff_cur * (1.0 + self._jitter * self._rng())
+        self._open_until = now + jittered
+
+    # ---------------- introspection ----------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def seconds_until_retry(self) -> float:
+        """0 when calls are (or may be) allowed; else time left in the
+        open backoff window."""
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._open_until - self._clock())
+
+    def snapshot(self) -> dict:
+        """Observability payload (info endpoint / metrics push)."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "failure_threshold": self._threshold,
+                "backoff_s": round(self._backoff_cur, 3),
+                "retry_in_s": round(
+                    max(0.0, self._open_until - self._clock()), 3)
+                if self._state == OPEN else 0.0,
+                "opened_total": self._opened_total,
+            }

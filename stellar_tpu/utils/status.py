@@ -13,6 +13,9 @@ class StatusCategory:
     HISTORY_CATCHUP = "history-catchup"
     HISTORY_PUBLISH = "history-publish"
     REQUIRES_UPGRADES = "requires-upgrades"
+    # verify dispatch degraded: breaker open/half-open, signatures
+    # served by the host oracle (set/cleared via Application.info)
+    VERIFY_DEVICE = "verify-device"
     # (reference also has NTP; no time-sync subsystem here)
 
 

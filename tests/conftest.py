@@ -40,3 +40,9 @@ def pytest_configure(config):
         "markers",
         "slow: heavyweight differential sweeps excluded from the tier-1 "
         "gate (run explicitly: pytest -m slow)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection dispatch-resilience suite "
+        "(tests/test_chaos_dispatch.py) — CPU-safe, faults are "
+        "injected via stellar_tpu.utils.faults; part of tier-1 and "
+        "also runnable alone: pytest -m chaos")

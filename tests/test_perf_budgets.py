@@ -93,10 +93,18 @@ def test_compute_bound_native_beats_scval():
     from stellar_tpu.simulation.load_generator import (
         soroban_compute_load,
     )
-    scval = soroban_compute_load(n_ledgers=2, txs_per_ledger=40,
-                                 n_iter=600)
-    wasm = soroban_compute_load(n_ledgers=2, txs_per_ledger=40,
-                                use_wasm=True, n_iter=600)
+    # best-of-2 per engine: a load spike during ONE run flaked the
+    # ratio below its floor on a busy tier-1 host (observed 1.36x);
+    # best-case approximates each engine's unloaded speed, which is
+    # what this structural guard compares
+    def best(**kw):
+        runs = [soroban_compute_load(n_ledgers=2, txs_per_ledger=40,
+                                     n_iter=600, **kw)
+                for _ in range(2)]
+        return max(runs, key=lambda r: r["txs_per_sec"])
+
+    scval = best()
+    wasm = best(use_wasm=True)
     assert wasm["engine"] == "wasm-native"
     # 4x+ in practice; 1.5x floor keeps the guard noise-proof
     assert wasm["txs_per_sec"] > 1.5 * scval["txs_per_sec"], (

@@ -108,6 +108,10 @@ def test_differential_every_bucket_size(bucket):
     got = check(v, items)
     assert got[0] and got[-5:].all()  # controls verify
     assert not got[1]                 # small-order rejected
+    # every chunk must have been served by the KERNEL: a silent host
+    # fallback (PR 2 resilience layer) would make this differential
+    # vacuous — identical-by-construction instead of identical-by-test
+    assert v.served["host-fallback"] == 0 and v.served["device"] > 0
 
 
 def test_padding_lanes_do_not_leak():

@@ -25,6 +25,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RUNS = os.path.join(REPO, "docs", "bench_runs")
 PROFILES = os.path.join(REPO, "docs", "profiles")
 PROBE = os.path.join(REPO, "tools", "device_probe.py")
+PROBES_LOG = os.path.join(REPO, "DEVICE_PROBES.jsonl")
+
+sys.path.insert(0, REPO)  # stellar_tpu.utils.resilience (breaker)
 
 PROBE_PERIOD_DEAD_S = 120      # how often to re-probe while dead
 PROBE_PERIOD_ALIVE_S = 900     # back off after a successful capture
@@ -93,12 +96,21 @@ def _run_group(cmd, timeout_s, env=None):
         raise
 
 
+PROBE_TIMEOUT_S = 60
+
+
 def run_probe():
+    """(alive, rc, probe_latency_s). ``tools/device_probe.py`` appends
+    its own record to DEVICE_PROBES.jsonl; the latency measured HERE
+    wraps the whole subprocess (interpreter + jax import + dispatch) —
+    the number a breaker-paced operator actually waits."""
+    t0 = time.monotonic()
     try:
-        rc, _o, _e = _run_group([sys.executable, PROBE, "60"], 150)
-        return rc == 0
+        rc, _o, _e = _run_group(
+            [sys.executable, PROBE, str(PROBE_TIMEOUT_S)], 150)
     except subprocess.TimeoutExpired:
-        return False
+        rc = "timeout"
+    return rc == 0, rc, round(time.monotonic() - t0, 3)
 
 
 def capture_json(cmd, prefix, ts, describe):
@@ -185,15 +197,47 @@ def _analyze_trace(trace_stdout, ts):
 
 def main():
     log("device watcher started")
+    from stellar_tpu.utils import resilience
+
+    # breaker-state transitions land in DEVICE_PROBES.jsonl alongside
+    # the per-probe records (same {ts, alive, rc, timeout_s} schema +
+    # probe_latency_s + the transition), so tunnel-health history and
+    # the watcher's reaction to it live in one provable stream
+    last = {"alive": False, "rc": None, "latency_s": None}
+
+    def on_transition(old, new):
+        rec = {"ts": now().isoformat(), "alive": last["alive"],
+               "rc": last["rc"], "timeout_s": PROBE_TIMEOUT_S,
+               "probe_latency_s": last["latency_s"],
+               "breaker": f"{old}->{new}"}
+        with open(PROBES_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        log(f"breaker {old} -> {new}")
+
+    # backoff bounds double as the probe cadence: dead-window pacing
+    # starts at the old fixed period and backs off toward the
+    # post-capture period instead of hammering a tunnel that stays down
+    breaker = resilience.CircuitBreaker(
+        name="device-watch", failure_threshold=3,
+        backoff_min_s=PROBE_PERIOD_DEAD_S,
+        backoff_max_s=PROBE_PERIOD_ALIVE_S,
+        on_transition=on_transition)
     while True:
         try:
-            alive = run_probe()
+            if not breaker.allow():
+                time.sleep(min(PROBE_PERIOD_DEAD_S,
+                               breaker.seconds_until_retry() + 1))
+                continue
+            alive, rc, latency_s = run_probe()
+            last.update(alive=alive, rc=rc, latency_s=latency_s)
             if alive:
+                breaker.record_success()
                 log("device ALIVE - capturing window")
                 ok = capture_window()
                 time.sleep(PROBE_PERIOD_ALIVE_S if ok
                            else PROBE_PERIOD_DEAD_S)
             else:
+                breaker.record_failure()
                 time.sleep(PROBE_PERIOD_DEAD_S)
         except Exception as e:  # never die silently mid-round
             log(f"watcher iteration failed: {e!r}")

@@ -15,4 +15,17 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
-exit $rc
+# Chaos gate: the fault-injection dispatch suite must ALSO pass when
+# selected by marker alone (CPU-safe — faults are injected, no device
+# needed). It already ran inside the sweep above ('not slow' includes
+# chaos); this second pass pins the marker registration and the
+# suite's independence from test ordering, and echoes its own count.
+rm -f /tmp/_t1_chaos.log
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m chaos -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee /tmp/_t1_chaos.log
+crc=${PIPESTATUS[0]}
+echo CHAOS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_t1_chaos.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit $rc
+exit $crc
