@@ -234,6 +234,36 @@ def _static_kernel_cost(timeout_s: float = 300.0):
     }
 
 
+def _static_analysis(timeout_s: float = 300.0):
+    """Static-analysis attestation for this record (tools/analyze.py):
+    overflow-prover pass/fail + the proven limb-envelope hash + lint
+    status, in a jax-CPU subprocess so a dead tunnel can't hang it.
+    A bench number must not be quotable without the proof state of the
+    kernel it measured — same policy as verify_backend attribution."""
+    import subprocess
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "analyze.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, tool, "--json", f"--buckets={N_SIGS}"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"ok": False,
+                "error": f"analysis tool failed: {e!r}"[:200]}
+    ov = rec.get("overflow", {})
+    return {
+        "ok": rec.get("ok", False),
+        "overflow_proven": ov.get("ok", False),
+        "envelope_sha256": ov.get("envelope_sha256"),
+        "golden": ov.get("golden"),
+        "violations": len(ov.get("violations", [])),
+        "lints_ok": all(l.get("ok", False)
+                        for l in rec.get("lints", {}).values()),
+    }
+
+
 def _last_ondevice_record():
     """Most recent self-recorded on-device bench (device_watch capture),
     embedded verbatim in the rc=3 output so the driver artifact always
@@ -290,6 +320,7 @@ def main():
                     "kernel — the hardware-independent perf trajectory",
             "last_ondevice": _last_ondevice_record(),
             "kernel_cost": _static_kernel_cost(),
+            "analysis": _static_analysis(),
         }))
         return 3
     from stellar_tpu.crypto import batch_verifier
@@ -465,6 +496,9 @@ def main():
     # hardware-independent, so it must never delay the on-device record
     # above — the live window can be minutes long (round 4: ~3 min total)
     optional("kernel_cost", lambda: {"kernel_cost": _static_kernel_cost()})
+    # proof attestation: a bench number can't come from an unproven
+    # kernel — overflow-prover pass/fail + envelope hash ride the record
+    optional("analysis", lambda: {"analysis": _static_analysis()})
     # final dispatch-health snapshot: breaker state + cumulative
     # fallback counters over the whole run (docs/robustness.md)
     rec["dispatch_health"] = batch_verifier.dispatch_health()

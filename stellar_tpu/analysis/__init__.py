@@ -1,0 +1,25 @@
+"""Static analysis for the TPU verify kernel and its host dispatch layer.
+
+Three provers/linters, one CLI (``tools/analyze.py``), one tier-1 gate
+(``tests/test_analysis.py`` + the ``tools/tier1.sh`` wiring):
+
+* :mod:`stellar_tpu.analysis.intervals` /
+  :mod:`stellar_tpu.analysis.overflow` — abstract interpretation with an
+  interval domain over the traced jaxprs of the three verify-kernel
+  stages, proving every integer intermediate fits its dtype with the
+  carry headroom the limb layout assumes (``docs/kernel_design.md`` §1).
+  The proven per-stage envelope is committed as ``docs/limb_bounds.json``
+  so kernel PRs diff the proof, not just a pass/fail bit.
+* :mod:`stellar_tpu.analysis.hotpath` — AST lint for host↔device sync
+  hazards and retrace hazards in jit-adjacent code.
+* :mod:`stellar_tpu.analysis.locks` — AST lint for shared mutable state
+  mutated outside a ``with <lock>`` block in the threaded modules.
+* :mod:`stellar_tpu.analysis.nondet` — the consensus nondeterminism lint
+  (formerly inline in ``tests/test_nondet_lint.py``), on the shared
+  framework, extended over the crypto host-oracle modules.
+
+How to read a failure and how to extend an allowlist:
+``docs/static_analysis.md``.
+"""
+
+from stellar_tpu.analysis.lint_base import Finding  # noqa: F401

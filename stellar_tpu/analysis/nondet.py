@@ -1,0 +1,148 @@
+"""Consensus nondeterminism lint, on the shared lint framework.
+
+Reference ``src/test/check-nondet``: a CI grep banning ``std::rand`` /
+unseeded randomness from consensus code. The consensus-critical packages
+must not consult wall clocks, unseeded RNGs, or per-process hash salts —
+any of those is a consensus-divergence hazard between nodes.
+
+This PR moves the pass out of ``tests/test_nondet_lint.py`` (which now
+just drives it) onto the shared framework — same file walking, same
+allowlist format with mandatory written safety arguments, same JSON
+report through ``tools/analyze.py`` — and extends coverage to the
+``stellar_tpu/crypto`` host-oracle modules: the failover path re-verifies
+signatures through these (``docs/robustness.md`` — "degraded mode changes
+latency, never decisions"), so their decisions must be exactly as
+deterministic as the consensus packages'.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from stellar_tpu.analysis.lint_base import (
+    Allowlist, Finding, LintReport, finish_report, repo_root, walk_py,
+)
+# quote-aware '#' stripping: a '#' inside a string literal must not
+# truncate the line before a banned call that follows it
+from stellar_tpu.utils.toml_compat import _strip_comment
+
+__all__ = ["run", "lint_source", "CONSENSUS_DIRS", "HOST_ORACLE_FILES",
+           "ALLOWLIST", "BANNED"]
+
+# packages whose behavior must be bit-identical across nodes
+CONSENSUS_DIRS = ["stellar_tpu/scp", "stellar_tpu/ledger",
+                  "stellar_tpu/tx", "stellar_tpu/bucket",
+                  "stellar_tpu/soroban", "stellar_tpu/xdr"]
+
+# crypto host-oracle modules: the host half of every verify decision
+# (policy gates, SHA-512 prep, the failover oracle) plus the pure
+# primitives under them — one nondeterministic branch here and the
+# device and host halves of a verdict could disagree
+HOST_ORACLE_FILES = [
+    "stellar_tpu/crypto/ed25519_ref.py",
+    "stellar_tpu/crypto/curve25519.py",
+    "stellar_tpu/crypto/keys.py",
+    "stellar_tpu/crypto/native_prep.py",
+    "stellar_tpu/crypto/native_verify.py",
+    "stellar_tpu/crypto/sha.py",
+    "stellar_tpu/crypto/keccak.py",
+    "stellar_tpu/crypto/shorthash.py",
+    "stellar_tpu/crypto/strkey.py",
+    "stellar_tpu/crypto/secp256.py",
+    "stellar_tpu/crypto/h2c.py",
+    "stellar_tpu/crypto/bls12_381.py",
+]
+
+BANNED = [
+    # (key, pattern, why)
+    ("random", re.compile(
+        r"\brandom\.(random|randint|randrange|choice|shuffle|"
+        r"getrandbits)\b"),
+     "unseeded process RNG in consensus code"),
+    ("os.urandom", re.compile(r"\bos\.urandom\b"),
+     "CSPRNG output must not influence consensus state"),
+    ("secrets", re.compile(
+        r"\bsecrets\.(token_bytes|randbits|randbelow)\b"),
+     "CSPRNG output must not influence consensus state"),
+    ("clock", re.compile(r"\btime\.time\(\)|\btime\.monotonic\(\)"),
+     "wall/monotonic clock reads diverge between nodes"),
+    ("wallclock", re.compile(
+        r"\bdatetime\.now\(\)|\bdatetime\.utcnow\(\)"),
+     "wall clock reads diverge between nodes"),
+    # bare builtin hash( — NOT .hash() methods (content hashes)
+    ("hash", re.compile(r"(?<![.\w])hash\("),
+     "builtin hash() is salted per-process (PYTHONHASHSEED)"),
+]
+
+ALLOWLIST = Allowlist({
+    # (the seed's allowlist carried a stale tx_test_utils.py entry for
+    # secrets.token_bytes — the code it excused is gone; the framework
+    # now fails on stale entries, which is how it surfaced)
+    "stellar_tpu/crypto/keys.py": {
+        "nondet:os.urandom":
+            "SecretKey.random()/PublicKey generation: key MATERIAL, "
+            "not consensus state — randomness here is the whole point "
+            "and never feeds a verify decision (decisions depend only "
+            "on the resulting public bytes).",
+        "nondet:random":
+            "SecretKey.pseudo_random_for_testing mirrors the "
+            "reference's test-only generator (SecretKey.h:66-77); "
+            "test fixtures, never ledger state.",
+    },
+    "stellar_tpu/crypto/curve25519.py": {
+        "nondet:os.urandom":
+            "X25519 ephemeral keypair generation for transport "
+            "encryption (overlay auth) — key material consumed only "
+            "by the local handshake, never consensus state.",
+    },
+    "stellar_tpu/crypto/shorthash.py": {
+        "nondet:os.urandom":
+            "per-process siphash key, mirroring the reference's "
+            "shortHash::initialize(): short hashes are process-local "
+            "(hashmap seeding) and never cross the wire or enter "
+            "consensus state.",
+    },
+})
+
+
+def _lint_lines(text: str, rel: str) -> List[Finding]:
+    out: List[Finding] = []
+    in_dunder_hash = False
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if "def " in line:
+            # hash() inside __hash__ feeds per-process dict/set
+            # identity only — never consensus state
+            in_dunder_hash = "def __hash__" in line
+        elif line and not line[0].isspace():
+            # any module-level statement ends the __hash__ body
+            in_dunder_hash = False
+        stripped = _strip_comment(line)  # ignore comments (quote-aware)
+        for key, pat, why in BANNED:
+            m = pat.search(stripped)
+            if not m:
+                continue
+            if key == "hash" and (in_dunder_hash or
+                                  re.match(r"\s*def hash\(", stripped)):
+                continue
+            out.append(Finding(
+                file=rel, line=lineno, rule="nondet", symbol=key,
+                message=f"{m.group(0)!r} — {why}"))
+    return out
+
+
+def lint_source(src: str, rel: str) -> List[Finding]:
+    """Lint one source text (unit-test hook)."""
+    return _lint_lines(src, rel)
+
+
+def run(allowlist: Optional[Allowlist] = None) -> LintReport:
+    allowlist = allowlist or ALLOWLIST
+    root = repo_root()
+    findings: List[Finding] = []
+    files = 0
+    for path in walk_py(CONSENSUS_DIRS + HOST_ORACLE_FILES, root):
+        rel = str(path.relative_to(root))
+        files += 1
+        findings.extend(_lint_lines(path.read_text(), rel))
+    return finish_report("nondet", files, findings, allowlist)

@@ -1,0 +1,292 @@
+"""Overflow prover for the verify kernel's three stages.
+
+Traces the same stage split as ``tools/kernel_cost.py`` —
+``decompress`` / ``dsm`` / ``compress_compare`` plus the composed
+``kernel_total`` — and abstract-interprets each jaxpr with the interval
+domain (:mod:`stellar_tpu.analysis.intervals`), proving:
+
+1. **dtype fit**: every integer intermediate's exact-arithmetic interval
+   stays inside its dtype (int32 for limbs — the
+   ``NLIMBS * LOOSE_MAX^2 < 2^31`` headroom claim of
+   ``docs/kernel_design.md`` §1, per equation, not per comment);
+2. **loose contract**: every limb-shaped stage *output* stays inside
+   ``[0, LOOSE_MAX]`` — the inter-stage contract that makes the per-stage
+   proofs compose (dsm consumes decompress's point, compress_compare
+   consumes dsm's) and that the next field multiply's headroom assumes.
+
+Input contracts per stage (supersets of what the composed kernel feeds):
+
+* ``decompress``: ``(batch, 32)`` uint8 bytes in ``[0, 255]``;
+* ``dsm``: scalar bytes in ``[0, 255]`` plus an extended point whose
+  limbs are anywhere in the loose range ``[0, LOOSE_MAX]`` — the proof
+  therefore covers *any* loose point, not just decompress outputs;
+* ``compress_compare``: a loose point plus encoded bytes;
+* ``kernel_total``: raw bytes end-to-end (validates the actual
+  composition, including ``negate`` between decompress and dsm).
+
+The proven per-stage envelope is summarized per limb (batch axes
+collapse — bounds are batch-uniform, asserted across bucket sizes) and
+committed as ``docs/limb_bounds.json`` so future kernel PRs diff the
+proof itself, not just a pass/fail bit. ``bench.py`` embeds the
+envelope's sha256 so a bench record can't come from an unproven kernel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from stellar_tpu.analysis.intervals import (
+    AbsVal, IntervalInterpreter, Unsupported,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "STAGE_OUTPUT_NAMES", "prove", "prove_buckets",
+    "envelope_sha256", "analyze_closed_jaxpr", "trace_stage_jaxprs",
+    "loose_point_avals", "GOLDEN_PATH",
+]
+
+def _default_buckets():
+    # the jit bucket cache sizes of the production verifier — the
+    # shapes that actually compile and run, hence the shapes the proof
+    # must cover (single source of truth in batch_verifier)
+    from stellar_tpu.crypto.batch_verifier import DEFAULT_BUCKET_SIZES
+    return DEFAULT_BUCKET_SIZES
+
+
+DEFAULT_BUCKETS = _default_buckets()
+
+GOLDEN_PATH = "docs/limb_bounds.json"
+
+STAGE_OUTPUT_NAMES = {
+    "decompress": ("ok", "x", "y", "z", "t"),
+    "dsm": ("x", "y", "z"),
+    "compress_compare": ("ok",),
+    "kernel_total": ("ok",),
+}
+
+# Limb-shaped stage outputs that must honor the loose contract
+# [0, LOOSE_MAX]: the inter-stage composition invariant.
+LOOSE_OUTPUTS = {
+    "decompress": ("x", "y", "z", "t"),
+    "dsm": ("x", "y", "z"),
+    "compress_compare": (),
+    "kernel_total": (),
+}
+
+
+def _fe():
+    from stellar_tpu.ops import field25519 as fe
+    return fe
+
+
+def loose_point_avals(batch: int):
+    import jax
+    fe = _fe()
+    limb = jax.ShapeDtypeStruct((fe.NLIMBS, batch), np.int32)
+    return (limb, limb, limb, limb)
+
+
+def trace_stage_jaxprs(batch: int) -> Dict[str, object]:
+    """Trace the three stages + composed kernel (the kernel_cost split)."""
+    import jax
+    from stellar_tpu.ops import edwards as ed
+    from stellar_tpu.ops import verify as vk
+
+    bytes32 = jax.ShapeDtypeStruct((batch, 32), np.uint8)
+    point = loose_point_avals(batch)
+
+    def dsm(s_bytes, h_bytes, x, y, z, t):
+        return vk.dsm_stage(s_bytes, h_bytes, (x, y, z, t))
+
+    return {
+        "decompress": jax.make_jaxpr(ed.decompress)(bytes32),
+        "dsm": jax.make_jaxpr(dsm)(bytes32, bytes32, *point),
+        "compress_compare": jax.make_jaxpr(
+            lambda x, y, z, t, r: ed.compress_equals((x, y, z, t), r))(
+                *point, bytes32),
+        "kernel_total": jax.make_jaxpr(vk.verify_kernel)(
+            bytes32, bytes32, bytes32, bytes32),
+    }
+
+
+def _stage_invals(stage: str, batch: int) -> List[AbsVal]:
+    import jax
+    fe = _fe()
+    bytes32 = jax.ShapeDtypeStruct((batch, 32), np.uint8)
+    limb = jax.ShapeDtypeStruct((fe.NLIMBS, batch), np.int32)
+
+    def byte_val():
+        return AbsVal.from_range(bytes32, 0, 255)
+
+    def limb_val():
+        return AbsVal.from_range(limb, 0, fe.LOOSE_MAX)
+
+    if stage == "decompress":
+        return [byte_val()]
+    if stage == "dsm":
+        return [byte_val(), byte_val()] + [limb_val() for _ in range(4)]
+    if stage == "compress_compare":
+        return [limb_val() for _ in range(4)] + [byte_val()]
+    if stage == "kernel_total":
+        return [byte_val() for _ in range(4)]
+    raise ValueError(stage)
+
+
+def _ladder_hints() -> List[int]:
+    fe = _fe()
+    return [fe.MASK, fe.MASK + 1, fe.LOOSE_MAX, fe.FOLD,
+            2 * fe.LOOSE_MAX, 4 * fe.LOOSE_MAX]
+
+
+def _summarize_output(val: AbsVal) -> list:
+    """Collapse batch axes: (20, batch) -> 20 [lo, hi] pairs; scalar or
+    (batch,) bool -> one [lo, hi] pair."""
+    fe = _fe()
+    lo, hi = val.lo, val.hi
+    if len(val.shape) >= 1 and val.shape[0] == fe.NLIMBS and \
+            val.dtype == np.int32:
+        axes = tuple(range(1, lo.ndim))
+        llo = lo.min(axis=axes) if axes else lo
+        lhi = hi.max(axis=axes) if axes else hi
+        llo = np.broadcast_to(llo, (fe.NLIMBS,))
+        lhi = np.broadcast_to(lhi, (fe.NLIMBS,))
+        return [[int(a), int(b)] for a, b in zip(llo, lhi)]
+    return [[int(lo.min()) if lo.size else 0,
+             int(hi.max()) if hi.size else 0]]
+
+
+def analyze_closed_jaxpr(closed_jaxpr, invals: Sequence[AbsVal],
+                         stage: str = "jaxpr") -> dict:
+    """Run the interval interpreter over one traced stage; returns
+    ``{violations, max_abs, outputs}`` (outputs as AbsVals)."""
+    interp = IntervalInterpreter(ladder_hints=_ladder_hints())
+    outs = interp.eval_closed(closed_jaxpr, invals, path=stage)
+    return {
+        "violations": interp.violations,
+        "max_abs": interp.max_abs,
+        "outputs": outs,
+    }
+
+
+def prove(batch: int) -> dict:
+    """Prove all four stage jaxprs at one batch size. Returns a record
+    with ``ok``, per-stage envelopes, violations, and contract breaches."""
+    fe = _fe()
+    jaxprs = trace_stage_jaxprs(batch)
+    stages = {}
+    violations: List[dict] = []
+    contract: List[str] = []
+    unsupported: List[str] = []
+    for stage, jx in jaxprs.items():
+        try:
+            res = analyze_closed_jaxpr(jx, _stage_invals(stage, batch),
+                                       stage)
+        except Unsupported as e:
+            unsupported.append(str(e))
+            stages[stage] = {"max_abs": None, "outputs": {}}
+            continue
+        names = STAGE_OUTPUT_NAMES[stage]
+        outs = res["outputs"]
+        if len(names) != len(outs):
+            unsupported.append(
+                f"{stage}: expected {len(names)} outputs, traced "
+                f"{len(outs)} — stage split drifted, update "
+                "STAGE_OUTPUT_NAMES")
+            continue
+        out_summ = {n: _summarize_output(v) for n, v in zip(names, outs)}
+        stages[stage] = {"max_abs": int(res["max_abs"]),
+                         "outputs": out_summ}
+        violations.extend(v.to_dict() for v in res["violations"])
+        for name in LOOSE_OUTPUTS[stage]:
+            for limb, (lo, hi) in enumerate(out_summ[name]):
+                if lo < 0 or hi > fe.LOOSE_MAX:
+                    contract.append(
+                        f"{stage}.{name} limb {limb} in [{lo}, {hi}] "
+                        f"escapes the loose contract [0, {fe.LOOSE_MAX}]"
+                        " — the next stage's multiply headroom is gone")
+    envelope = {
+        "format": 1,
+        "limb_layout": {"nlimbs": fe.NLIMBS, "bits": fe.BITS,
+                        "mask": fe.MASK, "loose_max": fe.LOOSE_MAX,
+                        "fold": fe.FOLD},
+        "stages": stages,
+    }
+    return {
+        "batch": batch,
+        "ok": not violations and not contract and not unsupported,
+        "violations": violations,
+        "contract_breaches": contract,
+        "unsupported": unsupported,
+        "envelope": envelope,
+        "envelope_sha256": envelope_sha256(envelope),
+    }
+
+
+def prove_buckets(buckets: Sequence[int] = DEFAULT_BUCKETS) -> dict:
+    """Prove at every jit bucket size; the envelope must be identical
+    across buckets (bounds are batch-uniform — a difference means the
+    kernel's math depends on batch size, itself a red flag)."""
+    records = [prove(b) for b in buckets]
+    first = records[0]
+    mismatch = [
+        r["batch"] for r in records[1:]
+        if r["envelope_sha256"] != first["envelope_sha256"]]
+    out = dict(first)
+    out["buckets"] = list(buckets)
+    out["ok"] = all(r["ok"] for r in records) and not mismatch
+    out["envelope_mismatch_buckets"] = mismatch
+    # merge EVERY failure class across buckets (tagged with the bucket
+    # that produced it): a later bucket failing with a clean first
+    # bucket must still explain itself in the gate output
+    for r in records[1:]:
+        out["violations"] = out["violations"] + [
+            v for v in r["violations"] if v not in out["violations"]]
+        for key in ("contract_breaches", "unsupported"):
+            out[key] = out[key] + [
+                f"[batch={r['batch']}] {m}"
+                for m in r[key] if m not in out[key]]
+    return out
+
+
+def envelope_sha256(envelope: dict) -> str:
+    canon = json.dumps(envelope, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def load_golden(repo_root: str) -> Optional[dict]:
+    import os
+    path = os.path.join(repo_root, GOLDEN_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def diff_golden(envelope: dict, golden: dict) -> List[str]:
+    """Human-readable envelope-vs-golden differences (empty = match)."""
+    diffs: List[str] = []
+    if golden.get("limb_layout") != envelope.get("limb_layout"):
+        diffs.append(
+            f"limb_layout changed: {golden.get('limb_layout')} -> "
+            f"{envelope.get('limb_layout')}")
+    gst = golden.get("stages", {})
+    est = envelope.get("stages", {})
+    for stage in sorted(set(gst) | set(est)):
+        g, e = gst.get(stage), est.get(stage)
+        if g is None or e is None:
+            diffs.append(f"stage {stage}: "
+                         f"{'added' if g is None else 'removed'}")
+            continue
+        if g.get("max_abs") != e.get("max_abs"):
+            diffs.append(f"{stage}.max_abs: {g.get('max_abs')} -> "
+                         f"{e.get('max_abs')}")
+        go, eo = g.get("outputs", {}), e.get("outputs", {})
+        for name in sorted(set(go) | set(eo)):
+            if go.get(name) != eo.get(name):
+                diffs.append(f"{stage}.{name}: {go.get(name)} -> "
+                             f"{eo.get(name)}")
+    return diffs

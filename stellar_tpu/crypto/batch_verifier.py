@@ -82,6 +82,11 @@ _P_BYTES = np.frombuffer(_P.to_bytes(32, "little"), dtype=np.uint8)
 DEADLINE_MS = float(os.environ.get("VERIFY_DEVICE_DEADLINE_MS", "8000"))
 DISPATCH_RETRIES = int(os.environ.get("VERIFY_DISPATCH_RETRIES", "1"))
 
+# The production jit bucket ladder (default_verifier). Also the shape
+# set the static overflow prover must cover — stellar_tpu.analysis.
+# overflow proves the kernel at exactly these sizes (tools/analyze.py).
+DEFAULT_BUCKET_SIZES = (128, 512, 2048, 4096, 8192, 16384)
+
 _log = logging.getLogger("stellar_tpu.crypto")
 
 
@@ -232,7 +237,12 @@ class BatchVerifier:
     def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
         self._mesh = mesh
         self._buckets = tuple(sorted(bucket_sizes))
+        # jit-wrapper cache: written from any thread that dispatches
+        # (trickle leaders, chaos tests, the close path) — guarded, the
+        # wrapper itself is built outside the lock (cheap; the compile
+        # happens lazily at first call)
         self._kernels = {}
+        self._kernels_lock = threading.Lock()
         # per-instance backend attribution (items served), mirrored into
         # the process-wide meters: bench and the chaos tests read these
         self._stats_lock = threading.Lock()
@@ -250,14 +260,21 @@ class BatchVerifier:
     # ---------------- device dispatch ----------------
 
     def _kernel_for(self, n: int):
-        if n not in self._kernels:
+        with self._kernels_lock:
+            kernel = self._kernels.get(n)
+        if kernel is None:
             import jax
             from stellar_tpu.ops import verify as vk
             if self._mesh is not None and n % self._mesh.size == 0:
-                self._kernels[n] = vk.verify_kernel_sharded(self._mesh)
+                built = vk.verify_kernel_sharded(self._mesh)
             else:
-                self._kernels[n] = jax.jit(vk.verify_kernel)
-        return self._kernels[n]
+                built = jax.jit(vk.verify_kernel)
+            with self._kernels_lock:
+                # setdefault: a racing builder's wrapper wins once —
+                # both wrappers trace identically, so the loser is
+                # just garbage, never a different kernel
+                kernel = self._kernels.setdefault(n, built)
+        return kernel
 
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -488,7 +505,9 @@ class TrickleBatcher:
                 batch = self._pending
                 self._pending = []
                 self._leader_active = False
-            self.dispatches += 1
+                # counted under the lock: the next window's leader can
+                # already be running by the time this one dispatches
+                self.dispatches += 1
             try:
                 results = self._verifier.verify_batch(
                     [item for item, _f in batch])
@@ -700,5 +719,5 @@ def default_verifier() -> BatchVerifier:
             # for 8x less kernel work); small batches bucket as before
             _default = BatchVerifier(
                 mesh=_auto_mesh(),
-                bucket_sizes=(128, 512, 2048, 4096, 8192, 16384))
+                bucket_sizes=DEFAULT_BUCKET_SIZES)
         return _default

@@ -1,10 +1,19 @@
 """Metrics registry (the reference vendors libmedida: meters, counters,
 timers, histograms keyed by dotted names, exported via the HTTP
-``metrics`` endpoint — ``docs/metrics.md``)."""
+``metrics`` endpoint — ``docs/metrics.md``).
+
+Thread safety: metrics are marked from resolve-watchdog threads,
+trickle-batch leaders, probe threads, and breaker transition callbacks
+concurrently, so every read-modify-write (counter increments, the
+meter's sliding-window push/evict, timer accumulators, the registry's
+get-or-create) holds the instance lock. The lock discipline is enforced
+by ``stellar_tpu/analysis/locks.py`` (tier-1 via ``tools/analyze.py``).
+"""
 
 from __future__ import annotations
 
 import math
+import threading
 import time
 from typing import Dict, List
 
@@ -14,13 +23,16 @@ __all__ = ["Counter", "Meter", "Timer", "Gauge", "MetricsRegistry",
 
 class Counter:
     def __init__(self):
+        self._lock = threading.Lock()
         self.count = 0
 
     def inc(self, n: int = 1):
-        self.count += n
+        with self._lock:
+            self.count += n
 
     def dec(self, n: int = 1):
-        self.count -= n
+        with self._lock:
+            self.count -= n
 
     def to_dict(self):
         return {"type": "counter", "count": self.count}
@@ -39,16 +51,21 @@ class Meter:
     consumers never misread the rate's denominator)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.count = 0
         self._events: List[float] = []
 
     def mark(self, n: int = 1):
-        self.count += n
         now = time.monotonic()
-        self._events.append(now)
         cutoff = now - WINDOW_SECONDS
-        while self._events and self._events[0] < cutoff:
-            self._events.pop(0)
+        with self._lock:
+            # push + evict under the lock: a concurrent pop(0) between
+            # another thread's emptiness check and its pop is an
+            # IndexError waiting for a loaded host
+            self.count += n
+            self._events.append(now)
+            while self._events and self._events[0] < cutoff:
+                self._events.pop(0)
 
     def windowed_rate(self) -> float:
         return len(self._events) / WINDOW_SECONDS
@@ -67,6 +84,7 @@ class Timer:
     """Duration stats: count/min/mean/max/stddev (ms)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.count = 0
         self._sum = 0.0
         self._sum2 = 0.0
@@ -74,11 +92,12 @@ class Timer:
         self.max_ms = 0.0
 
     def update_ms(self, ms: float):
-        self.count += 1
-        self._sum += ms
-        self._sum2 += ms * ms
-        self.min_ms = min(self.min_ms, ms)
-        self.max_ms = max(self.max_ms, ms)
+        with self._lock:
+            self.count += 1
+            self._sum += ms
+            self._sum2 += ms * ms
+            self.min_ms = min(self.min_ms, ms)
+            self.max_ms = max(self.max_ms, ms)
 
     def time(self):
         t0 = time.perf_counter()
@@ -119,10 +138,12 @@ class Gauge:
     breaker state / deadline knobs."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.value = None
 
     def set(self, value):
-        self.value = value
+        with self._lock:
+            self.value = value
 
     def to_dict(self):
         return {"type": "gauge", "value": self.value}
@@ -130,13 +151,19 @@ class Gauge:
 
 class MetricsRegistry:
     def __init__(self):
+        self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
     def _get(self, name: str, cls):
-        m = self._metrics.get(name)
-        if m is None:
-            m = self._metrics[name] = cls()
-        return m
+        with self._lock:
+            # get-or-create must be atomic: two threads racing the
+            # first mark of a meter would otherwise each create one,
+            # and whichever registers second silently eats the other's
+            # counts
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            return m
 
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
@@ -151,11 +178,16 @@ class MetricsRegistry:
         return self._get(name, Gauge)
 
     def to_dict(self) -> dict:
-        return {name: m.to_dict()
-                for name, m in sorted(self._metrics.items())}
+        with self._lock:
+            # snapshot under the lock: iterating the live dict while a
+            # first-mark thread inserts raises "dictionary changed size
+            # during iteration" on the metrics endpoint
+            items = sorted(self._metrics.items())
+        return {name: m.to_dict() for name, m in items}
 
     def clear(self):
-        self._metrics.clear()
+        with self._lock:
+            self._metrics.clear()
 
 
 # process-wide registry (the reference's per-app medida registry; one

@@ -1,0 +1,509 @@
+"""Gate for the static-analysis suite (ISSUE 3).
+
+Four layers:
+
+* **interval-domain unit checks** — the abstract interpreter's transfer
+  functions on tiny traced jaxprs, including the one-hot exclusivity
+  refinement the window selects depend on;
+* **the proof itself** — the verify-kernel overflow proof must hold and
+  its envelope must match the committed golden ``docs/limb_bounds.json``
+  (the golden was written at batch 128; proving at batch 2 here also
+  pins batch-invariance of the envelope);
+* **mutation tests** — a prover that can't catch a seeded bug is
+  vacuous: dropping one carry round from the field multiply must
+  produce violations (both on a synthetic chain and through the REAL
+  traced dsm stage), and an unlocked mutation in a lock-owning test
+  double must trip the lock lint;
+* **clean-tree lints** — hotpath/locks/nondet must be clean modulo the
+  reviewed allowlists, and allowlists must carry written reasons.
+
+The full bucket sweep (every jit bucket size) runs in tier-1 via
+``tools/tier1.sh`` -> ``tools/analyze.py``; see docs/static_analysis.md.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from stellar_tpu.analysis import hotpath, locks, nondet, overflow
+from stellar_tpu.analysis.intervals import (
+    AbsVal, IntervalInterpreter, Unsupported,
+)
+from stellar_tpu.analysis.lint_base import Allowlist, repo_root
+
+
+# ---------------- interval-domain units ----------------
+
+
+def _analyze(fn, *avals, in_ranges):
+    import jax
+    jx = jax.make_jaxpr(fn)(*avals)
+    interp = IntervalInterpreter()
+    invals = [AbsVal.from_range(a, lo, hi)
+              for a, (lo, hi) in zip(avals, in_ranges)]
+    outs = interp.eval_closed(jx, invals, path="unit")
+    return interp, outs
+
+
+def _i32(*shape):
+    import jax
+    return jax.ShapeDtypeStruct(shape, np.int32)
+
+
+def test_interval_mul_add_exact():
+    interp, (out,) = _analyze(
+        lambda a, b: a * b + a, _i32(4), _i32(4),
+        in_ranges=[(2, 10), (-3, 5)])
+    assert int(out.lo.min()) == 10 * -3 + 2  # mul corner -30, plus a.lo
+    assert int(out.hi.max()) == 10 * 5 + 10
+    assert not interp.violations
+
+
+def test_interval_flags_int32_overflow():
+    interp, _ = _analyze(
+        lambda a, b: a * b, _i32(4), _i32(4),
+        in_ranges=[(0, 1 << 20), (0, 1 << 20)])
+    assert len(interp.violations) == 1
+    v = interp.violations[0]
+    assert v.primitive == "mul" and v.dtype == "int32"
+    assert v.hi == 1 << 40
+
+
+def test_interval_carry_step_bound():
+    """The field layer's parallel carry round maps loose limbs back
+    under MASK + fold headroom — the analyzer must see that."""
+    from stellar_tpu.ops import field25519 as fe
+    interp, (out,) = _analyze(
+        fe._carry_step, _i32(fe.NLIMBS, 3),
+        in_ranges=[(0, 20 * fe.LOOSE_MAX ** 2 // 1000)])
+    assert not interp.violations
+    assert int(out.hi.max()) < 1 << 22
+
+
+def test_onehot_select_union_bound():
+    """The one-hot contraction idiom must get the union bound, not the
+    8x sum — the precision the window selects live on."""
+    import jax.numpy as jnp
+
+    def select(table, digit):
+        idx = jnp.arange(1, 9, dtype=jnp.int32).reshape(8, 1)
+        onehot = (idx == digit[None]).astype(jnp.int32)
+        return (table * onehot).sum(axis=0)
+
+    interp, (out,) = _analyze(
+        select, _i32(8, 5), _i32(5), in_ranges=[(0, 9000), (-8, 8)])
+    assert not interp.violations
+    assert int(out.hi.max()) == 9000  # union, not 8 * 9000
+    assert int(out.lo.min()) == 0
+
+
+def test_onehot_refinement_sound_against_varying_operand():
+    """Soundness regression: eq(iota(8), traced (8,) y) is NOT one-hot
+    — y varies per position (it could equal iota everywhere), so the
+    sum must be the full 8-fold sum, never the union bound. Uniform
+    BOUNDS (stored-size-1) must not be mistaken for uniform VALUES."""
+    import jax.numpy as jnp
+
+    def select(table, y):
+        idx = jnp.arange(8, dtype=jnp.int32)
+        onehot = (idx == y).astype(jnp.int32)  # y varies along axis 0
+        return (table[:, None] * onehot[:, None]).sum(axis=0)
+
+    interp, (out,) = _analyze(
+        select, _i32(8), _i32(8), in_ranges=[(0, 9000), (0, 7)])
+    assert int(out.hi.max()) == 8 * 9000  # all positions can match
+
+
+def test_scan_unroll_exact_counter():
+    """fori_loop lowers to scan; the loop counter and carries must stay
+    exact under unrolling (no widening overshoot)."""
+    from jax import lax
+
+    def f(x):
+        return lax.fori_loop(0, 10, lambda i, c: c + i, x)
+
+    interp, (out,) = _analyze(f, _i32(), in_ranges=[(0, 5)])
+    assert not interp.violations
+    assert int(out.hi.max()) == 5 + sum(range(10))
+    assert int(out.lo.min()) == 0 + sum(range(10))
+
+
+def test_unsupported_primitive_is_loud():
+    import jax.numpy as jnp
+    import jax
+    jx = jax.make_jaxpr(lambda a: jnp.sin(a.astype(jnp.float32)))(
+        _i32(3))
+    interp = IntervalInterpreter()
+    with pytest.raises(Unsupported):
+        interp.eval_closed(jx, [AbsVal.from_range(_i32(3), 0, 1)],
+                           path="unit")
+
+
+# ---------------- the proof + golden ----------------
+
+
+@pytest.fixture(scope="module")
+def proof():
+    return overflow.prove(batch=2)
+
+
+def test_overflow_proof_holds(proof):
+    assert proof["unsupported"] == []
+    assert proof["violations"] == [], proof["violations"][:3]
+    assert proof["contract_breaches"] == []
+    assert proof["ok"]
+
+
+def test_headroom_is_the_documented_claim(proof):
+    """The binding constraint must be the documented one: the multiply
+    accumulator's worst coefficient is exactly NLIMBS * LOOSE_MAX^2,
+    proven under int32. If this moves, docs/kernel_design.md §1 moved."""
+    from stellar_tpu.ops import field25519 as fe
+    worst = proof["envelope"]["stages"]["dsm"]["max_abs"]
+    assert worst == fe.NLIMBS * fe.LOOSE_MAX ** 2
+    assert worst < 2 ** 31
+
+
+def test_envelope_matches_golden(proof):
+    """The committed golden is the proof artifact kernel PRs diff.
+    Golden was written at batch 128; this proof ran at batch 2 — a
+    match also pins batch-invariance of the envelope."""
+    golden = overflow.load_golden(str(repo_root()))
+    assert golden is not None, (
+        "docs/limb_bounds.json missing — run tools/analyze.py "
+        "--write-golden and review/commit the envelope")
+    diff = overflow.diff_golden(proof["envelope"], golden)
+    assert not diff, "\n".join(
+        ["proven envelope drifted from docs/limb_bounds.json — if the "
+         "kernel change is intentional, re-run tools/analyze.py "
+         "--write-golden and commit the diff:"] + diff)
+
+
+def test_stage_outputs_honor_loose_contract(proof):
+    from stellar_tpu.ops import field25519 as fe
+    for stage, names in overflow.LOOSE_OUTPUTS.items():
+        for name in names:
+            for lo, hi in proof["envelope"]["stages"][stage][
+                    "outputs"][name]:
+                assert 0 <= lo and hi <= fe.LOOSE_MAX, (stage, name)
+
+
+# ---------------- mutation tests (prover vacuity guards) ----------------
+
+
+def _mul_dropped_carry(a, b):
+    """fe.mul with the final carry round removed — the seeded overflow:
+    limbs leave a single round around 2^23, so the NEXT multiply's
+    accumulator blows through int32."""
+    import jax.numpy as jnp
+    from stellar_tpu.ops import field25519 as fe
+    batch = a.shape[1:]
+    pad_rest = ((0, 0),) * len(batch)
+    acc = None
+    for i in range(fe.NLIMBS):
+        row = a[i][None] * b
+        shifted = jnp.pad(row, ((i, fe.NLIMBS - 1 - i),) + pad_rest)
+        acc = shifted if acc is None else acc + shifted
+    lo = acc & fe.MASK
+    hi = acc >> fe.BITS
+    shifted = jnp.concatenate(
+        [jnp.zeros((1,) + batch, jnp.int32), hi[:-1]], axis=0)
+    c40_low = lo + shifted
+    c39 = hi[-1:]
+    high = jnp.concatenate([c40_low[fe.NLIMBS:], c39], axis=0)
+    low = c40_low[:fe.NLIMBS] + fe.FOLD * high
+    return fe._carry_step(low)  # ONE round; upstream does two
+
+
+def test_mutant_dropped_carry_caught_synthetic():
+    from stellar_tpu.ops import field25519 as fe
+    interp, _ = _analyze(
+        lambda a, b: _mul_dropped_carry(_mul_dropped_carry(a, b), b),
+        _i32(fe.NLIMBS, 2), _i32(fe.NLIMBS, 2),
+        in_ranges=[(0, fe.LOOSE_MAX), (0, fe.LOOSE_MAX)])
+    assert interp.violations, "dropped carry must overflow the 2nd mul"
+
+
+def test_mutant_dropped_carry_caught_in_real_dsm(monkeypatch):
+    """The strong vacuity guard: seed the dropped carry into the REAL
+    field layer and re-trace the REAL dsm stage — the prover must fail
+    it. (PR 1 changed exactly these limb magnitudes; this is the test
+    that proves the proof would have noticed a bad rework.)"""
+    from stellar_tpu.ops import field25519 as fe
+    monkeypatch.setattr(fe, "mul", _mul_dropped_carry)
+    jaxprs = overflow.trace_stage_jaxprs(batch=2)
+    res = overflow.analyze_closed_jaxpr(
+        jaxprs["dsm"], overflow._stage_invals("dsm", 2), "dsm-mutant")
+    assert res["violations"], (
+        "the overflow prover accepted a field multiply with a dropped "
+        "carry — the proof is vacuous")
+
+
+_UNLOCKED_DOUBLE = textwrap.dedent("""
+    import threading
+
+    class StatsDouble:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.events = []
+
+        def record(self, n):
+            self.count += n
+            self.events.append(n)
+""")
+
+_LOCKED_DOUBLE = textwrap.dedent("""
+    import threading
+
+    class StatsDouble:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.events = []
+
+        def record(self, n):
+            with self._lock:
+                self.count += n
+                self.events.append(n)
+""")
+
+
+def test_mutant_unlocked_write_caught():
+    findings = locks.lint_source(_UNLOCKED_DOUBLE, "double.py")
+    keys = sorted(f.key for f in findings)
+    assert keys == ["unlocked-attr:StatsDouble.record.count",
+                    "unlocked-attr:StatsDouble.record.events"]
+    assert not locks.lint_source(_LOCKED_DOUBLE, "double.py")
+
+
+def test_lock_lint_catches_indirect_mutations():
+    """Tuple unpacking, assigned mutator calls, and nested-attribute
+    stores are mutations too — the rule must see through all three."""
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+                self.a = 0
+                self.b = 0
+
+            def bad(self):
+                self.a, self.b = 1, 2
+                item = self._q.pop(0)
+                self.a = item
+    """)
+    keys = sorted(f.key for f in locks.lint_source(src, "c.py"))
+    assert keys == ["unlocked-attr:C.bad._q",
+                    "unlocked-attr:C.bad.a",
+                    "unlocked-attr:C.bad.a",
+                    "unlocked-attr:C.bad.b"]
+
+
+def test_lock_lint_sees_mutators_in_statement_heads():
+    """`if self._q.pop():` / `while ...` / `raise f(self._q.pop())`
+    mutate state too — statement heads are expressions, and each call
+    must be reported exactly once (no double count via recursion)."""
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+            def bad(self):
+                if self._q.pop(0):
+                    return 1
+                while self._q.pop():
+                    pass
+                return 0
+    """)
+    keys = [f.key for f in locks.lint_source(src, "c.py")]
+    assert keys == ["unlocked-attr:C.bad._q",
+                    "unlocked-attr:C.bad._q"]
+
+
+def test_mutant_unlocked_global_caught():
+    src = textwrap.dedent("""
+        import threading
+        _lock = threading.Lock()
+        STATE = 0
+
+        def bump():
+            global STATE
+            STATE += 1
+
+        def bump_guarded():
+            global STATE
+            with _lock:
+                STATE += 1
+    """)
+    findings = locks.lint_source(src, "mod.py")
+    assert [f.key for f in findings] == ["unlocked-global:bump.STATE"]
+
+
+def test_lock_lint_catches_inplace_global_mutations():
+    """Dict/list globals are mutated without any `global` statement —
+    the most common shared-state idiom must still be enforced."""
+    src = textwrap.dedent("""
+        import threading
+        _lock = threading.Lock()
+        _CACHE = {}
+        _EVENTS = []
+
+        def record(k, v):
+            _CACHE[k] = v
+            _EVENTS.append(v)
+
+        def record_guarded(k, v):
+            with _lock:
+                _CACHE[k] = v
+                _EVENTS.append(v)
+
+        def local_ok(k, v):
+            _CACHE = {}        # local shadow, not the module global
+            _CACHE[k] = v
+    """)
+    keys = sorted(f.key for f in locks.lint_source(src, "mod.py"))
+    assert keys == ["unlocked-global:record._CACHE",
+                    "unlocked-global:record._EVENTS"]
+
+
+# ---------------- hot-path lint units ----------------
+
+
+def test_hotpath_flags_sync_and_branch():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def kernel(x):
+            y = np.asarray(x)       # host sync on traced value
+            if x > 0:               # python branch on traced value
+                y = x.item()        # another sync
+            for _ in range(x):      # data-dependent trip count
+                y = y + 1
+            return y
+    """)
+    keys = {f.key for f in hotpath.lint_source(src, "k.py")}
+    assert "host-sync:kernel.np.asarray" in keys
+    assert "host-sync:kernel.item" in keys
+    assert "traced-branch:kernel.x" in keys
+
+
+def test_hotpath_taint_propagates_through_long_chains():
+    """Forward dataflow must cross arbitrarily many assignment links —
+    a reversed walk would only propagate one link per pass."""
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def kernel(x):
+            a = x + 1
+            b = a + 1
+            c = b + 1
+            d = c + 1
+            if d > 0:
+                return np.asarray(d)
+            return d
+    """)
+    keys = {f.key for f in hotpath.lint_source(src, "k.py")}
+    assert "traced-branch:kernel.d" in keys
+    assert "host-sync:kernel.np.asarray" in keys
+
+
+def test_hotpath_shape_branches_are_static():
+    src = textwrap.dedent("""
+        def kernel(x, flag=True):
+            if x.ndim > 1:          # shape: static under trace
+                x = x + 1
+            if flag:                # config default: static
+                x = x + 2
+            if x is None:           # structural guard
+                return None
+            n = len(x.shape)
+            for i in range(n):      # laundered through len/.shape
+                x = x + i
+            return x
+    """)
+    assert hotpath.lint_source(src, "k.py") == []
+
+
+def test_hotpath_flags_jit_in_func():
+    src = textwrap.dedent("""
+        import jax
+
+        def dispatch(x):
+            f = jax.jit(lambda v: v + 1)
+            return f(x)
+    """)
+    keys = {f.key for f in hotpath.lint_source(src, "d.py",
+                                               device_file=False)}
+    assert "jit-in-func:dispatch.jax.jit" in keys
+
+
+def test_hotpath_flags_jit_decorator_and_import_forms():
+    """The decorator spelling and `from jax import jit` build the same
+    fresh-wrapper-per-call hazard and must not slip through."""
+    src = textwrap.dedent("""
+        import functools
+        import jax
+        from jax import jit
+
+        def dispatch(x):
+            @jax.jit
+            def f(v):
+                return v + 1
+            g = jit(lambda v: v - 1)
+            h = functools.partial(jax.jit, donate_argnums=0)
+            return f(x), g(x), h
+    """)
+    keys = {f.key for f in hotpath.lint_source(src, "d.py",
+                                               device_file=False)}
+    assert "jit-in-func:dispatch.f.jax.jit" in keys   # decorator
+    assert "jit-in-func:dispatch.jax.jit" in keys     # bare jit + partial
+
+    # module-level decoration is the normal, cached pattern: clean
+    top = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def kernel(v):
+            return v + 1
+    """)
+    assert hotpath.lint_source(top, "k.py", device_file=False) == []
+
+
+# ---------------- clean tree + allowlist hygiene ----------------
+
+
+def test_hotpath_clean_on_tree():
+    rep = hotpath.run()
+    assert rep.ok, "\n" + rep.describe()
+
+
+def test_locks_clean_on_tree():
+    rep = locks.run()
+    assert rep.ok, "\n" + rep.describe()
+
+
+def test_nondet_clean_on_tree():
+    rep = nondet.run()
+    assert rep.ok, "\n" + rep.describe()
+
+
+def test_allowlist_requires_written_reason():
+    with pytest.raises(ValueError):
+        Allowlist({"f.py": {"rule:sym": ""}})
+    with pytest.raises(ValueError):
+        Allowlist({"f.py": {"rule:sym": "ok"}})  # too short to argue
+
+
+def test_lock_lint_scope_covers_threaded_modules():
+    scope = set(locks.SCOPE)
+    assert "stellar_tpu/crypto/batch_verifier.py" in scope
+    assert "stellar_tpu/utils/resilience.py" in scope
+    assert "stellar_tpu/utils/metrics.py" in scope
+    assert "tools/device_watch.py" in scope

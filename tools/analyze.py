@@ -1,0 +1,143 @@
+"""Static analysis gate: overflow prover + hot-path/lock/nondet lints.
+
+Runs the full ``stellar_tpu.analysis`` suite and exits nonzero on ANY
+open finding — wired into ``tools/tier1.sh`` after the pytest gate so
+every kernel or dispatch PR is checked, and into ``bench.py`` so a
+bench record carries the proof's pass/fail + envelope hash.
+
+  python tools/analyze.py                  # pretty report, full sweep
+  python tools/analyze.py --json           # one JSON line (CI / bench)
+  python tools/analyze.py --buckets=128,2048
+  python tools/analyze.py --lint-only      # AST lints only (fast)
+  python tools/analyze.py --overflow-only  # interval prover only
+  python tools/analyze.py --write-golden   # refresh docs/limb_bounds.json
+                                           # (a DELIBERATE act: the diff
+                                           # is the proof change)
+
+The overflow prover traces the verify kernel's three stages at every
+jit bucket size (``stellar_tpu.analysis.overflow.DEFAULT_BUCKETS``) and
+proves every integer intermediate fits its dtype with the loose-limb
+headroom of ``docs/kernel_design.md`` §1; the proven per-stage envelope
+must match the committed golden ``docs/limb_bounds.json``. How to read
+a failure: ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _force_cpu():
+    """Pin jax to CPU before any backend initializes (a dead TPU tunnel
+    hangs array creation forever — same dance as tools/kernel_cost.py)."""
+    from stellar_tpu.utils.cpu_backend import force_cpu
+    force_cpu()
+
+
+def run_lints() -> dict:
+    from stellar_tpu.analysis import hotpath, locks, nondet
+    return {rep.name: rep.to_dict()
+            for rep in (hotpath.run(), locks.run(), nondet.run())}
+
+
+def run_overflow(buckets) -> dict:
+    _force_cpu()
+    from stellar_tpu.analysis import overflow
+    rec = overflow.prove_buckets(buckets)
+    golden = overflow.load_golden(_REPO)
+    if golden is None:
+        rec["golden"] = "missing"
+        rec["golden_diff"] = [
+            f"{overflow.GOLDEN_PATH} not committed — run "
+            "tools/analyze.py --write-golden and review the envelope"]
+        rec["ok"] = False
+    else:
+        diff = overflow.diff_golden(rec["envelope"], golden)
+        rec["golden"] = "match" if not diff else "MISMATCH"
+        rec["golden_diff"] = diff
+        rec["ok"] = rec["ok"] and not diff
+    return rec
+
+
+def main(argv) -> int:
+    as_json = "--json" in argv
+    lint_only = "--lint-only" in argv
+    overflow_only = "--overflow-only" in argv
+    write_golden = "--write-golden" in argv
+    from stellar_tpu.analysis.overflow import DEFAULT_BUCKETS, GOLDEN_PATH
+    buckets = list(DEFAULT_BUCKETS)
+    for a in argv:
+        if a.startswith("--buckets="):
+            buckets = [int(b) for b in a.split("=", 1)[1].split(",")]
+
+    out = {"ok": True}
+    if not overflow_only:
+        lints = run_lints()
+        out["lints"] = lints
+        out["ok"] &= all(rep["ok"] for rep in lints.values())
+    if not lint_only:
+        rec = run_overflow(buckets)
+        if write_golden:
+            path = os.path.join(_REPO, GOLDEN_PATH)
+            with open(path, "w") as f:
+                json.dump(rec["envelope"], f, indent=1, sort_keys=True)
+                f.write("\n")
+            rec["golden"] = "written"
+            rec["golden_diff"] = []
+            rec["ok"] = (not rec["violations"]
+                         and not rec["contract_breaches"]
+                         and not rec["unsupported"]
+                         and not rec["envelope_mismatch_buckets"])
+        # the full envelope rides the golden file, not every record
+        slim = {k: v for k, v in rec.items() if k != "envelope"}
+        out["overflow"] = slim
+        out["ok"] &= rec["ok"]
+
+    if as_json:
+        print(json.dumps(out, default=str))
+    else:
+        _pretty(out)
+    return 0 if out["ok"] else 1
+
+
+def _pretty(out: dict) -> None:
+    for name, rep in out.get("lints", {}).items():
+        status = "ok" if rep["ok"] else "FAIL"
+        print(f"[{status}] lint:{name}  files={rep['files_scanned']} "
+              f"open={len(rep['findings'])} "
+              f"allowlisted={len(rep['allowlisted'])} "
+              f"stale={len(rep['stale_allowlist'])}")
+        for f in rep["findings"]:
+            print(f"    {f['file']}:{f['line']}: [{f['key']}] "
+                  f"{f['message']}")
+        for e in rep["stale_allowlist"]:
+            print(f"    stale allowlist entry (delete it): {e}")
+    ov = out.get("overflow")
+    if ov:
+        status = "ok" if ov["ok"] else "FAIL"
+        print(f"[{status}] overflow  buckets={ov.get('buckets')} "
+              f"violations={len(ov['violations'])} "
+              f"contract={len(ov['contract_breaches'])} "
+              f"golden={ov.get('golden')}")
+        for v in ov["violations"][:20]:
+            print(f"    {v['path']}[{v['eqn_index']}] {v['primitive']} "
+                  f"-> [{v['lo']}, {v['hi']}] escapes {v['dtype']} at "
+                  f"{v['where']}")
+        for c in ov["contract_breaches"][:20]:
+            print(f"    {c}")
+        for u in ov["unsupported"][:20]:
+            print(f"    unsupported: {u}")
+        for d in ov.get("golden_diff", [])[:20]:
+            print(f"    golden: {d}")
+        print(f"    envelope_sha256={ov.get('envelope_sha256')}")
+    print("ANALYSIS_OK" if out["ok"] else "ANALYSIS_FAIL")
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

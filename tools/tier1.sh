@@ -27,5 +27,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 crc=${PIPESTATUS[0]}
 echo CHAOS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
     /tmp/_t1_chaos.log | tr -cd . | wc -c)
+# A red pytest/chaos gate exits here: its output is already printed,
+# and burning ~10 more minutes on the bucket sweep would bury it.
 [ "$rc" -ne 0 ] && exit $rc
-exit $crc
+[ "$crc" -ne 0 ] && exit $crc
+# Static-analysis gate (ISSUE 3): the jaxpr overflow prover must prove
+# all three verify-kernel stages at EVERY jit bucket size against the
+# committed envelope golden (docs/limb_bounds.json), and the
+# hot-path/lock-discipline/nondet lints must be clean
+# (docs/static_analysis.md). Fails the tier-1 gate on any open finding.
+timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py
+arc=$?
+echo ANALYSIS_RC=$arc
+exit $arc
