@@ -290,7 +290,14 @@ def _phase_backend(before: dict, after: dict, platform: str) -> str:
     """Which backend ACTUALLY served a measurement phase (never report
     a silent fallback as a device number — the PR 1 'no fictional
     baseline' rule extended to attribution). Reads the process-wide
-    items-served deltas from the dispatch layer."""
+    items-served deltas from the dispatch layer. A result-integrity
+    audit mismatch taints the whole record: a chip caught returning
+    wrong bits must not pollute a bench number any more than it may
+    decide signature validity."""
+    from stellar_tpu.crypto import batch_verifier
+    health = batch_verifier.dispatch_health()
+    if health["host_only"] or health["audit"]["mismatches"]:
+        return "untrusted(audit-mismatch)"
     dev = after["device"] - before["device"]
     fb = after["host_fallback"] - before["host_fallback"]
     if fb and dev:

@@ -40,10 +40,12 @@ from stellar_tpu.analysis.lint_base import (
 
 __all__ = ["run", "lint_source", "SCOPE", "ALLOWLIST"]
 
-# The threaded modules: verify dispatch, resilience primitives, the
-# metrics registry they all mark into, and the device-watch daemon.
+# The threaded modules: verify dispatch, resilience primitives (incl.
+# the watchdog pool), the per-device health registry, the metrics
+# registry they all mark into, and the device-watch daemon.
 SCOPE = [
     "stellar_tpu/crypto/batch_verifier.py",
+    "stellar_tpu/parallel/device_health.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
     "tools/device_watch.py",
@@ -82,6 +84,12 @@ ALLOWLIST = Allowlist({
             "read-modify-write): same argument as DEADLINE_MS — "
             "config push at startup, torn reads impossible under the "
             "GIL.",
+        "unlocked-global:configure_dispatch.AUDIT_RATE":
+            "single atomic store of an immutable float (no "
+            "read-modify-write): same argument as DEADLINE_MS — "
+            "config push at startup, torn reads impossible under the "
+            "GIL; a racing resolve sees either the old or the new "
+            "rate, both of which sample deterministically.",
     },
 })
 

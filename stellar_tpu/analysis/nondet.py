@@ -40,6 +40,12 @@ CONSENSUS_DIRS = ["stellar_tpu/scp", "stellar_tpu/ledger",
 # primitives under them — one nondeterministic branch here and the
 # device and host halves of a verdict could disagree
 HOST_ORACLE_FILES = [
+    # the result-integrity audit sampler and the quarantine registry:
+    # both gate WHICH backend serves a consensus verdict — the sample
+    # must be content-derived and the quarantine logic clock/RNG-free,
+    # or replicas could diverge in what they re-verify
+    "stellar_tpu/crypto/audit.py",
+    "stellar_tpu/parallel/device_health.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",

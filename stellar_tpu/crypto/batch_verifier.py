@@ -19,9 +19,17 @@ Division of labor (mirrors libsodium's own decomposition):
   itself.
 
 Batches are padded to a small set of bucket sizes so each size
-jit-compiles exactly once; oversize batches are chunked. A 1-D
-``jax.sharding.Mesh`` shards the batch across chips with ``shard_map``
-(no collectives — verify is data-parallel).
+jit-compiles exactly once; oversize batches are chunked. On a
+multi-chip host each padded bucket is split into per-device SUB-CHUNKS
+(bucket // n_devices rows each) dispatched independently to the
+devices of a 1-D mesh — pure data parallelism, no collectives, same
+math as the former ``shard_map`` dispatch, but every device interaction
+is now ATTRIBUTABLE to one chip. That attribution is the fault-domain
+boundary (``docs/robustness.md``): a failing device opens only its own
+breaker (``stellar_tpu.parallel.device_health``), its share of the
+batch re-shards over the surviving devices at unchanged sub-chunk
+shapes (so degradation never pays a fresh XLA compile), and a
+half-open re-probe regrows it into the rotation.
 
 ``submit`` is the asynchronous half of the API: it dispatches the device
 kernel without blocking and returns a resolver, so a caller draining a
@@ -38,12 +46,21 @@ Fault tolerance (``docs/robustness.md``): the tunnel's observed failure
 mode is a HANG, not an exception — a mid-flight death would park
 ``resolve`` in ``np.asarray`` forever. Every device interaction is
 therefore (a) deadline-guarded (``VERIFY_DEVICE_DEADLINE_MS``), (b)
-accounted to a process-wide circuit breaker, and (c) backed by host
-re-verification of the affected chunk through the same oracle stack
-(`ed25519_ref`/`native_verify`) — degraded mode changes latency, never
-decisions. The breaker also paces ``device_available`` re-probes so a
-recovered tunnel is picked up (half-open) instead of being ignored for
-the life of the process.
+accounted to a circuit breaker — the PER-DEVICE one when the failure is
+attributable to a mesh device, the process-wide one otherwise — and
+(c) backed by host re-verification of the affected rows through the
+same oracle stack (`ed25519_ref`/`native_verify`) — degraded mode
+changes latency, never decisions. The breaker also paces
+``device_available`` re-probes so a recovered tunnel is picked up
+(half-open) instead of being ignored for the life of the process.
+
+A chip that returns WRONG BITS instead of hanging defeats all of the
+above, so every resolve additionally re-verifies a deterministic
+content-seeded sample of device verdicts through the host oracle
+(``VERIFY_AUDIT_RATE``, :mod:`stellar_tpu.crypto.audit`); a mismatch
+hard-quarantines the device, flips the process into HOST-ONLY mode,
+and re-verifies the affected rows — a corrupting accelerator never
+decides signature validity.
 """
 
 from __future__ import annotations
@@ -56,8 +73,10 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.crypto import ed25519_ref as ref
 from stellar_tpu.crypto import native_prep
+from stellar_tpu.parallel import device_health
 from stellar_tpu.utils import faults, resilience
 from stellar_tpu.utils.metrics import registry
 
@@ -81,6 +100,11 @@ _P_BYTES = np.frombuffer(_P.to_bytes(32, "little"), dtype=np.uint8)
 
 DEADLINE_MS = float(os.environ.get("VERIFY_DEVICE_DEADLINE_MS", "8000"))
 DISPATCH_RETRIES = int(os.environ.get("VERIFY_DISPATCH_RETRIES", "1"))
+# Result-integrity audit: fraction of each device-served part re-checked
+# through the host oracle (min 1 row per part; <= 0 disables). The
+# sample is derived from the batch CONTENT (crypto/audit.py) so
+# consensus replicas audit identical rows.
+AUDIT_RATE = float(os.environ.get("VERIFY_AUDIT_RATE", "0.02"))
 
 # The production jit bucket ladder (default_verifier). Also the shape
 # set the static overflow prover must cover — stellar_tpu.analysis.
@@ -111,17 +135,59 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
                        dispatch_retries: Optional[int] = None,
                        failure_threshold: Optional[int] = None,
                        backoff_min_s: Optional[float] = None,
-                       backoff_max_s: Optional[float] = None) -> None:
+                       backoff_max_s: Optional[float] = None,
+                       audit_rate: Optional[float] = None,
+                       device_failure_threshold: Optional[int] = None,
+                       device_backoff_min_s: Optional[float] = None,
+                       device_backoff_max_s: Optional[float] = None
+                       ) -> None:
     """Push dispatch-resilience knobs (Config / tests); None keeps the
-    current value. ``deadline_ms <= 0`` disables the resolve watchdog."""
-    global DEADLINE_MS, DISPATCH_RETRIES
+    current value. ``deadline_ms <= 0`` disables the resolve watchdog;
+    ``audit_rate <= 0`` disables the result-integrity audit; the
+    ``device_*`` knobs shape the per-device quarantine breakers."""
+    global DEADLINE_MS, DISPATCH_RETRIES, AUDIT_RATE
     if deadline_ms is not None:
         DEADLINE_MS = float(deadline_ms)
     if dispatch_retries is not None:
         DISPATCH_RETRIES = max(0, int(dispatch_retries))
+    if audit_rate is not None:
+        AUDIT_RATE = float(audit_rate)
     _breaker.configure(failure_threshold=failure_threshold,
                        backoff_min_s=backoff_min_s,
                        backoff_max_s=backoff_max_s)
+    device_health.get().configure(
+        failure_threshold=device_failure_threshold,
+        backoff_min_s=device_backoff_min_s,
+        backoff_max_s=device_backoff_max_s)
+
+
+# ---------------- host-only mode (result-integrity posture) ----------------
+# Once ANY device is caught returning wrong verdict bits, the process
+# stops trusting the accelerator path entirely: quarantining the one
+# chip bounds the blast radius, but a machine that corrupted once has
+# forfeited the benefit of the doubt for consensus decisions. Sticky
+# for the process lifetime (operators restart after replacing the
+# part); tests reset via _reset_dispatch_state_for_testing.
+
+_host_only = False
+_host_only_lock = threading.Lock()
+
+
+def _enter_host_only(reason: str) -> None:
+    global _host_only
+    with _host_only_lock:
+        already = _host_only
+        _host_only = True
+    if not already:
+        registry.gauge("crypto.verify.host_only").set(True)
+        _log.error(
+            "verify dispatch entering HOST-ONLY mode (%s): device "
+            "verdicts are no longer trusted for consensus decisions",
+            reason)
+
+
+def host_only_mode() -> bool:
+    return _host_only
 
 
 def served_counts() -> dict:
@@ -152,17 +218,45 @@ def dispatch_health() -> dict:
         "retries": registry.counter("crypto.verify.dispatch.retry").count,
         "short_circuits": registry.counter(
             "crypto.verify.dispatch.short_circuit").count,
+        "host_only": _host_only,
+        "audit": {
+            "rate": AUDIT_RATE,
+            "sampled": registry.counter(
+                "crypto.verify.audit.sampled").count,
+            "mismatches": registry.counter(
+                "crypto.verify.audit.mismatch").count,
+        },
+        "device_health": device_health.get().snapshot(),
+        "watchdog": resilience.watchdog_stats(),
     }
 
 
-def _note_device_failure(stage: str, exc: BaseException) -> None:
+def _note_device_failure(stage: str, exc: BaseException,
+                         dev_idx: Optional[int] = None) -> None:
     """One failing device interaction: breaker accounting + metrics.
-    The caller re-verifies the affected chunk on the host."""
+    ``dev_idx`` attributes the failure to ONE mesh device (only its
+    breaker opens — the fault-domain boundary); None means the failure
+    is not attributable (single-device dispatch) and feeds the
+    process-wide breaker. The caller re-verifies the affected rows on
+    the host."""
     registry.meter("crypto.verify.dispatch.fallback").mark()
-    _breaker.record_failure()
+    if dev_idx is None:
+        _breaker.record_failure()
+    elif device_health.get().record_failure(dev_idx):
+        # correlated-outage escalation: each quarantine ONSET counts
+        # one failure against the global breaker. A single sick chip
+        # (one quarantine, then healthy traffic resets the streak)
+        # leaves the mesh serving; a whole-tunnel death quarantines
+        # device after device with no intervening success, reaches the
+        # global threshold, and short-circuits the remaining chunks —
+        # bounding the outage at global_threshold quarantines instead
+        # of n_devices independent ones
+        _breaker.record_failure()
     _log.warning(
-        "device %s failed (%s: %s) — affected chunk re-verified on the "
-        "host oracle", stage, type(exc).__name__, exc)
+        "device%s %s failed (%s: %s) — affected rows re-verified on "
+        "the host oracle",
+        "" if dev_idx is None else f" {dev_idx}",
+        stage, type(exc).__name__, exc)
 
 
 def _resolve_budget_s() -> Optional[float]:
@@ -181,10 +275,14 @@ def _resolve_budget_s() -> Optional[float]:
     return DEADLINE_MS / 1000.0
 
 
-def _fetch(dev) -> np.ndarray:
-    """The blocking half of a dispatch (runs under the watchdog)."""
-    faults.inject(faults.RESOLVE)
-    return np.asarray(dev)
+def _fetch(dev, dev_idx: Optional[int] = None) -> np.ndarray:
+    """The blocking half of a dispatch (runs under the watchdog).
+    ``dev_idx`` attributes the fetch to one mesh device for per-device
+    chaos faults — including verdict corruption, applied here so the
+    wrong bits flow through exactly the path real corruption would."""
+    faults.inject(faults.RESOLVE, device=dev_idx)
+    arr = np.asarray(dev)
+    return faults.corrupt_verdicts(faults.RESOLVE, dev_idx, arr)
 
 
 def _host_verify_items(items: Sequence[tuple]) -> np.ndarray:
@@ -229,30 +327,50 @@ class BatchVerifier:
     """Batched libsodium-exact ed25519 verifier with a jit bucket cache.
 
     Args:
-      mesh: optional 1-D ``jax.sharding.Mesh``; if given, buckets divisible
-        by the mesh size run under shard_map across its devices.
-      bucket_sizes: padded batch sizes, ascending; each compiles once.
+      mesh: optional 1-D ``jax.sharding.Mesh``; if given (and it spans
+        >= 2 devices), buckets divisible by the device count are split
+        into per-device SUB-CHUNKS of the plain kernel — one
+        attributable dispatch per device, quarantine/re-shard per
+        ``stellar_tpu.parallel.device_health`` — instead of one
+        ``shard_map`` call. Non-divisible buckets (and mesh=None) use
+        a single whole-bucket dispatch under the global breaker.
+      bucket_sizes: padded batch sizes, ascending; each dispatch shape
+        compiles once (per serving device on the mesh path).
     """
 
     def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
         self._mesh = mesh
+        self._devices = None
+        if mesh is not None:
+            from stellar_tpu.parallel.mesh import mesh_devices
+            devs = mesh_devices(mesh)
+            if len(devs) >= 2:
+                self._devices = devs
         self._buckets = tuple(sorted(bucket_sizes))
-        # jit-wrapper cache: written from any thread that dispatches
-        # (trickle leaders, chaos tests, the close path) — guarded, the
-        # wrapper itself is built outside the lock (cheap; the compile
-        # happens lazily at first call)
+        # jit-wrapper cache keyed by DISPATCH SHAPE (rows per kernel
+        # call: the bucket on single-device hosts, bucket // n_devices
+        # on a mesh): written from any thread that dispatches (trickle
+        # leaders, chaos tests, the close path) — guarded, the wrapper
+        # itself is built outside the lock (cheap; the compile happens
+        # lazily at first call)
         self._kernels = {}
         self._kernels_lock = threading.Lock()
         # per-instance backend attribution (items served), mirrored into
         # the process-wide meters: bench and the chaos tests read these
         self._stats_lock = threading.Lock()
         self.served = {"device": 0, "host-fallback": 0}
+        self.device_served = {}  # mesh device index -> items served
         self.deadline_misses = 0
         self.retries = 0
+        self.audit_mismatches = 0
 
-    def _mark_served(self, kind: str, n: int) -> None:
+    def _mark_served(self, kind: str, n: int,
+                     dev_idx: Optional[int] = None) -> None:
         with self._stats_lock:
             self.served[kind] += n
+            if dev_idx is not None:
+                self.device_served[dev_idx] = \
+                    self.device_served.get(dev_idx, 0) + n
         registry.meter("crypto.verify.serve." +
                        ("device" if kind == "device" else
                         "host_fallback")).mark(n)
@@ -265,10 +383,11 @@ class BatchVerifier:
         if kernel is None:
             import jax
             from stellar_tpu.ops import verify as vk
-            if self._mesh is not None and n % self._mesh.size == 0:
-                built = vk.verify_kernel_sharded(self._mesh)
-            else:
-                built = jax.jit(vk.verify_kernel)
+            # one plain jit wrapper per dispatch shape; on the mesh
+            # path placement follows the committed inputs, so the SAME
+            # wrapper serves every device (jax caches one executable
+            # per (shape, device) underneath)
+            built = jax.jit(vk.verify_kernel)
             with self._kernels_lock:
                 # setdefault: a racing builder's wrapper wins once —
                 # both wrappers trace identically, so the loser is
@@ -282,18 +401,86 @@ class BatchVerifier:
                 return b
         return self._buckets[-1]
 
+    def _dispatch_one(self, aa, rr, ss, hh, bsize: int,
+                      dev_idx: Optional[int]):
+        """One kernel call (whole padded bucket, or one per-device
+        sub-chunk): inject-point + retry + failure attribution. Returns
+        the in-flight device array, or None (host fallback)."""
+        attempts = 1 + DISPATCH_RETRIES
+        for attempt in range(attempts):
+            try:
+                faults.inject(faults.DISPATCH, device=dev_idx)
+                return self._kernel_for(bsize)(aa, rr, ss, hh)
+            except Exception as e:
+                if attempt + 1 < attempts:
+                    registry.counter(
+                        "crypto.verify.dispatch.retry").inc()
+                    with self._stats_lock:
+                        self.retries += 1
+                else:
+                    _note_device_failure("dispatch", e, dev_idx)
+        return None
+
+    def _dispatch_parts(self, aa, rr, ss, hh, b: int, chunk: int):
+        """Split one padded bucket into per-device sub-chunks over the
+        CURRENTLY HEALTHY devices — the degraded-mesh re-shard.
+
+        The sub-chunk shape is fixed at ``b // n_devices`` for the FULL
+        mesh size, independent of how many devices survive: quarantine
+        only changes which healthy device serves how many sub-chunks
+        (round-robin over the survivors), never the shapes — and every
+        survivor already compiled its sub-chunk executable when it
+        served its own share, so degradation and regrowth never pay a
+        fresh XLA compile (the invariant `docs/robustness.md` pins).
+
+        A half-open device's breaker grants exactly one sub-chunk per
+        backoff window — probation traffic IS the re-probe; success
+        regrows the device into the rotation.
+
+        Returns part records ``[lo, hi, dev_idx, arr]``: valid rows
+        ``lo:hi`` of the chunk, serving device, in-flight array (None =
+        host fallback). All-padding tail sub-chunks are skipped."""
+        import jax
+        n_dev = len(self._devices)
+        sub = b // n_dev
+        # sub-chunks that carry real rows (pure-padding tails are
+        # never dispatched)
+        n_parts = min(n_dev, -(-chunk // sub))
+        assignment = device_health.get().assign_parts(n_dev, n_parts)
+        parts = []
+        for j, di in enumerate(assignment):
+            lo = j * sub
+            hi = min(lo + sub, chunk)
+            if di is None:
+                # zero survivors and no probation grants: the whole
+                # mesh is quarantined — only now does the verifier
+                # fall back to the host oracle
+                registry.counter(
+                    "crypto.verify.dispatch.short_circuit").inc()
+                parts.append([lo, hi, None, None])
+                continue
+            placed = tuple(
+                jax.device_put(x[lo:lo + sub], self._devices[di])
+                for x in (aa, rr, ss, hh))
+            arr = self._dispatch_one(*placed, bsize=sub, dev_idx=di)
+            parts.append([lo, hi, di, arr])
+        return parts
+
     def _dispatch_device(self, a: np.ndarray, r: np.ndarray, s: np.ndarray,
                          h: np.ndarray):
         """Dispatch padded/chunked batches to the jitted kernel without
-        blocking; returns a list of (slice, chunk_len, device_array).
-        A chunk whose dispatch raises (or that the open breaker refuses)
-        carries ``None`` and is re-verified on the host at resolve time;
-        transient dispatch exceptions get ``DISPATCH_RETRIES`` fresh
-        attempts first."""
+        blocking; returns a list of (slice, chunk_len, parts) where
+        parts are per-device sub-chunk records (single-device hosts get
+        one whole-bucket part). A part whose dispatch raises (or that
+        an open breaker refuses, or host-only mode) carries ``None``
+        and is re-verified on the host at resolve time; transient
+        dispatch exceptions get ``DISPATCH_RETRIES`` fresh attempts
+        first."""
         n = a.shape[0]
         top = self._buckets[-1]
         pending = []
         start = 0
+        host_only = _host_only
         while start < n:
             chunk = min(top, n - start)
             b = self._bucket(chunk)
@@ -303,27 +490,30 @@ class BatchVerifier:
             rr = np.concatenate([r[sl], np.repeat(_PAD_R, pad, 0)])
             ss = np.concatenate([s[sl], np.repeat(_PAD_S, pad, 0)])
             hh = np.concatenate([h[sl], np.repeat(_PAD_H, pad, 0)])
-            dev = None
-            if _breaker.allow():
-                attempts = 1 + DISPATCH_RETRIES
-                for attempt in range(attempts):
-                    try:
-                        faults.inject(faults.DISPATCH)
-                        dev = self._kernel_for(b)(aa, rr, ss, hh)
-                        break
-                    except Exception as e:
-                        dev = None
-                        if attempt + 1 < attempts:
-                            registry.counter(
-                                "crypto.verify.dispatch.retry").inc()
-                            with self._stats_lock:
-                                self.retries += 1
-                        else:
-                            _note_device_failure("dispatch", e)
+            if host_only:
+                # integrity posture: no device dispatch at all
+                parts = [[0, chunk, None, None]]
+            elif self._devices is not None and \
+                    b % len(self._devices) == 0:
+                # the global breaker gates the mesh path too: a
+                # correlated outage (escalated quarantines) opens it
+                # and short-circuits whole chunks; its half-open grant
+                # admits one chunk as the recovery probe
+                if _breaker.allow():
+                    parts = self._dispatch_parts(aa, rr, ss, hh, b,
+                                                 chunk)
+                else:
+                    registry.counter(
+                        "crypto.verify.dispatch.short_circuit").inc()
+                    parts = [[0, chunk, None, None]]
+            elif _breaker.allow():
+                arr = self._dispatch_one(aa, rr, ss, hh, b, None)
+                parts = [[0, chunk, None, arr]]
             else:
                 registry.counter(
                     "crypto.verify.dispatch.short_circuit").inc()
-            pending.append((sl, chunk, dev))
+                parts = [[0, chunk, None, None]]
+            pending.append((sl, chunk, parts))
             start += chunk
         return pending
 
@@ -389,44 +579,114 @@ class BatchVerifier:
         pending = self._dispatch_device(a, r, s, h)
         items = list(items)  # pinned for possible host re-verification
 
+        def _audit_part(vals: np.ndarray, gl: int, gh: int) -> bool:
+            """Sampled result-integrity audit of one device-served
+            part (global rows ``gl:gh``): re-verify a content-seeded
+            sample through the host oracle and compare against the
+            COMPOSED decision (host policy gate AND device verdict) —
+            the quantity that is pinned bit-identical to libsodium.
+            Only rows that PASSED the host policy gate are sampled:
+            a gate-rejected row is False regardless of device bits, so
+            auditing it would be vacuous (and a predictable blind
+            spot). True = clean (or nothing to audit)."""
+            material = (a[gl:gh].tobytes() + r[gl:gh].tobytes() +
+                        s[gl:gh].tobytes() + h[gl:gh].tobytes())
+            eligible = [i for i in range(gh - gl) if ok[gl + i]]
+            idxs = audit_mod.sample_rows(material, eligible, AUDIT_RATE)
+            if not idxs:
+                return True
+            registry.counter("crypto.verify.audit.sampled").inc(
+                len(idxs))
+            want = _host_verify_items([items[gl + i] for i in idxs])
+            got_comp = np.array([bool(vals[i]) for i in idxs])
+            return bool((want == got_comp).all())
+
         def resolve() -> np.ndarray:
             out = np.zeros(n, dtype=bool)
-            for sl, chunk, dev in pending:
-                got = None
-                if dev is not None:
-                    # an OPEN breaker short-circuits remaining chunks so
-                    # one outage costs threshold x deadline, not chunks
-                    # x deadline; state (not allow()) is checked because
-                    # a half-open chunk already holds its grant from
-                    # dispatch time and must be fetched, not refused
-                    if _breaker.state != resilience.OPEN:
-                        try:
-                            got = resilience.call_with_deadline(
-                                lambda d=dev: _fetch(d),
-                                _resolve_budget_s(),
-                                name="verify-resolve")
-                        except resilience.DeadlineExceeded as e:
+            for sl, chunk, parts in pending:
+                for lo, hi, di, arr in parts:
+                    got = None
+                    # _host_only is re-read PER PART: once any part's
+                    # audit proves corruption, the remaining
+                    # already-dispatched parts of this very batch are
+                    # host re-verified too — the batch that convicted
+                    # the machine must not let device bits decide its
+                    # other rows
+                    if arr is not None and not _host_only:
+                        # an OPEN breaker short-circuits this fault
+                        # domain's remaining parts so one outage costs
+                        # threshold x deadline, not parts x deadline;
+                        # state (not allow()) is checked because a
+                        # half-open part already holds its grant from
+                        # dispatch time and must be fetched, not
+                        # refused
+                        gate = _breaker if di is None else \
+                            device_health.get().breaker(di)
+                        if gate.state != resilience.OPEN:
+                            try:
+                                got = resilience.call_with_deadline(
+                                    lambda d=arr, i=di: _fetch(d, i),
+                                    _resolve_budget_s(),
+                                    name="verify-resolve")
+                            except resilience.DeadlineExceeded as e:
+                                registry.counter(
+                                    "crypto.verify.dispatch."
+                                    "deadline_miss").inc()
+                                with self._stats_lock:
+                                    self.deadline_misses += 1
+                                _note_device_failure(
+                                    "resolve-deadline", e, di)
+                            except Exception as e:
+                                _note_device_failure("resolve", e, di)
+                        else:
                             registry.counter(
-                                "crypto.verify.dispatch.deadline_miss"
-                            ).inc()
+                                "crypto.verify.dispatch."
+                                "short_circuit").inc()
+                    gl, gh = sl.start + lo, sl.start + hi
+                    if got is not None:
+                        vals = np.asarray(got)[:hi - lo]
+                        if not _audit_part(vals, gl, gh):
+                            # wrong bits: hard-quarantine the chip,
+                            # stop trusting the accelerator path, and
+                            # re-verify the whole part on the host —
+                            # the corrupted verdicts never surface
+                            registry.counter(
+                                "crypto.verify.audit.mismatch").inc()
                             with self._stats_lock:
-                                self.deadline_misses += 1
-                            _note_device_failure("resolve-deadline", e)
-                        except Exception as e:
-                            _note_device_failure("resolve", e)
-                    else:
-                        registry.counter(
-                            "crypto.verify.dispatch.short_circuit").inc()
-                if got is not None:
-                    out[sl] = np.asarray(got)[:chunk]
-                    _breaker.record_success()
-                    self._mark_served("device", chunk)
-                else:
-                    # failover: bit-identical host re-verification of
-                    # the affected chunk (latency changes, decisions
-                    # never do)
-                    out[sl] = _host_verify_items(items[sl])
-                    self._mark_served("host-fallback", chunk)
+                                self.audit_mismatches += 1
+                            if di is not None:
+                                device_health.get().quarantine(
+                                    di, reason="audit-mismatch")
+                            else:
+                                _breaker.trip()
+                            _enter_host_only(
+                                "result-integrity audit mismatch on "
+                                f"device {di}")
+                            _log.error(
+                                "audit mismatch: device %s returned "
+                                "wrong verdict bits for rows %d:%d",
+                                di, gl, gh)
+                            got = None
+                        else:
+                            out[gl:gh] = vals
+                            if di is None:
+                                _breaker.record_success()
+                            else:
+                                device_health.get().record_success(di)
+                                # healthy traffic also resets the
+                                # global breaker's quarantine streak,
+                                # so isolated quarantines accumulated
+                                # over hours never masquerade as a
+                                # correlated outage (and a real one —
+                                # zero successes — still escalates)
+                                _breaker.record_success()
+                            self._mark_served("device", hi - lo, di)
+                    if got is None:
+                        # failover: bit-identical host re-verification
+                        # of the affected rows (latency changes,
+                        # decisions never do)
+                        out[gl:gh] = _host_verify_items(items[gl:gh])
+                        self._mark_served("host-fallback", hi - lo)
             return ok & out
 
         return resolve
@@ -681,11 +941,14 @@ def device_available(timeout_s: float = 30.0,
 def _reset_dispatch_state_for_testing() -> None:
     """Fresh probe/breaker state (chaos tests): equivalent to process
     start for the dispatch layer. Cumulative metrics are untouched."""
-    global _device_state, _probe
+    global _device_state, _probe, _host_only
     with _device_probe_lock:
         _device_state = None
         _probe = None
+    with _host_only_lock:
+        _host_only = False
     _breaker.record_success()  # closed, zero failures, backoff reset
+    device_health.get()._reset_for_testing()
 
 
 def _auto_mesh():

@@ -216,14 +216,23 @@ class Application:
                 changed("VERIFY_BREAKER_FAILURE_THRESHOLD") or \
                 changed("VERIFY_BREAKER_BACKOFF_MIN_S") or \
                 changed("VERIFY_BREAKER_BACKOFF_MAX_S") or \
-                changed("VERIFY_DISPATCH_RETRIES"):
+                changed("VERIFY_DISPATCH_RETRIES") or \
+                changed("VERIFY_AUDIT_RATE") or \
+                changed("VERIFY_DEVICE_FAILURE_THRESHOLD") or \
+                changed("VERIFY_DEVICE_BACKOFF_MIN_S") or \
+                changed("VERIFY_DEVICE_BACKOFF_MAX_S"):
             from stellar_tpu.crypto import batch_verifier
             batch_verifier.configure_dispatch(
                 deadline_ms=config.VERIFY_DEVICE_DEADLINE_MS,
                 dispatch_retries=config.VERIFY_DISPATCH_RETRIES,
                 failure_threshold=config.VERIFY_BREAKER_FAILURE_THRESHOLD,
                 backoff_min_s=config.VERIFY_BREAKER_BACKOFF_MIN_S,
-                backoff_max_s=config.VERIFY_BREAKER_BACKOFF_MAX_S)
+                backoff_max_s=config.VERIFY_BREAKER_BACKOFF_MAX_S,
+                audit_rate=config.VERIFY_AUDIT_RATE,
+                device_failure_threshold=(
+                    config.VERIFY_DEVICE_FAILURE_THRESHOLD),
+                device_backoff_min_s=config.VERIFY_DEVICE_BACKOFF_MIN_S,
+                device_backoff_max_s=config.VERIFY_DEVICE_BACKOFF_MAX_S)
         # worker pool active => verify callers are concurrent (overlay
         # pre-verify, threaded replay): put the device batch verifier
         # behind a trickle window by default (VERDICT r3 #3 — a policy,
@@ -649,13 +658,28 @@ class Application:
         health = batch_verifier.dispatch_health()
         health["backend"] = keys.get_verifier_backend_name()
         br = health["breaker"]
-        if br["state"] != "closed":
+        quarantined = health["device_health"]["quarantined"]
+        if health["host_only"]:
+            # integrity posture outranks availability degradation: the
+            # operator must know the accelerator is no longer trusted
+            self.status_manager.set_status(
+                StatusCategory.VERIFY_DEVICE,
+                "verify device UNTRUSTED: result-integrity audit "
+                f"caught {health['audit']['mismatches']} mismatched "
+                "verdict(s); host-only mode (restart after replacing "
+                "the part)")
+        elif br["state"] != "closed":
             self.status_manager.set_status(
                 StatusCategory.VERIFY_DEVICE,
                 f"verify device degraded: breaker {br['state']} "
                 f"({br['consecutive_failures']} consecutive failures, "
                 f"retry in {br['retry_in_s']}s); signatures served by "
                 "the host oracle")
+        elif quarantined:
+            self.status_manager.set_status(
+                StatusCategory.VERIFY_DEVICE,
+                f"verify mesh degraded: device(s) {quarantined} "
+                "quarantined; batch re-sharded over the survivors")
         else:
             self.status_manager.remove_status(StatusCategory.VERIFY_DEVICE)
         return health

@@ -159,6 +159,22 @@ class Config:
     VERIFY_BREAKER_BACKOFF_MAX_S: float = 120.0
     # fresh dispatch attempts after a transient kernel-call exception
     VERIFY_DISPATCH_RETRIES: int = 1
+    # per-device fault domains (docs/robustness.md): consecutive
+    # failures attributable to ONE mesh device before only THAT
+    # device's breaker opens and its share of the batch re-shards over
+    # the survivors (lower bar than the global breaker: benching one
+    # chip of n costs 1/n of throughput)
+    VERIFY_DEVICE_FAILURE_THRESHOLD: int = 2
+    # per-device half-open re-probe backoff bounds — how fast a healed
+    # chip regrows into the dispatch rotation
+    VERIFY_DEVICE_BACKOFF_MIN_S: float = 1.0
+    VERIFY_DEVICE_BACKOFF_MAX_S: float = 300.0
+    # result-integrity audit: fraction of each device-served part
+    # re-verified through the host oracle (sample is deterministic in
+    # the batch content; min one row per part; <= 0 disables). Any
+    # mismatch quarantines the device and flips verify host-only — a
+    # corrupting chip must never decide signature validity.
+    VERIFY_AUDIT_RATE: float = 0.02
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

@@ -110,6 +110,13 @@ def verify_kernel_sharded(mesh, axis_name="batch"):
     devices, no cross-device communication (each chip verifies its shard).
     Returns a jitted callable with the same signature as verify_kernel;
     batch must be divisible by mesh size.
+
+    Note: ``BatchVerifier`` no longer dispatches through this wrapper —
+    it splits buckets into per-device sub-chunks of the plain kernel so
+    failures are attributable to ONE chip (the fault-domain boundary,
+    ``docs/robustness.md``). This stays as the single-call collective
+    layout for harnesses (``__graft_entry__.dryrun_multichip``) and
+    mesh-layout experiments.
     """
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map

@@ -2,19 +2,26 @@
 
 The reference scales signature verification per-core with a worker pool
 (`ApplicationImpl.cpp:171-178` worker threads); the TPU-native design
-instead shards the signature batch axis across a 1-D chip mesh via
-``shard_map`` — pure data parallelism over ICI, no collectives on the hot
-path. Multi-host pods extend the same mesh over DCN transparently through
+instead shards the signature batch axis across a 1-D chip mesh — pure
+data parallelism over ICI, no collectives on the hot path. Multi-host
+pods extend the same mesh over DCN transparently through
 ``jax.distributed`` (same code path; the mesh just gets bigger).
+
+Fault domains: :func:`mesh_devices` fixes the device ORDER contract —
+position ``i`` in the flattened 1-D mesh is "mesh device ``i``"
+everywhere (sub-chunk assignment in ``BatchVerifier``, the breakers in
+``stellar_tpu.parallel.device_health``, per-device chaos faults), so a
+quarantine decision and the dispatch it gates always mean the same
+physical chip.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["batch_mesh", "device_count"]
+__all__ = ["batch_mesh", "device_count", "mesh_devices"]
 
 
 def device_count() -> int:
@@ -30,3 +37,10 @@ def batch_mesh(n: Optional[int] = None, axis: str = "batch"):
     if n is not None:
         devs = devs[:n]
     return Mesh(np.array(devs), (axis,))
+
+
+def mesh_devices(mesh) -> List:
+    """Flat device list of a mesh, in mesh order — the index contract
+    shared by sub-chunk assignment, per-device breakers, and per-device
+    chaos faults."""
+    return list(np.asarray(mesh.devices).reshape(-1))

@@ -23,13 +23,30 @@ Modes (``mode[:arg]``):
 * ``failn[:n]``     — raise on the first ``n`` calls (default 1), then
   behave: a link that recovers (breaker re-close path).
 
+Per-device modes (``mode:<device_index>`` — fault ONE chip of a mesh,
+the fault-domain chaos shapes of ``docs/robustness.md``):
+
+* ``fail-device:<idx>``    — raise on every call attributed to mesh
+  device ``idx``; other devices behave (single-chip outage);
+* ``flaky-device:<idx>``   — raise on every 2nd call attributed to
+  device ``idx`` (an intermittently sick chip, breaker flapping);
+* ``corrupt-device:<idx>`` — never raises: calls succeed, but verdict
+  arrays fetched from device ``idx`` come back BIT-FLIPPED via
+  :func:`corrupt_verdicts` — the silently-corrupting-chip shape that
+  only the result-integrity audit can catch.
+
+Production code attributes a call to a device by passing
+``inject(point, device=i)``; calls with ``device=None`` (single-device
+dispatch) never match a per-device fault.
+
 Injection points currently planted:
 
 * ``device.probe``    — inside the backend probe thread
   (``batch_verifier.start_device_probe``);
-* ``device.dispatch`` — immediately before the jitted kernel call;
+* ``device.dispatch`` — immediately before the jitted kernel call
+  (device-attributed on the per-device mesh path);
 * ``device.resolve``  — inside the (deadline-guarded) device-array
-  fetch.
+  fetch (device-attributed; also the ``corrupt_verdicts`` hook).
 """
 
 from __future__ import annotations
@@ -39,14 +56,16 @@ import threading
 import time
 from typing import Dict, Optional
 
-__all__ = ["FaultInjected", "inject", "is_active", "set_fault", "clear",
-           "counters", "load_spec"]
+__all__ = ["FaultInjected", "inject", "corrupt_verdicts", "is_active",
+           "set_fault", "clear", "counters", "load_spec"]
 
 PROBE = "device.probe"
 DISPATCH = "device.dispatch"
 RESOLVE = "device.resolve"
 
-_MODES = ("raise", "hang", "flake", "failn")
+_MODES = ("raise", "hang", "flake", "failn",
+          "fail-device", "flaky-device", "corrupt-device")
+_DEVICE_MODES = ("fail-device", "flaky-device", "corrupt-device")
 
 _lock = threading.Lock()
 _active: Dict[str, "_Fault"] = {}
@@ -63,22 +82,33 @@ class _Fault:
         if mode not in _MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
                              f"(one of {_MODES})")
+        if mode in _DEVICE_MODES and arg is None:
+            raise ValueError(f"{mode} needs a device index "
+                             f"({mode}:<idx>)")
         self.point = point
         self.mode = mode
         self.arg = arg
         self.calls = 0   # times the injection point was reached
         self.fired = 0   # times it actually misbehaved
 
-    def trip(self) -> None:
+    def trip(self, device: Optional[int] = None) -> None:
+        if self.mode in _DEVICE_MODES:
+            # device-scoped faults only see (and only count) calls
+            # attributed to their device; corruption never raises —
+            # it is applied to the fetched verdicts, see
+            # corrupt_verdicts()
+            if device is None or int(device) != int(self.arg) or \
+                    self.mode == "corrupt-device":
+                return
         with _lock:
             self.calls += 1
             n = self.calls
-        if self.mode == "raise":
+        if self.mode in ("raise", "hang", "fail-device"):
             fire = True
-        elif self.mode == "hang":
-            fire = True
-        elif self.mode == "flake":
-            fire = n % int(self.arg if self.arg else 2) == 0
+        elif self.mode in ("flake", "flaky-device"):
+            k = 2 if self.mode == "flaky-device" else \
+                int(self.arg if self.arg else 2)
+            fire = n % k == 0
         else:  # failn
             fire = n <= int(self.arg if self.arg is not None else 1)
         if not fire:
@@ -92,14 +122,35 @@ class _Fault:
                             f"({self.mode}, call #{n})")
 
 
-def inject(point: str) -> None:
+def inject(point: str, device: Optional[int] = None) -> None:
     """Trip the fault armed at ``point``; no-op when nothing is armed.
-    This is the call production code plants at an injection site."""
+    This is the call production code plants at an injection site.
+    ``device`` attributes the call to one mesh device so per-device
+    fault shapes can single it out."""
     if not _active:  # fast path: chaos off
         return
     f = _active.get(point)
     if f is not None:
-        f.trip()
+        f.trip(device=device)
+
+
+def corrupt_verdicts(point: str, device: Optional[int], arr):
+    """Result-corruption hook: with ``corrupt-device:<idx>`` armed at
+    ``point`` and ``device`` matching, returns the verdict array
+    BIT-FLIPPED (and counts a fire); otherwise returns ``arr``
+    unchanged. Planted where the dispatch layer materializes device
+    verdicts — the silently-wrong-bits chip that hangs nothing and
+    raises nothing, detectable only by re-verifying results."""
+    if not _active:  # fast path: chaos off
+        return arr
+    f = _active.get(point)
+    if f is None or f.mode != "corrupt-device" or device is None or \
+            int(device) != int(f.arg):
+        return arr
+    with _lock:
+        f.calls += 1
+        f.fired += 1
+    return ~arr
 
 
 def is_active(point: str) -> bool:

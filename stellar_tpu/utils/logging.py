@@ -74,3 +74,30 @@ def set_log_level(partition: Optional[str], level) -> None:
             logging.getLogger(f"stellar_tpu.{p}").setLevel(level)
     else:
         logging.getLogger(f"stellar_tpu.{partition}").setLevel(level)
+
+
+def append_jsonl_capped(path: str, rec: dict,
+                        max_bytes: int = 4_000_000,
+                        keep: int = 1) -> None:
+    """Size-bounded JSONL append with rotation: when ``path`` would
+    grow past ``max_bytes``, shift ``path`` → ``path.1`` → ... →
+    ``path.<keep>`` (the oldest generation is dropped) before
+    appending. Evidence streams written by unattended daemons
+    (``DEVICE_PROBES.jsonl`` from ``tools/device_watch.py``) keep the
+    recent history without ever filling the disk."""
+    import json
+    import os
+    line = json.dumps(rec) + "\n"
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if size and size + len(line) > max_bytes:
+        for g in range(keep, 0, -1):
+            src = path if g == 1 else f"{path}.{g - 1}"
+            try:
+                os.replace(src, f"{path}.{g}")
+            except OSError:
+                pass  # missing generation: nothing to shift
+    with open(path, "a") as f:
+        f.write(line)

@@ -14,6 +14,12 @@ policy (what counts as a failure, what the fallback is) lives in
   execution budgets for calls whose observed failure mode is a HANG,
   not an exception (``jax.devices()`` / device-array fetches through a
   dead tunnel block forever).
+* :class:`WatchdogPool` — the persistent worker pool behind
+  :func:`call_with_deadline`: one short-lived thread per guarded call
+  (the PR 2 shape) cost a spawn per chunk fetch; the pool reuses a
+  small set of daemon workers and only spawns when every idle worker
+  is busy, so the steady-state guarded fetch is a queue hand-off, not
+  a thread start.
 """
 
 from __future__ import annotations
@@ -21,12 +27,13 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN",
     "CircuitBreaker", "Deadline", "DeadlineExceeded",
-    "call_with_deadline",
+    "WatchdogPool", "call_with_deadline", "watchdog_stats",
 ]
 
 CLOSED = "closed"
@@ -66,34 +73,111 @@ class Deadline:
                 f"{what}: {self.budget_s:.3f}s budget exhausted")
 
 
+class WatchdogPool:
+    """Persistent daemon-worker pool for deadline-guarded calls.
+
+    Invariants:
+
+    * a submitted job is picked up immediately — ``submit`` spawns a
+      fresh worker whenever the queue outnumbers idle workers, so a
+      guarded call never waits behind another caller's work;
+    * a worker whose job HANGS is simply absent from the idle set (it
+      is parked inside ``fn()``); capacity self-heals because the next
+      submit spawns, and if the hung call ever returns the worker
+      rejoins the pool on its own;
+    * at most ``max_idle`` workers linger between bursts — extras exit
+      once the queue drains, so a resolve storm doesn't leave a
+      thread-per-chunk residue (the pre-pool behavior).
+
+    All shared state (queue, idle/worker counts) mutates under the
+    pool's condition variable — the lock-discipline lint covers this
+    module.
+    """
+
+    def __init__(self, name: str = "watchdog", max_idle: int = 4):
+        self.name = name
+        self._max_idle = max_idle
+        self._cv = threading.Condition()
+        self._jobs: deque = deque()
+        self._idle = 0
+        self._workers = 0
+        self._spawned_total = 0
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._jobs:
+                    if self._idle >= self._max_idle:
+                        self._workers -= 1
+                        return
+                    self._idle += 1
+                    while not self._jobs:
+                        self._cv.wait()
+                    self._idle -= 1
+                job = self._jobs.popleft()
+            try:
+                job["box"]["out"] = job["fn"]()
+            except BaseException as e:  # re-raised on the caller's thread
+                job["box"]["err"] = e
+            finally:
+                job["done"].set()
+
+    def submit(self, fn: Callable) -> dict:
+        """Queue ``fn`` for a pool worker; returns the job record
+        (``done`` event + ``box`` result slot). Never blocks."""
+        job = {"fn": fn, "box": {}, "done": threading.Event()}
+        with self._cv:
+            self._jobs.append(job)
+            if self._idle >= len(self._jobs):
+                self._cv.notify()
+            else:
+                # every queued job beyond the idle set gets a fresh
+                # worker NOW — hung workers (absent from _idle) can
+                # never make a guarded call wait behind their hang
+                self._workers += 1
+                self._spawned_total += 1
+                threading.Thread(target=self._loop, daemon=True,
+                                 name=f"{self.name}-worker").start()
+        return job
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"workers": self._workers, "idle": self._idle,
+                    "queued": len(self._jobs),
+                    "spawned_total": self._spawned_total}
+
+
+# process-wide pool behind call_with_deadline (ROADMAP "pool the
+# resolve watchdog"): the verify resolve path guards one fetch per
+# chunk, so reuse beats a thread spawn per chunk
+_pool = WatchdogPool(name="resilience-watchdog")
+
+
+def watchdog_stats() -> dict:
+    """Observability: worker/idle/spawn accounting of the shared pool
+    (surfaced by ``batch_verifier.dispatch_health``)."""
+    return _pool.stats()
+
+
 def call_with_deadline(fn: Callable, budget_s: Optional[float],
                        name: str = "guarded-call"):
-    """Run ``fn()`` on a watchdog thread; raise :class:`DeadlineExceeded`
-    if it doesn't finish within ``budget_s`` (None = no guard, direct
-    call). Python cannot kill the worker: on timeout it is ABANDONED as
-    a daemon thread parked on whatever hung — callers must treat the
-    underlying resource as suspect afterwards (that is the circuit
-    breaker's job). An exception from ``fn`` is re-raised verbatim."""
+    """Run ``fn()`` on a pooled watchdog worker; raise
+    :class:`DeadlineExceeded` if it doesn't finish within ``budget_s``
+    (None = no guard, direct call). Python cannot kill the worker: on
+    timeout the job is ABANDONED — its worker stays parked on whatever
+    hung (and rejoins the pool by itself if the hang ever resolves) —
+    so callers must treat the underlying resource as suspect afterwards
+    (that is the circuit breaker's job). An exception from ``fn`` is
+    re-raised verbatim."""
     if budget_s is None:
         return fn()
     if budget_s <= 0:
         raise DeadlineExceeded(f"{name}: no budget left")
-    box: dict = {}
-    done = threading.Event()
-
-    def run():
-        try:
-            box["out"] = fn()
-        except BaseException as e:  # re-raised on the caller's thread
-            box["err"] = e
-        finally:
-            done.set()
-
-    t = threading.Thread(target=run, daemon=True, name=name)
-    t.start()
-    if not done.wait(budget_s):
+    job = _pool.submit(fn)
+    if not job["done"].wait(budget_s):
         raise DeadlineExceeded(
             f"{name} exceeded {budget_s:.3f}s budget")
+    box = job["box"]
     if "err" in box:
         raise box["err"]
     return box.get("out")
@@ -207,7 +291,11 @@ class CircuitBreaker:
             change = self._transition_locked(CLOSED)
         self._fire(change)
 
-    def record_failure(self) -> None:
+    def record_failure(self) -> bool:
+        """Returns True when THIS call transitioned the breaker to
+        ``open`` (computed under the lock, so concurrent failure
+        reports can't both claim the same onset — callers use it to
+        count quarantine onsets exactly once)."""
         change = None
         with self._lock:
             self._failures += 1
@@ -223,6 +311,24 @@ class CircuitBreaker:
                 change = self._transition_locked(OPEN)
                 self._arm_locked(now)
             # already OPEN: a straggler failure report; don't extend
+        self._fire(change)
+        return change is not None and change[1] == OPEN
+
+    def trip(self) -> None:
+        """Force the breaker OPEN immediately, regardless of the
+        failure streak — the hard-quarantine primitive. A
+        result-INTEGRITY violation (a device returning wrong bits, not
+        hanging) must not get ``threshold - 1`` more chances to decide
+        signature validity; from half-open the backoff doubles exactly
+        as a failed probe would."""
+        with self._lock:
+            now = self._clock()
+            self._failures = max(self._failures, self._threshold)
+            if self._state == HALF_OPEN:
+                self._backoff_cur = min(self._backoff_cur * self._factor,
+                                        self._backoff_max)
+            change = self._transition_locked(OPEN)
+            self._arm_locked(now)
         self._fire(change)
 
     def _arm_locked(self, now: float) -> None:

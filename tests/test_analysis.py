@@ -507,3 +507,17 @@ def test_lock_lint_scope_covers_threaded_modules():
     assert "stellar_tpu/utils/resilience.py" in scope
     assert "stellar_tpu/utils/metrics.py" in scope
     assert "tools/device_watch.py" in scope
+    # ISSUE 4: the per-device quarantine registry mutates shared state
+    # from dispatch threads and breaker callbacks — it must stay under
+    # lock-discipline enforcement
+    assert "stellar_tpu/parallel/device_health.py" in scope
+
+
+def test_nondet_lint_scope_covers_audit_sampler():
+    """ISSUE 4: the audit sampler and the quarantine registry gate
+    WHICH backend serves a consensus verdict — both must stay inside
+    the nondeterminism lint's scope so a clock/RNG can never sneak
+    into what replicas re-verify."""
+    scope = set(nondet.HOST_ORACLE_FILES)
+    assert "stellar_tpu/crypto/audit.py" in scope
+    assert "stellar_tpu/parallel/device_health.py" in scope

@@ -142,8 +142,11 @@ def test_sharded_mesh():
 
 def test_default_verifier_auto_shards():
     """default_verifier() spans every local device with no config
-    (VERDICT r2 #2): the sharded and unsharded kernels agree and each
-    device holds batch/n_devices rows."""
+    (VERDICT r2 #2): the per-device and single-device dispatches agree
+    and each device serves batch/n_devices rows. Since ISSUE 4 the
+    split happens at DISPATCH level (per-device sub-chunks of the
+    plain kernel, so each failure is attributable to one chip —
+    docs/robustness.md) rather than inside one shard_map call."""
     import jax
     import stellar_tpu.crypto.batch_verifier as bv
     devs = jax.devices()
@@ -155,23 +158,55 @@ def test_default_verifier_auto_shards():
     try:
         v = bv.default_verifier()
         assert v._mesh is not None and v._mesh.size == len(devs)
+        assert v._devices is not None and len(v._devices) == len(devs)
         items = [make_sig() for _ in range(20)]
         bad = bytearray(items[3][2])
         bad[0] ^= 1
         items[3] = (items[3][0], items[3][1], bytes(bad))
         got = v.verify_batch(items)
-        want = BatchVerifier().verify_batch(items)  # unsharded oracle
+        want = BatchVerifier().verify_batch(items)  # single-device oracle
         assert (got == want).all() and not got[3]
-        # the dispatched batch really is split 8 ways on device
+        # 20 rows pad to the 128-bucket: only the first two sub-chunks
+        # (16 rows each) carry real rows, and pure-padding sub-chunks
+        # are SKIPPED, not dispatched — a short batch deliberately
+        # touches few devices (and pays few per-device compiles)
         n = v._buckets[0]
-        aa = np.repeat(bv._PAD_A, n, 0)
-        rr = np.repeat(bv._PAD_R, n, 0)
-        ss = np.repeat(bv._PAD_S, n, 0)
-        hh = np.repeat(bv._PAD_H, n, 0)
-        out = v._kernel_for(n)(aa, rr, ss, hh)
-        shards = out.addressable_shards
-        assert len(shards) == len(devs)
-        assert all(s.data.shape[0] == n // len(devs) for s in shards)
+        sub = n // len(devs)
+        assert set(v.device_served) == {0, 1}
+        assert v.device_served[0] == sub and v.device_served[1] == 4
+        # the full-bucket dispatch really is split n_devices ways: one
+        # sub-chunk part per device, committed to that device. A cheap
+        # stand-in kernel keeps this a PLACEMENT check — the real
+        # kernel would cost one ~50s cold XLA compile per device here,
+        # and its multi-device decisions are already pinned above and
+        # by the fault-domain chaos suite
+        # must actually CONSUME the inputs: jit drops unused args, and
+        # a zero-input executable lands on the default device instead
+        # of following the committed operands
+        cheap = jax.jit(
+            lambda a, r, s, h: (a.sum(1) + r.sum(1) +
+                                s.sum(1) + h.sum(1)) < 0)
+        with v._kernels_lock:
+            saved_kernels = dict(v._kernels)
+            v._kernels[sub] = cheap
+        try:
+            aa = np.repeat(bv._PAD_A, n, 0)
+            rr = np.repeat(bv._PAD_R, n, 0)
+            ss = np.repeat(bv._PAD_S, n, 0)
+            hh = np.repeat(bv._PAD_H, n, 0)
+            (_sl, chunk, parts), = v._dispatch_device(aa, rr, ss, hh)
+            assert chunk == n and len(parts) == len(devs)
+            placements = set()
+            for lo, hi, di, arr in parts:
+                assert arr is not None and hi - lo == sub
+                dev, = arr.devices()
+                assert dev == v._devices[di]
+                placements.add(dev)
+            assert placements == set(devs)
+        finally:
+            with v._kernels_lock:
+                v._kernels.clear()
+                v._kernels.update(saved_kernels)
     finally:
         with bv._default_lock:
             bv._default = old

@@ -35,6 +35,7 @@ def chaos_sandbox():
     bv._reset_dispatch_state_for_testing()
     saved = (bv.DEADLINE_MS, bv.DISPATCH_RETRIES, bv._breaker._threshold,
              bv._breaker._backoff_min, bv._breaker._backoff_max)
+    saved_audit = bv.AUDIT_RATE
     # the default deadline stays GENEROUS: armed faults switch the
     # resolve watchdog on, and a legitimate first-execution fetch (XLA
     # persistent-cache load + exec on a loaded CI host) can take whole
@@ -49,7 +50,8 @@ def chaos_sandbox():
     # hard-coded copy of the defaults
     bv.configure_dispatch(deadline_ms=saved[0], dispatch_retries=saved[1],
                           failure_threshold=saved[2],
-                          backoff_min_s=saved[3], backoff_max_s=saved[4])
+                          backoff_min_s=saved[3], backoff_max_s=saved[4],
+                          audit_rate=saved_audit)
     bv._reset_dispatch_state_for_testing()
 
 
@@ -336,6 +338,20 @@ def test_nonblocking_probe_hang_never_caches_but_trips_breaker():
     assert bv._breaker.state == resilience.OPEN
 
 
+def test_host_only_flips_mid_resolve():
+    """Once the result-integrity posture flips host-only, parts of the
+    SAME batch that were already dispatched must be host re-verified
+    too — the batch that convicted the machine must not let device
+    bits decide its remaining rows."""
+    v = BatchVerifier(bucket_sizes=(8,))
+    items = make_valid(3)
+    resolver = v.submit(items)          # device arrays in flight
+    bv._enter_host_only("test: corruption proven elsewhere")
+    got = resolver()
+    assert got.all()
+    assert v.served == {"device": 0, "host-fallback": 3}
+
+
 def test_dispatch_health_shape():
     health = bv.dispatch_health()
     assert health["breaker"]["state"] == "closed"
@@ -343,3 +359,10 @@ def test_dispatch_health_shape():
     for key in ("deadline_ms", "dispatch_retries", "deadline_misses",
                 "retries", "short_circuits", "fallback_chunks"):
         assert key in health
+    # ISSUE 4 additions: integrity posture + per-device fault domains
+    assert health["host_only"] is False
+    assert set(health["audit"]) == {"rate", "sampled", "mismatches"}
+    assert set(health["device_health"]) == \
+        {"devices", "quarantined", "transitions_total"}
+    assert set(health["watchdog"]) >= {"workers", "idle",
+                                       "spawned_total"}

@@ -23,11 +23,25 @@ devs = jax.devices()
 x = jnp.ones((8, 8))
 y = jax.jit(lambda a: a + 1)(x)
 y.block_until_ready()
+# per-device dispatch: the fault domain is one chip, not the mesh
+# (docs/robustness.md) — probe EVERY device so the watcher's
+# per-device breakers see which chips answered, not just chip 0
+per_dev = []
+for i, d in enumerate(devs):
+    t1 = time.time()
+    try:
+        jax.jit(lambda a: a + 1)(jax.device_put(x, d)).block_until_ready()
+        per_dev.append({"index": i, "ok": True,
+                        "probe_s": round(time.time() - t1, 3)})
+    except Exception as e:
+        per_dev.append({"index": i, "ok": False,
+                        "error": str(e)[:120]})
 print(json.dumps({
     "platform": devs[0].platform,
     "n_devices": len(devs),
     "device": str(devs[0]),
     "probe_s": round(time.time() - t0, 3),
+    "devices": per_dev,
 }))
 """
 
@@ -94,7 +108,10 @@ def probe(timeout_s: int = 90) -> dict:
 
 if __name__ == "__main__":
     rec = probe(int(sys.argv[1]) if len(sys.argv) > 1 else 90)
-    with open(LOG, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    sys.path.insert(0, REPO)
+    from stellar_tpu.utils.logging import append_jsonl_capped
+    # size-capped append: an unattended probe loop must never fill
+    # the disk (rotated generation keeps the older history)
+    append_jsonl_capped(LOG, rec)
     print(json.dumps(rec))
     sys.exit(0 if rec["alive"] else 3)

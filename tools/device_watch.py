@@ -100,17 +100,27 @@ PROBE_TIMEOUT_S = 60
 
 
 def run_probe():
-    """(alive, rc, probe_latency_s). ``tools/device_probe.py`` appends
-    its own record to DEVICE_PROBES.jsonl; the latency measured HERE
-    wraps the whole subprocess (interpreter + jax import + dispatch) —
-    the number a breaker-paced operator actually waits."""
+    """(alive, rc, probe_latency_s, per_device). ``tools/device_probe.py``
+    appends its own record to DEVICE_PROBES.jsonl; the latency measured
+    HERE wraps the whole subprocess (interpreter + jax import +
+    dispatch) — the number a breaker-paced operator actually waits.
+    ``per_device`` is the probe's per-device result list (``[]`` when
+    the probe died before answering), which feeds the watcher's
+    per-device breakers."""
     t0 = time.monotonic()
+    per_device = []
     try:
-        rc, _o, _e = _run_group(
+        rc, so, _e = _run_group(
             [sys.executable, PROBE, str(PROBE_TIMEOUT_S)], 150)
+        if so.strip():
+            try:
+                per_device = json.loads(
+                    so.strip().splitlines()[-1]).get("devices", [])
+            except ValueError:
+                pass
     except subprocess.TimeoutExpired:
         rc = "timeout"
-    return rc == 0, rc, round(time.monotonic() - t0, 3)
+    return rc == 0, rc, round(time.monotonic() - t0, 3), per_device
 
 
 def capture_json(cmd, prefix, ts, describe):
@@ -195,23 +205,60 @@ def _analyze_trace(trace_stdout, ts):
             log("trace analysis timed out")
 
 
+# Flap guard (breaker-state history feeding capture decisions): round
+# 4's window was alive ~2 minutes and died mid-capture. When the
+# tunnel's recent transition history shows flapping, demand extra
+# consecutive alive probes before burning a bench/trace window on it.
+FLAP_WINDOW_S = 1800.0
+FLAP_LIMIT = 4          # transitions within the window => "flapping"
+STABLE_ALIVE_PROBES = 2  # consecutive alive probes required while flapping
+
+
+def is_flapping(transitions, now_monotonic):
+    """True when the tunnel's breaker history shows FLAP_LIMIT or more
+    state transitions within the last FLAP_WINDOW_S — the r4 shape
+    where a capture started in a 2-minute window is wasted work."""
+    recent = [t for t in transitions
+              if now_monotonic - t["mono"] <= FLAP_WINDOW_S]
+    return len(recent) >= FLAP_LIMIT
+
+
 def main():
     log("device watcher started")
+    from stellar_tpu.parallel.device_health import DeviceHealth
     from stellar_tpu.utils import resilience
+    from stellar_tpu.utils.logging import append_jsonl_capped
 
     # breaker-state transitions land in DEVICE_PROBES.jsonl alongside
     # the per-probe records (same {ts, alive, rc, timeout_s} schema +
-    # probe_latency_s + the transition), so tunnel-health history and
-    # the watcher's reaction to it live in one provable stream
+    # probe_latency_s + the transition + per-device breaker states),
+    # so tunnel-health history and the watcher's reaction to it live
+    # in one provable, size-capped stream
     last = {"alive": False, "rc": None, "latency_s": None}
+    transitions = []  # {"mono": monotonic_ts, "change": "old->new"}
+
+    # per-device fault domains: the probe reports every chip, and one
+    # sick chip must not look like a dead tunnel (nor hide behind a
+    # healthy chip 0) — its own breaker tracks it across probes
+    devices = DeviceHealth(failure_threshold=2,
+                           backoff_min_s=PROBE_PERIOD_DEAD_S,
+                           backoff_max_s=PROBE_PERIOD_ALIVE_S)
+
+    def device_states():
+        snap = devices.snapshot()
+        return {idx: d["state"] for idx, d in snap["devices"].items()}
 
     def on_transition(old, new):
+        transitions.append({"mono": time.monotonic(),
+                            "change": f"{old}->{new}"})
+        del transitions[:-64]  # bounded history
         rec = {"ts": now().isoformat(), "alive": last["alive"],
                "rc": last["rc"], "timeout_s": PROBE_TIMEOUT_S,
                "probe_latency_s": last["latency_s"],
-               "breaker": f"{old}->{new}"}
-        with open(PROBES_LOG, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+               "breaker": f"{old}->{new}",
+               "recent_transitions": len(transitions),
+               "devices": device_states()}
+        append_jsonl_capped(PROBES_LOG, rec)
         log(f"breaker {old} -> {new}")
 
     # backoff bounds double as the probe cadence: dead-window pacing
@@ -222,22 +269,41 @@ def main():
         backoff_min_s=PROBE_PERIOD_DEAD_S,
         backoff_max_s=PROBE_PERIOD_ALIVE_S,
         on_transition=on_transition)
+    consec_alive = 0
     while True:
         try:
             if not breaker.allow():
                 time.sleep(min(PROBE_PERIOD_DEAD_S,
                                breaker.seconds_until_retry() + 1))
                 continue
-            alive, rc, latency_s = run_probe()
+            alive, rc, latency_s, per_device = run_probe()
             last.update(alive=alive, rc=rc, latency_s=latency_s)
+            for d in per_device:
+                if d.get("ok"):
+                    devices.record_success(int(d["index"]))
+                else:
+                    devices.record_failure(int(d["index"]))
             if alive:
                 breaker.record_success()
+                consec_alive += 1
+                # capture decision rides the transition history: a
+                # flapping tunnel must prove stability first, so a
+                # 2-minute window isn't burned on a doomed bench run
+                if is_flapping(transitions, time.monotonic()) and \
+                        consec_alive < STABLE_ALIVE_PROBES:
+                    log(f"device alive but tunnel is flapping "
+                        f"({len(transitions)} recent transitions) - "
+                        f"waiting for {STABLE_ALIVE_PROBES} stable "
+                        f"probes (have {consec_alive})")
+                    time.sleep(PROBE_PERIOD_DEAD_S)
+                    continue
                 log("device ALIVE - capturing window")
                 ok = capture_window()
                 time.sleep(PROBE_PERIOD_ALIVE_S if ok
                            else PROBE_PERIOD_DEAD_S)
             else:
                 breaker.record_failure()
+                consec_alive = 0
                 time.sleep(PROBE_PERIOD_DEAD_S)
         except Exception as e:  # never die silently mid-round
             log(f"watcher iteration failed: {e!r}")
