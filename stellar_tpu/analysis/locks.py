@@ -42,12 +42,15 @@ __all__ = ["run", "lint_source", "SCOPE", "ALLOWLIST"]
 
 # The threaded modules: verify dispatch, resilience primitives (incl.
 # the watchdog pool), the per-device health registry, the metrics
-# registry they all mark into, and the device-watch daemon.
+# registry they all mark into (reservoir replacement is an RMW), the
+# tracing layer's flight-recorder ring (marked from resolver, pool
+# worker and breaker-callback threads), and the device-watch daemon.
 SCOPE = [
     "stellar_tpu/crypto/batch_verifier.py",
     "stellar_tpu/parallel/device_health.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
+    "stellar_tpu/utils/tracing.py",
     "tools/device_watch.py",
 ]
 

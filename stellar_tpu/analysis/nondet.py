@@ -28,7 +28,7 @@ from stellar_tpu.analysis.lint_base import (
 from stellar_tpu.utils.toml_compat import _strip_comment
 
 __all__ = ["run", "lint_source", "CONSENSUS_DIRS", "HOST_ORACLE_FILES",
-           "ALLOWLIST", "BANNED"]
+           "ALLOWLIST", "BANNED", "TRACING_SANCTIONED"]
 
 # packages whose behavior must be bit-identical across nodes
 CONSENSUS_DIRS = ["stellar_tpu/scp", "stellar_tpu/ledger",
@@ -71,7 +71,9 @@ BANNED = [
     ("secrets", re.compile(
         r"\bsecrets\.(token_bytes|randbits|randbelow)\b"),
      "CSPRNG output must not influence consensus state"),
-    ("clock", re.compile(r"\btime\.time\(\)|\btime\.monotonic\(\)"),
+    ("clock", re.compile(
+        r"\btime\.time\(\)|\btime\.monotonic\(\)|"
+        r"\btime\.perf_counter\(\)"),
      "wall/monotonic clock reads diverge between nodes"),
     ("wallclock", re.compile(
         r"\bdatetime\.now\(\)|\bdatetime\.utcnow\(\)"),
@@ -81,11 +83,99 @@ BANNED = [
      "builtin hash() is salted per-process (PYTHONHASHSEED)"),
 ]
 
+# ---------------- tracing fence (ISSUE 5) ----------------
+# stellar_tpu/utils/tracing.py is clock-bearing BY DESIGN (perf_counter
+# pairs, span records, the flight recorder). Consensus/host-oracle
+# modules may use only its duration-blind context managers — zone/span
+# etc. time a scope but never EXPOSE a duration to the caller, so their
+# clock reads cannot influence a decision. Importing the module itself
+# (or any other name, e.g. ``flight_recorder`` or ``span_totals``)
+# would hand consensus code readable clock state and is banned.
+TRACING_SANCTIONED = frozenset({
+    "zone", "span", "LogSlowExecution", "current_zones", "frame_mark",
+})
+
+_TRACING_MODULE = re.compile(
+    r"^\s*import\s+stellar_tpu\.utils\.tracing\b")
+# from stellar_tpu.utils import a, (tracing), ... — names checked
+# after paren accumulation, so the parenthesized spelling can't slip
+# the module in
+_UTILS_FROM = re.compile(
+    r"^\s*from\s+stellar_tpu\.utils\s+import\s+(.*)$")
+_TRACING_FROM = re.compile(
+    r"^\s*from\s+stellar_tpu\.utils\.tracing\s+import\s+(.*)$")
+
+
+def _lint_tracing_imports(text: str, rel: str) -> List[Finding]:
+    """Fence tracing out of consensus modules: only the sanctioned
+    duration-blind names may be imported. Handles parenthesized
+    multi-line from-imports (the ``ledger_manager`` spelling)."""
+    out: List[Finding] = []
+
+    def emit(lineno: int, what: str):
+        out.append(Finding(
+            file=rel, line=lineno, rule="nondet", symbol="tracing-import",
+            message=f"{what} — tracing is clock-bearing by design; "
+                    "consensus modules may import only its "
+                    "duration-blind context managers "
+                    f"({', '.join(sorted(TRACING_SANCTIONED))})"))
+
+    lines = text.splitlines()
+
+    def gather_names(first: str, i: int) -> tuple:
+        """Imported names of one from-import, accumulating BOTH
+        continuation spellings — parenthesized and backslash-continued
+        lines; returns (names, next_i)."""
+        src = first
+        while i + 1 < len(lines) and (
+                ("(" in src and ")" not in src)
+                or src.rstrip().endswith("\\")):
+            i += 1
+            src = src.rstrip().rstrip("\\") + " " + \
+                _strip_comment(lines[i])
+        names = [tok.split(" as ")[0].strip()
+                 for tok in src.replace("(", " ").replace(")", " ")
+                 .replace("\\", " ").split(",")]
+        return [nm for nm in names if nm], i
+
+    i = 0
+    while i < len(lines):
+        lineno = i + 1
+        line = _strip_comment(lines[i])
+        if _TRACING_MODULE.match(line):
+            emit(lineno, "module-level tracing import")
+            i += 1
+            continue
+        m = _TRACING_FROM.match(line)
+        if m is not None:
+            names, i = gather_names(m.group(1), i)
+            bad = [nm for nm in names
+                   if nm not in TRACING_SANCTIONED]
+            if bad:
+                emit(lineno, "import of non-sanctioned tracing "
+                             f"names {bad}")
+            i += 1
+            continue
+        m = _UTILS_FROM.match(line)
+        if m is not None:
+            names, i = gather_names(m.group(1), i)
+            if "tracing" in names:
+                emit(lineno, "module-level tracing import")
+        i += 1
+    return out
+
 ALLOWLIST = Allowlist({
     # (the seed's allowlist carried a stale tx_test_utils.py entry for
     # secrets.token_bytes — the code it excused is gone; the framework
     # now fails on stale entries, which is how it surfaced)
     "stellar_tpu/crypto/keys.py": {
+        "nondet:clock":
+            "sign_ops_per_second/verify_ops_per_second mirror the "
+            "reference's SecretKey::benchmarkOpsPerSecond "
+            "(SecretKey.cpp:193-233): perf_counter pairs measuring a "
+            "benchmark loop's own wall time, returned to operators/"
+            "bench tooling only — no verify decision or ledger state "
+            "ever reads them.",
         "nondet:os.urandom":
             "SecretKey.random()/PublicKey generation: key MATERIAL, "
             "not consensus state — randomness here is the whole point "
@@ -139,7 +229,7 @@ def _lint_lines(text: str, rel: str) -> List[Finding]:
 
 def lint_source(src: str, rel: str) -> List[Finding]:
     """Lint one source text (unit-test hook)."""
-    return _lint_lines(src, rel)
+    return _lint_lines(src, rel) + _lint_tracing_imports(src, rel)
 
 
 def run(allowlist: Optional[Allowlist] = None) -> LintReport:
@@ -150,5 +240,7 @@ def run(allowlist: Optional[Allowlist] = None) -> LintReport:
     for path in walk_py(CONSENSUS_DIRS + HOST_ORACLE_FILES, root):
         rel = str(path.relative_to(root))
         files += 1
-        findings.extend(_lint_lines(path.read_text(), rel))
+        text = path.read_text()
+        findings.extend(_lint_lines(text, rel))
+        findings.extend(_lint_tracing_imports(text, rel))
     return finish_report("nondet", files, findings, allowlist)

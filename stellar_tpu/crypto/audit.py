@@ -21,9 +21,9 @@ node, in every process. No clocks, no RNG state, no hash salts.
 from __future__ import annotations
 
 import hashlib
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
-__all__ = ["sample_indices", "sample_rows"]
+__all__ = ["sample_indices", "sample_rows", "verdict_record"]
 
 
 def sample_indices(material: bytes, n: int, rate: float) -> List[int]:
@@ -79,3 +79,17 @@ def sample_rows(material: bytes, eligible_rows: Sequence[int],
     """
     picks = sample_indices(material, len(eligible_rows), rate)
     return [eligible_rows[p] for p in picks]
+
+
+def verdict_record(device: Optional[int], lo: int, hi: int,
+                   sampled: int, ok: bool) -> dict:
+    """The evidence shape of one audit verdict, shared by the flight
+    recorder's ``verify.audit.verdict`` events and the fault-domain
+    payload of ``MULTICHIP_r*`` captures (``tools/multichip_bench.py``)
+    — one definition so both streams stay comparable. Pure data: no
+    clocks, no RNG (this module is in the nondet-lint scope; consumers
+    that need timestamps stamp their own)."""
+    return {"device": -1 if device is None else int(device),
+            "rows": [int(lo), int(hi)],
+            "sampled": int(sampled),
+            "ok": bool(ok)}

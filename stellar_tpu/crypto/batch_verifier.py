@@ -77,11 +77,12 @@ from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.crypto import ed25519_ref as ref
 from stellar_tpu.crypto import native_prep
 from stellar_tpu.parallel import device_health
-from stellar_tpu.utils import faults, resilience
+from stellar_tpu.utils import faults, resilience, tracing
 from stellar_tpu.utils.metrics import registry
 
 __all__ = ["BatchVerifier", "default_verifier", "device_available",
-           "dispatch_health", "configure_dispatch"]
+           "dispatch_health", "configure_dispatch",
+           "dispatch_attribution", "RESOLVE_PHASES", "RESOLVE_ROOT"]
 
 _L = ref.L
 _P = ref.P
@@ -114,10 +115,61 @@ DEFAULT_BUCKET_SIZES = (128, 512, 2048, 4096, 8192, 16384)
 _log = logging.getLogger("stellar_tpu.crypto")
 
 
+# ---------------- resolve flight-recorder phases (ISSUE 5) ----------------
+# Every phase of a blocking verify is a span; the phases are DISJOINT
+# wall-time intervals under the RESOLVE_ROOT span, so summing their
+# timer deltas attributes the blocking headline ("relay = X ms, device
+# compute = Y ms, fetch = Z ms" — docs/observability.md). The next
+# dispatch-floor PR starts from this breakdown, not one opaque number.
+RESOLVE_PHASES = ("verify.prep", "verify.bucket", "verify.dispatch",
+                  "verify.fetch", "verify.audit", "verify.host_fallback")
+RESOLVE_ROOT = "verify.blocking"
+
+
+def dispatch_attribution(before: dict, after: dict, reps: int = 1) -> dict:
+    """Per-phase dispatch attribution from span-timer deltas.
+
+    ``before``/``after`` are :func:`stellar_tpu.utils.tracing.
+    span_totals` snapshots taken around the measured resolves. EVERY
+    phase is reported (zero-count phases included), so a dead-tunnel
+    record still carries the complete breakdown; ``coverage`` is the
+    phase-sum over the blocking root span's time — the reconciliation
+    the bench record asserts (>= 0.95 means the breakdown explains the
+    headline, not a fraction of it)."""
+    def delta(name):
+        key = f"span.{name}"
+        b = before.get(key, {"count": 0, "sum_ms": 0.0})
+        a = after.get(key, {"count": 0, "sum_ms": 0.0})
+        return a["count"] - b["count"], a["sum_ms"] - b["sum_ms"]
+
+    reps = max(1, int(reps))
+    phases = {}
+    phase_sum = 0.0
+    for name in RESOLVE_PHASES:
+        c, s = delta(name)
+        phases[name] = {"count": c, "total_ms": round(s, 3),
+                        "per_rep_ms": round(s / reps, 4)}
+        phase_sum += s
+    root_count, root_sum = delta(RESOLVE_ROOT)
+    coverage = (phase_sum / root_sum) if root_sum > 0 else None
+    return {
+        "phases": phases,
+        "span_sum_per_rep_ms": round(phase_sum / reps, 4),
+        "blocking_span_per_rep_ms": round(root_sum / reps, 4),
+        "blocking_span_count": root_count,
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "reps": reps,
+    }
+
+
 def _on_breaker_transition(old: str, new: str) -> None:
     registry.counter("crypto.verify.breaker.transitions").inc()
     registry.gauge("crypto.verify.breaker.state").set(new)
     _log.warning("verify-device breaker %s -> %s", old, new)
+    if new == resilience.OPEN:
+        # flight-recorder trigger: the spans leading into the trip
+        # must survive to be read (docs/observability.md)
+        tracing.flight_recorder.dump("breaker-open:verify-device")
 
 
 _breaker = resilience.CircuitBreaker(
@@ -228,6 +280,7 @@ def dispatch_health() -> dict:
         },
         "device_health": device_health.get().snapshot(),
         "watchdog": resilience.watchdog_stats(),
+        "flight_recorder": tracing.flight_recorder.stats(),
     }
 
 
@@ -251,6 +304,7 @@ def _note_device_failure(stage: str, exc: BaseException,
         # global threshold, and short-circuits the remaining chunks —
         # bounding the outage at global_threshold quarantines instead
         # of n_devices independent ones
+        tracing.flight_recorder.dump(f"quarantine:device{dev_idx}")
         _breaker.record_failure()
     _log.warning(
         "device%s %s failed (%s: %s) — affected rows re-verified on "
@@ -279,10 +333,14 @@ def _fetch(dev, dev_idx: Optional[int] = None) -> np.ndarray:
     """The blocking half of a dispatch (runs under the watchdog).
     ``dev_idx`` attributes the fetch to one mesh device for per-device
     chaos faults — including verdict corruption, applied here so the
-    wrong bits flow through exactly the path real corruption would."""
-    faults.inject(faults.RESOLVE, device=dev_idx)
-    arr = np.asarray(dev)
-    return faults.corrupt_verdicts(faults.RESOLVE, dev_idx, arr)
+    wrong bits flow through exactly the path real corruption would.
+    The span opens on the POOL WORKER with the submitter's propagated
+    context, so a fetch that hangs appears OPEN in a flight-recorder
+    dump, parent-linked to the resolve that dispatched it."""
+    with tracing.span("verify.fetch.device", device=dev_idx):
+        faults.inject(faults.RESOLVE, device=dev_idx)
+        arr = np.asarray(dev)
+        return faults.corrupt_verdicts(faults.RESOLVE, dev_idx, arr)
 
 
 def _host_verify_items(items: Sequence[tuple]) -> np.ndarray:
@@ -447,6 +505,13 @@ class BatchVerifier:
         # never dispatched)
         n_parts = min(n_dev, -(-chunk // sub))
         assignment = device_health.get().assign_parts(n_dev, n_parts)
+        if assignment != list(range(n_parts)):
+            # degraded-mesh re-shard decision: record WHO serves WHAT
+            # (or None = host fallback) so a dump of a degraded window
+            # shows the assignment that produced its latencies
+            tracing.flight_recorder.note(
+                "verify.reshard", assignment=list(assignment),
+                parts=n_parts, devices=n_dev)
         parts = []
         for j, di in enumerate(assignment):
             lo = j * sub
@@ -486,10 +551,23 @@ class BatchVerifier:
             b = self._bucket(chunk)
             pad = b - chunk
             sl = slice(start, start + chunk)
-            aa = np.concatenate([a[sl], np.repeat(_PAD_A, pad, 0)])
-            rr = np.concatenate([r[sl], np.repeat(_PAD_R, pad, 0)])
-            ss = np.concatenate([s[sl], np.repeat(_PAD_S, pad, 0)])
-            hh = np.concatenate([h[sl], np.repeat(_PAD_H, pad, 0)])
+
+            def _padded_inputs():
+                # built ONLY for chunks that will actually dispatch:
+                # a host-only or breaker-refused chunk must not pay
+                # 4x bucket-sized copies it never reads (nor charge
+                # them to the bucket phase of the attribution)
+                with tracing.span("verify.bucket"):
+                    return (
+                        np.concatenate([a[sl],
+                                        np.repeat(_PAD_A, pad, 0)]),
+                        np.concatenate([r[sl],
+                                        np.repeat(_PAD_R, pad, 0)]),
+                        np.concatenate([s[sl],
+                                        np.repeat(_PAD_S, pad, 0)]),
+                        np.concatenate([h[sl],
+                                        np.repeat(_PAD_H, pad, 0)]))
+
             if host_only:
                 # integrity posture: no device dispatch at all
                 parts = [[0, chunk, None, None]]
@@ -500,14 +578,18 @@ class BatchVerifier:
                 # and short-circuits whole chunks; its half-open grant
                 # admits one chunk as the recovery probe
                 if _breaker.allow():
-                    parts = self._dispatch_parts(aa, rr, ss, hh, b,
-                                                 chunk)
+                    aa, rr, ss, hh = _padded_inputs()
+                    with tracing.span("verify.dispatch", devices=True):
+                        parts = self._dispatch_parts(aa, rr, ss, hh, b,
+                                                     chunk)
                 else:
                     registry.counter(
                         "crypto.verify.dispatch.short_circuit").inc()
                     parts = [[0, chunk, None, None]]
             elif _breaker.allow():
-                arr = self._dispatch_one(aa, rr, ss, hh, b, None)
+                aa, rr, ss, hh = _padded_inputs()
+                with tracing.span("verify.dispatch"):
+                    arr = self._dispatch_one(aa, rr, ss, hh, b, None)
                 parts = [[0, chunk, None, arr]]
             else:
                 registry.counter(
@@ -520,8 +602,9 @@ class BatchVerifier:
     # ---------------- public API ----------------
 
     def _prep(self, items: Sequence[tuple]):
-        from stellar_tpu.utils.tracing import zone
-        with zone("crypto.prep"):
+        # host-side prep phase: byte recode into the on-wire matrices,
+        # SHA-512(R||A||M) mod L, and the policy gates
+        with tracing.span("verify.prep"):
             return self._prep_inner(items)
 
     def _prep_inner(self, items: Sequence[tuple]):
@@ -579,7 +662,8 @@ class BatchVerifier:
         pending = self._dispatch_device(a, r, s, h)
         items = list(items)  # pinned for possible host re-verification
 
-        def _audit_part(vals: np.ndarray, gl: int, gh: int) -> bool:
+        def _audit_part(vals: np.ndarray, gl: int, gh: int,
+                        di: Optional[int]) -> bool:
             """Sampled result-integrity audit of one device-served
             part (global rows ``gl:gh``): re-verify a content-seeded
             sample through the host oracle and compare against the
@@ -589,19 +673,31 @@ class BatchVerifier:
             a gate-rejected row is False regardless of device bits, so
             auditing it would be vacuous (and a predictable blind
             spot). True = clean (or nothing to audit)."""
-            material = (a[gl:gh].tobytes() + r[gl:gh].tobytes() +
-                        s[gl:gh].tobytes() + h[gl:gh].tobytes())
-            eligible = [i for i in range(gh - gl) if ok[gl + i]]
-            idxs = audit_mod.sample_rows(material, eligible, AUDIT_RATE)
-            if not idxs:
-                return True
-            registry.counter("crypto.verify.audit.sampled").inc(
-                len(idxs))
-            want = _host_verify_items([items[gl + i] for i in idxs])
-            got_comp = np.array([bool(vals[i]) for i in idxs])
-            return bool((want == got_comp).all())
+            with tracing.span("verify.audit", device=di):
+                material = (a[gl:gh].tobytes() + r[gl:gh].tobytes() +
+                            s[gl:gh].tobytes() + h[gl:gh].tobytes())
+                eligible = [i for i in range(gh - gl) if ok[gl + i]]
+                idxs = audit_mod.sample_rows(material, eligible,
+                                             AUDIT_RATE)
+                if not idxs:
+                    return True
+                registry.counter("crypto.verify.audit.sampled").inc(
+                    len(idxs))
+                want = _host_verify_items([items[gl + i] for i in idxs])
+                got_comp = np.array([bool(vals[i]) for i in idxs])
+                clean = bool((want == got_comp).all())
+            # verdict lands in both evidence streams: the per-device
+            # health registry (MULTICHIP fault-domain evidence) and
+            # the flight recorder (visible in dumps near the spans)
+            device_health.get().note_audit(di, ok=clean,
+                                           sampled=len(idxs))
+            tracing.flight_recorder.note(
+                "verify.audit.verdict",
+                **audit_mod.verdict_record(di, gl, gh, len(idxs),
+                                           clean))
+            return clean
 
-        def resolve() -> np.ndarray:
+        def _resolve_impl() -> np.ndarray:
             out = np.zeros(n, dtype=bool)
             for sl, chunk, parts in pending:
                 for lo, hi, di, arr in parts:
@@ -623,21 +719,33 @@ class BatchVerifier:
                         gate = _breaker if di is None else \
                             device_health.get().breaker(di)
                         if gate.state != resilience.OPEN:
-                            try:
-                                got = resilience.call_with_deadline(
-                                    lambda d=arr, i=di: _fetch(d, i),
-                                    _resolve_budget_s(),
-                                    name="verify-resolve")
-                            except resilience.DeadlineExceeded as e:
-                                registry.counter(
-                                    "crypto.verify.dispatch."
-                                    "deadline_miss").inc()
-                                with self._stats_lock:
-                                    self.deadline_misses += 1
-                                _note_device_failure(
-                                    "resolve-deadline", e, di)
-                            except Exception as e:
-                                _note_device_failure("resolve", e, di)
+                            # the fetch span covers the whole
+                            # fetch/deadline race; a trip dumps while
+                            # it (and the worker-side device span) are
+                            # still open, so the dump shows exactly
+                            # where the hang is parked
+                            with tracing.span("verify.fetch",
+                                              device=di):
+                                try:
+                                    got = resilience.call_with_deadline(
+                                        lambda d=arr, i=di:
+                                        _fetch(d, i),
+                                        _resolve_budget_s(),
+                                        name="verify-resolve")
+                                except resilience.DeadlineExceeded as e:
+                                    registry.counter(
+                                        "crypto.verify.dispatch."
+                                        "deadline_miss").inc()
+                                    with self._stats_lock:
+                                        self.deadline_misses += 1
+                                    _note_device_failure(
+                                        "resolve-deadline", e, di)
+                                    tracing.flight_recorder.dump(
+                                        "watchdog-timeout:device"
+                                        f"{'-global' if di is None else di}")
+                                except Exception as e:
+                                    _note_device_failure(
+                                        "resolve", e, di)
                         else:
                             registry.counter(
                                 "crypto.verify.dispatch."
@@ -645,7 +753,7 @@ class BatchVerifier:
                     gl, gh = sl.start + lo, sl.start + hi
                     if got is not None:
                         vals = np.asarray(got)[:hi - lo]
-                        if not _audit_part(vals, gl, gh):
+                        if not _audit_part(vals, gl, gh, di):
                             # wrong bits: hard-quarantine the chip,
                             # stop trusting the accelerator path, and
                             # re-verify the whole part on the host —
@@ -659,6 +767,8 @@ class BatchVerifier:
                                     di, reason="audit-mismatch")
                             else:
                                 _breaker.trip()
+                            tracing.flight_recorder.dump(
+                                f"audit-mismatch:device{di}")
                             _enter_host_only(
                                 "result-integrity audit mismatch on "
                                 f"device {di}")
@@ -685,16 +795,27 @@ class BatchVerifier:
                         # failover: bit-identical host re-verification
                         # of the affected rows (latency changes,
                         # decisions never do)
-                        out[gl:gh] = _host_verify_items(items[gl:gh])
+                        with tracing.span("verify.host_fallback",
+                                          device=di):
+                            out[gl:gh] = _host_verify_items(
+                                items[gl:gh])
                         self._mark_served("host-fallback", hi - lo)
             return ok & out
+
+        def resolve() -> np.ndarray:
+            with tracing.span("verify.resolve"):
+                return _resolve_impl()
 
         return resolve
 
     def verify_batch(self, items: Sequence[tuple]) -> np.ndarray:
         """items: sequence of (pk: bytes, msg: bytes, sig: bytes).
-        Returns bool array, libsodium-identical per item."""
-        return self.submit(items)()
+        Returns bool array, libsodium-identical per item. The root
+        span covers the whole blocking call, so the per-phase spans
+        under it attribute the blocking headline
+        (:func:`dispatch_attribution`)."""
+        with tracing.span(RESOLVE_ROOT):
+            return self.submit(items)()
 
     def verify_sig(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         """Single verify (uncached — the process-wide result cache lives

@@ -301,6 +301,16 @@ class Application:
             from stellar_tpu.utils import metrics as metrics_mod
             metrics_mod.WINDOW_SECONDS = \
                 float(config.HISTOGRAM_WINDOW_SIZE)
+        if changed("METRICS_RESERVOIR_SIZE"):
+            from stellar_tpu.utils import metrics as metrics_mod
+            # read at update time, so pushing before traffic starts
+            # sizes every timer's percentile reservoir
+            metrics_mod.RESERVOIR_SIZE = \
+                int(config.METRICS_RESERVOIR_SIZE)
+        if changed("FLIGHT_RECORDER_SPANS"):
+            from stellar_tpu.utils import tracing
+            tracing.flight_recorder.configure(
+                capacity=config.FLIGHT_RECORDER_SPANS)
         if changed("ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING"):
             from stellar_tpu.bucket import bucket_list as bl_mod
             bl_mod.REDUCE_MERGE_COUNTS = \

@@ -13,6 +13,13 @@ from urllib.parse import parse_qs, urlparse
 __all__ = ["CommandHandler"]
 
 
+class _TextResponse(str):
+    """Marker type: a route result served verbatim as ``text/plain``
+    (the Prometheus exposition) instead of being JSON-encoded."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"
+
+
 def _submit_status(res) -> dict:
     """Uniform tx-submission status JSON (tx + testtx routes):
     AddResult code by NAME, plus the inner result code on rejection."""
@@ -80,8 +87,35 @@ class CommandHandler:
         return self._on_main(self.app.info)
 
     def cmd_metrics(self, params):
+        """Registry export: JSON by default; ``metrics?format=
+        prometheus`` serves the text exposition (reference
+        ``docs/metrics.md`` — medida behind the HTTP endpoint). The
+        Prometheus form is served directly: scrapers poll it on a
+        cadence, the registry is lock-protected module state, and a
+        wedged main thread must not take the node's last observability
+        surface down with it (same policy as ``dispatch``)."""
         from stellar_tpu.utils.metrics import registry
+        if params.get("format", ["json"])[0] == "prometheus":
+            return _TextResponse(registry.to_prometheus())
         return self._on_main(registry.to_dict)
+
+    def cmd_spans(self, params):
+        """Flight-recorder surface (docs/observability.md): open
+        spans, recent completed spans, and failure dumps (breaker
+        trips / audit mismatches / watchdog timeouts). Served directly
+        — the recorder exists to explain a wedged main thread, so it
+        must stay readable when one is wedged. ``spans?dumps=true``
+        returns the full dump payloads; ``limit=N`` bounds the recent
+        window."""
+        from stellar_tpu.utils import tracing
+        try:
+            limit = int(params.get("limit", ["128"])[0])
+        except ValueError:
+            return {"error": "bad limit param"}
+        out = tracing.flight_recorder.snapshot(limit=limit)
+        if params.get("dumps", ["false"])[0] == "true":
+            out["dumps"] = tracing.flight_recorder.dumps()
+        return out
 
     def cmd_dispatch(self, params):
         """Verify-dispatch resilience surface: breaker state, backend
@@ -557,7 +591,7 @@ class CommandHandler:
 
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
-        "dispatch": cmd_dispatch,
+        "dispatch": cmd_dispatch, "spans": cmd_spans,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,
@@ -592,14 +626,19 @@ class CommandHandler:
                     self.end_headers()
                     self.wfile.write(b'{"error": "unknown command"}')
                     return
+                ctype = "application/json"
                 try:
                     out = fn(outer_self, parse_qs(parsed.query))
-                    body = json.dumps(out).encode()
+                    if isinstance(out, _TextResponse):
+                        body = out.encode()
+                        ctype = out.content_type
+                    else:
+                        body = json.dumps(out).encode()
                     self.send_response(200)
                 except Exception as e:
                     body = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.end_headers()
                 self.wfile.write(body)
         return Handler

@@ -199,6 +199,13 @@ class Config:
     # sliding-window length (seconds) for timer percentiles
     # (reference HISTOGRAM_WINDOW_SIZE)
     HISTOGRAM_WINDOW_SIZE: int = 300
+    # resolve flight recorder (docs/observability.md): bounded
+    # in-memory span ring dumped on breaker trips, audit mismatches
+    # and watchdog timeouts; read via the `spans` admin route
+    FLIGHT_RECORDER_SPANS: int = 4096
+    # reservoir sample size behind every timer's p50/p90/p99 export
+    # (metrics route, JSON and Prometheus forms)
+    METRICS_RESERVOIR_SIZE: int = 512
     # node-id strkey -> human name for quorum/log output (reference
     # VALIDATOR_NAMES; merged with names from VALIDATORS entries)
     VALIDATOR_NAMES: Dict[str, str] = field(default_factory=dict)

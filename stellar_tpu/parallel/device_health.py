@@ -76,6 +76,10 @@ class DeviceHealth:
         self._breakers: Dict[int, resilience.CircuitBreaker] = {}
         self._history: deque = deque(maxlen=history_limit)
         self._seq = 0
+        # per-device audit-verdict tallies (key "-1" = unattributable
+        # single-device dispatch) — the fault-domain evidence
+        # MULTICHIP_r* capture runs carry (docs/observability.md)
+        self._audits: Dict[str, Dict[str, int]] = {}
         self._threshold = int(failure_threshold
                               if failure_threshold is not None
                               else DEFAULT_FAILURE_THRESHOLD)
@@ -152,6 +156,26 @@ class DeviceHealth:
         _metrics.counter(
             f"crypto.verify.device.{idx}.quarantines").inc()
         self.breaker(idx).trip()
+
+    def note_audit(self, idx: Optional[int], ok: bool,
+                   sampled: int) -> None:
+        """Record one result-integrity audit verdict against device
+        ``idx`` (None = unattributable single-device dispatch):
+        per-device ok/mismatch tallies in the snapshot, a history
+        event on a mismatch, and counters — so a ``MULTICHIP_r*``
+        capture carries the audit evidence alongside breaker states.
+        Clock/RNG-free (this module is in the nondet-lint scope)."""
+        key = "-1" if idx is None else str(int(idx))
+        with self._lock:
+            tally = self._audits.setdefault(key,
+                                            {"ok": 0, "mismatch": 0})
+            tally["ok" if ok else "mismatch"] += 1
+        if not ok:
+            self._note_event(-1 if idx is None else idx,
+                             "audit-mismatch", f"sampled={sampled}")
+        _metrics.counter(
+            f"crypto.verify.device.{key}.audit."
+            + ("ok" if ok else "mismatch")).inc()
 
     def available_devices(self, n: int) -> List[int]:
         """Indices (of mesh devices ``0..n-1``) that may serve traffic
@@ -238,11 +262,13 @@ class DeviceHealth:
         with self._lock:
             items = sorted(self._breakers.items())
             seq = self._seq
+            audits = {k: dict(v) for k, v in self._audits.items()}
         return {
             "devices": {str(i): br.snapshot() for i, br in items},
             "quarantined": [i for i, br in items
                             if br.state == resilience.OPEN],
             "transitions_total": seq,
+            "audits": audits,
         }
 
     def _reset_for_testing(self) -> None:
@@ -252,6 +278,7 @@ class DeviceHealth:
             self._breakers.clear()
             self._history.clear()
             self._seq = 0
+            self._audits.clear()
 
 
 # process-wide registry: device health is a property of the PHYSICAL

@@ -5,20 +5,32 @@ timers, histograms keyed by dotted names, exported via the HTTP
 Thread safety: metrics are marked from resolve-watchdog threads,
 trickle-batch leaders, probe threads, and breaker transition callbacks
 concurrently, so every read-modify-write (counter increments, the
-meter's sliding-window push/evict, timer accumulators, the registry's
-get-or-create) holds the instance lock. The lock discipline is enforced
-by ``stellar_tpu/analysis/locks.py`` (tier-1 via ``tools/analyze.py``).
+meter's sliding-window push/evict, timer accumulators + reservoir
+replacement, the registry's get-or-create) holds the instance lock.
+The lock discipline is enforced by ``stellar_tpu/analysis/locks.py``
+(tier-1 via ``tools/analyze.py``).
+
+Timers are HISTOGRAMS (ISSUE 5): alongside the running count/min/mean/
+max/stddev they keep a fixed-size reservoir sample of observations, so
+``to_dict`` (and the Prometheus exposition, :meth:`MetricsRegistry.
+to_prometheus`) exports p50/p90/p99 — the dispatch-floor work needs
+latency *distributions*, not means (arXiv:2302.00418's measurement
+methodology; the reference exports medida percentiles the same way,
+``docs/metrics.md``). Same classes, same dotted names: every existing
+``registry.timer(...)`` call site gained percentiles in place.
 """
 
 from __future__ import annotations
 
 import math
+import random
+import re
 import threading
 import time
 from typing import Dict, List
 
 __all__ = ["Counter", "Meter", "Timer", "Gauge", "MetricsRegistry",
-           "registry"]
+           "registry", "RESERVOIR_SIZE"]
 
 
 class Counter:
@@ -43,6 +55,24 @@ class Counter:
 # default matches the Config default so changed()-gated pushes stay
 # consistent)
 WINDOW_SECONDS = 300.0
+
+# reservoir sample size for timer percentiles (pushed from Config's
+# METRICS_RESERVOIR_SIZE by the Application; read at update time, so a
+# push before traffic starts sizes every timer)
+RESERVOIR_SIZE = 512
+
+
+def _interp_percentile(data: List[float], q: float) -> float:
+    """Linear-interpolated percentile over an already-sorted sample;
+    0.0 on empty."""
+    if not data:
+        return 0.0
+    k = (len(data) - 1) * (q / 100.0)
+    f = math.floor(k)
+    c = math.ceil(k)
+    if f == c:
+        return data[int(k)]
+    return data[f] + (data[c] - data[f]) * (k - f)
 
 
 class Meter:
@@ -81,7 +111,15 @@ class Meter:
 
 
 class Timer:
-    """Duration stats: count/min/mean/max/stddev (ms)."""
+    """Duration stats: count/min/mean/max/stddev (ms) + a reservoir
+    sample for percentiles (p50/p90/p99).
+
+    The reservoir is the classic replace-with-probability-k/n scheme,
+    driven by a per-instance seeded RNG: percentile estimates must not
+    perturb (or depend on) the process RNG state, and timers live
+    outside every consensus decision path — the nondet lint fences the
+    clock-bearing tracing layer that feeds them out of consensus
+    modules (``stellar_tpu/analysis/nondet.py``)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -90,14 +128,30 @@ class Timer:
         self._sum2 = 0.0
         self.min_ms = math.inf
         self.max_ms = 0.0
+        self._reservoir: List[float] = []
+        self._rng = random.Random(0x5EED)
 
     def update_ms(self, ms: float):
+        size = max(1, int(RESERVOIR_SIZE))
         with self._lock:
             self.count += 1
             self._sum += ms
             self._sum2 += ms * ms
             self.min_ms = min(self.min_ms, ms)
             self.max_ms = max(self.max_ms, ms)
+            # reservoir replacement is a read-modify-write on both the
+            # sample list and the RNG stream: under the lock with the
+            # accumulators. A shrunken RESERVOIR_SIZE push truncates,
+            # or the tail indices would freeze stale samples into the
+            # percentiles forever.
+            if len(self._reservoir) > size:
+                del self._reservoir[size:]
+            if len(self._reservoir) < size:
+                self._reservoir.append(ms)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < size:
+                    self._reservoir[j] = ms
 
     def time(self):
         t0 = time.perf_counter()
@@ -122,13 +176,47 @@ class Timer:
         var = max(0.0, self._sum2 / self.count - m * m)
         return math.sqrt(var)
 
+    def sum_ms(self) -> float:
+        """Total observed time — the quantity span attribution sums
+        (``batch_verifier.dispatch_attribution``)."""
+        with self._lock:
+            return self._sum
+
+    def percentiles_ms(self, qs) -> List[float]:
+        """Linear-interpolated percentiles (each q in [0, 100]) from
+        ONE locked, sorted reservoir snapshot — exports ask for three
+        quantiles at a time, and per-quantile re-sorting on a polled
+        scrape path is wasted work."""
+        with self._lock:
+            data = sorted(self._reservoir)
+        return [_interp_percentile(data, q) for q in qs]
+
+    def percentile_ms(self, q: float) -> float:
+        return self.percentiles_ms((q,))[0]
+
     def to_dict(self):
-        return {"type": "timer", "count": self.count,
-                "min_ms": 0.0 if math.isinf(self.min_ms) else
-                round(self.min_ms, 3),
-                "mean_ms": round(self.mean_ms(), 3),
-                "max_ms": round(self.max_ms, 3),
-                "stddev_ms": round(self.stddev_ms(), 3)}
+        # one locked snapshot: a count/sum pair torn across a
+        # concurrent update_ms must not reach the export
+        with self._lock:
+            count = self.count
+            s = self._sum
+            s2 = self._sum2
+            mn = self.min_ms
+            mx = self.max_ms
+            data = sorted(self._reservoir)
+        mean = s / count if count else 0.0
+        var = max(0.0, s2 / count - mean * mean) if count >= 2 else 0.0
+        p50, p90, p99 = (_interp_percentile(data, q)
+                         for q in (50, 90, 99))
+        return {"type": "timer", "count": count,
+                "min_ms": 0.0 if math.isinf(mn) else round(mn, 3),
+                "mean_ms": round(mean, 3),
+                "max_ms": round(mx, 3),
+                "stddev_ms": round(math.sqrt(var), 3),
+                "sum_ms": round(s, 3),
+                "p50_ms": round(p50, 3),
+                "p90_ms": round(p90, 3),
+                "p99_ms": round(p99, 3)}
 
 
 class Gauge:
@@ -147,6 +235,24 @@ class Gauge:
 
     def to_dict(self):
         return {"type": "gauge", "value": self.value}
+
+
+# Prometheus exposition-format helpers: metric names may only be
+# [a-zA-Z_:][a-zA-Z0-9_:]*, so dotted registry names mangle dots (and
+# any other byte) to underscores.
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_label_escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 class MetricsRegistry:
@@ -184,6 +290,61 @@ class MetricsRegistry:
             # during iteration" on the metrics endpoint
             items = sorted(self._metrics.items())
         return {name: m.to_dict() for name, m in items}
+
+    def timer_totals(self) -> Dict[str, dict]:
+        """``{name: {"count", "sum_ms"}}`` for every timer — the cheap
+        accessor behind ``tracing.span_totals()``: no reservoir sorts,
+        no meter/gauge rendering, just the two fields attribution
+        deltas need."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: {"count": m.count, "sum_ms": m.sum_ms()}
+                for name, m in items if isinstance(m, Timer)}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the whole
+        registry — the ``metrics?format=prometheus`` admin surface
+        (the reference serves its medida registry over HTTP the same
+        way, ``docs/metrics.md``). Counters export as counters, meters
+        as a ``_total`` counter + ``_rate`` gauge, timers as summaries
+        (quantile-labeled samples + ``_sum``/``_count``), gauges as
+        gauges (non-numeric values become a ``value`` label)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in items:
+            base = _prom_name(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {base} counter")
+                lines.append(f"{base} {m.count}")
+            elif isinstance(m, Meter):
+                lines.append(f"# TYPE {base}_total counter")
+                lines.append(f"{base}_total {m.count}")
+                lines.append(f"# TYPE {base}_rate gauge")
+                lines.append(f"{base}_rate {m.windowed_rate():.6f}")
+            elif isinstance(m, Timer):
+                lines.append(f"# TYPE {base}_ms summary")
+                for q, v in zip((50, 90, 99),
+                                m.percentiles_ms((50, 90, 99))):
+                    lines.append(
+                        f'{base}_ms{{quantile="{q / 100}"}} {v:.6f}')
+                lines.append(f"{base}_ms_sum {m.sum_ms():.6f}")
+                lines.append(f"{base}_ms_count {m.count}")
+            elif isinstance(m, Gauge):
+                v = m.value
+                lines.append(f"# TYPE {base} gauge")
+                if isinstance(v, bool):
+                    lines.append(f"{base} {int(v)}")
+                elif isinstance(v, (int, float)) and not (
+                        isinstance(v, float) and math.isnan(v)):
+                    lines.append(f"{base} {v}")
+                elif v is None:
+                    lines.append(f'{base}{{value="none"}} 1')
+                else:
+                    lines.append(
+                        f'{base}{{value="{_prom_label_escape(str(v))}"'
+                        f"}} 1")
+        return "\n".join(lines) + "\n"
 
     def clear(self):
         with self._lock:

@@ -30,6 +30,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from stellar_tpu.utils import tracing
+
 __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN",
     "CircuitBreaker", "Deadline", "DeadlineExceeded",
@@ -116,7 +118,12 @@ class WatchdogPool:
                     self._idle -= 1
                 job = self._jobs.popleft()
             try:
-                job["box"]["out"] = job["fn"]()
+                # trace-context propagation (ISSUE 5): spans opened
+                # inside the guarded call parent under the submitter's
+                # live span — a HUNG fetch shows up in a flight-recorder
+                # dump linked to the resolve that dispatched it
+                with tracing.span_context(job["ctx"]):
+                    job["box"]["out"] = job["fn"]()
             except BaseException as e:  # re-raised on the caller's thread
                 job["box"]["err"] = e
             finally:
@@ -125,7 +132,8 @@ class WatchdogPool:
     def submit(self, fn: Callable) -> dict:
         """Queue ``fn`` for a pool worker; returns the job record
         (``done`` event + ``box`` result slot). Never blocks."""
-        job = {"fn": fn, "box": {}, "done": threading.Event()}
+        job = {"fn": fn, "box": {}, "done": threading.Event(),
+               "ctx": tracing.current_context()}
         with self._cv:
             self._jobs.append(job)
             if self._idle >= len(self._jobs):

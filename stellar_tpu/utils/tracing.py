@@ -1,32 +1,77 @@
-"""Lightweight tracing: nested zones + slow-execution watchdogs
+"""Structured tracing: spans + zones + the resolve flight recorder
 (reference: Tracy ``ZoneScoped`` annotations — 672 across ``src/`` —
-and ``util/LogSlowExecution.h`` wall-time watchdogs, e.g. the ledger
-close monitor at ``ledger/LedgerManagerImpl.cpp:817``).
+plus ``util/LogSlowExecution.h`` wall-time watchdogs; ISSUE 5 grows
+the zone layer into structured spans with IDs, parent links and
+cross-thread context propagation).
 
-Zones are always-on but cheap: one ``perf_counter`` pair and a registry
-timer update per zone. A thread-local stack records nesting so a zone's
-metric name reflects its own cost (not children's) is NOT attempted —
-like Tracy, zone times are inclusive; the stack exists for the ``info``
-introspection of where time goes (``current_zones``).
+Model:
+
+* a :class:`span` is a context manager that times one phase of work.
+  On entry it draws a process-unique ``span_id``, links to the
+  innermost live span of the current thread as ``parent_id``, and
+  registers an OPEN record with the :class:`FlightRecorder`; on exit
+  it feeds the inclusive duration into the registry timer
+  ``span.<name>`` (a reservoir histogram — p50/p90/p99 export) and
+  moves the record into the recorder's bounded ring.
+* :class:`zone` is the historical spelling (timer prefix ``zone.``);
+  it is a span, so every existing ``with zone(...)`` call site gained
+  span IDs and recorder coverage for free.
+* **cross-thread propagation**: :func:`current_context` captures the
+  caller's innermost span id; :class:`span_context` installs it as the
+  parent on another thread. ``resilience.WatchdogPool`` does this for
+  every guarded call, so a span opened inside a pooled device fetch
+  parents correctly under the resolve that submitted it — which is
+  exactly what makes a HUNG fetch attributable in a dump.
+* the :class:`FlightRecorder` keeps the last N completed spans plus
+  every still-open span in memory; ``dump(reason)`` snapshots both on
+  breaker trips, audit mismatches and watchdog timeouts
+  (``crypto/batch_verifier.py`` wires the triggers) so the spans
+  leading into a failure survive to be read from the ``spans`` admin
+  route. See ``docs/observability.md``.
+
+Determinism: this module is clock-bearing BY DESIGN (``perf_counter``
+pairs). Its timings feed metrics and the recorder, never decisions —
+and the nondet lint (``stellar_tpu/analysis/nondet.py``) fences
+everything except the duration-blind context managers out of the
+consensus modules.
+
+Zone times are inclusive, like Tracy; the thread-local stack exists
+for parent links and the ``current_zones`` introspection.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
-from typing import List
+from collections import deque
+from typing import Dict, List, Optional
 
 from stellar_tpu.utils.metrics import registry
 
-__all__ = ["zone", "LogSlowExecution", "current_zones", "frame_mark"]
+__all__ = ["span", "zone", "LogSlowExecution", "current_zones",
+           "current_context", "span_context", "frame_mark",
+           "FlightRecorder", "flight_recorder", "span_totals"]
 
 _log = logging.getLogger("stellar_tpu.perf")
 
 _tls = threading.local()
 
+# span ids: process-unique, monotone. itertools.count.__next__ is a
+# single C call (atomic under the GIL).
+_ids = itertools.count(1)
 
-def _stack() -> List[str]:
+# time origin for span start stamps: milliseconds since tracing
+# import, monotonic — no wall clock enters the records
+_EPOCH = time.perf_counter()
+
+
+def _now_ms() -> float:
+    return (time.perf_counter() - _EPOCH) * 1000.0
+
+
+def _stack() -> list:
     s = getattr(_tls, "zones", None)
     if s is None:
         s = _tls.zones = []
@@ -34,31 +79,280 @@ def _stack() -> List[str]:
 
 
 def current_zones() -> List[str]:
-    """The live zone stack of this thread (innermost last)."""
-    return list(_stack())
+    """The live zone/span names of this thread (innermost last);
+    context anchors are invisible."""
+    return [e.name for e in _stack() if e.name is not None]
 
 
-class zone:
-    """``with zone("ledger.close"): ...`` — inclusive wall time into the
-    registry timer ``zone.<name>`` (the ZoneScoped analog)."""
+def current_context() -> Optional[int]:
+    """The innermost live span id of this thread (None outside any
+    span) — hand it to another thread via :class:`span_context` so
+    spans opened there parent under this one."""
+    s = _stack()
+    return s[-1].span_id if s else None
 
-    __slots__ = ("name", "_t0")
 
-    def __init__(self, name: str):
+class FlightRecorder:
+    """Bounded in-memory ring of span records + the set of still-open
+    spans, dumped on failure triggers (breaker trips, audit
+    mismatches, watchdog timeouts).
+
+    Records are plain dicts: ``{"id", "parent", "name", "thread",
+    "start_ms", "dur_ms"}`` (+ optional ``attrs`` / ``event`` /
+    ``open`` / ``abandoned`` flags). ``dur_ms`` is None while a span
+    is open — a dump therefore shows exactly where each in-flight
+    thread is parked, with parent links back to the resolve that got
+    it there. All shared state mutates under the instance lock (the
+    lock-discipline lint covers this module)."""
+
+    DEFAULT_CAPACITY = 4096
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(16, int(capacity)))
+        self._active: Dict[int, dict] = {}
+        self._dumps: deque = deque(maxlen=8)
+        self._dumps_total = 0
+        self._recorded_total = 0
+
+    def configure(self, capacity: Optional[int] = None) -> None:
+        """Config push (FLIGHT_RECORDER_SPANS); None keeps current."""
+        if capacity is None:
+            return
+        cap = max(16, int(capacity))
+        with self._lock:
+            if cap != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=cap)
+
+    # ---------------- span lifecycle ----------------
+
+    def start_span(self, rec: dict) -> None:
+        with self._lock:
+            self._active[rec["id"]] = rec
+
+    def finish_span(self, rec: dict) -> None:
+        with self._lock:
+            self._active.pop(rec["id"], None)
+            self._ring.append(rec)
+            self._recorded_total += 1
+
+    def abandon_span(self, rec: dict) -> None:
+        """A span whose ``__exit__`` never ran (orphan found by an
+        outer span's defensive pop): closed into the ring with an
+        ``abandoned`` flag and no duration."""
+        rec["abandoned"] = True
+        self.finish_span(rec)
+
+    def note(self, name: str, **attrs) -> None:
+        """Instant event record (duration 0) — audit verdicts,
+        re-shard decisions — parented under the caller's live span."""
+        rec = {"id": next(_ids), "parent": current_context(),
+               "name": name,
+               "thread": threading.current_thread().name,
+               "start_ms": round(_now_ms(), 3), "dur_ms": 0.0,
+               "event": True}
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            self._ring.append(rec)
+            self._recorded_total += 1
+
+    # ---------------- failure dumps / introspection ----------------
+
+    def dump(self, reason: str, limit: int = 256) -> dict:
+        """Snapshot the open spans + the ring tail under ``reason``;
+        kept in a bounded dump list (``spans`` admin route) and
+        counted in ``tracing.recorder.dumps``."""
+        limit = max(0, int(limit))
+        with self._lock:
+            open_spans = [dict(r, open=True)
+                          for r in self._active.values()]
+            tail = list(self._ring)[-limit:] if limit else []
+            d = {"reason": reason, "seq": self._dumps_total + 1,
+                 "open_spans": open_spans,
+                 "spans": [dict(r) for r in tail]}
+            self._dumps.append(d)
+            self._dumps_total += 1
+        registry.counter("tracing.recorder.dumps").inc()
+        _log.warning("flight recorder dump (%s): %d open spans, "
+                     "%d recent records", reason, len(open_spans),
+                     len(d["spans"]))
+        return d
+
+    def dumps(self) -> List[dict]:
+        with self._lock:
+            return list(self._dumps)
+
+    def stats(self) -> dict:
+        """Accounting only (the ``dispatch_health`` embed): no record
+        copies, minimal time under the recorder lock."""
+        with self._lock:
+            return {"capacity": self._ring.maxlen,
+                    "recorded_total": self._recorded_total,
+                    "dumps_total": self._dumps_total,
+                    "dump_reasons": [d["reason"]
+                                     for d in self._dumps]}
+
+    def snapshot(self, limit: int = 128) -> dict:
+        """The ``spans`` admin-route payload: open spans, the most
+        recent completed records, and dump accounting. ``limit=0``
+        means NO recent records (accounting only — what
+        ``dispatch_health`` wants), never the whole ring."""
+        limit = max(0, int(limit))
+        with self._lock:
+            tail = list(self._ring)[-limit:] if limit else []
+            return {
+                "active": [dict(r) for r in self._active.values()],
+                "recent": [dict(r) for r in tail],
+                "capacity": self._ring.maxlen,
+                "recorded_total": self._recorded_total,
+                "dumps_total": self._dumps_total,
+                "dump_reasons": [d["reason"] for d in self._dumps],
+            }
+
+    def clear(self) -> None:
+        """Tests: drop every record, open span, dump and the
+        accounting counters — a fresh recorder."""
+        with self._lock:
+            self._ring.clear()
+            self._active.clear()
+            self._dumps.clear()
+            self._dumps_total = 0
+            self._recorded_total = 0
+
+
+# process-wide recorder (one node per process, like the registry)
+flight_recorder = FlightRecorder()
+
+
+class span:
+    """``with span("verify.fetch", device=3): ...`` — inclusive wall
+    time into the registry histogram ``span.<name>``, plus a recorder
+    record carrying span id, parent link, thread and attrs."""
+
+    _PREFIX = "span"
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "_t0",
+                 "_rec")
+
+    def __init__(self, name: str, **attrs):
         self.name = name
+        self.attrs = attrs
 
     def __enter__(self):
-        _stack().append(self.name)
+        st = _stack()
+        self.parent_id = st[-1].span_id if st else None
+        self.span_id = next(_ids)
+        self._rec = {"id": self.span_id, "parent": self.parent_id,
+                     "name": f"{self._PREFIX}.{self.name}",
+                     "thread": threading.current_thread().name,
+                     "start_ms": round(_now_ms(), 3), "dur_ms": None}
+        if self.attrs:
+            self._rec["attrs"] = dict(self.attrs)
+        st.append(self)
+        flight_recorder.start_span(self._rec)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._rec.get("abandoned"):
+            # already swept into the ring by an outer span's (or
+            # anchor's) defensive pop — a late __exit__ (closed
+            # generator, GC) must not fabricate a duration spanning
+            # the gap nor re-append the record
+            return False
         dt_ms = (time.perf_counter() - self._t0) * 1000.0
-        registry.timer(f"zone.{self.name}").update_ms(dt_ms)
-        s = _stack()
-        if s and s[-1] == self.name:
-            s.pop()
+        registry.timer(f"{self._PREFIX}.{self.name}").update_ms(dt_ms)
+        self._rec["dur_ms"] = round(dt_ms, 3)
+        flight_recorder.finish_span(self._rec)
+        # Defensive pop back to SELF: an inner span abandoned mid-flight
+        # (entered by hand, a generator that never resumed, an exit
+        # skipped by interpreter shutdown) must not leave orphan stack
+        # entries poisoning parent links for the rest of the thread's
+        # life. Entries above this span are closed into the recorder as
+        # abandoned; if this span is not on the stack at all (its own
+        # entry was already swept by an outer pop), the stack is left
+        # untouched.
+        st = _stack()
+        if any(e is self for e in st):
+            while st:
+                top = st.pop()
+                if top is self:
+                    break
+                top._abandon()
         return False
+
+    def _abandon(self):
+        rec = getattr(self, "_rec", None)
+        if rec is not None and rec.get("dur_ms") is None:
+            flight_recorder.abandon_span(rec)
+
+
+class zone(span):
+    """Historical spelling (timer prefix ``zone.``): the ZoneScoped
+    analog. A full span — IDs, parent links, recorder coverage."""
+
+    _PREFIX = "zone"
+    __slots__ = ()
+
+
+class _Anchor:
+    """Stack entry carrying a borrowed parent span id (cross-thread
+    context): invisible to ``current_zones``, never timed."""
+
+    __slots__ = ("span_id", "name")
+
+    def __init__(self, span_id: int):
+        self.span_id = span_id
+        self.name = None
+
+    def _abandon(self):
+        pass
+
+
+class span_context:
+    """Install ``parent_id`` as this thread's innermost span, so spans
+    opened here link under a span living on another thread:
+
+        ctx = tracing.current_context()      # caller thread
+        with tracing.span_context(ctx): ...  # worker thread
+
+    ``parent_id=None`` is a no-op (callers need no outside-any-span
+    special case)."""
+
+    __slots__ = ("_anchor",)
+
+    def __init__(self, parent_id: Optional[int]):
+        self._anchor = _Anchor(parent_id) if parent_id is not None \
+            else None
+
+    def __enter__(self):
+        if self._anchor is not None:
+            _stack().append(self._anchor)
+        return self
+
+    def __exit__(self, *exc):
+        if self._anchor is not None:
+            st = _stack()
+            if any(e is self._anchor for e in st):
+                while st:
+                    top = st.pop()
+                    if top is self._anchor:
+                        break
+                    # orphans above the anchor (a span abandoned
+                    # inside the pooled fn) get the same treatment as
+                    # span.__exit__'s defensive sweep — closed into
+                    # the ring as abandoned, never stuck in _active
+                    top._abandon()
+        return False
+
+
+def span_totals() -> Dict[str, dict]:
+    """``{timer_name: {"count", "sum_ms"}}`` snapshot of every
+    registry timer — the delta input of
+    ``batch_verifier.dispatch_attribution`` (bench takes one before
+    and one after the measured reps). Reads the registry's cheap
+    totals accessor, not the full percentile-rendering ``to_dict``."""
+    return registry.timer_totals()
 
 
 class LogSlowExecution:

@@ -521,3 +521,72 @@ def test_nondet_lint_scope_covers_audit_sampler():
     scope = set(nondet.HOST_ORACLE_FILES)
     assert "stellar_tpu/crypto/audit.py" in scope
     assert "stellar_tpu/parallel/device_health.py" in scope
+
+
+def test_lock_lint_scope_covers_tracing_ring():
+    """ISSUE 5: the flight-recorder ring + active-span map mutate from
+    resolver, pool-worker and breaker-callback threads; the reservoir
+    RMW lives in metrics. Both must stay under lock enforcement."""
+    scope = set(locks.SCOPE)
+    assert "stellar_tpu/utils/tracing.py" in scope
+    assert "stellar_tpu/utils/metrics.py" in scope
+
+
+def test_nondet_lint_fences_tracing_out_of_consensus():
+    """ISSUE 5: tracing is clock-bearing BY DESIGN — consensus modules
+    may import only its duration-blind context managers. Anything that
+    exposes readable clock state (the module itself, the flight
+    recorder, span_totals) is a finding."""
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils import tracing\n", "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    flagged = nondet.lint_source(
+        "import stellar_tpu.utils.tracing\n", "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils.tracing import flight_recorder\n",
+        "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils.tracing import span_totals\n", "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    # the parenthesized utils-import spelling can't slip the module in
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils import (\n    faults,\n"
+        "    tracing,\n)\n", "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    clean = nondet.lint_source(
+        "from stellar_tpu.utils import (\n    faults,\n)\n", "x.py")
+    assert not [f for f in clean if f.symbol == "tracing-import"]
+    # ...and neither can backslash continuations, in either spelling
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils.tracing import zone, \\\n"
+        "    span_totals\n", "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    flagged = nondet.lint_source(
+        "from stellar_tpu.utils import faults, \\\n    tracing\n",
+        "x.py")
+    assert any(f.symbol == "tracing-import" for f in flagged)
+    # the sanctioned names pass, including the ledger_manager's
+    # parenthesized multi-line spelling
+    clean = nondet.lint_source(
+        "from stellar_tpu.utils.tracing import (\n"
+        "    LogSlowExecution, frame_mark, zone,\n"
+        ")\n", "x.py")
+    assert not [f for f in clean if f.symbol == "tracing-import"]
+    clean = nondet.lint_source(
+        "from stellar_tpu.utils.tracing import zone\n", "x.py")
+    assert not [f for f in clean if f.symbol == "tracing-import"]
+    # the tracing module itself must never enter the nondet scope —
+    # its clock reads are the sanctioned implementation, fenced by
+    # this import rule instead
+    scoped = set(nondet.HOST_ORACLE_FILES)
+    assert "stellar_tpu/utils/tracing.py" not in scoped
+    assert "stellar_tpu/utils/metrics.py" not in scoped
+
+
+def test_nondet_bans_perf_counter_in_consensus():
+    """ISSUE 5: perf_counter joined the clock ban — before the fence,
+    consensus code could read the one clock tracing uses."""
+    flagged = nondet.lint_source("t0 = time.perf_counter()\n", "x.py")
+    assert any(f.symbol == "clock" for f in flagged)

@@ -363,6 +363,51 @@ def test_dispatch_health_shape():
     assert health["host_only"] is False
     assert set(health["audit"]) == {"rate", "sampled", "mismatches"}
     assert set(health["device_health"]) == \
-        {"devices", "quarantined", "transitions_total"}
+        {"devices", "quarantined", "transitions_total", "audits"}
     assert set(health["watchdog"]) >= {"workers", "idle",
                                        "spawned_total"}
+    # ISSUE 5: flight-recorder accounting rides the health payload
+    assert set(health["flight_recorder"]) == \
+        {"capacity", "recorded_total", "dumps_total", "dump_reasons"}
+
+
+def test_flight_recorder_dumps_hung_fetch_with_parent_links():
+    """ISSUE 5 satellite: a watchdog trip must dump the flight
+    recorder WHILE the hung fetch's spans are still open, and the
+    worker-side device span must parent-link (via WatchdogPool context
+    propagation) back through the caller's fetch span to the resolve
+    that dispatched it."""
+    from stellar_tpu.utils import tracing
+    tracing.flight_recorder.clear()
+    faults.set_fault(faults.RESOLVE, "hang", 2.0)
+    bv.configure_dispatch(deadline_ms=200)
+    v = BatchVerifier(bucket_sizes=(16,))
+    items, want = _tiled_corpus(16)
+    got = v.verify_batch(items)
+    assert (got == want).all()            # degraded, bit-identical
+    dumps = tracing.flight_recorder.dumps()
+    trip = [d for d in dumps
+            if d["reason"].startswith("watchdog-timeout")]
+    assert trip, [d["reason"] for d in dumps]
+    d = trip[0]
+    by_id = {r["id"]: r for r in d["open_spans"]}
+    dev = [r for r in d["open_spans"]
+           if r["name"] == "span.verify.fetch.device"]
+    assert dev, [r["name"] for r in d["open_spans"]]
+    dev = dev[0]
+    assert dev["dur_ms"] is None and dev["open"] is True
+    # parent chain: device-side fetch (pool worker thread) -> fetch
+    # (resolver thread) -> resolve -> blocking root
+    fetch = by_id[dev["parent"]]
+    assert fetch["name"] == "span.verify.fetch"
+    assert fetch["thread"] != dev["thread"]
+    resolve_rec = by_id[fetch["parent"]]
+    assert resolve_rec["name"] == "span.verify.resolve"
+    root = by_id[resolve_rec["parent"]]
+    assert root["name"] == "span.verify.blocking"
+    # a breaker OPEN transition is its own dump trigger (one chunk =
+    # one miss here, below threshold — trip it explicitly)
+    bv._breaker.trip()
+    assert any(d2["reason"].startswith("breaker-open")
+               for d2 in tracing.flight_recorder.dumps()), \
+        [d2["reason"] for d2 in tracing.flight_recorder.dumps()]

@@ -164,6 +164,19 @@ def capture_window():
         "multisig_device", ts,
         lambda r: f"close_mean={r.get('close_mean_ms')}ms "
                   f"backend={r.get('verify_backend')}") or ok
+    # MULTICHIP capture with fault-domain evidence (ISSUE 5): the
+    # per-device dispatch path, carrying breaker states / quarantine
+    # onsets / audit verdicts so the first honest multi-chip number
+    # can show its fault domains were quiet (or weren't)
+    ok = capture_json(
+        [sys.executable,
+         os.path.join(REPO, "tools", "multichip_bench.py")],
+        "multichip", ts,
+        lambda r: f"p50={r.get('value')}ms "
+                  f"devices={r.get('n_devices')} "
+                  f"backend={r.get('verify_backend')} quarantined="
+                  f"{r.get('fault_domain', {}).get('device_health', {}).get('quarantined')}"
+    ) or ok
     try:
         rc, so, se = _run_group(
             [sys.executable, "-c", TRACE_SRC, REPO,

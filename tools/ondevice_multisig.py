@@ -18,12 +18,17 @@ sys.path.insert(0, REPO)
 
 def main() -> int:
     n_ledgers = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    from stellar_tpu.crypto import batch_verifier
     from stellar_tpu.crypto.batch_verifier import default_verifier
     from stellar_tpu.crypto.keys import get_verifier_backend_name
     from stellar_tpu.simulation.load_generator import multisig_apply_load
     default_verifier().install()
     rec = multisig_apply_load(n_ledgers=n_ledgers, txs_per_ledger=1000)
     rec["verify_backend"] = get_verifier_backend_name()
+    # fault-domain posture of the run (ISSUE 5): breaker states,
+    # audit tallies, host-only flag — a mid-run degradation must be
+    # visible in the capture, not just slower
+    rec["dispatch_health"] = batch_verifier.dispatch_health()
     print(json.dumps(rec))
     return 0
 

@@ -50,4 +50,13 @@ echo DEVICE_CHAOS=$(timeout -k 5 120 env JAX_PLATFORMS=cpu \
 timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py
 arc=$?
 echo ANALYSIS_RC=$arc
-exit $arc
+[ "$arc" -ne 0 ] && exit $arc
+# Metrics/trace export self-check (ISSUE 5): a synthetic host-only
+# resolve must produce a complete per-phase dispatch_attribution whose
+# span sum reconciles with the blocking root span (>= 95%), and the
+# Prometheus exposition of the registry must parse. Seconds of wall
+# time, no device, no kernel compile.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/metrics_selfcheck.py
+mrc=$?
+echo METRICS_EXPORT_OK=$([ "$mrc" -eq 0 ] && echo 1 || echo 0)
+exit $mrc
