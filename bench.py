@@ -25,6 +25,11 @@ definition). Reported alongside:
 - ``trickle_p50_ms``: single-sig misses under concurrent load through
   the TrickleBatcher micro-batch window (SURVEY §7 trickle class),
   vs ``single_sig_miss_p50_ms`` — the solo-dispatch cost it amortizes.
+- ``service``: STREAM behavior through the resident verify service
+  (ISSUE 6): per-lane p50/p99 wait from the reservoir histograms plus
+  the shed/reject conservation totals — the record the soak harness
+  (``tools/soak.py``) regression-guards between live windows
+  (``docs/benchmarks.md``).
 
 vs_baseline = (single-core CPU time to verify the same 2048 signatures
 sequentially with OpenSSL ed25519 — same order as libsodium's
@@ -547,10 +552,49 @@ def main():
         return {"trickle_p50_ms": round(trickle_p50, 3),
                 "trickle_dispatches": trickle_dispatches}
 
+    def phase_service():
+        # resident-service stream shape (ISSUE 6): a bulk flood with a
+        # paced SCP-priority stream riding ahead of it, through the
+        # continuous-batching dispatcher. Captures per-lane p50/p99
+        # wait + the conservation totals so the live record carries
+        # STREAM behavior, not just blocking resolves.
+        from stellar_tpu.crypto import verify_service as vsvc
+        from stellar_tpu.utils.metrics import registry as _reg
+        svc = vsvc.VerifyService(
+            verifier=v, lane_depth=64, lane_bytes=64_000_000,
+            max_batch=N_SIGS, pipeline_depth=4, aging_every=4).start()
+        tickets = []
+        rejected = 0
+        for i in range(24):
+            for lane, sub in (("bulk", items[:256]),) + (
+                    (("scp", items[:16]),) if i % 3 == 0 else ()):
+                try:
+                    tickets.append(svc.submit(sub, lane=lane))
+                except vsvc.Overloaded:
+                    rejected += 1
+        shed = 0
+        for t in tickets:
+            try:
+                assert t.result(timeout=120).all()
+            except vsvc.Overloaded:
+                shed += 1
+        svc.stop(drain=True, timeout=60)
+        snap = svc.snapshot()
+        return {"service": {
+            "lane_latency_ms": vsvc.lane_latencies(),
+            "totals": snap["totals"],
+            "conservation_gap": snap["conservation_gap"],
+            "ingress_rejected_submissions": rejected,
+            "shed_submissions": shed,
+            "shed_onsets": _reg.counter(
+                "crypto.verify.service.shed_onsets").count,
+        }}
+
     optional("coalesced", phase_coalesced)   # most valuable first
     optional("pipelined", phase_pipelined)
     optional("singles", phase_singles)
     optional("trickle", phase_trickle)
+    optional("service", phase_service)
     # hardware-independent, so it must never delay the on-device record
     # above — the live window can be minutes long (round 4: ~3 min total)
     optional("kernel_cost", lambda: {"kernel_cost": _static_kernel_cost()})

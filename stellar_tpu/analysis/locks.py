@@ -47,6 +47,7 @@ __all__ = ["run", "lint_source", "SCOPE", "ALLOWLIST"]
 # worker and breaker-callback threads), and the device-watch daemon.
 SCOPE = [
     "stellar_tpu/crypto/batch_verifier.py",
+    "stellar_tpu/crypto/verify_service.py",
     "stellar_tpu/parallel/device_health.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",

@@ -46,6 +46,11 @@ HOST_ORACLE_FILES = [
     # or replicas could diverge in what they re-verify
     "stellar_tpu/crypto/audit.py",
     "stellar_tpu/parallel/device_health.py",
+    # the resident verify service decides WHICH queued work gets
+    # verified vs shed under overload — the shed rule must stay
+    # content-seeded (audit.keep_under_shed) and the scheduler
+    # sequence-based, never clocked or RNG-driven
+    "stellar_tpu/crypto/verify_service.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",
@@ -198,6 +203,17 @@ ALLOWLIST = Allowlist({
             "shortHash::initialize(): short hashes are process-local "
             "(hashmap seeding) and never cross the wire or enter "
             "consensus state.",
+    },
+    "stellar_tpu/crypto/verify_service.py": {
+        "nondet:clock":
+            "time.monotonic() stamps admission and completion for the "
+            "per-lane wait-time histograms (the p50/p99 the soak "
+            "harness publishes) — observability only. No decision "
+            "reads them: admission verdicts depend on bounded queue/"
+            "byte budgets, scheduling order on priorities plus "
+            "admission sequence numbers, and WHICH rows shed on the "
+            "content-seeded rule in crypto/audit.py (replicas under "
+            "identical pressure shed identical rows).",
     },
 })
 

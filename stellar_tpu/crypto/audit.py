@@ -23,7 +23,8 @@ from __future__ import annotations
 import hashlib
 from typing import List, Optional, Sequence
 
-__all__ = ["sample_indices", "sample_rows", "verdict_record"]
+__all__ = ["sample_indices", "sample_rows", "verdict_record",
+           "keep_under_shed"]
 
 
 def sample_indices(material: bytes, n: int, rate: float) -> List[int]:
@@ -79,6 +80,30 @@ def sample_rows(material: bytes, eligible_rows: Sequence[int],
     """
     picks = sample_indices(material, len(eligible_rows), rate)
     return [eligible_rows[p] for p in picks]
+
+
+def keep_under_shed(material: bytes, keep_fraction: float) -> bool:
+    """Deterministic content-seeded keep/drop draw — the verify
+    service's load-shed rule (``docs/robustness.md`` "Overload and
+    load-shed"), same discipline as the audit sampler above: under
+    identical overload pressure, replicas holding the same queued work
+    shed IDENTICAL rows, because the draw is SHA-256 of the work's own
+    bytes mapped uniformly into [0, 1) — no clocks, no RNG state, no
+    hash salts, no dependence on queue composition (a submission keeps
+    or sheds the same way regardless of what else is queued, so a
+    repeated shed pass is stable: survivors keep surviving until the
+    pressure level changes the fraction).
+
+    Returns True = KEEP (verify this work), False = SHED it. The
+    boundary cases short-circuit without hashing: ``keep_fraction >=
+    1`` keeps everything, ``<= 0`` sheds everything."""
+    if keep_fraction >= 1.0:
+        return True
+    if keep_fraction <= 0.0:
+        return False
+    h = hashlib.sha256(material).digest()
+    draw = int.from_bytes(h[:8], "little") / float(1 << 64)
+    return draw < keep_fraction
 
 
 def verdict_record(device: Optional[int], lo: int, hi: int,

@@ -82,7 +82,9 @@ from stellar_tpu.utils.metrics import registry
 
 __all__ = ["BatchVerifier", "default_verifier", "device_available",
            "dispatch_health", "configure_dispatch",
-           "dispatch_attribution", "RESOLVE_PHASES", "RESOLVE_ROOT"]
+           "dispatch_attribution", "dispatch_degraded",
+           "note_shed_onset", "register_service_health",
+           "RESOLVE_PHASES", "RESOLVE_ROOT"]
 
 _L = ref.L
 _P = ref.P
@@ -242,6 +244,54 @@ def host_only_mode() -> bool:
     return _host_only
 
 
+def dispatch_degraded() -> bool:
+    """True when the accelerator path is unavailable to new work — the
+    global breaker is OPEN or the process flipped host-only. This is
+    the verify service's shed-ladder pressure input
+    (:mod:`stellar_tpu.crypto.verify_service`): with effective
+    capacity collapsed to the host oracle, the service sheds
+    lowest-priority backlog instead of queueing to death."""
+    return _host_only or _breaker.state == resilience.OPEN
+
+
+# ---------------- resident verify service hooks ----------------
+# verify_service.py sits ON TOP of this module and is inside the
+# consensus nondet-lint scope, so it may not import the clock-bearing
+# tracing layer directly; its flight-recorder trigger and health
+# surface route through here instead.
+
+_service_lock = threading.Lock()
+_service_health_provider: Optional[Callable[[], dict]] = None
+
+
+def register_service_health(provider: Optional[Callable[[], dict]]
+                            ) -> None:
+    """Install the resident verify service's snapshot callable so
+    ``dispatch_health()`` (and the ``dispatch`` admin route) carries
+    queue depths and shed/reject accounting next to the breaker state.
+    ``None`` unregisters (tests)."""
+    global _service_health_provider
+    with _service_lock:
+        _service_health_provider = provider
+
+
+def service_health_snapshot() -> dict:
+    """The registered service's snapshot, or ``{"running": False}``
+    when no service ever started — shared by ``dispatch_health()``
+    and the ``service`` admin route."""
+    provider = _service_health_provider
+    return provider() if provider is not None else {"running": False}
+
+
+def note_shed_onset(reason: str) -> None:
+    """First-onset load-shed trigger: dump the flight recorder so the
+    spans and queue events leading INTO the overload survive to be
+    read (same policy as breaker trips and audit mismatches —
+    docs/observability.md)."""
+    registry.counter("crypto.verify.service.shed_onsets").inc()
+    tracing.flight_recorder.dump(f"service-shed:{reason}")
+
+
 def served_counts() -> dict:
     """Process-wide items-served tally by backend — the attribution
     bench.py records so a silent fallback can never be reported as a
@@ -281,6 +331,7 @@ def dispatch_health() -> dict:
         "device_health": device_health.get().snapshot(),
         "watchdog": resilience.watchdog_stats(),
         "flight_recorder": tracing.flight_recorder.stats(),
+        "service": service_health_snapshot(),
     }
 
 
@@ -850,23 +901,55 @@ class TrickleBatcher:
     dispatch; the synchronous bool API is preserved by parking callers
     on futures. The first caller of a window is the leader: it waits
     the window out, dispatches everything queued, and resolves every
-    future; followers just block on theirs."""
+    future; followers just block on theirs.
+
+    The internal queue is BOUNDED (``max_pending``): a caller arriving
+    when it is full gets a typed :class:`resilience.Overloaded` at
+    ingress instead of growing the pending list without limit while a
+    leader is stuck behind a slow dispatch — the same
+    admission-control discipline as the resident verify service
+    (``docs/robustness.md`` "Overload and load-shed")."""
 
     def __init__(self, verifier: BatchVerifier, window_ms: float = 1.0,
-                 max_batch: int = 64):
+                 max_batch: int = 64, max_pending: int = 4096):
         self._verifier = verifier
         self._window = window_ms / 1000.0
         self._max = max_batch
+        self._max_pending = max(1, int(max_pending))
         self._cv = threading.Condition()
         self._pending: list = []  # ((pk, msg, sig), Future)
         self._leader_active = False
+        self._flush_asap = False
         self.dispatches = 0  # instrumentation (bench / tests)
+        self.rejected = 0    # ingress Overloaded count
+
+    def _dispatch_batch(self, batch: list) -> None:
+        """Resolve one claimed batch through the verifier, fanning a
+        leader-side failure out to every parked future (nobody hangs)."""
+        try:
+            results = self._verifier.verify_batch(
+                [item for item, _f in batch])
+        except BaseException as e:
+            for _item, f in batch:
+                f.set_exception(e)
+            raise
+        for (_item, f), ok in zip(batch, results):
+            f.set_result(bool(ok))
 
     def verify_sig(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         from concurrent.futures import Future
         import time
         fut: Future = Future()
         with self._cv:
+            if len(self._pending) >= self._max_pending:
+                # bounded queue: reject at ingress, typed — the caller
+                # decides whether to retry, shed, or fail its request
+                self.rejected += 1
+                registry.counter("crypto.verify.trickle.rejected").inc()
+                raise resilience.Overloaded(
+                    f"trickle window full ({self._max_pending} pending)",
+                    kind="rejected", lane="trickle",
+                    reason="queue-depth")
             self._pending.append(((pk, msg, sig), fut))
             if self._leader_active:
                 if len(self._pending) >= self._max:
@@ -878,7 +961,8 @@ class TrickleBatcher:
         if lead:
             deadline = time.perf_counter() + self._window
             with self._cv:
-                while len(self._pending) < self._max:
+                while len(self._pending) < self._max and \
+                        not self._flush_asap:
                     left = deadline - time.perf_counter()
                     if left <= 0:
                         break
@@ -886,19 +970,36 @@ class TrickleBatcher:
                 batch = self._pending
                 self._pending = []
                 self._leader_active = False
+                self._flush_asap = False
                 # counted under the lock: the next window's leader can
                 # already be running by the time this one dispatches
                 self.dispatches += 1
-            try:
-                results = self._verifier.verify_batch(
-                    [item for item, _f in batch])
-            except BaseException as e:
-                for _item, f in batch:
-                    f.set_exception(e)
-                raise
-            for (_item, f), ok in zip(batch, results):
-                f.set_result(bool(ok))
+            self._dispatch_batch(batch)
         return fut.result()
+
+    def flush(self) -> int:
+        """Dispatch everything queued RIGHT NOW instead of waiting the
+        window out (service drain / shutdown path). Tolerant of
+        enqueues racing a window close: all queue/leader transitions
+        happen under the window lock, so an item is owned by exactly
+        one dispatcher — if a leader is active it OWNS the pending
+        list (flush just wakes it early and returns 0); otherwise
+        flush claims the batch itself, and an enqueue arriving after
+        the claim simply elects itself the next leader. Returns how
+        many items THIS call dispatched."""
+        with self._cv:
+            if self._leader_active:
+                self._flush_asap = True
+                self._cv.notify_all()
+                return 0
+            batch = self._pending
+            self._pending = []
+            if batch:
+                self.dispatches += 1
+        if not batch:
+            return 0
+        self._dispatch_batch(batch)
+        return len(batch)
 
 
 # Padding rows: any syntactically valid inputs work (results are sliced

@@ -1,0 +1,635 @@
+"""Resident verify service: continuous batching with admission
+control, priority lanes, and deterministic load-shed under overload.
+
+The reference serves a *stream* of signature work — Herder TxSet
+validation, SCP envelope verification, and overlay peer auth all feed
+``PubKeyUtils::verifySig`` continuously — but the batch verifier's
+entry point is resolve-a-batch: callers must assemble their own
+batches and nothing stands between a traffic spike and unbounded
+queueing. This module is the standing stream processor on top of
+:class:`stellar_tpu.crypto.batch_verifier.BatchVerifier`
+(``docs/robustness.md`` "Overload and load-shed"):
+
+* **priority lanes** (``scp`` > ``auth`` > ``bulk``, mirroring the
+  reference's Herder/overlay split): consensus-critical SCP envelope
+  verification and overlay peer auth are admitted and scheduled ahead
+  of tx-flood backlog, so a flood cannot stall the committee — the
+  failure mode "Performance of EdDSA and BLS Signatures in
+  Committee-Based Consensus" measures when both share one queue;
+* **continuous batching**: a single dispatcher thread coalesces queued
+  submissions into the verifier's pipelined jit buckets (up to
+  ``MAX_BATCH`` items per dispatch, up to ``PIPELINE_DEPTH`` dispatches
+  in flight), overlapping host prep of the next batch with device
+  execution of the current one;
+* **admission control + backpressure**: every lane has an explicit
+  queue-depth and in-flight byte budget; work arriving past a budget
+  is refused AT INGRESS with a typed
+  :class:`stellar_tpu.utils.resilience.Overloaded` instead of
+  buffering to death;
+* **deterministic load-shed ladder**: under backlog or global-breaker
+  /host-only pressure the service sheds lowest-priority QUEUED work
+  first, row selection decided by the content-seeded rule in
+  :func:`stellar_tpu.crypto.audit.keep_under_shed` — replicas under
+  identical pressure shed identical rows, no clocks or RNG involved
+  (this module sits inside the consensus nondet-lint scope). Every
+  shed is counted, ticketed back to its caller, and the first onset
+  dumps the flight recorder via
+  :func:`stellar_tpu.crypto.batch_verifier.note_shed_onset`.
+
+**Starvation-proofing** is sequence-based, not clock-based: every
+``AGING_EVERY``-th collected batch serves the lane whose head
+submission is globally OLDEST (smallest admission sequence number)
+regardless of priority, so the bulk lane always drains — deterministic
+in arrival order, no wall-clock reads in any scheduling decision.
+
+**Work conservation law** (pinned by ``tools/soak.py`` and the tier-1
+``SOAK_OK`` gate): for every lane,
+
+    submitted == verified + rejected + shed + failed + pending
+
+with ``failed == 0`` in healthy operation — no item is ever silently
+dropped; ``snapshot()["conservation_gap"]`` must read 0 at all times.
+
+Clock use in this module is confined to latency STAMPS feeding the
+per-lane wait-time histograms (``crypto.verify.service.lane.<lane>.
+wait_ms`` — the p50/p99 the soak harness and bench publish); which
+rows verify vs shed never depends on them (nondet allowlist,
+``stellar_tpu/analysis/nondet.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from stellar_tpu.crypto import audit as audit_mod
+from stellar_tpu.crypto import batch_verifier
+from stellar_tpu.utils import resilience
+from stellar_tpu.utils.metrics import registry
+from stellar_tpu.utils.tracing import span
+
+__all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
+           "SHED_LADDER", "configure_service", "default_service",
+           "service_health", "lane_latencies"]
+
+# re-export: the typed admission verdict lives with the resilience
+# primitives so TrickleBatcher can raise it without a module cycle
+Overloaded = resilience.Overloaded
+
+# priority order, highest first. scp = SCP envelope verification
+# (consensus-critical), auth = overlay peer-auth handshakes, bulk =
+# tx-flood / catchup backlog.
+LANES = ("scp", "auth", "bulk")
+
+# ---------------- service policy knobs ----------------
+# Env defaults let tools/soak and tests set these without a Config; a
+# node pushes its Config knobs through configure_service() at setup
+# (same pattern as batch_verifier.configure_dispatch).
+
+LANE_DEPTH = int(os.environ.get("VERIFY_SERVICE_LANE_DEPTH", "512"))
+LANE_BYTES = int(os.environ.get("VERIFY_SERVICE_LANE_BYTES",
+                                "16000000"))
+MAX_BATCH = int(os.environ.get("VERIFY_SERVICE_MAX_BATCH", "2048"))
+PIPELINE_DEPTH = int(os.environ.get("VERIFY_SERVICE_PIPELINE_DEPTH",
+                                    "4"))
+AGING_EVERY = int(os.environ.get("VERIFY_SERVICE_AGING_EVERY", "4"))
+
+# Degradation ladder: pressure level -> {lane: keep_fraction}. A lane
+# absent from a level is NEVER shed at that level; scp is absent from
+# every level — consensus work is only ever rejected by its own
+# ingress budgets, never dropped from the queue.
+#   level 1 (backlog): the bulk queue crossed its high-water mark —
+#     shed half the flood by content so the queue stays drainable;
+#   level 2 (dispatch-degraded): global breaker open or host-only —
+#     effective capacity collapsed to the host oracle; keep an eighth
+#     of bulk and half of auth so the priority lanes stay live.
+SHED_LADDER = {
+    1: {"bulk": 0.5},
+    2: {"bulk": 0.125, "auth": 0.5},
+}
+# fraction of LANE_DEPTH at which the bulk queue counts as backlogged
+SHED_HIGHWATER_FRAC = 0.75
+
+_defaults_lock = threading.Lock()
+
+
+def configure_service(lane_depth: Optional[int] = None,
+                      lane_bytes: Optional[int] = None,
+                      max_batch: Optional[int] = None,
+                      pipeline_depth: Optional[int] = None,
+                      aging_every: Optional[int] = None) -> None:
+    """Push service-policy knobs (Config / tests); None keeps the
+    current value. Instances read these at construction — push before
+    :func:`default_service` (the Application does)."""
+    global LANE_DEPTH, LANE_BYTES, MAX_BATCH, PIPELINE_DEPTH, \
+        AGING_EVERY
+    with _defaults_lock:
+        if lane_depth is not None:
+            LANE_DEPTH = max(1, int(lane_depth))
+        if lane_bytes is not None:
+            LANE_BYTES = max(1, int(lane_bytes))
+        if max_batch is not None:
+            MAX_BATCH = max(1, int(max_batch))
+        if pipeline_depth is not None:
+            PIPELINE_DEPTH = max(1, int(pipeline_depth))
+        if aging_every is not None:
+            AGING_EVERY = max(0, int(aging_every))
+
+
+class VerifyTicket:
+    """Handle for one admitted submission: ``result(timeout)`` blocks
+    for the per-item bool array (libsodium-identical decisions, same
+    order as the submitted items). Raises
+    :class:`Overloaded` with ``kind="shed"`` when the load-shed ladder
+    dropped the submission, or the verifier's own exception if the
+    batch failed — an admitted submission ALWAYS resolves to exactly
+    one of verified / shed / failed, never silence."""
+
+    __slots__ = ("lane", "n_items", "_items", "_nbytes", "_digest",
+                 "_seq", "_t_enq", "_fut")
+
+    def __init__(self, lane: str, items, nbytes: int, digest: bytes,
+                 seq: int, t_enq: float):
+        from concurrent.futures import Future
+        self.lane = lane
+        self.n_items = len(items)
+        self._items = items
+        self._nbytes = nbytes
+        self._digest = digest
+        self._seq = seq
+        self._t_enq = t_enq
+        self._fut = Future()
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self._fut.result(timeout)
+
+
+class VerifyService:
+    """The resident stream processor (module docstring). One instance
+    owns one dispatcher thread; production uses the process-wide
+    :func:`default_service`. ``verifier`` may be any object with the
+    ``submit(items) -> resolver`` contract of
+    :class:`~stellar_tpu.crypto.batch_verifier.BatchVerifier`; None
+    resolves to the default verifier at :meth:`start`."""
+
+    def __init__(self, verifier=None,
+                 lane_depth: Optional[int] = None,
+                 lane_bytes: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 pipeline_depth: Optional[int] = None,
+                 aging_every: Optional[int] = None):
+        self._verifier = verifier
+        self._lane_depth = LANE_DEPTH if lane_depth is None \
+            else max(1, int(lane_depth))
+        self._lane_bytes = LANE_BYTES if lane_bytes is None \
+            else max(1, int(lane_bytes))
+        self._max_batch = MAX_BATCH if max_batch is None \
+            else max(1, int(max_batch))
+        self._pipeline_depth = PIPELINE_DEPTH if pipeline_depth is None \
+            else max(1, int(pipeline_depth))
+        self._aging_every = AGING_EVERY if aging_every is None \
+            else max(0, int(aging_every))
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {ln: deque() for ln in LANES}
+        self._queued_items = {ln: 0 for ln in LANES}
+        self._queued_bytes = {ln: 0 for ln in LANES}
+        self._inflight_bytes = {ln: 0 for ln in LANES}
+        self._inflight_items = 0
+        self._counts = {ln: {"submitted": 0, "verified": 0,
+                             "rejected": 0, "shed": 0, "failed": 0}
+                        for ln in LANES}
+        self._seq = 0
+        self._batches = 0
+        self._pressure = 0
+        self._shed_seen = False
+        self._running = False
+        self._stop = False
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------- public API ----------------
+
+    def start(self) -> "VerifyService":
+        """Spawn the dispatcher thread (idempotent) and register the
+        service's health snapshot with ``dispatch_health()``."""
+        with self._cv:
+            if self._running:
+                return self
+            if self._verifier is None:
+                self._verifier = batch_verifier.default_verifier()
+            self._running = True
+            self._stop = False
+            self._drain = True
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="verify-service")
+        self._thread.start()
+        batch_verifier.register_service_health(self.snapshot)
+        return self
+
+    def submit(self, items: Sequence[tuple],
+               lane: str = "bulk") -> VerifyTicket:
+        """Admit one submission of (pk, msg, sig) triples into
+        ``lane``. Raises :class:`Overloaded` (``kind="rejected"``) at
+        ingress when the lane's queue-depth or byte budget is
+        exhausted, or the service is stopping — rejected work never
+        enters a queue, so memory stays bounded no matter the offered
+        load."""
+        if lane not in LANES:
+            raise ValueError(f"unknown lane {lane!r} (one of {LANES})")
+        items = list(items)
+        n = len(items)
+        nbytes = 0
+        h = hashlib.sha256()   # shed digest: incremental, zero copies
+        for pk, msg, sig in items:
+            nbytes += len(pk) + len(msg) + len(sig)
+            h.update(pk)
+            h.update(msg)
+            h.update(sig)
+        digest = h.digest()
+        # clock read: latency stamp only — feeds the lane wait-time
+        # histogram, never a verify/shed decision (nondet allowlist)
+        t_enq = time.monotonic()
+        registry.meter("crypto.verify.service.submitted").mark(n)
+        registry.meter(
+            f"crypto.verify.service.lane.{lane}.submitted").mark(n)
+        with self._cv:
+            self._counts[lane]["submitted"] += n
+            reason = None
+            if self._stop or not self._running:
+                reason = "stopped"
+            elif len(self._queues[lane]) >= self._lane_depth:
+                reason = "queue-depth"
+            elif (self._queued_bytes[lane] + self._inflight_bytes[lane]
+                  + nbytes) > self._lane_bytes:
+                reason = "bytes"
+            if reason is not None:
+                self._counts[lane]["rejected"] += n
+                registry.meter(
+                    "crypto.verify.service.rejected").mark(n)
+                registry.meter(
+                    f"crypto.verify.service.lane.{lane}.rejected"
+                ).mark(n)
+                raise Overloaded(
+                    f"verify service {lane} lane over budget "
+                    f"({reason})", kind="rejected", lane=lane,
+                    reason=reason)
+            tkt = VerifyTicket(lane, items, nbytes, digest,
+                               self._seq, t_enq)
+            self._seq += 1
+            if n == 0:
+                tkt._fut.set_result(np.zeros(0, dtype=bool))
+                return tkt
+            self._queues[lane].append(tkt)
+            self._queued_items[lane] += n
+            self._queued_bytes[lane] += nbytes
+            self._cv.notify_all()
+        return tkt
+
+    def verify(self, items: Sequence[tuple], lane: str = "bulk",
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(items, lane=lane).result(timeout)
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop the dispatcher. ``drain=True`` (default) keeps
+        dispatching until the queues are empty — but the shed ladder
+        still applies while draining: a shutdown under persistent
+        overload pressure (breaker open / host-only / backlog) must
+        bound its own duration, so low-priority backlog may still
+        shed (counted + ticketed, like any shed) rather than hold the
+        node open. ``drain=False`` sheds the whole queued backlog
+        (reason ``"stopped"``) and only finishes work already in
+        flight. New submissions are rejected (``"stopped"``) from the
+        moment stop is called."""
+        with self._cv:
+            if not self._running:
+                return
+            self._stop = True
+            self._drain = drain
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        with self._cv:
+            self._running = False
+
+    def snapshot(self) -> dict:
+        """Health surface (``dispatch_health()["service"]`` / the
+        ``service`` admin route): per-lane depths, budgets, the
+        conservation-law counters, wait-time percentiles, pressure
+        level. ``conservation_gap`` is the law's residual and must
+        always read 0."""
+        with self._cv:
+            lanes = {}
+            totals = {"submitted": 0, "verified": 0, "rejected": 0,
+                      "shed": 0, "failed": 0}
+            for ln in LANES:
+                c = dict(self._counts[ln])
+                for k in totals:
+                    totals[k] += c[k]
+                t = registry.timer(
+                    f"crypto.verify.service.lane.{ln}.wait_ms")
+                p50, p99 = t.percentiles_ms((50, 99))
+                lanes[ln] = {
+                    "queued_submissions": len(self._queues[ln]),
+                    "queued_items": self._queued_items[ln],
+                    "queued_bytes": self._queued_bytes[ln],
+                    "inflight_bytes": self._inflight_bytes[ln],
+                    "wait_ms": {"count": t.count,
+                                "p50": round(p50, 3),
+                                "p99": round(p99, 3)},
+                    **c,
+                }
+            pending = (sum(self._queued_items[ln] for ln in LANES)
+                       + self._inflight_items)
+            return {
+                "running": self._running and not self._stop,
+                "pressure": self._pressure,
+                "shed_onset_seen": self._shed_seen,
+                "batches": self._batches,
+                "pending_items": pending,
+                "lanes": lanes,
+                "totals": totals,
+                "conservation_gap": (
+                    totals["submitted"] - totals["verified"]
+                    - totals["rejected"] - totals["shed"]
+                    - totals["failed"] - pending),
+                "knobs": {"lane_depth": self._lane_depth,
+                          "lane_bytes": self._lane_bytes,
+                          "max_batch": self._max_batch,
+                          "pipeline_depth": self._pipeline_depth,
+                          "aging_every": self._aging_every},
+            }
+
+    # ---------------- dispatcher internals ----------------
+    # _locked helpers are called with self._cv held (the repo-wide
+    # naming contract the lock lint encodes).
+
+    def _pressure_locked(self) -> tuple:
+        """(level, why): 2 = dispatch degraded (global breaker open /
+        host-only — capacity collapsed to the host oracle), 1 = bulk
+        backlog over high-water, 0 = healthy."""
+        if batch_verifier.dispatch_degraded():
+            return 2, "dispatch-degraded"
+        hw = max(1, int(self._lane_depth * SHED_HIGHWATER_FRAC))
+        if len(self._queues["bulk"]) >= hw:
+            return 1, "backlog"
+        return 0, ""
+
+    def _shed_pass_locked(self) -> Optional[str]:
+        """Apply the shed ladder to the queues at the current pressure
+        level. Row selection is the content-seeded rule
+        (:func:`stellar_tpu.crypto.audit.keep_under_shed`) so replicas
+        shed identical rows; every shed is counted and ticketed.
+        Returns the pressure reason when THIS pass was the first-ever
+        shed (the caller fires the flight-recorder dump outside the
+        lock), else None."""
+        level, why = self._pressure_locked()
+        self._pressure = level
+        registry.gauge("crypto.verify.service.pressure").set(level)
+        ladder = SHED_LADDER.get(level)
+        if not ladder:
+            return None
+        onset = None
+        for ln, keep in ladder.items():
+            q = self._queues[ln]
+            if not q:
+                continue
+            kept: deque = deque()
+            while q:
+                tkt = q.popleft()
+                if audit_mod.keep_under_shed(tkt._digest, keep):
+                    kept.append(tkt)
+                    continue
+                self._queued_items[ln] -= tkt.n_items
+                self._queued_bytes[ln] -= tkt._nbytes
+                self._counts[ln]["shed"] += tkt.n_items
+                registry.meter(
+                    "crypto.verify.service.shed").mark(tkt.n_items)
+                registry.meter(
+                    f"crypto.verify.service.lane.{ln}.shed"
+                ).mark(tkt.n_items)
+                if not self._shed_seen:
+                    self._shed_seen = True
+                    onset = why
+                tkt._fut.set_exception(Overloaded(
+                    f"shed under overload (level {level}: {why})",
+                    kind="shed", lane=ln, reason=why))
+            self._queues[ln] = kept
+        return onset
+
+    def _abort_queues_locked(self) -> None:
+        """Non-drain stop: shed every queued submission (counted,
+        ticketed — reason ``"stopped"``, never silent)."""
+        for ln in LANES:
+            q = self._queues[ln]
+            while q:
+                tkt = q.popleft()
+                self._queued_items[ln] -= tkt.n_items
+                self._queued_bytes[ln] -= tkt._nbytes
+                self._counts[ln]["shed"] += tkt.n_items
+                registry.meter(
+                    "crypto.verify.service.shed").mark(tkt.n_items)
+                registry.meter(
+                    f"crypto.verify.service.lane.{ln}.shed"
+                ).mark(tkt.n_items)
+                tkt._fut.set_exception(Overloaded(
+                    "service stopped without drain", kind="shed",
+                    lane=ln, reason="stopped"))
+
+    def _pick_lane_locked(self) -> Optional[str]:
+        """Priority order, with sequence-based aging: every
+        ``aging_every``-th batch serves the lane whose head submission
+        is globally oldest, so the bulk lane cannot starve behind a
+        sustained priority stream. Clock-free and deterministic in
+        arrival order."""
+        nonempty = [ln for ln in LANES if self._queues[ln]]
+        if not nonempty:
+            return None
+        if len(nonempty) > 1 and self._aging_every > 0 and \
+                self._batches % self._aging_every == \
+                self._aging_every - 1:
+            return min(nonempty,
+                       key=lambda ln: self._queues[ln][0]._seq)
+        return nonempty[0]
+
+    def _collect_locked(self):
+        """Coalesce queued submissions of ONE lane into a batch of up
+        to ``max_batch`` items (continuous batching into the
+        verifier's jit buckets). An oversize single submission rides
+        alone — the verifier chunks it. Returns (lane, items, parts)
+        or None; parts are (ticket, item_offset) pairs."""
+        ln = self._pick_lane_locked()
+        if ln is None:
+            return None
+        q = self._queues[ln]
+        items: list = []
+        parts = []
+        while q:
+            tkt = q[0]
+            if items and len(items) + tkt.n_items > self._max_batch:
+                break
+            q.popleft()
+            parts.append((tkt, len(items)))
+            items.extend(tkt._items)
+            self._queued_items[ln] -= tkt.n_items
+            self._queued_bytes[ln] -= tkt._nbytes
+            self._inflight_bytes[ln] += tkt._nbytes
+        self._inflight_items += len(items)
+        self._batches += 1
+        registry.gauge(
+            f"crypto.verify.service.depth.{ln}").set(len(q))
+        return (ln, items, parts)
+
+    def _resolve_one(self, ln: str, parts, resolver) -> None:
+        """Block on one in-flight dispatch and complete its tickets.
+        Counters update BEFORE futures complete, so a caller that
+        wakes on its ticket already sees consistent accounting."""
+        out = None
+        err: Optional[BaseException] = None
+        with span("service.resolve", lane=ln):
+            try:
+                out = np.asarray(resolver())
+            except BaseException as e:  # ticketed, never silent
+                err = e
+        n = sum(t.n_items for t, _ in parts)
+        nbytes = sum(t._nbytes for t, _ in parts)
+        if err is not None:
+            with self._cv:
+                self._inflight_items -= n
+                self._inflight_bytes[ln] -= nbytes
+                self._counts[ln]["failed"] += n
+            registry.meter("crypto.verify.service.failed").mark(n)
+            registry.meter(
+                f"crypto.verify.service.lane.{ln}.failed").mark(n)
+            for tkt, _off in parts:
+                tkt._fut.set_exception(err)
+            return
+        with self._cv:
+            self._inflight_items -= n
+            self._inflight_bytes[ln] -= nbytes
+            self._counts[ln]["verified"] += n
+        registry.meter("crypto.verify.service.verified").mark(n)
+        registry.meter(
+            f"crypto.verify.service.lane.{ln}.verified").mark(n)
+        # clock read: wait-time histogram stamp only (nondet allowlist)
+        now = time.monotonic()
+        timer = registry.timer(
+            f"crypto.verify.service.lane.{ln}.wait_ms")
+        for tkt, off in parts:
+            timer.update_ms((now - tkt._t_enq) * 1000.0)
+            tkt._fut.set_result(
+                np.array(out[off:off + tkt.n_items], dtype=bool))
+
+    def _run(self) -> None:
+        # in-flight dispatches are LOCAL to the dispatcher thread (the
+        # only thread that touches them); shared state stays under cv
+        inflight: deque = deque()
+        while True:
+            onset = None
+            batch = None
+            stopping = False
+            with self._cv:
+                while True:
+                    if self._stop and not self._drain:
+                        self._abort_queues_locked()
+                    o = self._shed_pass_locked()
+                    onset = onset or o
+                    batch = self._collect_locked()
+                    stopping = self._stop
+                    if batch is not None or inflight or stopping:
+                        break
+                    self._cv.wait(0.05)
+            if onset:
+                batch_verifier.note_shed_onset(onset)
+            if batch is not None:
+                ln, items, parts = batch
+                resolver = None
+                err: Optional[BaseException] = None
+                with span("service.dispatch", lane=ln,
+                          items=len(items)):
+                    try:
+                        resolver = self._verifier.submit(items)
+                    except BaseException as e:
+                        err = e
+                if err is not None:
+                    self._resolve_failed(ln, parts, err)
+                else:
+                    inflight.append((ln, parts, resolver))
+            if inflight and (batch is None or
+                             len(inflight) >= self._pipeline_depth):
+                self._resolve_one(*inflight.popleft())
+            if stopping and batch is None and not inflight:
+                break
+
+    def _resolve_failed(self, ln: str, parts,
+                        err: BaseException) -> None:
+        """A dispatch (host prep) failure: ticketed + counted as
+        failed — the collect already moved the items in-flight."""
+        n = sum(t.n_items for t, _ in parts)
+        nbytes = sum(t._nbytes for t, _ in parts)
+        with self._cv:
+            self._inflight_items -= n
+            self._inflight_bytes[ln] -= nbytes
+            self._counts[ln]["failed"] += n
+        registry.meter("crypto.verify.service.failed").mark(n)
+        registry.meter(
+            f"crypto.verify.service.lane.{ln}.failed").mark(n)
+        for tkt, _off in parts:
+            tkt._fut.set_exception(err)
+
+
+def lane_latencies() -> Dict[str, dict]:
+    """Per-lane wait-time histogram summaries (count/p50/p90/p99/sum)
+    — what ``bench.py``'s ``service`` record section and the soak
+    harness publish (``docs/benchmarks.md``)."""
+    out = {}
+    for ln in LANES:
+        t = registry.timer(f"crypto.verify.service.lane.{ln}.wait_ms")
+        p50, p90, p99 = t.percentiles_ms((50, 90, 99))
+        out[ln] = {"count": t.count, "p50_ms": round(p50, 3),
+                   "p90_ms": round(p90, 3), "p99_ms": round(p99, 3),
+                   "sum_ms": round(t.sum_ms(), 3)}
+    return out
+
+
+# ---------------- process-wide service ----------------
+
+_service: Optional[VerifyService] = None
+_service_lock = threading.Lock()
+
+
+def default_service(start: bool = True) -> VerifyService:
+    """Process-wide resident service over the default verifier
+    (created on first call; Application starts it when
+    ``VERIFY_SERVICE_ENABLED``)."""
+    global _service
+    with _service_lock:
+        if _service is None:
+            _service = VerifyService()
+        svc = _service
+    if start:
+        svc.start()
+    return svc
+
+
+def service_health() -> dict:
+    """The ``service`` admin-route payload: the process-wide service's
+    snapshot; falls back to whichever service instance last registered
+    with the dispatch layer (a node embedding its own instance still
+    gets an admin surface), else ``{"running": False}``."""
+    with _service_lock:
+        svc = _service
+    if svc is not None:
+        return svc.snapshot()
+    return batch_verifier.service_health_snapshot()

@@ -233,6 +233,25 @@ class Application:
                     config.VERIFY_DEVICE_FAILURE_THRESHOLD),
                 device_backoff_min_s=config.VERIFY_DEVICE_BACKOFF_MIN_S,
                 device_backoff_max_s=config.VERIFY_DEVICE_BACKOFF_MAX_S)
+        # resident verify service knobs (docs/robustness.md "Overload
+        # and load-shed") — pushed BEFORE the service could start, so
+        # the first admitted submission already runs under the
+        # configured budgets
+        if changed("VERIFY_SERVICE_LANE_DEPTH") or \
+                changed("VERIFY_SERVICE_LANE_BYTES") or \
+                changed("VERIFY_SERVICE_MAX_BATCH") or \
+                changed("VERIFY_SERVICE_PIPELINE_DEPTH") or \
+                changed("VERIFY_SERVICE_AGING_EVERY"):
+            from stellar_tpu.crypto import verify_service
+            verify_service.configure_service(
+                lane_depth=config.VERIFY_SERVICE_LANE_DEPTH,
+                lane_bytes=config.VERIFY_SERVICE_LANE_BYTES,
+                max_batch=config.VERIFY_SERVICE_MAX_BATCH,
+                pipeline_depth=config.VERIFY_SERVICE_PIPELINE_DEPTH,
+                aging_every=config.VERIFY_SERVICE_AGING_EVERY)
+        if config.VERIFY_SERVICE_ENABLED:
+            from stellar_tpu.crypto import verify_service
+            verify_service.default_service()
         # worker pool active => verify callers are concurrent (overlay
         # pre-verify, threaded replay): put the device batch verifier
         # behind a trickle window by default (VERDICT r3 #3 — a policy,

@@ -129,6 +129,17 @@ class CommandHandler:
         health["backend"] = keys.get_verifier_backend_name()
         return health
 
+    def cmd_service(self, params):
+        """Resident verify-service surface: per-lane queue depths,
+        the work-conservation counters (submitted == verified +
+        rejected + shed + failed + pending), wait-time percentiles
+        and the shed-ladder pressure level (docs/robustness.md
+        "Overload and load-shed"). Served directly — overload is
+        exactly when the main thread may be busy, and this surface
+        exists to explain overload (same policy as ``dispatch``)."""
+        from stellar_tpu.crypto import verify_service
+        return verify_service.service_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -592,6 +603,7 @@ class CommandHandler:
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
         "dispatch": cmd_dispatch, "spans": cmd_spans,
+        "service": cmd_service,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

@@ -175,6 +175,26 @@ class Config:
     # mismatch quarantines the device and flips verify host-only — a
     # corrupting chip must never decide signature validity.
     VERIFY_AUDIT_RATE: float = 0.02
+    # resident verify service (docs/robustness.md "Overload and
+    # load-shed"): the standing stream processor with priority lanes
+    # (scp > auth > bulk), bounded per-lane queues, and the
+    # deterministic load-shed ladder. Disabled by default — nodes that
+    # want the streaming entry point opt in; the batch/trickle paths
+    # are unaffected either way.
+    VERIFY_SERVICE_ENABLED: bool = False
+    # max queued submissions per lane — past this, ingress rejects
+    # with a typed Overloaded instead of buffering
+    VERIFY_SERVICE_LANE_DEPTH: int = 512
+    # per-lane byte budget over queued + in-flight work
+    VERIFY_SERVICE_LANE_BYTES: int = 16_000_000
+    # max items coalesced into one dispatch (continuous batching into
+    # the jit buckets)
+    VERIFY_SERVICE_MAX_BATCH: int = 2048
+    # dispatches kept in flight (host prep overlaps device execution)
+    VERIFY_SERVICE_PIPELINE_DEPTH: int = 4
+    # starvation-proofing: every Nth batch serves the globally-oldest
+    # lane head regardless of priority (0 disables aging)
+    VERIFY_SERVICE_AGING_EVERY: int = 4
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

@@ -284,11 +284,23 @@ class MetricsRegistry:
         return self._get(name, Gauge)
 
     def to_dict(self) -> dict:
+        return self.find("")
+
+    def find(self, prefix: str) -> dict:
+        """Rendered snapshot of every metric whose dotted name starts
+        with ``prefix`` (``""`` = the whole registry — ``to_dict``) —
+        the subsystem-scoped export behind the soak harness's
+        accounting cross-check (``tools/soak.py`` proves the verify
+        service's conservation counters against the
+        ``crypto.verify.service.*`` meters) and ad-hoc admin queries.
+        The name walk snapshots under the registry lock (iterating the
+        live dict while a first-mark thread inserts would raise on the
+        metrics endpoint); rendering happens outside it, on the
+        per-metric locks."""
         with self._lock:
-            # snapshot under the lock: iterating the live dict while a
-            # first-mark thread inserts raises "dictionary changed size
-            # during iteration" on the metrics endpoint
-            items = sorted(self._metrics.items())
+            items = sorted((name, m) for name, m in
+                           self._metrics.items()
+                           if name.startswith(prefix))
         return {name: m.to_dict() for name, m in items}
 
     def timer_totals(self) -> Dict[str, dict]:

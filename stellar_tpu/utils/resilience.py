@@ -34,7 +34,7 @@ from stellar_tpu.utils import tracing
 
 __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN",
-    "CircuitBreaker", "Deadline", "DeadlineExceeded",
+    "CircuitBreaker", "Deadline", "DeadlineExceeded", "Overloaded",
     "WatchdogPool", "call_with_deadline", "watchdog_stats",
 ]
 
@@ -45,6 +45,30 @@ HALF_OPEN = "half-open"
 
 class DeadlineExceeded(Exception):
     """A guarded call did not finish within its budget."""
+
+
+class Overloaded(RuntimeError):
+    """Typed admission-control verdict: the system chose to REFUSE or
+    DROP work rather than buffer unboundedly (docs/robustness.md,
+    "Overload and load-shed"). Two kinds:
+
+    * ``kind="rejected"`` — refused at INGRESS (queue depth or byte
+      budget exceeded, or the service is stopping): the work never
+      entered a queue;
+    * ``kind="shed"`` — admitted, then dropped by the deterministic
+      load-shed ladder under overload pressure: the caller learns via
+      this exception from its ticket, never by silence.
+
+    ``lane`` names the priority lane (or ``"trickle"`` for the
+    micro-batch window), ``reason`` the specific budget that tripped
+    (``"queue-depth"``, ``"bytes"``, ``"backlog"``, ...)."""
+
+    def __init__(self, message: str, *, kind: str = "rejected",
+                 lane: Optional[str] = None, reason: str = ""):
+        super().__init__(message)
+        self.kind = kind
+        self.lane = lane
+        self.reason = reason
 
 
 class Deadline:

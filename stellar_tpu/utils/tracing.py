@@ -24,10 +24,17 @@ Model:
   exactly what makes a HUNG fetch attributable in a dump.
 * the :class:`FlightRecorder` keeps the last N completed spans plus
   every still-open span in memory; ``dump(reason)`` snapshots both on
-  breaker trips, audit mismatches and watchdog timeouts
-  (``crypto/batch_verifier.py`` wires the triggers) so the spans
+  breaker trips, audit mismatches, watchdog timeouts and the verify
+  service's first load-shed onset (``service-shed:<why>`` —
+  ``crypto/batch_verifier.py`` wires all the triggers) so the spans
   leading into a failure survive to be read from the ``spans`` admin
   route. See ``docs/observability.md``.
+* **span phase families**: ``verify.*`` phases attribute one blocking
+  resolve (``batch_verifier.RESOLVE_PHASES``); ``service.dispatch`` /
+  ``service.resolve`` wrap the resident verify service's continuous-
+  batching cycle (``crypto/verify_service.py``), so a recorder dump
+  taken under overload shows which lane's batch each in-flight
+  dispatch is serving.
 
 Determinism: this module is clock-bearing BY DESIGN (``perf_counter``
 pairs). Its timings feed metrics and the recorder, never decisions —

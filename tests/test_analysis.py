@@ -523,6 +523,25 @@ def test_nondet_lint_scope_covers_audit_sampler():
     assert "stellar_tpu/parallel/device_health.py" in scope
 
 
+def test_lint_scopes_cover_verify_service():
+    """ISSUE 6: the resident verify service mutates lane queues and
+    conservation counters from caller + dispatcher threads (lock
+    lint), and decides WHICH work verifies vs sheds under overload —
+    the shed rule must stay content-seeded and the scheduler
+    clock-free (nondet lint; its only clock use is the allowlisted
+    latency stamps, which must keep a written safety argument)."""
+    assert "stellar_tpu/crypto/verify_service.py" in set(locks.SCOPE)
+    assert "stellar_tpu/crypto/verify_service.py" in \
+        set(nondet.HOST_ORACLE_FILES)
+    entry = nondet.ALLOWLIST._entries.get(
+        "stellar_tpu/crypto/verify_service.py", {})
+    assert set(entry) == {"nondet:clock"}
+    assert "never" in entry["nondet:clock"] or \
+        "only" in entry["nondet:clock"]  # a real safety argument
+    # the shed rule itself lives in the audit module — already scoped
+    assert "stellar_tpu/crypto/audit.py" in set(nondet.HOST_ORACLE_FILES)
+
+
 def test_lock_lint_scope_covers_tracing_ring():
     """ISSUE 5: the flight-recorder ring + active-span map mutate from
     resolver, pool-worker and breaker-callback threads; the reservoir

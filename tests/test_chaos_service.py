@@ -1,0 +1,440 @@
+"""Chaos suite for the resident verify service (ISSUE 6 /
+``docs/robustness.md`` "Overload and load-shed"): under tx-flood
+saturation, breaker-open pressure and non-drain shutdown the service
+must (a) keep the SCP-priority lane served while the bulk lane
+rejects/sheds, (b) bound memory by refusing at ingress with a typed
+``Overloaded``, (c) shed deterministically by content, and (d) uphold
+the work-conservation law exactly — submitted == verified + rejected +
+shed + failed + pending at every instant, no silent drops.
+
+Everything here is CPU-safe: saturation comes from gate/sleep stub
+verifiers (deterministic, no device), and the one real-verifier test
+reuses bucket 16 — a size the rest of tier-1 already compiles (PR 2
+compile-cost note)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_verify_differential import make_valid
+
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.crypto import ed25519_ref as ref
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.crypto.batch_verifier import BatchVerifier, TrickleBatcher
+from stellar_tpu.utils import faults, resilience, tracing
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def service_sandbox():
+    """Process-start dispatch state, no faults, no registered service
+    health provider — and none of it left behind."""
+    faults.clear()
+    bv._reset_dispatch_state_for_testing()
+    saved = (bv.DEADLINE_MS, bv.DISPATCH_RETRIES, bv._breaker._threshold,
+             bv._breaker._backoff_min, bv._breaker._backoff_max,
+             bv.AUDIT_RATE)
+    bv.configure_dispatch(deadline_ms=10_000, dispatch_retries=1,
+                          failure_threshold=3, backoff_min_s=0.05,
+                          backoff_max_s=0.2)
+    yield
+    faults.clear()
+    bv.configure_dispatch(deadline_ms=saved[0], dispatch_retries=saved[1],
+                          failure_threshold=saved[2],
+                          backoff_min_s=saved[3], backoff_max_s=saved[4],
+                          audit_rate=saved[5])
+    bv.register_service_health(None)
+    bv._reset_dispatch_state_for_testing()
+
+
+class GateVerifier:
+    """Deterministic BatchVerifier stand-in: resolvers block on a
+    gate (closed = a wedged/slow device), then answer all-True."""
+
+    def __init__(self, resolve_sleep_s: float = 0.0):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.sleep_s = resolve_sleep_s
+        self.calls = 0
+
+    def submit(self, items):
+        self.calls += 1
+        n = len(items)
+
+        def resolver():
+            assert self.gate.wait(timeout=30), "gate never opened"
+            if self.sleep_s:
+                time.sleep(self.sleep_s)
+            return np.ones(n, dtype=bool)
+        return resolver
+
+
+def _distinct_items(i, n=2):
+    """n syntactically-valid rows whose BYTES vary with ``i`` — the
+    shed rule draws per-submission content digests, so submissions
+    must differ for a mixed shed outcome."""
+    pk = bytes([(i * 7 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"m%d-%d" % (i, k), bytes([(i + k) % 251]) * 64)
+            for k in range(n)]
+
+
+def _drain(tickets, timeout=30):
+    """(verified, shed) split of a ticket list; anything else raises."""
+    done, shed = [], []
+    for t in tickets:
+        try:
+            done.append((t, t.result(timeout)))
+        except vs.Overloaded as e:
+            assert e.kind == "shed", e.kind
+            shed.append((t, e))
+    return done, shed
+
+
+def _assert_conserved(svc):
+    snap = svc.snapshot()
+    assert snap["conservation_gap"] == 0, snap
+    return snap
+
+
+# ---------------- admission control / backpressure ----------------
+
+
+def test_backpressure_rejects_at_ingress_before_memory_growth():
+    """With the dispatcher wedged, offered load beyond the queue-depth
+    and byte budgets must be REFUSED at ingress (typed Overloaded,
+    counted), never buffered: queue size stays hard-bounded no matter
+    how much is thrown at the service. (The auth lane is used so the
+    bulk-backlog shed ladder stays out of the picture — this test is
+    pure admission control.)"""
+    g = GateVerifier()
+    g.gate.clear()                      # wedge the device
+    svc = vs.VerifyService(verifier=g, lane_depth=8,
+                           lane_bytes=10**6, max_batch=4,
+                           pipeline_depth=2, aging_every=4).start()
+    tickets, rejects = [], []
+    for i in range(100):
+        try:
+            tickets.append(svc.submit(_distinct_items(i), lane="auth"))
+        except vs.Overloaded as e:
+            assert e.kind == "rejected" and e.lane == "auth"
+            rejects.append(e.reason)
+    snap = _assert_conserved(svc)
+    assert rejects, "depth budget never tripped"
+    assert snap["lanes"]["auth"]["queued_submissions"] <= 8
+    assert snap["lanes"]["auth"]["rejected"] == 2 * len(rejects)
+    # byte budget: one oversize submission against a tiny-bytes lane
+    svc2 = vs.VerifyService(verifier=g, lane_depth=100, lane_bytes=64,
+                            max_batch=4, pipeline_depth=2).start()
+    with pytest.raises(vs.Overloaded) as ei:
+        svc2.submit(_distinct_items(0), lane="auth")
+    assert ei.value.reason == "bytes" and ei.value.kind == "rejected"
+    svc2.stop(timeout=10)
+    g.gate.set()                        # recovery: backlog drains
+    done, shed = _drain(tickets)
+    assert done and not shed            # healthy pressure: nothing shed
+    assert all(r.all() for _t, r in done)
+    svc.stop(drain=True, timeout=30)
+    snap = _assert_conserved(svc)
+    assert snap["pending_items"] == 0
+    t = snap["totals"]
+    assert t["submitted"] == t["verified"] + t["rejected"] + \
+        t["shed"] + t["failed"]
+
+
+def test_lane_isolation_scp_served_while_bulk_saturated():
+    """Priority admission/scheduling: with the bulk lane saturated
+    behind a slow device, SCP-lane work overtakes the backlog — its
+    tickets complete while bulk is still queued, in a fraction of the
+    drain wall time. (Latency PERCENTILES live in the process-global
+    lane histograms, which accumulate across tests, so the bound here
+    is measured locally; the histogram feature itself is pinned by
+    the fresh-process soak gate.)"""
+    before = {ln: vs.lane_latencies()[ln]["count"]
+              for ln in ("scp", "bulk")}
+    g = GateVerifier(resolve_sleep_s=0.02)
+    svc = vs.VerifyService(verifier=g, lane_depth=64,
+                           lane_bytes=10**7, max_batch=2,
+                           pipeline_depth=1, aging_every=0).start()
+    t0 = time.monotonic()
+    bulk = [svc.submit(_distinct_items(i), lane="bulk")
+            for i in range(30)]
+    scp = [svc.submit(_distinct_items(1000 + i), lane="scp")
+           for i in range(5)]
+    for t in scp:
+        t.result(timeout=30)
+    scp_wall = time.monotonic() - t0
+    # every scp ticket done while bulk backlog still queued
+    assert svc.snapshot()["lanes"]["bulk"]["queued_submissions"] > 0
+    done, shed = _drain(bulk)
+    total_wall = time.monotonic() - t0
+    assert len(done) == 30 and not shed
+    svc.stop(drain=True, timeout=30)
+    # isolation: the priority lane cleared in well under the time the
+    # saturated bulk lane needed (30 batches x 20 ms of device time)
+    assert scp_wall < total_wall / 3, (scp_wall, total_wall)
+    after = vs.lane_latencies()
+    assert after["scp"]["count"] - before["scp"] == 5
+    assert after["bulk"]["count"] - before["bulk"] == 30
+    _assert_conserved(svc)
+
+
+# ---------------- deterministic load-shed ladder ----------------
+
+
+def test_breaker_open_shed_ladder_sheds_bulk_first_scp_survives():
+    """Global-breaker pressure (shed level 2): bulk backlog sheds by
+    the content rule (typed Overloaded kind=shed, counted, flight-
+    recorder dump on first onset), the SCP lane is never shed, and
+    the conservation law holds through the whole episode."""
+    tracing.flight_recorder.clear()
+    bv.configure_dispatch(backoff_min_s=30.0, backoff_max_s=60.0)
+    bv._breaker.trip()                  # stays OPEN for the test
+    assert bv.dispatch_degraded()
+    g = GateVerifier(resolve_sleep_s=0.005)
+    svc = vs.VerifyService(verifier=g, lane_depth=256,
+                           lane_bytes=10**7, max_batch=2,
+                           pipeline_depth=1, aging_every=4).start()
+    bulk = [svc.submit(_distinct_items(i), lane="bulk")
+            for i in range(40)]
+    scp = [svc.submit(_distinct_items(2000 + i), lane="scp")
+           for i in range(6)]
+    done_b, shed_b = _drain(bulk)
+    done_s, shed_s = _drain(scp)
+    svc.stop(drain=True, timeout=30)
+    assert shed_b, "level-2 pressure never shed bulk work"
+    assert all(e.reason == "dispatch-degraded" for _t, e in shed_b)
+    assert len(done_s) == 6 and not shed_s  # scp NEVER shed
+    snap = _assert_conserved(svc)
+    assert snap["lanes"]["scp"]["shed"] == 0
+    assert snap["lanes"]["bulk"]["shed"] == 2 * len(shed_b)
+    assert snap["shed_onset_seen"]
+    assert any(d["reason"].startswith("service-shed")
+               for d in tracing.flight_recorder.dumps()), \
+        [d["reason"] for d in tracing.flight_recorder.dumps()]
+
+
+def test_shed_selection_is_deterministic_in_content():
+    """Replicas under identical pressure shed IDENTICAL rows: two
+    services fed the same submissions under the same breaker pressure
+    shed exactly the same content (and audit.keep_under_shed is a pure
+    function of the bytes)."""
+    from stellar_tpu.crypto import audit
+    assert audit.keep_under_shed(b"x", 1.0) is True
+    assert audit.keep_under_shed(b"x", 0.0) is False
+    draws = [audit.keep_under_shed(bytes([i]) * 16, 0.5)
+             for i in range(200)]
+    assert draws == [audit.keep_under_shed(bytes([i]) * 16, 0.5)
+                     for i in range(200)]          # pure
+    assert 40 < sum(draws) < 160                   # actually mixed
+
+    bv.configure_dispatch(backoff_min_s=30.0, backoff_max_s=60.0)
+    bv._breaker.trip()
+
+    def run_replica():
+        g = GateVerifier()
+        g.gate.clear()                  # everything queues first
+        svc = vs.VerifyService(verifier=g, lane_depth=256,
+                               lane_bytes=10**7, max_batch=2,
+                               pipeline_depth=1).start()
+        tickets = [(i, svc.submit(_distinct_items(i), lane="bulk"))
+                   for i in range(60)]
+        g.gate.set()
+        shed_ids = set()
+        for i, t in tickets:
+            try:
+                t.result(timeout=30)
+            except vs.Overloaded:
+                shed_ids.add(i)
+        svc.stop(drain=True, timeout=30)
+        _assert_conserved(svc)
+        return shed_ids
+
+    a, b = run_replica(), run_replica()
+    assert a and a == b
+
+
+def test_stop_without_drain_sheds_backlog_accounted():
+    """Non-drain shutdown must not drop work silently: the queued
+    backlog is ticketed shed (reason=stopped) and counted, work
+    already in flight still completes, and post-stop submissions are
+    rejected."""
+    g = GateVerifier()
+    g.gate.clear()                      # dispatcher wedges in-flight
+    svc = vs.VerifyService(verifier=g, lane_depth=64,
+                           lane_bytes=10**7, max_batch=2,
+                           pipeline_depth=1).start()
+    tickets = [svc.submit(_distinct_items(i), lane="bulk")
+               for i in range(10)]
+    # wait for the dispatcher to wedge with the first batch IN FLIGHT,
+    # so "in-flight completes, backlog sheds" is deterministic
+    deadline = time.monotonic() + 10
+    while g.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert g.calls >= 1
+    # stop lands while that batch is still wedged (the join times out)
+    svc.stop(drain=False, timeout=0.2)
+    with pytest.raises(vs.Overloaded) as ei:
+        svc.submit(_distinct_items(0), lane="bulk")
+    assert ei.value.reason == "stopped"
+    g.gate.set()                        # in-flight completes, loop exits
+    svc._thread.join(timeout=20)
+    assert not svc._thread.is_alive()
+    done, shed = _drain(tickets, timeout=10)
+    assert done, "in-flight work must still complete"
+    assert all(r.all() for _t, r in done)
+    assert len(shed) >= 8
+    assert all(e.reason == "stopped" for _t, e in shed)
+    snap = _assert_conserved(svc)
+    assert snap["pending_items"] == 0
+
+
+# ---------------- starvation-proof aging ----------------
+
+
+def test_aging_serves_oldest_lane_head_despite_priority():
+    """Every aging_every-th batch serves the lane whose head is
+    globally OLDEST (sequence-based, clock-free): a bulk submission
+    parked behind a sustained scp stream still gets scheduled."""
+    svc = vs.VerifyService(verifier=GateVerifier(), lane_depth=64,
+                           lane_bytes=10**7, max_batch=2,
+                           pipeline_depth=1, aging_every=3)
+    svc._running = True                 # scheduling unit: no thread
+    svc.submit(_distinct_items(0), lane="bulk")     # oldest (seq 0)
+    for i in range(8):
+        svc.submit(_distinct_items(100 + i), lane="scp")
+    order = []
+    with svc._cv:
+        for _ in range(3):
+            order.append(svc._collect_locked()[0])
+    # priority serves scp twice, then the aging slot picks the
+    # globally-oldest head — the starved bulk submission
+    assert order == ["scp", "scp", "bulk"]
+
+
+def test_recovery_drains_aged_backlog_bit_identical():
+    """Real verifier, injected transient dispatch failures: after the
+    fault heals, the aged backlog (bulk + scp) drains completely with
+    libsodium-identical decisions, and the law balances with zero
+    failed items — host-fallback rows included."""
+    v = BatchVerifier(bucket_sizes=(16,))
+    valid = make_valid(3)
+    pool = valid + [
+        (b"", b"m", b"s" * 64),                   # bad pk length
+        (valid[0][0], b"tampered", b"s" * 64),    # garbage signature
+    ]
+    want_pool = np.array([ref.verify(pk, m, s) for pk, m, s in pool])
+    # warm the bucket-16 executable BEFORE arming the fault: ticket
+    # timeouts below must measure queue behavior, not the one-off XLA
+    # compile/cache load (PR 2 compile-cost note)
+    assert (v.verify_batch(pool) == want_pool).all()
+    faults.set_fault(faults.DISPATCH, "failn", 2)
+    bv.configure_dispatch(dispatch_retries=0)
+    svc = vs.VerifyService(verifier=v, lane_depth=64,
+                           lane_bytes=10**7, max_batch=16,
+                           pipeline_depth=2, aging_every=4).start()
+    subs = []
+    for i in range(12):
+        idx = [(i + j) % len(pool) for j in range(4)]
+        lane = "scp" if i % 3 == 0 else "bulk"
+        subs.append((svc.submit([pool[k] for k in idx], lane=lane),
+                     want_pool[idx]))
+    mism = []
+    for t, want in subs:
+        got = t.result(timeout=60)
+        if not (got == want).all():
+            mism.append((got, want))
+    assert not mism, mism
+    svc.stop(drain=True, timeout=30)
+    snap = _assert_conserved(svc)
+    t = snap["totals"]
+    assert t["failed"] == 0 and t["shed"] == 0 and t["rejected"] == 0
+    assert t["submitted"] == t["verified"] == 48
+    # the injected failures really did reroute rows through the host
+    assert v.served["host-fallback"] > 0
+
+
+# ---------------- bounded trickle window ----------------
+
+
+def test_trickle_bound_overloads_and_flush_races_window_close():
+    """ISSUE 6 satellite: the trickle window's queue is bounded (typed
+    Overloaded at ingress) and flush() dispatches the pending window
+    early without racing the leader — all transitions under the
+    window lock, every parked future resolves."""
+    class VB:
+        def __init__(self):
+            self.batches = []
+
+        def verify_batch(self, items):
+            self.batches.append(len(items))
+            return np.ones(len(items), dtype=bool)
+
+    vb = VB()
+    batcher = TrickleBatcher(vb, window_ms=60_000.0, max_batch=100,
+                             max_pending=3)
+    items = make_valid(3)
+    results = [None] * 3
+
+    def call(i):
+        results[i] = batcher.verify_sig(*items[i])
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with batcher._cv:
+            if len(batcher._pending) == 3:
+                break
+        time.sleep(0.005)
+    with batcher._cv:
+        assert len(batcher._pending) == 3
+    # the bounded queue refuses the 4th caller instead of growing
+    with pytest.raises(resilience.Overloaded) as ei:
+        batcher.verify_sig(*make_valid(1)[0])
+    assert ei.value.lane == "trickle" and batcher.rejected == 1
+    # flush wakes the 60s-window leader early; nobody waits it out
+    assert batcher.flush() == 0          # leader owns the batch
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert results == [True] * 3 and vb.batches == [3]
+    assert batcher._pending == [] and not batcher._leader_active
+    # leaderless flush claims a racing enqueue itself
+    from concurrent.futures import Future
+    fut = Future()
+    with batcher._cv:
+        batcher._pending.append((items[0], fut))
+    assert batcher.flush() == 1 and fut.result(timeout=5) is True
+    assert batcher.flush() == 0          # empty window: no-op
+
+
+# ---------------- health surfaces ----------------
+
+
+def test_service_health_rides_dispatch_health_and_route():
+    g = GateVerifier()
+    svc = vs.VerifyService(verifier=g, lane_depth=8, max_batch=4,
+                           pipeline_depth=1).start()
+    svc.verify(_distinct_items(7), lane="auth", timeout=30)
+    health = bv.dispatch_health()
+    assert health["service"]["running"] is True
+    assert health["service"]["lanes"]["auth"]["verified"] == 2
+    assert health["service"]["conservation_gap"] == 0
+    snap = svc.snapshot()
+    assert set(snap["totals"]) == {"submitted", "verified", "rejected",
+                                   "shed", "failed"}
+    assert set(snap["knobs"]) == {"lane_depth", "lane_bytes",
+                                  "max_batch", "pipeline_depth",
+                                  "aging_every"}
+    svc.stop(drain=True, timeout=10)
+    # the admin route serves the module-level service (none started
+    # here) without touching app state
+    from stellar_tpu.main.command_handler import CommandHandler
+    out = CommandHandler.cmd_service(object(), {})
+    assert "running" in out

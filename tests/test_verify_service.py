@@ -1,0 +1,192 @@
+"""Unit tests for the resident verify service (ISSUE 6): admission
+accounting, the typed Overloaded verdict, the content-seeded shed
+rule, knob pushes, and the health surfaces. Saturation/chaos behavior
+lives in ``tests/test_chaos_service.py``; everything here is
+stub-verifier fast."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import audit
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.utils import resilience
+
+
+@pytest.fixture(autouse=True)
+def _unregister_health():
+    yield
+    bv.register_service_health(None)
+
+
+class InstantVerifier:
+    def __init__(self):
+        self.calls = 0
+
+    def submit(self, items):
+        self.calls += 1
+        n = len(items)
+        return lambda: np.ones(n, dtype=bool)
+
+
+def _items(i, n=2):
+    pk = bytes([(i * 11 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"u%d-%d" % (i, k), bytes([(i + k) % 251]) * 64)
+            for k in range(n)]
+
+
+def test_overloaded_is_typed_and_carries_context():
+    e = resilience.Overloaded("full", kind="shed", lane="bulk",
+                              reason="backlog")
+    assert isinstance(e, RuntimeError)
+    assert (e.kind, e.lane, e.reason) == ("shed", "bulk", "backlog")
+    assert vs.Overloaded is resilience.Overloaded  # one type, re-exported
+
+
+def test_keep_under_shed_content_seeded():
+    """The shed rule is a pure function of the bytes: deterministic,
+    boundary-exact, and roughly proportional to keep_fraction."""
+    assert audit.keep_under_shed(b"anything", 1.0) is True
+    assert audit.keep_under_shed(b"anything", 0.0) is False
+    mats = [bytes([i, (i * 7) % 256]) * 24 for i in range(200)]
+    kept = [audit.keep_under_shed(m, 0.5) for m in mats]
+    assert kept == [audit.keep_under_shed(m, 0.5) for m in mats]
+    assert 60 < sum(kept) < 140           # ~50%, loose bound
+    # monotone in the fraction: a row kept at 0.25 is kept at 0.75
+    for m in mats:
+        if audit.keep_under_shed(m, 0.25):
+            assert audit.keep_under_shed(m, 0.75)
+
+
+def test_submit_validations_and_empty_batch():
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=4,
+                           max_batch=8, pipeline_depth=1)
+    with pytest.raises(ValueError):
+        svc.submit(_items(0), lane="nope")
+    # not started: typed rejection, still counted
+    with pytest.raises(vs.Overloaded) as ei:
+        svc.submit(_items(0), lane="bulk")
+    assert ei.value.reason == "stopped"
+    snap = svc.snapshot()
+    assert snap["lanes"]["bulk"]["submitted"] == 2
+    assert snap["lanes"]["bulk"]["rejected"] == 2
+    assert snap["conservation_gap"] == 0
+    svc.start()
+    # empty submission resolves immediately (no queue traffic)
+    t = svc.submit([], lane="scp")
+    assert t.done() and t.result(1).shape == (0,)
+    out = svc.verify(_items(1), lane="scp", timeout=10)
+    assert out.tolist() == [True, True]
+    svc.stop(drain=True, timeout=10)
+    assert svc.snapshot()["conservation_gap"] == 0
+
+
+def test_service_snapshot_shape_and_lanes():
+    svc = vs.VerifyService(verifier=InstantVerifier()).start()
+    svc.verify(_items(2), lane="auth", timeout=10)
+    snap = svc.snapshot()
+    assert set(snap["lanes"]) == set(vs.LANES) == {"scp", "auth",
+                                                   "bulk"}
+    for ln in vs.LANES:
+        assert set(snap["lanes"][ln]) >= {
+            "queued_submissions", "queued_items", "queued_bytes",
+            "inflight_bytes", "wait_ms", "submitted", "verified",
+            "rejected", "shed", "failed"}
+    assert snap["lanes"]["auth"]["verified"] == 2
+    assert snap["running"] is True
+    svc.stop(drain=True, timeout=10)
+    assert svc.snapshot()["running"] is False
+
+
+def test_configure_service_clamps_and_applies():
+    saved = (vs.LANE_DEPTH, vs.LANE_BYTES, vs.MAX_BATCH,
+             vs.PIPELINE_DEPTH, vs.AGING_EVERY)
+    try:
+        vs.configure_service(lane_depth=0, lane_bytes=-5, max_batch=7,
+                             pipeline_depth=0, aging_every=-1)
+        assert (vs.LANE_DEPTH, vs.LANE_BYTES, vs.MAX_BATCH,
+                vs.PIPELINE_DEPTH, vs.AGING_EVERY) == (1, 1, 7, 1, 0)
+        svc = vs.VerifyService(verifier=InstantVerifier())
+        assert svc.snapshot()["knobs"] == {
+            "lane_depth": 1, "lane_bytes": 1, "max_batch": 7,
+            "pipeline_depth": 1, "aging_every": 0}
+    finally:
+        vs.configure_service(lane_depth=saved[0], lane_bytes=saved[1],
+                             max_batch=saved[2],
+                             pipeline_depth=saved[3],
+                             aging_every=saved[4])
+
+
+def test_config_knobs_push_to_service(tmp_path):
+    """The VERIFY_SERVICE_* Config knobs exist with the documented
+    defaults and Application pushes non-default values through
+    configure_service (same policy as the dispatch knobs)."""
+    from stellar_tpu.main.config import Config
+    cfg = Config()
+    assert cfg.VERIFY_SERVICE_ENABLED is False
+    assert cfg.VERIFY_SERVICE_LANE_DEPTH == 512
+    assert cfg.VERIFY_SERVICE_LANE_BYTES == 16_000_000
+    assert cfg.VERIFY_SERVICE_MAX_BATCH == 2048
+    assert cfg.VERIFY_SERVICE_PIPELINE_DEPTH == 4
+    assert cfg.VERIFY_SERVICE_AGING_EVERY == 4
+    saved = (vs.LANE_DEPTH, vs.LANE_BYTES, vs.MAX_BATCH,
+             vs.PIPELINE_DEPTH, vs.AGING_EVERY)
+    try:
+        from stellar_tpu.main.application import Application
+        cfg.VERIFY_SERVICE_LANE_DEPTH = 99
+        cfg.VERIFY_SERVICE_AGING_EVERY = 7
+        Application._apply_global_config(object.__new__(Application),
+                                         cfg)
+        assert vs.LANE_DEPTH == 99 and vs.AGING_EVERY == 7
+    finally:
+        vs.configure_service(lane_depth=saved[0], lane_bytes=saved[1],
+                             max_batch=saved[2],
+                             pipeline_depth=saved[3],
+                             aging_every=saved[4])
+
+
+def test_dispatch_health_and_service_route_surface():
+    health = bv.dispatch_health()
+    assert "service" in health           # present even with no service
+    svc = vs.VerifyService(verifier=InstantVerifier()).start()
+    try:
+        assert bv.dispatch_health()["service"]["running"] is True
+    finally:
+        svc.stop(timeout=10)
+    from stellar_tpu.main.command_handler import CommandHandler
+    assert "service" in CommandHandler.ROUTES
+    out = CommandHandler.cmd_service(object(), {})
+    assert "running" in out
+
+
+def test_service_meters_mirror_counts():
+    from stellar_tpu.utils.metrics import registry
+    before = {k: registry.meter(f"crypto.verify.service.{k}").count
+              for k in ("submitted", "verified", "rejected")}
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=8,
+                           max_batch=4, pipeline_depth=1).start()
+    svc.verify(_items(0), lane="bulk", timeout=10)
+    svc.stop(drain=True, timeout=10)
+    after = {k: registry.meter(f"crypto.verify.service.{k}").count
+             for k in ("submitted", "verified", "rejected")}
+    assert after["submitted"] - before["submitted"] == 2
+    assert after["verified"] - before["verified"] == 2
+    assert after["rejected"] == before["rejected"]
+    # the prefix query surfaces the whole subsystem for ops tooling
+    found = registry.find("crypto.verify.service.")
+    assert any(k.endswith(".submitted") for k in found)
+
+
+def test_trickle_flush_empty_and_bound_param():
+    from stellar_tpu.crypto.batch_verifier import TrickleBatcher
+
+    class VB:
+        def verify_batch(self, items):
+            return np.ones(len(items), dtype=bool)
+
+    b = TrickleBatcher(VB(), window_ms=1.0, max_pending=1)
+    assert b.flush() == 0                # empty window is a no-op
+    assert b.verify_sig(*_items(0)[0]) in (True, False)
+    assert b.rejected == 0

@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Soak/load harness for the resident verify service — the standing
+scale scenario (ROADMAP "continuous-batching verify service").
+
+Drives a sustained tx-flood through
+:class:`stellar_tpu.crypto.verify_service.VerifyService` with a
+flapping device injected (``flaky-device:0`` via
+``stellar_tpu.utils.faults``), the result-integrity audit sampling ON,
+and a mid-run global-breaker trip, then proves the overload story
+end-to-end:
+
+* **work conservation** (the law the tier-1 ``SOAK_OK`` gate pins):
+  ``submitted == verified + rejected + shed`` exactly, ``failed == 0``,
+  ``pending == 0`` after drain — no item is ever silently dropped;
+* **metrics accounting**: the service's counters agree with the
+  ``crypto.verify.service.*`` meters and the conservation totals
+  appear in the Prometheus exposition (the PR 5 export layer);
+* **lane isolation**: the SCP-priority lane's p99 wait stays bounded
+  while the bulk lane rejects at ingress AND sheds from the backlog
+  (typed ``Overloaded`` both ways);
+* **bit-identical decisions**: every VERIFIED item matches the
+  ``ed25519_ref`` oracle, flapping device or not.
+
+``--smoke`` is the short CPU-only tier-1 mode (forced 4 virtual
+devices, bucket 8 — the exact shapes the device-domain chaos driver
+already compiled into the shared persistent cache, so a tier-1 run
+pays zero new XLA compiles). Without ``--smoke`` the flood runs for
+``--duration`` seconds and optionally adds a corrupting device
+(``--corrupt``) so the audit → host-only → shed-ladder-level-2 path
+soaks too.
+
+Per-phase events append to a size-capped JSONL
+(``utils.logging.append_jsonl_capped`` — same 4 MB + 1 generation
+rotation as ``DEVICE_PROBES.jsonl``), so long soaks can't fill the
+disk. Prints one JSON record; exit 0 = every check passed.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEV = 4
+BUCKET = 8              # device-domain chaos shapes: sub-chunk = 2
+SUB = BUCKET // N_DEV
+SMOKE_SCP_P99_BOUND_MS = 5000.0
+
+
+def _env_setup(real_device: bool) -> None:
+    """CPU-only multi-device env — must run before jax imports."""
+    if real_device:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={N_DEV}").strip()
+    from stellar_tpu.utils.cpu_backend import force_cpu
+    force_cpu(compilation_cache_dir=os.environ.get(
+        "DEVICE_DOMAIN_JAX_CACHE",
+        "/tmp/stellar_tpu_devchaos_jaxcache"))
+
+
+def _signed_pool():
+    """Small pool of valid signatures + structured invalid rows, with
+    oracle expectations computed once per entry (pure-Python signing
+    is ~25 ms/sig — variety comes from COMPOSITION, not fresh keys)."""
+    import numpy as np
+    from stellar_tpu.crypto import ed25519_ref as ref
+    pool = []
+    for i in range(6):
+        seed = bytes([17 * (i + 1) % 251]) * 32
+        pk = ref.secret_to_public(seed)
+        msg = b"soak-%d" % i
+        pool.append((pk, msg, ref.sign(seed, msg)))
+    pk0, m0, s0 = pool[0]
+    pool.append((pk0, m0 + b"!", s0))     # tampered message
+    pool.append((pk0[:31], m0, s0))       # bad pk length
+    want = np.array([ref.verify(p, m, s) for p, m, s in pool])
+    return pool, want
+
+
+def _submission(pool, want, i, n):
+    """One flood submission: a rotating slice of the pool (start and
+    stride vary with ``i``) so submissions carry DISTINCT content —
+    the shed rule draws per-submission digests, and identical content
+    would shed identically by design."""
+    start = i % len(pool)
+    stride = 1 + i % 3
+    idx = [(start + j * stride) % len(pool) for j in range(n)]
+    return [pool[k] for k in idx], want[idx]
+
+
+def run(smoke: bool, duration_s: float, corrupt: bool,
+        events_path: str) -> dict:
+    import numpy as np
+
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils import faults
+    from stellar_tpu.utils.logging import append_jsonl_capped
+    from stellar_tpu.utils.metrics import registry
+
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit(
+            f"soak needs a multi-device host (got {len(devs)}): the "
+            "flaky-device fault shape is per-device — run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4")
+
+    def event(kind, **fields):
+        append_jsonl_capped(events_path, {"event": kind, **fields})
+
+    from stellar_tpu.parallel.mesh import batch_mesh
+    mesh = batch_mesh()
+    v = bv.BatchVerifier(mesh=mesh, bucket_sizes=(BUCKET,))
+    bv.configure_dispatch(
+        deadline_ms=30_000, dispatch_retries=0,
+        failure_threshold=6, backoff_min_s=0.3, backoff_max_s=0.6,
+        audit_rate=0.05,                # audit sampling ON
+        device_failure_threshold=2,
+        device_backoff_min_s=0.2, device_backoff_max_s=0.5)
+
+    # warm every device's sub-chunk executable in parallel (XLA's C++
+    # compile releases the GIL; the persistent cache shared with the
+    # device-domain chaos driver makes tier-1 runs load, not compile)
+    t0 = time.monotonic()
+    kern = v._kernel_for(SUB)
+    rows = [np.repeat(x, SUB, 0) for x in
+            (bv._PAD_A, bv._PAD_R, bv._PAD_S, bv._PAD_H)]
+
+    def warm(d):
+        np.asarray(kern(*[jax.device_put(x, d) for x in rows]))
+
+    # sequential on purpose: after the first device writes/loads the
+    # persistent-cache entry the rest LOAD it (~8 s each measured vs
+    # ~55 s compile), and parallel deserialization was measured 3x
+    # SLOWER than sequential on a small host (GIL-bound)
+    for d in devs:
+        warm(d)
+    warm_s = round(time.monotonic() - t0, 1)
+    event("warm", seconds=warm_s, devices=len(devs))
+
+    svc = vs.VerifyService(
+        verifier=v, lane_depth=24, lane_bytes=2_000_000,
+        max_batch=BUCKET, pipeline_depth=2, aging_every=4).start()
+
+    # the flapping chip: every 2nd dispatch attributed to device 0
+    # raises — quarantine, re-shard over survivors, half-open regrow,
+    # fail again (docs/robustness.md per-device fault domains)
+    faults.set_fault(faults.DISPATCH, "flaky-device", 0)
+    event("fault", spec="device.dispatch=flaky-device:0")
+
+    pool, want = _signed_pool()
+    results = {"bulk": {"tickets": [], "rejected": 0},
+               "scp": {"tickets": [], "rejected": 0}}
+    lock = threading.Lock()
+
+    def flood(lane, count, per_sub, pace_s, offset=0):
+        for i in range(count):
+            items, exp = _submission(pool, want, i + offset, per_sub)
+            try:
+                tkt = svc.submit(items, lane=lane)
+                with lock:
+                    results[lane]["tickets"].append((tkt, exp))
+            except vs.Overloaded as e:
+                assert e.kind == "rejected", e.kind
+                with lock:
+                    results[lane]["rejected"] += 1
+            if pace_s:
+                time.sleep(pace_s)
+
+    flood_rounds = 1 if smoke else max(1, int(duration_s / 3.0))
+    breaker_tripped = False
+    t_run = time.monotonic()
+    for rnd in range(flood_rounds):
+        # burst well past the bulk lane's depth budget: ingress
+        # rejects AND backlog shed are both certain
+        bulk = threading.Thread(
+            target=flood, args=("bulk", 150, 4, 0.002, rnd * 1000))
+        scp = threading.Thread(
+            target=flood, args=("scp", 25, 2, 0.02, rnd * 1000))
+        bulk.start()
+        scp.start()
+        bulk.join()
+        scp.join()
+        if not breaker_tripped:
+            # mid-run correlated outage: the OPEN global breaker is
+            # shed-ladder level 2 (dispatch-degraded) until its
+            # half-open probe re-closes it
+            bv._breaker.trip()
+            breaker_tripped = True
+            event("breaker-trip", round=rnd)
+        if corrupt and not smoke and rnd == flood_rounds // 2:
+            faults.set_fault(faults.RESOLVE, "corrupt-device", 2)
+            event("fault", spec="device.resolve=corrupt-device:2")
+        event("round", n=rnd,
+              service=svc.snapshot()["totals"])
+
+    # drain: every outstanding ticket resolves to verified or shed
+    mismatches = 0
+    shed = {"bulk": 0, "scp": 0}
+    verified_items = 0
+    for lane in ("bulk", "scp"):
+        for tkt, exp in results[lane]["tickets"]:
+            try:
+                got = tkt.result(timeout=120)
+            except vs.Overloaded as e:
+                assert e.kind == "shed", e.kind
+                shed[lane] += 1
+                continue
+            verified_items += len(got)
+            if not (got == exp).all():
+                mismatches += 1
+    svc.stop(drain=True, timeout=60)
+    fault_counters = faults.counters()   # captured BEFORE clear
+    faults.clear()
+    wall_s = round(time.monotonic() - t_run, 1)
+
+    snap = svc.snapshot()
+    lanes = vs.lane_latencies()
+    totals = snap["totals"]
+    meters = {k: registry.meter(f"crypto.verify.service.{k}").count
+              for k in ("submitted", "verified", "rejected", "shed",
+                        "failed")}
+    prom = registry.to_prometheus()
+    health = bv.dispatch_health()
+    event("final", totals=totals, lanes=lanes, wall_s=wall_s)
+
+    problems = []
+    if snap["conservation_gap"] != 0 or snap["pending_items"] != 0:
+        problems.append(
+            f"conservation violated: gap={snap['conservation_gap']} "
+            f"pending={snap['pending_items']}")
+    if totals["failed"] != 0:
+        problems.append(f"failed items: {totals['failed']}")
+    if totals["submitted"] != (totals["verified"] + totals["rejected"]
+                               + totals["shed"]):
+        problems.append("submitted != verified + rejected + shed")
+    if meters != {k: totals[k] for k in meters}:
+        problems.append(
+            f"service counters disagree with metrics: {meters} "
+            f"vs {totals}")
+    if totals["rejected"] == 0 or results["bulk"]["rejected"] == 0:
+        problems.append("Overloaded ingress rejection never exercised")
+    if totals["shed"] == 0 or shed["bulk"] == 0:
+        problems.append("bulk lane never shed under overload")
+    if shed["scp"] or snap["lanes"]["scp"]["shed"] or \
+            snap["lanes"]["scp"]["rejected"]:
+        problems.append("scp lane was shed/rejected — priority broken")
+    if lanes["scp"]["count"] == 0 or \
+            lanes["scp"]["p99_ms"] > SMOKE_SCP_P99_BOUND_MS:
+        problems.append(
+            f"scp p99 unbounded: {lanes['scp']}")
+    if lanes["bulk"]["count"] and \
+            lanes["scp"]["p99_ms"] > lanes["bulk"]["p99_ms"]:
+        problems.append("scp lane waited longer than bulk at p99")
+    if mismatches:
+        problems.append(
+            f"{mismatches} verified tickets mismatched the oracle")
+    fc = fault_counters.get("device.dispatch", {})
+    if not fc.get("fired"):
+        problems.append("flaky-device:0 never fired — no flap soaked")
+    if "crypto_verify_service" not in prom:
+        problems.append("service metrics missing from the Prometheus "
+                        "exposition")
+
+    return {
+        "ok": not problems,
+        "mode": "smoke" if smoke else "soak",
+        "wall_s": wall_s,
+        "warm_s": warm_s,
+        "devices": len(devs),
+        "totals": totals,
+        "shed_onsets": registry.counter(
+            "crypto.verify.service.shed_onsets").count,
+        "lane_latency_ms": lanes,
+        "verified_items": verified_items,
+        "ingress_rejected_submissions": {
+            ln: results[ln]["rejected"] for ln in results},
+        "shed_submissions": shed,
+        "fault_counters": fault_counters,
+        "breaker": health["breaker"]["state"],
+        "quarantines": health["device_health"]["transitions_total"],
+        "flight_recorder_dumps": health["flight_recorder"][
+            "dump_reasons"],
+        "events_path": events_path,
+        "problems": problems,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="short CPU-only tier-1 gate mode")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="flood duration (non-smoke), seconds")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="also inject corrupt-device:2 mid-run "
+                         "(audit -> host-only -> ladder level 2)")
+    ap.add_argument("--events", default=None,
+                    help="JSONL event-log path (size-capped, rotated)")
+    ap.add_argument("--real-device", action="store_true",
+                    help="don't force the CPU backend (live windows)")
+    args = ap.parse_args()
+    events = args.events or (
+        "/tmp/_soak_events.jsonl" if args.smoke
+        else os.path.join(REPO, "SOAK_EVENTS.jsonl"))
+    _env_setup(args.real_device)
+    rec = run(args.smoke, args.duration, args.corrupt, events)
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

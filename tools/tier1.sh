@@ -59,4 +59,18 @@ echo ANALYSIS_RC=$arc
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/metrics_selfcheck.py
 mrc=$?
 echo METRICS_EXPORT_OK=$([ "$mrc" -eq 0 ] && echo 1 || echo 0)
-exit $mrc
+[ "$mrc" -ne 0 ] && exit $mrc
+# Verify-service soak smoke (ISSUE 6): a short CPU-only overload run
+# of the resident verify service (forced 4-device subprocess,
+# flaky-device:0 injected, audit sampling on, mid-run breaker trip)
+# must uphold the work-conservation law EXACTLY (submitted ==
+# verified + rejected + shed, zero unaccounted drops), keep the
+# SCP-priority lane's p99 bounded while the bulk lane sheds, and
+# exercise a typed Overloaded ingress rejection. Reuses the
+# device-domain chaos gate's compiled shapes + persistent cache, so
+# after the chaos gate above this pays loads, not compiles
+# (~1 min warm; a cold cache can take ~4 min, hence the budget).
+timeout -k 10 560 env JAX_PLATFORMS=cpu python tools/soak.py --smoke
+src=$?
+echo SOAK_OK=$([ "$src" -eq 0 ] && echo 1 || echo 0)
+exit $src
