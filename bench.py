@@ -228,7 +228,7 @@ def _static_kernel_cost(timeout_s: float = 300.0):
         rec = json.loads(line)
     except Exception as e:
         return {"error": f"kernel cost tool failed: {e!r}"[:200]}
-    return {
+    slim = {
         "select_macs_per_verify": rec.get("select_macs_per_verify"),
         "table_entries": rec.get("table_entries"),
         "dsm_static_mul_ops": rec.get("dsm_static_mul_ops"),
@@ -237,6 +237,23 @@ def _static_kernel_cost(timeout_s: float = 300.0):
             "stages", {}).get("kernel_total", {}).get("static_mul_ops"),
         "batch": rec.get("batch"),
     }
+    # workload #2's static ledger rides the same record: the
+    # hash-kernel cost trajectory survives a dead tunnel too
+    try:
+        out = subprocess.run(
+            [sys.executable, tool, "--json", "--workload=sha256"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        sha = json.loads(out.stdout.strip().splitlines()[-1])
+        slim["sha256"] = {
+            "static_ops": sha.get("static_ops"),
+            "weighted_ops": sha.get("weighted_ops"),
+            "add_weighted_elems": sha.get("add_weighted_elems"),
+            "max_blocks": sha.get("max_blocks"),
+            "batch": sha.get("batch"),
+        }
+    except Exception as e:
+        slim["sha256"] = {"error": f"sha256 cost failed: {e!r}"[:200]}
+    return slim
 
 
 def _static_analysis(timeout_s: float = 300.0):
@@ -258,12 +275,18 @@ def _static_analysis(timeout_s: float = 300.0):
         return {"ok": False,
                 "error": f"analysis tool failed: {e!r}"[:200]}
     ov = rec.get("overflow", {})
+    sha = rec.get("overflow_sha256", {})
     return {
         "ok": rec.get("ok", False),
         "overflow_proven": ov.get("ok", False),
         "envelope_sha256": ov.get("envelope_sha256"),
         "golden": ov.get("golden"),
         "violations": len(ov.get("violations", [])),
+        # workload #2's proof state: a hash-bench number is no more
+        # quotable from an unproven kernel than a verify number
+        "sha256_overflow_proven": sha.get("ok", False),
+        "sha256_envelope": sha.get("envelope_sha256"),
+        "sha256_golden": sha.get("golden"),
         "lints_ok": all(l.get("ok", False)
                         for l in rec.get("lints", {}).values()),
     }
@@ -590,11 +613,43 @@ def main():
                 "crypto.verify.service.shed_onsets").count,
         }}
 
+    def phase_hash():
+        # workload #2 (ISSUE 7): batched SHA-256 through the SAME
+        # engine — digests pinned to hashlib, device p50 vs the serial
+        # host loop it replaces on the bucket/catchup paths
+        import hashlib as _hl
+
+        from stellar_tpu.crypto.batch_hasher import default_hasher
+        msgs = [pk + m + s for pk, m, s in items]  # ≤192 B, on-device
+        h = default_hasher()  # production config: auto mesh, shared
+        # per-device health — the path hash_many actually takes
+        want = [_hl.sha256(m).digest() for m in msgs]
+        assert h.hash_batch(msgs) == want          # warm + bit-identical
+        dev_times, host_times = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            h.hash_batch(msgs)
+            dev_times.append((time.perf_counter() - t0) * 1000.0)
+            t0 = time.perf_counter()
+            for m in msgs:
+                _hl.sha256(m).digest()
+            host_times.append((time.perf_counter() - t0) * 1000.0)
+        dev_p50 = float(np.median(dev_times))
+        host_p50 = float(np.median(host_times))
+        return {"hash": {
+            "batch": len(msgs),
+            "device_p50_ms": round(dev_p50, 3),
+            "hashlib_p50_ms": round(host_p50, 3),
+            "vs_hashlib": round(host_p50 / dev_p50, 2) if dev_p50 else None,
+            "served": dict(h.served),
+        }}
+
     optional("coalesced", phase_coalesced)   # most valuable first
     optional("pipelined", phase_pipelined)
     optional("singles", phase_singles)
     optional("trickle", phase_trickle)
     optional("service", phase_service)
+    optional("hash", phase_hash)
     # hardware-independent, so it must never delay the on-device record
     # above — the live window can be minutes long (round 4: ~3 min total)
     optional("kernel_cost", lambda: {"kernel_cost": _static_kernel_cost()})

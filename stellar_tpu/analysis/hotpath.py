@@ -42,8 +42,14 @@ __all__ = ["run", "lint_source", "SCOPE_DEVICE", "SCOPE_HOST",
 
 # Files whose function bodies are (or feed) traced device code.
 SCOPE_DEVICE = ["stellar_tpu/ops"]
-# Host-side dispatch code: retrace rules only.
-SCOPE_HOST = ["stellar_tpu/crypto/batch_verifier.py"]
+# Host-side dispatch code: retrace rules only. Since ISSUE 7 the
+# dispatch loop (and both jit wrapper sites) lives in the generic
+# batch engine; the verifier and hasher are thin plugin modules.
+SCOPE_HOST = [
+    "stellar_tpu/crypto/batch_verifier.py",
+    "stellar_tpu/crypto/batch_hasher.py",
+    "stellar_tpu/parallel/batch_engine.py",
+]
 
 _SYNC_NP_FUNCS = {"asarray", "array"}
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
@@ -84,11 +90,24 @@ ALLOWLIST = Allowlist({
             "runs per-dispatch, so there is exactly one trace per "
             "(mesh, bucket) pair.",
     },
-    "stellar_tpu/crypto/batch_verifier.py": {
+    "stellar_tpu/ops/sha256.py": {
+        "traced-branch:pack_messages.max_blocks":
+            "host-side packing helper (docstring says so): operates "
+            "on Python bytes before any device dispatch — max_blocks "
+            "is a static Python int (the plugin's block capacity) and "
+            "the per-message loop runs over host bytes, never traced "
+            "values.",
+        "host-sync:digest_words_to_bytes.np.asarray":
+            "documented host-side decoder: renders a digest row AFTER "
+            "the engine's explicit fetch (callers hold numpy arrays, "
+            "never tracers) — the np.asarray is a dtype-cast of host "
+            "memory, not a device sync.",
+    },
+    "stellar_tpu/parallel/batch_engine.py": {
         "jit-in-func:_kernel_for.jax.jit":
-            "built once per bucket size and memoized in self._kernels "
-            "under its lock — the per-call path is a dict hit, no "
-            "fresh wrapper and no retrace.",
+            "built once per dispatch shape and memoized in "
+            "self._kernels under its lock — the per-call path is a "
+            "dict hit, no fresh wrapper and no retrace.",
         "jit-in-func:probe.jax.jit":
             "intentional: each breaker-paced probe must prove the "
             "FULL tunnel including compile+dispatch (a cached wrapper "

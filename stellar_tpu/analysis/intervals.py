@@ -263,6 +263,24 @@ def _binop_arrays(a: AbsVal, b: AbsVal):
     return (lift(a.lo), lift(a.hi), lift(b.lo), lift(b.hi))
 
 
+def _bitmask_bound(hi: np.ndarray) -> np.ndarray:
+    """Smallest all-ones mask covering ``hi`` (elementwise, hi >= 0):
+    7 -> 7, 8 -> 15, 2^32-1 -> 2^32-1. The sound upper bound for
+    OR/XOR of non-negative values — neither can set a bit above the
+    highest bit of either operand."""
+    h = np.maximum(np.asarray(hi, dtype=np.int64), 0)
+    out = np.zeros_like(h)
+    nz = h > 0
+    if nz.any():
+        bits = np.ceil(np.log2(h[nz].astype(np.float64) + 1.0))
+        cand = (np.int64(1) << bits.astype(np.int64)) - 1
+        # float rounding safety: the mask must COVER hi
+        short = cand < h[nz]
+        cand = np.where(short, (cand << 1) | 1, cand)
+        out[nz] = cand
+    return _clamp(out)
+
+
 def _corner_minmax(fn, alo, ahi, blo, bhi):
     c1, c2, c3, c4 = fn(alo, blo), fn(alo, bhi), fn(ahi, blo), fn(ahi, bhi)
     lo = np.minimum(np.minimum(c1, c2), np.minimum(c3, c4))
@@ -501,7 +519,14 @@ class IntervalInterpreter:
                       np.minimum(alo, blo))
         # x|y <= x + y for non-negative x, y; a possibly-negative
         # operand contributes 0 to the upper bound (result <= other|0).
+        # Refinement (SHA-256 kernel): OR cannot set a bit above the
+        # highest bit of either operand, so for non-negative operands
+        # min in the power-of-two ceiling of max(ahi, bhi) — without
+        # it, uint32 full-range ORs would falsely escape uint32.
         hi = _clamp(np.maximum(ahi, 0) + np.maximum(bhi, 0))
+        hi = np.where(both_nn,
+                      np.minimum(hi, _bitmask_bound(
+                          np.maximum(ahi, bhi))), hi)
         return self._out(eqn, lo, hi)
 
     def _h_xor(self, eqn, ins, path, idx):
@@ -515,13 +540,25 @@ class IntervalInterpreter:
             return self._out(eqn, lo, hi)
         both_nn = (alo >= 0) & (blo >= 0)
         lo = np.where(both_nn, np.zeros_like(alo), np.full_like(alo, -SAT))
-        hi = np.where(both_nn, _clamp(ahi + bhi), np.full_like(ahi, SAT))
+        # same bit-ceiling refinement as OR: XOR of non-negative
+        # operands never sets a bit above either operand's highest —
+        # the bound that keeps the SHA-256 schedule/round XORs inside
+        # uint32 instead of the (sound but useless) ahi + bhi.
+        hi = np.where(both_nn,
+                      np.minimum(_clamp(ahi + bhi),
+                                 _bitmask_bound(np.maximum(ahi, bhi))),
+                      np.full_like(ahi, SAT))
         return self._out(eqn, lo, hi)
 
     def _h_not(self, eqn, ins, path, idx):
         a = ins[0]
-        if np.dtype(eqn.outvars[0].aval.dtype) == np.bool_:
+        dtype = np.dtype(eqn.outvars[0].aval.dtype)
+        if dtype == np.bool_:
             return self._out(eqn, 1 - a.hi, 1 - a.lo)
+        if dtype.kind == "u":
+            # unsigned bitwise-not is dtype_max - x, not -1 - x
+            _dlo, dhi = interval_for_dtype(dtype)
+            return self._out(eqn, dhi - a.hi, dhi - a.lo)
         return self._out(eqn, -1 - a.hi, -1 - a.lo)
 
     def _h_shift_left(self, eqn, ins, path, idx):

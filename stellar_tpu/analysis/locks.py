@@ -47,7 +47,9 @@ __all__ = ["run", "lint_source", "SCOPE", "ALLOWLIST"]
 # worker and breaker-callback threads), and the device-watch daemon.
 SCOPE = [
     "stellar_tpu/crypto/batch_verifier.py",
+    "stellar_tpu/crypto/batch_hasher.py",
     "stellar_tpu/crypto/verify_service.py",
+    "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
@@ -76,7 +78,7 @@ def _expr_calls(node: ast.AST):
                     yield n
 
 ALLOWLIST = Allowlist({
-    "stellar_tpu/crypto/batch_verifier.py": {
+    "stellar_tpu/parallel/batch_engine.py": {
         "unlocked-global:configure_dispatch.DEADLINE_MS":
             "single atomic store of an immutable float (no "
             "read-modify-write): under the GIL a concurrent reader "

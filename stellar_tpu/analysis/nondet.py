@@ -51,6 +51,16 @@ HOST_ORACLE_FILES = [
     # content-seeded (audit.keep_under_shed) and the scheduler
     # sequence-based, never clocked or RNG-driven
     "stellar_tpu/crypto/verify_service.py",
+    # the workload-agnostic batch engine owns dispatch, re-shard,
+    # audit-sample composition, and host-oracle failover for EVERY
+    # plugin — a clock or RNG here would desynchronize which rows any
+    # replica audits or sheds, for all workloads at once
+    "stellar_tpu/parallel/batch_engine.py",
+    # the SHA-256 workload: kernel host helpers (padding/encode) and
+    # the hasher plugin feed bucket-list and catchup hashes that must
+    # be bit-identical across nodes
+    "stellar_tpu/ops/sha256.py",
+    "stellar_tpu/crypto/batch_hasher.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",
@@ -214,6 +224,24 @@ ALLOWLIST = Allowlist({
             "admission sequence numbers, and WHICH rows shed on the "
             "content-seeded rule in crypto/audit.py (replicas under "
             "identical pressure shed identical rows).",
+    },
+    "stellar_tpu/parallel/batch_engine.py": {
+        "nondet:clock":
+            "time.monotonic() ages the device-probe thread (overdue "
+            "probe accounting) — local liveness bookkeeping deciding "
+            "only WHICH backend serves, never what a row's verdict "
+            "is: device and host-oracle answers are pinned "
+            "bit-identical by the differential gates and the sampled "
+            "audit, so a clock-driven backend flip cannot diverge "
+            "replicas' consensus state.",
+        "nondet:tracing-import":
+            "the engine IS the instrumentation owner the fence "
+            "protects consensus code from: it opens the resolve-phase "
+            "spans, dumps the flight recorder on breaker/quarantine/"
+            "shed onsets, and feeds dispatch_attribution — durations "
+            "land in observability records only, while row verdicts "
+            "are composed from device/oracle bits plus the "
+            "content-seeded audit sample, never a span reading.",
     },
 })
 

@@ -47,6 +47,8 @@ __all__ = [
     "DEFAULT_BUCKETS", "STAGE_OUTPUT_NAMES", "prove", "prove_buckets",
     "envelope_sha256", "analyze_closed_jaxpr", "trace_stage_jaxprs",
     "loose_point_avals", "GOLDEN_PATH",
+    "SHA_GOLDEN_PATH", "prove_sha256", "prove_sha256_buckets",
+    "trace_sha256_jaxpr", "sha_default_buckets",
 ]
 
 def _default_buckets():
@@ -250,6 +252,119 @@ def prove_buckets(buckets: Sequence[int] = DEFAULT_BUCKETS) -> dict:
                 f"[batch={r['batch']}] {m}"
                 for m in r[key] if m not in out[key]]
     return out
+
+
+# ---------------- SHA-256 workload proof (ISSUE 7) ----------------
+# Workload #2 on the batch substrate gets the same treatment as the
+# verify kernel: interval-prove every integer intermediate fits its
+# dtype at every jit bucket size, and commit the proven envelope as a
+# golden so future kernel PRs diff the proof itself. The interesting
+# obligations here are the masked half-word adds (each half-lane sum
+# must stay inside uint32 — a dropped mask would surface immediately)
+# and the pre-masked rotations (the left-shift operand must be
+# provably < 2^32). Separate golden file: the ed25519 envelope
+# (docs/limb_bounds.json) is pinned unchanged by the ISSUE 7
+# acceptance criteria.
+
+SHA_GOLDEN_PATH = "docs/sha256_bounds.json"
+
+
+def sha_default_buckets():
+    from stellar_tpu.crypto.batch_hasher import DEFAULT_HASH_BUCKET_SIZES
+    return DEFAULT_HASH_BUCKET_SIZES
+
+
+def _sha_max_blocks():
+    from stellar_tpu.crypto.batch_hasher import MAX_BLOCKS
+    return MAX_BLOCKS
+
+
+def trace_sha256_jaxpr(batch: int, max_blocks: Optional[int] = None):
+    import jax
+    from stellar_tpu.ops import sha256 as sk
+    max_blocks = max_blocks or _sha_max_blocks()
+    words = jax.ShapeDtypeStruct((batch, max_blocks, 16), np.uint32)
+    active = jax.ShapeDtypeStruct((batch, max_blocks), np.bool_)
+    return jax.make_jaxpr(sk.sha256_kernel)(words, active)
+
+
+def prove_sha256(batch: int, max_blocks: Optional[int] = None) -> dict:
+    """Prove the SHA-256 kernel at one bucket size: full-range uint32
+    message words, any active-block mask. One stage ("sha256_kernel"),
+    one output (the digest words, which must span exactly uint32)."""
+    max_blocks = max_blocks or _sha_max_blocks()
+    jaxpr = trace_sha256_jaxpr(batch, max_blocks)
+    words = AbsVal.from_range(
+        type("A", (), {"shape": (batch, max_blocks, 16),
+                       "dtype": np.uint32})(), 0, 0xFFFFFFFF)
+    active = AbsVal.from_range(
+        type("A", (), {"shape": (batch, max_blocks),
+                       "dtype": np.bool_})(), 0, 1)
+    violations: List[dict] = []
+    unsupported: List[str] = []
+    stages = {}
+    try:
+        res = analyze_closed_jaxpr(jaxpr, [words, active],
+                                   "sha256_kernel")
+        out, = res["outputs"]
+        lo = int(out.lo.min()) if out.lo.size else 0
+        hi = int(out.hi.max()) if out.hi.size else 0
+        stages["sha256_kernel"] = {
+            "max_abs": int(res["max_abs"]),
+            "outputs": {"digest": [[lo, hi]]},
+        }
+        violations = [v.to_dict() for v in res["violations"]]
+    except Unsupported as e:
+        unsupported.append(str(e))
+        stages["sha256_kernel"] = {"max_abs": None, "outputs": {}}
+    envelope = {
+        "format": 1,
+        "word_layout": {"word_bits": 32, "max_blocks": int(max_blocks),
+                        "rounds": 64},
+        "stages": stages,
+    }
+    return {
+        "batch": batch,
+        "ok": not violations and not unsupported,
+        "violations": violations,
+        "contract_breaches": [],
+        "unsupported": unsupported,
+        "envelope": envelope,
+        "envelope_sha256": envelope_sha256(envelope),
+    }
+
+
+def prove_sha256_buckets(buckets: Optional[Sequence[int]] = None,
+                         max_blocks: Optional[int] = None) -> dict:
+    """Prove the SHA-256 kernel at every hash jit bucket size; the
+    envelope must be identical across buckets (same batch-uniformity
+    argument as ``prove_buckets``)."""
+    buckets = list(buckets or sha_default_buckets())
+    records = [prove_sha256(b, max_blocks) for b in buckets]
+    first = records[0]
+    mismatch = [
+        r["batch"] for r in records[1:]
+        if r["envelope_sha256"] != first["envelope_sha256"]]
+    out = dict(first)
+    out["buckets"] = buckets
+    out["ok"] = all(r["ok"] for r in records) and not mismatch
+    out["envelope_mismatch_buckets"] = mismatch
+    for r in records[1:]:
+        out["violations"] = out["violations"] + [
+            v for v in r["violations"] if v not in out["violations"]]
+        out["unsupported"] = out["unsupported"] + [
+            f"[batch={r['batch']}] {m}"
+            for m in r["unsupported"] if m not in out["unsupported"]]
+    return out
+
+
+def load_sha_golden(repo_root: str) -> Optional[dict]:
+    import os
+    path = os.path.join(repo_root, SHA_GOLDEN_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def envelope_sha256(envelope: dict) -> str:

@@ -141,11 +141,14 @@ class BucketLevel:
         return self._next if isinstance(self._next, FutureBucket) \
             else None
 
+    def hash_preimage(self) -> bytes:
+        """curr ‖ snap — the single definition of the level-hash
+        preimage, shared by :meth:`hash` and the list-level batched
+        hashing (``LiveBucketList.hash``)."""
+        return self.curr.hash + self.snap.hash
+
     def hash(self) -> bytes:
-        h = hashlib.sha256()
-        h.update(self.curr.hash)
-        h.update(self.snap.hash)
-        return h.digest()
+        return hashlib.sha256(self.hash_preimage()).digest()
 
     def take_snap(self) -> Bucket:
         """curr -> snap, fresh curr (reference ``BucketLevel::snap``)."""
@@ -184,9 +187,17 @@ class LiveBucketList:
     # ---------------- hashing ----------------
 
     def hash(self) -> bytes:
+        # the level hashes are independent digests (each is
+        # SHA-256(curr || snap)) — batch them through the hash
+        # workload (bit-identical to the serial form: hashlib below
+        # the device threshold / without an accelerator), then chain
+        # the level digests exactly as before
+        from stellar_tpu.crypto.batch_hasher import hash_many
+        level_hashes = hash_many(
+            [lev.hash_preimage() for lev in self.levels])
         h = hashlib.sha256()
-        for lev in self.levels:
-            h.update(lev.hash())
+        for lh in level_hashes:
+            h.update(lh)
         return h.digest()
 
     # ---------------- the spill cascade ----------------

@@ -179,9 +179,14 @@ class _HotLevel:
     def next(self, bucket: Optional[HotArchiveBucket]):
         self._next = bucket
 
+    def hash_preimage(self) -> bytes:
+        """curr ‖ snap — shared by :meth:`hash` and the list-level
+        batched hashing (``HotArchiveBucketList.hash``)."""
+        return self.curr.hash + self.snap.hash
+
     def hash(self) -> bytes:
         from stellar_tpu.crypto.sha import sha256
-        return sha256(self.curr.hash + self.snap.hash)
+        return sha256(self.hash_preimage())
 
     def take_snap(self) -> HotArchiveBucket:
         self.snap = self.curr
@@ -220,9 +225,14 @@ class HotArchiveBucketList:
         self.levels = [_HotLevel(i) for i in range(NUM_LEVELS)]
 
     def hash(self) -> bytes:
+        # independent per-level digests batch through the hash
+        # workload (bit-identical; hashlib below the device
+        # threshold), then chain — same shape as LiveBucketList.hash
+        from stellar_tpu.crypto.batch_hasher import hash_many
         from stellar_tpu.crypto.sha import sha256
-        h = sha256(b"".join(lev.hash() for lev in self.levels))
-        return h
+        level_hashes = hash_many(
+            [lev.hash_preimage() for lev in self.levels])
+        return sha256(b"".join(level_hashes))
 
     def is_empty(self) -> bool:
         return all(lev.curr.is_empty() and lev.snap.is_empty() and

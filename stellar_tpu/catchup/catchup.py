@@ -59,11 +59,22 @@ def _successful_tx_hashes(results_by_seq, seq) -> set:
 
 def verify_ledger_chain(headers) -> bool:
     """Hash-chain verification (reference ``VerifyLedgerChainWork``:
-    each header commits to its predecessor)."""
+    each header commits to its predecessor). The per-header SHA-256
+    recomputation — one independent digest per replayed ledger, the
+    checkpoint path's serial hash cost — rides the batch-hash workload
+    (``crypto.batch_hasher.hash_many``): device-batched with audit +
+    host failover when an accelerator is live, plain hashlib
+    otherwise, bit-identical either way."""
+    from stellar_tpu.crypto.batch_hasher import hash_many
+    from stellar_tpu.xdr.ledger import LedgerHeader
+    from stellar_tpu.xdr.runtime import to_bytes
+    headers = list(headers)
     for prev, cur in zip(headers, headers[1:]):
         if cur.header.previousLedgerHash != prev.hash:
             return False
-    return all(ledger_header_hash(h.header) == h.hash for h in headers)
+    digests = hash_many([to_bytes(LedgerHeader, h.header)
+                         for h in headers])
+    return all(d == h.hash for d, h in zip(digests, headers))
 
 
 class CatchupConfiguration:
@@ -145,7 +156,13 @@ def _prefetch_checkpoint_sigs(lm, headers, tx_by_seq, results_by_seq,
             if SKIP_KNOWN_RESULTS:
                 # recorded-successful txs will be assume-valid seeded by
                 # the replay loop; verifying them here would add back
-                # exactly the work that flag skips
+                # exactly the work that flag skips. The per-frame tx-id
+                # hashes the split below needs are batch-computed first
+                # (hash workload; serial hashlib without a device)
+                from stellar_tpu.herder.tx_set import (
+                    prefetch_contents_hashes,
+                )
+                prefetch_contents_hashes(frames)
                 ok_hashes = _successful_tx_hashes(results_by_seq, seq)
                 trusted = [f for f in frames
                            if f.contents_hash() in ok_hashes]

@@ -1,0 +1,177 @@
+"""Batched SHA-256 on the batch-dispatch substrate — workload #2.
+
+The reference's replay and bucket paths are hash-bound once signatures
+batch: catchup prefetches a whole checkpoint's signatures in 16k-row
+coalesced device batches (PR 6 lineage), after which the remaining
+serial host work is thousands of small INDEPENDENT SHA-256 digests —
+ledger-header hashes in chain verification, per-tx contents hashes in
+TxSet splitting, bucket-level hashes in the bucket list. This module
+rides those digests on the same engine that serves ed25519 verify
+(:class:`stellar_tpu.parallel.batch_engine.BatchEngine`): same jit
+buckets, per-device fault domains, degraded re-shard, circuit
+breakers, watchdog fetches, sampled result-integrity audit
+(differential oracle: ``hashlib.sha256``), and host failover —
+``docs/robustness.md`` "Engine and workload plugins".
+
+Row semantics: an item is one ``bytes`` message; the result row is its
+(8,) uint32 big-endian digest words
+(:func:`stellar_tpu.ops.sha256.digest_words_to_bytes` renders bytes).
+The gate mask is FITS-ON-DEVICE: messages longer than the plugin's
+block capacity (``max_blocks * 64 - 9`` bytes) are hashed on the host
+by ``finalize`` — a capacity decision, never a correctness one
+(results are bit-identical to ``hashlib`` either way, which is also
+what the audit re-checks).
+
+:func:`hash_many` is the consumer API (catchup chain verification,
+bucket-level hashing, contents-hash prefetch): hashlib below
+``MIN_DEVICE_HASH_BATCH`` rows or whenever no accelerator is live
+(XLA-on-CPU loses to hashlib, same policy as
+``keys.batch_verify_into_cache``), the device engine above it — so on
+host-only processes the consumers are exactly the serial code they
+replaced.
+
+Determinism: this module is inside the consensus nondet-lint scope
+(hash results ARE consensus state — header/bucket/TxSet identities).
+It reads no clocks and no RNGs; which backend served a digest changes
+latency, never bytes (host failover + audit pin that).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from stellar_tpu.parallel import batch_engine
+from stellar_tpu.parallel.batch_engine import BatchEngine, Workload
+
+__all__ = ["Sha256Workload", "BatchHasher", "default_hasher",
+           "hash_many", "DEFAULT_HASH_BUCKET_SIZES", "MAX_BLOCKS",
+           "MIN_DEVICE_HASH_BATCH"]
+
+# The hash workload's jit bucket ladder. Smaller top than verify's:
+# hash rows carry max_blocks * 64 bytes each (vs 128 for verify), so
+# a 16k-row hash bucket would move ~8 MB per dispatch — 2048 keeps a
+# bucket within the relay budget measured for verify.
+DEFAULT_HASH_BUCKET_SIZES = (128, 512, 2048)
+
+# Block capacity per row: 8 blocks = messages up to 503 bytes cover
+# ledger headers, bucket levels, and typical tx contents preimages;
+# longer messages (whole tx-set XDR blobs) hash on the host via the
+# gate. The overflow prover proves the kernel at exactly this capacity
+# and every bucket size (tools/analyze.py, docs/sha256_bounds.json).
+MAX_BLOCKS = int(os.environ.get("HASH_MAX_BLOCKS", "8"))
+
+# below this, hash_many uses hashlib directly — a device round trip
+# costs more than hashing a handful of rows on the host
+MIN_DEVICE_HASH_BATCH = 32
+
+
+class Sha256Workload(Workload):
+    """SHA-256 plugin: host packing in ``encode``, the FIPS 180-4
+    kernel (:mod:`stellar_tpu.ops.sha256`) on device, ``hashlib`` as
+    the bit-identical host oracle for failover and audit."""
+
+    metrics_ns = "crypto.hash"
+    span_ns = "hash"
+
+    def __init__(self, max_blocks: int = MAX_BLOCKS):
+        self.max_blocks = int(max_blocks)
+
+    def encode(self, items: Sequence[bytes]
+               ) -> Tuple[np.ndarray, tuple]:
+        from stellar_tpu.ops import sha256 as sk
+        words, active, fits = sk.pack_messages(items, self.max_blocks)
+        return fits, (words, active)
+
+    def pad_rows(self) -> tuple:
+        # zero words, zero active blocks: a padded lane's state never
+        # advances past H0 — cheapest possible lane, sliced off
+        return (np.zeros((1, self.max_blocks, 16), dtype=np.uint32),
+                np.zeros((1, self.max_blocks), dtype=bool))
+
+    def kernel_fn(self):
+        from stellar_tpu.ops import sha256 as sk
+        return sk.sha256_kernel
+
+    def empty_result(self, n: int) -> np.ndarray:
+        return np.zeros((n, 8), dtype=np.uint32)
+
+    def host_result(self, items: Sequence[bytes]) -> np.ndarray:
+        from stellar_tpu.ops import sha256 as sk
+        return sk.host_digest_words(items)
+
+    def finalize(self, gate: np.ndarray, out: np.ndarray,
+                 items: Sequence[bytes]) -> np.ndarray:
+        if gate.all():
+            return out
+        # oversize rows: host-hashed here, by capacity (not failure)
+        res = out.copy()
+        idxs = np.flatnonzero(~gate)
+        res[idxs] = self.host_result([items[i] for i in idxs])
+        return res
+
+
+class BatchHasher(BatchEngine):
+    """Batched SHA-256 with the engine's jit bucket cache and fault
+    domains — the :class:`Sha256Workload` riding the generic engine.
+    Same constructor contract as ``BatchVerifier`` plus the block
+    capacity."""
+
+    def __init__(self, mesh=None,
+                 bucket_sizes=DEFAULT_HASH_BUCKET_SIZES,
+                 max_blocks: int = MAX_BLOCKS):
+        super().__init__(Sha256Workload(max_blocks), mesh=mesh,
+                         bucket_sizes=bucket_sizes)
+
+    def hash_batch(self, msgs: Sequence[bytes]) -> List[bytes]:
+        """Digests for ``msgs``, bit-identical to ``hashlib.sha256``,
+        in order. The root span covers the whole blocking call
+        (per-phase attribution via
+        ``batch_engine.phase_attribution(..., span_ns="hash")``)."""
+        from stellar_tpu.ops import sha256 as sk
+        words = self.compute_batch(msgs)
+        return [sk.digest_words_to_bytes(row) for row in words]
+
+    def hash_words(self, msgs: Sequence[bytes]) -> np.ndarray:
+        """Digest word rows (n, 8) uint32 — the raw engine result
+        (differential suites compare these directly)."""
+        return self.compute_batch(msgs)
+
+
+_default: Optional[BatchHasher] = None
+_default_lock = threading.Lock()
+
+
+def default_hasher() -> BatchHasher:
+    """Process-wide hasher, mesh-sharded with zero config like
+    ``default_verifier`` (the two workloads share the physical mesh
+    and its per-device health registry)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = BatchHasher(
+                mesh=batch_engine._auto_mesh(),
+                bucket_sizes=DEFAULT_HASH_BUCKET_SIZES)
+        return _default
+
+
+def hash_many(blobs: Sequence[bytes]) -> List[bytes]:
+    """SHA-256 each blob — the drop-in for serial per-item
+    ``sha256()`` loops on bulk paths (catchup chain verification,
+    bucket-level hashing, TxSet contents-hash prefetch).
+
+    Small batches, and any process without a live accelerator, use
+    ``hashlib`` directly (bit-identical, and faster than XLA-on-CPU —
+    the same auto-mode policy as ``keys.batch_verify_into_cache``);
+    large batches on a live device ride the engine with its audit and
+    failover. Either way the returned bytes are exactly
+    ``hashlib.sha256(blob).digest()``."""
+    blobs = list(blobs)
+    if len(blobs) < MIN_DEVICE_HASH_BATCH or \
+            not batch_engine.device_available(block=False):
+        return [hashlib.sha256(b).digest() for b in blobs]
+    return default_hasher().hash_batch(blobs)

@@ -1,4 +1,5 @@
-"""Host↔TPU bridge for batch ed25519 verification.
+"""Host↔TPU bridge for batch ed25519 verification — workload #1 of the
+generic batch-dispatch engine.
 
 This is the TPU-native replacement for the reference's verify boundary
 (``PubKeyUtils::verifySig``, ``src/crypto/SecretKey.cpp:435-468``): callers
@@ -18,18 +19,16 @@ Division of labor (mirrors libsodium's own decomposition):
   32-byte A/R/s/h rows (256 KB per 2k sigs) and unpacks scalar digits
   itself.
 
-Batches are padded to a small set of bucket sizes so each size
-jit-compiles exactly once; oversize batches are chunked. On a
-multi-chip host each padded bucket is split into per-device SUB-CHUNKS
-(bucket // n_devices rows each) dispatched independently to the
-devices of a 1-D mesh — pure data parallelism, no collectives, same
-math as the former ``shard_map`` dispatch, but every device interaction
-is now ATTRIBUTABLE to one chip. That attribution is the fault-domain
-boundary (``docs/robustness.md``): a failing device opens only its own
-breaker (``stellar_tpu.parallel.device_health``), its share of the
-batch re-shards over the surviving devices at unchanged sub-chunk
-shapes (so degradation never pays a fresh XLA compile), and a
-half-open re-probe regrows it into the rotation.
+Since ISSUE 7 the dispatch machinery itself — jit bucket cache,
+per-device fault domains + degraded re-shard, circuit breakers,
+watchdogged fetches, the sampled result-integrity audit, host-oracle
+failover, and span instrumentation — lives in the workload-agnostic
+:class:`stellar_tpu.parallel.batch_engine.BatchEngine`;
+:class:`BatchVerifier` is the engine driven by the
+:class:`Ed25519Workload` plugin, bit-identical in behavior to the
+pre-refactor module (every chaos / device-domain / soak gate runs
+against this composition). The second workload on the same substrate
+is batched SHA-256 (:mod:`stellar_tpu.crypto.batch_hasher`).
 
 ``submit`` is the asynchronous half of the API: it dispatches the device
 kernel without blocking and returns a resolver, so a caller draining a
@@ -40,48 +39,40 @@ classes" latency strategy from SURVEY §7.
 The process-wide verify-result cache (the reference's 0xffff-entry
 ``RandomEvictionCache``, ``SecretKey.cpp:44-48,318-338``) lives in
 ``stellar_tpu.crypto.keys``; :meth:`BatchVerifier.install` wires this
-verifier in behind it.
+verifier in behind it. Fault tolerance and the result-integrity story
+are the engine's (``docs/robustness.md``): degraded mode changes
+latency, never decisions, and a corrupting accelerator never decides
+signature validity.
 
-Fault tolerance (``docs/robustness.md``): the tunnel's observed failure
-mode is a HANG, not an exception — a mid-flight death would park
-``resolve`` in ``np.asarray`` forever. Every device interaction is
-therefore (a) deadline-guarded (``VERIFY_DEVICE_DEADLINE_MS``), (b)
-accounted to a circuit breaker — the PER-DEVICE one when the failure is
-attributable to a mesh device, the process-wide one otherwise — and
-(c) backed by host re-verification of the affected rows through the
-same oracle stack (`ed25519_ref`/`native_verify`) — degraded mode
-changes latency, never decisions. The breaker also paces
-``device_available`` re-probes so a recovered tunnel is picked up
-(half-open) instead of being ignored for the life of the process.
-
-A chip that returns WRONG BITS instead of hanging defeats all of the
-above, so every resolve additionally re-verifies a deterministic
-content-seeded sample of device verdicts through the host oracle
-(``VERIFY_AUDIT_RATE``, :mod:`stellar_tpu.crypto.audit`); a mismatch
-hard-quarantines the device, flips the process into HOST-ONLY mode,
-and re-verifies the affected rows — a corrupting accelerator never
-decides signature validity.
+For compatibility (tests, tools, the admin surface) this module
+re-exports the engine's process-wide dispatch state and functions under
+their historical names — ``configure_dispatch``, ``dispatch_health``,
+``device_available``, the breaker, the probe state, the knobs.
 """
 
 from __future__ import annotations
 
-import logging
-import os
 import threading
-import time
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
-from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.crypto import ed25519_ref as ref
 from stellar_tpu.crypto import native_prep
-from stellar_tpu.parallel import device_health
-from stellar_tpu.utils import faults, resilience, tracing
+from stellar_tpu.parallel import batch_engine
+from stellar_tpu.parallel.batch_engine import (  # noqa: F401 (re-exports)
+    DEFAULT_BUCKET_SIZES, RESOLVE_PHASES, RESOLVE_ROOT, BatchEngine,
+    Workload, _auto_mesh, _breaker, _enter_host_only, _note_device_failure,
+    _reset_dispatch_state_for_testing, configure_dispatch, device_available,
+    dispatch_attribution, dispatch_degraded, dispatch_health,
+    host_only_mode, note_shed_onset, register_service_health,
+    served_counts, service_health_snapshot, start_device_probe,
+)
+from stellar_tpu.utils import resilience
 from stellar_tpu.utils.metrics import registry
 
-__all__ = ["BatchVerifier", "default_verifier", "device_available",
-           "dispatch_health", "configure_dispatch",
+__all__ = ["BatchVerifier", "Ed25519Workload", "default_verifier",
+           "device_available", "dispatch_health", "configure_dispatch",
            "dispatch_attribution", "dispatch_degraded",
            "note_shed_onset", "register_service_health",
            "RESOLVE_PHASES", "RESOLVE_ROOT"]
@@ -96,302 +87,20 @@ _SMALL_ORDER = np.stack([np.frombuffer(e, dtype=np.uint8)
 _L_BYTES = np.frombuffer(_L.to_bytes(32, "little"), dtype=np.uint8)
 _P_BYTES = np.frombuffer(_P.to_bytes(32, "little"), dtype=np.uint8)
 
-
-# ---------------- dispatch resilience policy ----------------
-# Env defaults let tools/bench set these without a Config; a node pushes
-# its Config knobs through configure_dispatch() at setup.
-
-DEADLINE_MS = float(os.environ.get("VERIFY_DEVICE_DEADLINE_MS", "8000"))
-DISPATCH_RETRIES = int(os.environ.get("VERIFY_DISPATCH_RETRIES", "1"))
-# Result-integrity audit: fraction of each device-served part re-checked
-# through the host oracle (min 1 row per part; <= 0 disables). The
-# sample is derived from the batch CONTENT (crypto/audit.py) so
-# consensus replicas audit identical rows.
-AUDIT_RATE = float(os.environ.get("VERIFY_AUDIT_RATE", "0.02"))
-
-# The production jit bucket ladder (default_verifier). Also the shape
-# set the static overflow prover must cover — stellar_tpu.analysis.
-# overflow proves the kernel at exactly these sizes (tools/analyze.py).
-DEFAULT_BUCKET_SIZES = (128, 512, 2048, 4096, 8192, 16384)
-
-_log = logging.getLogger("stellar_tpu.crypto")
+# Mutable process-wide dispatch state lives in batch_engine (it is
+# shared by every workload); module __getattr__ below forwards reads of
+# the historical names (bv.DEADLINE_MS, bv._device_state, bv._probe,
+# ...) so existing tests and tools keep working against the live
+# values, not stale copies.
+_ENGINE_STATE = ("DEADLINE_MS", "DISPATCH_RETRIES", "AUDIT_RATE",
+                 "_device_state", "_probe", "_host_only")
 
 
-# ---------------- resolve flight-recorder phases (ISSUE 5) ----------------
-# Every phase of a blocking verify is a span; the phases are DISJOINT
-# wall-time intervals under the RESOLVE_ROOT span, so summing their
-# timer deltas attributes the blocking headline ("relay = X ms, device
-# compute = Y ms, fetch = Z ms" — docs/observability.md). The next
-# dispatch-floor PR starts from this breakdown, not one opaque number.
-RESOLVE_PHASES = ("verify.prep", "verify.bucket", "verify.dispatch",
-                  "verify.fetch", "verify.audit", "verify.host_fallback")
-RESOLVE_ROOT = "verify.blocking"
-
-
-def dispatch_attribution(before: dict, after: dict, reps: int = 1) -> dict:
-    """Per-phase dispatch attribution from span-timer deltas.
-
-    ``before``/``after`` are :func:`stellar_tpu.utils.tracing.
-    span_totals` snapshots taken around the measured resolves. EVERY
-    phase is reported (zero-count phases included), so a dead-tunnel
-    record still carries the complete breakdown; ``coverage`` is the
-    phase-sum over the blocking root span's time — the reconciliation
-    the bench record asserts (>= 0.95 means the breakdown explains the
-    headline, not a fraction of it)."""
-    def delta(name):
-        key = f"span.{name}"
-        b = before.get(key, {"count": 0, "sum_ms": 0.0})
-        a = after.get(key, {"count": 0, "sum_ms": 0.0})
-        return a["count"] - b["count"], a["sum_ms"] - b["sum_ms"]
-
-    reps = max(1, int(reps))
-    phases = {}
-    phase_sum = 0.0
-    for name in RESOLVE_PHASES:
-        c, s = delta(name)
-        phases[name] = {"count": c, "total_ms": round(s, 3),
-                        "per_rep_ms": round(s / reps, 4)}
-        phase_sum += s
-    root_count, root_sum = delta(RESOLVE_ROOT)
-    coverage = (phase_sum / root_sum) if root_sum > 0 else None
-    return {
-        "phases": phases,
-        "span_sum_per_rep_ms": round(phase_sum / reps, 4),
-        "blocking_span_per_rep_ms": round(root_sum / reps, 4),
-        "blocking_span_count": root_count,
-        "coverage": round(coverage, 4) if coverage is not None else None,
-        "reps": reps,
-    }
-
-
-def _on_breaker_transition(old: str, new: str) -> None:
-    registry.counter("crypto.verify.breaker.transitions").inc()
-    registry.gauge("crypto.verify.breaker.state").set(new)
-    _log.warning("verify-device breaker %s -> %s", old, new)
-    if new == resilience.OPEN:
-        # flight-recorder trigger: the spans leading into the trip
-        # must survive to be read (docs/observability.md)
-        tracing.flight_recorder.dump("breaker-open:verify-device")
-
-
-_breaker = resilience.CircuitBreaker(
-    name="verify-device",
-    failure_threshold=int(os.environ.get(
-        "VERIFY_BREAKER_FAILURE_THRESHOLD", "3")),
-    backoff_min_s=float(os.environ.get(
-        "VERIFY_BREAKER_BACKOFF_MIN_S", "1")),
-    backoff_max_s=float(os.environ.get(
-        "VERIFY_BREAKER_BACKOFF_MAX_S", "120")),
-    on_transition=_on_breaker_transition)
-
-
-def configure_dispatch(deadline_ms: Optional[float] = None,
-                       dispatch_retries: Optional[int] = None,
-                       failure_threshold: Optional[int] = None,
-                       backoff_min_s: Optional[float] = None,
-                       backoff_max_s: Optional[float] = None,
-                       audit_rate: Optional[float] = None,
-                       device_failure_threshold: Optional[int] = None,
-                       device_backoff_min_s: Optional[float] = None,
-                       device_backoff_max_s: Optional[float] = None
-                       ) -> None:
-    """Push dispatch-resilience knobs (Config / tests); None keeps the
-    current value. ``deadline_ms <= 0`` disables the resolve watchdog;
-    ``audit_rate <= 0`` disables the result-integrity audit; the
-    ``device_*`` knobs shape the per-device quarantine breakers."""
-    global DEADLINE_MS, DISPATCH_RETRIES, AUDIT_RATE
-    if deadline_ms is not None:
-        DEADLINE_MS = float(deadline_ms)
-    if dispatch_retries is not None:
-        DISPATCH_RETRIES = max(0, int(dispatch_retries))
-    if audit_rate is not None:
-        AUDIT_RATE = float(audit_rate)
-    _breaker.configure(failure_threshold=failure_threshold,
-                       backoff_min_s=backoff_min_s,
-                       backoff_max_s=backoff_max_s)
-    device_health.get().configure(
-        failure_threshold=device_failure_threshold,
-        backoff_min_s=device_backoff_min_s,
-        backoff_max_s=device_backoff_max_s)
-
-
-# ---------------- host-only mode (result-integrity posture) ----------------
-# Once ANY device is caught returning wrong verdict bits, the process
-# stops trusting the accelerator path entirely: quarantining the one
-# chip bounds the blast radius, but a machine that corrupted once has
-# forfeited the benefit of the doubt for consensus decisions. Sticky
-# for the process lifetime (operators restart after replacing the
-# part); tests reset via _reset_dispatch_state_for_testing.
-
-_host_only = False
-_host_only_lock = threading.Lock()
-
-
-def _enter_host_only(reason: str) -> None:
-    global _host_only
-    with _host_only_lock:
-        already = _host_only
-        _host_only = True
-    if not already:
-        registry.gauge("crypto.verify.host_only").set(True)
-        _log.error(
-            "verify dispatch entering HOST-ONLY mode (%s): device "
-            "verdicts are no longer trusted for consensus decisions",
-            reason)
-
-
-def host_only_mode() -> bool:
-    return _host_only
-
-
-def dispatch_degraded() -> bool:
-    """True when the accelerator path is unavailable to new work — the
-    global breaker is OPEN or the process flipped host-only. This is
-    the verify service's shed-ladder pressure input
-    (:mod:`stellar_tpu.crypto.verify_service`): with effective
-    capacity collapsed to the host oracle, the service sheds
-    lowest-priority backlog instead of queueing to death."""
-    return _host_only or _breaker.state == resilience.OPEN
-
-
-# ---------------- resident verify service hooks ----------------
-# verify_service.py sits ON TOP of this module and is inside the
-# consensus nondet-lint scope, so it may not import the clock-bearing
-# tracing layer directly; its flight-recorder trigger and health
-# surface route through here instead.
-
-_service_lock = threading.Lock()
-_service_health_provider: Optional[Callable[[], dict]] = None
-
-
-def register_service_health(provider: Optional[Callable[[], dict]]
-                            ) -> None:
-    """Install the resident verify service's snapshot callable so
-    ``dispatch_health()`` (and the ``dispatch`` admin route) carries
-    queue depths and shed/reject accounting next to the breaker state.
-    ``None`` unregisters (tests)."""
-    global _service_health_provider
-    with _service_lock:
-        _service_health_provider = provider
-
-
-def service_health_snapshot() -> dict:
-    """The registered service's snapshot, or ``{"running": False}``
-    when no service ever started — shared by ``dispatch_health()``
-    and the ``service`` admin route."""
-    provider = _service_health_provider
-    return provider() if provider is not None else {"running": False}
-
-
-def note_shed_onset(reason: str) -> None:
-    """First-onset load-shed trigger: dump the flight recorder so the
-    spans and queue events leading INTO the overload survive to be
-    read (same policy as breaker trips and audit mismatches —
-    docs/observability.md)."""
-    registry.counter("crypto.verify.service.shed_onsets").inc()
-    tracing.flight_recorder.dump(f"service-shed:{reason}")
-
-
-def served_counts() -> dict:
-    """Process-wide items-served tally by backend — the attribution
-    bench.py records so a silent fallback can never be reported as a
-    device number."""
-    return {
-        "device": registry.meter("crypto.verify.serve.device").count,
-        "host_fallback": registry.meter(
-            "crypto.verify.serve.host_fallback").count,
-    }
-
-
-def dispatch_health() -> dict:
-    """Degradation observability (info endpoint / `dispatch` admin
-    route): breaker state, backend attribution, fallback/retry/deadline
-    counters, active knobs."""
-    return {
-        "device_state": _device_state or "unprobed",
-        "breaker": _breaker.snapshot(),
-        "deadline_ms": DEADLINE_MS,
-        "dispatch_retries": DISPATCH_RETRIES,
-        "served": served_counts(),
-        "fallback_chunks": registry.meter(
-            "crypto.verify.dispatch.fallback").count,
-        "deadline_misses": registry.counter(
-            "crypto.verify.dispatch.deadline_miss").count,
-        "retries": registry.counter("crypto.verify.dispatch.retry").count,
-        "short_circuits": registry.counter(
-            "crypto.verify.dispatch.short_circuit").count,
-        "host_only": _host_only,
-        "audit": {
-            "rate": AUDIT_RATE,
-            "sampled": registry.counter(
-                "crypto.verify.audit.sampled").count,
-            "mismatches": registry.counter(
-                "crypto.verify.audit.mismatch").count,
-        },
-        "device_health": device_health.get().snapshot(),
-        "watchdog": resilience.watchdog_stats(),
-        "flight_recorder": tracing.flight_recorder.stats(),
-        "service": service_health_snapshot(),
-    }
-
-
-def _note_device_failure(stage: str, exc: BaseException,
-                         dev_idx: Optional[int] = None) -> None:
-    """One failing device interaction: breaker accounting + metrics.
-    ``dev_idx`` attributes the failure to ONE mesh device (only its
-    breaker opens — the fault-domain boundary); None means the failure
-    is not attributable (single-device dispatch) and feeds the
-    process-wide breaker. The caller re-verifies the affected rows on
-    the host."""
-    registry.meter("crypto.verify.dispatch.fallback").mark()
-    if dev_idx is None:
-        _breaker.record_failure()
-    elif device_health.get().record_failure(dev_idx):
-        # correlated-outage escalation: each quarantine ONSET counts
-        # one failure against the global breaker. A single sick chip
-        # (one quarantine, then healthy traffic resets the streak)
-        # leaves the mesh serving; a whole-tunnel death quarantines
-        # device after device with no intervening success, reaches the
-        # global threshold, and short-circuits the remaining chunks —
-        # bounding the outage at global_threshold quarantines instead
-        # of n_devices independent ones
-        tracing.flight_recorder.dump(f"quarantine:device{dev_idx}")
-        _breaker.record_failure()
-    _log.warning(
-        "device%s %s failed (%s: %s) — affected rows re-verified on "
-        "the host oracle",
-        "" if dev_idx is None else f" {dev_idx}",
-        stage, type(exc).__name__, exc)
-
-
-def _resolve_budget_s() -> Optional[float]:
-    """Watchdog budget for one device-array fetch, or None (unguarded).
-    Guarded whenever a real accelerator answered the probe (hangs are
-    its observed failure mode) or a chaos fault is armed; UNGUARDED on
-    jax-CPU/unprobed processes — XLA-on-CPU test executions are slow
-    but cannot tunnel-hang, and a false deadline trip there would
-    silently reroute differential tests to the host oracle."""
-    if DEADLINE_MS <= 0:
-        return None
-    if faults.is_active(faults.RESOLVE) or faults.is_active(faults.DISPATCH):
-        return DEADLINE_MS / 1000.0
-    if _device_state in (None, "cpu"):
-        return None
-    return DEADLINE_MS / 1000.0
-
-
-def _fetch(dev, dev_idx: Optional[int] = None) -> np.ndarray:
-    """The blocking half of a dispatch (runs under the watchdog).
-    ``dev_idx`` attributes the fetch to one mesh device for per-device
-    chaos faults — including verdict corruption, applied here so the
-    wrong bits flow through exactly the path real corruption would.
-    The span opens on the POOL WORKER with the submitter's propagated
-    context, so a fetch that hangs appears OPEN in a flight-recorder
-    dump, parent-linked to the resolve that dispatched it."""
-    with tracing.span("verify.fetch.device", device=dev_idx):
-        faults.inject(faults.RESOLVE, device=dev_idx)
-        arr = np.asarray(dev)
-        return faults.corrupt_verdicts(faults.RESOLVE, dev_idx, arr)
+def __getattr__(name: str):
+    if name in _ENGINE_STATE:
+        return getattr(batch_engine, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 def _host_verify_items(items: Sequence[tuple]) -> np.ndarray:
@@ -432,233 +141,19 @@ def _small_order_mask(enc: np.ndarray) -> np.ndarray:
     return (masked[:, None, :] == _SMALL_ORDER[None, :, :]).all(-1).any(-1)
 
 
-class BatchVerifier:
-    """Batched libsodium-exact ed25519 verifier with a jit bucket cache.
+class Ed25519Workload(Workload):
+    """The ed25519 verify workload: host policy gates + SHA-512 prep in
+    ``encode``, the signed-window Strauss-Shamir kernel on device, the
+    libsodium-exact host oracle for failover and audit. The gate mask
+    is the host policy verdict: a gate-rejected row is False regardless
+    of device bits (``finalize`` ANDs it in), exactly libsodium's
+    composed decision."""
 
-    Args:
-      mesh: optional 1-D ``jax.sharding.Mesh``; if given (and it spans
-        >= 2 devices), buckets divisible by the device count are split
-        into per-device SUB-CHUNKS of the plain kernel — one
-        attributable dispatch per device, quarantine/re-shard per
-        ``stellar_tpu.parallel.device_health`` — instead of one
-        ``shard_map`` call. Non-divisible buckets (and mesh=None) use
-        a single whole-bucket dispatch under the global breaker.
-      bucket_sizes: padded batch sizes, ascending; each dispatch shape
-        compiles once (per serving device on the mesh path).
-    """
+    metrics_ns = "crypto.verify"
+    span_ns = "verify"
 
-    def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
-        self._mesh = mesh
-        self._devices = None
-        if mesh is not None:
-            from stellar_tpu.parallel.mesh import mesh_devices
-            devs = mesh_devices(mesh)
-            if len(devs) >= 2:
-                self._devices = devs
-        self._buckets = tuple(sorted(bucket_sizes))
-        # jit-wrapper cache keyed by DISPATCH SHAPE (rows per kernel
-        # call: the bucket on single-device hosts, bucket // n_devices
-        # on a mesh): written from any thread that dispatches (trickle
-        # leaders, chaos tests, the close path) — guarded, the wrapper
-        # itself is built outside the lock (cheap; the compile happens
-        # lazily at first call)
-        self._kernels = {}
-        self._kernels_lock = threading.Lock()
-        # per-instance backend attribution (items served), mirrored into
-        # the process-wide meters: bench and the chaos tests read these
-        self._stats_lock = threading.Lock()
-        self.served = {"device": 0, "host-fallback": 0}
-        self.device_served = {}  # mesh device index -> items served
-        self.deadline_misses = 0
-        self.retries = 0
-        self.audit_mismatches = 0
-
-    def _mark_served(self, kind: str, n: int,
-                     dev_idx: Optional[int] = None) -> None:
-        with self._stats_lock:
-            self.served[kind] += n
-            if dev_idx is not None:
-                self.device_served[dev_idx] = \
-                    self.device_served.get(dev_idx, 0) + n
-        registry.meter("crypto.verify.serve." +
-                       ("device" if kind == "device" else
-                        "host_fallback")).mark(n)
-
-    # ---------------- device dispatch ----------------
-
-    def _kernel_for(self, n: int):
-        with self._kernels_lock:
-            kernel = self._kernels.get(n)
-        if kernel is None:
-            import jax
-            from stellar_tpu.ops import verify as vk
-            # one plain jit wrapper per dispatch shape; on the mesh
-            # path placement follows the committed inputs, so the SAME
-            # wrapper serves every device (jax caches one executable
-            # per (shape, device) underneath)
-            built = jax.jit(vk.verify_kernel)
-            with self._kernels_lock:
-                # setdefault: a racing builder's wrapper wins once —
-                # both wrappers trace identically, so the loser is
-                # just garbage, never a different kernel
-                kernel = self._kernels.setdefault(n, built)
-        return kernel
-
-    def _bucket(self, n: int) -> int:
-        for b in self._buckets:
-            if n <= b:
-                return b
-        return self._buckets[-1]
-
-    def _dispatch_one(self, aa, rr, ss, hh, bsize: int,
-                      dev_idx: Optional[int]):
-        """One kernel call (whole padded bucket, or one per-device
-        sub-chunk): inject-point + retry + failure attribution. Returns
-        the in-flight device array, or None (host fallback)."""
-        attempts = 1 + DISPATCH_RETRIES
-        for attempt in range(attempts):
-            try:
-                faults.inject(faults.DISPATCH, device=dev_idx)
-                return self._kernel_for(bsize)(aa, rr, ss, hh)
-            except Exception as e:
-                if attempt + 1 < attempts:
-                    registry.counter(
-                        "crypto.verify.dispatch.retry").inc()
-                    with self._stats_lock:
-                        self.retries += 1
-                else:
-                    _note_device_failure("dispatch", e, dev_idx)
-        return None
-
-    def _dispatch_parts(self, aa, rr, ss, hh, b: int, chunk: int):
-        """Split one padded bucket into per-device sub-chunks over the
-        CURRENTLY HEALTHY devices — the degraded-mesh re-shard.
-
-        The sub-chunk shape is fixed at ``b // n_devices`` for the FULL
-        mesh size, independent of how many devices survive: quarantine
-        only changes which healthy device serves how many sub-chunks
-        (round-robin over the survivors), never the shapes — and every
-        survivor already compiled its sub-chunk executable when it
-        served its own share, so degradation and regrowth never pay a
-        fresh XLA compile (the invariant `docs/robustness.md` pins).
-
-        A half-open device's breaker grants exactly one sub-chunk per
-        backoff window — probation traffic IS the re-probe; success
-        regrows the device into the rotation.
-
-        Returns part records ``[lo, hi, dev_idx, arr]``: valid rows
-        ``lo:hi`` of the chunk, serving device, in-flight array (None =
-        host fallback). All-padding tail sub-chunks are skipped."""
-        import jax
-        n_dev = len(self._devices)
-        sub = b // n_dev
-        # sub-chunks that carry real rows (pure-padding tails are
-        # never dispatched)
-        n_parts = min(n_dev, -(-chunk // sub))
-        assignment = device_health.get().assign_parts(n_dev, n_parts)
-        if assignment != list(range(n_parts)):
-            # degraded-mesh re-shard decision: record WHO serves WHAT
-            # (or None = host fallback) so a dump of a degraded window
-            # shows the assignment that produced its latencies
-            tracing.flight_recorder.note(
-                "verify.reshard", assignment=list(assignment),
-                parts=n_parts, devices=n_dev)
-        parts = []
-        for j, di in enumerate(assignment):
-            lo = j * sub
-            hi = min(lo + sub, chunk)
-            if di is None:
-                # zero survivors and no probation grants: the whole
-                # mesh is quarantined — only now does the verifier
-                # fall back to the host oracle
-                registry.counter(
-                    "crypto.verify.dispatch.short_circuit").inc()
-                parts.append([lo, hi, None, None])
-                continue
-            placed = tuple(
-                jax.device_put(x[lo:lo + sub], self._devices[di])
-                for x in (aa, rr, ss, hh))
-            arr = self._dispatch_one(*placed, bsize=sub, dev_idx=di)
-            parts.append([lo, hi, di, arr])
-        return parts
-
-    def _dispatch_device(self, a: np.ndarray, r: np.ndarray, s: np.ndarray,
-                         h: np.ndarray):
-        """Dispatch padded/chunked batches to the jitted kernel without
-        blocking; returns a list of (slice, chunk_len, parts) where
-        parts are per-device sub-chunk records (single-device hosts get
-        one whole-bucket part). A part whose dispatch raises (or that
-        an open breaker refuses, or host-only mode) carries ``None``
-        and is re-verified on the host at resolve time; transient
-        dispatch exceptions get ``DISPATCH_RETRIES`` fresh attempts
-        first."""
-        n = a.shape[0]
-        top = self._buckets[-1]
-        pending = []
-        start = 0
-        host_only = _host_only
-        while start < n:
-            chunk = min(top, n - start)
-            b = self._bucket(chunk)
-            pad = b - chunk
-            sl = slice(start, start + chunk)
-
-            def _padded_inputs():
-                # built ONLY for chunks that will actually dispatch:
-                # a host-only or breaker-refused chunk must not pay
-                # 4x bucket-sized copies it never reads (nor charge
-                # them to the bucket phase of the attribution)
-                with tracing.span("verify.bucket"):
-                    return (
-                        np.concatenate([a[sl],
-                                        np.repeat(_PAD_A, pad, 0)]),
-                        np.concatenate([r[sl],
-                                        np.repeat(_PAD_R, pad, 0)]),
-                        np.concatenate([s[sl],
-                                        np.repeat(_PAD_S, pad, 0)]),
-                        np.concatenate([h[sl],
-                                        np.repeat(_PAD_H, pad, 0)]))
-
-            if host_only:
-                # integrity posture: no device dispatch at all
-                parts = [[0, chunk, None, None]]
-            elif self._devices is not None and \
-                    b % len(self._devices) == 0:
-                # the global breaker gates the mesh path too: a
-                # correlated outage (escalated quarantines) opens it
-                # and short-circuits whole chunks; its half-open grant
-                # admits one chunk as the recovery probe
-                if _breaker.allow():
-                    aa, rr, ss, hh = _padded_inputs()
-                    with tracing.span("verify.dispatch", devices=True):
-                        parts = self._dispatch_parts(aa, rr, ss, hh, b,
-                                                     chunk)
-                else:
-                    registry.counter(
-                        "crypto.verify.dispatch.short_circuit").inc()
-                    parts = [[0, chunk, None, None]]
-            elif _breaker.allow():
-                aa, rr, ss, hh = _padded_inputs()
-                with tracing.span("verify.dispatch"):
-                    arr = self._dispatch_one(aa, rr, ss, hh, b, None)
-                parts = [[0, chunk, None, arr]]
-            else:
-                registry.counter(
-                    "crypto.verify.dispatch.short_circuit").inc()
-                parts = [[0, chunk, None, None]]
-            pending.append((sl, chunk, parts))
-            start += chunk
-        return pending
-
-    # ---------------- public API ----------------
-
-    def _prep(self, items: Sequence[tuple]):
-        # host-side prep phase: byte recode into the on-wire matrices,
-        # SHA-512(R||A||M) mod L, and the policy gates
-        with tracing.span("verify.prep"):
-            return self._prep_inner(items)
-
-    def _prep_inner(self, items: Sequence[tuple]):
+    def encode(self, items: Sequence[tuple]
+               ) -> Tuple[np.ndarray, tuple]:
         n = len(items)
         ok = np.ones(n, dtype=bool)
         # one frombuffer over joined bytes instead of three numpy row
@@ -694,170 +189,45 @@ class BatchVerifier:
         a_masked = a.copy()
         a_masked[:, 31] &= 0x7F
         ok &= _lt_le_bytes(a_masked, _P_BYTES)
-        return ok, a, r, s, h
+        return ok, (a, r, s, h)
 
-    def submit(self, items: Sequence[tuple]) -> Callable[[], np.ndarray]:
-        """Asynchronous verify: host prep + non-blocking device dispatch.
+    def pad_rows(self) -> tuple:
+        return (_PAD_A, _PAD_R, _PAD_S, _PAD_H)
 
-        Returns a zero-arg resolver; calling it blocks on the device result
-        and returns the per-item bool array. Multiple submitted batches
-        pipeline on device (jax async dispatch), overlapping transfer and
-        compute across batches.
-        """
-        n = len(items)
-        if n == 0:
-            return lambda: np.zeros(0, dtype=bool)
-        ok, a, r, s, h = self._prep(items)
-        if not ok.any():
-            return lambda: ok
-        pending = self._dispatch_device(a, r, s, h)
-        items = list(items)  # pinned for possible host re-verification
+    def kernel_fn(self):
+        from stellar_tpu.ops import verify as vk
+        return vk.verify_kernel
 
-        def _audit_part(vals: np.ndarray, gl: int, gh: int,
-                        di: Optional[int]) -> bool:
-            """Sampled result-integrity audit of one device-served
-            part (global rows ``gl:gh``): re-verify a content-seeded
-            sample through the host oracle and compare against the
-            COMPOSED decision (host policy gate AND device verdict) —
-            the quantity that is pinned bit-identical to libsodium.
-            Only rows that PASSED the host policy gate are sampled:
-            a gate-rejected row is False regardless of device bits, so
-            auditing it would be vacuous (and a predictable blind
-            spot). True = clean (or nothing to audit)."""
-            with tracing.span("verify.audit", device=di):
-                material = (a[gl:gh].tobytes() + r[gl:gh].tobytes() +
-                            s[gl:gh].tobytes() + h[gl:gh].tobytes())
-                eligible = [i for i in range(gh - gl) if ok[gl + i]]
-                idxs = audit_mod.sample_rows(material, eligible,
-                                             AUDIT_RATE)
-                if not idxs:
-                    return True
-                registry.counter("crypto.verify.audit.sampled").inc(
-                    len(idxs))
-                want = _host_verify_items([items[gl + i] for i in idxs])
-                got_comp = np.array([bool(vals[i]) for i in idxs])
-                clean = bool((want == got_comp).all())
-            # verdict lands in both evidence streams: the per-device
-            # health registry (MULTICHIP fault-domain evidence) and
-            # the flight recorder (visible in dumps near the spans)
-            device_health.get().note_audit(di, ok=clean,
-                                           sampled=len(idxs))
-            tracing.flight_recorder.note(
-                "verify.audit.verdict",
-                **audit_mod.verdict_record(di, gl, gh, len(idxs),
-                                           clean))
-            return clean
+    def empty_result(self, n: int) -> np.ndarray:
+        return np.zeros(n, dtype=bool)
 
-        def _resolve_impl() -> np.ndarray:
-            out = np.zeros(n, dtype=bool)
-            for sl, chunk, parts in pending:
-                for lo, hi, di, arr in parts:
-                    got = None
-                    # _host_only is re-read PER PART: once any part's
-                    # audit proves corruption, the remaining
-                    # already-dispatched parts of this very batch are
-                    # host re-verified too — the batch that convicted
-                    # the machine must not let device bits decide its
-                    # other rows
-                    if arr is not None and not _host_only:
-                        # an OPEN breaker short-circuits this fault
-                        # domain's remaining parts so one outage costs
-                        # threshold x deadline, not parts x deadline;
-                        # state (not allow()) is checked because a
-                        # half-open part already holds its grant from
-                        # dispatch time and must be fetched, not
-                        # refused
-                        gate = _breaker if di is None else \
-                            device_health.get().breaker(di)
-                        if gate.state != resilience.OPEN:
-                            # the fetch span covers the whole
-                            # fetch/deadline race; a trip dumps while
-                            # it (and the worker-side device span) are
-                            # still open, so the dump shows exactly
-                            # where the hang is parked
-                            with tracing.span("verify.fetch",
-                                              device=di):
-                                try:
-                                    got = resilience.call_with_deadline(
-                                        lambda d=arr, i=di:
-                                        _fetch(d, i),
-                                        _resolve_budget_s(),
-                                        name="verify-resolve")
-                                except resilience.DeadlineExceeded as e:
-                                    registry.counter(
-                                        "crypto.verify.dispatch."
-                                        "deadline_miss").inc()
-                                    with self._stats_lock:
-                                        self.deadline_misses += 1
-                                    _note_device_failure(
-                                        "resolve-deadline", e, di)
-                                    tracing.flight_recorder.dump(
-                                        "watchdog-timeout:device"
-                                        f"{'-global' if di is None else di}")
-                                except Exception as e:
-                                    _note_device_failure(
-                                        "resolve", e, di)
-                        else:
-                            registry.counter(
-                                "crypto.verify.dispatch."
-                                "short_circuit").inc()
-                    gl, gh = sl.start + lo, sl.start + hi
-                    if got is not None:
-                        vals = np.asarray(got)[:hi - lo]
-                        if not _audit_part(vals, gl, gh, di):
-                            # wrong bits: hard-quarantine the chip,
-                            # stop trusting the accelerator path, and
-                            # re-verify the whole part on the host —
-                            # the corrupted verdicts never surface
-                            registry.counter(
-                                "crypto.verify.audit.mismatch").inc()
-                            with self._stats_lock:
-                                self.audit_mismatches += 1
-                            if di is not None:
-                                device_health.get().quarantine(
-                                    di, reason="audit-mismatch")
-                            else:
-                                _breaker.trip()
-                            tracing.flight_recorder.dump(
-                                f"audit-mismatch:device{di}")
-                            _enter_host_only(
-                                "result-integrity audit mismatch on "
-                                f"device {di}")
-                            _log.error(
-                                "audit mismatch: device %s returned "
-                                "wrong verdict bits for rows %d:%d",
-                                di, gl, gh)
-                            got = None
-                        else:
-                            out[gl:gh] = vals
-                            if di is None:
-                                _breaker.record_success()
-                            else:
-                                device_health.get().record_success(di)
-                                # healthy traffic also resets the
-                                # global breaker's quarantine streak,
-                                # so isolated quarantines accumulated
-                                # over hours never masquerade as a
-                                # correlated outage (and a real one —
-                                # zero successes — still escalates)
-                                _breaker.record_success()
-                            self._mark_served("device", hi - lo, di)
-                    if got is None:
-                        # failover: bit-identical host re-verification
-                        # of the affected rows (latency changes,
-                        # decisions never do)
-                        with tracing.span("verify.host_fallback",
-                                          device=di):
-                            out[gl:gh] = _host_verify_items(
-                                items[gl:gh])
-                        self._mark_served("host-fallback", hi - lo)
-            return ok & out
+    def host_result(self, items: Sequence[tuple]) -> np.ndarray:
+        return _host_verify_items(items)
 
-        def resolve() -> np.ndarray:
-            with tracing.span("verify.resolve"):
-                return _resolve_impl()
+    def finalize(self, gate: np.ndarray, out: np.ndarray,
+                 items: Sequence[tuple]) -> np.ndarray:
+        return gate & out
 
-        return resolve
+
+class BatchVerifier(BatchEngine):
+    """Batched libsodium-exact ed25519 verifier with a jit bucket cache
+    — the :class:`Ed25519Workload` riding the generic engine.
+
+    Args:
+      mesh: optional 1-D ``jax.sharding.Mesh``; if given (and it spans
+        >= 2 devices), buckets divisible by the device count are split
+        into per-device SUB-CHUNKS of the plain kernel — one
+        attributable dispatch per device, quarantine/re-shard per
+        ``stellar_tpu.parallel.device_health`` — instead of one
+        ``shard_map`` call. Non-divisible buckets (and mesh=None) use
+        a single whole-bucket dispatch under the global breaker.
+      bucket_sizes: padded batch sizes, ascending; each dispatch shape
+        compiles once (per serving device on the mesh path).
+    """
+
+    def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
+        super().__init__(Ed25519Workload(), mesh=mesh,
+                         bucket_sizes=bucket_sizes)
 
     def verify_batch(self, items: Sequence[tuple]) -> np.ndarray:
         """items: sequence of (pk: bytes, msg: bytes, sig: bytes).
@@ -865,8 +235,7 @@ class BatchVerifier:
         span covers the whole blocking call, so the per-phase spans
         under it attribute the blocking headline
         (:func:`dispatch_attribution`)."""
-        with tracing.span(RESOLVE_ROOT):
-            return self.submit(items)()
+        return self.compute_batch(items)
 
     def verify_sig(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         """Single verify (uncached — the process-wide result cache lives
@@ -1018,174 +387,6 @@ _PAD_H = np.zeros((1, 32), dtype=np.uint8)
 
 _default: Optional[BatchVerifier] = None
 _default_lock = threading.Lock()
-
-_device_state: Optional[str] = None  # None=unprobed, else platform|"dead"
-_device_probe_lock = threading.Lock()
-# current probe attempt: {"thread", "box", "started", "accounted"}.
-# Unlike the pre-breaker design this is RE-ARMABLE: a "dead" verdict is
-# re-probed when the breaker's backoff window expires, so a recovered
-# tunnel is picked up instead of being ignored for the process lifetime.
-_probe: Optional[dict] = None
-
-
-def _launch_probe_locked() -> dict:
-    """Spawn a fresh probe attempt (call with _device_probe_lock held).
-    A probe on a wedged tunnel hangs; its daemon thread is abandoned
-    when accounted — backoff growth bounds the leak to one thread per
-    half-open window."""
-    global _probe
-
-    box: dict = {}
-
-    def probe():
-        try:
-            faults.inject(faults.PROBE)
-            import jax
-            platform = jax.devices()[0].platform
-            if platform != "cpu":
-                # jax.devices() answers from the in-process cache once
-                # the backend has initialized, so on an accelerator only
-                # a REAL tiny dispatch proves the tunnel: a vacuous
-                # success here would re-close a dispatch-opened breaker
-                # (and reset its backoff) while the device is still
-                # dead. On a dead tunnel this hangs — exactly what the
-                # caller's watchdog + breaker accounting expect.
-                np.asarray(jax.jit(lambda x: x + 1)(
-                    np.zeros(2, np.int32)))
-            box["platform"] = platform
-        except Exception as e:  # no backend at all
-            box["error"] = str(e)
-
-    t = threading.Thread(target=probe, daemon=True, name="device-probe")
-    _probe = {"thread": t, "box": box, "started": time.monotonic(),
-              "accounted": False}
-    t.start()
-    return _probe
-
-
-def _account_probe_locked(cur: dict, hung: bool, timeout_s: float) -> None:
-    """Turn a finished/overdue probe attempt into device state + breaker
-    accounting (call with _device_probe_lock held; idempotent)."""
-    global _device_state
-    if cur["accounted"]:
-        return
-    cur["accounted"] = True
-    box = cur["box"]
-    if hung:
-        _device_state = "dead"
-        _breaker.record_failure()
-        _log.warning(
-            "device probe hung > %ss — signature verification falls "
-            "back to the host oracle (breaker: %s)",
-            timeout_s, _breaker.state)
-    elif "platform" in box:
-        _device_state = box["platform"]
-        _breaker.record_success()
-    else:
-        _device_state = "dead"
-        _breaker.record_failure()
-        _log.warning(
-            "device probe failed (%s) — signature verification falls "
-            "back to the host oracle (breaker: %s)",
-            box.get("error", "no backend"), _breaker.state)
-
-
-def start_device_probe() -> None:
-    """Fire the device probe WITHOUT waiting for it (idempotent).
-    Called from LedgerManager/Application construction so the jax
-    import + ``jax.devices()`` cost (seconds, or a hang on a dead
-    tunnel) is paid during startup, never inside the first ledger
-    close (the reference initializes its crypto stack at app start,
-    not in ``closeLedger``)."""
-    with _device_probe_lock:
-        if _probe is None and _device_state is None:
-            _launch_probe_locked()
-
-
-def device_available(timeout_s: float = 30.0,
-                     block: bool = True) -> bool:
-    """True when a REAL accelerator is reachable AND the dispatch
-    breaker is closed. Probes run in watchdogged threads: with the axon
-    tunnel down, ``jax.devices()`` hangs forever rather than raising,
-    and a node must fall back to the host oracle instead of hanging the
-    close path (failure detection, not configuration). jax-CPU reports
-    False permanently: batching bignum kernels through XLA-on-CPU is
-    strictly slower than the host oracle, so auto mode only engages the
-    device path on tpu-class hardware — that is configuration, and is
-    never re-probed.
-
-    A "dead" verdict, by contrast, is a FAILURE and heals: the circuit
-    breaker re-probes (half-open) once its exponential-backoff window
-    expires, so a tunnel that comes back is picked up without hammering
-    one that stays down.
-
-    ``block=False`` never waits: a still-pending probe answers False
-    for now WITHOUT caching a verdict, so latency-critical callers
-    (the close path) fall back to the host oracle this round and pick
-    up the device once the probe resolves. A pending probe older than
-    ``timeout_s`` is accounted hung even for non-blocking callers, so
-    breaker-paced recovery works on a node that only ever asks
-    non-blockingly."""
-    start_device_probe()
-    with _device_probe_lock:
-        cur = _probe
-        if cur is None or cur["accounted"]:
-            if _device_state == "cpu":
-                return False  # configuration, not a fault
-            if _device_state not in (None, "dead") and \
-                    _breaker.state == resilience.CLOSED:
-                return True
-            # dead (or breaker tripped by dispatch failures): re-probe
-            # only when the backoff window has expired
-            if _breaker.allow():
-                cur = _launch_probe_locked()
-            else:
-                return False
-    t = cur["thread"]
-    if block:
-        # join OUTSIDE the lock: a blocking waiter must never make a
-        # concurrent block=False caller (the close path) wait on the
-        # lock for up to timeout_s
-        t.join(timeout_s)
-    with _device_probe_lock:
-        if not cur["accounted"]:
-            if not t.is_alive():
-                _account_probe_locked(cur, hung=False, timeout_s=timeout_s)
-            elif block or \
-                    time.monotonic() - cur["started"] > timeout_s:
-                _account_probe_locked(cur, hung=True, timeout_s=timeout_s)
-            else:
-                return False  # pending — ask again later, don't cache
-        return _device_state not in (None, "dead", "cpu") and \
-            _breaker.state == resilience.CLOSED
-
-
-def _reset_dispatch_state_for_testing() -> None:
-    """Fresh probe/breaker state (chaos tests): equivalent to process
-    start for the dispatch layer. Cumulative metrics are untouched."""
-    global _device_state, _probe, _host_only
-    with _device_probe_lock:
-        _device_state = None
-        _probe = None
-    with _host_only_lock:
-        _host_only = False
-    _breaker.record_success()  # closed, zero failures, backoff reset
-    device_health.get()._reset_for_testing()
-
-
-def _auto_mesh():
-    """1-D mesh over every local device, or None when single-device.
-    Buckets not divisible by the mesh size fall back to the unsharded
-    kernel, so odd device counts degrade gracefully."""
-    try:
-        import jax
-        devs = jax.devices()
-    except Exception:
-        return None
-    if len(devs) < 2:
-        return None
-    from jax.sharding import Mesh
-    return Mesh(np.array(devs), ("batch",))
 
 
 def default_verifier() -> BatchVerifier:

@@ -25,7 +25,8 @@ from stellar_tpu.crypto.sha import sha256
 from stellar_tpu.utils.cache import RandomEvictionCache
 
 __all__ = [
-    "SecretKey", "PublicKey", "verify_sig", "set_verifier_backend",
+    "SecretKey", "PublicKey", "verify_sig", "cached_verify_sig",
+    "seed_verify_cache", "set_verifier_backend",
     "get_verifier_backend_name",
     "get_verify_cache_stats", "flush_verify_cache",
     "sign_ops_per_second", "verify_ops_per_second",
@@ -208,6 +209,33 @@ def verify_sig(pk, msg: bytes, sig: bytes) -> bool:
     with _cache_lock:
         _verify_cache.put(key, ok)
     return ok
+
+
+def cached_verify_sig(pk, msg: bytes, sig: bytes) -> Optional[bool]:
+    """Cache-only lookup of a prior ``verify_sig`` answer (``None`` on
+    miss) — lets adoption call sites (herder SCP envelopes) honor a
+    ``batch_verify_into_cache`` prefetch before paying a verify-service
+    round trip for one row. Malformed lengths answer ``False`` exactly
+    as ``verify_sig`` would."""
+    raw = pk.raw if isinstance(pk, PublicKey) else bytes(pk)
+    if len(sig) != 64 or len(raw) != 32:
+        return False
+    with _cache_lock:
+        return _verify_cache.maybe_get(_cache_key(raw, msg, sig))
+
+
+def seed_verify_cache(results) -> None:
+    """Seed the ``verify_sig`` result cache with already-decided
+    ``(pk, msg, sig, ok)`` quadruples — how a verify-service verdict
+    keeps the flood-dedup cache consistent with the direct path (the
+    service's answers are pinned bit-identical to the host oracle, so
+    seeding can never teach the cache a different decision)."""
+    keyed = [(_cache_key(pk, msg, sig), bool(ok))
+             for pk, msg, sig, ok in results
+             if len(pk) == 32 and len(sig) == 64]
+    with _cache_lock:
+        for k, ok in keyed:
+            _verify_cache.put(k, ok)
 
 
 def _host_oracle_batch(todo) -> list:

@@ -76,7 +76,7 @@ from stellar_tpu.utils.tracing import span
 
 __all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
            "SHED_LADDER", "configure_service", "default_service",
-           "service_health", "lane_latencies"]
+           "running_service", "service_health", "lane_latencies"]
 
 # re-export: the typed admission verdict lives with the resilience
 # primitives so TrickleBatcher can raise it without a module cycle
@@ -621,6 +621,23 @@ def default_service(start: bool = True) -> VerifyService:
     if start:
         svc.start()
     return svc
+
+
+def running_service() -> Optional[VerifyService]:
+    """The process-wide service IF it exists and is accepting work,
+    else ``None`` — the adoption check for call sites (herder SCP
+    envelopes, overlay pre-verify) that ride the priority lanes when
+    ``VERIFY_SERVICE_ENABLED`` started the service but must keep
+    their direct path otherwise. Never creates or starts a service
+    as a side effect (that is :func:`default_service`'s job)."""
+    with _service_lock:
+        svc = _service
+    if svc is None:
+        return None
+    with svc._cv:
+        if svc._running and not svc._stop:
+            return svc
+    return None
 
 
 def service_health() -> dict:

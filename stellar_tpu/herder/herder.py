@@ -21,8 +21,10 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from stellar_tpu.crypto.keys import (
-    SecretKey, batch_verify_into_cache, verify_sig,
+    SecretKey, batch_verify_into_cache, cached_verify_sig,
+    seed_verify_cache, verify_sig,
 )
+from stellar_tpu.crypto.verify_service import running_service
 from stellar_tpu.herder.transaction_queue import AddResult, TransactionQueue
 from stellar_tpu.herder.tx_set import (
     ApplicableTxSetFrame, TxSetXDRFrame, make_tx_set_from_transactions,
@@ -319,11 +321,37 @@ class Herder:
     # ---------------- SCP envelopes ----------------
 
     def verify_envelope(self, env: SCPEnvelope) -> bool:
-        """Sig hot path #2 (reference ``HerderImpl::verifyEnvelope``)."""
+        """Sig hot path #2 (reference ``HerderImpl::verifyEnvelope``).
+
+        When the resident verify service is running
+        (``VERIFY_SERVICE_ENABLED``), the envelope rides the ``scp``
+        priority lane — the one lane the shed ladder NEVER sheds, so
+        consensus keeps making progress while bulk work sheds under
+        overload. A ``batch_verify_into_cache`` prefetch still wins
+        (cache consulted first), the verdict re-seeds that cache so
+        flood dedup stays O(1), and ingress rejection or any
+        service-side failure falls back to the direct path — the
+        decision is bit-identical on every route, so the service can
+        only ever change latency, never validity."""
         payload = scp_envelope_sign_payload(self.network_id,
                                             env.statement)
-        return verify_sig(env.statement.nodeID.value, payload,
-                          env.signature)
+        pk = env.statement.nodeID.value
+        got = cached_verify_sig(pk, payload, env.signature)
+        if got is not None:
+            return got
+        svc = running_service()
+        if svc is not None:
+            try:
+                ok = bool(svc.verify(
+                    [(pk, payload, env.signature)], lane="scp")[0])
+            except Exception:
+                # Overloaded at ingress, service stopping mid-call,
+                # dispatch failure — the service is an optimization;
+                # envelope verification must not depend on it
+                return verify_sig(pk, payload, env.signature)
+            seed_verify_cache([(pk, payload, env.signature, ok)])
+            return ok
+        return verify_sig(pk, payload, env.signature)
 
     def prefetch_envelope_signatures(self, envs: List[SCPEnvelope]):
         """Batch-verify an envelope flood in one device round trip; the

@@ -44,6 +44,26 @@ __all__ = [
 ]
 
 
+def prefetch_contents_hashes(frames) -> None:
+    """Batch-compute and memoize the contents hash (tx id) of every
+    frame in one pass through the hash workload
+    (``crypto.batch_hasher.hash_many``) — the TxSet half of the
+    "bucket-list and TxSet hashing remain serial host SHA-256" item:
+    catchup's recorded-results split calls ``contents_hash()`` per
+    frame, which this turns into cache hits. Bit-identical (the
+    workload's oracle IS hashlib); frames already hashed are skipped."""
+    from stellar_tpu.crypto.batch_hasher import hash_many
+    todo = [f for f in frames
+            if getattr(f, "_hash", None) is None
+            and hasattr(f, "contents_preimage")]
+    if not todo:
+        return
+    for f, digest in zip(todo,
+                         hash_many([f.contents_preimage()
+                                    for f in todo])):
+        f._hash = digest
+
+
 def full_tx_hash(frame) -> bytes:
     """Hash of the whole envelope incl. signatures (reference
     ``getFullHash``) — distinct from the contents hash. Memoized on the

@@ -1,0 +1,258 @@
+"""Batched SHA-256 kernel — workload #2 of the batch-dispatch engine.
+
+FIPS 180-4 SHA-256 over a batch of independent messages, one message
+per lane: the device-side half of
+:class:`stellar_tpu.crypto.batch_hasher.BatchHasher`. Bucket-list /
+TxSet / ledger-header hashing in the reference is thousands of small
+INDEPENDENT digests (content hash per tx frame, header hash per
+replayed ledger, level hash per bucket level) — embarrassingly
+parallel across messages even though each message's compression chain
+is sequential.
+
+Design (``docs/kernel_design.md`` §"SHA-256 kernel"):
+
+* **uint32 lanes, batch trailing.** All working values are uint32 with
+  the batch on the trailing axis, mapping each of the 8 state words /
+  64 schedule words to a (batch,)-wide vector op — the same lane
+  layout as the verify kernel's limbs.
+* **masked half-word adds.** TPU int32/uint32 addition wraps silently
+  — exactly what the overflow prover (:mod:`stellar_tpu.analysis`)
+  exists to reject, and this process runs jax with x64 DISABLED, so a
+  widening int64 add isn't even representable (it would silently
+  truncate back to 32 bits — worse than the wrap it hides; on real
+  TPUs int64 is 2x32 emulation anyway). Every mod-2^32 addition is
+  therefore an EXPLICIT split-carry add (:func:`_madd`): operands
+  split into 16-bit halves, each half-lane summed in uint32 (max 6
+  terms < 2^19, proven), the carry propagated once, and the halves
+  recombined — the wrap the spec demands, visible to (and certified
+  by) the interval prover instead of hidden in hardware.
+* **rotations without a not/overflow.** ``rotr(x, n)`` masks the low
+  ``n`` bits BEFORE the left shift (``(x >> n) | ((x & (2^n-1)) <<
+  (32-n))``), so the shifted operand is provably < 2^32; ``Ch`` uses
+  the ``g ^ (e & (f ^ g))`` form so no bitwise-not (whose unsigned
+  range the interval domain would have to special-case) appears.
+* **host-side packing.** Padding (0x80, zeros, 64-bit BE length) and
+  big-endian word packing are cheap byte work done once on the host
+  (:func:`pack_messages`); the device receives fixed-shape word
+  blocks plus a per-(message, block) ``active`` mask. Messages are
+  padded to a fixed block capacity per jit bucket; inactive blocks
+  are skipped via ``where`` so every lane runs the same traced
+  program (no data-dependent control flow — hot-path lint clean).
+* **scanned, not unrolled.** The 64 rounds and the block chain are
+  ``lax.scan`` loops with STATIC trip counts (64 and ``max_blocks``),
+  so the XLA graph is ONE round body + loop structure — a fully
+  unrolled 8-block kernel is ~57k ops and took XLA-CPU >10 min to
+  compile. The schedule is computed in-loop from a rolling 16-word
+  window carried through the round scan (rounds < 16 select the
+  message word instead via a trace-time ``iota < 16`` mask — same
+  program every round, the mask decides). Static trips keep both the
+  overflow prover (exact scan unrolling, ``max_unroll`` 256) and the
+  cost ledger (body ops x trip count) exact.
+
+The kernel's batch axis is LEADING on inputs and output (the engine's
+slicing contract); internally everything is transposed batch-trailing
+for the vector lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sha256_kernel", "pack_messages", "digest_words_to_bytes",
+           "host_digest_words", "blocks_needed", "max_message_bytes",
+           "K", "H0"]
+
+# FIPS 180-4 constants: first 32 bits of the fractional parts of the
+# cube roots of the first 64 primes (K) / square roots of the first 8
+# primes (H0).
+K = (
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+)
+
+H0 = (0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+      0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19)
+
+_MASK32 = 0xFFFFFFFF
+
+
+def blocks_needed(msg_len: int) -> int:
+    """64-byte compression blocks after FIPS padding (0x80 + length)."""
+    return (msg_len + 9 + 63) // 64
+
+
+def max_message_bytes(max_blocks: int) -> int:
+    """Longest message that fits ``max_blocks`` blocks after padding."""
+    return max_blocks * 64 - 9
+
+
+def _madd(*terms):
+    """Masked mod-2^32 add via 16-bit half lanes: each half's sum of
+    up to ``len(terms)`` (< 8) values stays < 2^19 — comfortably inside
+    uint32, the bound the overflow prover certifies — then one carry
+    propagation and a recombine whose pieces are disjoint
+    (``hi << 16 <= 2^32 - 2^16``, ``lo < 2^16``), so every
+    intermediate provably fits (docs/kernel_design.md)."""
+    import jax.numpy as jnp
+    half = jnp.uint32(0xFFFF)
+    lo = hi = None
+    for t in terms:
+        if isinstance(t, int):
+            tl = jnp.uint32(t & 0xFFFF)
+            th = jnp.uint32(t >> 16)
+        else:
+            tl = t & half
+            th = t >> jnp.uint32(16)
+        lo = tl if lo is None else lo + tl
+        hi = th if hi is None else hi + th
+    hi = hi + (lo >> jnp.uint32(16))
+    return ((hi & half) << jnp.uint32(16)) + (lo & half)
+
+
+def _rotr(x, n: int):
+    """rotr32 without overflow: the left-shift operand is pre-masked
+    to its low ``n`` bits, so ``(x & (2^n-1)) << (32-n)`` is provably
+    < 2^32 (no uint32 escape for the interval domain to flag)."""
+    import jax.numpy as jnp
+    low = jnp.uint32((1 << n) - 1)
+    return (x >> jnp.uint32(n)) | ((x & low) << jnp.uint32(32 - n))
+
+
+def _shr(x, n: int):
+    import jax.numpy as jnp
+    return x >> jnp.uint32(n)
+
+
+def _round_step(carry, x):
+    """One FIPS 180-4 round as a scan body: schedule expansion from
+    the rolling 16-word window + the compression round. ``carry`` is
+    ``(state (8, batch), window (16, batch))``; ``x`` is ``(K[i],
+    i < 16, padded message word i)``. The first 16 rounds take the
+    message word, later rounds the in-loop schedule expansion — the
+    SAME traced program every round, a trace-time mask decides."""
+    import jax.numpy as jnp
+    st, win = carry
+    k_i, use_msg, msg_w = x
+    # w[i] = w[i-16] + s0(w[i-15]) + w[i-7] + s1(w[i-2]); the window
+    # holds w[i-16..i-1] at positions 0..15
+    wm15, wm2 = win[1], win[14]
+    s0w = _rotr(wm15, 7) ^ _rotr(wm15, 18) ^ _shr(wm15, 3)
+    s1w = _rotr(wm2, 17) ^ _rotr(wm2, 19) ^ _shr(wm2, 10)
+    w_i = jnp.where(use_msg, msg_w,
+                    _madd(win[0], s0w, win[9], s1w))
+    a, b, c, d, e, f, g, h = (st[i] for i in range(8))
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    # Ch(e,f,g) in the not-free form g ^ (e & (f ^ g))
+    ch = g ^ (e & (f ^ g))
+    t1 = _madd(h, s1, ch, k_i, w_i)
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    t2 = _madd(s0, maj)
+    new_st = jnp.stack([_madd(t1, t2), a, b, c,
+                        _madd(d, t1), e, f, g])
+    new_win = jnp.concatenate([win[1:], w_i[None]], axis=0)
+    return (new_st, new_win), None
+
+
+def sha256_kernel(words, active):
+    """Batched SHA-256 over padded, word-packed messages.
+
+    Args:
+      words: (batch, max_blocks, 16) uint32 — big-endian message words
+        per 64-byte block (:func:`pack_messages`); inactive blocks are
+        zero-filled and never reach the state.
+      active: (batch, max_blocks) bool — True for each message's real
+        blocks (always a PREFIX per row).
+
+    Returns:
+      (batch, 8) uint32 — the digest as big-endian word VALUES
+      (:func:`digest_words_to_bytes` renders the canonical 32 bytes).
+      Rows with zero active blocks return the initial state H0 — the
+      padding-lane case; the engine slices such rows off.
+    """
+    import jax
+    import jax.numpy as jnp
+    batch = words.shape[0]
+    # batch-trailing internally: one (batch,)-wide vector per word
+    wt = jnp.transpose(words, (1, 2, 0))      # (blocks, 16, batch)
+    at = jnp.transpose(active, (1, 0))        # (blocks, batch)
+    k_arr = jnp.asarray(np.array(K, dtype=np.uint32))       # (64,)
+    use_msg = jnp.arange(64, dtype=jnp.uint32) < jnp.uint32(16)
+    zeros48 = jnp.zeros((48, batch), dtype=jnp.uint32)
+
+    def _block_step(state, x):
+        w0, act = x                           # (16, batch), (batch,)
+        # rounds 16..63 read the zero tail's slot never (use_msg is
+        # False there and the window expansion takes over); the pad
+        # just gives xs a uniform (64, batch) shape
+        msg_padded = jnp.concatenate([w0, zeros48], axis=0)
+        (st_new, _win), _ = jax.lax.scan(
+            _round_step, (state, w0), (k_arr, use_msg, msg_padded))
+        summed = _madd(state, st_new)
+        # inactive blocks keep the carried state: every lane runs the
+        # same program, the mask decides whether the block counted
+        return jnp.where(act[None, :], summed, state), None
+
+    state0 = jnp.tile(
+        jnp.asarray(np.array(H0, dtype=np.uint32))[:, None],
+        (1, batch))                           # (8, batch)
+    state, _ = jax.lax.scan(_block_step, state0, (wt, at))
+    return jnp.transpose(state, (1, 0))       # (batch, 8)
+
+
+# ---------------- host-side packing / decoding ----------------
+
+
+def pack_messages(msgs, max_blocks: int):
+    """FIPS-pad and word-pack ``msgs`` for :func:`sha256_kernel`.
+
+    Returns ``(words, active, fits)``: the kernel inputs plus a bool
+    row mask — False where a message needs more than ``max_blocks``
+    blocks (such rows must be hashed on the host; their words/active
+    rows are zeroed and hash to H0 on device, which the caller
+    discards)."""
+    n = len(msgs)
+    words = np.zeros((n, max_blocks, 16), dtype=np.uint32)
+    active = np.zeros((n, max_blocks), dtype=bool)
+    fits = np.ones(n, dtype=bool)
+    for i, m in enumerate(msgs):
+        nb = blocks_needed(len(m))
+        if nb > max_blocks:
+            fits[i] = False
+            continue
+        padded = (m + b"\x80" + b"\x00" * ((-(len(m) + 9)) % 64)
+                  + (8 * len(m)).to_bytes(8, "big"))
+        words[i, :nb] = np.frombuffer(
+            padded, dtype=">u4").reshape(nb, 16)
+        active[i, :nb] = True
+    return words, active, fits
+
+
+def digest_words_to_bytes(row: np.ndarray) -> bytes:
+    """(8,) uint32 word values -> the canonical 32-byte digest."""
+    return np.asarray(row, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def host_digest_words(msgs) -> np.ndarray:
+    """hashlib digests as (n, 8) uint32 word values — the differential
+    oracle in the kernel's output representation."""
+    import hashlib
+    out = np.zeros((len(msgs), 8), dtype=np.uint32)
+    for i, m in enumerate(msgs):
+        out[i] = np.frombuffer(hashlib.sha256(m).digest(),
+                               dtype=">u4").astype(np.uint32)
+    return out

@@ -179,15 +179,21 @@ class TransactionFrame:
                                            self.envelope)
         return self._env_bytes
 
+    def contents_preimage(self) -> bytes:
+        """The signature-payload bytes whose SHA-256 is the tx id —
+        exposed so bulk paths can batch-hash a whole set's ids through
+        the hash workload (``tx_set.prefetch_contents_hashes``)."""
+        p = Packer()
+        p.pack_fopaque(32, self.network_id)
+        EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX)
+        p.buf += self.tx_body_bytes()
+        return p.bytes()
+
     def contents_hash(self) -> bytes:
         """Tx id: SHA-256 of the signature payload (reference
         ``getContentsHash``; v0 envelopes hash as their v1 form)."""
         if self._hash is None:
-            p = Packer()
-            p.pack_fopaque(32, self.network_id)
-            EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX)
-            p.buf += self.tx_body_bytes()
-            self._hash = sha256(p.bytes())
+            self._hash = sha256(self.contents_preimage())
         return self._hash
 
     def source_account_id(self):
@@ -790,13 +796,18 @@ class FeeBumpTransactionFrame:
             self._env_bytes = p.bytes()
         return self._env_bytes
 
+    def contents_preimage(self) -> bytes:
+        """Signature-payload bytes (fee-bump form) — see the classic
+        frame's ``contents_preimage``."""
+        p = Packer()
+        p.pack_fopaque(32, self.network_id)
+        EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP)
+        p.buf += self.tx_body_bytes()
+        return p.bytes()
+
     def contents_hash(self) -> bytes:
         if self._hash is None:
-            p = Packer()
-            p.pack_fopaque(32, self.network_id)
-            EnvelopeType.pack(p, EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP)
-            p.buf += self.tx_body_bytes()
-            self._hash = sha256(p.bytes())
+            self._hash = sha256(self.contents_preimage())
         return self._hash
 
     def fee_source_id(self):

@@ -115,6 +115,51 @@ def test_onehot_refinement_sound_against_varying_operand():
     assert int(out.hi.max()) == 8 * 9000  # all positions can match
 
 
+def _u32(*shape):
+    import jax
+    return jax.ShapeDtypeStruct(shape, np.uint32)
+
+
+def test_or_xor_bitmask_refinement():
+    """ISSUE 7: OR/XOR of non-negative operands never set a bit above
+    either operand's highest bit — the refinement that keeps the
+    SHA-256 schedule/round mixing inside uint32 (the sum bound alone
+    would falsely escape on full-range words). The bound must COVER
+    the true range (soundness) and be the bit ceiling (precision)."""
+    import jax.numpy as jnp
+    interp, (o1, o2) = _analyze(
+        lambda a, b: (a | b, a ^ b), _u32(4), _u32(4),
+        in_ranges=[(0, 5), (0, 9)])
+    assert not interp.violations
+    # min(sum bound 5+9, bit ceiling of max(5,9)=9 -> 15) = 14, which
+    # covers the true max (5|8 = 5^8 = 13)
+    assert int(o1.hi.max()) == 14 and int(o2.hi.max()) == 14
+    assert int(o1.lo.min()) == 0 and int(o2.lo.min()) == 0
+    # full-range uint32 stays uint32 — no violation, no escape
+    interp2, (p1, p2) = _analyze(
+        lambda a, b: (a | b, a ^ b), _u32(4), _u32(4),
+        in_ranges=[(0, 0xFFFFFFFF), (0, 0xFFFFFFFF)])
+    assert not interp2.violations
+    assert int(p1.hi.max()) == 0xFFFFFFFF
+    assert int(p2.hi.max()) == 0xFFFFFFFF
+    # signed operands that may be negative fall back to the wide bound
+    interp3, (n1,) = _analyze(
+        lambda a, b: a ^ b, _i32(4), _i32(4),
+        in_ranges=[(-1, 5), (0, 9)])
+    assert int(n1.lo.min()) < 0
+
+
+def test_unsigned_not_transfer():
+    """Unsigned bitwise-not is dtype_max - x, not -1 - x (the signed
+    form would claim a negative range for a uint32 value)."""
+    import jax.numpy as jnp
+    interp, (out,) = _analyze(
+        lambda a: ~a, _u32(4), in_ranges=[(0, 10)])
+    assert not interp.violations
+    assert int(out.lo.min()) == 0xFFFFFFFF - 10
+    assert int(out.hi.max()) == 0xFFFFFFFF
+
+
 def test_scan_unroll_exact_counter():
     """fori_loop lowers to scan; the loop counter and carries must stay
     exact under unrolling (no widening overshoot)."""
@@ -540,6 +585,47 @@ def test_lint_scopes_cover_verify_service():
         "only" in entry["nondet:clock"]  # a real safety argument
     # the shed rule itself lives in the audit module — already scoped
     assert "stellar_tpu/crypto/audit.py" in set(nondet.HOST_ORACLE_FILES)
+
+
+def test_lint_scopes_cover_batch_engine():
+    """ISSUE 7: the workload-agnostic engine owns the jit-bucket cache,
+    device-health registry and served-counter RMWs from resolver/pool/
+    breaker threads (lock lint), and decides WHICH backend serves every
+    workload's rows (nondet lint — its clock use and tracing ownership
+    must keep written safety arguments); the SHA-256 workload's host
+    helpers and plugin produce CONSENSUS state (header/bucket/TxSet
+    identities), so they join the nondet scope, and the kernel module
+    joins the hot-path scope."""
+    eng = "stellar_tpu/parallel/batch_engine.py"
+    for mod in (eng, "stellar_tpu/crypto/batch_hasher.py"):
+        assert mod in set(locks.SCOPE), mod
+    for mod in (eng, "stellar_tpu/ops/sha256.py",
+                "stellar_tpu/crypto/batch_hasher.py"):
+        assert mod in set(nondet.HOST_ORACLE_FILES), mod
+    assert eng in set(hotpath.SCOPE_HOST)
+    entry = nondet.ALLOWLIST._entries.get(eng, {})
+    assert set(entry) == {"nondet:clock", "nondet:tracing-import"}
+    for key in entry:  # real safety arguments, not rubber stamps
+        assert "never" in entry[key] or "only" in entry[key], key
+    # the plugin modules carry NO nondet allowlist — clock/RNG-free
+    # by design, like audit.py and device_health.py before them
+    for mod in ("stellar_tpu/ops/sha256.py",
+                "stellar_tpu/crypto/batch_hasher.py"):
+        assert mod not in nondet.ALLOWLIST._entries, mod
+
+
+def test_sha256_overflow_golden_committed():
+    """ISSUE 7: the hash workload gets the verify kernel's discipline —
+    a committed proven envelope, diffed (not pass/failed) by
+    tools/analyze.py, in its OWN golden file so the ed25519 envelope
+    (docs/limb_bounds.json) diffs independently."""
+    golden = overflow.load_sha_golden(str(repo_root()))
+    assert golden is not None, (
+        f"{overflow.SHA_GOLDEN_PATH} missing — run tools/analyze.py "
+        "--write-golden and review the envelope")
+    assert golden["stages"]["sha256_kernel"]["outputs"]["digest"] == \
+        [[0, 0xFFFFFFFF]]  # digest words span exactly uint32
+    assert golden["word_layout"]["rounds"] == 64
 
 
 def test_lock_lint_scope_covers_tracing_ring():

@@ -163,6 +163,25 @@ def test_dispatch_raise_falls_back_bit_identical():
     assert v.retries == 1                 # one fresh attempt, also failed
 
 
+def test_hash_workload_dispatch_raise_falls_back_bit_identical():
+    """ISSUE 7: the SHA-256 plugin rides the SAME fault machinery as
+    verify — every kernel dispatch raising re-routes the chunk to the
+    hashlib oracle with unchanged digests (the fault-domain port is
+    real, not verify-specific)."""
+    import hashlib
+
+    from stellar_tpu.crypto.batch_hasher import BatchHasher
+    faults.set_fault(faults.DISPATCH, "raise")
+    h = BatchHasher(bucket_sizes=(128,))
+    msgs = [b"", b"abc", b"x" * 56, b"y" * 503, b"z" * 1000]
+    got = h.hash_batch(msgs)
+    assert got == [hashlib.sha256(m).digest() for m in msgs]
+    # the whole 5-row chunk re-computed on the host (the oversize row
+    # rides the chunk accounting; finalize re-hashes it either way)
+    assert h.served == {"device": 0, "host-fallback": 5}
+    assert h.retries == 1
+
+
 def test_transient_dispatch_flake_is_retried_on_device():
     """A single transient dispatch failure is absorbed by the retry —
     the chunk still rides the device, no fallback, breaker closed."""

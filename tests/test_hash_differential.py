@@ -1,0 +1,170 @@
+"""Kernel-vs-oracle differential for the batched SHA-256 workload
+(ISSUE 7 acceptance): ``BatchHasher`` digests must be bit-identical to
+``hashlib.sha256`` over structured edge messages and random sweeps, at
+EVERY hash jit bucket size (each padded bucket compiles its own kernel
+instance), including padding lanes and the oversize host path.
+
+The 10k-message sweep is ``-m slow`` (excluded from tier-1; run it when
+touching anything under stellar_tpu/ops/) — the same discipline as
+``test_verify_differential.py``. The in-tier-1 edge-corpus tests are
+counted by ``tools/tier1.sh`` as ``HASH_DIFF_OK``.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto.batch_hasher import (
+    DEFAULT_HASH_BUCKET_SIZES, MAX_BLOCKS, MIN_DEVICE_HASH_BATCH,
+    BatchHasher, hash_many,
+)
+from stellar_tpu.ops import sha256 as sk
+
+RNG = np.random.default_rng(0x5AA256)
+
+# FIPS 180-4 / NIST CAVP known answers — the corpus control rows
+ABC_DIGEST = bytes.fromhex(
+    "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+EMPTY_DIGEST = bytes.fromhex(
+    "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+
+
+def edge_corpus(max_blocks: int = MAX_BLOCKS):
+    """Every padding-layout regime: empty, 1-byte, the 55/56 one-vs-two
+    block padding boundary, exact-block 64, the 119/120 two-vs-three
+    boundary, >1-block interiors, the device capacity edge, and
+    structured byte patterns (0x00 / 0xff / 0x80 runs — the pad marker
+    itself)."""
+    cap = sk.max_message_bytes(max_blocks)
+    lens = [0, 1, 2, 31, 32, 55, 56, 57, 63, 64, 65,
+            119, 120, 121, 127, 128, 129, 191, 192, 255, 256,
+            cap - 1, cap]
+    msgs = [b"abc", b""]
+    for n in lens:
+        msgs.append(bytes(RNG.integers(0, 256, n, dtype=np.uint8)))
+    for n in (55, 56, 64, 120):
+        msgs.append(b"\x00" * n)
+        msgs.append(b"\xff" * n)
+        msgs.append(b"\x80" * n)
+    return msgs
+
+
+def check(hasher, msgs):
+    got = hasher.hash_batch(msgs)
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    mism = [i for i in range(len(msgs)) if got[i] != want[i]]
+    assert not mism, mism
+    return got
+
+
+@pytest.mark.parametrize("bucket", list(DEFAULT_HASH_BUCKET_SIZES))
+def test_differential_every_bucket_size(bucket):
+    """ISSUE 7 acceptance: the edge corpus plus random fill through
+    each bucket size of the hash ladder, with batch sizes chosen to
+    force padding (n % bucket != 0); bucket 128 also chunks."""
+    h = BatchHasher(bucket_sizes=(bucket,))
+    msgs = edge_corpus()
+    while len(msgs) <= 130:  # > one 128-bucket, never bucket-aligned
+        msgs.append(bytes(RNG.integers(0, 256, len(msgs) % 97,
+                                       dtype=np.uint8)))
+    assert len(msgs) % bucket != 0
+    got = check(h, msgs)
+    assert got[0] == ABC_DIGEST and got[1] == EMPTY_DIGEST
+    # every row must have been served by the KERNEL: a silent host
+    # fallback would make this differential vacuous
+    assert h.served["host-fallback"] == 0 and h.served["device"] > 0
+
+
+def test_padding_lanes_do_not_leak():
+    """A solo message in a 128-wide bucket shares the kernel with 127
+    zero-active padding lanes; its digest must equal the unpadded
+    oracle and the padding must never surface."""
+    h = BatchHasher(bucket_sizes=(128,))
+    assert h.hash_batch([b"abc"]) == [ABC_DIGEST]
+    out = h.hash_batch([b"", b"abc", b"xyz"])
+    assert out == [hashlib.sha256(m).digest()
+                   for m in (b"", b"abc", b"xyz")]
+
+
+def test_mixed_buckets_agree():
+    """The same workload through different bucket configurations
+    yields identical digests (bucketing is an execution detail)."""
+    msgs = edge_corpus()[:24]
+    a = BatchHasher(bucket_sizes=(128,)).hash_batch(msgs)
+    b = BatchHasher(bucket_sizes=(512,)).hash_batch(msgs)
+    assert a == b
+
+
+def test_oversize_rows_hash_on_host_by_capacity():
+    """Messages past the block capacity (max_blocks*64 - 9 bytes) are
+    hashed by the plugin's ``finalize`` on the host — a capacity
+    decision, not a failure: digests stay bit-identical and in-order
+    alongside device-served rows."""
+    cap = sk.max_message_bytes(MAX_BLOCKS)
+    big = bytes(RNG.integers(0, 256, cap + 1, dtype=np.uint8))
+    huge = bytes(RNG.integers(0, 256, 4 * cap, dtype=np.uint8))
+    h = BatchHasher(bucket_sizes=(128,))
+    check(h, [b"abc", big, b"", huge, b"tail"])
+    assert h.served["host-fallback"] == 0  # capacity != failure
+
+
+def test_pack_messages_layout():
+    """Host packing: big-endian words, active is a block-count prefix,
+    fits mirrors the capacity rule exactly."""
+    cap = sk.max_message_bytes(2)
+    words, active, fits = sk.pack_messages(
+        [b"abc", b"", b"x" * 56, b"y" * (cap + 1)], max_blocks=2)
+    assert words.shape == (4, 2, 16) and words.dtype == np.uint32
+    assert fits.tolist() == [True, True, True, False]
+    # "abc" -> one block: 0x61626380 then zeros, bit length 24 at the end
+    assert words[0, 0, 0] == 0x61626380 and words[0, 0, 15] == 24
+    assert active[0].tolist() == [True, False]
+    assert active[1].tolist() == [True, False]   # empty: 1 pad block
+    assert active[2].tolist() == [True, True]    # 56 bytes: 2 blocks
+    assert not active[3].any() and not words[3].any()  # oversize zeroed
+    assert sk.blocks_needed(55) == 1 and sk.blocks_needed(56) == 2
+    assert sk.blocks_needed(119) == 2 and sk.blocks_needed(120) == 3
+
+
+def test_hash_many_policy_and_identity():
+    """``hash_many`` is the consumers' drop-in: exact hashlib bytes on
+    every path — the sub-batch hashlib shortcut and the engine path."""
+    few = edge_corpus()[:MIN_DEVICE_HASH_BATCH - 1]
+    assert hash_many(few) == [hashlib.sha256(m).digest() for m in few]
+    assert hash_many([]) == []
+    many = edge_corpus()
+    assert hash_many(many) == [hashlib.sha256(m).digest() for m in many]
+
+
+def test_hash_words_matches_oracle_words():
+    """The raw engine result (word rows) equals the oracle in the
+    kernel's own representation — what the sampled audit compares."""
+    msgs = edge_corpus()[:16]
+    h = BatchHasher(bucket_sizes=(128,))
+    got = h.hash_words(msgs)
+    want = sk.host_digest_words(msgs)
+    assert got.shape == want.shape == (16, 8)
+    assert (got == want).all()
+
+
+@pytest.mark.slow
+def test_differential_10k_random_messages():
+    """ISSUE 7 acceptance: >= 10k random messages spanning every length
+    regime (0..capacity plus oversize rows), chunked through a
+    2048-bucket hasher — bit-identical to hashlib on every row."""
+    cap = sk.max_message_bytes(MAX_BLOCKS)
+    n = 10_240
+    msgs = []
+    for i in range(n):
+        if i % 211 == 0:                     # sprinkle oversize rows
+            ln = cap + 1 + (i % 777)
+        else:
+            ln = i % (cap + 1)
+        msgs.append(bytes(RNG.integers(0, 256, ln, dtype=np.uint8)))
+    h = BatchHasher(bucket_sizes=(2048,))
+    got = h.hash_batch(msgs)
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    mism = [i for i in range(n) if got[i] != want[i]]
+    assert not mism, mism[:10]
+    assert h.served["device"] > 0 and h.served["host-fallback"] == 0

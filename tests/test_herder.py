@@ -364,3 +364,62 @@ def test_background_quorum_intersection_recheck():
     assert h2.latest_quorum_intersection is None
     assert h2._qic_last_hash == b""
     assert Config().QUORUM_INTERSECTION_CHECKER is True  # default on
+
+
+def test_scp_envelope_rides_service_scp_lane(monkeypatch):
+    """ISSUE 7 satellite: when the resident verify service is running,
+    verify_envelope rides the never-shed scp lane; a prefetched cache
+    entry wins without a service round trip, the service verdict
+    re-seeds the cache, and a stopped service falls back to the direct
+    path — bit-identical decisions on every route."""
+    import numpy as np
+
+    from stellar_tpu.crypto import ed25519_ref, keys
+    from stellar_tpu.crypto import verify_service as vs
+
+    class OracleVerifier:  # host-oracle decisions, service transport
+        def __init__(self):
+            self.rows = 0
+
+        def submit(self, items):
+            res = np.array([ed25519_ref.verify(pk, msg, sig)
+                            for pk, msg, sig in items], dtype=bool)
+            self.rows += len(items)
+            return lambda: res
+
+    net = MiniNetwork(accounts=[])
+    h0, h1 = net.herders[0], net.herders[1]
+    captured = []
+    h1.broadcast_envelope = lambda env: captured.append(env)
+    h1.start()
+    net.clock.crank_until(lambda: captured, 30)
+    assert captured
+    env = captured[0]
+
+    keys.flush_verify_cache()
+    oracle = OracleVerifier()
+    svc = vs.VerifyService(verifier=oracle).start()
+    monkeypatch.setattr(vs, "_service", svc)
+    try:
+        assert vs.running_service() is svc
+        assert h0.verify_envelope(env) is True
+        assert oracle.rows == 1
+        lane = svc.snapshot()["lanes"]["scp"]
+        assert (lane["submitted"], lane["verified"]) == (1, 1)
+        # verdict seeded the verify_sig cache: dedup never re-submits
+        assert h0.verify_envelope(env) is True
+        assert oracle.rows == 1
+        # a corrupted signature is a fresh triple: service says False
+        bad_env = captured[0]
+        good_sig = bad_env.signature
+        bad_env.signature = bytes(64)
+        assert h0.verify_envelope(bad_env) is False
+        assert oracle.rows == 2
+        bad_env.signature = good_sig
+    finally:
+        svc.stop(drain=False)
+    # service stopped: running_service() is None, direct path serves
+    assert vs.running_service() is None
+    keys.flush_verify_cache()
+    assert h0.verify_envelope(env) is True
+    assert oracle.rows == 2

@@ -46,15 +46,12 @@ def run_lints() -> dict:
             for rep in (hotpath.run(), locks.run(), nondet.run())}
 
 
-def run_overflow(buckets) -> dict:
-    _force_cpu()
+def _check_golden(rec: dict, golden, path: str) -> dict:
     from stellar_tpu.analysis import overflow
-    rec = overflow.prove_buckets(buckets)
-    golden = overflow.load_golden(_REPO)
     if golden is None:
         rec["golden"] = "missing"
         rec["golden_diff"] = [
-            f"{overflow.GOLDEN_PATH} not committed — run "
+            f"{path} not committed — run "
             "tools/analyze.py --write-golden and review the envelope"]
         rec["ok"] = False
     else:
@@ -65,16 +62,51 @@ def run_overflow(buckets) -> dict:
     return rec
 
 
+def run_overflow(buckets) -> dict:
+    _force_cpu()
+    from stellar_tpu.analysis import overflow
+    rec = overflow.prove_buckets(buckets)
+    return _check_golden(rec, overflow.load_golden(_REPO),
+                         overflow.GOLDEN_PATH)
+
+
+def run_overflow_sha256(buckets=None) -> dict:
+    """Prove the SHA-256 workload kernel — separate golden, so the
+    ed25519 envelope (docs/limb_bounds.json) diffs independently."""
+    _force_cpu()
+    from stellar_tpu.analysis import overflow
+    rec = overflow.prove_sha256_buckets(buckets)
+    return _check_golden(rec, overflow.load_sha_golden(_REPO),
+                         overflow.SHA_GOLDEN_PATH)
+
+
 def main(argv) -> int:
     as_json = "--json" in argv
     lint_only = "--lint-only" in argv
     overflow_only = "--overflow-only" in argv
     write_golden = "--write-golden" in argv
-    from stellar_tpu.analysis.overflow import DEFAULT_BUCKETS, GOLDEN_PATH
+    from stellar_tpu.analysis.overflow import (
+        DEFAULT_BUCKETS, GOLDEN_PATH, SHA_GOLDEN_PATH)
     buckets = list(DEFAULT_BUCKETS)
+    sha_buckets = None  # batch_hasher.DEFAULT_HASH_BUCKET_SIZES
     for a in argv:
         if a.startswith("--buckets="):
             buckets = [int(b) for b in a.split("=", 1)[1].split(",")]
+            sha_buckets = buckets
+
+    def _maybe_write_golden(rec, path):
+        if not write_golden:
+            return rec
+        with open(os.path.join(_REPO, path), "w") as f:
+            json.dump(rec["envelope"], f, indent=1, sort_keys=True)
+            f.write("\n")
+        rec["golden"] = "written"
+        rec["golden_diff"] = []
+        rec["ok"] = (not rec["violations"]
+                     and not rec["contract_breaches"]
+                     and not rec["unsupported"]
+                     and not rec["envelope_mismatch_buckets"])
+        return rec
 
     out = {"ok": True}
     if not overflow_only:
@@ -82,22 +114,14 @@ def main(argv) -> int:
         out["lints"] = lints
         out["ok"] &= all(rep["ok"] for rep in lints.values())
     if not lint_only:
-        rec = run_overflow(buckets)
-        if write_golden:
-            path = os.path.join(_REPO, GOLDEN_PATH)
-            with open(path, "w") as f:
-                json.dump(rec["envelope"], f, indent=1, sort_keys=True)
-                f.write("\n")
-            rec["golden"] = "written"
-            rec["golden_diff"] = []
-            rec["ok"] = (not rec["violations"]
-                         and not rec["contract_breaches"]
-                         and not rec["unsupported"]
-                         and not rec["envelope_mismatch_buckets"])
-        # the full envelope rides the golden file, not every record
-        slim = {k: v for k, v in rec.items() if k != "envelope"}
-        out["overflow"] = slim
-        out["ok"] &= rec["ok"]
+        for key, rec, path in (
+                ("overflow", run_overflow(buckets), GOLDEN_PATH),
+                ("overflow_sha256", run_overflow_sha256(sha_buckets),
+                 SHA_GOLDEN_PATH)):
+            rec = _maybe_write_golden(rec, path)
+            # the full envelope rides the golden file, not every record
+            out[key] = {k: v for k, v in rec.items() if k != "envelope"}
+            out["ok"] &= rec["ok"]
 
     if as_json:
         print(json.dumps(out, default=str))
@@ -118,10 +142,12 @@ def _pretty(out: dict) -> None:
                   f"{f['message']}")
         for e in rep["stale_allowlist"]:
             print(f"    stale allowlist entry (delete it): {e}")
-    ov = out.get("overflow")
-    if ov:
+    for key in ("overflow", "overflow_sha256"):
+        ov = out.get(key)
+        if not ov:
+            continue
         status = "ok" if ov["ok"] else "FAIL"
-        print(f"[{status}] overflow  buckets={ov.get('buckets')} "
+        print(f"[{status}] {key}  buckets={ov.get('buckets')} "
               f"violations={len(ov['violations'])} "
               f"contract={len(ov['contract_breaches'])} "
               f"golden={ov.get('golden')}")

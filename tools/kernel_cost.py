@@ -99,37 +99,49 @@ def _sub_jaxprs(eqn):
                 yield v, 1
 
 
-def count_jaxpr(jaxpr) -> dict:
-    """Count multiply ops/elements in a (Closed)Jaxpr.
+def count_prims(jaxpr, prims) -> dict:
+    """Count ops/elements of ``prims`` (or EVERY primitive when None)
+    in a (Closed)Jaxpr.
 
-    Returns dict with ``static_mul_ops``/``static_mul_elems`` (loop bodies
-    once) and ``weighted_mul_ops``/``weighted_mul_elems`` (scan bodies times
+    Returns dict with ``static_ops``/``static_elems`` (loop bodies
+    once) and ``weighted_ops``/``weighted_elems`` (scan bodies times
     their trip counts; unknown-trip bodies count once and set
     ``has_unbounded_loop``).
     """
     import jax.core as core
     if isinstance(jaxpr, core.ClosedJaxpr):
         jaxpr = jaxpr.jaxpr
-    out = {"static_mul_ops": 0, "static_mul_elems": 0,
-           "weighted_mul_ops": 0, "weighted_mul_elems": 0,
+    out = {"static_ops": 0, "static_elems": 0,
+           "weighted_ops": 0, "weighted_elems": 0,
            "has_unbounded_loop": False}
     for eqn in jaxpr.eqns:
-        if eqn.primitive.name in _MUL_PRIMS:
+        if prims is None or eqn.primitive.name in prims:
             elems = _out_elems(eqn)
-            out["static_mul_ops"] += 1
-            out["static_mul_elems"] += elems
-            out["weighted_mul_ops"] += 1
-            out["weighted_mul_elems"] += elems
+            out["static_ops"] += 1
+            out["static_elems"] += elems
+            out["weighted_ops"] += 1
+            out["weighted_elems"] += elems
         for sub, trips in _sub_jaxprs(eqn):
-            c = count_jaxpr(sub)
-            out["static_mul_ops"] += c["static_mul_ops"]
-            out["static_mul_elems"] += c["static_mul_elems"]
+            c = count_prims(sub, prims)
+            out["static_ops"] += c["static_ops"]
+            out["static_elems"] += c["static_elems"]
             w = 1 if trips is None else trips
-            out["weighted_mul_ops"] += w * c["weighted_mul_ops"]
-            out["weighted_mul_elems"] += w * c["weighted_mul_elems"]
+            out["weighted_ops"] += w * c["weighted_ops"]
+            out["weighted_elems"] += w * c["weighted_elems"]
             out["has_unbounded_loop"] |= (
                 trips is None or c["has_unbounded_loop"])
     return out
+
+
+def count_jaxpr(jaxpr) -> dict:
+    """Multiply-op counts (the verify kernel's scoreboard metric),
+    under the historical ``*_mul_*`` key names."""
+    c = count_prims(jaxpr, _MUL_PRIMS)
+    return {"static_mul_ops": c["static_ops"],
+            "static_mul_elems": c["static_elems"],
+            "weighted_mul_ops": c["weighted_ops"],
+            "weighted_mul_elems": c["weighted_elems"],
+            "has_unbounded_loop": c["has_unbounded_loop"]}
 
 
 def _abstract_inputs(batch: int):
@@ -176,14 +188,69 @@ def trace_stages(batch: int = BATCH_DEFAULT) -> dict:
     return out
 
 
+# Primitives that do the SHA-256 kernel's arithmetic work: the masked
+# half-word adds (`add`), the rotate/shift lanes, and the boolean
+# mixing (Ch/Maj/sigma xor-and-or). Multiply counts are ~0 for a hash
+# kernel, so its scoreboard is add volume + logical volume + program
+# size — the quantities the scan-based design keeps flat in max_blocks.
+_SHA_ADD_PRIMS = ("add",)
+_SHA_LOGIC_PRIMS = ("xor", "and", "or", "shift_right_logical",
+                    "shift_left")
+
+
+def trace_sha256(batch: int = BATCH_DEFAULT,
+                 max_blocks: int = None) -> dict:
+    """Static cost record for the SHA-256 workload kernel
+    (``stellar_tpu.ops.sha256``): program size (static ops) and
+    executed volume (scan-weighted) overall, for the masked adds, and
+    for the logical mixing — the hash-kernel cost trajectory that
+    survives a dead tunnel, like the verify kernel's multiply ledger."""
+    import jax
+    import numpy as np
+    from stellar_tpu.ops import sha256 as sk
+    if max_blocks is None:
+        from stellar_tpu.crypto.batch_hasher import MAX_BLOCKS
+        max_blocks = MAX_BLOCKS
+    words = jax.ShapeDtypeStruct((batch, max_blocks, 16), np.uint32)
+    active = jax.ShapeDtypeStruct((batch, max_blocks), np.bool_)
+    jx = jax.make_jaxpr(sk.sha256_kernel)(words, active)
+    total = count_prims(jx, None)
+    adds = count_prims(jx, _SHA_ADD_PRIMS)
+    logic = count_prims(jx, _SHA_LOGIC_PRIMS)
+    return {
+        "workload": "sha256",
+        "batch": batch,
+        "max_blocks": int(max_blocks),
+        "rounds": 64,
+        "static_ops": total["static_ops"],
+        "weighted_ops": total["weighted_ops"],
+        "weighted_elems": total["weighted_elems"],
+        "add_static_ops": adds["static_ops"],
+        "add_weighted_ops": adds["weighted_ops"],
+        "add_weighted_elems": adds["weighted_elems"],
+        "logic_static_ops": logic["static_ops"],
+        "logic_weighted_ops": logic["weighted_ops"],
+        "logic_weighted_elems": logic["weighted_elems"],
+        "has_unbounded_loop": total["has_unbounded_loop"],
+    }
+
+
 def main(argv):
     as_json = "--json" in argv
     batch = BATCH_DEFAULT
+    workload = "verify"
     for a in argv:
         if a.startswith("--batch="):
             batch = int(a.split("=", 1)[1])
+        if a.startswith("--workload="):
+            workload = a.split("=", 1)[1]
     force_cpu()
-    rec = trace_stages(batch)
+    if workload == "sha256":
+        rec = trace_sha256(batch)
+    elif workload == "all":
+        rec = {"verify": trace_stages(batch), "sha256": trace_sha256(batch)}
+    else:
+        rec = trace_stages(batch)
     if as_json:
         print(json.dumps(rec))
     else:

@@ -97,6 +97,150 @@ def _submission(pool, want, i, n):
     return [pool[k] for k in idx], want[idx]
 
 
+def _hash_corpus(i: int, n: int):
+    """Rotating deterministic message batch ``i``: every length regime
+    (empty through multi-block) with content varying per round so no
+    two rounds hash identical bytes."""
+    msgs = []
+    for j in range(n):
+        ln = (7 * i + 13 * j) % 200
+        msgs.append(bytes(((i + j + k) % 256) for k in range(ln)))
+    return msgs
+
+
+def run_sha256(smoke: bool, duration_s: float,
+               events_path: str) -> dict:
+    """The second workload through the SAME flaky-device flap (ISSUE
+    7): sustained ``BatchHasher`` floods on a forced multi-device mesh
+    with ``flaky-device:0`` armed, audit sampling on, and a mid-run
+    global-breaker trip — proving the fault-domain port is real for a
+    plugin that is not ed25519. Invariants: every digest bit-identical
+    to ``hashlib.sha256`` (flap, quarantine, re-shard, breaker
+    short-circuit and all), the flap actually fired, device 0 actually
+    quarantined, rows actually fell back AND actually rode devices,
+    the audit actually sampled hash rows, and the served accounting
+    conserves (device + host-fallback == rows offered)."""
+    import hashlib
+
+    from stellar_tpu.crypto import batch_hasher as bh
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.utils import faults
+    from stellar_tpu.utils.logging import append_jsonl_capped
+    from stellar_tpu.utils.metrics import registry
+
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit(
+            f"soak needs a multi-device host (got {len(devs)}): the "
+            "flaky-device fault shape is per-device — run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4")
+
+    def event(kind, **fields):
+        append_jsonl_capped(events_path, {"event": kind, **fields})
+
+    from stellar_tpu.parallel.mesh import batch_mesh
+    h = bh.BatchHasher(mesh=batch_mesh(), bucket_sizes=(BUCKET,))
+    bv.configure_dispatch(
+        deadline_ms=30_000, dispatch_retries=0,
+        failure_threshold=6, backoff_min_s=0.3, backoff_max_s=0.6,
+        audit_rate=0.25,                # every part samples hash rows
+        device_failure_threshold=2,
+        device_backoff_min_s=0.2, device_backoff_max_s=0.5)
+
+    # warm: one clean full-bucket batch compiles the SUB-row hash
+    # kernel (scan-based — seconds, not the minutes a fresh verify
+    # bucket costs) and every device serves its own sub-chunk once
+    t0 = time.monotonic()
+    assert h.hash_batch(_hash_corpus(0, BUCKET)) == [
+        hashlib.sha256(m).digest() for m in _hash_corpus(0, BUCKET)]
+    warm_s = round(time.monotonic() - t0, 1)
+    event("warm", seconds=warm_s, devices=len(devs),
+          workload="sha256")
+
+    faults.set_fault(faults.DISPATCH, "flaky-device", 0)
+    event("fault", spec="device.dispatch=flaky-device:0")
+
+    rounds = 40 if smoke else max(40, int(duration_s * 10))
+    mismatches = 0
+    rows = BUCKET                    # the warm batch is served too
+    breaker_tripped = False
+    t_run = time.monotonic()
+    for i in range(1, rounds + 1):
+        msgs = _hash_corpus(i, BUCKET + (i % 5))  # padding varies
+        got = h.hash_batch(msgs)
+        want = [hashlib.sha256(m).digest() for m in msgs]
+        rows += len(msgs)
+        mismatches += sum(1 for g, w in zip(got, want) if g != w)
+        if not breaker_tripped and i == rounds // 2:
+            bv._breaker.trip()     # correlated outage mid-flood
+            breaker_tripped = True
+            event("breaker-trip", round=i)
+        if not smoke and time.monotonic() - t_run > duration_s:
+            break
+    fault_counters = faults.counters()   # captured BEFORE clear
+    faults.clear()
+    # recovery: with the flap gone the mesh heals and serves clean
+    post = _hash_corpus(rounds + 1, BUCKET)
+    mismatches += sum(
+        1 for g, w in zip(h.hash_batch(post),
+                          [hashlib.sha256(m).digest() for m in post])
+        if g != w)
+    rows += BUCKET
+    wall_s = round(time.monotonic() - t_run, 1)
+
+    health = bv.dispatch_health()
+    sampled = registry.counter("crypto.hash.audit.sampled").count
+    event("final", workload="sha256", rows=rows, served=dict(h.served),
+          wall_s=wall_s)
+
+    problems = []
+    if mismatches:
+        problems.append(f"{mismatches} digests mismatched hashlib")
+    if h.served["device"] == 0:
+        problems.append("no row ever rode a device — flap proved "
+                        "nothing")
+    if h.served["host-fallback"] == 0:
+        problems.append("flap never forced a host fallback")
+    if h.served["device"] + h.served["host-fallback"] != rows:
+        problems.append(
+            f"served accounting leaks rows: {h.served} vs {rows}")
+    fc = fault_counters.get("device.dispatch", {})
+    if not fc.get("fired"):
+        problems.append("flaky-device:0 never fired — no flap soaked")
+    if health["device_health"]["transitions_total"] == 0:
+        problems.append("device 0 never quarantined under the flap")
+    if sampled == 0:
+        problems.append("the result-integrity audit never sampled a "
+                        "hash row")
+    if "crypto_hash_serve" not in registry.to_prometheus():
+        problems.append("hash workload metrics missing from the "
+                        "Prometheus exposition")
+
+    return {
+        "ok": not problems,
+        "mode": "smoke" if smoke else "soak",
+        "workload": "sha256",
+        "wall_s": wall_s,
+        "warm_s": warm_s,
+        "devices": len(devs),
+        "rows": rows,
+        "rounds": rounds,
+        "served": dict(h.served),
+        "device_served": dict(h.device_served),
+        "audit_sampled": sampled,
+        "audit_mismatches": h.audit_mismatches,
+        "fault_counters": fault_counters,
+        "breaker": health["breaker"]["state"],
+        "quarantines": health["device_health"]["transitions_total"],
+        "flight_recorder_dumps": health["flight_recorder"][
+            "dump_reasons"],
+        "events_path": events_path,
+        "problems": problems,
+    }
+
+
 def run(smoke: bool, duration_s: float, corrupt: bool,
         events_path: str) -> dict:
     import numpy as np
@@ -310,12 +454,20 @@ def main() -> int:
                     help="JSONL event-log path (size-capped, rotated)")
     ap.add_argument("--real-device", action="store_true",
                     help="don't force the CPU backend (live windows)")
+    ap.add_argument("--workload", default="verify",
+                    choices=("verify", "sha256"),
+                    help="which engine plugin to soak: the verify "
+                         "service flood (default) or the SHA-256 "
+                         "hasher through the same flaky-device flap")
     args = ap.parse_args()
     events = args.events or (
         "/tmp/_soak_events.jsonl" if args.smoke
         else os.path.join(REPO, "SOAK_EVENTS.jsonl"))
     _env_setup(args.real_device)
-    rec = run(args.smoke, args.duration, args.corrupt, events)
+    if args.workload == "sha256":
+        rec = run_sha256(args.smoke, args.duration, events)
+    else:
+        rec = run(args.smoke, args.duration, args.corrupt, events)
     print(json.dumps(rec))
     return 0 if rec["ok"] else 1
 

@@ -38,13 +38,23 @@ echo CHAOS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
 echo DEVICE_CHAOS=$(timeout -k 5 120 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_chaos_device_domains.py -q -m chaos \
     --collect-only -p no:cacheprovider 2>/dev/null | grep -c '::')
+# Hash-workload differential count (ISSUE 7): how many of the sweep's
+# tests pin the SHA-256 kernel bit-identical to hashlib across the
+# edge corpus, every hash bucket size, padding lanes, and the oversize
+# host path. Collection only — their pass/fail is already pinned by
+# the main gate's exit status above.
+echo HASH_DIFF_OK=$(timeout -k 5 120 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_hash_differential.py -q -m 'not slow' \
+    --collect-only -p no:cacheprovider 2>/dev/null | grep -c '::')
 # A red pytest/chaos gate exits here: its output is already printed,
 # and burning ~10 more minutes on the bucket sweep would bury it.
 [ "$rc" -ne 0 ] && exit $rc
 [ "$crc" -ne 0 ] && exit $crc
 # Static-analysis gate (ISSUE 3): the jaxpr overflow prover must prove
 # all three verify-kernel stages at EVERY jit bucket size against the
-# committed envelope golden (docs/limb_bounds.json), and the
+# committed envelope golden (docs/limb_bounds.json), the SHA-256
+# workload kernel at every hash bucket size against its own golden
+# (docs/sha256_bounds.json, ISSUE 7), and the
 # hot-path/lock-discipline/nondet lints must be clean
 # (docs/static_analysis.md). Fails the tier-1 gate on any open finding.
 timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py
@@ -72,5 +82,17 @@ echo METRICS_EXPORT_OK=$([ "$mrc" -eq 0 ] && echo 1 || echo 0)
 # (~1 min warm; a cold cache can take ~4 min, hence the budget).
 timeout -k 10 560 env JAX_PLATFORMS=cpu python tools/soak.py --smoke
 src=$?
-echo SOAK_OK=$([ "$src" -eq 0 ] && echo 1 || echo 0)
-exit $src
+# Second-workload soak (ISSUE 7): the SHA-256 plugin through the SAME
+# flaky-device flap — quarantine, re-shard, breaker trip, audit
+# sampling — with every digest pinned to hashlib. The hash kernel
+# compiles in seconds (scan-based), so this adds ~1 min cold, seconds
+# warm. SOAK_OK covers BOTH workloads.
+hsrc=1
+if [ "$src" -eq 0 ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/soak.py --smoke --workload sha256
+    hsrc=$?
+fi
+echo SOAK_OK=$([ "$src" -eq 0 ] && [ "$hsrc" -eq 0 ] && echo 1 || echo 0)
+[ "$src" -ne 0 ] && exit $src
+exit $hsrc
