@@ -323,6 +323,63 @@ def _dead_tunnel_attribution(n=128):
         return {"error": f"attribution probe failed: {e!r}"[:200]}
 
 
+def _transfer_ledger_probe(timeout_s: float = 480.0):
+    """Transfer-ledger section for a DEAD-TUNNEL record: run the
+    tier-1 reconciliation self-check (forced-4-device CPU chaos
+    resolve over the SHA-256 workload, flaky-device:0 armed) in a
+    subprocess and embed its record — round trips, bytes each way,
+    redundant constant re-upload bytes, and the ledger-vs-engine
+    reconciliation the sentinel guards (docs/observability.md
+    "Transfer ledger"). A subprocess so the forced device-count env
+    never leaks into this process."""
+    import subprocess
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "transfer_selfcheck.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, tool], env=env,
+            capture_output=True, text=True, timeout=timeout_s)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": f"transfer self-check failed: {e!r}"[:200]}
+
+
+def _transfer_totals_delta(before: dict, after: dict) -> dict:
+    """Live-record transfer section: the ledger's process totals over
+    the measured blocking reps (next to dispatch_attribution, so the
+    dispatch-floor work reads round trips and re-upload bytes from
+    the same record as the span split)."""
+    keys = ("round_trips", "bytes_h2d", "bytes_d2h", "device_puts",
+            "fetches", "redundant_constant_bytes", "redundant_uploads",
+            "unfingerprinted_uploads", "unfingerprinted_bytes")
+    return {k: after.get(k, 0) - before.get(k, 0) for k in keys}
+
+
+def _service_capture():
+    """Most recent soak-window service capture
+    (tools/soak.py --emit-bench-service): per-lane p50/p99 +
+    conservation totals from a LIVE overload window, embedded in
+    dead-tunnel records so the next BENCH_r*.json carries stream
+    behavior for the sentinel's lane rules even when no device
+    answered."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "bench_service_capture.json")
+    try:
+        with open(path) as f:
+            cap = json.load(f)
+    except (OSError, ValueError):
+        return None
+    svc = cap.get("service")
+    if not isinstance(svc, dict):
+        return None
+    svc = dict(svc)
+    svc["source"] = "soak-capture"
+    svc["recorded_at"] = cap.get("recorded_at")
+    return svc
+
+
 def _last_ondevice_record():
     """Most recent self-recorded on-device bench (device_watch capture),
     embedded verbatim in the rc=3 output so the driver artifact always
@@ -391,6 +448,14 @@ def main():
             # observability layer must attribute even a dead-tunnel
             # run completely (docs/observability.md)
             "dispatch_attribution": _dead_tunnel_attribution(),
+            # the transfer quantities the dispatch-floor item indicts
+            # (round trips, h2d/d2h bytes, redundant constant
+            # re-uploads), from the forced-4-device reconciliation
+            # probe — measured even with the tunnel dead
+            "transfer_ledger": _transfer_ledger_probe(),
+            # stream behavior from the latest live soak window
+            # (tools/soak.py --emit-bench-service)
+            "service": _service_capture(),
         }))
         return 3
     from stellar_tpu.crypto import batch_verifier
@@ -427,8 +492,10 @@ def main():
     # dispatch-floor PR starts from "relay = X ms, fetch = Y ms", not
     # one opaque number (docs/observability.md)
     from stellar_tpu.utils import tracing
+    from stellar_tpu.utils.transfer_ledger import transfer_ledger
     served_before = batch_verifier.served_counts()
     spans_before = tracing.span_totals()
+    transfer_before = transfer_ledger.totals()
     blocking = []
     for _ in range(BLOCKING_REPS):
         t0 = time.perf_counter()
@@ -437,6 +504,14 @@ def main():
     assert out.all()
     attribution = batch_verifier.dispatch_attribution(
         spans_before, tracing.span_totals(), reps=BLOCKING_REPS)
+    transfer = _transfer_totals_delta(transfer_before,
+                                      transfer_ledger.totals())
+    transfer["reps"] = BLOCKING_REPS
+    transfer["round_trips_per_rep"] = round(
+        transfer["round_trips"] / BLOCKING_REPS, 3)
+    transfer["redundancy_frac"] = round(
+        transfer["redundant_constant_bytes"] /
+        max(1, transfer["bytes_h2d"]), 4)
     headline_backend = _phase_backend(
         served_before, batch_verifier.served_counts(), platform)
     blocking_p50 = float(np.median(blocking))
@@ -493,6 +568,11 @@ def main():
         "n_devices": 1 if mesh is None else mesh.size,
         "native_prep": native_prep.available(),
         "dispatch_attribution": attribution,
+        # tunnel round trips + bytes moved + redundant constant
+        # re-uploads over the measured reps — the quantities the
+        # dispatch-floor demolition must delete (docs/observability.md
+        # "Transfer ledger")
+        "transfer_ledger": transfer,
     }
     # Emit the core record NOW: the tunnel's observed failure mode is a
     # HANG (not an exception), so a wedge inside an optional phase would

@@ -54,6 +54,7 @@ SCOPE = [
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
     "stellar_tpu/utils/tracing.py",
+    "stellar_tpu/utils/transfer_ledger.py",
     "tools/device_watch.py",
 ]
 

@@ -61,6 +61,12 @@ HOST_ORACLE_FILES = [
     # be bit-identical across nodes
     "stellar_tpu/ops/sha256.py",
     "stellar_tpu/crypto/batch_hasher.py",
+    # the transfer ledger records every engine upload/fetch and the
+    # perf sentinel gates bench-record drift in tier-1: both must stay
+    # clock/RNG-free — fingerprints and drift verdicts are
+    # content-derived, so two runs over the same bytes always agree
+    "stellar_tpu/utils/transfer_ledger.py",
+    "tools/perf_sentinel.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",
@@ -218,12 +224,18 @@ ALLOWLIST = Allowlist({
         "nondet:clock":
             "time.monotonic() stamps admission and completion for the "
             "per-lane wait-time histograms (the p50/p99 the soak "
-            "harness publishes) — observability only. No decision "
-            "reads them: admission verdicts depend on bounded queue/"
-            "byte budgets, scheduling order on priorities plus "
-            "admission sequence numbers, and WHICH rows shed on the "
-            "content-seeded rule in crypto/audit.py (replicas under "
-            "identical pressure shed identical rows).",
+            "harness publishes), and ages the adopter cool-down "
+            "window (service_verified's wedged-dispatcher bypass). "
+            "Neither reads decide a VERDICT: admission verdicts "
+            "depend on bounded queue/byte budgets, scheduling order "
+            "on priorities plus admission sequence numbers, WHICH "
+            "rows shed on the content-seeded rule in crypto/audit.py "
+            "(replicas under identical pressure shed identical rows), "
+            "and the cool-down only picks WHICH bit-identical path "
+            "serves a signature check (service lane vs direct "
+            "verify_sig) — the differential gates pin both paths to "
+            "the same bools, so a clock-driven bypass can never "
+            "diverge replicas' consensus state.",
     },
     "stellar_tpu/parallel/batch_engine.py": {
         "nondet:clock":

@@ -60,10 +60,12 @@ rows verify vs shed never depends on them (nondet allowlist,
 from __future__ import annotations
 
 import hashlib
+import inspect
 import os
 import threading
 import time
 from collections import deque
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -76,7 +78,8 @@ from stellar_tpu.utils.tracing import span
 
 __all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
            "SHED_LADDER", "configure_service", "default_service",
-           "running_service", "service_health", "lane_latencies"]
+           "running_service", "service_verified", "service_health",
+           "lane_latencies"]
 
 # re-export: the typed admission verdict lives with the resilience
 # primitives so TrickleBatcher can raise it without a module cycle
@@ -118,6 +121,26 @@ SHED_HIGHWATER_FRAC = 0.75
 
 _defaults_lock = threading.Lock()
 
+# ---------------- trace IDs (ISSUE 8) ----------------
+# Every submitted item gets a process-unique trace ID at ingress; a
+# submission's items take one CONTIGUOUS block so exemplar ranges stay
+# compact (batch_verifier.trace_ranges). IDs ride lane queuing, batch
+# coalescing, engine sub-chunking, re-shard, audit and host failover —
+# and survive shed/reject in the Overloaded ticket. A plain guarded
+# counter: no clock, no RNG (this module is nondet-lint scoped).
+
+_trace_lock = threading.Lock()
+_trace_next = 1
+
+
+def _alloc_trace_block(n: int) -> int:
+    """Reserve ``n`` contiguous trace IDs; returns the first."""
+    global _trace_next
+    with _trace_lock:
+        lo = _trace_next
+        _trace_next += max(1, n)
+    return lo
+
 
 def configure_service(lane_depth: Optional[int] = None,
                       lane_bytes: Optional[int] = None,
@@ -151,20 +174,28 @@ class VerifyTicket:
     batch failed — an admitted submission ALWAYS resolves to exactly
     one of verified / shed / failed, never silence."""
 
-    __slots__ = ("lane", "n_items", "_items", "_nbytes", "_digest",
-                 "_seq", "_t_enq", "_fut")
+    __slots__ = ("lane", "n_items", "trace_lo", "_items", "_nbytes",
+                 "_digest", "_seq", "_t_enq", "_fut")
 
     def __init__(self, lane: str, items, nbytes: int, digest: bytes,
-                 seq: int, t_enq: float):
+                 seq: int, t_enq: float, trace_lo: int = 0):
         from concurrent.futures import Future
         self.lane = lane
         self.n_items = len(items)
+        self.trace_lo = trace_lo
         self._items = items
         self._nbytes = nbytes
         self._digest = digest
         self._seq = seq
         self._t_enq = t_enq
         self._fut = Future()
+
+    @property
+    def trace_ids(self) -> range:
+        """This submission's per-item trace IDs (aligned with the
+        submitted items) — the handle the ``trace`` admin route takes
+        to reconstruct one item's end-to-end timeline."""
+        return range(self.trace_lo, self.trace_lo + self.n_items)
 
     def done(self) -> bool:
         return self._fut.done()
@@ -214,6 +245,7 @@ class VerifyService:
         self._running = False
         self._stop = False
         self._drain = True
+        self._traceful = False
         self._thread: Optional[threading.Thread] = None
 
     # ---------------- public API ----------------
@@ -226,6 +258,14 @@ class VerifyService:
                 return self
             if self._verifier is None:
                 self._verifier = batch_verifier.default_verifier()
+            # trace-ID propagation (ISSUE 8) rides verifiers whose
+            # submit accepts trace_ids (the real engine); duck-typed
+            # stand-ins keep working without them
+            try:
+                self._traceful = "trace_ids" in inspect.signature(
+                    self._verifier.submit).parameters
+            except (TypeError, ValueError):
+                self._traceful = False
             self._running = True
             self._stop = False
             self._drain = True
@@ -255,6 +295,12 @@ class VerifyService:
             h.update(msg)
             h.update(sig)
         digest = h.digest()
+        # per-item trace IDs (one contiguous block per submission):
+        # assigned BEFORE admission so a rejected submission's trace
+        # still exists — tagged in the Overloaded ticket and the
+        # recorder's service.reject event
+        trace_lo = _alloc_trace_block(n)
+        trange = [[trace_lo, trace_lo + n]] if n else []
         # clock read: latency stamp only — feeds the lane wait-time
         # histogram, never a verify/shed decision (nondet allowlist)
         t_enq = time.monotonic()
@@ -278,12 +324,16 @@ class VerifyService:
                 registry.meter(
                     f"crypto.verify.service.lane.{lane}.rejected"
                 ).mark(n)
+                batch_verifier.note_trace_event(
+                    "service.reject", lane=lane, reason=reason,
+                    traces=trange, items=n)
                 raise Overloaded(
                     f"verify service {lane} lane over budget "
                     f"({reason})", kind="rejected", lane=lane,
-                    reason=reason)
+                    reason=reason,
+                    trace_ids=range(trace_lo, trace_lo + n))
             tkt = VerifyTicket(lane, items, nbytes, digest,
-                               self._seq, t_enq)
+                               self._seq, t_enq, trace_lo=trace_lo)
             self._seq += 1
             if n == 0:
                 tkt._fut.set_result(np.zeros(0, dtype=bool))
@@ -291,6 +341,17 @@ class VerifyService:
             self._queues[lane].append(tkt)
             self._queued_items[lane] += n
             self._queued_bytes[lane] += nbytes
+            # trace milestone: admitted into the lane queue (recorder
+            # write routed through the engine — the tracing fence
+            # keeps this module duration-blind). Emitted BEFORE the
+            # notify, like service.reject above: once the dispatcher
+            # wakes it may coalesce and record service.coalesce /
+            # service.verdict for these traces, and the reconstructed
+            # timeline (trace_timeline) must never see a verdict
+            # before its enqueue.
+            batch_verifier.note_trace_event(
+                "service.enqueue", lane=lane, traces=trange,
+                seq=tkt._seq, items=n)
             self._cv.notify_all()
         return tkt
 
@@ -422,9 +483,14 @@ class VerifyService:
                 if not self._shed_seen:
                     self._shed_seen = True
                     onset = why
+                batch_verifier.note_trace_event(
+                    "service.shed", lane=ln, reason=why, level=level,
+                    traces=[[tkt.trace_lo,
+                             tkt.trace_lo + tkt.n_items]])
                 tkt._fut.set_exception(Overloaded(
                     f"shed under overload (level {level}: {why})",
-                    kind="shed", lane=ln, reason=why))
+                    kind="shed", lane=ln, reason=why,
+                    trace_ids=tkt.trace_ids))
             self._queues[ln] = kept
         return onset
 
@@ -443,9 +509,14 @@ class VerifyService:
                 registry.meter(
                     f"crypto.verify.service.lane.{ln}.shed"
                 ).mark(tkt.n_items)
+                batch_verifier.note_trace_event(
+                    "service.shed", lane=ln, reason="stopped",
+                    traces=[[tkt.trace_lo,
+                             tkt.trace_lo + tkt.n_items]])
                 tkt._fut.set_exception(Overloaded(
                     "service stopped without drain", kind="shed",
-                    lane=ln, reason="stopped"))
+                    lane=ln, reason="stopped",
+                    trace_ids=tkt.trace_ids))
 
     def _pick_lane_locked(self) -> Optional[str]:
         """Priority order, with sequence-based aging: every
@@ -475,6 +546,7 @@ class VerifyService:
         q = self._queues[ln]
         items: list = []
         parts = []
+        tids: list = []
         while q:
             tkt = q[0]
             if items and len(items) + tkt.n_items > self._max_batch:
@@ -482,6 +554,7 @@ class VerifyService:
             q.popleft()
             parts.append((tkt, len(items)))
             items.extend(tkt._items)
+            tids.extend(tkt.trace_ids)
             self._queued_items[ln] -= tkt.n_items
             self._queued_bytes[ln] -= tkt._nbytes
             self._inflight_bytes[ln] += tkt._nbytes
@@ -489,15 +562,19 @@ class VerifyService:
         self._batches += 1
         registry.gauge(
             f"crypto.verify.service.depth.{ln}").set(len(q))
-        return (ln, items, parts)
+        return (ln, items, parts, tids)
 
-    def _resolve_one(self, ln: str, parts, resolver) -> None:
+    def _resolve_one(self, ln: str, parts, resolver,
+                     traces=None) -> None:
         """Block on one in-flight dispatch and complete its tickets.
         Counters update BEFORE futures complete, so a caller that
         wakes on its ticket already sees consistent accounting."""
         out = None
         err: Optional[BaseException] = None
-        with span("service.resolve", lane=ln):
+        rs_attrs = {"lane": ln}
+        if traces:
+            rs_attrs["traces"] = traces
+        with span("service.resolve", **rs_attrs):
             try:
                 out = np.asarray(resolver())
             except BaseException as e:  # ticketed, never silent
@@ -512,6 +589,9 @@ class VerifyService:
             registry.meter("crypto.verify.service.failed").mark(n)
             registry.meter(
                 f"crypto.verify.service.lane.{ln}.failed").mark(n)
+            batch_verifier.note_trace_event(
+                "service.verdict", lane=ln, failed=True,
+                traces=traces or [], items=n)
             for tkt, _off in parts:
                 tkt._fut.set_exception(err)
             return
@@ -522,6 +602,10 @@ class VerifyService:
         registry.meter("crypto.verify.service.verified").mark(n)
         registry.meter(
             f"crypto.verify.service.lane.{ln}.verified").mark(n)
+        # trace milestone: each verdict carries its trace — the END of
+        # the trace route's reconstructed timeline
+        batch_verifier.note_trace_event(
+            "service.verdict", lane=ln, traces=traces or [], items=n)
         # clock read: wait-time histogram stamp only (nondet allowlist)
         now = time.monotonic()
         timer = registry.timer(
@@ -553,27 +637,37 @@ class VerifyService:
             if onset:
                 batch_verifier.note_shed_onset(onset)
             if batch is not None:
-                ln, items, parts = batch
+                ln, items, parts, tids = batch
+                tr = batch_verifier.trace_ranges(tids)
+                batch_verifier.note_trace_event(
+                    "service.coalesce", lane=ln, traces=tr,
+                    items=len(items), tickets=len(parts))
                 resolver = None
                 err: Optional[BaseException] = None
+                # the batch's trace-ID list rides the dispatch span as
+                # exemplar ranges (compressed, exact — never truncated)
                 with span("service.dispatch", lane=ln,
-                          items=len(items)):
+                          items=len(items), traces=tr):
                     try:
-                        resolver = self._verifier.submit(items)
+                        if self._traceful:
+                            resolver = self._verifier.submit(
+                                items, trace_ids=tids)
+                        else:
+                            resolver = self._verifier.submit(items)
                     except BaseException as e:
                         err = e
                 if err is not None:
-                    self._resolve_failed(ln, parts, err)
+                    self._resolve_failed(ln, parts, err, traces=tr)
                 else:
-                    inflight.append((ln, parts, resolver))
+                    inflight.append((ln, parts, resolver, tr))
             if inflight and (batch is None or
                              len(inflight) >= self._pipeline_depth):
                 self._resolve_one(*inflight.popleft())
             if stopping and batch is None and not inflight:
                 break
 
-    def _resolve_failed(self, ln: str, parts,
-                        err: BaseException) -> None:
+    def _resolve_failed(self, ln: str, parts, err: BaseException,
+                        traces=None) -> None:
         """A dispatch (host prep) failure: ticketed + counted as
         failed — the collect already moved the items in-flight."""
         n = sum(t.n_items for t, _ in parts)
@@ -585,6 +679,9 @@ class VerifyService:
         registry.meter("crypto.verify.service.failed").mark(n)
         registry.meter(
             f"crypto.verify.service.lane.{ln}.failed").mark(n)
+        batch_verifier.note_trace_event(
+            "service.verdict", lane=ln, failed=True,
+            traces=traces or [], items=n)
         for tkt, _off in parts:
             tkt._fut.set_exception(err)
 
@@ -638,6 +735,87 @@ def running_service() -> Optional[VerifyService]:
         if svc._running and not svc._stop:
             return svc
     return None
+
+
+# Wedged-dispatcher cool-down for the lane adopters: one result
+# timeout (the hung-fetch signature — Overloaded fast-fails and never
+# arms this) opens a bypass window so subsequent cache misses degrade
+# to the direct path INSTANTLY instead of each serially paying the
+# full wait — without it, a wedged dispatcher costs every cache-miss
+# crank/handshake/close ``timeout`` seconds until the lane queue
+# fills (depth x timeout of serial stalls), not the "degrade in one
+# timeout" the adopters advertise.
+ADOPTER_COOLDOWN_S = 30.0
+_adopter_cooldown_until = 0.0
+
+
+def _adopter_fallback(lane: str, reason: str, n: int) -> None:
+    """Every ``service_verified`` fallback is counted, per lane and
+    reason — a fleet silently riding the direct path (service absent,
+    wedged, or throwing on a bad call) must be distinguishable from
+    one riding the lanes, from metrics alone."""
+    registry.meter("crypto.verify.service.adopter_fallback").mark(n)
+    registry.meter(
+        f"crypto.verify.service.adopter_fallback.{lane}.{reason}"
+    ).mark(n)
+
+
+def service_verified(items: Sequence[tuple], lane: str,
+                     timeout: float = 10.0) -> Optional[list]:
+    """One cache-seeding service round trip for the signature hot
+    paths (herder SCP envelopes, peer auth certs, overlay tx-flood
+    pre-verify — the three lane adopters share THIS block so their
+    fallback/seeding semantics can never diverge): per-item bools via
+    the resident service, with every verdict re-seeded into keys'
+    ``verify_sig`` cache, or ``None`` when the service is absent or
+    fails in ANY way — Overloaded at ingress, stop mid-call, dispatch
+    failure, or the ``timeout`` expiring on an unresolved ticket. The
+    wait is BOUNDED by default, and a result timeout additionally
+    arms the :data:`ADOPTER_COOLDOWN_S` bypass window: a wedged
+    dispatcher (the tunnel's hung-fetch failure mode) must degrade
+    the caller to its direct path — once, not once per cache miss —
+    and never park a consensus crank, a peer handshake, or a ledger
+    close on a future that will not resolve. Every ``None`` is
+    metered per lane+reason (``crypto.verify.service.
+    adopter_fallback.*``). ``None`` means "you decide" — the direct
+    path is bit-identical, so the service can only ever change
+    latency, never validity."""
+    global _adopter_cooldown_until
+    n = len(items)
+    # clock read: cool-down bypass decides only WHICH bit-identical
+    # path serves (service lane vs direct verify), never a verdict
+    # (nondet allowlist)
+    with _service_lock:
+        cooling = time.monotonic() < _adopter_cooldown_until
+    if cooling:
+        _adopter_fallback(lane, "cooldown", n)
+        return None
+    svc = running_service()
+    if svc is None:
+        _adopter_fallback(lane, "absent", n)
+        return None
+    try:
+        ok = svc.verify(items, lane=lane, timeout=timeout)
+    except (FuturesTimeout, TimeoutError):
+        with _service_lock:
+            _adopter_cooldown_until = (time.monotonic()
+                                       + ADOPTER_COOLDOWN_S)
+        _adopter_fallback(lane, "timeout", n)
+        return None
+    except Overloaded:
+        _adopter_fallback(lane, "overloaded", n)
+        return None
+    except Exception:
+        # programming errors degrade too (the direct path is the safe,
+        # bit-identical choice for peer auth / consensus cranks) — but
+        # never silently: the "error" meter is the tripwire
+        _adopter_fallback(lane, "error", n)
+        return None
+    from stellar_tpu.crypto.keys import seed_verify_cache
+    out = [bool(o) for o in ok]
+    seed_verify_cache([(pk, msg, sig, o)
+                       for (pk, msg, sig), o in zip(items, out)])
+    return out
 
 
 def service_health() -> dict:

@@ -22,9 +22,9 @@ from typing import Callable, Dict, List, Optional
 
 from stellar_tpu.crypto.keys import (
     SecretKey, batch_verify_into_cache, cached_verify_sig,
-    seed_verify_cache, verify_sig,
+    verify_sig,
 )
-from stellar_tpu.crypto.verify_service import running_service
+from stellar_tpu.crypto.verify_service import service_verified
 from stellar_tpu.herder.transaction_queue import AddResult, TransactionQueue
 from stellar_tpu.herder.tx_set import (
     ApplicableTxSetFrame, TxSetXDRFrame, make_tx_set_from_transactions,
@@ -339,18 +339,14 @@ class Herder:
         got = cached_verify_sig(pk, payload, env.signature)
         if got is not None:
             return got
-        svc = running_service()
-        if svc is not None:
-            try:
-                ok = bool(svc.verify(
-                    [(pk, payload, env.signature)], lane="scp")[0])
-            except Exception:
-                # Overloaded at ingress, service stopping mid-call,
-                # dispatch failure — the service is an optimization;
-                # envelope verification must not depend on it
-                return verify_sig(pk, payload, env.signature)
-            seed_verify_cache([(pk, payload, env.signature, ok)])
-            return ok
+        # shared adopter block (service_verified): bounded wait +
+        # cache seeding + any-failure fallback — previously this call
+        # had NO result timeout, so a wedged dispatcher could park
+        # the consensus crank on an unresolved scp ticket
+        res = service_verified([(pk, payload, env.signature)],
+                               lane="scp")
+        if res is not None:
+            return res[0]
         return verify_sig(pk, payload, env.signature)
 
     def prefetch_envelope_signatures(self, envs: List[SCPEnvelope]):

@@ -330,6 +330,16 @@ class Application:
             from stellar_tpu.utils import tracing
             tracing.flight_recorder.configure(
                 capacity=config.FLIGHT_RECORDER_SPANS)
+        if changed("TRANSFER_LEDGER_RESOLVES") or \
+                changed("TRANSFER_LEDGER_FINGERPRINTS") or \
+                changed("TRANSFER_LEDGER_FP_MAX_BYTES"):
+            from stellar_tpu.utils.transfer_ledger import (
+                transfer_ledger,
+            )
+            transfer_ledger.configure(
+                resolves=config.TRANSFER_LEDGER_RESOLVES,
+                fingerprints=config.TRANSFER_LEDGER_FINGERPRINTS,
+                fp_max_bytes=config.TRANSFER_LEDGER_FP_MAX_BYTES)
         if changed("ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING"):
             from stellar_tpu.bucket import bucket_list as bl_mod
             bl_mod.REDUCE_MERGE_COUNTS = \

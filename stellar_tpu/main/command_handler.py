@@ -106,8 +106,12 @@ class CommandHandler:
         — the recorder exists to explain a wedged main thread, so it
         must stay readable when one is wedged. ``spans?dumps=true``
         returns the full dump payloads; ``limit=N`` bounds the recent
-        window."""
+        window; ``spans?format=chrome`` renders the recorder as Chrome
+        ``trace_event`` JSON (load in chrome://tracing / Perfetto —
+        also exported by ``tools/trace_export.py``)."""
         from stellar_tpu.utils import tracing
+        if params.get("format", ["json"])[0] == "chrome":
+            return tracing.flight_recorder.to_chrome_trace()
         try:
             limit = int(params.get("limit", ["128"])[0])
         except ValueError:
@@ -116,6 +120,24 @@ class CommandHandler:
         if params.get("dumps", ["false"])[0] == "true":
             out["dumps"] = tracing.flight_recorder.dumps()
         return out
+
+    def cmd_trace(self, params):
+        """One item's end-to-end timeline (ISSUE 8): ``trace?id=N``
+        reconstructs the submission's path — service enqueue, lane
+        wait, batch coalesce, dispatch, engine sub-chunk fetch/audit/
+        host-fallback, verdict (or shed/reject) — from the flight
+        recorder's exemplar-tagged records. Served directly: tracing
+        exists to explain a node that is misbehaving, so it must not
+        depend on the main thread (same policy as ``spans``)."""
+        from stellar_tpu.utils import tracing
+        tid = params.get("id", [None])[0]
+        if tid is None:
+            return {"error": "missing id param (trace?id=N)"}
+        try:
+            tid = int(tid)
+        except ValueError:
+            return {"error": "bad id param"}
+        return tracing.flight_recorder.trace_timeline(tid)
 
     def cmd_dispatch(self, params):
         """Verify-dispatch resilience surface: breaker state, backend
@@ -603,7 +625,7 @@ class CommandHandler:
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
         "dispatch": cmd_dispatch, "spans": cmd_spans,
-        "service": cmd_service,
+        "trace": cmd_trace, "service": cmd_service,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

@@ -226,6 +226,18 @@ class Config:
     # reservoir sample size behind every timer's p50/p90/p99 export
     # (metrics route, JSON and Prometheus forms)
     METRICS_RESERVOIR_SIZE: int = 512
+    # transfer ledger (docs/observability.md "Transfer ledger"):
+    # bounded ring of per-resolve host<->device transfer records
+    # (round trips, bytes each way, redundant constant re-uploads)
+    TRANSFER_LEDGER_RESOLVES: int = 256
+    # bounded LRU of upload content fingerprints behind the
+    # redundant-constant-bytes detector
+    TRANSFER_LEDGER_FINGERPRINTS: int = 4096
+    # uploads above this size are counted bytes-only (no content
+    # hash): the fingerprint runs on the dispatch hot path, so its
+    # cost must stay bounded; skipped uploads are visible in the
+    # ledger's unfingerprinted_uploads tally
+    TRANSFER_LEDGER_FP_MAX_BYTES: int = 1 << 20
     # node-id strkey -> human name for quorum/log output (reference
     # VALIDATOR_NAMES; merged with names from VALIDATORS entries)
     VALIDATOR_NAMES: Dict[str, str] = field(default_factory=dict)

@@ -74,9 +74,29 @@ class PeerAuth:
 
     def verify_remote_cert(self, cert: AuthCert, remote_node_id: bytes,
                            now: int) -> bool:
+        """Sig hot path #3 (reference ``PeerAuth::verifyRemoteAuthCert``).
+
+        When the resident verify service is running
+        (``VERIFY_SERVICE_ENABLED``), the cert signature rides the
+        ``auth`` priority lane — scheduled ahead of tx-flood backlog,
+        so a flood cannot starve peer handshakes (the reference's
+        Herder/overlay split). Mirrors the herder's cache-first SCP
+        adoption (PR 7): a cached verdict wins without a service
+        round trip, the service verdict re-seeds the cache, and
+        ingress rejection or any service failure falls back to the
+        direct path — bit-identical decisions on every route."""
         if cert.expiration < now:
             return False
         payload = self._cert_payload(cert.expiration, cert.pubkey.key)
+        from stellar_tpu.crypto.keys import cached_verify_sig
+        from stellar_tpu.crypto.verify_service import service_verified
+        got = cached_verify_sig(remote_node_id, payload, cert.sig)
+        if got is not None:
+            return got
+        res = service_verified(
+            [(remote_node_id, payload, cert.sig)], lane="auth")
+        if res is not None:
+            return res[0]
         return verify_sig(remote_node_id, payload, cert.sig)
 
     def shared_keys(self, remote_pub: bytes, local_nonce: bytes,

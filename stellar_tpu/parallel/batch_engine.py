@@ -80,6 +80,7 @@ from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.parallel import device_health
 from stellar_tpu.utils import faults, resilience, tracing
 from stellar_tpu.utils.metrics import registry
+from stellar_tpu.utils.transfer_ledger import transfer_ledger
 
 __all__ = [
     "Workload", "BatchEngine",
@@ -88,6 +89,7 @@ __all__ = [
     "dispatch_attribution", "phase_attribution", "dispatch_degraded",
     "host_only_mode", "note_shed_onset", "register_service_health",
     "service_health_snapshot", "served_counts",
+    "trace_ranges", "note_trace_event",
     "RESOLVE_PHASES", "RESOLVE_ROOT", "PHASE_SUFFIXES",
     "DEFAULT_BUCKET_SIZES",
 ]
@@ -132,6 +134,33 @@ def phase_names(span_ns: str) -> Tuple[str, ...]:
     return tuple(f"{span_ns}.{s}" for s in PHASE_SUFFIXES)
 
 
+def trace_ranges(ids) -> list:
+    """Compress a per-row trace-ID list into ``[lo, hi)`` pairs — the
+    exemplar form span/event records carry (``attrs["traces"]``), so a
+    2048-row batch costs a handful of ints per record and matching
+    (:func:`stellar_tpu.utils.tracing.trace_matches`) stays EXACT,
+    never truncated. Contiguous runs (a submission's block of IDs)
+    collapse to one pair; interleaved coalesced tickets produce one
+    pair per run."""
+    out: list = []
+    for t in ids:
+        t = int(t)
+        if out and t == out[-1][1]:
+            out[-1][1] = t + 1
+        else:
+            out.append([t, t + 1])
+    return out
+
+
+def note_trace_event(name: str, **attrs) -> None:
+    """Flight-recorder instant event on behalf of the verify service
+    (trace milestones: enqueue, coalesce, verdict, shed/reject). The
+    service sits inside the consensus nondet-lint scope and may not
+    import the clock-bearing tracing module — its recorder writes
+    route through here, same policy as :func:`note_shed_onset`."""
+    tracing.flight_recorder.note(name, **attrs)
+
+
 def phase_attribution(before: dict, after: dict, reps: int = 1,
                       span_ns: str = "verify") -> dict:
     """Per-phase dispatch attribution from span-timer deltas, for any
@@ -143,9 +172,16 @@ def phase_attribution(before: dict, after: dict, reps: int = 1,
     record still carries the complete breakdown; ``coverage`` is the
     phase-sum over the blocking root span's time — the reconciliation
     the bench record asserts (>= 0.95 means the breakdown explains the
-    headline, not a fraction of it)."""
-    def delta(name):
-        key = f"span.{name}"
+    headline, not a fraction of it).
+
+    Phase deltas read the ROOT-ATTRIBUTED ``span.attr.<phase>`` timers
+    (flushed only when a blocking root span completes — ISSUE 8), not
+    the per-exit phase histograms: a snapshot taken mid-resolve, or
+    concurrent service-path resolves with no blocking root, can
+    therefore never inflate ``coverage`` with phase time whose root
+    never finished (the re-shard/retry re-entry double-count)."""
+    def delta(name, prefix="span.attr."):
+        key = f"{prefix}{name}"
         b = before.get(key, {"count": 0, "sum_ms": 0.0})
         a = after.get(key, {"count": 0, "sum_ms": 0.0})
         return a["count"] - b["count"], a["sum_ms"] - b["sum_ms"]
@@ -158,7 +194,7 @@ def phase_attribution(before: dict, after: dict, reps: int = 1,
         phases[name] = {"count": c, "total_ms": round(s, 3),
                         "per_rep_ms": round(s / reps, 4)}
         phase_sum += s
-    root_count, root_sum = delta(f"{span_ns}.blocking")
+    root_count, root_sum = delta(f"{span_ns}.blocking", prefix="span.")
     coverage = (phase_sum / root_sum) if root_sum > 0 else None
     return {
         "phases": phases,
@@ -347,6 +383,7 @@ def dispatch_health() -> dict:
         "device_health": device_health.get().snapshot(),
         "watchdog": resilience.watchdog_stats(),
         "flight_recorder": tracing.flight_recorder.stats(),
+        "transfer": transfer_ledger.totals(),
         "service": service_health_snapshot(),
     }
 
@@ -397,15 +434,26 @@ def _resolve_budget_s() -> Optional[float]:
 
 
 def _fetch(dev, dev_idx: Optional[int] = None,
-           span_ns: str = "verify") -> np.ndarray:
+           span_ns: str = "verify",
+           traces=None) -> np.ndarray:
     """The blocking half of a dispatch (runs under the watchdog).
     ``dev_idx`` attributes the fetch to one mesh device for per-device
     chaos faults — including result corruption, applied here so the
     wrong bits flow through exactly the path real corruption would.
     The span opens on the POOL WORKER with the submitter's propagated
     context, so a fetch that hangs appears OPEN in a flight-recorder
-    dump, parent-linked to the resolve that dispatched it."""
-    with tracing.span(f"{span_ns}.fetch.device", device=dev_idx):
+    dump, parent-linked to the resolve that dispatched it; ``traces``
+    carries the part's trace-ID exemplar ranges into the worker-side
+    span. The transfer ledger is NOT written here: a fetch that misses
+    its watchdog deadline keeps running on the abandoned pool worker,
+    and a late completion would inflate the ledger against the
+    engine's own delivered-bytes tally (and mutate a resolve token
+    whose ring snapshot was already taken) — the caller records d2h at
+    the moment it actually accepts the result."""
+    attrs = {"device": dev_idx}
+    if traces:
+        attrs["traces"] = traces
+    with tracing.span(f"{span_ns}.fetch.device", **attrs):
         faults.inject(faults.RESOLVE, device=dev_idx)
         arr = np.asarray(dev)
         return faults.corrupt_verdicts(faults.RESOLVE, dev_idx, arr)
@@ -520,6 +568,13 @@ class BatchEngine:
         self.deadline_misses = 0
         self.retries = 0
         self.audit_mismatches = 0
+        # engine-side byte accounting, derived INDEPENDENTLY from the
+        # dispatch shapes (prod(shape) * itemsize at the placement
+        # sites) — the reconciliation oracle the transfer ledger's
+        # tier-1 self-check compares against, so a new transfer path
+        # that forgets its ledger hook shows up as a byte gap
+        self.shipped_bytes = 0
+        self.fetched_bytes = 0
 
     def _mark_served(self, kind: str, n: int,
                      dev_idx: Optional[int] = None) -> None:
@@ -577,7 +632,18 @@ class BatchEngine:
                     _note_device_failure("dispatch", e, dev_idx)
         return None
 
-    def _dispatch_parts(self, arrays: tuple, b: int, chunk: int):
+    def _ship_accounting(self, arrays) -> int:
+        """Engine-side independent byte count of one upload (shape ×
+        itemsize — NOT the ledger's ``nbytes`` read, so the two tallies
+        reconcile only when both paths saw the same arrays)."""
+        total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in arrays)
+        with self._stats_lock:
+            self.shipped_bytes += total
+        return total
+
+    def _dispatch_parts(self, arrays: tuple, b: int, chunk: int,
+                        tok=None, traces=None):
         """Split one padded bucket into per-device sub-chunks over the
         CURRENTLY HEALTHY devices — the degraded-mesh re-shard.
 
@@ -607,9 +673,12 @@ class BatchEngine:
             # degraded-mesh re-shard decision: record WHO serves WHAT
             # (or None = host fallback) so a dump of a degraded window
             # shows the assignment that produced its latencies
+            reshard_attrs = {"assignment": list(assignment),
+                             "parts": n_parts, "devices": n_dev}
+            if traces:
+                reshard_attrs["traces"] = traces
             tracing.flight_recorder.note(
-                f"{self._span_ns}.reshard", assignment=list(assignment),
-                parts=n_parts, devices=n_dev)
+                f"{self._span_ns}.reshard", **reshard_attrs)
         parts = []
         for j, di in enumerate(assignment):
             lo = j * sub
@@ -622,14 +691,20 @@ class BatchEngine:
                     "crypto.verify.dispatch.short_circuit").inc()
                 parts.append([lo, hi, None, None])
                 continue
+            subs = tuple(x[lo:lo + sub] for x in arrays)
             placed = tuple(
-                jax.device_put(x[lo:lo + sub], self._devices[di])
-                for x in arrays)
+                jax.device_put(a, self._devices[di]) for a in subs)
+            # transfer ledger: the device_put IS the h2d upload; the
+            # engine's own shape-derived tally is the reconciliation
+            # oracle (tools/transfer_selfcheck.py)
+            transfer_ledger.record_h2d_many(tok, subs, device=di)
+            self._ship_accounting(subs)
             arr = self._dispatch_one(placed, bsize=sub, dev_idx=di)
             parts.append([lo, hi, di, arr])
         return parts
 
-    def _dispatch_device(self, *encoded: np.ndarray):
+    def _dispatch_device(self, *encoded: np.ndarray, tok=None,
+                         trace_ids=None):
         """Dispatch padded/chunked batches to the jitted kernel without
         blocking; returns a list of (slice, chunk_len, parts) where
         parts are per-device sub-chunk records (single-device hosts get
@@ -637,7 +712,9 @@ class BatchEngine:
         an open breaker refuses, or host-only mode) carries ``None``
         and is re-computed on the host at resolve time; transient
         dispatch exceptions get ``DISPATCH_RETRIES`` fresh attempts
-        first."""
+        first. ``tok`` threads the resolve's transfer-ledger token;
+        ``trace_ids`` the per-item trace IDs (exemplar ranges land on
+        every dispatch span)."""
         n = encoded[0].shape[0]
         top = self._buckets[-1]
         pads = self._plugin.pad_rows()
@@ -649,6 +726,7 @@ class BatchEngine:
             b = self._bucket(chunk)
             pad = b - chunk
             sl = slice(start, start + chunk)
+            tr = trace_ranges(trace_ids[sl]) if trace_ids else None
 
             def _padded_inputs():
                 # built ONLY for chunks that will actually dispatch:
@@ -659,6 +737,12 @@ class BatchEngine:
                     return tuple(
                         np.concatenate([x[sl], np.repeat(p, pad, 0)])
                         for x, p in zip(encoded, pads))
+
+            def _span_attrs(**extra):
+                at = dict(extra)
+                if tr:
+                    at["traces"] = tr
+                return at
 
             if host_only:
                 # integrity posture: no device dispatch at all
@@ -672,15 +756,21 @@ class BatchEngine:
                 if _breaker.allow():
                     arrays = _padded_inputs()
                     with tracing.span(f"{self._span_ns}.dispatch",
-                                      devices=True):
-                        parts = self._dispatch_parts(arrays, b, chunk)
+                                      **_span_attrs(devices=True)):
+                        parts = self._dispatch_parts(
+                            arrays, b, chunk, tok=tok, traces=tr)
                 else:
                     registry.counter(
                         "crypto.verify.dispatch.short_circuit").inc()
                     parts = [[0, chunk, None, None]]
             elif _breaker.allow():
                 arrays = _padded_inputs()
-                with tracing.span(f"{self._span_ns}.dispatch"):
+                with tracing.span(f"{self._span_ns}.dispatch",
+                                  **_span_attrs()):
+                    # committed whole-bucket operands transfer at call
+                    # time — the h2d upload of the single-device path
+                    transfer_ledger.record_h2d_many(tok, arrays)
+                    self._ship_accounting(arrays)
                     arr = self._dispatch_one(arrays, b, None)
                 parts = [[0, chunk, None, arr]]
             else:
@@ -699,7 +789,8 @@ class BatchEngine:
         with tracing.span(f"{self._span_ns}.prep"):
             return self._plugin.encode(items)
 
-    def submit(self, items: Sequence) -> Callable[[], np.ndarray]:
+    def submit(self, items: Sequence,
+               trace_ids=None) -> Callable[[], np.ndarray]:
         """Asynchronous batch: host prep + non-blocking device
         dispatch.
 
@@ -707,6 +798,14 @@ class BatchEngine:
         result and returns the per-item result rows. Multiple submitted
         batches pipeline on device (jax async dispatch), overlapping
         transfer and compute across batches.
+
+        ``trace_ids`` (ISSUE 8): optional per-item trace IDs, aligned
+        with ``items``. They survive sub-chunking, re-shard, audit and
+        host failover — every dispatch/fetch/audit/fallback span and
+        recorder event for a part carries the part's exemplar ranges
+        (``trace_ranges``), so one item's path through the engine
+        reconstructs from the flight recorder (the ``trace`` admin
+        route).
         """
         n = len(items)
         if n == 0:
@@ -718,8 +817,15 @@ class BatchEngine:
             # dispatch
             out0 = self._plugin.empty_result(n)
             return lambda: self._plugin.finalize(gate, out0, items)
-        pending = self._dispatch_device(*encoded)
+        trace_ids = list(trace_ids) if trace_ids is not None else None
+        tok = transfer_ledger.begin(self._ns)
+        pending = self._dispatch_device(*encoded, tok=tok,
+                                        trace_ids=trace_ids)
         items = list(items)  # pinned for possible host re-computation
+
+        def _part_traces(gl: int, gh: int):
+            return trace_ranges(trace_ids[gl:gh]) if trace_ids \
+                else None
 
         def _audit_part(vals: np.ndarray, gl: int, gh: int,
                         di: Optional[int]) -> bool:
@@ -732,7 +838,11 @@ class BatchEngine:
             bits, so auditing it would be vacuous (and a
             device-predictable blind spot). True = clean (or nothing
             to audit)."""
-            with tracing.span(f"{self._span_ns}.audit", device=di):
+            audit_attrs = {"device": di}
+            atr = _part_traces(gl, gh)
+            if atr:
+                audit_attrs["traces"] = atr
+            with tracing.span(f"{self._span_ns}.audit", **audit_attrs):
                 material = b"".join(x[gl:gh].tobytes() for x in encoded)
                 eligible = [i for i in range(gh - gl) if gate[gl + i]]
                 idxs = audit_mod.sample_rows(material, eligible,
@@ -751,10 +861,13 @@ class BatchEngine:
             # the flight recorder (visible in dumps near the spans)
             device_health.get().note_audit(di, ok=clean,
                                            sampled=len(idxs))
+            verdict_attrs = audit_mod.verdict_record(
+                di, gl, gh, len(idxs), clean)
+            ptr = _part_traces(gl, gh)
+            if ptr:
+                verdict_attrs["traces"] = ptr
             tracing.flight_recorder.note(
-                f"{self._span_ns}.audit.verdict",
-                **audit_mod.verdict_record(di, gl, gh, len(idxs),
-                                           clean))
+                f"{self._span_ns}.audit.verdict", **verdict_attrs)
             return clean
 
         def _resolve_impl() -> np.ndarray:
@@ -762,6 +875,7 @@ class BatchEngine:
             for sl, chunk, parts in pending:
                 for lo, hi, di, arr in parts:
                     got = None
+                    ptr = _part_traces(sl.start + lo, sl.start + hi)
                     # _host_only is re-read PER PART: once any part's
                     # audit proves corruption, the remaining
                     # already-dispatched parts of this very batch are
@@ -784,12 +898,16 @@ class BatchEngine:
                             # it (and the worker-side device span) are
                             # still open, so the dump shows exactly
                             # where the hang is parked
+                            fetch_attrs = {"device": di}
+                            if ptr:
+                                fetch_attrs["traces"] = ptr
                             with tracing.span(f"{self._span_ns}.fetch",
-                                              device=di):
+                                              **fetch_attrs):
                                 try:
                                     got = resilience.call_with_deadline(
                                         lambda d=arr, i=di:
-                                        _fetch(d, i, self._span_ns),
+                                        _fetch(d, i, self._span_ns,
+                                               ptr),
                                         _resolve_budget_s(),
                                         name=f"{self._span_ns}-resolve")
                                 except resilience.DeadlineExceeded as e:
@@ -812,7 +930,19 @@ class BatchEngine:
                                 "short_circuit").inc()
                     gl, gh = sl.start + lo, sl.start + hi
                     if got is not None:
-                        vals = np.asarray(got)[:hi - lo]
+                        full = np.asarray(got)
+                        vals = full[:hi - lo]
+                        # both accountings record DELIVERED results at
+                        # this one point, so a deadline-missed fetch
+                        # that later completes on the abandoned pool
+                        # worker can never skew ledger-vs-engine
+                        # reconciliation
+                        transfer_ledger.record_d2h(tok, full,
+                                                   device=di)
+                        fetched = int(np.prod(full.shape)) * \
+                            full.dtype.itemsize
+                        with self._stats_lock:
+                            self.fetched_bytes += fetched
                         if not _audit_part(vals, gl, gh, di):
                             # wrong bits: hard-quarantine the chip,
                             # stop trusting the accelerator path, and
@@ -855,9 +985,12 @@ class BatchEngine:
                         # failover: bit-identical host re-computation
                         # of the affected rows (latency changes,
                         # results never do)
+                        fb_attrs = {"device": di}
+                        if ptr:
+                            fb_attrs["traces"] = ptr
                         with tracing.span(
                                 f"{self._span_ns}.host_fallback",
-                                device=di):
+                                **fb_attrs):
                             out[gl:gh] = self._plugin.host_result(
                                 items[gl:gh])
                         self._mark_served("host-fallback", hi - lo)
@@ -865,17 +998,26 @@ class BatchEngine:
 
         def resolve() -> np.ndarray:
             with tracing.span(f"{self._span_ns}.resolve"):
-                return _resolve_impl()
+                try:
+                    return _resolve_impl()
+                finally:
+                    # close the per-resolve transfer record (idempotent)
+                    transfer_ledger.finish(tok)
 
         return resolve
 
-    def compute_batch(self, items: Sequence) -> np.ndarray:
+    def compute_batch(self, items: Sequence,
+                      trace_ids=None) -> np.ndarray:
         """Blocking batch: per-item result rows, bit-identical to the
         plugin's host oracle. The root span covers the whole blocking
         call, so the per-phase spans under it attribute the blocking
-        headline (:func:`phase_attribution`)."""
-        with tracing.span(f"{self._span_ns}.blocking"):
-            return self.submit(items)()
+        headline (:func:`phase_attribution`) — the root COLLECTS its
+        phases (``_collect``) and flushes them into the
+        root-attributed ``span.attr.*`` timers only on completion, the
+        idempotency guarantee mid-resolve snapshots rely on."""
+        with tracing.span(f"{self._span_ns}.blocking",
+                          _collect=phase_names(self._span_ns)):
+            return self.submit(items, trace_ids=trace_ids)()
 
 
 # ---------------- device probe / availability ----------------
@@ -1032,6 +1174,7 @@ def _reset_dispatch_state_for_testing() -> None:
         _host_only = False
     _breaker.record_success()  # closed, zero failures, backoff reset
     device_health.get()._reset_for_testing()
+    transfer_ledger._reset_for_testing()
 
 
 def _auto_mesh():

@@ -153,6 +153,34 @@ class Timer:
                 if j < size:
                     self._reservoir[j] = ms
 
+    def record_total(self, count: int, sum_ms: float):
+        """Fold an externally-aggregated (count, sum) pair into the
+        totals — the flush path of tracing's root-attributed phase
+        collectors (``span.attr.*`` timers, ISSUE 8). The reservoir
+        and min/max take the batch MEAN once per flush: these timers
+        exist for exact count/sum attribution deltas
+        (``timer_totals``), and pretending per-event resolution from
+        an aggregate would fabricate percentiles."""
+        n = int(count)
+        if n <= 0:
+            return
+        mean = sum_ms / n
+        size = max(1, int(RESERVOIR_SIZE))
+        with self._lock:
+            self.count += n
+            self._sum += sum_ms
+            self._sum2 += mean * mean * n
+            self.min_ms = min(self.min_ms, mean)
+            self.max_ms = max(self.max_ms, mean)
+            if len(self._reservoir) > size:
+                del self._reservoir[size:]
+            if len(self._reservoir) < size:
+                self._reservoir.append(mean)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < size:
+                    self._reservoir[j] = mean
+
     def time(self):
         t0 = time.perf_counter()
         timer = self

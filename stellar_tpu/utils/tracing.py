@@ -59,7 +59,8 @@ from stellar_tpu.utils.metrics import registry
 
 __all__ = ["span", "zone", "LogSlowExecution", "current_zones",
            "current_context", "span_context", "frame_mark",
-           "FlightRecorder", "flight_recorder", "span_totals"]
+           "FlightRecorder", "flight_recorder", "span_totals",
+           "trace_matches"]
 
 _log = logging.getLogger("stellar_tpu.perf")
 
@@ -97,6 +98,25 @@ def current_context() -> Optional[int]:
     spans opened there parent under this one."""
     s = _stack()
     return s[-1].span_id if s else None
+
+
+def trace_matches(rec: dict, trace_id: int) -> bool:
+    """True when a span/event record carries ``trace_id`` in its
+    ``traces`` exemplar ranges (ISSUE 8). Trace exemplars are stored
+    COMPRESSED as ``[lo, hi)`` pairs (``batch_engine.trace_ranges``)
+    so a 2048-item batch costs a handful of ints in the record, not a
+    2048-element list — and matching stays exact, never truncated."""
+    attrs = rec.get("attrs")
+    if not attrs:
+        return False
+    for pair in attrs.get("traces") or ():
+        try:
+            lo, hi = pair
+        except (TypeError, ValueError):
+            continue
+        if lo <= trace_id < hi:
+            return True
+    return False
 
 
 class FlightRecorder:
@@ -217,6 +237,147 @@ class FlightRecorder:
                 "dump_reasons": [d["reason"] for d in self._dumps],
             }
 
+    def trace_timeline(self, trace_id: int) -> dict:
+        """Reconstruct one trace's end-to-end timeline (ISSUE 8): every
+        record in the ring, the open-span set, and the failure dumps
+        whose ``traces`` exemplar ranges contain ``trace_id``, sorted
+        by start time, plus derived milestones (queue wait, coalesce,
+        dispatch-to-verdict) when the service notes are present. The
+        ring is bounded, so a trace older than the retention window
+        reconstructs partially (``found`` stays True if anything
+        matched) — the ``trace`` admin route serves this payload."""
+        tid = int(trace_id)
+        with self._lock:
+            recs = {r["id"]: dict(r) for r in self._ring
+                    if trace_matches(r, tid)}
+            for r in self._active.values():
+                if trace_matches(r, tid):
+                    recs.setdefault(r["id"], dict(r, open=True))
+            for d in self._dumps:
+                for r in d["spans"] + d["open_spans"]:
+                    if trace_matches(r, tid):
+                        recs.setdefault(r["id"], dict(r))
+        records = sorted(recs.values(),
+                         key=lambda r: (r["start_ms"], r["id"]))
+
+        def first(name):
+            for r in records:
+                if r["name"] == name:
+                    return r
+            return None
+
+        phases: Dict[str, dict] = {}
+        for r in records:
+            if r.get("event") or r.get("dur_ms") is None:
+                continue
+            p = phases.setdefault(r["name"],
+                                  {"count": 0, "total_ms": 0.0})
+            p["count"] += 1
+            p["total_ms"] = round(p["total_ms"] + r["dur_ms"], 3)
+        summary = {}
+        enq = first("service.enqueue")
+        coal = first("service.coalesce")
+        verdict = first("service.verdict")
+        disp = first("span.service.dispatch")
+        if enq and coal:
+            summary["queue_wait_ms"] = round(
+                coal["start_ms"] - enq["start_ms"], 3)
+        if disp and verdict:
+            summary["dispatch_to_verdict_ms"] = round(
+                verdict["start_ms"] - disp["start_ms"], 3)
+        if enq and verdict:
+            summary["enqueue_to_verdict_ms"] = round(
+                verdict["start_ms"] - enq["start_ms"], 3)
+        shed = first("service.shed") or first("service.reject")
+        if shed is not None:
+            summary["dropped"] = shed["name"]
+        return {"trace": tid, "found": bool(records),
+                "records": records, "phases": phases,
+                "summary": summary}
+
+    def to_chrome_trace(self) -> dict:
+        """Render the recorder as Chrome ``trace_event`` JSON (the
+        ``chrome://tracing`` / Perfetto import format): thread-named
+        tracks (metadata ``M`` events), completed spans as properly
+        nested ``B``/``E`` pairs, instant events and still-open /
+        abandoned spans as ``i`` instants (an open span has no duration
+        yet — an instant marks where it is parked). Nesting is derived
+        from the records' PARENT LINKS (same-thread), not from interval
+        arithmetic, and child intervals are clamped inside their
+        parent's, so float rounding can never emit a crossing
+        begin/end pair. Served by ``spans?format=chrome`` and the
+        ``tools/trace_export.py`` CLI (docs/observability.md)."""
+        with self._lock:
+            done = [dict(r) for r in self._ring]
+            open_ = [dict(r, open=True)
+                     for r in self._active.values()]
+        spans = [r for r in done
+                 if not r.get("event") and r.get("dur_ms") is not None]
+        instants = [r for r in done
+                    if r.get("event") or r.get("dur_ms") is None]
+        instants += open_
+        tids: Dict[str, int] = {}
+
+        def tid_of(thread: str) -> int:
+            if thread not in tids:
+                tids[thread] = len(tids) + 1
+            return tids[thread]
+
+        by_id = {r["id"]: r for r in spans}
+        children: Dict[int, list] = {}
+        roots: Dict[str, list] = {}
+        for r in spans:
+            p = r.get("parent")
+            if p in by_id and by_id[p]["thread"] == r["thread"]:
+                children.setdefault(p, []).append(r)
+            else:
+                roots.setdefault(r["thread"], []).append(r)
+        events: List[dict] = []
+
+        def emit(r, lo_ms: float, hi_ms: float) -> float:
+            """Emit one span's B/E pair (and its subtree), clamped to
+            the parent interval [lo_ms, hi_ms]; returns this span's
+            end so siblings can't overlap."""
+            t0 = min(max(r["start_ms"], lo_ms), hi_ms)
+            t1 = min(max(t0, r["start_ms"] + r["dur_ms"]), hi_ms)
+            tid = tid_of(r["thread"])
+            args = {"id": r["id"]}
+            if r.get("attrs"):
+                args.update(r["attrs"])
+            events.append({"name": r["name"], "ph": "B", "pid": 1,
+                           "tid": tid, "ts": round(t0 * 1000.0, 1),
+                           "args": args})
+            cursor = t0
+            for c in sorted(children.get(r["id"], []),
+                            key=lambda x: (x["start_ms"], x["id"])):
+                cursor = emit(c, max(cursor, t0), t1)
+            events.append({"name": r["name"], "ph": "E", "pid": 1,
+                           "tid": tid, "ts": round(t1 * 1000.0, 1)})
+            return t1
+
+        for thread, rs in sorted(roots.items()):
+            cursor = 0.0
+            for r in sorted(rs, key=lambda x: (x["start_ms"], x["id"])):
+                cursor = emit(r, max(cursor, r["start_ms"]),
+                              float("inf"))
+        for r in instants:
+            args = {"id": r["id"]}
+            if r.get("attrs"):
+                args.update(r["attrs"])
+            if r.get("open"):
+                args["open"] = True
+            if r.get("abandoned"):
+                args["abandoned"] = True
+            events.append({"name": r["name"], "ph": "i", "pid": 1,
+                           "tid": tid_of(r["thread"]), "s": "t",
+                           "ts": round(r["start_ms"] * 1000.0, 1),
+                           "args": args})
+        meta = [{"name": "thread_name", "ph": "M", "pid": 1,
+                 "tid": tid, "args": {"name": thread}}
+                for thread, tid in sorted(tids.items(),
+                                          key=lambda kv: kv[1])]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
     def clear(self) -> None:
         """Tests: drop every record, open span, dump and the
         accounting counters — a fresh recorder."""
@@ -235,15 +396,29 @@ flight_recorder = FlightRecorder()
 class span:
     """``with span("verify.fetch", device=3): ...`` — inclusive wall
     time into the registry histogram ``span.<name>``, plus a recorder
-    record carrying span id, parent link, thread and attrs."""
+    record carrying span id, parent link, thread and attrs.
+
+    ``_collect`` (ISSUE 8) makes a span a ROOT-ATTRIBUTED collector:
+    same-thread descendant spans whose names are in the set fold their
+    inclusive durations into the collector, and the collector flushes
+    the totals into ``span.attr.<name>`` timers only when IT exits.
+    That is what makes ``phase_attribution`` idempotent under
+    re-shard/retry re-entry: a phase re-entered inside a resolve that
+    has not completed contributes nothing to the attribution timers,
+    so a ``span_totals()`` snapshot taken mid-resolve can never count
+    a phase whose blocking root is still open (the phases' own
+    ``span.<name>`` timers update per-exit as before — the recorder
+    and per-phase histograms are unchanged)."""
 
     _PREFIX = "span"
     __slots__ = ("name", "attrs", "span_id", "parent_id", "_t0",
-                 "_rec")
+                 "_rec", "_collect", "_collected")
 
-    def __init__(self, name: str, **attrs):
+    def __init__(self, name: str, _collect=None, **attrs):
         self.name = name
         self.attrs = attrs
+        self._collect = None if _collect is None else frozenset(_collect)
+        self._collected = None if _collect is None else {}
 
     def __enter__(self):
         st = _stack()
@@ -271,6 +446,32 @@ class span:
         registry.timer(f"{self._PREFIX}.{self.name}").update_ms(dt_ms)
         self._rec["dur_ms"] = round(dt_ms, 3)
         flight_recorder.finish_span(self._rec)
+        # Root-attributed phase accounting (ISSUE 8): fold this span's
+        # inclusive time into the nearest enclosing collector on THIS
+        # thread that registered its name. The collector's dict is
+        # touched only from its own thread (the stack is thread-local),
+        # so no lock is needed.
+        st = _stack()
+        for e in reversed(st):
+            if e is self:
+                continue
+            coll = getattr(e, "_collect", None)
+            if coll is not None and self.name in coll:
+                tot = e._collected.get(self.name)
+                if tot is None:
+                    e._collected[self.name] = [1, dt_ms]
+                else:
+                    tot[0] += 1
+                    tot[1] += dt_ms
+                break
+        if self._collect is not None and self._collected:
+            # flush AFTER this root's own timer updated: a snapshot
+            # racing the flush sees the root without its phases
+            # (coverage dips toward under-attribution, never inflates
+            # past 1 by a phantom in-flight resolve)
+            for name, (cnt, sum_ms) in self._collected.items():
+                registry.timer(f"span.attr.{name}").record_total(
+                    cnt, sum_ms)
         # Defensive pop back to SELF: an inner span abandoned mid-flight
         # (entered by hand, a generator that never resumed, an exit
         # skipped by interpreter shutdown) must not leave orphan stack

@@ -614,6 +614,21 @@ def test_lint_scopes_cover_batch_engine():
         assert mod not in nondet.ALLOWLIST._entries, mod
 
 
+def test_lint_scopes_cover_transfer_ledger_and_sentinel():
+    """ISSUE 8: the transfer ledger mutates per-resolve accounting
+    and the fingerprint LRU from resolver + pool threads (lock lint),
+    and both it and the perf sentinel gate tier-1 verdicts — their
+    fingerprints/drift decisions must stay content-derived, no clocks
+    or RNG (nondet lint). Neither carries an allowlist entry:
+    clock/RNG-free by design, like audit.py."""
+    led = "stellar_tpu/utils/transfer_ledger.py"
+    assert led in set(locks.SCOPE)
+    assert led in set(nondet.HOST_ORACLE_FILES)
+    assert "tools/perf_sentinel.py" in set(nondet.HOST_ORACLE_FILES)
+    for mod in (led, "tools/perf_sentinel.py"):
+        assert mod not in nondet.ALLOWLIST._entries, mod
+
+
 def test_sha256_overflow_golden_committed():
     """ISSUE 7: the hash workload gets the verify kernel's discipline —
     a committed proven envelope, diffed (not pass/failed) by

@@ -263,6 +263,101 @@ def test_timer_reservoir_bounded_and_representative():
     assert t.count == n
 
 
+def test_timer_record_total_folds_aggregates():
+    """ISSUE 8: the root-attributed phase flush folds (count, sum)
+    pairs — exact totals for attribution deltas, batch-mean into the
+    reservoir."""
+    t = Timer()
+    t.record_total(3, 30.0)
+    t.record_total(2, 5.0)
+    t.record_total(0, 99.0)   # no-op
+    assert t.count == 5
+    assert t.sum_ms() == 35.0
+    assert t.mean_ms() == 7.0
+    d = t.to_dict()
+    assert d["count"] == 5 and d["sum_ms"] == 35.0
+
+
+def test_attribution_idempotent_under_mid_resolve_snapshot():
+    """ISSUE 8 satellite regression: a ``span_totals()`` snapshot
+    taken MID-RESOLVE must not count phases of the unfinished resolve
+    — before root-attributed accounting, a phase re-entered by a
+    second resolve (the re-shard / failover shape) leaked into the
+    window and inflated coverage past the completed roots' time."""
+    import time
+
+    import numpy as np
+
+    from stellar_tpu.parallel import batch_engine
+
+    started = threading.Event()
+    release = threading.Event()
+    blocking = {"on": False}
+
+    class _W(batch_engine.Workload):
+        metrics_ns = "test.attr"
+        span_ns = "attrx"
+
+        def encode(self, items):
+            return (np.ones(len(items), dtype=bool),
+                    (np.zeros((len(items), 2), dtype=np.uint8),))
+
+        def pad_rows(self):
+            return (np.zeros((1, 2), dtype=np.uint8),)
+
+        def kernel_fn(self):
+            raise AssertionError("host-only test must not trace")
+
+        def empty_result(self, n):
+            return np.zeros(n, dtype=np.uint8)
+
+        def host_result(self, items):
+            if blocking["on"]:
+                started.set()
+                release.wait(10)
+            # a real phase cost: the sub-ms span plumbing around a
+            # zero-work stub would otherwise swamp the coverage ratio
+            time.sleep(0.05)
+            return np.zeros(len(items), dtype=np.uint8)
+
+        def finalize(self, gate, out, items):
+            return out
+
+    bv._enter_host_only("test: mid-resolve attribution")
+    eng = batch_engine.BatchEngine(_W(), bucket_sizes=(4,))
+    before = tracing.span_totals()
+    eng.compute_batch([1, 2, 3, 4])          # resolve 1 completes
+    blocking["on"] = True
+    t = threading.Thread(
+        target=lambda: eng.compute_batch([5, 6, 7, 8]))
+    t.start()
+    assert started.wait(10)
+    # resolve 2 re-entered prep AND is parked inside host_fallback;
+    # the mid-resolve snapshot must attribute resolve 1 ONLY
+    att = batch_engine.phase_attribution(
+        before, tracing.span_totals(), reps=1, span_ns="attrx")
+    try:
+        assert att["blocking_span_count"] == 1
+        assert att["phases"]["attrx.prep"]["count"] == 1
+        assert att["phases"]["attrx.host_fallback"]["count"] == 1
+        assert att["coverage"] is not None
+        assert att["coverage"] <= 1.01
+    finally:
+        release.set()
+        t.join(10)
+    # ...and once resolve 2 completes, its phases attribute too —
+    # nothing is lost, only deferred to root completion
+    att2 = batch_engine.phase_attribution(
+        before, tracing.span_totals(), reps=2, span_ns="attrx")
+    assert att2["blocking_span_count"] == 2
+    assert att2["phases"]["attrx.prep"]["count"] == 2
+    assert att2["phases"]["attrx.host_fallback"]["count"] == 2
+    assert att2["coverage"] >= 0.95
+    # idempotent: re-deriving from the same snapshots changes nothing
+    assert att2 == batch_engine.phase_attribution(
+        before, tracing.span_totals(), reps=2, span_ns="attrx")
+
+
 def test_prometheus_exposition_parses_and_covers_types():
     import re
     r = MetricsRegistry()

@@ -275,3 +275,200 @@ def test_hand_assembled_frame_matches_xdr_pack():
         0, AuthenticatedMessageV0(sequence=seq, message=msg,
                                   mac=HmacSha256Mac(mac=mac))))
     assert fast == slow
+
+
+# ---------------- verify-service lane adoption (ISSUE 8) ----------------
+
+
+class _LaneOracle:
+    """Service-transport stub: host-oracle decisions, lane accounting
+    happens in the real VerifyService around it."""
+
+    def __init__(self):
+        self.rows = 0
+
+    def submit(self, items):
+        import numpy as np
+
+        from stellar_tpu.crypto import ed25519_ref
+        res = np.array([ed25519_ref.verify(pk, msg, sig)
+                        for pk, msg, sig in items], dtype=bool)
+        self.rows += len(items)
+        return lambda: res
+
+
+def _signed(n, tag):
+    from stellar_tpu.crypto import ed25519_ref
+    out = []
+    for i in range(n):
+        seed = bytes([(23 * (i + 1) + tag) % 251]) * 32
+        pk = ed25519_ref.secret_to_public(seed)
+        msg = b"lane-%d-%d" % (tag, i)
+        out.append((pk, msg, ed25519_ref.sign(seed, msg)))
+    return out
+
+
+def test_peer_auth_rides_service_auth_lane(monkeypatch):
+    """ISSUE 8 satellite: verify_remote_cert rides the ``auth``
+    priority lane when the resident service runs (cache-first, verdict
+    re-seeds the cache, stopped service falls back to the direct path
+    — bit-identical decisions on every route)."""
+    from stellar_tpu.crypto import keys
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.overlay.peer import PeerAuth
+
+    node = SecretKey.from_seed_str("auth-lane-node")
+    net_id = b"\x07" * 32
+    auth = PeerAuth(node, net_id, now=1000)
+    nid = node.public_key.raw
+
+    keys.flush_verify_cache()
+    oracle = _LaneOracle()
+    svc = vs.VerifyService(verifier=oracle).start()
+    monkeypatch.setattr(vs, "_service", svc)
+    try:
+        assert auth.verify_remote_cert(auth.cert, nid, now=1000)
+        assert oracle.rows == 1
+        lane = svc.snapshot()["lanes"]["auth"]
+        assert (lane["submitted"], lane["verified"]) == (1, 1)
+        # verdict seeded the verify_sig cache: repeat is a hit, no
+        # second service round trip
+        assert auth.verify_remote_cert(auth.cert, nid, now=1000)
+        assert oracle.rows == 1
+        # a tampered cert is a fresh triple: service says False
+        import copy
+        bad = copy.copy(auth.cert)
+        bad.sig = bytes(64)
+        assert not auth.verify_remote_cert(bad, nid, now=1000)
+        assert oracle.rows == 2
+        # expiry check still precedes any signature work
+        assert not auth.verify_remote_cert(
+            auth.cert, nid, now=10**9)
+    finally:
+        svc.stop(drain=False)
+        monkeypatch.setattr(vs, "_service", None)
+    # stopped service: direct path, identical decision
+    keys.flush_verify_cache()
+    assert auth.verify_remote_cert(auth.cert, nid, now=1000)
+
+
+def test_tx_preverify_rides_service_bulk_lane(monkeypatch):
+    """ISSUE 8 satellite: the overlay's off-crank tx-flood signature
+    pre-verification rides the sheddable ``bulk`` lane when the
+    service runs; verdicts seed the verify_sig cache; an Overloaded
+    service falls back to the direct batch path (pre-verification is
+    an optimization, never a correctness dependency)."""
+    from stellar_tpu.crypto import keys
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.overlay.overlay_manager import (
+        _preverify_into_cache,
+    )
+
+    items = _signed(3, tag=1)
+    keys.flush_verify_cache()
+    oracle = _LaneOracle()
+    svc = vs.VerifyService(verifier=oracle).start()
+    monkeypatch.setattr(vs, "_service", svc)
+    try:
+        _preverify_into_cache(items)
+        lane = svc.snapshot()["lanes"]["bulk"]
+        assert (lane["submitted"], lane["verified"]) == (3, 3)
+        # all three verdicts are now cache hits for admission
+        for pk, msg, sig in items:
+            assert keys.cached_verify_sig(pk, msg, sig) is True
+        # cache-first: nothing re-submits
+        _preverify_into_cache(items)
+        assert svc.snapshot()["lanes"]["bulk"]["submitted"] == 3
+    finally:
+        svc.stop(drain=False)
+        monkeypatch.setattr(vs, "_service", None)
+    # no service: the direct batch path decides identically
+    keys.flush_verify_cache()
+    _preverify_into_cache(items)
+    for pk, msg, sig in items:
+        assert keys.cached_verify_sig(pk, msg, sig) is True
+
+
+def test_tx_preverify_falls_back_on_overloaded(monkeypatch):
+    from stellar_tpu.crypto import keys
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.overlay.overlay_manager import (
+        _preverify_into_cache,
+    )
+    from stellar_tpu.utils.resilience import Overloaded
+
+    class _Refuser:
+        def submit(self, items):
+            raise AssertionError("unused")
+
+    svc = vs.VerifyService(verifier=_Refuser())
+
+    def refuse(items, lane="bulk", timeout=None):
+        raise Overloaded("bulk full", kind="rejected", lane="bulk",
+                         reason="queue-depth")
+
+    monkeypatch.setattr(svc, "verify", refuse)
+    monkeypatch.setattr(svc, "_running", True)
+    monkeypatch.setattr(vs, "_service", svc)
+    items = _signed(2, tag=9)
+    keys.flush_verify_cache()
+    _preverify_into_cache(items)   # falls back to the direct batch
+    for pk, msg, sig in items:
+        assert keys.cached_verify_sig(pk, msg, sig) is True
+    monkeypatch.setattr(vs, "_service", None)
+
+
+def test_adopter_timeout_arms_cooldown(monkeypatch):
+    """Code-review fix: a wedged dispatcher (result timeout — the
+    hung-fetch signature) must cost the lane adopters ONE bounded
+    wait, not one per cache miss. The first ``service_verified`` pays
+    the timeout and arms the cool-down; subsequent calls on EVERY
+    lane bypass the service instantly (metered per lane+reason) until
+    the window expires — so a consensus crank degrades once, never
+    serially per envelope until the lane queue fills."""
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils.metrics import registry
+
+    calls = []
+
+    class _Unused:
+        def submit(self, items):
+            raise AssertionError("unused")
+
+    svc = vs.VerifyService(verifier=_Unused())
+
+    def hang(items, lane="bulk", timeout=None):
+        calls.append(lane)
+        raise vs.FuturesTimeout()
+
+    monkeypatch.setattr(svc, "verify", hang)
+    monkeypatch.setattr(svc, "_running", True)
+    monkeypatch.setattr(vs, "_service", svc)
+    monkeypatch.setattr(vs, "_adopter_cooldown_until", 0.0)
+    items = _signed(1, tag=5)
+    before_to = registry.meter(
+        "crypto.verify.service.adopter_fallback.scp.timeout").count
+    before_cd = registry.meter(
+        "crypto.verify.service.adopter_fallback.auth.cooldown").count
+    try:
+        assert vs.service_verified(items, lane="scp") is None
+        assert calls == ["scp"]
+        # cool-down armed: later misses never touch the service,
+        # whatever the lane — the fallback is instant, not timeout*N
+        assert vs.service_verified(items, lane="auth") is None
+        assert vs.service_verified(items, lane="bulk") is None
+        assert calls == ["scp"]
+        assert registry.meter(
+            "crypto.verify.service.adopter_fallback.scp.timeout"
+        ).count == before_to + 1
+        assert registry.meter(
+            "crypto.verify.service.adopter_fallback.auth.cooldown"
+        ).count == before_cd + 1
+        # window expiry re-admits the service (and a fresh timeout
+        # re-arms it)
+        monkeypatch.setattr(vs, "_adopter_cooldown_until", 0.0)
+        assert vs.service_verified(items, lane="scp") is None
+        assert calls == ["scp", "scp"]
+    finally:
+        monkeypatch.setattr(vs, "_service", None)

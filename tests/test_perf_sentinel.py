@@ -1,0 +1,173 @@
+"""Perf-drift sentinel (ISSUE 8): the typed tolerance rules over the
+last two bench records — the tier-1 PERF_DRIFT_OK gate must pass on a
+steady trajectory and DEMONSTRABLY fail on a synthetically drifted
+record. See docs/observability.md "Perf sentinel"."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "perf_sentinel", os.path.join(REPO, "tools", "perf_sentinel.py"))
+sentinel = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sentinel)
+
+
+def _record(**over):
+    rec = {
+        "value": 80.0,
+        "kernel_cost": {"dsm_static_mul_ops": 772,
+                        "kernel_static_mul_ops": 2818,
+                        "dsm_weighted_mul_elems": 137724544,
+                        "select_macs_per_verify": 81920,
+                        "sha256": {"weighted_ops": 90269}},
+        "analysis": {"ok": True, "overflow_proven": True,
+                     "sha256_overflow_proven": True, "lints_ok": True,
+                     "envelope_sha256": "aaaa",
+                     "sha256_envelope": "bbbb"},
+        "dispatch_attribution": {"coverage": 0.999},
+        "transfer_ledger": {"reconciliation": 1.0, "round_trips": 7,
+                            "redundancy_frac": 0.5},
+        "service": {"lane_latency_ms": {
+            "scp": {"p50_ms": 5.0, "p99_ms": 20.0},
+            "auth": {"p99_ms": 30.0},
+            "bulk": {"p99_ms": 200.0}},
+            "conservation_gap": 0},
+    }
+    for path, val in over.items():
+        cur = rec
+        parts = path.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = val
+    return rec
+
+
+def test_latest_records_orders_numerically(tmp_path):
+    # r100 must sort AFTER r99 (lexicographic sort would diff the
+    # pair backwards and read a regression as an improvement)
+    for n in (7, 99, 100):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+    base, head = sentinel.latest_records(str(tmp_path))
+    assert os.path.basename(base) == "BENCH_r99.json"
+    assert os.path.basename(head) == "BENCH_r100.json"
+
+
+def test_steady_trajectory_passes():
+    out = sentinel.apply_rules(_record(), _record())
+    assert out["ok"], out["findings"]
+    assert not out["notes"]
+
+
+def test_kernel_cost_drift_fails():
+    out = sentinel.apply_rules(
+        _record(), _record(**{"kernel_cost.dsm_static_mul_ops": 1538}))
+    assert not out["ok"]
+    assert any(f["path"] == "kernel_cost.dsm_static_mul_ops"
+               for f in out["findings"])
+
+
+def test_coverage_and_reconciliation_floors():
+    out = sentinel.apply_rules(
+        _record(),
+        _record(**{"dispatch_attribution.coverage": 0.5,
+                   "transfer_ledger.reconciliation": 0.8}))
+    bad = {f["path"] for f in out["findings"]}
+    assert "dispatch_attribution.coverage" in bad
+    assert "transfer_ledger.reconciliation" in bad
+
+
+def test_redundancy_growth_fails_but_shrink_passes():
+    grown = sentinel.apply_rules(
+        _record(),
+        _record(**{"transfer_ledger.redundancy_frac": 0.9}))
+    assert any(f["path"] == "transfer_ledger.redundancy_frac"
+               for f in grown["findings"])
+    shrunk = sentinel.apply_rules(
+        _record(),
+        _record(**{"transfer_ledger.redundancy_frac": 0.0}))
+    assert shrunk["ok"], shrunk["findings"]
+
+
+def test_zero_baseline_skips_growth_rule():
+    """An idle lane in the base window (p99 = 0) must not flag the
+    first window that carries traffic."""
+    out = sentinel.apply_rules(
+        _record(**{"service.lane_latency_ms.auth.p99_ms": 0.0}),
+        _record(**{"service.lane_latency_ms.auth.p99_ms": 50.0}))
+    assert out["ok"], out["findings"]
+    assert any(s.get("reason") == "zero-baseline"
+               for s in out["skipped"])
+
+
+def test_unproven_analysis_fails():
+    out = sentinel.apply_rules(
+        _record(), _record(**{"analysis.overflow_proven": False}))
+    assert any(f["path"] == "analysis.overflow_proven"
+               for f in out["findings"])
+
+
+def test_envelope_change_is_note_not_fatal():
+    out = sentinel.apply_rules(
+        _record(), _record(**{"analysis.envelope_sha256": "cccc"}))
+    assert out["ok"]
+    assert any(n["path"] == "analysis.envelope_sha256"
+               for n in out["notes"])
+
+
+def test_missing_fields_skip_not_fail():
+    """Static records legitimately lack live-only fields — BENCH_r06's
+    tail carries only kernel_cost; the sentinel must not punish it."""
+    base = {"kernel_cost": {"dsm_static_mul_ops": 772}}
+    out = sentinel.apply_rules(base, _record())
+    assert out["ok"], out["findings"]
+    assert any(s["path"] == "value" for s in out["skipped"])
+
+
+def test_wrapper_tail_records_parse(tmp_path):
+    inner = _record()
+    wrapped = {"n": 9, "cmd": "python bench.py", "rc": 3,
+               "tail": json.dumps(inner)}
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps(wrapped))
+    assert sentinel.load_record(str(p)) == inner
+
+
+def test_cli_exits_nonzero_on_synthetic_drift(tmp_path):
+    """The acceptance pin: the sentinel must demonstrably FAIL (exit
+    != 0) on a drifted record — and pass on a steady pair."""
+    base = tmp_path / "BENCH_a.json"
+    head = tmp_path / "BENCH_b.json"
+    base.write_text(json.dumps(_record()))
+    head.write_text(json.dumps(
+        _record(**{"kernel_cost.dsm_static_mul_ops": 9999})))
+    tool = os.path.join(REPO, "tools", "perf_sentinel.py")
+    bad = subprocess.run(
+        [sys.executable, tool, "--records", str(base), str(head)],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode != 0
+    rec = json.loads(bad.stdout.strip().splitlines()[-1])
+    assert not rec["ok"] and rec["findings"]
+    head.write_text(json.dumps(_record()))
+    good = subprocess.run(
+        [sys.executable, tool, "--records", str(base), str(head)],
+        capture_output=True, text=True, timeout=60)
+    assert good.returncode == 0, good.stdout
+
+
+def test_repo_trajectory_is_clean():
+    """The committed BENCH_r*.json pair must pass the sentinel — the
+    exact check tier-1 echoes as PERF_DRIFT_OK."""
+    pair = sentinel.latest_records(REPO)
+    if pair is None:
+        pytest.skip("fewer than 2 bench records committed")
+    base = sentinel.load_record(pair[0])
+    head = sentinel.load_record(pair[1])
+    out = sentinel.apply_rules(base, head)
+    assert out["ok"], out["findings"]

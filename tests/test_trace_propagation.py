@@ -1,0 +1,324 @@
+"""End-to-end trace propagation + Chrome export (ISSUE 8): per-item
+trace IDs assigned at VerifyService ingress survive lane queuing, batch
+coalescing, engine sub-chunking, audit, host failover, and shed/reject;
+spans carry exemplar ranges; the ``trace`` admin route reconstructs one
+item's timeline from the flight recorder; the Chrome trace_event export
+loads as valid JSON with correctly nested begin/end pairs. See
+docs/observability.md "Trace propagation"."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.crypto import ed25519_ref as ref
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.parallel import batch_engine
+from stellar_tpu.utils import tracing
+from stellar_tpu.utils.resilience import Overloaded
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    tracing.flight_recorder.clear()
+    yield
+    tracing.flight_recorder.clear()
+    bv._reset_dispatch_state_for_testing()
+
+
+def _sigs(n):
+    pool = []
+    for i in range(min(n, 8)):
+        seed = bytes([i + 41]) * 32
+        pk = ref.secret_to_public(seed)
+        msg = b"trace-%d" % i
+        pool.append((pk, msg, ref.sign(seed, msg)))
+    return [pool[i % len(pool)] for i in range(n)]
+
+
+# ---------------- helpers: ranges + matching ----------------
+
+
+def test_trace_ranges_compression():
+    assert batch_engine.trace_ranges([]) == []
+    assert batch_engine.trace_ranges([5]) == [[5, 6]]
+    assert batch_engine.trace_ranges([5, 6, 7]) == [[5, 8]]
+    assert batch_engine.trace_ranges([5, 6, 9, 10, 3]) == \
+        [[5, 7], [9, 11], [3, 4]]
+
+
+def test_trace_matches_exact_ranges():
+    rec = {"attrs": {"traces": [[10, 14], [20, 21]]}}
+    assert tracing.trace_matches(rec, 10)
+    assert tracing.trace_matches(rec, 13)
+    assert not tracing.trace_matches(rec, 14)
+    assert tracing.trace_matches(rec, 20)
+    assert not tracing.trace_matches(rec, 9)
+    assert not tracing.trace_matches({"attrs": {}}, 10)
+    assert not tracing.trace_matches({}, 10)
+
+
+# ---------------- engine boundaries ----------------
+
+
+class _TraceWorkload(batch_engine.Workload):
+    """Trivial device workload (ms-compile on jax-CPU): kernel =
+    first column; host oracle identical — audits stay clean."""
+
+    metrics_ns = "test.trace"
+    span_ns = "trc"
+
+    def encode(self, items):
+        arr = np.array([[v, 0] for v in items], dtype=np.uint8)
+        return np.ones(len(items), dtype=bool), (arr,)
+
+    def pad_rows(self):
+        return (np.zeros((1, 2), dtype=np.uint8),)
+
+    def kernel_fn(self):
+        def k(a):
+            return a[:, 0]
+        return k
+
+    def empty_result(self, n):
+        return np.zeros(n, dtype=np.uint8)
+
+    def host_result(self, items):
+        return np.array(list(items), dtype=np.uint8)
+
+    def finalize(self, gate, out, items):
+        return out
+
+
+def _records_named(name):
+    with tracing.flight_recorder._lock:
+        return [dict(r) for r in tracing.flight_recorder._ring
+                if r["name"] == name]
+
+
+def test_engine_device_path_spans_carry_traces():
+    """Dispatch span, fetch span, worker-side fetch.device span, and
+    the audit verdict event all carry the batch's exemplar ranges on
+    the single-device jit path."""
+    eng = batch_engine.BatchEngine(_TraceWorkload(), bucket_sizes=(4,))
+    tids = [100, 101, 102, 103]
+    out = eng.compute_batch([1, 2, 3, 4], trace_ids=tids)
+    assert list(out) == [1, 2, 3, 4]
+    for name in ("span.trc.dispatch", "span.trc.fetch",
+                 "span.trc.fetch.device", "span.trc.audit"):
+        recs = _records_named(name)
+        assert recs, name
+        assert recs[-1]["attrs"]["traces"] == [[100, 104]], name
+    verdicts = _records_named("trc.audit.verdict")
+    assert verdicts and verdicts[-1]["attrs"]["traces"] == [[100, 104]]
+    # the trace route's recorder query finds the engine-side records
+    tl = tracing.flight_recorder.trace_timeline(102)
+    names = {r["name"] for r in tl["records"]}
+    assert tl["found"]
+    assert {"span.trc.dispatch", "span.trc.fetch",
+            "span.trc.audit"} <= names
+
+
+def test_engine_host_failover_carries_traces():
+    """IDs survive host failover: the host_fallback span is exemplar-
+    tagged, so a trace reconstructs even when no device served it."""
+    bv._enter_host_only("test: trace through failover")
+    eng = batch_engine.BatchEngine(_TraceWorkload(), bucket_sizes=(4,))
+    out = eng.compute_batch([5, 6, 7, 8], trace_ids=[7, 8, 9, 10])
+    assert list(out) == [5, 6, 7, 8]
+    recs = _records_named("span.trc.host_fallback")
+    assert recs and recs[-1]["attrs"]["traces"] == [[7, 11]]
+    assert not _records_named("span.trc.dispatch")
+
+
+# ---------------- service boundaries ----------------
+
+
+class _OracleVerifier:
+    """Service-transport stub with the engine's trace contract."""
+
+    def __init__(self):
+        self.trace_batches = []
+
+    def submit(self, items, trace_ids=None):
+        self.trace_batches.append(list(trace_ids or []))
+        res = np.array([ref.verify(pk, m, s) for pk, m, s in items],
+                       dtype=bool)
+        return lambda: res
+
+
+def test_service_assigns_and_propagates_trace_ids():
+    svc = vs.VerifyService(verifier=_OracleVerifier()).start()
+    try:
+        t1 = svc.submit(_sigs(3), lane="scp")
+        t2 = svc.submit(_sigs(2), lane="bulk")
+        assert t1.result(timeout=30).all()
+        assert t2.result(timeout=30).all()
+        # contiguous per-submission blocks, aligned with items
+        assert len(t1.trace_ids) == 3 and len(t2.trace_ids) == 2
+        assert set(t1.trace_ids).isdisjoint(t2.trace_ids)
+        # the engine saw the SAME ids the tickets carry
+        seen = {tid for batch in svc._verifier.trace_batches
+                for tid in batch}
+        assert set(t1.trace_ids) <= seen and set(t2.trace_ids) <= seen
+        # milestone events + exemplar-tagged dispatch span
+        for tid in (t1.trace_ids[0], t2.trace_ids[-1]):
+            tl = tracing.flight_recorder.trace_timeline(tid)
+            names = [r["name"] for r in tl["records"]]
+            assert "service.enqueue" in names
+            assert "service.coalesce" in names
+            assert "span.service.dispatch" in names
+            assert "service.verdict" in names
+            # derived milestones: queue wait is computable
+            assert "queue_wait_ms" in tl["summary"]
+    finally:
+        svc.stop(drain=False)
+
+
+def test_rejected_submission_tagged_in_overloaded():
+    svc = vs.VerifyService(verifier=_OracleVerifier())  # never started
+    with pytest.raises(Overloaded) as ei:
+        svc.submit(_sigs(2), lane="bulk")
+    assert ei.value.kind == "rejected"
+    assert len(ei.value.trace_ids) == 2
+    tid = ei.value.trace_ids[0]
+    tl = tracing.flight_recorder.trace_timeline(tid)
+    assert tl["found"]
+    assert any(r["name"] == "service.reject" for r in tl["records"])
+    assert tl["summary"].get("dropped") == "service.reject"
+
+
+def test_shed_submission_tagged_in_overloaded():
+    svc = vs.VerifyService(verifier=_OracleVerifier())
+    tkt = vs.VerifyTicket("bulk", _sigs(2), 10, b"d" * 32, 0, 0.0,
+                          trace_lo=vs._alloc_trace_block(2))
+    with svc._cv:
+        svc._queues["bulk"].append(tkt)
+        svc._queued_items["bulk"] += 2
+        svc._queued_bytes["bulk"] += 10
+        svc._abort_queues_locked()
+    with pytest.raises(Overloaded) as ei:
+        tkt.result(timeout=1)
+    assert ei.value.kind == "shed"
+    assert list(ei.value.trace_ids) == list(tkt.trace_ids)
+    tl = tracing.flight_recorder.trace_timeline(tkt.trace_ids[0])
+    assert any(r["name"] == "service.shed" for r in tl["records"])
+
+
+def test_trace_route_reconstructs_each_lane_end_to_end():
+    """ISSUE 8 acceptance: one item submitted on EACH lane
+    reconstructs end-to-end via the ``trace`` admin route — enqueue,
+    coalesce, dispatch, engine resolution, verdict."""
+    from stellar_tpu.main.command_handler import CommandHandler
+    bv._enter_host_only("test: trace route e2e")
+    v = bv.BatchVerifier(bucket_sizes=(8,))
+    svc = vs.VerifyService(verifier=v).start()
+    try:
+        tickets = {ln: svc.submit(_sigs(1), lane=ln)
+                   for ln in vs.LANES}
+        for ln, tkt in tickets.items():
+            assert tkt.result(timeout=60).all(), ln
+        for ln, tkt in tickets.items():
+            tid = tkt.trace_ids[0]
+            out = CommandHandler.cmd_trace(None, {"id": [str(tid)]})
+            assert out["found"], ln
+            names = [r["name"] for r in out["records"]]
+            assert "service.enqueue" in names, ln
+            assert "service.coalesce" in names, ln
+            assert "span.service.dispatch" in names, ln
+            assert "span.verify.host_fallback" in names, ln
+            assert "service.verdict" in names, ln
+            assert "enqueue_to_verdict_ms" in out["summary"], ln
+        # route-level errors are structured, not 500s
+        assert "error" in CommandHandler.cmd_trace(None, {})
+        assert "error" in CommandHandler.cmd_trace(
+            None, {"id": ["nope"]})
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------- Chrome trace_event export ----------------
+
+
+def _validate_chrome(trace: dict):
+    """Round-trip through JSON and check every track's B/E pairs nest
+    correctly (the golden-file criterion)."""
+    blob = json.dumps(trace)
+    out = json.loads(blob)
+    stacks = {}
+    for e in out["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "B":
+            stacks.setdefault(e["tid"], []).append((e["name"], e["ts"]))
+        elif e["ph"] == "E":
+            st = stacks.get(e["tid"])
+            assert st, f"E without B: {e}"
+            name, ts = st.pop()
+            assert name == e["name"], (name, e["name"])
+            assert e["ts"] >= ts
+    assert all(not s for s in stacks.values()), "unclosed B"
+    return out
+
+
+def test_chrome_trace_export_golden():
+    with tracing.span("outer", kind="root"):
+        with tracing.span("inner.a"):
+            pass
+        with tracing.span("inner.b", traces=[[1, 3]]):
+            with tracing.span("leaf"):
+                pass
+    tracing.flight_recorder.note("an.event", traces=[[1, 2]])
+    with tracing.span("left.open"):
+        trace = tracing.flight_recorder.to_chrome_trace()
+    out = _validate_chrome(trace)
+    evs = out["traceEvents"]
+    # thread-named track metadata
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert metas and metas[0]["name"] == "thread_name"
+    b_names = [e["name"] for e in evs if e["ph"] == "B"]
+    assert b_names.count("span.outer") == 1
+    # DFS order: parent B before child B
+    assert b_names.index("span.outer") < b_names.index("span.inner.a")
+    # instants: the note AND the still-open span
+    inst = {e["name"]: e for e in evs if e["ph"] == "i"}
+    assert "an.event" in inst
+    assert inst["span.left.open"]["args"].get("open") is True
+    # exemplar ranges survive into args
+    tagged = [e for e in evs
+              if e["ph"] == "B" and e["name"] == "span.inner.b"]
+    assert tagged[0]["args"]["traces"] == [[1, 3]]
+
+
+def test_chrome_trace_route_serves_json():
+    from stellar_tpu.main.command_handler import CommandHandler
+    with tracing.span("route.span"):
+        pass
+    out = CommandHandler.cmd_spans(None, {"format": ["chrome"]})
+    out = _validate_chrome(out)
+    assert any(e["name"] == "span.route.span"
+               for e in out["traceEvents"])
+
+
+def test_chrome_trace_cross_thread_child_is_own_track():
+    """A span opened on a pool thread under a propagated context must
+    not corrupt the submitter thread's B/E nesting — it renders on its
+    OWN tid track."""
+    def worker(ctx):
+        with tracing.span_context(ctx):
+            with tracing.span("pool.child"):
+                pass
+
+    with tracing.span("submitter"):
+        t = threading.Thread(target=worker,
+                             args=(tracing.current_context(),),
+                             name="pool-thread")
+        t.start()
+        t.join()
+    out = _validate_chrome(tracing.flight_recorder.to_chrome_trace())
+    by_name = {}
+    for e in out["traceEvents"]:
+        if e["ph"] == "B":
+            by_name[e["name"]] = e["tid"]
+    assert by_name["span.pool.child"] != by_name["span.submitter"]

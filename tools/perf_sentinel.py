@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Perf-drift sentinel (ISSUE 8): diff the last two bench records
+against TYPED tolerance rules, so ``BENCH_*.json`` drift can never
+again pass silently (the bench trajectory list was empty precisely
+because nothing consumed it).
+
+A bench record is the wrapper the driver commits ({"n", "cmd", "rc",
+"tail": "<one JSON line>"}) or the bare bench.py line; both parse. The
+sentinel compares the newest record (HEAD) against the previous one
+(BASE) under the rule table below:
+
+* ``max_increase_frac`` — HEAD may exceed BASE by at most ``tol``
+  (kernel-cost ledgers, transfer redundancy, lane latencies: bigger is
+  worse);
+* ``min_value`` — HEAD must be at least ``tol`` (attribution coverage,
+  transfer reconciliation: the record's own quality gates);
+* ``require_true`` — HEAD must carry a truthy value (analysis proof
+  state: a bench number from an unproven kernel is not quotable);
+* ``note_change`` — reported when BASE != HEAD, never fatal (the
+  proven-envelope hash changes on DELIBERATE kernel work; the sentinel
+  flags it for review instead of blocking the gate forever).
+
+A rule whose path is missing from the relevant record(s) is SKIPPED
+and listed — static (dead-tunnel) records legitimately lack live-only
+fields. Exit 0 = no fatal drift; anything else fails the tier-1 gate
+(``PERF_DRIFT_OK``). ``docs/observability.md`` "Perf sentinel" carries
+the same table.
+
+Usage:
+    python tools/perf_sentinel.py                    # last two BENCH_r*.json
+    python tools/perf_sentinel.py --records A B      # explicit pair
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------- the typed tolerance rules ----------------
+# (path, type, tol, why) — path walks dotted keys through the record.
+RULES = [
+    # kernel-cost ledgers: the hardware-independent perf trajectory.
+    # Static op counts are deterministic, so even 2% growth means the
+    # kernel got WORSE without anyone saying so.
+    ("kernel_cost.dsm_static_mul_ops", "max_increase_frac", 0.02,
+     "traced dsm multiply ops regressed"),
+    ("kernel_cost.kernel_static_mul_ops", "max_increase_frac", 0.02,
+     "traced kernel-total multiply ops regressed"),
+    ("kernel_cost.dsm_weighted_mul_elems", "max_increase_frac", 0.02,
+     "executed dsm MAC volume regressed"),
+    ("kernel_cost.select_macs_per_verify", "max_increase_frac", 0.02,
+     "window-select MAC volume regressed"),
+    ("kernel_cost.sha256.weighted_ops", "max_increase_frac", 0.02,
+     "sha256 weighted op volume regressed"),
+    # analysis envelope: proof state must hold; the envelope HASH may
+    # change deliberately (--write-golden) — flagged, not fatal.
+    ("analysis.ok", "require_true", None,
+     "static-analysis gate not green in the measured record"),
+    ("analysis.overflow_proven", "require_true", None,
+     "verify kernel not proven overflow-free in the measured record"),
+    ("analysis.sha256_overflow_proven", "require_true", None,
+     "sha256 kernel not proven overflow-free in the measured record"),
+    ("analysis.lints_ok", "require_true", None,
+     "lint findings open in the measured record"),
+    ("analysis.envelope_sha256", "note_change", None,
+     "proven limb envelope changed (deliberate? review the golden)"),
+    ("analysis.sha256_envelope", "note_change", None,
+     "proven sha256 envelope changed (deliberate? review the golden)"),
+    # attribution coverage: the breakdown must keep explaining the
+    # headline, or the next dispatch-floor claim is unattributed.
+    ("dispatch_attribution.coverage", "min_value", 0.95,
+     "per-phase span sum no longer reconciles the blocking root"),
+    # transfer ledger: the dispatch-floor quantities. Reconciliation
+    # is the record's own self-check; redundancy growth means MORE
+    # constant re-uploads than the last record — the exact regression
+    # the resident-tables work must drive to zero.
+    ("transfer_ledger.reconciliation", "min_value", 0.95,
+     "transfer ledger no longer reconciles engine byte accounting"),
+    ("transfer_ledger.round_trips", "min_value", 1,
+     "transfer probe recorded no tunnel round trips"),
+    # scale-free: redundant bytes / shipped bytes — comparable across
+    # probe-sized and live-sized windows, unlike absolute byte counts
+    ("transfer_ledger.redundancy_frac", "max_increase_frac", 0.25,
+     "redundant-constant re-upload FRACTION grew >25%"),
+    # per-lane service latency (soak-captured): generous tolerance —
+    # wall-clock percentiles across different hosts/windows are noisy;
+    # only egregious drift (3x) fails.
+    ("service.lane_latency_ms.scp.p50_ms", "max_increase_frac", 2.0,
+     "scp lane p50 wait grew >3x"),
+    ("service.lane_latency_ms.scp.p99_ms", "max_increase_frac", 2.0,
+     "scp lane p99 wait grew >3x"),
+    ("service.lane_latency_ms.auth.p99_ms", "max_increase_frac", 2.0,
+     "auth lane p99 wait grew >3x"),
+    ("service.lane_latency_ms.bulk.p99_ms", "max_increase_frac", 4.0,
+     "bulk lane p99 wait grew >5x (the sheddable lane drifts widest)"),
+    ("service.conservation_gap", "note_change", None,
+     "service conservation gap changed (must stay 0)"),
+    # the headline itself, when both windows were live
+    ("value", "max_increase_frac", 0.25,
+     "blocking headline p50 regressed >25%"),
+]
+
+
+def load_record(path: str) -> dict:
+    """Parse one bench artifact: the driver wrapper ({'tail': text})
+    or a bare bench.py JSON line. A wrapper's tail may carry log noise
+    (jax platform warnings) around the record — consumers read the
+    LAST stdout line that parses, exactly as the driver does."""
+    with open(path) as f:
+        rec = json.load(f)
+    if isinstance(rec, dict) and isinstance(rec.get("tail"), str):
+        for line in reversed(rec["tail"].strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        raise ValueError(f"no JSON record line in {path} tail")
+    return rec
+
+
+def walk(rec, path: str):
+    """Dotted-path lookup; returns (found, value)."""
+    cur = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return False, None
+        cur = cur[part]
+    return True, cur
+
+
+def apply_rules(base: dict, head: dict, rules=None) -> dict:
+    rules = RULES if rules is None else rules
+    findings = []
+    notes = []
+    skipped = []
+    for path, kind, tol, why in rules:
+        b_found, b = walk(base, path)
+        h_found, h = walk(head, path)
+        if kind == "require_true":
+            if not h_found:
+                skipped.append({"path": path, "reason": "missing"})
+            elif not h:
+                findings.append({"path": path, "rule": kind,
+                                 "head": h, "why": why})
+            continue
+        if kind == "min_value":
+            if not h_found or h is None:
+                skipped.append({"path": path, "reason": "missing"})
+            elif not isinstance(h, (int, float)) or h < tol:
+                findings.append({"path": path, "rule": kind,
+                                 "head": h, "tol": tol, "why": why})
+            continue
+        # two-record rules need BOTH sides
+        if not b_found or not h_found or b is None or h is None:
+            skipped.append({"path": path, "reason": "missing"})
+            continue
+        if kind == "note_change":
+            if b != h:
+                notes.append({"path": path, "base": b, "head": h,
+                              "why": why})
+            continue
+        if kind == "max_increase_frac":
+            if not isinstance(b, (int, float)) or \
+                    not isinstance(h, (int, float)):
+                skipped.append({"path": path, "reason": "non-numeric"})
+                continue
+            if b == 0:
+                # a zero baseline has no meaningful growth ratio (an
+                # idle lane in the base window would flag ANY traffic
+                # in the next); the first nonzero record becomes the
+                # baseline instead
+                skipped.append({"path": path,
+                                "reason": "zero-baseline"})
+                continue
+            ceiling = b * (1.0 + tol) if b >= 0 else b * (1.0 - tol)
+            if h > ceiling + 1e-9:
+                findings.append({"path": path, "rule": kind,
+                                 "base": b, "head": h, "tol": tol,
+                                 "why": why})
+            continue
+        skipped.append({"path": path, "reason": f"unknown rule {kind}"})
+    return {"ok": not findings, "findings": findings, "notes": notes,
+            "skipped": skipped}
+
+
+def _record_index(path: str):
+    """Run counter extracted from BENCH_r<N>.json — NUMERIC ordering,
+    so r100 sorts after r99 once the counter outgrows its zero
+    padding (lexicographic sort would read that diff backwards)."""
+    stem = os.path.basename(path)
+    digits = "".join(c for c in stem if c.isdigit())
+    return (int(digits) if digits else -1, stem)
+
+
+def latest_records(root: str):
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                   key=_record_index)
+    if len(paths) < 2:
+        return None
+    return paths[-2], paths[-1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--records", nargs=2, metavar=("BASE", "HEAD"),
+                    help="explicit record pair (default: the last two "
+                         "BENCH_r*.json in the repo root)")
+    args = ap.parse_args()
+    if args.records:
+        base_path, head_path = args.records
+    else:
+        pair = latest_records(REPO)
+        if pair is None:
+            # a single-record repo has no trajectory to guard yet —
+            # that is "nothing to diff", not drift
+            print(json.dumps({"ok": True, "findings": [],
+                              "notes": [],
+                              "skipped": [{"reason":
+                                           "fewer than 2 records"}]}))
+            return 0
+        base_path, head_path = pair
+    try:
+        base = load_record(base_path)
+        head = load_record(head_path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"ok": False,
+                          "findings": [{"path": "<load>",
+                                        "why": repr(e)[:200]}]}))
+        return 1
+    out = apply_rules(base, head)
+    out["base"] = os.path.basename(base_path)
+    out["head"] = os.path.basename(head_path)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

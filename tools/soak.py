@@ -48,7 +48,12 @@ sys.path.insert(0, REPO)
 N_DEV = 4
 BUCKET = 8              # device-domain chaos shapes: sub-chunk = 2
 SUB = BUCKET // N_DEV
-SMOKE_SCP_P99_BOUND_MS = 5000.0
+# Runaway guard only — scp waits are real 4-device CPU dispatches, so
+# the absolute p99 drifts ~2x with host load (observed 2.5-5.0s, the
+# worst right after a saturated tier-1 sweep); lane ISOLATION is
+# pinned by the relative check (scp p99 < bulk p99) below, which is
+# load-invariant. A starved scp lane shows up as tens of seconds.
+SMOKE_SCP_P99_BOUND_MS = 8000.0
 
 
 def _env_setup(real_device: bool) -> None:
@@ -424,6 +429,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "warm_s": warm_s,
         "devices": len(devs),
         "totals": totals,
+        "conservation_gap": snap["conservation_gap"],
         "shed_onsets": registry.counter(
             "crypto.verify.service.shed_onsets").count,
         "lane_latency_ms": lanes,
@@ -439,6 +445,39 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "events_path": events_path,
         "problems": problems,
     }
+
+
+BENCH_SERVICE_CAPTURE = os.path.join(
+    REPO, "docs", "bench_service_capture.json")
+
+
+def emit_bench_service(rec: dict, path: str) -> None:
+    """Persist this soak window's per-lane p50/p99 + conservation
+    totals as the capture ``bench.py`` embeds in its next record's
+    ``service`` section (ISSUE 8 satellite — the ROADMAP's "live
+    window capture of bench.py's service record section"). Only a
+    GREEN verify-workload window is worth regression-guarding; a red
+    one fails the run anyway."""
+    import datetime
+    cap = {
+        "recorded_at": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "source": "tools/soak.py",
+        "mode": rec["mode"],
+        "devices": rec["devices"],
+        "wall_s": rec["wall_s"],
+        "service": {
+            "lane_latency_ms": rec["lane_latency_ms"],
+            "totals": rec["totals"],
+            "conservation_gap": rec["conservation_gap"],
+            "shed_onsets": rec["shed_onsets"],
+            "ingress_rejected_submissions":
+                rec["ingress_rejected_submissions"],
+            "shed_submissions": rec["shed_submissions"],
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(cap, f, indent=1, sort_keys=True)
 
 
 def main() -> int:
@@ -459,6 +498,13 @@ def main() -> int:
                     help="which engine plugin to soak: the verify "
                          "service flood (default) or the SHA-256 "
                          "hasher through the same flaky-device flap")
+    ap.add_argument("--emit-bench-service", nargs="?",
+                    const=BENCH_SERVICE_CAPTURE, default=None,
+                    metavar="PATH",
+                    help="on a green verify run, write the per-lane "
+                         "p50/p99 + conservation capture bench.py "
+                         "embeds as its service record section "
+                         f"(default path: {BENCH_SERVICE_CAPTURE})")
     args = ap.parse_args()
     events = args.events or (
         "/tmp/_soak_events.jsonl" if args.smoke
@@ -468,6 +514,10 @@ def main() -> int:
         rec = run_sha256(args.smoke, args.duration, events)
     else:
         rec = run(args.smoke, args.duration, args.corrupt, events)
+    if args.emit_bench_service and args.workload == "verify" \
+            and rec["ok"]:
+        emit_bench_service(rec, args.emit_bench_service)
+        rec["bench_service_capture"] = args.emit_bench_service
     print(json.dumps(rec))
     return 0 if rec["ok"] else 1
 

@@ -95,4 +95,26 @@ if [ "$src" -eq 0 ]; then
 fi
 echo SOAK_OK=$([ "$src" -eq 0 ] && [ "$hsrc" -eq 0 ] && echo 1 || echo 0)
 [ "$src" -ne 0 ] && exit $src
-exit $hsrc
+[ "$hsrc" -ne 0 ] && exit $hsrc
+# Perf-drift sentinel (ISSUE 8): the last two BENCH_r*.json records
+# diffed against the typed tolerance rules (kernel-cost ledgers,
+# analysis proof state, attribution coverage, transfer-ledger totals,
+# per-lane p50/p99 — docs/observability.md "Perf sentinel"). Pure
+# JSON comparison, sub-second; a kernel/cost/coverage regression that
+# reached a committed bench record fails the gate here instead of
+# passing silently.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/perf_sentinel.py
+prc=$?
+echo PERF_DRIFT_OK=$([ "$prc" -eq 0 ] && echo 1 || echo 0)
+[ "$prc" -ne 0 ] && exit $prc
+# Transfer-ledger reconciliation (ISSUE 8): a forced-4-device chaos
+# resolve (SHA-256 workload, flaky-device:0 armed) must record
+# nonzero round trips AND nonzero redundant constant re-upload bytes,
+# and the ledger's byte totals must reconcile >= 95% against the
+# engine's own shape-derived accounting — a transfer path without a
+# ledger hook fails here as a byte gap. Reuses the chaos gate's
+# persistent jax cache: seconds warm, ~1 min cold.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/transfer_selfcheck.py
+trc=$?
+echo TRANSFER_LEDGER_OK=$([ "$trc" -eq 0 ] && echo 1 || echo 0)
+exit $trc

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Chrome ``trace_event`` exporter CLI (ISSUE 8): dump the flight
+recorder as a JSON file chrome://tracing / Perfetto load directly —
+thread-named tracks, nested begin/end span pairs, instant markers for
+events and still-open spans.
+
+Two sources:
+
+* ``--url http://127.0.0.1:11626`` — scrape a RUNNING node's
+  ``spans?format=chrome`` admin route (the recorder that explains the
+  node's last breaker trip / shed onset / audit mismatch);
+* no URL — run one synthetic host-only resolve in THIS process (the
+  ``tools/metrics_selfcheck.py`` shape: real span-instrumented code
+  path, no device, seconds) and export the local recorder: a
+  self-contained demo trace plus a smoke test of the exporter.
+
+``--out trace.json`` writes the file (default stdout); the last stderr
+line summarizes event counts. See ``docs/observability.md``
+"Trace propagation".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def synthetic_trace() -> dict:
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import ed25519_ref as ref
+    from stellar_tpu.utils import tracing
+
+    bv._enter_host_only("trace export: synthetic resolve")
+    pool = []
+    for i in range(8):
+        seed = bytes([i + 1]) * 32
+        pk = ref.secret_to_public(seed)
+        msg = b"trace-export-%d" % i
+        pool.append((pk, msg, ref.sign(seed, msg)))
+    items = [pool[i % len(pool)] for i in range(64)]
+    v = bv.BatchVerifier(bucket_sizes=(64,))
+    # trace IDs ride the synthetic resolve too, so the exported file
+    # demonstrates exemplar-tagged spans
+    out = v.compute_batch(items, trace_ids=list(range(1, 65)))
+    assert out.all(), "synthetic resolve signatures must verify"
+    return tracing.flight_recorder.to_chrome_trace()
+
+
+def fetch_trace(url: str) -> dict:
+    import urllib.request
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/spans?format=chrome",
+            timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="admin base URL of a running node "
+                         "(default: synthetic local resolve)")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: stdout)")
+    args = ap.parse_args()
+    trace = fetch_trace(args.url) if args.url else synthetic_trace()
+    text = json.dumps(trace)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    evs = trace.get("traceEvents", [])
+    print(f"trace-export: {len(evs)} events "
+          f"({sum(1 for e in evs if e.get('ph') == 'B')} spans, "
+          f"{sum(1 for e in evs if e.get('ph') == 'i')} instants) -> "
+          f"{args.out or 'stdout'}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""TRANSFER_LEDGER_OK self-check (run by ``tools/tier1.sh``; ISSUE 8).
+
+Proves the transfer ledger end-to-end on a forced-4-device CHAOS
+resolve — CPU backend, the SHA-256 engine workload (its scan-based
+kernel compiles in seconds, against the shared persistent cache), with
+``flaky-device:0`` armed so the recorded window includes real fault-
+domain traffic (failed dispatches, host fallback) and not just the
+happy path:
+
+1. two resolves of the SAME batch must yield a ledger whose
+   ``round_trips`` AND ``redundant_constant_bytes`` are nonzero — the
+   second upload of identical content is exactly the base/A-table
+   re-upload shape the dispatch-floor item indicts;
+2. the ledger's byte totals must RECONCILE (>= MIN_RECONCILE both
+   directions) against the engine's own independent shape-derived
+   accounting of what it shipped and fetched — a new transfer path
+   that forgets its ledger hook shows up here as a byte gap;
+3. the ``crypto.transfer.*`` counters must ride the Prometheus
+   exposition, and digests must stay bit-identical to hashlib through
+   the flap (the chaos part never changes results).
+
+Prints one JSON line (also embedded by ``bench.py`` dead-tunnel
+records as ``transfer_ledger``); exit 0 = every check passed.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEV = 4
+BUCKET = 8
+MIN_RECONCILE = 0.95
+
+
+def _env_setup() -> None:
+    """CPU-only multi-device env — must run before jax imports (same
+    shapes + persistent cache as the device-domain chaos driver)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={N_DEV}").strip()
+    from stellar_tpu.utils.cpu_backend import force_cpu
+    force_cpu(compilation_cache_dir=os.environ.get(
+        "DEVICE_DOMAIN_JAX_CACHE",
+        "/tmp/stellar_tpu_devchaos_jaxcache"))
+
+
+def _corpus(n: int):
+    return [bytes(((7 * j + k) % 256) for k in range(40 + 13 * j))
+            for j in range(n)]
+
+
+def _ratio(a: int, b: int):
+    if max(a, b) == 0:
+        return None
+    return min(a, b) / max(a, b)
+
+
+def run() -> dict:
+    import hashlib
+
+    from stellar_tpu.crypto import batch_hasher as bh
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.parallel.mesh import batch_mesh
+    from stellar_tpu.utils import faults
+    from stellar_tpu.utils.metrics import registry
+    from stellar_tpu.utils.transfer_ledger import transfer_ledger
+
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit(
+            f"self-check needs a multi-device host (got {len(devs)}): "
+            "run with XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=4")
+    h = bh.BatchHasher(mesh=batch_mesh(), bucket_sizes=(BUCKET,))
+    bv.configure_dispatch(
+        deadline_ms=30_000, dispatch_retries=0,
+        failure_threshold=8, backoff_min_s=0.3, backoff_max_s=0.6,
+        audit_rate=0.25, device_failure_threshold=2,
+        device_backoff_min_s=0.2, device_backoff_max_s=0.5)
+    msgs = _corpus(BUCKET)
+    want = [hashlib.sha256(m).digest() for m in msgs]
+
+    # warm compile (clean), then the measured chaos window
+    mismatches = sum(1 for g, w in zip(h.hash_batch(msgs), want)
+                     if g != w)
+    before = transfer_ledger.totals()
+    faults.set_fault(faults.DISPATCH, "flaky-device", 0)
+    try:
+        # the SAME batch twice: the second resolve re-uploads content
+        # the first already shipped — redundant_constant_bytes is the
+        # re-upload smoking gun the ledger exists to count
+        for _ in range(2):
+            mismatches += sum(
+                1 for g, w in zip(h.hash_batch(msgs), want) if g != w)
+    finally:
+        fault_counters = faults.counters()
+        faults.clear()
+    after = transfer_ledger.totals()
+    with h._stats_lock:
+        shipped1, fetched1 = h.shipped_bytes, h.fetched_bytes
+
+    delta = {k: after[k] - before[k]
+             for k in ("round_trips", "bytes_h2d", "bytes_d2h",
+                       "device_puts", "fetches",
+                       "redundant_constant_bytes",
+                       "redundant_uploads")}
+    # reconciliation: ledger totals vs the engine's OWN shape-derived
+    # accounting, over the whole run (warm included on both sides)
+    rec_h2d = _ratio(after["bytes_h2d"], shipped1)
+    rec_d2h = _ratio(after["bytes_d2h"], fetched1)
+    reconciliation = min(x for x in (rec_h2d, rec_d2h)
+                         if x is not None) \
+        if (rec_h2d or rec_d2h) else None
+    prom = registry.to_prometheus()
+
+    problems = []
+    if mismatches:
+        problems.append(f"{mismatches} digests mismatched hashlib "
+                        "under the flap")
+    if delta["round_trips"] == 0:
+        problems.append("chaos window recorded zero round trips")
+    if delta["redundant_constant_bytes"] == 0:
+        problems.append("re-shipping an identical batch recorded zero "
+                        "redundant constant bytes")
+    if delta["bytes_h2d"] == 0 or delta["bytes_d2h"] == 0:
+        problems.append(f"byte accounting empty: {delta}")
+    if reconciliation is None or reconciliation < MIN_RECONCILE:
+        problems.append(
+            f"ledger/engine byte reconciliation {reconciliation} < "
+            f"{MIN_RECONCILE} (ledger h2d={after['bytes_h2d']} vs "
+            f"engine {shipped1}; d2h={after['bytes_d2h']} vs "
+            f"{fetched1})")
+    if not fault_counters.get("device.dispatch", {}).get("fired"):
+        problems.append("flaky-device:0 never fired — not a chaos "
+                        "window")
+    if "crypto_transfer_bytes_h2d" not in prom:
+        problems.append("transfer counters missing from the "
+                        "Prometheus exposition")
+    per_resolve = transfer_ledger.recent(2)
+    if not per_resolve:
+        problems.append("no per-resolve ledger records")
+
+    return {
+        "ok": not problems,
+        "devices": len(devs),
+        "bucket": BUCKET,
+        "round_trips": delta["round_trips"],
+        "bytes_h2d": delta["bytes_h2d"],
+        "bytes_d2h": delta["bytes_d2h"],
+        "device_puts": delta["device_puts"],
+        "fetches": delta["fetches"],
+        "redundant_constant_bytes": delta["redundant_constant_bytes"],
+        "redundant_uploads": delta["redundant_uploads"],
+        "reconciliation": round(reconciliation, 4)
+        if reconciliation is not None else None,
+        # scale-free redundancy fraction: comparable across probe and
+        # live windows, the quantity the sentinel guards against
+        # regrowth (resident tables drive it to ~0)
+        "redundancy_frac": round(
+            delta["redundant_constant_bytes"] /
+            max(1, delta["bytes_h2d"]), 4),
+        "engine_shipped_bytes": shipped1,
+        "engine_fetched_bytes": fetched1,
+        "last_resolves": per_resolve,
+        "workload": "sha256",
+        "chaos": "flaky-device:0",
+        "problems": problems,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="(default) print one JSON line")
+    args = ap.parse_args()  # noqa: F841 — flag kept for symmetry
+    _env_setup()
+    rec = run()
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
