@@ -323,18 +323,15 @@ def _dead_tunnel_attribution(n=128):
         return {"error": f"attribution probe failed: {e!r}"[:200]}
 
 
-def _transfer_ledger_probe(timeout_s: float = 480.0):
-    """Transfer-ledger section for a DEAD-TUNNEL record: run the
-    tier-1 reconciliation self-check (forced-4-device CPU chaos
-    resolve over the SHA-256 workload, flaky-device:0 armed) in a
-    subprocess and embed its record — round trips, bytes each way,
-    redundant constant re-upload bytes, and the ledger-vs-engine
-    reconciliation the sentinel guards (docs/observability.md
-    "Transfer ledger"). A subprocess so the forced device-count env
-    never leaks into this process."""
+def _selfcheck_probe(tool_name: str, label: str,
+                     timeout_s: float = 480.0):
+    """Run one tier-1 self-check tool (forced-4-device CPU chaos
+    resolve) in a subprocess and embed its JSON record in a
+    dead-tunnel bench record. A subprocess so the forced device-count
+    env never leaks into this process."""
     import subprocess
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tools", "transfer_selfcheck.py")
+                        "tools", tool_name)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     try:
@@ -343,7 +340,56 @@ def _transfer_ledger_probe(timeout_s: float = 480.0):
             capture_output=True, text=True, timeout=timeout_s)
         return json.loads(out.stdout.strip().splitlines()[-1])
     except Exception as e:
-        return {"error": f"transfer self-check failed: {e!r}"[:200]}
+        return {"error": f"{label} self-check failed: {e!r}"[:200]}
+
+
+def _transfer_ledger_probe(timeout_s: float = 480.0):
+    """Transfer-ledger section for a DEAD-TUNNEL record
+    (tools/transfer_selfcheck.py, the tier-1 TRANSFER_LEDGER_OK
+    gate): round trips, bytes each way, redundant constant re-upload
+    bytes, and the ledger-vs-engine reconciliation the sentinel
+    guards (docs/observability.md "Transfer ledger")."""
+    return _selfcheck_probe("transfer_selfcheck.py", "transfer",
+                            timeout_s)
+
+
+def _pipeline_probe(timeout_s: float = 480.0):
+    """Pipeline section for a DEAD-TUNNEL record
+    (tools/pipeline_selfcheck.py, the tier-1 PIPELINE_OBS_OK gate):
+    busy/overlap fractions, bubble attribution by class, and the
+    reconciliation the sentinel gates (docs/observability.md §9)."""
+    return _selfcheck_probe("pipeline_selfcheck.py", "pipeline",
+                            timeout_s)
+
+
+def _pipeline_totals_delta(before: dict, after: dict) -> dict:
+    """Live-record pipeline section: the profiler's process totals
+    over the measured blocking reps, with the derived busy/overlap
+    fractions and reconciliation the sentinel gates (next to
+    dispatch_attribution and transfer_ledger, so the async-dispatch
+    work reads utilization from the same record as the span split
+    and the byte counts)."""
+    d = {k: after.get(k, 0) - before.get(k, 0)
+         for k in ("resolves", "parts", "delivered",
+                   "device_wall_ms", "busy_ms", "prep_ms",
+                   "overlap_ms", "bubble_count")}
+    bubbles = {c: round(after.get("bubble_ms", {}).get(c, 0.0)
+                        - before.get("bubble_ms", {}).get(c, 0.0), 3)
+               for c in set(after.get("bubble_ms", {}))
+               | set(before.get("bubble_ms", {}))}
+    dev_wall = d["device_wall_ms"]
+    prep = d["prep_ms"]
+    out = {k: round(v, 3) if isinstance(v, float) else v
+           for k, v in d.items()}
+    out["bubble_ms"] = bubbles
+    out["busy_frac"] = round(d["busy_ms"] / dev_wall, 4) \
+        if dev_wall > 0 else None
+    out["overlap_frac"] = round(d["overlap_ms"] / prep, 4) \
+        if prep > 0 else None
+    out["reconciliation"] = round(
+        (d["busy_ms"] + sum(bubbles.values())) / dev_wall, 4) \
+        if dev_wall > 0 else None
+    return out
 
 
 def _transfer_totals_delta(before: dict, after: dict) -> dict:
@@ -453,6 +499,11 @@ def main():
             # re-uploads), from the forced-4-device reconciliation
             # probe — measured even with the tunnel dead
             "transfer_ledger": _transfer_ledger_probe(),
+            # pipeline utilization/bubble record from the forced-
+            # 4-device bubble-profiler probe — busy/overlap fractions
+            # measured even with the tunnel dead, so the sentinel's
+            # pipeline rules always have a trajectory
+            "pipeline": _pipeline_probe(),
             # stream behavior from the latest live soak window
             # (tools/soak.py --emit-bench-service)
             "service": _service_capture(),
@@ -492,10 +543,12 @@ def main():
     # dispatch-floor PR starts from "relay = X ms, fetch = Y ms", not
     # one opaque number (docs/observability.md)
     from stellar_tpu.utils import tracing
+    from stellar_tpu.utils.timeline import pipeline_timeline
     from stellar_tpu.utils.transfer_ledger import transfer_ledger
     served_before = batch_verifier.served_counts()
     spans_before = tracing.span_totals()
     transfer_before = transfer_ledger.totals()
+    pipeline_before = pipeline_timeline.totals()
     blocking = []
     for _ in range(BLOCKING_REPS):
         t0 = time.perf_counter()
@@ -506,6 +559,8 @@ def main():
         spans_before, tracing.span_totals(), reps=BLOCKING_REPS)
     transfer = _transfer_totals_delta(transfer_before,
                                       transfer_ledger.totals())
+    pipeline = _pipeline_totals_delta(pipeline_before,
+                                      pipeline_timeline.totals())
     transfer["reps"] = BLOCKING_REPS
     transfer["round_trips_per_rep"] = round(
         transfer["round_trips"] / BLOCKING_REPS, 3)
@@ -573,6 +628,10 @@ def main():
         # dispatch-floor demolition must delete (docs/observability.md
         # "Transfer ledger")
         "transfer_ledger": transfer,
+        # per-device busy/bubble utilization over the same reps — the
+        # async-dispatch before/after number the sentinel gates
+        # (docs/observability.md §9)
+        "pipeline": pipeline,
     }
     # Emit the core record NOW: the tunnel's observed failure mode is a
     # HANG (not an exception), so a wedge inside an optional phase would

@@ -55,6 +55,11 @@ SCOPE = [
     "stellar_tpu/utils/metrics.py",
     "stellar_tpu/utils/tracing.py",
     "stellar_tpu/utils/transfer_ledger.py",
+    # the pipeline-bubble profiler's tokens/ring mutate from
+    # submitter + resolver + service-dispatcher threads, and the
+    # time-series ring (inside metrics.py, already scoped) is sampled
+    # concurrently with resolving engines (ISSUE 10)
+    "stellar_tpu/utils/timeline.py",
     "tools/device_watch.py",
 ]
 

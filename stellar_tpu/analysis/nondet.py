@@ -224,7 +224,9 @@ ALLOWLIST = Allowlist({
         "nondet:clock":
             "time.monotonic() stamps admission and completion for the "
             "per-lane wait-time histograms (the p50/p99 the soak "
-            "harness publishes), and ages the adopter cool-down "
+            "harness publishes) and the SLO latency accounting that "
+            "consumes the SAME stamp (burn rates feed dashboards "
+            "only), and ages the adopter cool-down "
             "window (service_verified's wedged-dispatcher bypass). "
             "Neither reads decide a VERDICT: admission verdicts "
             "depend on bounded queue/byte budgets, scheduling order "

@@ -79,7 +79,8 @@ from stellar_tpu.utils.tracing import span
 __all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
            "SHED_LADDER", "configure_service", "default_service",
            "running_service", "service_verified", "service_health",
-           "lane_latencies"]
+           "lane_latencies", "SloMonitor", "slo_monitor",
+           "configure_slo", "slo_health"]
 
 # re-export: the typed admission verdict lives with the resilience
 # primitives so TrickleBatcher can raise it without a module cycle
@@ -120,6 +121,214 @@ SHED_LADDER = {
 SHED_HIGHWATER_FRAC = 0.75
 
 _defaults_lock = threading.Lock()
+
+# ---------------- per-lane SLO definitions (ISSUE 10) ----------------
+# Service-level objectives per lane, Config-pushed (VERIFY_SLO_*):
+# a LATENCY objective ("<target> of items complete their lane wait
+# under <bound> ms") and a COMPLETION objective ("at most
+# <shed budget> of items may be shed/rejected/failed"). The bulk
+# lane's generous shed budget is DESIGN, not tolerance — the ladder
+# sheds flood backlog on purpose; scp's near-zero budget is the
+# consensus-lane contract (the ladder never sheds it, only its own
+# ingress bounds can reject). Burn rate = observed bad fraction over
+# the sliding window / budgeted bad fraction: 1.0 = burning exactly
+# at budget, >1 = the error budget is being consumed faster than the
+# objective allows (SRE burn-rate semantics).
+
+SLO_WAIT_BOUND_MS = {
+    "scp": float(os.environ.get("VERIFY_SLO_SCP_P99_MS", "5000")),
+    "auth": float(os.environ.get("VERIFY_SLO_AUTH_P99_MS", "8000")),
+    "bulk": float(os.environ.get("VERIFY_SLO_BULK_P99_MS", "30000")),
+}
+SLO_LATENCY_TARGET = float(os.environ.get(
+    "VERIFY_SLO_LATENCY_TARGET", "0.99"))
+SLO_SHED_BUDGET = {
+    "scp": 0.001,   # consensus lane: effectively zero tolerance
+    "auth": 0.05,
+    "bulk": float(os.environ.get("VERIFY_SLO_BULK_SHED_BUDGET",
+                                 "0.5")),
+}
+SLO_WINDOW = int(os.environ.get("VERIFY_SLO_WINDOW", "2048"))
+
+
+class SloMonitor:
+    """Sliding-window error-budget accounting per lane.
+
+    Windows are EVENT-COUNT sliding windows (the last ``window``
+    items), not wall-clock buckets: rotation is deterministic in
+    arrival order with zero clock reads, which keeps this module's
+    nondet posture unchanged — the only clock-derived input is the
+    per-item ``wait_ms`` already stamped for the lane histograms
+    (allowlisted), and SLO verdicts feed dashboards/burn-rate gauges
+    only, never a verify/shed decision.
+
+    A window that has not filled yet is MARKED (``partial: true``) in
+    every snapshot — a half-empty window's bad fraction is reported
+    with its denominator, never silently presented as a full-window
+    rate."""
+
+    def __init__(self, window: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._window = SLO_WINDOW if window is None \
+            else max(8, int(window))
+        # lane -> {"events": deque of 0/1 (1 = bad), "bad": int,
+        #          "total": int, "bad_total": int}
+        self._lat = {ln: self._fresh() for ln in LANES}
+        self._comp = {ln: self._fresh() for ln in LANES}
+
+    @staticmethod
+    def _fresh() -> dict:
+        return {"events": deque(), "bad": 0, "total": 0,
+                "bad_total": 0}
+
+    def configure(self, window: Optional[int] = None) -> None:
+        if window is None:
+            return
+        with self._lock:
+            self._window = max(8, int(window))
+            for table in (self._lat, self._comp):
+                for st in table.values():
+                    self._trim_locked(st)
+
+    def _trim_locked(self, st: dict) -> None:
+        while len(st["events"]) > self._window:
+            st["bad"] -= st["events"].popleft()
+
+    def _push_locked(self, st: dict, bad: bool, n: int) -> None:
+        flag = 1 if bad else 0
+        for _ in range(n):
+            st["events"].append(flag)
+        st["bad"] += flag * n
+        st["total"] += n
+        st["bad_total"] += flag * n
+        self._trim_locked(st)
+
+    def note_latency(self, lane: str, wait_ms: float,
+                     n: int = 1) -> None:
+        """``n`` items of ``lane`` completed with this lane wait."""
+        bad = wait_ms > SLO_WAIT_BOUND_MS.get(lane, math_inf)
+        with self._lock:
+            st = self._lat[lane]
+            self._push_locked(st, bad, n)
+            burn = self._burn_locked(
+                st, max(1e-9, 1.0 - SLO_LATENCY_TARGET))
+        # gauge refresh at the FEED site (outside the monitor lock):
+        # the Prometheus exposition and the time-series ring must
+        # carry live burn rates even when nothing polls the slo route
+        registry.gauge(
+            f"crypto.verify.service.slo.{lane}.latency_burn_rate"
+        ).set(burn)
+
+    def note_completion(self, lane: str, ok: bool,
+                        n: int = 1) -> None:
+        """``n`` items of ``lane`` reached a terminal state:
+        ``ok=False`` for shed / ingress-rejected / failed items (they
+        consume the lane's shed budget), True for verified ones."""
+        with self._lock:
+            st = self._comp[lane]
+            self._push_locked(st, not ok, n)
+            burn = self._burn_locked(
+                st, max(1e-9, SLO_SHED_BUDGET.get(lane, 0.05)))
+        registry.gauge(
+            f"crypto.verify.service.slo.{lane}.shed_burn_rate"
+        ).set(burn)
+
+    @staticmethod
+    def _burn_locked(st: dict, budget_frac: float) -> float:
+        n = len(st["events"])
+        return round((st["bad"] / n) / budget_frac, 4) if n else 0.0
+
+    def snapshot(self) -> dict:
+        """The ``slo`` admin-route payload: per lane, both objectives
+        with window accounting and burn rates. Also refreshes the
+        ``crypto.verify.service.slo.<lane>.*`` burn-rate gauges so
+        the Prometheus exposition (and the time-series ring) carry
+        live burn rates."""
+        with self._lock:
+            lanes = {}
+            for ln in LANES:
+                lat, comp = self._lat[ln], self._comp[ln]
+                lat_budget = max(1e-9, 1.0 - SLO_LATENCY_TARGET)
+                shed_budget = max(1e-9, SLO_SHED_BUDGET.get(ln, 0.05))
+                lanes[ln] = {
+                    "latency": self._objective_locked(
+                        lat, lat_budget,
+                        bound_ms=SLO_WAIT_BOUND_MS.get(ln),
+                        target=SLO_LATENCY_TARGET),
+                    "completion": self._objective_locked(
+                        comp, shed_budget, budget=shed_budget),
+                }
+            window = self._window
+        for ln, obj in lanes.items():
+            registry.gauge(
+                f"crypto.verify.service.slo.{ln}.latency_burn_rate"
+            ).set(obj["latency"]["burn_rate"])
+            registry.gauge(
+                f"crypto.verify.service.slo.{ln}.shed_burn_rate"
+            ).set(obj["completion"]["burn_rate"])
+        return {"window": window, "lanes": lanes}
+
+    def _objective_locked(self, st: dict, budget_frac: float,
+                          **extra) -> dict:
+        n = len(st["events"])
+        bad_frac = (st["bad"] / n) if n else 0.0
+        return {
+            "n": n,
+            "window": self._window,
+            "partial": n < self._window,
+            "bad": st["bad"],
+            "bad_frac": round(bad_frac, 6),
+            "budget_frac": round(budget_frac, 6),
+            "burn_rate": round(bad_frac / budget_frac, 4),
+            "total": st["total"],
+            "bad_total": st["bad_total"],
+            **extra,
+        }
+
+    def _reset_for_testing(self) -> None:
+        with self._lock:
+            self._lat = {ln: self._fresh() for ln in LANES}
+            self._comp = {ln: self._fresh() for ln in LANES}
+
+
+# inf without importing math at call sites (this module avoids new
+# imports on the hot path; float("inf") is a constant)
+math_inf = float("inf")
+
+# process-wide monitor (every service instance feeds it, like the
+# registry meters — one node per process in production)
+slo_monitor = SloMonitor()
+
+
+def configure_slo(scp_p99_ms: Optional[float] = None,
+                  auth_p99_ms: Optional[float] = None,
+                  bulk_p99_ms: Optional[float] = None,
+                  latency_target: Optional[float] = None,
+                  bulk_shed_budget: Optional[float] = None,
+                  window: Optional[int] = None) -> None:
+    """Push SLO knobs (Config / tests); None keeps the current
+    value."""
+    global SLO_LATENCY_TARGET
+    with _defaults_lock:
+        if scp_p99_ms is not None:
+            SLO_WAIT_BOUND_MS["scp"] = float(scp_p99_ms)
+        if auth_p99_ms is not None:
+            SLO_WAIT_BOUND_MS["auth"] = float(auth_p99_ms)
+        if bulk_p99_ms is not None:
+            SLO_WAIT_BOUND_MS["bulk"] = float(bulk_p99_ms)
+        if latency_target is not None:
+            SLO_LATENCY_TARGET = min(0.999999,
+                                     max(0.0, float(latency_target)))
+        if bulk_shed_budget is not None:
+            SLO_SHED_BUDGET["bulk"] = min(1.0, max(
+                1e-6, float(bulk_shed_budget)))
+    slo_monitor.configure(window=window)
+
+
+def slo_health() -> dict:
+    """The ``slo`` admin-route payload (served directly — overload is
+    exactly when burn rates matter)."""
+    return slo_monitor.snapshot()
 
 # ---------------- trace IDs (ISSUE 8) ----------------
 # Every submitted item gets a process-unique trace ID at ingress; a
@@ -324,6 +533,9 @@ class VerifyService:
                 registry.meter(
                     f"crypto.verify.service.lane.{lane}.rejected"
                 ).mark(n)
+                # a rejected item is a completion-SLO miss: it
+                # consumed the lane's shed/reject budget (ISSUE 10)
+                slo_monitor.note_completion(lane, ok=False, n=n)
                 batch_verifier.note_trace_event(
                     "service.reject", lane=lane, reason=reason,
                     traces=trange, items=n)
@@ -341,6 +553,7 @@ class VerifyService:
             self._queues[lane].append(tkt)
             self._queued_items[lane] += n
             self._queued_bytes[lane] += nbytes
+            self._publish_lane_gauges_locked(lane)
             # trace milestone: admitted into the lane queue (recorder
             # write routed through the engine — the tracing fence
             # keeps this module duration-blind). Emitted BEFORE the
@@ -436,6 +649,19 @@ class VerifyService:
     # _locked helpers are called with self._cv held (the repo-wide
     # naming contract the lock lint encodes).
 
+    def _publish_lane_gauges_locked(self, ln: str) -> None:
+        """Live backlog gauges (ISSUE 10 satellite): queue depth and
+        queued+in-flight bytes per lane ride the Prometheus
+        exposition, so an operator sees backlog BUILDING before the
+        shed ladder fires — the wait histograms only show it after
+        the fact."""
+        registry.gauge(
+            f"crypto.verify.service.lane.{ln}.depth").set(
+            len(self._queues[ln]))
+        registry.gauge(
+            f"crypto.verify.service.lane.{ln}.bytes").set(
+            self._queued_bytes[ln] + self._inflight_bytes[ln])
+
     def _pressure_locked(self) -> tuple:
         """(level, why): 2 = dispatch degraded (global breaker open /
         host-only — capacity collapsed to the host oracle), 1 = bulk
@@ -480,6 +706,8 @@ class VerifyService:
                 registry.meter(
                     f"crypto.verify.service.lane.{ln}.shed"
                 ).mark(tkt.n_items)
+                slo_monitor.note_completion(ln, ok=False,
+                                            n=tkt.n_items)
                 if not self._shed_seen:
                     self._shed_seen = True
                     onset = why
@@ -492,6 +720,7 @@ class VerifyService:
                     kind="shed", lane=ln, reason=why,
                     trace_ids=tkt.trace_ids))
             self._queues[ln] = kept
+            self._publish_lane_gauges_locked(ln)
         return onset
 
     def _abort_queues_locked(self) -> None:
@@ -509,6 +738,8 @@ class VerifyService:
                 registry.meter(
                     f"crypto.verify.service.lane.{ln}.shed"
                 ).mark(tkt.n_items)
+                slo_monitor.note_completion(ln, ok=False,
+                                            n=tkt.n_items)
                 batch_verifier.note_trace_event(
                     "service.shed", lane=ln, reason="stopped",
                     traces=[[tkt.trace_lo,
@@ -517,6 +748,7 @@ class VerifyService:
                     "service stopped without drain", kind="shed",
                     lane=ln, reason="stopped",
                     trace_ids=tkt.trace_ids))
+            self._publish_lane_gauges_locked(ln)
 
     def _pick_lane_locked(self) -> Optional[str]:
         """Priority order, with sequence-based aging: every
@@ -560,8 +792,10 @@ class VerifyService:
             self._inflight_bytes[ln] += tkt._nbytes
         self._inflight_items += len(items)
         self._batches += 1
-        registry.gauge(
-            f"crypto.verify.service.depth.{ln}").set(len(q))
+        # (the pre-ISSUE-10 `crypto.verify.service.depth.<lane>`
+        # gauge is superseded by `lane.<lane>.depth`, published at
+        # every queue transition instead of only at batch pick)
+        self._publish_lane_gauges_locked(ln)
         return (ln, items, parts, tids)
 
     def _resolve_one(self, ln: str, parts, resolver,
@@ -586,9 +820,11 @@ class VerifyService:
                 self._inflight_items -= n
                 self._inflight_bytes[ln] -= nbytes
                 self._counts[ln]["failed"] += n
+                self._publish_lane_gauges_locked(ln)
             registry.meter("crypto.verify.service.failed").mark(n)
             registry.meter(
                 f"crypto.verify.service.lane.{ln}.failed").mark(n)
+            slo_monitor.note_completion(ln, ok=False, n=n)
             batch_verifier.note_trace_event(
                 "service.verdict", lane=ln, failed=True,
                 traces=traces or [], items=n)
@@ -599,9 +835,11 @@ class VerifyService:
             self._inflight_items -= n
             self._inflight_bytes[ln] -= nbytes
             self._counts[ln]["verified"] += n
+            self._publish_lane_gauges_locked(ln)
         registry.meter("crypto.verify.service.verified").mark(n)
         registry.meter(
             f"crypto.verify.service.lane.{ln}.verified").mark(n)
+        slo_monitor.note_completion(ln, ok=True, n=n)
         # trace milestone: each verdict carries its trace — the END of
         # the trace route's reconstructed timeline
         batch_verifier.note_trace_event(
@@ -611,7 +849,12 @@ class VerifyService:
         timer = registry.timer(
             f"crypto.verify.service.lane.{ln}.wait_ms")
         for tkt, off in parts:
-            timer.update_ms((now - tkt._t_enq) * 1000.0)
+            wait_ms = (now - tkt._t_enq) * 1000.0
+            timer.update_ms(wait_ms)
+            # SLO accounting (ISSUE 10): the latency objective reads
+            # the SAME allowlisted stamp the histogram does; the
+            # verdict below never depends on it
+            slo_monitor.note_latency(ln, wait_ms, n=tkt.n_items)
             tkt._fut.set_result(
                 np.array(out[off:off + tkt.n_items], dtype=bool))
 
@@ -676,9 +919,11 @@ class VerifyService:
             self._inflight_items -= n
             self._inflight_bytes[ln] -= nbytes
             self._counts[ln]["failed"] += n
+            self._publish_lane_gauges_locked(ln)
         registry.meter("crypto.verify.service.failed").mark(n)
         registry.meter(
             f"crypto.verify.service.lane.{ln}.failed").mark(n)
+        slo_monitor.note_completion(ln, ok=False, n=n)
         batch_verifier.note_trace_event(
             "service.verdict", lane=ln, failed=True,
             traces=traces or [], items=n)

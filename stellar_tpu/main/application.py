@@ -340,6 +340,46 @@ class Application:
                 resolves=config.TRANSFER_LEDGER_RESOLVES,
                 fingerprints=config.TRANSFER_LEDGER_FINGERPRINTS,
                 fp_max_bytes=config.TRANSFER_LEDGER_FP_MAX_BYTES)
+        # pipeline-bubble profiler + time-series ring + SLO knobs
+        # (docs/observability.md §9)
+        if changed("PIPELINE_TIMELINE_RESOLVES"):
+            from stellar_tpu.utils.timeline import pipeline_timeline
+            pipeline_timeline.configure(
+                resolves=config.PIPELINE_TIMELINE_RESOLVES)
+        if changed("METRICS_TIMESERIES_SAMPLES") or \
+                changed("METRICS_TIMESERIES_INTERVAL_S") or \
+                changed("METRICS_ANOMALY_Z") or \
+                changed("METRICS_ANOMALY_SUSTAIN") or \
+                changed("METRICS_ANOMALY_MIN_SAMPLES"):
+            from stellar_tpu.utils.metrics import timeseries
+            timeseries.configure(
+                samples=config.METRICS_TIMESERIES_SAMPLES,
+                interval_s=config.METRICS_TIMESERIES_INTERVAL_S,
+                z=config.METRICS_ANOMALY_Z,
+                sustain=config.METRICS_ANOMALY_SUSTAIN,
+                min_samples=config.METRICS_ANOMALY_MIN_SAMPLES)
+        if config.METRICS_TIMESERIES_ENABLED:
+            # start-only, like VERIFY_SERVICE_ENABLED above: these are
+            # process-wide services and a later default-config node in
+            # a multi-node simulation must not stop one another node
+            # started (operators stop the sampler explicitly via
+            # timeseries.stop())
+            from stellar_tpu.utils.metrics import timeseries
+            timeseries.start()
+        if changed("VERIFY_SLO_SCP_P99_MS") or \
+                changed("VERIFY_SLO_AUTH_P99_MS") or \
+                changed("VERIFY_SLO_BULK_P99_MS") or \
+                changed("VERIFY_SLO_LATENCY_TARGET") or \
+                changed("VERIFY_SLO_BULK_SHED_BUDGET") or \
+                changed("VERIFY_SLO_WINDOW"):
+            from stellar_tpu.crypto import verify_service
+            verify_service.configure_slo(
+                scp_p99_ms=config.VERIFY_SLO_SCP_P99_MS,
+                auth_p99_ms=config.VERIFY_SLO_AUTH_P99_MS,
+                bulk_p99_ms=config.VERIFY_SLO_BULK_P99_MS,
+                latency_target=config.VERIFY_SLO_LATENCY_TARGET,
+                bulk_shed_budget=config.VERIFY_SLO_BULK_SHED_BUDGET,
+                window=config.VERIFY_SLO_WINDOW)
         if changed("ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING"):
             from stellar_tpu.bucket import bucket_list as bl_mod
             bl_mod.REDUCE_MERGE_COUNTS = \

@@ -162,6 +162,44 @@ class CommandHandler:
         from stellar_tpu.crypto import verify_service
         return verify_service.service_health()
 
+    def cmd_pipeline(self, params):
+        """Pipeline-bubble profiler surface (ISSUE 10,
+        docs/observability.md §9): per-device busy/idle totals,
+        busy/overlap fractions, bubble attribution by class, and the
+        most recent per-resolve timelines (``pipeline?limit=N``).
+        Served directly — lock-protected module state, same policy
+        as ``dispatch``/``spans``."""
+        from stellar_tpu.utils.timeline import pipeline_timeline
+        try:
+            limit = int(params.get("limit", ["8"])[0])
+        except ValueError:
+            return {"error": "bad limit param"}
+        return pipeline_timeline.snapshot(limit=limit)
+
+    def cmd_timeseries(self, params):
+        """In-process metric time-series (ISSUE 10): the bounded
+        fixed-interval history ring plus the EWMA anomaly watcher's
+        recent firings. ``timeseries?series=<prefix>`` filters,
+        ``limit=N`` bounds samples per series (0 = all retained).
+        Partial windows are marked, never silently averaged. Served
+        directly (same policy as ``metrics``)."""
+        from stellar_tpu.utils.metrics import timeseries
+        try:
+            limit = int(params.get("limit", ["0"])[0])
+        except ValueError:
+            return {"error": "bad limit param"}
+        return timeseries.snapshot(
+            series=params.get("series", [None])[0], limit=limit)
+
+    def cmd_slo(self, params):
+        """Per-lane SLO burn rates (ISSUE 10): sliding-window
+        latency and completion error-budget accounting for every
+        verify-service lane. Served directly — burn rates matter
+        exactly when the node is under pressure (same policy as
+        ``service``)."""
+        from stellar_tpu.crypto import verify_service
+        return verify_service.slo_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -626,6 +664,8 @@ class CommandHandler:
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
         "dispatch": cmd_dispatch, "spans": cmd_spans,
         "trace": cmd_trace, "service": cmd_service,
+        "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
+        "slo": cmd_slo,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

@@ -238,6 +238,38 @@ class Config:
     # cost must stay bounded; skipped uploads are visible in the
     # ledger's unfingerprinted_uploads tally
     TRANSFER_LEDGER_FP_MAX_BYTES: int = 1 << 20
+    # pipeline-bubble profiler (docs/observability.md §9): bounded
+    # ring of per-resolve busy/idle timeline records behind the
+    # `pipeline` admin route and the bench `pipeline` section
+    PIPELINE_TIMELINE_RESOLVES: int = 256
+    # in-process metric time-series ring (docs/observability.md §9):
+    # fixed-interval snapshots of counters/gauges/timer quantiles,
+    # behind the `timeseries` admin route. The sampler thread is
+    # opt-in (ENABLED); the ring itself always accepts sample_once()
+    METRICS_TIMESERIES_ENABLED: bool = False
+    METRICS_TIMESERIES_SAMPLES: int = 512
+    METRICS_TIMESERIES_INTERVAL_S: float = 1.0
+    # EWMA z-score anomaly watcher over the sampled series: a
+    # deviation past Z for SUSTAIN consecutive samples (after a
+    # MIN_SAMPLES warm-up) fires a flight-recorder dump
+    # (`timeseries-anomaly:<series>`), so a regression is caught
+    # WHILE running, not only between committed bench records
+    METRICS_ANOMALY_Z: float = 6.0
+    METRICS_ANOMALY_SUSTAIN: int = 3
+    METRICS_ANOMALY_MIN_SAMPLES: int = 32
+    # per-lane verify-service SLOs (docs/observability.md §9): the
+    # latency objective is "LATENCY_TARGET of items complete their
+    # lane wait under the lane's bound"; the bulk completion
+    # objective budgets the deliberate shed ladder. Burn rates ride
+    # the `slo` admin route and the
+    # crypto.verify.service.slo.* gauges.
+    VERIFY_SLO_SCP_P99_MS: float = 5000.0
+    VERIFY_SLO_AUTH_P99_MS: float = 8000.0
+    VERIFY_SLO_BULK_P99_MS: float = 30000.0
+    VERIFY_SLO_LATENCY_TARGET: float = 0.99
+    VERIFY_SLO_BULK_SHED_BUDGET: float = 0.5
+    # sliding-window length (items) behind the SLO accounting
+    VERIFY_SLO_WINDOW: int = 2048
     # node-id strkey -> human name for quorum/log output (reference
     # VALIDATOR_NAMES; merged with names from VALIDATORS entries)
     VALIDATOR_NAMES: Dict[str, str] = field(default_factory=dict)

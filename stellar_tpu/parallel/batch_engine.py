@@ -80,6 +80,7 @@ from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.parallel import device_health
 from stellar_tpu.utils import faults, resilience, tracing
 from stellar_tpu.utils.metrics import registry
+from stellar_tpu.utils.timeline import pipeline_timeline
 from stellar_tpu.utils.transfer_ledger import transfer_ledger
 
 __all__ = [
@@ -643,7 +644,7 @@ class BatchEngine:
         return total
 
     def _dispatch_parts(self, arrays: tuple, b: int, chunk: int,
-                        tok=None, traces=None):
+                        tok=None, traces=None, ptok=None):
         """Split one padded bucket into per-device sub-chunks over the
         CURRENTLY HEALTHY devices — the degraded-mesh re-shard.
 
@@ -700,11 +701,15 @@ class BatchEngine:
             transfer_ledger.record_h2d_many(tok, subs, device=di)
             self._ship_accounting(subs)
             arr = self._dispatch_one(placed, bsize=sub, dev_idx=di)
+            if arr is not None:
+                # pipeline timeline: a COMMITTED kernel call opens
+                # this device's busy interval (ISSUE 10)
+                pipeline_timeline.note_dispatch(ptok, di)
             parts.append([lo, hi, di, arr])
         return parts
 
     def _dispatch_device(self, *encoded: np.ndarray, tok=None,
-                         trace_ids=None):
+                         trace_ids=None, ptok=None):
         """Dispatch padded/chunked batches to the jitted kernel without
         blocking; returns a list of (slice, chunk_len, parts) where
         parts are per-device sub-chunk records (single-device hosts get
@@ -732,8 +737,11 @@ class BatchEngine:
                 # built ONLY for chunks that will actually dispatch:
                 # a host-only or breaker-refused chunk must not pay
                 # bucket-sized copies it never reads (nor charge
-                # them to the bucket phase of the attribution)
-                with tracing.span(f"{self._span_ns}.bucket"):
+                # them to the bucket phase of the attribution).
+                # Pipeline-wise the padding build is host PREP: a
+                # device idle while it runs is a prep bubble.
+                with tracing.span(f"{self._span_ns}.bucket"), \
+                        pipeline_timeline.host_phase(ptok, "prep"):
                     return tuple(
                         np.concatenate([x[sl], np.repeat(p, pad, 0)])
                         for x, p in zip(encoded, pads))
@@ -758,7 +766,8 @@ class BatchEngine:
                     with tracing.span(f"{self._span_ns}.dispatch",
                                       **_span_attrs(devices=True)):
                         parts = self._dispatch_parts(
-                            arrays, b, chunk, tok=tok, traces=tr)
+                            arrays, b, chunk, tok=tok, traces=tr,
+                            ptok=ptok)
                 else:
                     registry.counter(
                         "crypto.verify.dispatch.short_circuit").inc()
@@ -772,6 +781,8 @@ class BatchEngine:
                     transfer_ledger.record_h2d_many(tok, arrays)
                     self._ship_accounting(arrays)
                     arr = self._dispatch_one(arrays, b, None)
+                    if arr is not None:
+                        pipeline_timeline.note_dispatch(ptok, None)
                 parts = [[0, chunk, None, arr]]
             else:
                 registry.counter(
@@ -810,7 +821,13 @@ class BatchEngine:
         n = len(items)
         if n == 0:
             return lambda: self._plugin.empty_result(0)
-        gate, encoded = self._prep(items)
+        # pipeline timeline (ISSUE 10): the token's lifetime IS the
+        # resolve wall; a gate-empty early return simply drops it
+        # (begin registers nothing — same policy as the transfer
+        # ledger's tokens)
+        ptok = pipeline_timeline.begin(self._ns)
+        with pipeline_timeline.host_phase(ptok, "prep"):
+            gate, encoded = self._prep(items)
         if not gate.any():
             # no row's outcome depends on device bits: the plugin
             # finalizes (gate-fail fill / host hashing) without a
@@ -820,7 +837,8 @@ class BatchEngine:
         trace_ids = list(trace_ids) if trace_ids is not None else None
         tok = transfer_ledger.begin(self._ns)
         pending = self._dispatch_device(*encoded, tok=tok,
-                                        trace_ids=trace_ids)
+                                        trace_ids=trace_ids,
+                                        ptok=ptok)
         items = list(items)  # pinned for possible host re-computation
 
         def _part_traces(gl: int, gh: int):
@@ -842,7 +860,8 @@ class BatchEngine:
             atr = _part_traces(gl, gh)
             if atr:
                 audit_attrs["traces"] = atr
-            with tracing.span(f"{self._span_ns}.audit", **audit_attrs):
+            with tracing.span(f"{self._span_ns}.audit", **audit_attrs), \
+                    pipeline_timeline.host_phase(ptok, "audit"):
                 material = b"".join(x[gl:gh].tobytes() for x in encoded)
                 eligible = [i for i in range(gh - gl) if gate[gl + i]]
                 idxs = audit_mod.sample_rows(material, eligible,
@@ -875,6 +894,7 @@ class BatchEngine:
             for sl, chunk, parts in pending:
                 for lo, hi, di, arr in parts:
                     got = None
+                    accepted = False
                     ptr = _part_traces(sl.start + lo, sl.start + hi)
                     # _host_only is re-read PER PART: once any part's
                     # audit proves corruption, the remaining
@@ -902,7 +922,9 @@ class BatchEngine:
                             if ptr:
                                 fetch_attrs["traces"] = ptr
                             with tracing.span(f"{self._span_ns}.fetch",
-                                              **fetch_attrs):
+                                              **fetch_attrs), \
+                                    pipeline_timeline.host_phase(
+                                        ptok, "fetch"):
                                 try:
                                     got = resilience.call_with_deadline(
                                         lambda d=arr, i=di:
@@ -932,13 +954,17 @@ class BatchEngine:
                     if got is not None:
                         full = np.asarray(got)
                         vals = full[:hi - lo]
-                        # both accountings record DELIVERED results at
-                        # this one point, so a deadline-missed fetch
-                        # that later completes on the abandoned pool
-                        # worker can never skew ledger-vs-engine
-                        # reconciliation
+                        # all three accountings record DELIVERED
+                        # results at this one point, so a
+                        # deadline-missed fetch that later completes
+                        # on the abandoned pool worker can never skew
+                        # ledger-vs-engine reconciliation (nor close
+                        # a busy interval the engine already gave up
+                        # on)
                         transfer_ledger.record_d2h(tok, full,
                                                    device=di)
+                        pipeline_timeline.note_delivery(ptok, di)
+                        accepted = True
                         fetched = int(np.prod(full.shape)) * \
                             full.dtype.itemsize
                         with self._stats_lock:
@@ -982,6 +1008,16 @@ class BatchEngine:
                                 _breaker.record_success()
                             self._mark_served("device", hi - lo, di)
                     if got is None:
+                        if arr is not None and not accepted:
+                            # a dispatched part the engine gave up on
+                            # (deadline miss, fetch exception, breaker
+                            # short-circuit, host-only flip): its busy
+                            # interval closes HERE, never by the
+                            # abandoned pool worker — an audit
+                            # mismatch, by contrast, was genuinely
+                            # delivered and already closed above
+                            pipeline_timeline.note_delivery(
+                                ptok, di, delivered=False)
                         # failover: bit-identical host re-computation
                         # of the affected rows (latency changes,
                         # results never do)
@@ -990,7 +1026,9 @@ class BatchEngine:
                             fb_attrs["traces"] = ptr
                         with tracing.span(
                                 f"{self._span_ns}.host_fallback",
-                                **fb_attrs):
+                                **fb_attrs), \
+                                pipeline_timeline.host_phase(
+                                    ptok, "host_fallback"):
                             out[gl:gh] = self._plugin.host_result(
                                 items[gl:gh])
                         self._mark_served("host-fallback", hi - lo)
@@ -1001,8 +1039,12 @@ class BatchEngine:
                 try:
                     return _resolve_impl()
                 finally:
-                    # close the per-resolve transfer record (idempotent)
-                    transfer_ledger.finish(tok)
+                    # close the per-resolve transfer + pipeline
+                    # records (both idempotent); the transfer record
+                    # rides the pipeline ring entry so one record
+                    # carries bytes AND utilization
+                    pipeline_timeline.finish(
+                        ptok, transfer=transfer_ledger.finish(tok))
 
         return resolve
 
@@ -1175,6 +1217,7 @@ def _reset_dispatch_state_for_testing() -> None:
     _breaker.record_success()  # closed, zero failures, backoff reset
     device_health.get()._reset_for_testing()
     transfer_ledger._reset_for_testing()
+    pipeline_timeline._reset_for_testing()
 
 
 def _auto_mesh():

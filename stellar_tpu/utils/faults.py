@@ -33,7 +33,13 @@ the fault-domain chaos shapes of ``docs/robustness.md``):
 * ``corrupt-device:<idx>`` — never raises: calls succeed, but verdict
   arrays fetched from device ``idx`` come back BIT-FLIPPED via
   :func:`corrupt_verdicts` — the silently-corrupting-chip shape that
-  only the result-integrity audit can catch.
+  only the result-integrity audit can catch;
+* ``stall-device:<idx>``   — never raises: sleeps
+  :data:`STALL_DEVICE_SECONDS` (``set_fault(..., seconds=)``
+  overrides) before every call attributed to device ``idx`` — the
+  host-side inter-dispatch stall shape the pipeline-bubble profiler
+  must attribute as a bubble (ISSUE 10,
+  ``tools/pipeline_selfcheck.py``).
 
 Production code attributes a call to a device by passing
 ``inject(point, device=i)``; calls with ``device=None`` (single-device
@@ -64,8 +70,13 @@ DISPATCH = "device.dispatch"
 RESOLVE = "device.resolve"
 
 _MODES = ("raise", "hang", "flake", "failn",
-          "fail-device", "flaky-device", "corrupt-device")
-_DEVICE_MODES = ("fail-device", "flaky-device", "corrupt-device")
+          "fail-device", "flaky-device", "corrupt-device",
+          "stall-device")
+_DEVICE_MODES = ("fail-device", "flaky-device", "corrupt-device",
+                 "stall-device")
+
+# default sleep for stall-device (set_fault's ``seconds`` overrides)
+STALL_DEVICE_SECONDS = 0.05
 
 _lock = threading.Lock()
 _active: Dict[str, "_Fault"] = {}
@@ -78,7 +89,8 @@ class FaultInjected(RuntimeError):
 
 
 class _Fault:
-    def __init__(self, point: str, mode: str, arg: Optional[float]):
+    def __init__(self, point: str, mode: str, arg: Optional[float],
+                 seconds: Optional[float] = None):
         if mode not in _MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
                              f"(one of {_MODES})")
@@ -88,6 +100,7 @@ class _Fault:
         self.point = point
         self.mode = mode
         self.arg = arg
+        self.seconds = seconds
         self.calls = 0   # times the injection point was reached
         self.fired = 0   # times it actually misbehaved
 
@@ -103,7 +116,8 @@ class _Fault:
         with _lock:
             self.calls += 1
             n = self.calls
-        if self.mode in ("raise", "hang", "fail-device"):
+        if self.mode in ("raise", "hang", "fail-device",
+                         "stall-device"):
             fire = True
         elif self.mode in ("flake", "flaky-device"):
             k = 2 if self.mode == "flaky-device" else \
@@ -117,6 +131,13 @@ class _Fault:
             self.fired += 1
         if self.mode == "hang":
             time.sleep(float(self.arg) if self.arg is not None else 30.0)
+            return
+        if self.mode == "stall-device":
+            # a stall, not a failure: the dispatch proceeds after the
+            # sleep — the bubble profiler must SEE the delay, nothing
+            # in the fault-tolerance machinery should trip on it
+            time.sleep(self.seconds if self.seconds is not None
+                       else STALL_DEVICE_SECONDS)
             return
         raise FaultInjected(f"injected fault at {self.point} "
                             f"({self.mode}, call #{n})")
@@ -157,9 +178,11 @@ def is_active(point: str) -> bool:
     return point in _active
 
 
-def set_fault(point: str, mode: str, arg: Optional[float] = None) -> None:
-    """Arm ``point`` with ``mode`` (see module docstring)."""
-    f = _Fault(point, mode, arg)
+def set_fault(point: str, mode: str, arg: Optional[float] = None,
+              seconds: Optional[float] = None) -> None:
+    """Arm ``point`` with ``mode`` (see module docstring);
+    ``seconds`` overrides the stall-device sleep."""
+    f = _Fault(point, mode, arg, seconds=seconds)
     with _lock:
         _active[point] = f
 

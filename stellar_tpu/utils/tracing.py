@@ -305,8 +305,13 @@ class FlightRecorder:
         from the records' PARENT LINKS (same-thread), not from interval
         arithmetic, and child intervals are clamped inside their
         parent's, so float rounding can never emit a crossing
-        begin/end pair. Served by ``spans?format=chrome`` and the
-        ``tools/trace_export.py`` CLI (docs/observability.md)."""
+        begin/end pair. Counter tracks (``C`` events) from the
+        pipeline-bubble profiler ride alongside — per-device in-flight
+        state, busy fractions and cumulative transfer bytes share the
+        span clock, so one chrome://tracing load shows spans, bytes
+        AND utilization (ISSUE 10). Served by ``spans?format=chrome``
+        and the ``tools/trace_export.py`` CLI
+        (docs/observability.md)."""
         with self._lock:
             done = [dict(r) for r in self._ring]
             open_ = [dict(r, open=True)
@@ -372,6 +377,15 @@ class FlightRecorder:
                            "tid": tid_of(r["thread"]), "s": "t",
                            "ts": round(r["start_ms"] * 1000.0, 1),
                            "args": args})
+        # pipeline utilization + transfer-byte counter tracks
+        # (ISSUE 10): lazy import — timeline imports this module at
+        # load time, and the export path only ever runs long after
+        # both are imported
+        try:
+            from stellar_tpu.utils.timeline import pipeline_timeline
+            events += pipeline_timeline.chrome_counter_events()
+        except ImportError:  # pragma: no cover — import-order edge
+            pass
         meta = [{"name": "thread_name", "ph": "M", "pid": 1,
                  "tid": tid, "args": {"name": thread}}
                 for thread, tid in sorted(tids.items(),

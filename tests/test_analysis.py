@@ -629,6 +629,20 @@ def test_lint_scopes_cover_transfer_ledger_and_sentinel():
         assert mod not in nondet.ALLOWLIST._entries, mod
 
 
+def test_lint_scopes_cover_pipeline_timeline():
+    """ISSUE 10: the pipeline-bubble profiler's tokens and ring
+    mutate from submitter + resolver + service-dispatcher threads —
+    lock-lint scoped. It is deliberately NOT in the nondet scope: it
+    is clock-bearing observability BY DESIGN (like tracing), and the
+    engine reaches it only through the duration-blind token API, so
+    no clock value ever flows back into a scoped module."""
+    assert "stellar_tpu/utils/timeline.py" in set(locks.SCOPE)
+    assert "stellar_tpu/utils/timeline.py" not in \
+        set(nondet.HOST_ORACLE_FILES)
+    # the time-series ring lives inside metrics.py — already scoped
+    assert "stellar_tpu/utils/metrics.py" in set(locks.SCOPE)
+
+
 def test_sha256_overflow_golden_committed():
     """ISSUE 7: the hash workload gets the verify kernel's discipline —
     a committed proven envelope, diffed (not pass/failed) by

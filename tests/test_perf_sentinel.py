@@ -39,6 +39,8 @@ def _record(**over):
             "auth": {"p99_ms": 30.0},
             "bulk": {"p99_ms": 200.0}},
             "conservation_gap": 0},
+        "pipeline": {"busy_frac": 0.8, "overlap_frac": 0.2,
+                     "reconciliation": 0.99},
     }
     for path, val in over.items():
         cur = rec
@@ -104,6 +106,54 @@ def test_zero_baseline_skips_growth_rule():
     assert out["ok"], out["findings"]
     assert any(s.get("reason") == "zero-baseline"
                for s in out["skipped"])
+
+
+def test_pipeline_busy_frac_regression_fails_small_drop_passes():
+    """ISSUE 10: busy_frac is max-regression 10% — a 25% drop (more
+    device idle per resolve) fails, a 6% drop is wall-clock noise."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"pipeline.busy_frac": 0.6}))
+    assert any(f["path"] == "pipeline.busy_frac"
+               for f in out["findings"])
+    out = sentinel.apply_rules(
+        _record(), _record(**{"pipeline.busy_frac": 0.75}))
+    assert out["ok"], out["findings"]
+
+
+def test_pipeline_busy_frac_zero_baseline_skips():
+    out = sentinel.apply_rules(
+        _record(**{"pipeline.busy_frac": 0.0}),
+        _record(**{"pipeline.busy_frac": 0.8}))
+    assert out["ok"], out["findings"]
+    assert any(s.get("path") == "pipeline.busy_frac" and
+               s.get("reason") == "zero-baseline"
+               for s in out["skipped"])
+
+
+def test_pipeline_overlap_min_delta():
+    """overlap_frac is an ABSOLUTE min-delta (meaningful off a 0.0
+    baseline — today's blocking engine overlaps nothing): a drop past
+    the 0.05 delta fails, improvement and small noise pass."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"pipeline.overlap_frac": 0.1}))
+    assert any(f["path"] == "pipeline.overlap_frac"
+               for f in out["findings"])
+    for head in (0.17, 0.9):
+        out = sentinel.apply_rules(
+            _record(), _record(**{"pipeline.overlap_frac": head}))
+        assert out["ok"], out["findings"]
+    # a zero baseline passes trivially (never skipped: h >= -tol)
+    out = sentinel.apply_rules(
+        _record(**{"pipeline.overlap_frac": 0.0}),
+        _record(**{"pipeline.overlap_frac": 0.0}))
+    assert out["ok"], out["findings"]
+
+
+def test_pipeline_reconciliation_floor():
+    out = sentinel.apply_rules(
+        _record(), _record(**{"pipeline.reconciliation": 0.8}))
+    assert any(f["path"] == "pipeline.reconciliation"
+               for f in out["findings"])
 
 
 def test_unproven_analysis_fails():

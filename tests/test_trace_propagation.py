@@ -301,6 +301,33 @@ def test_chrome_trace_route_serves_json():
                for e in out["traceEvents"])
 
 
+def test_chrome_counter_tracks_ride_export():
+    """ISSUE 10: pipeline busy/bubble + transfer-byte counter tracks
+    (``C`` events) merge into the Chrome export on the shared span
+    clock — and never break the nested-B/E golden-shape criterion."""
+    from stellar_tpu.utils.timeline import pipeline_timeline
+
+    pipeline_timeline._reset_for_testing()  # ring isolation: the
+    # cumulative byte track below asserts exact args
+    with tracing.span("around.pipeline"):
+        tok = pipeline_timeline.begin("demo")
+        with pipeline_timeline.host_phase(tok, "prep"):
+            pass
+        pipeline_timeline.note_dispatch(tok, 0)
+        pipeline_timeline.note_delivery(tok, 0)
+        pipeline_timeline.finish(tok, transfer={
+            "round_trips": 1, "bytes_h2d": 256, "bytes_d2h": 32,
+            "redundant_constant_bytes": 0})
+    out = _validate_chrome(tracing.flight_recorder.to_chrome_trace())
+    cs = [e for e in out["traceEvents"] if e["ph"] == "C"]
+    names = {e["name"] for e in cs}
+    assert "pipeline.dev0.inflight" in names
+    assert "pipeline.busy_frac" in names
+    assert "transfer.bytes" in names
+    byte_samples = [e for e in cs if e["name"] == "transfer.bytes"]
+    assert byte_samples[-1]["args"] == {"h2d": 256, "d2h": 32}
+
+
 def test_chrome_trace_cross_thread_child_is_own_track():
     """A span opened on a pool thread under a propagated context must
     not corrupt the submitter thread's B/E nesting — it renders on its

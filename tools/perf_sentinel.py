@@ -12,8 +12,15 @@ sentinel compares the newest record (HEAD) against the previous one
 * ``max_increase_frac`` — HEAD may exceed BASE by at most ``tol``
   (kernel-cost ledgers, transfer redundancy, lane latencies: bigger is
   worse);
+* ``max_decrease_frac`` — HEAD may fall short of BASE by at most
+  ``tol`` (pipeline busy fraction: smaller is worse). Zero baselines
+  are skipped and listed, like the increase rule;
+* ``max_decrease_abs`` — HEAD must be >= BASE - ``tol`` (pipeline
+  overlap fraction: an absolute min-delta, meaningful even off a 0.0
+  baseline — today's blocking engine overlaps nothing, and the
+  async-dispatch win must not silently erode once it lands);
 * ``min_value`` — HEAD must be at least ``tol`` (attribution coverage,
-  transfer reconciliation: the record's own quality gates);
+  transfer/pipeline reconciliation: the record's own quality gates);
 * ``require_true`` — HEAD must carry a truthy value (analysis proof
   state: a bench number from an unproven kernel is not quotable);
 * ``note_change`` — reported when BASE != HEAD, never fatal (the
@@ -98,6 +105,18 @@ RULES = [
      "bulk lane p99 wait grew >5x (the sheddable lane drifts widest)"),
     ("service.conservation_gap", "note_change", None,
      "service conservation gap changed (must stay 0)"),
+    # pipeline-bubble profiler (ISSUE 10): the async-dispatch PR's
+    # before/after numbers. busy_frac down = more device idle per
+    # resolve; overlap_frac down = host prep stopped hiding behind
+    # in-flight device work; reconciliation is the record's own
+    # hook-coverage self-check.
+    ("pipeline.busy_frac", "max_decrease_frac", 0.10,
+     "device busy fraction regressed >10% (pipeline bubbles grew)"),
+    ("pipeline.overlap_frac", "max_decrease_abs", 0.05,
+     "host/device overlap fraction dropped (async-dispatch win "
+     "eroding)"),
+    ("pipeline.reconciliation", "min_value", 0.95,
+     "pipeline timeline no longer reconciles resolve wall-clock"),
     # the headline itself, when both windows were live
     ("value", "max_increase_frac", 0.25,
      "blocking headline p50 regressed >25%"),
@@ -165,7 +184,7 @@ def apply_rules(base: dict, head: dict, rules=None) -> dict:
                 notes.append({"path": path, "base": b, "head": h,
                               "why": why})
             continue
-        if kind == "max_increase_frac":
+        if kind in ("max_increase_frac", "max_decrease_frac"):
             if not isinstance(b, (int, float)) or \
                     not isinstance(h, (int, float)):
                 skipped.append({"path": path, "reason": "non-numeric"})
@@ -178,8 +197,24 @@ def apply_rules(base: dict, head: dict, rules=None) -> dict:
                 skipped.append({"path": path,
                                 "reason": "zero-baseline"})
                 continue
-            ceiling = b * (1.0 + tol) if b >= 0 else b * (1.0 - tol)
-            if h > ceiling + 1e-9:
+            if kind == "max_increase_frac":
+                ceiling = b * (1.0 + tol) if b >= 0 else \
+                    b * (1.0 - tol)
+                drifted = h > ceiling + 1e-9
+            else:
+                floor = b * (1.0 - tol) if b >= 0 else b * (1.0 + tol)
+                drifted = h < floor - 1e-9
+            if drifted:
+                findings.append({"path": path, "rule": kind,
+                                 "base": b, "head": h, "tol": tol,
+                                 "why": why})
+            continue
+        if kind == "max_decrease_abs":
+            if not isinstance(b, (int, float)) or \
+                    not isinstance(h, (int, float)):
+                skipped.append({"path": path, "reason": "non-numeric"})
+                continue
+            if h < b - tol - 1e-9:
                 findings.append({"path": path, "rule": kind,
                                  "base": b, "head": h, "tol": tol,
                                  "why": why})

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""PIPELINE_OBS_OK self-check (run by ``tools/tier1.sh``; ISSUE 10).
+
+Proves the pipeline-bubble profiler end-to-end on a forced-4-device
+CHAOS resolve — CPU backend, the SHA-256 engine workload (scan-based
+kernel, compiles in seconds against the shared persistent cache) —
+with an INJECTED inter-dispatch stall (``stall-device:1``, a
+host-side sleep before device 1's kernel call):
+
+1. the stalled resolve's record must show the stall as a BUBBLE in
+   the correct class — ``queue_wait`` on the delayed device (the
+   device sat idle waiting for its dispatch while the host slept) —
+   with the largest bubble >= 80% of the injected stall;
+2. per-device busy + attributed bubbles must reconcile >= 95% of
+   n_devices x resolve wall-clock, AND the record's own wall must
+   agree >= 95% with an INDEPENDENTLY measured wall clock around the
+   resolve call — an unhooked dispatch/delivery path shows up here
+   as missing busy or a wall gap;
+3. a clean (stall-free) resolve must NOT show a comparable bubble —
+   the detector finds the stall, not its own noise floor;
+4. the ``crypto.pipeline.*`` metrics must ride the Prometheus
+   exposition, and the time-series ring must sample CONCURRENTLY with
+   the resolving engine without raising or tearing (partial windows
+   marked);
+5. digests stay bit-identical to hashlib throughout (a stall is a
+   delay, never a result change).
+
+Prints one JSON line whose top level carries the fields bench.py
+embeds as the dead-tunnel ``pipeline`` record section
+(``busy_frac`` / ``overlap_frac`` / ``reconciliation`` — the paths
+``tools/perf_sentinel.py`` gates); exit 0 = every check passed. See
+``docs/observability.md`` §9.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEV = 4
+BUCKET = 8
+STALL_S = 0.25
+MIN_RECONCILE = 0.95
+MIN_STALL_ATTRIBUTED = 0.8
+
+
+def _env_setup() -> None:
+    """CPU-only multi-device env — must run before jax imports (same
+    shapes + persistent cache as the device-domain chaos driver)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={N_DEV}").strip()
+    from stellar_tpu.utils.cpu_backend import force_cpu
+    force_cpu(compilation_cache_dir=os.environ.get(
+        "DEVICE_DOMAIN_JAX_CACHE",
+        "/tmp/stellar_tpu_devchaos_jaxcache"))
+
+
+def _corpus(i: int, n: int):
+    return [bytes(((7 * j + k + i) % 256)
+                  for k in range(40 + 13 * j))
+            for j in range(n)]
+
+
+def run() -> dict:
+    import hashlib
+
+    from stellar_tpu.crypto import batch_hasher as bh
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.parallel.mesh import batch_mesh
+    from stellar_tpu.utils import faults
+    from stellar_tpu.utils.metrics import registry, timeseries
+    from stellar_tpu.utils.timeline import pipeline_timeline
+
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit(
+            f"self-check needs a multi-device host (got {len(devs)}): "
+            "run with XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=4")
+    h = bh.BatchHasher(mesh=batch_mesh(), bucket_sizes=(BUCKET,))
+    bv.configure_dispatch(
+        deadline_ms=30_000, dispatch_retries=0,
+        failure_threshold=8, backoff_min_s=0.3, backoff_max_s=0.6,
+        audit_rate=0.25, device_failure_threshold=4,
+        device_backoff_min_s=0.2, device_backoff_max_s=0.5)
+
+    # concurrent time-series sampling (ISSUE 10 satellite: snapshot
+    # under load must never raise or tear) — a hammer thread drives
+    # sample_once + snapshot as fast as it can for the whole window
+    ts_errors = []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                timeseries.sample_once()
+                snap = timeseries.snapshot(series="crypto.")
+                for s in snap["series"].values():
+                    # a torn series would show samples beyond its
+                    # declared length
+                    assert len(s["samples"]) <= max(s["n"], 1)
+                # fast but not a busy-loop: a GIL-saturating spin
+                # would measure the hammer, not the engine
+                time.sleep(0.002)
+        except BaseException as e:  # surfaced as a problem below
+            ts_errors.append(repr(e)[:200])
+    t = threading.Thread(target=hammer, daemon=True,
+                         name="ts-hammer")
+    t.start()
+
+    def resolve(i):
+        msgs = _corpus(i, BUCKET)
+        want = [hashlib.sha256(m).digest() for m in msgs]
+        t0 = time.perf_counter()
+        got = h.hash_batch(msgs)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        mism = sum(1 for g, w in zip(got, want) if g != w)
+        return wall_ms, mism
+
+    # warm: compile + first-touch (its record is not measured)
+    _, mismatches = resolve(0)
+    # clean resolve: the stall detector's noise floor
+    clean_wall_ms, m = resolve(1)
+    mismatches += m
+    clean = pipeline_timeline.recent(1)[-1]
+    # stalled resolve: a host-side sleep before device 1's kernel
+    # call — devices dispatched after the sleep sit idle waiting
+    faults.set_fault(faults.DISPATCH, "stall-device", 1,
+                     seconds=STALL_S)
+    try:
+        stalled_wall_ms, m = resolve(2)
+        mismatches += m
+    finally:
+        fault_counters = faults.counters()
+        faults.clear()
+    stalled = pipeline_timeline.recent(1)[-1]
+    stop.set()
+    t.join(timeout=10)
+    ts_snap = timeseries.snapshot(series="crypto.pipeline")
+
+    stall_ms = STALL_S * 1000.0
+    prom = registry.to_prometheus()
+    wall_agreement = (min(stalled["wall_ms"], stalled_wall_ms)
+                      / max(stalled["wall_ms"], stalled_wall_ms, 1e-9))
+
+    problems = []
+    if mismatches:
+        problems.append(f"{mismatches} digests mismatched hashlib")
+    if stalled["n_devices"] < 2 or stalled["delivered"] == 0:
+        problems.append(
+            f"stalled resolve saw {stalled['n_devices']} devices / "
+            f"{stalled['delivered']} deliveries — hooks not firing")
+    if stalled["largest_bubble_class"] != "queue_wait":
+        problems.append(
+            "injected inter-dispatch stall attributed to "
+            f"{stalled['largest_bubble_class']!r}, expected "
+            "'queue_wait' (the delayed device waiting for its "
+            "dispatch)")
+    if stalled["largest_bubble_ms"] < MIN_STALL_ATTRIBUTED * stall_ms:
+        problems.append(
+            f"largest bubble {stalled['largest_bubble_ms']}ms < "
+            f"{MIN_STALL_ATTRIBUTED:.0%} of the injected "
+            f"{stall_ms:.0f}ms stall")
+    # DIFFERENTIAL detection: the stall must stand out ABOVE the
+    # clean resolve's own queue-wait floor (a loaded CI host has a
+    # real floor — executable loads, GIL contention — and an absolute
+    # bound would measure the host, not the detector)
+    excess = (stalled["bubbles"]["queue_wait"]
+              - clean["bubbles"]["queue_wait"])
+    if excess < MIN_STALL_ATTRIBUTED * stall_ms:
+        problems.append(
+            f"stalled-vs-clean queue_wait excess {excess:.1f}ms < "
+            f"{MIN_STALL_ATTRIBUTED:.0%} of the injected "
+            f"{stall_ms:.0f}ms stall — the stall did not stand out "
+            "above the noise floor")
+    if stalled["reconciliation"] is None or \
+            stalled["reconciliation"] < MIN_RECONCILE:
+        problems.append(
+            f"busy+bubble reconciliation {stalled['reconciliation']} "
+            f"< {MIN_RECONCILE}")
+    if wall_agreement < MIN_RECONCILE:
+        problems.append(
+            f"record wall {stalled['wall_ms']}ms disagrees with the "
+            f"independently measured {stalled_wall_ms:.1f}ms "
+            f"(agreement {wall_agreement:.3f} < {MIN_RECONCILE})")
+    if not fault_counters.get("device.dispatch", {}).get("fired"):
+        problems.append("stall-device:1 never fired — nothing was "
+                        "injected")
+    if "crypto_pipeline_resolves" not in prom or \
+            "crypto_pipeline_bubble_ms" not in prom:
+        problems.append("crypto.pipeline.* metrics missing from the "
+                        "Prometheus exposition")
+    if ts_errors:
+        problems.append("time-series sampling under load raised: "
+                        + "; ".join(ts_errors[:3]))
+    if ts_snap["sampling"]["ticks"] == 0:
+        problems.append("time-series ring never sampled during the "
+                        "window")
+
+    totals = pipeline_timeline.totals()
+    return {
+        "ok": not problems,
+        "devices": len(devs),
+        "bucket": BUCKET,
+        # the bench `pipeline` section fields the sentinel gates
+        # (clean-resolve values — a deliberate stall must not poison
+        # the gated trajectory numbers)
+        "busy_frac": clean["busy_frac"],
+        "overlap_frac": clean["overlap_frac"],
+        "reconciliation": clean["reconciliation"],
+        "bubbles": clean["bubbles"],
+        "largest_bubble_ms": clean["largest_bubble_ms"],
+        "largest_bubble_class": clean["largest_bubble_class"],
+        "wall_ms": clean["wall_ms"],
+        "stall": {
+            "injected_ms": stall_ms,
+            "largest_bubble_ms": stalled["largest_bubble_ms"],
+            "largest_bubble_class": stalled["largest_bubble_class"],
+            "queue_wait_ms": stalled["bubbles"]["queue_wait"],
+            "reconciliation": stalled["reconciliation"],
+            "wall_agreement": round(wall_agreement, 4),
+            "busy_frac": stalled["busy_frac"],
+        },
+        "totals": totals,
+        "timeseries": {"ticks": ts_snap["sampling"]["ticks"],
+                       "series": len(ts_snap["series"])},
+        "chaos": f"stall-device:1 ({STALL_S}s)",
+        "workload": "sha256",
+        "problems": problems,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="(default) print one JSON line")
+    args = ap.parse_args()  # noqa: F841 — flag kept for symmetry
+    _env_setup()
+    rec = run()
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -449,6 +449,21 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
 
 BENCH_SERVICE_CAPTURE = os.path.join(
     REPO, "docs", "bench_service_capture.json")
+TELEMETRY_REPORT = os.path.join(REPO, "docs", "telemetry_report.md")
+
+
+def emit_telemetry_report(path: str) -> None:
+    """Render this window's telemetry (time-series + pipeline
+    bubbles + SLO budgets + top traces) into one markdown report
+    (ISSUE 10 — ``tools/telemetry_report.py`` is the renderer; the
+    soak harness is its live-window producer)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import telemetry_report
+    text = telemetry_report.render_report(
+        telemetry_report.collect_local(),
+        title="Soak-window telemetry report")
+    with open(path, "w") as f:
+        f.write(text)
 
 
 def emit_bench_service(rec: dict, path: str) -> None:
@@ -505,11 +520,23 @@ def main() -> int:
                          "p50/p99 + conservation capture bench.py "
                          "embeds as its service record section "
                          f"(default path: {BENCH_SERVICE_CAPTURE})")
+    ap.add_argument("--emit-telemetry-report", nargs="?",
+                    const=TELEMETRY_REPORT, default=None,
+                    metavar="PATH",
+                    help="render this window's telemetry "
+                         "(time-series + pipeline bubbles + SLO "
+                         "burn rates + top traces) into one markdown "
+                         f"report (default path: {TELEMETRY_REPORT})")
     args = ap.parse_args()
     events = args.events or (
         "/tmp/_soak_events.jsonl" if args.smoke
         else os.path.join(REPO, "SOAK_EVENTS.jsonl"))
     _env_setup(args.real_device)
+    if args.emit_telemetry_report:
+        # sample the soak window itself: the report's time-series
+        # section reads this ring (ISSUE 10)
+        from stellar_tpu.utils.metrics import timeseries
+        timeseries.start(interval_s=0.25)
     if args.workload == "sha256":
         rec = run_sha256(args.smoke, args.duration, events)
     else:
@@ -518,6 +545,11 @@ def main() -> int:
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)
         rec["bench_service_capture"] = args.emit_bench_service
+    if args.emit_telemetry_report:
+        from stellar_tpu.utils.metrics import timeseries
+        timeseries.stop()
+        emit_telemetry_report(args.emit_telemetry_report)
+        rec["telemetry_report"] = args.emit_telemetry_report
     print(json.dumps(rec))
     return 0 if rec["ok"] else 1
 

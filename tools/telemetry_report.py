@@ -1,0 +1,358 @@
+#!/usr/bin/env python
+"""Telemetry report renderer (ISSUE 10): one markdown document for a
+soak window — time-series trajectories, pipeline busy/bubble
+attribution, per-lane SLO error budgets and burn rates, transfer
+totals, and the top end-to-end trace timelines.
+
+The numbers all exist individually (``timeseries`` / ``pipeline`` /
+``slo`` / ``service`` / ``trace`` admin routes), but a soak review
+reads ONE artifact: this tool stitches the same payloads into a
+human-readable report.
+
+Three sources:
+
+* ``--url http://127.0.0.1:11626`` — scrape a RUNNING node's admin
+  routes;
+* ``tools/soak.py --emit-telemetry-report [PATH]`` — the soak harness
+  calls :func:`collect_local` + :func:`render_report` in-process at
+  the end of a green window;
+* no URL — run a small synthetic in-process window (host-only verify
+  service flood + a scripted pipeline resolve + time-series sampling)
+  and render it: a self-contained demo plus a smoke test of the
+  renderer.
+
+``--out report.md`` writes the file (default stdout). See
+``docs/observability.md`` §9.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# series rendered in the time-series section, in PRIORITY order (the
+# row cap trims from the back, so burn rates and utilization survive
+# a lane-metric flood); every series outside the prefixes — or past
+# the cap — is counted in the footer, never silently absent
+REPORT_SERIES_PREFIXES = (
+    "crypto.verify.service.slo.",
+    "crypto.pipeline.",
+    "crypto.transfer.",
+    "crypto.verify.service.lane.",
+)
+MAX_SERIES_ROWS = 40
+TOP_TRACES = 3
+
+
+# ---------------- collection ----------------
+
+
+def collect_local(top_traces: int = TOP_TRACES) -> dict:
+    """Gather every section from this process's own observability
+    surfaces (the soak harness path)."""
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils import tracing
+    from stellar_tpu.utils.metrics import timeseries
+    from stellar_tpu.utils.timeline import pipeline_timeline
+    from stellar_tpu.utils.transfer_ledger import transfer_ledger
+
+    traces = []
+    for tid in _recent_trace_ids(
+            tracing.flight_recorder.snapshot(limit=256)["recent"],
+            top_traces):
+        traces.append(tracing.flight_recorder.trace_timeline(tid))
+    return {
+        "slo": vs.slo_health(),
+        "service": vs.service_health(),
+        "pipeline": pipeline_timeline.snapshot(limit=4),
+        "timeseries": timeseries.snapshot(),
+        "transfer": transfer_ledger.totals(),
+        "traces": traces,
+    }
+
+
+def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
+    """Scrape a running node's admin routes into the same shape."""
+    import urllib.request
+
+    def get(route):
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/" + route, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    spans = get("spans?limit=256")
+    traces = []
+    for tid in _recent_trace_ids(spans.get("recent", []), top_traces):
+        traces.append(get(f"trace?id={tid}"))
+    dispatch = get("dispatch")
+    return {
+        "slo": get("slo"),
+        "service": get("service"),
+        "pipeline": get("pipeline?limit=4"),
+        "timeseries": get("timeseries"),
+        "transfer": dispatch.get("transfer", {}),
+        "traces": traces,
+    }
+
+
+def _recent_trace_ids(records, n: int) -> list:
+    """The last ``n`` distinct trace IDs that reached a verdict,
+    newest first (one exemplar per verdict event's first range)."""
+    ids = []
+    for rec in reversed(records):
+        if rec.get("name") != "service.verdict":
+            continue
+        for pair in (rec.get("attrs") or {}).get("traces") or ():
+            try:
+                lo = int(pair[0])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if lo not in ids:
+                ids.append(lo)
+            break
+        if len(ids) >= n:
+            break
+    return ids
+
+
+# ---------------- rendering ----------------
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _series_stats(samples):
+    vals = [v for _t, v in samples]
+    if not vals:
+        return None
+    return {"n": len(vals), "min": min(vals),
+            "mean": sum(vals) / len(vals), "max": max(vals),
+            "last": vals[-1]}
+
+
+def render_report(data: dict, title: str = "Telemetry report") -> str:
+    lines = [f"# {title}", ""]
+
+    # ---- SLO burn rates ----
+    slo = data.get("slo") or {}
+    lines += ["## SLO error budgets and burn rates", ""]
+    lanes = slo.get("lanes") or {}
+    if lanes:
+        lines += [f"Sliding window: last {slo.get('window')} items "
+                  "per lane per objective. Burn rate = observed bad "
+                  "fraction / budgeted bad fraction (>1 = burning "
+                  "faster than the objective allows). Partial "
+                  "windows are marked.", "",
+                  "| lane | objective | n | bad | bad_frac | budget "
+                  "| burn rate | window |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for ln, objs in lanes.items():
+            for kind, o in objs.items():
+                bound = f" (≤{_fmt(o.get('bound_ms'), 0)}ms)" \
+                    if o.get("bound_ms") is not None else ""
+                part = " ⚠ partial" if o.get("partial") else ""
+                lines.append(
+                    f"| {ln} | {kind}{bound} | {o['n']} | {o['bad']} "
+                    f"| {_fmt(o['bad_frac'], 4)} "
+                    f"| {_fmt(o['budget_frac'], 4)} "
+                    f"| **{_fmt(o['burn_rate'])}** "
+                    f"| {o['window']}{part} |")
+        lines.append("")
+    else:
+        lines += ["No SLO accounting in this window.", ""]
+
+    # ---- pipeline bubbles ----
+    pipe = data.get("pipeline") or {}
+    lines += ["## Pipeline utilization and bubbles", ""]
+    if pipe.get("resolves"):
+        lines += [
+            f"- resolves: **{pipe['resolves']}** "
+            f"({pipe.get('parts', 0)} device parts, "
+            f"{pipe.get('delivered', 0)} delivered)",
+            f"- busy fraction: **{_fmt(pipe.get('busy_frac'), 4)}** "
+            f"(busy {_fmt(pipe.get('busy_ms'))}ms of "
+            f"{_fmt(pipe.get('device_wall_ms'))}ms device-wall)",
+            f"- overlap fraction: "
+            f"**{_fmt(pipe.get('overlap_frac'), 4)}** "
+            f"(host prep hidden behind in-flight device work)",
+            f"- largest bubble: "
+            f"**{_fmt(pipe.get('largest_bubble_ms'))}ms** "
+            f"({pipe.get('largest_bubble_class')})", "",
+            "| bubble class | total ms |", "|---|---|"]
+        for cls, ms in (pipe.get("bubble_ms") or {}).items():
+            lines.append(f"| {cls} | {_fmt(ms)} |")
+        lines.append("")
+    else:
+        lines += ["No pipeline resolves in this window.", ""]
+
+    # ---- transfer ledger ----
+    tr = data.get("transfer") or {}
+    if tr:
+        lines += ["## Transfer ledger totals", "",
+                  f"- round trips: {tr.get('round_trips')}",
+                  f"- bytes h2d / d2h: {tr.get('bytes_h2d')} / "
+                  f"{tr.get('bytes_d2h')}",
+                  f"- redundant constant bytes: "
+                  f"{tr.get('redundant_constant_bytes')} "
+                  f"({tr.get('redundant_uploads')} uploads)", ""]
+
+    # ---- time series ----
+    ts = data.get("timeseries") or {}
+    series = ts.get("series") or {}
+    lines += ["## Metric time-series", ""]
+    if series:
+        samp = ts.get("sampling", {})
+        lines += [f"Sampled every {samp.get('interval_s')}s, "
+                  f"{samp.get('ticks')} ticks, "
+                  f"{samp.get('tracked_series')} series tracked.", ""]
+        rows = []
+        for prefix in REPORT_SERIES_PREFIXES:
+            for name, s in series.items():
+                if not name.startswith(prefix):
+                    continue
+                st = _series_stats(s.get("samples") or [])
+                if st is None:
+                    continue
+                part = " ⚠ partial" if s.get("partial") else ""
+                rows.append(
+                    f"| {name} | {st['n']}{part} | {_fmt(st['min'])} "
+                    f"| {_fmt(st['mean'])} | {_fmt(st['max'])} "
+                    f"| {_fmt(st['last'])} |")
+        shown = rows[:MAX_SERIES_ROWS]
+        if shown:
+            lines += ["| series | samples | min | mean | max | last |",
+                      "|---|---|---|---|---|---|"] + shown
+        if len(rows) > len(shown):
+            lines.append(f"\n({len(rows) - len(shown)} more series "
+                         "not shown)")
+        others = sum(1 for n in series
+                     if not n.startswith(REPORT_SERIES_PREFIXES))
+        if others:
+            lines.append(f"\n({others} series outside the report "
+                         "prefixes omitted)")
+        anomalies = ts.get("anomalies") or []
+        if anomalies:
+            lines += ["", "### Anomalies (EWMA z-score watcher)", ""]
+            for a in anomalies:
+                lines.append(f"- `{a['series']}` at t={a['t_s']}s: "
+                             f"value {_fmt(a['value'])} vs baseline "
+                             f"{_fmt(a['mu'])} (z={a['z']})")
+        lines.append("")
+    else:
+        lines += ["No time-series samples in this window (was the "
+                  "sampler started?).", ""]
+
+    # ---- service conservation ----
+    svc = data.get("service") or {}
+    if svc.get("totals"):
+        t = svc["totals"]
+        lines += ["## Verify-service conservation", "",
+                  f"- submitted {t.get('submitted')} = verified "
+                  f"{t.get('verified')} + rejected {t.get('rejected')}"
+                  f" + shed {t.get('shed')} + failed "
+                  f"{t.get('failed')} + pending "
+                  f"{svc.get('pending_items')}",
+                  f"- conservation gap: "
+                  f"**{svc.get('conservation_gap')}** (must be 0)",
+                  ""]
+
+    # ---- top traces ----
+    traces = data.get("traces") or []
+    lines += ["## Top trace timelines", ""]
+    if traces:
+        for tl in traces:
+            if not tl.get("found"):
+                continue
+            s = tl.get("summary", {})
+            lines.append(
+                f"### trace {tl['trace']} — queue wait "
+                f"{_fmt(s.get('queue_wait_ms'))}ms, enqueue→verdict "
+                f"{_fmt(s.get('enqueue_to_verdict_ms'))}ms"
+                + (f", dropped via {s['dropped']}"
+                   if s.get("dropped") else ""))
+            for rec in tl.get("records", [])[:12]:
+                dur = "open" if rec.get("dur_ms") is None else \
+                    f"{_fmt(rec['dur_ms'])}ms"
+                lines.append(f"- t={_fmt(rec['start_ms'])}ms "
+                             f"`{rec['name']}` ({dur})")
+            lines.append("")
+    else:
+        lines += ["No verdict-bearing traces in the recorder "
+                  "window.", ""]
+    return "\n".join(lines) + "\n"
+
+
+# ---------------- synthetic demo window ----------------
+
+
+def synthetic_window() -> None:
+    """A small host-only window so the default invocation renders a
+    complete report with no device and no running node: a verify
+    service flood over a stub-fast verifier, a scripted pipeline
+    resolve, and time-series sampling."""
+    import numpy as np
+
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils.metrics import timeseries
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_export
+
+    trace_export.synthetic_pipeline_window()
+
+    class _Instant:
+        def submit(self, items, trace_ids=None):
+            n = len(items)
+            return lambda: np.ones(n, dtype=bool)
+
+    svc = vs.VerifyService(verifier=_Instant(), lane_depth=64,
+                           lane_bytes=10 ** 7, max_batch=64).start()
+    tickets = []
+    for i in range(12):
+        pk = bytes([(i * 17 + j) % 251 + 1 for j in range(32)])
+        items = [(pk, b"report-%d-%d" % (i, k),
+                  bytes([(i + k) % 251]) * 64) for k in range(4)]
+        lane = "scp" if i % 3 == 0 else "bulk"
+        tickets.append(svc.submit(items, lane=lane))
+        timeseries.sample_once()
+    for t in tickets:
+        t.result(timeout=30)
+    svc.stop(drain=True, timeout=30)
+    timeseries.sample_once()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="admin base URL of a running node "
+                         "(default: synthetic local window)")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: stdout)")
+    ap.add_argument("--title", default="Telemetry report")
+    args = ap.parse_args()
+    if args.url:
+        data = collect_url(args.url)
+    else:
+        synthetic_window()
+        data = collect_local()
+    text = render_report(data, title=args.title)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"telemetry-report: {len(text.splitlines())} lines -> "
+              f"{args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

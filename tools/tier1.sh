@@ -117,4 +117,18 @@ echo PERF_DRIFT_OK=$([ "$prc" -eq 0 ] && echo 1 || echo 0)
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/transfer_selfcheck.py
 trc=$?
 echo TRANSFER_LEDGER_OK=$([ "$trc" -eq 0 ] && echo 1 || echo 0)
-exit $trc
+[ "$trc" -ne 0 ] && exit $trc
+# Pipeline-bubble profiler (ISSUE 10): a forced-4-device chaos resolve
+# with an injected inter-dispatch stall (stall-device:1) must show the
+# stall as a bubble in the correct class (queue_wait on the delayed
+# device, standing out above a clean resolve's floor), per-device
+# busy + attributed bubbles must reconcile >= 95% of resolve
+# wall-clock (record wall pinned against an independent clock), the
+# crypto.pipeline.* metrics must ride the Prometheus exposition, and
+# the time-series ring must sample concurrently with the resolving
+# engine without raising or tearing. Same shapes + persistent cache
+# as the chaos gate: seconds warm, ~1 min cold.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/pipeline_selfcheck.py
+porc=$?
+echo PIPELINE_OBS_OK=$([ "$porc" -eq 0 ] && echo 1 || echo 0)
+exit $porc

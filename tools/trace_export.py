@@ -2,7 +2,10 @@
 """Chrome ``trace_event`` exporter CLI (ISSUE 8): dump the flight
 recorder as a JSON file chrome://tracing / Perfetto load directly —
 thread-named tracks, nested begin/end span pairs, instant markers for
-events and still-open spans.
+events and still-open spans, and COUNTER tracks (``C`` events, ISSUE
+10): per-device pipeline in-flight state, per-resolve busy fractions
+and cumulative transfer bytes share the span clock, so one load shows
+spans, bytes and utilization together.
 
 Two sources:
 
@@ -11,12 +14,14 @@ Two sources:
   node's last breaker trip / shed onset / audit mismatch);
 * no URL — run one synthetic host-only resolve in THIS process (the
   ``tools/metrics_selfcheck.py`` shape: real span-instrumented code
-  path, no device, seconds) and export the local recorder: a
+  path, no device, seconds) plus a scripted two-device pipeline
+  window (so the counter tracks demonstrate busy/bubble/byte series
+  without an accelerator) and export the local recorder: a
   self-contained demo trace plus a smoke test of the exporter.
 
 ``--out trace.json`` writes the file (default stdout); the last stderr
 line summarizes event counts. See ``docs/observability.md``
-"Trace propagation".
+"Trace propagation" and §9.
 """
 
 import argparse
@@ -28,12 +33,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def synthetic_pipeline_window() -> None:
+    """Drive the pipeline profiler with a scripted two-device resolve
+    (prep, staggered dispatches, deliveries — real clock, millisecond
+    sleeps) so the exported demo trace carries busy/bubble counter
+    tracks and a transfer-byte series without touching a device."""
+    import time
+
+    from stellar_tpu.utils.timeline import pipeline_timeline
+
+    tok = pipeline_timeline.begin("demo")
+    with pipeline_timeline.host_phase(tok, "prep"):
+        time.sleep(0.004)
+    pipeline_timeline.note_dispatch(tok, 0)
+    time.sleep(0.006)                       # dev1's queue-wait bubble
+    pipeline_timeline.note_dispatch(tok, 1)
+    with pipeline_timeline.host_phase(tok, "fetch"):
+        time.sleep(0.005)
+    pipeline_timeline.note_delivery(tok, 0)
+    with pipeline_timeline.host_phase(tok, "fetch"):
+        time.sleep(0.003)
+    pipeline_timeline.note_delivery(tok, 1)
+    pipeline_timeline.finish(tok, transfer={
+        "round_trips": 2, "bytes_h2d": 4096, "bytes_d2h": 512,
+        "redundant_constant_bytes": 0})
+
+
 def synthetic_trace() -> dict:
     from stellar_tpu.crypto import batch_verifier as bv
     from stellar_tpu.crypto import ed25519_ref as ref
     from stellar_tpu.utils import tracing
 
     bv._enter_host_only("trace export: synthetic resolve")
+    synthetic_pipeline_window()
     pool = []
     for i in range(8):
         seed = bytes([i + 1]) * 32
@@ -75,8 +107,9 @@ def main() -> int:
     evs = trace.get("traceEvents", [])
     print(f"trace-export: {len(evs)} events "
           f"({sum(1 for e in evs if e.get('ph') == 'B')} spans, "
-          f"{sum(1 for e in evs if e.get('ph') == 'i')} instants) -> "
-          f"{args.out or 'stdout'}", file=sys.stderr)
+          f"{sum(1 for e in evs if e.get('ph') == 'i')} instants, "
+          f"{sum(1 for e in evs if e.get('ph') == 'C')} counter "
+          f"samples) -> {args.out or 'stdout'}", file=sys.stderr)
     return 0
 
 
