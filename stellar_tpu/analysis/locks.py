@@ -51,6 +51,10 @@ SCOPE = [
     "stellar_tpu/crypto/verify_service.py",
     "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
+    # the device-resident constant cache (ISSUE 12): its LRU mutates
+    # from every dispatching thread (trickle leaders, service
+    # dispatcher, chaos tests) through the engine's placement path
+    "stellar_tpu/parallel/residency.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
     "stellar_tpu/utils/tracing.py",
@@ -102,6 +106,13 @@ ALLOWLIST = Allowlist({
             "config push at startup, torn reads impossible under the "
             "GIL; a racing resolve sees either the old or the new "
             "rate, both of which sample deterministically.",
+        "unlocked-global:configure_dispatch.DONATE_BUFFERS":
+            "single atomic store of an immutable str (no "
+            "read-modify-write): same argument as DEADLINE_MS — "
+            "config push at startup; a racing dispatch reads either "
+            "the old or the new policy, and both produce "
+            "bit-identical results (donation changes buffer "
+            "lifetimes, never rows).",
     },
 })
 

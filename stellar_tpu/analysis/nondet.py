@@ -67,6 +67,11 @@ HOST_ORACLE_FILES = [
     # content-derived, so two runs over the same bytes always agree
     "stellar_tpu/utils/transfer_ledger.py",
     "tools/perf_sentinel.py",
+    # the device-resident constant cache (ISSUE 12) decides which
+    # operand uploads are skipped: keys must be content-derived and
+    # eviction clock/RNG-free, or replicas could pin different buffers
+    # (a latency divergence only — but the discipline is free to keep)
+    "stellar_tpu/parallel/residency.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",

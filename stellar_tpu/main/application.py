@@ -220,7 +220,11 @@ class Application:
                 changed("VERIFY_AUDIT_RATE") or \
                 changed("VERIFY_DEVICE_FAILURE_THRESHOLD") or \
                 changed("VERIFY_DEVICE_BACKOFF_MIN_S") or \
-                changed("VERIFY_DEVICE_BACKOFF_MAX_S"):
+                changed("VERIFY_DEVICE_BACKOFF_MAX_S") or \
+                changed("VERIFY_DONATE_BUFFERS") or \
+                changed("VERIFY_RESIDENT_CACHE_BYTES") or \
+                changed("VERIFY_RESIDENT_MAX_ITEM_BYTES") or \
+                changed("VERIFY_RESIDENT_CONSTANTS"):
             from stellar_tpu.crypto import batch_verifier
             batch_verifier.configure_dispatch(
                 deadline_ms=config.VERIFY_DEVICE_DEADLINE_MS,
@@ -232,7 +236,12 @@ class Application:
                 device_failure_threshold=(
                     config.VERIFY_DEVICE_FAILURE_THRESHOLD),
                 device_backoff_min_s=config.VERIFY_DEVICE_BACKOFF_MIN_S,
-                device_backoff_max_s=config.VERIFY_DEVICE_BACKOFF_MAX_S)
+                device_backoff_max_s=config.VERIFY_DEVICE_BACKOFF_MAX_S,
+                donate_buffers=config.VERIFY_DONATE_BUFFERS,
+                resident_cache_bytes=config.VERIFY_RESIDENT_CACHE_BYTES,
+                resident_max_item_bytes=(
+                    config.VERIFY_RESIDENT_MAX_ITEM_BYTES),
+                resident_enabled=config.VERIFY_RESIDENT_CONSTANTS)
         # resident verify service knobs (docs/robustness.md "Overload
         # and load-shed") — pushed BEFORE the service could start, so
         # the first admitted submission already runs under the

@@ -22,12 +22,43 @@ interface**:
   padding. Namespaces (``metrics_ns``/``span_ns``) keep each
   workload's serve/audit accounting and resolve spans separable while
   tunnel-level state stays shared.
-* :class:`BatchEngine` — the dispatch/resolve loop itself, moved
-  VERBATIM from ``BatchVerifier`` (same bucket/padding scheme, same
+* :class:`BatchEngine` — the dispatch/resolve loop itself, factored
+  out of ``BatchVerifier`` (same bucket/padding scheme, same
   per-device sub-chunk split, same breaker and probation-grant
   discipline, same audit composition and host-only escalation, same
-  spans and counters), now generic over the plugin's array tuple and
+  spans and counters), generic over the plugin's array tuple and
   result rows.
+
+**Dispatch floor (ISSUE 12).** The ledger/profiler instrumentation of
+PRs 8+10 measured ``redundancy_frac`` 1.0 and ``overlap_frac`` 0.0 on
+the old dispatch loop; this engine spends that measurement with four
+coordinated levers, each provable from the same gated telemetry:
+
+* **device-resident constant tables**
+  (:mod:`stellar_tpu.parallel.residency`): operand uploads are keyed
+  by content fingerprint and retained on device — identical bytes
+  upload once per placement per process; re-dispatches are served
+  from the resident committed array (``resident_hits`` in the
+  ledger), so ``redundant_constant_bytes`` sits at ~0 after warm-up
+  and is sentinel-pinned there;
+* **donated input buffers**: one-off operands the cache does NOT
+  retain dispatch through ``donate_argnums`` executables
+  (``VERIFY_DONATE_BUFFERS``, auto = real accelerators only), so
+  their device buffers are released without a defensive copy — never
+  for resident buffers (a donated buffer is consumed, a resident one
+  must survive for the next hit);
+* **coalesced per-mesh dispatch**: a fully healthy mesh serving a
+  full bucket ships ONE sharded h2d upload whose per-device shards
+  feed the SAME per-device sub-chunk executables — n_devices×n_arrays
+  ``device_put`` round trips collapse to n_arrays (or zero, on a
+  resident hit) while per-device fault attribution, breakers,
+  degraded re-shard, probation grants and the sampled audit keep
+  their existing shape;
+* **async pipelined submit**: batches wider than the top bucket
+  encode/pad chunk ``k+1`` while chunk ``k`` is in flight and fetch
+  only verdict bits — host prep hides behind device work
+  (``overlap_frac`` up from 0.0, regression-gated by
+  ``tools/perf_sentinel.py``).
 
 Workload #1 is ed25519 verify
 (:class:`stellar_tpu.crypto.batch_verifier.BatchVerifier` — a thin
@@ -77,7 +108,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from stellar_tpu.crypto import audit as audit_mod
-from stellar_tpu.parallel import device_health
+from stellar_tpu.parallel import device_health, residency
 from stellar_tpu.utils import faults, resilience, tracing
 from stellar_tpu.utils.metrics import registry
 from stellar_tpu.utils.timeline import pipeline_timeline
@@ -110,6 +141,15 @@ DISPATCH_RETRIES = int(os.environ.get("VERIFY_DISPATCH_RETRIES", "1"))
 # disables). The sample is derived from the batch CONTENT
 # (crypto/audit.py) so consensus replicas audit identical rows.
 AUDIT_RATE = float(os.environ.get("VERIFY_AUDIT_RATE", "0.02"))
+# Donated input buffers (ISSUE 12): operand uploads the resident cache
+# does NOT retain (one-off payloads, oversize arrays) are dispatched
+# through a donate_argnums executable so the device may reuse their
+# buffers instead of paying a defensive copy. "auto" donates only on a
+# real accelerator (jax-CPU ignores donation and would just warn);
+# "1"/"0" force it for tests. A donated dispatch never retries — the
+# operands are gone after the first attempt — so failures go straight
+# to attribution + host fallback.
+DONATE_BUFFERS = os.environ.get("VERIFY_DONATE_BUFFERS", "auto")
 
 # The production jit bucket ladder (the verify workload's
 # default_verifier). Also the shape set the static overflow prover must
@@ -242,21 +282,30 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
                        audit_rate: Optional[float] = None,
                        device_failure_threshold: Optional[int] = None,
                        device_backoff_min_s: Optional[float] = None,
-                       device_backoff_max_s: Optional[float] = None
+                       device_backoff_max_s: Optional[float] = None,
+                       donate_buffers: Optional[str] = None,
+                       resident_cache_bytes: Optional[int] = None,
+                       resident_max_item_bytes: Optional[int] = None,
+                       resident_enabled: Optional[bool] = None
                        ) -> None:
     """Push dispatch-resilience knobs (Config / tests); None keeps the
     current value. ``deadline_ms <= 0`` disables the resolve watchdog;
     ``audit_rate <= 0`` disables the result-integrity audit; the
-    ``device_*`` knobs shape the per-device quarantine breakers. The
-    knobs govern EVERY workload on the substrate (verify and hash
-    dispatches share the tunnel whose health they model)."""
-    global DEADLINE_MS, DISPATCH_RETRIES, AUDIT_RATE
+    ``device_*`` knobs shape the per-device quarantine breakers; the
+    ``donate_buffers`` / ``resident_*`` knobs shape the dispatch-floor
+    levers (ISSUE 12: donated one-off operands, device-resident
+    constant tables). The knobs govern EVERY workload on the substrate
+    (verify and hash dispatches share the tunnel whose health they
+    model — and the resident buffers living on its chips)."""
+    global DEADLINE_MS, DISPATCH_RETRIES, AUDIT_RATE, DONATE_BUFFERS
     if deadline_ms is not None:
         DEADLINE_MS = float(deadline_ms)
     if dispatch_retries is not None:
         DISPATCH_RETRIES = max(0, int(dispatch_retries))
     if audit_rate is not None:
         AUDIT_RATE = float(audit_rate)
+    if donate_buffers is not None:
+        DONATE_BUFFERS = str(donate_buffers)
     _breaker.configure(failure_threshold=failure_threshold,
                        backoff_min_s=backoff_min_s,
                        backoff_max_s=backoff_max_s)
@@ -264,6 +313,46 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
         failure_threshold=device_failure_threshold,
         backoff_min_s=device_backoff_min_s,
         backoff_max_s=device_backoff_max_s)
+    residency.resident_cache.configure(
+        max_bytes=resident_cache_bytes,
+        max_item_bytes=resident_max_item_bytes,
+        enabled=resident_enabled)
+
+
+_donate_warn_lock = threading.Lock()
+_donate_warn_filtered = False
+
+
+def _filter_donation_warning_once() -> None:
+    """Install (once per process) the ignore-filter for XLA's
+    'donated buffers were not usable' nag: our kernels' outputs never
+    alias their inputs (verdict bits / digest words vs byte
+    operands), so every donating compile would warn — the buffers are
+    still released early. Installed lazily at the FIRST donating
+    build, so a process that never donates keeps its warning state
+    untouched, and exactly one filter entry ever lands in the global
+    list."""
+    global _donate_warn_filtered
+    import warnings
+    with _donate_warn_lock:
+        if _donate_warn_filtered:
+            return
+        _donate_warn_filtered = True
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+
+
+def _donation_active() -> bool:
+    """May dispatches donate their (non-resident) operand buffers?
+    "auto" donates only when a REAL accelerator answered the probe:
+    jax-CPU ignores donation entirely, so forcing it there would buy
+    nothing and add a second executable per shape to the compile
+    budget the chaos suites are pinned against."""
+    if DONATE_BUFFERS == "1":
+        return True
+    if DONATE_BUFFERS == "auto":
+        return _device_state not in (None, "cpu", "dead")
+    return False
 
 
 # ---------------- host-only mode (result-integrity posture) ----------------
@@ -385,6 +474,8 @@ def dispatch_health() -> dict:
         "watchdog": resilience.watchdog_stats(),
         "flight_recorder": tracing.flight_recorder.stats(),
         "transfer": transfer_ledger.totals(),
+        "resident": residency.resident_cache.snapshot(),
+        "donate_buffers": DONATE_BUFFERS,
         "service": service_health_snapshot(),
     }
 
@@ -427,7 +518,9 @@ def _resolve_budget_s() -> Optional[float]:
     silently reroute differential tests to the host oracle."""
     if DEADLINE_MS <= 0:
         return None
-    if faults.is_active(faults.RESOLVE) or faults.is_active(faults.DISPATCH):
+    if faults.is_active(faults.RESOLVE) or \
+            faults.is_active(faults.DISPATCH) or \
+            faults.is_active(faults.TRANSFER):
         return DEADLINE_MS / 1000.0
     if _device_state in (None, "cpu"):
         return None
@@ -558,8 +651,13 @@ class BatchEngine:
         # on a mesh): written from any thread that dispatches (trickle
         # leaders, chaos tests, the close path) — guarded, the wrapper
         # itself is built outside the lock (cheap; the compile happens
-        # lazily at first call)
+        # lazily at first call). Donating variants live in a separate
+        # dict so `sorted(self._kernels)` stays the shape set the
+        # compile-reuse invariant pins, and a jax-CPU process (where
+        # donation is off) never builds — or compiles — the second
+        # executable per shape.
         self._kernels = {}
+        self._kernels_donate = {}
         self._kernels_lock = threading.Lock()
         # per-instance backend attribution (items served), mirrored into
         # the process-wide meters: bench and the chaos tests read these
@@ -569,6 +667,14 @@ class BatchEngine:
         self.deadline_misses = 0
         self.retries = 0
         self.audit_mismatches = 0
+        # dispatch-floor lever attribution (ISSUE 12): how many
+        # buckets rode the single coalesced per-mesh upload, how many
+        # kernel calls donated their operands, and how many operand
+        # uploads the resident constant cache absorbed — the engine's
+        # own view of the levers, next to the ledger's byte view
+        self.coalesced_dispatches = 0
+        self.donated_dispatches = 0
+        self.resident_hits = 0
         # engine-side byte accounting, derived INDEPENDENTLY from the
         # dispatch shapes (prod(shape) * itemsize at the placement
         # sites) — the reconciliation oracle the transfer ledger's
@@ -590,21 +696,28 @@ class BatchEngine:
 
     # ---------------- device dispatch ----------------
 
-    def _kernel_for(self, n: int):
+    def _kernel_for(self, n: int, donate: bool = False,
+                    n_args: Optional[int] = None):
+        cache = self._kernels_donate if donate else self._kernels
         with self._kernels_lock:
-            kernel = self._kernels.get(n)
+            kernel = cache.get(n)
         if kernel is None:
             import jax
-            # one plain jit wrapper per dispatch shape; on the mesh
-            # path placement follows the committed inputs, so the SAME
-            # wrapper serves every device (jax caches one executable
-            # per (shape, device) underneath)
-            built = jax.jit(self._plugin.kernel_fn())
+            if donate:
+                _filter_donation_warning_once()
+                built = jax.jit(self._plugin.kernel_fn(),
+                                donate_argnums=tuple(range(n_args)))
+            else:
+                # one plain jit wrapper per dispatch shape; on the
+                # mesh path placement follows the committed inputs,
+                # so the SAME wrapper serves every device (jax caches
+                # one executable per (shape, device) underneath)
+                built = jax.jit(self._plugin.kernel_fn())
             with self._kernels_lock:
                 # setdefault: a racing builder's wrapper wins once —
                 # both wrappers trace identically, so the loser is
                 # just garbage, never a different kernel
-                kernel = self._kernels.setdefault(n, built)
+                kernel = cache.setdefault(n, built)
         return kernel
 
     def _bucket(self, n: int) -> int:
@@ -614,14 +727,24 @@ class BatchEngine:
         return self._buckets[-1]
 
     def _dispatch_one(self, arrays: tuple, bsize: int,
-                      dev_idx: Optional[int]):
+                      dev_idx: Optional[int],
+                      donate: bool = False):
         """One kernel call (whole padded bucket, or one per-device
         sub-chunk): inject-point + retry + failure attribution. Returns
-        the in-flight device array, or None (host fallback)."""
-        attempts = 1 + DISPATCH_RETRIES
+        the in-flight device array, or None (host fallback).
+        ``donate=True`` dispatches through the donate_argnums variant
+        — and therefore never retries (the operand buffers are
+        consumed by the first attempt)."""
+        attempts = 1 if donate else 1 + DISPATCH_RETRIES
         for attempt in range(attempts):
             try:
                 faults.inject(faults.DISPATCH, device=dev_idx)
+                if donate:
+                    with self._stats_lock:
+                        self.donated_dispatches += 1
+                    return self._kernel_for(
+                        bsize, donate=True,
+                        n_args=len(arrays))(*arrays)
                 return self._kernel_for(bsize)(*arrays)
             except Exception as e:
                 if attempt + 1 < attempts:
@@ -643,6 +766,105 @@ class BatchEngine:
             self.shipped_bytes += total
         return total
 
+    def _place_operands(self, tok, arrays: tuple, dest, pkey,
+                        dev_idx: Optional[int] = None):
+        """Commit one operand tuple to ``dest`` (a device, a
+        per-mesh Sharding, or None = the default device) through the
+        device-resident constant cache: an operand whose exact bytes
+        are already resident at this placement is served from the
+        cached committed array — no upload, the ledger records a
+        resident hit — and a fresh upload is retained for the next
+        identical dispatch. Returns ``(placed_tuple, donatable)``:
+        donatable only when EVERY operand was freshly uploaded and
+        none was retained (a donated buffer is consumed by the kernel
+        and must never be a cache entry someone will reuse)."""
+        import jax
+        cache = residency.resident_cache
+        placed = []
+        donatable = _donation_active()
+        for a in arrays:
+            fp = residency.fingerprint(a)
+            hit = cache.get(fp, a, pkey)
+            if hit is not None:
+                transfer_ledger.record_resident_hit(tok, a,
+                                                    device=dev_idx)
+                with self._stats_lock:
+                    self.resident_hits += 1
+                placed.append(hit)
+                donatable = False
+                continue
+            put = jax.device_put(a, dest) if dest is not None \
+                else jax.device_put(a)
+            # transfer ledger: the device_put IS the h2d upload; the
+            # engine's own shape-derived tally is the reconciliation
+            # oracle (tools/transfer_selfcheck.py). The precomputed
+            # fingerprint is forwarded only when residency actually
+            # computed one — an operand over the RESIDENCY size cap
+            # must still be fingerprinted under the ledger's OWN cap
+            # (TRANSFER_LEDGER_FP_MAX_BYTES), or the redundancy
+            # detector would silently lose exactly the mid-size
+            # constants between the two knobs
+            if fp is not None:
+                transfer_ledger.record_h2d(tok, a, device=dev_idx,
+                                           fp=fp)
+            else:
+                transfer_ledger.record_h2d(tok, a, device=dev_idx)
+            self._ship_accounting((a,))
+            if cache.put(fp, a, pkey, put):
+                donatable = False
+            placed.append(put)
+        return tuple(placed), donatable
+
+    def _coalesced_upload(self, arrays: tuple, tok):
+        """ONE sharded h2d upload of the whole padded bucket — the
+        coalesced per-mesh transfer replacing n_devices separate
+        ``device_put`` round trips. Each operand is committed once
+        under a batch-axis NamedSharding (through the resident cache:
+        a bucket whose exact bytes already shipped is served from the
+        resident sharded array, zero new transfer); its per-device
+        shards then feed the SAME per-device sub-chunk executables the
+        legacy path compiles, so fault attribution and the
+        compile-reuse invariant are untouched.
+
+        Returns ``(per_device_operands, donatable)`` —
+        ``{dev_idx: operand_tuple}`` of committed shard arrays — or
+        ``None`` when the upload failed (the caller falls back to the
+        attributable per-device upload path, which re-encounters and
+        properly accounts the failure)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        n_dev = len(self._devices)
+        pkey = ("mesh",) + tuple(
+            int(getattr(d, "id", i))
+            for i, d in enumerate(self._devices))
+        try:
+            # the upload carries every device's shard: a per-device
+            # transfer fault (stall-transfer:<idx>, fail-device) armed
+            # for ANY device of the mesh sees the coalesced put
+            for di in range(n_dev):
+                faults.inject(faults.TRANSFER, device=di)
+            sharding = NamedSharding(
+                self._mesh, PartitionSpec(self._mesh.axis_names[0]))
+            placed, donatable = self._place_operands(
+                tok, arrays, dest=sharding, pkey=pkey, dev_idx=None)
+        except Exception as e:
+            registry.counter(
+                "crypto.verify.dispatch.coalesce_fallback").inc()
+            _log.warning(
+                "coalesced per-mesh upload failed (%s: %s) — "
+                "falling back to per-device uploads",
+                type(e).__name__, e)
+            return None
+        by_dev = {di: [] for di in range(n_dev)}
+        for op in placed:
+            shard_by_device = {s.device: s.data
+                               for s in op.addressable_shards}
+            for di, dev in enumerate(self._devices):
+                by_dev[di].append(shard_by_device[dev])
+        with self._stats_lock:
+            self.coalesced_dispatches += 1
+        return ({di: tuple(ops) for di, ops in by_dev.items()},
+                donatable)
+
     def _dispatch_parts(self, arrays: tuple, b: int, chunk: int,
                         tok=None, traces=None, ptok=None):
         """Split one padded bucket into per-device sub-chunks over the
@@ -656,6 +878,16 @@ class BatchEngine:
         served its own share, so degradation and regrowth never pay a
         fresh XLA compile (the invariant `docs/robustness.md` pins).
 
+        On a fully healthy mesh serving a full bucket (identity
+        assignment) the operands ride ONE coalesced sharded upload
+        (:meth:`_coalesced_upload`) and each device's kernel call
+        consumes its shard in place — same executables, same
+        per-device injection points, same per-part output arrays, so
+        ``DeviceHealth`` attribution, breakers, the sampled audit and
+        degraded re-shard all keep working. Any degradation (or a
+        short chunk, or a failed coalesced upload) takes the legacy
+        per-device upload loop below.
+
         A half-open device's breaker grants exactly one sub-chunk per
         backoff window — probation traffic IS the re-probe; success
         regrows the device into the rotation.
@@ -663,7 +895,6 @@ class BatchEngine:
         Returns part records ``[lo, hi, dev_idx, arr]``: valid rows
         ``lo:hi`` of the chunk, serving device, in-flight array (None =
         host fallback). All-padding tail sub-chunks are skipped."""
-        import jax
         n_dev = len(self._devices)
         sub = b // n_dev
         # sub-chunks that carry real rows (pure-padding tails are
@@ -681,6 +912,22 @@ class BatchEngine:
             tracing.flight_recorder.note(
                 f"{self._span_ns}.reshard", **reshard_attrs)
         parts = []
+        if n_parts == n_dev and assignment == list(range(n_dev)):
+            coalesced = self._coalesced_upload(arrays, tok)
+            if coalesced is not None:
+                per_device, donatable = coalesced
+                for j, di in enumerate(assignment):
+                    lo = j * sub
+                    hi = min(lo + sub, chunk)
+                    arr = self._dispatch_one(
+                        per_device[di], bsize=sub, dev_idx=di,
+                        donate=donatable)
+                    if arr is not None:
+                        # pipeline timeline: a COMMITTED kernel call
+                        # opens this device's busy interval (ISSUE 10)
+                        pipeline_timeline.note_dispatch(ptok, di)
+                    parts.append([lo, hi, di, arr])
+                return parts
         for j, di in enumerate(assignment):
             lo = j * sub
             hi = min(lo + sub, chunk)
@@ -693,14 +940,22 @@ class BatchEngine:
                 parts.append([lo, hi, None, None])
                 continue
             subs = tuple(x[lo:lo + sub] for x in arrays)
-            placed = tuple(
-                jax.device_put(a, self._devices[di]) for a in subs)
-            # transfer ledger: the device_put IS the h2d upload; the
-            # engine's own shape-derived tally is the reconciliation
-            # oracle (tools/transfer_selfcheck.py)
-            transfer_ledger.record_h2d_many(tok, subs, device=di)
-            self._ship_accounting(subs)
-            arr = self._dispatch_one(placed, bsize=sub, dev_idx=di)
+            try:
+                faults.inject(faults.TRANSFER, device=di)
+                # placement key is the PHYSICAL device id (same
+                # contract as the coalesced pkey): two engines over
+                # different meshes share the process-wide cache, and
+                # a mesh-index key would alias different chips
+                placed, donatable = self._place_operands(
+                    tok, subs, dest=self._devices[di],
+                    pkey=("dev", getattr(self._devices[di], "id", di)),
+                    dev_idx=di)
+            except Exception as e:
+                _note_device_failure("transfer", e, di)
+                parts.append([lo, hi, di, None])
+                continue
+            arr = self._dispatch_one(placed, bsize=sub, dev_idx=di,
+                                     donate=donatable)
             if arr is not None:
                 # pipeline timeline: a COMMITTED kernel call opens
                 # this device's busy interval (ISSUE 10)
@@ -776,11 +1031,20 @@ class BatchEngine:
                 arrays = _padded_inputs()
                 with tracing.span(f"{self._span_ns}.dispatch",
                                   **_span_attrs()):
-                    # committed whole-bucket operands transfer at call
-                    # time — the h2d upload of the single-device path
-                    transfer_ledger.record_h2d_many(tok, arrays)
-                    self._ship_accounting(arrays)
-                    arr = self._dispatch_one(arrays, b, None)
+                    # whole-bucket operands commit to the default
+                    # device (through the resident cache — identical
+                    # re-dispatched content uploads once per process)
+                    # before the kernel call
+                    try:
+                        faults.inject(faults.TRANSFER, device=None)
+                        placed, donatable = self._place_operands(
+                            tok, arrays, dest=None, pkey="default",
+                            dev_idx=None)
+                        arr = self._dispatch_one(placed, b, None,
+                                                 donate=donatable)
+                    except Exception as e:
+                        _note_device_failure("transfer", e, None)
+                        arr = None
                     if arr is not None:
                         pipeline_timeline.note_dispatch(ptok, None)
                 parts = [[0, chunk, None, arr]]
@@ -803,12 +1067,22 @@ class BatchEngine:
     def submit(self, items: Sequence,
                trace_ids=None) -> Callable[[], np.ndarray]:
         """Asynchronous batch: host prep + non-blocking device
-        dispatch.
+        dispatch, PIPELINED per bucket chunk (ISSUE 12).
+
+        Batches wider than the top bucket are encoded and dispatched
+        chunk by chunk: while chunk ``k``'s kernels are in flight on
+        device, the host encodes and pads chunk ``k+1`` — the prep of
+        every chunk after the first hides behind in-flight device
+        work, which is exactly the ``overlap_frac`` the
+        pipeline-bubble profiler measures (0.0 under the old
+        encode-everything-then-dispatch loop). The resolver then
+        fetches only the result rows (verdict bits / digest words),
+        never the operands.
 
         Returns a zero-arg resolver; calling it blocks on the device
-        result and returns the per-item result rows. Multiple submitted
-        batches pipeline on device (jax async dispatch), overlapping
-        transfer and compute across batches.
+        results and returns the per-item result rows. Multiple
+        submitted batches additionally pipeline on device (jax async
+        dispatch), overlapping transfer and compute across batches.
 
         ``trace_ids`` (ISSUE 8): optional per-item trace IDs, aligned
         with ``items``. They survive sub-chunking, re-shard, audit and
@@ -821,49 +1095,76 @@ class BatchEngine:
         n = len(items)
         if n == 0:
             return lambda: self._plugin.empty_result(0)
+        items = list(items)  # pinned for possible host re-computation
+        trace_ids = list(trace_ids) if trace_ids is not None else None
+        top = self._buckets[-1]
         # pipeline timeline (ISSUE 10): the token's lifetime IS the
         # resolve wall; a gate-empty early return simply drops it
         # (begin registers nothing — same policy as the transfer
         # ledger's tokens)
         ptok = pipeline_timeline.begin(self._ns)
-        with pipeline_timeline.host_phase(ptok, "prep"):
-            gate, encoded = self._prep(items)
-        if not gate.any():
-            # no row's outcome depends on device bits: the plugin
-            # finalizes (gate-fail fill / host hashing) without a
-            # dispatch
+        tok = transfer_ledger.begin(self._ns)
+        # pending: (global slice, chunk, parts, gate_c, encoded_c) —
+        # the per-chunk gate and encoded arrays stay with their chunk
+        # (the audit samples against the bytes that actually
+        # dispatched)
+        pending = []
+        gates = []
+        start = 0
+        while start < n:
+            chunk = min(top, n - start)
+            sl = slice(start, start + chunk)
+            with pipeline_timeline.host_phase(ptok, "prep"):
+                gate_c, encoded_c = self._prep(items[sl])
+            gates.append(gate_c)
+            if gate_c.any():
+                (_psl, _pchunk, parts), = self._dispatch_device(
+                    *encoded_c, tok=tok,
+                    trace_ids=(trace_ids[sl] if trace_ids else None),
+                    ptok=ptok)
+            else:
+                # no row of this chunk reads device bits: the plugin
+                # finalizes (gate-fail fill / host hashing) without a
+                # dispatch
+                parts = []
+            pending.append((sl, chunk, parts, gate_c, encoded_c))
+            start += chunk
+        gate = gates[0] if len(gates) == 1 else np.concatenate(gates)
+        if not any(p for _sl, _c, p, _g, _e in pending):
+            # nothing dispatched at all — the dropped tokens were
+            # never registered, and the ring stays clean
             out0 = self._plugin.empty_result(n)
             return lambda: self._plugin.finalize(gate, out0, items)
-        trace_ids = list(trace_ids) if trace_ids is not None else None
-        tok = transfer_ledger.begin(self._ns)
-        pending = self._dispatch_device(*encoded, tok=tok,
-                                        trace_ids=trace_ids,
-                                        ptok=ptok)
-        items = list(items)  # pinned for possible host re-computation
 
         def _part_traces(gl: int, gh: int):
             return trace_ranges(trace_ids[gl:gh]) if trace_ids \
                 else None
 
-        def _audit_part(vals: np.ndarray, gl: int, gh: int,
-                        di: Optional[int]) -> bool:
+        def _audit_part(vals: np.ndarray, sl: slice, lo: int, hi: int,
+                        di: Optional[int], gate_c: np.ndarray,
+                        encoded_c: tuple) -> bool:
             """Sampled result-integrity audit of one device-served
-            part (global rows ``gl:gh``): re-compute a content-seeded
-            sample through the host oracle and compare against the
-            COMPOSED result (the quantity pinned bit-identical to the
-            plugin's oracle). Only rows that PASSED the gate are
-            sampled: a gate-failed row's outcome never reads device
-            bits, so auditing it would be vacuous (and a
+            part (chunk-local rows ``lo:hi`` of the chunk at ``sl``):
+            re-compute a content-seeded sample through the host oracle
+            and compare against the COMPOSED result (the quantity
+            pinned bit-identical to the plugin's oracle). The sample
+            material is the chunk's own encoded bytes — the exact
+            bytes the device received. Only rows that PASSED the gate
+            are sampled: a gate-failed row's outcome never reads
+            device bits, so auditing it would be vacuous (and a
             device-predictable blind spot). True = clean (or nothing
             to audit)."""
+            gl, gh = sl.start + lo, sl.start + hi
             audit_attrs = {"device": di}
             atr = _part_traces(gl, gh)
             if atr:
                 audit_attrs["traces"] = atr
             with tracing.span(f"{self._span_ns}.audit", **audit_attrs), \
                     pipeline_timeline.host_phase(ptok, "audit"):
-                material = b"".join(x[gl:gh].tobytes() for x in encoded)
-                eligible = [i for i in range(gh - gl) if gate[gl + i]]
+                material = b"".join(x[lo:hi].tobytes()
+                                    for x in encoded_c)
+                eligible = [i for i in range(hi - lo)
+                            if gate_c[lo + i]]
                 idxs = audit_mod.sample_rows(material, eligible,
                                              AUDIT_RATE)
                 if not idxs:
@@ -891,7 +1192,7 @@ class BatchEngine:
 
         def _resolve_impl() -> np.ndarray:
             out = self._plugin.empty_result(n)
-            for sl, chunk, parts in pending:
+            for sl, chunk, parts, gate_c, encoded_c in pending:
                 for lo, hi, di, arr in parts:
                     got = None
                     accepted = False
@@ -969,7 +1270,8 @@ class BatchEngine:
                             full.dtype.itemsize
                         with self._stats_lock:
                             self.fetched_bytes += fetched
-                        if not _audit_part(vals, gl, gh, di):
+                        if not _audit_part(vals, sl, lo, hi, di,
+                                           gate_c, encoded_c):
                             # wrong bits: hard-quarantine the chip,
                             # stop trusting the accelerator path, and
                             # re-compute the whole part on the host —
@@ -1218,6 +1520,7 @@ def _reset_dispatch_state_for_testing() -> None:
     device_health.get()._reset_for_testing()
     transfer_ledger._reset_for_testing()
     pipeline_timeline._reset_for_testing()
+    residency.resident_cache._reset_for_testing()
 
 
 def _auto_mesh():

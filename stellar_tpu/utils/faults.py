@@ -39,7 +39,13 @@ the fault-domain chaos shapes of ``docs/robustness.md``):
   overrides) before every call attributed to device ``idx`` — the
   host-side inter-dispatch stall shape the pipeline-bubble profiler
   must attribute as a bubble (ISSUE 10,
-  ``tools/pipeline_selfcheck.py``).
+  ``tools/pipeline_selfcheck.py``);
+* ``stall-transfer:<idx>`` — never raises: same sleep, but armed at
+  the H2D UPLOAD point (``device.transfer``) instead of the kernel
+  call — a slow host→device transfer lane, distinguishable from a
+  slow kernel enqueue. The profiler must attribute the delay as
+  ``queue_wait`` on the stalled device (the host was moving bytes,
+  not encoding — prep-vs-queue_wait attribution, ISSUE 12).
 
 Production code attributes a call to a device by passing
 ``inject(point, device=i)``; calls with ``device=None`` (single-device
@@ -49,6 +55,9 @@ Injection points currently planted:
 
 * ``device.probe``    — inside the backend probe thread
   (``batch_verifier.start_device_probe``);
+* ``device.transfer`` — immediately before the h2d operand upload
+  (``device_put`` — per-device on the sub-chunk path, once per
+  assigned device on the coalesced per-mesh upload);
 * ``device.dispatch`` — immediately before the jitted kernel call
   (device-attributed on the per-device mesh path);
 * ``device.resolve``  — inside the (deadline-guarded) device-array
@@ -66,14 +75,15 @@ __all__ = ["FaultInjected", "inject", "corrupt_verdicts", "is_active",
            "set_fault", "clear", "counters", "load_spec"]
 
 PROBE = "device.probe"
+TRANSFER = "device.transfer"
 DISPATCH = "device.dispatch"
 RESOLVE = "device.resolve"
 
 _MODES = ("raise", "hang", "flake", "failn",
           "fail-device", "flaky-device", "corrupt-device",
-          "stall-device")
+          "stall-device", "stall-transfer")
 _DEVICE_MODES = ("fail-device", "flaky-device", "corrupt-device",
-                 "stall-device")
+                 "stall-device", "stall-transfer")
 
 # default sleep for stall-device (set_fault's ``seconds`` overrides)
 STALL_DEVICE_SECONDS = 0.05
@@ -117,7 +127,7 @@ class _Fault:
             self.calls += 1
             n = self.calls
         if self.mode in ("raise", "hang", "fail-device",
-                         "stall-device"):
+                         "stall-device", "stall-transfer"):
             fire = True
         elif self.mode in ("flake", "flaky-device"):
             k = 2 if self.mode == "flaky-device" else \
@@ -132,10 +142,11 @@ class _Fault:
         if self.mode == "hang":
             time.sleep(float(self.arg) if self.arg is not None else 30.0)
             return
-        if self.mode == "stall-device":
-            # a stall, not a failure: the dispatch proceeds after the
-            # sleep — the bubble profiler must SEE the delay, nothing
-            # in the fault-tolerance machinery should trip on it
+        if self.mode in ("stall-device", "stall-transfer"):
+            # a stall, not a failure: the dispatch/upload proceeds
+            # after the sleep — the bubble profiler must SEE the
+            # delay, nothing in the fault-tolerance machinery should
+            # trip on it
             time.sleep(self.seconds if self.seconds is not None
                        else STALL_DEVICE_SECONDS)
             return

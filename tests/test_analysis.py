@@ -629,6 +629,19 @@ def test_lint_scopes_cover_transfer_ledger_and_sentinel():
         assert mod not in nondet.ALLOWLIST._entries, mod
 
 
+def test_lint_scopes_cover_residency_cache():
+    """ISSUE 12: the device-resident constant cache's LRU mutates
+    from every dispatching thread through the engine's placement path
+    (lock lint), and it decides WHICH operand uploads are skipped —
+    keys must stay content-derived and eviction clock/RNG-free
+    (nondet lint). No allowlist entry: clock/RNG-free by design, like
+    the transfer ledger whose redundancy detector it answers."""
+    res = "stellar_tpu/parallel/residency.py"
+    assert res in set(locks.SCOPE)
+    assert res in set(nondet.HOST_ORACLE_FILES)
+    assert res not in nondet.ALLOWLIST._entries
+
+
 def test_lint_scopes_cover_pipeline_timeline():
     """ISSUE 10: the pipeline-bubble profiler's tokens and ring
     mutate from submitter + resolver + service-dispatcher threads —

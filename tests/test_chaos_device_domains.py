@@ -121,6 +121,36 @@ def test_degraded_reshard_compiles_no_new_kernels(domain_run):
 
 
 @lifecycle
+def test_coalesced_dispatch_reuses_subchunk_kernels(domain_run):
+    """ISSUE 12: the healthy baseline really rode the COALESCED
+    per-mesh upload (one sharded h2d per bucket, per-device shard
+    kernel calls), and neither coalescing nor the degraded re-shard
+    that follows it compiled any shape beyond the single sub-chunk
+    executable — the coalesced path feeds the SAME per-device
+    executables the legacy path uses, so degradation under it still
+    pays zero fresh XLA compiles. Donating wrappers (a second
+    executable per shape) must not exist on jax-CPU, where donation
+    is auto-off."""
+    base = domain_run["phases"]["baseline"]
+    degraded = domain_run["phases"]["degraded"]
+    assert base["coalesced_dispatches"] > 0
+    # degradation leaves the coalesced path (assignment != identity)
+    # without minting new dispatch shapes of EITHER kind
+    assert degraded["kernel_shapes"] == base["kernel_shapes"] == [2]
+    assert degraded["donate_kernel_shapes"] == \
+        base["donate_kernel_shapes"] == []
+    # and re-resolving identical content was served from the resident
+    # constant cache: the cumulative hit counter is nonzero by the
+    # degraded phase (the fail_device_1 re-resolve already hit), and
+    # the process-wide cache shows live entries — zero re-uploaded
+    # constant bytes is the acceptance number the transfer selfcheck
+    # pins process-wide
+    assert degraded["resident_hits"] > 0
+    assert domain_run["resident"]["entries"] > 0
+    assert domain_run["resident"]["hits"] > 0
+
+
+@lifecycle
 def test_healed_device_regrows(domain_run):
     """After the fault clears, the half-open probe sub-chunk re-closes
     device 1's breaker and it rejoins the rotation."""

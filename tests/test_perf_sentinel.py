@@ -33,7 +33,8 @@ def _record(**over):
                      "sha256_envelope": "bbbb"},
         "dispatch_attribution": {"coverage": 0.999},
         "transfer_ledger": {"reconciliation": 1.0, "round_trips": 7,
-                            "redundancy_frac": 0.5},
+                            "redundancy_frac": 0.5,
+                            "redundant_constant_bytes": 0},
         "service": {"lane_latency_ms": {
             "scp": {"p50_ms": 5.0, "p99_ms": 20.0},
             "auth": {"p99_ms": 30.0},
@@ -95,6 +96,42 @@ def test_redundancy_growth_fails_but_shrink_passes():
         _record(),
         _record(**{"transfer_ledger.redundancy_frac": 0.0}))
     assert shrunk["ok"], shrunk["findings"]
+
+
+def test_redundant_bytes_ceiling_is_absolute():
+    """ISSUE 12: redundant constant re-uploads are pinned to a
+    near-zero CEILING (max_abs) — a head past it fails regardless of
+    the base (a growth-ratio rule off the post-rework ~0 baseline
+    would skip forever and never catch the resident cache dying)."""
+    over = sentinel.apply_rules(
+        _record(),
+        _record(**{"transfer_ledger.redundant_constant_bytes": 8320}))
+    assert any(f["path"] == "transfer_ledger.redundant_constant_bytes"
+               and f["rule"] == "max_abs" for f in over["findings"])
+    # ... even when the BASE carried the same regression (no
+    # baseline-poisoning escape hatch)
+    over2 = sentinel.apply_rules(
+        _record(**{"transfer_ledger.redundant_constant_bytes": 8320}),
+        _record(**{"transfer_ledger.redundant_constant_bytes": 8320}))
+    assert not over2["ok"]
+    # within the stray-small-operand headroom: passes
+    ok = sentinel.apply_rules(
+        _record(),
+        _record(**{"transfer_ledger.redundant_constant_bytes": 512}))
+    assert ok["ok"], ok["findings"]
+
+
+def test_redundant_bytes_ceiling_missing_skips():
+    """Old records without the field (pre-ISSUE-12 bench shapes)
+    skip, not fail — the ceiling gates the head record only."""
+    base = _record()
+    head = _record()
+    del head["transfer_ledger"]["redundant_constant_bytes"]
+    out = sentinel.apply_rules(base, head)
+    assert out["ok"], out["findings"]
+    assert any(
+        s.get("path") == "transfer_ledger.redundant_constant_bytes"
+        and s.get("reason") == "missing" for s in out["skipped"])
 
 
 def test_zero_baseline_skips_growth_rule():

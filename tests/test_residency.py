@@ -1,0 +1,213 @@
+"""Device-resident constant cache + donated buffers (ISSUE 12): the
+LRU mechanics, the fingerprint size cap, the engine's placement path
+(resident hit vs fresh upload vs donated one-off), and the invariant
+that a resident buffer is never dispatched through a donating
+executable. The end-to-end forced-4-device acceptance (redundant
+bytes ~0 after warm-up, reconciliation) lives in
+tools/transfer_selfcheck.py (tier-1 TRANSFER_LEDGER_OK)."""
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.parallel import batch_engine, residency
+from stellar_tpu.parallel.residency import (
+    DeviceResidentCache, fingerprint, resident_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    yield
+    bv._reset_dispatch_state_for_testing()
+    bv.configure_dispatch(donate_buffers="auto",
+                          resident_enabled=True,
+                          resident_max_item_bytes=1 << 20)
+
+
+# ---------------- unit: the cache itself ----------------
+
+
+def test_fingerprint_content_derived_and_capped():
+    a = np.arange(16, dtype=np.uint8)
+    assert fingerprint(a, max_bytes=64) == \
+        fingerprint(a.copy(), max_bytes=64)
+    assert fingerprint(a, max_bytes=64) != \
+        fingerprint(a + 1, max_bytes=64)
+    # over the cap: no hash on the hot path, never cached
+    assert fingerprint(a, max_bytes=8) is None
+
+
+def test_cache_hit_keyed_by_content_shape_dtype_placement():
+    c = DeviceResidentCache(max_bytes=1 << 16, max_item_bytes=1 << 12)
+    a = np.arange(8, dtype=np.uint8)
+    fp = fingerprint(a, max_bytes=1 << 12)
+    assert c.get(fp, a, "dev0") is None          # miss
+    sentinel = object()
+    assert c.put(fp, a, "dev0", sentinel) is True
+    assert c.get(fp, a, "dev0") is sentinel      # hit
+    # same bytes at a DIFFERENT placement: distinct entry
+    assert c.get(fp, a, "dev1") is None
+    # same bytes, different layout: distinct entry (shape in the key)
+    b = a.reshape(2, 4)
+    assert c.get(fp, b, "dev0") is None
+    snap = c.snapshot()
+    assert snap["entries"] == 1 and snap["hits"] == 1
+    assert snap["misses"] == 3
+
+
+def test_cache_lru_evicts_by_bytes_and_disable_clears():
+    c = DeviceResidentCache(max_bytes=32, max_item_bytes=64)
+    rows = [np.full(16, i, dtype=np.uint8) for i in range(4)]
+    fps = [fingerprint(r, max_bytes=64) for r in rows]
+    c.put(fps[0], rows[0], "p", "a0")
+    c.put(fps[1], rows[1], "p", "a1")
+    assert c.snapshot()["bytes"] == 32
+    c.put(fps[2], rows[2], "p", "a2")            # evicts the oldest
+    assert c.get(fps[0], rows[0], "p") is None
+    assert c.get(fps[2], rows[2], "p") == "a2"
+    assert c.snapshot()["evictions"] == 1
+    # a hit refreshes recency: 1 is now newest, 2 evicts next
+    c.get(fps[1], rows[1], "p")
+    c.put(fps[3], rows[3], "p", "a3")
+    assert c.get(fps[1], rows[1], "p") == "a1"
+    assert c.get(fps[2], rows[2], "p") is None
+    # disabling drops every resident buffer immediately
+    c.configure(enabled=False)
+    assert c.snapshot()["entries"] == 0
+    assert c.get(fps[1], rows[1], "p") is None
+    assert c.put(fps[1], rows[1], "p", "a1") is False
+
+
+def test_cache_oversize_item_never_retained():
+    c = DeviceResidentCache(max_bytes=8, max_item_bytes=64)
+    big = np.zeros(16, dtype=np.uint8)
+    fp = fingerprint(big, max_bytes=64)
+    assert c.put(fp, big, "p", "arr") is False   # over the byte budget
+    assert c.snapshot()["entries"] == 0
+
+
+# ---------------- engine placement path ----------------
+
+
+class _ResWorkload(batch_engine.Workload):
+    """Trivial identity-ish workload (milliseconds to compile on
+    jax-CPU): one (n, 2) uint8 operand, kernel = first column."""
+
+    metrics_ns = "test.res"
+    span_ns = "res"
+
+    def encode(self, items):
+        arr = np.array([[v, v + 1] for v in items], dtype=np.uint8)
+        return np.ones(len(items), dtype=bool), (arr,)
+
+    def pad_rows(self):
+        return (np.zeros((1, 2), dtype=np.uint8),)
+
+    def kernel_fn(self):
+        def k(a):
+            return a[:, 0]
+        return k
+
+    def empty_result(self, n):
+        return np.zeros(n, dtype=np.uint8)
+
+    def host_result(self, items):
+        return np.array(list(items), dtype=np.uint8)
+
+    def finalize(self, gate, out, items):
+        return out
+
+
+def test_engine_resident_hit_skips_upload_and_never_donates():
+    """Identical content re-dispatched is served from the resident
+    buffer — zero new uploads — and, because it IS a cache entry,
+    never rides the donating executable even with donation forced
+    on (a donated buffer is consumed; the next hit would read a
+    deleted buffer)."""
+    bv.configure_dispatch(donate_buffers="1")
+    eng = batch_engine.BatchEngine(_ResWorkload(), bucket_sizes=(4,))
+    items = [1, 2, 3, 4]
+    assert list(eng.compute_batch(items)) == items
+    # first dispatch uploaded + retained -> not donatable
+    assert eng.donated_dispatches == 0
+    assert eng.resident_hits == 0
+    assert list(eng.compute_batch(items)) == items
+    assert eng.resident_hits == 1                # served resident
+    assert eng.donated_dispatches == 0
+    assert not eng._kernels_donate               # no second executable
+
+
+def test_engine_donates_only_unretained_oneoffs():
+    """Operands over the residency size cap are one-offs: with
+    donation forced on they dispatch through the donate_argnums
+    wrapper; with donation off (or auto on jax-CPU) they use the
+    plain wrapper and the donating cache stays empty."""
+    bv.configure_dispatch(donate_buffers="1",
+                          resident_max_item_bytes=2)  # operand is 8B
+    eng = batch_engine.BatchEngine(_ResWorkload(), bucket_sizes=(4,))
+    assert list(eng.compute_batch([5, 6, 7, 8])) == [5, 6, 7, 8]
+    assert eng.donated_dispatches == 1
+    assert sorted(eng._kernels_donate) == [4]
+    assert eng.resident_hits == 0
+    # auto on jax-CPU: donation off, plain wrapper only
+    bv.configure_dispatch(donate_buffers="auto")
+    eng2 = batch_engine.BatchEngine(_ResWorkload(), bucket_sizes=(4,))
+    assert list(eng2.compute_batch([5, 6, 7, 8])) == [5, 6, 7, 8]
+    assert eng2.donated_dispatches == 0
+    assert not eng2._kernels_donate
+
+
+def test_engine_results_identical_across_residency_modes():
+    """Residency and donation change WHICH buffers move, never any
+    result: the same batch through every knob combination yields
+    identical rows (the oracle contract every lever must keep)."""
+    items = [9, 10, 11, 12]
+    want = [9, 10, 11, 12]
+    for donate, res_on in (("0", True), ("1", True),
+                           ("0", False), ("1", False)):
+        bv.configure_dispatch(donate_buffers=donate,
+                              resident_enabled=res_on)
+        eng = batch_engine.BatchEngine(_ResWorkload(),
+                                       bucket_sizes=(4,))
+        assert list(eng.compute_batch(items)) == want, \
+            (donate, res_on)
+        assert list(eng.compute_batch(items)) == want, \
+            (donate, res_on)
+
+
+def test_dispatch_health_carries_resident_snapshot():
+    eng = batch_engine.BatchEngine(_ResWorkload(), bucket_sizes=(4,))
+    eng.compute_batch([13, 14, 15, 16])
+    health = bv.dispatch_health()
+    assert set(health["resident"]) >= {
+        "enabled", "entries", "bytes", "max_bytes", "hits",
+        "misses", "evictions"}
+    assert health["resident"]["entries"] >= 1
+    assert health["donate_buffers"] in ("auto", "0", "1")
+
+
+def test_reset_clears_resident_cache():
+    eng = batch_engine.BatchEngine(_ResWorkload(), bucket_sizes=(4,))
+    eng.compute_batch([17, 18, 19, 20])
+    assert resident_cache.snapshot()["entries"] >= 1
+    bv._reset_dispatch_state_for_testing()
+    assert resident_cache.snapshot()["entries"] == 0
+
+
+def test_config_pushes_residency_and_donation_knobs():
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.main.config import Config
+    try:
+        Application(Config(VERIFY_RESIDENT_CACHE_BYTES=1 << 16,
+                           VERIFY_RESIDENT_MAX_ITEM_BYTES=1 << 10,
+                           VERIFY_DONATE_BUFFERS="0"))
+        snap = resident_cache.snapshot()
+        assert snap["max_bytes"] == 1 << 16
+        assert snap["max_item_bytes"] == 1 << 10
+        assert batch_engine.DONATE_BUFFERS == "0"
+    finally:
+        bv.configure_dispatch(
+            donate_buffers="auto",
+            resident_cache_bytes=residency.DEFAULT_CACHE_BYTES,
+            resident_max_item_bytes=residency.DEFAULT_MAX_ITEM_BYTES)

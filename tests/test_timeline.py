@@ -213,6 +213,33 @@ def test_engine_hash_resolve_records_pipeline_timeline():
     assert "crypto_pipeline_busy_frac" in prom
 
 
+def test_async_multi_chunk_resolve_overlaps_prep():
+    """ISSUE 12 acceptance shape, in-process: a batch wider than the
+    top bucket rides the pipelined submit loop — chunk k+1's encode/
+    padding happens while chunk k is in flight — so the resolve's
+    record must show nonzero overlap_frac (host prep hidden behind
+    in-flight device work; the old encode-everything-then-dispatch
+    engine measured exactly 0.0)."""
+    import hashlib
+
+    from stellar_tpu.crypto.batch_hasher import BatchHasher
+    from stellar_tpu.utils.timeline import pipeline_timeline
+
+    msgs = [bytes([i % 251]) * ((i * 13) % 90 + 1) for i in range(384)]
+    h = BatchHasher(bucket_sizes=(128,))  # 3 chunks of the top bucket
+    assert h.hash_batch(msgs) == [hashlib.sha256(m).digest()
+                                  for m in msgs]
+    rec = pipeline_timeline.recent(1)[-1]
+    assert rec["ns"] == "crypto.hash"
+    assert rec["parts"] >= 3 and rec["delivered"] >= 3
+    assert rec["prep_ms"] > 0
+    # chunks 2 and 3 prepped while chunk 1 was in flight
+    assert rec["overlap_frac"] is not None
+    assert rec["overlap_frac"] > 0.0
+    assert rec["reconciliation"] is not None
+    assert rec["reconciliation"] >= 0.95
+
+
 def test_gate_empty_resolve_records_nothing():
     """An all-gate-fail batch never dispatches — the dropped token
     must not inflate the ring."""
@@ -506,6 +533,46 @@ def test_stall_device_fault_sleeps_and_never_raises():
         assert faults.counters()["device.dispatch"]["fired"] == 1
     finally:
         faults.clear()
+
+
+def test_stall_transfer_fault_delays_upload_point_only():
+    """ISSUE 12 satellite: the stall-transfer shape delays the H2D
+    upload (``device.transfer``), not the kernel call — so the
+    pipeline profiler's prep-vs-queue_wait attribution is testable
+    against the async loop (the forced-4-device engine check lives in
+    tools/pipeline_selfcheck.py). Like stall-device it sleeps and
+    NEVER raises: a slow transfer lane is a delay, not a failure, and
+    nothing in the fault-tolerance machinery may trip on it."""
+    import time
+
+    faults.set_fault(faults.TRANSFER, "stall-transfer", 1,
+                     seconds=0.05)
+    try:
+        # the kernel-call point is NOT armed: dispatch injection for
+        # the stalled device stays a no-op
+        t0 = time.perf_counter()
+        faults.inject(faults.DISPATCH, device=1)
+        assert time.perf_counter() - t0 < 0.04
+        # other devices' uploads are untouched
+        t0 = time.perf_counter()
+        faults.inject(faults.TRANSFER, device=0)
+        faults.inject(faults.TRANSFER, device=None)  # unattributed
+        assert time.perf_counter() - t0 < 0.04
+        # the armed device's upload stalls, no exception
+        t0 = time.perf_counter()
+        faults.inject(faults.TRANSFER, device=1)
+        assert time.perf_counter() - t0 >= 0.05
+        # device-scoped faults only count calls attributed to their
+        # device (same accounting as the other *-device modes)
+        c = faults.counters()["device.transfer"]
+        assert c == {"mode": "stall-transfer", "calls": 1, "fired": 1}
+    finally:
+        faults.clear()
+
+
+def test_stall_transfer_requires_device_index():
+    with pytest.raises(ValueError):
+        faults.set_fault(faults.TRANSFER, "stall-transfer")
 
 
 # ---------------- knobs + admin routes ----------------

@@ -17,8 +17,12 @@ sentinel compares the newest record (HEAD) against the previous one
   are skipped and listed, like the increase rule;
 * ``max_decrease_abs`` — HEAD must be >= BASE - ``tol`` (pipeline
   overlap fraction: an absolute min-delta, meaningful even off a 0.0
-  baseline — today's blocking engine overlaps nothing, and the
-  async-dispatch win must not silently erode once it lands);
+  baseline — the async dispatch loop's win must not silently erode);
+* ``max_abs`` — HEAD must be <= ``tol``, no BASE needed (redundant
+  constant re-upload bytes: the resident-table rework drove these to
+  ~0, and a near-zero CEILING — not a growth ratio — is what keeps
+  them there: a ratio rule off a ~0 baseline would either skip
+  forever or fire on noise);
 * ``min_value`` — HEAD must be at least ``tol`` (attribution coverage,
   transfer/pipeline reconciliation: the record's own quality gates);
 * ``require_true`` — HEAD must carry a truthy value (analysis proof
@@ -92,6 +96,15 @@ RULES = [
     # probe-sized and live-sized windows, unlike absolute byte counts
     ("transfer_ledger.redundancy_frac", "max_increase_frac", 0.25,
      "redundant-constant re-upload FRACTION grew >25%"),
+    # post-rework ceiling (ISSUE 12): the resident constant cache
+    # holds steady-state re-uploads at ~0 — an absolute near-zero
+    # bound, because a growth ratio off a zero baseline would skip
+    # forever and never catch the cache silently dying. The 4 KiB
+    # headroom tolerates a stray small operand, never a re-shipped
+    # table.
+    ("transfer_ledger.redundant_constant_bytes", "max_abs", 4096,
+     "steady-state constant re-uploads regrew past the near-zero "
+     "ceiling (resident cache not absorbing them)"),
     # per-lane service latency (soak-captured): generous tolerance —
     # wall-clock percentiles across different hosts/windows are noisy;
     # only egregious drift (3x) fails.
@@ -172,6 +185,15 @@ def apply_rules(base: dict, head: dict, rules=None) -> dict:
             if not h_found or h is None:
                 skipped.append({"path": path, "reason": "missing"})
             elif not isinstance(h, (int, float)) or h < tol:
+                findings.append({"path": path, "rule": kind,
+                                 "head": h, "tol": tol, "why": why})
+            continue
+        if kind == "max_abs":
+            # HEAD-only ceiling: meaningful with no baseline at all
+            # (the quantity is pinned near zero, not trended)
+            if not h_found or h is None:
+                skipped.append({"path": path, "reason": "missing"})
+            elif not isinstance(h, (int, float)) or h > tol:
                 findings.append({"path": path, "rule": kind,
                                  "head": h, "tol": tol, "why": why})
             continue

