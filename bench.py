@@ -210,50 +210,27 @@ def _probe_device(timeout_s: float = 180.0):
     return True, plat[0] if plat else "unknown"
 
 
-def _static_kernel_cost(timeout_s: float = 300.0):
-    """Hardware-independent kernel-cost record (tools/kernel_cost.py):
-    traced multiply-op counts and MAC volume per stage, plus the select
-    MAC volume per verify. Runs in a SUBPROCESS pinned to jax-CPU so a
-    dead TPU tunnel can't hang it — this is the number that keeps the
-    perf trajectory non-empty when the device is unreachable."""
+def _static_kernel_cost(timeout_s: float = 420.0):
+    """Hardware-independent kernel-cost record (tools/kernel_cost.py
+    ``--workload=record``): ledger version, traced multiply counts, the
+    executed-MAC headline, the batched-affine table rows, and the
+    SHA-256 workload ledger — ONE subprocess call returning the slim
+    consumer shape the perf sentinel's rule paths walk, replacing the
+    two slightly-divergent slim-dict builders this function used to
+    maintain. Runs in a SUBPROCESS pinned to jax-CPU so a dead TPU
+    tunnel can't hang it — this is the record that keeps the perf
+    trajectory non-empty when the device is unreachable."""
     import subprocess
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tools", "kernel_cost.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         out = subprocess.run(
-            [sys.executable, tool, "--json"], env=env,
-            capture_output=True, text=True, timeout=timeout_s)
-        line = out.stdout.strip().splitlines()[-1]
-        rec = json.loads(line)
+            [sys.executable, tool, "--json", "--workload=record"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        return json.loads(out.stdout.strip().splitlines()[-1])
     except Exception as e:
         return {"error": f"kernel cost tool failed: {e!r}"[:200]}
-    slim = {
-        "select_macs_per_verify": rec.get("select_macs_per_verify"),
-        "table_entries": rec.get("table_entries"),
-        "dsm_static_mul_ops": rec.get("dsm_static_mul_ops"),
-        "dsm_weighted_mul_elems": rec.get("dsm_weighted_mul_elems"),
-        "kernel_static_mul_ops": rec.get(
-            "stages", {}).get("kernel_total", {}).get("static_mul_ops"),
-        "batch": rec.get("batch"),
-    }
-    # workload #2's static ledger rides the same record: the
-    # hash-kernel cost trajectory survives a dead tunnel too
-    try:
-        out = subprocess.run(
-            [sys.executable, tool, "--json", "--workload=sha256"],
-            env=env, capture_output=True, text=True, timeout=timeout_s)
-        sha = json.loads(out.stdout.strip().splitlines()[-1])
-        slim["sha256"] = {
-            "static_ops": sha.get("static_ops"),
-            "weighted_ops": sha.get("weighted_ops"),
-            "add_weighted_elems": sha.get("add_weighted_elems"),
-            "max_blocks": sha.get("max_blocks"),
-            "batch": sha.get("batch"),
-        }
-    except Exception as e:
-        slim["sha256"] = {"error": f"sha256 cost failed: {e!r}"[:200]}
-    return slim
 
 
 def _static_analysis(timeout_s: float = 300.0):

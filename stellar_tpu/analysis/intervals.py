@@ -3,8 +3,9 @@
 The verify kernel's correctness rests on a range claim: with 13-bit limbs
 bounded by ``LOOSE_MAX``, every schoolbook-product coefficient stays below
 2^31 (``ops/field25519.py``). That claim was informal — a comment plus an
-empirical spot check — and every kernel rework (signed windows, future
-batched-affine tables) re-perturbs exactly the limb magnitudes it covers.
+empirical spot check — and every kernel rework (signed windows in PR 1,
+the batched-affine tables + Montgomery inversion chain + strength-reduced
+carry fold in PR 13) re-perturbs exactly the limb magnitudes it covers.
 This module makes it machine-checked, in the spirit of "Efficient
 Verification of Optimized Code: Correct High-speed X25519" (PAPERS.md):
 abstract-interpret the traced jaxpr with per-element ``[lo, hi]``

@@ -376,11 +376,13 @@ class TrickleBatcher:
 # Padding rows: any syntactically valid inputs work (results are sliced
 # off); use the base point with zero scalars so padded lanes stay cheap
 # and never hit the decompress-failure path. Under the signed-window
-# kernel (PR 1) zero scalars recode to all-zero digit streams, so every
-# padded window select rides the identity fixup of
-# ops.edwards.table_select — still valid, still cheap, and R' stays the
-# identity, matching _PAD_R (pinned by
-# tests/test_signed_recode.py::test_padding_rows_recode_to_identity_digits).
+# kernels zero scalars recode to all-zero digit streams, so every
+# padded window select rides the identity patch of
+# ops.edwards.table_select_affine (radix-32, PR 13) /
+# ops.edwards.table_select (radix-16) — still valid, still cheap, and
+# R' stays the identity, matching _PAD_R (pinned by
+# tests/test_signed_recode.py::test_padding_rows_recode_to_identity_digits
+# and its radix-32 sibling test_recode32_padding_rows_are_identity).
 _PAD_A = np.frombuffer(ref.point_compress(ref.BASE), np.uint8).copy()[None]
 _PAD_R = np.frombuffer(ref.point_compress(ref.IDENTITY), np.uint8).copy()[None]
 _PAD_S = np.zeros((1, 32), dtype=np.uint8)

@@ -26,7 +26,9 @@ from stellar_tpu.crypto import ed25519_ref as ref
 __all__ = [
     "identity", "point_add", "point_add_cached", "point_double",
     "to_cached", "decompress", "compress_equals",
-    "negate", "select_point", "table_select", "base_table", "D_LIMBS",
+    "negate", "select_point", "table_select", "table_select_affine",
+    "base_table", "base_table_affine", "build_point_table",
+    "build_point_table_affine", "D_LIMBS",
     "D2_LIMBS", "SQRTM1_LIMBS", "unpack255",
 ]
 
@@ -37,6 +39,17 @@ __all__ = [
 # volume of the unsigned 16-entry scheme (see docs/kernel_design.md).
 WINDOWS = 64       # radix-16 digits per 256-bit scalar
 TABLE_ENTRIES = 8  # one-hot contraction entries per window select
+
+# Signed radix-32 (PR 13, the landed default — see the radix-window
+# sweep decision record in docs/kernel_design.md §3): 52 five-bit
+# windows, 16-entry batched-AFFINE tables (Z normalized to exactly 1 by
+# one Montgomery-batched inversion per table, fe.batch_inv), selected
+# by a log-depth conditional-move tree (ref10 ge25519_select's masked
+# cmov, not a one-hot contraction) — the multiply ledger carries zero
+# select MACs and every A-window add takes the z2_is_one fast path.
+WINDOWS32 = 52        # radix-32 digits per 256-bit scalar
+TABLE_ENTRIES32 = 16  # cmov-tree entries per window select
+AFFINE_COORDS = 3     # affine cached entry: (Y+X, Y-X, 2d*T); Z == 1
 
 # Curve constants as canonical limb vectors (host numpy, broadcast at trace).
 D_LIMBS = fe.from_int(ref.D)
@@ -96,9 +109,16 @@ def point_add_cached(p, q_cached, need_t=True, z2_is_one=False):
     E*H lane of the output multiply — valid whenever the result only
     feeds doublings or encode (both ignore T).  ``z2_is_one`` drops the
     Z1*Z2 lane of the input multiply when q's Z is exactly 1 (the
-    precomputed base table is stored affine)."""
+    precomputed base table is stored affine). ``q_cached`` may be an
+    AFFINE cached triple (Y+X, Y-X, 2d*T) — Z == 1 is implied, so the
+    triple always takes the fast path (batched-affine A-tables,
+    :func:`build_point_table_affine`)."""
     x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
-    ypx2, ymx2, z2, t2d2 = q_cached
+    if len(q_cached) == AFFINE_COORDS:
+        ypx2, ymx2, t2d2 = q_cached
+        z2, z2_is_one = None, True
+    else:
+        ypx2, ymx2, z2, t2d2 = q_cached
     if z2_is_one:
         a, b, c = _mulstack((fe.sub(y1, x1), fe.add(y1, x1), t1),
                             (ymx2, ypx2, t2d2))
@@ -243,20 +263,30 @@ def table_select(table, digit):
             fe.select(neg, fe.neg(t2d), t2d))
 
 
+def _host_affine_cached_row(v: int) -> tuple:
+    """v*B normalized to affine and packed as canonical cached limbs
+    (y+x, y-x, 2d*x*y) — the ONE place the host-side cached-form
+    convention lives; both base-table layouts derive from it."""
+    pt = ref.point_mul(v, ref.BASE)
+    zinv = ref._inv(pt[2])
+    x = pt[0] * zinv % ref.P
+    y = pt[1] * zinv % ref.P
+    return (fe.from_int((y + x) % ref.P),
+            fe.from_int((y - x) % ref.P),
+            fe.from_int(2 * ref.D * x * y % ref.P))
+
+
 def _base_multiples() -> np.ndarray:
     """Host-precomputed v*B for v in 1..8 in CACHED form (y+x, y-x, 1,
     2d*x*y) canonical limbs, shape (8, 4, 20) int32. Z is exactly 1, so
     base-table adds may use the ``z2_is_one`` fast path."""
     out = np.zeros((TABLE_ENTRIES, 4, fe.NLIMBS), dtype=np.int32)
     for v in range(1, TABLE_ENTRIES + 1):
-        pt = ref.point_mul(v, ref.BASE)
-        zinv = ref._inv(pt[2])
-        x = pt[0] * zinv % ref.P
-        y = pt[1] * zinv % ref.P
-        out[v - 1, 0] = fe.from_int((y + x) % ref.P)
-        out[v - 1, 1] = fe.from_int((y - x) % ref.P)
+        ypx, ymx, t2d = _host_affine_cached_row(v)
+        out[v - 1, 0] = ypx
+        out[v - 1, 1] = ymx
         out[v - 1, 2] = fe.from_int(1)
-        out[v - 1, 3] = fe.from_int(2 * ref.D * x * y % ref.P)
+        out[v - 1, 3] = t2d
     return out
 
 
@@ -296,25 +326,187 @@ def build_point_table(p):
     return jnp.moveaxis(jnp.stack(cached), 2, 0)
 
 
+def _extended_multiples(p, entries=16):
+    """Per-batch extended points [1*p .. entries*p] via the even/odd
+    ladder: each round doubles every v with 2v missing (one stacked
+    double) and adds p to every even with v+1 missing (one stacked
+    cached add) — 2v = dbl(v), 2v+1 = 2v + 1. Doubles run 4-wide only
+    when some odd successor will read the T lane; adds always drop it
+    (the affine normalization recomputes T from the inverted Z).
+
+    ``entries`` and the round schedule (``have``) are compile-time
+    Python values — the hotpath lint's taint model needs the schedule
+    separated from the traced point dict to see that."""
+    c1 = to_cached(p)
+    pts = {1: p}
+    have = {1}
+    while len(have) < entries:
+        dbl_src = [v for v in sorted(have)
+                   if 2 * v <= entries and 2 * v not in have]
+        if dbl_src:
+            need_t = any(2 * v + 1 <= entries for v in dbl_src)
+            doubled = _unstack_points(point_double(
+                _stack_points([pts[v] for v in dbl_src]),
+                need_t=need_t), len(dbl_src))
+            for i in range(len(dbl_src)):
+                pts[2 * dbl_src[i]] = doubled[i]
+            have.update(2 * v for v in dbl_src)
+        add_src = [v for v in sorted(have) if v % 2 == 0
+                   and v + 1 <= entries and v + 1 not in have]
+        if add_src:
+            summed = _unstack_points(point_add_cached(
+                _stack_points([pts[v] for v in add_src]),
+                _stack_points([c1] * len(add_src)), need_t=False),
+                len(add_src))
+            for i in range(len(add_src)):
+                pts[add_src[i] + 1] = summed[i]
+            have.update(v + 1 for v in add_src)
+    return [pts[v] for v in range(1, entries + 1)]
+
+
+def build_point_table_affine(p, entries=TABLE_ENTRIES32):
+    """Per-batch AFFINE cached table v*p, v in 1..entries ->
+    (entries, 3, 20, *batch) with coords (Y+X, Y-X, 2d*T) and Z == 1
+    exactly: the ladder's projective Z column is normalized away by ONE
+    Montgomery-batched inversion (:func:`fe.batch_inv` — prefix
+    products over the entry axis stacked on the fused-multiply axis,
+    one true inversion for the whole call, back-substitution), so every
+    window add against this table takes the ``z2_is_one`` fast path
+    that previously only the precomputed base table enjoyed."""
+    pts = _extended_multiples(p, entries)
+    xs = jnp.stack([q[0] for q in pts], axis=1)   # (20, E, *batch)
+    ys = jnp.stack([q[1] for q in pts], axis=1)
+    zs = jnp.stack([q[2] for q in pts], axis=1)
+    zinv = fe.batch_inv(zs)
+    # affine ypx/ymx plus T = X*Y/Z^2 * Z = (X/Z)*(Y/Z): with u = X*zi,
+    # v = Y*zi, t = u*v needs a second pass — cheaper as ONE 3-wide
+    # stacked multiply by zinv of (X+Y, Y-X, T') where T' is the
+    # ladder's projective T... T was dropped (need_t=False) for odd and
+    # terminal entries, so recompute t = (X*zi)*(Y*zi) instead: one
+    # 2-wide multiply, one 1-wide, one d2 scale.
+    uv = fe.mul(jnp.stack([xs, ys], axis=1),
+                jnp.stack([zinv, zinv], axis=1))  # (20, 2, E, *batch)
+    u, v = uv[:, 0], uv[:, 1]
+    t2d = fe.mul(fe.mul(u, v), _const(D2_LIMBS, u.shape[1:]))
+    cached = jnp.stack([fe.add(v, u), fe.sub(v, u), t2d])  # (3,20,E,..)
+    return jnp.moveaxis(cached, 2, 0)  # (E, 3, 20, *batch)
+
+
+def _affine_multiples_host(entries=16) -> np.ndarray:
+    """Host-precomputed v*B, v in 1..entries, affine cached (Y+X, Y-X,
+    2d*X*Y) canonical limbs, shape (entries, 3, 20) int32 (``entries``
+    is a host-side Python int — module-level precompute only). Rows
+    come from the same :func:`_host_affine_cached_row` as the radix-16
+    base table, so the two layouts can never desynchronize."""
+    out = np.zeros((entries, AFFINE_COORDS, fe.NLIMBS), dtype=np.int32)
+    for v in range(1, entries + 1):
+        out[v - 1] = np.stack(_host_affine_cached_row(v))
+    return out
+
+
+_BASE_TABLE32 = _affine_multiples_host(TABLE_ENTRIES32)
+
+
+def base_table_affine(batch_shape):
+    """(16, 3, 20, *batch) broadcast constant affine cached table of
+    v*B, v = 1..16 (the radix-32 loop's B-table)."""
+    t = jnp.asarray(_BASE_TABLE32).reshape(
+        (TABLE_ENTRIES32, AFFINE_COORDS, fe.NLIMBS)
+        + (1,) * len(batch_shape))
+    return jnp.broadcast_to(
+        t, (TABLE_ENTRIES32, AFFINE_COORDS, fe.NLIMBS)
+        + tuple(batch_shape))
+
+
+def table_select_affine(table, digit):
+    """table (16, 3, 20, *batch) affine cached multiples 1*P..16*P;
+    digit (*batch,) int32 SIGNED radix-32 window digit in [-16, 16] ->
+    affine cached triple |digit|*P conditionally negated.
+
+    A log-depth conditional-move tree over the 16 entries — ref10
+    ge25519_select's masked cmov, vectorized: 4 levels of ``where`` on
+    the magnitude's bits, each halving the entry axis. Branchless,
+    constant-shape, VPU select/compare work with ZERO multiplies (the
+    PR 1 one-hot contraction spent 82k MACs/verify here; the executed
+    MAC ledger in docs/kernel_design.md §3 carries the select volume as
+    logic elems instead). Digit 0 is patched to the affine cached
+    identity (1, 1, 0) with one select; negative digits swap
+    Y+X <-> Y-X and negate 2dT — adds and selects, no multiplies.
+
+    Batch-polymorphic like :func:`table_select`: *batch may be stacked,
+    e.g. (2, n) when the B- and A-table selects fuse."""
+    nb = digit.ndim
+    mag = jnp.abs(digit)
+    # cmov tree on (mag - 1) clamped to [0, 15]; mag == 0 lands on
+    # entry 1 and is overwritten by the identity patch below.
+    m = jnp.maximum(mag - 1, 0)
+    sel = table
+    for bit in (8, 4, 2, 1):
+        top = (m >= bit)
+        m = jnp.where(top, m - bit, m)
+        half = sel.shape[0] // 2
+        sel = jnp.where(top[(None,) * (sel.ndim - nb)],
+                        sel[half:], sel[:half])
+    sel = sel[0]  # (3, 20, *batch)
+    is0 = (digit == 0)
+    ident = jnp.asarray(np.stack(
+        [fe.from_int(1), fe.from_int(1), fe.from_int(0)])).reshape(
+            (AFFINE_COORDS, fe.NLIMBS) + (1,) * nb)
+    sel = jnp.where(is0[None, None], ident, sel)
+    ypx, ymx, t2d = sel[0], sel[1], sel[2]
+    neg = digit < 0
+    return (fe.select(neg, ymx, ypx), fe.select(neg, ypx, ymx),
+            fe.select(neg, fe.neg(t2d), t2d))
+
+
+_HALF_LIMBS = fe.from_int((fe.P + 1) // 2)
+
+
+def _extended_from_affine_cached(c):
+    """Affine cached triple (Y+X, Y-X, 2d*T) -> extended (X, Y, 1, T):
+    x = (ypx - ymx)/2, y = (ypx + ymx)/2, t = x*y. Seeds the radix-32
+    loop's accumulator from the top window's B-entry without paying an
+    identity + cached add (the identity triple (1, 1, 0) reconstructs
+    to the identity point exactly)."""
+    ypx, ymx, t2d = c
+    batch = ypx.shape[1:]
+    half = _const(_HALF_LIMBS, batch)
+    xy = fe.mul(jnp.stack([fe.sub(ypx, ymx), fe.add(ypx, ymx)], axis=1),
+                jnp.stack([half, half], axis=1))
+    x, y = xy[:, 0], xy[:, 1]
+    return (x, y, _const(fe.from_int(1), batch), fe.mul(x, y))
+
+
 def double_scalarmult(s_digits, h_digits, a_neg):
-    """R' = s*B + h*a_neg via Strauss-Shamir with SIGNED 4-bit windows.
+    """R' = s*B + h*a_neg via Strauss-Shamir with SIGNED windows.
 
-    s_digits, h_digits: (64, batch) int32 signed radix-16 digits in
-    [-8, 8), most significant first (see
-    :func:`stellar_tpu.ops.verify.signed_digits16_dev`; the top digit may
-    reach 8 for scalars < 2^255, and scalars >= 9 * 2^252 — always
-    rejected by the host canonical-s gate — overflow the top window and
-    yield a well-defined garbage result). a_neg: extended point (the
-    verifier passes -A). Returns a PROJECTIVE (X, Y, Z) triple — T is
-    dropped lane-by-lane throughout the loop because nothing downstream
-    (doublings, encode) reads it.
+    The radix is inferred from the digit count: (52, batch) digits run
+    the radix-32 batched-affine loop (:func:`_double_scalarmult32`, the
+    landed default — see docs/kernel_design.md §3's sweep decision);
+    (64, batch) digits run the PR 1 radix-16 loop
+    (:func:`_double_scalarmult16`, kept traceable as the radix sweep's
+    baseline arm and for the op-level differential suite). a_neg:
+    extended point (the verifier passes -A). Returns a PROJECTIVE
+    (X, Y, Z) triple — T is dropped lane-by-lane throughout because
+    nothing downstream (doublings, encode) reads it.
+    """
+    if s_digits.shape[0] == WINDOWS32:
+        return _double_scalarmult32(s_digits, h_digits, a_neg)
+    return _double_scalarmult16(s_digits, h_digits, a_neg)
 
-    252 shared doublings + 128 cached adds under one fori_loop — the hot
-    loop of the whole framework. Per iteration: three 3-wide doubles, one
-    4-wide double, ONE fused 8-entry one-hot contraction selecting both
-    the B- and A-table windows (the pair rides a stacked batch axis), a
-    z2=1 base add, and a full cached add. Static cost accounting lives in
-    tools/kernel_cost.py; the MAC ledger in docs/kernel_design.md.
+
+def _double_scalarmult16(s_digits, h_digits, a_neg):
+    """Radix-16 Strauss-Shamir (PR 1): (64, batch) signed digits in
+    [-8, 8), most significant first (the top digit may reach 8 for
+    scalars < 2^255, and scalars >= 9 * 2^252 — always rejected by the
+    host canonical-s gate — overflow the top window and yield a
+    well-defined garbage result).
+
+    252 shared doublings + 128 cached adds under one fori_loop. Per
+    iteration: three 3-wide doubles, one 4-wide double, ONE fused
+    8-entry one-hot contraction selecting both the B- and A-table
+    windows (the pair rides a stacked batch axis), a z2=1 base add, and
+    a full projective-table cached add.
     """
     batch = a_neg[0].shape[1:]
     tab_a = build_point_table(a_neg)
@@ -335,3 +527,55 @@ def double_scalarmult(s_digits, h_digits, a_neg):
         return point_add_cached(acc, asel, need_t=False)
 
     return lax.fori_loop(0, 64, body, identity(batch)[:3])
+
+
+def _double_scalarmult32(s_digits, h_digits, a_neg):
+    """Radix-32 batched-affine Strauss-Shamir (PR 13, the hot loop):
+    (52, batch) signed radix-32 digits in [-16, 16), most significant
+    first (:func:`stellar_tpu.ops.verify.signed_digits32_dev`; the top
+    digit absorbs the carry unsigned and stays <= 2 for EVERY 256-bit
+    scalar, so — unlike the radix-16 arm — no scalar overflows its
+    window).
+
+    255 shared doublings + 103 cached adds, ALL of them fast-path:
+    both tables are affine (the base table precomputed, the A-table
+    normalized by one Montgomery-batched inversion per call in
+    :func:`build_point_table_affine`), so every add runs 3-wide on the
+    input multiply, and window selection is a multiply-free cmov tree
+    (:func:`table_select_affine`). The top window skips its doublings
+    entirely: the accumulator seeds from the selected B-entry
+    reconstructed to extended form plus one A-add. Per loop iteration:
+    four 3-wide doubles under an inner fori, one 4-wide double, one
+    fused 16-entry cmov-tree select for the B+A pair, and two affine
+    cached adds. Cost ledger: docs/kernel_design.md §3; enforced by
+    tests/test_kernel_cost.py.
+    """
+    batch = a_neg[0].shape[1:]
+    tab_a = build_point_table_affine(a_neg, TABLE_ENTRIES32)
+    tab_b = base_table_affine(batch)
+    tab = jnp.stack([tab_b, tab_a], axis=3)  # (16, 3, 20, 2, *batch)
+
+    def select_pair(j):
+        sd = lax.dynamic_index_in_dim(s_digits, j, 0, keepdims=False)
+        hd = lax.dynamic_index_in_dim(h_digits, j, 0, keepdims=False)
+        sel = table_select_affine(tab, jnp.stack([sd, hd]))
+        return (tuple(c[:, 0] for c in sel),
+                tuple(c[:, 1] for c in sel))
+
+    # top window: no doublings on a fresh accumulator — seed it from
+    # the B-entry directly and add the A-entry (T produced for the next
+    # window's base add... which reads T off the in-loop 4-wide double,
+    # so even this add can drop its T lane).
+    bsel0, asel0 = select_pair(jnp.int32(0))
+    acc = _extended_from_affine_cached(bsel0)
+    acc = point_add_cached(acc, asel0, need_t=False)
+
+    def body(j, acc):
+        acc = lax.fori_loop(
+            0, 4, lambda _, q: point_double(q, need_t=False), acc)
+        acc = point_double(acc)  # the adds below read T
+        bsel, asel = select_pair(j)
+        acc = point_add_cached(acc, bsel)
+        return point_add_cached(acc, asel, need_t=False)
+
+    return lax.fori_loop(1, WINDOWS32, body, acc)

@@ -45,7 +45,8 @@ assert NLIMBS * LOOSE_MAX * LOOSE_MAX < 2**31
 __all__ = [
     "NLIMBS", "BITS", "MASK", "P", "LOOSE_MAX", "from_int", "to_int",
     "zeros", "add", "sub", "mul", "sqr", "mul_small", "neg", "inv",
-    "pow22523", "canon", "eq", "is_zero", "select", "constant",
+    "inv_scan", "batch_inv", "pow22523", "canon", "eq", "is_zero",
+    "select", "constant",
 ]
 
 
@@ -75,13 +76,24 @@ def zeros(batch_shape=()) -> jnp.ndarray:
     return jnp.zeros((NLIMBS,) + tuple(batch_shape), dtype=jnp.int32)
 
 
+def _fold608(h):
+    """h * 608 strength-reduced to shifts: 608 = 2^9 + 2^6 + 2^5.
+    Value-exact for the non-negative loose-form operands every carry
+    fold sees (proven per-equation by the interval prover), and it
+    keeps the fold off the multiply units — the fold rode EVERY carry
+    round of EVERY field op, so as plain multiplies it accounted for
+    ~4% of the dsm stage's executed MAC volume and ~190 static multiply
+    equations (see the PR 13 ledger in docs/kernel_design.md §3)."""
+    return (h << 9) + (h << 6) + (h << 5)
+
+
 def _carry_step(x):
     """One parallel carry round on a (20, ...) array: every limb keeps its
     low 13 bits and receives the previous limb's overflow; the top limb's
     overflow re-enters limb 0 as * 608. Value mod p is preserved."""
     lo = x & MASK
     hi = x >> BITS
-    wrapped = jnp.concatenate([hi[-1:] * FOLD, hi[:-1]], axis=0)
+    wrapped = jnp.concatenate([_fold608(hi[-1:]), hi[:-1]], axis=0)
     return lo + wrapped
 
 
@@ -145,7 +157,7 @@ def mul(a, b):
     c39 = hi[-1:]  # coeff 39, <= 254k
     # Fold coeffs 20..39 onto 0..19: 2^(13*(20+j)) ≡ 608 * 2^(13*j) (mod p).
     high = jnp.concatenate([c40_low[NLIMBS:], c39], axis=0)  # (20, ...)
-    low = c40_low[:NLIMBS] + FOLD * high  # <= 262k + 608*262k… no:
+    low = c40_low[:NLIMBS] + _fold608(high)  # <= 262k + 608*262k… no:
     # high <= 262k only for the first row; bound: high <= MASK+254k+254k…
     # empirical worst-case bound is checked in tests/test_field25519.py.
     return _carry_step(_carry_step(low))
@@ -174,7 +186,7 @@ def sqr(a):
     c40_low = lo + shifted
     c39 = hi[-1:]
     high = jnp.concatenate([c40_low[NLIMBS:], c39], axis=0)
-    low = c40_low[:NLIMBS] + FOLD * high
+    low = c40_low[:NLIMBS] + _fold608(high)
     return _carry_step(_carry_step(low))
 
 
@@ -230,6 +242,136 @@ def pow22523(z):
     t1, _ = _pow22501(z)
     t1 = _pow2k(t1, 2)
     return mul(z, t1)
+
+
+# Exponent bits of p-2 after the leading 1, most significant first: the
+# square-and-multiply schedule of inv_scan (254 iterations, static).
+_INV_EXP_BITS = np.array(
+    [(P - 2) >> i & 1 for i in range((P - 2).bit_length() - 2, -1, -1)],
+    dtype=np.bool_)
+
+
+def inv_scan(z):
+    """z^(p-2) as a SCAN-shaped square-and-multiply (0 maps to 0).
+
+    Same value as :func:`inv`, different cost shape: the ref10 addition
+    chain unrolls ~770 multiply equations into the jaxpr (fine when the
+    inverse amortizes over a whole stage, ruinous for program size when
+    it doesn't), while this is ONE 254-trip ``lax.scan`` over the
+    static exponent bits — ~70 multiply equations, at ~2.6x the
+    *executed* squaring/multiply volume. Use it where the operand is a
+    single (or near-single) element so executed cost is nil and program
+    size is what matters: the one true inversion inside
+    :func:`batch_inv`."""
+    def body(acc, bit):
+        acc = sqr(acc)
+        return jnp.where(bit, mul(acc, z), acc), None
+    out, _ = lax.scan(body, z, jnp.asarray(_INV_EXP_BITS))
+    return out
+
+
+def _roll_batch(x, shift, width):
+    """Cyclic left-neighbour roll along the flattened batch axis:
+    result[:, b] = x[:, (b - shift) mod width], with a traced ``shift``
+    (dynamic_slice over a doubled copy keeps ONE fori body for every
+    tree level instead of log2(batch) unrolled ones)."""
+    doubled = jnp.concatenate([x, x], axis=1)
+    start = jnp.asarray(width, jnp.int32) - shift.astype(jnp.int32)
+    return lax.dynamic_slice(
+        doubled, (jnp.int32(0), start), (NLIMBS, width))
+
+
+def _inv_all_lanes(t):
+    """Inverse of every lane of ``t`` (NLIMBS, B) paying ONE scalar
+    inversion: log2(B)-level cyclic product tree (Montgomery's trick
+    across the batch axis). Requires B to be a power of two (the jit
+    bucket sizes are); callers pad with multiplicative 1s otherwise.
+
+    Level l of the tree holds, per lane b, the product of the 2^l
+    consecutive lanes ending at b (cyclically). Accumulating each level
+    rolled by the partial width gives the EXCLUSIVE all-but-self
+    product ex[b] = prod_{k != b} t[k] in 2*log2(B) full multiplies;
+    the grand product G (level log2(B), any lane) is inverted once with
+    :func:`inv_scan`, and inv(t[b]) = inv(G) * ex[b]."""
+    width = t.shape[1]
+    levels = max(0, int(width - 1).bit_length())
+    assert width == 1 << levels or width == 1, width
+
+    def body(l, carry):
+        w, ex, shift = carry
+        ex = mul(ex, _roll_batch(w, shift, width))
+        w = mul(w, _roll_batch(w, jnp.int32(1) << l, width))
+        return w, ex, shift + (jnp.int32(1) << l)
+
+    ones = jnp.broadcast_to(
+        jnp.asarray(from_int(1)).reshape(NLIMBS, 1), t.shape)
+    total, ex, _ = lax.fori_loop(
+        0, levels, body, (t, ones, jnp.int32(1)))
+    g = lax.slice(total, (0, 0), (NLIMBS, 1))  # every lane holds G
+    # mul derives batch shape from its first operand: broadcast the
+    # single inverted element across the lanes explicitly
+    return mul(jnp.broadcast_to(inv_scan(g), ex.shape), ex)
+
+
+def batch_inv(z):
+    """Elementwise field inverse of ``z`` with shape (20, N, *batch) —
+    N independent elements per lane stacked on the fused-multiply axis
+    (:func:`stellar_tpu.ops.edwards._mulstack`'s axis) — via
+    Montgomery's trick, paying ONE true inversion for the WHOLE call:
+
+      1. prefix-product scan along the N entries (per lane);
+      2. cyclic product tree across the flattened batch lanes
+         (:func:`_inv_all_lanes`), ending in a single-element
+         :func:`inv_scan`;
+      3. back-substitution scan along the entries.
+
+    Semantics match per-element :func:`inv` exactly, including
+    inv(0) == 0: zero entries are substituted with 1 before the chain
+    (one zero would otherwise annihilate every product it touches —
+    across LANES here, which would break lane independence) and zeroed
+    again afterwards. The substitution triggers only for z ≡ 0 mod p,
+    which valid curve points never produce (complete-formula Z is
+    nonzero), so on the verify path it is dead code that exists to keep
+    garbage lanes from poisoning their neighbours' verdicts."""
+    n = z.shape[1]
+    batch = z.shape[2:]
+    was_zero = is_zero(z)  # (N, *batch) bool
+    one = constant(1, z.shape[1:])
+    zs = select(was_zero, one, z)
+    zmov = jnp.moveaxis(zs, 1, 0)  # (N, 20, *batch)
+
+    def prefix(c, zi):
+        c2 = mul(c, zi)
+        return c2, c2
+
+    total, prefixes = lax.scan(prefix, zmov[0], zmov[1:])
+    prefixes = jnp.concatenate([zmov[:1], prefixes], axis=0)
+
+    # ONE inversion for all lanes: flatten batch, pad to a power of two
+    # with 1s (jit buckets are powers of two, so the pad is usually
+    # width zero and traced away).
+    nbatch = 1
+    for d in batch:
+        nbatch *= int(d)
+    flat = total.reshape(NLIMBS, nbatch)
+    width = 1 if nbatch <= 1 else 1 << (nbatch - 1).bit_length()
+    if width != nbatch:
+        pad1 = jnp.broadcast_to(
+            jnp.asarray(from_int(1)).reshape(NLIMBS, 1),
+            (NLIMBS, width - nbatch))
+        flat = jnp.concatenate([flat, pad1], axis=1)
+    tinv = _inv_all_lanes(flat)[:, :nbatch].reshape(total.shape)
+
+    def backsub(u, xs):
+        zi, cprev = xs
+        inv_i = mul(u, cprev)
+        return mul(u, zi), inv_i
+
+    u_fin, invs_rev = lax.scan(
+        backsub, tinv, (zmov[1:][::-1], prefixes[:-1][::-1]))
+    invs = jnp.concatenate([u_fin[None], invs_rev[::-1]], axis=0)
+    out = jnp.moveaxis(invs, 0, 1)
+    return select(was_zero, zeros(z.shape[1:]), out)
 
 
 def _strict_carry(a):

@@ -27,7 +27,43 @@ from jax import lax
 
 from stellar_tpu.ops import edwards as ed
 
-__all__ = ["verify_kernel", "verify_kernel_sharded", "signed_digits16_dev"]
+__all__ = ["verify_kernel", "verify_kernel_sharded", "signed_digits16_dev",
+           "signed_digits32_dev"]
+
+
+def _signed_window_carry_chain(e, window_bits):
+    """Unsigned window values -> SIGNED window digits, shared by both
+    recodes. ``e``: (windows, batch) int32 window values in
+    [0, 2^window_bits), LEAST significant first. Returns (windows,
+    batch) digits, MOST significant first, with d_i in
+    [-half, half) for i < windows-1 and the TOP window keeping its
+    carry as an unsigned residue so the stream reconstructs the scalar
+    exactly (sum(d_i * 2^(window_bits*i)) == s).
+
+    The carry chain (c_{i+1} = 1 iff e_i + c_i >= half) is a classic
+    generate/propagate recurrence — generate at e_i >= half, propagate
+    at e_i == half-1 — computed in log2(windows) parallel steps with
+    ``lax.associative_scan`` instead of a sequential chain; the
+    half-subtraction is a shift, so no recode work ever reaches the
+    multiply ledger."""
+    windows = e.shape[0]
+    half = 1 << (window_bits - 1)
+    gen = e >= half
+    prop = e == half - 1
+
+    def comb(lo_pair, hi_pair):
+        g1, p1 = lo_pair
+        g2, p2 = hi_pair
+        return g2 | (p2 & g1), p2 & p1
+
+    g_pre, _ = lax.associative_scan(comb, (gen, prop), axis=0)
+    carry_out = g_pre.astype(jnp.int32)             # c_{i+1}
+    carry_in = jnp.concatenate(                     # c_i
+        [jnp.zeros_like(carry_out[:1]), carry_out[:-1]], axis=0)
+    not_top = (jnp.arange(windows, dtype=jnp.int32) < windows - 1)
+    d = e + carry_in - jnp.where(not_top[:, None],
+                                 carry_out << window_bits, 0)
+    return d[::-1]
 
 
 def signed_digits16_dev(b):
@@ -43,44 +79,55 @@ def signed_digits16_dev(b):
     and in [0, 8] for any s < 2^255 — within the 8-entry table range of
     :func:`stellar_tpu.ops.edwards.table_select`. (Scalars >= 9 * 2^252
     overflow the top window; the host canonical-s gate rejects them before
-    the verdict, see double_scalarmult's contract.)
-
-    The nibble carry chain (c_{i+1} = 1 iff e_i + c_i >= 8) is a classic
-    generate/propagate recurrence — generate at e_i >= 8, propagate at
-    e_i == 7 — computed in log2(64) = 6 parallel steps with
-    ``lax.associative_scan`` instead of a 64-long sequential chain.
+    the verdict, see double_scalarmult's contract.) Carry chain:
+    :func:`_signed_window_carry_chain`.
     """
     x = b.astype(jnp.int32)
     lo = x & 15
     hi = x >> 4
     # (64, batch) unsigned nibbles, LEAST significant first
     e = jnp.stack([lo, hi], axis=2).reshape(b.shape[0], 64).T
-    gen = e >= 8
-    prop = e == 7
+    return _signed_window_carry_chain(e, 4)
 
-    def comb(lo_pair, hi_pair):
-        g1, p1 = lo_pair
-        g2, p2 = hi_pair
-        return g2 | (p2 & g1), p2 & p1
 
-    g_pre, _ = lax.associative_scan(comb, (gen, prop), axis=0)
-    carry_out = g_pre.astype(jnp.int32)                # c_{i+1}, i = 0..63
-    carry_in = jnp.concatenate(                        # c_i
-        [jnp.zeros_like(carry_out[:1]), carry_out[:-1]], axis=0)
-    # d_i = e_i + c_i - 16*c_{i+1}, except the top digit keeps its carry
-    # (unsigned residue) so the recode reconstructs every 256-bit value.
-    not_top = (jnp.arange(64, dtype=jnp.int32) < 63).astype(jnp.int32)
-    d = e + carry_in - 16 * carry_out * not_top[:, None]
-    return d[::-1]
+def signed_digits32_dev(b):
+    """(batch, 32) uint8 little-endian scalars -> (52, batch) int32
+    SIGNED radix-32 digits, most significant first — the 5-bit-window
+    sibling of :func:`signed_digits16_dev` for the batched-affine
+    radix-32 loop (PR 13; sweep decision in docs/kernel_design.md §3).
+
+    Digits d_i satisfy sum(d_i * 32^i) == s exactly for EVERY 256-bit
+    s, with d_i in [-16, 16) for i < 51; the top digit absorbs the
+    final carry unsigned. Since window 51 covers bits 255..259 of which
+    only bit 255 exists, the top digit stays in [0, 2] for ALL inputs —
+    every 256-bit scalar fits the 16-entry table range, a strictly
+    stronger contract than the radix-16 recode's (which overflows its
+    top window for s >= 9 * 2^252).
+
+    Five-bit windows straddle byte boundaries, so the bytes unpack to
+    a 256-bit vector first (shift/mask only — no multiplies reach the
+    dsm MAC ledger from the recode); the carry chain (generate at
+    e_i >= 16, propagate at e_i == 15) is the SAME shared
+    :func:`_signed_window_carry_chain` as the radix-16 recode.
+    """
+    nbatch = b.shape[0]
+    bits = ((b[:, :, None].astype(jnp.int32)
+             >> jnp.arange(8, dtype=jnp.int32)) & 1)
+    bits = bits.reshape(nbatch, 256)
+    bits = jnp.pad(bits, ((0, 0), (0, 260 - 256)))
+    e = (bits.reshape(nbatch, 52, 5)
+         << jnp.arange(5, dtype=jnp.int32)).sum(-1)
+    # (52, batch) unsigned 5-bit windows, LEAST significant first
+    return _signed_window_carry_chain(e.T, 5)
 
 
 def dsm_stage(s_bytes, h_bytes, a_neg):
     """Signed-window recode + double-scalarmult: the traceable 'dsm' stage
     of the kernel (tools/kernel_cost.py accounts cost per stage; the
     limb layout, window scheme, and MAC ledger live in
-    docs/kernel_design.md)."""
+    docs/kernel_design.md). Radix-32 batched-affine since PR 13."""
     return ed.double_scalarmult(
-        signed_digits16_dev(s_bytes), signed_digits16_dev(h_bytes), a_neg)
+        signed_digits32_dev(s_bytes), signed_digits32_dev(h_bytes), a_neg)
 
 
 def verify_kernel(a_bytes, r_bytes, s_bytes, h_bytes):

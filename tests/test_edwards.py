@@ -226,6 +226,150 @@ def test_build_point_table_entries():
         assert got == want, v
 
 
+# ---------------- batched-affine tables + radix-32 (ISSUE 13) ----------------
+
+
+def signed_digits32(x, n=52):
+    """msb-first SIGNED radix-32 digits (host reference of the 5-bit
+    recode: digits in [-16, 16), top digit unsigned residue)."""
+    digs = []
+    for i in range(n):
+        d = x & 31
+        x >>= 5
+        if d >= 16 and i < n - 1:
+            d -= 32
+            x += 1
+        digs.append(d)
+    assert x == 0, "scalar wider than n windows"
+    return digs[::-1]
+
+
+def scalars_to_signed_digits32(vals):
+    return jnp.asarray(np.array([signed_digits32(v) for v in vals]).T,
+                       dtype=jnp.int32)
+
+
+def test_build_point_table_affine_entries():
+    """Per-entry check of the batched-affine table: all 16 entries (the
+    full radix-32 range) equal v*P vs ed25519_ref, with Z normalized to
+    EXACTLY 1 by the Montgomery-batched inversion — asserted directly
+    on the cached coords, not just through an add."""
+    pts = random_ref_points(3)
+    dev = to_device(pts)
+    tab = ed.build_point_table_affine(dev, 16)
+    assert tab.shape == (16, 3, fe.NLIMBS, 3)
+    for v in range(1, 17):
+        ypx, ymx, t2d = (tab[v - 1, i] for i in range(3))
+        # affine-ness: the cached coords must BE the canonical affine
+        # values (y+x, y-x, 2dxy), not a projective scaling of them
+        for i, p in enumerate(pts):
+            q = ref.point_mul(v, p)
+            zinv = ref._inv(q[2])
+            x, y = q[0] * zinv % ref.P, q[1] * zinv % ref.P
+            assert int(fe.to_int(fe.canon(ypx))[i]) == (y + x) % ref.P, v
+            assert int(fe.to_int(fe.canon(ymx))[i]) == (y - x) % ref.P, v
+            assert int(fe.to_int(fe.canon(t2d))[i]) == \
+                2 * ref.D * x * y % ref.P, v
+        # and the composed path: identity + cached entry == v*P
+        got = to_affine_ints(ed.point_add_cached(
+            ed.identity((3,)), (ypx, ymx, t2d)))
+        assert got == [ref_affine(ref.point_mul(v, p)) for p in pts], v
+
+
+def test_build_point_table_affine_8_entry_variant():
+    """The generic ladder also serves the 8-entry (radix-16) shape the
+    sweep's affine arm would use — normalizing the PR 1 7-op table."""
+    pts = random_ref_points(2)
+    tab = ed.build_point_table_affine(to_device(pts), 8)
+    assert tab.shape == (8, 3, fe.NLIMBS, 2)
+    for v in range(1, 9):
+        got = to_affine_ints(ed.point_add_cached(
+            ed.identity((2,)), tuple(tab[v - 1, i] for i in range(3))))
+        assert got == [ref_affine(ref.point_mul(v, p)) for p in pts], v
+
+
+def test_table_select_affine_signed_digits():
+    """table_select_affine returns d*P in affine cached form for every
+    d in [-16, 16] — the full signed radix-32 digit range — including
+    the patched cached-identity row at d == 0 (asserted on the raw
+    coords: exactly (1, 1, 0))."""
+    base = random_ref_points(1)[0]
+    dev = to_device([base] * 33)
+    tab = ed.build_point_table_affine(dev, 16)
+    digits = jnp.asarray(np.arange(-16, 17, dtype=np.int32))
+    ypx, ymx, t2d = ed.table_select_affine(tab, digits)
+    got = to_affine_ints(ed.point_add_cached(
+        ed.identity((33,)), (ypx, ymx, t2d)))
+    want = []
+    for d in range(-16, 17):
+        q = ref.point_mul(abs(d), base)
+        if d < 0:
+            q = (ref.P - q[0], q[1], q[2], (ref.P - q[3]) % ref.P)
+        want.append(ref_affine(q))
+    assert got == want
+    # the identity patch row, raw: digit 0 sits at index 16
+    assert int(fe.to_int(fe.canon(ypx))[16]) == 1
+    assert int(fe.to_int(fe.canon(ymx))[16]) == 1
+    assert int(fe.to_int(fe.canon(t2d))[16]) == 0
+
+
+def test_double_scalarmult32_matches_ref():
+    n = 4
+    pts = random_ref_points(n)
+    ss = [secrets.randbelow(ref.L) for _ in range(n)]
+    hs = [secrets.randbelow(ref.L) for _ in range(n)]
+    a_neg = ed.negate(to_device(pts))
+    got = to_affine_ints(ed.double_scalarmult(
+        scalars_to_signed_digits32(ss), scalars_to_signed_digits32(hs),
+        a_neg))
+    want = []
+    for s, h, p in zip(ss, hs, pts):
+        neg = (ref.P - p[0], p[1], p[2], (ref.P - p[3]) % ref.P)
+        want.append(ref_affine(ref.point_add(ref.point_mul(s, ref.BASE),
+                                             ref.point_mul(h, neg))))
+    assert got == want
+
+
+def test_double_scalarmult32_boundary_scalars():
+    """Radix-32 window-scheme edge scalars: 0 (identity-seeded top
+    window AND all-identity selects), digit boundaries 16/-16
+    (0x...10/0x...F0 patterns), L-1, 2^252, and full 256-bit values —
+    the radix-32 recode reconstructs EVERY 256-bit scalar exactly, so
+    unlike the radix-16 arm there is no garbage-overflow regime."""
+    cases = [0, 1, 16, 31, 32, 0x210, ref.L - 1, 2**252, 2**252 - 1,
+             int("f" * 64, 16), int("84210" * 12, 16), 2**255 - 20]
+    n = len(cases)
+    pts = random_ref_points(n)
+    a_neg = ed.negate(to_device(pts))
+    d = scalars_to_signed_digits32(cases)
+    got = to_affine_ints(ed.double_scalarmult(d, d[:, ::-1], a_neg))
+    want = []
+    for s, h, p in zip(cases, reversed(cases), pts):
+        neg = (ref.P - p[0], p[1], p[2], (ref.P - p[3]) % ref.P)
+        want.append(ref_affine(ref.point_add(ref.point_mul(s, ref.BASE),
+                                             ref.point_mul(h, neg))))
+    assert got == want
+
+
+def test_radix_arms_agree():
+    """The sweep's two arms are the SAME function of (s, h, A): for
+    canonical scalars the radix-16 and radix-32 loops must produce the
+    same point — the equivalence that lets the sweep trade them on
+    cost alone."""
+    n = 3
+    pts = random_ref_points(n)
+    ss = [secrets.randbelow(ref.L) for _ in range(n)]
+    hs = [secrets.randbelow(ref.L) for _ in range(n)]
+    a_neg = ed.negate(to_device(pts))
+    got32 = to_affine_ints(ed.double_scalarmult(
+        scalars_to_signed_digits32(ss), scalars_to_signed_digits32(hs),
+        a_neg))
+    got16 = to_affine_ints(ed.double_scalarmult(
+        scalars_to_signed_digits(ss), scalars_to_signed_digits(hs),
+        a_neg))
+    assert got32 == got16
+
+
 def test_compress_equals():
     pts = random_ref_points(4)
     encs = np.stack([np.frombuffer(ref.point_compress(p), dtype=np.uint8)

@@ -129,3 +129,162 @@ def test_eq_is_zero_select():
         True, True, False, True]
     sel = fe.select(jnp.array([True, False]), pack([1, 1]), pack([2, 2]))
     assert list(fe.to_int(fe.canon(sel))) == [1, 2]
+
+
+# ---------------- batched inversion (ISSUE 13) ----------------
+# fe.batch_inv is the enabling primitive of the batched-affine
+# A-tables: Montgomery's trick over the stacked entry axis plus a
+# cross-lane product tree, ONE true inversion per call. Its contract is
+# exact elementwise agreement with fe.inv (including inv(0) == 0 and
+# lane independence around zero entries), and the suite carries the
+# same vacuity discipline as the PR 3 prover mutants: a seeded bug in
+# the back-substitution must be CAUGHT by these differentials.
+
+
+def pack_stack(vals, n, batch):
+    """Row-major list of n*batch ints -> (20, n, batch) limb array."""
+    arr = np.zeros((fe.NLIMBS, n, batch), dtype=np.int32)
+    for j, v in enumerate(vals):
+        v %= 1 << 260
+        for i in range(fe.NLIMBS):
+            arr[i, j // batch, j % batch] = (v >> (fe.BITS * i)) & fe.MASK
+    return jnp.asarray(arr)
+
+
+def _batch_inv_cases(rng, n, batch, zeros_at=()):
+    vals = []
+    boundary = [1, 2, P - 1, P + 1, 19, 608, 2**255 - 20, 2**13,
+                (1 << 255) - 19 - 1]
+    for k in range(n * batch):
+        if k in zeros_at:
+            vals.append(0)
+        elif k < len(boundary):
+            vals.append(boundary[k])
+        else:
+            vals.append(rng.getrandbits(260))
+    return vals
+
+
+@pytest.mark.parametrize("n,batch", [
+    (16, 8),   # the dsm shape class (entries x pow2 lanes)
+    (8, 8),    # radix-16 table width
+    (16, 5),   # non-power-of-two lane count (1s-padded tree)
+    (1, 4),    # degenerate entry axis
+    (3, 1),    # single lane (tree reduces to the scalar inversion)
+])
+def test_batch_inv_matches_inv(n, batch):
+    """Exact elementwise agreement with per-element fe.inv on random
+    and boundary elements across stacked-axis layouts."""
+    rng = random.Random(1000 + n * batch)
+    vals = _batch_inv_cases(rng, n, batch, zeros_at=(2, n * batch - 1))
+    z = pack_stack(vals, n, batch)
+    got = fe.to_int(fe.canon(jax.jit(fe.batch_inv)(z)))
+    want = fe.to_int(fe.canon(jax.jit(fe.inv)(z)))
+    for j in range(n):
+        for b in range(batch):
+            assert got[j, b] == want[j, b], (n, batch, j, b)
+
+
+def test_batch_inv_zero_entries_leave_lanes_independent():
+    """inv(0) == 0 AND a zero entry must not perturb ANY other entry
+    in any lane — the cross-lane Montgomery tree multiplies lanes
+    together, so without the zero guard one garbage lane would
+    annihilate every product it touches (the exact poisoning mode the
+    guard exists for)."""
+    rng = random.Random(77)
+    n, batch = 4, 4
+    vals = _batch_inv_cases(rng, n, batch)
+    z_clean = pack_stack(vals, n, batch)
+    vals_poisoned = list(vals)
+    vals_poisoned[5] = 0       # entry 1, lane 1
+    vals_poisoned[10] = P      # entry 2, lane 2: zero mod p, nonzero limbs
+    z_poisoned = pack_stack(vals_poisoned, n, batch)
+    clean = fe.to_int(fe.canon(fe.batch_inv(z_clean)))
+    poisoned = fe.to_int(fe.canon(fe.batch_inv(z_poisoned)))
+    assert poisoned[1, 1] == 0
+    assert poisoned[2, 2] == 0
+    for j in range(n):
+        for b in range(batch):
+            if (j, b) in ((1, 1), (2, 2)):
+                continue
+            assert poisoned[j, b] == clean[j, b], (j, b)
+
+
+def test_batch_inv_jit_bucket_shapes():
+    """The dsm shape proper: 16 entries x a pow2 jit-bucket-like lane
+    count, under jit (the traced form the overflow prover certifies)."""
+    rng = random.Random(3)
+    n, batch = 16, 32
+    vals = _batch_inv_cases(rng, n, batch)
+    z = pack_stack(vals, n, batch)
+    got = fe.to_int(fe.canon(jax.jit(fe.batch_inv)(z)))
+    for j in range(n):
+        for b in range(batch):
+            v = vals[j * batch + b] % P
+            assert int(got[j, b]) == pow(v, P - 2, P), (j, b)
+
+
+def _batch_inv_dropped_backsub(z):
+    """fe.batch_inv with the seeded bug the suite must catch: the
+    back-substitution drops the prefix-product multiply (inv_i = u
+    instead of u * c_{i-1}), the classic Montgomery-trick slip that
+    still returns the CORRECT inverse for entry 0 — a vacuous test
+    (one that only checks a single entry or only n == 1) would pass
+    it. Mirrors fe.batch_inv exactly otherwise."""
+    from jax import lax
+    n = z.shape[1]
+    was_zero = fe.is_zero(z)
+    one = fe.constant(1, z.shape[1:])
+    zs = fe.select(was_zero, one, z)
+    zmov = jnp.moveaxis(zs, 1, 0)
+
+    def prefix(c, zi):
+        c2 = fe.mul(c, zi)
+        return c2, c2
+
+    total, prefixes = lax.scan(prefix, zmov[0], zmov[1:])
+    prefixes = jnp.concatenate([zmov[:1], prefixes], axis=0)
+    nbatch = 1
+    for d in z.shape[2:]:
+        nbatch *= int(d)
+    flat = total.reshape(fe.NLIMBS, nbatch)
+    width = 1 if nbatch <= 1 else 1 << (nbatch - 1).bit_length()
+    if width != nbatch:
+        pad1 = jnp.broadcast_to(
+            jnp.asarray(fe.from_int(1)).reshape(fe.NLIMBS, 1),
+            (fe.NLIMBS, width - nbatch))
+        flat = jnp.concatenate([flat, pad1], axis=1)
+    tinv = fe._inv_all_lanes(flat)[:, :nbatch].reshape(total.shape)
+
+    def backsub(u, xs):
+        zi, cprev = xs
+        inv_i = u  # MUTANT: dropped `fe.mul(u, cprev)`
+        return fe.mul(u, zi), inv_i
+
+    u_fin, invs_rev = lax.scan(
+        backsub, tinv, (zmov[1:][::-1], prefixes[:-1][::-1]))
+    invs = jnp.concatenate([u_fin[None], invs_rev[::-1]], axis=0)
+    out = jnp.moveaxis(invs, 0, 1)
+    return fe.select(was_zero, fe.zeros(z.shape[1:]), out)
+
+
+def test_mutant_dropped_backsub_multiply_caught():
+    """Vacuity guard (PR 3 discipline): the differential above must
+    have the teeth to convict a dropped back-substitution multiply.
+    The mutant's entry 0 is CORRECT by construction — only the
+    per-entry sweep catches it — and this test pins both facts so the
+    suite can't rot into checking entry 0 alone."""
+    rng = random.Random(9)
+    n, batch = 8, 4
+    vals = _batch_inv_cases(rng, n, batch)
+    z = pack_stack(vals, n, batch)
+    want = fe.to_int(fe.canon(fe.inv(z)))
+    got = fe.to_int(fe.canon(_batch_inv_dropped_backsub(z)))
+    # entry 0 is right — the trap for a lazy differential...
+    assert all(got[0, b] == want[0, b] for b in range(batch))
+    # ...and at least one later entry is provably wrong in every lane
+    mismatches = sum(got[j, b] != want[j, b]
+                     for j in range(1, n) for b in range(batch))
+    assert mismatches > 0, (
+        "the batch_inv differential could not catch a dropped "
+        "back-substitution multiply — the suite is vacuous")

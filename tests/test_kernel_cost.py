@@ -1,12 +1,22 @@
-"""Regression gate for the verify kernel's static cost (PR 1 acceptance):
-the signed-window rework must keep the traced double_scalarmult multiply
-budget >= 30% below the unsigned-window baseline, and the one-hot select
-MAC volume halved — verifiable from the jaxpr alone, no TPU needed.
+"""Regression gate for the verify kernel's static cost ledger.
 
-Baseline constants were captured from the pre-rewrite unsigned kernel at
-the same batch size with the same tool (see docs/kernel_design.md for the
-full ledger); bumping them requires a deliberate docs update, not a code
-drift."""
+Two accepted reworks are enforced on the traced jaxprs, no TPU needed:
+
+* PR 1 (signed radix-16 windows): traced double_scalarmult multiply
+  budget >= 30% below the unsigned-window baseline — STILL enforced on
+  the landed kernel, so the radix-32 rework cannot quietly trade away
+  the program-size win.
+* PR 13 (batched-affine tables via Montgomery-batched inversion +
+  radix-32 windows + cmov-tree selects + strength-reduced carry fold):
+  EXECUTED MACs/call at batch 128 >= 10% below the PR 1 ledger
+  (137 724 544), the radix-window sweep's decision pinned, and the
+  Montgomery chain pinned at ~one inversion per call.
+
+Baseline constants were captured with the same tool (full ledger and
+the sweep decision record: docs/kernel_design.md §3); bumping any of
+them requires a deliberate docs update AND a LEDGER_VERSION bump in
+tools/kernel_cost.py (the perf sentinel re-baselines on it), not a
+code drift."""
 
 import importlib.util
 import os
@@ -27,6 +37,14 @@ BASELINE_UNSIGNED = {
     "kernel_static_mul_ops": 3584,
 }
 
+# Captured 2026-08-02 from the PR 1 signed radix-16 kernel (ledger
+# version 1) — the baseline the PR 13 acceptance is measured against.
+BASELINE_PR1_SIGNED = {
+    "dsm_static_mul_ops": 772,
+    "dsm_weighted_mul_elems": 137_724_544,
+    "kernel_static_mul_ops": 2818,
+}
+
 
 @pytest.fixture(scope="module")
 def kernel_cost():
@@ -41,48 +59,107 @@ def report(kernel_cost):
     return kernel_cost.trace_stages(batch=128)
 
 
+@pytest.fixture(scope="module")
+def sweep(kernel_cost):
+    return kernel_cost.radix_sweep(batch=128)
+
+
 def test_accounting_is_exact(report):
     """Every loop in every stage carries a static trip count (fori_loop
-    lowers to scan here) — the weighted numbers are exact, not bounds."""
+    and the batch_inv/inv_scan scans lower to scan here) — the weighted
+    numbers are exact, not bounds."""
     for name, stage in report["stages"].items():
         assert not stage["has_unbounded_loop"], name
         assert stage["static_mul_ops"] > 0, name
 
 
 def test_dsm_multiply_ops_dropped_30pct(report):
-    """ISSUE 1 acceptance: traced double_scalarmult multiply-op count
-    drops >= 30% vs the unsigned baseline. (Measured drop at rework
-    time: 49.8% static ops, 44.4% static MAC volume.)"""
+    """ISSUE 1 acceptance, still held by the radix-32 kernel: traced
+    double_scalarmult multiply-op count >= 30% below the unsigned
+    baseline. (PR 1 measured -49.8%; PR 13's batch-inversion chain
+    spends some of that headroom — deliberately, for executed volume —
+    and the strength-reduced carry fold buys most of it back.)"""
     base = BASELINE_UNSIGNED["dsm_static_mul_ops"]
     assert report["dsm_static_mul_ops"] <= 0.70 * base, (
         report["dsm_static_mul_ops"], base)
-    base_e = BASELINE_UNSIGNED["dsm_static_mul_elems"]
-    assert report["dsm_static_mul_elems"] <= 0.70 * base_e, (
-        report["dsm_static_mul_elems"], base_e)
 
 
-def test_dsm_executed_mac_volume_dropped(report):
-    """Trip-weighted (executed) MAC volume per kernel call must also
-    fall — the signed windows pay for themselves at runtime, not only
-    in program size. (Measured: -18.6% at rework time.)"""
-    base = BASELINE_UNSIGNED["dsm_weighted_mul_elems"]
-    assert report["dsm_weighted_mul_elems"] <= 0.85 * base, (
-        report["dsm_weighted_mul_elems"], base)
+def test_dsm_executed_macs_dropped_10pct_vs_pr1(report):
+    """ISSUE 13 acceptance: executed MACs/call at batch 128 drops
+    >= 10% vs the PR 1 ledger. (Measured at rework time: -16.4% —
+    affine A-adds dropping the Z1*Z2 lane, selects off the multiply
+    units, 103 adds instead of 128, carry folds as shifts.)"""
+    base = BASELINE_PR1_SIGNED["dsm_weighted_mul_elems"]
+    got = report["dsm"]["executed_macs_per_call"]
+    assert got == report["dsm_weighted_mul_elems"]
+    assert got <= 0.90 * base, (got, base)
 
 
-def test_select_macs_halved(report):
-    """8-entry signed tables halve the one-hot contraction volume."""
-    assert report["table_entries"] == 8
-    assert (report["select_macs_per_verify"]
-            == BASELINE_UNSIGNED["select_macs_per_verify"] // 2)
+def test_enforced_ledger_rows(report, kernel_cost):
+    """Every row of ENFORCED_LEDGER_ROWS (the KERNEL_COST_OK count in
+    tools/tier1.sh) holds on the traced kernel — the single source the
+    tier-1 echo, this suite, and the sentinel paths share."""
+    assert len(kernel_cost.ENFORCED_LEDGER_ROWS) >= 5
+    for path, (ceiling, why) in kernel_cost.ENFORCED_LEDGER_ROWS.items():
+        cur = report
+        for part in path.split("."):
+            assert part in cur, (path, why)
+            cur = cur[part]
+        assert cur <= ceiling, (path, cur, ceiling, why)
+
+
+def test_selects_off_the_multiply_units(report):
+    """PR 13: window selection is a cmov tree — ZERO one-hot MACs; the
+    select work is reported as logic elems, not dropped from the
+    ledger's books (2 tables x 52 windows x 15 cmovs x 3 coords x 20
+    limbs)."""
+    assert report["select_macs_per_verify"] == 0
+    assert report["select_logic_elems_per_verify"] == 2 * 52 * 15 * 3 * 20
+    assert report["table_entries"] == 16
+    assert report["windows"] == 52
+    assert report["radix"] == 32
+
+
+def test_radix_sweep_decision(sweep):
+    """The sweep that chose the landed kernel (docs/kernel_design.md §3
+    decision record): both arms traced, radix-32 wins the executed MAC
+    ledger, and the margin is real (> 5%), not a coin flip."""
+    assert sweep["decision"] == "radix32"
+    r16 = sweep["arms"]["radix16"]["weighted_mul_elems"]
+    r32 = sweep["arms"]["radix32"]["weighted_mul_elems"]
+    assert r32 < 0.95 * r16, (r32, r16)
+    # analytic shape of each arm, pinned so the sweep keeps describing
+    # what actually runs
+    assert sweep["arms"]["radix16"]["table_entries"] == 8
+    assert sweep["arms"]["radix16"]["select_macs"] == 81_920
+    assert sweep["arms"]["radix32"]["doublings"] == 255
+    assert sweep["arms"]["radix32"]["cached_adds"] == 103
+
+
+def test_batch_inv_is_one_inversion_per_call(report):
+    """The Montgomery chain's executed volume must stay near ONE
+    inversion per call. A silent decay to per-lane inversions would
+    cost ~64k elems/lane (~8.2M at batch 128, what compress_compare's
+    single fe.inv measures); the chain's whole budget is pinned well
+    under that."""
+    inv_chain = report["affine_table"]["batch_inv_weighted_mul_elems"]
+    one_inv_per_lane = report["stages"]["compress_compare"][
+        "weighted_mul_elems"]
+    assert inv_chain < 0.5 * one_inv_per_lane, (
+        inv_chain, one_inv_per_lane)
 
 
 def test_current_costs_pinned(report):
-    """Ratchet: the post-rework numbers themselves must not creep back
-    up (5% slack for benign jaxpr shifts across jax versions)."""
-    assert report["dsm_static_mul_ops"] <= 772 * 1.05
-    assert report["dsm_weighted_mul_elems"] <= 137_724_544 * 1.05
-    assert report["stages"]["kernel_total"]["static_mul_ops"] <= 2818 * 1.05
+    """Ratchet: the post-PR-13 numbers themselves must not creep back
+    up (5% slack for benign jaxpr shifts across jax versions).
+    Captured 2026-08-04; ledger version 2."""
+    assert report["ledger_version"] == 2
+    assert report["dsm_static_mul_ops"] <= 905 * 1.05
+    assert report["dsm_weighted_mul_elems"] <= 115_124_540 * 1.05
+    assert report["stages"]["kernel_total"]["static_mul_ops"] <= \
+        2759 * 1.05
+    assert report["affine_table"]["batch_inv_weighted_mul_elems"] <= \
+        3_237_180 * 1.05
 
 
 def test_stage_sum_close_to_total(report):
@@ -95,3 +172,21 @@ def test_stage_sum_close_to_total(report):
              + stages["compress_compare"]["static_mul_ops"])
     total = stages["kernel_total"]["static_mul_ops"]
     assert abs(total - parts) <= 0.02 * parts, (total, parts)
+
+
+def test_slim_record_carries_consumer_rows(kernel_cost):
+    """The ONE consumer shape (bench records + sentinel rule paths):
+    every enforced row resolves in it, the sha256 ledger rides along,
+    and the ledger version is stamped — the contract that replaced the
+    two ad-hoc bench.py parsers."""
+    rec = kernel_cost.slim_record(batch=128)
+    assert rec["ledger_version"] == kernel_cost.LEDGER_VERSION
+    for path in kernel_cost.ENFORCED_LEDGER_ROWS:
+        cur = rec
+        for part in path.split("."):
+            assert part in cur, path
+            cur = cur[part]
+        assert isinstance(cur, int), path
+    assert rec["sha256"]["weighted_ops"] > 0
+    assert rec["dsm"]["executed_macs_per_call"] == \
+        rec["dsm_weighted_mul_elems"]

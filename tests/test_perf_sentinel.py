@@ -22,10 +22,15 @@ spec.loader.exec_module(sentinel)
 def _record(**over):
     rec = {
         "value": 80.0,
-        "kernel_cost": {"dsm_static_mul_ops": 772,
-                        "kernel_static_mul_ops": 2818,
-                        "dsm_weighted_mul_elems": 137724544,
-                        "select_macs_per_verify": 81920,
+        "kernel_cost": {"ledger_version": 2,
+                        "dsm_static_mul_ops": 905,
+                        "kernel_static_mul_ops": 2759,
+                        "dsm_weighted_mul_elems": 115124540,
+                        "select_macs_per_verify": 0,
+                        "dsm": {"executed_macs_per_call": 115124540},
+                        "affine_table": {
+                            "build_weighted_mul_elems": 11521340,
+                            "batch_inv_weighted_mul_elems": 3237180},
                         "sha256": {"weighted_ops": 90269}},
         "analysis": {"ok": True, "overflow_proven": True,
                      "sha256_overflow_proven": True, "lints_ok": True,
@@ -73,6 +78,63 @@ def test_kernel_cost_drift_fails():
         _record(), _record(**{"kernel_cost.dsm_static_mul_ops": 1538}))
     assert not out["ok"]
     assert any(f["path"] == "kernel_cost.dsm_static_mul_ops"
+               for f in out["findings"])
+
+
+def test_executed_macs_family_drift_fails():
+    """ISSUE 13: the executed-MAC headline and the batched-affine
+    stage rows ride the max +2% family — each fires independently."""
+    for path, bad in [
+            ("kernel_cost.dsm.executed_macs_per_call", 137724544),
+            ("kernel_cost.affine_table.build_weighted_mul_elems",
+             20_000_000),
+            ("kernel_cost.affine_table.batch_inv_weighted_mul_elems",
+             8_200_000)]:
+        out = sentinel.apply_rules(_record(), _record(**{path: bad}))
+        assert any(f["path"] == path for f in out["findings"]), path
+    # within tolerance: passes
+    ok = sentinel.apply_rules(
+        _record(),
+        _record(**{"kernel_cost.dsm.executed_macs_per_call":
+                   int(115124540 * 1.01)}))
+    assert ok["ok"], ok["findings"]
+
+
+def test_ledger_version_bump_rebases_kernel_cost_family():
+    """A DELIBERATE window-scheme rework (LEDGER_VERSION bump beside
+    the §3 ledger) re-baselines the kernel_cost.* family: the v1->v2
+    record pair passes with the family skipped and the version change
+    surfaced as a note; every non-kernel-cost rule stays enforced."""
+    v1 = _record(**{"kernel_cost.ledger_version": 1,
+                    "kernel_cost.dsm_static_mul_ops": 772,
+                    "kernel_cost.dsm_weighted_mul_elems": 137724544,
+                    "kernel_cost.select_macs_per_verify": 81920})
+    out = sentinel.apply_rules(v1, _record())
+    assert out["ok"], out["findings"]
+    assert any(n["path"] == "kernel_cost.ledger_version"
+               for n in out["notes"])
+    assert any(s.get("reason") == "ledger-version-rebase"
+               for s in out["skipped"])
+    # the rebase is scoped: a non-kernel-cost regression still fails
+    out2 = sentinel.apply_rules(
+        v1, _record(**{"dispatch_attribution.coverage": 0.5}))
+    assert not out2["ok"]
+    # and a pre-version base record (no key at all) rebases the same
+    # way instead of misreading the rework as drift
+    legacy = _record(**{"kernel_cost.ledger_version": None})
+    del legacy["kernel_cost"]["ledger_version"]
+    out3 = sentinel.apply_rules(legacy, _record())
+    assert out3["ok"], out3["findings"]
+
+
+def test_same_version_pairs_resume_enforcement():
+    """The rebase lasts exactly one pair: two v2 records trend-gate
+    the kernel_cost family again."""
+    out = sentinel.apply_rules(
+        _record(),
+        _record(**{"kernel_cost.dsm_weighted_mul_elems": 137724544}))
+    assert not out["ok"]
+    assert any(f["path"] == "kernel_cost.dsm_weighted_mul_elems"
                for f in out["findings"])
 
 

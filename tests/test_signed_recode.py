@@ -1,8 +1,10 @@
-"""Property tests for the signed radix-16 scalar recode
-(stellar_tpu.ops.verify.signed_digits16_dev) — the device-side half of the
-signed-window kernel (PR 1). The rewrite is only safe if the recode
-reconstructs EVERY scalar exactly and keeps every digit inside the 8-entry
-table range for the scalars that can reach a verdict (s < L)."""
+"""Property tests for the signed scalar recodes — radix-16
+(stellar_tpu.ops.verify.signed_digits16_dev, PR 1) and radix-32
+(signed_digits32_dev, PR 13's batched-affine loop). A rewrite is only
+safe if the recode reconstructs EVERY scalar exactly and keeps every
+digit inside its table range for the scalars that can reach a verdict
+(s < L) — and, for radix-32, for every 256-bit scalar outright (the
+5-bit top window only ever sees bit 255 plus a carry)."""
 
 import numpy as np
 import jax
@@ -10,7 +12,8 @@ import jax.numpy as jnp
 import pytest
 
 from stellar_tpu.crypto import ed25519_ref as ref
-from stellar_tpu.ops.verify import signed_digits16_dev
+from stellar_tpu.ops.verify import (signed_digits16_dev,
+                                    signed_digits32_dev)
 
 L = ref.L
 RNG = np.random.default_rng(0xD161)
@@ -129,3 +132,77 @@ def test_zero_and_one_window_semantics():
     digs = _digits([8])
     assert list(digs[-2:, 0]) == [1, -8]
     assert (digs[:-2, 0] == 0).all()
+
+
+# ---------------- signed radix-32 recode (ISSUE 13) ----------------
+
+
+def _digits32(vals):
+    """Device radix-32 recode -> (52, n) numpy int32, msb first."""
+    rows = jnp.asarray(_to_bytes_rows(vals))
+    return np.asarray(jax.jit(signed_digits32_dev)(rows))
+
+
+def _reconstruct32(digs):
+    v = 0
+    for d in digs:
+        v = v * 32 + int(d)
+    return v
+
+
+def test_recode32_reconstructs_boundary_and_random():
+    """Exact reconstruction for the ISSUE boundary scalars, 5-bit
+    carry-torture patterns (maximal propagate 0b01111 runs, maximal
+    generate 0b10000 runs), and random 256-bit values."""
+    torture = [int("0f" * 32, 16), int("10" * 32, 16),
+               int("7bdef" * 12, 16), 2**255 - 1]
+    vals = BOUNDARY + torture + [
+        int.from_bytes(RNG.bytes(32), "little") for _ in range(512)]
+    digs = _digits32(vals)
+    assert digs.shape == (52, len(vals))
+    for i, v in enumerate(vals):
+        assert _reconstruct32(digs[:, i]) == v, hex(v)
+
+
+def test_recode32_digit_ranges():
+    """Non-top digits live in [-16, 16); the unsigned top digit stays
+    in [0, 2] for EVERY 256-bit scalar (window 51 holds only bit 255
+    plus the carry) — the whole-input-space table-range guarantee the
+    radix-16 recode cannot make."""
+    vals = BOUNDARY + [int.from_bytes(RNG.bytes(32), "little")
+                       for _ in range(512)]
+    digs = _digits32(vals)
+    assert digs[1:].min() >= -16 and digs[1:].max() <= 15
+    assert digs[0].min() >= 0 and digs[0].max() <= 2
+
+
+def test_recode32_matches_scalar_reference():
+    """The vectorized generate/propagate scan agrees digit-for-digit
+    with a sequential ref10-style 5-bit recode."""
+
+    def ref_recode(x):
+        digs = []
+        for i in range(51):
+            d = x & 31
+            x >>= 5
+            if d >= 16:
+                d -= 32
+                x += 1
+            digs.append(d)
+        digs.append(x)
+        return digs[::-1]
+
+    vals = BOUNDARY + [int.from_bytes(RNG.bytes(32), "little")
+                       for _ in range(256)]
+    digs = _digits32(vals)
+    for i, v in enumerate(vals):
+        assert list(digs[:, i]) == ref_recode(v), hex(v)
+
+
+def test_recode32_padding_rows_are_identity():
+    """Padding lanes (s = h = 0) recode to all-zero digits, riding the
+    affine select's identity patch without touching neighbours."""
+    from stellar_tpu.crypto.batch_verifier import _PAD_S, _PAD_H
+    rows = jnp.asarray(np.concatenate([_PAD_S, _PAD_H]))
+    digs = np.asarray(jax.jit(signed_digits32_dev)(rows))
+    assert (digs == 0).all()

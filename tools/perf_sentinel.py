@@ -33,7 +33,13 @@ sentinel compares the newest record (HEAD) against the previous one
 
 A rule whose path is missing from the relevant record(s) is SKIPPED
 and listed — static (dead-tunnel) records legitimately lack live-only
-fields. Exit 0 = no fatal drift; anything else fails the tier-1 gate
+fields. The ``kernel_cost.*`` family is additionally VERSION-SCOPED:
+when the two records carry different ``kernel_cost.ledger_version``
+values (a deliberate window-scheme rework, bumped in
+tools/kernel_cost.py beside the docs/kernel_design.md §3 ledger), the
+family is re-baselined — skipped with a note — instead of trended
+across incomparable cost shapes; the next same-version pair resumes
+enforcement. Exit 0 = no fatal drift; anything else fails the tier-1 gate
 (``PERF_DRIFT_OK``). ``docs/observability.md`` "Perf sentinel" carries
 the same table.
 
@@ -64,6 +70,18 @@ RULES = [
      "executed dsm MAC volume regressed"),
     ("kernel_cost.select_macs_per_verify", "max_increase_frac", 0.02,
      "window-select MAC volume regressed"),
+    # PR 13 batched-affine rows: the executed-MAC headline under its
+    # enforced ledger name, and the affine-table build + Montgomery
+    # batch-inversion chains — if batch_inv decays toward per-lane
+    # inversions (~2.5x these elems), this is where it surfaces.
+    ("kernel_cost.dsm.executed_macs_per_call", "max_increase_frac",
+     0.02, "executed dsm MACs/call regressed (the PR 13 win eroding)"),
+    ("kernel_cost.affine_table.build_weighted_mul_elems",
+     "max_increase_frac", 0.02,
+     "affine A-table build volume regressed"),
+    ("kernel_cost.affine_table.batch_inv_weighted_mul_elems",
+     "max_increase_frac", 0.02,
+     "Montgomery batch-inversion chain volume regressed"),
     ("kernel_cost.sha256.weighted_ops", "max_increase_frac", 0.02,
      "sha256 weighted op volume regressed"),
     # analysis envelope: proof state must hold; the envelope HASH may
@@ -171,7 +189,28 @@ def apply_rules(base: dict, head: dict, rules=None) -> dict:
     findings = []
     notes = []
     skipped = []
+    # A DELIBERATE kernel-cost rework (new window scheme, new ledger —
+    # tools/kernel_cost.py bumps LEDGER_VERSION alongside the
+    # docs/kernel_design.md §3 tables) re-baselines the whole
+    # kernel_cost.* family: trending the new scheme against the old
+    # one's numbers would either fail the gate forever (static ops
+    # traded for executed volume) or silently bless regressions within
+    # the new scheme. The version change itself is surfaced as a note;
+    # the first same-version record pair resumes trend enforcement.
+    _, bver = walk(base, "kernel_cost.ledger_version")
+    _, hver = walk(head, "kernel_cost.ledger_version")
+    ledger_rebased = bver != hver
+    if ledger_rebased:
+        notes.append({"path": "kernel_cost.ledger_version",
+                      "base": bver, "head": hver,
+                      "why": "kernel-cost ledger version changed — "
+                             "family re-baselined (deliberate rework; "
+                             "review docs/kernel_design.md §3)"})
     for path, kind, tol, why in rules:
+        if ledger_rebased and path.startswith("kernel_cost."):
+            skipped.append({"path": path,
+                            "reason": "ledger-version-rebase"})
+            continue
         b_found, b = walk(base, path)
         h_found, h = walk(head, path)
         if kind == "require_true":

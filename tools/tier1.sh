@@ -60,6 +60,15 @@ echo HASH_DIFF_OK=$(timeout -k 5 120 env JAX_PLATFORMS=cpu \
 timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py
 arc=$?
 echo ANALYSIS_RC=$arc
+# Kernel-cost ledger gate width (ISSUE 13): how many ledger rows the
+# cost suite enforces (tools/kernel_cost.py ENFORCED_LEDGER_ROWS,
+# asserted row-by-row in tests/test_kernel_cost.py, trend-gated by the
+# perf sentinel). Pass/fail is already pinned by the pytest gate
+# above; this echoes the enforced width so a PR that silently drops
+# ledger rows is visible from the tier-1 transcript alone.
+echo KERNEL_COST_OK=$(python -c "import sys; sys.path.insert(0, '.'); \
+from tools.kernel_cost import ENFORCED_LEDGER_ROWS as R; print(len(R))" \
+    2>/dev/null || echo 0)
 [ "$arc" -ne 0 ] && exit $arc
 # Metrics/trace export self-check (ISSUE 5): a synthetic host-only
 # resolve must produce a complete per-phase dispatch_attribution whose
