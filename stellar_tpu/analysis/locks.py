@@ -49,6 +49,11 @@ SCOPE = [
     "stellar_tpu/crypto/batch_verifier.py",
     "stellar_tpu/crypto/batch_hasher.py",
     "stellar_tpu/crypto/verify_service.py",
+    # the tenant QoS layer (ISSUE 14): policy table + per-tenant SLO
+    # windows mutate from caller and dispatcher threads under this
+    # module's own locks (the lane queues are service-internal state,
+    # touched only with the service cv held — the _locked convention)
+    "stellar_tpu/crypto/tenant.py",
     "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
     # the device-resident constant cache (ISSUE 12): its LRU mutates

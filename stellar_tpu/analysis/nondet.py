@@ -51,6 +51,12 @@ HOST_ORACLE_FILES = [
     # content-seeded (audit.keep_under_shed) and the scheduler
     # sequence-based, never clocked or RNG-driven
     "stellar_tpu/crypto/verify_service.py",
+    # the tenant QoS layer (ISSUE 14): per-tenant quotas, the
+    # weighted-fair scheduler's virtual-time accounting, and the
+    # tenant-keyed shed fractions all decide WHICH tenant's work
+    # dispatches or sheds — pure integer/content arithmetic, zero
+    # clock reads, NO allowlist entry (pinned in test_analysis.py)
+    "stellar_tpu/crypto/tenant.py",
     # the workload-agnostic batch engine owns dispatch, re-shard,
     # audit-sample composition, and host-oracle failover for EVERY
     # plugin — a clock or RNG here would desynchronize which rows any

@@ -82,17 +82,27 @@ def sample_rows(material: bytes, eligible_rows: Sequence[int],
     return [eligible_rows[p] for p in picks]
 
 
-def keep_under_shed(material: bytes, keep_fraction: float) -> bool:
+def keep_under_shed(material: bytes, keep_fraction: float,
+                    tenant: bytes = b"") -> bool:
     """Deterministic content-seeded keep/drop draw — the verify
     service's load-shed rule (``docs/robustness.md`` "Overload and
     load-shed"), same discipline as the audit sampler above: under
     identical overload pressure, replicas holding the same queued work
     shed IDENTICAL rows, because the draw is SHA-256 of the work's own
     bytes mapped uniformly into [0, 1) — no clocks, no RNG state, no
-    hash salts, no dependence on queue composition (a submission keeps
-    or sheds the same way regardless of what else is queued, so a
-    repeated shed pass is stable: survivors keep surviving until the
-    pressure level changes the fraction).
+    hash salts. The draw itself never depends on queue composition (a
+    submission's draw is fixed by its bytes), so survivors keep
+    surviving as long as their effective keep fraction holds; only a
+    pressure-level or tenant-pressure change in the FRACTION can shed
+    a previous survivor.
+
+    ``tenant`` (ISSUE 14) mixes the submitting tenant's key into the
+    draw — length-prefixed, so distinct (tenant, material) splits can
+    never alias — giving each tenant an independent shed stream: a
+    per-tenant keep fraction then sheds a flooding tenant's own rows
+    first while replicas still agree row-by-row. The empty key (the
+    default/un-tenanted stream) preserves the historical draw bytes
+    exactly.
 
     Returns True = KEEP (verify this work), False = SHED it. The
     boundary cases short-circuit without hashing: ``keep_fraction >=
@@ -101,6 +111,9 @@ def keep_under_shed(material: bytes, keep_fraction: float) -> bool:
         return True
     if keep_fraction <= 0.0:
         return False
+    if tenant:
+        material = (len(tenant).to_bytes(2, "little") + tenant
+                    + material)
     h = hashlib.sha256(material).digest()
     draw = int.from_bytes(h[:8], "little") / float(1 << 64)
     return draw < keep_fraction

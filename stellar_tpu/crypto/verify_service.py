@@ -42,6 +42,28 @@ submission is globally OLDEST (smallest admission sequence number)
 regardless of priority, so the bulk lane always drains — deterministic
 in arrival order, no wall-clock reads in any scheduling decision.
 
+**Multi-tenant QoS** (ISSUE 14, ``stellar_tpu/crypto/tenant.py``):
+``submit(lane=..., tenant=...)`` keys every submission to a principal.
+Per-tenant depth/byte quotas nest inside the lane budgets (refused at
+ingress with ``Overloaded(tenant=...)``, reasons ``"tenant-depth"`` /
+``"tenant-bytes"``); WITHIN a lane, queued tenants are served by a
+deterministic weighted-fair scheduler (start-time fair queueing over
+sequence-based virtual time — integer arithmetic, zero clock reads,
+same nondet posture as the aging rule); the shed ladder draws
+tenant-keyed (``audit.keep_under_shed(..., tenant=...)``) with a
+flooding tenant's effective keep fraction scaled down by how far it
+sits over its own quota high-water, so its rows shed first; and every
+scheduling/shed decision lands BOTH in the flight recorder
+(``service.schedule`` / ``service.shed`` events, with the decision's
+input window) and in a bounded in-order decision log
+(:meth:`VerifyService.decision_log`) — two replicas fed the same
+arrival order emit bit-identical decision sequences
+(``tools/tenant_selfcheck.py``, tier-1 ``TENANT_QOS_OK``). Per-tenant
+work conservation holds exactly (:meth:`VerifyService.
+tenant_snapshot`), and per-tenant SLO burn rates ride
+:data:`stellar_tpu.crypto.tenant.tenant_slo` under the rank-keyed
+metric-cardinality guard.
+
 **Work conservation law** (pinned by ``tools/soak.py`` and the tier-1
 ``SOAK_OK`` gate): for every lane,
 
@@ -72,6 +94,8 @@ import numpy as np
 
 from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.crypto import batch_verifier
+from stellar_tpu.crypto import tenant as tenant_mod
+from stellar_tpu.utils import metrics as metrics_mod
 from stellar_tpu.utils import resilience
 from stellar_tpu.utils.metrics import registry
 from stellar_tpu.utils.tracing import span
@@ -80,7 +104,7 @@ __all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
            "SHED_LADDER", "configure_service", "default_service",
            "running_service", "service_verified", "service_health",
            "lane_latencies", "SloMonitor", "slo_monitor",
-           "configure_slo", "slo_health"]
+           "configure_slo", "slo_health", "tenant_health"]
 
 # re-export: the typed admission verdict lives with the resilience
 # primitives so TrickleBatcher can raise it without a module cycle
@@ -103,6 +127,11 @@ MAX_BATCH = int(os.environ.get("VERIFY_SERVICE_MAX_BATCH", "2048"))
 PIPELINE_DEPTH = int(os.environ.get("VERIFY_SERVICE_PIPELINE_DEPTH",
                                     "4"))
 AGING_EVERY = int(os.environ.get("VERIFY_SERVICE_AGING_EVERY", "4"))
+# bounded in-order log of scheduling + shed decisions (ISSUE 14): the
+# replica-determinism surface — two services fed identical arrival
+# order must produce identical logs (tools/tenant_selfcheck.py)
+DECISION_LOG = int(os.environ.get("VERIFY_SERVICE_DECISION_LOG",
+                                  "8192"))
 
 # Degradation ladder: pressure level -> {lane: keep_fraction}. A lane
 # absent from a level is NEVER shed at that level; scp is absent from
@@ -176,10 +205,9 @@ class SloMonitor:
         self._lat = {ln: self._fresh() for ln in LANES}
         self._comp = {ln: self._fresh() for ln in LANES}
 
-    @staticmethod
-    def _fresh() -> dict:
-        return {"events": deque(), "bad": 0, "total": 0,
-                "bad_total": 0}
+    # window-state machinery is the shared metrics helpers (ONE
+    # implementation for the lane and tenant monitors)
+    _fresh = staticmethod(metrics_mod.fresh_burn_window)
 
     def configure(self, window: Optional[int] = None) -> None:
         if window is None:
@@ -191,17 +219,10 @@ class SloMonitor:
                     self._trim_locked(st)
 
     def _trim_locked(self, st: dict) -> None:
-        while len(st["events"]) > self._window:
-            st["bad"] -= st["events"].popleft()
+        metrics_mod.trim_burn_window(st, self._window)
 
     def _push_locked(self, st: dict, bad: bool, n: int) -> None:
-        flag = 1 if bad else 0
-        for _ in range(n):
-            st["events"].append(flag)
-        st["bad"] += flag * n
-        st["total"] += n
-        st["bad_total"] += flag * n
-        self._trim_locked(st)
+        metrics_mod.push_burn_window(st, bad, n, self._window)
 
     def note_latency(self, lane: str, wait_ms: float,
                      n: int = 1) -> None:
@@ -383,13 +404,16 @@ class VerifyTicket:
     batch failed — an admitted submission ALWAYS resolves to exactly
     one of verified / shed / failed, never silence."""
 
-    __slots__ = ("lane", "n_items", "trace_lo", "_items", "_nbytes",
-                 "_digest", "_seq", "_t_enq", "_fut")
+    __slots__ = ("lane", "tenant", "n_items", "trace_lo", "_items",
+                 "_nbytes", "_digest", "_seq", "_t_enq", "_fut",
+                 "_vstart", "_vfinish")
 
     def __init__(self, lane: str, items, nbytes: int, digest: bytes,
-                 seq: int, t_enq: float, trace_lo: int = 0):
+                 seq: int, t_enq: float, trace_lo: int = 0,
+                 tenant: str = tenant_mod.DEFAULT_TENANT):
         from concurrent.futures import Future
         self.lane = lane
+        self.tenant = tenant
         self.n_items = len(items)
         self.trace_lo = trace_lo
         self._items = items
@@ -398,6 +422,9 @@ class VerifyTicket:
         self._seq = seq
         self._t_enq = t_enq
         self._fut = Future()
+        # stamped by the lane's weighted-fair queue at admission
+        self._vstart = 0
+        self._vfinish = 0
 
     @property
     def trace_ids(self) -> range:
@@ -439,14 +466,27 @@ class VerifyService:
         self._aging_every = AGING_EVERY if aging_every is None \
             else max(0, int(aging_every))
         self._cv = threading.Condition()
-        self._queues: Dict[str, deque] = {ln: deque() for ln in LANES}
+        self._queues: Dict[str, tenant_mod.TenantLaneQueue] = {
+            ln: tenant_mod.TenantLaneQueue() for ln in LANES}
         self._queued_items = {ln: 0 for ln in LANES}
         self._queued_bytes = {ln: 0 for ln in LANES}
         self._inflight_bytes = {ln: 0 for ln in LANES}
+        # per-(lane, tenant) in-flight bytes: the tenant byte quota
+        # nests inside the lane's queued+in-flight budget, so it must
+        # charge the same window — queued alone would let a tenant
+        # hold (pipeline_depth+1)x its quota of lane capacity
+        self._tenant_inflight = {ln: {} for ln in LANES}
         self._inflight_items = 0
         self._counts = {ln: {"submitted": 0, "verified": 0,
                              "rejected": 0, "shed": 0, "failed": 0}
                         for ln in LANES}
+        # per-tenant conservation counters (ISSUE 14): submitted ==
+        # verified + rejected + shed + failed + pending PER TENANT;
+        # bounded by the tenant tracking cap (overflow folds into the
+        # reserved OTHER_TENANT rollup, counted — never silent)
+        self._tenant_counts: Dict[str, dict] = {}
+        # bounded in-order scheduling/shed decision log (ISSUE 14)
+        self._decisions: deque = deque(maxlen=max(16, DECISION_LOG))
         self._seq = 0
         self._batches = 0
         self._pressure = 0
@@ -482,18 +522,29 @@ class VerifyService:
                 target=self._run, daemon=True, name="verify-service")
         self._thread.start()
         batch_verifier.register_service_health(self.snapshot)
+        global _tenant_provider
+        with _service_lock:
+            # the tenant route serves the last-started instance (same
+            # policy as register_service_health: an embedded service
+            # still gets an admin surface)
+            _tenant_provider = self.tenant_snapshot
         return self
 
-    def submit(self, items: Sequence[tuple],
-               lane: str = "bulk") -> VerifyTicket:
+    def submit(self, items: Sequence[tuple], lane: str = "bulk",
+               tenant: Optional[str] = None) -> VerifyTicket:
         """Admit one submission of (pk, msg, sig) triples into
-        ``lane``. Raises :class:`Overloaded` (``kind="rejected"``) at
-        ingress when the lane's queue-depth or byte budget is
-        exhausted, or the service is stopping — rejected work never
-        enters a queue, so memory stays bounded no matter the offered
-        load."""
+        ``lane`` on behalf of ``tenant`` (None = the quota-exempt
+        default tenant). Raises :class:`Overloaded`
+        (``kind="rejected"``) at ingress when the lane's queue-depth
+        or byte budget is exhausted, the tenant's own depth/byte
+        quota inside the lane is exhausted (``reason="tenant-depth"``
+        / ``"tenant-bytes"``, ``tenant`` set on the exception), or
+        the service is stopping — rejected work never enters a queue,
+        so memory stays bounded no matter the offered load."""
         if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r} (one of {LANES})")
+        tenant = tenant_mod.validate_tenant(tenant)
+        weight, t_depth, t_bytes = tenant_mod.tenant_policy(tenant)
         items = list(items)
         n = len(items)
         nbytes = 0
@@ -518,6 +569,8 @@ class VerifyService:
             f"crypto.verify.service.lane.{lane}.submitted").mark(n)
         with self._cv:
             self._counts[lane]["submitted"] += n
+            tc = self._tenant_counts_locked(tenant)
+            tc["submitted"] += n
             reason = None
             if self._stop or not self._running:
                 reason = "stopped"
@@ -526,31 +579,54 @@ class VerifyService:
             elif (self._queued_bytes[lane] + self._inflight_bytes[lane]
                   + nbytes) > self._lane_bytes:
                 reason = "bytes"
+            # per-tenant quotas NEST inside the lane budgets (ISSUE
+            # 14): one tenant exhausts its own slice of the lane and
+            # gets a typed, tenant-attributed refusal while in-quota
+            # tenants keep submitting
+            elif t_depth and \
+                    self._queues[lane].depth(tenant) >= t_depth:
+                reason = "tenant-depth"
+            elif t_bytes and (self._queues[lane].queued_bytes(tenant)
+                              + self._tenant_inflight[lane].get(
+                                  tenant, 0)
+                              + nbytes) > t_bytes:
+                reason = "tenant-bytes"
             if reason is not None:
                 self._counts[lane]["rejected"] += n
+                tc["rejected"] += n
                 registry.meter(
                     "crypto.verify.service.rejected").mark(n)
                 registry.meter(
                     f"crypto.verify.service.lane.{lane}.rejected"
                 ).mark(n)
+                if reason.startswith("tenant-"):
+                    tc["quota_rejected"] += n
+                    registry.meter(
+                        "crypto.verify.service.tenant.quota_rejected"
+                    ).mark(n)
                 # a rejected item is a completion-SLO miss: it
                 # consumed the lane's shed/reject budget (ISSUE 10)
+                # and the tenant's own budget (ISSUE 14)
                 slo_monitor.note_completion(lane, ok=False, n=n)
+                tenant_mod.tenant_slo.note_completion(tenant, ok=False,
+                                                      n=n)
                 batch_verifier.note_trace_event(
                     "service.reject", lane=lane, reason=reason,
-                    traces=trange, items=n)
+                    tenant=tenant, traces=trange, items=n)
                 raise Overloaded(
                     f"verify service {lane} lane over budget "
                     f"({reason})", kind="rejected", lane=lane,
-                    reason=reason,
+                    reason=reason, tenant=tenant,
                     trace_ids=range(trace_lo, trace_lo + n))
             tkt = VerifyTicket(lane, items, nbytes, digest,
-                               self._seq, t_enq, trace_lo=trace_lo)
+                               self._seq, t_enq, trace_lo=trace_lo,
+                               tenant=tenant)
             self._seq += 1
             if n == 0:
                 tkt._fut.set_result(np.zeros(0, dtype=bool))
                 return tkt
-            self._queues[lane].append(tkt)
+            self._queues[lane].push(tkt, weight)
+            tc["pending"] += n
             self._queued_items[lane] += n
             self._queued_bytes[lane] += nbytes
             self._publish_lane_gauges_locked(lane)
@@ -563,15 +639,17 @@ class VerifyService:
             # timeline (trace_timeline) must never see a verdict
             # before its enqueue.
             batch_verifier.note_trace_event(
-                "service.enqueue", lane=lane, traces=trange,
-                seq=tkt._seq, items=n)
+                "service.enqueue", lane=lane, tenant=tenant,
+                traces=trange, seq=tkt._seq, items=n)
             self._cv.notify_all()
         return tkt
 
     def verify(self, items: Sequence[tuple], lane: str = "bulk",
-               timeout: Optional[float] = None) -> np.ndarray:
+               timeout: Optional[float] = None,
+               tenant: Optional[str] = None) -> np.ndarray:
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(items, lane=lane).result(timeout)
+        return self.submit(items, lane=lane,
+                           tenant=tenant).result(timeout)
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
@@ -645,9 +723,64 @@ class VerifyService:
                           "aging_every": self._aging_every},
             }
 
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant accounting surface (the ``tenant`` admin route,
+        ISSUE 14): the conservation counters per tracked tenant, each
+        tenant's residual (must read 0 — the per-tenant work
+        conservation law), and decision-log accounting. Tenants past
+        the tracking cap fold into the reserved ``~other`` rollup
+        (counted, never silent)."""
+        with self._cv:
+            tenants = {t: dict(c)
+                       for t, c in self._tenant_counts.items()}
+            n_decisions = len(self._decisions)
+        gaps = {}
+        for t, c in tenants.items():
+            c["conservation_gap"] = (
+                c["submitted"] - c["verified"] - c["rejected"]
+                - c["shed"] - c["failed"] - c["pending"])
+            if c["conservation_gap"] != 0:
+                gaps[t] = c["conservation_gap"]
+        return {"tenants": tenants,
+                "tracked": len(tenants),
+                "track_cap": tenant_mod.TENANT_TRACK_CAP,
+                "conservation_violations": gaps,
+                "decision_log_len": n_decisions}
+
+    def decision_log(self, limit: int = 0) -> list:
+        """The bounded in-order scheduling/shed decision log:
+        ``("dispatch", lane, tenant, seq, vfinish)`` per weighted-fair
+        pop and ``("shed", lane, tenant, seq, level)`` per shed row.
+        Two replicas fed identical arrival order produce identical
+        logs — the bit-identical surface ``tools/tenant_selfcheck.py``
+        gates on. ``limit`` bounds the tail returned (0 = all
+        retained)."""
+        with self._cv:
+            log = list(self._decisions)
+        return log[-limit:] if limit else log
+
     # ---------------- dispatcher internals ----------------
     # _locked helpers are called with self._cv held (the repo-wide
     # naming contract the lock lint encodes).
+
+    def _tenant_counts_locked(self, tenant: str) -> dict:
+        """Get-or-create one tenant's conservation counters, folding
+        into the reserved OTHER_TENANT rollup once the tracking cap is
+        reached — a tenant folded at submit keeps folding at every
+        later transition (entries are never removed), so the rollup's
+        own conservation stays exact."""
+        tc = self._tenant_counts.get(tenant)
+        if tc is None:
+            if len(self._tenant_counts) >= \
+                    tenant_mod.TENANT_TRACK_CAP and \
+                    tenant != tenant_mod.OTHER_TENANT:
+                return self._tenant_counts_locked(
+                    tenant_mod.OTHER_TENANT)
+            tc = self._tenant_counts[tenant] = {
+                "submitted": 0, "verified": 0, "rejected": 0,
+                "quota_rejected": 0, "shed": 0, "failed": 0,
+                "pending": 0}
+        return tc
 
     def _publish_lane_gauges_locked(self, ln: str) -> None:
         """Live backlog gauges (ISSUE 10 satellite): queue depth and
@@ -676,11 +809,18 @@ class VerifyService:
     def _shed_pass_locked(self) -> Optional[str]:
         """Apply the shed ladder to the queues at the current pressure
         level. Row selection is the content-seeded rule
-        (:func:`stellar_tpu.crypto.audit.keep_under_shed`) so replicas
-        shed identical rows; every shed is counted and ticketed.
-        Returns the pressure reason when THIS pass was the first-ever
-        shed (the caller fires the flight-recorder dump outside the
-        lock), else None."""
+        (:func:`stellar_tpu.crypto.audit.keep_under_shed`) with the
+        TENANT key mixed in (ISSUE 14), and each tenant's effective
+        keep fraction is the ladder fraction scaled down by how far
+        that tenant sits over its own quota high-water
+        (:func:`stellar_tpu.crypto.tenant.shed_keep_fraction`) — a
+        flooding tenant's rows shed first, in-quota tenants keep the
+        lane fraction, and replicas under identical arrival order
+        still shed identical rows (all inputs are queue state +
+        content, no clocks). Every shed is counted, ticketed, logged
+        in the decision log. Returns the pressure reason when THIS
+        pass was the first-ever shed (the caller fires the
+        flight-recorder dump outside the lock), else None."""
         level, why = self._pressure_locked()
         self._pressure = level
         registry.gauge("crypto.verify.service.pressure").set(level)
@@ -692,15 +832,28 @@ class VerifyService:
             q = self._queues[ln]
             if not q:
                 continue
-            kept: deque = deque()
-            while q:
-                tkt = q.popleft()
-                if audit_mod.keep_under_shed(tkt._digest, keep):
-                    kept.append(tkt)
-                    continue
+            # per-tenant effective keep fractions, computed ONCE per
+            # pass from the queue state this pass sees
+            eff = {}
+            for t, subs in q.tenant_depths().items():
+                _w, t_depth, _b = tenant_mod.tenant_policy(t)
+                eff[t] = tenant_mod.shed_keep_fraction(
+                    keep, subs, t_depth, level=level)
+
+            def _keep(tkt):
+                return audit_mod.keep_under_shed(
+                    tkt._digest, eff[tkt.tenant],
+                    tenant=tenant_mod.shed_key(tkt.tenant))
+
+            for tkt in q.drain_if(_keep):
                 self._queued_items[ln] -= tkt.n_items
                 self._queued_bytes[ln] -= tkt._nbytes
                 self._counts[ln]["shed"] += tkt.n_items
+                tc = self._tenant_counts_locked(tkt.tenant)
+                tc["shed"] += tkt.n_items
+                tc["pending"] -= tkt.n_items
+                self._decisions.append(
+                    ("shed", ln, tkt.tenant, tkt._seq, level))
                 registry.meter(
                     "crypto.verify.service.shed").mark(tkt.n_items)
                 registry.meter(
@@ -708,18 +861,21 @@ class VerifyService:
                 ).mark(tkt.n_items)
                 slo_monitor.note_completion(ln, ok=False,
                                             n=tkt.n_items)
+                tenant_mod.tenant_slo.note_completion(
+                    tkt.tenant, ok=False, n=tkt.n_items)
                 if not self._shed_seen:
                     self._shed_seen = True
                     onset = why
                 batch_verifier.note_trace_event(
                     "service.shed", lane=ln, reason=why, level=level,
+                    tenant=tkt.tenant,
+                    keep_fraction=round(eff[tkt.tenant], 6),
                     traces=[[tkt.trace_lo,
                              tkt.trace_lo + tkt.n_items]])
                 tkt._fut.set_exception(Overloaded(
                     f"shed under overload (level {level}: {why})",
                     kind="shed", lane=ln, reason=why,
-                    trace_ids=tkt.trace_ids))
-            self._queues[ln] = kept
+                    tenant=tkt.tenant, trace_ids=tkt.trace_ids))
             self._publish_lane_gauges_locked(ln)
         return onset
 
@@ -727,12 +883,13 @@ class VerifyService:
         """Non-drain stop: shed every queued submission (counted,
         ticketed — reason ``"stopped"``, never silent)."""
         for ln in LANES:
-            q = self._queues[ln]
-            while q:
-                tkt = q.popleft()
+            for tkt in self._queues[ln].drain_if(None):
                 self._queued_items[ln] -= tkt.n_items
                 self._queued_bytes[ln] -= tkt._nbytes
                 self._counts[ln]["shed"] += tkt.n_items
+                tc = self._tenant_counts_locked(tkt.tenant)
+                tc["shed"] += tkt.n_items
+                tc["pending"] -= tkt.n_items
                 registry.meter(
                     "crypto.verify.service.shed").mark(tkt.n_items)
                 registry.meter(
@@ -740,13 +897,16 @@ class VerifyService:
                 ).mark(tkt.n_items)
                 slo_monitor.note_completion(ln, ok=False,
                                             n=tkt.n_items)
+                tenant_mod.tenant_slo.note_completion(
+                    tkt.tenant, ok=False, n=tkt.n_items)
                 batch_verifier.note_trace_event(
                     "service.shed", lane=ln, reason="stopped",
+                    tenant=tkt.tenant,
                     traces=[[tkt.trace_lo,
                              tkt.trace_lo + tkt.n_items]])
                 tkt._fut.set_exception(Overloaded(
                     "service stopped without drain", kind="shed",
-                    lane=ln, reason="stopped",
+                    lane=ln, reason="stopped", tenant=tkt.tenant,
                     trace_ids=tkt.trace_ids))
             self._publish_lane_gauges_locked(ln)
 
@@ -763,15 +923,19 @@ class VerifyService:
                 self._batches % self._aging_every == \
                 self._aging_every - 1:
             return min(nonempty,
-                       key=lambda ln: self._queues[ln][0]._seq)
+                       key=lambda ln: self._queues[ln].oldest_seq())
         return nonempty[0]
 
     def _collect_locked(self):
         """Coalesce queued submissions of ONE lane into a batch of up
         to ``max_batch`` items (continuous batching into the
-        verifier's jit buckets). An oversize single submission rides
-        alone — the verifier chunks it. Returns (lane, items, parts)
-        or None; parts are (ticket, item_offset) pairs."""
+        verifier's jit buckets), serving tenants in deterministic
+        weighted-fair order within the lane (ISSUE 14). An oversize
+        single submission rides alone — the verifier chunks it.
+        Returns (lane, items, parts, tids, decisions) or None; parts
+        are (ticket, item_offset) pairs, decisions the weighted-fair
+        pop records (the caller emits them as ``service.schedule``
+        flight-recorder events outside this lock)."""
         ln = self._pick_lane_locked()
         if ln is None:
             return None
@@ -779,24 +943,32 @@ class VerifyService:
         items: list = []
         parts = []
         tids: list = []
+        decisions: list = []
         while q:
-            tkt = q[0]
-            if items and len(items) + tkt.n_items > self._max_batch:
+            head = q.peek()
+            if items and len(items) + head.n_items > self._max_batch:
                 break
-            q.popleft()
+            tkt, dec = q.pop(head)
+            dec["traces"] = [[tkt.trace_lo,
+                              tkt.trace_lo + tkt.n_items]]
+            decisions.append(dec)
+            self._decisions.append(
+                ("dispatch", ln, tkt.tenant, tkt._seq, tkt._vfinish))
             parts.append((tkt, len(items)))
             items.extend(tkt._items)
             tids.extend(tkt.trace_ids)
             self._queued_items[ln] -= tkt.n_items
             self._queued_bytes[ln] -= tkt._nbytes
             self._inflight_bytes[ln] += tkt._nbytes
+            ti = self._tenant_inflight[ln]
+            ti[tkt.tenant] = ti.get(tkt.tenant, 0) + tkt._nbytes
         self._inflight_items += len(items)
         self._batches += 1
         # (the pre-ISSUE-10 `crypto.verify.service.depth.<lane>`
         # gauge is superseded by `lane.<lane>.depth`, published at
         # every queue transition instead of only at batch pick)
         self._publish_lane_gauges_locked(ln)
-        return (ln, items, parts, tids)
+        return (ln, items, parts, tids, decisions)
 
     def _resolve_one(self, ln: str, parts, resolver,
                      traces=None) -> None:
@@ -815,19 +987,24 @@ class VerifyService:
                 err = e
         n = sum(t.n_items for t, _ in parts)
         nbytes = sum(t._nbytes for t, _ in parts)
+        tenants = _part_tenants(parts)
         if err is not None:
             with self._cv:
                 self._inflight_items -= n
                 self._inflight_bytes[ln] -= nbytes
                 self._counts[ln]["failed"] += n
+                self._tenant_terminal_locked(ln, parts, "failed")
                 self._publish_lane_gauges_locked(ln)
             registry.meter("crypto.verify.service.failed").mark(n)
             registry.meter(
                 f"crypto.verify.service.lane.{ln}.failed").mark(n)
             slo_monitor.note_completion(ln, ok=False, n=n)
+            for tkt, _off in parts:
+                tenant_mod.tenant_slo.note_completion(
+                    tkt.tenant, ok=False, n=tkt.n_items)
             batch_verifier.note_trace_event(
                 "service.verdict", lane=ln, failed=True,
-                traces=traces or [], items=n)
+                tenants=tenants, traces=traces or [], items=n)
             for tkt, _off in parts:
                 tkt._fut.set_exception(err)
             return
@@ -835,6 +1012,7 @@ class VerifyService:
             self._inflight_items -= n
             self._inflight_bytes[ln] -= nbytes
             self._counts[ln]["verified"] += n
+            self._tenant_terminal_locked(ln, parts, "verified")
             self._publish_lane_gauges_locked(ln)
         registry.meter("crypto.verify.service.verified").mark(n)
         registry.meter(
@@ -843,7 +1021,8 @@ class VerifyService:
         # trace milestone: each verdict carries its trace — the END of
         # the trace route's reconstructed timeline
         batch_verifier.note_trace_event(
-            "service.verdict", lane=ln, traces=traces or [], items=n)
+            "service.verdict", lane=ln, tenants=tenants,
+            traces=traces or [], items=n)
         # clock read: wait-time histogram stamp only (nondet allowlist)
         now = time.monotonic()
         timer = registry.timer(
@@ -851,12 +1030,32 @@ class VerifyService:
         for tkt, off in parts:
             wait_ms = (now - tkt._t_enq) * 1000.0
             timer.update_ms(wait_ms)
-            # SLO accounting (ISSUE 10): the latency objective reads
-            # the SAME allowlisted stamp the histogram does; the
-            # verdict below never depends on it
+            # SLO accounting (ISSUE 10/14): the lane AND tenant
+            # latency objectives read the SAME allowlisted stamp the
+            # histogram does; the verdict below never depends on it
             slo_monitor.note_latency(ln, wait_ms, n=tkt.n_items)
+            tenant_mod.tenant_slo.note_latency(
+                tkt.tenant, wait_ms, n=tkt.n_items)
+            tenant_mod.tenant_slo.note_completion(
+                tkt.tenant, ok=True, n=tkt.n_items)
             tkt._fut.set_result(
                 np.array(out[off:off + tkt.n_items], dtype=bool))
+
+    def _tenant_terminal_locked(self, ln: str, parts,
+                                outcome: str) -> None:
+        """Move every part's items from pending to a terminal
+        per-tenant counter and release the tenant's in-flight bytes
+        (called with the cv held)."""
+        ti = self._tenant_inflight[ln]
+        for tkt, _off in parts:
+            tc = self._tenant_counts_locked(tkt.tenant)
+            tc[outcome] += tkt.n_items
+            tc["pending"] -= tkt.n_items
+            left = ti.get(tkt.tenant, 0) - tkt._nbytes
+            if left > 0:
+                ti[tkt.tenant] = left
+            else:
+                ti.pop(tkt.tenant, None)
 
     def _run(self) -> None:
         # in-flight dispatches are LOCAL to the dispatcher thread (the
@@ -880,17 +1079,26 @@ class VerifyService:
             if onset:
                 batch_verifier.note_shed_onset(onset)
             if batch is not None:
-                ln, items, parts, tids = batch
+                ln, items, parts, tids, decisions = batch
+                tenants = _part_tenants(parts)
+                # every weighted-fair pop is a flight-recorder event
+                # with its input window (ISSUE 14): tenant, virtual
+                # times, lane vtime, candidate count, trace range —
+                # the replay-testable record of the decision
+                for dec in decisions:
+                    batch_verifier.note_trace_event(
+                        "service.schedule", lane=ln, **dec)
                 tr = batch_verifier.trace_ranges(tids)
                 batch_verifier.note_trace_event(
-                    "service.coalesce", lane=ln, traces=tr,
-                    items=len(items), tickets=len(parts))
+                    "service.coalesce", lane=ln, tenants=tenants,
+                    traces=tr, items=len(items), tickets=len(parts))
                 resolver = None
                 err: Optional[BaseException] = None
                 # the batch's trace-ID list rides the dispatch span as
                 # exemplar ranges (compressed, exact — never truncated)
                 with span("service.dispatch", lane=ln,
-                          items=len(items), traces=tr):
+                          tenants=tenants, items=len(items),
+                          traces=tr):
                     try:
                         if self._traceful:
                             resolver = self._verifier.submit(
@@ -919,16 +1127,33 @@ class VerifyService:
             self._inflight_items -= n
             self._inflight_bytes[ln] -= nbytes
             self._counts[ln]["failed"] += n
+            self._tenant_terminal_locked(ln, parts, "failed")
             self._publish_lane_gauges_locked(ln)
         registry.meter("crypto.verify.service.failed").mark(n)
         registry.meter(
             f"crypto.verify.service.lane.{ln}.failed").mark(n)
         slo_monitor.note_completion(ln, ok=False, n=n)
+        for tkt, _off in parts:
+            tenant_mod.tenant_slo.note_completion(
+                tkt.tenant, ok=False, n=tkt.n_items)
         batch_verifier.note_trace_event(
             "service.verdict", lane=ln, failed=True,
-            traces=traces or [], items=n)
+            tenants=_part_tenants(parts), traces=traces or [],
+            items=n)
         for tkt, _off in parts:
             tkt._fut.set_exception(err)
+
+
+def _part_tenants(parts) -> list:
+    """Unique tenants of a coalesced batch, in part order — the
+    ``tenants`` attribute of coalesce/dispatch/verdict records, so a
+    batch's queue wait is attributable to its principals from the
+    admin routes alone (ISSUE 14 trace satellite)."""
+    seen: list = []
+    for tkt, _off in parts:
+        if tkt.tenant not in seen:
+            seen.append(tkt.tenant)
+    return seen
 
 
 def lane_latencies() -> Dict[str, dict]:
@@ -949,6 +1174,9 @@ def lane_latencies() -> Dict[str, dict]:
 
 _service: Optional[VerifyService] = None
 _service_lock = threading.Lock()
+# tenant_snapshot of the process-wide service, else the last-started
+# instance (set under _service_lock in VerifyService.start)
+_tenant_provider = None
 
 
 def default_service(start: bool = True) -> VerifyService:
@@ -1073,3 +1301,21 @@ def service_health() -> dict:
     if svc is not None:
         return svc.snapshot()
     return batch_verifier.service_health_snapshot()
+
+
+def tenant_health() -> dict:
+    """The ``tenant`` admin-route payload (ISSUE 14): per-tenant SLO
+    burn rates (top-K + rollup, refreshing the rank-keyed gauges) and
+    the process-wide service's per-tenant conservation counters.
+    Served directly — tenant isolation matters exactly when the node
+    is overloaded."""
+    out = {"slo": tenant_mod.tenant_slo.snapshot()}
+    with _service_lock:
+        svc = _service
+        provider = _tenant_provider
+    if svc is not None:
+        provider = svc.tenant_snapshot
+    out["service"] = provider() if provider is not None else {
+        "tenants": {}, "tracked": 0, "conservation_violations": {},
+        "decision_log_len": 0}
+    return out

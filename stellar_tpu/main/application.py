@@ -258,6 +258,22 @@ class Application:
                 max_batch=config.VERIFY_SERVICE_MAX_BATCH,
                 pipeline_depth=config.VERIFY_SERVICE_PIPELINE_DEPTH,
                 aging_every=config.VERIFY_SERVICE_AGING_EVERY)
+        if changed("VERIFY_TENANT_DEPTH") or \
+                changed("VERIFY_TENANT_BYTES") or \
+                changed("VERIFY_TENANT_TOPK") or \
+                changed("VERIFY_TENANT_TRACK_CAP") or \
+                changed("VERIFY_TENANT_P99_MS") or \
+                changed("VERIFY_TENANT_SHED_BUDGET") or \
+                changed("VERIFY_TENANT_SLO_WINDOW"):
+            from stellar_tpu.crypto import tenant
+            tenant.configure_tenants(
+                depth=config.VERIFY_TENANT_DEPTH,
+                nbytes=config.VERIFY_TENANT_BYTES,
+                topk=config.VERIFY_TENANT_TOPK,
+                track_cap=config.VERIFY_TENANT_TRACK_CAP,
+                p99_ms=config.VERIFY_TENANT_P99_MS,
+                shed_budget=config.VERIFY_TENANT_SHED_BUDGET,
+                window=config.VERIFY_TENANT_SLO_WINDOW)
         if config.VERIFY_SERVICE_ENABLED:
             from stellar_tpu.crypto import verify_service
             verify_service.default_service()

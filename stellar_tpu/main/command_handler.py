@@ -200,6 +200,15 @@ class CommandHandler:
         from stellar_tpu.crypto import verify_service
         return verify_service.slo_health()
 
+    def cmd_tenant(self, params):
+        """Per-tenant QoS surface (ISSUE 14): top-K tenant SLO burn
+        rates + the ``tenant.other`` rollup, and the service's
+        per-tenant conservation counters — one misbehaving submitter
+        is attributable (and provably isolated) from this route
+        alone. Served directly, same policy as ``slo``."""
+        from stellar_tpu.crypto import verify_service
+        return verify_service.tenant_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -665,7 +674,7 @@ class CommandHandler:
         "dispatch": cmd_dispatch, "spans": cmd_spans,
         "trace": cmd_trace, "service": cmd_service,
         "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
-        "slo": cmd_slo,
+        "slo": cmd_slo, "tenant": cmd_tenant,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

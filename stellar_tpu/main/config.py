@@ -210,6 +210,24 @@ class Config:
     # starvation-proofing: every Nth batch serves the globally-oldest
     # lane head regardless of priority (0 disables aging)
     VERIFY_SERVICE_AGING_EVERY: int = 4
+    # multi-tenant QoS (docs/robustness.md "Tenants"): per-tenant
+    # depth/byte quotas nested inside each lane's budgets (0 =
+    # unlimited — tenancy is opt-in; the default/un-tenanted stream is
+    # always quota-exempt unless given an explicit policy)
+    VERIFY_TENANT_DEPTH: int = 0
+    VERIFY_TENANT_BYTES: int = 0
+    # rank-keyed per-tenant burn-rate gauges published (the
+    # metric-cardinality guard's K: crypto.verify.tenant.topk.<rank>.*
+    # + a tenant.other rollup — bounded series however many tenants)
+    VERIFY_TENANT_TOPK: int = 8
+    # hard cap on individually-tracked tenants (counters + SLO
+    # windows); later arrivals fold into the ~other rollup, counted
+    VERIFY_TENANT_TRACK_CAP: int = 4096
+    # per-tenant SLO objectives (event-count windows, like the lane
+    # SLOs): latency bound / target and the terminal-state shed budget
+    VERIFY_TENANT_P99_MS: float = 30000.0
+    VERIFY_TENANT_SHED_BUDGET: float = 0.5
+    VERIFY_TENANT_SLO_WINDOW: int = 256
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

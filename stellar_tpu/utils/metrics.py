@@ -27,10 +27,41 @@ import random
 import re
 import threading
 import time
+from collections import deque
 from typing import Dict, List
 
 __all__ = ["Counter", "Meter", "Timer", "Gauge", "MetricsRegistry",
-           "registry", "RESERVOIR_SIZE", "TimeSeriesRing", "timeseries"]
+           "registry", "RESERVOIR_SIZE", "TimeSeriesRing", "timeseries",
+           "fresh_burn_window", "push_burn_window", "trim_burn_window"]
+
+
+# ---------------- SLO burn-window helpers ----------------
+# ONE implementation of the event-count sliding-window error-budget
+# state (deque of 0/1 + running bad/total counters), shared by the
+# per-lane SloMonitor (crypto/verify_service.py) and the per-tenant
+# TenantSloMonitor (crypto/tenant.py): the window invariant must not
+# fork. Pure dict-state functions — the OWNING monitor holds its lock
+# around every call (these never lock).
+
+
+def fresh_burn_window() -> dict:
+    return {"events": deque(), "bad": 0, "total": 0, "bad_total": 0}
+
+
+def trim_burn_window(st: dict, window: int) -> None:
+    while len(st["events"]) > window:
+        st["bad"] -= st["events"].popleft()
+
+
+def push_burn_window(st: dict, bad: bool, n: int,
+                     window: int) -> None:
+    flag = 1 if bad else 0
+    for _ in range(n):
+        st["events"].append(flag)
+    st["bad"] += flag * n
+    st["total"] += n
+    st["bad_total"] += flag * n
+    trim_burn_window(st, window)
 
 
 class Counter:
@@ -416,7 +447,12 @@ ANOMALY_MIN_SAMPLES = 32  # EWMA warm-up before any alerting
 _EWMA_ALPHA = 0.1
 # hard cap on tracked series: per-lane meters etc. can mint names, and
 # the ring must stay bounded no matter what — overflow is COUNTED
-# (dropped_series in the snapshot), never silent
+# (dropped_series in the snapshot), never silent. Per-instance
+# override via TimeSeriesRing.configure(max_series=...) — the tenant
+# QoS layer (ISSUE 14) additionally publishes per-tenant burn rates
+# under RANK-keyed names (crypto.verify.tenant.topk.<rank>.*) exactly
+# so tenant cardinality can never race this cap, however many tenants
+# churn (tests/test_timeline.py pins the interplay)
 MAX_SERIES = 1024
 
 # series timestamps: monotonic seconds since module import (no wall
@@ -459,13 +495,18 @@ class TimeSeriesRing:
         self._interval_s = TIMESERIES_INTERVAL_S
         self._ticks = 0
         self._dropped_series = 0
+        # None = follow the module-level MAX_SERIES default
+        self._max_series = None
         self._thread = None
         self._stop_evt = threading.Event()
 
     def configure(self, samples=None, interval_s=None, z=None,
-                  sustain=None, min_samples=None) -> None:
+                  sustain=None, min_samples=None,
+                  max_series=None) -> None:
         """Config push (METRICS_TIMESERIES_* / METRICS_ANOMALY_*);
-        None keeps the current value."""
+        None keeps the current value. ``max_series`` overrides the
+        module-level hard cap for THIS ring (never below 8 — the cap
+        is a guard, not an off switch)."""
         with self._lock:
             if samples is not None:
                 self._samples = max(8, int(samples))
@@ -480,6 +521,8 @@ class TimeSeriesRing:
                 self._sustain = max(1, int(sustain))
             if min_samples is not None:
                 self._min_samples = max(2, int(min_samples))
+            if max_series is not None:
+                self._max_series = max(8, int(max_series))
 
     # ---------------- sampling ----------------
 
@@ -521,7 +564,9 @@ class TimeSeriesRing:
                     value = raw
                 buf = self._series.get(series)
                 if buf is None:
-                    if len(self._series) >= MAX_SERIES:
+                    cap = self._max_series if self._max_series \
+                        is not None else MAX_SERIES
+                    if len(self._series) >= cap:
                         self._dropped_series += 1
                         continue
                     buf = self._series[series] = []
@@ -659,6 +704,9 @@ class TimeSeriesRing:
                              "ticks": self._ticks,
                              "window": self._samples,
                              "tracked_series": len(self._series),
+                             "max_series": self._max_series
+                             if self._max_series is not None
+                             else MAX_SERIES,
                              "dropped_series": self._dropped_series,
                              "z": self._z,
                              "sustain": self._sustain,

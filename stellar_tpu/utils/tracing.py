@@ -279,6 +279,17 @@ class FlightRecorder:
         coal = first("service.coalesce")
         verdict = first("service.verdict")
         disp = first("span.service.dispatch")
+        # tenant attribution (ISSUE 14): the enqueue milestone carries
+        # the submitting tenant, so one item's queue wait is
+        # attributable to its principal from the trace route alone
+        # (shed/reject milestones carry it too — the fallback covers
+        # items refused before any enqueue was recorded)
+        for rec in (enq, first("service.shed"),
+                    first("service.reject")):
+            tenant = (rec or {}).get("attrs", {}).get("tenant")
+            if tenant is not None:
+                summary["tenant"] = tenant
+                break
         if enq and coal:
             summary["queue_wait_ms"] = round(
                 coal["start_ms"] - enq["start_ms"], 3)

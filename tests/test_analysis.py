@@ -587,6 +587,25 @@ def test_lint_scopes_cover_verify_service():
     assert "stellar_tpu/crypto/audit.py" in set(nondet.HOST_ORACLE_FILES)
 
 
+def test_lint_scopes_cover_tenant_scheduler():
+    """ISSUE 14: the tenant QoS layer decides WHICH tenant's work
+    dispatches (weighted-fair virtual time) and WHICH rows shed
+    (tenant-keyed fractions + draws) — it joins the nondet scope with
+    ZERO allowlist entries (the scheduler path reads no clock at
+    all), and its policy/SLO state joins the lock-lint scope. The
+    verify service's pre-existing clock allowlist (latency stamps)
+    must NOT have grown new keys for the scheduler."""
+    t = "stellar_tpu/crypto/tenant.py"
+    assert t in set(nondet.HOST_ORACLE_FILES)
+    assert t in set(locks.SCOPE)
+    assert t not in nondet.ALLOWLIST._entries
+    # the service surgery added no new nondet allowlist keys: still
+    # exactly the latency-stamp clock entry
+    entry = nondet.ALLOWLIST._entries.get(
+        "stellar_tpu/crypto/verify_service.py", {})
+    assert set(entry) == {"nondet:clock"}
+
+
 def test_lint_scopes_cover_batch_engine():
     """ISSUE 7: the workload-agnostic engine owns the jit-bucket cache,
     device-health registry and served-counter RMWs from resolver/pool/

@@ -1,0 +1,487 @@
+"""Multi-tenant QoS subsystem (ISSUE 14): tenant policy resolution,
+the deterministic weighted-fair lane queue (fairness property tests,
+no starvation, replica determinism), tenant-keyed ingress quotas
+(typed ``Overloaded`` with the ``tenant`` field), the tenant-keyed
+shed draw and per-tenant keep fractions, per-tenant work conservation,
+the decision log, and the per-tenant SLO monitor's rank-keyed
+metric-cardinality guard. The thousand-tenant flood acceptance lives
+in ``tools/tenant_selfcheck.py`` (tier-1 ``TENANT_QOS_OK``);
+everything here is stub-verifier fast."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import audit
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.crypto import tenant as tn
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.utils.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _tenant_sandbox():
+    """Pristine tenant policy/SLO state, restored afterwards (the
+    policy table and monitor are process-global, like the registry)."""
+    saved = (tn.TENANT_DEPTH, tn.TENANT_BYTES, tn.TENANT_TOPK,
+             tn.TENANT_TRACK_CAP, tn.TENANT_P99_MS,
+             tn.TENANT_SHED_BUDGET)
+    tn.clear_tenant_policies()
+    tn.tenant_slo._reset_for_testing()
+    yield
+    tn.clear_tenant_policies()
+    tn.tenant_slo._reset_for_testing()
+    tn.configure_tenants(depth=saved[0], nbytes=saved[1],
+                         topk=saved[2], track_cap=saved[3],
+                         p99_ms=saved[4], shed_budget=saved[5])
+    bv.register_service_health(None)
+
+
+class InstantVerifier:
+    def submit(self, items):
+        n = len(items)
+        return lambda: np.ones(n, dtype=bool)
+
+
+class WedgedVerifier:
+    """Gate-parked resolvers: everything queues until the gate opens."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def submit(self, items):
+        n = len(items)
+
+        def resolver():
+            assert self.gate.wait(timeout=30)
+            return np.ones(n, dtype=bool)
+        return resolver
+
+
+def _items(tag, i, n=2):
+    pk = bytes([(len(tag) * 13 + i * 11 + j) % 251 + 1
+                for j in range(32)])
+    return [(pk, b"%s-%d-%d" % (tag.encode(), i, k),
+             bytes([(i + k) % 251]) * 32) for k in range(n)]
+
+
+def _ticket(tag, i, n=1, seq=None):
+    return vs.VerifyTicket("bulk", _items(tag, i, n=n), 32 * n,
+                           b"d" * 32, i if seq is None else seq, 0.0,
+                           tenant=tag)
+
+
+# ---------------- policy + validation ----------------
+
+
+def test_validate_tenant_and_reserved_ids():
+    assert tn.validate_tenant(None) == tn.DEFAULT_TENANT
+    assert tn.validate_tenant("acct-7.A_b") == "acct-7.A_b"
+    for bad in ("", "~other", "a" * 65, "sp ace", "x\n", 7):
+        with pytest.raises(ValueError):
+            tn.validate_tenant(bad)
+
+
+def test_policy_resolution_default_exempt_until_configured():
+    tn.configure_tenants(depth=5, nbytes=1000)
+    # named tenants inherit the global quota, default stays exempt
+    assert tn.tenant_policy("alice") == (1, 5, 1000)
+    assert tn.tenant_policy(tn.DEFAULT_TENANT) == (1, 0, 0)
+    # per-tenant overrides win; unset fields inherit
+    tn.set_tenant_policy("bob", weight=3, depth=9)
+    assert tn.tenant_policy("bob") == (3, 9, 1000)
+    tn.set_tenant_policy(tn.DEFAULT_TENANT, depth=2)
+    assert tn.tenant_policy(tn.DEFAULT_TENANT)[1] == 2
+
+
+def test_shed_key_and_tenant_keyed_draw():
+    """The tenant key gives each tenant an independent, pure draw
+    stream; the empty key preserves the historical draw exactly."""
+    assert tn.shed_key(tn.DEFAULT_TENANT) == b""
+    mats = [bytes([i, (i * 5) % 256]) * 20 for i in range(150)]
+    # empty key == legacy two-arg call, byte-for-byte
+    assert [audit.keep_under_shed(m, 0.5) for m in mats] == \
+        [audit.keep_under_shed(m, 0.5, tenant=b"") for m in mats]
+    a = [audit.keep_under_shed(m, 0.5, tenant=b"alice") for m in mats]
+    b = [audit.keep_under_shed(m, 0.5, tenant=b"bob") for m in mats]
+    assert a == [audit.keep_under_shed(m, 0.5, tenant=b"alice")
+                 for m in mats]                     # pure
+    assert a != b                                   # independent
+    assert 40 < sum(a) < 110 and 40 < sum(b) < 110  # ~half each
+
+
+def test_shed_keep_fraction_regimes():
+    # quota-less: the lane ladder fraction, any level
+    assert tn.shed_keep_fraction(0.5, 100, 0) == 0.5
+    # in-quota at backlog level: protected; at level 2: lane fraction
+    assert tn.shed_keep_fraction(0.5, 3, 8, level=1) == 1.0
+    assert tn.shed_keep_fraction(0.5, 3, 8, level=2) == 0.5
+    # over-quota: scaled down by the overshoot (hw = 0.75 * 8 = 6)
+    assert tn.shed_keep_fraction(0.5, 12, 8, level=1) == \
+        pytest.approx(0.5 / 2.0)
+    assert tn.shed_keep_fraction(0.5, 12, 8, level=2) == \
+        pytest.approx(0.5 / 2.0)
+
+
+# ---------------- weighted-fair lane queue ----------------
+
+
+def test_wfq_weighted_shares_converge_under_saturation():
+    """The fairness property: with every tenant backlogged, served
+    shares converge to the weights — 4:2:1 over any window."""
+    tn.set_tenant_policy("gold", weight=4)
+    tn.set_tenant_policy("silver", weight=2)
+    q = tn.TenantLaneQueue()
+    seq = 0
+    for i in range(120):
+        for t in ("gold", "silver", "bronze"):
+            q.push(_ticket(t, i, seq=seq), tn.tenant_policy(t)[0])
+            seq += 1
+    served = [q.pop()[0].tenant for _ in range(140)]
+    counts = {t: served.count(t) for t in ("gold", "silver",
+                                           "bronze")}
+    assert abs(counts["gold"] - 80) <= 4, counts
+    assert abs(counts["silver"] - 40) <= 4, counts
+    assert abs(counts["bronze"] - 20) <= 4, counts
+
+
+def test_wfq_no_starvation_and_fifo_within_tenant():
+    """A weight-1 tenant behind a continuously-arriving weight-8
+    stream still gets served (virtual time advances with service, so
+    the heavy tenant cannot push the light one's finish times back),
+    and each tenant's own submissions serve in FIFO order."""
+    tn.set_tenant_policy("heavy", weight=8)
+    q = tn.TenantLaneQueue()
+    seq = 0
+    for i in range(10):
+        q.push(_ticket("light", i, seq=seq), 1)
+        seq += 1
+    served = []
+    for burst in range(40):
+        q.push(_ticket("heavy", burst, seq=seq), 8)
+        seq += 1
+        tkt, _d = q.pop()
+        served.append(tkt.tenant)
+    assert "light" in served[:12], served[:12]
+    assert served.count("light") >= 4   # ~1/9 share, not zero
+    light_seqs = [i for i, t in enumerate(served) if t == "light"]
+    assert light_seqs == sorted(light_seqs)
+
+
+def test_wfq_pop_decisions_are_replica_deterministic():
+    """Two queues fed the identical arrival order emit identical
+    (ticket, decision) sequences — the scheduler is a pure function
+    of arrival order (no clocks, no RNG, no hash salts)."""
+    def build():
+        tn.set_tenant_policy("a2", weight=2)
+        q = tn.TenantLaneQueue()
+        script = [("a2", 3), ("b", 1), ("a2", 2), ("c", 4), ("b", 1),
+                  ("c", 1), ("a2", 1), ("b", 2)]
+        for s, (t, n) in enumerate(script):
+            q.push(_ticket(t, s, n=n, seq=s), tn.tenant_policy(t)[0])
+        out = []
+        while q:
+            tkt, dec = q.pop()
+            out.append((tkt.tenant, tkt._seq, dec["vstart"],
+                        dec["vfinish"], dec["vtime"],
+                        dec["candidates"]))
+        return out
+
+    assert build() == build()
+
+
+def test_wfq_accounting_and_prune():
+    q = tn.TenantLaneQueue()
+    q.push(_ticket("a", 0, n=2, seq=0), 1)
+    q.push(_ticket("a", 1, n=1, seq=1), 1)
+    q.push(_ticket("b", 0, n=1, seq=2), 1)
+    assert len(q) == 3 and q.depth("a") == 2 and q.depth("b") == 1
+    assert q.queued_bytes("a") == 96 and q.queued_bytes("b") == 32
+    assert q.tenant_depths() == {"a": 2, "b": 1}
+    assert q.oldest_seq() == 0
+    while q:
+        q.pop()
+    # fully drained: per-tenant state pruned, vtime retained
+    assert q.tenant_depths() == {} and len(q) == 0
+    assert not q._q and not q._bytes
+
+
+def test_wfq_drain_if_filters_deterministically():
+    q = tn.TenantLaneQueue()
+    for s in range(8):
+        q.push(_ticket("a" if s % 2 else "b", s, seq=s), 1)
+    removed = q.drain_if(lambda tkt: tkt._seq % 3 != 0)
+    assert [t._seq for t in removed] == [0, 6, 3]  # b-FIFO then a-FIFO
+    assert len(q) == 5
+    assert q.drain_if(None) and len(q) == 0
+
+
+# ---------------- service integration ----------------
+
+
+def test_ingress_quota_typed_with_tenant_field():
+    """Per-tenant depth/byte quotas nest inside the lane budgets: the
+    refusal is a typed Overloaded carrying kind/lane/reason/tenant,
+    and in-quota tenants keep submitting."""
+    tn.configure_tenants(depth=2, nbytes=300)
+    g = WedgedVerifier()
+    svc = vs.VerifyService(verifier=g, lane_depth=64,
+                           lane_bytes=10 ** 7, max_batch=4,
+                           pipeline_depth=2).start()
+    try:
+        for i in range(2):
+            svc.submit(_items("mallory", i), lane="bulk",
+                       tenant="mallory")
+        with pytest.raises(vs.Overloaded) as ei:
+            svc.submit(_items("mallory", 9), lane="bulk",
+                       tenant="mallory")
+        e = ei.value
+        assert (e.kind, e.lane, e.reason, e.tenant) == \
+            ("rejected", "bulk", "tenant-depth", "mallory")
+        # byte quota: a fresh tenant with room in depth but not bytes
+        tn.set_tenant_policy("bytes-guy", depth=100, nbytes=100)
+        with pytest.raises(vs.Overloaded) as ei:
+            svc.submit(_items("bytes-guy", 0), lane="bulk",
+                       tenant="bytes-guy")
+        assert ei.value.reason == "tenant-bytes"
+        assert ei.value.tenant == "bytes-guy"
+        # an in-quota tenant is untouched by mallory's exhaustion
+        t = svc.submit(_items("alice", 0), lane="bulk",
+                       tenant="alice")
+        # quotas are PER LANE: mallory's bulk exhaustion does not
+        # block its scp submissions
+        t2 = svc.submit(_items("mallory", 20), lane="scp",
+                        tenant="mallory")
+        g.gate.set()
+        assert t.result(timeout=30).all()
+        assert t2.result(timeout=30).all()
+    finally:
+        g.gate.set()
+        svc.stop(drain=True, timeout=30)
+    snap = svc.tenant_snapshot()
+    assert snap["conservation_violations"] == {}
+    mc = snap["tenants"]["mallory"]
+    assert mc["quota_rejected"] == 2 and mc["rejected"] == 2
+    assert mc["pending"] == 0
+    assert snap["tenants"]["alice"]["verified"] == 2
+    assert svc.snapshot()["conservation_gap"] == 0
+
+
+def test_default_tenant_admission_unchanged_and_meters():
+    """Un-tenanted submissions ride the default tenant: quota-exempt
+    (lane budgets alone bound them), counted, conserved."""
+    tn.configure_tenants(depth=1, nbytes=10)   # harsh for NAMED tenants
+    before = registry.meter(
+        "crypto.verify.service.tenant.quota_rejected").count
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=64,
+                           lane_bytes=10 ** 7, max_batch=8,
+                           pipeline_depth=1).start()
+    try:
+        for i in range(6):   # way past the named-tenant quota
+            assert svc.verify(_items("x", i), lane="bulk",
+                              timeout=30).all()
+    finally:
+        svc.stop(drain=True, timeout=30)
+    snap = svc.tenant_snapshot()
+    assert snap["tenants"][tn.DEFAULT_TENANT]["verified"] == 12
+    assert snap["tenants"][tn.DEFAULT_TENANT]["quota_rejected"] == 0
+    assert snap["conservation_violations"] == {}
+    assert registry.meter(
+        "crypto.verify.service.tenant.quota_rejected").count == before
+
+
+def test_decision_log_and_schedule_events():
+    """Every weighted-fair pop lands in the decision log AND as a
+    service.schedule flight-recorder event carrying its input window
+    (tenant, virtual times, candidate count, trace range)."""
+    from stellar_tpu.utils import tracing
+    tn.set_tenant_policy("gold", weight=2)
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=64,
+                           lane_bytes=10 ** 7, max_batch=2,
+                           pipeline_depth=1).start()
+    try:
+        tks = [svc.submit(_items(t, i, n=1), lane="bulk", tenant=t)
+               for i, t in enumerate(("gold", "plain", "gold"))]
+        for t in tks:
+            t.result(timeout=30)
+    finally:
+        svc.stop(drain=True, timeout=30)
+    log = svc.decision_log()
+    assert [d[0] for d in log] == ["dispatch"] * 3
+    assert [d[2] for d in log].count("gold") == 2
+    recent = tracing.flight_recorder.snapshot(limit=512)["recent"]
+    scheds = [r for r in recent if r["name"] == "service.schedule"]
+    assert len(scheds) >= 3
+    attrs = scheds[-1]["attrs"]
+    assert {"lane", "tenant", "seq", "vstart", "vfinish", "vtime",
+            "candidates", "traces"} <= set(attrs)
+
+
+def test_trace_timeline_carries_tenant():
+    """ISSUE 14 trace satellite: one item's queue wait is
+    attributable to its tenant from the trace route alone — the
+    enqueue milestone and the reconstructed summary both carry it."""
+    from stellar_tpu.utils import tracing
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=8,
+                           max_batch=4, pipeline_depth=1).start()
+    try:
+        tkt = svc.submit(_items("carol", 0), lane="auth",
+                         tenant="carol")
+        tkt.result(timeout=30)
+    finally:
+        svc.stop(drain=True, timeout=30)
+    tl = tracing.flight_recorder.trace_timeline(tkt.trace_ids[0])
+    assert tl["found"]
+    assert tl["summary"].get("tenant") == "carol"
+    enq = next(r for r in tl["records"]
+               if r["name"] == "service.enqueue")
+    assert enq["attrs"]["tenant"] == "carol"
+    verdict = next(r for r in tl["records"]
+                   if r["name"] == "service.verdict")
+    assert "carol" in verdict["attrs"]["tenants"]
+
+
+def test_flooder_sheds_first_in_quota_protected():
+    """The tenant-keyed shed ladder: under backlog pressure the
+    over-quota flooder's rows shed (typed, tenant-tagged) while
+    in-quota tenants are protected at level 1."""
+    tn.configure_tenants(depth=4)
+    tn.set_tenant_policy("flood", depth=24)
+    g = WedgedVerifier()
+    # lane_depth 32 -> highwater 24: flood admits 24 (its quota),
+    # 6 in-quota submissions ride along, 30 >= 24 -> level 1
+    svc = vs.VerifyService(verifier=g, lane_depth=32,
+                           lane_bytes=10 ** 7, max_batch=2,
+                           pipeline_depth=1).start()
+    tickets = []
+    try:
+        for i in range(3):
+            tickets.append(("a", svc.submit(
+                _items("a", i), lane="bulk", tenant="a")))
+            tickets.append(("b", svc.submit(
+                _items("b", 100 + i), lane="bulk", tenant="b")))
+        for i in range(40):
+            try:
+                tickets.append(("flood", svc.submit(
+                    _items("flood", i), lane="bulk",
+                    tenant="flood")))
+            except vs.Overloaded as e:
+                assert e.reason == "tenant-depth"
+        g.gate.set()
+        shed = {"flood": 0, "a": 0, "b": 0}
+        for t, tkt in tickets:
+            try:
+                tkt.result(timeout=30)
+            except vs.Overloaded as e:
+                assert e.kind == "shed" and e.tenant == t
+                shed[t] += 1
+    finally:
+        g.gate.set()
+        svc.stop(drain=True, timeout=30)
+    assert shed["flood"] > 0, "flooder backlog never shed"
+    assert shed["a"] == 0 and shed["b"] == 0, shed
+    snap = svc.tenant_snapshot()
+    assert snap["conservation_violations"] == {}
+    log = svc.decision_log()
+    assert any(d[0] == "shed" and d[2] == "flood" for d in log)
+    assert not any(d[0] == "shed" and d[2] in ("a", "b")
+                   for d in log)
+
+
+# ---------------- per-tenant SLO monitor ----------------
+
+
+def test_tenant_slo_burn_math_and_rank_keyed_gauges():
+    tn.configure_tenants(topk=2, shed_budget=0.5, p99_ms=100.0,
+                         window=16)
+    mon = tn.TenantSloMonitor(window=16)
+    for _ in range(8):
+        mon.note_completion("noisy", ok=False)
+        mon.note_completion("quiet", ok=True)
+        mon.note_latency("slow", 500.0)
+        mon.note_latency("quiet", 1.0)
+    # monkey-free: rank the module-global publisher through a local
+    # monitor by swapping it in for the publish call
+    saved = tn.tenant_slo
+    tn.tenant_slo = mon
+    try:
+        top = mon.publish_topk()
+    finally:
+        tn.tenant_slo = saved
+    # ranked by the COMBINED burn (max of the two objectives):
+    # slow's latency burn 100x dwarfs noisy's shed burn 2x
+    assert [r["tenant"] for r in top] == ["slow", "noisy"]
+    assert top[0]["latency_burn_rate"] == pytest.approx(100.0)
+    assert top[1]["shed_burn_rate"] == pytest.approx(2.0)
+    assert registry.gauge(
+        "crypto.verify.tenant.topk.0.id").value == "slow"
+    assert registry.gauge(
+        "crypto.verify.tenant.topk.1.shed_burn_rate").value == \
+        pytest.approx(2.0)
+    # "quiet" folds into the rollup (zero burn population)
+    assert registry.gauge(
+        "crypto.verify.tenant.other.tenants").value == 1
+    snap = mon.snapshot()
+    assert snap["tracked"] == 3 and snap["topk"] == 2
+
+
+def test_topk_shrink_zeroes_stale_ranks():
+    """A lowered TENANT_TOPK (or a shrunken tenant population) must
+    ZERO the ranks it no longer writes — the registry has no delete,
+    and a frozen stale burn rate is worse than none."""
+    tn.configure_tenants(topk=3)
+    mon = tn.TenantSloMonitor(window=16)
+    for t in ("a", "b", "c"):
+        mon.note_completion(t, ok=False)
+    mon.publish_topk()
+    assert registry.gauge(
+        "crypto.verify.tenant.topk.2.id").value in ("a", "b", "c")
+    tn.configure_tenants(topk=1)
+    mon.publish_topk()
+    assert registry.gauge("crypto.verify.tenant.topk.2.id").value == ""
+    assert registry.gauge(
+        "crypto.verify.tenant.topk.2.burn_rate").value == 0.0
+    assert registry.gauge(
+        "crypto.verify.tenant.topk.1.shed_burn_rate").value == 0.0
+
+
+def test_tenant_slo_track_cap_folds_into_other():
+    tn.configure_tenants(track_cap=8)
+    mon = tn.TenantSloMonitor(window=16)
+    for i in range(20):
+        mon.note_completion(f"t{i:03d}", ok=(i % 2 == 0))
+    snap_tracked = len(mon._tenants)
+    assert snap_tracked <= 9          # 8 + the ~other rollup
+    assert tn.OTHER_TENANT in mon._tenants
+    assert mon._overflow_folded == 12
+    assert mon.burn_rates(tn.OTHER_TENANT) is not None
+
+
+def test_config_knobs_push_to_tenant_layer():
+    """The VERIFY_TENANT_* Config knobs exist with documented
+    defaults and Application pushes them through configure_tenants
+    (same policy as the service/SLO knobs)."""
+    from stellar_tpu.main.config import Config
+    cfg = Config()
+    assert cfg.VERIFY_TENANT_DEPTH == 0
+    assert cfg.VERIFY_TENANT_BYTES == 0
+    assert cfg.VERIFY_TENANT_TOPK == 8
+    assert cfg.VERIFY_TENANT_TRACK_CAP == 4096
+    assert cfg.VERIFY_TENANT_P99_MS == 30000.0
+    assert cfg.VERIFY_TENANT_SHED_BUDGET == 0.5
+    assert cfg.VERIFY_TENANT_SLO_WINDOW == 256
+    from stellar_tpu.main.application import Application
+    cfg.VERIFY_TENANT_DEPTH = 77
+    cfg.VERIFY_TENANT_TOPK = 3
+    Application._apply_global_config(object.__new__(Application), cfg)
+    assert tn.TENANT_DEPTH == 77 and tn.TENANT_TOPK == 3
+    # the sandbox fixture restores the module knobs
+
+
+def test_tenant_route_served_by_command_handler():
+    from stellar_tpu.main.command_handler import CommandHandler
+    assert "tenant" in CommandHandler.ROUTES
+    out = CommandHandler.cmd_tenant(object(), {})
+    assert "slo" in out and "service" in out
+    assert "tracked" in out["slo"]

@@ -367,6 +367,53 @@ def test_timeseries_series_cap_counts_drops(monkeypatch):
     assert snap["sampling"]["dropped_series"] == 2  # counted, never silent
 
 
+def test_timeseries_max_series_configurable_per_ring():
+    """ISSUE 14 satellite: the hard cap is Config-pushable per ring
+    (never below 8); an unconfigured ring still follows the module
+    default the cap test above monkeypatches."""
+    ring = TimeSeriesRing(registry, prefixes=("tst.mx.",))
+    ring.configure(max_series=8)
+    for i in range(12):
+        registry.gauge(f"tst.mx.g{i}").set(1.0)
+    ring.sample_once()
+    snap = ring.snapshot()
+    assert len(snap["series"]) == 8
+    assert snap["sampling"]["max_series"] == 8
+    assert snap["sampling"]["dropped_series"] == 4
+
+
+def test_tenant_gauges_bounded_under_series_cap(monkeypatch):
+    """The ISSUE 14 metric-cardinality guard meets the PR 10 hard
+    cap: thousands of tenants feeding the SLO monitor mint only the
+    RANK-keyed gauge set (topk.<rank>.* + the ~other rollup), so a
+    ring over the tenant namespace never drops a series — the naive
+    per-tenant-name design would blow MAX_SERIES and silently
+    increment dropped_series."""
+    from stellar_tpu.crypto import tenant as tn
+    saved = (tn.TENANT_TOPK, tn.TENANT_TRACK_CAP)
+    mon = tn.TenantSloMonitor(window=16)
+    monkeypatch.setattr(tn, "tenant_slo", mon)
+    try:
+        tn.configure_tenants(topk=8, track_cap=4096)
+        for i in range(2000):
+            mon.note_completion(f"z{i:04d}", ok=(i % 3 != 0))
+        mon.publish_topk()
+        ring = TimeSeriesRing(registry,
+                              prefixes=("crypto.verify.tenant.",))
+        ring.sample_once()
+        snap = ring.snapshot()["sampling"]
+        # 8 ranks x 4 gauges + rollup/accounting: far under the cap
+        assert snap["tracked_series"] <= 8 * 4 + 16
+        assert snap["dropped_series"] == 0
+        # the rollup aggregates the untracked masses, counted
+        assert registry.gauge(
+            "crypto.verify.tenant.tracked").value == 2000
+        assert registry.gauge(
+            "crypto.verify.tenant.other.tenants").value == 1992
+    finally:
+        tn.configure_tenants(topk=saved[0], track_cap=saved[1])
+
+
 def test_timeseries_sampler_thread_start_stop():
     ring = TimeSeriesRing(registry, prefixes=("tst.e.",))
     registry.gauge("tst.e.g").set(1.0)

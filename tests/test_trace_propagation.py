@@ -195,7 +195,8 @@ def test_shed_submission_tagged_in_overloaded():
     tkt = vs.VerifyTicket("bulk", _sigs(2), 10, b"d" * 32, 0, 0.0,
                           trace_lo=vs._alloc_trace_block(2))
     with svc._cv:
-        svc._queues["bulk"].append(tkt)
+        svc._queues["bulk"].push(tkt, 1)
+        svc._tenant_counts_locked(tkt.tenant)["pending"] += 2
         svc._queued_items["bulk"] += 2
         svc._queued_bytes["bulk"] += 10
         svc._abort_queues_locked()

@@ -42,7 +42,12 @@ def test_overloaded_is_typed_and_carries_context():
                               reason="backlog")
     assert isinstance(e, RuntimeError)
     assert (e.kind, e.lane, e.reason) == ("shed", "bulk", "backlog")
+    assert e.tenant is None               # un-tenanted verdicts
     assert vs.Overloaded is resilience.Overloaded  # one type, re-exported
+    # tenant-scoped verdicts (ISSUE 14) carry their principal
+    e = resilience.Overloaded("quota", kind="rejected", lane="bulk",
+                              reason="tenant-depth", tenant="mallory")
+    assert (e.reason, e.tenant) == ("tenant-depth", "mallory")
 
 
 def test_keep_under_shed_content_seeded():

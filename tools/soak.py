@@ -247,10 +247,12 @@ def run_sha256(smoke: bool, duration_s: float,
 
 
 def run(smoke: bool, duration_s: float, corrupt: bool,
-        events_path: str) -> dict:
+        events_path: str, tenants: int = 0,
+        flooder: bool = False) -> dict:
     import numpy as np
 
     from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import tenant as tn
     from stellar_tpu.crypto import verify_service as vs
     from stellar_tpu.utils import faults
     from stellar_tpu.utils.logging import append_jsonl_capped
@@ -298,6 +300,18 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     warm_s = round(time.monotonic() - t0, 1)
     event("warm", seconds=warm_s, devices=len(devs))
 
+    # --tenants N: the bulk flood is striped across N synthetic
+    # tenants (scp stays un-tenanted — the consensus lane's submitter
+    # is the node itself), with per-tenant quotas sized so the
+    # OPTIONAL adversarial flooder (--flooder) exhausts its own slice
+    # on the same forced-4-device chaos mesh the legacy scenario uses
+    tenant_knobs_saved = None
+    if tenants > 0:
+        tenant_knobs_saved = (tn.TENANT_DEPTH, tn.TENANT_BYTES,
+                              tn.tenant_slo._window)
+        tn.clear_tenant_policies()
+        tn.configure_tenants(depth=6, nbytes=0, window=1024)
+        tn.set_tenant_policy("flooder", depth=12)
     svc = vs.VerifyService(
         verifier=v, lane_depth=24, lane_bytes=2_000_000,
         max_batch=BUCKET, pipeline_depth=2, aging_every=4).start()
@@ -311,13 +325,18 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     pool, want = _signed_pool()
     results = {"bulk": {"tickets": [], "rejected": 0},
                "scp": {"tickets": [], "rejected": 0}}
+    flooder_stats = {"rejected": 0, "quota_rejected": 0,
+                     "submitted": 0}
     lock = threading.Lock()
 
     def flood(lane, count, per_sub, pace_s, offset=0):
         for i in range(count):
             items, exp = _submission(pool, want, i + offset, per_sub)
+            tenant = None
+            if tenants > 0 and lane == "bulk":
+                tenant = "t%03d" % ((i + offset) % tenants)
             try:
-                tkt = svc.submit(items, lane=lane)
+                tkt = svc.submit(items, lane=lane, tenant=tenant)
                 with lock:
                     results[lane]["tickets"].append((tkt, exp))
             except vs.Overloaded as e:
@@ -326,6 +345,24 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
                     results[lane]["rejected"] += 1
             if pace_s:
                 time.sleep(pace_s)
+
+    def flood_tenant(count, per_sub, offset=0):
+        """The adversarial flooder: unpaced bulk bursts under ONE
+        tenant id — its quota (not the lane budget) must absorb it."""
+        for i in range(count):
+            items, exp = _submission(pool, want, i + offset, per_sub)
+            with lock:
+                flooder_stats["submitted"] += 1
+            try:
+                tkt = svc.submit(items, lane="bulk", tenant="flooder")
+                with lock:
+                    results["bulk"]["tickets"].append((tkt, exp))
+            except vs.Overloaded as e:
+                assert e.kind == "rejected", e.kind
+                with lock:
+                    flooder_stats["rejected"] += 1
+                    if e.reason.startswith("tenant-"):
+                        flooder_stats["quota_rejected"] += 1
 
     flood_rounds = 1 if smoke else max(1, int(duration_s / 3.0))
     breaker_tripped = False
@@ -337,10 +374,14 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             target=flood, args=("bulk", 150, 4, 0.002, rnd * 1000))
         scp = threading.Thread(
             target=flood, args=("scp", 25, 2, 0.02, rnd * 1000))
-        bulk.start()
-        scp.start()
-        bulk.join()
-        scp.join()
+        threads = [bulk, scp]
+        if tenants > 0 and flooder:
+            threads.append(threading.Thread(
+                target=flood_tenant, args=(120, 4, rnd * 1000)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         if not breaker_tripped:
             # mid-run correlated outage: the OPEN global breaker is
             # shed-ladder level 2 (dispatch-degraded) until its
@@ -422,6 +463,42 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         problems.append("service metrics missing from the Prometheus "
                         "exposition")
 
+    # ---- tenant scenario gates (--tenants N [--flooder]) ----
+    tenant_rec = None
+    if tenants > 0:
+        tsnap = svc.tenant_snapshot()
+        tfc = tsnap["tenants"].get("flooder") or {}
+        tenant_rec = {
+            "tenants": tsnap["tracked"],
+            "conservation_violations":
+                tsnap["conservation_violations"],
+            "flooder": tfc or None,
+            "flooder_ingress": dict(flooder_stats),
+            "slo_top": tn.tenant_slo.publish_topk(),
+        }
+        if tsnap["conservation_violations"]:
+            problems.append(
+                "per-tenant conservation violated: "
+                f"{tsnap['conservation_violations']}")
+        if any(c["pending"] for c in tsnap["tenants"].values()):
+            problems.append("per-tenant pending nonzero after drain")
+        if flooder:
+            if not (tfc.get("quota_rejected") or tfc.get("shed")):
+                problems.append(
+                    "flooder quota never exhausted (no typed "
+                    "rejections or sheds)")
+            if tfc.get("failed"):
+                problems.append(
+                    f"flooder items FAILED ({tfc['failed']}) — "
+                    "exhaustion must be typed, not fatal")
+        # restore the process-global tenant knobs: run() is importable
+        # (bench/report tooling), so the scenario must not leave its
+        # quotas behind for the rest of the process
+        tn.clear_tenant_policies()
+        tn.configure_tenants(depth=tenant_knobs_saved[0],
+                             nbytes=tenant_knobs_saved[1],
+                             window=tenant_knobs_saved[2])
+
     return {
         "ok": not problems,
         "mode": "smoke" if smoke else "soak",
@@ -443,6 +520,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "flight_recorder_dumps": health["flight_recorder"][
             "dump_reasons"],
         "events_path": events_path,
+        "tenant": tenant_rec,
         "problems": problems,
     }
 
@@ -508,6 +586,16 @@ def main() -> int:
                     help="JSONL event-log path (size-capped, rotated)")
     ap.add_argument("--real-device", action="store_true",
                     help="don't force the CPU backend (live windows)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="stripe the bulk flood across N synthetic "
+                         "tenants with per-tenant quotas (0 = legacy "
+                         "un-tenanted scenario); verify workload only")
+    ap.add_argument("--flooder", action="store_true",
+                    help="with --tenants: add one adversarial "
+                         "flooding tenant whose quota (not the lane) "
+                         "must absorb its burst — typed rejections/"
+                         "sheds, zero failures, per-tenant "
+                         "conservation exact")
     ap.add_argument("--workload", default="verify",
                     choices=("verify", "sha256"),
                     help="which engine plugin to soak: the verify "
@@ -540,7 +628,8 @@ def main() -> int:
     if args.workload == "sha256":
         rec = run_sha256(args.smoke, args.duration, events)
     else:
-        rec = run(args.smoke, args.duration, args.corrupt, events)
+        rec = run(args.smoke, args.duration, args.corrupt, events,
+                  tenants=args.tenants, flooder=args.flooder)
     if args.emit_bench_service and args.workload == "verify" \
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)

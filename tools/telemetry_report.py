@@ -67,6 +67,7 @@ def collect_local(top_traces: int = TOP_TRACES) -> dict:
     return {
         "slo": vs.slo_health(),
         "service": vs.service_health(),
+        "tenant": vs.tenant_health(),
         "pipeline": pipeline_timeline.snapshot(limit=4),
         "timeseries": timeseries.snapshot(),
         "transfer": transfer_ledger.totals(),
@@ -91,6 +92,7 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
     return {
         "slo": get("slo"),
         "service": get("service"),
+        "tenant": get("tenant"),
         "pipeline": get("pipeline?limit=4"),
         "timeseries": get("timeseries"),
         "transfer": dispatch.get("transfer", {}),
@@ -168,6 +170,36 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
         lines.append("")
     else:
         lines += ["No SLO accounting in this window.", ""]
+
+    # ---- per-tenant QoS ----
+    ten = data.get("tenant") or {}
+    tslo = ten.get("slo") or {}
+    tsvc = ten.get("service") or {}
+    if tslo.get("tracked"):
+        lines += ["## Per-tenant QoS (top by burn rate)", "",
+                  f"{tslo['tracked']} tenants tracked "
+                  f"(cap {tslo.get('track_cap')}, "
+                  f"{tslo.get('overflow_folded', 0)} folded into "
+                  "`~other`); gauges are rank-keyed "
+                  "(`crypto.verify.tenant.topk.<rank>.*`) so tenant "
+                  "cardinality never grows the series set.", "",
+                  "| tenant | burn | latency burn | shed burn "
+                  "| verified | quota rejected | shed | pending |",
+                  "|---|---|---|---|---|---|---|---|"]
+        counts = tsvc.get("tenants") or {}
+        for row in tslo.get("top") or []:
+            c = counts.get(row["tenant"]) or {}
+            lines.append(
+                f"| {row['tenant']} | **{_fmt(row['burn_rate'])}** "
+                f"| {_fmt(row['latency_burn_rate'])} "
+                f"| {_fmt(row['shed_burn_rate'])} "
+                f"| {c.get('verified', 0)} "
+                f"| {c.get('quota_rejected', 0)} "
+                f"| {c.get('shed', 0)} | {c.get('pending', 0)} |")
+        viol = tsvc.get("conservation_violations") or {}
+        lines += ["",
+                  "Per-tenant conservation violations: "
+                  f"**{len(viol)}** (must be 0)", ""]
 
     # ---- pipeline bubbles ----
     pipe = data.get("pipeline") or {}
@@ -321,7 +353,11 @@ def synthetic_window() -> None:
         items = [(pk, b"report-%d-%d" % (i, k),
                   bytes([(i + k) % 251]) * 64) for k in range(4)]
         lane = "scp" if i % 3 == 0 else "bulk"
-        tickets.append(svc.submit(items, lane=lane))
+        # bulk traffic is tenant-striped so the default report also
+        # renders the per-tenant QoS table (scp stays un-tenanted —
+        # the consensus lane's submitter is the node itself)
+        tenant = None if lane == "scp" else f"demo{i % 3}"
+        tickets.append(svc.submit(items, lane=lane, tenant=tenant))
         timeseries.sample_once()
     for t in tickets:
         t.result(timeout=30)

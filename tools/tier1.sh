@@ -79,6 +79,21 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/metrics_selfcheck.py
 mrc=$?
 echo METRICS_EXPORT_OK=$([ "$mrc" -eq 0 ] && echo 1 || echo 0)
 [ "$mrc" -ne 0 ] && exit $mrc
+# Multi-tenant QoS gate (ISSUE 14): a thousand-tenant synthetic soak
+# with one adversarial flooder against the resident verify service —
+# host-only (stub verifier, no jax), seconds of wall time. Gates: the
+# flooder's quota is exhausted via TYPED rejections/sheds (never
+# failures) while every other tenant's latency and shed budgets stay
+# inside objective, per-tenant work conservation holds exactly
+# (submitted == verified + rejected + shed + failed + pending for
+# every tenant), two replicas under identical arrival order emit
+# bit-identical shed/dispatch decision sequences, weighted fair
+# shares converge 4:2:1, and the rank-keyed tenant gauges stay
+# bounded (the metric-cardinality guard).
+timeout -k 10 240 python tools/tenant_selfcheck.py
+tqrc=$?
+echo TENANT_QOS_OK=$([ "$tqrc" -eq 0 ] && echo 1 || echo 0)
+[ "$tqrc" -ne 0 ] && exit $tqrc
 # Verify-service soak smoke (ISSUE 6): a short CPU-only overload run
 # of the resident verify service (forced 4-device subprocess,
 # flaky-device:0 injected, audit sampling on, mid-run breaker trip)
