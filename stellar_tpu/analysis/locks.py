@@ -54,6 +54,11 @@ SCOPE = [
     # module's own locks (the lane queues are service-internal state,
     # touched only with the service cv held — the _locked convention)
     "stellar_tpu/crypto/tenant.py",
+    # the closed-loop controller (ISSUE 15): trajectory log + knob
+    # state mutate from the dispatcher thread while admin routes read
+    # snapshots — everything under the controller's own lock; the
+    # service applies the resulting knob values under its cv
+    "stellar_tpu/crypto/controller.py",
     "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
     # the device-resident constant cache (ISSUE 12): its LRU mutates

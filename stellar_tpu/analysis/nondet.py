@@ -57,6 +57,13 @@ HOST_ORACLE_FILES = [
     # dispatches or sheds — pure integer/content arithmetic, zero
     # clock reads, NO allowlist entry (pinned in test_analysis.py)
     "stellar_tpu/crypto/tenant.py",
+    # the closed-loop controller (ISSUE 15): its decisions move the
+    # service's scheduling knobs (batch size, pipeline depth, shed
+    # highwater), so it must be a pure function of the telemetry
+    # window it is handed — zero clock reads, NO allowlist entry
+    # (pinned in test_analysis.py), or two replicas' knob
+    # trajectories could diverge under identical inputs
+    "stellar_tpu/crypto/controller.py",
     # the workload-agnostic batch engine owns dispatch, re-shard,
     # audit-sample composition, and host-oracle failover for EVERY
     # plugin — a clock or RNG here would desynchronize which rows any

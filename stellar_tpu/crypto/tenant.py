@@ -55,6 +55,7 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from stellar_tpu.utils.env import env_true
 from stellar_tpu.utils.metrics import (
     fresh_burn_window, push_burn_window, registry, trim_burn_window,
 )
@@ -64,7 +65,7 @@ __all__ = [
     "TenantLaneQueue", "TenantSloMonitor", "tenant_slo",
     "validate_tenant", "shed_key", "shed_keep_fraction",
     "tenant_policy", "set_tenant_policy", "configure_tenants",
-    "clear_tenant_policies",
+    "clear_tenant_policies", "peer_tenant",
 ]
 
 # the implicit tenant of un-tenanted submissions; quota-exempt unless
@@ -110,6 +111,17 @@ TENANT_SLO_WINDOW = int(os.environ.get("VERIFY_TENANT_SLO_WINDOW",
 # fraction of a tenant's depth quota at which its backlog counts as
 # over high-water for the shed pass (mirrors SHED_HIGHWATER_FRAC)
 TENANT_HIGHWATER_FRAC = 0.75
+# tenant identity adoption (ISSUE 15 follow-on to ISSUE 14): when on,
+# the herder SCP-envelope and overlay peer-auth adopters tag their
+# service round trips tenant="peer-<id prefix>" via peer_tenant(), so
+# REAL peers ride per-tenant quotas/fair-share/burn rates. Off by
+# default — identity-to-tenant mapping is an operator policy choice
+# (pre-adoption behavior stays byte-identical).
+TENANT_FROM_PEER = env_true("VERIFY_TENANT_FROM_PEER")
+# hex bytes of the peer id used as the tenant tag: 4 bytes = 8 hex
+# chars, collision-safe for committee-scale fleets while keeping ids
+# short enough for metric/event attributes
+PEER_TENANT_PREFIX_BYTES = 4
 
 _policy_lock = threading.Lock()
 # tenant -> {"weight": int, "depth": Optional[int],
@@ -124,13 +136,17 @@ def configure_tenants(depth: Optional[int] = None,
                       p99_ms: Optional[float] = None,
                       latency_target: Optional[float] = None,
                       shed_budget: Optional[float] = None,
-                      window: Optional[int] = None) -> None:
+                      window: Optional[int] = None,
+                      from_peer: Optional[bool] = None) -> None:
     """Push the global tenant knobs (Config / tools); None keeps the
     current value. Quota knobs take effect on the next admission
     check; SLO knobs on the next window push."""
     global TENANT_DEPTH, TENANT_BYTES, TENANT_TOPK, TENANT_TRACK_CAP
     global TENANT_P99_MS, TENANT_LATENCY_TARGET, TENANT_SHED_BUDGET
+    global TENANT_FROM_PEER
     with _policy_lock:
+        if from_peer is not None:
+            TENANT_FROM_PEER = bool(from_peer)
         if depth is not None:
             TENANT_DEPTH = max(0, int(depth))
         if nbytes is not None:
@@ -200,6 +216,21 @@ def validate_tenant(tenant: Optional[str]) -> str:
             f"invalid tenant id {tenant!r} (want "
             "[A-Za-z0-9][A-Za-z0-9._-]{0,63})")
     return tenant
+
+
+def peer_tenant(peer_id: Optional[bytes]) -> Optional[str]:
+    """The tenant tag for one real peer identity (ISSUE 15 follow-on):
+    ``"peer-<first 4 bytes hex>"`` of an ed25519 node id when
+    :data:`TENANT_FROM_PEER` is on, else ``None`` (the quota-exempt
+    un-tenanted stream — byte-identical pre-adoption admission). The
+    tag is derived from the PUBLIC identity alone, so every replica
+    maps one peer to one tenant without coordination."""
+    if not TENANT_FROM_PEER or not peer_id:
+        return None
+    if not isinstance(peer_id, (bytes, bytearray)) or \
+            len(peer_id) < PEER_TENANT_PREFIX_BYTES:
+        return None
+    return "peer-" + bytes(peer_id[:PEER_TENANT_PREFIX_BYTES]).hex()
 
 
 def shed_key(tenant: str) -> bytes:

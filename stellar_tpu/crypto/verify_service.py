@@ -72,6 +72,22 @@ metric-cardinality guard.
 with ``failed == 0`` in healthy operation — no item is ever silently
 dropped; ``snapshot()["conservation_gap"]`` must read 0 at all times.
 
+**Closed-loop control** (ISSUE 15, ``stellar_tpu/crypto/
+controller.py``): when a :class:`~stellar_tpu.crypto.controller.
+VerifyController` is attached (``VERIFY_CONTROL_ENABLED``), the
+dispatcher assembles an event-count telemetry window every
+``CONTROL_EVERY`` collected batches — per-lane SLO burn rates,
+queue-wait bubble dominance from the pipeline timeline, lane backlog,
+the scp head-of-line sequence age, the shed pressure level — and the
+controller adapts ``max_batch``, ``pipeline_depth`` and the
+shed-ladder entry highwater within clamped, hysteresis-guarded
+bounds. Knob application happens under the service's condition
+variable (:meth:`VerifyService._apply_control_locked`), every move is
+a ``service.control`` flight-recorder event carrying its full input
+window, and the compact trajectory lands in the controller's bounded
+``control_log()`` — replayable bit-for-bit
+(``tools/control_selfcheck.py``, tier-1 ``CONTROL_OK``).
+
 Clock use in this module is confined to latency STAMPS feeding the
 per-lane wait-time histograms (``crypto.verify.service.lane.<lane>.
 wait_ms`` — the p50/p99 the soak harness and bench publish); which
@@ -94,6 +110,7 @@ import numpy as np
 
 from stellar_tpu.crypto import audit as audit_mod
 from stellar_tpu.crypto import batch_verifier
+from stellar_tpu.crypto import controller as controller_mod
 from stellar_tpu.crypto import tenant as tenant_mod
 from stellar_tpu.utils import metrics as metrics_mod
 from stellar_tpu.utils import resilience
@@ -104,7 +121,8 @@ __all__ = ["VerifyService", "VerifyTicket", "Overloaded", "LANES",
            "SHED_LADDER", "configure_service", "default_service",
            "running_service", "service_verified", "service_health",
            "lane_latencies", "SloMonitor", "slo_monitor",
-           "configure_slo", "slo_health", "tenant_health"]
+           "configure_slo", "slo_health", "tenant_health",
+           "control_health"]
 
 # re-export: the typed admission verdict lives with the resilience
 # primitives so TrickleBatcher can raise it without a module cycle
@@ -453,7 +471,10 @@ class VerifyService:
                  lane_bytes: Optional[int] = None,
                  max_batch: Optional[int] = None,
                  pipeline_depth: Optional[int] = None,
-                 aging_every: Optional[int] = None):
+                 aging_every: Optional[int] = None,
+                 shed_highwater_frac: Optional[float] = None,
+                 controller=None,
+                 control_every: Optional[int] = None):
         self._verifier = verifier
         self._lane_depth = LANE_DEPTH if lane_depth is None \
             else max(1, int(lane_depth))
@@ -465,6 +486,23 @@ class VerifyService:
             else max(1, int(pipeline_depth))
         self._aging_every = AGING_EVERY if aging_every is None \
             else max(0, int(aging_every))
+        # shed-ladder entry threshold, PER INSTANCE (ISSUE 15): the
+        # closed-loop controller adapts it within clamped bounds
+        self._shed_highwater_frac = SHED_HIGHWATER_FRAC \
+            if shed_highwater_frac is None \
+            else min(1.0, max(0.01, float(shed_highwater_frac)))
+        # closed-loop controller (ISSUE 15): explicit instance wins;
+        # None auto-attaches one iff VERIFY_CONTROL_ENABLED, seeded
+        # with THIS instance's configured knobs as the relax baseline
+        if controller is None and controller_mod.CONTROL_ENABLED:
+            controller = controller_mod.VerifyController(
+                self._max_batch, self._pipeline_depth,
+                self._shed_highwater_frac)
+        self._controller = controller
+        self._control_every = max(1, controller_mod.CONTROL_EVERY
+                                  if control_every is None
+                                  else int(control_every))
+        self._control_next = self._control_every
         self._cv = threading.Condition()
         self._queues: Dict[str, tenant_mod.TenantLaneQueue] = {
             ln: tenant_mod.TenantLaneQueue() for ln in LANES}
@@ -522,12 +560,13 @@ class VerifyService:
                 target=self._run, daemon=True, name="verify-service")
         self._thread.start()
         batch_verifier.register_service_health(self.snapshot)
-        global _tenant_provider
+        global _tenant_provider, _control_provider
         with _service_lock:
-            # the tenant route serves the last-started instance (same
-            # policy as register_service_health: an embedded service
-            # still gets an admin surface)
+            # the tenant/control routes serve the last-started
+            # instance (same policy as register_service_health: an
+            # embedded service still gets an admin surface)
             _tenant_provider = self.tenant_snapshot
+            _control_provider = self.control_snapshot
         return self
 
     def submit(self, items: Sequence[tuple], lane: str = "bulk",
@@ -721,6 +760,10 @@ class VerifyService:
                           "max_batch": self._max_batch,
                           "pipeline_depth": self._pipeline_depth,
                           "aging_every": self._aging_every},
+                "control": {
+                    "enabled": self._controller is not None,
+                    "shed_highwater_frac": self._shed_highwater_frac,
+                },
             }
 
     def tenant_snapshot(self) -> dict:
@@ -758,6 +801,28 @@ class VerifyService:
         with self._cv:
             log = list(self._decisions)
         return log[-limit:] if limit else log
+
+    def control_log(self, limit: int = 0) -> list:
+        """The attached controller's bounded knob-trajectory log
+        (ISSUE 15); empty when no controller is attached."""
+        ctl = self._controller
+        return ctl.control_log(limit) if ctl is not None else []
+
+    def control_snapshot(self) -> dict:
+        """The ``control`` admin-route payload: the controller's
+        knob/clamp/hysteresis state plus the tail of its trajectory
+        log, and the LIVE values the service is currently applying."""
+        ctl = self._controller
+        with self._cv:
+            live = {"max_batch": self._max_batch,
+                    "pipeline_depth": self._pipeline_depth,
+                    "shed_highwater_frac": self._shed_highwater_frac,
+                    "control_every": self._control_every}
+        if ctl is None:
+            return {"enabled": False, "live": live}
+        return {"enabled": True, "live": live,
+                "controller": ctl.snapshot(),
+                "log_tail": ctl.control_log(limit=32)}
 
     # ---------------- dispatcher internals ----------------
     # _locked helpers are called with self._cv held (the repo-wide
@@ -801,7 +866,7 @@ class VerifyService:
         backlog over high-water, 0 = healthy."""
         if batch_verifier.dispatch_degraded():
             return 2, "dispatch-degraded"
-        hw = max(1, int(self._lane_depth * SHED_HIGHWATER_FRAC))
+        hw = max(1, int(self._lane_depth * self._shed_highwater_frac))
         if len(self._queues["bulk"]) >= hw:
             return 1, "backlog"
         return 0, ""
@@ -1057,6 +1122,76 @@ class VerifyService:
             else:
                 ti.pop(tkt.tenant, None)
 
+    # ---------------- closed-loop control (ISSUE 15) ----------------
+
+    def _control_window_locked(self) -> dict:
+        """The deterministic half of one telemetry window (called with
+        the cv held): batch/pressure counters, per-lane backlog, and
+        the scp head-of-line SEQUENCE age — the clock-free latency
+        proxy (how many submissions were admitted after the oldest
+        queued scp submission)."""
+        scp_head = self._queues["scp"].oldest_seq()
+        lanes = {ln: {
+            "queued_submissions": len(self._queues[ln]),
+            "queued_items": self._queued_items[ln],
+        } for ln in LANES}
+        return {
+            "batches": self._batches,
+            "pressure": self._pressure,
+            "lane_depth": self._lane_depth,
+            "scp_hol_age": (self._seq - scp_head)
+            if scp_head is not None else 0,
+            "lanes": lanes,
+            "knobs": {"max_batch": self._max_batch,
+                      "pipeline_depth": self._pipeline_depth,
+                      "shed_highwater_frac":
+                          self._shed_highwater_frac},
+        }
+
+    def _apply_control_locked(self, knobs: dict) -> None:
+        """THE knob application point (called with the cv held): the
+        controller's clamped values become the scheduling knobs the
+        next collect/pressure pass reads — one consistent set, never
+        a half-applied mix."""
+        self._max_batch = max(1, int(knobs["max_batch"]))
+        self._pipeline_depth = max(1, int(knobs["pipeline_depth"]))
+        self._shed_highwater_frac = min(1.0, max(
+            0.01, float(knobs["shed_highwater_frac"])))
+
+    def _maybe_control(self) -> None:
+        """One controller step when the batch cadence is due: assemble
+        the window (deterministic half under the cv, advisory burn/
+        bubble half outside it), step the controller, apply any moved
+        knobs under the cv, and emit each move as a ``service.control``
+        flight-recorder event carrying the full window."""
+        ctl = self._controller
+        if ctl is None:
+            return
+        with self._cv:
+            if self._batches < self._control_next:
+                return
+            self._control_next = self._batches + self._control_every
+            window = self._control_window_locked()
+        _control_advisories(window)
+        decisions = ctl.step(window)
+        knobs = ctl.knobs()
+        with self._cv:
+            self._apply_control_locked(knobs)
+        if decisions:
+            registry.meter("crypto.verify.control.decisions").mark(
+                len(decisions))
+            batch_verifier.note_trace_event(
+                "service.control", window=window,
+                decisions=decisions)
+        registry.gauge("crypto.verify.control.max_batch").set(
+            knobs["max_batch"])
+        registry.gauge("crypto.verify.control.pipeline_depth").set(
+            knobs["pipeline_depth"])
+        registry.gauge(
+            "crypto.verify.control.shed_highwater_frac").set(
+            knobs["shed_highwater_frac"])
+        registry.gauge("crypto.verify.control.moves").set(ctl.moves)
+
     def _run(self) -> None:
         # in-flight dispatches are LOCAL to the dispatcher thread (the
         # only thread that touches them); shared state stays under cv
@@ -1111,6 +1246,11 @@ class VerifyService:
                     self._resolve_failed(ln, parts, err, traces=tr)
                 else:
                     inflight.append((ln, parts, resolver, tr))
+                # closed-loop control rides the batch cadence
+                # (event-count, never a timer) — evaluated after the
+                # dispatch so the window sees this batch's backlog
+                # drain (ISSUE 15)
+                self._maybe_control()
             if inflight and (batch is None or
                              len(inflight) >= self._pipeline_depth):
                 self._resolve_one(*inflight.popleft())
@@ -1156,6 +1296,25 @@ def _part_tenants(parts) -> list:
     return seen
 
 
+def _control_advisories(window: dict) -> None:
+    """Merge the advisory half of a control window in place: per-lane
+    SLO burn rates (latency + completion, from the process-wide
+    monitor) and queue-wait bubble dominance from the pipeline
+    timeline. These are REPORTED numbers — the controller itself
+    reads no clock; replaying the logged windows reproduces the
+    trajectory whatever these advisories were."""
+    slo = slo_monitor.snapshot()
+    for ln, objs in slo.get("lanes", {}).items():
+        lane = window["lanes"].setdefault(ln, {})
+        lane["latency_burn"] = objs["latency"]["burn_rate"]
+        lane["shed_burn"] = objs["completion"]["burn_rate"]
+    from stellar_tpu.utils.timeline import pipeline_timeline
+    bub = pipeline_timeline.totals().get("bubble_ms") or {}
+    total = sum(bub.values())
+    window["queue_wait_frac"] = round(
+        bub.get("queue_wait", 0.0) / total, 4) if total else 0.0
+
+
 def lane_latencies() -> Dict[str, dict]:
     """Per-lane wait-time histogram summaries (count/p50/p90/p99/sum)
     — what ``bench.py``'s ``service`` record section and the soak
@@ -1174,9 +1333,11 @@ def lane_latencies() -> Dict[str, dict]:
 
 _service: Optional[VerifyService] = None
 _service_lock = threading.Lock()
-# tenant_snapshot of the process-wide service, else the last-started
-# instance (set under _service_lock in VerifyService.start)
+# tenant_snapshot / control_snapshot of the process-wide service, else
+# the last-started instance (set under _service_lock in
+# VerifyService.start)
 _tenant_provider = None
+_control_provider = None
 
 
 def default_service(start: bool = True) -> VerifyService:
@@ -1234,7 +1395,8 @@ def _adopter_fallback(lane: str, reason: str, n: int) -> None:
 
 
 def service_verified(items: Sequence[tuple], lane: str,
-                     timeout: float = 10.0) -> Optional[list]:
+                     timeout: float = 10.0,
+                     tenant: Optional[str] = None) -> Optional[list]:
     """One cache-seeding service round trip for the signature hot
     paths (herder SCP envelopes, peer auth certs, overlay tx-flood
     pre-verify — the three lane adopters share THIS block so their
@@ -1252,7 +1414,11 @@ def service_verified(items: Sequence[tuple], lane: str,
     metered per lane+reason (``crypto.verify.service.
     adopter_fallback.*``). ``None`` means "you decide" — the direct
     path is bit-identical, so the service can only ever change
-    latency, never validity."""
+    latency, never validity. ``tenant`` attributes the round trip to
+    a principal (ISSUE 15 follow-on: the herder/overlay adopters pass
+    ``tenant_mod.peer_tenant(<peer id>)`` so real peers ride
+    per-tenant quotas once ``VERIFY_TENANT_FROM_PEER`` is on; None —
+    the default — keeps the quota-exempt un-tenanted stream)."""
     global _adopter_cooldown_until
     n = len(items)
     # clock read: cool-down bypass decides only WHICH bit-identical
@@ -1268,7 +1434,14 @@ def service_verified(items: Sequence[tuple], lane: str,
         _adopter_fallback(lane, "absent", n)
         return None
     try:
-        ok = svc.verify(items, lane=lane, timeout=timeout)
+        # the un-tenanted call keeps the legacy shape, so duck-typed
+        # service stand-ins (tests, embedders) without a tenant
+        # parameter keep working until they opt into tenancy
+        if tenant is None:
+            ok = svc.verify(items, lane=lane, timeout=timeout)
+        else:
+            ok = svc.verify(items, lane=lane, timeout=timeout,
+                            tenant=tenant)
     except (FuturesTimeout, TimeoutError):
         with _service_lock:
             _adopter_cooldown_until = (time.monotonic()
@@ -1301,6 +1474,22 @@ def service_health() -> dict:
     if svc is not None:
         return svc.snapshot()
     return batch_verifier.service_health_snapshot()
+
+
+def control_health() -> dict:
+    """The ``control`` admin-route payload (ISSUE 15): the closed-loop
+    controller's knob/clamp/hysteresis state, the live values the
+    service applies, and the tail of the trajectory log. Served
+    directly — the controller matters exactly when the node is under
+    load (same policy as ``slo``/``tenant``)."""
+    with _service_lock:
+        svc = _service
+        provider = _control_provider
+    if svc is not None:
+        provider = svc.control_snapshot
+    if provider is None:
+        return {"enabled": False}
+    return provider()
 
 
 def tenant_health() -> dict:

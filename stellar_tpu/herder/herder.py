@@ -24,6 +24,7 @@ from stellar_tpu.crypto.keys import (
     SecretKey, batch_verify_into_cache, cached_verify_sig,
     verify_sig,
 )
+from stellar_tpu.crypto.tenant import peer_tenant
 from stellar_tpu.crypto.verify_service import service_verified
 from stellar_tpu.herder.transaction_queue import AddResult, TransactionQueue
 from stellar_tpu.herder.tx_set import (
@@ -342,9 +343,14 @@ class Herder:
         # shared adopter block (service_verified): bounded wait +
         # cache seeding + any-failure fallback — previously this call
         # had NO result timeout, so a wedged dispatcher could park
-        # the consensus crank on an unresolved scp ticket
+        # the consensus crank on an unresolved scp ticket. The round
+        # trip is tenant-tagged with the envelope's VALIDATOR identity
+        # when VERIFY_TENANT_FROM_PEER is on (ISSUE 15 follow-on to
+        # the ISSUE 14 quotas), so one flooding validator degrades
+        # itself, not the whole scp lane; off (the default) keeps the
+        # quota-exempt un-tenanted stream byte-identical.
         res = service_verified([(pk, payload, env.signature)],
-                               lane="scp")
+                               lane="scp", tenant=peer_tenant(pk))
         if res is not None:
             return res[0]
         return verify_sig(pk, payload, env.signature)

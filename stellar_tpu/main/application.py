@@ -264,7 +264,8 @@ class Application:
                 changed("VERIFY_TENANT_TRACK_CAP") or \
                 changed("VERIFY_TENANT_P99_MS") or \
                 changed("VERIFY_TENANT_SHED_BUDGET") or \
-                changed("VERIFY_TENANT_SLO_WINDOW"):
+                changed("VERIFY_TENANT_SLO_WINDOW") or \
+                changed("VERIFY_TENANT_FROM_PEER"):
             from stellar_tpu.crypto import tenant
             tenant.configure_tenants(
                 depth=config.VERIFY_TENANT_DEPTH,
@@ -273,7 +274,30 @@ class Application:
                 track_cap=config.VERIFY_TENANT_TRACK_CAP,
                 p99_ms=config.VERIFY_TENANT_P99_MS,
                 shed_budget=config.VERIFY_TENANT_SHED_BUDGET,
-                window=config.VERIFY_TENANT_SLO_WINDOW)
+                window=config.VERIFY_TENANT_SLO_WINDOW,
+                from_peer=config.VERIFY_TENANT_FROM_PEER)
+        # closed-loop control knobs (docs/robustness.md "Closed-loop
+        # control") — pushed BEFORE the service could start, so an
+        # auto-attached controller is born with the configured clamps
+        if changed("VERIFY_CONTROL_ENABLED") or \
+                changed("VERIFY_CONTROL_EVERY") or \
+                changed("VERIFY_CONTROL_MIN_BATCH") or \
+                changed("VERIFY_CONTROL_MAX_BATCH") or \
+                changed("VERIFY_CONTROL_MAX_PIPELINE_DEPTH") or \
+                changed("VERIFY_CONTROL_HYSTERESIS") or \
+                changed("VERIFY_CONTROL_COOLDOWN") or \
+                changed("VERIFY_CONTROL_LOG"):
+            from stellar_tpu.crypto import controller
+            controller.configure_control(
+                enabled=config.VERIFY_CONTROL_ENABLED,
+                every=config.VERIFY_CONTROL_EVERY,
+                min_batch=config.VERIFY_CONTROL_MIN_BATCH,
+                max_batch=config.VERIFY_CONTROL_MAX_BATCH,
+                max_pipeline_depth=(
+                    config.VERIFY_CONTROL_MAX_PIPELINE_DEPTH),
+                hysteresis=config.VERIFY_CONTROL_HYSTERESIS,
+                cooldown=config.VERIFY_CONTROL_COOLDOWN,
+                log_cap=config.VERIFY_CONTROL_LOG)
         if config.VERIFY_SERVICE_ENABLED:
             from stellar_tpu.crypto import verify_service
             verify_service.default_service()

@@ -209,6 +209,16 @@ class CommandHandler:
         from stellar_tpu.crypto import verify_service
         return verify_service.tenant_health()
 
+    def cmd_control(self, params):
+        """Closed-loop controller surface (ISSUE 15): the knob
+        trajectory the deterministic feedback controller is driving —
+        current/base knob values, clamp bounds, hysteresis state, and
+        the tail of the bounded control log. Served directly — the
+        controller acts exactly when the node is overloaded (same
+        policy as ``slo``/``tenant``)."""
+        from stellar_tpu.crypto import verify_service
+        return verify_service.control_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -675,6 +685,7 @@ class CommandHandler:
         "trace": cmd_trace, "service": cmd_service,
         "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
         "slo": cmd_slo, "tenant": cmd_tenant,
+        "control": cmd_control,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

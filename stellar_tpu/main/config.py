@@ -228,6 +228,33 @@ class Config:
     VERIFY_TENANT_P99_MS: float = 30000.0
     VERIFY_TENANT_SHED_BUDGET: float = 0.5
     VERIFY_TENANT_SLO_WINDOW: int = 256
+    # tenant identity adoption (docs/robustness.md "Closed-loop
+    # control"): tag herder SCP-envelope and overlay peer-auth service
+    # round trips tenant="peer-<node-id prefix>" so real peers ride
+    # per-tenant quotas/fair-share once enabled. Off by default —
+    # identity-to-tenant mapping is an operator policy choice.
+    VERIFY_TENANT_FROM_PEER: bool = False
+    # closed-loop control (docs/robustness.md "Closed-loop control"):
+    # a deterministic feedback controller consumes event-count
+    # telemetry windows (SLO burn rates, queue-wait bubble dominance,
+    # lane backlog) and adapts MAX_BATCH / PIPELINE_DEPTH / the
+    # shed-ladder entry highwater within clamped, hysteresis-guarded
+    # bounds — zero clock reads in any decision, every move a
+    # service.control recorder event with its full input window.
+    # Disabled by default, exactly like the service itself.
+    VERIFY_CONTROL_ENABLED: bool = False
+    # controller cadence: one window every N collected batches
+    VERIFY_CONTROL_EVERY: int = 8
+    # clamp bounds for the adapted knobs
+    VERIFY_CONTROL_MIN_BATCH: int = 32
+    VERIFY_CONTROL_MAX_BATCH: int = 8192
+    VERIFY_CONTROL_MAX_PIPELINE_DEPTH: int = 8
+    # consecutive windows a condition must hold before it may act
+    VERIFY_CONTROL_HYSTERESIS: int = 2
+    # windows a knob stays frozen after it moved (anti-oscillation)
+    VERIFY_CONTROL_COOLDOWN: int = 4
+    # bounded control-log / retained-window depth (the replay surface)
+    VERIFY_CONTROL_LOG: int = 4096
 
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)

@@ -93,8 +93,14 @@ class PeerAuth:
         got = cached_verify_sig(remote_node_id, payload, cert.sig)
         if got is not None:
             return got
+        # tenant-tagged with the REMOTE peer's identity when
+        # VERIFY_TENANT_FROM_PEER is on (ISSUE 15 follow-on): a
+        # handshake-flooding peer exhausts its own per-tenant quota
+        # inside the auth lane instead of starving other peers
+        from stellar_tpu.crypto.tenant import peer_tenant
         res = service_verified(
-            [(remote_node_id, payload, cert.sig)], lane="auth")
+            [(remote_node_id, payload, cert.sig)], lane="auth",
+            tenant=peer_tenant(remote_node_id))
         if res is not None:
             return res[0]
         return verify_sig(remote_node_id, payload, cert.sig)

@@ -1,0 +1,19 @@
+"""Shared environment-knob parsing.
+
+ONE env-bool rule for the opt-in ``VERIFY_*`` flags
+(``VERIFY_CONTROL_ENABLED``, ``VERIFY_TENANT_FROM_PEER``, ...), so
+two knobs can never parse the same string differently."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_true"]
+
+
+def env_true(name: str, default: str = "0") -> bool:
+    """Truthy is EXPLICIT — an unrecognized value ("off", "disabled",
+    a typo) leaves an opt-in feature OFF rather than silently
+    enabling it."""
+    return os.environ.get(name, default).strip().lower() in (
+        "1", "true", "yes", "on")

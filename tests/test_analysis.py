@@ -606,6 +606,27 @@ def test_lint_scopes_cover_tenant_scheduler():
     assert set(entry) == {"nondet:clock"}
 
 
+def test_lint_scopes_cover_controller():
+    """ISSUE 15: the closed-loop controller moves the service's
+    scheduling knobs (batch size, pipeline depth, shed highwater), so
+    its decisions must be a pure function of the telemetry window —
+    it joins the nondet scope with ZERO allowlist entries (no clock
+    read anywhere in a decision) and the lock-lint scope (trajectory
+    log + knob state mutate from the dispatcher thread while admin
+    routes read snapshots). The verify service's pre-existing clock
+    allowlist must NOT have grown new keys for the control hook."""
+    c = "stellar_tpu/crypto/controller.py"
+    assert c in set(nondet.HOST_ORACLE_FILES)
+    assert c in set(locks.SCOPE)
+    assert c not in nondet.ALLOWLIST._entries
+    assert c not in locks.ALLOWLIST._entries
+    # the control surgery added no new nondet allowlist keys to the
+    # service: still exactly the latency-stamp clock entry
+    entry = nondet.ALLOWLIST._entries.get(
+        "stellar_tpu/crypto/verify_service.py", {})
+    assert set(entry) == {"nondet:clock"}
+
+
 def test_lint_scopes_cover_batch_engine():
     """ISSUE 7: the workload-agnostic engine owns the jit-bucket cache,
     device-health registry and served-counter RMWs from resolver/pool/

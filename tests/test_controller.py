@@ -1,0 +1,556 @@
+"""Closed-loop control (ISSUE 15): the deterministic feedback
+controller that adapts MAX_BATCH / PIPELINE_DEPTH / the shed-ladder
+entry highwater from event-count telemetry windows — clamps never
+exceeded, hysteresis + cool-down prevent oscillation on
+boundary-riding signals, replicas over identical windows are
+bit-identical, Config pushes through Application, and the service
+applies knob moves under its condition variable. See
+``docs/robustness.md`` "Closed-loop control"."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import controller as cmod
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.crypto.controller import VerifyController
+
+
+def _window(bulk_burn=0.0, scp_lat_burn=0.0, scp_shed_burn=0.0,
+            backlog=0, lane_depth=100, qw=0.0, pressure=0):
+    return {
+        "batches": 1, "pressure": pressure, "lane_depth": lane_depth,
+        "scp_hol_age": 0,
+        "lanes": {
+            "scp": {"queued_submissions": 0, "queued_items": 0,
+                    "latency_burn": scp_lat_burn,
+                    "shed_burn": scp_shed_burn},
+            "auth": {"queued_submissions": 0, "queued_items": 0,
+                     "latency_burn": 0.0, "shed_burn": 0.0},
+            "bulk": {"queued_submissions": backlog,
+                     "queued_items": backlog * 4,
+                     "latency_burn": 0.0, "shed_burn": bulk_burn},
+        },
+        "queue_wait_frac": qw,
+    }
+
+
+def _ctl(**kw):
+    kw.setdefault("min_batch", 4)
+    kw.setdefault("batch_ceiling", 64)
+    kw.setdefault("max_pipeline_depth", 4)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown", 2)
+    return VerifyController(16, 2, 0.75, **kw)
+
+
+# ---------------- decision table ----------------
+
+
+def test_grow_on_bulk_burn_with_queue_wait_dominance():
+    ctl = _ctl()
+    w = _window(bulk_burn=2.0, qw=0.8)
+    assert ctl.step(w) == []            # hysteresis: first window holds
+    moves = ctl.step(w)
+    assert [(m["knob"], m["old"], m["new"]) for m in moves] == \
+        [("max_batch", 16, 32)]
+    assert moves[0]["reason"] == "bulk-burn+queue-wait"
+
+
+def test_grow_on_backlog_pressure_without_device_timeline():
+    """Host-only: bulk backlog over the pressure band (half the shed
+    highwater) is the deterministic stand-in for queue-wait bubbles."""
+    ctl = _ctl()
+    w = _window(backlog=50, lane_depth=100)   # 0.5 >= 0.75 * 0.5
+    ctl.step(w)
+    moves = ctl.step(w)
+    # burn is 0 here: the logged reason must name backlog alone
+    assert moves and moves[0]["reason"] == "backlog"
+    # with the burn ALSO over budget, the label carries both signals
+    ctl2 = _ctl()
+    w2 = _window(bulk_burn=2.0, backlog=50, lane_depth=100)
+    ctl2.step(w2)
+    moves2 = ctl2.step(w2)
+    assert moves2 and moves2[0]["reason"] == "bulk-burn+backlog"
+
+
+def test_no_grow_when_bulk_burn_high_but_no_queue_pressure():
+    """Burn without queue-wait dominance or backlog (e.g. rejections
+    at a tight ingress budget) is not a batching problem — growing
+    batches would change nothing."""
+    ctl = _ctl()
+    w = _window(bulk_burn=3.0, qw=0.0, backlog=0)
+    for _ in range(6):
+        assert ctl.step(w) == []
+    assert ctl.knobs()["max_batch"] == 16
+
+
+def test_scp_threat_shrinks_batches_raises_depth_lowers_highwater():
+    ctl = _ctl()
+    w = _window(scp_lat_burn=1.5)
+    ctl.step(w)
+    moves = ctl.step(w)
+    got = {m["knob"]: (m["old"], m["new"]) for m in moves}
+    assert got == {"max_batch": (16, 8), "pipeline_depth": (2, 3),
+                   "shed_highwater_frac": (0.75, 0.625)}
+    assert all(m["action"] == "shrink" and m["reason"] == "scp-threat"
+               for m in moves)
+
+
+def test_scp_threat_wins_over_bulk_pressure():
+    ctl = _ctl()
+    w = _window(bulk_burn=3.0, qw=0.9, scp_lat_burn=2.0)
+    ctl.step(w)
+    moves = ctl.step(w)
+    assert all(m["action"] == "shrink" for m in moves)
+
+
+def test_relax_steps_back_toward_configured_baseline():
+    ctl = _ctl(cooldown=0)
+    threat = _window(scp_lat_burn=2.0)
+    for _ in range(4):
+        ctl.step(threat)
+    moved = ctl.knobs()
+    assert moved["max_batch"] < 16
+    healthy = _window()
+    for _ in range(12):
+        ctl.step(healthy)
+    assert ctl.knobs() == {"max_batch": 16, "pipeline_depth": 2,
+                           "shed_highwater_frac": 0.75}
+    # ... and never past the baseline
+    for _ in range(4):
+        ctl.step(healthy)
+    assert ctl.knobs()["max_batch"] == 16
+
+
+# ---------------- clamps ----------------
+
+
+def test_clamp_bounds_never_exceeded():
+    ctl = _ctl(cooldown=0, hysteresis=1)
+    threat = _window(scp_lat_burn=9.9, scp_shed_burn=9.9)
+    grow = _window(bulk_burn=9.9, qw=1.0, backlog=99)
+    for w in (threat, grow):
+        for _ in range(50):
+            ctl.step(w)
+    for entry in ctl.control_log():
+        _a, _seq, mb, pd, hw_milli, _r = entry
+        assert 4 <= mb <= 64
+        assert 1 <= pd <= 4
+        assert 250 <= hw_milli <= 875
+    # pinned endpoints: sustained threat rides the floor, sustained
+    # grow the ceiling
+    assert ctl.knobs()["max_batch"] == 64
+    for _ in range(50):
+        ctl.step(threat)
+    k = ctl.knobs()
+    assert k["max_batch"] == 4 and k["pipeline_depth"] == 4
+    assert k["shed_highwater_frac"] == cmod.HIGHWATER_MIN
+
+
+def test_operator_baseline_widens_clamps_never_overridden():
+    """An operator knob outside the default clamp range is NEVER
+    silently re-shaped: the clamp widens to include it, the baseline
+    stays exactly what was configured (a controller may not move a
+    knob without a logged decision), and garbage values are only
+    sanitized to physical bounds (highwater is a fraction)."""
+    ctl = VerifyController(10_000, 99, 5.0, min_batch=4,
+                           batch_ceiling=64, max_pipeline_depth=4)
+    assert ctl.knobs() == {"max_batch": 10_000, "pipeline_depth": 99,
+                           "shed_highwater_frac": 1.0}
+    clamps = ctl.snapshot()["clamps"]
+    assert clamps["batch_ceiling"] == 10_000
+    assert clamps["max_pipeline_depth"] == 99
+    assert clamps["highwater_max"] == 1.0
+    # below the floor widens downward the same way
+    low = VerifyController(16, 2, 0.75)   # module min_batch is 32
+    assert low.knobs()["max_batch"] == 16
+    assert low.snapshot()["clamps"]["min_batch"] == 16
+    # a service auto-attach therefore starts EXACTLY at the operator
+    # knobs even without any stepping
+    assert low.control_log() == []
+
+
+def test_deterministic_scp_signals_trigger_shrink():
+    """The clock-free early signals (ISSUE 15 window fields): a
+    queued scp submission whose head-of-line sequence age reached the
+    lane depth, or scp work queued under dispatch-degraded pressure,
+    threaten scp before any burn rate can show it."""
+    for field in ({"scp_hol_age": 100}, {"pressure": 2}):
+        ctl = _ctl()
+        w = _window()
+        w["lanes"]["scp"]["queued_submissions"] = 1
+        w.update(field)
+        ctl.step(w)
+        moves = ctl.step(w)
+        assert moves and all(m["action"] == "shrink" for m in moves), \
+            field
+    # ... but neither fires with an empty scp queue
+    ctl = _ctl()
+    w = _window()
+    w.update({"scp_hol_age": 500, "pressure": 2})
+    for _ in range(4):
+        assert ctl.step(w) == []
+
+
+# ---------------- hysteresis / anti-oscillation ----------------
+
+
+def test_boundary_riding_window_never_flaps_a_knob():
+    """A signal oscillating across the ACT threshold (burn
+    0.99 / 1.01 alternating) keeps resetting the streak: with
+    hysteresis 2 no knob ever moves."""
+    ctl = _ctl()
+    hot = _window(scp_lat_burn=1.01)
+    cold = _window(scp_lat_burn=0.99, backlog=40)
+    for i in range(40):
+        assert ctl.step(hot if i % 2 == 0 else cold) == []
+    assert ctl.knobs()["max_batch"] == 16
+    assert ctl.moves == 0
+
+
+def test_cooldown_freezes_a_moved_knob():
+    ctl = _ctl(cooldown=3)
+    w = _window(bulk_burn=2.0, qw=0.9)
+    logs = [ctl.step(w) for _ in range(6)]
+    moved_at = [i for i, m in enumerate(logs) if m]
+    # one move past hysteresis, then frozen for the cool-down span
+    assert moved_at == [1, 5]
+    held = ctl.control_log()[2:5]
+    assert all(e[0] == "hold" and e[5] == "cooldown" for e in held)
+
+
+def test_lowered_highwater_does_not_ratchet():
+    """Anti-windup: the backlog bands measure against the CONFIGURED
+    baseline highwater, not the adapted knob — otherwise a lowered
+    highwater lowers its own pressure band, the healthy branch
+    becomes unreachable, and the knob pins at the floor forever."""
+    ctl = _ctl(cooldown=0)
+    threat = _window(scp_lat_burn=2.0)
+    for _ in range(20):
+        ctl.step(threat)
+    assert ctl.knobs()["shed_highwater_frac"] == cmod.HIGHWATER_MIN
+    # backlog 20/100: healthy under the baseline band (0.2 <
+    # 0.75*0.5) even though it would read as pressure against the
+    # floor (0.2 >= 0.25*0.5) — the relax path must stay reachable
+    settled = _window(backlog=20, lane_depth=100)
+    for _ in range(20):
+        ctl.step(settled)
+    assert ctl.knobs()["shed_highwater_frac"] == 0.75
+    # and no grow ever fired off the adapted-band misread
+    assert not any(e[0] == "grow" for e in ctl.control_log())
+
+
+def test_hold_reasons_distinguish_base_from_clamp():
+    """'at-base' (healthy, steady at the configured knobs) and
+    'at-bound' (riding a clamp under sustained pressure) are
+    different operational states — the log must say which."""
+    ctl = _ctl(cooldown=0)
+    for _ in range(4):
+        ctl.step(_window())                   # healthy at baseline
+    assert ctl.control_log()[-1][:1] + ctl.control_log()[-1][5:] == \
+        ("hold", "at-base")
+    ctl2 = _ctl(cooldown=0, hysteresis=1)
+    grow = _window(bulk_burn=9.0, qw=1.0, backlog=90)
+    for _ in range(10):
+        ctl2.step(grow)                       # rides the ceiling
+    assert ctl2.knobs()["max_batch"] == 64
+    assert ctl2.control_log()[-1][5] == "at-bound"
+
+
+def test_deadband_between_act_and_relax():
+    """Burn in the deadband (RELAX_BURN..ACT_BURN) neither acts nor
+    relaxes — a mid-band signal parks the knobs where they are."""
+    ctl = _ctl(cooldown=0)
+    threat = _window(scp_lat_burn=2.0)
+    for _ in range(3):
+        ctl.step(threat)
+    parked = ctl.knobs()
+    assert parked["max_batch"] < 16
+    mid = _window(scp_lat_burn=0.8, bulk_burn=0.8)
+    for _ in range(10):
+        assert ctl.step(mid) == []
+    assert ctl.knobs() == parked
+
+
+# ---------------- replica bit-identity / replay ----------------
+
+
+def test_replica_bit_identity_over_identical_windows():
+    seq = ([_window(bulk_burn=2.0, qw=0.7)] * 5
+           + [_window(scp_lat_burn=1.4)] * 5
+           + [_window()] * 8
+           + [_window(scp_lat_burn=1.01), _window(scp_lat_burn=0.99)] * 4)
+    a, b = _ctl(), _ctl()
+    for w in seq:
+        a.step(w)
+        b.step(w)
+    assert a.control_log() == b.control_log()
+    assert a.knobs() == b.knobs()
+    assert a.moves == b.moves and a.moves > 0
+
+
+def test_replay_reproduces_live_trajectory():
+    ctl = _ctl()
+    for w in ([_window(bulk_burn=2.0, qw=0.7)] * 6 + [_window()] * 6):
+        ctl.step(w)
+    assert ctl.replay(ctl.windows()) == ctl.control_log()
+    # the log and retained windows stay in lockstep (the replay
+    # surface is complete)
+    assert len(ctl.windows()) == len(ctl.control_log())
+
+
+def test_log_is_bounded():
+    ctl = _ctl(log_cap=32)
+    w = _window()
+    for _ in range(100):
+        ctl.step(w)
+    assert len(ctl.control_log()) == 32
+    assert len(ctl.windows()) == 32
+    assert ctl.control_log(limit=5) == ctl.control_log()[-5:]
+
+
+# ---------------- configure / Config push ----------------
+
+
+def test_configure_control_clamps_and_applies():
+    saved = (cmod.CONTROL_ENABLED, cmod.CONTROL_EVERY,
+             cmod.CONTROL_MIN_BATCH, cmod.CONTROL_MAX_BATCH,
+             cmod.CONTROL_MAX_PIPELINE_DEPTH, cmod.CONTROL_HYSTERESIS,
+             cmod.CONTROL_COOLDOWN, cmod.CONTROL_LOG)
+    try:
+        cmod.configure_control(enabled=True, every=0, min_batch=0,
+                               max_batch=0, max_pipeline_depth=0,
+                               hysteresis=0, cooldown=-1, log_cap=1)
+        assert cmod.CONTROL_ENABLED is True
+        assert cmod.CONTROL_EVERY == 1
+        assert cmod.CONTROL_MIN_BATCH == 1
+        assert cmod.CONTROL_MAX_BATCH == 1
+        assert cmod.CONTROL_MAX_PIPELINE_DEPTH == 1
+        assert cmod.CONTROL_HYSTERESIS == 1
+        assert cmod.CONTROL_COOLDOWN == 0
+        assert cmod.CONTROL_LOG == 16
+    finally:
+        cmod.configure_control(enabled=saved[0], every=saved[1],
+                               min_batch=saved[2], max_batch=saved[3],
+                               max_pipeline_depth=saved[4],
+                               hysteresis=saved[5], cooldown=saved[6],
+                               log_cap=saved[7])
+
+
+def test_config_knobs_push_through_application():
+    """The VERIFY_CONTROL_* Config knobs exist with the documented
+    defaults and Application pushes non-default values through
+    configure_control (same policy as the service knobs)."""
+    from stellar_tpu.main.config import Config
+    cfg = Config()
+    assert cfg.VERIFY_CONTROL_ENABLED is False
+    assert cfg.VERIFY_CONTROL_EVERY == 8
+    assert cfg.VERIFY_CONTROL_MIN_BATCH == 32
+    assert cfg.VERIFY_CONTROL_MAX_BATCH == 8192
+    assert cfg.VERIFY_CONTROL_MAX_PIPELINE_DEPTH == 8
+    assert cfg.VERIFY_CONTROL_HYSTERESIS == 2
+    assert cfg.VERIFY_CONTROL_COOLDOWN == 4
+    assert cfg.VERIFY_CONTROL_LOG == 4096
+    assert cfg.VERIFY_TENANT_FROM_PEER is False
+    saved = (cmod.CONTROL_EVERY, cmod.CONTROL_HYSTERESIS)
+    try:
+        from stellar_tpu.main.application import Application
+        cfg.VERIFY_CONTROL_EVERY = 3
+        cfg.VERIFY_CONTROL_HYSTERESIS = 5
+        Application._apply_global_config(object.__new__(Application),
+                                         cfg)
+        assert cmod.CONTROL_EVERY == 3
+        assert cmod.CONTROL_HYSTERESIS == 5
+    finally:
+        cmod.configure_control(every=saved[0], hysteresis=saved[1])
+
+
+# ---------------- service integration ----------------
+
+
+class _Instant:
+    def submit(self, items, trace_ids=None):
+        n = len(items)
+        return lambda: np.ones(n, dtype=bool)
+
+
+def _items(i, n=1):
+    pk = bytes([(i * 13 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"c-%d-%d" % (i, k), bytes(16)) for k in range(n)]
+
+
+def test_service_applies_controller_knobs_under_cv():
+    """A controller that grows max_batch must change what the NEXT
+    collect reads — the knob application point under the lane lock."""
+    cmod.configure_control(every=1)
+    try:
+        ctl = VerifyController(2, 1, 0.75, min_batch=1,
+                               batch_ceiling=16, hysteresis=1,
+                               cooldown=0)
+        svc = vs.VerifyService(verifier=_Instant(), lane_depth=64,
+                               max_batch=2, pipeline_depth=1,
+                               controller=ctl)
+        svc._running = True          # scripted scheduling unit
+        for i in range(8):
+            svc.submit(_items(100 + i), lane="bulk")
+        with svc._cv:
+            assert svc._collect_locked() is not None
+        # force a grow and apply it the way the dispatcher does
+        for _ in range(2):
+            ctl.step({"batches": 1, "pressure": 0, "lane_depth": 64,
+                      "scp_hol_age": 0,
+                      "lanes": {"bulk": {"queued_submissions": 40,
+                                         "queued_items": 40,
+                                         "shed_burn": 2.0,
+                                         "latency_burn": 0.0},
+                                "scp": {"queued_submissions": 0,
+                                        "queued_items": 0,
+                                        "shed_burn": 0.0,
+                                        "latency_burn": 0.0}},
+                      "queue_wait_frac": 1.0})
+        # hysteresis 1 + cooldown 0: both steps grew (2 -> 4 -> 8)
+        with svc._cv:
+            svc._apply_control_locked(ctl.knobs())
+            assert svc._max_batch == 8
+            batch = svc._collect_locked()
+        # first collect took 2 items at the old knob; the grown knob
+        # lets the next collect coalesce the remaining 6 in one batch
+        assert batch is not None and len(batch[1]) == 6
+    finally:
+        cmod.configure_control(every=8)
+
+
+def test_live_service_steps_controller_on_batch_cadence():
+    cmod.configure_control(every=2)
+    try:
+        ctl = VerifyController(4, 1, 0.75, min_batch=2,
+                               batch_ceiling=64)
+        svc = vs.VerifyService(verifier=_Instant(), lane_depth=64,
+                               max_batch=4, pipeline_depth=1,
+                               controller=ctl).start()
+        tks = [svc.submit(_items(i), lane="bulk") for i in range(12)]
+        for t in tks:
+            t.result(timeout=20)
+        svc.stop(drain=True, timeout=20)
+        assert ctl.snapshot()["windows"] >= 1
+        assert svc.snapshot()["conservation_gap"] == 0
+        snap = svc.snapshot()["control"]
+        assert snap["enabled"] is True
+        cs = svc.control_snapshot()
+        assert cs["enabled"] and "controller" in cs
+        # retained windows carry both halves: deterministic backlog
+        # and the advisory burn/bubble feed
+        w = ctl.windows()[0]
+        assert "queue_wait_frac" in w
+        assert "shed_burn" in w["lanes"]["bulk"]
+    finally:
+        cmod.configure_control(every=8)
+
+
+def test_auto_attach_follows_control_enabled_knob():
+    saved = cmod.CONTROL_ENABLED
+    try:
+        cmod.configure_control(enabled=False)
+        assert vs.VerifyService(verifier=_Instant())._controller is None
+        cmod.configure_control(enabled=True)
+        svc = vs.VerifyService(verifier=_Instant(), max_batch=64)
+        assert isinstance(svc._controller, VerifyController)
+        assert svc._controller.knobs()["max_batch"] == 64
+    finally:
+        cmod.configure_control(enabled=saved)
+
+
+def test_control_route_and_health_surface():
+    from stellar_tpu.main.command_handler import CommandHandler
+    assert "control" in CommandHandler.ROUTES
+    out = CommandHandler.cmd_control(object(), {})
+    assert "enabled" in out
+
+
+def test_controller_thread_safety_smoke():
+    """Concurrent steppers + snapshot readers never tear the log
+    (every entry stays a complete 6-tuple)."""
+    ctl = _ctl(cooldown=0, hysteresis=1)
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            ctl.snapshot()
+            ctl.control_log(limit=4)
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for i in range(300):
+        ctl.step(_window(bulk_burn=float(i % 3), qw=0.9, backlog=60))
+    stop.set()
+    for t in threads:
+        t.join()
+    assert all(len(e) == 6 for e in ctl.control_log())
+
+
+# ---------------- shed highwater integration ----------------
+
+
+def test_shed_highwater_is_per_instance_and_moves_pressure():
+    svc = vs.VerifyService(verifier=_Instant(), lane_depth=10,
+                           shed_highwater_frac=0.2)
+    svc._running = True
+    for i in range(3):
+        svc.submit(_items(200 + i), lane="bulk")
+    with svc._cv:
+        level, why = svc._pressure_locked()
+    assert (level, why) == (1, "backlog")     # 3 >= 10 * 0.2
+    with svc._cv:
+        svc._apply_control_locked({"max_batch": 8,
+                                   "pipeline_depth": 1,
+                                   "shed_highwater_frac": 0.875})
+        level, _why = svc._pressure_locked()
+    assert level == 0                          # 3 < 10 * 0.875
+
+
+def test_peer_tenant_mapping():
+    """ISSUE 15 follow-on satellite: peer identities become tenant
+    tags only behind VERIFY_TENANT_FROM_PEER (default off)."""
+    from stellar_tpu.crypto import tenant as tn
+    assert tn.TENANT_FROM_PEER is False
+    nid = bytes(range(32))
+    assert tn.peer_tenant(nid) is None          # off: un-tenanted
+    try:
+        tn.configure_tenants(from_peer=True)
+        tag = tn.peer_tenant(nid)
+        assert tag == "peer-00010203"
+        assert tn.validate_tenant(tag) == tag   # rides quotas as-is
+        assert tn.peer_tenant(b"") is None
+        assert tn.peer_tenant(None) is None
+        assert tn.peer_tenant(b"ab") is None    # too short to tag
+    finally:
+        tn.configure_tenants(from_peer=False)
+
+
+def test_service_verified_forwards_tenant():
+    """The shared adopter block forwards the tenant tag into
+    submit() so per-tenant accounting sees real peers."""
+    seen = {}
+
+    class _Svc:
+        def verify(self, items, lane=None, timeout=None, tenant=None):
+            seen["tenant"] = tenant
+            seen["lane"] = lane
+            return np.ones(len(items), dtype=bool)
+
+        _cv = threading.Condition()
+        _running = True
+        _stop = False
+
+    saved = vs._service
+    try:
+        vs._service = _Svc()
+        out = vs.service_verified(_items(7), lane="auth",
+                                  tenant="peer-00010203")
+        assert out == [True]
+        assert seen == {"tenant": "peer-00010203", "lane": "auth"}
+    finally:
+        vs._service = saved

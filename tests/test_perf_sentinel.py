@@ -44,7 +44,9 @@ def _record(**over):
             "scp": {"p50_ms": 5.0, "p99_ms": 20.0},
             "auth": {"p99_ms": 30.0},
             "bulk": {"p99_ms": 200.0}},
-            "conservation_gap": 0},
+            "conservation_gap": 0,
+            "slo": {"scp": {"latency_burn_rate": 0.2}},
+            "control": {"decisions": 6}},
         "pipeline": {"busy_frac": 0.8, "overlap_frac": 0.2,
                      "reconciliation": 0.99},
     }
@@ -253,6 +255,49 @@ def test_pipeline_reconciliation_floor():
         _record(), _record(**{"pipeline.reconciliation": 0.8}))
     assert any(f["path"] == "pipeline.reconciliation"
                for f in out["findings"])
+
+
+def test_scp_burn_ceiling_is_absolute():
+    """ISSUE 15: the scp latency burn rate in a committed record is a
+    HEAD-only max ceiling at 1.0 — a window that burned the consensus
+    lane's budget fails regardless of the base record (the controller
+    failed the one objective it exists to keep)."""
+    over = sentinel.apply_rules(
+        _record(),
+        _record(**{"service.slo.scp.latency_burn_rate": 1.4}))
+    assert any(f["path"] == "service.slo.scp.latency_burn_rate"
+               and f["rule"] == "max_abs" for f in over["findings"])
+    # ... even when the BASE carried the same burn (no
+    # baseline-poisoning escape hatch)
+    both = sentinel.apply_rules(
+        _record(**{"service.slo.scp.latency_burn_rate": 1.4}),
+        _record(**{"service.slo.scp.latency_burn_rate": 1.4}))
+    assert not both["ok"]
+    # burning at exactly budget (1.0) passes; old records without the
+    # field skip, not fail
+    ok = sentinel.apply_rules(
+        _record(),
+        _record(**{"service.slo.scp.latency_burn_rate": 1.0}))
+    assert ok["ok"], ok["findings"]
+    head = _record()
+    del head["service"]["slo"]
+    out = sentinel.apply_rules(_record(), head)
+    assert out["ok"], out["findings"]
+    assert any(s.get("path") == "service.slo.scp.latency_burn_rate"
+               and s.get("reason") == "missing" for s in out["skipped"])
+
+
+def test_control_decisions_change_is_note_not_fatal():
+    """ISSUE 15: closed-loop decision counts legitimately vary with
+    the window's load shape — flagged for review, never fatal."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"service.control.decisions": 40}))
+    assert out["ok"], out["findings"]
+    assert any(n["path"] == "service.control.decisions"
+               for n in out["notes"])
+    steady = sentinel.apply_rules(_record(), _record())
+    assert not any(n["path"] == "service.control.decisions"
+                   for n in steady["notes"])
 
 
 def test_unproven_analysis_fails():

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Closed-loop control self-check (ISSUE 15) — the tier-1
+``CONTROL_OK`` gate.
+
+A ramped synthetic soak against the resident verify service
+(host-only: paced stub verifier, no device, no jax import — seconds
+of wall time) where the offered bulk load DOUBLES at the midpoint
+(the shared ``tools/soak.py ramp_schedule`` shape), proving the
+zero-human-knob-turns story end-to-end:
+
+* **the controller keeps the consensus lane inside objective**: under
+  the load doubling, the scp lane's latency burn rate finishes <= 1.0
+  and NO scp item is ever shed or rejected — with nobody touching
+  ``VERIFY_SERVICE_MAX_BATCH``;
+* **the controller demonstrably acted**: at least one clamped,
+  hysteresis-guarded knob move (``grow``/``shrink``/``relax``) in the
+  control log, and the clamp bounds were never exceeded at any point
+  of the trajectory;
+* **replica determinism / replay**: two fresh controller replicas fed
+  the identical window sequence emit BIT-IDENTICAL ``control_log()``
+  sequences, and both reproduce the live controller's own log exactly
+  (the replay procedure ``docs/robustness.md`` documents);
+* **conservation through the shift**: submitted == verified +
+  rejected + shed exactly, zero failures, zero pending after drain —
+  the load doubling loses nothing;
+* **nondet discipline**: ``stellar_tpu/crypto/controller.py`` sits in
+  the nondeterminism-lint scope with NO allowlist entry and the lint
+  is clean — the knob trajectory is a pure function of its inputs.
+
+Prints one JSON record; exit 0 = every gate passed.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import numpy as np  # noqa: E402
+
+from soak import ramp_schedule  # noqa: E402
+from stellar_tpu.crypto import controller as ctl_mod  # noqa: E402
+from stellar_tpu.crypto import verify_service as vs  # noqa: E402
+
+# paced stub device: a fixed per-dispatch floor plus a small per-item
+# cost — bigger batches amortize the floor, which is exactly the lever
+# the controller's grow action pulls (the real engine's dispatch-floor
+# shape from the ISSUE 12 measurements, scaled down to milliseconds)
+DISPATCH_FLOOR_S = 0.008
+PER_ITEM_S = 0.0001
+
+ROUNDS = 8
+ROUND_S = 0.35
+BASE_SUBS = 60                  # bulk submissions/round before the x2
+ITEMS_PER_SUB = 4
+SCP_SUBS_PER_ROUND = 4
+LANE_DEPTH = 120
+BASE_MAX_BATCH = 8
+SCP_P99_MS = 500.0
+
+
+class PacedVerifier:
+    """Stub verifier whose resolve time is floor + per-item — the
+    throughput ceiling the ramp must push the service through."""
+
+    def submit(self, items, trace_ids=None):
+        n = len(items)
+
+        def resolver():
+            time.sleep(DISPATCH_FLOOR_S + PER_ITEM_S * n)
+            return np.ones(n, dtype=bool)
+        return resolver
+
+
+def _items(i: int, n: int):
+    pk = bytes([(i * 17 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"ctl-%d-%d" % (i, k), bytes([(i + k) % 251]) * 16)
+            for k in range(n)]
+
+
+def ramp_phase(problems: list) -> dict:
+    """The ramped live soak: offered load x2 at the midpoint, the
+    controller alone turns the knobs."""
+    vs.slo_monitor._reset_for_testing()
+    vs.configure_slo(scp_p99_ms=SCP_P99_MS, window=1024)
+    ctl = ctl_mod.VerifyController(
+        BASE_MAX_BATCH, 2, 0.75, min_batch=4, batch_ceiling=128,
+        max_pipeline_depth=4, hysteresis=2, cooldown=2)
+    svc = vs.VerifyService(
+        verifier=PacedVerifier(), lane_depth=LANE_DEPTH,
+        lane_bytes=10 ** 9, max_batch=BASE_MAX_BATCH,
+        pipeline_depth=2, aging_every=4, controller=ctl,
+        control_every=2).start()
+
+    sched = ramp_schedule(ROUNDS, BASE_SUBS)
+    tickets = []
+    rejected = {"bulk": 0, "scp": 0}
+    lock = threading.Lock()
+
+    def flood(lane, count, n_items, pace_s, offset):
+        for i in range(count):
+            try:
+                tkt = svc.submit(_items(offset + i, n_items),
+                                 lane=lane)
+                with lock:
+                    tickets.append((lane, tkt))
+            except vs.Overloaded:
+                with lock:
+                    rejected[lane] += 1
+            time.sleep(pace_s)
+
+    t0 = time.monotonic()
+    for rnd, subs in enumerate(sched):
+        # pacing shrinks as the schedule doubles: same wall per round,
+        # twice the offered submissions after the midpoint
+        pace = ROUND_S / subs
+        bulk = threading.Thread(
+            target=flood,
+            args=("bulk", subs, ITEMS_PER_SUB, pace, rnd * 10_000))
+        scp = threading.Thread(
+            target=flood,
+            args=("scp", SCP_SUBS_PER_ROUND, 1,
+                  ROUND_S / SCP_SUBS_PER_ROUND, 50_000 + rnd * 100))
+        bulk.start()
+        scp.start()
+        bulk.join()
+        scp.join()
+
+    shed = {"bulk": 0, "scp": 0}
+    verified = {"bulk": 0, "scp": 0}
+    for lane, tkt in tickets:
+        try:
+            tkt.result(timeout=60)
+            verified[lane] += 1
+        except vs.Overloaded as e:
+            if e.kind != "shed":
+                problems.append(f"ticket died {e.kind}, want shed")
+            shed[lane] += 1
+    svc.stop(drain=True, timeout=60)
+    wall_s = round(time.monotonic() - t0, 2)
+
+    # ---- gates ----
+    snap = svc.snapshot()
+    if snap["conservation_gap"] != 0 or snap["pending_items"] != 0:
+        problems.append(
+            f"conservation violated through the ramp: "
+            f"gap={snap['conservation_gap']} "
+            f"pending={snap['pending_items']}")
+    if snap["totals"]["failed"]:
+        problems.append(f"failed items: {snap['totals']['failed']}")
+    if shed["scp"] or rejected["scp"] or snap["lanes"]["scp"]["shed"] \
+            or snap["lanes"]["scp"]["rejected"]:
+        problems.append("scp work was shed/rejected under the ramp — "
+                        "the consensus lane's contract broke")
+    slo = vs.slo_health()
+    scp_burn = slo["lanes"]["scp"]["latency"]["burn_rate"]
+    if scp_burn > 1.0:
+        problems.append(
+            f"scp latency burn rate {scp_burn} > 1.0 under the ramp "
+            "— the controller failed the objective it exists to keep")
+    log = ctl.control_log()
+    moved = [e for e in log if e[0] in ("grow", "shrink", "relax")]
+    if not moved:
+        problems.append(
+            "controller never moved a knob under a doubled load — "
+            "closed-loop control proved nothing")
+    csnap = ctl.snapshot()
+    clamps = csnap["clamps"]
+    for e in log:
+        _a, _seq, mb, pd, hw_milli, _r = e
+        if not clamps["min_batch"] <= mb <= clamps["batch_ceiling"]:
+            problems.append(f"max_batch {mb} escaped its clamp: {e}")
+        if not 1 <= pd <= clamps["max_pipeline_depth"]:
+            problems.append(f"pipeline_depth {pd} escaped its clamp: "
+                            f"{e}")
+        if not 250 <= hw_milli <= 875:
+            problems.append(f"shed highwater {hw_milli} escaped its "
+                            f"clamp: {e}")
+    lanes = vs.lane_latencies()
+    return {
+        "wall_s": wall_s,
+        "schedule": sched,
+        "scp_latency_burn": scp_burn,
+        "scp_p99_ms": lanes["scp"]["p99_ms"],
+        "bulk_p99_ms": lanes["bulk"]["p99_ms"],
+        "windows": csnap["windows"],
+        "moves": csnap["moves"],
+        "knobs": csnap["knobs"],
+        "actions": sorted({e[0] for e in moved}),
+        "log_tail": log[-8:],
+        "bulk": {"verified": verified["bulk"], "shed": shed["bulk"],
+                 "rejected": rejected["bulk"]},
+        "totals": snap["totals"],
+        "controller": ctl,         # consumed by replica_phase
+    }
+
+
+def replica_phase(problems: list, live: dict) -> dict:
+    """Bit-identical replicas + replay fidelity: the live controller's
+    retained window sequence, replayed through two fresh controllers,
+    must reproduce the live ``control_log()`` exactly."""
+    ctl = live.pop("controller")
+    windows = ctl.windows()
+    log = ctl.control_log()
+    if len(windows) != len(log):
+        problems.append(
+            f"retained windows ({len(windows)}) != log entries "
+            f"({len(log)}) — the replay surface is incomplete")
+    a = ctl.replay(windows)
+    b = ctl.replay(windows)
+    if a != b:
+        diff = next((i for i, (x, y) in enumerate(zip(a, b))
+                     if x != y), min(len(a), len(b)))
+        problems.append(
+            f"replica control logs diverge at #{diff}: "
+            f"{a[diff:diff + 2]} vs {b[diff:diff + 2]}")
+    if a != log:
+        diff = next((i for i, (x, y) in enumerate(zip(a, log))
+                     if x != y), min(len(a), len(log)))
+        problems.append(
+            f"replay diverged from the live trajectory at #{diff}: "
+            f"{a[diff:diff + 2]} vs {log[diff:diff + 2]}")
+    return {"windows": len(windows), "decisions": len(log),
+            "bit_identical": a == b == log}
+
+
+def nondet_phase(problems: list) -> dict:
+    """The controller joins the nondet-lint scope with NO allowlist
+    entry, and the lint is clean over the scoped tree."""
+    from stellar_tpu.analysis import nondet
+    mod = "stellar_tpu/crypto/controller.py"
+    if mod not in set(nondet.HOST_ORACLE_FILES):
+        problems.append(f"{mod} missing from the nondet lint scope")
+    if mod in nondet.ALLOWLIST._entries:
+        problems.append(
+            f"{mod} grew a nondet allowlist entry — the controller "
+            "must stay clock/RNG-free, not excused")
+    rep = nondet.run()
+    if not rep.ok:
+        problems.append(
+            f"nondet lint not clean: {[f.key for f in rep.findings][:4]}")
+    return {"scoped": mod in set(nondet.HOST_ORACLE_FILES),
+            "allowlisted": mod in nondet.ALLOWLIST._entries,
+            "lint_ok": rep.ok}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.parse_args()
+    problems: list = []
+    live = ramp_phase(problems)
+    rec = {"replicas": replica_phase(problems, live),
+           "ramp": live,
+           "nondet": nondet_phase(problems)}
+    rec["ok"] = not problems
+    rec["problems"] = problems
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

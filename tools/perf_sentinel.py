@@ -136,6 +136,19 @@ RULES = [
      "bulk lane p99 wait grew >5x (the sheddable lane drifts widest)"),
     ("service.conservation_gap", "note_change", None,
      "service conservation gap changed (must stay 0)"),
+    # closed-loop control (ISSUE 15): the scp latency burn captured in
+    # a committed record is a HEAD-only ceiling — past 1.0 means the
+    # consensus lane's error budget was burning faster than the
+    # objective allows in the measured window, which is exactly the
+    # regression the controller exists to prevent; the decision count
+    # is note-only (closed-loop activity legitimately varies with the
+    # window's load shape — flagged for review, never fatal).
+    ("service.slo.scp.latency_burn_rate", "max_abs", 1.0,
+     "scp latency burn rate past 1.0 in the measured window (the "
+     "controller failed the objective it exists to keep)"),
+    ("service.control.decisions", "note_change", None,
+     "closed-loop controller decision count changed (expected to "
+     "vary with load; review the control log if surprising)"),
     # pipeline-bubble profiler (ISSUE 10): the async-dispatch PR's
     # before/after numbers. busy_frac down = more device idle per
     # resolve; overlap_frac down = host prep stopped hiding behind

@@ -72,6 +72,19 @@ def _env_setup(real_device: bool) -> None:
         "/tmp/stellar_tpu_devchaos_jaxcache"))
 
 
+def ramp_schedule(rounds: int, base_count: int) -> list:
+    """Offered-load schedule for ``--ramp``: ``base_count``
+    submissions per round for the first half, DOUBLE from the midpoint
+    on — the mid-run load shift the closed-loop controller (ISSUE 15)
+    must absorb without human knob turns. Shared with
+    ``tools/control_selfcheck.py`` (the tier-1 ``CONTROL_OK`` gate
+    drives the same shape host-only), so the gate and the chaos-mesh
+    soak exercise one schedule."""
+    mid = max(1, rounds // 2)
+    return [base_count * (2 if r >= mid else 1)
+            for r in range(max(1, rounds))]
+
+
 def _signed_pool():
     """Small pool of valid signatures + structured invalid rows, with
     oracle expectations computed once per entry (pure-Python signing
@@ -248,10 +261,11 @@ def run_sha256(smoke: bool, duration_s: float,
 
 def run(smoke: bool, duration_s: float, corrupt: bool,
         events_path: str, tenants: int = 0,
-        flooder: bool = False) -> dict:
+        flooder: bool = False, ramp: bool = False) -> dict:
     import numpy as np
 
     from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import controller as ctl_mod
     from stellar_tpu.crypto import tenant as tn
     from stellar_tpu.crypto import verify_service as vs
     from stellar_tpu.utils import faults
@@ -312,9 +326,19 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         tn.clear_tenant_policies()
         tn.configure_tenants(depth=6, nbytes=0, window=1024)
         tn.set_tenant_policy("flooder", depth=12)
+    # --ramp: attach the closed-loop controller (ISSUE 15) so the
+    # mid-run load doubling is absorbed by knob moves, not operators —
+    # clamps sized to the chaos-mesh shapes (the verifier chunks any
+    # grown batch back into its compiled buckets)
+    ctl = None
+    if ramp:
+        ctl = ctl_mod.VerifyController(
+            BUCKET, 2, 0.75, min_batch=2, batch_ceiling=4 * BUCKET,
+            max_pipeline_depth=4, hysteresis=2, cooldown=2)
     svc = vs.VerifyService(
         verifier=v, lane_depth=24, lane_bytes=2_000_000,
-        max_batch=BUCKET, pipeline_depth=2, aging_every=4).start()
+        max_batch=BUCKET, pipeline_depth=2, aging_every=4,
+        controller=ctl, control_every=4).start()
 
     # the flapping chip: every 2nd dispatch attributed to device 0
     # raises — quarantine, re-shard over survivors, half-open regrow,
@@ -365,13 +389,22 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
                         flooder_stats["quota_rejected"] += 1
 
     flood_rounds = 1 if smoke else max(1, int(duration_s / 3.0))
+    if ramp:
+        # a midpoint needs at least two rounds; the second half
+        # offers DOUBLE the load (the conservation law must stay
+        # exact through the shift — every extra submission is still
+        # verified, rejected or shed, never lost)
+        flood_rounds = max(2, flood_rounds)
+    sched = ramp_schedule(flood_rounds, 150)
     breaker_tripped = False
     t_run = time.monotonic()
     for rnd in range(flood_rounds):
         # burst well past the bulk lane's depth budget: ingress
         # rejects AND backlog shed are both certain
         bulk = threading.Thread(
-            target=flood, args=("bulk", 150, 4, 0.002, rnd * 1000))
+            target=flood,
+            args=("bulk", sched[rnd] if ramp else 150, 4, 0.002,
+                  rnd * 1000))
         scp = threading.Thread(
             target=flood, args=("scp", 25, 2, 0.02, rnd * 1000))
         threads = [bulk, scp]
@@ -463,6 +496,29 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         problems.append("service metrics missing from the Prometheus "
                         "exposition")
 
+    # ---- ramp scenario record + gates (--ramp) ----
+    ramp_rec = None
+    if ramp:
+        csnap = ctl.snapshot()
+        ramp_rec = {
+            "schedule": sched,
+            "windows": csnap["windows"],
+            "moves": csnap["moves"],
+            "knobs": csnap["knobs"],
+            "log_tail": ctl.control_log(limit=16),
+        }
+        if csnap["windows"] == 0:
+            problems.append(
+                "ramp ran but the controller never evaluated a "
+                "window — the batch-cadence hook is dead")
+        log = ctl.control_log()
+        if log and log[0][1] == 1 and \
+                ctl.replay(ctl.windows()) != log:
+            # replay is exact while the retained history is complete
+            # (first entry still seq 1 — no deque eviction yet)
+            problems.append(
+                "controller replay diverged from the live trajectory")
+
     # ---- tenant scenario gates (--tenants N [--flooder]) ----
     tenant_rec = None
     if tenants > 0:
@@ -521,6 +577,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             "dump_reasons"],
         "events_path": events_path,
         "tenant": tenant_rec,
+        "ramp": ramp_rec,
         "problems": problems,
     }
 
@@ -569,6 +626,22 @@ def emit_bench_service(rec: dict, path: str) -> None:
             "shed_submissions": rec["shed_submissions"],
         },
     }
+    if rec.get("ramp"):
+        # ISSUE 15 sentinel rows — CONTROLLER windows only: the scp
+        # latency burn ceiling (max_abs 1.0) gates the closed-loop
+        # story, and the legacy soak deliberately trips the global
+        # breaker mid-run with no controller attached, so its scp
+        # waits can burn the 5 s SLO bound inside a legitimately
+        # green window (its own gate is the looser
+        # SMOKE_SCP_P99_BOUND_MS). Rows absent from non-ramp captures
+        # skip in the sentinel instead of flaking tier-1.
+        from stellar_tpu.crypto import verify_service as vs
+        slo = vs.slo_health()
+        cap["service"]["slo"] = {
+            "scp": {"latency_burn_rate":
+                    slo["lanes"]["scp"]["latency"]["burn_rate"]}}
+        cap["service"]["control"] = {
+            "decisions": rec["ramp"].get("moves", 0)}
     with open(path, "w") as f:
         json.dump(cap, f, indent=1, sort_keys=True)
 
@@ -596,6 +669,13 @@ def main() -> int:
                          "must absorb its burst — typed rejections/"
                          "sheds, zero failures, per-tenant "
                          "conservation exact")
+    ap.add_argument("--ramp", action="store_true",
+                    help="double the offered bulk load at the midpoint"
+                         " and attach the closed-loop controller "
+                         "(ISSUE 15) — the load shift must be "
+                         "absorbed by knob moves with the "
+                         "conservation law still exact; verify "
+                         "workload only")
     ap.add_argument("--workload", default="verify",
                     choices=("verify", "sha256"),
                     help="which engine plugin to soak: the verify "
@@ -629,7 +709,8 @@ def main() -> int:
         rec = run_sha256(args.smoke, args.duration, events)
     else:
         rec = run(args.smoke, args.duration, args.corrupt, events,
-                  tenants=args.tenants, flooder=args.flooder)
+                  tenants=args.tenants, flooder=args.flooder,
+                  ramp=args.ramp)
     if args.emit_bench_service and args.workload == "verify" \
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)

@@ -39,6 +39,7 @@ sys.path.insert(0, REPO)
 # the cap — is counted in the footer, never silently absent
 REPORT_SERIES_PREFIXES = (
     "crypto.verify.service.slo.",
+    "crypto.verify.control.",
     "crypto.pipeline.",
     "crypto.transfer.",
     "crypto.verify.service.lane.",
@@ -68,6 +69,7 @@ def collect_local(top_traces: int = TOP_TRACES) -> dict:
         "slo": vs.slo_health(),
         "service": vs.service_health(),
         "tenant": vs.tenant_health(),
+        "control": vs.control_health(),
         "pipeline": pipeline_timeline.snapshot(limit=4),
         "timeseries": timeseries.snapshot(),
         "transfer": transfer_ledger.totals(),
@@ -93,6 +95,7 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
         "slo": get("slo"),
         "service": get("service"),
         "tenant": get("tenant"),
+        "control": get("control"),
         "pipeline": get("pipeline?limit=4"),
         "timeseries": get("timeseries"),
         "transfer": dispatch.get("transfer", {}),
@@ -200,6 +203,39 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
         lines += ["",
                   "Per-tenant conservation violations: "
                   f"**{len(viol)}** (must be 0)", ""]
+
+    # ---- closed-loop control ----
+    ctl = data.get("control") or {}
+    if ctl.get("enabled"):
+        c = ctl.get("controller") or {}
+        knobs = c.get("knobs") or {}
+        base = c.get("base") or {}
+        lines += ["## Control decisions", "",
+                  f"{c.get('windows', 0)} windows evaluated, "
+                  f"**{c.get('moves', 0)}** knob moves "
+                  f"(hysteresis {c.get('hysteresis')}, cool-down "
+                  f"{c.get('cooldown')}); current max_batch "
+                  f"{knobs.get('max_batch')} (base "
+                  f"{base.get('max_batch')}), pipeline_depth "
+                  f"{knobs.get('pipeline_depth')} (base "
+                  f"{base.get('pipeline_depth')}), shed highwater "
+                  f"{_fmt(knobs.get('shed_highwater_frac'), 3)} "
+                  f"(base "
+                  f"{_fmt(base.get('shed_highwater_frac'), 3)}).",
+                  ""]
+        tail = ctl.get("log_tail") or []
+        rows = [e for e in tail if e[0] != "hold"]
+        if rows:
+            lines += ["| # | action | max_batch | pipeline_depth "
+                      "| highwater | reason |",
+                      "|---|---|---|---|---|---|"]
+            for action, seq, mb, pd, hw_milli, reason in rows:
+                lines.append(f"| {seq} | **{action}** | {mb} | {pd} "
+                             f"| {hw_milli / 1000:.3f} | {reason} |")
+        else:
+            lines.append("No knob moves in the retained tail "
+                         f"({len(tail)} hold windows).")
+        lines.append("")
 
     # ---- pipeline bubbles ----
     pipe = data.get("pipeline") or {}
@@ -345,8 +381,13 @@ def synthetic_window() -> None:
             n = len(items)
             return lambda: np.ones(n, dtype=bool)
 
+    # a controller rides the demo window so the default report also
+    # renders the "Control decisions" section (ISSUE 15)
+    from stellar_tpu.crypto import controller as ctl_mod
+    ctl = ctl_mod.VerifyController(64, 4, 0.75)
     svc = vs.VerifyService(verifier=_Instant(), lane_depth=64,
-                           lane_bytes=10 ** 7, max_batch=64).start()
+                           lane_bytes=10 ** 7, max_batch=64,
+                           controller=ctl).start()
     tickets = []
     for i in range(12):
         pk = bytes([(i * 17 + j) % 251 + 1 for j in range(32)])

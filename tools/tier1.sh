@@ -94,6 +94,21 @@ timeout -k 10 240 python tools/tenant_selfcheck.py
 tqrc=$?
 echo TENANT_QOS_OK=$([ "$tqrc" -eq 0 ] && echo 1 || echo 0)
 [ "$tqrc" -ne 0 ] && exit $tqrc
+# Closed-loop control gate (ISSUE 15): a ramped synthetic soak
+# (offered bulk load x2 at the midpoint — the shared soak.py
+# ramp_schedule shape) against the resident service with the
+# deterministic feedback controller attached — host-only (paced stub
+# verifier, no jax), seconds of wall time. Gates: scp latency burn
+# rate stays <= 1.0 with ZERO human knob turns, the controller
+# demonstrably moved at least one knob inside its clamps, two
+# replicas over the identical window sequence emit bit-identical
+# control_log() sequences (and reproduce the live trajectory — the
+# replay procedure), conservation holds exactly through the load
+# shift, and controller.py carries no nondet allowlist entry.
+timeout -k 10 240 python tools/control_selfcheck.py
+ctrc=$?
+echo CONTROL_OK=$([ "$ctrc" -eq 0 ] && echo 1 || echo 0)
+[ "$ctrc" -ne 0 ] && exit $ctrc
 # Verify-service soak smoke (ISSUE 6): a short CPU-only overload run
 # of the resident verify service (forced 4-device subprocess,
 # flaky-device:0 injected, audit sampling on, mid-run breaker trip)
