@@ -65,6 +65,10 @@ SCOPE = [
     # from every dispatching thread (trickle leaders, service
     # dispatcher, chaos tests) through the engine's placement path
     "stellar_tpu/parallel/residency.py",
+    # the per-pubkey signer-table cache (ISSUE 16): its LRU mutates
+    # from every submitting thread at partition time and from the
+    # engine's audit-conviction eviction hook
+    "stellar_tpu/parallel/signer_tables.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
     "stellar_tpu/utils/tracing.py",

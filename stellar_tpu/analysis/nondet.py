@@ -85,6 +85,12 @@ HOST_ORACLE_FILES = [
     # eviction clock/RNG-free, or replicas could pin different buffers
     # (a latency divergence only — but the discipline is free to keep)
     "stellar_tpu/parallel/residency.py",
+    # the per-pubkey signer-table cache (ISSUE 16) decides which rows
+    # dispatch HOT vs cold: keys must be content-derived and eviction
+    # clock/RNG-free — verdicts are path-independent (pinned by the
+    # differential suite), but the hot/cold split must still replay
+    # identically or replicas' ledgers and audits drift apart
+    "stellar_tpu/parallel/signer_tables.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",

@@ -66,8 +66,10 @@ GOLDEN_PATH = "docs/limb_bounds.json"
 STAGE_OUTPUT_NAMES = {
     "decompress": ("ok", "x", "y", "z", "t"),
     "dsm": ("x", "y", "z"),
+    "dsm_hot": ("x", "y", "z"),
     "compress_compare": ("ok",),
     "kernel_total": ("ok",),
+    "kernel_hot_total": ("ok",),
 }
 
 # Limb-shaped stage outputs that must honor the loose contract
@@ -75,8 +77,10 @@ STAGE_OUTPUT_NAMES = {
 LOOSE_OUTPUTS = {
     "decompress": ("x", "y", "z", "t"),
     "dsm": ("x", "y", "z"),
+    "dsm_hot": ("x", "y", "z"),
     "compress_compare": (),
     "kernel_total": (),
+    "kernel_hot_total": (),
 }
 
 
@@ -92,6 +96,18 @@ def loose_point_avals(batch: int):
     return (limb, limb, limb, limb)
 
 
+def hot_table_aval(batch: int):
+    """Aval of the cached per-signer affine table operand: batch-leading
+    (batch, 128 entries, 3 coords, 20 limbs) int16 — host-canonical
+    limbs, so every element is in [0, MASK]."""
+    import jax
+    from stellar_tpu.ops import edwards as ed
+    fe = _fe()
+    return jax.ShapeDtypeStruct(
+        (batch, ed.TABLE_ENTRIES256, ed.AFFINE_COORDS, fe.NLIMBS),
+        np.int16)
+
+
 def trace_stage_jaxprs(batch: int) -> Dict[str, object]:
     """Trace the three stages + composed kernel (the kernel_cost split)."""
     import jax
@@ -100,6 +116,7 @@ def trace_stage_jaxprs(batch: int) -> Dict[str, object]:
 
     bytes32 = jax.ShapeDtypeStruct((batch, 32), np.uint8)
     point = loose_point_avals(batch)
+    hot_table = hot_table_aval(batch)
 
     def dsm(s_bytes, h_bytes, x, y, z, t):
         return vk.dsm_stage(s_bytes, h_bytes, (x, y, z, t))
@@ -107,11 +124,15 @@ def trace_stage_jaxprs(batch: int) -> Dict[str, object]:
     return {
         "decompress": jax.make_jaxpr(ed.decompress)(bytes32),
         "dsm": jax.make_jaxpr(dsm)(bytes32, bytes32, *point),
+        "dsm_hot": jax.make_jaxpr(vk.dsm_stage_hot)(
+            bytes32, bytes32, hot_table),
         "compress_compare": jax.make_jaxpr(
             lambda x, y, z, t, r: ed.compress_equals((x, y, z, t), r))(
                 *point, bytes32),
         "kernel_total": jax.make_jaxpr(vk.verify_kernel)(
             bytes32, bytes32, bytes32, bytes32),
+        "kernel_hot_total": jax.make_jaxpr(vk.verify_kernel_hot)(
+            hot_table, bytes32, bytes32, bytes32),
     }
 
 
@@ -127,14 +148,25 @@ def _stage_invals(stage: str, batch: int) -> List[AbsVal]:
     def limb_val():
         return AbsVal.from_range(limb, 0, fe.LOOSE_MAX)
 
+    def table_val():
+        # Cached signer tables are host-built with CANONICAL limbs
+        # (parallel/signer_tables.py packs fe.from_int output), so the
+        # operand contract is [0, MASK], tighter than the loose limbs
+        # the in-kernel cold build feeds its selects.
+        return AbsVal.from_range(hot_table_aval(batch), 0, fe.MASK)
+
     if stage == "decompress":
         return [byte_val()]
     if stage == "dsm":
         return [byte_val(), byte_val()] + [limb_val() for _ in range(4)]
+    if stage == "dsm_hot":
+        return [byte_val(), byte_val(), table_val()]
     if stage == "compress_compare":
         return [limb_val() for _ in range(4)] + [byte_val()]
     if stage == "kernel_total":
         return [byte_val() for _ in range(4)]
+    if stage == "kernel_hot_total":
+        return [table_val()] + [byte_val() for _ in range(3)]
     raise ValueError(stage)
 
 

@@ -59,7 +59,7 @@ import numpy as np
 
 from stellar_tpu.crypto import ed25519_ref as ref
 from stellar_tpu.crypto import native_prep
-from stellar_tpu.parallel import batch_engine
+from stellar_tpu.parallel import batch_engine, signer_tables
 from stellar_tpu.parallel.batch_engine import (  # noqa: F401 (re-exports)
     DEFAULT_BUCKET_SIZES, RESOLVE_PHASES, RESOLVE_ROOT, BatchEngine,
     Workload, _auto_mesh, _breaker, _enter_host_only, _note_device_failure,
@@ -69,10 +69,11 @@ from stellar_tpu.parallel.batch_engine import (  # noqa: F401 (re-exports)
     register_service_health, served_counts, service_health_snapshot,
     start_device_probe, trace_ranges,
 )
-from stellar_tpu.utils import resilience
+from stellar_tpu.utils import resilience, tracing
 from stellar_tpu.utils.metrics import registry
 
-__all__ = ["BatchVerifier", "Ed25519Workload", "default_verifier",
+__all__ = ["BatchVerifier", "Ed25519Workload", "Ed25519HotWorkload",
+           "default_verifier",
            "device_available", "dispatch_health", "configure_dispatch",
            "dispatch_attribution", "dispatch_degraded",
            "note_shed_onset", "note_trace_event", "trace_ranges",
@@ -211,6 +212,55 @@ class Ed25519Workload(Workload):
         return gate & out
 
 
+class Ed25519HotWorkload(Ed25519Workload):
+    """The HOT-SIGNER variant of the verify workload (ISSUE 16): rows
+    whose pubkey already has a cached 128-entry affine A-table skip the
+    in-kernel decompression + table build and run the byte-aligned
+    radix-256 kernel (:func:`stellar_tpu.ops.verify.verify_kernel_hot`)
+    with the table as a plain operand — ~24% fewer executed dsm MACs
+    per lane than the cold radix-32 path (``tools/kernel_cost.py``
+    ``dsm.hot`` vs ``dsm.cold``; docs/kernel_design.md §5).
+
+    Items are ``((pk, msg, sig), table)`` pairs — the triple plus the
+    cache entry the partitioning :meth:`BatchVerifier.submit` looked
+    up for it. ``encode`` runs the UNCHANGED host policy gates over
+    the triples (canonical s/A, small-order, lengths — the gate ANDs
+    into the verdict exactly like the cold path), then replaces the
+    pubkey operand with the stacked per-row tables. ``host_result``
+    and the audit oracle see only the triples, so hot-served rows are
+    audited against the very same libsodium-exact oracle as cold ones.
+
+    ``variant_name`` keys this plugin's jit wrappers into the engine's
+    per-variant cache: the pinned primary bucket shapes never grow.
+    """
+
+    variant_name = "hot"
+
+    def encode(self, items: Sequence[tuple]
+               ) -> Tuple[np.ndarray, tuple]:
+        ok, (_a, r, s, h) = super().encode([it for it, _t in items])
+        tables = np.stack([t for _it, t in items])
+        return ok, (tables, r, s, h)
+
+    def pad_rows(self) -> tuple:
+        return (_PAD_TABLE, _PAD_R, _PAD_S, _PAD_H)
+
+    def kernel_fn(self):
+        from stellar_tpu.ops import verify as vk
+        return vk.verify_kernel_hot
+
+    def host_result(self, items: Sequence[tuple]) -> np.ndarray:
+        return _host_verify_items([it for it, _t in items])
+
+    def on_audit_conviction(self, items: Sequence[tuple]) -> None:
+        # a corrupt-device conviction over a hot-served part evicts
+        # every table that served it: a poisoned resident table must
+        # never outlive the audit that caught it (the next sight
+        # rebuilds from the pubkey bytes)
+        for (pk, _m, _s), _t in items:
+            signer_tables.signer_table_cache.evict(pk)
+
+
 class BatchVerifier(BatchEngine):
     """Batched libsodium-exact ed25519 verifier with a jit bucket cache
     — the :class:`Ed25519Workload` riding the generic engine.
@@ -230,6 +280,75 @@ class BatchVerifier(BatchEngine):
     def __init__(self, mesh=None, bucket_sizes=(128, 512, 2048)):
         super().__init__(Ed25519Workload(), mesh=mesh,
                          bucket_sizes=bucket_sizes)
+        self._hot = Ed25519HotWorkload()
+
+    def submit(self, items: Sequence[tuple], trace_ids=None,
+               variant=None) -> Callable[[], np.ndarray]:
+        """Partitioning submit (ISSUE 16): rows whose signer already
+        has a cached A-table ride the hot radix-256 kernel variant;
+        the rest ride the unchanged cold path — which populates the
+        cache, so a signer's FIRST sight is cold and every repeat is
+        hot. The partition is decided per row at encode time from the
+        cache alone (content-keyed, deterministic — two replicas fed
+        the same traffic split identically); verdicts are bit-identical
+        either way, so the split can never change a decision. With the
+        cache disabled (``VERIFY_SIGNER_TABLE_ENABLED=0`` /
+        ``configure_dispatch(signer_table_enabled=False)``) every row
+        rides cold and this is exactly the pre-16 engine submit."""
+        cache = signer_tables.signer_table_cache
+        if variant is not None or not cache.enabled or not len(items):
+            return super().submit(items, trace_ids=trace_ids,
+                                  variant=variant)
+        hot_idx, hot_items = [], []
+        cold_idx, cold_items = [], []
+        # the partition (cache traffic + first-sight table builds) is
+        # host PREP work: it rides the prep phase span so the blocking
+        # root's attribution stays >= 95% covered (METRICS_EXPORT_OK)
+        with tracing.span(f"{self._span_ns}.prep"):
+            for i, it in enumerate(items):
+                pk = it[0]
+                tab = cache.lookup(pk) if len(pk) == 32 else None
+                if tab is not None:
+                    hot_idx.append(i)
+                    hot_items.append((it, tab))
+                    continue
+                cold_idx.append(i)
+                cold_items.append(it)
+                if len(pk) == 32:
+                    # first sight: build + install NOW (one
+                    # incremental chain + one batched inversion,
+                    # ~1 ms) so the next occurrence — even later in
+                    # this very batch — hits; THIS row still rides
+                    # cold (its verdict needs the full decompress
+                    # gate the cold kernel carries)
+                    built = signer_tables.build_signer_table(pk)
+                    if built is not None:
+                        cache.install(pk, built)
+        if not hot_items:
+            return super().submit(items, trace_ids=trace_ids)
+        registry.meter(
+            "crypto.verify.signer_table.hot_rows").mark(len(hot_items))
+        registry.meter(
+            "crypto.verify.signer_table.cold_rows").mark(len(cold_items))
+        hot_tr = [trace_ids[i] for i in hot_idx] if trace_ids else None
+        cold_tr = [trace_ids[i] for i in cold_idx] if trace_ids \
+            else None
+        resolve_hot = super().submit(hot_items, trace_ids=hot_tr,
+                                     variant=self._hot)
+        resolve_cold = super().submit(cold_items, trace_ids=cold_tr) \
+            if cold_items else None
+        hot_ix = np.asarray(hot_idx, dtype=np.intp)
+        cold_ix = np.asarray(cold_idx, dtype=np.intp)
+        n = len(items)
+
+        def resolve() -> np.ndarray:
+            out = np.zeros(n, dtype=bool)
+            out[hot_ix] = resolve_hot()
+            if resolve_cold is not None:
+                out[cold_ix] = resolve_cold()
+            return out
+
+        return resolve
 
     def verify_batch(self, items: Sequence[tuple]) -> np.ndarray:
         """items: sequence of (pk: bytes, msg: bytes, sig: bytes).
@@ -387,6 +506,11 @@ _PAD_A = np.frombuffer(ref.point_compress(ref.BASE), np.uint8).copy()[None]
 _PAD_R = np.frombuffer(ref.point_compress(ref.IDENTITY), np.uint8).copy()[None]
 _PAD_S = np.zeros((1, 32), dtype=np.uint8)
 _PAD_H = np.zeros((1, 32), dtype=np.uint8)
+# Hot-path padding table: the base point's cached A-table (any valid
+# table works — padded lanes' zero scalars select the identity patch of
+# table_select_affine and the results are sliced off). Built once at
+# import by the same host builder that fills the signer cache.
+_PAD_TABLE = signer_tables.build_signer_table(_PAD_A.tobytes())[None]
 
 
 _default: Optional[BatchVerifier] = None

@@ -40,6 +40,7 @@ __all__ = [
     "point_compress",
     "point_add",
     "point_mul",
+    "affine_table_rows",
     "IDENTITY",
     "BASE",
     "SMALL_ORDER_ENCODINGS",
@@ -93,6 +94,40 @@ def point_mul(s: int, p1):
         p1 = point_double(p1)
         s >>= 1
     return q
+
+
+def affine_table_rows(p1, entries: int):
+    """Affine cached rows ``(y+x, y-x, 2*d*x*y) mod P`` for the
+    multiples ``v*p1``, v = 1..entries — the host half of every
+    precomputed window table (the device layout packs these into limb
+    vectors; see ``stellar_tpu.ops.edwards`` and
+    ``stellar_tpu.parallel.signer_tables``).
+
+    An incremental addition chain (entries-1 ``point_add``) keeps the
+    cost linear, and the projective Z column is normalized by ONE
+    Montgomery-batched inversion (prefix products + a single
+    ``pow(.., P-2, P)`` + back-substitution) instead of ``entries``
+    modexps — the same trick the device-side
+    ``build_point_table_affine`` plays with ``fe.batch_inv``."""
+    pts = []
+    q = p1
+    for _ in range(entries):
+        pts.append(q)
+        q = point_add(q, p1)
+    prefix = []
+    acc = 1
+    for pt in pts:
+        acc = acc * pt[2] % P
+        prefix.append(acc)
+    inv = _inv(acc)
+    rows = [None] * entries
+    for i in range(entries - 1, -1, -1):
+        zinv = inv * (prefix[i - 1] if i else 1) % P
+        inv = inv * pts[i][2] % P
+        x = pts[i][0] * zinv % P
+        y = pts[i][1] * zinv % P
+        rows[i] = ((y + x) % P, (y - x) % P, 2 * D * x * y % P)
+    return rows
 
 
 def point_equal(p1, p2) -> bool:

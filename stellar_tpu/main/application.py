@@ -224,7 +224,9 @@ class Application:
                 changed("VERIFY_DONATE_BUFFERS") or \
                 changed("VERIFY_RESIDENT_CACHE_BYTES") or \
                 changed("VERIFY_RESIDENT_MAX_ITEM_BYTES") or \
-                changed("VERIFY_RESIDENT_CONSTANTS"):
+                changed("VERIFY_RESIDENT_CONSTANTS") or \
+                changed("VERIFY_SIGNER_TABLE_BYTES") or \
+                changed("VERIFY_SIGNER_TABLE_ENABLED"):
             from stellar_tpu.crypto import batch_verifier
             batch_verifier.configure_dispatch(
                 deadline_ms=config.VERIFY_DEVICE_DEADLINE_MS,
@@ -241,7 +243,10 @@ class Application:
                 resident_cache_bytes=config.VERIFY_RESIDENT_CACHE_BYTES,
                 resident_max_item_bytes=(
                     config.VERIFY_RESIDENT_MAX_ITEM_BYTES),
-                resident_enabled=config.VERIFY_RESIDENT_CONSTANTS)
+                resident_enabled=config.VERIFY_RESIDENT_CONSTANTS,
+                signer_table_bytes=config.VERIFY_SIGNER_TABLE_BYTES,
+                signer_table_enabled=(
+                    config.VERIFY_SIGNER_TABLE_ENABLED))
         # resident verify service knobs (docs/robustness.md "Overload
         # and load-shed") — pushed BEFORE the service could start, so
         # the first admitted submission already runs under the

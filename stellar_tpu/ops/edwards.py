@@ -27,8 +27,9 @@ __all__ = [
     "identity", "point_add", "point_add_cached", "point_double",
     "to_cached", "decompress", "compress_equals",
     "negate", "select_point", "table_select", "table_select_affine",
-    "base_table", "base_table_affine", "build_point_table",
-    "build_point_table_affine", "D_LIMBS",
+    "base_table", "base_table_affine", "base_table_affine_wide",
+    "build_point_table", "build_point_table_affine",
+    "double_scalarmult_hot", "D_LIMBS",
     "D2_LIMBS", "SQRTM1_LIMBS", "unpack255",
 ]
 
@@ -50,6 +51,19 @@ TABLE_ENTRIES = 8  # one-hot contraction entries per window select
 WINDOWS32 = 52        # radix-32 digits per 256-bit scalar
 TABLE_ENTRIES32 = 16  # cmov-tree entries per window select
 AFFINE_COORDS = 3     # affine cached entry: (Y+X, Y-X, 2d*T); Z == 1
+
+# Signed radix-256 (PR 16, the HOT-SIGNER loop — docs/kernel_design.md
+# §5): 32 byte-aligned windows over 128-entry affine tables. The live
+# radix-32 loop cannot afford windows this wide — a 128-entry per-batch
+# table build would dwarf the doublings it saves — but a CACHED
+# per-pubkey table amortizes its (host-side) build across the signer's
+# lifetime, so the hot path pays only the loop: 248 doublings + 63 adds
+# instead of 255 + 103 + the in-kernel table build. Tables are stored
+# int16 (canonical 13-bit limbs fit with 3 bits to spare), halving the
+# dispatch operand bytes; the cmov tree runs in int16 and the selected
+# entry widens to int32 at the tree's root.
+WINDOWS256 = 32        # radix-256 digits per 256-bit scalar
+TABLE_ENTRIES256 = 128  # cmov-tree entries per hot window select
 
 # Curve constants as canonical limb vectors (host numpy, broadcast at trace).
 D_LIMBS = fe.from_int(ref.D)
@@ -418,10 +432,35 @@ def base_table_affine(batch_shape):
         + tuple(batch_shape))
 
 
+# The hot-signer loop's B-table: v*B for v = 1..128, affine cached,
+# int16 (canonical limbs are 13-bit). Built with the SAME host rows as
+# every other precomputed table (ref.affine_table_rows — an incremental
+# chain + one batched inversion, so the 128-entry build costs
+# milliseconds at import, not 128 full scalar-mults).
+_BASE_TABLE256 = np.array(
+    [[fe.from_int(c) for c in row]
+     for row in ref.affine_table_rows(ref.BASE, TABLE_ENTRIES256)],
+    dtype=np.int16)
+
+
+def base_table_affine_wide(batch_shape):
+    """(128, 3, 20, *batch) broadcast constant affine cached table of
+    v*B, v = 1..128, int16 (the hot-signer radix-256 loop's B-table —
+    same rows a cached signer table carries for -A)."""
+    t = jnp.asarray(_BASE_TABLE256).reshape(
+        (TABLE_ENTRIES256, AFFINE_COORDS, fe.NLIMBS)
+        + (1,) * len(batch_shape))
+    return jnp.broadcast_to(
+        t, (TABLE_ENTRIES256, AFFINE_COORDS, fe.NLIMBS)
+        + tuple(batch_shape))
+
+
 def table_select_affine(table, digit):
-    """table (16, 3, 20, *batch) affine cached multiples 1*P..16*P;
-    digit (*batch,) int32 SIGNED radix-32 window digit in [-16, 16] ->
-    affine cached triple |digit|*P conditionally negated.
+    """table (entries, 3, 20, *batch) affine cached multiples
+    1*P..entries*P; digit (*batch,) int32 SIGNED window digit with
+    |digit| <= entries -> affine cached triple |digit|*P conditionally
+    negated. ``entries`` must be a power of two — 16 for the radix-32
+    loop, 128 (int16 storage) for the hot-signer radix-256 loop.
 
     A log-depth conditional-move tree over the 16 entries — ref10
     ge25519_select's masked cmov, vectorized: 4 levels of ``where`` on
@@ -437,17 +476,20 @@ def table_select_affine(table, digit):
     e.g. (2, n) when the B- and A-table selects fuse."""
     nb = digit.ndim
     mag = jnp.abs(digit)
-    # cmov tree on (mag - 1) clamped to [0, 15]; mag == 0 lands on
-    # entry 1 and is overwritten by the identity patch below.
+    # cmov tree on (mag - 1) clamped to [0, entries-1]; mag == 0 lands
+    # on entry 1 and is overwritten by the identity patch below.
     m = jnp.maximum(mag - 1, 0)
     sel = table
-    for bit in (8, 4, 2, 1):
+    bit = table.shape[0]
+    while bit > 1:
+        bit //= 2
         top = (m >= bit)
         m = jnp.where(top, m - bit, m)
-        half = sel.shape[0] // 2
         sel = jnp.where(top[(None,) * (sel.ndim - nb)],
-                        sel[half:], sel[:half])
-    sel = sel[0]  # (3, 20, *batch)
+                        sel[bit:], sel[:bit])
+    # int16 wide tables widen to the int32 compute dtype here (a no-op
+    # for the int32 radix-32 table, so the cold jaxpr is unchanged)
+    sel = sel[0].astype(jnp.int32)  # (3, 20, *batch)
     is0 = (digit == 0)
     ident = jnp.asarray(np.stack(
         [fe.from_int(1), fe.from_int(1), fe.from_int(0)])).reshape(
@@ -579,3 +621,53 @@ def _double_scalarmult32(s_digits, h_digits, a_neg):
         return point_add_cached(acc, asel, need_t=False)
 
     return lax.fori_loop(1, WINDOWS32, body, acc)
+
+
+def double_scalarmult_hot(s_digits, h_digits, a_table):
+    """R' = s*B + h*(-A) for HOT signers: radix-256 Strauss-Shamir over
+    a device-RESIDENT 128-entry affine A-table (PR 16 — the per-pubkey
+    table cache, :mod:`stellar_tpu.parallel.signer_tables`; layout and
+    amortization math in docs/kernel_design.md §5).
+
+    s_digits/h_digits: (32, batch) signed radix-256 digits, most
+    significant first (:func:`stellar_tpu.ops.verify.signed_digits256_dev`;
+    digits in [-128, 128) with the top digit unsigned — <= 32 for every
+    gate-passed scalar < 2^253, so no canonical scalar overflows the
+    128-entry tables; s >= L rows compute well-defined garbage that the
+    host canonical-s gate has already vetoed). a_table: (128, 3, 20,
+    *batch) int16 affine cached multiples 1..128 of -A, canonical limbs
+    with Z == 1 exactly — built host-side ONCE per signer and replayed
+    from the signer-table cache, so unlike the radix-32 loop no table
+    build runs in-kernel at all.
+
+    248 shared doublings + 63 cached adds, every add fast-path affine:
+    per iteration seven 3-wide doubles under an inner fori, one 4-wide
+    double, one fused 128-entry cmov-tree select (int16 until the
+    tree's root) for the B+A pair, and two affine cached adds. The top
+    window seeds the accumulator from its B-entry + one A-add, exactly
+    like the radix-32 loop. Returns a PROJECTIVE (X, Y, Z) triple.
+    Cost ledger: ``dsm.hot`` rows in tools/kernel_cost.py."""
+    batch = s_digits.shape[1:]
+    tab_b = base_table_affine_wide(batch)
+    tab = jnp.stack([tab_b, a_table], axis=3)  # (128, 3, 20, 2, *batch)
+
+    def select_pair(j):
+        sd = lax.dynamic_index_in_dim(s_digits, j, 0, keepdims=False)
+        hd = lax.dynamic_index_in_dim(h_digits, j, 0, keepdims=False)
+        sel = table_select_affine(tab, jnp.stack([sd, hd]))
+        return (tuple(c[:, 0] for c in sel),
+                tuple(c[:, 1] for c in sel))
+
+    bsel0, asel0 = select_pair(jnp.int32(0))
+    acc = _extended_from_affine_cached(bsel0)
+    acc = point_add_cached(acc, asel0, need_t=False)
+
+    def body(j, acc):
+        acc = lax.fori_loop(
+            0, 7, lambda _, q: point_double(q, need_t=False), acc)
+        acc = point_double(acc)  # the adds below read T
+        bsel, asel = select_pair(j)
+        acc = point_add_cached(acc, bsel)
+        return point_add_cached(acc, asel, need_t=False)
+
+    return lax.fori_loop(1, WINDOWS256, body, acc)

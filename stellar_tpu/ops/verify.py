@@ -27,8 +27,9 @@ from jax import lax
 
 from stellar_tpu.ops import edwards as ed
 
-__all__ = ["verify_kernel", "verify_kernel_sharded", "signed_digits16_dev",
-           "signed_digits32_dev"]
+__all__ = ["verify_kernel", "verify_kernel_hot", "verify_kernel_sharded",
+           "signed_digits16_dev", "signed_digits32_dev",
+           "signed_digits256_dev"]
 
 
 def _signed_window_carry_chain(e, window_bits):
@@ -121,6 +122,27 @@ def signed_digits32_dev(b):
     return _signed_window_carry_chain(e.T, 5)
 
 
+def signed_digits256_dev(b):
+    """(batch, 32) uint8 little-endian scalars -> (32, batch) int32
+    SIGNED radix-256 digits, most significant first — the byte-aligned
+    recode for the hot-signer loop (PR 16; docs/kernel_design.md §5).
+
+    Eight-bit windows land exactly on byte boundaries, so the BYTES ARE
+    the unsigned window values and the recode is the shared
+    :func:`_signed_window_carry_chain` alone — no bit unpack at all.
+    Digits d_i satisfy sum(d_i * 256^i) == s exactly for EVERY 256-bit
+    s, with d_i in [-128, 128) for i < 31; the top digit absorbs the
+    final carry unsigned, staying <= 32 for every gate-passed scalar
+    (s < L < 2^253) — inside the 128-entry hot-table range. Scalars
+    >= 2^255 - 128 can push the top digit past the table; the host
+    canonical-s gate rejects them before any verdict, and the hot
+    dispatch path additionally never routes a gate-failed row
+    (double_scalarmult_hot's contract)."""
+    # (32, batch) unsigned byte windows, LEAST significant first
+    e = b.astype(jnp.int32).T
+    return _signed_window_carry_chain(e, 8)
+
+
 def dsm_stage(s_bytes, h_bytes, a_neg):
     """Signed-window recode + double-scalarmult: the traceable 'dsm' stage
     of the kernel (tools/kernel_cost.py accounts cost per stage; the
@@ -150,6 +172,40 @@ def verify_kernel(a_bytes, r_bytes, s_bytes, h_bytes):
     ok, a = ed.decompress(a_bytes)
     rprime = dsm_stage(s_bytes, h_bytes, ed.negate(a))
     return ok & ed.compress_equals(rprime, r_bytes)
+
+
+def dsm_stage_hot(s_bytes, h_bytes, a_table):
+    """Hot-signer sibling of :func:`dsm_stage` (PR 16): byte-aligned
+    radix-256 recode + the cached-table double-scalarmult. ``a_table``
+    is the batch-LEADING (batch, 128, 3, 20) int16 operand exactly as
+    the signer-table cache ships it; the limb layout wants batch
+    TRAILING, so the one transpose lives here at the stage boundary."""
+    tab = jnp.moveaxis(a_table, 0, -1)  # (128, 3, 20, batch)
+    return ed.double_scalarmult_hot(
+        signed_digits256_dev(s_bytes), signed_digits256_dev(h_bytes), tab)
+
+
+def verify_kernel_hot(a_table, r_bytes, s_bytes, h_bytes):
+    """Batched group-equation check for HOT (cache-hit) signers.
+
+    Args:
+      a_table: (batch, 128, 3, 20) int16 — affine cached multiples
+        1..128 of -A per row, canonical limbs, Z == 1 (built host-side
+        by :mod:`stellar_tpu.parallel.signer_tables`).
+      r_bytes, s_bytes, h_bytes: as :func:`verify_kernel`.
+
+    Returns:
+      (batch,) bool — True where encode(s*B + h*(-A)) == R bytewise.
+      There is NO decompression stage: a signer-table cache entry only
+      exists for a pubkey that decompressed successfully at population
+      time, so ``ok`` is True by construction for every row the hot
+      path serves (the host policy gates — canonical s/A, small-order,
+      lengths — still run in encode and AND into the verdict exactly
+      like the cold path). Bit-identical to verify_kernel on every row
+      both paths accept, which the differential suite pins per bucket.
+    """
+    rprime = dsm_stage_hot(s_bytes, h_bytes, a_table)
+    return ed.compress_equals(rprime, r_bytes)
 
 
 def verify_kernel_sharded(mesh, axis_name="batch"):

@@ -108,7 +108,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from stellar_tpu.crypto import audit as audit_mod
-from stellar_tpu.parallel import device_health, residency
+from stellar_tpu.parallel import device_health, residency, signer_tables
 from stellar_tpu.utils import faults, resilience, tracing
 from stellar_tpu.utils.metrics import registry
 from stellar_tpu.utils.timeline import pipeline_timeline
@@ -286,7 +286,9 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
                        donate_buffers: Optional[str] = None,
                        resident_cache_bytes: Optional[int] = None,
                        resident_max_item_bytes: Optional[int] = None,
-                       resident_enabled: Optional[bool] = None
+                       resident_enabled: Optional[bool] = None,
+                       signer_table_bytes: Optional[int] = None,
+                       signer_table_enabled: Optional[bool] = None
                        ) -> None:
     """Push dispatch-resilience knobs (Config / tests); None keeps the
     current value. ``deadline_ms <= 0`` disables the resolve watchdog;
@@ -294,7 +296,10 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
     ``device_*`` knobs shape the per-device quarantine breakers; the
     ``donate_buffers`` / ``resident_*`` knobs shape the dispatch-floor
     levers (ISSUE 12: donated one-off operands, device-resident
-    constant tables). The knobs govern EVERY workload on the substrate
+    constant tables); the ``signer_table_*`` knobs shape the hot-signer
+    per-pubkey A-table cache (ISSUE 16,
+    ``stellar_tpu.parallel.signer_tables``). The knobs govern EVERY
+    workload on the substrate
     (verify and hash dispatches share the tunnel whose health they
     model — and the resident buffers living on its chips)."""
     global DEADLINE_MS, DISPATCH_RETRIES, AUDIT_RATE, DONATE_BUFFERS
@@ -317,6 +322,9 @@ def configure_dispatch(deadline_ms: Optional[float] = None,
         max_bytes=resident_cache_bytes,
         max_item_bytes=resident_max_item_bytes,
         enabled=resident_enabled)
+    signer_tables.signer_table_cache.configure(
+        max_bytes=signer_table_bytes,
+        enabled=signer_table_enabled)
 
 
 _donate_warn_lock = threading.Lock()
@@ -475,6 +483,7 @@ def dispatch_health() -> dict:
         "flight_recorder": tracing.flight_recorder.stats(),
         "transfer": transfer_ledger.totals(),
         "resident": residency.resident_cache.snapshot(),
+        "signer_tables": signer_tables.signer_table_cache.snapshot(),
         "donate_buffers": DONATE_BUFFERS,
         "service": service_health_snapshot(),
     }
@@ -581,6 +590,23 @@ class Workload:
     metrics_ns = "workload"
     #: span-name prefix for the resolve phases, e.g. "verify"
     span_ns = "workload"
+    #: kernel-variant key; None marks an engine's PRIMARY plugin. A
+    #: variant plugin (a different kernel over the same result rows,
+    #: submitted via ``submit(..., variant=...)`` — e.g. the hot-signer
+    #: cached-table kernel, ISSUE 16) must set a unique name: its jit
+    #: wrappers are cached under ``(variant_name, donate)`` so
+    #: ``sorted(engine._kernels)`` stays exactly the primary shape set
+    #: the compile-reuse invariant pins.
+    variant_name: Optional[str] = None
+
+    def on_audit_conviction(self, items: Sequence) -> None:
+        """Hook: the result-integrity audit just CONVICTED the serving
+        device over a part these items rode (the engine has already
+        quarantined the chip and flipped host-only; the rows are being
+        host re-computed). Plugins holding derived state about the
+        items — e.g. the hot-signer table cache, whose resident tables
+        must never outlive the audit that caught the batch they
+        served — evict it here. Default: nothing to evict."""
 
     def encode(self, items: Sequence) -> Tuple[np.ndarray, tuple]:
         """Host prep: ``items`` -> ``(gate, arrays)``. ``gate`` is a
@@ -658,6 +684,11 @@ class BatchEngine:
         # executable per shape.
         self._kernels = {}
         self._kernels_donate = {}
+        # variant-kernel caches keyed (variant_name, donate) -> {shape:
+        # jit wrapper}: kernel VARIANTS (ISSUE 16's hot-signer path)
+        # never leak into the two primary dicts above, so the pinned
+        # `sorted(self._kernels)` shape sets survive variant traffic
+        self._kernels_variants = {}
         self._kernels_lock = threading.Lock()
         # per-instance backend attribution (items served), mirrored into
         # the process-wide meters: bench and the chaos tests read these
@@ -697,22 +728,30 @@ class BatchEngine:
     # ---------------- device dispatch ----------------
 
     def _kernel_for(self, n: int, donate: bool = False,
-                    n_args: Optional[int] = None):
-        cache = self._kernels_donate if donate else self._kernels
+                    n_args: Optional[int] = None, *, plugin=None):
+        # keyword-only `plugin` keeps the positional signature stable
+        # (harnesses call `_kernel_for(shape)` directly to pre-warm)
+        if plugin is None or plugin is self._plugin:
+            plugin = self._plugin
+            cache = self._kernels_donate if donate else self._kernels
+        else:
+            with self._kernels_lock:
+                cache = self._kernels_variants.setdefault(
+                    (plugin.variant_name, donate), {})
         with self._kernels_lock:
             kernel = cache.get(n)
         if kernel is None:
             import jax
             if donate:
                 _filter_donation_warning_once()
-                built = jax.jit(self._plugin.kernel_fn(),
+                built = jax.jit(plugin.kernel_fn(),
                                 donate_argnums=tuple(range(n_args)))
             else:
                 # one plain jit wrapper per dispatch shape; on the
                 # mesh path placement follows the committed inputs,
                 # so the SAME wrapper serves every device (jax caches
                 # one executable per (shape, device) underneath)
-                built = jax.jit(self._plugin.kernel_fn())
+                built = jax.jit(plugin.kernel_fn())
             with self._kernels_lock:
                 # setdefault: a racing builder's wrapper wins once —
                 # both wrappers trace identically, so the loser is
@@ -728,7 +767,7 @@ class BatchEngine:
 
     def _dispatch_one(self, arrays: tuple, bsize: int,
                       dev_idx: Optional[int],
-                      donate: bool = False):
+                      donate: bool = False, *, plugin=None):
         """One kernel call (whole padded bucket, or one per-device
         sub-chunk): inject-point + retry + failure attribution. Returns
         the in-flight device array, or None (host fallback).
@@ -744,8 +783,8 @@ class BatchEngine:
                         self.donated_dispatches += 1
                     return self._kernel_for(
                         bsize, donate=True,
-                        n_args=len(arrays))(*arrays)
-                return self._kernel_for(bsize)(*arrays)
+                        n_args=len(arrays), plugin=plugin)(*arrays)
+                return self._kernel_for(bsize, plugin=plugin)(*arrays)
             except Exception as e:
                 if attempt + 1 < attempts:
                     registry.counter(
@@ -866,7 +905,8 @@ class BatchEngine:
                 donatable)
 
     def _dispatch_parts(self, arrays: tuple, b: int, chunk: int,
-                        tok=None, traces=None, ptok=None):
+                        tok=None, traces=None, ptok=None,
+                        plugin=None):
         """Split one padded bucket into per-device sub-chunks over the
         CURRENTLY HEALTHY devices — the degraded-mesh re-shard.
 
@@ -921,7 +961,7 @@ class BatchEngine:
                     hi = min(lo + sub, chunk)
                     arr = self._dispatch_one(
                         per_device[di], bsize=sub, dev_idx=di,
-                        donate=donatable)
+                        donate=donatable, plugin=plugin)
                     if arr is not None:
                         # pipeline timeline: a COMMITTED kernel call
                         # opens this device's busy interval (ISSUE 10)
@@ -955,7 +995,7 @@ class BatchEngine:
                 parts.append([lo, hi, di, None])
                 continue
             arr = self._dispatch_one(placed, bsize=sub, dev_idx=di,
-                                     donate=donatable)
+                                     donate=donatable, plugin=plugin)
             if arr is not None:
                 # pipeline timeline: a COMMITTED kernel call opens
                 # this device's busy interval (ISSUE 10)
@@ -964,7 +1004,7 @@ class BatchEngine:
         return parts
 
     def _dispatch_device(self, *encoded: np.ndarray, tok=None,
-                         trace_ids=None, ptok=None):
+                         trace_ids=None, ptok=None, plugin=None):
         """Dispatch padded/chunked batches to the jitted kernel without
         blocking; returns a list of (slice, chunk_len, parts) where
         parts are per-device sub-chunk records (single-device hosts get
@@ -977,7 +1017,9 @@ class BatchEngine:
         every dispatch span)."""
         n = encoded[0].shape[0]
         top = self._buckets[-1]
-        pads = self._plugin.pad_rows()
+        if plugin is None:
+            plugin = self._plugin
+        pads = plugin.pad_rows()
         pending = []
         start = 0
         host_only = _host_only
@@ -1022,7 +1064,7 @@ class BatchEngine:
                                       **_span_attrs(devices=True)):
                         parts = self._dispatch_parts(
                             arrays, b, chunk, tok=tok, traces=tr,
-                            ptok=ptok)
+                            ptok=ptok, plugin=plugin)
                 else:
                     registry.counter(
                         "crypto.verify.dispatch.short_circuit").inc()
@@ -1041,7 +1083,8 @@ class BatchEngine:
                             tok, arrays, dest=None, pkey="default",
                             dev_idx=None)
                         arr = self._dispatch_one(placed, b, None,
-                                                 donate=donatable)
+                                                 donate=donatable,
+                                                 plugin=plugin)
                     except Exception as e:
                         _note_device_failure("transfer", e, None)
                         arr = None
@@ -1058,14 +1101,14 @@ class BatchEngine:
 
     # ---------------- public API ----------------
 
-    def _prep(self, items: Sequence):
+    def _prep(self, items: Sequence, plugin=None):
         # host-side prep phase: byte recode into the on-wire arrays
         # plus the plugin's eligibility gate
         with tracing.span(f"{self._span_ns}.prep"):
-            return self._plugin.encode(items)
+            return (plugin or self._plugin).encode(items)
 
-    def submit(self, items: Sequence,
-               trace_ids=None) -> Callable[[], np.ndarray]:
+    def submit(self, items: Sequence, trace_ids=None,
+               variant=None) -> Callable[[], np.ndarray]:
         """Asynchronous batch: host prep + non-blocking device
         dispatch, PIPELINED per bucket chunk (ISSUE 12).
 
@@ -1091,10 +1134,18 @@ class BatchEngine:
         (``trace_ranges``), so one item's path through the engine
         reconstructs from the flight recorder (the ``trace`` admin
         route).
+
+        ``variant`` (ISSUE 16): optional :class:`Workload` replacing
+        the primary plugin for THIS submit only — a different kernel
+        over the same result rows (the hot-signer cached-table path).
+        Its jit wrappers live in the per-variant cache, so the pinned
+        primary bucket shapes never grow; dispatch, fault domains,
+        breakers, audit and failover are untouched.
         """
+        plugin = variant if variant is not None else self._plugin
         n = len(items)
         if n == 0:
-            return lambda: self._plugin.empty_result(0)
+            return lambda: plugin.empty_result(0)
         items = list(items)  # pinned for possible host re-computation
         trace_ids = list(trace_ids) if trace_ids is not None else None
         top = self._buckets[-1]
@@ -1115,13 +1166,13 @@ class BatchEngine:
             chunk = min(top, n - start)
             sl = slice(start, start + chunk)
             with pipeline_timeline.host_phase(ptok, "prep"):
-                gate_c, encoded_c = self._prep(items[sl])
+                gate_c, encoded_c = self._prep(items[sl], plugin)
             gates.append(gate_c)
             if gate_c.any():
                 (_psl, _pchunk, parts), = self._dispatch_device(
                     *encoded_c, tok=tok,
                     trace_ids=(trace_ids[sl] if trace_ids else None),
-                    ptok=ptok)
+                    ptok=ptok, plugin=plugin)
             else:
                 # no row of this chunk reads device bits: the plugin
                 # finalizes (gate-fail fill / host hashing) without a
@@ -1133,8 +1184,8 @@ class BatchEngine:
         if not any(p for _sl, _c, p, _g, _e in pending):
             # nothing dispatched at all — the dropped tokens were
             # never registered, and the ring stays clean
-            out0 = self._plugin.empty_result(n)
-            return lambda: self._plugin.finalize(gate, out0, items)
+            out0 = plugin.empty_result(n)
+            return lambda: plugin.finalize(gate, out0, items)
 
         def _part_traces(gl: int, gh: int):
             return trace_ranges(trace_ids[gl:gh]) if trace_ids \
@@ -1171,7 +1222,7 @@ class BatchEngine:
                     return True
                 registry.counter(self._ns + ".audit.sampled").inc(
                     len(idxs))
-                want = self._plugin.host_result(
+                want = plugin.host_result(
                     [items[gl + i] for i in idxs])
                 got_comp = np.stack([np.asarray(vals[i])
                                      for i in idxs])
@@ -1191,7 +1242,7 @@ class BatchEngine:
             return clean
 
         def _resolve_impl() -> np.ndarray:
-            out = self._plugin.empty_result(n)
+            out = plugin.empty_result(n)
             for sl, chunk, parts, gate_c, encoded_c in pending:
                 for lo, hi, di, arr in parts:
                     got = None
@@ -1290,6 +1341,11 @@ class BatchEngine:
                             _enter_host_only(
                                 "result-integrity audit mismatch on "
                                 f"device {di}")
+                            # conviction hook: derived per-item state
+                            # (the hot-signer table cache) must not
+                            # outlive the audit that caught the part
+                            # it served
+                            plugin.on_audit_conviction(items[gl:gh])
                             _log.error(
                                 "audit mismatch: device %s returned "
                                 "wrong %s bits for rows %d:%d",
@@ -1331,10 +1387,10 @@ class BatchEngine:
                                 **fb_attrs), \
                                 pipeline_timeline.host_phase(
                                     ptok, "host_fallback"):
-                            out[gl:gh] = self._plugin.host_result(
+                            out[gl:gh] = plugin.host_result(
                                 items[gl:gh])
                         self._mark_served("host-fallback", hi - lo)
-            return self._plugin.finalize(gate, out, items)
+            return plugin.finalize(gate, out, items)
 
         def resolve() -> np.ndarray:
             with tracing.span(f"{self._span_ns}.resolve"):
@@ -1521,6 +1577,7 @@ def _reset_dispatch_state_for_testing() -> None:
     transfer_ledger._reset_for_testing()
     pipeline_timeline._reset_for_testing()
     residency.resident_cache._reset_for_testing()
+    signer_tables.signer_table_cache._reset_for_testing()
 
 
 def _auto_mesh():

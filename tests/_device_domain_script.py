@@ -116,9 +116,15 @@ def main():
     kern = v._kernel_for(SUB)
     rows = [np.repeat(x, SUB, 0) for x in
             (bv._PAD_A, bv._PAD_R, bv._PAD_S, bv._PAD_H)]
+    # the hot-signer phases (6-7) dispatch the cached-table kernel
+    # variant — warm it here too, or its first compile lands inside a
+    # phase and blows the 30s dispatch deadline
+    hkern = v._kernel_for(SUB, plugin=v._hot)
+    hrows = [np.repeat(x, SUB, 0) for x in v._hot.pad_rows()]
 
     def warm(d):
         np.asarray(kern(*[jax.device_put(x, d) for x in rows]))
+        np.asarray(hkern(*[jax.device_put(x, d) for x in hrows]))
 
     threads = [threading.Thread(target=warm, args=(d,)) for d in devs]
     for t in threads:
@@ -176,6 +182,44 @@ def main():
     # cache must show real hits (uploads suppressed) by the end
     out["resident"] = bv.dispatch_health()["resident"]
     out["breaker_history"] = health.history()
+
+    # ---- phases 6-7: hot-signer table cache vs audit conviction
+    # (ISSUE 16). Fresh dispatch story — the quarantine/host-only arc
+    # above already captured its records, and a conviction is only
+    # reachable while devices still serve. One signer repeated across
+    # the whole bucket: its cached table serves every row, so the
+    # corrupt-device conviction MUST evict that exact entry (the table
+    # is re-derived from the pubkey on next sight — a convicted chip
+    # may have returned us poisoned residency, so nothing it served
+    # stays trusted).
+    bv._reset_dispatch_state_for_testing()
+    bv.configure_dispatch(deadline_ms=30_000, dispatch_retries=0,
+                          failure_threshold=3, audit_rate=1.0,
+                          device_failure_threshold=2,
+                          device_backoff_min_s=0.3,
+                          device_backoff_max_s=0.6)
+    health = device_health.get()
+    import secrets
+    hseed = secrets.token_bytes(32)
+    hpk = ref.secret_to_public(hseed)
+    items = [(hpk, b"hot-%d" % i, ref.sign(hseed, b"hot-%d" % i))
+             for i in range(BUCKET)]
+    want = np.array([ref.verify(p, m, s) for p, m, s in items])
+
+    def cache_snap():
+        return bv.dispatch_health()["signer_tables"]
+
+    # serve pass 1 installs the table (the first occurrence rides the
+    # cold kernel); pass 2 is the recorded all-hot steady state
+    v.verify_batch(items)
+    verify_and_record("hot_signer_serve")
+    out["phases"]["hot_signer_serve"]["signer_tables"] = cache_snap()
+
+    faults.set_fault(faults.RESOLVE, "corrupt-device", 2)
+    verify_and_record("hot_signer_audit_evict")
+    out["phases"]["hot_signer_audit_evict"]["signer_tables"] = \
+        cache_snap()
+    faults.clear()
     print(json.dumps(out, default=str))
 
 

@@ -210,6 +210,20 @@ def test_headroom_is_the_documented_claim(proof):
     assert worst < 2 ** 31
 
 
+def test_hot_stage_envelope_pinned(proof):
+    """ISSUE 16: the hot-signer kernel is overflow-proven too, and its
+    accumulator envelope is strictly TIGHTER than cold's — the cached
+    table ships canonical limbs (<= MASK), not loose ones, so the
+    worst multiply coefficient drops below the cold dsm's
+    NLIMBS * LOOSE_MAX^2 headline."""
+    hot = proof["envelope"]["stages"]["dsm_hot"]["max_abs"]
+    cold = proof["envelope"]["stages"]["dsm"]["max_abs"]
+    assert hot < cold
+    assert hot < 2 ** 31
+    assert proof["envelope"]["stages"]["kernel_hot_total"]["max_abs"] \
+        < 2 ** 31
+
+
 def test_envelope_matches_golden(proof):
     """The committed golden is the proof artifact kernel PRs diff.
     Golden was written at batch 128; this proof ran at batch 2 — a
@@ -680,6 +694,19 @@ def test_lint_scopes_cover_residency_cache():
     assert res in set(locks.SCOPE)
     assert res in set(nondet.HOST_ORACLE_FILES)
     assert res not in nondet.ALLOWLIST._entries
+
+
+def test_lint_scopes_cover_signer_tables():
+    """ISSUE 16: the per-pubkey table cache's LRU mutates from every
+    partitioning submit thread (lock lint), and it decides which rows
+    ride the hot kernel — fingerprints must stay content-derived and
+    eviction clock/RNG-free (nondet lint), or replicas diverge on
+    which kernel variant served a row. No allowlist entry: clock/
+    RNG-free by design, like residency.py whose shape it follows."""
+    st = "stellar_tpu/parallel/signer_tables.py"
+    assert st in set(locks.SCOPE)
+    assert st in set(nondet.HOST_ORACLE_FILES)
+    assert st not in nondet.ALLOWLIST._entries
 
 
 def test_lint_scopes_cover_pipeline_timeline():

@@ -186,6 +186,41 @@ def test_host_only_steady_state(domain_run):
 
 
 @lifecycle
+def test_hot_signer_table_serves_on_chaos_mesh(domain_run):
+    """ISSUE 16: on the forced 4-device mesh, a repeat signer's cached
+    A-table actually serves rows through the HOT kernel variant (cache
+    hits > 0, one install for one signer), verdicts stay bit-identical
+    to the oracle, and the variant introduces no kernel shape beyond
+    the single pinned sub-chunk executable."""
+    ph = domain_run["phases"]["hot_signer_serve"]
+    assert ph["bit_identical"]
+    st = ph["signer_tables"]
+    assert st["enabled"]
+    assert st["entries"] == 1 and st["installs"] == 1
+    assert st["hits"] > 0
+    assert st["audit_evictions"] == 0
+    assert ph["kernel_shapes"] == [2]
+    assert ph["donate_kernel_shapes"] == []
+
+
+@lifecycle
+def test_audit_conviction_evicts_served_signer_table(domain_run):
+    """ISSUE 16 hardening: corrupt-device:2 convicted WHILE the cached
+    table was serving the batch — the conviction must evict that
+    signer's entry (nothing a convicted chip served stays trusted; the
+    table is re-derived from the pubkey on next sight), with the
+    corrupted verdicts never surfacing and the process flipped
+    host-only."""
+    ph = domain_run["phases"]["hot_signer_audit_evict"]
+    assert ph["bit_identical"]
+    st = ph["signer_tables"]
+    assert st["audit_evictions"] >= 1
+    assert st["entries"] == 0
+    assert 2 in ph["quarantined"]
+    assert ph["host_only"] is True
+
+
+@lifecycle
 def test_breaker_history_records_lifecycle(domain_run):
     """The DeviceHealth history ring carries the whole story: device
     1's open -> half-open -> closed arc and device 2's quarantine."""

@@ -152,14 +152,57 @@ def test_batch_inv_is_one_inversion_per_call(report):
 def test_current_costs_pinned(report):
     """Ratchet: the post-PR-13 numbers themselves must not creep back
     up (5% slack for benign jaxpr shifts across jax versions).
-    Captured 2026-08-04; ledger version 2."""
-    assert report["ledger_version"] == 2
+    Captured 2026-08-04; hot arm added 2026-08-06 (ledger version 3 —
+    the cold rows are unchanged from version 2)."""
+    assert report["ledger_version"] == 3
     assert report["dsm_static_mul_ops"] <= 905 * 1.05
     assert report["dsm_weighted_mul_elems"] <= 115_124_540 * 1.05
     assert report["stages"]["kernel_total"]["static_mul_ops"] <= \
         2759 * 1.05
     assert report["affine_table"]["batch_inv_weighted_mul_elems"] <= \
         3_237_180 * 1.05
+    assert report["dsm"]["hot"]["executed_macs_per_call"] <= \
+        87_439_360 * 1.05
+    assert report["stages"]["kernel_hot_total"]["static_mul_ops"] <= \
+        1032 * 1.05
+
+
+def test_hot_arm_dropped_20pct_vs_cold(report):
+    """ISSUE 16 acceptance: the hot-signer (cached-table radix-256)
+    dsm executes >= 20% fewer MACs per call than the cold live-build
+    radix-32 dsm at the same batch — measured from the SAME traced
+    report, not remembered constants. (Landed: -24.05%. Radix-128
+    would only reach -19.4%; the byte-aligned 128-entry tables are
+    what clears the bar.)"""
+    cold = report["dsm"]["cold"]["executed_macs_per_call"]
+    hot = report["dsm"]["hot"]["executed_macs_per_call"]
+    assert hot <= 0.80 * cold, (hot, cold)
+    assert report["dsm"]["executed_macs_per_call"] == cold
+    assert report["signer_table"]["hot_savings_frac"] >= 0.20
+
+
+def test_signer_table_geometry_pinned(report):
+    """The signer_table ledger section must describe the operand the
+    cache actually ships (parallel/signer_tables.py pins the same
+    numbers from the host side — the two halves of the contract)."""
+    st = report["signer_table"]
+    assert st["radix"] == 256
+    assert st["windows"] == 32
+    assert st["entries"] == 128
+    assert st["table_dtype"] == "int16"
+    assert st["bytes_per_signer"] == 128 * 3 * 20 * 2
+    assert st["doublings"] == 248
+    assert st["cached_adds"] == 63
+
+
+def test_hot_stage_has_no_decompress(report):
+    """The hot kernel's whole-program multiply budget must stay well
+    under cold's: no in-kernel decompression (cache membership is the
+    decompression proof) and no in-kernel table build. The hot TOTAL
+    is pinned below even the cold dsm stage alone."""
+    hot_total = report["stages"]["kernel_hot_total"]["static_mul_ops"]
+    cold_total = report["stages"]["kernel_total"]["static_mul_ops"]
+    assert hot_total < 0.5 * cold_total, (hot_total, cold_total)
 
 
 def test_stage_sum_close_to_total(report):

@@ -398,6 +398,14 @@ def test_dispatch_attribution_complete_and_reconciles():
     shape — no jax, no device) the breakdown still lists EVERY phase,
     and the per-phase span sum reconciles to >= 95% of the blocking
     root span."""
+    # partition-off: the hot-signer split (PR 16) would turn this
+    # repeat-signer pool into TWO submission streams (hot + cold),
+    # doubling the per-phase counts this test pins at exactly one
+    # resolve each. The attribution semantics are what's under test,
+    # not the partition (its own suite covers that); the autouse
+    # reset restores the default afterwards.
+    from stellar_tpu.parallel import signer_tables
+    signer_tables.signer_table_cache.configure(enabled=False)
     bv._enter_host_only("test: dead-tunnel attribution")
     v = bv.BatchVerifier(bucket_sizes=(64,))
     items = _pool_items(64)

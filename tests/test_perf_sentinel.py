@@ -22,15 +22,22 @@ spec.loader.exec_module(sentinel)
 def _record(**over):
     rec = {
         "value": 80.0,
-        "kernel_cost": {"ledger_version": 2,
+        "kernel_cost": {"ledger_version": 3,
                         "dsm_static_mul_ops": 905,
                         "kernel_static_mul_ops": 2759,
                         "dsm_weighted_mul_elems": 115124540,
                         "select_macs_per_verify": 0,
-                        "dsm": {"executed_macs_per_call": 115124540},
+                        "dsm": {"executed_macs_per_call": 115124540,
+                                "cold": {
+                                    "executed_macs_per_call": 115124540},
+                                "hot": {
+                                    "executed_macs_per_call": 87439360,
+                                    "vs_cold_frac": 0.7595}},
                         "affine_table": {
                             "build_weighted_mul_elems": 11521340,
                             "batch_inv_weighted_mul_elems": 3237180},
+                        "signer_table": {"bytes_per_signer": 15360,
+                                         "hot_savings_frac": 0.2405},
                         "sha256": {"weighted_ops": 90269}},
         "analysis": {"ok": True, "overflow_proven": True,
                      "sha256_overflow_proven": True, "lints_ok": True,
@@ -100,6 +107,30 @@ def test_executed_macs_family_drift_fails():
         _record(**{"kernel_cost.dsm.executed_macs_per_call":
                    int(115124540 * 1.01)}))
     assert ok["ok"], ok["findings"]
+
+
+def test_hot_signer_rows_gated():
+    """ISSUE 16: the hot-arm executed volume trends at +2% like every
+    kernel-cost row, and the hot/cold ratio has an ABSOLUTE ceiling at
+    the 0.80 acceptance bar — a slow creep back toward cold parity
+    fails even if each step is under 2%."""
+    out = sentinel.apply_rules(
+        _record(),
+        _record(**{"kernel_cost.dsm.hot.executed_macs_per_call":
+                   115_000_000}))
+    assert any(f["path"] == "kernel_cost.dsm.hot.executed_macs_per_call"
+               for f in out["findings"])
+    out = sentinel.apply_rules(
+        _record(),
+        _record(**{"kernel_cost.dsm.hot.vs_cold_frac": 0.85}))
+    assert any(f["path"] == "kernel_cost.dsm.hot.vs_cold_frac"
+               for f in out["findings"])
+    # the per-signer byte shape is pinned exactly (0% tolerance)
+    out = sentinel.apply_rules(
+        _record(),
+        _record(**{"kernel_cost.signer_table.bytes_per_signer": 30720}))
+    assert any(f["path"] == "kernel_cost.signer_table.bytes_per_signer"
+               for f in out["findings"])
 
 
 def test_ledger_version_bump_rebases_kernel_cost_family():

@@ -82,6 +82,20 @@ RULES = [
     ("kernel_cost.affine_table.batch_inv_weighted_mul_elems",
      "max_increase_frac", 0.02,
      "Montgomery batch-inversion chain volume regressed"),
+    # PR 16 hot-signer rows (ledger v3): the cached-table radix-256
+    # arm's executed volume, and the hot/cold ratio itself — the
+    # acceptance quantity (<= 0.80) must not creep back toward parity.
+    ("kernel_cost.dsm.hot.executed_macs_per_call",
+     "max_increase_frac", 0.02,
+     "hot-signer executed dsm MACs/call regressed (the PR 16 win "
+     "eroding)"),
+    ("kernel_cost.dsm.hot.vs_cold_frac", "max_abs", 0.80,
+     "hot-signer dsm must stay >= 20% below cold (ISSUE 16 "
+     "acceptance)"),
+    ("kernel_cost.signer_table.bytes_per_signer",
+     "max_increase_frac", 0.0,
+     "per-signer table bytes changed — cache budgets and the "
+     "residency story assume 15 KiB/signer"),
     ("kernel_cost.sha256.weighted_ops", "max_increase_frac", 0.02,
      "sha256 weighted op volume regressed"),
     # analysis envelope: proof state must hold; the envelope HASH may

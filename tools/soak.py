@@ -115,6 +115,46 @@ def _submission(pool, want, i, n):
     return [pool[k] for k in idx], want[idx]
 
 
+def _zipf_pool(n_signers: int):
+    """Zipf-signer corpus (``--signers zipf``): ``n_signers`` DISTINCT
+    keys, one pre-signed message each (oracle expectations computed
+    once — the OpenSSL signing path makes hundreds of keys cheap),
+    plus the two structured invalid rows. Returns the pool, the oracle
+    vector, and a zipf(s~1) rank table: signer ``k`` appears with
+    weight ~1/(k+1), so a handful of hot signers dominate the draw —
+    the repeat-signer regime the per-pubkey table cache (ISSUE 16)
+    exists for — while the long tail keeps installing fresh entries.
+    The table is deterministic (no RNG): replicas must partition the
+    SAME rows onto the hot kernel or verdict streams diverge."""
+    import numpy as np
+    from stellar_tpu.crypto import ed25519_ref as ref
+    pool = []
+    for i in range(n_signers):
+        seed = (i + 1).to_bytes(4, "little") * 8
+        pk = ref.secret_to_public(seed)
+        msg = b"zipf-%d" % i
+        pool.append((pk, msg, ref.sign(seed, msg)))
+    pk0, m0, s0 = pool[0]
+    pool.append((pk0, m0 + b"!", s0))     # tampered message
+    pool.append((pk0[:31], m0, s0))       # bad pk length
+    want = np.array([ref.verify(p, m, s) for p, m, s in pool])
+    weighted = []
+    for k in range(n_signers):
+        weighted.extend([k] * max(1, n_signers // (8 * (k + 1))))
+    weighted.extend([n_signers, n_signers + 1])   # invalid rows ride
+    return pool, want, weighted
+
+
+def _zipf_submission(pool, want, weighted, i, n):
+    """Draw ``n`` zipf-ranked rows for submission ``i``: a fixed prime
+    stride over the rank table — deterministic, full-cycle (the stride
+    is coprime to any table this size), and distinct per submission so
+    the shed rule's per-submission digests stay distinct."""
+    L = len(weighted)
+    idx = [weighted[((i * 7 + j) * 7919) % L] for j in range(n)]
+    return [pool[k] for k in idx], want[idx]
+
+
 def _hash_corpus(i: int, n: int):
     """Rotating deterministic message batch ``i``: every length regime
     (empty through multi-block) with content varying per round so no
@@ -261,7 +301,8 @@ def run_sha256(smoke: bool, duration_s: float,
 
 def run(smoke: bool, duration_s: float, corrupt: bool,
         events_path: str, tenants: int = 0,
-        flooder: bool = False, ramp: bool = False) -> dict:
+        flooder: bool = False, ramp: bool = False,
+        signers: str = "pool") -> dict:
     import numpy as np
 
     from stellar_tpu.crypto import batch_verifier as bv
@@ -311,6 +352,14 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     # SLOWER than sequential on a small host (GIL-bound)
     for d in devs:
         warm(d)
+    if signers == "zipf":
+        # zipf traffic rides the HOT (cached-table) kernel variant
+        # too — warm it now or its first compile lands mid-flood and
+        # stalls the scp lane past its p99 bound
+        hkern = v._kernel_for(SUB, plugin=v._hot)
+        hrows = [np.repeat(x, SUB, 0) for x in v._hot.pad_rows()]
+        for d in devs:
+            np.asarray(hkern(*[jax.device_put(x, d) for x in hrows]))
     warm_s = round(time.monotonic() - t0, 1)
     event("warm", seconds=warm_s, devices=len(devs))
 
@@ -346,7 +395,16 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     faults.set_fault(faults.DISPATCH, "flaky-device", 0)
     event("fault", spec="device.dispatch=flaky-device:0")
 
-    pool, want = _signed_pool()
+    if signers == "zipf":
+        zpool, zwant, weighted = _zipf_pool(400 if smoke else 1200)
+
+        def pick(i, n):
+            return _zipf_submission(zpool, zwant, weighted, i, n)
+    else:
+        pool, want = _signed_pool()
+
+        def pick(i, n):
+            return _submission(pool, want, i, n)
     results = {"bulk": {"tickets": [], "rejected": 0},
                "scp": {"tickets": [], "rejected": 0}}
     flooder_stats = {"rejected": 0, "quota_rejected": 0,
@@ -355,7 +413,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
 
     def flood(lane, count, per_sub, pace_s, offset=0):
         for i in range(count):
-            items, exp = _submission(pool, want, i + offset, per_sub)
+            items, exp = pick(i + offset, per_sub)
             tenant = None
             if tenants > 0 and lane == "bulk":
                 tenant = "t%03d" % ((i + offset) % tenants)
@@ -374,7 +432,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         """The adversarial flooder: unpaced bulk bursts under ONE
         tenant id — its quota (not the lane budget) must absorb it."""
         for i in range(count):
-            items, exp = _submission(pool, want, i + offset, per_sub)
+            items, exp = pick(i + offset, per_sub)
             with lock:
                 flooder_stats["submitted"] += 1
             try:
@@ -496,6 +554,39 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         problems.append("service metrics missing from the Prometheus "
                         "exposition")
 
+    # ---- zipf-signer scenario record + gates (--signers zipf) ----
+    signer_rec = None
+    if signers == "zipf":
+        st = health["signer_tables"]
+        hot_rows = registry.meter(
+            "crypto.verify.signer_table.hot_rows").count
+        cold_rows = registry.meter(
+            "crypto.verify.signer_table.cold_rows").count
+        variant_shapes = sorted(
+            {n for kerns in v._kernels_variants.values()
+             for n in kerns})
+        signer_rec = {
+            "distinct_signers": len(zpool) - 2,
+            "cache": st,
+            "hot_rows": hot_rows,
+            "cold_rows": cold_rows,
+            "variant_kernel_shapes": variant_shapes,
+        }
+        if not st["enabled"]:
+            problems.append(
+                "signer-table cache disabled — zipf proved nothing")
+        if st["hits"] == 0 or hot_rows == 0:
+            problems.append(
+                "zipf flood never hit the signer-table cache — hot "
+                f"rate is 0 ({st})")
+        if st["installs"] == 0:
+            problems.append(
+                "zipf flood never installed a signer table")
+        if not set(variant_shapes) <= {SUB, BUCKET}:
+            problems.append(
+                "hot kernel compiled beyond the pinned bucket "
+                f"shapes: {variant_shapes} vs {{{SUB}, {BUCKET}}}")
+
     # ---- ramp scenario record + gates (--ramp) ----
     ramp_rec = None
     if ramp:
@@ -578,6 +669,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "events_path": events_path,
         "tenant": tenant_rec,
         "ramp": ramp_rec,
+        "signer_tables": signer_rec,
         "problems": problems,
     }
 
@@ -676,6 +768,15 @@ def main() -> int:
                          "absorbed by knob moves with the "
                          "conservation law still exact; verify "
                          "workload only")
+    ap.add_argument("--signers", default="pool",
+                    choices=("pool", "zipf"),
+                    help="flood signer distribution: the 6-key "
+                         "rotating pool (default) or a zipf-ranked "
+                         "corpus of hundreds of DISTINCT signers — "
+                         "the repeat-signer regime the per-pubkey "
+                         "table cache (ISSUE 16) serves; gates hot "
+                         "hit rate > 0 and no kernel compiles beyond "
+                         "the pinned buckets; verify workload only")
     ap.add_argument("--workload", default="verify",
                     choices=("verify", "sha256"),
                     help="which engine plugin to soak: the verify "
@@ -710,7 +811,7 @@ def main() -> int:
     else:
         rec = run(args.smoke, args.duration, args.corrupt, events,
                   tenants=args.tenants, flooder=args.flooder,
-                  ramp=args.ramp)
+                  ramp=args.ramp, signers=args.signers)
     if args.emit_bench_service and args.workload == "verify" \
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)
