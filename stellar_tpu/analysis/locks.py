@@ -59,6 +59,13 @@ SCOPE = [
     # snapshots — everything under the controller's own lock; the
     # service applies the resulting knob values under its cv
     "stellar_tpu/crypto/controller.py",
+    # the fleet router (ISSUE 17): replica states, conservation
+    # counters and submission ledgers mutate from every submitting
+    # thread while admin routes read snapshots and the divergence
+    # detector re-reads replica logs — everything under the router's
+    # own lock (the _locked convention); the shared-engine adapter
+    # serializes replica dispatchers on one engine
+    "stellar_tpu/crypto/fleet.py",
     "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
     # the device-resident constant cache (ISSUE 12): its LRU mutates

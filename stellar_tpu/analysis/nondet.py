@@ -64,6 +64,15 @@ HOST_ORACLE_FILES = [
     # (pinned in test_analysis.py), or two replicas' knob
     # trajectories could diverge under identical inputs
     "stellar_tpu/crypto/controller.py",
+    # the fleet router (ISSUE 17): routing, probation re-admission
+    # and divergence conviction all decide WHICH replica serves a
+    # submission — pure SHA-256 rendezvous draws over event-count
+    # state, zero clock reads, NO allowlist entry (pinned in
+    # test_analysis.py), or two independently constructed routers
+    # could route the same stream differently (the per-replica
+    # breakers keep their clocks inside resilience.py; they are a
+    # metric surface, never a routing input)
+    "stellar_tpu/crypto/fleet.py",
     # the workload-agnostic batch engine owns dispatch, re-shard,
     # audit-sample composition, and host-oracle failover for EVERY
     # plugin — a clock or RNG here would desynchronize which rows any

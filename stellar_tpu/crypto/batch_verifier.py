@@ -65,9 +65,10 @@ from stellar_tpu.parallel.batch_engine import (  # noqa: F401 (re-exports)
     Workload, _auto_mesh, _breaker, _enter_host_only, _note_device_failure,
     _reset_dispatch_state_for_testing, configure_dispatch, device_available,
     dispatch_attribution, dispatch_degraded, dispatch_health,
-    host_only_mode, note_shed_onset, note_trace_event,
-    register_service_health, served_counts, service_health_snapshot,
-    start_device_probe, trace_ranges,
+    fleet_health_snapshot, host_only_mode, note_shed_onset,
+    note_trace_event, register_fleet_health, register_service_health,
+    served_counts, service_health_snapshot, start_device_probe,
+    trace_ranges,
 )
 from stellar_tpu.utils import resilience, tracing
 from stellar_tpu.utils.metrics import registry
@@ -77,7 +78,8 @@ __all__ = ["BatchVerifier", "Ed25519Workload", "Ed25519HotWorkload",
            "device_available", "dispatch_health", "configure_dispatch",
            "dispatch_attribution", "dispatch_degraded",
            "note_shed_onset", "note_trace_event", "trace_ranges",
-           "register_service_health",
+           "register_service_health", "register_fleet_health",
+           "fleet_health_snapshot",
            "RESOLVE_PHASES", "RESOLVE_ROOT"]
 
 _L = ref.L

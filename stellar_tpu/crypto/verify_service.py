@@ -67,10 +67,16 @@ metric-cardinality guard.
 **Work conservation law** (pinned by ``tools/soak.py`` and the tier-1
 ``SOAK_OK`` gate): for every lane,
 
-    submitted == verified + rejected + shed + failed + pending
+    submitted == verified + rejected + shed + failed + handoff
+                 + pending
 
 with ``failed == 0`` in healthy operation — no item is ever silently
 dropped; ``snapshot()["conservation_gap"]`` must read 0 at all times.
+``handoff`` (ISSUE 17) counts items this replica drained to the fleet
+router for re-submission elsewhere — a terminal for THIS replica,
+never for the fleet: the router's own conservation law counts each
+submission exactly once across all replicas
+(:mod:`stellar_tpu.crypto.fleet`, tier-1 ``FLEET_OK``).
 
 **Closed-loop control** (ISSUE 15, ``stellar_tpu/crypto/
 controller.py``): when a :class:`~stellar_tpu.crypto.controller.
@@ -474,10 +480,29 @@ class VerifyService:
                  aging_every: Optional[int] = None,
                  shed_highwater_frac: Optional[float] = None,
                  controller=None,
-                 control_every: Optional[int] = None):
+                 control_every: Optional[int] = None,
+                 replica: Optional[int] = None):
         self._verifier = verifier
-        self._lane_depth = LANE_DEPTH if lane_depth is None \
-            else max(1, int(lane_depth))
+        # fleet replica identity (ISSUE 17): stamped into every
+        # decision tuple and Overloaded refusal so fleet-level
+        # evidence (divergence conviction, refusal attribution) names
+        # the replica that produced it; None = single-service deploy
+        self.replica = replica
+        # ``lane_depth`` accepts a per-lane dict (ISSUE 17): a
+        # replicated fleet concentrates each (lane, tenant) key on
+        # ONE replica (rendezvous affinity), so a replica fronting
+        # the whole scp key needs a deeper scp queue than its bulk
+        # lanes — asymmetric depth is a fleet-sizing knob, not a
+        # scheduling change (admission only; shed dynamics key off
+        # the bulk depth as before)
+        if lane_depth is None:
+            self._lane_depth = LANE_DEPTH
+        elif isinstance(lane_depth, dict):
+            self._lane_depth = {
+                ln: max(1, int(lane_depth.get(ln, LANE_DEPTH)))
+                for ln in LANES}
+        else:
+            self._lane_depth = max(1, int(lane_depth))
         self._lane_bytes = LANE_BYTES if lane_bytes is None \
             else max(1, int(lane_bytes))
         self._max_batch = MAX_BATCH if max_batch is None \
@@ -516,7 +541,8 @@ class VerifyService:
         self._tenant_inflight = {ln: {} for ln in LANES}
         self._inflight_items = 0
         self._counts = {ln: {"submitted": 0, "verified": 0,
-                             "rejected": 0, "shed": 0, "failed": 0}
+                             "rejected": 0, "shed": 0, "failed": 0,
+                             "handoff": 0}
                         for ln in LANES}
         # per-tenant conservation counters (ISSUE 14): submitted ==
         # verified + rejected + shed + failed + pending PER TENANT;
@@ -570,7 +596,8 @@ class VerifyService:
         return self
 
     def submit(self, items: Sequence[tuple], lane: str = "bulk",
-               tenant: Optional[str] = None) -> VerifyTicket:
+               tenant: Optional[str] = None,
+               trace_lo: Optional[int] = None) -> VerifyTicket:
         """Admit one submission of (pk, msg, sig) triples into
         ``lane`` on behalf of ``tenant`` (None = the quota-exempt
         default tenant). Raises :class:`Overloaded`
@@ -579,7 +606,11 @@ class VerifyService:
         quota inside the lane is exhausted (``reason="tenant-depth"``
         / ``"tenant-bytes"``, ``tenant`` set on the exception), or
         the service is stopping — rejected work never enters a queue,
-        so memory stays bounded no matter the offered load."""
+        so memory stays bounded no matter the offered load.
+
+        ``trace_lo`` (ISSUE 17) lets the fleet router re-submit
+        drained work under its ORIGINAL trace block — a handoff keeps
+        the items' trace IDs intact; leave None for fresh work."""
         if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r} (one of {LANES})")
         tenant = tenant_mod.validate_tenant(tenant)
@@ -597,8 +628,11 @@ class VerifyService:
         # per-item trace IDs (one contiguous block per submission):
         # assigned BEFORE admission so a rejected submission's trace
         # still exists — tagged in the Overloaded ticket and the
-        # recorder's service.reject event
-        trace_lo = _alloc_trace_block(n)
+        # recorder's service.reject event. A fleet handoff passes the
+        # original block in, so a re-submitted item's trace survives
+        # its first replica's death.
+        if trace_lo is None:
+            trace_lo = _alloc_trace_block(n)
         trange = [[trace_lo, trace_lo + n]] if n else []
         # clock read: latency stamp only — feeds the lane wait-time
         # histogram, never a verify/shed decision (nondet allowlist)
@@ -613,7 +647,7 @@ class VerifyService:
             reason = None
             if self._stop or not self._running:
                 reason = "stopped"
-            elif len(self._queues[lane]) >= self._lane_depth:
+            elif len(self._queues[lane]) >= self._depth_of(lane):
                 reason = "queue-depth"
             elif (self._queued_bytes[lane] + self._inflight_bytes[lane]
                   + nbytes) > self._lane_bytes:
@@ -656,7 +690,8 @@ class VerifyService:
                     f"verify service {lane} lane over budget "
                     f"({reason})", kind="rejected", lane=lane,
                     reason=reason, tenant=tenant,
-                    trace_ids=range(trace_lo, trace_lo + n))
+                    trace_ids=range(trace_lo, trace_lo + n),
+                    replica=self.replica)
             tkt = VerifyTicket(lane, items, nbytes, digest,
                                self._seq, t_enq, trace_lo=trace_lo,
                                tenant=tenant)
@@ -714,6 +749,41 @@ class VerifyService:
         with self._cv:
             self._running = False
 
+    def drain_handoff(self) -> list:
+        """Fleet drain protocol (ISSUE 17): atomically extract every
+        QUEUED submission so the router can re-submit each one to a
+        surviving replica with its trace IDs intact. Extracted items
+        move to the ``handoff`` terminal of this replica's
+        conservation law (they are no longer this replica's to finish
+        — they will be counted exactly once more, at the survivor
+        that admits them), so both the per-replica and the fleet
+        residuals stay exactly 0 through a kill. In-flight work is
+        NOT touched: the dispatcher finishes it during the drain stop
+        that follows. Returns the extracted tickets with their
+        futures still pending — the router chains each future to its
+        re-submission, so callers never observe the handoff."""
+        out = []
+        with self._cv:
+            for ln in LANES:
+                for tkt in self._queues[ln].drain_if(None):
+                    self._queued_items[ln] -= tkt.n_items
+                    self._queued_bytes[ln] -= tkt._nbytes
+                    self._counts[ln]["handoff"] += tkt.n_items
+                    tc = self._tenant_counts_locked(tkt.tenant)
+                    tc["handoff"] += tkt.n_items
+                    tc["pending"] -= tkt.n_items
+                    registry.meter(
+                        "crypto.verify.service.handoff"
+                    ).mark(tkt.n_items)
+                    batch_verifier.note_trace_event(
+                        "service.handoff", lane=ln, tenant=tkt.tenant,
+                        replica=self.replica,
+                        traces=[[tkt.trace_lo,
+                                 tkt.trace_lo + tkt.n_items]])
+                    out.append(tkt)
+                self._publish_lane_gauges_locked(ln)
+        return out
+
     def snapshot(self) -> dict:
         """Health surface (``dispatch_health()["service"]`` / the
         ``service`` admin route): per-lane depths, budgets, the
@@ -723,7 +793,7 @@ class VerifyService:
         with self._cv:
             lanes = {}
             totals = {"submitted": 0, "verified": 0, "rejected": 0,
-                      "shed": 0, "failed": 0}
+                      "shed": 0, "failed": 0, "handoff": 0}
             for ln in LANES:
                 c = dict(self._counts[ln])
                 for k in totals:
@@ -754,7 +824,7 @@ class VerifyService:
                 "conservation_gap": (
                     totals["submitted"] - totals["verified"]
                     - totals["rejected"] - totals["shed"]
-                    - totals["failed"] - pending),
+                    - totals["failed"] - totals["handoff"] - pending),
                 "knobs": {"lane_depth": self._lane_depth,
                           "lane_bytes": self._lane_bytes,
                           "max_batch": self._max_batch,
@@ -781,7 +851,8 @@ class VerifyService:
         for t, c in tenants.items():
             c["conservation_gap"] = (
                 c["submitted"] - c["verified"] - c["rejected"]
-                - c["shed"] - c["failed"] - c["pending"])
+                - c["shed"] - c["failed"] - c.get("handoff", 0)
+                - c["pending"])
             if c["conservation_gap"] != 0:
                 gaps[t] = c["conservation_gap"]
         return {"tenants": tenants,
@@ -792,12 +863,14 @@ class VerifyService:
 
     def decision_log(self, limit: int = 0) -> list:
         """The bounded in-order scheduling/shed decision log:
-        ``("dispatch", lane, tenant, seq, vfinish)`` per weighted-fair
-        pop and ``("shed", lane, tenant, seq, level)`` per shed row.
-        Two replicas fed identical arrival order produce identical
-        logs — the bit-identical surface ``tools/tenant_selfcheck.py``
-        gates on. ``limit`` bounds the tail returned (0 = all
-        retained)."""
+        ``("dispatch", lane, tenant, seq, vfinish, replica)`` per
+        weighted-fair pop and ``("shed", lane, tenant, seq, level,
+        replica)`` per shed row (``replica`` is this service's fleet
+        identity, ISSUE 17 — None outside a fleet). Two replicas fed
+        identical arrival order produce identical logs — the
+        bit-identical surface ``tools/tenant_selfcheck.py`` gates on,
+        and the evidence the fleet divergence detector convicts from.
+        ``limit`` bounds the tail returned (0 = all retained)."""
         with self._cv:
             log = list(self._decisions)
         return log[-limit:] if limit else log
@@ -844,7 +917,7 @@ class VerifyService:
             tc = self._tenant_counts[tenant] = {
                 "submitted": 0, "verified": 0, "rejected": 0,
                 "quota_rejected": 0, "shed": 0, "failed": 0,
-                "pending": 0}
+                "handoff": 0, "pending": 0}
         return tc
 
     def _publish_lane_gauges_locked(self, ln: str) -> None:
@@ -860,13 +933,19 @@ class VerifyService:
             f"crypto.verify.service.lane.{ln}.bytes").set(
             self._queued_bytes[ln] + self._inflight_bytes[ln])
 
+    def _depth_of(self, lane: str) -> int:
+        """Admission depth for ``lane`` — scalar or per-lane dict."""
+        d = self._lane_depth
+        return d[lane] if isinstance(d, dict) else d
+
     def _pressure_locked(self) -> tuple:
         """(level, why): 2 = dispatch degraded (global breaker open /
         host-only — capacity collapsed to the host oracle), 1 = bulk
         backlog over high-water, 0 = healthy."""
         if batch_verifier.dispatch_degraded():
             return 2, "dispatch-degraded"
-        hw = max(1, int(self._lane_depth * self._shed_highwater_frac))
+        hw = max(1, int(self._depth_of("bulk")
+                        * self._shed_highwater_frac))
         if len(self._queues["bulk"]) >= hw:
             return 1, "backlog"
         return 0, ""
@@ -918,7 +997,8 @@ class VerifyService:
                 tc["shed"] += tkt.n_items
                 tc["pending"] -= tkt.n_items
                 self._decisions.append(
-                    ("shed", ln, tkt.tenant, tkt._seq, level))
+                    ("shed", ln, tkt.tenant, tkt._seq, level,
+                     self.replica))
                 registry.meter(
                     "crypto.verify.service.shed").mark(tkt.n_items)
                 registry.meter(
@@ -940,7 +1020,8 @@ class VerifyService:
                 tkt._fut.set_exception(Overloaded(
                     f"shed under overload (level {level}: {why})",
                     kind="shed", lane=ln, reason=why,
-                    tenant=tkt.tenant, trace_ids=tkt.trace_ids))
+                    tenant=tkt.tenant, trace_ids=tkt.trace_ids,
+                    replica=self.replica))
             self._publish_lane_gauges_locked(ln)
         return onset
 
@@ -972,7 +1053,7 @@ class VerifyService:
                 tkt._fut.set_exception(Overloaded(
                     "service stopped without drain", kind="shed",
                     lane=ln, reason="stopped", tenant=tkt.tenant,
-                    trace_ids=tkt.trace_ids))
+                    trace_ids=tkt.trace_ids, replica=self.replica))
             self._publish_lane_gauges_locked(ln)
 
     def _pick_lane_locked(self) -> Optional[str]:
@@ -1018,7 +1099,8 @@ class VerifyService:
                               tkt.trace_lo + tkt.n_items]]
             decisions.append(dec)
             self._decisions.append(
-                ("dispatch", ln, tkt.tenant, tkt._seq, tkt._vfinish))
+                ("dispatch", ln, tkt.tenant, tkt._seq, tkt._vfinish,
+                 self.replica))
             parts.append((tkt, len(items)))
             items.extend(tkt._items)
             tids.extend(tkt.trace_ids)
@@ -1138,7 +1220,10 @@ class VerifyService:
         return {
             "batches": self._batches,
             "pressure": self._pressure,
-            "lane_depth": self._lane_depth,
+            # the controller reasons about the BULK admission depth
+            # (its highwater knob keys off it); per-lane dicts stay
+            # a service-local sizing detail
+            "lane_depth": self._depth_of("bulk"),
             "scp_hol_age": (self._seq - scp_head)
             if scp_head is not None else 0,
             "lanes": lanes,

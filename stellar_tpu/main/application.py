@@ -303,7 +303,31 @@ class Application:
                 hysteresis=config.VERIFY_CONTROL_HYSTERESIS,
                 cooldown=config.VERIFY_CONTROL_COOLDOWN,
                 log_cap=config.VERIFY_CONTROL_LOG)
-        if config.VERIFY_SERVICE_ENABLED:
+        # fleet knobs (docs/robustness.md "Replicated fleet") —
+        # pushed BEFORE the fleet could start, so the router is born
+        # with the configured cadence/probation/ledger bounds
+        if changed("VERIFY_FLEET_ENABLED") or \
+                changed("VERIFY_FLEET_REPLICAS") or \
+                changed("VERIFY_FLEET_DIVERGENCE_EVERY") or \
+                changed("VERIFY_FLEET_PROBATION") or \
+                changed("VERIFY_FLEET_LEDGER") or \
+                changed("VERIFY_FLEET_METRIC_REPLICAS"):
+            from stellar_tpu.crypto import fleet
+            fleet.configure_fleet(
+                enabled=config.VERIFY_FLEET_ENABLED,
+                replicas=config.VERIFY_FLEET_REPLICAS,
+                divergence_every=(
+                    config.VERIFY_FLEET_DIVERGENCE_EVERY),
+                probation=config.VERIFY_FLEET_PROBATION,
+                ledger=config.VERIFY_FLEET_LEDGER,
+                metric_replicas=(
+                    config.VERIFY_FLEET_METRIC_REPLICAS))
+        if config.VERIFY_FLEET_ENABLED:
+            # the fleet replaces the single resident service: its
+            # replicas ARE the services (router-fronted)
+            from stellar_tpu.crypto import fleet
+            fleet.default_fleet()
+        elif config.VERIFY_SERVICE_ENABLED:
             from stellar_tpu.crypto import verify_service
             verify_service.default_service()
         # worker pool active => verify callers are concurrent (overlay

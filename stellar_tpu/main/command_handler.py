@@ -219,6 +219,16 @@ class CommandHandler:
         from stellar_tpu.crypto import verify_service
         return verify_service.control_health()
 
+    def cmd_fleet(self, params):
+        """Replicated-fleet surface (ISSUE 17): per-replica states
+        and counters, the fleet-level exact conservation law
+        (residual must read 0), divergence-conviction evidence and
+        the drain/handoff tallies. Served directly — replica health
+        matters exactly when the node is struggling (same policy as
+        ``slo``/``tenant``/``control``)."""
+        from stellar_tpu.crypto import fleet
+        return fleet.fleet_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -686,6 +696,7 @@ class CommandHandler:
         "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
         "slo": cmd_slo, "tenant": cmd_tenant,
         "control": cmd_control,
+        "fleet": cmd_fleet,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

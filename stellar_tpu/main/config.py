@@ -266,6 +266,24 @@ class Config:
     # bounded control-log / retained-window depth (the replay surface)
     VERIFY_CONTROL_LOG: int = 4096
 
+    # replicated verify fleet (docs/robustness.md "Replicated
+    # fleet"): N active-active VerifyService replicas behind a
+    # deterministic rendezvous-hash router with a standing
+    # divergence detector and zero-loss drain/handoff. Disabled by
+    # default, exactly like the service itself.
+    VERIFY_FLEET_ENABLED: bool = False
+    VERIFY_FLEET_REPLICAS: int = 3
+    # divergence-audit cadence: one full log re-check every N routes
+    VERIFY_FLEET_DIVERGENCE_EVERY: int = 64
+    # routes a convicted replica waits before probation re-admission
+    # (event-count — routing must stay clock-free)
+    VERIFY_FLEET_PROBATION: int = 256
+    # per-replica submission-ledger cap (seq -> (lane, tenant))
+    VERIFY_FLEET_LEDGER: int = 8192
+    # metric-cardinality guard: per-replica gauge series only for the
+    # first N replicas, the rest fold into the `~other` rollup
+    VERIFY_FLEET_METRIC_REPLICAS: int = 8
+
     # history
     HISTORY_ARCHIVES: List[str] = field(default_factory=list)
     # seconds to wait after a checkpoint boundary before publishing

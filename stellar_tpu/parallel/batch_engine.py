@@ -120,7 +120,8 @@ __all__ = [
     "dispatch_health", "configure_dispatch",
     "dispatch_attribution", "phase_attribution", "dispatch_degraded",
     "host_only_mode", "note_shed_onset", "register_service_health",
-    "service_health_snapshot", "served_counts",
+    "service_health_snapshot", "register_fleet_health",
+    "fleet_health_snapshot", "served_counts",
     "trace_ranges", "note_trace_event",
     "RESOLVE_PHASES", "RESOLVE_ROOT", "PHASE_SUFFIXES",
     "DEFAULT_BUCKET_SIZES",
@@ -432,6 +433,28 @@ def service_health_snapshot() -> dict:
     return provider() if provider is not None else {"running": False}
 
 
+_fleet_health_provider: Optional[Callable[[], dict]] = None
+
+
+def register_fleet_health(provider: Optional[Callable[[], dict]]
+                          ) -> None:
+    """Install the replicated fleet's snapshot callable (ISSUE 17) so
+    ``dispatch_health()`` (and the ``fleet`` admin route) carries
+    per-replica states and the fleet conservation law next to the
+    single-service surface. ``None`` unregisters (tests)."""
+    global _fleet_health_provider
+    with _service_lock:
+        _fleet_health_provider = provider
+
+
+def fleet_health_snapshot() -> dict:
+    """The registered fleet's snapshot, or ``{"enabled": False}``
+    when no fleet ever started — shared by ``dispatch_health()`` and
+    the ``fleet`` admin route."""
+    provider = _fleet_health_provider
+    return provider() if provider is not None else {"enabled": False}
+
+
 def note_shed_onset(reason: str) -> None:
     """First-onset load-shed trigger: dump the flight recorder so the
     spans and queue events leading INTO the overload survive to be
@@ -486,6 +509,7 @@ def dispatch_health() -> dict:
         "signer_tables": signer_tables.signer_table_cache.snapshot(),
         "donate_buffers": DONATE_BUFFERS,
         "service": service_health_snapshot(),
+        "fleet": fleet_health_snapshot(),
     }
 
 

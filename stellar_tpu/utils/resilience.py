@@ -65,20 +65,25 @@ class Overloaded(RuntimeError):
     ``"tenant-bytes"``, ``"backlog"``, ...); ``tenant`` names the
     submitting tenant when the verdict was tenant-scoped (ISSUE 14) —
     a per-tenant quota refusal or a tenant-keyed shed is attributable
-    to its principal from the exception alone; ``trace_ids``
+    to its principal from the exception alone; ``replica`` names the
+    refusing fleet replica (ISSUE 17) — in an N-replica deployment a
+    refusal attributes to the replica that issued it (``None`` for a
+    single-service deployment or a router-level refusal); ``trace_ids``
     carries the refused/shed items' trace IDs (ISSUE 8) — an item's
     trace survives even when the answer is "no", so the ``trace``
     admin route can show WHERE a submission died."""
 
     def __init__(self, message: str, *, kind: str = "rejected",
                  lane: Optional[str] = None, reason: str = "",
-                 tenant: Optional[str] = None, trace_ids=None):
+                 tenant: Optional[str] = None, trace_ids=None,
+                 replica: Optional[int] = None):
         super().__init__(message)
         self.kind = kind
         self.lane = lane
         self.reason = reason
         self.tenant = tenant
         self.trace_ids = trace_ids if trace_ids is not None else ()
+        self.replica = replica
 
 
 class Deadline:

@@ -641,6 +641,28 @@ def test_lint_scopes_cover_controller():
     assert set(entry) == {"nondet:clock"}
 
 
+def test_lint_scopes_cover_fleet():
+    """ISSUE 17: the fleet router decides WHICH replica serves every
+    (lane, tenant) key and WHO gets convicted of divergence — both
+    must be pure functions of the submission history (SHA-256
+    rendezvous draws + event-count probation, zero clock/RNG), so
+    fleet.py joins the nondet scope with ZERO allowlist entries; its
+    routing tables, conviction log and conservation counters mutate
+    from submitter threads while admin routes read snapshots, so it
+    joins the lock-lint scope with ZERO allowlist entries too. The
+    fleet surgery (replica stamps, handoff terminal, trace_lo
+    re-submission) must NOT have grown the verify service's
+    pre-existing clock allowlist."""
+    f = "stellar_tpu/crypto/fleet.py"
+    assert f in set(nondet.HOST_ORACLE_FILES)
+    assert f in set(locks.SCOPE)
+    assert f not in nondet.ALLOWLIST._entries
+    assert f not in locks.ALLOWLIST._entries
+    entry = nondet.ALLOWLIST._entries.get(
+        "stellar_tpu/crypto/verify_service.py", {})
+    assert set(entry) == {"nondet:clock"}
+
+
 def test_lint_scopes_cover_batch_engine():
     """ISSUE 7: the workload-agnostic engine owns the jit-bucket cache,
     device-health registry and served-counter RMWs from resolver/pool/

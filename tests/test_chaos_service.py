@@ -279,6 +279,17 @@ def test_tenant_keyed_shed_deterministic_across_replicas():
         svc = vs.VerifyService(verifier=g, lane_depth=256,
                                lane_bytes=10**7, max_batch=2,
                                pipeline_depth=1).start()
+        # park the dispatcher on the gate BEFORE the tenant-tagged
+        # arrivals: one scp submission (never shed) fills the
+        # pipeline, so every shed pass below evaluates the COMPLETE
+        # arrival set — the determinism claim is about arrival
+        # order, not about racing the dispatcher's wakeup against
+        # the submission loop
+        svc.submit(_distinct_items(99), lane="scp")
+        deadline = time.time() + 10
+        while svc.snapshot()["lanes"]["scp"]["queued_submissions"]:
+            assert time.time() < deadline, "dispatcher never parked"
+            time.sleep(0.005)
         tickets = []
         for i in range(20):
             for t in ("gold", "plain", "flood"):
@@ -488,7 +499,7 @@ def test_service_health_rides_dispatch_health_and_route():
     assert health["service"]["conservation_gap"] == 0
     snap = svc.snapshot()
     assert set(snap["totals"]) == {"submitted", "verified", "rejected",
-                                   "shed", "failed"}
+                                   "shed", "failed", "handoff"}
     assert set(snap["knobs"]) == {"lane_depth", "lane_bytes",
                                   "max_batch", "pipeline_depth",
                                   "aging_every"}

@@ -331,6 +331,42 @@ def test_control_decisions_change_is_note_not_fatal():
                    for n in steady["notes"])
 
 
+def test_fleet_conservation_gap_is_hard_zero():
+    """ISSUE 17: the fleet-level conservation residual in a committed
+    capture is a HEAD-only ceiling at exactly 0 — the router must
+    account for every item across replicas even through a mid-run
+    kill. Non-fleet captures skip the row, never fail it."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"fleet.conservation_gap": 2}))
+    assert any(f["path"] == "fleet.conservation_gap"
+               and f["rule"] == "max_abs" for f in out["findings"])
+    ok = sentinel.apply_rules(
+        _record(), _record(**{"fleet.conservation_gap": 0}))
+    assert ok["ok"], ok["findings"]
+    # the base record never ran a fleet: skip with a reason, not fail
+    steady = sentinel.apply_rules(_record(), _record())
+    assert steady["ok"], steady["findings"]
+    assert any(s.get("path") == "fleet.conservation_gap"
+               and s.get("reason") == "missing"
+               for s in steady["skipped"])
+
+
+def test_fleet_convictions_change_is_note_not_fatal():
+    """ISSUE 17: divergence conviction counts legitimately vary with
+    injected-Byzantine scenarios — flagged for review, never fatal."""
+    out = sentinel.apply_rules(
+        _record(**{"fleet.divergence_convictions": 0}),
+        _record(**{"fleet.divergence_convictions": 2}))
+    assert out["ok"], out["findings"]
+    assert any(n["path"] == "fleet.divergence_convictions"
+               for n in out["notes"])
+    steady = sentinel.apply_rules(
+        _record(**{"fleet.divergence_convictions": 1}),
+        _record(**{"fleet.divergence_convictions": 1}))
+    assert not any(n["path"] == "fleet.divergence_convictions"
+                   for n in steady["notes"])
+
+
 def test_unproven_analysis_fails():
     out = sentinel.apply_rules(
         _record(), _record(**{"analysis.overflow_proven": False}))

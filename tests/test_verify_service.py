@@ -48,6 +48,13 @@ def test_overloaded_is_typed_and_carries_context():
     e = resilience.Overloaded("quota", kind="rejected", lane="bulk",
                               reason="tenant-depth", tenant="mallory")
     assert (e.reason, e.tenant) == ("tenant-depth", "mallory")
+    # fleet-attributed refusals (ISSUE 17) name their replica; the
+    # default stays None for single-service deployments and
+    # router-level refusals
+    assert e.replica is None
+    e = resilience.Overloaded("full", kind="rejected", lane="scp",
+                              reason="queue-depth", replica=2)
+    assert e.replica == 2
 
 
 def test_keep_under_shed_content_seeded():

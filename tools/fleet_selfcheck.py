@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Replicated verify fleet self-check (ISSUE 17) — the tier-1
+``FLEET_OK`` gate.
+
+Four phases, one JSON record, exit 0 = every gate passed:
+
+* **chaos fleet soak** — N=3 ``VerifyService`` replicas behind the
+  :class:`~stellar_tpu.crypto.fleet.FleetRouter` on the forced-4-device
+  chaos mesh under tenant + flooder load (the ``tools/soak.py``
+  scenario, ``--replicas 3``). One replica is KILLED mid-run: the
+  drain/handoff protocol must move every queued ticket to a survivor
+  with trace IDs intact, fleet conservation must stay exact, the scp
+  latency burn rate must stay <= 1.0 throughout, and the standing
+  divergence detector must convict NOBODY (no false positives under
+  genuine chaos).
+* **router determinism** — two independently constructed fleets fed
+  the identical submission script with the identical mid-script kill
+  must route every (lane, tenant) key identically and leave
+  BIT-IDENTICAL per-replica decision logs (the replicas never start
+  their dispatcher threads: queues drain through the same
+  ``_shed_pass_locked``/``_collect_locked`` path the service thread
+  runs, so the comparison is thread-timing-free).
+* **Byzantine conviction** — an honest fleet survives its own audit
+  (zero convictions); then ONE decision-log tuple is bit-flipped
+  (wrong replica stamp) and the very next audit must convict exactly
+  that replica: quarantined, breaker OPEN, its key range re-hashed
+  across survivors. After the tuple is restored and the probation
+  window passes, the replica must be re-admitted and promoted.
+* **lint discipline** — ``stellar_tpu/crypto/fleet.py`` sits in BOTH
+  the nondeterminism-lint scope and the lock-discipline scope with NO
+  allowlist entry in either, and both lints run clean: routing is a
+  pure function of the submission history.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from soak import _env_setup  # noqa: E402
+
+EVENTS_PATH = "/tmp/_fleet_selfcheck_events.jsonl"
+# the chaos mesh's scp waits are wall-clock dominated (shared engine,
+# fault injection, breaker recovery) — the burn gate proves the fleet
+# never STARVES scp, with the objective sized for this environment
+CHAOS_SCP_P99_MS = 30_000.0
+
+# the determinism / Byzantine phases route over this key grid (every
+# lane, with and without tenants — enough diversity that all three
+# replicas own keys)
+KEY_GRID = [("bulk", None), ("bulk", "t0"), ("bulk", "t1"),
+            ("bulk", "t2"), ("scp", None), ("scp", "t3"),
+            ("auth", None), ("auth", "t4"), ("bulk", "t5"),
+            ("scp", "t6")]
+
+
+def _items(i: int, n: int = 2):
+    pk = bytes([(i * 31 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"fleet-%d-%d" % (i, k),
+             bytes([(i + k) % 251]) * 16) for k in range(n)]
+
+
+def _never_started_fleet(fleet_mod, vs, n=3, **knobs):
+    """A router over replicas whose dispatcher threads NEVER run —
+    submissions queue, and :func:`_manual_drain` walks the exact
+    dispatch path single-threaded (deterministic by construction)."""
+    svcs = [vs.VerifyService(lane_depth=512, lane_bytes=10 ** 9)
+            for _ in range(n)]
+    for svc in svcs:
+        svc._running = True          # accept submissions, no thread
+    fl = fleet_mod.FleetRouter(services=svcs, **knobs)
+    fl._running = True               # route, no global registration
+    return fl, svcs
+
+
+def _manual_drain(svc) -> None:
+    """Run the service's own shed + collect path to exhaustion under
+    its lock — the single-threaded stand-in for the dispatcher."""
+    with svc._cv:
+        svc._shed_pass_locked()
+        while svc._collect_locked() is not None:
+            pass
+
+
+def chaos_phase(problems: list) -> dict:
+    """The forced-4-device chaos soak with a replicated front end and
+    a mid-run replica kill."""
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import verify_service as vs
+    import soak
+
+    vs.slo_monitor._reset_for_testing()
+    vs.configure_slo(scp_p99_ms=CHAOS_SCP_P99_MS, window=1024)
+    try:
+        rec = soak.run(True, 0.0, False, EVENTS_PATH,
+                       tenants=3, flooder=True, replicas=3)
+    finally:
+        # the soak's fleet registered itself as the health surface;
+        # this process keeps running more phases
+        bv.register_fleet_health(None)
+        bv.register_service_health(None)
+    if not rec["ok"]:
+        problems.append(f"chaos fleet soak failed: {rec['problems']}")
+    fr = rec.get("fleet") or {}
+    if fr.get("killed") is None:
+        problems.append("chaos soak never killed a replica — the "
+                        "drain/handoff protocol went unexercised")
+    if fr.get("convictions", 0) != 0:
+        problems.append(
+            "divergence detector convicted an honest replica under "
+            f"chaos (false positive): {fr}")
+    if fr.get("conservation_gap", 1) != 0:
+        problems.append(
+            f"fleet conservation violated: gap={fr.get('conservation_gap')}")
+    burn = fr.get("max_scp_burn", 1e9)
+    if burn > 1.0:
+        problems.append(
+            f"scp latency burn rate peaked at {burn} > 1.0 — the "
+            "fleet starved the consensus lane")
+    if fr.get("handoffs", 0) != fr.get("handoff_items", -1):
+        problems.append(
+            f"handoff accounting split-brained: router counted "
+            f"{fr.get('handoffs')} items, the kill moved "
+            f"{fr.get('handoff_items')}")
+    return {
+        "soak_ok": rec["ok"],
+        "fleet": fr,
+        "totals": rec["totals"],
+        "scp_p99_ms": rec["lane_latency_ms"]["scp"]["p99_ms"],
+        "bulk_p99_ms": rec["lane_latency_ms"]["bulk"]["p99_ms"],
+    }
+
+
+def _drive(fl, kill_at: int, kill_idx: int, count: int = 96) -> None:
+    """The shared determinism script: ``count`` submissions over the
+    key grid with one mid-script replica kill."""
+    for i in range(count):
+        lane, tenant = KEY_GRID[i % len(KEY_GRID)]
+        fl.submit(_items(i), lane=lane, tenant=tenant)
+        if i == kill_at:
+            fl.kill_replica(kill_idx)
+
+
+def determinism_phase(problems: list) -> dict:
+    """Two independently constructed routers, identical script →
+    identical routing and bit-identical decision logs."""
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import verify_service as vs
+
+    # the shed ladder keys off the GLOBAL dispatch breaker — pin it
+    # closed so both fleets audit the same pressure level
+    bv._breaker.record_success()
+
+    fleets = []
+    for _ in range(2):
+        fl, svcs = _never_started_fleet(fleet_mod, vs)
+        kill_idx = fl.route_of("bulk", "t0")
+        _drive(fl, kill_at=47, kill_idx=kill_idx)
+        for i, svc in enumerate(svcs):
+            if fl.snapshot()["states"][i] != "dead":
+                _manual_drain(svc)
+        fleets.append((fl, svcs))
+    (fa, sa), (fb, sb) = fleets
+
+    routes_a = [fa.route_of(ln, t) for ln, t in KEY_GRID]
+    routes_b = [fb.route_of(ln, t) for ln, t in KEY_GRID]
+    if routes_a != routes_b:
+        problems.append(
+            f"independent routers route differently: {routes_a} vs "
+            f"{routes_b}")
+    logs_equal = True
+    for i, (x, y) in enumerate(zip(sa, sb)):
+        if x.decision_log() != y.decision_log():
+            logs_equal = False
+            problems.append(
+                f"replica {i} decision logs diverge between "
+                "independently constructed fleets")
+    na, nb = fa.snapshot(), fb.snapshot()
+    for key in ("routes", "submitted", "handoffs", "states",
+                "router_refused"):
+        if na[key] != nb[key]:
+            problems.append(
+                f"fleet counter {key!r} diverges: {na[key]} vs "
+                f"{nb[key]}")
+    if na["conservation_gap"] != 0 or nb["conservation_gap"] != 0:
+        problems.append(
+            f"determinism fleets leaked work: gaps "
+            f"{na['conservation_gap']}/{nb['conservation_gap']}")
+    return {
+        "routes": routes_a,
+        "states": na["states"],
+        "handoffs": na["handoffs"],
+        "decisions": [len(s.decision_log()) for s in sa],
+        "bit_identical": logs_equal and routes_a == routes_b,
+    }
+
+
+def byzantine_phase(problems: list) -> dict:
+    """No false positives on an honest fleet; a single bit-flipped
+    decision tuple convicts exactly its replica; probation re-admits
+    it once the evidence is gone."""
+    from stellar_tpu.crypto import batch_verifier as bv
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import verify_service as vs
+
+    bv._breaker.record_success()
+    fl, svcs = _never_started_fleet(
+        fleet_mod, vs, divergence_every=4, probation=16)
+    for i in range(40):
+        lane, tenant = KEY_GRID[i % len(KEY_GRID)]
+        fl.submit(_items(i), lane=lane, tenant=tenant)
+    for svc in svcs:
+        _manual_drain(svc)
+
+    if fl.divergence_check():
+        problems.append("honest fleet convicted a replica — the "
+                        "audit has false positives")
+
+    victim = max(range(len(svcs)),
+                 key=lambda i: len(svcs[i].decision_log()))
+    svc = svcs[victim]
+    with svc._cv:
+        d = svc._decisions[0]
+        svc._decisions[0] = d[:5] + ((victim + 1) % len(svcs),)
+    convicted = fl.divergence_check()
+    snap = fl.snapshot()
+    if [idx for idx, _ev in convicted] != [victim]:
+        problems.append(
+            f"bit-flipped replica {victim} not convicted (got "
+            f"{[i for i, _ in convicted]})")
+    if snap["states"][victim] != "quarantined":
+        problems.append(
+            f"convicted replica not quarantined: {snap['states']}")
+    if snap["per_replica"][victim]["breaker"] != "open":
+        problems.append("convicted replica's breaker not OPEN")
+    rerouted = [fl.route_of(ln, t) for ln, t in KEY_GRID]
+    if victim in rerouted:
+        problems.append(
+            f"quarantined replica {victim} still owns keys: "
+            f"{rerouted}")
+
+    # restore the tuple; once the probation window passes, the next
+    # clean audit must re-admit and promote
+    with svc._cv:
+        svc._decisions[0] = d
+    for i in range(40, 80):
+        lane, tenant = KEY_GRID[i % len(KEY_GRID)]
+        fl.submit(_items(i), lane=lane, tenant=tenant)
+    end = fl.snapshot()
+    if end["states"][victim] != "active":
+        problems.append(
+            f"replica {victim} never re-admitted after probation: "
+            f"{end['states']}")
+    if end["readmissions"] < 1:
+        problems.append("readmission counter never moved")
+    if end["per_replica"][victim]["breaker"] != "closed":
+        problems.append("re-admitted replica's breaker not CLOSED")
+    return {
+        "victim": victim,
+        "evidence": [repr(ev)[:160] for _i, ev in convicted],
+        "states_after_conviction": snap["states"],
+        "states_after_probation": end["states"],
+        "convictions": end["divergence_convictions"],
+        "readmissions": end["readmissions"],
+    }
+
+
+def lint_phase(problems: list) -> dict:
+    """fleet.py is scoped by BOTH lints, allowlisted by NEITHER, and
+    both lints are clean."""
+    from stellar_tpu.analysis import locks, nondet
+    mod = "stellar_tpu/crypto/fleet.py"
+    if mod not in set(nondet.HOST_ORACLE_FILES):
+        problems.append(f"{mod} missing from the nondet lint scope")
+    if mod in nondet.ALLOWLIST._entries:
+        problems.append(
+            f"{mod} grew a nondet allowlist entry — routing must stay "
+            "clock/RNG-free, not excused")
+    if mod not in set(locks.SCOPE):
+        problems.append(f"{mod} missing from the lock lint scope")
+    if mod in locks.ALLOWLIST._entries:
+        problems.append(f"{mod} grew a lock allowlist entry")
+    nrep = nondet.run()
+    if not nrep.ok:
+        problems.append(
+            f"nondet lint not clean: "
+            f"{[f.key for f in nrep.findings][:4]}")
+    lrep = locks.run()
+    if not lrep.ok:
+        problems.append(
+            f"lock lint not clean: "
+            f"{[f.key for f in lrep.findings][:4]}")
+    return {"nondet_ok": nrep.ok, "locks_ok": lrep.ok,
+            "scoped_both": (mod in set(nondet.HOST_ORACLE_FILES)
+                            and mod in set(locks.SCOPE))}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--skip-chaos", action="store_true",
+                    help="host-only phases only (fast local loop)")
+    args = ap.parse_args()
+    _env_setup(False)
+    problems: list = []
+    rec = {}
+    if not args.skip_chaos:
+        rec["chaos"] = chaos_phase(problems)
+    rec["determinism"] = determinism_phase(problems)
+    rec["byzantine"] = byzantine_phase(problems)
+    rec["lints"] = lint_phase(problems)
+    rec["ok"] = not problems
+    rec["problems"] = problems
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

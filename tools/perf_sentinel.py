@@ -163,6 +163,20 @@ RULES = [
     ("service.control.decisions", "note_change", None,
      "closed-loop controller decision count changed (expected to "
      "vary with load; review the control log if surprising)"),
+    # replicated fleet (ISSUE 17): the fleet-level conservation
+    # residual is a HARD zero — every item routed through the
+    # FleetRouter lands in exactly one replica terminal (verified /
+    # rejected / shed / failed / handoff) or the router's own refusal
+    # counter, even through a mid-run replica kill; conviction counts
+    # are note-only because Byzantine-injection scenarios
+    # legitimately vary them between captures.
+    ("fleet.conservation_gap", "max_abs", 0,
+     "fleet conservation residual nonzero — the router lost or "
+     "double-counted work across replicas"),
+    ("fleet.divergence_convictions", "note_change", None,
+     "fleet divergence conviction count changed (expected to vary "
+     "with injected-fault scenarios; review the conviction log if "
+     "surprising)"),
     # pipeline-bubble profiler (ISSUE 10): the async-dispatch PR's
     # before/after numbers. busy_frac down = more device idle per
     # resolve; overlap_frac down = host prep stopped hiding behind

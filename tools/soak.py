@@ -10,7 +10,9 @@ and a mid-run global-breaker trip, then proves the overload story
 end-to-end:
 
 * **work conservation** (the law the tier-1 ``SOAK_OK`` gate pins):
-  ``submitted == verified + rejected + shed`` exactly, ``failed == 0``,
+  ``submitted == verified + rejected + shed [+ handoff]`` exactly,
+  ``failed == 0`` (the ``handoff`` terminal appears only under
+  ``--replicas`` when a killed replica's queue moves to a survivor),
   ``pending == 0`` after drain — no item is ever silently dropped;
 * **metrics accounting**: the service's counters agree with the
   ``crypto.verify.service.*`` meters and the conservation totals
@@ -302,7 +304,7 @@ def run_sha256(smoke: bool, duration_s: float,
 def run(smoke: bool, duration_s: float, corrupt: bool,
         events_path: str, tenants: int = 0,
         flooder: bool = False, ramp: bool = False,
-        signers: str = "pool") -> dict:
+        signers: str = "pool", replicas: int = 0) -> dict:
     import numpy as np
 
     from stellar_tpu.crypto import batch_verifier as bv
@@ -380,14 +382,51 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     # clamps sized to the chaos-mesh shapes (the verifier chunks any
     # grown batch back into its compiled buckets)
     ctl = None
-    if ramp:
-        ctl = ctl_mod.VerifyController(
+    ctls = []
+
+    def _mk_controller():
+        return ctl_mod.VerifyController(
             BUCKET, 2, 0.75, min_batch=2, batch_ceiling=4 * BUCKET,
             max_pipeline_depth=4, hysteresis=2, cooldown=2)
-    svc = vs.VerifyService(
-        verifier=v, lane_depth=24, lane_bytes=2_000_000,
-        max_batch=BUCKET, pipeline_depth=2, aging_every=4,
-        controller=ctl, control_every=4).start()
+
+    # --replicas N (ISSUE 17): the same chaos-mesh scenario, but the
+    # submission front is the deterministic fleet router over N
+    # VerifyService replicas sharing the one engine — the kill below
+    # exercises drain/handoff, the standing divergence detector runs
+    # on its route cadence, and the fleet conservation law must stay
+    # exact through all of it
+    fl = None
+    svc = None
+    if replicas > 0:
+        from stellar_tpu.crypto import fleet as fleet_mod
+        shared = fleet_mod.SharedVerifier(v)
+        svcs = []
+        for i in range(replicas):
+            cl = _mk_controller() if ramp else None
+            if cl is not None:
+                ctls.append(cl)
+            svcs.append(vs.VerifyService(
+                # per-lane depth (ISSUE 17): rendezvous affinity
+                # pins the WHOLE scp key on one replica, so that
+                # replica's scp queue must absorb the full scp burst
+                # while bulk stays shallow enough that the shed
+                # ladder still fires under the flood
+                verifier=shared,
+                lane_depth={"scp": 24 * replicas, "auth": 24,
+                            "bulk": 24},
+                lane_bytes=2_000_000, max_batch=BUCKET,
+                pipeline_depth=2, aging_every=4, controller=cl,
+                control_every=4))
+        fl = fleet_mod.FleetRouter(services=svcs,
+                                   divergence_every=16).start()
+    else:
+        if ramp:
+            ctl = _mk_controller()
+        svc = vs.VerifyService(
+            verifier=v, lane_depth=24, lane_bytes=2_000_000,
+            max_batch=BUCKET, pipeline_depth=2, aging_every=4,
+            controller=ctl, control_every=4).start()
+    front = fl if fl is not None else svc
 
     # the flapping chip: every 2nd dispatch attributed to device 0
     # raises — quarantine, re-shard over survivors, half-open regrow,
@@ -418,7 +457,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             if tenants > 0 and lane == "bulk":
                 tenant = "t%03d" % ((i + offset) % tenants)
             try:
-                tkt = svc.submit(items, lane=lane, tenant=tenant)
+                tkt = front.submit(items, lane=lane, tenant=tenant)
                 with lock:
                     results[lane]["tickets"].append((tkt, exp))
             except vs.Overloaded as e:
@@ -436,7 +475,8 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             with lock:
                 flooder_stats["submitted"] += 1
             try:
-                tkt = svc.submit(items, lane="bulk", tenant="flooder")
+                tkt = front.submit(items, lane="bulk",
+                                   tenant="flooder")
                 with lock:
                     results["bulk"]["tickets"].append((tkt, exp))
             except vs.Overloaded as e:
@@ -446,6 +486,9 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
                     if e.reason.startswith("tenant-"):
                         flooder_stats["quota_rejected"] += 1
 
+    killed_idx = None
+    killed_moved = 0
+    max_scp_burn = 0.0
     flood_rounds = 1 if smoke else max(1, int(duration_s / 3.0))
     if ramp:
         # a midpoint needs at least two rounds; the second half
@@ -473,6 +516,20 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             t.start()
         for t in threads:
             t.join()
+        if fl is not None and killed_idx is None and \
+                rnd >= (flood_rounds - 1) // 2:
+            # kill one replica mid-soak while its queues are loaded:
+            # the drain/handoff protocol must move every queued
+            # ticket to a survivor with trace IDs intact — zero loss
+            ksnap = fl.snapshot()
+            cands = [i for i, stt in enumerate(ksnap["states"])
+                     if stt in ("active", "probation")]
+            if len(cands) > 1:
+                killed_idx = cands[-1]
+                killed_moved = fl.kill_replica(killed_idx,
+                                               stop_timeout=60)
+                event("replica-kill", replica=killed_idx,
+                      handoff_items=killed_moved)
         if not breaker_tripped:
             # mid-run correlated outage: the OPEN global breaker is
             # shed-ladder level 2 (dispatch-degraded) until its
@@ -483,8 +540,10 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         if corrupt and not smoke and rnd == flood_rounds // 2:
             faults.set_fault(faults.RESOLVE, "corrupt-device", 2)
             event("fault", spec="device.resolve=corrupt-device:2")
+        max_scp_burn = max(max_scp_burn, vs.slo_health()[
+            "lanes"]["scp"]["latency"]["burn_rate"])
         event("round", n=rnd,
-              service=svc.snapshot()["totals"])
+              service=front.snapshot()["totals"])
 
     # drain: every outstanding ticket resolves to verified or shed
     mismatches = 0
@@ -501,17 +560,32 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             verified_items += len(got)
             if not (got == exp).all():
                 mismatches += 1
-    svc.stop(drain=True, timeout=60)
+    front.stop(drain=True, timeout=60)
     fault_counters = faults.counters()   # captured BEFORE clear
     faults.clear()
     wall_s = round(time.monotonic() - t_run, 1)
 
-    snap = svc.snapshot()
+    fsnap = None
+    if fl is not None:
+        fsnap = fl.snapshot()
+        lane_counts = {ln: {"shed": 0, "rejected": 0}
+                       for ln in vs.LANES}
+        for s_ in fl.services():
+            rsnap = s_.snapshot()
+            for ln in vs.LANES:
+                for k in lane_counts[ln]:
+                    lane_counts[ln][k] += rsnap["lanes"][ln][k]
+        snap = {"conservation_gap": fsnap["conservation_gap"],
+                "pending_items": fsnap["pending_items"],
+                "totals": fsnap["totals"],
+                "lanes": lane_counts}
+    else:
+        snap = svc.snapshot()
     lanes = vs.lane_latencies()
     totals = snap["totals"]
     meters = {k: registry.meter(f"crypto.verify.service.{k}").count
               for k in ("submitted", "verified", "rejected", "shed",
-                        "failed")}
+                        "failed", "handoff")}
     prom = registry.to_prometheus()
     health = bv.dispatch_health()
     event("final", totals=totals, lanes=lanes, wall_s=wall_s)
@@ -524,8 +598,10 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     if totals["failed"] != 0:
         problems.append(f"failed items: {totals['failed']}")
     if totals["submitted"] != (totals["verified"] + totals["rejected"]
-                               + totals["shed"]):
-        problems.append("submitted != verified + rejected + shed")
+                               + totals["shed"]
+                               + totals.get("handoff", 0)):
+        problems.append(
+            "submitted != verified + rejected + shed + handoff")
     if meters != {k: totals[k] for k in meters}:
         problems.append(
             f"service counters disagree with metrics: {meters} "
@@ -537,10 +613,14 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
     if shed["scp"] or snap["lanes"]["scp"]["shed"] or \
             snap["lanes"]["scp"]["rejected"]:
         problems.append("scp lane was shed/rejected — priority broken")
+    # N replicas share the one engine, so absolute waits scale with
+    # the replica count; lane PRIORITY (the relative gate below) is
+    # what the fleet must preserve (ISSUE 17).
+    scp_bound = SMOKE_SCP_P99_BOUND_MS * max(1, replicas)
     if lanes["scp"]["count"] == 0 or \
-            lanes["scp"]["p99_ms"] > SMOKE_SCP_P99_BOUND_MS:
+            lanes["scp"]["p99_ms"] > scp_bound:
         problems.append(
-            f"scp p99 unbounded: {lanes['scp']}")
+            f"scp p99 unbounded (bound {scp_bound}): {lanes['scp']}")
     if lanes["bulk"]["count"] and \
             lanes["scp"]["p99_ms"] > lanes["bulk"]["p99_ms"]:
         problems.append("scp lane waited longer than bulk at p99")
@@ -589,7 +669,20 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
 
     # ---- ramp scenario record + gates (--ramp) ----
     ramp_rec = None
-    if ramp:
+    if ramp and fl is not None:
+        csnaps = [c.snapshot() for c in ctls]
+        ramp_rec = {
+            "schedule": sched,
+            "windows": sum(c["windows"] for c in csnaps),
+            "moves": sum(c["moves"] for c in csnaps),
+            "knobs": csnaps[0]["knobs"],
+            "log_tail": ctls[0].control_log(limit=16),
+        }
+        if ramp_rec["windows"] == 0:
+            problems.append(
+                "fleet ramp ran but no replica's controller ever "
+                "evaluated a window — the batch-cadence hook is dead")
+    elif ramp:
         csnap = ctl.snapshot()
         ramp_rec = {
             "schedule": sched,
@@ -610,10 +703,62 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             problems.append(
                 "controller replay diverged from the live trajectory")
 
+    # ---- fleet scenario record + gates (--replicas N) ----
+    fleet_rec = None
+    if fl is not None:
+        fleet_rec = {
+            "replicas": replicas,
+            "states": fsnap["states"],
+            "killed": killed_idx,
+            "handoff_items": killed_moved,
+            "handoffs": fsnap["handoffs"],
+            "router_refused": fsnap["router_refused"],
+            "divergence_checks": fsnap["divergence_checks"],
+            "convictions": fsnap["divergence_convictions"],
+            "conservation_gap": fsnap["conservation_gap"],
+            "max_scp_burn": round(max_scp_burn, 4),
+        }
+        if killed_idx is None:
+            problems.append(
+                "fleet soak never killed a replica — the "
+                "drain/handoff path went unexercised")
+        elif fsnap["states"][killed_idx] != "dead":
+            problems.append(
+                f"killed replica {killed_idx} not dead: "
+                f"{fsnap['states']}")
+        if fsnap["divergence_checks"] == 0:
+            problems.append(
+                "fleet divergence detector never ran")
+        if fsnap["divergence_convictions"] != 0:
+            problems.append(
+                "healthy fleet convicted a replica (divergence "
+                f"false positive): {fsnap['conviction_log']}")
+        if fsnap["router_refused"] != 0:
+            problems.append(
+                "router refused submissions while replicas were "
+                f"routable ({fsnap['router_refused']} items)")
+
     # ---- tenant scenario gates (--tenants N [--flooder]) ----
     tenant_rec = None
     if tenants > 0:
-        tsnap = svc.tenant_snapshot()
+        if fl is not None:
+            # per-tenant counters aggregate across replicas — each
+            # replica's own conservation is exact, so the sums are too
+            agg = {}
+            for s_ in fl.services():
+                for t, c in s_.tenant_snapshot()["tenants"].items():
+                    a = agg.setdefault(t, {k: 0 for k in c})
+                    for k, val in c.items():
+                        a[k] += val
+            tsnap = {
+                "tenants": agg,
+                "tracked": len(agg),
+                "conservation_violations": {
+                    t: c["conservation_gap"] for t, c in agg.items()
+                    if c["conservation_gap"] != 0},
+            }
+        else:
+            tsnap = svc.tenant_snapshot()
         tfc = tsnap["tenants"].get("flooder") or {}
         tenant_rec = {
             "tenants": tsnap["tracked"],
@@ -669,6 +814,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "events_path": events_path,
         "tenant": tenant_rec,
         "ramp": ramp_rec,
+        "fleet": fleet_rec,
         "signer_tables": signer_rec,
         "problems": problems,
     }
@@ -718,6 +864,22 @@ def emit_bench_service(rec: dict, path: str) -> None:
             "shed_submissions": rec["shed_submissions"],
         },
     }
+    if rec.get("fleet"):
+        # ISSUE 17 sentinel rows — FLEET windows only: the fleet
+        # conservation residual is a hard zero and conviction counts
+        # are note-only (they legitimately vary with injected
+        # Byzantine scenarios). Absent from non-fleet captures, so
+        # the sentinel skips instead of flaking.
+        cap["fleet"] = {
+            "replicas": rec["fleet"]["replicas"],
+            # magnitude: the sentinel's max_abs rule is a one-sided
+            # ceiling, and a NEGATIVE residual (double-count) is just
+            # as fatal as a positive one (lost work)
+            "conservation_gap": abs(rec["fleet"]["conservation_gap"]),
+            "divergence_convictions": rec["fleet"]["convictions"],
+            "divergence_checks": rec["fleet"]["divergence_checks"],
+            "handoffs": rec["fleet"]["handoffs"],
+        }
     if rec.get("ramp"):
         # ISSUE 15 sentinel rows — CONTROLLER windows only: the scp
         # latency burn ceiling (max_abs 1.0) gates the closed-loop
@@ -761,6 +923,10 @@ def main() -> int:
                          "must absorb its burst — typed rejections/"
                          "sheds, zero failures, per-tenant "
                          "conservation exact")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="front the soak with a FleetRouter over N "
+                         "VerifyService replicas and kill one mid-run "
+                         "(ISSUE 17); 0 = single service")
     ap.add_argument("--ramp", action="store_true",
                     help="double the offered bulk load at the midpoint"
                          " and attach the closed-loop controller "
@@ -811,7 +977,8 @@ def main() -> int:
     else:
         rec = run(args.smoke, args.duration, args.corrupt, events,
                   tenants=args.tenants, flooder=args.flooder,
-                  ramp=args.ramp, signers=args.signers)
+                  ramp=args.ramp, signers=args.signers,
+                  replicas=args.replicas)
     if args.emit_bench_service and args.workload == "verify" \
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)

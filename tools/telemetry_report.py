@@ -65,11 +65,13 @@ def collect_local(top_traces: int = TOP_TRACES) -> dict:
             tracing.flight_recorder.snapshot(limit=256)["recent"],
             top_traces):
         traces.append(tracing.flight_recorder.trace_timeline(tid))
+    from stellar_tpu.crypto import fleet as fleet_mod
     return {
         "slo": vs.slo_health(),
         "service": vs.service_health(),
         "tenant": vs.tenant_health(),
         "control": vs.control_health(),
+        "fleet": fleet_mod.fleet_health(),
         "pipeline": pipeline_timeline.snapshot(limit=4),
         "timeseries": timeseries.snapshot(),
         "transfer": transfer_ledger.totals(),
@@ -91,11 +93,17 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
     for tid in _recent_trace_ids(spans.get("recent", []), top_traces):
         traces.append(get(f"trace?id={tid}"))
     dispatch = get("dispatch")
+    try:
+        fleet = get("fleet")
+    except Exception:
+        # pre-fleet nodes have no such route — report "not deployed"
+        fleet = {"enabled": False}
     return {
         "slo": get("slo"),
         "service": get("service"),
         "tenant": get("tenant"),
         "control": get("control"),
+        "fleet": fleet,
         "pipeline": get("pipeline?limit=4"),
         "timeseries": get("timeseries"),
         "transfer": dispatch.get("transfer", {}),
@@ -235,6 +243,35 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
         else:
             lines.append("No knob moves in the retained tail "
                          f"({len(tail)} hold windows).")
+        lines.append("")
+
+    # ---- replicated fleet ----
+    flt = data.get("fleet") or {}
+    if flt.get("enabled"):
+        lines += ["## Fleet", "",
+                  f"{flt.get('active', 0)}/{flt.get('replicas', 0)} "
+                  f"replicas routable; {flt.get('routes', 0)} routed "
+                  f"submissions, {flt.get('handoffs', 0)} items "
+                  f"handed off, {flt.get('router_refused', 0)} "
+                  f"router-refused; {flt.get('divergence_checks', 0)} "
+                  f"divergence audits, "
+                  f"**{flt.get('divergence_convictions', 0)}** "
+                  f"convictions, {flt.get('readmissions', 0)} "
+                  f"re-admissions; conservation gap "
+                  f"**{flt.get('conservation_gap')}** (must be 0).",
+                  "",
+                  "| replica | state | breaker | routed items "
+                  "| verified | pending | gap |",
+                  "|---|---|---|---|---|---|---|"]
+        for row in flt.get("per_replica") or []:
+            tot = row.get("totals") or {}
+            lines.append(
+                f"| {row.get('replica')} | **{row.get('state')}** "
+                f"| {row.get('breaker')} "
+                f"| {row.get('routed_items', 0)} "
+                f"| {tot.get('verified', 0)} "
+                f"| {row.get('pending_items', 0)} "
+                f"| {row.get('conservation_gap')} |")
         lines.append("")
 
     # ---- pipeline bubbles ----
@@ -403,6 +440,22 @@ def synthetic_window() -> None:
     for t in tickets:
         t.result(timeout=30)
     svc.stop(drain=True, timeout=30)
+    # a three-replica fleet rides the demo window so the default
+    # report also renders the "Fleet" table (ISSUE 17)
+    from stellar_tpu.crypto import fleet as fleet_mod
+    fl = fleet_mod.FleetRouter(verifier=_Instant(), replicas=3,
+                               divergence_every=8).start()
+    fleet_tkts = []
+    for i in range(16):
+        pk = bytes([(i * 19 + j) % 251 + 1 for j in range(32)])
+        items = [(pk, b"fleetdemo-%d-%d" % (i, k),
+                  bytes([(i + k) % 251]) * 64) for k in range(2)]
+        lane = "scp" if i % 4 == 0 else "bulk"
+        tenant = None if lane == "scp" else f"demo{i % 3}"
+        fleet_tkts.append(fl.submit(items, lane=lane, tenant=tenant))
+    for t in fleet_tkts:
+        t.result(timeout=30)
+    fl.stop(drain=True, timeout=30)
     timeseries.sample_once()
 
 

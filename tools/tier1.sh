@@ -109,6 +109,17 @@ timeout -k 10 240 python tools/control_selfcheck.py
 ctrc=$?
 echo CONTROL_OK=$([ "$ctrc" -eq 0 ] && echo 1 || echo 0)
 [ "$ctrc" -ne 0 ] && exit $ctrc
+# Replicated verify fleet (ISSUE 17): N=3 VerifyService replicas
+# behind the deterministic FleetRouter on the forced-4-device chaos
+# mesh under flooder load — one replica killed mid-run with zero lost
+# tickets, fleet conservation exact, scp burn <= 1.0 throughout; two
+# independent routers route bit-identically; a bit-flipped decision
+# log is convicted and quarantined, then re-admitted on probation;
+# fleet.py sits in BOTH lint scopes with no allowlist entry.
+timeout -k 10 560 python tools/fleet_selfcheck.py
+flrc=$?
+echo FLEET_OK=$([ "$flrc" -eq 0 ] && echo 1 || echo 0)
+[ "$flrc" -ne 0 ] && exit $flrc
 # Verify-service soak smoke (ISSUE 6): a short CPU-only overload run
 # of the resident verify service (forced 4-device subprocess,
 # flaky-device:0 injected, audit sampling on, mid-run breaker trip)
