@@ -266,6 +266,15 @@ def _static_analysis(timeout_s: float = 300.0):
         "sha256_golden": sha.get("golden"),
         "lints_ok": all(l.get("ok", False)
                         for l in rec.get("lints", {}).values()),
+        # concurrency + coverage gates (ISSUE 18): a bench number is
+        # no more quotable from a deadlock-prone dispatch tier or an
+        # unproven kernel variant than from a broken envelope
+        "lockorder_ok": rec.get("lints", {}).get(
+            "lockorder", {}).get("ok", False),
+        "proof_coverage_ok": rec.get("proof_coverage", {}).get(
+            "ok", False),
+        "kernels_proven": rec.get("proof_coverage", {}).get(
+            "proven", 0),
     }
 
 

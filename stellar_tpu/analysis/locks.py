@@ -38,7 +38,8 @@ from stellar_tpu.analysis.lint_base import (
     Allowlist, Finding, LintReport, finish_report, repo_root, walk_py,
 )
 
-__all__ = ["run", "lint_source", "SCOPE", "ALLOWLIST"]
+__all__ = ["run", "lint_source", "drift_findings", "SCOPE",
+           "DRIFT_ROOTS", "ALLOWLIST"]
 
 # The threaded modules: verify dispatch, resilience primitives (incl.
 # the watchdog pool), the per-device health registry, the metrics
@@ -78,6 +79,28 @@ SCOPE = [
     "stellar_tpu/parallel/signer_tables.py",
     "stellar_tpu/utils/resilience.py",
     "stellar_tpu/utils/metrics.py",
+    # the background worker pool: pool pointer + mode flag mutate from
+    # app setup, determinism tests and shutdown while crank threads
+    # submit (ISSUE 18 brought it under enforcement and fixed a
+    # shutdown-under-lock hold-and-block)
+    "stellar_tpu/utils/workers.py",
+    # the fault-injection registry: chaos tests arm/disarm points
+    # while every dispatch-path thread consults them
+    "stellar_tpu/utils/faults.py",
+    # the verify-cache + backend selector: seeded from the crank,
+    # read and refilled from every verifying thread
+    "stellar_tpu/crypto/keys.py",
+    # the four native-library loaders share one idiom: a module lock
+    # serializing a one-shot g++ compile-and-dlopen (the lockorder
+    # pass carries the written hold-and-block safety argument)
+    "stellar_tpu/utils/native.py",
+    "stellar_tpu/crypto/native_prep.py",
+    "stellar_tpu/crypto/native_verify.py",
+    "stellar_tpu/soroban/native_wasm.py",
+    # the XDR pack-tree compiler: its RLock serializes the one-time
+    # recursive compile of composite pack trees; the registry and
+    # keepalive caches refill from any encoding thread
+    "stellar_tpu/xdr/runtime.py",
     "stellar_tpu/utils/tracing.py",
     "stellar_tpu/utils/transfer_ledger.py",
     # the pipeline-bubble profiler's tokens/ring mutate from
@@ -108,7 +131,31 @@ def _expr_calls(node: ast.AST):
                 if isinstance(n, ast.Call):
                     yield n
 
+# Where the scope-drift meta-lint looks for lock constructors that
+# escaped SCOPE: the whole shipped package. tools/ scripts are opted in
+# by listing them in SCOPE explicitly (device_watch.py is).
+DRIFT_ROOTS = ["stellar_tpu"]
+
 ALLOWLIST = Allowlist({
+    "stellar_tpu/main/command_handler.py": {
+        "scope-drift:lock-ctor":
+            "QueryServer's BoundedSemaphore is a concurrency "
+            "throttle bounding in-flight ledger-entry queries "
+            "(reference QUERY_THREAD_POOL_SIZE), not a guard over "
+            "shared mutable state — there is no attribute the "
+            "mutation lint could bind it to, and the handler tier's "
+            "shared state lives behind module locks already in SCOPE.",
+    },
+    "stellar_tpu/utils/timer.py": {
+        "scope-drift:lock-ctor":
+            "VirtualClock is single-threaded by crank discipline: "
+            "every mutation happens on the crank thread, and its one "
+            "lock guards only the cross-thread post_to_main queue "
+            "(posts under lock, crank drains under lock). The "
+            "mutation lint's every-attr-under-lock contract does not "
+            "describe this design, so the module stays out of SCOPE "
+            "with this written argument instead.",
+    },
     "stellar_tpu/parallel/batch_engine.py": {
         "unlocked-global:configure_dispatch.DEADLINE_MS":
             "single atomic store of an immutable float (no "
@@ -395,6 +442,38 @@ def lint_source(src: str, rel: str) -> List[Finding]:
     return findings
 
 
+def drift_findings(scope: Optional[List[str]] = None,
+                   roots: Optional[List[str]] = None) -> List[Finding]:
+    """Scope-drift meta-lint: a module under ``stellar_tpu/`` that
+    constructs a ``threading`` lock but is absent from :data:`SCOPE`
+    escapes both the mutation lint and the lock-order prover — new
+    threaded files can no longer do that silently. One finding per
+    offending module, at its first lock constructor."""
+    scoped = set(SCOPE if scope is None else scope)
+    root = repo_root()
+    out: List[Finding] = []
+    for path in walk_py(roots or DRIFT_ROOTS, root):
+        rel = str(path.relative_to(root))
+        if rel in scoped:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:  # pragma: no cover - tree is parseable
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    _is_lock_ctor(node.value):
+                out.append(Finding(
+                    file=rel, line=node.lineno, rule="scope-drift",
+                    symbol="lock-ctor",
+                    message="module constructs a threading lock but "
+                            "is not in locks.SCOPE — add it (mutation "
+                            "lint + lock-order prover) or write a "
+                            "safety argument in locks.ALLOWLIST"))
+                break
+    return out
+
+
 def run(allowlist: Optional[Allowlist] = None) -> LintReport:
     allowlist = allowlist or ALLOWLIST
     root = repo_root()
@@ -404,4 +483,5 @@ def run(allowlist: Optional[Allowlist] = None) -> LintReport:
         rel = str(path.relative_to(root))
         files += 1
         findings.extend(lint_source(path.read_text(), rel))
+    findings.extend(drift_findings())
     return finish_report("locks", files, findings, allowlist)

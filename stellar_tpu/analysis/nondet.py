@@ -27,8 +27,9 @@ from stellar_tpu.analysis.lint_base import (
 # truncate the line before a banned call that follows it
 from stellar_tpu.utils.toml_compat import _strip_comment
 
-__all__ = ["run", "lint_source", "CONSENSUS_DIRS", "HOST_ORACLE_FILES",
-           "ALLOWLIST", "BANNED", "TRACING_SANCTIONED"]
+__all__ = ["run", "lint_source", "drift_findings", "CONSENSUS_DIRS",
+           "HOST_ORACLE_FILES", "DRIFT_ROOTS", "ALLOWLIST", "BANNED",
+           "TRACING_SANCTIONED"]
 
 # packages whose behavior must be bit-identical across nodes
 CONSENSUS_DIRS = ["stellar_tpu/scp", "stellar_tpu/ledger",
@@ -100,6 +101,16 @@ HOST_ORACLE_FILES = [
     # differential suite), but the hot/cold split must still replay
     # identically or replicas' ledgers and audits drift apart
     "stellar_tpu/parallel/signer_tables.py",
+    # the trickle batcher + verify collector (ISSUE 18 scope-drift
+    # sweep): composes the reference oracle, native prep and the
+    # signer-table partitioner into batch verdicts — its one clock
+    # (trickle window pacing) decides WHEN a batch dispatches, never
+    # what any row's verdict is (allowlisted below)
+    "stellar_tpu/crypto/batch_verifier.py",
+    # transport sealed boxes over curve25519 (ISSUE 18 scope-drift
+    # sweep): pure HSalsa/HMAC composition, zero clock/RNG reads of
+    # its own — NO allowlist entry (pinned in test_analysis.py)
+    "stellar_tpu/crypto/nacl_box.py",
     "stellar_tpu/crypto/ed25519_ref.py",
     "stellar_tpu/crypto/curve25519.py",
     "stellar_tpu/crypto/keys.py",
@@ -272,6 +283,22 @@ ALLOWLIST = Allowlist({
             "the same bools, so a clock-driven bypass can never "
             "diverge replicas' consensus state.",
     },
+    "stellar_tpu/crypto/batch_verifier.py": {
+        "nondet:clock":
+            "time.perf_counter() pairs pace the trickle-batch "
+            "window (how long the leader waits for co-riders before "
+            "dispatching) — the clock decides WHEN a batch goes to "
+            "the device, never WHAT any row's verdict is: verdicts "
+            "come from the device kernel or the host oracle, both "
+            "pinned bit-identical by the differential gates, so "
+            "window jitter can only move latency, not decisions.",
+        "nondet:tracing-import":
+            "the verify collector is an instrumentation owner like "
+            "batch_engine: it opens collection/dispatch spans and "
+            "notes trace events for the flight recorder — durations "
+            "land in observability records only; verdict composition "
+            "reads device/oracle bits, never a span reading.",
+    },
     "stellar_tpu/parallel/batch_engine.py": {
         "nondet:clock":
             "time.monotonic() ages the device-probe thread (overdue "
@@ -323,6 +350,59 @@ def lint_source(src: str, rel: str) -> List[Finding]:
     return _lint_lines(src, rel) + _lint_tracing_imports(src, rel)
 
 
+# Where the scope-drift meta-lint looks: the host-oracle package
+# itself. A crypto module that composes other host-oracle modules is
+# part of the oracle and must be scoped; importers OUTSIDE the package
+# (overlay auth, tx validation) consume verdicts, they don't produce
+# them, so they stay out of this rule.
+DRIFT_ROOTS = ["stellar_tpu/crypto"]
+
+_ORACLE_IMPORT = re.compile(
+    r"^\s*(?:from\s+stellar_tpu\.crypto\s+import\s+(?P<names>.+)|"
+    r"(?:from\s+)?(?:import\s+)?stellar_tpu\.crypto\.(?P<dotted>\w+))")
+
+
+def drift_findings(scope: Optional[List[str]] = None) -> List[Finding]:
+    """Scope-drift meta-lint: a module in ``stellar_tpu/crypto`` that
+    imports a host-oracle crypto module but is itself absent from
+    :data:`HOST_ORACLE_FILES` composes oracle primitives outside the
+    nondeterminism fence — new crypto files can no longer silently
+    escape the lint. One finding per offending module, at its first
+    oracle import."""
+    scoped = set(HOST_ORACLE_FILES if scope is None else scope)
+    oracle_stems = {f.rsplit("/", 1)[-1][:-3] for f in scoped
+                    if f.startswith("stellar_tpu/crypto/")}
+    root = repo_root()
+    out: List[Finding] = []
+    for path in walk_py(DRIFT_ROOTS, root):
+        rel = str(path.relative_to(root))
+        if rel in scoped:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), 1):
+            m = _ORACLE_IMPORT.match(_strip_comment(line))
+            if not m:
+                continue
+            if m.group("dotted"):
+                names = [m.group("dotted")]
+            else:
+                names = [tok.split(" as ")[0].strip() for tok in
+                         m.group("names").split(",")]
+            hit = sorted(set(names) & oracle_stems)
+            if hit:
+                out.append(Finding(
+                    file=rel, line=lineno, rule="scope-drift",
+                    symbol="host-oracle-import",
+                    message=f"imports host-oracle module(s) {hit} "
+                            "but is not in nondet.HOST_ORACLE_FILES "
+                            "— add it (with written allowlist "
+                            "arguments for any clock/RNG use) so new "
+                            "crypto composition stays inside the "
+                            "nondeterminism fence"))
+                break
+    return out
+
+
 def run(allowlist: Optional[Allowlist] = None) -> LintReport:
     allowlist = allowlist or ALLOWLIST
     root = repo_root()
@@ -334,4 +414,5 @@ def run(allowlist: Optional[Allowlist] = None) -> LintReport:
         text = path.read_text()
         findings.extend(_lint_lines(text, rel))
         findings.extend(_lint_tracing_imports(text, rel))
+    findings.extend(drift_findings())
     return finish_report("nondet", files, findings, allowlist)

@@ -146,7 +146,8 @@ def set_verifier_backend(fn: Optional[Callable[[bytes, bytes, bytes], bool]]):
     """Install a verify backend (pk, msg, sig) -> bool; None restores the
     pure-Python oracle. The result cache stays in front either way."""
     global _backend
-    _backend = fn
+    with _cache_lock:
+        _backend = fn
 
 
 def accelerated_verify_available() -> bool:

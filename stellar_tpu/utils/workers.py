@@ -41,7 +41,8 @@ def set_background(enabled: bool) -> None:
     """Toggle background execution (False = run submissions inline;
     used by determinism tests and the ARTIFICIALLY_* config knobs)."""
     global _background
-    _background = enabled
+    with _lock:
+        _background = enabled
 
 
 def background_enabled() -> bool:
@@ -64,6 +65,8 @@ def run_async(fn: Callable, *args) -> Future:
 def shutdown() -> None:
     global _pool
     with _lock:
-        if _pool is not None:
-            _pool.shutdown(wait=True)
-            _pool = None
+        pool, _pool = _pool, None
+    if pool is not None:
+        # outside _lock: waiting for in-flight work while holding the
+        # submission lock would wedge any concurrent run_async caller
+        pool.shutdown(wait=True)

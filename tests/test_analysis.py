@@ -26,11 +26,15 @@ import textwrap
 import numpy as np
 import pytest
 
-from stellar_tpu.analysis import hotpath, locks, nondet, overflow
+from stellar_tpu.analysis import (
+    coverage, hotpath, lockorder, locks, nondet, overflow,
+)
 from stellar_tpu.analysis.intervals import (
     AbsVal, IntervalInterpreter, Unsupported,
 )
-from stellar_tpu.analysis.lint_base import Allowlist, repo_root
+from stellar_tpu.analysis.lint_base import (
+    Allowlist, finish_report, repo_root,
+)
 
 
 # ---------------- interval-domain units ----------------
@@ -826,3 +830,435 @@ def test_nondet_bans_perf_counter_in_consensus():
     consensus code could read the one clock tracing uses."""
     flagged = nondet.lint_source("t0 = time.perf_counter()\n", "x.py")
     assert any(f.symbol == "clock" for f in flagged)
+
+
+# ---------------- lock-order prover (ISSUE 18) ----------------
+
+
+_CYCLE_A = textwrap.dedent("""
+    import threading
+    import modb
+    _la = threading.Lock()
+
+    def fa():
+        with _la:
+            modb.fb()
+
+    def fa2():
+        with _la:
+            pass
+""")
+
+_CYCLE_B = textwrap.dedent("""
+    import threading
+    import moda
+    _lb = threading.Lock()
+
+    def fb():
+        with _lb:
+            pass
+
+    def fb2():
+        with _lb:
+            moda.fa2()
+""")
+
+
+def test_lockorder_synthetic_two_module_cycle_caught():
+    """The acceptance fixture: moda holds _la and calls into modb
+    (acquiring _lb); modb holds _lb and calls back into moda
+    (acquiring _la). Both acquisition paths must be printed, and a
+    report built from the findings must fail (exit nonzero through
+    tools/analyze.py)."""
+    findings, graph = lockorder.run_sources(
+        {"moda.py": _CYCLE_A, "modb.py": _CYCLE_B})
+    cycles = [f for f in findings if f.rule == "lock-cycle"]
+    assert len(cycles) == 1, [f.key for f in findings]
+    msg = cycles[0].message
+    assert "moda._la -> modb._lb" in msg  # path one
+    assert "modb._lb -> moda._la" in msg  # path two
+    assert "calls fb" in msg and "calls fa2" in msg
+    assert graph["edges"]["moda._la"] == ["modb._lb"]
+    assert graph["edges"]["modb._lb"] == ["moda._la"]
+    rep = finish_report("lockorder", 2, findings, Allowlist({}))
+    assert not rep.ok  # what makes analyze.py exit nonzero
+
+
+def test_lockorder_cycle_free_graph_passes():
+    """Same two modules, one acquisition direction only: edges exist,
+    no cycle, no findings."""
+    b_one_way = _CYCLE_B.replace("moda.fa2()", "pass")
+    findings, graph = lockorder.run_sources(
+        {"moda.py": _CYCLE_A, "modb.py": b_one_way})
+    assert findings == []
+    assert graph["edges"]["moda._la"] == ["modb._lb"]
+    assert "modb._lb" not in graph["edges"]
+
+
+def test_lockorder_hold_and_block_through_helper_hop():
+    """A blocking op reached through a helper-function hop while a
+    lock is held must be attributed to the lock-holding caller, with
+    the call path in the message."""
+    src = textwrap.dedent("""
+        import threading
+        import time
+        _l = threading.Lock()
+
+        def helper():
+            time.sleep(1.0)
+
+        def outer():
+            with _l:
+                helper()
+    """)
+    findings, _ = lockorder.run_sources({"mod.py": src})
+    assert [f.key for f in findings] == \
+        ["hold-and-block:outer.helper.sleep"]
+    assert "mod.py:helper" in findings[0].message
+    # the helper alone (no lock held anywhere) is clean
+    clean, _ = lockorder.run_sources({"mod.py": src.replace(
+        "with _l:\n        helper()", "helper()")})
+    assert clean == []
+
+
+def test_lockorder_inverted_order_mutation_caught():
+    """Mutation test against a vacuous pass: a test double acquiring
+    A->B in one method and B->A in another must produce a lock-cycle
+    finding — if this double ever passes, the prover is broken."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    findings, _ = lockorder.run_sources({"pair.py": src})
+    cycles = [f for f in findings if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert "pair.Pair._a" in cycles[0].message
+    assert "pair.Pair._b" in cycles[0].message
+    rep = finish_report("lockorder", 1, findings, Allowlist({}))
+    assert not rep.ok
+    # fixing one direction clears it
+    fixed = src.replace("with self._b:\n            with self._a:",
+                        "with self._a:\n            with self._b:")
+    clean, _ = lockorder.run_sources({"pair.py": fixed})
+    assert not [f for f in clean if f.rule == "lock-cycle"]
+
+
+def test_lockorder_untimed_wait_flagged_timed_ok():
+    """cv.wait() without a timeout is an unbounded park (the
+    WatchdogPool allowlist entry's exact shape); cv.wait(0.05) is
+    bounded and clean — and untimed join/Queue.get follow suit."""
+    src = textwrap.dedent("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def poll(self):
+                with self._cv:
+                    self._cv.wait(0.05)
+    """)
+    findings, _ = lockorder.run_sources({"w.py": src})
+    assert [f.key for f in findings] == \
+        ["hold-and-block:W.park.wait-untimed"]
+    src2 = textwrap.dedent("""
+        import threading
+        _l = threading.Lock()
+
+        def drain(q, t):
+            with _l:
+                q.get()
+                t.join()
+
+        def drain_bounded(q, t):
+            with _l:
+                q.get(timeout=1.0)
+                t.join(1.0)
+    """)
+    findings2, _ = lockorder.run_sources({"m.py": src2})
+    assert sorted(f.key for f in findings2) == [
+        "hold-and-block:drain.join-untimed",
+        "hold-and-block:drain.queue-get"]
+
+
+def test_lockorder_deferred_closures_not_attributed():
+    """A closure defined under a lock runs later, possibly outside
+    it — its body must not be charged to the lock holder (the same
+    lexical convention the locks lint encodes)."""
+    src = textwrap.dedent("""
+        import threading
+        import time
+        _l = threading.Lock()
+
+        def schedule(pool):
+            with _l:
+                def later():
+                    time.sleep(1.0)
+                pool.submit(later)
+    """)
+    findings, _ = lockorder.run_sources({"m.py": src})
+    assert findings == []
+
+
+def test_lockorder_clean_on_tree():
+    rep = lockorder.run()
+    assert rep.ok, "\n" + rep.describe()
+
+
+def test_lockorder_graph_covers_scope():
+    """Acceptance: the acquisition graph covers every module in
+    locks.SCOPE — a SCOPE entry the prover cannot parse would
+    silently shrink coverage."""
+    graph = lockorder.build_graph()
+    assert set(graph["modules"]) == set(locks.SCOPE)
+    # the known seams are live: the fleet router reaches the service
+    # cv, and the service cv reaches the SLO/tenant/metrics tier
+    edges = graph["edges"]
+    assert "verify_service.VerifyService._cv" in \
+        edges["fleet.FleetRouter._lock"]
+    assert "metrics.MetricsRegistry._lock" in \
+        edges["verify_service.VerifyService._cv"]
+
+
+def test_lockorder_allowlist_pinned():
+    """Every hold-and-block allowlist entry is a written safety
+    argument over exactly the expected parks: the watchdog pool's
+    idle wait and the four one-shot native compile locks. Anything
+    new must argue its case here."""
+    entries = {rel: sorted(keys)
+               for rel, keys in lockorder.ALLOWLIST._entries.items()}
+    assert entries == {
+        "stellar_tpu/utils/resilience.py":
+            ["hold-and-block:WatchdogPool._loop.wait-untimed"],
+        "stellar_tpu/utils/native.py":
+            ["hold-and-block:_load.subprocess"],
+        "stellar_tpu/crypto/native_prep.py":
+            ["hold-and-block:_load.subprocess"],
+        "stellar_tpu/crypto/native_verify.py":
+            ["hold-and-block:_load._build_lib.subprocess"],
+        "stellar_tpu/soroban/native_wasm.py":
+            ["hold-and-block:_load._build_lib.subprocess",
+             "hold-and-block:_load_ext._build_lib.subprocess"],
+    }
+
+
+def test_workers_shutdown_regression():
+    """The real finding ISSUE 18's prover surfaced: workers.shutdown()
+    used to run pool.shutdown(wait=True) UNDER the submission lock
+    (wedging any concurrent run_async), and set_background stored its
+    global without the lock. The old spellings must trip the lints;
+    the shipped module must be clean."""
+    old = textwrap.dedent("""
+        import threading
+        _pool = None
+        _lock = threading.Lock()
+        _background = True
+
+        def set_background(enabled):
+            global _background
+            _background = enabled
+
+        def shutdown():
+            global _pool
+            with _lock:
+                if _pool is not None:
+                    _pool.shutdown(wait=True)
+                    _pool = None
+    """)
+    held, _ = lockorder.run_sources({"workers.py": old})
+    assert "hold-and-block:shutdown.executor-shutdown" in \
+        [f.key for f in held]
+    assert "unlocked-global:set_background._background" in \
+        [f.key for f in locks.lint_source(old, "workers.py")]
+    rel = "stellar_tpu/utils/workers.py"
+    shipped = (repo_root() / rel).read_text()
+    assert locks.lint_source(shipped, rel) == []
+    fixed, _ = lockorder.run_sources({rel: shipped})
+    assert fixed == []
+
+
+# ---------------- scope-drift meta-lint (ISSUE 18) ----------------
+
+
+def test_scope_drift_catches_unscoped_lock_owner():
+    """Removing a lock-owning module from locks.SCOPE must produce a
+    scope-drift finding — new threaded files can no longer silently
+    escape the mutation lint and the lock-order prover."""
+    pruned = [s for s in locks.SCOPE
+              if not s.endswith("workers.py")]
+    hits = [f for f in locks.drift_findings(scope=pruned)
+            if f.file == "stellar_tpu/utils/workers.py"]
+    assert len(hits) == 1
+    assert hits[0].key == "scope-drift:lock-ctor"
+    # the real tree's only unscoped lock owners are the two argued
+    # allowlist entries (crank-disciplined VirtualClock, the query
+    # throttle semaphore)
+    assert sorted({f.file for f in locks.drift_findings()}) == [
+        "stellar_tpu/main/command_handler.py",
+        "stellar_tpu/utils/timer.py"]
+
+
+def test_nondet_scope_drift_catches_oracle_composition():
+    """A crypto module importing host-oracle modules while absent
+    from HOST_ORACLE_FILES is a finding (batch_verifier.py is the
+    module that made this rule necessary); the shipped tree is
+    drift-free."""
+    pruned = [s for s in nondet.HOST_ORACLE_FILES
+              if not s.endswith("batch_verifier.py")]
+    hits = [f for f in nondet.drift_findings(scope=pruned)
+            if f.file == "stellar_tpu/crypto/batch_verifier.py"]
+    assert len(hits) == 1
+    assert hits[0].key == "scope-drift:host-oracle-import"
+    assert nondet.drift_findings() == []
+
+
+def test_scope_sets_pinned():
+    """The ISSUE 18 pin: both scope sets, exactly. Growing either is
+    routine (add the file + this pin moves with it); SHRINKING either
+    must be a loud, reviewed act — scope removal is how a lint dies
+    in place."""
+    assert sorted(locks.SCOPE) == sorted([
+        "stellar_tpu/crypto/batch_verifier.py",
+        "stellar_tpu/crypto/batch_hasher.py",
+        "stellar_tpu/crypto/verify_service.py",
+        "stellar_tpu/crypto/tenant.py",
+        "stellar_tpu/crypto/controller.py",
+        "stellar_tpu/crypto/fleet.py",
+        "stellar_tpu/crypto/keys.py",
+        "stellar_tpu/crypto/native_prep.py",
+        "stellar_tpu/crypto/native_verify.py",
+        "stellar_tpu/parallel/batch_engine.py",
+        "stellar_tpu/parallel/device_health.py",
+        "stellar_tpu/parallel/residency.py",
+        "stellar_tpu/parallel/signer_tables.py",
+        "stellar_tpu/soroban/native_wasm.py",
+        "stellar_tpu/utils/faults.py",
+        "stellar_tpu/utils/metrics.py",
+        "stellar_tpu/utils/native.py",
+        "stellar_tpu/utils/resilience.py",
+        "stellar_tpu/utils/tracing.py",
+        "stellar_tpu/utils/transfer_ledger.py",
+        "stellar_tpu/utils/timeline.py",
+        "stellar_tpu/utils/workers.py",
+        "stellar_tpu/xdr/runtime.py",
+        "tools/device_watch.py",
+    ])
+    crypto_scope = {f for f in nondet.HOST_ORACLE_FILES
+                    if f.startswith("stellar_tpu/crypto/")}
+    assert crypto_scope == {
+        "stellar_tpu/crypto/audit.py",
+        "stellar_tpu/crypto/batch_hasher.py",
+        "stellar_tpu/crypto/batch_verifier.py",
+        "stellar_tpu/crypto/bls12_381.py",
+        "stellar_tpu/crypto/controller.py",
+        "stellar_tpu/crypto/curve25519.py",
+        "stellar_tpu/crypto/ed25519_ref.py",
+        "stellar_tpu/crypto/fleet.py",
+        "stellar_tpu/crypto/h2c.py",
+        "stellar_tpu/crypto/keccak.py",
+        "stellar_tpu/crypto/keys.py",
+        "stellar_tpu/crypto/nacl_box.py",
+        "stellar_tpu/crypto/native_prep.py",
+        "stellar_tpu/crypto/native_verify.py",
+        "stellar_tpu/crypto/secp256.py",
+        "stellar_tpu/crypto/sha.py",
+        "stellar_tpu/crypto/shorthash.py",
+        "stellar_tpu/crypto/strkey.py",
+        "stellar_tpu/crypto/verify_service.py",
+        "stellar_tpu/crypto/tenant.py",
+    }
+    # nacl_box composes curve25519 with zero clock/RNG of its own:
+    # scoped, NO allowlist entry
+    assert "stellar_tpu/crypto/nacl_box.py" not in \
+        nondet.ALLOWLIST._entries
+
+
+# ---------------- proof-coverage gate (ISSUE 18) ----------------
+
+
+def test_proof_coverage_clean_on_tree():
+    """Every registered kernel variant (cold, hot, sha256) maps to a
+    proven envelope stage in a committed golden."""
+    cov = coverage.run()
+    assert cov["ok"], cov
+    assert cov["proven"] == 3
+    assert {k["class"] for k in cov["kernels"]} >= {
+        "Ed25519Workload", "Ed25519HotWorkload", "Sha256Workload"}
+    assert all(k["proven"] for k in cov["kernels"])
+
+
+def test_proof_coverage_ignores_test_fixture_workloads():
+    """Workload subclasses defined outside the stellar_tpu package
+    (test fixtures, scratch scripts) are not dispatchable variants and
+    must not leak into the gate via ``__subclasses__()``."""
+    from stellar_tpu.parallel import batch_engine
+
+    class _FixtureWorkload(batch_engine.Workload):  # noqa: unused
+        metrics_ns = "test.fixture"
+        variant_name = None
+
+    names = {c for _ns, _v, c in coverage.enumerate_kernels()}
+    assert "_FixtureWorkload" not in names
+    assert coverage.run()["ok"]
+
+
+def test_proof_coverage_unmapped_variant_fails():
+    """A future Workload plugin with no PROOF_STAGES mapping (the
+    ROADMAP's BLS/MSM shape) must fail the gate."""
+    findings, rows = coverage.check(
+        [("crypto.bls", "msm", "BlsMsmWorkload")], {})
+    assert [f.key for f in findings] == \
+        ["proof-coverage:crypto.bls:msm"]
+    assert rows[0]["proven"] is False
+    rep = finish_report("proof_coverage", 1, findings, Allowlist({}))
+    assert not rep.ok
+
+
+def test_proof_coverage_missing_stage_fails():
+    """A mapped variant whose committed golden lacks the proven stage
+    (the forgot-to-rerun---write-golden shape) must fail too."""
+    stages = {("crypto.verify", None):
+              ("docs/limb_bounds.json", "kernel_total")}
+    goldens = {"docs/limb_bounds.json": {"stages": {}}}
+    findings, rows = coverage.check(
+        [("crypto.verify", None, "Ed25519Workload")], goldens,
+        proof_stages=stages)
+    assert [f.key for f in findings] == \
+        ["proof-coverage:crypto.verify:cold"]
+    assert not rows[0]["proven"]
+    # with the stage present and enveloped, it proves
+    goldens = {"docs/limb_bounds.json":
+               {"stages": {"kernel_total": {"max_abs": 7}}}}
+    findings, rows = coverage.check(
+        [("crypto.verify", None, "Ed25519Workload")], goldens,
+        proof_stages=stages)
+    assert findings == [] and rows[0]["proven"]
+
+
+def test_stale_allowlist_fails_every_family():
+    """The ISSUE 18 sweep: a stale allowlist entry FAILS a report
+    (rep.ok False -> analyze.py exits nonzero) for every lint family,
+    not just warns."""
+    stale = Allowlist({"ghost.py": {"rule:gone": "a written reason "
+                                    "for code that no longer exists"}})
+    rep = finish_report("locks", 1, [], stale)
+    assert rep.stale_allowlist == ["ghost.py:rule:gone"]
+    assert not rep.ok

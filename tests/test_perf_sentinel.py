@@ -42,7 +42,9 @@ def _record(**over):
         "analysis": {"ok": True, "overflow_proven": True,
                      "sha256_overflow_proven": True, "lints_ok": True,
                      "envelope_sha256": "aaaa",
-                     "sha256_envelope": "bbbb"},
+                     "sha256_envelope": "bbbb",
+                     "lockorder_ok": True,
+                     "proof_coverage_ok": True},
         "dispatch_attribution": {"coverage": 0.999},
         "transfer_ledger": {"reconciliation": 1.0, "round_trips": 7,
                             "redundancy_frac": 0.5,
@@ -372,6 +374,20 @@ def test_unproven_analysis_fails():
         _record(), _record(**{"analysis.overflow_proven": False}))
     assert any(f["path"] == "analysis.overflow_proven"
                for f in out["findings"])
+
+
+def test_lockorder_and_proof_coverage_required():
+    """ISSUE 18: the concurrency + coverage gates are require_true
+    rows — a record measured on a deadlock-prone dispatch tier or
+    with an unproven kernel variant is not quotable."""
+    for path in ("analysis.lockorder_ok",
+                 "analysis.proof_coverage_ok"):
+        out = sentinel.apply_rules(
+            _record(), _record(**{path: False}))
+        assert any(f["path"] == path and f["rule"] == "require_true"
+                   for f in out["findings"]), path
+    ok = sentinel.apply_rules(_record(), _record())
+    assert ok["ok"], ok["findings"]
 
 
 def test_envelope_change_is_note_not_fatal():

@@ -1,4 +1,6 @@
-"""Static analysis gate: overflow prover + hot-path/lock/nondet lints.
+"""Static analysis gate: overflow prover, hot-path/lock/nondet lints,
+the whole-program lock-order prover, and the kernel proof-coverage
+gate.
 
 Runs the full ``stellar_tpu.analysis`` suite and exits nonzero on ANY
 open finding — wired into ``tools/tier1.sh`` after the pytest gate so
@@ -41,9 +43,18 @@ def _force_cpu():
 
 
 def run_lints() -> dict:
-    from stellar_tpu.analysis import hotpath, locks, nondet
+    from stellar_tpu.analysis import hotpath, lockorder, locks, nondet
     return {rep.name: rep.to_dict()
-            for rep in (hotpath.run(), locks.run(), nondet.run())}
+            for rep in (hotpath.run(), locks.run(), nondet.run(),
+                        lockorder.run())}
+
+
+def run_proof_coverage() -> dict:
+    """Kernel proof-coverage gate: every registered Workload variant
+    must map to a proven envelope stage in a committed golden."""
+    _force_cpu()  # enumerating kernels imports the engine (jax)
+    from stellar_tpu.analysis import coverage
+    return coverage.run()
 
 
 def _check_golden(rec: dict, golden, path: str) -> dict:
@@ -113,6 +124,10 @@ def main(argv) -> int:
         lints = run_lints()
         out["lints"] = lints
         out["ok"] &= all(rep["ok"] for rep in lints.values())
+    if not lint_only and not overflow_only:
+        cov = run_proof_coverage()
+        out["proof_coverage"] = cov
+        out["ok"] &= cov["ok"]
     if not lint_only:
         for key, rec, path in (
                 ("overflow", run_overflow(buckets), GOLDEN_PATH),
@@ -162,6 +177,24 @@ def _pretty(out: dict) -> None:
         for d in ov.get("golden_diff", [])[:20]:
             print(f"    golden: {d}")
         print(f"    envelope_sha256={ov.get('envelope_sha256')}")
+    cov = out.get("proof_coverage")
+    if cov:
+        status = "ok" if cov["ok"] else "FAIL"
+        print(f"[{status}] proof-coverage  "
+              f"kernels={cov['files_scanned']} "
+              f"proven={cov['proven']} open={len(cov['findings'])} "
+              f"stale={len(cov['stale_allowlist'])}")
+        for f in cov["findings"]:
+            print(f"    {f['file']}: [{f['key']}] {f['message']}")
+        for e in cov["stale_allowlist"]:
+            print(f"    stale allowlist entry (delete it): {e}")
+    # machine-readable gate lines for the tier-1 harness: open
+    # lock-order/hold-and-block findings and proven kernel count
+    lo = out.get("lints", {}).get("lockorder")
+    if lo:
+        print(f"LOCKORDER_OK={len(lo['findings']) + len(lo['stale_allowlist'])}")
+    if cov:
+        print(f"PROOF_COVERAGE_OK={cov['proven'] if cov['ok'] else 0}")
     print("ANALYSIS_OK" if out["ok"] else "ANALYSIS_FAIL")
 
 

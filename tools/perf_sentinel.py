@@ -108,6 +108,15 @@ RULES = [
      "sha256 kernel not proven overflow-free in the measured record"),
     ("analysis.lints_ok", "require_true", None,
      "lint findings open in the measured record"),
+    # ISSUE 18 concurrency + coverage gates: the dispatch tier the
+    # bench number rode must be deadlock-clean, and every kernel
+    # variant it could have dispatched must carry an overflow proof.
+    ("analysis.lockorder_ok", "require_true", None,
+     "lock-order / hold-and-block findings open in the measured "
+     "record"),
+    ("analysis.proof_coverage_ok", "require_true", None,
+     "an engine kernel variant without a proven overflow envelope "
+     "in the measured record"),
     ("analysis.envelope_sha256", "note_change", None,
      "proven limb envelope changed (deliberate? review the golden)"),
     ("analysis.sha256_envelope", "note_change", None,

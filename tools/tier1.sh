@@ -57,9 +57,18 @@ echo HASH_DIFF_OK=$(timeout -k 5 120 env JAX_PLATFORMS=cpu \
 # (docs/sha256_bounds.json, ISSUE 7), and the
 # hot-path/lock-discipline/nondet lints must be clean
 # (docs/static_analysis.md). Fails the tier-1 gate on any open finding.
-timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py
-arc=$?
+_alog=$(mktemp)
+timeout -k 10 590 env JAX_PLATFORMS=cpu python tools/analyze.py | tee "$_alog"
+arc=${PIPESTATUS[0]}
 echo ANALYSIS_RC=$arc
+# Lock-order + proof-coverage gate lines (ISSUE 18), lifted from the
+# analyze transcript: LOCKORDER_OK counts open lock-cycle /
+# hold-and-block / stale-allowlist findings (0 = clean) and
+# PROOF_COVERAGE_OK counts proven kernel variants (0 = gate failed) —
+# both visible from the tier-1 transcript alone, next to ANALYSIS_RC.
+echo "$(grep -o '^LOCKORDER_OK=[0-9]*' "$_alog" | tail -1)"
+echo "$(grep -o '^PROOF_COVERAGE_OK=[0-9]*' "$_alog" | tail -1)"
+rm -f "$_alog"
 # Kernel-cost ledger gate width (ISSUE 13): how many ledger rows the
 # cost suite enforces (tools/kernel_cost.py ENFORCED_LEDGER_ROWS,
 # asserted row-by-row in tests/test_kernel_cost.py, trend-gated by the
