@@ -67,6 +67,20 @@ SCOPE = [
     # own lock (the _locked convention); the shared-engine adapter
     # serializes replica dispatchers on one engine
     "stellar_tpu/crypto/fleet.py",
+    # the wire ingress (ISSUE 19): connection registry + the
+    # wire-extended conservation counters mutate from accept, reader,
+    # responder and snapshot threads under the server's one cv; the
+    # design contract the lockorder prover enforces is that NO lock is
+    # ever held across a socket op (recv/accept/sendall)
+    "stellar_tpu/crypto/ingress.py",
+    # the frame codec is lock-free and thread-free by design; scoped
+    # so the prover's graph covers the whole wire path and any future
+    # lock sneaking in is caught, not argued
+    "stellar_tpu/utils/wire.py",
+    # the reusable receive-buffer pool (ISSUE 19): free list + lease
+    # refcounts mutate from reader and responder threads under the
+    # pool's one lock
+    "stellar_tpu/parallel/hostbuf.py",
     "stellar_tpu/parallel/batch_engine.py",
     "stellar_tpu/parallel/device_health.py",
     # the device-resident constant cache (ISSUE 12): its LRU mutates

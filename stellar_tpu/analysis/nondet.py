@@ -74,6 +74,14 @@ HOST_ORACLE_FILES = [
     # breakers keep their clocks inside resilience.py; they are a
     # metric surface, never a routing input)
     "stellar_tpu/crypto/fleet.py",
+    # the wire ingress + frame codec (ISSUE 19): what arrived, what
+    # was malformed, what was refused and which trace block each
+    # frame got must be pure functions of the byte stream — NO
+    # allowlist entry (pinned in test_analysis.py), so read deadlines
+    # ride socket timeouts and event counts, never a clock read, and
+    # two nodes decoding the same bytes always agree
+    "stellar_tpu/crypto/ingress.py",
+    "stellar_tpu/utils/wire.py",
     # the workload-agnostic batch engine owns dispatch, re-shard,
     # audit-sample composition, and host-oracle failover for EVERY
     # plugin — a clock or RNG here would desynchronize which rows any

@@ -357,13 +357,21 @@ class FleetRouter:
         return _pick(cands, route_key(lane, tenant))
 
     def submit(self, items: Sequence[tuple], lane: str = "bulk",
-               tenant: Optional[str] = None):
+               tenant: Optional[str] = None,
+               trace_lo: Optional[int] = None):
         """Route one submission to its replica and admit it there.
         Raises :class:`Overloaded` exactly as the service would (the
         exception's ``replica`` field names the refusing replica), or
         with ``reason="fleet-quarantined"`` / ``replica=None`` when
         no replica is routable at all. Returns the replica's
-        :class:`VerifyTicket`."""
+        :class:`VerifyTicket`.
+
+        ``trace_lo`` (ISSUE 19) is the wire-ingress pass-through: the
+        ingress server allocates the trace block when the frame
+        arrives, so the ``trace?id=`` timeline starts on the wire and
+        the block survives routing AND any later handoff re-route
+        (``_resubmit_locked`` already preserved it). None = the
+        replica's service allocates a fresh block."""
         if lane not in vs_mod.LANES:
             raise ValueError(
                 f"unknown lane {lane!r} (one of {vs_mod.LANES})")
@@ -396,7 +404,8 @@ class FleetRouter:
             registry.meter("crypto.verify.fleet.routed").mark(n)
             try:
                 tkt = rep["service"].submit(items, lane=lane,
-                                            tenant=tenant)
+                                            tenant=tenant,
+                                            trace_lo=trace_lo)
             finally:
                 # the divergence audit runs on its cadence whether or
                 # not this submission was admitted — the replica's

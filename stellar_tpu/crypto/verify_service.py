@@ -736,7 +736,15 @@ class VerifyService:
         node open. ``drain=False`` sheds the whole queued backlog
         (reason ``"stopped"``) and only finishes work already in
         flight. New submissions are rejected (``"stopped"``) from the
-        moment stop is called."""
+        moment stop is called.
+
+        Terminal guarantee (ISSUE 19): every client-visible ticket
+        held across a stop resolves — verified, failed, or a typed
+        ``Overloaded`` — even when the dispatcher thread itself died
+        (the ``_run`` finally sheds any stranded backlog with reason
+        ``"stopped"``), so a wire-ingress responder or a fleet
+        ``kill_replica`` composed with a connection close never
+        leaves a pending item without a documented terminal."""
         with self._cv:
             if not self._running:
                 return
@@ -1278,9 +1286,33 @@ class VerifyService:
         registry.gauge("crypto.verify.control.moves").set(ctl.moves)
 
     def _run(self) -> None:
+        """Dispatcher entry: the loop body, wrapped so that EVERY
+        client-visible ticket reaches a documented terminal even if
+        the loop dies on an unexpected exception (ISSUE 19 drain-gap
+        fix). On any exit — clean stop or crash — the finally block
+        re-flags stop (so new submissions are rejected ``"stopped"``
+        instead of queueing behind a dead dispatcher) and sheds the
+        queued backlog (reason ``"stopped"``, counted + ticketed); a
+        crash additionally fails every still-in-flight part's future
+        with the error through the ordinary ``failed`` terminal. A
+        clean drain makes both a no-op (queues and inflight are
+        already empty), so the conservation law holds either way."""
+        inflight: deque = deque()
+        try:
+            self._run_loop(inflight)
+        except BaseException as err:
+            while inflight:
+                ln, parts, _resolver, tr = inflight.popleft()
+                self._resolve_failed(ln, parts, err, traces=tr)
+            raise
+        finally:
+            with self._cv:
+                self._stop = True
+                self._abort_queues_locked()
+
+    def _run_loop(self, inflight: deque) -> None:
         # in-flight dispatches are LOCAL to the dispatcher thread (the
         # only thread that touches them); shared state stays under cv
-        inflight: deque = deque()
         while True:
             onset = None
             batch = None

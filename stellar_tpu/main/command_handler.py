@@ -229,6 +229,17 @@ class CommandHandler:
         from stellar_tpu.crypto import fleet
         return fleet.fleet_health()
 
+    def cmd_ingress(self, params):
+        """Wire-ingress surface (ISSUE 19): the active
+        ``IngressServer``'s snapshot — frame/item/byte counters, the
+        malformed-frame tally by typed reason, per-connection defense
+        kill counts, the reusable host-buffer pool, and the
+        wire-extended conservation residual (must read 0). Served
+        directly — wire health matters exactly when clients
+        misbehave (same policy as ``fleet``)."""
+        from stellar_tpu.crypto import ingress
+        return ingress.ingress_health()
+
     def cmd_peers(self, params):
         def peers():
             out = []
@@ -696,7 +707,7 @@ class CommandHandler:
         "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
         "slo": cmd_slo, "tenant": cmd_tenant,
         "control": cmd_control,
-        "fleet": cmd_fleet,
+        "fleet": cmd_fleet, "ingress": cmd_ingress,
         "tx": cmd_tx, "manualclose": cmd_manualclose,
         "quorum": cmd_quorum, "scp": cmd_scp, "ll": cmd_ll,
         "bans": cmd_bans, "ban": cmd_ban, "unban": cmd_unban,

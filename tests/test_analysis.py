@@ -667,6 +667,31 @@ def test_lint_scopes_cover_fleet():
     assert set(entry) == {"nondet:clock"}
 
 
+def test_lint_scopes_cover_ingress():
+    """ISSUE 19: two nodes decoding the same bytes must always agree
+    on what arrived, so the frame codec and the ingress server join
+    the nondet scope with ZERO allowlist entries (no clock, no RNG —
+    read deadlines are poll-counted, pack timing is measured by the
+    unscoped soak harness); the server's conservation counters mutate
+    from accept/reader/responder threads under one cv while socket
+    ops run lock-free, so both files join the lock scope — and the
+    lock-order prover's allowlist must NOT have grown for them (no
+    blocking call under a lock gets excused on the wire path)."""
+    from stellar_tpu.analysis import lockorder
+    for mod in ("stellar_tpu/crypto/ingress.py",
+                "stellar_tpu/utils/wire.py"):
+        assert mod in set(nondet.HOST_ORACLE_FILES), mod
+        assert mod in set(locks.SCOPE), mod
+        assert mod not in nondet.ALLOWLIST._entries, mod
+        assert mod not in locks.ALLOWLIST._entries, mod
+        assert mod not in lockorder.ALLOWLIST._entries, mod
+    # the reusable lease pool rides the lock scope too (refcounts
+    # mutate from reader + responder threads)
+    assert "stellar_tpu/parallel/hostbuf.py" in set(locks.SCOPE)
+    assert "stellar_tpu/parallel/hostbuf.py" not in \
+        locks.ALLOWLIST._entries
+
+
 def test_lint_scopes_cover_batch_engine():
     """ISSUE 7: the workload-agnostic engine owns the jit-bucket cache,
     device-health registry and served-counter RMWs from resolver/pool/
@@ -1142,16 +1167,19 @@ def test_scope_sets_pinned():
         "stellar_tpu/crypto/tenant.py",
         "stellar_tpu/crypto/controller.py",
         "stellar_tpu/crypto/fleet.py",
+        "stellar_tpu/crypto/ingress.py",
         "stellar_tpu/crypto/keys.py",
         "stellar_tpu/crypto/native_prep.py",
         "stellar_tpu/crypto/native_verify.py",
         "stellar_tpu/parallel/batch_engine.py",
         "stellar_tpu/parallel/device_health.py",
+        "stellar_tpu/parallel/hostbuf.py",
         "stellar_tpu/parallel/residency.py",
         "stellar_tpu/parallel/signer_tables.py",
         "stellar_tpu/soroban/native_wasm.py",
         "stellar_tpu/utils/faults.py",
         "stellar_tpu/utils/metrics.py",
+        "stellar_tpu/utils/wire.py",
         "stellar_tpu/utils/native.py",
         "stellar_tpu/utils/resilience.py",
         "stellar_tpu/utils/tracing.py",
@@ -1173,6 +1201,7 @@ def test_scope_sets_pinned():
         "stellar_tpu/crypto/ed25519_ref.py",
         "stellar_tpu/crypto/fleet.py",
         "stellar_tpu/crypto/h2c.py",
+        "stellar_tpu/crypto/ingress.py",
         "stellar_tpu/crypto/keccak.py",
         "stellar_tpu/crypto/keys.py",
         "stellar_tpu/crypto/nacl_box.py",

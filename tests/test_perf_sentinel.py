@@ -448,3 +448,39 @@ def test_repo_trajectory_is_clean():
     head = sentinel.load_record(pair[1])
     out = sentinel.apply_rules(base, head)
     assert out["ok"], out["findings"]
+
+
+def test_ingress_conservation_gap_is_hard_zero():
+    """ISSUE 19: the wire-ingress conservation residual in a committed
+    capture is a HEAD-only ceiling at exactly 0 — a frame or item
+    lost between the socket and a typed terminal fails the gate.
+    Non-ingress captures skip the row, never fail it."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"ingress.conservation_gap": 1}))
+    assert any(f["path"] == "ingress.conservation_gap"
+               and f["rule"] == "max_abs" for f in out["findings"])
+    ok = sentinel.apply_rules(
+        _record(), _record(**{"ingress.conservation_gap": 0}))
+    assert ok["ok"], ok["findings"]
+    # the base record never ran the wire front: skip with a reason
+    steady = sentinel.apply_rules(_record(), _record())
+    assert steady["ok"], steady["findings"]
+    assert any(s.get("path") == "ingress.conservation_gap"
+               and s.get("reason") == "missing"
+               for s in steady["skipped"])
+
+
+def test_ingress_malformed_frames_change_is_note_not_fatal():
+    """ISSUE 19: malformed-frame counts legitimately vary with the
+    armed wire fault shapes — flagged for review, never fatal."""
+    out = sentinel.apply_rules(
+        _record(**{"ingress.malformed_frames": 10}),
+        _record(**{"ingress.malformed_frames": 26}))
+    assert out["ok"], out["findings"]
+    assert any(n["path"] == "ingress.malformed_frames"
+               for n in out["notes"])
+    steady = sentinel.apply_rules(
+        _record(**{"ingress.malformed_frames": 26}),
+        _record(**{"ingress.malformed_frames": 26}))
+    assert not any(n["path"] == "ingress.malformed_frames"
+                   for n in steady["notes"])

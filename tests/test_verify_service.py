@@ -202,3 +202,48 @@ def test_trickle_flush_empty_and_bound_param():
     assert b.flush() == 0                # empty window is a no-op
     assert b.verify_sig(*_items(0)[0]) in (True, False)
     assert b.rejected == 0
+
+
+def test_dispatcher_crash_leaves_no_silent_tickets():
+    """ISSUE 19 drain-gap fix: if the dispatcher loop dies on an
+    unexpected exception, every client-visible ticket still reaches a
+    documented terminal — in-flight work fails typed, the queued
+    backlog is shed ``"stopped"``, and NEW submissions are rejected
+    ``"stopped"`` instead of queueing behind a dead dispatcher."""
+    svc = vs.VerifyService(verifier=InstantVerifier(), lane_depth=64,
+                           max_batch=4, pipeline_depth=1).start()
+    try:
+        boom = RuntimeError("dispatcher crashed")
+        orig = svc._collect_locked
+        fired = threading.Event()
+
+        def crashing():
+            if fired.is_set():
+                raise boom
+            return orig()
+
+        tkts = [svc.submit(_items(i), lane="bulk") for i in range(6)]
+        svc._collect_locked = crashing
+        fired.set()
+        with svc._cv:
+            svc._cv.notify_all()
+        outcomes = {"verified": 0, "stopped": 0, "failed": 0}
+        for tkt in tkts:
+            try:
+                outcomes["verified"] += len(tkt.result(timeout=10))
+            except vs.Overloaded as e:
+                assert e.reason == "stopped"
+                outcomes["stopped"] += tkt.n_items
+            except RuntimeError:
+                outcomes["failed"] += tkt.n_items
+        assert sum(outcomes.values()) == 12   # zero silent tickets
+        # the dead service refuses new work typed, immediately
+        with pytest.raises(vs.Overloaded) as ei:
+            svc.submit(_items(99), lane="bulk")
+        assert ei.value.reason == "stopped"
+        snap = svc.snapshot()
+        assert snap["conservation_gap"] == 0
+        assert snap["pending_items"] == 0
+    finally:
+        svc._collect_locked = orig
+        svc.stop(drain=False, timeout=10)

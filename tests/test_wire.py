@@ -1,0 +1,189 @@
+"""Unit tests for the wire frame codec (ISSUE 19,
+``stellar_tpu/utils/wire.py``): round-trip property sweeps, the
+torn-frame fuzz corpus over EVERY byte split point (decode identically
+or raise typed — never panic, never silently resync), decoder
+poisoning after the first malformed frame, canonical byte-identical
+refusal encoding, and the oversize-declaration guard. The
+socket-level composition lives in ``tests/test_ingress.py`` and the
+tier-1 ``INGRESS_OK`` gate (``tools/ingress_selfcheck.py``);
+everything here is pure bytes — no sockets, no threads."""
+
+import pytest
+
+from stellar_tpu.utils import wire
+
+
+def _items(i, n=3, pk_len=32, sig_len=64):
+    pk = bytes([(i * 13 + j) % 251 + 1 for j in range(pk_len)])
+    return [(pk, b"w%d-%d" % (i, k) * (k + 1),
+             bytes([(i + k) % 251]) * sig_len) for k in range(n)]
+
+
+def _frames(blob):
+    return wire.FrameDecoder().feed(blob)
+
+
+# ---------------- round trips ----------------
+
+@pytest.mark.parametrize("lane,tenant", [
+    ("bulk", None), ("scp", "t0"), ("auth", "tenant-with-a-name")])
+def test_submit_round_trip(lane, tenant):
+    items = _items(3, 5)
+    blob = wire.encode_submit(items, lane, tenant, req_id=77)
+    (ftype, payload, raw_len), = _frames(blob)
+    assert ftype == wire.SUBMIT and raw_len == len(blob)
+    req_id, got_lane, got_tenant, got = wire.decode_submit(payload)
+    assert (req_id, got_lane, got_tenant) == (77, lane, tenant)
+    assert [(bytes(p), bytes(m), bytes(s)) for p, m, s in got] == \
+        [(bytes(p), bytes(m), bytes(s)) for p, m, s in items]
+
+
+def test_submit_round_trip_noncanonical_key_lengths():
+    """The codec does NOT enforce PK_LEN/SIG_LEN: the verifier is the
+    authority on key validity, so a structurally invalid 31-byte pk
+    must ride the wire intact and come back as a False verdict — not
+    die in the codec (soak pools contain exactly such rows)."""
+    items = [(b"\x01" * 31, b"short", b"\x02" * 64),
+             (b"", b"empty", b""),
+             (b"\x03" * 255, b"long", b"\x04" * 255)]
+    blob = wire.encode_submit(items, "bulk", None, 5)
+    _, _, got = wire.decode_submit(_frames(blob)[0][1])[1:]
+    assert [(bytes(p), bytes(m), bytes(s)) for p, m, s in got] == items
+
+
+def test_submit_rejects_unencodable():
+    with pytest.raises(ValueError):
+        wire.encode_submit([(b"\x01" * 256, b"m", b"\x02" * 64)])
+    with pytest.raises(ValueError):
+        wire.encode_submit(_items(0, 1), lane="x" * 256)
+
+
+def test_verdict_round_trip():
+    blob = wire.encode_verdict(9, 12345, [1, 0, 1, 1])
+    req_id, trace_lo, verdicts = wire.decode_verdict(
+        _frames(blob)[0][1])
+    assert (req_id, trace_lo) == (9, 12345)
+    assert verdicts == [True, False, True, True]
+
+
+def test_refusal_and_error_round_trip():
+    blob = wire.encode_refusal(4, kind="shed", lane="bulk",
+                               reason="queue-depth", tenant="t1",
+                               replica=2, trace_lo=100, n=8,
+                               message="m")
+    d = wire.decode_json(_frames(blob)[0][1])
+    assert d == {"req_id": 4, "kind": "shed", "lane": "bulk",
+                 "reason": "queue-depth", "tenant": "t1",
+                 "replica": 2, "trace_lo": 100, "n": 8,
+                 "message": "m"}
+    e = wire.decode_json(_frames(wire.encode_error(
+        "garbage", "det"))[0][1])
+    assert e == {"reason": "garbage", "detail": "det"}
+
+
+def test_refusal_encoding_is_byte_identical():
+    """Two independent encodes of the same refusal are the same
+    bytes — canonical JSON (sorted keys, no whitespace), the property
+    the two-server gate in tools/ingress_selfcheck.py leans on."""
+    kw = dict(kind="rejected", lane="scp", reason="stopped",
+              tenant=None, replica=1, trace_lo=7, n=3, message="x")
+    assert wire.encode_refusal(9, **kw) == wire.encode_refusal(9, **kw)
+    b = wire.encode_refusal(9, **kw)
+    payload = bytes(_frames(b)[0][1])
+    assert b" " not in payload and payload.find(b'"kind"') < \
+        payload.find(b'"lane"') < payload.find(b'"message"')
+
+
+# ---------------- torn-frame fuzz ----------------
+
+def _blob():
+    return (wire.encode_submit(_items(0, 2), "bulk", None, 1)
+            + wire.encode_verdict(1, 40, [1, 0])
+            + wire.encode_refusal(2, kind="rejected", lane="bulk",
+                                  reason="queue-depth", tenant="t0",
+                                  replica=0, trace_lo=42, n=2)
+            + wire.encode_error("deadline"))
+
+
+def test_torn_frames_decode_identically_at_every_split():
+    """The tentpole property: feeding ANY byte-split of a valid frame
+    sequence yields exactly the frames of feeding it whole."""
+    blob = _blob()
+    whole = [(t, bytes(p)) for t, p, _ in _frames(blob)]
+    assert len(whole) == 4
+    for cut in wire.split_points(blob):
+        dec = wire.FrameDecoder()
+        out = dec.feed(blob[:cut]) + dec.feed(blob[cut:])
+        assert [(t, bytes(p)) for t, p, _ in out] == whole, \
+            f"split at byte {cut} diverged"
+        assert dec.dead is None and dec.partial_bytes == 0
+
+
+def test_torn_three_way_and_byte_at_a_time():
+    blob = _blob()
+    whole = [(t, bytes(p)) for t, p, _ in _frames(blob)]
+    dec = wire.FrameDecoder()
+    out = []
+    for i in range(len(blob)):
+        out += dec.feed(blob[i:i + 1])
+    assert [(t, bytes(p)) for t, p, _ in out] == whole
+
+
+@pytest.mark.parametrize("junk", [0x00, 0x05, 0x7f, 0xff])
+def test_garbage_prefix_is_typed_and_poisons(junk):
+    """An unknown type byte raises a TYPED MalformedFrame — and the
+    decoder refuses to resync afterwards (frame boundaries are no
+    longer trustworthy): every later feed re-raises."""
+    dec = wire.FrameDecoder()
+    with pytest.raises(wire.MalformedFrame) as ei:
+        dec.feed(bytes([junk]) + _blob())
+    assert ei.value.reason == "garbage"
+    assert dec.dead is ei.value
+    with pytest.raises(wire.MalformedFrame):
+        dec.feed(_blob())      # valid bytes — STILL dead
+
+
+def test_oversize_declaration_refused_without_buffering():
+    dec = wire.FrameDecoder()
+    with pytest.raises(wire.MalformedFrame) as ei:
+        dec.feed(wire._HDR.pack(wire.SUBMIT,
+                                wire.MAX_FRAME_BYTES + 1))
+    assert ei.value.reason == "oversize"
+    assert dec.partial_bytes <= wire.HEADER_LEN
+
+
+def test_truncated_submit_payloads_are_typed():
+    """Every proper prefix of a SUBMIT payload must raise typed
+    truncated-item (or trailing-bytes), never IndexError/struct
+    noise — the decode path a torn frame hits if framing lies."""
+    blob = wire.encode_submit(_items(2, 3), "scp", "t9", 6)
+    payload = bytes(_frames(blob)[0][1])
+    for cut in range(len(payload)):
+        try:
+            wire.decode_submit(payload[:cut])
+        except wire.MalformedFrame as e:
+            assert e.reason in ("truncated-item", "trailing-bytes")
+    with pytest.raises(wire.MalformedFrame) as ei:
+        wire.decode_submit(payload + b"\x00")
+    assert ei.value.reason == "trailing-bytes"
+
+
+def test_feed_decoded_poisons_on_payload_violation():
+    dec = wire.FrameDecoder()
+    bad = wire.frame(wire.VERDICT, b"\x00\x01")   # short preamble
+    with pytest.raises(wire.MalformedFrame):
+        list(dec.feed_decoded(bad))
+    assert dec.dead is not None
+
+
+def test_decode_submit_zero_copy_slices():
+    """Message bytes come back as memoryview slices of the caller's
+    buffer (the lease), not copies — the zero-copy contract."""
+    blob = wire.encode_submit(_items(1, 2), "bulk", None, 3)
+    buf = bytearray(blob)
+    payload = memoryview(buf)[wire.HEADER_LEN:]
+    _, _, _, items = wire.decode_submit(payload)
+    assert all(isinstance(m, memoryview) for _, m, _ in items)
+    assert bytes(items[0][1]) == b"w1-0"
+    buf[buf.index(b"w1-0"[0])] ^= 0xFF   # mutate backing store...
+    assert bytes(items[0][1]) != b"w1-0"  # ...the slice sees it

@@ -186,6 +186,21 @@ RULES = [
      "fleet divergence conviction count changed (expected to vary "
      "with injected-fault scenarios; review the conviction log if "
      "surprising)"),
+    # wire ingress (ISSUE 19): the WIRE-level conservation residual
+    # is a HARD zero — every frame that crossed the socket lands in
+    # decoded or malformed, every decoded item in accepted or
+    # refused, and every accepted item in exactly one typed terminal
+    # (resolved / shed / failed), even through torn frames, killed
+    # connections and a mid-run server stop; malformed-frame counts
+    # are note-only because chaos windows legitimately vary how many
+    # frames the misbehaving flooder tears.
+    ("ingress.conservation_gap", "max_abs", 0,
+     "wire-ingress conservation residual nonzero — a frame or item "
+     "was lost between the socket and a typed terminal"),
+    ("ingress.malformed_frames", "note_change", None,
+     "malformed wire-frame count changed (expected to vary with the "
+     "armed wire fault shapes; review the ingress record if "
+     "surprising)"),
     # pipeline-bubble profiler (ISSUE 10): the async-dispatch PR's
     # before/after numbers. busy_frac down = more device idle per
     # resolve; overlap_frac down = host prep stopped hiding behind

@@ -304,7 +304,8 @@ def run_sha256(smoke: bool, duration_s: float,
 def run(smoke: bool, duration_s: float, corrupt: bool,
         events_path: str, tenants: int = 0,
         flooder: bool = False, ramp: bool = False,
-        signers: str = "pool", replicas: int = 0) -> dict:
+        signers: str = "pool", replicas: int = 0,
+        ingress: bool = False) -> dict:
     import numpy as np
 
     from stellar_tpu.crypto import batch_verifier as bv
@@ -450,6 +451,39 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
                      "submitted": 0}
     lock = threading.Lock()
 
+    # --ingress (ISSUE 19): the submission front door becomes the
+    # WIRE — a real IngressServer on a loopback socket in front of
+    # the service/fleet, the flood threads real WireClients, and the
+    # flooder a deliberately MISBEHAVING socket client cycling the
+    # five wire fault shapes (faults.WIRE_MODES). Admission refusals
+    # then arrive as typed REFUSAL frames at drain time instead of
+    # synchronous raises at submit time.
+    ingress_srv = None
+    wire_clients = {}
+    pack_stats = {"ms": 0.0, "n": 0}
+    flooder_wire = {"cli": None, "conns": 0}
+    if ingress:
+        from stellar_tpu.crypto import ingress as ingress_mod
+        from stellar_tpu.utils import wire
+        ingress_srv = ingress_mod.IngressServer(front)
+        ingress_srv.start()
+        for ln in ("bulk", "scp"):
+            wire_clients[ln] = ingress_mod.WireClient(
+                "127.0.0.1", ingress_srv.port)
+
+    def wire_submit(cli, items, lane, tenant):
+        """One wire submission with the encode timed — ``pack_ms`` is
+        the host-side serialization cost the bench record quotes
+        (measured HERE: the scoped ingress module reads no clocks)."""
+        tkt = cli.reserve(lane, tenant, len(items))
+        t0 = time.perf_counter()
+        data = wire.encode_submit(items, lane, tenant, tkt.req_id)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        with lock:
+            pack_stats["ms"] += dt_ms
+            pack_stats["n"] += 1
+        return cli.send_encoded(tkt, data)
+
     def flood(lane, count, per_sub, pace_s, offset=0):
         for i in range(count):
             items, exp = pick(i + offset, per_sub)
@@ -457,7 +491,12 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
             if tenants > 0 and lane == "bulk":
                 tenant = "t%03d" % ((i + offset) % tenants)
             try:
-                tkt = front.submit(items, lane=lane, tenant=tenant)
+                if ingress_srv is not None:
+                    tkt = wire_submit(wire_clients[lane], items,
+                                      lane, tenant)
+                else:
+                    tkt = front.submit(items, lane=lane,
+                                       tenant=tenant)
                 with lock:
                     results[lane]["tickets"].append((tkt, exp))
             except vs.Overloaded as e:
@@ -469,11 +508,43 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
 
     def flood_tenant(count, per_sub, offset=0):
         """The adversarial flooder: unpaced bulk bursts under ONE
-        tenant id — its quota (not the lane budget) must absorb it."""
+        tenant id — its quota (not the lane budget) must absorb it.
+        Under ``--ingress`` it is a REAL misbehaving socket client:
+        every 25th submission re-arms the next wire fault shape, and
+        whenever the server kills (or a fault closes) its connection
+        it reconnects and keeps flooding."""
         for i in range(count):
             items, exp = pick(i + offset, per_sub)
             with lock:
                 flooder_stats["submitted"] += 1
+            if ingress_srv is not None:
+                from stellar_tpu.crypto import ingress as ingress_mod
+                mode = faults.WIRE_MODES[
+                    (i // 25) % len(faults.WIRE_MODES)]
+                # slow-client at the default 4 KiB/s would stall the
+                # round join; the shape (chunked sends with sleeps
+                # between) is what matters, not the starvation rate
+                arg = 262144.0 if mode == "slow-client" else None
+                faults.set_fault("wire.flooder", mode, arg)
+                cli = flooder_wire["cli"]
+                if cli is None or not cli.alive:
+                    if cli is not None:
+                        cli.close()
+                    try:
+                        cli = ingress_mod.WireClient(
+                            "127.0.0.1", ingress_srv.port,
+                            fault_point="wire.flooder")
+                    except OSError:
+                        continue
+                    flooder_wire["cli"] = cli
+                    flooder_wire["conns"] += 1
+                try:
+                    tkt = wire_submit(cli, items, "bulk", "flooder")
+                    with lock:
+                        results["bulk"]["tickets"].append((tkt, exp))
+                except (ConnectionError, OSError):
+                    pass        # ticket failed typed; reconnect above
+                continue
             try:
                 tkt = front.submit(items, lane="bulk",
                                    tenant="flooder")
@@ -546,20 +617,45 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
               service=front.snapshot()["totals"])
 
     # drain: every outstanding ticket resolves to verified or shed
+    # (wire mode adds two typed terminals: REFUSAL frames carrying
+    # admission rejections, and connection errors on the flooder's
+    # deliberately killed sockets — never on a well-behaved client)
     mismatches = 0
     shed = {"bulk": 0, "scp": 0}
     verified_items = 0
+    wire_dead = 0
+    wire_dead_good = 0
     for lane in ("bulk", "scp"):
         for tkt, exp in results[lane]["tickets"]:
             try:
                 got = tkt.result(timeout=120)
             except vs.Overloaded as e:
+                if ingress_srv is not None and e.kind == "rejected":
+                    results[lane]["rejected"] += 1
+                    if getattr(tkt, "tenant", None) == "flooder":
+                        flooder_stats["rejected"] += 1
+                        if e.reason.startswith("tenant-"):
+                            flooder_stats["quota_rejected"] += 1
+                    continue
                 assert e.kind == "shed", e.kind
                 shed[lane] += 1
+                continue
+            except (ConnectionError, OSError, RuntimeError):
+                wire_dead += 1
+                if getattr(tkt, "tenant", None) != "flooder":
+                    wire_dead_good += 1
                 continue
             verified_items += len(got)
             if not (got == exp).all():
                 mismatches += 1
+    ingress_snap = None
+    if ingress_srv is not None:
+        for cli in wire_clients.values():
+            cli.close()
+        if flooder_wire["cli"] is not None:
+            flooder_wire["cli"].close()
+        ingress_srv.stop()
+        ingress_snap = ingress_srv.snapshot()
     front.stop(drain=True, timeout=60)
     fault_counters = faults.counters()   # captured BEFORE clear
     faults.clear()
@@ -738,6 +834,53 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
                 "router refused submissions while replicas were "
                 f"routable ({fsnap['router_refused']} items)")
 
+    # ---- wire-ingress scenario record + gates (--ingress) ----
+    ingress_rec = None
+    if ingress_snap is not None:
+        isnap = ingress_snap
+        ingress_rec = {
+            "frames": isnap["decoded_frames"],
+            "malformed_frames": isnap["malformed_frames"],
+            "malformed_reasons": isnap["malformed_reasons"],
+            "items": isnap["items_decoded"],
+            "ingress_bytes": isnap["bytes_in"],
+            "bytes_out": isnap["bytes_out"],
+            "conservation_gap": isnap["conservation_gap"],
+            "pending": isnap["pending"],
+            "connections_total": isnap["connections_total"],
+            "flooder_connections": flooder_wire["conns"],
+            "wire_killed_tickets": wire_dead,
+            "pack_ms": {
+                "count": pack_stats["n"],
+                "total_ms": round(pack_stats["ms"], 3),
+                "avg_ms": round(
+                    pack_stats["ms"] / max(1, pack_stats["n"]), 5),
+            },
+            "pool": isnap["pool"],
+        }
+        if isnap["conservation_gap"] != 0:
+            problems.append(
+                "wire-ingress conservation violated: "
+                f"gap={isnap['conservation_gap']}")
+        if isnap["pending"] != 0:
+            problems.append(
+                "wire-ingress pending nonzero after drain: "
+                f"{isnap['pending']}")
+        if wire_dead_good:
+            problems.append(
+                f"{wire_dead_good} well-behaved wire tickets died on "
+                "connection errors — only the misbehaving flooder's "
+                "sockets may be killed")
+        if flooder:
+            if isnap["malformed_frames"] == 0:
+                problems.append(
+                    "wire flooder armed but no malformed frame ever "
+                    "reached the server — the fault shapes are dead")
+            wfc = fault_counters.get("wire.flooder", {})
+            if not wfc.get("fired"):
+                problems.append(
+                    "wire.flooder fault point never fired")
+
     # ---- tenant scenario gates (--tenants N [--flooder]) ----
     tenant_rec = None
     if tenants > 0:
@@ -815,6 +958,7 @@ def run(smoke: bool, duration_s: float, corrupt: bool,
         "tenant": tenant_rec,
         "ramp": ramp_rec,
         "fleet": fleet_rec,
+        "ingress": ingress_rec,
         "signer_tables": signer_rec,
         "problems": problems,
     }
@@ -880,6 +1024,24 @@ def emit_bench_service(rec: dict, path: str) -> None:
             "divergence_checks": rec["fleet"]["divergence_checks"],
             "handoffs": rec["fleet"]["handoffs"],
         }
+    if rec.get("ingress"):
+        # ISSUE 19 sentinel rows — WIRE-INGRESS windows only: the
+        # wire-level conservation residual is a hard zero (every byte
+        # that became a decoded item lands in exactly one typed
+        # terminal), malformed-frame counts are note-only (they vary
+        # with the armed fault shapes), and ingress_bytes/pack_ms are
+        # the bench quantities docs/benchmarks.md documents. Absent
+        # from non-ingress captures, so the sentinel skips instead of
+        # flaking.
+        ing = rec["ingress"]
+        cap["ingress"] = {
+            "conservation_gap": abs(ing["conservation_gap"]),
+            "malformed_frames": ing["malformed_frames"],
+            "frames": ing["frames"],
+            "items": ing["items"],
+            "ingress_bytes": ing["ingress_bytes"],
+            "pack_ms": ing["pack_ms"]["avg_ms"],
+        }
     if rec.get("ramp"):
         # ISSUE 15 sentinel rows — CONTROLLER windows only: the scp
         # latency burn ceiling (max_abs 1.0) gates the closed-loop
@@ -927,6 +1089,15 @@ def main() -> int:
                     help="front the soak with a FleetRouter over N "
                          "VerifyService replicas and kill one mid-run "
                          "(ISSUE 17); 0 = single service")
+    ap.add_argument("--ingress", action="store_true",
+                    help="front the soak with the streaming wire "
+                         "ingress (ISSUE 19): flood threads become "
+                         "real loopback WireClients, the --flooder "
+                         "tenant a misbehaving socket client cycling "
+                         "the five wire fault shapes; gates wire "
+                         "conservation gap == 0 and records "
+                         "ingress_bytes/pack_ms for the bench "
+                         "capture; verify workload only")
     ap.add_argument("--ramp", action="store_true",
                     help="double the offered bulk load at the midpoint"
                          " and attach the closed-loop controller "
@@ -978,7 +1149,7 @@ def main() -> int:
         rec = run(args.smoke, args.duration, args.corrupt, events,
                   tenants=args.tenants, flooder=args.flooder,
                   ramp=args.ramp, signers=args.signers,
-                  replicas=args.replicas)
+                  replicas=args.replicas, ingress=args.ingress)
     if args.emit_bench_service and args.workload == "verify" \
             and rec["ok"]:
         emit_bench_service(rec, args.emit_bench_service)

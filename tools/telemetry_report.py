@@ -40,6 +40,7 @@ sys.path.insert(0, REPO)
 REPORT_SERIES_PREFIXES = (
     "crypto.verify.service.slo.",
     "crypto.verify.control.",
+    "crypto.verify.ingress.",
     "crypto.pipeline.",
     "crypto.transfer.",
     "crypto.verify.service.lane.",
@@ -66,12 +67,14 @@ def collect_local(top_traces: int = TOP_TRACES) -> dict:
             top_traces):
         traces.append(tracing.flight_recorder.trace_timeline(tid))
     from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import ingress as ingress_mod
     return {
         "slo": vs.slo_health(),
         "service": vs.service_health(),
         "tenant": vs.tenant_health(),
         "control": vs.control_health(),
         "fleet": fleet_mod.fleet_health(),
+        "ingress": ingress_mod.ingress_health(),
         "pipeline": pipeline_timeline.snapshot(limit=4),
         "timeseries": timeseries.snapshot(),
         "transfer": transfer_ledger.totals(),
@@ -98,12 +101,18 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
     except Exception:
         # pre-fleet nodes have no such route — report "not deployed"
         fleet = {"enabled": False}
+    try:
+        ingress = get("ingress")
+    except Exception:
+        # pre-ingress nodes have no such route
+        ingress = {"enabled": False}
     return {
         "slo": get("slo"),
         "service": get("service"),
         "tenant": get("tenant"),
         "control": get("control"),
         "fleet": fleet,
+        "ingress": ingress,
         "pipeline": get("pipeline?limit=4"),
         "timeseries": get("timeseries"),
         "transfer": dispatch.get("transfer", {}),
@@ -273,6 +282,44 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
                 f"| {row.get('pending_items', 0)} "
                 f"| {row.get('conservation_gap')} |")
         lines.append("")
+
+    # ---- wire ingress ----
+    ing = data.get("ingress") or {}
+    if ing.get("enabled"):
+        reasons = ing.get("malformed_reasons") or {}
+        rtxt = ", ".join(f"{k}: {v}"
+                         for k, v in sorted(reasons.items())) or "—"
+        lines += ["## Ingress", "",
+                  f"{ing.get('connections', 0)} connections open "
+                  f"({ing.get('connections_total', 0)} lifetime); "
+                  f"{ing.get('frames_received', 0)} frames received "
+                  f"= {ing.get('decoded_frames', 0)} decoded + "
+                  f"**{ing.get('malformed_frames', 0)}** malformed "
+                  f"({rtxt}); wire conservation gap "
+                  f"**{ing.get('conservation_gap')}** (must be 0).",
+                  "",
+                  "| items decoded | accepted | refused | resolved "
+                  "| shed | failed | pending |",
+                  "|---|---|---|---|---|---|---|",
+                  f"| {ing.get('items_decoded', 0)} "
+                  f"| {ing.get('accepted', 0)} "
+                  f"| {ing.get('refused', 0)} "
+                  f"| {ing.get('resolved', 0)} "
+                  f"| {ing.get('shed', 0)} "
+                  f"| {ing.get('failed', 0)} "
+                  f"| {ing.get('pending', 0)} |", ""]
+        pool = ing.get("pool") or {}
+        lines += [
+            f"- bytes in / out: {ing.get('bytes_in', 0)} / "
+            f"{ing.get('bytes_out', 0)}; deadline kills "
+            f"{ing.get('deadline_kills', 0)}, byte-budget kills "
+            f"{ing.get('budget_kills', 0)}, send failures "
+            f"{ing.get('send_failures', 0)}",
+            f"- host-buffer pool: {pool.get('leases', 0)} leases "
+            f"over {pool.get('capacity', 0)} × "
+            f"{pool.get('buf_bytes', 0)}B buffers, "
+            f"{pool.get('misses', 0)} misses "
+            f"({pool.get('outstanding', 0)} outstanding)", ""]
 
     # ---- pipeline bubbles ----
     pipe = data.get("pipeline") or {}
@@ -455,6 +502,22 @@ def synthetic_window() -> None:
         fleet_tkts.append(fl.submit(items, lane=lane, tenant=tenant))
     for t in fleet_tkts:
         t.result(timeout=30)
+    # the wire ingress fronts the same fleet for a few frames so the
+    # default report also renders the "Ingress" section (ISSUE 19)
+    from stellar_tpu.crypto import ingress as ingress_mod
+    srv = ingress_mod.IngressServer(fl).start()
+    cli = ingress_mod.WireClient("127.0.0.1", srv.port)
+    wire_tkts = []
+    for i in range(6):
+        pk = bytes([(i * 23 + j) % 251 + 1 for j in range(32)])
+        items = [(pk, b"wiredemo-%d-%d" % (i, k),
+                  bytes([(i + k) % 251]) * 64) for k in range(2)]
+        wire_tkts.append(cli.submit(items, lane="bulk",
+                                    tenant=f"demo{i % 3}"))
+    for t in wire_tkts:
+        t.result(timeout=30)
+    cli.close()
+    srv.stop()
     fl.stop(drain=True, timeout=30)
     timeseries.sample_once()
 

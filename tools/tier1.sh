@@ -129,6 +129,25 @@ timeout -k 10 560 python tools/fleet_selfcheck.py
 flrc=$?
 echo FLEET_OK=$([ "$flrc" -eq 0 ] && echo 1 || echo 0)
 [ "$flrc" -ne 0 ] && exit $flrc
+# Streaming wire ingress (ISSUE 19): the zero-copy wire front door.
+# Codec phase: SUBMIT/VERDICT/REFUSAL round trips, a torn-frame fuzz
+# sweep over EVERY byte split point (decode identically or die typed,
+# never desync), two independent servers refusing byte-identically.
+# Throughput phase: >= 100k items/s of real loopback wire traffic
+# through a 3-replica stub fleet WHILE five misbehaving clients (one
+# per faults.WIRE_MODES shape) hammer the same listener — with the
+# wire conservation law EXACT at every live snapshot. Drain phase:
+# mid-flood replica kill + server stop with every wire ticket reaching
+# a typed terminal (zero unresolved). Chaos phase (subprocess): the
+# full forced-4-device soak with the wire ingress as front door
+# (tools/soak.py --ingress) — conservation exact at BOTH layers, the
+# misbehaving wire flooder's frames killed typed, no well-behaved
+# client harmed. Lint phase: ingress.py + wire.py in both lint scopes
+# and the lock-order graph with ZERO allowlist entries.
+timeout -k 10 560 python tools/ingress_selfcheck.py
+inrc=$?
+echo INGRESS_OK=$([ "$inrc" -eq 0 ] && echo 1 || echo 0)
+[ "$inrc" -ne 0 ] && exit $inrc
 # Verify-service soak smoke (ISSUE 6): a short CPU-only overload run
 # of the resident verify service (forced 4-device subprocess,
 # flaky-device:0 injected, audit sampling on, mid-run breaker trip)
