@@ -770,11 +770,43 @@ def main():
             "served": dict(h.served),
         }}
 
+    def phase_journal():
+        # unified-journal laws on a live window (ISSUE 20): the
+        # completeness gap must be a HARD 0 and every verdict trace of
+        # the window must stitch enqueue -> terminal — both ride the
+        # record so the perf sentinel can pin them
+        # (journal.completeness_gap max_abs 0, trace.stitch_frac
+        # min 1.0).
+        from stellar_tpu.crypto import verify_service as vsvc
+        from stellar_tpu.utils import journal, tracing
+        tracing.flight_recorder.clear()
+        svc = vsvc.VerifyService(
+            verifier=v, lane_depth=64, lane_bytes=64_000_000,
+            max_batch=N_SIGS, pipeline_depth=2).start()
+        tickets = [svc.submit(items[:64], lane="bulk")
+                   for _ in range(8)]
+        for t in tickets:
+            assert t.result(timeout=120).all()
+        svc.stop(drain=True, timeout=60)
+        merged = journal.merge(journal.collect(services=[svc]),
+                               journal.collect(services=[svc]))
+        comp = journal.completeness(merged, drained=True)
+        ids = [t.trace_lo for t in tickets if t.trace_lo is not None]
+        frac = journal.stitch_fraction(
+            ids, tracing.flight_recorder,
+            require=("enqueue", "terminal"))
+        return {"journal": {"completeness_gap": comp["gap"],
+                            "events": len(merged["events"]),
+                            "wrapped": comp["wrapped"]},
+                "trace": {"stitch_frac": frac,
+                          "sampled_traces": len(ids)}}
+
     optional("coalesced", phase_coalesced)   # most valuable first
     optional("pipelined", phase_pipelined)
     optional("singles", phase_singles)
     optional("trickle", phase_trickle)
     optional("service", phase_service)
+    optional("journal", phase_journal)
     optional("hash", phase_hash)
     # hardware-independent, so it must never delay the on-device record
     # above — the live window can be minutes long (round 4: ~3 min total)

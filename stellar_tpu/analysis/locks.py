@@ -77,6 +77,12 @@ SCOPE = [
     # so the prover's graph covers the whole wire path and any future
     # lock sneaking in is caught, not argued
     "stellar_tpu/utils/wire.py",
+    # the unified system journal (ISSUE 20) is likewise lock-free by
+    # design — it reads other components' logs through THEIR locked
+    # accessors and never holds anything itself; scoped so a lock
+    # (and with it a new ordering edge against the component locks it
+    # reads under) can never sneak in unseen
+    "stellar_tpu/utils/journal.py",
     # the reusable receive-buffer pool (ISSUE 19): free list + lease
     # refcounts mutate from reader and responder threads under the
     # pool's one lock

@@ -82,6 +82,13 @@ HOST_ORACLE_FILES = [
     # two nodes decoding the same bytes always agree
     "stellar_tpu/crypto/ingress.py",
     "stellar_tpu/utils/wire.py",
+    # the unified system journal (ISSUE 20): merge order, the
+    # completeness residual and the canonical bytes must be pure
+    # functions of the logs they are handed — one clock or RNG draw
+    # and two replicas' merged journals could differ while both are
+    # honest, which is exactly the divergence the merge is built to
+    # convict. NO allowlist entry (pinned in test_analysis.py)
+    "stellar_tpu/utils/journal.py",
     # the workload-agnostic batch engine owns dispatch, re-shard,
     # audit-sample composition, and host-oracle failover for EVERY
     # plugin — a clock or RNG here would desynchronize which rows any

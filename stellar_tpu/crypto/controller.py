@@ -260,6 +260,19 @@ class VerifyController:
             log = list(self._log)
         return log[-limit:] if limit else log
 
+    def journal_log(self, limit: int = 0) -> list:
+        """The control log rendered as unified-journal rows (ISSUE
+        20): one dict per evaluated window, keyed by the window seq —
+        already monotone and deterministic, so the journal merge can
+        key control events by ``(component, seq)`` without a second
+        counter. Same bit-identity contract as :meth:`control_log`."""
+        return [
+            {"seq": seq, "kind": "control", "action": action,
+             "max_batch": mb, "pipeline_depth": pd,
+             "highwater_milli": hw, "reason": reason}
+            for action, seq, mb, pd, hw, reason
+            in self.control_log(limit)]
+
     def windows(self, limit: int = 0) -> list:
         """The retained input windows, in step order (the replay
         input; bounded by the same cap as the log)."""

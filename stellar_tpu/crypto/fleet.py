@@ -76,6 +76,7 @@ import hashlib
 import inspect
 import os
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from stellar_tpu.crypto import batch_verifier
@@ -273,6 +274,17 @@ class FleetRouter:
         self._convictions = 0
         self._readmissions = 0
         self._conviction_log: List[dict] = []
+        # unified system journal feed (ISSUE 20): one bounded,
+        # in-order route/refusal log keyed by a monotone per-router
+        # seq — ``stellar_tpu/utils/journal.py`` merges it with the
+        # replicas' feeds. Routing is a pure rendezvous draw, so two
+        # routers fed the same stream produce bit-identical feeds;
+        # the never-evicting totals keep the completeness law
+        # checkable after the bounded row log wraps.
+        self._route_log: deque = deque(maxlen=self._ledger_cap)
+        self._route_seq = 0
+        self._route_totals = {"routed": 0, "refused": 0,
+                              "rerouted": 0}
         self._running = False
 
     # ---------------- construction helpers ----------------
@@ -371,18 +383,26 @@ class FleetRouter:
         arrives, so the ``trace?id=`` timeline starts on the wire and
         the block survives routing AND any later handoff re-route
         (``_resubmit_locked`` already preserved it). None = the
-        replica's service allocates a fresh block."""
+        router allocates the block itself (ISSUE 20) so the routing
+        decision — emitted as a ``fleet.route`` recorder event with
+        its rendezvous score BEFORE the replica's ``service.enqueue``
+        — is part of the stitched timeline even for direct fleet
+        submissions, and a total refusal still names its traces."""
         if lane not in vs_mod.LANES:
             raise ValueError(
                 f"unknown lane {lane!r} (one of {vs_mod.LANES})")
         tenant = tenant_mod.validate_tenant(tenant)
         items = list(items)
         n = len(items)
+        if trace_lo is None:
+            trace_lo = vs_mod._alloc_trace_block(n)
+        trange = [[trace_lo, trace_lo + n]] if n else []
         with self._lock:
             if not self._running:
                 raise Overloaded(
                     "verify fleet is stopped", kind="rejected",
-                    lane=lane, reason="stopped", tenant=tenant)
+                    lane=lane, reason="stopped", tenant=tenant,
+                    trace_ids=range(trace_lo, trace_lo + n))
             self._routes += 1
             self._submitted += n
             idx = self._route_locked(lane, tenant)
@@ -390,18 +410,38 @@ class FleetRouter:
             if idx is None:
                 # every replica convicted/dead: refuse typed — these
                 # items reached no replica's counters, so they carry
-                # their own conservation terminal
+                # their own conservation terminal (and their trace
+                # block: the refusal IS the stitched terminal)
                 self._router_refused += n
                 registry.meter(
                     "crypto.verify.fleet.router_refused").mark(n)
+                self._journal_note_locked(
+                    "refused", lane, tenant, None, trace_lo, n,
+                    reason="fleet-quarantined")
+                batch_verifier.note_trace_event(
+                    "fleet.refuse", lane=lane, tenant=tenant,
+                    reason="fleet-quarantined", traces=trange,
+                    items=n)
                 raise Overloaded(
                     "no routable fleet replica (all quarantined or "
                     "dead)", kind="rejected", lane=lane,
-                    reason="fleet-quarantined", tenant=tenant)
+                    reason="fleet-quarantined", tenant=tenant,
+                    trace_ids=range(trace_lo, trace_lo + n))
             rep = self._replicas[idx]
             rep["routed_submissions"] += 1
             rep["routed_items"] += n
             registry.meter("crypto.verify.fleet.routed").mark(n)
+            # the routing decision precedes the replica's
+            # service.enqueue/service.reject in the recorder, so the
+            # stitched timeline reads wire -> route -> replica in
+            # causal order (tracing.trace_timeline relies on it)
+            self._journal_note_locked(
+                "route", lane, tenant, idx, trace_lo, n,
+                score=route_score(route_key(lane, tenant), idx))
+            batch_verifier.note_trace_event(
+                "fleet.route", lane=lane, tenant=tenant, replica=idx,
+                score=route_score(route_key(lane, tenant), idx),
+                route=self._routes, traces=trange, items=n)
             try:
                 tkt = rep["service"].submit(items, lane=lane,
                                             tenant=tenant,
@@ -516,6 +556,7 @@ class FleetRouter:
                 "divergence_convictions": self._convictions,
                 "readmissions": self._readmissions,
                 "conviction_log": list(self._conviction_log),
+                "route_totals": dict(self._route_totals),
                 "pending_items": pending,
                 "totals": totals,
                 "conservation_gap": gap,
@@ -546,6 +587,56 @@ class FleetRouter:
                  if rep["state"] in _ROUTABLE]
         return _pick(cands, route_key(lane, tenant))
 
+    def _journal_note_locked(self, kind: str, lane: str, tenant,
+                             replica, trace_lo, n: int,
+                             **extra) -> None:
+        """Append one row to the router's journal feed (called with
+        the router lock held). Rows are pure functions of the
+        submission stream and the rendezvous draw — no clock reads —
+        so two routers fed identical streams produce bit-identical
+        feeds. The totals obey one exact law the completeness check
+        reads: ``routed + rerouted + refused == submitted +
+        handoffs`` (every submission routes or refuses; every
+        drained ticket re-routes or refuses)."""
+        row = {"seq": self._route_seq, "kind": kind, "lane": lane,
+               "tenant": tenant, "replica": replica,
+               "trace_lo": trace_lo, "n": n}
+        if extra:
+            row.update(extra)
+        self._route_seq += 1
+        self._route_log.append(row)
+        tot = self._route_totals
+        if kind == "route":
+            tot["rerouted" if extra.get("handoff") else "routed"] \
+                += n
+        elif kind == "refused":
+            tot["refused"] += n
+
+    def route_log(self, limit: int = 0) -> list:
+        """The bounded route/refusal journal feed (ISSUE 20): one
+        dict row per routing decision (``route``, with the rendezvous
+        score and ``handoff=True`` on a re-route) and per total
+        refusal (``refused``), each naming the trace block it covers.
+        ``limit`` bounds the tail returned (0 = all retained)."""
+        with self._lock:
+            log = [dict(r) for r in self._route_log]
+        return log[-limit:] if limit else log
+
+    def route_totals(self) -> dict:
+        """Never-evicting aggregates behind the route feed — the
+        fleet half of the journal completeness law (see
+        :func:`stellar_tpu.utils.journal.completeness`)."""
+        with self._lock:
+            return dict(self._route_totals)
+
+    def services(self) -> list:
+        """The replica services, in replica order — the journal
+        collector (ISSUE 20) walks them for their per-replica feeds;
+        dead replicas stay listed (their journal history is exactly
+        what a post-mortem needs)."""
+        with self._lock:
+            return [rep["service"] for rep in self._replicas]
+
     def _ledger_record_locked(self, idx: int, seq: int, lane: str,
                               tenant: str) -> None:
         led = self._ledgers[idx]
@@ -565,6 +656,15 @@ class FleetRouter:
             registry.meter(
                 "crypto.verify.fleet.router_refused"
             ).mark(tkt.n_items)
+            self._journal_note_locked(
+                "refused", tkt.lane, tkt.tenant, None, tkt.trace_lo,
+                tkt.n_items, reason="fleet-quarantined",
+                handoff=True)
+            batch_verifier.note_trace_event(
+                "fleet.refuse", lane=tkt.lane, tenant=tkt.tenant,
+                reason="fleet-quarantined", handoff=True,
+                traces=[[tkt.trace_lo, tkt.trace_lo + tkt.n_items]],
+                items=tkt.n_items)
             tkt._fut.set_exception(Overloaded(
                 "no routable fleet replica for handoff",
                 kind="rejected", lane=tkt.lane,
@@ -572,6 +672,22 @@ class FleetRouter:
                 trace_ids=tkt.trace_ids))
             return
         rep = self._replicas[idx]
+        # the handoff re-route is a first-class routing decision in
+        # the stitched timeline (ISSUE 20): it lands BEFORE the
+        # survivor's service.enqueue, so a re-homed trace reads
+        # handoff -> route -> enqueue -> verdict with no seam
+        self._journal_note_locked(
+            "route", tkt.lane, tkt.tenant, idx, tkt.trace_lo,
+            tkt.n_items,
+            score=route_score(route_key(tkt.lane, tkt.tenant), idx),
+            handoff=True)
+        batch_verifier.note_trace_event(
+            "fleet.route", lane=tkt.lane, tenant=tkt.tenant,
+            replica=idx, handoff=True,
+            score=route_score(route_key(tkt.lane, tkt.tenant), idx),
+            route=self._routes,
+            traces=[[tkt.trace_lo, tkt.trace_lo + tkt.n_items]],
+            items=tkt.n_items)
         try:
             new = rep["service"].submit(tkt._items, lane=tkt.lane,
                                         tenant=tkt.tenant,
@@ -622,6 +738,9 @@ class FleetRouter:
         rep["breaker"].trip()
         self._convictions += 1
         self._conviction_log.append({
+            # monotone conviction seq (ISSUE 20): the journal merge
+            # keys fleet conviction events by it
+            "seq": self._convictions,
             "replica": idx,
             "at_route": self._routes,
             "probation_due": rep["probation_due"],

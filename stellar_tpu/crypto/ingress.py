@@ -110,12 +110,22 @@ _MV = memoryview
 
 _health_lock = threading.Lock()
 _health_provider = None
+_server_ref = None
 
 
 def register_ingress_health(provider) -> None:
     global _health_provider
     with _health_lock:
         _health_provider = provider
+
+
+def running_server():
+    """The last-started (still-running) server instance, or None —
+    the journal collector (ISSUE 20) reads its wire totals through
+    this, the same last-started-instance policy as the health
+    surface."""
+    with _health_lock:
+        return _server_ref
 
 
 def ingress_health() -> dict:
@@ -208,6 +218,9 @@ class IngressServer:
             self._accept_t = t
         t.start()
         register_ingress_health(self.snapshot)
+        global _server_ref
+        with _health_lock:
+            _server_ref = self
         return self
 
     def stop(self, timeout: Optional[float] = None) -> None:
@@ -540,6 +553,30 @@ class IngressServer:
                 "crypto.verify.ingress.bytes_out").mark(len(fb))
 
     # ---------------- observability ----------------
+
+    def journal_totals(self) -> dict:
+        """Never-evicting wire totals for the unified journal (ISSUE
+        20) — the ingress half of the completeness law
+        (:func:`stellar_tpu.utils.journal.completeness` reconciles
+        them against the fleet/service terminals). The wire counters
+        depend on socket timing (how much a flooder got through), so
+        the journal treats ingress as a NONDETERMINISTIC component:
+        included in the completeness reconciliation, excluded from
+        the bit-identity merge. No gauge side effects — journal
+        collection must be a pure read (unlike :meth:`snapshot`)."""
+        with self._cv:
+            return {
+                "frames_received": self._frames_received,
+                "decoded_frames": self._decoded_frames,
+                "malformed_frames": self._malformed_frames,
+                "items_decoded": self._items_decoded,
+                "accepted": self._accepted,
+                "refused": self._refused,
+                "resolved": self._resolved,
+                "shed": self._shed,
+                "failed": self._failed,
+                "pending": self._pending,
+            }
 
     def snapshot(self) -> dict:
         """The ingress surface: every wire counter plus the

@@ -396,6 +396,15 @@ def _alloc_trace_block(n: int) -> int:
     return lo
 
 
+def allocated_traces() -> int:
+    """One past the highest trace ID ever issued (IDs start at 1) —
+    the typed ``trace?id=`` error path (ISSUE 20) uses it to tell a
+    ``never-admitted`` ID from one that was issued but has
+    ``expired`` out of the bounded recorder ring."""
+    with _trace_lock:
+        return _trace_next
+
+
 def configure_service(lane_depth: Optional[int] = None,
                       lane_bytes: Optional[int] = None,
                       max_batch: Optional[int] = None,
@@ -551,6 +560,18 @@ class VerifyService:
         self._tenant_counts: Dict[str, dict] = {}
         # bounded in-order scheduling/shed decision log (ISSUE 14)
         self._decisions: deque = deque(maxlen=max(16, DECISION_LOG))
+        # unified system journal feed (ISSUE 20): one bounded,
+        # in-order admission/terminal event log keyed by a monotone
+        # per-component seq — ``stellar_tpu/utils/journal.py`` merges
+        # these feeds across replicas into the fleet-wide journal.
+        # The aggregate totals are plain integers that never evict,
+        # so the journal completeness law stays checkable even after
+        # the bounded row log wraps.
+        self._journal: deque = deque(maxlen=max(16, DECISION_LOG))
+        self._jseq = 0
+        self._journal_totals = {"submitted": 0, "verified": 0,
+                                "failed": 0, "rejected": 0,
+                                "shed": 0, "handoff": 0}
         self._seq = 0
         self._batches = 0
         self._pressure = 0
@@ -582,8 +603,15 @@ class VerifyService:
             self._running = True
             self._stop = False
             self._drain = True
+            # a fleet replica's dispatcher carries its identity in
+            # the thread name (ISSUE 20): flight-recorder records tag
+            # the emitting thread, so the stitched timeline and the
+            # per-replica Chrome tracks can tell replicas apart even
+            # though they share one process-wide recorder
+            tname = ("verify-service" if self.replica is None
+                     else f"verify-service/{self.replica}")
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name="verify-service")
+                target=self._run, daemon=True, name=tname)
         self._thread.start()
         batch_verifier.register_service_health(self.snapshot)
         global _tenant_provider, _control_provider
@@ -686,6 +714,9 @@ class VerifyService:
                 batch_verifier.note_trace_event(
                     "service.reject", lane=lane, reason=reason,
                     tenant=tenant, traces=trange, items=n)
+                self._journal_note_locked(
+                    "rejected", lane, tenant, self._seq, trace_lo, n,
+                    reason=reason)
                 raise Overloaded(
                     f"verify service {lane} lane over budget "
                     f"({reason})", kind="rejected", lane=lane,
@@ -715,6 +746,8 @@ class VerifyService:
             batch_verifier.note_trace_event(
                 "service.enqueue", lane=lane, tenant=tenant,
                 traces=trange, seq=tkt._seq, items=n)
+            self._journal_note_locked(
+                "enqueue", lane, tenant, tkt._seq, trace_lo, n)
             self._cv.notify_all()
         return tkt
 
@@ -788,6 +821,9 @@ class VerifyService:
                         replica=self.replica,
                         traces=[[tkt.trace_lo,
                                  tkt.trace_lo + tkt.n_items]])
+                    self._journal_note_locked(
+                        "handoff", ln, tkt.tenant, tkt._seq,
+                        tkt.trace_lo, tkt.n_items)
                     out.append(tkt)
                 self._publish_lane_gauges_locked(ln)
         return out
@@ -883,6 +919,31 @@ class VerifyService:
             log = list(self._decisions)
         return log[-limit:] if limit else log
 
+    def journal_log(self, limit: int = 0) -> list:
+        """The bounded journal feed (ISSUE 20): one dict row per
+        admission (``enqueue``) and per terminal (``verified`` /
+        ``failed`` / ``rejected`` / ``shed`` / ``handoff``), each
+        carrying a monotone per-component ``seq``, the ticket seq,
+        the trace block ``(trace_lo, n)`` and — for refusals/sheds —
+        the typed reason. Pure content, no clock reads: two replicas
+        fed identical arrival order produce identical feeds (the
+        bit-identity surface ``stellar_tpu/utils/journal.py`` merges
+        and ``tools/journal_selfcheck.py`` gates on). ``limit``
+        bounds the tail returned (0 = all retained)."""
+        with self._cv:
+            log = [dict(r) for r in self._journal]
+        return log[-limit:] if limit else log
+
+    def journal_totals(self) -> dict:
+        """Never-evicting aggregate counts behind the journal feed:
+        items enqueued plus each terminal kind. These reconcile
+        EXACTLY with the per-lane conservation counters (``submitted
+        == journal.submitted + journal.rejected``; every terminal
+        matches), which is half of the journal completeness law —
+        :func:`stellar_tpu.utils.journal.completeness` checks it."""
+        with self._cv:
+            return dict(self._journal_totals)
+
     def control_log(self, limit: int = 0) -> list:
         """The attached controller's bounded knob-trajectory log
         (ISSUE 15); empty when no controller is attached."""
@@ -908,6 +969,28 @@ class VerifyService:
     # ---------------- dispatcher internals ----------------
     # _locked helpers are called with self._cv held (the repo-wide
     # naming contract the lock lint encodes).
+
+    def _journal_note_locked(self, kind: str, lane: str, tenant,
+                             seq: int, trace_lo, n: int,
+                             **extra) -> None:
+        """Append one row to this replica's journal feed (called with
+        the cv held). Rows are pure functions of admission content and
+        queue state — no clock reads, no RNG — so the feed is
+        bit-identical across replicas under identical arrival order.
+        The aggregate totals update on the same append path, so the
+        bounded row log and the totals can never disagree."""
+        row = {"seq": self._jseq, "kind": kind, "lane": lane,
+               "tenant": tenant, "ticket": seq,
+               "trace_lo": trace_lo, "n": n}
+        if extra:
+            row.update(extra)
+        self._jseq += 1
+        self._journal.append(row)
+        tot = self._journal_totals
+        if kind == "enqueue":
+            tot["submitted"] += n
+        elif kind in tot:
+            tot[kind] += n
 
     def _tenant_counts_locked(self, tenant: str) -> dict:
         """Get-or-create one tenant's conservation counters, folding
@@ -1019,6 +1102,9 @@ class VerifyService:
                 if not self._shed_seen:
                     self._shed_seen = True
                     onset = why
+                self._journal_note_locked(
+                    "shed", ln, tkt.tenant, tkt._seq, tkt.trace_lo,
+                    tkt.n_items, reason=why, level=level)
                 batch_verifier.note_trace_event(
                     "service.shed", lane=ln, reason=why, level=level,
                     tenant=tkt.tenant,
@@ -1058,6 +1144,9 @@ class VerifyService:
                     tenant=tkt.tenant,
                     traces=[[tkt.trace_lo,
                              tkt.trace_lo + tkt.n_items]])
+                self._journal_note_locked(
+                    "shed", ln, tkt.tenant, tkt._seq, tkt.trace_lo,
+                    tkt.n_items, reason="stopped")
                 tkt._fut.set_exception(Overloaded(
                     "service stopped without drain", kind="shed",
                     lane=ln, reason="stopped", tenant=tkt.tenant,
@@ -1206,6 +1295,9 @@ class VerifyService:
             tc = self._tenant_counts_locked(tkt.tenant)
             tc[outcome] += tkt.n_items
             tc["pending"] -= tkt.n_items
+            self._journal_note_locked(
+                outcome, ln, tkt.tenant, tkt._seq, tkt.trace_lo,
+                tkt.n_items)
             left = ti.get(tkt.tenant, 0) - tkt._nbytes
             if left > 0:
                 ti[tkt.tenant] = left

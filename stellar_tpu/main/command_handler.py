@@ -108,10 +108,15 @@ class CommandHandler:
         returns the full dump payloads; ``limit=N`` bounds the recent
         window; ``spans?format=chrome`` renders the recorder as Chrome
         ``trace_event`` JSON (load in chrome://tracing / Perfetto —
-        also exported by ``tools/trace_export.py``)."""
+        also exported by ``tools/trace_export.py``);
+        ``spans?format=chrome&fleet=true`` (ISSUE 20) splits the
+        export into per-replica process tracks merged on the one
+        recorder clock — the whole-fleet window."""
         from stellar_tpu.utils import tracing
         if params.get("format", ["json"])[0] == "chrome":
-            return tracing.flight_recorder.to_chrome_trace()
+            by_replica = params.get("fleet", ["false"])[0] == "true"
+            return tracing.flight_recorder.to_chrome_trace(
+                by_replica=by_replica)
         try:
             limit = int(params.get("limit", ["128"])[0])
         except ValueError:
@@ -123,21 +128,83 @@ class CommandHandler:
 
     def cmd_trace(self, params):
         """One item's end-to-end timeline (ISSUE 8): ``trace?id=N``
-        reconstructs the submission's path — service enqueue, lane
-        wait, batch coalesce, dispatch, engine sub-chunk fetch/audit/
-        host-fallback, verdict (or shed/reject) — from the flight
-        recorder's exemplar-tagged records. Served directly: tracing
-        exists to explain a node that is misbehaving, so it must not
-        depend on the main thread (same policy as ``spans``)."""
+        reconstructs the submission's path — wire frame, fleet route,
+        service enqueue, lane wait, batch coalesce, dispatch, engine
+        sub-chunk fetch/audit/host-fallback, verdict (or shed/
+        reject), with cross-replica handoff hops stitched in (ISSUE
+        20, the ``stitch`` section) — from the flight recorder's
+        exemplar-tagged records. Served directly: tracing exists to
+        explain a node that is misbehaving, so it must not depend on
+        the main thread (same policy as ``spans``).
+
+        Misses return a typed ``{"error", "reason"}`` body (ISSUE
+        20): ``never-admitted`` — the ID is beyond the allocator, no
+        such trace was ever issued; ``expired`` — the ID was issued
+        but every record has aged out of the bounded ring;
+        ``bad-request`` — the param is missing or malformed."""
         from stellar_tpu.utils import tracing
         tid = params.get("id", [None])[0]
         if tid is None:
-            return {"error": "missing id param (trace?id=N)"}
+            return {"error": "missing id param (trace?id=N)",
+                    "reason": "bad-request"}
         try:
             tid = int(tid)
         except ValueError:
-            return {"error": "bad id param"}
-        return tracing.flight_recorder.trace_timeline(tid)
+            return {"error": "bad id param", "reason": "bad-request"}
+        tl = tracing.flight_recorder.trace_timeline(tid)
+        if not tl["found"]:
+            from stellar_tpu.crypto import verify_service
+            if tid < 0 or tid >= verify_service.allocated_traces():
+                return {"error": f"trace {tid} was never admitted "
+                                 "(beyond the allocator)",
+                        "reason": "never-admitted", "trace": tid}
+            return {"error": f"trace {tid} has expired from the "
+                             "bounded recorder ring",
+                    "reason": "expired", "trace": tid}
+        return tl
+
+    def cmd_journal(self, params):
+        """The unified system journal (ISSUE 20,
+        docs/observability.md §12): the running fleet's (or bare
+        service's) deterministic feeds — route/refusal rows, replica
+        admission/terminal rows, scheduling decisions, control moves,
+        convictions — merged into one ``(component, seq)``-keyed
+        stream, plus the completeness-law verdict
+        (``completeness.gap`` must read 0). ``journal?events=false``
+        drops the merged stream (totals + law only);
+        ``limit=N`` bounds each component's retained tail. Served
+        directly — the journal exists to explain a misbehaving
+        system, so it must not depend on the main thread (same
+        policy as ``trace``/``spans``)."""
+        from stellar_tpu.crypto import fleet as fleet_mod
+        from stellar_tpu.crypto import ingress as ingress_mod
+        from stellar_tpu.crypto import verify_service
+        from stellar_tpu.utils import journal
+        fl = fleet_mod.running_fleet()
+        services = None
+        if fl is None:
+            svc = verify_service.running_service()
+            if svc is None:
+                return {"error": "no running fleet or verify "
+                                 "service to journal",
+                        "reason": "no-source"}
+            services = [svc]
+        srv = ingress_mod.running_server()
+        col = journal.collect(fleet=fl, services=services,
+                              ingress=srv)
+        merged = journal.merge(col)
+        out = {"totals": merged["totals"],
+               "nondet": merged["nondet"],
+               "completeness": journal.completeness(merged)}
+        if params.get("events", ["true"])[0] != "false":
+            events = merged["events"]
+            try:
+                limit = int(params.get("limit", ["0"])[0])
+            except ValueError:
+                return {"error": "bad limit param",
+                        "reason": "bad-request"}
+            out["events"] = events[-limit:] if limit > 0 else events
+        return out
 
     def cmd_dispatch(self, params):
         """Verify-dispatch resilience surface: breaker state, backend
@@ -703,7 +770,8 @@ class CommandHandler:
     ROUTES = {
         "info": cmd_info, "metrics": cmd_metrics, "peers": cmd_peers,
         "dispatch": cmd_dispatch, "spans": cmd_spans,
-        "trace": cmd_trace, "service": cmd_service,
+        "trace": cmd_trace, "journal": cmd_journal,
+        "service": cmd_service,
         "pipeline": cmd_pipeline, "timeseries": cmd_timeseries,
         "slo": cmd_slo, "tenant": cmd_tenant,
         "control": cmd_control,

@@ -302,11 +302,48 @@ class FlightRecorder:
         shed = first("service.shed") or first("service.reject")
         if shed is not None:
             summary["dropped"] = shed["name"]
+
+        # cross-replica stitch summary (ISSUE 20): which segments of
+        # the wire -> route -> replica -> verdict path are present,
+        # the replica hop sequence (one entry per fleet.route, with
+        # the rendezvous score; handoff re-routes flagged), and the
+        # seam check — every service.handoff must be followed by a
+        # re-admission on a survivor, so a re-homed trace's timeline
+        # reads handoff -> route -> enqueue -> verdict with no gap.
+        def every(name):
+            return [r for r in records if r["name"] == name]
+
+        routes = every("fleet.route")
+        handoffs = every("service.handoff")
+        enqueues = every("service.enqueue")
+        terminal = (first("service.verdict") or first("service.shed")
+                    or first("service.reject")
+                    or first("fleet.refuse"))
+        hops = [{"replica": r.get("attrs", {}).get("replica"),
+                 "score": r.get("attrs", {}).get("score"),
+                 "handoff": bool(r.get("attrs", {}).get("handoff"))}
+                for r in routes]
+        order = {r["id"]: i for i, r in enumerate(records)}
+        seamless = all(
+            any(order[e["id"]] > order[h["id"]] for e in enqueues)
+            for h in handoffs)
+        stitch = {
+            "wire": bool(first("ingress.frame")),
+            "route": bool(routes),
+            "enqueue": bool(enqueues),
+            "terminal": terminal["name"] if terminal else None,
+            "hops": hops,
+            "handoffs": len(handoffs),
+            "seamless": seamless,
+            "end_to_end": (bool(first("ingress.frame"))
+                           and bool(routes) and terminal is not None
+                           and seamless),
+        }
         return {"trace": tid, "found": bool(records),
                 "records": records, "phases": phases,
-                "summary": summary}
+                "summary": summary, "stitch": stitch}
 
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self, by_replica: bool = False) -> dict:
         """Render the recorder as Chrome ``trace_event`` JSON (the
         ``chrome://tracing`` / Perfetto import format): thread-named
         tracks (metadata ``M`` events), completed spans as properly
@@ -322,7 +359,16 @@ class FlightRecorder:
         span clock, so one chrome://tracing load shows spans, bytes
         AND utilization (ISSUE 10). Served by ``spans?format=chrome``
         and the ``tools/trace_export.py`` CLI
-        (docs/observability.md)."""
+        (docs/observability.md).
+
+        ``by_replica=True`` (ISSUE 20, ``spans?format=chrome&
+        fleet=true``): the whole-fleet window. Records attributable
+        to a fleet replica — a ``replica`` attribute, or a
+        ``verify-service/<i>`` dispatcher thread — move to per-replica
+        process tracks (pid ``2 + i``, named by ``process_name``
+        metadata) while everything else stays on the host track (pid
+        1). All tracks share the ONE recorder clock, so cross-replica
+        ordering in the merged view is real, not cosmetic."""
         with self._lock:
             done = [dict(r) for r in self._ring]
             open_ = [dict(r, open=True)
@@ -333,11 +379,32 @@ class FlightRecorder:
                     if r.get("event") or r.get("dur_ms") is None]
         instants += open_
         tids: Dict[str, int] = {}
+        seen_tracks: Dict[tuple, str] = {}
 
         def tid_of(thread: str) -> int:
             if thread not in tids:
                 tids[thread] = len(tids) + 1
             return tids[thread]
+
+        def pid_of(r) -> int:
+            if not by_replica:
+                return 1
+            rep = (r.get("attrs") or {}).get("replica")
+            if rep is None:
+                th = r.get("thread", "")
+                if th.startswith("verify-service/"):
+                    tail = th.rsplit("/", 1)[1]
+                    if tail.isdigit():
+                        rep = int(tail)
+            try:
+                return 1 if rep is None else 2 + int(rep)
+            except (TypeError, ValueError):
+                return 1
+
+        def track(pid: int, r) -> int:
+            tid = tid_of(r["thread"])
+            seen_tracks.setdefault((pid, tid), r["thread"])
+            return tid
 
         by_id = {r["id"]: r for r in spans}
         children: Dict[int, list] = {}
@@ -350,24 +417,25 @@ class FlightRecorder:
                 roots.setdefault(r["thread"], []).append(r)
         events: List[dict] = []
 
-        def emit(r, lo_ms: float, hi_ms: float) -> float:
+        def emit(r, lo_ms: float, hi_ms: float, pid: int) -> float:
             """Emit one span's B/E pair (and its subtree), clamped to
             the parent interval [lo_ms, hi_ms]; returns this span's
-            end so siblings can't overlap."""
+            end so siblings can't overlap. The subtree inherits the
+            root's pid — nesting must stay within one track."""
             t0 = min(max(r["start_ms"], lo_ms), hi_ms)
             t1 = min(max(t0, r["start_ms"] + r["dur_ms"]), hi_ms)
-            tid = tid_of(r["thread"])
+            tid = track(pid, r)
             args = {"id": r["id"]}
             if r.get("attrs"):
                 args.update(r["attrs"])
-            events.append({"name": r["name"], "ph": "B", "pid": 1,
+            events.append({"name": r["name"], "ph": "B", "pid": pid,
                            "tid": tid, "ts": round(t0 * 1000.0, 1),
                            "args": args})
             cursor = t0
             for c in sorted(children.get(r["id"], []),
                             key=lambda x: (x["start_ms"], x["id"])):
-                cursor = emit(c, max(cursor, t0), t1)
-            events.append({"name": r["name"], "ph": "E", "pid": 1,
+                cursor = emit(c, max(cursor, t0), t1, pid)
+            events.append({"name": r["name"], "ph": "E", "pid": pid,
                            "tid": tid, "ts": round(t1 * 1000.0, 1)})
             return t1
 
@@ -375,7 +443,7 @@ class FlightRecorder:
             cursor = 0.0
             for r in sorted(rs, key=lambda x: (x["start_ms"], x["id"])):
                 cursor = emit(r, max(cursor, r["start_ms"]),
-                              float("inf"))
+                              float("inf"), pid_of(r))
         for r in instants:
             args = {"id": r["id"]}
             if r.get("attrs"):
@@ -384,8 +452,9 @@ class FlightRecorder:
                 args["open"] = True
             if r.get("abandoned"):
                 args["abandoned"] = True
-            events.append({"name": r["name"], "ph": "i", "pid": 1,
-                           "tid": tid_of(r["thread"]), "s": "t",
+            pid = pid_of(r)
+            events.append({"name": r["name"], "ph": "i", "pid": pid,
+                           "tid": track(pid, r), "s": "t",
                            "ts": round(r["start_ms"] * 1000.0, 1),
                            "args": args})
         # pipeline utilization + transfer-byte counter tracks
@@ -397,10 +466,16 @@ class FlightRecorder:
             events += pipeline_timeline.chrome_counter_events()
         except ImportError:  # pragma: no cover — import-order edge
             pass
-        meta = [{"name": "thread_name", "ph": "M", "pid": 1,
+        meta = [{"name": "thread_name", "ph": "M", "pid": pid,
                  "tid": tid, "args": {"name": thread}}
-                for thread, tid in sorted(tids.items(),
-                                          key=lambda kv: kv[1])]
+                for (pid, tid), thread in sorted(seen_tracks.items())]
+        if by_replica:
+            pids = sorted({pid for pid, _tid in seen_tracks})
+            meta += [{"name": "process_name", "ph": "M", "pid": pid,
+                      "tid": 0,
+                      "args": {"name": ("host" if pid == 1 else
+                                        f"replica {pid - 2}")}}
+                     for pid in pids]
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
     def clear(self) -> None:

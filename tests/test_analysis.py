@@ -760,6 +760,21 @@ def test_lint_scopes_cover_signer_tables():
     assert st not in nondet.ALLOWLIST._entries
 
 
+def test_lint_scopes_cover_journal():
+    """ISSUE 20: the unified journal is the fleet's determinism
+    surface — two replicas' journals must merge bit-identically, so
+    journal.py must stay clock/RNG-free (nondet scope) and, being a
+    pure function of the logs it is handed, lock-free (lock scope
+    proves it grows no unordered lock). ZERO allowlist entries in
+    either lint: an excused journal is no determinism surface at
+    all."""
+    mod = "stellar_tpu/utils/journal.py"
+    assert mod in set(nondet.HOST_ORACLE_FILES)
+    assert mod in set(locks.SCOPE)
+    assert mod not in nondet.ALLOWLIST._entries
+    assert mod not in locks.ALLOWLIST._entries
+
+
 def test_lint_scopes_cover_pipeline_timeline():
     """ISSUE 10: the pipeline-bubble profiler's tokens and ring
     mutate from submitter + resolver + service-dispatcher threads —
@@ -1178,6 +1193,7 @@ def test_scope_sets_pinned():
         "stellar_tpu/parallel/signer_tables.py",
         "stellar_tpu/soroban/native_wasm.py",
         "stellar_tpu/utils/faults.py",
+        "stellar_tpu/utils/journal.py",
         "stellar_tpu/utils/metrics.py",
         "stellar_tpu/utils/wire.py",
         "stellar_tpu/utils/native.py",

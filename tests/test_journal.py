@@ -1,0 +1,296 @@
+"""Unified deterministic system journal (ISSUE 20): merge
+determinism across two identically-driven fleets, the completeness
+law under mixed verify/reject/shed/handoff/refusal terminals, the
+route-before-enqueue seam ordering, divergence refusal, and bounded
+memory with exact (never-evicting) totals. See
+docs/observability.md §12."""
+
+import time
+
+import numpy as np
+import pytest
+
+from stellar_tpu.crypto import batch_verifier as bv
+from stellar_tpu.crypto import fleet as fleet_mod
+from stellar_tpu.crypto import verify_service as vs
+from stellar_tpu.utils import journal, tracing
+from stellar_tpu.utils.resilience import Overloaded
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    tracing.flight_recorder.clear()
+    yield
+    tracing.flight_recorder.clear()
+    bv._reset_dispatch_state_for_testing()
+
+
+class _Instant:
+    def submit(self, items, trace_ids=None):
+        n = len(items)
+        return lambda: np.ones(n, dtype=bool)
+
+
+class _Slow:
+    """Slow enough that a mid-stream kill finds queued work."""
+
+    def submit(self, items, trace_ids=None):
+        n = len(items)
+
+        def resolve():
+            time.sleep(0.02)
+            return np.ones(n, dtype=bool)
+        return resolve
+
+
+def _items(i, n=2):
+    pk = bytes([(i * 31 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"journal-%d-%d" % (i, k),
+             bytes([(i + k) % 251]) * 16) for k in range(n)]
+
+
+KEY_GRID = [("bulk", None), ("bulk", "t0"), ("bulk", "t1"),
+            ("scp", None), ("scp", "t2"), ("auth", None),
+            ("bulk", "t3"), ("scp", "t4")]
+
+
+def _never_started_fleet(n=3, **knobs):
+    """fleet_selfcheck's discipline: dispatcher threads never run, so
+    a single-threaded replay is deterministic by construction."""
+    svcs = [vs.VerifyService(lane_depth=512, lane_bytes=10 ** 9)
+            for _ in range(n)]
+    for svc in svcs:
+        svc._running = True
+    fl = fleet_mod.FleetRouter(services=svcs, **knobs)
+    fl._running = True
+    return fl, svcs
+
+
+def _plan(count=48, kill_at=24):
+    """Pre-allocate the trace blocks ONCE so two fleets replaying the
+    plan journal the SAME trace IDs (the allocator is global)."""
+    plan = []
+    for i in range(count):
+        lane, tenant = KEY_GRID[i % len(KEY_GRID)]
+        items = _items(i)
+        plan.append((i == kill_at, lane, tenant,
+                     vs._alloc_trace_block(len(items)), items))
+    return plan
+
+
+def _replay(fl, svcs, plan):
+    for kill, lane, tenant, lo, items in plan:
+        if kill:
+            fl.kill_replica(0, stop_timeout=0)
+        try:
+            fl.submit(items, lane=lane, tenant=tenant, trace_lo=lo)
+        except Overloaded:
+            pass
+    for svc in svcs[1:]:
+        with svc._cv:
+            svc._shed_pass_locked()
+            while svc._collect_locked() is not None:
+                pass
+
+
+# ---------------- merge determinism ----------------
+
+
+def test_merge_determinism_across_two_fleets():
+    """Two fleets fed the identical submission stream (same trace
+    blocks, same mid-stream kill) journal bit-identically; and one
+    fleet double-collected merges bit-identically in either order."""
+    plan = _plan()
+    fa, sa = _never_started_fleet()
+    fb, sb = _never_started_fleet()
+    _replay(fa, sa, plan)
+    _replay(fb, sb, plan)
+    ma = journal.merge(journal.collect(fleet=fa))
+    mb = journal.merge(journal.collect(fleet=fb))
+    assert journal.canonical(ma) == journal.canonical(mb)
+    c1 = journal.collect(fleet=fa)
+    c2 = journal.collect(fleet=fa)
+    assert journal.canonical(journal.merge(c1, c2)) == \
+        journal.canonical(journal.merge(c2, c1))
+    for m in (ma, mb):
+        assert journal.completeness(m)["gap"] == 0
+
+
+def test_merge_refuses_conflicting_rows_and_totals():
+    j1 = {"components": {"c": [{"seq": 0, "kind": "a"}]},
+          "totals": {}, "nondet": {}}
+    j2 = {"components": {"c": [{"seq": 0, "kind": "b"}]},
+          "totals": {}, "nondet": {}}
+    with pytest.raises(journal.JournalDivergence):
+        journal.merge(j1, j2)
+    t1 = {"components": {}, "totals": {"fleet": {"submitted": 1}},
+          "nondet": {}}
+    t2 = {"components": {}, "totals": {"fleet": {"submitted": 2}},
+          "nondet": {}}
+    with pytest.raises(journal.JournalDivergence):
+        journal.merge(t1, t2)
+    # identical payloads under the same key are NOT a divergence
+    merged = journal.merge(j1, j1)
+    assert merged["components"]["c"] == j1["components"]["c"]
+
+
+# ---------------- the completeness law ----------------
+
+
+def test_completeness_law_under_mixed_terminals():
+    """verified + handoff + shed + rejected + fleet-refused all in
+    one window, and the merged journal still reconciles EXACTLY
+    (gap 0, drained)."""
+    svcs = [vs.VerifyService(verifier=_Slow(), lane_depth=512,
+                             lane_bytes=10 ** 9, max_batch=4,
+                             replica=i)
+            for i in range(3)]
+    fl = fleet_mod.FleetRouter(services=svcs,
+                               divergence_every=10 ** 6).start()
+    outcomes = {"verified": 0, "shed": 0, "rejected": 0,
+                "refused": 0}
+    try:
+        wave1 = [fl.submit(_items(i), lane="bulk",
+                           tenant="t%d" % (i % 5)) for i in range(20)]
+        moved = fl.kill_replica(0, stop_timeout=60)
+        assert moved > 0, "kill found nothing queued to hand off"
+        for t in wave1:
+            assert t.result(timeout=60).all()
+            outcomes["verified"] += 1
+        # shed: abort a survivor's queues under pressure
+        wave2 = [fl.submit(_items(100 + i), lane="bulk",
+                           tenant="t%d" % (i % 5)) for i in range(10)]
+        svcs[1].stop(drain=False, timeout=60)
+        for t in wave2:
+            try:
+                assert t.result(timeout=60).all()
+                outcomes["verified"] += 1
+            except Overloaded as e:
+                assert e.kind == "shed"
+                outcomes["shed"] += 1
+        # rejected: the stopped survivor still receives routes and
+        # refuses them typed (its reject rides the replica journal)
+        wave3 = []
+        for i in range(30, 40):
+            try:
+                wave3.append(fl.submit(_items(i), lane="bulk",
+                                       tenant="t%d" % i))
+            except Overloaded as e:
+                assert e.kind == "rejected"
+                outcomes["rejected"] += 1
+        for t in wave3:
+            assert t.result(timeout=60).all()
+            outcomes["verified"] += 1
+        # fleet-refused: quarantine every survivor, then submit
+        fl.convict(1, "test-quarantine")
+        fl.convict(2, "test-quarantine")
+        with pytest.raises(Overloaded) as ei:
+            fl.submit(_items(99), lane="bulk")
+        assert ei.value.reason == "fleet-quarantined"
+        outcomes["refused"] += 1
+    finally:
+        fl.stop(drain=True, timeout=60)
+    assert min(outcomes.values()) > 0, outcomes
+    m = journal.merge(journal.collect(fleet=fl))
+    comp = journal.completeness(m, drained=True)
+    assert comp["gap"] == 0, comp["checks"]
+    assert comp["wrapped"] == []
+    fleet_kinds = {r["kind"] for r in m["components"]["fleet"]}
+    assert {"route", "refused"} <= fleet_kinds
+    replica_kinds = set()
+    for cname, rows in m["components"].items():
+        if cname.startswith("replica/"):
+            replica_kinds |= {r["kind"] for r in rows}
+    assert {"enqueue", "verified", "handoff", "shed",
+            "rejected"} <= replica_kinds
+
+
+def test_completeness_flags_terminal_violations():
+    """The exactly-once sweep actually bites: a double terminal is a
+    positive gap, a missing terminal is a gap only once drained."""
+    double = {"components": {"replica/0": [
+        {"seq": 0, "kind": "enqueue", "trace_lo": 10, "n": 2},
+        {"seq": 1, "kind": "verified", "trace_lo": 10, "n": 2},
+        {"seq": 2, "kind": "verified", "trace_lo": 10, "n": 2},
+    ]}, "totals": {}, "nondet": {}}
+    assert journal.completeness(double)["gap"] == 2
+    missing = {"components": {"replica/0": [
+        {"seq": 0, "kind": "enqueue", "trace_lo": 10, "n": 2},
+    ]}, "totals": {}, "nondet": {}}
+    assert journal.completeness(missing)["gap"] == 0
+    assert journal.completeness(missing, drained=True)["gap"] == 2
+    # a handoff is a hop, not a terminal: the re-homed enqueue
+    # rebalances it and the one true terminal closes the trace
+    rehomed = {"components": {
+        "replica/0": [
+            {"seq": 0, "kind": "enqueue", "trace_lo": 4, "n": 1},
+            {"seq": 1, "kind": "handoff", "trace_lo": 4, "n": 1}],
+        "replica/1": [
+            {"seq": 0, "kind": "enqueue", "trace_lo": 4, "n": 1},
+            {"seq": 1, "kind": "verified", "trace_lo": 4, "n": 1}],
+    }, "totals": {}, "nondet": {}}
+    assert journal.completeness(rehomed, drained=True)["gap"] == 0
+
+
+# ---------------- seam ordering ----------------
+
+
+def test_route_precedes_enqueue_seam_order():
+    """The router journals and records its decision BEFORE the
+    replica's service.enqueue, so the stitched timeline reads
+    route -> enqueue -> verdict in causal order with no seam."""
+    svcs = [vs.VerifyService(verifier=_Instant(), lane_depth=512,
+                             lane_bytes=10 ** 9, replica=i)
+            for i in range(2)]
+    fl = fleet_mod.FleetRouter(services=svcs,
+                               divergence_every=10 ** 6).start()
+    try:
+        tkt = fl.submit(_items(1), lane="bulk", tenant="t0")
+        assert tkt.result(timeout=30).all()
+    finally:
+        fl.stop(drain=True, timeout=30)
+    tl = tracing.flight_recorder.trace_timeline(tkt.trace_lo)
+    names = [r["name"] for r in tl["records"]]
+    assert names.index("fleet.route") < names.index("service.enqueue")
+    st = tl["stitch"]
+    assert st["route"] and st["enqueue"]
+    assert st["terminal"] == "service.verdict"
+    assert st["seamless"]
+    # the journal agrees: the fleet's route row names the same block
+    m = journal.merge(journal.collect(fleet=fl))
+    route_rows = [r for r in m["components"]["fleet"]
+                  if r["kind"] == "route"
+                  and r["trace_lo"] == tkt.trace_lo]
+    assert route_rows and route_rows[0]["replica"] is not None
+
+
+# ---------------- bounded memory ----------------
+
+
+def test_journal_memory_bounded_totals_exact():
+    """The per-component feed is a bounded deque, but the totals
+    never evict — completeness stays checkable after wrap, and the
+    wrap is REPORTED, never silently mis-checked."""
+    svc = vs.VerifyService(verifier=_Instant(), lane_depth=64,
+                           lane_bytes=10 ** 9)
+    svc._running = True
+    cap = svc._journal.maxlen
+    n_sub = cap + 50
+    admitted = rejected = 0
+    for i in range(n_sub):
+        try:
+            svc.submit(_items(i, 1), lane="bulk")
+            admitted += 1
+        except Overloaded:
+            rejected += 1
+    assert rejected > 0
+    assert len(svc.journal_log()) <= cap
+    tot = svc.journal_totals()
+    assert tot["submitted"] == admitted
+    assert tot["rejected"] == rejected
+    m = journal.merge(journal.collect(services=[svc]))
+    comp = journal.completeness(m)
+    assert comp["wrapped"] == ["replica/0"]
+    assert comp["gap"] == 0, comp["checks"]
+    # limit= serves a bounded tail without touching the feed
+    assert len(svc.journal_log(limit=8)) == 8

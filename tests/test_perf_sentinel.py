@@ -484,3 +484,42 @@ def test_ingress_malformed_frames_change_is_note_not_fatal():
         _record(**{"ingress.malformed_frames": 26}))
     assert not any(n["path"] == "ingress.malformed_frames"
                    for n in steady["notes"])
+
+
+def test_journal_completeness_gap_is_hard_zero():
+    """ISSUE 20: the unified-journal completeness residual in a
+    committed capture is a HEAD-only ceiling at exactly 0 — a merged
+    journal that fails to reconcile with the conservation counters
+    means an admitted trace lost (or forged) a terminal. Captures
+    without a journal window skip the row, never fail it."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"journal.completeness_gap": 2}))
+    assert any(f["path"] == "journal.completeness_gap"
+               and f["rule"] == "max_abs" for f in out["findings"])
+    ok = sentinel.apply_rules(
+        _record(), _record(**{"journal.completeness_gap": 0}))
+    assert ok["ok"], ok["findings"]
+    steady = sentinel.apply_rules(_record(), _record())
+    assert steady["ok"], steady["findings"]
+    assert any(s.get("path") == "journal.completeness_gap"
+               and s.get("reason") == "missing"
+               for s in steady["skipped"])
+
+
+def test_trace_stitch_frac_floor_is_one():
+    """ISSUE 20: every sampled verdict trace on a selfcheck window
+    must reconstruct its stitched end-to-end timeline — the floor is
+    EXACTLY 1.0, and a record without the journal bench phase skips
+    the row instead of failing it."""
+    out = sentinel.apply_rules(
+        _record(), _record(**{"trace.stitch_frac": 0.97}))
+    assert any(f["path"] == "trace.stitch_frac"
+               and f["rule"] == "min_value" for f in out["findings"])
+    ok = sentinel.apply_rules(
+        _record(), _record(**{"trace.stitch_frac": 1.0}))
+    assert ok["ok"], ok["findings"]
+    steady = sentinel.apply_rules(_record(), _record())
+    assert steady["ok"], steady["findings"]
+    assert any(s.get("path") == "trace.stitch_frac"
+               and s.get("reason") == "missing"
+               for s in steady["skipped"])

@@ -240,6 +240,97 @@ def test_trace_route_reconstructs_each_lane_end_to_end():
         svc.stop(drain=False)
 
 
+# ---------------- cross-replica stitching (ISSUE 20) ----------------
+
+
+class _SlowVerifier:
+    """Slow enough that a mid-batch kill finds queued work."""
+
+    def submit(self, items, trace_ids=None):
+        import time
+        n = len(items)
+
+        def resolve():
+            time.sleep(0.02)
+            return np.ones(n, dtype=bool)
+        return resolve
+
+
+def test_handoff_trace_stitches_across_kill():
+    """PR 17 regression (ISSUE 20 satellite): a handed-off ticket's
+    timeline used to end at the kill — the stitched view must show
+    the handoff hop AND the surviving replica's completion with no
+    seam, for EVERY re-homed trace."""
+    from stellar_tpu.crypto import fleet as fleet_mod
+    svcs = [vs.VerifyService(verifier=_SlowVerifier(), lane_depth=512,
+                             lane_bytes=10 ** 9, max_batch=4,
+                             replica=i)
+            for i in range(2)]
+    fl = fleet_mod.FleetRouter(services=svcs,
+                               divergence_every=10 ** 6).start()
+    try:
+        batch = _sigs(2)   # sign ONCE — submits must outrun drains
+        tkts = [fl.submit(batch, lane="bulk", tenant=f"t{i % 6}")
+                for i in range(24)]
+        # kill whichever replica holds the deeper queue — rendezvous
+        # may have keyed most tenants onto one of the two
+        pend = [s.snapshot()["pending_items"] for s in svcs]
+        victim = max(range(len(svcs)), key=lambda i: pend[i])
+        moved = fl.kill_replica(victim, stop_timeout=60)
+        assert moved > 0, "kill found nothing queued to hand off"
+        for t in tkts:
+            assert t.result(timeout=60).all()
+    finally:
+        fl.stop(drain=True, timeout=60)
+    hopped = 0
+    for t in tkts:
+        st = tracing.flight_recorder.trace_timeline(
+            t.trace_lo)["stitch"]
+        assert st["route"] and st["enqueue"], st
+        assert st["terminal"] == "service.verdict", st
+        assert st["seamless"], st
+        if st["handoffs"] > 0:
+            # the hop names both replicas: original owner + survivor
+            assert len(st["hops"]) >= 2, st
+            assert st["hops"][-1]["handoff"] is True, st
+            assert st["hops"][-1]["replica"] != \
+                st["hops"][0]["replica"], st
+            hopped += 1
+    assert hopped > 0, "no re-homed trace crossed the kill"
+
+
+def test_trace_route_typed_errors():
+    """Unknown/expired/never-admitted trace IDs return structured
+    {"error", "reason"} bodies, pinned per reason."""
+    from stellar_tpu.main.command_handler import CommandHandler
+    out = CommandHandler.cmd_trace(None, {})
+    assert "error" in out and out["reason"] == "bad-request"
+    out = CommandHandler.cmd_trace(None, {"id": ["nope"]})
+    assert "error" in out and out["reason"] == "bad-request"
+    out = CommandHandler.cmd_trace(
+        None, {"id": [str(vs.allocated_traces() + 10 ** 6)]})
+    assert "error" in out and out["reason"] == "never-admitted"
+    # allocated, but the ring retains no record of it -> expired
+    tid = vs._alloc_trace_block(1)
+    out = CommandHandler.cmd_trace(None, {"id": [str(tid)]})
+    assert "error" in out and out["reason"] == "expired"
+
+
+def test_journal_route_serves_totals_and_typed_errors():
+    from stellar_tpu.main.command_handler import CommandHandler
+    svc = vs.VerifyService(verifier=_OracleVerifier()).start()
+    try:
+        assert svc.submit(_sigs(2), lane="bulk").result(
+            timeout=30).all()
+        out = CommandHandler.cmd_journal(None, {})
+        assert out["completeness"]["gap"] == 0
+        assert out["totals"] and "events" in out
+        bad = CommandHandler.cmd_journal(None, {"limit": ["nope"]})
+        assert "error" in bad and bad["reason"] == "bad-request"
+    finally:
+        svc.stop(drain=False)
+
+
 # ---------------- Chrome trace_event export ----------------
 
 
@@ -327,6 +418,43 @@ def test_chrome_counter_tracks_ride_export():
     assert "transfer.bytes" in names
     byte_samples = [e for e in cs if e["name"] == "transfer.bytes"]
     assert byte_samples[-1]["args"] == {"h2d": 256, "d2h": 32}
+
+
+def test_chrome_fleet_export_per_replica_tracks():
+    """ISSUE 20: ``spans?format=chrome&fleet=true`` renders each
+    replica as its OWN process track (pid 2+replica, named) on one
+    clock, host-side work stays on pid 1 — and the nested-B/E golden
+    criterion still holds."""
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.main.command_handler import CommandHandler
+    svcs = [vs.VerifyService(verifier=_OracleVerifier(), replica=i)
+            for i in range(2)]
+    fl = fleet_mod.FleetRouter(services=svcs,
+                               divergence_every=10 ** 6).start()
+    try:
+        tkts = [fl.submit(_sigs(1), lane="bulk", tenant=f"t{i}")
+                for i in range(8)]
+        for t in tkts:
+            assert t.result(timeout=30).all()
+    finally:
+        fl.stop(drain=True, timeout=30)
+    out = CommandHandler.cmd_spans(
+        None, {"format": ["chrome"], "fleet": ["true"]})
+    out = _validate_chrome(out)
+    evs = out["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert {2, 3} <= pids, pids        # both replica tracks present
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert pnames.get(1) == "host"
+    assert pnames.get(2) == "replica 0"
+    assert pnames.get(3) == "replica 1"
+    # replica-side verdicts land on their replica's track
+    verdicts = [e for e in evs if e["name"] == "service.verdict"]
+    assert verdicts and all(e["pid"] in (2, 3) for e in verdicts)
+    # the single-process export is unchanged (pid 1 only)
+    flat = CommandHandler.cmd_spans(None, {"format": ["chrome"]})
+    assert {e["pid"] for e in flat["traceEvents"]} == {1}
 
 
 def test_chrome_trace_cross_thread_child_is_own_track():

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Unified-journal self-check (ISSUE 20) — the tier-1 ``JOURNAL_OK``
+gate.
+
+Three phases, one JSON record, exit 0 = every gate passed:
+
+* **wire chaos + stitching** — a 3-replica slow-verifier fleet
+  behind the :class:`~stellar_tpu.crypto.ingress.IngressServer`,
+  four flooder clients pumping real loopback wire traffic, one
+  replica KILLED mid-flood, then a zero-loss drain. Gates: 100% of
+  the sampled verdict trace IDs reconstruct end-to-end
+  wire -> route -> enqueue -> verdict INCLUDING any handoff hops
+  (``trace.stitch_frac == 1.0``, seam-free); at least one re-homed
+  trace actually crossed replicas; the journal completeness gap is
+  EXACTLY 0 against the fleet + ingress conservation counters; and
+  two independently collected+merged journals are bit-identical over
+  the deterministic components.
+* **merge determinism** — two never-started fleets (single-threaded
+  manual drain — fleet_selfcheck's discipline) are driven with the
+  IDENTICAL submission stream and the same mid-stream replica kill;
+  their journals must merge to bit-identical canonical bytes, each
+  with completeness gap 0.
+* **lint discipline** — ``utils/journal.py`` sits in BOTH the
+  nondeterminism-lint scope and the lock-discipline scope with NO
+  allowlist entry in either, and all three lints run clean.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from soak import _env_setup                      # noqa: E402
+from fleet_selfcheck import (                    # noqa: E402
+    KEY_GRID, _manual_drain, _never_started_fleet)
+
+# the chaos window must fit the recorder ring whole — stitching needs
+# every sampled trace's FIRST event (the wire frame) still retained
+RING_CAPACITY = 65536
+
+
+def _items(i: int, n: int):
+    pk = bytes([(i * 31 + j) % 251 + 1 for j in range(32)])
+    return [(pk, b"journal-%d-%d" % (i, k),
+             bytes([(i + k) % 251]) * 64) for k in range(n)]
+
+
+def chaos_phase(problems: list) -> dict:
+    """Flooded wire fleet + mid-run kill: stitch_frac, completeness
+    gap, bit-identical double collection."""
+    import numpy as np
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import ingress as ingress_mod
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils import journal, tracing
+
+    class SlowVerifier:
+        # slow enough that the kill finds queued work to hand off
+        def submit(self, items, trace_ids=None):
+            n = len(items)
+
+            def resolve():
+                time.sleep(0.02)
+                return np.ones(n, dtype=bool)
+            return resolve
+
+    tracing.flight_recorder.configure(capacity=RING_CAPACITY)
+    tracing.flight_recorder.clear()
+    svcs = [vs.VerifyService(verifier=SlowVerifier(), lane_depth=512,
+                             lane_bytes=10 ** 9, replica=i)
+            for i in range(3)]
+    fl = fleet_mod.FleetRouter(services=svcs,
+                               divergence_every=1_000_000).start()
+    srv = ingress_mod.IngressServer(fl)
+    srv.start()
+    port = srv.port
+
+    tkts = []
+    tlock = threading.Lock()
+    stop_pump = threading.Event()
+
+    def pump(ci):
+        cli = ingress_mod.WireClient("127.0.0.1", port)
+        i = 0
+        while not stop_pump.is_set():
+            try:
+                t = cli.submit(_items(ci * 1000 + i, 4),
+                               lane="bulk", tenant="t%d" % ci)
+            except (ConnectionError, OSError):
+                break
+            with tlock:
+                tkts.append(t)
+            i += 1
+            time.sleep(0.002)
+
+    pumps = [threading.Thread(target=pump, args=(c,))
+             for c in range(4)]
+    for t in pumps:
+        t.start()
+    time.sleep(0.4)
+    moved = fl.kill_replica(0, stop_timeout=30)
+    time.sleep(0.2)
+    stop_pump.set()
+    for t in pumps:
+        t.join()
+    srv.stop()
+    for _ in range(100):
+        with tlock:
+            if all(t.done() for t in tkts):
+                break
+        time.sleep(0.05)
+
+    resolved_ids, resolved = [], 0
+    shed = failed = unresolved = 0
+    for tkt in tkts:
+        if not tkt.done():
+            unresolved += 1
+            continue
+        try:
+            tkt.result(timeout=0)
+            resolved += 1
+            if tkt.trace_lo is not None:
+                resolved_ids.append(tkt.trace_lo)
+        except vs.Overloaded:
+            shed += 1
+        except BaseException:        # noqa: BLE001 — typed terminal
+            failed += 1
+    fl.stop()
+
+    if unresolved:
+        problems.append(f"{unresolved} wire tickets never resolved "
+                        "through the kill+stop drain")
+    if moved == 0:
+        problems.append("the mid-flood kill found nothing to hand "
+                        "off — the handoff stitch went unexercised")
+    if resolved == 0:
+        problems.append("chaos phase resolved nothing — no load")
+
+    # 100% of sampled verdict traces stitch wire -> verdict, seamless
+    frac = journal.stitch_fraction(
+        resolved_ids, tracing.flight_recorder,
+        require=("wire", "route", "enqueue", "terminal"))
+    if frac != 1.0:
+        problems.append(
+            f"trace.stitch_frac {frac} != 1.0 over "
+            f"{len(resolved_ids)} sampled verdict traces")
+    hopped = 0
+    for tid in resolved_ids:
+        st = tracing.flight_recorder.trace_timeline(tid)["stitch"]
+        if st["handoffs"] > 0 and st["end_to_end"]:
+            hopped += 1
+    if moved and hopped == 0:
+        problems.append(
+            "no resolved trace shows a stitched handoff hop despite "
+            f"{moved} handed-off items")
+
+    # completeness law, exactly 0, against fleet + ingress counters
+    col1 = journal.collect(fleet=fl, ingress=srv)
+    col2 = journal.collect(fleet=fl, ingress=srv)
+    m1 = journal.merge(col1, col2)
+    m2 = journal.merge(col2, col1)
+    comp = journal.completeness(m1, drained=True)
+    if comp["gap"] != 0:
+        bad = {k: v for k, v in comp["checks"].items() if v}
+        problems.append(
+            f"journal completeness gap {comp['gap']} != 0: {bad}")
+    if journal.canonical(m1) != journal.canonical(m2):
+        problems.append(
+            "two independently-merged journals are NOT bit-identical "
+            "over the deterministic components")
+
+    return {"tickets": len(tkts), "resolved": resolved,
+            "shed": shed, "failed": failed,
+            "unresolved": unresolved, "handoff_moved": moved,
+            "stitched_handoff_traces": hopped,
+            "sampled_traces": len(resolved_ids),
+            "stitch_frac": frac,
+            "completeness_gap": comp["gap"],
+            "wrapped": comp["wrapped"],
+            "events": len(m1["events"])}
+
+
+def _drive_plan(count: int = 96, kill_at: int = 48) -> list:
+    """One pre-allocated submission plan both fleets replay: the
+    trace blocks are reserved ONCE so the two fleets journal the
+    SAME trace IDs (the allocator is process-global)."""
+    from stellar_tpu.crypto import verify_service as vs
+    plan = []
+    for i in range(count):
+        lane, tenant = KEY_GRID[i % len(KEY_GRID)]
+        items = _items(i, 2)
+        plan.append((i == kill_at, lane, tenant,
+                     vs._alloc_trace_block(len(items)), items))
+    return plan
+
+
+def _replay(fl, svcs, plan) -> None:
+    from stellar_tpu.utils.resilience import Overloaded
+    for kill, lane, tenant, lo, items in plan:
+        if kill:
+            fl.kill_replica(0, stop_timeout=0)
+        try:
+            fl.submit(items, lane=lane, tenant=tenant, trace_lo=lo)
+        except Overloaded:
+            pass
+    for svc in svcs[1:]:
+        _manual_drain(svc)
+
+
+def determinism_phase(problems: list) -> dict:
+    """Two never-started fleets, identical stream + kill: journals
+    must merge bit-identically, completeness gap 0 on both."""
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils import journal
+
+    plan = _drive_plan()
+    fa, sa = _never_started_fleet(fleet_mod, vs)
+    fb, sb = _never_started_fleet(fleet_mod, vs)
+    _replay(fa, sa, plan)
+    _replay(fb, sb, plan)
+    ma = journal.merge(journal.collect(fleet=fa))
+    mb = journal.merge(journal.collect(fleet=fb))
+    identical = journal.canonical(ma) == journal.canonical(mb)
+    if not identical:
+        problems.append(
+            "two fleets fed the identical stream produced "
+            "DIVERGENT journals")
+    gaps = []
+    for name, m in (("a", ma), ("b", mb)):
+        comp = journal.completeness(m)
+        gaps.append(comp["gap"])
+        if comp["gap"] != 0:
+            bad = {k: v for k, v in comp["checks"].items() if v}
+            problems.append(
+                f"fleet {name} completeness gap {comp['gap']}: {bad}")
+    return {"identical": identical, "gaps": gaps,
+            "events": len(ma["events"]),
+            "plan": len(plan)}
+
+
+def lint_phase(problems: list) -> dict:
+    """journal.py scoped by BOTH lints, allowlisted by NEITHER; all
+    three lints clean."""
+    from stellar_tpu.analysis import lockorder, locks, nondet
+    mod = "stellar_tpu/utils/journal.py"
+    if mod not in set(nondet.HOST_ORACLE_FILES):
+        problems.append(f"{mod} missing from the nondet scope")
+    if mod in nondet.ALLOWLIST._entries:
+        problems.append(
+            f"{mod} grew a nondet allowlist entry — the journal "
+            "must stay clock/RNG-free, not excused")
+    if mod not in set(locks.SCOPE):
+        problems.append(f"{mod} missing from the lock scope")
+    if mod in locks.ALLOWLIST._entries:
+        problems.append(f"{mod} grew a lock allowlist entry")
+    if mod in lockorder.ALLOWLIST._entries:
+        problems.append(f"{mod} grew a lock-order allowlist entry")
+    nrep = nondet.run()
+    if not nrep.ok:
+        problems.append(
+            f"nondet lint not clean: "
+            f"{[f.key for f in nrep.findings][:4]}")
+    lrep = locks.run()
+    if not lrep.ok:
+        problems.append(
+            f"lock lint not clean: "
+            f"{[f.key for f in lrep.findings][:4]}")
+    orep = lockorder.run()
+    if not orep.ok:
+        problems.append(
+            f"lock-order prover not clean: "
+            f"{[f.key for f in orep.findings][:4]}")
+    return {"nondet_ok": nrep.ok, "locks_ok": lrep.ok,
+            "lockorder_ok": orep.ok}
+
+
+def main() -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args()
+    _env_setup(False)
+    problems: list = []
+    rec = {
+        "chaos": chaos_phase(problems),
+        "determinism": determinism_phase(problems),
+        "lints": lint_phase(problems),
+    }
+    rec["ok"] = not problems
+    rec["problems"] = problems
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

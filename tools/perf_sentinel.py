@@ -201,6 +201,20 @@ RULES = [
      "malformed wire-frame count changed (expected to vary with the "
      "armed wire fault shapes; review the ingress record if "
      "surprising)"),
+    # unified system journal (ISSUE 20): the journal completeness
+    # residual is a HARD zero — the merged journal's admissions and
+    # terminals reconcile EXACTLY with the service/fleet/ingress
+    # conservation counters and every admitted trace reaches exactly
+    # one terminal over the retained window; and on a selfcheck
+    # window every sampled verdict trace must stitch end-to-end
+    # (seam-free through any handoff hops).
+    ("journal.completeness_gap", "max_abs", 0,
+     "journal completeness residual nonzero — the merged journal "
+     "disagrees with the conservation counters or a trace carries "
+     "the wrong number of terminals"),
+    ("trace.stitch_frac", "min_value", 1.0,
+     "a sampled verdict trace failed to reconstruct its stitched "
+     "end-to-end timeline on a selfcheck window"),
     # pipeline-bubble profiler (ISSUE 10): the async-dispatch PR's
     # before/after numbers. busy_frac down = more device idle per
     # resolve; overlap_frac down = host prep stopped hiding behind

@@ -12,14 +12,26 @@ human-readable report.
 Three sources:
 
 * ``--url http://127.0.0.1:11626`` — scrape a RUNNING node's admin
-  routes;
+  routes; a COMMA-SEPARATED list (``--url http://a:1,http://b:1``)
+  scrapes every replica and renders per-replica columns in the
+  Fleet/Ingress tables (ISSUE 20 federation — at most
+  ``MAX_REPLICA_COLS`` named columns, the rest rolled into
+  ``~other``, the same cardinality discipline as the tenant top-k
+  gauges);
 * ``tools/soak.py --emit-telemetry-report [PATH]`` — the soak harness
   calls :func:`collect_local` + :func:`render_report` in-process at
   the end of a green window;
 * no URL — run a small synthetic in-process window (host-only verify
-  service flood + a scripted pipeline resolve + time-series sampling)
-  and render it: a self-contained demo plus a smoke test of the
-  renderer.
+  service flood + a scripted pipeline resolve + time-series sampling
+  + a 3-replica fleet whose per-replica columns exercise the
+  federated tables WITHOUT sockets) and render it: a self-contained
+  demo plus a smoke test of the renderer.
+
+The report also carries the unified-journal section (completeness
+gap, retained events) and the anomaly CORRELATOR: each time-series
+excursion is joined with the decision-kind journal events of the
+same scrape window — the journal is deliberately clock-free
+(seq-ordered), so the join is window-granular by design.
 
 ``--out report.md`` writes the file (default stdout). See
 ``docs/observability.md`` §9.
@@ -47,6 +59,22 @@ REPORT_SERIES_PREFIXES = (
 )
 MAX_SERIES_ROWS = 40
 TOP_TRACES = 3
+# federation cardinality guard: at most this many NAMED per-replica
+# columns; further replicas fold into one `~other` rollup column
+MAX_REPLICA_COLS = 4
+# journal kinds that answer "what was the system deciding" — what the
+# anomaly correlator surfaces under each time-series excursion
+DECISION_KINDS = ("control", "shed", "refused", "handoff", "convict",
+                  "rejected", "dispatch")
+# series prefix -> journal component prefixes it most plausibly
+# implicates (the correlator prefers affine events, falls back to any
+# decision event in the window)
+_SERIES_AFFINITY = (
+    ("crypto.verify.control.", ("control/",)),
+    ("crypto.verify.service.", ("replica/", "decisions/")),
+    ("crypto.verify.ingress.", ("fleet",)),
+    ("crypto.verify.fleet.", ("fleet",)),
+)
 
 
 # ---------------- collection ----------------
@@ -79,7 +107,29 @@ def collect_local(top_traces: int = TOP_TRACES) -> dict:
         "timeseries": timeseries.snapshot(),
         "transfer": transfer_ledger.totals(),
         "traces": traces,
+        "journal": _journal_local(),
     }
+
+
+def _journal_local(event_tail: int = 64):
+    """The unified-journal section from this process's live
+    components (same sources as the ``journal`` admin route); None
+    when nothing is running to journal."""
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.crypto import ingress as ingress_mod
+    from stellar_tpu.crypto import verify_service as vs
+    from stellar_tpu.utils import journal as journal_mod
+
+    fl = fleet_mod.running_fleet()
+    svc = None if fl is not None else vs.running_service()
+    if fl is None and svc is None:
+        return None
+    merged = journal_mod.merge(journal_mod.collect(
+        fleet=fl, services=[svc] if svc is not None else None,
+        ingress=ingress_mod.running_server()))
+    return {"totals": merged["totals"], "nondet": merged["nondet"],
+            "completeness": journal_mod.completeness(merged),
+            "events": merged["events"][-event_tail:]}
 
 
 def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
@@ -106,6 +156,13 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
     except Exception:
         # pre-ingress nodes have no such route
         ingress = {"enabled": False}
+    try:
+        journal = get("journal?limit=64")
+        if journal.get("error"):
+            journal = None
+    except Exception:
+        # pre-journal nodes have no such route
+        journal = None
     return {
         "slo": get("slo"),
         "service": get("service"),
@@ -117,7 +174,67 @@ def collect_url(url: str, top_traces: int = TOP_TRACES) -> dict:
         "timeseries": get("timeseries"),
         "transfer": dispatch.get("transfer", {}),
         "traces": traces,
+        "journal": journal,
     }
+
+
+def collect_url_fleet(urls, top_traces: int = TOP_TRACES) -> dict:
+    """Scrape a comma-separated replica list. The FIRST url anchors
+    every single-node section of the report; every url contributes a
+    per-replica column to the federated Fleet/Ingress tables."""
+    datas = [collect_url(u, top_traces if i == 0 else 0)
+             for i, u in enumerate(urls)]
+    data = datas[0]
+    data["federation"] = _federate(
+        [(_url_label(u), d) for u, d in zip(urls, datas)])
+    return data
+
+
+def _url_label(url: str) -> str:
+    """host:port — the column header a scraped replica renders as."""
+    u = url.strip().rstrip("/")
+    for scheme in ("http://", "https://"):
+        if u.startswith(scheme):
+            u = u[len(scheme):]
+    return u
+
+
+def _federate(pairs) -> dict:
+    """Fold N per-replica views into the federated column set: at
+    most ``MAX_REPLICA_COLS`` named columns; every further replica is
+    summed into one ``~other`` rollup column (the same cardinality
+    guard the tenant top-k gauges use — replica count must never grow
+    the rendered surface unboundedly)."""
+    cols: dict = {}
+    folded = 0
+    for label, d in pairs:
+        svc = d.get("service") or {}
+        tot = svc.get("totals") or {}
+        ing = d.get("ingress") or {}
+        if not ing.get("enabled"):
+            ing = {}
+        comp = (d.get("journal") or {}).get("completeness") or {}
+        row = {
+            "submitted": tot.get("submitted", 0),
+            "verified": tot.get("verified", 0),
+            "shed": tot.get("shed", 0),
+            "pending": svc.get("pending_items", 0),
+            "conservation_gap": svc.get("conservation_gap"),
+            "journal_gap": comp.get("gap"),
+            "frames_received": ing.get("frames_received"),
+            "malformed_frames": ing.get("malformed_frames"),
+            "wire_pending": ing.get("pending"),
+        }
+        if len(cols) < MAX_REPLICA_COLS:
+            cols[label] = row
+        else:
+            folded += 1
+            other = cols.setdefault(
+                "~other", {k: None for k in row})
+            for k, v in row.items():
+                if v is not None:
+                    other[k] = (other[k] or 0) + v
+    return {"columns": cols, "folded": folded}
 
 
 def _recent_trace_ids(records, n: int) -> list:
@@ -149,6 +266,39 @@ def _fmt(v, nd=2):
     if isinstance(v, float):
         return f"{v:.{nd}f}"
     return str(v)
+
+
+def correlate_anomaly(anomaly: dict, journal, tail: int = 4) -> list:
+    """Join one time-series excursion with the decision-kind journal
+    events of the same scrape window — "what was the system deciding
+    when this spike happened". The journal is deliberately clock-free
+    (seq-ordered, never timestamped), so the join is window-granular
+    by design: the correlator prefers events from components the
+    series prefix implicates (``_SERIES_AFFINITY``) and falls back to
+    ANY decision event retained in the window; returns up to ``tail``
+    one-line descriptions, newest last."""
+    events = (journal or {}).get("events") or []
+    decisions = [e for e in events
+                 if e.get("kind") in DECISION_KINDS]
+    prefixes = ()
+    for sp, comps in _SERIES_AFFINITY:
+        if str(anomaly.get("series", "")).startswith(sp):
+            prefixes = comps
+            break
+    affine = [e for e in decisions
+              if str(e.get("component", "")).startswith(prefixes)] \
+        if prefixes else []
+    out = []
+    for e in (affine or decisions)[-tail:]:
+        desc = (f"{e.get('component')}#{e.get('seq')} "
+                f"{e.get('kind')}")
+        detail = e.get("reason") or e.get("action")
+        if detail:
+            desc += f" ({detail})"
+        if e.get("trace_lo") is not None:
+            desc += f" traces[{e['trace_lo']}+{e.get('n')}]"
+        out.append(desc)
+    return out
 
 
 def _series_stats(samples):
@@ -283,6 +433,26 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
                 f"| {row.get('conservation_gap')} |")
         lines.append("")
 
+    # ---- federated per-replica columns (ISSUE 20) ----
+    fed = data.get("federation") or {}
+    fcols = fed.get("columns") or {}
+    if fcols:
+        labels = list(fcols)
+        lines += ["## Federated replicas", "",
+                  f"{len(labels)} per-replica columns "
+                  f"({fed.get('folded', 0)} further replicas folded "
+                  "into `~other` — the cardinality guard caps named "
+                  f"columns at {MAX_REPLICA_COLS}).", "",
+                  "| metric | " + " | ".join(labels) + " |",
+                  "|---|" + "---|" * len(labels)]
+        for metric in ("submitted", "verified", "shed", "pending",
+                       "conservation_gap", "journal_gap"):
+            lines.append(
+                f"| {metric} | " + " | ".join(
+                    _fmt(fcols[c].get(metric)) for c in labels)
+                + " |")
+        lines.append("")
+
     # ---- wire ingress ----
     ing = data.get("ingress") or {}
     if ing.get("enabled"):
@@ -320,6 +490,20 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
             f"{pool.get('buf_bytes', 0)}B buffers, "
             f"{pool.get('misses', 0)} misses "
             f"({pool.get('outstanding', 0)} outstanding)", ""]
+        wire_cols = {c: r for c, r in fcols.items()
+                     if r.get("frames_received") is not None}
+        if wire_cols:
+            wl = list(wire_cols)
+            lines += ["### Per-replica wire columns", "",
+                      "| metric | " + " | ".join(wl) + " |",
+                      "|---|" + "---|" * len(wl)]
+            for metric in ("frames_received", "malformed_frames",
+                           "wire_pending"):
+                lines.append(
+                    f"| {metric} | " + " | ".join(
+                        _fmt(wire_cols[c].get(metric)) for c in wl)
+                    + " |")
+            lines.append("")
 
     # ---- pipeline bubbles ----
     pipe = data.get("pipeline") or {}
@@ -397,6 +581,8 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
                 lines.append(f"- `{a['series']}` at t={a['t_s']}s: "
                              f"value {_fmt(a['value'])} vs baseline "
                              f"{_fmt(a['mu'])} (z={a['z']})")
+                for ev in correlate_anomaly(a, data.get("journal")):
+                    lines.append(f"  - journal: `{ev}`")
         lines.append("")
     else:
         lines += ["No time-series samples in this window (was the "
@@ -415,6 +601,24 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
                   f"- conservation gap: "
                   f"**{svc.get('conservation_gap')}** (must be 0)",
                   ""]
+
+    # ---- unified journal (ISSUE 20) ----
+    jr = data.get("journal") or {}
+    if jr:
+        comp = jr.get("completeness") or {}
+        lines += ["## Unified journal", "",
+                  f"- {len(jr.get('totals') or {})} deterministic "
+                  f"components + {len(jr.get('nondet') or {})} "
+                  "nondeterministic (wire) sections",
+                  f"- events in the scraped tail: "
+                  f"{len(jr.get('events') or [])}",
+                  f"- completeness gap: **{comp.get('gap')}** "
+                  "(must be 0 — docs/observability.md §12)"]
+        if comp.get("wrapped"):
+            lines.append(
+                "- wrapped components (exactly-once check skipped): "
+                + ", ".join(comp["wrapped"]))
+        lines.append("")
 
     # ---- top traces ----
     traces = data.get("traces") or []
@@ -445,11 +649,14 @@ def render_report(data: dict, title: str = "Telemetry report") -> str:
 # ---------------- synthetic demo window ----------------
 
 
-def synthetic_window() -> None:
+def synthetic_window() -> dict:
     """A small host-only window so the default invocation renders a
     complete report with no device and no running node: a verify
     service flood over a stub-fast verifier, a scripted pipeline
-    resolve, and time-series sampling."""
+    resolve, and time-series sampling. Returns the extra ISSUE 20
+    sections — the unified journal of the demo fleet and a 3-replica
+    federation built from in-process service views (NO sockets), so
+    the per-replica tables are exercised by the bare demo."""
     import numpy as np
 
     from stellar_tpu.crypto import verify_service as vs
@@ -518,24 +725,48 @@ def synthetic_window() -> None:
         t.result(timeout=30)
     cli.close()
     srv.stop()
+    # ISSUE 20: the demo's journal + a per-replica federation built
+    # straight from the in-process service views (no sockets)
+    from stellar_tpu.utils import journal as journal_mod
+    merged = journal_mod.merge(
+        journal_mod.collect(fleet=fl, ingress=srv))
+    jr = {"totals": merged["totals"], "nondet": merged["nondet"],
+          "completeness": journal_mod.completeness(merged),
+          "events": merged["events"][-64:]}
+    pairs = []
+    for i, rsvc in enumerate(fl.services()):
+        snap = rsvc.snapshot()
+        pairs.append((f"replica/{i}", {
+            "service": {
+                "totals": snap["totals"],
+                "pending_items": snap["pending_items"],
+                "conservation_gap": snap["conservation_gap"]},
+            "journal": jr if i == 0 else None}))
+    fed = _federate(pairs)
     fl.stop(drain=True, timeout=30)
     timeseries.sample_once()
+    return {"journal": jr, "federation": fed}
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default=None,
-                    help="admin base URL of a running node "
+                    help="admin base URL of a running node, or a "
+                         "comma-separated replica list for a "
+                         "federated report "
                          "(default: synthetic local window)")
     ap.add_argument("--out", default=None,
                     help="output path (default: stdout)")
     ap.add_argument("--title", default="Telemetry report")
     args = ap.parse_args()
     if args.url:
-        data = collect_url(args.url)
+        urls = [u.strip() for u in args.url.split(",") if u.strip()]
+        data = (collect_url(urls[0]) if len(urls) == 1
+                else collect_url_fleet(urls))
     else:
-        synthetic_window()
+        extras = synthetic_window()
         data = collect_local()
+        data.update(extras)
     text = render_report(data, title=args.title)
     if args.out:
         with open(args.out, "w") as f:

@@ -11,7 +11,12 @@ Two sources:
 
 * ``--url http://127.0.0.1:11626`` — scrape a RUNNING node's
   ``spans?format=chrome`` admin route (the recorder that explains the
-  node's last breaker trip / shed onset / audit mismatch);
+  node's last breaker trip / shed onset / audit mismatch); add
+  ``--fleet`` to request the whole-fleet window instead
+  (``spans?format=chrome&fleet=true``, ISSUE 20): per-replica
+  process tracks merged on one clock, so a handed-off trace's hop
+  from the killed replica to the survivor reads as adjacent tracks
+  in one Perfetto load;
 * no URL — run one synthetic host-only resolve in THIS process (the
   ``tools/metrics_selfcheck.py`` shape: real span-instrumented code
   path, no device, seconds) plus a scripted two-device pipeline
@@ -81,11 +86,42 @@ def synthetic_trace() -> dict:
     return tracing.flight_recorder.to_chrome_trace()
 
 
-def fetch_trace(url: str) -> dict:
+def synthetic_fleet_trace() -> dict:
+    """A 3-replica in-process fleet window exported with per-replica
+    process tracks (``to_chrome_trace(by_replica=True)``) — the
+    no-device, no-socket demo of the whole-fleet export (ISSUE 20)."""
+    import numpy as np
+
+    from stellar_tpu.crypto import fleet as fleet_mod
+    from stellar_tpu.utils import tracing
+
+    class _Instant:
+        def submit(self, items, trace_ids=None):
+            n = len(items)
+            return lambda: np.ones(n, dtype=bool)
+
+    tracing.flight_recorder.clear()
+    synthetic_pipeline_window()
+    fl = fleet_mod.FleetRouter(verifier=_Instant(),
+                               replicas=3).start()
+    tkts = []
+    for i in range(12):
+        pk = bytes([(i * 29 + j) % 251 + 1 for j in range(32)])
+        items = [(pk, b"fleettrace-%d-%d" % (i, k),
+                  bytes([(i + k) % 251]) * 64) for k in range(2)]
+        tkts.append(fl.submit(items, lane="bulk",
+                              tenant=f"t{i % 3}"))
+    for t in tkts:
+        t.result(timeout=30)
+    fl.stop(drain=True, timeout=30)
+    return tracing.flight_recorder.to_chrome_trace(by_replica=True)
+
+
+def fetch_trace(url: str, fleet: bool = False) -> dict:
     import urllib.request
+    route = "/spans?format=chrome" + ("&fleet=true" if fleet else "")
     with urllib.request.urlopen(
-            url.rstrip("/") + "/spans?format=chrome",
-            timeout=10) as resp:
+            url.rstrip("/") + route, timeout=10) as resp:
         return json.loads(resp.read().decode())
 
 
@@ -94,10 +130,19 @@ def main() -> int:
     ap.add_argument("--url", default=None,
                     help="admin base URL of a running node "
                          "(default: synthetic local resolve)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="whole-fleet window: per-replica process "
+                         "tracks merged on one clock "
+                         "(spans?format=chrome&fleet=true)")
     ap.add_argument("--out", default=None,
                     help="output path (default: stdout)")
     args = ap.parse_args()
-    trace = fetch_trace(args.url) if args.url else synthetic_trace()
+    if args.url:
+        trace = fetch_trace(args.url, fleet=args.fleet)
+    elif args.fleet:
+        trace = synthetic_fleet_trace()
+    else:
+        trace = synthetic_trace()
     text = json.dumps(trace)
     if args.out:
         with open(args.out, "w") as f:
